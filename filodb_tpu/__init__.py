@@ -1,0 +1,23 @@
+"""filodb_tpu — a TPU-native, Prometheus-compatible distributed time-series database.
+
+A ground-up rebuild of the capabilities of FiloDB (the Scala/Akka reference at
+/root/reference) designed for TPU hardware: PromQL range functions run as vmap'd
+JAX/XLA kernels over dense columnar chunk arrays, cross-series/cross-shard
+aggregation uses mesh collectives (psum) instead of actor scatter-gather, and a
+host-side Python/C++ runtime provides ingestion, the tag index, sharding,
+persistence and recovery.
+
+Layer map (mirrors SURVEY.md section 1):
+  memory/    columnar chunk format + codecs (ref: memory/ module)
+  core/      memstore, schemas, records, tag index (ref: core/ module)
+  ops/       TPU kernels for range/instant/aggregate functions (ref: query/exec/rangefn)
+  query/     LogicalPlan, ExecPlan, planners (ref: query/ + coordinator/queryplanner)
+  promql/    PromQL parser -> AST -> LogicalPlan (ref: prometheus/ module)
+  parallel/  shard mapping, device mesh execution, cluster controller (ref: coordinator/)
+  http/      Prometheus-compatible HTTP API (ref: http/ module)
+  ingest/    ingestion streams, gateway protocols (ref: kafka/ + gateway/)
+  persist/   column store, checkpoints, recovery (ref: cassandra/ + MetaStore)
+  downsample/ downsamplers + batch job (ref: core/downsample + spark-jobs/)
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,102 @@
+"""Typed runtime configuration.
+
+Mirrors the reference's layered HOCON config (ref:
+core/src/main/resources/filodb-defaults.conf) with plain dataclasses.  Defaults
+below reproduce the reference's documented defaults (stale-sample lookback,
+sample limits, spread, flush groups, chunk sizing).
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Any, Dict, List, Optional, Tuple
+
+
+@dataclasses.dataclass
+class QueryConfig:
+    """ref: filodb-defaults.conf:166-204 `filodb.query`."""
+    ask_timeout_s: float = 120.0
+    stale_sample_after_ms: int = 5 * 60 * 1000
+    sample_limit: int = 1_000_000
+    join_cardinality_limit: int = 25_000
+    group_by_cardinality_limit: int = 1_000
+    min_step_ms: int = 5_000
+    fastreduce_max_windows: int = 50
+    faster_rate: bool = True
+
+
+@dataclasses.dataclass
+class StoreConfig:
+    """Per-dataset store tuning (ref: core/.../store/IngestionConfig.scala:211 area,
+    conf/timeseries-dev-source.conf `store {}` block)."""
+    flush_interval_ms: int = 60 * 60 * 1000      # 1h chunk boundary
+    disk_time_to_live_s: int = 3 * 24 * 3600
+    max_chunks_size: int = 400                   # max samples per chunk
+    groups_per_shard: int = 60
+    shard_mem_size: int = 512 * 1024 * 1024
+    max_blob_buffer_size: int = 15 * 1024 * 1024
+    demand_paging_enabled: bool = True
+    multi_partition_odp: bool = False
+    # TPU-native addition: time-block length (samples) for dense device arrays.
+    device_block_rows: int = 128
+
+
+@dataclasses.dataclass
+class SpreadAssignment:
+    """Per-shard-key spread override (ref: filodb-defaults.conf:157-161)."""
+    shard_key: Dict[str, str]
+    spread: int
+
+
+@dataclasses.dataclass
+class FilodbSettings:
+    """Top-level settings (ref: coordinator/.../FilodbSettings.scala:127)."""
+    spread_default: int = 1
+    spread_assignment: List[SpreadAssignment] = dataclasses.field(default_factory=list)
+    query: QueryConfig = dataclasses.field(default_factory=QueryConfig)
+    store: StoreConfig = dataclasses.field(default_factory=StoreConfig)
+    shard_key_level_metrics: bool = True
+    quota_default: int = 2_000_000_000
+    reassignment_min_interval_s: float = 2 * 3600.0
+
+    def spread_for(self, shard_key: Dict[str, str]) -> int:
+        for a in self.spread_assignment:
+            if all(shard_key.get(k) == v for k, v in a.shard_key.items()):
+                return a.spread
+        return self.spread_default
+
+    @classmethod
+    def from_json(cls, path: str) -> "FilodbSettings":
+        with open(path) as f:
+            raw = json.load(f)
+        s = cls()
+        for k, v in raw.get("query", {}).items():
+            setattr(s.query, k, v)
+        for k, v in raw.get("store", {}).items():
+            setattr(s.store, k, v)
+        s.spread_default = raw.get("spread_default", s.spread_default)
+        s.spread_assignment = [
+            SpreadAssignment(a["shard_key"], a["spread"])
+            for a in raw.get("spread_assignment", [])
+        ]
+        return s
+
+
+def compute_dtype():
+    """Value dtype for device kernels: float32 on TPU (f64 is emulated/slow),
+    float64 when x64 is enabled (CPU conformance tests)."""
+    import jax
+    import jax.numpy as jnp
+    return jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
+
+
+_SETTINGS: Optional[FilodbSettings] = None
+
+
+def settings() -> FilodbSettings:
+    global _SETTINGS
+    if _SETTINGS is None:
+        path = os.environ.get("FILODB_TPU_CONFIG")
+        _SETTINGS = FilodbSettings.from_json(path) if path else FilodbSettings()
+    return _SETTINGS

@@ -1,0 +1,254 @@
+"""DenseSeriesStore — the TPU-native working set for one (shard, schema).
+
+The reference keeps per-partition append buffers + immutable encoded chunks in
+off-heap block memory (ref: core/.../memstore/TimeSeriesPartition.scala:137-165,
+memory/.../BlockManager.scala).  TPUs want dense vectorized math over large
+arrays, so the rebuild keeps the query-hot working set as ONE dense
+[series, time] SoA matrix per schema per shard (SURVEY.md section 7 step 1-2):
+
+  ts      int64  [S_cap, T_cap]   sample timestamps (ms), per-series prefix-packed
+  col[x]  f64    [S_cap, T_cap]   values (or [S_cap, T_cap, B] for histograms)
+  counts  int32  [S_cap]          valid samples per series
+
+Appends are vectorized scatter writes; queries hand full rows to the device
+kernels which do window masking/searchsorted on-TPU.  Encoded chunks are
+produced at flush boundaries for persistence only (memory/chunks.py).
+Eviction drops the oldest samples per series in bulk (the BlockManager
+time-ordered reclaim analogue, ref: BlockManager.scala:16 reclaim ordering).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from filodb_tpu.core.schemas import Schema
+
+_PAD_TS = np.iinfo(np.int64).max
+
+
+class DenseSeriesStore:
+
+    def __init__(self, schema: Schema, initial_series: int = 1024,
+                 initial_time: int = 128, max_time_cap: int = 4096):
+        self.schema = schema
+        self.max_time_cap = max_time_cap
+        self._s_cap = initial_series
+        self._t_cap = initial_time
+        self.num_series = 0
+        self.generation = 0
+        self.num_buckets = 0
+        self.bucket_les: Optional[np.ndarray] = None
+        self.ts = np.full((self._s_cap, self._t_cap), _PAD_TS, dtype=np.int64)
+        self.counts = np.zeros(self._s_cap, dtype=np.int32)
+        self.sealed = np.zeros(self._s_cap, dtype=np.int32)  # flushed watermark
+        self.cols: Dict[str, np.ndarray] = {}
+        for c in schema.data_columns:
+            if c.col_type == "hist":
+                self.cols[c.name] = None  # allocated on first batch (needs B)
+            else:
+                self.cols[c.name] = np.full((self._s_cap, self._t_cap), np.nan)
+        self.dropped_out_of_order = 0
+
+    # ---- capacity management ----
+
+    def new_row(self) -> int:
+        if self.num_series >= self._s_cap:
+            self._grow_series(max(self._s_cap * 2, self.num_series + 1))
+        row = self.num_series
+        self.num_series += 1
+        return row
+
+    def _grow_series(self, new_cap: int) -> None:
+        def grow(arr, fill):
+            if arr is None:
+                return None
+            shape = (new_cap,) + arr.shape[1:]
+            out = np.full(shape, fill, dtype=arr.dtype)
+            out[: arr.shape[0]] = arr
+            return out
+        self.ts = grow(self.ts, _PAD_TS)
+        self.counts = grow(self.counts, 0)
+        self.sealed = grow(self.sealed, 0)
+        for name, arr in self.cols.items():
+            self.cols[name] = grow(arr, np.nan)
+        self._s_cap = new_cap
+
+    def _grow_time(self, need: int) -> None:
+        new_cap = self._t_cap
+        while new_cap < need:
+            new_cap *= 2
+        if new_cap > self.max_time_cap:
+            new_cap = max(need, self.max_time_cap)
+        def grow(arr, fill):
+            if arr is None:
+                return None
+            shape = (arr.shape[0], new_cap) + arr.shape[2:]
+            out = np.full(shape, fill, dtype=arr.dtype)
+            out[:, : arr.shape[1]] = arr
+            return out
+        self.ts = grow(self.ts, _PAD_TS)
+        for name, arr in self.cols.items():
+            self.cols[name] = grow(arr, np.nan)
+        self._t_cap = new_cap
+
+    def _ensure_hist(self, num_buckets: int, les: Optional[np.ndarray]) -> None:
+        for c in self.schema.data_columns:
+            if c.col_type == "hist" and self.cols[c.name] is None:
+                self.cols[c.name] = np.full(
+                    (self._s_cap, self._t_cap, num_buckets), np.nan)
+                self.num_buckets = num_buckets
+                self.bucket_les = None if les is None else np.asarray(les, float)
+
+    # ---- ingest ----
+
+    def append_batch(self, rows: np.ndarray, ts: np.ndarray,
+                     columns: Dict[str, np.ndarray],
+                     bucket_les: Optional[np.ndarray] = None) -> int:
+        """Vectorized multi-sample append.  `rows[i]` is the store row for
+        sample i; samples for a given series must be time-ascending within the
+        batch.  Out-of-order samples (vs what is already stored) are dropped,
+        matching the reference's ingest behavior.  Returns samples ingested."""
+        rows = np.asarray(rows, dtype=np.int64)
+        ts = np.asarray(ts, dtype=np.int64)
+        n = len(rows)
+        if n == 0:
+            return 0
+        if bucket_les is not None or any(
+                c.col_type == "hist" for c in self.schema.data_columns):
+            hist_col = next(c.name for c in self.schema.data_columns
+                            if c.col_type == "hist")
+            nb = columns[hist_col].shape[1] if columns[hist_col].ndim == 2 else 0
+            self._ensure_hist(nb, bucket_les)
+
+        # per-row occurrence number within this batch (vectorized cumcount)
+        order = np.argsort(rows, kind="stable")
+        sorted_rows = rows[order]
+        boundaries = np.concatenate([[0], np.flatnonzero(np.diff(sorted_rows)) + 1])
+        occ_sorted = np.arange(n) - np.repeat(boundaries, np.diff(
+            np.concatenate([boundaries, [n]])))
+        occ = np.empty(n, dtype=np.int64)
+        occ[order] = occ_sorted
+
+        pos = self.counts[rows].astype(np.int64) + occ
+
+        # drop out-of-order: sample ts must be > last stored ts for its series
+        last_ts = np.where(self.counts[rows] > 0,
+                           self.ts[rows, np.maximum(self.counts[rows] - 1, 0)],
+                           np.iinfo(np.int64).min)
+        ok = ts > last_ts
+        # also drop non-monotonic within batch (per series): ts must increase
+        # with occurrence; verify via sorted view
+        ts_sorted = ts[order]
+        ok_sorted = np.ones(n, dtype=bool)
+        same_series = np.zeros(n, dtype=bool)
+        same_series[1:] = sorted_rows[1:] == sorted_rows[:-1]
+        ok_sorted[1:] &= ~same_series[1:] | (ts_sorted[1:] > ts_sorted[:-1])
+        ok2 = np.empty(n, dtype=bool)
+        ok2[order] = ok_sorted
+        keep = ok & ok2
+        if not keep.all():
+            self.dropped_out_of_order += int((~keep).sum())
+            rows, ts, pos = rows[keep], ts[keep], pos[keep]
+            columns = {k: v[keep] for k, v in columns.items()}
+            if len(rows) == 0:
+                return 0
+            # recompute positions after drop
+            order = np.argsort(rows, kind="stable")
+            sr = rows[order]
+            b = np.concatenate([[0], np.flatnonzero(np.diff(sr)) + 1])
+            occ_s = np.arange(len(rows)) - np.repeat(
+                b, np.diff(np.concatenate([b, [len(rows)]])))
+            occ = np.empty(len(rows), dtype=np.int64)
+            occ[order] = occ_s
+            pos = self.counts[rows].astype(np.int64) + occ
+
+        need_t = int(pos.max()) + 1
+        if need_t > self._t_cap:
+            if need_t > self.max_time_cap:
+                self.evict_oldest(need_t - self.max_time_cap
+                                  + self.max_time_cap // 4)
+                pos = self.counts[rows].astype(np.int64) + occ
+                need_t = int(pos.max()) + 1
+            if need_t > self._t_cap:
+                self._grow_time(need_t)
+
+        self.ts[rows, pos] = ts
+        for c in self.schema.data_columns:
+            arr = columns[c.name]
+            if c.col_type == "hist":
+                self.cols[c.name][rows, pos, :] = arr
+            else:
+                self.cols[c.name][rows, pos] = arr
+        np.add.at(self.counts, rows, 1)
+        self.generation += 1
+        return len(rows)
+
+    # ---- eviction ----
+
+    def evict_oldest(self, nsamples: int) -> None:
+        """Evict up to `nsamples` of the oldest samples per series —
+        time-ordered reclaim, but NEVER beyond a series' sealed (persisted)
+        watermark: unflushed data must not be destroyed by another series
+        overflowing (the BlockManager reclaim-only-flushed-blocks guarantee,
+        ref: memory/.../BlockManager.scala reclaim ordering).  Series that have
+        nothing sealed are left intact; callers fall back to growing time
+        capacity instead."""
+        k = np.minimum(nsamples, self.sealed).astype(np.int64)   # per-series
+        if not k.any():
+            return
+        S, T = self.ts.shape
+        idx = np.arange(T, dtype=np.int64)[None, :] + k[:, None]
+        valid = idx < T
+        idx_c = np.where(valid, idx, T - 1)
+        rowi = np.arange(S, dtype=np.int64)[:, None]
+        self.ts = np.where(valid, self.ts[rowi, idx_c], _PAD_TS)
+        for name, arr in self.cols.items():
+            if arr is None:
+                continue
+            if arr.ndim == 3:
+                shifted = arr[rowi, idx_c, :]
+                shifted[~valid] = np.nan
+                self.cols[name] = shifted
+            else:
+                self.cols[name] = np.where(valid, arr[rowi, idx_c], np.nan)
+        self.counts = (self.counts - k).astype(np.int32)
+        self.sealed = (self.sealed - k).astype(np.int32)
+        self.generation += 1
+
+    # ---- query gather ----
+
+    @property
+    def time_used(self) -> int:
+        return int(self.counts.max()) if self.num_series else 0
+
+    def gather_rows(self, rows: np.ndarray) -> Tuple[np.ndarray, Dict[str, np.ndarray], np.ndarray]:
+        """Fancy-index full series rows for the device kernels.
+        Returns (ts [S, T_used], cols {name: [S, T_used(, B)]}, counts [S])."""
+        t_used = max(self.time_used, 1)
+        ts = self.ts[rows, :t_used]
+        cols = {name: (arr[rows, :t_used] if arr is not None else None)
+                for name, arr in self.cols.items()}
+        return ts, cols, self.counts[rows]
+
+    # ---- flush support ----
+
+    def unsealed_range(self, row: int) -> Tuple[int, int]:
+        return int(self.sealed[row]), int(self.counts[row])
+
+    def mark_sealed(self, row: int, upto: int) -> None:
+        self.sealed[row] = upto
+
+    def series_slice(self, row: int, lo: int, hi: int) -> Tuple[np.ndarray, Dict[str, np.ndarray]]:
+        ts = self.ts[row, lo:hi].copy()
+        cols = {}
+        for c in self.schema.data_columns:
+            arr = self.cols[c.name]
+            if arr is None:
+                cols[c.name] = np.zeros((hi - lo, 0))
+            elif c.col_type == "hist":
+                cols[c.name] = arr[row, lo:hi, :].copy()
+            else:
+                cols[c.name] = arr[row, lo:hi].copy()
+        return ts, cols

@@ -1,0 +1,228 @@
+"""PartKeyIndex — the tag index (Lucene equivalent).
+
+The reference indexes partKey -> tags/startTime/endTime/partId in Lucene with
+Equals/In/Prefix/Regex filters, label-values queries, and endTime ordering
+(ref: core/.../memstore/PartKeyLuceneIndex.scala:71,106-108; filter model
+core/.../query/KeyFilter.scala).  This implementation uses inverted posting
+lists (label -> value -> sorted int array of partIds) plus numpy start/end
+time arrays, so time-range intersection is a vectorized mask rather than a
+per-doc loop.  Posting lists use sorted numpy arrays — the roaring-bitmap
+moral equivalent — so AND/OR are array intersections.
+"""
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from filodb_tpu.core.partkey import PartKey
+
+MAX_TIME = (1 << 62)
+
+
+# ---- Column filters (ref: core/.../query/KeyFilter.scala Filter ADT) ----
+
+@dataclasses.dataclass(frozen=True)
+class ColumnFilter:
+    column: str
+
+
+@dataclasses.dataclass(frozen=True)
+class Equals(ColumnFilter):
+    value: str
+
+
+@dataclasses.dataclass(frozen=True)
+class NotEquals(ColumnFilter):
+    value: str
+
+
+@dataclasses.dataclass(frozen=True)
+class In(ColumnFilter):
+    values: Tuple[str, ...]
+
+
+@dataclasses.dataclass(frozen=True)
+class NotIn(ColumnFilter):
+    values: Tuple[str, ...]
+
+
+@dataclasses.dataclass(frozen=True)
+class EqualsRegex(ColumnFilter):
+    pattern: str
+
+
+@dataclasses.dataclass(frozen=True)
+class NotEqualsRegex(ColumnFilter):
+    pattern: str
+
+
+@dataclasses.dataclass(frozen=True)
+class Prefix(ColumnFilter):
+    prefix: str
+
+
+def _full_match(pattern: str, value: str) -> bool:
+    return re.fullmatch(pattern, value) is not None
+
+
+class PartKeyIndex:
+    """In-memory tag index for one shard."""
+
+    def __init__(self):
+        # label -> value -> list of partIds (kept as python list; frozen to
+        # numpy lazily on query, invalidated on append)
+        self._postings: Dict[str, Dict[str, List[int]]] = {}
+        self._frozen: Dict[Tuple[str, str], np.ndarray] = {}
+        self._start: np.ndarray = np.zeros(0, dtype=np.int64)
+        self._end: np.ndarray = np.zeros(0, dtype=np.int64)
+        self._part_keys: List[Optional[PartKey]] = []
+        self.num_docs = 0
+
+    # ---- write path ----
+
+    def add_partition(self, part_id: int, part_key: PartKey,
+                      start_time_ms: int, end_time_ms: int = MAX_TIME) -> None:
+        """ref: PartKeyLuceneIndex.addPartKey; endTime=MAX means still ingesting."""
+        if part_id >= len(self._part_keys):
+            grow = max(1024, part_id + 1 - len(self._part_keys))
+            self._part_keys.extend([None] * grow)
+            self._start = np.concatenate(
+                [self._start, np.zeros(grow, dtype=np.int64)])
+            self._end = np.concatenate(
+                [self._end, np.full(grow, MAX_TIME, dtype=np.int64)])
+        self._part_keys[part_id] = part_key
+        self._start[part_id] = start_time_ms
+        self._end[part_id] = end_time_ms
+        self._index_label("__name__", part_key.metric, part_id)
+        for k, v in part_key.tags:
+            self._index_label(k, v, part_id)
+        self.num_docs += 1
+
+    def _index_label(self, key: str, value: str, part_id: int) -> None:
+        self._postings.setdefault(key, {}).setdefault(value, []).append(part_id)
+        self._frozen.pop((key, value), None)
+
+    def update_end_time(self, part_id: int, end_time_ms: int) -> None:
+        """ref: PartKeyLuceneIndex.updatePartKeyWithEndTime (series stopped)."""
+        self._end[part_id] = end_time_ms
+
+    def start_time(self, part_id: int) -> int:
+        return int(self._start[part_id])
+
+    def end_time(self, part_id: int) -> int:
+        return int(self._end[part_id])
+
+    def part_key(self, part_id: int) -> Optional[PartKey]:
+        return self._part_keys[part_id] if part_id < len(self._part_keys) else None
+
+    # ---- read path ----
+
+    def _ids_for(self, key: str, value: str) -> np.ndarray:
+        arr = self._frozen.get((key, value))
+        if arr is None:
+            lst = self._postings.get(key, {}).get(value, [])
+            arr = np.asarray(lst, dtype=np.int64)
+            self._frozen[(key, value)] = arr
+        return arr
+
+    def _all_ids(self) -> np.ndarray:
+        ids = [i for i, pk in enumerate(self._part_keys[: self._live_len()])
+               if pk is not None]
+        return np.asarray(ids, dtype=np.int64)
+
+    def _live_len(self) -> int:
+        return len(self._part_keys)
+
+    def _match_filter(self, f: ColumnFilter) -> np.ndarray:
+        key = "__name__" if f.column in ("__name__", "_metric_") else f.column
+        values = self._postings.get(key, {})
+        if isinstance(f, Equals):
+            return self._ids_for(key, f.value)
+        if isinstance(f, In):
+            parts = [self._ids_for(key, v) for v in f.values]
+            return (np.unique(np.concatenate(parts)) if parts
+                    else np.zeros(0, dtype=np.int64))
+        if isinstance(f, Prefix):
+            parts = [self._ids_for(key, v) for v in values if v.startswith(f.prefix)]
+            return (np.unique(np.concatenate(parts)) if parts
+                    else np.zeros(0, dtype=np.int64))
+        if isinstance(f, EqualsRegex):
+            parts = [self._ids_for(key, v) for v in values if _full_match(f.pattern, v)]
+            return (np.unique(np.concatenate(parts)) if parts
+                    else np.zeros(0, dtype=np.int64))
+        if isinstance(f, (NotEquals, NotIn, NotEqualsRegex)):
+            universe = self._all_ids()
+            if isinstance(f, NotEquals):
+                excl = self._ids_for(key, f.value)
+            elif isinstance(f, NotIn):
+                ex = [self._ids_for(key, v) for v in f.values]
+                excl = np.concatenate(ex) if ex else np.zeros(0, dtype=np.int64)
+            else:
+                ex = [self._ids_for(key, v) for v in values if _full_match(f.pattern, v)]
+                excl = np.concatenate(ex) if ex else np.zeros(0, dtype=np.int64)
+            return np.setdiff1d(universe, excl, assume_unique=False)
+        raise TypeError(f"unsupported filter {f!r}")
+
+    def part_ids_from_filters(self, filters: Sequence[ColumnFilter],
+                              start_time_ms: int, end_time_ms: int,
+                              limit: Optional[int] = None) -> np.ndarray:
+        """AND of filters, intersected with [start,end] series liveness
+        (ref: PartKeyLuceneIndex.partIdsFromFilters; docs sorted by endTime)."""
+        ids: Optional[np.ndarray] = None
+        for f in filters:
+            cur = self._match_filter(f)
+            ids = cur if ids is None else np.intersect1d(ids, cur, assume_unique=False)
+            if ids.size == 0:
+                return ids
+        if ids is None:
+            ids = self._all_ids()
+        mask = (self._start[ids] <= end_time_ms) & (self._end[ids] >= start_time_ms)
+        ids = ids[mask]
+        # sort by endTime like the reference index ordering
+        ids = ids[np.argsort(self._end[ids], kind="stable")]
+        return ids[:limit] if limit is not None else ids
+
+    def label_values(self, label: str,
+                     filters: Sequence[ColumnFilter] = (),
+                     start_time_ms: int = 0, end_time_ms: int = MAX_TIME,
+                     limit: Optional[int] = None) -> List[str]:
+        key = "__name__" if label in ("__name__", "_metric_") else label
+        if not filters:
+            vals = sorted(self._postings.get(key, {}).keys())
+            return vals[:limit] if limit else vals
+        ids = set(self.part_ids_from_filters(filters, start_time_ms, end_time_ms).tolist())
+        out = set()
+        for value, plist in self._postings.get(key, {}).items():
+            if not ids.isdisjoint(plist):
+                out.add(value)
+        vals = sorted(out)
+        return vals[:limit] if limit else vals
+
+    def label_names(self, filters: Sequence[ColumnFilter] = (),
+                    start_time_ms: int = 0, end_time_ms: int = MAX_TIME) -> List[str]:
+        if not filters:
+            return sorted(self._postings.keys())
+        ids = set(self.part_ids_from_filters(filters, start_time_ms, end_time_ms).tolist())
+        out = set()
+        for key, vals in self._postings.items():
+            for plist in vals.values():
+                if not ids.isdisjoint(plist):
+                    out.add(key)
+                    break
+        return sorted(out)
+
+    def remove_partition(self, part_id: int) -> None:
+        """Eviction support (ref: PartKeyLuceneIndex.removePartKeys)."""
+        pk = self._part_keys[part_id]
+        if pk is None:
+            return
+        for k, v in [("__name__", pk.metric)] + list(pk.tags):
+            lst = self._postings.get(k, {}).get(v)
+            if lst and part_id in lst:
+                lst.remove(part_id)
+                self._frozen.pop((k, v), None)
+        self._part_keys[part_id] = None
+        self.num_docs -= 1

@@ -1,0 +1,78 @@
+"""TimeSeriesMemStore — per-node map of dataset -> shards.
+
+ref: core/.../memstore/TimeSeriesMemStore.scala:23 (setup creates shards,
+ingestStream interleaves flush with ingest, recovery APIs).
+"""
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, Optional, Tuple
+
+from filodb_tpu.config import FilodbSettings, settings as default_settings
+from filodb_tpu.core.records import RecordBatch
+from filodb_tpu.core.schemas import Schemas, DEFAULT_SCHEMAS
+from filodb_tpu.core.shard import TimeSeriesShard
+from filodb_tpu.core.store import ColumnStore, MetaStore, NullColumnStore, InMemoryMetaStore
+
+
+class TimeSeriesMemStore:
+
+    def __init__(self, schemas: Schemas = DEFAULT_SCHEMAS,
+                 column_store: Optional[ColumnStore] = None,
+                 meta_store: Optional[MetaStore] = None,
+                 config: Optional[FilodbSettings] = None):
+        self.schemas = schemas
+        self.config = config or default_settings()
+        self.column_store = column_store or NullColumnStore()
+        self.meta_store = meta_store or InMemoryMetaStore()
+        self._shards: Dict[str, Dict[int, TimeSeriesShard]] = {}
+
+    def setup(self, dataset: str, shard_num: int) -> TimeSeriesShard:
+        """ref: TimeSeriesMemStore.setup:60-72."""
+        shards = self._shards.setdefault(dataset, {})
+        if shard_num in shards:
+            return shards[shard_num]
+        shard = TimeSeriesShard(dataset, shard_num, self.schemas,
+                                self.column_store, self.meta_store, self.config)
+        shards[shard_num] = shard
+        return shard
+
+    def get_shard(self, dataset: str, shard_num: int) -> Optional[TimeSeriesShard]:
+        return self._shards.get(dataset, {}).get(shard_num)
+
+    def shards_for(self, dataset: str) -> List[TimeSeriesShard]:
+        return list(self._shards.get(dataset, {}).values())
+
+    def ingest(self, dataset: str, shard_num: int, batch: RecordBatch,
+               offset: int = -1) -> int:
+        shard = self.get_shard(dataset, shard_num)
+        if shard is None:
+            raise KeyError(f"shard {shard_num} of {dataset} not set up")
+        return shard.ingest(batch, offset)
+
+    def ingest_stream(self, dataset: str, shard_num: int,
+                      stream: Iterable[Tuple[RecordBatch, int]],
+                      flush_every: int = 0) -> int:
+        """Consume a stream of (batch, offset), interleaving round-robin group
+        flushes every `flush_every` batches (ref:
+        TimeSeriesMemStore.ingestStream:114-141 flush interleaving)."""
+        shard = self.get_shard(dataset, shard_num)
+        if shard is None:
+            raise KeyError(f"shard {shard_num} of {dataset} not set up")
+        total = 0
+        group = 0
+        for i, (batch, offset) in enumerate(stream):
+            total += shard.ingest(batch, offset)
+            if flush_every and (i + 1) % flush_every == 0:
+                shard.flush_group(group % shard._groups)
+                group += 1
+        return total
+
+    def recover_index(self, dataset: str, shard_num: int) -> int:
+        return self.setup(dataset, shard_num).recover_index()
+
+    def recover_stream(self, dataset: str, shard_num: int,
+                       batches: Iterable[Tuple[RecordBatch, int]]) -> int:
+        return self.setup(dataset, shard_num).recover_stream(batches)
+
+    def flush_all(self, dataset: str) -> int:
+        return sum(s.flush_all_groups() for s in self.shards_for(dataset))

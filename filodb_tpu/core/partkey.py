@@ -1,0 +1,132 @@
+"""Partition keys: canonical bytes, partition hash and shard-key hash.
+
+The reference's BinaryRecord v2 computes, per time series:
+  - partKey bytes: metric + tags serialized canonically
+    (ref: core/.../binaryrecord2/RecordBuilder.scala:188,313,
+     doc/binaryrecord-spec.md)
+  - partitionHash: xxHash32 of partKey bytes, excluding tags listed in
+    ignoreTagsOnPartitionKeyHash (e.g. `le`)
+  - shardKeyHash: hash of only the shard-key columns (_ws_, _ns_, _metric_)
+    with suffix stripping for _bucket/_count/_sum
+    (ref: RecordBuilder.scala:604-619, partition-schema options
+     filodb-defaults.conf:38-52)
+These two hashes drive shard routing (see parallel/shardmapper.py).
+"""
+from __future__ import annotations
+
+import dataclasses
+import struct
+from typing import Dict, Iterable, Mapping, Optional, Tuple
+
+from filodb_tpu.core.schemas import PartitionSchema
+from filodb_tpu.utils.hashing import xxhash32, xxhash64
+
+
+def _enc(s: bytes) -> bytes:
+    """2-byte-LE length-prefixed string (the BinaryRegionMedium framing,
+    ref: memory/.../format/BinaryRegion.scala:139) — label values may contain
+    any byte, so delimiters are not safe."""
+    return struct.pack("<H", len(s)) + s
+
+
+def _dec(data: bytes, off: int) -> Tuple[bytes, int]:
+    (n,) = struct.unpack_from("<H", data, off)
+    return data[off + 2: off + 2 + n], off + 2 + n
+
+
+@dataclasses.dataclass(frozen=True)
+class PartKey:
+    """One time series identity: metric name + sorted label pairs."""
+    metric: str
+    tags: Tuple[Tuple[str, str], ...]   # sorted by key
+
+    @staticmethod
+    def make(metric: str, tags: Mapping[str, str],
+             part_schema: Optional[PartitionSchema] = None) -> "PartKey":
+        """Normalizes tags, applying copyTags rules (ref: partition-schema
+        options.copyTags — derive _ns_ from exporter/job when absent)."""
+        t = dict(tags)
+        ps = part_schema or PartitionSchema()
+        for dest, sources in ps.options.copy_tags.items():
+            if dest not in t:
+                for src in sources:
+                    if src in t:
+                        t[dest] = t[src]
+                        break
+        t.pop("__name__", None)  # metric is carried separately
+        return PartKey(metric, tuple(sorted(t.items())))
+
+    @property
+    def tags_dict(self) -> Dict[str, str]:
+        return dict(self.tags)
+
+    def label(self, key: str) -> Optional[str]:
+        if key == "__name__" or key == "_metric_":
+            return self.metric
+        for k, v in self.tags:
+            if k == key:
+                return v
+        return None
+
+    def to_bytes(self) -> bytes:
+        """Canonical serialization — the identity used for dedup + hashing.
+        Length-prefixed so arbitrary label bytes cannot collide."""
+        parts = [_enc(self.metric.encode())]
+        for k, v in self.tags:
+            parts.append(_enc(k.encode()) + _enc(v.encode()))
+        return b"".join(parts)
+
+    @staticmethod
+    def from_bytes(data: bytes) -> "PartKey":
+        metric, off = _dec(data, 0)
+        tags = []
+        while off < len(data):
+            k, off = _dec(data, off)
+            v, off = _dec(data, off)
+            tags.append((k.decode(), v.decode()))
+        return PartKey(metric.decode(), tuple(tags))
+
+    def partition_hash(self, part_schema: Optional[PartitionSchema] = None) -> int:
+        """xxHash32 over canonical bytes excluding ignored tags (`le`)."""
+        ps = part_schema or PartitionSchema()
+        ignored = set(ps.options.ignore_tags_on_partition_key_hash)
+        parts = [_enc(self.metric.encode())]
+        for k, v in self.tags:
+            if k not in ignored:
+                parts.append(_enc(k.encode()) + _enc(v.encode()))
+        return xxhash32(b"".join(parts))
+
+    def shard_key(self, part_schema: Optional[PartitionSchema] = None) -> Dict[str, str]:
+        ps = part_schema or PartitionSchema()
+        out = {}
+        for col in ps.options.shard_key_columns:
+            if col == ps.options.metric_column:
+                out[col] = strip_metric_suffix(self.metric, ps)
+            else:
+                v = self.label(col)
+                if v is not None:
+                    out[col] = v
+        return out
+
+    def shard_key_hash(self, part_schema: Optional[PartitionSchema] = None) -> int:
+        ps = part_schema or PartitionSchema()
+        sk = self.shard_key(ps)
+        payload = b"".join(
+            _enc(k.encode()) + _enc(sk[k].encode())
+            for k in ps.options.shard_key_columns if k in sk)
+        return xxhash32(payload)
+
+    def __str__(self) -> str:
+        tags = ",".join(f'{k}="{v}"' for k, v in self.tags)
+        return f"{self.metric}{{{tags}}}"
+
+
+def strip_metric_suffix(metric: str, part_schema: Optional[PartitionSchema] = None) -> str:
+    """Prom histogram/summary series `foo_bucket`, `foo_count`, `foo_sum` share
+    the base metric's shard key so they land together
+    (ref: ignoreShardKeyColumnSuffixes, filodb-defaults.conf:46)."""
+    ps = part_schema or PartitionSchema()
+    for suffix in ps.options.ignore_shard_key_column_suffixes.get("_metric_", ()):
+        if metric.endswith(suffix):
+            return metric[: -len(suffix)]
+    return metric

@@ -1,0 +1,137 @@
+"""Data schemas, partition schema, and the default schema set.
+
+Reproduces the reference's config-declared schemas (ref:
+core/src/main/resources/filodb-defaults.conf:58-113 `filodb.schemas`,
+core/src/main/scala/filodb.core/metadata/Schemas.scala) — gauge, untyped,
+prom-counter, prom-histogram and the downsample schema ds-gauge — plus the
+partition-schema options (shard-key columns, suffix/tag exclusions,
+ref: filodb-defaults.conf:23-52).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from filodb_tpu.utils.hashing import xxhash32
+
+
+@dataclasses.dataclass(frozen=True)
+class Column:
+    name: str
+    col_type: str                  # 'ts' | 'double' | 'long' | 'hist' | 'string' | 'int'
+    detect_drops: bool = False     # counters: drop (reset) detection at ingest
+    counter: bool = False          # hist columns: cumulative/counter semantics
+
+
+@dataclasses.dataclass(frozen=True)
+class Schema:
+    """One data schema (ref: Schemas.scala; schema hash ids are 16-bit,
+    derived from name+columns like the reference's hash-based schemaID)."""
+    name: str
+    columns: Tuple[Column, ...]
+    value_column: str
+    downsamplers: Tuple[str, ...] = ()
+    downsample_period_marker: str = "time(0)"
+    downsample_schema: Optional[str] = None
+
+    @property
+    def schema_id(self) -> int:
+        payload = self.name + "|" + ",".join(f"{c.name}:{c.col_type}" for c in self.columns)
+        return xxhash32(payload.encode()) & 0xFFFF
+
+    @property
+    def data_columns(self) -> Tuple[Column, ...]:
+        return tuple(c for c in self.columns if c.col_type != "ts")
+
+    @property
+    def ts_column(self) -> Column:
+        return self.columns[0]
+
+    def column(self, name: str) -> Column:
+        for c in self.columns:
+            if c.name == name:
+                return c
+        raise KeyError(f"schema {self.name} has no column {name!r}")
+
+
+@dataclasses.dataclass(frozen=True)
+class PartitionSchemaOptions:
+    """ref: filodb-defaults.conf:38-52 partition-schema options block."""
+    copy_tags: Dict[str, Tuple[str, ...]] = dataclasses.field(
+        default_factory=lambda: {"_ns_": ("_ns", "exporter", "job")})
+    ignore_shard_key_column_suffixes: Dict[str, Tuple[str, ...]] = dataclasses.field(
+        default_factory=lambda: {"_metric_": ("_bucket", "_count", "_sum")})
+    ignore_tags_on_partition_key_hash: Tuple[str, ...] = ("le",)
+    metric_column: str = "_metric_"
+    shard_key_columns: Tuple[str, ...] = ("_ws_", "_ns_", "_metric_")
+
+
+@dataclasses.dataclass(frozen=True)
+class PartitionSchema:
+    """Cluster-wide partition key scheme: metric + tags map
+    (ref: filodb-defaults.conf:23-52)."""
+    predefined_keys: Tuple[str, ...] = (
+        "_ws_", "_ns_", "app", "__name__", "instance", "dc", "le", "job",
+        "exporter", "_pi_")
+    options: PartitionSchemaOptions = dataclasses.field(default_factory=PartitionSchemaOptions)
+
+
+def _mk(name, cols, value_column, downsamplers=(), marker="time(0)", ds_schema=None):
+    return Schema(name, tuple(cols), value_column, tuple(downsamplers), marker, ds_schema)
+
+
+GAUGE = _mk("gauge",
+            [Column("timestamp", "ts"), Column("value", "double")],
+            "value",
+            ["tTime(0)", "dMin(1)", "dMax(1)", "dSum(1)", "dCount(1)", "dAvg(1)"],
+            "time(0)", "ds-gauge")
+
+UNTYPED = _mk("untyped",
+              [Column("timestamp", "ts"), Column("number", "double")],
+              "number")
+
+PROM_COUNTER = _mk("prom-counter",
+                   [Column("timestamp", "ts"),
+                    Column("count", "double", detect_drops=True)],
+                   "count",
+                   ["tTime(0)", "dLast(1)"],
+                   "counter(1)", "prom-counter")
+
+PROM_HISTOGRAM = _mk("prom-histogram",
+                     [Column("timestamp", "ts"),
+                      Column("sum", "double", detect_drops=True),
+                      Column("count", "double", detect_drops=True),
+                      Column("h", "hist", counter=True)],
+                     "h",
+                     ["tTime(0)", "dLast(1)", "dLast(2)", "hLast(3)"],
+                     "counter(2)", "prom-histogram")
+
+DS_GAUGE = _mk("ds-gauge",
+               [Column("timestamp", "ts"), Column("min", "double"),
+                Column("max", "double"), Column("sum", "double"),
+                Column("count", "double"), Column("avg", "double")],
+               "avg")
+
+
+class Schemas:
+    """Registry of schemas keyed by name and 16-bit id (ref: Schemas.scala:464 area)."""
+
+    def __init__(self, schemas: Sequence[Schema], part: Optional[PartitionSchema] = None):
+        self.by_name: Dict[str, Schema] = {s.name: s for s in schemas}
+        self.by_id: Dict[int, Schema] = {s.schema_id: s for s in schemas}
+        if len(self.by_id) != len(self.by_name):
+            raise ValueError("schema id hash collision")
+        self.part = part or PartitionSchema()
+
+    def __getitem__(self, name: str) -> Schema:
+        return self.by_name[name]
+
+    def __contains__(self, name: str) -> bool:
+        return name in self.by_name
+
+    @staticmethod
+    def default() -> "Schemas":
+        return Schemas([GAUGE, UNTYPED, PROM_COUNTER, PROM_HISTOGRAM, DS_GAUGE])
+
+
+DEFAULT_SCHEMAS = Schemas.default()

@@ -1,0 +1,287 @@
+"""TimeSeriesShard — all state for one shard.
+
+Rebuild of the reference's shard runtime (ref:
+core/.../memstore/TimeSeriesShard.scala:246): partition registry keyed by
+partKey bytes, tag index, ingest entry point, flush groups with checkpoint
+watermarks, eviction, and partition lookup for query.  The per-partition
+write-buffer/chunk machinery is replaced by the dense per-schema
+DenseSeriesStore (see blockstore.py) which the TPU kernels consume directly.
+"""
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from filodb_tpu.config import FilodbSettings, settings as default_settings
+from filodb_tpu.core.blockstore import DenseSeriesStore
+from filodb_tpu.core.index import ColumnFilter, PartKeyIndex, MAX_TIME
+from filodb_tpu.core.partkey import PartKey
+from filodb_tpu.core.records import RecordBatch
+from filodb_tpu.core.schemas import Schemas, DEFAULT_SCHEMAS
+from filodb_tpu.core.store import (ColumnStore, MetaStore, NullColumnStore,
+                                   InMemoryMetaStore, PartKeyRecord)
+from filodb_tpu.memory.chunks import ChunkSet, encode_chunkset
+from filodb_tpu.memory.histogram import HistogramBuckets
+
+
+@dataclasses.dataclass
+class PartitionInfo:
+    """Lightweight partition record (the TimeSeriesPartition analogue,
+    ref: memstore/TimeSeriesPartition.scala:64 — heavy state lives in the
+    dense store row)."""
+    part_id: int
+    part_key: PartKey
+    schema_name: str
+    row: int                      # row in the schema's DenseSeriesStore
+    group: int                    # flush group
+
+
+@dataclasses.dataclass
+class ShardStats:
+    """ref: TimeSeriesShardStats (TimeSeriesShard.scala:41)."""
+    rows_ingested: int = 0
+    partitions_created: int = 0
+    rows_dropped: int = 0
+    chunks_flushed: int = 0
+    flushes: int = 0
+    evictions: int = 0
+
+
+@dataclasses.dataclass
+class PartLookupResult:
+    """ref: TimeSeriesShard.scala:212 PartLookupResult."""
+    shard: int
+    part_ids: np.ndarray
+    parts_by_schema: Dict[str, List[PartitionInfo]]
+    first_schema: Optional[str]
+
+
+class TimeSeriesShard:
+
+    def __init__(self, dataset: str, shard_num: int,
+                 schemas: Schemas = DEFAULT_SCHEMAS,
+                 column_store: Optional[ColumnStore] = None,
+                 meta_store: Optional[MetaStore] = None,
+                 config: Optional[FilodbSettings] = None):
+        self.dataset = dataset
+        self.shard_num = shard_num
+        self.schemas = schemas
+        self.config = config or default_settings()
+        self.column_store = column_store or NullColumnStore()
+        self.meta_store = meta_store or InMemoryMetaStore()
+        self.index = PartKeyIndex()
+        self.part_set: Dict[bytes, int] = {}       # partKey bytes -> partId
+        self.partitions: List[Optional[PartitionInfo]] = []
+        self.stores: Dict[str, DenseSeriesStore] = {}
+        self.stats = ShardStats()
+        self.ingested_offset = -1                   # latest ingest offset seen
+        self._groups = self.config.store.groups_per_shard
+        self._dirty_part_keys: set = set()          # partIds needing pk upsert
+
+    # ------------------------------------------------------------------ ingest
+
+    def group_for(self, part_key: PartKey) -> int:
+        """Stable flush-group assignment from the partKey hash."""
+        return part_key.partition_hash() % self._groups
+
+    def _store_for(self, schema_name: str) -> DenseSeriesStore:
+        store = self.stores.get(schema_name)
+        if store is None:
+            store = DenseSeriesStore(self.schemas[schema_name])
+            self.stores[schema_name] = store
+        return store
+
+    def get_or_create_partition(self, part_key: PartKey, schema_name: str,
+                                start_time_ms: int) -> PartitionInfo:
+        """ref: TimeSeriesShard.getOrAddPartitionAndIngest:1249 +
+        createNewPartition:1301 (partId assignment + index add)."""
+        kb = part_key.to_bytes()
+        pid = self.part_set.get(kb)
+        if pid is not None:
+            return self.partitions[pid]
+        pid = len(self.partitions)
+        store = self._store_for(schema_name)
+        # group from the stable partKey hash, NOT partId: replay filtering by
+        # group checkpoint must survive restart where partIds are reassigned
+        # (ref: TimeSeriesShard.scala group = partKeyGroup(hash))
+        info = PartitionInfo(pid, part_key, schema_name, store.new_row(),
+                             group=self.group_for(part_key))
+        self.partitions.append(info)
+        self.part_set[kb] = pid
+        self.index.add_partition(pid, part_key, start_time_ms)
+        self._dirty_part_keys.add(pid)
+        self.stats.partitions_created += 1
+        return info
+
+    def ingest(self, batch: RecordBatch, offset: int = -1) -> int:
+        """Ingest one record batch (ref: TimeSeriesShard.ingest:570).
+        Returns number of samples ingested."""
+        if batch.num_records == 0:
+            return 0
+        store = self._store_for(batch.schema.name)
+        # map batch-local part indices -> store rows (create partitions on miss);
+        # first timestamp per key via one vectorized pass, not a per-sample loop
+        rows_for_key = np.empty(len(batch.part_keys), dtype=np.int64)
+        uniq, first = np.unique(batch.part_idx, return_index=True)
+        first_ts_by_key = dict(zip(uniq.tolist(),
+                                   batch.timestamps[first].tolist()))
+        for k, pk in enumerate(batch.part_keys):
+            info = self.get_or_create_partition(
+                pk, batch.schema.name, first_ts_by_key.get(k, 0))
+            rows_for_key[k] = info.row
+        rows = rows_for_key[batch.part_idx]
+        n = store.append_batch(rows, batch.timestamps, batch.columns,
+                               batch.bucket_les)
+        self.stats.rows_ingested += n
+        self.stats.rows_dropped += batch.num_records - n
+        if offset >= 0:
+            self.ingested_offset = offset
+        return n
+
+    # ------------------------------------------------------------------- flush
+
+    def flush_group(self, group: int, ingestion_time_ms: Optional[int] = None) -> int:
+        """Seal + persist unsealed samples for one flush group, then commit the
+        group checkpoint (ref: TimeSeriesShard.doFlushSteps:969,
+        writeChunks:1072, commitCheckpoint:1127).  Returns chunks written."""
+        ingestion_time_ms = ingestion_time_ms or int(time.time() * 1000)
+        written = 0
+        dirty_pids: set = set()
+        for info in self.partitions:
+            if info is None or info.group != group:
+                continue
+            store = self.stores[info.schema_name]
+            lo, hi = store.unsealed_range(info.row)
+            if hi <= lo:
+                continue
+            ts, cols = store.series_slice(info.row, lo, hi)
+            schema = self.schemas[info.schema_name]
+            col_types = {c.name: c.col_type for c in schema.data_columns}
+            scheme = (HistogramBuckets.custom(store.bucket_les)
+                      if store.bucket_les is not None else None)
+            cs = encode_chunkset(ts, cols, col_types, ingestion_time_ms, scheme)
+            self.column_store.write_chunks(
+                self.dataset, self.shard_num, info.part_key, [cs],
+                info.schema_name)
+            store.mark_sealed(info.row, hi)
+            written += 1
+            dirty_pids.add(info.part_id)
+        # newly created partitions in this group get their part key persisted
+        # even before any data flush, so recover_index sees them after a crash
+        # (ref: TimeSeriesShard.writeDirtyPartKeys:1051)
+        for pid in self._dirty_part_keys:
+            info = self.partitions[pid]
+            if info is not None and info.group == group:
+                dirty_pids.add(pid)
+        self._dirty_part_keys -= dirty_pids
+        dirty = [PartKeyRecord(self.partitions[pid].part_key,
+                               self.partitions[pid].schema_name,
+                               self.index.start_time(pid),
+                               self.index.end_time(pid))
+                 for pid in sorted(dirty_pids)]
+        if dirty:
+            self.column_store.write_part_keys(self.dataset, self.shard_num, dirty)
+        self.meta_store.write_checkpoint(
+            self.dataset, self.shard_num, group, self.ingested_offset)
+        self.stats.chunks_flushed += written
+        self.stats.flushes += 1
+        return written
+
+    def flush_all_groups(self) -> int:
+        return sum(self.flush_group(g) for g in range(self._groups))
+
+    # ------------------------------------------------------------------- query
+
+    def lookup_partitions(self, filters: Sequence[ColumnFilter],
+                          start_time_ms: int, end_time_ms: int,
+                          limit: Optional[int] = None) -> PartLookupResult:
+        """ref: TimeSeriesShard.lookupPartitions:1521 — index query + schema
+        discovery (MultiSchemaPartitionsExec.scala:27-60)."""
+        ids = self.index.part_ids_from_filters(
+            filters, start_time_ms, end_time_ms, limit)
+        by_schema: Dict[str, List[PartitionInfo]] = {}
+        for pid in ids.tolist():
+            info = self.partitions[pid]
+            if info is not None:
+                by_schema.setdefault(info.schema_name, []).append(info)
+        first = next(iter(by_schema)) if by_schema else None
+        return PartLookupResult(self.shard_num, ids, by_schema, first)
+
+    def gather_series(self, parts: Sequence[PartitionInfo]):
+        """Dense-gather rows for a single-schema partition list.
+        Returns (ts [S,T], cols dict, counts [S], store)."""
+        if not parts:
+            return None
+        schema_name = parts[0].schema_name
+        store = self.stores[schema_name]
+        rows = np.asarray([p.row for p in parts], dtype=np.int64)
+        ts, cols, counts = store.gather_rows(rows)
+        return ts, cols, counts, store
+
+    # ---------------------------------------------------------------- recovery
+
+    def recover_index(self) -> int:
+        """Rebuild the tag index + partition registry from persisted part keys
+        (ref: TimeSeriesShard.recoverIndex:600, IndexBootstrapper.scala)."""
+        n = 0
+        for rec in self.column_store.read_part_keys(self.dataset, self.shard_num):
+            info = self.get_or_create_partition(
+                rec.part_key, rec.schema_name, rec.start_time_ms)
+            if rec.end_time_ms < MAX_TIME:
+                self.index.update_end_time(info.part_id, rec.end_time_ms)
+            n += 1
+        return n
+
+    def recover_stream(self, batches: Iterable[Tuple[RecordBatch, int]]) -> int:
+        """Replay record batches with offsets, skipping those at/below each
+        group's checkpoint watermark (ref: TimeSeriesMemStore.recoverStream:147,
+        doc/ingestion.md:114-133)."""
+        checkpoints = self.meta_store.read_checkpoints(self.dataset, self.shard_num)
+        n = 0
+        for batch, offset in batches:
+            # A batch is skippable for partitions in groups whose watermark is
+            # >= offset.  Filter per-record by group.
+            if not checkpoints:
+                n += self.ingest(batch, offset)
+                continue
+            # group is a pure function of the partKey hash, so replay
+            # filtering is correct even for partitions not yet recreated
+            group_by_key = np.asarray(
+                [self.group_for(pk) for pk in batch.part_keys], dtype=np.int64)
+            wm = np.full(self._groups, -1, dtype=np.int64)
+            for g, off in checkpoints.items():
+                wm[g] = off
+            keep = wm[group_by_key[batch.part_idx]] < offset
+            if keep.all():
+                n += self.ingest(batch, offset)
+            elif keep.any():
+                sub = RecordBatch(batch.schema, batch.part_keys,
+                                  batch.part_idx[keep], batch.timestamps[keep],
+                                  {k: v[keep] for k, v in batch.columns.items()},
+                                  batch.bucket_les)
+                n += self.ingest(sub, offset)
+        return n
+
+    # ---------------------------------------------------------------- eviction
+
+    def evict_ended_partitions(self, before_ms: int) -> int:
+        """Evict partitions whose series ended before `before_ms`
+        (ref: TimeSeriesShard.partitionsToEvict:1464)."""
+        evicted = 0
+        for info in list(self.partitions):
+            if info is None:
+                continue
+            if self.index.end_time(info.part_id) < before_ms:
+                self.index.remove_partition(info.part_id)
+                self.part_set.pop(info.part_key.to_bytes(), None)
+                self.partitions[info.part_id] = None
+                evicted += 1
+                self.stats.evictions += 1
+        return evicted
+
+    @property
+    def num_partitions(self) -> int:
+        return sum(1 for p in self.partitions if p is not None)

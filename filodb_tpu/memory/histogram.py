@@ -1,0 +1,73 @@
+"""First-class histogram support: bucket schemes and bucket-matrix encoding.
+
+Mirrors the reference's histogram model (ref:
+memory/src/main/scala/filodb.memory/format/vectors/Histogram.scala:17,
+HistogramBuckets.scala area `HistogramBuckets:340`): buckets are CUMULATIVE
+counts with `le` (less-than-or-equal) upper bounds, last bucket is +Inf —
+the Prometheus scheme.  Instead of the reference's per-sample BinaryHistogram
+blobs, the TPU-native layout is a dense bucket matrix [time, buckets] per
+series, which maps directly onto vectorized histogram_quantile kernels.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Sequence, Tuple
+
+import numpy as np
+
+from filodb_tpu.memory import nibblepack
+
+
+@dataclasses.dataclass(frozen=True)
+class HistogramBuckets:
+    """A bucket scheme: the array of `le` upper bounds (ascending, last may be
+    +Inf).  ref: memory/.../vectors/HistogramBuckets geometric & custom forms."""
+    les: Tuple[float, ...]
+
+    @property
+    def num_buckets(self) -> int:
+        return len(self.les)
+
+    def as_array(self) -> np.ndarray:
+        return np.asarray(self.les, dtype=np.float64)
+
+    @staticmethod
+    def geometric(first: float, multiplier: float, num: int,
+                  inf_bucket: bool = True) -> "HistogramBuckets":
+        """ref: HistogramBuckets geometric scheme — le[i] = first * multiplier^i."""
+        les = [first * (multiplier ** i) for i in range(num - (1 if inf_bucket else 0))]
+        if inf_bucket:
+            les.append(float("inf"))
+        return HistogramBuckets(tuple(les))
+
+    @staticmethod
+    def custom(les: Sequence[float]) -> "HistogramBuckets":
+        return HistogramBuckets(tuple(float(x) for x in les))
+
+
+# The reference's canonical test scheme: 8 geometric buckets starting at 2, x2.
+def default_buckets(num: int = 8) -> HistogramBuckets:
+    return HistogramBuckets.geometric(2.0, 2.0, num, inf_bucket=False)
+
+
+def encode_hist_matrix(mat: np.ndarray) -> bytes:
+    """Encode a [time, buckets] cumulative-count matrix.
+
+    2D-delta: each row is delta'd against the previous row (time-delta), and
+    within a row buckets are delta'd against the previous bucket (the
+    section-delta idea of ref AppendableSectDeltaHistVector:427) — increasing
+    cumulative buckets make both deltas small and NibblePack-friendly.
+    """
+    m = np.asarray(mat, dtype=np.int64)
+    if m.ndim != 2:
+        raise ValueError("hist matrix must be [time, buckets]")
+    bucket_delta = np.diff(m, axis=1, prepend=0)       # within-row
+    time_delta = np.diff(bucket_delta, axis=0, prepend=0)  # across rows
+    return nibblepack.pack_i64(time_delta.ravel())
+
+
+def decode_hist_matrix(data: bytes, num_rows: int, num_buckets: int) -> np.ndarray:
+    flat = nibblepack.unpack_i64(data, num_rows * num_buckets)
+    time_delta = flat.reshape(num_rows, num_buckets)
+    bucket_delta = np.cumsum(time_delta, axis=0)
+    return np.cumsum(bucket_delta, axis=1)

@@ -1,0 +1,36 @@
+"""Optional C++ acceleration library loader.
+
+Builds are produced by `make -C filodb_tpu/native` (see Makefile / filodb_native.cc).
+When the shared object is absent, `lib` is None and pure-Python fallbacks are
+used everywhere, so the framework never hard-depends on a compiled artifact.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+
+lib = None
+
+_SO = os.path.join(os.path.dirname(__file__), "libfilodb_native.so")
+
+
+class _NativeLib:
+    def __init__(self, cdll: ctypes.CDLL):
+        self._c = cdll
+        self._c.filodb_xxhash32.restype = ctypes.c_uint32
+        self._c.filodb_xxhash32.argtypes = [ctypes.c_char_p, ctypes.c_size_t, ctypes.c_uint32]
+        self._c.filodb_xxhash64.restype = ctypes.c_uint64
+        self._c.filodb_xxhash64.argtypes = [ctypes.c_char_p, ctypes.c_size_t, ctypes.c_uint64]
+
+    def xxhash32(self, data: bytes, seed: int = 0) -> int:
+        return self._c.filodb_xxhash32(data, len(data), seed)
+
+    def xxhash64(self, data: bytes, seed: int = 0) -> int:
+        return self._c.filodb_xxhash64(data, len(data), seed)
+
+
+if os.path.exists(_SO):  # pragma: no cover - depends on local build
+    try:
+        lib = _NativeLib(ctypes.CDLL(_SO))
+    except OSError:
+        lib = None

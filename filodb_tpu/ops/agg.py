@@ -1,0 +1,178 @@
+"""Cross-series aggregation kernels with the 3-phase map/reduce/present contract.
+
+The reference distributes aggregations as AggregateMapReduce at leaves,
+ReduceAggregateExec at intermediates, and AggregatePresenter at the root
+(ref: query/.../exec/AggrOverRangeVectors.scala:17-125,
+exec/aggregator/RowAggregator.scala:140, doc/query-engine.md:311-330).
+The TPU rebuild keeps exactly that contract so partial aggregates can ride
+mesh collectives: `map_phase` produces component arrays [G, W, C] per shard,
+`reduce_phase` combines them (psum/pmin/pmax across the shard mesh axis),
+and `present` finishes (divide for avg, sqrt for stddev, ...).
+
+Group ids are computed host-side from `by`/`without` label hashing; NaN
+values mean 'series absent at this step' and never contribute.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Dict, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+class AggSpec(NamedTuple):
+    num_components: int
+    combiner: str                 # 'sum' | 'min' | 'max'
+
+
+AGGREGATORS: Dict[str, AggSpec] = {
+    "sum":    AggSpec(1, "sum"),
+    "count":  AggSpec(1, "sum"),
+    "avg":    AggSpec(2, "sum"),     # (sum, count)
+    "min":    AggSpec(1, "min"),
+    "max":    AggSpec(1, "max"),
+    "stddev": AggSpec(3, "sum"),     # (sum, sumsq, count)
+    "stdvar": AggSpec(3, "sum"),
+    "group":  AggSpec(1, "max"),     # group() = 1 for any present series
+}
+
+
+def _seg(op, vals, group_ids, num_groups):
+    if op == "sum":
+        return jax.ops.segment_sum(vals, group_ids, num_segments=num_groups)
+    if op == "min":
+        return jax.ops.segment_min(vals, group_ids, num_segments=num_groups)
+    if op == "max":
+        return jax.ops.segment_max(vals, group_ids, num_segments=num_groups)
+    raise ValueError(op)
+
+
+@functools.partial(jax.jit, static_argnames=("op", "num_groups"))
+def map_phase(op: str, vals: jax.Array, group_ids: jax.Array,
+              num_groups: int) -> jax.Array:
+    """vals [S, W] (NaN absent) -> partial components [G, W, C]."""
+    present = ~jnp.isnan(vals)
+    zeroed = jnp.where(present, vals, 0.0)
+    cnt = present.astype(vals.dtype)
+    if op in ("sum", "count"):
+        comp = [zeroed] if op == "sum" else [cnt]
+    elif op == "avg":
+        comp = [zeroed, cnt]
+    elif op in ("stddev", "stdvar"):
+        comp = [zeroed, zeroed * zeroed, cnt]
+    elif op == "min":
+        comp = [jnp.where(present, vals, jnp.inf)]
+    elif op == "max":
+        comp = [jnp.where(present, vals, -jnp.inf)]
+    elif op == "group":
+        comp = [jnp.where(present, 1.0, -jnp.inf)]
+    else:
+        raise ValueError(f"unknown aggregate {op}")
+    spec = AGGREGATORS[op]
+    stacked = jnp.stack(comp, axis=-1)            # [S, W, C]
+    return _seg(spec.combiner, stacked, group_ids, num_groups)
+
+
+def reduce_phase(op: str, a: jax.Array, b: jax.Array) -> jax.Array:
+    """Combine two partials [G, W, C] (inter-shard tree reduce)."""
+    comb = AGGREGATORS[op].combiner
+    if comb == "sum":
+        return a + b
+    return jnp.minimum(a, b) if comb == "min" else jnp.maximum(a, b)
+
+
+@functools.partial(jax.jit, static_argnames=("op",))
+def present(op: str, partial: jax.Array) -> jax.Array:
+    """Partial components [G, W, C] -> final [G, W] (NaN where no series)."""
+    if op == "sum":
+        s = partial[..., 0]
+        return s  # caller masks empty groups via count-based presence if needed
+    if op == "count":
+        c = partial[..., 0]
+        return jnp.where(c > 0, c, jnp.nan)
+    if op == "avg":
+        s, c = partial[..., 0], partial[..., 1]
+        return jnp.where(c > 0, s / jnp.maximum(c, 1.0), jnp.nan)
+    if op in ("stddev", "stdvar"):
+        s, s2, c = partial[..., 0], partial[..., 1], partial[..., 2]
+        cs = jnp.maximum(c, 1.0)
+        var = jnp.maximum(s2 / cs - (s / cs) ** 2, 0.0)
+        out = jnp.sqrt(var) if op == "stddev" else var
+        return jnp.where(c > 0, out, jnp.nan)
+    if op in ("min", "group"):
+        v = partial[..., 0]
+        return jnp.where(jnp.isinf(v), jnp.nan, v)
+    if op == "max":
+        v = partial[..., 0]
+        return jnp.where(jnp.isinf(v), jnp.nan, v)
+    raise ValueError(op)
+
+
+@functools.partial(jax.jit, static_argnames=("op", "num_groups"))
+def aggregate(op: str, vals: jax.Array, group_ids: jax.Array,
+              num_groups: int) -> jax.Array:
+    """Single-shard shortcut: map + present in one pass -> [G, W].
+    For `sum` this also applies presence masking (NaN when group empty)."""
+    partial = map_phase(op, vals, group_ids, num_groups)
+    out = present(op, partial)
+    if op == "sum":
+        cnt = jax.ops.segment_sum((~jnp.isnan(vals)).astype(vals.dtype),
+                                  group_ids, num_segments=num_groups)
+        out = jnp.where(cnt > 0, out, jnp.nan)
+    return out
+
+
+# ----------------------------------------------------------- rank aggregates
+
+@functools.partial(jax.jit, static_argnames=("k", "largest", "num_groups"))
+def topk_mask(vals: jax.Array, group_ids: jax.Array, num_groups: int,
+              k: int, largest: bool = True) -> jax.Array:
+    """Per-(group, step) top/bottom-k selection mask [S, W].
+
+    Computes each value's rank within its group per step via lexicographic
+    sort (group asc, value desc), the vectorized equivalent of the
+    reference's TopBottomK RowAggregator (ref: exec/aggregator/
+    TopBottomKRowAggregator note in RowAggregator.scala area).
+    """
+    S, W = vals.shape
+    key_vals = jnp.where(jnp.isnan(vals), -jnp.inf if largest else jnp.inf, vals)
+    sign = -1.0 if largest else 1.0
+
+    def per_step(v_col):
+        order = jnp.lexsort((sign * v_col, group_ids))      # stable: group, value
+        # rank within group = position - first position of that group
+        g_sorted = group_ids[order]
+        first_of_group = jnp.searchsorted(g_sorted, jnp.arange(num_groups))
+        pos = jnp.arange(S)
+        rank_sorted = pos - first_of_group[g_sorted]
+        rank = jnp.zeros(S, jnp.int32).at[order].set(rank_sorted.astype(jnp.int32))
+        return rank
+
+    ranks = jax.vmap(per_step, in_axes=1, out_axes=1)(key_vals)   # [S, W]
+    return (ranks < k) & ~jnp.isnan(vals)
+
+
+@functools.partial(jax.jit, static_argnames=("num_groups",))
+def quantile_agg(vals: jax.Array, group_ids: jax.Array, num_groups: int,
+                 q) -> jax.Array:
+    """quantile(q, expr) by group -> [G, W].  Exact (sort-based) rather than
+    the reference's t-digest approximation (ref: exec/aggregator/
+    QuantileRowAggregator.scala:87) — bitonic sort on TPU is cheap."""
+    S, W = vals.shape
+
+    def per_group(g):
+        m = (group_ids == g)[:, None]
+        v = jnp.where(m & ~jnp.isnan(vals), vals, jnp.inf)
+        srt = jnp.sort(v, axis=0)                            # [S, W]
+        cnt = jnp.sum((~jnp.isinf(srt)).astype(jnp.int32), axis=0)
+        rank = q * (cnt.astype(vals.dtype) - 1.0)
+        lo = jnp.clip(jnp.floor(rank).astype(jnp.int32), 0, S - 1)
+        hi = jnp.clip(jnp.ceil(rank).astype(jnp.int32), 0, S - 1)
+        frac = rank - lo.astype(vals.dtype)
+        vlo = jnp.take_along_axis(srt, lo[None, :], axis=0)[0]
+        vhi = jnp.take_along_axis(srt, hi[None, :], axis=0)[0]
+        out = vlo + (vhi - vlo) * frac
+        return jnp.where(cnt > 0, out, jnp.nan)
+
+    return jax.vmap(per_group)(jnp.arange(num_groups))

@@ -1,0 +1,60 @@
+"""Counter reset (drop) detection and correction as an associative scan.
+
+The reference detects drops at ingest and carries per-chunk correction
+metadata so query-time rate is O(chunks) (ref:
+memory/.../format/vectors/DoubleVector.scala:301 CorrectingDoubleVectorReader,
+DoubleCounterAppender:442; query/.../rangefn/RangeFunction.scala:126
+CounterChunkedRangeFunction).  On TPU the whole series row is resident as a
+dense array, so correction is simply a prefix sum of observed drops — an
+associative scan the hardware does in one fused pass (SURVEY.md section 7
+"counter correction semantics on device").
+"""
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def _prev_valid(vals: jax.Array) -> jax.Array:
+    """prev[s, t] = most recent non-NaN value at an index < t (NaN if none).
+    Forward-fill via an associative carry scan, so NaN gaps inside a row do
+    not hide a reset that happened across the gap."""
+    valid = ~jnp.isnan(vals)
+    def combine(a, b):
+        av, af = a
+        bv, bf = b
+        return jnp.where(bf, bv, av), af | bf
+    filled, _ = jax.lax.associative_scan(
+        combine, (jnp.where(valid, vals, 0.0), valid), axis=1)
+    any_before = jnp.cumsum(valid.astype(jnp.int32), axis=1) > 0
+    filled = jnp.where(any_before, filled, jnp.nan)
+    return jnp.concatenate(
+        [jnp.full_like(vals[:, :1], jnp.nan), filled[:, :-1]], axis=1)
+
+
+def drops(vals: jax.Array) -> jax.Array:
+    """Per-sample drop magnitude max(0, prev_valid - cur), 0 at NaN samples."""
+    valid = ~jnp.isnan(vals)
+    prev = _prev_valid(vals)
+    return jnp.where(valid & ~jnp.isnan(prev) & (prev > vals), prev - vals, 0.0)
+
+
+def counter_correct(vals: jax.Array) -> jax.Array:
+    """Reset-corrected values: vals + cumulative drop sum; monotone per row."""
+    correction = jnp.cumsum(drops(vals), axis=1)
+    return jnp.where(jnp.isnan(vals), vals, vals + correction)
+
+
+def total_correction_and_last(vals: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """Per-series (total correction, last raw value) for cross-block carry —
+    the chunk-level correction metadata analogue used when a query spans
+    multiple dense blocks."""
+    valid = ~jnp.isnan(vals)
+    total = jnp.sum(drops(vals), axis=1)
+    idx = jnp.where(valid, jnp.arange(vals.shape[1])[None, :], -1)
+    last_idx = jnp.max(idx, axis=1)
+    last = jnp.take_along_axis(
+        vals, jnp.maximum(last_idx, 0)[:, None], axis=1)[:, 0]
+    return total, jnp.where(last_idx >= 0, last, jnp.nan)

@@ -1,0 +1,93 @@
+"""Histogram kernels: histogram_quantile / histogram_max_quantile /
+histogram_bucket over dense bucket matrices.
+
+The reference evaluates quantiles over first-class histogram vectors
+(ref: query/.../rangefn/InstantFunction.scala HistogramQuantileImpl area,
+memory/.../vectors/Histogram.scala:17 `quantile`) and can also assemble
+Prometheus-style `_bucket` series into histograms
+(ref: query/.../exec/HistogramQuantileMapper.scala:149).  Buckets are
+cumulative counts with ascending `le` upper bounds, last bucket +Inf.
+
+TPU layout: bucket values arrive as [S, W, B] (range function already applied
+per bucket, e.g. rate), `les` is [B].  The quantile search is a vectorized
+searchsorted over the bucket axis + linear interpolation inside the bucket —
+Prometheus's algorithm exactly.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@functools.partial(jax.jit, static_argnames=())
+def histogram_quantile(q, buckets: jax.Array, les: jax.Array) -> jax.Array:
+    """q scalar, buckets [S, W, B] cumulative counts, les [B] -> [S, W].
+
+    Prometheus semantics: rank = q * total; find first bucket with
+    cumulative >= rank; linearly interpolate within [lower_le, upper_le].
+    If the located bucket is +Inf -> return the last finite le; if it is the
+    first bucket -> interpolate from 0 (or from le if le <= 0).
+    q < 0 -> -Inf, q > 1 -> +Inf, empty histogram -> NaN.
+    """
+    B = buckets.shape[-1]
+    # enforce monotone non-decreasing cumulative counts (mirrors the
+    # ensureMonotonic fixup Prometheus applies for float jitter)
+    cum = jax.lax.associative_scan(jnp.maximum, buckets, axis=-1)
+    total = cum[..., -1]
+    rank = q * total
+
+    # first bucket index with cum >= rank  (per cell binary search)
+    ge = cum >= rank[..., None]
+    idx = jnp.argmax(ge, axis=-1)                     # first True
+    none_ge = ~jnp.any(ge, axis=-1)
+    idx = jnp.where(none_ge, B - 1, idx)
+
+    les_b = jnp.broadcast_to(les, buckets.shape)
+    count_at = jnp.take_along_axis(cum, idx[..., None], axis=-1)[..., 0]
+    le_at = jnp.take_along_axis(les_b, idx[..., None], axis=-1)[..., 0]
+    prev_idx = jnp.maximum(idx - 1, 0)
+    count_prev = jnp.where(idx > 0,
+                           jnp.take_along_axis(cum, prev_idx[..., None], axis=-1)[..., 0],
+                           0.0)
+    le_prev = jnp.where(idx > 0,
+                        jnp.take_along_axis(les_b, prev_idx[..., None], axis=-1)[..., 0],
+                        0.0)
+    # first bucket with negative upper bound: lower bound is le itself
+    le_prev = jnp.where((idx == 0) & (le_at <= 0), le_at, le_prev)
+
+    bucket_count = count_at - count_prev
+    frac = jnp.where(bucket_count > 0, (rank - count_prev) / bucket_count, 0.0)
+    interp = le_prev + (le_at - le_prev) * frac
+
+    # +Inf bucket: return highest finite le (Prometheus returns les[B-2])
+    has_inf_top = jnp.isinf(le_at)
+    finite_les = jnp.where(jnp.isinf(les), -jnp.inf, les)
+    max_finite = jnp.max(finite_les)
+    out = jnp.where(has_inf_top, max_finite, interp)
+
+    out = jnp.where(total > 0, out, jnp.nan)
+    out = jnp.where(jnp.isnan(rank), jnp.nan, out)
+    out = jnp.where(q < 0, -jnp.inf, out)
+    out = jnp.where(q > 1, jnp.inf, out)
+    return out
+
+
+def histogram_bucket(le: float, buckets: jax.Array, les: jax.Array) -> jax.Array:
+    """Extract one bucket's series [S, W] by upper bound (ref:
+    InstantFunction.scala histogram_bucket)."""
+    matches = jnp.isclose(les, le) | (jnp.isinf(les) & jnp.isinf(jnp.asarray(le)))
+    idx = jnp.argmax(matches)
+    found = jnp.any(matches)
+    out = buckets[..., idx]
+    return jnp.where(found, out, jnp.nan)
+
+
+def hist_sum_rv(buckets: jax.Array) -> jax.Array:
+    """Sum across series of bucket matrices (HistSum aggregate, ref:
+    exec/aggregator/HistSumRowAggregator) — elementwise NaN-aware sum."""
+    present = ~jnp.isnan(buckets)
+    s = jnp.sum(jnp.where(present, buckets, 0.0), axis=0)
+    any_present = jnp.any(present, axis=0)
+    return jnp.where(any_present, s, jnp.nan)

@@ -1,0 +1,133 @@
+"""Instant (scalar) functions and binary operators, applied elementwise to
+periodic sample matrices [S, W].
+
+ref: query/.../exec/rangefn/InstantFunction.scala:72 (abs..sqrt + date parts),
+query/.../exec/RangeVectorTransformer.scala:61 InstantVectorFunctionMapper,
+ScalarOperationMapper:186, and BinaryOperator evaluation in BinaryJoinExec.
+NaN propagates naturally (absent stays absent).
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict
+
+import jax
+import jax.numpy as jnp
+
+_SECONDS_PER_DAY = 86400.0
+
+
+def _days_in_month(y, m):
+    thirty_one = ((m == 1) | (m == 3) | (m == 5) | (m == 7) | (m == 8)
+                  | (m == 10) | (m == 12))
+    thirty = (m == 4) | (m == 6) | (m == 9) | (m == 11)
+    leap = ((y % 4 == 0) & (y % 100 != 0)) | (y % 400 == 0)
+    feb = jnp.where(leap, 29.0, 28.0)
+    return jnp.where(thirty_one, 31.0, jnp.where(thirty, 30.0, feb))
+
+
+def _civil_from_epoch_days(days):
+    """Gregorian (y, m, d) from days since 1970-01-01 (Howard Hinnant's
+    civil_from_days algorithm, branchless)."""
+    z = days + 719468.0
+    era = jnp.floor(z / 146097.0)
+    doe = z - era * 146097.0
+    yoe = jnp.floor((doe - jnp.floor(doe / 1460.0) + jnp.floor(doe / 36524.0)
+                     - jnp.floor(doe / 146096.0)) / 365.0)
+    y = yoe + era * 400.0
+    doy = doe - (365.0 * yoe + jnp.floor(yoe / 4.0) - jnp.floor(yoe / 100.0))
+    mp = jnp.floor((5.0 * doy + 2.0) / 153.0)
+    d = doy - jnp.floor((153.0 * mp + 2.0) / 5.0) + 1.0
+    m = mp + jnp.where(mp < 10.0, 3.0, -9.0)
+    y = y + (m <= 2.0)
+    return y, m, d
+
+
+def _epoch_parts(v):
+    days = jnp.floor(v / _SECONDS_PER_DAY)
+    return _civil_from_epoch_days(days)
+
+
+INSTANT_FUNCTIONS: Dict[str, Callable] = {
+    "abs": jnp.abs,
+    "ceil": jnp.ceil,
+    "floor": jnp.floor,
+    "exp": jnp.exp,
+    "ln": jnp.log,
+    "log2": jnp.log2,
+    "log10": jnp.log10,
+    "sqrt": jnp.sqrt,
+    "round": lambda v, to_nearest=1.0: jnp.floor(v / to_nearest + 0.5) * to_nearest,
+    "clamp_min": lambda v, lo: jnp.maximum(v, lo),
+    "clamp_max": lambda v, hi: jnp.minimum(v, hi),
+    "clamp": lambda v, lo, hi: jnp.clip(v, lo, hi),
+    "sgn": jnp.sign,
+    "deg": jnp.degrees,
+    "rad": jnp.radians,
+    "sin": jnp.sin, "cos": jnp.cos, "tan": jnp.tan,
+    "asin": jnp.arcsin, "acos": jnp.arccos, "atan": jnp.arctan,
+    "sinh": jnp.sinh, "cosh": jnp.cosh, "tanh": jnp.tanh,
+    "asinh": jnp.arcsinh, "acosh": jnp.arccosh, "atanh": jnp.arctanh,
+    # date parts operate on the sample VALUE as epoch seconds (PromQL semantics)
+    "minute": lambda v: jnp.floor(v / 60.0) % 60.0,
+    "hour": lambda v: jnp.floor(v / 3600.0) % 24.0,
+    "day_of_week": lambda v: (jnp.floor(v / _SECONDS_PER_DAY) + 4.0) % 7.0,
+    "day_of_month": lambda v: _epoch_parts(v)[2],
+    "month": lambda v: _epoch_parts(v)[1],
+    "year": lambda v: _epoch_parts(v)[0],
+    "days_in_month": lambda v: _days_in_month(_epoch_parts(v)[0], _epoch_parts(v)[1]),
+}
+
+
+def apply_instant_function(name: str, vals: jax.Array, *params) -> jax.Array:
+    fn = INSTANT_FUNCTIONS[name]
+    return fn(vals, *params)
+
+
+# ---------------------------------------------------------- binary operators
+
+def _safe_div(a, b):
+    return a / b  # IEEE: x/0 = inf, 0/0 = nan — PromQL follows IEEE here
+
+
+def _pow(a, b):
+    return jnp.power(a, b)
+
+
+def _mod(a, b):
+    # PromQL mod follows Go math.Mod: result has sign of dividend
+    return jnp.fmod(a, b)
+
+
+ARITH_OPERATORS: Dict[str, Callable] = {
+    "+": jnp.add,
+    "-": jnp.subtract,
+    "*": jnp.multiply,
+    "/": _safe_div,
+    "%": _mod,
+    "^": _pow,
+    "atan2": jnp.arctan2,
+}
+
+COMPARISON_OPERATORS: Dict[str, Callable] = {
+    "==": lambda a, b: a == b,
+    "!=": lambda a, b: a != b,
+    ">": lambda a, b: a > b,
+    "<": lambda a, b: a < b,
+    ">=": lambda a, b: a >= b,
+    "<=": lambda a, b: a <= b,
+}
+
+
+def apply_binary_op(op: str, lhs: jax.Array, rhs: jax.Array,
+                    bool_modifier: bool = False) -> jax.Array:
+    """Vector op vector/scalar.  Comparison without `bool` filters (keeps lhs
+    value where true, NaN where false); with `bool` returns 1/0.
+    ref: query BinaryOperator semantics + ScalarOperationMapper:186."""
+    absent = jnp.isnan(lhs) | jnp.isnan(rhs)
+    if op in ARITH_OPERATORS:
+        out = ARITH_OPERATORS[op](lhs, rhs)
+        return jnp.where(absent, jnp.nan, out)
+    cmp = COMPARISON_OPERATORS[op](lhs, rhs)
+    if bool_modifier:
+        return jnp.where(absent, jnp.nan, cmp.astype(lhs.dtype))
+    return jnp.where(~absent & cmp, lhs, jnp.nan)

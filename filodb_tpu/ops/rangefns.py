@@ -1,0 +1,412 @@
+"""PromQL range functions as vectorized TPU kernels.
+
+Each function evaluates all (series, window) cells at once over dense
+[S, T] arrays — the TPU-native rebuild of the reference's per-window chunked
+iterators (ref: query/.../exec/rangefn/RangeFunction.scala:86
+ChunkedRangeFunction hierarchy, AggrOverTimeFunctions.scala, RateFunctions.scala).
+
+Window convention matches the reference: a window for output step `wend`
+contains samples with timestamp in [wend - range + 1, wend]; the extrapolation
+boundary passed to the rate formula is wend - range (ref:
+ChunkedRateFunctionBase.apply "windowStart - 1", RateFunctions.scala:176-184).
+
+Strategies:
+  - O(1)-per-window functions (sum/count/avg/stddev/rate/...) use cumulative
+    sums along time + boundary gathers.
+  - order-statistics functions (min/max/quantile) use a masked broadcast over
+    window tiles (bounded memory), an MXU/VPU-dense pattern.
+  - counter functions apply the reset-correction prefix scan first
+    (ops/counter.py).
+Absent results are NaN, filtered at serialization like the reference's
+removal of NaN rows.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Callable, Dict, NamedTuple, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from filodb_tpu.ops import counter as counter_ops
+from filodb_tpu.ops.timewindow import (PAD_TS, gather_at, window_bounds,
+                                       windowed_cumsum_delta)
+
+
+class WindowCtx(NamedTuple):
+    """Precomputed per-query window state shared by all range functions."""
+    ts_off: jax.Array      # i32 [S, T]
+    vals: jax.Array        # f [S, T] (raw)
+    valid: jax.Array       # bool [S, T]
+    wstart: jax.Array      # i32 [W] inclusive
+    wend: jax.Array        # i32 [W] inclusive
+    first: jax.Array       # i32 [S, W]
+    last: jax.Array        # i32 [S, W]
+    n: jax.Array           # i32 [S, W] samples in window
+    base_ms: jax.Array     # i64/f scalar: absolute ms of offset 0
+
+
+def make_ctx(ts_off: jax.Array, vals: jax.Array,
+             wends: jax.Array, range_ms, base_ms=0) -> WindowCtx:
+    wend = wends.astype(jnp.int32)
+    wstart = (wend - jnp.int32(range_ms) + 1).astype(jnp.int32)
+    valid = (~jnp.isnan(vals)) & (ts_off < PAD_TS)
+    # NaN samples must not satisfy boundary gathers; they are masked in sums
+    first, last, n = window_bounds(ts_off, wstart, wend)
+    return WindowCtx(ts_off, vals, valid, wstart, wend, first, last, n,
+                     jnp.asarray(base_ms, vals.dtype))
+
+
+def _cumsum(x: jax.Array) -> jax.Array:
+    return jnp.cumsum(x, axis=1)
+
+
+def _masked(ctx: WindowCtx, arr: Optional[jax.Array] = None) -> jax.Array:
+    a = ctx.vals if arr is None else arr
+    return jnp.where(ctx.valid, a, 0.0)
+
+
+def _nan_where(cond: jax.Array, x: jax.Array) -> jax.Array:
+    return jnp.where(cond, x, jnp.nan)
+
+
+# --------------------------------------------------------------- extrapolation
+
+def extrapolated_rate(window_start, window_end, n, t1, v1, t2, v2,
+                      is_counter: bool, is_rate: bool) -> jax.Array:
+    """Vectorized Prometheus extrapolation (semantics of ref:
+    RateFunctions.scala:37-76 extrapolatedRate; all args [S, W] except the
+    window bounds which broadcast [W])."""
+    dur_start = (t1 - window_start) / 1000.0
+    dur_end = (window_end - t2) / 1000.0
+    sampled = (t2 - t1) / 1000.0
+    avg_between = sampled / (n - 1.0)
+    delta = v2 - v1
+    if is_counter:
+        dur_zero = sampled * (v1 / jnp.where(delta == 0, jnp.inf, delta))
+        take_zero = (delta > 0) & (v1 >= 0) & (dur_zero < dur_start)
+        dur_start = jnp.where(take_zero, dur_zero, dur_start)
+    threshold = avg_between * 1.1
+    extrap = sampled
+    extrap = extrap + jnp.where(dur_start < threshold, dur_start, avg_between / 2)
+    extrap = extrap + jnp.where(dur_end < threshold, dur_end, avg_between / 2)
+    scaled = delta * (extrap / sampled)
+    if is_rate:
+        return scaled / (window_end - window_start) * 1000.0
+    return scaled
+
+
+def _rate_family(ctx: WindowCtx, is_counter: bool, is_rate: bool) -> jax.Array:
+    vals = counter_ops.counter_correct(ctx.vals) if is_counter else ctx.vals
+    t1 = gather_at(ctx.ts_off, ctx.first).astype(vals.dtype)
+    t2 = gather_at(ctx.ts_off, ctx.last).astype(vals.dtype)
+    v1 = gather_at(vals, ctx.first)
+    v2 = gather_at(vals, ctx.last)
+    # boundary per ChunkedRateFunctionBase: windowStart - 1 == wend - range
+    wstart_x = (ctx.wstart - 1).astype(vals.dtype)[None, :]
+    wend_x = ctx.wend.astype(vals.dtype)[None, :]
+    out = extrapolated_rate(wstart_x, wend_x, ctx.n.astype(vals.dtype),
+                            t1, v1, t2, v2, is_counter, is_rate)
+    return _nan_where(ctx.n >= 2, out)
+
+
+def rate(ctx: WindowCtx) -> jax.Array:
+    return _rate_family(ctx, True, True)
+
+
+def increase(ctx: WindowCtx) -> jax.Array:
+    return _rate_family(ctx, True, False)
+
+
+def delta_fn(ctx: WindowCtx) -> jax.Array:
+    return _rate_family(ctx, False, False)
+
+
+def irate(ctx: WindowCtx) -> jax.Array:
+    vals = counter_ops.counter_correct(ctx.vals)
+    t2 = gather_at(ctx.ts_off, ctx.last).astype(vals.dtype)
+    t1 = gather_at(ctx.ts_off, ctx.last - 1).astype(vals.dtype)
+    v2 = gather_at(vals, ctx.last)
+    v1 = gather_at(vals, ctx.last - 1)
+    out = (v2 - v1) / ((t2 - t1) / 1000.0)
+    return _nan_where((ctx.n >= 2) & (ctx.last - 1 >= ctx.first), out)
+
+
+def idelta(ctx: WindowCtx) -> jax.Array:
+    t2 = gather_at(ctx.ts_off, ctx.last).astype(ctx.vals.dtype)
+    t1 = gather_at(ctx.ts_off, ctx.last - 1).astype(ctx.vals.dtype)
+    v2 = gather_at(ctx.vals, ctx.last)
+    v1 = gather_at(ctx.vals, ctx.last - 1)
+    return _nan_where((ctx.n >= 2) & (ctx.last - 1 >= ctx.first), v2 - v1)
+
+
+# ------------------------------------------------------------- over_time / sums
+
+def sum_over_time(ctx: WindowCtx) -> jax.Array:
+    s = windowed_cumsum_delta(_cumsum(_masked(ctx)), ctx.first, ctx.last, ctx.n)
+    return _nan_where(ctx.n > 0, s)
+
+
+def count_over_time(ctx: WindowCtx) -> jax.Array:
+    c = windowed_cumsum_delta(_cumsum(ctx.valid.astype(ctx.vals.dtype)),
+                              ctx.first, ctx.last, ctx.n)
+    return _nan_where(ctx.n > 0, c)
+
+
+def avg_over_time(ctx: WindowCtx) -> jax.Array:
+    s = windowed_cumsum_delta(_cumsum(_masked(ctx)), ctx.first, ctx.last, ctx.n)
+    c = windowed_cumsum_delta(_cumsum(ctx.valid.astype(ctx.vals.dtype)),
+                              ctx.first, ctx.last, ctx.n)
+    return _nan_where(ctx.n > 0, s / jnp.maximum(c, 1.0))
+
+
+def _var_over_time(ctx: WindowCtx) -> Tuple[jax.Array, jax.Array]:
+    s = windowed_cumsum_delta(_cumsum(_masked(ctx)), ctx.first, ctx.last, ctx.n)
+    s2 = windowed_cumsum_delta(_cumsum(_masked(ctx, ctx.vals * ctx.vals)),
+                               ctx.first, ctx.last, ctx.n)
+    c = jnp.maximum(windowed_cumsum_delta(
+        _cumsum(ctx.valid.astype(ctx.vals.dtype)), ctx.first, ctx.last, ctx.n), 1.0)
+    mean = s / c
+    var = jnp.maximum(s2 / c - mean * mean, 0.0)
+    return var, c
+
+
+def stdvar_over_time(ctx: WindowCtx) -> jax.Array:
+    var, _ = _var_over_time(ctx)
+    return _nan_where(ctx.n > 0, var)
+
+
+def stddev_over_time(ctx: WindowCtx) -> jax.Array:
+    var, _ = _var_over_time(ctx)
+    return _nan_where(ctx.n > 0, jnp.sqrt(var))
+
+
+def last_over_time(ctx: WindowCtx) -> jax.Array:
+    return _nan_where(ctx.n > 0, gather_at(ctx.vals, ctx.last))
+
+
+def timestamp_fn(ctx: WindowCtx) -> jax.Array:
+    t = (gather_at(ctx.ts_off, ctx.last).astype(ctx.vals.dtype)
+         + ctx.base_ms) / 1000.0
+    return _nan_where(ctx.n > 0, t)
+
+
+def absent_over_time(ctx: WindowCtx) -> jax.Array:
+    return jnp.where(ctx.n == 0, 1.0, jnp.nan).astype(ctx.vals.dtype)
+
+
+def present_over_time(ctx: WindowCtx) -> jax.Array:
+    return _nan_where(ctx.n > 0, jnp.ones_like(ctx.n, dtype=ctx.vals.dtype))
+
+
+# ------------------------------------------------ pairwise-indicator functions
+
+def _pair_indicator_window(ctx: WindowCtx, indicator: jax.Array) -> jax.Array:
+    """Sum indicator[t] (attributed to pair (prev,t)) for pairs fully inside
+    the window: cum[last] - cum[first] (the pair of the first sample reaches
+    before the window and is excluded)."""
+    cum = _cumsum(indicator)
+    hi = gather_at(cum, ctx.last)
+    lo = gather_at(cum, ctx.first)
+    return hi - lo
+
+
+def resets(ctx: WindowCtx) -> jax.Array:
+    ind = (counter_ops.drops(ctx.vals) > 0).astype(ctx.vals.dtype)
+    return _nan_where(ctx.n > 0, _pair_indicator_window(ctx, ind))
+
+
+def changes(ctx: WindowCtx) -> jax.Array:
+    prev = counter_ops._prev_valid(ctx.vals)
+    ind = (ctx.valid & ~jnp.isnan(prev) & (ctx.vals != prev)).astype(ctx.vals.dtype)
+    return _nan_where(ctx.n > 0, _pair_indicator_window(ctx, ind))
+
+
+# ------------------------------------------------------- regression functions
+
+def _linreg(ctx: WindowCtx) -> Tuple[jax.Array, jax.Array]:
+    """Least-squares slope+intercept over (t seconds relative to window end,
+    value) like Prometheus deriv/predict_linear."""
+    t_sec = jnp.where(ctx.valid,
+                      ctx.ts_off.astype(ctx.vals.dtype) / 1000.0, 0.0)
+    v = _masked(ctx)
+    n = jnp.maximum(windowed_cumsum_delta(
+        _cumsum(ctx.valid.astype(ctx.vals.dtype)), ctx.first, ctx.last, ctx.n), 1.0)
+    st = windowed_cumsum_delta(_cumsum(t_sec), ctx.first, ctx.last, ctx.n)
+    sv = windowed_cumsum_delta(_cumsum(v), ctx.first, ctx.last, ctx.n)
+    stt = windowed_cumsum_delta(_cumsum(t_sec * t_sec), ctx.first, ctx.last, ctx.n)
+    stv = windowed_cumsum_delta(_cumsum(t_sec * v), ctx.first, ctx.last, ctx.n)
+    denom = n * stt - st * st
+    slope = (n * stv - st * sv) / jnp.where(denom == 0, jnp.nan, denom)
+    intercept = (sv - slope * st) / n
+    return slope, intercept
+
+
+def deriv(ctx: WindowCtx) -> jax.Array:
+    slope, _ = _linreg(ctx)
+    return _nan_where(ctx.n >= 2, slope)
+
+
+def predict_linear(ctx: WindowCtx, t_ahead_s: float) -> jax.Array:
+    slope, intercept = _linreg(ctx)
+    at = ctx.wend.astype(ctx.vals.dtype)[None, :] / 1000.0 + t_ahead_s
+    return _nan_where(ctx.n >= 2, slope * at + intercept)
+
+
+def z_score(ctx: WindowCtx) -> jax.Array:
+    var, c = _var_over_time(ctx)
+    s = windowed_cumsum_delta(_cumsum(_masked(ctx)), ctx.first, ctx.last, ctx.n)
+    mean = s / c
+    lastv = gather_at(ctx.vals, ctx.last)
+    std = jnp.sqrt(var)
+    # std == 0 (e.g. single sample): 0/0 — NaN, not +/-inf from rounding
+    return _nan_where((ctx.n > 0) & (std > 0), (lastv - mean) / std)
+
+
+# ----------------------------------------------- masked-broadcast reductions
+
+def _window_tile_reduce(ctx: WindowCtx, reducer: Callable[[jax.Array, jax.Array], jax.Array],
+                        tile_elems: int = 1 << 26) -> jax.Array:
+    """Evaluate reducer(masked_vals [S, wt, T], mask) over window tiles.
+    Memory bounded to ~tile_elems array cells per tile."""
+    S, T = ctx.vals.shape
+    W = ctx.wend.shape[0]
+    wt = max(1, min(W, tile_elems // max(S * T, 1)))
+    n_tiles = -(-W // wt)
+    pad = n_tiles * wt - W
+    ws = jnp.pad(ctx.wstart, (0, pad)).reshape(n_tiles, wt)
+    we = jnp.pad(ctx.wend, (0, pad), constant_values=-(1 << 30)).reshape(n_tiles, wt)
+
+    def tile(args):
+        ws_t, we_t = args
+        in_win = ((ctx.ts_off[:, None, :] >= ws_t[None, :, None])
+                  & (ctx.ts_off[:, None, :] <= we_t[None, :, None])
+                  & ctx.valid[:, None, :])
+        return reducer(ctx.vals[:, None, :], in_win)
+
+    out = jax.lax.map(tile, (ws, we))          # [n_tiles, S, wt]
+    out = jnp.moveaxis(out, 0, 1).reshape(S, n_tiles * wt)
+    return out[:, :W]
+
+
+def min_over_time(ctx: WindowCtx) -> jax.Array:
+    r = _window_tile_reduce(
+        ctx, lambda v, m: jnp.min(jnp.where(m, v, jnp.inf), axis=-1))
+    return _nan_where(ctx.n > 0, r)
+
+
+def max_over_time(ctx: WindowCtx) -> jax.Array:
+    r = _window_tile_reduce(
+        ctx, lambda v, m: jnp.max(jnp.where(m, v, -jnp.inf), axis=-1))
+    return _nan_where(ctx.n > 0, r)
+
+
+def quantile_over_time(ctx: WindowCtx, q: float) -> jax.Array:
+    def reducer(v, m):
+        big = jnp.where(m, v, jnp.inf)
+        srt = jnp.sort(big, axis=-1)
+        cnt = jnp.sum(m, axis=-1).astype(v.dtype)
+        rank = q * (cnt - 1.0)
+        lo = jnp.floor(rank).astype(jnp.int32)
+        hi = jnp.ceil(rank).astype(jnp.int32)
+        frac = rank - lo.astype(v.dtype)
+        vlo = jnp.take_along_axis(srt, jnp.maximum(lo, 0)[..., None], axis=-1)[..., 0]
+        vhi = jnp.take_along_axis(srt, jnp.maximum(hi, 0)[..., None], axis=-1)[..., 0]
+        return vlo + (vhi - vlo) * frac
+    r = _window_tile_reduce(ctx, reducer)
+    if not 0.0 <= q <= 1.0:
+        return jnp.where(ctx.n > 0,
+                         jnp.inf if q > 1 else -jnp.inf, jnp.nan).astype(ctx.vals.dtype)
+    return _nan_where(ctx.n > 0, r)
+
+
+def holt_winters(ctx: WindowCtx, sf: float, tf: float) -> jax.Array:
+    """Double exponential smoothing (ref: AggrOverTimeFunctions.scala holt-winters).
+    Sequential per window -> scan over time inside a window tile."""
+    def reducer(v, m):
+        # v: [S, wt, T] broadcastable, m: [S, wt, T].  Prometheus recurrence:
+        # s1 := x0; b := x1 - x0; then for i >= 1:
+        #   b    = i==1 ? b : tf*(s_prev - s_prev2) + (1-tf)*b     (trend FIRST,
+        #                       from the previous two smoothed values)
+        #   s    = sf*x_i + (1-sf)*(s_prev + b)
+        vb = jnp.broadcast_to(v, m.shape)
+
+        def step(carry, xt):
+            s_prev2, s_prev, b_prev, cnt = carry
+            x, valid = xt
+            b_eff = jnp.where(cnt == 1, x - s_prev,
+                              tf * (s_prev - s_prev2) + (1 - tf) * b_prev)
+            s_new = sf * x + (1 - sf) * (s_prev + b_eff)
+            s_upd = jnp.where(cnt == 0, x, s_new)
+            b_upd = jnp.where(cnt == 0, jnp.zeros_like(x), b_eff)
+            s_prev2_out = jnp.where(valid, s_prev, s_prev2)
+            s_out = jnp.where(valid, s_upd, s_prev)
+            b_out = jnp.where(valid, b_upd, b_prev)
+            cnt_out = cnt + valid.astype(jnp.int32)
+            return (s_prev2_out, s_out, b_out, cnt_out), None
+
+        init = (jnp.zeros(m.shape[:-1], v.dtype),
+                jnp.zeros(m.shape[:-1], v.dtype),
+                jnp.zeros(m.shape[:-1], v.dtype),
+                jnp.zeros(m.shape[:-1], jnp.int32))
+        (_, s_fin, _, cnt), _ = jax.lax.scan(
+            step, init, (jnp.moveaxis(vb, -1, 0), jnp.moveaxis(m, -1, 0)))
+        return jnp.where(cnt >= 2, s_fin, jnp.nan)
+    r = _window_tile_reduce(ctx, reducer)
+    return _nan_where(ctx.n >= 2, r)
+
+
+# ------------------------------------------------------------------ dispatch
+
+class RangeFnSpec(NamedTuple):
+    fn: Callable
+    needs_params: int = 0       # number of scalar params consumed
+    is_counter: bool = False
+
+
+RANGE_FUNCTIONS: Dict[str, RangeFnSpec] = {
+    "rate": RangeFnSpec(rate, is_counter=True),
+    "increase": RangeFnSpec(increase, is_counter=True),
+    "delta": RangeFnSpec(delta_fn),
+    "irate": RangeFnSpec(irate, is_counter=True),
+    "idelta": RangeFnSpec(idelta),
+    "resets": RangeFnSpec(resets),
+    "changes": RangeFnSpec(changes),
+    "deriv": RangeFnSpec(deriv),
+    "predict_linear": RangeFnSpec(predict_linear, needs_params=1),
+    "sum_over_time": RangeFnSpec(sum_over_time),
+    "count_over_time": RangeFnSpec(count_over_time),
+    "avg_over_time": RangeFnSpec(avg_over_time),
+    "min_over_time": RangeFnSpec(min_over_time),
+    "max_over_time": RangeFnSpec(max_over_time),
+    "stddev_over_time": RangeFnSpec(stddev_over_time),
+    "stdvar_over_time": RangeFnSpec(stdvar_over_time),
+    "last_over_time": RangeFnSpec(last_over_time),
+    "quantile_over_time": RangeFnSpec(quantile_over_time, needs_params=1),
+    "holt_winters": RangeFnSpec(holt_winters, needs_params=2),
+    "z_score": RangeFnSpec(z_score),
+    "timestamp": RangeFnSpec(timestamp_fn),
+    "absent_over_time": RangeFnSpec(absent_over_time),
+    "present_over_time": RangeFnSpec(present_over_time),
+}
+
+
+@functools.partial(jax.jit, static_argnames=("fn_name", "params"))
+def evaluate_range_function(ts_off: jax.Array, vals: jax.Array,
+                            wends: jax.Array, range_ms,
+                            fn_name: Optional[str],
+                            params: Tuple[float, ...] = (),
+                            base_ms=0) -> jax.Array:
+    """The fused leaf kernel: window bounds + range function in one jit.
+
+    fn_name None means plain periodic samples (instant-vector selector):
+    last sample within the stale-lookback window, which callers express by
+    passing range_ms = lookback and fn_name = 'last_over_time'.
+    """
+    ctx = make_ctx(ts_off, vals, wends, range_ms, base_ms)
+    name = fn_name or "last_over_time"
+    spec = RANGE_FUNCTIONS[name]
+    if spec.needs_params:
+        return spec.fn(ctx, *params[: spec.needs_params])
+    return spec.fn(ctx)

@@ -1,0 +1,70 @@
+"""Window-grid math for periodic-sample evaluation on device.
+
+The reference iterates windows over compressed chunks host-side
+(ref: query/.../exec/PeriodicSamplesMapper.scala:202-292 ChunkedWindowIterator).
+On TPU the same contract — for each output step, the window (wend-range, wend]
+of samples — becomes vectorized index math over dense [series, time] arrays:
+per-row binary search for window boundaries, then gather/cumsum tricks for the
+window reductions.  All shapes are static under jit; timestamps are int32
+millisecond offsets from a host-side int64 base (fits 24 days of window span,
+long ranges are split by the planner like the reference's time-splitting,
+ref: SingleClusterPlanner.scala:91-117).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# Padding sentinel for ts offsets beyond each row's sample count.  Chosen well
+# below int32 max so `pad + range_ms` cannot overflow.
+PAD_TS = np.int32(1 << 30)
+
+
+def make_window_ends(start_ms: int, end_ms: int, step_ms: int) -> np.ndarray:
+    """Absolute output step timestamps: start, start+step, ..., <= end
+    (PromQL range-query grid)."""
+    return np.arange(start_ms, end_ms + 1, step_ms, dtype=np.int64)
+
+
+def to_offsets(ts: np.ndarray, counts: np.ndarray, base_ms: int) -> np.ndarray:
+    """Host-side: int64 absolute ms -> padded int32 offsets from base."""
+    pos = np.arange(ts.shape[1])[None, :]
+    off = np.clip(ts - base_ms, -(1 << 30), 1 << 30).astype(np.int32)
+    return np.where(pos < counts[:, None], off, PAD_TS)
+
+
+@functools.partial(jax.jit, static_argnames=())
+def window_bounds(ts_off: jax.Array, wstart: jax.Array, wend: jax.Array
+                  ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Per (series, window) first/last sample indices and counts.
+
+    ts_off: int32 [S, T], ascending per row, PAD_TS beyond each row's count.
+    wstart/wend: int32 [W] inclusive window bounds (wstart = wend - range + 1).
+    Returns (first [S,W], last [S,W], n [S,W]); n == 0 means empty window.
+    """
+    def row(ts_row):
+        first = jnp.searchsorted(ts_row, wstart, side="left")
+        last = jnp.searchsorted(ts_row, wend, side="right") - 1
+        return first, last
+    first, last = jax.vmap(row)(ts_off)
+    n = jnp.maximum(last - first + 1, 0)
+    return first.astype(jnp.int32), last.astype(jnp.int32), n.astype(jnp.int32)
+
+
+def gather_at(arr: jax.Array, idx: jax.Array) -> jax.Array:
+    """Gather arr[s, idx[s, w]] -> [S, W]; idx clipped (caller masks)."""
+    safe = jnp.clip(idx, 0, arr.shape[1] - 1)
+    return jnp.take_along_axis(arr, safe, axis=1)
+
+
+def windowed_cumsum_delta(csum: jax.Array, first: jax.Array, last: jax.Array,
+                          n: jax.Array) -> jax.Array:
+    """Window sums from a cumulative array: csum[last] - csum[first-1].
+    csum: [S, T] inclusive cumsum along time.  Returns [S, W] (0 where n==0)."""
+    hi = gather_at(csum, last)
+    lo = jnp.where(first > 0, gather_at(csum, first - 1), 0.0)
+    return jnp.where(n > 0, hi - lo, 0.0)

@@ -1,0 +1,41 @@
+"""Test harness configuration.
+
+Tests run on a virtual 8-device CPU mesh so multi-shard / multi-host sharding
+logic is exercised without TPU hardware (the reference's analogue is the
+multi-JVM test harness, ref: standalone/src/multi-jvm).  Environment variables
+must be set before jax is imported anywhere.
+"""
+import os
+
+# Force CPU: the ambient environment points JAX at the real TPU (platform
+# 'axon'); unit tests must not occupy it and need 8 virtual devices.  The
+# TPU plugin is registered by a sitecustomize hook at interpreter start, so
+# jax is already imported — env vars alone are too late; use jax.config.
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+# Float64 on CPU for exact-semantics conformance tests against the reference's
+# double-precision math; the TPU runtime path uses float32 (see filodb_tpu.config).
+jax.config.update("jax_enable_x64", True)
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(scope="session")
+def mesh8():
+    from jax.sharding import Mesh
+    devs = np.array(jax.devices("cpu")[:8]).reshape(8)
+    return Mesh(devs, ("shard",))
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(42)

@@ -1,0 +1,168 @@
+"""Brute-force numpy oracle for PromQL window semantics.
+
+Implements the reference behavior sample-by-sample (window = samples with
+ts in [wend-range+1, wend]; extrapolation per RateFunctions.scala:37-76;
+counter correction by walking resets) so kernel tests compare the vectorized
+TPU implementations against an independently-written scalar model.
+"""
+from __future__ import annotations
+
+import math
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+def correct_counter(vals: Sequence[float]) -> List[float]:
+    out = []
+    corr = 0.0
+    prev = None
+    for v in vals:
+        if v is None or (isinstance(v, float) and math.isnan(v)):
+            out.append(float("nan"))
+            continue
+        if prev is not None and v < prev:
+            corr += prev - v
+        prev = v
+        out.append(v + corr)
+    return out
+
+
+def extrapolated_rate(window_start: float, window_end: float, n: int,
+                      t1: float, v1: float, t2: float, v2: float,
+                      is_counter: bool, is_rate: bool) -> float:
+    if n < 2:
+        return float("nan")
+    dur_start = (t1 - window_start) / 1000.0
+    dur_end = (window_end - t2) / 1000.0
+    sampled = (t2 - t1) / 1000.0
+    avg = sampled / (n - 1)
+    delta = v2 - v1
+    if is_counter and delta > 0 and v1 >= 0:
+        dur_zero = sampled * (v1 / delta)
+        if dur_zero < dur_start:
+            dur_start = dur_zero
+    threshold = avg * 1.1
+    extrap = sampled
+    extrap += dur_start if dur_start < threshold else avg / 2
+    extrap += dur_end if dur_end < threshold else avg / 2
+    scaled = delta * (extrap / sampled)
+    if is_rate:
+        return scaled / (window_end - window_start) * 1000.0
+    return scaled
+
+
+def window_samples(ts: np.ndarray, vals: np.ndarray, wend: int, range_ms: int
+                   ) -> Tuple[np.ndarray, np.ndarray]:
+    lo = wend - range_ms + 1
+    m = (ts >= lo) & (ts <= wend)
+    return ts[m], vals[m]
+
+
+def eval_series(ts: np.ndarray, vals: np.ndarray, wends: Sequence[int],
+                range_ms: int, fn: str, params: Tuple = ()) -> np.ndarray:
+    """Evaluate one range function over one series, one value per window."""
+    out = np.full(len(wends), np.nan)
+    corrected = np.array(correct_counter(list(vals)))
+    for i, wend in enumerate(wends):
+        wt, wv = window_samples(ts, vals, wend, range_ms)
+        mask = ~np.isnan(wv)
+        if fn in ("rate", "increase", "irate"):
+            _, wc = window_samples(ts, corrected, wend, range_ms)
+        if len(wt) == 0:
+            if fn == "absent_over_time":
+                out[i] = 1.0
+            continue
+        if fn == "rate" or fn == "increase":
+            if len(wt) >= 2:
+                out[i] = extrapolated_rate(wend - range_ms, wend, len(wt),
+                                           wt[0], wc[0], wt[-1], wc[-1],
+                                           True, fn == "rate")
+        elif fn == "delta":
+            if len(wt) >= 2:
+                out[i] = extrapolated_rate(wend - range_ms, wend, len(wt),
+                                           wt[0], wv[0], wt[-1], wv[-1],
+                                           False, False)
+        elif fn == "irate":
+            if len(wt) >= 2:
+                out[i] = (wc[-1] - wc[-2]) / ((wt[-1] - wt[-2]) / 1000.0)
+        elif fn == "idelta":
+            if len(wt) >= 2:
+                out[i] = wv[-1] - wv[-2]
+        elif fn == "sum_over_time":
+            out[i] = np.sum(wv[mask])
+        elif fn == "count_over_time":
+            out[i] = np.sum(mask)
+        elif fn == "avg_over_time":
+            out[i] = np.mean(wv[mask]) if mask.any() else np.nan
+        elif fn == "min_over_time":
+            out[i] = np.min(wv[mask]) if mask.any() else np.nan
+        elif fn == "max_over_time":
+            out[i] = np.max(wv[mask]) if mask.any() else np.nan
+        elif fn == "stddev_over_time":
+            out[i] = np.std(wv[mask]) if mask.any() else np.nan
+        elif fn == "stdvar_over_time":
+            out[i] = np.var(wv[mask]) if mask.any() else np.nan
+        elif fn == "last_over_time":
+            out[i] = wv[-1]
+        elif fn == "quantile_over_time":
+            q = params[0]
+            out[i] = (np.quantile(wv[mask], q, method="linear")
+                      if mask.any() else np.nan)
+        elif fn == "changes":
+            # pairs of consecutive valid samples fully inside window
+            prev = None
+            cnt = 0
+            # find index of first window sample in the full series
+            for t, v in zip(ts, vals):
+                if t < wend - range_ms + 1 or t > wend or np.isnan(v):
+                    continue
+                if prev is not None and v != prev:
+                    cnt += 1
+                prev = v
+            out[i] = cnt
+        elif fn == "resets":
+            prev = None
+            cnt = 0
+            for t, v in zip(ts, vals):
+                if t < wend - range_ms + 1 or t > wend or np.isnan(v):
+                    continue
+                if prev is not None and v < prev:
+                    cnt += 1
+                prev = v
+            out[i] = cnt
+        elif fn == "deriv":
+            if mask.sum() >= 2:
+                t_s = wt[mask] / 1000.0
+                slope, _ = np.polyfit(t_s, wv[mask], 1)
+                out[i] = slope
+        elif fn == "predict_linear":
+            if mask.sum() >= 2:
+                t_s = wt[mask] / 1000.0
+                slope, icept = np.polyfit(t_s, wv[mask], 1)
+                out[i] = slope * (wend / 1000.0 + params[0]) + icept
+        elif fn == "z_score":
+            if mask.any():
+                mean = np.mean(wv[mask])
+                std = np.std(wv[mask])
+                out[i] = (wv[-1] - mean) / std
+        elif fn == "holt_winters":
+            sf, tf = params
+            xs = wv[mask]
+            if len(xs) >= 2:
+                s_prev = xs[0]
+                b = xs[1] - xs[0]
+                for j in range(1, len(xs)):
+                    if j > 1:
+                        b = tf * (s_prev - s_prev2) + (1 - tf) * b
+                    s_prev2, s_prev = s_prev, sf * xs[j] + (1 - sf) * (s_prev + b)
+                out[i] = s_prev
+        elif fn == "timestamp":
+            out[i] = wt[-1] / 1000.0
+        elif fn == "present_over_time":
+            out[i] = 1.0
+        elif fn == "absent_over_time":
+            pass
+        else:
+            raise ValueError(fn)
+    return out

@@ -1,0 +1,323 @@
+"""Core memstore tests — models the reference's TimeSeriesMemStoreSpec /
+TimeSeriesPartitionSpec / PartKeyLuceneIndexSpec
+(ref: core/src/test/.../memstore/)."""
+import numpy as np
+import pytest
+
+from filodb_tpu.core.index import (Equals, EqualsRegex, In, NotEquals, Prefix,
+                                   PartKeyIndex, MAX_TIME)
+from filodb_tpu.core.memstore import TimeSeriesMemStore
+from filodb_tpu.core.partkey import PartKey, strip_metric_suffix
+from filodb_tpu.core.records import RecordBatch, RecordBatchBuilder
+from filodb_tpu.core.schemas import DEFAULT_SCHEMAS, GAUGE, PROM_COUNTER
+from filodb_tpu.core.store import InMemoryColumnStore, InMemoryMetaStore
+from filodb_tpu.ingest.generator import (gauge_batch, counter_batch,
+                                         histogram_batch, batch_stream)
+
+
+# ---------------------------------------------------------------- part keys
+
+def test_partkey_identity_and_hashes():
+    pk1 = PartKey.make("heap_usage", {"_ws_": "demo", "_ns_": "App-0", "instance": "i1"})
+    pk2 = PartKey.make("heap_usage", {"instance": "i1", "_ns_": "App-0", "_ws_": "demo"})
+    assert pk1 == pk2
+    assert pk1.to_bytes() == pk2.to_bytes()
+    assert pk1.partition_hash() == pk2.partition_hash()
+    pk3 = PartKey.make("heap_usage", {"_ws_": "demo", "_ns_": "App-1", "instance": "i1"})
+    assert pk1.partition_hash() != pk3.partition_hash()
+
+
+def test_partkey_le_excluded_from_hash():
+    # `le` is excluded from the partition hash (ignoreTagsOnPartitionKeyHash)
+    a = PartKey.make("lat_bucket", {"_ws_": "w", "_ns_": "n", "le": "0.5"})
+    b = PartKey.make("lat_bucket", {"_ws_": "w", "_ns_": "n", "le": "2.5"})
+    assert a.partition_hash() == b.partition_hash()
+    assert a.to_bytes() != b.to_bytes()
+
+
+def test_shard_key_suffix_stripping():
+    # _bucket/_count/_sum share the base metric's shard key
+    assert strip_metric_suffix("http_latency_bucket") == "http_latency"
+    a = PartKey.make("http_latency_bucket", {"_ws_": "w", "_ns_": "n"})
+    b = PartKey.make("http_latency_sum", {"_ws_": "w", "_ns_": "n"})
+    c = PartKey.make("http_latency", {"_ws_": "w", "_ns_": "n"})
+    assert a.shard_key_hash() == b.shard_key_hash() == c.shard_key_hash()
+
+
+def test_copy_tags_derives_ns():
+    pk = PartKey.make("m", {"_ws_": "w", "job": "scraper"})
+    assert pk.label("_ns_") == "scraper"
+
+
+# ---------------------------------------------------------------- schemas
+
+def test_default_schemas():
+    s = DEFAULT_SCHEMAS
+    assert set(s.by_name) == {"gauge", "untyped", "prom-counter",
+                              "prom-histogram", "ds-gauge"}
+    assert s["prom-counter"].column("count").detect_drops
+    assert s["prom-histogram"].column("h").col_type == "hist"
+    assert s["gauge"].downsample_schema == "ds-gauge"
+    # ids stable and distinct
+    assert len({sch.schema_id for sch in s.by_name.values()}) == 5
+
+
+# ---------------------------------------------------------------- tag index
+
+def _mk_index():
+    idx = PartKeyIndex()
+    for i in range(10):
+        pk = PartKey.make("heap_usage", {"_ws_": "demo", "_ns_": f"App-{i % 3}",
+                                         "instance": f"Instance-{i}"})
+        idx.add_partition(i, pk, start_time_ms=1000 * i)
+    return idx
+
+
+def test_index_equals_and_in():
+    idx = _mk_index()
+    ids = idx.part_ids_from_filters([Equals("_ns_", "App-0")], 0, MAX_TIME)
+    assert sorted(ids.tolist()) == [0, 3, 6, 9]
+    ids = idx.part_ids_from_filters(
+        [In("_ns_", ("App-0", "App-1")), Equals("__name__", "heap_usage")],
+        0, MAX_TIME)
+    assert sorted(ids.tolist()) == [0, 1, 3, 4, 6, 7, 9]
+
+
+def test_index_regex_prefix_notequals():
+    idx = _mk_index()
+    ids = idx.part_ids_from_filters([EqualsRegex("instance", "Instance-[12]")],
+                                    0, MAX_TIME)
+    assert sorted(ids.tolist()) == [1, 2]
+    ids = idx.part_ids_from_filters([Prefix("instance", "Instance-1")], 0, MAX_TIME)
+    assert sorted(ids.tolist()) == [1]
+    ids = idx.part_ids_from_filters([NotEquals("_ns_", "App-0")], 0, MAX_TIME)
+    assert sorted(ids.tolist()) == [1, 2, 4, 5, 7, 8]
+
+
+def test_index_time_range_and_end_time():
+    idx = _mk_index()
+    idx.update_end_time(0, 1500)
+    ids = idx.part_ids_from_filters([Equals("_ns_", "App-0")], 2000, MAX_TIME)
+    assert 0 not in ids.tolist()
+    # start-time filter: series starting after query end excluded
+    ids = idx.part_ids_from_filters([], 0, 4500)
+    assert sorted(ids.tolist()) == [0, 1, 2, 3, 4]
+
+
+def test_index_label_values_and_names():
+    idx = _mk_index()
+    assert idx.label_values("_ns_") == ["App-0", "App-1", "App-2"]
+    assert idx.label_values("_ns_", [Equals("instance", "Instance-4")]) == ["App-1"]
+    assert "instance" in idx.label_names()
+    assert idx.label_values("__name__") == ["heap_usage"]
+
+
+def test_index_remove_partition():
+    idx = _mk_index()
+    idx.remove_partition(0)
+    ids = idx.part_ids_from_filters([Equals("_ns_", "App-0")], 0, MAX_TIME)
+    assert 0 not in ids.tolist()
+    assert idx.num_docs == 9
+
+
+# ---------------------------------------------------------------- records
+
+def test_record_batch_roundtrip():
+    batch = gauge_batch(5, 10)
+    blob = batch.to_bytes()
+    out = RecordBatch.from_bytes(blob)
+    assert out.schema.name == "gauge"
+    assert out.part_keys == batch.part_keys
+    np.testing.assert_array_equal(out.timestamps, batch.timestamps)
+    np.testing.assert_array_equal(out.columns["value"], batch.columns["value"])
+
+
+def test_record_batch_hist_roundtrip():
+    batch = histogram_batch(3, 5, num_buckets=4)
+    out = RecordBatch.from_bytes(batch.to_bytes())
+    assert out.columns["h"].shape == (15, 4)
+    np.testing.assert_array_equal(out.columns["h"], batch.columns["h"])
+    np.testing.assert_array_equal(out.bucket_les, batch.bucket_les)
+
+
+def test_record_builder():
+    b = RecordBatchBuilder(GAUGE)
+    pk = PartKey.make("m", {"_ws_": "w", "_ns_": "n"})
+    for i in range(5):
+        b.add(pk, 1000 + i * 10, value=float(i))
+    batch = b.build()
+    assert batch.num_records == 5
+    assert len(batch.part_keys) == 1  # interned
+
+
+# ---------------------------------------------------------------- memstore
+
+def test_shard_ingest_and_lookup():
+    ms = TimeSeriesMemStore()
+    shard = ms.setup("prometheus", 0)
+    batch = gauge_batch(20, 50)
+    n = shard.ingest(batch, offset=1)
+    assert n == 1000
+    assert shard.num_partitions == 20
+    res = shard.lookup_partitions([Equals("_ns_", "App-0")], 0, MAX_TIME)
+    assert len(res.parts_by_schema["gauge"]) == 2
+    ts, cols, counts, store = shard.gather_series(res.parts_by_schema["gauge"])
+    assert ts.shape[0] == 2
+    assert (counts == 50).all()
+    # values are finite where counts valid
+    assert np.isfinite(cols["value"][0, :50]).all()
+
+
+def test_shard_out_of_order_dropped():
+    ms = TimeSeriesMemStore()
+    shard = ms.setup("prometheus", 0)
+    b1 = gauge_batch(2, 10, start_ms=1_000_000)
+    shard.ingest(b1)
+    # replay the same data: all out-of-order, all dropped
+    n = shard.ingest(gauge_batch(2, 10, start_ms=1_000_000))
+    assert n == 0
+    assert shard.stats.rows_dropped == 20
+
+
+def test_flush_and_recovery_roundtrip():
+    cs, meta = InMemoryColumnStore(), InMemoryMetaStore()
+    ms = TimeSeriesMemStore(column_store=cs, meta_store=meta)
+    shard = ms.setup("prometheus", 0)
+    batch = gauge_batch(10, 40)
+    stream = list(batch_stream(batch, samples_per_chunk=10))
+    for b, off in stream:
+        shard.ingest(b, off)
+    shard.flush_all_groups()
+    assert cs.num_chunksets() == 10  # one sealed chunk per series for this flush
+    # checkpoints recorded for all groups
+    cps = meta.read_checkpoints("prometheus", 0)
+    assert len(cps) == shard._groups
+    assert meta.read_highest_checkpoint("prometheus", 0) == 3
+
+    # new node: recover index from column store, then replay stream
+    ms2 = TimeSeriesMemStore(column_store=cs, meta_store=meta)
+    shard2 = ms2.setup("prometheus", 0)
+    assert shard2.recover_index() == 10
+    assert shard2.num_partitions == 10
+    replayed = shard2.recover_stream(stream)
+    # all offsets <= checkpoint watermark are skipped
+    assert replayed == 0
+
+
+def test_recovery_partial_checkpoint():
+    cs, meta = InMemoryColumnStore(), InMemoryMetaStore()
+    ms = TimeSeriesMemStore(column_store=cs, meta_store=meta)
+    shard = ms.setup("prometheus", 0)
+    batch = gauge_batch(4, 40)
+    stream = list(batch_stream(batch, samples_per_chunk=10))
+    # ingest only first 2 offsets, flush, then "crash"
+    for b, off in stream[:2]:
+        shard.ingest(b, off)
+    shard.flush_all_groups()
+
+    ms2 = TimeSeriesMemStore(column_store=cs, meta_store=meta)
+    shard2 = ms2.setup("prometheus", 0)
+    shard2.recover_index()
+    replayed = shard2.recover_stream(stream)
+    # offsets 2,3 replayed (2 batches x 4 series x 10 samples)
+    assert replayed == 2 * 4 * 10
+
+
+def test_eviction():
+    ms = TimeSeriesMemStore()
+    shard = ms.setup("prometheus", 0)
+    shard.ingest(gauge_batch(5, 10, start_ms=1_000_000))
+    for pid in range(5):
+        shard.index.update_end_time(pid, 1_050_000)
+    n = shard.evict_ended_partitions(2_000_000)
+    assert n == 5
+    assert shard.num_partitions == 0
+
+
+def test_dense_store_time_growth_and_eviction():
+    ms = TimeSeriesMemStore()
+    shard = ms.setup("prometheus", 0)
+    for i in range(4):
+        shard.ingest(gauge_batch(3, 100, start_ms=1_000_000 + i * 100 * 10_000))
+    store = shard.stores["gauge"]
+    assert (store.counts[:3] == 400).all()
+    # unflushed samples are never evicted (reclaim-only-persisted guarantee)
+    store.evict_oldest(100)
+    assert (store.counts[:3] == 400).all()
+    shard.flush_all_groups()
+    store.evict_oldest(100)
+    assert (store.counts[:3] == 300).all()
+    ts, cols, counts = store.gather_rows(np.array([0, 1, 2]))
+    assert np.isfinite(cols["value"][:, :300]).all()
+
+
+def test_partkey_bytes_no_delimiter_collision():
+    # label values may contain any byte; length-prefixed encoding must keep
+    # distinct series distinct (regression: \x00/\x01-joined encoding collided)
+    a = PartKey.make("m", {"a": "b\x01c\x00d"})
+    b = PartKey.make("m", {"a": "b", "c": "d"})
+    assert a.to_bytes() != b.to_bytes()
+    assert a.partition_hash() != b.partition_hash()
+    assert PartKey.from_bytes(a.to_bytes()) == a
+    assert PartKey.from_bytes(b.to_bytes()) == b
+
+
+def test_recordbatch_roundtrip_hostile_labels():
+    from filodb_tpu.core.records import RecordBatchBuilder
+    from filodb_tpu.core.schemas import DEFAULT_SCHEMAS
+    bld = RecordBatchBuilder(DEFAULT_SCHEMAS["gauge"])
+    pk = PartKey.make("m\x02x", {"k\x01": "v\x00\x02w"})
+    bld.add(pk, 1_000, value=1.5)
+    batch = bld.build()
+    rt = RecordBatch.from_bytes(batch.to_bytes())
+    assert rt.part_keys == [pk]
+    assert rt.timestamps.tolist() == [1_000]
+
+
+def test_flush_group_stable_across_restart_no_data_loss():
+    """Crash-replay scenario: group checkpoints must filter by a partKey-stable
+    group id, or unflushed records get silently dropped on recovery."""
+    cs, mstore = InMemoryColumnStore(), InMemoryMetaStore()
+    ms = TimeSeriesMemStore(column_store=cs, meta_store=mstore)
+    shard = ms.setup("prometheus", 0)
+    batch = gauge_batch(8, 50, start_ms=1_000_000)
+    shard.ingest(batch, offset=10)
+    # flush only ONE group, then "crash" (other groups unflushed)
+    flushed_group = shard.partitions[0].group
+    shard.flush_group(flushed_group)
+
+    ms2 = TimeSeriesMemStore(column_store=cs, meta_store=mstore)
+    shard2 = ms2.setup("prometheus", 0)
+    shard2.recover_index()
+    replayed = shard2.recover_stream([(batch, 10)])
+    # every record NOT in the flushed group must be replayed
+    expect = sum(50 for p in shard.partitions
+                 if p is not None and p.group != flushed_group)
+    assert replayed == expect
+    # and total samples visible after recovery covers all 8 series
+    for p in shard2.partitions:
+        store = shard2.stores[p.schema_name]
+        if p.group == flushed_group:
+            # flushed data lives in the column store (ODP tier), not memstore
+            continue
+        assert store.counts[p.row] == 50
+
+
+def test_evict_preserves_unsealed_low_volume_series():
+    """One hot series overflowing must not destroy another series' unflushed
+    samples (regression: uniform-shift eviction)."""
+    from filodb_tpu.core.blockstore import DenseSeriesStore
+    from filodb_tpu.core.schemas import DEFAULT_SCHEMAS
+    store = DenseSeriesStore(DEFAULT_SCHEMAS["gauge"], initial_series=2,
+                             initial_time=8, max_time_cap=64)
+    hot, cold = store.new_row(), store.new_row()
+    # cold series: 5 unflushed samples
+    store.append_batch(np.full(5, cold), np.arange(5, dtype=np.int64) * 1000 + 1,
+                       {"value": np.arange(5, dtype=float)})
+    # hot series: flood past max_time_cap
+    n = 100
+    store.append_batch(np.full(n, hot), np.arange(n, dtype=np.int64) * 1000 + 1,
+                       {"value": np.ones(n)})
+    assert store.counts[cold] == 5
+    vals = store.cols["value"][cold, :5]
+    np.testing.assert_array_equal(vals, np.arange(5, dtype=float))

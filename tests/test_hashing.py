@@ -1,0 +1,30 @@
+"""xxHash known-answer tests (standard XXH32/XXH64 vectors)."""
+from filodb_tpu.utils.hashing import xxhash32, xxhash64, hash32_signed
+
+
+def test_xxhash32_vectors():
+    assert xxhash32(b"") == 0x02CC5D05
+    assert xxhash32(b"abc") == 0x32D153FF
+    assert xxhash32(b"", seed=1) != xxhash32(b"")
+    # >16 bytes exercises the 4-lane path
+    assert xxhash32(b"0123456789abcdef0123") == xxhash32(b"0123456789abcdef0123")
+
+
+def test_xxhash64_vectors():
+    assert xxhash64(b"") == 0xEF46DB3751D8E999
+    assert xxhash64(b"abc") == 0x44BC2CF5AD770999
+
+
+def test_hash32_signed_range():
+    for data in [b"", b"a", b"foo_bar_metric", b"x" * 100]:
+        h = hash32_signed(data)
+        assert -(1 << 31) <= h < (1 << 31)
+        assert (h & 0xFFFFFFFF) == xxhash32(data)
+
+
+def test_determinism_across_lengths():
+    seen = set()
+    for i in range(64):
+        h = xxhash32(bytes(range(i)))
+        assert h not in seen
+        seen.add(h)

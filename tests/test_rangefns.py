@@ -1,0 +1,149 @@
+"""Range-function kernel conformance vs the scalar numpy oracle
+(models ref: query/src/test/.../WindowIteratorSpec.scala, RateFunctionsSpec.scala)."""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from filodb_tpu.ops import counter as counter_ops
+from filodb_tpu.ops.rangefns import evaluate_range_function, RANGE_FUNCTIONS
+from filodb_tpu.ops.timewindow import to_offsets, make_window_ends, PAD_TS
+
+from oracle import correct_counter, eval_series
+
+START = 1_600_000_000_000
+STEP = 10_000
+
+
+def _series(num_samples, kind="counter", seed=0, nan_every=0):
+    rng = np.random.default_rng(seed)
+    ts = START + np.arange(num_samples, dtype=np.int64) * STEP \
+        + rng.integers(-500, 500, size=num_samples)
+    ts.sort()
+    if kind == "counter":
+        vals = np.cumsum(rng.exponential(10, size=num_samples))
+        # inject two resets
+        if num_samples > 20:
+            vals[num_samples // 3:] -= vals[num_samples // 3] * 0.9
+            vals[2 * num_samples // 3:] -= vals[2 * num_samples // 3] * 0.5
+    else:
+        vals = rng.normal(50, 15, size=num_samples)
+    if nan_every:
+        vals[::nan_every] = np.nan
+    return ts, vals
+
+
+def _run_kernel(ts_list, vals_list, wends, range_ms, fn, params=()):
+    S = len(ts_list)
+    T = max(len(t) for t in ts_list)
+    base = int(wends[0] - range_ms)
+    ts_mat = np.full((S, T), 0, dtype=np.int64)
+    val_mat = np.full((S, T), np.nan)
+    counts = np.zeros(S, dtype=np.int32)
+    for i, (t, v) in enumerate(zip(ts_list, vals_list)):
+        ts_mat[i, :len(t)] = t
+        val_mat[i, :len(v)] = v
+        counts[i] = len(t)
+    ts_off = to_offsets(ts_mat, counts, base)
+    wends_off = (np.asarray(wends, dtype=np.int64) - base).astype(np.int32)
+    out = evaluate_range_function(jnp.asarray(ts_off), jnp.asarray(val_mat),
+                                  jnp.asarray(wends_off), range_ms, fn,
+                                  tuple(params), base_ms=base)
+    return np.asarray(out)
+
+
+CHEAP_FNS = ["rate", "increase", "delta", "irate", "idelta", "sum_over_time",
+             "count_over_time", "avg_over_time", "min_over_time",
+             "max_over_time", "stddev_over_time", "stdvar_over_time",
+             "last_over_time", "changes", "resets", "deriv", "z_score",
+             "timestamp", "present_over_time", "absent_over_time"]
+
+
+@pytest.mark.parametrize("fn", CHEAP_FNS)
+def test_kernel_matches_oracle(fn):
+    kind = "counter" if fn in ("rate", "increase", "irate", "resets") else "gauge"
+    ts1, v1 = _series(120, kind, seed=1)
+    ts2, v2 = _series(80, kind, seed=2)
+    ts3, v3 = _series(120, kind, seed=3, nan_every=17)
+    wends = make_window_ends(START + 300_000, START + 1_100_000, 60_000)
+    range_ms = 300_000
+    out = _run_kernel([ts1, ts2, ts3], [v1, v2, v3], wends, range_ms, fn)
+    # linear-regression-based fns accumulate rounding over large ts offsets
+    rtol = 1e-6 if fn in ("deriv", "z_score", "predict_linear") else 1e-9
+    for i, (t, v) in enumerate([(ts1, v1), (ts2, v2), (ts3, v3)]):
+        expect = eval_series(t, v, wends, range_ms, fn)
+        np.testing.assert_allclose(out[i], expect, rtol=rtol, atol=1e-9,
+                                   err_msg=f"{fn} series {i}")
+
+
+@pytest.mark.parametrize("fn,params", [
+    ("quantile_over_time", (0.75,)),
+    ("predict_linear", (600.0,)),
+    ("holt_winters", (0.5, 0.1)),
+])
+def test_param_kernels_match_oracle(fn, params):
+    ts1, v1 = _series(100, "gauge", seed=5)
+    wends = make_window_ends(START + 300_000, START + 900_000, 60_000)
+    out = _run_kernel([ts1], [v1], wends, 300_000, fn, params)
+    expect = eval_series(ts1, v1, wends, 300_000, fn, params)
+    np.testing.assert_allclose(out[0], expect, rtol=1e-7, atol=1e-9)
+
+
+def test_counter_correct_matches_oracle():
+    _, v = _series(60, "counter", seed=9)
+    v[5] = np.nan
+    corrected = np.asarray(counter_ops.counter_correct(jnp.asarray(v[None, :])))[0]
+    expect = np.array(correct_counter(list(v)))
+    np.testing.assert_allclose(corrected, expect, equal_nan=True)
+    # monotone where valid
+    cv = corrected[~np.isnan(corrected)]
+    assert (np.diff(cv) >= 0).all()
+
+
+def test_reset_across_nan_gap_detected():
+    v = np.array([10.0, 20.0, np.nan, 5.0, 8.0])
+    corrected = np.asarray(counter_ops.counter_correct(jnp.asarray(v[None, :])))[0]
+    np.testing.assert_allclose(corrected[3:], [20.0, 23.0])
+
+
+def test_rate_simple_hand_computed():
+    # regular 10s counter, +5 per sample, window exactly covering samples
+    ts = START + np.arange(31, dtype=np.int64) * 10_000
+    vals = 5.0 * np.arange(31)
+    wend = int(ts[-1])
+    out = _run_kernel([ts], [vals], [wend], 300_000, "rate")
+    # samples exactly span the window: t1 = wend-300000, no extrapolation slack
+    # beyond half-interval; compare directly to oracle formula
+    expect = eval_series(ts, vals, [wend], 300_000, "rate")
+    np.testing.assert_allclose(out[0], expect)
+    # and the obvious physical rate is 0.5/s
+    assert abs(out[0][0] - 0.5) < 0.01
+
+
+def test_empty_window_nan():
+    ts, v = _series(10, "gauge")
+    wends = [int(ts[-1]) + 10_000_000]
+    out = _run_kernel([ts], [v], wends, 60_000, "sum_over_time")
+    assert np.isnan(out[0][0])
+    out = _run_kernel([ts], [v], wends, 60_000, "absent_over_time")
+    assert out[0][0] == 1.0
+
+
+def test_single_sample_rate_is_nan():
+    ts = np.array([START], dtype=np.int64)
+    out = _run_kernel([ts], [np.array([100.0])], [START + 100], 60_000, "rate")
+    assert np.isnan(out[0][0])
+
+
+def test_quantile_out_of_bounds():
+    ts, v = _series(20, "gauge")
+    out = _run_kernel([ts], [v], [int(ts[-1])], 300_000,
+                      "quantile_over_time", (1.5,))
+    assert np.isposinf(out[0][0])
+
+
+def test_holt_winters_smoke():
+    ts, v = _series(50, "gauge", seed=13)
+    out = _run_kernel([ts], [v], [int(ts[-1])], 300_000,
+                      "holt_winters", (0.5, 0.1))
+    assert np.isfinite(out[0][0])
