@@ -27,7 +27,7 @@ class AggSpec(NamedTuple):
 
 
 AGGREGATORS: Dict[str, AggSpec] = {
-    "sum":    AggSpec(1, "sum"),
+    "sum":    AggSpec(2, "sum"),     # (sum, count) — count masks empty steps
     "count":  AggSpec(1, "sum"),
     "avg":    AggSpec(2, "sum"),     # (sum, count)
     "min":    AggSpec(1, "min"),
@@ -35,6 +35,7 @@ AGGREGATORS: Dict[str, AggSpec] = {
     "stddev": AggSpec(3, "sum"),     # (sum, sumsq, count)
     "stdvar": AggSpec(3, "sum"),
     "group":  AggSpec(1, "max"),     # group() = 1 for any present series
+    "hist_sum": AggSpec(0, "sum"),   # [B buckets + count]; B is data-dependent
 }
 
 
@@ -55,8 +56,10 @@ def map_phase(op: str, vals: jax.Array, group_ids: jax.Array,
     present = ~jnp.isnan(vals)
     zeroed = jnp.where(present, vals, 0.0)
     cnt = present.astype(vals.dtype)
-    if op in ("sum", "count"):
-        comp = [zeroed] if op == "sum" else [cnt]
+    if op == "sum":
+        comp = [zeroed, cnt]
+    elif op == "count":
+        comp = [cnt]
     elif op == "avg":
         comp = [zeroed, cnt]
     elif op in ("stddev", "stdvar"):
@@ -86,8 +89,8 @@ def reduce_phase(op: str, a: jax.Array, b: jax.Array) -> jax.Array:
 def present(op: str, partial: jax.Array) -> jax.Array:
     """Partial components [G, W, C] -> final [G, W] (NaN where no series)."""
     if op == "sum":
-        s = partial[..., 0]
-        return s  # caller masks empty groups via count-based presence if needed
+        s, c = partial[..., 0], partial[..., 1]
+        return jnp.where(c > 0, s, jnp.nan)
     if op == "count":
         c = partial[..., 0]
         return jnp.where(c > 0, c, jnp.nan)
@@ -112,15 +115,8 @@ def present(op: str, partial: jax.Array) -> jax.Array:
 @functools.partial(jax.jit, static_argnames=("op", "num_groups"))
 def aggregate(op: str, vals: jax.Array, group_ids: jax.Array,
               num_groups: int) -> jax.Array:
-    """Single-shard shortcut: map + present in one pass -> [G, W].
-    For `sum` this also applies presence masking (NaN when group empty)."""
-    partial = map_phase(op, vals, group_ids, num_groups)
-    out = present(op, partial)
-    if op == "sum":
-        cnt = jax.ops.segment_sum((~jnp.isnan(vals)).astype(vals.dtype),
-                                  group_ids, num_segments=num_groups)
-        out = jnp.where(cnt > 0, out, jnp.nan)
-    return out
+    """Single-shard shortcut: map + present in one pass -> [G, W]."""
+    return present(op, map_phase(op, vals, group_ids, num_groups))
 
 
 # ----------------------------------------------------------- rank aggregates

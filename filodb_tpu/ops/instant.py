@@ -118,11 +118,13 @@ COMPARISON_OPERATORS: Dict[str, Callable] = {
 }
 
 
-def apply_binary_op(op: str, lhs: jax.Array, rhs: jax.Array,
-                    bool_modifier: bool = False) -> jax.Array:
-    """Vector op vector/scalar.  Comparison without `bool` filters (keeps lhs
-    value where true, NaN where false); with `bool` returns 1/0.
-    ref: query BinaryOperator semantics + ScalarOperationMapper:186."""
+def apply_binary_op(lhs: jax.Array, rhs: jax.Array, *, op: str,
+                    bool_modifier: bool = False,
+                    keep_side: str = "lhs") -> jax.Array:
+    """Vector op vector/scalar.  Comparison without `bool` filters: keeps the
+    vector side's value (keep_side) where true, NaN where false; with `bool`
+    returns 1/0.  ref: query BinaryOperator semantics +
+    ScalarOperationMapper:186."""
     absent = jnp.isnan(lhs) | jnp.isnan(rhs)
     if op in ARITH_OPERATORS:
         out = ARITH_OPERATORS[op](lhs, rhs)
@@ -130,4 +132,5 @@ def apply_binary_op(op: str, lhs: jax.Array, rhs: jax.Array,
     cmp = COMPARISON_OPERATORS[op](lhs, rhs)
     if bool_modifier:
         return jnp.where(absent, jnp.nan, cmp.astype(lhs.dtype))
-    return jnp.where(~absent & cmp, lhs, jnp.nan)
+    kept = lhs if keep_side == "lhs" else rhs
+    return jnp.where(~absent & cmp, kept, jnp.nan)

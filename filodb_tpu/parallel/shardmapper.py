@@ -1,0 +1,156 @@
+"""ShardMapper — shard routing with spread (hot-key splitting).
+
+Reproduces the reference's shard math exactly (ref: coordinator/.../
+ShardMapper.scala:26-120, doc/sharding.md:23-56):
+
+  - numShards is a power of 2.
+  - shardKeyHash (hash of _ws_/_ns_/_metric_) selects a contiguous run of
+    2^spread shards; partitionHash selects within the run:
+        shardHash = (shardKeyHash & ~mask) | (partHash & mask)
+        shard     = shardHash & (numShards - 1),  mask = (1<<spread) - 1
+    ...expressed upstream as the upper bits from the shard key and the lower
+    `spread` bits from the partition hash.
+  - queryShards(shardKeyHash, spread) = all shards the key can land on.
+
+Shard status tracking mirrors ShardStatus + ShardMapper.updateFromEvent.
+"""
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import Dict, List, Optional, Sequence, Tuple
+
+
+class ShardStatus(enum.Enum):
+    """ref: coordinator/ShardStatus.scala."""
+    UNASSIGNED = "Unassigned"
+    ASSIGNED = "Assigned"
+    RECOVERY = "Recovery"
+    ACTIVE = "Active"
+    ERROR = "Error"
+    STOPPED = "Stopped"
+    DOWN = "Down"
+
+    @property
+    def query_ready(self) -> bool:
+        return self in (ShardStatus.ACTIVE, ShardStatus.RECOVERY)
+
+
+@dataclasses.dataclass
+class ShardEvent:
+    """ref: coordinator/ShardEvent ADT (IngestionStarted, RecoveryInProgress,
+    IngestionStopped, ShardDown...)."""
+    kind: str
+    dataset: str
+    shard: int
+    node: Optional[str] = None
+    progress_pct: int = 0
+
+
+_EVENT_STATUS = {
+    "ShardAssignmentStarted": ShardStatus.ASSIGNED,
+    "IngestionStarted": ShardStatus.ACTIVE,
+    "RecoveryInProgress": ShardStatus.RECOVERY,
+    "RecoveryStarted": ShardStatus.RECOVERY,
+    "IngestionStopped": ShardStatus.STOPPED,
+    "IngestionError": ShardStatus.ERROR,
+    "ShardDown": ShardStatus.DOWN,
+}
+
+
+class ShardMapper:
+    """Tracks shard -> (node, status) and does spread-based shard math."""
+
+    def __init__(self, num_shards: int):
+        assert num_shards > 0 and (num_shards & (num_shards - 1)) == 0, \
+            "numShards must be a power of 2"
+        self.num_shards = num_shards
+        self.nodes: List[Optional[str]] = [None] * num_shards
+        self.statuses: List[ShardStatus] = [ShardStatus.UNASSIGNED] * num_shards
+
+    # ------------------------------------------------------------ shard math
+
+    def _mask(self, spread: int) -> int:
+        """spread clamped so 2^spread never exceeds numShards
+        (the reference requires spread <= log2(numShards))."""
+        return min((1 << spread) - 1, self.num_shards - 1)
+
+    def ingestion_shard(self, shard_key_hash: int, partition_hash: int,
+                        spread: int) -> int:
+        """ref: ShardMapper.ingestionShard:108-120 — upper bits from the
+        shard-key hash, lower `spread` bits from the partition hash."""
+        mask = self._mask(spread)
+        h = (shard_key_hash & ~mask) | (partition_hash & mask)
+        return h & (self.num_shards - 1)
+
+    def query_shards(self, shard_key_hash: int, spread: int) -> List[int]:
+        """ref: ShardMapper.queryShards:93 — every shard 2^spread wide run."""
+        mask = self._mask(spread)
+        base = shard_key_hash & ~mask & (self.num_shards - 1)
+        return [base | i for i in range(mask + 1)]
+
+    def all_shards(self) -> List[int]:
+        return list(range(self.num_shards))
+
+    # --------------------------------------------------------- status state
+
+    def update_from_event(self, ev: ShardEvent) -> None:
+        st = _EVENT_STATUS.get(ev.kind)
+        if st is None:
+            raise ValueError(f"unknown shard event {ev.kind}")
+        self.statuses[ev.shard] = st
+        if ev.node is not None:
+            self.nodes[ev.shard] = ev.node
+        if st in (ShardStatus.DOWN, ShardStatus.UNASSIGNED):
+            self.nodes[ev.shard] = None
+
+    def register_node(self, shards: Sequence[int], node: str) -> None:
+        for s in shards:
+            self.nodes[s] = node
+            if self.statuses[s] == ShardStatus.UNASSIGNED:
+                self.statuses[s] = ShardStatus.ASSIGNED
+
+    def unassign(self, shard: int) -> None:
+        self.nodes[shard] = None
+        self.statuses[shard] = ShardStatus.UNASSIGNED
+
+    def node_for_shard(self, shard: int) -> Optional[str]:
+        return self.nodes[shard]
+
+    def shards_for_node(self, node: str) -> List[int]:
+        return [i for i, n in enumerate(self.nodes) if n == node]
+
+    @property
+    def num_assigned(self) -> int:
+        return sum(1 for n in self.nodes if n is not None)
+
+    def active_shards(self, shards: Optional[Sequence[int]] = None) -> List[int]:
+        shards = shards if shards is not None else range(self.num_shards)
+        return [s for s in shards if self.statuses[s].query_ready]
+
+    def status_snapshot(self) -> Dict[int, Tuple[Optional[str], str]]:
+        return {i: (self.nodes[i], self.statuses[i].value)
+                for i in range(self.num_shards)}
+
+
+@dataclasses.dataclass(frozen=True)
+class SpreadChange:
+    """Spread override by shard-key filters (ref: filodb-defaults.conf:157-161
+    + SpreadProvider)."""
+    shard_key: Dict[str, str]
+    spread: int
+
+
+class SpreadProvider:
+    """ref: coordinator SpreadProvider/FilodbSpreadMap."""
+
+    def __init__(self, default_spread: int = 1,
+                 overrides: Sequence[SpreadChange] = ()):
+        self.default_spread = default_spread
+        self.overrides = list(overrides)
+
+    def spread_for(self, shard_key: Dict[str, str]) -> int:
+        for ov in self.overrides:
+            if all(shard_key.get(k) == v for k, v in ov.shard_key.items()):
+                return ov.spread
+        return self.default_spread

@@ -1,0 +1,14 @@
+"""PromQL front-end: lexer + Pratt parser -> AST -> LogicalPlan.
+
+The reference routes between a legacy combinator parser and a generated
+ANTLR parser (ref: prometheus/.../parse/Parser.scala:13-70); this package is
+a single hand-written recursive-descent/Pratt parser covering the same
+grammar including FiloDB extensions (`_ws_`/`_ns_` shard keys, `::col`
+column selection, `_bucket_`; ref: doc/query-engine.md:206-229).
+"""
+from filodb_tpu.promql.parser import (parse_query, query_to_logical_plan,
+                                      query_range_to_logical_plan,
+                                      TimeStepParams, ParseError)
+
+__all__ = ["parse_query", "query_to_logical_plan",
+           "query_range_to_logical_plan", "TimeStepParams", "ParseError"]

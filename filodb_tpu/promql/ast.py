@@ -1,0 +1,91 @@
+"""PromQL AST (ref: prometheus/src/main/scala/filodb/prometheus/ast/
+Vectors.scala, Expressions.scala, Functions.scala, Aggregates.scala,
+Operators.scala)."""
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Tuple
+
+
+class Expr:
+    pass
+
+
+@dataclasses.dataclass
+class LabelMatcher:
+    name: str
+    op: str                 # = != =~ !~
+    value: str
+
+
+@dataclasses.dataclass
+class VectorSelector(Expr):
+    metric: Optional[str]
+    matchers: List[LabelMatcher]
+    offset_ms: int = 0
+    at_ms: Optional[int] = None
+    column: Optional[str] = None        # FiloDB ::col extension
+
+
+@dataclasses.dataclass
+class MatrixSelector(Expr):
+    selector: VectorSelector
+    range_ms: int
+
+
+@dataclasses.dataclass
+class Subquery(Expr):
+    expr: Expr
+    window_ms: int
+    step_ms: Optional[int]              # None -> default eval interval
+    offset_ms: int = 0
+    at_ms: Optional[int] = None
+
+
+@dataclasses.dataclass
+class NumberLit(Expr):
+    value: float
+
+
+@dataclasses.dataclass
+class StringLit(Expr):
+    value: str
+
+
+@dataclasses.dataclass
+class Call(Expr):
+    name: str
+    args: List[Expr]
+
+
+@dataclasses.dataclass
+class Agg(Expr):
+    op: str
+    expr: Expr
+    params: List[Expr]
+    by: Tuple[str, ...] = ()
+    without: Tuple[str, ...] = ()
+
+
+@dataclasses.dataclass
+class VectorMatch:
+    on: Optional[Tuple[str, ...]] = None
+    ignoring: Tuple[str, ...] = ()
+    group_left: bool = False
+    group_right: bool = False
+    include: Tuple[str, ...] = ()
+
+
+@dataclasses.dataclass
+class BinaryExpr(Expr):
+    op: str
+    lhs: Expr
+    rhs: Expr
+    bool_modifier: bool = False
+    matching: Optional[VectorMatch] = None
+
+
+@dataclasses.dataclass
+class Unary(Expr):
+    op: str
+    expr: Expr

@@ -1,0 +1,117 @@
+"""PromQL lexer.
+
+Token set mirrors the reference grammar (ref: prometheus/src/main/antlr4/
+PromQL.g4 area + LegacyParser tokens): identifiers (incl. `:` for recording
+rules and the FiloDB `::column` suffix handled in the parser), numbers
+(int/float/hex/Inf/NaN), durations (1h30m), strings, operators, keywords.
+"""
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Iterator, List, Optional
+
+
+class ParseError(ValueError):
+    pass
+
+
+@dataclasses.dataclass
+class Token:
+    kind: str           # IDENT NUMBER DURATION STRING OP KEYWORD EOF
+    text: str
+    pos: int
+
+
+KEYWORDS = {
+    "and", "or", "unless", "by", "without", "on", "ignoring",
+    "group_left", "group_right", "offset", "bool", "start", "end",
+}
+
+_DUR_RE = re.compile(r"(?:\d+(?:\.\d+)?(?:ms|s|m|h|d|w|y))+")
+_NUM_RE = re.compile(
+    r"0x[0-9a-fA-F]+|(?:\d+\.?\d*|\.\d+)(?:[eE][+-]?\d+)?|[iI]nf|[nN]a[nN]")
+_IDENT_RE = re.compile(r"[a-zA-Z_][a-zA-Z0-9_:]*")   # recording rules keep
+                                                     # inner ':' but cannot
+                                                     # start with one
+_OPS = ["==", "!=", "=~", "!~", ">=", "<=", "<<", ">>", "@", ">", "<", "=",
+        "+", "-", "*", "/", "%", "^", "(", ")", "{", "}", "[", "]", ",", ":"]
+
+_UNITS_MS = {"ms": 1, "s": 1000, "m": 60_000, "h": 3_600_000,
+             "d": 86_400_000, "w": 7 * 86_400_000, "y": 365 * 86_400_000}
+
+
+def duration_to_ms(text: str) -> int:
+    total = 0.0
+    for m in re.finditer(r"(\d+(?:\.\d+)?)(ms|s|m|h|d|w|y)", text):
+        total += float(m.group(1)) * _UNITS_MS[m.group(2)]
+    return int(total)
+
+
+def tokenize(q: str) -> List[Token]:
+    out: List[Token] = []
+    i, n = 0, len(q)
+    while i < n:
+        c = q[i]
+        if c in " \t\n\r":
+            i += 1
+            continue
+        if c == "#":                               # comment to EOL
+            while i < n and q[i] != "\n":
+                i += 1
+            continue
+        if c in "\"'`":
+            j = i + 1
+            buf = []
+            while j < n and q[j] != c:
+                if q[j] == "\\" and j + 1 < n:
+                    esc = q[j + 1]
+                    buf.append({"n": "\n", "t": "\t", "\\": "\\",
+                                '"': '"', "'": "'"}.get(esc, "\\" + esc))
+                    j += 2
+                else:
+                    buf.append(q[j])
+                    j += 1
+            if j >= n:
+                raise ParseError(f"unterminated string at {i}")
+            out.append(Token("STRING", "".join(buf), i))
+            i = j + 1
+            continue
+        m = _DUR_RE.match(q, i)
+        if m and not q[i].isalpha():
+            # durations start with a digit; distinguish from plain numbers by
+            # the unit suffix.  "5m" -> DURATION, "5" -> NUMBER, "5e3" NUMBER.
+            num = _NUM_RE.match(q, i)
+            if num is None or len(m.group(0)) > len(num.group(0)):
+                out.append(Token("DURATION", m.group(0), i))
+                i = m.end()
+                continue
+        m = _NUM_RE.match(q, i)
+        if m and (c.isdigit() or c == "." or
+                  (c in "iInN" and m.group(0).lower() in ("inf", "nan"))):
+            # only treat inf/nan as numbers when not part of an identifier
+            if c.isalpha():
+                ident = _IDENT_RE.match(q, i)
+                if ident and ident.group(0).lower() not in ("inf", "nan"):
+                    out.append(Token("IDENT", ident.group(0), i))
+                    i = ident.end()
+                    continue
+            out.append(Token("NUMBER", m.group(0), i))
+            i = m.end()
+            continue
+        ident = _IDENT_RE.match(q, i)
+        if ident:
+            text = ident.group(0)
+            kind = "KEYWORD" if text in KEYWORDS else "IDENT"
+            out.append(Token(kind, text, i))
+            i = ident.end()
+            continue
+        for op in _OPS:
+            if q.startswith(op, i):
+                out.append(Token("OP", op, i))
+                i += len(op)
+                break
+        else:
+            raise ParseError(f"unexpected character {c!r} at {i}")
+    out.append(Token("EOF", "", n))
+    return out

@@ -1,0 +1,579 @@
+"""PromQL Pratt parser + AST -> LogicalPlan conversion.
+
+Covers the reference grammar (ref: prometheus/.../parse/Parser.scala:135
+queryRangeToLogicalPlan, ast/Expressions.scala toSeriesPlan) including:
+aggregation by/without (both clause orders), binary operators with PromQL
+precedence + bool modifier + on/ignoring/group_left/group_right, offset,
+subqueries `[5m:1m]`, FiloDB `::column` selection, and `_ws_`/`_ns_`
+shard-key labels (they are plain label matchers here).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Sequence, Tuple
+
+from filodb_tpu.core.index import (ColumnFilter, Equals, EqualsRegex,
+                                   NotEquals, NotEqualsRegex)
+from filodb_tpu.promql import ast as A
+from filodb_tpu.promql.lexer import ParseError, Token, duration_to_ms, tokenize
+from filodb_tpu.query import logical as lp
+
+# ---------------------------------------------------------------- function sets
+
+RANGE_FUNCTIONS = {
+    "rate", "increase", "delta", "irate", "idelta", "resets", "changes",
+    "deriv", "predict_linear", "sum_over_time", "count_over_time",
+    "avg_over_time", "min_over_time", "max_over_time", "stddev_over_time",
+    "stdvar_over_time", "last_over_time", "quantile_over_time",
+    "holt_winters", "z_score", "timestamp", "absent_over_time",
+    "present_over_time", "mad_over_time",
+}
+
+AGG_OPERATORS = {
+    "sum", "min", "max", "avg", "count", "stddev", "stdvar", "topk",
+    "bottomk", "quantile", "count_values", "group",
+}
+
+INSTANT_FNS = {
+    "abs", "ceil", "floor", "exp", "ln", "log2", "log10", "sqrt", "round",
+    "clamp", "clamp_min", "clamp_max", "sgn", "deg", "rad",
+    "sin", "cos", "tan", "asin", "acos", "atan", "sinh", "cosh", "tanh",
+    "asinh", "acosh", "atanh",
+    "histogram_quantile", "histogram_max_quantile", "histogram_bucket",
+}
+
+DATE_FNS = {"minute", "hour", "day_of_week", "day_of_month", "month", "year",
+            "days_in_month"}
+
+MISC_FNS = {"label_replace", "label_join", "hist_to_prom_vectors"}
+
+_PREC = [  # lowest to highest
+    ({"or"}, "left"),
+    ({"and", "unless"}, "left"),
+    ({"==", "!=", ">", "<", ">=", "<="}, "left"),
+    ({"+", "-"}, "left"),
+    ({"*", "/", "%", "atan2"}, "left"),
+    ({"^"}, "right"),
+]
+
+
+@dataclasses.dataclass
+class TimeStepParams:
+    """Seconds, like the reference's TimeStepParams."""
+    start: int
+    step: int
+    end: int
+
+
+# -------------------------------------------------------------------- parser
+
+
+class _Parser:
+    def __init__(self, query: str):
+        self.toks = tokenize(query)
+        self.i = 0
+
+    def peek(self) -> Token:
+        return self.toks[self.i]
+
+    def next(self) -> Token:
+        t = self.toks[self.i]
+        self.i += 1
+        return t
+
+    def expect(self, kind: str, text: Optional[str] = None) -> Token:
+        t = self.next()
+        if t.kind != kind or (text is not None and t.text != text):
+            raise ParseError(f"expected {text or kind} at pos {t.pos}, "
+                             f"got {t.kind}:{t.text!r}")
+        return t
+
+    def at_op(self, *texts: str) -> bool:
+        t = self.peek()
+        return ((t.kind == "OP" or t.kind == "KEYWORD") and t.text in texts)
+
+    # ---- entry
+
+    def parse(self) -> A.Expr:
+        e = self.parse_expr(0)
+        t = self.peek()
+        if t.kind != "EOF":
+            raise ParseError(f"trailing input at pos {t.pos}: {t.text!r}")
+        return e
+
+    def parse_expr(self, level: int) -> A.Expr:
+        if level >= len(_PREC):
+            return self.parse_unary()
+        ops, assoc = _PREC[level]
+        lhs = self.parse_expr(level + 1)
+        while True:
+            t = self.peek()
+            if not ((t.kind in ("OP", "KEYWORD", "IDENT")) and t.text in ops):
+                break
+            self.next()
+            bool_mod = False
+            if self.at_op("bool"):
+                self.next()
+                bool_mod = True
+            matching = self._parse_matching()
+            rhs_level = level + (0 if assoc == "right" else 1)
+            rhs = self.parse_expr(rhs_level)
+            lhs = A.BinaryExpr(t.text, lhs, rhs, bool_mod, matching)
+        return lhs
+
+    def _parse_matching(self) -> Optional[A.VectorMatch]:
+        if not self.at_op("on", "ignoring"):
+            return None
+        kw = self.next().text
+        labels = self._label_list()
+        m = A.VectorMatch()
+        if kw == "on":
+            m.on = labels
+        else:
+            m.ignoring = labels
+        if self.at_op("group_left", "group_right"):
+            side = self.next().text
+            if side == "group_left":
+                m.group_left = True
+            else:
+                m.group_right = True
+            if self.at_op("("):
+                m.include = self._label_list()
+        return m
+
+    def _label_list(self) -> Tuple[str, ...]:
+        self.expect("OP", "(")
+        out: List[str] = []
+        while not self.at_op(")"):
+            t = self.next()
+            if t.kind not in ("IDENT", "KEYWORD"):
+                raise ParseError(f"expected label name at {t.pos}")
+            out.append(t.text)
+            if self.at_op(","):
+                self.next()
+        self.expect("OP", ")")
+        return tuple(out)
+
+    def parse_unary(self) -> A.Expr:
+        if self.at_op("-", "+"):
+            op = self.next().text
+            e = self.parse_unary()
+            return e if op == "+" else A.Unary("-", e)
+        return self.parse_postfix()
+
+    def parse_postfix(self) -> A.Expr:
+        e = self.parse_atom()
+        while True:
+            if self.at_op("["):
+                self.next()
+                rng = self.expect("DURATION").text
+                if self.at_op(":"):
+                    self.next()
+                    step = None
+                    if self.peek().kind == "DURATION":
+                        step = duration_to_ms(self.next().text)
+                    self.expect("OP", "]")
+                    e = A.Subquery(e, duration_to_ms(rng), step)
+                else:
+                    self.expect("OP", "]")
+                    if not isinstance(e, A.VectorSelector):
+                        raise ParseError("range selector on non-vector")
+                    e = A.MatrixSelector(e, duration_to_ms(rng))
+                continue
+            if self.at_op("offset"):
+                self.next()
+                neg = False
+                if self.at_op("-"):
+                    self.next()
+                    neg = True
+                off = duration_to_ms(self.expect("DURATION").text)
+                off = -off if neg else off
+                self._apply_offset(e, off)
+                continue
+            if self.at_op("@"):
+                self.next()
+                if self.at_op("start", "end"):
+                    which = self.next().text
+                    self.expect("OP", "(")
+                    self.expect("OP", ")")
+                    at_ms = ("start", which)
+                else:
+                    at_ms = int(float(self.expect("NUMBER").text) * 1000)
+                self._apply_at(e, at_ms)
+                continue
+            break
+        return e
+
+    @staticmethod
+    def _apply_offset(e: A.Expr, off: int) -> None:
+        if isinstance(e, A.VectorSelector):
+            e.offset_ms = off
+        elif isinstance(e, A.MatrixSelector):
+            e.selector.offset_ms = off
+        elif isinstance(e, A.Subquery):
+            e.offset_ms = off
+        else:
+            raise ParseError("offset must follow a selector or subquery")
+
+    @staticmethod
+    def _apply_at(e: A.Expr, at) -> None:
+        if isinstance(e, A.VectorSelector):
+            e.at_ms = at
+        elif isinstance(e, A.MatrixSelector):
+            e.selector.at_ms = at
+        elif isinstance(e, A.Subquery):
+            e.at_ms = at
+        else:
+            raise ParseError("@ must follow a selector or subquery")
+
+    def parse_atom(self) -> A.Expr:
+        t = self.peek()
+        if t.kind == "OP" and t.text == "(":
+            self.next()
+            e = self.parse_expr(0)
+            self.expect("OP", ")")
+            return e
+        if t.kind == "NUMBER":
+            self.next()
+            return A.NumberLit(_num(t.text))
+        if t.kind == "STRING":
+            self.next()
+            return A.StringLit(t.text)
+        if t.kind == "OP" and t.text == "{":
+            return self.parse_selector(None)
+        if t.kind in ("IDENT", "KEYWORD"):
+            name = t.text
+            if name in AGG_OPERATORS and self._lookahead_is_agg():
+                return self.parse_agg()
+            nxt = self.toks[self.i + 1]
+            if nxt.kind == "OP" and nxt.text == "(" and (
+                    name in RANGE_FUNCTIONS or name in INSTANT_FNS or
+                    name in DATE_FNS or name in MISC_FNS or
+                    name in ("scalar", "vector", "time", "absent", "sort",
+                             "sort_desc", "pi", "limitk")):
+                self.next()
+                return self.parse_call(name)
+            self.next()
+            return self.parse_selector(name)
+        raise ParseError(f"unexpected token {t.text!r} at {t.pos}")
+
+    def _lookahead_is_agg(self) -> bool:
+        nxt = self.toks[self.i + 1]
+        return nxt.kind == "OP" and nxt.text == "(" or \
+            (nxt.kind == "KEYWORD" and nxt.text in ("by", "without"))
+
+    def parse_agg(self) -> A.Expr:
+        op = self.next().text
+        by: Tuple[str, ...] = ()
+        without: Tuple[str, ...] = ()
+        if self.at_op("by", "without"):             # prefix clause
+            kw = self.next().text
+            labels = self._label_list()
+            if kw == "by":
+                by = labels
+            else:
+                without = labels
+        self.expect("OP", "(")
+        args: List[A.Expr] = [self.parse_expr(0)]
+        while self.at_op(","):
+            self.next()
+            args.append(self.parse_expr(0))
+        self.expect("OP", ")")
+        if self.at_op("by", "without"):             # suffix clause
+            kw = self.next().text
+            labels = self._label_list()
+            if kw == "by":
+                by = labels
+            else:
+                without = labels
+        params = args[:-1]
+        expr = args[-1]
+        return A.Agg(op, expr, params, by, without)
+
+    def parse_call(self, name: str) -> A.Expr:
+        self.expect("OP", "(")
+        args: List[A.Expr] = []
+        while not self.at_op(")"):
+            args.append(self.parse_expr(0))
+            if self.at_op(","):
+                self.next()
+        self.expect("OP", ")")
+        return A.Call(name, args)
+
+    def parse_selector(self, metric: Optional[str]) -> A.VectorSelector:
+        column = None
+        if metric is not None and "::" in metric:
+            metric, column = metric.split("::", 1)
+        matchers: List[A.LabelMatcher] = []
+        if self.at_op("{"):
+            self.next()
+            while not self.at_op("}"):
+                nt = self.next()
+                if nt.kind not in ("IDENT", "KEYWORD"):
+                    raise ParseError(f"expected label name at {nt.pos}")
+                opt = self.next()
+                if opt.kind != "OP" or opt.text not in ("=", "!=", "=~", "!~"):
+                    raise ParseError(f"bad matcher op at {opt.pos}")
+                val = self.expect("STRING")
+                matchers.append(A.LabelMatcher(nt.text, opt.text, val.text))
+                if self.at_op(","):
+                    self.next()
+            self.expect("OP", "}")
+        if metric is None and not matchers:
+            raise ParseError("empty selector")
+        return A.VectorSelector(metric, matchers, column=column)
+
+
+def _num(text: str) -> float:
+    t = text.lower()
+    if t.startswith("0x"):
+        return float(int(t, 16))
+    if t == "inf":
+        return float("inf")
+    if t == "nan":
+        return float("nan")
+    return float(t)
+
+
+def parse_query(query: str) -> A.Expr:
+    return _Parser(query).parse()
+
+
+# ----------------------------------------------------- AST -> LogicalPlan
+
+
+def _filters(sel: A.VectorSelector) -> Tuple[ColumnFilter, ...]:
+    out: List[ColumnFilter] = []
+    if sel.metric:
+        out.append(Equals("_metric_", sel.metric))
+    for m in sel.matchers:
+        col = m.name
+        if m.op == "=":
+            out.append(Equals(col, m.value))
+        elif m.op == "!=":
+            out.append(NotEquals(col, m.value))
+        elif m.op == "=~":
+            out.append(EqualsRegex(col, m.value))
+        else:
+            out.append(NotEqualsRegex(col, m.value))
+    return tuple(out)
+
+
+class _Converter:
+    def __init__(self, params: TimeStepParams):
+        self.start_ms = params.start * 1000
+        self.step_ms = max(params.step, 1) * 1000
+        self.end_ms = params.end * 1000
+
+    def convert(self, e: A.Expr) -> lp.LogicalPlan:
+        return self._conv(e, self.start_ms, self.step_ms, self.end_ms)
+
+    # scalar test helper
+    @staticmethod
+    def _is_scalar(p: lp.LogicalPlan) -> bool:
+        return isinstance(p, lp.ScalarPlan)
+
+    def _conv(self, e: A.Expr, start, step, end) -> lp.LogicalPlan:
+        if isinstance(e, A.NumberLit):
+            return lp.ScalarFixedDoublePlan(e.value, start, step, end)
+        if isinstance(e, A.VectorSelector):
+            self._check_at(e)
+            raw = lp.RawSeries(
+                lp.IntervalSelector(start, end), _filters(e),
+                columns=(e.column,) if e.column else (),
+                offset_ms=e.offset_ms or None)
+            return lp.PeriodicSeries(raw, start, step, end,
+                                     offset_ms=e.offset_ms or None)
+        if isinstance(e, A.MatrixSelector):
+            raise ParseError("range selector must be inside a range function")
+        if isinstance(e, A.Subquery):
+            # offset shifts the whole inner evaluation window back; results
+            # keep the inner grid's (shifted) sample timestamps like a
+            # matrix selector with offset
+            off = e.offset_ms or 0
+            inner_step = e.step_ms or step
+            inner = self._conv(e.expr, start - e.window_ms - off,
+                               inner_step, end - off)
+            return lp.TopLevelSubquery(inner, start, step, end,
+                                       offset_ms=e.offset_ms or None)
+        if isinstance(e, A.Unary):
+            inner = self._conv(e.expr, start, step, end)
+            if isinstance(inner, lp.ScalarFixedDoublePlan):
+                return lp.ScalarFixedDoublePlan(-inner.scalar, start, step, end)
+            if isinstance(inner, lp.ScalarPlan):
+                return lp.ScalarBinaryOperation("-", 0.0, inner, start, step, end)  # type: ignore[arg-type]
+            return lp.ScalarVectorBinaryOperation(
+                "-", lp.ScalarFixedDoublePlan(0.0, start, step, end), inner,
+                scalar_is_lhs=True)
+        if isinstance(e, A.Agg):
+            return self._conv_agg(e, start, step, end)
+        if isinstance(e, A.Call):
+            return self._conv_call(e, start, step, end)
+        if isinstance(e, A.BinaryExpr):
+            return self._conv_binary(e, start, step, end)
+        if isinstance(e, A.StringLit):
+            raise ParseError("string literal cannot be a query result")
+        raise ParseError(f"cannot convert {type(e).__name__}")
+
+    @staticmethod
+    def _check_at(sel: A.VectorSelector):
+        if sel.at_ms is not None:
+            raise ParseError("@ modifier is not supported yet")
+
+    def _conv_agg(self, e: A.Agg, start, step, end) -> lp.LogicalPlan:
+        inner = self._conv(e.expr, start, step, end)
+        params: List = []
+        for p in e.params:
+            if isinstance(p, A.NumberLit):
+                params.append(p.value)
+            elif isinstance(p, A.StringLit):
+                params.append(p.value)
+            else:
+                raise ParseError("aggregate parameter must be a literal")
+        return lp.Aggregate(e.op, inner, tuple(params), tuple(e.by),
+                            tuple(e.without))
+
+    def _conv_call(self, e: A.Call, start, step, end) -> lp.LogicalPlan:
+        name = e.name
+        if name == "time":
+            return lp.ScalarTimeBasedPlan("time", start, step, end)
+        if name == "pi":
+            import math
+            return lp.ScalarFixedDoublePlan(math.pi, start, step, end)
+        if name in DATE_FNS and not e.args:
+            return lp.ScalarTimeBasedPlan(name, start, step, end)
+        if name == "scalar":
+            inner = self._conv(e.args[0], start, step, end)
+            return lp.ScalarVaryingDoublePlan(inner)
+        if name == "vector":
+            inner = self._conv(e.args[0], start, step, end)
+            if not isinstance(inner, lp.ScalarPlan):
+                raise ParseError("vector() requires a scalar argument")
+            return lp.VectorPlan(inner)
+        if name == "absent":
+            inner_expr = e.args[0]
+            inner = self._conv(inner_expr, start, step, end)
+            filters: Tuple[ColumnFilter, ...] = ()
+            if isinstance(inner_expr, A.VectorSelector):
+                filters = _filters(inner_expr)
+            return lp.ApplyAbsentFunction(inner, filters, start, step, end)
+        if name in ("sort", "sort_desc"):
+            inner = self._conv(e.args[0], start, step, end)
+            return lp.ApplySortFunction(inner, name)
+        if name == "limitk":
+            k = e.args[0]
+            assert isinstance(k, A.NumberLit)
+            inner = self._conv(e.args[1], start, step, end)
+            return lp.ApplyLimitFunction(inner, int(k.value))
+        if name in MISC_FNS:
+            str_args = []
+            vec = None
+            for a in e.args:
+                if isinstance(a, A.StringLit):
+                    str_args.append(a.value)
+                else:
+                    vec = a
+            inner = self._conv(vec, start, step, end)
+            return lp.ApplyMiscellaneousFunction(inner, name, tuple(str_args))
+        if name in RANGE_FUNCTIONS:
+            return self._conv_range_fn(e, start, step, end)
+        if name in INSTANT_FNS or name in DATE_FNS:
+            # args convert first; exactly one must be the vector operand —
+            # a non-literal scalar (e.g. scalar(x)) stays a scalar argument
+            scalar_args: List = []
+            vec_plan = None
+            for a in e.args:
+                if isinstance(a, A.NumberLit):
+                    scalar_args.append(a.value)
+                    continue
+                p = self._conv(a, start, step, end)
+                if isinstance(p, lp.ScalarPlan):
+                    scalar_args.append(p)
+                elif vec_plan is None:
+                    vec_plan = p
+                else:
+                    raise ParseError(f"{name} takes one vector argument")
+            if vec_plan is None:
+                raise ParseError(f"{name} needs a vector argument")
+            return lp.ApplyInstantFunction(vec_plan, name, tuple(scalar_args))
+        raise ParseError(f"unknown function {name}")
+
+    def _conv_range_fn(self, e: A.Call, start, step, end) -> lp.LogicalPlan:
+        fn_args: List[float] = []
+        target = None
+        for a in e.args:
+            if isinstance(a, A.NumberLit):
+                fn_args.append(a.value)
+            else:
+                target = a
+        if isinstance(target, A.MatrixSelector):
+            sel = target.selector
+            self._check_at(sel)
+            raw = lp.RawSeries(
+                lp.IntervalSelector(start - target.range_ms, end),
+                _filters(sel),
+                columns=(sel.column,) if sel.column else (),
+                offset_ms=sel.offset_ms or None)
+            return lp.PeriodicSeriesWithWindowing(
+                raw, start, step, end, target.range_ms, e.name,
+                tuple(fn_args), offset_ms=sel.offset_ms or None)
+        if isinstance(target, A.Subquery):
+            sq = target
+            off = sq.offset_ms or 0
+            inner_step = sq.step_ms or step
+            # outer windows evaluate at wends - offset, reaching back a full
+            # subquery window: inner data must span [start-off-window, end-off]
+            inner = self._conv(sq.expr, start - off - sq.window_ms,
+                               inner_step, end - off)
+            return lp.SubqueryWithWindowing(
+                inner, start, step, end, e.name, tuple(fn_args),
+                sq.window_ms, inner_step, offset_ms=sq.offset_ms or None)
+        raise ParseError(f"{e.name} requires a range-vector argument")
+
+    def _conv_binary(self, e: A.BinaryExpr, start, step, end) -> lp.LogicalPlan:
+        lhs = self._conv(e.lhs, start, step, end)
+        rhs = self._conv(e.rhs, start, step, end)
+        op = e.op + ("_bool" if e.bool_modifier else "")
+        l_scalar = self._is_scalar(lhs)
+        r_scalar = self._is_scalar(rhs)
+        if l_scalar and r_scalar:
+            def unwrap(p):
+                if isinstance(p, lp.ScalarFixedDoublePlan):
+                    return p.scalar
+                if isinstance(p, lp.ScalarBinaryOperation):
+                    return p
+                raise ParseError("complex scalar operand not supported in "
+                                 "scalar-scalar expression")
+            return lp.ScalarBinaryOperation(e.op, unwrap(lhs), unwrap(rhs),
+                                            start, step, end)
+        if l_scalar or r_scalar:
+            scalar, vector = (lhs, rhs) if l_scalar else (rhs, lhs)
+            return lp.ScalarVectorBinaryOperation(op, scalar, vector,
+                                                  scalar_is_lhs=l_scalar)
+        m = e.matching or A.VectorMatch()
+        cardinality = "OneToOne"
+        include: Tuple[str, ...] = ()
+        if m.group_left:
+            cardinality = "ManyToOne"
+            include = m.include
+        elif m.group_right:
+            cardinality = "OneToMany"
+            include = m.include
+        if e.op in ("and", "or", "unless"):
+            cardinality = "ManyToMany"
+        return lp.BinaryJoin(lhs, op, rhs, cardinality,
+                             on=m.on, ignoring=m.ignoring, include=include)
+
+
+def query_range_to_logical_plan(query: str,
+                                params: TimeStepParams) -> lp.LogicalPlan:
+    """ref: Parser.queryRangeToLogicalPlan (parse/Parser.scala:135)."""
+    expr = parse_query(query)
+    return _Converter(params).convert(expr)
+
+
+def query_to_logical_plan(query: str, time_s: int,
+                          step_s: int = 1) -> lp.LogicalPlan:
+    """Instant query (ref: Parser.queryToLogicalPlan)."""
+    return query_range_to_logical_plan(
+        query, TimeStepParams(time_s, step_s, time_s))

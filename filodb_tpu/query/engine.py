@@ -1,0 +1,128 @@
+"""QueryEngine — parse -> plan -> execute facade (the QueryActor analogue).
+
+ref: coordinator/.../QueryActor.scala:119-137 (LogicalPlan2Query ->
+SingleClusterPlanner.materialize -> ExecPlan.execute) and
+prometheus/.../query/PrometheusModel.scala (result JSON conversion).
+"""
+from __future__ import annotations
+
+import math
+import time as _time
+import uuid
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from filodb_tpu.parallel.shardmapper import ShardMapper, SpreadProvider
+from filodb_tpu.promql.parser import (TimeStepParams,
+                                      query_range_to_logical_plan)
+from filodb_tpu.query import logical as lp
+from filodb_tpu.query.planner import SingleClusterPlanner
+from filodb_tpu.query.rangevector import (PlannerParams, QueryContext,
+                                          QueryResult)
+
+
+class QueryEngine:
+
+    def __init__(self, dataset: str, source,
+                 shard_mapper: Optional[ShardMapper] = None,
+                 spread_provider: Optional[SpreadProvider] = None,
+                 planner: Optional[SingleClusterPlanner] = None):
+        self.dataset = dataset
+        self.source = source
+        self.shard_mapper = shard_mapper or _single_shard_mapper()
+        self.planner = planner or SingleClusterPlanner(
+            dataset, self.shard_mapper, spread_provider)
+
+    def _ctx(self, planner_params: Optional[PlannerParams]) -> QueryContext:
+        return QueryContext(query_id=str(uuid.uuid4()),
+                            submit_time_ms=int(_time.time() * 1000),
+                            planner_params=planner_params or PlannerParams())
+
+    def query_range(self, promql: str, start_s: int, step_s: int, end_s: int,
+                    planner_params: Optional[PlannerParams] = None
+                    ) -> QueryResult:
+        try:
+            plan = query_range_to_logical_plan(
+                promql, TimeStepParams(start_s, step_s, end_s))
+        except Exception as e:  # noqa: BLE001 — parse errors surface in result
+            return QueryResult([], error=f"parse error: {e}")
+        return self.exec_logical_plan(plan, planner_params)
+
+    def query_instant(self, promql: str, time_s: int,
+                      planner_params: Optional[PlannerParams] = None
+                      ) -> QueryResult:
+        return self.query_range(promql, time_s, 1, time_s, planner_params)
+
+    def exec_logical_plan(self, plan: lp.LogicalPlan,
+                          planner_params: Optional[PlannerParams] = None
+                          ) -> QueryResult:
+        ctx = self._ctx(planner_params)
+        try:
+            ep = self.planner.materialize(plan, ctx)
+        except Exception as e:  # noqa: BLE001
+            return QueryResult([], error=f"planning error: {e}")
+        if isinstance(plan, lp.MetadataQueryPlan):
+            data, stats = ep.execute_internal(self.source)
+            if isinstance(data, QueryResult):
+                return data
+            return QueryResult([], stats)
+        return ep.execute(self.source)
+
+    # ------------------------------------------------- Prometheus JSON model
+
+    @staticmethod
+    def to_prom_matrix(result: QueryResult) -> Dict:
+        """ref: PrometheusModel.toPromSuccessResponse (matrix result)."""
+        if result.error:
+            return {"status": "error", "errorType": "query_error",
+                    "error": result.error}
+        out = []
+        for key, wends, vals in result.series():
+            if vals.ndim == 2:      # histogram series -> skip buckets here
+                continue
+            pairs = [[int(t) / 1000.0, _fmt(v)]
+                     for t, v in zip(wends, vals) if not math.isnan(v)]
+            if pairs:
+                out.append({"metric": _prom_labels(key.labels_dict),
+                            "values": pairs})
+        return {"status": "success",
+                "data": {"resultType": "matrix", "result": out}}
+
+    @staticmethod
+    def to_prom_vector(result: QueryResult) -> Dict:
+        """Instant-vector response (last step of each series)."""
+        if result.error:
+            return {"status": "error", "errorType": "query_error",
+                    "error": result.error}
+        out = []
+        for key, wends, vals in result.series():
+            if vals.ndim == 2 or len(vals) == 0:
+                continue
+            v = vals[-1]
+            if not math.isnan(v):
+                out.append({"metric": _prom_labels(key.labels_dict),
+                            "value": [int(wends[-1]) / 1000.0, _fmt(v)]})
+        return {"status": "success",
+                "data": {"resultType": "vector", "result": out}}
+
+
+def _prom_labels(labels: Dict[str, str]) -> Dict[str, str]:
+    out = dict(labels)
+    metric = out.pop("_metric_", None)
+    if metric:
+        out["__name__"] = metric
+    return out
+
+
+def _fmt(v: float) -> str:
+    if math.isinf(v):
+        return "+Inf" if v > 0 else "-Inf"
+    return f"{v:.17g}" if v == v else "NaN"
+
+
+def _single_shard_mapper() -> ShardMapper:
+    from filodb_tpu.parallel.shardmapper import ShardEvent
+    m = ShardMapper(1)
+    m.update_from_event(ShardEvent("IngestionStarted", "", 0, "local"))
+    return m

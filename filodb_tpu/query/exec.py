@@ -1,0 +1,1230 @@
+"""ExecPlan — the distributed execution tree.
+
+Mirrors the reference's exec framework (ref: query/.../exec/ExecPlan.scala:41,
+RangeVectorTransformer.scala:36, AggrOverRangeVectors.scala, BinaryJoinExec.scala,
+DistConcatExec.scala, StitchRvsExec.scala) with a TPU-first data plane:
+
+  - Leaves gather a shard's matching series into ONE dense [S, T] batch
+    (RawBlock) instead of per-partition iterators.
+  - PeriodicSamplesMapper runs the fused window kernel (ops/rangefns.py) on
+    device producing a step-grid ResultBlock [S, W].
+  - AggregateMapReduce emits mesh-reducible partial components; the
+    map/reduce/present 3-phase contract is identical to the reference
+    (doc/query-engine.md:311-330) so partials can ride psum collectives.
+
+Dispatchers decouple tree topology from placement: InProcessPlanDispatcher
+runs a subtree inline; the cluster layer adds remote dispatch.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Dict, List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+import jax.numpy as jnp
+
+from filodb_tpu.core.index import ColumnFilter, Equals
+from filodb_tpu.ops import agg as agg_ops
+from filodb_tpu.ops import hist as hist_ops
+from filodb_tpu.ops.instant import (INSTANT_FUNCTIONS, ARITH_OPERATORS,
+                                    COMPARISON_OPERATORS, apply_binary_op)
+from filodb_tpu.ops.rangefns import evaluate_range_function
+from filodb_tpu.ops.timewindow import to_offsets, make_window_ends
+from filodb_tpu.query.rangevector import (QueryContext, QueryResult, QueryStats,
+                                          RangeVectorKey, ResultBlock,
+                                          concat_blocks, remove_nan_series)
+
+# --------------------------------------------------------------- data shapes
+
+
+@dataclasses.dataclass
+class RawBlock:
+    """Raw gathered samples for one schema on one shard: pre-step-grid."""
+    keys: List[RangeVectorKey]
+    ts_off: np.ndarray                  # int32 [S, T] offsets from base_ms
+    values: np.ndarray                  # [S, T] or [S, T, B]
+    base_ms: int
+    bucket_les: Optional[np.ndarray] = None
+    samples: int = 0                    # total valid samples (stats)
+
+
+@dataclasses.dataclass
+class ScalarResult:
+    """One value per step (scalar plans)."""
+    wends: np.ndarray                   # int64 [W]
+    values: np.ndarray                  # float [W]
+
+
+@dataclasses.dataclass
+class AggPartial:
+    """Partial aggregate: mesh-reducible (op-dependent) representation."""
+    op: str
+    group_keys: List[RangeVectorKey]
+    wends: np.ndarray
+    comp: Optional[np.ndarray] = None   # [G, W, C] associative component form
+    # candidate form (topk/bottomk/quantile/count_values): raw rows
+    cand_keys: Optional[List[RangeVectorKey]] = None
+    cand_vals: Optional[np.ndarray] = None   # [N, W]
+    cand_groups: Optional[np.ndarray] = None  # int [N] -> group_keys index
+    params: Tuple = ()
+    bucket_les: Optional[np.ndarray] = None  # hist_sum partials
+
+
+Data = Union[RawBlock, ResultBlock, ScalarResult, AggPartial, None]
+
+
+def _block_empty(wends: np.ndarray) -> ResultBlock:
+    return ResultBlock([], wends, np.zeros((0, len(wends))))
+
+
+# ------------------------------------------------------------- transformers
+
+
+class RangeVectorTransformer:
+    """ref: exec/RangeVectorTransformer.scala:36."""
+
+    def apply(self, data: Data, ctx: QueryContext, stats: QueryStats,
+              source=None) -> Data:
+        raise NotImplementedError
+
+    def args_str(self) -> str:
+        return ""
+
+    def __str__(self):
+        return f"{type(self).__name__}({self.args_str()})"
+
+
+@dataclasses.dataclass
+class PeriodicSamplesMapper(RangeVectorTransformer):
+    """Raw samples -> regular step grid, optional range function
+    (ref: exec/PeriodicSamplesMapper.scala:27)."""
+    start_ms: int
+    step_ms: int
+    end_ms: int
+    window_ms: Optional[int] = None     # None => plain lookback sampling
+    function: Optional[str] = None
+    function_args: Tuple[float, ...] = ()
+    offset_ms: int = 0
+    lookback_ms: int = 5 * 60 * 1000
+
+    def args_str(self):
+        return (f"start={self.start_ms}, step={self.step_ms}, end={self.end_ms}, "
+                f"window={self.window_ms}, functionId={self.function}, "
+                f"offset={self.offset_ms}")
+
+    def apply(self, data: Data, ctx: QueryContext, stats: QueryStats,
+              source=None) -> Data:
+        wends = make_window_ends(self.start_ms, self.end_ms, self.step_ms)
+        if data is None or (isinstance(data, RawBlock) and not data.keys):
+            return _block_empty(wends)
+        assert isinstance(data, RawBlock), "PeriodicSamplesMapper needs raw data"
+        window = self.window_ms if self.window_ms else self.lookback_ms
+        fn = self.function
+        base = data.base_ms
+        # offset: shift the window grid back, evaluate, keep original stamps
+        eval_wends = wends - self.offset_ms
+        wends_off = (eval_wends - base).astype(np.int32)
+        vals = data.values
+        if vals.ndim == 3:
+            S, T, B = vals.shape
+            flat = np.moveaxis(vals, 2, 1).reshape(S * B, T)
+            ts_rep = np.repeat(data.ts_off, B, axis=0)
+            out = np.asarray(evaluate_range_function(
+                jnp.asarray(ts_rep), jnp.asarray(flat),
+                jnp.asarray(wends_off), window, fn,
+                tuple(self.function_args), base_ms=base))
+            out = np.moveaxis(out.reshape(S, B, -1), 1, 2)     # [S, W, B]
+        else:
+            out = np.asarray(evaluate_range_function(
+                jnp.asarray(data.ts_off), jnp.asarray(vals),
+                jnp.asarray(wends_off), window, fn,
+                tuple(self.function_args), base_ms=base))
+        return ResultBlock(data.keys, wends, out, data.bucket_les)
+
+
+@dataclasses.dataclass
+class InstantVectorFunctionMapper(RangeVectorTransformer):
+    """ref: exec/RangeVectorTransformer.scala:61."""
+    function: str
+    args: Tuple = ()
+
+    def args_str(self):
+        return f"function={self.function}"
+
+    def apply(self, data: Data, ctx: QueryContext, stats: QueryStats,
+              source=None) -> Data:
+        if not isinstance(data, ResultBlock) or data.num_series == 0:
+            return data
+        vals = data.values
+        if self.function in ("histogram_quantile", "histogram_max_quantile"):
+            assert data.is_histogram, "histogram_quantile needs histogram data"
+            q = float(self._arg_value(self.args[0], source))
+            out = np.asarray(hist_ops.histogram_quantile(
+                q, jnp.asarray(vals), jnp.asarray(data.bucket_les)))
+            return ResultBlock(data.keys, data.wends, out)
+        if self.function == "histogram_bucket":
+            le = float(self._arg_value(self.args[0], source))
+            out = np.asarray(hist_ops.histogram_bucket(
+                le, jnp.asarray(vals), jnp.asarray(data.bucket_les)))
+            return ResultBlock(data.keys, data.wends, out)
+        fn = INSTANT_FUNCTIONS[self.function]
+        # elementwise functions broadcast per-step scalar args over [S, W]
+        extra = [np.asarray(self._arg_value(a, source, per_step=True))
+                 for a in self.args]
+        out = np.asarray(fn(jnp.asarray(vals),
+                            *[jnp.asarray(x) for x in extra]))
+        return ResultBlock(data.keys, data.wends, out, data.bucket_les)
+
+    @staticmethod
+    def _arg_value(a, source, per_step: bool = False):
+        """Resolve a (possibly deferred) scalar argument.  per_step returns a
+        [W] array for elementwise functions; otherwise a single float — a
+        genuinely time-varying scalar is rejected rather than silently
+        collapsed to its first step."""
+        if hasattr(a, "resolve"):                 # deferred scalar subplan
+            a = a.resolve(source)
+        if isinstance(a, ScalarResult):
+            if len(a.values) == 0:
+                return np.nan
+            if per_step:
+                return a.values
+            vals = a.values[~np.isnan(a.values)]
+            if len(vals) and not np.all(vals == vals[0]):
+                raise ValueError(
+                    "time-varying scalar argument not supported for this "
+                    "function")
+            return a.values[0] if len(vals) == 0 else vals[0]
+        return a
+
+
+@dataclasses.dataclass
+class ScalarOperationMapper(RangeVectorTransformer):
+    """vector op scalar (ref: RangeVectorTransformer.scala:186)."""
+    operator: str
+    scalar: Union[float, ScalarResult]
+    scalar_is_lhs: bool = False
+    bool_modifier: bool = False
+
+    def args_str(self):
+        return f"operator={self.operator}, scalarOnLhs={self.scalar_is_lhs}"
+
+    def apply(self, data: Data, ctx: QueryContext, stats: QueryStats,
+              source=None) -> Data:
+        if not isinstance(data, ResultBlock) or data.num_series == 0:
+            return data
+        vals = np.asarray(data.values)
+        scalar = self.scalar
+        if hasattr(scalar, "resolve"):            # deferred scalar subplan
+            scalar = scalar.resolve(source)
+        sv = (scalar.values[None, :] if isinstance(scalar, ScalarResult)
+              else np.full((1, 1), float(scalar)))
+        sv = np.broadcast_to(sv, vals.shape)
+        a, b = (sv, vals) if self.scalar_is_lhs else (vals, sv)
+        # comparison filtering keeps the VECTOR side's value
+        out = np.asarray(apply_binary_op(
+            jnp.asarray(a), jnp.asarray(b), op=self.operator,
+            bool_modifier=self.bool_modifier,
+            keep_side=("rhs" if self.scalar_is_lhs else "lhs")))
+        return ResultBlock(data.keys, data.wends, out, data.bucket_les)
+
+
+def _group_ids(keys: Sequence[RangeVectorKey], by: Tuple[str, ...],
+               without: Tuple[str, ...]) -> Tuple[np.ndarray, List[RangeVectorKey]]:
+    """Host-side grouping: series key -> group key (by/without semantics)."""
+    gmap: Dict[RangeVectorKey, int] = {}
+    gids = np.empty(len(keys), dtype=np.int32)
+    gkeys: List[RangeVectorKey] = []
+    for i, k in enumerate(keys):
+        if by:
+            gk = k.only(by)
+        elif without:
+            gk = k.without(tuple(without) + ("_metric_", "__name__"))
+        else:
+            gk = RangeVectorKey(())
+        gid = gmap.get(gk)
+        if gid is None:
+            gid = len(gkeys)
+            gmap[gk] = gid
+            gkeys.append(gk)
+        gids[i] = gid
+    return gids, gkeys
+
+
+_CANDIDATE_OPS = {"topk", "bottomk", "quantile", "count_values"}
+
+
+@dataclasses.dataclass
+class AggregateMapReduce(RangeVectorTransformer):
+    """Map phase of 3-phase aggregation (ref: AggrOverRangeVectors.scala:76)."""
+    op: str
+    params: Tuple = ()
+    by: Tuple[str, ...] = ()
+    without: Tuple[str, ...] = ()
+
+    def args_str(self):
+        return (f"aggrOp={self.op}, aggrParams={list(self.params)}, "
+                f"without={list(self.without)}, by={list(self.by)}")
+
+    def apply(self, data: Data, ctx: QueryContext, stats: QueryStats,
+              source=None) -> Data:
+        assert isinstance(data, (ResultBlock, type(None)))
+        if data is None or data.num_series == 0:
+            return None
+        vals = np.asarray(data.values)
+        gids, gkeys = _group_ids(data.keys, self.by, self.without)
+        limit = ctx.planner_params.group_by_cardinality_limit
+        if limit and len(gkeys) > limit:
+            raise ValueError(
+                f"group-by cardinality limit {limit} exceeded "
+                f"({len(gkeys)} groups)")
+        if data.is_histogram and self.op == "sum":
+            # histogram sum: elementwise over buckets — [G, W, B+1] where the
+            # extra slot counts present series (empty-step masking)
+            present = ~np.isnan(vals)
+            comp = np.where(present, vals, 0.0)
+            G = len(gkeys)
+            S, W, B = vals.shape
+            agg = np.zeros((G, W, B + 1))
+            np.add.at(agg[..., :B], gids, comp)     # view write-through
+            np.add.at(agg[..., B], gids, present.any(axis=2).astype(float))
+            return AggPartial("hist_sum", gkeys, data.wends, comp=agg,
+                              params=self.params, bucket_les=data.bucket_les)
+        if self.op in _CANDIDATE_OPS:
+            cand_keys, cand_vals, cand_groups = self._candidates(
+                data, vals, gids, len(gkeys))
+            return AggPartial(self.op, gkeys, data.wends, cand_keys=cand_keys,
+                              cand_vals=cand_vals, cand_groups=cand_groups,
+                              params=self.params)
+        comp = np.asarray(agg_ops.map_phase(
+            self.op, jnp.asarray(vals), jnp.asarray(gids), len(gkeys)))
+        return AggPartial(self.op, gkeys, data.wends, comp=comp,
+                          params=self.params)
+
+    def _candidates(self, data, vals, gids, num_groups):
+        if self.op in ("topk", "bottomk"):
+            k = int(self.params[0])
+            mask = np.asarray(agg_ops.topk_mask(
+                jnp.asarray(vals), jnp.asarray(gids), num_groups, k,
+                largest=(self.op == "topk")))
+            keep = mask.any(axis=1)
+            rows = np.flatnonzero(keep)
+        else:
+            rows = np.arange(len(data.keys))
+        return ([data.keys[int(r)] for r in rows], vals[rows], gids[rows])
+
+
+class AggregatePresenter(RangeVectorTransformer):
+    """Present phase (ref: AggrOverRangeVectors.scala:125)."""
+
+    def __init__(self, op: str, params: Tuple = ()):
+        self.op = op
+        self.params = params
+
+    def args_str(self):
+        return f"aggrOp={self.op}, aggrParams={list(self.params)}"
+
+    def apply(self, data: Data, ctx: QueryContext, stats: QueryStats,
+              source=None) -> Data:
+        if data is None:
+            return None
+        assert isinstance(data, AggPartial)
+        return present_partial(data)
+
+
+def present_partial(p: AggPartial) -> Optional[ResultBlock]:
+    """Finish an AggPartial into a ResultBlock."""
+    if p.comp is not None:
+        if p.op == "hist_sum":
+            # [G, W, B+1] with present-series count in the last slot
+            buckets = p.comp[..., :-1]
+            present_cnt = p.comp[..., -1]
+            out = np.where(present_cnt[..., None] > 0, buckets, np.nan)
+            return ResultBlock(p.group_keys, p.wends, out, p.bucket_les)
+        out = np.asarray(agg_ops.present(p.op, jnp.asarray(p.comp)))
+        return ResultBlock(p.group_keys, p.wends, out)
+    # candidate form
+    if p.op in ("topk", "bottomk"):
+        k = int(p.params[0])
+        gids = p.cand_groups
+        mask = np.asarray(agg_ops.topk_mask(
+            jnp.asarray(p.cand_vals), jnp.asarray(gids), len(p.group_keys),
+            k, largest=(p.op == "topk")))
+        vals = np.where(mask, p.cand_vals, np.nan)
+        block = ResultBlock(p.cand_keys, p.wends, vals)
+        return remove_nan_series(block)
+    if p.op == "quantile":
+        q = float(p.params[0])
+        out = np.asarray(agg_ops.quantile_agg(
+            jnp.asarray(p.cand_vals), jnp.asarray(p.cand_groups),
+            len(p.group_keys), q))
+        return ResultBlock(p.group_keys, p.wends, out)
+    if p.op == "count_values":
+        label = str(p.params[0])
+        vals = p.cand_vals
+        out_keys: List[RangeVectorKey] = []
+        out_rows: List[np.ndarray] = []
+        W = vals.shape[1]
+        for g in range(len(p.group_keys)):
+            rows = vals[p.cand_groups == g]
+            uniq = np.unique(rows[~np.isnan(rows)])
+            for v in uniq:
+                cnt = np.nansum(rows == v, axis=0).astype(float)
+                cnt[cnt == 0] = np.nan
+                lbls = dict(p.group_keys[g].labels)
+                lbls[label] = f"{v:g}"
+                out_keys.append(RangeVectorKey.make(lbls))
+                out_rows.append(cnt)
+        if not out_keys:
+            return None
+        return ResultBlock(out_keys, p.wends, np.stack(out_rows))
+    raise ValueError(p.op)
+
+
+def reduce_partials(parts: List[AggPartial]) -> Optional[AggPartial]:
+    """Inter-shard reduce (ReduceAggregateExec): merge partials by group key."""
+    parts = [p for p in parts if p is not None]
+    if not parts:
+        return None
+    op = parts[0].op
+    if op == "hist_sum":
+        # bucket-index-wise merge is only valid for identical bucket schemes;
+        # cross-scheme re-bucketing is not implemented — fail loudly rather
+        # than sum mismatched buckets (ref: HistogramBuckets scheme changes)
+        first_les = parts[0].bucket_les
+        for p in parts[1:]:
+            if (p.comp.shape[-1] != parts[0].comp.shape[-1]
+                    or (first_les is not None and p.bucket_les is not None
+                        and not np.array_equal(first_les, p.bucket_les))):
+                raise ValueError(
+                    "cannot merge histogram partials with different bucket "
+                    "schemes across shards")
+    gmap: Dict[RangeVectorKey, int] = {}
+    gkeys: List[RangeVectorKey] = []
+    for p in parts:
+        for k in p.group_keys:
+            if k not in gmap:
+                gmap[k] = len(gkeys)
+                gkeys.append(k)
+    wends = parts[0].wends
+    if parts[0].comp is not None:
+        C = parts[0].comp.shape[-1]
+        W = parts[0].comp.shape[1]
+        comb = agg_ops.AGGREGATORS.get(op, agg_ops.AggSpec(1, "sum")).combiner
+        init = 0.0 if comb == "sum" else (np.inf if comb == "min" else -np.inf)
+        out = np.full((len(gkeys), W, C), init)
+        for p in parts:
+            idx = np.asarray([gmap[k] for k in p.group_keys], dtype=np.int64)
+            if comb == "sum":
+                np.add.at(out, idx, p.comp)
+            elif comb == "min":
+                np.minimum.at(out, idx, p.comp)
+            else:
+                np.maximum.at(out, idx, p.comp)
+        return AggPartial(op, gkeys, wends, comp=out, params=parts[0].params,
+                          bucket_les=parts[0].bucket_les)
+    # candidate form: concat and remap groups
+    ck: List[RangeVectorKey] = []
+    cv: List[np.ndarray] = []
+    cg: List[np.ndarray] = []
+    for p in parts:
+        idx = np.asarray([gmap[k] for k in p.group_keys], dtype=np.int64)
+        ck.extend(p.cand_keys)
+        cv.append(p.cand_vals)
+        cg.append(idx[p.cand_groups])
+    return AggPartial(op, gkeys, wends,
+                      cand_keys=ck, cand_vals=np.concatenate(cv),
+                      cand_groups=np.concatenate(cg), params=parts[0].params)
+
+
+@dataclasses.dataclass
+class AbsentFunctionMapper(RangeVectorTransformer):
+    """absent() (ref: RangeVectorTransformer.scala:340)."""
+    filters: Tuple[ColumnFilter, ...]
+    start_ms: int = 0
+    step_ms: int = 0
+    end_ms: int = 0
+
+    def args_str(self):
+        return "functionId=absent"
+
+    def apply(self, data: Data, ctx: QueryContext, stats: QueryStats,
+              source=None) -> Data:
+        wends = (data.wends if isinstance(data, ResultBlock)
+                 else make_window_ends(self.start_ms, self.end_ms,
+                                       max(self.step_ms, 1)))
+        if isinstance(data, ResultBlock) and data.num_series:
+            present = ~np.isnan(np.asarray(data.values)).all(axis=0)
+        else:
+            present = np.zeros(len(wends), dtype=bool)
+        out = np.where(present, np.nan, 1.0)[None, :]
+        labels = {f.column: f.value for f in self.filters
+                  if isinstance(f, Equals)
+                  and f.column not in ("__name__", "_metric_")}
+        return ResultBlock([RangeVectorKey.make(labels)], wends, out)
+
+
+@dataclasses.dataclass
+class SortFunctionMapper(RangeVectorTransformer):
+    """sort()/sort_desc() by mean value (ref: RangeVectorTransformer.scala:254)."""
+    descending: bool = False
+
+    def args_str(self):
+        return f"function={'sort_desc' if self.descending else 'sort'}"
+
+    def apply(self, data: Data, ctx: QueryContext, stats: QueryStats,
+              source=None) -> Data:
+        if not isinstance(data, ResultBlock) or data.num_series <= 1:
+            return data
+        with np.errstate(invalid="ignore"):
+            means = np.nanmean(np.asarray(data.values), axis=1)
+        means = np.where(np.isnan(means), -np.inf if not self.descending else np.inf,
+                         means)
+        order = np.argsort(-means if self.descending else means, kind="stable")
+        return data.select(order)
+
+
+@dataclasses.dataclass
+class MiscellaneousFunctionMapper(RangeVectorTransformer):
+    """label_replace / label_join (ref: rangefn/MiscellaneousFunction.scala)."""
+    function: str
+    string_args: Tuple[str, ...] = ()
+
+    def args_str(self):
+        return f"function={self.function}"
+
+    def apply(self, data: Data, ctx: QueryContext, stats: QueryStats,
+              source=None) -> Data:
+        if not isinstance(data, ResultBlock):
+            return data
+        import re
+        if self.function == "label_replace":
+            dst, repl, src, regex = self.string_args
+            pat = re.compile("^(?:" + regex + ")$")
+            keys = []
+            for k in data.keys:
+                lbls = k.labels_dict
+                m = pat.match(lbls.get(src, ""))
+                if m:
+                    val = m.expand(_dollar_to_backslash(repl))
+                    if val:
+                        lbls[dst] = val
+                    else:
+                        lbls.pop(dst, None)
+                keys.append(RangeVectorKey.make(lbls))
+            return ResultBlock(keys, data.wends, data.values, data.bucket_les)
+        if self.function == "label_join":
+            dst, sep, *srcs = self.string_args
+            keys = []
+            for k in data.keys:
+                lbls = k.labels_dict
+                val = sep.join(lbls.get(s, "") for s in srcs)
+                if val:
+                    lbls[dst] = val
+                else:
+                    lbls.pop(dst, None)
+                keys.append(RangeVectorKey.make(lbls))
+            return ResultBlock(keys, data.wends, data.values, data.bucket_les)
+        raise ValueError(f"unknown misc function {self.function}")
+
+
+def _dollar_to_backslash(repl: str) -> str:
+    """PromQL uses $1; python re.expand uses \\1."""
+    import re as _re
+    return _re.sub(r"\$(\d+)", r"\\\1", repl)
+
+
+@dataclasses.dataclass
+class LimitFunctionMapper(RangeVectorTransformer):
+    limit: int
+
+    def args_str(self):
+        return f"limit={self.limit}"
+
+    def apply(self, data: Data, ctx: QueryContext, stats: QueryStats,
+              source=None) -> Data:
+        if isinstance(data, ResultBlock) and data.num_series > self.limit:
+            return data.select(np.arange(self.limit))
+        return data
+
+
+@dataclasses.dataclass
+class ScalarFunctionMapper(RangeVectorTransformer):
+    """scalar(vector): 1 series -> scalar stream, else NaN (ref:
+    RangeVectorTransformer ScalarFunctionMapper)."""
+    function: str = "scalar"
+
+    def args_str(self):
+        return f"function={self.function}"
+
+    def apply(self, data: Data, ctx: QueryContext, stats: QueryStats,
+              source=None) -> Data:
+        assert isinstance(data, (ResultBlock, type(None)))
+        if data is None or data.num_series != 1:
+            wends = data.wends if data is not None else np.zeros(0, np.int64)
+            return ScalarResult(wends, np.full(len(wends), np.nan))
+        return ScalarResult(data.wends, np.asarray(data.values)[0])
+
+
+@dataclasses.dataclass
+class VectorFunctionMapper(RangeVectorTransformer):
+    """vector(scalar) (ref: RangeVectorTransformer VectorFunctionMapper)."""
+
+    def args_str(self):
+        return "function=vector"
+
+    def apply(self, data: Data, ctx: QueryContext, stats: QueryStats,
+              source=None) -> Data:
+        if isinstance(data, ScalarResult):
+            return ResultBlock([RangeVectorKey(())], data.wends,
+                               data.values[None, :])
+        return data
+
+
+# ---------------------------------------------------------------- exec plans
+
+
+class PlanDispatcher:
+    """ref: exec/PlanDispatcher.scala:20."""
+
+    def dispatch(self, plan: "ExecPlan", source) -> QueryResultLike:
+        raise NotImplementedError
+
+
+QueryResultLike = Tuple[Data, QueryStats]
+
+
+class InProcessPlanDispatcher(PlanDispatcher):
+    """Run the subtree in-process (ref: exec/InProcessPlanDispatcher.scala:89)."""
+
+    def dispatch(self, plan: "ExecPlan", source) -> QueryResultLike:
+        return plan.execute_internal(source)
+
+
+class ExecPlan:
+    """Base execution node.  `execute_internal` returns raw Data + stats;
+    `execute` materializes a QueryResult with limits enforced
+    (ref: ExecPlan.scala:96-186)."""
+
+    def __init__(self, ctx: Optional[QueryContext] = None):
+        self.ctx = ctx or QueryContext()
+        self.transformers: List[RangeVectorTransformer] = []
+        self.dispatcher: PlanDispatcher = InProcessPlanDispatcher()
+
+    def add_transformer(self, t: RangeVectorTransformer) -> "ExecPlan":
+        self.transformers.append(t)
+        return self
+
+    @property
+    def children(self) -> List["ExecPlan"]:
+        return []
+
+    # -- execution
+
+    def _do_execute(self, source) -> QueryResultLike:
+        raise NotImplementedError
+
+    def execute_internal(self, source) -> QueryResultLike:
+        data, stats = self._do_execute(source)
+        for t in self.transformers:
+            data = t.apply(data, self.ctx, stats, source)
+        return data, stats
+
+    def execute(self, source) -> QueryResult:
+        try:
+            data, stats = self.execute_internal(source)
+        except Exception as e:  # noqa: BLE001 — query errors surface in result
+            return QueryResult([], QueryStats(), error=f"{type(e).__name__}: {e}")
+        if isinstance(data, AggPartial):
+            data = present_partial(data)
+        if isinstance(data, ScalarResult):
+            data = ResultBlock([RangeVectorKey(())], data.wends,
+                               data.values[None, :])
+        data = remove_nan_series(data)
+        blocks = [data] if data is not None else []
+        limit = self.ctx.planner_params.sample_limit
+        result_samples = sum(int(np.asarray(b.values).size) for b in blocks)
+        if limit and result_samples > limit:
+            return QueryResult([], stats,
+                               error=f"sample limit {limit} exceeded "
+                                     f"({result_samples} samples)")
+        stats.result_samples = result_samples
+        return QueryResult(blocks, stats)
+
+    # -- plan printing (ref: ExecPlan.printTree, doc/query-engine.md:174-204)
+
+    def args_str(self) -> str:
+        return ""
+
+    def print_tree(self, level: int = 0) -> str:
+        transf = [f"{'-' * (level + i + 1)}T~{type(t).__name__}({t.args_str()})"
+                  for i, t in enumerate(reversed(self.transformers))]
+        me = (f"{'-' * (level + len(self.transformers) + 1)}"
+              f"E~{type(self).__name__}({self.args_str()})")
+        kids = [c.print_tree(level + len(self.transformers) + 1)
+                for c in self.children]
+        return "\n".join(transf + [me] + kids)
+
+    def __str__(self):
+        return self.print_tree()
+
+
+class LeafExecPlan(ExecPlan):
+    pass
+
+
+class MultiSchemaPartitionsExec(LeafExecPlan):
+    """Leaf: index lookup + dense gather on the owning shard
+    (ref: exec/MultiSchemaPartitionsExec.scala:27-60,
+    SelectRawPartitionsExec.doExecute:125)."""
+
+    def __init__(self, ctx: QueryContext, dataset: str, shard: int,
+                 filters: Sequence[ColumnFilter], chunk_start_ms: int,
+                 chunk_end_ms: int, columns: Sequence[str] = (),
+                 schema: Optional[str] = None):
+        super().__init__(ctx)
+        self.dataset = dataset
+        self.shard = shard
+        self.filters = list(filters)
+        self.chunk_start_ms = chunk_start_ms
+        self.chunk_end_ms = chunk_end_ms
+        self.columns = list(columns)
+        self.schema = schema
+
+    def args_str(self):
+        fs = ",".join(str(f) for f in self.filters)
+        return (f"dataset={self.dataset}, shard={self.shard}, "
+                f"chunkMethod=TimeRangeChunkScan({self.chunk_start_ms},"
+                f"{self.chunk_end_ms}), filters=[{fs}], colName={self.columns}")
+
+    def _do_execute(self, source) -> QueryResultLike:
+        stats = QueryStats(shards_queried=1)
+        shard = source.get_shard(self.dataset, self.shard)
+        if shard is None:
+            return None, stats
+        lookup = shard.lookup_partitions(self.filters, self.chunk_start_ms,
+                                         self.chunk_end_ms)
+        schema_name = self.schema or lookup.first_schema
+        if schema_name is None:
+            return None, stats
+        parts = lookup.parts_by_schema.get(schema_name, [])
+        if not parts:
+            return None, stats
+        gathered = shard.gather_series(parts)
+        ts, cols, counts, store = gathered
+        schema = shard.schemas[schema_name]
+        col_name = (self.columns[0] if self.columns
+                    else schema.value_column)
+        # value column selection: histograms gather [S, T, B]
+        vals = cols[col_name]
+        base = self.chunk_start_ms
+        ts_off = to_offsets(ts, counts, base)
+        keys = [RangeVectorKey.make(
+            {**p.part_key.tags_dict, "_metric_": p.part_key.metric})
+            for p in parts]
+        stats.series_scanned = len(parts)
+        stats.samples_scanned = int(counts.sum())
+        les = store.bucket_les if vals.ndim == 3 else None
+        return RawBlock(keys, ts_off, vals, base, les,
+                        samples=stats.samples_scanned), stats
+
+
+class EmptyResultExec(LeafExecPlan):
+    """ref: exec/EmptyResultExec."""
+
+    def _do_execute(self, source) -> QueryResultLike:
+        return None, QueryStats()
+
+
+class NonLeafExecPlan(ExecPlan):
+    """Scatter-gather over children via their dispatchers
+    (ref: ExecPlan.scala NonLeafExecPlan)."""
+
+    def __init__(self, ctx: QueryContext, children: Sequence[ExecPlan]):
+        super().__init__(ctx)
+        self._children = list(children)
+
+    @property
+    def children(self) -> List[ExecPlan]:
+        return self._children
+
+    def _gather(self, source) -> Tuple[List[Data], QueryStats]:
+        stats = QueryStats()
+        results = []
+        for c in self._children:
+            data, st = c.dispatcher.dispatch(c, source)
+            stats.merge(st)
+            results.append(data)
+        return results, stats
+
+    def compose(self, results: List[Data], stats: QueryStats) -> Data:
+        raise NotImplementedError
+
+    def _do_execute(self, source) -> QueryResultLike:
+        results, stats = self._gather(source)
+        return self.compose(results, stats), stats
+
+
+class DistConcatExec(NonLeafExecPlan):
+    """Concatenate child results (ref: exec/DistConcatExec.scala)."""
+
+    def compose(self, results, stats):
+        blocks = [r for r in results if isinstance(r, ResultBlock)]
+        raws = [r for r in results if isinstance(r, RawBlock)]
+        if raws:
+            # raw blocks concat only if same grid/base — planner guarantees
+            les0 = raws[0].bucket_les
+            for r in raws[1:]:
+                if (r.bucket_les is None) != (les0 is None) or (
+                        les0 is not None and r.bucket_les is not None
+                        and not np.array_equal(les0, r.bucket_les)):
+                    raise ValueError("cannot concat histogram blocks with "
+                                     "different bucket schemes across shards")
+            keys = []
+            for r in raws:
+                keys.extend(r.keys)
+            T = max(r.ts_off.shape[1] for r in raws)
+            def pad(a, fill):
+                out = np.full((a.shape[0], T) + a.shape[2:], fill, a.dtype)
+                out[:, :a.shape[1]] = a
+                return out
+            from filodb_tpu.ops.timewindow import PAD_TS
+            ts = np.concatenate([pad(r.ts_off, PAD_TS) for r in raws])
+            vals = np.concatenate([pad(r.values, np.nan) for r in raws])
+            return RawBlock(keys, ts, vals, raws[0].base_ms,
+                            raws[0].bucket_les,
+                            samples=sum(r.samples for r in raws))
+        return concat_blocks(blocks)
+
+
+class LocalPartitionDistConcatExec(DistConcatExec):
+    """ref: exec/DistConcatExec.scala LocalPartitionDistConcatExec."""
+
+
+class ReduceAggregateExec(NonLeafExecPlan):
+    """Reduce phase across shards (ref: AggrOverRangeVectors.scala:51)."""
+
+    def __init__(self, ctx, children, op: str, params: Tuple = ()):
+        super().__init__(ctx, children)
+        self.op = op
+        self.params = params
+
+    def args_str(self):
+        return f"aggrOp={self.op}, aggrParams={list(self.params)}"
+
+    def compose(self, results, stats):
+        parts = [r for r in results if isinstance(r, AggPartial)]
+        return reduce_partials(parts)
+
+
+class BinaryJoinExec(NonLeafExecPlan):
+    """Vector-vector join (ref: exec/BinaryJoinExec.scala:210).
+
+    lhs children come first, then rhs children; the split index separates
+    them (mirrors the reference's lhs/rhs Seq[ExecPlan]).
+    """
+
+    def __init__(self, ctx, lhs: Sequence[ExecPlan], rhs: Sequence[ExecPlan],
+                 operator: str, cardinality: str = "OneToOne",
+                 on: Optional[Tuple[str, ...]] = None,
+                 ignoring: Tuple[str, ...] = (),
+                 include: Tuple[str, ...] = (),
+                 bool_modifier: bool = False):
+        super().__init__(ctx, list(lhs) + list(rhs))
+        self.n_lhs = len(lhs)
+        self.operator = operator
+        self.cardinality = cardinality
+        self.on = tuple(on) if on is not None else None
+        self.ignoring = tuple(ignoring)
+        self.include = tuple(include)
+        self.bool_modifier = bool_modifier
+
+    def args_str(self):
+        return (f"binaryOp={self.operator}, on={self.on}, "
+                f"ignoring={list(self.ignoring)}")
+
+    def _match_key(self, k: RangeVectorKey) -> RangeVectorKey:
+        if self.on is not None:
+            return k.only(self.on)
+        drop = self.ignoring + ("_metric_", "__name__")
+        return k.without(drop)
+
+    def compose(self, results, stats):
+        lhs_blocks = [r for r in results[:self.n_lhs] if isinstance(r, ResultBlock)]
+        rhs_blocks = [r for r in results[self.n_lhs:] if isinstance(r, ResultBlock)]
+        lhs = concat_blocks(lhs_blocks)
+        rhs = concat_blocks(rhs_blocks)
+        if lhs is None or rhs is None:
+            return None
+        many_side, one_side = lhs, rhs
+        flip = False
+        if self.cardinality == "OneToMany":
+            many_side, one_side = rhs, lhs
+            flip = True
+        elif self.cardinality == "ManyToOne":
+            pass
+        elif self.cardinality == "OneToOne":
+            pass
+        # index the "one" side by match key; duplicates are an error
+        one_index: Dict[RangeVectorKey, int] = {}
+        for i, k in enumerate(one_side.keys):
+            mk = self._match_key(k)
+            if mk in one_index:
+                raise ValueError(
+                    "many-to-many matching not allowed: duplicate series on "
+                    f"'one' side for key {mk}")
+            one_index[mk] = i
+        card_limit = self.ctx.planner_params.join_cardinality_limit
+        pairs: List[Tuple[int, int]] = []
+        for i, k in enumerate(many_side.keys):
+            j = one_index.get(self._match_key(k))
+            if j is not None:
+                pairs.append((i, j))
+                if len(pairs) > card_limit:
+                    raise ValueError(f"join cardinality limit {card_limit} exceeded")
+        if self.cardinality == "OneToOne":
+            seen: Dict[int, int] = {}
+            for i, j in pairs:
+                if j in seen:
+                    raise ValueError("one-to-one join has many-to-one matches; "
+                                     "use group_left/group_right")
+                seen[j] = i
+        if not pairs:
+            return None
+        mi = np.asarray([p[0] for p in pairs])
+        oi = np.asarray([p[1] for p in pairs])
+        mv = np.asarray(many_side.values)[mi]
+        ov = np.asarray(one_side.values)[oi]
+        a, b = (ov, mv) if flip else (mv, ov)   # a = query LHS values
+        out = np.asarray(apply_binary_op(
+            jnp.asarray(a), jnp.asarray(b), op=self.operator,
+            bool_modifier=self.bool_modifier, keep_side="lhs"))
+        keys = []
+        for i, j in pairs:
+            mk = many_side.keys[i]
+            lbls = self._result_labels(mk, one_side.keys[j])
+            keys.append(lbls)
+        return ResultBlock(keys, many_side.wends, out)
+
+    def _result_labels(self, many_key: RangeVectorKey,
+                       one_key: RangeVectorKey) -> RangeVectorKey:
+        if self.cardinality in ("ManyToOne", "OneToMany"):
+            lbls = many_key.without(("_metric_", "__name__")).labels_dict
+            if self.include:
+                od = one_key.labels_dict
+                for lbl in self.include:
+                    if lbl in od:
+                        lbls[lbl] = od[lbl]
+                    else:
+                        lbls.pop(lbl, None)
+            return RangeVectorKey.make(lbls)
+        if self.on is not None:
+            return many_key.only(self.on)
+        return many_key.without(self.ignoring + ("_metric_", "__name__"))
+
+
+class SetOperatorExec(NonLeafExecPlan):
+    """and/or/unless (ref: exec/SetOperatorExec.scala)."""
+
+    def __init__(self, ctx, lhs: Sequence[ExecPlan], rhs: Sequence[ExecPlan],
+                 operator: str, on: Optional[Tuple[str, ...]] = None,
+                 ignoring: Tuple[str, ...] = ()):
+        super().__init__(ctx, list(lhs) + list(rhs))
+        self.n_lhs = len(lhs)
+        self.operator = operator.lower()
+        self.on = tuple(on) if on is not None else None
+        self.ignoring = tuple(ignoring)
+
+    def args_str(self):
+        return f"binaryOp={self.operator}, on={self.on}, ignoring={list(self.ignoring)}"
+
+    def _match_key(self, k: RangeVectorKey) -> RangeVectorKey:
+        if self.on is not None:
+            return k.only(self.on)
+        return k.without(self.ignoring + ("_metric_", "__name__"))
+
+    def compose(self, results, stats):
+        lhs = concat_blocks([r for r in results[:self.n_lhs]
+                             if isinstance(r, ResultBlock)])
+        rhs = concat_blocks([r for r in results[self.n_lhs:]
+                             if isinstance(r, ResultBlock)])
+        op = self.operator
+        if op == "and":
+            if lhs is None or rhs is None:
+                return None
+            rhs_keys = {self._match_key(k) for k in rhs.keys}
+            # per-step AND: lhs kept where rhs series present at that step
+            rk_rows: Dict[RangeVectorKey, np.ndarray] = {}
+            rvals = np.asarray(rhs.values)
+            for i, k in enumerate(rhs.keys):
+                mk = self._match_key(k)
+                pres = ~np.isnan(rvals[i])
+                rk_rows[mk] = rk_rows.get(mk, False) | pres
+            rows, outs = [], []
+            lvals = np.asarray(lhs.values)
+            for i, k in enumerate(lhs.keys):
+                mk = self._match_key(k)
+                if mk in rhs_keys:
+                    rows.append(i)
+                    outs.append(np.where(rk_rows[mk], lvals[i], np.nan))
+            if not rows:
+                return None
+            return ResultBlock([lhs.keys[i] for i in rows], lhs.wends,
+                               np.stack(outs))
+        if op == "or":
+            if lhs is None:
+                return rhs
+            if rhs is None:
+                return lhs
+            lvals = np.asarray(lhs.values)
+            lhs_present: Dict[RangeVectorKey, np.ndarray] = {}
+            for i, k in enumerate(lhs.keys):
+                mk = self._match_key(k)
+                pres = ~np.isnan(lvals[i])
+                lhs_present[mk] = lhs_present.get(mk, False) | pres
+            keys = list(lhs.keys)
+            vals = [lvals]
+            rvals = np.asarray(rhs.values)
+            extra_rows, extra_keys = [], []
+            for i, k in enumerate(rhs.keys):
+                mk = self._match_key(k)
+                mask = lhs_present.get(mk)
+                row = rvals[i]
+                if mask is not None:
+                    row = np.where(mask, np.nan, row)
+                extra_rows.append(row)
+                extra_keys.append(k)
+            if extra_rows:
+                keys = keys + extra_keys
+                vals.append(np.stack(extra_rows))
+            return ResultBlock(keys, lhs.wends, np.concatenate(vals))
+        if op == "unless":
+            if lhs is None:
+                return None
+            if rhs is None:
+                return lhs
+            rvals = np.asarray(rhs.values)
+            rk_rows: Dict[RangeVectorKey, np.ndarray] = {}
+            for i, k in enumerate(rhs.keys):
+                mk = self._match_key(k)
+                pres = ~np.isnan(rvals[i])
+                rk_rows[mk] = rk_rows.get(mk, False) | pres
+            lvals = np.asarray(lhs.values)
+            outs = []
+            for i, k in enumerate(lhs.keys):
+                mk = self._match_key(k)
+                mask = rk_rows.get(mk)
+                outs.append(np.where(mask, np.nan, lvals[i])
+                            if mask is not None else lvals[i])
+            return remove_nan_series(
+                ResultBlock(list(lhs.keys), lhs.wends, np.stack(outs)))
+        raise ValueError(op)
+
+
+class SubqueryExec(NonLeafExecPlan):
+    """Evaluate an outer range function over an inner periodic series
+    (foo[5m:1m] with rate/max_over_time/... outside).  The inner child's
+    step-grid samples are treated as raw samples for the outer window kernel
+    (ref: exec/... subquery handling via PeriodicSamplesMapper on inner)."""
+
+    def __init__(self, ctx, children, start_ms, step_ms, end_ms, function,
+                 function_args, subquery_window_ms, subquery_step_ms,
+                 offset_ms=0):
+        super().__init__(ctx, children)
+        self.start_ms, self.step_ms, self.end_ms = start_ms, step_ms, end_ms
+        self.function = function
+        self.function_args = tuple(function_args)
+        self.subquery_window_ms = subquery_window_ms
+        self.subquery_step_ms = subquery_step_ms
+        self.offset_ms = offset_ms
+
+    def args_str(self):
+        return (f"function={self.function}, window={self.subquery_window_ms}, "
+                f"step={self.subquery_step_ms}")
+
+    def compose(self, results, stats):
+        block = concat_blocks([r for r in results if isinstance(r, ResultBlock)])
+        wends = make_window_ends(self.start_ms, self.end_ms, self.step_ms)
+        if block is None:
+            return _block_empty(wends)
+        inner_ts = np.asarray(block.wends)
+        base = int(inner_ts[0]) if len(inner_ts) else 0
+        vals = np.asarray(block.values)
+        S = vals.shape[0]
+        ts_off = np.broadcast_to((inner_ts - base).astype(np.int32),
+                                 (S, len(inner_ts))).copy()
+        # NaN steps are absent samples; offsets stay valid (kernel masks NaN)
+        eval_wends = (wends - self.offset_ms - base).astype(np.int32)
+        out = np.asarray(evaluate_range_function(
+            jnp.asarray(ts_off), jnp.asarray(vals), jnp.asarray(eval_wends),
+            self.subquery_window_ms, self.function, self.function_args,
+            base_ms=base))
+        return ResultBlock(block.keys, wends, out)
+
+
+class StitchRvsExec(NonLeafExecPlan):
+    """Merge same-key series evaluated over adjacent time ranges
+    (ref: exec/StitchRvsExec.scala)."""
+
+    def compose(self, results, stats):
+        blocks = [r for r in results if isinstance(r, ResultBlock)]
+        if not blocks:
+            return None
+        wends = np.unique(np.concatenate([b.wends for b in blocks]))
+        merged: Dict[RangeVectorKey, np.ndarray] = {}
+        for b in blocks:
+            pos = np.searchsorted(wends, b.wends)
+            vals = np.asarray(b.values)
+            for i, k in enumerate(b.keys):
+                row = merged.get(k)
+                if row is None:
+                    row = np.full(len(wends), np.nan)
+                    merged[k] = row
+                fill = vals[i]
+                take = ~np.isnan(fill)
+                row[pos[take]] = fill[take]
+        keys = list(merged)
+        return ResultBlock(keys, wends, np.stack([merged[k] for k in keys]))
+
+
+# ------------------------------------------------------------- scalar execs
+
+
+class TimeScalarGeneratorExec(LeafExecPlan):
+    """time(), hour(), ... (ref: exec/TimeScalarGeneratorExec:84)."""
+
+    def __init__(self, ctx, start_ms, step_ms, end_ms, function="time"):
+        super().__init__(ctx)
+        self.start_ms, self.step_ms, self.end_ms = start_ms, step_ms, end_ms
+        self.function = function
+
+    def args_str(self):
+        return f"function={self.function}"
+
+    def _do_execute(self, source) -> QueryResultLike:
+        wends = make_window_ends(self.start_ms, self.end_ms, self.step_ms)
+        secs = wends / 1000.0
+        if self.function == "time":
+            vals = secs
+        else:
+            # hour()/minute()/day_of_week()... on step timestamps: the date
+            # INSTANT_FUNCTIONS already interpret values as epoch seconds
+            vals = np.asarray(INSTANT_FUNCTIONS[self.function](jnp.asarray(secs)))
+        return ScalarResult(wends, np.asarray(vals, dtype=float)), QueryStats()
+
+
+class ScalarFixedDoubleExec(LeafExecPlan):
+    """Literal scalar (ref: exec/ScalarFixedDoubleExec:76)."""
+
+    def __init__(self, ctx, start_ms, step_ms, end_ms, value: float):
+        super().__init__(ctx)
+        self.start_ms, self.step_ms, self.end_ms = start_ms, step_ms, end_ms
+        self.value = value
+
+    def args_str(self):
+        return f"value={self.value}"
+
+    def _do_execute(self, source) -> QueryResultLike:
+        wends = make_window_ends(self.start_ms, self.end_ms, self.step_ms)
+        return ScalarResult(wends, np.full(len(wends), self.value)), QueryStats()
+
+
+class ScalarBinaryOperationExec(LeafExecPlan):
+    """scalar op scalar (ref: exec/ScalarBinaryOperationExec:72)."""
+
+    def __init__(self, ctx, start_ms, step_ms, end_ms, operator, lhs, rhs):
+        super().__init__(ctx)
+        self.start_ms, self.step_ms, self.end_ms = start_ms, step_ms, end_ms
+        self.operator = operator
+        self.lhs = lhs          # float or ScalarBinaryOperationExec
+        self.rhs = rhs
+
+    def args_str(self):
+        return f"operator={self.operator}"
+
+    def _eval(self, x, source):
+        if isinstance(x, ScalarBinaryOperationExec):
+            return x._do_execute(source)[0].values
+        return float(x)
+
+    def _do_execute(self, source) -> QueryResultLike:
+        wends = make_window_ends(self.start_ms, self.end_ms, self.step_ms)
+        a = np.broadcast_to(self._eval(self.lhs, source), wends.shape).astype(float)
+        b = np.broadcast_to(self._eval(self.rhs, source), wends.shape).astype(float)
+        # scalar-scalar comparisons always behave as `bool` (PromQL requires it)
+        out = np.asarray(apply_binary_op(
+            jnp.asarray(a), jnp.asarray(b), op=self.operator,
+            bool_modifier=True))
+        return ScalarResult(wends, out), QueryStats()
+
+
+# ----------------------------------------------------------- metadata execs
+
+
+class PartKeysExec(LeafExecPlan):
+    """Series-key metadata query (ref: exec/MetadataExecPlan.scala)."""
+
+    def __init__(self, ctx, dataset, shard, filters, start_ms, end_ms):
+        super().__init__(ctx)
+        self.dataset, self.shard = dataset, shard
+        self.filters = list(filters)
+        self.start_ms, self.end_ms = start_ms, end_ms
+
+    def args_str(self):
+        return f"shard={self.shard}, filters={[str(f) for f in self.filters]}"
+
+    def _do_execute(self, source) -> QueryResultLike:
+        shard = source.get_shard(self.dataset, self.shard)
+        stats = QueryStats(shards_queried=1)
+        if shard is None:
+            return None, stats
+        res = shard.lookup_partitions(self.filters, self.start_ms, self.end_ms)
+        keys = []
+        for parts in res.parts_by_schema.values():
+            for p in parts:
+                keys.append({**p.part_key.tags_dict,
+                             "_metric_": p.part_key.metric})
+        data = QueryResult([], stats, data=keys)
+        return data, stats
+
+
+class LabelValuesExec(LeafExecPlan):
+    """ref: exec/MetadataExecPlan.scala LabelValuesExec."""
+
+    def __init__(self, ctx, dataset, shard, filters, labels, start_ms, end_ms):
+        super().__init__(ctx)
+        self.dataset, self.shard = dataset, shard
+        self.filters = list(filters)
+        self.labels = list(labels)
+        self.start_ms, self.end_ms = start_ms, end_ms
+
+    def args_str(self):
+        return f"shard={self.shard}, labels={self.labels}"
+
+    def _do_execute(self, source) -> QueryResultLike:
+        shard = source.get_shard(self.dataset, self.shard)
+        stats = QueryStats(shards_queried=1)
+        if shard is None:
+            return None, stats
+        out: Dict[str, List[str]] = {}
+        for lbl in self.labels:
+            out[lbl] = shard.index.label_values(lbl, self.filters or None)
+        return QueryResult([], stats, data=out), stats
+
+
+class MetadataMergeExec(NonLeafExecPlan):
+    """Merge metadata results across shards."""
+
+    def compose(self, results, stats):
+        merged = None
+        for r in results:
+            if not isinstance(r, QueryResult) or r.data is None:
+                continue
+            if merged is None:
+                merged = r.data
+            elif isinstance(merged, list):
+                merged = merged + r.data
+            elif isinstance(merged, dict):
+                for k, v in r.data.items():
+                    vals = set(merged.get(k, [])) | set(v)
+                    merged[k] = sorted(vals)
+        return QueryResult([], stats, data=merged)

@@ -1,0 +1,350 @@
+"""LogicalPlan — the PromQL-level algebra.
+
+Mirrors the reference's LogicalPlan ADT (ref: query/src/main/scala/filodb/
+query/LogicalPlan.scala:6-577): RawSeries at the bottom, periodic
+transformations, aggregates, joins, scalar plans and metadata plans.  Plans
+are immutable dataclasses; planners pattern-match on type.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Sequence, Tuple
+
+from filodb_tpu.core.index import ColumnFilter
+
+
+class LogicalPlan:
+    """Base marker.  is_raw_series / is_periodic mirror the reference's
+    RawSeriesLikePlan / PeriodicSeriesPlan split (LogicalPlan.scala:6-64)."""
+
+
+class RawSeriesLikePlan(LogicalPlan):
+    pass
+
+
+class PeriodicSeriesPlan(LogicalPlan):
+    """Evaluates to regular-step samples: startMs/stepMs/endMs required."""
+    start_ms: int
+    step_ms: int
+    end_ms: int
+
+
+class MetadataQueryPlan(LogicalPlan):
+    pass
+
+
+@dataclasses.dataclass(frozen=True)
+class IntervalSelector:
+    """Chunk-scan time range (ref: LogicalPlan.scala:73 RangeSelector)."""
+    from_ms: int
+    to_ms: int
+
+
+@dataclasses.dataclass(frozen=True)
+class RawSeries(RawSeriesLikePlan):
+    """Select raw chunk data for matching series
+    (ref: LogicalPlan.scala:91 RawSeries)."""
+    range_selector: IntervalSelector
+    filters: Tuple[ColumnFilter, ...]
+    columns: Tuple[str, ...] = ()
+    lookback_ms: Optional[int] = None
+    offset_ms: Optional[int] = None
+
+
+@dataclasses.dataclass(frozen=True)
+class RawChunkMeta(RawSeriesLikePlan):
+    """Chunk metadata debug plan (ref: LogicalPlan.scala:119)."""
+    range_selector: IntervalSelector
+    filters: Tuple[ColumnFilter, ...]
+    column: str = ""
+
+
+@dataclasses.dataclass(frozen=True)
+class PeriodicSeries(PeriodicSeriesPlan):
+    """Raw -> regular step, last-sample-in-lookback semantics
+    (ref: LogicalPlan.scala:147)."""
+    raw_series: RawSeries
+    start_ms: int
+    step_ms: int
+    end_ms: int
+    offset_ms: Optional[int] = None
+
+
+@dataclasses.dataclass(frozen=True)
+class PeriodicSeriesWithWindowing(PeriodicSeriesPlan):
+    """Range-function application over sliding windows
+    (ref: LogicalPlan.scala:245)."""
+    series: RawSeries
+    start_ms: int
+    step_ms: int
+    end_ms: int
+    window_ms: int
+    function: str                                   # range function name
+    function_args: Tuple[float, ...] = ()
+    offset_ms: Optional[int] = None
+
+
+@dataclasses.dataclass(frozen=True)
+class SubqueryWithWindowing(PeriodicSeriesPlan):
+    """foo[5m:1m] with an outer range function
+    (ref: LogicalPlan.scala:196)."""
+    inner: PeriodicSeriesPlan
+    start_ms: int
+    step_ms: int
+    end_ms: int
+    function: str
+    function_args: Tuple[float, ...]
+    subquery_window_ms: int
+    subquery_step_ms: int
+    offset_ms: Optional[int] = None
+
+
+@dataclasses.dataclass(frozen=True)
+class TopLevelSubquery(PeriodicSeriesPlan):
+    """Top-level foo[5m:1m] (ref: LogicalPlan.scala:223)."""
+    inner: PeriodicSeriesPlan
+    start_ms: int
+    step_ms: int
+    end_ms: int
+    original_lookback_ms: Optional[int] = None
+    offset_ms: Optional[int] = None
+
+
+@dataclasses.dataclass(frozen=True)
+class Aggregate(PeriodicSeriesPlan):
+    """Cross-series aggregation with by/without clauses
+    (ref: LogicalPlan.scala:269)."""
+    operator: str                                   # sum/min/max/avg/...
+    vectors: PeriodicSeriesPlan
+    params: Tuple = ()                              # k for topk, q for quantile
+    by: Tuple[str, ...] = ()
+    without: Tuple[str, ...] = ()
+
+    @property
+    def start_ms(self): return self.vectors.start_ms
+    @property
+    def step_ms(self): return self.vectors.step_ms
+    @property
+    def end_ms(self): return self.vectors.end_ms
+
+
+@dataclasses.dataclass(frozen=True)
+class BinaryJoin(PeriodicSeriesPlan):
+    """Vector-vector binary operation with matching rules
+    (ref: LogicalPlan.scala:292)."""
+    lhs: PeriodicSeriesPlan
+    operator: str
+    rhs: PeriodicSeriesPlan
+    cardinality: str = "OneToOne"                   # OneToOne/OneToMany/ManyToOne/ManyToMany
+    on: Optional[Tuple[str, ...]] = None
+    ignoring: Tuple[str, ...] = ()
+    include: Tuple[str, ...] = ()                   # group_left/right labels
+
+    @property
+    def start_ms(self): return self.lhs.start_ms
+    @property
+    def step_ms(self): return self.lhs.step_ms
+    @property
+    def end_ms(self): return self.lhs.end_ms
+
+
+@dataclasses.dataclass(frozen=True)
+class ScalarVectorBinaryOperation(PeriodicSeriesPlan):
+    """vector op scalar (ref: LogicalPlan.scala:314)."""
+    operator: str
+    scalar_arg: "PeriodicSeriesPlan"                # ScalarPlan
+    vector: PeriodicSeriesPlan
+    scalar_is_lhs: bool = False
+
+    @property
+    def start_ms(self): return self.vector.start_ms
+    @property
+    def step_ms(self): return self.vector.step_ms
+    @property
+    def end_ms(self): return self.vector.end_ms
+
+
+@dataclasses.dataclass(frozen=True)
+class ApplyInstantFunction(PeriodicSeriesPlan):
+    """abs()/ceil()/histogram_quantile()/... (ref: LogicalPlan.scala:331)."""
+    vectors: PeriodicSeriesPlan
+    function: str
+    function_args: Tuple = ()
+
+    @property
+    def start_ms(self): return self.vectors.start_ms
+    @property
+    def step_ms(self): return self.vectors.step_ms
+    @property
+    def end_ms(self): return self.vectors.end_ms
+
+
+@dataclasses.dataclass(frozen=True)
+class ApplyMiscellaneousFunction(PeriodicSeriesPlan):
+    """label_replace/label_join/sort_desc etc (ref: LogicalPlan.scala:410 area)."""
+    vectors: PeriodicSeriesPlan
+    function: str
+    string_args: Tuple[str, ...] = ()
+
+    @property
+    def start_ms(self): return self.vectors.start_ms
+    @property
+    def step_ms(self): return self.vectors.step_ms
+    @property
+    def end_ms(self): return self.vectors.end_ms
+
+
+@dataclasses.dataclass(frozen=True)
+class ApplySortFunction(PeriodicSeriesPlan):
+    vectors: PeriodicSeriesPlan
+    function: str                                   # sort | sort_desc
+
+    @property
+    def start_ms(self): return self.vectors.start_ms
+    @property
+    def step_ms(self): return self.vectors.step_ms
+    @property
+    def end_ms(self): return self.vectors.end_ms
+
+
+@dataclasses.dataclass(frozen=True)
+class ApplyAbsentFunction(PeriodicSeriesPlan):
+    """absent() (ref: LogicalPlan.scala:478)."""
+    vectors: PeriodicSeriesPlan
+    filters: Tuple[ColumnFilter, ...]
+    start_ms: int
+    step_ms: int
+    end_ms: int
+
+
+@dataclasses.dataclass(frozen=True)
+class ApplyLimitFunction(PeriodicSeriesPlan):
+    vectors: PeriodicSeriesPlan
+    limit: int
+
+    @property
+    def start_ms(self): return self.vectors.start_ms
+    @property
+    def step_ms(self): return self.vectors.step_ms
+    @property
+    def end_ms(self): return self.vectors.end_ms
+
+
+# ------------------------------------------------------------- scalar plans
+
+class ScalarPlan(PeriodicSeriesPlan):
+    """Evaluates to one value per step (ref: LogicalPlan.scala:395-475)."""
+
+
+@dataclasses.dataclass(frozen=True)
+class ScalarTimeBasedPlan(ScalarPlan):
+    """time(), hour(), ... of the step timestamps (ref: :404)."""
+    function: str
+    start_ms: int
+    step_ms: int
+    end_ms: int
+
+
+@dataclasses.dataclass(frozen=True)
+class ScalarFixedDoublePlan(ScalarPlan):
+    """Literal number (ref: :417)."""
+    scalar: float
+    start_ms: int
+    step_ms: int
+    end_ms: int
+
+
+@dataclasses.dataclass(frozen=True)
+class ScalarVaryingDoublePlan(ScalarPlan):
+    """scalar(vector) (ref: :395)."""
+    vectors: PeriodicSeriesPlan
+    function: str = "scalar"
+
+    @property
+    def start_ms(self): return self.vectors.start_ms
+    @property
+    def step_ms(self): return self.vectors.step_ms
+    @property
+    def end_ms(self): return self.vectors.end_ms
+
+
+@dataclasses.dataclass(frozen=True)
+class ScalarBinaryOperation(ScalarPlan):
+    """scalar op scalar, possibly nested (ref: :457)."""
+    operator: str
+    lhs: "float | ScalarBinaryOperation"
+    rhs: "float | ScalarBinaryOperation"
+    start_ms: int
+    step_ms: int
+    end_ms: int
+
+
+@dataclasses.dataclass(frozen=True)
+class VectorPlan(PeriodicSeriesPlan):
+    """vector(scalar) (ref: :444)."""
+    scalars: ScalarPlan
+
+    @property
+    def start_ms(self): return self.scalars.start_ms
+    @property
+    def step_ms(self): return self.scalars.step_ms
+    @property
+    def end_ms(self): return self.scalars.end_ms
+
+
+# ----------------------------------------------------------- metadata plans
+
+@dataclasses.dataclass(frozen=True)
+class LabelValues(MetadataQueryPlan):
+    """ref: LogicalPlan.scala:105."""
+    label_names: Tuple[str, ...]
+    filters: Tuple[ColumnFilter, ...]
+    start_ms: int
+    end_ms: int
+
+
+@dataclasses.dataclass(frozen=True)
+class LabelNames(MetadataQueryPlan):
+    filters: Tuple[ColumnFilter, ...]
+    start_ms: int
+    end_ms: int
+
+
+@dataclasses.dataclass(frozen=True)
+class SeriesKeysByFilters(MetadataQueryPlan):
+    """ref: LogicalPlan.scala:110."""
+    filters: Tuple[ColumnFilter, ...]
+    start_ms: int
+    end_ms: int
+
+
+@dataclasses.dataclass(frozen=True)
+class LabelCardinality(MetadataQueryPlan):
+    filters: Tuple[ColumnFilter, ...]
+    start_ms: int
+    end_ms: int
+
+
+@dataclasses.dataclass(frozen=True)
+class TsCardinalities(MetadataQueryPlan):
+    """Cardinality overview (ref: LogicalPlan.scala TsCardinalities)."""
+    shard_key_prefix: Tuple[str, ...]
+    num_group_by_fields: int
+
+
+# ------------------------------------------------------------------- helpers
+
+def raw_series_filters(plan: LogicalPlan) -> List[Tuple[ColumnFilter, ...]]:
+    """Collect the filter sets of every RawSeries under `plan`
+    (ref: LogicalPlan.getRawSeriesFilters)."""
+    out: List[Tuple[ColumnFilter, ...]] = []
+    def walk(p):
+        if isinstance(p, RawSeries):
+            out.append(p.filters)
+        elif dataclasses.is_dataclass(p):
+            for f in dataclasses.fields(p):
+                v = getattr(p, f.name)
+                if isinstance(v, LogicalPlan):
+                    walk(v)
+    walk(plan)
+    return out

@@ -1,0 +1,158 @@
+"""Result types: range-vector keys, dense result blocks, query context.
+
+The reference materializes per-series SerializedRangeVectors (ref:
+core/.../query/RangeVector.scala:121, ResultTypes.scala).  The TPU-native
+design keeps results BATCH-DENSE: one ResultBlock = many series sharing the
+same step grid, values in a single [S, W] (or [S, W, B] histogram) matrix.
+Transformers and reducers operate on whole blocks on device; per-series
+objects only exist at the JSON/serialization edge.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class RangeVectorKey:
+    """Series identity in results (ref: RangeVector.scala:27
+    CustomRangeVectorKey)."""
+    labels: Tuple[Tuple[str, str], ...]             # sorted
+
+    @staticmethod
+    def make(labels: Dict[str, str]) -> "RangeVectorKey":
+        return RangeVectorKey(tuple(sorted(labels.items())))
+
+    @property
+    def labels_dict(self) -> Dict[str, str]:
+        return dict(self.labels)
+
+    def without(self, names: Sequence[str]) -> "RangeVectorKey":
+        ns = set(names)
+        return RangeVectorKey(tuple((k, v) for k, v in self.labels
+                                    if k not in ns))
+
+    def only(self, names: Sequence[str]) -> "RangeVectorKey":
+        ns = set(names)
+        return RangeVectorKey(tuple((k, v) for k, v in self.labels
+                                    if k in ns))
+
+    def __str__(self) -> str:
+        return "{" + ",".join(f'{k}="{v}"' for k, v in self.labels) + "}"
+
+
+@dataclasses.dataclass
+class ResultBlock:
+    """A batch of series on a common step grid.
+
+    values: [S, W] float (NaN = absent at that step), or [S, W, B] for
+    histogram-valued vectors (bucket_les gives upper bounds).
+    """
+    keys: List[RangeVectorKey]
+    wends: np.ndarray                               # int64 [W] step timestamps ms
+    values: np.ndarray
+    bucket_les: Optional[np.ndarray] = None
+
+    @property
+    def num_series(self) -> int:
+        return len(self.keys)
+
+    @property
+    def is_histogram(self) -> bool:
+        return self.values.ndim == 3
+
+    def select(self, rows: np.ndarray) -> "ResultBlock":
+        return ResultBlock([self.keys[int(r)] for r in rows], self.wends,
+                           np.asarray(self.values)[rows], self.bucket_les)
+
+
+def concat_blocks(blocks: Sequence[ResultBlock]) -> Optional[ResultBlock]:
+    """Concatenate blocks sharing a step grid (DistConcatExec analogue)."""
+    blocks = [b for b in blocks if b is not None and b.num_series > 0]
+    if not blocks:
+        return None
+    if len(blocks) == 1:
+        return blocks[0]
+    keys: List[RangeVectorKey] = []
+    for b in blocks:
+        keys.extend(b.keys)
+    return ResultBlock(keys, blocks[0].wends,
+                       np.concatenate([np.asarray(b.values) for b in blocks]),
+                       blocks[0].bucket_les)
+
+
+@dataclasses.dataclass
+class QueryStats:
+    """ref: QueryStats / TimeSeriesShardStats query-side counters."""
+    samples_scanned: int = 0
+    series_scanned: int = 0
+    result_samples: int = 0
+    shards_queried: int = 0
+
+    def merge(self, other: "QueryStats") -> None:
+        self.samples_scanned += other.samples_scanned
+        self.series_scanned += other.series_scanned
+        self.result_samples += other.result_samples
+        self.shards_queried += other.shards_queried
+
+
+@dataclasses.dataclass
+class QueryResult:
+    """ref: filodb.query QueryResult / QueryError."""
+    blocks: List[ResultBlock]
+    stats: QueryStats = dataclasses.field(default_factory=QueryStats)
+    error: Optional[str] = None
+    # metadata-query payloads (label values etc.) ride in `data`
+    data: Optional[object] = None
+
+    @property
+    def num_series(self) -> int:
+        return sum(b.num_series for b in self.blocks)
+
+    def series(self):
+        """Iterate (key, wends, values_row) across blocks — serialization edge."""
+        for b in self.blocks:
+            vals = np.asarray(b.values)
+            for i, k in enumerate(b.keys):
+                yield k, b.wends, vals[i]
+
+
+@dataclasses.dataclass
+class PlannerParams:
+    """ref: core/.../query/QueryContext.scala:98 PlannerParams."""
+    spread: int = 1
+    sample_limit: int = 1_000_000
+    group_by_cardinality_limit: int = 100_000
+    join_cardinality_limit: int = 100_000
+    enforced_limits: bool = True
+    shard_overrides: Optional[List[int]] = None
+    process_multi_partition: bool = False
+
+
+@dataclasses.dataclass
+class QueryContext:
+    """Per-query context threaded through planning + execution
+    (ref: QueryContext.scala)."""
+    query_id: str = ""
+    submit_time_ms: int = 0
+    origin: str = ""
+    planner_params: PlannerParams = dataclasses.field(default_factory=PlannerParams)
+    lookback_ms: int = 5 * 60 * 1000                # staleness window
+
+
+def remove_nan_series(block: Optional[ResultBlock]) -> Optional[ResultBlock]:
+    """Drop series that are NaN at every step (the reference filters
+    all-NaN SerializedRangeVectors before responding)."""
+    if block is None:
+        return None
+    vals = np.asarray(block.values)
+    axis = tuple(range(1, vals.ndim))
+    keep = ~np.isnan(vals).all(axis=axis)
+    if keep.all():
+        return block
+    rows = np.flatnonzero(keep)
+    if len(rows) == 0:
+        return None
+    return block.select(rows)

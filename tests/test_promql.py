@@ -1,0 +1,204 @@
+"""PromQL parser conformance (models ref: prometheus/src/test/.../parse/
+ParserSpec.scala)."""
+import pytest
+
+from filodb_tpu.core.index import Equals, EqualsRegex, NotEquals
+from filodb_tpu.promql import parse_query, query_range_to_logical_plan, TimeStepParams
+from filodb_tpu.promql.lexer import ParseError, duration_to_ms, tokenize
+from filodb_tpu.promql import ast as A
+from filodb_tpu.query import logical as lp
+
+T = TimeStepParams(1000, 10, 2000)
+
+
+def plan(q):
+    return query_range_to_logical_plan(q, T)
+
+
+# ------------------------------------------------------------------- lexer
+
+def test_durations():
+    assert duration_to_ms("5m") == 300_000
+    assert duration_to_ms("1h30m") == 5_400_000
+    assert duration_to_ms("90s") == 90_000
+    assert duration_to_ms("1d") == 86_400_000
+
+
+def test_tokenize_basic():
+    kinds = [t.kind for t in tokenize('sum(rate(foo{a="b"}[5m]))')]
+    assert "DURATION" in kinds and "STRING" in kinds
+
+
+# ------------------------------------------------------------------ parser
+
+def test_simple_selector():
+    e = parse_query('http_requests_total{job="api", instance!="i1"}')
+    assert isinstance(e, A.VectorSelector)
+    assert e.metric == "http_requests_total"
+    assert e.matchers[0].op == "=" and e.matchers[1].op == "!="
+
+
+def test_selector_to_plan():
+    p = plan('foo{_ws_="demo",_ns_="app"}')
+    assert isinstance(p, lp.PeriodicSeries)
+    f = p.raw_series.filters
+    assert Equals("_metric_", "foo") in f
+    assert Equals("_ws_", "demo") in f
+    assert p.start_ms == 1_000_000 and p.end_ms == 2_000_000
+
+
+def test_rate_window():
+    p = plan('rate(foo[5m])')
+    assert isinstance(p, lp.PeriodicSeriesWithWindowing)
+    assert p.function == "rate" and p.window_ms == 300_000
+    # chunk scan starts window earlier
+    assert p.series.range_selector.from_ms == 1_000_000 - 300_000
+
+
+def test_aggregate_by():
+    p = plan('sum by (job) (rate(foo[1m]))')
+    assert isinstance(p, lp.Aggregate)
+    assert p.operator == "sum" and p.by == ("job",)
+    p2 = plan('sum(rate(foo[1m])) by (job)')
+    assert p2.by == ("job",)
+    p3 = plan('sum without (instance) (foo)')
+    assert p3.without == ("instance",)
+
+
+def test_topk_quantile_params():
+    p = plan('topk(5, foo)')
+    assert p.operator == "topk" and p.params == (5.0,)
+    p = plan('quantile(0.9, foo)')
+    assert p.params == (0.9,)
+    p = plan('count_values("version", foo)')
+    assert p.params == ("version",)
+
+
+def test_binary_join_precedence():
+    p = plan('a + b * c')
+    assert isinstance(p, lp.BinaryJoin) and p.operator == "+"
+    assert isinstance(p.rhs, lp.BinaryJoin) and p.rhs.operator == "*"
+
+
+def test_power_right_assoc():
+    p = plan('2 ^ 3 ^ 2')
+    assert isinstance(p, lp.ScalarBinaryOperation)
+    assert isinstance(p.rhs, lp.ScalarBinaryOperation)
+
+
+def test_scalar_vector_op():
+    p = plan('foo * 2')
+    assert isinstance(p, lp.ScalarVectorBinaryOperation)
+    assert not p.scalar_is_lhs
+    p = plan('2 < foo')
+    assert p.scalar_is_lhs
+
+
+def test_bool_modifier():
+    p = plan('foo > bool 2')
+    assert isinstance(p, lp.ScalarVectorBinaryOperation)
+    assert p.operator == ">_bool"
+
+
+def test_on_group_left():
+    p = plan('a * on (job) group_left (extra) b')
+    assert isinstance(p, lp.BinaryJoin)
+    assert p.on == ("job",) and p.cardinality == "ManyToOne"
+    assert p.include == ("extra",)
+
+
+def test_set_operators():
+    p = plan('a and b')
+    assert isinstance(p, lp.BinaryJoin) and p.operator == "and"
+    p = plan('a unless on (x) b')
+    assert p.operator == "unless" and p.on == ("x",)
+
+
+def test_instant_functions():
+    p = plan('abs(foo)')
+    assert isinstance(p, lp.ApplyInstantFunction) and p.function == "abs"
+    p = plan('clamp_max(foo, 10)')
+    assert p.function_args == (10.0,)
+    p = plan('histogram_quantile(0.9, sum(rate(lat_bucket[5m])))')
+    assert p.function == "histogram_quantile"
+    assert isinstance(p.vectors, lp.Aggregate)
+
+
+def test_offset():
+    p = plan('rate(foo[5m] offset 10m)')
+    assert p.offset_ms == 600_000
+    p = plan('foo offset 1h')
+    assert p.offset_ms == 3_600_000
+
+
+def test_subquery():
+    p = plan('max_over_time(rate(foo[1m])[10m:30s])')
+    assert isinstance(p, lp.SubqueryWithWindowing)
+    assert p.function == "max_over_time"
+    assert p.subquery_window_ms == 600_000 and p.subquery_step_ms == 30_000
+    assert isinstance(p.inner, lp.PeriodicSeriesWithWindowing)
+
+
+def test_scalar_functions():
+    p = plan('scalar(foo)')
+    assert isinstance(p, lp.ScalarVaryingDoublePlan)
+    p = plan('vector(1)')
+    assert isinstance(p, lp.VectorPlan)
+    p = plan('time()')
+    assert isinstance(p, lp.ScalarTimeBasedPlan)
+
+
+def test_absent_and_sort():
+    p = plan('absent(foo{job="x"})')
+    assert isinstance(p, lp.ApplyAbsentFunction)
+    assert Equals("job", "x") in p.filters
+    p = plan('sort_desc(foo)')
+    assert isinstance(p, lp.ApplySortFunction) and p.function == "sort_desc"
+
+
+def test_label_replace():
+    p = plan('label_replace(foo, "dst", "$1", "src", "(.*)")')
+    assert isinstance(p, lp.ApplyMiscellaneousFunction)
+    assert p.string_args == ("dst", "$1", "src", "(.*)")
+
+
+def test_column_selector_extension():
+    p = plan('foo::sum{_ws_="w"}')
+    assert isinstance(p, lp.PeriodicSeries)
+    assert p.raw_series.columns == ("sum",)
+    assert Equals("_metric_", "foo") in p.raw_series.filters
+
+
+def test_regex_matcher():
+    p = plan('foo{job=~"a.*", x!~"b"}')
+    f = p.raw_series.filters
+    assert EqualsRegex("job", "a.*") in f
+
+
+def test_unary_minus():
+    p = plan('-foo')
+    assert isinstance(p, lp.ScalarVectorBinaryOperation)
+    assert p.scalar_is_lhs and p.operator == "-"
+    p = plan('-(3)')
+    assert isinstance(p, lp.ScalarFixedDoublePlan) and p.scalar == -3.0
+
+
+def test_parse_errors():
+    with pytest.raises(ParseError):
+        parse_query('foo{')
+    with pytest.raises(ParseError):
+        parse_query('rate(foo)')  # missing range -> conversion error
+        query_range_to_logical_plan('rate(foo)', T)
+    with pytest.raises(ParseError):
+        query_range_to_logical_plan('rate(foo)', T)
+    with pytest.raises(ParseError):
+        parse_query('sum(foo')
+
+
+def test_nested_full_query():
+    q = ('histogram_quantile(0.75, sum(rate(http_req_latency_bucket'
+         '{_ws_="demo",_ns_="App-0"}[5m])) by (le))')
+    p = plan(q)
+    assert isinstance(p, lp.ApplyInstantFunction)
+    agg = p.vectors
+    assert agg.by == ("le",)

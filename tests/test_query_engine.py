@@ -1,0 +1,272 @@
+"""End-to-end query engine tests (models ref: query/src/test/.../exec/
+MultiSchemaPartitionsExecSpec, AggrOverRangeVectorsSpec, BinaryJoinExecSpec,
+coordinator SingleClusterPlannerSpec)."""
+import numpy as np
+import pytest
+
+from filodb_tpu.core.memstore import TimeSeriesMemStore
+from filodb_tpu.core.records import RecordBatch
+from filodb_tpu.ingest.generator import (counter_batch, gauge_batch,
+                                         histogram_batch)
+from filodb_tpu.parallel.shardmapper import ShardEvent, ShardMapper
+from filodb_tpu.query.engine import QueryEngine
+from filodb_tpu.query.rangevector import PlannerParams
+
+from oracle import eval_series
+
+START_MS = 1_600_000_000_000
+START_S = START_MS // 1000
+END_S = START_S + 7200
+NUM_SAMPLES = 720
+
+
+def _mk_engine(batches, num_shards=1, spread=0):
+    """Ingest batches routed by the reference shard math."""
+    ms = TimeSeriesMemStore()
+    mapper = ShardMapper(num_shards)
+    for s in range(num_shards):
+        ms.setup("prometheus", s)
+        mapper.update_from_event(
+            ShardEvent("IngestionStarted", "prometheus", s, "local"))
+    for batch in batches:
+        if num_shards == 1:
+            ms.get_shard("prometheus", 0).ingest(batch)
+            continue
+        # route each series to its shard (gateway's ingestionShard math)
+        shard_of_key = np.asarray([
+            mapper.ingestion_shard(pk.shard_key_hash(), pk.partition_hash(),
+                                   spread)
+            for pk in batch.part_keys])
+        for s in range(num_shards):
+            keep = shard_of_key[batch.part_idx] == s
+            if not keep.any():
+                continue
+            sub = RecordBatch(batch.schema, batch.part_keys,
+                              batch.part_idx[keep], batch.timestamps[keep],
+                              {k: v[keep] for k, v in batch.columns.items()},
+                              batch.bucket_les)
+            ms.get_shard("prometheus", s).ingest(sub)
+    from filodb_tpu.parallel.shardmapper import SpreadProvider
+    return QueryEngine("prometheus", ms, mapper,
+                       SpreadProvider(default_spread=spread))
+
+
+@pytest.fixture(scope="module")
+def engine():
+    return _mk_engine([counter_batch(100, NUM_SAMPLES, start_ms=START_MS),
+                       gauge_batch(100, NUM_SAMPLES, start_ms=START_MS)])
+
+
+def test_sum_rate_matches_oracle(engine):
+    res = engine.query_range(
+        'sum(rate(request_total{_ws_="demo",_ns_="App-2"}[5m]))',
+        START_S + 600, 60, END_S)
+    assert res.error is None
+    assert res.num_series == 1
+    # oracle: sum of per-series rates
+    batch = counter_batch(100, NUM_SAMPLES, start_ms=START_MS)
+    wends = np.arange((START_S + 600) * 1000, END_S * 1000 + 1, 60_000)
+    expect = np.zeros(len(wends))
+    vals = batch.columns["count"].reshape(100, NUM_SAMPLES)
+    ts = batch.timestamps.reshape(100, NUM_SAMPLES)
+    for i in range(100):
+        if batch.part_keys[i].label("_ns_") == "App-2":
+            expect += eval_series(ts[i], vals[i], wends, 300_000, "rate")
+    got = res.blocks[0].values[0]
+    np.testing.assert_allclose(got, expect, rtol=1e-9)
+
+
+def test_sum_by_grouping(engine):
+    res = engine.query_range(
+        'sum(rate(request_total{_ws_="demo"}[5m])) by (_ns_)',
+        START_S + 600, 60, END_S)
+    assert res.error is None
+    assert res.num_series == 10          # 10 apps
+    labels = {k.labels_dict.get("_ns_") for k, _, _ in res.series()}
+    assert labels == {f"App-{i}" for i in range(10)}
+
+
+def test_avg_min_max_count(engine):
+    for op, np_fn in [("avg", np.nanmean), ("min", np.nanmin),
+                      ("max", np.nanmax), ("count", None)]:
+        res = engine.query_range(
+            f'{op}(heap_usage{{_ws_="demo",_ns_="App-1"}})',
+            START_S + 600, 60, END_S)
+        assert res.error is None, f"{op}: {res.error}"
+        assert res.num_series == 1
+
+
+def test_topk(engine):
+    res = engine.query_range(
+        'topk(3, heap_usage{_ws_="demo"})', START_S + 600, 60, END_S)
+    assert res.error is None
+    # at most 3 series present per step; series with any presence returned
+    vals = np.concatenate([np.asarray(b.values) for b in res.blocks])
+    present_per_step = (~np.isnan(vals)).sum(axis=0)
+    assert (present_per_step <= 3).all()
+    assert present_per_step.max() == 3
+
+
+def test_quantile_agg(engine):
+    res = engine.query_range(
+        'quantile(0.5, heap_usage{_ws_="demo",_ns_="App-3"})',
+        START_S + 600, 60, END_S)
+    assert res.error is None and res.num_series == 1
+
+
+def test_scalar_ops(engine):
+    r1 = engine.query_range('heap_usage{_ws_="demo",_ns_="App-1"} * 2',
+                            START_S + 600, 60, START_S + 660)
+    r2 = engine.query_range('heap_usage{_ws_="demo",_ns_="App-1"}',
+                            START_S + 600, 60, START_S + 660)
+    assert r1.error is None
+    v1 = np.sort(np.concatenate([b.values for b in r1.blocks]), axis=0)
+    v2 = np.sort(np.concatenate([b.values for b in r2.blocks]), axis=0)
+    np.testing.assert_allclose(v1, v2 * 2)
+
+
+def test_comparison_filters(engine):
+    res = engine.query_range('heap_usage{_ws_="demo",_ns_="App-1"} > 1000',
+                             START_S + 600, 60, END_S)
+    assert res.error is None
+    for _, _, vals in res.series():
+        assert np.nanmin(vals) > 1000 or np.isnan(vals).all()
+
+
+def test_binary_join_ratio(engine):
+    # rate / rate == 1 for identical series (self join)
+    res = engine.query_range(
+        'rate(request_total{_ws_="demo",_ns_="App-2"}[5m]) / '
+        'rate(request_total{_ws_="demo",_ns_="App-2"}[5m])',
+        START_S + 600, 60, END_S)
+    assert res.error is None
+    assert res.num_series == 10
+    for _, _, vals in res.series():
+        ok = vals[~np.isnan(vals)]
+        np.testing.assert_allclose(ok, 1.0)
+
+
+def test_set_and(engine):
+    res = engine.query_range(
+        'heap_usage{_ws_="demo",_ns_="App-1"} and '
+        'heap_usage{_ws_="demo",_ns_="App-1"}',
+        START_S + 600, 60, START_S + 1200)
+    assert res.error is None and res.num_series == 10
+
+
+def test_absent_on_missing(engine):
+    res = engine.query_range('absent(no_such_metric{_ws_="demo"})',
+                             START_S + 600, 60, START_S + 900)
+    assert res.error is None
+    assert res.num_series == 1
+    _, _, vals = next(res.series())
+    np.testing.assert_allclose(vals, 1.0)
+
+
+def test_subquery_engine(engine):
+    res = engine.query_range(
+        'max_over_time(rate(request_total{_ws_="demo",_ns_="App-2"}[1m])[10m:1m])',
+        START_S + 1200, 300, END_S)
+    assert res.error is None
+    assert res.num_series == 10
+
+
+def test_instant_fn_pipeline(engine):
+    res = engine.query_range('abs(heap_usage{_ws_="demo",_ns_="App-1"} - 100)',
+                             START_S + 600, 60, START_S + 900)
+    assert res.error is None
+    for _, _, vals in res.series():
+        assert np.nanmin(vals) >= 0
+
+
+def test_prometheus_json(engine):
+    res = engine.query_range(
+        'sum(rate(request_total{_ws_="demo",_ns_="App-2"}[5m]))',
+        START_S + 600, 60, START_S + 900)
+    j = QueryEngine.to_prom_matrix(res)
+    assert j["status"] == "success"
+    assert j["data"]["resultType"] == "matrix"
+    assert len(j["data"]["result"]) == 1
+    assert len(j["data"]["result"][0]["values"]) == 6
+
+
+def test_metadata_queries(engine):
+    from filodb_tpu.query import logical as lp
+    from filodb_tpu.core.index import Equals
+    res = engine.exec_logical_plan(lp.LabelValues(
+        ("_ns_",), (), START_MS, END_S * 1000))
+    assert sorted(res.data["_ns_"]) == [f"App-{i}" for i in range(10)]
+    res = engine.exec_logical_plan(lp.SeriesKeysByFilters(
+        (Equals("_ns_", "App-1"),), START_MS, END_S * 1000))
+    assert len(res.data) == 20       # 10 heap + 10 counter series
+
+
+# ------------------------------------------------- multi-shard (32 shards)
+
+@pytest.fixture(scope="module")
+def sharded_engine():
+    return _mk_engine([counter_batch(128, 60, start_ms=START_MS)],
+                      num_shards=8, spread=2)
+
+
+def test_sharded_sum_matches_single(sharded_engine):
+    res = sharded_engine.query_range(
+        'sum(rate(request_total{_ws_="demo",_ns_="App-2"}[5m]))',
+        START_S + 360, 60, START_S + 600)
+    single = _mk_engine([counter_batch(128, 60, start_ms=START_MS)])
+    res1 = single.query_range(
+        'sum(rate(request_total{_ws_="demo",_ns_="App-2"}[5m]))',
+        START_S + 360, 60, START_S + 600)
+    assert res.error is None and res1.error is None
+    np.testing.assert_allclose(res.blocks[0].values, res1.blocks[0].values,
+                               rtol=1e-12)
+
+
+def test_sharded_plan_uses_spread_shards(sharded_engine):
+    from filodb_tpu.promql.parser import query_range_to_logical_plan, TimeStepParams
+    from filodb_tpu.query.rangevector import QueryContext
+    plan = query_range_to_logical_plan(
+        'sum(rate(request_total{_ws_="demo",_ns_="App-2"}[5m]))',
+        TimeStepParams(START_S + 360, 60, START_S + 600))
+    ep = sharded_engine.planner.materialize(plan, QueryContext())
+    tree = ep.print_tree()
+    # spread 2 -> exactly 4 target shards
+    assert tree.count("MultiSchemaPartitionsExec") == 4
+    assert "ReduceAggregateExec" in tree
+
+
+def test_sharded_no_shard_key_fans_out_all(sharded_engine):
+    from filodb_tpu.promql.parser import query_range_to_logical_plan, TimeStepParams
+    from filodb_tpu.query.rangevector import QueryContext
+    plan = query_range_to_logical_plan(
+        'sum(rate(request_total[5m]))',
+        TimeStepParams(START_S + 360, 60, START_S + 600))
+    ep = sharded_engine.planner.materialize(plan, QueryContext())
+    assert ep.print_tree().count("MultiSchemaPartitionsExec") == 8
+
+
+# ------------------------------------------------------------- histograms
+
+def test_histogram_quantile_pipeline():
+    eng = _mk_engine([histogram_batch(20, 240, num_buckets=8,
+                                      start_ms=START_MS)])
+    res = eng.query_range(
+        'histogram_quantile(0.9, sum(rate(http_latency{_ws_="demo"}[5m])))',
+        START_S + 600, 60, START_S + 2400)
+    assert res.error is None
+    assert res.num_series == 1
+    _, _, vals = next(res.series())
+    assert np.isfinite(vals).all()
+    assert (vals > 0).all()
+
+
+def test_empty_on_group_left(engine):
+    """on() with empty label list must match everything (regression: empty
+    tuple was treated as no on-clause)."""
+    res = engine.query_range(
+        'heap_usage{_ws_="demo",_ns_="App-1"} - on() group_left '
+        'avg(heap_usage{_ws_="demo",_ns_="App-1"})',
+        START_S + 600, 60, START_S + 1200)
+    assert res.error is None and res.num_series == 10
+    vals = np.concatenate([b.values for b in res.blocks])
+    assert abs(float(np.nanmean(vals))) < 1.0
