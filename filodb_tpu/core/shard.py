@@ -122,15 +122,16 @@ class TimeSeriesShard:
         if batch.num_records == 0:
             return 0
         store = self._store_for(batch.schema.name)
-        # map batch-local part indices -> store rows (create partitions on miss);
-        # first timestamp per key via one vectorized pass, not a per-sample loop
-        rows_for_key = np.empty(len(batch.part_keys), dtype=np.int64)
+        # map batch-local part indices -> store rows (create partitions on
+        # miss); only keys actually referenced by records get partitions —
+        # a routed sub-batch carries the full key list but only this shard's
+        # rows (ref: TimeSeriesShard.getOrAddPartitionAndIngest:1249 creates
+        # per ingest record, never per container key table entry)
+        rows_for_key = np.full(len(batch.part_keys), -1, dtype=np.int64)
         uniq, first = np.unique(batch.part_idx, return_index=True)
-        first_ts_by_key = dict(zip(uniq.tolist(),
-                                   batch.timestamps[first].tolist()))
-        for k, pk in enumerate(batch.part_keys):
+        for k, ts0 in zip(uniq.tolist(), batch.timestamps[first].tolist()):
             info = self.get_or_create_partition(
-                pk, batch.schema.name, first_ts_by_key.get(k, 0))
+                batch.part_keys[k], batch.schema.name, ts0)
             rows_for_key[k] = info.row
         rows = rows_for_key[batch.part_idx]
         n = store.append_batch(rows, batch.timestamps, batch.columns,

@@ -47,13 +47,12 @@ DATE_FNS = {"minute", "hour", "day_of_week", "day_of_month", "month", "year",
 
 MISC_FNS = {"label_replace", "label_join", "hist_to_prom_vectors"}
 
-_PREC = [  # lowest to highest
+_PREC = [  # lowest to highest; "^" binds tighter than unary -> parse_power
     ({"or"}, "left"),
     ({"and", "unless"}, "left"),
     ({"==", "!=", ">", "<", ">=", "<="}, "left"),
     ({"+", "-"}, "left"),
     ({"*", "/", "%", "atan2"}, "left"),
-    ({"^"}, "right"),
 ]
 
 
@@ -155,11 +154,22 @@ class _Parser:
         return tuple(out)
 
     def parse_unary(self) -> A.Expr:
+        # unary +/- binds looser than '^' (Prometheus: -2^2 == -(2^2) == -4)
         if self.at_op("-", "+"):
             op = self.next().text
             e = self.parse_unary()
             return e if op == "+" else A.Unary("-", e)
-        return self.parse_postfix()
+        return self.parse_power()
+
+    def parse_power(self) -> A.Expr:
+        lhs = self.parse_postfix()
+        if self.at_op("^"):
+            self.next()
+            matching = self._parse_matching()
+            # right-assoc; RHS may itself be unary (2^-3)
+            rhs = self.parse_unary()
+            return A.BinaryExpr("^", lhs, rhs, False, matching)
+        return lhs
 
     def parse_postfix(self) -> A.Expr:
         e = self.parse_atom()
@@ -196,7 +206,7 @@ class _Parser:
                     which = self.next().text
                     self.expect("OP", "(")
                     self.expect("OP", ")")
-                    at_ms = ("start", which)
+                    at_ms = which          # "start" | "end" sentinel
                 else:
                     at_ms = int(float(self.expect("NUMBER").text) * 1000)
                 self._apply_at(e, at_ms)
@@ -387,6 +397,8 @@ class _Converter:
         if isinstance(e, A.MatrixSelector):
             raise ParseError("range selector must be inside a range function")
         if isinstance(e, A.Subquery):
+            if getattr(e, "at_ms", None) is not None:
+                raise ParseError("@ modifier is not supported yet")
             # offset shifts the whole inner evaluation window back; results
             # keep the inner grid's (shifted) sample timestamps like a
             # matrix selector with offset

@@ -216,8 +216,13 @@ class ScalarOperationMapper(RangeVectorTransformer):
         scalar = self.scalar
         if hasattr(scalar, "resolve"):            # deferred scalar subplan
             scalar = scalar.resolve(source)
-        sv = (scalar.values[None, :] if isinstance(scalar, ScalarResult)
-              else np.full((1, 1), float(scalar)))
+        if isinstance(scalar, ScalarResult):
+            # empty scalar stream (e.g. scalar(absent-selector) across
+            # shards) behaves as NaN, same as the 1-shard path
+            sv = (scalar.values[None, :] if scalar.values.shape[0]
+                  == vals.shape[1] else np.full((1, 1), np.nan))
+        else:
+            sv = np.full((1, 1), float(scalar))
         sv = np.broadcast_to(sv, vals.shape)
         a, b = (sv, vals) if self.scalar_is_lhs else (vals, sv)
         # comparison filtering keeps the VECTOR side's value
@@ -860,10 +865,6 @@ class BinaryJoinExec(NonLeafExecPlan):
         if self.cardinality == "OneToMany":
             many_side, one_side = rhs, lhs
             flip = True
-        elif self.cardinality == "ManyToOne":
-            pass
-        elif self.cardinality == "OneToOne":
-            pass
         # index the "one" side by match key; duplicates are an error
         one_index: Dict[RangeVectorKey, int] = {}
         for i, k in enumerate(one_side.keys):
@@ -942,6 +943,19 @@ class SetOperatorExec(NonLeafExecPlan):
             return k.only(self.on)
         return k.without(self.ignoring + ("_metric_", "__name__"))
 
+    def _presence_by_key(self, block: ResultBlock) -> Dict[RangeVectorKey, np.ndarray]:
+        """match-key -> [W] bool, True where any series with that key has a
+        sample at the step."""
+        vals = np.asarray(block.values)
+        if vals.ndim == 3:                       # histogram block
+            vals = vals[..., 0]
+        present: Dict[RangeVectorKey, np.ndarray] = {}
+        for i, k in enumerate(block.keys):
+            mk = self._match_key(k)
+            pres = ~np.isnan(vals[i])
+            present[mk] = present.get(mk, False) | pres
+        return present
+
     def compose(self, results, stats):
         lhs = concat_blocks([r for r in results[:self.n_lhs]
                              if isinstance(r, ResultBlock)])
@@ -953,12 +967,7 @@ class SetOperatorExec(NonLeafExecPlan):
                 return None
             rhs_keys = {self._match_key(k) for k in rhs.keys}
             # per-step AND: lhs kept where rhs series present at that step
-            rk_rows: Dict[RangeVectorKey, np.ndarray] = {}
-            rvals = np.asarray(rhs.values)
-            for i, k in enumerate(rhs.keys):
-                mk = self._match_key(k)
-                pres = ~np.isnan(rvals[i])
-                rk_rows[mk] = rk_rows.get(mk, False) | pres
+            rk_rows = self._presence_by_key(rhs)
             rows, outs = [], []
             lvals = np.asarray(lhs.values)
             for i, k in enumerate(lhs.keys):
@@ -976,11 +985,7 @@ class SetOperatorExec(NonLeafExecPlan):
             if rhs is None:
                 return lhs
             lvals = np.asarray(lhs.values)
-            lhs_present: Dict[RangeVectorKey, np.ndarray] = {}
-            for i, k in enumerate(lhs.keys):
-                mk = self._match_key(k)
-                pres = ~np.isnan(lvals[i])
-                lhs_present[mk] = lhs_present.get(mk, False) | pres
+            lhs_present = self._presence_by_key(lhs)
             keys = list(lhs.keys)
             vals = [lvals]
             rvals = np.asarray(rhs.values)
@@ -1002,12 +1007,7 @@ class SetOperatorExec(NonLeafExecPlan):
                 return None
             if rhs is None:
                 return lhs
-            rvals = np.asarray(rhs.values)
-            rk_rows: Dict[RangeVectorKey, np.ndarray] = {}
-            for i, k in enumerate(rhs.keys):
-                mk = self._match_key(k)
-                pres = ~np.isnan(rvals[i])
-                rk_rows[mk] = rk_rows.get(mk, False) | pres
+            rk_rows = self._presence_by_key(rhs)
             lvals = np.asarray(lhs.values)
             outs = []
             for i, k in enumerate(lhs.keys):
@@ -1205,6 +1205,9 @@ class LabelValuesExec(LeafExecPlan):
         stats = QueryStats(shards_queried=1)
         if shard is None:
             return None, stats
+        if not self.labels:        # LabelNames query (ref: LabelNamesExec)
+            return QueryResult([], stats,
+                               data=shard.index.label_names(self.filters)), stats
         out: Dict[str, List[str]] = {}
         for lbl in self.labels:
             out[lbl] = shard.index.label_values(lbl, self.filters or None)
@@ -1222,7 +1225,7 @@ class MetadataMergeExec(NonLeafExecPlan):
             if merged is None:
                 merged = r.data
             elif isinstance(merged, list):
-                merged = merged + r.data
+                merged = merged + [x for x in r.data if x not in merged]
             elif isinstance(merged, dict):
                 for k, v in r.data.items():
                     vals = set(merged.get(k, [])) | set(v)

@@ -117,9 +117,8 @@ class SingleClusterPlanner(QueryPlanner):
     # raw + periodic ----------------------------------------------------------
 
     def _m_RawSeries(self, p: lp.RawSeries, ctx: QueryContext) -> List[ExecPlan]:
-        shards = self.shard_mapper.active_shards(
-            self.shards_from_filters(p.filters, ctx)) or \
-            self.shards_from_filters(p.filters, ctx)
+        candidates = self.shards_from_filters(p.filters, ctx)
+        shards = self.shard_mapper.active_shards(candidates) or candidates
         plans: List[ExecPlan] = []
         for s in shards:
             e = MultiSchemaPartitionsExec(

@@ -202,3 +202,20 @@ def test_nested_full_query():
     assert isinstance(p, lp.ApplyInstantFunction)
     agg = p.vectors
     assert agg.by == ("le",)
+
+
+def test_unary_minus_power_precedence():
+    # Prometheus: '^' binds tighter than unary minus: -2^2 == -(2^2)
+    import filodb_tpu.promql.ast as A
+    e = parse_query("-2^2")
+    assert isinstance(e, A.Unary) and isinstance(e.expr, A.BinaryExpr)
+    assert e.expr.op == "^"
+    e2 = parse_query("2^-3")          # RHS of ^ may be unary
+    assert isinstance(e2, A.BinaryExpr) and isinstance(e2.rhs, A.Unary)
+    e3 = parse_query("2^3^2")         # right-assoc
+    assert isinstance(e3.rhs, A.BinaryExpr) and e3.rhs.op == "^"
+
+
+def test_subquery_at_modifier_rejected():
+    with pytest.raises(ParseError):
+        query_range_to_logical_plan("rate(foo[5m])[30m:1m] @ 1600000000", T)

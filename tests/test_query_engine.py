@@ -199,6 +199,8 @@ def test_metadata_queries(engine):
     res = engine.exec_logical_plan(lp.SeriesKeysByFilters(
         (Equals("_ns_", "App-1"),), START_MS, END_S * 1000))
     assert len(res.data) == 20       # 10 heap + 10 counter series
+    res = engine.exec_logical_plan(lp.LabelNames((), START_MS, END_S * 1000))
+    assert "_ns_" in res.data and "instance" in res.data
 
 
 # ------------------------------------------------- multi-shard (32 shards)
