@@ -1,0 +1,158 @@
+"""Headline benchmark: PromQL samples/sec scanned on sum by (rate[5m]).
+
+Mirrors the reference's QueryInMemoryBenchmark workload shape
+(ref: jmh/src/main/scala/filodb.jmh/QueryInMemoryBenchmark.scala:31-35,
+126-133 — Prom-schema counters, 720 samples @10s, 5m rate windows, sum
+aggregation) scaled toward the BASELINE.json north star (1M-series
+sum by(rate()) on one chip; multi-chip scales via the mesh path, see
+tests/test_mesh.py and __graft_entry__.dryrun_multichip).
+
+Accounting is conservative: "samples scanned" counts every stored sample in
+the queried span ONCE (S * samples_in_span), not once per overlapping window
+the way the JVM SlidingWindowIterator would touch them — so the number is a
+lower bound on iterator-equivalent throughput.
+
+vs_baseline compares against the same algorithm implemented in vectorized
+NumPy on host CPU (the strongest portable CPU stand-in we can run here; the
+reference publishes no absolute numbers — see BASELINE.md). A second,
+per-window loop baseline ("iterator") mimicking ChunkedWindowIterator's
+per-window access pattern is reported as an extra field.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
+"""
+import argparse
+import json
+import time
+
+import numpy as np
+
+
+def make_counter_data(S, T, step_ms=10_000, seed=7):
+    rng = np.random.default_rng(seed)
+    ts_row = np.arange(T, dtype=np.int64) * step_ms
+    vals = np.cumsum(rng.exponential(10.0, size=(S, T)).astype(np.float32),
+                     axis=1)
+    return ts_row, vals
+
+
+def numpy_vectorized_baseline(ts_row, vals, gids, G, wends, range_ms):
+    """Same algorithm as the device kernel, vectorized NumPy on host."""
+    lo = np.searchsorted(ts_row, wends - range_ms, side="left")
+    hi = np.searchsorted(ts_row, wends, side="right") - 1
+    ok = hi > lo
+    t1, t2 = ts_row[lo], ts_row[hi]
+    v1, v2 = vals[:, lo], vals[:, hi]                  # [S, W]
+    with np.errstate(invalid="ignore", divide="ignore"):
+        rate = np.where(ok & (t2 > t1), (v2 - v1) / (t2 - t1) * 1000.0,
+                        np.nan)
+    out = np.zeros((G, rate.shape[1]))
+    np.add.at(out, gids, np.nan_to_num(rate))
+    return out
+
+
+def numpy_iterator_baseline(ts_row, vals, wends, range_ms):
+    """Per-(series,window) loop mimicking ChunkedWindowIterator's access
+    pattern (ref: query/.../exec/PeriodicSamplesMapper.scala:202-292)."""
+    S = vals.shape[0]
+    out = np.empty((S, len(wends)))
+    for s in range(S):
+        row_v = vals[s]
+        for wi, wend in enumerate(wends):
+            lo = np.searchsorted(ts_row, wend - range_ms, side="left")
+            hi = np.searchsorted(ts_row, wend, side="right")
+            if hi - lo < 2:
+                out[s, wi] = np.nan
+                continue
+            t1, t2 = ts_row[lo], ts_row[hi - 1]
+            out[s, wi] = ((row_v[hi - 1] - row_v[lo]) / (t2 - t1) * 1000.0
+                          if t2 > t1 else np.nan)
+    return out
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true",
+                    help="small config for smoke runs")
+    ap.add_argument("--series", type=int, default=0)
+    ap.add_argument("--iters", type=int, default=0)
+    args = ap.parse_args()
+
+    import jax
+    from filodb_tpu.ops.rangefns import evaluate_range_function
+    from filodb_tpu.ops import agg as agg_ops
+    from filodb_tpu.ops.timewindow import to_offsets, make_window_ends
+
+    platform = jax.devices()[0].platform
+    quick = args.quick
+    S = args.series or (8_192 if quick else 262_144)
+    T = 720                                  # 2h of 10s samples
+    G = min(1000, S)                         # sum by() group count
+    range_ms, step_ms = 300_000, 60_000      # rate[5m], 1m steps
+    iters = args.iters or (3 if quick else 10)
+
+    ts_row, vals = make_counter_data(S, T)
+    ts_off = to_offsets(np.tile(ts_row, (S, 1)), np.full(S, T), 0)
+    gids = (np.arange(S) % G).astype(np.int32)
+    qstart, qend = 600_000, 7_190_000        # inside the data range
+    wends = make_window_ends(qstart, qend, step_ms).astype(np.int32)
+    W = len(wends)
+    # conservative accounting: every stored sample in the span, once
+    span_lo = np.searchsorted(ts_row, qstart - range_ms)
+    span_hi = np.searchsorted(ts_row, qend, side="right")
+    scanned_per_query = S * int(span_hi - span_lo)
+
+    dev_ts = jax.device_put(ts_off)
+    dev_vals = jax.device_put(vals)
+    dev_gids = jax.device_put(gids)
+    dev_wends = jax.device_put(wends)
+
+    @jax.jit
+    def query(ts_off, vals, gids, wends):
+        res = evaluate_range_function(ts_off, vals, wends, range_ms, "rate",
+                                      shared_grid=True)
+        return agg_ops.aggregate("sum", res, gids, G)
+
+    np.asarray(query(dev_ts, dev_vals, dev_gids, dev_wends))  # compile + warm
+    lat = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        # np.asarray forces execution AND result fetch: block_until_ready
+        # is not a reliable completion barrier on the tunneled TPU backend
+        np.asarray(query(dev_ts, dev_vals, dev_gids, dev_wends))
+        lat.append(time.perf_counter() - t0)
+    p50 = float(np.median(np.asarray(lat)))
+    samples_per_sec = scanned_per_query / p50
+
+    # vectorized-NumPy CPU baseline, same algorithm, capped working set
+    Sv = min(S, 65_536)
+    t0 = time.perf_counter()
+    numpy_vectorized_baseline(ts_row, vals[:Sv].astype(np.float64),
+                              gids[:Sv], G, wends.astype(np.int64), range_ms)
+    vec_elapsed = time.perf_counter() - t0
+    vec_samples_per_sec = (Sv * (span_hi - span_lo)) / vec_elapsed
+
+    # per-window loop baseline on a small subset (slow by construction)
+    Sb = min(S, 512)
+    t0 = time.perf_counter()
+    numpy_iterator_baseline(ts_row, vals[:Sb].astype(np.float64),
+                            wends.astype(np.int64), range_ms)
+    it_elapsed = time.perf_counter() - t0
+    it_samples_per_sec = (Sb * (span_hi - span_lo)) / it_elapsed
+
+    result = {
+        "metric": "promql_samples_scanned_per_sec",
+        "value": round(samples_per_sec, 1),
+        "unit": "samples/s",
+        "vs_baseline": round(samples_per_sec / vec_samples_per_sec, 2),
+        "p50_query_latency_s": round(p50, 5),
+        "series": S, "windows": W, "groups": G,
+        "platform": platform,
+        "baseline_samples_per_sec": round(vec_samples_per_sec, 1),
+        "baseline_kind": "vectorized numpy, same algorithm, host CPU",
+        "iterator_baseline_samples_per_sec": round(it_samples_per_sec, 1),
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

@@ -48,12 +48,18 @@ class WindowCtx(NamedTuple):
 
 
 def make_ctx(ts_off: jax.Array, vals: jax.Array,
-             wends: jax.Array, range_ms, base_ms=0) -> WindowCtx:
+             wends: jax.Array, range_ms, base_ms=0,
+             shared_grid: bool = False) -> WindowCtx:
+    """shared_grid=True asserts every series row of ts_off is identical
+    (one scrape grid — the common case); window bounds are then computed
+    once from row 0 and kept [1, W], turning every downstream gather into
+    a cheap column gather (see timewindow.gather_at)."""
     wend = wends.astype(jnp.int32)
     wstart = (wend - jnp.int32(range_ms) + 1).astype(jnp.int32)
     valid = (~jnp.isnan(vals)) & (ts_off < PAD_TS)
     # NaN samples must not satisfy boundary gathers; they are masked in sums
-    first, last, n = window_bounds(ts_off, wstart, wend)
+    first, last, n = window_bounds(ts_off[:1] if shared_grid else ts_off,
+                                   wstart, wend)
     return WindowCtx(ts_off, vals, valid, wstart, wend, first, last, n,
                      jnp.asarray(base_ms, vals.dtype))
 
@@ -392,19 +398,21 @@ RANGE_FUNCTIONS: Dict[str, RangeFnSpec] = {
 }
 
 
-@functools.partial(jax.jit, static_argnames=("fn_name", "params"))
+@functools.partial(jax.jit,
+                   static_argnames=("fn_name", "params", "shared_grid"))
 def evaluate_range_function(ts_off: jax.Array, vals: jax.Array,
                             wends: jax.Array, range_ms,
                             fn_name: Optional[str],
                             params: Tuple[float, ...] = (),
-                            base_ms=0) -> jax.Array:
+                            base_ms=0, shared_grid: bool = False) -> jax.Array:
     """The fused leaf kernel: window bounds + range function in one jit.
 
     fn_name None means plain periodic samples (instant-vector selector):
     last sample within the stale-lookback window, which callers express by
     passing range_ms = lookback and fn_name = 'last_over_time'.
+    shared_grid: all ts_off rows identical -> column-gather fast path.
     """
-    ctx = make_ctx(ts_off, vals, wends, range_ms, base_ms)
+    ctx = make_ctx(ts_off, vals, wends, range_ms, base_ms, shared_grid)
     name = fn_name or "last_over_time"
     spec = RANGE_FUNCTIONS[name]
     if spec.needs_params:

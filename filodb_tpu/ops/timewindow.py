@@ -56,8 +56,15 @@ def window_bounds(ts_off: jax.Array, wstart: jax.Array, wend: jax.Array
 
 
 def gather_at(arr: jax.Array, idx: jax.Array) -> jax.Array:
-    """Gather arr[s, idx[s, w]] -> [S, W]; idx clipped (caller masks)."""
+    """Gather arr[s, idx[s, w]] -> [S, W]; idx clipped (caller masks).
+
+    Fast path: idx [1, W] (shared time grid across series) lowers to a
+    rank-1 column gather — contiguous lanes, no per-row dynamic gather —
+    which is the difference between an MXU-friendly program and a scalar
+    mess on TPU."""
     safe = jnp.clip(idx, 0, arr.shape[1] - 1)
+    if safe.shape[0] == 1 and arr.shape[0] != 1:
+        return arr[:, safe[0]]
     return jnp.take_along_axis(arr, safe, axis=1)
 
 

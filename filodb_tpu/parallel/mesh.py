@@ -149,8 +149,7 @@ def device_put_packed(packed: PackedShards, mesh: Mesh) -> PackedShards:
 
 @functools.partial(
     jax.jit,
-    static_argnames=("mesh", "fn_name", "params", "agg_op", "num_groups",
-                     "range_ms", "base_ms"))
+    static_argnames=("mesh", "fn_name", "params", "agg_op", "num_groups"))
 def distributed_window_agg(mesh: Mesh,
                            ts_off: jax.Array, values: jax.Array,
                            group_ids: jax.Array, wends: jax.Array,
@@ -188,8 +187,7 @@ def distributed_window_agg(mesh: Mesh,
 
 
 @functools.partial(
-    jax.jit,
-    static_argnames=("mesh", "fn_name", "params", "range_ms", "base_ms"))
+    jax.jit, static_argnames=("mesh", "fn_name", "params"))
 def distributed_window_raw(mesh: Mesh,
                            ts_off: jax.Array, values: jax.Array,
                            wends: jax.Array, *, range_ms: int,
@@ -250,19 +248,30 @@ class MeshExecutor:
             blocks.append((ts_off, vals.astype(np.float64), labels))
         if not blocks:
             return None
+        if len(blocks) > self.n_shard:
+            raise ValueError(
+                f"memstore has {len(blocks)} shards but mesh shard axis is "
+                f"{self.n_shard}; data would be silently dropped")
         # pad shard list to mesh size
         while len(blocks) < self.n_shard:
             blocks.append((np.full((1, 1), PAD_TS, np.int32),
                            np.full((1, 1), np.nan), []))
-        packed = pack_shards(blocks[: self.n_shard], by=by, without=without,
-                             base_ms=start_ms)
+        packed = pack_shards(blocks, by=by, without=without, base_ms=start_ms)
         return device_put_packed(packed, self.mesh)
 
     def run_agg(self, packed: PackedShards, wends: np.ndarray, *,
                 range_ms: int, fn_name: Optional[str], agg_op: str,
                 params: Tuple[float, ...] = ()) -> Tuple[np.ndarray, List[Dict[str, str]]]:
-        """Returns (final [G, W] values, group label dicts)."""
-        wends = np.asarray(wends, np.int32)
+        """Returns (final [G, W] values, group label dicts).
+
+        wends are ABSOLUTE ms (same clock as lookup_and_pack's time range);
+        they are rebased onto the pack's offset base here."""
+        wends = np.asarray(wends, np.int64) - packed.base_ms
+        if wends.size and (wends.max() >= (1 << 30) or
+                           wends.min() <= -(1 << 30)):
+            raise ValueError("window ends more than ~12 days from the packed "
+                             "base; split the query by time range")
+        wends = wends.astype(np.int32)
         W = wends.shape[0]
         n_time = self.mesh.shape["time"]
         # pad the window grid to a multiple of the time axis; padded windows
@@ -277,6 +286,6 @@ class MeshExecutor:
             self.mesh, packed.ts_off, packed.values, packed.group_ids,
             wends_dev, range_ms=range_ms, fn_name=fn_name, params=params,
             agg_op=agg_op, num_groups=packed.num_groups,
-            base_ms=0)
+            base_ms=packed.base_ms)
         out = agg_ops.present(agg_op, partials)
         return np.asarray(out)[:, :W], packed.group_labels

@@ -1214,6 +1214,11 @@ class LabelValuesExec(LeafExecPlan):
         return QueryResult([], stats, data=out), stats
 
 
+def _canon(x):
+    """Hashable canonical form for metadata dedup (str or label dict)."""
+    return tuple(sorted(x.items())) if isinstance(x, dict) else x
+
+
 class MetadataMergeExec(NonLeafExecPlan):
     """Merge metadata results across shards."""
 
@@ -1223,9 +1228,15 @@ class MetadataMergeExec(NonLeafExecPlan):
             if not isinstance(r, QueryResult) or r.data is None:
                 continue
             if merged is None:
-                merged = r.data
+                merged = list(r.data) if isinstance(r.data, list) else r.data
+                if isinstance(merged, list):
+                    seen = {_canon(x) for x in merged}
             elif isinstance(merged, list):
-                merged = merged + [x for x in r.data if x not in merged]
+                for x in r.data:
+                    c = _canon(x)
+                    if c not in seen:
+                        seen.add(c)
+                        merged.append(x)
             elif isinstance(merged, dict):
                 for k, v in r.data.items():
                     vals = set(merged.get(k, [])) | set(v)

@@ -74,10 +74,8 @@ def _mesh_result(ms, mesh, agg_op, fn_name, by=(), range_ms=300_000):
         (START_S + 600) * 1000 - range_ms, QEND_S * 1000, by=by)
     wends = make_window_ends((START_S + 600) * 1000, QEND_S * 1000,
                              STEP_S * 1000)
-    # lookup_and_pack bases offsets at chunk start; window ends are absolute,
-    # rebase them the same way
-    base = (START_S + 600) * 1000 - range_ms
-    out, labels = ex.run_agg(packed, wends - base, range_ms=range_ms,
+    # absolute ms: run_agg rebases onto the pack's offset base itself
+    out, labels = ex.run_agg(packed, wends, range_ms=range_ms,
                              fn_name=fn_name, agg_op=agg_op)
     return out, labels
 

@@ -147,3 +147,24 @@ def test_holt_winters_smoke():
     out = _run_kernel([ts], [v], [int(ts[-1])], 300_000,
                       "holt_winters", (0.5, 0.1))
     assert np.isfinite(out[0][0])
+
+
+def test_shared_grid_matches_general_path():
+    """shared_grid=True must be bit-identical when all rows share one grid."""
+    import jax
+    from filodb_tpu.ops.rangefns import evaluate_range_function
+    from filodb_tpu.ops.timewindow import to_offsets
+    rng = np.random.default_rng(3)
+    S, T = 16, 200
+    ts = np.tile(np.arange(T, dtype=np.int64) * 10_000, (S, 1))
+    vals = np.cumsum(rng.exponential(5.0, size=(S, T)), axis=1)
+    vals[2, 50:60] = np.nan                       # per-series gaps are fine
+    ts_off = to_offsets(ts, np.full(S, T), 0)
+    wends = (np.arange(1, 21, dtype=np.int32) * 90_000)
+    for fn in ["rate", "increase", "sum_over_time", "min_over_time",
+               "last_over_time", "changes", "deriv", "z_score", "irate"]:
+        a = np.asarray(evaluate_range_function(ts_off, vals, wends, 120_000,
+                                               fn))
+        b = np.asarray(evaluate_range_function(ts_off, vals, wends, 120_000,
+                                               fn, shared_grid=True))
+        np.testing.assert_array_equal(a, b, err_msg=fn)
