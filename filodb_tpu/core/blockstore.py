@@ -43,6 +43,17 @@ class DenseSeriesStore:
         self.ts = np.full((self._s_cap, self._t_cap), _PAD_TS, dtype=np.int64)
         self.counts = np.zeros(self._s_cap, dtype=np.int32)
         self.sealed = np.zeros(self._s_cap, dtype=np.int32)  # flushed watermark
+        # ODP coverage bookkeeping (see TimeSeriesShard.ensure_paged).  Lives
+        # here — not on PartitionInfo — so eviction can invalidate it:
+        #   paged_floor: disk consulted AND resident down to this time
+        #                (_PAD_TS sentinel = never consulted)
+        #   paged_ceil:  for page-only rows, disk consulted up to this time
+        #                above the in-memory top (-1 = none)
+        #   page_only:   row has never received live appends (recovered /
+        #                query-only partitions)
+        self.paged_floor = np.full(self._s_cap, _PAD_TS, dtype=np.int64)
+        self.paged_ceil = np.full(self._s_cap, -1, dtype=np.int64)
+        self.page_only = np.ones(self._s_cap, dtype=bool)
         self.cols: Dict[str, np.ndarray] = {}
         for c in schema.data_columns:
             if c.col_type == "hist":
@@ -71,6 +82,9 @@ class DenseSeriesStore:
         self.ts = grow(self.ts, _PAD_TS)
         self.counts = grow(self.counts, 0)
         self.sealed = grow(self.sealed, 0)
+        self.paged_floor = grow(self.paged_floor, _PAD_TS)
+        self.paged_ceil = grow(self.paged_ceil, -1)
+        self.page_only = grow(self.page_only, True)
         for name, arr in self.cols.items():
             self.cols[name] = grow(arr, np.nan)
         self._s_cap = new_cap
@@ -182,8 +196,92 @@ class DenseSeriesStore:
             else:
                 self.cols[c.name][rows, pos] = arr
         np.add.at(self.counts, rows, 1)
+        # live data now tops these rows: upper disk coverage is governed by
+        # the checkpoint/replay invariant, not paged_ceil
+        self.page_only[np.unique(rows)] = False
         self.generation += 1
         return len(rows)
+
+    def prepend_row(self, row: int, ts: np.ndarray,
+                    columns: Dict[str, np.ndarray]) -> int:
+        """Insert samples strictly OLDER than the oldest stored sample for
+        `row` — the ODP page-in path (ref: DemandPagedChunkStore populating
+        TSPartitions from persisted chunks, OnDemandPagingShard.scala:27-39).
+        Paged-in data is already persisted, so the sealed watermark advances
+        with it (it is reclaimable, like ODP-flagged blocks).  If the row
+        would exceed max_time_cap, the OLDEST part of the payload is trimmed
+        to fit (the capDataScannedPerShardCheck spirit of ref:
+        OnDemandPagingShard.scala:55); callers must set paged_floor from what
+        is actually resident, so a trimmed page-in is re-consulted rather than
+        trusted."""
+        n = len(ts)
+        if n == 0:
+            return 0
+        cnt = int(self.counts[row])
+        room = self.max_time_cap - cnt
+        if n > room:
+            if room <= 0:
+                return 0
+            ts = ts[-room:]
+            columns = {k: v[-room:] for k, v in columns.items()}
+            n = room
+        need = cnt + n
+        if need > self._t_cap:
+            self._grow_time(need)
+        self.ts[row, n:need] = self.ts[row, :cnt].copy()
+        self.ts[row, :n] = ts
+        for c in self.schema.data_columns:
+            arr = self.cols[c.name]
+            if arr is None:
+                continue
+            vals = columns.get(c.name)
+            if arr.ndim == 3:
+                arr[row, n:need, :] = arr[row, :cnt, :].copy()
+                arr[row, :n, :] = np.nan if vals is None else vals
+            else:
+                arr[row, n:need] = arr[row, :cnt].copy()
+                arr[row, :n] = np.nan if vals is None else vals
+        self.counts[row] += n
+        self.sealed[row] += n
+        self.generation += 1
+        return n
+
+    def append_row(self, row: int, ts: np.ndarray,
+                   columns: Dict[str, np.ndarray]) -> int:
+        """ODP page-in ABOVE the in-memory data for one row (samples strictly
+        newer than the row's last).  Unlike append_batch this never triggers
+        store-wide eviction — a query's page-in must not evict samples another
+        row of the same query just loaded; the NEWEST part of the payload is
+        trimmed to fit max_time_cap instead, and callers set paged_ceil from
+        what is actually resident."""
+        n = len(ts)
+        if n == 0:
+            return 0
+        cnt = int(self.counts[row])
+        room = self.max_time_cap - cnt
+        if n > room:
+            if room <= 0:
+                return 0
+            ts = ts[:room]
+            columns = {k: v[:room] for k, v in columns.items()}
+            n = room
+        need = cnt + n
+        if need > self._t_cap:
+            self._grow_time(need)
+        self.ts[row, cnt:need] = ts
+        for c in self.schema.data_columns:
+            arr = self.cols[c.name]
+            if arr is None:
+                continue
+            vals = columns.get(c.name)
+            if arr.ndim == 3:
+                arr[row, cnt:need, :] = np.nan if vals is None else vals
+            else:
+                arr[row, cnt:need] = np.nan if vals is None else vals
+        self.counts[row] += n
+        self.sealed[row] += n
+        self.generation += 1
+        return n
 
     # ---- eviction ----
 
@@ -215,6 +313,11 @@ class DenseSeriesStore:
                 self.cols[name] = np.where(valid, arr[rowi, idx_c], np.nan)
         self.counts = (self.counts - k).astype(np.int32)
         self.sealed = (self.sealed - k).astype(np.int32)
+        # evicted rows no longer hold everything disk was consulted for:
+        # force re-paging on the next query (floor AND ceil — a fully
+        # evicted page-only row must not keep stale upper coverage either)
+        self.paged_floor[k > 0] = _PAD_TS
+        self.paged_ceil[k > 0] = -1
         self.generation += 1
 
     # ---- query gather ----

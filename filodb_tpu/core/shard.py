@@ -211,6 +211,104 @@ class TimeSeriesShard:
         first = next(iter(by_schema)) if by_schema else None
         return PartLookupResult(self.shard_num, ids, by_schema, first)
 
+    def _decode_paged_chunks(self, store: DenseSeriesStore, chunks,
+                             lo_excl: int, hi_incl: int):
+        """Decode + concatenate chunk data with ts in (lo_excl, hi_incl],
+        dropping overlaps and bucket-scheme-mismatched histogram chunks."""
+        from filodb_tpu.memory.chunks import decode_chunkset
+        ts_parts, col_parts = [], []
+        for cs in sorted(chunks, key=lambda c: c.info.start_time_ms):
+            if cs.bucket_scheme is not None:
+                if store.num_buckets == 0:
+                    store._ensure_hist(cs.bucket_scheme.num_buckets,
+                                       cs.bucket_scheme.as_array())
+                elif cs.bucket_scheme.num_buckets != store.num_buckets:
+                    # scheme changed across the chunk's lifetime; a dense row
+                    # has one width — skip rather than crash the query
+                    # (ref: HistogramBuckets scheme-change handling)
+                    self.stats.rows_dropped += cs.info.num_rows
+                    continue
+            decoded = decode_chunkset(cs)
+            ts = decoded.pop("timestamp")
+            keep = (ts > lo_excl) & (ts <= hi_incl)
+            if ts_parts:
+                keep &= ts > ts_parts[-1][-1]     # chunks must not overlap
+            if not keep.any():
+                continue
+            ts_parts.append(ts[keep])
+            col_parts.append({k: v[keep] for k, v in decoded.items()})
+        if not ts_parts:
+            return None, None
+        return (np.concatenate(ts_parts),
+                {k: np.concatenate([cp[k] for cp in col_parts])
+                 for k in col_parts[0]})
+
+    def ensure_paged(self, parts: Sequence[PartitionInfo],
+                     start_time_ms: int, end_time_ms: int) -> int:
+        """On-demand paging: load persisted chunks not in the in-memory
+        working set so the query sees full history (ref:
+        OnDemandPagingShard.scala:27-39, DemandPagedChunkStore.scala:17-34).
+
+        Coverage bookkeeping lives in the DenseSeriesStore (per-row
+        paged_floor/paged_ceil) so eviction invalidates it.  Two directions:
+        below the in-memory data (prepend — recovered partitions whose flushed
+        history is on disk) and, for page-only rows (no live appends, e.g. a
+        query-only downsample store), above it too.  Returns samples paged."""
+        if isinstance(self.column_store, NullColumnStore):
+            return 0
+        paged = 0
+        for info in parts:
+            store = self.stores[info.schema_name]
+            row = info.row
+            cnt = int(store.counts[row])
+            floor = int(store.paged_floor[row])
+            first_mem = int(store.ts[row, 0]) if cnt else MAX_TIME
+            covered_down_to = min(floor, first_mem)
+            if start_time_ms < covered_down_to:
+                hi = min(first_mem - 1, end_time_ms)
+                if hi >= start_time_ms:
+                    chunks = self.column_store.read_chunks(
+                        self.dataset, self.shard_num, info.part_key,
+                        start_time_ms, hi)
+                    ts_all, cols_all = self._decode_paged_chunks(
+                        store, chunks, start_time_ms - 1, min(first_mem - 1, hi))
+                    if ts_all is not None:
+                        n = store.prepend_row(row, ts_all, cols_all)
+                        paged += n
+                        # trimmed page-ins must not claim full coverage
+                        if n == len(ts_all):
+                            store.paged_floor[row] = start_time_ms
+                        elif n > 0:
+                            store.paged_floor[row] = int(store.ts[row, 0])
+                    else:
+                        store.paged_floor[row] = start_time_ms
+                    if cnt == 0 and store.page_only[row]:
+                        store.paged_ceil[row] = max(
+                            int(store.paged_ceil[row]), hi)
+            # upper paging: only for rows that have never seen live ingest
+            # (live rows' upper coverage is the checkpoint/replay invariant)
+            if store.page_only[row] and int(store.counts[row]) > 0:
+                last_mem = int(store.ts[row, int(store.counts[row]) - 1])
+                ceil = max(int(store.paged_ceil[row]), last_mem)
+                if end_time_ms > ceil:
+                    chunks = self.column_store.read_chunks(
+                        self.dataset, self.shard_num, info.part_key,
+                        ceil + 1, end_time_ms)
+                    ts_all, cols_all = self._decode_paged_chunks(
+                        store, chunks, last_mem, end_time_ms)
+                    if ts_all is not None:
+                        n = store.append_row(row, ts_all, cols_all)
+                        paged += n
+                        # a trimmed page-in must not claim full coverage
+                        if n == len(ts_all):
+                            store.paged_ceil[row] = end_time_ms
+                        elif n > 0:
+                            store.paged_ceil[row] = int(
+                                store.ts[row, int(store.counts[row]) - 1])
+                    else:
+                        store.paged_ceil[row] = end_time_ms
+        return paged
+
     def gather_series(self, parts: Sequence[PartitionInfo]):
         """Dense-gather rows for a single-schema partition list.
         Returns (ts [S,T], cols dict, counts [S], store)."""

@@ -198,12 +198,20 @@ def timestamp_fn(ctx: WindowCtx) -> jax.Array:
     return _nan_where(ctx.n > 0, t)
 
 
+def _n_full(ctx: WindowCtx) -> jax.Array:
+    """ctx.n broadcast to [S, W] — under shared_grid the bounds stay [1, W],
+    but functions whose OUTPUT derives only from n must still return [S, W]."""
+    return jnp.broadcast_to(ctx.n, (ctx.vals.shape[0], ctx.n.shape[-1]))
+
+
 def absent_over_time(ctx: WindowCtx) -> jax.Array:
-    return jnp.where(ctx.n == 0, 1.0, jnp.nan).astype(ctx.vals.dtype)
+    n = _n_full(ctx)
+    return jnp.where(n == 0, 1.0, jnp.nan).astype(ctx.vals.dtype)
 
 
 def present_over_time(ctx: WindowCtx) -> jax.Array:
-    return _nan_where(ctx.n > 0, jnp.ones_like(ctx.n, dtype=ctx.vals.dtype))
+    n = _n_full(ctx)
+    return jnp.where(n > 0, 1.0, jnp.nan).astype(ctx.vals.dtype)
 
 
 # ------------------------------------------------ pairwise-indicator functions

@@ -239,6 +239,7 @@ class MeshExecutor:
                 blocks.append((np.full((1, 1), PAD_TS, np.int32),
                                np.full((1, 1), np.nan), []))
                 continue
+            shard.ensure_paged(parts, start_ms, end_ms)
             ts, cols, counts, store = shard.gather_series(parts)
             schema = shard.schemas[schema_name]
             vals = cols[schema.value_column]

@@ -1,0 +1,3 @@
+from filodb_tpu.persist.localstore import LocalDiskColumnStore, LocalDiskMetaStore
+
+__all__ = ["LocalDiskColumnStore", "LocalDiskMetaStore"]

@@ -1,0 +1,301 @@
+"""Local-disk persistence backend: the Cassandra-analogue.
+
+The reference persists chunks + part keys + checkpoints in Cassandra tables
+(ref: cassandra/.../columnstore/CassandraColumnStore.scala:53-80,
+TimeSeriesChunksTable, PartitionKeysTable, metastore/CheckpointTable.scala).
+The TPU-native build keeps the same pluggable ColumnStore/MetaStore traits
+(core/store.py) and backs them with per-shard append-only log files on local
+disk (or any mounted object store):
+
+    <root>/<dataset>/shard-<N>/chunks-<gen>.log   framed ChunkSets
+    <root>/<dataset>/shard-<N>/partkeys.log       framed PartKeyRecord upserts
+    <root>/<dataset>/checkpoints-<N>.json         group watermarks (atomic)
+
+Design points carried over from the reference:
+  - part-key upserts are last-write-wins on (partKey bytes), exactly like the
+    PartitionKeysTable primary key (ref: PartitionKeysTable.scala);
+  - chunks can be scanned by ingestion time for the downsampler batch job
+    (ref: IngestionTimeIndexTable.scala — here the frame header carries
+    ingestionTime so a sequential scan filters without a second table);
+  - checkpoints are tiny and written atomically (write-to-temp + rename),
+    the crash-consistency analogue of C* CheckpointTable row upserts.
+
+Torn tails: a crash mid-append leaves a truncated/corrupt final frame.  Every
+frame carries a CRC32 and a length; readers stop at the first bad frame, which
+is exactly the recovery contract — data past the last good frame is replayed
+from the ingest stream via group watermarks (doc/ingestion.md:114-133).
+"""
+from __future__ import annotations
+
+import json
+import os
+import struct
+import threading
+import zlib
+from typing import Dict, Iterable, Iterator, List, Optional, Tuple
+
+import numpy as np
+
+from filodb_tpu.core.partkey import PartKey
+from filodb_tpu.core.store import ColumnStore, MetaStore, PartKeyRecord
+from filodb_tpu.memory.chunks import ChunkSet, ChunkSetInfo, ColumnChunk
+from filodb_tpu.memory.histogram import HistogramBuckets
+
+_MAGIC_CHUNK = 0xF1D0C401
+_MAGIC_PK = 0xF1D0C402
+
+
+# ---------------------------------------------------------------- frame codec
+
+def _write_frame(f, magic: int, payload: bytes) -> None:
+    header = struct.pack("<IIi", magic, len(payload), zlib.crc32(payload) & 0x7FFFFFFF)
+    f.write(header + payload)
+
+
+def _iter_frames(path: str, magic: int) -> Iterator[bytes]:
+    """Yield payloads of valid frames; stop silently at a torn/corrupt tail."""
+    if not os.path.exists(path):
+        return
+    with open(path, "rb") as f:
+        data = f.read()
+    off = 0
+    n = len(data)
+    while off + 12 <= n:
+        m, length, crc = struct.unpack_from("<IIi", data, off)
+        if m != magic or off + 12 + length > n:
+            return
+        payload = data[off + 12: off + 12 + length]
+        if (zlib.crc32(payload) & 0x7FFFFFFF) != crc:
+            return
+        yield payload
+        off += 12 + length
+
+
+# ------------------------------------------------------------- chunk (de)code
+
+def _encode_chunkset_frame(part_key: PartKey, schema_name: str, cs: ChunkSet) -> bytes:
+    pk = part_key.to_bytes()
+    scheme = cs.bucket_scheme.as_array().tobytes() if cs.bucket_scheme else b""
+    head = struct.pack(
+        "<H", len(pk)) + pk + struct.pack(
+        "<H", len(schema_name)) + schema_name.encode() + struct.pack(
+        "<qqiqqH", cs.info.chunk_id, cs.info.ingestion_time_ms,
+        cs.info.num_rows, cs.info.start_time_ms, cs.info.end_time_ms,
+        len(scheme) // 8) + scheme + struct.pack("<H", len(cs.columns))
+    parts = [head]
+    for name, col in cs.columns.items():
+        nb = name.encode()
+        kb = col.kind.encode()
+        parts.append(struct.pack("<HH", len(nb), len(kb)) + nb + kb)
+        parts.append(struct.pack("<qqiI", col.base, col.slope,
+                                 col.num_buckets, len(col.payload)))
+        parts.append(col.payload)
+    return b"".join(parts)
+
+
+def _decode_chunkset_frame(data: bytes) -> Tuple[bytes, str, ChunkSet]:
+    off = 0
+    (pk_len,) = struct.unpack_from("<H", data, off); off += 2
+    pk_bytes = data[off: off + pk_len]; off += pk_len
+    (sn_len,) = struct.unpack_from("<H", data, off); off += 2
+    schema_name = data[off: off + sn_len].decode(); off += sn_len
+    chunk_id, ing_ms, num_rows, start_ms, end_ms, n_les = struct.unpack_from(
+        "<qqiqqH", data, off); off += 38
+    scheme = None
+    if n_les:
+        les = np.frombuffer(data[off: off + 8 * n_les], dtype=np.float64)
+        scheme = HistogramBuckets(tuple(float(x) for x in les))
+        off += 8 * n_les
+    (n_cols,) = struct.unpack_from("<H", data, off); off += 2
+    cols: Dict[str, ColumnChunk] = {}
+    for _ in range(n_cols):
+        nl, kl = struct.unpack_from("<HH", data, off); off += 4
+        name = data[off: off + nl].decode(); off += nl
+        kind = data[off: off + kl].decode(); off += kl
+        base, slope, num_buckets, plen = struct.unpack_from("<qqiI", data, off)
+        off += 24
+        payload = data[off: off + plen]; off += plen
+        cols[name] = ColumnChunk(kind, payload, base=base, slope=slope,
+                                 num_buckets=num_buckets)
+    info = ChunkSetInfo(chunk_id, ing_ms, num_rows, start_ms, end_ms)
+    return pk_bytes, schema_name, ChunkSet(info, cols, scheme)
+
+
+def _encode_pk_frame(r: PartKeyRecord) -> bytes:
+    pk = r.part_key.to_bytes()
+    sn = r.schema_name.encode()
+    return (struct.pack("<H", len(pk)) + pk + struct.pack("<H", len(sn)) + sn
+            + struct.pack("<qq", r.start_time_ms, r.end_time_ms))
+
+
+def _decode_pk_frame(data: bytes) -> PartKeyRecord:
+    off = 0
+    (pk_len,) = struct.unpack_from("<H", data, off); off += 2
+    pk = PartKey.from_bytes(data[off: off + pk_len]); off += pk_len
+    (sn_len,) = struct.unpack_from("<H", data, off); off += 2
+    sn = data[off: off + sn_len].decode(); off += sn_len
+    start_ms, end_ms = struct.unpack_from("<qq", data, off)
+    return PartKeyRecord(pk, sn, start_ms, end_ms)
+
+
+# -------------------------------------------------------------------- stores
+
+class LocalDiskColumnStore(ColumnStore):
+    """Append-only chunk + partkey logs per shard.
+
+    An in-memory index (partKey bytes -> frame offsets) is built lazily per
+    shard by one sequential scan on first read; appends keep it current.  This
+    is the local-disk stand-in for Cassandra's clustering-key lookups.
+    """
+
+    def __init__(self, root: str):
+        self.root = root
+        self._lock = threading.Lock()
+        # (dataset, shard) -> partKey bytes -> List[ChunkSet]
+        self._chunk_idx: Dict[Tuple[str, int], Dict[bytes, List[Tuple[str, ChunkSet]]]] = {}
+        self._pk_idx: Dict[Tuple[str, int], Dict[bytes, PartKeyRecord]] = {}
+        self._files: Dict[str, object] = {}
+
+    # -- paths
+    def _shard_dir(self, dataset: str, shard: int) -> str:
+        return os.path.join(self.root, dataset, f"shard-{shard}")
+
+    def _chunk_path(self, dataset: str, shard: int) -> str:
+        return os.path.join(self._shard_dir(dataset, shard), "chunks.log")
+
+    def _pk_path(self, dataset: str, shard: int) -> str:
+        return os.path.join(self._shard_dir(dataset, shard), "partkeys.log")
+
+    def initialize(self, dataset: str, num_shards: int) -> None:
+        for s in range(num_shards):
+            os.makedirs(self._shard_dir(dataset, s), exist_ok=True)
+
+    def _append(self, path: str, magic: int, payload: bytes) -> None:
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        f = self._files.get(path)
+        if f is None:
+            f = open(path, "ab")
+            self._files[path] = f
+        _write_frame(f, magic, payload)
+        f.flush()
+
+    def _load_shard(self, dataset: str, shard: int) -> None:
+        key = (dataset, shard)
+        if key in self._chunk_idx:
+            return
+        chunks: Dict[bytes, List[Tuple[str, ChunkSet]]] = {}
+        for payload in _iter_frames(self._chunk_path(dataset, shard), _MAGIC_CHUNK):
+            pk_bytes, schema_name, cs = _decode_chunkset_frame(payload)
+            chunks.setdefault(pk_bytes, []).append((schema_name, cs))
+        pks: Dict[bytes, PartKeyRecord] = {}
+        for payload in _iter_frames(self._pk_path(dataset, shard), _MAGIC_PK):
+            r = _decode_pk_frame(payload)
+            pks[r.part_key.to_bytes()] = r        # last write wins
+        self._chunk_idx[key] = chunks
+        self._pk_idx[key] = pks
+
+    # -- ColumnStore API
+    def write_chunks(self, dataset, shard, part_key, chunksets, schema_name) -> None:
+        with self._lock:
+            self._load_shard(dataset, shard)
+            path = self._chunk_path(dataset, shard)
+            pk_bytes = part_key.to_bytes()
+            bucket = self._chunk_idx[(dataset, shard)].setdefault(pk_bytes, [])
+            for cs in chunksets:
+                self._append(path, _MAGIC_CHUNK,
+                             _encode_chunkset_frame(part_key, schema_name, cs))
+                bucket.append((schema_name, cs))
+
+    def write_part_keys(self, dataset, shard, records) -> None:
+        with self._lock:
+            self._load_shard(dataset, shard)
+            path = self._pk_path(dataset, shard)
+            idx = self._pk_idx[(dataset, shard)]
+            for r in records:
+                self._append(path, _MAGIC_PK, _encode_pk_frame(r))
+                idx[r.part_key.to_bytes()] = r
+
+    def read_part_keys(self, dataset, shard) -> List[PartKeyRecord]:
+        with self._lock:
+            self._load_shard(dataset, shard)
+            return list(self._pk_idx[(dataset, shard)].values())
+
+    def read_chunks(self, dataset, shard, part_key, start_time_ms, end_time_ms):
+        with self._lock:
+            self._load_shard(dataset, shard)
+            out = []
+            for _, cs in self._chunk_idx[(dataset, shard)].get(part_key.to_bytes(), []):
+                if (cs.info.start_time_ms <= end_time_ms
+                        and cs.info.end_time_ms >= start_time_ms):
+                    out.append(cs)
+            return out
+
+    def scan_chunks_by_ingestion_time(
+            self, dataset: str, shard: int,
+            ingestion_start_ms: int, ingestion_end_ms: int,
+    ) -> Iterator[Tuple[PartKey, str, ChunkSet]]:
+        """Sequential scan filtered by ingestionTime — the downsampler's read
+        path (ref: IngestionTimeIndexTable.scala; DownsamplerMain reads raw
+        chunks by ingestion-time window)."""
+        with self._lock:
+            self._load_shard(dataset, shard)
+            items = [(pk_bytes, sn, cs)
+                     for pk_bytes, lst in self._chunk_idx[(dataset, shard)].items()
+                     for sn, cs in lst
+                     if ingestion_start_ms <= cs.info.ingestion_time_ms < ingestion_end_ms]
+        for pk_bytes, sn, cs in items:
+            yield PartKey.from_bytes(pk_bytes), sn, cs
+
+    def num_chunksets(self, dataset: str, shard: int) -> int:
+        with self._lock:
+            self._load_shard(dataset, shard)
+            return sum(len(v) for v in self._chunk_idx[(dataset, shard)].values())
+
+    def close(self) -> None:
+        with self._lock:
+            for f in self._files.values():
+                f.close()
+            self._files.clear()
+            self._chunk_idx.clear()
+            self._pk_idx.clear()
+
+
+class LocalDiskMetaStore(MetaStore):
+    """Atomic JSON checkpoint files, one per (dataset, shard).
+
+    Equivalent of the C* CheckpointTable (ref: metastore/CheckpointTable.scala):
+    one watermark per flush group; recovery starts at min(watermarks) and
+    skips below-watermark records per group.
+    """
+
+    def __init__(self, root: str):
+        self.root = root
+        self._lock = threading.Lock()
+
+    def _path(self, dataset: str, shard: int) -> str:
+        return os.path.join(self.root, dataset, f"checkpoints-{shard}.json")
+
+    def write_checkpoint(self, dataset, shard, group, offset) -> None:
+        with self._lock:
+            path = self._path(dataset, shard)
+            os.makedirs(os.path.dirname(path), exist_ok=True)
+            cps = self._read(path)
+            cps[str(group)] = offset
+            tmp = path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(cps, f)
+            os.replace(tmp, path)   # atomic on POSIX
+
+    @staticmethod
+    def _read(path: str) -> Dict[str, int]:
+        if not os.path.exists(path):
+            return {}
+        try:
+            with open(path) as f:
+                return json.load(f)
+        except (json.JSONDecodeError, OSError):
+            return {}
+
+    def read_checkpoints(self, dataset, shard) -> Dict[int, int]:
+        with self._lock:
+            return {int(g): o for g, o in self._read(self._path(dataset, shard)).items()}

@@ -714,6 +714,7 @@ class MultiSchemaPartitionsExec(LeafExecPlan):
         parts = lookup.parts_by_schema.get(schema_name, [])
         if not parts:
             return None, stats
+        shard.ensure_paged(parts, self.chunk_start_ms, self.chunk_end_ms)
         gathered = shard.gather_series(parts)
         ts, cols, counts, store = gathered
         schema = shard.schemas[schema_name]

@@ -162,7 +162,8 @@ def test_shared_grid_matches_general_path():
     ts_off = to_offsets(ts, np.full(S, T), 0)
     wends = (np.arange(1, 21, dtype=np.int32) * 90_000)
     for fn in ["rate", "increase", "sum_over_time", "min_over_time",
-               "last_over_time", "changes", "deriv", "z_score", "irate"]:
+               "last_over_time", "changes", "deriv", "z_score", "irate",
+               "present_over_time", "absent_over_time", "timestamp"]:
         a = np.asarray(evaluate_range_function(ts_off, vals, wends, 120_000,
                                                fn))
         b = np.asarray(evaluate_range_function(ts_off, vals, wends, 120_000,
