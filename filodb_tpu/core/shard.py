@@ -265,13 +265,18 @@ class TimeSeriesShard:
             first_mem = int(store.ts[row, 0]) if cnt else MAX_TIME
             covered_down_to = min(floor, first_mem)
             if start_time_ms < covered_down_to:
-                hi = min(first_mem - 1, end_time_ms)
+                # non-empty rows page all the way up to the in-memory floor —
+                # NOT clamped to end_time_ms — so the resident region stays
+                # contiguous and paged_floor's "covered down to" claim holds;
+                # empty rows clamp to the query range (coverage tracked by
+                # paged_floor/paged_ceil as an interval)
+                hi = end_time_ms if cnt == 0 else first_mem - 1
                 if hi >= start_time_ms:
                     chunks = self.column_store.read_chunks(
                         self.dataset, self.shard_num, info.part_key,
                         start_time_ms, hi)
                     ts_all, cols_all = self._decode_paged_chunks(
-                        store, chunks, start_time_ms - 1, min(first_mem - 1, hi))
+                        store, chunks, start_time_ms - 1, hi)
                     if ts_all is not None:
                         n = store.prepend_row(row, ts_all, cols_all)
                         paged += n

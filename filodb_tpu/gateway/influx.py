@@ -1,0 +1,195 @@
+"""InfluxDB Line Protocol parser → record batches.
+
+Re-implements the gateway's wire-format front-end (ref:
+gateway/.../conversion/InfluxProtocolParser.scala:66-198,
+InfluxRecord.scala:88-260) with the same semantics:
+
+  - `measurement[,tag=v...] field=v[,field=v...] [timestamp_ns]`
+  - backslash escapes for comma/space/equals; quoted string field values;
+    `123i` integer suffix
+  - nanosecond timestamps truncated to ms by dropping the last 6 digits
+    (ref: InfluxProtocolParser.parseUnixTime)
+  - ONE field → Prom single-value record; the schema is prom-counter when the
+    field is named `counter`, else gauge (ref: InfluxPromSingleRecord:88-123)
+  - MANY fields → histogram: field keys are bucket `le` tops (`+Inf`/`inf`),
+    plus `sum` and `count`; the record is dropped unless a +Inf bucket exists
+    (ref: InfluxHistogramRecord + HistogramFieldVisitor:171-252)
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Dict, Iterable, List, Optional, Tuple
+
+import numpy as np
+
+from filodb_tpu.core.partkey import PartKey
+from filodb_tpu.core.records import RecordBatch, RecordBatchBuilder
+from filodb_tpu.core.schemas import Schemas, DEFAULT_SCHEMAS
+
+
+@dataclasses.dataclass
+class InfluxRecord:
+    measurement: str
+    tags: Dict[str, str]
+    fields: Dict[str, object]      # str values stay str; numbers are float
+    ts_ms: int
+
+
+def _split_escaped(s: str, delim: str, stoppers: str = "") -> List[str]:
+    """Split on `delim` honoring backslash escapes (one pass, like
+    ref parseInner which unescapes while delimiting)."""
+    out, cur, i = [], [], 0
+    while i < len(s):
+        ch = s[i]
+        if ch == "\\" and i + 1 < len(s):
+            cur.append(s[i + 1])
+            i += 2
+            continue
+        if ch == delim:
+            out.append("".join(cur))
+            cur = []
+            i += 1
+            continue
+        cur.append(ch)
+        i += 1
+    out.append("".join(cur))
+    return out
+
+
+def _split_top(s: str) -> List[str]:
+    """Split line into ≤3 space-separated sections, honoring escapes and
+    quoted strings."""
+    out, cur, i, in_quote = [], [], 0, False
+    while i < len(s):
+        ch = s[i]
+        if ch == "\\" and i + 1 < len(s) and not in_quote:
+            cur.append(s[i: i + 2])
+            i += 2
+            continue
+        if ch == '"':
+            in_quote = not in_quote
+            cur.append(ch)
+            i += 1
+            continue
+        if ch == " " and not in_quote:
+            out.append("".join(cur))
+            cur = []
+            i += 1
+            continue
+        cur.append(ch)
+        i += 1
+    out.append("".join(cur))
+    return [p for p in out if p != ""]
+
+
+def _parse_field_value(v: str):
+    if len(v) >= 2 and v[0] == '"' and v[-1] == '"':
+        return v[1:-1]
+    if not v:
+        return ""
+    body = v[:-1] if v[-1] in "iu" else v
+    if v[-1] in ("t", "T") or v in ("true", "false", "True", "False"):
+        return 1.0 if v.lower().startswith("t") else 0.0
+    try:
+        return float(body)
+    except ValueError:
+        return v
+
+
+def parse_influx_line(line: str, now_ms: Optional[int] = None) -> Optional[InfluxRecord]:
+    """Parse one line; returns None on malformed input (the reference logs and
+    skips, ref: InfluxProtocolParser.parse:127-170)."""
+    line = line.strip()
+    if not line or line.startswith("#"):
+        return None
+    sections = _split_top(line)
+    if len(sections) < 2:
+        return None
+    head = _split_escaped(sections[0], ",")
+    measurement = head[0]
+    if not measurement:
+        return None
+    tags: Dict[str, str] = {}
+    for kv in head[1:]:
+        parts = _split_escaped(kv, "=")
+        if len(parts) == 2 and parts[0]:
+            tags[parts[0]] = parts[1]
+    fields: Dict[str, object] = {}
+    for kv in _split_escaped(sections[1], ","):
+        parts = _split_escaped(kv, "=")
+        if len(parts) == 2 and parts[0]:
+            fields[parts[0]] = _parse_field_value(parts[1])
+    if not fields:
+        return None
+    if len(sections) >= 3:
+        ts_str = sections[2]
+        if len(ts_str) <= 6 or not ts_str.lstrip("-").isdigit():
+            return None
+        ts_ms = int(ts_str[:-6])        # ns → ms: drop last 6 digits
+    else:
+        ts_ms = now_ms if now_ms is not None else 0
+    return InfluxRecord(measurement, tags, fields, ts_ms)
+
+
+_SPECIAL_HIST_KEYS = ("sum", "count")
+
+
+def influx_lines_to_batches(lines: Iterable[str],
+                            schemas: Schemas = DEFAULT_SCHEMAS,
+                            now_ms: Optional[int] = None) -> List[RecordBatch]:
+    """Convert parsed lines into per-schema RecordBatches (the gateway's
+    InputRecord → RecordBuilder container step, ref: GatewayServer.scala:101-115)."""
+    builders: Dict[str, RecordBatchBuilder] = {}
+    hist_les: Optional[np.ndarray] = None
+
+    def builder(schema_name: str) -> RecordBatchBuilder:
+        b = builders.get(schema_name)
+        if b is None:
+            b = RecordBatchBuilder(schemas[schema_name])
+            builders[schema_name] = b
+        return b
+
+    for line in lines:
+        rec = parse_influx_line(line, now_ms)
+        if rec is None:
+            continue
+        numeric = {k: v for k, v in rec.fields.items() if isinstance(v, float)}
+        if not numeric:
+            continue
+        pk = PartKey.make(rec.measurement, rec.tags)
+        if len(rec.fields) == 1:
+            (fname, fval), = numeric.items()
+            schema_name = "prom-counter" if fname == "counter" else "gauge"
+            col = schemas[schema_name].data_columns[0].name
+            builder(schema_name).add(pk, rec.ts_ms, **{col: fval})
+        else:
+            # histogram: bucket tops + sum/count; +Inf required
+            buckets: List[Tuple[float, float]] = []
+            hsum = hcount = float("nan")
+            got_inf = False
+            for k, v in numeric.items():
+                if k == "sum":
+                    hsum = v
+                elif k == "count":
+                    hcount = v
+                else:
+                    try:
+                        top = (math.inf if k in ("+Inf", "inf", "Inf")
+                               else float(k))
+                    except ValueError:
+                        continue
+                    got_inf = got_inf or math.isinf(top)
+                    buckets.append((top, v))
+            if not got_inf or not buckets:
+                continue
+            buckets.sort(key=lambda bv: bv[0])
+            les = np.asarray([b[0] for b in buckets])
+            vals = np.asarray([b[1] for b in buckets])
+            b = builder("prom-histogram")
+            if b._les is None:
+                b.set_bucket_les(les)
+            elif len(b._les) != len(les) or not np.array_equal(b._les, les):
+                continue                # one scheme per batch; drop outliers
+            b.add(pk, rec.ts_ms, sum=hsum, count=hcount, h=vals)
+    return [b.build() for b in builders.values()]

@@ -1,0 +1,72 @@
+"""Gateway shard routing: batch → per-shard sub-batches → shard ingest.
+
+The reference gateway computes `shardMapper.ingestionShard(shardKeyHash,
+partitionHash, spread)` per record and publishes each container to its
+shard's Kafka partition (ref: gateway/.../GatewayServer.scala:101-115,
+coordinator/.../ShardMapper.scala:108-120).  Here routing produces per-shard
+RecordBatches handed to local shards or serialized for a remote transport.
+"""
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, Optional
+
+import numpy as np
+
+from filodb_tpu.core.records import RecordBatch
+from filodb_tpu.core.schemas import Schemas, DEFAULT_SCHEMAS
+from filodb_tpu.parallel.shardmapper import ShardMapper, SpreadProvider
+
+
+def split_batch_by_shard(batch: RecordBatch, mapper: ShardMapper,
+                         spread_provider: SpreadProvider) -> Dict[int, RecordBatch]:
+    """Route each record to its shard via the spread math
+    (ref: ShardMapper.ingestionShard:108-120)."""
+    if batch.num_records == 0:
+        return {}
+    shard_of_key = np.asarray([
+        mapper.ingestion_shard(
+            pk.shard_key_hash(), pk.partition_hash(),
+            spread_provider.spread_for(pk.shard_key()))
+        for pk in batch.part_keys])
+    out: Dict[int, RecordBatch] = {}
+    for s in np.unique(shard_of_key[batch.part_idx]).tolist():
+        keep = shard_of_key[batch.part_idx] == s
+        out[s] = RecordBatch(batch.schema, batch.part_keys,
+                             batch.part_idx[keep], batch.timestamps[keep],
+                             {k: v[keep] for k, v in batch.columns.items()},
+                             batch.bucket_les)
+    return out
+
+
+class GatewayPipeline:
+    """Influx lines → parsed batches → shard-routed ingest
+    (the GatewayServer data path minus the TCP listener, which lives in
+    filodb_tpu/http; ref: GatewayServer.scala:58-115)."""
+
+    def __init__(self, memstore, dataset: str, mapper: ShardMapper,
+                 spread_provider: Optional[SpreadProvider] = None,
+                 schemas: Schemas = DEFAULT_SCHEMAS):
+        self.memstore = memstore
+        self.dataset = dataset
+        self.mapper = mapper
+        self.spread = spread_provider or SpreadProvider(0)
+        self.schemas = schemas
+        self.lines_dropped = 0
+
+    def ingest_lines(self, lines: Iterable[str],
+                     now_ms: Optional[int] = None,
+                     offset: int = -1) -> int:
+        from filodb_tpu.gateway.influx import influx_lines_to_batches
+        lines = list(lines)
+        batches = influx_lines_to_batches(lines, self.schemas, now_ms)
+        n = 0
+        got = 0
+        for batch in batches:
+            got += batch.num_records
+            for shard_num, sub in split_batch_by_shard(
+                    batch, self.mapper, self.spread).items():
+                shard = self.memstore.get_shard(self.dataset, shard_num)
+                if shard is not None:
+                    n += shard.ingest(sub, offset)
+        self.lines_dropped += len(lines) - got
+        return n

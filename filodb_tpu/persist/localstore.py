@@ -52,8 +52,9 @@ def _write_frame(f, magic: int, payload: bytes) -> None:
     f.write(header + payload)
 
 
-def _iter_frames(path: str, magic: int) -> Iterator[bytes]:
-    """Yield payloads of valid frames; stop silently at a torn/corrupt tail."""
+def _iter_frames(path: str, magic: int) -> Iterator[Tuple[int, bytes]]:
+    """Yield (file_offset, payload) of valid frames; stop silently at a
+    torn/corrupt tail."""
     if not os.path.exists(path):
         return
     with open(path, "rb") as f:
@@ -67,7 +68,7 @@ def _iter_frames(path: str, magic: int) -> Iterator[bytes]:
         payload = data[off + 12: off + 12 + length]
         if (zlib.crc32(payload) & 0x7FFFFFFF) != crc:
             return
-        yield payload
+        yield off, payload
         off += 12 + length
 
 
@@ -128,6 +129,35 @@ def _encode_pk_frame(r: PartKeyRecord) -> bytes:
             + struct.pack("<qq", r.start_time_ms, r.end_time_ms))
 
 
+def _peek_chunk_meta(data: bytes) -> Tuple[bytes, str, int, int, int, int]:
+    """Parse only the frame header: (pk_bytes, schema_name, start_ms, end_ms,
+    ingestion_ms, num_rows) — no column payload decode."""
+    off = 0
+    (pk_len,) = struct.unpack_from("<H", data, off); off += 2
+    pk_bytes = data[off: off + pk_len]; off += pk_len
+    (sn_len,) = struct.unpack_from("<H", data, off); off += 2
+    schema_name = data[off: off + sn_len].decode(); off += sn_len
+    _, ing_ms, num_rows, start_ms, end_ms, _ = struct.unpack_from(
+        "<qqiqqH", data, off)
+    return pk_bytes, schema_name, start_ms, end_ms, ing_ms, num_rows
+
+
+def _read_frame_at(path: str, offset: int, magic: int) -> Optional[bytes]:
+    """Read + CRC-check one frame at a known offset."""
+    with open(path, "rb") as f:
+        f.seek(offset)
+        header = f.read(12)
+        if len(header) < 12:
+            return None
+        m, length, crc = struct.unpack("<IIi", header)
+        if m != magic:
+            return None
+        payload = f.read(length)
+    if len(payload) < length or (zlib.crc32(payload) & 0x7FFFFFFF) != crc:
+        return None
+    return payload
+
+
 def _decode_pk_frame(data: bytes) -> PartKeyRecord:
     off = 0
     (pk_len,) = struct.unpack_from("<H", data, off); off += 2
@@ -140,19 +170,38 @@ def _decode_pk_frame(data: bytes) -> PartKeyRecord:
 
 # -------------------------------------------------------------------- stores
 
+class _FrameRef:
+    """Index entry: where a chunk frame lives + the metadata needed to filter
+    reads without decoding (start/end/ingestion time)."""
+    __slots__ = ("offset", "start_ms", "end_ms", "ingestion_ms", "schema_name",
+                 "num_rows")
+
+    def __init__(self, offset, start_ms, end_ms, ingestion_ms, schema_name,
+                 num_rows):
+        self.offset = offset
+        self.start_ms = start_ms
+        self.end_ms = end_ms
+        self.ingestion_ms = ingestion_ms
+        self.schema_name = schema_name
+        self.num_rows = num_rows
+
+
 class LocalDiskColumnStore(ColumnStore):
     """Append-only chunk + partkey logs per shard.
 
-    An in-memory index (partKey bytes -> frame offsets) is built lazily per
-    shard by one sequential scan on first read; appends keep it current.  This
-    is the local-disk stand-in for Cassandra's clustering-key lookups.
+    The in-memory index maps partKey bytes -> frame offsets + time metadata
+    (NOT decoded chunks — a disk tier that pinned every chunk it ever read
+    would defeat the memstore's eviction); reads seek + decode on demand.
+    Built lazily per shard by one sequential scan on first use; appends keep
+    it current.  This is the local-disk stand-in for Cassandra's
+    clustering-key lookups.
     """
 
     def __init__(self, root: str):
         self.root = root
         self._lock = threading.Lock()
-        # (dataset, shard) -> partKey bytes -> List[ChunkSet]
-        self._chunk_idx: Dict[Tuple[str, int], Dict[bytes, List[Tuple[str, ChunkSet]]]] = {}
+        # (dataset, shard) -> partKey bytes -> List[_FrameRef]
+        self._chunk_idx: Dict[Tuple[str, int], Dict[bytes, List[_FrameRef]]] = {}
         self._pk_idx: Dict[Tuple[str, int], Dict[bytes, PartKeyRecord]] = {}
         self._files: Dict[str, object] = {}
 
@@ -170,29 +219,43 @@ class LocalDiskColumnStore(ColumnStore):
         for s in range(num_shards):
             os.makedirs(self._shard_dir(dataset, s), exist_ok=True)
 
-    def _append(self, path: str, magic: int, payload: bytes) -> None:
+    def _append(self, path: str, magic: int, payload: bytes) -> int:
+        """Append one frame; returns the frame's file offset."""
         os.makedirs(os.path.dirname(path), exist_ok=True)
         f = self._files.get(path)
         if f is None:
             f = open(path, "ab")
+            f.seek(0, os.SEEK_END)   # 'a' mode position is unspecified pre-write
             self._files[path] = f
+        offset = f.tell()
         _write_frame(f, magic, payload)
         f.flush()
+        return offset
 
     def _load_shard(self, dataset: str, shard: int) -> None:
         key = (dataset, shard)
         if key in self._chunk_idx:
             return
-        chunks: Dict[bytes, List[Tuple[str, ChunkSet]]] = {}
-        for payload in _iter_frames(self._chunk_path(dataset, shard), _MAGIC_CHUNK):
-            pk_bytes, schema_name, cs = _decode_chunkset_frame(payload)
-            chunks.setdefault(pk_bytes, []).append((schema_name, cs))
+        chunks: Dict[bytes, List[_FrameRef]] = {}
+        for offset, payload in _iter_frames(self._chunk_path(dataset, shard),
+                                            _MAGIC_CHUNK):
+            pk_bytes, sn, start_ms, end_ms, ing_ms, nrows = _peek_chunk_meta(payload)
+            chunks.setdefault(pk_bytes, []).append(
+                _FrameRef(offset, start_ms, end_ms, ing_ms, sn, nrows))
         pks: Dict[bytes, PartKeyRecord] = {}
-        for payload in _iter_frames(self._pk_path(dataset, shard), _MAGIC_PK):
+        for _, payload in _iter_frames(self._pk_path(dataset, shard), _MAGIC_PK):
             r = _decode_pk_frame(payload)
             pks[r.part_key.to_bytes()] = r        # last write wins
         self._chunk_idx[key] = chunks
         self._pk_idx[key] = pks
+
+    def _fetch(self, dataset: str, shard: int, ref: _FrameRef) -> Optional[ChunkSet]:
+        payload = _read_frame_at(self._chunk_path(dataset, shard), ref.offset,
+                                 _MAGIC_CHUNK)
+        if payload is None:
+            return None
+        _, _, cs = _decode_chunkset_frame(payload)
+        return cs
 
     # -- ColumnStore API
     def write_chunks(self, dataset, shard, part_key, chunksets, schema_name) -> None:
@@ -202,9 +265,13 @@ class LocalDiskColumnStore(ColumnStore):
             pk_bytes = part_key.to_bytes()
             bucket = self._chunk_idx[(dataset, shard)].setdefault(pk_bytes, [])
             for cs in chunksets:
-                self._append(path, _MAGIC_CHUNK,
-                             _encode_chunkset_frame(part_key, schema_name, cs))
-                bucket.append((schema_name, cs))
+                offset = self._append(
+                    path, _MAGIC_CHUNK,
+                    _encode_chunkset_frame(part_key, schema_name, cs))
+                bucket.append(_FrameRef(offset, cs.info.start_time_ms,
+                                        cs.info.end_time_ms,
+                                        cs.info.ingestion_time_ms,
+                                        schema_name, cs.info.num_rows))
 
     def write_part_keys(self, dataset, shard, records) -> None:
         with self._lock:
@@ -223,10 +290,13 @@ class LocalDiskColumnStore(ColumnStore):
     def read_chunks(self, dataset, shard, part_key, start_time_ms, end_time_ms):
         with self._lock:
             self._load_shard(dataset, shard)
+            refs = [r for r in self._chunk_idx[(dataset, shard)].get(
+                        part_key.to_bytes(), [])
+                    if r.start_ms <= end_time_ms and r.end_ms >= start_time_ms]
             out = []
-            for _, cs in self._chunk_idx[(dataset, shard)].get(part_key.to_bytes(), []):
-                if (cs.info.start_time_ms <= end_time_ms
-                        and cs.info.end_time_ms >= start_time_ms):
+            for ref in refs:
+                cs = self._fetch(dataset, shard, ref)
+                if cs is not None:
                     out.append(cs)
             return out
 
@@ -239,12 +309,15 @@ class LocalDiskColumnStore(ColumnStore):
         chunks by ingestion-time window)."""
         with self._lock:
             self._load_shard(dataset, shard)
-            items = [(pk_bytes, sn, cs)
+            items = [(pk_bytes, ref)
                      for pk_bytes, lst in self._chunk_idx[(dataset, shard)].items()
-                     for sn, cs in lst
-                     if ingestion_start_ms <= cs.info.ingestion_time_ms < ingestion_end_ms]
-        for pk_bytes, sn, cs in items:
-            yield PartKey.from_bytes(pk_bytes), sn, cs
+                     for ref in lst
+                     if ingestion_start_ms <= ref.ingestion_ms < ingestion_end_ms]
+        for pk_bytes, ref in items:
+            with self._lock:
+                cs = self._fetch(dataset, shard, ref)
+            if cs is not None:
+                yield PartKey.from_bytes(pk_bytes), ref.schema_name, cs
 
     def num_chunksets(self, dataset: str, shard: int) -> int:
         with self._lock:

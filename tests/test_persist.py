@@ -251,6 +251,33 @@ def test_odp_clamps_to_query_range(tmp_path):
     assert counts.tolist() == [60, 60]
 
 
+def test_odp_live_row_narrow_then_wide_query(tmp_path):
+    """A narrow historical query on a LIVE row must not poison coverage for a
+    later wider query: lower paging always reaches the in-memory floor so the
+    resident region stays contiguous."""
+    cs = LocalDiskColumnStore(str(tmp_path))
+    ms = TimeSeriesMemStore(column_store=cs,
+                            meta_store=LocalDiskMetaStore(str(tmp_path)))
+    shard = ms.setup("p", 0)
+    start_ms = 1_000_000
+    stream = list(batch_stream(gauge_batch(1, 60, start_ms=start_ms),
+                               samples_per_chunk=20))
+    for b, off in stream:
+        shard.ingest(b, off)
+    shard.flush_all_groups()
+    store = shard.stores["gauge"]
+    store.evict_oldest(30)                 # first 30 samples now disk-only
+    parts = shard.lookup_partitions([], 0, 10**15).parts_by_schema["gauge"]
+    # narrow query over just the first 10 evicted samples
+    shard.ensure_paged(parts, start_ms, start_ms + 9 * 10_000)
+    # wide query over everything: all 60 samples must be resident
+    shard.ensure_paged(parts, start_ms, 10**15)
+    _, _, counts, _ = shard.gather_series(parts)
+    assert counts.tolist() == [60]
+    ts_row = store.ts[parts[0].row, :60]
+    assert (np.diff(ts_row) == 10_000).all()   # contiguous, no gaps
+
+
 def test_odp_eviction_invalidates_coverage(tmp_path):
     """If paged-in history is evicted, the coverage cache must not claim it is
     still resident — a repeat query re-pages from disk."""
