@@ -88,9 +88,11 @@ def _parse_field_value(v: str):
         return v[1:-1]
     if not v:
         return ""
+    if v.lower() in ("t", "true"):
+        return 1.0
+    if v.lower() in ("f", "false"):
+        return 0.0
     body = v[:-1] if v[-1] in "iu" else v
-    if v[-1] in ("t", "T") or v in ("true", "false", "True", "False"):
-        return 1.0 if v.lower().startswith("t") else 0.0
     try:
         return float(body)
     except ValueError:
@@ -158,7 +160,7 @@ def influx_lines_to_batches(lines: Iterable[str],
         if not numeric:
             continue
         pk = PartKey.make(rec.measurement, rec.tags)
-        if len(rec.fields) == 1:
+        if len(numeric) == 1:
             (fname, fval), = numeric.items()
             schema_name = "prom-counter" if fname == "counter" else "gauge"
             col = schemas[schema_name].data_columns[0].name

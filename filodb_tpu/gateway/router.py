@@ -28,9 +28,10 @@ def split_batch_by_shard(batch: RecordBatch, mapper: ShardMapper,
             pk.shard_key_hash(), pk.partition_hash(),
             spread_provider.spread_for(pk.shard_key()))
         for pk in batch.part_keys])
+    rec_shards = shard_of_key[batch.part_idx]
     out: Dict[int, RecordBatch] = {}
-    for s in np.unique(shard_of_key[batch.part_idx]).tolist():
-        keep = shard_of_key[batch.part_idx] == s
+    for s in np.unique(rec_shards).tolist():
+        keep = rec_shards == s
         out[s] = RecordBatch(batch.schema, batch.part_keys,
                              batch.part_idx[keep], batch.timestamps[keep],
                              {k: v[keep] for k, v in batch.columns.items()},

@@ -1,0 +1,337 @@
+"""LogicalPlan utilities: time-range math, plan rewriting, plan→PromQL.
+
+Mirrors the reference's planner helpers:
+  - time range + copy-with-time-range:
+    ref: coordinator/.../queryplanner/LogicalPlanUtils.scala:230 (splitPlans,
+    getTimeFromLogicalPlan, copyLogicalPlanWithUpdatedTimeRange)
+  - plan → PromQL string (for shipping subqueries to remote clusters):
+    ref: coordinator/.../queryplanner/LogicalPlanParser.scala (convertToQuery)
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Sequence, Tuple
+
+from filodb_tpu.core.index import (ColumnFilter, Equals, EqualsRegex, In,
+                                   NotEquals, NotEqualsRegex, NotIn, Prefix)
+from filodb_tpu.query import logical as lp
+
+
+@dataclasses.dataclass(frozen=True)
+class TimeRange:
+    start_ms: int
+    end_ms: int
+
+
+# --------------------------------------------------------------- time range
+
+def get_time_range(plan: lp.LogicalPlan) -> TimeRange:
+    """ref: LogicalPlanUtils.getTimeFromLogicalPlan."""
+    if isinstance(plan, lp.PeriodicSeriesPlan):
+        return TimeRange(plan.start_ms, plan.end_ms)
+    if isinstance(plan, lp.RawSeries):
+        return TimeRange(plan.range_selector.from_ms, plan.range_selector.to_ms)
+    raise ValueError(f"no time range on {type(plan).__name__}")
+
+
+def get_lookback_ms(plan: lp.LogicalPlan, default_ms: int) -> int:
+    """Largest raw-data reach-back of any selector in the plan: window for
+    range functions, staleness lookback otherwise
+    (ref: LogicalPlanUtils.getLookBackMillis)."""
+    out = [0]
+
+    def walk(p):
+        if isinstance(p, lp.PeriodicSeriesWithWindowing):
+            out.append(p.window_ms)
+            walk(p.series)
+        elif isinstance(p, lp.PeriodicSeries):
+            out.append(p.raw_series.lookback_ms or default_ms)
+        elif isinstance(p, (lp.SubqueryWithWindowing,)):
+            out.append(p.subquery_window_ms +
+                       get_lookback_ms(p.inner, default_ms))
+        elif isinstance(p, lp.TopLevelSubquery):
+            walk(p.inner)
+        elif dataclasses.is_dataclass(p):
+            for f in dataclasses.fields(p):
+                v = getattr(p, f.name)
+                if isinstance(v, lp.LogicalPlan):
+                    walk(v)
+        return
+    walk(plan)
+    return max(out)
+
+
+def get_offset_ms(plan: lp.LogicalPlan) -> int:
+    """Largest selector offset in the plan
+    (ref: LogicalPlanUtils.getOffsetMillis)."""
+    out = [0]
+
+    def walk(p):
+        if dataclasses.is_dataclass(p):
+            off = getattr(p, "offset_ms", None)
+            if off:
+                out.append(off)
+            for f in dataclasses.fields(p):
+                v = getattr(p, f.name)
+                if isinstance(v, lp.LogicalPlan):
+                    walk(v)
+    walk(plan)
+    return max(out)
+
+
+def copy_with_time_range(plan: lp.LogicalPlan, tr: TimeRange) -> lp.LogicalPlan:
+    """Rewrite every start/end (and nested RawSeries interval) to `tr`
+    (ref: LogicalPlanUtils.copyLogicalPlanWithUpdatedTimeRange /
+    copyWithUpdatedTimeRange)."""
+    return _copy_tr(plan, tr)
+
+
+def _copy_tr(p, tr: TimeRange):
+    if isinstance(p, lp.RawSeries):
+        return dataclasses.replace(
+            p, range_selector=lp.IntervalSelector(tr.start_ms, tr.end_ms))
+    if isinstance(p, lp.PeriodicSeries):
+        raw = _copy_tr(p.raw_series, tr)
+        return dataclasses.replace(p, raw_series=raw, start_ms=tr.start_ms,
+                                   end_ms=tr.end_ms)
+    if isinstance(p, lp.PeriodicSeriesWithWindowing):
+        raw = _copy_tr(p.series,
+                       TimeRange(tr.start_ms - p.window_ms, tr.end_ms))
+        return dataclasses.replace(p, series=raw, start_ms=tr.start_ms,
+                                   end_ms=tr.end_ms)
+    if isinstance(p, (lp.SubqueryWithWindowing, lp.TopLevelSubquery)):
+        # inner grids are anchored to the outer range; recompute conservatively
+        win = getattr(p, "subquery_window_ms", 0)
+        off = p.offset_ms or 0
+        inner = _copy_tr(p.inner,
+                         TimeRange(tr.start_ms - win - off, tr.end_ms - off))
+        return dataclasses.replace(p, inner=inner, start_ms=tr.start_ms,
+                                   end_ms=tr.end_ms)
+    if isinstance(p, lp.ScalarVaryingDoublePlan):
+        return dataclasses.replace(p, vectors=_copy_tr(p.vectors, tr))
+    if isinstance(p, lp.VectorPlan):
+        return dataclasses.replace(p, scalars=_copy_tr(p.scalars, tr))
+    if isinstance(p, lp.ScalarBinaryOperation):
+        lhs = _copy_tr(p.lhs, tr) if isinstance(p.lhs, lp.LogicalPlan) else p.lhs
+        rhs = _copy_tr(p.rhs, tr) if isinstance(p.rhs, lp.LogicalPlan) else p.rhs
+        return dataclasses.replace(p, lhs=lhs, rhs=rhs, start_ms=tr.start_ms,
+                                   end_ms=tr.end_ms)
+    if dataclasses.is_dataclass(p) and isinstance(p, lp.LogicalPlan):
+        updates = {}
+        for f in dataclasses.fields(p):
+            v = getattr(p, f.name)
+            if isinstance(v, lp.LogicalPlan):
+                updates[f.name] = _copy_tr(v, tr)
+        for name in ("start_ms", "end_ms"):
+            if any(f.name == name for f in dataclasses.fields(p)):
+                updates[name] = tr.start_ms if name == "start_ms" else tr.end_ms
+        return dataclasses.replace(p, **updates) if updates else p
+    return p
+
+
+def split_plans(plan: lp.PeriodicSeriesPlan,
+                split_size_ms: int) -> List[lp.PeriodicSeriesPlan]:
+    """Split a long periodic plan into sequential time slices on the step
+    grid (ref: LogicalPlanUtils.splitPlans:230)."""
+    start, step, end = plan.start_ms, plan.step_ms, plan.end_ms
+    if end - start <= split_size_ms:
+        return [plan]
+    out = []
+    s = start
+    while s <= end:
+        e = min(s + split_size_ms, end)
+        # snap the slice end onto the step grid
+        e = s + ((e - s) // step) * step if e < end else end
+        out.append(copy_with_time_range(plan, TimeRange(s, e)))
+        if e >= end:
+            break
+        s = e + step
+    return out
+
+
+# --------------------------------------------------------------- filters
+
+def get_raw_series_filters(plan: lp.LogicalPlan) -> List[Tuple[ColumnFilter, ...]]:
+    """All RawSeries filter groups in the plan
+    (ref: LogicalPlan.getRawSeriesFilters)."""
+    out: List[Tuple[ColumnFilter, ...]] = []
+
+    def walk(p):
+        if isinstance(p, lp.RawSeries):
+            out.append(p.filters)
+        elif dataclasses.is_dataclass(p):
+            for f in dataclasses.fields(p):
+                v = getattr(p, f.name)
+                if isinstance(v, lp.LogicalPlan):
+                    walk(v)
+    walk(plan)
+    return out
+
+
+def rewrite_filters(plan: lp.LogicalPlan,
+                    replace: Sequence[ColumnFilter]) -> lp.LogicalPlan:
+    """Replace same-column filters on every RawSeries / metadata plan
+    (ref: ShardKeyRegexPlanner's generateExec filter rewriting)."""
+    cols = {f.column: f for f in replace}
+
+    def walk(p):
+        if isinstance(p, lp.RawSeries):
+            newf = tuple(cols.get(f.column, f) for f in p.filters)
+            # add filters for columns not present at all
+            present = {f.column for f in newf}
+            newf += tuple(f for c, f in cols.items() if c not in present)
+            return dataclasses.replace(p, filters=newf)
+        if dataclasses.is_dataclass(p) and isinstance(p, lp.LogicalPlan):
+            updates = {}
+            for f in dataclasses.fields(p):
+                v = getattr(p, f.name)
+                if isinstance(v, lp.LogicalPlan):
+                    updates[f.name] = walk(v)
+            return dataclasses.replace(p, **updates) if updates else p
+        return p
+    return walk(plan)
+
+
+# --------------------------------------------------------- plan → PromQL
+
+def _matchers(filters: Sequence[ColumnFilter]) -> Tuple[str, List[str]]:
+    """Returns (metric_name, label matcher strings)."""
+    metric = ""
+    out: List[str] = []
+    for f in filters:
+        if f.column in ("_metric_", "__name__") and isinstance(f, Equals):
+            metric = f.value
+            continue
+        if isinstance(f, Equals):
+            out.append(f'{f.column}="{f.value}"')
+        elif isinstance(f, NotEquals):
+            out.append(f'{f.column}!="{f.value}"')
+        elif isinstance(f, EqualsRegex):
+            out.append(f'{f.column}=~"{f.pattern}"')
+        elif isinstance(f, NotEqualsRegex):
+            out.append(f'{f.column}!~"{f.pattern}"')
+        elif isinstance(f, In):
+            out.append(f'{f.column}=~"{"|".join(sorted(f.values))}"')
+        elif isinstance(f, NotIn):
+            out.append(f'{f.column}!~"{"|".join(sorted(f.values))}"')
+        elif isinstance(f, Prefix):
+            out.append(f'{f.column}=~"{f.prefix}.*"')
+        else:
+            raise ValueError(f"cannot unparse filter {f}")
+    return metric, out
+
+
+def _selector(raw: lp.RawSeries, window_ms: Optional[int] = None,
+              offset_ms: Optional[int] = None) -> str:
+    metric, ms = _matchers(raw.filters)
+    col = f"::{raw.columns[0]}" if raw.columns else ""
+    s = metric + col + ("{" + ",".join(ms) + "}" if ms or not metric else "")
+    if window_ms:
+        s += f"[{_dur(window_ms)}]"
+    off = offset_ms if offset_ms is not None else raw.offset_ms
+    if off:
+        s += f" offset {_dur(off)}"
+    return s
+
+
+def _dur(ms: int) -> str:
+    for unit, span in (("d", 86_400_000), ("h", 3_600_000), ("m", 60_000),
+                       ("s", 1000)):
+        if ms % span == 0 and ms >= span:
+            return f"{ms // span}{unit}"
+    return f"{ms}ms"
+
+
+def unparse(plan: lp.LogicalPlan) -> str:
+    """LogicalPlan → PromQL string (ref: LogicalPlanParser.convertToQuery).
+    Used by remote execs (HA / multi-partition routing) and by planner tests
+    as a round-trip regression net."""
+    u = unparse
+    if isinstance(plan, lp.PeriodicSeries):
+        return _selector(plan.raw_series, offset_ms=plan.offset_ms)
+    if isinstance(plan, lp.PeriodicSeriesWithWindowing):
+        inner = _selector(plan.series, window_ms=plan.window_ms,
+                          offset_ms=plan.offset_ms)
+        args = [_num_str(a) for a in plan.function_args]
+        return f"{plan.function}({','.join(args + [inner])})"
+    if isinstance(plan, lp.Aggregate):
+        clause = ""
+        if plan.by:
+            clause = f" by ({','.join(plan.by)})"
+        elif plan.without:
+            clause = f" without ({','.join(plan.without)})"
+        args = [_num_str(a) if not isinstance(a, str) else f'"{a}"'
+                for a in plan.params]
+        return (f"{plan.operator}{clause}"
+                f"({','.join(args + [u(plan.vectors)])})")
+    if isinstance(plan, lp.BinaryJoin):
+        op = plan.operator
+        boolmod = ""
+        if op.endswith("_bool"):
+            op, boolmod = op[:-5], " bool"
+        match = ""
+        if plan.on is not None:
+            match = f" on ({','.join(plan.on)})"
+        elif plan.ignoring:
+            match = f" ignoring ({','.join(plan.ignoring)})"
+        grp = ""
+        if plan.cardinality == "ManyToOne":
+            grp = f" group_left ({','.join(plan.include)})"
+        elif plan.cardinality == "OneToMany":
+            grp = f" group_right ({','.join(plan.include)})"
+        return f"({u(plan.lhs)} {op}{boolmod}{match}{grp} {u(plan.rhs)})"
+    if isinstance(plan, lp.ScalarVectorBinaryOperation):
+        op = plan.operator
+        boolmod = ""
+        if op.endswith("_bool"):
+            op, boolmod = op[:-5], " bool"
+        s, v = u(plan.scalar_arg), u(plan.vector)
+        lhs, rhs = (s, v) if plan.scalar_is_lhs else (v, s)
+        return f"({lhs} {op}{boolmod} {rhs})"
+    if isinstance(plan, lp.ApplyInstantFunction):
+        args = [_num_str(a) if isinstance(a, (int, float)) else u(a)
+                for a in plan.function_args]
+        return f"{plan.function}({','.join([u(plan.vectors)] + args)})"
+    if isinstance(plan, lp.ApplyMiscellaneousFunction):
+        args = [f'"{a}"' for a in plan.string_args]
+        return f"{plan.function}({','.join([u(plan.vectors)] + args)})"
+    if isinstance(plan, lp.ApplySortFunction):
+        return f"{plan.function}({u(plan.vectors)})"
+    if isinstance(plan, lp.ApplyAbsentFunction):
+        return f"absent({u(plan.vectors)})"
+    if isinstance(plan, lp.ApplyLimitFunction):
+        return f"limitk({plan.limit},{u(plan.vectors)})"
+    if isinstance(plan, lp.ScalarFixedDoublePlan):
+        return _num_str(plan.scalar)
+    if isinstance(plan, lp.ScalarTimeBasedPlan):
+        return f"{plan.function}()"
+    if isinstance(plan, lp.ScalarVaryingDoublePlan):
+        return f"scalar({u(plan.vectors)})"
+    if isinstance(plan, lp.ScalarBinaryOperation):
+        lhs = u(plan.lhs) if isinstance(plan.lhs, lp.LogicalPlan) \
+            else _num_str(plan.lhs)
+        rhs = u(plan.rhs) if isinstance(plan.rhs, lp.LogicalPlan) \
+            else _num_str(plan.rhs)
+        return f"({lhs} {plan.operator} {rhs})"
+    if isinstance(plan, lp.VectorPlan):
+        return f"vector({u(plan.scalars)})"
+    if isinstance(plan, lp.TopLevelSubquery):
+        step = plan.inner.step_ms
+        win = plan.end_ms - plan.start_ms
+        off = f" offset {_dur(plan.offset_ms)}" if plan.offset_ms else ""
+        return f"({u(plan.inner)})[{_dur(win)}:{_dur(step)}]{off}"
+    if isinstance(plan, lp.SubqueryWithWindowing):
+        off = f" offset {_dur(plan.offset_ms)}" if plan.offset_ms else ""
+        sq = (f"({u(plan.inner)})"
+              f"[{_dur(plan.subquery_window_ms)}:{_dur(plan.subquery_step_ms)}]"
+              f"{off}")
+        args = [_num_str(a) for a in plan.function_args]
+        return f"{plan.function}({','.join(args + [sq])})"
+    if isinstance(plan, lp.RawSeries):
+        return _selector(plan)
+    raise ValueError(f"cannot unparse {type(plan).__name__}")
+
+
+def _num_str(x: float) -> str:
+    xf = float(x)
+    return str(int(xf)) if xf == int(xf) else repr(xf)
