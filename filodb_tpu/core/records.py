@@ -131,6 +131,21 @@ class RecordBatchBuilder:
         for c in self.schema.data_columns:
             self._cols[c.name].append(values[c.name])
 
+    def add_rows(self, part_key: PartKey, ts_ms: np.ndarray,
+                 columns: Dict[str, np.ndarray]) -> None:
+        """Bulk append many samples of one series (flush-path fast lane:
+        columns arrive as whole arrays, no per-row Python dispatch)."""
+        idx = self._keys.get(part_key)
+        if idx is None:
+            idx = len(self._part_keys)
+            self._keys[part_key] = idx
+            self._part_keys.append(part_key)
+        n = len(ts_ms)
+        self._part_idx.extend([idx] * n)
+        self._ts.extend(np.asarray(ts_ms).tolist())
+        for c in self.schema.data_columns:
+            self._cols[c.name].extend(np.asarray(columns[c.name]))
+
     def set_bucket_les(self, les: Sequence[float]) -> None:
         self._les = np.asarray(les, dtype=np.float64)
 

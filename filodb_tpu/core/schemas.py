@@ -113,6 +113,22 @@ DS_GAUGE = _mk("ds-gauge",
                "avg")
 
 
+# Range-function → (ds-gauge column, substituted function) for queries that
+# land on downsampled gauge data (ref: the reference's downsample-aware
+# range-function substitution in MultiSchemaPartitionsExec / doc/downsampling.md).
+# count_over_time must SUM the per-period counts; avg_over_time over the avg
+# column is exact only for uniform period counts (the common case).
+DS_GAUGE_FN_SUBSTITUTION = {
+    "min_over_time": ("min", "min_over_time"),
+    "max_over_time": ("max", "max_over_time"),
+    "sum_over_time": ("sum", "sum_over_time"),
+    "count_over_time": ("count", "sum_over_time"),
+    "avg_over_time": ("avg", "avg_over_time"),
+    "last_over_time": ("avg", "last_over_time"),
+    None: ("avg", None),
+}
+
+
 class Schemas:
     """Registry of schemas keyed by name and 16-bit id (ref: Schemas.scala:464 area)."""
 

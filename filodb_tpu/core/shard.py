@@ -80,6 +80,9 @@ class TimeSeriesShard:
         self.ingested_offset = -1                   # latest ingest offset seen
         self._groups = self.config.store.groups_per_shard
         self._dirty_part_keys: set = set()          # partIds needing pk upsert
+        # optional streaming downsampler fed at flush (ref:
+        # ShardDownsampler.scala:103 populateDownsampleRecords at doFlushSteps)
+        self.shard_downsampler = None
 
     # ------------------------------------------------------------------ ingest
 
@@ -167,6 +170,10 @@ class TimeSeriesShard:
             self.column_store.write_chunks(
                 self.dataset, self.shard_num, info.part_key, [cs],
                 info.schema_name)
+            if self.shard_downsampler is not None:
+                self.shard_downsampler.downsample(
+                    info.part_key, schema, ts, cols,
+                    bucket_les=store.bucket_les)
             store.mark_sealed(info.row, hi)
             written += 1
             dirty_pids.add(info.part_id)

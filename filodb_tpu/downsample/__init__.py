@@ -1,0 +1,22 @@
+"""Downsample runtime: chunk downsamplers, streaming shard downsampler,
+query-only downsampled store, and the batch rollup job
+(maps ref: core/.../downsample/ + spark-jobs/.../downsampler/)."""
+from filodb_tpu.downsample.downsamplers import (DownsamplerSpec,
+                                                downsample_chunk,
+                                                downsample_column,
+                                                parse_period_marker,
+                                                period_boundaries)
+from filodb_tpu.downsample.shard_downsampler import (DEFAULT_RESOLUTIONS,
+                                                     ShardDownsampler)
+from filodb_tpu.downsample.store import (DownsampleClusterPlanner,
+                                         DownsampledTimeSeriesStore,
+                                         ds_dataset_name)
+from filodb_tpu.downsample.batch_job import DownsamplerJob, DownsampleJobStats
+
+__all__ = [
+    "DownsamplerSpec", "downsample_chunk", "downsample_column",
+    "parse_period_marker", "period_boundaries", "ShardDownsampler",
+    "DEFAULT_RESOLUTIONS", "DownsampledTimeSeriesStore",
+    "DownsampleClusterPlanner", "ds_dataset_name", "DownsamplerJob",
+    "DownsampleJobStats",
+]

@@ -1,0 +1,118 @@
+"""Batch downsampler job — rolls persisted raw chunks into the downsample
+datasets.
+
+ref: spark-jobs/.../downsampler/chunk/DownsamplerMain.scala:14-53 +
+BatchDownsampler.scala:399 — a periodic batch job that reads raw chunks
+whose userTime falls in the job window, downsamples them with the same
+ChunkDownsampler algorithms the streaming path uses, and writes
+downsample-keyspace chunks; DSIndexJobMain copies part-key updates.
+
+The TPU-native job shares `downsample_chunk` with the streaming
+ShardDownsampler, and writes through the stock chunk encoder — no Spark:
+shards are an embarrassingly parallel loop (the driver can fan them out
+over processes or hosts).
+"""
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from filodb_tpu.core.partkey import PartKey
+from filodb_tpu.core.schemas import Schemas, DEFAULT_SCHEMAS
+from filodb_tpu.core.store import ColumnStore, PartKeyRecord
+from filodb_tpu.downsample.downsamplers import downsample_chunk
+from filodb_tpu.downsample.shard_downsampler import DEFAULT_RESOLUTIONS
+from filodb_tpu.downsample.store import ds_dataset_name
+from filodb_tpu.memory.chunks import decode_chunkset, encode_chunkset
+
+
+@dataclasses.dataclass
+class DownsampleJobStats:
+    parts_scanned: int = 0
+    chunks_read: int = 0
+    records_emitted: int = 0
+    chunks_written: int = 0
+
+
+class DownsamplerJob:
+    """One run downsamples `[user_time_start, user_time_end)` for a set of
+    shards (ref: DownsamplerMain.run window math — the driver schedules runs
+    every N hours with a widened ingestion-time scan)."""
+
+    def __init__(self, raw_store: ColumnStore, ds_store: ColumnStore,
+                 dataset: str, schemas: Schemas = DEFAULT_SCHEMAS,
+                 resolutions: Sequence[int] = DEFAULT_RESOLUTIONS):
+        self.raw_store = raw_store
+        self.ds_store = ds_store
+        self.dataset = dataset
+        self.schemas = schemas
+        self.resolutions = tuple(resolutions)
+
+    def run(self, shards: Sequence[int], user_time_start: int,
+            user_time_end: int) -> DownsampleJobStats:
+        stats = DownsampleJobStats()
+        for shard in shards:
+            self._run_shard(shard, user_time_start, user_time_end, stats)
+        return stats
+
+    def _run_shard(self, shard: int, t0: int, t1: int,
+                   stats: DownsampleJobStats) -> None:
+        now = int(time.time() * 1000)
+        pk_records = self.raw_store.read_part_keys(self.dataset, shard)
+        ds_pk_updates: Dict[int, List[PartKeyRecord]] = {
+            r: [] for r in self.resolutions}
+        for rec in pk_records:
+            schema = self.schemas[rec.schema_name]
+            if not schema.downsamplers or schema.downsample_schema is None:
+                continue
+            if rec.start_time_ms >= t1 or rec.end_time_ms < t0:
+                continue
+            stats.parts_scanned += 1
+            chunks = self.raw_store.read_chunks(self.dataset, shard,
+                                                rec.part_key, t0, t1 - 1)
+            per_res: Dict[int, Dict[str, List[np.ndarray]]] = {}
+            for cs in chunks:
+                stats.chunks_read += 1
+                decoded = decode_chunkset(cs)
+                ts = decoded.pop("timestamp")
+                keep = (ts >= t0) & (ts < t1)
+                if not keep.all():
+                    ts = ts[keep]
+                    decoded = {k: v[keep] for k, v in decoded.items()}
+                if len(ts) == 0:
+                    continue
+                for res in self.resolutions:
+                    out_ts, out_cols = downsample_chunk(schema, ts, decoded,
+                                                        res)
+                    if len(out_ts) == 0:
+                        continue
+                    acc = per_res.setdefault(res, {"timestamp": []})
+                    acc["timestamp"].append(out_ts)
+                    for name, vals in out_cols.items():
+                        acc.setdefault(name, []).append(vals)
+                    stats.records_emitted += len(out_ts)
+            scheme = chunks[-1].bucket_scheme if chunks else None
+            for res, acc in per_res.items():
+                out_ts = np.concatenate(acc.pop("timestamp"))
+                order = np.argsort(out_ts, kind="stable")
+                cols = {k: np.concatenate(v)[order] for k, v in acc.items()}
+                target = self.schemas[schema.downsample_schema]
+                col_types = {c.name: c.col_type for c in target.data_columns}
+                chunkset = encode_chunkset(out_ts[order], cols, col_types,
+                                           now, scheme)
+                ds_name = ds_dataset_name(self.dataset, res)
+                self.ds_store.write_chunks(ds_name, shard, rec.part_key,
+                                           [chunkset], target.name)
+                stats.chunks_written += 1
+                ds_pk_updates[res].append(PartKeyRecord(
+                    rec.part_key, target.name, rec.start_time_ms,
+                    rec.end_time_ms))
+        # DSIndexJob half: publish part-key liveness to the ds keyspace
+        # (ref: spark-jobs/.../index/DSIndexJobMain.scala)
+        for res, recs in ds_pk_updates.items():
+            if recs:
+                self.ds_store.write_part_keys(ds_dataset_name(self.dataset, res),
+                                              shard, recs)

@@ -694,6 +694,15 @@ class MultiSchemaPartitionsExec(LeafExecPlan):
         self.chunk_end_ms = chunk_end_ms
         self.columns = list(columns)
         self.schema = schema
+        self._transformer_overrides: Dict[int, RangeVectorTransformer] = {}
+
+    def execute_internal(self, source) -> QueryResultLike:
+        self._transformer_overrides = {}
+        data, stats = self._do_execute(source)
+        for i, t in enumerate(self.transformers):
+            t = self._transformer_overrides.get(i, t)
+            data = t.apply(data, self.ctx, stats, source)
+        return data, stats
 
     def args_str(self):
         fs = ",".join(str(f) for f in self.filters)
@@ -720,6 +729,23 @@ class MultiSchemaPartitionsExec(LeafExecPlan):
         schema = shard.schemas[schema_name]
         col_name = (self.columns[0] if self.columns
                     else schema.value_column)
+        # schema-specific column + range-function substitution for the
+        # downsample gauge schema: min_over_time reads the `min` column,
+        # count_over_time becomes sum_over_time over `count`, etc.  Applied
+        # as per-execution overrides so the plan stays reusable
+        # (ref: MultiSchemaPartitionsExec.finalizePlan schema substitutions;
+        # Schemas DS_GAUGE_FN_SUBSTITUTION)
+        if schema.name == "ds-gauge" and not self.columns:
+            from filodb_tpu.core.schemas import DS_GAUGE_FN_SUBSTITUTION
+            for i, t in enumerate(self.transformers):
+                if isinstance(t, PeriodicSamplesMapper):
+                    sub = DS_GAUGE_FN_SUBSTITUTION.get(t.function)
+                    if sub is not None:
+                        col_name = sub[0]
+                        if sub[1] != t.function:
+                            self._transformer_overrides[i] = \
+                                dataclasses.replace(t, function=sub[1])
+                    break
         # value column selection: histograms gather [S, T, B]
         vals = cols[col_name]
         base = self.chunk_start_ms

@@ -379,6 +379,7 @@ def default_shard_key_matcher(index_label_values: Callable[[str], List[str]],
     import re
 
     def matcher(filters: Sequence[ColumnFilter]) -> List[Sequence[ColumnFilter]]:
+        from filodb_tpu.core.index import NotEquals, NotEqualsRegex, NotIn
         combos: List[List[ColumnFilter]] = [[]]
         for f in filters:
             if f.column not in shard_key_columns:
@@ -391,6 +392,16 @@ def default_shard_key_matcher(index_label_values: Callable[[str], List[str]],
                 rx = re.compile(f.pattern)
                 vals = [v for v in index_label_values(f.column)
                         if rx.fullmatch(v)]
+            elif isinstance(f, NotEquals):
+                vals = [v for v in index_label_values(f.column)
+                        if v != f.value]
+            elif isinstance(f, NotIn):
+                vals = [v for v in index_label_values(f.column)
+                        if v not in f.values]
+            elif isinstance(f, NotEqualsRegex):
+                rx = re.compile(f.pattern)
+                vals = [v for v in index_label_values(f.column)
+                        if not rx.fullmatch(v)]
             else:
                 vals = index_label_values(f.column)
             combos = [c + [Equals(f.column, v)] for c in combos for v in vals]
@@ -428,6 +439,12 @@ class ShardKeyRegexPlanner(QueryPlanner):
             # onto the other would corrupt the join
             # (ref: ShardKeyRegexPlanner materializeBinaryJoin)
             return self._materialize_join(plan, ctx)
+        if not self._concat_safe(plan):
+            # a cross-series op (avg/topk/sort/...) that cannot be rebuilt
+            # from per-combo presented results: let the wrapped planner fan
+            # to all shards and apply the regex at the index — correct,
+            # just less targeted
+            return self.planner.materialize(plan, ctx)
         groups = pu.get_raw_series_filters(plan)
         base = groups[0] if groups else ()
         key_of = lambda fs: frozenset(  # noqa: E731
@@ -451,6 +468,29 @@ class ShardKeyRegexPlanner(QueryPlanner):
             return MultiPartitionReduceAggregateExec(ctx, children,
                                                      plan.operator)
         return DistConcatExec(ctx, children)
+
+    def _concat_safe(self, plan: lp.LogicalPlan) -> bool:
+        """True when per-combo results compose correctly: either the top is a
+        combinable Aggregate, or the plan contains no cross-series operation
+        at all (pure per-series pipelines concatenate cleanly)."""
+        if isinstance(plan, lp.Aggregate):
+            return plan.operator in MultiPartitionReduceAggregateExec.COMBINE \
+                and self._per_series_only(plan.vectors)
+        return self._per_series_only(plan)
+
+    @staticmethod
+    def _per_series_only(plan) -> bool:
+        import dataclasses as _dc
+        if isinstance(plan, (lp.Aggregate, lp.ApplySortFunction,
+                             lp.ApplyLimitFunction)):
+            return False
+        if _dc.is_dataclass(plan):
+            for f in _dc.fields(plan):
+                v = getattr(plan, f.name)
+                if isinstance(v, lp.LogicalPlan) and \
+                        not ShardKeyRegexPlanner._per_series_only(v):
+                    return False
+        return True
 
     def _materialize_join(self, plan: lp.BinaryJoin,
                           ctx: QueryContext) -> ExecPlan:

@@ -194,8 +194,14 @@ def rewrite_filters(plan: lp.LogicalPlan,
 
 # --------------------------------------------------------- plan → PromQL
 
+def _esc(v: str) -> str:
+    """Escape a literal label value for a double-quoted PromQL matcher."""
+    return v.replace("\\", "\\\\").replace('"', '\\"')
+
+
 def _matchers(filters: Sequence[ColumnFilter]) -> Tuple[str, List[str]]:
     """Returns (metric_name, label matcher strings)."""
+    import re as _re
     metric = ""
     out: List[str] = []
     for f in filters:
@@ -203,19 +209,21 @@ def _matchers(filters: Sequence[ColumnFilter]) -> Tuple[str, List[str]]:
             metric = f.value
             continue
         if isinstance(f, Equals):
-            out.append(f'{f.column}="{f.value}"')
+            out.append(f'{f.column}="{_esc(f.value)}"')
         elif isinstance(f, NotEquals):
-            out.append(f'{f.column}!="{f.value}"')
+            out.append(f'{f.column}!="{_esc(f.value)}"')
         elif isinstance(f, EqualsRegex):
-            out.append(f'{f.column}=~"{f.pattern}"')
+            out.append(f'{f.column}=~"{_esc(f.pattern)}"')
         elif isinstance(f, NotEqualsRegex):
-            out.append(f'{f.column}!~"{f.pattern}"')
+            out.append(f'{f.column}!~"{_esc(f.pattern)}"')
         elif isinstance(f, In):
-            out.append(f'{f.column}=~"{"|".join(sorted(f.values))}"')
+            alts = "|".join(_re.escape(v) for v in sorted(f.values))
+            out.append(f'{f.column}=~"{_esc(alts)}"')
         elif isinstance(f, NotIn):
-            out.append(f'{f.column}!~"{"|".join(sorted(f.values))}"')
+            alts = "|".join(_re.escape(v) for v in sorted(f.values))
+            out.append(f'{f.column}!~"{_esc(alts)}"')
         elif isinstance(f, Prefix):
-            out.append(f'{f.column}=~"{f.prefix}.*"')
+            out.append(f'{f.column}=~"{_esc(_re.escape(f.prefix))}.*"')
         else:
             raise ValueError(f"cannot unparse filter {f}")
     return metric, out

@@ -1,0 +1,235 @@
+"""Downsample runtime tests (models ref: core/src/test/.../downsample/
+ShardDownsamplerSpec, spark-jobs/src/test/.../DownsamplerMainSpec,
+DownsampledTimeSeriesShardSpec)."""
+import numpy as np
+import pytest
+
+from filodb_tpu.core.memstore import TimeSeriesMemStore
+from filodb_tpu.core.schemas import DEFAULT_SCHEMAS, GAUGE, PROM_COUNTER
+from filodb_tpu.core.store import InMemoryColumnStore, InMemoryMetaStore
+from filodb_tpu.downsample import (DownsampleClusterPlanner,
+                                   DownsampledTimeSeriesStore, DownsamplerJob,
+                                   ShardDownsampler, downsample_chunk,
+                                   ds_dataset_name, period_boundaries)
+from filodb_tpu.ingest.generator import counter_batch, gauge_batch
+from filodb_tpu.parallel.shardmapper import ShardEvent, ShardMapper
+from filodb_tpu.query.engine import QueryEngine
+from filodb_tpu.query.planner import SingleClusterPlanner
+from filodb_tpu.query.planners import LongTimeRangePlanner
+from filodb_tpu.query.rangevector import QueryContext
+
+START = 1_600_000_000_000
+RES = 60_000
+
+
+# ------------------------------------------------------------ downsamplers
+
+
+# periods are absolute (k*res, (k+1)*res] buckets, so tests use timestamps
+# starting one sample into a period boundary
+ALIGNED = (START // RES) * RES
+
+
+def test_period_boundaries_time_marker():
+    ts = np.asarray([ALIGNED + (i + 1) * 10_000 for i in range(18)],
+                    dtype=np.int64)
+    starts = period_boundaries(ts, RES)
+    # 10s samples, 1m periods -> a new period every 6 samples
+    assert list(starts) == [0, 6, 12]
+
+
+def test_period_boundaries_counter_marker_splits_at_drop():
+    ts = np.asarray([ALIGNED + (i + 1) * 10_000 for i in range(12)],
+                    dtype=np.int64)
+    vals = np.asarray([1, 2, 3, 4, 5, 6, 7, 1, 2, 3, 4, 5], dtype=np.float64)
+    starts = period_boundaries(ts, RES, counter_vals=vals)
+    # period boundary at 6 (time) plus reset boundary at 7 (drop)
+    assert list(starts) == [0, 6, 7]
+
+
+def test_downsample_chunk_gauge():
+    T = 24
+    ts = np.asarray([ALIGNED + (i + 1) * 10_000 for i in range(T)],
+                    dtype=np.int64)
+    vals = np.arange(T, dtype=np.float64)
+    out_ts, out_cols = downsample_chunk(GAUGE, ts, {"value": vals}, RES)
+    assert len(out_ts) == 4
+    # tTime = last sample of each period
+    assert list(out_ts) == [int(ts[5]), int(ts[11]), int(ts[17]), int(ts[23])]
+    assert list(out_cols["min"]) == [0, 6, 12, 18]
+    assert list(out_cols["max"]) == [5, 11, 17, 23]
+    assert list(out_cols["sum"]) == [15, 51, 87, 123]
+    assert list(out_cols["count"]) == [6, 6, 6, 6]
+    np.testing.assert_allclose(out_cols["avg"],
+                               np.asarray([2.5, 8.5, 14.5, 20.5]))
+
+
+def test_downsample_chunk_counter_preserves_reset():
+    ts = np.asarray([ALIGNED + (i + 1) * 10_000 for i in range(12)],
+                    dtype=np.int64)
+    vals = np.asarray([1, 2, 3, 4, 5, 6, 7, 1, 2, 3, 4, 5], dtype=np.float64)
+    out_ts, out_cols = downsample_chunk(PROM_COUNTER, ts,
+                                        {"count": vals}, RES)
+    # 3 periods: [0..5], [6] (cut short by the drop at 7), [7..11]
+    assert list(out_cols["count"]) == [6, 7, 5]
+    # the dip 7 -> 1 survives in the dLast sequence so query-time rate
+    # correction still sees the reset
+    assert out_cols["count"][1] > out_cols["count"][2]
+
+
+# ---------------------------------------------------- streaming pipeline
+
+
+def _mk_raw_engine(store, meta, batches):
+    ms = TimeSeriesMemStore(column_store=store, meta_store=meta)
+    mapper = ShardMapper(1)
+    mapper.update_from_event(
+        ShardEvent("IngestionStarted", "prometheus", 0, "local"))
+    shard = ms.setup("prometheus", 0)
+    for b in batches:
+        shard.ingest(b)
+    eng = QueryEngine("prometheus", ms, mapper)
+    return ms, shard, mapper, eng
+
+
+@pytest.fixture()
+def pipeline():
+    raw_cs, raw_meta = InMemoryColumnStore(), InMemoryMetaStore()
+    ms, shard, mapper, raw_eng = _mk_raw_engine(
+        raw_cs, raw_meta, [gauge_batch(20, 720, start_ms=START),
+                           counter_batch(10, 720, start_ms=START)])
+    dsr = ShardDownsampler(resolutions=(RES,))
+    shard.shard_downsampler = dsr
+    shard.flush_all_groups()
+    ds_store = DownsampledTimeSeriesStore(
+        "prometheus", column_store=InMemoryColumnStore(),
+        resolutions=(RES,))
+    ds_store.setup_shard(0)
+    n = ds_store.ingest_downsample_batches(0, dsr.result_batches())
+    assert n > 0
+    planner = DownsampleClusterPlanner(ds_store, mapper)
+    ds_eng = QueryEngine("prometheus", ds_store, mapper, planner=planner)
+    return raw_eng, ds_eng
+
+
+def _vals(res):
+    assert res.error is None, res.error
+    assert res.blocks, "empty result"
+    return np.asarray(res.blocks[0].values)
+
+
+# evaluation instants on the period grid: a window (t-10m, t] with t aligned
+# to the 1m period boundaries covers whole periods, so period-level
+# min/max/sum/count reproduce the raw answers exactly
+ALIGNED_S = ALIGNED // 1000
+
+
+def test_ds_min_max_over_time_exact(pipeline):
+    raw_eng, ds_eng = pipeline
+    for fn in ("min_over_time", "max_over_time", "sum_over_time",
+               "count_over_time"):
+        q = f'sum({fn}(heap_usage{{_ws_="demo"}}[10m]))'
+        raw = _vals(raw_eng.query_range(q, ALIGNED_S + 1260, 300,
+                                        ALIGNED_S + 7080))
+        ds = _vals(ds_eng.query_range(q, ALIGNED_S + 1260, 300,
+                                      ALIGNED_S + 7080))
+        np.testing.assert_allclose(ds, raw, rtol=1e-9, err_msg=fn)
+
+
+def test_ds_counter_rate_close(pipeline):
+    raw_eng, ds_eng = pipeline
+    q = 'sum(rate(request_total[10m]))'
+    raw = _vals(raw_eng.query_range(q, ALIGNED_S + 1260, 300,
+                                    ALIGNED_S + 7080))
+    ds = _vals(ds_eng.query_range(q, ALIGNED_S + 1260, 300,
+                                  ALIGNED_S + 7080))
+    both = ~(np.isnan(raw) | np.isnan(ds))
+    assert both.any()
+    # rate over dLast periods loses intra-period slope detail only at the
+    # window edges: close, not exact
+    np.testing.assert_allclose(ds[both], raw[both], rtol=0.05)
+
+
+def test_ds_substitution_is_idempotent(pipeline):
+    """Executing the same plan twice must not double-apply the ds-gauge
+    function substitution (count_over_time -> sum_over_time over `count`)."""
+    _, ds_eng = pipeline
+    from filodb_tpu.promql.parser import (TimeStepParams,
+                                          query_range_to_logical_plan)
+    plan = query_range_to_logical_plan(
+        'sum(count_over_time(heap_usage[10m]))',
+        TimeStepParams(ALIGNED_S + 1260, 300, ALIGNED_S + 7080))
+    ep = ds_eng.planner.materialize(plan, QueryContext())
+    r1 = ep.execute(ds_eng.source)
+    r2 = ep.execute(ds_eng.source)
+    np.testing.assert_array_equal(np.asarray(r1.blocks[0].values),
+                                  np.asarray(r2.blocks[0].values))
+
+
+# ------------------------------------------------------------- batch job
+
+
+def test_batch_job_roundtrip():
+    raw_cs, raw_meta = InMemoryColumnStore(), InMemoryMetaStore()
+    ms, shard, mapper, raw_eng = _mk_raw_engine(
+        raw_cs, raw_meta, [gauge_batch(12, 720, start_ms=START)])
+    shard.flush_all_groups()
+
+    ds_cs = InMemoryColumnStore()
+    job = DownsamplerJob(raw_cs, ds_cs, "prometheus", resolutions=(RES,))
+    stats = job.run([0], START, START + 720 * 10_000)
+    assert stats.parts_scanned == 12
+    assert stats.chunks_written > 0
+    assert stats.records_emitted > 0
+
+    ds_store = DownsampledTimeSeriesStore("prometheus", column_store=ds_cs,
+                                          resolutions=(RES,))
+    ds_store.setup_shard(0)
+    assert ds_store.refresh_index(0) == 12
+    planner = DownsampleClusterPlanner(ds_store, mapper)
+    ds_eng = QueryEngine("prometheus", ds_store, mapper, planner=planner)
+    q = 'sum(max_over_time(heap_usage{_ws_="demo"}[10m]))'
+    raw = _vals(raw_eng.query_range(q, ALIGNED_S + 1260, 300,
+                                    ALIGNED_S + 7080))
+    ds = _vals(ds_eng.query_range(q, ALIGNED_S + 1260, 300,
+                                  ALIGNED_S + 7080))
+    np.testing.assert_allclose(ds, raw, rtol=1e-9)
+
+
+# -------------------------------------------- long-time-range integration
+
+
+def test_long_time_range_with_real_downsample_cluster():
+    raw_cs, raw_meta = InMemoryColumnStore(), InMemoryMetaStore()
+    ms, shard, mapper, raw_eng = _mk_raw_engine(
+        raw_cs, raw_meta, [gauge_batch(8, 720, start_ms=START)])
+    dsr = ShardDownsampler(resolutions=(RES,))
+    shard.shard_downsampler = dsr
+    shard.flush_all_groups()
+    ds_store = DownsampledTimeSeriesStore(
+        "prometheus", column_store=InMemoryColumnStore(), resolutions=(RES,))
+    ds_store.setup_shard(0)
+    ds_store.ingest_downsample_batches(0, dsr.result_batches())
+
+    # pretend raw retention starts mid-query; downsample covers everything
+    earliest_raw = START + 3_600_000
+    raw_planner = SingleClusterPlanner("prometheus", mapper)
+    ds_planner = DownsampleClusterPlanner(ds_store, mapper)
+    ltr = LongTimeRangePlanner(raw_planner, ds_planner,
+                               lambda: earliest_raw,
+                               lambda: START + 720 * 10_000)
+
+    class _FanoutSource:
+        """Route leaf execs to whichever store owns their dataset."""
+        def get_shard(self, dataset, shard_num):
+            if "::ds::" in dataset:
+                return ds_store.get_shard(dataset, shard_num)
+            return ms.get_shard(dataset, shard_num)
+
+    q = 'sum(max_over_time(heap_usage[10m]))'
+    plan_eng = QueryEngine("prometheus", _FanoutSource(), mapper, planner=ltr)
+    res = plan_eng.query_range(q, ALIGNED_S + 1260, 300, ALIGNED_S + 7080)
+    stitched = _vals(res)
+    raw_all = _vals(raw_eng.query_range(q, ALIGNED_S + 1260, 300,
+                                        ALIGNED_S + 7080))
+    np.testing.assert_allclose(stitched, raw_all, rtol=1e-9)
