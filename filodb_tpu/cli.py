@@ -1,0 +1,290 @@
+"""filo-cli — operator command line.
+
+ref: cli/.../CliMain.scala:91-116,138-210 — init/create/importcsv/list/
+indexnames/indexvalues/labelvalues/validateSchemas/decodeChunkInfo plus
+PromQL timeseries queries, and `serve` standing in for the standalone
+launcher script.  Commands run in-process against a local data directory
+(LocalDiskColumnStore) or — for query/status — against a running server
+over HTTP with --host.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from typing import Dict, List, Optional
+
+
+def _open_local(data_dir: str, dataset: str, num_shards: int):
+    from filodb_tpu.core.memstore import TimeSeriesMemStore
+    from filodb_tpu.persist.localstore import (LocalDiskColumnStore,
+                                               LocalDiskMetaStore)
+    cs = LocalDiskColumnStore(os.path.join(data_dir, "chunks"))
+    meta = LocalDiskMetaStore(os.path.join(data_dir, "meta"))
+    ms = TimeSeriesMemStore(column_store=cs, meta_store=meta)
+    for s in range(num_shards):
+        ms.setup(dataset, s).recover_index()
+    return ms, cs, meta
+
+
+def _local_engine(ms, dataset: str, num_shards: int):
+    from filodb_tpu.parallel.shardmapper import ShardEvent, ShardMapper
+    from filodb_tpu.query.engine import QueryEngine
+    mapper = ShardMapper(num_shards)
+    for s in range(num_shards):
+        mapper.update_from_event(
+            ShardEvent("IngestionStarted", dataset, s, "cli"))
+    return QueryEngine(dataset, ms, mapper)
+
+
+def _http_get(host: str, path: str, params: Dict[str, str]) -> dict:
+    import urllib.error
+    import urllib.parse
+    import urllib.request
+    url = f"http://{host}{path}?{urllib.parse.urlencode(params)}"
+    try:
+        with urllib.request.urlopen(url, timeout=60) as r:
+            return json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        try:
+            return json.loads(e.read())
+        except Exception:  # noqa: BLE001 — non-JSON error body
+            return {"status": "error", "error": f"HTTP {e.code}: {e.reason}"}
+
+
+# ------------------------------------------------------------------ commands
+
+
+def cmd_init(args) -> int:
+    """Create the data-directory layout (ref: CliMain `init`/`create`)."""
+    for sub in ("chunks", "meta"):
+        os.makedirs(os.path.join(args.data_dir, sub), exist_ok=True)
+    ms, cs, _ = _open_local(args.data_dir, args.dataset, args.shards)
+    cs.initialize(args.dataset, args.shards)
+    print(f"initialized {args.data_dir} for dataset {args.dataset} "
+          f"({args.shards} shards)")
+    return 0
+
+
+def cmd_importcsv(args) -> int:
+    """CSV ingest routed by the shard-key math so queries find the data
+    on multi-shard datasets (ref: CliMain `importcsv` / CsvStream source)."""
+    from filodb_tpu.gateway.router import split_batch_by_shard
+    from filodb_tpu.ingest.stream import CsvStream
+    from filodb_tpu.parallel.shardmapper import ShardMapper, SpreadProvider
+    ms, _, _ = _open_local(args.data_dir, args.dataset, args.shards)
+    stream = CsvStream(args.file, schema_name=args.schema)
+    mapper = ShardMapper(args.shards)
+    spread = SpreadProvider()
+    n = 0
+    touched = set()
+    for batch, off in stream.batches():
+        for s, sub in split_batch_by_shard(batch, mapper, spread).items():
+            n += ms.get_shard(args.dataset, s).ingest(sub, off)
+            touched.add(s)
+    for s in touched:
+        ms.get_shard(args.dataset, s).flush_all_groups()
+    print(f"imported {n} samples from {args.file} into shards "
+          f"{sorted(touched)}")
+    return 0
+
+
+def cmd_list(args) -> int:
+    """Datasets + per-shard series counts in a data dir (ref: `list`)."""
+    root = os.path.join(args.data_dir, "chunks")
+    if not os.path.isdir(root):
+        print("no datasets", file=sys.stderr)
+        return 1
+    for ds in sorted(os.listdir(root)):
+        shards = [d for d in os.listdir(os.path.join(root, ds))
+                  if d.startswith("shard-")]
+        print(f"{ds}\tshards={len(shards)}")
+    return 0
+
+
+def cmd_indexnames(args) -> int:
+    ms, _, _ = _open_local(args.data_dir, args.dataset, args.shards)
+    names = set()
+    for sh in ms.shards_for(args.dataset):
+        names.update(sh.index.label_names())
+    for n in sorted(names):
+        print(n)
+    return 0
+
+
+def cmd_indexvalues(args) -> int:
+    ms, _, _ = _open_local(args.data_dir, args.dataset, args.shards)
+    counts: Dict[str, int] = {}
+    for sh in ms.shards_for(args.dataset):
+        for v in sh.index.label_values(args.label):
+            val, cnt = v if isinstance(v, tuple) else (v, 1)
+            counts[val] = counts.get(val, 0) + cnt
+    for val, cnt in sorted(counts.items(), key=lambda kv: -kv[1])[:args.limit]:
+        print(f"{cnt:>8}  {val}")
+    return 0
+
+
+def cmd_query(args) -> int:
+    """PromQL range query (ref: CliMain `timeseries` query commands)."""
+    end = args.end or int(time.time())
+    start = args.start or end - 1800
+    if args.host:
+        payload = _http_get(
+            args.host, f"/promql/{args.dataset}/api/v1/query_range",
+            {"query": args.promql, "start": str(start), "end": str(end),
+             "step": str(args.step)})
+    else:
+        from filodb_tpu.query.engine import QueryEngine
+        ms, _, _ = _open_local(args.data_dir, args.dataset, args.shards)
+        eng = _local_engine(ms, args.dataset, args.shards)
+        res = eng.query_range(args.promql, start, args.step, end)
+        payload = QueryEngine.to_prom_matrix(res)
+    print(json.dumps(payload, indent=None if args.raw else 2))
+    return 0 if payload.get("status") == "success" else 2
+
+
+def cmd_status(args) -> int:
+    payload = _http_get(args.host, f"/cluster/{args.dataset}/status", {})
+    print(json.dumps(payload, indent=2))
+    return 0
+
+
+def cmd_validate_schemas(args) -> int:
+    """ref: CliMain `validateSchemas`."""
+    from filodb_tpu.core.schemas import DEFAULT_SCHEMAS
+    ok = True
+    seen: Dict[int, str] = {}
+    for name, schema in DEFAULT_SCHEMAS.by_name.items():
+        sid = schema.schema_id
+        if sid in seen and seen[sid] != name:
+            print(f"HASH CONFLICT: {name} vs {seen[sid]} (id={sid})")
+            ok = False
+        seen[sid] = name
+        print(f"{name:16} id={sid:5} columns="
+              f"{[c.name + ':' + c.col_type for c in schema.columns]}")
+    print("Validation passed" if ok else "Validation FAILED")
+    return 0 if ok else 1
+
+
+def cmd_decodechunks(args) -> int:
+    """Chunk metadata dump (ref: CliMain `decodeChunkInfo`)."""
+    from filodb_tpu.persist.localstore import LocalDiskColumnStore
+    cs = LocalDiskColumnStore(os.path.join(args.data_dir, "chunks"))
+    for rec in cs.read_part_keys(args.dataset, args.shard)[:args.limit]:
+        chunks = cs.read_chunks(args.dataset, args.shard, rec.part_key,
+                                0, 1 << 62)
+        for c in chunks:
+            print(f"{rec.part_key}  id={c.info.chunk_id} "
+                  f"rows={c.info.num_rows} "
+                  f"start={c.info.start_time_ms} end={c.info.end_time_ms} "
+                  f"bytes={c.nbytes}")
+    return 0
+
+
+def cmd_serve(args) -> int:
+    """Run the standalone server (ref: FiloServer.scala:39)."""
+    from filodb_tpu.persist.localstore import (LocalDiskColumnStore,
+                                               LocalDiskMetaStore)
+    from filodb_tpu.standalone import DatasetConfig, FiloServer
+    kwargs = {}
+    if args.data_dir:
+        kwargs["column_store"] = LocalDiskColumnStore(
+            os.path.join(args.data_dir, "chunks"))
+        kwargs["meta_store"] = LocalDiskMetaStore(
+            os.path.join(args.data_dir, "meta"))
+    res = tuple(int(r) for r in args.downsample.split(",")) \
+        if args.downsample else ()
+    server = FiloServer(
+        [DatasetConfig(args.dataset, args.shards,
+                       downsample_resolutions=res)],
+        http_host=args.bind, http_port=args.port, **kwargs)
+    server.start()
+    print(f"serving {args.dataset} on {args.bind}:{server.http.port}")
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        server.shutdown()
+    return 0
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="filo-cli",
+                                description="FiloDB-TPU operator CLI")
+    sub = p.add_subparsers(dest="command", required=True)
+
+    def common(sp, data_dir=True):
+        sp.add_argument("--dataset", default="prometheus")
+        sp.add_argument("--shards", type=int, default=1)
+        if data_dir:
+            sp.add_argument("--data-dir", default="./filodb-data")
+
+    sp = sub.add_parser("init", help="create data-dir layout")
+    common(sp)
+    sp.set_defaults(fn=cmd_init)
+
+    sp = sub.add_parser("importcsv", help="ingest a CSV file")
+    common(sp)
+    sp.add_argument("--file", required=True)
+    sp.add_argument("--schema", default="gauge")
+    sp.set_defaults(fn=cmd_importcsv)
+
+    sp = sub.add_parser("list", help="list datasets in a data dir")
+    sp.add_argument("--data-dir", default="./filodb-data")
+    sp.set_defaults(fn=cmd_list)
+
+    sp = sub.add_parser("indexnames", help="label names in the tag index")
+    common(sp)
+    sp.set_defaults(fn=cmd_indexnames)
+
+    sp = sub.add_parser("indexvalues", help="top label values by count")
+    common(sp)
+    sp.add_argument("--label", required=True)
+    sp.add_argument("--limit", type=int, default=20)
+    sp.set_defaults(fn=cmd_indexvalues)
+
+    sp = sub.add_parser("query", help="PromQL range query")
+    common(sp)
+    sp.add_argument("--promql", required=True)
+    sp.add_argument("--start", type=int, default=0)
+    sp.add_argument("--end", type=int, default=0)
+    sp.add_argument("--step", type=int, default=60)
+    sp.add_argument("--host", default="",
+                    help="query a running server (host:port) over HTTP")
+    sp.add_argument("--raw", action="store_true")
+    sp.set_defaults(fn=cmd_query)
+
+    sp = sub.add_parser("status", help="cluster shard status over HTTP")
+    sp.add_argument("--host", required=True)
+    sp.add_argument("--dataset", default="prometheus")
+    sp.set_defaults(fn=cmd_status)
+
+    sp = sub.add_parser("validateSchemas", help="check schema registry")
+    sp.set_defaults(fn=cmd_validate_schemas)
+
+    sp = sub.add_parser("decodechunks", help="dump chunk metadata")
+    common(sp)
+    sp.add_argument("--shard", type=int, default=0)
+    sp.add_argument("--limit", type=int, default=10)
+    sp.set_defaults(fn=cmd_decodechunks)
+
+    sp = sub.add_parser("serve", help="run the standalone server")
+    common(sp, data_dir=False)
+    sp.add_argument("--data-dir", default="")
+    sp.add_argument("--bind", default="127.0.0.1")
+    sp.add_argument("--port", type=int, default=8080)
+    sp.add_argument("--downsample", default="",
+                    help="comma-separated resolutions in ms, e.g. 60000,300000")
+    sp.set_defaults(fn=cmd_serve)
+    return p
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

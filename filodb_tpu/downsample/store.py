@@ -12,6 +12,7 @@ TimeSeriesShard machinery serve downsampled queries unchanged.
 """
 from __future__ import annotations
 
+import threading
 from typing import Dict, Iterable, List, Optional, Sequence
 
 from filodb_tpu.config import FilodbSettings
@@ -95,7 +96,8 @@ class DownsampleClusterPlanner(SingleClusterPlanner):
                  **kwargs):
         super().__init__(store.raw_dataset, shard_mapper, **kwargs)
         self.store = store
-        self._res_stack: List[int] = []
+        # per-thread: one planner instance serves concurrent HTTP requests
+        self._tls = threading.local()
 
     def materialize(self, plan, ctx):
         from filodb_tpu.query import logical as lp
@@ -105,16 +107,19 @@ class DownsampleClusterPlanner(SingleClusterPlanner):
             res = self.store.pick_resolution(plan.step_ms, win)
         if res is None:
             res = self.store.resolutions[0]
-        self._res_stack.append(res)
+        stack = getattr(self._tls, "res_stack", None)
+        if stack is None:
+            stack = self._tls.res_stack = []
+        stack.append(res)
         try:
             return super().materialize(plan, ctx)
         finally:
-            self._res_stack.pop()
+            stack.pop()
 
     def _m_RawSeries(self, p, ctx):
         plans = super()._m_RawSeries(p, ctx)
-        res = self._res_stack[-1] if self._res_stack \
-            else self.store.resolutions[0]
+        stack = getattr(self._tls, "res_stack", None)
+        res = stack[-1] if stack else self.store.resolutions[0]
         for leaf in plans:
             leaf.dataset = ds_dataset_name(self.store.raw_dataset, res)
         return plans

@@ -1,0 +1,6 @@
+"""HTTP API layer (maps ref: http/ — PrometheusApiRoute, ClusterApiRoute,
+HealthRoute, FiloHttpServer)."""
+from filodb_tpu.http.routes import PromHttpApi
+from filodb_tpu.http.server import FiloHttpServer
+
+__all__ = ["PromHttpApi", "FiloHttpServer"]

@@ -1,0 +1,190 @@
+"""Transport-agnostic HTTP route handlers.
+
+Mirrors the reference's akka-http routes (ref:
+http/.../PrometheusApiRoute.scala:37-62 — query/query_range/labels/series,
+ClusterApiRoute.scala — shard status admin, HealthRoute.scala,
+doc/http_api.md — /admin/loglevel) plus an Influx line-protocol write
+endpoint standing in for the gateway's TCP listener
+(ref: gateway/.../GatewayServer.scala:58).
+
+Handlers take (params, body) and return (status_code, payload_dict); the
+socket server in server.py is a thin shell, so tests exercise routes
+without binding ports (the reference tests routes the same way with
+akka-http testkit).
+"""
+from __future__ import annotations
+
+import logging
+from typing import Callable, Dict, List, Optional, Tuple
+
+from filodb_tpu.query.engine import QueryEngine
+from filodb_tpu.query.rangevector import PlannerParams
+
+
+class PromHttpApi:
+
+    def __init__(self, engines: Dict[str, QueryEngine],
+                 gateways: Optional[Dict[str, object]] = None,  # GatewayPipeline per dataset
+                 shard_mappers: Optional[Dict[str, object]] = None,
+                 default_dataset: Optional[str] = None):
+        self.engines = engines
+        self.gateways = gateways or {}
+        self.shard_mappers = shard_mappers or {}
+        self.default_dataset = default_dataset or next(iter(engines), None)
+
+    # ------------------------------------------------------------ dispatch
+
+    def handle(self, method: str, path: str, params: Dict[str, str],
+               body: bytes = b"") -> Tuple[int, object]:
+        parts = [p for p in path.split("/") if p]
+        try:
+            if parts == ["__health"]:
+                return 200, {"status": "healthy"}
+            if parts[:1] == ["promql"] and len(parts) >= 4 \
+                    and parts[2] == "api" and parts[3] == "v1":
+                return self._api_v1(parts[1], parts[4:], method, params, body)
+            if parts[:2] == ["api", "v1"]:
+                if self.default_dataset is None:
+                    return 404, _err("no datasets registered")
+                return self._api_v1(self.default_dataset, parts[2:], method,
+                                    params, body)
+            if parts[:1] == ["cluster"] and len(parts) >= 3 \
+                    and parts[2] == "status":
+                return self._cluster_status(parts[1])
+            if parts[:2] == ["admin", "loglevel"] and len(parts) == 3 \
+                    and method == "POST":
+                return self._loglevel(parts[2], body.decode().strip())
+            if parts[:1] == ["influx"] and len(parts) == 2 \
+                    and parts[1] == "write" and method == "POST":
+                return self._influx_write(params, body)
+            return 404, _err(f"no route for {method} {path}")
+        except Exception as e:  # noqa: BLE001 — HTTP edge turns errors into 500s
+            return 500, _err(f"{type(e).__name__}: {e}")
+
+    # ----------------------------------------------------------- prom api
+
+    def _api_v1(self, dataset: str, rest: List[str], method: str,
+                params: Dict[str, str], body: bytes) -> Tuple[int, object]:
+        eng = self.engines.get(dataset)
+        if eng is None:
+            return 404, _err(f"dataset {dataset!r} not found")
+        planner_params = _planner_params(params)
+        if rest == ["query_range"]:
+            q = params.get("query", "")
+            start = int(float(params["start"]))
+            end = int(float(params["end"]))
+            step = max(int(float(params.get("step", "15"))), 1)
+            if params.get("explain") in ("true", "1"):
+                return self._explain(eng, q, start, step, end)
+            res = eng.query_range(q, start, step, end, planner_params)
+            payload = QueryEngine.to_prom_matrix(res)
+            return (200 if payload["status"] == "success" else 400), payload
+        if rest == ["query"]:
+            q = params.get("query", "")
+            t = int(float(params.get("time", "0")))
+            if params.get("explain") in ("true", "1"):
+                return self._explain(eng, q, t, 1, t)
+            res = eng.query_instant(q, t, planner_params)
+            payload = QueryEngine.to_prom_vector(res)
+            return (200 if payload["status"] == "success" else 400), payload
+        if rest == ["labels"]:
+            return self._metadata(eng, "labels", params)
+        if len(rest) == 3 and rest[0] == "label" and rest[2] == "values":
+            return self._metadata(eng, "label_values", params, label=rest[1])
+        if rest == ["series"]:
+            return self._metadata(eng, "series", params)
+        return 404, _err(f"unknown api/v1 endpoint {'/'.join(rest)}")
+
+    def _explain(self, eng: QueryEngine, q: str, start: int, step: int,
+                 end: int) -> Tuple[int, object]:
+        """Exec-plan tree instead of results (ref: PrometheusApiRoute
+        `explainOnly` verb; tree format doc/query-engine.md:174-204)."""
+        from filodb_tpu.promql.parser import (TimeStepParams,
+                                              query_range_to_logical_plan)
+        from filodb_tpu.query.rangevector import QueryContext
+        plan = query_range_to_logical_plan(q, TimeStepParams(start, step, end))
+        ep = eng.planner.materialize(plan, QueryContext())
+        return 200, {"status": "success",
+                     "data": {"resultType": "execPlan",
+                              "result": ep.print_tree().splitlines()}}
+
+    def _metadata(self, eng: QueryEngine, kind: str, params: Dict[str, str],
+                  label: Optional[str] = None) -> Tuple[int, object]:
+        from filodb_tpu.promql.parser import parse_query, _filters
+        from filodb_tpu.promql import ast as A
+        from filodb_tpu.query import logical as lp
+        start = int(float(params.get("start", "0"))) * 1000
+        end = int(float(params.get("end", "253402300799"))) * 1000
+        filters: Tuple = ()
+        match = params.get("match[]") or params.get("match")
+        if match:
+            sel = parse_query(match)
+            if not isinstance(sel, A.VectorSelector):
+                return 400, _err("match[] must be a vector selector")
+            filters = _filters(sel)
+        if kind == "labels":
+            plan: lp.LogicalPlan = lp.LabelNames(filters, start, end)
+        elif kind == "label_values":
+            plan = lp.LabelValues((label,), filters, start, end)
+        else:
+            plan = lp.SeriesKeysByFilters(filters, start, end)
+        res = eng.exec_logical_plan(plan)
+        if res.error:
+            return 400, _err(res.error)
+        data = res.data or []
+        # the label-values exec returns {label: values}; the Prometheus
+        # endpoint shape is a flat list for a single label
+        if kind == "label_values" and isinstance(data, dict):
+            data = sorted(data.get(label, []))
+        return 200, {"status": "success", "data": data}
+
+    # ------------------------------------------------------------- cluster
+
+    def _cluster_status(self, dataset: str) -> Tuple[int, object]:
+        """ref: ClusterApiRoute shard status (doc/http_api.md)."""
+        mapper = self.shard_mappers.get(dataset)
+        if mapper is None:
+            return 404, _err(f"dataset {dataset!r} not found")
+        statuses = [{"shard": i, "status": st, "address": addr}
+                    for i, (addr, st) in sorted(mapper.status_snapshot().items())]
+        return 200, {"status": "success", "data": statuses}
+
+    def _loglevel(self, logger_name: str, level: str) -> Tuple[int, object]:
+        """Dynamic per-logger level (ref: doc/http_api.md:38-46)."""
+        lvl = getattr(logging, level.upper(), None)
+        if not isinstance(lvl, int):
+            return 400, _err(f"bad level {level!r}")
+        logging.getLogger(logger_name if logger_name != "root" else None
+                          ).setLevel(lvl)
+        return 200, {"status": "success",
+                     "data": f"{logger_name} set to {level.upper()}"}
+
+    # -------------------------------------------------------------- influx
+
+    def _influx_write(self, params: Dict[str, str],
+                      body: bytes) -> Tuple[int, object]:
+        dataset = params.get("db") or self.default_dataset
+        gateway = self.gateways.get(dataset)
+        if gateway is None:
+            return 404, _err(f"no gateway for dataset {dataset!r}")
+        lines = body.decode("utf-8", errors="replace").splitlines()
+        gateway.ingest_lines(lines)
+        return 204, {}
+
+
+def _planner_params(params: Dict[str, str]) -> Optional[PlannerParams]:
+    """spread / sample-limit overrides (ref: PrometheusApiRoute query params
+    `spread`, `histogramMap`)."""
+    pp = PlannerParams()
+    changed = False
+    if "spread" in params:
+        pp.spread = int(params["spread"])
+        changed = True
+    if "limit" in params:
+        pp.sample_limit = int(params["limit"])
+        changed = True
+    return pp if changed else None
+
+
+def _err(msg: str) -> Dict[str, str]:
+    return {"status": "error", "errorType": "bad_data", "error": msg}

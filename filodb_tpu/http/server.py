@@ -1,0 +1,78 @@
+"""Threaded HTTP server shell around PromHttpApi.
+
+ref: http/.../FiloHttpServer.scala:85 — binds the route tree, started by the
+standalone FiloServer.  Python stdlib ThreadingHTTPServer is the transport;
+all route logic lives in routes.py.
+"""
+from __future__ import annotations
+
+import json
+import threading
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from filodb_tpu.http.routes import PromHttpApi
+
+
+class FiloHttpServer:
+
+    def __init__(self, api: PromHttpApi, host: str = "127.0.0.1",
+                 port: int = 8080):
+        self.api = api
+        api_ref = api
+
+        class _Handler(BaseHTTPRequestHandler):
+            def _serve(self, method: str):
+                parsed = urllib.parse.urlsplit(self.path)
+                params = {k: v[-1] for k, v in
+                          urllib.parse.parse_qs(parsed.query).items()}
+                length = int(self.headers.get("Content-Length") or 0)
+                body = self.rfile.read(length) if length else b""
+                # form-decode only for the API routes: write endpoints
+                # (/influx, /admin) carry raw line-protocol / text bodies
+                # even when clients default the form content-type
+                if method == "POST" and body and \
+                        parsed.path.startswith(("/promql", "/api")) and \
+                        self.headers.get("Content-Type", "").startswith(
+                            "application/x-www-form-urlencoded"):
+                    form = {k: v[-1] for k, v in
+                            urllib.parse.parse_qs(body.decode()).items()}
+                    params = {**form, **params}
+                    body = b""
+                status, payload = api_ref.handle(method, parsed.path, params,
+                                                 body)
+                blob = b"" if status == 204 else json.dumps(payload).encode()
+                self.send_response(status)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(blob)))
+                self.end_headers()
+                if blob:
+                    self.wfile.write(blob)
+
+            def do_GET(self):       # noqa: N802 — BaseHTTPRequestHandler API
+                self._serve("GET")
+
+            def do_POST(self):      # noqa: N802
+                self._serve("POST")
+
+            def log_message(self, fmt, *args):
+                pass                 # quiet; observability goes via metrics
+
+        self._httpd = ThreadingHTTPServer((host, port), _Handler)
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread:
+            self._thread.join(timeout=5)

@@ -80,8 +80,17 @@ class CsvStream(IngestionStream):
                     continue
                 metric = row.get("metric") or row.get("__name__") or ""
                 tags = {k: v for k, v in row.items()
-                        if k not in ("timestamp", "metric", "__name__", *value_cols)
+                        if k not in ("timestamp", "metric", "__name__",
+                                     "tags", *value_cols)
                         and v}
+                # packed tag column: `tags` holds `k=v` pairs split by ';'
+                # (the map-column form of the reference's CSV source)
+                packed = row.get("tags")
+                if packed:
+                    for kv in packed.split(";"):
+                        k, _, v = kv.partition("=")
+                        if k and v:
+                            tags[k] = v
                 values = {c: float(row[c]) for c in value_cols if c in row}
                 builder.add(PartKey.make(metric, tags),
                             int(row["timestamp"]), **values)

@@ -1,0 +1,172 @@
+"""FiloServer — the standalone process entry point.
+
+ref: standalone/.../FiloServer.scala:39-60 — boots the coordinator, memstore,
+and HTTP server for a single node owning every shard of its datasets.  The
+TPU-native standalone wires: memstore (+ optional local-disk persistence),
+shard mapper, planner stack (shard-key regex fan-out over the single-cluster
+planner, long-time-range split when downsampling is enabled), Influx gateway,
+and the HTTP API.  Cluster mode adds the ShardManager/controller from
+filodb_tpu.parallel (multi-node assignment) on top of the same pieces.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence
+
+from filodb_tpu.config import FilodbSettings, settings as default_settings
+from filodb_tpu.core.memstore import TimeSeriesMemStore
+from filodb_tpu.core.store import (ColumnStore, InMemoryColumnStore,
+                                   InMemoryMetaStore, MetaStore,
+                                   NullColumnStore)
+from filodb_tpu.gateway.router import GatewayPipeline
+from filodb_tpu.http.routes import PromHttpApi
+from filodb_tpu.http.server import FiloHttpServer
+from filodb_tpu.parallel.shardmapper import (ShardEvent, ShardMapper,
+                                             SpreadProvider)
+from filodb_tpu.query.engine import QueryEngine
+from filodb_tpu.query.planner import SingleClusterPlanner
+from filodb_tpu.query.planners import (ShardKeyRegexPlanner,
+                                       default_shard_key_matcher)
+
+
+@dataclasses.dataclass
+class DatasetConfig:
+    """Per-dataset ingestion config (ref: conf/timeseries-dev-source.conf —
+    dataset, num-shards, sourcefactory, store block)."""
+    name: str = "prometheus"
+    num_shards: int = 4
+    downsample_resolutions: Sequence[int] = ()
+
+
+class FiloServer:
+
+    def __init__(self, datasets: Optional[List[DatasetConfig]] = None,
+                 column_store: Optional[ColumnStore] = None,
+                 meta_store: Optional[MetaStore] = None,
+                 config: Optional[FilodbSettings] = None,
+                 http_host: str = "127.0.0.1", http_port: int = 0,
+                 node_name: str = "local"):
+        self.config = config or default_settings()
+        self.datasets = datasets or [DatasetConfig()]
+        self.column_store = column_store or InMemoryColumnStore()
+        self.meta_store = meta_store or InMemoryMetaStore()
+        self.node_name = node_name
+        self.memstore = TimeSeriesMemStore(
+            column_store=self.column_store, meta_store=self.meta_store,
+            config=self.config)
+        self.mappers: Dict[str, ShardMapper] = {}
+        self.engines: Dict[str, QueryEngine] = {}
+        self.gateways: Dict[str, GatewayPipeline] = {}
+        self.ds_stores: Dict[str, object] = {}
+        for dc in self.datasets:
+            self._setup_dataset(dc)
+        first = self.datasets[0].name
+        self.api = PromHttpApi(self.engines, gateways=self.gateways,
+                               shard_mappers=self.mappers,
+                               default_dataset=first)
+        self.http = FiloHttpServer(self.api, http_host, http_port)
+
+    # ------------------------------------------------------------- wiring
+
+    def _setup_dataset(self, dc: DatasetConfig) -> None:
+        mapper = ShardMapper(dc.num_shards)
+        spread = SpreadProvider(default_spread=self.config.spread_default)
+        shards = []
+        for s in range(dc.num_shards):
+            shard = self.memstore.setup(dc.name, s)
+            shard.recover_index()
+            shards.append(shard)
+            mapper.update_from_event(
+                ShardEvent("IngestionStarted", dc.name, s, self.node_name))
+        planner = SingleClusterPlanner(dc.name, mapper, spread)
+        if dc.downsample_resolutions:
+            planner = self._with_downsample(dc, mapper, planner)
+
+        def label_vals(col: str) -> List[str]:
+            out = set()
+            for sh in shards:
+                for v in sh.index.label_values(col):
+                    out.add(v[0] if isinstance(v, tuple) else v)
+            return sorted(out)
+
+        matcher = default_shard_key_matcher(
+            label_vals, self.memstore.schemas.part.options.shard_key_columns)
+        planner = ShardKeyRegexPlanner(planner, matcher)
+        self.mappers[dc.name] = mapper
+        self.engines[dc.name] = QueryEngine(dc.name, self._source(), mapper,
+                                            planner=planner)
+        self.gateways[dc.name] = GatewayPipeline(self.memstore, dc.name,
+                                                 mapper, spread)
+
+    def _with_downsample(self, dc: DatasetConfig, mapper: ShardMapper,
+                         raw_planner: SingleClusterPlanner):
+        from filodb_tpu.downsample import (DownsampleClusterPlanner,
+                                           DownsampledTimeSeriesStore,
+                                           ShardDownsampler)
+        from filodb_tpu.query.planners import LongTimeRangePlanner
+        ds_store = DownsampledTimeSeriesStore(
+            dc.name, column_store=self.column_store,
+            meta_store=self.meta_store,
+            resolutions=dc.downsample_resolutions, config=self.config)
+        self.ds_stores[dc.name] = ds_store
+        for s in range(dc.num_shards):
+            ds_store.setup_shard(s)
+            ds_store.refresh_index(s)
+            dsr = ShardDownsampler(resolutions=dc.downsample_resolutions)
+            raw_shard = self.memstore.get_shard(dc.name, s)
+            raw_shard.shard_downsampler = dsr
+        ds_planner = DownsampleClusterPlanner(ds_store, mapper)
+        earliest = self._earliest_raw_time
+        return LongTimeRangePlanner(
+            raw_planner, ds_planner,
+            earliest_raw_time_fn=lambda: earliest(dc.name),
+            latest_downsample_time_fn=lambda: 1 << 62)
+
+    def _earliest_raw_time(self, dataset: str) -> int:
+        """Raw retention floor: earliest live sample across shards (a real
+        deployment derives this from retention config)."""
+        import numpy as np
+        out = []
+        for sh in self.memstore.shards_for(dataset):
+            for store in sh.stores.values():
+                live = store.ts[:store.num_series]
+                if live.size:
+                    valid = live[live > 0]
+                    if valid.size:
+                        out.append(int(valid.min()))
+        return min(out) if out else 0
+
+    def _source(self):
+        server = self
+
+        class _Source:
+            """Routes leaf dataset names to raw or downsample stores."""
+            def get_shard(self, dataset: str, shard_num: int):
+                if "::ds::" in dataset:
+                    raw = dataset.split("::ds::")[0]
+                    ds_store = server.ds_stores.get(raw)
+                    return ds_store.get_shard(dataset, shard_num) \
+                        if ds_store else None
+                return server.memstore.get_shard(dataset, shard_num)
+        return _Source()
+
+    # ------------------------------------------------------------ lifecycle
+
+    def start(self) -> None:
+        self.http.start()
+
+    def shutdown(self) -> None:
+        self.http.stop()
+
+    def flush_and_downsample(self, dataset: str) -> int:
+        """Flush all shards, then feed accumulated downsample records into
+        the downsample store (the streaming ShardDownsampler → downsample
+        ingestion hop, ref: ShardDownsampler.scala publishToDownsampleDataset)."""
+        n = 0
+        ds_store = self.ds_stores.get(dataset)
+        for sh in self.memstore.shards_for(dataset):
+            sh.flush_all_groups()
+            if ds_store is not None and sh.shard_downsampler is not None:
+                n += ds_store.ingest_downsample_batches(
+                    sh.shard_num, sh.shard_downsampler.result_batches())
+        return n

@@ -1,0 +1,187 @@
+"""HTTP API + CLI + standalone server tests (models ref:
+http/src/test/.../PrometheusApiRouteSpec, cli usage in doc/)."""
+import json
+import os
+import urllib.request
+
+import numpy as np
+import pytest
+
+from filodb_tpu.ingest.generator import counter_batch, gauge_batch
+from filodb_tpu.standalone import DatasetConfig, FiloServer
+
+START = 1_600_000_020_000
+START_S = START // 1000
+
+
+@pytest.fixture(scope="module")
+def server():
+    srv = FiloServer([DatasetConfig("prometheus", num_shards=1)],
+                     http_port=0)
+    shard = srv.memstore.get_shard("prometheus", 0)
+    shard.ingest(gauge_batch(10, 720, start_ms=START))
+    shard.ingest(counter_batch(6, 720, start_ms=START))
+    srv.start()
+    yield srv
+    srv.shutdown()
+
+
+def _get(srv, path, **params):
+    import urllib.parse
+    url = (f"http://127.0.0.1:{srv.http.port}{path}?"
+           + urllib.parse.urlencode(params))
+    with urllib.request.urlopen(url, timeout=30) as r:
+        return r.status, json.loads(r.read())
+
+
+def test_health(server):
+    st, payload = _get(server, "/__health")
+    assert st == 200 and payload["status"] == "healthy"
+
+
+def test_query_range_http(server):
+    st, payload = _get(
+        server, "/promql/prometheus/api/v1/query_range",
+        query='sum(rate(request_total[5m]))',
+        start=START_S + 600, end=START_S + 7200, step=60)
+    assert st == 200, payload
+    assert payload["status"] == "success"
+    result = payload["data"]["result"]
+    assert len(result) == 1
+    assert len(result[0]["values"]) > 50
+    assert float(result[0]["values"][0][1]) > 0
+
+
+def test_query_instant_http(server):
+    st, payload = _get(server, "/promql/prometheus/api/v1/query",
+                       query='heap_usage{_ws_="demo"}',
+                       time=START_S + 3600)
+    assert st == 200 and payload["data"]["resultType"] == "vector"
+    assert len(payload["data"]["result"]) == 10
+
+
+def test_default_dataset_alias(server):
+    st, payload = _get(server, "/api/v1/query",
+                       query="request_total", time=START_S + 3600)
+    assert st == 200
+    assert len(payload["data"]["result"]) == 6
+
+
+def test_labels_and_values(server):
+    st, payload = _get(server, "/promql/prometheus/api/v1/labels")
+    assert st == 200 and "_ns_" in payload["data"]
+    st, payload = _get(server,
+                       "/promql/prometheus/api/v1/label/_ws_/values")
+    assert st == 200 and payload["data"] == ["demo"]
+
+
+def test_series_endpoint(server):
+    st, payload = _get(server, "/promql/prometheus/api/v1/series",
+                       **{"match[]": 'heap_usage{_ws_="demo"}',
+                          "start": START_S, "end": START_S + 7200})
+    assert st == 200
+    assert len(payload["data"]) == 10
+    assert all(s["_metric_"] == "heap_usage" for s in payload["data"])
+
+
+def test_explain_plan(server):
+    st, payload = _get(server, "/promql/prometheus/api/v1/query_range",
+                       query='sum(rate(request_total[5m]))',
+                       start=START_S, end=START_S + 3600, step=60,
+                       explain="true")
+    assert st == 200
+    tree = "\n".join(payload["data"]["result"])
+    assert "ReduceAggregateExec" in tree
+    assert "MultiSchemaPartitionsExec" in tree
+    assert "PeriodicSamplesMapper" in tree
+
+
+def test_cluster_status(server):
+    st, payload = _get(server, "/cluster/prometheus/status")
+    assert st == 200
+    assert payload["data"][0]["status"] == "Active"
+
+
+def test_parse_error_is_400(server):
+    import urllib.error
+    try:
+        _get(server, "/promql/prometheus/api/v1/query_range",
+             query="sum(((", start=START_S, end=START_S + 60, step=60)
+        assert False, "expected HTTPError"
+    except urllib.error.HTTPError as e:
+        assert e.code == 400
+        assert json.loads(e.read())["status"] == "error"
+
+
+def test_influx_write_roundtrip(server):
+    lines = "\n".join(
+        f"cpu_temp,_ws_=demo,_ns_=App-0,host=h{i} value={20+i} "
+        f"{(START + 1000) * 1_000_000}" for i in range(4))
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{server.http.port}/influx/write?db=prometheus",
+        data=lines.encode(), method="POST")
+    with urllib.request.urlopen(req, timeout=30) as r:
+        assert r.status == 204
+    st, payload = _get(server, "/promql/prometheus/api/v1/query",
+                       query="cpu_temp", time=START_S + 300)
+    assert st == 200
+    assert len(payload["data"]["result"]) == 4
+
+
+def test_loglevel_admin(server):
+    import logging
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{server.http.port}/admin/loglevel/filodb.test",
+        data=b"DEBUG", method="POST")
+    with urllib.request.urlopen(req, timeout=30) as r:
+        assert r.status == 200
+    assert logging.getLogger("filodb.test").level == logging.DEBUG
+
+
+# ------------------------------------------------------------------- CLI
+
+
+def test_cli_roundtrip(tmp_path):
+    from filodb_tpu.cli import main
+    data_dir = str(tmp_path / "data")
+    assert main(["init", "--data-dir", data_dir]) == 0
+
+    csv = tmp_path / "in.csv"
+    rows = ["metric,tags,timestamp,value"]
+    for i in range(50):
+        rows.append(f"cpu_load,host=h{i % 5},{START + i * 10_000},{i * 1.5}")
+    csv.write_text("\n".join(rows))
+    assert main(["importcsv", "--data-dir", data_dir,
+                 "--file", str(csv)]) == 0
+
+    assert main(["list", "--data-dir", data_dir]) == 0
+    assert main(["indexnames", "--data-dir", data_dir]) == 0
+    assert main(["indexvalues", "--data-dir", data_dir,
+                 "--label", "host"]) == 0
+    assert main(["validateSchemas"]) == 0
+    assert main(["decodechunks", "--data-dir", data_dir]) == 0
+    assert main(["query", "--data-dir", data_dir,
+                 "--promql", "cpu_load",
+                 "--start", str(START_S), "--end", str(START_S + 600),
+                 "--step", "60"]) == 0
+
+
+def test_cli_query_output(tmp_path, capsys):
+    from filodb_tpu.cli import main
+    data_dir = str(tmp_path / "data")
+    main(["init", "--data-dir", data_dir])
+    csv = tmp_path / "in.csv"
+    rows = ["metric,tags,timestamp,value"]
+    for i in range(30):
+        rows.append(f"mem_used,app=web,{START + i * 10_000},{100 + i}")
+    csv.write_text("\n".join(rows))
+    main(["importcsv", "--data-dir", data_dir, "--file", str(csv)])
+    capsys.readouterr()
+    rc = main(["query", "--data-dir", data_dir, "--raw",
+               "--promql", 'mem_used{app="web"}',
+               "--start", str(START_S), "--end", str(START_S + 300),
+               "--step", "60"])
+    assert rc == 0
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["status"] == "success"
+    assert payload["data"]["result"][0]["metric"]["app"] == "web"
