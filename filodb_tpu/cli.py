@@ -52,6 +52,8 @@ def _http_get(host: str, path: str, params: Dict[str, str]) -> dict:
             return json.loads(e.read())
         except Exception:  # noqa: BLE001 — non-JSON error body
             return {"status": "error", "error": f"HTTP {e.code}: {e.reason}"}
+    except urllib.error.URLError as e:
+        return {"status": "error", "error": f"cannot reach {host}: {e.reason}"}
 
 
 # ------------------------------------------------------------------ commands
@@ -118,8 +120,7 @@ def cmd_indexvalues(args) -> int:
     ms, _, _ = _open_local(args.data_dir, args.dataset, args.shards)
     counts: Dict[str, int] = {}
     for sh in ms.shards_for(args.dataset):
-        for v in sh.index.label_values(args.label):
-            val, cnt = v if isinstance(v, tuple) else (v, 1)
+        for val, cnt in sh.index.label_value_counts(args.label):
             counts[val] = counts.get(val, 0) + cnt
     for val, cnt in sorted(counts.items(), key=lambda kv: -kv[1])[:args.limit]:
         print(f"{cnt:>8}  {val}")

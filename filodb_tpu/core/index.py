@@ -201,6 +201,15 @@ class PartKeyIndex:
         vals = sorted(out)
         return vals[:limit] if limit else vals
 
+    def label_value_counts(self, label: str) -> List[Tuple[str, int]]:
+        """(value, series count) pairs, most numerous first — the cardinality
+        view behind indexvalues/topkcard (ref: PartKeyLuceneIndex
+        indexValues with counts, CliMain indexvalues)."""
+        key = "__name__" if label in ("__name__", "_metric_") else label
+        out = [(v, len(plist))
+               for v, plist in self._postings.get(key, {}).items()]
+        return sorted(out, key=lambda kv: (-kv[1], kv[0]))
+
     def label_names(self, filters: Sequence[ColumnFilter] = (),
                     start_time_ms: int = 0, end_time_ms: int = MAX_TIME) -> List[str]:
         if not filters:

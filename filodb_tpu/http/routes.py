@@ -14,6 +14,7 @@ akka-http testkit).
 """
 from __future__ import annotations
 
+import json
 import logging
 from typing import Callable, Dict, List, Optional, Tuple
 
@@ -35,19 +36,23 @@ class PromHttpApi:
     # ------------------------------------------------------------ dispatch
 
     def handle(self, method: str, path: str, params: Dict[str, str],
-               body: bytes = b"") -> Tuple[int, object]:
+               body: bytes = b"",
+               multi_params: Optional[Dict[str, List[str]]] = None
+               ) -> Tuple[int, object]:
         parts = [p for p in path.split("/") if p]
+        multi = multi_params or {k: [v] for k, v in params.items()}
         try:
             if parts == ["__health"]:
                 return 200, {"status": "healthy"}
             if parts[:1] == ["promql"] and len(parts) >= 4 \
                     and parts[2] == "api" and parts[3] == "v1":
-                return self._api_v1(parts[1], parts[4:], method, params, body)
+                return self._api_v1(parts[1], parts[4:], method, params,
+                                    body, multi)
             if parts[:2] == ["api", "v1"]:
                 if self.default_dataset is None:
                     return 404, _err("no datasets registered")
                 return self._api_v1(self.default_dataset, parts[2:], method,
-                                    params, body)
+                                    params, body, multi)
             if parts[:1] == ["cluster"] and len(parts) >= 3 \
                     and parts[2] == "status":
                 return self._cluster_status(parts[1])
@@ -58,13 +63,17 @@ class PromHttpApi:
                     and parts[1] == "write" and method == "POST":
                 return self._influx_write(params, body)
             return 404, _err(f"no route for {method} {path}")
+        except (KeyError, ValueError) as e:
+            # missing/malformed client parameters are the client's fault
+            return 400, _err(f"bad request parameter: {e}")
         except Exception as e:  # noqa: BLE001 — HTTP edge turns errors into 500s
             return 500, _err(f"{type(e).__name__}: {e}")
 
     # ----------------------------------------------------------- prom api
 
     def _api_v1(self, dataset: str, rest: List[str], method: str,
-                params: Dict[str, str], body: bytes) -> Tuple[int, object]:
+                params: Dict[str, str], body: bytes,
+                multi: Dict[str, List[str]]) -> Tuple[int, object]:
         eng = self.engines.get(dataset)
         if eng is None:
             return 404, _err(f"dataset {dataset!r} not found")
@@ -88,11 +97,12 @@ class PromHttpApi:
             payload = QueryEngine.to_prom_vector(res)
             return (200 if payload["status"] == "success" else 400), payload
         if rest == ["labels"]:
-            return self._metadata(eng, "labels", params)
+            return self._metadata(eng, "labels", params, multi)
         if len(rest) == 3 and rest[0] == "label" and rest[2] == "values":
-            return self._metadata(eng, "label_values", params, label=rest[1])
+            return self._metadata(eng, "label_values", params, multi,
+                                  label=rest[1])
         if rest == ["series"]:
-            return self._metadata(eng, "series", params)
+            return self._metadata(eng, "series", params, multi)
         return 404, _err(f"unknown api/v1 endpoint {'/'.join(rest)}")
 
     def _explain(self, eng: QueryEngine, q: str, start: int, step: int,
@@ -109,34 +119,47 @@ class PromHttpApi:
                               "result": ep.print_tree().splitlines()}}
 
     def _metadata(self, eng: QueryEngine, kind: str, params: Dict[str, str],
+                  multi: Dict[str, List[str]],
                   label: Optional[str] = None) -> Tuple[int, object]:
         from filodb_tpu.promql.parser import parse_query, _filters
         from filodb_tpu.promql import ast as A
         from filodb_tpu.query import logical as lp
         start = int(float(params.get("start", "0"))) * 1000
         end = int(float(params.get("end", "253402300799"))) * 1000
-        filters: Tuple = ()
-        match = params.get("match[]") or params.get("match")
-        if match:
-            sel = parse_query(match)
-            if not isinstance(sel, A.VectorSelector):
-                return 400, _err("match[] must be a vector selector")
-            filters = _filters(sel)
-        if kind == "labels":
-            plan: lp.LogicalPlan = lp.LabelNames(filters, start, end)
-        elif kind == "label_values":
-            plan = lp.LabelValues((label,), filters, start, end)
-        else:
-            plan = lp.SeriesKeysByFilters(filters, start, end)
-        res = eng.exec_logical_plan(plan)
-        if res.error:
-            return 400, _err(res.error)
-        data = res.data or []
-        # the label-values exec returns {label: values}; the Prometheus
-        # endpoint shape is a flat list for a single label
-        if kind == "label_values" and isinstance(data, dict):
-            data = sorted(data.get(label, []))
-        return 200, {"status": "success", "data": data}
+        # the Prometheus API unions results over repeated match[] selectors
+        matches = (multi.get("match[]") or multi.get("match") or [None])
+        merged: Optional[object] = None
+        for match in matches:
+            filters: Tuple = ()
+            if match:
+                sel = parse_query(match)
+                if not isinstance(sel, A.VectorSelector):
+                    return 400, _err("match[] must be a vector selector")
+                filters = _filters(sel)
+            if kind == "labels":
+                plan: lp.LogicalPlan = lp.LabelNames(filters, start, end)
+            elif kind == "label_values":
+                plan = lp.LabelValues((label,), filters, start, end)
+            else:
+                plan = lp.SeriesKeysByFilters(filters, start, end)
+            res = eng.exec_logical_plan(plan)
+            if res.error:
+                return 400, _err(res.error)
+            data = res.data or []
+            if kind == "label_values" and isinstance(data, dict):
+                data = sorted(data.get(label, []))
+            if merged is None:
+                merged = data
+            elif isinstance(merged, list):
+                seen = {json.dumps(x, sort_keys=True) if isinstance(x, dict)
+                        else x for x in merged}
+                for x in data:
+                    c = json.dumps(x, sort_keys=True) if isinstance(x, dict) \
+                        else x
+                    if c not in seen:
+                        seen.add(c)
+                        merged.append(x)
+        return 200, {"status": "success", "data": merged or []}
 
     # ------------------------------------------------------------- cluster
 

@@ -25,8 +25,8 @@ class FiloHttpServer:
         class _Handler(BaseHTTPRequestHandler):
             def _serve(self, method: str):
                 parsed = urllib.parse.urlsplit(self.path)
-                params = {k: v[-1] for k, v in
-                          urllib.parse.parse_qs(parsed.query).items()}
+                multi = urllib.parse.parse_qs(parsed.query)
+                params = {k: v[-1] for k, v in multi.items()}
                 length = int(self.headers.get("Content-Length") or 0)
                 body = self.rfile.read(length) if length else b""
                 # form-decode only for the API routes: write endpoints
@@ -36,12 +36,13 @@ class FiloHttpServer:
                         parsed.path.startswith(("/promql", "/api")) and \
                         self.headers.get("Content-Type", "").startswith(
                             "application/x-www-form-urlencoded"):
-                    form = {k: v[-1] for k, v in
-                            urllib.parse.parse_qs(body.decode()).items()}
+                    form_multi = urllib.parse.parse_qs(body.decode())
+                    form = {k: v[-1] for k, v in form_multi.items()}
                     params = {**form, **params}
+                    multi = {**form_multi, **multi}
                     body = b""
                 status, payload = api_ref.handle(method, parsed.path, params,
-                                                 body)
+                                                 body, multi_params=multi)
                 blob = b"" if status == 204 else json.dumps(payload).encode()
                 self.send_response(status)
                 self.send_header("Content-Type", "application/json")

@@ -83,14 +83,18 @@ class CsvStream(IngestionStream):
                         if k not in ("timestamp", "metric", "__name__",
                                      "tags", *value_cols)
                         and v}
-                # packed tag column: `tags` holds `k=v` pairs split by ';'
+                # packed tag column: `tags` holds `k=v` pairs split by ';';
+                # a plain value stays a literal `tags` label
                 # (the map-column form of the reference's CSV source)
                 packed = row.get("tags")
                 if packed:
-                    for kv in packed.split(";"):
-                        k, _, v = kv.partition("=")
-                        if k and v:
-                            tags[k] = v
+                    if "=" in packed:
+                        for kv in packed.split(";"):
+                            k, _, v = kv.partition("=")
+                            if k and v:
+                                tags[k] = v
+                    else:
+                        tags["tags"] = packed
                 values = {c: float(row[c]) for c in value_cols if c in row}
                 builder.add(PartKey.make(metric, tags),
                             int(row["timestamp"]), **values)

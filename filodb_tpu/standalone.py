@@ -58,6 +58,7 @@ class FiloServer:
         self.engines: Dict[str, QueryEngine] = {}
         self.gateways: Dict[str, GatewayPipeline] = {}
         self.ds_stores: Dict[str, object] = {}
+        self._earliest_cache: Dict[str, tuple] = {}
         for dc in self.datasets:
             self._setup_dataset(dc)
         first = self.datasets[0].name
@@ -123,9 +124,14 @@ class FiloServer:
             latest_downsample_time_fn=lambda: 1 << 62)
 
     def _earliest_raw_time(self, dataset: str) -> int:
-        """Raw retention floor: earliest live sample across shards (a real
-        deployment derives this from retention config)."""
-        import numpy as np
+        """Raw retention floor: earliest live sample across shards, cached a
+        few seconds — this sits on the planning hot path (a real deployment
+        derives it from retention config)."""
+        import time
+        cached = self._earliest_cache.get(dataset)
+        now = time.monotonic()
+        if cached is not None and now - cached[1] < 10.0:
+            return cached[0]
         out = []
         for sh in self.memstore.shards_for(dataset):
             for store in sh.stores.values():
@@ -134,7 +140,9 @@ class FiloServer:
                     valid = live[live > 0]
                     if valid.size:
                         out.append(int(valid.min()))
-        return min(out) if out else 0
+        val = min(out) if out else 0
+        self._earliest_cache[dataset] = (val, now)
+        return val
 
     def _source(self):
         server = self

@@ -1,0 +1,141 @@
+"""ShardManager tests (models ref: coordinator/src/test/.../ShardManagerSpec,
+ShardAssignmentStrategySpec — assignment/failover without a real network)."""
+import pytest
+
+from filodb_tpu.parallel.shardmanager import (DatasetResourceSpec,
+                                              ShardManager, ShardSnapshot)
+from filodb_tpu.parallel.shardmapper import ShardEvent, ShardStatus
+
+DS = "prometheus"
+RES = DatasetResourceSpec(num_shards=8, min_num_nodes=2)
+
+
+def _mgr(t0=1000.0):
+    state = {"now": t0}
+    m = ShardManager(reassignment_min_interval_s=600.0,
+                     clock=lambda: state["now"])
+    return m, state
+
+
+def test_even_assignment_across_nodes():
+    mgr, _ = _mgr()
+    mgr.add_member("nodeA")
+    mgr.add_member("nodeB")
+    mapper = mgr.setup_dataset(DS, RES)
+    assert sorted(mapper.shards_for_node("nodeA") +
+                  mapper.shards_for_node("nodeB")) == list(range(8))
+    assert len(mapper.shards_for_node("nodeA")) == 4
+    assert len(mapper.shards_for_node("nodeB")) == 4
+    assert all(s == ShardStatus.ASSIGNED for s in mapper.statuses)
+
+
+def test_join_after_setup_takes_unassigned():
+    mgr, _ = _mgr()
+    mgr.add_member("nodeA")
+    mapper = mgr.setup_dataset(DS, RES)
+    # capacity ceil(8/2)=4: half the shards wait for a second node
+    assert len(mapper.shards_for_node("nodeA")) == 4
+    assert mapper.num_assigned == 4
+    got = mgr.add_member("nodeB")
+    assert len(got[DS]) == 4
+    assert mapper.num_assigned == 8
+
+
+def test_excess_nodes_get_nothing_until_needed():
+    mgr, _ = _mgr()
+    for n in ("a", "b", "c"):
+        mgr.add_member(n)
+    mapper = mgr.setup_dataset(DS, RES)
+    assert mapper.num_assigned == 8
+    counts = sorted(len(mapper.shards_for_node(n)) for n in ("a", "b", "c"))
+    assert counts == [0, 4, 4]      # reverse deploy order fills newest first
+
+
+def test_failover_reassigns_downed_shards():
+    mgr, state = _mgr()
+    mgr.add_member("nodeA")
+    mgr.add_member("nodeB")
+    mgr.add_member("nodeC")         # spare capacity
+    mapper = mgr.setup_dataset(DS, RES)
+    lost = mapper.shards_for_node("nodeB") or mapper.shards_for_node("nodeC")
+    owner = "nodeB" if mapper.shards_for_node("nodeB") else "nodeC"
+    affected = mgr.remove_member(owner)
+    assert affected[DS] == lost
+    # reassigned to the spare node — nothing left unassigned
+    assert mapper.num_assigned == 8
+    assert not mapper.shards_for_node(owner)
+
+
+def test_reassignment_rate_limit():
+    mgr, state = _mgr()
+    mgr.add_member("a")
+    mgr.add_member("b")
+    mapper = mgr.setup_dataset(DS, RES)
+    # kill b; no spare node -> shards stay down
+    mgr.remove_member("b")
+    assert mapper.num_assigned == 4
+    mgr.add_member("c")             # c picks the downed shards up (first move)
+    assert mapper.num_assigned == 8
+    # kill c immediately: the same shards just moved; rate limit blocks
+    mgr.remove_member("c")
+    mgr.add_member("d")
+    assert mapper.num_assigned == 4, "rate limit should block immediate move"
+    # ... until the interval passes
+    state["now"] += 601.0
+    mgr.add_member("e")
+    assert mapper.num_assigned == 8
+
+
+def test_subscriber_gets_snapshot_then_events():
+    mgr, _ = _mgr()
+    mgr.add_member("a")
+    mgr.add_member("b")
+    mgr.setup_dataset(DS, RES)
+    got = []
+    mgr.subscribe(DS, got.append)
+    assert isinstance(got[0], ShardSnapshot)
+    assert got[0].statuses == ["Assigned"] * 8
+    mgr.on_shard_event(ShardEvent("IngestionStarted", DS, 0, "a"))
+    assert isinstance(got[-1], ShardEvent)
+    assert got[-1].kind == "IngestionStarted"
+    assert mgr.mapper(DS).statuses[0] == ShardStatus.ACTIVE
+
+
+def test_error_shard_returns_to_pool_and_reassigns():
+    mgr, state = _mgr()
+    mgr.add_member("a")
+    mgr.add_member("b")
+    mgr.add_member("c")
+    mapper = mgr.setup_dataset(DS, RES)
+    victim = mapper.shards_for_node("b")[0] if mapper.shards_for_node("b") \
+        else mapper.shards_for_node("c")[0]
+    owner = mapper.node_for_shard(victim)
+    mgr.on_shard_event(ShardEvent("IngestionError", DS, victim, owner))
+    # shard moved to a node with spare capacity
+    assert mapper.node_for_shard(victim) is not None
+    assert mapper.node_for_shard(victim) != owner
+
+
+def test_singleton_recovery_from_snapshots():
+    mgr, _ = _mgr()
+    mgr.add_member("a")
+    mgr.add_member("b")
+    mapper = mgr.setup_dataset(DS, RES)
+    for s in range(8):
+        mgr.on_shard_event(ShardEvent("IngestionStarted", DS, s,
+                                      mapper.node_for_shard(s)))
+    snap = mgr.snapshot(DS)
+
+    # new singleton after failover
+    mgr2, _ = _mgr()
+    mgr2.recover({DS: RES}, ["a", "b"], {DS: snap})
+    m2 = mgr2.mapper(DS)
+    assert m2.nodes == mapper.nodes
+    assert [s.value for s in m2.statuses] == ["Active"] * 8
+
+
+def test_recovery_assigns_leftovers():
+    mgr, _ = _mgr()
+    snap = ShardSnapshot(DS, [None] * 8, ["Unassigned"] * 8)
+    mgr.recover({DS: RES}, ["a", "b"], {DS: snap})
+    assert mgr.mapper(DS).num_assigned == 8
