@@ -127,6 +127,33 @@ def cmd_indexvalues(args) -> int:
     return 0
 
 
+def cmd_topkcard(args) -> int:
+    """Top-k cardinality prefixes (ref: CliMain `topkcard`).  Over HTTP when
+    --host is given; otherwise rebuilt from the recovered local index."""
+    if args.host:
+        payload = _http_get(
+            args.host, f"/promql/{args.dataset}/api/v1/metering/cardinality",
+            {"prefix": args.prefix, "k": str(args.k)})
+        print(json.dumps(payload, indent=2))
+        return 0 if payload.get("status") == "success" else 2
+    from filodb_tpu.core.ratelimit import CardinalityTracker
+    ms, _, _ = _open_local(args.data_dir, args.dataset, args.shards)
+    tracker = CardinalityTracker()
+    for sh in ms.shards_for(args.dataset):
+        opts = sh.schemas.part.options
+        for info in sh.partitions:
+            if info is None:
+                continue
+            sk = info.part_key.shard_key(sh.schemas.part)
+            tracker.series_created(
+                tuple(sk.get(c, "") for c in opts.shard_key_columns))
+    prefix = tuple(p for p in args.prefix.split(",") if p)
+    for rec in tracker.top_k(prefix, args.k):
+        print(f"{rec.ts_count:>8}  {'/'.join(rec.prefix) or '(root)'}  "
+              f"children={rec.children_count}")
+    return 0
+
+
 def cmd_query(args) -> int:
     """PromQL range query (ref: CliMain `timeseries` query commands)."""
     end = args.end or int(time.time())
@@ -245,6 +272,14 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--label", required=True)
     sp.add_argument("--limit", type=int, default=20)
     sp.set_defaults(fn=cmd_indexvalues)
+
+    sp = sub.add_parser("topkcard", help="top-k cardinality by prefix")
+    common(sp)
+    sp.add_argument("--prefix", default="",
+                    help="comma-separated shard-key prefix, e.g. demo,App-1")
+    sp.add_argument("--k", type=int, default=10)
+    sp.add_argument("--host", default="")
+    sp.set_defaults(fn=cmd_topkcard)
 
     sp = sub.add_parser("query", help="PromQL range query")
     common(sp)

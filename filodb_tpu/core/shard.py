@@ -19,6 +19,7 @@ from filodb_tpu.config import FilodbSettings, settings as default_settings
 from filodb_tpu.core.blockstore import DenseSeriesStore
 from filodb_tpu.core.index import ColumnFilter, PartKeyIndex, MAX_TIME
 from filodb_tpu.core.partkey import PartKey
+from filodb_tpu.core.ratelimit import QuotaReachedException
 from filodb_tpu.core.records import RecordBatch
 from filodb_tpu.core.schemas import Schemas, DEFAULT_SCHEMAS
 from filodb_tpu.core.store import (ColumnStore, MetaStore, NullColumnStore,
@@ -48,6 +49,7 @@ class ShardStats:
     chunks_flushed: int = 0
     flushes: int = 0
     evictions: int = 0
+    quota_dropped: int = 0          # series rejected by cardinality quota
 
 
 @dataclasses.dataclass
@@ -83,6 +85,9 @@ class TimeSeriesShard:
         # optional streaming downsampler fed at flush (ref:
         # ShardDownsampler.scala:103 populateDownsampleRecords at doFlushSteps)
         self.shard_downsampler = None
+        # optional cardinality tracker enforcing quotas at series creation
+        # (ref: TimeSeriesShard cardTracker, ratelimit/CardinalityTracker)
+        self.cardinality_tracker = None
 
     # ------------------------------------------------------------------ ingest
 
@@ -105,6 +110,13 @@ class TimeSeriesShard:
         pid = self.part_set.get(kb)
         if pid is not None:
             return self.partitions[pid]
+        if self.cardinality_tracker is not None:
+            # raises QuotaReachedException before any state is touched
+            # (ref: TimeSeriesShard.createNewPartition quota protocol)
+            sk = part_key.shard_key(self.schemas.part)
+            self.cardinality_tracker.series_created(
+                tuple(sk.get(c, "") for c in
+                      self.schemas.part.options.shard_key_columns))
         pid = len(self.partitions)
         store = self._store_for(schema_name)
         # group from the stable partKey hash, NOT partId: replay filtering by
@@ -133,10 +145,25 @@ class TimeSeriesShard:
         rows_for_key = np.full(len(batch.part_keys), -1, dtype=np.int64)
         uniq, first = np.unique(batch.part_idx, return_index=True)
         for k, ts0 in zip(uniq.tolist(), batch.timestamps[first].tolist()):
-            info = self.get_or_create_partition(
-                batch.part_keys[k], batch.schema.name, ts0)
+            try:
+                info = self.get_or_create_partition(
+                    batch.part_keys[k], batch.schema.name, ts0)
+            except QuotaReachedException:
+                # quota-rejected series: drop its records, count them
+                # (ref: TimeSeriesShard ingest QuotaReachedException handling)
+                self.stats.quota_dropped += 1
+                continue
             rows_for_key[k] = info.row
         rows = rows_for_key[batch.part_idx]
+        keep = rows >= 0
+        if not keep.all():
+            dropped = int((~keep).sum())
+            self.stats.rows_dropped += dropped
+            rows = rows[keep]
+            batch = RecordBatch(batch.schema, batch.part_keys,
+                                batch.part_idx[keep], batch.timestamps[keep],
+                                {k: v[keep] for k, v in batch.columns.items()},
+                                batch.bucket_les)
         n = store.append_batch(rows, batch.timestamps, batch.columns,
                                batch.bucket_les)
         self.stats.rows_ingested += n
@@ -339,8 +366,12 @@ class TimeSeriesShard:
         (ref: TimeSeriesShard.recoverIndex:600, IndexBootstrapper.scala)."""
         n = 0
         for rec in self.column_store.read_part_keys(self.dataset, self.shard_num):
-            info = self.get_or_create_partition(
-                rec.part_key, rec.schema_name, rec.start_time_ms)
+            try:
+                info = self.get_or_create_partition(
+                    rec.part_key, rec.schema_name, rec.start_time_ms)
+            except QuotaReachedException:
+                self.stats.quota_dropped += 1
+                continue
             if rec.end_time_ms < MAX_TIME:
                 self.index.update_end_time(info.part_id, rec.end_time_ms)
             n += 1
@@ -389,6 +420,11 @@ class TimeSeriesShard:
                 self.index.remove_partition(info.part_id)
                 self.part_set.pop(info.part_key.to_bytes(), None)
                 self.partitions[info.part_id] = None
+                if self.cardinality_tracker is not None:
+                    sk = info.part_key.shard_key(self.schemas.part)
+                    self.cardinality_tracker.series_stopped(
+                        tuple(sk.get(c, "") for c in
+                              self.schemas.part.options.shard_key_columns))
                 evicted += 1
                 self.stats.evictions += 1
         return evicted

@@ -63,9 +63,8 @@ class PromHttpApi:
                     and parts[1] == "write" and method == "POST":
                 return self._influx_write(params, body)
             return 404, _err(f"no route for {method} {path}")
-        except (KeyError, ValueError) as e:
-            # missing/malformed client parameters are the client's fault
-            return 400, _err(f"bad request parameter: {e}")
+        except _BadRequest as e:
+            return 400, _err(str(e))
         except Exception as e:  # noqa: BLE001 — HTTP edge turns errors into 500s
             return 500, _err(f"{type(e).__name__}: {e}")
 
@@ -80,9 +79,9 @@ class PromHttpApi:
         planner_params = _planner_params(params)
         if rest == ["query_range"]:
             q = params.get("query", "")
-            start = int(float(params["start"]))
-            end = int(float(params["end"]))
-            step = max(int(float(params.get("step", "15"))), 1)
+            start = _num_param(params, "start")
+            end = _num_param(params, "end")
+            step = max(_num_param(params, "step", "15"), 1)
             if params.get("explain") in ("true", "1"):
                 return self._explain(eng, q, start, step, end)
             res = eng.query_range(q, start, step, end, planner_params)
@@ -90,7 +89,7 @@ class PromHttpApi:
             return (200 if payload["status"] == "success" else 400), payload
         if rest == ["query"]:
             q = params.get("query", "")
-            t = int(float(params.get("time", "0")))
+            t = _num_param(params, "time", "0")
             if params.get("explain") in ("true", "1"):
                 return self._explain(eng, q, t, 1, t)
             res = eng.query_instant(q, t, planner_params)
@@ -103,7 +102,39 @@ class PromHttpApi:
                                   label=rest[1])
         if rest == ["series"]:
             return self._metadata(eng, "series", params, multi)
+        if rest == ["metering", "cardinality"]:
+            return self._cardinality(dataset, params)
         return 404, _err(f"unknown api/v1 endpoint {'/'.join(rest)}")
+
+    def _cardinality(self, dataset: str,
+                     params: Dict[str, str]) -> Tuple[int, object]:
+        """Top-k child prefixes by series count, merged across shards
+        (ref: TsCardinalities logical plan / ClusterApiRoute cardinality)."""
+        eng = self.engines[dataset]
+        prefix = tuple(p for p in params.get("prefix", "").split(",") if p)
+        k = _num_param(params, "k", "10")
+        merged: Dict[Tuple[str, ...], Dict[str, int]] = {}
+        source = getattr(eng, "source", None)
+        mapper = self.shard_mappers.get(dataset)
+        shard_ids = mapper.all_shards() if mapper is not None else [0]
+        for s in shard_ids:
+            shard = source.get_shard(dataset, s) if source else None
+            tracker = getattr(shard, "cardinality_tracker", None)
+            if tracker is None:
+                continue
+            # merge FULL child lists — per-shard top-k truncation would
+            # undercount prefixes that rank differently across shards
+            for rec in tracker.children(prefix):
+                agg = merged.setdefault(rec.prefix, {"ts": 0, "active": 0,
+                                                     "children": 0})
+                agg["ts"] += rec.ts_count
+                agg["active"] += rec.active_ts_count
+                agg["children"] += rec.children_count
+        rows = [{"prefix": list(p), "tsCount": v["ts"],
+                 "activeTsCount": v["active"], "childrenCount": v["children"]}
+                for p, v in merged.items()]
+        rows.sort(key=lambda r: -r["tsCount"])
+        return 200, {"status": "success", "data": rows[:k]}
 
     def _explain(self, eng: QueryEngine, q: str, start: int, step: int,
                  end: int) -> Tuple[int, object]:
@@ -124,8 +155,8 @@ class PromHttpApi:
         from filodb_tpu.promql.parser import parse_query, _filters
         from filodb_tpu.promql import ast as A
         from filodb_tpu.query import logical as lp
-        start = int(float(params.get("start", "0"))) * 1000
-        end = int(float(params.get("end", "253402300799"))) * 1000
+        start = _num_param(params, "start", "0") * 1000
+        end = _num_param(params, "end", "253402300799") * 1000
         # the Prometheus API unions results over repeated match[] selectors
         matches = (multi.get("match[]") or multi.get("match") or [None])
         merged: Optional[object] = None
@@ -159,6 +190,11 @@ class PromHttpApi:
                     if c not in seen:
                         seen.add(c)
                         merged.append(x)
+        # label names/values keep their sorted-output contract across the
+        # multi-match union; series dicts stay in discovery order
+        if isinstance(merged, list) and \
+                all(isinstance(x, str) for x in merged):
+            merged = sorted(merged)
         return 200, {"status": "success", "data": merged or []}
 
     # ------------------------------------------------------------- cluster
@@ -195,16 +231,31 @@ class PromHttpApi:
         return 204, {}
 
 
+class _BadRequest(Exception):
+    """Client-side parameter problem → HTTP 400 (internal errors stay 500)."""
+
+
+def _num_param(params: Dict[str, str], key: str,
+               default: Optional[str] = None) -> int:
+    raw = params.get(key, default)
+    if raw is None:
+        raise _BadRequest(f"missing required parameter {key!r}")
+    try:
+        return int(float(raw))
+    except ValueError:
+        raise _BadRequest(f"parameter {key!r} is not a number: {raw!r}")
+
+
 def _planner_params(params: Dict[str, str]) -> Optional[PlannerParams]:
     """spread / sample-limit overrides (ref: PrometheusApiRoute query params
     `spread`, `histogramMap`)."""
     pp = PlannerParams()
     changed = False
     if "spread" in params:
-        pp.spread = int(params["spread"])
+        pp.spread = _num_param(params, "spread")
         changed = True
     if "limit" in params:
-        pp.sample_limit = int(params["limit"])
+        pp.sample_limit = _num_param(params, "limit")
         changed = True
     return pp if changed else None
 

@@ -187,19 +187,28 @@ class ShardManager:
         resources = self._datasets[dataset]
         mapper = self._mappers[dataset]
         now = self.clock()
-        assigned = []
-        # the strategy proposes from the unassigned pool; re-ask after each
-        # skip so capacity accounting stays exact
-        proposals = self.strategy.shards_for_node(node, dataset, resources,
-                                                  mapper)
-        for s in proposals:
+        assigned: List[int] = []
+        skipped: set = set()
+        # re-ask the strategy after every assignment/skip so an ineligible
+        # proposal (rate-limited / error-pinned) is replaced by the next
+        # eligible shard instead of wasting the node's capacity slot
+        while True:
+            proposals = [
+                s for s in self.strategy.shards_for_node(node, dataset,
+                                                         resources, mapper)
+                if s not in skipped]
+            if not proposals:
+                break
+            s = proposals[0]
             key = (dataset, s)
             if self._error_node.get(key) == node:
+                skipped.add(s)
                 continue
             if key in self._ever_assigned:
                 last = self._last_reassign.get(key)
                 if last is not None and \
                         now - last < self.reassignment_min_interval_s:
+                    skipped.add(s)
                     continue
                 self._last_reassign[key] = now
             self._ever_assigned.add(key)

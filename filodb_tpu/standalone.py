@@ -70,11 +70,19 @@ class FiloServer:
     # ------------------------------------------------------------- wiring
 
     def _setup_dataset(self, dc: DatasetConfig) -> None:
+        from filodb_tpu.core.ratelimit import CardinalityTracker, QuotaSource
         mapper = ShardMapper(dc.num_shards)
         spread = SpreadProvider(default_spread=self.config.spread_default)
+        quota_source = QuotaSource(self.config.quota_default)
         shards = []
         for s in range(dc.num_shards):
             shard = self.memstore.setup(dc.name, s)
+            # tracker attaches BEFORE index recovery so recovered series are
+            # counted and quotas survive restarts by recount
+            shard.cardinality_tracker = CardinalityTracker(
+                shard_key_len=len(
+                    self.memstore.schemas.part.options.shard_key_columns),
+                quota_source=quota_source)
             shard.recover_index()
             shards.append(shard)
             mapper.update_from_event(

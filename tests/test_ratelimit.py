@@ -1,0 +1,130 @@
+"""Cardinality tracking + quota tests (models ref: core/src/test/.../
+ratelimit/CardinalityTrackerSpec, RocksDbCardinalityStoreSpec)."""
+import json
+import urllib.request
+
+import pytest
+
+from filodb_tpu.core.memstore import TimeSeriesMemStore
+from filodb_tpu.core.ratelimit import (CardinalityTracker,
+                                       InMemoryCardinalityStore,
+                                       QuotaReachedException, QuotaSource,
+                                       SqliteCardinalityStore)
+from filodb_tpu.ingest.generator import gauge_batch
+
+START = 1_600_000_020_000
+
+
+def _track_n(tracker, ws, ns, metric, n):
+    for i in range(n):
+        tracker.series_created((ws, ns, f"{metric}{i}"))
+
+
+def test_counts_at_every_depth():
+    t = CardinalityTracker()
+    _track_n(t, "demo", "App-1", "m", 5)
+    _track_n(t, "demo", "App-2", "m", 3)
+    assert t.cardinality(()).ts_count == 8
+    assert t.cardinality(("demo",)).ts_count == 8
+    assert t.cardinality(("demo", "App-1")).ts_count == 5
+    assert t.cardinality(("demo", "App-2")).ts_count == 3
+    assert t.cardinality(("demo",)).children_count == 2
+    top = t.top_k(("demo",), 1)
+    assert top[0].prefix == ("demo", "App-1") and top[0].ts_count == 5
+
+
+def test_quota_enforced_at_prefix():
+    qs = QuotaSource(default_quota=1_000_000)
+    qs.set_quota(("demo", "App-1"), 3)
+    t = CardinalityTracker(quota_source=qs)
+    _track_n(t, "demo", "App-1", "m", 3)
+    with pytest.raises(QuotaReachedException) as ei:
+        t.series_created(("demo", "App-1", "m99"))
+    assert ei.value.prefix == ("demo", "App-1")
+    # sibling namespace unaffected
+    t.series_created(("demo", "App-2", "m0"))
+    # failed creation did not corrupt parent counts
+    assert t.cardinality(("demo",)).ts_count == 4
+
+
+def test_counts_decrement_on_stop_and_churn_is_quota_neutral():
+    t = CardinalityTracker()
+    _track_n(t, "demo", "App-1", "m", 4)
+    t.series_stopped(("demo", "App-1", "m0"))
+    rec = t.cardinality(("demo", "App-1"))
+    # eviction releases quota: both counts drop, re-ingest re-counts
+    assert rec.ts_count == 3 and rec.active_ts_count == 3
+    t.series_created(("demo", "App-1", "m0"))
+    assert t.cardinality(("demo", "App-1")).ts_count == 4
+
+
+def test_evict_reingest_does_not_exhaust_quota():
+    qs = QuotaSource(default_quota=1_000_000)
+    qs.set_quota(("demo",), 3)
+    t = CardinalityTracker(quota_source=qs)
+    for round_ in range(5):           # churn the same 3 series repeatedly
+        for i in range(3):
+            t.series_created(("demo", f"App-{i}", "m"))
+        for i in range(3):
+            t.series_stopped(("demo", f"App-{i}", "m"))
+    assert t.cardinality(("demo",)).ts_count == 0
+
+
+def test_sqlite_store_roundtrip(tmp_path):
+    store = SqliteCardinalityStore(str(tmp_path / "card.db"))
+    t = CardinalityTracker(store=store)
+    _track_n(t, "demo", "App-1", "m", 5)
+    store.close()
+    store2 = SqliteCardinalityStore(str(tmp_path / "card.db"))
+    t2 = CardinalityTracker(store=store2)
+    assert t2.cardinality(("demo", "App-1")).ts_count == 5
+    assert t2.top_k(("demo",), 5)[0].ts_count == 5
+    store2.close()
+
+
+def test_shard_drops_series_over_quota():
+    ms = TimeSeriesMemStore()
+    shard = ms.setup("prometheus", 0)
+    qs = QuotaSource(default_quota=1_000_000)
+    qs.set_quota((), 6)               # only 6 series fit the whole shard
+    shard.cardinality_tracker = CardinalityTracker(quota_source=qs)
+    batch = gauge_batch(10, 100, start_ms=START)
+    n = shard.ingest(batch)
+    assert shard.num_partitions == 6
+    assert shard.stats.quota_dropped == 4
+    assert n == 6 * 100
+    assert shard.stats.rows_dropped == 4 * 100
+
+
+def test_http_cardinality_endpoint():
+    from filodb_tpu.standalone import DatasetConfig, FiloServer
+    srv = FiloServer([DatasetConfig("prometheus", num_shards=1)], http_port=0)
+    srv.memstore.get_shard("prometheus", 0).ingest(
+        gauge_batch(20, 10, start_ms=START))
+    srv.start()
+    try:
+        url = (f"http://127.0.0.1:{srv.http.port}/promql/prometheus/api/v1/"
+               f"metering/cardinality?prefix=&k=5")
+        with urllib.request.urlopen(url, timeout=30) as r:
+            payload = json.loads(r.read())
+        assert payload["status"] == "success"
+        assert payload["data"], "no cardinality rows"
+        assert payload["data"][0]["prefix"] == ["demo"]
+        assert payload["data"][0]["tsCount"] == 20
+    finally:
+        srv.shutdown()
+
+
+def test_cli_topkcard(tmp_path, capsys):
+    from filodb_tpu.cli import main, _open_local
+    data_dir = str(tmp_path / "data")
+    main(["init", "--data-dir", data_dir])
+    ms, _, _ = _open_local(data_dir, "prometheus", 1)
+    sh = ms.get_shard("prometheus", 0)
+    sh.ingest(gauge_batch(12, 10, start_ms=START))
+    sh.flush_all_groups()
+    capsys.readouterr()
+    rc = main(["topkcard", "--data-dir", data_dir, "--prefix", "demo"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "App-" in out
