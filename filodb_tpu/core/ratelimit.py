@@ -176,12 +176,11 @@ class CardinalityTracker:
                     raise QuotaReachedException(prefix, quota)
                 recs.append(rec)
             for d, rec in enumerate(recs):
+                if d < len(recs) - 1 and recs[d + 1].ts_count == 0:
+                    # child prefix transitions 0 -> 1: one more child
+                    rec.children_count += 1
                 rec.ts_count += 1
                 rec.active_ts_count += 1
-                if d < len(recs) - 1:
-                    child = recs[d + 1]
-                    if child.ts_count == 0:     # new child prefix appears
-                        rec.children_count += 1
                 self.store.write(rec)
 
     def series_stopped(self, shard_key: Sequence[str]) -> None:
@@ -191,12 +190,18 @@ class CardinalityTracker:
         negative deltas on partKey removal)."""
         shard_key = tuple(shard_key)[:self.shard_key_len]
         with self._lock:
-            for d in range(len(shard_key) + 1):
-                rec = self.store.read(shard_key[:d])
-                if rec is not None:
-                    rec.ts_count = max(rec.ts_count - 1, 0)
-                    rec.active_ts_count = max(rec.active_ts_count - 1, 0)
-                    self.store.write(rec)
+            recs = [self.store.read(shard_key[:d])
+                    for d in range(len(shard_key) + 1)]
+            for d, rec in enumerate(recs):
+                if rec is None:
+                    continue
+                child = recs[d + 1] if d < len(recs) - 1 else None
+                if child is not None and child.ts_count == 1:
+                    # child prefix transitions 1 -> 0: one fewer child
+                    rec.children_count = max(rec.children_count - 1, 0)
+                rec.ts_count = max(rec.ts_count - 1, 0)
+                rec.active_ts_count = max(rec.active_ts_count - 1, 0)
+                self.store.write(rec)
 
     def set_quota(self, prefix: Sequence[str], quota: int) -> None:
         self.quotas.set_quota(tuple(prefix), quota)

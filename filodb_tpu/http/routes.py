@@ -18,6 +18,7 @@ import json
 import logging
 from typing import Callable, Dict, List, Optional, Tuple
 
+from filodb_tpu.promql.lexer import ParseError
 from filodb_tpu.query.engine import QueryEngine
 from filodb_tpu.query.rangevector import PlannerParams
 
@@ -65,6 +66,10 @@ class PromHttpApi:
             return 404, _err(f"no route for {method} {path}")
         except _BadRequest as e:
             return 400, _err(str(e))
+        except ParseError as e:
+            # PromQL typos in match[]/explain parse outside the engine's
+            # own error capture — still the client's fault
+            return 400, _err(f"parse error: {e}")
         except Exception as e:  # noqa: BLE001 — HTTP edge turns errors into 500s
             return 500, _err(f"{type(e).__name__}: {e}")
 
@@ -242,7 +247,7 @@ def _num_param(params: Dict[str, str], key: str,
         raise _BadRequest(f"missing required parameter {key!r}")
     try:
         return int(float(raw))
-    except ValueError:
+    except (ValueError, OverflowError):
         raise _BadRequest(f"parameter {key!r} is not a number: {raw!r}")
 
 

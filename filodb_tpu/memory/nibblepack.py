@@ -21,6 +21,12 @@ from typing import Tuple
 
 import numpy as np
 
+# C fast path (filodb_tpu/native); None -> pure-Python implementations
+try:
+    from filodb_tpu.native import lib as _native
+except Exception:  # pragma: no cover
+    _native = None
+
 _M64 = 0xFFFFFFFFFFFFFFFF
 
 
@@ -43,6 +49,12 @@ def _leading_zero_nibbles(x: int) -> int:
 def pack(values: np.ndarray) -> bytes:
     """Pack an array of uint64 into NibblePack bytes.  Length is encoded by the
     caller (chunk metadata holds numRows); trailing group is zero-padded."""
+    if _native is not None:
+        return _native.nibble_pack(values)
+    return _pack_py(values)
+
+
+def _pack_py(values: np.ndarray) -> bytes:
     vals = np.asarray(values, dtype=np.uint64)
     n = len(vals)
     out = bytearray()
@@ -80,6 +92,12 @@ def pack(values: np.ndarray) -> bytes:
 
 def unpack(data: bytes, count: int) -> np.ndarray:
     """Unpack `count` uint64 values from NibblePack bytes."""
+    if _native is not None:
+        return _native.nibble_unpack(data, count)
+    return _unpack_py(data, count)
+
+
+def _unpack_py(data: bytes, count: int) -> np.ndarray:
     out = np.zeros(count, dtype=np.uint64)
     idx = 0
     pos = 0

@@ -49,11 +49,14 @@ class ShardSnapshot:
 
 
 class ShardAssignmentStrategy:
-    """ref: ShardAssignmentStrategy.scala trait."""
+    """ref: ShardAssignmentStrategy.scala trait.  `exclude` removes shards
+    from the candidate pool BEFORE capacity truncation, so an ineligible
+    shard (rate-limited, error-pinned) never occupies a proposal slot."""
 
     def shards_for_node(self, node: str, dataset: str,
                         resources: DatasetResourceSpec,
-                        mapper: ShardMapper) -> List[int]:
+                        mapper: ShardMapper,
+                        exclude: frozenset = frozenset()) -> List[int]:
         raise NotImplementedError
 
 
@@ -62,14 +65,16 @@ class DefaultShardAssignmentStrategy(ShardAssignmentStrategy):
     ceil(numShards / minNumNodes) shards from the unassigned pool
     (ref: DefaultShardAssignmentStrategy, doc/sharding.md:87-103)."""
 
-    def shards_for_node(self, node, dataset, resources, mapper):
+    def shards_for_node(self, node, dataset, resources, mapper,
+                        exclude=frozenset()):
         assigned_to_node = mapper.shards_for_node(node)
         capacity = math.ceil(resources.num_shards / resources.min_num_nodes)
         room = capacity - len(assigned_to_node)
         if room <= 0:
             return []
         unassigned = [s for s in range(mapper.num_shards)
-                      if mapper.node_for_shard(s) is None]
+                      if mapper.node_for_shard(s) is None
+                      and s not in exclude]
         return unassigned[:room]
 
 
@@ -193,10 +198,8 @@ class ShardManager:
         # proposal (rate-limited / error-pinned) is replaced by the next
         # eligible shard instead of wasting the node's capacity slot
         while True:
-            proposals = [
-                s for s in self.strategy.shards_for_node(node, dataset,
-                                                         resources, mapper)
-                if s not in skipped]
+            proposals = self.strategy.shards_for_node(
+                node, dataset, resources, mapper, exclude=frozenset(skipped))
             if not proposals:
                 break
             s = proposals[0]

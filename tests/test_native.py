@@ -1,0 +1,95 @@
+"""Native C++ acceleration parity tests (models ref: the bit-compat contract
+between lz4-java native XXHash and its JVM fallback, and NibblePackTest).
+
+Skipped when the shared library could not be built; the Python fallbacks are
+covered by test_hashing.py / test_nibblepack.py either way.
+"""
+import numpy as np
+import pytest
+
+from filodb_tpu.native import lib as native
+
+pytestmark = pytest.mark.skipif(native is None,
+                                reason="native library not built")
+
+from filodb_tpu.utils import hashing as H               # noqa: E402
+from filodb_tpu.memory import nibblepack as NP          # noqa: E402
+
+
+def _py_xxhash32(data, seed=0):
+    return getattr(H, "_py_xxhash32", H.xxhash32)(data, seed)
+
+
+def _py_xxhash64(data, seed=0):
+    return getattr(H, "_py_xxhash64", H.xxhash64)(data, seed)
+
+
+@pytest.mark.parametrize("n", [0, 1, 3, 4, 5, 15, 16, 17, 31, 32, 33, 255,
+                               1024])
+def test_xxhash32_parity(n, rng):
+    data = rng.integers(0, 256, n, dtype=np.uint8).tobytes()
+    for seed in (0, 1, 0xDEADBEEF):
+        assert native.xxhash32(data, seed) == _py_xxhash32(data, seed)
+
+
+@pytest.mark.parametrize("n", [0, 1, 7, 8, 9, 31, 32, 33, 255, 1024])
+def test_xxhash64_parity(n, rng):
+    data = rng.integers(0, 256, n, dtype=np.uint8).tobytes()
+    for seed in (0, 7, 2**63):
+        assert native.xxhash64(data, seed) == _py_xxhash64(data, seed)
+
+
+def test_hashing_module_uses_native():
+    # utils.hashing must have swapped in the native implementation
+    assert getattr(H, "_py_xxhash32", None) is not None
+
+
+@pytest.mark.parametrize("case", ["zeros", "small", "large", "mixed",
+                                  "full64", "ragged"])
+def test_nibblepack_parity(case, rng):
+    if case == "zeros":
+        vals = np.zeros(64, dtype=np.uint64)
+    elif case == "small":
+        vals = rng.integers(0, 16, 64).astype(np.uint64)
+    elif case == "large":
+        vals = rng.integers(0, 2**62, 64).astype(np.uint64)
+    elif case == "mixed":
+        vals = rng.integers(0, 2**30, 64).astype(np.uint64)
+        vals[::3] = 0
+    elif case == "full64":
+        vals = np.full(16, 0xFFFFFFFFFFFFFFFF, dtype=np.uint64)
+    else:
+        vals = rng.integers(0, 1000, 13).astype(np.uint64)   # non-multiple of 8
+    c_packed = native.nibble_pack(vals)
+    py_packed = NP._pack_py(vals)
+    assert c_packed == py_packed, "wire bytes must be identical"
+    # cross-decode both ways
+    np.testing.assert_array_equal(native.nibble_unpack(py_packed, len(vals)),
+                                  vals)
+    np.testing.assert_array_equal(NP._unpack_py(c_packed, len(vals)), vals)
+
+
+def test_nibblepack_roundtrip_fuzz(rng):
+    for _ in range(50):
+        n = int(rng.integers(1, 200))
+        shift = int(rng.integers(0, 12)) * 4
+        vals = (rng.integers(0, 2**52, n).astype(np.uint64)
+                << np.uint64(shift))
+        packed = native.nibble_pack(vals)
+        assert packed == NP._pack_py(vals)
+        np.testing.assert_array_equal(native.nibble_unpack(packed, n), vals)
+
+
+def test_unpack_truncated_raises():
+    vals = np.arange(1, 17, dtype=np.uint64)
+    packed = native.nibble_pack(vals)
+    with pytest.raises(ValueError):
+        native.nibble_unpack(packed[:3], 16)
+
+
+def test_timestamp_codec_through_native():
+    ts = 1_600_000_000_000 + np.arange(720, dtype=np.int64) * 10_000
+    ts[37] += 3
+    base, slope, payload = NP.pack_timestamps(ts)
+    out = NP.unpack_timestamps(base, slope, payload, len(ts))
+    np.testing.assert_array_equal(out, ts)

@@ -58,6 +58,18 @@ def test_counts_decrement_on_stop_and_churn_is_quota_neutral():
     assert t.cardinality(("demo", "App-1")).ts_count == 4
 
 
+def test_children_count_stable_under_churn():
+    t = CardinalityTracker()
+    for _ in range(5):
+        for i in range(3):
+            t.series_created(("demo", f"App-{i}", "m"))
+        assert t.cardinality(("demo",)).children_count == 3
+        assert t.cardinality(()).children_count == 1
+        for i in range(3):
+            t.series_stopped(("demo", f"App-{i}", "m"))
+        assert t.cardinality(("demo",)).children_count == 0
+
+
 def test_evict_reingest_does_not_exhaust_quota():
     qs = QuotaSource(default_quota=1_000_000)
     qs.set_quota(("demo",), 3)

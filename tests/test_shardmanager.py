@@ -86,6 +86,29 @@ def test_reassignment_rate_limit():
     assert mapper.num_assigned == 8
 
 
+def test_rate_limited_shards_do_not_block_eligible_ones():
+    """A rate-limited shard must not occupy the proposal window: eligible
+    shards beyond the capacity-truncated pool still get assigned."""
+    mgr, state = _mgr()
+    res4 = DatasetResourceSpec(num_shards=4, min_num_nodes=2)
+    mgr.add_member("n1")
+    mgr.add_member("n2")
+    mapper = mgr.setup_dataset(DS, res4)
+    # shards 0,1 (n2's) bounce: n2 dies, n3 picks them up, n3 dies
+    first = mapper.shards_for_node("n2")
+    mgr.remove_member("n2")
+    mgr.add_member("n3")
+    assert sorted(mapper.shards_for_node("n3")) == sorted(first)
+    mgr.remove_member("n3")          # `first` now rate-limited
+    # n1's shards also go down (n1 dies), then n4 joins: it must take n1's
+    # shards even though `first` sits earlier in the unassigned pool
+    second = mapper.shards_for_node("n1")
+    mgr.remove_member("n1")
+    mgr.add_member("n4")
+    assert sorted(mapper.shards_for_node("n4")) == sorted(second), \
+        "rate-limited shards blocked eligible ones"
+
+
 def test_subscriber_gets_snapshot_then_events():
     mgr, _ = _mgr()
     mgr.add_member("a")
