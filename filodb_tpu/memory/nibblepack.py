@@ -102,11 +102,18 @@ def _unpack_py(data: bytes, count: int) -> np.ndarray:
     idx = 0
     pos = 0
     while idx < count:
+        # bounds contract matches the C implementation: truncated input is
+        # a ValueError, never a silent zero-pad (divergent decodes across
+        # nodes with/without the native lib would corrupt results)
+        if pos >= len(data):
+            raise ValueError("nibble_unpack: truncated input")
         bitmask = data[pos]
         pos += 1
         if bitmask == 0:
             idx += 8
             continue
+        if pos >= len(data):
+            raise ValueError("nibble_unpack: truncated input")
         hdr = data[pos]
         pos += 1
         trailing = hdr & 0xF
@@ -114,6 +121,8 @@ def _unpack_py(data: bytes, count: int) -> np.ndarray:
         nonzero = bin(bitmask).count("1")
         total_nibbles = num_nibbles * nonzero
         nbytes = (total_nibbles + 1) // 2
+        if pos + nbytes > len(data):
+            raise ValueError("nibble_unpack: truncated input")
         acc = int.from_bytes(data[pos:pos + nbytes], "little")
         pos += nbytes
         mask_bits = (1 << (num_nibbles * 4)) - 1

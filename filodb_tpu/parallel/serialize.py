@@ -1,0 +1,201 @@
+"""Wire serialization for exec-plan subtrees and query results.
+
+The reference moves plan subtrees and results between nodes with Kryo over
+Akka remoting (ref: coordinator/.../client/Serializer.scala:34-55,
+FiloKryoSerializers.scala, exec/PlanDispatcher.scala:31-55; the README
+calls SerializationSpec the regression net).  The TPU-native wire format is
+a two-part frame:
+
+  [u32 json_len][json tree][buffer table + raw array bytes]
+
+The JSON tree captures structure; every numpy array node is a {"$nd": i}
+reference into the binary section, so bulk result matrices cross the wire
+as raw bytes with zero re-encoding.  Only classes in the explicit
+registries below can be revived — no arbitrary-class instantiation (the
+same closed-registry stance as the reference's registered Kryo serializers).
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import struct
+from typing import Any, Dict, List, Tuple
+
+import numpy as np
+
+from filodb_tpu.core import index as index_mod
+from filodb_tpu.query import exec as exec_mod
+from filodb_tpu.query import rangevector as rv_mod
+
+# ------------------------------------------------------------- registries
+
+# dataclasses revivable by name (transformers, filters, result carriers)
+_DATACLASSES: Dict[str, type] = {}
+for _m in (exec_mod, rv_mod, index_mod):
+    for _name in dir(_m):
+        _cls = getattr(_m, _name)
+        if isinstance(_cls, type) and dataclasses.is_dataclass(_cls):
+            _DATACLASSES[_cls.__name__] = _cls
+
+# plain classes revived via constructor arg-name lists
+_SIMPLE: Dict[str, Tuple[type, List[str]]] = {
+    "AggregatePresenter": (exec_mod.AggregatePresenter, ["op", "params"]),
+}
+
+# leaf exec plans: (class, constructor attr names after ctx)
+_LEAF_PLANS: Dict[str, Tuple[type, List[str]]] = {
+    "MultiSchemaPartitionsExec": (
+        exec_mod.MultiSchemaPartitionsExec,
+        ["dataset", "shard", "filters", "chunk_start_ms", "chunk_end_ms",
+         "columns", "schema"]),
+    "LabelValuesExec": (
+        exec_mod.LabelValuesExec,
+        ["dataset", "shard", "filters", "labels", "start_ms", "end_ms"]),
+    "PartKeysExec": (
+        exec_mod.PartKeysExec,
+        ["dataset", "shard", "filters", "start_ms", "end_ms"]),
+    "TimeScalarGeneratorExec": (
+        exec_mod.TimeScalarGeneratorExec,
+        ["start_ms", "step_ms", "end_ms", "function"]),
+    "ScalarFixedDoubleExec": (
+        exec_mod.ScalarFixedDoubleExec,
+        ["start_ms", "step_ms", "end_ms", "value"]),
+}
+
+
+class NotSerializable(TypeError):
+    pass
+
+
+# --------------------------------------------------------------- encoding
+
+
+class _Encoder:
+    def __init__(self):
+        self.buffers: List[np.ndarray] = []
+
+    def enc(self, obj: Any):
+        if obj is None or isinstance(obj, (bool, int, float, str)):
+            return obj
+        if isinstance(obj, (np.integer,)):
+            return int(obj)
+        if isinstance(obj, (np.floating,)):
+            return float(obj)
+        if isinstance(obj, np.ndarray):
+            self.buffers.append(np.ascontiguousarray(obj))
+            return {"$nd": len(self.buffers) - 1}
+        if isinstance(obj, tuple):
+            return {"$t": [self.enc(x) for x in obj]}
+        if isinstance(obj, list):
+            return [self.enc(x) for x in obj]
+        if isinstance(obj, dict):
+            return {"$m": {k: self.enc(v) for k, v in obj.items()}}
+        if isinstance(obj, exec_mod.ExecPlan):
+            return self._enc_plan(obj)
+        if dataclasses.is_dataclass(obj):
+            name = type(obj).__name__
+            if name not in _DATACLASSES:
+                raise NotSerializable(f"unregistered dataclass {name}")
+            return {"$c": name,
+                    "f": {f.name: self.enc(getattr(obj, f.name))
+                          for f in dataclasses.fields(obj)}}
+        name = type(obj).__name__
+        if name in _SIMPLE:
+            _, attrs = _SIMPLE[name]
+            return {"$s": name, "f": {a: self.enc(getattr(obj, a))
+                                      for a in attrs}}
+        raise NotSerializable(f"cannot serialize {type(obj)!r}")
+
+    def _enc_plan(self, plan: exec_mod.ExecPlan):
+        name = type(plan).__name__
+        if name not in _LEAF_PLANS:
+            raise NotSerializable(
+                f"plan {name} does not cross node boundaries — only leaf "
+                f"subtrees are dispatched (ref: PlanDispatcher)")
+        _, attrs = _LEAF_PLANS[name]
+        return {"$plan": name,
+                "ctx": self.enc(plan.ctx),
+                "transformers": [self.enc(t) for t in plan.transformers],
+                "f": {a: self.enc(getattr(plan, a)) for a in attrs}}
+
+
+class _Decoder:
+    def __init__(self, buffers: List[np.ndarray]):
+        self.buffers = buffers
+
+    def dec(self, node: Any):
+        if node is None or isinstance(node, (bool, int, float, str)):
+            return node
+        if isinstance(node, list):
+            return [self.dec(x) for x in node]
+        if isinstance(node, dict):
+            if "$nd" in node:
+                return self.buffers[node["$nd"]]
+            if "$t" in node:
+                return tuple(self.dec(x) for x in node["$t"])
+            if "$m" in node:
+                return {k: self.dec(v) for k, v in node["$m"].items()}
+            if "$c" in node:
+                cls = _DATACLASSES[node["$c"]]
+                return cls(**{k: self.dec(v) for k, v in node["f"].items()})
+            if "$s" in node:
+                cls, _ = _SIMPLE[node["$s"]]
+                return cls(**{k: self.dec(v) for k, v in node["f"].items()})
+            if "$plan" in node:
+                cls, attrs = _LEAF_PLANS[node["$plan"]]
+                ctx = self.dec(node["ctx"])
+                kwargs = {k: self.dec(v) for k, v in node["f"].items()}
+                plan = cls(ctx, **kwargs)
+                plan.transformers = [self.dec(t)
+                                     for t in node["transformers"]]
+                return plan
+        raise NotSerializable(f"cannot decode node {node!r}")
+
+
+def dumps(obj: Any) -> bytes:
+    """Object → wire frame."""
+    enc = _Encoder()
+    tree = enc.enc(obj)
+    blob = json.dumps(tree, separators=(",", ":")).encode()
+    parts = [struct.pack("<I", len(blob)), blob,
+             struct.pack("<I", len(enc.buffers))]
+    for arr in enc.buffers:
+        dt = str(arr.dtype).encode()
+        shape = arr.shape
+        parts.append(struct.pack("<H", len(dt)))
+        parts.append(dt)
+        parts.append(struct.pack("<H", len(shape)))
+        parts.append(struct.pack(f"<{len(shape)}q", *shape))
+        raw = arr.tobytes()
+        parts.append(struct.pack("<Q", len(raw)))
+        parts.append(raw)
+    return b"".join(parts)
+
+
+def loads(data: bytes) -> Any:
+    """Wire frame → object."""
+    (jlen,) = struct.unpack_from("<I", data, 0)
+    tree = json.loads(data[4:4 + jlen])
+    pos = 4 + jlen
+    (nbuf,) = struct.unpack_from("<I", data, pos)
+    pos += 4
+    buffers: List[np.ndarray] = []
+    for _ in range(nbuf):
+        (dlen,) = struct.unpack_from("<H", data, pos)
+        pos += 2
+        dtype = np.dtype(data[pos:pos + dlen].decode())
+        pos += dlen
+        (ndim,) = struct.unpack_from("<H", data, pos)
+        pos += 2
+        shape = struct.unpack_from(f"<{ndim}q", data, pos)
+        pos += 8 * ndim
+        (rlen,) = struct.unpack_from("<Q", data, pos)
+        pos += 8
+        # single copy: frombuffer(offset=) avoids a bytes-slice copy, and
+        # .copy() makes the array writable for downstream consumers
+        count = rlen // dtype.itemsize if dtype.itemsize else 0
+        arr = np.frombuffer(data, dtype=dtype, count=count,
+                            offset=pos).reshape(shape).copy()
+        pos += rlen
+        buffers.append(arr)
+    return _Decoder(buffers).dec(tree)

@@ -119,17 +119,11 @@ class SingleClusterPlanner(QueryPlanner):
     def _m_RawSeries(self, p: lp.RawSeries, ctx: QueryContext) -> List[ExecPlan]:
         candidates = self.shards_from_filters(p.filters, ctx)
         shards = self.shard_mapper.active_shards(candidates) or candidates
-        plans: List[ExecPlan] = []
-        for s in shards:
-            e = MultiSchemaPartitionsExec(
-                ctx, self.dataset, s, p.filters,
-                p.range_selector.from_ms, p.range_selector.to_ms,
-                columns=p.columns)
-            d = self._dispatcher(s)
-            if d is not None:
-                e.dispatcher = d
-            plans.append(e)
-        return plans
+        plans = [MultiSchemaPartitionsExec(
+            ctx, self.dataset, s, p.filters,
+            p.range_selector.from_ms, p.range_selector.to_ms,
+            columns=p.columns) for s in shards]
+        return self._with_dispatcher(plans, shards)
 
     def _m_PeriodicSeries(self, p: lp.PeriodicSeries, ctx: QueryContext):
         lookback = p.raw_series.lookback_ms or self.stale_lookback_ms
@@ -286,23 +280,33 @@ class SingleClusterPlanner(QueryPlanner):
 
     # metadata ----------------------------------------------------------------
 
+    def _with_dispatcher(self, plans: List[ExecPlan],
+                         shards: Sequence[int]) -> List[ExecPlan]:
+        for e, s in zip(plans, shards):
+            d = self._dispatcher(s)
+            if d is not None:
+                e.dispatcher = d
+        return plans
+
     def _m_LabelValues(self, p: lp.LabelValues, ctx) -> ExecPlan:
+        shards = self.shard_mapper.all_shards()
         children = [LabelValuesExec(ctx, self.dataset, s, p.filters,
                                     p.label_names, p.start_ms, p.end_ms)
-                    for s in self.shard_mapper.all_shards()]
-        return MetadataMergeExec(ctx, children)
+                    for s in shards]
+        return MetadataMergeExec(ctx, self._with_dispatcher(children, shards))
 
     def _m_LabelNames(self, p: lp.LabelNames, ctx) -> ExecPlan:
+        shards = self.shard_mapper.all_shards()
         children = [LabelValuesExec(ctx, self.dataset, s, p.filters,
                                     [], p.start_ms, p.end_ms)
-                    for s in self.shard_mapper.all_shards()]
-        return MetadataMergeExec(ctx, children)
+                    for s in shards]
+        return MetadataMergeExec(ctx, self._with_dispatcher(children, shards))
 
     def _m_SeriesKeysByFilters(self, p: lp.SeriesKeysByFilters, ctx) -> ExecPlan:
         shards = self.shards_from_filters(p.filters, ctx)
         children = [PartKeysExec(ctx, self.dataset, s, p.filters,
                                  p.start_ms, p.end_ms) for s in shards]
-        return MetadataMergeExec(ctx, children)
+        return MetadataMergeExec(ctx, self._with_dispatcher(children, shards))
 
 
 class _DeferredScalar:
