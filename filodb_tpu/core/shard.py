@@ -10,8 +10,11 @@ DenseSeriesStore (see blockstore.py) which the TPU kernels consume directly.
 from __future__ import annotations
 
 import dataclasses
+import logging
 import time
 from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+_log = logging.getLogger("filodb.shard")
 
 import numpy as np
 
@@ -26,6 +29,8 @@ from filodb_tpu.core.store import (ColumnStore, MetaStore, NullColumnStore,
                                    InMemoryMetaStore, PartKeyRecord)
 from filodb_tpu.memory.chunks import ChunkSet, encode_chunkset
 from filodb_tpu.memory.histogram import HistogramBuckets
+from filodb_tpu.utils.metrics import (registry as metrics_registry,
+                                      span as metrics_span)
 
 
 @dataclasses.dataclass
@@ -88,6 +93,10 @@ class TimeSeriesShard:
         # optional cardinality tracker enforcing quotas at series creation
         # (ref: TimeSeriesShard cardTracker, ratelimit/CardinalityTracker)
         self.cardinality_tracker = None
+        # trace-filter logging of individual series: partitions whose labels
+        # match ALL filters get lifecycle log lines (ref: tracedPartFilters,
+        # README:871-875)
+        self.traced_part_filters: List[Tuple[str, str]] = []
 
     # ------------------------------------------------------------------ ingest
 
@@ -129,6 +138,11 @@ class TimeSeriesShard:
         self.index.add_partition(pid, part_key, start_time_ms)
         self._dirty_part_keys.add(pid)
         self.stats.partitions_created += 1
+        if self.traced_part_filters:
+            labels = {**part_key.tags_dict, "_metric_": part_key.metric}
+            if all(labels.get(k) == v for k, v in self.traced_part_filters):
+                _log.info("TRACED series created: shard=%d partId=%d %s",
+                          self.shard_num, pid, part_key)
         return info
 
     def ingest(self, batch: RecordBatch, offset: int = -1) -> int:
@@ -168,6 +182,8 @@ class TimeSeriesShard:
                                batch.bucket_les)
         self.stats.rows_ingested += n
         self.stats.rows_dropped += batch.num_records - n
+        metrics_registry.counter("ingested_rows", dataset=self.dataset,
+                                 shard=str(self.shard_num)).increment(n)
         if offset >= 0:
             self.ingested_offset = offset
         return n
@@ -179,6 +195,13 @@ class TimeSeriesShard:
         group checkpoint (ref: TimeSeriesShard.doFlushSteps:969,
         writeChunks:1072, commitCheckpoint:1127).  Returns chunks written."""
         ingestion_time_ms = ingestion_time_ms or int(time.time() * 1000)
+        with metrics_span("flush", dataset=self.dataset):
+            written = self._do_flush_group(group, ingestion_time_ms)
+        metrics_registry.counter("chunks_flushed",
+                                 dataset=self.dataset).increment(written)
+        return written
+
+    def _do_flush_group(self, group: int, ingestion_time_ms: int) -> int:
         written = 0
         dirty_pids: set = set()
         for info in self.partitions:

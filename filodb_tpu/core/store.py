@@ -51,6 +51,12 @@ class ColumnStore:
     def all_part_keys(self, dataset: str, shard: int) -> List[PartKeyRecord]:
         return self.read_part_keys(dataset, shard)
 
+    def delete_part_keys(self, dataset: str, shard: int,
+                         part_keys: Iterable[PartKey]) -> int:
+        """Remove part keys so index bootstrap stops resurrecting them
+        (the CardinalityBuster write path, ref: cardbuster/)."""
+        raise NotImplementedError
+
 
 class MetaStore:
     """Checkpoints + dataset metadata (ref: core MetaStore trait; checkpoint
@@ -126,6 +132,15 @@ class InMemoryColumnStore(ColumnStore):
                         and cs.info.end_time_ms >= start_time_ms):
                     out.append(cs)
             return out
+
+    def delete_part_keys(self, dataset, shard, part_keys) -> int:
+        n = 0
+        with self._lock:
+            for pk in part_keys:
+                if self._pks.pop((dataset, shard, pk.to_bytes()),
+                                 None) is not None:
+                    n += 1
+        return n
 
     def num_chunksets(self) -> int:
         with self._lock:

@@ -45,6 +45,8 @@ class PromHttpApi:
         try:
             if parts == ["__health"]:
                 return 200, {"status": "healthy"}
+            if parts == ["metrics"]:
+                return self._own_metrics()
             if parts[:1] == ["promql"] and len(parts) >= 4 \
                     and parts[2] == "api" and parts[3] == "v1":
                 return self._api_v1(parts[1], parts[4:], method, params,
@@ -212,6 +214,29 @@ class PromHttpApi:
         statuses = [{"shard": i, "status": st, "address": addr}
                     for i, (addr, st) in sorted(mapper.status_snapshot().items())]
         return 200, {"status": "success", "data": statuses}
+
+    def _own_metrics(self) -> Tuple[int, str]:
+        """The framework's OWN metrics in Prometheus text format
+        (ref: Kamon prometheus reporter endpoint, README:812-819).  Shard
+        gauges refresh on scrape."""
+        from filodb_tpu.utils.metrics import registry
+        for dataset, eng in self.engines.items():
+            source = getattr(eng, "source", None)
+            mapper = self.shard_mappers.get(dataset)
+            if source is None or mapper is None:
+                continue
+            for s in mapper.all_shards():
+                shard = source.get_shard(dataset, s)
+                if shard is None or not hasattr(shard, "stats"):
+                    continue
+                tags = {"dataset": dataset, "shard": str(s)}
+                registry.gauge("num_partitions", **tags).update(
+                    shard.num_partitions)
+                registry.gauge("rows_dropped", **tags).update(
+                    shard.stats.rows_dropped)
+                registry.gauge("quota_dropped", **tags).update(
+                    shard.stats.quota_dropped)
+        return 200, registry.expose_prometheus()
 
     def _loglevel(self, logger_name: str, level: str) -> Tuple[int, object]:
         """Dynamic per-logger level (ref: doc/http_api.md:38-46)."""

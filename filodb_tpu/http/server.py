@@ -43,9 +43,14 @@ class FiloHttpServer:
                     body = b""
                 status, payload = api_ref.handle(method, parsed.path, params,
                                                  body, multi_params=multi)
-                blob = b"" if status == 204 else json.dumps(payload).encode()
+                if isinstance(payload, str):        # text routes (/metrics)
+                    blob = payload.encode()
+                    ctype = "text/plain; version=0.0.4"
+                else:
+                    blob = b"" if status == 204 else json.dumps(payload).encode()
+                    ctype = "application/json"
                 self.send_response(status)
-                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(blob)))
                 self.end_headers()
                 if blob:

@@ -1,0 +1,7 @@
+"""Batch/maintenance jobs (maps ref: spark-jobs/ — DownsamplerMain lives in
+filodb_tpu.downsample.batch_job; this package holds the repair/migration
+jobs: ChunkCopier, PartitionKeysCopier, CardinalityBuster)."""
+from filodb_tpu.jobs.copier import ChunkCopier, PartitionKeysCopier
+from filodb_tpu.jobs.buster import CardinalityBuster
+
+__all__ = ["ChunkCopier", "PartitionKeysCopier", "CardinalityBuster"]

@@ -1,0 +1,101 @@
+"""Cluster seed discovery — the akka-bootstrapper analogue.
+
+ref: akka-bootstrapper/.../AkkaBootstrapper.scala:31-50 +
+ClusterSeedDiscovery.scala:84 — a joining node discovers existing cluster
+seeds via (a) an explicit seed list, (b) DNS SRV records, or (c) an HTTP
+`/__members` endpoint served by live members; if nobody answers, it forms a
+new cluster with itself as the first seed.
+
+The TPU-native control plane uses the same shapes: `discover()` returns
+live (host, port) coordinator addresses to hand to the ShardManager's
+add_member, and `members_payload()` is what the HTTP layer serves at
+/__members so later joiners find the cluster.
+"""
+from __future__ import annotations
+
+import json
+import socket
+import urllib.request
+from typing import List, Optional, Sequence, Tuple
+
+Address = Tuple[str, int]
+
+
+class ClusterSeedDiscovery:
+    """ref: ClusterSeedDiscovery trait."""
+
+    def discover(self) -> List[Address]:
+        raise NotImplementedError
+
+
+class ExplicitListSeedDiscovery(ClusterSeedDiscovery):
+    """Static seed list (ref: ExplicitListClusterSeedDiscovery)."""
+
+    def __init__(self, seeds: Sequence[Address]):
+        self.seeds = list(seeds)
+
+    def discover(self) -> List[Address]:
+        return list(self.seeds)
+
+
+class DnsSrvSeedDiscovery(ClusterSeedDiscovery):
+    """DNS SRV lookup (ref: DnsSrvClusterSeedDiscovery.scala:122).  Uses a
+    pluggable resolver because stdlib has no SRV client; deployments pass
+    one backed by their resolver library."""
+
+    def __init__(self, srv_name: str,
+                 resolver=None):
+        self.srv_name = srv_name
+        self.resolver = resolver
+
+    def discover(self) -> List[Address]:
+        if self.resolver is None:
+            raise RuntimeError("DNS SRV discovery needs a resolver callable "
+                               "(srv_name -> [(host, port)])")
+        return list(self.resolver(self.srv_name))
+
+
+class HttpMembersSeedDiscovery(ClusterSeedDiscovery):
+    """Ask candidate endpoints for their member list via /__members
+    (ref: the seed HTTP endpoint AkkaBootstrapper exposes)."""
+
+    def __init__(self, candidates: Sequence[Address], timeout_s: float = 5.0):
+        self.candidates = list(candidates)
+        self.timeout_s = timeout_s
+
+    def discover(self) -> List[Address]:
+        for host, port in self.candidates:
+            try:
+                with urllib.request.urlopen(
+                        f"http://{host}:{port}/__members",
+                        timeout=self.timeout_s) as r:
+                    payload = json.loads(r.read())
+                members = [(m["host"], int(m["port"]))
+                           for m in payload.get("members", [])]
+                if members:
+                    return members
+            except (OSError, ValueError, KeyError, TypeError,
+                    AttributeError):
+                # unreachable OR malformed answer: try the next candidate —
+                # discovery must degrade to self-seeding, never crash
+                continue
+        return []
+
+
+def bootstrap(discovery: ClusterSeedDiscovery, self_addr: Address,
+              join_fn, retries: int = 3) -> List[Address]:
+    """Join discovered seeds, or seed a new cluster with ourselves when no
+    one answers (ref: AkkaBootstrapper.bootstrap: retry then
+    joinSeedNodes(self))."""
+    for _ in range(retries):
+        seeds = [s for s in discovery.discover() if s != self_addr]
+        if seeds:
+            join_fn(seeds)
+            return seeds
+    join_fn([self_addr])
+    return [self_addr]
+
+
+def members_payload(members: Sequence[Address]) -> dict:
+    """The /__members response body served by live nodes."""
+    return {"members": [{"host": h, "port": p} for h, p in members]}

@@ -43,6 +43,7 @@ from filodb_tpu.memory.histogram import HistogramBuckets
 
 _MAGIC_CHUNK = 0xF1D0C401
 _MAGIC_PK = 0xF1D0C402
+_MAGIC_PK_DEL = 0xF1D0C403      # part-key tombstone (CardinalityBuster)
 
 
 # ---------------------------------------------------------------- frame codec
@@ -215,6 +216,10 @@ class LocalDiskColumnStore(ColumnStore):
     def _pk_path(self, dataset: str, shard: int) -> str:
         return os.path.join(self._shard_dir(dataset, shard), "partkeys.log")
 
+    def _del_path(self, dataset: str, shard: int) -> str:
+        return os.path.join(self._shard_dir(dataset, shard),
+                            "partkeys.deleted.log")
+
     def initialize(self, dataset: str, num_shards: int) -> None:
         for s in range(num_shards):
             os.makedirs(self._shard_dir(dataset, s), exist_ok=True)
@@ -243,9 +248,23 @@ class LocalDiskColumnStore(ColumnStore):
             chunks.setdefault(pk_bytes, []).append(
                 _FrameRef(offset, start_ms, end_ms, ing_ms, sn, nrows))
         pks: Dict[bytes, PartKeyRecord] = {}
-        for _, payload in _iter_frames(self._pk_path(dataset, shard), _MAGIC_PK):
+        last_upsert: Dict[bytes, int] = {}
+        for off, payload in _iter_frames(self._pk_path(dataset, shard),
+                                         _MAGIC_PK):
             r = _decode_pk_frame(payload)
-            pks[r.part_key.to_bytes()] = r        # last write wins
+            kb = r.part_key.to_bytes()
+            pks[kb] = r                           # last write wins
+            last_upsert[kb] = off
+        # each tombstone carries the partkeys.log watermark at delete time:
+        # a key re-upserted AFTER its deletion (offset past the watermark)
+        # stays alive (the cross-file ordering the busted->reingested
+        # lifecycle needs)
+        for _, payload in _iter_frames(self._del_path(dataset, shard),
+                                       _MAGIC_PK_DEL):
+            (watermark,) = struct.unpack_from("<Q", payload, 0)
+            kb = bytes(payload[8:])
+            if last_upsert.get(kb, -1) < watermark:
+                pks.pop(kb, None)
         self._chunk_idx[key] = chunks
         self._pk_idx[key] = pks
 
@@ -286,6 +305,27 @@ class LocalDiskColumnStore(ColumnStore):
         with self._lock:
             self._load_shard(dataset, shard)
             return list(self._pk_idx[(dataset, shard)].values())
+
+    def delete_part_keys(self, dataset, shard, part_keys) -> int:
+        """Tombstone part keys so bootstrap stops resurrecting them
+        (the CardinalityBuster write path)."""
+        with self._lock:
+            self._load_shard(dataset, shard)
+            idx = self._pk_idx[(dataset, shard)]
+            pk_path = self._pk_path(dataset, shard)
+            try:
+                watermark = os.path.getsize(pk_path)
+            except OSError:
+                watermark = 0
+            n = 0
+            for pk in part_keys:
+                kb = pk.to_bytes()
+                if idx.pop(kb, None) is not None:
+                    self._append(self._del_path(dataset, shard),
+                                 _MAGIC_PK_DEL,
+                                 struct.pack("<Q", watermark) + kb)
+                    n += 1
+            return n
 
     def read_chunks(self, dataset, shard, part_key, start_time_ms, end_time_ms):
         with self._lock:

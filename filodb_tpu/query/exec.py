@@ -635,9 +635,15 @@ class ExecPlan:
         return data, stats
 
     def execute(self, source) -> QueryResult:
+        # span + error counters per plan type (ref: ExecPlan.scala:102-131
+        # Kamon span around doExecute; query-error counters QueryActor:80-96)
+        from filodb_tpu.utils.metrics import registry, span
         try:
-            data, stats = self.execute_internal(source)
+            with span("execplan", plan=type(self).__name__):
+                data, stats = self.execute_internal(source)
         except Exception as e:  # noqa: BLE001 — query errors surface in result
+            registry.counter("query_errors",
+                             plan=type(self).__name__).increment()
             return QueryResult([], QueryStats(), error=f"{type(e).__name__}: {e}")
         if isinstance(data, AggPartial):
             data = present_partial(data)

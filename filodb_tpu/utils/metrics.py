@@ -1,0 +1,233 @@
+"""Metrics + span tracing — the Kamon analogue.
+
+ref: the reference threads Kamon counters/gauges/histograms through every
+subsystem (TimeSeriesShardStats TimeSeriesShard.scala:41-134, MemoryStats
+BlockManager.scala:91-106, per-query spans exec/ExecPlan.scala:102-131)
+and exposes them via reporters — a Prometheus endpoint plus log reporters
+(coordinator/.../KamonLogger.scala:16-40, README:812-819).
+
+Here: a process-wide registry of tagged counters/gauges/histograms with
+Prometheus text exposition (served at /metrics by the HTTP layer), and a
+`span()` context manager that records durations into histograms and feeds
+optional span reporters.  Everything is thread-safe and allocation-light —
+metric lookups are dict hits on interned (name, tags) keys.
+"""
+from __future__ import annotations
+
+import bisect
+import threading
+import time
+from typing import Callable, Dict, Iterator, List, Optional, Sequence, Tuple
+
+TagTuple = Tuple[Tuple[str, str], ...]
+
+
+def _tags_key(tags: Dict[str, str]) -> TagTuple:
+    return tuple(sorted(tags.items()))
+
+
+class Counter:
+    __slots__ = ("value", "_lock")
+
+    def __init__(self):
+        self.value = 0.0
+        self._lock = threading.Lock()
+
+    def increment(self, by: float = 1.0) -> None:
+        with self._lock:
+            self.value += by
+
+
+class Gauge:
+    __slots__ = ("value", "_lock")
+
+    def __init__(self):
+        self.value = 0.0
+        self._lock = threading.Lock()
+
+    def update(self, v: float) -> None:
+        with self._lock:
+            self.value = v
+
+
+# log2-ish bucket boundaries, milliseconds-friendly
+_DEFAULT_BOUNDS = (0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1, 5, 10, 50, 100,
+                   500, 1000, 5000, 10000, 60000)
+
+
+class Histogram:
+    __slots__ = ("bounds", "counts", "sum", "count", "_lock")
+
+    def __init__(self, bounds: Sequence[float] = _DEFAULT_BOUNDS):
+        self.bounds = tuple(bounds)
+        self.counts = [0] * (len(self.bounds) + 1)
+        self.sum = 0.0
+        self.count = 0
+        self._lock = threading.Lock()
+
+    def record(self, v: float) -> None:
+        i = bisect.bisect_left(self.bounds, v)
+        with self._lock:
+            self.counts[i] += 1
+            self.sum += v
+            self.count += 1
+
+    def percentile(self, q: float) -> float:
+        """Approximate percentile from bucket upper bounds."""
+        with self._lock:
+            if self.count == 0:
+                return 0.0
+            target = q * self.count
+            acc = 0
+            for i, c in enumerate(self.counts):
+                acc += c
+                if acc >= target:
+                    return self.bounds[i] if i < len(self.bounds) \
+                        else self.bounds[-1]
+        return self.bounds[-1]
+
+
+class MetricsRegistry:
+    """Process-wide named+tagged metrics (ref: Kamon.counter/gauge/histogram)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters: Dict[Tuple[str, TagTuple], Counter] = {}
+        self._gauges: Dict[Tuple[str, TagTuple], Gauge] = {}
+        self._hists: Dict[Tuple[str, TagTuple], Histogram] = {}
+
+    def counter(self, name: str, **tags) -> Counter:
+        key = (name, _tags_key(tags))
+        c = self._counters.get(key)
+        if c is None:
+            with self._lock:
+                c = self._counters.setdefault(key, Counter())
+        return c
+
+    def gauge(self, name: str, **tags) -> Gauge:
+        key = (name, _tags_key(tags))
+        g = self._gauges.get(key)
+        if g is None:
+            with self._lock:
+                g = self._gauges.setdefault(key, Gauge())
+        return g
+
+    def histogram(self, name: str, **tags) -> Histogram:
+        key = (name, _tags_key(tags))
+        h = self._hists.get(key)
+        if h is None:
+            with self._lock:
+                h = self._hists.setdefault(key, Histogram())
+        return h
+
+    def clear(self) -> None:
+        with self._lock:
+            self._counters.clear()
+            self._gauges.clear()
+            self._hists.clear()
+
+    # -------------------------------------------------- prometheus format
+
+    def expose_prometheus(self) -> str:
+        """Prometheus text exposition of the framework's own metrics
+        (ref: Kamon prometheus reporter, README:812-819)."""
+        out: List[str] = []
+
+        def fmt_tags(tags: TagTuple, extra: str = "") -> str:
+            items = [f'{k}="{v}"' for k, v in tags]
+            if extra:
+                items.append(extra)
+            return "{" + ",".join(items) + "}" if items else ""
+
+        # snapshot under the lock: concurrent first-seen metric creation must
+        # not blow up a scrape mid-iteration
+        with self._lock:
+            counters = list(self._counters.items())
+            gauges = list(self._gauges.items())
+            hists = list(self._hists.items())
+        for (name, tags), c in sorted(counters):
+            out.append(f"{name}_total{fmt_tags(tags)} {c.value:g}")
+        for (name, tags), g in sorted(gauges):
+            out.append(f"{name}{fmt_tags(tags)} {g.value:g}")
+        for (name, tags), h in sorted(hists):
+            acc = 0
+            for i, b in enumerate(h.bounds):
+                acc += h.counts[i]
+                out.append(f"{name}_bucket{fmt_tags(tags, f'le=\"{b:g}\"')} "
+                           f"{acc}")
+            out.append(f"{name}_bucket{fmt_tags(tags, 'le=\"+Inf\"')} "
+                       f"{h.count}")
+            out.append(f"{name}_sum{fmt_tags(tags)} {h.sum:g}")
+            out.append(f"{name}_count{fmt_tags(tags)} {h.count}")
+        return "\n".join(out) + "\n"
+
+
+registry = MetricsRegistry()
+
+
+# ------------------------------------------------------------------ spans
+
+SpanReporter = Callable[[str, float, Dict[str, str]], None]
+_reporters: List[SpanReporter] = []
+_active = threading.local()
+
+
+def add_span_reporter(rep: SpanReporter) -> None:
+    """ref: KamonSpanLogReporter (KamonLogger.scala:16-40)."""
+    _reporters.append(rep)
+
+
+def remove_span_reporter(rep: SpanReporter) -> None:
+    if rep in _reporters:
+        _reporters.remove(rep)
+
+
+class span:
+    """Duration-recording span (ref: Kamon.spanBuilder threaded through
+    ExecPlan.execute / startODPSpan).  Nesting is tracked per thread so
+    reporters see parent names dotted in."""
+
+    def __init__(self, name: str, **tags: str):
+        self.name = name
+        self.tags = tags
+
+    def __enter__(self):
+        stack = getattr(_active, "stack", None)
+        if stack is None:
+            stack = _active.stack = []
+        stack.append(self.name)
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        elapsed = time.perf_counter() - self._t0
+        stack = _active.stack
+        full = ".".join(stack)
+        stack.pop()
+        registry.histogram(f"span_{self.name}_seconds",
+                           **self.tags).record(elapsed)
+        for rep in _reporters:
+            rep(full, elapsed, self.tags)
+        return False
+
+
+# ----------------------------------------------------- scheduler asserts
+
+
+class FiloSchedulers:
+    """Thread-name assertions on hot entry points (ref:
+    core/.../memstore/FiloSchedulers.scala:14-20, gated by
+    filodb.scheduler.enable-assertions)."""
+
+    enabled = False
+    INGEST = "ingest"
+    QUERY = "query"
+    FLUSH = "flush"
+
+    @staticmethod
+    def assert_thread_name(fragment: str) -> None:
+        if not FiloSchedulers.enabled:
+            return
+        name = threading.current_thread().name
+        assert fragment in name, \
+            f"expected thread name containing {fragment!r}, got {name!r}"

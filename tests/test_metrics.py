@@ -1,0 +1,123 @@
+"""Metrics/tracing tests (models ref: Kamon metric assertions sprinkled in
+TimeSeriesShardSpec + KamonLogger reporters)."""
+import logging
+import threading
+import urllib.request
+
+import numpy as np
+import pytest
+
+from filodb_tpu.core.memstore import TimeSeriesMemStore
+from filodb_tpu.ingest.generator import gauge_batch
+from filodb_tpu.utils.metrics import (FiloSchedulers, Histogram, registry,
+                                      add_span_reporter, remove_span_reporter,
+                                      span)
+
+START = 1_600_000_020_000
+
+
+def test_counter_gauge_histogram_basics():
+    c = registry.counter("test_ops", kind="a")
+    c.increment()
+    c.increment(4)
+    assert c.value == 5
+    assert registry.counter("test_ops", kind="a") is c
+    assert registry.counter("test_ops", kind="b") is not c
+    g = registry.gauge("test_depth")
+    g.update(42)
+    assert g.value == 42
+    h = Histogram()
+    for v in (0.02, 0.02, 8.0):
+        h.record(v)
+    assert h.count == 3 and h.percentile(0.5) == 0.05
+
+
+def test_span_records_and_reports():
+    seen = []
+    rep = lambda name, dur, tags: seen.append((name, dur, tags))  # noqa: E731
+    add_span_reporter(rep)
+    try:
+        with span("outer", q="1"):
+            with span("inner"):
+                pass
+    finally:
+        remove_span_reporter(rep)
+    names = [s[0] for s in seen]
+    assert names == ["outer.inner", "outer"]
+    assert registry.histogram("span_outer_seconds", q="1").count >= 1
+
+
+def test_ingest_and_query_emit_metrics():
+    ms = TimeSeriesMemStore()
+    sh = ms.setup("mtest", 0)
+    sh.ingest(gauge_batch(5, 50, start_ms=START))
+    assert registry.counter("ingested_rows", dataset="mtest",
+                            shard="0").value == 250
+    sh.flush_all_groups()
+    assert registry.histogram("span_flush_seconds", dataset="mtest").count > 0
+
+    from filodb_tpu.parallel.shardmapper import ShardEvent, ShardMapper
+    from filodb_tpu.query.engine import QueryEngine
+    mapper = ShardMapper(1)
+    mapper.update_from_event(ShardEvent("IngestionStarted", "mtest", 0, "x"))
+    eng = QueryEngine("mtest", ms, mapper)
+    res = eng.query_range("heap_usage", START // 1000, 60, START // 1000 + 300)
+    assert res.error is None
+    assert registry.histogram("span_execplan_seconds",
+                              plan="MultiSchemaPartitionsExec").count > 0
+
+
+def test_prometheus_exposition_format():
+    registry.counter("expo_total_ops", x="1").increment(3)
+    registry.gauge("expo_live").update(7)
+    registry.histogram("expo_lat").record(0.3)
+    text = registry.expose_prometheus()
+    assert 'expo_total_ops_total{x="1"} 3' in text
+    assert "expo_live 7" in text
+    assert 'expo_lat_bucket{le="+Inf"} 1' in text
+    assert "expo_lat_count 1" in text
+
+
+def test_metrics_http_endpoint():
+    from filodb_tpu.standalone import DatasetConfig, FiloServer
+    srv = FiloServer([DatasetConfig("prometheus", num_shards=1)], http_port=0)
+    srv.memstore.get_shard("prometheus", 0).ingest(
+        gauge_batch(6, 20, start_ms=START))
+    srv.start()
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.http.port}/metrics", timeout=30) as r:
+            assert r.status == 200
+            assert "text/plain" in r.headers["Content-Type"]
+            text = r.read().decode()
+        assert 'num_partitions{dataset="prometheus",shard="0"} 6' in text
+        assert "ingested_rows_total" in text
+    finally:
+        srv.shutdown()
+
+
+def test_traced_part_filters_log(caplog):
+    ms = TimeSeriesMemStore()
+    sh = ms.setup("ttest", 0)
+    sh.traced_part_filters = [("_ns_", "App-1")]
+    with caplog.at_level(logging.INFO, logger="filodb.shard"):
+        sh.ingest(gauge_batch(10, 5, start_ms=START))
+    traced = [r for r in caplog.records if "TRACED" in r.message]
+    assert len(traced) == 1
+    assert "App-1" in traced[0].getMessage()
+
+
+def test_scheduler_assertions_gated():
+    FiloSchedulers.enabled = False
+    FiloSchedulers.assert_thread_name("nope")      # no-op when disabled
+    FiloSchedulers.enabled = True
+    try:
+        with pytest.raises(AssertionError):
+            FiloSchedulers.assert_thread_name("definitely-not-this-thread")
+        t = threading.Thread(
+            target=lambda: FiloSchedulers.assert_thread_name("ingest"),
+            name="filodb-ingest-0")
+        t.start()
+        t.join()
+    finally:
+        FiloSchedulers.enabled = False
