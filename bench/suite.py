@@ -1,0 +1,241 @@
+"""Benchmark suite mirroring the reference's jmh classes.
+
+ref: jmh/src/main/scala/filodb.jmh/ — IngestionBenchmark,
+EncodingBenchmark, PartKeyIndexBenchmark, GatewayBenchmark,
+QueryInMemoryBenchmark (:31-35,126-133 query set),
+QueryHiCardInMemoryBenchmark, HistogramIngestBenchmark,
+HistogramQueryBenchmark; runner run_benchmarks.sh.
+
+Each benchmark prints one JSON line {"bench", "metric", "value", "unit"}.
+Run all: python -m bench.suite            (add --quick for smoke sizing)
+Run one: python -m bench.suite ingestion
+The headline driver benchmark stays in bench.py at the repo root.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import time
+from typing import Callable, Dict, List
+
+import numpy as np
+
+START = 1_600_000_020_000
+
+
+def _emit(bench: str, metric: str, value: float, unit: str, **extra):
+    print(json.dumps({"bench": bench, "metric": metric,
+                      "value": round(value, 1), "unit": unit, **extra}))
+
+
+def _time_it(fn: Callable, iters: int) -> float:
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        fn()
+    return (time.perf_counter() - t0) / iters
+
+
+# ------------------------------------------------------------- ingestion
+
+
+def bench_ingestion(quick: bool):
+    """Samples/sec through the shard ingest path
+    (ref: IngestionBenchmark.scala)."""
+    from filodb_tpu.core.memstore import TimeSeriesMemStore
+    from filodb_tpu.ingest.generator import gauge_batch
+    S, T = (500, 200) if quick else (2000, 720)
+    batch = gauge_batch(S, T, start_ms=START)
+    iters = 3 if quick else 5
+    times = []
+    for i in range(iters):
+        ms = TimeSeriesMemStore()
+        sh = ms.setup(f"bench{i}", 0)
+        t0 = time.perf_counter()
+        sh.ingest(batch)
+        times.append(time.perf_counter() - t0)
+    best = min(times)
+    _emit("ingestion", "samples_per_sec", S * T / best, "samples/s",
+          series=S, samples=T)
+
+
+# -------------------------------------------------------------- encoding
+
+
+def bench_encoding(quick: bool):
+    """Chunk encode/decode throughput (ref: EncodingBenchmark.scala,
+    IntSumReadBenchmark)."""
+    from filodb_tpu.memory.chunks import decode_chunkset, encode_chunkset
+    n = 10_000 if quick else 100_000
+    ts = START + np.arange(n, dtype=np.int64) * 10_000
+    vals = np.cumsum(np.random.default_rng(0).exponential(10, n))
+    col_types = {"value": "double"}
+    enc = lambda: encode_chunkset(ts, {"value": vals}, col_types, START)  # noqa: E731
+    per = _time_it(enc, 3 if quick else 10)
+    _emit("encoding", "encode_samples_per_sec", n / per, "samples/s")
+    cs = enc()
+    per = _time_it(lambda: decode_chunkset(cs), 3 if quick else 10)
+    _emit("encoding", "decode_samples_per_sec", n / per, "samples/s",
+          bytes_per_sample=round(cs.nbytes / n, 2))
+
+
+# ----------------------------------------------------------------- index
+
+
+def bench_index(quick: bool):
+    """Tag-index add + filter lookup ops/sec
+    (ref: PartKeyIndexBenchmark.scala)."""
+    from filodb_tpu.core.index import Equals, EqualsRegex, PartKeyIndex
+    from filodb_tpu.core.partkey import PartKey
+    n = 20_000 if quick else 100_000
+    keys = [PartKey.make(f"metric_{i % 50}",
+                         {"_ws_": "demo", "_ns_": f"App-{i % 100}",
+                          "instance": f"i{i}"}) for i in range(n)]
+    idx = PartKeyIndex()
+    t0 = time.perf_counter()
+    for i, pk in enumerate(keys):
+        idx.add_partition(i, pk, START)
+    add_per_sec = n / (time.perf_counter() - t0)
+    _emit("partkey_index", "adds_per_sec", add_per_sec, "ops/s", keys=n)
+    filters = [Equals("_metric_", "metric_7"), Equals("_ns_", "App-42")]
+    per = _time_it(lambda: idx.part_ids_from_filters(filters, 0, 1 << 62),
+                   50 if quick else 200)
+    _emit("partkey_index", "equals_lookups_per_sec", 1 / per, "ops/s")
+    rx = [EqualsRegex("_ns_", "App-1.*")]
+    per = _time_it(lambda: idx.part_ids_from_filters(rx, 0, 1 << 62),
+                   20 if quick else 50)
+    _emit("partkey_index", "regex_lookups_per_sec", 1 / per, "ops/s")
+
+
+# --------------------------------------------------------------- gateway
+
+
+def bench_gateway(quick: bool):
+    """Influx line parse -> RecordBatch throughput
+    (ref: GatewayBenchmark.scala)."""
+    from filodb_tpu.core.schemas import DEFAULT_SCHEMAS
+    from filodb_tpu.gateway.influx import influx_lines_to_batches
+    n = 5_000 if quick else 20_000
+    lines = [f"cpu_usage,_ws_=demo,_ns_=App-{i % 8},host=h{i % 100} "
+             f"value={i * 0.5} {(START + i) * 1_000_000}" for i in range(n)]
+    per = _time_it(lambda: influx_lines_to_batches(lines, DEFAULT_SCHEMAS),
+                   3 if quick else 5)
+    _emit("gateway", "influx_lines_per_sec", n / per, "lines/s")
+
+
+# ------------------------------------------------------------ query set
+
+
+def _mk_query_engine(S, T, quick):
+    from filodb_tpu.core.memstore import TimeSeriesMemStore
+    from filodb_tpu.ingest.generator import counter_batch, gauge_batch
+    from filodb_tpu.parallel.shardmapper import ShardEvent, ShardMapper
+    from filodb_tpu.query.engine import QueryEngine
+    ms = TimeSeriesMemStore()
+    sh = ms.setup("prometheus", 0)
+    sh.ingest(counter_batch(S, T, start_ms=START))
+    sh.ingest(gauge_batch(S, T, start_ms=START))
+    mapper = ShardMapper(1)
+    mapper.update_from_event(
+        ShardEvent("IngestionStarted", "prometheus", 0, "b"))
+    return QueryEngine("prometheus", ms, mapper)
+
+
+QUERY_SET = [  # ref: QueryInMemoryBenchmark.scala:126-133
+    ("raw_scan", 'request_total{_ws_="demo"}'),
+    ("sum_rate", 'sum(rate(request_total[5m]))'),
+    ("sum_by_rate", 'sum by (_ns_)(rate(request_total[5m]))'),
+    ("quantile", 'quantile(0.75,heap_usage)'),
+    ("sum_over_time", 'sum(sum_over_time(heap_usage[5m]))'),
+]
+
+
+def bench_query(quick: bool):
+    """PromQL QPS over the in-memory store
+    (ref: QueryInMemoryBenchmark.scala:31-35 — 100 series x 720 samples
+    per shard; QPS per query shape)."""
+    S, T = (100, 200) if quick else (100, 720)
+    eng = _mk_query_engine(S, T, quick)
+    s = START // 1000
+    end = s + T * 10
+    for name, q in QUERY_SET:
+        run = lambda: eng.query_range(q, s + 600, 60, end)  # noqa: E731
+        assert run().error is None, (name, run().error)
+        per = _time_it(run, 5 if quick else 20)
+        _emit("query_inmemory", f"{name}_qps", 1 / per, "queries/s",
+              series=S)
+
+
+def bench_query_hicard(quick: bool):
+    """Single-shard high-cardinality scan
+    (ref: QueryHiCardInMemoryBenchmark.scala)."""
+    S, T = (20_000, 40) if quick else (100_000, 60)
+    eng = _mk_query_engine(S, T, quick)
+    s = START // 1000
+    q = 'sum(rate(request_total[5m]))'
+    run = lambda: eng.query_range(q, s + 360, 60, s + T * 10)  # noqa: E731
+    assert run().error is None
+    per = _time_it(run, 2 if quick else 5)
+    _emit("query_hicard", "sum_rate_qps", 1 / per, "queries/s", series=S)
+
+
+# -------------------------------------------------------------- histogram
+
+
+def bench_histogram(quick: bool):
+    """Histogram-schema ingest + quantile query
+    (ref: HistogramIngestBenchmark.scala:24, HistogramQueryBenchmark)."""
+    from filodb_tpu.core.memstore import TimeSeriesMemStore
+    from filodb_tpu.ingest.generator import histogram_batch
+    from filodb_tpu.parallel.shardmapper import ShardEvent, ShardMapper
+    from filodb_tpu.query.engine import QueryEngine
+    S, T = (50, 100) if quick else (200, 360)
+    batch = histogram_batch(S, T, start_ms=START)
+    times = []
+    for i in range(3):
+        ms = TimeSeriesMemStore()
+        sh = ms.setup(f"hb{i}", 0)
+        t0 = time.perf_counter()
+        sh.ingest(batch)
+        times.append(time.perf_counter() - t0)
+    _emit("histogram", "ingest_samples_per_sec", S * T / min(times),
+          "samples/s", buckets=batch.columns["h"].shape[-1])
+    ms = TimeSeriesMemStore()
+    sh = ms.setup("prometheus", 0)
+    sh.ingest(batch)
+    mapper = ShardMapper(1)
+    mapper.update_from_event(
+        ShardEvent("IngestionStarted", "prometheus", 0, "b"))
+    eng = QueryEngine("prometheus", ms, mapper)
+    s = START // 1000
+    q = 'histogram_quantile(0.9,sum by (le)(rate(http_latency[5m])))'
+    run = lambda: eng.query_range(q, s + 600, 60, s + T * 10)  # noqa: E731
+    res = run()
+    assert res.error is None, res.error
+    per = _time_it(run, 2 if quick else 5)
+    _emit("histogram", "quantile_qps", 1 / per, "queries/s", series=S)
+
+
+BENCHES: Dict[str, Callable[[bool], None]] = {
+    "ingestion": bench_ingestion,
+    "encoding": bench_encoding,
+    "index": bench_index,
+    "gateway": bench_gateway,
+    "query": bench_query,
+    "query_hicard": bench_query_hicard,
+    "histogram": bench_histogram,
+}
+
+
+def main(argv: List[str] = None):
+    ap = argparse.ArgumentParser(description="filodb-tpu benchmark suite")
+    ap.add_argument("bench", nargs="?", choices=sorted(BENCHES),
+                    help="run one benchmark (default: all)")
+    ap.add_argument("--quick", action="store_true")
+    args = ap.parse_args(argv)
+    targets = [args.bench] if args.bench else sorted(BENCHES)
+    for name in targets:
+        BENCHES[name](args.quick)
+
+
+if __name__ == "__main__":
+    main()

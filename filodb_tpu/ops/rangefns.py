@@ -406,8 +406,6 @@ RANGE_FUNCTIONS: Dict[str, RangeFnSpec] = {
 }
 
 
-@functools.partial(jax.jit,
-                   static_argnames=("fn_name", "params", "shared_grid"))
 def evaluate_range_function(ts_off: jax.Array, vals: jax.Array,
                             wends: jax.Array, range_ms,
                             fn_name: Optional[str],
@@ -419,7 +417,25 @@ def evaluate_range_function(ts_off: jax.Array, vals: jax.Array,
     last sample within the stale-lookback window, which callers express by
     passing range_ms = lookback and fn_name = 'last_over_time'.
     shared_grid: all ts_off rows identical -> column-gather fast path.
+
+    base_ms crosses the jit boundary as float: epoch-ms magnitudes overflow
+    int32 canonicalization on TPU (no x64).  On f32 backends an epoch base
+    rounds to ~2-minute granularity, so the only consumer needing exact
+    epoch values — timestamp_fn — is fed base_ms=0 by PeriodicSamplesMapper,
+    which re-adds the base host-side in f64.  Tracer/array inputs
+    (mesh-inner calls already under jit) pass through untouched.
     """
+    if isinstance(base_ms, (int, float)):
+        base_ms = float(base_ms)
+    return _evaluate_range_function(ts_off, vals, wends, range_ms,
+                                    base_ms, fn_name, params,
+                                    shared_grid)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("fn_name", "params", "shared_grid"))
+def _evaluate_range_function(ts_off, vals, wends, range_ms, base_ms,
+                             fn_name, params, shared_grid):
     ctx = make_ctx(ts_off, vals, wends, range_ms, base_ms, shared_grid)
     name = fn_name or "last_over_time"
     spec = RANGE_FUNCTIONS[name]

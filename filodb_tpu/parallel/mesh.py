@@ -147,10 +147,22 @@ def device_put_packed(packed: PackedShards, mesh: Mesh) -> PackedShards:
 
 # ------------------------------------------------------------ SPMD kernels
 
+def distributed_window_agg(mesh: Mesh, ts_off, values, group_ids, wends, *,
+                           range_ms, fn_name, params=(), agg_op="sum",
+                           num_groups=1, base_ms=0):
+    """Eager wrapper: floats base_ms before the jit boundary (epoch-ms ints
+    overflow int32 canonicalization on no-x64 TPU; see rangefns)."""
+    return _distributed_window_agg(mesh, ts_off, values, group_ids, wends,
+                                   range_ms=range_ms, fn_name=fn_name,
+                                   params=params, agg_op=agg_op,
+                                   num_groups=num_groups,
+                                   base_ms=float(base_ms))
+
+
 @functools.partial(
     jax.jit,
     static_argnames=("mesh", "fn_name", "params", "agg_op", "num_groups"))
-def distributed_window_agg(mesh: Mesh,
+def _distributed_window_agg(mesh: Mesh,
                            ts_off: jax.Array, values: jax.Array,
                            group_ids: jax.Array, wends: jax.Array,
                            *, range_ms: int, fn_name: Optional[str],
@@ -186,9 +198,17 @@ def distributed_window_agg(mesh: Mesh,
         out_specs=P(None, "time", None))(ts_off, values, group_ids, wends)
 
 
+def distributed_window_raw(mesh: Mesh, ts_off, values, wends, *, range_ms,
+                           fn_name, params=(), base_ms=0):
+    """Eager wrapper: floats base_ms (see distributed_window_agg)."""
+    return _distributed_window_raw(mesh, ts_off, values, wends,
+                                   range_ms=range_ms, fn_name=fn_name,
+                                   params=params, base_ms=float(base_ms))
+
+
 @functools.partial(
     jax.jit, static_argnames=("mesh", "fn_name", "params"))
-def distributed_window_raw(mesh: Mesh,
+def _distributed_window_raw(mesh: Mesh,
                            ts_off: jax.Array, values: jax.Array,
                            wends: jax.Array, *, range_ms: int,
                            fn_name: Optional[str],

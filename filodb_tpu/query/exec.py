@@ -121,6 +121,10 @@ class PeriodicSamplesMapper(RangeVectorTransformer):
         window = self.window_ms if self.window_ms else self.lookback_ms
         fn = self.function
         base = data.base_ms
+        # timestamp(): the kernel computes f32 offset-seconds (exact for
+        # query-sized ranges); the epoch base adds back below in f64 — f32
+        # cannot hold epoch seconds to sub-minute precision
+        kernel_base = 0 if fn == "timestamp" else base
         # offset: shift the window grid back, evaluate, keep original stamps
         eval_wends = wends - self.offset_ms
         wends_off = (eval_wends - base).astype(np.int32)
@@ -132,13 +136,15 @@ class PeriodicSamplesMapper(RangeVectorTransformer):
             out = np.asarray(evaluate_range_function(
                 jnp.asarray(ts_rep), jnp.asarray(flat),
                 jnp.asarray(wends_off), window, fn,
-                tuple(self.function_args), base_ms=base))
+                tuple(self.function_args), base_ms=kernel_base))
             out = np.moveaxis(out.reshape(S, B, -1), 1, 2)     # [S, W, B]
         else:
             out = np.asarray(evaluate_range_function(
                 jnp.asarray(data.ts_off), jnp.asarray(vals),
                 jnp.asarray(wends_off), window, fn,
-                tuple(self.function_args), base_ms=base))
+                tuple(self.function_args), base_ms=kernel_base))
+        if fn == "timestamp":
+            out = out.astype(np.float64) + base / 1000.0
         return ResultBlock(data.keys, wends, out, data.bucket_les)
 
 
