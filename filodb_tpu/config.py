@@ -40,6 +40,10 @@ class StoreConfig:
     multi_partition_odp: bool = False
     # TPU-native addition: time-block length (samples) for dense device arrays.
     device_block_rows: int = 128
+    # keep an HBM-resident mirror of each store, revalidated by generation,
+    # so repeat queries skip the host->device transfer (devicecache.py)
+    device_mirror_enabled: bool = True
+    device_mirror_hbm_limit: int = 8 << 30
 
 
 @dataclasses.dataclass

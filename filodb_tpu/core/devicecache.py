@@ -1,0 +1,83 @@
+"""Device-resident mirror of a shard's dense series store.
+
+The TPU-native analogue of the reference's block-memory working set (ref:
+memory/.../BlockManager.scala — query-hot chunks live in pinned block
+memory; SURVEY §7.2 'device mirror: packed [series x time-block] arrays
+per schema').  Without a mirror every query re-ships the full [S, T]
+matrix host→device — on a tunneled TPU that transfer dwarfs compute.
+
+The mirror uploads a store's live arrays once and revalidates by the
+store's generation counter: unchanged generation → queries gather rows
+ON DEVICE from the cached copy; changed generation → one re-upload (the
+same cost the uncached path paid per query, so live-ingest workloads are
+never worse off).  Timestamp offsets are rebased once to the mirror's
+base, so every query shares the cached int32 offset matrix regardless of
+its own chunk-scan window.
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from filodb_tpu.ops.timewindow import PAD_TS
+
+
+class DeviceMirror:
+    """One mirror per DenseSeriesStore (lazily attached)."""
+
+    def __init__(self, hbm_limit_bytes: int = 8 << 30):
+        self.hbm_limit_bytes = hbm_limit_bytes
+        self._gen = -1
+        self._t_used = 0
+        self._base_ms = 0
+        self._ts_off = None                 # jax i32 [S_live, T_used]
+        self._cols: Dict[str, object] = {}  # jax f [S_live, T_used(, B)]
+
+    def _nbytes(self, store) -> int:
+        t = max(store.time_used, 1)
+        n = store.num_series * t * 4
+        for arr in store.cols.values():
+            if arr is not None:
+                n += store.num_series * t * arr.itemsize * \
+                    (arr.shape[2] if arr.ndim == 3 else 1)
+        return n
+
+    def _refresh(self, store) -> bool:
+        import jax
+        if self._nbytes(store) > self.hbm_limit_bytes:
+            return False
+        s, t = store.num_series, max(store.time_used, 1)
+        ts = store.ts[:s, :t]
+        live = ts[ts > 0]
+        self._base_ms = int(live.min()) if live.size else 0
+        pos = np.arange(t)[None, :]
+        off = np.clip(ts - self._base_ms, -(1 << 30), 1 << 30).astype(np.int32)
+        ts_off = np.where(pos < store.counts[:s, None], off, PAD_TS)
+        self._ts_off = jax.device_put(ts_off)
+        self._cols = {}
+        for name, arr in store.cols.items():
+            if arr is not None:
+                self._cols[name] = jax.device_put(arr[:s, :t])
+        self._t_used = t
+        self._gen = store.generation
+        return True
+
+    def gather(self, store, rows: np.ndarray
+               ) -> Optional[Tuple[object, Dict[str, object]]]:
+        """(ts_off [R, T], cols) as device arrays for the requested rows, or
+        None when the mirror cannot serve (over the HBM cap).  The returned
+        offsets are relative to `self.base_ms`."""
+        import jax.numpy as jnp
+        if store.generation != self._gen or self._ts_off is None:
+            if not self._refresh(store):
+                return None
+        idx = jnp.asarray(rows.astype(np.int32))
+        ts_off = jnp.take(self._ts_off, idx, axis=0)
+        cols = {name: jnp.take(arr, idx, axis=0)
+                for name, arr in self._cols.items()}
+        return ts_off, cols
+
+    @property
+    def base_ms(self) -> int:
+        return self._base_ms

@@ -736,8 +736,19 @@ class MultiSchemaPartitionsExec(LeafExecPlan):
         if not parts:
             return None, stats
         shard.ensure_paged(parts, self.chunk_start_ms, self.chunk_end_ms)
-        gathered = shard.gather_series(parts)
-        ts, cols, counts, store = gathered
+        # device-resident fast path: gather rows from the HBM mirror instead
+        # of re-shipping the matrix every query (ref: block-memory working
+        # set, BlockManager.scala; see core/devicecache.py)
+        store = shard.stores[schema_name]
+        rows = np.asarray([p.row for p in parts], dtype=np.int64)
+        counts = store.counts[rows]
+        mirrored = None
+        if getattr(shard.config.store, "device_mirror_enabled", True):
+            mirror = getattr(store, "device_mirror", None)
+            if mirror is None:
+                from filodb_tpu.core.devicecache import DeviceMirror
+                mirror = store.device_mirror = DeviceMirror()
+            mirrored = mirror.gather(store, rows)
         schema = shard.schemas[schema_name]
         col_name = (self.columns[0] if self.columns
                     else schema.value_column)
@@ -759,9 +770,15 @@ class MultiSchemaPartitionsExec(LeafExecPlan):
                                 dataclasses.replace(t, function=sub[1])
                     break
         # value column selection: histograms gather [S, T, B]
-        vals = cols[col_name]
-        base = self.chunk_start_ms
-        ts_off = to_offsets(ts, counts, base)
+        if mirrored is not None:
+            ts_off, dev_cols = mirrored
+            vals = dev_cols[col_name]
+            base = store.device_mirror.base_ms
+        else:
+            ts, cols, counts, _ = shard.gather_series(parts)
+            vals = cols[col_name]
+            base = self.chunk_start_ms
+            ts_off = to_offsets(ts, counts, base)
         keys = [RangeVectorKey.make(
             {**p.part_key.tags_dict, "_metric_": p.part_key.metric})
             for p in parts]
