@@ -19,9 +19,19 @@ per-window loop baseline ("iterator") mimicking ChunkedWindowIterator's
 per-window access pattern is reported as an extra field.
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
+
+Robustness: backend init on the tunneled TPU ('axon') can fail or hang
+indefinitely, which in round 1 destroyed the whole round's bench artifact.
+The default invocation therefore runs as a SUPERVISOR that executes the
+measurement in a child process under a hard timeout, retries once, and
+falls back to a (smaller) CPU run — so a JSON line with a `platform` field
+is always emitted, no matter what the TPU tunnel does.
 """
 import argparse
 import json
+import os
+import subprocess
+import sys
 import time
 
 import numpy as np
@@ -69,15 +79,28 @@ def numpy_iterator_baseline(ts_row, vals, wends, range_ms):
     return out
 
 
-def main():
+def parse_args(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true",
                     help="small config for smoke runs")
     ap.add_argument("--series", type=int, default=0)
     ap.add_argument("--iters", type=int, default=0)
-    args = ap.parse_args()
+    ap.add_argument("--_worker", action="store_true",
+                    help="internal: run the measurement in this process")
+    ap.add_argument("--platform", default="default",
+                    choices=["default", "cpu"],
+                    help="internal: pin the jax platform for a worker run")
+    return ap.parse_args(argv)
 
+
+def run_worker(args):
     import jax
+
+    if args.platform == "cpu":
+        # Env vars are too late once the sitecustomize hook has imported
+        # jax — pin via jax.config (same fix as tests/conftest.py).
+        jax.config.update("jax_platforms", "cpu")
+
     from filodb_tpu.ops.rangefns import evaluate_range_function
     from filodb_tpu.ops import agg as agg_ops
     from filodb_tpu.ops.timewindow import to_offsets, make_window_ends
@@ -85,6 +108,9 @@ def main():
     platform = jax.devices()[0].platform
     quick = args.quick
     S = args.series or (8_192 if quick else 262_144)
+    if platform == "cpu" and not args.series:
+        # fallback runs must finish within the supervisor timeout
+        S = min(S, 65_536)
     T = 720                                  # 2h of 10s samples
     G = min(1000, S)                         # sum by() group count
     range_ms, step_ms = 300_000, 60_000      # rate[5m], 1m steps
@@ -152,6 +178,94 @@ def main():
         "iterator_baseline_samples_per_sec": round(it_samples_per_sec, 1),
     }
     print(json.dumps(result))
+
+
+def _spawn_worker(args, platform, timeout_s):
+    """Run the measurement in a child under a hard timeout; return the
+    parsed JSON result dict or None."""
+    cmd = [sys.executable, os.path.abspath(__file__), "--_worker",
+           "--platform", platform]
+    if args.quick:
+        cmd.append("--quick")
+    if args.series:
+        cmd += ["--series", str(args.series)]
+    if args.iters:
+        cmd += ["--iters", str(args.iters)]
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True,
+                              timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        print(f"bench: worker ({platform}) timed out after {timeout_s}s",
+              file=sys.stderr)
+        return None
+    if proc.returncode != 0:
+        tail = "\n".join(proc.stderr.strip().splitlines()[-5:])
+        print(f"bench: worker ({platform}) rc={proc.returncode}:\n{tail}",
+              file=sys.stderr)
+        return None
+    for line in reversed(proc.stdout.strip().splitlines()):
+        if line.startswith("{"):
+            try:
+                return json.loads(line)
+            except json.JSONDecodeError:
+                continue
+    print(f"bench: worker ({platform}) emitted no JSON", file=sys.stderr)
+    return None
+
+
+def _probe_default_backend(timeout_s):
+    """Init the default jax backend in a child; return its platform name or
+    None if init fails/hangs.  Cheap insurance against the tunneled-TPU
+    backend hanging indefinitely (it did in round 1)."""
+    code = "import jax; print(jax.devices()[0].platform)"
+    try:
+        p = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                           text=True, timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        print(f"bench: backend probe timed out after {timeout_s}s",
+              file=sys.stderr)
+        return None
+    if p.returncode == 0 and p.stdout.strip():
+        return p.stdout.strip().splitlines()[-1]
+    return None
+
+
+def main():
+    args = parse_args()
+    if args._worker:
+        run_worker(args)
+        return
+
+    # Supervisor: probe the default backend (the real chip) under a short
+    # timeout, run the measurement there if it answers, and otherwise fall
+    # back to CPU — so the round always records a number.
+    if args.platform == "cpu":
+        # explicit CPU request: no probe, no fallback relabeling
+        result = _spawn_worker(args, "cpu", 1200)
+        print(json.dumps(result if result is not None else {
+            "metric": "promql_samples_scanned_per_sec", "value": 0.0,
+            "unit": "samples/s", "vs_baseline": 0.0, "platform": "none",
+            "error": "cpu bench attempt failed"}))
+        return
+    tpu_timeout = int(os.environ.get("FILODB_BENCH_TPU_TIMEOUT",
+                                     "600" if args.quick else "1800"))
+    plat = _probe_default_backend(180) or _probe_default_backend(90)
+    if plat is not None:
+        for _ in range(2):
+            result = _spawn_worker(args, "default", tpu_timeout)
+            if result is not None:
+                print(json.dumps(result))
+                return
+    result = _spawn_worker(args, "cpu", 1200)
+    if result is not None:
+        result["fallback"] = "cpu (default backend unavailable: probe=%s)" % plat
+        print(json.dumps(result))
+        return
+    print(json.dumps({
+        "metric": "promql_samples_scanned_per_sec", "value": 0.0,
+        "unit": "samples/s", "vs_baseline": 0.0, "platform": "none",
+        "error": "all bench attempts failed (default backend + cpu)",
+    }))
 
 
 if __name__ == "__main__":

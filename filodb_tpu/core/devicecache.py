@@ -33,6 +33,9 @@ class DeviceMirror:
         self._base_ms = 0
         self._ts_off = None                 # jax i32 [S_live, T_used]
         self._cols: Dict[str, object] = {}  # jax f [S_live, T_used(, B)]
+        # per-series value bases subtracted in f64 before upload, so counter
+        # deltas survive the f32 downcast (ops/timewindow.series_value_base)
+        self._vbases: Dict[str, object] = {}
 
     def _nbytes(self, store) -> int:
         t = max(store.time_used, 1)
@@ -56,18 +59,28 @@ class DeviceMirror:
         ts_off = np.where(pos < store.counts[:s, None], off, PAD_TS)
         self._ts_off = jax.device_put(ts_off)
         self._cols = {}
+        self._vbases = {}
+        from filodb_tpu.ops.counter import rebase_values
+        counter_cols = {c.name for c in store.schema.data_columns
+                        if c.detect_drops or c.counter}
         for name, arr in store.cols.items():
             if arr is not None:
-                self._cols[name] = jax.device_put(arr[:s, :t])
+                # counter columns are reset-corrected in f64 BEFORE rebasing
+                # so f32 deltas are exact across resets; the leaf exec routes
+                # non-counter functions on counter columns around the mirror
+                rebased, vb = rebase_values(arr[:s, :t], name in counter_cols)
+                self._cols[name] = jax.device_put(rebased)
+                self._vbases[name] = jax.device_put(vb)
         self._t_used = t
         self._gen = store.generation
         return True
 
     def gather(self, store, rows: np.ndarray
-               ) -> Optional[Tuple[object, Dict[str, object]]]:
-        """(ts_off [R, T], cols) as device arrays for the requested rows, or
-        None when the mirror cannot serve (over the HBM cap).  The returned
-        offsets are relative to `self.base_ms`."""
+               ) -> Optional[Tuple[object, Dict[str, object], Dict[str, object]]]:
+        """(ts_off [R, T], cols, vbases) as device arrays for the requested
+        rows, or None when the mirror cannot serve (over the HBM cap).  The
+        returned offsets are relative to `self.base_ms`; col values are
+        rebased by vbases[col]."""
         import jax.numpy as jnp
         if store.generation != self._gen or self._ts_off is None:
             if not self._refresh(store):
@@ -76,7 +89,9 @@ class DeviceMirror:
         ts_off = jnp.take(self._ts_off, idx, axis=0)
         cols = {name: jnp.take(arr, idx, axis=0)
                 for name, arr in self._cols.items()}
-        return ts_off, cols
+        vbases = {name: jnp.take(vb, idx, axis=0)
+                  for name, vb in self._vbases.items()}
+        return ts_off, cols, vbases
 
     @property
     def base_ms(self) -> int:

@@ -15,6 +15,57 @@ from typing import Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
+
+
+def host_counter_correct(vals: np.ndarray) -> np.ndarray:
+    """Reset-correction in f64 on HOST, before the f32 device downcast.
+
+    This is the ingest-side drop detection of the reference
+    (ref: memory/.../format/vectors/DoubleVector.scala:442
+    DoubleCounterAppender records drops at ingest) moved to the gather
+    boundary: counter columns are corrected (made monotone) in f64 so that
+    after per-series rebasing every delta the device computes is exact in
+    f32 — including across resets, where the drop magnitude itself can
+    exceed f32 resolution at large counter values.  Accepts [S, T] or
+    [S, T, B] (histogram buckets are counters too); NaNs pass through.
+    """
+    v = np.asarray(vals, dtype=np.float64)
+    orig_shape = v.shape
+    if v.ndim == 3:
+        v = np.moveaxis(v, 2, 1).reshape(-1, orig_shape[1])
+    S, T = v.shape
+    valid = np.isfinite(v)
+    idx = np.where(valid, np.arange(T)[None, :], -1)
+    last_valid = np.maximum.accumulate(idx, axis=1)
+    prev_idx = np.concatenate(
+        [np.full((S, 1), -1, dtype=last_valid.dtype), last_valid[:, :-1]],
+        axis=1)
+    prev = np.where(prev_idx >= 0,
+                    np.take_along_axis(v, np.maximum(prev_idx, 0), axis=1),
+                    np.nan)
+    drops = np.where(valid & np.isfinite(prev) & (prev > v), prev - v, 0.0)
+    out = v + np.cumsum(drops, axis=1)
+    if len(orig_shape) == 3:
+        out = np.moveaxis(out.reshape(orig_shape[0], orig_shape[2],
+                                      orig_shape[1]), 1, 2)
+    return out
+
+
+def rebase_values(vals: np.ndarray, correct_counter: bool
+                  ) -> Tuple[np.ndarray, np.ndarray]:
+    """The single host-side prep step for device value columns: optional f64
+    reset correction, then per-series rebasing.  Returns (rebased f64, vbase)
+    with vbase [S] (or [S, B] for histograms).  Both the leaf exec raw path
+    and the DeviceMirror upload MUST use this so the two paths cannot
+    diverge numerically."""
+    from filodb_tpu.ops.timewindow import series_value_base
+    v64 = np.asarray(vals, dtype=np.float64)
+    if correct_counter:
+        v64 = host_counter_correct(v64)
+    vbase = series_value_base(v64)
+    rebased = v64 - (vbase[:, None, :] if v64.ndim == 3 else vbase[:, None])
+    return rebased, vbase
 
 
 def _prev_valid(vals: jax.Array) -> jax.Array:

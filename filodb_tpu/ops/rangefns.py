@@ -37,7 +37,7 @@ from filodb_tpu.ops.timewindow import (PAD_TS, gather_at, window_bounds,
 class WindowCtx(NamedTuple):
     """Precomputed per-query window state shared by all range functions."""
     ts_off: jax.Array      # i32 [S, T]
-    vals: jax.Array        # f [S, T] (raw)
+    vals: jax.Array        # f [S, T] (rebased: absolute value - vbase[s])
     valid: jax.Array       # bool [S, T]
     wstart: jax.Array      # i32 [W] inclusive
     wend: jax.Array        # i32 [W] inclusive
@@ -45,23 +45,53 @@ class WindowCtx(NamedTuple):
     last: jax.Array        # i32 [S, W]
     n: jax.Array           # i32 [S, W] samples in window
     base_ms: jax.Array     # i64/f scalar: absolute ms of offset 0
+    vbase: jax.Array       # f [S] per-series value base (0 when not rebased)
+    # True when the host already reset-corrected counter values in f64
+    # (ops/counter.rebase_values) -> the device drop scan is a no-op and
+    # is skipped.  Python bool, constant-folded under jit.
+    precorrected: bool = False
 
 
 def make_ctx(ts_off: jax.Array, vals: jax.Array,
              wends: jax.Array, range_ms, base_ms=0,
-             shared_grid: bool = False) -> WindowCtx:
+             shared_grid: bool = False, vbase=None,
+             precorrected: bool = False) -> WindowCtx:
     """shared_grid=True asserts every series row of ts_off is identical
     (one scrape grid — the common case); window bounds are then computed
     once from row 0 and kept [1, W], turning every downstream gather into
-    a cheap column gather (see timewindow.gather_at)."""
+    a cheap column gather (see timewindow.gather_at).
+
+    vbase is the per-series value base subtracted host-side in f64 before
+    the downcast to the device dtype.  Difference-based functions (the rate
+    family, stddev, deriv, ...) run directly on the rebased values — this
+    is what keeps counter deltas exact in f32 even for counters >= 2^24
+    (ref: rate semantics RateFunctions.scala:37-76; the reference computes
+    in f64 where cancellation is benign).  Absolute-value functions add the
+    base back via _absolute()."""
     wend = wends.astype(jnp.int32)
     wstart = (wend - jnp.int32(range_ms) + 1).astype(jnp.int32)
     valid = (~jnp.isnan(vals)) & (ts_off < PAD_TS)
     # NaN samples must not satisfy boundary gathers; they are masked in sums
     first, last, n = window_bounds(ts_off[:1] if shared_grid else ts_off,
                                    wstart, wend)
+    if vbase is None:
+        vbase = jnp.zeros(vals.shape[:1], vals.dtype)
     return WindowCtx(ts_off, vals, valid, wstart, wend, first, last, n,
-                     jnp.asarray(base_ms, vals.dtype))
+                     jnp.asarray(base_ms, vals.dtype),
+                     vbase.astype(vals.dtype), precorrected)
+
+
+def _absolute(ctx: WindowCtx) -> WindowCtx:
+    """Ctx with absolute values restored (for functions whose OUTPUT is in
+    absolute value space).  Precision equals shipping absolute f32 directly,
+    so rebasing never regresses these functions."""
+    return ctx._replace(vals=ctx.vals + ctx.vbase[:, None],
+                        vbase=jnp.zeros_like(ctx.vbase))
+
+
+def _counter_values(ctx: WindowCtx) -> jax.Array:
+    """Reset-corrected values: free when the host pre-corrected in f64."""
+    return ctx.vals if ctx.precorrected else counter_ops.counter_correct(ctx.vals)
 
 
 def _cumsum(x: jax.Array) -> jax.Array:
@@ -80,18 +110,22 @@ def _nan_where(cond: jax.Array, x: jax.Array) -> jax.Array:
 # --------------------------------------------------------------- extrapolation
 
 def extrapolated_rate(window_start, window_end, n, t1, v1, t2, v2,
-                      is_counter: bool, is_rate: bool) -> jax.Array:
+                      is_counter: bool, is_rate: bool,
+                      v1_abs=None) -> jax.Array:
     """Vectorized Prometheus extrapolation (semantics of ref:
     RateFunctions.scala:37-76 extrapolatedRate; all args [S, W] except the
-    window bounds which broadcast [W])."""
+    window bounds which broadcast [W]).  v1_abs is the ABSOLUTE first value
+    for the counter-started-at-zero heuristic when v1/v2 are rebased; the
+    heuristic only gates a threshold so f32 absolute precision suffices."""
     dur_start = (t1 - window_start) / 1000.0
     dur_end = (window_end - t2) / 1000.0
     sampled = (t2 - t1) / 1000.0
     avg_between = sampled / (n - 1.0)
     delta = v2 - v1
     if is_counter:
-        dur_zero = sampled * (v1 / jnp.where(delta == 0, jnp.inf, delta))
-        take_zero = (delta > 0) & (v1 >= 0) & (dur_zero < dur_start)
+        va = v1 if v1_abs is None else v1_abs
+        dur_zero = sampled * (va / jnp.where(delta == 0, jnp.inf, delta))
+        take_zero = (delta > 0) & (va >= 0) & (dur_zero < dur_start)
         dur_start = jnp.where(take_zero, dur_zero, dur_start)
     threshold = avg_between * 1.1
     extrap = sampled
@@ -104,7 +138,7 @@ def extrapolated_rate(window_start, window_end, n, t1, v1, t2, v2,
 
 
 def _rate_family(ctx: WindowCtx, is_counter: bool, is_rate: bool) -> jax.Array:
-    vals = counter_ops.counter_correct(ctx.vals) if is_counter else ctx.vals
+    vals = _counter_values(ctx) if is_counter else ctx.vals
     t1 = gather_at(ctx.ts_off, ctx.first).astype(vals.dtype)
     t2 = gather_at(ctx.ts_off, ctx.last).astype(vals.dtype)
     v1 = gather_at(vals, ctx.first)
@@ -112,8 +146,10 @@ def _rate_family(ctx: WindowCtx, is_counter: bool, is_rate: bool) -> jax.Array:
     # boundary per ChunkedRateFunctionBase: windowStart - 1 == wend - range
     wstart_x = (ctx.wstart - 1).astype(vals.dtype)[None, :]
     wend_x = ctx.wend.astype(vals.dtype)[None, :]
+    v1_abs = v1 + ctx.vbase[:, None] if is_counter else None
     out = extrapolated_rate(wstart_x, wend_x, ctx.n.astype(vals.dtype),
-                            t1, v1, t2, v2, is_counter, is_rate)
+                            t1, v1, t2, v2, is_counter, is_rate,
+                            v1_abs=v1_abs)
     return _nan_where(ctx.n >= 2, out)
 
 
@@ -130,7 +166,7 @@ def delta_fn(ctx: WindowCtx) -> jax.Array:
 
 
 def irate(ctx: WindowCtx) -> jax.Array:
-    vals = counter_ops.counter_correct(ctx.vals)
+    vals = _counter_values(ctx)
     t2 = gather_at(ctx.ts_off, ctx.last).astype(vals.dtype)
     t1 = gather_at(ctx.ts_off, ctx.last - 1).astype(vals.dtype)
     v2 = gather_at(vals, ctx.last)
@@ -217,13 +253,24 @@ def present_over_time(ctx: WindowCtx) -> jax.Array:
 # ------------------------------------------------ pairwise-indicator functions
 
 def _pair_indicator_window(ctx: WindowCtx, indicator: jax.Array) -> jax.Array:
-    """Sum indicator[t] (attributed to pair (prev,t)) for pairs fully inside
-    the window: cum[last] - cum[first] (the pair of the first sample reaches
-    before the window and is excluded)."""
+    """Sum indicator[t] (attributed to pair (prev_valid, t)) for pairs whose
+    BOTH members are valid samples inside the window — Prometheus
+    changes()/resets() start fresh at the window's first valid sample, so a
+    pair reaching back past the window start (including across a leading NaN
+    gap) must not count.  Sum over indices strictly after the first valid
+    in-window sample: cum[last] - cum[first_valid]."""
     cum = _cumsum(indicator)
+    cv = jnp.cumsum(ctx.valid.astype(jnp.int32), axis=1)     # [S, T]
+    rank_before = jnp.where(ctx.first > 0,
+                            gather_at(cv, ctx.first - 1), 0)  # [S, W]
+    # index of the (rank_before+1)-th valid sample = first valid in window
+    first_valid = jax.vmap(
+        lambda cv_row, tgt: jnp.searchsorted(cv_row, tgt, side="left")
+    )(cv, rank_before + 1)
+    nvalid = gather_at(cv, ctx.last) - rank_before
     hi = gather_at(cum, ctx.last)
-    lo = gather_at(cum, ctx.first)
-    return hi - lo
+    lo = gather_at(cum, first_valid)
+    return jnp.where(nvalid >= 2, hi - lo, 0.0)
 
 
 def resets(ctx: WindowCtx) -> jax.Array:
@@ -377,6 +424,11 @@ class RangeFnSpec(NamedTuple):
     fn: Callable
     needs_params: int = 0       # number of scalar params consumed
     is_counter: bool = False
+    # output lives in absolute value space -> re-add the per-series base.
+    # Difference-/shape-based functions (rate family, stddev, deriv, changes,
+    # z_score, ...) are shift-invariant and run on rebased values directly,
+    # which is exactly where the f32 precision win lives.
+    absolute: bool = False
 
 
 RANGE_FUNCTIONS: Dict[str, RangeFnSpec] = {
@@ -388,17 +440,19 @@ RANGE_FUNCTIONS: Dict[str, RangeFnSpec] = {
     "resets": RangeFnSpec(resets),
     "changes": RangeFnSpec(changes),
     "deriv": RangeFnSpec(deriv),
-    "predict_linear": RangeFnSpec(predict_linear, needs_params=1),
-    "sum_over_time": RangeFnSpec(sum_over_time),
+    "predict_linear": RangeFnSpec(predict_linear, needs_params=1,
+                                  absolute=True),
+    "sum_over_time": RangeFnSpec(sum_over_time, absolute=True),
     "count_over_time": RangeFnSpec(count_over_time),
-    "avg_over_time": RangeFnSpec(avg_over_time),
-    "min_over_time": RangeFnSpec(min_over_time),
-    "max_over_time": RangeFnSpec(max_over_time),
+    "avg_over_time": RangeFnSpec(avg_over_time, absolute=True),
+    "min_over_time": RangeFnSpec(min_over_time, absolute=True),
+    "max_over_time": RangeFnSpec(max_over_time, absolute=True),
     "stddev_over_time": RangeFnSpec(stddev_over_time),
     "stdvar_over_time": RangeFnSpec(stdvar_over_time),
-    "last_over_time": RangeFnSpec(last_over_time),
-    "quantile_over_time": RangeFnSpec(quantile_over_time, needs_params=1),
-    "holt_winters": RangeFnSpec(holt_winters, needs_params=2),
+    "last_over_time": RangeFnSpec(last_over_time, absolute=True),
+    "quantile_over_time": RangeFnSpec(quantile_over_time, needs_params=1,
+                                      absolute=True),
+    "holt_winters": RangeFnSpec(holt_winters, needs_params=2, absolute=True),
     "z_score": RangeFnSpec(z_score),
     "timestamp": RangeFnSpec(timestamp_fn),
     "absent_over_time": RangeFnSpec(absent_over_time),
@@ -410,7 +464,9 @@ def evaluate_range_function(ts_off: jax.Array, vals: jax.Array,
                             wends: jax.Array, range_ms,
                             fn_name: Optional[str],
                             params: Tuple[float, ...] = (),
-                            base_ms=0, shared_grid: bool = False) -> jax.Array:
+                            base_ms=0, shared_grid: bool = False,
+                            vbase=None, precorrected: bool = False
+                            ) -> jax.Array:
     """The fused leaf kernel: window bounds + range function in one jit.
 
     fn_name None means plain periodic samples (instant-vector selector):
@@ -427,18 +483,25 @@ def evaluate_range_function(ts_off: jax.Array, vals: jax.Array,
     """
     if isinstance(base_ms, (int, float)):
         base_ms = float(base_ms)
+    if vbase is None:
+        vbase = jnp.zeros(vals.shape[:1], vals.dtype)
     return _evaluate_range_function(ts_off, vals, wends, range_ms,
-                                    base_ms, fn_name, params,
-                                    shared_grid)
+                                    base_ms, vbase, fn_name, params,
+                                    shared_grid, precorrected)
 
 
 @functools.partial(jax.jit,
-                   static_argnames=("fn_name", "params", "shared_grid"))
+                   static_argnames=("fn_name", "params", "shared_grid",
+                                    "precorrected"))
 def _evaluate_range_function(ts_off, vals, wends, range_ms, base_ms,
-                             fn_name, params, shared_grid):
-    ctx = make_ctx(ts_off, vals, wends, range_ms, base_ms, shared_grid)
+                             vbase, fn_name, params, shared_grid,
+                             precorrected):
+    ctx = make_ctx(ts_off, vals, wends, range_ms, base_ms, shared_grid,
+                   vbase, precorrected)
     name = fn_name or "last_over_time"
     spec = RANGE_FUNCTIONS[name]
+    if spec.absolute:
+        ctx = _absolute(ctx)
     if spec.needs_params:
         return spec.fn(ctx, *params[: spec.needs_params])
     return spec.fn(ctx)

@@ -37,6 +37,24 @@ def to_offsets(ts: np.ndarray, counts: np.ndarray, base_ms: int) -> np.ndarray:
     return np.where(pos < counts[:, None], off, PAD_TS)
 
 
+def series_value_base(vals: np.ndarray) -> np.ndarray:
+    """Host-side per-series value base for f64->f32 rebasing: the first
+    finite value along time.  [S, T] -> [S]; [S, T, B] -> [S, B].
+
+    Subtracting this in f64 BEFORE the device downcast keeps counter deltas
+    exact in f32 even for counters >= 2^24, where absolute f32 storage loses
+    every per-sample increment (the value-space analogue of the epoch-ms
+    timestamp rebasing; ref rate math: rangefn/RateFunctions.scala:37-76).
+    """
+    finite = np.isfinite(vals)
+    first = finite.argmax(axis=1)
+    if vals.ndim == 3:
+        base = np.take_along_axis(vals, first[:, None, :], axis=1)[:, 0, :]
+    else:
+        base = vals[np.arange(vals.shape[0]), first]
+    return np.where(finite.any(axis=1), base, 0.0)
+
+
 @functools.partial(jax.jit, static_argnames=())
 def window_bounds(ts_off: jax.Array, wstart: jax.Array, wend: jax.Array
                   ) -> Tuple[jax.Array, jax.Array, jax.Array]:

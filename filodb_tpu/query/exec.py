@@ -28,7 +28,8 @@ from filodb_tpu.ops import agg as agg_ops
 from filodb_tpu.ops import hist as hist_ops
 from filodb_tpu.ops.instant import (INSTANT_FUNCTIONS, ARITH_OPERATORS,
                                     COMPARISON_OPERATORS, apply_binary_op)
-from filodb_tpu.ops.rangefns import evaluate_range_function
+from filodb_tpu.ops import counter as counter_ops
+from filodb_tpu.ops.rangefns import RANGE_FUNCTIONS, evaluate_range_function
 from filodb_tpu.ops.timewindow import to_offsets, make_window_ends
 from filodb_tpu.query.rangevector import (QueryContext, QueryResult, QueryStats,
                                           RangeVectorKey, ResultBlock,
@@ -39,13 +40,19 @@ from filodb_tpu.query.rangevector import (QueryContext, QueryResult, QueryStats,
 
 @dataclasses.dataclass
 class RawBlock:
-    """Raw gathered samples for one schema on one shard: pre-step-grid."""
+    """Raw gathered samples for one schema on one shard: pre-step-grid.
+
+    values are REBASED per series (absolute value - vbase[s]) so counter
+    deltas survive the f32 device downcast; vbase is the per-series base
+    in f64 (None = not rebased).  See ops/timewindow.series_value_base."""
     keys: List[RangeVectorKey]
     ts_off: np.ndarray                  # int32 [S, T] offsets from base_ms
     values: np.ndarray                  # [S, T] or [S, T, B]
     base_ms: int
     bucket_les: Optional[np.ndarray] = None
     samples: int = 0                    # total valid samples (stats)
+    vbase: Optional[np.ndarray] = None  # [S] or [S, B]
+    precorrected: bool = False          # counter reset-correction done host-side
 
 
 @dataclasses.dataclass
@@ -129,20 +136,25 @@ class PeriodicSamplesMapper(RangeVectorTransformer):
         eval_wends = wends - self.offset_ms
         wends_off = (eval_wends - base).astype(np.int32)
         vals = data.values
+        vb = data.vbase
         if vals.ndim == 3:
             S, T, B = vals.shape
             flat = np.moveaxis(vals, 2, 1).reshape(S * B, T)
             ts_rep = np.repeat(data.ts_off, B, axis=0)
+            vb_flat = None if vb is None else jnp.asarray(vb).reshape(S * B)
             out = np.asarray(evaluate_range_function(
                 jnp.asarray(ts_rep), jnp.asarray(flat),
                 jnp.asarray(wends_off), window, fn,
-                tuple(self.function_args), base_ms=kernel_base))
+                tuple(self.function_args), base_ms=kernel_base,
+                vbase=vb_flat, precorrected=data.precorrected))
             out = np.moveaxis(out.reshape(S, B, -1), 1, 2)     # [S, W, B]
         else:
             out = np.asarray(evaluate_range_function(
                 jnp.asarray(data.ts_off), jnp.asarray(vals),
                 jnp.asarray(wends_off), window, fn,
-                tuple(self.function_args), base_ms=kernel_base))
+                tuple(self.function_args), base_ms=kernel_base,
+                vbase=None if vb is None else jnp.asarray(vb),
+                precorrected=data.precorrected))
         if fn == "timestamp":
             out = out.astype(np.float64) + base / 1000.0
         return ResultBlock(data.keys, wends, out, data.bucket_les)
@@ -736,19 +748,9 @@ class MultiSchemaPartitionsExec(LeafExecPlan):
         if not parts:
             return None, stats
         shard.ensure_paged(parts, self.chunk_start_ms, self.chunk_end_ms)
-        # device-resident fast path: gather rows from the HBM mirror instead
-        # of re-shipping the matrix every query (ref: block-memory working
-        # set, BlockManager.scala; see core/devicecache.py)
         store = shard.stores[schema_name]
         rows = np.asarray([p.row for p in parts], dtype=np.int64)
         counts = store.counts[rows]
-        mirrored = None
-        if getattr(shard.config.store, "device_mirror_enabled", True):
-            mirror = getattr(store, "device_mirror", None)
-            if mirror is None:
-                from filodb_tpu.core.devicecache import DeviceMirror
-                mirror = store.device_mirror = DeviceMirror()
-            mirrored = mirror.gather(store, rows)
         schema = shard.schemas[schema_name]
         col_name = (self.columns[0] if self.columns
                     else schema.value_column)
@@ -769,16 +771,48 @@ class MultiSchemaPartitionsExec(LeafExecPlan):
                             self._transformer_overrides[i] = \
                                 dataclasses.replace(t, function=sub[1])
                     break
+        # counter semantics: counter-typed columns are reset-corrected in
+        # f64 host-side (ops/counter.host_counter_correct) when the range
+        # function has counter semantics, so post-rebase f32 deltas are
+        # exact even across resets.  Non-counter functions on counter
+        # columns (resets/delta/changes) need the RAW values and therefore
+        # bypass the (pre-corrected) device mirror.
+        col_def = next((c for c in schema.data_columns
+                        if c.name == col_name), None)
+        counter_col = col_def is not None and (col_def.detect_drops
+                                               or col_def.counter)
+        fn_is_counter = False
+        for t in self.transformers:
+            if isinstance(t, PeriodicSamplesMapper):
+                spec = RANGE_FUNCTIONS.get(t.function or "")
+                fn_is_counter = spec.is_counter if spec else False
+                break
+        # device-resident fast path: gather rows from the HBM mirror instead
+        # of re-shipping the matrix every query (ref: block-memory working
+        # set, BlockManager.scala; see core/devicecache.py)
+        mirrored = None
+        if getattr(shard.config.store, "device_mirror_enabled", True) and (
+                not counter_col or fn_is_counter):
+            mirror = getattr(store, "device_mirror", None)
+            if mirror is None:
+                from filodb_tpu.core.devicecache import DeviceMirror
+                mirror = store.device_mirror = DeviceMirror()
+            mirrored = mirror.gather(store, rows)
         # value column selection: histograms gather [S, T, B]
         if mirrored is not None:
-            ts_off, dev_cols = mirrored
+            ts_off, dev_cols, dev_vbases = mirrored
             vals = dev_cols[col_name]
+            vbase = dev_vbases.get(col_name)
             base = store.device_mirror.base_ms
+            precorrected = counter_col   # mirror corrects counter columns
         else:
             ts, cols, counts, _ = shard.gather_series(parts)
-            vals = cols[col_name]
             base = self.chunk_start_ms
             ts_off = to_offsets(ts, counts, base)
+            # correct (f64) + rebase so counter deltas stay exact on chip
+            precorrected = counter_col and fn_is_counter
+            vals, vbase = counter_ops.rebase_values(cols[col_name],
+                                                    precorrected)
         keys = [RangeVectorKey.make(
             {**p.part_key.tags_dict, "_metric_": p.part_key.metric})
             for p in parts]
@@ -786,7 +820,8 @@ class MultiSchemaPartitionsExec(LeafExecPlan):
         stats.samples_scanned = int(counts.sum())
         les = store.bucket_les if vals.ndim == 3 else None
         return RawBlock(keys, ts_off, vals, base, les,
-                        samples=stats.samples_scanned), stats
+                        samples=stats.samples_scanned, vbase=vbase,
+                        precorrected=precorrected), stats
 
 
 class EmptyResultExec(LeafExecPlan):
@@ -850,10 +885,20 @@ class DistConcatExec(NonLeafExecPlan):
                 return out
             from filodb_tpu.ops.timewindow import PAD_TS
             ts = np.concatenate([pad(r.ts_off, PAD_TS) for r in raws])
-            vals = np.concatenate([pad(r.values, np.nan) for r in raws])
+            vals = np.concatenate([pad(np.asarray(r.values), np.nan)
+                                   for r in raws])
+            vbase = None
+            if any(r.vbase is not None for r in raws):
+                vbase = np.concatenate([
+                    np.asarray(r.vbase) if r.vbase is not None
+                    else np.zeros(np.asarray(r.values).shape[:1]
+                                  + np.asarray(r.values).shape[2:])
+                    for r in raws])
             return RawBlock(keys, ts, vals, raws[0].base_ms,
                             raws[0].bucket_les,
-                            samples=sum(r.samples for r in raws))
+                            samples=sum(r.samples for r in raws),
+                            vbase=vbase,
+                            precorrected=all(r.precorrected for r in raws))
         return concat_blocks(blocks)
 
 
