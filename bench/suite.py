@@ -215,8 +215,41 @@ def bench_histogram(quick: bool):
     _emit("histogram", "quantile_qps", 1 / per, "queries/s", series=S)
 
 
+def bench_memory(quick: bool):
+    """Resident memory per series after sealing history to the compressed
+    tier (ref: doc/ingestion.md:110 '1.5 million time series fit within
+    1GB heap' — the reference's only quantitative memory claim)."""
+    from filodb_tpu.core.memstore import TimeSeriesMemStore
+    from filodb_tpu.ingest.generator import counter_batch
+
+    S = 2_000 if quick else 20_000
+    T = 360                                   # 1h of 10s samples
+    ms = TimeSeriesMemStore()
+    shard = ms.setup("prometheus", 0)
+    for lo in range(0, S, 2_000):             # batch to bound peak RAM
+        n = min(2_000, S - lo)
+        b = counter_batch(n, T, start_ms=START,
+                          metric=f"m{lo}")
+        # real counters are integral — exercises the delta-delta-as-long
+        # double encoding (ref: DoubleVector.scala 'when integral')
+        b.columns["count"] = np.floor(b.columns["count"])
+        shard.ingest(b)
+    dense_before = shard.memory_usage()["dense_bytes"]
+    shard.enforce_memory(budget_bytes=1, active_tail_rows=32)
+    u = shard.memory_usage()
+    per_series = u["total_bytes"] / S
+    _emit("memory", "bytes_per_series_1h", per_series, "bytes",
+          series=S, samples_per_series=T,
+          dense_bytes=u["dense_bytes"], resident_bytes=u["resident_bytes"],
+          dense_before_bytes=dense_before,
+          series_per_gb=round((1 << 30) / per_series),
+          compressed_bytes_per_sample=round(
+              u["resident_bytes"] / (S * T), 3))
+
+
 BENCHES: Dict[str, Callable[[bool], None]] = {
     "ingestion": bench_ingestion,
+    "memory": bench_memory,
     "encoding": bench_encoding,
     "index": bench_index,
     "gateway": bench_gateway,

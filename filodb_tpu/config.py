@@ -44,6 +44,12 @@ class StoreConfig:
     # so repeat queries skip the host->device transfer (devicecache.py)
     device_mirror_enabled: bool = True
     device_mirror_hbm_limit: int = 8 << 30
+    # compressed resident tier: sealed chunks kept NibblePack'd in host RAM
+    # under this budget so the dense tier holds only the active tail
+    # (memory/resident.py; ref: doc/ingestion.md:110 in-memory compression)
+    resident_cache_bytes: int = 256 << 20
+    # samples per series retained dense after memory enforcement
+    active_tail_rows: int = 512
 
 
 @dataclasses.dataclass

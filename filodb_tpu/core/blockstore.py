@@ -285,8 +285,9 @@ class DenseSeriesStore:
 
     # ---- eviction ----
 
-    def evict_oldest(self, nsamples: int) -> None:
-        """Evict up to `nsamples` of the oldest samples per series —
+    def evict_oldest(self, nsamples) -> None:
+        """Evict up to `nsamples` (scalar, or per-series [S] array) of the
+        oldest samples per series —
         time-ordered reclaim, but NEVER beyond a series' sealed (persisted)
         watermark: unflushed data must not be destroyed by another series
         overflowing (the BlockManager reclaim-only-flushed-blocks guarantee,
@@ -320,7 +321,34 @@ class DenseSeriesStore:
         self.paged_ceil[k > 0] = -1
         self.generation += 1
 
+    def compact_time(self, slack: int = 64) -> int:
+        """Shrink the time capacity down to the live extent (+slack) so
+        evicted history actually releases host RAM — evict_oldest only
+        shifts within the allocation.  Returns bytes released."""
+        t_used = self.time_used
+        target = max(t_used + slack, 1)
+        if target >= self._t_cap:
+            return 0
+        before = self.nbytes
+        self.ts = np.ascontiguousarray(self.ts[:, :target])
+        for name, arr in self.cols.items():
+            if arr is not None:
+                self.cols[name] = np.ascontiguousarray(arr[:, :target])
+        self._t_cap = target
+        self.generation += 1
+        return before - self.nbytes
+
     # ---- query gather ----
+
+    @property
+    def nbytes(self) -> int:
+        n = self.ts.nbytes + self.counts.nbytes + self.sealed.nbytes
+        n += self.paged_floor.nbytes + self.paged_ceil.nbytes
+        n += self.page_only.nbytes
+        for arr in self.cols.values():
+            if arr is not None:
+                n += arr.nbytes
+        return n
 
     @property
     def time_used(self) -> int:

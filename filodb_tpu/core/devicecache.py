@@ -48,8 +48,15 @@ class DeviceMirror:
 
     def _refresh(self, store) -> bool:
         import jax
-        if self._nbytes(store) > self.hbm_limit_bytes:
+
+        from filodb_tpu.utils.metrics import registry as metrics_registry
+        nbytes = self._nbytes(store)
+        if nbytes > self.hbm_limit_bytes:
+            # silently-degraded path flagged in round 1: make it observable
+            metrics_registry.counter("device_mirror_over_cap").increment()
             return False
+        metrics_registry.counter("device_mirror_refreshes").increment()
+        metrics_registry.gauge("device_mirror_bytes").update(nbytes)
         s, t = store.num_series, max(store.time_used, 1)
         ts = store.ts[:s, :t]
         live = ts[ts > 0]

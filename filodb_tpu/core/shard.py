@@ -83,6 +83,12 @@ class TimeSeriesShard:
         self.part_set: Dict[bytes, int] = {}       # partKey bytes -> partId
         self.partitions: List[Optional[PartitionInfo]] = []
         self.stores: Dict[str, DenseSeriesStore] = {}
+        # compressed resident tier: sealed chunks kept encoded in host RAM
+        # so the dense tier holds only the active tail (memory/resident.py)
+        from filodb_tpu.memory.resident import ResidentChunkCache
+        self.resident = ResidentChunkCache(
+            self.config.store.resident_cache_bytes, dataset, shard_num,
+            persistent=not isinstance(self.column_store, NullColumnStore))
         self.stats = ShardStats()
         self.ingested_offset = -1                   # latest ingest offset seen
         self._groups = self.config.store.groups_per_shard
@@ -220,6 +226,9 @@ class TimeSeriesShard:
             self.column_store.write_chunks(
                 self.dataset, self.shard_num, info.part_key, [cs],
                 info.schema_name)
+            # the same encoded chunk stays resident in RAM: the dense tier
+            # may now drop these samples and re-page without touching disk
+            self.resident.add(info.part_id, cs)
             if self.shard_downsampler is not None:
                 self.shard_downsampler.downsample(
                     info.part_key, schema, ts, cols,
@@ -300,6 +309,23 @@ class TimeSeriesShard:
                 {k: np.concatenate([cp[k] for cp in col_parts])
                  for k in col_parts[0]})
 
+    def _read_sealed_chunks(self, info: PartitionInfo, start_time_ms: int,
+                            end_time_ms: int) -> list:
+        """Sealed chunks overlapping the range: the compressed RAM tier
+        first, disk only for history older than what RAM retains (ref:
+        OnDemandPagingShard paging order — block memory, then Cassandra).
+        Duplicates are harmless: _decode_paged_chunks drops overlap."""
+        chunks = self.resident.read(info.part_id, start_time_ms, end_time_ms)
+        floor = self.resident.coverage_floor(info.part_id)
+        ram_covers = (floor is not None and floor <= start_time_ms
+                      and bool(chunks))
+        if not ram_covers and not isinstance(self.column_store,
+                                             NullColumnStore):
+            chunks = list(self.column_store.read_chunks(
+                self.dataset, self.shard_num, info.part_key,
+                start_time_ms, end_time_ms)) + chunks
+        return chunks
+
     def ensure_paged(self, parts: Sequence[PartitionInfo],
                      start_time_ms: int, end_time_ms: int) -> int:
         """On-demand paging: load persisted chunks not in the in-memory
@@ -311,7 +337,8 @@ class TimeSeriesShard:
         below the in-memory data (prepend — recovered partitions whose flushed
         history is on disk) and, for page-only rows (no live appends, e.g. a
         query-only downsample store), above it too.  Returns samples paged."""
-        if isinstance(self.column_store, NullColumnStore):
+        if (isinstance(self.column_store, NullColumnStore)
+                and self.resident.num_chunks == 0):
             return 0
         paged = 0
         for info in parts:
@@ -329,9 +356,7 @@ class TimeSeriesShard:
                 # paged_floor/paged_ceil as an interval)
                 hi = end_time_ms if cnt == 0 else first_mem - 1
                 if hi >= start_time_ms:
-                    chunks = self.column_store.read_chunks(
-                        self.dataset, self.shard_num, info.part_key,
-                        start_time_ms, hi)
+                    chunks = self._read_sealed_chunks(info, start_time_ms, hi)
                     ts_all, cols_all = self._decode_paged_chunks(
                         store, chunks, start_time_ms - 1, hi)
                     if ts_all is not None:
@@ -353,9 +378,8 @@ class TimeSeriesShard:
                 last_mem = int(store.ts[row, int(store.counts[row]) - 1])
                 ceil = max(int(store.paged_ceil[row]), last_mem)
                 if end_time_ms > ceil:
-                    chunks = self.column_store.read_chunks(
-                        self.dataset, self.shard_num, info.part_key,
-                        ceil + 1, end_time_ms)
+                    chunks = self._read_sealed_chunks(info, ceil + 1,
+                                                      end_time_ms)
                     ts_all, cols_all = self._decode_paged_chunks(
                         store, chunks, last_mem, end_time_ms)
                     if ts_all is not None:
@@ -430,6 +454,51 @@ class TimeSeriesShard:
                 n += self.ingest(sub, offset)
         return n
 
+    # ---------------------------------------------------------------- memory
+
+    def memory_usage(self) -> Dict[str, int]:
+        """Byte accounting across tiers (ref: MemoryStats,
+        BlockManager.scala:91)."""
+        dense = sum(s.nbytes for s in self.stores.values())
+        return {"dense_bytes": dense,
+                "resident_bytes": self.resident.bytes_used,
+                "total_bytes": dense + self.resident.bytes_used}
+
+    def enforce_memory(self, budget_bytes: Optional[int] = None,
+                       active_tail_rows: Optional[int] = None) -> int:
+        """Headroom enforcement (ref: TimeSeriesShard.startHeadroomTask:1665
+        + CompositeEvictionPolicy, PartitionEvictionPolicy.scala:59): when
+        the dense tier exceeds its budget, seal everything via flush, then
+        truncate each series to the active tail and release the freed time
+        capacity.  Sealed history stays queryable from the compressed
+        resident tier (RAM) or the column store (disk) via ensure_paged.
+        Returns bytes released."""
+        budget = (budget_bytes if budget_bytes is not None
+                  else self.config.store.shard_mem_size)
+        tail = (active_tail_rows if active_tail_rows is not None
+                else self.config.store.active_tail_rows)
+        dense = sum(s.nbytes for s in self.stores.values())
+        metrics_registry.gauge("dense_store_bytes", dataset=self.dataset,
+                               shard=str(self.shard_num)).update(dense)
+        if dense <= budget:
+            return 0
+        self.flush_all_groups()
+        released = 0
+        for store in self.stores.values():
+            if store.num_series == 0:
+                continue
+            excess = np.maximum(store.counts - tail, 0)
+            if excess.any():
+                store.evict_oldest(excess)
+            released += store.compact_time(slack=max(8, tail // 4))
+        metrics_registry.gauge("dense_store_bytes", dataset=self.dataset,
+                               shard=str(self.shard_num)).update(
+            sum(s.nbytes for s in self.stores.values()))
+        metrics_registry.counter("memory_pressure_evictions",
+                                 dataset=self.dataset).increment()
+        self.stats.evictions += 1
+        return released
+
     # ---------------------------------------------------------------- eviction
 
     def evict_ended_partitions(self, before_ms: int) -> int:
@@ -443,6 +512,7 @@ class TimeSeriesShard:
                 self.index.remove_partition(info.part_id)
                 self.part_set.pop(info.part_key.to_bytes(), None)
                 self.partitions[info.part_id] = None
+                self.resident.drop_part(info.part_id)
                 if self.cardinality_tracker is not None:
                     sk = info.part_key.shard_key(self.schemas.part)
                     self.cardinality_tracker.series_stopped(

@@ -35,7 +35,7 @@ class ChunkSetInfo:
 @dataclasses.dataclass
 class ColumnChunk:
     """One encoded column of a chunk."""
-    kind: str                 # 'ts-dd' | 'f64-xor' | 'i64-dd' | 'hist-2d'
+    kind: str        # 'ts-dd' | 'f64-xor' | 'f64-i64dd' | 'i64-dd' | 'hist-2d'
     payload: bytes
     base: int = 0             # ts-dd/i64-dd: line base
     slope: int = 0            # ts-dd/i64-dd: line slope
@@ -63,7 +63,18 @@ def encode_ts_column(ts: np.ndarray) -> ColumnChunk:
 
 
 def encode_double_column(vals: np.ndarray) -> ColumnChunk:
-    return ColumnChunk("f64-xor", nibblepack.pack_f64_xor(vals))
+    """Doubles: delta-delta-as-long when all values are integral (the
+    DeltaDeltaVector trick, ref: memory/.../format/vectors/DoubleVector.scala
+    delta-delta-as-long 'when integral' — real counters are integers and
+    pack to ~1-2 B/sample), XOR-mantissa packing otherwise."""
+    v = np.asarray(vals, dtype=np.float64)
+    if (len(v) and np.isfinite(v).all() and (v == np.floor(v)).all()
+            and (np.abs(v) < 2.0**53).all()):
+        base, slope, deltas = nibblepack.delta_delta_encode(
+            v.astype(np.int64))
+        return ColumnChunk("f64-i64dd", nibblepack.pack_i64(deltas),
+                           base=base, slope=slope)
+    return ColumnChunk("f64-xor", nibblepack.pack_f64_xor(v))
 
 
 def encode_long_column(vals: np.ndarray) -> ColumnChunk:
@@ -80,6 +91,10 @@ def decode_column(col: ColumnChunk, num_rows: int) -> np.ndarray:
         return nibblepack.unpack_timestamps(col.base, col.slope, col.payload, num_rows)
     if col.kind == "f64-xor":
         return nibblepack.unpack_f64_xor(col.payload, num_rows)
+    if col.kind == "f64-i64dd":
+        return nibblepack.delta_delta_decode(
+            col.base, col.slope,
+            nibblepack.unpack_i64(col.payload, num_rows)).astype(np.float64)
     if col.kind == "i64-dd":
         return nibblepack.delta_delta_decode(
             col.base, col.slope, nibblepack.unpack_i64(col.payload, num_rows))

@@ -76,19 +76,26 @@ class PackedShards:
     group_labels: List[Dict[str, str]]
     base_ms: int
     n_series: np.ndarray
+    # per-series value base subtracted host-side in f64 (ops/counter.
+    # rebase_values) so counter deltas survive the f32 device downcast —
+    # same contract as the single-shard leaf path (RawBlock.vbase)
+    vbase: Optional[np.ndarray] = None      # [D, S]
+    precorrected: bool = False
 
     @property
     def n_shards(self) -> int:
         return self.ts_off.shape[0]
 
 
-def pack_shards(blocks: Sequence[Tuple[np.ndarray, np.ndarray, Sequence[Dict[str, str]]]],
+def pack_shards(blocks: Sequence[Tuple],
                 by: Sequence[str] = (), without: Sequence[str] = (),
                 base_ms: int = 0,
                 pad_series_to: Optional[int] = None,
-                pad_time_to: Optional[int] = None) -> PackedShards:
-    """Pack per-shard (ts_off [S,T], vals [S,T], series label dicts) into the
-    uniform [D, S, T] layout, assigning globally-consistent group slots.
+                pad_time_to: Optional[int] = None,
+                precorrected: bool = False) -> PackedShards:
+    """Pack per-shard (ts_off [S,T], vals [S,T], series label dicts[,
+    vbase [S]]) into the uniform [D, S, T] layout, assigning
+    globally-consistent group slots.
 
     Group identity follows the reference's by/without label semantics
     (ref: exec/AggrOverRangeVectors.scala AggregateMapReduce grouping):
@@ -106,8 +113,14 @@ def pack_shards(blocks: Sequence[Tuple[np.ndarray, np.ndarray, Sequence[Dict[str
     vals = np.full((D, S, T), np.nan, dtype=np.float64)
     gids = np.zeros((D, S), dtype=np.int32)
     nser = np.zeros(D, dtype=np.int32)
+    vbase = np.zeros((D, S), dtype=np.float64)
+    any_vbase = False
 
-    for d, (t, v, labels) in enumerate(blocks):
+    for d, blk in enumerate(blocks):
+        t, v, labels = blk[0], blk[1], blk[2]
+        if len(blk) > 3 and blk[3] is not None:
+            vbase[d, :len(blk[3])] = blk[3]
+            any_vbase = True
         s, tt = t.shape
         ts[d, :s, :tt] = t
         vals[d, :s, :tt] = v
@@ -129,7 +142,9 @@ def pack_shards(blocks: Sequence[Tuple[np.ndarray, np.ndarray, Sequence[Dict[str
             gids[d, i] = slot
 
     return PackedShards(ts, vals, gids, max(len(group_labels), 1),
-                        group_labels, base_ms, nser)
+                        group_labels, base_ms, nser,
+                        vbase=vbase if any_vbase else None,
+                        precorrected=precorrected)
 
 
 def device_put_packed(packed: PackedShards, mesh: Mesh) -> PackedShards:
@@ -142,33 +157,43 @@ def device_put_packed(packed: PackedShards, mesh: Mesh) -> PackedShards:
         packed,
         ts_off=jax.device_put(packed.ts_off, data_spec),
         values=jax.device_put(packed.values, data_spec),
-        group_ids=jax.device_put(packed.group_ids, gid_spec))
+        group_ids=jax.device_put(packed.group_ids, gid_spec),
+        vbase=(None if packed.vbase is None
+               else jax.device_put(packed.vbase, gid_spec)))
 
 
 # ------------------------------------------------------------ SPMD kernels
 
 def distributed_window_agg(mesh: Mesh, ts_off, values, group_ids, wends, *,
                            range_ms, fn_name, params=(), agg_op="sum",
-                           num_groups=1, base_ms=0):
+                           num_groups=1, base_ms=0, vbase=None,
+                           precorrected=False):
     """Eager wrapper: floats base_ms before the jit boundary (epoch-ms ints
     overflow int32 canonicalization on no-x64 TPU; see rangefns)."""
+    if vbase is None:
+        vbase = jnp.zeros(values.shape[:2], values.dtype)
     return _distributed_window_agg(mesh, ts_off, values, group_ids, wends,
+                                   vbase,
                                    range_ms=range_ms, fn_name=fn_name,
                                    params=params, agg_op=agg_op,
                                    num_groups=num_groups,
-                                   base_ms=float(base_ms))
+                                   base_ms=float(base_ms),
+                                   precorrected=precorrected)
 
 
 @functools.partial(
     jax.jit,
-    static_argnames=("mesh", "fn_name", "params", "agg_op", "num_groups"))
+    static_argnames=("mesh", "fn_name", "params", "agg_op", "num_groups",
+                     "precorrected"))
 def _distributed_window_agg(mesh: Mesh,
                            ts_off: jax.Array, values: jax.Array,
                            group_ids: jax.Array, wends: jax.Array,
+                           vbase: jax.Array,
                            *, range_ms: int, fn_name: Optional[str],
                            params: Tuple[float, ...] = (),
                            agg_op: str = "sum", num_groups: int = 1,
-                           base_ms: int = 0) -> jax.Array:
+                           base_ms: int = 0,
+                           precorrected: bool = False) -> jax.Array:
     """Full distributed query step: windowed range function + cross-shard
     aggregate, SPMD over the ('shard', 'time') mesh.
 
@@ -178,10 +203,12 @@ def _distributed_window_agg(mesh: Mesh,
     """
     combiner = agg_ops.AGGREGATORS[agg_op].combiner
 
-    def step(ts_blk, val_blk, gid_blk, wends_blk):
+    def step(ts_blk, val_blk, gid_blk, wends_blk, vbase_blk):
         # ts_blk [1, S, T] — this device column's shard; wends_blk [W/nt]
         res = evaluate_range_function(ts_blk[0], val_blk[0], wends_blk,
-                                      range_ms, fn_name, params, base_ms)
+                                      range_ms, fn_name, params, base_ms,
+                                      vbase=vbase_blk[0],
+                                      precorrected=precorrected)
         part = agg_ops.map_phase(agg_op, res, gid_blk[0], num_groups)
         if combiner == "sum":
             part = jax.lax.psum(part, "shard")
@@ -194,38 +221,48 @@ def _distributed_window_agg(mesh: Mesh,
     return jax.shard_map(
         step, mesh=mesh,
         in_specs=(P("shard", None, None), P("shard", None, None),
-                  P("shard", None), P("time")),
-        out_specs=P(None, "time", None))(ts_off, values, group_ids, wends)
+                  P("shard", None), P("time"), P("shard", None)),
+        out_specs=P(None, "time", None))(ts_off, values, group_ids, wends,
+                                         vbase)
 
 
 def distributed_window_raw(mesh: Mesh, ts_off, values, wends, *, range_ms,
-                           fn_name, params=(), base_ms=0):
+                           fn_name, params=(), base_ms=0, vbase=None,
+                           precorrected=False):
     """Eager wrapper: floats base_ms (see distributed_window_agg)."""
-    return _distributed_window_raw(mesh, ts_off, values, wends,
+    if vbase is None:
+        vbase = jnp.zeros(values.shape[:2], values.dtype)
+    return _distributed_window_raw(mesh, ts_off, values, wends, vbase,
                                    range_ms=range_ms, fn_name=fn_name,
-                                   params=params, base_ms=float(base_ms))
+                                   params=params, base_ms=float(base_ms),
+                                   precorrected=precorrected)
 
 
 @functools.partial(
-    jax.jit, static_argnames=("mesh", "fn_name", "params"))
+    jax.jit, static_argnames=("mesh", "fn_name", "params", "precorrected"))
 def _distributed_window_raw(mesh: Mesh,
                            ts_off: jax.Array, values: jax.Array,
-                           wends: jax.Array, *, range_ms: int,
+                           wends: jax.Array, vbase: jax.Array,
+                           *, range_ms: int,
                            fn_name: Optional[str],
                            params: Tuple[float, ...] = (),
-                           base_ms: int = 0) -> jax.Array:
+                           base_ms: int = 0,
+                           precorrected: bool = False) -> jax.Array:
     """Un-aggregated distributed evaluation -> [D, S, W] (the DistConcatExec
     analogue: per-shard results stay sharded; host gathers lazily)."""
 
-    def step(ts_blk, val_blk, wends_blk):
+    def step(ts_blk, val_blk, wends_blk, vbase_blk):
         res = evaluate_range_function(ts_blk[0], val_blk[0], wends_blk,
-                                      range_ms, fn_name, params, base_ms)
+                                      range_ms, fn_name, params, base_ms,
+                                      vbase=vbase_blk[0],
+                                      precorrected=precorrected)
         return res[None]
 
     return jax.shard_map(
         step, mesh=mesh,
-        in_specs=(P("shard", None, None), P("shard", None, None), P("time")),
-        out_specs=P("shard", None, "time"))(ts_off, values, wends)
+        in_specs=(P("shard", None, None), P("shard", None, None), P("time"),
+                  P("shard", None)),
+        out_specs=P("shard", None, "time"))(ts_off, values, wends, vbase)
 
 
 # ----------------------------------------------------------- executor glue
@@ -247,9 +284,19 @@ class MeshExecutor:
 
     def lookup_and_pack(self, filters, start_ms: int, end_ms: int,
                         by: Sequence[str] = (),
-                        without: Sequence[str] = ()) -> Optional[PackedShards]:
-        blocks = []
+                        without: Sequence[str] = (),
+                        fn_name: Optional[str] = None
+                        ) -> Optional[PackedShards]:
+        """fn_name (the range function the pack will feed) selects counter
+        semantics: counter columns are reset-corrected host-side in f64 so
+        f32 deltas on device are exact — same contract as the leaf exec."""
+        from filodb_tpu.ops.counter import rebase_values
+        from filodb_tpu.ops.rangefns import RANGE_FUNCTIONS
         from filodb_tpu.ops.timewindow import to_offsets
+        spec = RANGE_FUNCTIONS.get(fn_name or "")
+        fn_is_counter = spec.is_counter if spec else False
+        blocks = []
+        precorrected = True
         for shard in self.memstore.shards_for(self.dataset):
             lookup = shard.lookup_partitions(filters, start_ms, end_ms)
             schema_name = lookup.first_schema
@@ -262,11 +309,17 @@ class MeshExecutor:
             shard.ensure_paged(parts, start_ms, end_ms)
             ts, cols, counts, store = shard.gather_series(parts)
             schema = shard.schemas[schema_name]
-            vals = cols[schema.value_column]
+            col_def = next((c for c in schema.data_columns
+                            if c.name == schema.value_column), None)
+            counter_col = col_def is not None and (col_def.detect_drops
+                                                   or col_def.counter)
+            correct = counter_col and fn_is_counter
+            precorrected = precorrected and correct
+            vals, vbase = rebase_values(cols[schema.value_column], correct)
             ts_off = to_offsets(ts, counts, start_ms)
             labels = [{**p.part_key.tags_dict, "_metric_": p.part_key.metric}
                       for p in parts]
-            blocks.append((ts_off, vals.astype(np.float64), labels))
+            blocks.append((ts_off, vals, labels, vbase))
         if not blocks:
             return None
         if len(blocks) > self.n_shard:
@@ -277,7 +330,8 @@ class MeshExecutor:
         while len(blocks) < self.n_shard:
             blocks.append((np.full((1, 1), PAD_TS, np.int32),
                            np.full((1, 1), np.nan), []))
-        packed = pack_shards(blocks, by=by, without=without, base_ms=start_ms)
+        packed = pack_shards(blocks, by=by, without=without, base_ms=start_ms,
+                             precorrected=precorrected)
         return device_put_packed(packed, self.mesh)
 
     def run_agg(self, packed: PackedShards, wends: np.ndarray, *,
@@ -307,6 +361,7 @@ class MeshExecutor:
             self.mesh, packed.ts_off, packed.values, packed.group_ids,
             wends_dev, range_ms=range_ms, fn_name=fn_name, params=params,
             agg_op=agg_op, num_groups=packed.num_groups,
-            base_ms=packed.base_ms)
+            base_ms=packed.base_ms, vbase=packed.vbase,
+            precorrected=packed.precorrected)
         out = agg_ops.present(agg_op, partials)
         return np.asarray(out)[:, :W], packed.group_labels
