@@ -177,6 +177,47 @@ def run_worker(args):
         "baseline_kind": "vectorized numpy, same algorithm, host CPU",
         "iterator_baseline_samples_per_sec": round(it_samples_per_sec, 1),
     }
+
+    # North-star config (BASELINE.md: 1M-series sum by(rate()) + p50):
+    # 1M series x 1h of 10s samples, chip-resident, same query shape.
+    # Skipped on CPU fallback and --quick (would blow the supervisor
+    # timeout); reported as extra fields on the same JSON line.
+    if not quick and platform != "cpu" and not args.series:
+        try:
+            ns_S, ns_T, ns_G = 1_000_000, 360, 1000
+            ts_row1, vals1 = make_counter_data(ns_S, ns_T)
+            ts_off1 = to_offsets(np.tile(ts_row1, (ns_S, 1)),
+                                 np.full(ns_S, ns_T), 0)
+            gids1 = (np.arange(ns_S) % ns_G).astype(np.int32)
+            wends1 = make_window_ends(600_000, 3_590_000, step_ms).astype(np.int32)
+            lo1 = np.searchsorted(ts_row1, 600_000 - range_ms)
+            hi1 = np.searchsorted(ts_row1, 3_590_000, side="right")
+            scanned1 = ns_S * int(hi1 - lo1)
+            d_ts = jax.device_put(ts_off1)
+            d_vals = jax.device_put(vals1)
+            d_gids = jax.device_put(gids1)
+            d_wends = jax.device_put(wends1)
+
+            @jax.jit
+            def query1m(ts_off, vals, gids, wends):
+                res = evaluate_range_function(ts_off, vals, wends, range_ms,
+                                              "rate", shared_grid=True)
+                return agg_ops.aggregate("sum", res, gids, ns_G)
+
+            np.asarray(query1m(d_ts, d_vals, d_gids, d_wends))  # compile
+            lat1 = []
+            for _ in range(max(3, iters // 2)):
+                t0 = time.perf_counter()
+                np.asarray(query1m(d_ts, d_vals, d_gids, d_wends))
+                lat1.append(time.perf_counter() - t0)
+            p50_1m = float(np.median(np.asarray(lat1)))
+            result.update({
+                "north_star_series": ns_S,
+                "north_star_p50_s": round(p50_1m, 5),
+                "north_star_samples_per_sec": round(scanned1 / p50_1m, 1),
+            })
+        except Exception as e:  # noqa: BLE001 — keep the headline number
+            result["north_star_error"] = f"{type(e).__name__}: {e}"
     print(json.dumps(result))
 
 

@@ -18,6 +18,7 @@ from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 import numpy as np
 
 from filodb_tpu.core.partkey import PartKey
+from filodb_tpu.utils.growable import grow_to
 
 MAX_TIME = (1 << 62)
 
@@ -78,6 +79,7 @@ class PartKeyIndex:
         self._frozen: Dict[Tuple[str, str], np.ndarray] = {}
         self._start: np.ndarray = np.zeros(0, dtype=np.int64)
         self._end: np.ndarray = np.zeros(0, dtype=np.int64)
+        self._alive: np.ndarray = np.zeros(0, dtype=bool)
         self._part_keys: List[Optional[PartKey]] = []
         self.num_docs = 0
 
@@ -87,15 +89,16 @@ class PartKeyIndex:
                       start_time_ms: int, end_time_ms: int = MAX_TIME) -> None:
         """ref: PartKeyLuceneIndex.addPartKey; endTime=MAX means still ingesting."""
         if part_id >= len(self._part_keys):
-            grow = max(1024, part_id + 1 - len(self._part_keys))
-            self._part_keys.extend([None] * grow)
-            self._start = np.concatenate(
-                [self._start, np.zeros(grow, dtype=np.int64)])
-            self._end = np.concatenate(
-                [self._end, np.full(grow, MAX_TIME, dtype=np.int64)])
+            n = part_id + 1
+            self._start = grow_to(self._start, n)
+            self._end = grow_to(self._end, n, fill=MAX_TIME)
+            self._alive = grow_to(self._alive, n, fill=False)
+            self._part_keys.extend(
+                [None] * (self._start.shape[0] - len(self._part_keys)))
         self._part_keys[part_id] = part_key
         self._start[part_id] = start_time_ms
         self._end[part_id] = end_time_ms
+        self._alive[part_id] = True
         self._index_label("__name__", part_key.metric, part_id)
         for k, v in part_key.tags:
             self._index_label(k, v, part_id)
@@ -129,9 +132,7 @@ class PartKeyIndex:
         return arr
 
     def _all_ids(self) -> np.ndarray:
-        ids = [i for i, pk in enumerate(self._part_keys[: self._live_len()])
-               if pk is not None]
-        return np.asarray(ids, dtype=np.int64)
+        return np.nonzero(self._alive)[0].astype(np.int64)
 
     def _live_len(self) -> int:
         return len(self._part_keys)
@@ -234,4 +235,5 @@ class PartKeyIndex:
                 lst.remove(part_id)
                 self._frozen.pop((k, v), None)
         self._part_keys[part_id] = None
+        self._alive[part_id] = False
         self.num_docs -= 1

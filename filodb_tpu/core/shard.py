@@ -57,13 +57,27 @@ class ShardStats:
     quota_dropped: int = 0          # series rejected by cardinality quota
 
 
+from filodb_tpu.utils.growable import grow_to as _grow_to
+
+
 @dataclasses.dataclass
 class PartLookupResult:
-    """ref: TimeSeriesShard.scala:212 PartLookupResult."""
+    """ref: TimeSeriesShard.scala:212 PartLookupResult.
+
+    Hot paths consume the vectorized pid arrays (pids_by_schema) plus the
+    shard's pid->row / pid->key tables; parts_by_schema materializes
+    PartitionInfo lists lazily for metadata/maintenance consumers."""
     shard: int
     part_ids: np.ndarray
-    parts_by_schema: Dict[str, List[PartitionInfo]]
+    pids_by_schema: Dict[str, np.ndarray]
     first_schema: Optional[str]
+    shard_obj: Optional["TimeSeriesShard"] = None
+
+    @property
+    def parts_by_schema(self) -> Dict[str, List[PartitionInfo]]:
+        parts = self.shard_obj.partitions
+        return {s: [parts[p] for p in pids.tolist()]
+                for s, pids in self.pids_by_schema.items()}
 
 
 class TimeSeriesShard:
@@ -82,6 +96,15 @@ class TimeSeriesShard:
         self.index = PartKeyIndex()
         self.part_set: Dict[bytes, int] = {}       # partKey bytes -> partId
         self.partitions: List[Optional[PartitionInfo]] = []
+        # vectorized pid tables: schema code / store row / liveness per pid,
+        # so query-path lookup+gather never loops partitions in Python
+        # (the partId->TimeSeriesPartition map equivalent, SoA form)
+        self._schema_code_of: Dict[str, int] = {}
+        self._schema_names: List[str] = []
+        self._pid_schema_code = np.zeros(0, dtype=np.int16)
+        self._pid_row = np.zeros(0, dtype=np.int64)
+        self._pid_alive = np.zeros(0, dtype=bool)
+        self._rv_keys: List[Optional[object]] = []  # cached RangeVectorKeys
         self.stores: Dict[str, DenseSeriesStore] = {}
         # compressed resident tier: sealed chunks kept encoded in host RAM
         # so the dense tier holds only the active tail (memory/resident.py)
@@ -140,6 +163,19 @@ class TimeSeriesShard:
         info = PartitionInfo(pid, part_key, schema_name, store.new_row(),
                              group=self.group_for(part_key))
         self.partitions.append(info)
+        code = self._schema_code_of.get(schema_name)
+        if code is None:
+            code = len(self._schema_names)
+            self._schema_code_of[schema_name] = code
+            self._schema_names.append(schema_name)
+        n = pid + 1
+        self._pid_schema_code = _grow_to(self._pid_schema_code, n)
+        self._pid_row = _grow_to(self._pid_row, n)
+        self._pid_alive = _grow_to(self._pid_alive, n, fill=False)
+        self._pid_schema_code[pid] = code
+        self._pid_row[pid] = info.row
+        self._pid_alive[pid] = True
+        self._rv_keys.append(None)
         self.part_set[kb] = pid
         self.index.add_partition(pid, part_key, start_time_ms)
         self._dirty_part_keys.add(pid)
@@ -269,13 +305,39 @@ class TimeSeriesShard:
         discovery (MultiSchemaPartitionsExec.scala:27-60)."""
         ids = self.index.part_ids_from_filters(
             filters, start_time_ms, end_time_ms, limit)
-        by_schema: Dict[str, List[PartitionInfo]] = {}
-        for pid in ids.tolist():
-            info = self.partitions[pid]
-            if info is not None:
-                by_schema.setdefault(info.schema_name, []).append(info)
-        first = next(iter(by_schema)) if by_schema else None
-        return PartLookupResult(self.shard_num, ids, by_schema, first)
+        if ids.size:
+            ids = ids[self._pid_alive[ids]]
+        by_schema: Dict[str, np.ndarray] = {}
+        first = None
+        if ids.size:
+            codes = self._pid_schema_code[ids]
+            first = self._schema_names[int(codes[0])]
+            for c in np.unique(codes):
+                name = self._schema_names[int(c)]
+                by_schema[name] = ids[codes == c]
+        return PartLookupResult(self.shard_num, ids, by_schema, first, self)
+
+    def rows_for(self, pids: np.ndarray) -> np.ndarray:
+        """Store rows for a pid array — vectorized pid->row map."""
+        return self._pid_row[pids]
+
+    def keys_for(self, pids: np.ndarray) -> List:
+        """RangeVectorKeys for a pid array, built once per partition lifetime
+        and cached — repeat queries do list indexing, not dict construction
+        (ref: TimeSeriesPartition caches its partKey bytes similarly)."""
+        from filodb_tpu.query.rangevector import RangeVectorKey
+        rk = self._rv_keys
+        parts = self.partitions
+        out = []
+        for pid in pids.tolist():
+            k = rk[pid]
+            if k is None:
+                p = parts[pid]
+                k = RangeVectorKey.make(
+                    {**p.part_key.tags_dict, "_metric_": p.part_key.metric})
+                rk[pid] = k
+            out.append(k)
+        return out
 
     def _decode_paged_chunks(self, store: DenseSeriesStore, chunks,
                              lo_excl: int, hi_incl: int):
@@ -325,6 +387,35 @@ class TimeSeriesShard:
                 self.dataset, self.shard_num, info.part_key,
                 start_time_ms, end_time_ms)) + chunks
         return chunks
+
+    def ensure_paged_pids(self, schema_name: str, pids: np.ndarray,
+                          start_time_ms: int, end_time_ms: int) -> int:
+        """Vectorized ensure_paged precheck: computes which pids actually
+        need on-demand paging with numpy over the whole pid array, then runs
+        the per-partition paging loop only on that (usually empty) subset —
+        the fully-resident hot path costs O(S) numpy, no Python loop."""
+        if ((isinstance(self.column_store, NullColumnStore)
+                and self.resident.num_chunks == 0) or pids.size == 0):
+            return 0
+        store = self.stores[schema_name]
+        rows = self._pid_row[pids]
+        cnt = store.counts[rows]
+        if store.ts.shape[1] == 0:
+            first_mem = np.full(rows.shape, MAX_TIME, dtype=np.int64)
+            last_mem = np.zeros(rows.shape, dtype=np.int64)
+        else:
+            first_mem = np.where(cnt > 0, store.ts[rows, 0], MAX_TIME)
+            last_mem = np.where(
+                cnt > 0, store.ts[rows, np.maximum(cnt - 1, 0)], 0)
+        covered = np.minimum(store.paged_floor[rows], first_mem)
+        need = start_time_ms < covered
+        page_only = store.page_only[rows]
+        need |= (page_only & (cnt > 0)
+                 & (end_time_ms > np.maximum(store.paged_ceil[rows], last_mem)))
+        if not need.any():
+            return 0
+        parts = [self.partitions[p] for p in np.asarray(pids)[need].tolist()]
+        return self.ensure_paged(parts, start_time_ms, end_time_ms)
 
     def ensure_paged(self, parts: Sequence[PartitionInfo],
                      start_time_ms: int, end_time_ms: int) -> int:
@@ -512,6 +603,8 @@ class TimeSeriesShard:
                 self.index.remove_partition(info.part_id)
                 self.part_set.pop(info.part_key.to_bytes(), None)
                 self.partitions[info.part_id] = None
+                self._pid_alive[info.part_id] = False
+                self._rv_keys[info.part_id] = None
                 self.resident.drop_part(info.part_id)
                 if self.cardinality_tracker is not None:
                     sk = info.part_key.shard_key(self.schemas.part)

@@ -382,6 +382,36 @@ def quantile_over_time(ctx: WindowCtx, q: float) -> jax.Array:
     return _nan_where(ctx.n > 0, r)
 
 
+def _masked_median(vals: jax.Array, mask: jax.Array) -> jax.Array:
+    """Linear-interpolated median of masked values along the last axis.
+    vals broadcastable to mask's shape; invalid cells sort to +inf past the
+    valid prefix."""
+    big = jnp.where(mask, vals, jnp.inf)
+    srt = jnp.sort(big, axis=-1)
+    cnt = jnp.sum(mask, axis=-1).astype(srt.dtype)
+    rank = 0.5 * (cnt - 1.0)
+    lo = jnp.floor(rank).astype(jnp.int32)
+    hi = jnp.ceil(rank).astype(jnp.int32)
+    frac = rank - lo.astype(srt.dtype)
+    vlo = jnp.take_along_axis(srt, jnp.maximum(lo, 0)[..., None], axis=-1)[..., 0]
+    vhi = jnp.take_along_axis(srt, jnp.maximum(hi, 0)[..., None], axis=-1)[..., 0]
+    return vlo + (vhi - vlo) * frac
+
+
+def mad_over_time(ctx: WindowCtx) -> jax.Array:
+    """Median absolute deviation: median(|x - median(x)|) over the window
+    (ref: query/.../exec/rangefn/AggrOverTimeFunctions.scala MedianAbsoluteDeviation).
+    Shift-invariant, so it runs on rebased values — exact in f32 even for
+    large-magnitude series."""
+    def reducer(v, m):
+        vb = jnp.broadcast_to(v, m.shape)
+        med = _masked_median(vb, m)
+        dev = jnp.abs(vb - med[..., None])
+        return _masked_median(dev, m)
+    r = _window_tile_reduce(ctx, reducer)
+    return _nan_where(ctx.n > 0, r)
+
+
 def holt_winters(ctx: WindowCtx, sf: float, tf: float) -> jax.Array:
     """Double exponential smoothing (ref: AggrOverTimeFunctions.scala holt-winters).
     Sequential per window -> scan over time inside a window tile."""
@@ -454,6 +484,7 @@ RANGE_FUNCTIONS: Dict[str, RangeFnSpec] = {
                                       absolute=True),
     "holt_winters": RangeFnSpec(holt_winters, needs_params=2, absolute=True),
     "z_score": RangeFnSpec(z_score),
+    "mad_over_time": RangeFnSpec(mad_over_time),
     "timestamp": RangeFnSpec(timestamp_fn),
     "absent_over_time": RangeFnSpec(absent_over_time),
     "present_over_time": RangeFnSpec(present_over_time),

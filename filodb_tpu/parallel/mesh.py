@@ -87,12 +87,42 @@ class PackedShards:
         return self.ts_off.shape[0]
 
 
+class GroupRegistry:
+    """Global aggregation-group slot assignment shared across shards (and
+    across queries, when cached by MeshExecutor): group key -> stable slot.
+    Group identity follows by/without label semantics (ref:
+    exec/AggrOverRangeVectors.scala AggregateMapReduce grouping)."""
+
+    def __init__(self, by: Sequence[str] = (), without: Sequence[str] = ()):
+        self.by = frozenset(by) if by else None
+        self.drop = (set(without) | {"_metric_", "__name__"}) if without else None
+        self.slot_of: Dict[Tuple[Tuple[str, str], ...], int] = {}
+        self.labels: List[Dict[str, str]] = []
+
+    def slot_for(self, items: Tuple[Tuple[str, str], ...]) -> int:
+        """items: the series' sorted (label, value) tuple."""
+        if self.by is not None:
+            key = tuple((k, v) for k, v in items if k in self.by)
+        elif self.drop is not None:
+            key = tuple((k, v) for k, v in items if k not in self.drop)
+        else:
+            key = ()
+        slot = self.slot_of.get(key)
+        if slot is None:
+            slot = len(self.labels)
+            self.slot_of[key] = slot
+            self.labels.append(dict(key))
+        return slot
+
+
 def pack_shards(blocks: Sequence[Tuple],
                 by: Sequence[str] = (), without: Sequence[str] = (),
                 base_ms: int = 0,
                 pad_series_to: Optional[int] = None,
                 pad_time_to: Optional[int] = None,
-                precorrected: bool = False) -> PackedShards:
+                precorrected: bool = False,
+                group_labels: Optional[List[Dict[str, str]]] = None
+                ) -> PackedShards:
     """Pack per-shard (ts_off [S,T], vals [S,T], series label dicts[,
     vbase [S]]) into the uniform [D, S, T] layout, assigning
     globally-consistent group slots.
@@ -100,14 +130,19 @@ def pack_shards(blocks: Sequence[Tuple],
     Group identity follows the reference's by/without label semantics
     (ref: exec/AggrOverRangeVectors.scala AggregateMapReduce grouping):
     group key = labels restricted to `by` (or all minus `without`).
+
+    Each block's third element is either a per-series label sequence
+    (dicts or sorted (k, v) tuples) grouped here, or a precomputed int32
+    gid array already compacted to [0, len(group_labels)) — the cached
+    fast path that avoids per-series Python work entirely (see
+    MeshExecutor._gids_for, which also does the per-query compaction).
     """
     D = len(blocks)
     S = pad_series_to or max((b[0].shape[0] for b in blocks), default=1)
     T = pad_time_to or max((b[0].shape[1] for b in blocks), default=1)
     S, T = max(S, 1), max(T, 1)
 
-    group_slot: Dict[Tuple[Tuple[str, str], ...], int] = {}
-    group_labels: List[Dict[str, str]] = []
+    reg = GroupRegistry(by, without)
 
     ts = np.full((D, S, T), PAD_TS, dtype=np.int32)
     vals = np.full((D, S, T), np.nan, dtype=np.float64)
@@ -125,24 +160,17 @@ def pack_shards(blocks: Sequence[Tuple],
         ts[d, :s, :tt] = t
         vals[d, :s, :tt] = v
         nser[d] = s
-        for i, lab in enumerate(labels):
-            if by:
-                kept = {k: lab[k] for k in by if k in lab}
-            elif without:
-                drop = set(without) | {"_metric_", "__name__"}
-                kept = {k: x for k, x in lab.items() if k not in drop}
-            else:
-                kept = {}              # aggregate over everything -> 1 group
-            key = tuple(sorted(kept.items()))
-            slot = group_slot.get(key)
-            if slot is None:
-                slot = len(group_labels)
-                group_slot[key] = slot
-                group_labels.append(dict(kept))
-            gids[d, i] = slot
+        if isinstance(labels, np.ndarray):
+            gids[d, :labels.shape[0]] = labels
+        else:
+            for i, lab in enumerate(labels):
+                items = (lab if isinstance(lab, tuple)
+                         else tuple(sorted(lab.items())))
+                gids[d, i] = reg.slot_for(items)
 
-    return PackedShards(ts, vals, gids, max(len(group_labels), 1),
-                        group_labels, base_ms, nser,
+    labels_out = group_labels if group_labels is not None else list(reg.labels)
+    return PackedShards(ts, vals, gids, max(len(labels_out), 1),
+                        labels_out, base_ms, nser,
                         vbase=vbase if any_vbase else None,
                         precorrected=precorrected)
 
@@ -281,6 +309,37 @@ class MeshExecutor:
         self.dataset = dataset
         self.mesh = mesh
         self.n_shard = mesh.shape["shard"]
+        # (by, without) -> (GroupRegistry, per-shard pid->slot arrays).
+        # Slots are assigned once per series lifetime; repeat queries map
+        # pids to group slots with one numpy gather instead of per-series
+        # label work (ref: the reference re-groups every query — this is
+        # a deliberate TPU-side improvement for the 1M-series target).
+        self._group_caches: Dict[Tuple, Tuple[GroupRegistry, Dict[int, np.ndarray]]] = {}
+
+    def _gids_for(self, shard, pids: np.ndarray,
+                  by: Sequence[str], without: Sequence[str]
+                  ) -> Tuple[np.ndarray, GroupRegistry]:
+        ck = (tuple(by), tuple(without))
+        entry = self._group_caches.get(ck)
+        if entry is None:
+            entry = (GroupRegistry(by, without), {})
+            self._group_caches[ck] = entry
+        reg, per_shard = entry
+        arr = per_shard.get(shard.shard_num)
+        n = len(shard.partitions)
+        if arr is None:
+            arr = np.full(n, -1, dtype=np.int32)
+        elif arr.shape[0] < n:
+            arr = np.concatenate(
+                [arr, np.full(n - arr.shape[0], -1, dtype=np.int32)])
+        need = arr[pids] < 0
+        if need.any():
+            new_pids = pids[need]
+            keys = shard.keys_for(new_pids)
+            for pid, key in zip(new_pids.tolist(), keys):
+                arr[pid] = reg.slot_for(key.labels)
+        per_shard[shard.shard_num] = arr
+        return arr[pids], reg
 
     def lookup_and_pack(self, filters, start_ms: int, end_ms: int,
                         by: Sequence[str] = (),
@@ -297,17 +356,19 @@ class MeshExecutor:
         fn_is_counter = spec.is_counter if spec else False
         blocks = []
         precorrected = True
+        registry = None
         for shard in self.memstore.shards_for(self.dataset):
             lookup = shard.lookup_partitions(filters, start_ms, end_ms)
             schema_name = lookup.first_schema
-            parts = (lookup.parts_by_schema.get(schema_name, [])
-                     if schema_name else [])
-            if not parts:
+            pids = (lookup.pids_by_schema.get(schema_name)
+                    if schema_name else None)
+            if pids is None or pids.size == 0:
                 blocks.append((np.full((1, 1), PAD_TS, np.int32),
                                np.full((1, 1), np.nan), []))
                 continue
-            shard.ensure_paged(parts, start_ms, end_ms)
-            ts, cols, counts, store = shard.gather_series(parts)
+            shard.ensure_paged_pids(schema_name, pids, start_ms, end_ms)
+            store = shard.stores[schema_name]
+            ts, cols, counts = store.gather_rows(shard.rows_for(pids))
             schema = shard.schemas[schema_name]
             col_def = next((c for c in schema.data_columns
                             if c.name == schema.value_column), None)
@@ -317,9 +378,8 @@ class MeshExecutor:
             precorrected = precorrected and correct
             vals, vbase = rebase_values(cols[schema.value_column], correct)
             ts_off = to_offsets(ts, counts, start_ms)
-            labels = [{**p.part_key.tags_dict, "_metric_": p.part_key.metric}
-                      for p in parts]
-            blocks.append((ts_off, vals, labels, vbase))
+            gids, registry = self._gids_for(shard, pids, by, without)
+            blocks.append((ts_off, vals, gids, vbase))
         if not blocks:
             return None
         if len(blocks) > self.n_shard:
@@ -330,8 +390,21 @@ class MeshExecutor:
         while len(blocks) < self.n_shard:
             blocks.append((np.full((1, 1), PAD_TS, np.int32),
                            np.full((1, 1), np.nan), []))
+        # Compact global registry slots to this query's groups only, so a
+        # narrow filter never emits phantom groups from earlier queries
+        # and num_groups (-> jit shapes) doesn't grow unboundedly.
+        labels = None
+        if registry is not None:
+            arrs = [b[2] for b in blocks if isinstance(b[2], np.ndarray)]
+            uniq = (np.unique(np.concatenate(arrs)) if arrs
+                    else np.zeros(0, dtype=np.int32))
+            labels = [registry.labels[int(g)] for g in uniq]
+            blocks = [(b[0], b[1],
+                       (np.searchsorted(uniq, b[2]).astype(np.int32)
+                        if isinstance(b[2], np.ndarray) else b[2]),
+                       *b[3:]) for b in blocks]
         packed = pack_shards(blocks, by=by, without=without, base_ms=start_ms,
-                             precorrected=precorrected)
+                             precorrected=precorrected, group_labels=labels)
         return device_put_packed(packed, self.mesh)
 
     def run_agg(self, packed: PackedShards, wends: np.ndarray, *,

@@ -744,12 +744,13 @@ class MultiSchemaPartitionsExec(LeafExecPlan):
         schema_name = self.schema or lookup.first_schema
         if schema_name is None:
             return None, stats
-        parts = lookup.parts_by_schema.get(schema_name, [])
-        if not parts:
+        pids = lookup.pids_by_schema.get(schema_name)
+        if pids is None or pids.size == 0:
             return None, stats
-        shard.ensure_paged(parts, self.chunk_start_ms, self.chunk_end_ms)
+        shard.ensure_paged_pids(schema_name, pids,
+                                self.chunk_start_ms, self.chunk_end_ms)
         store = shard.stores[schema_name]
-        rows = np.asarray([p.row for p in parts], dtype=np.int64)
+        rows = shard.rows_for(pids)
         counts = store.counts[rows]
         schema = shard.schemas[schema_name]
         col_name = (self.columns[0] if self.columns
@@ -806,17 +807,15 @@ class MultiSchemaPartitionsExec(LeafExecPlan):
             base = store.device_mirror.base_ms
             precorrected = counter_col   # mirror corrects counter columns
         else:
-            ts, cols, counts, _ = shard.gather_series(parts)
+            ts, cols, counts = store.gather_rows(rows)
             base = self.chunk_start_ms
             ts_off = to_offsets(ts, counts, base)
             # correct (f64) + rebase so counter deltas stay exact on chip
             precorrected = counter_col and fn_is_counter
             vals, vbase = counter_ops.rebase_values(cols[col_name],
                                                     precorrected)
-        keys = [RangeVectorKey.make(
-            {**p.part_key.tags_dict, "_metric_": p.part_key.metric})
-            for p in parts]
-        stats.series_scanned = len(parts)
+        keys = shard.keys_for(pids)
+        stats.series_scanned = int(pids.size)
         stats.samples_scanned = int(counts.sum())
         les = store.bucket_les if vals.ndim == 3 else None
         return RawBlock(keys, ts_off, vals, base, les,

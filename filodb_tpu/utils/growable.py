@@ -1,0 +1,18 @@
+"""Amortized-O(1) append support for flat numpy arrays.
+
+Shared by the shard's pid tables and the tag index's liveness/time arrays
+(the dense 2D store keeps its own shape-aware grow in blockstore.py).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+
+def grow_to(arr: np.ndarray, n: int, fill=0) -> np.ndarray:
+    """Return `arr` with capacity >= n, growing geometrically."""
+    if n <= arr.shape[0]:
+        return arr
+    cap = max(n, 2 * arr.shape[0], 1024)
+    out = np.full(cap, fill, dtype=arr.dtype)
+    out[:arr.shape[0]] = arr
+    return out

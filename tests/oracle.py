@@ -105,6 +105,11 @@ def eval_series(ts: np.ndarray, vals: np.ndarray, wends: Sequence[int],
             out[i] = np.var(wv[mask]) if mask.any() else np.nan
         elif fn == "last_over_time":
             out[i] = wv[-1]
+        elif fn == "mad_over_time":
+            if mask.any():
+                xs = wv[mask]
+                med = np.quantile(xs, 0.5, method="linear")
+                out[i] = np.quantile(np.abs(xs - med), 0.5, method="linear")
         elif fn == "quantile_over_time":
             q = params[0]
             out[i] = (np.quantile(wv[mask], q, method="linear")

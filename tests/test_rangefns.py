@@ -56,7 +56,8 @@ CHEAP_FNS = ["rate", "increase", "delta", "irate", "idelta", "sum_over_time",
              "count_over_time", "avg_over_time", "min_over_time",
              "max_over_time", "stddev_over_time", "stdvar_over_time",
              "last_over_time", "changes", "resets", "deriv", "z_score",
-             "timestamp", "present_over_time", "absent_over_time"]
+             "timestamp", "present_over_time", "absent_over_time",
+             "mad_over_time"]
 
 
 @pytest.mark.parametrize("fn", CHEAP_FNS)
