@@ -182,6 +182,63 @@ def bench_query_hicard(quick: bool):
     _emit("query_hicard", "sum_rate_qps", 1 / per, "queries/s", series=S)
 
 
+def bench_query_under_ingest(quick: bool):
+    """Query QPS while a thread continuously ingests into the same shard
+    (ref: QueryAndIngestBenchmark.scala — the reference runs queries during
+    its second window of live ingestion).  Reports concurrent QPS and the
+    quiesced QPS for the same store so the interference cost is visible."""
+    import threading
+    from filodb_tpu.core.memstore import TimeSeriesMemStore
+    from filodb_tpu.core.records import RecordBatch
+    from filodb_tpu.ingest.generator import counter_batch
+    from filodb_tpu.parallel.shardmapper import ShardEvent, ShardMapper
+    from filodb_tpu.query.engine import QueryEngine
+    S, T = (500, 360) if quick else (2000, 720)
+    full = counter_batch(S, T, start_ms=START)
+    ms = TimeSeriesMemStore()
+    sh = ms.setup("prometheus", 0)
+    half_ms = START + (T // 2) * 10_000
+    keep = full.timestamps < half_ms
+    sh.ingest(RecordBatch(full.schema, full.part_keys, full.part_idx[keep],
+                          full.timestamps[keep],
+                          {k: v[keep] for k, v in full.columns.items()},
+                          full.bucket_les))
+    mapper = ShardMapper(1)
+    mapper.update_from_event(ShardEvent("IngestionStarted", "prometheus", 0, "b"))
+    eng = QueryEngine("prometheus", ms, mapper)
+    s = START // 1000
+    q = 'sum by (_ns_)(rate(request_total[5m]))'
+    run = lambda: eng.query_range(q, s + 600, 60, s + T * 10)  # noqa: E731
+    assert run().error is None
+    stop = threading.Event()
+
+    def ingester():
+        # stream the second half in small slices until the bench ends
+        idx = T // 2
+        while not stop.is_set():
+            if idx >= T:
+                idx = T // 2  # wrap: re-deliver (dropped as out-of-order)
+            lo = START + idx * 10_000
+            hi = lo + 20 * 10_000
+            k = (full.timestamps >= lo) & (full.timestamps < hi)
+            sh.ingest(RecordBatch(full.schema, full.part_keys,
+                                  full.part_idx[k], full.timestamps[k],
+                                  {kk: v[k] for kk, v in full.columns.items()},
+                                  full.bucket_les))
+            idx += 20
+    t = threading.Thread(target=ingester, daemon=True)
+    t.start()
+    try:
+        per_concurrent = _time_it(run, 5 if quick else 20)
+    finally:
+        stop.set()
+        t.join(timeout=30)
+    per_quiesced = _time_it(run, 5 if quick else 20)
+    _emit("query_under_ingest", "concurrent_qps", 1 / per_concurrent,
+          "queries/s", series=S,
+          quiesced_qps=round(1 / per_quiesced, 1))
+
+
 def bench_query_1m(quick: bool):
     """North-star end-to-end: memstore ingest -> index lookup -> dense
     gather -> mesh pack (cached group ids) -> kernel, at 1M series
@@ -321,6 +378,7 @@ BENCHES: Dict[str, Callable[[bool], None]] = {
     "query": bench_query,
     "query_hicard": bench_query_hicard,
     "query_1m": bench_query_1m,
+    "query_under_ingest": bench_query_under_ingest,
     "histogram": bench_histogram,
 }
 

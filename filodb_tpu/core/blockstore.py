@@ -18,6 +18,7 @@ time-ordered reclaim analogue, ref: BlockManager.scala:16 reclaim ordering).
 """
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 from typing import Dict, List, Optional, Tuple
 
@@ -26,6 +27,16 @@ import numpy as np
 from filodb_tpu.core.schemas import Schema
 
 _PAD_TS = np.iinfo(np.int64).max
+
+
+class _MutationToken:
+    __slots__ = ("cancelled",)
+
+    def __init__(self):
+        self.cancelled = False
+
+    def cancel(self):
+        self.cancelled = True
 
 
 class DenseSeriesStore:
@@ -37,7 +48,14 @@ class DenseSeriesStore:
         self._s_cap = initial_series
         self._t_cap = initial_time
         self.num_series = 0
+        # seqlock-style version counter: odd while a mutation is in
+        # progress, even when stable.  Lock-free readers (query gathers,
+        # the device mirror) snapshot an even generation, copy, and retry
+        # if it moved — the TPU-native replacement for the reference's
+        # per-partition Latch/ChunkMap reader-writer protocol
+        # (ref: memory/.../Latch.scala, TimeSeriesShard.scala:817).
         self.generation = 0
+        self._mut_depth = 0
         self.num_buckets = 0
         self.bucket_les: Optional[np.ndarray] = None
         self.ts = np.full((self._s_cap, self._t_cap), _PAD_TS, dtype=np.int64)
@@ -61,6 +79,30 @@ class DenseSeriesStore:
             else:
                 self.cols[c.name] = np.full((self._s_cap, self._t_cap), np.nan)
         self.dropped_out_of_order = 0
+
+    # ---- mutation protocol ----
+
+    @contextlib.contextmanager
+    def mutation(self):
+        """Bracket any in-place change to the SoA arrays.  Nest-safe.
+        The yielded token's cancel() marks the outermost mutation a no-op
+        (nothing visible changed), reverting the generation so readers and
+        the device mirror aren't spuriously invalidated — e.g. an append
+        whose samples were all dropped as out-of-order re-delivery."""
+        outer = self._mut_depth == 0
+        if outer:
+            self.generation += 1          # odd: mutation in progress
+        self._mut_depth += 1
+        tok = _MutationToken()
+        try:
+            yield tok
+        finally:
+            self._mut_depth -= 1
+            if self._mut_depth == 0:
+                if tok.cancelled:
+                    self.generation -= 1  # back to the prior even value
+                else:
+                    self.generation += 1  # new even value: data changed
 
     # ---- capacity management ----
 
@@ -124,6 +166,13 @@ class DenseSeriesStore:
         sample i; samples for a given series must be time-ascending within the
         batch.  Out-of-order samples (vs what is already stored) are dropped,
         matching the reference's ingest behavior.  Returns samples ingested."""
+        with self.mutation() as mut:
+            n = self._append_batch(rows, ts, columns, bucket_les)
+            if n == 0:
+                mut.cancel()
+            return n
+
+    def _append_batch(self, rows, ts, columns, bucket_les) -> int:
         rows = np.asarray(rows, dtype=np.int64)
         ts = np.asarray(ts, dtype=np.int64)
         n = len(rows)
@@ -199,7 +248,6 @@ class DenseSeriesStore:
         # live data now tops these rows: upper disk coverage is governed by
         # the checkpoint/replay invariant, not paged_ceil
         self.page_only[np.unique(rows)] = False
-        self.generation += 1
         return len(rows)
 
     def prepend_row(self, row: int, ts: np.ndarray,
@@ -214,6 +262,13 @@ class DenseSeriesStore:
         OnDemandPagingShard.scala:55); callers must set paged_floor from what
         is actually resident, so a trimmed page-in is re-consulted rather than
         trusted."""
+        with self.mutation() as mut:
+            n = self._prepend_row(row, ts, columns)
+            if n == 0:
+                mut.cancel()
+            return n
+
+    def _prepend_row(self, row, ts, columns) -> int:
         n = len(ts)
         if n == 0:
             return 0
@@ -243,7 +298,6 @@ class DenseSeriesStore:
                 arr[row, :n] = np.nan if vals is None else vals
         self.counts[row] += n
         self.sealed[row] += n
-        self.generation += 1
         return n
 
     def append_row(self, row: int, ts: np.ndarray,
@@ -254,6 +308,13 @@ class DenseSeriesStore:
         row of the same query just loaded; the NEWEST part of the payload is
         trimmed to fit max_time_cap instead, and callers set paged_ceil from
         what is actually resident."""
+        with self.mutation() as mut:
+            n = self._append_row(row, ts, columns)
+            if n == 0:
+                mut.cancel()
+            return n
+
+    def _append_row(self, row, ts, columns) -> int:
         n = len(ts)
         if n == 0:
             return 0
@@ -280,7 +341,6 @@ class DenseSeriesStore:
                 arr[row, cnt:need] = np.nan if vals is None else vals
         self.counts[row] += n
         self.sealed[row] += n
-        self.generation += 1
         return n
 
     # ---- eviction ----
@@ -294,9 +354,14 @@ class DenseSeriesStore:
         ref: memory/.../BlockManager.scala reclaim ordering).  Series that have
         nothing sealed are left intact; callers fall back to growing time
         capacity instead."""
+        with self.mutation() as mut:
+            if not self._evict_oldest(nsamples):
+                mut.cancel()
+
+    def _evict_oldest(self, nsamples) -> bool:
         k = np.minimum(nsamples, self.sealed).astype(np.int64)   # per-series
         if not k.any():
-            return
+            return False
         S, T = self.ts.shape
         idx = np.arange(T, dtype=np.int64)[None, :] + k[:, None]
         valid = idx < T
@@ -319,24 +384,25 @@ class DenseSeriesStore:
         # evicted page-only row must not keep stale upper coverage either)
         self.paged_floor[k > 0] = _PAD_TS
         self.paged_ceil[k > 0] = -1
-        self.generation += 1
+        return True
 
     def compact_time(self, slack: int = 64) -> int:
         """Shrink the time capacity down to the live extent (+slack) so
         evicted history actually releases host RAM — evict_oldest only
         shifts within the allocation.  Returns bytes released."""
-        t_used = self.time_used
-        target = max(t_used + slack, 1)
-        if target >= self._t_cap:
-            return 0
-        before = self.nbytes
-        self.ts = np.ascontiguousarray(self.ts[:, :target])
-        for name, arr in self.cols.items():
-            if arr is not None:
-                self.cols[name] = np.ascontiguousarray(arr[:, :target])
-        self._t_cap = target
-        self.generation += 1
-        return before - self.nbytes
+        with self.mutation() as mut:
+            t_used = self.time_used
+            target = max(t_used + slack, 1)
+            if target >= self._t_cap:
+                mut.cancel()
+                return 0
+            before = self.nbytes
+            self.ts = np.ascontiguousarray(self.ts[:, :target])
+            for name, arr in self.cols.items():
+                if arr is not None:
+                    self.cols[name] = np.ascontiguousarray(arr[:, :target])
+            self._t_cap = target
+            return before - self.nbytes
 
     # ---- query gather ----
 

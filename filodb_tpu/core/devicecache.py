@@ -50,6 +50,11 @@ class DeviceMirror:
         import jax
 
         from filodb_tpu.utils.metrics import registry as metrics_registry
+        # capture the version BEFORE copying host arrays: if a mutation
+        # lands mid-copy the recorded generation is stale, so the caller's
+        # snapshot_read retry forces a clean re-upload (seqlock protocol,
+        # see DenseSeriesStore.mutation)
+        gen0 = store.generation
         nbytes = self._nbytes(store)
         if nbytes > self.hbm_limit_bytes:
             # silently-degraded path flagged in round 1: make it observable
@@ -79,19 +84,32 @@ class DeviceMirror:
                 self._cols[name] = jax.device_put(rebased)
                 self._vbases[name] = jax.device_put(vb)
         self._t_used = t
-        self._gen = store.generation
+        self._gen = gen0
         return True
 
-    def gather(self, store, rows: np.ndarray
-               ) -> Optional[Tuple[object, Dict[str, object], Dict[str, object]]]:
-        """(ts_off [R, T], cols, vbases) as device arrays for the requested
-        rows, or None when the mirror cannot serve (over the HBM cap).  The
-        returned offsets are relative to `self.base_ms`; col values are
-        rebased by vbases[col]."""
+    def is_fresh(self, store) -> bool:
+        return store.generation == self._gen and self._ts_off is not None
+
+    def ensure_fresh(self, store) -> bool:
+        """Re-upload if the store moved on.  Callers must exclude writers
+        (hold the shard write_lock) — the refresh copies the full host
+        arrays and must not race a mutation.  Returns False when the store
+        exceeds the HBM cap (callers fall back to host gather)."""
+        if self.is_fresh(store):
+            return True
+        return self._refresh(store)
+
+    def gather_cached(self, rows: np.ndarray
+                      ) -> Optional[Tuple[object, Dict[str, object], Dict[str, object]]]:
+        """(ts_off [R, T], cols, vbases) device arrays for the requested rows
+        from the CURRENT device copy — no host reads, no freshness check, so
+        it can run outside any lock: the copy is an immutable snapshot that
+        was fresh when ensure_fresh validated it (a concurrent ingest just
+        makes it one batch stale, same as a query that started earlier).
+        Offsets are relative to `self.base_ms`; values rebased by vbases."""
         import jax.numpy as jnp
-        if store.generation != self._gen or self._ts_off is None:
-            if not self._refresh(store):
-                return None
+        if self._ts_off is None:
+            return None
         idx = jnp.asarray(rows.astype(np.int32))
         ts_off = jnp.take(self._ts_off, idx, axis=0)
         cols = {name: jnp.take(arr, idx, axis=0)

@@ -1,0 +1,95 @@
+"""Background flush scheduling — ingest/persist overlap.
+
+The reference drives flushes from a dedicated stream: each shard cycles
+through its flush groups on a timer, sealing write buffers and persisting
+chunks while ingest continues on other groups (ref:
+core/.../memstore/TimeSeriesShard.scala createFlushTask / prepareFlushGroup,
+doc/ingestion.md flush-interval semantics).  The TPU rebuild keeps the same
+shape: a daemon thread rotates groups round-robin so each group flushes once
+per `interval_s`, and every flush serializes with ingest via the shard's
+write_lock while queries keep reading through the seqlock.
+
+The same thread doubles as the headroom task (ref:
+TimeSeriesShard.startHeadroomTask:1665): after each full rotation it runs
+enforce_memory() so dense-tier pressure is relieved without a caller having
+to remember to.
+"""
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Optional
+
+_log = logging.getLogger("filodb.flush")
+
+
+class FlushScheduler:
+    """Rotates flush groups of every shard of a dataset on a timer."""
+
+    def __init__(self, memstore, dataset: str, interval_s: float = 60.0,
+                 headroom: bool = True):
+        self.memstore = memstore
+        self.dataset = dataset
+        self.interval_s = interval_s
+        self.headroom = headroom
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.flushes = 0
+        self.errors = 0
+
+    # ------------------------------------------------------------------ control
+
+    def start(self) -> "FlushScheduler":
+        if self._thread is not None:
+            return self
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name=f"flush-{self.dataset}")
+        self._thread.start()
+        return self
+
+    def stop(self, final_flush: bool = True) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=30)
+            self._thread = None
+        if final_flush:
+            for shard in self.memstore.shards_for(self.dataset):
+                try:
+                    shard.flush_all_groups()
+                except Exception:  # noqa: BLE001
+                    _log.exception("final flush failed shard=%d",
+                                   shard.shard_num)
+
+    # ------------------------------------------------------------------- loop
+
+    def _run(self) -> None:
+        group = 0
+        while not self._stop.is_set():
+            shards = self.memstore.shards_for(self.dataset)
+            n_groups = max((s._groups for s in shards), default=1)
+            # one group per tick across all shards -> every group flushes
+            # once per interval_s, like the reference's flush stream
+            tick = self.interval_s / max(n_groups, 1)
+            for shard in shards:
+                if self._stop.is_set():
+                    return
+                try:
+                    if group < shard._groups:
+                        shard.flush_group(group)
+                        self.flushes += 1
+                except Exception:  # noqa: BLE001
+                    self.errors += 1
+                    _log.exception("background flush failed shard=%d group=%d",
+                                   shard.shard_num, group)
+            group += 1
+            if group >= n_groups:
+                group = 0
+                if self.headroom:
+                    for shard in shards:
+                        try:
+                            shard.enforce_memory()
+                        except Exception:  # noqa: BLE001
+                            self.errors += 1
+                            _log.exception("headroom task failed shard=%d",
+                                           shard.shard_num)
+            self._stop.wait(tick)

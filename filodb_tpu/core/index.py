@@ -134,9 +134,6 @@ class PartKeyIndex:
     def _all_ids(self) -> np.ndarray:
         return np.nonzero(self._alive)[0].astype(np.int64)
 
-    def _live_len(self) -> int:
-        return len(self._part_keys)
-
     def _match_filter(self, f: ColumnFilter) -> np.ndarray:
         key = "__name__" if f.column in ("__name__", "_metric_") else f.column
         values = self._postings.get(key, {})

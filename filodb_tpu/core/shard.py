@@ -11,8 +11,9 @@ from __future__ import annotations
 
 import dataclasses
 import logging
+import threading
 import time
-from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+from typing import Callable, Dict, Iterable, List, Optional, Sequence, Tuple
 
 _log = logging.getLogger("filodb.shard")
 
@@ -126,6 +127,16 @@ class TimeSeriesShard:
         # match ALL filters get lifecycle log lines (ref: tracedPartFilters,
         # README:871-875)
         self.traced_part_filters: List[Tuple[str, str]] = []
+        # Writer mutex: ingest / flush / ODP page-in / eviction serialize
+        # here (the reference serializes these on the shard's ingestion
+        # dispatcher, ref: TimeSeriesShard.scala ingestSched + EvictionLock).
+        # Queries do NOT take it — they use snapshot_read's seqlock retry
+        # against DenseSeriesStore.generation, so reads stay lock-free
+        # unless a writer is mid-mutation.
+        self.write_lock = threading.RLock()
+        # flush-group membership maintained at creation so a group flush
+        # walks only its own partitions, not all of them
+        self._group_pids: List[List[int]] = [[] for _ in range(self._groups)]
 
     # ------------------------------------------------------------------ ingest
 
@@ -176,6 +187,7 @@ class TimeSeriesShard:
         self._pid_row[pid] = info.row
         self._pid_alive[pid] = True
         self._rv_keys.append(None)
+        self._group_pids[info.group].append(pid)
         self.part_set[kb] = pid
         self.index.add_partition(pid, part_key, start_time_ms)
         self._dirty_part_keys.add(pid)
@@ -189,7 +201,13 @@ class TimeSeriesShard:
 
     def ingest(self, batch: RecordBatch, offset: int = -1) -> int:
         """Ingest one record batch (ref: TimeSeriesShard.ingest:570).
-        Returns number of samples ingested."""
+        Returns number of samples ingested.  Thread-safe: serialized with
+        flush/eviction/paging via write_lock; concurrent queries read
+        through the seqlock (snapshot_read)."""
+        with self.write_lock:
+            return self._ingest(batch, offset)
+
+    def _ingest(self, batch: RecordBatch, offset: int = -1) -> int:
         if batch.num_records == 0:
             return 0
         store = self._store_for(batch.schema.name)
@@ -237,17 +255,25 @@ class TimeSeriesShard:
         group checkpoint (ref: TimeSeriesShard.doFlushSteps:969,
         writeChunks:1072, commitCheckpoint:1127).  Returns chunks written."""
         ingestion_time_ms = ingestion_time_ms or int(time.time() * 1000)
-        with metrics_span("flush", dataset=self.dataset):
-            written = self._do_flush_group(group, ingestion_time_ms)
+        with self.write_lock:
+            with metrics_span("flush", dataset=self.dataset):
+                written = self._do_flush_group(group, ingestion_time_ms)
         metrics_registry.counter("chunks_flushed",
                                  dataset=self.dataset).increment(written)
         return written
 
     def _do_flush_group(self, group: int, ingestion_time_ms: int) -> int:
+        # Snapshot the replay watermark BEFORE reading any data: the
+        # checkpoint must never claim offsets whose samples were not yet
+        # encoded when this flush read them (a background flush racing a
+        # live ingest would otherwise lose samples on replay, ref:
+        # TimeSeriesShard.commitCheckpoint ordering).
+        offset_snapshot = self.ingested_offset
         written = 0
         dirty_pids: set = set()
-        for info in self.partitions:
-            if info is None or info.group != group:
+        for pid in self._group_pids[group]:
+            info = self.partitions[pid]
+            if info is None or not self._pid_alive[pid]:
                 continue
             store = self.stores[info.schema_name]
             lo, hi = store.unsealed_range(info.row)
@@ -288,7 +314,7 @@ class TimeSeriesShard:
         if dirty:
             self.column_store.write_part_keys(self.dataset, self.shard_num, dirty)
         self.meta_store.write_checkpoint(
-            self.dataset, self.shard_num, group, self.ingested_offset)
+            self.dataset, self.shard_num, group, offset_snapshot)
         self.stats.chunks_flushed += written
         self.stats.flushes += 1
         return written
@@ -297,6 +323,25 @@ class TimeSeriesShard:
         return sum(self.flush_group(g) for g in range(self._groups))
 
     # ------------------------------------------------------------------- query
+
+    def snapshot_read(self, store: DenseSeriesStore, fn: Callable,
+                      retries: int = 8):
+        """Run fn() — a host-side read that copies data out of `store` —
+        against a consistent snapshot.  Lock-free seqlock retry: snapshot an
+        even generation, read, verify unchanged; after `retries` torn reads
+        fall back to excluding writers via write_lock.  The TPU-native
+        replacement for the reference's reader Latch (SURVEY §7 seal/epoch
+        protocol; ref: memory/.../Latch.scala)."""
+        for _ in range(retries):
+            g0 = store.generation
+            if g0 % 2:                      # mutation in progress
+                time.sleep(0.0002)
+                continue
+            out = fn()
+            if store.generation == g0:
+                return out
+        with self.write_lock:
+            return fn()
 
     def lookup_partitions(self, filters: Sequence[ColumnFilter],
                           start_time_ms: int, end_time_ms: int,
@@ -415,7 +460,8 @@ class TimeSeriesShard:
         if not need.any():
             return 0
         parts = [self.partitions[p] for p in np.asarray(pids)[need].tolist()]
-        return self.ensure_paged(parts, start_time_ms, end_time_ms)
+        with self.write_lock:
+            return self.ensure_paged(parts, start_time_ms, end_time_ms)
 
     def ensure_paged(self, parts: Sequence[PartitionInfo],
                      start_time_ms: int, end_time_ms: int) -> int:
@@ -568,6 +614,10 @@ class TimeSeriesShard:
                   else self.config.store.shard_mem_size)
         tail = (active_tail_rows if active_tail_rows is not None
                 else self.config.store.active_tail_rows)
+        with self.write_lock:
+            return self._enforce_memory(budget, tail)
+
+    def _enforce_memory(self, budget: int, tail: int) -> int:
         dense = sum(s.nbytes for s in self.stores.values())
         metrics_registry.gauge("dense_store_bytes", dataset=self.dataset,
                                shard=str(self.shard_num)).update(dense)
@@ -595,16 +645,22 @@ class TimeSeriesShard:
     def evict_ended_partitions(self, before_ms: int) -> int:
         """Evict partitions whose series ended before `before_ms`
         (ref: TimeSeriesShard.partitionsToEvict:1464)."""
+        with self.write_lock:
+            return self._evict_ended_partitions(before_ms)
+
+    def _evict_ended_partitions(self, before_ms: int) -> int:
         evicted = 0
         for info in list(self.partitions):
-            if info is None:
+            if info is None or not self._pid_alive[info.part_id]:
                 continue
             if self.index.end_time(info.part_id) < before_ms:
                 self.index.remove_partition(info.part_id)
                 self.part_set.pop(info.part_key.to_bytes(), None)
-                self.partitions[info.part_id] = None
+                # the PartitionInfo stays as a tombstone: lock-free query
+                # paths that passed the _pid_alive filter a moment ago may
+                # still deref partitions[pid]/_rv_keys[pid] — nulling the
+                # slot would crash them.  Liveness is _pid_alive alone.
                 self._pid_alive[info.part_id] = False
-                self._rv_keys[info.part_id] = None
                 self.resident.drop_part(info.part_id)
                 if self.cardinality_tracker is not None:
                     sk = info.part_key.shard_key(self.schemas.part)
@@ -617,4 +673,4 @@ class TimeSeriesShard:
 
     @property
     def num_partitions(self) -> int:
-        return sum(1 for p in self.partitions if p is not None)
+        return int(self._pid_alive[:len(self.partitions)].sum())

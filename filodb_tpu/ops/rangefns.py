@@ -363,39 +363,29 @@ def max_over_time(ctx: WindowCtx) -> jax.Array:
     return _nan_where(ctx.n > 0, r)
 
 
-def quantile_over_time(ctx: WindowCtx, q: float) -> jax.Array:
-    def reducer(v, m):
-        big = jnp.where(m, v, jnp.inf)
-        srt = jnp.sort(big, axis=-1)
-        cnt = jnp.sum(m, axis=-1).astype(v.dtype)
-        rank = q * (cnt - 1.0)
-        lo = jnp.floor(rank).astype(jnp.int32)
-        hi = jnp.ceil(rank).astype(jnp.int32)
-        frac = rank - lo.astype(v.dtype)
-        vlo = jnp.take_along_axis(srt, jnp.maximum(lo, 0)[..., None], axis=-1)[..., 0]
-        vhi = jnp.take_along_axis(srt, jnp.maximum(hi, 0)[..., None], axis=-1)[..., 0]
-        return vlo + (vhi - vlo) * frac
-    r = _window_tile_reduce(ctx, reducer)
-    if not 0.0 <= q <= 1.0:
-        return jnp.where(ctx.n > 0,
-                         jnp.inf if q > 1 else -jnp.inf, jnp.nan).astype(ctx.vals.dtype)
-    return _nan_where(ctx.n > 0, r)
-
-
-def _masked_median(vals: jax.Array, mask: jax.Array) -> jax.Array:
-    """Linear-interpolated median of masked values along the last axis.
+def _masked_quantile(vals: jax.Array, mask: jax.Array, q: float) -> jax.Array:
+    """Linear-interpolated quantile of masked values along the last axis.
     vals broadcastable to mask's shape; invalid cells sort to +inf past the
     valid prefix."""
     big = jnp.where(mask, vals, jnp.inf)
     srt = jnp.sort(big, axis=-1)
     cnt = jnp.sum(mask, axis=-1).astype(srt.dtype)
-    rank = 0.5 * (cnt - 1.0)
+    rank = q * (cnt - 1.0)
     lo = jnp.floor(rank).astype(jnp.int32)
     hi = jnp.ceil(rank).astype(jnp.int32)
     frac = rank - lo.astype(srt.dtype)
     vlo = jnp.take_along_axis(srt, jnp.maximum(lo, 0)[..., None], axis=-1)[..., 0]
     vhi = jnp.take_along_axis(srt, jnp.maximum(hi, 0)[..., None], axis=-1)[..., 0]
     return vlo + (vhi - vlo) * frac
+
+
+def quantile_over_time(ctx: WindowCtx, q: float) -> jax.Array:
+    r = _window_tile_reduce(
+        ctx, lambda v, m: _masked_quantile(jnp.broadcast_to(v, m.shape), m, q))
+    if not 0.0 <= q <= 1.0:
+        return jnp.where(ctx.n > 0,
+                         jnp.inf if q > 1 else -jnp.inf, jnp.nan).astype(ctx.vals.dtype)
+    return _nan_where(ctx.n > 0, r)
 
 
 def mad_over_time(ctx: WindowCtx) -> jax.Array:
@@ -405,9 +395,9 @@ def mad_over_time(ctx: WindowCtx) -> jax.Array:
     large-magnitude series."""
     def reducer(v, m):
         vb = jnp.broadcast_to(v, m.shape)
-        med = _masked_median(vb, m)
+        med = _masked_quantile(vb, m, 0.5)
         dev = jnp.abs(vb - med[..., None])
-        return _masked_median(dev, m)
+        return _masked_quantile(dev, m, 0.5)
     r = _window_tile_reduce(ctx, reducer)
     return _nan_where(ctx.n > 0, r)
 

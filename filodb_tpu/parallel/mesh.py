@@ -368,7 +368,9 @@ class MeshExecutor:
                 continue
             shard.ensure_paged_pids(schema_name, pids, start_ms, end_ms)
             store = shard.stores[schema_name]
-            ts, cols, counts = store.gather_rows(shard.rows_for(pids))
+            rows = shard.rows_for(pids)
+            ts, cols, counts = shard.snapshot_read(
+                store, lambda: store.gather_rows(rows))
             schema = shard.schemas[schema_name]
             col_def = next((c for c in schema.data_columns
                             if c.name == schema.value_column), None)

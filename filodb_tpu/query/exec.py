@@ -751,7 +751,6 @@ class MultiSchemaPartitionsExec(LeafExecPlan):
                                 self.chunk_start_ms, self.chunk_end_ms)
         store = shard.stores[schema_name]
         rows = shard.rows_for(pids)
-        counts = store.counts[rows]
         schema = shard.schemas[schema_name]
         col_name = (self.columns[0] if self.columns
                     else schema.value_column)
@@ -791,14 +790,34 @@ class MultiSchemaPartitionsExec(LeafExecPlan):
         # device-resident fast path: gather rows from the HBM mirror instead
         # of re-shipping the matrix every query (ref: block-memory working
         # set, BlockManager.scala; see core/devicecache.py)
-        mirrored = None
+        mirror = None
         if getattr(shard.config.store, "device_mirror_enabled", True) and (
                 not counter_col or fn_is_counter):
             mirror = getattr(store, "device_mirror", None)
             if mirror is None:
                 from filodb_tpu.core.devicecache import DeviceMirror
                 mirror = store.device_mirror = DeviceMirror()
-            mirrored = mirror.gather(store, rows)
+
+        # Mirror refresh (a full host->device upload) runs at most once per
+        # query, under the write lock so it can't race a mutation; the
+        # subsequent row gather reads only the immutable device copy.  The
+        # host fallback copies out under the seqlock so a concurrent
+        # ingest/flush can't hand the kernel a torn matrix.
+        mirrored = None
+        if mirror is not None:
+            if mirror.is_fresh(store):
+                mirrored = mirror.gather_cached(rows)
+            else:
+                with shard.write_lock:
+                    if mirror.ensure_fresh(store):
+                        mirrored = mirror.gather_cached(rows)
+        if mirrored is not None:
+            counts, gathered = shard.snapshot_read(
+                store, lambda: store.counts[rows].copy()), None
+        else:
+            counts, gathered = shard.snapshot_read(
+                store, lambda: (store.counts[rows].copy(),
+                                store.gather_rows(rows)))
         # value column selection: histograms gather [S, T, B]
         if mirrored is not None:
             ts_off, dev_cols, dev_vbases = mirrored
@@ -807,7 +826,7 @@ class MultiSchemaPartitionsExec(LeafExecPlan):
             base = store.device_mirror.base_ms
             precorrected = counter_col   # mirror corrects counter columns
         else:
-            ts, cols, counts = store.gather_rows(rows)
+            ts, cols, counts = gathered
             base = self.chunk_start_ms
             ts_off = to_offsets(ts, counts, base)
             # correct (f64) + rebase so counter deltas stay exact on chip

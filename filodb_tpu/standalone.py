@@ -58,6 +58,7 @@ class FiloServer:
         self.engines: Dict[str, QueryEngine] = {}
         self.gateways: Dict[str, GatewayPipeline] = {}
         self.ds_stores: Dict[str, object] = {}
+        self.flush_schedulers: Dict[str, object] = {}
         self._earliest_cache: Dict[str, tuple] = {}
         for dc in self.datasets:
             self._setup_dataset(dc)
@@ -168,10 +169,20 @@ class FiloServer:
 
     # ------------------------------------------------------------ lifecycle
 
-    def start(self) -> None:
+    def start(self, background_flush: bool = True) -> None:
         self.http.start()
+        if background_flush:
+            from filodb_tpu.core.flush import FlushScheduler
+            for dc in self.datasets:
+                sched = FlushScheduler(
+                    self.memstore, dc.name,
+                    interval_s=self.config.store.flush_interval_ms / 1000.0)
+                self.flush_schedulers[dc.name] = sched.start()
 
     def shutdown(self) -> None:
+        for sched in self.flush_schedulers.values():
+            sched.stop(final_flush=True)
+        self.flush_schedulers.clear()
         self.http.stop()
 
     def flush_and_downsample(self, dataset: str) -> int:
