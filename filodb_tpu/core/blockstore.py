@@ -178,13 +178,6 @@ class DenseSeriesStore:
         n = len(rows)
         if n == 0:
             return 0
-        if bucket_les is not None or any(
-                c.col_type == "hist" for c in self.schema.data_columns):
-            hist_col = next(c.name for c in self.schema.data_columns
-                            if c.col_type == "hist")
-            nb = columns[hist_col].shape[1] if columns[hist_col].ndim == 2 else 0
-            self._ensure_hist(nb, bucket_les)
-
         # per-row occurrence number within this batch (vectorized cumcount)
         order = np.argsort(rows, kind="stable")
         sorted_rows = rows[order]
@@ -226,6 +219,16 @@ class DenseSeriesStore:
             occ = np.empty(len(rows), dtype=np.int64)
             occ[order] = occ_s
             pos = self.counts[rows].astype(np.int64) + occ
+
+        # hist column allocation AFTER the drop filter: a fully-dropped
+        # batch must leave no visible state change (cancel invariant of
+        # mutation(); see _MutationToken)
+        if bucket_les is not None or any(
+                c.col_type == "hist" for c in self.schema.data_columns):
+            hist_col = next(c.name for c in self.schema.data_columns
+                            if c.col_type == "hist")
+            nb = columns[hist_col].shape[1] if columns[hist_col].ndim == 2 else 0
+            self._ensure_hist(nb, bucket_les)
 
         need_t = int(pos.max()) + 1
         if need_t > self._t_cap:

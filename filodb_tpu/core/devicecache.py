@@ -16,6 +16,7 @@ its own chunk-scan window.
 """
 from __future__ import annotations
 
+import dataclasses
 from typing import Dict, Optional, Tuple
 
 import numpy as np
@@ -23,19 +24,28 @@ import numpy as np
 from filodb_tpu.ops.timewindow import PAD_TS
 
 
+@dataclasses.dataclass(frozen=True)
+class _MirrorSnapshot:
+    """One immutable upload generation.  _refresh builds a complete snapshot
+    and publishes it with a single attribute assignment, so a lock-free
+    gather_cached racing a refresh sees either the old snapshot or the new
+    one in full — never a half-replaced mix of fields."""
+    gen: int
+    base_ms: int
+    t_used: int
+    ts_off: object                      # jax i32 [S_live, T_used]
+    cols: Dict[str, object]             # jax f [S_live, T_used(, B)]
+    # per-series value bases subtracted in f64 before upload, so counter
+    # deltas survive the f32 downcast (ops/timewindow.series_value_base)
+    vbases: Dict[str, object]
+
+
 class DeviceMirror:
     """One mirror per DenseSeriesStore (lazily attached)."""
 
     def __init__(self, hbm_limit_bytes: int = 8 << 30):
         self.hbm_limit_bytes = hbm_limit_bytes
-        self._gen = -1
-        self._t_used = 0
-        self._base_ms = 0
-        self._ts_off = None                 # jax i32 [S_live, T_used]
-        self._cols: Dict[str, object] = {}  # jax f [S_live, T_used(, B)]
-        # per-series value bases subtracted in f64 before upload, so counter
-        # deltas survive the f32 downcast (ops/timewindow.series_value_base)
-        self._vbases: Dict[str, object] = {}
+        self._snap: Optional[_MirrorSnapshot] = None
 
     def _nbytes(self, store) -> int:
         t = max(store.time_used, 1)
@@ -65,13 +75,12 @@ class DeviceMirror:
         s, t = store.num_series, max(store.time_used, 1)
         ts = store.ts[:s, :t]
         live = ts[ts > 0]
-        self._base_ms = int(live.min()) if live.size else 0
+        base_ms = int(live.min()) if live.size else 0
         pos = np.arange(t)[None, :]
-        off = np.clip(ts - self._base_ms, -(1 << 30), 1 << 30).astype(np.int32)
+        off = np.clip(ts - base_ms, -(1 << 30), 1 << 30).astype(np.int32)
         ts_off = np.where(pos < store.counts[:s, None], off, PAD_TS)
-        self._ts_off = jax.device_put(ts_off)
-        self._cols = {}
-        self._vbases = {}
+        cols: Dict[str, object] = {}
+        vbases: Dict[str, object] = {}
         from filodb_tpu.ops.counter import rebase_values
         counter_cols = {c.name for c in store.schema.data_columns
                         if c.detect_drops or c.counter}
@@ -81,14 +90,16 @@ class DeviceMirror:
                 # so f32 deltas are exact across resets; the leaf exec routes
                 # non-counter functions on counter columns around the mirror
                 rebased, vb = rebase_values(arr[:s, :t], name in counter_cols)
-                self._cols[name] = jax.device_put(rebased)
-                self._vbases[name] = jax.device_put(vb)
-        self._t_used = t
-        self._gen = gen0
+                cols[name] = jax.device_put(rebased)
+                vbases[name] = jax.device_put(vb)
+        # single publication point (GIL-atomic): see _MirrorSnapshot
+        self._snap = _MirrorSnapshot(gen0, base_ms, t,
+                                     jax.device_put(ts_off), cols, vbases)
         return True
 
     def is_fresh(self, store) -> bool:
-        return store.generation == self._gen and self._ts_off is not None
+        snap = self._snap
+        return snap is not None and store.generation == snap.gen
 
     def ensure_fresh(self, store) -> bool:
         """Re-upload if the store moved on.  Callers must exclude writers
@@ -100,24 +111,28 @@ class DeviceMirror:
         return self._refresh(store)
 
     def gather_cached(self, rows: np.ndarray
-                      ) -> Optional[Tuple[object, Dict[str, object], Dict[str, object]]]:
-        """(ts_off [R, T], cols, vbases) device arrays for the requested rows
-        from the CURRENT device copy — no host reads, no freshness check, so
-        it can run outside any lock: the copy is an immutable snapshot that
-        was fresh when ensure_fresh validated it (a concurrent ingest just
-        makes it one batch stale, same as a query that started earlier).
-        Offsets are relative to `self.base_ms`; values rebased by vbases."""
+                      ) -> Optional[Tuple[object, Dict[str, object],
+                                          Dict[str, object], int]]:
+        """(ts_off [R, T], cols, vbases, base_ms) device arrays for the
+        requested rows from the current snapshot — no host reads, no
+        freshness check, so it runs outside any lock: the snapshot is
+        immutable and was fresh when ensure_fresh validated it (a concurrent
+        refresh just publishes a new snapshot; this query keeps its own).
+        Offsets are relative to the returned base_ms; values rebased by
+        vbases."""
         import jax.numpy as jnp
-        if self._ts_off is None:
+        snap = self._snap
+        if snap is None:
             return None
         idx = jnp.asarray(rows.astype(np.int32))
-        ts_off = jnp.take(self._ts_off, idx, axis=0)
+        ts_off = jnp.take(snap.ts_off, idx, axis=0)
         cols = {name: jnp.take(arr, idx, axis=0)
-                for name, arr in self._cols.items()}
+                for name, arr in snap.cols.items()}
         vbases = {name: jnp.take(vb, idx, axis=0)
-                  for name, vb in self._vbases.items()}
-        return ts_off, cols, vbases
+                  for name, vb in snap.vbases.items()}
+        return ts_off, cols, vbases, snap.base_ms
 
     @property
     def base_ms(self) -> int:
-        return self._base_ms
+        snap = self._snap
+        return snap.base_ms if snap is not None else 0

@@ -137,6 +137,11 @@ class TimeSeriesShard:
         # flush-group membership maintained at creation so a group flush
         # walks only its own partitions, not all of them
         self._group_pids: List[List[int]] = [[] for _ in range(self._groups)]
+        # deferred tombstone reclamation queue: (evicted_at, pid).  Evicted
+        # partitions keep their PartitionInfo for a grace period so lock-free
+        # readers holding the pid can still resolve it; flush prunes entries
+        # past the grace window under write_lock (two-phase reclamation)
+        self._evicted_tombstones: List[Tuple[float, int]] = []
 
     # ------------------------------------------------------------------ ingest
 
@@ -262,7 +267,31 @@ class TimeSeriesShard:
                                  dataset=self.dataset).increment(written)
         return written
 
+    def _prune_tombstones(self, grace_s: float = 60.0) -> int:
+        """Reclaim evicted partitions past the grace window (caller holds
+        write_lock).  After grace_s no realistic in-flight query still holds
+        the pid, so the PartitionInfo / cached key / group membership can be
+        freed — otherwise high series churn grows them without bound."""
+        if not self._evicted_tombstones:
+            return 0
+        cutoff = time.time() - grace_s
+        pruned = []
+        while self._evicted_tombstones and self._evicted_tombstones[0][0] <= cutoff:
+            _, pid = self._evicted_tombstones.pop(0)
+            info = self.partitions[pid]
+            if info is not None:
+                glist = self._group_pids[info.group]
+                try:
+                    glist.remove(pid)
+                except ValueError:
+                    pass
+            self.partitions[pid] = None
+            self._rv_keys[pid] = None
+            pruned.append(pid)
+        return len(pruned)
+
     def _do_flush_group(self, group: int, ingestion_time_ms: int) -> int:
+        self._prune_tombstones()
         # Snapshot the replay watermark BEFORE reading any data: the
         # checkpoint must never claim offsets whose samples were not yet
         # encoded when this flush read them (a background flush racing a
@@ -378,9 +407,15 @@ class TimeSeriesShard:
             k = rk[pid]
             if k is None:
                 p = parts[pid]
-                k = RangeVectorKey.make(
-                    {**p.part_key.tags_dict, "_metric_": p.part_key.metric})
-                rk[pid] = k
+                if p is None:
+                    # pruned tombstone hit by a query older than the grace
+                    # window: keep shape alignment with a sentinel key
+                    k = RangeVectorKey((("_evicted_", str(pid)),))
+                else:
+                    k = RangeVectorKey.make(
+                        {**p.part_key.tags_dict,
+                         "_metric_": p.part_key.metric})
+                    rk[pid] = k
             out.append(k)
         return out
 
@@ -659,8 +694,11 @@ class TimeSeriesShard:
                 # the PartitionInfo stays as a tombstone: lock-free query
                 # paths that passed the _pid_alive filter a moment ago may
                 # still deref partitions[pid]/_rv_keys[pid] — nulling the
-                # slot would crash them.  Liveness is _pid_alive alone.
+                # slot would crash them.  Liveness is _pid_alive alone;
+                # the slot itself is reclaimed after a grace period by
+                # _prune_tombstones (called from flush, under write_lock).
                 self._pid_alive[info.part_id] = False
+                self._evicted_tombstones.append((time.time(), info.part_id))
                 self.resident.drop_part(info.part_id)
                 if self.cardinality_tracker is not None:
                     sk = info.part_key.shard_key(self.schemas.part)

@@ -1,0 +1,246 @@
+"""Prometheus remote-read protobuf messages, hand-coded wire format.
+
+Implements exactly the prompb subset the remote-read endpoint needs
+(ref: prometheus/src/main/java/remote/RemoteStorage.java — ReadRequest /
+ReadResponse and friends; http/.../PrometheusApiRoute.scala:37-62 drives
+them).  The wire format is standard protobuf encoding (varint keys,
+length-delimited submessages); coding it directly keeps the dependency
+surface at zero and the schema auditable in one file.
+
+Message numbering matches prompb/remote.proto + prompb/types.proto:
+
+  ReadRequest  { repeated Query queries = 1; }
+  Query        { int64 start_timestamp_ms = 1; int64 end_timestamp_ms = 2;
+                 repeated LabelMatcher matchers = 3; }
+  LabelMatcher { enum Type { EQ=0; NEQ=1; RE=2; NRE=3; } Type type = 1;
+                 string name = 2; string value = 3; }
+  ReadResponse { repeated QueryResult results = 1; }
+  QueryResult  { repeated TimeSeries timeseries = 1; }
+  TimeSeries   { repeated Label labels = 1; repeated Sample samples = 2; }
+  Label        { string name = 1; string value = 2; }
+  Sample       { double value = 1; int64 timestamp = 2; }
+"""
+from __future__ import annotations
+
+import dataclasses
+import struct
+from typing import List, Tuple
+
+EQ, NEQ, RE, NRE = 0, 1, 2, 3
+
+
+@dataclasses.dataclass
+class LabelMatcher:
+    type: int
+    name: str
+    value: str
+
+
+@dataclasses.dataclass
+class PromQuery:
+    start_timestamp_ms: int
+    end_timestamp_ms: int
+    matchers: List[LabelMatcher]
+
+
+@dataclasses.dataclass
+class PromTimeSeries:
+    labels: List[Tuple[str, str]]
+    samples: List[Tuple[float, int]]        # (value, timestamp_ms)
+
+
+# ------------------------------------------------------------ primitives
+
+def _uvarint(n: int) -> bytes:
+    out = bytearray()
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _varint64(n: int) -> bytes:
+    """int64 as protobuf varint (negatives use 64-bit two's complement)."""
+    return _uvarint(n & 0xFFFFFFFFFFFFFFFF)
+
+
+def _read_uvarint(data: bytes, pos: int) -> Tuple[int, int]:
+    result = 0
+    shift = 0
+    while True:
+        b = data[pos]
+        pos += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return result, pos
+        shift += 7
+        if shift > 70:
+            raise ValueError("varint too long")
+
+
+def _to_int64(u: int) -> int:
+    return u - (1 << 64) if u >= (1 << 63) else u
+
+
+def _key(field: int, wire: int) -> bytes:
+    return _uvarint((field << 3) | wire)
+
+
+def _ld(field: int, payload: bytes) -> bytes:
+    """Length-delimited field."""
+    return _key(field, 2) + _uvarint(len(payload)) + payload
+
+
+def _skip(data: bytes, pos: int, wire: int) -> int:
+    if wire == 0:
+        _, pos = _read_uvarint(data, pos)
+    elif wire == 1:
+        pos += 8
+    elif wire == 2:
+        ln, pos = _read_uvarint(data, pos)
+        pos += ln
+    elif wire == 5:
+        pos += 4
+    else:
+        raise ValueError(f"unsupported wire type {wire}")
+    return pos
+
+
+def _fields(data: bytes):
+    """Iterate (field_num, wire_type, value, next_pos) over a message."""
+    pos = 0
+    n = len(data)
+    while pos < n:
+        k, pos = _read_uvarint(data, pos)
+        field, wire = k >> 3, k & 0x07
+        if wire == 0:
+            v, pos = _read_uvarint(data, pos)
+        elif wire == 1:
+            v = data[pos:pos + 8]
+            pos += 8
+        elif wire == 2:
+            ln, pos = _read_uvarint(data, pos)
+            v = data[pos:pos + ln]
+            pos += ln
+        elif wire == 5:
+            v = data[pos:pos + 4]
+            pos += 4
+        else:
+            raise ValueError(f"unsupported wire type {wire}")
+        yield field, wire, v
+
+
+# -------------------------------------------------------------- decoding
+
+def _decode_matcher(data: bytes) -> LabelMatcher:
+    t, name, value = EQ, "", ""
+    for field, wire, v in _fields(data):
+        if field == 1 and wire == 0:
+            t = int(v)
+        elif field == 2 and wire == 2:
+            name = v.decode("utf-8")
+        elif field == 3 and wire == 2:
+            value = v.decode("utf-8")
+    return LabelMatcher(t, name, value)
+
+
+def _decode_query(data: bytes) -> PromQuery:
+    start, end, matchers = 0, 0, []
+    for field, wire, v in _fields(data):
+        if field == 1 and wire == 0:
+            start = _to_int64(v)
+        elif field == 2 and wire == 0:
+            end = _to_int64(v)
+        elif field == 3 and wire == 2:
+            matchers.append(_decode_matcher(v))
+    return PromQuery(start, end, matchers)
+
+
+def decode_read_request(data: bytes) -> List[PromQuery]:
+    queries = []
+    for field, wire, v in _fields(data):
+        if field == 1 and wire == 2:
+            queries.append(_decode_query(v))
+    return queries
+
+
+def _decode_sample(data: bytes) -> Tuple[float, int]:
+    value, ts = 0.0, 0
+    for field, wire, v in _fields(data):
+        if field == 1 and wire == 1:
+            value = struct.unpack("<d", v)[0]
+        elif field == 2 and wire == 0:
+            ts = _to_int64(v)
+    return value, ts
+
+
+def _decode_timeseries(data: bytes) -> PromTimeSeries:
+    labels, samples = [], []
+    for field, wire, v in _fields(data):
+        if field == 1 and wire == 2:
+            name, value = "", ""
+            for f2, w2, v2 in _fields(v):
+                if f2 == 1 and w2 == 2:
+                    name = v2.decode("utf-8")
+                elif f2 == 2 and w2 == 2:
+                    value = v2.decode("utf-8")
+            labels.append((name, value))
+        elif field == 2 and wire == 2:
+            samples.append(_decode_sample(v))
+    return PromTimeSeries(labels, samples)
+
+
+def decode_read_response(data: bytes) -> List[List[PromTimeSeries]]:
+    results = []
+    for field, wire, v in _fields(data):
+        if field == 1 and wire == 2:
+            series = []
+            for f2, w2, v2 in _fields(v):
+                if f2 == 1 and w2 == 2:
+                    series.append(_decode_timeseries(v2))
+            results.append(series)
+    return results
+
+
+# -------------------------------------------------------------- encoding
+
+def encode_read_request(queries: List[PromQuery]) -> bytes:
+    out = bytearray()
+    for q in queries:
+        body = bytearray()
+        body += _key(1, 0) + _varint64(q.start_timestamp_ms)
+        body += _key(2, 0) + _varint64(q.end_timestamp_ms)
+        for m in q.matchers:
+            mb = bytearray()
+            if m.type:
+                mb += _key(1, 0) + _uvarint(m.type)
+            mb += _ld(2, m.name.encode("utf-8"))
+            mb += _ld(3, m.value.encode("utf-8"))
+            body += _ld(3, bytes(mb))
+        out += _ld(1, bytes(body))
+    return bytes(out)
+
+
+def encode_timeseries(ts: PromTimeSeries) -> bytes:
+    body = bytearray()
+    for name, value in ts.labels:
+        lb = _ld(1, name.encode("utf-8")) + _ld(2, value.encode("utf-8"))
+        body += _ld(1, lb)
+    for value, t in ts.samples:
+        sb = _key(1, 1) + struct.pack("<d", value) + _key(2, 0) + _varint64(t)
+        body += _ld(2, sb)
+    return bytes(body)
+
+
+def encode_read_response(results: List[List[PromTimeSeries]]) -> bytes:
+    out = bytearray()
+    for series_list in results:
+        qr = bytearray()
+        for ts in series_list:
+            qr += _ld(1, encode_timeseries(ts))
+        out += _ld(1, bytes(qr))
+    return bytes(out)

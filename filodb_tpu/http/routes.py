@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import json
 import logging
+import struct
 from typing import Callable, Dict, List, Optional, Tuple
 
 from filodb_tpu.promql.lexer import ParseError
@@ -111,7 +112,69 @@ class PromHttpApi:
             return self._metadata(eng, "series", params, multi)
         if rest == ["metering", "cardinality"]:
             return self._cardinality(dataset, params)
+        if rest == ["read"] and method == "POST":
+            return self._remote_read(eng, body)
         return 404, _err(f"unknown api/v1 endpoint {'/'.join(rest)}")
+
+    # --------------------------------------------------------- remote read
+
+    def _remote_read(self, eng: QueryEngine, body: bytes) -> Tuple[int, bytes]:
+        """Prometheus remote-read: snappy-compressed protobuf ReadRequest in,
+        snappy-compressed ReadResponse of raw samples out (ref:
+        PrometheusApiRoute.scala:37-62, remote/RemoteStorage.java).  A bytes
+        payload tells the server shell to send application/x-protobuf with
+        Content-Encoding: snappy."""
+        import numpy as np
+
+        from filodb_tpu.core.index import (Equals, EqualsRegex, NotEquals,
+                                           NotEqualsRegex)
+        from filodb_tpu.http import remotepb
+        from filodb_tpu.query import logical as lp
+        from filodb_tpu.utils import snappy
+
+        try:
+            queries = remotepb.decode_read_request(snappy.decompress(body))
+        except (ValueError, IndexError, struct.error) as e:
+            # IndexError/struct.error: truncated snappy or protobuf bytes —
+            # still the client's fault, so a 400 like any other bad payload
+            raise _BadRequest(f"bad remote-read payload: {e}")
+        matcher_map = {remotepb.EQ: Equals, remotepb.NEQ: NotEquals,
+                       remotepb.RE: EqualsRegex, remotepb.NRE: NotEqualsRegex}
+        results = []
+        for q in queries:
+            filters = []
+            for m in q.matchers:
+                cls = matcher_map.get(m.type)
+                if cls is None:
+                    raise _BadRequest(f"unsupported matcher type {m.type}")
+                name = "_metric_" if m.name == "__name__" else m.name
+                filters.append(cls(name, m.value))
+            plan = lp.RawSeries(
+                lp.IntervalSelector(q.start_timestamp_ms, q.end_timestamp_ms),
+                tuple(filters))
+            res = eng.exec_logical_plan(plan)
+            if res.error:
+                raise _BadRequest(res.error)
+            series_out = []
+            for block in res.blocks:
+                vals = np.asarray(block.values, dtype=np.float64)
+                if vals.ndim != 2:
+                    continue            # histogram schemas: not remote-readable
+                ts_abs = np.asarray(block.ts_off, dtype=np.int64) + block.base_ms
+                if block.vbase is not None:
+                    vals = vals + np.asarray(block.vbase, np.float64)[:, None]
+                for i, key in enumerate(block.keys):
+                    valid = (np.isfinite(vals[i])
+                             & (ts_abs[i] >= q.start_timestamp_ms)
+                             & (ts_abs[i] <= q.end_timestamp_ms))
+                    labels = [("__name__" if k == "_metric_" else k, v)
+                              for k, v in key.labels]
+                    samples = [(float(v), int(t)) for v, t in
+                               zip(vals[i][valid], ts_abs[i][valid])]
+                    series_out.append(remotepb.PromTimeSeries(labels, samples))
+            results.append(series_out)
+        payload = snappy.compress(remotepb.encode_read_response(results))
+        return 200, payload
 
     def _cardinality(self, dataset: str,
                      params: Dict[str, str]) -> Tuple[int, object]:

@@ -43,7 +43,12 @@ class FiloHttpServer:
                     body = b""
                 status, payload = api_ref.handle(method, parsed.path, params,
                                                  body, multi_params=multi)
-                if isinstance(payload, str):        # text routes (/metrics)
+                extra_headers = {}
+                if isinstance(payload, bytes):      # binary (remote-read)
+                    blob = payload
+                    ctype = "application/x-protobuf"
+                    extra_headers["Content-Encoding"] = "snappy"
+                elif isinstance(payload, str):      # text routes (/metrics)
                     blob = payload.encode()
                     ctype = "text/plain; version=0.0.4"
                 else:
@@ -51,6 +56,8 @@ class FiloHttpServer:
                     ctype = "application/json"
                 self.send_response(status)
                 self.send_header("Content-Type", ctype)
+                for k, v in extra_headers.items():
+                    self.send_header(k, v)
                 self.send_header("Content-Length", str(len(blob)))
                 self.end_headers()
                 if blob:

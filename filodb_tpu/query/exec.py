@@ -811,22 +811,17 @@ class MultiSchemaPartitionsExec(LeafExecPlan):
                 with shard.write_lock:
                     if mirror.ensure_fresh(store):
                         mirrored = mirror.gather_cached(rows)
-        if mirrored is not None:
-            counts, gathered = shard.snapshot_read(
-                store, lambda: store.counts[rows].copy()), None
-        else:
-            counts, gathered = shard.snapshot_read(
-                store, lambda: (store.counts[rows].copy(),
-                                store.gather_rows(rows)))
         # value column selection: histograms gather [S, T, B]
         if mirrored is not None:
-            ts_off, dev_cols, dev_vbases = mirrored
+            ts_off, dev_cols, dev_vbases, base = mirrored
             vals = dev_cols[col_name]
             vbase = dev_vbases.get(col_name)
-            base = store.device_mirror.base_ms
+            counts = shard.snapshot_read(store,
+                                         lambda: store.counts[rows].copy())
             precorrected = counter_col   # mirror corrects counter columns
         else:
-            ts, cols, counts = gathered
+            ts, cols, counts = shard.snapshot_read(
+                store, lambda: store.gather_rows(rows))
             base = self.chunk_start_ms
             ts_off = to_offsets(ts, counts, base)
             # correct (f64) + rebase so counter deltas stay exact on chip

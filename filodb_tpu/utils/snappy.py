@@ -1,0 +1,137 @@
+"""Pure-Python Snappy block-format codec.
+
+The Prometheus remote-read/write protocol frames its protobuf payloads with
+Snappy block compression (ref: http/src/main/scala/filodb/http/
+PrometheusApiRoute.scala:37-62 — `Snappy.uncompress` on the request,
+`Snappy.compress` on the response).  No snappy library is available in this
+environment, so this implements the block format
+(github.com/google/snappy/format_description.txt) directly:
+
+- decompress() handles the full format (literals + copy ops with 1/2/4-byte
+  offsets, including overlapping RLE-style copies), so payloads from real
+  clients decode correctly.
+- compress() emits a valid literal-only stream plus greedy back-references
+  for long runs found via a tiny hash table — not snappy-optimal, but
+  interoperable and fast enough for the request/response sizes involved.
+"""
+from __future__ import annotations
+
+
+def _read_uvarint(data: bytes, pos: int) -> tuple:
+    result = 0
+    shift = 0
+    while True:
+        b = data[pos]
+        pos += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return result, pos
+        shift += 7
+        if shift > 63:
+            raise ValueError("uvarint too long")
+
+
+def _write_uvarint(n: int) -> bytes:
+    out = bytearray()
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def decompress(data: bytes) -> bytes:
+    """Snappy block-format decompress (raises ValueError on malformed input)."""
+    if not data:
+        raise ValueError("empty snappy input")
+    expected, pos = _read_uvarint(data, 0)
+    out = bytearray()
+    n = len(data)
+    while pos < n:
+        tag = data[pos]
+        pos += 1
+        kind = tag & 0x03
+        if kind == 0:                       # literal
+            length = (tag >> 2) + 1
+            if length > 60:
+                extra = length - 60
+                if pos + extra > n:
+                    raise ValueError("truncated literal length")
+                length = int.from_bytes(data[pos:pos + extra], "little") + 1
+                pos += extra
+            if pos + length > n:
+                raise ValueError("truncated literal")
+            out += data[pos:pos + length]
+            pos += length
+            continue
+        if kind == 1:                       # copy, 1-byte offset
+            length = 4 + ((tag >> 2) & 0x07)
+            offset = ((tag >> 5) << 8) | data[pos]
+            pos += 1
+        elif kind == 2:                     # copy, 2-byte offset
+            length = (tag >> 2) + 1
+            offset = int.from_bytes(data[pos:pos + 2], "little")
+            pos += 2
+        else:                               # copy, 4-byte offset
+            length = (tag >> 2) + 1
+            offset = int.from_bytes(data[pos:pos + 4], "little")
+            pos += 4
+        if offset == 0 or offset > len(out):
+            raise ValueError("invalid copy offset")
+        start = len(out) - offset
+        if offset >= length:
+            out += out[start:start + length]
+        else:                               # overlapping copy: RLE semantics
+            for i in range(length):
+                out.append(out[start + i])
+    if len(out) != expected:
+        raise ValueError(
+            f"snappy length mismatch: got {len(out)}, expected {expected}")
+    return bytes(out)
+
+
+def _emit_literal(out: bytearray, chunk: bytes) -> None:
+    length = len(chunk)
+    if length == 0:
+        return
+    if length <= 60:
+        out.append((length - 1) << 2)
+    else:
+        nbytes = (max(length - 1, 1).bit_length() + 7) // 8
+        out.append((59 + nbytes) << 2)
+        out += (length - 1).to_bytes(nbytes, "little")
+    out += chunk
+
+
+def compress(data: bytes) -> bytes:
+    """Valid snappy block stream: greedy 4-byte-hash matcher + literals."""
+    out = bytearray(_write_uvarint(len(data)))
+    n = len(data)
+    if n == 0:
+        return bytes(out)
+    table = {}
+    pos = 0
+    lit_start = 0
+    while pos + 4 <= n:
+        key = data[pos:pos + 4]
+        cand = table.get(key)
+        table[key] = pos
+        if cand is not None and pos - cand <= 0xFFFF:
+            match = 4
+            limit = min(n - pos, 64)
+            while (match < limit
+                   and data[cand + match] == data[pos + match]):
+                match += 1
+            _emit_literal(out, data[lit_start:pos])
+            offset = pos - cand
+            out.append(((match - 1) << 2) | 2)      # 2-byte-offset copy
+            out += offset.to_bytes(2, "little")
+            pos += match
+            lit_start = pos
+        else:
+            pos += 1
+    _emit_literal(out, data[lit_start:])
+    return bytes(out)

@@ -177,6 +177,33 @@ def test_snapshot_read_retries_torn_generation():
     assert results == [42]
 
 
+def test_eviction_tombstones_pruned_after_grace():
+    """Evicted partitions keep a tombstone for in-flight readers, but the
+    slot must be reclaimed after the grace window or series churn grows
+    host memory without bound."""
+    ms = TimeSeriesMemStore()
+    sh = ms.setup("prometheus", 0)
+    sh.ingest(_slice_batch(0, 10))
+    pid = 0
+    sh.index.update_end_time(pid, START + 1000)
+    assert sh.evict_ended_partitions(START + 2000) == 1
+    # tombstone retained immediately after eviction
+    assert sh.partitions[pid] is not None
+    assert not sh._pid_alive[pid]
+    # inside grace: flush keeps it
+    sh._prune_tombstones(grace_s=3600)
+    assert sh.partitions[pid] is not None
+    # past grace: flush prunes slot, cached key, and group membership
+    group = sh.partitions[pid].group
+    sh._prune_tombstones(grace_s=0)
+    assert sh.partitions[pid] is None
+    assert sh._rv_keys[pid] is None
+    assert pid not in sh._group_pids[group]
+    # a zombie reader hitting the pruned slot gets a sentinel key, not a crash
+    keys = sh.keys_for(np.asarray([pid]))
+    assert keys[0].labels[0][0] == "_evicted_"
+
+
 def test_flush_scheduler_rotates_all_groups():
     ms = TimeSeriesMemStore()
     sh = ms.setup("prometheus", 0)

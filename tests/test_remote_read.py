@@ -1,0 +1,182 @@
+"""Prometheus remote-read endpoint: snappy codec, prompb wire format, and
+the /api/v1/read round trip (ref: PrometheusApiRoute.scala:37-62,
+remote/RemoteStorage.java)."""
+import numpy as np
+import pytest
+
+from filodb_tpu.http import remotepb
+from filodb_tpu.utils import snappy
+
+START = 1_600_000_000_000
+
+
+# ------------------------------------------------------------------ snappy
+
+def test_snappy_roundtrip_various_sizes():
+    rng = np.random.default_rng(3)
+    for n in (0, 1, 59, 60, 61, 1000, 70_000):
+        data = rng.integers(0, 256, n, dtype=np.uint8).tobytes()
+        assert snappy.decompress(snappy.compress(data)) == data
+
+
+def test_snappy_compresses_repetitive_data():
+    data = b"abcdefgh" * 4096
+    comp = snappy.compress(data)
+    assert snappy.decompress(comp) == data
+    assert len(comp) < len(data) // 4       # back-references actually used
+
+
+def test_snappy_decodes_foreign_copy_ops():
+    """Hand-built streams using all three copy encodings, as a real snappy
+    writer would emit them."""
+    # "abcd" literal + 1-byte-offset copy (len 4, offset 4) => "abcdabcd"
+    blob = bytes([8]) + bytes([(4 - 1) << 2]) + b"abcd" \
+        + bytes([(0 << 5) | ((4 - 4) << 2) | 1, 4])
+    assert snappy.decompress(blob) == b"abcdabcd"
+    # overlapping RLE copy: "ab" + copy(offset=2, len=6) => "abababab"
+    blob = bytes([8]) + bytes([(2 - 1) << 2]) + b"ab" \
+        + bytes([((6 - 1) << 2) | 2, 2, 0])
+    assert snappy.decompress(blob) == b"abababab"
+    # 4-byte-offset copy
+    blob = bytes([8]) + bytes([(4 - 1) << 2]) + b"wxyz" \
+        + bytes([((4 - 1) << 2) | 3, 4, 0, 0, 0])
+    assert snappy.decompress(blob) == b"wxyzwxyz"
+
+
+def test_snappy_rejects_malformed():
+    with pytest.raises(ValueError):
+        snappy.decompress(b"")
+    with pytest.raises(ValueError):          # copy before any output
+        snappy.decompress(bytes([4]) + bytes([(4 - 1) << 2 | 1, 1]))
+    with pytest.raises(ValueError):          # declared length mismatch
+        snappy.decompress(bytes([99]) + bytes([(4 - 1) << 2]) + b"abcd")
+
+
+# ------------------------------------------------------------------ prompb
+
+def test_prompb_request_roundtrip():
+    req = [remotepb.PromQuery(START, START + 60_000, [
+        remotepb.LabelMatcher(remotepb.EQ, "__name__", "request_total"),
+        remotepb.LabelMatcher(remotepb.RE, "_ns_", "App-.*"),
+        remotepb.LabelMatcher(remotepb.NEQ, "dc", "DC1"),
+    ])]
+    decoded = remotepb.decode_read_request(remotepb.encode_read_request(req))
+    assert decoded == req
+
+
+def test_prompb_response_roundtrip():
+    ts = remotepb.PromTimeSeries(
+        labels=[("__name__", "m"), ("app", "a")],
+        samples=[(1.5, START), (float("nan"), START + 1000), (-2.25, START + 2000)])
+    out = remotepb.decode_read_response(
+        remotepb.encode_read_response([[ts]]))
+    assert len(out) == 1 and len(out[0]) == 1
+    got = out[0][0]
+    assert got.labels == ts.labels
+    assert got.samples[0] == (1.5, START)
+    assert np.isnan(got.samples[1][0]) and got.samples[1][1] == START + 1000
+    assert got.samples[2] == (-2.25, START + 2000)
+
+
+def test_prompb_negative_int64():
+    req = [remotepb.PromQuery(-5, -1, [])]
+    assert remotepb.decode_read_request(
+        remotepb.encode_read_request(req)) == req
+
+
+# ----------------------------------------------------------------- endpoint
+
+@pytest.fixture()
+def api():
+    from filodb_tpu.core.memstore import TimeSeriesMemStore
+    from filodb_tpu.http.routes import PromHttpApi
+    from filodb_tpu.ingest.generator import counter_batch
+    from filodb_tpu.query.engine import QueryEngine
+    ms = TimeSeriesMemStore()
+    ms.setup("prometheus", 0)
+    batch = counter_batch(12, 50, start_ms=START)
+    ms.ingest("prometheus", 0, batch, offset=1)
+    eng = QueryEngine("prometheus", ms)
+    return PromHttpApi({"prometheus": eng}), batch
+
+
+def _read(api_obj, queries):
+    body = snappy.compress(remotepb.encode_read_request(queries))
+    status, payload = api_obj.handle("POST", "/api/v1/read", {}, body)
+    assert status == 200, payload
+    assert isinstance(payload, bytes)
+    return remotepb.decode_read_response(snappy.decompress(payload))
+
+
+def test_remote_read_returns_raw_samples(api):
+    api_obj, batch = api
+    q = remotepb.PromQuery(START, START + 500_000, [
+        remotepb.LabelMatcher(remotepb.EQ, "__name__", "request_total"),
+        remotepb.LabelMatcher(remotepb.EQ, "_ns_", "App-3"),
+    ])
+    results = _read(api_obj, [q])
+    assert len(results) == 1
+    series = results[0]
+    assert series, "no series returned"
+    for ts in series:
+        labels = dict(ts.labels)
+        assert labels["__name__"] == "request_total"
+        assert labels["_ns_"] == "App-3"
+        # locate the source series in the batch and compare raw samples
+        target = None
+        for i, pk in enumerate(batch.part_keys):
+            pkl = dict(pk.tags)
+            if (pk.metric == "request_total"
+                    and all(pkl.get(k) == v for k, v in labels.items()
+                            if k != "__name__")):
+                target = i
+                break
+        assert target is not None, labels
+        sel = batch.part_idx == target
+        want_ts = batch.timestamps[sel]
+        want_v = batch.columns["count"][sel]
+        got_ts = np.array([t for _, t in ts.samples])
+        got_v = np.array([v for v, _ in ts.samples])
+        np.testing.assert_array_equal(got_ts, want_ts)
+        np.testing.assert_allclose(got_v, want_v, rtol=1e-12)
+
+
+def test_remote_read_time_range_clipping(api):
+    api_obj, batch = api
+    lo, hi = START + 100_000, START + 200_000
+    q = remotepb.PromQuery(lo, hi, [
+        remotepb.LabelMatcher(remotepb.EQ, "__name__", "request_total")])
+    results = _read(api_obj, [q])
+    assert results[0]
+    for ts in results[0]:
+        for _, t in ts.samples:
+            assert lo <= t <= hi
+
+
+def test_remote_read_regex_and_neq_matchers(api):
+    api_obj, _ = api
+    q = remotepb.PromQuery(START, START + 500_000, [
+        remotepb.LabelMatcher(remotepb.EQ, "__name__", "request_total"),
+        remotepb.LabelMatcher(remotepb.RE, "_ns_", "App-[12]"),
+        remotepb.LabelMatcher(remotepb.NEQ, "_ns_", "App-2"),
+    ])
+    results = _read(api_obj, [q])
+    ns = {dict(ts.labels)["_ns_"] for ts in results[0]}
+    assert ns == {"App-1"}
+
+
+def test_remote_read_multiple_queries(api):
+    api_obj, _ = api
+    qs = [remotepb.PromQuery(START, START + 500_000, [
+              remotepb.LabelMatcher(remotepb.EQ, "__name__", "request_total"),
+              remotepb.LabelMatcher(remotepb.EQ, "_ns_", f"App-{i}")])
+          for i in (1, 2)]
+    results = _read(api_obj, qs)
+    assert len(results) == 2
+    assert all(r for r in results)
+
+
+def test_remote_read_bad_payload_is_400(api):
+    api_obj, _ = api
+    status, payload = api_obj.handle("POST", "/api/v1/read", {}, b"not snappy")
+    assert status == 400
