@@ -157,6 +157,42 @@ class DenseSeriesStore:
                 self.num_buckets = num_buckets
                 self.bucket_les = None if les is None else np.asarray(les, float)
 
+    def ensure_scheme(self, num_buckets: int,
+                      les: Optional[np.ndarray]) -> bool:
+        """Adopt or widen the store's bucket scheme for incoming data with
+        (num_buckets, les).  A scheme CHANGE widens the store to the union
+        of boundaries and rebuckets resident data, instead of crashing the
+        write or dropping chunks (ref: HistogramBuckets.scala:340 scheme
+        evolution).  Returns True when the incoming payload itself must be
+        rebucketed to the (possibly widened) store scheme before writing."""
+        if not any(c.col_type == "hist" for c in self.schema.data_columns):
+            return False
+        self._ensure_hist(num_buckets, les)
+        if les is None or self.bucket_les is None:
+            # width-only information: identical widths are assumed to be the
+            # same scheme (legacy callers); mismatched widths cannot be
+            # mapped without boundaries
+            if num_buckets != self.num_buckets:
+                raise ValueError(
+                    f"histogram width changed {self.num_buckets} -> "
+                    f"{num_buckets} with no bucket boundaries to re-map by")
+            return False
+        inc = np.asarray(les, np.float64)
+        if inc.shape[0] == self.num_buckets \
+                and np.array_equal(inc, self.bucket_les):
+            return False
+        from filodb_tpu.memory.histogram import rebucket, union_les
+        union = union_les(self.bucket_les, inc)
+        if not np.array_equal(union, self.bucket_les):
+            with self.mutation():       # nest-safe under an ongoing append
+                for c in self.schema.data_columns:
+                    if c.col_type == "hist" and self.cols[c.name] is not None:
+                        self.cols[c.name] = rebucket(
+                            self.cols[c.name], self.bucket_les, union)
+                self.bucket_les = union
+                self.num_buckets = len(union)
+        return not np.array_equal(inc, self.bucket_les)
+
     # ---- ingest ----
 
     def append_batch(self, rows: np.ndarray, ts: np.ndarray,
@@ -228,7 +264,11 @@ class DenseSeriesStore:
             hist_col = next(c.name for c in self.schema.data_columns
                             if c.col_type == "hist")
             nb = columns[hist_col].shape[1] if columns[hist_col].ndim == 2 else 0
-            self._ensure_hist(nb, bucket_les)
+            if self.ensure_scheme(nb, bucket_les):
+                from filodb_tpu.memory.histogram import rebucket
+                columns = {**columns,
+                           hist_col: rebucket(columns[hist_col],
+                                              bucket_les, self.bucket_les)}
 
         need_t = int(pos.max()) + 1
         if need_t > self._t_cap:

@@ -424,19 +424,34 @@ class TimeSeriesShard:
         """Decode + concatenate chunk data with ts in (lo_excl, hi_incl],
         dropping overlaps and bucket-scheme-mismatched histogram chunks."""
         from filodb_tpu.memory.chunks import decode_chunkset
+        from filodb_tpu.memory.histogram import rebucket
+        hist_cols = {c.name for c in store.schema.data_columns
+                     if c.col_type == "hist"}
         ts_parts, col_parts = [], []
         for cs in sorted(chunks, key=lambda c: c.info.start_time_ms):
+            chunk_les = None
             if cs.bucket_scheme is not None:
-                if store.num_buckets == 0:
-                    store._ensure_hist(cs.bucket_scheme.num_buckets,
-                                       cs.bucket_scheme.as_array())
-                elif cs.bucket_scheme.num_buckets != store.num_buckets:
-                    # scheme changed across the chunk's lifetime; a dense row
-                    # has one width — skip rather than crash the query
-                    # (ref: HistogramBuckets scheme-change handling)
+                chunk_les = cs.bucket_scheme.as_array()
+                # widen the store to the union of schemes if the chunk was
+                # written under different boundaries, then rebucket the
+                # decoded payload onto the store scheme — a scheme change
+                # mid-retention stays queryable instead of dropping chunks
+                # (ref: HistogramBuckets.scala:340 scheme evolution)
+                try:
+                    store.ensure_scheme(cs.bucket_scheme.num_buckets,
+                                        chunk_les)
+                except ValueError:
+                    # boundary-less store of a different width: no mapping
+                    # exists — degrade to skipping this chunk, not failing
+                    # the whole query
                     self.stats.rows_dropped += cs.info.num_rows
                     continue
             decoded = decode_chunkset(cs)
+            if chunk_les is not None and store.bucket_les is not None \
+                    and not np.array_equal(chunk_les, store.bucket_les):
+                decoded = {k: (rebucket(v, chunk_les, store.bucket_les)
+                               if k in hist_cols else v)
+                           for k, v in decoded.items()}
             ts = decoded.pop("timestamp")
             keep = (ts > lo_excl) & (ts <= hi_incl)
             if ts_parts:

@@ -51,35 +51,13 @@ class PromTimeSeries:
 
 # ------------------------------------------------------------ primitives
 
-def _uvarint(n: int) -> bytes:
-    out = bytearray()
-    while True:
-        b = n & 0x7F
-        n >>= 7
-        if n:
-            out.append(b | 0x80)
-        else:
-            out.append(b)
-            return bytes(out)
+from filodb_tpu.utils.varint import (read_uvarint as _read_uvarint,  # noqa: E402
+                                     write_uvarint as _uvarint)
 
 
 def _varint64(n: int) -> bytes:
     """int64 as protobuf varint (negatives use 64-bit two's complement)."""
     return _uvarint(n & 0xFFFFFFFFFFFFFFFF)
-
-
-def _read_uvarint(data: bytes, pos: int) -> Tuple[int, int]:
-    result = 0
-    shift = 0
-    while True:
-        b = data[pos]
-        pos += 1
-        result |= (b & 0x7F) << shift
-        if not b & 0x80:
-            return result, pos
-        shift += 7
-        if shift > 70:
-            raise ValueError("varint too long")
 
 
 def _to_int64(u: int) -> int:

@@ -50,6 +50,30 @@ def default_buckets(num: int = 8) -> HistogramBuckets:
     return HistogramBuckets.geometric(2.0, 2.0, num, inf_bucket=False)
 
 
+def union_les(a: np.ndarray, b: np.ndarray) -> np.ndarray:
+    """Union of two bucket schemes: sorted unique le boundaries.  The widened
+    scheme every source can be mapped onto (ref: HistogramBuckets.scala:340
+    scheme-change handling — queries spanning a scheme change evaluate on a
+    common scheme instead of failing)."""
+    return np.union1d(np.asarray(a, np.float64), np.asarray(b, np.float64))
+
+
+def rebucket(mat: np.ndarray, src_les: np.ndarray,
+             dst_les: np.ndarray) -> np.ndarray:
+    """Map cumulative bucket counts [..., B_src] onto dst_les [..., B_dst].
+
+    Buckets are cumulative (CDF samples at le boundaries), so the value at a
+    destination boundary is the source CDF at the smallest source le >= that
+    boundary — exact where boundaries coincide, and the tightest monotone
+    upper bound at boundaries the source scheme never measured.  A dst le
+    above every source le takes the topmost bucket (the +Inf total)."""
+    src = np.asarray(src_les, np.float64)
+    dst = np.asarray(dst_les, np.float64)
+    idx = np.searchsorted(src, dst, side="left")
+    idx = np.minimum(idx, len(src) - 1)
+    return np.asarray(mat)[..., idx]
+
+
 def encode_hist_matrix(mat: np.ndarray) -> bytes:
     """Encode a [time, buckets] cumulative-count matrix.
 

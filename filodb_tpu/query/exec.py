@@ -75,6 +75,10 @@ class AggPartial:
     cand_groups: Optional[np.ndarray] = None  # int [N] -> group_keys index
     params: Tuple = ()
     bucket_les: Optional[np.ndarray] = None  # hist_sum partials
+    # quantile(): mergeable centroid sketch [G, W, K, 2] — O(groups) wire
+    # cost instead of shipping every candidate series row
+    # (ref: QuantileRowAggregator.scala:87 t-digest partials)
+    sketch: Optional[np.ndarray] = None
 
 
 Data = Union[RawBlock, ResultBlock, ScalarResult, AggPartial, None]
@@ -273,7 +277,7 @@ def _group_ids(keys: Sequence[RangeVectorKey], by: Tuple[str, ...],
     return gids, gkeys
 
 
-_CANDIDATE_OPS = {"topk", "bottomk", "quantile", "count_values"}
+_CANDIDATE_OPS = {"topk", "bottomk", "count_values"}
 
 
 @dataclasses.dataclass
@@ -312,7 +316,12 @@ class AggregateMapReduce(RangeVectorTransformer):
             np.add.at(agg[..., B], gids, present.any(axis=2).astype(float))
             return AggPartial("hist_sum", gkeys, data.wends, comp=agg,
                               params=self.params, bucket_les=data.bucket_les)
-        if self.op in _CANDIDATE_OPS:
+        if self.op == "quantile" and vals.ndim == 2:
+            from filodb_tpu.ops import sketch as sketch_ops
+            sk = sketch_ops.sketch_from_values(vals, gids, len(gkeys))
+            return AggPartial(self.op, gkeys, data.wends, sketch=sk,
+                              params=self.params)
+        if self.op in _CANDIDATE_OPS or self.op == "quantile":
             cand_keys, cand_vals, cand_groups = self._candidates(
                 data, vals, gids, len(gkeys))
             return AggPartial(self.op, gkeys, data.wends, cand_keys=cand_keys,
@@ -356,6 +365,11 @@ class AggregatePresenter(RangeVectorTransformer):
 
 def present_partial(p: AggPartial) -> Optional[ResultBlock]:
     """Finish an AggPartial into a ResultBlock."""
+    if p.sketch is not None:
+        from filodb_tpu.ops import sketch as sketch_ops
+        q = float(p.params[0])
+        out = sketch_ops.sketch_quantile(p.sketch, q)
+        return ResultBlock(p.group_keys, p.wends, out)
     if p.comp is not None:
         if p.op == "hist_sum":
             # [G, W, B+1] with present-series count in the last slot
@@ -403,6 +417,49 @@ def present_partial(p: AggPartial) -> Optional[ResultBlock]:
     raise ValueError(p.op)
 
 
+def _union_scheme(les_list: List[Optional[np.ndarray]]) -> Optional[np.ndarray]:
+    """Union bucket scheme across shards, or None when any shard carries no
+    boundaries (widths must then match — checked by the caller's reshape)."""
+    from filodb_tpu.memory.histogram import union_les
+    known = [l for l in les_list if l is not None]
+    if len(known) != len(les_list):
+        return None
+    out = known[0]
+    for l in known[1:]:
+        out = union_les(out, l)
+    return out
+
+
+def _align_hist_schemes(parts: List[AggPartial]) -> List[AggPartial]:
+    """Rebucket hist_sum partials onto the union scheme so shards whose
+    series changed bucket scheme mid-retention still merge
+    (ref: HistogramBuckets.scala:340; replaces the fail-loudly behavior)."""
+    from filodb_tpu.memory.histogram import rebucket
+    les_list = [p.bucket_les for p in parts]
+    if any(l is None for l in les_list):
+        # boundary-less partials can only merge by width (legacy behavior);
+        # order of children must not matter
+        widths = {p.comp.shape[-1] for p in parts}
+        if len(widths) > 1:
+            raise ValueError(
+                "cannot merge histogram partials of different widths with "
+                "no bucket boundaries to re-map by")
+        return parts
+    if all(np.array_equal(l, les_list[0]) for l in les_list):
+        return parts
+    union = _union_scheme(les_list)
+
+    def _rebucket_comp(p):
+        # comp is [G, W, B+1]: B bucket slots + the present-series count
+        B = len(p.bucket_les)
+        buckets = rebucket(p.comp[..., :B], p.bucket_les, union)
+        return np.concatenate([buckets, p.comp[..., B:]], axis=-1)
+
+    return [dataclasses.replace(p, comp=_rebucket_comp(p), bucket_les=union)
+            if not np.array_equal(p.bucket_les, union) else p
+            for p in parts]
+
+
 def reduce_partials(parts: List[AggPartial]) -> Optional[AggPartial]:
     """Inter-shard reduce (ReduceAggregateExec): merge partials by group key."""
     parts = [p for p in parts if p is not None]
@@ -410,17 +467,7 @@ def reduce_partials(parts: List[AggPartial]) -> Optional[AggPartial]:
         return None
     op = parts[0].op
     if op == "hist_sum":
-        # bucket-index-wise merge is only valid for identical bucket schemes;
-        # cross-scheme re-bucketing is not implemented — fail loudly rather
-        # than sum mismatched buckets (ref: HistogramBuckets scheme changes)
-        first_les = parts[0].bucket_les
-        for p in parts[1:]:
-            if (p.comp.shape[-1] != parts[0].comp.shape[-1]
-                    or (first_les is not None and p.bucket_les is not None
-                        and not np.array_equal(first_les, p.bucket_les))):
-                raise ValueError(
-                    "cannot merge histogram partials with different bucket "
-                    "schemes across shards")
+        parts = _align_hist_schemes(parts)
     gmap: Dict[RangeVectorKey, int] = {}
     gkeys: List[RangeVectorKey] = []
     for p in parts:
@@ -429,6 +476,24 @@ def reduce_partials(parts: List[AggPartial]) -> Optional[AggPartial]:
                 gmap[k] = len(gkeys)
                 gkeys.append(k)
     wends = parts[0].wends
+    if parts[0].sketch is not None:
+        # quantile sketches: concat centroid axis per group (zero-weight
+        # padding for shards that lack a group), then re-compress to K
+        from filodb_tpu.ops import sketch as sketch_ops
+        G = len(gkeys)
+        W = parts[0].sketch.shape[1]
+        M = sum(p.sketch.shape[2] for p in parts)
+        cat = np.zeros((G, W, M, 2))
+        cat[..., 0] = np.nan
+        off = 0
+        for p in parts:
+            idx = np.asarray([gmap[k] for k in p.group_keys], dtype=np.int64)
+            m = p.sketch.shape[2]
+            cat[idx, :, off:off + m] = p.sketch
+            off += m
+        return AggPartial(op, gkeys, wends,
+                          sketch=sketch_ops.merge_sketches(cat),
+                          params=parts[0].params)
     if parts[0].comp is not None:
         C = parts[0].comp.shape[-1]
         W = parts[0].comp.shape[1]
@@ -880,14 +945,33 @@ class DistConcatExec(NonLeafExecPlan):
         blocks = [r for r in results if isinstance(r, ResultBlock)]
         raws = [r for r in results if isinstance(r, RawBlock)]
         if raws:
-            # raw blocks concat only if same grid/base — planner guarantees
+            # raw blocks concat only if same grid/base — planner guarantees.
+            # Cross-shard bucket-scheme drift is resolved by rebucketing
+            # every block onto the union scheme (HistogramBuckets.scala:340)
             les0 = raws[0].bucket_les
-            for r in raws[1:]:
-                if (r.bucket_les is None) != (les0 is None) or (
-                        les0 is not None and r.bucket_les is not None
-                        and not np.array_equal(les0, r.bucket_les)):
-                    raise ValueError("cannot concat histogram blocks with "
-                                     "different bucket schemes across shards")
+            if any((r.bucket_les is None) != (les0 is None) or (
+                    les0 is not None and r.bucket_les is not None
+                    and not np.array_equal(les0, r.bucket_les))
+                   for r in raws[1:]):
+                union = _union_scheme([r.bucket_les for r in raws])
+                if union is None:
+                    raise ValueError(
+                        "cannot concat histogram blocks: some shards carry "
+                        "no bucket boundaries")
+                from filodb_tpu.memory.histogram import rebucket
+                raws = [dataclasses.replace(
+                            r,
+                            values=rebucket(np.asarray(r.values),
+                                            r.bucket_les, union),
+                            vbase=(rebucket(np.asarray(r.vbase),
+                                            r.bucket_les, union)
+                                   if r.vbase is not None
+                                   and np.asarray(r.vbase).ndim == 2
+                                   else r.vbase),
+                            bucket_les=union)
+                        if not np.array_equal(r.bucket_les, union) else r
+                        for r in raws]
+                les0 = union
             keys = []
             for r in raws:
                 keys.extend(r.keys)

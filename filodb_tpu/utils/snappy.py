@@ -16,31 +16,8 @@ environment, so this implements the block format
 """
 from __future__ import annotations
 
-
-def _read_uvarint(data: bytes, pos: int) -> tuple:
-    result = 0
-    shift = 0
-    while True:
-        b = data[pos]
-        pos += 1
-        result |= (b & 0x7F) << shift
-        if not b & 0x80:
-            return result, pos
-        shift += 7
-        if shift > 63:
-            raise ValueError("uvarint too long")
-
-
-def _write_uvarint(n: int) -> bytes:
-    out = bytearray()
-    while True:
-        b = n & 0x7F
-        n >>= 7
-        if n:
-            out.append(b | 0x80)
-        else:
-            out.append(b)
-            return bytes(out)
+from filodb_tpu.utils.varint import (read_uvarint as _read_uvarint,
+                                     write_uvarint as _write_uvarint)
 
 
 def decompress(data: bytes) -> bytes:

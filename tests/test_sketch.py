@@ -1,0 +1,133 @@
+"""Quantile sketch partials (ref: QuantileRowAggregator.scala:87 t-digest).
+
+Exact when a (group, window) cell holds <= K samples; bounded-error and
+mergeable beyond that; O(groups) wire size regardless of series count.
+"""
+import numpy as np
+import pytest
+
+from filodb_tpu.ops.sketch import (K_DEFAULT, merge_sketches, sketch_quantile,
+                                   sketch_from_values)
+
+
+def _prom_quantile(xs, q):
+    xs = np.asarray(xs, float)
+    xs = xs[~np.isnan(xs)]
+    if xs.size == 0:
+        return np.nan
+    return np.quantile(xs, q, method="linear")
+
+
+@pytest.mark.parametrize("q", [0.0, 0.25, 0.5, 0.9, 1.0])
+def test_exact_under_k_samples(q):
+    rng = np.random.default_rng(1)
+    vals = rng.normal(10, 4, size=(40, 6))
+    vals[rng.random(vals.shape) < 0.15] = np.nan
+    gids = (np.arange(40) % 3).astype(np.int64)
+    sk = sketch_from_values(vals, gids, 3)
+    out = sketch_quantile(sk, q)
+    for g in range(3):
+        for w in range(6):
+            want = _prom_quantile(vals[gids == g, w], q)
+            got = out[g, w]
+            if np.isnan(want):
+                assert np.isnan(got)
+            else:
+                np.testing.assert_allclose(got, want, rtol=1e-12, atol=1e-12)
+
+
+def test_merge_exact_under_k_total():
+    rng = np.random.default_rng(2)
+    a = rng.normal(0, 1, size=(20, 4))
+    b = rng.normal(5, 2, size=(25, 4))
+    gids_a = np.zeros(20, dtype=np.int64)
+    gids_b = np.zeros(25, dtype=np.int64)
+    sa = sketch_from_values(a, gids_a, 1)
+    sb = sketch_from_values(b, gids_b, 1)
+    merged = merge_sketches(np.concatenate([sa, sb], axis=2))
+    out = sketch_quantile(merged, 0.75)
+    want = [_prom_quantile(np.concatenate([a[:, w], b[:, w]]), 0.75)
+            for w in range(4)]
+    np.testing.assert_allclose(out[0], want, rtol=1e-12)
+
+
+def test_bounded_error_at_scale():
+    rng = np.random.default_rng(3)
+    N = 20_000
+    vals = rng.normal(100, 15, size=(N, 3))
+    gids = np.zeros(N, dtype=np.int64)
+    sk = sketch_from_values(vals, gids, 1)
+    assert sk.shape == (1, 3, K_DEFAULT, 2)
+    for q in (0.1, 0.5, 0.9):
+        got = sketch_quantile(sk, q)[0]
+        want = np.quantile(vals, q, axis=0)
+        # equal-depth bins: rank error <= 1/K of the population
+        np.testing.assert_allclose(got, want, rtol=0.02)
+
+
+def test_merge_bounded_error_many_shards():
+    rng = np.random.default_rng(4)
+    per_shard = [rng.exponential(7.0, size=(5_000, 2)) for _ in range(8)]
+    sketches = [sketch_from_values(v, np.zeros(len(v), np.int64), 1)
+                for v in per_shard]
+    merged = merge_sketches(np.concatenate(sketches, axis=2))
+    assert merged.shape[2] == K_DEFAULT
+    allv = np.concatenate(per_shard, axis=0)
+    for q in (0.5, 0.95):
+        got = sketch_quantile(merged, q)[0]
+        want = np.quantile(allv, q, axis=0)
+        np.testing.assert_allclose(got, want, rtol=0.05)
+
+
+def test_high_quantile_with_dead_centroids():
+    """Weight>1 centroids + padded weight-0 slots (the post-merge shape)
+    must not turn q=1.0 / q=0.9 into NaN."""
+    sk = np.zeros((1, 1, 3, 2))
+    sk[0, 0, :, 0] = [10.0, 20.0, np.nan]
+    sk[0, 0, :, 1] = [5.0, 5.0, 0.0]
+    assert sketch_quantile(sk, 1.0)[0, 0] == 20.0
+    assert 10.0 <= sketch_quantile(sk, 0.9)[0, 0] <= 20.0
+    # merge of a 65-sample shard with a 1-sample shard
+    rng = np.random.default_rng(7)
+    big = sketch_from_values(rng.normal(0, 1, size=(65, 1)),
+                             np.zeros(65, np.int64), 1)
+    small = sketch_from_values(np.full((1, 1), 99.0), np.zeros(1, np.int64), 1)
+    merged = merge_sketches(np.concatenate([big, small], axis=2))
+    assert np.isfinite(sketch_quantile(merged, 1.0)[0, 0])
+
+
+def test_out_of_range_q():
+    vals = np.ones((5, 2))
+    sk = sketch_from_values(vals, np.zeros(5, np.int64), 1)
+    assert (sketch_quantile(sk, 1.5) == np.inf).all()
+    assert (sketch_quantile(sk, -0.5) == -np.inf).all()
+
+
+def test_cross_shard_quantile_wire_cost_is_o_groups():
+    """The reduce input/output for quantile() must be sketch-sized, not
+    candidate-row-sized."""
+    from filodb_tpu.query.exec import (AggregateMapReduce, ResultBlock,
+                                       reduce_partials)
+    from filodb_tpu.query.rangevector import (QueryContext, QueryStats,
+                                              RangeVectorKey)
+    S, W = 500, 7
+    rng = np.random.default_rng(5)
+    wends = np.arange(W, dtype=np.int64)
+    partials = []
+    for shard in range(3):
+        keys = [RangeVectorKey.make({"_ns_": f"App-{i % 2}",
+                                     "instance": f"s{shard}-{i}"})
+                for i in range(S)]
+        block = ResultBlock(keys, wends, rng.normal(0, 1, size=(S, W)))
+        p = AggregateMapReduce("quantile", params=(0.9,), by=("_ns_",)).apply(
+            block, QueryContext(), QueryStats())
+        assert p.sketch is not None and p.cand_vals is None
+        assert p.sketch.shape == (2, W, K_DEFAULT, 2)   # groups, not series
+        partials.append(p)
+    merged = reduce_partials(partials)
+    assert merged.sketch.shape == (2, W, K_DEFAULT, 2)
+    from filodb_tpu.query.exec import present_partial
+    out = present_partial(merged)
+    assert out.values.shape == (2, W)
+    # sanity: close to the exact quantile over all 1500 series per group
+    assert np.isfinite(out.values).all()
