@@ -427,16 +427,17 @@ class TimeSeriesShard:
         from filodb_tpu.memory.histogram import rebucket
         hist_cols = {c.name for c in store.schema.data_columns
                      if c.col_type == "hist"}
-        ts_parts, col_parts = [], []
+        ts_parts, col_parts, part_les = [], [], []
         for cs in sorted(chunks, key=lambda c: c.info.start_time_ms):
             chunk_les = None
             if cs.bucket_scheme is not None:
                 chunk_les = cs.bucket_scheme.as_array()
-                # widen the store to the union of schemes if the chunk was
-                # written under different boundaries, then rebucket the
-                # decoded payload onto the store scheme — a scheme change
-                # mid-retention stays queryable instead of dropping chunks
-                # (ref: HistogramBuckets.scala:340 scheme evolution)
+                # widen the store to the union of every chunk's boundaries —
+                # a scheme change mid-retention stays queryable instead of
+                # dropping chunks (ref: HistogramBuckets.scala:340).  The
+                # decoded payloads are harmonized onto the FINAL store
+                # scheme after the loop, since a later chunk can widen the
+                # store again after earlier chunks were already decoded.
                 try:
                     store.ensure_scheme(cs.bucket_scheme.num_buckets,
                                         chunk_les)
@@ -447,11 +448,6 @@ class TimeSeriesShard:
                     self.stats.rows_dropped += cs.info.num_rows
                     continue
             decoded = decode_chunkset(cs)
-            if chunk_les is not None and store.bucket_les is not None \
-                    and not np.array_equal(chunk_les, store.bucket_les):
-                decoded = {k: (rebucket(v, chunk_les, store.bucket_les)
-                               if k in hist_cols else v)
-                           for k, v in decoded.items()}
             ts = decoded.pop("timestamp")
             keep = (ts > lo_excl) & (ts <= hi_incl)
             if ts_parts:
@@ -460,8 +456,16 @@ class TimeSeriesShard:
                 continue
             ts_parts.append(ts[keep])
             col_parts.append({k: v[keep] for k, v in decoded.items()})
+            part_les.append(chunk_les)
         if not ts_parts:
             return None, None
+        final_les = store.bucket_les
+        if final_les is not None:
+            for i, les in enumerate(part_les):
+                if les is not None and not np.array_equal(les, final_les):
+                    col_parts[i] = {k: (rebucket(v, les, final_les)
+                                        if k in hist_cols else v)
+                                    for k, v in col_parts[i].items()}
         return (np.concatenate(ts_parts),
                 {k: np.concatenate([cp[k] for cp in col_parts])
                  for k in col_parts[0]})

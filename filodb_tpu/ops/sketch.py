@@ -29,8 +29,15 @@ def sketch_from_values(vals: np.ndarray, gids: np.ndarray, num_groups: int,
     N, W = vals.shape
     out = np.zeros((num_groups, W, k, 2))
     out[..., 0] = np.nan
+    # one stable sort, then contiguous slices per group — O(N log N) total
+    # instead of a full boolean mask per group (O(G*N))
+    order = np.argsort(gids, kind="stable")
+    sorted_gids = gids[order]
+    g_ids = np.arange(num_groups)
+    starts = np.searchsorted(sorted_gids, g_ids, side="left")
+    ends = np.searchsorted(sorted_gids, g_ids, side="right")
     for g in range(num_groups):
-        rows = vals[gids == g]                        # [n_g, W]
+        rows = vals[order[starts[g]:ends[g]]]         # [n_g, W]
         n_g = rows.shape[0]
         if n_g == 0:
             continue

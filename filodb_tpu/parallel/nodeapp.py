@@ -1,0 +1,139 @@
+"""Standalone cluster node process.
+
+The multi-process analogue of the reference's FiloDB standalone node
+(ref: standalone/.../FiloServer.scala + multi-jvm IngestionAndRecoverySpec):
+one process = memstore + query-plan server + cluster agent (register /
+heartbeat / assignment application with index recovery), plus a small
+framed-JSON control socket the test harness uses as its ingest feed (the
+Kafka-consumer stand-in: every node sees the full stream and ingests only
+the shards it owns).
+
+Run: python -m filodb_tpu.parallel.nodeapp --name A \
+         --coordinator 127.0.0.1:9999 --data-dir /tmp/filodb [--platform cpu]
+
+Prints one JSON line {"ready": true, "query_port": N, "control_port": N}
+on stdout once serving.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import socketserver
+import sys
+import threading
+
+
+def main(argv=None) -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--name", required=True)
+    ap.add_argument("--coordinator", required=True, help="host:port")
+    ap.add_argument("--data-dir", required=True)
+    ap.add_argument("--platform", default="",
+                    help="pin jax platform (e.g. cpu) BEFORE package import")
+    ap.add_argument("--heartbeat-interval", type=float, default=0.5)
+    args = ap.parse_args(argv)
+
+    if args.platform:
+        import jax
+        jax.config.update("jax_platforms", args.platform)
+
+    from filodb_tpu.core.memstore import TimeSeriesMemStore
+    from filodb_tpu.gateway.influx import influx_lines_to_batches
+    from filodb_tpu.gateway.router import split_batch_by_shard
+    from filodb_tpu.parallel.cluster import (ClusterClient, NodeAgent,
+                                             _recv_json, _send_json)
+    from filodb_tpu.parallel.shardmapper import SpreadProvider
+    from filodb_tpu.parallel.transport import NodeQueryServer
+    from filodb_tpu.persist.localstore import (LocalDiskColumnStore,
+                                               LocalDiskMetaStore)
+
+    host, port = args.coordinator.rsplit(":", 1)
+    coord_addr = (host, int(port))
+    column_store = LocalDiskColumnStore(args.data_dir)
+    meta_store = LocalDiskMetaStore(args.data_dir)
+    memstore = TimeSeriesMemStore(column_store=column_store,
+                                  meta_store=meta_store)
+    qsrv = NodeQueryServer(memstore).start()
+
+    def on_assign(dataset: str, shard: int) -> None:
+        sh = memstore.get_shard(dataset, shard) or \
+            memstore.setup(dataset, shard)
+        # recovery-by-replay: rebuild the index from persisted part keys;
+        # historical chunk data pages in on demand at query time
+        sh.recover_index()
+
+    agent = NodeAgent(args.name, coord_addr, qsrv.address, on_assign,
+                      heartbeat_interval_s=args.heartbeat_interval)
+    client = ClusterClient(coord_addr)
+    spread = SpreadProvider(default_spread=1)
+
+    class _Control(socketserver.BaseRequestHandler):
+        def handle(self):
+            try:
+                while True:
+                    req = _recv_json(self.request)
+                    try:
+                        reply = _control(req)
+                    except Exception as e:  # noqa: BLE001
+                        reply = {"ok": False,
+                                 "error": f"{type(e).__name__}: {e}"}
+                    _send_json(self.request, reply)
+            except (ConnectionError, OSError, json.JSONDecodeError):
+                return
+
+    def _control(req):
+        cmd = req.get("cmd")
+        if cmd == "ping":
+            return {"ok": True, "owned": agent.owned}
+        if cmd == "ingest_lines":
+            dataset = req.get("dataset", "prometheus")
+            mapper, _ = client.mapper(dataset)
+            n = 0
+            for batch in influx_lines_to_batches(req["lines"]):
+                routed = split_batch_by_shard(batch, mapper, spread)
+                for shard_num, sub in routed.items():
+                    sh = memstore.get_shard(dataset, shard_num)
+                    if sh is not None and \
+                            shard_num in agent.owned.get(dataset, []):
+                        n += sh.ingest(sub, offset=int(req.get("offset", -1)))
+            return {"ok": True, "ingested": n}
+        if cmd == "flush":
+            n = 0
+            for ds, shards in agent.owned.items():
+                for s in shards:
+                    sh = memstore.get_shard(ds, s)
+                    if sh is not None:
+                        n += sh.flush_all_groups()
+            return {"ok": True, "chunks": n}
+        if cmd == "stop":
+            threading.Thread(target=_shutdown, daemon=True).start()
+            return {"ok": True}
+        return {"ok": False, "error": f"unknown cmd {cmd!r}"}
+
+    class _Server(socketserver.ThreadingTCPServer):
+        allow_reuse_address = True
+        daemon_threads = True
+
+    ctrl = _Server(("127.0.0.1", 0), _Control)
+    stop_evt = threading.Event()
+
+    def _shutdown():
+        agent.stop()
+        qsrv.stop()
+        ctrl.shutdown()
+        stop_evt.set()
+
+    agent.start()
+    t = threading.Thread(target=ctrl.serve_forever, daemon=True)
+    t.start()
+    print(json.dumps({"ready": True, "query_port": qsrv.address[1],
+                      "control_port": ctrl.server_address[1],
+                      "node": args.name}), flush=True)
+    try:
+        stop_evt.wait()
+    except KeyboardInterrupt:
+        _shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -438,12 +438,16 @@ def _align_hist_schemes(parts: List[AggPartial]) -> List[AggPartial]:
     les_list = [p.bucket_les for p in parts]
     if any(l is None for l in les_list):
         # boundary-less partials can only merge by width (legacy behavior);
-        # order of children must not matter
+        # order of children must not matter — and any two KNOWN schemes
+        # that differ cannot be silently index-merged just because a third
+        # partial lacks boundaries
         widths = {p.comp.shape[-1] for p in parts}
-        if len(widths) > 1:
+        known = [l for l in les_list if l is not None]
+        if len(widths) > 1 or any(not np.array_equal(l, known[0])
+                                  for l in known[1:]):
             raise ValueError(
-                "cannot merge histogram partials of different widths with "
-                "no bucket boundaries to re-map by")
+                "cannot merge histogram partials of different schemes when "
+                "some shards carry no bucket boundaries to re-map by")
         return parts
     if all(np.array_equal(l, les_list[0]) for l in les_list):
         return parts

@@ -1,0 +1,186 @@
+"""Multi-process cluster end-to-end (ref: standalone/src/multi-jvm/
+IngestionAndRecoverySpec.scala, ClusterSingletonFailoverSpec.scala).
+
+Three REAL node processes join a coordinator, receive shard assignments,
+ingest the same stream (each keeping only its shards, the Kafka-partition
+stand-in), serve a cross-node scatter-gather query — then one node is
+SIGKILLed, the liveness monitor detects the death, shards reassign to the
+standby node, which recovers from the shared column store, and the query
+completes with full results again.
+"""
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from filodb_tpu.core.memstore import TimeSeriesMemStore
+from filodb_tpu.gateway.influx import influx_lines_to_batches
+from filodb_tpu.gateway.router import split_batch_by_shard
+from filodb_tpu.parallel.cluster import ClusterClient, ClusterCoordinator, _rpc
+from filodb_tpu.parallel.shardmanager import ShardManager
+from filodb_tpu.parallel.shardmapper import SpreadProvider
+from filodb_tpu.parallel.transport import RemoteNodeDispatcher
+from filodb_tpu.query.engine import QueryEngine
+from filodb_tpu.query.planner import SingleClusterPlanner
+
+START = 1_600_000_000_000
+NUM_SHARDS = 4
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _mk_lines(num_series=24, num_samples=90):
+    lines = []
+    for t in range(num_samples):
+        ts_ns = (START + t * 10_000) * 1_000_000
+        for i in range(num_series):
+            lines.append(
+                f"cluster_metric,_ws_=demo,_ns_=App-{i % 4},inst=i{i} "
+                f"value={t * 3.0 + i} {ts_ns}")
+    return lines
+
+
+def _spawn(name, coord_port, data_dir):
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "filodb_tpu.parallel.nodeapp",
+         "--name", name, "--coordinator", f"127.0.0.1:{coord_port}",
+         "--data-dir", str(data_dir), "--platform", "cpu",
+         "--heartbeat-interval", "0.3"],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        cwd=REPO, env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    box = {}
+
+    def _read():
+        box["line"] = proc.stdout.readline()
+
+    t = threading.Thread(target=_read, daemon=True)
+    t.start()
+    t.join(timeout=90)
+    if "line" not in box or not box["line"]:
+        proc.kill()
+        raise RuntimeError(f"node {name} failed to start: "
+                           f"{proc.stderr.read()[-2000:]}")
+    info = json.loads(box["line"])
+    assert info["ready"]
+    return proc, info
+
+
+def _wait_state(cli, pred, timeout_s=30.0, what="condition"):
+    deadline = time.time() + timeout_s
+    last = None
+    while time.time() < deadline:
+        last = cli.state()
+        if pred(last):
+            return last
+        time.sleep(0.3)
+    raise AssertionError(f"timed out waiting for {what}; last state: {last}")
+
+
+def _engine(cli):
+    mapper, addrs = cli.mapper("prometheus")
+    spread = SpreadProvider(default_spread=1)
+    planner = SingleClusterPlanner(
+        "prometheus", mapper, spread,
+        dispatcher_factory=lambda s: RemoteNodeDispatcher(
+            *addrs[mapper.node_for_shard(s)]))
+    return QueryEngine("prometheus", TimeSeriesMemStore(), mapper,
+                       planner=planner)
+
+
+def _query(cli, q):
+    res = _engine(cli).query_range(q, START // 1000 + 120, 60,
+                                   START // 1000 + 880)
+    assert res.error is None, res.error
+    return {str(k): np.asarray(v) for k, _, v in res.series()}
+
+
+def test_cluster_ingest_query_failover(tmp_path):
+    sm = ShardManager(reassignment_min_interval_s=0)
+    coord = ClusterCoordinator(sm, liveness_timeout_s=2.5,
+                               check_interval_s=0.3).start()
+    coord.setup_dataset("prometheus", NUM_SHARDS, min_num_nodes=2)
+    procs = []
+    try:
+        pa, ia = _spawn("A", coord.address[1], tmp_path)
+        procs.append(pa)
+        pb, ib = _spawn("B", coord.address[1], tmp_path)
+        procs.append(pb)
+        pc, ic = _spawn("C", coord.address[1], tmp_path)   # standby
+        procs.append(pc)
+        cli = ClusterClient(coord.address)
+
+        # A and B each own 2 shards and report them active; C is standby
+        st = _wait_state(
+            cli, lambda s: s["datasets"]["prometheus"]["statuses"]
+            == ["Active"] * NUM_SHARDS, what="all shards active")
+        owners = set(st["datasets"]["prometheus"]["nodes"])
+        assert owners == {"A", "B"}
+
+        # same stream to every node; each ingests only its shards
+        lines = _mk_lines()
+        for info in (ia, ib, ic):
+            r = _rpc(("127.0.0.1", info["control_port"]),
+                     {"cmd": "ingest_lines", "lines": lines, "offset": 1},
+                     timeout_s=120)
+            assert r["ok"], r
+        total = sum(
+            _rpc(("127.0.0.1", info["control_port"]), {"cmd": "ping"})["ok"]
+            for info in (ia, ib, ic))
+        assert total == 3
+
+        # ground truth: a local store ingesting the identical stream
+        truth = TimeSeriesMemStore()
+        t_mapper, _ = cli.mapper("prometheus")
+        spread = SpreadProvider(default_spread=1)
+        for s in range(NUM_SHARDS):
+            truth.setup("prometheus", s)
+        for batch in influx_lines_to_batches(lines):
+            for s, sub in split_batch_by_shard(batch, t_mapper,
+                                               spread).items():
+                truth.get_shard("prometheus", s).ingest(sub)
+        truth_eng = QueryEngine("prometheus", truth, t_mapper, spread)
+        want_res = truth_eng.query_range(
+            'sum by (_ns_)(cluster_metric{_ws_="demo"})',
+            START // 1000 + 120, 60, START // 1000 + 880)
+        want = {str(k): np.asarray(v) for k, _, v in want_res.series()}
+        assert len(want) == 4
+
+        got = _query(cli, 'sum by (_ns_)(cluster_metric{_ws_="demo"})')
+        assert set(got) == set(want)
+        for k in want:
+            np.testing.assert_allclose(got[k], want[k], rtol=1e-9,
+                                       equal_nan=True)
+
+        # persist everything, then kill node B without ceremony
+        for info in (ia, ib):
+            r = _rpc(("127.0.0.1", info["control_port"]), {"cmd": "flush"},
+                     timeout_s=120)
+            assert r["ok"], r
+        pb.kill()
+
+        # deathwatch: B leaves the member list, its shards land on C and
+        # come back Active after index recovery
+        def _failover_done(s):
+            ds = s["datasets"]["prometheus"]
+            return ("B" not in s["members"]
+                    and set(ds["nodes"]) == {"A", "C"}
+                    and ds["statuses"] == ["Active"] * NUM_SHARDS)
+        _wait_state(cli, _failover_done, timeout_s=60,
+                    what="failover to standby node C")
+
+        # the same query now scatter-gathers across A + C, paging B's
+        # flushed history from the shared column store
+        got2 = _query(cli, 'sum by (_ns_)(cluster_metric{_ws_="demo"})')
+        assert set(got2) == set(want)
+        for k in want:
+            np.testing.assert_allclose(got2[k], want[k], rtol=1e-9,
+                                       equal_nan=True)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        coord.stop()

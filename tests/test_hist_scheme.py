@@ -123,6 +123,58 @@ def test_paged_chunks_rebucket_after_scheme_change():
     assert sh2.stats.rows_dropped == 0
 
 
+LES_C = [3.0, 6.0, 12.0, 24.0, 48.0, float("inf")]
+
+
+def test_paged_chunks_from_two_old_schemes():
+    """History flushed under TWO different schemes (A then C), restart,
+    live ingest under B: page-in must harmonize every chunk onto the final
+    union scheme — a later chunk widening the store must not leave earlier
+    decoded chunks at a stale width."""
+    cs, meta = InMemoryColumnStore(), InMemoryMetaStore()
+    ms = TimeSeriesMemStore(column_store=cs, meta_store=meta)
+    sh = ms.setup("prometheus", 0)
+    sh.ingest(_hist_batch(2, 30, LES_A, t0=START), offset=1)
+    sh.flush_all_groups()
+    sh.ingest(_hist_batch(2, 30, LES_C, t0=START + 30 * 10_000, seed=8),
+              offset=2)
+    sh.flush_all_groups()
+
+    ms2 = TimeSeriesMemStore(column_store=cs, meta_store=meta)
+    sh2 = ms2.setup("prometheus", 0)
+    sh2.recover_index()
+    sh2.ingest(_hist_batch(2, 30, LES_B, t0=START + 60 * 10_000, seed=9),
+               offset=3)
+    eng = QueryEngine("prometheus", ms2)
+    s = START // 1000
+    res = eng.query_range(
+        'histogram_quantile(0.5, sum(rate(http_latency{_ws_="demo"}[5m])))',
+        s + 350, 60, s + 880)
+    assert res.error is None, res.error
+    vals = np.asarray(list(res.series())[0][2])
+    assert np.isfinite(vals[:3]).any(), "scheme-A history missing"
+    assert np.isfinite(vals[-3:]).any(), "scheme-B live data missing"
+    assert sh2.stats.rows_dropped == 0
+
+
+def test_mixed_none_and_unequal_schemes_raises():
+    """Two partials with DIFFERENT known schemes must not silently
+    index-merge just because a third partial lacks boundaries."""
+    from filodb_tpu.query.exec import AggPartial, reduce_partials
+    from filodb_tpu.query.rangevector import RangeVectorKey
+    wends = np.arange(3, dtype=np.int64)
+    k = [RangeVectorKey.make({"g": "x"})]
+    comp = np.ones((1, 3, 5))
+    a = AggPartial("hist_sum", k, wends, comp=comp.copy(),
+                   bucket_les=np.array([1.0, 2.0, 4.0, np.inf]))
+    b = AggPartial("hist_sum", k, wends, comp=comp.copy(),
+                   bucket_les=np.array([2.0, 4.0, 8.0, np.inf]))
+    c = AggPartial("hist_sum", k, wends, comp=comp.copy(), bucket_les=None)
+    for order in ([a, b, c], [c, a, b], [b, c, a]):
+        with pytest.raises(ValueError):
+            reduce_partials(order)
+
+
 def test_boundaryless_width_mismatch_degrades_not_crashes():
     """A width-mismatched chunk paged into a boundary-less store must skip
     that chunk (rows_dropped), not fail the query (legacy behavior)."""
