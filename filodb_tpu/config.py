@@ -1,9 +1,19 @@
-"""Typed runtime configuration.
+"""Typed, layered runtime configuration.
 
 Mirrors the reference's layered HOCON config (ref:
-core/src/main/resources/filodb-defaults.conf) with plain dataclasses.  Defaults
-below reproduce the reference's documented defaults (stale-sample lookback,
-sample limits, spread, flush groups, chunk sizing).
+core/src/main/resources/filodb-defaults.conf + FilodbSettings.scala:127 —
+defaults, then the deploy's config file, then system-property overrides,
+validated against the reference schema).  Here the layers are:
+
+    dataclass defaults  <-  config file (HOCON-lite .conf or .json,
+                            FILODB_TPU_CONFIG)  <-  environment variables
+                            (FILODB_QUERY_*, FILODB_STORE_*, FILODB_*)
+
+Every overlay is validated: unknown keys raise ConfigError with the full
+path, values are coerced to the field's declared type (HOCON-lite duration
+strings like "1h" convert by the field's _ms/_s suffix).  Dataset schemas
+may be declared in the file's `schemas` block (Schemas.from_config) exactly
+like the reference's `filodb.schemas` section.
 """
 from __future__ import annotations
 
@@ -11,6 +21,10 @@ import dataclasses
 import json
 import os
 from typing import Any, Dict, List, Optional, Tuple
+
+
+class ConfigError(ValueError):
+    pass
 
 
 @dataclasses.dataclass
@@ -70,27 +84,136 @@ class FilodbSettings:
     quota_default: int = 2_000_000_000
     reassignment_min_interval_s: float = 2 * 3600.0
 
+    # dataset schemas declared in config (None = built-in DEFAULT_SCHEMAS);
+    # populated by overlay from the file's `schemas` block
+    schemas: Optional[object] = None
+
     def spread_for(self, shard_key: Dict[str, str]) -> int:
         for a in self.spread_assignment:
             if all(shard_key.get(k) == v for k, v in a.shard_key.items()):
                 return a.spread
         return self.spread_default
 
+    # ------------------------------------------------------------- layering
+
+    def overlay(self, raw: Dict[str, Any], source: str = "config"
+                ) -> "FilodbSettings":
+        """Apply one config layer with validation.  Mutates and returns self."""
+        raw = dict(raw)
+        schemas_raw = {}
+        for sect in ("schemas", "partition_schema"):
+            if sect in raw:
+                schemas_raw[sect] = raw.pop(sect)
+        if schemas_raw:
+            from filodb_tpu.core.schemas import Schemas
+            try:
+                self.schemas = Schemas.from_config(schemas_raw)
+            except ValueError as e:
+                raise ConfigError(f"{source}: {e}")
+        for section, obj in (("query", self.query), ("store", self.store)):
+            for k, v in (raw.pop(section, None) or {}).items():
+                _set_field(obj, k, v, f"{source}: {section}.{k}")
+        if "spread_assignment" in raw:
+            entries = raw.pop("spread_assignment")
+            try:
+                self.spread_assignment = [
+                    SpreadAssignment(dict(a["shard_key"]), int(a["spread"]))
+                    for a in entries]
+            except (TypeError, KeyError, ValueError):
+                raise ConfigError(
+                    f"{source}: spread_assignment entries must be objects "
+                    "with 'shard_key' and 'spread' — declare them in a "
+                    ".json config (HOCON-lite does not parse object lists)")
+        for k, v in raw.items():
+            _set_field(self, k, v, f"{source}: {k}")
+        return self
+
+    @classmethod
+    def load(cls, path: Optional[str] = None,
+             env: Optional[Dict[str, str]] = None) -> "FilodbSettings":
+        """defaults <- file <- environment."""
+        s = cls()
+        if path:
+            if path.endswith(".json"):
+                with open(path) as f:
+                    raw = json.load(f)
+            else:
+                from filodb_tpu.utils import hoconlite
+                raw = hoconlite.load(path)
+                # allow the reference's `filodb { ... }` top-level wrapper
+                if set(raw) == {"filodb"}:
+                    raw = raw["filodb"]
+            s.overlay(raw, source=path)
+        env = os.environ if env is None else env
+        overlay: Dict[str, Any] = {}
+        top_fields = {f.name for f in dataclasses.fields(cls)}
+        for name, val in env.items():
+            if not name.startswith("FILODB_") or name == "FILODB_TPU_CONFIG":
+                continue
+            rest = name[len("FILODB_"):].lower()
+            # env values get the same scalar parsing as .conf files, so
+            # durations ("30 minutes") and booleans behave identically
+            from filodb_tpu.utils.hoconlite import _parse_scalar
+            parsed = _parse_scalar(val)
+            for section in ("query_", "store_"):
+                if rest.startswith(section):
+                    overlay.setdefault(section[:-1], {})[
+                        rest[len(section):]] = parsed
+                    break
+            else:
+                if rest in top_fields:
+                    overlay[rest] = parsed
+                # other FILODB_* vars (e.g. FILODB_BENCH_TPU_TIMEOUT) belong
+                # to sibling tools — not config keys, not typos: ignored
+        if overlay:
+            s.overlay(overlay, source="environment")
+        return s
+
     @classmethod
     def from_json(cls, path: str) -> "FilodbSettings":
-        with open(path) as f:
-            raw = json.load(f)
-        s = cls()
-        for k, v in raw.get("query", {}).items():
-            setattr(s.query, k, v)
-        for k, v in raw.get("store", {}).items():
-            setattr(s.store, k, v)
-        s.spread_default = raw.get("spread_default", s.spread_default)
-        s.spread_assignment = [
-            SpreadAssignment(a["shard_key"], a["spread"])
-            for a in raw.get("spread_assignment", [])
-        ]
-        return s
+        return cls.load(path)
+
+
+def _set_field(obj, key: str, value, where: str) -> None:
+    fields = {f.name: f for f in dataclasses.fields(obj)}
+    if key not in fields:
+        raise ConfigError(f"{where}: unknown setting "
+                          f"(valid: {sorted(fields)})")
+    setattr(obj, key, _coerce(value, getattr(obj, key), key, where))
+
+
+def _coerce(value, current, key: str, where: str):
+    from filodb_tpu.utils.hoconlite import Duration
+    if isinstance(value, Duration):
+        if key.endswith("_ms"):
+            return int(value.millis)
+        if key.endswith("_s"):
+            return float(value.seconds)
+        raise ConfigError(f"{where}: duration given for non-duration field")
+    want = type(current)
+    if isinstance(current, bool):
+        if isinstance(value, bool):
+            return value
+        if isinstance(value, str):
+            low = value.lower()
+            if low in ("true", "yes", "on", "1"):
+                return True
+            if low in ("false", "no", "off", "0"):
+                return False
+        raise ConfigError(f"{where}: expected a boolean, got {value!r}")
+    if isinstance(current, (int, float)) and not isinstance(current, bool):
+        try:
+            out = want(value)
+        except (TypeError, ValueError):
+            raise ConfigError(f"{where}: expected {want.__name__}, "
+                              f"got {value!r}")
+        if isinstance(current, int) and isinstance(value, float) \
+                and value != out:
+            raise ConfigError(f"{where}: expected an integer, got {value!r}")
+        return out
+    if current is None or isinstance(current, (str, list, dict)):
+        return value
+    return value
 
 
 def compute_dtype():
@@ -107,6 +230,5 @@ _SETTINGS: Optional[FilodbSettings] = None
 def settings() -> FilodbSettings:
     global _SETTINGS
     if _SETTINGS is None:
-        path = os.environ.get("FILODB_TPU_CONFIG")
-        _SETTINGS = FilodbSettings.from_json(path) if path else FilodbSettings()
+        _SETTINGS = FilodbSettings.load(os.environ.get("FILODB_TPU_CONFIG"))
     return _SETTINGS

@@ -16,12 +16,16 @@ from filodb_tpu.core.store import ColumnStore, MetaStore, NullColumnStore, InMem
 
 class TimeSeriesMemStore:
 
-    def __init__(self, schemas: Schemas = DEFAULT_SCHEMAS,
+    def __init__(self, schemas: Optional[Schemas] = None,
                  column_store: Optional[ColumnStore] = None,
                  meta_store: Optional[MetaStore] = None,
                  config: Optional[FilodbSettings] = None):
-        self.schemas = schemas
         self.config = config or default_settings()
+        # precedence: explicit arg > config-declared schemas > built-ins —
+        # so cluster nodes and servers pick up the config's schema block
+        # without per-call-site plumbing
+        self.schemas = (schemas if schemas is not None
+                        else (self.config.schemas or DEFAULT_SCHEMAS))
         self.column_store = column_store or NullColumnStore()
         self.meta_store = meta_store or InMemoryMetaStore()
         self._shards: Dict[str, Dict[int, TimeSeriesShard]] = {}

@@ -149,5 +149,86 @@ class Schemas:
     def default() -> "Schemas":
         return Schemas([GAUGE, UNTYPED, PROM_COUNTER, PROM_HISTOGRAM, DS_GAUGE])
 
+    @staticmethod
+    def from_config(raw: Dict) -> "Schemas":
+        """Build a validated schema registry from a config dict — the
+        config-declared schemas of ref: filodb-defaults.conf:58-113
+        `filodb.schemas` + Schemas.fromConfig validation.  Declared schemas
+        EXTEND the built-in set (same name overrides).  Raises ValueError
+        with the offending path on any invalid declaration."""
+        valid_types = {"ts", "double", "long", "hist", "string", "int"}
+        out = {s.name: s for s in
+               (GAUGE, UNTYPED, PROM_COUNTER, PROM_HISTOGRAM, DS_GAUGE)}
+        for name, spec in (raw.get("schemas") or {}).items():
+            if not isinstance(spec, dict):
+                raise ValueError(f"schemas.{name}: expected a block")
+            cols = []
+            for i, c in enumerate(spec.get("columns") or []):
+                # "name:type[:flag,...]" — the reference's "colname:type"
+                # column declaration form (filodb-defaults.conf:64)
+                parts = str(c).split(":")
+                if len(parts) < 2 or parts[1] not in valid_types:
+                    raise ValueError(
+                        f"schemas.{name}.columns[{i}]: {c!r} is not "
+                        f"'name:type' with type in {sorted(valid_types)}")
+                flags = set(parts[2].split(",")) if len(parts) > 2 else set()
+                unknown = flags - {"detect_drops", "counter"}
+                if unknown:
+                    raise ValueError(
+                        f"schemas.{name}.columns[{i}]: unknown flags "
+                        f"{sorted(unknown)}")
+                cols.append(Column(parts[0], parts[1],
+                                   detect_drops="detect_drops" in flags,
+                                   counter="counter" in flags))
+            if not cols or cols[0].col_type != "ts":
+                raise ValueError(
+                    f"schemas.{name}: first column must be the 'ts' column")
+            value_column = spec.get("value_column")
+            if value_column not in {c.name for c in cols}:
+                raise ValueError(
+                    f"schemas.{name}.value_column: {value_column!r} is not "
+                    f"a declared column")
+            unknown_keys = set(spec) - {"columns", "value_column",
+                                        "downsamplers",
+                                        "downsample_period_marker",
+                                        "downsample_schema"}
+            if unknown_keys:
+                raise ValueError(
+                    f"schemas.{name}: unknown keys {sorted(unknown_keys)}")
+            out[name] = Schema(
+                name, tuple(cols), value_column,
+                tuple(spec.get("downsamplers") or ()),
+                spec.get("downsample_period_marker", "time(0)"),
+                spec.get("downsample_schema"))
+        for s in out.values():
+            ds = s.downsample_schema
+            if ds is not None and ds not in out:
+                raise ValueError(
+                    f"schemas.{s.name}.downsample_schema: {ds!r} not defined")
+        part = PartitionSchema()
+        praw = raw.get("partition_schema") or {}
+        if praw:
+            unknown_top = set(praw) - {"options", "predefined_keys"}
+            if unknown_top:
+                raise ValueError(
+                    f"partition_schema: unknown keys {sorted(unknown_top)}")
+            opts_raw = praw.get("options") or {}
+            unknown = set(opts_raw) - {"metric_column", "shard_key_columns",
+                                       "ignore_tags_on_partition_key_hash"}
+            if unknown:
+                raise ValueError(
+                    f"partition_schema.options: unknown keys {sorted(unknown)}")
+            opts = PartitionSchemaOptions(
+                metric_column=opts_raw.get("metric_column", "_metric_"),
+                shard_key_columns=tuple(opts_raw.get(
+                    "shard_key_columns", ("_ws_", "_ns_", "_metric_"))),
+                ignore_tags_on_partition_key_hash=tuple(opts_raw.get(
+                    "ignore_tags_on_partition_key_hash", ("le",))))
+            part = PartitionSchema(
+                predefined_keys=tuple(praw.get(
+                    "predefined_keys", PartitionSchema().predefined_keys)),
+                options=opts)
+        return Schemas(list(out.values()), part)
+
 
 DEFAULT_SCHEMAS = Schemas.default()

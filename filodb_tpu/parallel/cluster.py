@@ -26,6 +26,7 @@ from __future__ import annotations
 
 import json
 import logging
+import queue
 import socket
 import socketserver
 import threading
@@ -240,9 +241,13 @@ class NodeAgent:
         self.on_assign = on_assign
         self.on_unassign = on_unassign
         self.heartbeat_interval_s = heartbeat_interval_s
-        self._owned: Dict[str, set] = {}       # dataset -> shard set
+        self._lock = threading.Lock()
+        self._owned: Dict[str, set] = {}       # dataset -> recovered shards
+        self._scheduled: set = set()           # (ds, shard) queued/recovering
+        self._assign_q: "queue.Queue" = queue.Queue()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        self._applier: Optional[threading.Thread] = None
         self.errors = 0
 
     def register(self) -> None:
@@ -254,20 +259,45 @@ class NodeAgent:
         self._apply(reply.get("assignments") or {})
 
     def _apply(self, assignments: Dict[str, List[int]]) -> None:
-        for ds, shards in assignments.items():
-            owned = self._owned.setdefault(ds, set())
-            for s in shards:
-                if s not in owned:
-                    self.on_assign(ds, int(s))
-                    owned.add(s)
-        for ds, owned in self._owned.items():
-            now = set(assignments.get(ds, []))
-            for s in sorted(owned - now):
-                if self.on_unassign is not None:
-                    self.on_unassign(ds, int(s))
-                owned.discard(s)
+        """Diff assignments; recovery work (on_assign) runs on the applier
+        thread so a long index recovery never starves heartbeats — the
+        coordinator's deathwatch must not declare a RECOVERING node dead."""
+        with self._lock:
+            for ds, shards in assignments.items():
+                for s in shards:
+                    key = (ds, int(s))
+                    if int(s) not in self._owned.get(ds, set()) \
+                            and key not in self._scheduled:
+                        self._scheduled.add(key)
+                        self._assign_q.put(key)
+            for ds, owned in self._owned.items():
+                now = set(assignments.get(ds, []))
+                for s in sorted(owned - now):
+                    if self.on_unassign is not None:
+                        self.on_unassign(ds, int(s))
+                    owned.discard(s)
+
+    def _applier_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                ds, s = self._assign_q.get(timeout=0.2)
+            except queue.Empty:
+                continue
+            try:
+                self.on_assign(ds, s)
+                with self._lock:
+                    self._owned.setdefault(ds, set()).add(s)
+            except Exception:  # noqa: BLE001
+                self.errors += 1
+                _log.exception("shard assignment failed: %s/%d", ds, s)
+            finally:
+                with self._lock:
+                    self._scheduled.discard((ds, s))
 
     def start(self) -> "NodeAgent":
+        self._applier = threading.Thread(target=self._applier_loop,
+                                         daemon=True)
+        self._applier.start()
         self.register()
         self._thread = threading.Thread(target=self._heartbeat_loop,
                                         daemon=True)
@@ -276,12 +306,14 @@ class NodeAgent:
 
     def stop(self) -> None:
         self._stop.set()
-        if self._thread:
-            self._thread.join(timeout=5)
+        for t in (self._thread, self._applier):
+            if t:
+                t.join(timeout=5)
 
     @property
     def owned(self) -> Dict[str, List[int]]:
-        return {ds: sorted(s) for ds, s in self._owned.items()}
+        with self._lock:
+            return {ds: sorted(s) for ds, s in self._owned.items()}
 
     def _heartbeat_loop(self) -> None:
         while not self._stop.wait(self.heartbeat_interval_s):

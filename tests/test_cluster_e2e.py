@@ -45,12 +45,16 @@ def _mk_lines(num_series=24, num_samples=90):
 
 
 def _spawn(name, coord_port, data_dir):
+    # stderr to a file, never a PIPE: an undrained pipe filling up would
+    # block the node's writes and stall heartbeats mid-test
+    errpath = os.path.join(str(data_dir), f"{name}.stderr")
+    errf = open(errpath, "w")
     proc = subprocess.Popen(
         [sys.executable, "-m", "filodb_tpu.parallel.nodeapp",
          "--name", name, "--coordinator", f"127.0.0.1:{coord_port}",
          "--data-dir", str(data_dir), "--platform", "cpu",
          "--heartbeat-interval", "0.3"],
-        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        stdout=subprocess.PIPE, stderr=errf, text=True,
         cwd=REPO, env={**os.environ, "JAX_PLATFORMS": "cpu"})
     box = {}
 
@@ -62,8 +66,9 @@ def _spawn(name, coord_port, data_dir):
     t.join(timeout=90)
     if "line" not in box or not box["line"]:
         proc.kill()
-        raise RuntimeError(f"node {name} failed to start: "
-                           f"{proc.stderr.read()[-2000:]}")
+        with open(errpath) as f:
+            tail = f.read()[-2000:]
+        raise RuntimeError(f"node {name} failed to start: {tail}")
     info = json.loads(box["line"])
     assert info["ready"]
     return proc, info
