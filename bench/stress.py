@@ -1,0 +1,201 @@
+"""Long-running stress/soak harnesses, assertion-checked.
+
+Ports of the reference's stress apps (ref: stress/src/main/scala/
+filodb.stress/ — IngestionStress.scala, InMemoryQueryStress.scala): keep
+the system under continuous load for minutes, verify invariants the unit
+suite can't (stable RSS under churn, no correctness drift under sustained
+concurrent ingest+query+flush), and print one JSON line per harness.
+
+Opt-in (not part of the driver's bench):
+    python -m bench.stress ingest --minutes 10
+    python -m bench.stress query  --minutes 10
+    python -m bench.stress all    --minutes 5
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import threading
+import time
+from typing import List
+
+
+def _rss_mb() -> float:
+    with open("/proc/self/statm") as f:
+        pages = int(f.read().split()[1])
+    return pages * os.sysconf("SC_PAGE_SIZE") / (1 << 20)
+
+
+def _emit(harness: str, ok: bool, **extra):
+    print(json.dumps({"stress": harness, "ok": ok, **extra}), flush=True)
+
+
+def ingestion_stress(minutes: float, series: int = 5_000) -> bool:
+    """Continuous ingest + background flush + memory enforcement; asserts
+    zero drops/errors and a stable RSS after warm-up (the
+    IngestionStress.scala shape: heavy + quick streams, verified counts)."""
+    import numpy as np
+    from filodb_tpu.core.flush import FlushScheduler
+    from filodb_tpu.core.memstore import TimeSeriesMemStore
+    from filodb_tpu.core.records import RecordBatch
+    from filodb_tpu.ingest.generator import counter_batch
+    from filodb_tpu.persist.localstore import (LocalDiskColumnStore,
+                                               LocalDiskMetaStore)
+    import tempfile
+    tmp = tempfile.mkdtemp(prefix="filodb_stress_")
+    ms = TimeSeriesMemStore(column_store=LocalDiskColumnStore(tmp),
+                            meta_store=LocalDiskMetaStore(tmp))
+    sh = ms.setup("stress", 0)
+    sh.config.store.shard_mem_size = 256 << 20
+    # small resident budget so every tier reaches steady state within the
+    # soak window — the point is proving the plateaus hold, not sizing
+    sh.resident.budget_bytes = 64 << 20
+    sched = FlushScheduler(ms, "stress", interval_s=5.0).start()
+    START = 1_600_000_000_000
+    deadline = time.time() + minutes * 60
+    t_idx = 0
+    total = 0
+    # The dense tier saw-tooths by design (fill until the headroom task
+    # truncates), so raw RSS samples mix cycle phases.  Leak detection
+    # compares SAME-PHASE marks: RSS at each post-enforcement trough.
+    troughs: List[float] = []
+    last_evictions = 0
+    base = counter_batch(series, 1, start_ms=START)
+    try:
+        while time.time() < deadline:
+            # 20 new samples per series per iteration, strictly in-order
+            n = 20
+            ts = np.tile(START + (t_idx + np.arange(n, dtype=np.int64))
+                         * 10_000, series)
+            idx = np.repeat(np.arange(series, dtype=np.int32), n)
+            vals = (t_idx + np.arange(n, dtype=np.float64))[None, :] \
+                * 5.0 + np.arange(series)[:, None]
+            batch = RecordBatch(base.schema, base.part_keys, idx, ts,
+                                {"count": vals.ravel()})
+            total += sh.ingest(batch, offset=t_idx)
+            t_idx += n
+            if sh.stats.evictions > last_evictions:
+                last_evictions = sh.stats.evictions
+                troughs.append(_rss_mb())
+    finally:
+        sched.stop(final_flush=True)
+    dropped = sh.stats.rows_dropped
+    # Stable = the troughs stop climbing once tiers filled: compare the
+    # last trough against the median of the middle third.
+    stable = True
+    if minutes >= 2 and len(troughs) >= 6:
+        third = len(troughs) // 3
+        mid = float(np.median(troughs[third:2 * third]))
+        stable = troughs[-1] / max(mid, 1.0) < 1.2
+    ok = (dropped == 0 and sched.errors == 0 and stable
+          and total == series * t_idx)
+    _emit("ingestion", ok, samples=total, dropped=int(dropped),
+          flush_errors=sched.errors, rss_mb=round(_rss_mb(), 1),
+          rss_stable=stable, evictions=sh.stats.evictions,
+          trough_rss_mb=[round(t, 1) for t in troughs[-6:]])
+    return ok
+
+
+def query_stress(minutes: float, series: int = 2_000,
+                 query_threads: int = 4) -> bool:
+    """Concurrent PromQL queries against live ingest for the duration;
+    asserts every query succeeds and rates stay in the generator's bounds
+    (InMemoryQueryStress.scala: parallel queries, verified results)."""
+    import numpy as np
+    from filodb_tpu.core.memstore import TimeSeriesMemStore
+    from filodb_tpu.core.records import RecordBatch
+    from filodb_tpu.ingest.generator import counter_batch
+    from filodb_tpu.query.engine import QueryEngine
+    START = 1_600_000_000_000
+    ms = TimeSeriesMemStore()
+    sh = ms.setup("stress", 0)
+    base = counter_batch(series, 1, start_ms=START)
+    # 30 min of warm data so rate windows are well-formed from the start
+    warm = 180
+    ts = np.tile(START + np.arange(warm, dtype=np.int64) * 10_000, series)
+    idx = np.repeat(np.arange(series, dtype=np.int32), warm)
+    vals = np.arange(warm, dtype=np.float64)[None, :] * 5.0 \
+        + np.arange(series)[:, None]
+    sh.ingest(RecordBatch(base.schema, base.part_keys, idx, ts,
+                          {"count": vals.ravel()}))
+    from filodb_tpu.query.rangevector import PlannerParams
+    pp = PlannerParams(sample_limit=200_000_000)
+    eng = QueryEngine("stress", ms)
+    s = START // 1000
+    deadline = time.time() + minutes * 60
+    stop = threading.Event()
+    counts = [0] * query_threads
+    errors: List[str] = []
+
+    def ingester():
+        t_idx = warm
+        while not stop.is_set():
+            n = 10
+            its = np.tile(START + (t_idx + np.arange(n, dtype=np.int64))
+                          * 10_000, series)
+            iidx = np.repeat(np.arange(series, dtype=np.int32), n)
+            ivals = (t_idx + np.arange(n, dtype=np.float64))[None, :] * 5.0 \
+                + np.arange(series)[:, None]
+            sh.ingest(RecordBatch(base.schema, base.part_keys, iidx, its,
+                                  {"count": ivals.ravel()}))
+            t_idx += n
+            time.sleep(0.01)
+
+    def querier(i):
+        while time.time() < deadline and not errors:
+            res = eng.query_range('sum by (_ns_)(rate(request_total[5m]))',
+                                  s + 600, 60, s + 1700, pp)
+            if res.error is not None:
+                errors.append(res.error)
+                return
+            for _, _, vs in res.series():
+                arr = np.asarray(vs)
+                finite = arr[np.isfinite(arr)]
+                # each series gains +5 per 10s -> rate 0.5/s; per _ns_
+                # group of series/10 members the sum is bounded
+                if finite.size and ((finite < 0).any()
+                                    or (finite > series * 2.0).any()):
+                    errors.append(f"rate out of bounds: {finite.min()}"
+                                  f"..{finite.max()}")
+                    return
+            counts[i] += 1
+
+    ing = threading.Thread(target=ingester, daemon=True)
+    ing.start()
+    threads = [threading.Thread(target=querier, args=(i,))
+               for i in range(query_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    stop.set()
+    ing.join(timeout=10)
+    ok = not errors and sum(counts) > 0
+    _emit("query", ok, queries=sum(counts),
+          qps=round(sum(counts) / max(minutes * 60, 1e-9), 1),
+          errors=errors[:3], rss_mb=round(_rss_mb(), 1))
+    return ok
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description="filodb-tpu stress harnesses")
+    ap.add_argument("harness", choices=["ingest", "query", "all"])
+    ap.add_argument("--minutes", type=float, default=10.0)
+    ap.add_argument("--platform", default="",
+                    help="pin the jax platform (e.g. cpu) — the tunneled "
+                         "TPU backend's init can hang for minutes")
+    args = ap.parse_args(argv)
+    if args.platform:
+        import jax
+        jax.config.update("jax_platforms", args.platform)
+    ok = True
+    if args.harness in ("ingest", "all"):
+        ok &= ingestion_stress(args.minutes)
+    if args.harness in ("query", "all"):
+        ok &= query_stress(args.minutes)
+    raise SystemExit(0 if ok else 1)
+
+
+if __name__ == "__main__":
+    main()

@@ -182,6 +182,67 @@ def bench_query_hicard(quick: bool):
     _emit("query_hicard", "sum_rate_qps", 1 / per, "queries/s", series=S)
 
 
+def bench_query_odp(quick: bool):
+    """Query served by on-demand paging from the persistence tier after the
+    dense working set was truncated (ref: QueryOnDemandBenchmark.scala —
+    queries against data that must page in from the column store)."""
+    import tempfile
+    from filodb_tpu.core.memstore import TimeSeriesMemStore
+    from filodb_tpu.ingest.generator import counter_batch
+    from filodb_tpu.persist.localstore import (LocalDiskColumnStore,
+                                               LocalDiskMetaStore)
+    from filodb_tpu.query.engine import QueryEngine
+    S, T = (500, 240) if quick else (2000, 720)
+    tmp = tempfile.mkdtemp(prefix="filodb_odp_bench_")
+    cs, meta = LocalDiskColumnStore(tmp), LocalDiskMetaStore(tmp)
+    ms = TimeSeriesMemStore(column_store=cs, meta_store=meta)
+    sh = ms.setup("prometheus", 0)
+    sh.ingest(counter_batch(S, T, start_ms=START), offset=1)
+    sh.flush_all_groups()
+    # cold store: recovered index, no resident data -> every query pages
+    cold = TimeSeriesMemStore(column_store=cs, meta_store=meta)
+    sh2 = cold.setup("prometheus", 0)
+    sh2.recover_index()
+    eng = QueryEngine("prometheus", cold)
+    s = START // 1000
+    q = 'sum(rate(request_total[5m]))'
+    t0 = time.perf_counter()
+    res = eng.query_range(q, s + 600, 60, s + T * 10)
+    first = time.perf_counter() - t0
+    assert res.error is None, res.error
+    _emit("query_odp", "first_query_page_in_s", first, "s",
+          series=S, samples=S * T,
+          samples_paged_per_sec=round(S * T / first, 1))
+    # warm: data now resident, same query
+    per = _time_it(lambda: eng.query_range(q, s + 600, 60, s + T * 10),
+                   3 if quick else 10)
+    _emit("query_odp", "warm_qps_after_page_in", 1 / per, "queries/s",
+          series=S)
+
+
+def bench_partition_list(quick: bool):
+    """lookup_partitions throughput over a populated shard
+    (ref: PartitionListBenchmark.scala)."""
+    from filodb_tpu.core.index import Equals
+    from filodb_tpu.core.memstore import TimeSeriesMemStore
+    from filodb_tpu.ingest.generator import counter_batch
+    S = 20_000 if quick else 200_000
+    ms = TimeSeriesMemStore()
+    sh = ms.setup("prometheus", 0)
+    sh.ingest(counter_batch(S, 2, start_ms=START, num_apps=100))
+    lo, hi = 0, 1 << 62
+    broad = [Equals("_metric_", "request_total")]
+    per = _time_it(lambda: sh.lookup_partitions(broad, lo, hi),
+                   20 if quick else 50)
+    _emit("partition_list", "broad_lookups_per_sec", 1 / per, "ops/s",
+          series=S, latency_ms=round(per * 1000, 3))
+    narrow = [Equals("_metric_", "request_total"), Equals("_ns_", "App-7")]
+    per = _time_it(lambda: sh.lookup_partitions(narrow, lo, hi),
+                   50 if quick else 200)
+    _emit("partition_list", "narrow_lookups_per_sec", 1 / per, "ops/s",
+          series=S, latency_ms=round(per * 1000, 3))
+
+
 def bench_query_under_ingest(quick: bool):
     """Query QPS while a thread continuously ingests into the same shard
     (ref: QueryAndIngestBenchmark.scala — the reference runs queries during
@@ -378,6 +439,8 @@ BENCHES: Dict[str, Callable[[bool], None]] = {
     "query": bench_query,
     "query_hicard": bench_query_hicard,
     "query_1m": bench_query_1m,
+    "query_odp": bench_query_odp,
+    "partition_list": bench_partition_list,
     "query_under_ingest": bench_query_under_ingest,
     "histogram": bench_histogram,
 }
@@ -388,7 +451,13 @@ def main(argv: List[str] = None):
     ap.add_argument("bench", nargs="?", choices=sorted(BENCHES),
                     help="run one benchmark (default: all)")
     ap.add_argument("--quick", action="store_true")
+    ap.add_argument("--platform", default="",
+                    help="pin the jax platform (e.g. cpu) — the tunneled "
+                         "TPU backend's init can hang for minutes")
     args = ap.parse_args(argv)
+    if args.platform:
+        import jax
+        jax.config.update("jax_platforms", args.platform)
     targets = [args.bench] if args.bench else sorted(BENCHES)
     for name in targets:
         BENCHES[name](args.quick)

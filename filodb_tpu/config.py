@@ -108,7 +108,9 @@ class FilodbSettings:
             from filodb_tpu.core.schemas import Schemas
             try:
                 self.schemas = Schemas.from_config(schemas_raw)
-            except ValueError as e:
+            except (ValueError, AttributeError, TypeError) as e:
+                # AttributeError/TypeError: non-dict where a block was
+                # expected — still a config mistake, same error surface
                 raise ConfigError(f"{source}: {e}")
         for section, obj in (("query", self.query), ("store", self.store)):
             for k, v in (raw.pop(section, None) or {}).items():
@@ -186,10 +188,14 @@ def _coerce(value, current, key: str, where: str):
     from filodb_tpu.utils.hoconlite import Duration
     if isinstance(value, Duration):
         if key.endswith("_ms"):
-            return int(value.millis)
-        if key.endswith("_s"):
-            return float(value.seconds)
-        raise ConfigError(f"{where}: duration given for non-duration field")
+            num = value.millis
+        elif key.endswith("_s"):
+            num = value.seconds
+        else:
+            raise ConfigError(f"{where}: duration given for "
+                              f"non-duration field")
+        # respect the field's declared type (int fields stay ints)
+        return int(num) if isinstance(current, int) else float(num)
     want = type(current)
     if isinstance(current, bool):
         if isinstance(value, bool):

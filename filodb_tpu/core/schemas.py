@@ -159,7 +159,10 @@ class Schemas:
         valid_types = {"ts", "double", "long", "hist", "string", "int"}
         out = {s.name: s for s in
                (GAUGE, UNTYPED, PROM_COUNTER, PROM_HISTOGRAM, DS_GAUGE)}
-        for name, spec in (raw.get("schemas") or {}).items():
+        schemas_raw = raw.get("schemas") or {}
+        if not isinstance(schemas_raw, dict):
+            raise ValueError("schemas: expected a block of declarations")
+        for name, spec in schemas_raw.items():
             if not isinstance(spec, dict):
                 raise ValueError(f"schemas.{name}: expected a block")
             cols = []
@@ -195,9 +198,15 @@ class Schemas:
             if unknown_keys:
                 raise ValueError(
                     f"schemas.{name}: unknown keys {sorted(unknown_keys)}")
+            ds_list = spec.get("downsamplers") or []
+            if isinstance(ds_list, str) or not all(
+                    isinstance(d, str) for d in ds_list):
+                raise ValueError(
+                    f"schemas.{name}.downsamplers: must be a list of "
+                    f"'algo(col)' strings")
             out[name] = Schema(
                 name, tuple(cols), value_column,
-                tuple(spec.get("downsamplers") or ()),
+                tuple(ds_list),
                 spec.get("downsample_period_marker", "time(0)"),
                 spec.get("downsample_schema"))
         for s in out.values():

@@ -488,7 +488,8 @@ class TimeSeriesShard:
         return chunks
 
     def ensure_paged_pids(self, schema_name: str, pids: np.ndarray,
-                          start_time_ms: int, end_time_ms: int) -> int:
+                          start_time_ms: int, end_time_ms: int,
+                          max_samples: Optional[int] = None) -> int:
         """Vectorized ensure_paged precheck: computes which pids actually
         need on-demand paging with numpy over the whole pid array, then runs
         the per-partition paging loop only on that (usually empty) subset —
@@ -515,10 +516,12 @@ class TimeSeriesShard:
             return 0
         parts = [self.partitions[p] for p in np.asarray(pids)[need].tolist()]
         with self.write_lock:
-            return self.ensure_paged(parts, start_time_ms, end_time_ms)
+            return self.ensure_paged(parts, start_time_ms, end_time_ms,
+                                     max_samples=max_samples)
 
     def ensure_paged(self, parts: Sequence[PartitionInfo],
-                     start_time_ms: int, end_time_ms: int) -> int:
+                     start_time_ms: int, end_time_ms: int,
+                     max_samples: Optional[int] = None) -> int:
         """On-demand paging: load persisted chunks not in the in-memory
         working set so the query sees full history (ref:
         OnDemandPagingShard.scala:27-39, DemandPagedChunkStore.scala:17-34).
@@ -533,6 +536,14 @@ class TimeSeriesShard:
             return 0
         paged = 0
         for info in parts:
+            # abort BEFORE materializing more history than the query may
+            # scan — demand paging itself must not be the OOM (ref:
+            # capDataScannedPerShardCheck runs pre-ODP on chunk metadata)
+            if max_samples is not None and paged > max_samples:
+                raise ValueError(
+                    f"demand paging exceeded the scan limit {max_samples} "
+                    f"after {paged} samples — narrow the filters or time "
+                    f"range")
             store = self.stores[info.schema_name]
             row = info.row
             cnt = int(store.counts[row])

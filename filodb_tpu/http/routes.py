@@ -350,6 +350,9 @@ def _planner_params(params: Dict[str, str]) -> Optional[PlannerParams]:
     if "limit" in params:
         pp.sample_limit = _num_param(params, "limit")
         changed = True
+    if "scanLimit" in params:
+        pp.scan_limit = _num_param(params, "scanLimit")
+        changed = True
     return pp if changed else None
 
 

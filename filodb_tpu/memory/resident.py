@@ -32,6 +32,13 @@ class ResidentChunkCache:
     same reclaim ordering the reference's BlockManager guarantees.
     """
 
+    # Per-chunk accounting overhead beyond the encoded payload: the ChunkSet
+    # object, its info record, per-column bytes objects, and list/queue
+    # slots.  Without this, many tiny chunks (frequent flushes) cost far
+    # more RSS than bytes_used claims and the budget never triggers —
+    # observed as unbounded growth in the ingestion soak.
+    CHUNK_OVERHEAD = 1024
+
     def __init__(self, budget_bytes: int = 256 << 20,
                  dataset: str = "", shard: int = -1,
                  persistent: bool = True):
@@ -50,7 +57,7 @@ class ResidentChunkCache:
     # ------------------------------------------------------------------ write
 
     def add(self, part_id: int, cs: ChunkSet) -> None:
-        nb = cs.nbytes
+        nb = cs.nbytes + self.CHUNK_OVERHEAD
         self._by_part.setdefault(part_id, []).append(cs)
         self._queue.append((part_id, cs.info.chunk_id, nb))
         self.bytes_used += nb
@@ -83,7 +90,8 @@ class ResidentChunkCache:
         (queue entries lazily skip missing chunks)."""
         lst = self._by_part.pop(part_id, None)
         if lst:
-            self.bytes_used -= sum(cs.nbytes for cs in lst)
+            self.bytes_used -= sum(cs.nbytes + self.CHUNK_OVERHEAD
+                                   for cs in lst)
 
     # ------------------------------------------------------------------- read
 

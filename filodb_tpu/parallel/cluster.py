@@ -270,12 +270,17 @@ class NodeAgent:
                             and key not in self._scheduled:
                         self._scheduled.add(key)
                         self._assign_q.put(key)
+            # revocations: drop owned shards AND cancel ones still queued
+            # or mid-recovery so the applier doesn't resurrect them
             for ds, owned in self._owned.items():
                 now = set(assignments.get(ds, []))
                 for s in sorted(owned - now):
                     if self.on_unassign is not None:
                         self.on_unassign(ds, int(s))
                     owned.discard(s)
+            for key in list(self._scheduled):
+                if key[1] not in set(assignments.get(key[0], [])):
+                    self._scheduled.discard(key)
 
     def _applier_loop(self) -> None:
         while not self._stop.is_set():
@@ -283,10 +288,20 @@ class NodeAgent:
                 ds, s = self._assign_q.get(timeout=0.2)
             except queue.Empty:
                 continue
+            with self._lock:
+                if (ds, s) not in self._scheduled:
+                    continue            # revoked while queued: cancelled
             try:
                 self.on_assign(ds, s)
                 with self._lock:
-                    self._owned.setdefault(ds, set()).add(s)
+                    # only claim ownership if the assignment survived the
+                    # recovery — a revocation mid-recovery means the work
+                    # must be torn down, not silently kept
+                    survived = (ds, s) in self._scheduled
+                    if survived:
+                        self._owned.setdefault(ds, set()).add(s)
+                if not survived and self.on_unassign is not None:
+                    self.on_unassign(ds, s)
             except Exception:  # noqa: BLE001
                 self.errors += 1
                 _log.exception("shard assignment failed: %s/%d", ds, s)
@@ -320,8 +335,7 @@ class NodeAgent:
             try:
                 reply = _rpc(self.coordinator_addr,
                              {"cmd": "heartbeat", "node": self.node,
-                              "active": {ds: sorted(s) for ds, s
-                                         in self._owned.items()}},
+                              "active": self.owned},  # locked snapshot
                              timeout_s=self.heartbeat_interval_s * 4)
                 if reply.get("rejoin"):
                     self.register()

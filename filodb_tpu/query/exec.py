@@ -816,10 +816,35 @@ class MultiSchemaPartitionsExec(LeafExecPlan):
         pids = lookup.pids_by_schema.get(schema_name)
         if pids is None or pids.size == 0:
             return None, stats
-        shard.ensure_paged_pids(schema_name, pids,
-                                self.chunk_start_ms, self.chunk_end_ms)
         store = shard.stores[schema_name]
         rows = shard.rows_for(pids)
+
+        # Cap data scanned BEFORE materializing (or paging) the [S, T]
+        # matrix — a pathological selector must fail fast, not OOM first
+        # (ref: OnDemandPagingShard.scala:55 capDataScannedPerShardCheck,
+        # ExecPlan.scala:139-180 enforcedLimits).  The estimate clips each
+        # series to the query's chunk range assuming uniform spacing (the
+        # reference estimates from chunk metadata the same way); checked
+        # against the resident data before ODP and again after paging.
+        limit = self.ctx.planner_params.scan_limit
+        enforced = limit and self.ctx.planner_params.enforced_limits
+
+        def _check_scan_cap(when: str):
+            if not enforced:
+                return
+            to_scan = _estimate_scan(store, rows, self.chunk_start_ms,
+                                     self.chunk_end_ms)
+            if to_scan > limit:
+                raise ValueError(
+                    f"shard {self.shard}: query would scan ~{to_scan} "
+                    f"samples ({when}), over the scan limit {limit} — "
+                    f"narrow the filters or time range")
+
+        _check_scan_cap("resident")
+        shard.ensure_paged_pids(schema_name, pids,
+                                self.chunk_start_ms, self.chunk_end_ms,
+                                max_samples=limit if enforced else None)
+        _check_scan_cap("after demand paging")
         schema = shard.schemas[schema_name]
         col_name = (self.columns[0] if self.columns
                     else schema.value_column)
@@ -904,6 +929,24 @@ class MultiSchemaPartitionsExec(LeafExecPlan):
         return RawBlock(keys, ts_off, vals, base, les,
                         samples=stats.samples_scanned, vbase=vbase,
                         precorrected=precorrected), stats
+
+
+def _estimate_scan(store, rows: np.ndarray, start_ms: int,
+                   end_ms: int) -> int:
+    """Estimated samples in [start_ms, end_ms] across the given store rows,
+    from per-series extents under a uniform-spacing assumption — O(S), no
+    [S, T] materialization."""
+    cnt = store.counts[rows].astype(np.int64)
+    if store.ts.shape[1] == 0 or not cnt.any():
+        return 0
+    first = store.ts[rows, 0]
+    last = store.ts[rows, np.maximum(cnt - 1, 0)]
+    lo = np.maximum(first, start_ms)
+    hi = np.minimum(last, end_ms)
+    span = np.maximum(last - first, 1).astype(np.float64)
+    frac = np.clip((hi - lo).astype(np.float64) / span, 0.0, 1.0)
+    est = np.where((cnt > 0) & (hi >= lo), np.maximum(cnt * frac, 1.0), 0.0)
+    return int(est.sum())
 
 
 class EmptyResultExec(LeafExecPlan):

@@ -123,7 +123,12 @@ class QueryResult:
 class PlannerParams:
     """ref: core/.../query/QueryContext.scala:98 PlannerParams."""
     spread: int = 1
-    sample_limit: int = 1_000_000
+    sample_limit: int = 1_000_000        # RESULT samples (post-transform)
+    # samples a leaf may SCAN (gather/page) per shard for one query — the
+    # fail-fast guard against pathological selectors (ref:
+    # OnDemandPagingShard.scala:55 capDataScannedPerShardCheck).  Distinct
+    # from sample_limit: aggregations scan much more than they return.
+    scan_limit: int = 50_000_000
     group_by_cardinality_limit: int = 100_000
     join_cardinality_limit: int = 100_000
     enforced_limits: bool = True

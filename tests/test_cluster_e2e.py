@@ -48,14 +48,15 @@ def _spawn(name, coord_port, data_dir):
     # stderr to a file, never a PIPE: an undrained pipe filling up would
     # block the node's writes and stall heartbeats mid-test
     errpath = os.path.join(str(data_dir), f"{name}.stderr")
-    errf = open(errpath, "w")
-    proc = subprocess.Popen(
-        [sys.executable, "-m", "filodb_tpu.parallel.nodeapp",
-         "--name", name, "--coordinator", f"127.0.0.1:{coord_port}",
-         "--data-dir", str(data_dir), "--platform", "cpu",
-         "--heartbeat-interval", "0.3"],
-        stdout=subprocess.PIPE, stderr=errf, text=True,
-        cwd=REPO, env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    with open(errpath, "w") as errf:
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "filodb_tpu.parallel.nodeapp",
+             "--name", name, "--coordinator", f"127.0.0.1:{coord_port}",
+             "--data-dir", str(data_dir), "--platform", "cpu",
+             "--heartbeat-interval", "0.3"],
+            stdout=subprocess.PIPE, stderr=errf, text=True,
+            cwd=REPO, env={**os.environ, "JAX_PLATFORMS": "cpu"})
+        # the child holds its own duplicated fd; the parent's closes now
     box = {}
 
     def _read():

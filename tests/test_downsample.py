@@ -233,3 +233,32 @@ def test_long_time_range_with_real_downsample_cluster():
     raw_all = _vals(raw_eng.query_range(q, ALIGNED_S + 1260, 300,
                                         ALIGNED_S + 7080))
     np.testing.assert_allclose(stitched, raw_all, rtol=1e-9)
+
+
+def test_downsample_chunk_histogram_counter_reset():
+    """prom-histogram's counter(2) period marker must split periods at a
+    histogram count reset so hLast never merges across the reset — the
+    dip survives for query-time correction (ref:
+    DownsamplePeriodMarker.scala:163 counter marker on histogram schemas)."""
+    from filodb_tpu.core.schemas import PROM_HISTOGRAM
+    T, B = 12, 4
+    ts = np.asarray([ALIGNED + (i + 1) * 10_000 for i in range(T)],
+                    dtype=np.int64)
+    # cumulative bucket counts rising, then a reset (restart) at i=7
+    row = np.arange(1, T + 1, dtype=np.float64)
+    row[7:] = np.arange(1, T - 6, dtype=np.float64)
+    h = row[:, None] * np.arange(1, B + 1, dtype=np.float64)[None, :]
+    count = h[:, -1].copy()
+    total = count * 7.0
+    out_ts, out_cols = downsample_chunk(
+        PROM_HISTOGRAM, ts, {"sum": total, "count": count, "h": h}, RES)
+    # same 3 periods as the scalar counter case: the drop at i=7 cuts one
+    assert len(out_ts) == 3
+    assert list(out_cols["count"]) == [count[5], count[6], count[11]]
+    # hLast snapshots the LAST histogram of each period; the pre-reset
+    # snapshot (period 1) must exceed the post-reset one (period 2)
+    np.testing.assert_array_equal(out_cols["h"][1], h[6])
+    np.testing.assert_array_equal(out_cols["h"][2], h[11])
+    assert (out_cols["h"][1] > out_cols["h"][2]).all()
+    # sum column dips too (dLast across the same periods)
+    assert out_cols["sum"][1] > out_cols["sum"][2]

@@ -272,3 +272,30 @@ def test_empty_on_group_left(engine):
     assert res.error is None and res.num_series == 10
     vals = np.concatenate([b.values for b in res.blocks])
     assert abs(float(np.nanmean(vals))) < 1.0
+
+
+def test_scan_time_sample_limit_fails_fast():
+    """A selector over the sample limit must fail at scan time in the leaf
+    (before materializing the gather), not after building the result
+    (ref: OnDemandPagingShard.scala:55 capDataScannedPerShardCheck)."""
+    from filodb_tpu.query.rangevector import PlannerParams
+    ms = TimeSeriesMemStore()
+    ms.setup("prometheus", 0)
+    ms.ingest("prometheus", 0, counter_batch(50, 100, start_ms=START_MS), offset=1)
+    eng = QueryEngine("prometheus", ms)
+    s = START_S
+    pp = PlannerParams(scan_limit=1000)
+    res = eng.query_range('sum(rate(request_total[5m]))', s + 600, 60,
+                          s + 900, pp)
+    assert res.error is not None and "scan" in res.error
+    # under the limit: fine
+    pp2 = PlannerParams(scan_limit=50 * 100 + 1)
+    res2 = eng.query_range('sum(rate(request_total[5m]))', s + 600, 60,
+                           s + 900, pp2)
+    assert res2.error is None, res2.error
+    # a narrow TIME RANGE over a big store must pass: the cap is on data
+    # scanned in-range, not total resident data
+    pp3 = PlannerParams(scan_limit=2000)
+    res3 = eng.query_range('sum(rate(request_total[30s]))', s + 900, 30,
+                           s + 960, pp3)
+    assert res3.error is None, res3.error

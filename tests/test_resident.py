@@ -43,8 +43,12 @@ def test_flush_populates_resident_cache():
     shard.flush_all_groups()
     assert shard.resident.num_chunks == 20
     assert shard.resident.bytes_used > 0
-    # compression: far below the 16 B/sample dense footprint
-    bytes_per_sample = shard.resident.bytes_used / (20 * T)
+    # compression of the encoded PAYLOAD: far below the 16 B/sample dense
+    # footprint (bytes_used additionally carries per-chunk object overhead
+    # so the eviction budget reflects true RSS cost)
+    payload = shard.resident.bytes_used \
+        - 20 * shard.resident.CHUNK_OVERHEAD
+    bytes_per_sample = payload / (20 * T)
     assert bytes_per_sample < 8, bytes_per_sample
 
 
@@ -82,7 +86,9 @@ def test_resident_budget_evicts_oldest_first():
                              {"count": "double"}, ingestion_time_ms=i)
         chunks.append(cs)
         sizes.append(cs.nbytes)
-    cache.budget_bytes = sum(sizes[:5]) + 1   # room for ~5 chunks
+    cache.budget_bytes = (sum(sizes[:5])
+                          + 5 * ResidentChunkCache.CHUNK_OVERHEAD
+                          + 1)                # room for ~5 chunks
     for i, cs in enumerate(chunks):
         cache.add(0, cs)
     assert cache.bytes_used <= cache.budget_bytes
