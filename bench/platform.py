@@ -1,0 +1,18 @@
+"""Shared --platform plumbing for bench/stress entry points.
+
+The ambient environment points JAX at a tunneled TPU whose first connect can
+hang for minutes; pinning must happen via jax.config BEFORE any filodb import
+touches jax (env vars are too late once the sitecustomize hook ran)."""
+from __future__ import annotations
+
+
+def add_platform_arg(ap) -> None:
+    ap.add_argument("--platform", default="",
+                    help="pin the jax platform (e.g. cpu) — the tunneled "
+                         "TPU backend's init can hang for minutes")
+
+
+def apply_platform(args) -> None:
+    if getattr(args, "platform", ""):
+        import jax
+        jax.config.update("jax_platforms", args.platform)

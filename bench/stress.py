@@ -182,13 +182,10 @@ def main(argv=None):
     ap = argparse.ArgumentParser(description="filodb-tpu stress harnesses")
     ap.add_argument("harness", choices=["ingest", "query", "all"])
     ap.add_argument("--minutes", type=float, default=10.0)
-    ap.add_argument("--platform", default="",
-                    help="pin the jax platform (e.g. cpu) — the tunneled "
-                         "TPU backend's init can hang for minutes")
+    from bench.platform import add_platform_arg, apply_platform
+    add_platform_arg(ap)
     args = ap.parse_args(argv)
-    if args.platform:
-        import jax
-        jax.config.update("jax_platforms", args.platform)
+    apply_platform(args)
     ok = True
     if args.harness in ("ingest", "all"):
         ok &= ingestion_stress(args.minutes)

@@ -350,7 +350,9 @@ def bench_query_1m(quick: bool):
                                  fn_name="rate", agg_op="sum")
         return np.asarray(out)
 
-    run()                      # warm: compile + group-cache fill
+    t1 = time.perf_counter()
+    run()                      # cold: compile + group cache + pack upload
+    cold_s = time.perf_counter() - t1
     lat = []
     for _ in range(2 if quick else 5):
         t1 = time.perf_counter()
@@ -358,7 +360,8 @@ def bench_query_1m(quick: bool):
         lat.append(time.perf_counter() - t1)
     p50 = float(np.median(lat))
     _emit("query_1m", "sum_by_rate_p50_latency", p50 * 1000, "ms",
-          series=S, samples_scanned_per_sec=round(S * T / p50, 1))
+          series=S, samples_scanned_per_sec=round(S * T / p50, 1),
+          cold_first_query_s=round(cold_s, 3))
 
 
 # -------------------------------------------------------------- histogram
@@ -451,13 +454,10 @@ def main(argv: List[str] = None):
     ap.add_argument("bench", nargs="?", choices=sorted(BENCHES),
                     help="run one benchmark (default: all)")
     ap.add_argument("--quick", action="store_true")
-    ap.add_argument("--platform", default="",
-                    help="pin the jax platform (e.g. cpu) — the tunneled "
-                         "TPU backend's init can hang for minutes")
+    from bench.platform import add_platform_arg, apply_platform
+    add_platform_arg(ap)
     args = ap.parse_args(argv)
-    if args.platform:
-        import jax
-        jax.config.update("jax_platforms", args.platform)
+    apply_platform(args)
     targets = [args.bench] if args.bench else sorted(BENCHES)
     for name in targets:
         BENCHES[name](args.quick)

@@ -420,15 +420,25 @@ class TimeSeriesShard:
         return out
 
     def _decode_paged_chunks(self, store: DenseSeriesStore, chunks,
-                             lo_excl: int, hi_incl: int):
+                             lo_excl: int, hi_incl: int,
+                             max_samples: Optional[int] = None):
         """Decode + concatenate chunk data with ts in (lo_excl, hi_incl],
-        dropping overlaps and bucket-scheme-mismatched histogram chunks."""
+        dropping overlaps and bucket-scheme-mismatched histogram chunks.
+        Raises once more than max_samples decode — chunk-granular, so a
+        single partition with unbounded history can't OOM the pager."""
         from filodb_tpu.memory.chunks import decode_chunkset
         from filodb_tpu.memory.histogram import rebucket
         hist_cols = {c.name for c in store.schema.data_columns
                      if c.col_type == "hist"}
         ts_parts, col_parts, part_les = [], [], []
+        decoded_total = 0
         for cs in sorted(chunks, key=lambda c: c.info.start_time_ms):
+            if max_samples is not None and decoded_total > max_samples:
+                raise ValueError(
+                    f"demand paging exceeded the scan limit {max_samples} "
+                    f"inside one partition — narrow the filters or time "
+                    f"range")
+            decoded_total += cs.info.num_rows
             chunk_les = None
             if cs.bucket_scheme is not None:
                 chunk_les = cs.bucket_scheme.as_array()
@@ -560,7 +570,9 @@ class TimeSeriesShard:
                 if hi >= start_time_ms:
                     chunks = self._read_sealed_chunks(info, start_time_ms, hi)
                     ts_all, cols_all = self._decode_paged_chunks(
-                        store, chunks, start_time_ms - 1, hi)
+                        store, chunks, start_time_ms - 1, hi,
+                        max_samples=(None if max_samples is None
+                                     else max_samples - paged))
                     if ts_all is not None:
                         n = store.prepend_row(row, ts_all, cols_all)
                         paged += n
@@ -583,7 +595,9 @@ class TimeSeriesShard:
                     chunks = self._read_sealed_chunks(info, ceil + 1,
                                                       end_time_ms)
                     ts_all, cols_all = self._decode_paged_chunks(
-                        store, chunks, last_mem, end_time_ms)
+                        store, chunks, last_mem, end_time_ms,
+                        max_samples=(None if max_samples is None
+                                     else max_samples - paged))
                     if ts_all is not None:
                         n = store.append_row(row, ts_all, cols_all)
                         paged += n

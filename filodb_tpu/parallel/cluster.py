@@ -243,7 +243,12 @@ class NodeAgent:
         self.heartbeat_interval_s = heartbeat_interval_s
         self._lock = threading.Lock()
         self._owned: Dict[str, set] = {}       # dataset -> recovered shards
-        self._scheduled: set = set()           # (ds, shard) queued/recovering
+        # (ds, shard) -> epoch of the CURRENT assignment attempt.  Epochs
+        # defeat the revoke-then-reassign ABA: a recovery started under an
+        # older epoch must neither claim ownership nor cancel the newer
+        # attempt when it finally completes.
+        self._scheduled: Dict[Tuple[str, int], int] = {}
+        self._epoch = 0
         self._assign_q: "queue.Queue" = queue.Queue()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -268,8 +273,9 @@ class NodeAgent:
                     key = (ds, int(s))
                     if int(s) not in self._owned.get(ds, set()) \
                             and key not in self._scheduled:
-                        self._scheduled.add(key)
-                        self._assign_q.put(key)
+                        self._epoch += 1
+                        self._scheduled[key] = self._epoch
+                        self._assign_q.put((key, self._epoch))
             # revocations: drop owned shards AND cancel ones still queued
             # or mid-recovery so the applier doesn't resurrect them
             for ds, owned in self._owned.items():
@@ -280,34 +286,35 @@ class NodeAgent:
                     owned.discard(s)
             for key in list(self._scheduled):
                 if key[1] not in set(assignments.get(key[0], [])):
-                    self._scheduled.discard(key)
+                    del self._scheduled[key]
 
     def _applier_loop(self) -> None:
         while not self._stop.is_set():
             try:
-                ds, s = self._assign_q.get(timeout=0.2)
+                (ds, s), epoch = self._assign_q.get(timeout=0.2)
             except queue.Empty:
                 continue
             with self._lock:
-                if (ds, s) not in self._scheduled:
-                    continue            # revoked while queued: cancelled
+                if self._scheduled.get((ds, s)) != epoch:
+                    continue            # revoked/superseded while queued
             try:
                 self.on_assign(ds, s)
                 with self._lock:
-                    # only claim ownership if the assignment survived the
-                    # recovery — a revocation mid-recovery means the work
-                    # must be torn down, not silently kept
-                    survived = (ds, s) in self._scheduled
+                    # only claim ownership if THIS attempt is still the
+                    # current one — a revocation (or a newer reassignment)
+                    # mid-recovery means this work must be torn down
+                    survived = self._scheduled.get((ds, s)) == epoch
                     if survived:
                         self._owned.setdefault(ds, set()).add(s)
+                        del self._scheduled[(ds, s)]
                 if not survived and self.on_unassign is not None:
                     self.on_unassign(ds, s)
             except Exception:  # noqa: BLE001
                 self.errors += 1
                 _log.exception("shard assignment failed: %s/%d", ds, s)
-            finally:
                 with self._lock:
-                    self._scheduled.discard((ds, s))
+                    if self._scheduled.get((ds, s)) == epoch:
+                        del self._scheduled[(ds, s)]
 
     def start(self) -> "NodeAgent":
         self._applier = threading.Thread(target=self._applier_loop,

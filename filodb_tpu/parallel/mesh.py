@@ -315,6 +315,21 @@ class MeshExecutor:
         # label work (ref: the reference re-groups every query — this is
         # a deliberate TPU-side improvement for the 1M-series target).
         self._group_caches: Dict[Tuple, Tuple[GroupRegistry, Dict[int, np.ndarray]]] = {}
+        # Device-resident pack cache: the mesh analogue of the leaf path's
+        # DeviceMirror (core/devicecache.py).  A pack is revalidated by
+        # every shard's (partition count, store generations) signature —
+        # unchanged data means repeat queries skip the host gather AND the
+        # host->device transfer entirely; any ingest invalidates it and the
+        # next query pays one re-upload (never worse than uncached).
+        self._pack_cache: Dict[Tuple, Dict] = {}
+        self._pack_cache_max = 8
+
+    def _cluster_sig(self) -> Tuple:
+        return tuple(
+            (sh.shard_num, len(sh.partitions),
+             tuple((name, st.generation)
+                   for name, st in sorted(sh.stores.items())))
+            for sh in self.memstore.shards_for(self.dataset))
 
     def _gids_for(self, shard, pids: np.ndarray,
                   by: Sequence[str], without: Sequence[str]
@@ -348,10 +363,32 @@ class MeshExecutor:
                         ) -> Optional[PackedShards]:
         """fn_name (the range function the pack will feed) selects counter
         semantics: counter columns are reset-corrected host-side in f64 so
-        f32 deltas on device are exact — same contract as the leaf exec."""
+        f32 deltas on device are exact — same contract as the leaf exec.
+
+        Packs are cached on device: a repeat query over unchanged data
+        (validated by per-shard generation signatures) reuses the resident
+        arrays — run_agg rebases any window grid onto the pack's base, so
+        the cache serves rolling windows too, as long as the requested
+        start doesn't reach below what the pack was paged for."""
         from filodb_tpu.ops.counter import rebase_values
         from filodb_tpu.ops.rangefns import RANGE_FUNCTIONS
         from filodb_tpu.ops.timewindow import to_offsets
+        from filodb_tpu.utils.metrics import registry as metrics_registry
+        ck = (tuple(str(f) for f in filters), tuple(by), tuple(without),
+              fn_name)
+        sig = self._cluster_sig()
+        # stale entries pin device memory for nothing — drop them eagerly
+        for k in [k for k, e in self._pack_cache.items() if e["sig"] != sig]:
+            del self._pack_cache[k]
+        ent = self._pack_cache.get(ck)
+        # a hit needs the requested range INSIDE the cached one: the index
+        # prunes series by time, so a later end could admit series the
+        # cached pack never gathered
+        if ent is not None and ent["start_ms"] <= start_ms \
+                and ent["end_ms"] >= end_ms:
+            metrics_registry.counter("mesh_pack_cache_hits").increment()
+            self._pack_cache[ck] = self._pack_cache.pop(ck)   # LRU touch
+            return ent["packed"]
         spec = RANGE_FUNCTIONS.get(fn_name or "")
         fn_is_counter = spec.is_counter if spec else False
         blocks = []
@@ -407,7 +444,16 @@ class MeshExecutor:
                        *b[3:]) for b in blocks]
         packed = pack_shards(blocks, by=by, without=without, base_ms=start_ms,
                              precorrected=precorrected, group_labels=labels)
-        return device_put_packed(packed, self.mesh)
+        packed = device_put_packed(packed, self.mesh)
+        # re-read the signature: paging during the gather may have bumped
+        # generations — cache under the state the pack actually reflects
+        self._pack_cache[ck] = {"sig": self._cluster_sig(),
+                                "start_ms": start_ms, "end_ms": end_ms,
+                                "packed": packed}
+        while len(self._pack_cache) > self._pack_cache_max:
+            self._pack_cache.pop(next(iter(self._pack_cache)))
+        metrics_registry.counter("mesh_pack_cache_misses").increment()
+        return packed
 
     def run_agg(self, packed: PackedShards, wends: np.ndarray, *,
                 range_ms: int, fn_name: Optional[str], agg_op: str,
