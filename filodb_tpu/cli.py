@@ -93,6 +93,39 @@ def cmd_importcsv(args) -> int:
     return 0
 
 
+def cmd_exportbundle(args) -> int:
+    """Export filtered raw series to a columnar NPZ bundle — the batch
+    analytics bridge (ref: spark/ legacy connector's DataFrame read)."""
+    from filodb_tpu.core.index import Equals
+    from filodb_tpu.jobs.batch_io import export_series
+    ms, _, _ = _open_local(args.data_dir, args.dataset, args.shards)
+    filters = [Equals("_metric_", args.metric)] if args.metric else []
+    for f in args.filter or []:
+        if "=" not in f:
+            print(f"--filter expects label=value, got {f!r}",
+                  file=sys.stderr)
+            return 2
+        k, v = f.split("=", 1)
+        filters.append(Equals(k, v))
+    n = export_series(ms, args.dataset, filters,
+                      args.start, args.end, args.out)
+    print(f"exported {n} series to {args.out}")
+    return 0
+
+
+def cmd_importbundle(args) -> int:
+    """Bulk-load an NPZ bundle (ref: spark/ connector's DataFrame write)."""
+    from filodb_tpu.jobs.batch_io import import_series
+    ms, _, _ = _open_local(args.data_dir, args.dataset, args.shards)
+    n = import_series(ms, args.dataset, args.bundle)
+    for s in range(args.shards):
+        sh = ms.get_shard(args.dataset, s)
+        if sh is not None:
+            sh.flush_all_groups()
+    print(f"imported {n} samples from {args.bundle}")
+    return 0
+
+
 def cmd_list(args) -> int:
     """Datasets + per-shard series counts in a data dir (ref: `list`)."""
     root = os.path.join(args.data_dir, "chunks")
@@ -258,6 +291,22 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--file", required=True)
     sp.add_argument("--schema", default="gauge")
     sp.set_defaults(fn=cmd_importcsv)
+
+    sp = sub.add_parser("exportbundle",
+                        help="export raw series to a columnar NPZ bundle")
+    common(sp)
+    sp.add_argument("--metric")
+    sp.add_argument("--filter", action="append",
+                    help="label=value (repeatable)")
+    sp.add_argument("--start", type=int, required=True, help="ms epoch")
+    sp.add_argument("--end", type=int, required=True, help="ms epoch")
+    sp.add_argument("--out", required=True)
+    sp.set_defaults(fn=cmd_exportbundle)
+
+    sp = sub.add_parser("importbundle", help="bulk-load an NPZ bundle")
+    common(sp)
+    sp.add_argument("--bundle", required=True)
+    sp.set_defaults(fn=cmd_importbundle)
 
     sp = sub.add_parser("list", help="list datasets in a data dir")
     sp.add_argument("--data-dir", default="./filodb-data")

@@ -445,9 +445,13 @@ class MeshExecutor:
         packed = pack_shards(blocks, by=by, without=without, base_ms=start_ms,
                              precorrected=precorrected, group_labels=labels)
         packed = device_put_packed(packed, self.mesh)
-        # re-read the signature: paging during the gather may have bumped
-        # generations — cache under the state the pack actually reflects
-        self._pack_cache[ck] = {"sig": self._cluster_sig(),
+        # cache under the PRE-gather signature: a concurrent ingest landing
+        # mid-gather then invalidates the entry (over-invalidation is safe;
+        # re-reading the signature here could cache a pack MISSING those
+        # samples under the post-ingest generation and serve it as fresh).
+        # ODP during the first gather also bumps generations, so the second
+        # query re-packs once and stabilizes from the third on.
+        self._pack_cache[ck] = {"sig": sig,
                                 "start_ms": start_ms, "end_ms": end_ms,
                                 "packed": packed}
         while len(self._pack_cache) > self._pack_cache_max:
