@@ -63,6 +63,8 @@ class PromHttpApi:
             if parts[:2] == ["admin", "loglevel"] and len(parts) == 3 \
                     and method == "POST":
                 return self._loglevel(parts[2], body.decode().strip())
+            if parts[:2] == ["admin", "profiler"] and len(parts) == 3:
+                return self._profiler(parts[2], params, method)
             if parts[:1] == ["influx"] and len(parts) == 2 \
                     and parts[1] == "write" and method == "POST":
                 return self._influx_write(params, body)
@@ -310,6 +312,33 @@ class PromHttpApi:
                           ).setLevel(lvl)
         return 200, {"status": "success",
                      "data": f"{logger_name} set to {level.upper()}"}
+
+    # ------------------------------------------------------------ profiler
+
+    def _profiler(self, action: str, params: Dict[str, str],
+                  method: str) -> Tuple[int, object]:
+        """Sampling-profiler admin (ref: SimpleProfiler.java in the
+        reference's standalone server)."""
+        from filodb_tpu.utils.profiler import profiler
+        expected = {"start": "POST", "stop": "POST", "report": "GET"}
+        if action not in expected:
+            return 404, _err(f"unknown profiler action {action!r}")
+        if method != expected[action]:
+            return 405, _err(f"profiler {action} requires "
+                             f"{expected[action]}, got {method}")
+        if action == "start":
+            try:
+                hz = float(params.get("hz", "100"))
+                if not profiler.start(hz):
+                    raise _BadRequest("profiler already running")
+            except ValueError as e:
+                raise _BadRequest(f"bad hz: {e}")
+            return 200, {"status": "started", "hz": profiler.hz}
+        if action == "stop":
+            if not profiler.stop():
+                raise _BadRequest("profiler not running")
+            return 200, {"status": "stopped", "samples": profiler.samples}
+        return 200, profiler.report(_num_param(params, "top", "30"))
 
     # -------------------------------------------------------------- influx
 

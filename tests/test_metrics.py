@@ -121,3 +121,73 @@ def test_scheduler_assertions_gated():
         t.join()
     finally:
         FiloSchedulers.enabled = False
+
+
+# ----------------------------------------------------------- profiler
+
+
+def test_sampling_profiler_catches_hot_function():
+    import threading
+    import time
+    from filodb_tpu.utils.profiler import SamplingProfiler
+
+    stop = threading.Event()
+
+    def hot_spin():
+        x = 0
+        while not stop.is_set():
+            for i in range(2000):
+                x += i * i
+        return x
+
+    t = threading.Thread(target=hot_spin, daemon=True)
+    t.start()
+    p = SamplingProfiler()
+    assert p.start(hz=200)
+    assert not p.start()            # double-start refused
+    time.sleep(0.5)
+    assert p.stop()
+    stop.set(); t.join(timeout=5)
+    assert p.samples > 20
+    rep = p.report()
+    assert "hot_spin" in rep, rep
+    assert "sampling profiler" in rep
+    # stopped profiler reports without error and start() resets counters
+    assert p.start(hz=50) and p.stop()
+
+
+def test_profiler_http_routes():
+    from filodb_tpu.http.routes import PromHttpApi
+    api = PromHttpApi({})
+    status, body = api.handle("POST", "/admin/profiler/start", {"hz": "150"})
+    assert status == 200 and body["status"] == "started"
+    status, _ = api.handle("POST", "/admin/profiler/start", {})
+    assert status == 400                      # already running
+    status, rep = api.handle("GET", "/admin/profiler/report", {})
+    assert status == 200 and "sampling profiler" in rep
+    status, body = api.handle("POST", "/admin/profiler/stop", {})
+    assert status == 200 and body["status"] == "stopped"
+    status, _ = api.handle("POST", "/admin/profiler/stop", {})
+    assert status == 400
+
+
+def test_profiler_input_validation():
+    from filodb_tpu.http.routes import PromHttpApi
+    from filodb_tpu.utils.profiler import SamplingProfiler
+    import pytest as _pytest
+    p = SamplingProfiler()
+    for bad in (float("inf"), float("nan"), 0.0, -5.0):
+        with _pytest.raises(ValueError):
+            p.start(bad)
+    assert p.start(10_000.0)           # clamped, not rejected
+    assert p.hz == p.MAX_HZ
+    assert p.stop()
+    api = PromHttpApi({})
+    status, body = api.handle("POST", "/admin/profiler/start", {"hz": "abc"})
+    assert status == 400, body
+    status, body = api.handle("POST", "/admin/profiler/start", {"hz": "inf"})
+    assert status == 400, body
+    status, body = api.handle("GET", "/admin/profiler/start", {})
+    assert status == 405, body
+    status, body = api.handle("POST", "/admin/profiler/bogus", {})
+    assert status == 404
