@@ -9,6 +9,7 @@ DenseSeriesStore (see blockstore.py) which the TPU kernels consume directly.
 """
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import logging
 import threading
@@ -132,7 +133,9 @@ class TimeSeriesShard:
         # dispatcher, ref: TimeSeriesShard.scala ingestSched + EvictionLock).
         # Queries do NOT take it — they use snapshot_read's seqlock retry
         # against DenseSeriesStore.generation, so reads stay lock-free
-        # unless a writer is mid-mutation.
+        # unless a writer is mid-mutation.  Acquire through _write_locked
+        # for stall logging (the ChunkMap lock-stall detection analogue,
+        # ref: memory/.../data/ChunkMap.scala:24-38).
         self.write_lock = threading.RLock()
         # flush-group membership maintained at creation so a group flush
         # walks only its own partitions, not all of them
@@ -142,6 +145,28 @@ class TimeSeriesShard:
         # readers holding the pid can still resolve it; flush prunes entries
         # past the grace window under write_lock (two-phase reclamation)
         self._evicted_tombstones: List[Tuple[float, int]] = []
+
+    # --------------------------------------------------------------- locking
+
+    @contextlib.contextmanager
+    def _write_locked(self, what: str, warn_after_s: float = 10.0):
+        """write_lock acquisition with stall detection: a writer waiting
+        past `warn_after_s` logs who is stalled and counts a metric before
+        blocking on, so operators see lock contention instead of silent
+        latency (ref: ChunkMap.scala:24-38 lock-stall logging)."""
+        if not self.write_lock.acquire(timeout=warn_after_s):
+            _log.warning(
+                "write_lock stall: %s waited >%.0fs on shard %d — another "
+                "writer (flush/ingest/paging/eviction) is holding it",
+                what, warn_after_s, self.shard_num)
+            metrics_registry.counter(
+                "write_lock_stalls", dataset=self.dataset,
+                shard=str(self.shard_num)).increment()
+            self.write_lock.acquire()
+        try:
+            yield
+        finally:
+            self.write_lock.release()
 
     # ------------------------------------------------------------------ ingest
 
@@ -209,7 +234,7 @@ class TimeSeriesShard:
         Returns number of samples ingested.  Thread-safe: serialized with
         flush/eviction/paging via write_lock; concurrent queries read
         through the seqlock (snapshot_read)."""
-        with self.write_lock:
+        with self._write_locked("ingest"):
             return self._ingest(batch, offset)
 
     def _ingest(self, batch: RecordBatch, offset: int = -1) -> int:
@@ -260,7 +285,7 @@ class TimeSeriesShard:
         group checkpoint (ref: TimeSeriesShard.doFlushSteps:969,
         writeChunks:1072, commitCheckpoint:1127).  Returns chunks written."""
         ingestion_time_ms = ingestion_time_ms or int(time.time() * 1000)
-        with self.write_lock:
+        with self._write_locked("flush"):
             with metrics_span("flush", dataset=self.dataset):
                 written = self._do_flush_group(group, ingestion_time_ms)
         metrics_registry.counter("chunks_flushed",
@@ -369,7 +394,7 @@ class TimeSeriesShard:
             out = fn()
             if store.generation == g0:
                 return out
-        with self.write_lock:
+        with self._write_locked("query_snapshot_fallback"):
             return fn()
 
     def lookup_partitions(self, filters: Sequence[ColumnFilter],
@@ -525,7 +550,7 @@ class TimeSeriesShard:
         if not need.any():
             return 0
         parts = [self.partitions[p] for p in np.asarray(pids)[need].tolist()]
-        with self.write_lock:
+        with self._write_locked("demand_paging"):
             return self.ensure_paged(parts, start_time_ms, end_time_ms,
                                      max_samples=max_samples)
 
@@ -693,7 +718,7 @@ class TimeSeriesShard:
                   else self.config.store.shard_mem_size)
         tail = (active_tail_rows if active_tail_rows is not None
                 else self.config.store.active_tail_rows)
-        with self.write_lock:
+        with self._write_locked("enforce_memory"):
             return self._enforce_memory(budget, tail)
 
     def _enforce_memory(self, budget: int, tail: int) -> int:
@@ -724,7 +749,7 @@ class TimeSeriesShard:
     def evict_ended_partitions(self, before_ms: int) -> int:
         """Evict partitions whose series ended before `before_ms`
         (ref: TimeSeriesShard.partitionsToEvict:1464)."""
-        with self.write_lock:
+        with self._write_locked("evict_ended"):
             return self._evict_ended_partitions(before_ms)
 
     def _evict_ended_partitions(self, before_ms: int) -> int:

@@ -902,7 +902,7 @@ class MultiSchemaPartitionsExec(LeafExecPlan):
             if mirror.is_fresh(store):
                 mirrored = mirror.gather_cached(rows)
             else:
-                with shard.write_lock:
+                with shard._write_locked("mirror_refresh"):
                     if mirror.ensure_fresh(store):
                         mirrored = mirror.gather_cached(rows)
         # value column selection: histograms gather [S, T, B]

@@ -221,3 +221,46 @@ def test_flush_scheduler_rotates_all_groups():
     store = sh.stores["prom-counter"]
     n = store.num_series
     assert (store.sealed[:n] == store.counts[:n]).all()
+
+
+def test_write_lock_stall_detection():
+    """A writer stalled past the threshold logs + counts a metric, then
+    still acquires once the holder releases (ChunkMap stall analogue)."""
+    from filodb_tpu.utils.metrics import registry
+    ms = TimeSeriesMemStore()
+    sh = ms.setup("prometheus", 0)
+    before = registry.counter("write_lock_stalls", dataset="prometheus",
+                              shard="0").value
+    acquired = threading.Event()
+    release = threading.Event()
+
+    def holder():
+        with sh.write_lock:
+            acquired.set()
+            release.wait(timeout=10)
+
+    t = threading.Thread(target=holder, daemon=True)
+    t.start()
+    assert acquired.wait(timeout=5), "holder never took the lock"
+    done = []
+
+    def stalled_writer():
+        with sh._write_locked("test", warn_after_s=0.05):
+            done.append(True)
+
+    w = threading.Thread(target=stalled_writer, daemon=True)
+    w.start()
+    # wait until the stall is OBSERVED (counter ticks) before releasing —
+    # a fixed sleep would race the writer reaching its timed acquire
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        if registry.counter("write_lock_stalls", dataset="prometheus",
+                            shard="0").value > before:
+            break
+        time.sleep(0.02)
+    release.set()
+    w.join(timeout=10); t.join(timeout=10)
+    assert done == [True]
+    after = registry.counter("write_lock_stalls", dataset="prometheus",
+                             shard="0").value
+    assert after == before + 1
