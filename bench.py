@@ -297,6 +297,13 @@ def main():
             if result is not None:
                 print(json.dumps(result))
                 return
+    else:
+        # probes hung, but probe flakiness is not proof the chip is gone:
+        # one bounded direct attempt before surrendering to CPU
+        result = _spawn_worker(args, "default", min(tpu_timeout, 600))
+        if result is not None:
+            print(json.dumps(result))
+            return
     result = _spawn_worker(args, "cpu", 1200)
     if result is not None:
         result["fallback"] = "cpu (default backend unavailable: probe=%s)" % plat

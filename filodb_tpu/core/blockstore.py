@@ -56,6 +56,12 @@ class DenseSeriesStore:
         # (ref: memory/.../Latch.scala, TimeSeriesShard.scala:817).
         self.generation = 0
         self._mut_depth = 0
+        # bumped by mutations that REARRANGE existing cells (prepend,
+        # eviction shifts, histogram scheme widening) as opposed to pure
+        # appends and capacity changes.  The device mirror uses it to
+        # decide whether an incremental tail upload is sound or a full
+        # re-upload is required.
+        self.shift_version = 0
         self.num_buckets = 0
         self.bucket_les: Optional[np.ndarray] = None
         self.ts = np.full((self._s_cap, self._t_cap), _PAD_TS, dtype=np.int64)
@@ -191,6 +197,7 @@ class DenseSeriesStore:
                             self.cols[c.name], self.bucket_les, union)
                 self.bucket_les = union
                 self.num_buckets = len(union)
+                self.shift_version += 1
         return not np.array_equal(inc, self.bucket_les)
 
     # ---- ingest ----
@@ -341,6 +348,7 @@ class DenseSeriesStore:
                 arr[row, :n] = np.nan if vals is None else vals
         self.counts[row] += n
         self.sealed[row] += n
+        self.shift_version += 1
         return n
 
     def append_row(self, row: int, ts: np.ndarray,
@@ -427,6 +435,7 @@ class DenseSeriesStore:
         # evicted page-only row must not keep stale upper coverage either)
         self.paged_floor[k > 0] = _PAD_TS
         self.paged_ceil[k > 0] = -1
+        self.shift_version += 1
         return True
 
     def compact_time(self, slack: int = 64) -> int:
@@ -444,6 +453,9 @@ class DenseSeriesStore:
             for name, arr in self.cols.items():
                 if arr is not None:
                     self.cols[name] = np.ascontiguousarray(arr[:, :target])
+            # NOTE: no shift_version bump — compaction only truncates
+            # unused capacity past time_used; live cell positions are
+            # untouched, so incremental mirror updates remain sound
             self._t_cap = target
             return before - self.nbytes
 

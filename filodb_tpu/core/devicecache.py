@@ -38,6 +38,63 @@ class _MirrorSnapshot:
     # per-series value bases subtracted in f64 before upload, so counter
     # deltas survive the f32 downcast (ops/timewindow.series_value_base)
     vbases: Dict[str, object]
+    # --- incremental-update bookkeeping (host-side, f64) ---
+    shift_version: int = -1             # store.shift_version at upload
+    counts: Optional[np.ndarray] = None        # int32 [S] at upload
+    host_vbases: Dict[str, np.ndarray] = dataclasses.field(
+        default_factory=dict)                  # f64 [S(, B)]
+    # per counter column: correction state at each row's last sample, so a
+    # purely-appended tail can be reset-corrected without re-reading the
+    # whole row: corrected_tail = correct(seed=last_raw ++ tail) + cum_drop
+    tail_last_raw: Dict[str, np.ndarray] = dataclasses.field(
+        default_factory=dict)                  # f64 [S(, B)]
+    tail_cum_drop: Dict[str, np.ndarray] = dataclasses.field(
+        default_factory=dict)                  # f64 [S(, B)]
+    # whether each row's vbase came from a real finite sample — a row that
+    # was all-NaN at upload (vbase 0) must get a REAL base from its first
+    # finite append or large counters land on device un-rebased
+    vbase_valid: Dict[str, np.ndarray] = dataclasses.field(
+        default_factory=dict)                  # bool [S(, B)]
+
+
+def _tail_state(raw: np.ndarray, corrected: np.ndarray
+                ) -> Tuple[np.ndarray, np.ndarray]:
+    """(last_raw, cum_drop) per series: the raw value at the last finite
+    sample and the cumulative reset correction there (0 / NaN-free when a
+    row has no finite samples).  raw/corrected are [S, T] or [S, T, B]."""
+    v = raw if raw.ndim == 2 else np.moveaxis(raw, 2, 1)
+    c = corrected if corrected.ndim == 2 else np.moveaxis(corrected, 2, 1)
+    shape2 = v.shape[:-1]
+    v2 = v.reshape(-1, v.shape[-1])
+    c2 = c.reshape(-1, c.shape[-1])
+    finite = np.isfinite(v2)
+    any_f = finite.any(axis=1)
+    last = np.where(any_f, v2.shape[1] - 1 -
+                    np.argmax(finite[:, ::-1], axis=1), 0)
+    rows = np.arange(v2.shape[0])
+    lr = np.where(any_f, v2[rows, last], np.nan)
+    cd = np.where(any_f, c2[rows, last] - v2[rows, last], 0.0)
+    return lr.reshape(shape2), cd.reshape(shape2)
+
+
+def _tails_matrix(col: np.ndarray, rows: np.ndarray, counts_old: np.ndarray,
+                  counts_new: np.ndarray
+                  ) -> Tuple[np.ndarray, np.ndarray]:
+    """Compact [R, L(, B)] matrix of each changed row's new samples
+    (positions [counts_old, counts_new)), NaN-padded, plus the structural
+    validity mask [R, L] (which distinguishes padding from genuinely-NaN
+    samples).  R = len(rows)."""
+    n_new = (counts_new - counts_old)[rows]
+    L = int(n_new.max())
+    pos = counts_old[rows][:, None] + np.arange(L)[None, :]
+    valid = np.arange(L)[None, :] < n_new[:, None]
+    pos_c = np.where(valid, pos, 0)
+    tails = col[rows[:, None], pos_c].astype(np.float64)
+    if tails.ndim == 3:
+        tails[~valid] = np.nan
+    else:
+        tails = np.where(valid, tails, np.nan)
+    return tails, valid
 
 
 class DeviceMirror:
@@ -81,20 +138,40 @@ class DeviceMirror:
         ts_off = np.where(pos < store.counts[:s, None], off, PAD_TS)
         cols: Dict[str, object] = {}
         vbases: Dict[str, object] = {}
+        host_vbases: Dict[str, np.ndarray] = {}
+        last_raw: Dict[str, np.ndarray] = {}
+        cum_drop: Dict[str, np.ndarray] = {}
         from filodb_tpu.ops.counter import rebase_values
         counter_cols = {c.name for c in store.schema.data_columns
                         if c.detect_drops or c.counter}
+        counts = store.counts[:s].copy()
+        vbase_valid: Dict[str, np.ndarray] = {}
         for name, arr in store.cols.items():
             if arr is not None:
                 # counter columns are reset-corrected in f64 BEFORE rebasing
                 # so f32 deltas are exact across resets; the leaf exec routes
                 # non-counter functions on counter columns around the mirror
-                rebased, vb = rebase_values(arr[:s, :t], name in counter_cols)
+                is_counter = name in counter_cols
+                rebased, vb, corrected = rebase_values(
+                    arr[:s, :t], is_counter, return_corrected=True)
                 cols[name] = jax.device_put(rebased)
                 vbases[name] = jax.device_put(vb)
+                host_vbases[name] = np.asarray(vb, np.float64)
+                fin = np.isfinite(corrected)
+                vbase_valid[name] = fin.any(axis=1)
+                if is_counter:
+                    raw = np.asarray(arr[:s, :t], np.float64)
+                    lr, cd = _tail_state(raw, corrected)
+                    last_raw[name] = lr
+                    cum_drop[name] = cd
         # single publication point (GIL-atomic): see _MirrorSnapshot
         self._snap = _MirrorSnapshot(gen0, base_ms, t,
-                                     jax.device_put(ts_off), cols, vbases)
+                                     jax.device_put(ts_off), cols, vbases,
+                                     shift_version=store.shift_version,
+                                     counts=counts, host_vbases=host_vbases,
+                                     tail_last_raw=last_raw,
+                                     tail_cum_drop=cum_drop,
+                                     vbase_valid=vbase_valid)
         return True
 
     def is_fresh(self, store) -> bool:
@@ -103,12 +180,158 @@ class DeviceMirror:
 
     def ensure_fresh(self, store) -> bool:
         """Re-upload if the store moved on.  Callers must exclude writers
-        (hold the shard write_lock) — the refresh copies the full host
-        arrays and must not race a mutation.  Returns False when the store
-        exceeds the HBM cap (callers fall back to host gather)."""
+        (hold the shard write_lock) — the refresh copies host arrays and
+        must not race a mutation.  Append-only changes take the incremental
+        path (transfer O(new samples), not O(S*T)); anything that
+        rearranged cells falls back to a full upload.  Returns False when
+        the store exceeds the HBM cap (callers fall back to host gather)."""
         if self.is_fresh(store):
             return True
+        snap = self._snap
+        if snap is not None and snap.shift_version == store.shift_version \
+                and snap.counts is not None:
+            try:
+                if self._refresh_incremental(store, snap):
+                    return True
+            except Exception:  # noqa: BLE001 — incremental is an optimization
+                from filodb_tpu.utils.metrics import registry
+                registry.counter(
+                    "device_mirror_incremental_errors").increment()
         return self._refresh(store)
+
+    def _refresh_incremental(self, store, snap: _MirrorSnapshot) -> bool:
+        """Upload only the appended tail cells.  Sound exactly when nothing
+        rearranged existing cells (shift_version unchanged) and counts only
+        grew; returns False to request a full refresh otherwise."""
+        import jax
+        import jax.numpy as jnp
+
+        from filodb_tpu.ops.counter import host_counter_correct
+        from filodb_tpu.ops.timewindow import series_value_base
+        from filodb_tpu.utils.metrics import registry as metrics_registry
+
+        gen0 = store.generation
+        s_old = snap.counts.shape[0]
+        s_new = store.num_series
+        t_new = max(store.time_used, 1)
+        if s_new < s_old or t_new < snap.t_used:
+            return False
+        if set(n for n, a in store.cols.items() if a is not None) \
+                != set(snap.cols):
+            return False                 # a column appeared (e.g. hist alloc)
+        if self._nbytes(store) > self.hbm_limit_bytes:
+            return False
+        counts_new = store.counts[:s_new].astype(np.int32).copy()
+        counts_old = np.zeros(s_new, dtype=np.int32)
+        counts_old[:s_old] = snap.counts
+        delta = counts_new - counts_old
+        if (delta < 0).any():
+            return False
+        total_new = int(delta.sum())
+        if total_new == 0 and s_new == s_old and t_new == snap.t_used:
+            self._snap = dataclasses.replace(snap, gen=gen0)
+            return True                  # bookkeeping-only generation bump
+        if total_new > 0.5 * s_new * t_new:
+            return False                 # full upload is cheaper
+        rows = np.flatnonzero(delta > 0)
+        # flat (row, pos) scatter indices over all new cells
+        n_new = delta[rows]
+        idx_r = np.repeat(rows, n_new)
+        starts = counts_old[rows]
+        idx_p = (np.arange(total_new)
+                 - np.repeat(np.cumsum(n_new) - n_new, n_new)
+                 + np.repeat(starts, n_new))
+        new_ts = store.ts[idx_r, idx_p]
+        off = new_ts - snap.base_ms
+        if off.size and (off.min() <= -(1 << 30) or off.max() >= (1 << 30)):
+            return False                 # out of int32 offset range: re-base
+
+        dS, dT = s_new - s_old, t_new - snap.t_used
+        ts_dev = snap.ts_off
+        if dS or dT:
+            ts_dev = jnp.pad(ts_dev, ((0, dS), (0, dT)),
+                             constant_values=PAD_TS)
+        ts_dev = ts_dev.at[idx_r, idx_p].set(off.astype(np.int32))
+
+        counter_cols = {c.name for c in store.schema.data_columns
+                        if c.detect_drops or c.counter}
+        new_cols: Dict[str, object] = {}
+        new_vbases: Dict[str, object] = {}
+        host_vbases = dict(snap.host_vbases)
+        last_raw = dict(snap.tail_last_raw)
+        cum_drop = dict(snap.tail_cum_drop)
+        vbase_valid = dict(snap.vbase_valid)
+        for name, dev in snap.cols.items():
+            arr = store.cols[name]
+            hist = arr.ndim == 3
+            tails, valid = _tails_matrix(arr, rows, counts_old, counts_new)
+            vb = host_vbases[name]
+            vb_new = np.zeros((s_new,) + vb.shape[1:], np.float64)
+            vb_new[:s_old] = vb
+            if name in counter_cols:
+                lr = np.full((s_new,) + vb.shape[1:], np.nan)
+                lr[:s_old] = last_raw[name]
+                cd = np.zeros((s_new,) + vb.shape[1:], np.float64)
+                cd[:s_old] = cum_drop[name]
+                seed = lr[rows][:, None] if not hist else \
+                    lr[rows][:, None, :]
+                seeded = np.concatenate([seed, tails], axis=1)
+                corr_seeded = host_counter_correct(seeded)
+                corrected = corr_seeded[:, 1:] + (
+                    cd[rows][:, None, :] if hist else cd[rows][:, None])
+                n_lr, n_cd = _tail_state(seeded, corr_seeded)
+                upd = np.isfinite(n_lr)
+                lr[rows] = np.where(upd, n_lr, lr[rows])
+                cd[rows] = np.where(
+                    upd, (cd[rows] + n_cd), cd[rows])
+                last_raw[name] = lr
+                cum_drop[name] = cd
+                vals = corrected
+            else:
+                vals = tails
+            # (re)establish vbase for any row/bucket whose base never came
+            # from a finite sample: the first finite appended value becomes
+            # the base — without this, large counters appended to a
+            # previously-all-NaN row land on device un-rebased and their
+            # f32 deltas vanish
+            vv = np.zeros((s_new,) + vb.shape[1:], dtype=bool)
+            vv[:s_old] = vbase_valid[name]
+            tail_fin = np.isfinite(vals).any(axis=1)       # [R(, B)]
+            tail_base = series_value_base(vals)            # [R(, B)]
+            upd_vb = (~vv[rows]) & tail_fin
+            vb_changed = bool(upd_vb.any())
+            if vb_changed:
+                vb_new[rows] = np.where(upd_vb, tail_base, vb_new[rows])
+            vv[rows] = vv[rows] | tail_fin
+            vbase_valid[name] = vv
+            host_vbases[name] = vb_new
+            # rebased cell values, flattened to the scatter order (row-major
+            # over [rows, ascending positions] — exactly idx_r/idx_p order)
+            rb = vals - (vb_new[rows][:, None, :] if hist
+                         else vb_new[rows][:, None])
+            flat = rb[valid]
+            col_dev = dev
+            if dS or dT:
+                pad = ((0, dS), (0, dT)) + (((0, 0),) if hist else ())
+                col_dev = jnp.pad(col_dev, pad, constant_values=np.nan)
+            new_cols[name] = col_dev.at[idx_r, idx_p].set(
+                flat.astype(col_dev.dtype))
+            vb_dev = snap.vbases[name]
+            if dS or vb_changed:
+                new_vbases[name] = jax.device_put(
+                    vb_new.astype(vb_dev.dtype))
+            else:
+                new_vbases[name] = vb_dev
+
+        metrics_registry.counter("device_mirror_incremental").increment()
+        metrics_registry.gauge("device_mirror_bytes").update(
+            self._nbytes(store))
+        self._snap = _MirrorSnapshot(
+            gen0, snap.base_ms, t_new, ts_dev, new_cols, new_vbases,
+            shift_version=store.shift_version, counts=counts_new,
+            host_vbases=host_vbases, tail_last_raw=last_raw,
+            tail_cum_drop=cum_drop, vbase_valid=vbase_valid)
+        return True
 
     def gather_cached(self, rows: np.ndarray
                       ) -> Optional[Tuple[object, Dict[str, object],

@@ -52,19 +52,23 @@ def host_counter_correct(vals: np.ndarray) -> np.ndarray:
     return out
 
 
-def rebase_values(vals: np.ndarray, correct_counter: bool
-                  ) -> Tuple[np.ndarray, np.ndarray]:
+def rebase_values(vals: np.ndarray, correct_counter: bool,
+                  return_corrected: bool = False):
     """The single host-side prep step for device value columns: optional f64
     reset correction, then per-series rebasing.  Returns (rebased f64, vbase)
-    with vbase [S] (or [S, B] for histograms).  Both the leaf exec raw path
-    and the DeviceMirror upload MUST use this so the two paths cannot
-    diverge numerically."""
+    with vbase [S] (or [S, B] for histograms) — plus the corrected f64
+    matrix itself when return_corrected (so callers needing it don't run
+    the O(S*T) correction scan twice).  Both the leaf exec raw path and the
+    DeviceMirror upload MUST use this so the two paths cannot diverge
+    numerically."""
     from filodb_tpu.ops.timewindow import series_value_base
     v64 = np.asarray(vals, dtype=np.float64)
     if correct_counter:
         v64 = host_counter_correct(v64)
     vbase = series_value_base(v64)
     rebased = v64 - (vbase[:, None, :] if v64.ndim == 3 else vbase[:, None])
+    if return_corrected:
+        return rebased, vbase, v64
     return rebased, vbase
 
 
