@@ -433,8 +433,45 @@ def bench_memory(quick: bool):
               u["resident_bytes"] / (S * T), 3))
 
 
+def bench_intsum(quick: bool):
+    """Bit-packed int vector decode + scan-sum (ref: IntSumReadBenchmark,
+    BasicFiloBenchmark — sum over an encoded int vector)."""
+    from filodb_tpu.memory import intvec
+    n = 100_000 if quick else 1_000_000
+    vals = np.random.default_rng(1).integers(0, 1000, n).astype(np.int64)
+    enc = intvec.pack_ints(vals)
+    iters = 5 if quick else 20
+    per = _time_it(lambda: int(intvec.unpack_ints(enc, n).sum()), iters)
+    _emit("intsum", "decode_sum_values_per_sec", n / per, "values/s",
+          width_bits=intvec.packed_width_bits(enc),
+          bytes_per_value=round(len(enc) / n, 3))
+    per = _time_it(lambda: intvec.pack_ints(vals), iters)
+    _emit("intsum", "encode_values_per_sec", n / per, "values/s")
+
+
+def bench_utf8(quick: bool):
+    """UTF8 blob + dictionary string vector encode/decode
+    (ref: UTF8StringBenchmark, DictStringBenchmark)."""
+    from filodb_tpu.memory import utf8vec
+    n = 10_000 if quick else 100_000
+    vocab = [f"value-{i}".encode() for i in range(64)]
+    col = [vocab[i % 64] for i in range(n)]
+    iters = 3 if quick else 10
+    per = _time_it(lambda: utf8vec.pack_utf8(col), iters)
+    _emit("utf8", "blob_encode_strings_per_sec", n / per, "strings/s")
+    enc = utf8vec.pack_dict_utf8(col)
+    per = _time_it(lambda: utf8vec.pack_dict_utf8(col), iters)
+    _emit("utf8", "dict_encode_strings_per_sec", n / per, "strings/s",
+          bytes_per_string=round(len(enc) / n, 3),
+          plain_bytes_per_string=round(len(utf8vec.pack_utf8(col)) / n, 3))
+    per = _time_it(lambda: utf8vec.unpack_dict_utf8(enc), iters)
+    _emit("utf8", "dict_decode_strings_per_sec", n / per, "strings/s")
+
+
 BENCHES: Dict[str, Callable[[bool], None]] = {
     "ingestion": bench_ingestion,
+    "intsum": bench_intsum,
+    "utf8": bench_utf8,
     "memory": bench_memory,
     "encoding": bench_encoding,
     "index": bench_index,

@@ -231,6 +231,10 @@ class DeviceMirror:
         if total_new == 0 and s_new == s_old and t_new == snap.t_used:
             self._snap = dataclasses.replace(snap, gen=gen0)
             return True                  # bookkeeping-only generation bump
+        if total_new == 0:
+            # series/time grew but no new cells (e.g. new rows whose batch
+            # was dropped as out-of-order): pad-only, no scatter to build
+            return self._refresh_pad_only(store, snap, gen0, s_new, t_new)
         if total_new > 0.5 * s_new * t_new:
             return False                 # full upload is cheaper
         rows = np.flatnonzero(delta > 0)
@@ -326,6 +330,56 @@ class DeviceMirror:
         metrics_registry.counter("device_mirror_incremental").increment()
         metrics_registry.gauge("device_mirror_bytes").update(
             self._nbytes(store))
+        self._snap = _MirrorSnapshot(
+            gen0, snap.base_ms, t_new, ts_dev, new_cols, new_vbases,
+            shift_version=store.shift_version, counts=counts_new,
+            host_vbases=host_vbases, tail_last_raw=last_raw,
+            tail_cum_drop=cum_drop, vbase_valid=vbase_valid)
+        return True
+
+    def _refresh_pad_only(self, store, snap, gen0: int, s_new: int,
+                          t_new: int) -> bool:
+        """Grow the snapshot to [s_new, t_new] when no cell values changed
+        (new rows registered but their samples were all dropped, or the time
+        axis grew without appends).  New rows start empty: PAD_TS offsets,
+        NaN values, invalid vbase."""
+        import jax.numpy as jnp
+
+        from filodb_tpu.utils.metrics import registry as metrics_registry
+        dS, dT = s_new - snap.counts.shape[0], t_new - snap.t_used
+        s_old = snap.counts.shape[0]
+        ts_dev = jnp.pad(snap.ts_off, ((0, dS), (0, dT)),
+                         constant_values=PAD_TS) if (dS or dT) else snap.ts_off
+        new_cols, new_vbases = {}, {}
+        host_vbases, last_raw = dict(snap.host_vbases), dict(snap.tail_last_raw)
+        cum_drop, vbase_valid = dict(snap.tail_cum_drop), dict(snap.vbase_valid)
+
+        def grow(a, fill, dtype=None):
+            out = np.full((s_new,) + a.shape[1:], fill, dtype or a.dtype)
+            out[:s_old] = a
+            return out
+
+        for name, dev in snap.cols.items():
+            if dS or dT:
+                pad = ((0, dS), (0, dT)) + \
+                    (((0, 0),) if dev.ndim == 3 else ())
+                dev = jnp.pad(dev, pad, constant_values=np.nan)
+            new_cols[name] = dev
+            host_vbases[name] = grow(host_vbases[name], 0.0)
+            vbase_valid[name] = grow(vbase_valid[name], False)
+            if name in last_raw:
+                last_raw[name] = grow(last_raw[name], np.nan)
+                cum_drop[name] = grow(cum_drop[name], 0.0)
+            vb_dev = snap.vbases[name]
+            if dS:
+                import jax
+                vb_dev = jax.device_put(
+                    host_vbases[name].astype(vb_dev.dtype))
+            new_vbases[name] = vb_dev
+
+        counts_new = np.zeros(s_new, dtype=np.int32)
+        counts_new[:s_old] = snap.counts
+        metrics_registry.counter("device_mirror_incremental").increment()
         self._snap = _MirrorSnapshot(
             gen0, snap.base_ms, t_new, ts_dev, new_cols, new_vbases,
             shift_version=store.shift_version, counts=counts_new,

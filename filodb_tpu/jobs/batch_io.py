@@ -24,6 +24,7 @@ from typing import Dict, Iterator, List, Optional, Sequence, Tuple
 import numpy as np
 
 from filodb_tpu.core.index import ColumnFilter
+from filodb_tpu.memory import utf8vec
 from filodb_tpu.core.partkey import PartKey
 from filodb_tpu.core.records import RecordBatch
 from filodb_tpu.core.schemas import Schemas, DEFAULT_SCHEMAS
@@ -78,8 +79,11 @@ def export_series(memstore, dataset: str, filters: Sequence[ColumnFilter],
                 arrays[f"col_{i}_{c}"] = v
         if les is not None:
             arrays[f"les_{i}"] = np.asarray(les, np.float64)
-    arrays["__labels__"] = np.frombuffer(
-        json.dumps(keys).encode("utf-8"), dtype=np.uint8)
+    # Label table is dict-encoded columnar (memory/utf8vec.py) — the
+    # DictUTF8Vector analogue: low-cardinality label columns collapse to a
+    # few bits/row instead of repeating strings per series.
+    arrays["__labels_dict__"] = np.frombuffer(
+        utf8vec.pack_label_table(keys), dtype=np.uint8)
     arrays["__schemas__"] = np.frombuffer(
         json.dumps(schema_names).encode("utf-8"), dtype=np.uint8)
     with open(path, "wb") as f:
@@ -90,7 +94,10 @@ def export_series(memstore, dataset: str, filters: Sequence[ColumnFilter],
 def load_bundle(path: str):
     """(labels, schema_names, per-series {ts, cols, les}) from a bundle."""
     with np.load(path) as z:
-        labels = json.loads(bytes(z["__labels__"]).decode("utf-8"))
+        if "__labels_dict__" in z.files:
+            labels = utf8vec.unpack_label_table(bytes(z["__labels_dict__"]))
+        else:  # bundles written before dict encoding
+            labels = json.loads(bytes(z["__labels__"]).decode("utf-8"))
         schemas = json.loads(bytes(z["__schemas__"]).decode("utf-8"))
         # one pass over the archive members (NOT per-series scans: bundles
         # can hold 100k+ series and the member list is large)

@@ -1,0 +1,111 @@
+"""Bit-packed int vectors + UTF8/dict vectors — property-style round trips
+(mirrors ref memory/src/test/.../EncodingPropertiesTest.scala,
+IntBinaryVectorTest, UTF8VectorTest)."""
+import numpy as np
+import pytest
+
+from filodb_tpu.memory import intvec, utf8vec
+
+
+RNG = np.random.default_rng(42)
+
+
+@pytest.mark.parametrize("span_bits", [0, 1, 2, 3, 7, 9, 15, 17, 31, 40])
+def test_intvec_roundtrip_widths(span_bits):
+    n = 1000
+    base = int(RNG.integers(-(1 << 40), 1 << 40))
+    vals = base + RNG.integers(0, (1 << span_bits) if span_bits else 1,
+                               size=n).astype(np.int64)
+    enc = intvec.pack_ints(vals)
+    out = intvec.unpack_ints(enc, n)
+    np.testing.assert_array_equal(out, vals)
+
+
+def test_intvec_const_is_tiny():
+    vals = np.full(10_000, 123456789, dtype=np.int64)
+    enc = intvec.pack_ints(vals)
+    assert len(enc) == 10  # header only
+    assert intvec.packed_width_bits(enc) == 0
+    np.testing.assert_array_equal(intvec.unpack_ints(enc, 10_000), vals)
+
+
+def test_intvec_width_selection():
+    # span 3 -> 2 bits, span 200 -> 8 bits, span 70000 -> 32 bits
+    for span, bits in [(3, 2), (10, 4), (200, 8), (60_000, 16),
+                       (70_000, 32), (1 << 40, 64)]:
+        enc = intvec.pack_ints(np.array([5, 5 + span], dtype=np.int64))
+        assert intvec.packed_width_bits(enc) == bits, span
+
+
+def test_intvec_2bit_packing_density():
+    vals = RNG.integers(0, 4, size=4000).astype(np.int64)
+    enc = intvec.pack_ints(vals)
+    # 4000 values at 2 bits = 1000 bytes + 10 header
+    assert len(enc) <= 1024
+    np.testing.assert_array_equal(intvec.unpack_ints(enc, 4000), vals)
+
+
+def test_intvec_empty_and_single():
+    assert len(intvec.unpack_ints(intvec.pack_ints(np.array([], np.int64)), 0)) == 0
+    one = np.array([-7], dtype=np.int64)
+    np.testing.assert_array_equal(
+        intvec.unpack_ints(intvec.pack_ints(one), 1), one)
+
+
+def test_intvec_negative_range():
+    vals = RNG.integers(-1000, -900, size=333).astype(np.int64)
+    np.testing.assert_array_equal(
+        intvec.unpack_ints(intvec.pack_ints(vals), 333), vals)
+
+
+def test_intvec_masked_roundtrip():
+    n = 257
+    vals = RNG.integers(0, 100, size=n).astype(np.int64)
+    valid = RNG.random(n) < 0.7
+    enc = intvec.pack_ints_masked(vals, valid)
+    out, out_valid = intvec.unpack_ints_masked(enc, n)
+    np.testing.assert_array_equal(out_valid, valid)
+    np.testing.assert_array_equal(out[valid], vals[valid])
+    assert (out[~valid] == 0).all()
+
+
+def test_utf8_blob_roundtrip():
+    strings = [b"", b"a", "héllo".encode(), b"x" * 1000, b"tail"]
+    data = utf8vec.pack_utf8(strings)
+    out, off = utf8vec.unpack_utf8(data)
+    assert out == strings and off == len(data)
+
+
+def test_dict_utf8_roundtrip_and_compression():
+    # 10k rows, 5 distinct values -> codes pack at 4 bits
+    vocab = [b"prod", b"staging", b"dev", b"test", b"canary"]
+    col = [vocab[i % 5] for i in range(10_000)]
+    enc = utf8vec.pack_dict_utf8(col)
+    assert utf8vec.unpack_dict_utf8(enc) == col
+    assert utf8vec.dict_cardinality(enc) == 5
+    plain = utf8vec.pack_utf8(col)
+    assert len(enc) < len(plain) / 5
+
+
+def test_label_table_roundtrip_sparse_keys():
+    rows = [
+        {"job": "api", "instance": "i-1", "_metric_": "heap"},
+        {"job": "api", "zone": "us-east", "_metric_": "heap"},
+        {"job": "db", "instance": "i-2", "_metric_": "cpu"},
+        {},
+    ]
+    enc = utf8vec.pack_label_table(rows)
+    assert utf8vec.unpack_label_table(enc) == rows
+
+
+def test_label_table_empty_string_values_preserved():
+    rows = [{"a": "", "b": "x"}, {"b": ""}, {"a": "y"}]
+    enc = utf8vec.pack_label_table(rows)
+    assert utf8vec.unpack_label_table(enc) == rows
+
+
+def test_label_table_large():
+    rows = [{"job": f"job{i % 3}", "instance": f"inst-{i}"}
+            for i in range(5000)]
+    enc = utf8vec.pack_label_table(rows)
+    assert utf8vec.unpack_label_table(enc) == rows

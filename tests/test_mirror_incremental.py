@@ -211,3 +211,34 @@ def test_incremental_correctness_through_query_path():
     for k in want:
         np.testing.assert_allclose(got[k], want[k], rtol=1e-5,
                                    equal_nan=True)
+
+
+def test_series_growth_with_zero_new_samples_pads_without_error():
+    """A new row registered with no surviving samples (s grows, no new
+    cells) must take the cheap pad-only path, not the error fallback."""
+    ms = TimeSeriesMemStore()
+    sh = ms.setup("prometheus", 0)
+    sh.ingest(counter_batch(10, 60, start_ms=START), offset=0)
+    store = sh.stores["prom-counter"]
+    mirror = DeviceMirror()
+    assert mirror.ensure_fresh(store)
+    # register a row directly with zero samples (what a fully-dropped
+    # out-of-order batch leaves behind), bumping the generation
+    with store.mutation():
+        store.new_row()
+    before_err = registry.counter("device_mirror_incremental_errors").value
+    before_incr = _incr_count()
+    assert mirror.ensure_fresh(store)
+    assert registry.counter("device_mirror_incremental_errors").value \
+        == before_err
+    assert _incr_count() == before_incr + 1
+    _assert_equivalent(store, mirror)
+    # and appends after the pad continue incrementally + correctly
+    full = counter_batch(10, 90, start_ms=START)
+    k = full.timestamps >= START + 60 * 10_000
+    sh.ingest(RecordBatch(full.schema, full.part_keys, full.part_idx[k],
+                          full.timestamps[k],
+                          {kk: v[k] for kk, v in full.columns.items()},
+                          full.bucket_les), offset=1)
+    assert mirror.ensure_fresh(store)
+    _assert_equivalent(store, mirror)
