@@ -17,6 +17,7 @@ its own chunk-scan window.
 from __future__ import annotations
 
 import dataclasses
+import itertools
 from typing import Dict, Optional, Tuple
 
 import numpy as np
@@ -55,6 +56,12 @@ class _MirrorSnapshot:
     # finite append or large counters land on device un-rebased
     vbase_valid: Dict[str, np.ndarray] = dataclasses.field(
         default_factory=dict)                  # bool [S(, B)]
+    # --- fused-kernel eligibility (ops/pallas_fused.py preconditions) ---
+    # every row shares one scrape grid (identical ts offsets + counts)
+    uniform_grid: bool = False
+    ts_row0: Optional[np.ndarray] = None       # int32 [T] row-0 offsets
+    # per column: no NaN anywhere in the counted region
+    col_finite: Dict[str, bool] = dataclasses.field(default_factory=dict)
 
 
 def _tail_state(raw: np.ndarray, corrected: np.ndarray
@@ -97,12 +104,18 @@ def _tails_matrix(col: np.ndarray, rows: np.ndarray, counts_old: np.ndarray,
     return tails, valid
 
 
+_mirror_serial = itertools.count(1)
+
+
 class DeviceMirror:
     """One mirror per DenseSeriesStore (lazily attached)."""
 
     def __init__(self, hbm_limit_bytes: int = 8 << 30):
         self.hbm_limit_bytes = hbm_limit_bytes
         self._snap: Optional[_MirrorSnapshot] = None
+        # process-unique identity for external caches: id() can be reused
+        # by a later allocation after this mirror is collected
+        self.serial = next(_mirror_serial)
 
     def _nbytes(self, store) -> int:
         t = max(store.time_used, 1)
@@ -146,6 +159,9 @@ class DeviceMirror:
                         if c.detect_drops or c.counter}
         counts = store.counts[:s].copy()
         vbase_valid: Dict[str, np.ndarray] = {}
+        col_finite: Dict[str, bool] = {}
+        uniform = bool(s > 0 and (counts == counts[0]).all()
+                       and (ts_off == ts_off[0:1]).all())
         for name, arr in store.cols.items():
             if arr is not None:
                 # counter columns are reset-corrected in f64 BEFORE rebasing
@@ -159,6 +175,12 @@ class DeviceMirror:
                 host_vbases[name] = np.asarray(vb, np.float64)
                 fin = np.isfinite(corrected)
                 vbase_valid[name] = fin.any(axis=1)
+                # counted region fully finite (padding beyond counts is NaN
+                # by construction and doesn't disqualify)
+                pos_ok = pos >= counts[:, None]
+                col_finite[name] = bool(
+                    (fin | pos_ok[..., None] if fin.ndim == 3
+                     else fin | pos_ok).all())
                 if is_counter:
                     raw = np.asarray(arr[:s, :t], np.float64)
                     lr, cd = _tail_state(raw, corrected)
@@ -171,7 +193,11 @@ class DeviceMirror:
                                      counts=counts, host_vbases=host_vbases,
                                      tail_last_raw=last_raw,
                                      tail_cum_drop=cum_drop,
-                                     vbase_valid=vbase_valid)
+                                     vbase_valid=vbase_valid,
+                                     uniform_grid=uniform,
+                                     ts_row0=(ts_off[0].copy() if uniform
+                                              else None),
+                                     col_finite=col_finite)
         return True
 
     def is_fresh(self, store) -> bool:
@@ -257,6 +283,21 @@ class DeviceMirror:
                              constant_values=PAD_TS)
         ts_dev = ts_dev.at[idx_r, idx_p].set(off.astype(np.int32))
 
+        # uniform-grid preservation: every row appended the same offsets
+        uniform = (snap.uniform_grid and s_new == s_old
+                   and rows.size == s_new
+                   and bool((delta == delta[0]).all()))
+        ts_row0 = None
+        if uniform:
+            off2 = off.reshape(s_new, -1)
+            uniform = bool((off2 == off2[0:1]).all())
+            if uniform:
+                ts_row0 = np.full(t_new, PAD_TS, np.int32)
+                ts_row0[:snap.t_used] = snap.ts_row0
+                k = off2.shape[1]
+                start0 = int(counts_old[0])
+                ts_row0[start0:start0 + k] = off2[0].astype(np.int32)
+
         counter_cols = {c.name for c in store.schema.data_columns
                         if c.detect_drops or c.counter}
         new_cols: Dict[str, object] = {}
@@ -265,6 +306,7 @@ class DeviceMirror:
         last_raw = dict(snap.tail_last_raw)
         cum_drop = dict(snap.tail_cum_drop)
         vbase_valid = dict(snap.vbase_valid)
+        col_finite = dict(snap.col_finite)
         for name, dev in snap.cols.items():
             arr = store.cols[name]
             hist = arr.ndim == 3
@@ -314,6 +356,8 @@ class DeviceMirror:
             rb = vals - (vb_new[rows][:, None, :] if hist
                          else vb_new[rows][:, None])
             flat = rb[valid]
+            col_finite[name] = bool(col_finite.get(name, False)
+                                    and np.isfinite(flat).all())
             col_dev = dev
             if dS or dT:
                 pad = ((0, dS), (0, dT)) + (((0, 0),) if hist else ())
@@ -334,7 +378,8 @@ class DeviceMirror:
             gen0, snap.base_ms, t_new, ts_dev, new_cols, new_vbases,
             shift_version=store.shift_version, counts=counts_new,
             host_vbases=host_vbases, tail_last_raw=last_raw,
-            tail_cum_drop=cum_drop, vbase_valid=vbase_valid)
+            tail_cum_drop=cum_drop, vbase_valid=vbase_valid,
+            uniform_grid=uniform, ts_row0=ts_row0, col_finite=col_finite)
         return True
 
     def _refresh_pad_only(self, store, snap, gen0: int, s_new: int,
@@ -380,14 +425,38 @@ class DeviceMirror:
         counts_new = np.zeros(s_new, dtype=np.int32)
         counts_new[:s_old] = snap.counts
         metrics_registry.counter("device_mirror_incremental").increment()
+        # pad-only is only reachable with new (empty) rows — dS > 0, since
+        # time_used == counts.max() makes pure time growth impossible with
+        # zero new cells — and empty rows always break grid uniformity
         self._snap = _MirrorSnapshot(
             gen0, snap.base_ms, t_new, ts_dev, new_cols, new_vbases,
             shift_version=store.shift_version, counts=counts_new,
             host_vbases=host_vbases, tail_last_raw=last_raw,
-            tail_cum_drop=cum_drop, vbase_valid=vbase_valid)
+            tail_cum_drop=cum_drop, vbase_valid=vbase_valid,
+            uniform_grid=False, ts_row0=None,
+            col_finite=dict(snap.col_finite))
         return True
 
-    def gather_cached(self, rows: np.ndarray
+    def snapshot(self):
+        """The current immutable snapshot (None before first refresh).
+        Callers that combine gather_cached with fused_eligible MUST read
+        the snapshot once and pass it to both — re-reading _snap between
+        the calls can pair one snapshot's grid with another's values."""
+        return self._snap
+
+    def fused_eligible(self, col_name: str, snap=None) -> Optional[np.ndarray]:
+        """Row-0 ts offsets (int32 [T], PAD_TS beyond counts) when the
+        snapshot meets the pallas_fused preconditions for this column —
+        one shared scrape grid and a fully-finite counted region — else
+        None.  Any row subset of a uniform grid is itself uniform."""
+        snap = snap if snap is not None else self._snap
+        if snap is None or not snap.uniform_grid or snap.ts_row0 is None:
+            return None
+        if not snap.col_finite.get(col_name, False):
+            return None
+        return snap.ts_row0
+
+    def gather_cached(self, rows: np.ndarray, snap=None
                       ) -> Optional[Tuple[object, Dict[str, object],
                                           Dict[str, object], int]]:
         """(ts_off [R, T], cols, vbases, base_ms) device arrays for the
@@ -396,9 +465,10 @@ class DeviceMirror:
         immutable and was fresh when ensure_fresh validated it (a concurrent
         refresh just publishes a new snapshot; this query keeps its own).
         Offsets are relative to the returned base_ms; values rebased by
-        vbases."""
+        vbases.  Pass `snap` (from .snapshot()) to pin a specific snapshot
+        when pairing with other per-snapshot reads."""
         import jax.numpy as jnp
-        snap = self._snap
+        snap = snap if snap is not None else self._snap
         if snap is None:
             return None
         idx = jnp.asarray(rows.astype(np.int32))

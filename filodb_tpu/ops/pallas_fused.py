@@ -1,0 +1,261 @@
+"""Pallas TPU kernel: fused windowed-rate + group-sum in one HBM pass.
+
+The headline query shape — `sum by (...) (rate(counter[5m]))` — costs the
+XLA path several passes over the [S, T] value matrix (validity mask, reset
+correction scan, boundary gathers, then a scatter-add segment sum).  On a
+bandwidth-bound chip the passes are the latency.  This kernel computes the
+whole thing in ONE read of the values, by turning every data-dependent
+access into an MXU matmul against tiny host-built selection matrices:
+
+- boundary gathers  v[:, first[w]]  ->  v @ O1, O1[t, w] = 1{t == first[w]}
+- cumulative reset corrections      ->  drops @ L1, L1[t, w] = 1{t <= first[w]}
+  (drops[s, t] = max(prev - cur, 0) is local once rows are dense)
+- group segment-sum                 ->  onehot(gids) @ rate  on the MXU
+
+Preconditions (the caller gates, see `can_fuse`): one shared scrape grid
+across series (the devicecache/shared_grid invariant) and dense rows — no
+NaN inside the counted region.  Anything else falls back to the general
+XLA path in ops/rangefns.py; semantics here match it bit-for-bit in f32
+(same extrapolation rules, ref: RateFunctions.scala:37-76; same 3-phase
+aggregate contract, ref: exec/AggrOverRangeVectors.scala:17-125).
+
+Works on CPU via interpret=True (tests); on TPU via the MXU.
+"""
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+_LANE = 128
+_BS = 256          # series rows per grid step (VMEM-sized)
+
+
+def _pad_to(x: int, m: int) -> int:
+    return (x + m - 1) // m * m
+
+
+class FusedPlan(NamedTuple):
+    """Host-built query plan: selection matrices + shared window scalars."""
+    o1: np.ndarray       # [Tp, Wp] f32  one-hot at first[w]
+    o2: np.ndarray       # [Tp, Wp] f32  one-hot at last[w]
+    l2: np.ndarray       # [Tp, Wp] f32  1{t <= last[w]}  (drops path)
+    l1: np.ndarray       # [Tp, Wp] f32  1{t <= first[w]} (drops path)
+    t1: np.ndarray       # [1, Wp] f32   ts at first[w]
+    t2: np.ndarray       # [1, Wp] f32   ts at last[w]
+    n: np.ndarray        # [1, Wp] f32   samples in window
+    wstart_x: np.ndarray  # [1, Wp] f32  window start boundary (exclusive-1)
+    wend_x: np.ndarray   # [1, Wp] f32
+    wvalid: np.ndarray   # [W] bool      n >= 2
+    W: int
+    Tp: int
+
+
+def build_plan(ts_row: np.ndarray, wends: np.ndarray,
+               range_ms: int) -> FusedPlan:
+    """Window boundary math once, host-side (shared grid: one ts row)."""
+    ts_row = np.asarray(ts_row, dtype=np.int64)
+    wend = np.asarray(wends, dtype=np.int64)
+    wstart = wend - int(range_ms) + 1
+    first = np.searchsorted(ts_row, wstart, side="left")
+    last = np.searchsorted(ts_row, wend, side="right") - 1
+    n = np.maximum(last - first + 1, 0)
+    W, T = len(wend), len(ts_row)
+    Wp, Tp = _pad_to(max(W, 1), _LANE), _pad_to(max(T, 1), _LANE)
+    valid = n >= 2
+
+    def sel(idx, leq):
+        m = np.zeros((Tp, Wp), np.float32)
+        t = np.arange(Tp)[:, None]
+        iw = np.where(valid, np.clip(idx, 0, T - 1), -1)[None, :]
+        body = (t <= iw) if leq else (t == iw)
+        m[:, :W] = body.astype(np.float32)
+        return m
+
+    def row(v):
+        out = np.zeros((1, Wp), np.float32)
+        out[0, :W] = v
+        return out
+
+    fi = np.clip(first, 0, T - 1)
+    la = np.clip(last, 0, T - 1)
+    return FusedPlan(
+        o1=sel(first, False), o2=sel(last, False),
+        l2=sel(last, True), l1=sel(first, True),
+        t1=row(np.where(valid, ts_row[fi], 0)),
+        t2=row(np.where(valid, ts_row[la], 0)),
+        n=row(np.maximum(n, 2)),           # safe: invalid windows masked out
+        wstart_x=row(wstart - 1), wend_x=row(wend),
+        wvalid=valid, W=W, Tp=Tp)
+
+
+def _kernel(vals_ref, vbase_ref, gids_ref, o1_ref, o2_ref, l1_ref, l2_ref,
+            t1_ref, t2_ref, n_ref, ws_ref, we_ref, out_ref,
+            *, num_groups: int, is_counter: bool, is_rate: bool,
+            with_drops: bool):
+    v = vals_ref[:]                                   # [BS, Tp]
+    # HIGHEST: the MXU's default bf16 pass truncates f32 mantissas (1e-2
+    # relative error on counter magnitudes); the multi-pass f32 decomposition
+    # restores ~1e-7 at a small FLOP cost (these matmuls are tiny next to
+    # the HBM read)
+    mm = functools.partial(jnp.dot, preferred_element_type=jnp.float32,
+                           precision=jax.lax.Precision.HIGHEST)
+    v1 = mm(v, o1_ref[:])                             # [BS, Wp]
+    v2 = mm(v, o2_ref[:])
+    if with_drops:
+        prev = jnp.concatenate([v[:, :1], v[:, :-1]], axis=1)
+        # first column has no predecessor; padded tail columns are never
+        # selected by l1/l2 (first/last < T <= padded region)
+        d = jnp.maximum(prev - v, 0.0)
+        col = jax.lax.broadcasted_iota(jnp.int32, d.shape, 1)
+        d = jnp.where(col == 0, 0.0, d)
+        v1 = v1 + mm(d, l1_ref[:])
+        v2 = v2 + mm(d, l2_ref[:])
+    t1, t2 = t1_ref[:], t2_ref[:]                     # [1, Wp]
+    n, ws, we = n_ref[:], ws_ref[:], we_ref[:]
+
+    dur_start = (t1 - ws) / 1000.0
+    dur_end = (we - t2) / 1000.0
+    sampled = jnp.maximum((t2 - t1) / 1000.0, 1e-9)
+    avg_between = sampled / (n - 1.0)
+    delta = v2 - v1
+    if is_counter:
+        va = v1 + vbase_ref[:]                        # absolute first value
+        dur_zero = sampled * (va / jnp.where(delta == 0.0, jnp.inf, delta))
+        take_zero = (delta > 0) & (va >= 0) & (dur_zero < dur_start)
+        dur_start = jnp.where(take_zero, dur_zero, dur_start)
+    threshold = avg_between * 1.1
+    extrap = sampled \
+        + jnp.where(dur_start < threshold, dur_start, avg_between / 2) \
+        + jnp.where(dur_end < threshold, dur_end, avg_between / 2)
+    out = delta * (extrap / sampled)
+    if is_rate:
+        out = out / jnp.maximum(we - ws, 1.0) * 1000.0
+
+    gids = gids_ref[:]                                # [BS, 1] int32
+    groups = jax.lax.broadcasted_iota(jnp.int32, (num_groups, v.shape[0]), 0)
+    onehot = (groups == gids[:, 0][None, :]).astype(jnp.float32)
+    part = mm(onehot, out)                            # [Gp, Wp]
+
+    @pl.when(pl.program_id(0) == 0)
+    def _():
+        out_ref[:] = jnp.zeros_like(out_ref)
+    out_ref[:] += part
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "num_groups", "is_counter", "is_rate", "with_drops", "interpret"))
+def _run(vals_p, vbase_p, gids_p, o1, o2, l1, l2, t1, t2, n, ws, we,
+         num_groups: int, is_counter: bool, is_rate: bool,
+         with_drops: bool, interpret: bool):
+    from jax.experimental.pallas import tpu as pltpu
+
+    Sp, Tp = vals_p.shape
+    Wp = o1.shape[1]
+    Gp = num_groups
+    grid = Sp // _BS
+    space = {} if interpret else {"memory_space": pltpu.VMEM}
+    row_spec = pl.BlockSpec((_BS, Tp), lambda i: (i, 0), **space)
+    col_spec = pl.BlockSpec((_BS, 1), lambda i: (i, 0), **space)
+    fix = lambda shape: pl.BlockSpec(shape, lambda i: (0, 0), **space)  # noqa: E731
+    kern = functools.partial(_kernel, num_groups=Gp, is_counter=is_counter,
+                             is_rate=is_rate, with_drops=with_drops)
+    return pl.pallas_call(
+        kern,
+        grid=(grid,),
+        in_specs=[row_spec, col_spec, col_spec,
+                  fix((Tp, Wp)), fix((Tp, Wp)), fix((Tp, Wp)), fix((Tp, Wp)),
+                  fix((1, Wp)), fix((1, Wp)), fix((1, Wp)), fix((1, Wp)),
+                  fix((1, Wp))],
+        out_specs=fix((Gp, Wp)),
+        out_shape=jax.ShapeDtypeStruct((Gp, Wp), jnp.float32),
+        interpret=interpret,
+    )(vals_p, vbase_p, gids_p, o1, o2, l1, l2, t1, t2, n, ws, we)
+
+
+VMEM_BUDGET = 12 << 20          # per-core VMEM is ~16MB; leave headroom
+
+
+def vmem_estimate(Tp: int, Wp: int, Gp: int) -> int:
+    """Rough resident-bytes model for one grid step: 4 selection matrices,
+    the double-buffered values block, the group one-hot + accumulator, and
+    [BS, Wp] f32 temporaries.  Callers divert to the general XLA path when
+    this exceeds VMEM_BUDGET instead of failing at kernel lowering."""
+    sel = 4 * Tp * Wp * 4
+    vals = 2 * _BS * Tp * 4
+    group = Gp * (Wp * 8 + _BS * 4)
+    inter = 12 * _BS * Wp * 4
+    return sel + vals + group + inter
+
+
+def can_fuse(fn_name: str, agg_op: str, shared_grid: bool,
+             dense: bool) -> bool:
+    return (fn_name in ("rate", "increase", "delta") and agg_op == "sum"
+            and shared_grid and dense)
+
+
+class PreparedInputs(NamedTuple):
+    """Padded device-resident query inputs — build once per working set
+    (the pad is a full [S, T] device copy; never pay it per query)."""
+    vals_p: jax.Array    # [Sp, Tp] f32
+    vbase_p: jax.Array   # [Sp, 1] f32
+    gids_p: jax.Array    # [Sp, 1] int32 (-1 pad rows)
+    gsize: np.ndarray    # [num_groups] series per group
+
+
+def pad_inputs(vals, vbase, gids, plan: FusedPlan,
+               num_groups: int) -> PreparedInputs:
+    S = vals.shape[0]
+    Sp = _pad_to(S, _BS)
+    Tp = plan.Tp
+    gids_np = np.asarray(gids, np.int32)
+    vals_p = jnp.zeros((Sp, Tp), jnp.float32)
+    vals_p = vals_p.at[:S, :vals.shape[1]].set(jnp.asarray(vals, jnp.float32))
+    vbase_p = jnp.zeros((Sp, 1), jnp.float32)
+    vbase_p = vbase_p.at[:S, 0].set(jnp.asarray(vbase, jnp.float32))
+    gids_p = jnp.full((Sp, 1), -1, jnp.int32)
+    gids_p = gids_p.at[:S, 0].set(jnp.asarray(gids_np))
+    gsize = np.bincount(gids_np, minlength=num_groups)[:num_groups]
+    return PreparedInputs(vals_p, vbase_p, gids_p, gsize)
+
+
+def fused_rate_groupsum(vals, vbase, gids, plan: FusedPlan,
+                        num_groups: int, fn_name: str = "rate",
+                        precorrected: bool = False,
+                        interpret: bool = False,
+                        prepared: Optional[PreparedInputs] = None
+                        ) -> Tuple[jax.Array, np.ndarray]:
+    """-> (sums [G, W] device array, counts [G, W] numpy).
+
+    vals: [S, T] f32 rebased values (dense, shared grid); ignored when
+    `prepared` is given.  vbase: [S] f32 per-series value base (absolute
+    = rebased + vbase).  Present-count is shared across series under the
+    dense/shared-grid precondition: counts[g, w] = |group g| * 1{n[w] >= 2}
+    — NaN where 0, matching ops/agg.py present().
+    """
+    is_counter = fn_name in ("rate", "increase")
+    is_rate = fn_name == "rate"
+    with_drops = is_counter and not precorrected
+    if prepared is None:
+        prepared = pad_inputs(vals, vbase, gids, plan, num_groups)
+    Gp = _pad_to(max(num_groups, 8), 8)
+    sums = _run(prepared.vals_p, prepared.vbase_p, prepared.gids_p,
+                *(jnp.asarray(m) for m in
+                  (plan.o1, plan.o2, plan.l1, plan.l2, plan.t1, plan.t2,
+                   plan.n, plan.wstart_x, plan.wend_x)),
+                num_groups=Gp, is_counter=is_counter, is_rate=is_rate,
+                with_drops=with_drops, interpret=interpret)
+    counts = prepared.gsize[:, None].astype(np.float64) * \
+        plan.wvalid[None, :].astype(np.float64)
+    return sums[:num_groups, :plan.W], counts
+
+
+def present_sum(sums, counts) -> np.ndarray:
+    """Finish the 3-phase contract host-side: NaN where no contributors."""
+    s = np.asarray(sums, np.float64)
+    return np.where(counts > 0, s, np.nan)

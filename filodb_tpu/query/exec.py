@@ -18,6 +18,8 @@ runs a subtree inline; the cluster layer adds remote dispatch.
 from __future__ import annotations
 
 import dataclasses
+import os
+import threading
 from typing import Callable, Dict, List, Optional, Sequence, Tuple, Union
 
 import numpy as np
@@ -53,6 +55,38 @@ class RawBlock:
     samples: int = 0                    # total valid samples (stats)
     vbase: Optional[np.ndarray] = None  # [S] or [S, B]
     precorrected: bool = False          # counter reset-correction done host-side
+    # shared scrape grid + fully-finite values: row-0 ts offsets when ALL
+    # rows share one grid with no NaN holes (the pallas_fused precondition,
+    # tracked by the device mirror); None otherwise
+    shared_ts_row: Optional[np.ndarray] = None
+
+
+# Fused-leaf caches (see MultiSchemaPartitionsExec._try_fused): entries are
+# keyed by (mirror serial, snapshot gen, ...) so any ingest naturally
+# misses.  The prep cache holds full padded device copies, so it is bounded
+# in BYTES (not just entries) — this HBM lives outside the DeviceMirror's
+# own hbm_limit_bytes accounting.
+_FUSED_PLAN_CACHE: Dict[Tuple, object] = {}
+_FUSED_PREP_CACHE: Dict[Tuple, Tuple] = {}
+_FUSED_PREP_CACHE_BYTES = 4 << 30
+# queries run on HTTP worker threads (http/server.py ThreadingHTTPServer) —
+# every cache read-modify-write holds this lock; the kernel runs outside it
+_FUSED_CACHE_LOCK = threading.Lock()
+
+
+def _prep_nbytes(prep) -> int:
+    return int(prep.vals_p.size * 4 + prep.vbase_p.size * 4
+               + prep.gids_p.size * 4)
+
+
+def _prep_cache_insert(key, prep, gkeys) -> None:
+    _FUSED_PREP_CACHE[key] = (prep, gkeys)
+    while len(_FUSED_PREP_CACHE) > 4 or sum(
+            _prep_nbytes(p) for p, _ in _FUSED_PREP_CACHE.values()
+            ) > _FUSED_PREP_CACHE_BYTES:
+        if len(_FUSED_PREP_CACHE) == 1:
+            break                        # always keep the entry just added
+        _FUSED_PREP_CACHE.pop(next(iter(_FUSED_PREP_CACHE)))
 
 
 @dataclasses.dataclass
@@ -791,11 +825,122 @@ class MultiSchemaPartitionsExec(LeafExecPlan):
 
     def execute_internal(self, source) -> QueryResultLike:
         self._transformer_overrides = {}
+        self._fused_cache_key = None
         data, stats = self._do_execute(source)
-        for i, t in enumerate(self.transformers):
+        start = 0
+        try:
+            fused = self._try_fused(data, stats)
+        except ValueError:
+            raise                        # real query errors (limits) surface
+        except Exception:  # noqa: BLE001 — fusion is an optimization
+            from filodb_tpu.utils.metrics import registry
+            registry.counter("leaf_fused_errors").increment()
+            fused = None
+        if fused is not None:
+            data, start = fused, 2
+        for i, t in enumerate(self.transformers[start:], start):
             t = self._transformer_overrides.get(i, t)
             data = t.apply(data, self.ctx, stats, source)
         return data, stats
+
+    def _try_fused(self, data, stats):
+        """Peephole: PeriodicSamplesMapper(rate|increase|delta) followed by
+        AggregateMapReduce(sum) over a shared-grid fully-finite working set
+        collapses into the single-HBM-pass MXU kernel (ops/pallas_fused.py)
+        — the leaf analogue of the reference pushing AggregateMapReduce to
+        data nodes (ref: AggrOverRangeVectors.scala:76), fused one level
+        further.  Returns the AggPartial or None (general path)."""
+        if len(self.transformers) < 2 or not isinstance(data, RawBlock) \
+                or not data.keys or data.shared_ts_row is None:
+            return None
+        t0 = self._transformer_overrides.get(0, self.transformers[0])
+        t1 = self._transformer_overrides.get(1, self.transformers[1])
+        if not isinstance(t0, PeriodicSamplesMapper) \
+                or not isinstance(t1, AggregateMapReduce):
+            return None
+        from filodb_tpu.ops import pallas_fused as pf
+        import jax
+        backend = jax.default_backend()
+        interpret = backend != "tpu"
+        if interpret and not os.environ.get("FILODB_TPU_FUSED_INTERPRET"):
+            return None                 # kernel is MXU-targeted
+        vals = data.values
+        if getattr(vals, "ndim", 0) != 2 or t0.window_ms is None \
+                or t0.function_args or t1.params:
+            return None
+        if not pf.can_fuse(t0.function or "", t1.op, True, True):
+            return None
+        if t0.function in ("rate", "increase") and not data.precorrected:
+            return None
+        wends = make_window_ends(t0.start_ms, t0.end_ms, t0.step_ms)
+        eval_wends = wends - t0.offset_ms - data.base_ms
+        if eval_wends.size == 0 or abs(eval_wends).max() >= (1 << 30):
+            return None
+        # VMEM guard, part 1 (selection matrices alone): very long ranges
+        # with many windows must take the general path, not fail at lowering
+        Tp = -(-vals.shape[1] // 128) * 128
+        Wp = -(-eval_wends.size // 128) * 128
+        if 16 * Tp * Wp > pf.VMEM_BUDGET:
+            return None
+        from filodb_tpu.utils.metrics import registry
+        # plan + prepared-input caches: a repeat query over an unchanged
+        # snapshot (the dashboard-poll pattern) skips the selection-matrix
+        # rebuild AND the full padded device copy (PreparedInputs contract)
+        key = self._fused_cache_key
+        plan = prep = gkeys = None
+        if key is not None:
+            plan_key = key[:3] + (t0.start_ms, t0.step_ms, t0.end_ms,
+                                  t0.offset_ms, t0.window_ms, data.base_ms)
+            prep_key = key + (t1.by, t1.without)
+            with _FUSED_CACHE_LOCK:
+                plan = _FUSED_PLAN_CACHE.get(plan_key)
+                ent = _FUSED_PREP_CACHE.get(prep_key)
+            if ent is not None:
+                prep, gkeys = ent
+                registry.counter("leaf_fused_prep_hits").increment()
+        if plan is None:
+            plan = pf.build_plan(data.shared_ts_row.astype(np.int64),
+                                 eval_wends, t0.window_ms)
+            if key is not None:
+                with _FUSED_CACHE_LOCK:
+                    for k in [k for k in _FUSED_PLAN_CACHE
+                              if k[0] == key[0] and k[1] != key[1]]:
+                        del _FUSED_PLAN_CACHE[k]
+                    _FUSED_PLAN_CACHE[plan_key] = plan
+                    while len(_FUSED_PLAN_CACHE) > 8:
+                        _FUSED_PLAN_CACHE.pop(next(iter(_FUSED_PLAN_CACHE)))
+        limit = self.ctx.planner_params.group_by_cardinality_limit
+        if gkeys is None:
+            gids, gkeys = _group_ids(data.keys, t1.by, t1.without)
+        if limit and len(gkeys) > limit:
+            raise ValueError(
+                f"group-by cardinality limit {limit} exceeded "
+                f"({len(gkeys)} groups)")
+        # VMEM guard, part 2: full estimate now that group count is known —
+        # BEFORE the padded device copy, so diverted queries cost nothing
+        if pf.vmem_estimate(Tp, Wp, max(len(gkeys), 8)) > pf.VMEM_BUDGET:
+            return None
+        if prep is None:
+            vbase = data.vbase
+            if vbase is None:
+                vbase = np.zeros(vals.shape[0], np.float32)
+            prep = pf.pad_inputs(vals, vbase, gids, plan, len(gkeys))
+            if key is not None:
+                # a new snapshot generation obsoletes this mirror's older
+                # entries — drop them NOW, not at LRU eviction: each pins a
+                # full padded copy of the working set in HBM
+                with _FUSED_CACHE_LOCK:
+                    for k in [k for k in _FUSED_PREP_CACHE
+                              if k[0] == key[0] and k[1] != key[1]]:
+                        del _FUSED_PREP_CACHE[k]
+                    _prep_cache_insert(prep_key, prep, gkeys)
+        sums, counts = pf.fused_rate_groupsum(
+            None, None, None, plan, len(gkeys), fn_name=t0.function,
+            precorrected=data.precorrected, interpret=interpret,
+            prepared=prep)
+        registry.counter("leaf_fused_kernel").increment()
+        comp = np.stack([np.asarray(sums, np.float64), counts], axis=-1)
+        return AggPartial("sum", gkeys, wends, comp=comp)
 
     def args_str(self):
         fs = ",".join(str(f) for f in self.filters)
@@ -897,15 +1042,20 @@ class MultiSchemaPartitionsExec(LeafExecPlan):
         # subsequent row gather reads only the immutable device copy.  The
         # host fallback copies out under the seqlock so a concurrent
         # ingest/flush can't hand the kernel a torn matrix.
-        mirrored = None
+        mirrored = snap = None
         if mirror is not None:
-            if mirror.is_fresh(store):
-                mirrored = mirror.gather_cached(rows)
-            else:
+            ok = mirror.is_fresh(store)
+            if not ok:
                 with shard._write_locked("mirror_refresh"):
-                    if mirror.ensure_fresh(store):
-                        mirrored = mirror.gather_cached(rows)
+                    ok = mirror.ensure_fresh(store)
+            if ok:
+                # one snapshot read serves gather AND fused-eligibility:
+                # pairing a newer snapshot's grid with an older one's values
+                # would feed the kernel zero-padded phantom columns
+                snap = mirror.snapshot()
+                mirrored = mirror.gather_cached(rows, snap)
         # value column selection: histograms gather [S, T, B]
+        shared_ts_row = None
         if mirrored is not None:
             ts_off, dev_cols, dev_vbases, base = mirrored
             vals = dev_cols[col_name]
@@ -913,6 +1063,14 @@ class MultiSchemaPartitionsExec(LeafExecPlan):
             counts = shard.snapshot_read(store,
                                          lambda: store.counts[rows].copy())
             precorrected = counter_col   # mirror corrects counter columns
+            shared_ts_row = mirror.fused_eligible(col_name, snap)
+            if shared_ts_row is not None:
+                # cache identity for the fused path's prepared-input reuse
+                # (mirror.serial, not id(): ids are reused after GC; raw
+                # rows bytes, not their hash: a collision would silently
+                # serve another row-set's values)
+                self._fused_cache_key = (mirror.serial, snap.gen, col_name,
+                                         rows.tobytes())
         else:
             ts, cols, counts = shard.snapshot_read(
                 store, lambda: store.gather_rows(rows))
@@ -928,7 +1086,8 @@ class MultiSchemaPartitionsExec(LeafExecPlan):
         les = store.bucket_les if vals.ndim == 3 else None
         return RawBlock(keys, ts_off, vals, base, les,
                         samples=stats.samples_scanned, vbase=vbase,
-                        precorrected=precorrected), stats
+                        precorrected=precorrected,
+                        shared_ts_row=shared_ts_row), stats
 
 
 def _estimate_scan(store, rows: np.ndarray, start_ms: int,

@@ -1,0 +1,125 @@
+"""Leaf-exec fused fast path: PeriodicSamplesMapper(rate) +
+AggregateMapReduce(sum) collapsing into the Pallas kernel must be
+transparent — same results as the general path, engaged only when the
+mirror certifies the preconditions."""
+import numpy as np
+import pytest
+
+from filodb_tpu.core.records import RecordBatch
+from filodb_tpu.ingest.generator import counter_batch
+from filodb_tpu.utils.metrics import registry
+
+from test_query_engine import _mk_engine
+
+START_MS = 1_600_000_000_000
+START_S = START_MS // 1000
+T = 240
+END_S = START_S + T * 10
+
+
+@pytest.fixture()
+def fused_env(monkeypatch):
+    monkeypatch.setenv("FILODB_TPU_FUSED_INTERPRET", "1")
+
+
+def _fused_count():
+    return registry.counter("leaf_fused_kernel").value
+
+
+def _query(engine, promql='sum(rate(request_total{_ws_="demo"}[5m])) by (_ns_)'):
+    res = engine.query_range(promql, START_S + 600, 60, END_S)
+    assert res.error is None, res.error
+    return {tuple(sorted(k.labels_dict.items())): np.asarray(v)
+            for k, _, v in res.series()}
+
+
+def test_fused_leaf_matches_general_path(fused_env):
+    batch = counter_batch(60, T, start_ms=START_MS, resets=True)
+    engine = _mk_engine([batch])
+    # warm the mirror; second query takes the fused path
+    base = _query(engine)
+    before = _fused_count()
+    got = _query(engine)
+    assert _fused_count() > before, "fused path did not engage"
+    # general path, fused disabled
+    import os
+    os.environ.pop("FILODB_TPU_FUSED_INTERPRET", None)
+    want = _query(engine)
+    assert set(got) == set(want)
+    for k in want:
+        np.testing.assert_allclose(got[k], want[k], rtol=2e-5, atol=1e-4,
+                                   equal_nan=True)
+    for k in base:
+        np.testing.assert_allclose(base[k], want[k], rtol=2e-5, atol=1e-4,
+                                   equal_nan=True)
+
+
+def test_fused_skipped_on_ragged_grid(fused_env):
+    """Series with different sample grids must take the general path."""
+    full = counter_batch(20, T, start_ms=START_MS)
+    ragged = counter_batch(10, T // 2, start_ms=START_MS + 5_000,
+                           metric="other_total", seed=5)
+    engine = _mk_engine([full, ragged])
+    before = _fused_count()
+    a = _query(engine, 'sum(rate(request_total{_ws_="demo"}[5m])) by (_ns_)')
+    b = _query(engine, 'sum(rate(other_total{_ws_="demo"}[5m])) by (_ns_)')
+    assert _fused_count() == before     # mixed grids -> not uniform
+    assert a and b
+
+
+def test_fused_skipped_with_nan_values(fused_env):
+    """A NaN sample value inside the grid disqualifies the column."""
+    batch = counter_batch(8, T, start_ms=START_MS)
+    vals = batch.columns["count"].copy()
+    vals[T + 3] = np.nan                 # one NaN in series 1
+    batch = RecordBatch(batch.schema, batch.part_keys, batch.part_idx,
+                        batch.timestamps, {"count": vals}, batch.bucket_les)
+    engine = _mk_engine([batch])
+    before = _fused_count()
+    res = _query(engine)
+    assert _fused_count() == before
+    assert res
+
+
+def test_fused_engages_after_incremental_append(fused_env):
+    """Uniform appends preserve eligibility through the incremental
+    mirror refresh."""
+    full = counter_batch(30, T, start_ms=START_MS)
+    k = full.timestamps < START_MS + (T - 40) * 10_000
+    first = RecordBatch(full.schema, full.part_keys, full.part_idx[k],
+                        full.timestamps[k],
+                        {c: v[k] for c, v in full.columns.items()},
+                        full.bucket_les)
+    engine = _mk_engine([first])
+    _query(engine)                       # mirror upload (full refresh)
+    rest = RecordBatch(full.schema, full.part_keys, full.part_idx[~k],
+                       full.timestamps[~k],
+                       {c: v[~k] for c, v in full.columns.items()},
+                       full.bucket_les)
+    engine.source.get_shard("prometheus", 0).ingest(rest)
+    _query(engine)                       # incremental refresh
+    before = _fused_count()
+    got = _query(engine)
+    assert _fused_count() > before, \
+        "uniform append should keep the fused path eligible"
+    # equals a from-scratch engine over the full data
+    fresh = _mk_engine([counter_batch(30, T, start_ms=START_MS)])
+    want = _query(fresh)
+    assert set(got) == set(want)
+    for key in want:
+        np.testing.assert_allclose(got[key], want[key], rtol=2e-5,
+                                   atol=1e-4, equal_nan=True)
+
+
+def test_fused_prep_cache_reused_across_queries(fused_env):
+    """Repeat queries over an unchanged snapshot must hit the prepared-input
+    cache (no per-query full device pad) and still be correct."""
+    engine = _mk_engine([counter_batch(40, T, start_ms=START_MS)])
+    _query(engine)                       # mirror upload
+    first = _query(engine)               # fused, cache miss
+    hits0 = registry.counter("leaf_fused_prep_hits").value
+    again = _query(engine)               # fused, cache hit
+    assert registry.counter("leaf_fused_prep_hits").value > hits0
+    for k in first:
+        np.testing.assert_allclose(first[k], again[k], rtol=1e-6,
+                                   equal_nan=True)

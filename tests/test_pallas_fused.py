@@ -1,0 +1,135 @@
+"""Pallas fused rate+group-sum kernel vs the general XLA path.
+
+Runs in interpret mode on CPU (the kernel itself is MXU-targeted; the
+driver bench exercises it on the real chip).  The XLA path
+(evaluate_range_function + agg.aggregate) is oracle-verified elsewhere
+(tests/test_rangefns.py, test_query_engine.py), so agreement here chains
+the conformance."""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from filodb_tpu.ops import agg as agg_ops
+from filodb_tpu.ops.counter import rebase_values
+from filodb_tpu.ops.pallas_fused import (build_plan, can_fuse,
+                                         fused_rate_groupsum, pad_inputs,
+                                         present_sum)
+from filodb_tpu.ops.rangefns import evaluate_range_function
+from filodb_tpu.ops.timewindow import make_window_ends, to_offsets
+
+START_STEP = 10_000
+
+
+def _mk(S=120, T=160, G=5, resets=True, seed=0):
+    rng = np.random.default_rng(seed)
+    ts_row = np.arange(T, dtype=np.int64) * START_STEP
+    raw = np.cumsum(rng.exponential(10.0, size=(S, T)), axis=1)
+    if resets:
+        raw[::7, T // 2:] *= 0.1          # counter resets mid-series
+    gids = (np.arange(S) % G).astype(np.int32)
+    return ts_row, raw, gids
+
+
+def _xla(ts_row, vals32, vbase, gids, wends, range_ms, fn, G, precor):
+    S, T = vals32.shape
+    ts_off = to_offsets(np.tile(ts_row, (S, 1)), np.full(S, T), 0)
+    r = evaluate_range_function(
+        jnp.asarray(ts_off), jnp.asarray(vals32),
+        jnp.asarray(wends.astype(np.int32)), range_ms, fn, shared_grid=True,
+        vbase=jnp.asarray(vbase.astype(np.float32)), precorrected=precor)
+    return np.asarray(agg_ops.aggregate("sum", r, jnp.asarray(gids), G))
+
+
+@pytest.mark.parametrize("fn,precor", [
+    ("rate", False), ("rate", True), ("increase", False),
+    ("increase", True), ("delta", False)])
+def test_fused_matches_xla_path(fn, precor):
+    ts_row, raw, gids = _mk()
+    G = 5
+    range_ms = 30 * START_STEP
+    wends = make_window_ends(40 * START_STEP, 150 * START_STEP,
+                             6 * START_STEP)
+    plan = build_plan(ts_row, wends, range_ms)
+    reb, vbase = rebase_values(raw, precor and fn != "delta")
+    vals32 = reb.astype(np.float32)
+    vb32 = vbase.astype(np.float32)
+    sums, counts = fused_rate_groupsum(
+        vals32, vb32, gids, plan, G, fn_name=fn, precorrected=precor,
+        interpret=True)
+    got = present_sum(sums, counts)
+    want = _xla(ts_row, vals32, vb32, gids, wends, range_ms, fn, G, precor)
+    assert (np.isnan(got) == np.isnan(want)).all()
+    np.testing.assert_allclose(got, want, rtol=2e-5, atol=1e-4,
+                               equal_nan=True)
+
+
+def test_fused_sparse_windows_and_edges():
+    """Windows before data, with < 2 samples, and beyond the data range."""
+    ts_row, raw, gids = _mk(S=40, T=50, G=3, resets=False)
+    G, range_ms = 3, 2 * START_STEP          # tiny window: n varies 0..2
+    wends = make_window_ends(-5 * START_STEP, 70 * START_STEP, START_STEP)
+    plan = build_plan(ts_row, wends, range_ms)
+    reb, vbase = rebase_values(raw, False)
+    sums, counts = fused_rate_groupsum(
+        reb.astype(np.float32), vbase.astype(np.float32), gids, plan, G,
+        interpret=True)
+    got = present_sum(sums, counts)
+    want = _xla(ts_row, reb.astype(np.float32), vbase.astype(np.float32),
+                gids, wends, range_ms, "rate", G, False)
+    assert (np.isnan(got) == np.isnan(want)).all()
+    np.testing.assert_allclose(got, want, rtol=2e-5, atol=1e-4,
+                               equal_nan=True)
+
+
+def test_fused_large_counter_rebase_precision():
+    """Counters at 2^30: rebased f32 deltas stay exact (the round-1 f32
+    cancellation bug class)."""
+    S, T, G = 16, 100, 2
+    ts_row = np.arange(T, dtype=np.int64) * START_STEP
+    rng = np.random.default_rng(1)
+    raw = 2.0**30 + np.cumsum(rng.integers(1, 100, size=(S, T)), axis=1)
+    gids = (np.arange(S) % G).astype(np.int32)
+    range_ms = 30 * START_STEP
+    wends = make_window_ends(40 * START_STEP, 90 * START_STEP,
+                             5 * START_STEP)
+    plan = build_plan(ts_row, wends, range_ms)
+    reb, vbase = rebase_values(raw, True)
+    sums, counts = fused_rate_groupsum(
+        reb.astype(np.float32), vbase.astype(np.float32), gids, plan, G,
+        precorrected=True, interpret=True)
+    got = present_sum(sums, counts)
+    # f64 oracle on the raw values
+    lo = np.searchsorted(ts_row, wends - range_ms + 1, side="left")
+    hi = np.searchsorted(ts_row, wends, side="right") - 1
+    per = (raw[:, hi] - raw[:, lo]) / ((ts_row[hi] - ts_row[lo]) / 1000.0)
+    # extrapolation factor is near 1 for dense full windows; compare rates
+    # group-summed with generous-but-small tolerance
+    want = np.zeros((G, len(wends)))
+    np.add.at(want, gids, per)
+    np.testing.assert_allclose(got, want, rtol=5e-3)
+
+
+def test_prepared_inputs_reuse():
+    ts_row, raw, gids = _mk(S=64, T=80, G=4)
+    G, range_ms = 4, 20 * START_STEP
+    wends = make_window_ends(25 * START_STEP, 70 * START_STEP,
+                             5 * START_STEP)
+    plan = build_plan(ts_row, wends, range_ms)
+    reb, vbase = rebase_values(raw, False)
+    v32, vb32 = reb.astype(np.float32), vbase.astype(np.float32)
+    prep = pad_inputs(v32, vb32, gids, plan, G)
+    a, ca = fused_rate_groupsum(v32, vb32, gids, plan, G, interpret=True)
+    b, cb = fused_rate_groupsum(None, None, None, plan, G, interpret=True,
+                                prepared=prep)
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b))
+    np.testing.assert_array_equal(ca, cb)
+
+
+def test_can_fuse_gate():
+    assert can_fuse("rate", "sum", True, True)
+    assert can_fuse("increase", "sum", True, True)
+    assert not can_fuse("rate", "avg", True, True)
+    assert not can_fuse("sum_over_time", "sum", True, True)
+    assert not can_fuse("rate", "sum", False, True)   # ragged grids
+    assert not can_fuse("rate", "sum", True, False)   # NaN holes
