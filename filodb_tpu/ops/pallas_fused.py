@@ -199,6 +199,11 @@ def can_fuse(fn_name: str, agg_op: str, shared_grid: bool,
             and shared_grid and dense)
 
 
+# traceable entry for callers composing the kernel inside shard_map (the
+# mesh executor); the jit wrapper inlines under an enclosing trace
+run_kernel = _run
+
+
 class PreparedInputs(NamedTuple):
     """Padded device-resident query inputs — build once per working set
     (the pad is a full [S, T] device copy; never pay it per query)."""
@@ -208,20 +213,43 @@ class PreparedInputs(NamedTuple):
     gsize: np.ndarray    # [num_groups] series per group
 
 
-def pad_inputs(vals, vbase, gids, plan: FusedPlan,
-               num_groups: int) -> PreparedInputs:
+class PaddedValues(NamedTuple):
+    """The grouping-independent (and byte-dominant) half of PreparedInputs
+    — cacheable once per (working set, column) across grouping variants."""
+    vals_p: jax.Array    # [Sp, Tp] f32
+    vbase_p: jax.Array   # [Sp, 1] f32
+
+
+class PaddedGroups(NamedTuple):
+    """The small grouping-dependent half — one per (by, without) variant."""
+    gids_p: jax.Array    # [Sp, 1] int32 (-1 pad rows)
+    gsize: np.ndarray    # [num_groups]
+
+
+def pad_values(vals, vbase, plan: FusedPlan) -> PaddedValues:
     S = vals.shape[0]
     Sp = _pad_to(S, _BS)
-    Tp = plan.Tp
-    gids_np = np.asarray(gids, np.int32)
-    vals_p = jnp.zeros((Sp, Tp), jnp.float32)
+    vals_p = jnp.zeros((Sp, plan.Tp), jnp.float32)
     vals_p = vals_p.at[:S, :vals.shape[1]].set(jnp.asarray(vals, jnp.float32))
     vbase_p = jnp.zeros((Sp, 1), jnp.float32)
     vbase_p = vbase_p.at[:S, 0].set(jnp.asarray(vbase, jnp.float32))
+    return PaddedValues(vals_p, vbase_p)
+
+
+def pad_groups(gids, S: int, num_groups: int) -> PaddedGroups:
+    Sp = _pad_to(S, _BS)
+    gids_np = np.asarray(gids, np.int32)
     gids_p = jnp.full((Sp, 1), -1, jnp.int32)
     gids_p = gids_p.at[:S, 0].set(jnp.asarray(gids_np))
     gsize = np.bincount(gids_np, minlength=num_groups)[:num_groups]
-    return PreparedInputs(vals_p, vbase_p, gids_p, gsize)
+    return PaddedGroups(gids_p, gsize)
+
+
+def pad_inputs(vals, vbase, gids, plan: FusedPlan,
+               num_groups: int) -> PreparedInputs:
+    v = pad_values(vals, vbase, plan)
+    g = pad_groups(gids, vals.shape[0], num_groups)
+    return PreparedInputs(v.vals_p, v.vbase_p, g.gids_p, g.gsize)
 
 
 def fused_rate_groupsum(vals, vbase, gids, plan: FusedPlan,

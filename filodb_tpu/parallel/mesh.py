@@ -81,6 +81,12 @@ class PackedShards:
     # same contract as the single-shard leaf path (RawBlock.vbase)
     vbase: Optional[np.ndarray] = None      # [D, S]
     precorrected: bool = False
+    # fused-kernel eligibility (ops/pallas_fused.py): when every real row
+    # of every shard shares ONE scrape grid with no NaN holes, the shared
+    # row (int32 [T], PAD_TS tail) — else None.  Computed at pack time.
+    shared_ts_row: Optional[np.ndarray] = None
+    # series per aggregation group over REAL rows (for present-count math)
+    gsize: Optional[np.ndarray] = None
 
     @property
     def n_shards(self) -> int:
@@ -159,20 +165,57 @@ def pack_shards(blocks: Sequence[Tuple],
         s, tt = t.shape
         ts[d, :s, :tt] = t
         vals[d, :s, :tt] = v
-        nser[d] = s
+        # real series = labeled rows; empty-shard placeholder blocks carry
+        # a single all-PAD row with NO labels — that row is padding, not
+        # data (it must not count toward group sizes or grid uniformity)
         if isinstance(labels, np.ndarray):
+            nser[d] = labels.shape[0]
             gids[d, :labels.shape[0]] = labels
         else:
+            nser[d] = min(s, len(labels))
             for i, lab in enumerate(labels):
                 items = (lab if isinstance(lab, tuple)
                          else tuple(sorted(lab.items())))
                 gids[d, i] = reg.slot_for(items)
 
     labels_out = group_labels if group_labels is not None else list(reg.labels)
-    return PackedShards(ts, vals, gids, max(len(labels_out), 1),
+    num_groups = max(len(labels_out), 1)
+    # fused-kernel eligibility: one shared grid + no NaN in counted cells.
+    # Per-shard views with early exit — no [N, T] fancy-index copies (packs
+    # run for every query shape, most of which can't fuse anyway).
+    shared_row = None
+    ref = None
+    for d in range(D):
+        n = nser[d]
+        if n == 0:
+            continue
+        if ref is None:
+            ref = ts[d, 0]
+        rows = ts[d, :n]
+        if not (rows == ref[None, :]).all():
+            ref = None
+            break
+        # counted region is a prefix (timestamps ascend, PAD_TS tail), so a
+        # basic slice (a view, no copy) covers exactly the selectable cells.
+        # isfinite, not isnan: an inf sample would be clamped finite by the
+        # kernel wrapper's nan_to_num and silently change query results
+        # (the leaf path's col_finite gate uses isfinite for the same reason)
+        n_counted = int((ref < PAD_TS).sum())
+        if not np.isfinite(vals[d, :n, :n_counted]).all():
+            ref = None
+            break
+    if ref is not None:
+        shared_row = ref.copy()
+    gsize = np.zeros(num_groups, dtype=np.int64)
+    for d in range(D):
+        if nser[d]:
+            gsize += np.bincount(gids[d, :nser[d]],
+                                 minlength=num_groups)[:num_groups]
+    return PackedShards(ts, vals, gids, num_groups,
                         labels_out, base_ms, nser,
                         vbase=vbase if any_vbase else None,
-                        precorrected=precorrected)
+                        precorrected=precorrected,
+                        shared_ts_row=shared_row, gsize=gsize)
 
 
 def device_put_packed(packed: PackedShards, mesh: Mesh) -> PackedShards:
@@ -191,6 +234,50 @@ def device_put_packed(packed: PackedShards, mesh: Mesh) -> PackedShards:
 
 
 # ------------------------------------------------------------ SPMD kernels
+
+@functools.partial(jax.jit, static_argnames=(
+    "mesh", "G", "S", "T", "Tp", "is_counter", "is_rate", "interpret"))
+def _mesh_fused_call(mesh: Mesh, values, group_ids, vbase,
+                     o1, o2, l1, l2, t1, t2, n, ws, we, *,
+                     G: int, S: int, T: int, Tp: int,
+                     is_counter: bool, is_rate: bool, interpret: bool):
+    """Pallas fused sum(rate)-family kernel inside shard_map: values sharded
+    over 'shard', per-slice selection matrices over 'time', group sums psum
+    over 'shard'.  jit-cached on the static shape/flag tuple so repeat
+    queries don't re-trace (the closure-per-call anti-pattern)."""
+    from filodb_tpu.ops import pallas_fused as pf
+    Gp = pf._pad_to(max(G, 8), 8)
+    Sp = pf._pad_to(S, pf._BS)
+
+    def step(val_blk, gid_blk, vb_blk, o1b, o2b, l1b, l2b,
+             t1b, t2b, nb, wsb, web):
+        # NaN cells are exactly pad rows / beyond-count columns under the
+        # pack's eligibility gate; zeroed they contribute nothing (pack pad
+        # rows carry gid 0 but add +0 to its sums).  with_drops is always
+        # False here: counter functions require a precorrected pack.
+        v = jnp.nan_to_num(val_blk[0].astype(jnp.float32))
+        v = jnp.pad(v, ((0, Sp - S), (0, Tp - T)))
+        vb = jnp.pad(vb_blk[0].astype(jnp.float32), (0, Sp - S))[:, None]
+        g = jnp.pad(gid_blk[0].astype(jnp.int32), (0, Sp - S),
+                    constant_values=-1)[:, None]
+        out = pf.run_kernel(v, vb, g, o1b[0], o2b[0], l1b[0], l2b[0],
+                            t1b[0], t2b[0], nb[0], wsb[0], web[0],
+                            num_groups=Gp, is_counter=is_counter,
+                            is_rate=is_rate, with_drops=False,
+                            interpret=interpret)
+        return jax.lax.psum(out[:G], "shard")          # [G, Wlp]
+
+    return jax.shard_map(
+        step, mesh=mesh,
+        in_specs=(P("shard", None, None), P("shard", None),
+                  P("shard", None)) + (P("time", None, None),) * 9,
+        out_specs=P(None, "time"),
+        # pallas_call's out_shape carries no varying-mesh-axes info, which
+        # trips shard_map's vma checker; the psum makes the output
+        # replicated over 'shard' by construction
+        check_vma=False)(values, group_ids, vbase,
+                         o1, o2, l1, l2, t1, t2, n, ws, we)
+
 
 def distributed_window_agg(mesh: Mesh, ts_off, values, group_ids, wends, *,
                            range_ms, fn_name, params=(), agg_op="sum",
@@ -323,6 +410,9 @@ class MeshExecutor:
         # next query pays one re-upload (never worse than uncached).
         self._pack_cache: Dict[Tuple, Dict] = {}
         self._pack_cache_max = 8
+        # fused-path plan/mats cache: (shared_ts_row, wends, range) ->
+        # (device selection matrices, wvalid); see _run_agg_fused
+        self._fused_plan_cache: Dict[Tuple, Tuple] = {}
 
     def _cluster_sig(self) -> Tuple:
         return tuple(
@@ -480,6 +570,16 @@ class MeshExecutor:
         if Wp != W:
             wends = np.concatenate(
                 [wends, np.full(Wp - W, -PAD_TS, np.int32)])
+        if agg_op == "sum" and not params:
+            try:
+                fused = self._run_agg_fused(packed, wends, W, range_ms,
+                                            fn_name)
+            except Exception:  # noqa: BLE001 — fusion is an optimization
+                from filodb_tpu.utils.metrics import registry
+                registry.counter("mesh_fused_errors").increment()
+                fused = None
+            if fused is not None:
+                return fused, packed.group_labels
         wends_dev = jax.device_put(
             wends, NamedSharding(self.mesh, P("time")))
         partials = distributed_window_agg(
@@ -490,3 +590,79 @@ class MeshExecutor:
             precorrected=packed.precorrected)
         out = agg_ops.present(agg_op, partials)
         return np.asarray(out)[:, :W], packed.group_labels
+
+    def _run_agg_fused(self, packed: PackedShards, wends_p: np.ndarray,
+                       W: int, range_ms: int,
+                       fn_name: Optional[str]) -> Optional[np.ndarray]:
+        """sum(rate|increase|delta) over a uniform-grid dense pack via the
+        Pallas MXU kernel (ops/pallas_fused.py) composed inside shard_map:
+        per-time-slice selection-matrix plans shard over the 'time' axis,
+        the kernel runs per shard device, group sums psum over 'shard' —
+        one HBM pass per device instead of the general path's several.
+        Returns the finished [G, W] array, or None when ineligible."""
+        import os
+
+        from filodb_tpu.ops import pallas_fused as pf
+        shared = packed.shared_ts_row is not None and packed.gsize is not None
+        if not pf.can_fuse(fn_name or "", "sum", shared, shared):
+            return None
+        interpret = jax.default_backend() != "tpu"
+        if interpret and not os.environ.get("FILODB_TPU_FUSED_INTERPRET"):
+            return None
+        if fn_name in ("rate", "increase") and not packed.precorrected:
+            return None
+        n_time = self.mesh.shape["time"]
+        Wp = wends_p.shape[0]
+        Wl = Wp // n_time
+        G = packed.num_groups
+        D, S, T = packed.ts_off.shape
+        Tp = pf._pad_to(T, pf._LANE)
+        Wlp = pf._pad_to(max(Wl, 1), pf._LANE)
+        if pf.vmem_estimate(Tp, Wlp, max(G, 8)) > pf.VMEM_BUDGET:
+            return None
+        # plan + device-mats cache: repeat queries (the pack-cache pattern)
+        # skip the host selection-matrix rebuild and the 9 uploads
+        plan_key = (packed.shared_ts_row.tobytes(), wends_p.tobytes(),
+                    range_ms)
+        ent = self._fused_plan_cache.get(plan_key)
+        if ent is not None:
+            self._fused_plan_cache[plan_key] = \
+                self._fused_plan_cache.pop(plan_key)    # LRU touch
+        if ent is None:
+            ts_row = packed.shared_ts_row.astype(np.int64)
+            plans = [pf.build_plan(
+                ts_row, wends_p[i * Wl:(i + 1) * Wl].astype(np.int64),
+                range_ms) for i in range(n_time)]
+            st = lambda a: np.stack([getattr(p, a) for p in plans])  # noqa: E731
+            mats = tuple(
+                jax.device_put(st(a), NamedSharding(
+                    self.mesh, P("time", None, None)))
+                for a in ("o1", "o2", "l1", "l2",
+                          "t1", "t2", "n", "wstart_x", "wend_x"))
+            wvalid = np.concatenate([p.wvalid for p in plans])
+            ent = (mats, wvalid)
+            self._fused_plan_cache[plan_key] = ent
+            while len(self._fused_plan_cache) > 4:
+                self._fused_plan_cache.pop(
+                    next(iter(self._fused_plan_cache)))
+        mats, wvalid = ent
+        vbase = packed.vbase
+        if vbase is None:
+            vbase = jax.device_put(
+                np.zeros((D, S), np.float32),
+                NamedSharding(self.mesh, P("shard", None)))
+            # the pack is cached across queries — keep the device zeros
+            # with it so repeats skip this alloc + transfer (also serves
+            # the general path, which otherwise re-zeros per call)
+            packed.vbase = vbase
+        res = _mesh_fused_call(
+            self.mesh, packed.values, packed.group_ids, vbase, *mats,
+            G=G, S=S, T=T, Tp=Tp,
+            is_counter=(fn_name in ("rate", "increase")),
+            is_rate=(fn_name == "rate"), interpret=interpret)
+        out = np.asarray(res).reshape(G, n_time, Wlp)[:, :, :Wl] \
+            .reshape(G, Wp)[:, :W]
+        counts = packed.gsize[:, None] * wvalid[None, :W]
+        from filodb_tpu.utils.metrics import registry
+        registry.counter("mesh_fused_kernel").increment()
+        return pf.present_sum(out, counts)

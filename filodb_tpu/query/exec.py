@@ -63,30 +63,37 @@ class RawBlock:
 
 # Fused-leaf caches (see MultiSchemaPartitionsExec._try_fused): entries are
 # keyed by (mirror serial, snapshot gen, ...) so any ingest naturally
-# misses.  The prep cache holds full padded device copies, so it is bounded
-# in BYTES (not just entries) — this HBM lives outside the DeviceMirror's
-# own hbm_limit_bytes accounting.
+# misses.  The VALUES cache holds the full padded device copies — shared
+# across grouping variants (they depend only on the working set) and
+# bounded in BYTES, since this HBM lives outside the DeviceMirror's own
+# hbm_limit_bytes accounting.  The GROUP cache holds the small per-grouping
+# gid arrays.
 _FUSED_PLAN_CACHE: Dict[Tuple, object] = {}
-_FUSED_PREP_CACHE: Dict[Tuple, Tuple] = {}
-_FUSED_PREP_CACHE_BYTES = 4 << 30
+_FUSED_VALS_CACHE: Dict[Tuple, object] = {}
+_FUSED_GROUP_CACHE: Dict[Tuple, Tuple] = {}
+_FUSED_VALS_CACHE_BYTES = 4 << 30
 # queries run on HTTP worker threads (http/server.py ThreadingHTTPServer) —
 # every cache read-modify-write holds this lock; the kernel runs outside it
 _FUSED_CACHE_LOCK = threading.Lock()
 
 
-def _prep_nbytes(prep) -> int:
-    return int(prep.vals_p.size * 4 + prep.vbase_p.size * 4
-               + prep.gids_p.size * 4)
+class GroupCardinalityError(ValueError):
+    """group-by cardinality limit exceeded — a real query error that must
+    surface even from the fused fast path (everything else falls back)."""
 
 
-def _prep_cache_insert(key, prep, gkeys) -> None:
-    _FUSED_PREP_CACHE[key] = (prep, gkeys)
-    while len(_FUSED_PREP_CACHE) > 4 or sum(
-            _prep_nbytes(p) for p, _ in _FUSED_PREP_CACHE.values()
-            ) > _FUSED_PREP_CACHE_BYTES:
-        if len(_FUSED_PREP_CACHE) == 1:
+def _vals_nbytes(v) -> int:
+    return int(v.vals_p.size * 4 + v.vbase_p.size * 4)
+
+
+def _vals_cache_insert(key, v) -> None:
+    _FUSED_VALS_CACHE[key] = v
+    while len(_FUSED_VALS_CACHE) > 4 or sum(
+            _vals_nbytes(e) for e in _FUSED_VALS_CACHE.values()
+            ) > _FUSED_VALS_CACHE_BYTES:
+        if len(_FUSED_VALS_CACHE) == 1:
             break                        # always keep the entry just added
-        _FUSED_PREP_CACHE.pop(next(iter(_FUSED_PREP_CACHE)))
+        _FUSED_VALS_CACHE.pop(next(iter(_FUSED_VALS_CACHE)))
 
 
 @dataclasses.dataclass
@@ -830,8 +837,8 @@ class MultiSchemaPartitionsExec(LeafExecPlan):
         start = 0
         try:
             fused = self._try_fused(data, stats)
-        except ValueError:
-            raise                        # real query errors (limits) surface
+        except GroupCardinalityError:
+            raise                        # real query error — must surface
         except Exception:  # noqa: BLE001 — fusion is an optimization
             from filodb_tpu.utils.metrics import registry
             registry.counter("leaf_fused_errors").increment()
@@ -876,27 +883,36 @@ class MultiSchemaPartitionsExec(LeafExecPlan):
         eval_wends = wends - t0.offset_ms - data.base_ms
         if eval_wends.size == 0 or abs(eval_wends).max() >= (1 << 30):
             return None
-        # VMEM guard, part 1 (selection matrices alone): very long ranges
-        # with many windows must take the general path, not fail at lowering
-        Tp = -(-vals.shape[1] // 128) * 128
-        Wp = -(-eval_wends.size // 128) * 128
-        if 16 * Tp * Wp > pf.VMEM_BUDGET:
+        # VMEM guard, part 1 (group count not yet known — use the minimum):
+        # very long ranges with many windows must take the general path,
+        # not fail at kernel lowering
+        Tp = pf._pad_to(vals.shape[1], pf._LANE)
+        Wp = pf._pad_to(eval_wends.size, pf._LANE)
+        if pf.vmem_estimate(Tp, Wp, 8) > pf.VMEM_BUDGET:
             return None
         from filodb_tpu.utils.metrics import registry
         # plan + prepared-input caches: a repeat query over an unchanged
         # snapshot (the dashboard-poll pattern) skips the selection-matrix
         # rebuild AND the full padded device copy (PreparedInputs contract)
         key = self._fused_cache_key
-        plan = prep = gkeys = None
+        plan = padded_vals = groups = gkeys = None
         if key is not None:
             plan_key = key[:3] + (t0.start_ms, t0.step_ms, t0.end_ms,
                                   t0.offset_ms, t0.window_ms, data.base_ms)
-            prep_key = key + (t1.by, t1.without)
+            group_key = key + (t1.by, t1.without)
             with _FUSED_CACHE_LOCK:
                 plan = _FUSED_PLAN_CACHE.get(plan_key)
-                ent = _FUSED_PREP_CACHE.get(prep_key)
+                if plan is not None:
+                    _FUSED_PLAN_CACHE[plan_key] = \
+                        _FUSED_PLAN_CACHE.pop(plan_key)     # LRU touch
+                padded_vals = _FUSED_VALS_CACHE.get(key)
+                if padded_vals is not None:
+                    _FUSED_VALS_CACHE[key] = \
+                        _FUSED_VALS_CACHE.pop(key)          # LRU touch
+                ent = _FUSED_GROUP_CACHE.get(group_key)
             if ent is not None:
-                prep, gkeys = ent
+                groups, gkeys = ent
+            if padded_vals is not None:
                 registry.counter("leaf_fused_prep_hits").increment()
         if plan is None:
             plan = pf.build_plan(data.shared_ts_row.astype(np.int64),
@@ -913,27 +929,40 @@ class MultiSchemaPartitionsExec(LeafExecPlan):
         if gkeys is None:
             gids, gkeys = _group_ids(data.keys, t1.by, t1.without)
         if limit and len(gkeys) > limit:
-            raise ValueError(
+            raise GroupCardinalityError(
                 f"group-by cardinality limit {limit} exceeded "
                 f"({len(gkeys)} groups)")
         # VMEM guard, part 2: full estimate now that group count is known —
         # BEFORE the padded device copy, so diverted queries cost nothing
         if pf.vmem_estimate(Tp, Wp, max(len(gkeys), 8)) > pf.VMEM_BUDGET:
             return None
-        if prep is None:
+        if padded_vals is None:
             vbase = data.vbase
             if vbase is None:
                 vbase = np.zeros(vals.shape[0], np.float32)
-            prep = pf.pad_inputs(vals, vbase, gids, plan, len(gkeys))
+            padded_vals = pf.pad_values(vals, vbase, plan)
             if key is not None:
                 # a new snapshot generation obsoletes this mirror's older
                 # entries — drop them NOW, not at LRU eviction: each pins a
                 # full padded copy of the working set in HBM
                 with _FUSED_CACHE_LOCK:
-                    for k in [k for k in _FUSED_PREP_CACHE
+                    for k in [k for k in _FUSED_VALS_CACHE
                               if k[0] == key[0] and k[1] != key[1]]:
-                        del _FUSED_PREP_CACHE[k]
-                    _prep_cache_insert(prep_key, prep, gkeys)
+                        del _FUSED_VALS_CACHE[k]
+                    _vals_cache_insert(key, padded_vals)
+        if groups is None:
+            groups = pf.pad_groups(gids, vals.shape[0], len(gkeys))
+            if key is not None:
+                with _FUSED_CACHE_LOCK:
+                    for k in [k for k in _FUSED_GROUP_CACHE
+                              if k[0] == key[0] and k[1] != key[1]]:
+                        del _FUSED_GROUP_CACHE[k]
+                    _FUSED_GROUP_CACHE[group_key] = (groups, gkeys)
+                    while len(_FUSED_GROUP_CACHE) > 16:
+                        _FUSED_GROUP_CACHE.pop(
+                            next(iter(_FUSED_GROUP_CACHE)))
+        prep = pf.PreparedInputs(padded_vals.vals_p, padded_vals.vbase_p,
+                                 groups.gids_p, groups.gsize)
         sums, counts = pf.fused_rate_groupsum(
             None, None, None, plan, len(gkeys), fn_name=t0.function,
             precorrected=data.precorrected, interpret=interpret,

@@ -123,3 +123,19 @@ def test_fused_prep_cache_reused_across_queries(fused_env):
     for k in first:
         np.testing.assert_allclose(first[k], again[k], rtol=1e-6,
                                    equal_nan=True)
+
+
+def test_fused_vals_cache_shared_across_groupings(fused_env):
+    """Two grouping variants over one snapshot share ONE padded values
+    copy (the grouping-dependent gid arrays are cached separately)."""
+    from filodb_tpu.query import exec as exec_mod
+    engine = _mk_engine([counter_batch(30, T, start_ms=START_MS)])
+    _query(engine)                       # warm mirror
+    exec_mod._FUSED_VALS_CACHE.clear()
+    exec_mod._FUSED_GROUP_CACHE.clear()
+    a = _query(engine, 'sum(rate(request_total{_ws_="demo"}[5m])) by (_ns_)')
+    b = _query(engine, 'sum(rate(request_total{_ws_="demo"}[5m]))')
+    assert len(exec_mod._FUSED_VALS_CACHE) == 1, \
+        "grouping variants must share the padded values entry"
+    assert len(exec_mod._FUSED_GROUP_CACHE) == 2
+    assert a and b

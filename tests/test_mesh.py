@@ -168,3 +168,64 @@ def test_mesh_empty_shard_contributes_nothing(mesh42):
     final = np.asarray(agg_ops.present("sum", out))
     # 4 series * 10 samples/window * 1.0 each = 40
     np.testing.assert_allclose(final[0], 40.0)
+
+
+def test_mesh_fused_sum_rate_matches_general(store4, mesh42, monkeypatch):
+    """The Pallas fused mesh path (shard_map + psum around the MXU kernel)
+    must match the general distributed path and the single-process engine."""
+    from filodb_tpu.utils.metrics import registry
+    ms, mapper = store4
+    range_ms = 300_000
+
+    def run():
+        ex = MeshExecutor(ms, "prometheus", mesh42)
+        packed = ex.lookup_and_pack(
+            [Equals("_metric_", "request_total"), Equals("_ws_", "demo")],
+            (START_S + 600) * 1000 - range_ms, QEND_S * 1000,
+            by=("_ns_",), fn_name="rate")
+        wends = make_window_ends((START_S + 600) * 1000, QEND_S * 1000,
+                                 STEP_S * 1000)
+        return ex.run_agg(packed, wends, range_ms=range_ms,
+                          fn_name="rate", agg_op="sum")
+
+    out_gen, labels_gen = run()
+    monkeypatch.setenv("FILODB_TPU_FUSED_INTERPRET", "1")
+    before = registry.counter("mesh_fused_kernel").value
+    err_before = registry.counter("mesh_fused_errors").value
+    out_fused, labels_fused = run()
+    assert registry.counter("mesh_fused_kernel").value > before, \
+        "fused mesh path did not engage"
+    assert registry.counter("mesh_fused_errors").value == err_before
+    assert labels_fused == labels_gen
+    assert (np.isnan(out_fused) == np.isnan(out_gen)).all()
+    np.testing.assert_allclose(out_fused, out_gen, rtol=2e-5, atol=1e-4,
+                               equal_nan=True)
+
+
+def test_mesh_fused_skipped_on_ragged_pack(mesh42, monkeypatch):
+    """A pack whose shards have different grids must use the general path."""
+    from filodb_tpu.utils.metrics import registry
+    monkeypatch.setenv("FILODB_TPU_FUSED_INTERPRET", "1")
+    ms = TimeSeriesMemStore()
+    mapper = ShardMapper(4)
+    for s in range(4):
+        ms.setup("prometheus", s)
+        mapper.update_from_event(
+            ShardEvent("IngestionStarted", "prometheus", s, "local"))
+    # shard 0: full grid; shard 1: offset grid -> pack is not uniform
+    ms.get_shard("prometheus", 0).ingest(
+        counter_batch(8, NUM_SAMPLES, start_ms=START_MS))
+    ms.get_shard("prometheus", 1).ingest(
+        counter_batch(8, NUM_SAMPLES // 2, start_ms=START_MS + 5_000,
+                      seed=3))
+    ex = MeshExecutor(ms, "prometheus", mesh42)
+    packed = ex.lookup_and_pack([Equals("_metric_", "request_total")],
+                                START_MS, QEND_S * 1000, by=("_ns_",))
+    assert packed.shared_ts_row is None
+    wends = make_window_ends((START_S + 600) * 1000, QEND_S * 1000,
+                             STEP_S * 1000)
+    before = registry.counter("mesh_fused_kernel").value
+    out, _ = ex.run_agg(packed, wends, range_ms=300_000, fn_name="rate",
+                        agg_op="sum")
+    assert registry.counter("mesh_fused_kernel").value == before
+    assert np.isfinite(out).any()
