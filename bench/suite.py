@@ -468,7 +468,40 @@ def bench_utf8(quick: bool):
     _emit("utf8", "dict_decode_strings_per_sec", n / per, "strings/s")
 
 
+def bench_downsample(quick: bool):
+    """Batch downsampler throughput: raw persisted chunks -> 5m rollups
+    (ref: spark-jobs/.../DownsamplerMain.scala — the 5th driver-designated
+    target config in BASELINE.md)."""
+    from filodb_tpu.core.memstore import TimeSeriesMemStore
+    from filodb_tpu.core.store import InMemoryColumnStore, InMemoryMetaStore
+    from filodb_tpu.downsample.batch_job import DownsamplerJob
+    from filodb_tpu.ingest.generator import gauge_batch, counter_batch
+
+    S, T = (200, 360) if quick else (2000, 720)
+    raw_cs, raw_meta = InMemoryColumnStore(), InMemoryMetaStore()
+    ms = TimeSeriesMemStore(column_store=raw_cs, meta_store=raw_meta)
+    shard = ms.setup("prometheus", 0)
+    shard.ingest(gauge_batch(S // 2, T, start_ms=START))
+    shard.ingest(counter_batch(S // 2, T, start_ms=START))
+    shard.flush_all_groups()
+    samples = S * T
+    iters = 2 if quick else 3
+    times = []
+    for _ in range(iters):
+        job = DownsamplerJob(raw_cs, InMemoryColumnStore(), "prometheus",
+                             resolutions=(300_000,))
+        t0 = time.perf_counter()
+        stats = job.run([0], START, START + T * 10_000)
+        times.append(time.perf_counter() - t0)
+    best = min(times)
+    _emit("downsample", "raw_samples_per_sec", samples / best, "samples/s",
+          series=S, parts=stats.parts_scanned,
+          records_emitted=stats.records_emitted,
+          chunks_written=stats.chunks_written)
+
+
 BENCHES: Dict[str, Callable[[bool], None]] = {
+    "downsample": bench_downsample,
     "ingestion": bench_ingestion,
     "intsum": bench_intsum,
     "utf8": bench_utf8,

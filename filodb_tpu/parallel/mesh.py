@@ -624,10 +624,8 @@ class MeshExecutor:
         # skip the host selection-matrix rebuild and the 9 uploads
         plan_key = (packed.shared_ts_row.tobytes(), wends_p.tobytes(),
                     range_ms)
-        ent = self._fused_plan_cache.get(plan_key)
-        if ent is not None:
-            self._fused_plan_cache[plan_key] = \
-                self._fused_plan_cache.pop(plan_key)    # LRU touch
+        from filodb_tpu.query.exec import _lru_touch
+        ent = _lru_touch(self._fused_plan_cache, plan_key)
         if ent is None:
             ts_row = packed.shared_ts_row.astype(np.int64)
             plans = [pf.build_plan(

@@ -82,6 +82,15 @@ class GroupCardinalityError(ValueError):
     surface even from the fused fast path (everything else falls back)."""
 
 
+def _lru_touch(cache: Dict, key) -> object:
+    """Get + move-to-back (dicts iterate in insertion order, so eviction
+    pops the front = least-recently-used).  One idiom for all fused caches."""
+    val = cache.get(key)
+    if val is not None:
+        cache[key] = cache.pop(key)
+    return val
+
+
 def _vals_nbytes(v) -> int:
     return int(v.vals_p.size * 4 + v.vbase_p.size * 4)
 
@@ -342,7 +351,7 @@ class AggregateMapReduce(RangeVectorTransformer):
         gids, gkeys = _group_ids(data.keys, self.by, self.without)
         limit = ctx.planner_params.group_by_cardinality_limit
         if limit and len(gkeys) > limit:
-            raise ValueError(
+            raise GroupCardinalityError(
                 f"group-by cardinality limit {limit} exceeded "
                 f"({len(gkeys)} groups)")
         if data.is_histogram and self.op == "sum":
@@ -901,15 +910,9 @@ class MultiSchemaPartitionsExec(LeafExecPlan):
                                   t0.offset_ms, t0.window_ms, data.base_ms)
             group_key = key + (t1.by, t1.without)
             with _FUSED_CACHE_LOCK:
-                plan = _FUSED_PLAN_CACHE.get(plan_key)
-                if plan is not None:
-                    _FUSED_PLAN_CACHE[plan_key] = \
-                        _FUSED_PLAN_CACHE.pop(plan_key)     # LRU touch
-                padded_vals = _FUSED_VALS_CACHE.get(key)
-                if padded_vals is not None:
-                    _FUSED_VALS_CACHE[key] = \
-                        _FUSED_VALS_CACHE.pop(key)          # LRU touch
-                ent = _FUSED_GROUP_CACHE.get(group_key)
+                plan = _lru_touch(_FUSED_PLAN_CACHE, plan_key)
+                padded_vals = _lru_touch(_FUSED_VALS_CACHE, key)
+                ent = _lru_touch(_FUSED_GROUP_CACHE, group_key)
             if ent is not None:
                 groups, gkeys = ent
             if padded_vals is not None:

@@ -262,3 +262,17 @@ def test_downsample_chunk_histogram_counter_reset():
     assert (out_cols["h"][1] > out_cols["h"][2]).all()
     # sum column dips too (dLast across the same periods)
     assert out_cols["sum"][1] > out_cols["sum"][2]
+
+
+def test_bench_downsample_smoke():
+    """The downsample bench workload (DownsamplerMain config parity) runs
+    and emits a JSON line."""
+    import io
+    from contextlib import redirect_stdout
+    from bench.suite import bench_downsample
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        bench_downsample(quick=True)
+    import json
+    line = json.loads(buf.getvalue().strip().splitlines()[-1])
+    assert line["bench"] == "downsample" and line["value"] > 0
