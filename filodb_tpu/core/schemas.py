@@ -10,6 +10,7 @@ ref: filodb-defaults.conf:23-52).
 from __future__ import annotations
 
 import dataclasses
+import functools
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from filodb_tpu.utils.hashing import xxhash32
@@ -34,12 +35,16 @@ class Schema:
     downsample_period_marker: str = "time(0)"
     downsample_schema: Optional[str] = None
 
-    @property
+    # schema_id/data_columns sit on the per-record ingest hot path;
+    # cached_property writes straight into __dict__, bypassing the frozen
+    # dataclass __setattr__ guard
+    @functools.cached_property
     def schema_id(self) -> int:
-        payload = self.name + "|" + ",".join(f"{c.name}:{c.col_type}" for c in self.columns)
+        payload = self.name + "|" + ",".join(
+            f"{c.name}:{c.col_type}" for c in self.columns)
         return xxhash32(payload.encode()) & 0xFFFF
 
-    @property
+    @functools.cached_property
     def data_columns(self) -> Tuple[Column, ...]:
         return tuple(c for c in self.columns if c.col_type != "ts")
 

@@ -36,25 +36,53 @@ class InfluxRecord:
     ts_ms: int
 
 
-def _split_escaped(s: str, delim: str, stoppers: str = "") -> List[str]:
-    """Split on `delim` honoring backslash escapes (one pass, like
-    ref parseInner which unescapes while delimiting)."""
-    out, cur, i = [], [], 0
+def _split_raw(s: str, delim: str, quoted: bool = False) -> List[str]:
+    """Split on unescaped `delim`, KEEPING escape sequences intact — so a
+    later split on a different delimiter still sees them escaped (the
+    reference's parseInner tracks both delimiters in one pass,
+    ref: InfluxProtocolParser.scala parseInner).  quoted=True additionally
+    refuses to split inside double-quoted runs (field values)."""
+    out, start, i, in_quote = [], 0, 0, False
     while i < len(s):
         ch = s[i]
         if ch == "\\" and i + 1 < len(s):
-            cur.append(s[i + 1])
             i += 2
             continue
-        if ch == delim:
-            out.append("".join(cur))
-            cur = []
+        if quoted and ch == '"':
+            in_quote = not in_quote
             i += 1
             continue
-        cur.append(ch)
+        if ch == delim and not in_quote:
+            out.append(s[start:i])
+            start = i + 1
         i += 1
-    out.append("".join(cur))
+    out.append(s[start:])
     return out
+
+
+def _parse_ts(ts_str: str) -> Optional[int]:
+    """ns-epoch string -> ms, None when malformed (shared by both parse
+    paths so validation can't drift between them)."""
+    if len(ts_str) <= 6:
+        return None
+    try:
+        return int(ts_str[:-6])         # ns → ms: drop last 6 digits
+    except ValueError:
+        return None
+
+
+def _unescape(s: str) -> str:
+    if "\\" not in s:
+        return s
+    out, i = [], 0
+    while i < len(s):
+        if s[i] == "\\" and i + 1 < len(s):
+            out.append(s[i + 1])
+            i += 2
+        else:
+            out.append(s[i])
+            i += 1
+    return "".join(out)
 
 
 def _split_top(s: str) -> List[str]:
@@ -99,36 +127,71 @@ def _parse_field_value(v: str):
         return v
 
 
+def _parse_fast(line: str, now_ms: Optional[int]) -> Optional[InfluxRecord]:
+    """No-escape no-quote fast path: C-speed str.split does all delimiting.
+    Correct exactly when the line contains no backslash and no quote —
+    ~all real metric traffic; anything else takes the general parser."""
+    sections = line.split(" ")
+    if len(sections) < 2 or not sections[1]:
+        return None
+    head = sections[0].split(",")
+    measurement = head[0]
+    if not measurement:
+        return None
+    tags: Dict[str, str] = {}
+    for kv in head[1:]:
+        k, eq, v = kv.partition("=")
+        if eq and k and "=" not in v:   # exactly one '=', like the general path
+            tags[k] = v
+    fields: Dict[str, object] = {}
+    for kv in sections[1].split(","):
+        k, eq, v = kv.partition("=")
+        if eq and k and "=" not in v:
+            fields[k] = _parse_field_value(v)
+    if not fields:
+        return None
+    if len(sections) == 3:
+        ts_ms = _parse_ts(sections[2])
+        if ts_ms is None:
+            return None
+    else:
+        ts_ms = now_ms if now_ms is not None else 0
+    return InfluxRecord(measurement, tags, fields, ts_ms)
+
+
 def parse_influx_line(line: str, now_ms: Optional[int] = None) -> Optional[InfluxRecord]:
     """Parse one line; returns None on malformed input (the reference logs and
     skips, ref: InfluxProtocolParser.parse:127-170)."""
     line = line.strip()
     if not line or line.startswith("#"):
         return None
+    if "\\" not in line and '"' not in line and "  " not in line \
+            and line.count(" ") <= 2:
+        return _parse_fast(line, now_ms)
     sections = _split_top(line)
     if len(sections) < 2:
         return None
-    head = _split_escaped(sections[0], ",")
-    measurement = head[0]
+    head = _split_raw(sections[0], ",")
+    measurement = _unescape(head[0])
     if not measurement:
         return None
     tags: Dict[str, str] = {}
     for kv in head[1:]:
-        parts = _split_escaped(kv, "=")
+        parts = _split_raw(kv, "=")
         if len(parts) == 2 and parts[0]:
-            tags[parts[0]] = parts[1]
+            tags[_unescape(parts[0])] = _unescape(parts[1])
     fields: Dict[str, object] = {}
-    for kv in _split_escaped(sections[1], ","):
-        parts = _split_escaped(kv, "=")
+    for kv in _split_raw(sections[1], ",", quoted=True):
+        parts = _split_raw(kv, "=", quoted=True)
         if len(parts) == 2 and parts[0]:
-            fields[parts[0]] = _parse_field_value(parts[1])
+            fields[_unescape(parts[0])] = _parse_field_value(
+                _unescape(parts[1]))
     if not fields:
         return None
     if len(sections) >= 3:
-        ts_str = sections[2]
-        if len(ts_str) <= 6 or not ts_str.lstrip("-").isdigit():
+        ts_ms = _parse_ts(sections[2])
+        if ts_ms is None:
             return None
-        ts_ms = int(ts_str[:-6])        # ns → ms: drop last 6 digits
     else:
         ts_ms = now_ms if now_ms is not None else 0
     return InfluxRecord(measurement, tags, fields, ts_ms)

@@ -177,3 +177,39 @@ def test_lifecycle_flush_stride():
     lc.start()
     assert shard.stats.flushes >= 3     # rotated through groups during ingest
     assert cs.num_chunksets() > 0
+
+
+def test_influx_fast_path_matches_general_parser():
+    """The no-escape fast path must agree with the escape-aware parser on
+    every line it accepts (the gate sends escaped/quoted lines around it)."""
+    from filodb_tpu.gateway.influx import _parse_fast
+    lines = [
+        "cpu,host=h1,dc=us value=1.5 1600000000000000000",
+        "cpu value=2",
+        "m,a=b f1=1,f2=2i,f3=true 1600000000123000000",
+        "weather,location=us temp=82 1600000000000000001",
+    ]
+    for ln in lines:
+        fast = _parse_fast(ln, now_ms=7)
+        general = parse_influx_line(ln, now_ms=7)
+        assert fast == general, ln
+    # escaped lines bypass the fast path but still parse correctly
+    esc = r"my\ metric,tag\,key=va\=lue value=3 1600000000000000000"
+    r = parse_influx_line(esc)
+    assert r.measurement == "my metric"
+    assert r.tags == {"tag,key": "va=lue"}
+    quoted = 'm,t=x msg="hello world",v=1 1600000000000000000'
+    r2 = parse_influx_line(quoted)
+    assert r2.fields["msg"] == "hello world" and r2.fields["v"] == 1.0
+    # quoted values containing the delimiters themselves
+    r3 = parse_influx_line('m,t=x msg="a,b=c",v=2 1600000000000000000')
+    assert r3.fields["msg"] == "a,b=c" and r3.fields["v"] == 2.0
+    # malformed timestamps are skipped, never raise
+    assert parse_influx_line("m v=1 --1234567") is None
+    assert parse_influx_line("m v=1 -123456") is None
+    assert parse_influx_line("m v=1 12x4567890") is None
+    # a bare extra '=' drops the kv on BOTH paths (no fast/general skew)
+    from filodb_tpu.gateway.influx import _parse_fast
+    skew = "cpu,t=a=b v=1 1600000000000000000"
+    assert _parse_fast(skew, None) == parse_influx_line(skew)
+    assert parse_influx_line(skew).tags == {}
