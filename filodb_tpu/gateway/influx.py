@@ -62,8 +62,13 @@ def _split_raw(s: str, delim: str, quoted: bool = False) -> List[str]:
 
 def _parse_ts(ts_str: str) -> Optional[int]:
     """ns-epoch string -> ms, None when malformed (shared by both parse
-    paths so validation can't drift between them)."""
+    paths so validation can't drift between them).  The WHOLE string must
+    be digits (one leading '-' allowed): int() alone would silently accept
+    garbage in the truncated last-6 characters, '+', or '_' separators."""
     if len(ts_str) <= 6:
+        return None
+    body = ts_str[1:] if ts_str[0] == "-" else ts_str
+    if not (body.isascii() and body.isdigit()):
         return None
     try:
         return int(ts_str[:-6])         # ns → ms: drop last 6 digits
@@ -91,7 +96,9 @@ def _split_top(s: str) -> List[str]:
     out, cur, i, in_quote = [], [], 0, False
     while i < len(s):
         ch = s[i]
-        if ch == "\\" and i + 1 < len(s) and not in_quote:
+        # escapes are honored inside quotes too (so \" doesn't end the
+        # quoted run) — must match _split_raw's escape-before-quote order
+        if ch == "\\" and i + 1 < len(s):
             cur.append(s[i: i + 2])
             i += 2
             continue

@@ -881,13 +881,23 @@ class MultiSchemaPartitionsExec(LeafExecPlan):
         if interpret and not os.environ.get("FILODB_TPU_FUSED_INTERPRET"):
             return None                 # kernel is MXU-targeted
         vals = data.values
-        if getattr(vals, "ndim", 0) != 2 or t0.window_ms is None \
+        ndim = getattr(vals, "ndim", 0)
+        is_hist = ndim == 3
+        if ndim not in (2, 3) or t0.window_ms is None \
                 or t0.function_args or t1.params:
             return None
         if not pf.can_fuse(t0.function or "", t1.op, True, True):
             return None
         if t0.function in ("rate", "increase") and not data.precorrected:
             return None
+        if is_hist:
+            # histogram buckets are counters too: flatten [S, T, B] into
+            # S*B kernel rows with per-(group, bucket) slots — the hist
+            # analogue (ref: HistogramQueryBenchmark's
+            # sum(rate(..._bucket[5m])) + histogram_quantile)
+            if t0.function not in ("rate", "increase") \
+                    or data.bucket_les is None:
+                return None
         wends = make_window_ends(t0.start_ms, t0.end_ms, t0.step_ms)
         eval_wends = wends - t0.offset_ms - data.base_ms
         if eval_wends.size == 0 or abs(eval_wends).max() >= (1 << 30):
@@ -935,15 +945,28 @@ class MultiSchemaPartitionsExec(LeafExecPlan):
             raise GroupCardinalityError(
                 f"group-by cardinality limit {limit} exceeded "
                 f"({len(gkeys)} groups)")
+        B = vals.shape[2] if is_hist else 1
+        num_slots = len(gkeys) * B      # hist: one kernel group per (g, b)
         # VMEM guard, part 2: full estimate now that group count is known —
         # BEFORE the padded device copy, so diverted queries cost nothing
-        if pf.vmem_estimate(Tp, Wp, max(len(gkeys), 8)) > pf.VMEM_BUDGET:
+        if pf.vmem_estimate(Tp, Wp, max(num_slots, 8)) > pf.VMEM_BUDGET:
             return None
         if padded_vals is None:
             vbase = data.vbase
-            if vbase is None:
-                vbase = np.zeros(vals.shape[0], np.float32)
-            padded_vals = pf.pad_values(vals, vbase, plan)
+            if is_hist:
+                # [S, T, B] -> [S*B, T] rows (bucket-major within a series,
+                # same layout PeriodicSamplesMapper flattens to)
+                flat = jnp.moveaxis(jnp.asarray(vals), 2, 1) \
+                    .reshape(vals.shape[0] * B, vals.shape[1])
+                vb_flat = (np.zeros(flat.shape[0], np.float32)
+                           if vbase is None
+                           else jnp.asarray(vbase,
+                                            jnp.float32).reshape(-1))
+                padded_vals = pf.pad_values(flat, vb_flat, plan)
+            else:
+                if vbase is None:
+                    vbase = np.zeros(vals.shape[0], np.float32)
+                padded_vals = pf.pad_values(vals, vbase, plan)
             if key is not None:
                 # a new snapshot generation obsoletes this mirror's older
                 # entries — drop them NOW, not at LRU eviction: each pins a
@@ -954,7 +977,13 @@ class MultiSchemaPartitionsExec(LeafExecPlan):
                         del _FUSED_VALS_CACHE[k]
                     _vals_cache_insert(key, padded_vals)
         if groups is None:
-            groups = pf.pad_groups(gids, vals.shape[0], len(gkeys))
+            if is_hist:
+                gids_flat = (np.asarray(gids, np.int64)[:, None] * B
+                             + np.arange(B)[None, :]).reshape(-1)
+                groups = pf.pad_groups(gids_flat, vals.shape[0] * B,
+                                       num_slots)
+            else:
+                groups = pf.pad_groups(gids, vals.shape[0], len(gkeys))
             if key is not None:
                 with _FUSED_CACHE_LOCK:
                     for k in [k for k in _FUSED_GROUP_CACHE
@@ -967,10 +996,23 @@ class MultiSchemaPartitionsExec(LeafExecPlan):
         prep = pf.PreparedInputs(padded_vals.vals_p, padded_vals.vbase_p,
                                  groups.gids_p, groups.gsize)
         sums, counts = pf.fused_rate_groupsum(
-            None, None, None, plan, len(gkeys), fn_name=t0.function,
+            None, None, None, plan, num_slots, fn_name=t0.function,
             precorrected=data.precorrected, interpret=interpret,
             prepared=prep)
         registry.counter("leaf_fused_kernel").increment()
+        if is_hist:
+            G = len(gkeys)
+            buckets = np.asarray(sums, np.float64) \
+                .reshape(G, B, -1).transpose(0, 2, 1)       # [G, W, B]
+            # series-per-group count: every bucket row of a series shares
+            # presence under the dense gate, so any bucket slot's size IS
+            # the group's series count (works on the group-cache hit path
+            # too, where the raw gids were never recomputed)
+            gsize = groups.gsize.reshape(G, B)[:, 0]
+            cnt = gsize[:, None] * plan.wvalid[None, :].astype(np.float64)
+            comp = np.concatenate([buckets, cnt[..., None]], axis=2)
+            return AggPartial("hist_sum", gkeys, wends, comp=comp,
+                              bucket_les=data.bucket_les)
         comp = np.stack([np.asarray(sums, np.float64), counts], axis=-1)
         return AggPartial("sum", gkeys, wends, comp=comp)
 

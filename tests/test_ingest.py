@@ -208,8 +208,16 @@ def test_influx_fast_path_matches_general_parser():
     assert parse_influx_line("m v=1 --1234567") is None
     assert parse_influx_line("m v=1 -123456") is None
     assert parse_influx_line("m v=1 12x4567890") is None
+    # garbage confined to the truncated ns digits must also be rejected
+    assert parse_influx_line("m v=1 1600000000000.56789") is None
+    assert parse_influx_line("m v=1 1600000000000abc123") is None
+    assert parse_influx_line("m v=1 +1600000000000123456") is None
+    assert parse_influx_line("m v=1 1_600_000_000_000123456") is None
+    # escaped quotes inside quoted string fields survive
+    r4 = parse_influx_line(r'm msg="a \"b\" c",v=1 1600000000000000000')
+    assert r4 is not None and r4.fields["msg"] == 'a "b" c' \
+        and r4.fields["v"] == 1.0
     # a bare extra '=' drops the kv on BOTH paths (no fast/general skew)
-    from filodb_tpu.gateway.influx import _parse_fast
     skew = "cpu,t=a=b v=1 1600000000000000000"
     assert _parse_fast(skew, None) == parse_influx_line(skew)
     assert parse_influx_line(skew).tags == {}

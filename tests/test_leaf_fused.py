@@ -139,3 +139,24 @@ def test_fused_vals_cache_shared_across_groupings(fused_env):
         "grouping variants must share the padded values entry"
     assert len(exec_mod._FUSED_GROUP_CACHE) == 2
     assert a and b
+
+
+def test_fused_histogram_sum_rate_matches_general(fused_env):
+    """histogram sum(rate(bucket[5m])) through the fused kernel (bucket
+    rows flattened into per-(group, bucket) slots) must match the general
+    path, including downstream histogram_quantile."""
+    from filodb_tpu.ingest.generator import histogram_batch
+    engine = _mk_engine([histogram_batch(12, T, start_ms=START_MS)])
+    q = ('histogram_quantile(0.9, '
+         'sum(rate(http_latency{_ws_="demo"}[5m])) by (_ns_))')
+    base = _query(engine, q)             # warm mirror
+    before = _fused_count()
+    got = _query(engine, q)
+    assert _fused_count() > before, "hist fused path did not engage"
+    import os
+    os.environ.pop("FILODB_TPU_FUSED_INTERPRET", None)
+    want = _query(engine, q)
+    assert set(got) == set(want) and got
+    for k in want:
+        np.testing.assert_allclose(got[k], want[k], rtol=5e-4, atol=1e-3,
+                                   equal_nan=True)
