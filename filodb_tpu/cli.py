@@ -244,6 +244,76 @@ def cmd_decodechunks(args) -> int:
     return 0
 
 
+def cmd_partkey(args) -> int:
+    """PromQL filter -> partition key bytes + routing hashes (ref: CliMain
+    `promFilterToPartKeyBR` + `partKeyBrAsString` — the shard-routing
+    debugging pair)."""
+    from filodb_tpu.core.partkey import PartKey
+    from filodb_tpu.promql.parser import query_to_logical_plan
+    from filodb_tpu.query.logical import raw_series_filters
+    try:
+        plan = query_to_logical_plan(args.filter, 0)
+        filter_sets = raw_series_filters(plan)
+    except Exception as e:  # noqa: BLE001
+        print(f"parse error: {e}", file=sys.stderr)
+        return 1
+    from filodb_tpu.core.index import Equals
+    labels = {}
+    metric = ""
+    for fs in filter_sets[:1]:
+        for f in fs:
+            if not isinstance(f, Equals):
+                continue            # only equality pins a partkey label
+            if f.column in ("__name__", "_metric_"):
+                metric = f.value
+            else:
+                labels[f.column] = f.value
+    if not metric:
+        print("filter must pin a metric name with equality", file=sys.stderr)
+        return 1
+    pk = PartKey.make(metric, labels)
+    raw = pk.to_bytes()
+    print(f"partKey       {pk}")
+    print(f"bytes ({len(raw)})   {raw.hex()}")
+    print(f"partitionHash 0x{pk.partition_hash() & 0xFFFFFFFF:08x}")
+    print(f"shardKeyHash  0x{pk.shard_key_hash() & 0xFFFFFFFF:08x}")
+    from filodb_tpu.parallel.shardmapper import ShardMapper
+    mapper = ShardMapper(args.num_shards)
+    shard = mapper.ingestion_shard(pk.shard_key_hash(), pk.partition_hash(),
+                                   args.spread)
+    print(f"ingestionShard {shard}  (numShards={args.num_shards}, "
+          f"spread={args.spread})")
+    return 0
+
+
+def cmd_decodevector(args) -> int:
+    """Decoded sample dump for one series' chunks (ref: CliMain
+    `decodeVector` — raw vector contents for debugging)."""
+    import numpy as np
+
+    from filodb_tpu.memory.chunks import decode_chunkset
+    from filodb_tpu.persist.localstore import LocalDiskColumnStore
+    cs = LocalDiskColumnStore(os.path.join(args.data_dir, "chunks"))
+    shown = 0
+    for rec in cs.read_part_keys(args.dataset, args.shard):
+        if args.metric and rec.part_key.metric != args.metric:
+            continue
+        for c in cs.read_chunks(args.dataset, args.shard, rec.part_key,
+                                0, 1 << 62):
+            cols = decode_chunkset(c)
+            ts = cols.pop("timestamp")
+            print(f"# {rec.part_key} chunk={c.info.chunk_id} "
+                  f"rows={c.info.num_rows}")
+            for i in range(min(len(ts), args.rows)):
+                vals = " ".join(f"{k}={np.asarray(v)[i]}"
+                                for k, v in cols.items())
+                print(f"  {int(ts[i])} {vals}")
+            shown += 1
+            if shown >= args.limit:
+                return 0
+    return 0
+
+
 def cmd_serve(args) -> int:
     """Run the standalone server (ref: FiloServer.scala:39)."""
     from filodb_tpu.persist.localstore import (LocalDiskColumnStore,
@@ -354,6 +424,22 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--shard", type=int, default=0)
     sp.add_argument("--limit", type=int, default=10)
     sp.set_defaults(fn=cmd_decodechunks)
+
+    sp = sub.add_parser("partkey",
+                        help="PromQL filter -> partkey bytes + shard routing")
+    sp.add_argument("filter", help='e.g. \'m{_ws_="demo",_ns_="App-1"}\'')
+    sp.add_argument("--num-shards", type=int, default=32)
+    sp.add_argument("--spread", type=int, default=1)
+    sp.set_defaults(fn=cmd_partkey)
+
+    sp = sub.add_parser("decodevector",
+                        help="dump decoded samples from persisted chunks")
+    common(sp)
+    sp.add_argument("--shard", type=int, default=0)
+    sp.add_argument("--metric", default="")
+    sp.add_argument("--rows", type=int, default=10)
+    sp.add_argument("--limit", type=int, default=5)
+    sp.set_defaults(fn=cmd_decodevector)
 
     sp = sub.add_parser("serve", help="run the standalone server")
     common(sp, data_dir=False)

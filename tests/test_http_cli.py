@@ -185,3 +185,38 @@ def test_cli_query_output(tmp_path, capsys):
     payload = json.loads(capsys.readouterr().out)
     assert payload["status"] == "success"
     assert payload["data"]["result"][0]["metric"]["app"] == "web"
+
+
+def test_cli_partkey_and_decodevector(tmp_path, capsys):
+    from filodb_tpu.cli import main
+    # partkey: filter -> bytes + routing (promFilterToPartKeyBR analogue)
+    assert main(["partkey", 'cpu_load{_ws_="demo",host="h1"}',
+                 "--num-shards", "16", "--spread", "1"]) == 0
+    out = capsys.readouterr().out
+    assert "partitionHash" in out and "ingestionShard" in out
+    assert "cpu_load" in out
+
+    # decodevector: persisted chunk sample dump (decodeVector analogue)
+    data_dir = str(tmp_path / "data")
+    main(["init", "--data-dir", data_dir])
+    csv = tmp_path / "in.csv"
+    rows = ["metric,tags,timestamp,value"]
+    for i in range(30):
+        rows.append(f"cpu_load,host=h{i % 3},{START + i * 10_000},{i * 1.5}")
+    csv.write_text("\n".join(rows))
+    assert main(["importcsv", "--data-dir", data_dir,
+                 "--file", str(csv)]) == 0
+    assert main(["decodevector", "--data-dir", data_dir,
+                 "--rows", "2", "--limit", "2"]) == 0
+    out = capsys.readouterr().out
+    assert "chunk=" in out and "value=" in out
+
+
+def test_cli_partkey_equality_only(capsys):
+    from filodb_tpu.cli import main
+    # NotEquals must not be treated as a pinned label
+    assert main(["partkey", 'cpu{_ws_="demo",host!="h1"}']) == 0
+    out = capsys.readouterr().out
+    assert "host" not in out.split("partKey")[1].splitlines()[0]
+    # a metric pinned only by != is rejected
+    assert main(["partkey", '{__name__!="x",_ws_="demo"}']) == 1
