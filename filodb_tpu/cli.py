@@ -278,7 +278,12 @@ def cmd_partkey(args) -> int:
     print(f"partitionHash 0x{pk.partition_hash() & 0xFFFFFFFF:08x}")
     print(f"shardKeyHash  0x{pk.shard_key_hash() & 0xFFFFFFFF:08x}")
     from filodb_tpu.parallel.shardmapper import ShardMapper
-    mapper = ShardMapper(args.num_shards)
+    n = args.num_shards
+    if n <= 0 or (n & (n - 1)) != 0:
+        print(f"--num-shards must be a power of 2, got {n}",
+              file=sys.stderr)
+        return 1
+    mapper = ShardMapper(n)
     shard = mapper.ingestion_shard(pk.shard_key_hash(), pk.partition_hash(),
                                    args.spread)
     print(f"ingestionShard {shard}  (numShards={args.num_shards}, "

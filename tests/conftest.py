@@ -39,3 +39,9 @@ def mesh8():
 @pytest.fixture
 def rng():
     return np.random.default_rng(42)
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: multi-process / long-running tests (run by "
+        "default; deselect with -m 'not slow')")

@@ -1,0 +1,109 @@
+"""Worker for the real two-process multihost test (test_multihost_2proc).
+
+Each process owns 2 of 4 shards of one deterministic dataset, joins the
+jax.distributed runtime over localhost, assembles the global pack with
+multihost.device_put_packed_multihost, and runs the SPMD windowed
+aggregate over the 8-device global mesh.  Every process then checks the
+psum'd result against a locally-computed oracle over the FULL dataset —
+cross-process collectives must reproduce single-process math exactly.
+
+Run: python tests/mh_worker.py <process_id> <coordinator_port>
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+
+import numpy as np  # noqa: E402
+
+PID = int(sys.argv[1])
+PORT = int(sys.argv[2])
+
+from filodb_tpu.parallel import multihost  # noqa: E402
+
+multihost.initialize(coordinator_address=f"127.0.0.1:{PORT}",
+                     num_processes=2, process_id=PID)
+
+import jax.numpy as jnp  # noqa: E402
+
+from filodb_tpu.ops import agg as agg_ops  # noqa: E402
+from filodb_tpu.ops.rangefns import evaluate_range_function  # noqa: E402
+from filodb_tpu.ops.timewindow import make_window_ends, to_offsets  # noqa: E402
+from filodb_tpu.parallel.mesh import distributed_window_agg, pack_shards  # noqa: E402
+
+assert jax.process_count() == 2, jax.process_count()
+assert len(jax.devices()) == 8
+
+# ---- deterministic dataset: 4 shards x 8 series x 240 samples ----------
+S_PER_SHARD, T, G = 8, 240, 4
+STEP_MS = 10_000
+RANGE_MS = 300_000
+
+
+def shard_data(shard: int):
+    rng = np.random.default_rng(1000 + shard)
+    ts_row = np.arange(T, dtype=np.int64) * STEP_MS
+    vals = np.cumsum(rng.exponential(5.0, size=(S_PER_SHARD, T)), axis=1)
+    gids = ((np.arange(S_PER_SHARD) + shard) % G).astype(np.int32)
+    return ts_row, vals, gids
+
+
+mesh = multihost.global_mesh(n_shard=4, n_time=2)
+my_shards = [0, 1] if PID == 0 else [2, 3]
+blocks = []
+for sh in my_shards:
+    ts_row, vals, gids = shard_data(sh)
+    ts_off = to_offsets(np.tile(ts_row, (S_PER_SHARD, 1)),
+                        np.full(S_PER_SHARD, T), 0)
+    blocks.append((ts_off, vals, gids))
+# invariant #1: precomputed gid arrays + fixed group_labels on every process
+packed = pack_shards(blocks, base_ms=0,
+                     group_labels=[{"g": str(i)} for i in range(G)])
+packed = multihost.device_put_packed_multihost(packed, mesh)
+
+wends = make_window_ends(600_000, 2_390_000, 60_000).astype(np.int32)
+W = len(wends)
+assert W % 2 == 0, "window grid must split evenly over the time axis"
+# each process's devices span BOTH time columns (process-major shard rows),
+# so the window grid is fully process-local: hand the whole array over
+wends_dev = jax.make_array_from_process_local_data(
+    jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec("time")),
+    wends, (W,))
+
+partials = distributed_window_agg(
+    mesh, packed.ts_off, packed.values, packed.group_ids, wends_dev,
+    range_ms=RANGE_MS, fn_name="rate", params=(), agg_op="sum",
+    num_groups=G, base_ms=0, vbase=packed.vbase, precorrected=False)
+out = agg_ops.present("sum", partials)
+
+from jax.experimental import multihost_utils  # noqa: E402
+
+got = np.asarray(multihost_utils.process_allgather(out, tiled=True))[:, :W]
+
+# ---- local oracle over the FULL dataset --------------------------------
+want = np.zeros((G, W))
+cnt = np.zeros((G, W))
+for sh in range(4):
+    ts_row, vals, gids = shard_data(sh)
+    ts_off = to_offsets(np.tile(ts_row, (S_PER_SHARD, 1)),
+                        np.full(S_PER_SHARD, T), 0)
+    r = np.asarray(evaluate_range_function(
+        jnp.asarray(ts_off), jnp.asarray(vals),
+        jnp.asarray(wends), RANGE_MS, "rate", shared_grid=True))
+    for i in range(S_PER_SHARD):
+        ok = ~np.isnan(r[i])
+        want[gids[i]][ok] += r[i][ok]
+        cnt[gids[i]][ok] += 1
+want = np.where(cnt > 0, want, np.nan)
+
+assert (np.isnan(got) == np.isnan(want)).all(), "NaN pattern mismatch"
+np.testing.assert_allclose(got, want, rtol=1e-9, atol=1e-12,
+                           equal_nan=True)
+print(f"proc {PID}: 2-process mesh sum(rate) == oracle over "
+      f"{4 * S_PER_SHARD} series, {W} windows OK", flush=True)
