@@ -500,7 +500,31 @@ def bench_downsample(quick: bool):
           chunks_written=stats.chunks_written)
 
 
+def bench_dispatch(quick: bool):
+    """Cross-node query dispatch QPS over the TCP wire (the Akka-remoting
+    analogue; ref: exec/PlanDispatcher.scala:20-57, client/Serializer —
+    plan subtree + serialized results over the socket)."""
+    from filodb_tpu.ingest.generator import counter_batch
+    from filodb_tpu.parallel.testcluster import make_two_node_cluster
+
+    S, T = (100, 240) if quick else (400, 720)
+    cluster = make_two_node_cluster([counter_batch(S, T, start_ms=START)])
+    try:
+        start_s = START // 1000
+        q = 'sum by (_ns_)(rate(request_total[5m]))'
+        run = lambda: cluster.engine.query_range(  # noqa: E731
+            q, start_s + 600, 60, start_s + T * 10)
+        assert run().error is None
+        n = 20 if quick else 50
+        per = _time_it(run, n)
+        _emit("dispatch", "cross_node_queries_per_sec", 1.0 / per,
+              "queries/s", shards=4, nodes=2, series=S)
+    finally:
+        cluster.stop()
+
+
 BENCHES: Dict[str, Callable[[bool], None]] = {
+    "dispatch": bench_dispatch,
     "downsample": bench_downsample,
     "ingestion": bench_ingestion,
     "intsum": bench_intsum,

@@ -98,37 +98,16 @@ def test_presenter_roundtrip():
 @pytest.fixture(scope="module")
 def cluster():
     """Two node processes (in-process servers), 4 shards, coordinator with
-    remote dispatchers — the multi-JVM IngestionAndRecoverySpec shape."""
-    num_shards = 4
-    mapper = ShardMapper(num_shards)
-    spread = SpreadProvider(default_spread=1)
-    stores = {"nodeA": TimeSeriesMemStore(), "nodeB": TimeSeriesMemStore()}
-    owner = {0: "nodeA", 1: "nodeA", 2: "nodeB", 3: "nodeB"}
-    for s, node in owner.items():
-        stores[node].setup("prometheus", s)
-        mapper.update_from_event(
-            ShardEvent("IngestionStarted", "prometheus", s, node))
-    # reference single store with ALL data for ground truth
-    truth = TimeSeriesMemStore()
-    truth_shards = {s: truth.setup("prometheus", s) for s in range(num_shards)}
-    for batch in (counter_batch(40, 360, start_ms=START),
-                  gauge_batch(30, 360, start_ms=START)):
-        for s, sub in split_batch_by_shard(batch, mapper, spread).items():
-            stores[owner[s]].get_shard("prometheus", s).ingest(sub)
-            truth_shards[s].ingest(sub)
-    servers = {n: NodeQueryServer(st).start() for n, st in stores.items()}
-    dispatchers = {n: RemoteNodeDispatcher(*srv.address)
-                   for n, srv in servers.items()}
-    planner = SingleClusterPlanner(
-        "prometheus", mapper, spread,
-        dispatcher_factory=lambda s: dispatchers[owner[s]])
-    coord_source = TimeSeriesMemStore()        # coordinator holds NO data
-    eng = QueryEngine("prometheus", coord_source, mapper, planner=planner)
-    truth_eng = QueryEngine("prometheus", truth, mapper,
+    remote dispatchers — the multi-JVM IngestionAndRecoverySpec shape.
+    Wiring shared with the dispatch benchmark (parallel/testcluster.py)."""
+    from filodb_tpu.parallel.testcluster import make_two_node_cluster
+    c = make_two_node_cluster(
+        [counter_batch(40, 360, start_ms=START),
+         gauge_batch(30, 360, start_ms=START)], with_truth=True)
+    truth_eng = QueryEngine("prometheus", c.truth, c.mapper,
                             SpreadProvider(default_spread=1))
-    yield eng, truth_eng
-    for srv in servers.values():
-        srv.stop()
+    yield c.engine, truth_eng
+    c.stop()
 
 
 @pytest.mark.parametrize("q", [
@@ -188,3 +167,17 @@ def test_remote_exception_rides_wire_as_error():
         assert str(srv.address[1]) in str(ei.value)
     finally:
         srv.stop()
+
+
+def test_bench_dispatch_smoke():
+    """The cross-node dispatch bench workload runs and emits a JSON line."""
+    import io
+    import json
+    from contextlib import redirect_stdout
+
+    from bench.suite import bench_dispatch
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        bench_dispatch(quick=True)
+    line = json.loads(buf.getvalue().strip().splitlines()[-1])
+    assert line["bench"] == "dispatch" and line["value"] > 0
