@@ -75,7 +75,27 @@ INSTANT_FUNCTIONS: Dict[str, Callable] = {
     "month": lambda v: _epoch_parts(v)[1],
     "year": lambda v: _epoch_parts(v)[0],
     "days_in_month": lambda v: _days_in_month(_epoch_parts(v)[0], _epoch_parts(v)[1]),
+    "day_of_year": lambda v: _day_of_year(v),
 }
+
+
+def _day_of_year(v):
+    """1..365/366 (PromQL day_of_year): days since Jan 1 of the value's
+    year, via the same civil-date math as the other date parts."""
+    y, _, _ = _epoch_parts(v)
+    # epoch day number of Jan 1 of year y (inverse of _civil_from_epoch_days
+    # for month=1 day=1): shift to the March-based era used there
+    ys = y - 1.0                           # era math with March-year m=11
+    era = jnp.floor(ys / 400.0)
+    yoe = ys - era * 400.0
+    # day-of-era for March 1 of civil year y-1 is doe(yoe, doy=306) —
+    # civil Jan 1 of year y is 306 days after March 1 of year y-1
+    doy_m = 306.0                          # Jan 1 in the March calendar
+    doe = yoe * 365.0 + jnp.floor(yoe / 4.0) - jnp.floor(yoe / 100.0) \
+        + doy_m
+    jan1_days = era * 146097.0 + doe - 719468.0
+    days = jnp.floor(v / _SECONDS_PER_DAY)
+    return days - jan1_days + 1.0
 
 
 def apply_instant_function(name: str, vals: jax.Array, *params) -> jax.Array:

@@ -42,8 +42,8 @@ INSTANT_FNS = {
     "histogram_quantile", "histogram_max_quantile", "histogram_bucket",
 }
 
-DATE_FNS = {"minute", "hour", "day_of_week", "day_of_month", "month", "year",
-            "days_in_month"}
+DATE_FNS = {"minute", "hour", "day_of_week", "day_of_month", "day_of_year",
+            "month", "year", "days_in_month"}
 
 MISC_FNS = {"label_replace", "label_join", "hist_to_prom_vectors"}
 

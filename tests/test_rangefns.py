@@ -170,3 +170,23 @@ def test_shared_grid_matches_general_path():
         b = np.asarray(evaluate_range_function(ts_off, vals, wends, 120_000,
                                                fn, shared_grid=True))
         np.testing.assert_array_equal(a, b, err_msg=fn)
+
+
+def test_day_of_year_matches_datetime():
+    """day_of_year over epoch-second values == datetime's tm_yday,
+    including leap-year edges (new date part fn)."""
+    import datetime
+    import jax.numpy as jnp
+    from filodb_tpu.ops.instant import INSTANT_FUNCTIONS
+    rng = np.random.default_rng(3)
+    edges = [datetime.datetime(y, m, d, tzinfo=datetime.timezone.utc)
+             .timestamp() for (y, m, d) in
+             [(2000, 12, 31), (2020, 2, 29), (2020, 12, 31),
+              (2096, 2, 29), (2100, 3, 1), (1972, 12, 31), (1970, 1, 1)]]
+    ts = np.concatenate([
+        rng.integers(0, 4_000_000_000, 1000).astype(np.float64),
+        np.asarray(edges)])
+    got = np.asarray(INSTANT_FUNCTIONS["day_of_year"](jnp.asarray(ts)))
+    want = np.array([datetime.datetime.fromtimestamp(
+        t, datetime.timezone.utc).timetuple().tm_yday for t in ts])
+    np.testing.assert_array_equal(got, want)
