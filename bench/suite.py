@@ -523,8 +523,49 @@ def bench_dispatch(quick: bool):
         cluster.stop()
 
 
+def bench_persist(quick: bool):
+    """Flush-to-disk and read-back throughput through the CRC-framed
+    column store (the ChunkSink/RawChunkSource analogue of the reference's
+    Cassandra write/read path, ref: CassandraColumnStore.scala:53-80)."""
+    import shutil
+    import tempfile
+
+    from filodb_tpu.core.memstore import TimeSeriesMemStore
+    from filodb_tpu.ingest.generator import counter_batch
+    from filodb_tpu.persist.localstore import (LocalDiskColumnStore,
+                                               LocalDiskMetaStore)
+    S, T = (500, 360) if quick else (2000, 720)
+    tmp = tempfile.mkdtemp(prefix="filodb-bench-persist-")
+    try:
+        cs = LocalDiskColumnStore(tmp)
+        ms = TimeSeriesMemStore(column_store=cs,
+                                meta_store=LocalDiskMetaStore(tmp))
+        sh = ms.setup("prometheus", 0)
+        sh.ingest(counter_batch(S, T, start_ms=START))
+        t0 = time.perf_counter()
+        sh.flush_all_groups()
+        fl = time.perf_counter() - t0
+        _emit("persist", "flush_samples_per_sec", S * T / fl, "samples/s",
+              series=S)
+        # COLD store for the read: a fresh instance pays the real
+        # recovery frame scan, not the writer's warm in-memory index
+        cold = LocalDiskColumnStore(tmp)
+        t0 = time.perf_counter()
+        n = 0
+        for rec in cold.read_part_keys("prometheus", 0):
+            for c in cold.read_chunks("prometheus", 0, rec.part_key,
+                                      0, 1 << 62):
+                n += c.info.num_rows
+        rd = time.perf_counter() - t0
+        _emit("persist", "read_samples_per_sec", n / rd, "samples/s",
+              samples=n)
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 BENCHES: Dict[str, Callable[[bool], None]] = {
     "dispatch": bench_dispatch,
+    "persist": bench_persist,
     "downsample": bench_downsample,
     "ingestion": bench_ingestion,
     "intsum": bench_intsum,

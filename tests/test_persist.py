@@ -305,3 +305,19 @@ def test_odp_eviction_invalidates_coverage(tmp_path):
     assert sh2.ensure_paged(parts, start_ms, 10**15) == 60  # re-paged
     _, _, counts, _ = sh2.gather_series(parts)
     assert counts.tolist() == [60, 60]
+
+
+def test_bench_persist_smoke():
+    """The persist bench workload runs and emits JSON lines."""
+    import io
+    import json
+    from contextlib import redirect_stdout
+
+    from bench.suite import bench_persist
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        bench_persist(quick=True)
+    lines = [json.loads(ln) for ln in buf.getvalue().strip().splitlines()]
+    assert {ln["metric"] for ln in lines} == {
+        "flush_samples_per_sec", "read_samples_per_sec"}
+    assert all(ln["value"] > 0 for ln in lines)
