@@ -387,7 +387,17 @@ class _Converter:
         if isinstance(e, A.NumberLit):
             return lp.ScalarFixedDoublePlan(e.value, start, step, end)
         if isinstance(e, A.VectorSelector):
-            self._check_at(e)
+            at = self._resolve_at(e.at_ms)
+            if at is not None:
+                # `m @ t`: evaluate on a single-step grid pinned at t,
+                # then repeat across the output grid
+                raw = lp.RawSeries(
+                    lp.IntervalSelector(at, at), _filters(e),
+                    columns=(e.column,) if e.column else (),
+                    offset_ms=e.offset_ms or None)
+                inner = lp.PeriodicSeries(raw, at, step, at,
+                                          offset_ms=e.offset_ms or None)
+                return lp.ApplyAtTimestamp(inner, start, step, end)
             raw = lp.RawSeries(
                 lp.IntervalSelector(start, end), _filters(e),
                 columns=(e.column,) if e.column else (),
@@ -397,17 +407,24 @@ class _Converter:
         if isinstance(e, A.MatrixSelector):
             raise ParseError("range selector must be inside a range function")
         if isinstance(e, A.Subquery):
-            if getattr(e, "at_ms", None) is not None:
-                raise ParseError("@ modifier is not supported yet")
+            at = self._resolve_at(getattr(e, "at_ms", None))
+            s, en = (at, at) if at is not None else (start, end)
             # offset shifts the whole inner evaluation window back; results
             # keep the inner grid's (shifted) sample timestamps like a
             # matrix selector with offset
             off = e.offset_ms or 0
             inner_step = e.step_ms or step
-            inner = self._conv(e.expr, start - e.window_ms - off,
-                               inner_step, end - off)
-            return lp.TopLevelSubquery(inner, start, step, end,
+            inner = self._conv(e.expr, s - e.window_ms - off,
+                               inner_step, en - off)
+            plan = lp.TopLevelSubquery(inner, s, step, en,
                                        offset_ms=e.offset_ms or None)
+            if at is not None:
+                # top-level subquery yields a MATRIX (only meaningful in an
+                # instant query): the wrapper carries the pin for planners
+                # and copiers but performs no repeating
+                return lp.ApplyAtTimestamp(plan, start, step, end,
+                                           repeat=False)
+            return plan
         if isinstance(e, A.Unary):
             inner = self._conv(e.expr, start, step, end)
             if isinstance(inner, lp.ScalarFixedDoublePlan):
@@ -427,10 +444,18 @@ class _Converter:
             raise ParseError("string literal cannot be a query result")
         raise ParseError(f"cannot convert {type(e).__name__}")
 
-    @staticmethod
-    def _check_at(sel: A.VectorSelector):
-        if sel.at_ms is not None:
-            raise ParseError("@ modifier is not supported yet")
+    def _resolve_at(self, at):
+        """at_ms from the AST: None, epoch-ms int, or 'start'/'end'
+        sentinel -> pinned evaluation time in ms (or None).  Sentinels
+        resolve against the TOP-LEVEL query bounds, as PromQL defines,
+        even inside offset/subquery-shifted conversions."""
+        if at is None:
+            return None
+        if at == "start":
+            return self.start_ms
+        if at == "end":
+            return self.end_ms
+        return int(at)
 
     def _conv_agg(self, e: A.Agg, start, step, end) -> lp.LogicalPlan:
         inner = self._conv(e.expr, start, step, end)
@@ -520,26 +545,35 @@ class _Converter:
                 target = a
         if isinstance(target, A.MatrixSelector):
             sel = target.selector
-            self._check_at(sel)
+            at = self._resolve_at(sel.at_ms)
+            s, en = (at, at) if at is not None else (start, end)
             raw = lp.RawSeries(
-                lp.IntervalSelector(start - target.range_ms, end),
+                lp.IntervalSelector(s - target.range_ms, en),
                 _filters(sel),
                 columns=(sel.column,) if sel.column else (),
                 offset_ms=sel.offset_ms or None)
-            return lp.PeriodicSeriesWithWindowing(
-                raw, start, step, end, target.range_ms, e.name,
+            plan = lp.PeriodicSeriesWithWindowing(
+                raw, s, step, en, target.range_ms, e.name,
                 tuple(fn_args), offset_ms=sel.offset_ms or None)
+            if at is not None:
+                return lp.ApplyAtTimestamp(plan, start, step, end)
+            return plan
         if isinstance(target, A.Subquery):
             sq = target
+            at = self._resolve_at(getattr(sq, "at_ms", None))
+            s, en = (at, at) if at is not None else (start, end)
             off = sq.offset_ms or 0
             inner_step = sq.step_ms or step
             # outer windows evaluate at wends - offset, reaching back a full
             # subquery window: inner data must span [start-off-window, end-off]
-            inner = self._conv(sq.expr, start - off - sq.window_ms,
-                               inner_step, end - off)
-            return lp.SubqueryWithWindowing(
-                inner, start, step, end, e.name, tuple(fn_args),
+            inner = self._conv(sq.expr, s - off - sq.window_ms,
+                               inner_step, en - off)
+            plan = lp.SubqueryWithWindowing(
+                inner, s, step, en, e.name, tuple(fn_args),
                 sq.window_ms, inner_step, offset_ms=sq.offset_ms or None)
+            if at is not None:
+                return lp.ApplyAtTimestamp(plan, start, step, end)
+            return plan
         raise ParseError(f"{e.name} requires a range-vector argument")
 
     def _conv_binary(self, e: A.BinaryExpr, start, step, end) -> lp.LogicalPlan:

@@ -215,6 +215,33 @@ class PeriodicSamplesMapper(RangeVectorTransformer):
 
 
 @dataclasses.dataclass
+class RepeatToGridMapper(RangeVectorTransformer):
+    """PromQL `@` modifier finisher: the upstream mapper evaluated on a
+    single-step grid pinned at the @ timestamp; tile that one column
+    across the query's output grid (Prometheus: the pinned value at every
+    step)."""
+    start_ms: int
+    step_ms: int
+    end_ms: int
+
+    def args_str(self):
+        return (f"start={self.start_ms}, step={self.step_ms}, "
+                f"end={self.end_ms}")
+
+    def apply(self, data: Data, ctx: QueryContext, stats: QueryStats,
+              source=None) -> Data:
+        wends = make_window_ends(self.start_ms, self.end_ms, self.step_ms)
+        if data is None:
+            return None
+        assert isinstance(data, ResultBlock), "@ repeat needs periodic data"
+        vals = np.asarray(data.values)
+        assert vals.shape[1] == 1, "@ inner grid must be single-step"
+        reps = (1, len(wends)) + (1,) * (vals.ndim - 2)
+        return ResultBlock(data.keys, wends, np.tile(vals, reps),
+                           data.bucket_les)
+
+
+@dataclasses.dataclass
 class InstantVectorFunctionMapper(RangeVectorTransformer):
     """ref: exec/RangeVectorTransformer.scala:61."""
     function: str

@@ -218,6 +218,68 @@ class ApplyAbsentFunction(PeriodicSeriesPlan):
 
 
 @dataclasses.dataclass(frozen=True)
+class ApplyAtTimestamp(PeriodicSeriesPlan):
+    """PromQL `@` modifier: `inner` is evaluated on a single-step grid
+    pinned at the @ timestamp; its one column is then repeated across the
+    query's output grid (Prometheus semantics: the pinned value at every
+    step).  repeat=False marks pinned plans whose result is a matrix
+    (top-level subqueries) — the wrapper still carries the pin for
+    planners/copiers, but no repeating happens."""
+    inner: PeriodicSeriesPlan       # start_ms == end_ms == the pinned time
+    start_ms: int
+    step_ms: int
+    end_ms: int
+    repeat: bool = True
+
+    @property
+    def at_ms(self) -> int:
+        return self.inner.start_ms
+
+
+def contains_at_pin(plan: LogicalPlan) -> bool:
+    """True when any subtree is pinned by an @ modifier (planners must
+    then route by pinned data times, not the outer grid)."""
+    if isinstance(plan, ApplyAtTimestamp):
+        return True
+    if dataclasses.is_dataclass(plan):
+        for f in dataclasses.fields(plan):
+            v = getattr(plan, f.name)
+            if isinstance(v, LogicalPlan) and contains_at_pin(v):
+                return True
+    return False
+
+
+def pinned_data_range(plan: LogicalPlan, default_lookback_ms: int):
+    """(earliest_data_ms, latest_data_ms) the plan actually READS.
+    Correct for @ pins WITHOUT special-casing them: the parser bakes
+    pinned grids into every selector (a pinned selector's own
+    start/end IS the pinned time; a pinned subquery's inner grid is
+    already shifted onto it), so each selector's own grid minus its
+    lookback/offset is the truth.  Returns None when the plan has no
+    selectors."""
+    from filodb_tpu.query import planutils as pu
+    lo: List[int] = []
+    hi: List[int] = []
+
+    def walk(p):
+        if isinstance(p, (PeriodicSeries, PeriodicSeriesWithWindowing)):
+            look = pu.get_lookback_ms(p, default_lookback_ms)
+            off = pu.get_offset_ms(p)
+            lo.append(p.start_ms - look - off)
+            hi.append(p.end_ms - off)
+            return
+        if dataclasses.is_dataclass(p):
+            for f in dataclasses.fields(p):
+                v = getattr(p, f.name)
+                if isinstance(v, LogicalPlan):
+                    walk(v)
+    walk(plan)
+    if not lo:
+        return None
+    return min(lo), max(hi)
+
+
+@dataclasses.dataclass(frozen=True)
 class ApplyLimitFunction(PeriodicSeriesPlan):
     vectors: PeriodicSeriesPlan
     limit: int

@@ -156,6 +156,19 @@ class SingleClusterPlanner(QueryPlanner):
                 lookback_ms=self.stale_lookback_ms))
         return leaves
 
+    def _m_ApplyAtTimestamp(self, p: lp.ApplyAtTimestamp, ctx: QueryContext):
+        from filodb_tpu.query.exec import RepeatToGridMapper
+        out = self._walk(p.inner, ctx)
+        if not p.repeat:                 # matrix-valued pins (subqueries)
+            return out
+        mapper = RepeatToGridMapper(p.start_ms, p.step_ms, p.end_ms)
+        if isinstance(out, list):
+            for leaf in out:
+                leaf.add_transformer(mapper)
+            return out
+        out.add_transformer(mapper)
+        return out
+
     # subqueries --------------------------------------------------------------
 
     def _m_TopLevelSubquery(self, p: lp.TopLevelSubquery, ctx: QueryContext):

@@ -118,6 +118,20 @@ class LongTimeRangePlanner(QueryPlanner):
     def materialize(self, plan: lp.LogicalPlan, ctx: QueryContext) -> ExecPlan:
         if not isinstance(plan, lp.PeriodicSeriesPlan):
             return self.raw.materialize(plan, ctx)   # metadata → raw cluster
+        if lp.contains_at_pin(plan):
+            # @ (anywhere in the tree) reads data at pinned times, not the
+            # outer grid: route the WHOLE query by the true data range —
+            # straddle-splitting the outer grid cannot relocate pinned
+            # reads.  Fits-raw wins; else downsample only when it covers
+            # the range end; else conservatively raw.
+            dr = lp.pinned_data_range(plan, self.stale_lookback_ms)
+            if dr is None:
+                return self.raw.materialize(plan, ctx)
+            if dr[0] >= self.earliest_raw_time_fn():
+                return self.raw.materialize(plan, ctx)
+            if dr[1] <= self.latest_downsample_time_fn():
+                return self.downsample.materialize(plan, ctx)
+            return self.raw.materialize(plan, ctx)
         earliest_raw = self.earliest_raw_time_fn()
         lookback = pu.get_lookback_ms(plan, self.stale_lookback_ms)
         offset = pu.get_offset_ms(plan)
@@ -234,6 +248,20 @@ class HighAvailabilityPlanner(QueryPlanner):
     def materialize(self, plan: lp.LogicalPlan, ctx: QueryContext) -> ExecPlan:
         if not isinstance(plan, lp.PeriodicSeriesPlan):
             return self.local.materialize(plan, ctx)
+        if lp.contains_at_pin(plan):
+            # @ reads at pinned times: check failures against the true
+            # data range and route the WHOLE query (slicing the outer
+            # grid cannot relocate a pinned read)
+            dr = lp.pinned_data_range(plan, self.stale_lookback_ms)
+            if dr is not None:
+                failures = self.failure_provider.get_failures(
+                    self.dataset, TimeRange(dr[0], dr[1]))
+                if any(not f.is_remote for f in failures):
+                    return PromQlRemoteExec(
+                        ctx, self.remote_endpoint, pu.unparse(plan),
+                        plan.start_ms, plan.step_ms, plan.end_ms,
+                        transport=self.transport)
+            return self.local.materialize(plan, ctx)
         lookback = pu.get_lookback_ms(plan, self.stale_lookback_ms)
         offset = pu.get_offset_ms(plan)
         tr = TimeRange(plan.start_ms - lookback - offset, plan.end_ms)
@@ -302,6 +330,8 @@ class MultiPartitionPlanner(QueryPlanner):
             return self.local.materialize(plan, ctx)
         filter_groups = pu.get_raw_series_filters(plan)
         tr = pu.get_time_range(plan)
+        if lp.contains_at_pin(plan):
+            return self._materialize_pinned(plan, ctx, filter_groups)
         # a partition may own several disjoint windows (data moved away and
         # back) — dedupe on the full assignment, never just the name
         assignments: List[PartitionAssignment] = []
@@ -333,6 +363,29 @@ class MultiPartitionPlanner(QueryPlanner):
         if len(children) == 1:
             return children[0]
         return StitchRvsExec(ctx, children)
+
+    def _materialize_pinned(self, plan: lp.LogicalPlan, ctx: QueryContext,
+                            filter_groups) -> ExecPlan:
+        """@ plans read data at the PINNED time, not the outer grid: select
+        the partition by the true data range and send the WHOLE plan there
+        (slicing the outer grid cannot relocate a pinned read).  Mixed
+        multi-partition pinned expressions degrade to local evaluation."""
+        dr = lp.pinned_data_range(plan, self.stale_lookback_ms)
+        if dr is None:
+            return self.local.materialize(plan, ctx)
+        tr = TimeRange(dr[0], dr[1])
+        names = set()
+        endpoint = None
+        for fg in (filter_groups or [()]):
+            for a in self.provider.get_partitions(fg, tr):
+                names.add(a.partition_name)
+                if a.partition_name != self.local_name:
+                    endpoint = a.endpoint
+        if len(names) == 1 and endpoint is not None:
+            return PromQlRemoteExec(
+                ctx, endpoint, pu.unparse(plan), plan.start_ms,
+                plan.step_ms, plan.end_ms, transport=self.transport)
+        return self.local.materialize(plan, ctx)
 
 
 def _snap_up(t: int, grid_start: int, step: int) -> int:

@@ -87,6 +87,11 @@ def copy_with_time_range(plan: lp.LogicalPlan, tr: TimeRange) -> lp.LogicalPlan:
 
 
 def _copy_tr(p, tr: TimeRange):
+    if isinstance(p, lp.ApplyAtTimestamp):
+        # @ pins the inner evaluation time: only the OUTER (repeat) grid
+        # retargets; rewriting the inner grid would destroy the pinning
+        return dataclasses.replace(p, start_ms=tr.start_ms,
+                                   end_ms=tr.end_ms)
     if isinstance(p, lp.RawSeries):
         return dataclasses.replace(
             p, range_selector=lp.IntervalSelector(tr.start_ms, tr.end_ms))
@@ -255,6 +260,38 @@ def unparse(plan: lp.LogicalPlan) -> str:
     Used by remote execs (HA / multi-partition routing) and by planner tests
     as a round-trip regression net."""
     u = unparse
+    if isinstance(plan, lp.ApplyAtTimestamp):
+        # re-attach the @ to the pinned selector/subquery text
+        at_s = plan.inner.start_ms / 1000.0
+        at_txt = f"{at_s:.3f}".rstrip("0").rstrip(".")
+        inner = plan.inner
+        if isinstance(inner, lp.PeriodicSeries):
+            return (f"{_selector(inner.raw_series, offset_ms=inner.offset_ms)}"
+                    f" @ {at_txt}")
+        if isinstance(inner, lp.PeriodicSeriesWithWindowing):
+            sel = _selector(inner.series, window_ms=inner.window_ms,
+                            offset_ms=inner.offset_ms)
+            args = [_num_str(a) for a in inner.function_args]
+            return (f"{inner.function}("
+                    f"{','.join(args + [sel + ' @ ' + at_txt])})")
+        if isinstance(inner, lp.SubqueryWithWindowing):
+            off = (f" offset {_dur(inner.offset_ms)}"
+                   if inner.offset_ms else "")
+            sq = (f"({u(inner.inner)})"
+                  f"[{_dur(inner.subquery_window_ms)}:"
+                  f"{_dur(inner.subquery_step_ms)}]{off} @ {at_txt}")
+            args = [_num_str(a) for a in inner.function_args]
+            return f"{inner.function}({','.join(args + [sq])})"
+        if isinstance(inner, lp.TopLevelSubquery):
+            step = inner.inner.step_ms
+            win = (inner.start_ms - (inner.offset_ms or 0)
+                   - inner.inner.start_ms)
+            off = (f" offset {_dur(inner.offset_ms)}"
+                   if inner.offset_ms else "")
+            return (f"({u(inner.inner)})[{_dur(win)}:{_dur(step)}]{off}"
+                    f" @ {at_txt}")
+        raise ValueError(
+            f"cannot unparse @ over {type(inner).__name__}")
     if isinstance(plan, lp.PeriodicSeries):
         return _selector(plan.raw_series, offset_ms=plan.offset_ms)
     if isinstance(plan, lp.PeriodicSeriesWithWindowing):
@@ -325,7 +362,9 @@ def unparse(plan: lp.LogicalPlan) -> str:
         return f"vector({u(plan.scalars)})"
     if isinstance(plan, lp.TopLevelSubquery):
         step = plan.inner.step_ms
-        win = plan.end_ms - plan.start_ms
+        # window from the inner grid anchor (end-start is 0 for @-pinned
+        # plans): inner spans [start - window - offset, end - offset]
+        win = plan.start_ms - (plan.offset_ms or 0) - plan.inner.start_ms
         off = f" offset {_dur(plan.offset_ms)}" if plan.offset_ms else ""
         return f"({u(plan.inner)})[{_dur(win)}:{_dur(step)}]{off}"
     if isinstance(plan, lp.SubqueryWithWindowing):

@@ -403,3 +403,145 @@ def test_multi_partition_reduce_aggregate_compose():
     vals = {k: v for k, v in zip(out.keys, np.asarray(out.values))}
     assert vals[k1][0] == 11.0 and vals[k1][1] == 2.0
     assert np.isnan(vals[k2][0]) and vals[k2][1] == 5.0
+
+
+def test_at_modifier_survives_time_range_copy_and_unparse():
+    """@ plans: copy_with_time_range must keep the pinned inner grid, and
+    unparse must emit valid PromQL for remote routing (HA/multi-partition)."""
+    from filodb_tpu.promql.parser import (TimeStepParams,
+                                          query_range_to_logical_plan)
+    from filodb_tpu.query import planutils as pu
+    from filodb_tpu.query import logical as lp
+
+    T = TimeStepParams(1_600_000_600, 60, 1_600_003_600)
+    for q in ["foo @ 1600000000",
+              "rate(foo[5m] @ 1600000000)",
+              "max_over_time(foo[10m:1m] @ 1600000000)",
+              "max_over_time(foo[10m:1m] offset 5m @ 1600000000)",
+              "rate(foo[5m])[30m:1m] @ 1600000000"]:
+        plan = query_range_to_logical_plan(q, T)
+        assert isinstance(plan, lp.ApplyAtTimestamp), q
+        moved = pu.copy_with_time_range(
+            plan, pu.TimeRange(1_600_001_000_000, 1_600_002_000_000))
+        assert moved.inner.start_ms == moved.inner.end_ms \
+            == 1_600_000_000_000, q
+        assert moved.start_ms == 1_600_001_000_000
+        # unparse -> reparse round trip preserves the pinned time
+        text = pu.unparse(plan)
+        again = query_range_to_logical_plan(text, T)
+        assert isinstance(again, lp.ApplyAtTimestamp), text
+        assert again.inner.start_ms == plan.inner.start_ms, text
+
+
+def test_at_modifier_long_time_range_routes_by_pinned_time():
+    """LongTimeRangePlanner must route @ queries by the PINNED time: an @
+    older than raw retention goes to the downsample cluster even when the
+    outer grid is recent."""
+    from filodb_tpu.promql.parser import (TimeStepParams,
+                                          query_range_to_logical_plan)
+    from filodb_tpu.query.planners import LongTimeRangePlanner
+
+    calls = []
+
+    class _P:
+        def __init__(self, name):
+            self.name = name
+
+        def materialize(self, plan, ctx):
+            calls.append(self.name)
+            return object()
+
+    earliest_raw = 1_600_010_000_000
+    planner = LongTimeRangePlanner(
+        _P("raw"), _P("ds"), lambda: earliest_raw,
+        lambda: earliest_raw + 3_600_000)
+    T = TimeStepParams(1_600_020_000, 60, 1_600_023_000)  # recent outer grid
+    old = query_range_to_logical_plan("foo @ 1600000000", T)   # pinned OLD
+    recent = query_range_to_logical_plan(
+        f"foo @ {earliest_raw // 1000 + 600}", T)
+    planner.materialize(old, QueryContext())
+    planner.materialize(recent, QueryContext())
+    assert calls == ["ds", "raw"]
+
+
+def test_at_sentinels_resolve_to_top_level_bounds():
+    """start()/end() inside subqueries resolve to the OUTERMOST query
+    bounds (PromQL), not the shifted inner conversion range."""
+    from filodb_tpu.promql.parser import (TimeStepParams,
+                                          query_range_to_logical_plan)
+    from filodb_tpu.query import logical as lp
+
+    T = TimeStepParams(1_600_000_600, 60, 1_600_003_600)
+    plan = query_range_to_logical_plan(
+        "max_over_time((foo @ start())[30m:1m])", T)
+    # find the nested ApplyAtTimestamp and check it pins to query start
+    def find(p):
+        if isinstance(p, lp.ApplyAtTimestamp):
+            return p
+        for f in p.__dataclass_fields__:
+            v = getattr(p, f)
+            if isinstance(v, lp.LogicalPlan):
+                r = find(v)
+                if r is not None:
+                    return r
+        return None
+    at = find(plan)
+    assert at is not None
+    assert at.inner.start_ms == 1_600_000_600_000
+
+
+def test_at_modifier_wrapped_aggregate_routes_by_pinned_time():
+    """sum(foo @ t) — pin NOT at the plan root — must still route by the
+    pinned data time (the pin detector walks the whole tree)."""
+    from filodb_tpu.promql.parser import (TimeStepParams,
+                                          query_range_to_logical_plan)
+    from filodb_tpu.query.planners import LongTimeRangePlanner
+
+    calls = []
+
+    class _P:
+        def __init__(self, name):
+            self.name = name
+
+        def materialize(self, plan, ctx):
+            calls.append(self.name)
+            return object()
+
+    earliest_raw = 1_600_010_000_000
+    planner = LongTimeRangePlanner(
+        _P("raw"), _P("ds"), lambda: earliest_raw,
+        lambda: earliest_raw + 3_600_000)
+    T = TimeStepParams(1_600_020_000, 60, 1_600_023_000)
+    old = query_range_to_logical_plan("sum(foo @ 1600000000)", T)
+    planner.materialize(old, QueryContext())
+    assert calls == ["ds"]
+
+
+def test_at_modifier_pinned_data_range_includes_subquery_window():
+    """pinned_data_range must account for a pinned subquery's full
+    reach-back (window + lookback), not just the pinned instant."""
+    plan = _plan("max_over_time(foo[2h:1m] @ 1600000000)")
+    dr = lp.pinned_data_range(plan, 300_000)
+    at = 1_600_000_000_000
+    assert dr[1] == at
+    assert dr[0] == at - 2 * 3600_000 - 300_000
+
+
+def test_ha_planner_routes_pinned_failures_remote():
+    """A local failure window covering the pinned @ time must send the
+    whole query to the replica, even when the outer grid is healthy."""
+    at_ms = 1_600_000_000_000
+    fail = FailureTimeRange("local", TimeRange(at_ms - 600_000,
+                                               at_ms + 600_000),
+                            is_remote=False)
+    local = _RecordingPlanner("local")
+    T2 = TimeStepParams(START_S + 7200, 60, START_S + 10800)
+    planner = HighAvailabilityPlanner("prometheus", local, _FP([fail]),
+                                      "http://replica")
+    out = planner.materialize(_plan("foo @ 1600000000", T2), QueryContext())
+    assert isinstance(out, PromQlRemoteExec)
+    assert not local.materialized
+    # healthy pinned time -> local
+    out2 = planner.materialize(
+        _plan(f"foo @ {at_ms // 1000 + 7200}", T2), QueryContext())
+    assert isinstance(out2, _Dummy) and out2.tag == "local"

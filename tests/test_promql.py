@@ -216,6 +216,12 @@ def test_unary_minus_power_precedence():
     assert isinstance(e3.rhs, A.BinaryExpr) and e3.rhs.op == "^"
 
 
-def test_subquery_at_modifier_rejected():
-    with pytest.raises(ParseError):
-        query_range_to_logical_plan("rate(foo[5m])[30m:1m] @ 1600000000", T)
+def test_subquery_at_modifier_pins_grid():
+    """@ on a top-level subquery pins its evaluation grid via a
+    non-repeating ApplyAtTimestamp wrapper (the result is a matrix,
+    meaningful in instant queries)."""
+    from filodb_tpu.query import logical as lp
+    plan = query_range_to_logical_plan(
+        "rate(foo[5m])[30m:1m] @ 1600000000", T)
+    assert isinstance(plan, lp.ApplyAtTimestamp) and not plan.repeat
+    assert plan.inner.start_ms == plan.inner.end_ms == 1_600_000_000_000

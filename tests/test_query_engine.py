@@ -299,3 +299,47 @@ def test_scan_time_sample_limit_fails_fast():
     res3 = eng.query_range('sum(rate(request_total[30s]))', s + 900, 30,
                            s + 960, pp3)
     assert res3.error is None, res3.error
+
+
+def test_at_modifier_pins_evaluation_time(engine):
+    """`m @ t` evaluates at the pinned time and repeats across the grid;
+    `@ end()`/`@ start()` resolve to the query range bounds (PromQL @)."""
+    t = START_S + 1200
+    r = engine.query_range(f'heap_usage{{_ws_="demo",_ns_="App-1"}} @ {t}',
+                           START_S + 600, 60, START_S + 2400)
+    assert r.error is None, r.error
+    inst = engine.query_range('heap_usage{_ws_="demo",_ns_="App-1"}',
+                              t, 60, t)
+    want = {tuple(sorted(k.labels_dict.items())): np.asarray(v)[0]
+            for k, _, v in inst.series()}
+    count = 0
+    for k, _, v in r.series():
+        key = tuple(sorted(k.labels_dict.items()))
+        v = np.asarray(v)
+        assert (v == want[key]).all()
+        count += 1
+    assert count == len(want) > 0
+
+    # rate at a pinned end(), aggregated, equals the instant evaluation
+    r2 = engine.query_range(
+        'sum(rate(request_total{_ws_="demo"}[5m] @ end()))',
+        START_S + 600, 60, START_S + 2400)
+    assert r2.error is None, r2.error
+    inst2 = engine.query_range('sum(rate(request_total{_ws_="demo"}[5m]))',
+                               START_S + 2400, 60, START_S + 2400)
+    want2 = np.asarray(list(inst2.series())[0][2])[0]
+    got2 = np.asarray(list(r2.series())[0][2])
+    np.testing.assert_allclose(got2, want2, rtol=1e-9)
+
+    # sentinel == explicit timestamp
+    r3 = engine.query_range('heap_usage{_ws_="demo"} @ start()',
+                            START_S + 600, 60, START_S + 1200)
+    r4 = engine.query_range(f'heap_usage{{_ws_="demo"}} @ {START_S + 600}',
+                            START_S + 600, 60, START_S + 1200)
+    a = {tuple(sorted(k.labels_dict.items())): np.asarray(v)
+         for k, _, v in r3.series()}
+    b = {tuple(sorted(k.labels_dict.items())): np.asarray(v)
+         for k, _, v in r4.series()}
+    assert set(a) == set(b) and a
+    for k in a:
+        np.testing.assert_array_equal(a[k], b[k])
