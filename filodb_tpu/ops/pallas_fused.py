@@ -51,7 +51,9 @@ class FusedPlan(NamedTuple):
     n: np.ndarray        # [1, Wp] f32   samples in window
     wstart_x: np.ndarray  # [1, Wp] f32  window start boundary (exclusive-1)
     wend_x: np.ndarray   # [1, Wp] f32
-    wvalid: np.ndarray   # [W] bool      n >= 2
+    wvalid: np.ndarray   # [W] bool      n >= 2 (rate family)
+    wvalid1: np.ndarray  # [W] bool      n >= 1 (*_over_time family)
+    n1: np.ndarray       # [1, Wp] f32   TRUE samples in window (0 empty)
     W: int
     Tp: int
 
@@ -67,7 +69,11 @@ def build_plan(ts_row: np.ndarray, wends: np.ndarray,
     n = np.maximum(last - first + 1, 0)
     W, T = len(wend), len(ts_row)
     Wp, Tp = _pad_to(max(W, 1), _LANE), _pad_to(max(T, 1), _LANE)
-    valid = n >= 2
+    # selection matrices cover every NON-EMPTY window (n >= 1): the
+    # over_time band needs single-sample windows, and the rate family is
+    # harmless on them (first == last -> delta == 0 -> contributes 0; its
+    # host mask wvalid stays n >= 2)
+    valid = n >= 1
 
     def sel(idx, leq):
         m = np.zeros((Tp, Wp), np.float32)
@@ -91,13 +97,13 @@ def build_plan(ts_row: np.ndarray, wends: np.ndarray,
         t2=row(np.where(valid, ts_row[la], 0)),
         n=row(np.maximum(n, 2)),           # safe: invalid windows masked out
         wstart_x=row(wstart - 1), wend_x=row(wend),
-        wvalid=valid, W=W, Tp=Tp)
+        wvalid=(n >= 2), wvalid1=(n >= 1), n1=row(n), W=W, Tp=Tp)
 
 
 def _kernel(vals_ref, vbase_ref, gids_ref, o1_ref, o2_ref, l1_ref, l2_ref,
             t1_ref, t2_ref, n_ref, ws_ref, we_ref, out_ref,
             *, num_groups: int, is_counter: bool, is_rate: bool,
-            with_drops: bool):
+            with_drops: bool, kind: str = "rate_family"):
     v = vals_ref[:]                                   # [BS, Tp]
     # HIGHEST: the MXU's default bf16 pass truncates f32 mantissas (1e-2
     # relative error on counter magnitudes); the multi-pass f32 decomposition
@@ -105,6 +111,19 @@ def _kernel(vals_ref, vbase_ref, gids_ref, o1_ref, o2_ref, l1_ref, l2_ref,
     # the HBM read)
     mm = functools.partial(jnp.dot, preferred_element_type=jnp.float32,
                            precision=jax.lax.Precision.HIGHEST)
+    if kind in ("sum_over_time", "avg_over_time"):
+        # window sums as ONE matmul against the band matrix
+        # band[t, w] = 1{first[w] <= t <= last[w]} = l2 - l1 + o1;
+        # the ABSOLUTE sum re-adds the per-series base as vb * n
+        band = l2_ref[:] - l1_ref[:] + o1_ref[:]
+        n = n_ref[:]                                  # TRUE counts here
+        s = mm(v, band)
+        if kind == "sum_over_time":
+            out = s + vbase_ref[:] * n
+        else:
+            out = s / jnp.maximum(n, 1.0) + vbase_ref[:]
+        _group_accumulate(mm, v, gids_ref, out, out_ref, num_groups)
+        return
     v1 = mm(v, o1_ref[:])                             # [BS, Wp]
     v2 = mm(v, o2_ref[:])
     if with_drops:
@@ -137,6 +156,12 @@ def _kernel(vals_ref, vbase_ref, gids_ref, o1_ref, o2_ref, l1_ref, l2_ref,
     if is_rate:
         out = out / jnp.maximum(we - ws, 1.0) * 1000.0
 
+    _group_accumulate(mm, v, gids_ref, out, out_ref, num_groups)
+
+
+def _group_accumulate(mm, v, gids_ref, out, out_ref, num_groups: int):
+    """Shared epilogue: one-hot group segment-sum on the MXU, accumulated
+    across sequential grid steps (pad rows carry gid -1: no match)."""
     gids = gids_ref[:]                                # [BS, 1] int32
     groups = jax.lax.broadcasted_iota(jnp.int32, (num_groups, v.shape[0]), 0)
     onehot = (groups == gids[:, 0][None, :]).astype(jnp.float32)
@@ -149,10 +174,11 @@ def _kernel(vals_ref, vbase_ref, gids_ref, o1_ref, o2_ref, l1_ref, l2_ref,
 
 
 @functools.partial(jax.jit, static_argnames=(
-    "num_groups", "is_counter", "is_rate", "with_drops", "interpret"))
+    "num_groups", "is_counter", "is_rate", "with_drops", "interpret",
+    "kind"))
 def _run(vals_p, vbase_p, gids_p, o1, o2, l1, l2, t1, t2, n, ws, we,
          num_groups: int, is_counter: bool, is_rate: bool,
-         with_drops: bool, interpret: bool):
+         with_drops: bool, interpret: bool, kind: str = "rate_family"):
     from jax.experimental.pallas import tpu as pltpu
 
     Sp, Tp = vals_p.shape
@@ -164,7 +190,8 @@ def _run(vals_p, vbase_p, gids_p, o1, o2, l1, l2, t1, t2, n, ws, we,
     col_spec = pl.BlockSpec((_BS, 1), lambda i: (i, 0), **space)
     fix = lambda shape: pl.BlockSpec(shape, lambda i: (0, 0), **space)  # noqa: E731
     kern = functools.partial(_kernel, num_groups=Gp, is_counter=is_counter,
-                             is_rate=is_rate, with_drops=with_drops)
+                             is_rate=is_rate, with_drops=with_drops,
+                             kind=kind)
     return pl.pallas_call(
         kern,
         grid=(grid,),
@@ -186,16 +213,21 @@ def vmem_estimate(Tp: int, Wp: int, Gp: int) -> int:
     the double-buffered values block, the group one-hot + accumulator, and
     [BS, Wp] f32 temporaries.  Callers divert to the general XLA path when
     this exceeds VMEM_BUDGET instead of failing at kernel lowering."""
-    sel = 4 * Tp * Wp * 4
+    sel = 5 * Tp * Wp * 4      # 4 selection matrices + the band temporary
     vals = 2 * _BS * Tp * 4
     group = Gp * (Wp * 8 + _BS * 4)
     inter = 12 * _BS * Wp * 4
     return sel + vals + group + inter
 
 
+FUSABLE_FNS = ("rate", "increase", "delta", "sum_over_time",
+               "avg_over_time")
+OVER_TIME_FNS = ("sum_over_time", "avg_over_time")
+
+
 def can_fuse(fn_name: str, agg_op: str, shared_grid: bool,
              dense: bool) -> bool:
-    return (fn_name in ("rate", "increase", "delta") and agg_op == "sum"
+    return (fn_name in FUSABLE_FNS and agg_op == "sum"
             and shared_grid and dense)
 
 
@@ -269,17 +301,21 @@ def fused_rate_groupsum(vals, vbase, gids, plan: FusedPlan,
     is_counter = fn_name in ("rate", "increase")
     is_rate = fn_name == "rate"
     with_drops = is_counter and not precorrected
+    over_time = fn_name in OVER_TIME_FNS
+    kind = fn_name if over_time else "rate_family"
     if prepared is None:
         prepared = pad_inputs(vals, vbase, gids, plan, num_groups)
     Gp = _pad_to(max(num_groups, 8), 8)
     sums = _run(prepared.vals_p, prepared.vbase_p, prepared.gids_p,
                 *(jnp.asarray(m) for m in
                   (plan.o1, plan.o2, plan.l1, plan.l2, plan.t1, plan.t2,
-                   plan.n, plan.wstart_x, plan.wend_x)),
+                   plan.n1 if over_time else plan.n,
+                   plan.wstart_x, plan.wend_x)),
                 num_groups=Gp, is_counter=is_counter, is_rate=is_rate,
-                with_drops=with_drops, interpret=interpret)
+                with_drops=with_drops, interpret=interpret, kind=kind)
+    wvalid = plan.wvalid1 if over_time else plan.wvalid
     counts = prepared.gsize[:, None].astype(np.float64) * \
-        plan.wvalid[None, :].astype(np.float64)
+        wvalid[None, :].astype(np.float64)
     return sums[:num_groups, :plan.W], counts
 
 

@@ -236,11 +236,13 @@ def device_put_packed(packed: PackedShards, mesh: Mesh) -> PackedShards:
 # ------------------------------------------------------------ SPMD kernels
 
 @functools.partial(jax.jit, static_argnames=(
-    "mesh", "G", "S", "T", "Tp", "is_counter", "is_rate", "interpret"))
+    "mesh", "G", "S", "T", "Tp", "is_counter", "is_rate", "interpret",
+    "kind"))
 def _mesh_fused_call(mesh: Mesh, values, group_ids, vbase,
                      o1, o2, l1, l2, t1, t2, n, ws, we, *,
                      G: int, S: int, T: int, Tp: int,
-                     is_counter: bool, is_rate: bool, interpret: bool):
+                     is_counter: bool, is_rate: bool, interpret: bool,
+                     kind: str = "rate_family"):
     """Pallas fused sum(rate)-family kernel inside shard_map: values sharded
     over 'shard', per-slice selection matrices over 'time', group sums psum
     over 'shard'.  jit-cached on the static shape/flag tuple so repeat
@@ -264,7 +266,7 @@ def _mesh_fused_call(mesh: Mesh, values, group_ids, vbase,
                             t1b[0], t2b[0], nb[0], wsb[0], web[0],
                             num_groups=Gp, is_counter=is_counter,
                             is_rate=is_rate, with_drops=False,
-                            interpret=interpret)
+                            interpret=interpret, kind=kind)
         return jax.lax.psum(out[:G], "shard")          # [G, Wlp]
 
     return jax.shard_map(
@@ -636,14 +638,19 @@ class MeshExecutor:
                 jax.device_put(st(a), NamedSharding(
                     self.mesh, P("time", None, None)))
                 for a in ("o1", "o2", "l1", "l2",
-                          "t1", "t2", "n", "wstart_x", "wend_x"))
+                          "t1", "t2", "n", "wstart_x", "wend_x", "n1"))
             wvalid = np.concatenate([p.wvalid for p in plans])
-            ent = (mats, wvalid)
+            wvalid1 = np.concatenate([p.wvalid1 for p in plans])
+            ent = (mats, wvalid, wvalid1)
             self._fused_plan_cache[plan_key] = ent
             while len(self._fused_plan_cache) > 4:
                 self._fused_plan_cache.pop(
                     next(iter(self._fused_plan_cache)))
-        mats, wvalid = ent
+        mats, wvalid, wvalid1 = ent
+        over_time = fn_name in pf.OVER_TIME_FNS
+        # the kernel's `n` slot carries TRUE counts for the over_time
+        # kinds and the rate family's clamped counts otherwise
+        mats = mats[:6] + ((mats[9] if over_time else mats[6]),) + mats[7:9]
         vbase = packed.vbase
         if vbase is None:
             vbase = jax.device_put(
@@ -657,10 +664,12 @@ class MeshExecutor:
             self.mesh, packed.values, packed.group_ids, vbase, *mats,
             G=G, S=S, T=T, Tp=Tp,
             is_counter=(fn_name in ("rate", "increase")),
-            is_rate=(fn_name == "rate"), interpret=interpret)
+            is_rate=(fn_name == "rate"), interpret=interpret,
+            kind=(fn_name if over_time else "rate_family"))
         out = np.asarray(res).reshape(G, n_time, Wlp)[:, :, :Wl] \
             .reshape(G, Wp)[:, :W]
-        counts = packed.gsize[:, None] * wvalid[None, :W]
+        counts = packed.gsize[:, None] * \
+            (wvalid1 if over_time else wvalid)[None, :W]
         from filodb_tpu.utils.metrics import registry
         registry.counter("mesh_fused_kernel").increment()
         return pf.present_sum(out, counts)

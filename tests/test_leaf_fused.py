@@ -160,3 +160,23 @@ def test_fused_histogram_sum_rate_matches_general(fused_env):
     for k in want:
         np.testing.assert_allclose(got[k], want[k], rtol=5e-4, atol=1e-3,
                                    equal_nan=True)
+
+
+@pytest.mark.parametrize("fn", ["sum_over_time", "avg_over_time"])
+def test_fused_over_time_matches_general(fused_env, fn):
+    """sum by of the *_over_time family through the band-matrix kernel
+    must match the general path (gauge columns, vbase re-added)."""
+    from filodb_tpu.ingest.generator import gauge_batch
+    engine = _mk_engine([gauge_batch(40, T, start_ms=START_MS)])
+    q = f'sum({fn}(heap_usage{{_ws_="demo"}}[5m])) by (_ns_)'
+    base = _query(engine, q)             # warm mirror
+    before = _fused_count()
+    got = _query(engine, q)
+    assert _fused_count() > before, f"{fn} fused path did not engage"
+    import os
+    os.environ.pop("FILODB_TPU_FUSED_INTERPRET", None)
+    want = _query(engine, q)
+    assert set(got) == set(want) and got
+    for k in want:
+        np.testing.assert_allclose(got[k], want[k], rtol=2e-4, atol=1e-3,
+                                   equal_nan=True)

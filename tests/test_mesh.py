@@ -229,3 +229,32 @@ def test_mesh_fused_skipped_on_ragged_pack(mesh42, monkeypatch):
                         agg_op="sum")
     assert registry.counter("mesh_fused_kernel").value == before
     assert np.isfinite(out).any()
+
+
+def test_mesh_fused_sum_over_time_matches_general(store4, mesh42,
+                                                  monkeypatch):
+    """The over_time band-matrix kernel composes on the mesh too."""
+    from filodb_tpu.utils.metrics import registry
+    ms, mapper = store4
+    range_ms = 300_000
+
+    def run():
+        ex = MeshExecutor(ms, "prometheus", mesh42)
+        packed = ex.lookup_and_pack(
+            [Equals("_metric_", "request_total"), Equals("_ws_", "demo")],
+            (START_S + 600) * 1000 - range_ms, QEND_S * 1000,
+            by=("_ns_",), fn_name="sum_over_time")
+        wends = make_window_ends((START_S + 600) * 1000, QEND_S * 1000,
+                                 STEP_S * 1000)
+        return ex.run_agg(packed, wends, range_ms=range_ms,
+                          fn_name="sum_over_time", agg_op="sum")
+
+    out_gen, labels_gen = run()
+    monkeypatch.setenv("FILODB_TPU_FUSED_INTERPRET", "1")
+    before = registry.counter("mesh_fused_kernel").value
+    out_fused, labels_fused = run()
+    assert registry.counter("mesh_fused_kernel").value > before
+    assert labels_fused == labels_gen
+    assert (np.isnan(out_fused) == np.isnan(out_gen)).all()
+    np.testing.assert_allclose(out_fused, out_gen, rtol=2e-4, atol=1e-3,
+                               equal_nan=True)

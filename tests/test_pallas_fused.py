@@ -130,6 +130,45 @@ def test_can_fuse_gate():
     assert can_fuse("rate", "sum", True, True)
     assert can_fuse("increase", "sum", True, True)
     assert not can_fuse("rate", "avg", True, True)
-    assert not can_fuse("sum_over_time", "sum", True, True)
+    assert can_fuse("sum_over_time", "sum", True, True)
+    assert can_fuse("avg_over_time", "sum", True, True)
+    assert not can_fuse("min_over_time", "sum", True, True)
     assert not can_fuse("rate", "sum", False, True)   # ragged grids
     assert not can_fuse("rate", "sum", True, False)   # NaN holes
+
+
+@pytest.mark.parametrize("fn", ["sum_over_time", "avg_over_time"])
+def test_fused_over_time_single_sample_windows(fn):
+    """Windows containing exactly one sample must return that sample's
+    contribution, not the bare vbase (n=1 band coverage regression)."""
+    S, T, G = 8, 40, 2
+    ts_row = np.arange(T, dtype=np.int64) * 10_000
+    rng = np.random.default_rng(5)
+    raw = 100.0 + rng.random((S, T))
+    gids = (np.arange(S) % G).astype(np.int32)
+    range_ms = 15_000                    # < 2 scrape intervals: n is 1 or 2
+    wends = make_window_ends(5_000, 380_000, 10_000)
+    plan = build_plan(ts_row, wends, range_ms)
+    assert (np.asarray(plan.n1)[0, :len(wends)] == 1).any(), \
+        "test needs single-sample windows"
+    reb, vbase = rebase_values(raw, False)
+    sums, counts = fused_rate_groupsum(
+        reb.astype(np.float32), vbase.astype(np.float32), gids, plan, G,
+        fn_name=fn, interpret=True)
+    got = present_sum(sums, counts)
+    want = _xla_overtime(ts_row, reb.astype(np.float32),
+                         vbase.astype(np.float32), gids, wends, range_ms,
+                         fn, G)
+    assert (np.isnan(got) == np.isnan(want)).all()
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=1e-3,
+                               equal_nan=True)
+
+
+def _xla_overtime(ts_row, vals32, vbase, gids, wends, range_ms, fn, G):
+    S, T = vals32.shape
+    ts_off = to_offsets(np.tile(ts_row, (S, 1)), np.full(S, T), 0)
+    r = evaluate_range_function(
+        jnp.asarray(ts_off), jnp.asarray(vals32),
+        jnp.asarray(wends.astype(np.int32)), range_ms, fn,
+        shared_grid=True, vbase=jnp.asarray(vbase))
+    return np.asarray(agg_ops.aggregate("sum", r, jnp.asarray(gids), G))
