@@ -66,7 +66,7 @@ def build_plan(ts_row: np.ndarray, wends: np.ndarray,
     wstart = wend - int(range_ms) + 1
     first = np.searchsorted(ts_row, wstart, side="left")
     last = np.searchsorted(ts_row, wend, side="right") - 1
-    n = np.maximum(last - first + 1, 0)
+    n = window_counts(ts_row, wend, range_ms)
     W, T = len(wend), len(ts_row)
     Wp, Tp = _pad_to(max(W, 1), _LANE), _pad_to(max(T, 1), _LANE)
     # selection matrices cover every NON-EMPTY window (n >= 1): the
@@ -208,16 +208,30 @@ def _run(vals_p, vbase_p, gids_p, o1, o2, l1, l2, t1, t2, n, ws, we,
 VMEM_BUDGET = 12 << 20          # per-core VMEM is ~16MB; leave headroom
 
 
-def vmem_estimate(Tp: int, Wp: int, Gp: int) -> int:
-    """Rough resident-bytes model for one grid step: 4 selection matrices,
-    the double-buffered values block, the group one-hot + accumulator, and
+def vmem_estimate(Tp: int, Wp: int, Gp: int,
+                  over_time: bool = False) -> int:
+    """Rough resident-bytes model for one grid step: the 4 selection
+    matrices (plus the over_time kinds' band temporary), the
+    double-buffered values block, the group one-hot + accumulator, and
     [BS, Wp] f32 temporaries.  Callers divert to the general XLA path when
     this exceeds VMEM_BUDGET instead of failing at kernel lowering."""
-    sel = 5 * Tp * Wp * 4      # 4 selection matrices + the band temporary
+    sel = (5 if over_time else 4) * Tp * Wp * 4
     vals = 2 * _BS * Tp * 4
     group = Gp * (Wp * 8 + _BS * 4)
     inter = 12 * _BS * Wp * 4
     return sel + vals + group + inter
+
+
+def window_counts(ts_row: np.ndarray, wends: np.ndarray,
+                  range_ms: int) -> np.ndarray:
+    """Per-window sample counts over one shared grid — the single source
+    of the window-inclusion convention ((wend-range, wend], matching
+    build_plan and ops/timewindow.window_bounds)."""
+    ts_row = np.asarray(ts_row, dtype=np.int64)
+    wend = np.asarray(wends, dtype=np.int64)
+    first = np.searchsorted(ts_row, wend - int(range_ms) + 1, side="left")
+    last = np.searchsorted(ts_row, wend, side="right") - 1
+    return np.maximum(last - first + 1, 0)
 
 
 FUSABLE_FNS = ("rate", "increase", "delta", "sum_over_time",

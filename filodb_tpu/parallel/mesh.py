@@ -620,7 +620,8 @@ class MeshExecutor:
         D, S, T = packed.ts_off.shape
         Tp = pf._pad_to(T, pf._LANE)
         Wlp = pf._pad_to(max(Wl, 1), pf._LANE)
-        if pf.vmem_estimate(Tp, Wlp, max(G, 8)) > pf.VMEM_BUDGET:
+        if pf.vmem_estimate(Tp, Wlp, max(G, 8),
+                            fn_name in pf.OVER_TIME_FNS) > pf.VMEM_BUDGET:
             return None
         # plan + device-mats cache: repeat queries (the pack-cache pattern)
         # skip the host selection-matrix rebuild and the 9 uploads

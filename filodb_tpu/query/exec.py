@@ -902,17 +902,21 @@ class MultiSchemaPartitionsExec(LeafExecPlan):
                 or not isinstance(t1, AggregateMapReduce):
             return None
         from filodb_tpu.ops import pallas_fused as pf
-        import jax
-        backend = jax.default_backend()
-        interpret = backend != "tpu"
-        if interpret and not os.environ.get("FILODB_TPU_FUSED_INTERPRET"):
-            return None                 # kernel is MXU-targeted
         vals = data.values
         ndim = getattr(vals, "ndim", 0)
         is_hist = ndim == 3
         if ndim not in (2, 3) or t0.window_ms is None \
                 or t0.function_args or t1.params:
             return None
+        if (t0.function == "count_over_time" and t1.op == "sum"
+                and not is_hist):
+            # pure host math — no device work, so no backend gate
+            return self._fused_count_over_time(data, t0, t1)
+        import jax
+        backend = jax.default_backend()
+        interpret = backend != "tpu"
+        if interpret and not os.environ.get("FILODB_TPU_FUSED_INTERPRET"):
+            return None                 # kernel is MXU-targeted
         if not pf.can_fuse(t0.function or "", t1.op, True, True):
             return None
         if t0.function in ("rate", "increase") and not data.precorrected:
@@ -934,7 +938,8 @@ class MultiSchemaPartitionsExec(LeafExecPlan):
         # not fail at kernel lowering
         Tp = pf._pad_to(vals.shape[1], pf._LANE)
         Wp = pf._pad_to(eval_wends.size, pf._LANE)
-        if pf.vmem_estimate(Tp, Wp, 8) > pf.VMEM_BUDGET:
+        over_time = t0.function in pf.OVER_TIME_FNS
+        if pf.vmem_estimate(Tp, Wp, 8, over_time) > pf.VMEM_BUDGET:
             return None
         from filodb_tpu.utils.metrics import registry
         # plan + prepared-input caches: a repeat query over an unchanged
@@ -965,18 +970,15 @@ class MultiSchemaPartitionsExec(LeafExecPlan):
                     _FUSED_PLAN_CACHE[plan_key] = plan
                     while len(_FUSED_PLAN_CACHE) > 8:
                         _FUSED_PLAN_CACHE.pop(next(iter(_FUSED_PLAN_CACHE)))
-        limit = self.ctx.planner_params.group_by_cardinality_limit
         if gkeys is None:
             gids, gkeys = _group_ids(data.keys, t1.by, t1.without)
-        if limit and len(gkeys) > limit:
-            raise GroupCardinalityError(
-                f"group-by cardinality limit {limit} exceeded "
-                f"({len(gkeys)} groups)")
+        self._check_group_limit(gkeys)
         B = vals.shape[2] if is_hist else 1
         num_slots = len(gkeys) * B      # hist: one kernel group per (g, b)
         # VMEM guard, part 2: full estimate now that group count is known —
         # BEFORE the padded device copy, so diverted queries cost nothing
-        if pf.vmem_estimate(Tp, Wp, max(num_slots, 8)) > pf.VMEM_BUDGET:
+        if pf.vmem_estimate(Tp, Wp, max(num_slots, 8),
+                            over_time) > pf.VMEM_BUDGET:
             return None
         if padded_vals is None:
             vbase = data.vbase
@@ -1048,6 +1050,35 @@ class MultiSchemaPartitionsExec(LeafExecPlan):
         return (f"dataset={self.dataset}, shard={self.shard}, "
                 f"chunkMethod=TimeRangeChunkScan({self.chunk_start_ms},"
                 f"{self.chunk_end_ms}), filters=[{fs}], colName={self.columns}")
+
+    def _fused_count_over_time(self, data, t0, t1):
+        """sum by (count_over_time(...)): under the shared dense grid every
+        series has IDENTICAL per-window sample counts, so the whole result
+        is gsize * n — pure host math, no device work at all."""
+        wends = make_window_ends(t0.start_ms, t0.end_ms, t0.step_ms)
+        eval_wends = wends - t0.offset_ms - data.base_ms
+        if eval_wends.size == 0 or abs(eval_wends).max() >= (1 << 30):
+            return None
+        from filodb_tpu.ops import pallas_fused as pf
+        gids, gkeys = _group_ids(data.keys, t1.by, t1.without)
+        self._check_group_limit(gkeys)
+        n = pf.window_counts(data.shared_ts_row.astype(np.int64),
+                             eval_wends, t0.window_ms).astype(np.float64)
+        gsize = np.bincount(np.asarray(gids),
+                            minlength=len(gkeys))[:len(gkeys)]
+        sums = gsize[:, None] * n[None, :]
+        counts = gsize[:, None] * (n >= 1).astype(np.float64)
+        from filodb_tpu.utils.metrics import registry
+        registry.counter("leaf_fused_count_host").increment()
+        comp = np.stack([sums, counts], axis=-1)
+        return AggPartial("sum", gkeys, wends, comp=comp)
+
+    def _check_group_limit(self, gkeys) -> None:
+        limit = self.ctx.planner_params.group_by_cardinality_limit
+        if limit and len(gkeys) > limit:
+            raise GroupCardinalityError(
+                f"group-by cardinality limit {limit} exceeded "
+                f"({len(gkeys)} groups)")
 
     def _do_execute(self, source) -> QueryResultLike:
         stats = QueryStats(shards_queried=1)

@@ -23,7 +23,7 @@ def fused_env(monkeypatch):
 
 
 def _fused_count():
-    return registry.counter("leaf_fused_kernel").value
+    return registry.counter("leaf_fused_kernel").value + registry.counter("leaf_fused_count_host").value
 
 
 def _query(engine, promql='sum(rate(request_total{_ws_="demo"}[5m])) by (_ns_)'):
@@ -179,4 +179,23 @@ def test_fused_over_time_matches_general(fused_env, fn):
     assert set(got) == set(want) and got
     for k in want:
         np.testing.assert_allclose(got[k], want[k], rtol=2e-4, atol=1e-3,
+                                   equal_nan=True)
+
+
+def test_fused_count_over_time_pure_host(fused_env):
+    """sum by (count_over_time) over a shared dense grid is computed
+    entirely host-side (gsize * n) and must match the general path."""
+    from filodb_tpu.ingest.generator import gauge_batch
+    engine = _mk_engine([gauge_batch(30, T, start_ms=START_MS)])
+    q = 'sum(count_over_time(heap_usage{_ws_="demo"}[5m])) by (_ns_)'
+    _query(engine, q)                    # warm mirror
+    before = _fused_count()
+    got = _query(engine, q)
+    assert _fused_count() > before, "count_over_time fast path not used"
+    import os
+    os.environ.pop("FILODB_TPU_FUSED_INTERPRET", None)
+    want = _query(engine, q)
+    assert set(got) == set(want) and got
+    for k in want:
+        np.testing.assert_allclose(got[k], want[k], rtol=1e-9,
                                    equal_nan=True)
