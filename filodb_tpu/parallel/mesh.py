@@ -576,9 +576,11 @@ class MeshExecutor:
             try:
                 fused = self._run_agg_fused(packed, wends, W, range_ms,
                                             fn_name)
-            except Exception:  # noqa: BLE001 — fusion is an optimization
+            except Exception as e:  # noqa: BLE001 — fusion is optional
+                from filodb_tpu.query.exec import _log_fused_error
                 from filodb_tpu.utils.metrics import registry
                 registry.counter("mesh_fused_errors").increment()
+                _log_fused_error("mesh", e)
                 fused = None
             if fused is not None:
                 return fused, packed.group_labels

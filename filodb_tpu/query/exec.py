@@ -18,6 +18,7 @@ runs a subtree inline; the cluster layer adds remote dispatch.
 from __future__ import annotations
 
 import dataclasses
+import logging
 import os
 import threading
 from typing import Callable, Dict, List, Optional, Sequence, Tuple, Union
@@ -80,6 +81,24 @@ _FUSED_CACHE_LOCK = threading.Lock()
 class GroupCardinalityError(ValueError):
     """group-by cardinality limit exceeded — a real query error that must
     surface even from the fused fast path (everything else falls back)."""
+
+
+_log = logging.getLogger("filodb.exec")
+_fused_err_last: Dict[str, float] = {}
+
+
+def _log_fused_error(where: str, exc: BaseException,
+                     min_interval_s: float = 60.0) -> None:
+    """The fused fast paths degrade silently to the general path on any
+    error; without the exception text the operator only sees an error
+    counter climb with nothing to diagnose.  Rate-limited so a hot query
+    loop can't flood the log."""
+    import time as _time
+    now = _time.monotonic()
+    if now - _fused_err_last.get(where, -1e9) >= min_interval_s:
+        _fused_err_last[where] = now
+        _log.warning("%s fused path degraded to general path: %s: %s",
+                     where, type(exc).__name__, exc)
 
 
 def _lru_touch(cache: Dict, key) -> object:
@@ -875,9 +894,10 @@ class MultiSchemaPartitionsExec(LeafExecPlan):
             fused = self._try_fused(data, stats)
         except GroupCardinalityError:
             raise                        # real query error — must surface
-        except Exception:  # noqa: BLE001 — fusion is an optimization
+        except Exception as e:  # noqa: BLE001 — fusion is an optimization
             from filodb_tpu.utils.metrics import registry
             registry.counter("leaf_fused_errors").increment()
+            _log_fused_error("leaf", e)
             fused = None
         if fused is not None:
             data, start = fused, 2

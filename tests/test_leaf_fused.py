@@ -199,3 +199,25 @@ def test_fused_count_over_time_pure_host(fused_env):
     for k in want:
         np.testing.assert_allclose(got[k], want[k], rtol=1e-9,
                                    equal_nan=True)
+
+
+def test_fused_error_is_logged_with_reason(fused_env, caplog, monkeypatch):
+    """A fused-path failure must leave a diagnosable warning (type +
+    message), not just an anonymous error counter."""
+    import logging
+
+    from filodb_tpu.query import exec as exec_mod
+    engine = _mk_engine([counter_batch(10, T, start_ms=START_MS)])
+    _query(engine)                       # warm mirror
+
+    def boom(*a, **k):
+        raise RuntimeError("synthetic kernel failure")
+    monkeypatch.setattr(exec_mod.MultiSchemaPartitionsExec,
+                        "_try_fused",
+                        lambda self, d, s: boom())
+    exec_mod._fused_err_last.clear()
+    with caplog.at_level(logging.WARNING, logger="filodb.exec"):
+        got = _query(engine)             # degrades to general path
+    assert got
+    assert any("synthetic kernel failure" in r.message
+               for r in caplog.records), caplog.records
