@@ -577,10 +577,10 @@ class MeshExecutor:
                 fused = self._run_agg_fused(packed, wends, W, range_ms,
                                             fn_name)
             except Exception as e:  # noqa: BLE001 — fusion is optional
-                from filodb_tpu.query.exec import _log_fused_error
-                from filodb_tpu.utils.metrics import registry
+                from filodb_tpu.utils.metrics import (
+                    log_fused_degradation, registry)
                 registry.counter("mesh_fused_errors").increment()
-                _log_fused_error("mesh", e)
+                log_fused_degradation("mesh", e)
                 fused = None
             if fused is not None:
                 return fused, packed.group_labels

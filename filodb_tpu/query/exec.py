@@ -83,24 +83,6 @@ class GroupCardinalityError(ValueError):
     surface even from the fused fast path (everything else falls back)."""
 
 
-_log = logging.getLogger("filodb.exec")
-_fused_err_last: Dict[str, float] = {}
-
-
-def _log_fused_error(where: str, exc: BaseException,
-                     min_interval_s: float = 60.0) -> None:
-    """The fused fast paths degrade silently to the general path on any
-    error; without the exception text the operator only sees an error
-    counter climb with nothing to diagnose.  Rate-limited so a hot query
-    loop can't flood the log."""
-    import time as _time
-    now = _time.monotonic()
-    if now - _fused_err_last.get(where, -1e9) >= min_interval_s:
-        _fused_err_last[where] = now
-        _log.warning("%s fused path degraded to general path: %s: %s",
-                     where, type(exc).__name__, exc)
-
-
 def _lru_touch(cache: Dict, key) -> object:
     """Get + move-to-back (dicts iterate in insertion order, so eviction
     pops the front = least-recently-used).  One idiom for all fused caches."""
@@ -895,9 +877,10 @@ class MultiSchemaPartitionsExec(LeafExecPlan):
         except GroupCardinalityError:
             raise                        # real query error — must surface
         except Exception as e:  # noqa: BLE001 — fusion is an optimization
-            from filodb_tpu.utils.metrics import registry
+            from filodb_tpu.utils.metrics import (log_fused_degradation,
+                                                  registry)
             registry.counter("leaf_fused_errors").increment()
-            _log_fused_error("leaf", e)
+            log_fused_degradation("leaf", e)
             fused = None
         if fused is not None:
             data, start = fused, 2

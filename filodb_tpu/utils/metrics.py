@@ -15,6 +15,7 @@ metric lookups are dict hits on interned (name, tags) keys.
 from __future__ import annotations
 
 import bisect
+import logging
 import threading
 import time
 from typing import Callable, Dict, Iterator, List, Optional, Sequence, Tuple
@@ -231,3 +232,21 @@ class FiloSchedulers:
         name = threading.current_thread().name
         assert fragment in name, \
             f"expected thread name containing {fragment!r}, got {name!r}"
+
+
+_degrade_log = logging.getLogger("filodb.fused")
+_degrade_last: Dict[str, float] = {}
+
+
+def log_fused_degradation(where: str, exc: BaseException,
+                          min_interval_s: float = 60.0) -> None:
+    """The fused fast paths (query/exec.py leaf, parallel/mesh.py) degrade
+    silently to the general path on any error; without the exception text
+    the operator only sees an error counter climb with nothing to
+    diagnose.  Rate-limited so a hot query loop can't flood the log."""
+    now = time.monotonic()
+    if now - _degrade_last.get(where, -1e9) >= min_interval_s:
+        _degrade_last[where] = now
+        _degrade_log.warning(
+            "%s fused path degraded to general path: %s: %s",
+            where, type(exc).__name__, exc)

@@ -215,8 +215,9 @@ def test_fused_error_is_logged_with_reason(fused_env, caplog, monkeypatch):
     monkeypatch.setattr(exec_mod.MultiSchemaPartitionsExec,
                         "_try_fused",
                         lambda self, d, s: boom())
-    exec_mod._fused_err_last.clear()
-    with caplog.at_level(logging.WARNING, logger="filodb.exec"):
+    from filodb_tpu.utils import metrics as metrics_mod
+    metrics_mod._degrade_last.clear()
+    with caplog.at_level(logging.WARNING, logger="filodb.fused"):
         got = _query(engine)             # degrades to general path
     assert got
     assert any("synthetic kernel failure" in r.message

@@ -194,3 +194,42 @@ def test_end_to_end_sum_rate_f32_large_counters():
         for s in range(20)]), axis=0)
     m = ~np.isnan(want)
     np.testing.assert_allclose(got[m], want[m], rtol=1e-4)
+
+
+@pytest.mark.parametrize("base", BASES)
+@pytest.mark.parametrize("fn", ["rate", "increase", "sum_over_time",
+                                "avg_over_time"])
+def test_fused_kernel_f32_vs_oracle(base, fn):
+    """The Pallas fused kernel (interpret mode, f32 inputs end to end) vs
+    the f64 oracle, group-summed — parameterized over counter magnitudes
+    up to 2^40.  Dense data (no gaps): the fused path's eligibility gate
+    requires a fully-finite shared grid."""
+    from filodb_tpu.ops.counter import rebase_values
+    from filodb_tpu.ops.pallas_fused import (build_plan,
+                                             fused_rate_groupsum,
+                                             present_sum)
+    ts, vals = _mk_data(base, S=6, with_resets=(fn in ("rate", "increase")),
+                        with_gaps=False)
+    G = 2
+    gids = (np.arange(vals.shape[0]) % G).astype(np.int32)
+    plan = build_plan(ts, WENDS, RANGE_MS)
+    is_counter = fn in ("rate", "increase")
+    reb, vbase = rebase_values(vals, is_counter)
+    with jax.enable_x64(False):
+        sums, counts = fused_rate_groupsum(
+            reb.astype(np.float32), vbase.astype(np.float32), gids, plan,
+            G, fn_name=fn, precorrected=is_counter, interpret=True)
+        got = present_sum(sums, counts)
+    per = _oracle(ts, vals, WENDS, fn)
+    want = np.zeros((G, len(WENDS)))
+    cnt = np.zeros((G, len(WENDS)))
+    for s in range(vals.shape[0]):
+        ok = ~np.isnan(per[s])
+        want[gids[s]][ok] += per[s][ok]
+        cnt[gids[s]][ok] += 1
+    want = np.where(cnt > 0, want, np.nan)
+    # documented f32 error envelope: deltas exact via rebasing; absolute
+    # *_over_time sums inherit base/|window sum| relative rounding
+    rtol = 2e-4 if fn in ("rate", "increase") else 2e-3
+    atol = 1e-3 if fn in ("rate", "increase") else base * 2e-6 + 1e-3
+    _compare(got, want, rtol=rtol, atol=atol)
