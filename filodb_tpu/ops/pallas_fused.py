@@ -111,6 +111,13 @@ def _kernel(vals_ref, vbase_ref, gids_ref, o1_ref, o2_ref, l1_ref, l2_ref,
     # the HBM read)
     mm = functools.partial(jnp.dot, preferred_element_type=jnp.float32,
                            precision=jax.lax.Precision.HIGHEST)
+    if kind == "last_over_time":
+        # instant-vector selector (`sum by (x) (metric)` with staleness
+        # lookback): the last sample in each window is the o2 one-hot
+        # gather; empty windows contribute 0 and are masked by counts
+        out = mm(v, o2_ref[:]) + vbase_ref[:] * jnp.minimum(n_ref[:], 1.0)
+        _group_accumulate(mm, v, gids_ref, out, out_ref, num_groups)
+        return
     if kind in ("sum_over_time", "avg_over_time"):
         # window sums as ONE matmul against the band matrix
         # band[t, w] = 1{first[w] <= t <= last[w]} = l2 - l1 + o1;
@@ -235,8 +242,8 @@ def window_counts(ts_row: np.ndarray, wends: np.ndarray,
 
 
 FUSABLE_FNS = ("rate", "increase", "delta", "sum_over_time",
-               "avg_over_time")
-OVER_TIME_FNS = ("sum_over_time", "avg_over_time")
+               "avg_over_time", "last_over_time")
+OVER_TIME_FNS = ("sum_over_time", "avg_over_time", "last_over_time")
 
 
 def can_fuse(fn_name: str, agg_op: str, shared_grid: bool,
