@@ -106,11 +106,16 @@ def _tails_matrix(col: np.ndarray, rows: np.ndarray, counts_old: np.ndarray,
 
 _mirror_serial = itertools.count(1)
 
+# Default mirror HBM budget — the single source for this constant (also
+# mirrored by config.device_mirror_hbm_limit and subtracted by the fused
+# padded-values cache budget in query/exec._fused_vals_budget).
+DEFAULT_HBM_LIMIT_BYTES = 8 << 30
+
 
 class DeviceMirror:
     """One mirror per DenseSeriesStore (lazily attached)."""
 
-    def __init__(self, hbm_limit_bytes: int = 8 << 30):
+    def __init__(self, hbm_limit_bytes: int = DEFAULT_HBM_LIMIT_BYTES):
         self.hbm_limit_bytes = hbm_limit_bytes
         self._snap: Optional[_MirrorSnapshot] = None
         # process-unique identity for external caches: id() can be reused
@@ -444,17 +449,27 @@ class DeviceMirror:
         the calls can pair one snapshot's grid with another's values."""
         return self._snap
 
-    def fused_eligible(self, col_name: str, snap=None) -> Optional[np.ndarray]:
+    def fused_eligible(self, col_name: str, snap=None,
+                       allow_ragged: bool = False) -> Optional[np.ndarray]:
         """Row-0 ts offsets (int32 [T], PAD_TS beyond counts) when the
         snapshot meets the pallas_fused preconditions for this column —
-        one shared scrape grid and a fully-finite counted region — else
-        None.  Any row subset of a uniform grid is itself uniform."""
+        one shared scrape grid and (unless allow_ragged) a fully-finite
+        counted region — else None.  allow_ragged admits NaN-holed values
+        on a shared grid: the validity-weighted fused kinds handle those
+        (ops/pallas_fused.can_fuse dense=False).  Any row subset of a
+        uniform grid is itself uniform."""
         snap = snap if snap is not None else self._snap
         if snap is None or not snap.uniform_grid or snap.ts_row0 is None:
             return None
-        if not snap.col_finite.get(col_name, False):
+        if not snap.col_finite.get(col_name, False) and not allow_ragged:
             return None
         return snap.ts_row0
+
+    def col_dense(self, col_name: str, snap=None) -> bool:
+        """True when the column's counted region has no NaN holes."""
+        snap = snap if snap is not None else self._snap
+        return bool(snap is not None
+                    and snap.col_finite.get(col_name, False))
 
     def gather_cached(self, rows: np.ndarray, snap=None
                       ) -> Optional[Tuple[object, Dict[str, object],

@@ -23,20 +23,31 @@ import jax.numpy as jnp
 
 class AggSpec(NamedTuple):
     num_components: int
-    combiner: str                 # 'sum' | 'min' | 'max'
+    # one op for every component, or a per-component tuple
+    # ('sum' | 'min' | 'max')
+    combiner: object
 
 
 AGGREGATORS: Dict[str, AggSpec] = {
     "sum":    AggSpec(2, "sum"),     # (sum, count) — count masks empty steps
     "count":  AggSpec(1, "sum"),
     "avg":    AggSpec(2, "sum"),     # (sum, count)
-    "min":    AggSpec(1, "min"),
-    "max":    AggSpec(1, "max"),
+    # min/max carry an explicit presence flag (combined with max = OR):
+    # the +/-inf identity alone cannot mark absence because +/-Inf are
+    # legal sample values the result must preserve
+    "min":    AggSpec(2, ("min", "max")),   # (min-or-+inf, present)
+    "max":    AggSpec(2, ("max", "max")),   # (max-or--inf, present)
     "stddev": AggSpec(3, "sum"),     # (sum, sumsq, count)
     "stdvar": AggSpec(3, "sum"),
     "group":  AggSpec(1, "max"),     # group() = 1 for any present series
     "hist_sum": AggSpec(0, "sum"),   # [B buckets + count]; B is data-dependent
 }
+
+
+def combiners_for(op: str, ncomp: int):
+    """Normalized per-component combiner tuple for an op's partials."""
+    comb = AGGREGATORS.get(op, AggSpec(1, "sum")).combiner
+    return comb if isinstance(comb, tuple) else (comb,) * ncomp
 
 
 def _seg(op, vals, group_ids, num_groups):
@@ -65,24 +76,33 @@ def map_phase(op: str, vals: jax.Array, group_ids: jax.Array,
     elif op in ("stddev", "stdvar"):
         comp = [zeroed, zeroed * zeroed, cnt]
     elif op == "min":
-        comp = [jnp.where(present, vals, jnp.inf)]
+        comp = [jnp.where(present, vals, jnp.inf), cnt]
     elif op == "max":
-        comp = [jnp.where(present, vals, -jnp.inf)]
+        comp = [jnp.where(present, vals, -jnp.inf), cnt]
     elif op == "group":
         comp = [jnp.where(present, 1.0, -jnp.inf)]
     else:
         raise ValueError(f"unknown aggregate {op}")
-    spec = AGGREGATORS[op]
-    stacked = jnp.stack(comp, axis=-1)            # [S, W, C]
-    return _seg(spec.combiner, stacked, group_ids, num_groups)
+    combs = combiners_for(op, len(comp))
+    if len(set(combs)) == 1:
+        stacked = jnp.stack(comp, axis=-1)        # [S, W, C]
+        return _seg(combs[0], stacked, group_ids, num_groups)
+    return jnp.stack([_seg(c, x, group_ids, num_groups)
+                      for c, x in zip(combs, comp)], axis=-1)
 
 
 def reduce_phase(op: str, a: jax.Array, b: jax.Array) -> jax.Array:
     """Combine two partials [G, W, C] (inter-shard tree reduce)."""
-    comb = AGGREGATORS[op].combiner
-    if comb == "sum":
-        return a + b
-    return jnp.minimum(a, b) if comb == "min" else jnp.maximum(a, b)
+    combs = combiners_for(op, a.shape[-1])
+
+    def one(comb, x, y):
+        if comb == "sum":
+            return x + y
+        return jnp.minimum(x, y) if comb == "min" else jnp.maximum(x, y)
+    if len(set(combs)) == 1:
+        return one(combs[0], a, b)
+    return jnp.stack([one(c, a[..., i], b[..., i])
+                      for i, c in enumerate(combs)], axis=-1)
 
 
 @functools.partial(jax.jit, static_argnames=("op",))
@@ -103,10 +123,10 @@ def present(op: str, partial: jax.Array) -> jax.Array:
         var = jnp.maximum(s2 / cs - (s / cs) ** 2, 0.0)
         out = jnp.sqrt(var) if op == "stddev" else var
         return jnp.where(c > 0, out, jnp.nan)
-    if op in ("min", "group"):
-        v = partial[..., 0]
-        return jnp.where(jnp.isinf(v), jnp.nan, v)
-    if op == "max":
+    if op in ("min", "max"):
+        v, c = partial[..., 0], partial[..., 1]
+        return jnp.where(c > 0, v, jnp.nan)
+    if op == "group":
         v = partial[..., 0]
         return jnp.where(jnp.isinf(v), jnp.nan, v)
     raise ValueError(op)

@@ -101,9 +101,10 @@ def build_plan(ts_row: np.ndarray, wends: np.ndarray,
 
 
 def _kernel(vals_ref, vbase_ref, gids_ref, o1_ref, o2_ref, l1_ref, l2_ref,
-            t1_ref, t2_ref, n_ref, ws_ref, we_ref, out_ref,
-            *, num_groups: int, is_counter: bool, is_rate: bool,
-            with_drops: bool, kind: str = "rate_family"):
+            t1_ref, t2_ref, n_ref, ws_ref, we_ref, *out_refs,
+            num_groups: int, is_counter: bool, is_rate: bool,
+            with_drops: bool, kind: str = "rate_family",
+            ragged: bool = False, per_series: bool = False):
     v = vals_ref[:]                                   # [BS, Tp]
     # HIGHEST: the MXU's default bf16 pass truncates f32 mantissas (1e-2
     # relative error on counter magnitudes); the multi-pass f32 decomposition
@@ -116,20 +117,39 @@ def _kernel(vals_ref, vbase_ref, gids_ref, o1_ref, o2_ref, l1_ref, l2_ref,
         # lookback): the last sample in each window is the o2 one-hot
         # gather; empty windows contribute 0 and are masked by counts
         out = mm(v, o2_ref[:]) + vbase_ref[:] * jnp.minimum(n_ref[:], 1.0)
-        _group_accumulate(mm, v, gids_ref, out, out_ref, num_groups)
+        _epilogue(mm, gids_ref, out, None, out_refs, num_groups, per_series)
         return
-    if kind in ("sum_over_time", "avg_over_time"):
+    if kind in ("sum_over_time", "avg_over_time", "count_over_time"):
         # window sums as ONE matmul against the band matrix
         # band[t, w] = 1{first[w] <= t <= last[w]} = l2 - l1 + o1;
-        # the ABSOLUTE sum re-adds the per-series base as vb * n
+        # the ABSOLUTE sum re-adds the per-series base as vb * n.
+        # Ragged (NaN-holed) rows: validity-weighted variant — zero the
+        # holes, take per-(series, window) counts from a second matmul of
+        # the validity mask against the same band (VERDICT r2 item 2).
         band = l2_ref[:] - l1_ref[:] + o1_ref[:]
-        n = n_ref[:]                                  # TRUE counts here
-        s = mm(v, band)
+        if ragged:
+            validf = (v == v).astype(jnp.float32)     # NaN-aware
+            s = mm(jnp.where(v == v, v, 0.0), band)
+            n = mm(validf, band)                      # [BS, Wp] valid counts
+            pres = (n > 0).astype(jnp.float32)
+        else:
+            s = mm(v, band)
+            n = n_ref[:]                              # [1, Wp] true counts
+            pres = None
         if kind == "sum_over_time":
             out = s + vbase_ref[:] * n
-        else:
+        elif kind == "avg_over_time":
             out = s / jnp.maximum(n, 1.0) + vbase_ref[:]
-        _group_accumulate(mm, v, gids_ref, out, out_ref, num_groups)
+            if ragged:
+                out = out * pres      # no vbase leak into absent cells
+        else:                                         # count_over_time
+            out = n * jnp.ones_like(s)
+            if ragged:
+                # count's presence is SLOT-based: a window whose grid slots
+                # exist but hold only NaN emits 0, not absent (ref:
+                # AggrOverTimeFunctions.scala:367-382), unlike sum/avg
+                pres = (n_ref[:] > 0).astype(jnp.float32) * jnp.ones_like(s)
+        _epilogue(mm, gids_ref, out, pres, out_refs, num_groups, per_series)
         return
     v1 = mm(v, o1_ref[:])                             # [BS, Wp]
     v2 = mm(v, o2_ref[:])
@@ -163,29 +183,45 @@ def _kernel(vals_ref, vbase_ref, gids_ref, o1_ref, o2_ref, l1_ref, l2_ref,
     if is_rate:
         out = out / jnp.maximum(we - ws, 1.0) * 1000.0
 
-    _group_accumulate(mm, v, gids_ref, out, out_ref, num_groups)
+    _epilogue(mm, gids_ref, out, None, out_refs, num_groups, per_series)
 
 
-def _group_accumulate(mm, v, gids_ref, out, out_ref, num_groups: int):
-    """Shared epilogue: one-hot group segment-sum on the MXU, accumulated
-    across sequential grid steps (pad rows carry gid -1: no match)."""
+def _epilogue(mm, gids_ref, out, pres, out_refs, num_groups: int,
+              per_series: bool):
+    """Shared epilogue.  Group mode: one-hot segment-sum on the MXU,
+    accumulated across sequential grid steps (pad rows carry gid -1: no
+    match); `pres` (ragged presence [BS, Wp]) feeds a second accumulated
+    output so present-counts ride the same kernel.  Per-series mode
+    (agg min/max: sum is the MXU's semiring, min is not): write the raw
+    [BS, Wp] block and let an XLA segment reduction finish on the
+    T/W-times-smaller output."""
+    if per_series:
+        out_refs[0][:] = out
+        if pres is not None:
+            out_refs[1][:] = pres
+        return
     gids = gids_ref[:]                                # [BS, 1] int32
-    groups = jax.lax.broadcasted_iota(jnp.int32, (num_groups, v.shape[0]), 0)
+    groups = jax.lax.broadcasted_iota(jnp.int32, (num_groups, out.shape[0]),
+                                      0)
     onehot = (groups == gids[:, 0][None, :]).astype(jnp.float32)
     part = mm(onehot, out)                            # [Gp, Wp]
 
     @pl.when(pl.program_id(0) == 0)
     def _():
-        out_ref[:] = jnp.zeros_like(out_ref)
-    out_ref[:] += part
+        for r in out_refs:
+            r[:] = jnp.zeros_like(r)
+    out_refs[0][:] += part
+    if pres is not None:
+        out_refs[1][:] += mm(onehot, pres)
 
 
 @functools.partial(jax.jit, static_argnames=(
     "num_groups", "is_counter", "is_rate", "with_drops", "interpret",
-    "kind"))
+    "kind", "ragged", "per_series"))
 def _run(vals_p, vbase_p, gids_p, o1, o2, l1, l2, t1, t2, n, ws, we,
          num_groups: int, is_counter: bool, is_rate: bool,
-         with_drops: bool, interpret: bool, kind: str = "rate_family"):
+         with_drops: bool, interpret: bool, kind: str = "rate_family",
+         ragged: bool = False, per_series: bool = False):
     from jax.experimental.pallas import tpu as pltpu
 
     Sp, Tp = vals_p.shape
@@ -198,7 +234,16 @@ def _run(vals_p, vbase_p, gids_p, o1, o2, l1, l2, t1, t2, n, ws, we,
     fix = lambda shape: pl.BlockSpec(shape, lambda i: (0, 0), **space)  # noqa: E731
     kern = functools.partial(_kernel, num_groups=Gp, is_counter=is_counter,
                              is_rate=is_rate, with_drops=with_drops,
-                             kind=kind)
+                             kind=kind, ragged=ragged, per_series=per_series)
+    with_counts = ragged                 # presence rides a second output
+    if per_series:
+        out_spec = pl.BlockSpec((_BS, Wp), lambda i: (i, 0), **space)
+        out_shape = jax.ShapeDtypeStruct((Sp, Wp), jnp.float32)
+    else:
+        out_spec = fix((Gp, Wp))
+        out_shape = jax.ShapeDtypeStruct((Gp, Wp), jnp.float32)
+    out_specs = [out_spec, out_spec] if with_counts else out_spec
+    out_shapes = [out_shape, out_shape] if with_counts else out_shape
     return pl.pallas_call(
         kern,
         grid=(grid,),
@@ -206,8 +251,8 @@ def _run(vals_p, vbase_p, gids_p, o1, o2, l1, l2, t1, t2, n, ws, we,
                   fix((Tp, Wp)), fix((Tp, Wp)), fix((Tp, Wp)), fix((Tp, Wp)),
                   fix((1, Wp)), fix((1, Wp)), fix((1, Wp)), fix((1, Wp)),
                   fix((1, Wp))],
-        out_specs=fix((Gp, Wp)),
-        out_shape=jax.ShapeDtypeStruct((Gp, Wp), jnp.float32),
+        out_specs=out_specs,
+        out_shape=out_shapes,
         interpret=interpret,
     )(vals_p, vbase_p, gids_p, o1, o2, l1, l2, t1, t2, n, ws, we)
 
@@ -242,14 +287,31 @@ def window_counts(ts_row: np.ndarray, wends: np.ndarray,
 
 
 FUSABLE_FNS = ("rate", "increase", "delta", "sum_over_time",
-               "avg_over_time", "last_over_time")
-OVER_TIME_FNS = ("sum_over_time", "avg_over_time", "last_over_time")
+               "avg_over_time", "last_over_time", "count_over_time",
+               "min_over_time", "max_over_time")
+OVER_TIME_FNS = ("sum_over_time", "avg_over_time", "last_over_time",
+                 "count_over_time")
+# kinds whose validity-weighted variant handles NaN-holed (ragged) rows
+RAGGED_FNS = ("sum_over_time", "avg_over_time", "count_over_time")
+# kinds served by the XLA reduce_window path (min-plus is not the MXU's
+# semiring; reduce_window is the TPU-native windowed order-statistic)
+MINMAX_FNS = ("min_over_time", "max_over_time")
+FUSABLE_AGGS = ("sum", "avg", "count", "min", "max")
 
 
 def can_fuse(fn_name: str, agg_op: str, shared_grid: bool,
              dense: bool) -> bool:
-    return (fn_name in FUSABLE_FNS and agg_op == "sum"
-            and shared_grid and dense)
+    """Leaf fused-path eligibility (VERDICT r2 item 2 broadened set).
+
+    dense=False means a shared scrape grid whose VALUES have NaN holes;
+    only the validity-weighted kinds and the reduce_window kinds accept
+    that.  The rate family needs per-series boundary samples, which the
+    shared selection matrices cannot express for ragged rows."""
+    if not shared_grid or agg_op not in FUSABLE_AGGS:
+        return False
+    if fn_name in ("rate", "increase", "delta", "last_over_time"):
+        return dense
+    return fn_name in RAGGED_FNS or fn_name in MINMAX_FNS
 
 
 # traceable entry for callers composing the kernel inside shard_map (the
@@ -344,3 +406,149 @@ def present_sum(sums, counts) -> np.ndarray:
     """Finish the 3-phase contract host-side: NaN where no contributors."""
     s = np.asarray(sums, np.float64)
     return np.where(counts > 0, s, np.nan)
+
+
+# ------------------------------------------------------- broadened leaf API
+# (VERDICT r2 item 2: count/avg/min/max group-aggs, min/max_over_time via
+# reduce_window, ragged/NaN working sets)
+
+def uniform_window_geometry(ts_row: np.ndarray, wends: np.ndarray,
+                            range_ms: int):
+    """(first0, stride_samples, width_samples, t_needed) when every window
+    covers a constant-width, constant-stride span of the (conceptually
+    extended) uniform grid — the precondition for lax.reduce_window — else
+    None.  Closed-form from the grid spacing, so windows hanging past the
+    data's right edge (the `end=now` dashboard shape) stay uniform:
+    t_needed > len(ts_row) tells the caller to NaN-pad that tail and run
+    the ragged variant.  Irregular grids/steps or left-clipped windows
+    fall back to the general path."""
+    ts_row = np.asarray(ts_row, dtype=np.int64)
+    wend = np.asarray(wends, dtype=np.int64)
+    T = ts_row.size
+    if wend.size == 0 or T < 2:
+        return None
+    d = int(ts_row[1] - ts_row[0])
+    if d <= 0 or (np.diff(ts_row) != d).any():
+        return None
+    t0 = int(ts_row[0])
+    if wend.size > 1:
+        s = int(wend[1] - wend[0])
+        if s <= 0 or (np.diff(wend) != s).any() or s % d:
+            return None
+        stride = s // d
+    else:
+        stride = 1
+    f0 = -(-(int(wend[0]) - int(range_ms) + 1 - t0) // d)      # ceil div
+    l0 = (int(wend[0]) - t0) // d
+    width = l0 - f0 + 1
+    if f0 < 0 or width < 1:
+        return None
+    t_needed = l0 + stride * (wend.size - 1) + 1
+    return f0, stride, width, t_needed
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "f0", "stride", "width", "W", "fn_name", "agg_op", "num_groups",
+    "ragged"))
+def fused_minmax_agg(vals, vbase, gids, f0: int, stride: int, width: int,
+                     W: int, fn_name: str, agg_op: str, num_groups: int,
+                     ragged: bool):
+    """min/max_over_time + group aggregation in ONE jit: a strided
+    lax.reduce_window over the values (one HBM pass; the VPU's native
+    windowed order-statistic) straight into the 3-phase map (segment
+    reduction on the T/W-times-smaller [S, W] intermediate) with no host
+    round trip.  Runs on any backend — pure XLA, no Pallas.
+
+    vals [S, T] (absolute values = vals + vbase broadcast), gids [S].
+    Returns partial components [G, W, C] per ops/agg.AGGREGATORS.
+    """
+    from jax import lax
+
+    from filodb_tpu.ops import agg as agg_ops
+
+    is_min = fn_name == "min_over_time"
+    seg = vals[:, f0:f0 + stride * (W - 1) + width]
+    if vbase is not None:
+        seg = seg + vbase[:, None]
+    init = jnp.inf if is_min else -jnp.inf
+    valid = ~jnp.isnan(seg)
+    x = jnp.where(valid, seg, init) if ragged else seg
+    red = lax.reduce_window(
+        x, init, lax.min if is_min else lax.max,
+        window_dimensions=(1, width), window_strides=(1, stride),
+        padding="VALID")                               # [S, W]
+    if ragged:
+        # absence = no VALID sample in the window, counted explicitly — a
+        # sentinel check on `red` would misreport windows whose real
+        # samples are themselves +/-Inf (legal float samples)
+        cnt = lax.reduce_window(
+            valid.astype(jnp.float32), 0.0, lax.add,
+            window_dimensions=(1, width), window_strides=(1, stride),
+            padding="VALID")
+        red = jnp.where(cnt > 0, red, jnp.nan)
+    return agg_ops.map_phase(agg_op, red, gids, num_groups)
+
+
+def fused_leaf_agg(plan: FusedPlan, prepared: PreparedInputs,
+                   gids: np.ndarray, num_groups: int, fn_name: str,
+                   agg_op: str, precorrected: bool = False,
+                   interpret: bool = False, ragged: bool = False
+                   ) -> np.ndarray:
+    """One fused leaf evaluation -> partial components [G, W, C] (float64,
+    ops/agg.AGGREGATORS layout) for any (fusable fn, agg) combination on
+    the matmul kernel path.  agg sum/avg/count ride the group matmul;
+    agg min/max use the kernel's per-series output mode plus an XLA
+    segment reduction (ops/agg.map_phase) on the small [S, W] result.
+    """
+    is_counter = fn_name in ("rate", "increase")
+    is_rate = fn_name == "rate"
+    with_drops = is_counter and not precorrected
+    over_time = fn_name in OVER_TIME_FNS
+    kind = fn_name if over_time else "rate_family"
+    Gp = _pad_to(max(num_groups, 8), 8)
+    wvalid = plan.wvalid1 if over_time else plan.wvalid
+    S = len(gids)
+
+    def run(per_series):
+        return _run(prepared.vals_p, prepared.vbase_p, prepared.gids_p,
+                    *(jnp.asarray(m) for m in
+                      (plan.o1, plan.o2, plan.l1, plan.l2, plan.t1,
+                       plan.t2, plan.n1 if over_time else plan.n,
+                       plan.wstart_x, plan.wend_x)),
+                    num_groups=Gp, is_counter=is_counter, is_rate=is_rate,
+                    with_drops=with_drops, interpret=interpret, kind=kind,
+                    ragged=ragged, per_series=per_series)
+
+    if agg_op in ("sum", "avg"):
+        res = run(per_series=False)
+        if ragged:
+            sums, cnts = res
+            sums = np.asarray(sums, np.float64)[:num_groups, :plan.W]
+            counts = np.asarray(cnts, np.float64)[:num_groups, :plan.W]
+        else:
+            sums = np.asarray(res, np.float64)[:num_groups, :plan.W]
+            counts = prepared.gsize[:, None].astype(np.float64) * \
+                wvalid[None, :].astype(np.float64)
+        return np.stack([sums * (counts > 0), counts], axis=-1)
+    if agg_op == "count":
+        if not ragged:
+            counts = prepared.gsize[:, None].astype(np.float64) * \
+                wvalid[None, :].astype(np.float64)
+        else:
+            _, cnts = run(per_series=False)
+            counts = np.asarray(cnts, np.float64)[:num_groups, :plan.W]
+        return counts[..., None]
+    if agg_op in ("min", "max"):
+        res = run(per_series=True)
+        if ragged:
+            per, pres = res
+            per = jnp.where(pres[:S, :plan.W] > 0, per[:S, :plan.W],
+                            jnp.nan)
+        else:
+            per = jnp.where(jnp.asarray(wvalid)[None, :],
+                            res[:S, :plan.W], jnp.nan)
+        from filodb_tpu.ops import agg as agg_ops
+        comp = agg_ops.map_phase(agg_op, per, jnp.asarray(gids, jnp.int32),
+                                 num_groups)
+        return np.asarray(comp, np.float64)
+    raise ValueError(f"unsupported fused agg {agg_op}")

@@ -185,43 +185,52 @@ def idelta(ctx: WindowCtx) -> jax.Array:
 
 # ------------------------------------------------------------- over_time / sums
 
+def _valid_count(ctx: WindowCtx) -> jax.Array:
+    """Per-window count of VALID (non-NaN) samples — the presence gate for
+    the value-summing functions.  A window whose grid slots exist but whose
+    values are all NaN is ABSENT for sum/avg/min/..., matching the
+    reference's NaN-skipping accumulators that start at NaN (ref:
+    AggrOverTimeFunctions.scala:153-165 SumOverTimeChunkedFunctionD), while
+    count_over_time emits 0 there (ref: :367-382)."""
+    return windowed_cumsum_delta(_cumsum(ctx.valid.astype(ctx.vals.dtype)),
+                                 ctx.first, ctx.last, ctx.n)
+
+
 def sum_over_time(ctx: WindowCtx) -> jax.Array:
     s = windowed_cumsum_delta(_cumsum(_masked(ctx)), ctx.first, ctx.last, ctx.n)
-    return _nan_where(ctx.n > 0, s)
+    return _nan_where(_valid_count(ctx) > 0, s)
 
 
 def count_over_time(ctx: WindowCtx) -> jax.Array:
-    c = windowed_cumsum_delta(_cumsum(ctx.valid.astype(ctx.vals.dtype)),
-                              ctx.first, ctx.last, ctx.n)
+    c = _valid_count(ctx)
     return _nan_where(ctx.n > 0, c)
 
 
 def avg_over_time(ctx: WindowCtx) -> jax.Array:
     s = windowed_cumsum_delta(_cumsum(_masked(ctx)), ctx.first, ctx.last, ctx.n)
-    c = windowed_cumsum_delta(_cumsum(ctx.valid.astype(ctx.vals.dtype)),
-                              ctx.first, ctx.last, ctx.n)
-    return _nan_where(ctx.n > 0, s / jnp.maximum(c, 1.0))
+    c = _valid_count(ctx)
+    return _nan_where(c > 0, s / jnp.maximum(c, 1.0))
 
 
 def _var_over_time(ctx: WindowCtx) -> Tuple[jax.Array, jax.Array]:
     s = windowed_cumsum_delta(_cumsum(_masked(ctx)), ctx.first, ctx.last, ctx.n)
     s2 = windowed_cumsum_delta(_cumsum(_masked(ctx, ctx.vals * ctx.vals)),
                                ctx.first, ctx.last, ctx.n)
-    c = jnp.maximum(windowed_cumsum_delta(
-        _cumsum(ctx.valid.astype(ctx.vals.dtype)), ctx.first, ctx.last, ctx.n), 1.0)
-    mean = s / c
-    var = jnp.maximum(s2 / c - mean * mean, 0.0)
+    c = _valid_count(ctx)
+    cs = jnp.maximum(c, 1.0)
+    mean = s / cs
+    var = jnp.maximum(s2 / cs - mean * mean, 0.0)
     return var, c
 
 
 def stdvar_over_time(ctx: WindowCtx) -> jax.Array:
-    var, _ = _var_over_time(ctx)
-    return _nan_where(ctx.n > 0, var)
+    var, c = _var_over_time(ctx)
+    return _nan_where((ctx.n > 0) & (c > 0.5), var)
 
 
 def stddev_over_time(ctx: WindowCtx) -> jax.Array:
-    var, _ = _var_over_time(ctx)
-    return _nan_where(ctx.n > 0, jnp.sqrt(var))
+    var, c = _var_over_time(ctx)
+    return _nan_where((ctx.n > 0) & (c > 0.5), jnp.sqrt(var))
 
 
 def last_over_time(ctx: WindowCtx) -> jax.Array:
@@ -354,13 +363,16 @@ def _window_tile_reduce(ctx: WindowCtx, reducer: Callable[[jax.Array, jax.Array]
 def min_over_time(ctx: WindowCtx) -> jax.Array:
     r = _window_tile_reduce(
         ctx, lambda v, m: jnp.min(jnp.where(m, v, jnp.inf), axis=-1))
-    return _nan_where(ctx.n > 0, r)
+    # absence = zero VALID samples (the reference accumulator starts NaN
+    # and skips only NaN) — counted explicitly so windows whose real
+    # samples are +/-Inf still emit their inf, not absent
+    return _nan_where(_valid_count(ctx) > 0, r)
 
 
 def max_over_time(ctx: WindowCtx) -> jax.Array:
     r = _window_tile_reduce(
         ctx, lambda v, m: jnp.max(jnp.where(m, v, -jnp.inf), axis=-1))
-    return _nan_where(ctx.n > 0, r)
+    return _nan_where(_valid_count(ctx) > 0, r)
 
 
 def _masked_quantile(vals: jax.Array, mask: jax.Array, q: float) -> jax.Array:

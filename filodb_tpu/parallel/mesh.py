@@ -30,6 +30,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import threading
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -318,7 +319,10 @@ def _distributed_window_agg(mesh: Mesh,
     'time'.  Returns partial components [G, W, C] (replicated over 'shard',
     sharded over 'time') — call agg_ops.present() to finish.
     """
-    combiner = agg_ops.AGGREGATORS[agg_op].combiner
+    def _collective(comb, x):
+        if comb == "sum":
+            return jax.lax.psum(x, "shard")
+        return (jax.lax.pmin if comb == "min" else jax.lax.pmax)(x, "shard")
 
     def step(ts_blk, val_blk, gid_blk, wends_blk, vbase_blk):
         # ts_blk [1, S, T] — this device column's shard; wends_blk [W/nt]
@@ -327,13 +331,11 @@ def _distributed_window_agg(mesh: Mesh,
                                       vbase=vbase_blk[0],
                                       precorrected=precorrected)
         part = agg_ops.map_phase(agg_op, res, gid_blk[0], num_groups)
-        if combiner == "sum":
-            part = jax.lax.psum(part, "shard")
-        elif combiner == "min":
-            part = jax.lax.pmin(part, "shard")
-        else:
-            part = jax.lax.pmax(part, "shard")
-        return part
+        combs = agg_ops.combiners_for(agg_op, part.shape[-1])
+        if len(set(combs)) == 1:
+            return _collective(combs[0], part)
+        return jnp.stack([_collective(c, part[..., i])
+                          for i, c in enumerate(combs)], axis=-1)
 
     return jax.shard_map(
         step, mesh=mesh,
@@ -415,6 +417,11 @@ class MeshExecutor:
         # fused-path plan/mats cache: (shared_ts_row, wends, range) ->
         # (device selection matrices, wvalid); see _run_agg_fused
         self._fused_plan_cache: Dict[Tuple, Tuple] = {}
+        # queries can reach the executor from HTTP worker threads (same
+        # contract as the leaf caches' _FUSED_CACHE_LOCK in query/exec.py):
+        # every cache read-modify-write below holds this lock; device work
+        # runs outside it
+        self._cache_lock = threading.Lock()
 
     def _cluster_sig(self) -> Tuple:
         return tuple(
@@ -427,26 +434,31 @@ class MeshExecutor:
                   by: Sequence[str], without: Sequence[str]
                   ) -> Tuple[np.ndarray, GroupRegistry]:
         ck = (tuple(by), tuple(without))
-        entry = self._group_caches.get(ck)
-        if entry is None:
-            entry = (GroupRegistry(by, without), {})
-            self._group_caches[ck] = entry
-        reg, per_shard = entry
-        arr = per_shard.get(shard.shard_num)
-        n = len(shard.partitions)
-        if arr is None:
-            arr = np.full(n, -1, dtype=np.int32)
-        elif arr.shape[0] < n:
-            arr = np.concatenate(
-                [arr, np.full(n - arr.shape[0], -1, dtype=np.int32)])
-        need = arr[pids] < 0
-        if need.any():
-            new_pids = pids[need]
-            keys = shard.keys_for(new_pids)
-            for pid, key in zip(new_pids.tolist(), keys):
-                arr[pid] = reg.slot_for(key.labels)
-        per_shard[shard.shard_num] = arr
-        return arr[pids], reg
+        # the whole resolve runs under the lock: GroupRegistry.slot_for is
+        # check-then-insert (a race would assign one group key two slots and
+        # permanently split its aggregates) and the per-shard array is
+        # read-modify-written; keys_for is a fast snapshot read
+        with self._cache_lock:
+            entry = self._group_caches.get(ck)
+            if entry is None:
+                entry = (GroupRegistry(by, without), {})
+                self._group_caches[ck] = entry
+            reg, per_shard = entry
+            arr = per_shard.get(shard.shard_num)
+            n = len(shard.partitions)
+            if arr is None:
+                arr = np.full(n, -1, dtype=np.int32)
+            elif arr.shape[0] < n:
+                arr = np.concatenate(
+                    [arr, np.full(n - arr.shape[0], -1, dtype=np.int32)])
+            need = arr[pids] < 0
+            if need.any():
+                new_pids = pids[need]
+                keys = shard.keys_for(new_pids)
+                for pid, key in zip(new_pids.tolist(), keys):
+                    arr[pid] = reg.slot_for(key.labels)
+            per_shard[shard.shard_num] = arr
+            return arr[pids], reg
 
     def lookup_and_pack(self, filters, start_ms: int, end_ms: int,
                         by: Sequence[str] = (),
@@ -469,18 +481,20 @@ class MeshExecutor:
         ck = (tuple(str(f) for f in filters), tuple(by), tuple(without),
               fn_name)
         sig = self._cluster_sig()
-        # stale entries pin device memory for nothing — drop them eagerly
-        for k in [k for k, e in self._pack_cache.items() if e["sig"] != sig]:
-            del self._pack_cache[k]
-        ent = self._pack_cache.get(ck)
-        # a hit needs the requested range INSIDE the cached one: the index
-        # prunes series by time, so a later end could admit series the
-        # cached pack never gathered
-        if ent is not None and ent["start_ms"] <= start_ms \
-                and ent["end_ms"] >= end_ms:
-            metrics_registry.counter("mesh_pack_cache_hits").increment()
-            self._pack_cache[ck] = self._pack_cache.pop(ck)   # LRU touch
-            return ent["packed"]
+        with self._cache_lock:
+            # stale entries pin device memory for nothing — drop eagerly
+            for k in [k for k, e in self._pack_cache.items()
+                      if e["sig"] != sig]:
+                del self._pack_cache[k]
+            ent = self._pack_cache.get(ck)
+            # a hit needs the requested range INSIDE the cached one: the
+            # index prunes series by time, so a later end could admit
+            # series the cached pack never gathered
+            if ent is not None and ent["start_ms"] <= start_ms \
+                    and ent["end_ms"] >= end_ms:
+                metrics_registry.counter("mesh_pack_cache_hits").increment()
+                self._pack_cache[ck] = self._pack_cache.pop(ck)  # LRU touch
+                return ent["packed"]
         spec = RANGE_FUNCTIONS.get(fn_name or "")
         fn_is_counter = spec.is_counter if spec else False
         blocks = []
@@ -543,11 +557,12 @@ class MeshExecutor:
         # samples under the post-ingest generation and serve it as fresh).
         # ODP during the first gather also bumps generations, so the second
         # query re-packs once and stabilizes from the third on.
-        self._pack_cache[ck] = {"sig": sig,
-                                "start_ms": start_ms, "end_ms": end_ms,
-                                "packed": packed}
-        while len(self._pack_cache) > self._pack_cache_max:
-            self._pack_cache.pop(next(iter(self._pack_cache)))
+        with self._cache_lock:
+            self._pack_cache[ck] = {"sig": sig,
+                                    "start_ms": start_ms, "end_ms": end_ms,
+                                    "packed": packed}
+            while len(self._pack_cache) > self._pack_cache_max:
+                self._pack_cache.pop(next(iter(self._pack_cache)))
         metrics_registry.counter("mesh_pack_cache_misses").increment()
         return packed
 
@@ -610,6 +625,10 @@ class MeshExecutor:
         shared = packed.shared_ts_row is not None and packed.gsize is not None
         if not pf.can_fuse(fn_name or "", "sum", shared, shared):
             return None
+        if fn_name in pf.MINMAX_FNS:
+            # reduce_window kinds run through the general mesh path (XLA
+            # fuses them fine); the matmul kernel has no min/max kind
+            return None
         interpret = jax.default_backend() != "tpu"
         if interpret and not os.environ.get("FILODB_TPU_FUSED_INTERPRET"):
             return None
@@ -630,7 +649,8 @@ class MeshExecutor:
         plan_key = (packed.shared_ts_row.tobytes(), wends_p.tobytes(),
                     range_ms)
         from filodb_tpu.query.exec import _lru_touch
-        ent = _lru_touch(self._fused_plan_cache, plan_key)
+        with self._cache_lock:
+            ent = _lru_touch(self._fused_plan_cache, plan_key)
         if ent is None:
             ts_row = packed.shared_ts_row.astype(np.int64)
             plans = [pf.build_plan(
@@ -645,10 +665,11 @@ class MeshExecutor:
             wvalid = np.concatenate([p.wvalid for p in plans])
             wvalid1 = np.concatenate([p.wvalid1 for p in plans])
             ent = (mats, wvalid, wvalid1)
-            self._fused_plan_cache[plan_key] = ent
-            while len(self._fused_plan_cache) > 4:
-                self._fused_plan_cache.pop(
-                    next(iter(self._fused_plan_cache)))
+            with self._cache_lock:
+                self._fused_plan_cache[plan_key] = ent
+                while len(self._fused_plan_cache) > 4:
+                    self._fused_plan_cache.pop(
+                        next(iter(self._fused_plan_cache)))
         mats, wvalid, wvalid1 = ent
         over_time = fn_name in pf.OVER_TIME_FNS
         # the kernel's `n` slot carries TRUE counts for the over_time

@@ -33,7 +33,7 @@ from filodb_tpu.ops.instant import (INSTANT_FUNCTIONS, ARITH_OPERATORS,
                                     COMPARISON_OPERATORS, apply_binary_op)
 from filodb_tpu.ops import counter as counter_ops
 from filodb_tpu.ops.rangefns import RANGE_FUNCTIONS, evaluate_range_function
-from filodb_tpu.ops.timewindow import to_offsets, make_window_ends
+from filodb_tpu.ops.timewindow import PAD_TS, to_offsets, make_window_ends
 from filodb_tpu.query.rangevector import (QueryContext, QueryResult, QueryStats,
                                           RangeVectorKey, ResultBlock,
                                           concat_blocks, remove_nan_series)
@@ -56,10 +56,13 @@ class RawBlock:
     samples: int = 0                    # total valid samples (stats)
     vbase: Optional[np.ndarray] = None  # [S] or [S, B]
     precorrected: bool = False          # counter reset-correction done host-side
-    # shared scrape grid + fully-finite values: row-0 ts offsets when ALL
-    # rows share one grid with no NaN holes (the pallas_fused precondition,
-    # tracked by the device mirror); None otherwise
+    # shared scrape grid: row-0 ts offsets when ALL rows share one grid
+    # (the pallas_fused precondition, tracked by the device mirror); None
+    # otherwise.  `dense` qualifies it: True = no NaN holes anywhere in the
+    # counted region; False = NaN-holed values on the shared grid, which
+    # only the validity-weighted fused kinds accept.
     shared_ts_row: Optional[np.ndarray] = None
+    dense: bool = True
 
 
 # Fused-leaf caches (see MultiSchemaPartitionsExec._try_fused): entries are
@@ -72,7 +75,52 @@ class RawBlock:
 _FUSED_PLAN_CACHE: Dict[Tuple, object] = {}
 _FUSED_VALS_CACHE: Dict[Tuple, object] = {}
 _FUSED_GROUP_CACHE: Dict[Tuple, Tuple] = {}
-_FUSED_VALS_CACHE_BYTES = 4 << 30
+# NaN-padded device copies for the reduce_window path's end=now shape,
+# keyed (working set, t_needed) — small cap: each entry pins a full copy
+_FUSED_MINMAX_PAD_CACHE: Dict[Tuple, object] = {}
+_FUSED_VALS_CACHE_BYTES: Optional[int] = None    # resolved lazily
+_MIRROR_LIMIT_SEEN: Optional[int] = None         # largest live mirror budget
+
+
+def _note_mirror_limit(limit_bytes: int) -> None:
+    """Record the largest DeviceMirror HBM budget actually constructed so
+    the fused-cache budget subtracts the REAL mirror share, not just the
+    compile-time default (review r3)."""
+    global _MIRROR_LIMIT_SEEN, _FUSED_VALS_CACHE_BYTES
+    if _MIRROR_LIMIT_SEEN is None or limit_bytes > _MIRROR_LIMIT_SEEN:
+        _MIRROR_LIMIT_SEEN = limit_bytes
+        _FUSED_VALS_CACHE_BYTES = None   # re-derive on next insert
+
+
+def _fused_vals_budget() -> int:
+    """Byte budget for the padded-values cache.  Configurable via
+    FILODB_TPU_FUSED_CACHE_BYTES; otherwise derived from the device's
+    reported HBM minus the live mirror budget so mirror + this cache +
+    headroom cannot exceed the chip (ADVICE r2: the old fixed 4 GiB
+    ignored the mirror's budget).  Resolved lazily — the backend is
+    already initialized by the time the first fused query inserts."""
+    global _FUSED_VALS_CACHE_BYTES
+    if _FUSED_VALS_CACHE_BYTES is not None:
+        return _FUSED_VALS_CACHE_BYTES
+    env = os.environ.get("FILODB_TPU_FUSED_CACHE_BYTES")
+    if env:
+        _FUSED_VALS_CACHE_BYTES = int(env)
+        return _FUSED_VALS_CACHE_BYTES
+    budget = 4 << 30
+    try:
+        import jax
+
+        from filodb_tpu.core.devicecache import DEFAULT_HBM_LIMIT_BYTES
+        mirror_limit = _MIRROR_LIMIT_SEEN or DEFAULT_HBM_LIMIT_BYTES
+        stats = jax.devices()[0].memory_stats() or {}
+        limit = int(stats.get("bytes_limit", 0))
+        if limit:
+            budget = min(budget,
+                         max(1 << 30, limit - mirror_limit - (2 << 30)))
+    except Exception:  # noqa: BLE001 — stats unavailable: keep the default
+        pass
+    _FUSED_VALS_CACHE_BYTES = budget
+    return budget
 # queries run on HTTP worker threads (http/server.py ThreadingHTTPServer) —
 # every cache read-modify-write holds this lock; the kernel runs outside it
 _FUSED_CACHE_LOCK = threading.Lock()
@@ -96,11 +144,39 @@ def _vals_nbytes(v) -> int:
     return int(v.vals_p.size * 4 + v.vbase_p.size * 4)
 
 
+def _group_cache_lookup(key, by, without):
+    """Cached (PaddedGroups, gkeys) for this working set + grouping, or
+    (None, None).  Pairs with _group_cache_insert — the two halves of the
+    group-cache protocol, shared by the kernel and reduce_window paths."""
+    if key is None:
+        return None, None
+    with _FUSED_CACHE_LOCK:
+        ent = _lru_touch(_FUSED_GROUP_CACHE, key + (by, without))
+    return ent if ent is not None else (None, None)
+
+
+def _group_cache_insert(key, by, without, groups, gkeys) -> None:
+    """Insert a (PaddedGroups, gkeys) entry, evicting entries from older
+    snapshot generations of the same mirror (each pins device arrays) and
+    capping the cache.  The single home of the group-cache write rules —
+    used by both the kernel path and the reduce_window path."""
+    if key is None:
+        return
+    group_key = key + (by, without)
+    with _FUSED_CACHE_LOCK:
+        for k in [k for k in _FUSED_GROUP_CACHE
+                  if k[0] == key[0] and k[1] != key[1]]:
+            del _FUSED_GROUP_CACHE[k]
+        _FUSED_GROUP_CACHE[group_key] = (groups, gkeys)
+        while len(_FUSED_GROUP_CACHE) > 16:
+            _FUSED_GROUP_CACHE.pop(next(iter(_FUSED_GROUP_CACHE)))
+
+
 def _vals_cache_insert(key, v) -> None:
     _FUSED_VALS_CACHE[key] = v
     while len(_FUSED_VALS_CACHE) > 4 or sum(
             _vals_nbytes(e) for e in _FUSED_VALS_CACHE.values()
-            ) > _FUSED_VALS_CACHE_BYTES:
+            ) > _fused_vals_budget():
         if len(_FUSED_VALS_CACHE) == 1:
             break                        # always keep the entry just added
         _FUSED_VALS_CACHE.pop(next(iter(_FUSED_VALS_CACHE)))
@@ -579,17 +655,17 @@ def reduce_partials(parts: List[AggPartial]) -> Optional[AggPartial]:
     if parts[0].comp is not None:
         C = parts[0].comp.shape[-1]
         W = parts[0].comp.shape[1]
-        comb = agg_ops.AGGREGATORS.get(op, agg_ops.AggSpec(1, "sum")).combiner
-        init = 0.0 if comb == "sum" else (np.inf if comb == "min" else -np.inf)
-        out = np.full((len(gkeys), W, C), init)
+        combs = agg_ops.combiners_for(op, C)
+        init = {"sum": 0.0, "min": np.inf, "max": -np.inf}
+        out = np.empty((len(gkeys), W, C))
+        for i, comb in enumerate(combs):
+            out[..., i] = init[comb]
         for p in parts:
             idx = np.asarray([gmap[k] for k in p.group_keys], dtype=np.int64)
-            if comb == "sum":
-                np.add.at(out, idx, p.comp)
-            elif comb == "min":
-                np.minimum.at(out, idx, p.comp)
-            else:
-                np.maximum.at(out, idx, p.comp)
+            for i, comb in enumerate(combs):
+                ufunc = {"sum": np.add, "min": np.minimum,
+                         "max": np.maximum}[comb]
+                ufunc.at(out[..., i], idx, p.comp[..., i])
         return AggPartial(op, gkeys, wends, comp=out, params=parts[0].params,
                           bucket_les=parts[0].bucket_les)
     # candidate form: concat and remap groups
@@ -908,33 +984,48 @@ class MultiSchemaPartitionsExec(LeafExecPlan):
         vals = data.values
         ndim = getattr(vals, "ndim", 0)
         is_hist = ndim == 3
-        if ndim not in (2, 3) or t0.window_ms is None \
-                or t0.function_args or t1.params:
+        if ndim not in (2, 3) or t0.function_args or t1.params:
             return None
-        if (t0.function == "count_over_time" and t1.op == "sum"
-                and not is_hist):
-            # pure host math — no device work, so no backend gate
-            return self._fused_count_over_time(data, t0, t1)
-        import jax
-        backend = jax.default_backend()
-        interpret = backend != "tpu"
-        if interpret and not os.environ.get("FILODB_TPU_FUSED_INTERPRET"):
-            return None                 # kernel is MXU-targeted
-        if not pf.can_fuse(t0.function or "", t1.op, True, True):
-            return None
-        if t0.function in ("rate", "increase") and not data.precorrected:
+        if t0.window_ms is None:
+            # instant-vector selector (`sum by (x) (metric)`): plain
+            # lookback sampling IS last_over_time over the stale-lookback
+            # window — the same normalization the general apply() does
+            if t0.function is not None:
+                return None
+            t0 = dataclasses.replace(t0, window_ms=t0.lookback_ms,
+                                     function="last_over_time")
+        fn = t0.function or ""
+        dense = data.dense
+        if not pf.can_fuse(fn, t1.op, True, dense):
             return None
         if is_hist:
             # histogram buckets are counters too: flatten [S, T, B] into
             # S*B kernel rows with per-(group, bucket) slots — the hist
             # analogue (ref: HistogramQueryBenchmark's
             # sum(rate(..._bucket[5m])) + histogram_quantile)
-            if t0.function not in ("rate", "increase") \
-                    or data.bucket_les is None:
+            if fn not in ("rate", "increase") or t1.op != "sum" \
+                    or data.bucket_les is None or not dense:
                 return None
+        # host-only fast paths: under the dense shared grid every series
+        # has IDENTICAL per-window sample counts, so count_over_time and
+        # the count aggregate are pure host math — no device work at all
+        if dense and not is_hist and fn == "count_over_time":
+            return self._fused_count_over_time(data, t0, t1)
+        if dense and not is_hist and t1.op == "count":
+            return self._fused_count_agg(data, t0, t1)
         wends = make_window_ends(t0.start_ms, t0.end_ms, t0.step_ms)
         eval_wends = wends - t0.offset_ms - data.base_ms
         if eval_wends.size == 0 or abs(eval_wends).max() >= (1 << 30):
+            return None
+        if fn in pf.MINMAX_FNS:
+            # pure-XLA reduce_window path — any backend, no Pallas
+            return self._fused_minmax(data, t0, t1, wends, eval_wends)
+        import jax
+        backend = jax.default_backend()
+        interpret = backend != "tpu"
+        if interpret and not os.environ.get("FILODB_TPU_FUSED_INTERPRET"):
+            return None                 # kernel is MXU-targeted
+        if fn in ("rate", "increase") and not data.precorrected:
             return None
         # VMEM guard, part 1 (group count not yet known — use the minimum):
         # very long ranges with many windows must take the general path,
@@ -953,13 +1044,10 @@ class MultiSchemaPartitionsExec(LeafExecPlan):
         if key is not None:
             plan_key = key[:3] + (t0.start_ms, t0.step_ms, t0.end_ms,
                                   t0.offset_ms, t0.window_ms, data.base_ms)
-            group_key = key + (t1.by, t1.without)
             with _FUSED_CACHE_LOCK:
                 plan = _lru_touch(_FUSED_PLAN_CACHE, plan_key)
                 padded_vals = _lru_touch(_FUSED_VALS_CACHE, key)
-                ent = _lru_touch(_FUSED_GROUP_CACHE, group_key)
-            if ent is not None:
-                groups, gkeys = ent
+            groups, gkeys = _group_cache_lookup(key, t1.by, t1.without)
             if padded_vals is not None:
                 registry.counter("leaf_fused_prep_hits").increment()
         if plan is None:
@@ -1016,37 +1104,34 @@ class MultiSchemaPartitionsExec(LeafExecPlan):
                                        num_slots)
             else:
                 groups = pf.pad_groups(gids, vals.shape[0], len(gkeys))
-            if key is not None:
-                with _FUSED_CACHE_LOCK:
-                    for k in [k for k in _FUSED_GROUP_CACHE
-                              if k[0] == key[0] and k[1] != key[1]]:
-                        del _FUSED_GROUP_CACHE[k]
-                    _FUSED_GROUP_CACHE[group_key] = (groups, gkeys)
-                    while len(_FUSED_GROUP_CACHE) > 16:
-                        _FUSED_GROUP_CACHE.pop(
-                            next(iter(_FUSED_GROUP_CACHE)))
+            _group_cache_insert(key, t1.by, t1.without, groups, gkeys)
         prep = pf.PreparedInputs(padded_vals.vals_p, padded_vals.vbase_p,
                                  groups.gids_p, groups.gsize)
-        sums, counts = pf.fused_rate_groupsum(
+        registry.counter("leaf_fused_kernel").increment()
+        if not is_hist:
+            # broadened matmul path: any fusable (fn, agg) combination,
+            # ragged (validity-weighted) when the working set has NaN holes
+            comp = pf.fused_leaf_agg(
+                plan, prep, groups.gids_p[:vals.shape[0], 0],
+                len(gkeys), fn, t1.op, precorrected=data.precorrected,
+                interpret=interpret, ragged=not dense)
+            return AggPartial(t1.op, gkeys, wends, comp=comp)
+        sums, _counts = pf.fused_rate_groupsum(
             None, None, None, plan, num_slots, fn_name=t0.function,
             precorrected=data.precorrected, interpret=interpret,
             prepared=prep)
-        registry.counter("leaf_fused_kernel").increment()
-        if is_hist:
-            G = len(gkeys)
-            buckets = np.asarray(sums, np.float64) \
-                .reshape(G, B, -1).transpose(0, 2, 1)       # [G, W, B]
-            # series-per-group count: every bucket row of a series shares
-            # presence under the dense gate, so any bucket slot's size IS
-            # the group's series count (works on the group-cache hit path
-            # too, where the raw gids were never recomputed)
-            gsize = groups.gsize.reshape(G, B)[:, 0]
-            cnt = gsize[:, None] * plan.wvalid[None, :].astype(np.float64)
-            comp = np.concatenate([buckets, cnt[..., None]], axis=2)
-            return AggPartial("hist_sum", gkeys, wends, comp=comp,
-                              bucket_les=data.bucket_les)
-        comp = np.stack([np.asarray(sums, np.float64), counts], axis=-1)
-        return AggPartial("sum", gkeys, wends, comp=comp)
+        G = len(gkeys)
+        buckets = np.asarray(sums, np.float64) \
+            .reshape(G, B, -1).transpose(0, 2, 1)           # [G, W, B]
+        # series-per-group count: every bucket row of a series shares
+        # presence under the dense gate, so any bucket slot's size IS
+        # the group's series count (works on the group-cache hit path
+        # too, where the raw gids were never recomputed)
+        gsize = groups.gsize.reshape(G, B)[:, 0]
+        cnt = gsize[:, None] * plan.wvalid[None, :].astype(np.float64)
+        comp = np.concatenate([buckets, cnt[..., None]], axis=2)
+        return AggPartial("hist_sum", gkeys, wends, comp=comp,
+                          bucket_les=data.bucket_les)
 
     def args_str(self):
         fs = ",".join(str(f) for f in self.filters)
@@ -1054,10 +1139,9 @@ class MultiSchemaPartitionsExec(LeafExecPlan):
                 f"chunkMethod=TimeRangeChunkScan({self.chunk_start_ms},"
                 f"{self.chunk_end_ms}), filters=[{fs}], colName={self.columns}")
 
-    def _fused_count_over_time(self, data, t0, t1):
-        """sum by (count_over_time(...)): under the shared dense grid every
-        series has IDENTICAL per-window sample counts, so the whole result
-        is gsize * n — pure host math, no device work at all."""
+    def _window_counts_groups(self, data, t0, t1):
+        """Shared host math for the no-device fast paths: per-window
+        sample counts on the dense shared grid + grouping."""
         wends = make_window_ends(t0.start_ms, t0.end_ms, t0.step_ms)
         eval_wends = wends - t0.offset_ms - data.base_ms
         if eval_wends.size == 0 or abs(eval_wends).max() >= (1 << 30):
@@ -1069,12 +1153,119 @@ class MultiSchemaPartitionsExec(LeafExecPlan):
                              eval_wends, t0.window_ms).astype(np.float64)
         gsize = np.bincount(np.asarray(gids),
                             minlength=len(gkeys))[:len(gkeys)]
-        sums = gsize[:, None] * n[None, :]
-        counts = gsize[:, None] * (n >= 1).astype(np.float64)
+        return wends, gkeys, n, gsize.astype(np.float64)
+
+    def _fused_count_over_time(self, data, t0, t1):
+        """agg by (count_over_time(...)): under the shared dense grid every
+        series has IDENTICAL per-window sample counts, so the whole result
+        is host math over (gsize, n) — no device work at all.  Handles all
+        five fusable aggregates: each series' value at window w is n[w]."""
+        r = self._window_counts_groups(data, t0, t1)
+        if r is None:
+            return None
+        wends, gkeys, n, gsize = r
+        valid = (n >= 1).astype(np.float64)
+        op = t1.op
+        if op in ("sum", "avg"):
+            comp = np.stack([gsize[:, None] * n[None, :] * valid,
+                             gsize[:, None] * valid[None, :]], axis=-1)
+        elif op == "count":
+            comp = (gsize[:, None] * valid[None, :])[..., None]
+        else:                            # min/max: every series agrees on n
+            absent = np.inf if op == "min" else -np.inf
+            per = np.where(valid > 0, n, absent)
+            comp = np.stack(
+                [np.broadcast_to(per[None, :], (len(gkeys), len(n))),
+                 gsize[:, None] * valid[None, :]], axis=-1)
         from filodb_tpu.utils.metrics import registry
         registry.counter("leaf_fused_count_host").increment()
-        comp = np.stack([sums, counts], axis=-1)
-        return AggPartial("sum", gkeys, wends, comp=comp)
+        return AggPartial(op, gkeys, wends, comp=comp)
+
+    def _fused_count_agg(self, data, t0, t1):
+        """count by (fn(...)) on a dense shared grid: the count of series
+        emitting a value at window w is gsize * 1{n[w] >= min_samples} —
+        host math, no device work (the value itself never matters)."""
+        r = self._window_counts_groups(data, t0, t1)
+        if r is None:
+            return None
+        wends, gkeys, n, gsize = r
+        minsamp = 2 if t0.function in ("rate", "increase", "delta") else 1
+        valid = (n >= minsamp).astype(np.float64)
+        from filodb_tpu.utils.metrics import registry
+        registry.counter("leaf_fused_count_host").increment()
+        comp = (gsize[:, None] * valid[None, :])[..., None]
+        return AggPartial("count", gkeys, wends, comp=comp)
+
+    def _fused_minmax(self, data, t0, t1, wends, eval_wends):
+        """min/max_over_time + any aggregate in one jit via the XLA
+        reduce_window path (ops/pallas_fused.fused_minmax_agg) — one HBM
+        pass, no host round trip of the [S, T] working set, any backend.
+        Requires uniform window geometry; else the general path runs."""
+        from filodb_tpu.ops import pallas_fused as pf
+        ts_row0 = np.asarray(data.shared_ts_row)
+        real = ts_row0[ts_row0 < PAD_TS]
+        geom = pf.uniform_window_geometry(real.astype(np.int64),
+                                          eval_wends, t0.window_ms)
+        if geom is None:
+            return None
+        f0, stride, width, t_needed = geom
+        if t_needed > 2 * real.size:
+            # a grid hanging FAR past the data (end=now long after the last
+            # scrape) would pad more columns than the data itself — the
+            # general path handles that without materializing the padding
+            return None
+        # grouping: reuse the shared per-working-set group cache (the same
+        # per-series label hashing the kernel path caches away)
+        key = self._fused_cache_key
+        groups_c, gkeys = _group_cache_lookup(key, t1.by, t1.without)
+        if gkeys is None:
+            gids, gkeys = _group_ids(data.keys, t1.by, t1.without)
+            self._check_group_limit(gkeys)      # reject BEFORE caching
+            _group_cache_insert(key, t1.by, t1.without,
+                                pf.pad_groups(gids, len(data.keys),
+                                              len(gkeys)), gkeys)
+        else:
+            self._check_group_limit(gkeys)
+            gids = np.asarray(groups_c.gids_p[:len(data.keys), 0])
+        vb = data.vbase
+        vals = jnp.asarray(data.values)
+        ragged = not data.dense
+        if t_needed > real.size:
+            # windows hang past the data's right edge (end=now queries):
+            # extend with NaN columns so the ragged variant masks them —
+            # cached per (working set, t_needed): the dashboard-poll shape
+            # would otherwise re-copy the whole set on device every refresh
+            pad_key = None if key is None else key + ("minmax_pad",
+                                                      t_needed)
+            padded = None
+            if pad_key is not None:
+                with _FUSED_CACHE_LOCK:
+                    padded = _lru_touch(_FUSED_MINMAX_PAD_CACHE, pad_key)
+            if padded is None:
+                padded = jnp.pad(vals[:, :real.size],
+                                 ((0, 0), (0, t_needed - real.size)),
+                                 constant_values=np.nan)
+                if pad_key is not None:
+                    with _FUSED_CACHE_LOCK:
+                        for k in [k for k in _FUSED_MINMAX_PAD_CACHE
+                                  if k[0] == pad_key[0]
+                                  and k[1] != pad_key[1]]:
+                            del _FUSED_MINMAX_PAD_CACHE[k]
+                        _FUSED_MINMAX_PAD_CACHE[pad_key] = padded
+                        while len(_FUSED_MINMAX_PAD_CACHE) > 2:
+                            _FUSED_MINMAX_PAD_CACHE.pop(
+                                next(iter(_FUSED_MINMAX_PAD_CACHE)))
+            vals = padded
+            ragged = True
+        comp = pf.fused_minmax_agg(
+            vals, None if vb is None else jnp.asarray(vb),
+            jnp.asarray(gids, jnp.int32), f0, stride, width,
+            int(eval_wends.size), t0.function, t1.op, len(gkeys),
+            ragged=ragged)
+        from filodb_tpu.utils.metrics import registry
+        registry.counter("leaf_fused_minmax").increment()
+        return AggPartial(t1.op, gkeys, wends,
+                          comp=np.asarray(comp, np.float64))
 
     def _check_group_limit(self, gkeys) -> None:
         limit = self.ctx.planner_params.group_by_cardinality_limit
@@ -1169,8 +1360,13 @@ class MultiSchemaPartitionsExec(LeafExecPlan):
                 not counter_col or fn_is_counter):
             mirror = getattr(store, "device_mirror", None)
             if mirror is None:
-                from filodb_tpu.core.devicecache import DeviceMirror
-                mirror = store.device_mirror = DeviceMirror()
+                from filodb_tpu.core.devicecache import (
+                    DEFAULT_HBM_LIMIT_BYTES, DeviceMirror)
+                limit = getattr(shard.config.store,
+                                "device_mirror_hbm_limit",
+                                DEFAULT_HBM_LIMIT_BYTES)
+                mirror = store.device_mirror = DeviceMirror(limit)
+                _note_mirror_limit(limit)
 
         # Mirror refresh (a full host->device upload) runs at most once per
         # query, under the write lock so it can't race a mutation; the
@@ -1191,6 +1387,7 @@ class MultiSchemaPartitionsExec(LeafExecPlan):
                 mirrored = mirror.gather_cached(rows, snap)
         # value column selection: histograms gather [S, T, B]
         shared_ts_row = None
+        dense = True
         if mirrored is not None:
             ts_off, dev_cols, dev_vbases, base = mirrored
             vals = dev_cols[col_name]
@@ -1198,7 +1395,10 @@ class MultiSchemaPartitionsExec(LeafExecPlan):
             counts = shard.snapshot_read(store,
                                          lambda: store.counts[rows].copy())
             precorrected = counter_col   # mirror corrects counter columns
-            shared_ts_row = mirror.fused_eligible(col_name, snap)
+            shared_ts_row = mirror.fused_eligible(col_name, snap,
+                                                  allow_ragged=True)
+            dense = shared_ts_row is not None and mirror.col_dense(col_name,
+                                                                   snap)
             if shared_ts_row is not None:
                 # cache identity for the fused path's prepared-input reuse
                 # (mirror.serial, not id(): ids are reused after GC; raw
@@ -1222,7 +1422,7 @@ class MultiSchemaPartitionsExec(LeafExecPlan):
         return RawBlock(keys, ts_off, vals, base, les,
                         samples=stats.samples_scanned, vbase=vbase,
                         precorrected=precorrected,
-                        shared_ts_row=shared_ts_row), stats
+                        shared_ts_row=shared_ts_row, dense=dense), stats
 
 
 def _estimate_scan(store, rows: np.ndarray, start_ms: int,

@@ -368,8 +368,12 @@ class MultiPartitionPlanner(QueryPlanner):
                             filter_groups) -> ExecPlan:
         """@ plans read data at the PINNED time, not the outer grid: select
         the partition by the true data range and send the WHOLE plan there
-        (slicing the outer grid cannot relocate a pinned read).  Mixed
-        multi-partition pinned expressions degrade to local evaluation."""
+        (slicing the outer grid cannot relocate a pinned read).  A pinned
+        data range that SPANS partitions is an error: no single node holds
+        the whole range, so local evaluation would silently return partial
+        results (every partition is missing part of the window), and the
+        outer-grid stitch used for unpinned plans cannot split a pinned
+        read either."""
         dr = lp.pinned_data_range(plan, self.stale_lookback_ms)
         if dr is None:
             return self.local.materialize(plan, ctx)
@@ -381,6 +385,11 @@ class MultiPartitionPlanner(QueryPlanner):
                 names.add(a.partition_name)
                 if a.partition_name != self.local_name:
                     endpoint = a.endpoint
+        if len(names) > 1:
+            raise ValueError(
+                "@-pinned expression reads data spanning partitions "
+                f"{sorted(names)}; a pinned read cannot be split — narrow "
+                "the @ timestamp or the selector range")
         if len(names) == 1 and endpoint is not None:
             return PromQlRemoteExec(
                 ctx, endpoint, pu.unparse(plan), plan.start_ms,

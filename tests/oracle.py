@@ -90,7 +90,10 @@ def eval_series(ts: np.ndarray, vals: np.ndarray, wends: Sequence[int],
             if len(wt) >= 2:
                 out[i] = wv[-1] - wv[-2]
         elif fn == "sum_over_time":
-            out[i] = np.sum(wv[mask])
+            # all-NaN windows are absent: the reference accumulator starts
+            # at NaN and only zeroes on the first non-NaN chunk (ref:
+            # AggrOverTimeFunctions.scala:153-165)
+            out[i] = np.sum(wv[mask]) if mask.any() else np.nan
         elif fn == "count_over_time":
             out[i] = np.sum(mask)
         elif fn == "avg_over_time":

@@ -222,3 +222,100 @@ def test_fused_error_is_logged_with_reason(fused_env, caplog, monkeypatch):
     assert got
     assert any("synthetic kernel failure" in r.message
                for r in caplog.records), caplog.records
+
+
+# ------------------------- r3 broadened eligibility (VERDICT r2 item 2)
+
+def _general_query(engine, q, monkeypatch):
+    """Run q with the fused peephole disabled entirely."""
+    from filodb_tpu.query import exec as exec_mod
+    with pytest.MonkeyPatch.context() as mp:
+        mp.setattr(exec_mod.MultiSchemaPartitionsExec, "_try_fused",
+                   lambda self, d, s: None)
+        return _query(engine, q)
+
+
+def _fused_all():
+    return (registry.counter("leaf_fused_kernel").value
+            + registry.counter("leaf_fused_count_host").value
+            + registry.counter("leaf_fused_minmax").value)
+
+
+@pytest.mark.parametrize("agg", ["avg", "min", "max", "count"])
+def test_fused_broadened_rate_aggs(fused_env, agg, monkeypatch):
+    """avg/min/max/count by () over rate through the fused path must match
+    the general path."""
+    engine = _mk_engine([counter_batch(48, T, start_ms=START_MS)])
+    q = f'{agg}(rate(request_total{{_ws_="demo"}}[5m])) by (_ns_)'
+    _query(engine, q)                    # warm mirror
+    before = _fused_all()
+    got = _query(engine, q)
+    assert _fused_all() > before, f"{agg} fused path did not engage"
+    want = _general_query(engine, q, monkeypatch)
+    assert set(got) == set(want) and got
+    for k in want:
+        np.testing.assert_allclose(got[k], want[k], rtol=2e-4, atol=1e-3,
+                                   equal_nan=True)
+
+
+@pytest.mark.parametrize("fn,agg", [
+    ("min_over_time", "sum"), ("max_over_time", "min"),
+    ("min_over_time", "avg")])
+def test_fused_minmax_over_time(fn, agg, monkeypatch):
+    """min/max_over_time ride the XLA reduce_window path on any backend —
+    no FILODB_TPU_FUSED_INTERPRET needed."""
+    from filodb_tpu.ingest.generator import gauge_batch
+    engine = _mk_engine([gauge_batch(40, T, start_ms=START_MS)])
+    q = f'{agg}({fn}(heap_usage{{_ws_="demo"}}[5m])) by (_ns_)'
+    _query(engine, q)                    # warm mirror
+    before = registry.counter("leaf_fused_minmax").value
+    got = _query(engine, q)
+    assert registry.counter("leaf_fused_minmax").value > before, \
+        f"{fn} reduce_window path did not engage"
+    want = _general_query(engine, q, monkeypatch)
+    assert set(got) == set(want) and got
+    for k in want:
+        np.testing.assert_allclose(got[k], want[k], rtol=2e-5, atol=1e-4,
+                                   equal_nan=True)
+
+
+@pytest.mark.parametrize("fn,agg", [
+    ("sum_over_time", "sum"), ("avg_over_time", "avg"),
+    ("count_over_time", "sum"), ("min_over_time", "max")])
+def test_fused_ragged_nan_working_set(fused_env, fn, agg, monkeypatch):
+    """NaN-holed values on a shared grid engage the validity-weighted
+    fused kinds and match the general path's NaN semantics."""
+    from filodb_tpu.ingest.generator import gauge_batch
+    batch = gauge_batch(24, T, start_ms=START_MS)
+    vals = batch.columns["value"].copy()
+    rng = np.random.default_rng(9)
+    vals[rng.random(vals.shape) < 0.1] = np.nan
+    vals[2 * T:3 * T] = np.nan           # one fully-absent series
+    batch = RecordBatch(batch.schema, batch.part_keys, batch.part_idx,
+                        batch.timestamps, {"value": vals}, batch.bucket_les)
+    engine = _mk_engine([batch])
+    q = f'{agg}({fn}(heap_usage{{_ws_="demo"}}[5m])) by (_ns_)'
+    _query(engine, q)                    # warm mirror
+    before = _fused_all()
+    got = _query(engine, q)
+    assert _fused_all() > before, f"ragged {fn} fused path did not engage"
+    want = _general_query(engine, q, monkeypatch)
+    assert set(got) == set(want) and got
+    for k in want:
+        np.testing.assert_allclose(got[k], want[k], rtol=2e-4, atol=1e-3,
+                                   equal_nan=True)
+
+
+def test_fused_count_agg_pure_host(fused_env, monkeypatch):
+    """count by (rate(...)) on a dense grid is host-only math."""
+    engine = _mk_engine([counter_batch(30, T, start_ms=START_MS)])
+    q = 'count(rate(request_total{_ws_="demo"}[5m])) by (_ns_)'
+    _query(engine, q)                    # warm mirror
+    before = registry.counter("leaf_fused_count_host").value
+    got = _query(engine, q)
+    assert registry.counter("leaf_fused_count_host").value > before
+    want = _general_query(engine, q, monkeypatch)
+    assert set(got) == set(want) and got
+    for k in want:
+        np.testing.assert_allclose(got[k], want[k], rtol=1e-9,
+                                   equal_nan=True)

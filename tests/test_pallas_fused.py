@@ -129,12 +129,19 @@ def test_prepared_inputs_reuse():
 def test_can_fuse_gate():
     assert can_fuse("rate", "sum", True, True)
     assert can_fuse("increase", "sum", True, True)
-    assert not can_fuse("rate", "avg", True, True)
+    assert can_fuse("rate", "avg", True, True)        # r3: broadened aggs
+    assert can_fuse("rate", "min", True, True)
+    assert can_fuse("rate", "count", True, True)
+    assert not can_fuse("rate", "stddev", True, True)
     assert can_fuse("sum_over_time", "sum", True, True)
     assert can_fuse("avg_over_time", "sum", True, True)
-    assert not can_fuse("min_over_time", "sum", True, True)
-    assert not can_fuse("rate", "sum", False, True)   # ragged grids
-    assert not can_fuse("rate", "sum", True, False)   # NaN holes
+    assert can_fuse("min_over_time", "sum", True, True)  # reduce_window
+    assert can_fuse("count_over_time", "max", True, True)
+    assert not can_fuse("rate", "sum", False, True)   # no shared grid
+    assert not can_fuse("rate", "sum", True, False)   # NaN holes: no rate
+    assert can_fuse("sum_over_time", "sum", True, False)   # ragged ok
+    assert can_fuse("min_over_time", "avg", True, False)
+    assert not can_fuse("last_over_time", "sum", True, False)
 
 
 @pytest.mark.parametrize("fn", ["sum_over_time", "avg_over_time"])
@@ -172,3 +179,242 @@ def _xla_overtime(ts_row, vals32, vbase, gids, wends, range_ms, fn, G):
         jnp.asarray(wends.astype(np.int32)), range_ms, fn,
         shared_grid=True, vbase=jnp.asarray(vbase))
     return np.asarray(agg_ops.aggregate("sum", r, jnp.asarray(gids), G))
+
+
+# ------------------------- r3 broadened eligibility (VERDICT r2 item 2)
+
+def _general(ts_row, vals32, vbase, gids, wends, range_ms, fn, agg, G,
+             precor=False):
+    """General XLA path (oracle-verified elsewhere) for any (fn, agg)."""
+    S, T = vals32.shape
+    ts_off = to_offsets(np.tile(ts_row, (S, 1)), np.full(S, T), 0)
+    r = evaluate_range_function(
+        jnp.asarray(ts_off), jnp.asarray(vals32),
+        jnp.asarray(wends.astype(np.int32)), range_ms, fn,
+        shared_grid=True, vbase=jnp.asarray(vbase.astype(np.float32)),
+        precorrected=precor)
+    return np.asarray(agg_ops.aggregate(agg, r, jnp.asarray(gids), G))
+
+
+@pytest.mark.parametrize("fn,agg", [
+    ("rate", "avg"), ("rate", "min"), ("rate", "max"), ("rate", "count"),
+    ("increase", "avg"), ("delta", "max"), ("sum_over_time", "min"),
+    ("avg_over_time", "max"), ("last_over_time", "avg")])
+def test_fused_leaf_agg_broadened_dense(fn, agg):
+    from filodb_tpu.ops.pallas_fused import fused_leaf_agg
+    ts_row, raw, gids = _mk(S=96, T=120)
+    G, range_ms = 5, 30 * START_STEP
+    wends = make_window_ends(40 * START_STEP, 110 * START_STEP,
+                             6 * START_STEP)
+    plan = build_plan(ts_row, wends, range_ms)
+    precor = fn in ("rate", "increase")
+    reb, vbase = rebase_values(raw, precor)
+    vals32, vb32 = reb.astype(np.float32), vbase.astype(np.float32)
+    prep = pad_inputs(vals32, vb32, gids, plan, G)
+    comp = fused_leaf_agg(plan, prep, gids, G, fn, agg,
+                          precorrected=precor, interpret=True)
+    got = np.asarray(agg_ops.present(agg, jnp.asarray(comp)))
+    want = _general(ts_row, vals32, vb32, gids, wends, range_ms, fn, agg,
+                    G, precor)
+    assert (np.isnan(got) == np.isnan(want)).all()
+    np.testing.assert_allclose(got, want, rtol=5e-4, atol=1e-3,
+                               equal_nan=True)
+
+
+@pytest.mark.parametrize("fn,agg", [
+    ("sum_over_time", "sum"), ("sum_over_time", "min"),
+    ("avg_over_time", "avg"), ("avg_over_time", "sum"),
+    ("count_over_time", "sum"), ("count_over_time", "count")])
+def test_fused_leaf_agg_ragged_nan(fn, agg):
+    """Validity-weighted kernel on a shared grid with NaN holes must match
+    the general path's NaN semantics exactly."""
+    from filodb_tpu.ops.pallas_fused import fused_leaf_agg
+    ts_row, raw, gids = _mk(S=64, T=100, resets=False)
+    rng = np.random.default_rng(11)
+    holes = rng.random(raw.shape) < 0.15
+    raw = raw.copy()
+    raw[holes] = np.nan
+    raw[7, :] = np.nan                   # one fully-absent series
+    G, range_ms = 5, 30 * START_STEP
+    wends = make_window_ends(40 * START_STEP, 90 * START_STEP,
+                             6 * START_STEP)
+    plan = build_plan(ts_row, wends, range_ms)
+    vals32 = raw.astype(np.float32)
+    vb32 = np.zeros(raw.shape[0], np.float32)
+    prep = pad_inputs(vals32, vb32, gids, plan, G)
+    comp = fused_leaf_agg(plan, prep, gids, G, fn, agg, interpret=True,
+                          ragged=True)
+    got = np.asarray(agg_ops.present(agg, jnp.asarray(comp)))
+    want = _general(ts_row, vals32, vb32, gids, wends, range_ms, fn, agg, G)
+    assert (np.isnan(got) == np.isnan(want)).all()
+    np.testing.assert_allclose(got, want, rtol=5e-4, atol=1e-3,
+                               equal_nan=True)
+
+
+def test_fused_leaf_agg_ragged_vbase_avg():
+    """Ragged avg_over_time with a non-zero vbase must not leak the base
+    into absent cells (the `out * pres` guard)."""
+    from filodb_tpu.ops.pallas_fused import fused_leaf_agg
+    ts_row, raw, gids = _mk(S=32, T=80, resets=False)
+    raw = raw + 1e8                      # large absolute values -> rebase
+    raw[3, 10:70] = np.nan
+    G, range_ms = 4, 30 * START_STEP
+    wends = make_window_ends(40 * START_STEP, 75 * START_STEP,
+                             6 * START_STEP)
+    plan = build_plan(ts_row, wends, range_ms)
+    reb, vbase = rebase_values(raw, False)
+    vals32, vb32 = reb.astype(np.float32), vbase.astype(np.float32)
+    prep = pad_inputs(vals32, vb32, gids, plan, G)
+    comp = fused_leaf_agg(plan, prep, gids, G, "avg_over_time", "min",
+                          interpret=True, ragged=True)
+    got = np.asarray(agg_ops.present("min", jnp.asarray(comp)))
+    want = _general(ts_row, vals32, vb32, gids, wends, range_ms,
+                    "avg_over_time", "min", G)
+    assert (np.isnan(got) == np.isnan(want)).all()
+    np.testing.assert_allclose(got, want, rtol=5e-4, atol=1.0,
+                               equal_nan=True)
+
+
+@pytest.mark.parametrize("fn,agg,ragged", [
+    ("min_over_time", "sum", False), ("min_over_time", "min", False),
+    ("max_over_time", "max", False), ("max_over_time", "avg", True),
+    ("min_over_time", "count", True)])
+def test_fused_minmax_reduce_window(fn, agg, ragged):
+    """The XLA reduce_window path vs the general masked-broadcast path."""
+    from filodb_tpu.ops.pallas_fused import (fused_minmax_agg,
+                                             uniform_window_geometry)
+    ts_row, raw, gids = _mk(S=48, T=100, resets=False)
+    if ragged:
+        rng = np.random.default_rng(3)
+        raw = raw.copy()
+        raw[rng.random(raw.shape) < 0.2] = np.nan
+    G, range_ms = 5, 30 * START_STEP
+    wends = make_window_ends(40 * START_STEP, 90 * START_STEP,
+                             6 * START_STEP)
+    geom = uniform_window_geometry(ts_row, wends, range_ms)
+    assert geom is not None
+    f0, stride, width, t_needed = geom
+    assert t_needed <= raw.shape[1]
+    vals32 = raw.astype(np.float32)
+    comp = fused_minmax_agg(jnp.asarray(vals32), None,
+                            jnp.asarray(gids), f0, stride, width,
+                            len(wends), fn, agg, G, ragged)
+    got = np.asarray(agg_ops.present(agg, jnp.asarray(comp)))
+    want = _general(ts_row, vals32, np.zeros(raw.shape[0], np.float32),
+                    gids, wends, range_ms, fn, agg, G)
+    assert (np.isnan(got) == np.isnan(want)).all()
+    np.testing.assert_allclose(got, want, rtol=5e-5, atol=1e-3,
+                               equal_nan=True)
+
+
+def test_uniform_window_geometry_gate():
+    from filodb_tpu.ops.pallas_fused import uniform_window_geometry
+    ts_row = np.arange(100, dtype=np.int64) * 10_000
+    wends = make_window_ends(300_000, 900_000, 60_000)
+    geom = uniform_window_geometry(ts_row, wends, 300_000)
+    assert geom is not None and geom[1] == 6 and geom[2] == 30
+    # left-clipped first window -> non-uniform -> None
+    wends_bad = make_window_ends(100_000, 900_000, 60_000)
+    assert uniform_window_geometry(ts_row, wends_bad, 300_000) is None
+    # irregular scrape grid -> None
+    ts_bad = ts_row.copy()
+    ts_bad[50:] += 3_000
+    assert uniform_window_geometry(ts_bad, wends, 300_000) is None
+    # step not a multiple of the scrape interval -> None
+    wends_frac = make_window_ends(300_000, 900_000, 15_000)
+    assert uniform_window_geometry(ts_row, wends_frac, 300_000) is None
+    # windows past the end of the grid stay uniform: t_needed says how
+    # many NaN-padded columns the caller must supply
+    wends_off = make_window_ends(300_000, 1_200_000, 60_000)
+    geom_off = uniform_window_geometry(ts_row, wends_off, 300_000)
+    assert geom_off is not None and geom_off[3] == 121
+
+
+def test_fused_minmax_right_edge_padding():
+    """Windows hanging past the last sample (end=now) must match the
+    general path through the NaN-padded ragged variant."""
+    from filodb_tpu.ops.pallas_fused import (fused_minmax_agg,
+                                             uniform_window_geometry)
+    ts_row, raw, gids = _mk(S=24, T=100, resets=False)
+    G, range_ms = 5, 30 * START_STEP
+    wends = make_window_ends(40 * START_STEP, 108 * START_STEP,
+                             6 * START_STEP)
+    geom = uniform_window_geometry(ts_row, wends, range_ms)
+    assert geom is not None
+    f0, stride, width, t_needed = geom
+    assert t_needed > raw.shape[1]
+    vals32 = raw.astype(np.float32)
+    padded = np.pad(vals32, ((0, 0), (0, t_needed - raw.shape[1])),
+                    constant_values=np.nan)
+    comp = fused_minmax_agg(jnp.asarray(padded), None, jnp.asarray(gids),
+                            f0, stride, width, len(wends),
+                            "max_over_time", "sum", G, ragged=True)
+    got = np.asarray(agg_ops.present("sum", jnp.asarray(comp)))
+    want = _general(ts_row, vals32, np.zeros(raw.shape[0], np.float32),
+                    gids, wends, range_ms, "max_over_time", "sum", G)
+    assert (np.isnan(got) == np.isnan(want)).all()
+    np.testing.assert_allclose(got, want, rtol=5e-5, atol=1e-3,
+                               equal_nan=True)
+
+
+def test_fused_large_ts_offset_precision():
+    """ts offsets near the 2^30 guard (f32 ulp there is 64 ms): the
+    extrapolation thresholds must stay within tolerance of the f64 oracle
+    (ADVICE r2 — previously only ~2.4e6 ms offsets were exercised)."""
+    import sys
+    sys.path.insert(0, "tests")
+    from oracle import eval_series
+
+    S, T, G = 8, 120, 2
+    base_off = (1 << 30) - 140 * START_STEP     # ~12.4 days from base
+    ts_row = base_off + np.arange(T, dtype=np.int64) * START_STEP
+    rng = np.random.default_rng(2)
+    raw = np.cumsum(rng.exponential(10.0, size=(S, T)), axis=1)
+    gids = (np.arange(S) % G).astype(np.int32)
+    range_ms = 30 * START_STEP
+    wends = base_off + make_window_ends(40 * START_STEP, 110 * START_STEP,
+                                        6 * START_STEP)
+    assert wends.max() < (1 << 30)               # inside the eval guard
+    plan = build_plan(ts_row, wends, range_ms)
+    reb, vbase = rebase_values(raw, True)
+    sums, counts = fused_rate_groupsum(
+        reb.astype(np.float32), vbase.astype(np.float32), gids, plan, G,
+        fn_name="rate", precorrected=True, interpret=True)
+    got = present_sum(sums, counts)
+    # f64 oracle, group-summed
+    want = np.zeros((G, len(wends)))
+    for s in range(S):
+        want[gids[s]] += eval_series(ts_row, raw[s], wends, range_ms,
+                                     "rate")
+    np.testing.assert_allclose(got, want, rtol=2e-3, atol=1e-4)
+
+
+@pytest.mark.parametrize("fn", ["min_over_time", "max_over_time"])
+def test_minmax_inf_samples_not_absent(fn):
+    """+/-Inf are legal sample values: a window whose valid samples are all
+    +Inf must emit +Inf from min/max_over_time, not absent (review r3)."""
+    from filodb_tpu.ops.pallas_fused import (fused_minmax_agg,
+                                             uniform_window_geometry)
+    S, T, G = 4, 60, 2
+    ts_row = np.arange(T, dtype=np.int64) * START_STEP
+    raw = np.full((S, T), np.inf, np.float32)
+    raw[2] = 1.5                         # one finite series
+    raw[3, ::2] = np.nan                 # ragged series with inf holes
+    raw[3, 1::2] = np.inf
+    gids = (np.arange(S) % G).astype(np.int32)
+    range_ms = 30 * START_STEP
+    wends = make_window_ends(40 * START_STEP, 55 * START_STEP,
+                             6 * START_STEP)
+    geom = uniform_window_geometry(ts_row, wends, range_ms)
+    f0, stride, width, _ = geom
+    for agg in ("min", "max"):
+        comp = fused_minmax_agg(jnp.asarray(raw), None, jnp.asarray(gids),
+                                f0, stride, width, len(wends),
+                                fn, agg, G, ragged=True)
+        got = np.asarray(agg_ops.present(agg, jnp.asarray(comp)))
+        want = _general(ts_row, raw, np.zeros(S, np.float32), gids, wends,
+                        range_ms, fn, agg, G)
+        assert (np.isnan(got) == np.isnan(want)).all(), (agg, got, want)
+        np.testing.assert_allclose(got, want, equal_nan=True)
+        # group 1 = {all-inf series, nan/inf series} -> +inf, never NaN
+        assert np.isinf(got[1]).all(), got

@@ -545,3 +545,34 @@ def test_ha_planner_routes_pinned_failures_remote():
     out2 = planner.materialize(
         _plan(f"foo @ {at_ms // 1000 + 7200}", T2), QueryContext())
     assert isinstance(out2, _Dummy) and out2.tag == "local"
+
+
+def test_multi_partition_pinned_spanning_partitions_errors():
+    """A pinned (@) read whose data range spans partitions must raise,
+    not silently evaluate locally with partial data (ADVICE r2)."""
+    local = _RecordingPlanner("local")
+    start_ms = START_S * 1000
+    mid = start_ms + 1800_000
+    prov = _Provider([
+        PartitionAssignment("remote-p", "http://p2/api",
+                            TimeRange(0, mid - 1)),
+        PartitionAssignment("local", "", TimeRange(mid, start_ms + 10**9)),
+    ])
+    mp = MultiPartitionPlanner(prov, "local", local)
+    p = _plan(f'rate(foo[5m] @ {START_S + 600})')
+    with pytest.raises(ValueError, match="pinned"):
+        mp.materialize(p, QueryContext())
+
+
+def test_multi_partition_pinned_single_remote_still_routes():
+    """A pinned read wholly inside one remote partition routes there."""
+    local = _RecordingPlanner("local")
+    start_ms = START_S * 1000
+    prov = _Provider([
+        PartitionAssignment("remote-p", "http://p2/api",
+                            TimeRange(0, start_ms + 10**9)),
+    ])
+    mp = MultiPartitionPlanner(prov, "local", local)
+    p = _plan(f'rate(foo[5m] @ {START_S + 600})')
+    out = mp.materialize(p, QueryContext())
+    assert isinstance(out, PromQlRemoteExec)
