@@ -401,6 +401,92 @@ def bench_histogram(quick: bool):
     _emit("histogram", "quantile_qps", 1 / per, "queries/s", series=S)
 
 
+def bench_histogram_compression(quick: bool):
+    """Histogram storage-format efficiency, the HistogramCompressor
+    harness analogue (ref: memory/.../HistogramCompressor.scala:1-216;
+    doc/compression.md:97 claims ~50x vs the traditional per-bucket
+    Prometheus data model at 64 buckets).  Measures bytes/histogram-sample
+    for: the per-bucket time-series model, BinaryHistogram ingest blobs,
+    the section-based appendable vector, and the sealed 2D-delta matrix
+    codec."""
+    import numpy as np
+
+    from filodb_tpu.core.partkey import PartKey
+    from filodb_tpu.memory.binhist import (AppendableSectHistVector,
+                                           encode_blob_column)
+    from filodb_tpu.memory.histogram import encode_hist_matrix
+
+    B = 64
+    T = 300 if quick else 2_000
+    rng = np.random.default_rng(9)
+    # busy + quiet mixture like real request-latency histograms
+    rate = np.where(rng.random(B) < 0.3, 8.0, 0.2)
+    inc = rng.poisson(rate, size=(T, B))
+    per_bucket = np.cumsum(inc, axis=0)
+    mat = np.cumsum(per_bucket, axis=1).astype(np.float64)
+    les = 2.0 * 2.0 ** np.arange(B)
+
+    # traditional prom data model: one series per bucket; each sample is
+    # (ts i64 + value f64) plus the bucket series' part key amortized
+    labels = {"_ws_": "demo", "_ns_": "App-0", "instance": "host-1",
+              "path": "/api/v1/query"}
+    pk_bytes = sum(
+        len(PartKey.make("http_latency_bucket",
+                         dict(labels, le=str(le))).to_bytes())
+        for le in les)
+    bucket_series_bytes = T * B * 16 + pk_bytes
+    per_hist_bucket_series = bucket_series_bytes / T
+
+    blob_bytes = len(encode_blob_column(mat, les))
+    vec = AppendableSectHistVector(les)
+    for row in mat:
+        vec.append(row)
+    sealed_bytes = len(encode_hist_matrix(mat))
+
+    per_hist_blob = blob_bytes / T
+    _emit("hist_compression", "bucket_series_bytes_per_hist",
+          per_hist_bucket_series, "bytes", buckets=B)
+    _emit("hist_compression", "binhist_blob_bytes_per_hist", per_hist_blob,
+          "bytes", buckets=B,
+          vs_bucket_series=round(per_hist_bucket_series / per_hist_blob, 1))
+    _emit("hist_compression", "section_vector_bytes_per_hist",
+          vec.num_bytes / T, "bytes", buckets=B,
+          vs_bucket_series=round(per_hist_bucket_series
+                                 / (vec.num_bytes / T), 1))
+    _emit("hist_compression", "sealed_2d_delta_bytes_per_hist",
+          sealed_bytes / T, "bytes", buckets=B,
+          vs_bucket_series=round(per_hist_bucket_series
+                                 / (sealed_bytes / T), 1))
+
+
+def bench_cardinality(quick: bool):
+    """Cardinality store at the reference's millions-of-prefixes scale
+    (ref: RocksDbCardinalityStore.scala:256): batched write throughput,
+    flush cost, and top-k query latency on the durable SQLite store."""
+    import tempfile
+
+    from filodb_tpu.core.ratelimit import (CardinalityRecord,
+                                           SqliteCardinalityStore)
+    n = 50_000 if quick else 1_000_000
+    path = tempfile.mktemp(prefix="filodb_card_bench_", suffix=".db")
+    store = SqliteCardinalityStore(path, flush_every=4096)
+    t0 = time.perf_counter()
+    for i in range(n):
+        store.write(CardinalityRecord(
+            ("demo", f"ns-{i % 1000}", f"app-{i}"), ts_count=i % 97 + 1))
+    store.flush()
+    wall = time.perf_counter() - t0
+    _emit("cardinality", "writes_per_sec", n / wall, "ops/s", prefixes=n)
+    t0 = time.perf_counter()
+    kids = store.scan_children(("demo", "ns-7"))
+    scan_s = time.perf_counter() - t0
+    _emit("cardinality", "scan_children_latency_ms", scan_s * 1000, "ms",
+          children=len(kids))
+    store.close()
+    import os as _os
+    _os.unlink(path)
+
+
 def bench_memory(quick: bool):
     """Resident memory per series after sealing history to the compressed
     tier (ref: doc/ingestion.md:110 '1.5 million time series fit within
@@ -581,6 +667,8 @@ BENCHES: Dict[str, Callable[[bool], None]] = {
     "partition_list": bench_partition_list,
     "query_under_ingest": bench_query_under_ingest,
     "histogram": bench_histogram,
+    "hist_compression": bench_histogram_compression,
+    "cardinality": bench_cardinality,
 }
 
 

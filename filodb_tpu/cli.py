@@ -319,6 +319,64 @@ def cmd_decodevector(args) -> int:
     return 0
 
 
+def cmd_decodechunkinfo(args) -> int:
+    """Decode a hex chunkset frame's metadata (ref: CliMain
+    `decodeChunkInfo --hexchunkinfo` — the chunk-info struct decoder)."""
+    import json as _json
+
+    from filodb_tpu.persist.localstore import _decode_chunkset_frame
+    raw = bytes.fromhex(args.hexframe.removeprefix("0x"))
+    pk_bytes, schema_name, cs = _decode_chunkset_frame(raw)
+    from filodb_tpu.core.partkey import PartKey
+    pk = PartKey.from_bytes(pk_bytes)
+    print(_json.dumps({
+        "partKey": {"metric": pk.metric, **pk.tags_dict},
+        "schema": schema_name,
+        "chunkId": cs.info.chunk_id,
+        "ingestionTime": cs.info.ingestion_time_ms,
+        "numRows": cs.info.num_rows,
+        "startTime": cs.info.start_time_ms,
+        "endTime": cs.info.end_time_ms,
+        "numBytes": cs.nbytes,
+        "encodings": {n: c.kind for n, c in cs.columns.items()},
+    }, indent=1))
+    return 0
+
+
+def cmd_chunkinfos(args) -> int:
+    """Per-chunk metadata for the series a PromQL filter selects, via the
+    SelectChunkInfosExec debug plan over a recovered shard (ref:
+    query/.../exec/SelectChunkInfosExec.scala)."""
+    import json as _json
+
+
+    from filodb_tpu.core.memstore import TimeSeriesMemStore
+    from filodb_tpu.persist.localstore import (LocalDiskColumnStore,
+                                               LocalDiskMetaStore)
+    from filodb_tpu.promql.parser import query_to_logical_plan
+    from filodb_tpu.query.logical import raw_series_filters
+    from filodb_tpu.query.exec import SelectChunkInfosExec
+    from filodb_tpu.query.rangevector import QueryContext
+    try:
+        filter_sets = raw_series_filters(
+            query_to_logical_plan(args.filter, 0))
+        filters = list(filter_sets[0]) if filter_sets else []
+    except Exception as e:  # noqa: BLE001
+        print(f"parse error: {e}", file=sys.stderr)
+        return 1
+    cs = LocalDiskColumnStore(os.path.join(args.data_dir, "chunks"))
+    meta = LocalDiskMetaStore(os.path.join(args.data_dir, "chunks"))
+    ms = TimeSeriesMemStore(column_store=cs, meta_store=meta)
+    shard = ms.setup(args.dataset, args.shard)
+    shard.recover_index()
+    plan = SelectChunkInfosExec(QueryContext(), args.dataset, args.shard,
+                                filters, 0, 1 << 62)
+    res, _stats = plan._do_execute(ms)
+    for row in (res.data or [])[:args.limit]:
+        print(_json.dumps(row))
+    return 0
+
+
 def cmd_serve(args) -> int:
     """Run the standalone server (ref: FiloServer.scala:39)."""
     from filodb_tpu.persist.localstore import (LocalDiskColumnStore,
@@ -429,6 +487,20 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--shard", type=int, default=0)
     sp.add_argument("--limit", type=int, default=10)
     sp.set_defaults(fn=cmd_decodechunks)
+
+    sp = sub.add_parser("decodechunkinfo",
+                        help="decode a hex chunkset frame's metadata")
+    sp.add_argument("hexframe", help="hex bytes of a chunkset frame")
+    sp.set_defaults(fn=cmd_decodechunkinfo)
+
+    sp = sub.add_parser("chunkinfos",
+                        help="per-chunk metadata for a PromQL filter "
+                             "(SelectChunkInfos debug plan)")
+    common(sp)
+    sp.add_argument("filter", help='e.g. \'m{_ws_="demo"}\'')
+    sp.add_argument("--shard", type=int, default=0)
+    sp.add_argument("--limit", type=int, default=50)
+    sp.set_defaults(fn=cmd_chunkinfos)
 
     sp = sub.add_parser("partkey",
                         help="PromQL filter -> partkey bytes + shard routing")

@@ -21,7 +21,12 @@ from filodb_tpu.core.partkey import PartKey
 from filodb_tpu.core.schemas import Schema, Schemas, DEFAULT_SCHEMAS
 
 _MAGIC = b"FTRB"
-_VERSION = 1
+# v2: histogram columns ship as concatenated NibblePack'd BinaryHistogram
+# blobs (memory/binhist.py) instead of raw f64 matrices — the reference's
+# ingest wire format (ref: HistogramVector.scala:17-34 BinaryHistogram),
+# typically ~5-10x smaller on the gateway->broker->node hop.  v1 frames
+# are still read (already-written broker logs / fixtures).
+_VERSION = 2
 
 
 @dataclasses.dataclass
@@ -66,8 +71,10 @@ class RecordBatch:
         for c in self.schema.data_columns:
             arr = np.asarray(self.columns[c.name])
             if c.col_type == "hist":
-                buf.write(struct.pack("<HI", arr.shape[1], arr.size * 8))
-                buf.write(arr.astype(np.float64).tobytes())
+                from filodb_tpu.memory.binhist import encode_blob_column
+                blobs = encode_blob_column(arr, self.bucket_les)
+                buf.write(struct.pack("<HI", arr.shape[1], len(blobs)))
+                buf.write(blobs)
             else:
                 buf.write(struct.pack("<HI", 0, n * 8))
                 buf.write(arr.astype(np.float64).tobytes())
@@ -85,6 +92,8 @@ class RecordBatch:
         if magic != _MAGIC:
             raise ValueError("bad record batch magic")
         version, schema_id = struct.unpack("<HH", buf.read(4))
+        if version not in (1, 2):
+            raise ValueError(f"unsupported record batch version {version}")
         schema = schemas.by_id[schema_id]
         (npk,) = struct.unpack("<I", buf.read(4))
         part_keys: List[PartKey] = []
@@ -98,8 +107,15 @@ class RecordBatch:
         columns: Dict[str, np.ndarray] = {}
         for c in schema.data_columns[:ncols]:
             nbuckets, nbytes = struct.unpack("<HI", buf.read(6))
-            raw = np.frombuffer(buf.read(nbytes), dtype=np.float64).copy()
-            columns[c.name] = raw.reshape(n, nbuckets) if nbuckets else raw
+            if nbuckets and version >= 2:
+                from filodb_tpu.memory.binhist import decode_blob_column
+                mat, _ = decode_blob_column(buf.read(nbytes), n)
+                columns[c.name] = mat
+            else:
+                raw = np.frombuffer(buf.read(nbytes),
+                                    dtype=np.float64).copy()
+                columns[c.name] = (raw.reshape(n, nbuckets)
+                                   if nbuckets else raw)
         (nles,) = struct.unpack("<H", buf.read(2))
         les = (np.frombuffer(buf.read(8 * nles), dtype=np.float64).copy()
                if nles else None)

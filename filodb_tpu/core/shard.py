@@ -369,6 +369,9 @@ class TimeSeriesShard:
             self.column_store.write_part_keys(self.dataset, self.shard_num, dirty)
         self.meta_store.write_checkpoint(
             self.dataset, self.shard_num, group, offset_snapshot)
+        if self.cardinality_tracker is not None:
+            # buffered cardinality updates persist with the checkpoint
+            self.cardinality_tracker.flush()
         self.stats.chunks_flushed += written
         self.stats.flushes += 1
         return written

@@ -209,11 +209,20 @@ _SPECIAL_HIST_KEYS = ("sum", "count")
 
 def influx_lines_to_batches(lines: Iterable[str],
                             schemas: Schemas = DEFAULT_SCHEMAS,
-                            now_ms: Optional[int] = None) -> List[RecordBatch]:
+                            now_ms: Optional[int] = None,
+                            drops: Optional[Dict[str, int]] = None
+                            ) -> List[RecordBatch]:
     """Convert parsed lines into per-schema RecordBatches (the gateway's
-    InputRecord → RecordBuilder container step, ref: GatewayServer.scala:101-115)."""
+    InputRecord → RecordBuilder container step, ref: GatewayServer.scala:101-115).
+
+    `drops` (optional dict) is bumped per drop REASON — the per-error
+    visibility the reference's InfluxProtocolParser logs per line."""
     builders: Dict[str, RecordBatchBuilder] = {}
     hist_les: Optional[np.ndarray] = None
+
+    def drop(reason: str) -> None:
+        if drops is not None:
+            drops[reason] = drops.get(reason, 0) + 1
 
     def builder(schema_name: str) -> RecordBatchBuilder:
         b = builders.get(schema_name)
@@ -225,9 +234,13 @@ def influx_lines_to_batches(lines: Iterable[str],
     for line in lines:
         rec = parse_influx_line(line, now_ms)
         if rec is None:
+            s = line.strip()
+            if s and not s.startswith("#"):
+                drop("parse_error")
             continue
         numeric = {k: v for k, v in rec.fields.items() if isinstance(v, float)}
         if not numeric:
+            drop("no_numeric_fields")
             continue
         pk = PartKey.make(rec.measurement, rec.tags)
         if len(numeric) == 1:
@@ -254,6 +267,7 @@ def influx_lines_to_batches(lines: Iterable[str],
                     got_inf = got_inf or math.isinf(top)
                     buckets.append((top, v))
             if not got_inf or not buckets:
+                drop("histogram_missing_inf_bucket")
                 continue
             buckets.sort(key=lambda bv: bv[0])
             les = np.asarray([b[0] for b in buckets])
@@ -262,6 +276,7 @@ def influx_lines_to_batches(lines: Iterable[str],
             if b._les is None:
                 b.set_bucket_les(les)
             elif len(b._les) != len(les) or not np.array_equal(b._les, les):
+                drop("histogram_scheme_mismatch")
                 continue                # one scheme per batch; drop outliers
             b.add(pk, rec.ts_ms, sum=hsum, count=hcount, h=vals)
     return [b.build() for b in builders.values()]

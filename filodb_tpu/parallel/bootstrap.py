@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import json
 import socket
+import urllib.error
 import urllib.request
 from typing import List, Optional, Sequence, Tuple
 
@@ -80,6 +81,77 @@ class HttpMembersSeedDiscovery(ClusterSeedDiscovery):
                 # discovery must degrade to self-seeding, never crash
                 continue
         return []
+
+
+class ConsulSeedDiscovery(ClusterSeedDiscovery):
+    """Consul-backed seed discovery (ref: akka-bootstrapper/.../
+    ConsulClient.scala:29 + DnsSrvClusterSeedDiscovery.scala:95
+    ConsulClusterSeedDiscovery): a joining node REGISTERS itself with the
+    local Consul agent and discovers live seeds from Consul's catalog.
+    The reference resolves seeds through Consul's DNS-SRV interface; this
+    client uses the equivalent HTTP health API
+    (GET /v1/health/service/<name>?passing=true) so no SRV resolver
+    dependency is needed — same catalog, same liveness filter."""
+
+    def __init__(self, service_name: str,
+                 consul_host: str = "127.0.0.1", consul_port: int = 8500,
+                 timeout_s: float = 5.0):
+        self.service_name = service_name
+        self.base = f"http://{consul_host}:{consul_port}"
+        self.timeout_s = timeout_s
+        self._service_id: Optional[str] = None
+
+    def register(self, host: str, port: int) -> str:
+        """PUT /v1/agent/service/register (ref: ConsulClient.register:38).
+        Returns the service id used for deregistration."""
+        service_id = f"{self.service_name}-{host}-{port}"
+        payload = json.dumps({"id": service_id, "name": self.service_name,
+                              "address": host, "port": port}).encode()
+        req = urllib.request.Request(
+            f"{self.base}/v1/agent/service/register", data=payload,
+            method="PUT", headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout_s):
+                pass
+        except urllib.error.HTTPError as e:
+            raise RuntimeError(
+                f"consul registration failed: HTTP {e.code} {e.reason}"
+            ) from e
+        self._service_id = service_id
+        return service_id
+
+    def deregister(self) -> None:
+        """PUT /v1/agent/service/deregister/<id> (ref:
+        ConsulClient.deregister:50) — the reference runs this from a
+        shutdown hook."""
+        if self._service_id is None:
+            return
+        req = urllib.request.Request(
+            f"{self.base}/v1/agent/service/deregister/{self._service_id}",
+            data=b"", method="PUT")
+        with urllib.request.urlopen(req, timeout=self.timeout_s):
+            pass
+        self._service_id = None
+
+    def discover(self) -> List[Address]:
+        try:
+            with urllib.request.urlopen(
+                    f"{self.base}/v1/health/service/{self.service_name}"
+                    f"?passing=true", timeout=self.timeout_s) as r:
+                entries = json.loads(r.read())
+        except (OSError, ValueError):
+            return []               # agent down: degrade to self-seeding
+        out: List[Address] = []
+        for e in entries:
+            try:
+                svc = e["Service"]
+                host = svc.get("Address") or e.get("Node", {}).get("Address")
+                if not host:
+                    continue             # malformed entry: skip, don't crash
+                out.append((host, int(svc["Port"])))
+            except (KeyError, TypeError, ValueError):
+                continue
+        return out
 
 
 def bootstrap(discovery: ClusterSeedDiscovery, self_addr: Address,

@@ -220,3 +220,50 @@ def test_cli_partkey_equality_only(capsys):
     assert "host" not in out.split("partKey")[1].splitlines()[0]
     # a metric pinned only by != is rejected
     assert main(["partkey", '{__name__!="x",_ws_="demo"}']) == 1
+
+
+def test_cli_chunkinfos_and_decodechunkinfo(tmp_path, capsys):
+    """SelectChunkInfos debug plan via CLI + hex chunk-frame decoding
+    (ref: SelectChunkInfosExec.scala, CliMain decodeChunkInfo)."""
+    import json
+
+    from filodb_tpu.cli import main
+    data_dir = str(tmp_path / "data")
+    main(["init", "--data-dir", data_dir])
+    csv = tmp_path / "in.csv"
+    rows = ["metric,tags,timestamp,value"]
+    for i in range(60):
+        rows.append(f"cpu_load,host=h{i % 3},{START + i * 10_000},{i * 1.5}")
+    csv.write_text("\n".join(rows))
+    assert main(["importcsv", "--data-dir", data_dir,
+                 "--file", str(csv)]) == 0
+    capsys.readouterr()
+
+    assert main(["chunkinfos", "--data-dir", data_dir,
+                 'cpu_load{host="h1"}']) == 0
+    out = capsys.readouterr().out.strip().splitlines()
+    assert out, "chunkinfos printed nothing"
+    infos = [json.loads(line) for line in out]
+    assert all(i["_metric_"] == "cpu_load" and i["host"] == "h1"
+               for i in infos)
+    assert any(i["tier"] in ("resident", "persisted") for i in infos)
+    assert all(i["numRows"] > 0 and i["endTime"] >= i["startTime"]
+               for i in infos)
+    assert any("ts-dd" in str(i["encodings"].values()) or i["encodings"]
+               for i in infos)
+
+    # decodechunkinfo: hex frame -> metadata json
+    from filodb_tpu.core.partkey import PartKey
+    from filodb_tpu.memory.chunks import encode_chunkset
+    from filodb_tpu.persist.localstore import _encode_chunkset_frame
+    import numpy as np
+    ts = START + np.arange(20, dtype=np.int64) * 10_000
+    cs = encode_chunkset(ts, {"value": np.arange(20) * 2.0},
+                         {"value": "double"}, START)
+    frame = _encode_chunkset_frame(
+        PartKey.make("cpu_load", {"host": "h1"}), "gauge", cs)
+    assert main(["decodechunkinfo", frame.hex()]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["partKey"]["metric"] == "cpu_load"
+    assert doc["numRows"] == 20 and doc["schema"] == "gauge"
+    assert doc["encodings"]

@@ -232,3 +232,78 @@ def test_batch_bundle_preserves_histogram_scheme(tmp_path):
     v1 = np.asarray(list(r1.series())[0][2])
     v2 = np.asarray(list(r2.series())[0][2])
     np.testing.assert_allclose(v2, v1, rtol=1e-12, equal_nan=True)
+
+
+def test_consul_seed_discovery():
+    """Consul register + passing-health discovery against a fake Consul
+    agent (ref: ConsulClient.scala:29, ConsulClusterSeedDiscovery)."""
+    import json
+    import threading
+    from http.server import BaseHTTPRequestHandler, HTTPServer
+
+    from filodb_tpu.parallel.bootstrap import ConsulSeedDiscovery, bootstrap
+
+    services = {}
+
+    class FakeConsul(BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_PUT(self):
+            if self.path == "/v1/agent/service/register":
+                body = json.loads(self.rfile.read(
+                    int(self.headers.get("Content-Length", 0))))
+                services[body["id"]] = body
+            elif self.path.startswith("/v1/agent/service/deregister/"):
+                services.pop(self.path.rsplit("/", 1)[1], None)
+            else:
+                self.send_response(404)
+                self.end_headers()
+                return
+            self.send_response(200)
+            self.end_headers()
+
+        def do_GET(self):
+            if self.path.startswith("/v1/health/service/"):
+                name = self.path.split("/")[-1].split("?")[0]
+                out = [{"Node": {"Address": "fallback"},
+                        "Service": {"ID": s["id"], "Service": s["name"],
+                                    "Address": s["address"],
+                                    "Port": s["port"]}}
+                       for s in services.values() if s["name"] == name]
+                payload = json.dumps(out).encode()
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                self.wfile.write(payload)
+            else:
+                self.send_response(404)
+                self.end_headers()
+
+    srv = HTTPServer(("127.0.0.1", 0), FakeConsul)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        port = srv.server_address[1]
+        d1 = ConsulSeedDiscovery("filodb", consul_port=port)
+        assert d1.discover() == []           # empty catalog
+        d1.register("node-a", 4001)
+        d2 = ConsulSeedDiscovery("filodb", consul_port=port)
+        assert d2.discover() == [("node-a", 4001)]
+        # a second node bootstraps onto the first
+        joined = []
+        seeds = bootstrap(d2, ("node-b", 4002), joined.append)
+        assert seeds == [("node-a", 4001)] and joined == [seeds]
+        d2.register("node-b", 4002)
+        assert sorted(d1.discover()) == [("node-a", 4001),
+                                         ("node-b", 4002)]
+        # deregistration removes the seed (the shutdown-hook contract)
+        d1.deregister()
+        assert d2.discover() == [("node-b", 4002)]
+        # dead agent degrades to self-seeding, never raises
+        srv.shutdown()
+        dead = ConsulSeedDiscovery("filodb", consul_port=port,
+                                   timeout_s=0.2)
+        joined2 = []
+        assert bootstrap(dead, ("me", 1), joined2.append) == [("me", 1)]
+    finally:
+        srv.server_close()

@@ -6,7 +6,8 @@ import urllib.request
 import pytest
 
 from filodb_tpu.core.memstore import TimeSeriesMemStore
-from filodb_tpu.core.ratelimit import (CardinalityTracker,
+from filodb_tpu.core.ratelimit import (CardinalityRecord,
+                                       CardinalityTracker,
                                        InMemoryCardinalityStore,
                                        QuotaReachedException, QuotaSource,
                                        SqliteCardinalityStore)
@@ -140,3 +141,61 @@ def test_cli_topkcard(tmp_path, capsys):
     assert rc == 0
     out = capsys.readouterr().out
     assert "App-" in out
+
+
+def test_sqlite_store_batched_writes_and_flush(tmp_path):
+    """Writes buffer (no per-write commit) and persist on flush()/close();
+    reads and child scans see buffered records (VERDICT r2: RocksDB-style
+    memtable batching instead of commit-per-write)."""
+    path = str(tmp_path / "card.db")
+    store = SqliteCardinalityStore(path, flush_every=1000)
+    for i in range(50):
+        store.write(CardinalityRecord(("demo", f"App-{i}"), ts_count=i + 1))
+    # buffered, not yet committed: a second connection sees nothing
+    other = SqliteCardinalityStore(path)
+    assert other.read(("demo", "App-0")) is None
+    # but THIS store's reads and scans see the buffer
+    assert store.read(("demo", "App-7")).ts_count == 8
+    assert len(store.scan_children(("demo",))) == 50   # scan flushes
+    other2 = SqliteCardinalityStore(path)
+    assert other2.read(("demo", "App-0")).ts_count == 1
+    other.close()
+    other2.close()
+    store.close()
+
+
+def test_sqlite_store_crash_recovery(tmp_path):
+    """Flushed records survive an abrupt crash (connection never closed);
+    the WAL replays on reopen."""
+    path = str(tmp_path / "card.db")
+    store = SqliteCardinalityStore(path, flush_every=10)
+    for i in range(25):                 # crosses two auto-flush boundaries
+        store.write(CardinalityRecord(("ws", f"ns-{i}"), ts_count=i))
+    store.flush()
+    # simulate crash: drop every reference without close()
+    del store._conn
+    del store
+    back = SqliteCardinalityStore(path)
+    assert len(back.scan_children(("ws",))) == 25
+    assert back.read(("ws", "ns-24")).ts_count == 24
+    back.close()
+
+
+def test_tracker_flush_rides_shard_flush(tmp_path):
+    """The shard flush cycle persists buffered cardinality updates."""
+    from filodb_tpu.core.memstore import TimeSeriesMemStore
+    from filodb_tpu.ingest.generator import gauge_batch
+
+    path = str(tmp_path / "card.db")
+    ms = TimeSeriesMemStore()
+    sh = ms.setup("prometheus", 0)
+    store = SqliteCardinalityStore(path, flush_every=1 << 20)  # never auto
+    sh.cardinality_tracker = CardinalityTracker(store=store)
+    sh.ingest(gauge_batch(12, 30))
+    assert store._dirty                 # buffered, not yet persisted
+    sh.flush_all_groups()
+    assert not store._dirty
+    fresh = SqliteCardinalityStore(path)
+    assert fresh.read(("demo",)) is not None
+    fresh.close()
+    store.close()
