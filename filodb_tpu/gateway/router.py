@@ -8,12 +8,9 @@ RecordBatches handed to local shards or serialized for a remote transport.
 """
 from __future__ import annotations
 
-import logging
 from typing import Dict, Iterable, List, Optional
 
 import numpy as np
-
-_log = logging.getLogger("filodb.gateway")
 
 from filodb_tpu.core.records import RecordBatch
 from filodb_tpu.core.schemas import Schemas, DEFAULT_SCHEMAS

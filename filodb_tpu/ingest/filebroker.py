@@ -45,13 +45,12 @@ class FileBackedBroker:
         other producers in THIS process via the lock; cross-process
         single-writer per partition is the deployment contract (exactly
         Kafka's per-partition ordering model).  The per-partition count is
-        cached after one initial scan, so appends are O(1) — not a re-read
-        of the whole log per message."""
+        cached after one initial header-only scan, so appends are O(1)."""
         with self._lock:
             key = (topic, partition)
             offset = self._count_cache.get(key)
             if offset is None:
-                offset = len(self.read_all(topic, partition))
+                offset = self._scan_count(topic, partition)
             with open(self._path(topic, partition), "ab") as f:
                 f.write(len(value).to_bytes(4, "big") + value)
                 if self.fsync:
@@ -60,8 +59,29 @@ class FileBackedBroker:
             self._count_cache[key] = offset + 1
             return offset
 
+    def _scan_count(self, topic: str, partition: int) -> int:
+        """Message count via a header-only scan: read each 4-byte length,
+        seek over the body — O(messages) tiny reads, O(1) memory."""
+        path = self._path(topic, partition)
+        if not os.path.exists(path):
+            return 0
+        n = 0
+        size = os.path.getsize(path)
+        with open(path, "rb") as f:
+            pos = 0
+            while True:
+                hdr = f.read(4)
+                if len(hdr) < 4:
+                    return n
+                body_len = int.from_bytes(hdr, "big")
+                pos += 4 + body_len
+                if pos > size:
+                    return n            # torn tail write
+                f.seek(body_len, 1)
+                n += 1
+
     def end_offset(self, topic: str, partition: int) -> int:
-        return len(self.read_all(topic, partition))
+        return self._scan_count(topic, partition)
 
     def read_all(self, topic: str, partition: int) -> List[bytes]:
         path = self._path(topic, partition)

@@ -226,10 +226,13 @@ class AppendableSectHistVector:
 
     def append(self, values: np.ndarray) -> None:
         values = np.asarray(values, np.float64)
+        # roll on: first append, entry cap, counter drop, or the u16
+        # section length would overflow (wide custom-bucket XOR blobs)
         start_new = (not self._sections
                      or self._counts[-1] >= self.section_limit
                      or (self._section_start is not None
-                         and (values < self._section_start).any()))
+                         and (values < self._section_start).any())
+                     or len(self._sections[-1]) > 0xC000)
         if start_new:
             blob = encode_blob(values, scheme=self.scheme)
             sect = bytearray(_SECT_HEADER.pack(1, len(blob)))
@@ -241,6 +244,16 @@ class AppendableSectHistVector:
             delta = values - self._section_start
             blob = encode_blob(delta, scheme=self.scheme)
             sect = self._sections[-1]
+            if len(sect) + len(blob) - _SECT_HEADER.size > 0xFFFF:
+                # blob would overflow the u16 section length: roll instead
+                abs_blob = encode_blob(values, scheme=self.scheme)
+                sect = bytearray(_SECT_HEADER.pack(1, len(abs_blob)))
+                sect += abs_blob
+                self._sections.append(sect)
+                self._counts.append(1)
+                self._section_start = values
+                self.num_histograms += 1
+                return
             sect += blob
             self._counts[-1] += 1
             n, _ = _SECT_HEADER.unpack_from(sect, 0)

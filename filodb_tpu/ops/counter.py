@@ -44,7 +44,15 @@ def host_counter_correct(vals: np.ndarray) -> np.ndarray:
     prev = np.where(prev_idx >= 0,
                     np.take_along_axis(v, np.maximum(prev_idx, 0), axis=1),
                     np.nan)
-    drops = np.where(valid & np.isfinite(prev) & (prev > v), prev - v, 0.0)
+    # a reset adds the FULL previous value (the counter restarted from 0;
+    # everything up to `prev` already happened) — Prometheus semantics and
+    # the reference's `_correction += last` (ref: DoubleVector.scala:328).
+    # Divergence kept deliberately: the reference also converts NaN to 0
+    # and counts it as a reset ("end of time series marker" kludge its own
+    # comment marks TODO); here NaN samples are skipped and `prev` tracks
+    # the last finite value, which composes with the incremental mirror's
+    # seeded-tail correction (core/devicecache._tail_state contract).
+    drops = np.where(valid & np.isfinite(prev) & (prev > v), prev, 0.0)
     out = v + np.cumsum(drops, axis=1)
     if len(orig_shape) == 3:
         out = np.moveaxis(out.reshape(orig_shape[0], orig_shape[2],
@@ -89,16 +97,24 @@ def _prev_valid(vals: jax.Array) -> jax.Array:
         [jnp.full_like(vals[:, :1], jnp.nan), filled[:, :-1]], axis=1)
 
 
-def drops(vals: jax.Array) -> jax.Array:
-    """Per-sample drop magnitude max(0, prev_valid - cur), 0 at NaN samples."""
+def drops(vals: jax.Array, vbase=None) -> jax.Array:
+    """Per-sample reset correction: the FULL previous valid value where the
+    counter dropped (Prometheus/reference semantics, ref:
+    DoubleVector.scala:328 `_correction += last`), 0 at NaN samples.
+
+    vbase [S]: when vals are REBASED (raw - vbase), the true previous raw
+    value is prev + vbase — the correction amount is NOT base-invariant
+    (unlike the old prev-cur delta), so callers on rebased data must pass
+    their base."""
     valid = ~jnp.isnan(vals)
     prev = _prev_valid(vals)
-    return jnp.where(valid & ~jnp.isnan(prev) & (prev > vals), prev - vals, 0.0)
+    amount = prev if vbase is None else prev + vbase[:, None]
+    return jnp.where(valid & ~jnp.isnan(prev) & (prev > vals), amount, 0.0)
 
 
-def counter_correct(vals: jax.Array) -> jax.Array:
+def counter_correct(vals: jax.Array, vbase=None) -> jax.Array:
     """Reset-corrected values: vals + cumulative drop sum; monotone per row."""
-    correction = jnp.cumsum(drops(vals), axis=1)
+    correction = jnp.cumsum(drops(vals, vbase), axis=1)
     return jnp.where(jnp.isnan(vals), vals, vals + correction)
 
 

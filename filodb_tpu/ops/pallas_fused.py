@@ -156,8 +156,10 @@ def _kernel(vals_ref, vbase_ref, gids_ref, o1_ref, o2_ref, l1_ref, l2_ref,
     if with_drops:
         prev = jnp.concatenate([v[:, :1], v[:, :-1]], axis=1)
         # first column has no predecessor; padded tail columns are never
-        # selected by l1/l2 (first/last < T <= padded region)
-        d = jnp.maximum(prev - v, 0.0)
+        # selected by l1/l2 (first/last < T <= padded region).  A reset
+        # adds the FULL previous RAW value = prev + vbase (rebased rows;
+        # ref: DoubleVector.scala:328 `_correction += last`)
+        d = jnp.where(v < prev, prev + vbase_ref[:], 0.0)
         col = jax.lax.broadcasted_iota(jnp.int32, d.shape, 1)
         d = jnp.where(col == 0, 0.0, d)
         v1 = v1 + mm(d, l1_ref[:])

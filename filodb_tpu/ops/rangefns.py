@@ -90,8 +90,11 @@ def _absolute(ctx: WindowCtx) -> WindowCtx:
 
 
 def _counter_values(ctx: WindowCtx) -> jax.Array:
-    """Reset-corrected values: free when the host pre-corrected in f64."""
-    return ctx.vals if ctx.precorrected else counter_ops.counter_correct(ctx.vals)
+    """Reset-corrected values: free when the host pre-corrected in f64.
+    ctx.vals are rebased, so the base rides along: the reset correction
+    adds the full previous RAW value (prev + vbase)."""
+    return ctx.vals if ctx.precorrected \
+        else counter_ops.counter_correct(ctx.vals, ctx.vbase)
 
 
 def _cumsum(x: jax.Array) -> jax.Array:
@@ -283,7 +286,12 @@ def _pair_indicator_window(ctx: WindowCtx, indicator: jax.Array) -> jax.Array:
 
 
 def resets(ctx: WindowCtx) -> jax.Array:
-    ind = (counter_ops.drops(ctx.vals) > 0).astype(ctx.vals.dtype)
+    # detect on the VALUE ordering (v < prev), not on drops()'s correction
+    # AMOUNT — the amount is the previous raw value, which on rebased rows
+    # can be <= 0 even at a genuine reset
+    prev = counter_ops._prev_valid(ctx.vals)
+    ind = (ctx.valid & ~jnp.isnan(prev)
+           & (ctx.vals < prev)).astype(ctx.vals.dtype)
     return _nan_where(ctx.n > 0, _pair_indicator_window(ctx, ind))
 
 

@@ -117,6 +117,8 @@ class ConsulSeedDiscovery(ClusterSeedDiscovery):
             raise RuntimeError(
                 f"consul registration failed: HTTP {e.code} {e.reason}"
             ) from e
+        except OSError as e:             # agent unreachable / refused
+            raise RuntimeError(f"consul agent unreachable: {e}") from e
         self._service_id = service_id
         return service_id
 
@@ -129,7 +131,12 @@ class ConsulSeedDiscovery(ClusterSeedDiscovery):
         req = urllib.request.Request(
             f"{self.base}/v1/agent/service/deregister/{self._service_id}",
             data=b"", method="PUT")
-        with urllib.request.urlopen(req, timeout=self.timeout_s):
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout_s):
+                pass
+        except OSError:
+            # shutdown-hook context: a down agent must not abort the rest
+            # of shutdown; the registration expires with the agent anyway
             pass
         self._service_id = None
 

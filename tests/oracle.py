@@ -22,7 +22,9 @@ def correct_counter(vals: Sequence[float]) -> List[float]:
             out.append(float("nan"))
             continue
         if prev is not None and v < prev:
-            corr += prev - v
+            # full previous value: the counter restarted from zero
+            # (ref: DoubleVector.scala:328 `_correction += last`)
+            corr += prev
         prev = v
         out.append(v + corr)
     return out

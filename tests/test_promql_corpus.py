@@ -1,0 +1,203 @@
+"""PromQL conformance corpus — the shadow-parser replacement.
+
+The reference runs two parsers in shadow mode in production and compares
+results (ref: prometheus/.../parse/Parser.scala:13-70).  With one Pratt
+parser, the substitute assurance is this corpus: test files transcribed
+from the Prometheus-upstream promql testdata DSL (`load` blocks +
+`eval instant at` cases), executed through the FULL engine stack
+(parse -> plan -> exec -> kernels) and checked against hand-verified
+expected values.
+
+DSL subset supported:
+    load <step>
+      metric{l1="v1",...} v1 v2 _ 3+4x5 ...
+    eval instant at <time> <expr>
+      {labels} value            # one line per expected series
+      metric{labels} value
+(`a+bxN` / `a-bxN` expand to N+1 samples; `_` is a missing sample;
+values may be NaN/Inf/-Inf.)
+
+Documented divergence from upstream: FiloDB treats NaN samples as
+ABSENT (the staleness marker), not as propagating float values — the
+staleness.test cases encode the FiloDB semantics (see tests/oracle.py
+and ref: AggrOverTimeFunctions NaN-skipping accumulators).
+"""
+import math
+import os
+import re
+
+import numpy as np
+import pytest
+
+CORPUS_DIR = os.path.join(os.path.dirname(__file__), "promql_corpus")
+
+_DUR = {"s": 1, "m": 60, "h": 3600, "d": 86400}
+
+
+def _dur_s(text):
+    m = re.fullmatch(r"(\d+(?:\.\d+)?)([smhd])", text.strip())
+    assert m, f"bad duration {text!r}"
+    return float(m.group(1)) * _DUR[m.group(2)]
+
+
+def _num(tok):
+    t = tok.strip()
+    if t in ("NaN", "nan"):
+        return math.nan
+    if t in ("Inf", "+Inf", "inf"):
+        return math.inf
+    if t == "-Inf":
+        return -math.inf
+    return float(t)
+
+
+def _expand_values(tokens):
+    """upstream series notation: literals, `_`, and a+bxN expansions."""
+    out = []
+    for tok in tokens:
+        m = re.fullmatch(r"(-?\d+(?:\.\d+)?)([+-]\d+(?:\.\d+)?)x(\d+)", tok)
+        if m:
+            start, step, n = (float(m.group(1)), float(m.group(2)),
+                              int(m.group(3)))
+            out.extend(start + step * i for i in range(n + 1))
+        elif tok == "_":
+            out.append(None)
+        else:
+            out.append(_num(tok))
+    return out
+
+
+_SERIES_RE = re.compile(r"^([A-Za-z_:][A-Za-z0-9_:]*)?(\{[^}]*\})?\s*(.*)$")
+
+
+def _parse_labels(text):
+    labels = {}
+    body = text.strip()[1:-1].strip()
+    if body:
+        for part in re.findall(r'([A-Za-z_][A-Za-z0-9_]*)="([^"]*)"', body):
+            labels[part[0]] = part[1]
+    return labels
+
+
+class Case:
+    def __init__(self, at_s, expr, expected, line_no):
+        self.at_s = at_s
+        self.expr = expr
+        self.expected = expected        # list of (metric, labels, value)
+        self.line_no = line_no
+
+
+def parse_corpus(path):
+    """-> (load_step_s, series list [(metric, labels, values)], cases)."""
+    step_s = None
+    series = []
+    cases = []
+    cur = None
+    with open(path) as f:
+        for ln, raw in enumerate(f, 1):
+            line = raw.rstrip("\n")
+            stripped = line.strip()
+            if not stripped or stripped.startswith("#"):
+                continue
+            if stripped.startswith("load "):
+                step_s = _dur_s(stripped.split(None, 1)[1])
+                cur = None
+                continue
+            if stripped.startswith("eval instant at "):
+                rest = stripped[len("eval instant at "):]
+                at, expr = rest.split(None, 1)
+                cur = Case(_dur_s(at), expr, [], ln)
+                cases.append(cur)
+                continue
+            if line[:1] in (" ", "\t"):
+                m = _SERIES_RE.match(stripped)
+                metric = m.group(1) or ""
+                labels = _parse_labels(m.group(2)) if m.group(2) else {}
+                rest = m.group(3).split()
+                if cur is None:         # a load series
+                    series.append((metric, labels, _expand_values(rest)))
+                else:                   # an expected result line
+                    assert len(rest) == 1, (path, ln, rest)
+                    cur.expected.append((metric, labels, _num(rest[0])))
+                continue
+            raise AssertionError(f"{path}:{ln}: unparsable line {line!r}")
+    return step_s, series, cases
+
+
+def build_engine(step_s, series):
+    from filodb_tpu.core.memstore import TimeSeriesMemStore
+    from filodb_tpu.core.partkey import PartKey
+    from filodb_tpu.core.records import RecordBatchBuilder
+    from filodb_tpu.core.schemas import DEFAULT_SCHEMAS
+    from filodb_tpu.parallel.shardmapper import ShardEvent, ShardMapper
+    from filodb_tpu.query.engine import QueryEngine
+
+    b = RecordBatchBuilder(DEFAULT_SCHEMAS["gauge"])
+    for metric, labels, values in series:
+        pk = PartKey.make(metric, labels)
+        for i, v in enumerate(values):
+            if v is None:
+                continue
+            b.add(pk, int(i * step_s * 1000), value=float(v))
+    ms = TimeSeriesMemStore()
+    sh = ms.setup("prometheus", 0)
+    sh.ingest(b.build())
+    mapper = ShardMapper(1)
+    mapper.update_from_event(
+        ShardEvent("IngestionStarted", "prometheus", 0, "b"))
+    return QueryEngine("prometheus", ms, mapper)
+
+
+# labels injected by the shard-key schema, not part of upstream semantics
+_IMPL_LABELS = ("_ws_", "_ns_")
+
+
+def _norm(metric, labels, strict_name):
+    lab = {k: v for k, v in labels.items() if k not in _IMPL_LABELS}
+    name = lab.pop("_metric_", lab.pop("__name__", metric or ""))
+    return (name if strict_name else "",
+            tuple(sorted(lab.items())))
+
+
+def run_case(engine, case):
+    res = engine.query_range(case.expr, case.at_s, 60, case.at_s)
+    assert res.error is None, f"line {case.line_no}: {res.error}"
+    got = {}
+    # strict metric-name matching only when some expected line names one
+    # (our engine keeps _metric_ through function application; upstream
+    # drops it — value conformance is what this corpus pins down)
+    strict = any(m for m, _, _ in case.expected)
+    for k, _, v in res.series():
+        vals = np.asarray(v, np.float64).reshape(-1)
+        assert vals.size == 1, (case.expr, vals)
+        got[_norm("", k.labels_dict, strict)] = float(vals[0])
+    want = {_norm(m, dict(labels), strict): val
+            for m, labels, val in case.expected}
+    assert set(got) == set(want), (
+        f"line {case.line_no}: {case.expr}\n  got keys  {sorted(got)}\n"
+        f"  want keys {sorted(want)}")
+    for key, val in want.items():
+        g = got[key]
+        if math.isnan(val):
+            assert math.isnan(g), (case.line_no, case.expr, key, g)
+        elif math.isinf(val):
+            assert g == val, (case.line_no, case.expr, key, g)
+        else:
+            assert g == pytest.approx(val, rel=2e-5, abs=1e-4), (
+                f"line {case.line_no}: {case.expr} {key}: "
+                f"got {g}, want {val}")
+
+
+def _corpus_files():
+    return sorted(f for f in os.listdir(CORPUS_DIR)
+                  if f.endswith(".test"))
+
+
+@pytest.mark.parametrize("fname", _corpus_files())
+def test_corpus_file(fname):
+    path = os.path.join(CORPUS_DIR, fname)
+    step_s, series, cases = parse_corpus(path)
+    assert step_s and series and cases, path
+    engine = build_engine(step_s, series)
+    for case in cases:
+        run_case(engine, case)

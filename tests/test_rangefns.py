@@ -104,7 +104,9 @@ def test_counter_correct_matches_oracle():
 def test_reset_across_nan_gap_detected():
     v = np.array([10.0, 20.0, np.nan, 5.0, 8.0])
     corrected = np.asarray(counter_ops.counter_correct(jnp.asarray(v[None, :])))[0]
-    np.testing.assert_allclose(corrected[3:], [20.0, 23.0])
+    # a reset adds the FULL previous value (the counter restarted from 0):
+    # 5 -> 5+20, 8 -> 8+20 (ref: DoubleVector.scala:328, Prometheus rate)
+    np.testing.assert_allclose(corrected[3:], [25.0, 28.0])
 
 
 def test_rate_simple_hand_computed():
@@ -190,3 +192,21 @@ def test_day_of_year_matches_datetime():
     want = np.array([datetime.datetime.fromtimestamp(
         t, datetime.timezone.utc).timetuple().tm_yday for t in ts])
     np.testing.assert_array_equal(got, want)
+
+
+def test_resets_on_rebased_large_counter():
+    """resets() must detect drops on REBASED rows where the pre-reset
+    value is below the series base (review r3: detection must use value
+    ordering, never the correction amount)."""
+    from filodb_tpu.ops.rangefns import evaluate_range_function
+    from filodb_tpu.ops.timewindow import to_offsets
+    raw = np.array([[100.0, 20.0, 30.0, 5.0, 50.0]])
+    vbase = np.array([100.0], np.float32)
+    rebased = (raw - 100.0).astype(np.float32)
+    ts = to_offsets(np.arange(5, dtype=np.int64)[None, :] * 10_000,
+                    np.full(1, 5), 0)
+    wends = np.array([40_000], np.int32)
+    out = np.asarray(evaluate_range_function(
+        jnp.asarray(ts), jnp.asarray(rebased), jnp.asarray(wends),
+        50_000, "resets", vbase=jnp.asarray(vbase)))
+    assert out[0, 0] == 2.0, out
