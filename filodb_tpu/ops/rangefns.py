@@ -241,9 +241,19 @@ def last_over_time(ctx: WindowCtx) -> jax.Array:
 
 
 def timestamp_fn(ctx: WindowCtx) -> jax.Array:
-    t = (gather_at(ctx.ts_off, ctx.last).astype(ctx.vals.dtype)
-         + ctx.base_ms) / 1000.0
-    return _nan_where(ctx.n > 0, t)
+    """Timestamp of each series' last VALID sample in the window.  Slot
+    presence is not enough: a ragged series whose freshest grid slots are
+    NaN holes has no sample there, and fabricating the hole's time would
+    keep a dead series alive past the lookback (review r3).  Running max
+    of valid-sample times, gathered at the window boundary."""
+    tsb = jnp.broadcast_to(ctx.ts_off, ctx.vals.shape).astype(ctx.vals.dtype)
+    vt = jnp.where(ctx.valid, tsb, -jnp.inf)
+    run = jax.lax.cummax(vt, axis=1)               # [S, T]
+    t = gather_at(run, ctx.last)                   # [S, W] (column gather)
+    in_window = (t >= jnp.broadcast_to(
+        ctx.wstart[None, :].astype(ctx.vals.dtype), t.shape)) \
+        & jnp.isfinite(t) & (_n_full(ctx) > 0)
+    return _nan_where(in_window, (t + ctx.base_ms) / 1000.0)
 
 
 def _n_full(ctx: WindowCtx) -> jax.Array:
@@ -403,7 +413,9 @@ def quantile_over_time(ctx: WindowCtx, q: float) -> jax.Array:
     r = _window_tile_reduce(
         ctx, lambda v, m: _masked_quantile(jnp.broadcast_to(v, m.shape), m, q))
     if not 0.0 <= q <= 1.0:
-        return jnp.where(ctx.n > 0,
+        # _n_full, not ctx.n: under shared_grid the bounds stay [1, W] but
+        # the output must be per-series
+        return jnp.where(_n_full(ctx) > 0,
                          jnp.inf if q > 1 else -jnp.inf, jnp.nan).astype(ctx.vals.dtype)
     return _nan_where(ctx.n > 0, r)
 

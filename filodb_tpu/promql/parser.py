@@ -56,6 +56,11 @@ _PREC = [  # lowest to highest; "^" binds tighter than unary -> parse_power
 ]
 
 
+# the Prometheus stale-lookback default; instant-vector timestamp()
+# evaluates over a window of exactly this reach
+STALE_LOOKBACK_MS = 5 * 60 * 1000
+
+
 @dataclasses.dataclass
 class TimeStepParams:
     """Seconds, like the reference's TimeStepParams."""
@@ -543,21 +548,24 @@ class _Converter:
                 fn_args.append(a.value)
             else:
                 target = a
-        if isinstance(target, A.MatrixSelector):
-            sel = target.selector
+        def selector_window_plan(sel, window_ms, window_is_lookback=False):
             at = self._resolve_at(sel.at_ms)
             s, en = (at, at) if at is not None else (start, end)
             raw = lp.RawSeries(
-                lp.IntervalSelector(s - target.range_ms, en),
+                lp.IntervalSelector(s - window_ms, en),
                 _filters(sel),
                 columns=(sel.column,) if sel.column else (),
                 offset_ms=sel.offset_ms or None)
             plan = lp.PeriodicSeriesWithWindowing(
-                raw, s, step, en, target.range_ms, e.name,
-                tuple(fn_args), offset_ms=sel.offset_ms or None)
+                raw, s, step, en, window_ms, e.name,
+                tuple(fn_args), offset_ms=sel.offset_ms or None,
+                window_is_lookback=window_is_lookback)
             if at is not None:
                 return lp.ApplyAtTimestamp(plan, start, step, end)
             return plan
+
+        if isinstance(target, A.MatrixSelector):
+            return selector_window_plan(target.selector, target.range_ms)
         if isinstance(target, A.Subquery):
             sq = target
             at = self._resolve_at(getattr(sq, "at_ms", None))
@@ -574,6 +582,17 @@ class _Converter:
             if at is not None:
                 return lp.ApplyAtTimestamp(plan, start, step, end)
             return plan
+        if e.name == "timestamp":
+            if isinstance(target, A.VectorSelector):
+                # upstream timestamp() takes an INSTANT vector: the sample
+                # time of each series' freshest point within the stale
+                # lookback (the planner substitutes its configured value
+                # via window_is_lookback)
+                return selector_window_plan(target, STALE_LOOKBACK_MS,
+                                            window_is_lookback=True)
+            raise ParseError(
+                "timestamp over a derived vector is not supported yet; "
+                "apply it to a plain selector")
         raise ParseError(f"{e.name} requires a range-vector argument")
 
     def _conv_binary(self, e: A.BinaryExpr, start, step, end) -> lp.LogicalPlan:

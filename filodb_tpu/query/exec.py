@@ -268,24 +268,32 @@ class PeriodicSamplesMapper(RangeVectorTransformer):
         wends_off = (eval_wends - base).astype(np.int32)
         vals = data.values
         vb = data.vbase
+        # shared scrape grid: ship ONE [1, T] offset row and let it
+        # broadcast through the kernel (exact for every range function —
+        # window bounds come from row 0 and every gather takes the
+        # column fast path).  Halves the general path's HBM timestamp
+        # traffic and skips the S-fold ts transfer entirely.
+        shared = data.shared_ts_row is not None
+        ts_in = data.ts_off[:1] if shared else data.ts_off
         if vals.ndim == 3:
             S, T, B = vals.shape
             flat = np.moveaxis(vals, 2, 1).reshape(S * B, T)
-            ts_rep = np.repeat(data.ts_off, B, axis=0)
+            ts_rep = ts_in if shared else np.repeat(data.ts_off, B, axis=0)
             vb_flat = None if vb is None else jnp.asarray(vb).reshape(S * B)
             out = np.asarray(evaluate_range_function(
                 jnp.asarray(ts_rep), jnp.asarray(flat),
                 jnp.asarray(wends_off), window, fn,
                 tuple(self.function_args), base_ms=kernel_base,
-                vbase=vb_flat, precorrected=data.precorrected))
+                vbase=vb_flat, precorrected=data.precorrected,
+                shared_grid=shared))
             out = np.moveaxis(out.reshape(S, B, -1), 1, 2)     # [S, W, B]
         else:
             out = np.asarray(evaluate_range_function(
-                jnp.asarray(data.ts_off), jnp.asarray(vals),
+                jnp.asarray(ts_in), jnp.asarray(vals),
                 jnp.asarray(wends_off), window, fn,
                 tuple(self.function_args), base_ms=kernel_base,
                 vbase=None if vb is None else jnp.asarray(vb),
-                precorrected=data.precorrected))
+                precorrected=data.precorrected, shared_grid=shared))
         if fn == "timestamp":
             out = out.astype(np.float64) + base / 1000.0
         return ResultBlock(data.keys, wends, out, data.bucket_les)

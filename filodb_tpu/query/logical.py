@@ -82,6 +82,44 @@ class PeriodicSeriesWithWindowing(PeriodicSeriesPlan):
     function: str                                   # range function name
     function_args: Tuple[float, ...] = ()
     offset_ms: Optional[int] = None
+    # instant-vector timestamp(): the window IS the stale lookback; the
+    # parser stores its default here and the planner re-resolves it to the
+    # deployment's configured stale_lookback_ms before materializing
+    window_is_lookback: bool = False
+
+
+def resolve_lookback_windows(plan: LogicalPlan, lookback_ms: int
+                             ) -> LogicalPlan:
+    """Rewrite every window_is_lookback PSWW to the configured lookback."""
+    import dataclasses as _dc
+
+    def walk(p):
+        if not _dc.is_dataclass(p):
+            return p
+        changes = {}
+        for f in _dc.fields(p):
+            v = getattr(p, f.name)
+            if isinstance(v, LogicalPlan):
+                nv = walk(v)
+                if nv is not v:
+                    changes[f.name] = nv
+            elif isinstance(v, tuple) and any(
+                    isinstance(x, LogicalPlan) for x in v):
+                nv = tuple(walk(x) if isinstance(x, LogicalPlan) else x
+                           for x in v)
+                if nv != v:
+                    changes[f.name] = nv
+        if isinstance(p, PeriodicSeriesWithWindowing) \
+                and p.window_is_lookback:
+            changes.update(window_ms=lookback_ms, window_is_lookback=False)
+            raw = changes.get("series", p.series)
+            changes["series"] = _dc.replace(
+                raw, range_selector=IntervalSelector(
+                    p.start_ms - lookback_ms - (p.offset_ms or 0),
+                    raw.range_selector.to_ms))
+        return _dc.replace(p, **changes) if changes else p
+
+    return walk(plan)
 
 
 @dataclasses.dataclass(frozen=True)

@@ -96,6 +96,9 @@ class SingleClusterPlanner(QueryPlanner):
     # ----------------------------------------------------------- materialize
 
     def materialize(self, plan: lp.LogicalPlan, ctx: QueryContext) -> ExecPlan:
+        # instant-vector timestamp() windows resolve to THIS planner's
+        # configured lookback, not the parser's compile-time default
+        plan = lp.resolve_lookback_windows(plan, self.stale_lookback_ms)
         out = self._walk(plan, ctx)
         if isinstance(out, list):
             if len(out) == 1:

@@ -295,6 +295,11 @@ def unparse(plan: lp.LogicalPlan) -> str:
     if isinstance(plan, lp.PeriodicSeries):
         return _selector(plan.raw_series, offset_ms=plan.offset_ms)
     if isinstance(plan, lp.PeriodicSeriesWithWindowing):
+        if plan.window_is_lookback:
+            # instant-vector timestamp(): round-trips WITHOUT a range so
+            # the remote side re-resolves its own lookback
+            inner = _selector(plan.series, offset_ms=plan.offset_ms)
+            return f"{plan.function}({inner})"
         inner = _selector(plan.series, window_ms=plan.window_ms,
                           offset_ms=plan.offset_ms)
         args = [_num_str(a) for a in plan.function_args]

@@ -168,7 +168,9 @@ def eval_series(ts: np.ndarray, vals: np.ndarray, wends: Sequence[int],
                     s_prev2, s_prev = s_prev, sf * xs[j] + (1 - sf) * (s_prev + b)
                 out[i] = s_prev
         elif fn == "timestamp":
-            out[i] = wt[-1] / 1000.0
+            # the last VALID sample's time — NaN slots are absent samples
+            # under the FiloDB convention, so they carry no timestamp
+            out[i] = wt[mask][-1] / 1000.0 if mask.any() else float("nan")
         elif fn == "present_over_time":
             out[i] = 1.0
         elif fn == "absent_over_time":

@@ -210,3 +210,32 @@ def test_resets_on_rebased_large_counter():
         jnp.asarray(ts), jnp.asarray(rebased), jnp.asarray(wends),
         50_000, "resets", vbase=jnp.asarray(vbase)))
     assert out[0, 0] == 2.0, out
+
+
+def test_one_row_ts_broadcast_matches_full():
+    """A single shared [1, T] ts row must produce identical [S, W] output
+    to the tiled [S, T] form for every range function (the general path
+    ships one row under the mirror's shared-grid certificate)."""
+    rng = np.random.default_rng(7)
+    S, T = 12, 120
+    ts_row = np.arange(T, dtype=np.int64) * 10_000
+    vals = np.cumsum(rng.exponential(5.0, size=(S, T)), axis=1)
+    vals[3, 40:55] = np.nan
+    ts_full = to_offsets(np.tile(ts_row, (S, 1)), np.full(S, T), 0)
+    ts_one = to_offsets(ts_row[None, :], np.full(1, T), 0)
+    wends = make_window_ends(300_000, 1_100_000, 60_000).astype(np.int32)
+    # EVERY registry function — hand-listing misses shape regressions
+    # (review r3: quantile_over_time's invalid-q branch was [1, W])
+    params_for = {"quantile_over_time": (0.75,), "predict_linear": (600.0,),
+                  "holt_winters": (0.5, 0.1)}
+    cases = [(fn, params_for.get(fn, ())) for fn in RANGE_FUNCTIONS]
+    cases.append(("quantile_over_time", (1.5,)))     # invalid-q branch
+    for fn, params in cases:
+        a = np.asarray(evaluate_range_function(
+            jnp.asarray(ts_full), jnp.asarray(vals), jnp.asarray(wends),
+            300_000, fn, params, shared_grid=True))
+        b = np.asarray(evaluate_range_function(
+            jnp.asarray(ts_one), jnp.asarray(vals), jnp.asarray(wends),
+            300_000, fn, params, shared_grid=True))
+        assert a.shape == b.shape == (S, len(wends)), (fn, a.shape, b.shape)
+        np.testing.assert_array_equal(a, b, err_msg=fn)
