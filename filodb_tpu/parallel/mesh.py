@@ -587,10 +587,10 @@ class MeshExecutor:
         if Wp != W:
             wends = np.concatenate(
                 [wends, np.full(Wp - W, -PAD_TS, np.int32)])
-        if agg_op == "sum" and not params:
+        if agg_op in ("sum", "avg", "count") and not params:
             try:
                 fused = self._run_agg_fused(packed, wends, W, range_ms,
-                                            fn_name)
+                                            fn_name, agg_op)
             except Exception as e:  # noqa: BLE001 — fusion is optional
                 from filodb_tpu.utils.metrics import (
                     log_fused_degradation, registry)
@@ -611,24 +611,38 @@ class MeshExecutor:
         return np.asarray(out)[:, :W], packed.group_labels
 
     def _run_agg_fused(self, packed: PackedShards, wends_p: np.ndarray,
-                       W: int, range_ms: int,
-                       fn_name: Optional[str]) -> Optional[np.ndarray]:
-        """sum(rate|increase|delta) over a uniform-grid dense pack via the
-        Pallas MXU kernel (ops/pallas_fused.py) composed inside shard_map:
-        per-time-slice selection-matrix plans shard over the 'time' axis,
-        the kernel runs per shard device, group sums psum over 'shard' —
-        one HBM pass per device instead of the general path's several.
+                       W: int, range_ms: int, fn_name: Optional[str],
+                       agg_op: str = "sum") -> Optional[np.ndarray]:
+        """sum/avg/count(rate|increase|delta|*_over_time) over a
+        uniform-grid dense pack via the Pallas MXU kernel
+        (ops/pallas_fused.py) composed inside shard_map: per-time-slice
+        selection-matrix plans shard over the 'time' axis, the kernel runs
+        per shard device, group sums psum over 'shard' — one HBM pass per
+        device instead of the general path's several.  count needs NO
+        device work at all on a dense pack (identical per-window counts);
+        avg divides the kernel's sums by the host counts.
         Returns the finished [G, W] array, or None when ineligible."""
         import os
 
         from filodb_tpu.ops import pallas_fused as pf
         shared = packed.shared_ts_row is not None and packed.gsize is not None
-        if not pf.can_fuse(fn_name or "", "sum", shared, shared):
+        if not pf.can_fuse(fn_name or "", agg_op, shared, shared):
             return None
         if fn_name in pf.MINMAX_FNS:
             # reduce_window kinds run through the general mesh path (XLA
             # fuses them fine); the matmul kernel has no min/max kind
             return None
+        if agg_op == "count":
+            # dense pack: every REAL series emits a value exactly where the
+            # shared window is valid — pure host math, zero device work
+            minsamp = 2 if fn_name in ("rate", "increase", "delta") else 1
+            n = pf.window_counts(packed.shared_ts_row.astype(np.int64),
+                                 wends_p[:W].astype(np.int64), range_ms)
+            valid = (n >= minsamp).astype(np.float64)
+            counts = packed.gsize[:, None] * valid[None, :]
+            from filodb_tpu.utils.metrics import registry
+            registry.counter("mesh_fused_count_host").increment()
+            return np.where(counts > 0, counts, np.nan)
         interpret = jax.default_backend() != "tpu"
         if interpret and not os.environ.get("FILODB_TPU_FUSED_INTERPRET"):
             return None
