@@ -60,15 +60,36 @@ def make_counter_data(S, T, step_ms=10_000, seed=7):
 
 
 def numpy_vectorized_baseline(ts_row, vals, gids, G, wends, range_ms):
-    """Same algorithm as the device kernel, vectorized NumPy on host."""
-    lo = np.searchsorted(ts_row, wends - range_ms, side="left")
+    """Same algorithm as the device kernel, vectorized NumPy on host: window
+    is samples in [wend-range+1, wend] and the rate uses full Prometheus
+    extrapolation with the counter-zero clamp (semantics of ref:
+    query/.../rangefn/RateFunctions.scala:37-76 extrapolatedRate), so in f64
+    this doubles as the conformance oracle for the f32 device result."""
+    lo = np.searchsorted(ts_row, wends - range_ms + 1, side="left")
     hi = np.searchsorted(ts_row, wends, side="right") - 1
-    ok = hi > lo
-    t1, t2 = ts_row[lo], ts_row[hi]
-    v1, v2 = vals[:, lo], vals[:, hi]                  # [S, W]
+    n = hi - lo + 1
+    ok = n >= 2
+    lo_c = np.minimum(lo, len(ts_row) - 1)
+    t1 = ts_row[lo_c].astype(np.float64)
+    t2 = ts_row[hi].astype(np.float64)                 # [W]
+    v1 = vals[:, lo_c].astype(np.float64)
+    v2 = vals[:, hi].astype(np.float64)                # [S, W]
+    wstart = (wends - range_ms).astype(np.float64)
+    wend = wends.astype(np.float64)
+    dur_start = (t1 - wstart) / 1000.0
+    dur_end = (wend - t2) / 1000.0
+    sampled = (t2 - t1) / 1000.0
+    avg = sampled / np.maximum(n - 1, 1)
+    delta = v2 - v1
     with np.errstate(invalid="ignore", divide="ignore"):
-        rate = np.where(ok & (t2 > t1), (v2 - v1) / (t2 - t1) * 1000.0,
-                        np.nan)
+        dur_zero = sampled * (v1 / delta)              # counter hit 0 here
+        ds = np.where((delta > 0) & (v1 >= 0) & (dur_zero < dur_start),
+                      dur_zero, dur_start)
+        threshold = avg * 1.1
+        extrap = (sampled + np.where(ds < threshold, ds, avg / 2)
+                  + np.where(dur_end < threshold, dur_end, avg / 2))
+        rate = delta * (extrap / sampled) / (wend - wstart) * 1000.0
+    rate = np.where(ok & (sampled > 0), rate, np.nan)
     out = np.zeros((G, rate.shape[1]))
     np.add.at(out, gids, np.nan_to_num(rate))
     return out
@@ -150,6 +171,27 @@ def run_pallas_fused(ts_row, vals_dev, gids, wends, range_ms, G,
         fused_query()
         lat.append(time.perf_counter() - t0)
     return float(np.median(np.asarray(lat))), err
+
+
+CONFORMANCE_SERIES_CAP = 262_144
+
+
+def cpu_f64_conformance(stage, xla_res, ts_row, vals, gids, G, wends,
+                        range_ms):
+    """Self-certify a CPU stage: cross-check the XLA f32 result against the
+    same algorithm in f64 NumPy (round-3 verdict weak #3 — the artifact must
+    carry an in-run correctness certificate even on the CPU fallback).
+    Callers cap the series count (CONFORMANCE_SERIES_CAP) so the f64
+    temporaries (~8 [S,W] arrays) can't OOM a smaller fallback host; vals
+    stays f32 here — the oracle casts only the gathered [S,W] columns."""
+    ref = numpy_vectorized_baseline(ts_row, vals, gids,
+                                    G, wends.astype(np.int64), range_ms)
+    got = np.nan_to_num(np.asarray(xla_res, np.float64))
+    err = float(np.max(np.abs(got - ref) / np.maximum(np.abs(ref), 1e-6)))
+    stage["xla_max_rel_err_vs_f64"] = round(err, 9)
+    if vals.shape[0] != stage["series"]:
+        stage["conformance_series"] = vals.shape[0]
+    return err < 1e-3
 
 
 def measure_stage(S, T, iters, platform, do_fused, persist,
@@ -246,13 +288,39 @@ def measure_stage(S, T, iters, platform, do_fused, persist,
         paths.append(("xla", stage["xla_p50_s"]))
     err_ok = stage.get("pallas_max_rel_err_vs_xla")
     checked_here = isinstance(err_ok, float) and err_ok < 1e-4
+    cpu_cert_failed = False
+    if platform == "cpu" and xla_res is not None:
+        # no Pallas on the CPU path: certify XLA against the f64 oracle so
+        # the artifact's number is still self-checking.  Above the cap,
+        # certify a group-representative subset (gids cycle through all G
+        # groups) by re-running the jitted query on the sliced inputs.
+        try:
+            Sc = min(S, CONFORMANCE_SERIES_CAP)
+            if Sc == S:
+                sub_res = xla_res
+            else:
+                sub_res = np.asarray(query(dev_ts, dev_vals[:Sc],
+                                           dev_gids[:Sc], dev_wends))
+            checked_here = cpu_f64_conformance(
+                stage, sub_res, ts_row, vals[:Sc], gids[:Sc], G, wends,
+                range_ms)
+            cpu_cert_failed = not checked_here
+        except Exception as e:  # noqa: BLE001 — a cert CRASH (OOM etc.) is
+            # not evidence the result is wrong: record it and fall back to
+            # conformance inherited from a previously-certified stage
+            stage["conformance_error"] = f"{type(e).__name__}: {e}"[:200]
     if "pallas_p50_s" in stage and (
             checked_here or (err_ok is None and xla_res is None
                              and prior_conformance_ok)):
         paths.append(("pallas_fused", stage["pallas_p50_s"]))
         if not checked_here:
             stage["pallas_conformance"] = "inherited from previous stage"
-    stage["conformance_ok"] = checked_here or prior_conformance_ok
+    stage["conformance_ok"] = checked_here or (prior_conformance_ok
+                                               and not cpu_cert_failed)
+    if cpu_cert_failed:
+        # a stage whose own certificate failed must not publish a trusted
+        # headline number (raw xla_* timings stay recorded above)
+        paths = []
     if paths:
         kernel, p50 = min(paths, key=lambda kv: kv[1])
         stage.update({
@@ -425,7 +493,7 @@ def assemble_result(platform, stages, vec_sps, it_sps, partial=False):
     for k in ("fused_coverage_dense", "fused_coverage_ragged"):
         if k in cov:
             result[k] = cov[k]
-    ns = stages.get("north_star_1m")
+    ns = stages.get("north_star_1m") or stages.get("cpu_north_star_1m")
     if ns and "samples_per_sec" in ns:
         result.update({
             "north_star_series": ns["series"],
@@ -441,6 +509,17 @@ def assemble_result(platform, stages, vec_sps, it_sps, partial=False):
 
 def run_worker(args):
     import jax
+
+    # persistent compile cache: repeated tunnel-window attempts must not pay
+    # cold XLA compiles again (round-3 verdict item 1c)
+    cache_dir = os.environ.get("JAX_COMPILATION_CACHE_DIR",
+                               os.path.join(REPO_DIR, ".jax_cache"))
+    try:
+        os.makedirs(cache_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:  # noqa: BLE001 — cache is an optimization only
+        pass
 
     if args.platform == "cpu":
         # Env vars are too late once the sitecustomize hook has imported
@@ -458,22 +537,30 @@ def run_worker(args):
     writer.doc["jax_platform"] = raw_platform
 
     if args.series:
-        ladder = [("explicit", args.series)]
+        ladder = [("explicit", args.series, iters)]
     elif quick:
-        ladder = [("quick_8k", 8_192)]
+        ladder = [("quick_8k", 8_192, iters)]
     elif platform == "cpu":
-        # fallback runs must finish within the supervisor timeout
-        ladder = [("cpu_65k", 65_536)]
+        # fallback runs must finish within the supervisor timeout; the 1M
+        # north-star SHAPE still gets a measured point (relaxed iters) so
+        # the target workload has executed somewhere every round
+        ladder = [("cpu_65k", 65_536, iters),
+                  ("cpu_north_star_1m", 1_048_576, 3)]
     else:
-        ladder = [("warm_262k", 262_144), ("north_star_1m", 1_048_576)]
+        # smallest-first: a 5-minute tunnel window must still leave a
+        # trusted TPU number behind before the big stages start
+        ladder = [("warm_8k", 8_192, iters),
+                  ("warm_65k", 65_536, iters),
+                  ("warm_262k", 262_144, iters),
+                  ("north_star_1m", 1_048_576, iters)]
 
     stages = {}
     baseline_inputs = None
     conformance_ok = False
-    for name, S in ladder:
+    for name, S, stage_iters in ladder:
         try:
             st, ts_row, vals, gids, wends, range_ms, span = measure_stage(
-                S, T, iters, platform,
+                S, T, stage_iters, platform,
                 do_fused=platform != "cpu",
                 persist=lambda d, n=name: writer.stage(n, d),
                 prior_conformance_ok=conformance_ok)
@@ -593,7 +680,7 @@ def main():
     # back to CPU — so the round always records a number.
     if args.platform == "cpu":
         # explicit CPU request: no probe, no fallback relabeling
-        result = _spawn_worker(args, "cpu", 1800, run_id)
+        result = _spawn_worker(args, "cpu", 2700, run_id)
         print(json.dumps(result if result is not None else {
             "metric": "promql_samples_scanned_per_sec", "value": 0.0,
             "unit": "samples/s", "vs_baseline": 0.0, "platform": "none",
@@ -624,7 +711,7 @@ def main():
         if rec is not None:
             print(json.dumps(rec))
             return
-    result = _spawn_worker(args, "cpu", 1800, run_id)
+    result = _spawn_worker(args, "cpu", 2700, run_id)
     if result is not None:
         result["fallback"] = "cpu (default backend unavailable: probe=%s)" % plat
         print(json.dumps(result))
