@@ -50,12 +50,17 @@ class WindowCtx(NamedTuple):
     # (ops/counter.rebase_values) -> the device drop scan is a no-op and
     # is skipped.  Python bool, constant-folded under jit.
     precorrected: bool = False
+    # False when values may carry NaN holes (staleness markers): the rate
+    # family then computes per-series VALID boundaries instead of slot
+    # boundaries — upstream filters markers out of range vectors, so a NaN
+    # at a window edge must not poison the rate.  Python bool, static.
+    dense: bool = True
 
 
 def make_ctx(ts_off: jax.Array, vals: jax.Array,
              wends: jax.Array, range_ms, base_ms=0,
              shared_grid: bool = False, vbase=None,
-             precorrected: bool = False) -> WindowCtx:
+             precorrected: bool = False, dense: bool = True) -> WindowCtx:
     """shared_grid=True asserts every series row of ts_off is identical
     (one scrape grid — the common case); window bounds are then computed
     once from row 0 and kept [1, W], turning every downstream gather into
@@ -78,7 +83,7 @@ def make_ctx(ts_off: jax.Array, vals: jax.Array,
         vbase = jnp.zeros(vals.shape[:1], vals.dtype)
     return WindowCtx(ts_off, vals, valid, wstart, wend, first, last, n,
                      jnp.asarray(base_ms, vals.dtype),
-                     vbase.astype(vals.dtype), precorrected)
+                     vbase.astype(vals.dtype), precorrected, dense)
 
 
 def _absolute(ctx: WindowCtx) -> WindowCtx:
@@ -140,20 +145,52 @@ def extrapolated_rate(window_start, window_end, n, t1, v1, t2, v2,
     return scaled
 
 
+def _valid_bounds(ctx: WindowCtx):
+    """Per-series first/last VALID sample index in each window + valid
+    count, for the NaN-skipping rate-family boundaries on ragged data
+    (upstream drops staleness markers from range vectors before the rate
+    math).  Running scans over the validity mask turn the per-window
+    search into two column gathers:
+
+      lastrun[s, t]  = newest valid index <= t   (cummax over iota)
+      firstrun[s, t] = oldest valid index >= t   (reverse cummin)
+
+    Returns (firstv [S,W], lastv [S,W], nv [S,W], lastrun [S,T]); callers
+    mask with nv >= k, which also covers windows whose nearest valid
+    samples lie outside the slot bounds."""
+    T = ctx.vals.shape[1]
+    iota = jnp.arange(T, dtype=jnp.int32)[None, :]
+    lastrun = jax.lax.cummax(jnp.where(ctx.valid, iota, jnp.int32(-1)),
+                             axis=1)
+    firstrun = jnp.flip(jax.lax.cummin(
+        jnp.flip(jnp.where(ctx.valid, iota, jnp.int32(T)), axis=1),
+        axis=1), axis=1)
+    lastv = gather_at(lastrun, ctx.last)
+    firstv = gather_at(firstrun, ctx.first)
+    nv = windowed_cumsum_delta(
+        _cumsum(ctx.valid.astype(ctx.vals.dtype)), ctx.first, ctx.last,
+        ctx.n).astype(jnp.int32)
+    return firstv, lastv, nv, lastrun
+
+
 def _rate_family(ctx: WindowCtx, is_counter: bool, is_rate: bool) -> jax.Array:
     vals = _counter_values(ctx) if is_counter else ctx.vals
-    t1 = gather_at(ctx.ts_off, ctx.first).astype(vals.dtype)
-    t2 = gather_at(ctx.ts_off, ctx.last).astype(vals.dtype)
-    v1 = gather_at(vals, ctx.first)
-    v2 = gather_at(vals, ctx.last)
+    if ctx.dense:
+        first, last, n = ctx.first, ctx.last, ctx.n
+    else:
+        first, last, n, _ = _valid_bounds(ctx)
+    t1 = gather_at(ctx.ts_off, first).astype(vals.dtype)
+    t2 = gather_at(ctx.ts_off, last).astype(vals.dtype)
+    v1 = gather_at(vals, first)
+    v2 = gather_at(vals, last)
     # boundary per ChunkedRateFunctionBase: windowStart - 1 == wend - range
     wstart_x = (ctx.wstart - 1).astype(vals.dtype)[None, :]
     wend_x = ctx.wend.astype(vals.dtype)[None, :]
     v1_abs = v1 + ctx.vbase[:, None] if is_counter else None
-    out = extrapolated_rate(wstart_x, wend_x, ctx.n.astype(vals.dtype),
+    out = extrapolated_rate(wstart_x, wend_x, n.astype(vals.dtype),
                             t1, v1, t2, v2, is_counter, is_rate,
                             v1_abs=v1_abs)
-    return _nan_where(ctx.n >= 2, out)
+    return _nan_where(n >= 2, out)
 
 
 def rate(ctx: WindowCtx) -> jax.Array:
@@ -168,22 +205,36 @@ def delta_fn(ctx: WindowCtx) -> jax.Array:
     return _rate_family(ctx, False, False)
 
 
+def _instant_pair(ctx: WindowCtx):
+    """(last, prev, ok): the newest two sample indices in each window for
+    irate/idelta — slot math when dense, last two VALID samples when the
+    data may hold staleness-marker NaNs."""
+    if ctx.dense:
+        return (ctx.last, ctx.last - 1,
+                (ctx.n >= 2) & (ctx.last - 1 >= ctx.first))
+    _, lastv, nv, lastrun = _valid_bounds(ctx)
+    prev = gather_at(lastrun, jnp.maximum(lastv - 1, 0))
+    return lastv, prev, nv >= 2
+
+
 def irate(ctx: WindowCtx) -> jax.Array:
     vals = _counter_values(ctx)
-    t2 = gather_at(ctx.ts_off, ctx.last).astype(vals.dtype)
-    t1 = gather_at(ctx.ts_off, ctx.last - 1).astype(vals.dtype)
-    v2 = gather_at(vals, ctx.last)
-    v1 = gather_at(vals, ctx.last - 1)
+    last, prev, ok = _instant_pair(ctx)
+    t2 = gather_at(ctx.ts_off, last).astype(vals.dtype)
+    t1 = gather_at(ctx.ts_off, prev).astype(vals.dtype)
+    v2 = gather_at(vals, last)
+    v1 = gather_at(vals, prev)
     out = (v2 - v1) / ((t2 - t1) / 1000.0)
-    return _nan_where((ctx.n >= 2) & (ctx.last - 1 >= ctx.first), out)
+    return _nan_where(ok, out)
 
 
 def idelta(ctx: WindowCtx) -> jax.Array:
-    t2 = gather_at(ctx.ts_off, ctx.last).astype(ctx.vals.dtype)
-    t1 = gather_at(ctx.ts_off, ctx.last - 1).astype(ctx.vals.dtype)
-    v2 = gather_at(ctx.vals, ctx.last)
-    v1 = gather_at(ctx.vals, ctx.last - 1)
-    return _nan_where((ctx.n >= 2) & (ctx.last - 1 >= ctx.first), v2 - v1)
+    last, prev, ok = _instant_pair(ctx)
+    t2 = gather_at(ctx.ts_off, last).astype(ctx.vals.dtype)
+    t1 = gather_at(ctx.ts_off, prev).astype(ctx.vals.dtype)
+    v2 = gather_at(ctx.vals, last)
+    v1 = gather_at(ctx.vals, prev)
+    return _nan_where(ok, v2 - v1)
 
 
 # ------------------------------------------------------------- over_time / sums
@@ -518,8 +569,8 @@ def evaluate_range_function(ts_off: jax.Array, vals: jax.Array,
                             fn_name: Optional[str],
                             params: Tuple[float, ...] = (),
                             base_ms=0, shared_grid: bool = False,
-                            vbase=None, precorrected: bool = False
-                            ) -> jax.Array:
+                            vbase=None, precorrected: bool = False,
+                            dense: bool = True) -> jax.Array:
     """The fused leaf kernel: window bounds + range function in one jit.
 
     fn_name None means plain periodic samples (instant-vector selector):
@@ -540,17 +591,17 @@ def evaluate_range_function(ts_off: jax.Array, vals: jax.Array,
         vbase = jnp.zeros(vals.shape[:1], vals.dtype)
     return _evaluate_range_function(ts_off, vals, wends, range_ms,
                                     base_ms, vbase, fn_name, params,
-                                    shared_grid, precorrected)
+                                    shared_grid, precorrected, dense)
 
 
 @functools.partial(jax.jit,
                    static_argnames=("fn_name", "params", "shared_grid",
-                                    "precorrected"))
+                                    "precorrected", "dense"))
 def _evaluate_range_function(ts_off, vals, wends, range_ms, base_ms,
                              vbase, fn_name, params, shared_grid,
-                             precorrected):
+                             precorrected, dense):
     ctx = make_ctx(ts_off, vals, wends, range_ms, base_ms, shared_grid,
-                   vbase, precorrected)
+                   vbase, precorrected, dense)
     name = fn_name or "last_over_time"
     spec = RANGE_FUNCTIONS[name]
     if spec.absolute:

@@ -83,6 +83,10 @@ def gather_at(arr: jax.Array, idx: jax.Array) -> jax.Array:
     safe = jnp.clip(idx, 0, arr.shape[1] - 1)
     if safe.shape[0] == 1 and arr.shape[0] != 1:
         return arr[:, safe[0]]
+    if arr.shape[0] == 1 and safe.shape[0] != 1:
+        # shared [1, T] row gathered at per-series indices (the ragged
+        # rate family's valid boundaries on the shared scrape grid)
+        return jnp.take(arr[0], safe, axis=0)
     return jnp.take_along_axis(arr, safe, axis=1)
 
 

@@ -88,6 +88,9 @@ class PackedShards:
     shared_ts_row: Optional[np.ndarray] = None
     # series per aggregation group over REAL rows (for present-count math)
     gsize: Optional[np.ndarray] = None
+    # False when any counted cell is non-finite: the rate family then runs
+    # its valid-boundary variant (staleness markers are absent samples)
+    dense: bool = True
 
     @property
     def n_shards(self) -> int:
@@ -212,11 +215,23 @@ def pack_shards(blocks: Sequence[Tuple],
         if nser[d]:
             gsize += np.bincount(gids[d, :nser[d]],
                                  minlength=num_groups)[:num_groups]
+    # dense = every COUNTED cell finite (pad cells don't count); routes the
+    # general path's rate family to valid-boundary semantics when False.
+    # A surviving shared_row already proved finiteness above — skip the
+    # rescan (and its per-shard np.where temporaries) in that case.
+    dense = shared_row is not None
+    if not dense:
+        dense = all(
+            nser[d] == 0 or np.isfinite(
+                np.where(ts[d, :nser[d]] < PAD_TS,
+                         vals[d, :nser[d]], 0.0)).all()
+            for d in range(D))
     return PackedShards(ts, vals, gids, num_groups,
                         labels_out, base_ms, nser,
                         vbase=vbase if any_vbase else None,
                         precorrected=precorrected,
-                        shared_ts_row=shared_row, gsize=gsize)
+                        shared_ts_row=shared_row, gsize=gsize,
+                        dense=dense)
 
 
 def device_put_packed(packed: PackedShards, mesh: Mesh) -> PackedShards:
@@ -240,7 +255,7 @@ def device_put_packed(packed: PackedShards, mesh: Mesh) -> PackedShards:
     "mesh", "G", "S", "T", "Tp", "is_counter", "is_rate", "interpret",
     "kind"))
 def _mesh_fused_call(mesh: Mesh, values, group_ids, vbase,
-                     o1, o2, l1, l2, t1, t2, n, ws, we, *,
+                     o1, o2, l1, l2, t1, t2, n, ws, we, ts, *,
                      G: int, S: int, T: int, Tp: int,
                      is_counter: bool, is_rate: bool, interpret: bool,
                      kind: str = "rate_family"):
@@ -253,7 +268,7 @@ def _mesh_fused_call(mesh: Mesh, values, group_ids, vbase,
     Sp = pf._pad_to(S, pf._BS)
 
     def step(val_blk, gid_blk, vb_blk, o1b, o2b, l1b, l2b,
-             t1b, t2b, nb, wsb, web):
+             t1b, t2b, nb, wsb, web, tsb):
         # NaN cells are exactly pad rows / beyond-count columns under the
         # pack's eligibility gate; zeroed they contribute nothing (pack pad
         # rows carry gid 0 but add +0 to its sums).  with_drops is always
@@ -264,7 +279,7 @@ def _mesh_fused_call(mesh: Mesh, values, group_ids, vbase,
         g = jnp.pad(gid_blk[0].astype(jnp.int32), (0, Sp - S),
                     constant_values=-1)[:, None]
         out = pf.run_kernel(v, vb, g, o1b[0], o2b[0], l1b[0], l2b[0],
-                            t1b[0], t2b[0], nb[0], wsb[0], web[0],
+                            t1b[0], t2b[0], nb[0], wsb[0], web[0], tsb[0],
                             num_groups=Gp, is_counter=is_counter,
                             is_rate=is_rate, with_drops=False,
                             interpret=interpret, kind=kind)
@@ -273,19 +288,19 @@ def _mesh_fused_call(mesh: Mesh, values, group_ids, vbase,
     return jax.shard_map(
         step, mesh=mesh,
         in_specs=(P("shard", None, None), P("shard", None),
-                  P("shard", None)) + (P("time", None, None),) * 9,
+                  P("shard", None)) + (P("time", None, None),) * 10,
         out_specs=P(None, "time"),
         # pallas_call's out_shape carries no varying-mesh-axes info, which
         # trips shard_map's vma checker; the psum makes the output
         # replicated over 'shard' by construction
         check_vma=False)(values, group_ids, vbase,
-                         o1, o2, l1, l2, t1, t2, n, ws, we)
+                         o1, o2, l1, l2, t1, t2, n, ws, we, ts)
 
 
 def distributed_window_agg(mesh: Mesh, ts_off, values, group_ids, wends, *,
                            range_ms, fn_name, params=(), agg_op="sum",
                            num_groups=1, base_ms=0, vbase=None,
-                           precorrected=False):
+                           precorrected=False, dense=True):
     """Eager wrapper: floats base_ms before the jit boundary (epoch-ms ints
     overflow int32 canonicalization on no-x64 TPU; see rangefns)."""
     if vbase is None:
@@ -296,13 +311,13 @@ def distributed_window_agg(mesh: Mesh, ts_off, values, group_ids, wends, *,
                                    params=params, agg_op=agg_op,
                                    num_groups=num_groups,
                                    base_ms=float(base_ms),
-                                   precorrected=precorrected)
+                                   precorrected=precorrected, dense=dense)
 
 
 @functools.partial(
     jax.jit,
     static_argnames=("mesh", "fn_name", "params", "agg_op", "num_groups",
-                     "precorrected"))
+                     "precorrected", "dense"))
 def _distributed_window_agg(mesh: Mesh,
                            ts_off: jax.Array, values: jax.Array,
                            group_ids: jax.Array, wends: jax.Array,
@@ -311,7 +326,8 @@ def _distributed_window_agg(mesh: Mesh,
                            params: Tuple[float, ...] = (),
                            agg_op: str = "sum", num_groups: int = 1,
                            base_ms: int = 0,
-                           precorrected: bool = False) -> jax.Array:
+                           precorrected: bool = False,
+                           dense: bool = True) -> jax.Array:
     """Full distributed query step: windowed range function + cross-shard
     aggregate, SPMD over the ('shard', 'time') mesh.
 
@@ -329,7 +345,8 @@ def _distributed_window_agg(mesh: Mesh,
         res = evaluate_range_function(ts_blk[0], val_blk[0], wends_blk,
                                       range_ms, fn_name, params, base_ms,
                                       vbase=vbase_blk[0],
-                                      precorrected=precorrected)
+                                      precorrected=precorrected,
+                                      dense=dense)
         part = agg_ops.map_phase(agg_op, res, gid_blk[0], num_groups)
         combs = agg_ops.combiners_for(agg_op, part.shape[-1])
         if len(set(combs)) == 1:
@@ -347,18 +364,19 @@ def _distributed_window_agg(mesh: Mesh,
 
 def distributed_window_raw(mesh: Mesh, ts_off, values, wends, *, range_ms,
                            fn_name, params=(), base_ms=0, vbase=None,
-                           precorrected=False):
+                           precorrected=False, dense=True):
     """Eager wrapper: floats base_ms (see distributed_window_agg)."""
     if vbase is None:
         vbase = jnp.zeros(values.shape[:2], values.dtype)
     return _distributed_window_raw(mesh, ts_off, values, wends, vbase,
                                    range_ms=range_ms, fn_name=fn_name,
                                    params=params, base_ms=float(base_ms),
-                                   precorrected=precorrected)
+                                   precorrected=precorrected, dense=dense)
 
 
 @functools.partial(
-    jax.jit, static_argnames=("mesh", "fn_name", "params", "precorrected"))
+    jax.jit, static_argnames=("mesh", "fn_name", "params", "precorrected",
+                              "dense"))
 def _distributed_window_raw(mesh: Mesh,
                            ts_off: jax.Array, values: jax.Array,
                            wends: jax.Array, vbase: jax.Array,
@@ -366,7 +384,8 @@ def _distributed_window_raw(mesh: Mesh,
                            fn_name: Optional[str],
                            params: Tuple[float, ...] = (),
                            base_ms: int = 0,
-                           precorrected: bool = False) -> jax.Array:
+                           precorrected: bool = False,
+                           dense: bool = True) -> jax.Array:
     """Un-aggregated distributed evaluation -> [D, S, W] (the DistConcatExec
     analogue: per-shard results stay sharded; host gathers lazily)."""
 
@@ -374,7 +393,8 @@ def _distributed_window_raw(mesh: Mesh,
         res = evaluate_range_function(ts_blk[0], val_blk[0], wends_blk,
                                       range_ms, fn_name, params, base_ms,
                                       vbase=vbase_blk[0],
-                                      precorrected=precorrected)
+                                      precorrected=precorrected,
+                                      dense=dense)
         return res[None]
 
     return jax.shard_map(
@@ -606,7 +626,7 @@ class MeshExecutor:
             wends_dev, range_ms=range_ms, fn_name=fn_name, params=params,
             agg_op=agg_op, num_groups=packed.num_groups,
             base_ms=packed.base_ms, vbase=packed.vbase,
-            precorrected=packed.precorrected)
+            precorrected=packed.precorrected, dense=packed.dense)
         out = agg_ops.present(agg_op, partials)
         return np.asarray(out)[:, :W], packed.group_labels
 
@@ -674,8 +694,8 @@ class MeshExecutor:
             mats = tuple(
                 jax.device_put(st(a), NamedSharding(
                     self.mesh, P("time", None, None)))
-                for a in ("o1", "o2", "l1", "l2",
-                          "t1", "t2", "n", "wstart_x", "wend_x", "n1"))
+                for a in ("o1", "o2", "l1", "l2", "t1", "t2", "n",
+                          "wstart_x", "wend_x", "n1", "tsrow"))
             wvalid = np.concatenate([p.wvalid for p in plans])
             wvalid1 = np.concatenate([p.wvalid1 for p in plans])
             ent = (mats, wvalid, wvalid1)
@@ -688,7 +708,8 @@ class MeshExecutor:
         over_time = fn_name in pf.OVER_TIME_FNS
         # the kernel's `n` slot carries TRUE counts for the over_time
         # kinds and the rate family's clamped counts otherwise
-        mats = mats[:6] + ((mats[9] if over_time else mats[6]),) + mats[7:9]
+        mats = (mats[:6] + ((mats[9] if over_time else mats[6]),)
+                + mats[7:9] + (mats[10],))
         vbase = packed.vbase
         if vbase is None:
             vbase = jax.device_put(

@@ -285,7 +285,7 @@ class PeriodicSamplesMapper(RangeVectorTransformer):
                 jnp.asarray(wends_off), window, fn,
                 tuple(self.function_args), base_ms=kernel_base,
                 vbase=vb_flat, precorrected=data.precorrected,
-                shared_grid=shared))
+                shared_grid=shared, dense=data.dense))
             out = np.moveaxis(out.reshape(S, B, -1), 1, 2)     # [S, W, B]
         else:
             out = np.asarray(evaluate_range_function(
@@ -293,7 +293,8 @@ class PeriodicSamplesMapper(RangeVectorTransformer):
                 jnp.asarray(wends_off), window, fn,
                 tuple(self.function_args), base_ms=kernel_base,
                 vbase=None if vb is None else jnp.asarray(vb),
-                precorrected=data.precorrected, shared_grid=shared))
+                precorrected=data.precorrected, shared_grid=shared,
+                dense=data.dense))
         if fn == "timestamp":
             out = out.astype(np.float64) + base / 1000.0
         return ResultBlock(data.keys, wends, out, data.bucket_les)
@@ -1041,7 +1042,9 @@ class MultiSchemaPartitionsExec(LeafExecPlan):
         Tp = pf._pad_to(vals.shape[1], pf._LANE)
         Wp = pf._pad_to(eval_wends.size, pf._LANE)
         over_time = t0.function in pf.OVER_TIME_FNS
-        if pf.vmem_estimate(Tp, Wp, 8, over_time) > pf.VMEM_BUDGET:
+        ragged_rate = not dense and fn in ("rate", "increase", "delta")
+        if pf.vmem_estimate(Tp, Wp, 8, over_time,
+                            ragged_rate) > pf.VMEM_BUDGET:
             return None
         from filodb_tpu.utils.metrics import registry
         # plan + prepared-input caches: a repeat query over an unchanged
@@ -1077,7 +1080,7 @@ class MultiSchemaPartitionsExec(LeafExecPlan):
         # VMEM guard, part 2: full estimate now that group count is known —
         # BEFORE the padded device copy, so diverted queries cost nothing
         if pf.vmem_estimate(Tp, Wp, max(num_slots, 8),
-                            over_time) > pf.VMEM_BUDGET:
+                            over_time, ragged_rate) > pf.VMEM_BUDGET:
             return None
         if padded_vals is None:
             vbase = data.vbase
@@ -1405,8 +1408,10 @@ class MultiSchemaPartitionsExec(LeafExecPlan):
             precorrected = counter_col   # mirror corrects counter columns
             shared_ts_row = mirror.fused_eligible(col_name, snap,
                                                   allow_ragged=True)
-            dense = shared_ts_row is not None and mirror.col_dense(col_name,
-                                                                   snap)
+            # col_dense is grid-independent (counted cells finite; pads are
+            # excluded via PAD_TS), so a non-shared grid with finite values
+            # keeps the cheap slot-boundary rate path
+            dense = mirror.col_dense(col_name, snap)
             if shared_ts_row is not None:
                 # cache identity for the fused path's prepared-input reuse
                 # (mirror.serial, not id(): ids are reused after GC; raw
@@ -1423,6 +1428,9 @@ class MultiSchemaPartitionsExec(LeafExecPlan):
             precorrected = counter_col and fn_is_counter
             vals, vbase = counter_ops.rebase_values(cols[col_name],
                                                     precorrected)
+            # NaN anywhere (staleness markers or ragged-length padding)
+            # routes the rate family onto its valid-boundary variant
+            dense = not bool(np.isnan(vals).any())
         keys = shard.keys_for(pids)
         stats.series_scanned = int(pids.size)
         stats.samples_scanned = int(counts.sum())
@@ -1544,7 +1552,10 @@ class DistConcatExec(NonLeafExecPlan):
                             raws[0].bucket_les,
                             samples=sum(r.samples for r in raws),
                             vbase=vbase,
-                            precorrected=all(r.precorrected for r in raws))
+                            precorrected=all(r.precorrected for r in raws),
+                            # pad NaNs live at PAD_TS slots (excluded via
+                            # ts), so raggedness merges as AND over blocks
+                            dense=all(r.dense for r in raws))
         return concat_blocks(blocks)
 
 
@@ -1804,7 +1815,7 @@ class SubqueryExec(NonLeafExecPlan):
         out = np.asarray(evaluate_range_function(
             jnp.asarray(ts_off), jnp.asarray(vals), jnp.asarray(eval_wends),
             self.subquery_window_ms, self.function, self.function_args,
-            base_ms=base))
+            base_ms=base, dense=not bool(np.isnan(vals).any())))
         return ResultBlock(block.keys, wends, out)
 
 

@@ -76,21 +76,31 @@ def eval_series(ts: np.ndarray, vals: np.ndarray, wends: Sequence[int],
                 out[i] = 1.0
             continue
         if fn == "rate" or fn == "increase":
-            if len(wt) >= 2:
-                out[i] = extrapolated_rate(wend - range_ms, wend, len(wt),
-                                           wt[0], wc[0], wt[-1], wc[-1],
+            # NaN slots are ABSENT samples (staleness markers): upstream
+            # filters them out of range vectors before rate math, so the
+            # boundaries are the first/last VALID samples and n counts
+            # valid samples only (Prometheus extrapolatedRate contract)
+            if mask.sum() >= 2:
+                vt, vc = wt[mask], wc[mask]
+                out[i] = extrapolated_rate(wend - range_ms, wend,
+                                           int(mask.sum()),
+                                           vt[0], vc[0], vt[-1], vc[-1],
                                            True, fn == "rate")
         elif fn == "delta":
-            if len(wt) >= 2:
-                out[i] = extrapolated_rate(wend - range_ms, wend, len(wt),
-                                           wt[0], wv[0], wt[-1], wv[-1],
+            if mask.sum() >= 2:
+                vt, vd = wt[mask], wv[mask]
+                out[i] = extrapolated_rate(wend - range_ms, wend,
+                                           int(mask.sum()),
+                                           vt[0], vd[0], vt[-1], vd[-1],
                                            False, False)
         elif fn == "irate":
-            if len(wt) >= 2:
-                out[i] = (wc[-1] - wc[-2]) / ((wt[-1] - wt[-2]) / 1000.0)
+            if mask.sum() >= 2:
+                vt, vc = wt[mask], wc[mask]
+                out[i] = (vc[-1] - vc[-2]) / ((vt[-1] - vt[-2]) / 1000.0)
         elif fn == "idelta":
-            if len(wt) >= 2:
-                out[i] = wv[-1] - wv[-2]
+            if mask.sum() >= 2:
+                vd = wv[mask]
+                out[i] = vd[-1] - vd[-2]
         elif fn == "sum_over_time":
             # all-NaN windows are absent: the reference accumulator starts
             # at NaN and only zeroes on the first non-NaN chunk (ref:

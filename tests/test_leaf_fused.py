@@ -67,18 +67,29 @@ def test_fused_skipped_on_ragged_grid(fused_env):
     assert a and b
 
 
-def test_fused_skipped_with_nan_values(fused_env):
-    """A NaN sample value inside the grid disqualifies the column."""
+def test_fused_ragged_counter_engages_and_matches(fused_env):
+    """NaN scrape gaps no longer disqualify the rate family (r4): the
+    ragged kernel variant engages and matches the general path, which
+    itself runs valid-boundary semantics on ragged data."""
     batch = counter_batch(8, T, start_ms=START_MS)
     vals = batch.columns["count"].copy()
-    vals[T + 3] = np.nan                 # one NaN in series 1
+    rng = np.random.default_rng(3)
+    vals[rng.random(vals.shape) < 0.1] = np.nan      # scrape gaps
     batch = RecordBatch(batch.schema, batch.part_keys, batch.part_idx,
                         batch.timestamps, {"count": vals}, batch.bucket_les)
     engine = _mk_engine([batch])
+    base = _query(engine)                # mirror warm-up
     before = _fused_count()
-    res = _query(engine)
-    assert _fused_count() == before
-    assert res
+    got = _query(engine)
+    assert _fused_count() > before, \
+        "ragged counter should engage the fused kernel"
+    import os
+    os.environ.pop("FILODB_TPU_FUSED_INTERPRET", None)
+    want = _query(engine)
+    assert set(got) == set(want)
+    for k in want:
+        np.testing.assert_allclose(got[k], want[k], rtol=2e-5, atol=1e-4,
+                                   equal_nan=True)
 
 
 def test_fused_engages_after_incremental_append(fused_env):

@@ -138,10 +138,14 @@ def test_can_fuse_gate():
     assert can_fuse("min_over_time", "sum", True, True)  # reduce_window
     assert can_fuse("count_over_time", "max", True, True)
     assert not can_fuse("rate", "sum", False, True)   # no shared grid
-    assert not can_fuse("rate", "sum", True, False)   # NaN holes: no rate
-    assert can_fuse("sum_over_time", "sum", True, False)   # ragged ok
+    # r4: the whole fusable set takes ragged rows (valid-boundary scans
+    # for the rate family, validity one-hot for last_over_time)
+    assert can_fuse("rate", "sum", True, False)
+    assert can_fuse("increase", "avg", True, False)
+    assert can_fuse("delta", "sum", True, False)
+    assert can_fuse("sum_over_time", "sum", True, False)
     assert can_fuse("min_over_time", "avg", True, False)
-    assert not can_fuse("last_over_time", "sum", True, False)
+    assert can_fuse("last_over_time", "sum", True, False)
 
 
 @pytest.mark.parametrize("fn", ["sum_over_time", "avg_over_time"])
@@ -418,3 +422,128 @@ def test_minmax_inf_samples_not_absent(fn):
         np.testing.assert_allclose(got, want, equal_nan=True)
         # group 1 = {all-inf series, nan/inf series} -> +inf, never NaN
         assert np.isinf(got[1]).all(), got
+
+
+# --------------------- r4: ragged rate family (VERDICT r3 item 2)
+
+def _mk_ragged_counters(S=64, T=120, G=4, seed=11, hole_frac=0.15,
+                        resets_per_series=2):
+    """Production-shaped counters: NaN scrape gaps + mid-series restarts."""
+    rng = np.random.default_rng(seed)
+    ts_row = np.arange(T, dtype=np.int64) * START_STEP
+    raw = np.cumsum(rng.exponential(10.0, size=(S, T)), axis=1)
+    for s in range(S):
+        for r in rng.choice(np.arange(6, T), size=resets_per_series,
+                            replace=False):
+            raw[s, r:] = raw[s, r:] - raw[s, r - 1] + rng.exponential(5.0)
+    raw[rng.random((S, T)) < hole_frac] = np.nan
+    gids = (np.arange(S) % G).astype(np.int32)
+    return ts_row, raw, gids
+
+
+def _oracle_group_sum(ts_row, raw, gids, wends, range_ms, fn, G):
+    from oracle import eval_series
+    per = np.stack([eval_series(ts_row, raw[s], wends, range_ms, fn)
+                    for s in range(raw.shape[0])])
+    sums = np.zeros((G, len(wends)))
+    counts = np.zeros((G, len(wends)))
+    for s in range(raw.shape[0]):
+        m = ~np.isnan(per[s])
+        sums[gids[s], m] += per[s, m]
+        counts[gids[s]] += m
+    return np.where(counts > 0, sums, np.nan)
+
+
+@pytest.mark.parametrize("fn,precor", [
+    ("rate", False), ("rate", True), ("increase", False),
+    ("increase", True), ("delta", False)])
+def test_fused_ragged_rate_family_vs_oracle(fn, precor):
+    """Ragged counters with resets stay on the one-pass kernel: in-kernel
+    fill scans find each series' valid window boundaries and the result
+    matches the scalar f64 oracle (NaN slots are absent samples, skipped
+    like upstream's range-vector marker filtering)."""
+    ts_row, raw, gids = _mk_ragged_counters()
+    G = 4
+    range_ms = 30 * START_STEP
+    wends = make_window_ends(40 * START_STEP, 110 * START_STEP,
+                             6 * START_STEP)
+    plan = build_plan(ts_row, wends, range_ms)
+    reb, vbase = rebase_values(raw, precor and fn != "delta")
+    sums, counts = fused_rate_groupsum(
+        reb.astype(np.float32), vbase.astype(np.float32), gids, plan, G,
+        fn_name=fn, precorrected=precor, interpret=True, ragged=True)
+    got = present_sum(sums, counts)
+    want = _oracle_group_sum(ts_row, raw, gids, wends, range_ms, fn, G)
+    assert (np.isnan(got) == np.isnan(want)).all()
+    np.testing.assert_allclose(got, want, rtol=5e-4, atol=1e-4,
+                               equal_nan=True)
+
+
+def test_general_path_ragged_rate_vs_oracle():
+    """dense=False routes the general XLA path onto valid boundaries; the
+    result matches the oracle exactly in f64 (including windows whose edge
+    slots are NaN holes — previously poisoned to NaN)."""
+    from oracle import eval_series
+    ts_row, raw, gids = _mk_ragged_counters(S=24, T=90, seed=3)
+    range_ms = 30 * START_STEP
+    wends = make_window_ends(40 * START_STEP, 80 * START_STEP,
+                             4 * START_STEP)
+    ts_off = ts_row.astype(np.int32)[None, :]
+    for fn in ("rate", "increase", "delta", "irate", "idelta"):
+        got = np.asarray(evaluate_range_function(
+            jnp.asarray(ts_off), jnp.asarray(raw),
+            jnp.asarray(wends.astype(np.int32)), range_ms, fn,
+            shared_grid=True, dense=False))
+        want = np.stack([eval_series(ts_row, raw[s], wends, range_ms, fn)
+                         for s in range(raw.shape[0])])
+        assert (np.isnan(got) == np.isnan(want)).all(), fn
+        np.testing.assert_allclose(got, want, rtol=1e-9, atol=1e-12,
+                                   equal_nan=True, err_msg=fn)
+
+
+def test_general_path_dense_flag_degenerates_on_dense_data():
+    """On hole-free data the valid-boundary variant must equal the slot
+    variant bit-for-bit."""
+    ts_row, raw, gids = _mk(S=16, T=80, G=2, resets=True, seed=9)
+    range_ms = 20 * START_STEP
+    wends = make_window_ends(25 * START_STEP, 75 * START_STEP,
+                             5 * START_STEP)
+    ts_off = ts_row.astype(np.int32)[None, :]
+    for fn in ("rate", "irate", "idelta"):
+        a = np.asarray(evaluate_range_function(
+            jnp.asarray(ts_off), jnp.asarray(raw),
+            jnp.asarray(wends.astype(np.int32)), range_ms, fn,
+            shared_grid=True, dense=True))
+        b = np.asarray(evaluate_range_function(
+            jnp.asarray(ts_off), jnp.asarray(raw),
+            jnp.asarray(wends.astype(np.int32)), range_ms, fn,
+            shared_grid=True, dense=False))
+        np.testing.assert_array_equal(a, b, err_msg=fn)
+
+
+def test_fused_ragged_last_over_time_slot_semantics():
+    """last_over_time keeps SLOT semantics on ragged rows: a NaN in the
+    newest in-window slot is a staleness marker (absent), not a hole to
+    skip — matching the general path."""
+    S, T, G = 16, 60, 2
+    rng = np.random.default_rng(7)
+    ts_row = np.arange(T, dtype=np.int64) * START_STEP
+    raw = 50.0 + rng.random((S, T))
+    raw[rng.random((S, T)) < 0.3] = np.nan
+    raw[0, :] = np.nan                    # fully-stale series
+    gids = (np.arange(S) % G).astype(np.int32)
+    range_ms = 5 * START_STEP
+    wends = make_window_ends(10 * START_STEP, 55 * START_STEP,
+                             3 * START_STEP)
+    plan = build_plan(ts_row, wends, range_ms)
+    reb, vbase = rebase_values(raw, False)
+    sums, counts = fused_rate_groupsum(
+        reb.astype(np.float32), vbase.astype(np.float32), gids, plan, G,
+        fn_name="last_over_time", interpret=True, ragged=True)
+    got = present_sum(sums, counts)
+    want = _xla_overtime(ts_row, reb.astype(np.float32),
+                         vbase.astype(np.float32), gids, wends, range_ms,
+                         "last_over_time", G)
+    assert (np.isnan(got) == np.isnan(want)).all()
+    np.testing.assert_allclose(got, want, rtol=2e-5, atol=1e-4,
+                               equal_nan=True)

@@ -48,7 +48,8 @@ def _run_kernel(ts_list, vals_list, wends, range_ms, fn, params=()):
     wends_off = (np.asarray(wends, dtype=np.int64) - base).astype(np.int32)
     out = evaluate_range_function(jnp.asarray(ts_off), jnp.asarray(val_mat),
                                   jnp.asarray(wends_off), range_ms, fn,
-                                  tuple(params), base_ms=base)
+                                  tuple(params), base_ms=base,
+                                  dense=not bool(np.isnan(val_mat).any()))
     return np.asarray(out)
 
 

@@ -67,7 +67,8 @@ def _run_kernel_f32(ts, vals_abs, wends, fn, params=()):
         out = evaluate_range_function(
             jnp.asarray(ts_off), jnp.asarray(rebased),
             jnp.asarray(wends.astype(np.int32)), RANGE_MS, fn,
-            tuple(params), vbase=jnp.asarray(vbase.astype(np.float32)))
+            tuple(params), vbase=jnp.asarray(vbase.astype(np.float32)),
+            dense=not bool(np.isnan(vals_abs).any()))
         return np.asarray(out)
 
 
