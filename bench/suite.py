@@ -14,6 +14,7 @@ The headline driver benchmark stays in bench.py at the repo root.
 from __future__ import annotations
 
 import argparse
+import os
 import json
 import time
 from typing import Callable, Dict, List
@@ -586,6 +587,47 @@ def bench_downsample(quick: bool):
           chunks_written=stats.chunks_written)
 
 
+def bench_downsample_dist(quick: bool):
+    """Distributed downsampler rollup throughput vs worker count: shard
+    splits over worker processes on the shared local store (ref:
+    DownsamplerMain.scala:64-90 Spark fan-out over scan splits).  Reports
+    samples rolled/s for 1 worker and N workers — on a multi-core host the
+    scaling approaches N x; this 1-core CI box mostly shows the fan-out
+    machinery overhead staying small."""
+    import tempfile
+
+    from filodb_tpu.core.memstore import TimeSeriesMemStore
+    from filodb_tpu.core.store import InMemoryMetaStore
+    from filodb_tpu.downsample.dist_job import DistributedDownsamplerJob
+    from filodb_tpu.ingest.generator import counter_batch, gauge_batch
+    from filodb_tpu.persist.localstore import LocalDiskColumnStore
+
+    shards, S, T = (2, 100, 240) if quick else (6, 400, 720)
+    tmp = tempfile.mkdtemp(prefix="bench_dsdist_")
+    raw_root = os.path.join(tmp, "raw")
+    cs = LocalDiskColumnStore(raw_root)
+    ms = TimeSeriesMemStore(column_store=cs, meta_store=InMemoryMetaStore())
+    for sh in range(shards):
+        s = ms.setup("prometheus", sh)
+        s.ingest(gauge_batch(S // 2, T, start_ms=START, seed=sh))
+        s.ingest(counter_batch(S // 2, T, start_ms=START, seed=sh + 100))
+        s.flush_all_groups()
+    cs.close()
+    samples = shards * S * T
+    for workers in (1, 2 if quick else 4):
+        ds_root = os.path.join(tmp, f"ds_w{workers}")
+        job = DistributedDownsamplerJob(raw_root, ds_root, "prometheus",
+                                        workers=workers,
+                                        resolutions=(300_000,))
+        t0 = time.perf_counter()
+        stats = job.run(list(range(shards)), START, START + T * 10_000)
+        dt = time.perf_counter() - t0
+        _emit("downsample_dist", f"rolled_samples_per_sec_w{workers}",
+              samples / dt, "samples/s", workers=workers, shards=shards,
+              parts=stats.parts_scanned,
+              records_emitted=stats.records_emitted)
+
+
 def bench_dispatch(quick: bool):
     """Cross-node query dispatch QPS over the TCP wire (the Akka-remoting
     analogue; ref: exec/PlanDispatcher.scala:20-57, client/Serializer —
@@ -653,6 +695,7 @@ BENCHES: Dict[str, Callable[[bool], None]] = {
     "dispatch": bench_dispatch,
     "persist": bench_persist,
     "downsample": bench_downsample,
+    "downsample_dist": bench_downsample_dist,
     "ingestion": bench_ingestion,
     "intsum": bench_intsum,
     "utf8": bench_utf8,

@@ -48,6 +48,16 @@ class ColumnStore:
                     start_time_ms: int, end_time_ms: int) -> List[ChunkSet]:
         raise NotImplementedError
 
+    def scan_chunks_by_ingestion_time(self, dataset: str, shard: int,
+                                      ingestion_start_ms: int,
+                                      ingestion_end_ms: int):
+        """Yield (PartKey, schema_name, ChunkSet) for chunks INGESTED in the
+        window — the batch downsampler's read path (ref:
+        cassandra/.../IngestionTimeIndexTable.scala; DownsamplerMain reads
+        raw chunks by ingestion-time range so late-arriving data is
+        caught)."""
+        raise NotImplementedError
+
     def all_part_keys(self, dataset: str, shard: int) -> List[PartKeyRecord]:
         return self.read_part_keys(dataset, shard)
 
@@ -132,6 +142,18 @@ class InMemoryColumnStore(ColumnStore):
                         and cs.info.end_time_ms >= start_time_ms):
                     out.append(cs)
             return out
+
+    def scan_chunks_by_ingestion_time(self, dataset, shard,
+                                      ingestion_start_ms, ingestion_end_ms):
+        with self._lock:
+            items = [(pkb, schema_name, cs)
+                     for (ds, sh, pkb), lst in self._chunks.items()
+                     if ds == dataset and sh == shard
+                     for schema_name, cs in lst
+                     if ingestion_start_ms <= cs.info.ingestion_time_ms
+                     < ingestion_end_ms]
+        for pkb, schema_name, cs in items:
+            yield PartKey.from_bytes(pkb), schema_name, cs
 
     def delete_part_keys(self, dataset, shard, part_keys) -> int:
         n = 0

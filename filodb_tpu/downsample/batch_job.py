@@ -52,27 +52,63 @@ class DownsamplerJob:
         self.resolutions = tuple(resolutions)
 
     def run(self, shards: Sequence[int], user_time_start: int,
-            user_time_end: int) -> DownsampleJobStats:
+            user_time_end: int,
+            ingestion_window: Optional[Sequence[int]] = None
+            ) -> DownsampleJobStats:
+        """ingestion_window (lo_ms, hi_ms): when given, chunks are selected
+        by INGESTION time via the store's ingestion-time scan — the
+        reference's read path, which catches late-arriving data whose user
+        time predates the job window by widening the scan backwards (ref:
+        DownsamplerMain.scala:64-90 ingestion-time range; the per-sample
+        user-time filter below still bounds what is rolled up)."""
         stats = DownsampleJobStats()
         for shard in shards:
-            self._run_shard(shard, user_time_start, user_time_end, stats)
+            self._run_shard(shard, user_time_start, user_time_end, stats,
+                            ingestion_window)
         return stats
 
-    def _run_shard(self, shard: int, t0: int, t1: int,
-                   stats: DownsampleJobStats) -> None:
-        now = int(time.time() * 1000)
+    def _downsamplable(self, rec) -> bool:
+        schema = self.schemas[rec.schema_name]
+        return bool(schema.downsamplers
+                    and schema.downsample_schema is not None)
+
+    def _chunks_for(self, shard: int, t0: int, t1: int,
+                    ingestion_window: Optional[Sequence[int]]):
+        """Yields (PartKeyRecord, [ChunkSet]) for the job window, by user
+        time (default, streamed one partition at a time) or by the widened
+        ingestion-time scan.  The schema downsampler gate applies BEFORE
+        any chunk read, so non-downsamplable partitions cost nothing."""
         pk_records = self.raw_store.read_part_keys(self.dataset, shard)
+        if ingestion_window is None:
+            for rec in pk_records:
+                if (self._downsamplable(rec) and rec.start_time_ms < t1
+                        and rec.end_time_ms >= t0):
+                    yield rec, self.raw_store.read_chunks(
+                        self.dataset, shard, rec.part_key, t0, t1 - 1)
+            return
+        by_pk = {rec.part_key.to_bytes(): rec for rec in pk_records
+                 if self._downsamplable(rec)}
+        grouped: Dict[bytes, list] = {}
+        lo, hi = int(ingestion_window[0]), int(ingestion_window[1])
+        for pk, _schema_name, cs in \
+                self.raw_store.scan_chunks_by_ingestion_time(
+                    self.dataset, shard, lo, hi):
+            b = pk.to_bytes()
+            if b in by_pk and cs.info.start_time_ms < t1 \
+                    and cs.info.end_time_ms >= t0:
+                grouped.setdefault(b, []).append(cs)
+        for b, chunks in grouped.items():
+            yield by_pk[b], chunks
+
+    def _run_shard(self, shard: int, t0: int, t1: int,
+                   stats: DownsampleJobStats,
+                   ingestion_window: Optional[Sequence[int]] = None) -> None:
+        now = int(time.time() * 1000)
         ds_pk_updates: Dict[int, List[PartKeyRecord]] = {
             r: [] for r in self.resolutions}
-        for rec in pk_records:
+        for rec, chunks in self._chunks_for(shard, t0, t1, ingestion_window):
             schema = self.schemas[rec.schema_name]
-            if not schema.downsamplers or schema.downsample_schema is None:
-                continue
-            if rec.start_time_ms >= t1 or rec.end_time_ms < t0:
-                continue
             stats.parts_scanned += 1
-            chunks = self.raw_store.read_chunks(self.dataset, shard,
-                                                rec.part_key, t0, t1 - 1)
             per_res: Dict[int, Dict[str, List[np.ndarray]]] = {}
             for cs in chunks:
                 stats.chunks_read += 1

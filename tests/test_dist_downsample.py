@@ -1,0 +1,179 @@
+"""Distributed batch downsampler: worker fan-out, split ledger resume,
+worker-death recovery, ingestion-time-widened scans.
+
+Models the reference's Spark-job behavior (ref: spark-jobs/.../downsampler/
+chunk/DownsamplerMain.scala:44-90 — parallel over store scan splits,
+restartable per split, executor loss requeues the partition)."""
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+from filodb_tpu.core.memstore import TimeSeriesMemStore
+from filodb_tpu.core.store import InMemoryMetaStore
+from filodb_tpu.downsample.batch_job import DownsamplerJob
+from filodb_tpu.downsample.dist_job import (DistributedDownsamplerJob,
+                                            SplitLedger, _split_id)
+from filodb_tpu.ingest.generator import gauge_batch
+from filodb_tpu.persist.localstore import LocalDiskColumnStore
+
+START = 1_600_000_020_000
+T = 240
+N_SHARDS = 4
+RES = 300_000
+
+
+def _mk_raw(tmp_path, n_shards=N_SHARDS, n_series=6):
+    raw_root = str(tmp_path / "raw")
+    cs = LocalDiskColumnStore(raw_root)
+    ms = TimeSeriesMemStore(column_store=cs, meta_store=InMemoryMetaStore())
+    for sh in range(n_shards):
+        s = ms.setup("prometheus", sh)
+        s.ingest(gauge_batch(n_series, T, start_ms=START, seed=sh))
+        s.flush_all_groups()
+    cs.close()
+    return raw_root
+
+
+def _ds_chunks_per_shard(ds_root, n_shards=N_SHARDS, res=RES):
+    cs = LocalDiskColumnStore(ds_root)
+    from filodb_tpu.downsample.store import ds_dataset_name
+    name = ds_dataset_name("prometheus", res)
+    out = [cs.num_chunksets(name, sh) for sh in range(n_shards)]
+    cs.close()
+    return out
+
+
+def test_distributed_matches_sequential(tmp_path):
+    raw_root = _mk_raw(tmp_path)
+    t0, t1 = START, START + T * 10_000
+
+    seq_root = str(tmp_path / "ds_seq")
+    seq = DownsamplerJob(LocalDiskColumnStore(raw_root),
+                         LocalDiskColumnStore(seq_root), "prometheus",
+                         resolutions=(RES,))
+    seq_stats = seq.run(list(range(N_SHARDS)), t0, t1)
+
+    dist_root = str(tmp_path / "ds_dist")
+    job = DistributedDownsamplerJob(raw_root, dist_root, "prometheus",
+                                    workers=3, resolutions=(RES,))
+    stats = job.run(list(range(N_SHARDS)), t0, t1)
+
+    assert stats.parts_scanned == seq_stats.parts_scanned
+    assert stats.records_emitted == seq_stats.records_emitted
+    assert stats.chunks_written == seq_stats.chunks_written
+    assert _ds_chunks_per_shard(dist_root) == _ds_chunks_per_shard(seq_root)
+    # every split completed exactly once
+    assert all(a == 1 for a in job.attempts.values())
+
+
+def test_worker_sigkill_requeues_split(tmp_path, monkeypatch):
+    raw_root = _mk_raw(tmp_path)
+    t0, t1 = START, START + T * 10_000
+    marker = str(tmp_path / "died.marker")
+    monkeypatch.setenv("FILODB_DS_DIE_MARKER", marker)
+    monkeypatch.setenv("FILODB_DS_DIE_SHARD", "2")
+
+    dist_root = str(tmp_path / "ds_dist")
+    job = DistributedDownsamplerJob(raw_root, dist_root, "prometheus",
+                                    workers=2, resolutions=(RES,))
+    stats = job.run(list(range(N_SHARDS)), t0, t1)
+
+    assert os.path.exists(marker), "hook should have fired"
+    assert job.attempts[2] == 2, "killed split must be retried"
+    assert all(job.attempts[s] == 1 for s in (0, 1, 3))
+    assert stats.parts_scanned == N_SHARDS * 6
+    assert min(_ds_chunks_per_shard(dist_root)) > 0
+
+
+def test_resume_from_ledger(tmp_path):
+    raw_root = _mk_raw(tmp_path)
+    t0, t1 = START, START + T * 10_000
+    dist_root = str(tmp_path / "ds_dist")
+    job = DistributedDownsamplerJob(raw_root, dist_root, "prometheus",
+                                    workers=2, resolutions=(RES,))
+    first = job.run(list(range(N_SHARDS)), t0, t1)
+    assert first.parts_scanned == N_SHARDS * 6
+
+    # a rerun of the same window resumes from the ledger: no new workers
+    job2 = DistributedDownsamplerJob(raw_root, dist_root, "prometheus",
+                                     workers=2, resolutions=(RES,))
+    again = job2.run(list(range(N_SHARDS)), t0, t1)
+    assert job2.attempts == {}, "all splits were already complete"
+    # aggregated stats come from the ledger, not from re-execution
+    assert again.parts_scanned == first.parts_scanned
+    assert again.records_emitted == first.records_emitted
+
+
+def test_exhausted_split_raises_then_resumes(tmp_path, monkeypatch):
+    raw_root = _mk_raw(tmp_path)
+    t0, t1 = START, START + T * 10_000
+    # marker is never created -> shard 1 dies on EVERY attempt
+    always_die = str(tmp_path / "never-created" / "marker")
+    monkeypatch.setenv("FILODB_DS_DIE_MARKER", always_die)
+    monkeypatch.setenv("FILODB_DS_DIE_SHARD", "1")
+
+    dist_root = str(tmp_path / "ds_dist")
+    job = DistributedDownsamplerJob(raw_root, dist_root, "prometheus",
+                                    workers=2, max_attempts=2,
+                                    resolutions=(RES,))
+    with pytest.raises(RuntimeError, match="shard 1"):
+        job.run(list(range(N_SHARDS)), t0, t1)
+    assert job.attempts[1] == 2
+    # the other splits completed and survived in the ledger
+    ledger = SplitLedger(os.path.join(dist_root, ".downsample_ledger",
+                                      f"prometheus_{t0}_{t1}.json"))
+    for sh in (0, 2, 3):
+        assert ledger.done(_split_id(sh, t0, t1))
+    assert not ledger.done(_split_id(1, t0, t1))
+
+    # heal the hook; rerun completes only the missing split
+    monkeypatch.delenv("FILODB_DS_DIE_MARKER")
+    monkeypatch.delenv("FILODB_DS_DIE_SHARD")
+    job2 = DistributedDownsamplerJob(raw_root, dist_root, "prometheus",
+                                     workers=2, resolutions=(RES,))
+    stats = job2.run(list(range(N_SHARDS)), t0, t1)
+    assert list(job2.attempts) == [1]
+    assert stats.parts_scanned == N_SHARDS * 6
+
+
+def test_ingestion_widened_scan(tmp_path):
+    """Chunks are selected by INGESTION time when a window is given: an
+    old-ingestion chunk is skipped, while late-arriving data (recent
+    ingestion, old user time) is caught — the reference's reason for
+    scanning by ingestion time (DownsamplerMain.scala:64-90)."""
+    raw_root = _mk_raw(tmp_path, n_shards=1)
+    t0, t1 = START, START + T * 10_000
+    now = int(time.time() * 1000)
+
+    raw = LocalDiskColumnStore(raw_root)
+    ds = LocalDiskColumnStore(str(tmp_path / "ds"))
+    job = DownsamplerJob(raw, ds, "prometheus", resolutions=(RES,))
+
+    # window covering the flush's ingestion time: everything rolls up
+    covered = job.run([0], t0, t1, ingestion_window=(now - 3_600_000,
+                                                     now + 60_000))
+    assert covered.parts_scanned == 6
+    assert covered.records_emitted > 0
+
+    # window strictly BEFORE the flush's ingestion time: nothing selected
+    job2 = DownsamplerJob(raw, LocalDiskColumnStore(str(tmp_path / "ds2")),
+                          "prometheus", resolutions=(RES,))
+    missed = job2.run([0], t0, t1, ingestion_window=(now - 7_200_000,
+                                                     now - 3_600_000))
+    assert missed.parts_scanned == 0
+    assert missed.records_emitted == 0
+
+
+def test_distributed_uses_widened_ingestion_scan(tmp_path):
+    raw_root = _mk_raw(tmp_path, n_shards=2)
+    t0, t1 = START, START + T * 10_000
+    dist_root = str(tmp_path / "ds_dist")
+    job = DistributedDownsamplerJob(raw_root, dist_root, "prometheus",
+                                    workers=2, resolutions=(RES,),
+                                    ingestion_widen_ms=3_600_000)
+    stats = job.run([0, 1], t0, t1)
+    assert stats.parts_scanned == 2 * 6
+    assert stats.records_emitted > 0
