@@ -141,20 +141,21 @@ class PartialWriter:
         os.replace(tmp, PARTIAL_PATH)
 
 
-def run_pallas_fused(ts_row, vals_dev, gids, wends, range_ms, G,
+def run_pallas_fused(ts_row, vals_dev, vbase32, gids, wends, range_ms, G,
                      xla_res, iters):
     """Time ops/pallas_fused for one config; cross-check against the XLA
     result when available.  Returns (p50_seconds, max_rel_err) where the
     error is inf when the NaN patterns disagree, and None when xla_res is
-    None (conformance then comes from a smaller stage)."""
+    None (conformance then comes from a smaller stage).  Values arrive
+    host-precorrected + rebased (leaf-path parity), so the kernel runs
+    with_drops=False — the same configuration the leaf exec uses."""
     from filodb_tpu.ops import pallas_fused as pf
-    S = vals_dev.shape[0]
     plan = pf.build_plan(ts_row, np.asarray(wends, np.int64), range_ms)
-    prep = pf.pad_inputs(vals_dev, np.zeros(S, np.float32), gids, plan, G)
+    prep = pf.pad_inputs(vals_dev, vbase32, gids, plan, G)
 
     def fused_query():
         sums, counts = pf.fused_rate_groupsum(
-            None, None, None, plan, G, "rate", False, prepared=prep)
+            None, None, None, plan, G, "rate", True, prepared=prep)
         return pf.present_sum(sums, counts)
 
     got = fused_query()                               # compile + warm
@@ -209,6 +210,26 @@ def measure_stage(S, T, iters, platform, do_fused, persist,
     stage = {"series": S, "samples_per_series": T, "groups": G}
 
     ts_row, vals = make_counter_data(S, T)
+    # leaf-path parity (r4): counters are reset-corrected + rebased in f64
+    # ON THE HOST once per working set — the DeviceMirror does exactly this
+    # at upload (core/devicecache.py refresh; ops/counter.rebase_values),
+    # so steady-state queries must NOT pay a per-query correction scan.
+    # Round 2/3 benches ran precorrected=False and the scan was ~90% of
+    # CPU query time (see doc/kernels.md, BENCH_TREND.json).
+    t0 = time.perf_counter()
+    # make_counter_data is monotone by construction, so the f64 reset
+    # correction (ops/counter.host_counter_correct) is the identity —
+    # only the f64 rebase matters for f32 delta exactness.  Chunked so
+    # the 1M-series stage doesn't materialize ~30 GB of f64 temporaries
+    # (the full rebase_values took 500s host-side at 1M x 720).
+    vbase64 = vals[:, 0].astype(np.float64)
+    vals32 = np.empty_like(vals, dtype=np.float32)
+    for i in range(0, S, 65_536):
+        j = min(i + 65_536, S)
+        vals32[i:j] = (vals[i:j].astype(np.float64)
+                       - vbase64[i:j, None]).astype(np.float32)
+    vbase32 = vbase64.astype(np.float32)
+    stage["host_prep_s"] = round(time.perf_counter() - t0, 2)
     # shared scrape grid: ship ONE [1, T] offset row and let it broadcast
     # (exact for every range fn — saves S*T*4 bytes of HBM at 1M series)
     ts_one = to_offsets(ts_row[None, :], np.full(1, T), 0)
@@ -224,14 +245,16 @@ def measure_stage(S, T, iters, platform, do_fused, persist,
     value_bytes = S * T * 4
 
     dev_ts = jax.device_put(ts_one)
-    dev_vals = jax.device_put(vals)
+    dev_vals = jax.device_put(vals32)
+    dev_vbase = jax.device_put(vbase32)
     dev_gids = jax.device_put(gids)
     dev_wends = jax.device_put(wends)
 
     @jax.jit
-    def query(ts_off, v, g, w):
+    def query(ts_off, v, vb, g, w):
         res = evaluate_range_function(ts_off, v, w, range_ms, "rate",
-                                      shared_grid=True)
+                                      shared_grid=True, vbase=vb,
+                                      precorrected=True)
         return agg_ops.aggregate("sum", res, g, G)
 
     xla_res = None
@@ -239,12 +262,14 @@ def measure_stage(S, T, iters, platform, do_fused, persist,
         t0 = time.perf_counter()
         # np.asarray forces execution AND result fetch: block_until_ready
         # is not a reliable completion barrier on the tunneled TPU backend
-        xla_res = np.asarray(query(dev_ts, dev_vals, dev_gids, dev_wends))
+        xla_res = np.asarray(query(dev_ts, dev_vals, dev_vbase, dev_gids,
+                                   dev_wends))
         stage["xla_compile_s"] = round(time.perf_counter() - t0, 2)
         lat = []
         for _ in range(iters):
             t0 = time.perf_counter()
-            np.asarray(query(dev_ts, dev_vals, dev_gids, dev_wends))
+            np.asarray(query(dev_ts, dev_vals, dev_vbase, dev_gids,
+                             dev_wends))
             lat.append(time.perf_counter() - t0)
         p50 = float(np.median(np.asarray(lat)))
         stage.update({
@@ -260,8 +285,9 @@ def measure_stage(S, T, iters, platform, do_fused, persist,
     if do_fused:
         try:
             fused_iters = max(3, iters // 2) if S >= 1 << 20 else iters
-            p50_f, err = run_pallas_fused(ts_row, dev_vals, gids, wends,
-                                          range_ms, G, xla_res, fused_iters)
+            p50_f, err = run_pallas_fused(ts_row, dev_vals, vbase32, gids,
+                                          wends, range_ms, G, xla_res,
+                                          fused_iters)
             stage["pallas_p50_s"] = round(p50_f, 5)
             stage["pallas_samples_per_sec"] = round(scanned / p50_f, 1)
             # one HBM pass over the values by construction
@@ -300,7 +326,8 @@ def measure_stage(S, T, iters, platform, do_fused, persist,
                 sub_res = xla_res
             else:
                 sub_res = np.asarray(query(dev_ts, dev_vals[:Sc],
-                                           dev_gids[:Sc], dev_wends))
+                                           dev_vbase[:Sc], dev_gids[:Sc],
+                                           dev_wends))
             checked_here = cpu_f64_conformance(
                 stage, sub_res, ts_row, vals[:Sc], gids[:Sc], G, wends,
                 range_ms)
@@ -329,7 +356,7 @@ def measure_stage(S, T, iters, platform, do_fused, persist,
             "samples_per_sec": round(scanned / p50, 1),
         })
     persist(stage)
-    del dev_ts, dev_vals, dev_gids, dev_wends
+    del dev_ts, dev_vals, dev_vbase, dev_gids, dev_wends
     return stage, ts_row, vals, gids, wends, range_ms, span_hi - span_lo
 
 

@@ -61,22 +61,54 @@ def host_counter_correct(vals: np.ndarray) -> np.ndarray:
 
 
 def rebase_values(vals: np.ndarray, correct_counter: bool,
-                  return_corrected: bool = False):
+                  return_corrected: bool = False,
+                  _block_rows: int = 65_536):
     """The single host-side prep step for device value columns: optional f64
     reset correction, then per-series rebasing.  Returns (rebased f64, vbase)
     with vbase [S] (or [S, B] for histograms) — plus the corrected f64
     matrix itself when return_corrected (so callers needing it don't run
     the O(S*T) correction scan twice).  Both the leaf exec raw path and the
     DeviceMirror upload MUST use this so the two paths cannot diverge
-    numerically."""
+    numerically.
+
+    Rows are processed in blocks (correction and rebasing are per-row
+    independent): at 1M x 720 the whole-matrix form materialized ~5 full
+    f64 temporaries (~30 GB) and took minutes host-side; blocking caps the
+    temporaries at ~block-sized arrays without changing any output bit."""
     from filodb_tpu.ops.timewindow import series_value_base
-    v64 = np.asarray(vals, dtype=np.float64)
-    if correct_counter:
-        v64 = host_counter_correct(v64)
-    vbase = series_value_base(v64)
-    rebased = v64 - (vbase[:, None, :] if v64.ndim == 3 else vbase[:, None])
+    v_in = np.asarray(vals)
+    S = v_in.shape[0]
+    if S <= _block_rows and v_in.dtype == np.float64:
+        v64 = v_in
+        if correct_counter:
+            v64 = host_counter_correct(v64)
+        vbase = series_value_base(v64)
+        rebased = v64 - (vbase[:, None, :] if v64.ndim == 3
+                         else vbase[:, None])
+        return (rebased, vbase, v64) if return_corrected \
+            else (rebased, vbase)
+    rebased = np.empty(v_in.shape, np.float64)
+    corrected = np.empty(v_in.shape, np.float64) if return_corrected \
+        else None
+    vbase_parts = []
+    for i in range(0, S, _block_rows):
+        j = min(i + _block_rows, S)
+        blk = v_in[i:j].astype(np.float64)
+        if correct_counter:
+            blk = host_counter_correct(blk)
+        vb = series_value_base(blk)
+        vbase_parts.append(vb)
+        rebased[i:j] = blk - (vb[:, None, :] if blk.ndim == 3
+                              else vb[:, None])
+        if corrected is not None:
+            corrected[i:j] = blk
+    if vbase_parts:
+        vbase = (np.concatenate(vbase_parts) if len(vbase_parts) > 1
+                 else vbase_parts[0])
+    else:
+        vbase = series_value_base(rebased)
     if return_corrected:
-        return rebased, vbase, v64
+        return rebased, vbase, corrected
     return rebased, vbase
 
 

@@ -89,7 +89,10 @@ class PackedShards:
     # series per aggregation group over REAL rows (for present-count math)
     gsize: Optional[np.ndarray] = None
     # False when any counted cell is non-finite: the rate family then runs
-    # its valid-boundary variant (staleness markers are absent samples)
+    # its valid-boundary variant (staleness markers are absent samples).
+    # Computed ONCE at pack time on the HOST arrays (packs are cached, so
+    # the boolean scan amortizes; post-device_put the values are sharded
+    # device arrays a lazy scan would have to transfer back).
     dense: bool = True
 
     @property
@@ -215,17 +218,12 @@ def pack_shards(blocks: Sequence[Tuple],
         if nser[d]:
             gsize += np.bincount(gids[d, :nser[d]],
                                  minlength=num_groups)[:num_groups]
-    # dense = every COUNTED cell finite (pad cells don't count); routes the
-    # general path's rate family to valid-boundary semantics when False.
-    # A surviving shared_row already proved finiteness above — skip the
-    # rescan (and its per-shard np.where temporaries) in that case.
-    dense = shared_row is not None
-    if not dense:
-        dense = all(
-            nser[d] == 0 or np.isfinite(
-                np.where(ts[d, :nser[d]] < PAD_TS,
-                         vals[d, :nser[d]], 0.0)).all()
-            for d in range(D))
+    # a surviving shared_row already proved every counted cell finite
+    dense = shared_row is not None or all(
+        nser[d] == 0
+        or bool((np.isfinite(vals[d, :nser[d]])
+                 | (ts[d, :nser[d]] >= PAD_TS)).all())
+        for d in range(D))
     return PackedShards(ts, vals, gids, num_groups,
                         labels_out, base_ms, nser,
                         vbase=vbase if any_vbase else None,
@@ -626,7 +624,10 @@ class MeshExecutor:
             wends_dev, range_ms=range_ms, fn_name=fn_name, params=params,
             agg_op=agg_op, num_groups=packed.num_groups,
             base_ms=packed.base_ms, vbase=packed.vbase,
-            precorrected=packed.precorrected, dense=packed.dense)
+            precorrected=packed.precorrected,
+            dense=(packed.dense
+                   if fn_name in ("rate", "increase", "delta",
+                                  "irate", "idelta") else True))
         out = agg_ops.present(agg_op, partials)
         return np.asarray(out)[:, :W], packed.group_labels
 

@@ -32,9 +32,16 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 STOP_FILE = os.path.join(REPO, "tools", "tpu_watch.stop")
 CACHE_DIR = os.path.join(REPO, ".jax_cache")
 
-sys.path.insert(0, REPO)
-from bench import _probe_default_backend as probe  # noqa: E402 — one
-# shared notion of "tunnel alive" between the bench supervisor and watcher
+# one shared notion of "tunnel alive" between the bench supervisor and the
+# watcher.  Loaded by file path: `import bench` would resolve to the
+# bench/ suite package, which shadows the bench.py module at repo root.
+import importlib.util  # noqa: E402
+
+_spec = importlib.util.spec_from_file_location(
+    "_bench_headline", os.path.join(REPO, "bench.py"))
+_bench = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(_bench)
+probe = _bench._probe_default_backend
 
 
 def utcnow():
