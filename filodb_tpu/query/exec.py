@@ -1,4 +1,4 @@
-"""ExecPlan — the distributed execution tree.
+"""ExecPlan — the distributed execution tree (facade).
 
 Mirrors the reference's exec framework (ref: query/.../exec/ExecPlan.scala:41,
 RangeVectorTransformer.scala:36, AggrOverRangeVectors.scala, BinaryJoinExec.scala,
@@ -14,2079 +14,41 @@ DistConcatExec.scala, StitchRvsExec.scala) with a TPU-first data plane:
 
 Dispatchers decouple tree topology from placement: InProcessPlanDispatcher
 runs a subtree inline; the cluster layer adds remote dispatch.
+
+Round 4: the implementation lives in execbase / transformers / leafexec /
+nonleaf / metaexec (each under 800 LoC); this module re-exports every name
+so existing import paths keep working.
 """
-from __future__ import annotations
-
-import dataclasses
-import logging
-import os
-import threading
-from typing import Callable, Dict, List, Optional, Sequence, Tuple, Union
-
-import numpy as np
-import jax.numpy as jnp
-
-from filodb_tpu.core.index import ColumnFilter, Equals
-from filodb_tpu.ops import agg as agg_ops
-from filodb_tpu.ops import hist as hist_ops
-from filodb_tpu.ops.instant import (INSTANT_FUNCTIONS, ARITH_OPERATORS,
-                                    COMPARISON_OPERATORS, apply_binary_op)
-from filodb_tpu.ops import counter as counter_ops
-from filodb_tpu.ops.rangefns import RANGE_FUNCTIONS, evaluate_range_function
-from filodb_tpu.ops.timewindow import PAD_TS, to_offsets, make_window_ends
-from filodb_tpu.query.rangevector import (QueryContext, QueryResult, QueryStats,
-                                          RangeVectorKey, ResultBlock,
-                                          concat_blocks, remove_nan_series)
-
-# --------------------------------------------------------------- data shapes
-
-
-@dataclasses.dataclass
-class RawBlock:
-    """Raw gathered samples for one schema on one shard: pre-step-grid.
-
-    values are REBASED per series (absolute value - vbase[s]) so counter
-    deltas survive the f32 device downcast; vbase is the per-series base
-    in f64 (None = not rebased).  See ops/timewindow.series_value_base."""
-    keys: List[RangeVectorKey]
-    ts_off: np.ndarray                  # int32 [S, T] offsets from base_ms
-    values: np.ndarray                  # [S, T] or [S, T, B]
-    base_ms: int
-    bucket_les: Optional[np.ndarray] = None
-    samples: int = 0                    # total valid samples (stats)
-    vbase: Optional[np.ndarray] = None  # [S] or [S, B]
-    precorrected: bool = False          # counter reset-correction done host-side
-    # shared scrape grid: row-0 ts offsets when ALL rows share one grid
-    # (the pallas_fused precondition, tracked by the device mirror); None
-    # otherwise.  `dense` qualifies it: True = no NaN holes anywhere in the
-    # counted region; False = NaN-holed values on the shared grid, which
-    # only the validity-weighted fused kinds accept.
-    shared_ts_row: Optional[np.ndarray] = None
-    dense: bool = True
-
-
-# Fused-leaf caches (see MultiSchemaPartitionsExec._try_fused): entries are
-# keyed by (mirror serial, snapshot gen, ...) so any ingest naturally
-# misses.  The VALUES cache holds the full padded device copies — shared
-# across grouping variants (they depend only on the working set) and
-# bounded in BYTES, since this HBM lives outside the DeviceMirror's own
-# hbm_limit_bytes accounting.  The GROUP cache holds the small per-grouping
-# gid arrays.
-_FUSED_PLAN_CACHE: Dict[Tuple, object] = {}
-_FUSED_VALS_CACHE: Dict[Tuple, object] = {}
-_FUSED_GROUP_CACHE: Dict[Tuple, Tuple] = {}
-# NaN-padded device copies for the reduce_window path's end=now shape,
-# keyed (working set, t_needed) — small cap: each entry pins a full copy
-_FUSED_MINMAX_PAD_CACHE: Dict[Tuple, object] = {}
-_FUSED_VALS_CACHE_BYTES: Optional[int] = None    # resolved lazily
-_MIRROR_LIMIT_SEEN: Optional[int] = None         # largest live mirror budget
-
-
-def _note_mirror_limit(limit_bytes: int) -> None:
-    """Record the largest DeviceMirror HBM budget actually constructed so
-    the fused-cache budget subtracts the REAL mirror share, not just the
-    compile-time default (review r3)."""
-    global _MIRROR_LIMIT_SEEN, _FUSED_VALS_CACHE_BYTES
-    if _MIRROR_LIMIT_SEEN is None or limit_bytes > _MIRROR_LIMIT_SEEN:
-        _MIRROR_LIMIT_SEEN = limit_bytes
-        _FUSED_VALS_CACHE_BYTES = None   # re-derive on next insert
-
-
-def _fused_vals_budget() -> int:
-    """Byte budget for the padded-values cache.  Configurable via
-    FILODB_TPU_FUSED_CACHE_BYTES; otherwise derived from the device's
-    reported HBM minus the live mirror budget so mirror + this cache +
-    headroom cannot exceed the chip (ADVICE r2: the old fixed 4 GiB
-    ignored the mirror's budget).  Resolved lazily — the backend is
-    already initialized by the time the first fused query inserts."""
-    global _FUSED_VALS_CACHE_BYTES
-    if _FUSED_VALS_CACHE_BYTES is not None:
-        return _FUSED_VALS_CACHE_BYTES
-    env = os.environ.get("FILODB_TPU_FUSED_CACHE_BYTES")
-    if env:
-        _FUSED_VALS_CACHE_BYTES = int(env)
-        return _FUSED_VALS_CACHE_BYTES
-    budget = 4 << 30
-    try:
-        import jax
-
-        from filodb_tpu.core.devicecache import DEFAULT_HBM_LIMIT_BYTES
-        mirror_limit = _MIRROR_LIMIT_SEEN or DEFAULT_HBM_LIMIT_BYTES
-        stats = jax.devices()[0].memory_stats() or {}
-        limit = int(stats.get("bytes_limit", 0))
-        if limit:
-            budget = min(budget,
-                         max(1 << 30, limit - mirror_limit - (2 << 30)))
-    except Exception:  # noqa: BLE001 — stats unavailable: keep the default
-        pass
-    _FUSED_VALS_CACHE_BYTES = budget
-    return budget
-# queries run on HTTP worker threads (http/server.py ThreadingHTTPServer) —
-# every cache read-modify-write holds this lock; the kernel runs outside it
-_FUSED_CACHE_LOCK = threading.Lock()
-
-
-class GroupCardinalityError(ValueError):
-    """group-by cardinality limit exceeded — a real query error that must
-    surface even from the fused fast path (everything else falls back)."""
-
-
-def _lru_touch(cache: Dict, key) -> object:
-    """Get + move-to-back (dicts iterate in insertion order, so eviction
-    pops the front = least-recently-used).  One idiom for all fused caches."""
-    val = cache.get(key)
-    if val is not None:
-        cache[key] = cache.pop(key)
-    return val
-
-
-def _vals_nbytes(v) -> int:
-    return int(v.vals_p.size * 4 + v.vbase_p.size * 4)
-
-
-def _group_cache_lookup(key, by, without):
-    """Cached (PaddedGroups, gkeys) for this working set + grouping, or
-    (None, None).  Pairs with _group_cache_insert — the two halves of the
-    group-cache protocol, shared by the kernel and reduce_window paths."""
-    if key is None:
-        return None, None
-    with _FUSED_CACHE_LOCK:
-        ent = _lru_touch(_FUSED_GROUP_CACHE, key + (by, without))
-    return ent if ent is not None else (None, None)
-
-
-def _group_cache_insert(key, by, without, groups, gkeys) -> None:
-    """Insert a (PaddedGroups, gkeys) entry, evicting entries from older
-    snapshot generations of the same mirror (each pins device arrays) and
-    capping the cache.  The single home of the group-cache write rules —
-    used by both the kernel path and the reduce_window path."""
-    if key is None:
-        return
-    group_key = key + (by, without)
-    with _FUSED_CACHE_LOCK:
-        for k in [k for k in _FUSED_GROUP_CACHE
-                  if k[0] == key[0] and k[1] != key[1]]:
-            del _FUSED_GROUP_CACHE[k]
-        _FUSED_GROUP_CACHE[group_key] = (groups, gkeys)
-        while len(_FUSED_GROUP_CACHE) > 16:
-            _FUSED_GROUP_CACHE.pop(next(iter(_FUSED_GROUP_CACHE)))
-
-
-def _vals_cache_insert(key, v) -> None:
-    _FUSED_VALS_CACHE[key] = v
-    while len(_FUSED_VALS_CACHE) > 4 or sum(
-            _vals_nbytes(e) for e in _FUSED_VALS_CACHE.values()
-            ) > _fused_vals_budget():
-        if len(_FUSED_VALS_CACHE) == 1:
-            break                        # always keep the entry just added
-        _FUSED_VALS_CACHE.pop(next(iter(_FUSED_VALS_CACHE)))
-
-
-@dataclasses.dataclass
-class ScalarResult:
-    """One value per step (scalar plans)."""
-    wends: np.ndarray                   # int64 [W]
-    values: np.ndarray                  # float [W]
-
-
-@dataclasses.dataclass
-class AggPartial:
-    """Partial aggregate: mesh-reducible (op-dependent) representation."""
-    op: str
-    group_keys: List[RangeVectorKey]
-    wends: np.ndarray
-    comp: Optional[np.ndarray] = None   # [G, W, C] associative component form
-    # candidate form (topk/bottomk/quantile/count_values): raw rows
-    cand_keys: Optional[List[RangeVectorKey]] = None
-    cand_vals: Optional[np.ndarray] = None   # [N, W]
-    cand_groups: Optional[np.ndarray] = None  # int [N] -> group_keys index
-    params: Tuple = ()
-    bucket_les: Optional[np.ndarray] = None  # hist_sum partials
-    # quantile(): mergeable centroid sketch [G, W, K, 2] — O(groups) wire
-    # cost instead of shipping every candidate series row
-    # (ref: QuantileRowAggregator.scala:87 t-digest partials)
-    sketch: Optional[np.ndarray] = None
-
-
-Data = Union[RawBlock, ResultBlock, ScalarResult, AggPartial, None]
-
-
-def _block_empty(wends: np.ndarray) -> ResultBlock:
-    return ResultBlock([], wends, np.zeros((0, len(wends))))
-
-
-# ------------------------------------------------------------- transformers
-
-
-class RangeVectorTransformer:
-    """ref: exec/RangeVectorTransformer.scala:36."""
-
-    def apply(self, data: Data, ctx: QueryContext, stats: QueryStats,
-              source=None) -> Data:
-        raise NotImplementedError
-
-    def args_str(self) -> str:
-        return ""
-
-    def __str__(self):
-        return f"{type(self).__name__}({self.args_str()})"
-
-
-@dataclasses.dataclass
-class PeriodicSamplesMapper(RangeVectorTransformer):
-    """Raw samples -> regular step grid, optional range function
-    (ref: exec/PeriodicSamplesMapper.scala:27)."""
-    start_ms: int
-    step_ms: int
-    end_ms: int
-    window_ms: Optional[int] = None     # None => plain lookback sampling
-    function: Optional[str] = None
-    function_args: Tuple[float, ...] = ()
-    offset_ms: int = 0
-    lookback_ms: int = 5 * 60 * 1000
-
-    def args_str(self):
-        return (f"start={self.start_ms}, step={self.step_ms}, end={self.end_ms}, "
-                f"window={self.window_ms}, functionId={self.function}, "
-                f"offset={self.offset_ms}")
-
-    def apply(self, data: Data, ctx: QueryContext, stats: QueryStats,
-              source=None) -> Data:
-        wends = make_window_ends(self.start_ms, self.end_ms, self.step_ms)
-        if data is None or (isinstance(data, RawBlock) and not data.keys):
-            return _block_empty(wends)
-        assert isinstance(data, RawBlock), "PeriodicSamplesMapper needs raw data"
-        window = self.window_ms if self.window_ms else self.lookback_ms
-        fn = self.function
-        base = data.base_ms
-        # timestamp(): the kernel computes f32 offset-seconds (exact for
-        # query-sized ranges); the epoch base adds back below in f64 — f32
-        # cannot hold epoch seconds to sub-minute precision
-        kernel_base = 0 if fn == "timestamp" else base
-        # offset: shift the window grid back, evaluate, keep original stamps
-        eval_wends = wends - self.offset_ms
-        wends_off = (eval_wends - base).astype(np.int32)
-        vals = data.values
-        vb = data.vbase
-        # shared scrape grid: ship ONE [1, T] offset row and let it
-        # broadcast through the kernel (exact for every range function —
-        # window bounds come from row 0 and every gather takes the
-        # column fast path).  Halves the general path's HBM timestamp
-        # traffic and skips the S-fold ts transfer entirely.
-        shared = data.shared_ts_row is not None
-        ts_in = data.ts_off[:1] if shared else data.ts_off
-        if vals.ndim == 3:
-            S, T, B = vals.shape
-            flat = np.moveaxis(vals, 2, 1).reshape(S * B, T)
-            ts_rep = ts_in if shared else np.repeat(data.ts_off, B, axis=0)
-            vb_flat = None if vb is None else jnp.asarray(vb).reshape(S * B)
-            out = np.asarray(evaluate_range_function(
-                jnp.asarray(ts_rep), jnp.asarray(flat),
-                jnp.asarray(wends_off), window, fn,
-                tuple(self.function_args), base_ms=kernel_base,
-                vbase=vb_flat, precorrected=data.precorrected,
-                shared_grid=shared, dense=data.dense))
-            out = np.moveaxis(out.reshape(S, B, -1), 1, 2)     # [S, W, B]
-        else:
-            out = np.asarray(evaluate_range_function(
-                jnp.asarray(ts_in), jnp.asarray(vals),
-                jnp.asarray(wends_off), window, fn,
-                tuple(self.function_args), base_ms=kernel_base,
-                vbase=None if vb is None else jnp.asarray(vb),
-                precorrected=data.precorrected, shared_grid=shared,
-                dense=data.dense))
-        if fn == "timestamp":
-            out = out.astype(np.float64) + base / 1000.0
-        return ResultBlock(data.keys, wends, out, data.bucket_les)
-
-
-@dataclasses.dataclass
-class RepeatToGridMapper(RangeVectorTransformer):
-    """PromQL `@` modifier finisher: the upstream mapper evaluated on a
-    single-step grid pinned at the @ timestamp; tile that one column
-    across the query's output grid (Prometheus: the pinned value at every
-    step)."""
-    start_ms: int
-    step_ms: int
-    end_ms: int
-
-    def args_str(self):
-        return (f"start={self.start_ms}, step={self.step_ms}, "
-                f"end={self.end_ms}")
-
-    def apply(self, data: Data, ctx: QueryContext, stats: QueryStats,
-              source=None) -> Data:
-        wends = make_window_ends(self.start_ms, self.end_ms, self.step_ms)
-        if data is None:
-            return None
-        assert isinstance(data, ResultBlock), "@ repeat needs periodic data"
-        vals = np.asarray(data.values)
-        assert vals.shape[1] == 1, "@ inner grid must be single-step"
-        reps = (1, len(wends)) + (1,) * (vals.ndim - 2)
-        return ResultBlock(data.keys, wends, np.tile(vals, reps),
-                           data.bucket_les)
-
-
-@dataclasses.dataclass
-class InstantVectorFunctionMapper(RangeVectorTransformer):
-    """ref: exec/RangeVectorTransformer.scala:61."""
-    function: str
-    args: Tuple = ()
-
-    def args_str(self):
-        return f"function={self.function}"
-
-    def apply(self, data: Data, ctx: QueryContext, stats: QueryStats,
-              source=None) -> Data:
-        if not isinstance(data, ResultBlock) or data.num_series == 0:
-            return data
-        vals = data.values
-        if self.function in ("histogram_quantile", "histogram_max_quantile"):
-            assert data.is_histogram, "histogram_quantile needs histogram data"
-            q = float(self._arg_value(self.args[0], source))
-            out = np.asarray(hist_ops.histogram_quantile(
-                q, jnp.asarray(vals), jnp.asarray(data.bucket_les)))
-            return ResultBlock(data.keys, data.wends, out)
-        if self.function == "histogram_bucket":
-            le = float(self._arg_value(self.args[0], source))
-            out = np.asarray(hist_ops.histogram_bucket(
-                le, jnp.asarray(vals), jnp.asarray(data.bucket_les)))
-            return ResultBlock(data.keys, data.wends, out)
-        fn = INSTANT_FUNCTIONS[self.function]
-        # elementwise functions broadcast per-step scalar args over [S, W]
-        extra = [np.asarray(self._arg_value(a, source, per_step=True))
-                 for a in self.args]
-        out = np.asarray(fn(jnp.asarray(vals),
-                            *[jnp.asarray(x) for x in extra]))
-        return ResultBlock(data.keys, data.wends, out, data.bucket_les)
-
-    @staticmethod
-    def _arg_value(a, source, per_step: bool = False):
-        """Resolve a (possibly deferred) scalar argument.  per_step returns a
-        [W] array for elementwise functions; otherwise a single float — a
-        genuinely time-varying scalar is rejected rather than silently
-        collapsed to its first step."""
-        if hasattr(a, "resolve"):                 # deferred scalar subplan
-            a = a.resolve(source)
-        if isinstance(a, ScalarResult):
-            if len(a.values) == 0:
-                return np.nan
-            if per_step:
-                return a.values
-            vals = a.values[~np.isnan(a.values)]
-            if len(vals) and not np.all(vals == vals[0]):
-                raise ValueError(
-                    "time-varying scalar argument not supported for this "
-                    "function")
-            return a.values[0] if len(vals) == 0 else vals[0]
-        return a
-
-
-@dataclasses.dataclass
-class ScalarOperationMapper(RangeVectorTransformer):
-    """vector op scalar (ref: RangeVectorTransformer.scala:186)."""
-    operator: str
-    scalar: Union[float, ScalarResult]
-    scalar_is_lhs: bool = False
-    bool_modifier: bool = False
-
-    def args_str(self):
-        return f"operator={self.operator}, scalarOnLhs={self.scalar_is_lhs}"
-
-    def apply(self, data: Data, ctx: QueryContext, stats: QueryStats,
-              source=None) -> Data:
-        if not isinstance(data, ResultBlock) or data.num_series == 0:
-            return data
-        vals = np.asarray(data.values)
-        scalar = self.scalar
-        if hasattr(scalar, "resolve"):            # deferred scalar subplan
-            scalar = scalar.resolve(source)
-        if isinstance(scalar, ScalarResult):
-            # empty scalar stream (e.g. scalar(absent-selector) across
-            # shards) behaves as NaN, same as the 1-shard path
-            sv = (scalar.values[None, :] if scalar.values.shape[0]
-                  == vals.shape[1] else np.full((1, 1), np.nan))
-        else:
-            sv = np.full((1, 1), float(scalar))
-        sv = np.broadcast_to(sv, vals.shape)
-        a, b = (sv, vals) if self.scalar_is_lhs else (vals, sv)
-        # comparison filtering keeps the VECTOR side's value
-        out = np.asarray(apply_binary_op(
-            jnp.asarray(a), jnp.asarray(b), op=self.operator,
-            bool_modifier=self.bool_modifier,
-            keep_side=("rhs" if self.scalar_is_lhs else "lhs")))
-        return ResultBlock(data.keys, data.wends, out, data.bucket_les)
-
-
-def _group_ids(keys: Sequence[RangeVectorKey], by: Tuple[str, ...],
-               without: Tuple[str, ...]) -> Tuple[np.ndarray, List[RangeVectorKey]]:
-    """Host-side grouping: series key -> group key (by/without semantics)."""
-    gmap: Dict[RangeVectorKey, int] = {}
-    gids = np.empty(len(keys), dtype=np.int32)
-    gkeys: List[RangeVectorKey] = []
-    for i, k in enumerate(keys):
-        if by:
-            gk = k.only(by)
-        elif without:
-            gk = k.without(tuple(without) + ("_metric_", "__name__"))
-        else:
-            gk = RangeVectorKey(())
-        gid = gmap.get(gk)
-        if gid is None:
-            gid = len(gkeys)
-            gmap[gk] = gid
-            gkeys.append(gk)
-        gids[i] = gid
-    return gids, gkeys
-
-
-_CANDIDATE_OPS = {"topk", "bottomk", "count_values"}
-
-
-@dataclasses.dataclass
-class AggregateMapReduce(RangeVectorTransformer):
-    """Map phase of 3-phase aggregation (ref: AggrOverRangeVectors.scala:76)."""
-    op: str
-    params: Tuple = ()
-    by: Tuple[str, ...] = ()
-    without: Tuple[str, ...] = ()
-
-    def args_str(self):
-        return (f"aggrOp={self.op}, aggrParams={list(self.params)}, "
-                f"without={list(self.without)}, by={list(self.by)}")
-
-    def apply(self, data: Data, ctx: QueryContext, stats: QueryStats,
-              source=None) -> Data:
-        assert isinstance(data, (ResultBlock, type(None)))
-        if data is None or data.num_series == 0:
-            return None
-        vals = np.asarray(data.values)
-        gids, gkeys = _group_ids(data.keys, self.by, self.without)
-        limit = ctx.planner_params.group_by_cardinality_limit
-        if limit and len(gkeys) > limit:
-            raise GroupCardinalityError(
-                f"group-by cardinality limit {limit} exceeded "
-                f"({len(gkeys)} groups)")
-        if data.is_histogram and self.op == "sum":
-            # histogram sum: elementwise over buckets — [G, W, B+1] where the
-            # extra slot counts present series (empty-step masking)
-            present = ~np.isnan(vals)
-            comp = np.where(present, vals, 0.0)
-            G = len(gkeys)
-            S, W, B = vals.shape
-            agg = np.zeros((G, W, B + 1))
-            np.add.at(agg[..., :B], gids, comp)     # view write-through
-            np.add.at(agg[..., B], gids, present.any(axis=2).astype(float))
-            return AggPartial("hist_sum", gkeys, data.wends, comp=agg,
-                              params=self.params, bucket_les=data.bucket_les)
-        if self.op == "quantile" and vals.ndim == 2:
-            from filodb_tpu.ops import sketch as sketch_ops
-            sk = sketch_ops.sketch_from_values(vals, gids, len(gkeys))
-            return AggPartial(self.op, gkeys, data.wends, sketch=sk,
-                              params=self.params)
-        if self.op in _CANDIDATE_OPS or self.op == "quantile":
-            cand_keys, cand_vals, cand_groups = self._candidates(
-                data, vals, gids, len(gkeys))
-            return AggPartial(self.op, gkeys, data.wends, cand_keys=cand_keys,
-                              cand_vals=cand_vals, cand_groups=cand_groups,
-                              params=self.params)
-        comp = np.asarray(agg_ops.map_phase(
-            self.op, jnp.asarray(vals), jnp.asarray(gids), len(gkeys)))
-        return AggPartial(self.op, gkeys, data.wends, comp=comp,
-                          params=self.params)
-
-    def _candidates(self, data, vals, gids, num_groups):
-        if self.op in ("topk", "bottomk"):
-            k = int(self.params[0])
-            mask = np.asarray(agg_ops.topk_mask(
-                jnp.asarray(vals), jnp.asarray(gids), num_groups, k,
-                largest=(self.op == "topk")))
-            keep = mask.any(axis=1)
-            rows = np.flatnonzero(keep)
-        else:
-            rows = np.arange(len(data.keys))
-        return ([data.keys[int(r)] for r in rows], vals[rows], gids[rows])
-
-
-class AggregatePresenter(RangeVectorTransformer):
-    """Present phase (ref: AggrOverRangeVectors.scala:125)."""
-
-    def __init__(self, op: str, params: Tuple = ()):
-        self.op = op
-        self.params = params
-
-    def args_str(self):
-        return f"aggrOp={self.op}, aggrParams={list(self.params)}"
-
-    def apply(self, data: Data, ctx: QueryContext, stats: QueryStats,
-              source=None) -> Data:
-        if data is None:
-            return None
-        assert isinstance(data, AggPartial)
-        return present_partial(data)
-
-
-def present_partial(p: AggPartial) -> Optional[ResultBlock]:
-    """Finish an AggPartial into a ResultBlock."""
-    if p.sketch is not None:
-        from filodb_tpu.ops import sketch as sketch_ops
-        q = float(p.params[0])
-        out = sketch_ops.sketch_quantile(p.sketch, q)
-        return ResultBlock(p.group_keys, p.wends, out)
-    if p.comp is not None:
-        if p.op == "hist_sum":
-            # [G, W, B+1] with present-series count in the last slot
-            buckets = p.comp[..., :-1]
-            present_cnt = p.comp[..., -1]
-            out = np.where(present_cnt[..., None] > 0, buckets, np.nan)
-            return ResultBlock(p.group_keys, p.wends, out, p.bucket_les)
-        out = np.asarray(agg_ops.present(p.op, jnp.asarray(p.comp)))
-        return ResultBlock(p.group_keys, p.wends, out)
-    # candidate form
-    if p.op in ("topk", "bottomk"):
-        k = int(p.params[0])
-        gids = p.cand_groups
-        mask = np.asarray(agg_ops.topk_mask(
-            jnp.asarray(p.cand_vals), jnp.asarray(gids), len(p.group_keys),
-            k, largest=(p.op == "topk")))
-        vals = np.where(mask, p.cand_vals, np.nan)
-        block = ResultBlock(p.cand_keys, p.wends, vals)
-        return remove_nan_series(block)
-    if p.op == "quantile":
-        q = float(p.params[0])
-        out = np.asarray(agg_ops.quantile_agg(
-            jnp.asarray(p.cand_vals), jnp.asarray(p.cand_groups),
-            len(p.group_keys), q))
-        return ResultBlock(p.group_keys, p.wends, out)
-    if p.op == "count_values":
-        label = str(p.params[0])
-        vals = p.cand_vals
-        out_keys: List[RangeVectorKey] = []
-        out_rows: List[np.ndarray] = []
-        W = vals.shape[1]
-        for g in range(len(p.group_keys)):
-            rows = vals[p.cand_groups == g]
-            uniq = np.unique(rows[~np.isnan(rows)])
-            for v in uniq:
-                cnt = np.nansum(rows == v, axis=0).astype(float)
-                cnt[cnt == 0] = np.nan
-                lbls = dict(p.group_keys[g].labels)
-                lbls[label] = f"{v:g}"
-                out_keys.append(RangeVectorKey.make(lbls))
-                out_rows.append(cnt)
-        if not out_keys:
-            return None
-        return ResultBlock(out_keys, p.wends, np.stack(out_rows))
-    raise ValueError(p.op)
-
-
-def _union_scheme(les_list: List[Optional[np.ndarray]]) -> Optional[np.ndarray]:
-    """Union bucket scheme across shards, or None when any shard carries no
-    boundaries (widths must then match — checked by the caller's reshape)."""
-    from filodb_tpu.memory.histogram import union_les
-    known = [l for l in les_list if l is not None]
-    if len(known) != len(les_list):
-        return None
-    out = known[0]
-    for l in known[1:]:
-        out = union_les(out, l)
-    return out
-
-
-def _align_hist_schemes(parts: List[AggPartial]) -> List[AggPartial]:
-    """Rebucket hist_sum partials onto the union scheme so shards whose
-    series changed bucket scheme mid-retention still merge
-    (ref: HistogramBuckets.scala:340; replaces the fail-loudly behavior)."""
-    from filodb_tpu.memory.histogram import rebucket
-    les_list = [p.bucket_les for p in parts]
-    if any(l is None for l in les_list):
-        # boundary-less partials can only merge by width (legacy behavior);
-        # order of children must not matter — and any two KNOWN schemes
-        # that differ cannot be silently index-merged just because a third
-        # partial lacks boundaries
-        widths = {p.comp.shape[-1] for p in parts}
-        known = [l for l in les_list if l is not None]
-        if len(widths) > 1 or any(not np.array_equal(l, known[0])
-                                  for l in known[1:]):
-            raise ValueError(
-                "cannot merge histogram partials of different schemes when "
-                "some shards carry no bucket boundaries to re-map by")
-        return parts
-    if all(np.array_equal(l, les_list[0]) for l in les_list):
-        return parts
-    union = _union_scheme(les_list)
-
-    def _rebucket_comp(p):
-        # comp is [G, W, B+1]: B bucket slots + the present-series count
-        B = len(p.bucket_les)
-        buckets = rebucket(p.comp[..., :B], p.bucket_les, union)
-        return np.concatenate([buckets, p.comp[..., B:]], axis=-1)
-
-    return [dataclasses.replace(p, comp=_rebucket_comp(p), bucket_les=union)
-            if not np.array_equal(p.bucket_les, union) else p
-            for p in parts]
-
-
-def reduce_partials(parts: List[AggPartial]) -> Optional[AggPartial]:
-    """Inter-shard reduce (ReduceAggregateExec): merge partials by group key."""
-    parts = [p for p in parts if p is not None]
-    if not parts:
-        return None
-    op = parts[0].op
-    if op == "hist_sum":
-        parts = _align_hist_schemes(parts)
-    gmap: Dict[RangeVectorKey, int] = {}
-    gkeys: List[RangeVectorKey] = []
-    for p in parts:
-        for k in p.group_keys:
-            if k not in gmap:
-                gmap[k] = len(gkeys)
-                gkeys.append(k)
-    wends = parts[0].wends
-    if parts[0].sketch is not None:
-        # quantile sketches: concat centroid axis per group (zero-weight
-        # padding for shards that lack a group), then re-compress to K
-        from filodb_tpu.ops import sketch as sketch_ops
-        G = len(gkeys)
-        W = parts[0].sketch.shape[1]
-        M = sum(p.sketch.shape[2] for p in parts)
-        cat = np.zeros((G, W, M, 2))
-        cat[..., 0] = np.nan
-        off = 0
-        for p in parts:
-            idx = np.asarray([gmap[k] for k in p.group_keys], dtype=np.int64)
-            m = p.sketch.shape[2]
-            cat[idx, :, off:off + m] = p.sketch
-            off += m
-        return AggPartial(op, gkeys, wends,
-                          sketch=sketch_ops.merge_sketches(cat),
-                          params=parts[0].params)
-    if parts[0].comp is not None:
-        C = parts[0].comp.shape[-1]
-        W = parts[0].comp.shape[1]
-        combs = agg_ops.combiners_for(op, C)
-        init = {"sum": 0.0, "min": np.inf, "max": -np.inf}
-        out = np.empty((len(gkeys), W, C))
-        for i, comb in enumerate(combs):
-            out[..., i] = init[comb]
-        for p in parts:
-            idx = np.asarray([gmap[k] for k in p.group_keys], dtype=np.int64)
-            for i, comb in enumerate(combs):
-                ufunc = {"sum": np.add, "min": np.minimum,
-                         "max": np.maximum}[comb]
-                ufunc.at(out[..., i], idx, p.comp[..., i])
-        return AggPartial(op, gkeys, wends, comp=out, params=parts[0].params,
-                          bucket_les=parts[0].bucket_les)
-    # candidate form: concat and remap groups
-    ck: List[RangeVectorKey] = []
-    cv: List[np.ndarray] = []
-    cg: List[np.ndarray] = []
-    for p in parts:
-        idx = np.asarray([gmap[k] for k in p.group_keys], dtype=np.int64)
-        ck.extend(p.cand_keys)
-        cv.append(p.cand_vals)
-        cg.append(idx[p.cand_groups])
-    return AggPartial(op, gkeys, wends,
-                      cand_keys=ck, cand_vals=np.concatenate(cv),
-                      cand_groups=np.concatenate(cg), params=parts[0].params)
-
-
-@dataclasses.dataclass
-class AbsentFunctionMapper(RangeVectorTransformer):
-    """absent() (ref: RangeVectorTransformer.scala:340)."""
-    filters: Tuple[ColumnFilter, ...]
-    start_ms: int = 0
-    step_ms: int = 0
-    end_ms: int = 0
-
-    def args_str(self):
-        return "functionId=absent"
-
-    def apply(self, data: Data, ctx: QueryContext, stats: QueryStats,
-              source=None) -> Data:
-        wends = (data.wends if isinstance(data, ResultBlock)
-                 else make_window_ends(self.start_ms, self.end_ms,
-                                       max(self.step_ms, 1)))
-        if isinstance(data, ResultBlock) and data.num_series:
-            present = ~np.isnan(np.asarray(data.values)).all(axis=0)
-        else:
-            present = np.zeros(len(wends), dtype=bool)
-        out = np.where(present, np.nan, 1.0)[None, :]
-        labels = {f.column: f.value for f in self.filters
-                  if isinstance(f, Equals)
-                  and f.column not in ("__name__", "_metric_")}
-        return ResultBlock([RangeVectorKey.make(labels)], wends, out)
-
-
-@dataclasses.dataclass
-class SortFunctionMapper(RangeVectorTransformer):
-    """sort()/sort_desc() by mean value (ref: RangeVectorTransformer.scala:254)."""
-    descending: bool = False
-
-    def args_str(self):
-        return f"function={'sort_desc' if self.descending else 'sort'}"
-
-    def apply(self, data: Data, ctx: QueryContext, stats: QueryStats,
-              source=None) -> Data:
-        if not isinstance(data, ResultBlock) or data.num_series <= 1:
-            return data
-        with np.errstate(invalid="ignore"):
-            means = np.nanmean(np.asarray(data.values), axis=1)
-        means = np.where(np.isnan(means), -np.inf if not self.descending else np.inf,
-                         means)
-        order = np.argsort(-means if self.descending else means, kind="stable")
-        return data.select(order)
-
-
-@dataclasses.dataclass
-class MiscellaneousFunctionMapper(RangeVectorTransformer):
-    """label_replace / label_join (ref: rangefn/MiscellaneousFunction.scala)."""
-    function: str
-    string_args: Tuple[str, ...] = ()
-
-    def args_str(self):
-        return f"function={self.function}"
-
-    def apply(self, data: Data, ctx: QueryContext, stats: QueryStats,
-              source=None) -> Data:
-        if not isinstance(data, ResultBlock):
-            return data
-        import re
-        if self.function == "label_replace":
-            dst, repl, src, regex = self.string_args
-            pat = re.compile("^(?:" + regex + ")$")
-            keys = []
-            for k in data.keys:
-                lbls = k.labels_dict
-                m = pat.match(lbls.get(src, ""))
-                if m:
-                    val = m.expand(_dollar_to_backslash(repl))
-                    if val:
-                        lbls[dst] = val
-                    else:
-                        lbls.pop(dst, None)
-                keys.append(RangeVectorKey.make(lbls))
-            return ResultBlock(keys, data.wends, data.values, data.bucket_les)
-        if self.function == "label_join":
-            dst, sep, *srcs = self.string_args
-            keys = []
-            for k in data.keys:
-                lbls = k.labels_dict
-                val = sep.join(lbls.get(s, "") for s in srcs)
-                if val:
-                    lbls[dst] = val
-                else:
-                    lbls.pop(dst, None)
-                keys.append(RangeVectorKey.make(lbls))
-            return ResultBlock(keys, data.wends, data.values, data.bucket_les)
-        raise ValueError(f"unknown misc function {self.function}")
-
-
-def _dollar_to_backslash(repl: str) -> str:
-    """PromQL uses $1; python re.expand uses \\1."""
-    import re as _re
-    return _re.sub(r"\$(\d+)", r"\\\1", repl)
-
-
-@dataclasses.dataclass
-class LimitFunctionMapper(RangeVectorTransformer):
-    limit: int
-
-    def args_str(self):
-        return f"limit={self.limit}"
-
-    def apply(self, data: Data, ctx: QueryContext, stats: QueryStats,
-              source=None) -> Data:
-        if isinstance(data, ResultBlock) and data.num_series > self.limit:
-            return data.select(np.arange(self.limit))
-        return data
-
-
-@dataclasses.dataclass
-class ScalarFunctionMapper(RangeVectorTransformer):
-    """scalar(vector): 1 series -> scalar stream, else NaN (ref:
-    RangeVectorTransformer ScalarFunctionMapper)."""
-    function: str = "scalar"
-
-    def args_str(self):
-        return f"function={self.function}"
-
-    def apply(self, data: Data, ctx: QueryContext, stats: QueryStats,
-              source=None) -> Data:
-        assert isinstance(data, (ResultBlock, type(None)))
-        if data is None or data.num_series != 1:
-            wends = data.wends if data is not None else np.zeros(0, np.int64)
-            return ScalarResult(wends, np.full(len(wends), np.nan))
-        return ScalarResult(data.wends, np.asarray(data.values)[0])
-
-
-@dataclasses.dataclass
-class VectorFunctionMapper(RangeVectorTransformer):
-    """vector(scalar) (ref: RangeVectorTransformer VectorFunctionMapper)."""
-
-    def args_str(self):
-        return "function=vector"
-
-    def apply(self, data: Data, ctx: QueryContext, stats: QueryStats,
-              source=None) -> Data:
-        if isinstance(data, ScalarResult):
-            return ResultBlock([RangeVectorKey(())], data.wends,
-                               data.values[None, :])
-        return data
-
-
-# ---------------------------------------------------------------- exec plans
-
-
-class PlanDispatcher:
-    """ref: exec/PlanDispatcher.scala:20."""
-
-    def dispatch(self, plan: "ExecPlan", source) -> QueryResultLike:
-        raise NotImplementedError
-
-
-QueryResultLike = Tuple[Data, QueryStats]
-
-
-class InProcessPlanDispatcher(PlanDispatcher):
-    """Run the subtree in-process (ref: exec/InProcessPlanDispatcher.scala:89)."""
-
-    def dispatch(self, plan: "ExecPlan", source) -> QueryResultLike:
-        return plan.execute_internal(source)
-
-
-class ExecPlan:
-    """Base execution node.  `execute_internal` returns raw Data + stats;
-    `execute` materializes a QueryResult with limits enforced
-    (ref: ExecPlan.scala:96-186)."""
-
-    def __init__(self, ctx: Optional[QueryContext] = None):
-        self.ctx = ctx or QueryContext()
-        self.transformers: List[RangeVectorTransformer] = []
-        self.dispatcher: PlanDispatcher = InProcessPlanDispatcher()
-
-    def add_transformer(self, t: RangeVectorTransformer) -> "ExecPlan":
-        self.transformers.append(t)
-        return self
-
-    @property
-    def children(self) -> List["ExecPlan"]:
-        return []
-
-    # -- execution
-
-    def _do_execute(self, source) -> QueryResultLike:
-        raise NotImplementedError
-
-    def execute_internal(self, source) -> QueryResultLike:
-        data, stats = self._do_execute(source)
-        for t in self.transformers:
-            data = t.apply(data, self.ctx, stats, source)
-        return data, stats
-
-    def execute(self, source) -> QueryResult:
-        # span + error counters per plan type (ref: ExecPlan.scala:102-131
-        # Kamon span around doExecute; query-error counters QueryActor:80-96)
-        from filodb_tpu.utils.metrics import registry, span
-        try:
-            with span("execplan", plan=type(self).__name__):
-                data, stats = self.execute_internal(source)
-        except Exception as e:  # noqa: BLE001 — query errors surface in result
-            registry.counter("query_errors",
-                             plan=type(self).__name__).increment()
-            return QueryResult([], QueryStats(), error=f"{type(e).__name__}: {e}")
-        if isinstance(data, AggPartial):
-            data = present_partial(data)
-        if isinstance(data, ScalarResult):
-            data = ResultBlock([RangeVectorKey(())], data.wends,
-                               data.values[None, :])
-        data = remove_nan_series(data)
-        blocks = [data] if data is not None else []
-        limit = self.ctx.planner_params.sample_limit
-        result_samples = sum(int(np.asarray(b.values).size) for b in blocks)
-        if limit and result_samples > limit:
-            return QueryResult([], stats,
-                               error=f"sample limit {limit} exceeded "
-                                     f"({result_samples} samples)")
-        stats.result_samples = result_samples
-        return QueryResult(blocks, stats)
-
-    # -- plan printing (ref: ExecPlan.printTree, doc/query-engine.md:174-204)
-
-    def args_str(self) -> str:
-        return ""
-
-    def print_tree(self, level: int = 0) -> str:
-        transf = [f"{'-' * (level + i + 1)}T~{type(t).__name__}({t.args_str()})"
-                  for i, t in enumerate(reversed(self.transformers))]
-        me = (f"{'-' * (level + len(self.transformers) + 1)}"
-              f"E~{type(self).__name__}({self.args_str()})")
-        kids = [c.print_tree(level + len(self.transformers) + 1)
-                for c in self.children]
-        return "\n".join(transf + [me] + kids)
-
-    def __str__(self):
-        return self.print_tree()
-
-
-class LeafExecPlan(ExecPlan):
-    pass
-
-
-class MultiSchemaPartitionsExec(LeafExecPlan):
-    """Leaf: index lookup + dense gather on the owning shard
-    (ref: exec/MultiSchemaPartitionsExec.scala:27-60,
-    SelectRawPartitionsExec.doExecute:125)."""
-
-    def __init__(self, ctx: QueryContext, dataset: str, shard: int,
-                 filters: Sequence[ColumnFilter], chunk_start_ms: int,
-                 chunk_end_ms: int, columns: Sequence[str] = (),
-                 schema: Optional[str] = None):
-        super().__init__(ctx)
-        self.dataset = dataset
-        self.shard = shard
-        self.filters = list(filters)
-        self.chunk_start_ms = chunk_start_ms
-        self.chunk_end_ms = chunk_end_ms
-        self.columns = list(columns)
-        self.schema = schema
-        self._transformer_overrides: Dict[int, RangeVectorTransformer] = {}
-
-    def execute_internal(self, source) -> QueryResultLike:
-        self._transformer_overrides = {}
-        self._fused_cache_key = None
-        data, stats = self._do_execute(source)
-        start = 0
-        try:
-            fused = self._try_fused(data, stats)
-        except GroupCardinalityError:
-            raise                        # real query error — must surface
-        except Exception as e:  # noqa: BLE001 — fusion is an optimization
-            from filodb_tpu.utils.metrics import (log_fused_degradation,
-                                                  registry)
-            registry.counter("leaf_fused_errors").increment()
-            log_fused_degradation("leaf", e)
-            fused = None
-        if fused is not None:
-            data, start = fused, 2
-        for i, t in enumerate(self.transformers[start:], start):
-            t = self._transformer_overrides.get(i, t)
-            data = t.apply(data, self.ctx, stats, source)
-        return data, stats
-
-    def _try_fused(self, data, stats):
-        """Peephole: PeriodicSamplesMapper(rate|increase|delta) followed by
-        AggregateMapReduce(sum) over a shared-grid fully-finite working set
-        collapses into the single-HBM-pass MXU kernel (ops/pallas_fused.py)
-        — the leaf analogue of the reference pushing AggregateMapReduce to
-        data nodes (ref: AggrOverRangeVectors.scala:76), fused one level
-        further.  Returns the AggPartial or None (general path)."""
-        if len(self.transformers) < 2 or not isinstance(data, RawBlock) \
-                or not data.keys or data.shared_ts_row is None:
-            return None
-        t0 = self._transformer_overrides.get(0, self.transformers[0])
-        t1 = self._transformer_overrides.get(1, self.transformers[1])
-        if not isinstance(t0, PeriodicSamplesMapper) \
-                or not isinstance(t1, AggregateMapReduce):
-            return None
-        from filodb_tpu.ops import pallas_fused as pf
-        vals = data.values
-        ndim = getattr(vals, "ndim", 0)
-        is_hist = ndim == 3
-        if ndim not in (2, 3) or t0.function_args or t1.params:
-            return None
-        if t0.window_ms is None:
-            # instant-vector selector (`sum by (x) (metric)`): plain
-            # lookback sampling IS last_over_time over the stale-lookback
-            # window — the same normalization the general apply() does
-            if t0.function is not None:
-                return None
-            t0 = dataclasses.replace(t0, window_ms=t0.lookback_ms,
-                                     function="last_over_time")
-        fn = t0.function or ""
-        dense = data.dense
-        if not pf.can_fuse(fn, t1.op, True, dense):
-            return None
-        if is_hist:
-            # histogram buckets are counters too: flatten [S, T, B] into
-            # S*B kernel rows with per-(group, bucket) slots — the hist
-            # analogue (ref: HistogramQueryBenchmark's
-            # sum(rate(..._bucket[5m])) + histogram_quantile)
-            if fn not in ("rate", "increase") or t1.op != "sum" \
-                    or data.bucket_les is None or not dense:
-                return None
-        # host-only fast paths: under the dense shared grid every series
-        # has IDENTICAL per-window sample counts, so count_over_time and
-        # the count aggregate are pure host math — no device work at all
-        if dense and not is_hist and fn == "count_over_time":
-            return self._fused_count_over_time(data, t0, t1)
-        if dense and not is_hist and t1.op == "count":
-            return self._fused_count_agg(data, t0, t1)
-        wends = make_window_ends(t0.start_ms, t0.end_ms, t0.step_ms)
-        eval_wends = wends - t0.offset_ms - data.base_ms
-        if eval_wends.size == 0 or abs(eval_wends).max() >= (1 << 30):
-            return None
-        if fn in pf.MINMAX_FNS:
-            # pure-XLA reduce_window path — any backend, no Pallas
-            return self._fused_minmax(data, t0, t1, wends, eval_wends)
-        import jax
-        backend = jax.default_backend()
-        interpret = backend != "tpu"
-        if interpret and not os.environ.get("FILODB_TPU_FUSED_INTERPRET"):
-            return None                 # kernel is MXU-targeted
-        if fn in ("rate", "increase") and not data.precorrected:
-            return None
-        # VMEM guard, part 1 (group count not yet known — use the minimum):
-        # very long ranges with many windows must take the general path,
-        # not fail at kernel lowering
-        Tp = pf._pad_to(vals.shape[1], pf._LANE)
-        Wp = pf._pad_to(eval_wends.size, pf._LANE)
-        over_time = t0.function in pf.OVER_TIME_FNS
-        ragged_rate = not dense and fn in ("rate", "increase", "delta")
-        if pf.vmem_estimate(Tp, Wp, 8, over_time,
-                            ragged_rate) > pf.VMEM_BUDGET:
-            return None
-        from filodb_tpu.utils.metrics import registry
-        # plan + prepared-input caches: a repeat query over an unchanged
-        # snapshot (the dashboard-poll pattern) skips the selection-matrix
-        # rebuild AND the full padded device copy (PreparedInputs contract)
-        key = self._fused_cache_key
-        plan = padded_vals = groups = gkeys = None
-        if key is not None:
-            plan_key = key[:3] + (t0.start_ms, t0.step_ms, t0.end_ms,
-                                  t0.offset_ms, t0.window_ms, data.base_ms)
-            with _FUSED_CACHE_LOCK:
-                plan = _lru_touch(_FUSED_PLAN_CACHE, plan_key)
-                padded_vals = _lru_touch(_FUSED_VALS_CACHE, key)
-            groups, gkeys = _group_cache_lookup(key, t1.by, t1.without)
-            if padded_vals is not None:
-                registry.counter("leaf_fused_prep_hits").increment()
-        if plan is None:
-            plan = pf.build_plan(data.shared_ts_row.astype(np.int64),
-                                 eval_wends, t0.window_ms)
-            if key is not None:
-                with _FUSED_CACHE_LOCK:
-                    for k in [k for k in _FUSED_PLAN_CACHE
-                              if k[0] == key[0] and k[1] != key[1]]:
-                        del _FUSED_PLAN_CACHE[k]
-                    _FUSED_PLAN_CACHE[plan_key] = plan
-                    while len(_FUSED_PLAN_CACHE) > 8:
-                        _FUSED_PLAN_CACHE.pop(next(iter(_FUSED_PLAN_CACHE)))
-        if gkeys is None:
-            gids, gkeys = _group_ids(data.keys, t1.by, t1.without)
-        self._check_group_limit(gkeys)
-        B = vals.shape[2] if is_hist else 1
-        num_slots = len(gkeys) * B      # hist: one kernel group per (g, b)
-        # VMEM guard, part 2: full estimate now that group count is known —
-        # BEFORE the padded device copy, so diverted queries cost nothing
-        if pf.vmem_estimate(Tp, Wp, max(num_slots, 8),
-                            over_time, ragged_rate) > pf.VMEM_BUDGET:
-            return None
-        if padded_vals is None:
-            vbase = data.vbase
-            if is_hist:
-                # [S, T, B] -> [S*B, T] rows (bucket-major within a series,
-                # same layout PeriodicSamplesMapper flattens to)
-                flat = jnp.moveaxis(jnp.asarray(vals), 2, 1) \
-                    .reshape(vals.shape[0] * B, vals.shape[1])
-                vb_flat = (np.zeros(flat.shape[0], np.float32)
-                           if vbase is None
-                           else jnp.asarray(vbase,
-                                            jnp.float32).reshape(-1))
-                padded_vals = pf.pad_values(flat, vb_flat, plan)
-            else:
-                if vbase is None:
-                    vbase = np.zeros(vals.shape[0], np.float32)
-                padded_vals = pf.pad_values(vals, vbase, plan)
-            if key is not None:
-                # a new snapshot generation obsoletes this mirror's older
-                # entries — drop them NOW, not at LRU eviction: each pins a
-                # full padded copy of the working set in HBM
-                with _FUSED_CACHE_LOCK:
-                    for k in [k for k in _FUSED_VALS_CACHE
-                              if k[0] == key[0] and k[1] != key[1]]:
-                        del _FUSED_VALS_CACHE[k]
-                    _vals_cache_insert(key, padded_vals)
-        if groups is None:
-            if is_hist:
-                gids_flat = (np.asarray(gids, np.int64)[:, None] * B
-                             + np.arange(B)[None, :]).reshape(-1)
-                groups = pf.pad_groups(gids_flat, vals.shape[0] * B,
-                                       num_slots)
-            else:
-                groups = pf.pad_groups(gids, vals.shape[0], len(gkeys))
-            _group_cache_insert(key, t1.by, t1.without, groups, gkeys)
-        prep = pf.PreparedInputs(padded_vals.vals_p, padded_vals.vbase_p,
-                                 groups.gids_p, groups.gsize)
-        registry.counter("leaf_fused_kernel").increment()
-        if not is_hist:
-            # broadened matmul path: any fusable (fn, agg) combination,
-            # ragged (validity-weighted) when the working set has NaN holes
-            comp = pf.fused_leaf_agg(
-                plan, prep, groups.gids_p[:vals.shape[0], 0],
-                len(gkeys), fn, t1.op, precorrected=data.precorrected,
-                interpret=interpret, ragged=not dense)
-            return AggPartial(t1.op, gkeys, wends, comp=comp)
-        sums, _counts = pf.fused_rate_groupsum(
-            None, None, None, plan, num_slots, fn_name=t0.function,
-            precorrected=data.precorrected, interpret=interpret,
-            prepared=prep)
-        G = len(gkeys)
-        buckets = np.asarray(sums, np.float64) \
-            .reshape(G, B, -1).transpose(0, 2, 1)           # [G, W, B]
-        # series-per-group count: every bucket row of a series shares
-        # presence under the dense gate, so any bucket slot's size IS
-        # the group's series count (works on the group-cache hit path
-        # too, where the raw gids were never recomputed)
-        gsize = groups.gsize.reshape(G, B)[:, 0]
-        cnt = gsize[:, None] * plan.wvalid[None, :].astype(np.float64)
-        comp = np.concatenate([buckets, cnt[..., None]], axis=2)
-        return AggPartial("hist_sum", gkeys, wends, comp=comp,
-                          bucket_les=data.bucket_les)
-
-    def args_str(self):
-        fs = ",".join(str(f) for f in self.filters)
-        return (f"dataset={self.dataset}, shard={self.shard}, "
-                f"chunkMethod=TimeRangeChunkScan({self.chunk_start_ms},"
-                f"{self.chunk_end_ms}), filters=[{fs}], colName={self.columns}")
-
-    def _window_counts_groups(self, data, t0, t1):
-        """Shared host math for the no-device fast paths: per-window
-        sample counts on the dense shared grid + grouping."""
-        wends = make_window_ends(t0.start_ms, t0.end_ms, t0.step_ms)
-        eval_wends = wends - t0.offset_ms - data.base_ms
-        if eval_wends.size == 0 or abs(eval_wends).max() >= (1 << 30):
-            return None
-        from filodb_tpu.ops import pallas_fused as pf
-        gids, gkeys = _group_ids(data.keys, t1.by, t1.without)
-        self._check_group_limit(gkeys)
-        n = pf.window_counts(data.shared_ts_row.astype(np.int64),
-                             eval_wends, t0.window_ms).astype(np.float64)
-        gsize = np.bincount(np.asarray(gids),
-                            minlength=len(gkeys))[:len(gkeys)]
-        return wends, gkeys, n, gsize.astype(np.float64)
-
-    def _fused_count_over_time(self, data, t0, t1):
-        """agg by (count_over_time(...)): under the shared dense grid every
-        series has IDENTICAL per-window sample counts, so the whole result
-        is host math over (gsize, n) — no device work at all.  Handles all
-        five fusable aggregates: each series' value at window w is n[w]."""
-        r = self._window_counts_groups(data, t0, t1)
-        if r is None:
-            return None
-        wends, gkeys, n, gsize = r
-        valid = (n >= 1).astype(np.float64)
-        op = t1.op
-        if op in ("sum", "avg"):
-            comp = np.stack([gsize[:, None] * n[None, :] * valid,
-                             gsize[:, None] * valid[None, :]], axis=-1)
-        elif op == "count":
-            comp = (gsize[:, None] * valid[None, :])[..., None]
-        else:                            # min/max: every series agrees on n
-            absent = np.inf if op == "min" else -np.inf
-            per = np.where(valid > 0, n, absent)
-            comp = np.stack(
-                [np.broadcast_to(per[None, :], (len(gkeys), len(n))),
-                 gsize[:, None] * valid[None, :]], axis=-1)
-        from filodb_tpu.utils.metrics import registry
-        registry.counter("leaf_fused_count_host").increment()
-        return AggPartial(op, gkeys, wends, comp=comp)
-
-    def _fused_count_agg(self, data, t0, t1):
-        """count by (fn(...)) on a dense shared grid: the count of series
-        emitting a value at window w is gsize * 1{n[w] >= min_samples} —
-        host math, no device work (the value itself never matters)."""
-        r = self._window_counts_groups(data, t0, t1)
-        if r is None:
-            return None
-        wends, gkeys, n, gsize = r
-        minsamp = 2 if t0.function in ("rate", "increase", "delta") else 1
-        valid = (n >= minsamp).astype(np.float64)
-        from filodb_tpu.utils.metrics import registry
-        registry.counter("leaf_fused_count_host").increment()
-        comp = (gsize[:, None] * valid[None, :])[..., None]
-        return AggPartial("count", gkeys, wends, comp=comp)
-
-    def _fused_minmax(self, data, t0, t1, wends, eval_wends):
-        """min/max_over_time + any aggregate in one jit via the XLA
-        reduce_window path (ops/pallas_fused.fused_minmax_agg) — one HBM
-        pass, no host round trip of the [S, T] working set, any backend.
-        Requires uniform window geometry; else the general path runs."""
-        from filodb_tpu.ops import pallas_fused as pf
-        ts_row0 = np.asarray(data.shared_ts_row)
-        real = ts_row0[ts_row0 < PAD_TS]
-        geom = pf.uniform_window_geometry(real.astype(np.int64),
-                                          eval_wends, t0.window_ms)
-        if geom is None:
-            return None
-        f0, stride, width, t_needed = geom
-        if t_needed > 2 * real.size:
-            # a grid hanging FAR past the data (end=now long after the last
-            # scrape) would pad more columns than the data itself — the
-            # general path handles that without materializing the padding
-            return None
-        # grouping: reuse the shared per-working-set group cache (the same
-        # per-series label hashing the kernel path caches away)
-        key = self._fused_cache_key
-        groups_c, gkeys = _group_cache_lookup(key, t1.by, t1.without)
-        if gkeys is None:
-            gids, gkeys = _group_ids(data.keys, t1.by, t1.without)
-            self._check_group_limit(gkeys)      # reject BEFORE caching
-            _group_cache_insert(key, t1.by, t1.without,
-                                pf.pad_groups(gids, len(data.keys),
-                                              len(gkeys)), gkeys)
-        else:
-            self._check_group_limit(gkeys)
-            gids = np.asarray(groups_c.gids_p[:len(data.keys), 0])
-        vb = data.vbase
-        vals = jnp.asarray(data.values)
-        ragged = not data.dense
-        if t_needed > real.size:
-            # windows hang past the data's right edge (end=now queries):
-            # extend with NaN columns so the ragged variant masks them —
-            # cached per (working set, t_needed): the dashboard-poll shape
-            # would otherwise re-copy the whole set on device every refresh
-            pad_key = None if key is None else key + ("minmax_pad",
-                                                      t_needed)
-            padded = None
-            if pad_key is not None:
-                with _FUSED_CACHE_LOCK:
-                    padded = _lru_touch(_FUSED_MINMAX_PAD_CACHE, pad_key)
-            if padded is None:
-                padded = jnp.pad(vals[:, :real.size],
-                                 ((0, 0), (0, t_needed - real.size)),
-                                 constant_values=np.nan)
-                if pad_key is not None:
-                    with _FUSED_CACHE_LOCK:
-                        for k in [k for k in _FUSED_MINMAX_PAD_CACHE
-                                  if k[0] == pad_key[0]
-                                  and k[1] != pad_key[1]]:
-                            del _FUSED_MINMAX_PAD_CACHE[k]
-                        _FUSED_MINMAX_PAD_CACHE[pad_key] = padded
-                        while len(_FUSED_MINMAX_PAD_CACHE) > 2:
-                            _FUSED_MINMAX_PAD_CACHE.pop(
-                                next(iter(_FUSED_MINMAX_PAD_CACHE)))
-            vals = padded
-            ragged = True
-        comp = pf.fused_minmax_agg(
-            vals, None if vb is None else jnp.asarray(vb),
-            jnp.asarray(gids, jnp.int32), f0, stride, width,
-            int(eval_wends.size), t0.function, t1.op, len(gkeys),
-            ragged=ragged)
-        from filodb_tpu.utils.metrics import registry
-        registry.counter("leaf_fused_minmax").increment()
-        return AggPartial(t1.op, gkeys, wends,
-                          comp=np.asarray(comp, np.float64))
-
-    def _check_group_limit(self, gkeys) -> None:
-        limit = self.ctx.planner_params.group_by_cardinality_limit
-        if limit and len(gkeys) > limit:
-            raise GroupCardinalityError(
-                f"group-by cardinality limit {limit} exceeded "
-                f"({len(gkeys)} groups)")
-
-    def _do_execute(self, source) -> QueryResultLike:
-        stats = QueryStats(shards_queried=1)
-        shard = source.get_shard(self.dataset, self.shard)
-        if shard is None:
-            return None, stats
-        lookup = shard.lookup_partitions(self.filters, self.chunk_start_ms,
-                                         self.chunk_end_ms)
-        schema_name = self.schema or lookup.first_schema
-        if schema_name is None:
-            return None, stats
-        pids = lookup.pids_by_schema.get(schema_name)
-        if pids is None or pids.size == 0:
-            return None, stats
-        store = shard.stores[schema_name]
-        rows = shard.rows_for(pids)
-
-        # Cap data scanned BEFORE materializing (or paging) the [S, T]
-        # matrix — a pathological selector must fail fast, not OOM first
-        # (ref: OnDemandPagingShard.scala:55 capDataScannedPerShardCheck,
-        # ExecPlan.scala:139-180 enforcedLimits).  The estimate clips each
-        # series to the query's chunk range assuming uniform spacing (the
-        # reference estimates from chunk metadata the same way); checked
-        # against the resident data before ODP and again after paging.
-        limit = self.ctx.planner_params.scan_limit
-        enforced = limit and self.ctx.planner_params.enforced_limits
-
-        def _check_scan_cap(when: str):
-            if not enforced:
-                return
-            to_scan = _estimate_scan(store, rows, self.chunk_start_ms,
-                                     self.chunk_end_ms)
-            if to_scan > limit:
-                raise ValueError(
-                    f"shard {self.shard}: query would scan ~{to_scan} "
-                    f"samples ({when}), over the scan limit {limit} — "
-                    f"narrow the filters or time range")
-
-        _check_scan_cap("resident")
-        shard.ensure_paged_pids(schema_name, pids,
-                                self.chunk_start_ms, self.chunk_end_ms,
-                                max_samples=limit if enforced else None)
-        _check_scan_cap("after demand paging")
-        schema = shard.schemas[schema_name]
-        col_name = (self.columns[0] if self.columns
-                    else schema.value_column)
-        # schema-specific column + range-function substitution for the
-        # downsample gauge schema: min_over_time reads the `min` column,
-        # count_over_time becomes sum_over_time over `count`, etc.  Applied
-        # as per-execution overrides so the plan stays reusable
-        # (ref: MultiSchemaPartitionsExec.finalizePlan schema substitutions;
-        # Schemas DS_GAUGE_FN_SUBSTITUTION)
-        if schema.name == "ds-gauge" and not self.columns:
-            from filodb_tpu.core.schemas import DS_GAUGE_FN_SUBSTITUTION
-            for i, t in enumerate(self.transformers):
-                if isinstance(t, PeriodicSamplesMapper):
-                    sub = DS_GAUGE_FN_SUBSTITUTION.get(t.function)
-                    if sub is not None:
-                        col_name = sub[0]
-                        if sub[1] != t.function:
-                            self._transformer_overrides[i] = \
-                                dataclasses.replace(t, function=sub[1])
-                    break
-        # counter semantics: counter-typed columns are reset-corrected in
-        # f64 host-side (ops/counter.host_counter_correct) when the range
-        # function has counter semantics, so post-rebase f32 deltas are
-        # exact even across resets.  Non-counter functions on counter
-        # columns (resets/delta/changes) need the RAW values and therefore
-        # bypass the (pre-corrected) device mirror.
-        col_def = next((c for c in schema.data_columns
-                        if c.name == col_name), None)
-        counter_col = col_def is not None and (col_def.detect_drops
-                                               or col_def.counter)
-        fn_is_counter = False
-        for t in self.transformers:
-            if isinstance(t, PeriodicSamplesMapper):
-                spec = RANGE_FUNCTIONS.get(t.function or "")
-                fn_is_counter = spec.is_counter if spec else False
-                break
-        # device-resident fast path: gather rows from the HBM mirror instead
-        # of re-shipping the matrix every query (ref: block-memory working
-        # set, BlockManager.scala; see core/devicecache.py)
-        mirror = None
-        if getattr(shard.config.store, "device_mirror_enabled", True) and (
-                not counter_col or fn_is_counter):
-            mirror = getattr(store, "device_mirror", None)
-            if mirror is None:
-                from filodb_tpu.core.devicecache import (
-                    DEFAULT_HBM_LIMIT_BYTES, DeviceMirror)
-                limit = getattr(shard.config.store,
-                                "device_mirror_hbm_limit",
-                                DEFAULT_HBM_LIMIT_BYTES)
-                mirror = store.device_mirror = DeviceMirror(limit)
-                _note_mirror_limit(limit)
-
-        # Mirror refresh (a full host->device upload) runs at most once per
-        # query, under the write lock so it can't race a mutation; the
-        # subsequent row gather reads only the immutable device copy.  The
-        # host fallback copies out under the seqlock so a concurrent
-        # ingest/flush can't hand the kernel a torn matrix.
-        mirrored = snap = None
-        if mirror is not None:
-            ok = mirror.is_fresh(store)
-            if not ok:
-                with shard._write_locked("mirror_refresh"):
-                    ok = mirror.ensure_fresh(store)
-            if ok:
-                # one snapshot read serves gather AND fused-eligibility:
-                # pairing a newer snapshot's grid with an older one's values
-                # would feed the kernel zero-padded phantom columns
-                snap = mirror.snapshot()
-                mirrored = mirror.gather_cached(rows, snap)
-        # value column selection: histograms gather [S, T, B]
-        shared_ts_row = None
-        dense = True
-        if mirrored is not None:
-            ts_off, dev_cols, dev_vbases, base = mirrored
-            vals = dev_cols[col_name]
-            vbase = dev_vbases.get(col_name)
-            counts = shard.snapshot_read(store,
-                                         lambda: store.counts[rows].copy())
-            precorrected = counter_col   # mirror corrects counter columns
-            shared_ts_row = mirror.fused_eligible(col_name, snap,
-                                                  allow_ragged=True)
-            # col_dense is grid-independent (counted cells finite; pads are
-            # excluded via PAD_TS), so a non-shared grid with finite values
-            # keeps the cheap slot-boundary rate path
-            dense = mirror.col_dense(col_name, snap)
-            if shared_ts_row is not None:
-                # cache identity for the fused path's prepared-input reuse
-                # (mirror.serial, not id(): ids are reused after GC; raw
-                # rows bytes, not their hash: a collision would silently
-                # serve another row-set's values)
-                self._fused_cache_key = (mirror.serial, snap.gen, col_name,
-                                         rows.tobytes())
-        else:
-            ts, cols, counts = shard.snapshot_read(
-                store, lambda: store.gather_rows(rows))
-            base = self.chunk_start_ms
-            ts_off = to_offsets(ts, counts, base)
-            # correct (f64) + rebase so counter deltas stay exact on chip
-            precorrected = counter_col and fn_is_counter
-            vals, vbase = counter_ops.rebase_values(cols[col_name],
-                                                    precorrected)
-            # NaN anywhere (staleness markers or ragged-length padding)
-            # routes the rate family onto its valid-boundary variant
-            dense = not bool(np.isnan(vals).any())
-        keys = shard.keys_for(pids)
-        stats.series_scanned = int(pids.size)
-        stats.samples_scanned = int(counts.sum())
-        les = store.bucket_les if vals.ndim == 3 else None
-        return RawBlock(keys, ts_off, vals, base, les,
-                        samples=stats.samples_scanned, vbase=vbase,
-                        precorrected=precorrected,
-                        shared_ts_row=shared_ts_row, dense=dense), stats
-
-
-def _estimate_scan(store, rows: np.ndarray, start_ms: int,
-                   end_ms: int) -> int:
-    """Estimated samples in [start_ms, end_ms] across the given store rows,
-    from per-series extents under a uniform-spacing assumption — O(S), no
-    [S, T] materialization."""
-    cnt = store.counts[rows].astype(np.int64)
-    if store.ts.shape[1] == 0 or not cnt.any():
-        return 0
-    first = store.ts[rows, 0]
-    last = store.ts[rows, np.maximum(cnt - 1, 0)]
-    lo = np.maximum(first, start_ms)
-    hi = np.minimum(last, end_ms)
-    span = np.maximum(last - first, 1).astype(np.float64)
-    frac = np.clip((hi - lo).astype(np.float64) / span, 0.0, 1.0)
-    est = np.where((cnt > 0) & (hi >= lo), np.maximum(cnt * frac, 1.0), 0.0)
-    return int(est.sum())
-
-
-class EmptyResultExec(LeafExecPlan):
-    """ref: exec/EmptyResultExec."""
-
-    def _do_execute(self, source) -> QueryResultLike:
-        return None, QueryStats()
-
-
-class NonLeafExecPlan(ExecPlan):
-    """Scatter-gather over children via their dispatchers
-    (ref: ExecPlan.scala NonLeafExecPlan)."""
-
-    def __init__(self, ctx: QueryContext, children: Sequence[ExecPlan]):
-        super().__init__(ctx)
-        self._children = list(children)
-
-    @property
-    def children(self) -> List[ExecPlan]:
-        return self._children
-
-    def _gather(self, source) -> Tuple[List[Data], QueryStats]:
-        stats = QueryStats()
-        results = []
-        for c in self._children:
-            data, st = c.dispatcher.dispatch(c, source)
-            stats.merge(st)
-            results.append(data)
-        return results, stats
-
-    def compose(self, results: List[Data], stats: QueryStats) -> Data:
-        raise NotImplementedError
-
-    def _do_execute(self, source) -> QueryResultLike:
-        results, stats = self._gather(source)
-        return self.compose(results, stats), stats
-
-
-class DistConcatExec(NonLeafExecPlan):
-    """Concatenate child results (ref: exec/DistConcatExec.scala)."""
-
-    def compose(self, results, stats):
-        blocks = [r for r in results if isinstance(r, ResultBlock)]
-        raws = [r for r in results if isinstance(r, RawBlock)]
-        if raws:
-            # raw blocks concat only if same grid/base — planner guarantees.
-            # Cross-shard bucket-scheme drift is resolved by rebucketing
-            # every block onto the union scheme (HistogramBuckets.scala:340)
-            les0 = raws[0].bucket_les
-            if any((r.bucket_les is None) != (les0 is None) or (
-                    les0 is not None and r.bucket_les is not None
-                    and not np.array_equal(les0, r.bucket_les))
-                   for r in raws[1:]):
-                union = _union_scheme([r.bucket_les for r in raws])
-                if union is None:
-                    raise ValueError(
-                        "cannot concat histogram blocks: some shards carry "
-                        "no bucket boundaries")
-                from filodb_tpu.memory.histogram import rebucket
-                raws = [dataclasses.replace(
-                            r,
-                            values=rebucket(np.asarray(r.values),
-                                            r.bucket_les, union),
-                            vbase=(rebucket(np.asarray(r.vbase),
-                                            r.bucket_les, union)
-                                   if r.vbase is not None
-                                   and np.asarray(r.vbase).ndim == 2
-                                   else r.vbase),
-                            bucket_les=union)
-                        if not np.array_equal(r.bucket_les, union) else r
-                        for r in raws]
-                les0 = union
-            keys = []
-            for r in raws:
-                keys.extend(r.keys)
-            T = max(r.ts_off.shape[1] for r in raws)
-            def pad(a, fill):
-                out = np.full((a.shape[0], T) + a.shape[2:], fill, a.dtype)
-                out[:, :a.shape[1]] = a
-                return out
-            from filodb_tpu.ops.timewindow import PAD_TS
-            ts = np.concatenate([pad(r.ts_off, PAD_TS) for r in raws])
-            vals = np.concatenate([pad(np.asarray(r.values), np.nan)
-                                   for r in raws])
-            vbase = None
-            if any(r.vbase is not None for r in raws):
-                vbase = np.concatenate([
-                    np.asarray(r.vbase) if r.vbase is not None
-                    else np.zeros(np.asarray(r.values).shape[:1]
-                                  + np.asarray(r.values).shape[2:])
-                    for r in raws])
-            return RawBlock(keys, ts, vals, raws[0].base_ms,
-                            raws[0].bucket_les,
-                            samples=sum(r.samples for r in raws),
-                            vbase=vbase,
-                            precorrected=all(r.precorrected for r in raws),
-                            # pad NaNs live at PAD_TS slots (excluded via
-                            # ts), so raggedness merges as AND over blocks
-                            dense=all(r.dense for r in raws))
-        return concat_blocks(blocks)
-
-
-class LocalPartitionDistConcatExec(DistConcatExec):
-    """ref: exec/DistConcatExec.scala LocalPartitionDistConcatExec."""
-
-
-class ReduceAggregateExec(NonLeafExecPlan):
-    """Reduce phase across shards (ref: AggrOverRangeVectors.scala:51)."""
-
-    def __init__(self, ctx, children, op: str, params: Tuple = ()):
-        super().__init__(ctx, children)
-        self.op = op
-        self.params = params
-
-    def args_str(self):
-        return f"aggrOp={self.op}, aggrParams={list(self.params)}"
-
-    def compose(self, results, stats):
-        parts = [r for r in results if isinstance(r, AggPartial)]
-        return reduce_partials(parts)
-
-
-class BinaryJoinExec(NonLeafExecPlan):
-    """Vector-vector join (ref: exec/BinaryJoinExec.scala:210).
-
-    lhs children come first, then rhs children; the split index separates
-    them (mirrors the reference's lhs/rhs Seq[ExecPlan]).
-    """
-
-    def __init__(self, ctx, lhs: Sequence[ExecPlan], rhs: Sequence[ExecPlan],
-                 operator: str, cardinality: str = "OneToOne",
-                 on: Optional[Tuple[str, ...]] = None,
-                 ignoring: Tuple[str, ...] = (),
-                 include: Tuple[str, ...] = (),
-                 bool_modifier: bool = False):
-        super().__init__(ctx, list(lhs) + list(rhs))
-        self.n_lhs = len(lhs)
-        self.operator = operator
-        self.cardinality = cardinality
-        self.on = tuple(on) if on is not None else None
-        self.ignoring = tuple(ignoring)
-        self.include = tuple(include)
-        self.bool_modifier = bool_modifier
-
-    def args_str(self):
-        return (f"binaryOp={self.operator}, on={self.on}, "
-                f"ignoring={list(self.ignoring)}")
-
-    def _match_key(self, k: RangeVectorKey) -> RangeVectorKey:
-        if self.on is not None:
-            return k.only(self.on)
-        drop = self.ignoring + ("_metric_", "__name__")
-        return k.without(drop)
-
-    def compose(self, results, stats):
-        lhs_blocks = [r for r in results[:self.n_lhs] if isinstance(r, ResultBlock)]
-        rhs_blocks = [r for r in results[self.n_lhs:] if isinstance(r, ResultBlock)]
-        lhs = concat_blocks(lhs_blocks)
-        rhs = concat_blocks(rhs_blocks)
-        if lhs is None or rhs is None:
-            return None
-        many_side, one_side = lhs, rhs
-        flip = False
-        if self.cardinality == "OneToMany":
-            many_side, one_side = rhs, lhs
-            flip = True
-        # index the "one" side by match key; duplicates are an error
-        one_index: Dict[RangeVectorKey, int] = {}
-        for i, k in enumerate(one_side.keys):
-            mk = self._match_key(k)
-            if mk in one_index:
-                raise ValueError(
-                    "many-to-many matching not allowed: duplicate series on "
-                    f"'one' side for key {mk}")
-            one_index[mk] = i
-        card_limit = self.ctx.planner_params.join_cardinality_limit
-        pairs: List[Tuple[int, int]] = []
-        for i, k in enumerate(many_side.keys):
-            j = one_index.get(self._match_key(k))
-            if j is not None:
-                pairs.append((i, j))
-                if len(pairs) > card_limit:
-                    raise ValueError(f"join cardinality limit {card_limit} exceeded")
-        if self.cardinality == "OneToOne":
-            seen: Dict[int, int] = {}
-            for i, j in pairs:
-                if j in seen:
-                    raise ValueError("one-to-one join has many-to-one matches; "
-                                     "use group_left/group_right")
-                seen[j] = i
-        if not pairs:
-            return None
-        mi = np.asarray([p[0] for p in pairs])
-        oi = np.asarray([p[1] for p in pairs])
-        mv = np.asarray(many_side.values)[mi]
-        ov = np.asarray(one_side.values)[oi]
-        a, b = (ov, mv) if flip else (mv, ov)   # a = query LHS values
-        out = np.asarray(apply_binary_op(
-            jnp.asarray(a), jnp.asarray(b), op=self.operator,
-            bool_modifier=self.bool_modifier, keep_side="lhs"))
-        keys = []
-        for i, j in pairs:
-            mk = many_side.keys[i]
-            lbls = self._result_labels(mk, one_side.keys[j])
-            keys.append(lbls)
-        return ResultBlock(keys, many_side.wends, out)
-
-    def _result_labels(self, many_key: RangeVectorKey,
-                       one_key: RangeVectorKey) -> RangeVectorKey:
-        if self.cardinality in ("ManyToOne", "OneToMany"):
-            lbls = many_key.without(("_metric_", "__name__")).labels_dict
-            if self.include:
-                od = one_key.labels_dict
-                for lbl in self.include:
-                    if lbl in od:
-                        lbls[lbl] = od[lbl]
-                    else:
-                        lbls.pop(lbl, None)
-            return RangeVectorKey.make(lbls)
-        if self.on is not None:
-            return many_key.only(self.on)
-        return many_key.without(self.ignoring + ("_metric_", "__name__"))
-
-
-class SetOperatorExec(NonLeafExecPlan):
-    """and/or/unless (ref: exec/SetOperatorExec.scala)."""
-
-    def __init__(self, ctx, lhs: Sequence[ExecPlan], rhs: Sequence[ExecPlan],
-                 operator: str, on: Optional[Tuple[str, ...]] = None,
-                 ignoring: Tuple[str, ...] = ()):
-        super().__init__(ctx, list(lhs) + list(rhs))
-        self.n_lhs = len(lhs)
-        self.operator = operator.lower()
-        self.on = tuple(on) if on is not None else None
-        self.ignoring = tuple(ignoring)
-
-    def args_str(self):
-        return f"binaryOp={self.operator}, on={self.on}, ignoring={list(self.ignoring)}"
-
-    def _match_key(self, k: RangeVectorKey) -> RangeVectorKey:
-        if self.on is not None:
-            return k.only(self.on)
-        return k.without(self.ignoring + ("_metric_", "__name__"))
-
-    def _presence_by_key(self, block: ResultBlock) -> Dict[RangeVectorKey, np.ndarray]:
-        """match-key -> [W] bool, True where any series with that key has a
-        sample at the step."""
-        vals = np.asarray(block.values)
-        if vals.ndim == 3:                       # histogram block
-            vals = vals[..., 0]
-        present: Dict[RangeVectorKey, np.ndarray] = {}
-        for i, k in enumerate(block.keys):
-            mk = self._match_key(k)
-            pres = ~np.isnan(vals[i])
-            present[mk] = present.get(mk, False) | pres
-        return present
-
-    def compose(self, results, stats):
-        lhs = concat_blocks([r for r in results[:self.n_lhs]
-                             if isinstance(r, ResultBlock)])
-        rhs = concat_blocks([r for r in results[self.n_lhs:]
-                             if isinstance(r, ResultBlock)])
-        op = self.operator
-        if op == "and":
-            if lhs is None or rhs is None:
-                return None
-            rhs_keys = {self._match_key(k) for k in rhs.keys}
-            # per-step AND: lhs kept where rhs series present at that step
-            rk_rows = self._presence_by_key(rhs)
-            rows, outs = [], []
-            lvals = np.asarray(lhs.values)
-            for i, k in enumerate(lhs.keys):
-                mk = self._match_key(k)
-                if mk in rhs_keys:
-                    rows.append(i)
-                    outs.append(np.where(rk_rows[mk], lvals[i], np.nan))
-            if not rows:
-                return None
-            return ResultBlock([lhs.keys[i] for i in rows], lhs.wends,
-                               np.stack(outs))
-        if op == "or":
-            if lhs is None:
-                return rhs
-            if rhs is None:
-                return lhs
-            lvals = np.asarray(lhs.values)
-            lhs_present = self._presence_by_key(lhs)
-            keys = list(lhs.keys)
-            vals = [lvals]
-            rvals = np.asarray(rhs.values)
-            extra_rows, extra_keys = [], []
-            for i, k in enumerate(rhs.keys):
-                mk = self._match_key(k)
-                mask = lhs_present.get(mk)
-                row = rvals[i]
-                if mask is not None:
-                    row = np.where(mask, np.nan, row)
-                extra_rows.append(row)
-                extra_keys.append(k)
-            if extra_rows:
-                keys = keys + extra_keys
-                vals.append(np.stack(extra_rows))
-            return ResultBlock(keys, lhs.wends, np.concatenate(vals))
-        if op == "unless":
-            if lhs is None:
-                return None
-            if rhs is None:
-                return lhs
-            rk_rows = self._presence_by_key(rhs)
-            lvals = np.asarray(lhs.values)
-            outs = []
-            for i, k in enumerate(lhs.keys):
-                mk = self._match_key(k)
-                mask = rk_rows.get(mk)
-                outs.append(np.where(mask, np.nan, lvals[i])
-                            if mask is not None else lvals[i])
-            return remove_nan_series(
-                ResultBlock(list(lhs.keys), lhs.wends, np.stack(outs)))
-        raise ValueError(op)
-
-
-class SubqueryExec(NonLeafExecPlan):
-    """Evaluate an outer range function over an inner periodic series
-    (foo[5m:1m] with rate/max_over_time/... outside).  The inner child's
-    step-grid samples are treated as raw samples for the outer window kernel
-    (ref: exec/... subquery handling via PeriodicSamplesMapper on inner)."""
-
-    def __init__(self, ctx, children, start_ms, step_ms, end_ms, function,
-                 function_args, subquery_window_ms, subquery_step_ms,
-                 offset_ms=0):
-        super().__init__(ctx, children)
-        self.start_ms, self.step_ms, self.end_ms = start_ms, step_ms, end_ms
-        self.function = function
-        self.function_args = tuple(function_args)
-        self.subquery_window_ms = subquery_window_ms
-        self.subquery_step_ms = subquery_step_ms
-        self.offset_ms = offset_ms
-
-    def args_str(self):
-        return (f"function={self.function}, window={self.subquery_window_ms}, "
-                f"step={self.subquery_step_ms}")
-
-    def compose(self, results, stats):
-        block = concat_blocks([r for r in results if isinstance(r, ResultBlock)])
-        wends = make_window_ends(self.start_ms, self.end_ms, self.step_ms)
-        if block is None:
-            return _block_empty(wends)
-        inner_ts = np.asarray(block.wends)
-        base = int(inner_ts[0]) if len(inner_ts) else 0
-        vals = np.asarray(block.values)
-        S = vals.shape[0]
-        ts_off = np.broadcast_to((inner_ts - base).astype(np.int32),
-                                 (S, len(inner_ts))).copy()
-        # NaN steps are absent samples; offsets stay valid (kernel masks NaN)
-        eval_wends = (wends - self.offset_ms - base).astype(np.int32)
-        out = np.asarray(evaluate_range_function(
-            jnp.asarray(ts_off), jnp.asarray(vals), jnp.asarray(eval_wends),
-            self.subquery_window_ms, self.function, self.function_args,
-            base_ms=base, dense=not bool(np.isnan(vals).any())))
-        return ResultBlock(block.keys, wends, out)
-
-
-class StitchRvsExec(NonLeafExecPlan):
-    """Merge same-key series evaluated over adjacent time ranges
-    (ref: exec/StitchRvsExec.scala)."""
-
-    def compose(self, results, stats):
-        blocks = [r for r in results if isinstance(r, ResultBlock)]
-        if not blocks:
-            return None
-        wends = np.unique(np.concatenate([b.wends for b in blocks]))
-        merged: Dict[RangeVectorKey, np.ndarray] = {}
-        for b in blocks:
-            pos = np.searchsorted(wends, b.wends)
-            vals = np.asarray(b.values)
-            for i, k in enumerate(b.keys):
-                row = merged.get(k)
-                if row is None:
-                    row = np.full(len(wends), np.nan)
-                    merged[k] = row
-                fill = vals[i]
-                take = ~np.isnan(fill)
-                row[pos[take]] = fill[take]
-        keys = list(merged)
-        return ResultBlock(keys, wends, np.stack([merged[k] for k in keys]))
-
-
-# ------------------------------------------------------------- scalar execs
-
-
-class TimeScalarGeneratorExec(LeafExecPlan):
-    """time(), hour(), ... (ref: exec/TimeScalarGeneratorExec:84)."""
-
-    def __init__(self, ctx, start_ms, step_ms, end_ms, function="time"):
-        super().__init__(ctx)
-        self.start_ms, self.step_ms, self.end_ms = start_ms, step_ms, end_ms
-        self.function = function
-
-    def args_str(self):
-        return f"function={self.function}"
-
-    def _do_execute(self, source) -> QueryResultLike:
-        wends = make_window_ends(self.start_ms, self.end_ms, self.step_ms)
-        secs = wends / 1000.0
-        if self.function == "time":
-            vals = secs
-        else:
-            # hour()/minute()/day_of_week()... on step timestamps: the date
-            # INSTANT_FUNCTIONS already interpret values as epoch seconds
-            vals = np.asarray(INSTANT_FUNCTIONS[self.function](jnp.asarray(secs)))
-        return ScalarResult(wends, np.asarray(vals, dtype=float)), QueryStats()
-
-
-class ScalarFixedDoubleExec(LeafExecPlan):
-    """Literal scalar (ref: exec/ScalarFixedDoubleExec:76)."""
-
-    def __init__(self, ctx, start_ms, step_ms, end_ms, value: float):
-        super().__init__(ctx)
-        self.start_ms, self.step_ms, self.end_ms = start_ms, step_ms, end_ms
-        self.value = value
-
-    def args_str(self):
-        return f"value={self.value}"
-
-    def _do_execute(self, source) -> QueryResultLike:
-        wends = make_window_ends(self.start_ms, self.end_ms, self.step_ms)
-        return ScalarResult(wends, np.full(len(wends), self.value)), QueryStats()
-
-
-class ScalarBinaryOperationExec(LeafExecPlan):
-    """scalar op scalar (ref: exec/ScalarBinaryOperationExec:72)."""
-
-    def __init__(self, ctx, start_ms, step_ms, end_ms, operator, lhs, rhs):
-        super().__init__(ctx)
-        self.start_ms, self.step_ms, self.end_ms = start_ms, step_ms, end_ms
-        self.operator = operator
-        self.lhs = lhs          # float or ScalarBinaryOperationExec
-        self.rhs = rhs
-
-    def args_str(self):
-        return f"operator={self.operator}"
-
-    def _eval(self, x, source):
-        if isinstance(x, ScalarBinaryOperationExec):
-            return x._do_execute(source)[0].values
-        return float(x)
-
-    def _do_execute(self, source) -> QueryResultLike:
-        wends = make_window_ends(self.start_ms, self.end_ms, self.step_ms)
-        a = np.broadcast_to(self._eval(self.lhs, source), wends.shape).astype(float)
-        b = np.broadcast_to(self._eval(self.rhs, source), wends.shape).astype(float)
-        # scalar-scalar comparisons always behave as `bool` (PromQL requires it)
-        out = np.asarray(apply_binary_op(
-            jnp.asarray(a), jnp.asarray(b), op=self.operator,
-            bool_modifier=True))
-        return ScalarResult(wends, out), QueryStats()
-
-
-# ----------------------------------------------------------- metadata execs
-
-
-class SelectChunkInfosExec(LeafExecPlan):
-    """Chunk-metadata debug plan: per-partition chunk infos (id, numRows,
-    time range, bytes, per-column encodings) for the series a filter
-    resolves to (ref: query/.../exec/SelectChunkInfosExec.scala:1-78 —
-    id/NumRows/startTime/endTime/numBytes/readerKlazz).  Covers BOTH
-    tiers: sealed chunks in the resident cache and the unsealed tail of
-    the dense store (reported as encoding 'dense-unsealed')."""
-
-    def __init__(self, ctx, dataset, shard, filters, start_ms, end_ms,
-                 schema=None, col_name=None):
-        super().__init__(ctx)
-        self.dataset, self.shard = dataset, shard
-        self.filters = list(filters)
-        self.start_ms, self.end_ms = start_ms, end_ms
-        self.schema = schema
-        self.col_name = col_name
-
-    def args_str(self):
-        return (f"shard={self.shard}, chunkMethod=TimeRangeChunkScan("
-                f"{self.start_ms},{self.end_ms}), "
-                f"filters={[str(f) for f in self.filters]}, "
-                f"col={self.col_name}")
-
-    def _do_execute(self, source) -> QueryResultLike:
-        shard = source.get_shard(self.dataset, self.shard)
-        stats = QueryStats(shards_queried=1)
-        if shard is None:
-            return None, stats
-        lookup = shard.lookup_partitions(self.filters, self.start_ms,
-                                         self.end_ms)
-        rows = []
-        for schema_name, parts in lookup.parts_by_schema.items():
-            if self.schema and schema_name != self.schema:
-                continue
-            store = shard.stores[schema_name]
-            for p in parts:
-                labels = {**p.part_key.tags_dict,
-                          "_metric_": p.part_key.metric}
-                chunks = [(cs, "resident") for cs in shard.resident.read(
-                    p.part_id, self.start_ms, self.end_ms)]
-                if not chunks:
-                    # evicted / recovered partitions: the persisted tier
-                    # still knows the chunk metadata
-                    try:
-                        chunks = [(cs, "persisted")
-                                  for cs in shard.column_store.read_chunks(
-                                      self.dataset, self.shard, p.part_key,
-                                      self.start_ms, self.end_ms)]
-                    except Exception:  # noqa: BLE001 — Null store etc.
-                        chunks = []
-                for cs, tier in chunks:
-                    cols = {name: c.kind
-                            for name, c in cs.columns.items()
-                            if self.col_name in (None, name)}
-                    rows.append({
-                        **labels, "shard": self.shard, "partId": p.part_id,
-                        "chunkId": cs.info.chunk_id,
-                        "numRows": cs.info.num_rows,
-                        "startTime": cs.info.start_time_ms,
-                        "endTime": cs.info.end_time_ms,
-                        "numBytes": cs.nbytes,
-                        "ingestionTime": cs.info.ingestion_time_ms,
-                        "encodings": cols, "tier": tier})
-                # the unsealed dense-store tail is one writable chunk
-                cnt = int(store.counts[p.row])
-                sealed = int(store.sealed[p.row])
-                if cnt > sealed:
-                    ts_row = store.ts[p.row, sealed:cnt]
-                    t0, t1 = int(ts_row[0]), int(ts_row[-1])
-                    if t1 >= self.start_ms and t0 <= self.end_ms:
-                        per_cell = sum(
-                            (arr.dtype.itemsize
-                             * (arr.shape[2] if arr.ndim == 3 else 1))
-                            for name, arr in store.cols.items()
-                            if arr is not None
-                            and self.col_name in (None, name)) + 8
-                        rows.append({
-                            **labels, "shard": self.shard,
-                            "partId": p.part_id, "chunkId": -1,
-                            "numRows": cnt - sealed,
-                            "startTime": t0, "endTime": t1,
-                            "numBytes": (cnt - sealed) * per_cell,
-                            "ingestionTime": -1,
-                            "encodings": {"*": "dense-unsealed"},
-                            "tier": "dense"})
-        stats.series_scanned = sum(
-            len(v) for v in lookup.parts_by_schema.values())
-        return QueryResult([], stats, data=rows), stats
-
-
-class PartKeysExec(LeafExecPlan):
-    """Series-key metadata query (ref: exec/MetadataExecPlan.scala)."""
-
-    def __init__(self, ctx, dataset, shard, filters, start_ms, end_ms):
-        super().__init__(ctx)
-        self.dataset, self.shard = dataset, shard
-        self.filters = list(filters)
-        self.start_ms, self.end_ms = start_ms, end_ms
-
-    def args_str(self):
-        return f"shard={self.shard}, filters={[str(f) for f in self.filters]}"
-
-    def _do_execute(self, source) -> QueryResultLike:
-        shard = source.get_shard(self.dataset, self.shard)
-        stats = QueryStats(shards_queried=1)
-        if shard is None:
-            return None, stats
-        res = shard.lookup_partitions(self.filters, self.start_ms, self.end_ms)
-        keys = []
-        for parts in res.parts_by_schema.values():
-            for p in parts:
-                keys.append({**p.part_key.tags_dict,
-                             "_metric_": p.part_key.metric})
-        data = QueryResult([], stats, data=keys)
-        return data, stats
-
-
-class LabelValuesExec(LeafExecPlan):
-    """ref: exec/MetadataExecPlan.scala LabelValuesExec."""
-
-    def __init__(self, ctx, dataset, shard, filters, labels, start_ms, end_ms):
-        super().__init__(ctx)
-        self.dataset, self.shard = dataset, shard
-        self.filters = list(filters)
-        self.labels = list(labels)
-        self.start_ms, self.end_ms = start_ms, end_ms
-
-    def args_str(self):
-        return f"shard={self.shard}, labels={self.labels}"
-
-    def _do_execute(self, source) -> QueryResultLike:
-        shard = source.get_shard(self.dataset, self.shard)
-        stats = QueryStats(shards_queried=1)
-        if shard is None:
-            return None, stats
-        if not self.labels:        # LabelNames query (ref: LabelNamesExec)
-            return QueryResult([], stats,
-                               data=shard.index.label_names(self.filters)), stats
-        out: Dict[str, List[str]] = {}
-        for lbl in self.labels:
-            out[lbl] = shard.index.label_values(lbl, self.filters or None)
-        return QueryResult([], stats, data=out), stats
-
-
-def _canon(x):
-    """Hashable canonical form for metadata dedup (str or label dict)."""
-    return tuple(sorted(x.items())) if isinstance(x, dict) else x
-
-
-class MetadataMergeExec(NonLeafExecPlan):
-    """Merge metadata results across shards."""
-
-    def compose(self, results, stats):
-        merged = None
-        for r in results:
-            if not isinstance(r, QueryResult) or r.data is None:
-                continue
-            if merged is None:
-                merged = list(r.data) if isinstance(r.data, list) else r.data
-                if isinstance(merged, list):
-                    seen = {_canon(x) for x in merged}
-            elif isinstance(merged, list):
-                for x in r.data:
-                    c = _canon(x)
-                    if c not in seen:
-                        seen.add(c)
-                        merged.append(x)
-            elif isinstance(merged, dict):
-                for k, v in r.data.items():
-                    vals = set(merged.get(k, [])) | set(v)
-                    merged[k] = sorted(vals)
-        return QueryResult([], stats, data=merged)
+from filodb_tpu.query.execbase import (  # noqa: F401
+    AggPartial, Data, EmptyResultExec, ExecPlan, GroupCardinalityError,
+    InProcessPlanDispatcher, LeafExecPlan, NonLeafExecPlan, PlanDispatcher,
+    QueryResultLike, RawBlock, ScalarResult, _FUSED_CACHE_LOCK,
+    _FUSED_GROUP_CACHE, _FUSED_MINMAX_PAD_CACHE, _FUSED_PLAN_CACHE,
+    _FUSED_VALS_CACHE,
+    _align_hist_schemes, _block_empty, _fused_vals_budget,
+    _group_cache_insert, _group_cache_lookup, _lru_touch,
+    _note_mirror_limit, _union_scheme, _vals_cache_insert, _vals_nbytes,
+    present_partial, reduce_partials)
+from filodb_tpu.query.transformers import (  # noqa: F401
+    AbsentFunctionMapper, AggregateMapReduce, AggregatePresenter,
+    InstantVectorFunctionMapper, LimitFunctionMapper,
+    MiscellaneousFunctionMapper, PeriodicSamplesMapper,
+    RangeVectorTransformer, RepeatToGridMapper, ScalarFunctionMapper,
+    ScalarOperationMapper, SortFunctionMapper, VectorFunctionMapper,
+    _CANDIDATE_OPS, _dollar_to_backslash, _group_ids)
+from filodb_tpu.query.leafexec import (  # noqa: F401
+    MultiSchemaPartitionsExec, ScalarBinaryOperationExec,
+    ScalarFixedDoubleExec, TimeScalarGeneratorExec, _estimate_scan)
+from filodb_tpu.query.nonleaf import (  # noqa: F401
+    BinaryJoinExec, DistConcatExec, LocalPartitionDistConcatExec,
+    ReduceAggregateExec, SetOperatorExec, StitchRvsExec, SubqueryExec)
+from filodb_tpu.query.metaexec import (  # noqa: F401
+    LabelValuesExec, MetadataMergeExec, PartKeysExec, SelectChunkInfosExec,
+    _canon)
+from filodb_tpu.query.rangevector import (  # noqa: F401 — the original
+    # module re-exported these transitively; keep import-path compat
+    QueryContext, QueryResult, QueryStats, RangeVectorKey, ResultBlock,
+    concat_blocks, remove_nan_series)
+from filodb_tpu.core.index import ColumnFilter, Equals  # noqa: F401
+from filodb_tpu.ops.timewindow import (  # noqa: F401
+    PAD_TS, make_window_ends, to_offsets)

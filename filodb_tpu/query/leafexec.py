@@ -1,0 +1,640 @@
+"""Leaf exec plans: the shard-local gather + fused-path leaf and the
+scalar generators.
+
+Split from query/exec.py (round 4, no behavior change).
+ref: query/.../exec/MultiSchemaPartitionsExec.scala,
+TimeScalarGeneratorExec.scala.
+"""
+from __future__ import annotations
+
+import dataclasses
+import logging
+import os
+import threading
+from typing import Callable, Dict, List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+import jax.numpy as jnp
+
+from filodb_tpu.core.index import ColumnFilter, Equals
+from filodb_tpu.ops import agg as agg_ops
+from filodb_tpu.ops import hist as hist_ops
+from filodb_tpu.ops.instant import (INSTANT_FUNCTIONS, ARITH_OPERATORS,
+                                    COMPARISON_OPERATORS, apply_binary_op)
+from filodb_tpu.ops import counter as counter_ops
+from filodb_tpu.ops.rangefns import RANGE_FUNCTIONS, evaluate_range_function
+from filodb_tpu.ops.timewindow import PAD_TS, to_offsets, make_window_ends
+from filodb_tpu.query.rangevector import (QueryContext, QueryResult, QueryStats,
+                                          RangeVectorKey, ResultBlock,
+                                          concat_blocks, remove_nan_series)
+
+from filodb_tpu.query.execbase import (
+    AggPartial, GroupCardinalityError, LeafExecPlan, QueryResultLike,
+    RawBlock, ScalarResult,
+    _FUSED_CACHE_LOCK, _FUSED_MINMAX_PAD_CACHE, _FUSED_PLAN_CACHE,
+    _FUSED_VALS_CACHE, _block_empty, _group_cache_insert,
+    _group_cache_lookup, _lru_touch, _note_mirror_limit,
+    _vals_cache_insert)
+from filodb_tpu.query.transformers import (
+    AggregateMapReduce, PeriodicSamplesMapper, RangeVectorTransformer,
+    _group_ids)
+
+
+class MultiSchemaPartitionsExec(LeafExecPlan):
+    """Leaf: index lookup + dense gather on the owning shard
+    (ref: exec/MultiSchemaPartitionsExec.scala:27-60,
+    SelectRawPartitionsExec.doExecute:125)."""
+
+    def __init__(self, ctx: QueryContext, dataset: str, shard: int,
+                 filters: Sequence[ColumnFilter], chunk_start_ms: int,
+                 chunk_end_ms: int, columns: Sequence[str] = (),
+                 schema: Optional[str] = None):
+        super().__init__(ctx)
+        self.dataset = dataset
+        self.shard = shard
+        self.filters = list(filters)
+        self.chunk_start_ms = chunk_start_ms
+        self.chunk_end_ms = chunk_end_ms
+        self.columns = list(columns)
+        self.schema = schema
+        self._transformer_overrides: Dict[int, RangeVectorTransformer] = {}
+
+    def execute_internal(self, source) -> QueryResultLike:
+        self._transformer_overrides = {}
+        self._fused_cache_key = None
+        data, stats = self._do_execute(source)
+        start = 0
+        try:
+            fused = self._try_fused(data, stats)
+        except GroupCardinalityError:
+            raise                        # real query error — must surface
+        except Exception as e:  # noqa: BLE001 — fusion is an optimization
+            from filodb_tpu.utils.metrics import (log_fused_degradation,
+                                                  registry)
+            registry.counter("leaf_fused_errors").increment()
+            log_fused_degradation("leaf", e)
+            fused = None
+        if fused is not None:
+            data, start = fused, 2
+        for i, t in enumerate(self.transformers[start:], start):
+            t = self._transformer_overrides.get(i, t)
+            data = t.apply(data, self.ctx, stats, source)
+        return data, stats
+
+    def _try_fused(self, data, stats):
+        """Peephole: PeriodicSamplesMapper(rate|increase|delta) followed by
+        AggregateMapReduce(sum) over a shared-grid fully-finite working set
+        collapses into the single-HBM-pass MXU kernel (ops/pallas_fused.py)
+        — the leaf analogue of the reference pushing AggregateMapReduce to
+        data nodes (ref: AggrOverRangeVectors.scala:76), fused one level
+        further.  Returns the AggPartial or None (general path)."""
+        if len(self.transformers) < 2 or not isinstance(data, RawBlock) \
+                or not data.keys or data.shared_ts_row is None:
+            return None
+        t0 = self._transformer_overrides.get(0, self.transformers[0])
+        t1 = self._transformer_overrides.get(1, self.transformers[1])
+        if not isinstance(t0, PeriodicSamplesMapper) \
+                or not isinstance(t1, AggregateMapReduce):
+            return None
+        from filodb_tpu.ops import pallas_fused as pf
+        vals = data.values
+        ndim = getattr(vals, "ndim", 0)
+        is_hist = ndim == 3
+        if ndim not in (2, 3) or t0.function_args or t1.params:
+            return None
+        if t0.window_ms is None:
+            # instant-vector selector (`sum by (x) (metric)`): plain
+            # lookback sampling IS last_over_time over the stale-lookback
+            # window — the same normalization the general apply() does
+            if t0.function is not None:
+                return None
+            t0 = dataclasses.replace(t0, window_ms=t0.lookback_ms,
+                                     function="last_over_time")
+        fn = t0.function or ""
+        dense = data.dense
+        if not pf.can_fuse(fn, t1.op, True, dense):
+            return None
+        if is_hist:
+            # histogram buckets are counters too: flatten [S, T, B] into
+            # S*B kernel rows with per-(group, bucket) slots — the hist
+            # analogue (ref: HistogramQueryBenchmark's
+            # sum(rate(..._bucket[5m])) + histogram_quantile)
+            if fn not in ("rate", "increase") or t1.op != "sum" \
+                    or data.bucket_les is None or not dense:
+                return None
+        # host-only fast paths: under the dense shared grid every series
+        # has IDENTICAL per-window sample counts, so count_over_time and
+        # the count aggregate are pure host math — no device work at all
+        if dense and not is_hist and fn == "count_over_time":
+            return self._fused_count_over_time(data, t0, t1)
+        if dense and not is_hist and t1.op == "count":
+            return self._fused_count_agg(data, t0, t1)
+        wends = make_window_ends(t0.start_ms, t0.end_ms, t0.step_ms)
+        eval_wends = wends - t0.offset_ms - data.base_ms
+        if eval_wends.size == 0 or abs(eval_wends).max() >= (1 << 30):
+            return None
+        if fn in pf.MINMAX_FNS:
+            # pure-XLA reduce_window path — any backend, no Pallas
+            return self._fused_minmax(data, t0, t1, wends, eval_wends)
+        import jax
+        backend = jax.default_backend()
+        interpret = backend != "tpu"
+        if interpret and not os.environ.get("FILODB_TPU_FUSED_INTERPRET"):
+            return None                 # kernel is MXU-targeted
+        if fn in ("rate", "increase") and not data.precorrected:
+            return None
+        # VMEM guard, part 1 (group count not yet known — use the minimum):
+        # very long ranges with many windows must take the general path,
+        # not fail at kernel lowering
+        Tp = pf._pad_to(vals.shape[1], pf._LANE)
+        Wp = pf._pad_to(eval_wends.size, pf._LANE)
+        over_time = t0.function in pf.OVER_TIME_FNS
+        ragged_rate = not dense and fn in ("rate", "increase", "delta")
+        if pf.vmem_estimate(Tp, Wp, 8, over_time,
+                            ragged_rate) > pf.VMEM_BUDGET:
+            return None
+        from filodb_tpu.utils.metrics import registry
+        # plan + prepared-input caches: a repeat query over an unchanged
+        # snapshot (the dashboard-poll pattern) skips the selection-matrix
+        # rebuild AND the full padded device copy (PreparedInputs contract)
+        key = self._fused_cache_key
+        plan = padded_vals = groups = gkeys = None
+        if key is not None:
+            plan_key = key[:3] + (t0.start_ms, t0.step_ms, t0.end_ms,
+                                  t0.offset_ms, t0.window_ms, data.base_ms)
+            with _FUSED_CACHE_LOCK:
+                plan = _lru_touch(_FUSED_PLAN_CACHE, plan_key)
+                padded_vals = _lru_touch(_FUSED_VALS_CACHE, key)
+            groups, gkeys = _group_cache_lookup(key, t1.by, t1.without)
+            if padded_vals is not None:
+                registry.counter("leaf_fused_prep_hits").increment()
+        if plan is None:
+            plan = pf.build_plan(data.shared_ts_row.astype(np.int64),
+                                 eval_wends, t0.window_ms)
+            if key is not None:
+                with _FUSED_CACHE_LOCK:
+                    for k in [k for k in _FUSED_PLAN_CACHE
+                              if k[0] == key[0] and k[1] != key[1]]:
+                        del _FUSED_PLAN_CACHE[k]
+                    _FUSED_PLAN_CACHE[plan_key] = plan
+                    while len(_FUSED_PLAN_CACHE) > 8:
+                        _FUSED_PLAN_CACHE.pop(next(iter(_FUSED_PLAN_CACHE)))
+        if gkeys is None:
+            gids, gkeys = _group_ids(data.keys, t1.by, t1.without)
+        self._check_group_limit(gkeys)
+        B = vals.shape[2] if is_hist else 1
+        num_slots = len(gkeys) * B      # hist: one kernel group per (g, b)
+        # VMEM guard, part 2: full estimate now that group count is known —
+        # BEFORE the padded device copy, so diverted queries cost nothing
+        if pf.vmem_estimate(Tp, Wp, max(num_slots, 8),
+                            over_time, ragged_rate) > pf.VMEM_BUDGET:
+            return None
+        if padded_vals is None:
+            vbase = data.vbase
+            if is_hist:
+                # [S, T, B] -> [S*B, T] rows (bucket-major within a series,
+                # same layout PeriodicSamplesMapper flattens to)
+                flat = jnp.moveaxis(jnp.asarray(vals), 2, 1) \
+                    .reshape(vals.shape[0] * B, vals.shape[1])
+                vb_flat = (np.zeros(flat.shape[0], np.float32)
+                           if vbase is None
+                           else jnp.asarray(vbase,
+                                            jnp.float32).reshape(-1))
+                padded_vals = pf.pad_values(flat, vb_flat, plan)
+            else:
+                if vbase is None:
+                    vbase = np.zeros(vals.shape[0], np.float32)
+                padded_vals = pf.pad_values(vals, vbase, plan)
+            if key is not None:
+                # a new snapshot generation obsoletes this mirror's older
+                # entries — drop them NOW, not at LRU eviction: each pins a
+                # full padded copy of the working set in HBM
+                with _FUSED_CACHE_LOCK:
+                    for k in [k for k in _FUSED_VALS_CACHE
+                              if k[0] == key[0] and k[1] != key[1]]:
+                        del _FUSED_VALS_CACHE[k]
+                    _vals_cache_insert(key, padded_vals)
+        if groups is None:
+            if is_hist:
+                gids_flat = (np.asarray(gids, np.int64)[:, None] * B
+                             + np.arange(B)[None, :]).reshape(-1)
+                groups = pf.pad_groups(gids_flat, vals.shape[0] * B,
+                                       num_slots)
+            else:
+                groups = pf.pad_groups(gids, vals.shape[0], len(gkeys))
+            _group_cache_insert(key, t1.by, t1.without, groups, gkeys)
+        prep = pf.PreparedInputs(padded_vals.vals_p, padded_vals.vbase_p,
+                                 groups.gids_p, groups.gsize)
+        registry.counter("leaf_fused_kernel").increment()
+        if not is_hist:
+            # broadened matmul path: any fusable (fn, agg) combination,
+            # ragged (validity-weighted) when the working set has NaN holes
+            comp = pf.fused_leaf_agg(
+                plan, prep, groups.gids_p[:vals.shape[0], 0],
+                len(gkeys), fn, t1.op, precorrected=data.precorrected,
+                interpret=interpret, ragged=not dense)
+            return AggPartial(t1.op, gkeys, wends, comp=comp)
+        sums, _counts = pf.fused_rate_groupsum(
+            None, None, None, plan, num_slots, fn_name=t0.function,
+            precorrected=data.precorrected, interpret=interpret,
+            prepared=prep)
+        G = len(gkeys)
+        buckets = np.asarray(sums, np.float64) \
+            .reshape(G, B, -1).transpose(0, 2, 1)           # [G, W, B]
+        # series-per-group count: every bucket row of a series shares
+        # presence under the dense gate, so any bucket slot's size IS
+        # the group's series count (works on the group-cache hit path
+        # too, where the raw gids were never recomputed)
+        gsize = groups.gsize.reshape(G, B)[:, 0]
+        cnt = gsize[:, None] * plan.wvalid[None, :].astype(np.float64)
+        comp = np.concatenate([buckets, cnt[..., None]], axis=2)
+        return AggPartial("hist_sum", gkeys, wends, comp=comp,
+                          bucket_les=data.bucket_les)
+
+    def args_str(self):
+        fs = ",".join(str(f) for f in self.filters)
+        return (f"dataset={self.dataset}, shard={self.shard}, "
+                f"chunkMethod=TimeRangeChunkScan({self.chunk_start_ms},"
+                f"{self.chunk_end_ms}), filters=[{fs}], colName={self.columns}")
+
+    def _window_counts_groups(self, data, t0, t1):
+        """Shared host math for the no-device fast paths: per-window
+        sample counts on the dense shared grid + grouping."""
+        wends = make_window_ends(t0.start_ms, t0.end_ms, t0.step_ms)
+        eval_wends = wends - t0.offset_ms - data.base_ms
+        if eval_wends.size == 0 or abs(eval_wends).max() >= (1 << 30):
+            return None
+        from filodb_tpu.ops import pallas_fused as pf
+        gids, gkeys = _group_ids(data.keys, t1.by, t1.without)
+        self._check_group_limit(gkeys)
+        n = pf.window_counts(data.shared_ts_row.astype(np.int64),
+                             eval_wends, t0.window_ms).astype(np.float64)
+        gsize = np.bincount(np.asarray(gids),
+                            minlength=len(gkeys))[:len(gkeys)]
+        return wends, gkeys, n, gsize.astype(np.float64)
+
+    def _fused_count_over_time(self, data, t0, t1):
+        """agg by (count_over_time(...)): under the shared dense grid every
+        series has IDENTICAL per-window sample counts, so the whole result
+        is host math over (gsize, n) — no device work at all.  Handles all
+        five fusable aggregates: each series' value at window w is n[w]."""
+        r = self._window_counts_groups(data, t0, t1)
+        if r is None:
+            return None
+        wends, gkeys, n, gsize = r
+        valid = (n >= 1).astype(np.float64)
+        op = t1.op
+        if op in ("sum", "avg"):
+            comp = np.stack([gsize[:, None] * n[None, :] * valid,
+                             gsize[:, None] * valid[None, :]], axis=-1)
+        elif op == "count":
+            comp = (gsize[:, None] * valid[None, :])[..., None]
+        else:                            # min/max: every series agrees on n
+            absent = np.inf if op == "min" else -np.inf
+            per = np.where(valid > 0, n, absent)
+            comp = np.stack(
+                [np.broadcast_to(per[None, :], (len(gkeys), len(n))),
+                 gsize[:, None] * valid[None, :]], axis=-1)
+        from filodb_tpu.utils.metrics import registry
+        registry.counter("leaf_fused_count_host").increment()
+        return AggPartial(op, gkeys, wends, comp=comp)
+
+    def _fused_count_agg(self, data, t0, t1):
+        """count by (fn(...)) on a dense shared grid: the count of series
+        emitting a value at window w is gsize * 1{n[w] >= min_samples} —
+        host math, no device work (the value itself never matters)."""
+        r = self._window_counts_groups(data, t0, t1)
+        if r is None:
+            return None
+        wends, gkeys, n, gsize = r
+        minsamp = 2 if t0.function in ("rate", "increase", "delta") else 1
+        valid = (n >= minsamp).astype(np.float64)
+        from filodb_tpu.utils.metrics import registry
+        registry.counter("leaf_fused_count_host").increment()
+        comp = (gsize[:, None] * valid[None, :])[..., None]
+        return AggPartial("count", gkeys, wends, comp=comp)
+
+    def _fused_minmax(self, data, t0, t1, wends, eval_wends):
+        """min/max_over_time + any aggregate in one jit via the XLA
+        reduce_window path (ops/pallas_fused.fused_minmax_agg) — one HBM
+        pass, no host round trip of the [S, T] working set, any backend.
+        Requires uniform window geometry; else the general path runs."""
+        from filodb_tpu.ops import pallas_fused as pf
+        ts_row0 = np.asarray(data.shared_ts_row)
+        real = ts_row0[ts_row0 < PAD_TS]
+        geom = pf.uniform_window_geometry(real.astype(np.int64),
+                                          eval_wends, t0.window_ms)
+        if geom is None:
+            return None
+        f0, stride, width, t_needed = geom
+        if t_needed > 2 * real.size:
+            # a grid hanging FAR past the data (end=now long after the last
+            # scrape) would pad more columns than the data itself — the
+            # general path handles that without materializing the padding
+            return None
+        # grouping: reuse the shared per-working-set group cache (the same
+        # per-series label hashing the kernel path caches away)
+        key = self._fused_cache_key
+        groups_c, gkeys = _group_cache_lookup(key, t1.by, t1.without)
+        if gkeys is None:
+            gids, gkeys = _group_ids(data.keys, t1.by, t1.without)
+            self._check_group_limit(gkeys)      # reject BEFORE caching
+            _group_cache_insert(key, t1.by, t1.without,
+                                pf.pad_groups(gids, len(data.keys),
+                                              len(gkeys)), gkeys)
+        else:
+            self._check_group_limit(gkeys)
+            gids = np.asarray(groups_c.gids_p[:len(data.keys), 0])
+        vb = data.vbase
+        vals = jnp.asarray(data.values)
+        ragged = not data.dense
+        if t_needed > real.size:
+            # windows hang past the data's right edge (end=now queries):
+            # extend with NaN columns so the ragged variant masks them —
+            # cached per (working set, t_needed): the dashboard-poll shape
+            # would otherwise re-copy the whole set on device every refresh
+            pad_key = None if key is None else key + ("minmax_pad",
+                                                      t_needed)
+            padded = None
+            if pad_key is not None:
+                with _FUSED_CACHE_LOCK:
+                    padded = _lru_touch(_FUSED_MINMAX_PAD_CACHE, pad_key)
+            if padded is None:
+                padded = jnp.pad(vals[:, :real.size],
+                                 ((0, 0), (0, t_needed - real.size)),
+                                 constant_values=np.nan)
+                if pad_key is not None:
+                    with _FUSED_CACHE_LOCK:
+                        for k in [k for k in _FUSED_MINMAX_PAD_CACHE
+                                  if k[0] == pad_key[0]
+                                  and k[1] != pad_key[1]]:
+                            del _FUSED_MINMAX_PAD_CACHE[k]
+                        _FUSED_MINMAX_PAD_CACHE[pad_key] = padded
+                        while len(_FUSED_MINMAX_PAD_CACHE) > 2:
+                            _FUSED_MINMAX_PAD_CACHE.pop(
+                                next(iter(_FUSED_MINMAX_PAD_CACHE)))
+            vals = padded
+            ragged = True
+        comp = pf.fused_minmax_agg(
+            vals, None if vb is None else jnp.asarray(vb),
+            jnp.asarray(gids, jnp.int32), f0, stride, width,
+            int(eval_wends.size), t0.function, t1.op, len(gkeys),
+            ragged=ragged)
+        from filodb_tpu.utils.metrics import registry
+        registry.counter("leaf_fused_minmax").increment()
+        return AggPartial(t1.op, gkeys, wends,
+                          comp=np.asarray(comp, np.float64))
+
+    def _check_group_limit(self, gkeys) -> None:
+        limit = self.ctx.planner_params.group_by_cardinality_limit
+        if limit and len(gkeys) > limit:
+            raise GroupCardinalityError(
+                f"group-by cardinality limit {limit} exceeded "
+                f"({len(gkeys)} groups)")
+
+    def _do_execute(self, source) -> QueryResultLike:
+        stats = QueryStats(shards_queried=1)
+        shard = source.get_shard(self.dataset, self.shard)
+        if shard is None:
+            return None, stats
+        lookup = shard.lookup_partitions(self.filters, self.chunk_start_ms,
+                                         self.chunk_end_ms)
+        schema_name = self.schema or lookup.first_schema
+        if schema_name is None:
+            return None, stats
+        pids = lookup.pids_by_schema.get(schema_name)
+        if pids is None or pids.size == 0:
+            return None, stats
+        store = shard.stores[schema_name]
+        rows = shard.rows_for(pids)
+
+        # Cap data scanned BEFORE materializing (or paging) the [S, T]
+        # matrix — a pathological selector must fail fast, not OOM first
+        # (ref: OnDemandPagingShard.scala:55 capDataScannedPerShardCheck,
+        # ExecPlan.scala:139-180 enforcedLimits).  The estimate clips each
+        # series to the query's chunk range assuming uniform spacing (the
+        # reference estimates from chunk metadata the same way); checked
+        # against the resident data before ODP and again after paging.
+        limit = self.ctx.planner_params.scan_limit
+        enforced = limit and self.ctx.planner_params.enforced_limits
+
+        def _check_scan_cap(when: str):
+            if not enforced:
+                return
+            to_scan = _estimate_scan(store, rows, self.chunk_start_ms,
+                                     self.chunk_end_ms)
+            if to_scan > limit:
+                raise ValueError(
+                    f"shard {self.shard}: query would scan ~{to_scan} "
+                    f"samples ({when}), over the scan limit {limit} — "
+                    f"narrow the filters or time range")
+
+        _check_scan_cap("resident")
+        shard.ensure_paged_pids(schema_name, pids,
+                                self.chunk_start_ms, self.chunk_end_ms,
+                                max_samples=limit if enforced else None)
+        _check_scan_cap("after demand paging")
+        schema = shard.schemas[schema_name]
+        col_name = (self.columns[0] if self.columns
+                    else schema.value_column)
+        # schema-specific column + range-function substitution for the
+        # downsample gauge schema: min_over_time reads the `min` column,
+        # count_over_time becomes sum_over_time over `count`, etc.  Applied
+        # as per-execution overrides so the plan stays reusable
+        # (ref: MultiSchemaPartitionsExec.finalizePlan schema substitutions;
+        # Schemas DS_GAUGE_FN_SUBSTITUTION)
+        if schema.name == "ds-gauge" and not self.columns:
+            from filodb_tpu.core.schemas import DS_GAUGE_FN_SUBSTITUTION
+            for i, t in enumerate(self.transformers):
+                if isinstance(t, PeriodicSamplesMapper):
+                    sub = DS_GAUGE_FN_SUBSTITUTION.get(t.function)
+                    if sub is not None:
+                        col_name = sub[0]
+                        if sub[1] != t.function:
+                            self._transformer_overrides[i] = \
+                                dataclasses.replace(t, function=sub[1])
+                    break
+        # counter semantics: counter-typed columns are reset-corrected in
+        # f64 host-side (ops/counter.host_counter_correct) when the range
+        # function has counter semantics, so post-rebase f32 deltas are
+        # exact even across resets.  Non-counter functions on counter
+        # columns (resets/delta/changes) need the RAW values and therefore
+        # bypass the (pre-corrected) device mirror.
+        col_def = next((c for c in schema.data_columns
+                        if c.name == col_name), None)
+        counter_col = col_def is not None and (col_def.detect_drops
+                                               or col_def.counter)
+        fn_is_counter = False
+        for t in self.transformers:
+            if isinstance(t, PeriodicSamplesMapper):
+                spec = RANGE_FUNCTIONS.get(t.function or "")
+                fn_is_counter = spec.is_counter if spec else False
+                break
+        # device-resident fast path: gather rows from the HBM mirror instead
+        # of re-shipping the matrix every query (ref: block-memory working
+        # set, BlockManager.scala; see core/devicecache.py)
+        mirror = None
+        if getattr(shard.config.store, "device_mirror_enabled", True) and (
+                not counter_col or fn_is_counter):
+            mirror = getattr(store, "device_mirror", None)
+            if mirror is None:
+                from filodb_tpu.core.devicecache import (
+                    DEFAULT_HBM_LIMIT_BYTES, DeviceMirror)
+                limit = getattr(shard.config.store,
+                                "device_mirror_hbm_limit",
+                                DEFAULT_HBM_LIMIT_BYTES)
+                mirror = store.device_mirror = DeviceMirror(limit)
+                _note_mirror_limit(limit)
+
+        # Mirror refresh (a full host->device upload) runs at most once per
+        # query, under the write lock so it can't race a mutation; the
+        # subsequent row gather reads only the immutable device copy.  The
+        # host fallback copies out under the seqlock so a concurrent
+        # ingest/flush can't hand the kernel a torn matrix.
+        mirrored = snap = None
+        if mirror is not None:
+            ok = mirror.is_fresh(store)
+            if not ok:
+                with shard._write_locked("mirror_refresh"):
+                    ok = mirror.ensure_fresh(store)
+            if ok:
+                # one snapshot read serves gather AND fused-eligibility:
+                # pairing a newer snapshot's grid with an older one's values
+                # would feed the kernel zero-padded phantom columns
+                snap = mirror.snapshot()
+                mirrored = mirror.gather_cached(rows, snap)
+        # value column selection: histograms gather [S, T, B]
+        shared_ts_row = None
+        dense = True
+        if mirrored is not None:
+            ts_off, dev_cols, dev_vbases, base = mirrored
+            vals = dev_cols[col_name]
+            vbase = dev_vbases.get(col_name)
+            counts = shard.snapshot_read(store,
+                                         lambda: store.counts[rows].copy())
+            precorrected = counter_col   # mirror corrects counter columns
+            shared_ts_row = mirror.fused_eligible(col_name, snap,
+                                                  allow_ragged=True)
+            # col_dense is grid-independent (counted cells finite; pads are
+            # excluded via PAD_TS), so a non-shared grid with finite values
+            # keeps the cheap slot-boundary rate path
+            dense = mirror.col_dense(col_name, snap)
+            if shared_ts_row is not None:
+                # cache identity for the fused path's prepared-input reuse
+                # (mirror.serial, not id(): ids are reused after GC; raw
+                # rows bytes, not their hash: a collision would silently
+                # serve another row-set's values)
+                self._fused_cache_key = (mirror.serial, snap.gen, col_name,
+                                         rows.tobytes())
+        else:
+            ts, cols, counts = shard.snapshot_read(
+                store, lambda: store.gather_rows(rows))
+            base = self.chunk_start_ms
+            ts_off = to_offsets(ts, counts, base)
+            # correct (f64) + rebase so counter deltas stay exact on chip
+            precorrected = counter_col and fn_is_counter
+            vals, vbase = counter_ops.rebase_values(cols[col_name],
+                                                    precorrected)
+            # NaN anywhere (staleness markers or ragged-length padding)
+            # routes the rate family onto its valid-boundary variant
+            dense = not bool(np.isnan(vals).any())
+        keys = shard.keys_for(pids)
+        stats.series_scanned = int(pids.size)
+        stats.samples_scanned = int(counts.sum())
+        les = store.bucket_les if vals.ndim == 3 else None
+        return RawBlock(keys, ts_off, vals, base, les,
+                        samples=stats.samples_scanned, vbase=vbase,
+                        precorrected=precorrected,
+                        shared_ts_row=shared_ts_row, dense=dense), stats
+
+
+def _estimate_scan(store, rows: np.ndarray, start_ms: int,
+                   end_ms: int) -> int:
+    """Estimated samples in [start_ms, end_ms] across the given store rows,
+    from per-series extents under a uniform-spacing assumption — O(S), no
+    [S, T] materialization."""
+    cnt = store.counts[rows].astype(np.int64)
+    if store.ts.shape[1] == 0 or not cnt.any():
+        return 0
+    first = store.ts[rows, 0]
+    last = store.ts[rows, np.maximum(cnt - 1, 0)]
+    lo = np.maximum(first, start_ms)
+    hi = np.minimum(last, end_ms)
+    span = np.maximum(last - first, 1).astype(np.float64)
+    frac = np.clip((hi - lo).astype(np.float64) / span, 0.0, 1.0)
+    est = np.where((cnt > 0) & (hi >= lo), np.maximum(cnt * frac, 1.0), 0.0)
+    return int(est.sum())
+
+
+
+# ------------------------------------------------------------- scalar execs
+
+
+class TimeScalarGeneratorExec(LeafExecPlan):
+    """time(), hour(), ... (ref: exec/TimeScalarGeneratorExec:84)."""
+
+    def __init__(self, ctx, start_ms, step_ms, end_ms, function="time"):
+        super().__init__(ctx)
+        self.start_ms, self.step_ms, self.end_ms = start_ms, step_ms, end_ms
+        self.function = function
+
+    def args_str(self):
+        return f"function={self.function}"
+
+    def _do_execute(self, source) -> QueryResultLike:
+        wends = make_window_ends(self.start_ms, self.end_ms, self.step_ms)
+        secs = wends / 1000.0
+        if self.function == "time":
+            vals = secs
+        else:
+            # hour()/minute()/day_of_week()... on step timestamps: the date
+            # INSTANT_FUNCTIONS already interpret values as epoch seconds
+            vals = np.asarray(INSTANT_FUNCTIONS[self.function](jnp.asarray(secs)))
+        return ScalarResult(wends, np.asarray(vals, dtype=float)), QueryStats()
+
+
+class ScalarFixedDoubleExec(LeafExecPlan):
+    """Literal scalar (ref: exec/ScalarFixedDoubleExec:76)."""
+
+    def __init__(self, ctx, start_ms, step_ms, end_ms, value: float):
+        super().__init__(ctx)
+        self.start_ms, self.step_ms, self.end_ms = start_ms, step_ms, end_ms
+        self.value = value
+
+    def args_str(self):
+        return f"value={self.value}"
+
+    def _do_execute(self, source) -> QueryResultLike:
+        wends = make_window_ends(self.start_ms, self.end_ms, self.step_ms)
+        return ScalarResult(wends, np.full(len(wends), self.value)), QueryStats()
+
+
+class ScalarBinaryOperationExec(LeafExecPlan):
+    """scalar op scalar (ref: exec/ScalarBinaryOperationExec:72)."""
+
+    def __init__(self, ctx, start_ms, step_ms, end_ms, operator, lhs, rhs):
+        super().__init__(ctx)
+        self.start_ms, self.step_ms, self.end_ms = start_ms, step_ms, end_ms
+        self.operator = operator
+        self.lhs = lhs          # float or ScalarBinaryOperationExec
+        self.rhs = rhs
+
+    def args_str(self):
+        return f"operator={self.operator}"
+
+    def _eval(self, x, source):
+        if isinstance(x, ScalarBinaryOperationExec):
+            return x._do_execute(source)[0].values
+        return float(x)
+
+    def _do_execute(self, source) -> QueryResultLike:
+        wends = make_window_ends(self.start_ms, self.end_ms, self.step_ms)
+        a = np.broadcast_to(self._eval(self.lhs, source), wends.shape).astype(float)
+        b = np.broadcast_to(self._eval(self.rhs, source), wends.shape).astype(float)
+        # scalar-scalar comparisons always behave as `bool` (PromQL requires it)
+        out = np.asarray(apply_binary_op(
+            jnp.asarray(a), jnp.asarray(b), op=self.operator,
+            bool_modifier=True))
+        return ScalarResult(wends, out), QueryStats()
+
+

@@ -1,0 +1,210 @@
+"""Metadata exec plans: chunk-info debug scans, part-key and label
+queries, cross-shard metadata merge.
+
+Split from query/exec.py (round 4, no behavior change).
+ref: query/.../exec/SelectChunkInfosExec.scala:1-78,
+MetadataExecPlan.scala.
+"""
+from __future__ import annotations
+
+import dataclasses
+import logging
+import os
+import threading
+from typing import Callable, Dict, List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+import jax.numpy as jnp
+
+from filodb_tpu.core.index import ColumnFilter, Equals
+from filodb_tpu.ops import agg as agg_ops
+from filodb_tpu.ops import hist as hist_ops
+from filodb_tpu.ops.instant import (INSTANT_FUNCTIONS, ARITH_OPERATORS,
+                                    COMPARISON_OPERATORS, apply_binary_op)
+from filodb_tpu.ops import counter as counter_ops
+from filodb_tpu.ops.rangefns import RANGE_FUNCTIONS, evaluate_range_function
+from filodb_tpu.ops.timewindow import PAD_TS, to_offsets, make_window_ends
+from filodb_tpu.query.rangevector import (QueryContext, QueryResult, QueryStats,
+                                          RangeVectorKey, ResultBlock,
+                                          concat_blocks, remove_nan_series)
+
+from filodb_tpu.query.execbase import (
+    LeafExecPlan, NonLeafExecPlan, QueryResultLike)
+
+
+# ----------------------------------------------------------- metadata execs
+
+
+class SelectChunkInfosExec(LeafExecPlan):
+    """Chunk-metadata debug plan: per-partition chunk infos (id, numRows,
+    time range, bytes, per-column encodings) for the series a filter
+    resolves to (ref: query/.../exec/SelectChunkInfosExec.scala:1-78 —
+    id/NumRows/startTime/endTime/numBytes/readerKlazz).  Covers BOTH
+    tiers: sealed chunks in the resident cache and the unsealed tail of
+    the dense store (reported as encoding 'dense-unsealed')."""
+
+    def __init__(self, ctx, dataset, shard, filters, start_ms, end_ms,
+                 schema=None, col_name=None):
+        super().__init__(ctx)
+        self.dataset, self.shard = dataset, shard
+        self.filters = list(filters)
+        self.start_ms, self.end_ms = start_ms, end_ms
+        self.schema = schema
+        self.col_name = col_name
+
+    def args_str(self):
+        return (f"shard={self.shard}, chunkMethod=TimeRangeChunkScan("
+                f"{self.start_ms},{self.end_ms}), "
+                f"filters={[str(f) for f in self.filters]}, "
+                f"col={self.col_name}")
+
+    def _do_execute(self, source) -> QueryResultLike:
+        shard = source.get_shard(self.dataset, self.shard)
+        stats = QueryStats(shards_queried=1)
+        if shard is None:
+            return None, stats
+        lookup = shard.lookup_partitions(self.filters, self.start_ms,
+                                         self.end_ms)
+        rows = []
+        for schema_name, parts in lookup.parts_by_schema.items():
+            if self.schema and schema_name != self.schema:
+                continue
+            store = shard.stores[schema_name]
+            for p in parts:
+                labels = {**p.part_key.tags_dict,
+                          "_metric_": p.part_key.metric}
+                chunks = [(cs, "resident") for cs in shard.resident.read(
+                    p.part_id, self.start_ms, self.end_ms)]
+                if not chunks:
+                    # evicted / recovered partitions: the persisted tier
+                    # still knows the chunk metadata
+                    try:
+                        chunks = [(cs, "persisted")
+                                  for cs in shard.column_store.read_chunks(
+                                      self.dataset, self.shard, p.part_key,
+                                      self.start_ms, self.end_ms)]
+                    except Exception:  # noqa: BLE001 — Null store etc.
+                        chunks = []
+                for cs, tier in chunks:
+                    cols = {name: c.kind
+                            for name, c in cs.columns.items()
+                            if self.col_name in (None, name)}
+                    rows.append({
+                        **labels, "shard": self.shard, "partId": p.part_id,
+                        "chunkId": cs.info.chunk_id,
+                        "numRows": cs.info.num_rows,
+                        "startTime": cs.info.start_time_ms,
+                        "endTime": cs.info.end_time_ms,
+                        "numBytes": cs.nbytes,
+                        "ingestionTime": cs.info.ingestion_time_ms,
+                        "encodings": cols, "tier": tier})
+                # the unsealed dense-store tail is one writable chunk
+                cnt = int(store.counts[p.row])
+                sealed = int(store.sealed[p.row])
+                if cnt > sealed:
+                    ts_row = store.ts[p.row, sealed:cnt]
+                    t0, t1 = int(ts_row[0]), int(ts_row[-1])
+                    if t1 >= self.start_ms and t0 <= self.end_ms:
+                        per_cell = sum(
+                            (arr.dtype.itemsize
+                             * (arr.shape[2] if arr.ndim == 3 else 1))
+                            for name, arr in store.cols.items()
+                            if arr is not None
+                            and self.col_name in (None, name)) + 8
+                        rows.append({
+                            **labels, "shard": self.shard,
+                            "partId": p.part_id, "chunkId": -1,
+                            "numRows": cnt - sealed,
+                            "startTime": t0, "endTime": t1,
+                            "numBytes": (cnt - sealed) * per_cell,
+                            "ingestionTime": -1,
+                            "encodings": {"*": "dense-unsealed"},
+                            "tier": "dense"})
+        stats.series_scanned = sum(
+            len(v) for v in lookup.parts_by_schema.values())
+        return QueryResult([], stats, data=rows), stats
+
+
+class PartKeysExec(LeafExecPlan):
+    """Series-key metadata query (ref: exec/MetadataExecPlan.scala)."""
+
+    def __init__(self, ctx, dataset, shard, filters, start_ms, end_ms):
+        super().__init__(ctx)
+        self.dataset, self.shard = dataset, shard
+        self.filters = list(filters)
+        self.start_ms, self.end_ms = start_ms, end_ms
+
+    def args_str(self):
+        return f"shard={self.shard}, filters={[str(f) for f in self.filters]}"
+
+    def _do_execute(self, source) -> QueryResultLike:
+        shard = source.get_shard(self.dataset, self.shard)
+        stats = QueryStats(shards_queried=1)
+        if shard is None:
+            return None, stats
+        res = shard.lookup_partitions(self.filters, self.start_ms, self.end_ms)
+        keys = []
+        for parts in res.parts_by_schema.values():
+            for p in parts:
+                keys.append({**p.part_key.tags_dict,
+                             "_metric_": p.part_key.metric})
+        data = QueryResult([], stats, data=keys)
+        return data, stats
+
+
+class LabelValuesExec(LeafExecPlan):
+    """ref: exec/MetadataExecPlan.scala LabelValuesExec."""
+
+    def __init__(self, ctx, dataset, shard, filters, labels, start_ms, end_ms):
+        super().__init__(ctx)
+        self.dataset, self.shard = dataset, shard
+        self.filters = list(filters)
+        self.labels = list(labels)
+        self.start_ms, self.end_ms = start_ms, end_ms
+
+    def args_str(self):
+        return f"shard={self.shard}, labels={self.labels}"
+
+    def _do_execute(self, source) -> QueryResultLike:
+        shard = source.get_shard(self.dataset, self.shard)
+        stats = QueryStats(shards_queried=1)
+        if shard is None:
+            return None, stats
+        if not self.labels:        # LabelNames query (ref: LabelNamesExec)
+            return QueryResult([], stats,
+                               data=shard.index.label_names(self.filters)), stats
+        out: Dict[str, List[str]] = {}
+        for lbl in self.labels:
+            out[lbl] = shard.index.label_values(lbl, self.filters or None)
+        return QueryResult([], stats, data=out), stats
+
+
+def _canon(x):
+    """Hashable canonical form for metadata dedup (str or label dict)."""
+    return tuple(sorted(x.items())) if isinstance(x, dict) else x
+
+
+class MetadataMergeExec(NonLeafExecPlan):
+    """Merge metadata results across shards."""
+
+    def compose(self, results, stats):
+        merged = None
+        for r in results:
+            if not isinstance(r, QueryResult) or r.data is None:
+                continue
+            if merged is None:
+                merged = list(r.data) if isinstance(r.data, list) else r.data
+                if isinstance(merged, list):
+                    seen = {_canon(x) for x in merged}
+            elif isinstance(merged, list):
+                for x in r.data:
+                    c = _canon(x)
+                    if c not in seen:
+                        seen.add(c)
+                        merged.append(x)
+            elif isinstance(merged, dict):
+                for k, v in r.data.items():
+                    vals = set(merged.get(k, [])) | set(v)
+                    merged[k] = sorted(vals)
+        return QueryResult([], stats, data=merged)
+

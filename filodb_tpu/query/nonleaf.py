@@ -1,0 +1,384 @@
+"""Non-leaf exec plans: concat/stitch, tree-reduce aggregation, binary
+joins and set operators, subqueries.
+
+Split from query/exec.py (round 4, no behavior change).
+ref: query/.../exec/DistConcatExec.scala, BinaryJoinExec.scala,
+StitchRvsExec.scala, AggrOverRangeVectors.scala:51.
+"""
+from __future__ import annotations
+
+import dataclasses
+import logging
+import os
+import threading
+from typing import Callable, Dict, List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+import jax.numpy as jnp
+
+from filodb_tpu.core.index import ColumnFilter, Equals
+from filodb_tpu.ops import agg as agg_ops
+from filodb_tpu.ops import hist as hist_ops
+from filodb_tpu.ops.instant import (INSTANT_FUNCTIONS, ARITH_OPERATORS,
+                                    COMPARISON_OPERATORS, apply_binary_op)
+from filodb_tpu.ops import counter as counter_ops
+from filodb_tpu.ops.rangefns import RANGE_FUNCTIONS, evaluate_range_function
+from filodb_tpu.ops.timewindow import PAD_TS, to_offsets, make_window_ends
+from filodb_tpu.query.rangevector import (QueryContext, QueryResult, QueryStats,
+                                          RangeVectorKey, ResultBlock,
+                                          concat_blocks, remove_nan_series)
+
+from filodb_tpu.query.execbase import (
+    AggPartial, ExecPlan, NonLeafExecPlan, RawBlock, ScalarResult,
+    _block_empty, _union_scheme, reduce_partials)
+from filodb_tpu.query.transformers import _group_ids
+
+
+class DistConcatExec(NonLeafExecPlan):
+    """Concatenate child results (ref: exec/DistConcatExec.scala)."""
+
+    def compose(self, results, stats):
+        blocks = [r for r in results if isinstance(r, ResultBlock)]
+        raws = [r for r in results if isinstance(r, RawBlock)]
+        if raws:
+            # raw blocks concat only if same grid/base — planner guarantees.
+            # Cross-shard bucket-scheme drift is resolved by rebucketing
+            # every block onto the union scheme (HistogramBuckets.scala:340)
+            les0 = raws[0].bucket_les
+            if any((r.bucket_les is None) != (les0 is None) or (
+                    les0 is not None and r.bucket_les is not None
+                    and not np.array_equal(les0, r.bucket_les))
+                   for r in raws[1:]):
+                union = _union_scheme([r.bucket_les for r in raws])
+                if union is None:
+                    raise ValueError(
+                        "cannot concat histogram blocks: some shards carry "
+                        "no bucket boundaries")
+                from filodb_tpu.memory.histogram import rebucket
+                raws = [dataclasses.replace(
+                            r,
+                            values=rebucket(np.asarray(r.values),
+                                            r.bucket_les, union),
+                            vbase=(rebucket(np.asarray(r.vbase),
+                                            r.bucket_les, union)
+                                   if r.vbase is not None
+                                   and np.asarray(r.vbase).ndim == 2
+                                   else r.vbase),
+                            bucket_les=union)
+                        if not np.array_equal(r.bucket_les, union) else r
+                        for r in raws]
+                les0 = union
+            keys = []
+            for r in raws:
+                keys.extend(r.keys)
+            T = max(r.ts_off.shape[1] for r in raws)
+            def pad(a, fill):
+                out = np.full((a.shape[0], T) + a.shape[2:], fill, a.dtype)
+                out[:, :a.shape[1]] = a
+                return out
+            from filodb_tpu.ops.timewindow import PAD_TS
+            ts = np.concatenate([pad(r.ts_off, PAD_TS) for r in raws])
+            vals = np.concatenate([pad(np.asarray(r.values), np.nan)
+                                   for r in raws])
+            vbase = None
+            if any(r.vbase is not None for r in raws):
+                vbase = np.concatenate([
+                    np.asarray(r.vbase) if r.vbase is not None
+                    else np.zeros(np.asarray(r.values).shape[:1]
+                                  + np.asarray(r.values).shape[2:])
+                    for r in raws])
+            return RawBlock(keys, ts, vals, raws[0].base_ms,
+                            raws[0].bucket_les,
+                            samples=sum(r.samples for r in raws),
+                            vbase=vbase,
+                            precorrected=all(r.precorrected for r in raws),
+                            # pad NaNs live at PAD_TS slots (excluded via
+                            # ts), so raggedness merges as AND over blocks
+                            dense=all(r.dense for r in raws))
+        return concat_blocks(blocks)
+
+
+class LocalPartitionDistConcatExec(DistConcatExec):
+    """ref: exec/DistConcatExec.scala LocalPartitionDistConcatExec."""
+
+
+class ReduceAggregateExec(NonLeafExecPlan):
+    """Reduce phase across shards (ref: AggrOverRangeVectors.scala:51)."""
+
+    def __init__(self, ctx, children, op: str, params: Tuple = ()):
+        super().__init__(ctx, children)
+        self.op = op
+        self.params = params
+
+    def args_str(self):
+        return f"aggrOp={self.op}, aggrParams={list(self.params)}"
+
+    def compose(self, results, stats):
+        parts = [r for r in results if isinstance(r, AggPartial)]
+        return reduce_partials(parts)
+
+
+class BinaryJoinExec(NonLeafExecPlan):
+    """Vector-vector join (ref: exec/BinaryJoinExec.scala:210).
+
+    lhs children come first, then rhs children; the split index separates
+    them (mirrors the reference's lhs/rhs Seq[ExecPlan]).
+    """
+
+    def __init__(self, ctx, lhs: Sequence[ExecPlan], rhs: Sequence[ExecPlan],
+                 operator: str, cardinality: str = "OneToOne",
+                 on: Optional[Tuple[str, ...]] = None,
+                 ignoring: Tuple[str, ...] = (),
+                 include: Tuple[str, ...] = (),
+                 bool_modifier: bool = False):
+        super().__init__(ctx, list(lhs) + list(rhs))
+        self.n_lhs = len(lhs)
+        self.operator = operator
+        self.cardinality = cardinality
+        self.on = tuple(on) if on is not None else None
+        self.ignoring = tuple(ignoring)
+        self.include = tuple(include)
+        self.bool_modifier = bool_modifier
+
+    def args_str(self):
+        return (f"binaryOp={self.operator}, on={self.on}, "
+                f"ignoring={list(self.ignoring)}")
+
+    def _match_key(self, k: RangeVectorKey) -> RangeVectorKey:
+        if self.on is not None:
+            return k.only(self.on)
+        drop = self.ignoring + ("_metric_", "__name__")
+        return k.without(drop)
+
+    def compose(self, results, stats):
+        lhs_blocks = [r for r in results[:self.n_lhs] if isinstance(r, ResultBlock)]
+        rhs_blocks = [r for r in results[self.n_lhs:] if isinstance(r, ResultBlock)]
+        lhs = concat_blocks(lhs_blocks)
+        rhs = concat_blocks(rhs_blocks)
+        if lhs is None or rhs is None:
+            return None
+        many_side, one_side = lhs, rhs
+        flip = False
+        if self.cardinality == "OneToMany":
+            many_side, one_side = rhs, lhs
+            flip = True
+        # index the "one" side by match key; duplicates are an error
+        one_index: Dict[RangeVectorKey, int] = {}
+        for i, k in enumerate(one_side.keys):
+            mk = self._match_key(k)
+            if mk in one_index:
+                raise ValueError(
+                    "many-to-many matching not allowed: duplicate series on "
+                    f"'one' side for key {mk}")
+            one_index[mk] = i
+        card_limit = self.ctx.planner_params.join_cardinality_limit
+        pairs: List[Tuple[int, int]] = []
+        for i, k in enumerate(many_side.keys):
+            j = one_index.get(self._match_key(k))
+            if j is not None:
+                pairs.append((i, j))
+                if len(pairs) > card_limit:
+                    raise ValueError(f"join cardinality limit {card_limit} exceeded")
+        if self.cardinality == "OneToOne":
+            seen: Dict[int, int] = {}
+            for i, j in pairs:
+                if j in seen:
+                    raise ValueError("one-to-one join has many-to-one matches; "
+                                     "use group_left/group_right")
+                seen[j] = i
+        if not pairs:
+            return None
+        mi = np.asarray([p[0] for p in pairs])
+        oi = np.asarray([p[1] for p in pairs])
+        mv = np.asarray(many_side.values)[mi]
+        ov = np.asarray(one_side.values)[oi]
+        a, b = (ov, mv) if flip else (mv, ov)   # a = query LHS values
+        out = np.asarray(apply_binary_op(
+            jnp.asarray(a), jnp.asarray(b), op=self.operator,
+            bool_modifier=self.bool_modifier, keep_side="lhs"))
+        keys = []
+        for i, j in pairs:
+            mk = many_side.keys[i]
+            lbls = self._result_labels(mk, one_side.keys[j])
+            keys.append(lbls)
+        return ResultBlock(keys, many_side.wends, out)
+
+    def _result_labels(self, many_key: RangeVectorKey,
+                       one_key: RangeVectorKey) -> RangeVectorKey:
+        if self.cardinality in ("ManyToOne", "OneToMany"):
+            lbls = many_key.without(("_metric_", "__name__")).labels_dict
+            if self.include:
+                od = one_key.labels_dict
+                for lbl in self.include:
+                    if lbl in od:
+                        lbls[lbl] = od[lbl]
+                    else:
+                        lbls.pop(lbl, None)
+            return RangeVectorKey.make(lbls)
+        if self.on is not None:
+            return many_key.only(self.on)
+        return many_key.without(self.ignoring + ("_metric_", "__name__"))
+
+
+class SetOperatorExec(NonLeafExecPlan):
+    """and/or/unless (ref: exec/SetOperatorExec.scala)."""
+
+    def __init__(self, ctx, lhs: Sequence[ExecPlan], rhs: Sequence[ExecPlan],
+                 operator: str, on: Optional[Tuple[str, ...]] = None,
+                 ignoring: Tuple[str, ...] = ()):
+        super().__init__(ctx, list(lhs) + list(rhs))
+        self.n_lhs = len(lhs)
+        self.operator = operator.lower()
+        self.on = tuple(on) if on is not None else None
+        self.ignoring = tuple(ignoring)
+
+    def args_str(self):
+        return f"binaryOp={self.operator}, on={self.on}, ignoring={list(self.ignoring)}"
+
+    def _match_key(self, k: RangeVectorKey) -> RangeVectorKey:
+        if self.on is not None:
+            return k.only(self.on)
+        return k.without(self.ignoring + ("_metric_", "__name__"))
+
+    def _presence_by_key(self, block: ResultBlock) -> Dict[RangeVectorKey, np.ndarray]:
+        """match-key -> [W] bool, True where any series with that key has a
+        sample at the step."""
+        vals = np.asarray(block.values)
+        if vals.ndim == 3:                       # histogram block
+            vals = vals[..., 0]
+        present: Dict[RangeVectorKey, np.ndarray] = {}
+        for i, k in enumerate(block.keys):
+            mk = self._match_key(k)
+            pres = ~np.isnan(vals[i])
+            present[mk] = present.get(mk, False) | pres
+        return present
+
+    def compose(self, results, stats):
+        lhs = concat_blocks([r for r in results[:self.n_lhs]
+                             if isinstance(r, ResultBlock)])
+        rhs = concat_blocks([r for r in results[self.n_lhs:]
+                             if isinstance(r, ResultBlock)])
+        op = self.operator
+        if op == "and":
+            if lhs is None or rhs is None:
+                return None
+            rhs_keys = {self._match_key(k) for k in rhs.keys}
+            # per-step AND: lhs kept where rhs series present at that step
+            rk_rows = self._presence_by_key(rhs)
+            rows, outs = [], []
+            lvals = np.asarray(lhs.values)
+            for i, k in enumerate(lhs.keys):
+                mk = self._match_key(k)
+                if mk in rhs_keys:
+                    rows.append(i)
+                    outs.append(np.where(rk_rows[mk], lvals[i], np.nan))
+            if not rows:
+                return None
+            return ResultBlock([lhs.keys[i] for i in rows], lhs.wends,
+                               np.stack(outs))
+        if op == "or":
+            if lhs is None:
+                return rhs
+            if rhs is None:
+                return lhs
+            lvals = np.asarray(lhs.values)
+            lhs_present = self._presence_by_key(lhs)
+            keys = list(lhs.keys)
+            vals = [lvals]
+            rvals = np.asarray(rhs.values)
+            extra_rows, extra_keys = [], []
+            for i, k in enumerate(rhs.keys):
+                mk = self._match_key(k)
+                mask = lhs_present.get(mk)
+                row = rvals[i]
+                if mask is not None:
+                    row = np.where(mask, np.nan, row)
+                extra_rows.append(row)
+                extra_keys.append(k)
+            if extra_rows:
+                keys = keys + extra_keys
+                vals.append(np.stack(extra_rows))
+            return ResultBlock(keys, lhs.wends, np.concatenate(vals))
+        if op == "unless":
+            if lhs is None:
+                return None
+            if rhs is None:
+                return lhs
+            rk_rows = self._presence_by_key(rhs)
+            lvals = np.asarray(lhs.values)
+            outs = []
+            for i, k in enumerate(lhs.keys):
+                mk = self._match_key(k)
+                mask = rk_rows.get(mk)
+                outs.append(np.where(mask, np.nan, lvals[i])
+                            if mask is not None else lvals[i])
+            return remove_nan_series(
+                ResultBlock(list(lhs.keys), lhs.wends, np.stack(outs)))
+        raise ValueError(op)
+
+
+class SubqueryExec(NonLeafExecPlan):
+    """Evaluate an outer range function over an inner periodic series
+    (foo[5m:1m] with rate/max_over_time/... outside).  The inner child's
+    step-grid samples are treated as raw samples for the outer window kernel
+    (ref: exec/... subquery handling via PeriodicSamplesMapper on inner)."""
+
+    def __init__(self, ctx, children, start_ms, step_ms, end_ms, function,
+                 function_args, subquery_window_ms, subquery_step_ms,
+                 offset_ms=0):
+        super().__init__(ctx, children)
+        self.start_ms, self.step_ms, self.end_ms = start_ms, step_ms, end_ms
+        self.function = function
+        self.function_args = tuple(function_args)
+        self.subquery_window_ms = subquery_window_ms
+        self.subquery_step_ms = subquery_step_ms
+        self.offset_ms = offset_ms
+
+    def args_str(self):
+        return (f"function={self.function}, window={self.subquery_window_ms}, "
+                f"step={self.subquery_step_ms}")
+
+    def compose(self, results, stats):
+        block = concat_blocks([r for r in results if isinstance(r, ResultBlock)])
+        wends = make_window_ends(self.start_ms, self.end_ms, self.step_ms)
+        if block is None:
+            return _block_empty(wends)
+        inner_ts = np.asarray(block.wends)
+        base = int(inner_ts[0]) if len(inner_ts) else 0
+        vals = np.asarray(block.values)
+        S = vals.shape[0]
+        ts_off = np.broadcast_to((inner_ts - base).astype(np.int32),
+                                 (S, len(inner_ts))).copy()
+        # NaN steps are absent samples; offsets stay valid (kernel masks NaN)
+        eval_wends = (wends - self.offset_ms - base).astype(np.int32)
+        out = np.asarray(evaluate_range_function(
+            jnp.asarray(ts_off), jnp.asarray(vals), jnp.asarray(eval_wends),
+            self.subquery_window_ms, self.function, self.function_args,
+            base_ms=base, dense=not bool(np.isnan(vals).any())))
+        return ResultBlock(block.keys, wends, out)
+
+
+class StitchRvsExec(NonLeafExecPlan):
+    """Merge same-key series evaluated over adjacent time ranges
+    (ref: exec/StitchRvsExec.scala)."""
+
+    def compose(self, results, stats):
+        blocks = [r for r in results if isinstance(r, ResultBlock)]
+        if not blocks:
+            return None
+        wends = np.unique(np.concatenate([b.wends for b in blocks]))
+        merged: Dict[RangeVectorKey, np.ndarray] = {}
+        for b in blocks:
+            pos = np.searchsorted(wends, b.wends)
+            vals = np.asarray(b.values)
+            for i, k in enumerate(b.keys):
+                row = merged.get(k)
+                if row is None:
+                    row = np.full(len(wends), np.nan)
+                    merged[k] = row
+                fill = vals[i]
+                take = ~np.isnan(fill)
+                row[pos[take]] = fill[take]
+        keys = list(merged)
+        return ResultBlock(keys, wends, np.stack([merged[k] for k in keys]))
+
