@@ -120,8 +120,11 @@ class InMemoryColumnStore(ColumnStore):
     def write_chunks(self, dataset, shard, part_key, chunksets, schema_name) -> None:
         key = (dataset, shard, part_key.to_bytes())
         with self._lock:
-            self._chunks.setdefault(key, []).extend(
-                (schema_name, cs) for cs in chunksets)
+            bucket = self._chunks.setdefault(key, [])
+            seen = {c.info.chunk_id for _, c in bucket}
+            # idempotent by chunk id (retried network writes, see netstore)
+            bucket.extend((schema_name, cs) for cs in chunksets
+                          if cs.info.chunk_id not in seen)
 
     def write_part_keys(self, dataset, shard, records) -> None:
         with self._lock:

@@ -36,17 +36,14 @@ from typing import Callable, Dict, List, Optional, Tuple
 from filodb_tpu.parallel.shardmanager import (DatasetResourceSpec,
                                               ShardEvent, ShardManager)
 from filodb_tpu.parallel.shardmapper import ShardMapper, ShardStatus
-from filodb_tpu.parallel.transport import _recv_frame, _send_frame
+from filodb_tpu.parallel.transport import recv_json_frame, send_json_frame, _recv_frame, _send_frame
 
 _log = logging.getLogger("filodb.cluster")
 
 
-def _send_json(sock, obj) -> None:
-    _send_frame(sock, json.dumps(obj).encode("utf-8"))
-
-
-def _recv_json(sock):
-    return json.loads(_recv_frame(sock).decode("utf-8"))
+# shared frame codec (one copy next to the framing it wraps)
+_send_json = send_json_frame
+_recv_json = recv_json_frame
 
 
 def _rpc(addr: Tuple[str, int], obj, timeout_s: float = 10.0):

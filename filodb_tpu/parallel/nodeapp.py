@@ -28,6 +28,10 @@ def main(argv=None) -> None:
     ap.add_argument("--name", required=True)
     ap.add_argument("--coordinator", required=True, help="host:port")
     ap.add_argument("--data-dir", required=True)
+    ap.add_argument("--store-url", default="",
+                    help="host:port of a chunk service (persist/netstore) — "
+                         "the node then needs NO shared filesystem; default "
+                         "is the local-disk store in --data-dir")
     ap.add_argument("--platform", default="",
                     help="pin jax platform (e.g. cpu) BEFORE package import")
     ap.add_argument("--heartbeat-interval", type=float, default=0.5)
@@ -49,8 +53,17 @@ def main(argv=None) -> None:
 
     host, port = args.coordinator.rsplit(":", 1)
     coord_addr = (host, int(port))
-    column_store = LocalDiskColumnStore(args.data_dir)
-    meta_store = LocalDiskMetaStore(args.data_dir)
+    if args.store_url:
+        # shared NETWORK store (ref: CassandraColumnStore — a remote
+        # service every node reads through; failover recovery included)
+        from filodb_tpu.persist.netstore import (RemoteColumnStore,
+                                                 RemoteMetaStore)
+        s_host, s_port = args.store_url.rsplit(":", 1)
+        column_store = RemoteColumnStore(s_host, int(s_port))
+        meta_store = RemoteMetaStore(s_host, int(s_port))
+    else:
+        column_store = LocalDiskColumnStore(args.data_dir)
+        meta_store = LocalDiskMetaStore(args.data_dir)
     memstore = TimeSeriesMemStore(column_store=column_store,
                                   meta_store=meta_store)
     qsrv = NodeQueryServer(memstore).start()

@@ -11,7 +11,9 @@ single-process path.
 """
 from __future__ import annotations
 
+import json
 import socket
+import json
 import socketserver
 import struct
 import threading
@@ -45,6 +47,16 @@ def _recv_frame(sock: socket.socket) -> bytes:
         raise ConnectionError(f"bad frame magic {hdr[:4]!r}")
     (ln,) = struct.unpack("<Q", hdr[4:])
     return _recv_exact(sock, ln)
+
+
+def send_json_frame(sock: socket.socket, obj) -> None:
+    """One JSON message as one frame — the shared control-plane encoding
+    (cluster coordination, node control sockets, the chunk service)."""
+    _send_frame(sock, json.dumps(obj).encode("utf-8"))
+
+
+def recv_json_frame(sock: socket.socket):
+    return json.loads(_recv_frame(sock).decode("utf-8"))
 
 
 class NodeQueryServer:

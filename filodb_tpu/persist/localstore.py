@@ -130,17 +130,18 @@ def _encode_pk_frame(r: PartKeyRecord) -> bytes:
             + struct.pack("<qq", r.start_time_ms, r.end_time_ms))
 
 
-def _peek_chunk_meta(data: bytes) -> Tuple[bytes, str, int, int, int, int]:
+def _peek_chunk_meta(data: bytes) -> Tuple[bytes, str, int, int, int, int,
+                                           int]:
     """Parse only the frame header: (pk_bytes, schema_name, start_ms, end_ms,
-    ingestion_ms, num_rows) — no column payload decode."""
+    ingestion_ms, num_rows, chunk_id) — no column payload decode."""
     off = 0
     (pk_len,) = struct.unpack_from("<H", data, off); off += 2
     pk_bytes = data[off: off + pk_len]; off += pk_len
     (sn_len,) = struct.unpack_from("<H", data, off); off += 2
     schema_name = data[off: off + sn_len].decode(); off += sn_len
-    _, ing_ms, num_rows, start_ms, end_ms, _ = struct.unpack_from(
+    chunk_id, ing_ms, num_rows, start_ms, end_ms, _ = struct.unpack_from(
         "<qqiqqH", data, off)
-    return pk_bytes, schema_name, start_ms, end_ms, ing_ms, num_rows
+    return pk_bytes, schema_name, start_ms, end_ms, ing_ms, num_rows, chunk_id
 
 
 def _read_frame_at(path: str, offset: int, magic: int) -> Optional[bytes]:
@@ -173,18 +174,21 @@ def _decode_pk_frame(data: bytes) -> PartKeyRecord:
 
 class _FrameRef:
     """Index entry: where a chunk frame lives + the metadata needed to filter
-    reads without decoding (start/end/ingestion time)."""
+    reads without decoding (start/end/ingestion time).  chunk_id makes
+    writes idempotent: a network client may retry a write whose reply was
+    lost after the append landed (persist/netstore)."""
     __slots__ = ("offset", "start_ms", "end_ms", "ingestion_ms", "schema_name",
-                 "num_rows")
+                 "num_rows", "chunk_id")
 
     def __init__(self, offset, start_ms, end_ms, ingestion_ms, schema_name,
-                 num_rows):
+                 num_rows, chunk_id=0):
         self.offset = offset
         self.start_ms = start_ms
         self.end_ms = end_ms
         self.ingestion_ms = ingestion_ms
         self.schema_name = schema_name
         self.num_rows = num_rows
+        self.chunk_id = chunk_id
 
 
 class LocalDiskColumnStore(ColumnStore):
@@ -244,9 +248,14 @@ class LocalDiskColumnStore(ColumnStore):
         chunks: Dict[bytes, List[_FrameRef]] = {}
         for offset, payload in _iter_frames(self._chunk_path(dataset, shard),
                                             _MAGIC_CHUNK):
-            pk_bytes, sn, start_ms, end_ms, ing_ms, nrows = _peek_chunk_meta(payload)
-            chunks.setdefault(pk_bytes, []).append(
-                _FrameRef(offset, start_ms, end_ms, ing_ms, sn, nrows))
+            (pk_bytes, sn, start_ms, end_ms, ing_ms, nrows,
+             cid) = _peek_chunk_meta(payload)
+            bucket = chunks.setdefault(pk_bytes, [])
+            # duplicate appends (lost-reply write retries) index once
+            if any(r.chunk_id == cid for r in bucket):
+                continue
+            bucket.append(
+                _FrameRef(offset, start_ms, end_ms, ing_ms, sn, nrows, cid))
         pks: Dict[bytes, PartKeyRecord] = {}
         last_upsert: Dict[bytes, int] = {}
         for off, payload in _iter_frames(self._pk_path(dataset, shard),
@@ -283,14 +292,21 @@ class LocalDiskColumnStore(ColumnStore):
             path = self._chunk_path(dataset, shard)
             pk_bytes = part_key.to_bytes()
             bucket = self._chunk_idx[(dataset, shard)].setdefault(pk_bytes, [])
+            seen = {r.chunk_id for r in bucket}
             for cs in chunksets:
+                # idempotent by chunk id: a retried write whose first
+                # attempt landed (lost reply) must not double the chunk
+                if cs.info.chunk_id in seen:
+                    continue
+                seen.add(cs.info.chunk_id)
                 offset = self._append(
                     path, _MAGIC_CHUNK,
                     _encode_chunkset_frame(part_key, schema_name, cs))
                 bucket.append(_FrameRef(offset, cs.info.start_time_ms,
                                         cs.info.end_time_ms,
                                         cs.info.ingestion_time_ms,
-                                        schema_name, cs.info.num_rows))
+                                        schema_name, cs.info.num_rows,
+                                        cs.info.chunk_id))
 
     def write_part_keys(self, dataset, shard, records) -> None:
         with self._lock:

@@ -44,17 +44,20 @@ def _mk_lines(num_series=24, num_samples=90):
     return lines
 
 
-def _spawn(name, coord_port, data_dir):
+def _spawn(name, coord_port, data_dir, store_url=""):
     # stderr to a file, never a PIPE: an undrained pipe filling up would
     # block the node's writes and stall heartbeats mid-test
+    os.makedirs(str(data_dir), exist_ok=True)
     errpath = os.path.join(str(data_dir), f"{name}.stderr")
+    cmd = [sys.executable, "-m", "filodb_tpu.parallel.nodeapp",
+           "--name", name, "--coordinator", f"127.0.0.1:{coord_port}",
+           "--data-dir", str(data_dir), "--platform", "cpu",
+           "--heartbeat-interval", "0.3"]
+    if store_url:
+        cmd += ["--store-url", store_url]
     with open(errpath, "w") as errf:
         proc = subprocess.Popen(
-            [sys.executable, "-m", "filodb_tpu.parallel.nodeapp",
-             "--name", name, "--coordinator", f"127.0.0.1:{coord_port}",
-             "--data-dir", str(data_dir), "--platform", "cpu",
-             "--heartbeat-interval", "0.3"],
-            stdout=subprocess.PIPE, stderr=errf, text=True,
+            cmd, stdout=subprocess.PIPE, stderr=errf, text=True,
             cwd=REPO, env={**os.environ, "JAX_PLATFORMS": "cpu"})
         # the child holds its own duplicated fd; the parent's closes now
     box = {}
@@ -104,18 +107,36 @@ def _query(cli, q):
     return {str(k): np.asarray(v) for k, _, v in res.series()}
 
 
-def test_cluster_ingest_query_failover(tmp_path):
+@pytest.mark.parametrize("backend", ["shared_dir", "netstore"])
+def test_cluster_ingest_query_failover(tmp_path, backend):
+    # netstore: nodes get PRIVATE data dirs and reach one central chunk
+    # service over TCP — failover recovery with NO shared filesystem,
+    # the reference's Cassandra topology (CassandraColumnStore.scala:53-80)
+    svc = None
+    store_url = ""
+    if backend == "netstore":
+        from filodb_tpu.persist.localstore import (LocalDiskColumnStore,
+                                                   LocalDiskMetaStore)
+        from filodb_tpu.persist.netstore import ChunkServiceServer
+        root = str(tmp_path / "central_store")
+        svc = ChunkServiceServer(LocalDiskColumnStore(root),
+                                 LocalDiskMetaStore(root)).start()
+        store_url = f"127.0.0.1:{svc.address[1]}"
+
+    def node_dir(name):
+        return tmp_path if backend == "shared_dir" else tmp_path / name
+
     sm = ShardManager(reassignment_min_interval_s=0)
     coord = ClusterCoordinator(sm, liveness_timeout_s=2.5,
                                check_interval_s=0.3).start()
     coord.setup_dataset("prometheus", NUM_SHARDS, min_num_nodes=2)
     procs = []
     try:
-        pa, ia = _spawn("A", coord.address[1], tmp_path)
+        pa, ia = _spawn("A", coord.address[1], node_dir("A"), store_url)
         procs.append(pa)
-        pb, ib = _spawn("B", coord.address[1], tmp_path)
+        pb, ib = _spawn("B", coord.address[1], node_dir("B"), store_url)
         procs.append(pb)
-        pc, ic = _spawn("C", coord.address[1], tmp_path)   # standby
+        pc, ic = _spawn("C", coord.address[1], node_dir("C"), store_url)
         procs.append(pc)
         cli = ClusterClient(coord.address)
 
@@ -190,3 +211,5 @@ def test_cluster_ingest_query_failover(tmp_path):
             if p.poll() is None:
                 p.kill()
         coord.stop()
+        if svc is not None:
+            svc.stop()
