@@ -125,9 +125,15 @@ class TimeSeriesShard:
         # (ref: TimeSeriesShard cardTracker, ratelimit/CardinalityTracker)
         self.cardinality_tracker = None
         # trace-filter logging of individual series: partitions whose labels
-        # match ALL filters get lifecycle log lines (ref: tracedPartFilters,
-        # README:871-875)
+        # match ALL filters of any filter group get lifecycle log lines at
+        # creation, ingest and query lookup (ref: tracedPartFilters,
+        # README:871-875; TimeSeriesShard.scala:265).  Set via
+        # set_traced_filters (list of label maps) or POST
+        # /admin/tracedfilters; traced pids are tracked as a set so the
+        # ingest/query hot paths pay one membership test.
         self.traced_part_filters: List[Tuple[str, str]] = []
+        self._traced_groups: List[Dict[str, str]] = []
+        self._traced_pids: set = set()
         # Writer mutex: ingest / flush / ODP page-in / eviction serialize
         # here (the reference serializes these on the shard's ingestion
         # dispatcher, ref: TimeSeriesShard.scala ingestSched + EvictionLock).
@@ -222,12 +228,58 @@ class TimeSeriesShard:
         self.index.add_partition(pid, part_key, start_time_ms)
         self._dirty_part_keys.add(pid)
         self.stats.partitions_created += 1
-        if self.traced_part_filters:
-            labels = {**part_key.tags_dict, "_metric_": part_key.metric}
-            if all(labels.get(k) == v for k, v in self.traced_part_filters):
+        if self.traced_part_filters or self._traced_groups:
+            if self._trace_match(part_key):
+                self._traced_pids.add(pid)
                 _log.info("TRACED series created: shard=%d partId=%d %s",
                           self.shard_num, pid, part_key)
         return info
+
+    # ------------------------------------------- per-series debug follow
+
+    def _trace_match(self, part_key: PartKey) -> bool:
+        labels = {**part_key.tags_dict, "_metric_": part_key.metric}
+        if self.traced_part_filters and \
+                all(labels.get(k) == v
+                    for k, v in self.traced_part_filters):
+            return True
+        return any(all(labels.get(k) == v for k, v in grp.items())
+                   for grp in self._traced_groups)
+
+    def set_traced_filters(self, groups) -> int:
+        """groups: list of {label: value} maps; a series matching ALL
+        labels of ANY map is debug-followed through creation, ingest and
+        query lookup (ref: README.md:871-875 tracedPartFilters).  []
+        clears.  Returns the number of currently-matching partitions.
+        Takes the write lock: the scan must not race partition creation
+        (a series created mid-scan would be dropped by the overwrite)."""
+        with self._write_locked("traced_filters"):
+            self._traced_groups = [dict(g) for g in groups]
+            pids = set()
+            if self._traced_groups:
+                for info in self.partitions:
+                    if info is not None and self._trace_match(info.part_key):
+                        pids.add(info.part_id)
+                        _log.info("TRACED series matched filter: shard=%d "
+                                  "partId=%d %s", self.shard_num,
+                                  info.part_id, info.part_key)
+            self._traced_pids = pids
+            return len(pids)
+
+    def _trace_touch(self, what: str, pids, extra: str = "") -> None:
+        if not self._traced_pids:
+            return
+        hit = self._traced_pids.intersection(
+            pids if isinstance(pids, (list, set))
+            else np.asarray(pids).tolist())
+        for pid in sorted(hit):
+            info = self.partitions[pid]
+            _log.info("TRACED series %s: shard=%d partId=%d %s%s",
+                      what, self.shard_num, pid,
+                      info.part_key if info is not None else "?", extra)
+            metrics_registry.counter(
+                "traced_series_events", dataset=self.dataset,
+                event=what).increment()
 
     def ingest(self, batch: RecordBatch, offset: int = -1) -> int:
         """Ingest one record batch (ref: TimeSeriesShard.ingest:570).
@@ -248,6 +300,7 @@ class TimeSeriesShard:
         # per ingest record, never per container key table entry)
         rows_for_key = np.full(len(batch.part_keys), -1, dtype=np.int64)
         uniq, first = np.unique(batch.part_idx, return_index=True)
+        traced_touched = []
         for k, ts0 in zip(uniq.tolist(), batch.timestamps[first].tolist()):
             try:
                 info = self.get_or_create_partition(
@@ -258,6 +311,11 @@ class TimeSeriesShard:
                 self.stats.quota_dropped += 1
                 continue
             rows_for_key[k] = info.row
+            if self._traced_pids and info.part_id in self._traced_pids:
+                traced_touched.append(info.part_id)
+        if traced_touched:
+            self._trace_touch("ingest", traced_touched,
+                              extra=f" offset={offset}")
         rows = rows_for_key[batch.part_idx]
         keep = rows >= 0
         if not keep.all():
@@ -417,6 +475,8 @@ class TimeSeriesShard:
             for c in np.unique(codes):
                 name = self._schema_names[int(c)]
                 by_schema[name] = ids[codes == c]
+        if self._traced_pids and ids.size:
+            self._trace_touch("query_lookup", ids)
         return PartLookupResult(self.shard_num, ids, by_schema, first, self)
 
     def rows_for(self, pids: np.ndarray) -> np.ndarray:

@@ -65,9 +65,13 @@ class PromHttpApi:
                 return self._loglevel(parts[2], body.decode().strip())
             if parts[:2] == ["admin", "profiler"] and len(parts) == 3:
                 return self._profiler(parts[2], params, method)
+            if parts[:2] == ["admin", "traces"] and len(parts) in (2, 3):
+                return self._traces(parts[2] if len(parts) == 3 else None)
+            if parts[:2] == ["admin", "tracedfilters"] and method == "POST":
+                return self._traced_filters(body)
             if parts[:1] == ["influx"] and len(parts) == 2 \
                     and parts[1] == "write" and method == "POST":
-                return self._influx_write(params, body)
+                return self._influx_write_traced(params, body)
             return 404, _err(f"no route for {method} {path}")
         except _BadRequest as e:
             return 400, _err(str(e))
@@ -96,6 +100,8 @@ class PromHttpApi:
                 return self._explain(eng, q, start, step, end)
             res = eng.query_range(q, start, step, end, planner_params)
             payload = QueryEngine.to_prom_matrix(res)
+            if res.trace_id:
+                payload["traceID"] = res.trace_id
             return (200 if payload["status"] == "success" else 400), payload
         if rest == ["query"]:
             q = params.get("query", "")
@@ -104,6 +110,8 @@ class PromHttpApi:
                 return self._explain(eng, q, t, 1, t)
             res = eng.query_instant(q, t, planner_params)
             payload = QueryEngine.to_prom_vector(res)
+            if res.trace_id:
+                payload["traceID"] = res.trace_id
             return (200 if payload["status"] == "success" else 400), payload
         if rest == ["labels"]:
             return self._metadata(eng, "labels", params, multi)
@@ -303,6 +311,45 @@ class PromHttpApi:
                     shard.stats.quota_dropped)
         return 200, registry.expose_prometheus()
 
+    def _traces(self, trace_id) -> Tuple[int, object]:
+        """Stitched cross-node span tree for one query (the Zipkin-query
+        analogue; spans from remote nodes arrive via the dispatch reply and
+        carry their node name).  GET /admin/traces lists known ids;
+        /admin/traces/<id> returns the events sorted by end time."""
+        from filodb_tpu.utils.metrics import collector
+        if trace_id is None:
+            return 200, {"status": "success",
+                         "data": collector.trace_ids()[-50:]}
+        evs = sorted(collector.trace(trace_id),
+                     key=lambda e: e.get("end_unix_s", 0))
+        if not evs:
+            return 404, _err(f"no trace {trace_id!r}")
+        return 200, {"status": "success",
+                     "data": {"traceID": trace_id, "spans": evs}}
+
+    def _traced_filters(self, body: bytes) -> Tuple[int, object]:
+        """Set per-series debug-follow filters on every local shard (ref:
+        README.md:871-875 tracedPartFilters; TimeSeriesShard.scala:265) —
+        POST a JSON list of label->value maps; [] clears."""
+        import json as _json
+        try:
+            filters = _json.loads(body.decode() or "[]")
+            if not isinstance(filters, list) or any(
+                    not isinstance(g, dict) for g in filters):
+                raise ValueError("expected a list of label maps")
+        except (ValueError, UnicodeDecodeError) as e:
+            raise _BadRequest(f"bad traced-filter body: {e}")
+        n = 0
+        for name, eng in self.engines.items():
+            source = getattr(eng, "source", None)
+            if source is None or not hasattr(source, "shards_for"):
+                continue
+            for shard in source.shards_for(name):
+                shard.set_traced_filters(filters)
+                n += 1
+        return 200, {"status": "success",
+                     "data": {"shards": n, "filters": filters}}
+
     def _loglevel(self, logger_name: str, level: str) -> Tuple[int, object]:
         """Dynamic per-logger level (ref: doc/http_api.md:38-46)."""
         lvl = getattr(logging, level.upper(), None)
@@ -341,6 +388,21 @@ class PromHttpApi:
         return 200, profiler.report(_num_param(params, "top", "30"))
 
     # -------------------------------------------------------------- influx
+
+    def _influx_write_traced(self, params, body):
+        """Gateway-side trace context: the write path's spans collect
+        under one trace id, returned in the X-Trace-Id response header
+        (Influx writes answer 204 with no body; ref: the ingest half of
+        the Kamon span pipeline, KamonLogger.scala:16-40)."""
+        import uuid as _uuid
+
+        from filodb_tpu.utils.metrics import span, trace_context
+        tid = _uuid.uuid4().hex[:16]
+        with trace_context(tid), span("influx_write"):
+            status, payload = self._influx_write(params, body)
+        if isinstance(payload, dict):
+            payload.setdefault("_headers", {})["X-Trace-Id"] = tid
+        return status, payload
 
     def _influx_write(self, params: Dict[str, str],
                       body: bytes) -> Tuple[int, object]:

@@ -52,6 +52,8 @@ class FiloHttpServer:
                     blob = payload.encode()
                     ctype = "text/plain; version=0.0.4"
                 else:
+                    if isinstance(payload, dict) and "_headers" in payload:
+                        extra_headers.update(payload.pop("_headers"))
                     blob = b"" if status == 204 else json.dumps(payload).encode()
                     ctype = "application/json"
                 self.send_response(status)

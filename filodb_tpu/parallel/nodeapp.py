@@ -41,6 +41,8 @@ def main(argv=None) -> None:
         import jax
         jax.config.update("jax_platforms", args.platform)
 
+    from filodb_tpu.utils import metrics as _metrics
+    _metrics.NODE_NAME = args.name       # stamp this node on trace spans
     from filodb_tpu.core.memstore import TimeSeriesMemStore
     from filodb_tpu.gateway.influx import influx_lines_to_batches
     from filodb_tpu.gateway.router import split_batch_by_shard

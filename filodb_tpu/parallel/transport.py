@@ -73,10 +73,22 @@ class NodeQueryServer:
                     while True:
                         payload = _recv_frame(self.request)
                         try:
+                            from filodb_tpu.utils.metrics import (
+                                collector, span, trace_context)
                             plan = serialize.loads(payload)
-                            data, stats = plan.execute_internal(outer.source)
+                            tid = getattr(plan.ctx, "query_id", "")
+                            # execute under the CALLER's trace id so this
+                            # node's spans stitch into the same trace; ship
+                            # them back with the reply (the Kamon-context-
+                            # over-Akka analogue, ref: ExecPlan.scala:102)
+                            with trace_context(tid),                                     span("remote_exec",
+                                         plan=type(plan).__name__):
+                                data, stats = plan.execute_internal(
+                                    outer.source)
                             reply = serialize.dumps(
-                                {"ok": True, "data": data, "stats": stats})
+                                {"ok": True, "data": data, "stats": stats,
+                                 "spans": (collector.take(tid)
+                                           if tid else [])})
                         except Exception as e:  # noqa: BLE001 — errors ride the wire
                             reply = serialize.dumps(
                                 {"ok": False,
@@ -160,5 +172,14 @@ class RemoteNodeDispatcher(PlanDispatcher):
         if not reply["ok"]:
             raise RuntimeError(f"remote node {self.host}:{self.port} "
                                f"failed: {reply['error']}")
+        # stitch the remote node's spans into the caller's trace (they
+        # arrive stamped with the remote NODE_NAME)
+        spans = reply.get("spans")
+        if spans:
+            from filodb_tpu.utils.metrics import collector
+            tid = getattr(plan.ctx, "query_id", "")
+            for ev in spans:
+                if isinstance(ev, dict):
+                    collector.record(tid, ev)
         stats = reply["stats"] or QueryStats()
         return reply["data"], stats

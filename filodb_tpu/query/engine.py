@@ -67,7 +67,9 @@ class QueryEngine:
             if isinstance(data, QueryResult):
                 return data
             return QueryResult([], stats)
-        return ep.execute(self.source)
+        res = ep.execute(self.source)
+        res.trace_id = ctx.query_id
+        return res
 
     # ------------------------------------------------- Prometheus JSON model
 

@@ -424,9 +424,12 @@ class ExecPlan:
     def execute(self, source) -> QueryResult:
         # span + error counters per plan type (ref: ExecPlan.scala:102-131
         # Kamon span around doExecute; query-error counters QueryActor:80-96)
-        from filodb_tpu.utils.metrics import registry, span
+        # bound to the query's trace id, so every span lands in ONE
+        # cross-node trace (remote subtrees ship theirs back on the wire)
+        from filodb_tpu.utils.metrics import registry, span, trace_context
         try:
-            with span("execplan", plan=type(self).__name__):
+            with trace_context(self.ctx.query_id), \
+                    span("execplan", plan=type(self).__name__):
                 data, stats = self.execute_internal(source)
         except Exception as e:  # noqa: BLE001 — query errors surface in result
             registry.counter("query_errors",

@@ -106,6 +106,9 @@ class QueryResult:
     error: Optional[str] = None
     # metadata-query payloads (label values etc.) ride in `data`
     data: Optional[object] = None
+    # the query's trace id (= ctx.query_id): fetch the stitched cross-node
+    # span tree from utils.metrics.collector / GET /admin/traces/<id>
+    trace_id: str = ""
 
     @property
     def num_series(self) -> int:

@@ -172,6 +172,81 @@ SpanReporter = Callable[[str, float, Dict[str, str]], None]
 _reporters: List[SpanReporter] = []
 _active = threading.local()
 
+# node identity stamped on every collected span event (set by nodeapp /
+# standalone at startup) so a stitched cross-node trace shows placement
+NODE_NAME = ""
+
+
+class TraceCollector:
+    """Bounded per-trace span-event buffer — the Zipkin-reporter analogue
+    of the reference's Kamon span pipeline (ref: ExecPlan.scala:102-131
+    Kamon spans around doExecute; KamonLogger.scala:16-40).  Remote nodes
+    ship their events back with the query reply (parallel/transport), so
+    `trace(tid)` returns ONE stitched cross-node trace."""
+
+    def __init__(self, max_traces: int = 256, max_events: int = 512):
+        self.max_traces = max_traces
+        self.max_events = max_events
+        self._traces: Dict[str, List[dict]] = {}
+        self._order: List[str] = []
+        self._lock = threading.Lock()
+
+    def record(self, trace_id: str, event: dict) -> None:
+        with self._lock:
+            evs = self._traces.get(trace_id)
+            if evs is None:
+                evs = self._traces[trace_id] = []
+                self._order.append(trace_id)
+                while len(self._order) > self.max_traces:
+                    self._traces.pop(self._order.pop(0), None)
+            if len(evs) < self.max_events:
+                evs.append(event)
+
+    def trace(self, trace_id: str) -> List[dict]:
+        with self._lock:
+            return list(self._traces.get(trace_id, ()))
+
+    def take(self, trace_id: str) -> List[dict]:
+        """Drain the trace's events (used by the node query server: each
+        dispatch reply carries exactly the events recorded since the last
+        one, so the coordinator's merge never duplicates)."""
+        with self._lock:
+            evs = self._traces.get(trace_id)
+            if not evs:
+                return []
+            out = list(evs)
+            evs.clear()
+            return out
+
+    def trace_ids(self) -> List[str]:
+        with self._lock:
+            return list(self._order)
+
+
+collector = TraceCollector()
+
+
+class trace_context:
+    """Bind a trace id to this thread for the duration; spans entered
+    inside feed TraceCollector under it.  Re-entrant (restores the outer
+    id), so a node executing a dispatched subtree nests cleanly."""
+
+    def __init__(self, trace_id: str):
+        self.trace_id = trace_id
+
+    def __enter__(self):
+        self._prev = getattr(_active, "trace_id", None)
+        _active.trace_id = self.trace_id
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        _active.trace_id = self._prev
+        return False
+
+
+def current_trace_id():
+    return getattr(_active, "trace_id", None)
+
 
 def add_span_reporter(rep: SpanReporter) -> None:
     """ref: KamonSpanLogReporter (KamonLogger.scala:16-40)."""
@@ -207,6 +282,12 @@ class span:
         stack.pop()
         registry.histogram(f"span_{self.name}_seconds",
                            **self.tags).record(elapsed)
+        tid = current_trace_id()
+        if tid:
+            collector.record(tid, {
+                "span": full, "dur_s": round(elapsed, 6),
+                "end_unix_s": round(time.time(), 3),
+                "node": NODE_NAME, **self.tags})
         for rep in _reporters:
             rep(full, elapsed, self.tags)
         return False

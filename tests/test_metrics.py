@@ -102,9 +102,12 @@ def test_traced_part_filters_log(caplog):
     sh.traced_part_filters = [("_ns_", "App-1")]
     with caplog.at_level(logging.INFO, logger="filodb.shard"):
         sh.ingest(gauge_batch(10, 5, start_ms=START))
-    traced = [r for r in caplog.records if "TRACED" in r.message]
-    assert len(traced) == 1
-    assert "App-1" in traced[0].getMessage()
+    traced = [r.getMessage() for r in caplog.records
+              if "TRACED" in r.message]
+    # r4: matched series are followed through creation AND ingest
+    assert len([m for m in traced if "created" in m]) == 1
+    assert len([m for m in traced if "ingest" in m]) == 1
+    assert all("App-1" in m for m in traced)
 
 
 def test_scheduler_assertions_gated():

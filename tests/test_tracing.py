@@ -1,0 +1,148 @@
+"""Cross-node trace propagation + per-series debug follow (ref:
+query/.../exec/ExecPlan.scala:102-131 Kamon spans through distributed
+exec; KamonLogger.scala:16-40; README.md:871-875 tracedPartFilters)."""
+import json
+import logging
+import urllib.request
+
+import numpy as np
+import pytest
+
+from filodb_tpu.core.memstore import TimeSeriesMemStore
+from filodb_tpu.ingest.generator import counter_batch, gauge_batch
+from filodb_tpu.parallel.shardmapper import ShardEvent, ShardMapper
+from filodb_tpu.parallel.testcluster import make_two_node_cluster
+from filodb_tpu.query.engine import QueryEngine
+from filodb_tpu.utils.metrics import collector, registry
+
+START = 1_600_000_000_000
+START_S = START // 1000
+
+
+def test_cross_node_query_stitches_one_trace():
+    """A scatter-gather query across two node servers produces ONE trace:
+    the coordinator's spans plus each remote node's spans (shipped back in
+    the dispatch reply), under the query's trace id."""
+    cluster = make_two_node_cluster(
+        [counter_batch(24, 120, start_ms=START)])
+    try:
+        res = cluster.engine.query_range(
+            'sum by (_ns_)(rate(request_total[5m]))',
+            START_S + 600, 60, START_S + 1200)
+        assert res.error is None, res.error
+        assert res.trace_id, "query result must carry its trace id"
+        evs = collector.trace(res.trace_id)
+        names = [e["span"] for e in evs]
+        # remote subtree spans crossed the wire, tagged with their plan...
+        remotes = [e for e in evs if e["span"].startswith("remote_exec")]
+        assert remotes and all(
+            r.get("plan") == "MultiSchemaPartitionsExec" for r in remotes)
+        # one per dispatched leaf (4 shards), no duplication from the
+        # drain-per-reply protocol
+        assert len(remotes) == 4, names
+        # and the coordinator's root plan span is present
+        assert any(n == "execplan" or n.startswith("execplan")
+                   for n in names), names
+    finally:
+        cluster.stop()
+
+
+def test_trace_ids_isolate_queries():
+    cluster = make_two_node_cluster(
+        [gauge_batch(8, 60, start_ms=START)])
+    try:
+        r1 = cluster.engine.query_range('sum(heap_usage)', START_S + 120,
+                                        60, START_S + 500)
+        r2 = cluster.engine.query_range('sum(heap_usage)', START_S + 120,
+                                        60, START_S + 500)
+        assert r1.trace_id and r2.trace_id and r1.trace_id != r2.trace_id
+        assert collector.trace(r1.trace_id)
+        assert collector.trace(r2.trace_id)
+    finally:
+        cluster.stop()
+
+
+def test_traces_and_traceid_over_http():
+    """traceID rides the Prometheus JSON response; /admin/traces/<id>
+    returns the stitched span tree."""
+    from filodb_tpu.http.routes import PromHttpApi
+    from filodb_tpu.http.server import FiloHttpServer
+    ms = TimeSeriesMemStore()
+    sh = ms.setup("prometheus", 0)
+    sh.ingest(gauge_batch(8, 60, start_ms=START))
+    mapper = ShardMapper(1)
+    mapper.update_from_event(
+        ShardEvent("IngestionStarted", "prometheus", 0, "b"))
+    eng = QueryEngine("prometheus", ms, mapper)
+    srv = FiloHttpServer(PromHttpApi({"prometheus": eng}), port=0)
+    srv.start()
+    try:
+        url = (f"http://127.0.0.1:{srv.port}/promql/prometheus/api/v1/"
+               f"query_range?query=sum(heap_usage)&start={START_S + 120}"
+               f"&end={START_S + 500}&step=60")
+        with urllib.request.urlopen(url, timeout=60) as r:
+            d = json.load(r)
+        assert d["status"] == "success" and d.get("traceID")
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/admin/traces/{d['traceID']}",
+                timeout=60) as r:
+            tr = json.load(r)
+        spans = tr["data"]["spans"]
+        assert spans and all("span" in e and "dur_s" in e for e in spans)
+        assert any(e["span"].startswith("execplan") for e in spans)
+        # trace listing contains the id
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/admin/traces",
+                timeout=60) as r:
+            ids = json.load(r)["data"]
+        assert d["traceID"] in ids
+    finally:
+        srv.stop()
+
+
+# --------------------------------------------- per-series debug follow
+
+def test_traced_filters_follow_ingest_and_query(caplog):
+    ms = TimeSeriesMemStore()
+    sh = ms.setup("prometheus", 0)
+    sh.ingest(gauge_batch(10, 5, start_ms=START))
+    n = sh.set_traced_filters([{"_ns_": "App-1"}])
+    assert n >= 1, "existing matching series should be found"
+    before = registry.counter("traced_series_events", dataset="prometheus",
+                              event="ingest").value
+    with caplog.at_level(logging.INFO, logger="filodb.shard"):
+        sh.ingest(gauge_batch(10, 3, start_ms=START + 60_000))
+        from filodb_tpu.core.index import Equals
+        sh.lookup_partitions([Equals("_ns_", "App-1")], START,
+                             START + 600_000)
+    msgs = [r.getMessage() for r in caplog.records if "TRACED" in r.message]
+    assert any("ingest" in m and "App-1" in m for m in msgs), msgs
+    assert any("query_lookup" in m for m in msgs), msgs
+    after = registry.counter("traced_series_events", dataset="prometheus",
+                             event="ingest").value
+    assert after > before
+    # clearing stops the follow
+    assert sh.set_traced_filters([]) == 0
+    caplog.clear()
+    with caplog.at_level(logging.INFO, logger="filodb.shard"):
+        sh.ingest(gauge_batch(10, 2, start_ms=START + 120_000))
+    assert not [r for r in caplog.records if "TRACED" in r.message]
+
+
+def test_traced_filters_via_http_admin():
+    from filodb_tpu.http.routes import PromHttpApi
+    ms = TimeSeriesMemStore()
+    sh = ms.setup("prometheus", 0)
+    sh.ingest(gauge_batch(6, 5, start_ms=START))
+    mapper = ShardMapper(1)
+    mapper.update_from_event(
+        ShardEvent("IngestionStarted", "prometheus", 0, "b"))
+    eng = QueryEngine("prometheus", ms, mapper)
+    api = PromHttpApi({"prometheus": eng})
+    status, payload = api.handle(
+        "POST", "/admin/tracedfilters", {},
+        json.dumps([{"_ns_": "App-0"}]).encode())
+    assert status == 200 and payload["data"]["shards"] == 1
+    assert sh._traced_pids, "filter should mark matching partitions"
+    status, payload = api.handle("POST", "/admin/tracedfilters", {}, b"[]")
+    assert status == 200 and not sh._traced_pids
