@@ -8,6 +8,7 @@ AggrOverRangeVectors.scala, PeriodicSamplesMapper.scala.
 """
 from __future__ import annotations
 
+import math
 import dataclasses
 import logging
 import os
@@ -161,8 +162,13 @@ class InstantVectorFunctionMapper(RangeVectorTransformer):
             return data
         vals = data.values
         if self.function in ("histogram_quantile", "histogram_max_quantile"):
-            assert data.is_histogram, "histogram_quantile needs histogram data"
             q = float(self._arg_value(self.args[0], source))
+            if not data.is_histogram:
+                # classic Prometheus histograms: `_bucket` series carrying
+                # cumulative counts in `le` labels (upstream
+                # promql/quantile.go bucketQuantile; the reference accepts
+                # both forms, prometheus/.../PrometheusModel.scala)
+                return self._classic_bucket_quantile(q, data)
             out = np.asarray(hist_ops.histogram_quantile(
                 q, jnp.asarray(vals), jnp.asarray(data.bucket_les)))
             return ResultBlock(data.keys, data.wends, out)
@@ -178,6 +184,57 @@ class InstantVectorFunctionMapper(RangeVectorTransformer):
         out = np.asarray(fn(jnp.asarray(vals),
                             *[jnp.asarray(x) for x in extra]))
         return ResultBlock(data.keys, data.wends, out, data.bucket_les)
+
+    @staticmethod
+    def _classic_bucket_quantile(q: float, data: ResultBlock) -> ResultBlock:
+        """histogram_quantile over le-labeled `_bucket` series: group by
+        the labels minus `le`, assemble each group's cumulative-count
+        matrix in ascending le order, and reuse the native quantile
+        kernel (it already applies the ensureMonotonic fixup and the
+        first/+Inf-bucket edge rules).  Groups without a +Inf bucket are
+        dropped, matching upstream.  Groups sharing one le ladder batch
+        into a single [G, W, B] kernel call (the repo's batch-dense rule);
+        an absent bucket sample (scrape gap / later-born bucket series)
+        fills down from the bucket below — it contributes no extra
+        observations instead of poisoning the group's quantile to NaN."""
+        vals = np.asarray(data.values)
+        groups: Dict[tuple, list] = {}
+        for i, k in enumerate(data.keys):
+            le_txt = k.labels_dict.get("le")
+            if le_txt is None:
+                continue
+            try:
+                le = float(le_txt)
+            except ValueError:
+                continue
+            gk = k.without(("le", "_metric_", "__name__")).labels
+            groups.setdefault(gk, []).append((le, i))
+        by_ladder: Dict[tuple, list] = {}
+        for gk, entries in sorted(groups.items()):
+            entries.sort(key=lambda e: e[0])
+            les = tuple(e[0] for e in entries)
+            if len(les) < 2 or not math.isinf(les[-1]):
+                continue                  # upstream requires an +Inf bucket
+            mat = vals[[e[1] for e in entries]]           # [B, W]
+            if np.isnan(mat).any():
+                mat = mat.copy()
+                mat[0] = np.where(np.isnan(mat[0]), 0.0, mat[0])
+                for bi in range(1, mat.shape[0]):
+                    mat[bi] = np.where(np.isnan(mat[bi]), mat[bi - 1],
+                                       mat[bi])
+            by_ladder.setdefault(les, []).append((gk, mat))
+        keys, rows = [], []
+        for les, members in by_ladder.items():
+            stacked = np.stack([m.T for _, m in members])  # [G, W, B]
+            out = np.asarray(hist_ops.histogram_quantile(
+                q, jnp.asarray(stacked), jnp.asarray(np.array(les))))
+            for (gk, _), row in zip(members, out):
+                keys.append(RangeVectorKey(gk))
+                rows.append(row)
+        if not keys:
+            return ResultBlock([], data.wends,
+                               np.zeros((0, len(data.wends))))
+        return ResultBlock(keys, data.wends, np.stack(rows))
 
     @staticmethod
     def _arg_value(a, source, per_step: bool = False):
