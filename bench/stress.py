@@ -205,17 +205,22 @@ def north_star_soak(minutes: float, series: int = 1_048_576,
     ms = TimeSeriesMemStore(column_store=LocalDiskColumnStore(tmp),
                             meta_store=LocalDiskMetaStore(tmp))
     sh = ms.setup("stress", 0)
-    # steady-state budgets sized so every tier cycles within the window;
-    # the device mirror is off — re-mirroring 1M series on every ingest
-    # generation would measure the mirror, not the memstore
-    sh.config.store.shard_mem_size = 6 << 30
+    # budgets sized so every tier CYCLES within the window — the dense
+    # store must overflow into enforcement (seal + evict to the resident
+    # tier/disk) during the soak, not just grow; the device mirror is off
+    # (re-mirroring 1M series per ingest generation would measure the
+    # mirror, not the memstore)
+    sh.config.store.shard_mem_size = 1 << 30
     sh.config.store.device_mirror_enabled = False
-    sh.resident.budget_bytes = 1 << 30
+    sh.resident.budget_bytes = 256 << 20
     t0_build = time.time()
     base = counter_batch(series, 1, start_ms=START)
     build_s = time.time() - t0_build
     eng = QueryEngine("stress", ms)
-    pp = PlannerParams(sample_limit=2_000_000_000)
+    # the north-star query legitimately scans ~60M samples (1M series x a
+    # 10-minute window): lift the default per-query caps for the soak
+    pp = PlannerParams(sample_limit=2_000_000_000,
+                       scan_limit=2_000_000_000)
     sched = FlushScheduler(ms, "stress", interval_s=20.0).start()
 
     deadline = time.time() + minutes * 60

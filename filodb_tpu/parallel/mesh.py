@@ -83,8 +83,10 @@ class PackedShards:
     vbase: Optional[np.ndarray] = None      # [D, S]
     precorrected: bool = False
     # fused-kernel eligibility (ops/pallas_fused.py): when every real row
-    # of every shard shares ONE scrape grid with no NaN holes, the shared
-    # row (int32 [T], PAD_TS tail) — else None.  Computed at pack time.
+    # of every shard shares ONE scrape grid, the shared row (int32 [T],
+    # PAD_TS tail) — else None.  Computed at pack time; `dense` qualifies
+    # whether values are hole-free (dense kernel) or NaN-holed (ragged
+    # kernel variant).
     shared_ts_row: Optional[np.ndarray] = None
     # series per aggregation group over REAL rows (for present-count math)
     gsize: Optional[np.ndarray] = None
@@ -187,7 +189,7 @@ def pack_shards(blocks: Sequence[Tuple],
 
     labels_out = group_labels if group_labels is not None else list(reg.labels)
     num_groups = max(len(labels_out), 1)
-    # fused-kernel eligibility: one shared grid + no NaN in counted cells.
+    # fused-kernel eligibility: one shared grid across every real row.
     # Per-shard views with early exit — no [N, T] fancy-index copies (packs
     # run for every query shape, most of which can't fuse anyway).
     shared_row = None
@@ -202,15 +204,6 @@ def pack_shards(blocks: Sequence[Tuple],
         if not (rows == ref[None, :]).all():
             ref = None
             break
-        # counted region is a prefix (timestamps ascend, PAD_TS tail), so a
-        # basic slice (a view, no copy) covers exactly the selectable cells.
-        # isfinite, not isnan: an inf sample would be clamped finite by the
-        # kernel wrapper's nan_to_num and silently change query results
-        # (the leaf path's col_finite gate uses isfinite for the same reason)
-        n_counted = int((ref < PAD_TS).sum())
-        if not np.isfinite(vals[d, :n, :n_counted]).all():
-            ref = None
-            break
     if ref is not None:
         shared_row = ref.copy()
     gsize = np.zeros(num_groups, dtype=np.int64)
@@ -218,8 +211,12 @@ def pack_shards(blocks: Sequence[Tuple],
         if nser[d]:
             gsize += np.bincount(gids[d, :nser[d]],
                                  minlength=num_groups)[:num_groups]
-    # a surviving shared_row already proved every counted cell finite
-    dense = shared_row is not None or all(
+    # dense = every counted cell finite.  Tracked SEPARATELY from grid
+    # sharing (r4): a uniform-grid pack with NaN holes keeps its
+    # shared_ts_row and runs the RAGGED fused kernel variant.  isfinite,
+    # not isnan: an inf sample would be clamped by the dense kernel
+    # wrapper's nan_to_num and silently change query results.
+    dense = all(
         nser[d] == 0
         or bool((np.isfinite(vals[d, :nser[d]])
                  | (ts[d, :nser[d]] >= PAD_TS)).all())
@@ -251,12 +248,12 @@ def device_put_packed(packed: PackedShards, mesh: Mesh) -> PackedShards:
 
 @functools.partial(jax.jit, static_argnames=(
     "mesh", "G", "S", "T", "Tp", "is_counter", "is_rate", "interpret",
-    "kind"))
+    "kind", "ragged"))
 def _mesh_fused_call(mesh: Mesh, values, group_ids, vbase,
                      o1, o2, l1, l2, t1, t2, n, ws, we, ts, *,
                      G: int, S: int, T: int, Tp: int,
                      is_counter: bool, is_rate: bool, interpret: bool,
-                     kind: str = "rate_family"):
+                     kind: str = "rate_family", ragged: bool = False):
     """Pallas fused sum(rate)-family kernel inside shard_map: values sharded
     over 'shard', per-slice selection matrices over 'time', group sums psum
     over 'shard'.  jit-cached on the static shape/flag tuple so repeat
@@ -267,27 +264,38 @@ def _mesh_fused_call(mesh: Mesh, values, group_ids, vbase,
 
     def step(val_blk, gid_blk, vb_blk, o1b, o2b, l1b, l2b,
              t1b, t2b, nb, wsb, web, tsb):
-        # NaN cells are exactly pad rows / beyond-count columns under the
-        # pack's eligibility gate; zeroed they contribute nothing (pack pad
-        # rows carry gid 0 but add +0 to its sums).  with_drops is always
+        # Dense packs: NaN cells are exactly pad rows / beyond-count
+        # columns, zeroed they contribute nothing (pack pad rows carry
+        # gid 0 but add +0 to its sums).  Ragged packs keep their NaNs —
+        # the kernel's fill scans treat them as absent samples; pad rows
+        # become all-NaN rows whose presence is 0.  with_drops is always
         # False here: counter functions require a precorrected pack.
-        v = jnp.nan_to_num(val_blk[0].astype(jnp.float32))
-        v = jnp.pad(v, ((0, Sp - S), (0, Tp - T)))
+        v = val_blk[0].astype(jnp.float32)
+        if ragged:
+            v = jnp.pad(v, ((0, Sp - S), (0, Tp - T)),
+                        constant_values=np.nan)
+        else:
+            v = jnp.pad(jnp.nan_to_num(v), ((0, Sp - S), (0, Tp - T)))
         vb = jnp.pad(vb_blk[0].astype(jnp.float32), (0, Sp - S))[:, None]
         g = jnp.pad(gid_blk[0].astype(jnp.int32), (0, Sp - S),
                     constant_values=-1)[:, None]
-        out = pf.run_kernel(v, vb, g, o1b[0], o2b[0], l1b[0], l2b[0],
+        res = pf.run_kernel(v, vb, g, o1b[0], o2b[0], l1b[0], l2b[0],
                             t1b[0], t2b[0], nb[0], wsb[0], web[0], tsb[0],
                             num_groups=Gp, is_counter=is_counter,
                             is_rate=is_rate, with_drops=False,
-                            interpret=interpret, kind=kind)
-        return jax.lax.psum(out[:G], "shard")          # [G, Wlp]
+                            interpret=interpret, kind=kind, ragged=ragged)
+        if ragged:
+            sums, cnts = res
+            return (jax.lax.psum(sums[:G], "shard"),
+                    jax.lax.psum(cnts[:G], "shard"))
+        return jax.lax.psum(res[:G], "shard")          # [G, Wlp]
 
     return jax.shard_map(
         step, mesh=mesh,
         in_specs=(P("shard", None, None), P("shard", None),
                   P("shard", None)) + (P("time", None, None),) * 10,
-        out_specs=P(None, "time"),
+        out_specs=((P(None, "time"), P(None, "time")) if ragged
+                   else P(None, "time")),
         # pallas_call's out_shape carries no varying-mesh-axes info, which
         # trips shard_map's vma checker; the psum makes the output
         # replicated over 'shard' by construction
@@ -635,25 +643,33 @@ class MeshExecutor:
                        W: int, range_ms: int, fn_name: Optional[str],
                        agg_op: str = "sum") -> Optional[np.ndarray]:
         """sum/avg/count(rate|increase|delta|*_over_time) over a
-        uniform-grid dense pack via the Pallas MXU kernel
-        (ops/pallas_fused.py) composed inside shard_map: per-time-slice
-        selection-matrix plans shard over the 'time' axis, the kernel runs
-        per shard device, group sums psum over 'shard' — one HBM pass per
-        device instead of the general path's several.  count needs NO
-        device work at all on a dense pack (identical per-window counts);
-        avg divides the kernel's sums by the host counts.
+        uniform-grid pack via the Pallas MXU kernel (ops/pallas_fused.py)
+        composed inside shard_map: per-time-slice selection-matrix plans
+        shard over the 'time' axis, the kernel runs per shard device,
+        group sums psum over 'shard' — one HBM pass per device instead of
+        the general path's several.  NaN-holed (ragged) packs run the
+        kernel's valid-boundary variant with per-cell presence psum'd as
+        a second output (r4).  On a dense pack count needs NO device work
+        (identical per-window counts); avg divides sums by counts.
         Returns the finished [G, W] array, or None when ineligible."""
         import os
 
         from filodb_tpu.ops import pallas_fused as pf
         shared = packed.shared_ts_row is not None and packed.gsize is not None
-        if not pf.can_fuse(fn_name or "", agg_op, shared, shared):
+        dense = packed.dense
+        if not pf.can_fuse(fn_name or "", agg_op, shared, dense):
             return None
         if fn_name in pf.MINMAX_FNS:
             # reduce_window kinds run through the general mesh path (XLA
             # fuses them fine); the matmul kernel has no min/max kind
             return None
-        if agg_op == "count":
+        ragged = not dense
+        if ragged and fn_name in ("last_over_time", "count_over_time"):
+            # slot-semantics kinds: their kernel presence counts grid
+            # SLOTS, and mesh pack padding rows carry gid 0 (unlike the
+            # leaf path's -1) — they would inflate group 0.  General path.
+            return None
+        if agg_op == "count" and dense:
             # dense pack: every REAL series emits a value exactly where the
             # shared window is valid — pure host math, zero device work
             minsamp = 2 if fn_name in ("rate", "increase", "delta") else 1
@@ -676,8 +692,10 @@ class MeshExecutor:
         D, S, T = packed.ts_off.shape
         Tp = pf._pad_to(T, pf._LANE)
         Wlp = pf._pad_to(max(Wl, 1), pf._LANE)
-        if pf.vmem_estimate(Tp, Wlp, max(G, 8),
-                            fn_name in pf.OVER_TIME_FNS) > pf.VMEM_BUDGET:
+        if pf.vmem_estimate(
+                Tp, Wlp, max(G, 8), fn_name in pf.OVER_TIME_FNS,
+                ragged and fn_name in ("rate", "increase", "delta")
+                ) > pf.VMEM_BUDGET:
             return None
         # plan + device-mats cache: repeat queries (the pack-cache pattern)
         # skip the host selection-matrix rebuild and the 9 uploads
@@ -725,11 +743,23 @@ class MeshExecutor:
             G=G, S=S, T=T, Tp=Tp,
             is_counter=(fn_name in ("rate", "increase")),
             is_rate=(fn_name == "rate"), interpret=interpret,
-            kind=(fn_name if over_time else "rate_family"))
-        out = np.asarray(res).reshape(G, n_time, Wlp)[:, :, :Wl] \
-            .reshape(G, Wp)[:, :W]
-        counts = packed.gsize[:, None] * \
-            (wvalid1 if over_time else wvalid)[None, :W]
+            kind=(fn_name if over_time else "rate_family"), ragged=ragged)
+
+        def unslice(a):
+            return np.asarray(a).reshape(G, n_time, Wlp)[:, :, :Wl] \
+                .reshape(G, Wp)[:, :W]
+
+        if ragged:
+            out, counts = unslice(res[0]), unslice(res[1])
+        else:
+            out = unslice(res)
+            counts = packed.gsize[:, None] * \
+                (wvalid1 if over_time else wvalid)[None, :W]
         from filodb_tpu.utils.metrics import registry
         registry.counter("mesh_fused_kernel").increment()
+        if agg_op == "count":                 # ragged: kernel presence
+            return np.where(counts > 0, counts.astype(np.float64), np.nan)
+        if agg_op == "avg":
+            with np.errstate(invalid="ignore", divide="ignore"):
+                out = np.asarray(out, np.float64) / np.maximum(counts, 1.0)
         return pf.present_sum(out, counts)

@@ -258,3 +258,69 @@ def test_mesh_fused_sum_over_time_matches_general(store4, mesh42,
     assert (np.isnan(out_fused) == np.isnan(out_gen)).all()
     np.testing.assert_allclose(out_fused, out_gen, rtol=2e-4, atol=1e-3,
                                equal_nan=True)
+
+
+@pytest.mark.parametrize("agg_op", ["sum", "avg", "count"])
+def test_mesh_fused_ragged_pack_matches_general(mesh42, monkeypatch,
+                                                agg_op):
+    """r4: a uniform-grid pack WITH NaN holes keeps shared_ts_row and runs
+    the ragged kernel variant (valid-boundary scans, presence psum'd as a
+    second output) — results match the general path's dense=False
+    semantics for sum/avg/count."""
+    from filodb_tpu.core.records import RecordBatch
+    from filodb_tpu.utils.metrics import registry
+    rng = np.random.default_rng(7)
+    ms = TimeSeriesMemStore()
+    mapper = ShardMapper(4)
+    for s in range(4):
+        sh = ms.setup("prometheus", s)
+        mapper.update_from_event(
+            ShardEvent("IngestionStarted", "prometheus", s, "local"))
+        cb = counter_batch(8, NUM_SAMPLES, start_ms=START_MS, seed=s)
+        v = cb.columns["count"].copy()
+        v[rng.random(v.shape) < 0.1] = np.nan
+        sh.ingest(RecordBatch(cb.schema, cb.part_keys, cb.part_idx,
+                              cb.timestamps, {"count": v}, cb.bucket_les))
+    ex = MeshExecutor(ms, "prometheus", mesh42)
+    packed = ex.lookup_and_pack([Equals("_metric_", "request_total")],
+                                START_MS, QEND_S * 1000,
+                                fn_name="rate")
+    assert packed.shared_ts_row is not None and not packed.dense
+    wends = make_window_ends((START_S + 600) * 1000, QEND_S * 1000,
+                             STEP_S * 1000)
+    out_gen, _ = ex.run_agg(packed, wends, range_ms=300_000,
+                            fn_name="rate", agg_op=agg_op)
+    monkeypatch.setenv("FILODB_TPU_FUSED_INTERPRET", "1")
+    before = registry.counter("mesh_fused_kernel").value
+    out_fused, _ = ex.run_agg(packed, wends, range_ms=300_000,
+                              fn_name="rate", agg_op=agg_op)
+    assert registry.counter("mesh_fused_kernel").value > before
+    assert (np.isnan(out_fused) == np.isnan(out_gen)).all()
+    np.testing.assert_allclose(out_fused, out_gen, rtol=2e-5, atol=1e-4,
+                               equal_nan=True)
+
+
+def test_mesh_fused_avg_divides_by_counts(store4, mesh42, monkeypatch):
+    """avg on the fused mesh path must divide group sums by present-series
+    counts (r4 regression: it silently returned raw sums)."""
+    from filodb_tpu.utils.metrics import registry
+    ms, mapper = store4
+
+    def run():
+        ex = MeshExecutor(ms, "prometheus", mesh42)
+        packed = ex.lookup_and_pack(
+            [Equals("_metric_", "request_total"), Equals("_ws_", "demo")],
+            (START_S + 600) * 1000 - 300_000, QEND_S * 1000,
+            fn_name="rate")
+        wends = make_window_ends((START_S + 600) * 1000, QEND_S * 1000,
+                                 STEP_S * 1000)
+        return ex.run_agg(packed, wends, range_ms=300_000,
+                          fn_name="rate", agg_op="avg")
+
+    out_gen, _ = run()
+    monkeypatch.setenv("FILODB_TPU_FUSED_INTERPRET", "1")
+    before = registry.counter("mesh_fused_kernel").value
+    out_fused, _ = run()
+    assert registry.counter("mesh_fused_kernel").value > before
+    np.testing.assert_allclose(out_fused, out_gen, rtol=2e-5, atol=1e-4,
+                               equal_nan=True)

@@ -1,0 +1,115 @@
+"""TPU tunnel watcher strike path (tools/tpu_watch.py): when a probe
+finds the chip, the staged bench runs and EVERY completed stage is
+snapshotted + committed immediately — so a short tunnel window still
+leaves a committed artifact.  Exercised against a scratch git repo with
+a stub bench worker standing in for the chip (the machinery must be
+demonstrably armed even in rounds where the tunnel never wakes;
+VERDICT r3 item 1)."""
+import argparse
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_watch():
+    spec = importlib.util.spec_from_file_location(
+        "_tpu_watch_under_test", os.path.join(REPO, "tools", "tpu_watch.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture()
+def scratch_repo(tmp_path):
+    root = tmp_path / "scratch"
+    root.mkdir()
+    subprocess.run(["git", "init", "-q", str(root)], check=True)
+    subprocess.run(["git", "-C", str(root), "config", "user.email", "t@t"],
+                   check=True)
+    subprocess.run(["git", "-C", str(root), "config", "user.name", "t"],
+                   check=True)
+    (root / "tools").mkdir()
+    return root
+
+
+def _git_log(root):
+    out = subprocess.run(["git", "-C", str(root), "log", "--oneline"],
+                         capture_output=True, text=True)
+    return out.stdout
+
+
+def test_strike_snapshots_and_commits_each_stage(scratch_repo, monkeypatch):
+    tw = _load_watch()
+    monkeypatch.setattr(tw, "REPO", str(scratch_repo))
+    monkeypatch.setattr(tw, "STOP_FILE",
+                        str(scratch_repo / "tools" / "tpu_watch.stop"))
+    monkeypatch.setattr(tw, "CACHE_DIR", str(scratch_repo / ".jax_cache"))
+
+    # stub bench worker: writes a TPU BENCH_PARTIAL with the warm stage,
+    # then (second invocation-of-poll window) the north-star stage
+    stub = scratch_repo / "bench.py"
+    stub.write_text("""
+import json, os, sys, time
+run_id = sys.argv[sys.argv.index("--run-id") + 1]
+doc = {"run_id": run_id, "platform": "tpu", "stages": {
+    "warm_8k": {"series": 8192, "samples_per_sec": 5.0e8, "p50_s": 0.01}}}
+p = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                 "BENCH_PARTIAL.json")
+json.dump(doc, open(p, "w"))
+time.sleep(20)
+doc["stages"]["north_star_1m"] = {"series": 1048576,
+                                  "samples_per_sec": 1.0e9, "p50_s": 0.8}
+json.dump(doc, open(p, "w"))
+""")
+    log = tw.WatchLog(str(scratch_repo / "TPU_WATCH_test.jsonl"),
+                      commit_every=1000)
+    args = argparse.Namespace(round=99, bench_timeout=120)
+    committed, done = tw.run_bench_window(args, log, "")
+    assert done, "north-star stage should be detected"
+    snap_path = scratch_repo / "BENCH_TPU_SNAPSHOT_r99.json"
+    assert snap_path.exists()
+    snap = json.loads(snap_path.read_text())
+    assert snap["platform"] == "tpu"
+    assert "north_star_1m" in snap["stages"]
+    hist = _git_log(scratch_repo)
+    # at least one per-stage snapshot commit landed (a 5-minute window
+    # leaves evidence even if the big stage never finishes)
+    assert hist.count("tpu_watch: TPU bench snapshot") >= 1, hist
+
+
+def test_stale_partial_from_other_run_is_ignored(scratch_repo, monkeypatch):
+    tw = _load_watch()
+    monkeypatch.setattr(tw, "REPO", str(scratch_repo))
+    partial = scratch_repo / "BENCH_PARTIAL.json"
+    partial.write_text(json.dumps({
+        "run_id": "someone-else", "platform": "tpu",
+        "stages": {"warm_8k": {"series": 8192,
+                               "samples_per_sec": 1.0}}}))
+    stages, doc = tw.trusted_stages(str(partial))
+    assert stages and doc["run_id"] == "someone-else"
+    # cpu partials never count as TPU evidence
+    partial.write_text(json.dumps({
+        "run_id": "x", "platform": "cpu",
+        "stages": {"cpu_65k": {"series": 65536,
+                               "samples_per_sec": 1.0}}}))
+    stages, _ = tw.trusted_stages(str(partial))
+    assert stages == {}
+
+
+def test_probe_is_the_bench_supervisors(monkeypatch):
+    """The watcher's notion of 'tunnel alive' is bench.py's probe — one
+    implementation, no drift."""
+    tw = _load_watch()
+    import importlib.util as iu
+    spec = iu.spec_from_file_location("_bench_probe_check",
+                                      os.path.join(REPO, "bench.py"))
+    bench = iu.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+    assert tw.probe.__code__.co_filename == \
+        bench._probe_default_backend.__code__.co_filename
