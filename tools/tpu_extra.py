@@ -1,0 +1,301 @@
+#!/usr/bin/env python
+"""On-chip evidence beyond the staged headline bench (round 4).
+
+Captures, while a TPU tunnel window is live, the three measurements the
+round-3 verdict asked for that the headline ladder doesn't cover:
+
+  1. ragged_rate: the r4 ragged (NaN-holed counters + restarts) rate
+     family on the fused one-pass kernel at production scale, vs the
+     general XLA path, with an f64 scalar-oracle spot check — proof the
+     "production-shaped data falls off the fused cliff" weakness is gone
+     ON CHIP, not just under CPU interpret mode.
+  2. shardmap_fused: the fused kernel composed inside jax.shard_map on
+     real hardware (1-device mesh) vs the direct call — round-3 verdict
+     weak #4: "the distributed-fused configuration has never been shown
+     faster anywhere" (CPU interpret mode made it look 7.8x slower).
+  3. hbm_peak / mxu_peak: measured achievable HBM copy bandwidth and
+     bf16/f32 matmul throughput on this chip, so doc/kernels.md can quote
+     the fused kernel's achieved GB/s and model TFLOP/s against a
+     *measured* roofline instead of datasheet model numbers.
+
+Every section persists incrementally to TPU_EXTRA_r04.json so a tunnel
+death mid-run still leaves the finished sections behind.
+
+Usage: python tools/tpu_extra.py   (refuses to run on a non-TPU backend)
+"""
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.join(REPO, "tests"))
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
+                      os.path.join(REPO, ".jax_cache"))
+OUT = os.path.join(REPO, "TPU_EXTRA_r04.json")
+
+DOC = {"utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())}
+
+
+def persist():
+    tmp = OUT + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(DOC, f, indent=1)
+    os.replace(tmp, OUT)
+
+
+def p50(fn, iters=10):
+    lat = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        fn()
+        lat.append(time.perf_counter() - t0)
+    return float(np.median(np.asarray(lat)))
+
+
+def mk_ragged_counters(S, T, hole_frac=0.10, reset_frac=0.02, seed=7,
+                       step_ms=10_000):
+    """Production-shaped counters at scale: NaN scrape gaps + restarts
+    (vectorized — the per-series loop in tests/test_pallas_fused.py is
+    fine at 64 series, not at 262k)."""
+    rng = np.random.default_rng(seed)
+    ts_row = np.arange(T, dtype=np.int64) * step_ms
+    inc = rng.exponential(10.0, size=(S, T))
+    # restarts: at reset points the counter restarts from a small value —
+    # inject by subtracting the running value (vectorized via segment
+    # cumsum trick: cumsum of increments, minus cumsum frozen at resets)
+    raw = np.cumsum(inc, axis=1)
+    resets = rng.random((S, T)) < reset_frac
+    resets[:, :2] = False
+    # value carried away at each reset = raw just before it
+    carried = np.where(resets, np.roll(raw, 1, axis=1), 0.0)
+    raw = raw - np.maximum.accumulate(
+        np.where(resets, carried, 0.0), axis=1)
+    raw = np.maximum(raw, 0.0)
+    raw[rng.random((S, T)) < hole_frac] = np.nan
+    return ts_row, raw
+
+
+def section_ragged(jax, jnp):
+    from filodb_tpu.ops import pallas_fused as pf
+    from filodb_tpu.ops.counter import rebase_values
+    from filodb_tpu.ops.rangefns import evaluate_range_function
+    from filodb_tpu.ops import agg as agg_ops
+    from filodb_tpu.ops.timewindow import make_window_ends, to_offsets
+
+    S, T, G = 262_144, 720, 1000
+    range_ms, step_ms = 300_000, 60_000
+    sec = {"series": S, "samples_per_series": T, "groups": G,
+           "hole_frac": 0.10, "reset_frac": 0.02}
+    DOC["ragged_rate_262k"] = sec
+    t0 = time.perf_counter()
+    ts_row, raw = mk_ragged_counters(S, T)
+    reb, vbase = rebase_values(raw, True)
+    vals32 = reb.astype(np.float32)
+    vbase32 = vbase.astype(np.float32)
+    gids = (np.arange(S) % G).astype(np.int32)
+    wends = make_window_ends(600_000, int(ts_row[-1]), step_ms)
+    W = len(wends)
+    span = S * int(np.searchsorted(ts_row, int(ts_row[-1]), side="right")
+                   - np.searchsorted(ts_row, 600_000 - range_ms))
+    sec.update({"windows": W, "samples_scanned_per_query": span,
+                "host_prep_s": round(time.perf_counter() - t0, 2)})
+    persist()
+
+    ts_one = to_offsets(ts_row[None, :], np.full(1, T), 0)
+    dev = {k: jax.device_put(v) for k, v in
+           (("ts", ts_one), ("vals", vals32), ("vb", vbase32),
+            ("g", gids), ("w", wends.astype(np.int32)))}
+
+    @jax.jit
+    def general(ts, v, vb, g, w):
+        res = evaluate_range_function(ts, v, w, range_ms, "rate",
+                                      shared_grid=True, vbase=vb,
+                                      precorrected=True, dense=False)
+        return agg_ops.aggregate("sum", res, g, G)
+
+    t0 = time.perf_counter()
+    xla_res = np.asarray(general(dev["ts"], dev["vals"], dev["vb"],
+                                 dev["g"], dev["w"]))
+    sec["xla_compile_s"] = round(time.perf_counter() - t0, 2)
+    g50 = p50(lambda: np.asarray(general(dev["ts"], dev["vals"],
+                                         dev["vb"], dev["g"], dev["w"])))
+    sec.update({"xla_p50_s": round(g50, 5),
+                "xla_samples_per_sec": round(span / g50, 1)})
+    persist()
+
+    plan = pf.build_plan(ts_row, np.asarray(wends, np.int64), range_ms)
+    prep = pf.pad_inputs(dev["vals"], vbase32, gids, plan, G)
+
+    def fused():
+        sums, counts = pf.fused_rate_groupsum(
+            None, None, None, plan, G, "rate", True, prepared=prep,
+            ragged=True)
+        return pf.present_sum(sums, counts)
+
+    t0 = time.perf_counter()
+    got = fused()
+    sec["pallas_compile_s"] = round(time.perf_counter() - t0, 2)
+    f50 = p50(fused)
+    sec.update({"pallas_p50_s": round(f50, 5),
+                "pallas_samples_per_sec": round(span / f50, 1),
+                "pallas_speedup_vs_general": round(g50 / f50, 2)})
+    # on-chip cross-check: fused vs general XLA over the full shape
+    same_nan = bool((np.isnan(got) == np.isnan(xla_res)).all())
+    err = float(np.nanmax(np.abs(got - xla_res)
+                          / np.maximum(np.abs(xla_res), 1e-6)))
+    sec["pallas_max_rel_err_vs_xla"] = round(err, 9) if same_nan else "inf"
+    # f64 scalar-oracle spot check: 96 random series as singleton groups
+    from oracle import eval_series
+    rng = np.random.default_rng(3)
+    idx = rng.choice(S, size=96, replace=False)
+    sub32 = vals32[idx]
+    subvb = vbase32[idx]
+    subg = np.arange(96, dtype=np.int32)
+    sums, counts = pf.fused_rate_groupsum(
+        sub32, subvb, subg, plan, 96, "rate", True, ragged=True)
+    got_sub = pf.present_sum(sums, counts)
+    want = np.stack([eval_series(ts_row, raw[i], wends, range_ms, "rate")
+                     for i in idx])
+    ok_nan = bool((np.isnan(got_sub) == np.isnan(want)).all())
+    oerr = float(np.nanmax(np.abs(got_sub - want)
+                           / np.maximum(np.abs(want), 1e-6)))
+    sec["oracle_series_checked"] = 96
+    sec["pallas_max_rel_err_vs_f64_oracle"] = (round(oerr, 9) if ok_nan
+                                               else "inf")
+    sec["conformance_ok"] = bool(same_nan and err < 1e-3
+                                 and ok_nan and oerr < 1e-3)
+    persist()
+
+
+def section_shardmap(jax, jnp):
+    from jax.sharding import Mesh
+    from filodb_tpu.ops import pallas_fused as pf
+    from filodb_tpu.ops.timewindow import make_window_ends
+    from filodb_tpu.parallel import mesh as fmesh
+
+    S, T, G = 262_144, 720, 1000
+    range_ms, step_ms = 300_000, 60_000
+    sec = {"series": S, "mesh": "1 shard x 1 time (single real chip)"}
+    DOC["shardmap_fused_262k"] = sec
+    rng = np.random.default_rng(5)
+    ts_row = np.arange(T, dtype=np.int64) * 10_000
+    vals32 = np.cumsum(rng.exponential(10.0, size=(S, T)),
+                       axis=1).astype(np.float32)
+    vb = vals32[:, 0].copy()
+    vals32 -= vb[:, None]
+    gids = (np.arange(S) % G).astype(np.int32)
+    wends = make_window_ends(600_000, int(ts_row[-1]), step_ms)
+    W = len(wends)
+    span = S * T
+    plan = pf.build_plan(ts_row, np.asarray(wends, np.int64), range_ms)
+    prep = pf.pad_inputs(vals32, vb, gids, plan, G)
+
+    def direct():
+        sums, counts = pf.fused_rate_groupsum(
+            None, None, None, plan, G, "rate", True, prepared=prep)
+        return pf.present_sum(sums, counts)
+
+    want = direct()
+    d50 = p50(direct)
+    sec.update({"direct_p50_s": round(d50, 5),
+                "direct_samples_per_sec": round(span / d50, 1)})
+    persist()
+
+    mesh = Mesh(np.asarray(jax.devices()[:1]).reshape(1, 1),
+                ("shard", "time"))
+    dv = jax.device_put(vals32[None])          # [1, S, T]
+    dg = jax.device_put(gids[None])
+    dvb = jax.device_put(vb[None])
+    mats = tuple(jax.device_put(getattr(plan, a)[None]) for a in
+                 ("o1", "o2", "l1", "l2", "t1", "t2", "n",
+                  "wstart_x", "wend_x", "tsrow"))
+
+    def via_shardmap():
+        out = fmesh._mesh_fused_call(
+            mesh, dv, dg, dvb, *mats, G=G, S=S, T=T, Tp=plan.Tp,
+            is_counter=True, is_rate=True, interpret=False)
+        counts = prep.gsize[:, None].astype(np.float64) * \
+            plan.wvalid[None, :].astype(np.float64)
+        s = np.asarray(out, np.float64)[:G, :plan.W]
+        return np.where(counts > 0, s, np.nan)
+
+    t0 = time.perf_counter()
+    got = via_shardmap()
+    sec["shardmap_compile_s"] = round(time.perf_counter() - t0, 2)
+    m50 = p50(via_shardmap)
+    err = float(np.nanmax(np.abs(got - want)
+                          / np.maximum(np.abs(want), 1e-6)))
+    sec.update({
+        "shardmap_p50_s": round(m50, 5),
+        "shardmap_samples_per_sec": round(span / m50, 1),
+        "shardmap_overhead_vs_direct": round(m50 / d50, 3),
+        "max_rel_err_vs_direct": round(err, 9),
+        "note": ("CPU interpret mode made fused-in-shard_map look 7.8x "
+                 "slower (MULTICHIP_r03); on real TPU the wrapper costs "
+                 "shardmap_overhead_vs_direct"),
+    })
+    persist()
+
+
+def section_roofline(jax, jnp):
+    sec = {}
+    DOC["roofline"] = sec
+    n = 256 * 1024 * 1024 // 4                 # 256 MiB f32
+    x = jax.device_put(np.ones(n, np.float32))
+    copy = jax.jit(lambda a: a * np.float32(1.0000001))
+    np.asarray(copy(x))
+
+    def run_copy():
+        copy(x).block_until_ready()
+
+    c50 = p50(run_copy, iters=20)
+    sec["hbm_copy_gb_s"] = round(2 * n * 4 / c50 / 1e9, 1)
+    red = jax.jit(lambda a: a.sum())
+    np.asarray(red(x))
+    r50 = p50(lambda: red(x).block_until_ready(), iters=20)
+    sec["hbm_read_reduce_gb_s"] = round(n * 4 / r50 / 1e9, 1)
+    persist()
+
+    for dt, name in ((jnp.bfloat16, "bf16"), (jnp.float32, "f32")):
+        k = 4096
+        a = jax.device_put(np.ones((k, k), np.float32).astype(dt))
+        mm = jax.jit(lambda p, q: p @ q)
+        np.asarray(mm(a, a), np.float32)
+        m50 = p50(lambda: mm(a, a).block_until_ready(), iters=20)
+        sec[f"mxu_{name}_tflops_per_s"] = round(2 * k**3 / m50 / 1e12, 1)
+        persist()
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    plat = jax.devices()[0].platform
+    DOC["platform"] = plat
+    DOC["device"] = str(jax.devices()[0])
+    if plat not in ("tpu",):
+        print(f"not a TPU backend ({plat}); refusing", file=sys.stderr)
+        return 2
+    persist()
+    for name, fn in (("roofline", section_roofline),
+                     ("ragged", section_ragged),
+                     ("shardmap", section_shardmap)):
+        try:
+            t0 = time.perf_counter()
+            fn(jax, jnp)
+            print(f"{name}: ok in {time.perf_counter() - t0:.1f}s",
+                  flush=True)
+        except Exception as e:  # noqa: BLE001 — keep later sections alive
+            DOC[f"{name}_error"] = f"{type(e).__name__}: {e}"[:400]
+            persist()
+            print(f"{name}: FAILED {e}", flush=True)
+    DOC["done"] = True
+    persist()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
