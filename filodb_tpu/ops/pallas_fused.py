@@ -121,16 +121,43 @@ def _fill_scan(x, ok, left: bool):
     along time in log2(T) shift-and-select steps — the in-kernel form of a
     lax.associative_scan carry, Pallas-friendly (static shapes, no dynamic
     control flow).  Positions with no valid neighbor on the fill side keep
-    their input value; callers mask those via window valid-counts."""
+    their input value; callers mask those via window valid-counts.
+
+    Validity travels as f32 0/1, NOT bool: Mosaic cannot shift/concat i1
+    vregs on real TPU (`tpu.bitcast_vreg vector<8x128xi1> -> i32` is
+    rejected as an invalid vector register cast; interpret mode accepted
+    the bool form, which hid this until the first on-chip ragged compile).
+    Returns (filled x, f32 validity)."""
     shift = _shift_l if left else _shift_r
+    okf = ok.astype(jnp.float32)
     k = 1
     while k < x.shape[1]:
         xs = shift(x, k, 0.0)
-        oks = shift(ok, k, False)
-        x = jnp.where(ok, x, xs)
-        ok = ok | oks
+        oks = shift(okf, k, 0.0)
+        x = jnp.where(okf > 0, x, xs)
+        okf = jnp.maximum(okf, oks)
         k *= 2
-    return x, ok
+    return x, okf
+
+
+def _fill_scan2(x, y, ok, left: bool):
+    """_fill_scan over two carriers sharing ONE validity evolution — the
+    ragged rate path fills values and timestamps against the same mask,
+    and sharing the okf carry halves the live [bs, Tp] scan temporaries
+    (the footprint that forces the series-block shrink)."""
+    shift = _shift_l if left else _shift_r
+    okf = ok.astype(jnp.float32)
+    k = 1
+    while k < x.shape[1]:
+        xs = shift(x, k, 0.0)
+        ys = shift(y, k, 0.0)
+        oks = shift(okf, k, 0.0)
+        keep = okf > 0
+        x = jnp.where(keep, x, xs)
+        y = jnp.where(keep, y, ys)
+        okf = jnp.maximum(okf, oks)
+        k *= 2
+    return x, y, okf
 
 
 def _cumsum_lanes(x):
@@ -218,18 +245,17 @@ def _kernel(vals_ref, vbase_ref, gids_ref, o1_ref, o2_ref, l1_ref, l2_ref,
         if with_drops:
             fv, fok = _fill_scan(vz, m, left=False)
             prev = _shift_r(fv, 1, 0.0)
-            pok = _shift_r(fok, 1, False)
+            pok = _shift_r(fok, 1, 0.0)                # f32 validity
             # reset vs the previous VALID value; correction adds the full
             # previous RAW value (prev + vbase), cumulative across the row
-            d = jnp.where(m & pok & (vz < prev), prev + vbase_ref[:], 0.0)
+            d = jnp.where(m & (pok > 0) & (vz < prev),
+                          prev + vbase_ref[:], 0.0)
             c = vz + _cumsum_lanes(d)
         else:
             c = vz
         tsb = jnp.where(m, jnp.broadcast_to(ts_ref[:], v.shape), 0.0)
-        f_c, _ = _fill_scan(c, m, left=False)
-        b_c, _ = _fill_scan(c, m, left=True)
-        f_t, _ = _fill_scan(tsb, m, left=False)
-        b_t, _ = _fill_scan(tsb, m, left=True)
+        f_c, f_t, _ = _fill_scan2(c, tsb, m, left=False)
+        b_c, b_t, _ = _fill_scan2(c, tsb, m, left=True)
         band = l2_ref[:] - l1_ref[:] + o1_ref[:]
         nv = mm(m.astype(jnp.float32), band)          # [BS, Wp] valid count
         v1 = mm(b_c, o1_ref[:])
@@ -320,17 +346,35 @@ def _run(vals_p, vbase_p, gids_p, o1, o2, l1, l2, t1, t2, n, ws, we, ts,
     Sp, Tp = vals_p.shape
     Wp = o1.shape[1]
     Gp = num_groups
-    grid = Sp // _BS
+    # adaptive series block: the ragged rate family's scan temporaries
+    # scale with bs*Tp, so long rows shrink the block instead of OOMing
+    # scoped vmem (or being rejected by the eligibility gate).  All
+    # shapes here are static at trace time; Sp is padded to _BS, which
+    # every smaller power-of-two block divides.
+    bs = pick_block(Tp, Wp, Gp, kind in OVER_TIME_FNS,
+                    ragged and kind == "rate_family")
+    if bs is None:
+        if interpret:
+            bs = _MIN_BS            # no scoped-vmem limit off-chip
+        else:
+            # fail loudly here rather than with an opaque Mosaic
+            # scoped-vmem OOM at lowering: gated callers (leafexec, mesh)
+            # never reach this, but direct fused_rate_groupsum users can
+            raise ValueError(
+                f"fused kernel shape exceeds VMEM budget at every block "
+                f"size (Tp={Tp}, Wp={Wp}, Gp={Gp}, kind={kind}, "
+                f"ragged={ragged}); use the general path")
+    grid = Sp // bs
     space = {} if interpret else {"memory_space": pltpu.VMEM}
-    row_spec = pl.BlockSpec((_BS, Tp), lambda i: (i, 0), **space)
-    col_spec = pl.BlockSpec((_BS, 1), lambda i: (i, 0), **space)
+    row_spec = pl.BlockSpec((bs, Tp), lambda i: (i, 0), **space)
+    col_spec = pl.BlockSpec((bs, 1), lambda i: (i, 0), **space)
     fix = lambda shape: pl.BlockSpec(shape, lambda i: (0, 0), **space)  # noqa: E731
     kern = functools.partial(_kernel, num_groups=Gp, is_counter=is_counter,
                              is_rate=is_rate, with_drops=with_drops,
                              kind=kind, ragged=ragged, per_series=per_series)
     with_counts = ragged                 # presence rides a second output
     if per_series:
-        out_spec = pl.BlockSpec((_BS, Wp), lambda i: (i, 0), **space)
+        out_spec = pl.BlockSpec((bs, Wp), lambda i: (i, 0), **space)
         out_shape = jax.ShapeDtypeStruct((Sp, Wp), jnp.float32)
     else:
         out_spec = fix((Gp, Wp))
@@ -355,21 +399,49 @@ VMEM_BUDGET = 12 << 20          # per-core VMEM is ~16MB; leave headroom
 
 def vmem_estimate(Tp: int, Wp: int, Gp: int,
                   over_time: bool = False,
-                  ragged_rate: bool = False) -> int:
+                  ragged_rate: bool = False, bs: int = _BS) -> int:
     """Rough resident-bytes model for one grid step: the 4 selection
     matrices (plus the over_time kinds' band temporary), the
     double-buffered values block, the group one-hot + accumulator, and
-    [BS, Wp] f32 temporaries.  The ragged rate family adds ~8 live
-    [BS, Tp] fill/prefix-scan temporaries.  Callers divert to the general
-    XLA path when this exceeds VMEM_BUDGET instead of failing at kernel
-    lowering."""
+    [bs, Wp] f32 temporaries.  The ragged rate family's fill/prefix
+    scans keep ~19 [bs, Tp] temporaries live (calibrated against the
+    Mosaic scoped-vmem allocation report on a real v5e: 21.36 MiB at
+    bs=256, Tp=768, Wp=128, Gp=1000 — the first on-chip ragged compile
+    OOM'd scoped vmem where the old 8-temporary model predicted 13 MiB).
+    Callers divert to the general XLA path when this exceeds VMEM_BUDGET
+    instead of failing at kernel lowering; _run shrinks its series block
+    (pick_block) before giving up, so the gate must test the SMALLEST
+    block, not _BS."""
     sel = (5 if over_time else 4) * Tp * Wp * 4
-    vals = 2 * _BS * Tp * 4
+    vals = 2 * bs * Tp * 4
     if ragged_rate:
-        vals += 8 * _BS * Tp * 4
-    group = Gp * (Wp * 8 + _BS * 4)
-    inter = 12 * _BS * Wp * 4
+        # 19 was calibrated BEFORE _fill_scan2 halved the scan carries;
+        # kept until the next on-chip window re-measures it (conservative
+        # = smaller blocks than strictly needed, never an OOM)
+        vals += 19 * bs * Tp * 4
+    group = Gp * (Wp * 8 + bs * 4)
+    inter = 12 * bs * Wp * 4
     return sel + vals + group + inter
+
+
+_MIN_BS = 32
+
+
+def pick_block(Tp: int, Wp: int, Gp: int, over_time: bool = False,
+               ragged_rate: bool = False) -> Optional[int]:
+    """Largest series-block size whose vmem_estimate fits VMEM_BUDGET
+    (None when even _MIN_BS doesn't — the caller must divert to the
+    general path).  The ragged rate family's scan temporaries scale with
+    bs*Tp, so long rows fuse fine at a smaller block: at Tp=768 the
+    dense kernel keeps bs=256 while ragged rate drops to 64 instead of
+    falling off the fused path entirely."""
+    bs = _BS
+    while bs >= _MIN_BS:
+        if vmem_estimate(Tp, Wp, Gp, over_time, ragged_rate,
+                         bs=bs) <= VMEM_BUDGET:
+            return bs
+        bs //= 2
+    return None
 
 
 def window_counts(ts_row: np.ndarray, wends: np.ndarray,

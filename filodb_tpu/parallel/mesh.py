@@ -692,10 +692,12 @@ class MeshExecutor:
         D, S, T = packed.ts_off.shape
         Tp = pf._pad_to(T, pf._LANE)
         Wlp = pf._pad_to(max(Wl, 1), pf._LANE)
-        if pf.vmem_estimate(
-                Tp, Wlp, max(G, 8), fn_name in pf.OVER_TIME_FNS,
+        # padded group count, matching _run's recomputation exactly
+        if pf.pick_block(
+                Tp, Wlp, pf._pad_to(max(G, 8), 8),
+                fn_name in pf.OVER_TIME_FNS,
                 ragged and fn_name in ("rate", "increase", "delta")
-                ) > pf.VMEM_BUDGET:
+                ) is None:
             return None
         # plan + device-mats cache: repeat queries (the pack-cache pattern)
         # skip the host selection-matrix rebuild and the 9 uploads

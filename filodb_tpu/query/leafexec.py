@@ -150,8 +150,7 @@ class MultiSchemaPartitionsExec(LeafExecPlan):
         Wp = pf._pad_to(eval_wends.size, pf._LANE)
         over_time = t0.function in pf.OVER_TIME_FNS
         ragged_rate = not dense and fn in ("rate", "increase", "delta")
-        if pf.vmem_estimate(Tp, Wp, 8, over_time,
-                            ragged_rate) > pf.VMEM_BUDGET:
+        if pf.pick_block(Tp, Wp, 8, over_time, ragged_rate) is None:
             return None
         from filodb_tpu.utils.metrics import registry
         # plan + prepared-input caches: a repeat query over an unchanged
@@ -186,8 +185,10 @@ class MultiSchemaPartitionsExec(LeafExecPlan):
         num_slots = len(gkeys) * B      # hist: one kernel group per (g, b)
         # VMEM guard, part 2: full estimate now that group count is known —
         # BEFORE the padded device copy, so diverted queries cost nothing
-        if pf.vmem_estimate(Tp, Wp, max(num_slots, 8),
-                            over_time, ragged_rate) > pf.VMEM_BUDGET:
+        # same padded group count _run will use — a gate tested on the
+        # unpadded count could accept a shape _run then rejects
+        if pf.pick_block(Tp, Wp, pf._pad_to(max(num_slots, 8), 8),
+                         over_time, ragged_rate) is None:
             return None
         if padded_vals is None:
             vbase = data.vbase

@@ -547,3 +547,57 @@ def test_fused_ragged_last_over_time_slot_semantics():
     assert (np.isnan(got) == np.isnan(want)).all()
     np.testing.assert_allclose(got, want, rtol=2e-5, atol=1e-4,
                                equal_nan=True)
+
+
+# ------------- r4: adaptive series block (on-chip scoped-vmem OOM fix)
+
+def test_pick_block_adaptive():
+    """Long ragged rate rows shrink the series block instead of being
+    rejected: the first on-chip ragged compile OOM'd scoped vmem at
+    bs=256, Tp=768 (Mosaic: 21.36M > 16M limit) while the old estimate
+    said 13M — the calibrated model must divert THAT shape to a smaller
+    block and keep the dense kernel at the full block."""
+    from filodb_tpu.ops import pallas_fused as pf
+    assert pf.pick_block(768, 128, 1000, False, False) == pf._BS
+    bs = pf.pick_block(768, 128, 1000, False, True)
+    assert bs is not None and bs < pf._BS
+    assert pf.vmem_estimate(768, 128, 1000, False, True,
+                            bs=bs) <= pf.VMEM_BUDGET
+    # the calibrated model rejects the shape that actually OOM'd on chip
+    assert pf.vmem_estimate(768, 128, 1000, False, True,
+                            bs=256) > pf.VMEM_BUDGET
+    # tiny shapes keep the full block (interpret-mode tests stay fast)
+    assert pf.pick_block(256, 128, 8, False, True) == pf._BS
+
+
+def test_fused_ragged_rate_long_rows():
+    """T=720 (dashboard shape, Tp=768): the ragged kernel runs with a
+    shrunken block and still matches the f64 oracle — this is the exact
+    shape whose bs=256 compile OOM'd scoped vmem on the real chip."""
+    from oracle import eval_series
+    S, T, G = 16, 720, 4
+    rng = np.random.default_rng(9)
+    ts_row = np.arange(T, dtype=np.int64) * START_STEP
+    raw = np.cumsum(rng.exponential(10.0, size=(S, T)), axis=1)
+    raw[rng.random((S, T)) < 0.1] = np.nan
+    gids = (np.arange(S) % G).astype(np.int32)
+    range_ms = 300_000
+    wends = make_window_ends(600_000, int(ts_row[-1]), 60_000)
+    plan = build_plan(ts_row, wends, range_ms)
+    reb, vbase = rebase_values(raw, True)
+    sums, counts = fused_rate_groupsum(
+        reb.astype(np.float32), vbase.astype(np.float32), gids, plan, G,
+        fn_name="rate", precorrected=True, interpret=True, ragged=True)
+    got = present_sum(sums, counts)
+    per = np.stack([eval_series(ts_row, raw[s], wends, range_ms, "rate")
+                    for s in range(S)])
+    want = np.zeros((G, len(wends)))
+    cnt = np.zeros((G, len(wends)))
+    for s in range(S):
+        m = ~np.isnan(per[s])
+        want[gids[s], m] += per[s, m]
+        cnt[gids[s]] += m
+    want = np.where(cnt > 0, want, np.nan)
+    assert (np.isnan(got) == np.isnan(want)).all()
+    np.testing.assert_allclose(got, want, rtol=5e-4, atol=1e-4,
+                               equal_nan=True)
