@@ -1,0 +1,85 @@
+#!/usr/bin/env python
+"""Companion watcher for tools/tpu_extra.py: after the headline watcher
+(tools/tpu_watch.py) captured the north star and stopped, this one waits
+for the next live tunnel window to (re)capture the sections that need the
+fixed ragged kernel — ragged_rate_262k with the adaptive series block and
+the Precision.HIGHEST f32 roofline — then commits and stops.
+
+Usage: nohup python tools/tpu_watch_extra.py >/tmp/tpu_watch_extra.out 2>&1 &
+Stop:  touch tools/tpu_watch.stop
+"""
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+STOP_FILE = os.path.join(REPO, "tools", "tpu_watch.stop")
+LOG = os.path.join(REPO, "TPU_WATCH_r04.jsonl")
+OUT = os.path.join(REPO, "TPU_EXTRA_r04.json")
+PROBE_INTERVAL = 240
+SECTIONS = ["roofline", "ragged"]
+
+import importlib.util  # noqa: E402
+
+_spec = importlib.util.spec_from_file_location(
+    "_bench_headline", os.path.join(REPO, "bench.py"))
+_bench = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(_bench)
+probe = _bench._probe_default_backend
+
+
+def log(event, **kw):
+    rec = {"event": event,
+           "utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()), **kw}
+    with open(LOG, "a") as f:
+        f.write(json.dumps(rec) + "\n")
+
+
+def commit(msg):
+    for _ in range(5):
+        try:
+            subprocess.run(["git", "-C", REPO, "add", "--", OUT, LOG],
+                           check=True, capture_output=True, timeout=60)
+            r = subprocess.run(["git", "-C", REPO, "commit", "-m", msg,
+                                "--no-verify"],
+                               capture_output=True, text=True, timeout=60)
+            if r.returncode == 0 or "nothing to commit" in r.stdout:
+                return
+        except Exception:  # noqa: BLE001
+            pass
+        time.sleep(5)
+
+
+def main():
+    log("extra_watch_armed", sections=SECTIONS)
+    while True:
+        if os.path.exists(STOP_FILE):
+            log("extra_watch_stopped", reason="stop file")
+            return 0
+        plat = probe(90)
+        log("extra_probe", platform=plat)
+        if plat == "tpu":
+            r = subprocess.run(
+                [sys.executable, os.path.join(REPO, "tools", "tpu_extra.py")]
+                + SECTIONS, capture_output=True, text=True, timeout=3600)
+            log("extra_run", rc=r.returncode, tail=r.stdout[-300:])
+            ok = False
+            try:
+                with open(OUT) as f:
+                    doc = json.load(f)
+                ok = (doc.get("ragged_rate_262k", {}).get("conformance_ok")
+                      and "ragged_error" not in doc)
+            except Exception:  # noqa: BLE001
+                pass
+            commit("tpu_watch_extra: ragged+roofline recapture "
+                   f"(rc={r.returncode}, conformant={bool(ok)})")
+            if ok:
+                log("extra_watch_done")
+                return 0
+        time.sleep(PROBE_INTERVAL)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
