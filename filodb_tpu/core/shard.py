@@ -11,12 +11,15 @@ from __future__ import annotations
 
 import contextlib
 import dataclasses
+import itertools
 import logging
 import threading
 import time
 from typing import Callable, Dict, Iterable, List, Optional, Sequence, Tuple
 
 _log = logging.getLogger("filodb.shard")
+
+_SHARD_KEYS_SERIAL = itertools.count(1)  # see TimeSeriesShard.keys_serial
 
 import numpy as np
 
@@ -107,6 +110,13 @@ class TimeSeriesShard:
         self._pid_row = np.zeros(0, dtype=np.int64)
         self._pid_alive = np.zeros(0, dtype=bool)
         self._rv_keys: List[Optional[object]] = []  # cached RangeVectorKeys
+        # identity for downstream per-working-set caches (host group-id
+        # cache, transformers._group_ids): process-unique serial (ids are
+        # reused after GC; tests rebuild memstores with the same dataset
+        # name) + an epoch that bumps whenever a pid's cached key mapping
+        # is invalidated (tombstone reclaim can recycle pids)
+        self.keys_serial = next(_SHARD_KEYS_SERIAL)
+        self.keys_epoch = 0
         self.stores: Dict[str, DenseSeriesStore] = {}
         # compressed resident tier: sealed chunks kept encoded in host RAM
         # so the dense tier holds only the active tail (memory/resident.py)
@@ -371,6 +381,10 @@ class TimeSeriesShard:
             self.partitions[pid] = None
             self._rv_keys[pid] = None
             pruned.append(pid)
+        if pruned:
+            # pids may be recycled from here on — invalidate any cache
+            # keyed on (keys_serial, keys_epoch, pids)
+            self.keys_epoch += 1
         return len(pruned)
 
     def _do_flush_group(self, group: int, ingestion_time_ms: int) -> int:

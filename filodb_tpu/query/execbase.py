@@ -54,6 +54,11 @@ class RawBlock:
     # only the validity-weighted fused kinds accept.
     shared_ts_row: Optional[np.ndarray] = None
     dense: bool = True
+    # working-set identity (shard keys_serial, keys_epoch, pids bytes):
+    # lets key-preserving transformers reuse cached host group ids —
+    # _group_ids is an O(S) Python loop that dominated warm general-path
+    # queries (~0.3s of a 0.4s query at 65k series)
+    cache_token: Optional[Tuple] = None
 
 
 # Fused-leaf caches (see MultiSchemaPartitionsExec._try_fused): entries are

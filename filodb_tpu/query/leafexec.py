@@ -546,7 +546,9 @@ class MultiSchemaPartitionsExec(LeafExecPlan):
         return RawBlock(keys, ts_off, vals, base, les,
                         samples=stats.samples_scanned, vbase=vbase,
                         precorrected=precorrected,
-                        shared_ts_row=shared_ts_row, dense=dense), stats
+                        shared_ts_row=shared_ts_row, dense=dense,
+                        cache_token=(shard.keys_serial, shard.keys_epoch,
+                                     pids.tobytes())), stats
 
 
 def _estimate_scan(store, rows: np.ndarray, start_ms: int,

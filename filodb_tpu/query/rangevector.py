@@ -54,6 +54,10 @@ class ResultBlock:
     wends: np.ndarray                               # int64 [W] step timestamps ms
     values: np.ndarray
     bucket_les: Optional[np.ndarray] = None
+    # working-set identity for the host group-id cache; ONLY propagate
+    # through transformers that keep `keys` unchanged 1:1 (a stale token
+    # on a re-keyed block would serve another key set's group ids)
+    cache_token: Optional[tuple] = None
 
     @property
     def num_series(self) -> int:

@@ -32,7 +32,7 @@ from filodb_tpu.query.rangevector import (QueryContext, QueryResult, QueryStats,
 
 from filodb_tpu.query.execbase import (
     AggPartial, Data, GroupCardinalityError, RawBlock, ScalarResult,
-    _block_empty, present_partial)
+    _block_empty, _lru_touch, present_partial)
 
 
 # ------------------------------------------------------------- transformers
@@ -117,7 +117,8 @@ class PeriodicSamplesMapper(RangeVectorTransformer):
                 dense=data.dense))
         if fn == "timestamp":
             out = out.astype(np.float64) + base / 1000.0
-        return ResultBlock(data.keys, wends, out, data.bucket_les)
+        return ResultBlock(data.keys, wends, out, data.bucket_les,
+                           cache_token=getattr(data, "cache_token", None))
 
 
 @dataclasses.dataclass
@@ -144,7 +145,8 @@ class RepeatToGridMapper(RangeVectorTransformer):
         assert vals.shape[1] == 1, "@ inner grid must be single-step"
         reps = (1, len(wends)) + (1,) * (vals.ndim - 2)
         return ResultBlock(data.keys, wends, np.tile(vals, reps),
-                           data.bucket_les)
+                           data.bucket_les,
+                           cache_token=data.cache_token)
 
 
 @dataclasses.dataclass
@@ -171,19 +173,22 @@ class InstantVectorFunctionMapper(RangeVectorTransformer):
                 return self._classic_bucket_quantile(q, data)
             out = np.asarray(hist_ops.histogram_quantile(
                 q, jnp.asarray(vals), jnp.asarray(data.bucket_les)))
-            return ResultBlock(data.keys, data.wends, out)
+            return ResultBlock(data.keys, data.wends, out,
+                               cache_token=data.cache_token)
         if self.function == "histogram_bucket":
             le = float(self._arg_value(self.args[0], source))
             out = np.asarray(hist_ops.histogram_bucket(
                 le, jnp.asarray(vals), jnp.asarray(data.bucket_les)))
-            return ResultBlock(data.keys, data.wends, out)
+            return ResultBlock(data.keys, data.wends, out,
+                               cache_token=data.cache_token)
         fn = INSTANT_FUNCTIONS[self.function]
         # elementwise functions broadcast per-step scalar args over [S, W]
         extra = [np.asarray(self._arg_value(a, source, per_step=True))
                  for a in self.args]
         out = np.asarray(fn(jnp.asarray(vals),
                             *[jnp.asarray(x) for x in extra]))
-        return ResultBlock(data.keys, data.wends, out, data.bucket_les)
+        return ResultBlock(data.keys, data.wends, out, data.bucket_les,
+                           cache_token=data.cache_token)
 
     @staticmethod
     def _classic_bucket_quantile(q: float, data: ResultBlock) -> ResultBlock:
@@ -291,7 +296,8 @@ class ScalarOperationMapper(RangeVectorTransformer):
             jnp.asarray(a), jnp.asarray(b), op=self.operator,
             bool_modifier=self.bool_modifier,
             keep_side=("rhs" if self.scalar_is_lhs else "lhs")))
-        return ResultBlock(data.keys, data.wends, out, data.bucket_les)
+        return ResultBlock(data.keys, data.wends, out, data.bucket_les,
+                           cache_token=data.cache_token)
 
 
 def _group_ids(keys: Sequence[RangeVectorKey], by: Tuple[str, ...],
@@ -318,6 +324,41 @@ def _group_ids(keys: Sequence[RangeVectorKey], by: Tuple[str, ...],
 
 _CANDIDATE_OPS = {"topk", "bottomk", "count_values"}
 
+# host group-id cache: (cache_token, by, without) -> (gids, gkeys).
+# _group_ids is an O(S) Python loop (key.only() per series) that
+# dominated warm general-path queries (~0.3s of 0.4s at 65k series,
+# ~5s at 1M); the token (shard keys_serial, keys_epoch, pids bytes)
+# identifies the key set exactly, so repeat dashboard queries do a
+# dict hit instead.  Entries are treated as immutable.
+_HOST_GROUP_CACHE: Dict[tuple, tuple] = {}
+_HOST_GROUP_LOCK = threading.Lock()
+
+
+def _group_ids_cached(token, keys, by, without):
+    if token is None:
+        return _group_ids(keys, by, without)
+    k = (token, tuple(by), tuple(without))
+    with _HOST_GROUP_LOCK:
+        ent = _lru_touch(_HOST_GROUP_CACHE, k)
+    if ent is not None and len(ent[0]) == len(keys):
+        return ent
+    gids, gkeys = _group_ids(keys, by, without)
+    with _HOST_GROUP_LOCK:
+        # entries from OLDER epochs of the same shard are dead — a
+        # reclaimed pid may have been recycled for a different series.
+        # Strictly older only: an in-flight query holding a pre-prune
+        # token must not evict valid newer-epoch entries, nor install
+        # its own never-hittable stale one.
+        for old in [o for o in _HOST_GROUP_CACHE
+                    if o[0][0] == token[0] and o[0][1] < token[1]]:
+            del _HOST_GROUP_CACHE[old]
+        if not any(o[0][0] == token[0] and o[0][1] > token[1]
+                   for o in _HOST_GROUP_CACHE):
+            _HOST_GROUP_CACHE[k] = (gids, gkeys)
+            while len(_HOST_GROUP_CACHE) > 8:
+                _HOST_GROUP_CACHE.pop(next(iter(_HOST_GROUP_CACHE)))
+    return gids, gkeys
+
 
 @dataclasses.dataclass
 class AggregateMapReduce(RangeVectorTransformer):
@@ -337,7 +378,9 @@ class AggregateMapReduce(RangeVectorTransformer):
         if data is None or data.num_series == 0:
             return None
         vals = np.asarray(data.values)
-        gids, gkeys = _group_ids(data.keys, self.by, self.without)
+        gids, gkeys = _group_ids_cached(
+            getattr(data, "cache_token", None), data.keys, self.by,
+            self.without)
         limit = ctx.planner_params.group_by_cardinality_limit
         if limit and len(gkeys) > limit:
             raise GroupCardinalityError(

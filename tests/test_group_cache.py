@@ -1,0 +1,82 @@
+"""Host group-id cache (round 4): repeat dashboard queries must not pay
+the O(S) Python grouping loop again, and the cache must never serve a
+stale key set (new series, evicted/recycled pids).
+
+ref: the reference pays per-query grouping inside RangeVectorAggregator
+(query/src/main/scala/filodb/query/exec/AggrOverRangeVectors.scala:155
+fastReduce); here grouping is hostside prep for a device segment-sum, so
+it is cacheable per working-set snapshot."""
+import numpy as np
+
+from filodb_tpu.core.memstore import TimeSeriesMemStore
+from filodb_tpu.ingest.generator import counter_batch
+from filodb_tpu.parallel.shardmapper import ShardEvent, ShardMapper
+from filodb_tpu.query.engine import QueryEngine
+from filodb_tpu.query import transformers as tr
+from filodb_tpu.query.rangevector import RangeVectorKey
+
+START = 1_600_000_000_000
+
+
+def _keys(n, tag="a"):
+    return [RangeVectorKey((("_ns_", f"ns{i % 3}"), ("inst", f"{tag}{i}")))
+            for i in range(n)]
+
+
+def test_cached_hit_returns_same_object():
+    keys = _keys(10)
+    tok = (1, 0, b"pids")
+    g1 = tr._group_ids_cached(tok, keys, ("_ns_",), ())
+    g2 = tr._group_ids_cached(tok, keys, ("_ns_",), ())
+    assert g1[0] is g2[0] and g1[1] is g2[1]          # dict hit, no rebuild
+    assert len(g1[1]) == 3
+    # different grouping under the same token is its own entry
+    g3 = tr._group_ids_cached(tok, keys, (), ("inst",))
+    assert len(g3[1]) == 3 and g3[0] is not g1[0]
+
+
+def test_token_none_bypasses_cache():
+    keys = _keys(6)
+    g1 = tr._group_ids_cached(None, keys, ("_ns_",), ())
+    g2 = tr._group_ids_cached(None, keys, ("_ns_",), ())
+    assert g1[0] is not g2[0]
+
+
+def test_epoch_change_evicts_same_shard_entries():
+    keys = _keys(8)
+    t0 = (7, 0, b"p")
+    tr._group_ids_cached(t0, keys, ("_ns_",), ())
+    assert (t0, ("_ns_",), ()) in tr._HOST_GROUP_CACHE
+    t1 = (7, 1, b"p")                       # same shard, bumped epoch
+    tr._group_ids_cached(t1, _keys(8, "b"), ("_ns_",), ())
+    assert (t0, ("_ns_",), ()) not in tr._HOST_GROUP_CACHE
+    assert (t1, ("_ns_",), ()) in tr._HOST_GROUP_CACHE
+
+
+def test_engine_sees_new_series_after_warm_query():
+    """End-to-end staleness guard: a warm (cached) query followed by more
+    ingest must include the new series in the next query's groups."""
+    ms = TimeSeriesMemStore()
+    sh = ms.setup("prometheus", 0)
+    sh.ingest(counter_batch(30, 60, start_ms=START))
+    mapper = ShardMapper(1)
+    mapper.update_from_event(
+        ShardEvent("IngestionStarted", "prometheus", 0, "b"))
+    eng = QueryEngine("prometheus", ms, mapper)
+    s = START // 1000
+    q = 'count by (_ns_)(rate(request_total[5m]))'
+    r1 = eng.query_range(q, s + 400, 60, s + 590)
+    assert r1.error is None
+    eng.query_range(q, s + 400, 60, s + 590)          # warm the cache
+    total1 = sum(np.nansum(row) for _, _, row in r1.series())
+    from filodb_tpu.core.partkey import PartKey
+    from filodb_tpu.core.records import RecordBatch
+    b = counter_batch(30, 60, start_ms=START)
+    keys = [PartKey.make(pk.metric, {**dict(pk.tags), "instance": f"X{i}"})
+            for i, pk in enumerate(b.part_keys)]
+    sh.ingest(RecordBatch(b.schema, keys, b.part_idx, b.timestamps,
+                          b.columns, b.bucket_les))
+    r2 = eng.query_range(q, s + 400, 60, s + 590)
+    assert r2.error is None
+    total2 = sum(np.nansum(row) for _, _, row in r2.series())
+    assert total2 > total1                 # new series counted, not stale
