@@ -70,11 +70,19 @@ class PartKey:
 
     def to_bytes(self) -> bytes:
         """Canonical serialization — the identity used for dedup + hashing.
-        Length-prefixed so arbitrary label bytes cannot collide."""
-        parts = [_enc(self.metric.encode())]
-        for k, v in self.tags:
-            parts.append(_enc(k.encode()) + _enc(v.encode()))
-        return b"".join(parts)
+        Length-prefixed so arbitrary label bytes cannot collide.  Cached on
+        the instance: streaming sources reuse key objects across batches,
+        and rebuilding ~1.5µs of encodes per key per batch was the single
+        largest ingest cost at 1M series (derived from frozen fields, so
+        the cache can never go stale)."""
+        kb = self.__dict__.get("_kb")
+        if kb is None:
+            parts = [_enc(self.metric.encode())]
+            for k, v in self.tags:
+                parts.append(_enc(k.encode()) + _enc(v.encode()))
+            kb = b"".join(parts)
+            object.__setattr__(self, "_kb", kb)
+        return kb
 
     @staticmethod
     def from_bytes(data: bytes) -> "PartKey":

@@ -20,6 +20,7 @@ from typing import Callable, Dict, Iterable, List, Optional, Sequence, Tuple
 _log = logging.getLogger("filodb.shard")
 
 _SHARD_KEYS_SERIAL = itertools.count(1)  # see TimeSeriesShard.keys_serial
+_KEY_RESOLVE_CACHE_MAX = 4               # live key tables per shard (schemas)
 
 import numpy as np
 
@@ -117,6 +118,13 @@ class TimeSeriesShard:
         # is invalidated (tombstone reclaim can recycle pids)
         self.keys_serial = next(_SHARD_KEYS_SERIAL)
         self.keys_epoch = 0
+        # key-table resolution cache: streaming sources reuse one part_keys
+        # list across batches (the broker/generator key-table pattern), so
+        # per-batch key->pid resolution collapses to one dict hit instead
+        # of an O(K) Python loop.  id -> (list ref, pids, epoch, schema);
+        # the pinned list ref both validates identity (ids are reused
+        # after GC) and bounds the cache to _KEY_RESOLVE_CACHE_MAX tables
+        self._key_resolve_cache: Dict[int, tuple] = {}
         self.stores: Dict[str, DenseSeriesStore] = {}
         # compressed resident tier: sealed chunks kept encoded in host RAM
         # so the dense tier holds only the active tail (memory/resident.py)
@@ -303,30 +311,63 @@ class TimeSeriesShard:
         if batch.num_records == 0:
             return 0
         store = self._store_for(batch.schema.name)
-        # map batch-local part indices -> store rows (create partitions on
-        # miss); only keys actually referenced by records get partitions —
-        # a routed sub-batch carries the full key list but only this shard's
+        # map batch-local part indices -> pids (create partitions on miss);
+        # only keys actually referenced by records get partitions — a
+        # routed sub-batch carries the full key list but only this shard's
         # rows (ref: TimeSeriesShard.getOrAddPartitionAndIngest:1249 creates
-        # per ingest record, never per container key table entry)
-        rows_for_key = np.full(len(batch.part_keys), -1, dtype=np.int64)
+        # per ingest record, never per container key table entry).
+        # Resolution is cached per key-table identity: streaming sources
+        # reuse one part_keys list across batches, so steady-state ingest
+        # skips the O(K) Python loop entirely.  pids are cached, not rows:
+        # memory-pressure compaction remaps rows, and _pid_row picks that
+        # up per batch; evictions bump keys_epoch, invalidating the cache
+        # before a dead pid could be written to.
+        pk_list = batch.part_keys
+        nk = len(pk_list)
+        cache = self._key_resolve_cache
+        ent = cache.get(id(pk_list))
+        if (ent is not None and ent[0] is pk_list
+                and ent[2] == self.keys_epoch
+                and ent[3] == batch.schema.name and len(ent[1]) == nk):
+            cache[id(pk_list)] = cache.pop(id(pk_list))   # LRU touch
+            pids_for_key = ent[1]
+        else:
+            pids_for_key = np.full(nk, -1, dtype=np.int64)
+            self._key_resolve_cache[id(pk_list)] = (
+                pk_list, pids_for_key, self.keys_epoch, batch.schema.name)
+            while len(self._key_resolve_cache) > _KEY_RESOLVE_CACHE_MAX:
+                self._key_resolve_cache.pop(
+                    next(iter(self._key_resolve_cache)))
         uniq, first = np.unique(batch.part_idx, return_index=True)
-        traced_touched = []
-        for k, ts0 in zip(uniq.tolist(), batch.timestamps[first].tolist()):
-            try:
-                info = self.get_or_create_partition(
-                    batch.part_keys[k], batch.schema.name, ts0)
-            except QuotaReachedException:
-                # quota-rejected series: drop its records, count them
-                # (ref: TimeSeriesShard ingest QuotaReachedException handling)
-                self.stats.quota_dropped += 1
-                continue
-            rows_for_key[k] = info.row
-            if self._traced_pids and info.part_id in self._traced_pids:
-                traced_touched.append(info.part_id)
-        if traced_touched:
-            self._trace_touch("ingest", traced_touched,
-                              extra=f" offset={offset}")
-        rows = rows_for_key[batch.part_idx]
+        unresolved = uniq[pids_for_key[uniq] < 0]
+        if unresolved.size:
+            first_ts = dict(zip(uniq.tolist(),
+                                batch.timestamps[first].tolist()))
+            for k in unresolved.tolist():
+                try:
+                    info = self.get_or_create_partition(
+                        pk_list[k], batch.schema.name, first_ts[k])
+                except QuotaReachedException:
+                    # quota-rejected series: drop its records, count them
+                    # (ref: TimeSeriesShard ingest QuotaReachedException
+                    # handling); retried per batch, so a later quota raise
+                    # admits the series — the pid slot stays -1 until then
+                    self.stats.quota_dropped += 1
+                    continue
+                pids_for_key[k] = info.part_id
+        if self._traced_pids:
+            touched = pids_for_key[uniq]
+            traced_touched = [int(p) for p in touched[touched >= 0].tolist()
+                              if int(p) in self._traced_pids]
+            if traced_touched:
+                self._trace_touch("ingest", traced_touched,
+                                  extra=f" offset={offset}")
+        pid_sel = pids_for_key[batch.part_idx]
+        if self._pid_row.size == 0:        # every key quota-dropped
+            rows = np.full(pid_sel.shape, -1, dtype=np.int64)
+        else:
+            rows = np.where(pid_sel >= 0,
+                            self._pid_row[np.clip(pid_sel, 0, None)], -1)
         keep = rows >= 0
         if not keep.all():
             dropped = int((~keep).sum())
@@ -385,6 +426,7 @@ class TimeSeriesShard:
             # pids may be recycled from here on — invalidate any cache
             # keyed on (keys_serial, keys_epoch, pids)
             self.keys_epoch += 1
+            self._key_resolve_cache.clear()
         return len(pruned)
 
     def _do_flush_group(self, group: int, ingestion_time_ms: int) -> int:
@@ -853,6 +895,11 @@ class TimeSeriesShard:
                               self.schemas.part.options.shard_key_columns))
                 evicted += 1
                 self.stats.evictions += 1
+        if evicted:
+            # evicted keys left part_set — cached key->pid resolutions
+            # (ingest) and group-id entries must not outlive them
+            self.keys_epoch += 1
+            self._key_resolve_cache.clear()
         return evicted
 
     @property

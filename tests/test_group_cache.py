@@ -7,7 +7,9 @@ ref: the reference pays per-query grouping inside RangeVectorAggregator
 fastReduce); here grouping is hostside prep for a device segment-sum, so
 it is cacheable per working-set snapshot."""
 import numpy as np
+import pytest
 
+from filodb_tpu.core import shard as shard_mod
 from filodb_tpu.core.memstore import TimeSeriesMemStore
 from filodb_tpu.ingest.generator import counter_batch
 from filodb_tpu.parallel.shardmapper import ShardEvent, ShardMapper
@@ -18,6 +20,20 @@ from filodb_tpu.query.rangevector import RangeVectorKey
 START = 1_600_000_000_000
 
 
+@pytest.fixture(autouse=True)
+def _fresh_cache():
+    """The cache is process-global and serials are process-wide: earlier
+    tests may have left entries for any serial, so isolate each test."""
+    tr._HOST_GROUP_CACHE.clear()
+    yield
+    tr._HOST_GROUP_CACHE.clear()
+
+
+def _serial():
+    """A process-unique shard serial no real shard has used."""
+    return next(shard_mod._SHARD_KEYS_SERIAL)
+
+
 def _keys(n, tag="a"):
     return [RangeVectorKey((("_ns_", f"ns{i % 3}"), ("inst", f"{tag}{i}")))
             for i in range(n)]
@@ -25,7 +41,7 @@ def _keys(n, tag="a"):
 
 def test_cached_hit_returns_same_object():
     keys = _keys(10)
-    tok = (1, 0, b"pids")
+    tok = (_serial(), 0, b"pids")
     g1 = tr._group_ids_cached(tok, keys, ("_ns_",), ())
     g2 = tr._group_ids_cached(tok, keys, ("_ns_",), ())
     assert g1[0] is g2[0] and g1[1] is g2[1]          # dict hit, no rebuild
@@ -44,10 +60,11 @@ def test_token_none_bypasses_cache():
 
 def test_epoch_change_evicts_same_shard_entries():
     keys = _keys(8)
-    t0 = (7, 0, b"p")
+    ser = _serial()
+    t0 = (ser, 0, b"p")
     tr._group_ids_cached(t0, keys, ("_ns_",), ())
     assert (t0, ("_ns_",), ()) in tr._HOST_GROUP_CACHE
-    t1 = (7, 1, b"p")                       # same shard, bumped epoch
+    t1 = (ser, 1, b"p")                     # same shard, bumped epoch
     tr._group_ids_cached(t1, _keys(8, "b"), ("_ns_",), ())
     assert (t0, ("_ns_",), ()) not in tr._HOST_GROUP_CACHE
     assert (t1, ("_ns_",), ()) in tr._HOST_GROUP_CACHE
