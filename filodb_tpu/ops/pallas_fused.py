@@ -24,6 +24,7 @@ Works on CPU via interpret=True (tests); on TPU via the MXU.
 from __future__ import annotations
 
 import functools
+import os
 from typing import NamedTuple, Optional, Tuple
 
 import numpy as np
@@ -33,7 +34,91 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
 _LANE = 128
-_BS = 256          # series rows per grid step (VMEM-sized)
+_MIN_BS = 32
+try:
+    _BS = int(os.environ.get("FILODB_FUSED_BS", "256"))
+except ValueError:
+    raise ValueError(
+        f"FILODB_FUSED_BS={os.environ['FILODB_FUSED_BS']!r} is not an "
+        f"integer") from None
+"""Series rows per grid step (VMEM-sized).  Env-overridable for on-chip
+block-size sweeps (tools/tpu_tune.py); pick_block still shrinks from here
+whenever the VMEM estimate demands it."""
+if _BS < _MIN_BS or (_BS & (_BS - 1)):
+    raise ValueError(
+        f"FILODB_FUSED_BS={_BS} must be a power of two >= {_MIN_BS}: "
+        f"padding (pad_values) and the pick_block halving ladder both "
+        f"assume it, and a block below _MIN_BS would silently drop "
+        f"trailing series rows in interpret mode")
+
+_PRECISION = os.environ.get("FILODB_FUSED_PRECISION", "highest")
+"""MXU precision strategy for the kernel's matmuls — see _matmuls()."""
+if _PRECISION not in ("highest", "split"):
+    raise ValueError(
+        f"FILODB_FUSED_PRECISION={_PRECISION!r}: expected 'highest' or "
+        f"'split' (a typo here would silently mislabel a tuning sweep)")
+
+
+def _dot_hi(a, b):
+    return jnp.dot(a, b, preferred_element_type=jnp.float32,
+                   precision=jax.lax.Precision.HIGHEST)
+
+
+def _dot_1p(a, b):
+    """One bf16 MXU pass (f32 operands truncated), f32 accumulation."""
+    return jnp.dot(a, b, preferred_element_type=jnp.float32,
+                   precision=jax.lax.Precision.DEFAULT)
+
+
+def _split3(x):
+    """x == hi + mid + lo with hi/mid exactly bf16-representable and lo
+    carrying the last ~8 mantissa bits (its own bf16 truncation error is
+    ~|x|*2^-24, i.e. f32 epsilon)."""
+    hi = x.astype(jnp.bfloat16).astype(jnp.float32)
+    r = x - hi
+    mid = r.astype(jnp.bfloat16).astype(jnp.float32)
+    return hi, mid, r - mid
+
+
+def _matmuls():
+    """Per-operand MXU precision for the kernel's matmuls.
+
+    Every matmul in this kernel has at least one exact-in-bf16 operand:
+    the 0/1 selection/band/one-hot matrices, or a 0/1 validity mask.
+    Full f32 emulation (HIGHEST ~ 6 bf16 MXU passes) therefore wastes
+    passes on a side that cannot lose bits.  "split" mode decomposes the
+    VALUES operand into 3 bf16 terms (Mosaic rejects per-operand
+    `precision` tuples, so the decomposition HIGHEST would do internally
+    is spelled out) and runs 3 single-pass matmuls against the binary
+    operand: the hi/mid passes are exact, the lo pass carries ~f32-
+    epsilon truncation — the same |v|*2^-24 error the f32 *storage* of
+    the values already imposes on every path.  Binary x binary matmuls
+    (validity counts) are exact at DEFAULT outright (0/1 products, f32
+    MXU accumulation): 1 pass.  Returns (mmv, mmg, mmb): values x
+    binary, binary x values (group epilogue), binary x binary.
+
+    Measured on a real v5e (TPU_TUNE_r04.json, tools/tpu_tune.py): at
+    262k x 720 the split is NOT faster — dense p50 regressed ~20% (three
+    separate single-pass dots + the VPU decomposition schedule worse
+    than Mosaic's fused multi-pass emulation) and ragged gained only
+    ~6%, while results stayed bit-identical (max_rel_err 0.0).  The
+    kernel at production shapes is dispatch/bandwidth-bound, not
+    MXU-pass-bound, so "highest" stays the default; the knob remains
+    for re-sweeping on hardware without the per-call tunnel floor.
+    (Mosaic lowers only DEFAULT and HIGHEST; Precision.HIGH and
+    per-operand precision tuples are rejected.)"""
+    if _PRECISION != "split":
+        return _dot_hi, _dot_hi, _dot_hi
+
+    def mmv(a, b):
+        hi, mid, lo = _split3(a)
+        return _dot_1p(hi, b) + _dot_1p(mid, b) + _dot_1p(lo, b)
+
+    def mmg(a, b):
+        hi, mid, lo = _split3(b)
+        return _dot_1p(a, hi) + _dot_1p(a, mid) + _dot_1p(a, lo)
+
+    return mmv, mmg, _dot_1p
 
 
 def _pad_to(x: int, m: int) -> int:
@@ -175,12 +260,10 @@ def _kernel(vals_ref, vbase_ref, gids_ref, o1_ref, o2_ref, l1_ref, l2_ref,
             with_drops: bool, kind: str = "rate_family",
             ragged: bool = False, per_series: bool = False):
     v = vals_ref[:]                                   # [BS, Tp]
-    # HIGHEST: the MXU's default bf16 pass truncates f32 mantissas (1e-2
-    # relative error on counter magnitudes); the multi-pass f32 decomposition
-    # restores ~1e-7 at a small FLOP cost (these matmuls are tiny next to
-    # the HBM read)
-    mm = functools.partial(jnp.dot, preferred_element_type=jnp.float32,
-                           precision=jax.lax.Precision.HIGHEST)
+    # The MXU's default single bf16 pass truncates f32 mantissas (1e-2
+    # relative error on counter magnitudes); _matmuls() picks multi-pass
+    # f32 decompositions per operand — see its docstring.
+    mmv, mmg, mmb = _matmuls()
     if kind == "last_over_time":
         # instant-vector selector (`sum by (x) (metric)` with staleness
         # lookback): the last sample in each window is the o2 one-hot
@@ -190,14 +273,14 @@ def _kernel(vals_ref, vbase_ref, gids_ref, o1_ref, o2_ref, l1_ref, l2_ref,
         # hole to skip (unlike the rate family's range-vector filtering)
         if ragged:
             m = v == v
-            sel = mm(jnp.where(m, v, 0.0), o2_ref[:])
-            pres = mm(m.astype(jnp.float32), o2_ref[:])
+            sel = mmv(jnp.where(m, v, 0.0), o2_ref[:])
+            pres = mmb(m.astype(jnp.float32), o2_ref[:])
             out = (sel + vbase_ref[:]) * pres
-            _epilogue(mm, gids_ref, out, pres, out_refs, num_groups,
-                      per_series)
+            _epilogue(mmg, gids_ref, out, pres, out_refs, num_groups,
+                      per_series, mmb=mmb)
             return
-        out = mm(v, o2_ref[:]) + vbase_ref[:] * jnp.minimum(n_ref[:], 1.0)
-        _epilogue(mm, gids_ref, out, None, out_refs, num_groups, per_series)
+        out = mmv(v, o2_ref[:]) + vbase_ref[:] * jnp.minimum(n_ref[:], 1.0)
+        _epilogue(mmg, gids_ref, out, None, out_refs, num_groups, per_series)
         return
     if kind in ("sum_over_time", "avg_over_time", "count_over_time"):
         # window sums as ONE matmul against the band matrix
@@ -209,11 +292,11 @@ def _kernel(vals_ref, vbase_ref, gids_ref, o1_ref, o2_ref, l1_ref, l2_ref,
         band = l2_ref[:] - l1_ref[:] + o1_ref[:]
         if ragged:
             validf = (v == v).astype(jnp.float32)     # NaN-aware
-            s = mm(jnp.where(v == v, v, 0.0), band)
-            n = mm(validf, band)                      # [BS, Wp] valid counts
+            s = mmv(jnp.where(v == v, v, 0.0), band)
+            n = mmb(validf, band)                      # [BS, Wp] valid counts
             pres = (n > 0).astype(jnp.float32)
         else:
-            s = mm(v, band)
+            s = mmv(v, band)
             n = n_ref[:]                              # [1, Wp] true counts
             pres = None
         if kind == "sum_over_time":
@@ -229,7 +312,8 @@ def _kernel(vals_ref, vbase_ref, gids_ref, o1_ref, o2_ref, l1_ref, l2_ref,
                 # exist but hold only NaN emits 0, not absent (ref:
                 # AggrOverTimeFunctions.scala:367-382), unlike sum/avg
                 pres = (n_ref[:] > 0).astype(jnp.float32) * jnp.ones_like(s)
-        _epilogue(mm, gids_ref, out, pres, out_refs, num_groups, per_series)
+        _epilogue(mmg, gids_ref, out, pres, out_refs, num_groups,
+                  per_series, mmb=mmb)
         return
     pres = None
     if ragged:
@@ -257,16 +341,16 @@ def _kernel(vals_ref, vbase_ref, gids_ref, o1_ref, o2_ref, l1_ref, l2_ref,
         f_c, f_t, _ = _fill_scan2(c, tsb, m, left=False)
         b_c, b_t, _ = _fill_scan2(c, tsb, m, left=True)
         band = l2_ref[:] - l1_ref[:] + o1_ref[:]
-        nv = mm(m.astype(jnp.float32), band)          # [BS, Wp] valid count
-        v1 = mm(b_c, o1_ref[:])
-        v2 = mm(f_c, o2_ref[:])
-        t1 = mm(b_t, o1_ref[:])
-        t2 = mm(f_t, o2_ref[:])
+        nv = mmb(m.astype(jnp.float32), band)          # [BS, Wp] valid count
+        v1 = mmv(b_c, o1_ref[:])
+        v2 = mmv(f_c, o2_ref[:])
+        t1 = mmv(b_t, o1_ref[:])
+        t2 = mmv(f_t, o2_ref[:])
         n = jnp.maximum(nv, 2.0)                      # math-safe; masked
         pres = (nv >= 2.0).astype(jnp.float32)
     else:
-        v1 = mm(v, o1_ref[:])                         # [BS, Wp]
-        v2 = mm(v, o2_ref[:])
+        v1 = mmv(v, o1_ref[:])                         # [BS, Wp]
+        v2 = mmv(v, o2_ref[:])
         if with_drops:
             prev = jnp.concatenate([v[:, :1], v[:, :-1]], axis=1)
             # first column has no predecessor; padded tail columns are
@@ -276,8 +360,8 @@ def _kernel(vals_ref, vbase_ref, gids_ref, o1_ref, o2_ref, l1_ref, l2_ref,
             d = jnp.where(v < prev, prev + vbase_ref[:], 0.0)
             col = jax.lax.broadcasted_iota(jnp.int32, d.shape, 1)
             d = jnp.where(col == 0, 0.0, d)
-            v1 = v1 + mm(d, l1_ref[:])
-            v2 = v2 + mm(d, l2_ref[:])
+            v1 = v1 + mmv(d, l1_ref[:])
+            v2 = v2 + mmv(d, l2_ref[:])
         t1, t2 = t1_ref[:], t2_ref[:]                 # [1, Wp]
         n = n_ref[:]
     ws, we = ws_ref[:], we_ref[:]
@@ -302,11 +386,12 @@ def _kernel(vals_ref, vbase_ref, gids_ref, o1_ref, o2_ref, l1_ref, l2_ref,
     if pres is not None:
         out = out * pres                              # no NaN into the MXU
 
-    _epilogue(mm, gids_ref, out, pres, out_refs, num_groups, per_series)
+    _epilogue(mmg, gids_ref, out, pres, out_refs, num_groups,
+              per_series, mmb=mmb)
 
 
 def _epilogue(mm, gids_ref, out, pres, out_refs, num_groups: int,
-              per_series: bool):
+              per_series: bool, mmb=None):
     """Shared epilogue.  Group mode: one-hot segment-sum on the MXU,
     accumulated across sequential grid steps (pad rows carry gid -1: no
     match); `pres` (ragged presence [BS, Wp]) feeds a second accumulated
@@ -331,7 +416,8 @@ def _epilogue(mm, gids_ref, out, pres, out_refs, num_groups: int,
             r[:] = jnp.zeros_like(r)
     out_refs[0][:] += part
     if pres is not None:
-        out_refs[1][:] += mm(onehot, pres)
+        # presence is 0/1 x 0/1: the binary matmul is exact in one pass
+        out_refs[1][:] += (mmb or mm)(onehot, pres)
 
 
 @functools.partial(jax.jit, static_argnames=(
@@ -422,9 +508,6 @@ def vmem_estimate(Tp: int, Wp: int, Gp: int,
     group = Gp * (Wp * 8 + bs * 4)
     inter = 12 * bs * Wp * 4
     return sel + vals + group + inter
-
-
-_MIN_BS = 32
 
 
 def pick_block(Tp: int, Wp: int, Gp: int, over_time: bool = False,

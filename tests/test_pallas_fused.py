@@ -558,6 +558,8 @@ def test_pick_block_adaptive():
     said 13M — the calibrated model must divert THAT shape to a smaller
     block and keep the dense kernel at the full block."""
     from filodb_tpu.ops import pallas_fused as pf
+    if pf._BS != 256:
+        pytest.skip("FILODB_FUSED_BS overrides the block this test models")
     assert pf.pick_block(768, 128, 1000, False, False) == pf._BS
     bs = pf.pick_block(768, 128, 1000, False, True)
     assert bs is not None and bs < pf._BS
@@ -601,3 +603,46 @@ def test_fused_ragged_rate_long_rows():
     assert (np.isnan(got) == np.isnan(want)).all()
     np.testing.assert_allclose(got, want, rtol=5e-4, atol=1e-4,
                                equal_nan=True)
+
+
+def test_split_precision_matches_highest_interpret(monkeypatch):
+    """The FILODB_FUSED_PRECISION=split decomposition (ops/pallas_fused.
+    _matmuls) must produce the same results as the all-HIGHEST default —
+    in interpret mode, so a future edit that breaks the mmv/mmg operand-
+    order convention (or _split3 itself) fails here instead of only as
+    wrong numbers in the next on-chip sweep.  jit caches don't key on the
+    module-level knob, so they are cleared around each flip."""
+    import jax
+    from filodb_tpu.ops import pallas_fused as pf
+    ts_row, raw, gids = _mk(S=48, T=96, G=4)
+    G, range_ms = 4, 30 * START_STEP
+    wends = make_window_ends(40 * START_STEP, 90 * START_STEP,
+                             6 * START_STEP)
+    plan = build_plan(ts_row, wends, range_ms)
+    reb, vbase = rebase_values(raw, True)
+    vals32 = reb.astype(np.float32)
+    vb32 = vbase.astype(np.float32)
+    ragged_vals = vals32.copy()
+    ragged_vals[np.random.default_rng(5).random(vals32.shape) < 0.2] = np.nan
+
+    def run_all():
+        out = []
+        for vals, ragged in ((vals32, False), (ragged_vals, True)):
+            sums, counts = fused_rate_groupsum(
+                vals, vb32, gids, plan, G, fn_name="rate",
+                precorrected=True, interpret=True, ragged=ragged)
+            out.append(present_sum(sums, counts))
+        return out
+
+    base = run_all()
+    monkeypatch.setattr(pf, "_PRECISION", "split")
+    jax.clear_caches()
+    try:
+        split = run_all()
+    finally:
+        monkeypatch.undo()
+        jax.clear_caches()
+    for b, s in zip(base, split):
+        assert (np.isnan(b) == np.isnan(s)).all()
+        np.testing.assert_allclose(s, b, rtol=1e-5, atol=1e-6,
+                                   equal_nan=True)

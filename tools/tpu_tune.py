@@ -1,0 +1,137 @@
+#!/usr/bin/env python
+"""On-chip A/B sweep of fused-kernel MXU precision and series-block size.
+
+The round-4 roofline capture (TPU_EXTRA_r04.json) showed the fused kernel
+at ~27% MFU against the f32-HIGHEST matmul roofline it runs at — MXU
+passes, not bandwidth, are a visible fraction of device time.  Every
+matmul in the kernel has one exact-in-bf16 operand (0/1 selection/band/
+one-hot matrices), so per-operand precision (ops/pallas_fused._matmuls)
+should halve the MXU passes with no accuracy loss.  This script measures
+that ON CHIP: each variant runs in a subprocess (the knobs are read at
+import) over identical seeded data, and the parent compares p50 latency
+and max relative error vs the all-HIGHEST baseline.
+
+Usage: python tools/tpu_tune.py [S] (default 262144; refuses non-TPU).
+Writes TPU_TUNE_r04.json incrementally.
+"""
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+OUT = os.path.join(REPO, "TPU_TUNE_r04.json")
+
+VARIANTS = [
+    ("base", {"FILODB_FUSED_PRECISION": "highest", "FILODB_FUSED_BS": "256"}),
+    ("split", {"FILODB_FUSED_PRECISION": "split", "FILODB_FUSED_BS": "256"}),
+    ("bs512", {"FILODB_FUSED_PRECISION": "highest", "FILODB_FUSED_BS": "512"}),
+    ("split512", {"FILODB_FUSED_PRECISION": "split",
+                  "FILODB_FUSED_BS": "512"}),
+]
+
+CHILD = r"""
+import json, os, sys, time
+import numpy as np
+sys.path.insert(0, %(repo)r)
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
+                      os.path.join(%(repo)r, ".jax_cache"))
+import jax
+assert jax.devices()[0].platform != "cpu", "needs the TPU tunnel"
+from filodb_tpu.ops import pallas_fused as pf
+from filodb_tpu.ops.timewindow import make_window_ends
+
+S, T, G = %(S)d, 720, 1000
+ragged = %(ragged)r
+rng = np.random.default_rng(7)
+ts_row = (600_000 + 10_000 * np.arange(T)).astype(np.int64)
+# leaf-path parity (bench.py): host pre-corrected counters -> monotone
+# rebased values on device, precorrected=True (with_drops=False dense)
+incr = rng.random((S, T), np.float32) * 10.0
+vals = np.cumsum(incr, axis=1, dtype=np.float64).astype(np.float32)
+if ragged:
+    vals[rng.random((S, T)) < 0.10] = np.nan
+vbase = np.zeros(S, np.float32)
+gids = (np.arange(S) %% G).astype(np.int32)
+wends = make_window_ends(600_000, int(ts_row[-1]), 60_000)
+range_ms = 300_000
+plan = pf.build_plan(ts_row, np.asarray(wends, np.int64), range_ms)
+prep = pf.pad_inputs(vals, vbase, gids, plan, G)
+
+def run():
+    sums, counts = pf.fused_rate_groupsum(
+        None, None, None, plan, G, "rate", True, prepared=prep,
+        ragged=ragged)
+    return pf.present_sum(sums, counts)
+
+t0 = time.perf_counter()
+res = run()
+compile_s = time.perf_counter() - t0
+times = []
+for _ in range(15):
+    t0 = time.perf_counter(); run(); times.append(time.perf_counter() - t0)
+times.sort()
+p50 = times[len(times) // 2]
+# samples scanned per query: grid slots from the earliest window start
+# to the last window end, per series (this grid starts AT the first
+# window end, so all T slots are covered -- don't copy tpu_extra's 690)
+lo = np.searchsorted(ts_row, int(wends[0]) - range_ms)
+hi = np.searchsorted(ts_row, int(wends[-1]), side="right")
+span = S * int(hi - lo)
+np.save(%(resfile)r, res)
+print(json.dumps({"p50_s": round(p50, 5), "compile_s": round(compile_s, 2),
+                  "samples_per_sec": round(span / p50, 1),
+                  "min_s": round(times[0], 5)}))
+"""
+
+
+def main():
+    S = int(sys.argv[1]) if len(sys.argv) > 1 else 262_144
+    doc = {"utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+           "series": S, "samples_per_series": 720, "groups": 1000,
+           "variants": {}}
+    import numpy as np
+    for ragged in (False, True):
+        tag = "ragged" if ragged else "dense"
+        base_res = None
+        for name, env in VARIANTS:
+            resfile = f"/tmp/tune_{tag}_{name}.npy"
+            child_env = dict(os.environ, **env)
+            code = CHILD % {"repo": REPO, "S": S, "mode": name,
+                            "ragged": ragged, "resfile": resfile}
+            t0 = time.perf_counter()
+            r = subprocess.run([sys.executable, "-c", code],
+                               capture_output=True, text=True, timeout=1800,
+                               env=child_env)
+            key = f"{tag}_{name}"
+            if r.returncode != 0:
+                doc["variants"][key] = {"error": r.stderr[-1200:]}
+                print(f"{key}: FAILED\n{r.stderr[-1200:]}")
+            else:
+                rec = json.loads(r.stdout.strip().splitlines()[-1])
+                res = np.load(resfile)
+                if base_res is None and name == "base":
+                    base_res = res
+                if base_res is None and name != "base":
+                    # never let a sweep read as "faster AND conformant"
+                    # when the conformance reference failed to run
+                    rec["max_rel_err_vs_base"] = "base-missing"
+                if base_res is not None and name != "base":
+                    same_nan = bool((np.isnan(res) == np.isnan(base_res))
+                                    .all())
+                    err = float(np.nanmax(
+                        np.abs(res - base_res)
+                        / np.maximum(np.abs(base_res), 1e-6)))
+                    rec["max_rel_err_vs_base"] = (round(err, 9) if same_nan
+                                                  else "nan-mismatch")
+                rec["wall_s"] = round(time.perf_counter() - t0, 1)
+                doc["variants"][key] = rec
+                print(f"{key}: {rec}")
+            with open(OUT, "w") as f:
+                json.dump(doc, f, indent=1)
+    print(json.dumps(doc, indent=1))
+
+
+if __name__ == "__main__":
+    main()
