@@ -170,6 +170,57 @@ def bench_query(quick: bool):
               series=S)
 
 
+def bench_dashboard_batch(quick: bool):
+    """Dashboard panel throughput: P fused panels over one window grid,
+    batched into merged kernel dispatches (engine.query_range_batch)
+    vs issued one at a time.  The round-4 on-chip finding: a fused leaf
+    query is dispatch-bound, so batching is where dashboard latency goes
+    (doc/kernels.md; no reference analogue — iterator engines pay
+    per-series either way)."""
+    import os
+    had = os.environ.get("FILODB_TPU_FUSED_INTERPRET")
+    os.environ["FILODB_TPU_FUSED_INTERPRET"] = "1"
+    S, T = (2_000, 240) if quick else (20_000, 720)
+    eng = _mk_query_engine(S, T, quick)
+    s = START // 1000
+    end = s + T * 10
+    panels = ['sum(rate(request_total[5m])) by (_ns_)',
+              'avg(rate(request_total[5m])) by (dc)',
+              'sum(rate(request_total[5m])) by (_ns_, dc)',
+              'count(rate(request_total[5m])) by (dc)',
+              'min(rate(request_total[5m])) by (_ns_)',
+              'max(rate(request_total[5m])) by (dc)',
+              'sum(rate(request_total[5m])) by (dc)',
+              'sum(rate(request_total[5m])) by (instance)']
+    args = (s + 600, 60, end)
+
+    def seq():
+        for q in panels:
+            assert eng.query_range(q, *args).error is None
+
+    def batch():
+        for r in eng.query_range_batch(panels, *args):
+            assert r.error is None
+
+    try:
+        seq(); batch()                   # warm mirror + caches
+        iters = 3 if quick else 10
+        t_seq = _time_it(seq, iters)
+        t_batch = _time_it(batch, iters)
+    finally:
+        # restore: leaking interpret mode would silently reroute every
+        # later bench's queries through the interpret fused path
+        if had is None:
+            os.environ.pop("FILODB_TPU_FUSED_INTERPRET", None)
+        else:
+            os.environ["FILODB_TPU_FUSED_INTERPRET"] = had
+    _emit("dashboard_batch", "sequential_panels_per_s",
+          len(panels) / t_seq, "panels/s", series=S)
+    _emit("dashboard_batch", "batched_panels_per_s",
+          len(panels) / t_batch, "panels/s", series=S,
+          speedup=round(t_seq / t_batch, 2))
+
+
 def bench_query_hicard(quick: bool):
     """Single-shard high-cardinality scan
     (ref: QueryHiCardInMemoryBenchmark.scala)."""
@@ -705,6 +756,7 @@ BENCHES: Dict[str, Callable[[bool], None]] = {
     "gateway": bench_gateway,
     "query": bench_query,
     "query_hicard": bench_query_hicard,
+    "dashboard_batch": bench_dashboard_batch,
     "query_1m": bench_query_1m,
     "query_odp": bench_query_odp,
     "partition_list": bench_partition_list,

@@ -103,6 +103,28 @@ class PromHttpApi:
             if res.trace_id:
                 payload["traceID"] = res.trace_id
             return (200 if payload["status"] == "success" else 400), payload
+        if rest == ["query_range_batch"] and method == "POST":
+            # dashboard batch: JSON {"queries": [...], "start", "step",
+            # "end"} -> list of prom matrix payloads, compatible fused
+            # leaves merged into single kernel dispatches
+            # (QueryEngine.query_range_batch)
+            import json as _json
+            try:
+                req = _json.loads(body.decode() or "{}")
+                queries = list(req["queries"])
+                start, end = float(req["start"]), float(req["end"])
+                step = max(float(req.get("step", 15)), 1)
+            except (KeyError, TypeError, ValueError) as e:
+                raise _BadRequest(f"bad batch request: {e}") from None
+            results = eng.query_range_batch(queries, start, step, end,
+                                            planner_params)
+            payloads = []
+            for res in results:
+                p = QueryEngine.to_prom_matrix(res)
+                if res.trace_id:
+                    p["traceID"] = res.trace_id
+                payloads.append(p)
+            return 200, {"status": "success", "results": payloads}
         if rest == ["query"]:
             q = params.get("query", "")
             t = _num_param(params, "time", "0")

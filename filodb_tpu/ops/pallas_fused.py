@@ -404,10 +404,15 @@ def _epilogue(mm, gids_ref, out, pres, out_refs, num_groups: int,
         if pres is not None:
             out_refs[1][:] = pres
         return
-    gids = gids_ref[:]                                # [BS, 1] int32
+    gids = gids_ref[:]                                # [BS, P] int32
     groups = jax.lax.broadcasted_iota(jnp.int32, (num_groups, out.shape[0]),
                                       0)
     onehot = (groups == gids[:, 0][None, :]).astype(jnp.float32)
+    # multi-grouping batch (merge_groups): each extra column is another
+    # panel's grouping over DISJOINT group-id ranges, so the sum stays a
+    # 0/1 matrix and P dashboard panels ride ONE kernel dispatch
+    for p in range(1, gids.shape[1]):
+        onehot = onehot + (groups == gids[:, p][None, :]).astype(jnp.float32)
     part = mm(onehot, out)                            # [Gp, Wp]
 
     @pl.when(pl.program_id(0) == 0)
@@ -454,6 +459,8 @@ def _run(vals_p, vbase_p, gids_p, o1, o2, l1, l2, t1, t2, n, ws, we, ts,
     space = {} if interpret else {"memory_space": pltpu.VMEM}
     row_spec = pl.BlockSpec((bs, Tp), lambda i: (i, 0), **space)
     col_spec = pl.BlockSpec((bs, 1), lambda i: (i, 0), **space)
+    # gids may carry P grouping columns (multi-panel batch, merge_groups)
+    gid_spec = pl.BlockSpec((bs, gids_p.shape[1]), lambda i: (i, 0), **space)
     fix = lambda shape: pl.BlockSpec(shape, lambda i: (0, 0), **space)  # noqa: E731
     kern = functools.partial(_kernel, num_groups=Gp, is_counter=is_counter,
                              is_rate=is_rate, with_drops=with_drops,
@@ -470,7 +477,7 @@ def _run(vals_p, vbase_p, gids_p, o1, o2, l1, l2, t1, t2, n, ws, we, ts,
     return pl.pallas_call(
         kern,
         grid=(grid,),
-        in_specs=[row_spec, col_spec, col_spec,
+        in_specs=[row_spec, col_spec, gid_spec,
                   fix((Tp, Wp)), fix((Tp, Wp)), fix((Tp, Wp)), fix((Tp, Wp)),
                   fix((1, Wp)), fix((1, Wp)), fix((1, Wp)), fix((1, Wp)),
                   fix((1, Wp)), fix((1, Tp))],
@@ -763,18 +770,55 @@ def fused_leaf_agg(plan: FusedPlan, prepared: PreparedInputs,
     the matmul kernel path.  agg sum/avg/count ride the group matmul;
     agg min/max use the kernel's per-series output mode plus an XLA
     segment reduction (ops/agg.map_phase) on the small [S, W] result.
-    """
+    Single-panel form of fused_leaf_agg_batch."""
+    values = PaddedValues(prepared.vals_p, prepared.vbase_p)
+    groups = PaddedGroups(prepared.gids_p, prepared.gsize)
+    return fused_leaf_agg_batch(
+        plan, values, [(groups, num_groups, agg_op)], fn_name,
+        precorrected=precorrected, interpret=interpret, ragged=ragged,
+        num_series=len(gids))[0]
+
+
+def merge_groups(groups_list, num_groups_list):
+    """Stack P panel groupings into one [Sp, P] gid matrix over DISJOINT
+    group-id ranges (panel p's ids are offset by sum of earlier panels'
+    group counts; -1 pad rows stay -1).  The kernel epilogue turns the
+    columns into one multi-hot matrix, so P groupings cost ONE dispatch.
+    Returns (gids_multi, offsets, total_groups)."""
+    cols, offsets, off = [], [], 0
+    for g, n in zip(groups_list, num_groups_list):
+        col = g.gids_p[:, 0]
+        cols.append(jnp.where(col >= 0, col + off, -1))
+        offsets.append(off)
+        off += int(n)
+    return jnp.stack(cols, axis=1), offsets, off
+
+
+def fused_leaf_agg_batch(plan: FusedPlan, values: PaddedValues, panels,
+                         fn_name: str, precorrected: bool = False,
+                         interpret: bool = False, ragged: bool = False,
+                         num_series: Optional[int] = None):
+    """Evaluate P aggregation panels over ONE working set in at most two
+    kernel dispatches — the dashboard case (same metric + window grid,
+    different `by (...)` groupings / agg ops), where the per-call
+    dispatch latency dominates device time (doc/kernels.md).
+
+    panels: [(PaddedGroups, num_groups, agg_op)].  All panels share
+    (plan, values, fn_name, precorrected, ragged).  sum/avg/count panels
+    merge into one group-mode run via merge_groups (disjoint id spaces,
+    multi-hot epilogue); min/max panels share one per-series-mode run
+    finished by per-panel XLA segment reductions; dense count panels are
+    host-only math.  Returns per-panel [G, W, C] float64 components in
+    input order (ops/agg.AGGREGATORS layout)."""
     is_counter = fn_name in ("rate", "increase")
     is_rate = fn_name == "rate"
     with_drops = is_counter and not precorrected
     over_time = fn_name in OVER_TIME_FNS
     kind = fn_name if over_time else "rate_family"
-    Gp = _pad_to(max(num_groups, 8), 8)
     wvalid = plan.wvalid1 if over_time else plan.wvalid
-    S = len(gids)
 
-    def run(per_series):
-        return _run(prepared.vals_p, prepared.vbase_p, prepared.gids_p,
+    def run(gids_p, Gp, per_series):
+        return _run(values.vals_p, values.vbase_p, gids_p,
                     *(jnp.asarray(m) for m in
                       (plan.o1, plan.o2, plan.l1, plan.l2, plan.t1,
                        plan.t2, plan.n1 if over_time else plan.n,
@@ -783,36 +827,60 @@ def fused_leaf_agg(plan: FusedPlan, prepared: PreparedInputs,
                     with_drops=with_drops, interpret=interpret, kind=kind,
                     ragged=ragged, per_series=per_series)
 
-    if agg_op in ("sum", "avg"):
-        res = run(per_series=False)
+    def dense_counts(groups):
+        return groups.gsize[:, None].astype(np.float64) * \
+            wvalid[None, :].astype(np.float64)
+
+    mm_idx = [i for i, (_, _, op) in enumerate(panels)
+              if op in ("sum", "avg") or (op == "count" and ragged)]
+    ps_idx = [i for i, (_, _, op) in enumerate(panels)
+              if op in ("min", "max")]
+    bad = [op for _, _, op in panels
+           if op not in ("sum", "avg", "count", "min", "max")]
+    if bad:
+        raise ValueError(f"unsupported fused agg {bad[0]}")
+
+    out: list = [None] * len(panels)
+    if mm_idx:
+        gids_multi, offsets, total = merge_groups(
+            [panels[i][0] for i in mm_idx], [panels[i][1] for i in mm_idx])
+        Gp = _pad_to(max(total, 8), 8)
+        res = run(gids_multi, Gp, per_series=False)
         if ragged:
-            sums, cnts = res
-            sums = np.asarray(sums, np.float64)[:num_groups, :plan.W]
-            counts = np.asarray(cnts, np.float64)[:num_groups, :plan.W]
+            sums_all, cnts_all = (np.asarray(r, np.float64) for r in res)
         else:
-            sums = np.asarray(res, np.float64)[:num_groups, :plan.W]
-            counts = prepared.gsize[:, None].astype(np.float64) * \
-                wvalid[None, :].astype(np.float64)
-        return np.stack([sums * (counts > 0), counts], axis=-1)
-    if agg_op == "count":
-        if not ragged:
-            counts = prepared.gsize[:, None].astype(np.float64) * \
-                wvalid[None, :].astype(np.float64)
-        else:
-            _, cnts = run(per_series=False)
-            counts = np.asarray(cnts, np.float64)[:num_groups, :plan.W]
-        return counts[..., None]
-    if agg_op in ("min", "max"):
-        res = run(per_series=True)
+            sums_all = np.asarray(res, np.float64)
+            cnts_all = None
+        for j, i in enumerate(mm_idx):
+            groups, G, op = panels[i]
+            lo = offsets[j]
+            sums = sums_all[lo:lo + G, :plan.W]
+            counts = (cnts_all[lo:lo + G, :plan.W] if ragged
+                      else dense_counts(groups))
+            if op == "count":
+                out[i] = counts[..., None]
+            else:
+                out[i] = np.stack([sums * (counts > 0), counts], axis=-1)
+    if ps_idx:
+        from filodb_tpu.ops import agg as agg_ops
+        S = num_series
+        if S is None:
+            gp0 = panels[ps_idx[0]][0].gids_p[:, 0]
+            S = int(np.asarray(gp0 >= 0).sum())
+        # one shared per-series run: the [S, W] output is group-agnostic
+        res = run(panels[ps_idx[0]][0].gids_p, 8, per_series=True)
         if ragged:
-            per, pres = res
-            per = jnp.where(pres[:S, :plan.W] > 0, per[:S, :plan.W],
+            per_raw, pres = res
+            per = jnp.where(pres[:S, :plan.W] > 0, per_raw[:S, :plan.W],
                             jnp.nan)
         else:
             per = jnp.where(jnp.asarray(wvalid)[None, :],
                             res[:S, :plan.W], jnp.nan)
-        from filodb_tpu.ops import agg as agg_ops
-        comp = agg_ops.map_phase(agg_op, per, jnp.asarray(gids, jnp.int32),
-                                 num_groups)
-        return np.asarray(comp, np.float64)
-    raise ValueError(f"unsupported fused agg {agg_op}")
+        for i in ps_idx:
+            groups, G, op = panels[i]
+            comp = agg_ops.map_phase(op, per, groups.gids_p[:S, 0], G)
+            out[i] = np.asarray(comp, np.float64)
+    for i, (groups, G, op) in enumerate(panels):
+        if out[i] is None:              # dense count: pure host math
+            out[i] = dense_counts(groups)[..., None]
+    return out

@@ -54,6 +54,78 @@ class QueryEngine:
                       ) -> QueryResult:
         return self.query_range(promql, time_s, 1, time_s, planner_params)
 
+    def query_range_batch(self, promqls: List[str], start_s: int,
+                          step_s: int, end_s: int,
+                          planner_params: Optional[PlannerParams] = None
+                          ) -> List[QueryResult]:
+        """Evaluate a dashboard's worth of queries over one time grid,
+        merging compatible fused leaves into single kernel dispatches.
+
+        The round-4 on-chip measurements (doc/kernels.md) show a fused
+        leaf query is dominated by per-call dispatch latency, not device
+        time — so P panels over the same working set and window grid
+        should cost ONE dispatch, not P.  Three phases: (1) every
+        in-process MultiSchemaPartitionsExec leaf runs its gather + fused
+        preflight (prepare_fused), parking the gathered data; (2)
+        compatible FusedCalls merge via leafexec.finish_fused_calls
+        (disjoint-group multi-hot epilogue, at most two dispatches per
+        compatible set); (3) each tree executes normally, leaves reusing
+        the parked data and injected partials.  Queries that don't fit
+        the pattern (parse errors, metadata plans, non-fusable shapes,
+        remote-dispatched leaves) take their normal paths unchanged.
+
+        The reference has no analogue — its iterator engine pays per-
+        series cost either way; this is a TPU-shaped throughput feature
+        (amortizing dispatch the way the MXU amortizes FLOPs).
+        """
+        from filodb_tpu.query.execbase import InProcessPlanDispatcher
+        from filodb_tpu.query.leafexec import (MultiSchemaPartitionsExec,
+                                               finish_fused_calls)
+        results: List[Optional[QueryResult]] = [None] * len(promqls)
+        entries = []
+        for i, q in enumerate(promqls):
+            try:
+                plan = query_range_to_logical_plan(
+                    q, TimeStepParams(start_s, step_s, end_s))
+            except Exception as e:  # noqa: BLE001
+                results[i] = QueryResult([], error=f"parse error: {e}")
+                continue
+            if isinstance(plan, lp.MetadataQueryPlan):
+                results[i] = self.exec_logical_plan(plan, planner_params)
+                continue
+            ctx = self._ctx(planner_params)
+            try:
+                ep = self.planner.materialize(plan, ctx)
+            except Exception as e:  # noqa: BLE001
+                results[i] = QueryResult([], error=f"planning error: {e}")
+                continue
+            entries.append((i, ep, ctx))
+        calls = []
+        for _, ep, _ in entries:
+            for leaf in _walk_plan(ep):
+                if isinstance(leaf, MultiSchemaPartitionsExec) and \
+                        isinstance(leaf.dispatcher, InProcessPlanDispatcher):
+                    try:
+                        fc = leaf.prepare_fused(self.source)
+                    except Exception:  # noqa: BLE001 — leaf will re-execute
+                        leaf._prefused = None
+                        fc = None
+                    if fc is not None:
+                        calls.append((leaf, fc))
+        if calls:
+            try:
+                partials = finish_fused_calls([fc for _, fc in calls])
+            except Exception:  # noqa: BLE001 — leaves finish standalone
+                partials = [None] * len(calls)
+            for (leaf, fc), partial in zip(calls, partials):
+                if partial is not None:
+                    leaf.inject_fused(partial)
+        for i, ep, ctx in entries:
+            res = ep.execute(self.source)
+            res.trace_id = ctx.query_id
+            results[i] = res
+        return results
+
     def exec_logical_plan(self, plan: lp.LogicalPlan,
                           planner_params: Optional[PlannerParams] = None
                           ) -> QueryResult:
@@ -107,6 +179,13 @@ class QueryEngine:
                             "value": [int(wends[-1]) / 1000.0, _fmt(v)]})
         return {"status": "success",
                 "data": {"resultType": "vector", "result": out}}
+
+
+def _walk_plan(ep):
+    """Yield every node of an exec tree (pre-order)."""
+    yield ep
+    for c in ep.children:
+        yield from _walk_plan(c)
 
 
 def _prom_labels(labels: Dict[str, str]) -> Dict[str, str]:

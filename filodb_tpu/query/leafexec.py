@@ -40,6 +40,99 @@ from filodb_tpu.query.transformers import (
     _group_ids)
 
 
+@dataclasses.dataclass
+class FusedCall:
+    """A fused matmul-kernel leaf evaluation with everything resolved
+    except the kernel dispatch itself — the unit of merging for
+    engine.query_range_batch.  Compatible calls (same plan + device
+    values + function flavor) become panels of ONE
+    ops/pallas_fused.fused_leaf_agg_batch dispatch: the dashboard case,
+    where per-call dispatch latency dominates device time
+    (doc/kernels.md round-4 measurements)."""
+    plan: object                  # pf.FusedPlan
+    values: object                # pf.PaddedValues (device-resident)
+    groups: object                # pf.PaddedGroups
+    gkeys: List
+    wends: np.ndarray
+    fn: str
+    op: str
+    precorrected: bool
+    interpret: bool
+    ragged: bool
+    num_series: int
+    # semantic identity (mirror serial + snapshot gen + column + row
+    # subset + window params): lets equal-but-distinct plan/values
+    # objects merge when the LRU caches declined to share them
+    cache_key: Optional[tuple] = None
+
+    def compat_key(self):
+        base = (self.fn, self.precorrected, self.interpret, self.ragged)
+        if self.cache_key is not None:
+            return ("k",) + base + (self.cache_key,)
+        return ("id",) + base + (id(self.plan), id(self.values.vals_p))
+
+
+def finish_fused_calls(calls: List[FusedCall]) -> List[AggPartial]:
+    """Phase-2 of engine.query_range_batch: dispatch every FusedCall,
+    merging compatible ones into single kernel launches.  A merged set
+    whose combined group count would blow the VMEM budget is split back
+    into singleton dispatches instead of degrading to the general path
+    (the per-panel gate in _try_fused already passed)."""
+    from filodb_tpu.ops import pallas_fused as pf
+    out: List[Optional[AggPartial]] = [None] * len(calls)
+    by_key: Dict[tuple, List[int]] = {}
+    for i, fc in enumerate(calls):
+        by_key.setdefault(fc.compat_key(), []).append(i)
+    for idxs in by_key.values():
+        fc0 = calls[idxs[0]]
+        while idxs:
+            take = idxs
+            def in_group_mode(i):
+                # which panels join the merged group-mode dispatch: min/max
+                # run per-series (Gp-independent) and dense count is host
+                # math, so neither counts toward the multi-hot group total
+                op = calls[i].op
+                return op in ("sum", "avg") or (op == "count" and fc0.ragged)
+
+            if len(idxs) > 1:
+                Tp = fc0.plan.Tp
+                Wp = pf._pad_to(max(fc0.plan.W, 1), pf._LANE)
+                over_time = fc0.fn in pf.OVER_TIME_FNS
+                ragged_rate = fc0.ragged and fc0.fn in ("rate", "increase",
+                                                        "delta")
+                while len(take) > 1:
+                    total = sum(len(calls[i].gkeys) for i in take
+                                if in_group_mode(i))
+                    if total == 0 or pf.pick_block(
+                            Tp, Wp, pf._pad_to(max(total, 8), 8),
+                            over_time, ragged_rate) is not None:
+                        break
+                    take = take[:max(1, len(take) // 2)]
+            panels = [(calls[i].groups, len(calls[i].gkeys), calls[i].op)
+                      for i in take]
+            if len(take) > 1:
+                # observability of the batching win: actual kernel
+                # launches this merged set costs (group-mode + per-series
+                # mode), and how many panels shared them
+                from filodb_tpu.utils.metrics import registry
+                launches = (any(in_group_mode(i) for i in take)
+                            + any(calls[i].op in ("min", "max")
+                                  for i in take))
+                registry.counter("fused_batch_dispatches") \
+                    .increment(launches)
+                registry.counter("fused_batch_merged_panels") \
+                    .increment(len(take))
+            comps = pf.fused_leaf_agg_batch(
+                fc0.plan, fc0.values, panels, fc0.fn,
+                precorrected=fc0.precorrected, interpret=fc0.interpret,
+                ragged=fc0.ragged, num_series=fc0.num_series)
+            for i, comp in zip(take, comps):
+                out[i] = AggPartial(calls[i].op, calls[i].gkeys,
+                                    calls[i].wends, comp=comp)
+            idxs = idxs[len(take):]
+    return out
+
+
 class MultiSchemaPartitionsExec(LeafExecPlan):
     """Leaf: index lookup + dense gather on the owning shard
     (ref: exec/MultiSchemaPartitionsExec.scala:27-60,
@@ -58,22 +151,35 @@ class MultiSchemaPartitionsExec(LeafExecPlan):
         self.columns = list(columns)
         self.schema = schema
         self._transformer_overrides: Dict[int, RangeVectorTransformer] = {}
+        self._prefused = None
 
     def execute_internal(self, source) -> QueryResultLike:
-        self._transformer_overrides = {}
-        self._fused_cache_key = None
-        data, stats = self._do_execute(source)
+        pre = getattr(self, "_prefused", None)
+        if pre is not None:
+            # phase-3 of engine.query_range_batch: the gather and fused
+            # preflight already ran in prepare_fused (keeping this leaf's
+            # _transformer_overrides), and the kernel work was batched
+            self._prefused = None
+            data, stats, fused = pre
+            if isinstance(fused, FusedCall):
+                # engine collected the call but never finished it (e.g. a
+                # batch peer errored): run it standalone
+                fused = self._finish_or_degrade(fused)
+        else:
+            self._transformer_overrides = {}
+            self._fused_cache_key = None
+            data, stats = self._do_execute(source)
+            try:
+                fused = self._try_fused(data, stats)
+            except GroupCardinalityError:
+                raise                    # real query error — must surface
+            except Exception as e:  # noqa: BLE001 — fusion is an optimization
+                from filodb_tpu.utils.metrics import (log_fused_degradation,
+                                                      registry)
+                registry.counter("leaf_fused_errors").increment()
+                log_fused_degradation("leaf", e)
+                fused = None
         start = 0
-        try:
-            fused = self._try_fused(data, stats)
-        except GroupCardinalityError:
-            raise                        # real query error — must surface
-        except Exception as e:  # noqa: BLE001 — fusion is an optimization
-            from filodb_tpu.utils.metrics import (log_fused_degradation,
-                                                  registry)
-            registry.counter("leaf_fused_errors").increment()
-            log_fused_degradation("leaf", e)
-            fused = None
         if fused is not None:
             data, start = fused, 2
         for i, t in enumerate(self.transformers[start:], start):
@@ -81,13 +187,59 @@ class MultiSchemaPartitionsExec(LeafExecPlan):
             data = t.apply(data, self.ctx, stats, source)
         return data, stats
 
-    def _try_fused(self, data, stats):
+    def prepare_fused(self, source):
+        """Phase-1 of engine.query_range_batch: run the gather and the
+        fused preflight, but NOT the kernel.  Returns a FusedCall when
+        this leaf's kernel work can be merged with other panels'
+        (finish_fused_calls), else None.  Either way the gathered data is
+        parked on the leaf so phase-3 execution never re-gathers; the
+        engine injects the finished AggPartial via inject_fused."""
+        self._transformer_overrides = {}
+        self._fused_cache_key = None
+        data, stats = self._do_execute(source)
+        try:
+            pre = self._try_fused(data, stats, defer=True)
+        except GroupCardinalityError:
+            # real query error — park the gather anyway so phase-3
+            # surfaces the SAME error from the general aggregate path
+            # (transformers.py group limit) without paying the index
+            # lookup + dense gather twice
+            self._prefused = (data, stats, None)
+            return None
+        except Exception as e:  # noqa: BLE001 — fusion is an optimization
+            from filodb_tpu.utils.metrics import (log_fused_degradation,
+                                                  registry)
+            registry.counter("leaf_fused_errors").increment()
+            log_fused_degradation("leaf", e)
+            pre = None
+        self._prefused = (data, stats, pre)
+        return pre if isinstance(pre, FusedCall) else None
+
+    def inject_fused(self, partial) -> None:
+        """Phase-2 handoff: replace the parked FusedCall with its batched
+        result (an AggPartial)."""
+        data, stats, _ = self._prefused
+        self._prefused = (data, stats, partial)
+
+    def _finish_or_degrade(self, fc):
+        try:
+            return finish_fused_calls([fc])[0]
+        except Exception as e:  # noqa: BLE001 — fusion is an optimization
+            from filodb_tpu.utils.metrics import (log_fused_degradation,
+                                                  registry)
+            registry.counter("leaf_fused_errors").increment()
+            log_fused_degradation("leaf", e)
+            return None
+
+    def _try_fused(self, data, stats, defer: bool = False):
         """Peephole: PeriodicSamplesMapper(rate|increase|delta) followed by
         AggregateMapReduce(sum) over a shared-grid fully-finite working set
         collapses into the single-HBM-pass MXU kernel (ops/pallas_fused.py)
         — the leaf analogue of the reference pushing AggregateMapReduce to
         data nodes (ref: AggrOverRangeVectors.scala:76), fused one level
-        further.  Returns the AggPartial or None (general path)."""
+        further.  Returns the AggPartial or None (general path); with
+        defer=True the matmul-kernel path returns a FusedCall instead so
+        the engine can merge compatible panels into one dispatch."""
         if len(self.transformers) < 2 or not isinstance(data, RawBlock) \
                 or not data.keys or data.shared_ts_row is None:
             return None
@@ -229,12 +381,21 @@ class MultiSchemaPartitionsExec(LeafExecPlan):
         registry.counter("leaf_fused_kernel").increment()
         if not is_hist:
             # broadened matmul path: any fusable (fn, agg) combination,
-            # ragged (validity-weighted) when the working set has NaN holes
-            comp = pf.fused_leaf_agg(
-                plan, prep, groups.gids_p[:vals.shape[0], 0],
-                len(gkeys), fn, t1.op, precorrected=data.precorrected,
-                interpret=interpret, ragged=not dense)
-            return AggPartial(t1.op, gkeys, wends, comp=comp)
+            # ragged (validity-weighted) when the working set has NaN
+            # holes.  Packaged as a FusedCall so engine.query_range_batch
+            # can merge compatible panels into one kernel dispatch; the
+            # single-query path finishes it immediately.
+            ck = None if key is None else key + (
+                t0.start_ms, t0.step_ms, t0.end_ms, t0.offset_ms,
+                t0.window_ms, data.base_ms)
+            fc = FusedCall(
+                plan=plan, values=padded_vals, groups=groups, gkeys=gkeys,
+                wends=wends, fn=fn, op=t1.op,
+                precorrected=data.precorrected, interpret=interpret,
+                ragged=not dense, num_series=vals.shape[0], cache_key=ck)
+            if defer:
+                return fc
+            return finish_fused_calls([fc])[0]
         sums, _counts = pf.fused_rate_groupsum(
             None, None, None, plan, num_slots, fn_name=t0.function,
             precorrected=data.precorrected, interpret=interpret,

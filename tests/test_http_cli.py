@@ -267,3 +267,30 @@ def test_cli_chunkinfos_and_decodechunkinfo(tmp_path, capsys):
     assert doc["partKey"]["metric"] == "cpu_load"
     assert doc["numRows"] == 20 and doc["schema"] == "gauge"
     assert doc["encodings"]
+
+
+def test_query_range_batch_http(server):
+    """Dashboard batch endpoint: one POST answers every panel, each
+    payload matching its individual query_range response."""
+    queries = ['sum(rate(request_total[5m])) by (_ns_)',
+               'avg(rate(request_total[5m])) by (dc)',
+               'bad{{{']
+    body = json.dumps({"queries": queries, "start": START_S + 600,
+                       "end": START_S + 7200, "step": 60}).encode()
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{server.http.port}"
+        f"/promql/prometheus/api/v1/query_range_batch",
+        data=body, headers={"Content-Type": "application/json"},
+        method="POST")
+    with urllib.request.urlopen(req, timeout=60) as r:
+        st, payload = r.status, json.loads(r.read())
+    assert st == 200 and payload["status"] == "success"
+    results = payload["results"]
+    assert len(results) == 3
+    assert results[2]["status"] == "error"
+    for q, got in zip(queries[:2], results[:2]):
+        _, want = _get(server, "/promql/prometheus/api/v1/query_range",
+                       query=q, start=START_S + 600, end=START_S + 7200,
+                       step=60)
+        assert got["status"] == "success"
+        assert got["data"]["result"] == want["data"]["result"], q

@@ -182,7 +182,7 @@ def test_rearranging_ops_fall_back_to_full_refresh():
     _assert_equivalent(store, mirror)
 
 
-def test_incremental_correctness_through_query_path():
+def test_incremental_correctness_through_query_path(monkeypatch):
     """End-to-end: rates served from an incrementally-updated mirror match
     a mirror-disabled engine exactly."""
     from filodb_tpu.query.engine import QueryEngine
@@ -204,7 +204,10 @@ def test_incremental_correctness_through_query_path():
     # truth: same data, mirror disabled
     ms2 = TimeSeriesMemStore()
     sh2 = ms2.setup("prometheus", 0)
-    sh2.config.store.device_mirror_enabled = False
+    # config.store is the process-wide settings() singleton: restore the
+    # flag after the test or every later store silently loses its mirror
+    # (this leak hid the fused path from any test running after this one)
+    monkeypatch.setattr(sh2.config.store, "device_mirror_enabled", False)
     sh2.ingest(counter_batch(20, 240, start_ms=START, resets=True), offset=0)
     want = q(QueryEngine("prometheus", ms2))
     assert set(got) == set(want)

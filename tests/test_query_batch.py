@@ -1,0 +1,130 @@
+"""engine.query_range_batch: a dashboard's panels over one window grid
+merge compatible fused leaves into single kernel dispatches (multi-hot
+epilogue, ops/pallas_fused.fused_leaf_agg_batch) with results identical
+to the queries run one at a time.
+
+The reference has no analogue (its iterator engine pays per-series cost
+either way); this is the TPU-shaped answer to the round-4 on-chip
+finding that fused leaf queries are dispatch-bound (doc/kernels.md)."""
+import numpy as np
+import pytest
+
+from filodb_tpu.ingest.generator import counter_batch
+from filodb_tpu.utils.metrics import registry
+
+from test_query_engine import _mk_engine
+
+START_MS = 1_600_000_000_000
+START_S = START_MS // 1000
+T = 240
+END_S = START_S + T * 10
+
+PANELS = [
+    'sum(rate(request_total{_ws_="demo"}[5m])) by (_ns_)',
+    'avg(rate(request_total{_ws_="demo"}[5m])) by (dc)',
+    'sum(rate(request_total{_ws_="demo"}[5m])) by (_ns_, dc)',
+    'count(rate(request_total{_ws_="demo"}[5m])) by (dc)',
+    'min(rate(request_total{_ws_="demo"}[5m])) by (_ns_)',
+    'max(rate(request_total{_ws_="demo"}[5m])) by (dc)',
+]
+
+
+@pytest.fixture()
+def fused_env(monkeypatch):
+    monkeypatch.setenv("FILODB_TPU_FUSED_INTERPRET", "1")
+
+
+def _series_map(res):
+    assert res.error is None, res.error
+    return {tuple(sorted(k.labels_dict.items())): np.asarray(v)
+            for k, _, v in res.series()}
+
+
+def _mk(batches=None):
+    return _mk_engine(batches or [counter_batch(60, T, start_ms=START_MS,
+                                                resets=True)])
+
+
+def test_batch_matches_individual_queries(fused_env):
+    engine = _mk()
+    args = (START_S + 600, 60, END_S)
+    want = [_series_map(engine.query_range(q, *args)) for q in PANELS]
+    dispatches0 = registry.counter("fused_batch_dispatches").value
+    merged0 = registry.counter("fused_batch_merged_panels").value
+    got = engine.query_range_batch(PANELS, *args)
+    assert registry.counter("fused_batch_merged_panels").value - merged0 \
+        >= 4, "sum/avg/count panels did not merge"
+    # 6 panels, at most two dispatches: one group-mode (sum/avg/count and
+    # ragged counts merged via disjoint-id multi-hot), one per-series
+    # mode shared by min/max
+    assert registry.counter("fused_batch_dispatches").value - dispatches0 \
+        <= 2
+    for q, w, g in zip(PANELS, want, got):
+        g = _series_map(g)
+        assert set(g) == set(w), q
+        for k in w:
+            np.testing.assert_allclose(g[k], w[k], rtol=2e-5, atol=1e-4,
+                                       equal_nan=True, err_msg=q)
+
+
+def test_batch_mixed_eligibility(fused_env):
+    """Non-fusable and erroring queries ride along untouched."""
+    engine = _mk()
+    args = (START_S + 600, 60, END_S)
+    queries = [PANELS[0],
+               'rate(request_total{_ws_="demo"}[5m])',      # no agg: general
+               'sum(nosuch_metric[5m])',                    # parse error
+               'topk(2, rate(request_total{_ws_="demo"}[5m]))',  # candidate
+               PANELS[1]]
+    got = engine.query_range_batch(queries, *args)
+    assert got[2].error is not None
+    for i in (0, 1, 3, 4):
+        w = _series_map(engine.query_range(queries[i], *args))
+        g = _series_map(got[i])
+        assert set(g) == set(w), queries[i]
+        for k in w:
+            np.testing.assert_allclose(g[k], w[k], rtol=2e-5, atol=1e-4,
+                                       equal_nan=True, err_msg=queries[i])
+
+
+def test_batch_general_path_without_fused(monkeypatch):
+    """With the fused kernel unavailable (no TPU, interpret off), the
+    batch API still answers every query via the general path."""
+    monkeypatch.delenv("FILODB_TPU_FUSED_INTERPRET", raising=False)
+    engine = _mk()
+    args = (START_S + 600, 60, END_S)
+    got = engine.query_range_batch(PANELS[:3], *args)
+    for q, g in zip(PANELS[:3], got):
+        w = _series_map(engine.query_range(q, *args))
+        g = _series_map(g)
+        assert set(g) == set(w)
+        for k in w:
+            np.testing.assert_allclose(g[k], w[k], rtol=1e-6,
+                                       equal_nan=True)
+
+
+def test_batch_ragged_matches_individual_queries(fused_env):
+    """NaN scrape gaps (the production-normal shape): the merged ragged
+    dispatch — multi-hot presence epilogue + disjoint-offset counts
+    slicing in fused_leaf_agg_batch — must match per-query results."""
+    from filodb_tpu.core.records import RecordBatch
+    batch = counter_batch(48, T, start_ms=START_MS)
+    vals = batch.columns["count"].copy()
+    rng = np.random.default_rng(11)
+    vals[rng.random(vals.shape) < 0.1] = np.nan      # scrape gaps
+    batch = RecordBatch(batch.schema, batch.part_keys, batch.part_idx,
+                        batch.timestamps, {"count": vals},
+                        batch.bucket_les)
+    engine = _mk([batch])
+    args = (START_S + 600, 60, END_S)
+    want = [_series_map(engine.query_range(q, *args)) for q in PANELS]
+    merged0 = registry.counter("fused_batch_merged_panels").value
+    got = engine.query_range_batch(PANELS, *args)
+    assert registry.counter("fused_batch_merged_panels").value - merged0 \
+        >= 4, "ragged panels did not merge"
+    for q, w, g in zip(PANELS, want, got):
+        g = _series_map(g)
+        assert set(g) == set(w), q
+        for k in w:
+            np.testing.assert_allclose(g[k], w[k], rtol=2e-5, atol=1e-4,
+                                       equal_nan=True, err_msg=q)

@@ -1,0 +1,93 @@
+#!/usr/bin/env python
+"""On-chip proof of the round-4 dashboard-batch feature: P aggregation
+panels over one 262k-series working set, batched into merged kernel
+dispatches (ops/pallas_fused.fused_leaf_agg_batch) vs dispatched one at
+a time (fused_leaf_agg).  The headline bench showed a fused query is
+dispatch-bound through the tunnel (TPU_TUNE_r04.json: min 61ms vs a
+2.5ms HBM read), so merging panels is where dashboard latency goes.
+
+Writes TPU_BATCH_r04.json.  Refuses to run off-TPU.
+"""
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
+                      os.path.join(REPO, ".jax_cache"))
+OUT = os.path.join(REPO, "TPU_BATCH_r04.json")
+
+
+def main():
+    import jax
+    assert jax.devices()[0].platform != "cpu", "needs the TPU tunnel"
+    from filodb_tpu.ops import pallas_fused as pf
+    from filodb_tpu.ops.timewindow import make_window_ends
+
+    S, T = 262_144, 720
+    rng = np.random.default_rng(7)
+    ts_row = (600_000 + 10_000 * np.arange(T)).astype(np.int64)
+    vals = np.cumsum(rng.random((S, T), np.float32) * 10.0, axis=1,
+                     dtype=np.float64).astype(np.float32)
+    vbase = np.zeros(S, np.float32)
+    wends = make_window_ends(600_000, int(ts_row[-1]), 60_000)
+    plan = pf.build_plan(ts_row, np.asarray(wends, np.int64), 300_000)
+    pv = pf.pad_values(vals, vbase, plan)
+    groupings = [(np.arange(S) % 1000, 1000, "sum"),
+                 (np.arange(S) % 100, 100, "avg"),
+                 (np.arange(S) % 10, 10, "sum"),
+                 (np.arange(S) // (S // 8), 8, "sum"),
+                 (np.arange(S) % 500, 500, "sum"),
+                 (np.arange(S) % 50, 50, "avg"),
+                 (np.arange(S) % 250, 250, "sum"),
+                 (np.arange(S) % 2, 2, "sum")]
+    panels = [(pf.pad_groups(g.astype(np.int32), S, G), G, op)
+              for g, G, op in groupings]
+
+    def batched():
+        return pf.fused_leaf_agg_batch(plan, pv, panels, "rate",
+                                       precorrected=True, ragged=False,
+                                       num_series=S)
+
+    def sequential():
+        out = []
+        for (g, G, op), (groups, _, _) in zip(groupings, panels):
+            prep = pf.PreparedInputs(pv.vals_p, pv.vbase_p,
+                                     groups.gids_p, groups.gsize)
+            out.append(pf.fused_leaf_agg(plan, prep, g.astype(np.int32),
+                                         G, "rate", op, precorrected=True))
+        return out
+
+    doc = {"utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+           "platform": "tpu", "series": S, "samples_per_series": T,
+           "panels": len(groupings),
+           "total_groups": sum(G for _, G, _ in groupings)}
+    t0 = time.perf_counter()
+    got_b = batched()
+    doc["batched_compile_s"] = round(time.perf_counter() - t0, 2)
+    t0 = time.perf_counter()
+    got_s = sequential()
+    doc["sequential_compile_s"] = round(time.perf_counter() - t0, 2)
+    for name, fn in (("batched", batched), ("sequential", sequential)):
+        ts = sorted(time.perf_counter() - t0
+                    for _ in range(11) for t0 in [time.perf_counter()]
+                    if fn() is not None)
+        doc[f"{name}_p50_s"] = round(ts[5], 5)
+        doc[f"{name}_min_s"] = round(ts[0], 5)
+    doc["speedup_p50"] = round(doc["sequential_p50_s"]
+                               / doc["batched_p50_s"], 2)
+    err = max(float(np.nanmax(np.abs(b - s)
+                              / np.maximum(np.abs(s), 1e-6)))
+              for b, s in zip(got_b, got_s))
+    doc["max_rel_err_batched_vs_sequential"] = err
+    with open(OUT, "w") as f:
+        json.dump(doc, f, indent=1)
+    print(json.dumps(doc, indent=1))
+
+
+if __name__ == "__main__":
+    main()
