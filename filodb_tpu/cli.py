@@ -206,6 +206,47 @@ def cmd_query(args) -> int:
     return 0 if payload.get("status") == "success" else 2
 
 
+def cmd_querybatch(args) -> int:
+    """Dashboard batch: evaluate several PromQL queries over one window
+    grid, merging compatible fused leaves into single kernel dispatches
+    (engine.query_range_batch; no reference analogue — TPU dispatch
+    amortization, see doc/kernels.md)."""
+    end = args.end or int(time.time())
+    start = args.start or end - 1800
+    queries = list(args.promql)
+    if args.host:
+        import urllib.error
+        import urllib.request
+        body = json.dumps({"queries": queries, "start": start, "end": end,
+                           "step": args.step}).encode()
+        req = urllib.request.Request(
+            f"http://{args.host}/promql/{args.dataset}/api/v1/"
+            f"query_range_batch", data=body,
+            headers={"Content-Type": "application/json"}, method="POST")
+        try:
+            with urllib.request.urlopen(req, timeout=120) as r:
+                payload = json.loads(r.read())
+        except urllib.error.HTTPError as e:
+            try:
+                payload = json.loads(e.read())
+            except Exception:  # noqa: BLE001 — non-JSON error body
+                payload = {"status": "error", "error": str(e)}
+        except urllib.error.URLError as e:
+            payload = {"status": "error", "error": str(e)}
+    else:
+        from filodb_tpu.query.engine import QueryEngine
+        ms, _, _ = _open_local(args.data_dir, args.dataset, args.shards)
+        eng = _local_engine(ms, args.dataset, args.shards)
+        results = eng.query_range_batch(queries, start, args.step, end)
+        payload = {"status": "success",
+                   "results": [QueryEngine.to_prom_matrix(r)
+                               for r in results]}
+    print(json.dumps(payload, indent=None if args.raw else 2))
+    ok = payload.get("status") == "success" and all(
+        r.get("status") == "success" for r in payload.get("results", []))
+    return 0 if ok else 2
+
+
 def cmd_status(args) -> int:
     payload = _http_get(args.host, f"/cluster/{args.dataset}/status", {})
     print(json.dumps(payload, indent=2))
@@ -473,6 +514,20 @@ def build_parser() -> argparse.ArgumentParser:
                     help="query a running server (host:port) over HTTP")
     sp.add_argument("--raw", action="store_true")
     sp.set_defaults(fn=cmd_query)
+
+    sp = sub.add_parser("querybatch",
+                        help="batched PromQL range queries (one dashboard, "
+                             "merged kernel dispatches)")
+    common(sp)
+    sp.add_argument("--promql", required=True, action="append",
+                    help="repeatable: one per panel")
+    sp.add_argument("--start", type=int, default=0)
+    sp.add_argument("--end", type=int, default=0)
+    sp.add_argument("--step", type=int, default=60)
+    sp.add_argument("--host", default="",
+                    help="query a running server (host:port) over HTTP")
+    sp.add_argument("--raw", action="store_true")
+    sp.set_defaults(fn=cmd_querybatch)
 
     sp = sub.add_parser("status", help="cluster shard status over HTTP")
     sp.add_argument("--host", required=True)

@@ -96,6 +96,11 @@ class PackedShards:
     # the boolean scan amortizes; post-device_put the values are sharded
     # device arrays a lazy scan would have to transfer back).
     dense: bool = True
+    # host-side per-shard pid arrays in pack-row order (None for empty
+    # shards): lets run_agg_batch recompute OTHER groupings over the SAME
+    # rows without re-gathering (the mesh analogue of the leaf path's
+    # PaddedValues/PaddedGroups split)
+    pids_by_shard: Optional[List[np.ndarray]] = None
 
     @property
     def n_shards(self) -> int:
@@ -277,8 +282,10 @@ def _mesh_fused_call(mesh: Mesh, values, group_ids, vbase,
         else:
             v = jnp.pad(jnp.nan_to_num(v), ((0, Sp - S), (0, Tp - T)))
         vb = jnp.pad(vb_blk[0].astype(jnp.float32), (0, Sp - S))[:, None]
-        g = jnp.pad(gid_blk[0].astype(jnp.int32), (0, Sp - S),
-                    constant_values=-1)[:, None]
+        # [S, P] grouping columns (P > 1: run_agg_batch panels over
+        # disjoint group-id ranges, multi-hot kernel epilogue)
+        g = jnp.pad(gid_blk[0].astype(jnp.int32), ((0, Sp - S), (0, 0)),
+                    constant_values=-1)
         res = pf.run_kernel(v, vb, g, o1b[0], o2b[0], l1b[0], l2b[0],
                             t1b[0], t2b[0], nb[0], wsb[0], web[0], tsb[0],
                             num_groups=Gp, is_counter=is_counter,
@@ -292,7 +299,7 @@ def _mesh_fused_call(mesh: Mesh, values, group_ids, vbase,
 
     return jax.shard_map(
         step, mesh=mesh,
-        in_specs=(P("shard", None, None), P("shard", None),
+        in_specs=(P("shard", None, None), P("shard", None, None),
                   P("shard", None)) + (P("time", None, None),) * 10,
         out_specs=((P(None, "time"), P(None, "time")) if ragged
                    else P(None, "time")),
@@ -526,6 +533,7 @@ class MeshExecutor:
         blocks = []
         precorrected = True
         registry = None
+        pids_by_shard = []
         for shard in self.memstore.shards_for(self.dataset):
             lookup = shard.lookup_partitions(filters, start_ms, end_ms)
             schema_name = lookup.first_schema
@@ -534,7 +542,9 @@ class MeshExecutor:
             if pids is None or pids.size == 0:
                 blocks.append((np.full((1, 1), PAD_TS, np.int32),
                                np.full((1, 1), np.nan), []))
+                pids_by_shard.append(None)
                 continue
+            pids_by_shard.append(np.asarray(pids))
             shard.ensure_paged_pids(schema_name, pids, start_ms, end_ms)
             store = shard.stores[schema_name]
             rows = shard.rows_for(pids)
@@ -576,6 +586,7 @@ class MeshExecutor:
                        *b[3:]) for b in blocks]
         packed = pack_shards(blocks, by=by, without=without, base_ms=start_ms,
                              precorrected=precorrected, group_labels=labels)
+        packed.pids_by_shard = pids_by_shard
         packed = device_put_packed(packed, self.mesh)
         # cache under the PRE-gather signature: a concurrent ingest landing
         # mid-gather then invalidates the entry (over-invalidation is safe;
@@ -592,13 +603,11 @@ class MeshExecutor:
         metrics_registry.counter("mesh_pack_cache_misses").increment()
         return packed
 
-    def run_agg(self, packed: PackedShards, wends: np.ndarray, *,
-                range_ms: int, fn_name: Optional[str], agg_op: str,
-                params: Tuple[float, ...] = ()) -> Tuple[np.ndarray, List[Dict[str, str]]]:
-        """Returns (final [G, W] values, group label dicts).
-
-        wends are ABSOLUTE ms (same clock as lookup_and_pack's time range);
-        they are rebased onto the pack's offset base here."""
+    def _prep_wends(self, packed: PackedShards, wends: np.ndarray
+                    ) -> Tuple[np.ndarray, int]:
+        """Rebase absolute window ends onto the pack's offset base and pad
+        the grid to a multiple of the time axis; padded windows end before
+        all data (-PAD_TS) so they are empty, not garbage."""
         wends = np.asarray(wends, np.int64) - packed.base_ms
         if wends.size and (wends.max() >= (1 << 30) or
                            wends.min() <= -(1 << 30)):
@@ -607,12 +616,107 @@ class MeshExecutor:
         wends = wends.astype(np.int32)
         W = wends.shape[0]
         n_time = self.mesh.shape["time"]
-        # pad the window grid to a multiple of the time axis; padded windows
-        # end before all data (-PAD_TS) so they are empty, not garbage
         Wp = -(-W // n_time) * n_time
         if Wp != W:
             wends = np.concatenate(
                 [wends, np.full(Wp - W, -PAD_TS, np.int32)])
+        return wends, W
+
+    def run_agg_batch(self, filters, start_ms: int, end_ms: int,
+                      wends: np.ndarray, *, range_ms: int,
+                      fn_name: Optional[str],
+                      panels) -> List[Tuple[np.ndarray, List[Dict[str, str]]]]:
+        """A dashboard's panels over one packed working set: panels is
+        [(by, without, agg_op)]; returns [(values [G, W], labels)] in
+        panel order.
+
+        The mesh analogue of engine.query_range_batch: the values are
+        packed ONCE (grouping recomputed per panel over the same rows via
+        pids_by_shard), and every fused-eligible panel merges into ONE
+        shard_map kernel dispatch over disjoint group-id ranges
+        (_run_agg_fused_multi multi-hot epilogue).  Ineligible panels —
+        and all panels when the shared fused gate rejects — fall back to
+        run_agg per panel, where the pack cache still dedups the gather
+        for repeated groupings."""
+        by0, wo0, _ = panels[0]
+        packed = self.lookup_and_pack(filters, start_ms, end_ms, by=by0,
+                                      without=wo0, fn_name=fn_name)
+        results: List = [None] * len(panels)
+        if packed is None:
+            # no shards for the dataset: keep the declared contract —
+            # one (empty values, no labels) tuple per panel
+            empty = np.zeros((0, np.asarray(wends).shape[0]))
+            return [(empty, []) for _ in panels]
+        kpanels, kmap, klabels = [], [], []
+        shards = list(self.memstore.shards_for(self.dataset))
+        D, S, _ = packed.ts_off.shape
+        for idx, (by, wo, op) in enumerate(panels):
+            if op not in ("sum", "avg", "count"):
+                continue
+            if idx == 0:
+                kpanels.append((None, packed.num_groups, op, packed.gsize))
+                kmap.append(idx)
+                klabels.append(packed.group_labels)
+                continue
+            if packed.pids_by_shard is None:
+                continue          # pack built outside lookup_and_pack
+            garrs, registry = [], None
+            for shard, pids in zip(shards, packed.pids_by_shard):
+                if pids is None:
+                    garrs.append(None)
+                    continue
+                g, registry = self._gids_for(shard, pids, tuple(by),
+                                             tuple(wo))
+                garrs.append(np.asarray(g, np.int64))
+            real = [g for g in garrs if g is not None]
+            uniq = (np.unique(np.concatenate(real)) if real
+                    else np.zeros(0, np.int64))
+            labels = ([registry.labels[int(x)] for x in uniq]
+                      if registry is not None else [])
+            G = max(len(labels), 1)
+            gids = np.full((D, S), -1, np.int32)
+            gsize = np.zeros(G, np.int64)
+            for d, g in enumerate(garrs):
+                if g is None:
+                    continue
+                cg = np.searchsorted(uniq, g).astype(np.int32)
+                gids[d, :len(cg)] = cg
+                gsize += np.bincount(cg, minlength=G)[:G]
+            kpanels.append((gids, G, op, gsize))
+            kmap.append(idx)
+            klabels.append(labels)
+        if kpanels:
+            wends_p, W = self._prep_wends(packed, wends)
+            try:
+                fused = self._run_agg_fused_multi(
+                    packed, wends_p, W, range_ms, fn_name, kpanels)
+            except Exception as e:  # noqa: BLE001 — fusion is optional
+                from filodb_tpu.utils.metrics import (
+                    log_fused_degradation, registry as mreg)
+                mreg.counter("mesh_fused_errors").increment()
+                log_fused_degradation("mesh", e)
+                fused = None
+            if fused is not None:
+                for arr, idx, labels in zip(fused, kmap, klabels):
+                    results[idx] = (arr, labels)
+        for idx, (by, wo, op) in enumerate(panels):
+            if results[idx] is None:
+                pk = self.lookup_and_pack(filters, start_ms, end_ms,
+                                          by=by, without=wo,
+                                          fn_name=fn_name)
+                results[idx] = self.run_agg(pk, np.asarray(wends),
+                                            range_ms=range_ms,
+                                            fn_name=fn_name, agg_op=op)
+        return results
+
+    def run_agg(self, packed: PackedShards, wends: np.ndarray, *,
+                range_ms: int, fn_name: Optional[str], agg_op: str,
+                params: Tuple[float, ...] = ()) -> Tuple[np.ndarray, List[Dict[str, str]]]:
+        """Returns (final [G, W] values, group label dicts).
+
+        wends are ABSOLUTE ms (same clock as lookup_and_pack's time range);
+        they are rebased onto the pack's offset base here."""
+        wends, W = self._prep_wends(packed, wends)
         if agg_op in ("sum", "avg", "count") and not params:
             try:
                 fused = self._run_agg_fused(packed, wends, W, range_ms,
@@ -642,6 +746,16 @@ class MeshExecutor:
     def _run_agg_fused(self, packed: PackedShards, wends_p: np.ndarray,
                        W: int, range_ms: int, fn_name: Optional[str],
                        agg_op: str = "sum") -> Optional[np.ndarray]:
+        """Single-panel form of _run_agg_fused_multi (see below)."""
+        res = self._run_agg_fused_multi(
+            packed, wends_p, W, range_ms, fn_name,
+            [(None, packed.num_groups, agg_op, packed.gsize)])
+        return None if res is None else res[0]
+
+    def _run_agg_fused_multi(self, packed: PackedShards,
+                             wends_p: np.ndarray, W: int, range_ms: int,
+                             fn_name: Optional[str],
+                             kpanels) -> Optional[List[np.ndarray]]:
         """sum/avg/count(rate|increase|delta|*_over_time) over a
         uniform-grid pack via the Pallas MXU kernel (ops/pallas_fused.py)
         composed inside shard_map: per-time-slice selection-matrix plans
@@ -651,14 +765,21 @@ class MeshExecutor:
         kernel's valid-boundary variant with per-cell presence psum'd as
         a second output (r4).  On a dense pack count needs NO device work
         (identical per-window counts); avg divides sums by counts.
-        Returns the finished [G, W] array, or None when ineligible."""
+
+        kpanels: [(gids [D, S] int32 or None for the pack's own grouping,
+        G, agg_op, gsize [G])] — multiple panels (run_agg_batch) merge
+        into ONE kernel dispatch over disjoint group-id ranges, the mesh
+        analogue of the leaf path's fused_leaf_agg_batch.  Returns the
+        finished [G, W] arrays in panel order, or None when the shared
+        gate rejects (callers then take the general path per panel)."""
         import os
 
         from filodb_tpu.ops import pallas_fused as pf
         shared = packed.shared_ts_row is not None and packed.gsize is not None
         dense = packed.dense
-        if not pf.can_fuse(fn_name or "", agg_op, shared, dense):
-            return None
+        for _, _, op, _ in kpanels:
+            if not pf.can_fuse(fn_name or "", op, shared, dense):
+                return None
         if fn_name in pf.MINMAX_FNS:
             # reduce_window kinds run through the general mesh path (XLA
             # fuses them fine); the matmul kernel has no min/max kind
@@ -669,99 +790,143 @@ class MeshExecutor:
             # SLOTS, and mesh pack padding rows carry gid 0 (unlike the
             # leaf path's -1) — they would inflate group 0.  General path.
             return None
-        if agg_op == "count" and dense:
-            # dense pack: every REAL series emits a value exactly where the
-            # shared window is valid — pure host math, zero device work
-            minsamp = 2 if fn_name in ("rate", "increase", "delta") else 1
-            n = pf.window_counts(packed.shared_ts_row.astype(np.int64),
-                                 wends_p[:W].astype(np.int64), range_ms)
-            valid = (n >= minsamp).astype(np.float64)
-            counts = packed.gsize[:, None] * valid[None, :]
-            from filodb_tpu.utils.metrics import registry
-            registry.counter("mesh_fused_count_host").increment()
-            return np.where(counts > 0, counts, np.nan)
-        interpret = jax.default_backend() != "tpu"
-        if interpret and not os.environ.get("FILODB_TPU_FUSED_INTERPRET"):
-            return None
-        if fn_name in ("rate", "increase") and not packed.precorrected:
-            return None
-        n_time = self.mesh.shape["time"]
-        Wp = wends_p.shape[0]
-        Wl = Wp // n_time
-        G = packed.num_groups
-        D, S, T = packed.ts_off.shape
-        Tp = pf._pad_to(T, pf._LANE)
-        Wlp = pf._pad_to(max(Wl, 1), pf._LANE)
-        # padded group count, matching _run's recomputation exactly
-        if pf.pick_block(
-                Tp, Wlp, pf._pad_to(max(G, 8), 8),
-                fn_name in pf.OVER_TIME_FNS,
-                ragged and fn_name in ("rate", "increase", "delta")
-                ) is None:
-            return None
-        # plan + device-mats cache: repeat queries (the pack-cache pattern)
-        # skip the host selection-matrix rebuild and the 9 uploads
-        plan_key = (packed.shared_ts_row.tobytes(), wends_p.tobytes(),
-                    range_ms)
-        from filodb_tpu.query.exec import _lru_touch
-        with self._cache_lock:
-            ent = _lru_touch(self._fused_plan_cache, plan_key)
-        if ent is None:
-            ts_row = packed.shared_ts_row.astype(np.int64)
-            plans = [pf.build_plan(
-                ts_row, wends_p[i * Wl:(i + 1) * Wl].astype(np.int64),
-                range_ms) for i in range(n_time)]
-            st = lambda a: np.stack([getattr(p, a) for p in plans])  # noqa: E731
-            mats = tuple(
-                jax.device_put(st(a), NamedSharding(
-                    self.mesh, P("time", None, None)))
-                for a in ("o1", "o2", "l1", "l2", "t1", "t2", "n",
-                          "wstart_x", "wend_x", "n1", "tsrow"))
-            wvalid = np.concatenate([p.wvalid for p in plans])
-            wvalid1 = np.concatenate([p.wvalid1 for p in plans])
-            ent = (mats, wvalid, wvalid1)
-            with self._cache_lock:
-                self._fused_plan_cache[plan_key] = ent
-                while len(self._fused_plan_cache) > 4:
-                    self._fused_plan_cache.pop(
-                        next(iter(self._fused_plan_cache)))
-        mats, wvalid, wvalid1 = ent
+        minsamp = 2 if fn_name in ("rate", "increase", "delta") else 1
         over_time = fn_name in pf.OVER_TIME_FNS
-        # the kernel's `n` slot carries TRUE counts for the over_time
-        # kinds and the rate family's clamped counts otherwise
-        mats = (mats[:6] + ((mats[9] if over_time else mats[6]),)
-                + mats[7:9] + (mats[10],))
-        vbase = packed.vbase
-        if vbase is None:
-            vbase = jax.device_put(
-                np.zeros((D, S), np.float32),
-                NamedSharding(self.mesh, P("shard", None)))
-            # the pack is cached across queries — keep the device zeros
-            # with it so repeats skip this alloc + transfer (also serves
-            # the general path, which otherwise re-zeros per call)
-            packed.vbase = vbase
-        res = _mesh_fused_call(
-            self.mesh, packed.values, packed.group_ids, vbase, *mats,
-            G=G, S=S, T=T, Tp=Tp,
-            is_counter=(fn_name in ("rate", "increase")),
-            is_rate=(fn_name == "rate"), interpret=interpret,
-            kind=(fn_name if over_time else "rate_family"), ragged=ragged)
 
-        def unslice(a):
-            return np.asarray(a).reshape(G, n_time, Wlp)[:, :, :Wl] \
-                .reshape(G, Wp)[:, :W]
+        def host_counts(gsize, wvalid):
+            return gsize[:, None] * wvalid[None, :].astype(np.float64)
 
-        if ragged:
-            out, counts = unslice(res[0]), unslice(res[1])
-        else:
-            out = unslice(res)
-            counts = packed.gsize[:, None] * \
-                (wvalid1 if over_time else wvalid)[None, :W]
-        from filodb_tpu.utils.metrics import registry
-        registry.counter("mesh_fused_kernel").increment()
-        if agg_op == "count":                 # ragged: kernel presence
-            return np.where(counts > 0, counts.astype(np.float64), np.nan)
-        if agg_op == "avg":
-            with np.errstate(invalid="ignore", divide="ignore"):
-                out = np.asarray(out, np.float64) / np.maximum(counts, 1.0)
-        return pf.present_sum(out, counts)
+        out: List[Optional[np.ndarray]] = [None] * len(kpanels)
+        # dense count panels: every REAL series emits a value exactly
+        # where the shared window is valid — pure host math
+        kidx = [i for i, (_, _, op, _) in enumerate(kpanels)
+                if not (op == "count" and dense)]
+        if kidx:
+            interpret = jax.default_backend() != "tpu"
+            if interpret and not os.environ.get("FILODB_TPU_FUSED_INTERPRET"):
+                return None
+            if fn_name in ("rate", "increase") and not packed.precorrected:
+                return None
+            n_time = self.mesh.shape["time"]
+            Wp = wends_p.shape[0]
+            Wl = Wp // n_time
+            D, S, T = packed.ts_off.shape
+            Tp = pf._pad_to(T, pf._LANE)
+            Wlp = pf._pad_to(max(Wl, 1), pf._LANE)
+            offsets, Gtot = [], 0
+            for i in kidx:
+                offsets.append(Gtot)
+                Gtot += kpanels[i][1]
+            # padded group count, matching _run's recomputation exactly
+            if pf.pick_block(
+                    Tp, Wlp, pf._pad_to(max(Gtot, 8), 8),
+                    over_time,
+                    ragged and fn_name in ("rate", "increase", "delta")
+                    ) is None:
+                return None
+            # plan + device-mats cache: repeat queries (the pack-cache
+            # pattern) skip the host selection-matrix rebuild + 9 uploads
+            plan_key = (packed.shared_ts_row.tobytes(), wends_p.tobytes(),
+                        range_ms)
+            from filodb_tpu.query.exec import _lru_touch
+            with self._cache_lock:
+                ent = _lru_touch(self._fused_plan_cache, plan_key)
+            if ent is None:
+                ts_row = packed.shared_ts_row.astype(np.int64)
+                plans = [pf.build_plan(
+                    ts_row, wends_p[i * Wl:(i + 1) * Wl].astype(np.int64),
+                    range_ms) for i in range(n_time)]
+                st = lambda a: np.stack([getattr(p, a) for p in plans])  # noqa: E731
+                mats = tuple(
+                    jax.device_put(st(a), NamedSharding(
+                        self.mesh, P("time", None, None)))
+                    for a in ("o1", "o2", "l1", "l2", "t1", "t2", "n",
+                              "wstart_x", "wend_x", "n1", "tsrow"))
+                wvalid = np.concatenate([p.wvalid for p in plans])
+                wvalid1 = np.concatenate([p.wvalid1 for p in plans])
+                ent = (mats, wvalid, wvalid1)
+                with self._cache_lock:
+                    self._fused_plan_cache[plan_key] = ent
+                    while len(self._fused_plan_cache) > 4:
+                        self._fused_plan_cache.pop(
+                            next(iter(self._fused_plan_cache)))
+            mats, wvalid, wvalid1 = ent
+            # the kernel's `n` slot carries TRUE counts for the over_time
+            # kinds and the rate family's clamped counts otherwise
+            mats = (mats[:6] + ((mats[9] if over_time else mats[6]),)
+                    + mats[7:9] + (mats[10],))
+            vbase = packed.vbase
+            if vbase is None:
+                vbase = jax.device_put(
+                    np.zeros((D, S), np.float32),
+                    NamedSharding(self.mesh, P("shard", None)))
+                # the pack is cached across queries — keep the device zeros
+                # with it so repeats skip this alloc + transfer (also
+                # serves the general path, which otherwise re-zeros)
+                packed.vbase = vbase
+            if len(kidx) == 1 and kpanels[kidx[0]][0] is None:
+                gids_dev = packed.group_ids[..., None]
+            else:
+                cols = []
+                for j, i in enumerate(kidx):
+                    g = kpanels[i][0]
+                    if g is None:
+                        g = np.asarray(packed.group_ids)
+                    # pack pad rows carry gid 0 over zeroed/NaN values:
+                    # offset keeps them harmless (+0 sums, 0 presence)
+                    cols.append(np.where(g >= 0, g + offsets[j], -1)
+                                .astype(np.int32))
+                gids_dev = jax.device_put(
+                    np.stack(cols, axis=-1),
+                    NamedSharding(self.mesh, P("shard", None, None)))
+            res = _mesh_fused_call(
+                self.mesh, packed.values, gids_dev, vbase, *mats,
+                G=Gtot, S=S, T=T, Tp=Tp,
+                is_counter=(fn_name in ("rate", "increase")),
+                is_rate=(fn_name == "rate"), interpret=interpret,
+                kind=(fn_name if over_time else "rate_family"),
+                ragged=ragged)
+
+            def unslice(a):
+                return np.asarray(a).reshape(Gtot, n_time, Wlp)[:, :, :Wl] \
+                    .reshape(Gtot, Wp)[:, :W]
+
+            if ragged:
+                all_out, all_counts = unslice(res[0]), unslice(res[1])
+            else:
+                all_out, all_counts = unslice(res), None
+            from filodb_tpu.utils.metrics import registry
+            registry.counter("mesh_fused_kernel").increment()
+            if len(kidx) > 1:
+                registry.counter("mesh_fused_batch_panels") \
+                    .increment(len(kidx))
+            for j, i in enumerate(kidx):
+                _, G, op, gsize = kpanels[i]
+                lo = offsets[j]
+                pout = all_out[lo:lo + G]
+                counts = (all_counts[lo:lo + G] if ragged
+                          else host_counts(gsize,
+                                           wvalid1 if over_time
+                                           else wvalid)[:, :W])
+                if op == "count":             # ragged: kernel presence
+                    out[i] = np.where(counts > 0,
+                                      counts.astype(np.float64), np.nan)
+                    continue
+                if op == "avg":
+                    with np.errstate(invalid="ignore", divide="ignore"):
+                        pout = np.asarray(pout, np.float64) \
+                            / np.maximum(counts, 1.0)
+                out[i] = pf.present_sum(pout, counts)
+        valid = None                          # panel-independent; lazy
+        for i, (_, _, op, gsize) in enumerate(kpanels):
+            if out[i] is None:                # dense count: host math
+                if valid is None:
+                    n = pf.window_counts(
+                        packed.shared_ts_row.astype(np.int64),
+                        wends_p[:W].astype(np.int64), range_ms)
+                    valid = (n >= minsamp).astype(np.float64)
+                counts = host_counts(gsize, valid)
+                from filodb_tpu.utils.metrics import registry
+                registry.counter("mesh_fused_count_host").increment()
+                out[i] = np.where(counts > 0, counts, np.nan)
+        return out

@@ -294,3 +294,30 @@ def test_query_range_batch_http(server):
                        step=60)
         assert got["status"] == "success"
         assert got["data"]["result"] == want["data"]["result"], q
+
+
+def test_cli_querybatch(tmp_path, capsys):
+    from filodb_tpu.cli import main
+    data_dir = str(tmp_path / "data")
+    main(["init", "--data-dir", data_dir])
+    csv = tmp_path / "in.csv"
+    rows = ["metric,tags,timestamp,value"]
+    for i in range(30):
+        rows.append(f"mem_used,app=web,{START + i * 10_000},{100 + i}")
+        rows.append(f"mem_used,app=db,{START + i * 10_000},{200 + i}")
+    csv.write_text("\n".join(rows))
+    main(["importcsv", "--data-dir", data_dir, "--file", str(csv)])
+    capsys.readouterr()
+    rc = main(["querybatch", "--data-dir", data_dir, "--raw",
+               "--promql", 'sum(mem_used) by (app)',
+               "--promql", 'avg(mem_used) by (app)',
+               "--start", str(START_S), "--end", str(START_S + 300),
+               "--step", "60"])
+    assert rc == 0
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["status"] == "success"
+    assert len(payload["results"]) == 2
+    for r in payload["results"]:
+        assert r["status"] == "success"
+        apps = {m["metric"]["app"] for m in r["data"]["result"]}
+        assert apps == {"web", "db"}

@@ -324,3 +324,43 @@ def test_mesh_fused_avg_divides_by_counts(store4, mesh42, monkeypatch):
     assert registry.counter("mesh_fused_kernel").value > before
     np.testing.assert_allclose(out_fused, out_gen, rtol=2e-5, atol=1e-4,
                                equal_nan=True)
+
+
+def test_run_agg_batch_matches_individual(store4, mesh42, monkeypatch):
+    """A dashboard's panels over ONE pack + ONE shard_map dispatch
+    (multi-hot over disjoint group-id ranges) must match per-panel
+    run_agg exactly; min/max panels fall back per panel."""
+    from filodb_tpu.utils.metrics import registry
+    monkeypatch.setenv("FILODB_TPU_FUSED_INTERPRET", "1")
+    ms, _ = store4
+    ex = MeshExecutor(ms, "prometheus", mesh42)
+    filters = [Equals("_metric_", "request_total"), Equals("_ws_", "demo")]
+    t0 = (START_S + 600) * 1000 - 300_000
+    t1 = QEND_S * 1000
+    wends = make_window_ends((START_S + 600) * 1000, t1, STEP_S * 1000)
+    panels = [(("_ns_",), (), "sum"),
+              (("dc",), (), "avg"),
+              (("_ns_", "dc"), (), "sum"),
+              (("dc",), (), "count"),
+              (("_ns_",), (), "max")]     # not fusable: per-panel fallback
+    want = []
+    for by, wo, op in panels:
+        pk = ex.lookup_and_pack(filters, t0, t1, by=by, without=wo,
+                                fn_name="rate")
+        want.append(ex.run_agg(pk, wends, range_ms=300_000,
+                               fn_name="rate", agg_op=op))
+    k0 = registry.counter("mesh_fused_kernel").value
+    b0 = registry.counter("mesh_fused_batch_panels").value
+    got = ex.run_agg_batch(filters, t0, t1, wends, range_ms=300_000,
+                           fn_name="rate", panels=panels)
+    assert registry.counter("mesh_fused_batch_panels").value - b0 >= 3, \
+        "fusable panels did not merge"
+    assert registry.counter("mesh_fused_kernel").value - k0 == 1, \
+        "merged panels must cost ONE kernel dispatch"
+    for (by, wo, op), (w_out, w_labels), (g_out, g_labels) in \
+            zip(panels, want, got):
+        key = (by, op)
+        assert [dict(l) for l in g_labels] == [dict(l) for l in w_labels], key
+        assert g_out.shape == w_out.shape, key
+        np.testing.assert_allclose(g_out, w_out, rtol=1e-6, atol=1e-9,
+                                   equal_nan=True, err_msg=str(key))

@@ -128,3 +128,25 @@ def test_batch_ragged_matches_individual_queries(fused_env):
         for k in w:
             np.testing.assert_allclose(g[k], w[k], rtol=2e-5, atol=1e-4,
                                        equal_nan=True, err_msg=q)
+
+
+def test_batch_multi_shard(fused_env):
+    """Two shards: each shard's leaves merge within their own working
+    set (different mirrors -> different compat keys), and the stitched
+    results still match individual queries."""
+    engine = _mk_engine([counter_batch(60, T, start_ms=START_MS,
+                                       resets=True)], num_shards=2)
+    args = (START_S + 600, 60, END_S)
+    queries = PANELS[:4]
+    want = [_series_map(engine.query_range(q, *args)) for q in queries]
+    merged0 = registry.counter("fused_batch_merged_panels").value
+    got = engine.query_range_batch(queries, *args)
+    # 4 panels x 2 shard-leaves each: both shards' sets merge
+    assert registry.counter("fused_batch_merged_panels").value - merged0 \
+        >= 6, "per-shard leaf sets did not merge"
+    for q, w, g in zip(queries, want, got):
+        g = _series_map(g)
+        assert set(g) == set(w), q
+        for k in w:
+            np.testing.assert_allclose(g[k], w[k], rtol=2e-5, atol=1e-4,
+                                       equal_nan=True, err_msg=q)

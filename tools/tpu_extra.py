@@ -216,7 +216,7 @@ def section_shardmap(jax, jnp):
 
     def via_shardmap():
         out = fmesh._mesh_fused_call(
-            mesh, dv, dg, dvb, *mats, G=G, S=S, T=T, Tp=plan.Tp,
+            mesh, dv, dg[..., None], dvb, *mats, G=G, S=S, T=T, Tp=plan.Tp,
             is_counter=True, is_rate=True, interpret=False)
         counts = prep.gsize[:, None].astype(np.float64) * \
             plan.wvalid[None, :].astype(np.float64)
