@@ -461,6 +461,78 @@ def measure_fused_coverage():
     return results
 
 
+def measure_dashboard_batch(platform):
+    """Ops-level dashboard batching (r4): 8 aggregation panels over ONE
+    65k working set — merged multi-hot dispatch (fused_leaf_agg_batch)
+    vs one dispatch per panel (fused_leaf_agg).  A fused query through
+    the tunnel is dispatch-bound (doc/kernels.md), so this is the
+    dashboard-latency number; on-chip reference capture:
+    TPU_BATCH_r04.json (4.71x at 262k)."""
+    from filodb_tpu.ops import pallas_fused as pf
+    from filodb_tpu.ops.timewindow import make_window_ends
+    interpret = platform != "tpu"
+    if interpret and not os.environ.get("FILODB_TPU_FUSED_INTERPRET"):
+        return {"skipped": "kernel is MXU-targeted; no TPU backend"}
+    S, T, iters = 65_536, 720, 7
+    ts_row, vals = make_counter_data(S, T)
+    vbase64 = vals[:, 0].astype(np.float64)
+    vals32 = (vals.astype(np.float64) - vbase64[:, None]).astype(np.float32)
+    vbase32 = vbase64.astype(np.float32)
+    wends = make_window_ends(600_000, int(ts_row[-1]), 60_000)
+    plan = pf.build_plan(ts_row.astype(np.int64),
+                         np.asarray(wends, np.int64), 300_000)
+    pv = pf.pad_values(vals32, vbase32, plan)
+    groupings = [(1000, "sum"), (100, "avg"), (10, "sum"), (8, "sum"),
+                 (500, "sum"), (50, "avg"), (250, "sum"), (2, "sum")]
+    panels = [(pf.pad_groups((np.arange(S) % g).astype(np.int32), S, g),
+               g, op) for g, op in groupings]
+
+    def batched():
+        return pf.fused_leaf_agg_batch(plan, pv, panels, "rate",
+                                       precorrected=True,
+                                       interpret=interpret, ragged=False,
+                                       num_series=S)
+
+    # host copies OUTSIDE the timed region: fused_leaf_agg only takes
+    # len(gids) from this, and a per-iteration device pull would bias
+    # sequential_p50_s (and so the speedup) upward
+    gids_rows = [np.asarray(groups.gids_p[:S, 0]) for groups, _, _ in panels]
+
+    def sequential():
+        out = []
+        for (g, op), (groups, G, _), grow in zip(groupings, panels,
+                                                 gids_rows):
+            prep = pf.PreparedInputs(pv.vals_p, pv.vbase_p,
+                                     groups.gids_p, groups.gsize)
+            out.append(pf.fused_leaf_agg(
+                plan, prep, grow, G, "rate", op,
+                precorrected=True, interpret=interpret))
+        return out
+
+    st = {"series": S, "panels": len(panels),
+          "total_groups": sum(g for g, _ in groupings)}
+    t0 = time.perf_counter()
+    got_b = batched()
+    st["batched_compile_s"] = round(time.perf_counter() - t0, 2)
+    t0 = time.perf_counter()
+    got_s = sequential()
+    st["sequential_compile_s"] = round(time.perf_counter() - t0, 2)
+    for name, fn in (("batched", batched), ("sequential", sequential)):
+        ts = []
+        for _ in range(iters):
+            t1 = time.perf_counter()
+            fn()
+            ts.append(time.perf_counter() - t1)
+        ts.sort()
+        st[f"{name}_p50_s"] = round(ts[len(ts) // 2], 5)
+    st["speedup_p50"] = round(st["sequential_p50_s"]
+                              / st["batched_p50_s"], 2)
+    st["max_rel_err_batched_vs_sequential"] = max(
+        float(np.nanmax(np.abs(b - q) / np.maximum(np.abs(q), 1e-6)))
+        for b, q in zip(got_b, got_s))
+    return st
+
+
 def host_baselines(ts_row, vals, gids, wends, range_ms, span):
     """CPU reference numbers (vectorized + per-window iterator)."""
     G = int(gids.max()) + 1
@@ -532,6 +604,9 @@ def assemble_result(platform, stages, vec_sps, it_sps, partial=False):
     for k in ("fused_coverage_dense", "fused_coverage_ragged"):
         if k in cov:
             result[k] = cov[k]
+    db = stages.get("dashboard_batch", {})
+    if "speedup_p50" in db:
+        result["dashboard_batch_speedup"] = db["speedup_p50"]
     ns = stages.get("north_star_1m") or stages.get("cpu_north_star_1m")
     if ns and "samples_per_sec" in ns:
         result.update({
@@ -630,6 +705,15 @@ def run_worker(args):
     except Exception as e:  # noqa: BLE001 — coverage must not sink the run
         writer.stage("fused_coverage",
                      {"error": f"{type(e).__name__}: {e}"[:300]})
+
+    if not quick:
+        try:
+            db = measure_dashboard_batch(platform)
+            writer.stage("dashboard_batch", db)
+            stages["dashboard_batch"] = db
+        except Exception as e:  # noqa: BLE001 — must not sink the run
+            writer.stage("dashboard_batch",
+                         {"error": f"{type(e).__name__}: {e}"[:300]})
 
     result = assemble_result(platform, stages, vec_sps, it_sps)
     result["jax_platform"] = raw_platform

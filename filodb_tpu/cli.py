@@ -39,13 +39,22 @@ def _local_engine(ms, dataset: str, num_shards: int):
     return QueryEngine(dataset, ms, mapper)
 
 
-def _http_get(host: str, path: str, params: Dict[str, str]) -> dict:
+def _http_get(host: str, path: str, params: Dict[str, str],
+              data: bytes = None, timeout: int = 60) -> dict:
+    """GET (or POST when `data` is given) with the shared JSON error
+    handling every CLI command goes through."""
     import urllib.error
     import urllib.parse
     import urllib.request
-    url = f"http://{host}{path}?{urllib.parse.urlencode(params)}"
+    url = f"http://{host}{path}"
+    if params:
+        url += f"?{urllib.parse.urlencode(params)}"
+    req = urllib.request.Request(
+        url, data=data,
+        headers=({"Content-Type": "application/json"} if data else {}),
+        method="POST" if data is not None else "GET")
     try:
-        with urllib.request.urlopen(url, timeout=60) as r:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
             return json.loads(r.read())
     except urllib.error.HTTPError as e:
         try:
@@ -215,24 +224,11 @@ def cmd_querybatch(args) -> int:
     start = args.start or end - 1800
     queries = list(args.promql)
     if args.host:
-        import urllib.error
-        import urllib.request
         body = json.dumps({"queries": queries, "start": start, "end": end,
                            "step": args.step}).encode()
-        req = urllib.request.Request(
-            f"http://{args.host}/promql/{args.dataset}/api/v1/"
-            f"query_range_batch", data=body,
-            headers={"Content-Type": "application/json"}, method="POST")
-        try:
-            with urllib.request.urlopen(req, timeout=120) as r:
-                payload = json.loads(r.read())
-        except urllib.error.HTTPError as e:
-            try:
-                payload = json.loads(e.read())
-            except Exception:  # noqa: BLE001 — non-JSON error body
-                payload = {"status": "error", "error": str(e)}
-        except urllib.error.URLError as e:
-            payload = {"status": "error", "error": str(e)}
+        payload = _http_get(
+            args.host, f"/promql/{args.dataset}/api/v1/query_range_batch",
+            {}, data=body, timeout=120)
     else:
         from filodb_tpu.query.engine import QueryEngine
         ms, _, _ = _open_local(args.data_dir, args.dataset, args.shards)

@@ -450,6 +450,11 @@ class MeshExecutor:
         # fused-path plan/mats cache: (shared_ts_row, wends, range) ->
         # (device selection matrices, wvalid); see _run_agg_fused
         self._fused_plan_cache: Dict[Tuple, Tuple] = {}
+        # run_agg_batch merged-gid cache: (id(pack), panels, fn) -> the
+        # device-resident [D, S, P] grouping matrix (+ the pack ref to
+        # pin identity), so a dashboard refresh loop over a warm pack
+        # skips the per-panel host remaps AND the gid upload
+        self._batch_gid_cache: Dict[Tuple, Dict] = {}
         # queries can reach the executor from HTTP worker threads (same
         # contract as the leaf caches' _FUSED_CACHE_LOCK in query/exec.py):
         # every cache read-modify-write below holds this lock; device work
@@ -647,6 +652,51 @@ class MeshExecutor:
             # one (empty values, no labels) tuple per panel
             empty = np.zeros((0, np.asarray(wends).shape[0]))
             return [(empty, []) for _ in panels]
+        panels_key = tuple((tuple(by), tuple(wo), op)
+                           for by, wo, op in panels)
+        merged_key = (id(packed), panels_key, fn_name)
+        with self._cache_lock:
+            cached = self._batch_gid_cache.get(("panels",) + merged_key)
+        if cached is not None and cached["packed"] is packed:
+            kpanels, kmap, klabels = cached["kpanels"], cached["kmap"], \
+                cached["klabels"]
+        else:
+            kpanels, kmap, klabels = self._panel_groupings(packed, panels)
+            with self._cache_lock:
+                self._batch_gid_cache[("panels",) + merged_key] = {
+                    "packed": packed, "kpanels": kpanels, "kmap": kmap,
+                    "klabels": klabels}
+                while len(self._batch_gid_cache) > 8:
+                    self._batch_gid_cache.pop(
+                        next(iter(self._batch_gid_cache)))
+        if kpanels:
+            wends_p, W = self._prep_wends(packed, wends)
+            try:
+                fused = self._run_agg_fused_multi(
+                    packed, wends_p, W, range_ms, fn_name, kpanels,
+                    merged_key=merged_key)
+            except Exception as e:  # noqa: BLE001 — fusion is optional
+                from filodb_tpu.utils.metrics import (
+                    log_fused_degradation, registry as mreg)
+                mreg.counter("mesh_fused_errors").increment()
+                log_fused_degradation("mesh", e)
+                fused = None
+            if fused is not None:
+                for arr, idx, labels in zip(fused, kmap, klabels):
+                    results[idx] = (arr, labels)
+        for idx, (by, wo, op) in enumerate(panels):
+            if results[idx] is None:
+                pk = self.lookup_and_pack(filters, start_ms, end_ms,
+                                          by=by, without=wo,
+                                          fn_name=fn_name)
+                results[idx] = self.run_agg(pk, np.asarray(wends),
+                                            range_ms=range_ms,
+                                            fn_name=fn_name, agg_op=op)
+        return results
+
+    def _panel_groupings(self, packed: PackedShards, panels):
+        """Per-panel (gids, G, op, gsize) + labels over the pack's rows —
+        the host remap work run_agg_batch caches per (pack, panels)."""
         kpanels, kmap, klabels = [], [], []
         shards = list(self.memstore.shards_for(self.dataset))
         D, S, _ = packed.ts_off.shape
@@ -685,29 +735,7 @@ class MeshExecutor:
             kpanels.append((gids, G, op, gsize))
             kmap.append(idx)
             klabels.append(labels)
-        if kpanels:
-            wends_p, W = self._prep_wends(packed, wends)
-            try:
-                fused = self._run_agg_fused_multi(
-                    packed, wends_p, W, range_ms, fn_name, kpanels)
-            except Exception as e:  # noqa: BLE001 — fusion is optional
-                from filodb_tpu.utils.metrics import (
-                    log_fused_degradation, registry as mreg)
-                mreg.counter("mesh_fused_errors").increment()
-                log_fused_degradation("mesh", e)
-                fused = None
-            if fused is not None:
-                for arr, idx, labels in zip(fused, kmap, klabels):
-                    results[idx] = (arr, labels)
-        for idx, (by, wo, op) in enumerate(panels):
-            if results[idx] is None:
-                pk = self.lookup_and_pack(filters, start_ms, end_ms,
-                                          by=by, without=wo,
-                                          fn_name=fn_name)
-                results[idx] = self.run_agg(pk, np.asarray(wends),
-                                            range_ms=range_ms,
-                                            fn_name=fn_name, agg_op=op)
-        return results
+        return kpanels, kmap, klabels
 
     def run_agg(self, packed: PackedShards, wends: np.ndarray, *,
                 range_ms: int, fn_name: Optional[str], agg_op: str,
@@ -755,7 +783,9 @@ class MeshExecutor:
     def _run_agg_fused_multi(self, packed: PackedShards,
                              wends_p: np.ndarray, W: int, range_ms: int,
                              fn_name: Optional[str],
-                             kpanels) -> Optional[List[np.ndarray]]:
+                             kpanels,
+                             merged_key: Optional[Tuple] = None
+                             ) -> Optional[List[np.ndarray]]:
         """sum/avg/count(rate|increase|delta|*_over_time) over a
         uniform-grid pack via the Pallas MXU kernel (ops/pallas_fused.py)
         composed inside shard_map: per-time-slice selection-matrix plans
@@ -867,18 +897,33 @@ class MeshExecutor:
             if len(kidx) == 1 and kpanels[kidx[0]][0] is None:
                 gids_dev = packed.group_ids[..., None]
             else:
-                cols = []
-                for j, i in enumerate(kidx):
-                    g = kpanels[i][0]
-                    if g is None:
-                        g = np.asarray(packed.group_ids)
-                    # pack pad rows carry gid 0 over zeroed/NaN values:
-                    # offset keeps them harmless (+0 sums, 0 presence)
-                    cols.append(np.where(g >= 0, g + offsets[j], -1)
-                                .astype(np.int32))
-                gids_dev = jax.device_put(
-                    np.stack(cols, axis=-1),
-                    NamedSharding(self.mesh, P("shard", None, None)))
+                gids_dev = None
+                if merged_key is not None:
+                    with self._cache_lock:
+                        ent2 = self._batch_gid_cache.get(merged_key)
+                    if ent2 is not None and ent2["packed"] is packed:
+                        gids_dev = ent2["gids_dev"]
+                if gids_dev is None:
+                    cols = []
+                    for j, i in enumerate(kidx):
+                        g = kpanels[i][0]
+                        if g is None:
+                            g = np.asarray(packed.group_ids)
+                        # pack pad rows carry gid 0 over zeroed/NaN
+                        # values: offset keeps them harmless (+0 sums,
+                        # 0 presence)
+                        cols.append(np.where(g >= 0, g + offsets[j], -1)
+                                    .astype(np.int32))
+                    gids_dev = jax.device_put(
+                        np.stack(cols, axis=-1),
+                        NamedSharding(self.mesh, P("shard", None, None)))
+                    if merged_key is not None:
+                        with self._cache_lock:
+                            self._batch_gid_cache[merged_key] = {
+                                "packed": packed, "gids_dev": gids_dev}
+                            while len(self._batch_gid_cache) > 4:
+                                self._batch_gid_cache.pop(
+                                    next(iter(self._batch_gid_cache)))
             res = _mesh_fused_call(
                 self.mesh, packed.values, gids_dev, vbase, *mats,
                 G=Gtot, S=S, T=T, Tp=Tp,

@@ -364,3 +364,9 @@ def test_run_agg_batch_matches_individual(store4, mesh42, monkeypatch):
         assert g_out.shape == w_out.shape, key
         np.testing.assert_allclose(g_out, w_out, rtol=1e-6, atol=1e-9,
                                    equal_nan=True, err_msg=str(key))
+    # warm repeat (the dashboard refresh loop): per-panel remaps and the
+    # merged gid upload come from _batch_gid_cache; results identical
+    again = ex.run_agg_batch(filters, t0, t1, wends, range_ms=300_000,
+                             fn_name="rate", panels=panels)
+    for (g_out, _), (a_out, _) in zip(got, again):
+        np.testing.assert_array_equal(g_out, a_out)
