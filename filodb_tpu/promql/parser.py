@@ -548,7 +548,8 @@ class _Converter:
                 fn_args.append(a.value)
             else:
                 target = a
-        def selector_window_plan(sel, window_ms, window_is_lookback=False):
+        def selector_window_plan(sel, window_ms, window_is_lookback=False,
+                                 fn_name=None):
             at = self._resolve_at(sel.at_ms)
             s, en = (at, at) if at is not None else (start, end)
             raw = lp.RawSeries(
@@ -557,7 +558,7 @@ class _Converter:
                 columns=(sel.column,) if sel.column else (),
                 offset_ms=sel.offset_ms or None)
             plan = lp.PeriodicSeriesWithWindowing(
-                raw, s, step, en, window_ms, e.name,
+                raw, s, step, en, window_ms, fn_name or e.name,
                 tuple(fn_args), offset_ms=sel.offset_ms or None,
                 window_is_lookback=window_is_lookback)
             if at is not None:
@@ -565,6 +566,18 @@ class _Converter:
             return plan
 
         if isinstance(target, A.MatrixSelector):
+            if e.name == "absent_over_time":
+                # upstream synthesizes the answer from the selector's
+                # equality matchers even when NO series match (ref:
+                # promql/functions.go funcAbsentOverTime; caught by the
+                # round-4 corpus): plan the per-series presence scan,
+                # then the absent transformer reduces across series and
+                # carries the matcher labels
+                plan = selector_window_plan(target.selector,
+                                            target.range_ms,
+                                            fn_name="present_over_time")
+                return lp.ApplyAbsentFunction(
+                    plan, _filters(target.selector), start, step, end)
             return selector_window_plan(target.selector, target.range_ms)
         if isinstance(target, A.Subquery):
             sq = target
@@ -576,9 +589,18 @@ class _Converter:
             # subquery window: inner data must span [start-off-window, end-off]
             inner = self._conv(sq.expr, s - off - sq.window_ms,
                                inner_step, en - off)
+            fn_name = e.name
+            wrap_absent = fn_name == "absent_over_time"
+            if wrap_absent:
+                # same cross-series reduction as the MatrixSelector case;
+                # subqueries expose no matchers, so the synthesized row
+                # carries empty labels (ref: funcAbsentOverTime)
+                fn_name = "present_over_time"
             plan = lp.SubqueryWithWindowing(
-                inner, s, step, en, e.name, tuple(fn_args),
+                inner, s, step, en, fn_name, tuple(fn_args),
                 sq.window_ms, inner_step, offset_ms=sq.offset_ms or None)
+            if wrap_absent:
+                plan = lp.ApplyAbsentFunction(plan, (), start, step, end)
             if at is not None:
                 return lp.ApplyAtTimestamp(plan, start, step, end)
             return plan

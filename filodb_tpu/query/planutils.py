@@ -348,6 +348,16 @@ def unparse(plan: lp.LogicalPlan) -> str:
     if isinstance(plan, lp.ApplySortFunction):
         return f"{plan.function}({u(plan.vectors)})"
     if isinstance(plan, lp.ApplyAbsentFunction):
+        # absent_over_time plans as ApplyAbsentFunction over a
+        # present_over_time windowing (parser r4); unparse back to the
+        # surface form so a remote re-parse keeps the matcher labels —
+        # absent(present_over_time(...)) would re-parse with filters=()
+        inner = plan.vectors
+        if isinstance(inner, (lp.PeriodicSeriesWithWindowing,
+                              lp.SubqueryWithWindowing)) \
+                and inner.function == "present_over_time":
+            return "absent_over_time(" \
+                + u(inner)[len("present_over_time("):]
         return f"absent({u(plan.vectors)})"
     if isinstance(plan, lp.ApplyLimitFunction):
         return f"limitk({plan.limit},{u(plan.vectors)})"

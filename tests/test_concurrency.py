@@ -264,3 +264,70 @@ def test_write_lock_stall_detection():
     after = registry.counter("write_lock_stalls", dataset="prometheus",
                              shard="0").value
     assert after == before + 1
+
+
+def test_concurrent_ingest_batch_query_matches_quiesced(monkeypatch):
+    """query_range_batch racing live ingest: the two-phase leaf protocol
+    (prepare_fused parks a gather, finish runs the merged kernel, the
+    tree executes from the parked snapshot) must only ever see valid
+    seqlock snapshots, and the quiesced batch must equal per-query
+    results on an unconcurrent store."""
+    monkeypatch.setenv("FILODB_TPU_FUSED_INTERPRET", "1")
+    ms = TimeSeriesMemStore()
+    sh = ms.setup("prometheus", 0)
+    sh.ingest(_slice_batch(0, 60), offset=0)
+    eng = QueryEngine("prometheus", ms)
+    s = START // 1000
+    panels = ['sum by (_ns_)(rate(request_total[5m]))',
+              'avg by (dc)(rate(request_total[5m]))',
+              'sum by (dc)(rate(request_total[5m]))']
+    args = (s + 600, 60, s + TOTAL * 10)
+
+    errors = []
+
+    def ingester():
+        idx, o = 60, 1
+        while idx < TOTAL:
+            try:
+                sh.ingest(_slice_batch(idx, 30), offset=o)
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+                return
+            idx += 30
+            o += 1
+
+    def querier():
+        while ing.is_alive():
+            try:
+                for res in eng.query_range_batch(panels, *args):
+                    assert res.error is None, res.error
+                    for _, _, vs in res.series():
+                        arr = np.asarray(vs)
+                        finite = arr[np.isfinite(arr)]
+                        assert (finite >= 0).all()
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+                return
+
+    ing = threading.Thread(target=ingester)
+    qs = [threading.Thread(target=querier) for _ in range(2)]
+    ing.start()
+    for q in qs:
+        q.start()
+    ing.join(timeout=120)
+    for q in qs:
+        q.join(timeout=120)
+    assert not errors, errors[:3]
+
+    ms2 = TimeSeriesMemStore()
+    ms2.setup("prometheus", 0).ingest(_slice_batch(0, TOTAL))
+    eng2 = QueryEngine("prometheus", ms2)
+    got = eng.query_range_batch(panels, *args)
+    for q, res in zip(panels, got):
+        want = eng2.query_range(q, *args)
+        w = {str(k): np.asarray(v) for k, _, v in want.series()}
+        g = {str(k): np.asarray(v) for k, _, v in res.series()}
+        assert set(g) == set(w), q
+        for k in w:
+            np.testing.assert_allclose(g[k], w[k], rtol=2e-5, atol=1e-4,
+                                       equal_nan=True, err_msg=q)

@@ -225,3 +225,24 @@ def test_subquery_at_modifier_pins_grid():
         "rate(foo[5m])[30m:1m] @ 1600000000", T)
     assert isinstance(plan, lp.ApplyAtTimestamp) and not plan.repeat
     assert plan.inner.start_ms == plan.inner.end_ms == 1_600_000_000_000
+
+
+def test_absent_over_time_unparse_roundtrip():
+    """absent_over_time plans as ApplyAbsentFunction(present_over_time);
+    the remote-dispatch unparse must render the SURFACE form so a remote
+    re-parse keeps the selector's matcher labels (review r4: the naive
+    absent(present_over_time(...)) rendering re-parsed with filters=())."""
+    from filodb_tpu.promql.parser import (TimeStepParams,
+                                          query_range_to_logical_plan)
+    from filodb_tpu.query import planutils as pu
+    tsp = TimeStepParams(1000, 60, 2000)
+    plan = query_range_to_logical_plan(
+        'absent_over_time(gappy{l="g"}[10m])', tsp)
+    q = pu.unparse(plan)
+    assert q == 'absent_over_time(gappy{l="g"}[10m])'
+    plan2 = query_range_to_logical_plan(q, tsp)
+    assert plan2.filters == plan.filters and plan.filters
+    sq = query_range_to_logical_plan(
+        'absent_over_time(metricx[10m:1m])', tsp)
+    sq2 = query_range_to_logical_plan(pu.unparse(sq), tsp)
+    assert type(sq2).__name__ == "ApplyAbsentFunction"
