@@ -599,10 +599,13 @@ class _Converter:
             plan = lp.SubqueryWithWindowing(
                 inner, s, step, en, fn_name, tuple(fn_args),
                 sq.window_ms, inner_step, offset_ms=sq.offset_ms or None)
-            if wrap_absent:
-                plan = lp.ApplyAbsentFunction(plan, (), start, step, end)
             if at is not None:
-                return lp.ApplyAtTimestamp(plan, start, step, end)
+                plan = lp.ApplyAtTimestamp(plan, start, step, end)
+            if wrap_absent:
+                # absent OUTERMOST, matching the MatrixSelector nesting —
+                # ApplyAtTimestamp(ApplyAbsentFunction(...)) has no
+                # unparse form and would crash remote dispatch (review r4)
+                plan = lp.ApplyAbsentFunction(plan, (), start, step, end)
             return plan
         if e.name == "timestamp":
             if isinstance(target, A.VectorSelector):

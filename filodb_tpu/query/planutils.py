@@ -348,14 +348,22 @@ def unparse(plan: lp.LogicalPlan) -> str:
     if isinstance(plan, lp.ApplySortFunction):
         return f"{plan.function}({u(plan.vectors)})"
     if isinstance(plan, lp.ApplyAbsentFunction):
-        # absent_over_time plans as ApplyAbsentFunction over a
-        # present_over_time windowing (parser r4); unparse back to the
-        # surface form so a remote re-parse keeps the matcher labels —
-        # absent(present_over_time(...)) would re-parse with filters=()
+        # absent_over_time over a selector plans as ApplyAbsentFunction
+        # (filters = the selector's matchers) over a present_over_time
+        # windowing, possibly @-pinned (parser r4); unparse back to the
+        # SURFACE form so a remote re-parse keeps the matcher labels —
+        # absent(present_over_time(...)) re-parses with filters=().
+        # Guarded on non-empty filters: a genuine user-written
+        # absent(present_over_time(sel[w])) carries filters=() and must
+        # NOT gain the selector's labels through a rewrite (review r4);
+        # the subquery lowering also has filters=() and round-trips
+        # structurally through the absent() rendering below.
         inner = plan.vectors
-        if isinstance(inner, (lp.PeriodicSeriesWithWindowing,
-                              lp.SubqueryWithWindowing)) \
-                and inner.function == "present_over_time":
+        look = (inner.inner if isinstance(inner, lp.ApplyAtTimestamp)
+                else inner)
+        if plan.filters \
+                and isinstance(look, lp.PeriodicSeriesWithWindowing) \
+                and look.function == "present_over_time":
             return "absent_over_time(" \
                 + u(inner)[len("present_over_time("):]
         return f"absent({u(plan.vectors)})"

@@ -297,7 +297,11 @@ def test_concurrent_ingest_batch_query_matches_quiesced(monkeypatch):
             o += 1
 
     def querier():
-        while ing.is_alive():
+        done = False
+        while not done:
+            # final iteration AFTER ingest completes: at least one batch
+            # always runs even if ingestion wins the scheduling race
+            done = not ing.is_alive()
             try:
                 for res in eng.query_range_batch(panels, *args):
                     assert res.error is None, res.error
@@ -317,6 +321,11 @@ def test_concurrent_ingest_batch_query_matches_quiesced(monkeypatch):
     ing.join(timeout=120)
     for q in qs:
         q.join(timeout=120)
+    # a timed-out join returns with the thread still alive: the quiesced
+    # comparison below would race live ingest and misattribute the
+    # failure to the seqlock protocol
+    assert not ing.is_alive(), "ingester still running after timeout"
+    assert not any(q.is_alive() for q in qs), "querier hung"
     assert not errors, errors[:3]
 
     ms2 = TimeSeriesMemStore()
