@@ -38,6 +38,13 @@ class QueryConfig:
     min_step_ms: int = 5_000
     fastreduce_max_windows: int = 50
     faster_rate: bool = True
+    # server-side micro-batching: concurrent HTTP query_range requests
+    # over the same window grid arriving within this many ms coalesce
+    # into ONE engine.query_range_batch (merged kernel dispatches) —
+    # the batching win for UNMODIFIED dashboard clients that issue one
+    # request per panel.  0 disables (default: opt-in, it trades up to
+    # this much added latency for dispatch amortization).
+    batch_window_ms: float = 0.0
 
 
 @dataclasses.dataclass

@@ -29,11 +29,25 @@ class PromHttpApi:
     def __init__(self, engines: Dict[str, QueryEngine],
                  gateways: Optional[Dict[str, object]] = None,  # GatewayPipeline per dataset
                  shard_mappers: Optional[Dict[str, object]] = None,
-                 default_dataset: Optional[str] = None):
+                 default_dataset: Optional[str] = None,
+                 batch_window_ms: Optional[float] = None):
         self.engines = engines
         self.gateways = gateways or {}
         self.shard_mappers = shard_mappers or {}
         self.default_dataset = default_dataset or next(iter(engines), None)
+        # server-side micro-batching (query.batch_window_ms > 0):
+        # concurrent query_range requests over one window grid coalesce
+        # into merged kernel dispatches for unmodified dashboard clients.
+        # The window comes from the CALLER's config when given (FiloServer
+        # injects its own FilodbSettings); the settings() singleton is
+        # only the fallback for bare constructions.
+        from filodb_tpu.query.coalesce import QueryCoalescer
+        if batch_window_ms is None:
+            from filodb_tpu.config import settings
+            batch_window_ms = settings().query.batch_window_ms
+        self.coalescers = {name: QueryCoalescer(eng,
+                                                batch_window_ms / 1000.0)
+                           for name, eng in engines.items()}
 
     # ------------------------------------------------------------ dispatch
 
@@ -98,7 +112,8 @@ class PromHttpApi:
             step = max(_num_param(params, "step", "15"), 1)
             if params.get("explain") in ("true", "1"):
                 return self._explain(eng, q, start, step, end)
-            res = eng.query_range(q, start, step, end, planner_params)
+            res = self.coalescers[dataset].query_range(
+                q, start, step, end, planner_params)
             payload = QueryEngine.to_prom_matrix(res)
             if res.trace_id:
                 payload["traceID"] = res.trace_id

@@ -87,7 +87,10 @@ class FiloHttpServer:
         self._thread.start()
 
     def stop(self) -> None:
-        self._httpd.shutdown()
+        # stdlib shutdown() BLOCKS until serve_forever acknowledges —
+        # forever if the serving thread was never started
+        if self._thread is not None:
+            self._httpd.shutdown()
         self._httpd.server_close()
         if self._thread:
             self._thread.join(timeout=5)

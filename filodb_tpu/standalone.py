@@ -65,7 +65,9 @@ class FiloServer:
         first = self.datasets[0].name
         self.api = PromHttpApi(self.engines, gateways=self.gateways,
                                shard_mappers=self.mappers,
-                               default_dataset=first)
+                               default_dataset=first,
+                               batch_window_ms=self.config.query
+                               .batch_window_ms)
         self.http = FiloHttpServer(self.api, http_host, http_port)
 
     # ------------------------------------------------------------- wiring

@@ -321,3 +321,67 @@ def test_cli_querybatch(tmp_path, capsys):
         assert r["status"] == "success"
         apps = {m["metric"]["app"] for m in r["data"]["result"]}
         assert apps == {"web", "db"}
+
+
+def test_http_micro_batching_coalesces_panels(monkeypatch):
+    """query.batch_window_ms > 0: concurrent query_range HTTP requests
+    (one per dashboard panel, as Grafana sends them) coalesce into
+    merged kernel dispatches server-side, responses unchanged."""
+    import threading
+
+    from filodb_tpu.config import settings
+    from filodb_tpu.utils.metrics import registry
+
+    monkeypatch.setenv("FILODB_TPU_FUSED_INTERPRET", "1")
+    monkeypatch.setattr(settings().query, "batch_window_ms", 250.0)
+    srv = FiloServer([DatasetConfig("prometheus", num_shards=1)],
+                     http_port=0)
+    srv.memstore.get_shard("prometheus", 0).ingest(
+        counter_batch(30, 240, start_ms=START))
+    srv.start()
+    try:
+        queries = ['sum(rate(request_total[5m])) by (_ns_)',
+                   'avg(rate(request_total[5m])) by (dc)',
+                   'sum(rate(request_total[5m])) by (dc)']
+        args = {"start": START_S + 600, "end": START_S + 2390, "step": 60}
+        # warm the mirror (sequential; not coalesced with the batch below)
+        _get(srv, "/promql/prometheus/api/v1/query_range",
+             query=queries[0], **args)
+        want = [_get(srv, "/promql/prometheus/api/v1/query_range",
+                     query=q, **args)[1] for q in queries]
+        merged0 = registry.counter("fused_batch_merged_panels").value
+        got = {}
+
+        def call(q):
+            got[q] = _get(srv, "/promql/prometheus/api/v1/query_range",
+                          query=q, **args)
+
+        threads = [threading.Thread(target=call, args=(q,))
+                   for q in queries]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        assert registry.counter("fused_batch_merged_panels").value \
+            - merged0 >= 2, "HTTP requests did not coalesce"
+        for q, w in zip(queries, want):
+            st, payload = got[q]
+            assert st == 200
+            assert payload["data"]["result"] == w["data"]["result"], q
+    finally:
+        srv.shutdown()
+
+
+def test_injected_config_controls_batch_window():
+    """The coalescing window must follow the INJECTED FilodbSettings, not
+    the global singleton (review r4)."""
+    from filodb_tpu.config import FilodbSettings
+    cfg = FilodbSettings()
+    cfg.query.batch_window_ms = 123.0
+    srv = FiloServer([DatasetConfig("prometheus", num_shards=1)],
+                     http_port=0, config=cfg)
+    try:
+        co = srv.api.coalescers["prometheus"]
+        assert co.window_s == pytest.approx(0.123)
+    finally:
+        srv.shutdown()

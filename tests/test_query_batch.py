@@ -150,3 +150,69 @@ def test_batch_multi_shard(fused_env):
         for k in w:
             np.testing.assert_allclose(g[k], w[k], rtol=2e-5, atol=1e-4,
                                        equal_nan=True, err_msg=q)
+
+
+def test_coalescer_merges_concurrent_queries(fused_env):
+    """Server-side micro-batching: concurrent query_range calls over one
+    window grid coalesce into a single engine batch with per-query
+    results identical to direct execution."""
+    import threading
+
+    from filodb_tpu.query.coalesce import QueryCoalescer
+    engine = _mk()
+    args = (START_S + 600, 60, END_S)
+    for q in PANELS[:4]:
+        assert engine.query_range(q, *args).error is None   # warm mirror
+    co = QueryCoalescer(engine, window_s=0.25)
+    merged0 = registry.counter("fused_batch_merged_panels").value
+    results = {}
+    errors = []
+
+    def call(q):
+        try:
+            results[q] = _series_map(co.query_range(q, *args))
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    threads = [threading.Thread(target=call, args=(q,))
+               for q in PANELS[:4]]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    assert not errors, errors
+    assert registry.counter("fused_batch_merged_panels").value - merged0 \
+        >= 3, "concurrent queries did not coalesce"
+    for q in PANELS[:4]:
+        want = _series_map(engine.query_range(q, *args))
+        assert set(results[q]) == set(want), q
+        for k in want:
+            np.testing.assert_allclose(results[q][k], want[k], rtol=2e-5,
+                                       atol=1e-4, equal_nan=True,
+                                       err_msg=q)
+
+
+def test_coalescer_window_zero_is_passthrough(fused_env):
+    from filodb_tpu.query.coalesce import QueryCoalescer
+    engine = _mk()
+    args = (START_S + 600, 60, END_S)
+    co = QueryCoalescer(engine, window_s=0.0)
+    got = _series_map(co.query_range(PANELS[0], *args))
+    want = _series_map(engine.query_range(PANELS[0], *args))
+    assert set(got) == set(want)
+
+
+def test_coalescer_failed_batch_falls_back(fused_env, monkeypatch):
+    """A batch-path failure must not lose queries that succeed alone."""
+    from filodb_tpu.query.coalesce import QueryCoalescer
+    engine = _mk()
+    args = (START_S + 600, 60, END_S)
+
+    def boom(*a, **k):
+        raise RuntimeError("batch path down")
+
+    monkeypatch.setattr(engine, "query_range_batch", boom)
+    co = QueryCoalescer(engine, window_s=0.05)
+    res = co.query_range(PANELS[0], *args)
+    assert res.error is None
+    assert _series_map(res)
