@@ -276,3 +276,48 @@ def test_bench_downsample_smoke():
     import json
     line = json.loads(buf.getvalue().strip().splitlines()[-1])
     assert line["bench"] == "downsample" and line["value"] > 0
+
+
+def test_long_time_range_batch_matches_individual(monkeypatch):
+    """query_range_batch through the tiered LongTimeRangePlanner: batch
+    walks BOTH tiers' leaves (raw + downsample, with the ds-gauge
+    function substitution applied in the parked gather) and results
+    equal per-query execution."""
+    monkeypatch.setenv("FILODB_TPU_FUSED_INTERPRET", "1")
+    raw_cs, raw_meta = InMemoryColumnStore(), InMemoryMetaStore()
+    ms, shard, mapper, raw_eng = _mk_raw_engine(
+        raw_cs, raw_meta, [gauge_batch(8, 720, start_ms=START)])
+    dsr = ShardDownsampler(resolutions=(RES,))
+    shard.shard_downsampler = dsr
+    shard.flush_all_groups()
+    ds_store = DownsampledTimeSeriesStore(
+        "prometheus", column_store=InMemoryColumnStore(), resolutions=(RES,))
+    ds_store.setup_shard(0)
+    ds_store.ingest_downsample_batches(0, dsr.result_batches())
+    earliest_raw = START + 3_600_000
+    ltr = LongTimeRangePlanner(
+        SingleClusterPlanner("prometheus", mapper),
+        DownsampleClusterPlanner(ds_store, mapper),
+        lambda: earliest_raw, lambda: START + 720 * 10_000)
+
+    class _FanoutSource:
+        def get_shard(self, dataset, shard_num):
+            if "::ds::" in dataset:
+                return ds_store.get_shard(dataset, shard_num)
+            return ms.get_shard(dataset, shard_num)
+
+    eng = QueryEngine("prometheus", _FanoutSource(), mapper, planner=ltr)
+    panels = ['sum(max_over_time(heap_usage[10m]))',
+              'sum(min_over_time(heap_usage[10m])) by (_ns_)',
+              'sum(sum_over_time(heap_usage[10m])) by (dc)']
+    args = (ALIGNED_S + 1260, 300, ALIGNED_S + 7080)
+    want = [eng.query_range(q, *args) for q in panels]
+    got = eng.query_range_batch(panels, *args)
+    for q, w, g in zip(panels, want, got):
+        assert g.error is None, (q, g.error)
+        wm = {str(k): np.asarray(v) for k, _, v in w.series()}
+        gm = {str(k): np.asarray(v) for k, _, v in g.series()}
+        assert set(gm) == set(wm), q
+        for k in wm:
+            np.testing.assert_allclose(gm[k], wm[k], rtol=2e-5, atol=1e-4,
+                                       equal_nan=True, err_msg=q)
