@@ -67,7 +67,7 @@ class QueryEngine:
         should cost ONE dispatch, not P.  Three phases: (1) every
         in-process MultiSchemaPartitionsExec leaf runs its gather + fused
         preflight (prepare_fused), parking the gathered data; (2)
-        compatible FusedCalls merge via leafexec.finish_fused_calls
+        compatible FusedCalls merge via fusedbatch.finish_fused_calls
         (disjoint-group multi-hot epilogue, at most two dispatches per
         compatible set); (3) each tree executes normally, leaves reusing
         the parked data and injected partials.  Queries that don't fit
@@ -79,8 +79,8 @@ class QueryEngine:
         (amortizing dispatch the way the MXU amortizes FLOPs).
         """
         from filodb_tpu.query.execbase import InProcessPlanDispatcher
-        from filodb_tpu.query.leafexec import (MultiSchemaPartitionsExec,
-                                               finish_fused_calls)
+        from filodb_tpu.query.fusedbatch import finish_fused_calls
+        from filodb_tpu.query.leafexec import MultiSchemaPartitionsExec
         results: List[Optional[QueryResult]] = [None] * len(promqls)
         entries = []
         for i, q in enumerate(promqls):

@@ -38,99 +38,7 @@ from filodb_tpu.query.execbase import (
 from filodb_tpu.query.transformers import (
     AggregateMapReduce, PeriodicSamplesMapper, RangeVectorTransformer,
     _group_ids)
-
-
-@dataclasses.dataclass
-class FusedCall:
-    """A fused matmul-kernel leaf evaluation with everything resolved
-    except the kernel dispatch itself — the unit of merging for
-    engine.query_range_batch.  Compatible calls (same plan + device
-    values + function flavor) become panels of ONE
-    ops/pallas_fused.fused_leaf_agg_batch dispatch: the dashboard case,
-    where per-call dispatch latency dominates device time
-    (doc/kernels.md round-4 measurements)."""
-    plan: object                  # pf.FusedPlan
-    values: object                # pf.PaddedValues (device-resident)
-    groups: object                # pf.PaddedGroups
-    gkeys: List
-    wends: np.ndarray
-    fn: str
-    op: str
-    precorrected: bool
-    interpret: bool
-    ragged: bool
-    num_series: int
-    # semantic identity (mirror serial + snapshot gen + column + row
-    # subset + window params): lets equal-but-distinct plan/values
-    # objects merge when the LRU caches declined to share them
-    cache_key: Optional[tuple] = None
-
-    def compat_key(self):
-        base = (self.fn, self.precorrected, self.interpret, self.ragged)
-        if self.cache_key is not None:
-            return ("k",) + base + (self.cache_key,)
-        return ("id",) + base + (id(self.plan), id(self.values.vals_p))
-
-
-def finish_fused_calls(calls: List[FusedCall]) -> List[AggPartial]:
-    """Phase-2 of engine.query_range_batch: dispatch every FusedCall,
-    merging compatible ones into single kernel launches.  A merged set
-    whose combined group count would blow the VMEM budget is split back
-    into singleton dispatches instead of degrading to the general path
-    (the per-panel gate in _try_fused already passed)."""
-    from filodb_tpu.ops import pallas_fused as pf
-    out: List[Optional[AggPartial]] = [None] * len(calls)
-    by_key: Dict[tuple, List[int]] = {}
-    for i, fc in enumerate(calls):
-        by_key.setdefault(fc.compat_key(), []).append(i)
-    for idxs in by_key.values():
-        fc0 = calls[idxs[0]]
-        while idxs:
-            take = idxs
-            def in_group_mode(i):
-                # which panels join the merged group-mode dispatch: min/max
-                # run per-series (Gp-independent) and dense count is host
-                # math, so neither counts toward the multi-hot group total
-                op = calls[i].op
-                return op in ("sum", "avg") or (op == "count" and fc0.ragged)
-
-            if len(idxs) > 1:
-                Tp = fc0.plan.Tp
-                Wp = pf._pad_to(max(fc0.plan.W, 1), pf._LANE)
-                over_time = fc0.fn in pf.OVER_TIME_FNS
-                ragged_rate = fc0.ragged and fc0.fn in ("rate", "increase",
-                                                        "delta")
-                while len(take) > 1:
-                    total = sum(len(calls[i].gkeys) for i in take
-                                if in_group_mode(i))
-                    if total == 0 or pf.pick_block(
-                            Tp, Wp, pf._pad_to(max(total, 8), 8),
-                            over_time, ragged_rate) is not None:
-                        break
-                    take = take[:max(1, len(take) // 2)]
-            panels = [(calls[i].groups, len(calls[i].gkeys), calls[i].op)
-                      for i in take]
-            if len(take) > 1:
-                # observability of the batching win: actual kernel
-                # launches this merged set costs (group-mode + per-series
-                # mode), and how many panels shared them
-                from filodb_tpu.utils.metrics import registry
-                launches = (any(in_group_mode(i) for i in take)
-                            + any(calls[i].op in ("min", "max")
-                                  for i in take))
-                registry.counter("fused_batch_dispatches") \
-                    .increment(launches)
-                registry.counter("fused_batch_merged_panels") \
-                    .increment(len(take))
-            comps = pf.fused_leaf_agg_batch(
-                fc0.plan, fc0.values, panels, fc0.fn,
-                precorrected=fc0.precorrected, interpret=fc0.interpret,
-                ragged=fc0.ragged, num_series=fc0.num_series)
-            for i, comp in zip(take, comps):
-                out[i] = AggPartial(calls[i].op, calls[i].gkeys,
-                                    calls[i].wends, comp=comp)
-            idxs = idxs[len(take):]
-    return out
+from filodb_tpu.query.fusedbatch import FusedCall, finish_fused_calls
 
 
 class MultiSchemaPartitionsExec(LeafExecPlan):

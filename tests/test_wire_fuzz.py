@@ -54,9 +54,9 @@ def _rand_obj(rng, depth):
 
 
 def _assert_eq(a, b, path="$"):
-    assert type(a) is type(b) or (
-        isinstance(a, (int, float)) and isinstance(b, (int, float))), \
-        (path, type(a), type(b))
+    # STRICT type identity: bool->int or int->float collapses in the
+    # codec are exactly the wire-fidelity bugs this fuzz exists to catch
+    assert type(a) is type(b), (path, type(a), type(b))
     if isinstance(a, np.ndarray):
         assert a.dtype == b.dtype and a.shape == b.shape, path
         np.testing.assert_array_equal(a, b, err_msg=path)
