@@ -178,6 +178,141 @@ def query_stress(minutes: float, series: int = 2_000,
     return ok
 
 
+def batch_query_stress(minutes: float, series: int = 2_000,
+                       batch_threads: int = 2,
+                       coalesce_threads: int = 3) -> bool:
+    """Dashboard-batch machinery under live ingest for the duration:
+    rotating panel sets through engine.query_range_batch AND single
+    panels through the server-side coalescer (query/coalesce.py), every
+    result verified, RSS tracked — the leak check for the r4 batch
+    caches (merged gid matrices, panel groupings, coalescer groups)
+    whose entries pin device arrays."""
+    import numpy as np
+    from filodb_tpu.core.memstore import TimeSeriesMemStore
+    from filodb_tpu.core.records import RecordBatch
+    from filodb_tpu.ingest.generator import counter_batch
+    from filodb_tpu.query.coalesce import QueryCoalescer
+    from filodb_tpu.query.engine import QueryEngine
+    from filodb_tpu.query.rangevector import PlannerParams
+    had_interp = os.environ.get("FILODB_TPU_FUSED_INTERPRET")
+    os.environ["FILODB_TPU_FUSED_INTERPRET"] = "1"
+    START = 1_600_000_000_000
+    ms = TimeSeriesMemStore()
+    sh = ms.setup("stress", 0)
+    base = counter_batch(series, 1, start_ms=START)
+    warm = 180
+    ts = np.tile(START + np.arange(warm, dtype=np.int64) * 10_000, series)
+    idx = np.repeat(np.arange(series, dtype=np.int32), warm)
+    vals = np.arange(warm, dtype=np.float64)[None, :] * 5.0 \
+        + np.arange(series)[:, None]
+    sh.ingest(RecordBatch(base.schema, base.part_keys, idx, ts,
+                          {"count": vals.ravel()}))
+    pp = PlannerParams(sample_limit=200_000_000)
+    eng = QueryEngine("stress", ms)
+    co = QueryCoalescer(eng, window_s=0.02)
+    s0 = START // 1000
+    args = (s0 + 600, 60, s0 + 1700)
+    panel_sets = [
+        ['sum(rate(request_total[5m])) by (_ns_)',
+         'avg(rate(request_total[5m])) by (dc)',
+         'sum(rate(request_total[5m])) by (dc)'],
+        ['sum(rate(request_total[5m])) by (_ns_, dc)',
+         'count(rate(request_total[5m])) by (_ns_)',
+         'min(rate(request_total[5m])) by (dc)'],
+        ['sum(rate(request_total[5m]))',
+         'max(rate(request_total[5m])) by (_ns_)'],
+    ]
+    deadline = time.time() + minutes * 60
+    stop = threading.Event()
+    counts = [0] * (batch_threads + coalesce_threads)
+    errors: List[str] = []
+
+    nonempty = [0]
+
+    def check(res, q):
+        if res.error is not None:
+            errors.append(f"{q}: {res.error}")
+            return False
+        n = 0
+        for _, _, vs in res.series():
+            n += 1
+            arr = np.asarray(vs)
+            finite = arr[np.isfinite(arr)]
+            if finite.size and (finite < -1e-6).any():
+                errors.append(f"{q}: negative rate {finite.min()}")
+                return False
+        nonempty[0] += n > 0
+        return True
+
+    ingested = [0]
+
+    def ingester():
+        t_idx = warm
+        while not stop.is_set():
+            n = 10
+            its = np.tile(START + (t_idx + np.arange(n, dtype=np.int64))
+                          * 10_000, series)
+            iidx = np.repeat(np.arange(series, dtype=np.int32), n)
+            ivals = (t_idx + np.arange(n, dtype=np.float64))[None, :] \
+                * 5.0 + np.arange(series)[:, None]
+            sh.ingest(RecordBatch(base.schema, base.part_keys, iidx, its,
+                                  {"count": ivals.ravel()}))
+            t_idx += n
+            ingested[0] += n * series
+            time.sleep(0.01)
+
+    def batcher(i):
+        k = 0
+        while time.time() < deadline and not errors:
+            panels = panel_sets[k % len(panel_sets)]
+            k += 1
+            for q, res in zip(panels,
+                              eng.query_range_batch(panels, *args, pp)):
+                if not check(res, q):
+                    return
+            counts[i] += 1
+
+    def coalescer(i):
+        k = 0
+        while time.time() < deadline and not errors:
+            q = panel_sets[0][k % 3]
+            k += 1
+            if not check(co.query_range(q, *args, pp), q):
+                return
+            counts[i] += 1
+
+    rss0 = _rss_mb()
+    ing = threading.Thread(target=ingester, daemon=True)
+    ing.start()
+    threads = [threading.Thread(target=batcher, args=(i,))
+               for i in range(batch_threads)]
+    threads += [threading.Thread(target=coalescer,
+                                 args=(batch_threads + i,))
+                for i in range(coalesce_threads)]
+    try:
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+    finally:
+        stop.set()
+        if had_interp is None:
+            os.environ.pop("FILODB_TPU_FUSED_INTERPRET", None)
+        else:
+            os.environ["FILODB_TPU_FUSED_INTERPRET"] = had_interp
+    ing.join(timeout=10)
+    # "every result verified" must not hold vacuously: a regression
+    # returning zero series everywhere is a failure, not a pass
+    ok = not errors and sum(counts) > 0 and nonempty[0] > 0
+    # rss grows with the live-ingested working set; report the ingested
+    # volume alongside so cache leaks are distinguishable from data
+    _emit("batch", ok, rounds=sum(counts), errors=errors[:3],
+          ingested_samples=ingested[0],
+          ingested_mb=round(ingested[0] * 16 / 1e6, 1),
+          rss_start_mb=round(rss0, 1), rss_mb=round(_rss_mb(), 1))
+    return ok
+
+
 def north_star_soak(minutes: float, series: int = 1_048_576,
                     report_path: str = "") -> bool:
     """The full pipeline at the BASELINE.md north-star scale for the whole
@@ -323,7 +458,8 @@ def north_star_soak(minutes: float, series: int = 1_048_576,
 
 def main(argv=None):
     ap = argparse.ArgumentParser(description="filodb-tpu stress harnesses")
-    ap.add_argument("harness", choices=["ingest", "query", "soak", "all"])
+    ap.add_argument("harness",
+                    choices=["ingest", "query", "batch", "soak", "all"])
     ap.add_argument("--minutes", type=float, default=10.0)
     ap.add_argument("--series", type=int, default=1_048_576)
     ap.add_argument("--report", default="")
@@ -336,6 +472,8 @@ def main(argv=None):
         ok &= ingestion_stress(args.minutes)
     if args.harness in ("query", "all"):
         ok &= query_stress(args.minutes)
+    if args.harness in ("batch", "all"):
+        ok &= batch_query_stress(args.minutes)
     if args.harness == "soak":
         ok &= north_star_soak(args.minutes, series=args.series,
                               report_path=args.report)
