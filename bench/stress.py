@@ -97,11 +97,13 @@ def ingestion_stress(minutes: float, series: int = 5_000) -> bool:
     return ok
 
 
-def query_stress(minutes: float, series: int = 2_000,
-                 query_threads: int = 4) -> bool:
-    """Concurrent PromQL queries against live ingest for the duration;
-    asserts every query succeeds and rates stay in the generator's bounds
-    (InMemoryQueryStress.scala: parallel queries, verified results)."""
+def _setup_live_ingest(series: int):
+    """Shared scaffold for the query-under-ingest harnesses: a memstore
+    warmed with 30min of deterministic counters (+5 per 10s per series)
+    plus an ingester loop extending them live.  Returns
+    (engine, ingester_fn, stop_event, ingested_counter); both harnesses'
+    rate bound checks depend on the +5/10s invariant — change it here,
+    not in a copy."""
     import numpy as np
     from filodb_tpu.core.memstore import TimeSeriesMemStore
     from filodb_tpu.core.records import RecordBatch
@@ -111,7 +113,6 @@ def query_stress(minutes: float, series: int = 2_000,
     ms = TimeSeriesMemStore()
     sh = ms.setup("stress", 0)
     base = counter_batch(series, 1, start_ms=START)
-    # 30 min of warm data so rate windows are well-formed from the start
     warm = 180
     ts = np.tile(START + np.arange(warm, dtype=np.int64) * 10_000, series)
     idx = np.repeat(np.arange(series, dtype=np.int32), warm)
@@ -119,14 +120,8 @@ def query_stress(minutes: float, series: int = 2_000,
         + np.arange(series)[:, None]
     sh.ingest(RecordBatch(base.schema, base.part_keys, idx, ts,
                           {"count": vals.ravel()}))
-    from filodb_tpu.query.rangevector import PlannerParams
-    pp = PlannerParams(sample_limit=200_000_000)
-    eng = QueryEngine("stress", ms)
-    s = START // 1000
-    deadline = time.time() + minutes * 60
     stop = threading.Event()
-    counts = [0] * query_threads
-    errors: List[str] = []
+    ingested = [0]
 
     def ingester():
         t_idx = warm
@@ -135,12 +130,30 @@ def query_stress(minutes: float, series: int = 2_000,
             its = np.tile(START + (t_idx + np.arange(n, dtype=np.int64))
                           * 10_000, series)
             iidx = np.repeat(np.arange(series, dtype=np.int32), n)
-            ivals = (t_idx + np.arange(n, dtype=np.float64))[None, :] * 5.0 \
-                + np.arange(series)[:, None]
+            ivals = (t_idx + np.arange(n, dtype=np.float64))[None, :] \
+                * 5.0 + np.arange(series)[:, None]
             sh.ingest(RecordBatch(base.schema, base.part_keys, iidx, its,
                                   {"count": ivals.ravel()}))
             t_idx += n
+            ingested[0] += n * series
             time.sleep(0.01)
+
+    return QueryEngine("stress", ms), ingester, stop, ingested
+
+
+def query_stress(minutes: float, series: int = 2_000,
+                 query_threads: int = 4) -> bool:
+    """Concurrent PromQL queries against live ingest for the duration;
+    asserts every query succeeds and rates stay in the generator's bounds
+    (InMemoryQueryStress.scala: parallel queries, verified results)."""
+    import numpy as np
+    from filodb_tpu.query.rangevector import PlannerParams
+    pp = PlannerParams(sample_limit=200_000_000)
+    eng, ingester, stop, _ = _setup_live_ingest(series)
+    s = 1_600_000_000_000 // 1000
+    deadline = time.time() + minutes * 60
+    counts = [0] * query_threads
+    errors: List[str] = []
 
     def querier(i):
         while time.time() < deadline and not errors:
@@ -188,29 +201,14 @@ def batch_query_stress(minutes: float, series: int = 2_000,
     caches (merged gid matrices, panel groupings, coalescer groups)
     whose entries pin device arrays."""
     import numpy as np
-    from filodb_tpu.core.memstore import TimeSeriesMemStore
-    from filodb_tpu.core.records import RecordBatch
-    from filodb_tpu.ingest.generator import counter_batch
     from filodb_tpu.query.coalesce import QueryCoalescer
-    from filodb_tpu.query.engine import QueryEngine
     from filodb_tpu.query.rangevector import PlannerParams
     had_interp = os.environ.get("FILODB_TPU_FUSED_INTERPRET")
     os.environ["FILODB_TPU_FUSED_INTERPRET"] = "1"
-    START = 1_600_000_000_000
-    ms = TimeSeriesMemStore()
-    sh = ms.setup("stress", 0)
-    base = counter_batch(series, 1, start_ms=START)
-    warm = 180
-    ts = np.tile(START + np.arange(warm, dtype=np.int64) * 10_000, series)
-    idx = np.repeat(np.arange(series, dtype=np.int32), warm)
-    vals = np.arange(warm, dtype=np.float64)[None, :] * 5.0 \
-        + np.arange(series)[:, None]
-    sh.ingest(RecordBatch(base.schema, base.part_keys, idx, ts,
-                          {"count": vals.ravel()}))
     pp = PlannerParams(sample_limit=200_000_000)
-    eng = QueryEngine("stress", ms)
+    eng, ingester, stop, ingested = _setup_live_ingest(series)
     co = QueryCoalescer(eng, window_s=0.02)
-    s0 = START // 1000
+    s0 = 1_600_000_000_000 // 1000
     args = (s0 + 600, 60, s0 + 1700)
     panel_sets = [
         ['sum(rate(request_total[5m])) by (_ns_)',
@@ -223,7 +221,6 @@ def batch_query_stress(minutes: float, series: int = 2_000,
          'max(rate(request_total[5m])) by (_ns_)'],
     ]
     deadline = time.time() + minutes * 60
-    stop = threading.Event()
     counts = [0] * (batch_threads + coalesce_threads)
     errors: List[str] = []
 
@@ -243,23 +240,6 @@ def batch_query_stress(minutes: float, series: int = 2_000,
                 return False
         nonempty[0] += n > 0
         return True
-
-    ingested = [0]
-
-    def ingester():
-        t_idx = warm
-        while not stop.is_set():
-            n = 10
-            its = np.tile(START + (t_idx + np.arange(n, dtype=np.int64))
-                          * 10_000, series)
-            iidx = np.repeat(np.arange(series, dtype=np.int32), n)
-            ivals = (t_idx + np.arange(n, dtype=np.float64))[None, :] \
-                * 5.0 + np.arange(series)[:, None]
-            sh.ingest(RecordBatch(base.schema, base.part_keys, iidx, its,
-                                  {"count": ivals.ravel()}))
-            t_idx += n
-            ingested[0] += n * series
-            time.sleep(0.01)
 
     def batcher(i):
         k = 0

@@ -37,6 +37,11 @@ class FusedCall:
     # subset + window params): lets equal-but-distinct plan/values
     # objects merge when the LRU caches declined to share them
     cache_key: Optional[tuple] = None
+    # histogram leaf (sum(rate(bucket_metric[...]))): groups carry
+    # (group, bucket) SLOTS; the finisher reshapes sums to [G, W, B] and
+    # appends the present-series count (AggPartial op "hist_sum")
+    bucket_les: Optional[np.ndarray] = None
+    num_buckets: int = 1
 
     def compat_key(self):
         base = (self.fn, self.precorrected, self.interpret, self.ragged)
@@ -53,13 +58,34 @@ def finish_fused_calls(calls: List[FusedCall]) -> List[AggPartial]:
     (the per-panel gate in _try_fused already passed)."""
     from filodb_tpu.ops import pallas_fused as pf
     out: List[Optional[AggPartial]] = [None] * len(calls)
+    # dedup identical panels first — a quantile dashboard's p50/p90/p99
+    # queries differ only ABOVE the leaf (histogram_quantile transformer),
+    # so their leaf calls are the same work: compute once, share the comp
+    prim: Dict[tuple, int] = {}
+    alias: Dict[int, int] = {}
+    for i, fc in enumerate(calls):
+        k = fc.compat_key() + (id(fc.groups.gids_p), fc.op, fc.num_buckets)
+        if k in prim:
+            alias[i] = prim[k]
+        else:
+            prim[k] = i
+    if alias:
+        from filodb_tpu.utils.metrics import registry
+        registry.counter("fused_batch_deduped").increment(len(alias))
     by_key: Dict[tuple, List[int]] = {}
     for i, fc in enumerate(calls):
+        if i in alias:
+            continue
         by_key.setdefault(fc.compat_key(), []).append(i)
+    def slots(i):
+        # histogram panels aggregate over (group, bucket) SLOTS
+        return len(calls[i].gkeys) * calls[i].num_buckets
+
     for idxs in by_key.values():
         fc0 = calls[idxs[0]]
         while idxs:
             take = idxs
+
             def in_group_mode(i):
                 # which panels join the merged group-mode dispatch: min/max
                 # run per-series (Gp-independent) and dense count is host
@@ -74,14 +100,14 @@ def finish_fused_calls(calls: List[FusedCall]) -> List[AggPartial]:
                 ragged_rate = fc0.ragged and fc0.fn in ("rate", "increase",
                                                         "delta")
                 while len(take) > 1:
-                    total = sum(len(calls[i].gkeys) for i in take
+                    total = sum(slots(i) for i in take
                                 if in_group_mode(i))
                     if total == 0 or pf.pick_block(
                             Tp, Wp, pf._pad_to(max(total, 8), 8),
                             over_time, ragged_rate) is not None:
                         break
                     take = take[:max(1, len(take) // 2)]
-            panels = [(calls[i].groups, len(calls[i].gkeys), calls[i].op)
+            panels = [(calls[i].groups, slots(i), calls[i].op)
                       for i in take]
             if len(take) > 1:
                 # observability of the batching win: actual kernel
@@ -100,7 +126,25 @@ def finish_fused_calls(calls: List[FusedCall]) -> List[AggPartial]:
                 precorrected=fc0.precorrected, interpret=fc0.interpret,
                 ragged=fc0.ragged, num_series=fc0.num_series)
             for i, comp in zip(take, comps):
-                out[i] = AggPartial(calls[i].op, calls[i].gkeys,
-                                    calls[i].wends, comp=comp)
+                out[i] = _present(calls[i], comp)
             idxs = idxs[len(take):]
+    for i, j in alias.items():
+        src = out[j]
+        out[i] = dataclasses.replace(src) if src is not None else None
     return out
+
+
+def _present(fc: FusedCall, comp) -> AggPartial:
+    if fc.bucket_les is None:
+        return AggPartial(fc.op, fc.gkeys, fc.wends, comp=comp)
+    # histogram: comp[..., 0] is the per-(group, bucket)-slot sum, masked
+    # where the window has no samples — the hist_sum presenter NaNs those
+    # windows via the count column anyway, so the mask is invisible
+    G, B = len(fc.gkeys), fc.num_buckets
+    buckets = np.asarray(comp[..., 0], np.float64) \
+        .reshape(G, B, -1).transpose(0, 2, 1)           # [G, W, B]
+    gsize = fc.groups.gsize.reshape(G, B)[:, 0]
+    cnt = gsize[:, None] * fc.plan.wvalid[None, :].astype(np.float64)
+    hist_comp = np.concatenate([buckets, cnt[..., None]], axis=2)
+    return AggPartial("hist_sum", fc.gkeys, fc.wends, comp=hist_comp,
+                      bucket_les=fc.bucket_les)

@@ -284,8 +284,6 @@ class MultiSchemaPartitionsExec(LeafExecPlan):
             else:
                 groups = pf.pad_groups(gids, vals.shape[0], len(gkeys))
             _group_cache_insert(key, t1.by, t1.without, groups, gkeys)
-        prep = pf.PreparedInputs(padded_vals.vals_p, padded_vals.vbase_p,
-                                 groups.gids_p, groups.gsize)
         registry.counter("leaf_fused_kernel").increment()
         if not is_hist:
             # broadened matmul path: any fusable (fn, agg) combination,
@@ -304,22 +302,23 @@ class MultiSchemaPartitionsExec(LeafExecPlan):
             if defer:
                 return fc
             return finish_fused_calls([fc])[0]
-        sums, _counts = pf.fused_rate_groupsum(
-            None, None, None, plan, num_slots, fn_name=t0.function,
+        # histogram leaf (sum(rate(bucket_metric))): (group, bucket)
+        # slots ride the same FusedCall machinery so quantile dashboards
+        # batch too — identical panels (p50/p90/p99 over one metric)
+        # dedup to ONE kernel run (fusedbatch finisher reshapes slots to
+        # [G, W, B] and appends the present-series count)
+        ck = None if key is None else key + (
+            t0.start_ms, t0.step_ms, t0.end_ms, t0.offset_ms,
+            t0.window_ms, data.base_ms, "hist", B)
+        fc = FusedCall(
+            plan=plan, values=padded_vals,
+            groups=groups, gkeys=gkeys, wends=wends, fn=fn, op="sum",
             precorrected=data.precorrected, interpret=interpret,
-            prepared=prep)
-        G = len(gkeys)
-        buckets = np.asarray(sums, np.float64) \
-            .reshape(G, B, -1).transpose(0, 2, 1)           # [G, W, B]
-        # series-per-group count: every bucket row of a series shares
-        # presence under the dense gate, so any bucket slot's size IS
-        # the group's series count (works on the group-cache hit path
-        # too, where the raw gids were never recomputed)
-        gsize = groups.gsize.reshape(G, B)[:, 0]
-        cnt = gsize[:, None] * plan.wvalid[None, :].astype(np.float64)
-        comp = np.concatenate([buckets, cnt[..., None]], axis=2)
-        return AggPartial("hist_sum", gkeys, wends, comp=comp,
-                          bucket_les=data.bucket_les)
+            ragged=False, num_series=vals.shape[0] * B, cache_key=ck,
+            bucket_les=data.bucket_les, num_buckets=B)
+        if defer:
+            return fc
+        return finish_fused_calls([fc])[0]
 
     def args_str(self):
         fs = ",".join(str(f) for f in self.filters)

@@ -216,3 +216,31 @@ def test_coalescer_failed_batch_falls_back(fused_env, monkeypatch):
     res = co.query_range(PANELS[0], *args)
     assert res.error is None
     assert _series_map(res)
+
+
+def test_batch_histogram_quantile_dashboard(fused_env):
+    """The canonical quantile dashboard: p50/p90/p99 panels over ONE
+    bucket metric differ only above the leaf, so their leaf calls dedup
+    to a single kernel run; a differently-grouped hist panel merges via
+    slot offsets.  All results equal individual queries."""
+    from filodb_tpu.ingest.generator import histogram_batch
+    engine = _mk_engine([histogram_batch(24, T, start_ms=START_MS)])
+    args = (START_S + 600, 60, END_S)
+    panels = [
+        'histogram_quantile(0.5, sum(rate(http_latency{_ws_="demo"}[5m])))',
+        'histogram_quantile(0.9, sum(rate(http_latency{_ws_="demo"}[5m])))',
+        'histogram_quantile(0.99, sum(rate(http_latency{_ws_="demo"}[5m])))',
+        'histogram_quantile(0.9, '
+        'sum(rate(http_latency{_ws_="demo"}[5m])) by (_ns_))',
+    ]
+    want = [_series_map(engine.query_range(q, *args)) for q in panels]
+    dedup0 = registry.counter("fused_batch_deduped").value
+    got = engine.query_range_batch(panels, *args)
+    assert registry.counter("fused_batch_deduped").value - dedup0 >= 2, \
+        "identical quantile-panel leaves did not dedup"
+    for q, w, g in zip(panels, want, got):
+        g = _series_map(g)
+        assert set(g) == set(w), q
+        for k in w:
+            np.testing.assert_allclose(g[k], w[k], rtol=2e-5, atol=1e-4,
+                                       equal_nan=True, err_msg=q)
