@@ -86,6 +86,64 @@ def main():
     doc["max_rel_err_batched_vs_sequential"] = err
     with open(OUT, "w") as f:
         json.dump(doc, f, indent=1)
+
+    # quantile dashboard: p50/p90/p99 panels over one bucket metric are
+    # IDENTICAL leaf work — dedup makes the dashboard cost ~one panel
+    # (engine-level, through query_range_batch; r4 hist FusedCall path)
+    from filodb_tpu.core.memstore import TimeSeriesMemStore
+    from filodb_tpu.ingest.generator import histogram_batch
+    from filodb_tpu.query.engine import QueryEngine
+    Sh, Th = 32_768, 360
+    start_ms = 1_600_000_000_000
+    ms = TimeSeriesMemStore()
+    ms.setup("prometheus", 0).ingest(
+        histogram_batch(Sh, Th, start_ms=start_ms))
+    eng = QueryEngine("prometheus", ms)
+    qs = [f'histogram_quantile({q}, '
+          f'sum(rate(http_latency{{_ws_="demo"}}[5m])))'
+          for q in (0.5, 0.9, 0.99)]
+    s0 = start_ms // 1000
+    qargs = (s0 + 600, 60, s0 + Th * 10)
+
+    def smap(r):
+        assert r.error is None, r.error
+        return {tuple(sorted(k.labels_dict.items())): np.asarray(v)
+                for k, _, v in r.series()}
+
+    def hseq():
+        return [smap(eng.query_range(q, *qargs)) for q in qs]
+
+    def hbatch():
+        return [smap(r) for r in eng.query_range_batch(qs, *qargs)]
+
+    want = hseq()
+    got = hbatch()                        # warm + equivalence material
+    hd = {"series": Sh, "samples_per_series": Th, "panels": len(qs)}
+    herr = 0.0
+    for w, g in zip(want, got):
+        assert set(w) == set(g)
+        for k in w:
+            aw, ag = w[k], g[k]
+            m = np.isfinite(aw) & np.isfinite(ag)
+            assert (np.isnan(aw) == np.isnan(ag)).all()
+            if m.any():
+                herr = max(herr, float(np.max(
+                    np.abs(aw[m] - ag[m])
+                    / np.maximum(np.abs(aw[m]), 1e-6))))
+    hd["max_rel_err_batched_vs_sequential"] = herr
+    for name, fn in (("batched", hbatch), ("sequential", hseq)):
+        ts = []
+        for _ in range(9):
+            t0 = time.perf_counter()
+            fn()
+            ts.append(time.perf_counter() - t0)
+        ts.sort()
+        hd[f"{name}_p50_s"] = round(ts[len(ts) // 2], 5)
+    hd["speedup_p50"] = round(hd["sequential_p50_s"]
+                              / hd["batched_p50_s"], 2)
+    doc["hist_quantile_dashboard"] = hd
+    with open(OUT, "w") as f:
+        json.dump(doc, f, indent=1)
     print(json.dumps(doc, indent=1))
 
 
