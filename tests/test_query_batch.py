@@ -244,3 +244,33 @@ def test_batch_histogram_quantile_dashboard(fused_env):
         for k in w:
             np.testing.assert_allclose(g[k], w[k], rtol=2e-5, atol=1e-4,
                                        equal_nan=True, err_msg=q)
+
+
+def test_coalescer_separates_planner_params(fused_env):
+    """Requests with different planner params (limits, spread) must land
+    in separate coalescing groups — sharing a batch across them would
+    apply one request's limits to another's query."""
+    import threading
+
+    from filodb_tpu.query.coalesce import QueryCoalescer
+    from filodb_tpu.query.rangevector import PlannerParams
+    engine = _mk()
+    args = (START_S + 600, 60, END_S)
+    engine.query_range(PANELS[0], *args)            # warm mirror
+    co = QueryCoalescer(engine, window_s=0.2)
+    results = {}
+
+    def call(tag, pp):
+        results[tag] = co.query_range(PANELS[0], *args, pp)
+
+    tight = PlannerParams(sample_limit=1)           # must error
+    loose = PlannerParams()
+    ts = [threading.Thread(target=call, args=("tight", tight)),
+          threading.Thread(target=call, args=("loose", loose))]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=60)
+    assert results["loose"].error is None
+    assert results["tight"].error is not None \
+        and "limit" in results["tight"].error
