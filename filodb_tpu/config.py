@@ -84,6 +84,17 @@ class SpreadAssignment:
 class FilodbSettings:
     """Top-level settings (ref: coordinator/.../FilodbSettings.scala:127)."""
     spread_default: int = 1
+    # persistent XLA compile cache for the SERVER path (round-5 verdict
+    # item 2): first-hit compiles measured 43.6-73.4 s at 262k-1M
+    # (BENCH_r04.json) — a restarted production server must not pay them
+    # again.  Empty string disables.  The reference's operational stance
+    # is "the query path is always ready" (ref: coordinator/../
+    # QueryActor.scala:98-117).
+    jax_compile_cache_dir: str = ".filodb_jax_cache"
+    # boot-time warmup: "SxTxWxG[;SxTxWxG...]" fused-kernel shapes to
+    # compile before serving (cache-hit deserialization on restart, full
+    # compile on first boot) so the first dashboard never waits.
+    warmup_shapes: str = ""
     spread_assignment: List[SpreadAssignment] = dataclasses.field(default_factory=list)
     query: QueryConfig = dataclasses.field(default_factory=QueryConfig)
     store: StoreConfig = dataclasses.field(default_factory=StoreConfig)
@@ -245,3 +256,44 @@ def settings() -> FilodbSettings:
     if _SETTINGS is None:
         _SETTINGS = FilodbSettings.load(os.environ.get("FILODB_TPU_CONFIG"))
     return _SETTINGS
+
+
+def apply_jax_runtime(cfg: FilodbSettings) -> Optional[str]:
+    """Point JAX's persistent compile cache at cfg.jax_compile_cache_dir
+    (round-5 verdict item 2: only bench.py/tools did this before — a
+    restarted production server re-paid 43.6-73.4 s first-hit compiles,
+    BENCH_r04.json).  Idempotent; returns the cache dir or None.  An
+    explicit JAX_COMPILATION_CACHE_DIR env wins over config."""
+    path = os.environ.get("JAX_COMPILATION_CACHE_DIR") \
+        or cfg.jax_compile_cache_dir
+    if not path:
+        return None
+    import jax
+    try:
+        os.makedirs(path, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", path)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:  # noqa: BLE001 — the cache is an optimization only
+        return None
+    return path
+
+
+def parse_warmup_shapes(spec: str):
+    """cfg.warmup_shapes "SxTxWxG[;...]" -> [(S, T, W, G)] (ValueError on
+    malformed entries: a typo'd warmup list must fail boot loudly, not
+    silently skip the warmup it was deployed for)."""
+    shapes = []
+    for part in (spec or "").replace(",", ";").split(";"):
+        part = part.strip()
+        if not part:
+            continue
+        dims = part.lower().split("x")
+        if len(dims) != 4:
+            raise ConfigError(
+                f"warmup_shapes entry {part!r}: expected SxTxWxG")
+        try:
+            shapes.append(tuple(int(d) for d in dims))
+        except ValueError:
+            raise ConfigError(
+                f"warmup_shapes entry {part!r}: non-integer dimension")
+    return shapes

@@ -127,9 +127,13 @@ class PromHttpApi:
             try:
                 req = _json.loads(body.decode() or "{}")
                 queries = list(req["queries"])
-                start, end = float(req["start"]), float(req["end"])
-                step = max(float(req.get("step", 15)), 1)
-            except (KeyError, TypeError, ValueError) as e:
+                # same int(float(...)) grid coercion as GET query_range
+                # (_num_param): a float-typed start/step must not build a
+                # different time grid on the batch path
+                start = int(float(req["start"]))
+                end = int(float(req["end"]))
+                step = max(int(float(req.get("step", 15))), 1)
+            except (KeyError, TypeError, ValueError, OverflowError) as e:
                 raise _BadRequest(f"bad batch request: {e}") from None
             results = eng.query_range_batch(queries, start, step, end,
                                             planner_params)

@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import functools
 import os
+import threading
 from typing import NamedTuple, Optional, Tuple
 
 import numpy as np
@@ -125,6 +126,42 @@ def _pad_to(x: int, m: int) -> int:
     return (x + m - 1) // m * m
 
 
+def _bucket_up(n: int, quantum: int, exact_below: int) -> int:
+    """Round n up to a {8/8, 9/8, ..., 16/8} x 2^k geometric ladder of
+    quantum multiples (adjacent rungs <= 1.125x, so padding <= 12.5%) —
+    shape canonicalization so near-identical working sets share ONE
+    compiled program.
+
+    Under live ingest the series count drifts every snapshot refresh;
+    without bucketing each drift changes Sp and every query pays a full
+    XLA recompile (measured 43-73 s at 262k-1M, BENCH_r04.json) — the
+    prime suspect for SOAK_r04's 9x query degradation.  Below
+    `exact_below` the plain quantum pad is kept: small shapes are cheap
+    to compile and common in tests that assert exact padding."""
+    if n <= exact_below:
+        return _pad_to(max(n, 1), quantum)
+    k = 1
+    while quantum * 16 * k < n:
+        k *= 2
+    for m in range(8, 17):
+        cand = quantum * k * m
+        if cand >= n:
+            return cand
+    raise AssertionError("unreachable: the loop exits with 16*k*quantum >= n")
+
+
+def pad_series_count(S: int) -> int:
+    """Canonical padded series count: multiple of _BS (every pick_block
+    block size divides it) on the geometric ladder."""
+    return _bucket_up(S, _BS, 8 * _BS)
+
+
+def pad_group_count(G: int) -> int:
+    """Canonical padded group count for the kernel epilogue (multiple of
+    8, geometric ladder above 64 so group-count drift reuses programs)."""
+    return _bucket_up(max(G, 8), 8, 64)
+
+
 class FusedPlan(NamedTuple):
     """Host-built query plan: selection matrices + shared window scalars."""
     o1: np.ndarray       # [Tp, Wp] f32  one-hot at first[w]
@@ -189,6 +226,43 @@ def build_plan(ts_row: np.ndarray, wends: np.ndarray,
         wstart_x=row(wstart - 1), wend_x=row(wend),
         wvalid=(n >= 2), wvalid1=(n >= 1), n1=row(n), W=W, Tp=Tp,
         tsrow=tsr)
+
+
+_PLAN_MATS_CACHE: dict = {}
+_PLAN_MATS_LOCK = threading.Lock()
+
+
+def plan_device_mats(plan: "FusedPlan") -> tuple:
+    """Device-resident copies of a plan's selection matrices + window
+    rows, uploaded ONCE per plan object.
+
+    Measured on the tunneled v5e (TPU_CHAIN_r05.json): the kernel's true
+    device time at 262k x 720 is ~6 ms, but the per-call p50 was ~113 ms
+    against a ~63 ms dispatch floor — most of the unexplained ~44 ms was
+    this function's absence: every query re-uploaded ~1.6 MB of numpy
+    plan matrices through `jnp.asarray`.  Keyed by id(plan) with the
+    plan pinned (id-reuse safe), matching the leaf/mesh plan caches'
+    lifetime."""
+    k = id(plan)
+    with _PLAN_MATS_LOCK:
+        ent = _PLAN_MATS_CACHE.get(k)
+        if ent is not None and ent[0] is plan:
+            return ent[1]
+    mats = tuple(jnp.asarray(m) for m in
+                 (plan.o1, plan.o2, plan.l1, plan.l2, plan.t1, plan.t2,
+                  plan.n, plan.n1, plan.wstart_x, plan.wend_x, plan.tsrow))
+    with _PLAN_MATS_LOCK:
+        _PLAN_MATS_CACHE[k] = (plan, mats)
+        while len(_PLAN_MATS_CACHE) > 8:
+            _PLAN_MATS_CACHE.pop(next(iter(_PLAN_MATS_CACHE)))
+    return mats
+
+
+def _kernel_mats(plan: "FusedPlan", over_time: bool) -> tuple:
+    """The 10 operands _run expects, with `n` resolved to true counts for
+    the over_time kinds and clamped counts for the rate family."""
+    m = plan_device_mats(plan)
+    return m[:6] + (m[7] if over_time else m[6],) + m[8:]
 
 
 def _shift_r(x, k: int, fill):
@@ -443,7 +517,8 @@ def _run(vals_p, vbase_p, gids_p, o1, o2, l1, l2, t1, t2, n, ws, we, ts,
     # shapes here are static at trace time; Sp is padded to _BS, which
     # every smaller power-of-two block divides.
     bs = pick_block(Tp, Wp, Gp, kind in OVER_TIME_FNS,
-                    ragged and kind == "rate_family")
+                    ragged and kind == "rate_family",
+                    panels=gids_p.shape[1])
     if bs is None:
         if interpret:
             bs = _MIN_BS            # no scoped-vmem limit off-chip
@@ -492,7 +567,8 @@ VMEM_BUDGET = 12 << 20          # per-core VMEM is ~16MB; leave headroom
 
 def vmem_estimate(Tp: int, Wp: int, Gp: int,
                   over_time: bool = False,
-                  ragged_rate: bool = False, bs: int = _BS) -> int:
+                  ragged_rate: bool = False, bs: int = _BS,
+                  panels: int = 1) -> int:
     """Rough resident-bytes model for one grid step: the 4 selection
     matrices (plus the over_time kinds' band temporary), the
     double-buffered values block, the group one-hot + accumulator, and
@@ -512,13 +588,17 @@ def vmem_estimate(Tp: int, Wp: int, Gp: int,
         # kept until the next on-chip window re-measures it (conservative
         # = smaller blocks than strictly needed, never an OOM)
         vals += 19 * bs * Tp * 4
-    group = Gp * (Wp * 8 + bs * 4)
+    # multi-panel epilogue (merge_groups): each extra grouping column
+    # builds another [Gp, bs] one-hot compare temporary feeding the
+    # accumulated multi-hot — a large merged batch that fit the P=1
+    # model could still exceed scoped VMEM at Mosaic lowering on-chip
+    group = Gp * (Wp * 8 + bs * 4 * max(panels, 1))
     inter = 12 * bs * Wp * 4
     return sel + vals + group + inter
 
 
 def pick_block(Tp: int, Wp: int, Gp: int, over_time: bool = False,
-               ragged_rate: bool = False) -> Optional[int]:
+               ragged_rate: bool = False, panels: int = 1) -> Optional[int]:
     """Largest series-block size whose vmem_estimate fits VMEM_BUDGET
     (None when even _MIN_BS doesn't — the caller must divert to the
     general path).  The ragged rate family's scan temporaries scale with
@@ -528,7 +608,7 @@ def pick_block(Tp: int, Wp: int, Gp: int, over_time: bool = False,
     bs = _BS
     while bs >= _MIN_BS:
         if vmem_estimate(Tp, Wp, Gp, over_time, ragged_rate,
-                         bs=bs) <= VMEM_BUDGET:
+                         bs=bs, panels=panels) <= VMEM_BUDGET:
             return bs
         bs //= 2
     return None
@@ -605,7 +685,7 @@ class PaddedGroups(NamedTuple):
 
 def pad_values(vals, vbase, plan: FusedPlan) -> PaddedValues:
     S = vals.shape[0]
-    Sp = _pad_to(S, _BS)
+    Sp = pad_series_count(S)
     vals_p = jnp.zeros((Sp, plan.Tp), jnp.float32)
     vals_p = vals_p.at[:S, :vals.shape[1]].set(jnp.asarray(vals, jnp.float32))
     vbase_p = jnp.zeros((Sp, 1), jnp.float32)
@@ -614,7 +694,7 @@ def pad_values(vals, vbase, plan: FusedPlan) -> PaddedValues:
 
 
 def pad_groups(gids, S: int, num_groups: int) -> PaddedGroups:
-    Sp = _pad_to(S, _BS)
+    Sp = pad_series_count(S)
     gids_np = np.asarray(gids, np.int32)
     gids_p = jnp.full((Sp, 1), -1, jnp.int32)
     gids_p = gids_p.at[:S, 0].set(jnp.asarray(gids_np))
@@ -653,12 +733,9 @@ def fused_rate_groupsum(vals, vbase, gids, plan: FusedPlan,
     kind = fn_name if over_time else "rate_family"
     if prepared is None:
         prepared = pad_inputs(vals, vbase, gids, plan, num_groups)
-    Gp = _pad_to(max(num_groups, 8), 8)
+    Gp = pad_group_count(num_groups)
     res = _run(prepared.vals_p, prepared.vbase_p, prepared.gids_p,
-               *(jnp.asarray(m) for m in
-                 (plan.o1, plan.o2, plan.l1, plan.l2, plan.t1, plan.t2,
-                  plan.n1 if over_time else plan.n,
-                  plan.wstart_x, plan.wend_x, plan.tsrow)),
+               *_kernel_mats(plan, over_time),
                num_groups=Gp, is_counter=is_counter, is_rate=is_rate,
                with_drops=with_drops, interpret=interpret, kind=kind,
                ragged=ragged)
@@ -671,6 +748,32 @@ def fused_rate_groupsum(vals, vbase, gids, plan: FusedPlan,
         counts = prepared.gsize[:, None].astype(np.float64) * \
             wvalid[None, :].astype(np.float64)
     return sums[:num_groups, :plan.W], counts
+
+
+def warmup_compile(S: int, T: int, W: int, G: int,
+                   fn_name: str = "rate") -> float:
+    """Compile (or cache-deserialize) the fused kernel for the canonical
+    padded shape of (S series, T samples, W windows, G groups) using
+    device zeros — the boot-warmup hook behind config.warmup_shapes.
+    Returns wall seconds spent.  The compiled program is keyed by the
+    BUCKETED shape, so any production working set in the same buckets
+    hits it."""
+    import time
+    t0 = time.perf_counter()
+    step = 10_000
+    W = max(min(W, T), 1)
+    ts_row = np.arange(T, dtype=np.int64) * step
+    wends = ts_row[-1] - np.arange(W, dtype=np.int64)[::-1] * step
+    plan = build_plan(ts_row, wends, 300_000)
+    vals = jnp.zeros((S, T), jnp.float32)
+    vbase = jnp.zeros((S,), jnp.float32)
+    gids = (np.arange(S) % max(G, 1)).astype(np.int32)
+    interpret = jax.default_backend() != "tpu"   # leafexec's gate, exactly
+    sums, _ = fused_rate_groupsum(vals, vbase, gids, plan, max(G, 1),
+                                  fn_name, precorrected=True,
+                                  interpret=interpret)
+    sums.block_until_ready()
+    return time.perf_counter() - t0
 
 
 def present_sum(sums, counts) -> np.ndarray:
@@ -819,10 +922,7 @@ def fused_leaf_agg_batch(plan: FusedPlan, values: PaddedValues, panels,
 
     def run(gids_p, Gp, per_series):
         return _run(values.vals_p, values.vbase_p, gids_p,
-                    *(jnp.asarray(m) for m in
-                      (plan.o1, plan.o2, plan.l1, plan.l2, plan.t1,
-                       plan.t2, plan.n1 if over_time else plan.n,
-                       plan.wstart_x, plan.wend_x, plan.tsrow)),
+                    *_kernel_mats(plan, over_time),
                     num_groups=Gp, is_counter=is_counter, is_rate=is_rate,
                     with_drops=with_drops, interpret=interpret, kind=kind,
                     ragged=ragged, per_series=per_series)
@@ -844,7 +944,7 @@ def fused_leaf_agg_batch(plan: FusedPlan, values: PaddedValues, panels,
     if mm_idx:
         gids_multi, offsets, total = merge_groups(
             [panels[i][0] for i in mm_idx], [panels[i][1] for i in mm_idx])
-        Gp = _pad_to(max(total, 8), 8)
+        Gp = pad_group_count(total)
         res = run(gids_multi, Gp, per_series=False)
         if ragged:
             sums_all, cnts_all = (np.asarray(r, np.float64) for r in res)

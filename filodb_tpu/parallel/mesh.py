@@ -264,8 +264,8 @@ def _mesh_fused_call(mesh: Mesh, values, group_ids, vbase,
     over 'shard'.  jit-cached on the static shape/flag tuple so repeat
     queries don't re-trace (the closure-per-call anti-pattern)."""
     from filodb_tpu.ops import pallas_fused as pf
-    Gp = pf._pad_to(max(G, 8), 8)
-    Sp = pf._pad_to(S, pf._BS)
+    Gp = pf.pad_group_count(G)
+    Sp = pf.pad_series_count(S)
 
     def step(val_blk, gid_blk, vb_blk, o1b, o2b, l1b, l2b,
              t1b, t2b, nb, wsb, web, tsb):
@@ -453,8 +453,12 @@ class MeshExecutor:
         # run_agg_batch merged-gid cache: (id(pack), panels, fn) -> the
         # device-resident [D, S, P] grouping matrix (+ the pack ref to
         # pin identity), so a dashboard refresh loop over a warm pack
-        # skips the per-panel host remaps AND the gid upload
+        # skips the per-panel host remaps AND the gid upload.  Panel-
+        # grouping entries live in their own dict: with one shared dict
+        # a gids_dev insert (cap 4) could purge recently cached panel
+        # groupings (cap 8) and defeat the dashboard-refresh warm path
         self._batch_gid_cache: Dict[Tuple, Dict] = {}
+        self._panel_group_cache: Dict[Tuple, Dict] = {}
         # queries can reach the executor from HTTP worker threads (same
         # contract as the leaf caches' _FUSED_CACHE_LOCK in query/exec.py):
         # every cache read-modify-write below holds this lock; device work
@@ -656,19 +660,19 @@ class MeshExecutor:
                            for by, wo, op in panels)
         merged_key = (id(packed), panels_key, fn_name)
         with self._cache_lock:
-            cached = self._batch_gid_cache.get(("panels",) + merged_key)
+            cached = self._panel_group_cache.get(merged_key)
         if cached is not None and cached["packed"] is packed:
             kpanels, kmap, klabels = cached["kpanels"], cached["kmap"], \
                 cached["klabels"]
         else:
             kpanels, kmap, klabels = self._panel_groupings(packed, panels)
             with self._cache_lock:
-                self._batch_gid_cache[("panels",) + merged_key] = {
+                self._panel_group_cache[merged_key] = {
                     "packed": packed, "kpanels": kpanels, "kmap": kmap,
                     "klabels": klabels}
-                while len(self._batch_gid_cache) > 8:
-                    self._batch_gid_cache.pop(
-                        next(iter(self._batch_gid_cache)))
+                while len(self._panel_group_cache) > 8:
+                    self._panel_group_cache.pop(
+                        next(iter(self._panel_group_cache)))
         if kpanels:
             wends_p, W = self._prep_wends(packed, wends)
             try:
@@ -849,10 +853,10 @@ class MeshExecutor:
                 Gtot += kpanels[i][1]
             # padded group count, matching _run's recomputation exactly
             if pf.pick_block(
-                    Tp, Wlp, pf._pad_to(max(Gtot, 8), 8),
+                    Tp, Wlp, pf.pad_group_count(Gtot),
                     over_time,
-                    ragged and fn_name in ("rate", "increase", "delta")
-                    ) is None:
+                    ragged and fn_name in ("rate", "increase", "delta"),
+                    panels=max(len(kidx), 1)) is None:
                 return None
             # plan + device-mats cache: repeat queries (the pack-cache
             # pattern) skip the host selection-matrix rebuild + 9 uploads

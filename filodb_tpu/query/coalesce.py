@@ -68,9 +68,17 @@ class QueryCoalescer:
             try:
                 grp.results = self.engine.query_range_batch(
                     grp.queries, start_s, step_s, end_s, planner_params)
-            except BaseException as e:  # noqa: BLE001 — followers must wake
+            except Exception as e:  # noqa: BLE001 — followers must wake
                 grp.error = e
-            finally:
+                grp.done.set()
+            except BaseException as e:
+                # KeyboardInterrupt/SystemExit: wake followers (they fall
+                # back to solo execution) but PROPAGATE the exit — the
+                # leader thread must not swallow an interpreter shutdown
+                grp.error = e
+                grp.done.set()
+                raise
+            else:
                 grp.done.set()
         else:
             # generous bound: a wedged leader must not strand followers

@@ -30,6 +30,10 @@ class QueryEngine:
                  planner: Optional[SingleClusterPlanner] = None):
         self.dataset = dataset
         self.source = source
+        # embedded-engine deployments (no FiloServer) still get the
+        # persistent compile cache; idempotent under the standalone path
+        from filodb_tpu.config import apply_jax_runtime, settings
+        apply_jax_runtime(settings())
         self.shard_mapper = shard_mapper or _single_shard_mapper()
         self.planner = planner or SingleClusterPlanner(
             dataset, self.shard_mapper, spread_provider)

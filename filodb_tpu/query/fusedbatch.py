@@ -100,11 +100,13 @@ def finish_fused_calls(calls: List[FusedCall]) -> List[AggPartial]:
                 ragged_rate = fc0.ragged and fc0.fn in ("rate", "increase",
                                                         "delta")
                 while len(take) > 1:
+                    n_group = sum(1 for i in take if in_group_mode(i))
                     total = sum(slots(i) for i in take
                                 if in_group_mode(i))
                     if total == 0 or pf.pick_block(
-                            Tp, Wp, pf._pad_to(max(total, 8), 8),
-                            over_time, ragged_rate) is not None:
+                            Tp, Wp, pf.pad_group_count(total),
+                            over_time, ragged_rate,
+                            panels=max(n_group, 1)) is not None:
                         break
                     take = take[:max(1, len(take) // 2)]
             panels = [(calls[i].groups, slots(i), calls[i].op)

@@ -247,7 +247,7 @@ class MultiSchemaPartitionsExec(LeafExecPlan):
         # BEFORE the padded device copy, so diverted queries cost nothing
         # same padded group count _run will use — a gate tested on the
         # unpadded count could accept a shape _run then rejects
-        if pf.pick_block(Tp, Wp, pf._pad_to(max(num_slots, 8), 8),
+        if pf.pick_block(Tp, Wp, pf.pad_group_count(num_slots),
                          over_time, ragged_rate) is None:
             return None
         if padded_vals is None:

@@ -1,0 +1,169 @@
+"""Mosaic probe: can the TPU Pallas kernel do STATIC lane-strided slices?
+
+The fused kernel's boundary gathers (v @ o1 one-hot matmuls) are pure
+column selections at host-static positions f0 + w*stride whenever the
+window geometry is uniform (every Prometheus query_range).  If Mosaic
+lowers `x[:, f0:stop:stride]` on the lane dim, the gathers cost ~nothing
+instead of 6-pass HIGHEST matmuls.  This probe compiles three candidate
+gather strategies on a [256, 768] block and times K-chained runs:
+
+  a) lane_strided:  y = x[:, f0::stride]           (direct lane slice)
+  b) transpose:     y = x.T[f0::stride, :].T       (sublane slice path)
+  c) matmul:        y = x @ onehot                 (the current kernel's)
+
+Run on the tunneled chip; prints one JSON line per strategy.
+"""
+import functools
+import json
+import sys
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+
+BS, TP, WP = 256, 768, 128
+F0, STRIDE, W = 5, 6, 110
+GRID = 1024        # series blocks per call (262k series equivalent)
+
+
+def _pad_w(y):
+    return jnp.concatenate(
+        [y, jnp.zeros((y.shape[0], WP - y.shape[1]), jnp.float32)], axis=1)
+
+
+IDX = np.zeros(TP, np.int32)
+IDX[:W] = F0 + STRIDE * np.arange(W, dtype=np.int32)
+
+
+def k_dyngather(x_ref, o_ref, i1_ref, i2_ref, y_ref):
+    x = x_ref[:]
+    idx = jnp.broadcast_to(i1_ref[:], x.shape)
+    g = jnp.take_along_axis(x, idx, axis=1, mode="promise_in_bounds")
+    y_ref[:] = g[:, :WP] * _pad_w(jnp.ones((x.shape[0], W), jnp.float32))
+
+
+def k_two_gathers(x_ref, o_ref, i1_ref, i2_ref, y_ref):
+    """Dense-rate shape: two gathers (v1, v2) + elementwise, one output."""
+    x = x_ref[:]
+    idx1 = jnp.broadcast_to(i1_ref[:], x.shape)
+    idx2 = jnp.broadcast_to(i2_ref[:], x.shape)
+    v1 = jnp.take_along_axis(x, idx1, axis=1, mode="promise_in_bounds")
+    v2 = jnp.take_along_axis(x, idx2, axis=1, mode="promise_in_bounds")
+    mask = _pad_w(jnp.ones((x.shape[0], W), jnp.float32))
+    y_ref[:] = (v2[:, :WP] - v1[:, :WP]) * mask
+
+
+def _tiled_gather(x, idx_row):
+    """Gather x[s, idx[w]] as W columns via per-128-lane-tile dynamic
+    gathers (dynamic_gather across vreg boundaries fails to compile):
+    out[:, w] = x[:, idx[w]] for w < WP, where idx rides a [1, WP] row."""
+    bs = x.shape[0]
+    out = jnp.zeros((bs, WP), jnp.float32)
+    idx = jnp.broadcast_to(idx_row, (bs, WP))
+    for k in range(TP // 128):
+        tile = x[:, 128 * k:128 * (k + 1)]
+        local = jnp.clip(idx - 128 * k, 0, 127)
+        g = jnp.take_along_axis(tile, local, axis=1,
+                                mode="promise_in_bounds")
+        out = jnp.where((idx >= 128 * k) & (idx < 128 * (k + 1)), g, out)
+    return out
+
+
+def k_tiled_gather(x_ref, o_ref, i1_ref, i2_ref, y_ref):
+    x = x_ref[:]
+    mask = _pad_w(jnp.ones((x.shape[0], W), jnp.float32))
+    y_ref[:] = _tiled_gather(x, i1_ref[:, :WP]) * mask
+
+
+def k_tiled_two(x_ref, o_ref, i1_ref, i2_ref, y_ref):
+    x = x_ref[:]
+    mask = _pad_w(jnp.ones((x.shape[0], W), jnp.float32))
+    v1 = _tiled_gather(x, i1_ref[:, :WP])
+    v2 = _tiled_gather(x, i2_ref[:, :WP])
+    y_ref[:] = (v2 - v1) * mask
+
+
+def k_matmul(x_ref, o_ref, i1_ref, i2_ref, y_ref):
+    y_ref[:] = jnp.dot(x_ref[:], o_ref[:],
+                       preferred_element_type=jnp.float32,
+                       precision=lax.Precision.HIGHEST)
+
+
+def run(kern, x, o, i1, i2, interpret=False):
+    from jax.experimental.pallas import tpu as pltpu
+    space = {} if interpret else {"memory_space": pltpu.VMEM}
+    return pl.pallas_call(
+        kern, grid=(GRID,),
+        in_specs=[pl.BlockSpec((BS, TP), lambda i: (i, 0), **space),
+                  pl.BlockSpec((TP, WP), lambda i: (0, 0), **space),
+                  pl.BlockSpec((1, TP), lambda i: (0, 0), **space),
+                  pl.BlockSpec((1, TP), lambda i: (0, 0), **space)],
+        out_specs=pl.BlockSpec((BS, WP), lambda i: (i, 0), **space),
+        out_shape=jax.ShapeDtypeStruct((GRID * BS, WP), jnp.float32),
+        interpret=interpret)(x, o, i1, i2)
+
+
+def main():
+    interpret = jax.devices()[0].platform == "cpu"
+    rng = np.random.default_rng(0)
+    x = jax.device_put(rng.standard_normal((GRID * BS, TP)).astype(np.float32))
+    onehot = np.zeros((TP, WP), np.float32)
+    for w in range(W):
+        onehot[F0 + STRIDE * w, w] = 1.0
+    o = jax.device_put(onehot)
+    i1 = jax.device_put(IDX[None, :])
+    i2 = jax.device_put((IDX + (STRIDE - 1))[None, :])
+    xh = np.asarray(x)
+    gather1 = xh @ onehot
+    onehot2 = np.zeros((TP, WP), np.float32)
+    for w in range(W):
+        onehot2[F0 + STRIDE * w + STRIDE - 1, w] = 1.0
+    wants = {"dyngather": gather1, "matmul": gather1,
+             "tiled_gather": gather1,
+             "two_gathers": xh @ onehot2 - gather1,
+             "tiled_two": xh @ onehot2 - gather1}
+
+    import time
+    KS = (2, 16)
+    for name, kern in (("tiled_gather", k_tiled_gather),
+                       ("tiled_two", k_tiled_two),
+                       ("matmul", k_matmul)):
+        rec = {"strategy": name}
+        try:
+            fn = functools.partial(run, kern, interpret=interpret)
+            got = np.asarray(fn(x, o, i1, i2))
+            rec["max_abs_err"] = float(np.abs(got - wants[name]).max())
+            p50s = {}
+            for K in KS:
+                @jax.jit
+                def chain(x0, o0, K=K):
+                    def body(i, acc):
+                        y = fn(x0 + acc * 1e-30, o0, i1, i2)
+                        return acc + y[0, 0] * 1e-30
+                    return lax.fori_loop(0, K, body, jnp.float32(0.0))
+
+                t0 = time.perf_counter()
+                chain(x, o).block_until_ready()
+                rec[f"k{K}_compile_s"] = round(time.perf_counter() - t0, 2)
+                lat = []
+                for _ in range(7):
+                    t0 = time.perf_counter()
+                    chain(x, o).block_until_ready()
+                    lat.append(time.perf_counter() - t0)
+                p50s[K] = float(np.median(lat))
+                rec[f"k{K}_p50_s"] = round(p50s[K], 5)
+            slope = (p50s[KS[1]] - p50s[KS[0]]) / (KS[1] - KS[0])
+            rec["device_ms_per_call"] = round(slope * 1e3, 3)
+            rec["intercept_ms"] = round(
+                (p50s[KS[0]] - slope * KS[0]) * 1e3, 1)
+        except Exception as e:  # noqa: BLE001 — probe failure is the result
+            rec["error"] = f"{type(e).__name__}: {str(e)[:200]}"
+        print(json.dumps(rec))
+        sys.stdout.flush()
+
+
+if __name__ == "__main__":
+    main()
