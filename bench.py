@@ -45,10 +45,13 @@ REPO_DIR = os.path.dirname(os.path.abspath(__file__))
 PARTIAL_PATH = os.environ.get(
     "FILODB_BENCH_PARTIAL", os.path.join(REPO_DIR, "BENCH_PARTIAL.json"))
 
-# FLOP/byte model for the fused kernel (see doc/kernels.md): per grid step
-# the kernel does 4 [BS,Tp]x[Tp,Wp] selection matmuls (boundary gathers +
-# drop prefix sums) and one [Gp,BS]x[BS,Wp] group matmul.
-_FUSED_MATMULS = 4
+# FLOP/byte model for the fused kernel (see doc/kernels.md): since round
+# 5 the boundary selections are exact per-tile gathers (data movement, 0
+# model FLOPs); the matmul work is the [Gp,BS]x[BS,Wp] group epilogue.
+# The legacy matmul-selection path (FILODB_FUSED_GATHER=0) adds 2 (dense
+# precorrected) selection matmuls.
+_FUSED_MATMULS = (0 if os.environ.get("FILODB_FUSED_GATHER", "1") != "0"
+                  else 2)
 
 
 def make_counter_data(S, T, step_ms=10_000, seed=7):
@@ -534,7 +537,11 @@ def measure_dashboard_batch(platform):
 
 
 def host_baselines(ts_row, vals, gids, wends, range_ms, span):
-    """CPU reference numbers (vectorized + per-window iterator)."""
+    """CPU reference numbers: vectorized numpy, per-window Python-loop
+    iterator, and the single-core C iterator (the compiled
+    ChunkedWindowIterator stand-in — no JVM exists in this environment,
+    so this is the honest 'iterator on one core' comparator; see
+    native/filodb_native.cc filodb_iter_rate and BASELINE.md)."""
     G = int(gids.max()) + 1
     Sv = min(vals.shape[0], 65_536)
     t0 = time.perf_counter()
@@ -546,7 +553,15 @@ def host_baselines(ts_row, vals, gids, wends, range_ms, span):
     numpy_iterator_baseline(ts_row, vals[:Sb].astype(np.float64),
                             wends.astype(np.int64), range_ms)
     it_sps = (Sb * span) / (time.perf_counter() - t0)
-    return vec_sps, it_sps
+    c_sps = 0.0
+    from filodb_tpu import native
+    if native.lib is not None:
+        Sc = min(vals.shape[0], 16_384)
+        t0 = time.perf_counter()
+        native.lib.iter_rate(ts_row, vals[:Sc].astype(np.float64),
+                             wends.astype(np.int64), range_ms)
+        c_sps = (Sc * span) / (time.perf_counter() - t0)
+    return vec_sps, it_sps, c_sps
 
 
 def parse_args(argv=None):
@@ -564,7 +579,8 @@ def parse_args(argv=None):
     return ap.parse_args(argv)
 
 
-def assemble_result(platform, stages, vec_sps, it_sps, partial=False):
+def assemble_result(platform, stages, vec_sps, it_sps, c_sps=0.0,
+                    partial=False):
     """One JSON line from whatever stages completed.  The headline is the
     highest-throughput trusted stage — comparable round-over-round; on
     chip the 1M north-star stage wins this naturally (bigger batches
@@ -600,6 +616,12 @@ def assemble_result(platform, stages, vec_sps, it_sps, partial=False):
             result["iterator_baseline_samples_per_sec"] = round(it_sps, 1)
             result["vs_iterator_baseline"] = \
                 round(best["samples_per_sec"] / it_sps, 1)
+        if c_sps:
+            # the honest compiled-iterator comparator (single C core; no
+            # JVM exists here — see BASELINE.md north-star note)
+            result["iterator_c_samples_per_sec"] = round(c_sps, 1)
+            result["vs_iterator_c"] = \
+                round(best["samples_per_sec"] / c_sps, 1)
     cov = stages.get("fused_coverage", {})
     for k in ("fused_coverage_dense", "fused_coverage_ragged"):
         if k in cov:
@@ -691,12 +713,13 @@ def run_worker(args):
                             "error": f"{type(e).__name__}: {e}"[:300]}
             writer.stage(name, stages[name])
 
-    vec_sps = it_sps = 0.0
+    vec_sps = it_sps = c_sps = 0.0
     if baseline_inputs is not None:
-        vec_sps, it_sps = host_baselines(*baseline_inputs)
+        vec_sps, it_sps, c_sps = host_baselines(*baseline_inputs)
         writer.stage("host_baselines", {
             "vectorized_numpy_samples_per_sec": round(vec_sps, 1),
-            "iterator_numpy_samples_per_sec": round(it_sps, 1)})
+            "iterator_numpy_samples_per_sec": round(it_sps, 1),
+            "iterator_c_samples_per_sec": round(c_sps, 1)})
 
     try:
         cov = measure_fused_coverage()
@@ -715,7 +738,8 @@ def run_worker(args):
             writer.stage("dashboard_batch",
                          {"error": f"{type(e).__name__}: {e}"[:300]})
 
-    result = assemble_result(platform, stages, vec_sps, it_sps)
+    result = assemble_result(platform, stages, vec_sps, it_sps,
+                             c_sps)
     result["jax_platform"] = raw_platform
     writer.finish()
     print(json.dumps(result))
@@ -768,7 +792,8 @@ def _recover_partial(run_id):
     result = assemble_result(
         doc.get("platform", "unknown"), doc["stages"],
         hb.get("vectorized_numpy_samples_per_sec", 0.0),
-        hb.get("iterator_numpy_samples_per_sec", 0.0), partial=True)
+        hb.get("iterator_numpy_samples_per_sec", 0.0),
+        hb.get("iterator_c_samples_per_sec", 0.0), partial=True)
     if result.get("value"):
         return result
     return None

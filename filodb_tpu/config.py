@@ -31,6 +31,11 @@ class ConfigError(ValueError):
 class QueryConfig:
     """ref: filodb-defaults.conf:166-204 `filodb.query`."""
     ask_timeout_s: float = 120.0
+    # shard_unavailable re-plan retries at the engine root (a node died
+    # mid-query; after failover the re-planned query lands on the
+    # reassigned owner).  dispatch_timeout is NEVER retried — the remote
+    # may still be executing.  See query/execbase.QueryError taxonomy.
+    dispatch_retries: int = 1
     stale_sample_after_ms: int = 5 * 60 * 1000
     sample_limit: int = 1_000_000
     join_cardinality_limit: int = 25_000
@@ -95,6 +100,11 @@ class FilodbSettings:
     # compile before serving (cache-hit deserialization on restart, full
     # compile on first boot) so the first dashboard never waits.
     warmup_shapes: str = ""
+    # span push-export target (ref: the Kamon Zipkin reporter,
+    # KamonLogger.scala:16-40): "http(s)://host:port/api/v2/spans" or
+    # "file:///path/spans.jsonl"; empty disables.  The in-memory trace
+    # store stays bounded either way (256 traces x 512 events).
+    trace_export_url: str = ""
     spread_assignment: List[SpreadAssignment] = dataclasses.field(default_factory=list)
     query: QueryConfig = dataclasses.field(default_factory=QueryConfig)
     store: StoreConfig = dataclasses.field(default_factory=StoreConfig)

@@ -485,6 +485,11 @@ def _planner_params(params: Dict[str, str]) -> Optional[PlannerParams]:
     if "scanLimit" in params:
         pp.scan_limit = _num_param(params, "scanLimit")
         changed = True
+    if params.get("allowPartialResults") in ("true", "1"):
+        # opt-in: unreachable shard owners are dropped and the payload
+        # carries "partial": true + a warning (never silent partials)
+        pp.allow_partial_results = True
+        changed = True
     return pp if changed else None
 
 

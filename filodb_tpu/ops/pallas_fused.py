@@ -59,6 +59,22 @@ if _PRECISION not in ("highest", "split"):
         f"FILODB_FUSED_PRECISION={_PRECISION!r}: expected 'highest' or "
         f"'split' (a typo here would silently mislabel a tuning sweep)")
 
+_GATHER = os.environ.get("FILODB_FUSED_GATHER", "1") != "0"
+"""Boundary selection strategy for the rate family + last_over_time: the
+default replaces the v @ o1 / v @ o2 one-hot selection MATMULS (6-pass
+f32-HIGHEST emulation over a >=99%-zero [Tp, Wp] matrix) with exact
+per-128-lane-tile dynamic gathers at host-built indices — pure data
+movement, bit-identical selections (tools/probe_slice.py: tiled
+tpu.dynamic_gather compiles on v5e; cross-vreg gathers do not).  "0"
+keeps the matmul path for A/B measurement (tools/tpu_chain.py)."""
+
+
+def gather_default(kind: str) -> bool:
+    """Whether the gather strategy applies to this kernel kind (the
+    over_time band kinds keep their window-sum matmuls: a cumsum+
+    gather-diff replacement would change f32 summation order)."""
+    return _GATHER and kind in ("rate_family", "last_over_time")
+
 
 def _dot_hi(a, b):
     return jnp.dot(a, b, preferred_element_type=jnp.float32,
@@ -181,6 +197,12 @@ class FusedPlan(NamedTuple):
     # raw shared-grid timestamps [1, Tp] f32 (0 pad tail): the ragged rate
     # family selects per-series VALID boundary timestamps in-kernel
     tsrow: np.ndarray = None
+    # boundary slot indices [1, Wp] f32 (first[w] / last[w]; 0 sentinel
+    # for empty + padded windows) — the gather-strategy kernel selects
+    # columns at these host-built positions instead of multiplying the
+    # o1/o2 one-hot matrices (gather_default)
+    idx1: np.ndarray = None
+    idx2: np.ndarray = None
 
 
 def build_plan(ts_row: np.ndarray, wends: np.ndarray,
@@ -225,7 +247,8 @@ def build_plan(ts_row: np.ndarray, wends: np.ndarray,
         n=row(np.maximum(n, 2)),           # safe: invalid windows masked out
         wstart_x=row(wstart - 1), wend_x=row(wend),
         wvalid=(n >= 2), wvalid1=(n >= 1), n1=row(n), W=W, Tp=Tp,
-        tsrow=tsr)
+        tsrow=tsr,
+        idx1=row(np.where(valid, fi, 0)), idx2=row(np.where(valid, la, 0)))
 
 
 _PLAN_MATS_CACHE: dict = {}
@@ -248,9 +271,15 @@ def plan_device_mats(plan: "FusedPlan") -> tuple:
         ent = _PLAN_MATS_CACHE.get(k)
         if ent is not None and ent[0] is plan:
             return ent[1]
+    W = plan.t1.shape[1]
+    idx1 = plan.idx1 if plan.idx1 is not None else np.zeros((1, W),
+                                                            np.float32)
+    idx2 = plan.idx2 if plan.idx2 is not None else np.zeros((1, W),
+                                                            np.float32)
     mats = tuple(jnp.asarray(m) for m in
                  (plan.o1, plan.o2, plan.l1, plan.l2, plan.t1, plan.t2,
-                  plan.n, plan.n1, plan.wstart_x, plan.wend_x, plan.tsrow))
+                  plan.n, plan.n1, plan.wstart_x, plan.wend_x, plan.tsrow,
+                  idx1, idx2))
     with _PLAN_MATS_LOCK:
         _PLAN_MATS_CACHE[k] = (plan, mats)
         while len(_PLAN_MATS_CACHE) > 8:
@@ -258,11 +287,27 @@ def plan_device_mats(plan: "FusedPlan") -> tuple:
     return mats
 
 
-def _kernel_mats(plan: "FusedPlan", over_time: bool) -> tuple:
-    """The 10 operands _run expects, with `n` resolved to true counts for
-    the over_time kinds and clamped counts for the rate family."""
+_SEL_DUMMY = None
+
+
+def _sel_dummy():
+    """Tiny stand-in for the unused selection matrices in gather mode —
+    the kernel never reads them, and the small block frees their ~1.5 MB
+    of VMEM for larger series blocks."""
+    global _SEL_DUMMY
+    if _SEL_DUMMY is None:
+        _SEL_DUMMY = jnp.zeros((8, _LANE), jnp.float32)
+    return _SEL_DUMMY
+
+
+def _kernel_mats(plan: "FusedPlan", over_time: bool,
+                 gather: bool = False) -> tuple:
+    """The 12 operands _run expects after (vals, vbase, gids), with `n`
+    resolved to true counts for the over_time kinds and the o1..l2
+    selection matrices swapped for dummies in gather mode."""
     m = plan_device_mats(plan)
-    return m[:6] + (m[7] if over_time else m[6],) + m[8:]
+    sel = (_sel_dummy(),) * 4 if gather else m[:4]
+    return sel + m[4:6] + (m[7] if over_time else m[6],) + m[8:]
 
 
 def _shift_r(x, k: int, fill):
@@ -328,11 +373,36 @@ def _cumsum_lanes(x):
     return x
 
 
+def _gather_cols(x, idx):
+    """out[s, w] = x[s, idx[0, w]] — the one-hot selection matmul as pure
+    data movement.  Mosaic lowers take_along_axis to tpu.dynamic_gather
+    only within one 128-lane vreg (the cross-vreg form fails to compile,
+    tools/probe_slice.py), so the row is gathered per 128-lane tile and
+    the right tile selected per window.  Exact: no arithmetic touches
+    the values."""
+    bs, Tp = x.shape
+    Wp = idx.shape[1]
+    chunks = []
+    for wc in range(0, Wp, _LANE):
+        ic = jnp.broadcast_to(idx[:, wc:wc + _LANE], (bs, _LANE))
+        acc = jnp.zeros((bs, _LANE), x.dtype)
+        for k in range(0, Tp, _LANE):
+            tile = x[:, k:k + _LANE]
+            local = jnp.clip(ic - k, 0, _LANE - 1)
+            g = jnp.take_along_axis(tile, local, axis=1,
+                                    mode="promise_in_bounds")
+            acc = jnp.where((ic >= k) & (ic < k + _LANE), g, acc)
+        chunks.append(acc)
+    return chunks[0] if len(chunks) == 1 else jnp.concatenate(chunks, axis=1)
+
+
 def _kernel(vals_ref, vbase_ref, gids_ref, o1_ref, o2_ref, l1_ref, l2_ref,
-            t1_ref, t2_ref, n_ref, ws_ref, we_ref, ts_ref, *out_refs,
+            t1_ref, t2_ref, n_ref, ws_ref, we_ref, ts_ref, i1_ref, i2_ref,
+            *out_refs,
             num_groups: int, is_counter: bool, is_rate: bool,
             with_drops: bool, kind: str = "rate_family",
-            ragged: bool = False, per_series: bool = False):
+            ragged: bool = False, per_series: bool = False,
+            gather: bool = False):
     v = vals_ref[:]                                   # [BS, Tp]
     # The MXU's default single bf16 pass truncates f32 mantissas (1e-2
     # relative error on counter magnitudes); _matmuls() picks multi-pass
@@ -347,13 +417,27 @@ def _kernel(vals_ref, vbase_ref, gids_ref, o1_ref, o2_ref, l1_ref, l2_ref,
         # hole to skip (unlike the rate family's range-vector filtering)
         if ragged:
             m = v == v
-            sel = mmv(jnp.where(m, v, 0.0), o2_ref[:])
-            pres = mmb(m.astype(jnp.float32), o2_ref[:])
+            if gather:
+                idx2 = i2_ref[:].astype(jnp.int32)
+                sel = _gather_cols(jnp.where(m, v, 0.0), idx2)
+                # empty windows gather column idx 0 (a plan sentinel):
+                # the true-count mask zeroes their presence, matching
+                # the all-zero o2 column the matmul form relied on
+                pres = _gather_cols(m.astype(jnp.float32), idx2) \
+                    * jnp.minimum(n_ref[:], 1.0)
+            else:
+                sel = mmv(jnp.where(m, v, 0.0), o2_ref[:])
+                pres = mmb(m.astype(jnp.float32), o2_ref[:])
             out = (sel + vbase_ref[:]) * pres
             _epilogue(mmg, gids_ref, out, pres, out_refs, num_groups,
                       per_series, mmb=mmb)
             return
-        out = mmv(v, o2_ref[:]) + vbase_ref[:] * jnp.minimum(n_ref[:], 1.0)
+        if gather:
+            sel = _gather_cols(v, i2_ref[:].astype(jnp.int32)) \
+                * jnp.minimum(n_ref[:], 1.0)
+        else:
+            sel = mmv(v, o2_ref[:])
+        out = sel + vbase_ref[:] * jnp.minimum(n_ref[:], 1.0)
         _epilogue(mmg, gids_ref, out, None, out_refs, num_groups, per_series)
         return
     if kind in ("sum_over_time", "avg_over_time", "count_over_time"):
@@ -414,28 +498,62 @@ def _kernel(vals_ref, vbase_ref, gids_ref, o1_ref, o2_ref, l1_ref, l2_ref,
         tsb = jnp.where(m, jnp.broadcast_to(ts_ref[:], v.shape), 0.0)
         f_c, f_t, _ = _fill_scan2(c, tsb, m, left=False)
         b_c, b_t, _ = _fill_scan2(c, tsb, m, left=True)
-        band = l2_ref[:] - l1_ref[:] + o1_ref[:]
-        nv = mmb(m.astype(jnp.float32), band)          # [BS, Wp] valid count
-        v1 = mmv(b_c, o1_ref[:])
-        v2 = mmv(f_c, o2_ref[:])
-        t1 = mmv(b_t, o1_ref[:])
-        t2 = mmv(f_t, o2_ref[:])
+        if gather:
+            # exact selections at first/last window slots (the fill scans
+            # made those slots carry the boundary VALID values), and the
+            # validity count as a cumsum difference — all integer-in-f32,
+            # bit-identical to the matmul form.  Empty windows gather
+            # slot 0: nv <= 1 there, so presence masks them exactly as
+            # the all-zero selection columns did.
+            idx1 = i1_ref[:].astype(jnp.int32)
+            idx2 = i2_ref[:].astype(jnp.int32)
+            mf = m.astype(jnp.float32)
+            cs_m = _cumsum_lanes(mf)
+            nv = _gather_cols(cs_m, idx2) - _gather_cols(cs_m, idx1) \
+                + _gather_cols(mf, idx1)
+            v1 = _gather_cols(b_c, idx1)
+            v2 = _gather_cols(f_c, idx2)
+            t1 = _gather_cols(b_t, idx1)
+            t2 = _gather_cols(f_t, idx2)
+        else:
+            band = l2_ref[:] - l1_ref[:] + o1_ref[:]
+            nv = mmb(m.astype(jnp.float32), band)      # [BS, Wp] valid count
+            v1 = mmv(b_c, o1_ref[:])
+            v2 = mmv(f_c, o2_ref[:])
+            t1 = mmv(b_t, o1_ref[:])
+            t2 = mmv(f_t, o2_ref[:])
         n = jnp.maximum(nv, 2.0)                      # math-safe; masked
         pres = (nv >= 2.0).astype(jnp.float32)
     else:
-        v1 = mmv(v, o1_ref[:])                         # [BS, Wp]
-        v2 = mmv(v, o2_ref[:])
-        if with_drops:
-            prev = jnp.concatenate([v[:, :1], v[:, :-1]], axis=1)
-            # first column has no predecessor; padded tail columns are
-            # never selected by l1/l2 (first/last < T <= padded region).
-            # A reset adds the FULL previous RAW value = prev + vbase
-            # (rebased rows; ref: DoubleVector.scala:328)
-            d = jnp.where(v < prev, prev + vbase_ref[:], 0.0)
-            col = jax.lax.broadcasted_iota(jnp.int32, d.shape, 1)
-            d = jnp.where(col == 0, 0.0, d)
-            v1 = v1 + mmv(d, l1_ref[:])
-            v2 = v2 + mmv(d, l2_ref[:])
+        if gather:
+            idx1 = i1_ref[:].astype(jnp.int32)
+            idx2 = i2_ref[:].astype(jnp.int32)
+            if with_drops:
+                prev = jnp.concatenate([v[:, :1], v[:, :-1]], axis=1)
+                d = jnp.where(v < prev, prev + vbase_ref[:], 0.0)
+                col = jax.lax.broadcasted_iota(jnp.int32, d.shape, 1)
+                d = jnp.where(col == 0, 0.0, d)
+                # v@o1 + d@l1 == (v + cumsum(d)) selected at first[w]
+                # (l1 is the <=first[w] step matrix); ditto last[w]
+                c = v + _cumsum_lanes(d)
+            else:
+                c = v
+            v1 = _gather_cols(c, idx1)                 # [BS, Wp]
+            v2 = _gather_cols(c, idx2)
+        else:
+            v1 = mmv(v, o1_ref[:])                     # [BS, Wp]
+            v2 = mmv(v, o2_ref[:])
+            if with_drops:
+                prev = jnp.concatenate([v[:, :1], v[:, :-1]], axis=1)
+                # first column has no predecessor; padded tail columns
+                # are never selected by l1/l2 (first/last < T <= padded
+                # region).  A reset adds the FULL previous RAW value =
+                # prev + vbase (rebased rows; ref: DoubleVector.scala:328)
+                d = jnp.where(v < prev, prev + vbase_ref[:], 0.0)
+                col = jax.lax.broadcasted_iota(jnp.int32, d.shape, 1)
+                d = jnp.where(col == 0, 0.0, d)
+                v1 = v1 + mmv(d, l1_ref[:])
+                v2 = v2 + mmv(d, l2_ref[:])
         t1, t2 = t1_ref[:], t2_ref[:]                 # [1, Wp]
         n = n_ref[:]
     ws, we = ws_ref[:], we_ref[:]
@@ -501,15 +619,17 @@ def _epilogue(mm, gids_ref, out, pres, out_refs, num_groups: int,
 
 @functools.partial(jax.jit, static_argnames=(
     "num_groups", "is_counter", "is_rate", "with_drops", "interpret",
-    "kind", "ragged", "per_series"))
+    "kind", "ragged", "per_series", "gather"))
 def _run(vals_p, vbase_p, gids_p, o1, o2, l1, l2, t1, t2, n, ws, we, ts,
+         idx1, idx2,
          num_groups: int, is_counter: bool, is_rate: bool,
          with_drops: bool, interpret: bool, kind: str = "rate_family",
-         ragged: bool = False, per_series: bool = False):
+         ragged: bool = False, per_series: bool = False,
+         gather: bool = False):
     from jax.experimental.pallas import tpu as pltpu
 
     Sp, Tp = vals_p.shape
-    Wp = o1.shape[1]
+    Wp = t1.shape[1]
     Gp = num_groups
     # adaptive series block: the ragged rate family's scan temporaries
     # scale with bs*Tp, so long rows shrink the block instead of OOMing
@@ -518,7 +638,7 @@ def _run(vals_p, vbase_p, gids_p, o1, o2, l1, l2, t1, t2, n, ws, we, ts,
     # every smaller power-of-two block divides.
     bs = pick_block(Tp, Wp, Gp, kind in OVER_TIME_FNS,
                     ragged and kind == "rate_family",
-                    panels=gids_p.shape[1])
+                    panels=gids_p.shape[1], gather=gather)
     if bs is None:
         if interpret:
             bs = _MIN_BS            # no scoped-vmem limit off-chip
@@ -539,7 +659,8 @@ def _run(vals_p, vbase_p, gids_p, o1, o2, l1, l2, t1, t2, n, ws, we, ts,
     fix = lambda shape: pl.BlockSpec(shape, lambda i: (0, 0), **space)  # noqa: E731
     kern = functools.partial(_kernel, num_groups=Gp, is_counter=is_counter,
                              is_rate=is_rate, with_drops=with_drops,
-                             kind=kind, ragged=ragged, per_series=per_series)
+                             kind=kind, ragged=ragged, per_series=per_series,
+                             gather=gather)
     with_counts = ragged                 # presence rides a second output
     if per_series:
         out_spec = pl.BlockSpec((bs, Wp), lambda i: (i, 0), **space)
@@ -549,17 +670,22 @@ def _run(vals_p, vbase_p, gids_p, o1, o2, l1, l2, t1, t2, n, ws, we, ts,
         out_shape = jax.ShapeDtypeStruct((Gp, Wp), jnp.float32)
     out_specs = [out_spec, out_spec] if with_counts else out_spec
     out_shapes = [out_shape, out_shape] if with_counts else out_shape
+    # selection-matrix specs follow the operands' actual shapes: gather-
+    # mode callers pass tiny dummies for the unused o1/o2/l1/l2, freeing
+    # their ~1.5 MB of VMEM for larger series blocks
     return pl.pallas_call(
         kern,
         grid=(grid,),
         in_specs=[row_spec, col_spec, gid_spec,
-                  fix((Tp, Wp)), fix((Tp, Wp)), fix((Tp, Wp)), fix((Tp, Wp)),
+                  fix(o1.shape), fix(o2.shape), fix(l1.shape),
+                  fix(l2.shape),
                   fix((1, Wp)), fix((1, Wp)), fix((1, Wp)), fix((1, Wp)),
-                  fix((1, Wp)), fix((1, Tp))],
+                  fix((1, Wp)), fix((1, Tp)), fix((1, Wp)), fix((1, Wp))],
         out_specs=out_specs,
         out_shape=out_shapes,
         interpret=interpret,
-    )(vals_p, vbase_p, gids_p, o1, o2, l1, l2, t1, t2, n, ws, we, ts)
+    )(vals_p, vbase_p, gids_p, o1, o2, l1, l2, t1, t2, n, ws, we, ts,
+      idx1, idx2)
 
 
 VMEM_BUDGET = 12 << 20          # per-core VMEM is ~16MB; leave headroom
@@ -568,7 +694,7 @@ VMEM_BUDGET = 12 << 20          # per-core VMEM is ~16MB; leave headroom
 def vmem_estimate(Tp: int, Wp: int, Gp: int,
                   over_time: bool = False,
                   ragged_rate: bool = False, bs: int = _BS,
-                  panels: int = 1) -> int:
+                  panels: int = 1, gather: bool = False) -> int:
     """Rough resident-bytes model for one grid step: the 4 selection
     matrices (plus the over_time kinds' band temporary), the
     double-buffered values block, the group one-hot + accumulator, and
@@ -581,7 +707,10 @@ def vmem_estimate(Tp: int, Wp: int, Gp: int,
     instead of failing at kernel lowering; _run shrinks its series block
     (pick_block) before giving up, so the gate must test the SMALLEST
     block, not _BS."""
-    sel = (5 if over_time else 4) * Tp * Wp * 4
+    # gather mode ships 4 KB dummies instead of the o1..l2 matrices
+    # (the over_time band kinds still need them — gather never applies)
+    sel = 4 * 8 * _LANE * 4 if gather else \
+        (5 if over_time else 4) * Tp * Wp * 4
     vals = 2 * bs * Tp * 4
     if ragged_rate:
         # 19 was calibrated BEFORE _fill_scan2 halved the scan carries;
@@ -598,7 +727,8 @@ def vmem_estimate(Tp: int, Wp: int, Gp: int,
 
 
 def pick_block(Tp: int, Wp: int, Gp: int, over_time: bool = False,
-               ragged_rate: bool = False, panels: int = 1) -> Optional[int]:
+               ragged_rate: bool = False, panels: int = 1,
+               gather: bool = False) -> Optional[int]:
     """Largest series-block size whose vmem_estimate fits VMEM_BUDGET
     (None when even _MIN_BS doesn't — the caller must divert to the
     general path).  The ragged rate family's scan temporaries scale with
@@ -608,7 +738,8 @@ def pick_block(Tp: int, Wp: int, Gp: int, over_time: bool = False,
     bs = _BS
     while bs >= _MIN_BS:
         if vmem_estimate(Tp, Wp, Gp, over_time, ragged_rate,
-                         bs=bs, panels=panels) <= VMEM_BUDGET:
+                         bs=bs, panels=panels,
+                         gather=gather) <= VMEM_BUDGET:
             return bs
         bs //= 2
     return None
@@ -657,8 +788,17 @@ def can_fuse(fn_name: str, agg_op: str, shared_grid: bool,
 
 
 # traceable entry for callers composing the kernel inside shard_map (the
-# mesh executor); the jit wrapper inlines under an enclosing trace
-run_kernel = _run
+# mesh executor); the jit wrapper inlines under an enclosing trace.
+# idx1/idx2 optional for legacy 13-operand callers (matmul path only).
+def run_kernel(vals_p, vbase_p, gids_p, o1, o2, l1, l2, t1, t2, n, ws, we,
+               ts, idx1=None, idx2=None, *, gather: bool = False, **kw):
+    if idx1 is None or idx2 is None:
+        if gather:
+            raise ValueError("gather=True requires idx1/idx2 operands")
+        z = jnp.zeros((1, t1.shape[1]), jnp.float32)
+        idx1 = idx2 = z
+    return _run(vals_p, vbase_p, gids_p, o1, o2, l1, l2, t1, t2, n, ws, we,
+                ts, idx1, idx2, gather=gather, **kw)
 
 
 class PreparedInputs(NamedTuple):
@@ -714,7 +854,8 @@ def fused_rate_groupsum(vals, vbase, gids, plan: FusedPlan,
                         precorrected: bool = False,
                         interpret: bool = False,
                         prepared: Optional[PreparedInputs] = None,
-                        ragged: bool = False
+                        ragged: bool = False,
+                        gather: Optional[bool] = None
                         ) -> Tuple[jax.Array, np.ndarray]:
     """-> (sums [G, W] device array, counts [G, W] numpy).
 
@@ -734,11 +875,13 @@ def fused_rate_groupsum(vals, vbase, gids, plan: FusedPlan,
     if prepared is None:
         prepared = pad_inputs(vals, vbase, gids, plan, num_groups)
     Gp = pad_group_count(num_groups)
+    if gather is None:
+        gather = gather_default(kind) and plan.idx1 is not None
     res = _run(prepared.vals_p, prepared.vbase_p, prepared.gids_p,
-               *_kernel_mats(plan, over_time),
+               *_kernel_mats(plan, over_time, gather),
                num_groups=Gp, is_counter=is_counter, is_rate=is_rate,
                with_drops=with_drops, interpret=interpret, kind=kind,
-               ragged=ragged)
+               ragged=ragged, gather=gather)
     if ragged:
         sums, cnts = res
         counts = np.asarray(cnts, np.float64)[:num_groups, :plan.W]
@@ -920,12 +1063,14 @@ def fused_leaf_agg_batch(plan: FusedPlan, values: PaddedValues, panels,
     kind = fn_name if over_time else "rate_family"
     wvalid = plan.wvalid1 if over_time else plan.wvalid
 
+    gather = gather_default(kind) and plan.idx1 is not None
+
     def run(gids_p, Gp, per_series):
         return _run(values.vals_p, values.vbase_p, gids_p,
-                    *_kernel_mats(plan, over_time),
+                    *_kernel_mats(plan, over_time, gather),
                     num_groups=Gp, is_counter=is_counter, is_rate=is_rate,
                     with_drops=with_drops, interpret=interpret, kind=kind,
-                    ragged=ragged, per_series=per_series)
+                    ragged=ragged, per_series=per_series, gather=gather)
 
     def dense_counts(groups):
         return groups.gsize[:, None].astype(np.float64) * \

@@ -255,7 +255,7 @@ def device_put_packed(packed: PackedShards, mesh: Mesh) -> PackedShards:
     "mesh", "G", "S", "T", "Tp", "is_counter", "is_rate", "interpret",
     "kind", "ragged"))
 def _mesh_fused_call(mesh: Mesh, values, group_ids, vbase,
-                     o1, o2, l1, l2, t1, t2, n, ws, we, ts, *,
+                     o1, o2, l1, l2, t1, t2, n, ws, we, ts, i1, i2, *,
                      G: int, S: int, T: int, Tp: int,
                      is_counter: bool, is_rate: bool, interpret: bool,
                      kind: str = "rate_family", ragged: bool = False):
@@ -266,9 +266,10 @@ def _mesh_fused_call(mesh: Mesh, values, group_ids, vbase,
     from filodb_tpu.ops import pallas_fused as pf
     Gp = pf.pad_group_count(G)
     Sp = pf.pad_series_count(S)
+    gather = pf.gather_default(kind)
 
     def step(val_blk, gid_blk, vb_blk, o1b, o2b, l1b, l2b,
-             t1b, t2b, nb, wsb, web, tsb):
+             t1b, t2b, nb, wsb, web, tsb, i1b, i2b):
         # Dense packs: NaN cells are exactly pad rows / beyond-count
         # columns, zeroed they contribute nothing (pack pad rows carry
         # gid 0 but add +0 to its sums).  Ragged packs keep their NaNs —
@@ -288,6 +289,7 @@ def _mesh_fused_call(mesh: Mesh, values, group_ids, vbase,
                     constant_values=-1)
         res = pf.run_kernel(v, vb, g, o1b[0], o2b[0], l1b[0], l2b[0],
                             t1b[0], t2b[0], nb[0], wsb[0], web[0], tsb[0],
+                            i1b[0], i2b[0], gather=gather,
                             num_groups=Gp, is_counter=is_counter,
                             is_rate=is_rate, with_drops=False,
                             interpret=interpret, kind=kind, ragged=ragged)
@@ -300,14 +302,14 @@ def _mesh_fused_call(mesh: Mesh, values, group_ids, vbase,
     return jax.shard_map(
         step, mesh=mesh,
         in_specs=(P("shard", None, None), P("shard", None, None),
-                  P("shard", None)) + (P("time", None, None),) * 10,
+                  P("shard", None)) + (P("time", None, None),) * 12,
         out_specs=((P(None, "time"), P(None, "time")) if ragged
                    else P(None, "time")),
         # pallas_call's out_shape carries no varying-mesh-axes info, which
         # trips shard_map's vma checker; the psum makes the output
         # replicated over 'shard' by construction
         check_vma=False)(values, group_ids, vbase,
-                         o1, o2, l1, l2, t1, t2, n, ws, we, ts)
+                         o1, o2, l1, l2, t1, t2, n, ws, we, ts, i1, i2)
 
 
 def distributed_window_agg(mesh: Mesh, ts_off, values, group_ids, wends, *,
@@ -698,6 +700,17 @@ class MeshExecutor:
                                             fn_name=fn_name, agg_op=op)
         return results
 
+    def _sel_dummy(self, n_time: int):
+        """Stacked [n_time, 8, 128] zeros standing in for the unused
+        selection matrices on the gather path, uploaded once."""
+        d = getattr(self, "_sel_dummy_dev", None)
+        if d is None or d.shape[0] != n_time:
+            d = jax.device_put(
+                np.zeros((n_time, 8, 128), np.float32),
+                NamedSharding(self.mesh, P("time", None, None)))
+            self._sel_dummy_dev = d
+        return d
+
     def _panel_groupings(self, packed: PackedShards, panels):
         """Per-panel (gids, G, op, gsize) + labels over the pack's rows —
         the host remap work run_agg_batch caches per (pack, panels)."""
@@ -852,11 +865,13 @@ class MeshExecutor:
                 offsets.append(Gtot)
                 Gtot += kpanels[i][1]
             # padded group count, matching _run's recomputation exactly
+            kind_k = fn_name if over_time else "rate_family"
             if pf.pick_block(
                     Tp, Wlp, pf.pad_group_count(Gtot),
                     over_time,
                     ragged and fn_name in ("rate", "increase", "delta"),
-                    panels=max(len(kidx), 1)) is None:
+                    panels=max(len(kidx), 1),
+                    gather=pf.gather_default(kind_k)) is None:
                 return None
             # plan + device-mats cache: repeat queries (the pack-cache
             # pattern) skip the host selection-matrix rebuild + 9 uploads
@@ -875,7 +890,8 @@ class MeshExecutor:
                     jax.device_put(st(a), NamedSharding(
                         self.mesh, P("time", None, None)))
                     for a in ("o1", "o2", "l1", "l2", "t1", "t2", "n",
-                              "wstart_x", "wend_x", "n1", "tsrow"))
+                              "wstart_x", "wend_x", "n1", "tsrow",
+                              "idx1", "idx2"))
                 wvalid = np.concatenate([p.wvalid for p in plans])
                 wvalid1 = np.concatenate([p.wvalid1 for p in plans])
                 ent = (mats, wvalid, wvalid1)
@@ -888,7 +904,12 @@ class MeshExecutor:
             # the kernel's `n` slot carries TRUE counts for the over_time
             # kinds and the rate family's clamped counts otherwise
             mats = (mats[:6] + ((mats[9] if over_time else mats[6]),)
-                    + mats[7:9] + (mats[10],))
+                    + mats[7:9] + (mats[10], mats[11], mats[12]))
+            if pf.gather_default(fn_name if over_time else "rate_family"):
+                # gather mode never reads o1..l2: ship 4 KB dummies so
+                # each grid step skips ~1.5 MB of dead VMEM loads (same
+                # swap the leaf path does in _kernel_mats)
+                mats = (self._sel_dummy(n_time),) * 4 + mats[4:]
             vbase = packed.vbase
             if vbase is None:
                 vbase = jax.device_put(

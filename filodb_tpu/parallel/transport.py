@@ -125,8 +125,14 @@ class RemoteNodeDispatcher(PlanDispatcher):
     """Coordinator-side dispatcher for one remote node; keeps one pooled
     connection per thread (ref: ActorPlanDispatcher ask-pattern send)."""
 
-    def __init__(self, host: str, port: int, timeout_s: float = 60.0):
+    def __init__(self, host: str, port: int,
+                 timeout_s: Optional[float] = None):
         self.host, self.port = host, port
+        if timeout_s is None:
+            # the ask-timeout knob (ref: filodb-defaults.conf
+            # query.ask-timeout; PlanDispatcher.scala:31 Akka ask)
+            from filodb_tpu.config import settings
+            timeout_s = settings().query.ask_timeout_s
         self.timeout_s = timeout_s
         self._tls = threading.local()
 
@@ -151,27 +157,60 @@ class RemoteNodeDispatcher(PlanDispatcher):
                 self._tls.sock = None
 
     def dispatch(self, plan, source) -> QueryResultLike:
+        from filodb_tpu.query.execbase import QueryError
         payload = serialize.dumps(plan)
-        sock, fresh = self._sock()
+        where = f"{self.host}:{self.port}"
+        try:
+            sock, fresh = self._sock()
+        except OSError as e:
+            # connect refused/unreachable: the owner is gone (SIGKILL,
+            # network partition) — the taxonomy's shard_unavailable
+            raise QueryError("shard_unavailable",
+                             f"node {where} unreachable: {e}") from e
         try:
             _send_frame(sock, payload)
             reply = serialize.loads(_recv_frame(sock))
-        except socket.timeout:
+        except socket.timeout as e:
             # NEVER retry a timeout: the remote may still be executing the
             # plan, and a re-send would run the query twice
             self._reset()
-            raise
-        except (ConnectionError, OSError):
+            raise QueryError(
+                "dispatch_timeout",
+                f"node {where} gave no reply within {self.timeout_s}s "
+                f"(not retried: the remote may still be executing)") from e
+        except (ConnectionError, OSError) as e:
             self._reset()
             if fresh:
-                raise                  # a brand-new connection failed: real
-            # pooled socket had gone stale — one retry on a fresh one
-            sock, _ = self._sock()
-            _send_frame(sock, payload)
-            reply = serialize.loads(_recv_frame(sock))
+                raise QueryError("shard_unavailable",
+                                 f"node {where} died mid-dispatch: "
+                                 f"{e}") from e
+            # pooled socket had gone stale — one retry on a fresh one.
+            # The CONNECT is classified separately: a connect timeout
+            # means the node is unreachable (shard_unavailable, same as
+            # the first-attempt path), not "accepted but silent"
+            try:
+                sock, _ = self._sock()
+            except OSError as e2:
+                raise QueryError("shard_unavailable",
+                                 f"node {where} unreachable: "
+                                 f"{e2}") from e2
+            try:
+                _send_frame(sock, payload)
+                reply = serialize.loads(_recv_frame(sock))
+            except socket.timeout as e2:
+                self._reset()
+                raise QueryError(
+                    "dispatch_timeout",
+                    f"node {where} gave no reply within "
+                    f"{self.timeout_s}s") from e2
+            except (ConnectionError, OSError) as e2:
+                self._reset()
+                raise QueryError("shard_unavailable",
+                                 f"node {where} died mid-dispatch: "
+                                 f"{e2}") from e2
         if not reply["ok"]:
-            raise RuntimeError(f"remote node {self.host}:{self.port} "
-                               f"failed: {reply['error']}")
+            raise QueryError("remote_failure",
+                             f"node {where} failed: {reply['error']}")
         # stitch the remote node's spans into the caller's trace (they
         # arrive stamped with the remote NODE_NAME)
         spans = reply.get("spans")

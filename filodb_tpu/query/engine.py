@@ -27,7 +27,8 @@ class QueryEngine:
     def __init__(self, dataset: str, source,
                  shard_mapper: Optional[ShardMapper] = None,
                  spread_provider: Optional[SpreadProvider] = None,
-                 planner: Optional[SingleClusterPlanner] = None):
+                 planner: Optional[SingleClusterPlanner] = None,
+                 replan_hook=None):
         self.dataset = dataset
         self.source = source
         # embedded-engine deployments (no FiloServer) still get the
@@ -37,6 +38,13 @@ class QueryEngine:
         self.shard_mapper = shard_mapper or _single_shard_mapper()
         self.planner = planner or SingleClusterPlanner(
             dataset, self.shard_mapper, spread_provider)
+        # () -> SingleClusterPlanner with a FRESH shard-map snapshot.
+        # When a scatter-gather fails shard_unavailable (owner died
+        # mid-query), the engine re-plans through this hook up to
+        # query.dispatch_retries times — after failover the new plan
+        # dispatches to the reassigned owner (ref: the HA planner's
+        # route-around-failure stance, HighAvailabilityPlanner.scala:22)
+        self.replan_hook = replan_hook
 
     def _ctx(self, planner_params: Optional[PlannerParams]) -> QueryContext:
         return QueryContext(query_id=str(uuid.uuid4()),
@@ -103,9 +111,9 @@ class QueryEngine:
             except Exception as e:  # noqa: BLE001
                 results[i] = QueryResult([], error=f"planning error: {e}")
                 continue
-            entries.append((i, ep, ctx))
+            entries.append((i, ep, ctx, plan))
         calls = []
-        for _, ep, _ in entries:
+        for _, ep, _, _ in entries:
             for leaf in _walk_plan(ep):
                 if isinstance(leaf, MultiSchemaPartitionsExec) and \
                         isinstance(leaf.dispatcher, InProcessPlanDispatcher):
@@ -124,9 +132,16 @@ class QueryEngine:
             for (leaf, fc), partial in zip(calls, partials):
                 if partial is not None:
                     leaf.inject_fused(partial)
-        for i, ep, ctx in entries:
+        for i, ep, ctx, plan in entries:
             res = ep.execute(self.source)
             res.trace_id = ctx.query_id
+            if res.error and res.error.startswith("shard_unavailable") \
+                    and self.replan_hook is not None:
+                # failover retry for the dashboard-batch path too: the
+                # retried query re-plans through exec_logical_plan (it
+                # loses this batch's fusion, which is moot — its shard
+                # owner just died)
+                res = self.exec_logical_plan(plan, planner_params)
             results[i] = res
         return results
 
@@ -145,6 +160,26 @@ class QueryEngine:
             return QueryResult([], stats)
         res = ep.execute(self.source)
         res.trace_id = ctx.query_id
+        if res.error and res.error.startswith("shard_unavailable") \
+                and self.replan_hook is not None:
+            from filodb_tpu.config import settings
+            from filodb_tpu.utils.metrics import registry
+            for _ in range(max(settings().query.dispatch_retries, 0)):
+                # a shard owner died mid-query: re-plan against a fresh
+                # shard-map snapshot and retry on the reassigned owner
+                # (only shard_unavailable — dispatch_timeout is never
+                # retried, the remote may still be executing)
+                registry.counter("query_replan_retries").increment()
+                try:
+                    self.planner = self.replan_hook()
+                    ep = self.planner.materialize(plan, ctx)
+                except Exception as e:  # noqa: BLE001
+                    return QueryResult([], error=f"replan error: {e}")
+                res = ep.execute(self.source)
+                res.trace_id = ctx.query_id
+                if not (res.error
+                        and res.error.startswith("shard_unavailable")):
+                    break
         return res
 
     # ------------------------------------------------- Prometheus JSON model
@@ -164,8 +199,13 @@ class QueryEngine:
             if pairs:
                 out.append({"metric": _prom_labels(key.labels_dict),
                             "values": pairs})
-        return {"status": "success",
-                "data": {"resultType": "matrix", "result": out}}
+        payload = {"status": "success",
+                   "data": {"resultType": "matrix", "result": out}}
+        if result.partial:
+            payload["warnings"] = ["partial results: one or more shards "
+                                   "were unreachable"]
+            payload["partial"] = True
+        return payload
 
     @staticmethod
     def to_prom_vector(result: QueryResult) -> Dict:

@@ -127,6 +127,36 @@ class GroupCardinalityError(ValueError):
     surface even from the fused fast path (everything else falls back)."""
 
 
+class QueryError(Exception):
+    """Typed query-path failure with a stable machine-readable code — the
+    error taxonomy a scatter-gather root surfaces when a node dies
+    mid-query (ref: the Akka ask's clean QueryError at the root,
+    query/.../exec/PlanDispatcher.scala:31-55).  Codes:
+
+      shard_unavailable — a child dispatch could not reach its shard
+          owner (connection refused / reset, e.g. SIGKILL mid-query).
+          Retryable: after failover reassigns the shard, a re-planned
+          query succeeds (QueryEngine retries once when
+          query.dispatch_retries > 0 and a replan hook is wired).
+      dispatch_timeout — the remote accepted the plan but no reply
+          arrived within the dispatcher timeout (query.ask_timeout_s).
+          NOT retried automatically: the remote may still be executing,
+          and a re-send would run the query twice.
+      remote_failure — the remote executed the plan and returned an
+          error (its exception text rides along).  Not retryable here;
+          the same plan would fail the same way.
+
+    The string form is always "<code>: <detail>", so HTTP/CLI clients
+    (and tests) can route on `error.split(':', 1)[0]`."""
+
+    def __init__(self, code: str, detail: str):
+        self.code = code
+        super().__init__(detail)
+
+    def __str__(self):
+        return f"{self.code}: {super().__str__()}"
+
+
 def _lru_touch(cache: Dict, key) -> object:
     """Get + move-to-back (dicts iterate in insertion order, so eviction
     pops the front = least-recently-used).  One idiom for all fused caches."""
@@ -436,6 +466,13 @@ class ExecPlan:
             with trace_context(self.ctx.query_id), \
                     span("execplan", plan=type(self).__name__):
                 data, stats = self.execute_internal(source)
+        except QueryError as e:
+            # typed taxonomy (shard_unavailable / dispatch_timeout /
+            # remote_failure): str(e) already leads with the code
+            registry.counter("query_errors",
+                             plan=type(self).__name__,
+                             code=e.code).increment()
+            return QueryResult([], QueryStats(), error=str(e))
         except Exception as e:  # noqa: BLE001 — query errors surface in result
             registry.counter("query_errors",
                              plan=type(self).__name__).increment()
@@ -454,7 +491,7 @@ class ExecPlan:
                                error=f"sample limit {limit} exceeded "
                                      f"({result_samples} samples)")
         stats.result_samples = result_samples
-        return QueryResult(blocks, stats)
+        return QueryResult(blocks, stats, partial=stats.partial)
 
     # -- plan printing (ref: ExecPlan.printTree, doc/query-engine.md:174-204)
 
@@ -500,8 +537,28 @@ class NonLeafExecPlan(ExecPlan):
     def _gather(self, source) -> Tuple[List[Data], QueryStats]:
         stats = QueryStats()
         results = []
+        allow_partial = self.ctx.planner_params.allow_partial_results
         for c in self._children:
-            data, st = c.dispatcher.dispatch(c, source)
+            try:
+                data, st = c.dispatcher.dispatch(c, source)
+            except QueryError as e:
+                # a dead shard owner mid-query: fail the whole query with
+                # the typed error — or, when the caller opted into
+                # partial results, drop the child and FLAG the result
+                # (never silent partials; ref: PlanDispatcher.scala:31-55,
+                # PlannerParams.allowPartialResults)
+                if allow_partial and e.code == "shard_unavailable":
+                    from filodb_tpu.utils.metrics import registry
+                    registry.counter("query_partial_children",
+                                     plan=type(self).__name__).increment()
+                    stats.partial = True
+                    # placeholder, NOT continue: BinaryJoin/SetOperator
+                    # split `results` positionally at n_lhs, so a dropped
+                    # child must keep its slot (every compose filters by
+                    # isinstance, so None contributes nothing)
+                    results.append(None)
+                    continue
+                raise
             stats.merge(st)
             results.append(data)
         return results, stats

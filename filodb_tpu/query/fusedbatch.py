@@ -99,6 +99,8 @@ def finish_fused_calls(calls: List[FusedCall]) -> List[AggPartial]:
                 over_time = fc0.fn in pf.OVER_TIME_FNS
                 ragged_rate = fc0.ragged and fc0.fn in ("rate", "increase",
                                                         "delta")
+                kind = fc0.fn if over_time else "rate_family"
+                gmode = pf.gather_default(kind)
                 while len(take) > 1:
                     n_group = sum(1 for i in take if in_group_mode(i))
                     total = sum(slots(i) for i in take
@@ -106,7 +108,8 @@ def finish_fused_calls(calls: List[FusedCall]) -> List[AggPartial]:
                     if total == 0 or pf.pick_block(
                             Tp, Wp, pf.pad_group_count(total),
                             over_time, ragged_rate,
-                            panels=max(n_group, 1)) is not None:
+                            panels=max(n_group, 1),
+                            gather=gmode) is not None:
                         break
                     take = take[:max(1, len(take) // 2)]
             panels = [(calls[i].groups, slots(i), calls[i].op)

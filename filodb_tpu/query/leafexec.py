@@ -210,7 +210,10 @@ class MultiSchemaPartitionsExec(LeafExecPlan):
         Wp = pf._pad_to(eval_wends.size, pf._LANE)
         over_time = t0.function in pf.OVER_TIME_FNS
         ragged_rate = not dense and fn in ("rate", "increase", "delta")
-        if pf.pick_block(Tp, Wp, 8, over_time, ragged_rate) is None:
+        kind = fn if fn in pf.OVER_TIME_FNS else "rate_family"
+        gather = pf.gather_default(kind)
+        if pf.pick_block(Tp, Wp, 8, over_time, ragged_rate,
+                         gather=gather) is None:
             return None
         from filodb_tpu.utils.metrics import registry
         # plan + prepared-input caches: a repeat query over an unchanged
@@ -248,7 +251,7 @@ class MultiSchemaPartitionsExec(LeafExecPlan):
         # same padded group count _run will use — a gate tested on the
         # unpadded count could accept a shape _run then rejects
         if pf.pick_block(Tp, Wp, pf.pad_group_count(num_slots),
-                         over_time, ragged_rate) is None:
+                         over_time, ragged_rate, gather=gather) is None:
             return None
         if padded_vals is None:
             vbase = data.vbase

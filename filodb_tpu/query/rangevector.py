@@ -94,12 +94,16 @@ class QueryStats:
     series_scanned: int = 0
     result_samples: int = 0
     shards_queried: int = 0
+    # set when allow_partial_results dropped an unreachable child —
+    # propagates bottom-up through merge() to the root QueryResult
+    partial: bool = False
 
     def merge(self, other: "QueryStats") -> None:
         self.samples_scanned += other.samples_scanned
         self.series_scanned += other.series_scanned
         self.result_samples += other.result_samples
         self.shards_queried += other.shards_queried
+        self.partial = self.partial or other.partial
 
 
 @dataclasses.dataclass
@@ -113,6 +117,11 @@ class QueryResult:
     # the query's trace id (= ctx.query_id): fetch the stitched cross-node
     # span tree from utils.metrics.collector / GET /admin/traces/<id>
     trace_id: str = ""
+    # True when allow_partial_results dropped unreachable shards from a
+    # scatter-gather (ref: QueryContext.scala PlannerParams
+    # allowPartialResults / QueryResult mayBePartial): NEVER silently —
+    # to_prom_matrix surfaces it as a warning + "partial": true
+    partial: bool = False
 
     @property
     def num_series(self) -> int:
@@ -141,6 +150,10 @@ class PlannerParams:
     enforced_limits: bool = True
     shard_overrides: Optional[List[int]] = None
     process_multi_partition: bool = False
+    # scatter-gather children whose shard owner is unreachable are
+    # DROPPED (result flagged partial) instead of failing the query
+    # (ref: PlannerParams.allowPartialResults)
+    allow_partial_results: bool = False
 
 
 @dataclasses.dataclass

@@ -179,6 +179,11 @@ class FiloServer:
 
     def start(self, background_flush: bool = True) -> None:
         self.http.start()
+        self.trace_exporter = None
+        if self.config.trace_export_url:
+            from filodb_tpu.utils.traceexport import TraceExporter
+            self.trace_exporter = TraceExporter(
+                self.config.trace_export_url).start()
         self.warmup_thread = None
         shapes = parse_warmup_shapes(self.config.warmup_shapes)
         if shapes:
@@ -215,6 +220,9 @@ class FiloServer:
         for sched in self.flush_schedulers.values():
             sched.stop(final_flush=True)
         self.flush_schedulers.clear()
+        if getattr(self, "trace_exporter", None) is not None:
+            self.trace_exporter.stop()
+            self.trace_exporter = None
         self.http.stop()
 
     def flush_and_downsample(self, dataset: str) -> int:

@@ -190,6 +190,16 @@ class TraceCollector:
         self._traces: Dict[str, List[dict]] = {}
         self._order: List[str] = []
         self._lock = threading.Lock()
+        # push-export hooks (utils/traceexport.TraceExporter): called
+        # outside the lock with every recorded event; must not block
+        self._sinks: List = []
+
+    def add_sink(self, sink) -> None:
+        self._sinks.append(sink)
+
+    def remove_sink(self, sink) -> None:
+        if sink in self._sinks:
+            self._sinks.remove(sink)
 
     def record(self, trace_id: str, event: dict) -> None:
         with self._lock:
@@ -201,6 +211,8 @@ class TraceCollector:
                     self._traces.pop(self._order.pop(0), None)
             if len(evs) < self.max_events:
                 evs.append(event)
+        for sink in self._sinks:
+            sink(trace_id, event)
 
     def trace(self, trace_id: str) -> List[dict]:
         with self._lock:

@@ -213,3 +213,118 @@ def test_cluster_ingest_query_failover(tmp_path, backend):
         coord.stop()
         if svc is not None:
             svc.stop()
+
+
+def test_mid_query_node_kill_semantics(tmp_path):
+    """Round-5 verdict item 4 (ref: ClusterSingletonFailoverSpec.scala,
+    PlanDispatcher.scala:31-55): SIGKILL a shard owner with queries in
+    flight.  The scatter-gather root must (a) surface a CLEAN typed
+    QueryError — code `shard_unavailable` — promptly, never hang;
+    (b) return flagged partials when the caller opted in, never silent
+    ones; (c) with a replan hook, retry on the reassigned owner after
+    failover and succeed."""
+    from filodb_tpu.query.rangevector import PlannerParams
+
+    q = 'sum by (_ns_)(cluster_metric{_ws_="demo"})'
+    sm = ShardManager(reassignment_min_interval_s=0)
+    coord = ClusterCoordinator(sm, liveness_timeout_s=2.5,
+                               check_interval_s=0.3).start()
+    coord.setup_dataset("prometheus", NUM_SHARDS, min_num_nodes=2)
+    procs = []
+    try:
+        pa, ia = _spawn("A", coord.address[1], tmp_path)
+        procs.append(pa)
+        pb, ib = _spawn("B", coord.address[1], tmp_path)
+        procs.append(pb)
+        pc, ic = _spawn("C", coord.address[1], tmp_path)
+        procs.append(pc)
+        cli = ClusterClient(coord.address)
+        _wait_state(
+            cli, lambda s: s["datasets"]["prometheus"]["statuses"]
+            == ["Active"] * NUM_SHARDS, what="all shards active")
+
+        lines = _mk_lines()
+        for info in (ia, ib, ic):
+            r = _rpc(("127.0.0.1", info["control_port"]),
+                     {"cmd": "ingest_lines", "lines": lines, "offset": 1},
+                     timeout_s=120)
+            assert r["ok"], r
+        for info in (ia, ib):
+            r = _rpc(("127.0.0.1", info["control_port"]), {"cmd": "flush"},
+                     timeout_s=120)
+            assert r["ok"], r
+
+        # engines bound to the PRE-KILL shard map: they will keep
+        # dispatching to B after it dies (the production window between
+        # a crash and deathwatch noticing)
+        stale_engine = _engine(cli)
+        want = _query(cli, q)
+        assert len(want) == 4
+
+        # (true in-flight race) fire a query concurrently with the kill:
+        # it must COMPLETE either way — success if it won the race, a
+        # typed error if it lost — never hang
+        box = {}
+
+        def racing():
+            box["res"] = stale_engine.query_range(
+                q, START // 1000 + 120, 60, START // 1000 + 880)
+
+        racer = threading.Thread(target=racing, daemon=True)
+        racer.start()
+        time.sleep(0.05)
+        pb.kill()
+        racer.join(timeout=30)
+        assert "res" in box, "in-flight query hung after owner SIGKILL"
+        res = box["res"]
+        assert res.error is None or res.error.startswith(
+            ("shard_unavailable", "dispatch_timeout")), res.error
+
+        # (a) clean typed error, promptly — before failover completes
+        t0 = time.time()
+        res = stale_engine.query_range(q, START // 1000 + 120, 60,
+                                       START // 1000 + 880)
+        elapsed = time.time() - t0
+        assert res.error is not None and res.error.startswith(
+            "shard_unavailable"), res.error
+        assert elapsed < 20, f"error took {elapsed:.1f}s (hang?)"
+
+        # (b) flagged partials on opt-in: surviving shards answer, the
+        # result says so — silent partials are forbidden
+        res_p = stale_engine.query_range(
+            q, START // 1000 + 120, 60, START // 1000 + 880,
+            PlannerParams(allow_partial_results=True))
+        assert res_p.error is None, res_p.error
+        assert res_p.partial is True
+        assert 0 < res_p.num_series <= len(want)
+        payload = QueryEngine.to_prom_matrix(res_p)
+        assert payload.get("partial") is True
+        assert payload.get("warnings")
+
+        # (c) replan hook: same stale engine, but wired to re-plan from a
+        # fresh shard map — after failover lands the retry succeeds
+        def _failover_done(s):
+            ds = s["datasets"]["prometheus"]
+            return ("B" not in s["members"]
+                    and ds["statuses"] == ["Active"] * NUM_SHARDS)
+        _wait_state(cli, _failover_done, timeout_s=60,
+                    what="failover to standby")
+
+        retry_engine = _engine(cli2 := ClusterClient(coord.address))
+        # poison the retry engine with the STALE planner so its first
+        # dispatch fails, then let the hook re-plan from the live map
+        retry_engine.planner = stale_engine.planner
+        retry_engine.replan_hook = lambda: _engine(cli2).planner
+        res3 = retry_engine.query_range(q, START // 1000 + 120, 60,
+                                        START // 1000 + 880)
+        assert res3.error is None, res3.error
+        got3 = {str(k): np.asarray(v) for k, _, v in res3.series()}
+        assert set(got3) == set(want)
+        for k in want:
+            np.testing.assert_allclose(got3[k], want[k], rtol=1e-9,
+                                       equal_nan=True)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        coord.stop()

@@ -150,7 +150,9 @@ def test_missing_dataset_returns_empty(cluster):
 
 def test_remote_exception_rides_wire_as_error():
     """A server-side crash must come back as ok=False and surface as a
-    RuntimeError naming the node (ref: QueryActor error replies)."""
+    typed QueryError(remote_failure) naming the node (ref: QueryActor
+    error replies; taxonomy in doc/query-engine.md)."""
+    from filodb_tpu.query.execbase import QueryError
 
     class _ExplodingSource:
         def get_shard(self, dataset, shard_num):
@@ -161,8 +163,9 @@ def test_remote_exception_rides_wire_as_error():
         disp = RemoteNodeDispatcher(*srv.address)
         leaf = MultiSchemaPartitionsExec(QueryContext(), "prometheus", 0,
                                          [], 0, 10)
-        with pytest.raises(RuntimeError) as ei:
+        with pytest.raises(QueryError) as ei:
             disp.dispatch(leaf, None)
+        assert ei.value.code == "remote_failure"
         assert "store corrupted" in str(ei.value)
         assert str(srv.address[1]) in str(ei.value)
     finally:

@@ -84,16 +84,16 @@ def chain_fn(jax, jnp, plan, prep, G, K, per_series):
     from jax import lax
     from filodb_tpu.ops import pallas_fused as pf
 
-    Gp = (max(G, 8) + 7) // 8 * 8
-    mats = tuple(jnp.asarray(m) for m in
-                 (plan.o1, plan.o2, plan.l1, plan.l2, plan.t1, plan.t2,
-                  plan.n, plan.wstart_x, plan.wend_x, plan.tsrow))
+    Gp = pf.pad_group_count(G)
+    gather = os.environ.get("FILODB_CHAIN_GATHER", "0") == "1"
+    mats = pf._kernel_mats(plan, over_time=False, gather=gather)
 
     @jax.jit
     def run(vals_p, vbase_p, gids_p):
         def body(i, acc):
             res = pf.run_kernel(
                 vals_p, vbase_p + acc * 1e-30, gids_p, *mats,
+                gather=gather,
                 num_groups=Gp, is_counter=True, is_rate=True,
                 with_drops=False, interpret=False, kind="rate_family",
                 ragged=False, per_series=per_series)
@@ -161,7 +161,10 @@ def main():
         except Exception:  # noqa: BLE001
             pass
     persist()
-    shapes = [("chain_262k", 262_144), ("chain_1m", 1_048_576)]
+    suffix = "_gather" if os.environ.get("FILODB_CHAIN_GATHER") == "1" \
+        else ""
+    shapes = [("chain_262k" + suffix, 262_144),
+              ("chain_1m" + suffix, 1_048_576)]
     want = set(sys.argv[1:])
     for name, S in shapes:
         if want and name not in want:

@@ -212,7 +212,7 @@ def section_shardmap(jax, jnp):
     dvb = jax.device_put(vb[None])
     mats = tuple(jax.device_put(getattr(plan, a)[None]) for a in
                  ("o1", "o2", "l1", "l2", "t1", "t2", "n",
-                  "wstart_x", "wend_x", "tsrow"))
+                  "wstart_x", "wend_x", "tsrow", "idx1", "idx2"))
 
     def via_shardmap():
         out = fmesh._mesh_fused_call(
