@@ -50,6 +50,14 @@ class QueryConfig:
     # request per panel.  0 disables (default: opt-in, it trades up to
     # this much added latency for dispatch amortization).
     batch_window_ms: float = 0.0
+    # cost-based host/device leaf routing (round-5 verdict item 6): leaf
+    # working sets whose estimated scan is at or below this many samples
+    # evaluate in host numpy (ops/hostleaf) instead of paying the chip's
+    # ~65 ms per-dispatch floor (measured crossover ~2-3M samples on the
+    # tunneled v5e: host vectorized numpy sustains ~40-60M samples/s).
+    # 0 disables.  Decision is observable: `leaf_host_routed` counter +
+    # the execplan span's route tag.
+    host_route_max_samples: int = 2_000_000
 
 
 @dataclasses.dataclass

@@ -161,6 +161,14 @@ class TimeSeriesShard:
         # for stall logging (the ChunkMap lock-stall detection analogue,
         # ref: memory/.../data/ChunkMap.scala:24-38).
         self.write_lock = threading.RLock()
+        # flush-vs-flush mutex only: serializes concurrent flush_group
+        # calls (downsampler state, store write ordering) WITHOUT holding
+        # the shard write_lock across the expensive encode+persist phase
+        self._flush_lock = threading.Lock()
+        # per-partition newest-downsampled timestamp (flush-thread only,
+        # under _flush_lock): dedupes downsample emission when a
+        # shift-skipped seal makes a flush re-read an unsealed range
+        self._ds_time_wm: Dict[int, int] = {}
         # flush-group membership maintained at creation so a group flush
         # walks only its own partitions, not all of them
         self._group_pids: List[List[int]] = [[] for _ in range(self._groups)]
@@ -394,7 +402,12 @@ class TimeSeriesShard:
         group checkpoint (ref: TimeSeriesShard.doFlushSteps:969,
         writeChunks:1072, commitCheckpoint:1127).  Returns chunks written."""
         ingestion_time_ms = ingestion_time_ms or int(time.time() * 1000)
-        with self._write_locked("flush"):
+        # Flushes serialize against EACH OTHER here (downsampler state,
+        # store writes), but hold the shard write_lock only for the brief
+        # copy and seal phases — encode + persist + downsample run with
+        # ingest and queries live.  The old whole-flush write_lock held
+        # it >10 s per group at 131k series (soak-measured stall).
+        with self._flush_lock:
             with metrics_span("flush", dataset=self.dataset):
                 written = self._do_flush_group(group, ingestion_time_ms)
         metrics_registry.counter("chunks_flushed",
@@ -430,57 +443,96 @@ class TimeSeriesShard:
         return len(pruned)
 
     def _do_flush_group(self, group: int, ingestion_time_ms: int) -> int:
-        self._prune_tombstones()
-        # Snapshot the replay watermark BEFORE reading any data: the
-        # checkpoint must never claim offsets whose samples were not yet
-        # encoded when this flush read them (a background flush racing a
-        # live ingest would otherwise lose samples on replay, ref:
-        # TimeSeriesShard.commitCheckpoint ordering).
-        offset_snapshot = self.ingested_offset
+        """Three phases: (1) under write_lock, copy every partition's
+        unsealed slice (cheap); (2) lock-FREE, encode + persist +
+        downsample (the expensive part, overlapping live ingest/queries);
+        (3) under write_lock, advance sealed watermarks + commit the
+        checkpoint.  Sealing happens only AFTER chunks are persisted, so
+        a crash mid-encode loses nothing (replay covers it) and eviction
+        can never reclaim samples whose disk copy doesn't exist yet.  If
+        an eviction SHIFTED a store's rows during phase 2 (shift_version
+        moved), its seals are skipped — the next flush re-reads and
+        re-writes those slices; chunk writes are idempotent."""
+        pending = []
+        with self._write_locked("flush_copy"):
+            self._prune_tombstones()
+            # Snapshot the replay watermark BEFORE reading any data: the
+            # checkpoint must never claim offsets whose samples were not
+            # yet encoded when this flush read them (a background flush
+            # racing a live ingest would otherwise lose samples on
+            # replay, ref: TimeSeriesShard.commitCheckpoint ordering).
+            offset_snapshot = self.ingested_offset
+            shift_snapshot = {name: st.shift_version
+                              for name, st in self.stores.items()}
+            for pid in self._group_pids[group]:
+                info = self.partitions[pid]
+                if info is None or not self._pid_alive[pid]:
+                    continue
+                store = self.stores[info.schema_name]
+                lo, hi = store.unsealed_range(info.row)
+                if hi <= lo:
+                    continue
+                ts, cols = store.series_slice(info.row, lo, hi)
+                pending.append((pid, info, hi, ts, cols,
+                                store.bucket_les))
         written = 0
-        dirty_pids: set = set()
-        for pid in self._group_pids[group]:
-            info = self.partitions[pid]
-            if info is None or not self._pid_alive[pid]:
-                continue
-            store = self.stores[info.schema_name]
-            lo, hi = store.unsealed_range(info.row)
-            if hi <= lo:
-                continue
-            ts, cols = store.series_slice(info.row, lo, hi)
+        encoded = []
+        for pid, info, hi, ts, cols, les in pending:
             schema = self.schemas[info.schema_name]
             col_types = {c.name: c.col_type for c in schema.data_columns}
-            scheme = (HistogramBuckets.custom(store.bucket_les)
-                      if store.bucket_les is not None else None)
-            cs = encode_chunkset(ts, cols, col_types, ingestion_time_ms, scheme)
+            scheme = (HistogramBuckets.custom(les)
+                      if les is not None else None)
+            cs = encode_chunkset(ts, cols, col_types, ingestion_time_ms,
+                                 scheme)
             self.column_store.write_chunks(
                 self.dataset, self.shard_num, info.part_key, [cs],
                 info.schema_name)
-            # the same encoded chunk stays resident in RAM: the dense tier
-            # may now drop these samples and re-page without touching disk
-            self.resident.add(info.part_id, cs)
-            if self.shard_downsampler is not None:
-                self.shard_downsampler.downsample(
-                    info.part_key, schema, ts, cols,
-                    bucket_les=store.bucket_les)
-            store.mark_sealed(info.row, hi)
+            if self.shard_downsampler is not None and len(ts):
+                # downsample only samples past the per-partition TIME
+                # watermark: a shift-skipped seal (phase 3) makes the next
+                # flush re-read the same range, and chunk rewrites are
+                # idempotent but downsample emission is NOT — without the
+                # watermark those samples would double-count downstream
+                wm = self._ds_time_wm.get(pid)
+                if wm is None or ts[-1] > wm:
+                    cut = int(np.searchsorted(ts, wm, side="right")) \
+                        if wm is not None else 0
+                    self.shard_downsampler.downsample(
+                        info.part_key, schema, ts[cut:],
+                        {k: v[cut:] for k, v in cols.items()},
+                        bucket_les=les)
+                    self._ds_time_wm[pid] = int(ts[-1])
+            encoded.append((pid, info, hi, cs))
             written += 1
-            dirty_pids.add(info.part_id)
-        # newly created partitions in this group get their part key persisted
-        # even before any data flush, so recover_index sees them after a crash
-        # (ref: TimeSeriesShard.writeDirtyPartKeys:1051)
-        for pid in self._dirty_part_keys:
-            info = self.partitions[pid]
-            if info is not None and info.group == group:
-                dirty_pids.add(pid)
-        self._dirty_part_keys -= dirty_pids
-        dirty = [PartKeyRecord(self.partitions[pid].part_key,
-                               self.partitions[pid].schema_name,
-                               self.index.start_time(pid),
-                               self.index.end_time(pid))
-                 for pid in sorted(dirty_pids)]
+        dirty_pids: set = set()
+        with self._write_locked("flush_seal"):
+            for pid, info, hi, cs in encoded:
+                store = self.stores[info.schema_name]
+                if store.shift_version != shift_snapshot[info.schema_name]:
+                    # rows shifted mid-flush: positions are stale — leave
+                    # the watermark; the next flush re-covers this data
+                    continue
+                store.mark_sealed(info.row, hi)
+                # the same encoded chunk stays resident in RAM: the dense
+                # tier may drop these samples and re-page without disk
+                self.resident.add(info.part_id, cs)
+                dirty_pids.add(info.part_id)
+            # newly created partitions in this group get their part key
+            # persisted even before any data flush, so recover_index sees
+            # them after a crash (ref: writeDirtyPartKeys:1051)
+            for pid in self._dirty_part_keys:
+                info = self.partitions[pid]
+                if info is not None and info.group == group:
+                    dirty_pids.add(pid)
+            self._dirty_part_keys -= dirty_pids
+            dirty = [PartKeyRecord(self.partitions[pid].part_key,
+                                   self.partitions[pid].schema_name,
+                                   self.index.start_time(pid),
+                                   self.index.end_time(pid))
+                     for pid in sorted(dirty_pids)]
         if dirty:
-            self.column_store.write_part_keys(self.dataset, self.shard_num, dirty)
+            self.column_store.write_part_keys(self.dataset, self.shard_num,
+                                              dirty)
         self.meta_store.write_checkpoint(
             self.dataset, self.shard_num, group, offset_snapshot)
         if self.cardinality_tracker is not None:
@@ -502,15 +554,27 @@ class TimeSeriesShard:
         even generation, read, verify unchanged; after `retries` torn reads
         fall back to excluding writers via write_lock.  The TPU-native
         replacement for the reference's reader Latch (SURVEY §7 seal/epoch
-        protocol; ref: memory/.../Latch.scala)."""
+        protocol; ref: memory/.../Latch.scala).
+
+        Cost-aware: when a single read attempt is EXPENSIVE (a big gather),
+        back-to-back ingest will tear it every time — burning retries x
+        the full copy cost before the lock fallback (the r4 soak's
+        under-ingest degradation).  After the second torn read of a
+        >50 ms fn, go straight to the lock."""
+        torn_slow = 0
         for _ in range(retries):
             g0 = store.generation
             if g0 % 2:                      # mutation in progress
                 time.sleep(0.0002)
                 continue
+            t0 = time.perf_counter()
             out = fn()
             if store.generation == g0:
                 return out
+            if time.perf_counter() - t0 > 0.05:
+                torn_slow += 1
+                if torn_slow >= 2:
+                    break
         with self._write_locked("query_snapshot_fallback"):
             return fn()
 

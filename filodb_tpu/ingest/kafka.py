@@ -41,10 +41,19 @@ class KafkaIngestionStream(IngestionStream):
             return self._consumer_factory(self.topic, self.shard, from_offset)
         try:
             from kafka import KafkaConsumer, TopicPartition  # type: ignore
-        except ImportError as e:
-            raise RuntimeError(
-                "kafka-python is not installed; pass consumer_factory= or "
-                "use another IngestionStream source") from e
+        except ImportError:
+            # no kafka-python: speak the Kafka binary protocol directly
+            # (ingest/kafka_wire.py — Fetch v4 / ListOffsets v1 against
+            # any >= 0.11 broker; exercised by the env-gated IT in
+            # tests/test_kafka_wire_it.py)
+            from filodb_tpu.ingest.kafka_wire import WireConsumer
+            consumer = WireConsumer(self.bootstrap_servers, self.topic,
+                                    self.shard)
+            if from_offset >= 0:
+                consumer.seek(None, from_offset + 1)
+            else:
+                consumer.seek_to_beginning()
+            return consumer
         consumer = KafkaConsumer(
             bootstrap_servers=self.bootstrap_servers,
             enable_auto_commit=False,   # offsets commit via flush watermarks

@@ -19,10 +19,10 @@ import functools
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 
-@functools.partial(jax.jit, static_argnames=())
-def histogram_quantile(q, buckets: jax.Array, les: jax.Array) -> jax.Array:
+def histogram_quantile(q, buckets, les):
     """q scalar, buckets [S, W, B] cumulative counts, les [B] -> [S, W].
 
     Prometheus semantics: rank = q * total; find first bucket with
@@ -30,7 +30,22 @@ def histogram_quantile(q, buckets: jax.Array, les: jax.Array) -> jax.Array:
     If the located bucket is +Inf -> return the last finite le; if it is the
     first bucket -> interpolate from 0 (or from le if le <= 0).
     q < 0 -> -Inf, q > 1 -> +Inf, empty histogram -> NaN.
+
+    Host-resident inputs of modest size run the numpy twin: aggregated
+    comps are [G, W, B] host arrays, and shipping them to the chip costs
+    a per-panel dispatch (~70 ms through the tunnel) for microseconds of
+    math — the round-4 quantile-dashboard batching measured only 1.37x
+    end-to-end because every panel re-paid exactly this (round-5 verdict
+    item 5).
     """
+    if isinstance(buckets, np.ndarray) and buckets.size <= 8_000_000 \
+            and not isinstance(q, jax.Array):
+        return _histogram_quantile_np(float(q), buckets, np.asarray(les))
+    return _histogram_quantile_jax(q, buckets, les)
+
+
+@functools.partial(jax.jit, static_argnames=())
+def _histogram_quantile_jax(q, buckets, les):
     B = buckets.shape[-1]
     # enforce monotone non-decreasing cumulative counts (mirrors the
     # ensureMonotonic fixup Prometheus applies for float jitter)
@@ -71,6 +86,52 @@ def histogram_quantile(q, buckets: jax.Array, les: jax.Array) -> jax.Array:
     out = jnp.where(jnp.isnan(rank), jnp.nan, out)
     out = jnp.where(q < 0, -jnp.inf, out)
     out = jnp.where(q > 1, jnp.inf, out)
+    return out
+
+
+def _histogram_quantile_np(q: float, buckets: np.ndarray,
+                           les: np.ndarray) -> np.ndarray:
+    """Numpy twin of histogram_quantile — identical semantics, no device
+    dispatch (kept in lockstep; parity-tested in tests/test_hist_scheme)."""
+    B = buckets.shape[-1]
+    cum = np.maximum.accumulate(buckets, axis=-1)
+    total = cum[..., -1]
+    rank = q * total
+    ge = cum >= rank[..., None]
+    idx = np.argmax(ge, axis=-1)
+    none_ge = ~np.any(ge, axis=-1)
+    idx = np.where(none_ge, B - 1, idx)
+
+    les_b = np.broadcast_to(les, buckets.shape)
+    count_at = np.take_along_axis(cum, idx[..., None], axis=-1)[..., 0]
+    le_at = np.take_along_axis(les_b, idx[..., None], axis=-1)[..., 0]
+    prev_idx = np.maximum(idx - 1, 0)
+    count_prev = np.where(
+        idx > 0,
+        np.take_along_axis(cum, prev_idx[..., None], axis=-1)[..., 0], 0.0)
+    le_prev = np.where(
+        idx > 0,
+        np.take_along_axis(les_b, prev_idx[..., None], axis=-1)[..., 0],
+        0.0)
+    le_prev = np.where((idx == 0) & (le_at <= 0), le_at, le_prev)
+
+    bucket_count = count_at - count_prev
+    with np.errstate(invalid="ignore", divide="ignore"):
+        frac = np.where(bucket_count > 0,
+                        (rank - count_prev) / bucket_count, 0.0)
+    interp = le_prev + (le_at - le_prev) * frac
+
+    has_inf_top = np.isinf(le_at)
+    finite_les = np.where(np.isinf(les), -np.inf, les)
+    max_finite = np.max(finite_les)
+    out = np.where(has_inf_top, max_finite, interp)
+
+    out = np.where(total > 0, out, np.nan)
+    out = np.where(np.isnan(rank), np.nan, out)
+    if q < 0:
+        out = np.full_like(out, -np.inf)
+    elif q > 1:
+        out = np.full_like(out, np.inf)
     return out
 
 

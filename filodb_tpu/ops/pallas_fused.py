@@ -916,6 +916,27 @@ def warmup_compile(S: int, T: int, W: int, G: int,
                                   fn_name, precorrected=True,
                                   interpret=interpret)
     sums.block_until_ready()
+    # also warm the general XLA path at this shape — the 20-40s-class
+    # compile (BENCH_r04) the persistent cache + warmup exist for; any
+    # non-fusable query over the same working-set shape hits it
+    try:
+        from filodb_tpu.ops import agg as agg_ops
+        from filodb_tpu.ops.rangefns import evaluate_range_function
+        from filodb_tpu.ops.timewindow import to_offsets
+
+        ts_one = to_offsets(ts_row[None, :], np.full(1, T), 0)
+
+        @jax.jit
+        def _general(ts_off, v, vb, g, w):
+            res = evaluate_range_function(ts_off, v, w, 300_000, fn_name,
+                                          shared_grid=True, vbase=vb,
+                                          precorrected=True)
+            return agg_ops.aggregate("sum", res, g, max(G, 1))
+
+        _general(jnp.asarray(ts_one), vals, vbase, jnp.asarray(gids),
+                 jnp.asarray(wends.astype(np.int32))).block_until_ready()
+    except Exception:  # noqa: BLE001 — fused warmup alone is still useful
+        pass
     return time.perf_counter() - t0
 
 

@@ -59,6 +59,11 @@ class RawBlock:
     # _group_ids is an O(S) Python loop that dominated warm general-path
     # queries (~0.3s of a 0.4s query at 65k series)
     cache_token: Optional[Tuple] = None
+    # cost-based router verdict (round-5 item 6): True when the leaf's
+    # estimated working set is below query.host_route_max_samples — the
+    # gather then stays host-side and _try_fused evaluates in numpy
+    # (ops/hostleaf) instead of paying the ~65 ms device dispatch floor
+    route_host: bool = False
 
 
 # Fused-leaf caches (see MultiSchemaPartitionsExec._try_fused): entries are

@@ -148,8 +148,16 @@ def _present(fc: FusedCall, comp) -> AggPartial:
     G, B = len(fc.gkeys), fc.num_buckets
     buckets = np.asarray(comp[..., 0], np.float64) \
         .reshape(G, B, -1).transpose(0, 2, 1)           # [G, W, B]
-    gsize = fc.groups.gsize.reshape(G, B)[:, 0]
-    cnt = gsize[:, None] * fc.plan.wvalid[None, :].astype(np.float64)
+    if fc.ragged:
+        # ragged bucket rows (round-5 item 5): per-(slot, window) counts
+        # come back from the kernel's presence output; scrape holes hit
+        # whole scrape rows, so every bucket of a series shares one
+        # validity pattern — bucket 0's count IS the series count
+        cnt = np.asarray(comp[..., 1], np.float64) \
+            .reshape(G, B, -1)[:, 0, :]                  # [G, W]
+    else:
+        gsize = fc.groups.gsize.reshape(G, B)[:, 0]
+        cnt = gsize[:, None] * fc.plan.wvalid[None, :].astype(np.float64)
     hist_comp = np.concatenate([buckets, cnt[..., None]], axis=2)
     return AggPartial("hist_sum", fc.gkeys, fc.wends, comp=hist_comp,
                       bucket_les=fc.bucket_les)

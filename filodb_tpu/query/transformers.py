@@ -171,8 +171,11 @@ class InstantVectorFunctionMapper(RangeVectorTransformer):
                 # promql/quantile.go bucketQuantile; the reference accepts
                 # both forms, prometheus/.../PrometheusModel.scala)
                 return self._classic_bucket_quantile(q, data)
+            # no jnp pre-conversion: host [G, W, B] comps take the
+            # numpy twin inside histogram_quantile (a device round trip
+            # here cost a ~70 ms dispatch per quantile panel)
             out = np.asarray(hist_ops.histogram_quantile(
-                q, jnp.asarray(vals), jnp.asarray(data.bucket_les)))
+                q, vals, np.asarray(data.bucket_les)))
             return ResultBlock(data.keys, data.wends, out,
                                cache_token=data.cache_token)
         if self.function == "histogram_bucket":
@@ -232,7 +235,7 @@ class InstantVectorFunctionMapper(RangeVectorTransformer):
         for les, members in by_ladder.items():
             stacked = np.stack([m.T for _, m in members])  # [G, W, B]
             out = np.asarray(hist_ops.histogram_quantile(
-                q, jnp.asarray(stacked), jnp.asarray(np.array(les))))
+                q, stacked, np.array(les)))
             for (gk, _), row in zip(members, out):
                 keys.append(RangeVectorKey(gk))
                 rows.append(row)

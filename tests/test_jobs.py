@@ -127,10 +127,14 @@ def test_kafka_stream_with_fake_consumer():
     assert fake.closed
 
 
-def test_kafka_without_lib_raises_clearly():
+def test_kafka_without_lib_uses_wire_consumer():
+    """Without kafka-python the real branch now speaks the Kafka binary
+    protocol itself (ingest/kafka_wire.py, round-5) — connecting to a
+    dead port surfaces a clean connection error, not a library error."""
     from filodb_tpu.ingest.kafka import KafkaIngestionStream
-    stream = KafkaIngestionStream("t", 0)
-    with pytest.raises(RuntimeError, match="kafka-python"):
+    stream = KafkaIngestionStream("t", 0,
+                                  bootstrap_servers="127.0.0.1:1")
+    with pytest.raises(OSError):
         list(stream.batches())
 
 

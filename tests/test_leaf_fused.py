@@ -330,3 +330,73 @@ def test_fused_count_agg_pure_host(fused_env, monkeypatch):
     for k in want:
         np.testing.assert_allclose(got[k], want[k], rtol=1e-9,
                                    equal_nan=True)
+
+
+@pytest.mark.parametrize("promql", [
+    'sum(rate(request_total{_ws_="demo"}[5m])) by (_ns_)',
+    'avg(increase(request_total{_ws_="demo"}[5m])) by (_ns_)',
+    'max(sum_over_time(request_total{_ws_="demo"}[5m])) by (_ns_)',
+    'min(min_over_time(request_total{_ws_="demo"}[5m])) by (_ns_)',
+    'sum(last_over_time(request_total{_ws_="demo"}[5m])) by (_ns_)',
+])
+def test_host_route_matches_device_path(fused_env, monkeypatch, promql):
+    """Round-5 verdict item 6: small working sets evaluate in host numpy
+    (ops/hostleaf) — same results as the kernel path, decision observable
+    via the leaf_host_routed counter and the explain route tag."""
+    batch = counter_batch(48, T, start_ms=START_MS, resets=True)
+    engine = _mk_engine([batch])
+    want = _query(engine, promql)              # kernel/interpret path
+    monkeypatch.setenv("FILODB_TPU_FORCE_HOST_ROUTE", "1")
+    before = registry.counter("leaf_host_routed").value
+    got = _query(engine, promql)
+    assert registry.counter("leaf_host_routed").value > before, \
+        "host route did not engage"
+    assert set(got) == set(want)
+    for k in want:
+        np.testing.assert_allclose(got[k], want[k], rtol=2e-5, atol=1e-4,
+                                   equal_nan=True)
+
+
+def test_host_route_respects_threshold(fused_env, monkeypatch):
+    """Working sets above query.host_route_max_samples stay on the
+    device path (no change at 262k+ is the verdict's requirement; here
+    the same property at test scale via a tiny threshold)."""
+    from filodb_tpu.config import settings
+    batch = counter_batch(48, T, start_ms=START_MS)
+    engine = _mk_engine([batch])
+    _query(engine)
+    monkeypatch.setenv("FILODB_TPU_FORCE_HOST_ROUTE", "1")
+    monkeypatch.setattr(settings().query, "host_route_max_samples", 10)
+    before = registry.counter("leaf_host_routed").value
+    _query(engine)
+    assert registry.counter("leaf_host_routed").value == before
+
+
+def test_fused_histogram_ragged_engages_and_matches(fused_env):
+    """Round-5 verdict item 5: NaN-holed (ragged) bucket rows ride the
+    fused kernel's valid-boundary machinery instead of falling to the
+    general path, with per-cell presence counts — results match the
+    general path including downstream histogram_quantile."""
+    from filodb_tpu.ingest.generator import histogram_batch
+
+    b = histogram_batch(12, T, start_ms=START_MS)
+    hcol = b.columns["h"].copy()
+    rng = np.random.default_rng(11)
+    holes = rng.random(hcol.shape[0]) < 0.12     # whole scrape rows
+    hcol[holes] = np.nan
+    ragged = RecordBatch(b.schema, b.part_keys, b.part_idx, b.timestamps,
+                         {**b.columns, "h": hcol}, b.bucket_les)
+    engine = _mk_engine([ragged])
+    q = ('histogram_quantile(0.9, '
+         'sum(rate(http_latency{_ws_="demo"}[5m])) by (_ns_))')
+    _query(engine, q)                    # warm mirror
+    before = _fused_count()
+    got = _query(engine, q)
+    assert _fused_count() > before, "ragged hist fused path did not engage"
+    import os
+    os.environ.pop("FILODB_TPU_FUSED_INTERPRET", None)
+    want = _query(engine, q)
+    assert set(got) == set(want) and got
+    for k in want:
+        np.testing.assert_allclose(got[k], want[k], rtol=5e-4, atol=1e-3,
+                                   equal_nan=True)
