@@ -382,6 +382,18 @@ def north_star_soak(minutes: float, series: int = 1_048_576,
         idle_lat.append(time.perf_counter() - t0)
     idle_p50 = float(np.median(idle_lat)) if idle_lat else float("nan")
 
+    # ---- ingest-only capacity: unpaced, no queries — the sustained
+    # rate the pipeline itself supports.  On this 1-core box the
+    # STEADY-STATE rate below divides the core with the query thread
+    # and the flush encoder (a scheduling identity, not a pipeline
+    # limit), so the capacity number is measured separately.
+    cap_t0 = time.time()
+    cap_n0 = state["ingested"]
+    while time.time() - cap_t0 < 45 and not errors:
+        ingest_once()
+    ingest_only_rate = (state["ingested"] - cap_n0) \
+        / max(time.time() - cap_t0, 1e-9)
+
     def querier():
         # rate over the freshest 10 minutes of the stream, group-summed —
         # the headline shape against live data (absent windows before the
@@ -456,6 +468,7 @@ def north_star_soak(minutes: float, series: int = 1_048_576,
         "samples_ingested": state["ingested"],
         "samples_per_sec_ingest": round(
             (state["ingested"] - ingested0) / ingest_wall_s, 1),
+        "ingest_only_samples_per_sec": round(ingest_only_rate, 1),
         "target_ingest_per_s": target_ingest_per_s,
         "dropped": int(sh.stats.rows_dropped),
         "flush_errors": sched.errors, "evictions": sh.stats.evictions,
