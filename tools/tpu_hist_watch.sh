@@ -1,0 +1,14 @@
+#!/bin/bash
+# Probe the tunnel every 4 minutes; when alive, run the hist dashboard
+# sections of tools/tpu_batch.py once and exit.
+cd /root/repo
+for i in $(seq 1 60); do
+  if timeout 70 python -c "import os; os.environ.pop('JAX_PLATFORMS',None); import jax; assert jax.devices()[0].platform != 'cpu'" 2>/dev/null; then
+    echo "tunnel alive at attempt $i; running hist sections"
+    JAX_COMPILATION_CACHE_DIR=/root/repo/.jax_cache timeout 2400 python tools/tpu_batch.py hist 2>&1 | grep -v WARNING | tail -5
+    exit 0
+  fi
+  sleep 240
+done
+echo "tunnel never returned"
+exit 1

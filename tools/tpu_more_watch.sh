@@ -1,0 +1,14 @@
+#!/bin/bash
+# After the hist watcher completes, capture the ragged chained-K slopes
+# (matmul + gather) in the same tunnel window.
+cd /root/repo
+for i in $(seq 1 60); do
+  if timeout 70 python -c "import os; os.environ.pop('JAX_PLATFORMS',None); import jax; assert jax.devices()[0].platform != 'cpu'" 2>/dev/null; then
+    echo "tunnel alive; ragged chains"
+    FILODB_CHAIN_RAGGED=1 timeout 1800 python tools/tpu_chain.py 2>&1 | grep -v WARNING | tail -2
+    FILODB_CHAIN_RAGGED=1 FILODB_CHAIN_GATHER=1 timeout 1800 python tools/tpu_chain.py 2>&1 | grep -v WARNING | tail -2
+    exit 0
+  fi
+  sleep 240
+done
+exit 1
