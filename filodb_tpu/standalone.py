@@ -201,7 +201,8 @@ class FiloServer:
                 for (s, t, w, g) in shapes:
                     try:
                         secs = pf.warmup_compile(s, t, w, g)
-                        registry.gauge("warmup_compile_seconds").set(secs)
+                        registry.gauge("warmup_compile_seconds") \
+                            .update(secs)
                     except Exception:  # noqa: BLE001 — warmup is advisory
                         registry.counter("warmup_compile_errors").increment()
 
