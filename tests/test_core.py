@@ -321,3 +321,76 @@ def test_evict_preserves_unsealed_low_volume_series():
     assert store.counts[cold] == 5
     vals = store.cols["value"][cold, :5]
     np.testing.assert_array_equal(vals, np.arange(5, dtype=float))
+
+
+def test_windowed_gather_bounds_after_evict_and_prepend():
+    """Round-5 windowed gather: the per-position timestamp bounds must
+    stay CONSERVATIVE (never exclude in-window data) across the two
+    position-rearranging mutations — eviction left-shifts and ODP
+    prepend right-shifts."""
+    import numpy as np
+    from filodb_tpu.core.blockstore import DenseSeriesStore
+    from filodb_tpu.core.schemas import DEFAULT_SCHEMAS
+
+    schema = DEFAULT_SCHEMAS["gauge"]
+    st = DenseSeriesStore(schema, initial_series=4, initial_time=16,
+                          max_time_cap=64)
+    rows = np.array([st.new_row() for _ in range(3)])
+
+    def append(t0, n):
+        ts = np.repeat(np.arange(t0, t0 + n) * 1000, 1)
+        for r in rows:
+            st.append_batch(np.full(n, r), np.arange(t0, t0 + n) * 1000,
+                            {"value": np.arange(t0, t0 + n, dtype=float)})
+
+    append(10, 40)                         # ts 10_000..49_000
+
+    def gathered_ts(t_lo, t_hi):
+        ts, cols, counts = st.gather_rows(rows, t_lo, t_hi)
+        out = []
+        for i in range(len(rows)):
+            row = ts[i][:counts[i]]
+            out.append(row[(row >= t_lo) & (row <= t_hi)])
+        return out
+
+    # full in-window coverage before any shift
+    want = np.arange(20, 30) * 1000
+    for row in gathered_ts(20_000, 29_000):
+        np.testing.assert_array_equal(row, want)
+
+    # eviction shifts rows left; bounds must be recomputed
+    st.mark_sealed(int(rows[0]), 30)
+    st.mark_sealed(int(rows[1]), 30)
+    st.mark_sealed(int(rows[2]), 30)
+    st.evict_oldest(12)
+    for row in gathered_ts(30_000, 45_000):
+        np.testing.assert_array_equal(row, np.arange(30, 46) * 1000)
+
+    # ODP prepend shifts one row right; its bounds updates are row-wise
+    pre_ts = np.arange(2, 10) * 1000       # data older than the oldest
+    st.prepend_row(int(rows[0]), pre_ts,
+                   {"value": pre_ts.astype(float)})
+    got = gathered_ts(2_000, 9_000)
+    np.testing.assert_array_equal(got[0], pre_ts)
+    # windows covering everything still return everything
+    for i, row in enumerate(gathered_ts(22_000, 49_000)):
+        np.testing.assert_array_equal(row, np.arange(22, 50) * 1000)
+
+
+def test_windowed_gather_counts_relative():
+    """gather_rows with bounds returns slice-relative counts and a
+    non-empty matrix even for windows entirely outside the data."""
+    import numpy as np
+    from filodb_tpu.core.blockstore import DenseSeriesStore
+    from filodb_tpu.core.schemas import DEFAULT_SCHEMAS
+
+    st = DenseSeriesStore(DEFAULT_SCHEMAS["gauge"],
+                          initial_series=2, initial_time=8)
+    r = st.new_row()
+    st.append_batch(np.zeros(6, np.int64), np.arange(6) * 1000,
+                    {"value": np.arange(6, dtype=float)})
+    ts, cols, counts = st.gather_rows(np.array([r]), 2_000, 4_000)
+    assert ts.shape[1] >= 1 and counts[0] >= 3
+    # fully out-of-range window: 1 pad-masked column, zero count is fine
+    ts2, _, counts2 = st.gather_rows(np.array([r]), 99_000, 100_000)
+    assert ts2.shape[1] >= 1
