@@ -437,7 +437,7 @@ def measure_fused_coverage():
         return QueryEngine("prometheus", ms, mapper)
 
     counters = ("leaf_fused_kernel", "leaf_fused_count_host",
-                "leaf_fused_minmax")
+                "leaf_fused_minmax", "leaf_host_routed")
 
     def fused_total():
         return sum(registry.counter(c).value for c in counters)
