@@ -184,3 +184,58 @@ def test_bench_dispatch_smoke():
         bench_dispatch(quick=True)
     line = json.loads(buf.getvalue().strip().splitlines()[-1])
     assert line["bench"] == "dispatch" and line["value"] > 0
+
+
+def test_partial_results_keep_join_child_positions():
+    """allow_partial_results must not SHIFT surviving children into the
+    wrong side of a positional split: BinaryJoinExec splits gathered
+    results at n_lhs, so a dropped lhs child needs a placeholder, never
+    compaction (silently joining an rhs block as an lhs operand)."""
+    import numpy as np
+
+    from filodb_tpu.query.execbase import (ExecPlan, QueryError)
+    from filodb_tpu.query.nonleaf import BinaryJoinExec
+    from filodb_tpu.query.rangevector import (PlannerParams, QueryContext,
+                                              QueryStats, RangeVectorKey,
+                                              ResultBlock)
+
+    wends = np.array([1000, 2000], np.int64)
+
+    class _Static(ExecPlan):
+        def __init__(self, ctx, label, value):
+            super().__init__(ctx)
+            self._block = ResultBlock(
+                [RangeVectorKey((("inst", label),))], wends,
+                np.full((1, 2), value))
+
+        def _do_execute(self, source):
+            return self._block, QueryStats()
+
+    class _Dead(ExecPlan):
+        def _do_execute(self, source):
+            raise QueryError("shard_unavailable", "owner SIGKILLed")
+
+    ctx = QueryContext(
+        planner_params=PlannerParams(allow_partial_results=True))
+    dead = _Dead(ctx)
+    lhs_ok = _Static(ctx, "a", 10.0)
+    rhs_a = _Static(ctx, "a", 1.0)
+    rhs_b = _Static(ctx, "b", 2.0)
+    join = BinaryJoinExec(ctx, [dead, lhs_ok], [rhs_a, rhs_b], "+")
+    res = join.execute(None)
+    assert res.error is None
+    assert res.partial is True
+    series = {k.labels_dict["inst"]: v for k, _, v in res.series()}
+    # the surviving lhs child (inst=a, 10.0) joins rhs inst=a (1.0);
+    # without the placeholder, rhs_a would have been consumed as an LHS
+    # operand and the sums would be wrong/misassigned
+    assert set(series) == {"a"}
+    np.testing.assert_allclose(series["a"], [11.0, 11.0])
+
+    # without the opt-in the same death fails the query with the code
+    ctx2 = QueryContext(planner_params=PlannerParams())
+    join2 = BinaryJoinExec(ctx2, [_Dead(ctx2), _Static(ctx2, "a", 10.0)],
+                           [_Static(ctx2, "a", 1.0)], "+")
+    res2 = join2.execute(None)
+    assert res2.error is not None
+    assert res2.error.startswith("shard_unavailable")
