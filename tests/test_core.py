@@ -394,3 +394,58 @@ def test_windowed_gather_counts_relative():
     # fully out-of-range window: 1 pad-masked column, zero count is fine
     ts2, _, counts2 = st.gather_rows(np.array([r]), 99_000, 100_000)
     assert ts2.shape[1] >= 1
+
+
+def test_window_positions_bounds_invariant_fuzz():
+    """Property fuzz: after ANY interleaving of appends, evictions, and
+    prepends, window_positions(lo, hi) must cover every live cell with
+    lo <= ts <= hi in every row (bounds may be wider, never narrower)."""
+    import numpy as np
+    from filodb_tpu.core.blockstore import DenseSeriesStore
+    from filodb_tpu.core.schemas import DEFAULT_SCHEMAS
+
+    rng = np.random.default_rng(42)
+    st = DenseSeriesStore(DEFAULT_SCHEMAS["gauge"], initial_series=4,
+                          initial_time=8, max_time_cap=96)
+    rows = np.array([st.new_row() for _ in range(3)])
+    next_ts = {int(r): 100 + 10 * int(r) for r in rows}
+    oldest = {int(r): next_ts[int(r)] for r in rows}
+
+    def check():
+        for lo, hi in [(0, 10**9), (500, 900), (1, 400), (700, 701)]:
+            p_lo, p_hi = st.window_positions(lo, hi)
+            for r in rows:
+                c = int(st.counts[r])
+                ts_r = st.ts[r, :c]
+                inside = np.flatnonzero((ts_r >= lo) & (ts_r <= hi))
+                if inside.size:
+                    assert p_lo <= inside.min() and inside.max() < p_hi, (
+                        lo, hi, p_lo, p_hi, inside.min(), inside.max())
+
+    for step in range(120):
+        op = rng.integers(0, 10)
+        if op < 6:                                   # append burst
+            n = int(rng.integers(1, 4))
+            for r in rows:
+                t0 = next_ts[int(r)]
+                ts = np.arange(t0, t0 + n) * 1  # ms-scale ints
+                st.append_batch(np.full(n, r), ts,
+                                {"value": ts.astype(float)})
+                next_ts[int(r)] = t0 + n
+        elif op < 8:                                 # seal + evict
+            for r in rows:
+                st.mark_sealed(int(r), int(st.counts[r]) // 2)
+            st.evict_oldest(int(rng.integers(1, 5)))
+            for r in rows:
+                c = int(st.counts[r])
+                if c:
+                    oldest[int(r)] = int(st.ts[r, 0])
+        else:                                        # ODP prepend one row
+            r = int(rows[rng.integers(0, len(rows))])
+            c = int(st.counts[r])
+            first = int(st.ts[r, 0]) if c else next_ts[r]
+            m = int(rng.integers(1, 3))
+            pre = np.arange(first - m, first)
+            if pre[0] > 0:
+                st.prepend_row(r, pre, {"value": pre.astype(float)})
+        check()
