@@ -342,28 +342,18 @@ def test_concurrent_ingest_batch_query_matches_quiesced(monkeypatch):
                                        equal_nan=True, err_msg=q)
 
 
-def test_three_phase_flush_loses_nothing_under_concurrent_ingest():
+def test_three_phase_flush_loses_nothing_under_concurrent_ingest(tmp_path):
     """Round-5 flush holds the write lock only for copy/seal phases;
     encode+persist runs with ingest live.  Torture: concurrent ingest +
-    tight flush loop + queries for a few seconds, then assert (a) zero
-    errors, (b) every ingested sample is queryable, (c) sealed
-    watermarks never exceed counts, (d) chunks on disk cover the sealed
-    range after a final flush."""
-    import tempfile
-    import threading
-    import time
-
-    import numpy as np
-
-    from filodb_tpu.core.memstore import TimeSeriesMemStore
+    tight flush loop for a few seconds, then assert (a) zero errors and
+    no wedged threads, (b) tail integrity per row, (c) sealed
+    watermarks never exceed counts, (d) a quiescent flush seals all."""
     from filodb_tpu.core.records import RecordBatch
-    from filodb_tpu.ingest.generator import counter_batch
     from filodb_tpu.persist.localstore import (LocalDiskColumnStore,
                                                LocalDiskMetaStore)
 
-    tmp = tempfile.mkdtemp(prefix="flush_torture_")
-    ms = TimeSeriesMemStore(column_store=LocalDiskColumnStore(tmp),
-                            meta_store=LocalDiskMetaStore(tmp))
+    ms = TimeSeriesMemStore(column_store=LocalDiskColumnStore(str(tmp_path)),
+                            meta_store=LocalDiskMetaStore(str(tmp_path)))
     sh = ms.setup("prometheus", 0)
     START = 1_600_000_000_000
     S = 64
@@ -406,6 +396,9 @@ def test_three_phase_flush_loses_nothing_under_concurrent_ingest():
     stop.set()
     for th in threads:
         th.join(timeout=30)
+        # a wedged thread IS the failure this torture test exists for
+        # (e.g. a write_lock deadlock in the three-phase flush)
+        assert not th.is_alive(), "ingest/flush thread wedged"
     assert not errors, errors
     assert sh.stats.rows_dropped == 0
 
