@@ -54,10 +54,11 @@ if _BS < _MIN_BS or (_BS & (_BS - 1)):
 
 _PRECISION = os.environ.get("FILODB_FUSED_PRECISION", "highest")
 """MXU precision strategy for the kernel's matmuls — see _matmuls()."""
-if _PRECISION not in ("highest", "split"):
+if _PRECISION not in ("highest", "split", "episplit"):
     raise ValueError(
-        f"FILODB_FUSED_PRECISION={_PRECISION!r}: expected 'highest' or "
-        f"'split' (a typo here would silently mislabel a tuning sweep)")
+        f"FILODB_FUSED_PRECISION={_PRECISION!r}: expected 'highest', "
+        f"'split' or 'episplit' (a typo here would silently mislabel a "
+        f"tuning sweep)")
 
 _GATHER = os.environ.get("FILODB_FUSED_GATHER", "1") != "0"
 """Boundary selection strategy for the rate family + last_over_time: the
@@ -122,20 +123,32 @@ def _matmuls():
     kernel at production shapes is dispatch/bandwidth-bound, not
     MXU-pass-bound, so "highest" stays the default; the knob remains
     for re-sweeping on hardware without the per-call tunnel floor.
+    "episplit" (round 5) applies the decomposition ONLY to the group
+    epilogue (mmg) and keeps the over_time band matmuls (mmv) at
+    HIGHEST: with gather selections the default for the rate family,
+    mmg is that kernel's only large matmul, and the r4 dense regression
+    under full "split" was the since-removed selection matmuls'
+    schedule, not the epilogue's.  mmb (binary x binary presence
+    counts) is single-pass in every mode: 0/1 operands are exact in
+    bf16 and the MXU accumulates in f32, so DEFAULT is mathematically
+    exact there — emulation passes on it buy nothing.
+
     (Mosaic lowers only DEFAULT and HIGHEST; Precision.HIGH and
     per-operand precision tuples are rejected.)"""
+    def mmg_split(a, b):
+        hi, mid, lo = _split3(b)
+        return _dot_1p(a, hi) + _dot_1p(a, mid) + _dot_1p(a, lo)
+
+    if _PRECISION == "episplit":
+        return _dot_hi, mmg_split, _dot_1p
     if _PRECISION != "split":
-        return _dot_hi, _dot_hi, _dot_hi
+        return _dot_hi, _dot_hi, _dot_1p
 
     def mmv(a, b):
         hi, mid, lo = _split3(a)
         return _dot_1p(hi, b) + _dot_1p(mid, b) + _dot_1p(lo, b)
 
-    def mmg(a, b):
-        hi, mid, lo = _split3(b)
-        return _dot_1p(a, hi) + _dot_1p(a, mid) + _dot_1p(a, lo)
-
-    return mmv, mmg, _dot_1p
+    return mmv, mmg_split, _dot_1p
 
 
 def _pad_to(x: int, m: int) -> int:

@@ -29,8 +29,8 @@ from filodb_tpu.query.rangevector import (QueryContext, QueryResult, QueryStats,
                                           concat_blocks, remove_nan_series)
 
 from filodb_tpu.query.execbase import (
-    AggPartial, GroupCardinalityError, LeafExecPlan, QueryResultLike,
-    RawBlock, ScalarResult,
+    AggPartial, GroupCardinalityError, LazyKeys, LeafExecPlan,
+    QueryResultLike, RawBlock, ScalarResult,
     _FUSED_CACHE_LOCK, _FUSED_MINMAX_PAD_CACHE, _FUSED_PLAN_CACHE,
     _FUSED_VALS_CACHE, _block_empty, _group_cache_insert,
     _group_cache_lookup, _lru_touch, _note_mirror_limit,
@@ -676,7 +676,7 @@ class MultiSchemaPartitionsExec(LeafExecPlan):
             # NaN anywhere (staleness markers or ragged-length padding)
             # routes the rate family onto its valid-boundary variant
             dense = not bool(np.isnan(vals).any())
-        keys = shard.keys_for(pids)
+        keys = LazyKeys(shard, pids)
         stats.series_scanned = int(pids.size)
         stats.samples_scanned = int(counts.sum())
         les = store.bucket_les if vals.ndim == 3 else None

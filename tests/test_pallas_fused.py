@@ -605,13 +605,15 @@ def test_fused_ragged_rate_long_rows():
                                equal_nan=True)
 
 
-def test_split_precision_matches_highest_interpret(monkeypatch):
-    """The FILODB_FUSED_PRECISION=split decomposition (ops/pallas_fused.
-    _matmuls) must produce the same results as the all-HIGHEST default —
-    in interpret mode, so a future edit that breaks the mmv/mmg operand-
-    order convention (or _split3 itself) fails here instead of only as
-    wrong numbers in the next on-chip sweep.  jit caches don't key on the
-    module-level knob, so they are cleared around each flip."""
+@pytest.mark.parametrize("mode", ["split", "episplit"])
+def test_split_precision_matches_highest_interpret(monkeypatch, mode):
+    """The FILODB_FUSED_PRECISION=split/episplit decompositions
+    (ops/pallas_fused._matmuls) must produce the same results as the
+    all-HIGHEST default — in interpret mode, so a future edit that
+    breaks the mmv/mmg operand-order convention (or _split3 itself)
+    fails here instead of only as wrong numbers in the next on-chip
+    sweep.  jit caches don't key on the module-level knob, so they are
+    cleared around each flip."""
     import jax
     from filodb_tpu.ops import pallas_fused as pf
     ts_row, raw, gids = _mk(S=48, T=96, G=4)
@@ -635,7 +637,7 @@ def test_split_precision_matches_highest_interpret(monkeypatch):
         return out
 
     base = run_all()
-    monkeypatch.setattr(pf, "_PRECISION", "split")
+    monkeypatch.setattr(pf, "_PRECISION", mode)
     jax.clear_caches()
     try:
         split = run_all()

@@ -122,7 +122,11 @@ def _hist_sections(doc):
     from filodb_tpu.core.memstore import TimeSeriesMemStore
     from filodb_tpu.ingest.generator import histogram_batch
     from filodb_tpu.query.engine import QueryEngine
-    Sh, Th = 131_072, 360
+    # 131k OOM'd the tunnel chip's HBM mid-r5 (mirror [S,T,B] + padded
+    # kernel copy + general-path warm buffers); 65k is the biggest shape
+    # that fit, and the env knob lets a roomier window retry larger
+    Sh = int(os.environ.get("FILODB_HIST_S", "65536"))
+    Th = 360
     start_ms = 1_600_000_000_000
     ms = TimeSeriesMemStore()
     ms.setup("prometheus", 0).ingest(

@@ -172,6 +172,15 @@ def main():
     persist()
     suffix = "_gather" if os.environ.get("FILODB_CHAIN_GATHER") == "1" \
         else ""
+    prec = os.environ.get("FILODB_FUSED_PRECISION")
+    if prec in ("split", "episplit"):
+        # epilogue-precision A/B: with gather selections the one-hot
+        # group epilogue is the kernel's only large matmul, so "split"/
+        # "episplit" (3 single-pass dots) vs "highest" (6-pass emulation)
+        # isolates its cost — the r4 sweep that measured split slower
+        # predates gather and was dominated by the since-removed
+        # selection matmuls
+        suffix += "_" + prec
     shapes = [("chain_262k" + suffix, 262_144),
               ("chain_1m" + suffix, 1_048_576)]
     if os.environ.get("FILODB_CHAIN_RAGGED") == "1":

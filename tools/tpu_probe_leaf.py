@@ -1,0 +1,136 @@
+#!/usr/bin/env python
+"""Decompose the leaf-path per-query wall time at the bench shapes.
+
+TPU_CHAIN_r05.json measured k1 ~80 ms at 1M (kernel dispatch + scalar
+fetch) while the bench/engine path p50s ~95 ms — this tool attributes
+the ~15 ms gap by timing four variants of the same query on device-
+resident inputs:
+
+  kernel_scalar   — _run dispatch, fetch a [1,1] slice (chain k1 twin)
+  kernel_fetch    — _run dispatch, fetch the FULL padded [Gp, Wp] f32
+  bench_path      — fused_rate_groupsum + present_sum exactly as
+                    bench.run_pallas_fused does (lazy host slice, f64
+                    cast, counts numpy, np.where)
+  masked_finish   — one extra jit that slices [:G, :W] and NaN-masks on
+                    DEVICE, then ONE f32 fetch + f64 cast host-side
+                    (the proposed leaf finisher)
+
+Writes TPU_PROBE_r05.json; refuses non-TPU backends.
+"""
+import functools
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
+                      os.path.join(REPO, ".jax_cache"))
+OUT = os.path.join(REPO, "TPU_PROBE_r05.json")
+sys.path.insert(0, os.path.join(REPO, "tools"))
+from tpu_chain import build, p50  # noqa: E402
+
+DOC = {"utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())}
+
+
+def persist():
+    tmp = OUT + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(DOC, f, indent=1)
+    os.replace(tmp, OUT)
+
+
+def probe_shape(jax, jnp, name, S):
+    from filodb_tpu.ops import pallas_fused as pf
+    sec = {"series": S, "groups": 1000}
+    DOC[name] = sec
+    t0 = time.perf_counter()
+    plan, prep, span, W = build(S)
+    sec["windows"] = W
+    sec["samples_scanned_per_query"] = span
+    sec["host_prep_s"] = round(time.perf_counter() - t0, 2)
+    persist()
+    G, Gp = 1000, pf.pad_group_count(1000)
+    gather = pf.gather_default("rate_family") and plan.idx1 is not None
+    mats = pf._kernel_mats(plan, over_time=False, gather=gather)
+
+    def run_raw():
+        return pf._run(prep.vals_p, prep.vbase_p, prep.gids_p, *mats,
+                       num_groups=Gp, is_counter=True, is_rate=True,
+                       with_drops=False, interpret=False,
+                       kind="rate_family", ragged=False,
+                       per_series=False, gather=gather)
+
+    # counts are snapshot-static: device mask once, like the leaf should
+    wvalid_dev = jax.device_put(np.asarray(plan.wvalid, bool))
+    gsize_dev = jax.device_put(
+        (np.asarray(prep.gsize) > 0).astype(np.float32))
+
+    @functools.partial(jax.jit, static_argnums=(3, 4))
+    def finish_masked(res, wv, gs, g, w):
+        s = res[:g, :w]
+        mask = wv[None, :w] & (gs[:g, None] > 0)
+        return jnp.where(mask, s, jnp.nan)
+
+    def q_kernel_scalar():
+        np.asarray(run_raw()[:1, :1])
+
+    def q_kernel_fetch():
+        np.asarray(run_raw())
+
+    def q_bench_path():
+        sums, counts = pf.fused_rate_groupsum(
+            None, None, None, plan, G, "rate", True, prepared=prep)
+        return pf.present_sum(sums, counts)
+
+    def q_masked():
+        out = finish_masked(run_raw(), wvalid_dev, gsize_dev, G, W)
+        return np.asarray(out).astype(np.float64)
+
+    # conformance first (also warms every compile)
+    want = q_bench_path()
+    got = q_masked()
+    m = np.isfinite(want)
+    assert (np.isnan(want) == np.isnan(got)).all()
+    err = float(np.max(np.abs(want[m] - got[m])
+                       / np.maximum(np.abs(want[m]), 1e-6))) if m.any() \
+        else 0.0
+    sec["masked_vs_bench_max_rel_err"] = err
+    for nm, fn in (("kernel_scalar", q_kernel_scalar),
+                   ("kernel_fetch", q_kernel_fetch),
+                   ("bench_path", q_bench_path),
+                   ("masked_finish", q_masked)):
+        fn()
+        sec[f"{nm}_p50_s"] = round(p50(fn), 5)
+        persist()
+    sec["fetch_cost_ms"] = round(
+        (sec["kernel_fetch_p50_s"] - sec["kernel_scalar_p50_s"]) * 1e3, 2)
+    sec["bench_overhead_ms"] = round(
+        (sec["bench_path_p50_s"] - sec["kernel_scalar_p50_s"]) * 1e3, 2)
+    sec["masked_overhead_ms"] = round(
+        (sec["masked_finish_p50_s"] - sec["kernel_scalar_p50_s"]) * 1e3, 2)
+    persist()
+
+
+def main():
+    os.environ.pop("JAX_PLATFORMS", None)
+    import jax
+    import jax.numpy as jnp
+    plat = jax.devices()[0].platform
+    if plat == "cpu":
+        print("refusing: cpu backend")
+        sys.exit(2)
+    DOC["platform"] = "tpu"
+    DOC["device"] = str(jax.devices()[0])
+    for name, S in (("probe_262k", 262_144), ("probe_1m", 1_048_576)):
+        probe_shape(jax, jnp, name, S)
+    DOC["done"] = True
+    persist()
+    print(json.dumps({k: v for k, v in DOC.items() if k != "utc"})[:400])
+
+
+if __name__ == "__main__":
+    main()
