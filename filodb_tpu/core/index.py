@@ -82,6 +82,12 @@ class PartKeyIndex:
         self._alive: np.ndarray = np.zeros(0, dtype=bool)
         self._part_keys: List[Optional[PartKey]] = []
         self.num_docs = 0
+        # bumps on any mutation that can change a lookup's result (add,
+        # end-time update, removal) — the invalidation token for
+        # TimeSeriesShard.lookup_partitions' small result cache, so a
+        # dashboard's identical-filter panels don't re-run the postings
+        # intersection per panel
+        self.mutations = 0
 
     # ---- write path ----
 
@@ -103,6 +109,7 @@ class PartKeyIndex:
         for k, v in part_key.tags:
             self._index_label(k, v, part_id)
         self.num_docs += 1
+        self.mutations += 1
 
     def _index_label(self, key: str, value: str, part_id: int) -> None:
         self._postings.setdefault(key, {}).setdefault(value, []).append(part_id)
@@ -111,6 +118,7 @@ class PartKeyIndex:
     def update_end_time(self, part_id: int, end_time_ms: int) -> None:
         """ref: PartKeyLuceneIndex.updatePartKeyWithEndTime (series stopped)."""
         self._end[part_id] = end_time_ms
+        self.mutations += 1
 
     def start_time(self, part_id: int) -> int:
         return int(self._start[part_id])
@@ -234,3 +242,4 @@ class PartKeyIndex:
         self._part_keys[part_id] = None
         self._alive[part_id] = False
         self.num_docs -= 1
+        self.mutations += 1

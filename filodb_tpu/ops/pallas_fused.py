@@ -52,7 +52,7 @@ if _BS < _MIN_BS or (_BS & (_BS - 1)):
         f"assume it, and a block below _MIN_BS would silently drop "
         f"trailing series rows in interpret mode")
 
-_PRECISION = os.environ.get("FILODB_FUSED_PRECISION", "highest")
+_PRECISION = os.environ.get("FILODB_FUSED_PRECISION", "episplit")
 """MXU precision strategy for the kernel's matmuls — see _matmuls()."""
 if _PRECISION not in ("highest", "split", "episplit"):
     raise ValueError(
@@ -116,19 +116,19 @@ def _matmuls():
     binary, binary x values (group epilogue), binary x binary.
 
     Measured on a real v5e (TPU_TUNE_r04.json, tools/tpu_tune.py): at
-    262k x 720 the split is NOT faster — dense p50 regressed ~20% (three
-    separate single-pass dots + the VPU decomposition schedule worse
-    than Mosaic's fused multi-pass emulation) and ragged gained only
-    ~6%, while results stayed bit-identical (max_rel_err 0.0).  The
-    kernel at production shapes is dispatch/bandwidth-bound, not
-    MXU-pass-bound, so "highest" stays the default; the knob remains
-    for re-sweeping on hardware without the per-call tunnel floor.
-    "episplit" (round 5) applies the decomposition ONLY to the group
-    epilogue (mmg) and keeps the over_time band matmuls (mmv) at
-    HIGHEST: with gather selections the default for the rate family,
-    mmg is that kernel's only large matmul, and the r4 dense regression
-    under full "split" was the since-removed selection matmuls'
-    schedule, not the epilogue's.  mmb (binary x binary presence
+    262k x 720 full "split" is NOT faster — dense p50 regressed ~20%
+    (three separate single-pass dots + the VPU decomposition schedule
+    worse than Mosaic's fused multi-pass emulation) and ragged gained
+    only ~6%, while results stayed bit-identical (max_rel_err 0.0).
+    That regression was the since-removed selection matmuls' schedule,
+    not the epilogue's: "episplit" (round 5, the DEFAULT) applies the
+    decomposition ONLY to the group epilogue (mmg) and keeps the
+    over_time band matmuls (mmv) at HIGHEST — with gather selections
+    the default for the rate family, mmg is that kernel's only large
+    matmul.  Measured (TPU_CHAIN_r05.json *_episplit vs *_gather):
+    epilogue attribution 1.84 -> 1.18 ms at 262k, 7.40 -> 4.52 ms at
+    1M; total device time at the 1M north star 15.95 -> 13.15 ms
+    (55.0B samples/s device rate).  mmb (binary x binary presence
     counts) is single-pass in every mode: 0/1 operands are exact in
     bf16 and the MXU accumulates in f32, so DEFAULT is mathematically
     exact there — emulation passes on it buy nothing.

@@ -543,10 +543,15 @@ class MultiSchemaPartitionsExec(LeafExecPlan):
                     f"narrow the filters or time range")
 
         _check_scan_cap("resident")
-        shard.ensure_paged_pids(schema_name, pids,
-                                self.chunk_start_ms, self.chunk_end_ms,
-                                max_samples=limit if enforced else None)
-        _check_scan_cap("after demand paging")
+        paged = shard.ensure_paged_pids(
+            schema_name, pids, self.chunk_start_ms, self.chunk_end_ms,
+            max_samples=limit if enforced else None)
+        if paged:
+            # ODP grew some series' extents, so the resident estimate is
+            # stale; when nothing paged the second O(S) estimate would
+            # be identical to the first — skip it (dashboard panels pay
+            # this twice per panel otherwise)
+            _check_scan_cap("after demand paging")
         schema = shard.schemas[schema_name]
         col_name = (self.columns[0] if self.columns
                     else schema.value_column)

@@ -449,3 +449,30 @@ def test_window_positions_bounds_invariant_fuzz():
             if pre[0] > 0:
                 st.prepend_row(r, pre, {"value": pre.astype(float)})
         check()
+
+
+def test_lookup_partitions_cache_invalidation():
+    """The lookup_partitions memo (round 5: dashboards repeat one selector
+    per panel) must return cached results for repeat lookups, see NEW
+    series the moment the index mutates, and drop evicted ones."""
+    from filodb_tpu.ingest.generator import gauge_batch
+    ms = TimeSeriesMemStore()
+    shard = ms.setup("prometheus", 0)
+    shard.ingest(gauge_batch(20, 50), offset=1)
+    filt = [Equals("_ns_", "App-0")]
+    r1 = shard.lookup_partitions(filt, 0, MAX_TIME)
+    r2 = shard.lookup_partitions(filt, 0, MAX_TIME)
+    assert r2 is r1                       # memo hit: same object
+    # equal-but-distinct filter objects hit too (frozen dataclass hash)
+    r3 = shard.lookup_partitions([Equals("_ns_", "App-0")], 0, MAX_TIME)
+    assert r3 is r1
+    # different range misses
+    r4 = shard.lookup_partitions(filt, 0, 10)
+    assert r4 is not r1
+    # ingesting a NEW matching series invalidates: the next lookup sees it
+    before = r1.part_ids.size
+    extra = gauge_batch(40, 10, start_ms=1_600_000_000_000 + 50 * 10_000)
+    shard.ingest(extra, offset=2)
+    r5 = shard.lookup_partitions(filt, 0, MAX_TIME)
+    assert r5 is not r1
+    assert r5.part_ids.size > before

@@ -636,10 +636,12 @@ def test_split_precision_matches_highest_interpret(monkeypatch, mode):
             out.append(present_sum(sums, counts))
         return out
 
-    base = run_all()
-    monkeypatch.setattr(pf, "_PRECISION", mode)
+    monkeypatch.setattr(pf, "_PRECISION", "highest")
     jax.clear_caches()
     try:
+        base = run_all()
+        monkeypatch.setattr(pf, "_PRECISION", mode)
+        jax.clear_caches()
         split = run_all()
     finally:
         monkeypatch.undo()
