@@ -427,3 +427,52 @@ def test_three_phase_flush_loses_nothing_under_concurrent_ingest(tmp_path):
     for store in sh.stores.values():
         n = store.num_series
         assert (store.sealed[:n] == store.counts[:n]).all()
+
+
+def test_lookup_cache_concurrent_hits_and_invalidation():
+    """The round-5 lookup_partitions memo is lock-free (GIL-atomic
+    pop/reinsert): query threads hammering ONE selector while ingest
+    creates new matching series must never error, and every lookup
+    that STARTS after an ingest completes must see the post-ingest
+    series count (memo keys include index.mutations)."""
+    from filodb_tpu.core.index import Equals
+    ms = TimeSeriesMemStore(column_store=InMemoryColumnStore(),
+                            meta_store=InMemoryMetaStore())
+    shard = ms.setup("prometheus", 0)
+    shard.ingest(counter_batch(64, 30, start_ms=START), offset=1)
+    filt = [Equals("_ws_", "demo")]
+    stop = threading.Event()
+    errors = []
+    seen = [[] for _ in range(4)]    # per-thread observation sequences
+
+    def reader(i):
+        try:
+            while not stop.is_set():
+                r = shard.lookup_partitions(filt, 0, 1 << 62)
+                seen[i].append(int(r.part_ids.size))
+        except Exception as e:  # noqa: BLE001 — must surface
+            errors.append(e)
+
+    threads = [threading.Thread(target=reader, args=(i,))
+               for i in range(4)]
+    for t in threads:
+        t.start()
+    for k in range(1, 6):
+        shard.ingest(counter_batch(64 + 32 * k, 30, start_ms=START),
+                     offset=1 + k)
+        # a lookup started strictly after ingest returned (mutations
+        # bumped) must see everything that ingest added
+        r = shard.lookup_partitions(filt, 0, 1 << 62)
+        assert int(r.part_ids.size) == 64 + 32 * k
+        time.sleep(0.01)
+    stop.set()
+    for t in threads:
+        t.join(10)
+        assert not t.is_alive()
+    assert not errors, errors
+    # per-thread monotonicity: index.mutations only grows, so a thread's
+    # later lookups can never serve an OLDER memo generation than its
+    # earlier ones — observed series counts are nondecreasing
+    for obs in seen:
+        assert all(a <= b for a, b in zip(obs, obs[1:])), obs[:20]
+    assert any(seen), "readers never ran"

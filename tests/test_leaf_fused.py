@@ -400,3 +400,52 @@ def test_fused_histogram_ragged_engages_and_matches(fused_env):
     for k in want:
         np.testing.assert_allclose(got[k], want[k], rtol=5e-4, atol=1e-3,
                                    equal_nan=True)
+
+
+def test_lazykeys_defers_materialization_on_fused_path():
+    """RawBlock.keys must stay unmaterialized for warm fused aggregate
+    queries (group ids come from the snapshot cache) and materialize
+    exactly once for consumers that read per-series keys."""
+    from filodb_tpu.core.memstore import TimeSeriesMemStore
+    from filodb_tpu.query.engine import QueryEngine
+    from filodb_tpu.query.execbase import LazyKeys
+
+    START = 1_600_000_000_000
+    ms = TimeSeriesMemStore()
+    shard = ms.setup("prometheus", 0)
+    shard.ingest(counter_batch(96, 60, start_ms=START), offset=1)
+    eng = QueryEngine("prometheus", ms)
+    s0 = START // 1000
+    q = 'sum by (_ns_)(rate(request_total{_ws_="demo"}[5m]))'
+
+    mats = []
+    orig = LazyKeys._mat
+
+    def counting_mat(self):
+        mats.append(1)
+        return orig(self)
+
+    LazyKeys._mat = counting_mat
+    try:
+        r1 = eng.query_range(q, s0 + 600, 60, s0 + 600 + 1200)
+        assert r1.error is None, r1.error
+        warm_mats_before = len(mats)
+        r2 = eng.query_range(q, s0 + 600, 60, s0 + 600 + 1200)
+        assert r2.error is None
+        # the WARM aggregate query must not materialize per-series keys
+        assert len(mats) == warm_mats_before, \
+            "warm fused query materialized per-series keys"
+        # a raw selector needs them: exactly one materialization per block
+        rr = eng.query_range('rate(request_total{_ns_="App-1"}[5m])',
+                             s0 + 600, 60, s0 + 600 + 1200)
+        assert rr.error is None
+        assert len(list(rr.series())) > 0
+        assert len(mats) > warm_mats_before
+    finally:
+        LazyKeys._mat = orig
+
+    # sequence contract: len/bool are O(1)-safe pre-materialization
+    lk = LazyKeys(shard, np.asarray([0, 1, 2]))
+    assert len(lk) == 3 and bool(lk)
+    assert lk._keys is None                     # len/bool didn't materialize
+    assert lk[0] is not None and lk._keys is not None
