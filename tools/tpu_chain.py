@@ -15,6 +15,10 @@ Modes per shape:
   per_series  — same kernel, epilogue matmul ablated (raw [S, W] out);
                 group-minus-per_series ~ epilogue cost (+ the bigger
                 output write, reported alongside)
+  segsum      — FILODB_CHAIN_SEGSUM=1: per-series kernel output
+                finished by XLA segment_sum in the same jit — the
+                complete-query scatter alternative to the in-kernel
+                one-hot epilogue (measured SLOWER; doc/kernels.md)
 
 Shapes mirror bench.py's ladder stages (dense counters, precorrected,
 shared grid, G=1000, rate[5m] @ 1m steps over 2 h of 10 s samples).
@@ -80,17 +84,24 @@ def build(S, T=720, G=1000, range_ms=300_000, step_ms=60_000,
     return plan, prep, span, len(wends)
 
 
-def chain_fn(jax, jnp, plan, prep, G, K, per_series, ragged=False):
+def chain_fn(jax, jnp, plan, prep, G, K, per_series, ragged=False,
+             segsum=False):
     """K dependent fused calls in one jit; the carry perturbs vbase by a
     denormal-scale epsilon so XLA cannot CSE the iterations, while values
     stay the same HBM-resident array each pass (the steady-state query
-    re-reads them from HBM exactly like this)."""
+    re-reads them from HBM exactly like this).  segsum=True measures the
+    alternative COMPLETE-query epilogue: per-series kernel output
+    finished by an XLA segment-sum scatter (instead of the in-kernel
+    one-hot matmul) in the same jit."""
     from jax import lax
     from filodb_tpu.ops import pallas_fused as pf
 
     Gp = pf.pad_group_count(G)
     gather = os.environ.get("FILODB_CHAIN_GATHER", "0") == "1"
     mats = pf._kernel_mats(plan, over_time=False, gather=gather)
+    if segsum:
+        # pad rows carry gid -1: route them to an overflow segment Gp
+        seg_ids = jnp.where(prep.gids_p[:, 0] >= 0, prep.gids_p[:, 0], Gp)
 
     @jax.jit
     def run(vals_p, vbase_p, gids_p):
@@ -100,9 +111,12 @@ def chain_fn(jax, jnp, plan, prep, G, K, per_series, ragged=False):
                 gather=gather,
                 num_groups=Gp, is_counter=True, is_rate=True,
                 with_drops=False, interpret=False, kind="rate_family",
-                ragged=ragged, per_series=per_series)
+                ragged=ragged, per_series=per_series or segsum)
             if ragged:
                 res = res[0]
+            if segsum:
+                res = jax.ops.segment_sum(res, seg_ids,
+                                          num_segments=Gp + 1)
             return acc + res[0, 0] * 1e-30
         return lax.fori_loop(0, K, body, jnp.float32(0.0))
 
@@ -123,11 +137,14 @@ def section_shape(jax, jnp, name, S, hole_frac=0.0):
     persist()
 
     KS = (1, 4, 16)
-    for mode, per_series in (("group", False), ("per_series", True)):
+    modes = [("group", False, False), ("per_series", True, False)]
+    if os.environ.get("FILODB_CHAIN_SEGSUM") == "1":
+        modes.append(("segsum", False, True))
+    for mode, per_series, segsum in modes:
         times = {}
         for K in KS:
             fn = chain_fn(jax, jnp, plan, prep, 1000, K, per_series,
-                          ragged=hole_frac > 0)
+                          ragged=hole_frac > 0, segsum=segsum)
             t0 = time.perf_counter()
             fn()
             times[f"k{K}_compile_s"] = round(time.perf_counter() - t0, 2)
