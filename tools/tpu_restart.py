@@ -147,8 +147,8 @@ def main():
     # two restart attempts: on the experimental tunneled backend the
     # FIRST fresh process after the cold writer has been observed to
     # fingerprint-miss the general program (recompile ~6 s) while the
-    # next process hits it in ~0.3 s — judge the steady-state restart
-    # (best attempt) and keep both recorded
+    # next process hits it in ~0.3 s — both are recorded, restart2 is
+    # judged (see below)
     doc["restart"] = run_child("restart", shape)
     doc["restart2"] = run_child("restart2", shape)
     c = doc["cold"]
