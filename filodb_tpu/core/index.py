@@ -77,6 +77,10 @@ class PartKeyIndex:
         # numpy lazily on query, invalidated on append)
         self._postings: Dict[str, Dict[str, List[int]]] = {}
         self._frozen: Dict[Tuple[str, str], np.ndarray] = {}
+        # label -> sorted ids having a NON-EMPTY value for it (the
+        # complement basis for the absent-label "" convention); built
+        # lazily, invalidated like _frozen on append/remove
+        self._having: Dict[str, np.ndarray] = {}
         self._start: np.ndarray = np.zeros(0, dtype=np.int64)
         self._end: np.ndarray = np.zeros(0, dtype=np.int64)
         self._alive: np.ndarray = np.zeros(0, dtype=bool)
@@ -114,6 +118,7 @@ class PartKeyIndex:
     def _index_label(self, key: str, value: str, part_id: int) -> None:
         self._postings.setdefault(key, {}).setdefault(value, []).append(part_id)
         self._frozen.pop((key, value), None)
+        self._having.pop(key, None)
 
     def update_end_time(self, part_id: int, end_time_ms: int) -> None:
         """ref: PartKeyLuceneIndex.updatePartKeyWithEndTime (series stopped)."""
@@ -142,34 +147,61 @@ class PartKeyIndex:
     def _all_ids(self) -> np.ndarray:
         return np.nonzero(self._alive)[0].astype(np.int64)
 
+    def _union(self, parts) -> np.ndarray:
+        parts = list(parts)
+        return (np.unique(np.concatenate(parts)) if parts
+                else np.zeros(0, dtype=np.int64))
+
+    def _absent_or_empty(self, key: str) -> np.ndarray:
+        """Series where label `key` is missing or "" — PromQL treats the
+        two identically (an absent label HAS the value ""), so
+        `{l=""}` / regexes that match "" must select these (ref:
+        prometheus model.LabelSet semantics; KeyFilter equality on
+        missing keys).  The per-label having-union is memoized
+        (`_having`) so repeat dashboards don't re-concatenate every
+        posting list of a high-cardinality label per query; alive-ness
+        is re-applied per call since eviction doesn't touch postings
+        caches' shape."""
+        having = self._having.get(key)
+        if having is None:
+            having = self._union(self._ids_for(key, v)
+                                 for v in self._postings.get(key, {}) if v)
+            self._having[key] = having
+        return np.setdiff1d(self._all_ids(), having, assume_unique=False)
+
     def _match_filter(self, f: ColumnFilter) -> np.ndarray:
         key = "__name__" if f.column in ("__name__", "_metric_") else f.column
         values = self._postings.get(key, {})
         if isinstance(f, Equals):
-            return self._ids_for(key, f.value)
+            return self._absent_or_empty(key) if f.value == "" \
+                else self._ids_for(key, f.value)
         if isinstance(f, In):
-            parts = [self._ids_for(key, v) for v in f.values]
-            return (np.unique(np.concatenate(parts)) if parts
-                    else np.zeros(0, dtype=np.int64))
+            parts = [self._ids_for(key, v) for v in f.values if v]
+            if "" in f.values:
+                parts.append(self._absent_or_empty(key))
+            return self._union(parts)
         if isinstance(f, Prefix):
-            parts = [self._ids_for(key, v) for v in values if v.startswith(f.prefix)]
-            return (np.unique(np.concatenate(parts)) if parts
-                    else np.zeros(0, dtype=np.int64))
+            # FiloDB extension over indexed values only (no "" convention:
+            # upstream PromQL has no prefix matcher)
+            return self._union(self._ids_for(key, v) for v in values
+                               if v.startswith(f.prefix))
         if isinstance(f, EqualsRegex):
-            parts = [self._ids_for(key, v) for v in values if _full_match(f.pattern, v)]
-            return (np.unique(np.concatenate(parts)) if parts
-                    else np.zeros(0, dtype=np.int64))
+            parts = [self._ids_for(key, v) for v in values
+                     if v and _full_match(f.pattern, v)]
+            if _full_match(f.pattern, ""):
+                parts.append(self._absent_or_empty(key))
+            return self._union(parts)
         if isinstance(f, (NotEquals, NotIn, NotEqualsRegex)):
-            universe = self._all_ids()
+            # complement of the matching positive filter, so absent-label
+            # ("") semantics stay consistent between the two polarities
             if isinstance(f, NotEquals):
-                excl = self._ids_for(key, f.value)
+                pos = Equals(f.column, f.value)
             elif isinstance(f, NotIn):
-                ex = [self._ids_for(key, v) for v in f.values]
-                excl = np.concatenate(ex) if ex else np.zeros(0, dtype=np.int64)
+                pos = In(f.column, f.values)
             else:
-                ex = [self._ids_for(key, v) for v in values if _full_match(f.pattern, v)]
-                excl = np.concatenate(ex) if ex else np.zeros(0, dtype=np.int64)
-            return np.setdiff1d(universe, excl, assume_unique=False)
+                pos = EqualsRegex(f.column, f.pattern)
+            return np.setdiff1d(self._all_ids(), self._match_filter(pos),
+                                assume_unique=False)
         raise TypeError(f"unsupported filter {f!r}")
 
     def part_ids_from_filters(self, filters: Sequence[ColumnFilter],
@@ -239,6 +271,7 @@ class PartKeyIndex:
             if lst and part_id in lst:
                 lst.remove(part_id)
                 self._frozen.pop((k, v), None)
+                self._having.pop(k, None)
         self._part_keys[part_id] = None
         self._alive[part_id] = False
         self.num_docs -= 1

@@ -476,3 +476,29 @@ def test_lookup_partitions_cache_invalidation():
     r5 = shard.lookup_partitions(filt, 0, MAX_TIME)
     assert r5 is not r1
     assert r5.part_ids.size > before
+
+
+def test_index_absent_label_empty_string_convention():
+    """PromQL: a series without label L has L="" for matching (round-5
+    fix) — Equals/In/EqualsRegex and their negations must treat absent
+    and empty-matching consistently at the INDEX level."""
+    from filodb_tpu.core.index import NotEqualsRegex, NotIn
+    idx = PartKeyIndex()
+    idx.add_partition(0, PartKey.make("m", {"job": "api", "env": "prod"}), 0)
+    idx.add_partition(1, PartKey.make("m", {"job": "app"}), 0)
+    T = 1 << 62
+
+    def ids(f):
+        return sorted(idx.part_ids_from_filters([f], 0, T).tolist())
+
+    assert ids(Equals("env", "")) == [1]
+    assert ids(NotEquals("env", "")) == [0]
+    assert ids(Equals("env", "prod")) == [0]
+    assert ids(NotEquals("env", "prod")) == [1]
+    assert ids(In("env", ("prod", ""))) == [0, 1]
+    assert ids(NotIn("env", ("prod", ""))) == []
+    assert ids(EqualsRegex("env", "prod|")) == [0, 1]
+    assert ids(EqualsRegex("env", ".+")) == [0]
+    assert ids(NotEqualsRegex("env", ".+")) == [1]
+    assert ids(NotEqualsRegex("env", "prod|")) == []
+    assert ids(EqualsRegex("env", "")) == [1]
