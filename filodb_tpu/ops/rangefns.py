@@ -488,6 +488,15 @@ def mad_over_time(ctx: WindowCtx) -> jax.Array:
 def holt_winters(ctx: WindowCtx, sf: float, tf: float) -> jax.Array:
     """Double exponential smoothing (ref: AggrOverTimeFunctions.scala holt-winters).
     Sequential per window -> scan over time inside a window tile."""
+    # upstream rejects out-of-range factors instead of smoothing with a
+    # divergent recurrence (prometheus functions.go funcHoltWinters:
+    # sf must be in (0, 1) exclusive, tf in (0, 1] — tf == 1 is legal)
+    if not 0 < sf < 1:
+        raise ValueError(
+            f"invalid smoothing factor {sf}: expected 0 < sf < 1")
+    if not 0 < tf <= 1:
+        raise ValueError(
+            f"invalid trend factor {tf}: expected 0 < tf <= 1")
     def reducer(v, m):
         # v: [S, wt, T] broadcastable, m: [S, wt, T].  Prometheus recurrence:
         # s1 := x0; b := x1 - x0; then for i >= 1:

@@ -523,7 +523,8 @@ class MiscellaneousFunctionMapper(RangeVectorTransformer):
                     else:
                         lbls.pop(dst, None)
                 keys.append(RangeVectorKey.make(lbls))
-            return ResultBlock(keys, data.wends, data.values, data.bucket_les)
+            keys, vals = _merge_relabeled(keys, data, "label_replace")
+            return ResultBlock(keys, data.wends, vals, data.bucket_les)
         if self.function == "label_join":
             dst, sep, *srcs = self.string_args
             keys = []
@@ -535,8 +536,45 @@ class MiscellaneousFunctionMapper(RangeVectorTransformer):
                 else:
                     lbls.pop(dst, None)
                 keys.append(RangeVectorKey.make(lbls))
-            return ResultBlock(keys, data.wends, data.values, data.bucket_les)
+            keys, vals = _merge_relabeled(keys, data, "label_join")
+            return ResultBlock(keys, data.wends, vals, data.bucket_les)
         raise ValueError(f"unknown misc function {self.function}")
+
+
+def _merge_relabeled(keys, data, fn_name: str):
+    """Upstream semantics for relabeling that lands several series on
+    one labelset: it is an ERROR only when the duplicates co-occur in
+    the same evaluation step ("vector cannot contain metrics with the
+    same labelset"); series whose samples never overlap (e.g. the two
+    halves of a restart, absent-as-NaN here) MERGE into one series
+    (ref: prometheus functions.go label_replace + per-step Series
+    dedup).  Returns (keys, values) with disjoint duplicates merged."""
+    groups: dict = {}
+    for i, k in enumerate(keys):
+        groups.setdefault(k.labels, []).append(i)
+    if all(len(rows) == 1 for rows in groups.values()):
+        return keys, data.values
+    vals = np.asarray(data.values)
+    out_keys, out_rows = [], []
+    for sig, rows in groups.items():
+        if len(rows) == 1:
+            out_keys.append(keys[rows[0]])
+            out_rows.append(vals[rows[0]])
+            continue
+        sub = vals[rows]                      # [d, W] or [d, W, B]
+        finite = np.isfinite(sub)
+        present = finite.any(axis=-1) if sub.ndim == 3 else finite
+        if (present.sum(axis=0) > 1).any():
+            raise ValueError(
+                f"{fn_name}: vector cannot contain metrics with the "
+                f"same labelset")
+        merged = np.full(sub.shape[1:], np.nan, vals.dtype)
+        for d in range(sub.shape[0]):
+            m = present[d]
+            merged[m] = sub[d][m]
+        out_keys.append(keys[rows[0]])
+        out_rows.append(merged)
+    return out_keys, np.stack(out_rows)
 
 
 def _dollar_to_backslash(repl: str) -> str:

@@ -343,3 +343,68 @@ def test_at_modifier_pins_evaluation_time(engine):
     assert set(a) == set(b) and a
     for k in a:
         np.testing.assert_array_equal(a[k], b[k])
+
+
+def test_label_replace_collision_is_clean_error(engine):
+    """Upstream rejects relabeling that collapses distinct series onto
+    one labelset; the error must surface cleanly, not as an ambiguous
+    result vector (round-5 conformance fix)."""
+    res = engine.query_range(
+        'label_replace(heap_usage{_ws_="demo"}, "instance", "same", '
+        '"instance", "(.*)")', START_S + 600, 60, START_S + 1200)
+    assert res.error is not None
+    assert "same labelset" in str(res.error)
+    # a non-colliding replace still works
+    ok = engine.query_range(
+        'label_replace(heap_usage{_ws_="demo",_ns_="App-1"}, "dst", '
+        '"v$1", "_ns_", "App-(.*)")', START_S + 600, 60, START_S + 1200)
+    assert ok.error is None
+    assert all(k.labels_dict.get("dst") == "v1"
+               for k, _, _ in ok.series())
+
+
+def test_holt_winters_rejects_out_of_range_factors(engine):
+    """Upstream errors on smoothing/trend factors outside (0, 1)
+    (round-5 conformance fix)."""
+    for q in ('holt_winters(heap_usage{_ns_="App-1"}[20m], 1.5, 0.5)',
+              'holt_winters(heap_usage{_ns_="App-1"}[20m], 0.5, 0)'):
+        res = engine.query_range(q, START_S + 1200, 60, START_S + 1800)
+        assert res.error is not None, q
+        assert "factor" in str(res.error)
+    ok = engine.query_range(
+        'holt_winters(heap_usage{_ns_="App-1"}[20m], 0.5, 0.5)',
+        START_S + 1200, 60, START_S + 1800)
+    assert ok.error is None
+
+
+def test_label_replace_merges_disjoint_series():
+    """Series whose samples never co-occur (restart halves) may be
+    relabeled onto one labelset: upstream merges them per step instead
+    of erroring — the error is reserved for true per-step collisions."""
+    from filodb_tpu.core.partkey import PartKey
+    from filodb_tpu.core.records import RecordBatchBuilder
+    from filodb_tpu.core.schemas import DEFAULT_SCHEMAS
+    b = RecordBatchBuilder(DEFAULT_SCHEMAS["gauge"])
+    half = 60
+    for j in range(half):
+        b.add(PartKey.make("up", {"_ws_": "demo", "_ns_": "a",
+                                  "pod": "old"}),
+              START_MS + j * 10_000, value=1.0)
+    # the second half starts 400 s after the first ends — beyond the
+    # 5 m lookback, so no step sees both pods (upstream merges, no error)
+    gap_ms = 400_000
+    for j in range(half, 2 * half):
+        b.add(PartKey.make("up", {"_ws_": "demo", "_ns_": "a",
+                                  "pod": "new"}),
+              START_MS + gap_ms + j * 10_000, value=2.0)
+    eng = _mk_engine([b.build()])
+    q = 'label_replace(up{_ws_="demo"}, "pod", "x", "pod", "(.*)")'
+    res = eng.query_range(q, START_S + 60, 60,
+                          START_S + 400 + 2 * half * 10 - 10)
+    assert res.error is None, res.error
+    series = list(res.series())
+    assert len(series) == 1                     # merged onto one labelset
+    _, _, v = series[0]
+    arr = np.asarray(v, np.float64)
+    finite = arr[np.isfinite(arr)]
+    assert set(np.unique(finite)) == {1.0, 2.0}  # both halves survive
