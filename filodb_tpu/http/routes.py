@@ -316,6 +316,14 @@ class PromHttpApi:
         if isinstance(merged, list) and \
                 all(isinstance(x, str) for x in merged):
             merged = sorted(merged)
+        if kind == "series" and isinstance(merged, list):
+            # wire compatibility: Prometheus clients key the metric name
+            # as __name__ in /api/v1/series items (the internal exec
+            # keeps FiloDB's _metric_; query results map identically via
+            # engine._prom_labels)
+            from filodb_tpu.query.engine import _prom_labels
+            merged = [_prom_labels(x) if isinstance(x, dict) else x
+                      for x in merged]
         return 200, {"status": "success", "data": merged or []}
 
     # ------------------------------------------------------------- cluster

@@ -81,7 +81,9 @@ def test_series_endpoint(server):
                           "start": START_S, "end": START_S + 7200})
     assert st == 200
     assert len(payload["data"]) == 10
-    assert all(s["_metric_"] == "heap_usage" for s in payload["data"])
+    # wire compat (round 5): Prometheus clients expect __name__ here
+    assert all(s["__name__"] == "heap_usage" and "_metric_" not in s
+               for s in payload["data"])
 
 
 def test_explain_plan(server):
