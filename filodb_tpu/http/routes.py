@@ -19,7 +19,9 @@ import logging
 import struct
 from typing import Callable, Dict, List, Optional, Tuple
 
-from filodb_tpu.promql.lexer import ParseError
+import re
+
+from filodb_tpu.promql.lexer import ParseError, duration_to_ms
 from filodb_tpu.query.engine import QueryEngine
 from filodb_tpu.query.rangevector import PlannerParams
 
@@ -109,7 +111,7 @@ class PromHttpApi:
             q = params.get("query", "")
             start = _num_param(params, "start")
             end = _num_param(params, "end")
-            step = max(_num_param(params, "step", "15"), 1)
+            step = _step_param(params.get("step", "15"))
             if params.get("explain") in ("true", "1"):
                 return self._explain(eng, q, start, step, end)
             res = self.coalescers[dataset].query_range(
@@ -127,12 +129,12 @@ class PromHttpApi:
             try:
                 req = _json.loads(body.decode() or "{}")
                 queries = list(req["queries"])
-                # same int(float(...)) grid coercion as GET query_range
-                # (_num_param): a float-typed start/step must not build a
-                # different time grid on the batch path
+                # same grid coercion as GET query_range (_num_param /
+                # _step_param): a float- or duration-typed start/step
+                # must not build a different time grid on the batch path
                 start = int(float(req["start"]))
                 end = int(float(req["end"]))
-                step = max(int(float(req.get("step", 15))), 1)
+                step = _step_param(req.get("step", 15))
             except (KeyError, TypeError, ValueError, OverflowError) as e:
                 raise _BadRequest(f"bad batch request: {e}") from None
             results = eng.query_range_batch(queries, start, step, end,
@@ -477,6 +479,28 @@ def _num_param(params: Dict[str, str], key: str,
         return int(float(raw))
     except (ValueError, OverflowError):
         raise _BadRequest(f"parameter {key!r} is not a number: {raw!r}")
+
+
+_DURATION_RE = re.compile(r"(?:\d+(?:\.\d+)?(?:ms|s|m|h|d|w|y))+")
+
+
+def _step_param(raw) -> int:
+    """Prometheus `step` accepts a float (seconds) OR a duration string
+    ("15s", "1m", "1h30m") — Grafana sends numbers, the API spec and
+    curl users send durations.  -> whole seconds, floored at 1."""
+    try:
+        return max(int(float(raw)), 1)
+    except (ValueError, OverflowError, TypeError):
+        pass
+    s = str(raw)
+    if not _DURATION_RE.fullmatch(s):
+        raise _BadRequest(
+            f"parameter 'step' is not a number or duration: {raw!r}")
+    try:
+        return max(duration_to_ms(s) // 1000, 1)
+    except (OverflowError, ValueError):
+        raise _BadRequest(f"parameter 'step' is out of range: {raw!r}") \
+            from None
 
 
 def _planner_params(params: Dict[str, str]) -> Optional[PlannerParams]:
