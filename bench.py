@@ -363,6 +363,51 @@ def measure_stage(S, T, iters, platform, do_fused, persist,
     return stage, ts_row, vals, gids, wends, range_ms, span_hi - span_lo
 
 
+def measure_ingest(series=262_144, max_seconds=10.0, max_t=256):
+    """Host-path ingest throughput: columnar grid appends into one live
+    shard (partition creation warmed out of the timed window, no flush, no
+    queries) — the `ingest_samples_per_sec` stage of the one-line bench
+    contract, so the trajectory tracks the host half of the pipeline and
+    not just the device scan path.  Bounded two ways: wall clock and
+    samples-per-series (memory)."""
+    import numpy as np
+
+    from filodb_tpu.core.memstore import TimeSeriesMemStore
+    from filodb_tpu.ingest.generator import counter_batch
+
+    START = 1_600_000_000_000
+    ms = TimeSeriesMemStore()
+    sh = ms.setup("bench_ingest", 0)
+    t0 = time.perf_counter()
+    base = counter_batch(series, 1, start_ms=START)
+    build_s = time.perf_counter() - t0
+    k = 2
+    row_base = np.arange(series, dtype=np.float64)[:, None]
+
+    def ingest_once(t_idx):
+        ts_row = START + (t_idx + np.arange(k, dtype=np.int64)) * 10_000
+        ts2d = np.broadcast_to(ts_row, (series, k))
+        vals = (t_idx + np.arange(k, dtype=np.float64))[None, :] * 5.0 \
+            + row_base
+        return sh.ingest_columns("prom-counter", base.part_keys, ts2d,
+                                 {"count": vals}, offset=t_idx)
+
+    ingest_once(0)                       # warm: creates all partitions
+    t_idx = k
+    n0 = sh.stats.rows_ingested
+    t0 = time.perf_counter()
+    while (time.perf_counter() - t0 < max_seconds) and t_idx < max_t:
+        ingest_once(t_idx)
+        t_idx += k
+    dt = time.perf_counter() - t0
+    n = sh.stats.rows_ingested - n0
+    return {"series": series, "samples": int(n),
+            "elapsed_s": round(dt, 2),
+            "partkey_build_s": round(build_s, 2),
+            "dropped": int(sh.stats.rows_dropped),
+            "ingest_samples_per_sec": round(n / max(dt, 1e-9), 1)}
+
+
 COVERAGE_QUERIES = [
     # (name, promql, ragged_ok) — a realistic dashboard mix, expanded from
     # the reference's QueryInMemoryBenchmark set (QUERY_SET in bench/suite).
@@ -622,6 +667,12 @@ def assemble_result(platform, stages, vec_sps, it_sps, c_sps=0.0,
             result["iterator_c_samples_per_sec"] = round(c_sps, 1)
             result["vs_iterator_c"] = \
                 round(best["samples_per_sec"] / c_sps, 1)
+    ing = stages.get("ingest", {})
+    if "ingest_samples_per_sec" in ing:
+        # the host half of the pipeline, in the parsed line from round 1
+        # (this PR's ISSUE: the driver must track ingest, not just scan)
+        result["ingest_samples_per_sec"] = ing["ingest_samples_per_sec"]
+        result["ingest_series"] = ing["series"]
     cov = stages.get("fused_coverage", {})
     for k in ("fused_coverage_dense", "fused_coverage_ragged"):
         if k in cov:
@@ -727,6 +778,14 @@ def run_worker(args):
             "vectorized_numpy_samples_per_sec": round(vec_sps, 1),
             "iterator_numpy_samples_per_sec": round(it_sps, 1),
             "iterator_c_samples_per_sec": round(c_sps, 1)})
+
+    try:
+        ing = measure_ingest(series=65_536 if quick else 262_144,
+                             max_seconds=5.0 if quick else 10.0)
+        writer.stage("ingest", ing)
+        stages["ingest"] = ing
+    except Exception as e:  # noqa: BLE001 — ingest stage must not sink the run
+        writer.stage("ingest", {"error": f"{type(e).__name__}: {e}"[:300]})
 
     try:
         cov = measure_fused_coverage()

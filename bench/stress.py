@@ -38,7 +38,6 @@ def ingestion_stress(minutes: float, series: int = 5_000) -> bool:
     import numpy as np
     from filodb_tpu.core.flush import FlushScheduler
     from filodb_tpu.core.memstore import TimeSeriesMemStore
-    from filodb_tpu.core.records import RecordBatch
     from filodb_tpu.ingest.generator import counter_batch
     from filodb_tpu.persist.localstore import (LocalDiskColumnStore,
                                                LocalDiskMetaStore)
@@ -64,16 +63,17 @@ def ingestion_stress(minutes: float, series: int = 5_000) -> bool:
     base = counter_batch(series, 1, start_ms=START)
     try:
         while time.time() < deadline:
-            # 20 new samples per series per iteration, strictly in-order
+            # 20 new samples per series per iteration, strictly in-order,
+            # through the columnar grid path (shard.ingest_columns) — the
+            # scrape-cycle shape needs no flatten/re-sort round trip
             n = 20
-            ts = np.tile(START + (t_idx + np.arange(n, dtype=np.int64))
-                         * 10_000, series)
-            idx = np.repeat(np.arange(series, dtype=np.int32), n)
+            ts2d = np.broadcast_to(
+                START + (t_idx + np.arange(n, dtype=np.int64)) * 10_000,
+                (series, n))
             vals = (t_idx + np.arange(n, dtype=np.float64))[None, :] \
                 * 5.0 + np.arange(series)[:, None]
-            batch = RecordBatch(base.schema, base.part_keys, idx, ts,
-                                {"count": vals.ravel()})
-            total += sh.ingest(batch, offset=t_idx)
+            total += sh.ingest_columns("prom-counter", base.part_keys,
+                                       ts2d, {"count": vals}, offset=t_idx)
             t_idx += n
             if sh.stats.evictions > last_evictions:
                 last_evictions = sh.stats.evictions
@@ -127,13 +127,13 @@ def _setup_live_ingest(series: int):
         t_idx = warm
         while not stop.is_set():
             n = 10
-            its = np.tile(START + (t_idx + np.arange(n, dtype=np.int64))
-                          * 10_000, series)
-            iidx = np.repeat(np.arange(series, dtype=np.int32), n)
+            its = np.broadcast_to(
+                START + (t_idx + np.arange(n, dtype=np.int64)) * 10_000,
+                (series, n))
             ivals = (t_idx + np.arange(n, dtype=np.float64))[None, :] \
                 * 5.0 + np.arange(series)[:, None]
-            sh.ingest(RecordBatch(base.schema, base.part_keys, iidx, its,
-                                  {"count": ivals.ravel()}))
+            sh.ingest_columns("prom-counter", base.part_keys, its,
+                              {"count": ivals})
             t_idx += n
             ingested[0] += n * series
             time.sleep(0.01)
@@ -309,13 +309,21 @@ def north_star_soak(minutes: float, series: int = 1_048_576,
 
     from filodb_tpu.core.flush import FlushScheduler
     from filodb_tpu.core.memstore import TimeSeriesMemStore
-    from filodb_tpu.core.records import RecordBatch
     from filodb_tpu.ingest.generator import counter_batch
     from filodb_tpu.persist.localstore import (LocalDiskColumnStore,
                                                LocalDiskMetaStore)
     from filodb_tpu.query.engine import QueryEngine
     from filodb_tpu.query.rangevector import PlannerParams
 
+    import sys
+
+    def _phase(msg: str) -> None:
+        # progress to STDERR: the stdout one-JSON-line contract stays
+        # intact, and a wedged soak shows WHERE it wedged
+        print(f"[soak +{time.time() - _soak_t0:.0f}s] {msg}",
+              file=sys.stderr, flush=True)
+
+    _soak_t0 = time.time()
     START = 1_600_000_000_000
     tmp = tempfile.mkdtemp(prefix="filodb_soak_")
     ms = TimeSeriesMemStore(column_store=LocalDiskColumnStore(tmp),
@@ -347,17 +355,19 @@ def north_star_soak(minutes: float, series: int = 1_048_576,
     last_evictions = 0
     s = START // 1000
     step_ms = 10_000
-    idx = np.repeat(np.arange(series, dtype=np.int32), 2)
 
     def ingest_once():
+        # columnar grid ingest: the scrape-cycle shape goes straight to
+        # the SoA store as rectangular slice writes (shard.ingest_columns)
         t_idx = state["t_idx"]
-        ts = np.tile(START + (t_idx + np.arange(2, dtype=np.int64))
-                     * step_ms, series)
+        ts2d = np.broadcast_to(
+            START + (t_idx + np.arange(2, dtype=np.int64)) * step_ms,
+            (series, 2))
         vals = ((t_idx + np.arange(2, dtype=np.float64))[None, :] * 5.0
                 + np.arange(series)[:, None])
-        batch = RecordBatch(base.schema, base.part_keys, idx, ts,
-                            {"count": vals.ravel()})
-        state["ingested"] += sh.ingest(batch, offset=t_idx)
+        state["ingested"] += sh.ingest_columns(
+            "prom-counter", base.part_keys, ts2d, {"count": vals},
+            offset=t_idx)
         state["t_idx"] += 2
         state["iters"] += 1
 
@@ -367,10 +377,12 @@ def north_star_soak(minutes: float, series: int = 1_048_576,
     # workloads), no concurrent ingest — the under-ingest degradation
     # is then measured in-artifact against the same process/box
     # (round-5 verdict item 3)
+    _phase(f"partkeys built in {build_s:.0f}s; preloading")
     for _ in range(65):
         ingest_once()
+    _phase("preload done; idle queries")
     idle_lat: List[float] = []
-    for _ in range(7):
+    for _ in range(5):
         hi = s + state["t_idx"] * 10
         lo = max(s + 600, hi - 600)
         t0 = time.perf_counter()
@@ -380,6 +392,7 @@ def north_star_soak(minutes: float, series: int = 1_048_576,
             errors.append(res.error)
             break
         idle_lat.append(time.perf_counter() - t0)
+        _phase(f"idle query {len(idle_lat)}: {idle_lat[-1]:.1f}s")
     idle_p50 = float(np.median(idle_lat)) if idle_lat else float("nan")
 
     # ---- ingest-only capacity: unpaced, no queries — the sustained
@@ -389,10 +402,12 @@ def north_star_soak(minutes: float, series: int = 1_048_576,
     # limit), so the capacity number is measured separately.
     cap_t0 = time.time()
     cap_n0 = state["ingested"]
-    while time.time() - cap_t0 < 45 and not errors:
+    while time.time() - cap_t0 < 30 and not errors:
         ingest_once()
     ingest_only_rate = (state["ingested"] - cap_n0) \
         / max(time.time() - cap_t0, 1e-9)
+    _phase(f"ingest-only capacity: {ingest_only_rate / 1e6:.2f}M/s; "
+           f"starting {minutes:.1f}min soak window")
 
     def querier():
         # rate over the freshest 10 minutes of the stream, group-summed —
@@ -449,7 +464,9 @@ def north_star_soak(minutes: float, series: int = 1_048_576,
     finally:
         stop.set()
         qt.join(timeout=120)
+        _phase("soak window done; final flush")
         sched.stop(final_flush=True)
+        _phase("final flush done")
     ingest_wall_s = max(time.time() - ingest_t0, 1e-9)
 
     stable = True

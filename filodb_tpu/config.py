@@ -67,6 +67,14 @@ class StoreConfig:
     flush_interval_ms: int = 60 * 60 * 1000      # 1h chunk boundary
     disk_time_to_live_s: int = 3 * 24 * 3600
     max_chunks_size: int = 400                   # max samples per chunk
+    # background flushes seal a partition only once this many samples are
+    # unsealed (the reference's write-buffer batching: fewer, bigger
+    # chunks; per-chunk encode+persist overhead was the ingest throttle
+    # at 1M series).  Bounded lag: after 8 skipping rounds a group seals
+    # everything, so the checkpoint advances at least every ~8 intervals.
+    # Direct flush_group()/flush_all_groups() calls always seal all.
+    # 256 targets the reference's ~400-sample chunks (max_chunks_size).
+    min_flush_samples: int = 256
     groups_per_shard: int = 60
     shard_mem_size: int = 512 * 1024 * 1024
     max_blob_buffer_size: int = 15 * 1024 * 1024

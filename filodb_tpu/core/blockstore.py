@@ -68,6 +68,12 @@ class DenseSeriesStore:
         self.ts = np.full((self._s_cap, self._t_cap), _PAD_TS, dtype=np.int64)
         self.counts = np.zeros(self._s_cap, dtype=np.int32)
         self.sealed = np.zeros(self._s_cap, dtype=np.int32)  # flushed watermark
+        # dense per-row newest-sample cache: the ingest out-of-order check
+        # reads this contiguous [S] array instead of the strided
+        # ts[rows, counts-1] gather (~1 cache line per row, measured 38 ms
+        # per 1M-series batch).  Valid only where counts > 0 — consumers
+        # mask by that, so eviction-to-empty needs no invalidation.
+        self.last_ts = np.full(self._s_cap, _NEG_TS, dtype=np.int64)
         # ODP coverage bookkeeping (see TimeSeriesShard.ensure_paged).  Lives
         # here — not on PartitionInfo — so eviction can invalidate it:
         #   paged_floor: disk consulted AND resident down to this time
@@ -141,6 +147,7 @@ class DenseSeriesStore:
         self.ts = grow(self.ts, _PAD_TS)
         self.counts = grow(self.counts, 0)
         self.sealed = grow(self.sealed, 0)
+        self.last_ts = grow(self.last_ts, _NEG_TS)
         self.paged_floor = grow(self.paged_floor, _PAD_TS)
         self.paged_ceil = grow(self.paged_ceil, -1)
         self.page_only = grow(self.page_only, True)
@@ -258,8 +265,7 @@ class DenseSeriesStore:
         pos = self.counts[rows].astype(np.int64) + occ
 
         # drop out-of-order: sample ts must be > last stored ts for its series
-        last_ts = np.where(self.counts[rows] > 0,
-                           self.ts[rows, np.maximum(self.counts[rows] - 1, 0)],
+        last_ts = np.where(self.counts[rows] > 0, self.last_ts[rows],
                            np.iinfo(np.int64).min)
         ok = ts > last_ts
         # also drop non-monotonic within batch (per series): ts must increase
@@ -330,6 +336,13 @@ class DenseSeriesStore:
                 self.cols[c.name][rows, pos, :] = arr
             else:
                 self.cols[c.name][rows, pos] = arr
+        # per-row newest sample: the last occurrence of each row in the
+        # sorted view (within-row ts are ascending by the ok2 filter)
+        sr = rows[order]
+        run_starts = np.concatenate(
+            [[0], np.flatnonzero(np.diff(sr)) + 1])
+        run_ends = np.concatenate([run_starts[1:], [len(rows)]]) - 1
+        self.last_ts[sr[run_ends]] = ts[order][run_ends]
         # bincount, not np.add.at (the unbuffered ufunc.at path is ~10x
         # slower and was the single largest ingest cost at scale)
         inc = np.bincount(rows, minlength=self.counts.shape[0])
@@ -339,6 +352,127 @@ class DenseSeriesStore:
         # scatter writes are idempotent — cheaper than np.unique)
         self.page_only[rows] = False
         return len(rows)
+
+    def append_grid(self, rows: np.ndarray, ts: np.ndarray,
+                    columns: Dict[str, np.ndarray],
+                    bucket_les: Optional[np.ndarray] = None) -> int:
+        """Columnar grid append: `rows` [S] are UNIQUE store rows, `ts` is
+        [S, k] time-ascending per row, columns map to [S, k] (or [S, k, B])
+        matrices.  The common steady-state shape — every series advances by
+        the same k new samples — lands as ONE rectangular slice write per
+        column with zero per-sample index math (no argsort, no cumcount, no
+        np.unique), which is what lets ingest keep up with the scan path.
+        Rows whose samples are out-of-order against stored data degrade to
+        the flat per-sample path; the clean rows still take the fast lane.
+        Returns samples ingested."""
+        with self.mutation() as mut:
+            n = self._append_grid(rows, ts, columns, bucket_les)
+            if n == 0:
+                mut.cancel()
+            return n
+
+    def _append_grid(self, rows, ts, columns, bucket_les) -> int:
+        rows = np.asarray(rows, dtype=np.int64)
+        ts = np.asarray(ts, dtype=np.int64)
+        S, k = ts.shape
+        if S == 0 or k == 0:
+            return 0
+        # shared scrape grid: a broadcast ts (stride-0 rows) means every
+        # row carries the SAME k timestamps — the within-row monotonicity
+        # check collapses to one k-element pass instead of [S, k]
+        shared_row = ts.strides[0] == 0
+        cnt = self.counts[rows]
+        last_ts = np.where(cnt > 0, self.last_ts[rows],
+                           np.iinfo(np.int64).min)
+        row_ok = ts[:, 0] > last_ts
+        if k > 1:
+            if shared_row:
+                if not bool((np.diff(ts[0]) > 0).all()):
+                    row_ok[:] = False
+            else:
+                row_ok &= (np.diff(ts, axis=1) > 0).all(axis=1)
+        ingested = 0
+        if not row_ok.all():
+            # mixed batch: route the dirty rows through the flat path
+            # (per-sample drop semantics), keep the clean rows on the grid
+            bad = ~row_ok
+            flat_rows = np.repeat(rows[bad], k)
+            flat_cols = {c: v[bad].reshape((-1,) + v.shape[2:])
+                         for c, v in columns.items()}
+            ingested += self._append_batch(flat_rows, ts[bad].reshape(-1),
+                                           flat_cols, bucket_les)
+            rows, ts = rows[row_ok], ts[row_ok]
+            columns = {c: v[row_ok] for c, v in columns.items()}
+            S = len(rows)
+            if S == 0:
+                return ingested
+            # re-gather: the flat fallback can trigger evict_oldest, which
+            # shifts EVERY row's count — stale positions would write the
+            # clean rows outside their live window (silent data loss)
+            cnt = self.counts[rows]
+
+        if bucket_les is not None or any(
+                c.col_type == "hist" for c in self.schema.data_columns):
+            hist_col = next(c.name for c in self.schema.data_columns
+                            if c.col_type == "hist")
+            nb = columns[hist_col].shape[2] if columns[hist_col].ndim == 3 \
+                else 0
+            if self.ensure_scheme(nb, bucket_les):
+                from filodb_tpu.memory.histogram import rebucket
+                columns = {**columns,
+                           hist_col: rebucket(columns[hist_col],
+                                              bucket_les, self.bucket_les)}
+
+        pos0 = cnt.astype(np.int64)            # reuse the OOO-check gather
+        need_t = int(pos0.max()) + k
+        if need_t > self._t_cap:
+            if need_t > self.max_time_cap:
+                self.evict_oldest(need_t - self.max_time_cap
+                                  + self.max_time_cap // 4)
+                pos0 = self.counts[rows].astype(np.int64)
+                need_t = int(pos0.max()) + k
+            if need_t > self._t_cap:
+                self._grow_time(need_t)
+
+        c0 = int(pos0[0])
+        uniform = bool((pos0 == c0).all())
+        contig = bool(rows[-1] - rows[0] == S - 1
+                      and (np.diff(rows) == 1).all()) if S > 1 else True
+        hist_cols = {c.name for c in self.schema.data_columns
+                     if c.col_type == "hist"}
+        if uniform and contig:
+            r0 = int(rows[0])
+            self.ts[r0:r0 + S, c0:c0 + k] = ts
+            for name, arr in columns.items():
+                self.cols[name][r0:r0 + S, c0:c0 + k] = arr
+        elif uniform:
+            self.ts[rows, c0:c0 + k] = ts
+            for name, arr in columns.items():
+                self.cols[name][rows, c0:c0 + k] = arr
+        else:
+            pos = pos0[:, None] + np.arange(k, dtype=np.int64)
+            self.ts[rows[:, None], pos] = ts
+            for name, arr in columns.items():
+                if name in hist_cols:
+                    self.cols[name][rows[:, None], pos, :] = arr
+                else:
+                    self.cols[name][rows[:, None], pos] = arr
+        # conservative per-position bounds, as in _append_batch; rows are
+        # time-ascending so the edge columns bound the whole grid (one
+        # [S] pass each, and O(k) on a shared grid)
+        p0, p1 = int(pos0.min()), int(pos0.max()) + k
+        if shared_row and ts.strides[0] == 0:
+            tmin, tmax = int(ts[0, 0]), int(ts[0, -1])
+        else:
+            tmin, tmax = int(ts[:, 0].min()), int(ts[:, -1].max())
+        np.minimum(self.pos_ts_min[p0:p1], tmin,
+                   out=self.pos_ts_min[p0:p1])
+        np.maximum(self.pos_ts_max[p0:p1], tmax,
+                   out=self.pos_ts_max[p0:p1])
+        self.counts[rows] += k            # rows unique: fancy += is exact
+        self.last_ts[rows] = ts[:, -1]
+        self.page_only[rows] = False
+        return ingested + S * k
 
     def prepend_row(self, row: int, ts: np.ndarray,
                     columns: Dict[str, np.ndarray]) -> int:
@@ -386,6 +520,8 @@ class DenseSeriesStore:
             else:
                 arr[row, n:need] = arr[row, :cnt].copy()
                 arr[row, :n] = np.nan if vals is None else vals
+        if cnt == 0:
+            self.last_ts[row] = int(ts[-1])   # row was empty: payload tops it
         self.counts[row] += n
         self.sealed[row] += n
         # position bounds: the right shift leaves stale entries that are
@@ -445,6 +581,7 @@ class DenseSeriesStore:
                 arr[row, cnt:need] = np.nan if vals is None else vals
         self.counts[row] += n
         self.sealed[row] += n
+        self.last_ts[row] = int(ts[n - 1])
         return n
 
     # ---- eviction ----
@@ -520,6 +657,7 @@ class DenseSeriesStore:
     @property
     def nbytes(self) -> int:
         n = self.ts.nbytes + self.counts.nbytes + self.sealed.nbytes
+        n += self.last_ts.nbytes
         n += self.paged_floor.nbytes + self.paged_ceil.nbytes
         n += self.page_only.nbytes
         for arr in self.cols.values():

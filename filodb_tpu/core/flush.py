@@ -75,7 +75,11 @@ class FlushScheduler:
                     return
                 try:
                     if group < shard._groups:
-                        shard.flush_group(group)
+                        # background flushes batch small partitions (the
+                        # write-buffer behavior); direct flush calls seal all
+                        shard.flush_group(
+                            group,
+                            min_samples=shard.config.store.min_flush_samples)
                         self.flushes += 1
                 except Exception:  # noqa: BLE001
                     self.errors += 1

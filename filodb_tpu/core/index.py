@@ -261,6 +261,14 @@ class PartKeyIndex:
                     break
         return sorted(out)
 
+    def ended_pids(self, before_ms: int) -> np.ndarray:
+        """Alive partIds whose series ended before `before_ms` — the
+        eviction candidate sweep as one vectorized compare instead of a
+        per-partition Python loop (TimeSeriesShard.evict_ended_partitions
+        drains these in fixed-size increments)."""
+        n = len(self._part_keys)
+        return np.flatnonzero(self._alive[:n] & (self._end[:n] < before_ms))
+
     def remove_partition(self, part_id: int) -> None:
         """Eviction support (ref: PartKeyLuceneIndex.removePartKeys)."""
         pk = self._part_keys[part_id]

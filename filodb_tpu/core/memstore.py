@@ -53,6 +53,16 @@ class TimeSeriesMemStore:
             raise KeyError(f"shard {shard_num} of {dataset} not set up")
         return shard.ingest(batch, offset)
 
+    def ingest_columns(self, dataset: str, shard_num: int, schema_name: str,
+                       part_keys, ts, columns, offset: int = -1,
+                       bucket_les=None) -> int:
+        """Columnar grid ingest (see TimeSeriesShard.ingest_columns)."""
+        shard = self.get_shard(dataset, shard_num)
+        if shard is None:
+            raise KeyError(f"shard {shard_num} of {dataset} not set up")
+        return shard.ingest_columns(schema_name, part_keys, ts, columns,
+                                    offset, bucket_les)
+
     def ingest_stream(self, dataset: str, shard_num: int,
                       stream: Iterable[Tuple[RecordBatch, int]],
                       flush_every: int = 0) -> int:

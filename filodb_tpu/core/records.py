@@ -85,6 +85,28 @@ class RecordBatch:
             buf.write(struct.pack("<H", 0))
         return buf.getvalue()
 
+    @classmethod
+    def from_grid(cls, schema: Schema, part_keys: List[PartKey],
+                  ts: np.ndarray, columns: Dict[str, np.ndarray],
+                  bucket_les: Optional[np.ndarray] = None) -> "RecordBatch":
+        """Build a batch from grid-shaped columnar data: ts [S, k] and each
+        column [S, k] (or [S, k, B]) where row i belongs to part_keys[i] —
+        the scrape-cycle shape.  The flattened part_idx is the canonical
+        repeat(arange(S), k) pattern, which the shard's ingest detects and
+        routes through the rectangular append path (no per-sample index
+        math); `TimeSeriesShard.ingest_columns` skips even this flatten."""
+        ts = np.asarray(ts, dtype=np.int64)
+        if ts.ndim != 2 or ts.shape[0] != len(part_keys):
+            raise ValueError("from_grid: ts must be [num_keys, k]")
+        S, k = ts.shape
+        cols = {}
+        for c in schema.data_columns:
+            v = np.asarray(columns[c.name])
+            cols[c.name] = v.reshape((S * k,) + v.shape[2:])
+        return cls(schema, list(part_keys),
+                   np.repeat(np.arange(S, dtype=np.int32), k),
+                   ts.reshape(-1), cols, bucket_les)
+
     @staticmethod
     def from_bytes(data: bytes, schemas: Schemas = DEFAULT_SCHEMAS) -> "RecordBatch":
         buf = io.BytesIO(data)

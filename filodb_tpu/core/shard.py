@@ -9,6 +9,7 @@ DenseSeriesStore (see blockstore.py) which the TPU kernels consume directly.
 """
 from __future__ import annotations
 
+import collections
 import contextlib
 import dataclasses
 import itertools
@@ -22,6 +23,30 @@ _log = logging.getLogger("filodb.shard")
 _SHARD_KEYS_SERIAL = itertools.count(1)  # see TimeSeriesShard.keys_serial
 _KEY_RESOLVE_CACHE_MAX = 4               # live key tables per shard (schemas)
 _LOOKUP_CACHE_MAX = 32                   # memoized lookup_partitions results
+
+# shared flush-encode pool: chunk encoding is NumPy (releases the GIL), so
+# slab-parallel encode overlaps with live ingest on the other cores.  One
+# process-wide pool — flushes across shards share it rather than each
+# spawning threads.  Lazy: tests that never flush big groups pay nothing.
+_ENCODE_POOL = None
+_ENCODE_POOL_WORKERS = 0
+_ENCODE_POOL_LOCK = threading.Lock()
+_ENCODE_MIN_PARALLEL = 16                # serial below this many partitions
+
+
+def _encode_pool():
+    """-> (executor, worker_count)."""
+    global _ENCODE_POOL, _ENCODE_POOL_WORKERS
+    if _ENCODE_POOL is None:
+        with _ENCODE_POOL_LOCK:
+            if _ENCODE_POOL is None:
+                import concurrent.futures
+                import os
+                _ENCODE_POOL_WORKERS = max(2, min(4, os.cpu_count() or 1))
+                _ENCODE_POOL = concurrent.futures.ThreadPoolExecutor(
+                    max_workers=_ENCODE_POOL_WORKERS,
+                    thread_name_prefix="filodb-flush-encode")
+    return _ENCODE_POOL, _ENCODE_POOL_WORKERS
 
 import numpy as np
 
@@ -176,11 +201,20 @@ class TimeSeriesShard:
         # flush-group membership maintained at creation so a group flush
         # walks only its own partitions, not all of them
         self._group_pids: List[List[int]] = [[] for _ in range(self._groups)]
+        # write-buffer batching state (min_flush_samples): consecutive
+        # rounds each group skipped small partitions, and the last offset
+        # at which the group was FULLY persisted (the only offset its
+        # checkpoint may claim — a skipped partition's samples are not on
+        # disk yet, and replay-past-them would lose data)
+        self._group_skip_rounds: List[int] = [0] * self._groups
+        self._group_ckpt_offset: Dict[int, int] = {}
         # deferred tombstone reclamation queue: (evicted_at, pid).  Evicted
         # partitions keep their PartitionInfo for a grace period so lock-free
         # readers holding the pid can still resolve it; flush prunes entries
-        # past the grace window under write_lock (two-phase reclamation)
-        self._evicted_tombstones: List[Tuple[float, int]] = []
+        # past the grace window under write_lock (two-phase reclamation).
+        # A deque: mass-expiry pushes 100k+ entries and list.pop(0) would
+        # make the prune quadratic
+        self._evicted_tombstones: collections.deque = collections.deque()
 
     # --------------------------------------------------------------- locking
 
@@ -319,6 +353,88 @@ class TimeSeriesShard:
         with self._write_locked("ingest"):
             return self._ingest(batch, offset)
 
+    def _resolve_key_table(self, pk_list, schema_name: str) -> list:
+        """Cached key-table -> pid resolution entry [pk_list, pids, epoch,
+        schema, grid_ok] (pid entries -1 until a partition exists).
+        Cached per key-table identity: streaming sources reuse one
+        part_keys list across batches, so steady-state ingest skips the
+        O(K) Python loop entirely.  pids are cached, not rows:
+        memory-pressure compaction remaps rows, and _pid_row picks that
+        up per batch; evictions bump keys_epoch, invalidating the cache
+        before a dead pid could be written to.  grid_ok memoizes the
+        all-pids-distinct check the rectangular append path needs (a
+        duplicate part key would alias two rows onto one pid)."""
+        nk = len(pk_list)
+        cache = self._key_resolve_cache
+        ent = cache.get(id(pk_list))
+        if (ent is not None and ent[0] is pk_list
+                and ent[2] == self.keys_epoch
+                and ent[3] == schema_name and len(ent[1]) == nk):
+            cache[id(pk_list)] = cache.pop(id(pk_list))   # LRU touch
+            return ent
+        ent = [pk_list, np.full(nk, -1, dtype=np.int64), self.keys_epoch,
+               schema_name, None]
+        cache[id(pk_list)] = ent
+        while len(cache) > _KEY_RESOLVE_CACHE_MAX:
+            cache.pop(next(iter(cache)))
+        return ent
+
+    @staticmethod
+    def _grid_rows_ok(ent: list) -> bool:
+        """True when the entry's resolved pids are pairwise distinct (the
+        append_grid precondition).  Fully-resolved tables memoize the
+        verdict; tables with quota holes (-1 slots) are re-checked on the
+        kept subset per batch — rare, and still vectorized."""
+        pids = ent[1]
+        if (pids < 0).any():
+            kept = pids[pids >= 0]
+            return bool(np.unique(kept).size == kept.size)
+        if ent[4] is None:
+            ent[4] = bool(np.unique(pids).size == pids.size)
+        return ent[4]
+
+    def _create_missing(self, pk_list, schema_name: str,
+                        pids_for_key: np.ndarray, need: np.ndarray,
+                        first_ts) -> None:
+        """Create partitions for key indices `need` whose pid slot is -1.
+        Python work is per NEW SERIES only (index + registry insertion are
+        inherently per-object); steady-state batches resolve everything
+        from the cache and never reach here.  `first_ts` maps key index ->
+        first sample time (dict or array)."""
+        for k in need.tolist():
+            try:
+                info = self.get_or_create_partition(
+                    pk_list[k], schema_name, int(first_ts[k]))
+            except QuotaReachedException:
+                # quota-rejected series: drop its records, count them
+                # (ref: TimeSeriesShard ingest QuotaReachedException
+                # handling); retried per batch, so a later quota raise
+                # admits the series — the pid slot stays -1 until then
+                self.stats.quota_dropped += 1
+                continue
+            pids_for_key[k] = info.part_id
+
+    @staticmethod
+    def _grid_samples(batch: RecordBatch) -> int:
+        """k if the batch is GRID-shaped — part_idx == repeat(arange(nk), k)
+        — else 0.  Two vectorized comparison passes, far cheaper than the
+        argsort/cumcount the flat path would spend on the same records."""
+        nk = len(batch.part_keys)
+        n = batch.num_records
+        if nk == 0 or n % nk:
+            return 0
+        k = n // nk
+        pi = batch.part_idx
+        if k == 0 or pi[0] != 0 or pi[-1] != nk - 1:
+            return 0
+        pm = pi.reshape(nk, k)
+        if not np.array_equal(pm[:, 0],
+                              np.arange(nk, dtype=pm.dtype)):
+            return 0
+        if k > 1 and not (pm[:, 1:] == pm[:, :1]).all():
+            return 0
+        return k
+
     def _ingest(self, batch: RecordBatch, offset: int = -1) -> int:
         if batch.num_records == 0:
             return 0
@@ -328,45 +444,54 @@ class TimeSeriesShard:
         # routed sub-batch carries the full key list but only this shard's
         # rows (ref: TimeSeriesShard.getOrAddPartitionAndIngest:1249 creates
         # per ingest record, never per container key table entry).
-        # Resolution is cached per key-table identity: streaming sources
-        # reuse one part_keys list across batches, so steady-state ingest
-        # skips the O(K) Python loop entirely.  pids are cached, not rows:
-        # memory-pressure compaction remaps rows, and _pid_row picks that
-        # up per batch; evictions bump keys_epoch, invalidating the cache
-        # before a dead pid could be written to.
         pk_list = batch.part_keys
-        nk = len(pk_list)
-        cache = self._key_resolve_cache
-        ent = cache.get(id(pk_list))
-        if (ent is not None and ent[0] is pk_list
-                and ent[2] == self.keys_epoch
-                and ent[3] == batch.schema.name and len(ent[1]) == nk):
-            cache[id(pk_list)] = cache.pop(id(pk_list))   # LRU touch
-            pids_for_key = ent[1]
-        else:
-            pids_for_key = np.full(nk, -1, dtype=np.int64)
-            self._key_resolve_cache[id(pk_list)] = (
-                pk_list, pids_for_key, self.keys_epoch, batch.schema.name)
-            while len(self._key_resolve_cache) > _KEY_RESOLVE_CACHE_MAX:
-                self._key_resolve_cache.pop(
-                    next(iter(self._key_resolve_cache)))
+        ent = self._resolve_key_table(pk_list, batch.schema.name)
+        pids_for_key = ent[1]
+        grid_k = self._grid_samples(batch)
+        if grid_k:
+            # grid batch: every key is referenced exactly k times in order,
+            # so resolution needs no np.unique and the store write is a
+            # rectangular scatter (append_grid) — no per-sample index math
+            ts2d = batch.timestamps.reshape(-1, grid_k)
+            unresolved = np.flatnonzero(pids_for_key < 0)
+            if unresolved.size:
+                # first_ts is indexed by KEY INDEX inside _create_missing,
+                # so hand over the full first-sample column — a subsetted
+                # array would misalign when unresolved keys are a
+                # non-prefix subset (quota-hole retries)
+                self._create_missing(pk_list, batch.schema.name,
+                                     pids_for_key, unresolved, ts2d[:, 0])
+            if not self._grid_rows_ok(ent):
+                grid_k = 0             # duplicate keys: flat path below
+        if grid_k:
+            if self._traced_pids:
+                self._trace_touch_resolved(pids_for_key, offset)
+            keep = pids_for_key >= 0
+            rows = self._pid_row[pids_for_key[keep]] if keep.any() \
+                else np.zeros(0, dtype=np.int64)
+            dropped_keys = int((~keep).sum())
+            if dropped_keys:
+                self.stats.rows_dropped += dropped_keys * grid_k
+                ts2d = ts2d[keep]
+            cols2d = {c: v.reshape((len(pk_list), grid_k) + v.shape[1:])[keep]
+                      for c, v in batch.columns.items()} if dropped_keys \
+                else {c: v.reshape((len(pk_list), grid_k) + v.shape[1:])
+                      for c, v in batch.columns.items()}
+            n = store.append_grid(rows, ts2d, cols2d, batch.bucket_les)
+            self.stats.rows_ingested += n
+            self.stats.rows_dropped += ts2d.size - n
+            metrics_registry.counter("ingested_rows", dataset=self.dataset,
+                                     shard=str(self.shard_num)).increment(n)
+            if offset >= 0:
+                self.ingested_offset = offset
+            return n
         uniq, first = np.unique(batch.part_idx, return_index=True)
         unresolved = uniq[pids_for_key[uniq] < 0]
         if unresolved.size:
             first_ts = dict(zip(uniq.tolist(),
                                 batch.timestamps[first].tolist()))
-            for k in unresolved.tolist():
-                try:
-                    info = self.get_or_create_partition(
-                        pk_list[k], batch.schema.name, first_ts[k])
-                except QuotaReachedException:
-                    # quota-rejected series: drop its records, count them
-                    # (ref: TimeSeriesShard ingest QuotaReachedException
-                    # handling); retried per batch, so a later quota raise
-                    # admits the series — the pid slot stays -1 until then
-                    self.stats.quota_dropped += 1
-                    continue
-                pids_for_key[k] = info.part_id
+            self._create_missing(pk_list, batch.schema.name, pids_for_key,
+                                 unresolved, first_ts)
         if self._traced_pids:
             touched = pids_for_key[uniq]
             traced_touched = [int(p) for p in touched[touched >= 0].tolist()
@@ -399,12 +524,83 @@ class TimeSeriesShard:
             self.ingested_offset = offset
         return n
 
+    def _trace_touch_resolved(self, pids_for_key: np.ndarray,
+                              offset: int) -> None:
+        touched = pids_for_key[pids_for_key >= 0]
+        traced = [int(p) for p in touched.tolist()
+                  if int(p) in self._traced_pids]
+        if traced:
+            self._trace_touch("ingest", traced, extra=f" offset={offset}")
+
+    def ingest_columns(self, schema_name: str, part_keys,
+                       ts: np.ndarray, columns: Dict[str, np.ndarray],
+                       offset: int = -1,
+                       bucket_les: Optional[np.ndarray] = None) -> int:
+        """Columnar ingest fast path: `ts` [S, k] and each column [S, k]
+        (or [S, k, B]) where row i belongs to part_keys[i].  The natural
+        shape of a scrape cycle — every series gains the same k samples —
+        lands in the per-schema SoA store as rectangular slice writes with
+        no flatten/re-sort round trip through a RecordBatch.  Semantically
+        identical to ingest() of the equivalent flat batch (see
+        tests/test_ingest_columnar.py for the enforced equivalence)."""
+        ts = np.asarray(ts)
+        if ts.ndim != 2 or len(part_keys) != ts.shape[0]:
+            raise ValueError("ingest_columns: ts must be [num_keys, k]")
+        with self._write_locked("ingest"):
+            if ts.size == 0:
+                return 0
+            store = self._store_for(schema_name)
+            ent = self._resolve_key_table(part_keys, schema_name)
+            pids_for_key = ent[1]
+            unresolved = np.flatnonzero(pids_for_key < 0)
+            if unresolved.size:
+                # full first-sample column: _create_missing indexes it by
+                # key index (see the grid path in _ingest)
+                self._create_missing(part_keys, schema_name, pids_for_key,
+                                     unresolved, ts[:, 0])
+            if not self._grid_rows_ok(ent):
+                # duplicate part keys: flatten to the per-record path,
+                # which cumcounts duplicate rows correctly
+                from filodb_tpu.core.records import RecordBatch
+                flat = RecordBatch.from_grid(self.schemas[schema_name],
+                                             list(part_keys), ts, columns,
+                                             bucket_les)
+                return self._ingest(flat, offset)
+            if self._traced_pids:
+                self._trace_touch_resolved(pids_for_key, offset)
+            keep = pids_for_key >= 0
+            if keep.all():
+                rows = self._pid_row[pids_for_key]
+            else:
+                self.stats.rows_dropped += int((~keep).sum()) * ts.shape[1]
+                rows = self._pid_row[pids_for_key[keep]]
+                ts = ts[keep]
+                columns = {c: v[keep] for c, v in columns.items()}
+            n = store.append_grid(rows, ts, columns, bucket_les)
+            self.stats.rows_ingested += n
+            self.stats.rows_dropped += ts.size - n
+            metrics_registry.counter("ingested_rows", dataset=self.dataset,
+                                     shard=str(self.shard_num)).increment(n)
+            if offset >= 0:
+                self.ingested_offset = offset
+            return n
+
     # ------------------------------------------------------------------- flush
 
-    def flush_group(self, group: int, ingestion_time_ms: Optional[int] = None) -> int:
+    def flush_group(self, group: int, ingestion_time_ms: Optional[int] = None,
+                    min_samples: int = 0) -> int:
         """Seal + persist unsealed samples for one flush group, then commit the
         group checkpoint (ref: TimeSeriesShard.doFlushSteps:969,
-        writeChunks:1072, commitCheckpoint:1127).  Returns chunks written."""
+        writeChunks:1072, commitCheckpoint:1127).  Returns chunks written.
+
+        min_samples > 0 (the background scheduler's path) batches like the
+        reference's write buffers: partitions with fewer unsealed samples
+        are left to accumulate — fewer, bigger chunks, and per-chunk
+        encode/persist overhead stops throttling ingest.  The group's
+        checkpoint then only advances on fully-persisted rounds, and a
+        group force-seals after 8 consecutive skipping rounds so the
+        replay window stays bounded.  Direct calls (tests, final flush,
+        memory enforcement) default to sealing everything."""
         ingestion_time_ms = ingestion_time_ms or int(time.time() * 1000)
         # Flushes serialize against EACH OTHER here (downsampler state,
         # store writes), but hold the shard write_lock only for the brief
@@ -413,22 +609,29 @@ class TimeSeriesShard:
         # it >10 s per group at 131k series (soak-measured stall).
         with self._flush_lock:
             with metrics_span("flush", dataset=self.dataset):
-                written = self._do_flush_group(group, ingestion_time_ms)
+                written = self._do_flush_group(group, ingestion_time_ms,
+                                               min_samples)
         metrics_registry.counter("chunks_flushed",
                                  dataset=self.dataset).increment(written)
         return written
 
-    def _prune_tombstones(self, grace_s: float = 60.0) -> int:
+    def _prune_tombstones(self, grace_s: float = 60.0,
+                          max_prune: int = 8192) -> int:
         """Reclaim evicted partitions past the grace window (caller holds
         write_lock).  After grace_s no realistic in-flight query still holds
         the pid, so the PartitionInfo / cached key / group membership can be
-        freed — otherwise high series churn grows them without bound."""
+        freed — otherwise high series churn grows them without bound.
+        At most `max_prune` per call: the prune runs inside flush's
+        lock-held copy phase, so one call must stay bounded; the next
+        flush continues the drain."""
         if not self._evicted_tombstones:
             return 0
         cutoff = time.time() - grace_s
         pruned = []
-        while self._evicted_tombstones and self._evicted_tombstones[0][0] <= cutoff:
-            _, pid = self._evicted_tombstones.pop(0)
+        while (self._evicted_tombstones
+               and self._evicted_tombstones[0][0] <= cutoff
+               and len(pruned) < max_prune):
+            _, pid = self._evicted_tombstones.popleft()
             info = self.partitions[pid]
             if info is not None:
                 glist = self._group_pids[info.group]
@@ -446,7 +649,42 @@ class TimeSeriesShard:
             self._key_resolve_cache.clear()
         return len(pruned)
 
-    def _do_flush_group(self, group: int, ingestion_time_ms: int) -> int:
+    def _encode_one(self, info: PartitionInfo, ts, cols, les,
+                    ingestion_time_ms: int):
+        schema = self.schemas[info.schema_name]
+        col_types = {c.name: c.col_type for c in schema.data_columns}
+        scheme = HistogramBuckets.custom(les) if les is not None else None
+        return encode_chunkset(ts, cols, col_types, ingestion_time_ms,
+                               scheme)
+
+    def _encode_pending(self, pending, ingestion_time_ms: int) -> list:
+        """Encode the copied flush slices into ChunkSets, in `pending`
+        order.  Large groups split into per-worker SLABS on the shared
+        thread pool — NumPy codec work drops the GIL, so encode overlaps
+        flush's own persist loop and live ingest; slab granularity (not
+        per-partition tasks) keeps executor overhead off the millions of
+        small chunks a 1M-series flush produces.  Persist + downsample
+        stay on the flush thread: store writers and the downsampler are
+        not thread-safe, and their ordering is part of the checkpoint
+        contract."""
+        if len(pending) < _ENCODE_MIN_PARALLEL:
+            return [self._encode_one(info, ts, cols, les, ingestion_time_ms)
+                    for _, info, _, ts, cols, les in pending]
+        pool, workers = _encode_pool()
+
+        def encode_slab(slab):
+            return [self._encode_one(info, ts, cols, les, ingestion_time_ms)
+                    for _, info, _, ts, cols, les in slab]
+
+        step = (len(pending) + workers - 1) // workers
+        slabs = [pending[i:i + step] for i in range(0, len(pending), step)]
+        out: list = []
+        for fut in [pool.submit(encode_slab, s) for s in slabs]:
+            out.extend(fut.result())
+        return out
+
+    def _do_flush_group(self, group: int, ingestion_time_ms: int,
+                        min_samples: int = 0) -> int:
         """Three phases: (1) under write_lock, copy every partition's
         unsealed slice (cheap); (2) lock-FREE, encode + persist +
         downsample (the expensive part, overlapping live ingest/queries);
@@ -468,26 +706,100 @@ class TimeSeriesShard:
             offset_snapshot = self.ingested_offset
             shift_snapshot = {name: st.shift_version
                               for name, st in self.stores.items()}
+            # Copy every partition's unsealed slice with BATCH gathers —
+            # one padded [R, Lmax] fancy-index per schema per column —
+            # instead of a per-partition Python loop under the lock.  At
+            # 1M series / 64 groups the old loop held the write lock
+            # ~0.5 s per group while groups ticked every ~0.3 s, which
+            # made flush, not the append path, the ingest throttle (the
+            # r5 soak's 2.58M samples/s ceiling).  The padded matrices
+            # ARE the snapshot; per-partition views are cut from them in
+            # phase 2, outside the lock.
+            seal_all = (min_samples <= 0
+                        or self._group_skip_rounds[group] >= 7)
+            skipped_any = False
+            snap = []
             for pid in self._group_pids[group]:
                 info = self.partitions[pid]
                 if info is None or not self._pid_alive[pid]:
                     continue
-                store = self.stores[info.schema_name]
-                lo, hi = store.unsealed_range(info.row)
-                if hi <= lo:
+                snap.append(pid)
+            for schema_name, store in self.stores.items():
+                pids = [p for p in snap
+                        if self.partitions[p].schema_name == schema_name]
+                if not pids:
                     continue
-                ts, cols = store.series_slice(info.row, lo, hi)
-                pending.append((pid, info, hi, ts, cols,
-                                store.bucket_les))
+                pids = np.asarray(pids, dtype=np.int64)
+                rows = self._pid_row[pids]
+                lo = store.sealed[rows].astype(np.int64)
+                hi = store.counts[rows].astype(np.int64)
+                sel = hi > lo
+                if not seal_all:
+                    big = sel & (hi - lo >= min_samples)
+                    skipped_any = skipped_any or bool((sel & ~big).any())
+                    sel = big
+                if not sel.any():
+                    continue
+                pids, rows, lo, hi = pids[sel], rows[sel], lo[sel], hi[sel]
+                les = store.bucket_les
+                # block the row set so R * Lmax padded cells stay bounded
+                # (a mass-recovery group with long unsealed tails must not
+                # materialize gigabytes)
+                lens = hi - lo
+                # <= ~64 MB per padded column gather: budget in CELLS,
+                # deflated by the widest column's bucket axis so a
+                # histogram schema's [R, Lmax, B] gather obeys the same
+                # byte bound as a scalar column's [R, Lmax]
+                widest = max([1] + [store.num_buckets or 1
+                                    for c in store.schema.data_columns
+                                    if c.col_type == "hist"])
+                max_cells = max(1, (1 << 23) // widest)
+                start = 0
+                R = len(pids)
+                while start < R:
+                    end = start + 1
+                    lmax = int(lens[start])
+                    cells = lmax
+                    while end < R:
+                        nl = max(lmax, int(lens[end]))
+                        nc = nl * (end - start + 1)
+                        if nc > max_cells:
+                            break
+                        lmax, cells = nl, nc
+                        end += 1
+                    rs = rows[start:end]
+                    lor = lo[start:end]
+                    posm = lor[:, None] + np.arange(lmax, dtype=np.int64)
+                    posc = np.minimum(posm, store.ts.shape[1] - 1)
+                    ts_pad = store.ts[rs[:, None], posc]
+                    col_pads = {}
+                    for c in store.schema.data_columns:
+                        arr = store.cols[c.name]
+                        if arr is None:
+                            col_pads[c.name] = None
+                        elif arr.ndim == 3:
+                            col_pads[c.name] = arr[rs[:, None], posc, :]
+                        else:
+                            col_pads[c.name] = arr[rs[:, None], posc]
+                    for i in range(start, end):
+                        pending.append((int(pids[i]),
+                                        self.partitions[int(pids[i])],
+                                        int(hi[i]), ts_pad, col_pads,
+                                        les, i - start, int(lens[i])))
+                    start = end
+        # cut per-partition views from the padded snapshots (lock-free)
+        pending = [
+            (pid, info, hi_i,
+             ts_pad[r, :ln],
+             {name: (np.zeros((ln, 0)) if pad is None
+                     else pad[r, :ln])
+              for name, pad in col_pads_.items()},
+             les)
+            for pid, info, hi_i, ts_pad, col_pads_, les, r, ln in pending]
         written = 0
         encoded = []
-        for pid, info, hi, ts, cols, les in pending:
-            schema = self.schemas[info.schema_name]
-            col_types = {c.name: c.col_type for c in schema.data_columns}
-            scheme = (HistogramBuckets.custom(les)
-                      if les is not None else None)
-            cs = encode_chunkset(ts, cols, col_types, ingestion_time_ms,
-                                 scheme)
+        chunksets = self._encode_pending(pending, ingestion_time_ms)
+        for (pid, info, hi, ts, cols, les), cs in zip(pending, chunksets):
             self.column_store.write_chunks(
                 self.dataset, self.shard_num, info.part_key, [cs],
                 info.schema_name)
@@ -502,8 +814,8 @@ class TimeSeriesShard:
                     cut = int(np.searchsorted(ts, wm, side="right")) \
                         if wm is not None else 0
                     self.shard_downsampler.downsample(
-                        info.part_key, schema, ts[cut:],
-                        {k: v[cut:] for k, v in cols.items()},
+                        info.part_key, self.schemas[info.schema_name],
+                        ts[cut:], {k: v[cut:] for k, v in cols.items()},
                         bucket_les=les)
                     self._ds_time_wm[pid] = int(ts[-1])
             encoded.append((pid, info, hi, cs))
@@ -537,8 +849,22 @@ class TimeSeriesShard:
         if dirty:
             self.column_store.write_part_keys(self.dataset, self.shard_num,
                                               dirty)
-        self.meta_store.write_checkpoint(
-            self.dataset, self.shard_num, group, offset_snapshot)
+        if skipped_any:
+            # small partitions kept accumulating: their samples are not on
+            # disk, so the checkpoint may only claim the last FULLY
+            # persisted offset (replaying a bit extra is safe — replayed
+            # samples land in the dense tier and paging never duplicates
+            # below the dense floor)
+            self._group_skip_rounds[group] += 1
+            ckpt = self._group_ckpt_offset.get(group)
+            if ckpt is not None:
+                self.meta_store.write_checkpoint(
+                    self.dataset, self.shard_num, group, ckpt)
+        else:
+            self._group_skip_rounds[group] = 0
+            self._group_ckpt_offset[group] = offset_snapshot
+            self.meta_store.write_checkpoint(
+                self.dataset, self.shard_num, group, offset_snapshot)
         if self.cardinality_tracker is not None:
             # buffered cardinality updates persist with the checkpoint
             self.cardinality_tracker.flush()
@@ -547,6 +873,8 @@ class TimeSeriesShard:
         return written
 
     def flush_all_groups(self) -> int:
+        """Seal + persist EVERYTHING (no write-buffer batching): the
+        final-flush / memory-enforcement / test path."""
         return sum(self.flush_group(g) for g in range(self._groups))
 
     # ------------------------------------------------------------------- query
@@ -626,6 +954,13 @@ class TimeSeriesShard:
             self._trace_touch("query_lookup", ids)
         res = PartLookupResult(self.shard_num, ids, by_schema, first, self)
         if ck is not None:
+            # the memo hands the SAME PartLookupResult to every hit:
+            # freeze the arrays so a future consumer mutating part_ids /
+            # pids_by_schema in place poisons its own copy attempt loudly
+            # instead of silently corrupting later queries (ADVICE r5)
+            ids.setflags(write=False)
+            for arr in by_schema.values():
+                arr.setflags(write=False)
             self._lookup_cache[ck] = res
             while len(self._lookup_cache) > _LOOKUP_CACHE_MAX:
                 try:
@@ -937,8 +1272,7 @@ class TimeSeriesShard:
                   else self.config.store.shard_mem_size)
         tail = (active_tail_rows if active_tail_rows is not None
                 else self.config.store.active_tail_rows)
-        with self._write_locked("enforce_memory"):
-            return self._enforce_memory(budget, tail)
+        return self._enforce_memory(budget, tail)
 
     def _enforce_memory(self, budget: int, tail: int) -> int:
         dense = sum(s.nbytes for s in self.stores.values())
@@ -946,15 +1280,23 @@ class TimeSeriesShard:
                                shard=str(self.shard_num)).update(dense)
         if dense <= budget:
             return 0
+        # Seal everything OUTSIDE the write lock: flush manages its own
+        # lock phases (copy/seal brief, encode+persist lock-free).  The
+        # old whole-enforcement write_lock hold spanned this full forced
+        # flush — minutes at 1M series once write-buffer batching let a
+        # real backlog accumulate — freezing ingest and queries (the
+        # soak's p99 tail).  Racing ingest between flush and truncation
+        # is safe: evict_oldest only ever drops SEALED samples.
         self.flush_all_groups()
         released = 0
-        for store in self.stores.values():
-            if store.num_series == 0:
-                continue
-            excess = np.maximum(store.counts - tail, 0)
-            if excess.any():
-                store.evict_oldest(excess)
-            released += store.compact_time(slack=max(8, tail // 4))
+        with self._write_locked("enforce_memory"):
+            for store in self.stores.values():
+                if store.num_series == 0:
+                    continue
+                excess = np.maximum(store.counts - tail, 0)
+                if excess.any():
+                    store.evict_oldest(excess)
+                released += store.compact_time(slack=max(8, tail // 4))
         metrics_registry.gauge("dense_store_bytes", dataset=self.dataset,
                                shard=str(self.shard_num)).update(
             sum(s.nbytes for s in self.stores.values()))
@@ -965,42 +1307,57 @@ class TimeSeriesShard:
 
     # ---------------------------------------------------------------- eviction
 
-    def evict_ended_partitions(self, before_ms: int) -> int:
+    def evict_ended_partitions(self, before_ms: int,
+                               max_per_lock: int = 2048) -> int:
         """Evict partitions whose series ended before `before_ms`
-        (ref: TimeSeriesShard.partitionsToEvict:1464)."""
-        with self._write_locked("evict_ended"):
-            return self._evict_ended_partitions(before_ms)
+        (ref: TimeSeriesShard.partitionsToEvict:1464).
 
-    def _evict_ended_partitions(self, before_ms: int) -> int:
-        evicted = 0
-        for info in list(self.partitions):
-            if info is None or not self._pid_alive[info.part_id]:
-                continue
-            if self.index.end_time(info.part_id) < before_ms:
-                self.index.remove_partition(info.part_id)
-                self.part_set.pop(info.part_key.to_bytes(), None)
-                # the PartitionInfo stays as a tombstone: lock-free query
-                # paths that passed the _pid_alive filter a moment ago may
-                # still deref partitions[pid]/_rv_keys[pid] — nulling the
-                # slot would crash them.  Liveness is _pid_alive alone;
-                # the slot itself is reclaimed after a grace period by
-                # _prune_tombstones (called from flush, under write_lock).
-                self._pid_alive[info.part_id] = False
-                self._evicted_tombstones.append((time.time(), info.part_id))
-                self.resident.drop_part(info.part_id)
-                if self.cardinality_tracker is not None:
-                    sk = info.part_key.shard_key(self.schemas.part)
-                    self.cardinality_tracker.series_stopped(
-                        tuple(sk.get(c, "") for c in
-                              self.schemas.part.options.shard_key_columns))
-                evicted += 1
-                self.stats.evictions += 1
-        if evicted:
-            # evicted keys left part_set — cached key->pid resolutions
-            # (ingest) and group-id entries must not outlive them
-            self.keys_epoch += 1
-            self._key_resolve_cache.clear()
-        return evicted
+        Candidates come from one vectorized index sweep; the per-partition
+        teardown then runs in fixed-size increments of `max_per_lock`,
+        releasing the write lock between increments so a mass-expiry
+        (deploy churn ending 100k series at once) can't stall concurrent
+        ingest and query-snapshot fallbacks behind a single multi-second
+        sweep — the eviction-shaped p99 tail the r5 soak exposed.  Evicted
+        pids join the tombstone queue; _prune_tombstones reclaims them
+        after the reader grace period."""
+        total = 0
+        while True:
+            with self._write_locked("evict_ended"):
+                cand = self.index.ended_pids(before_ms)
+                batch = cand[:max_per_lock]
+                evicted = 0
+                for pid in batch.tolist():
+                    info = self.partitions[pid]
+                    if info is None or not self._pid_alive[pid]:
+                        continue
+                    self.index.remove_partition(pid)
+                    self.part_set.pop(info.part_key.to_bytes(), None)
+                    # the PartitionInfo stays as a tombstone: lock-free
+                    # query paths that passed the _pid_alive filter a
+                    # moment ago may still deref partitions[pid] /
+                    # _rv_keys[pid] — nulling the slot would crash them.
+                    # Liveness is _pid_alive alone; the slot itself is
+                    # reclaimed after a grace period by _prune_tombstones
+                    # (called from flush, under write_lock).
+                    self._pid_alive[pid] = False
+                    self._evicted_tombstones.append((time.time(), pid))
+                    self.resident.drop_part(pid)
+                    if self.cardinality_tracker is not None:
+                        sk = info.part_key.shard_key(self.schemas.part)
+                        self.cardinality_tracker.series_stopped(
+                            tuple(sk.get(c, "") for c in
+                                  self.schemas.part.options.shard_key_columns))
+                    evicted += 1
+                    self.stats.evictions += 1
+                if evicted:
+                    # evicted keys left part_set — cached key->pid
+                    # resolutions (ingest) and group-id entries must not
+                    # outlive them
+                    self.keys_epoch += 1
+                    self._key_resolve_cache.clear()
+                total += evicted
+                if cand.size <= max_per_lock:
+                    return total
 
     @property
     def num_partitions(self) -> int:

@@ -481,7 +481,11 @@ def _num_param(params: Dict[str, str], key: str,
         raise _BadRequest(f"parameter {key!r} is not a number: {raw!r}")
 
 
-_DURATION_RE = re.compile(r"(?:\d+(?:\.\d+)?(?:ms|s|m|h|d|w|y))+")
+# the upstream Prometheus duration grammar: units in strictly descending
+# order, each at most once, no fractions — "1h30m" yes, "1.5s"/"1s1s"/"1m1h"
+# 400 (ref: prometheus/common model.ParseDuration; wire parity per ADVICE r5)
+_DURATION_RE = re.compile(
+    r"((\d+)y)?((\d+)w)?((\d+)d)?((\d+)h)?((\d+)m)?((\d+)s)?((\d+)ms)?")
 
 
 def _step_param(raw) -> int:
@@ -493,8 +497,9 @@ def _step_param(raw) -> int:
     except (ValueError, OverflowError, TypeError):
         pass
     s = str(raw)
-    if not _DURATION_RE.fullmatch(s):
-        raise _BadRequest(
+    m = _DURATION_RE.fullmatch(s)
+    if not m or not any(m.groups()):       # all-optional grammar: "" is
+        raise _BadRequest(                 # a match but not a duration
             f"parameter 'step' is not a number or duration: {raw!r}")
     try:
         return max(duration_to_ms(s) // 1000, 1)

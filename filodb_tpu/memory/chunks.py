@@ -13,6 +13,7 @@ optimizes for storage/replay, not random access.
 from __future__ import annotations
 
 import dataclasses
+import itertools
 from typing import Dict, Optional
 
 import numpy as np
@@ -103,14 +104,15 @@ def decode_column(col: ColumnChunk, num_rows: int) -> np.ndarray:
     raise ValueError(f"unknown column chunk kind {col.kind!r}")
 
 
-_next_chunk_id = [0]
+# itertools.count.__next__ is atomic under the GIL — flush encoding runs on
+# a thread pool, and a `x[0] += 1` load/add/store would race there
+_next_chunk_id = itertools.count(1)
 
 
 def make_chunk_id() -> int:
     """Monotonic chunk id (the reference uses timeuuid ordering,
     ref ChunkSetInfo 'id=timeuuid'); monotonicity is what recovery relies on."""
-    _next_chunk_id[0] += 1
-    return _next_chunk_id[0]
+    return next(_next_chunk_id)
 
 
 def encode_chunkset(ts: np.ndarray,

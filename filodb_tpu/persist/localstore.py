@@ -230,9 +230,11 @@ class LocalDiskColumnStore(ColumnStore):
 
     def _append(self, path: str, magic: int, payload: bytes) -> int:
         """Append one frame; returns the frame's file offset."""
-        os.makedirs(os.path.dirname(path), exist_ok=True)
         f = self._files.get(path)
         if f is None:
+            # dir creation only on first open, not per frame — a 1M-chunk
+            # flush rotation was paying 1M redundant makedirs syscalls
+            os.makedirs(os.path.dirname(path), exist_ok=True)
             f = open(path, "ab")
             f.seek(0, os.SEEK_END)   # 'a' mode position is unspecified pre-write
             self._files[path] = f

@@ -40,16 +40,29 @@ class LazyKeys:
     eagerly charged every dashboard poll ~6 ms per 16k series (measured:
     keys_for was 35% of the batched 12-panel hist dashboard's host time)
     for a list nobody indexed.  len()/bool are O(1); iteration, indexing
-    and slicing materialize once and memoize."""
-    __slots__ = ("_shard", "_pids", "_keys")
+    and slicing materialize once and memoize.
+
+    Deferral widens the window in which eviction can recycle a pid
+    between the leaf scan and first key read, so the shard's keys_epoch
+    is captured at construction: if it moved by materialization time the
+    pids may no longer name the snapshot's series — fall back to
+    resolving each pid defensively (keys_for already yields a sentinel
+    key for pruned slots) and count the event so the race is observable
+    instead of silent (ADVICE r5)."""
+    __slots__ = ("_shard", "_pids", "_keys", "_epoch")
 
     def __init__(self, shard, pids):
         self._shard = shard
         self._pids = pids
         self._keys = None
+        self._epoch = shard.keys_epoch
 
     def _mat(self):
         if self._keys is None:
+            if self._shard.keys_epoch != self._epoch:
+                from filodb_tpu.utils.metrics import registry
+                registry.counter("lazykeys_epoch_moved",
+                                 dataset=self._shard.dataset).increment()
             self._keys = self._shard.keys_for(self._pids)
         return self._keys
 

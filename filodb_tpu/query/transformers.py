@@ -562,8 +562,13 @@ def _merge_relabeled(keys, data, fn_name: str):
             out_rows.append(vals[rows[0]])
             continue
         sub = vals[rows]                      # [d, W] or [d, W, B]
-        finite = np.isfinite(sub)
-        present = finite.any(axis=-1) if sub.ndim == 3 else finite
+        # presence is NaN-only (the staleness convention everywhere else:
+        # nonleaf dedup, absent()): +/-Inf is a legal sample value (1/0,
+        # histogram_quantile overflow) and must collide/merge like any
+        # other sample, not vanish (ADVICE r5, medium)
+        present = ~np.isnan(sub)
+        if sub.ndim == 3:
+            present = present.any(axis=-1)
         if (present.sum(axis=0) > 1).any():
             raise ValueError(
                 f"{fn_name}: vector cannot contain metrics with the "

@@ -154,9 +154,11 @@ class MetricsRegistry:
             acc = 0
             for i, b in enumerate(h.bounds):
                 acc += h.counts[i]
-                out.append(f"{name}_bucket{fmt_tags(tags, f'le=\"{b:g}\"')} "
+                le_tag = 'le="%g"' % b
+                out.append(f"{name}_bucket{fmt_tags(tags, le_tag)} "
                            f"{acc}")
-            out.append(f"{name}_bucket{fmt_tags(tags, 'le=\"+Inf\"')} "
+            inf_tag = 'le="+Inf"'
+            out.append(f"{name}_bucket{fmt_tags(tags, inf_tag)} "
                        f"{h.count}")
             out.append(f"{name}_sum{fmt_tags(tags)} {h.sum:g}")
             out.append(f"{name}_count{fmt_tags(tags)} {h.count}")

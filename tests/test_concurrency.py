@@ -207,20 +207,57 @@ def test_eviction_tombstones_pruned_after_grace():
 def test_flush_scheduler_rotates_all_groups():
     ms = TimeSeriesMemStore()
     sh = ms.setup("prometheus", 0)
+    # batching off: this test asserts full rotation coverage, so every
+    # partition (30 samples < the default min_flush_samples) must seal.
+    # sh.config is the process-global settings — restore it (fixture-free
+    # test file, so do it inline)
+    prev_min = sh.config.store.min_flush_samples
+    sh.config.store.min_flush_samples = 0
+    try:
+        sh.ingest(_slice_batch(0, 30), offset=5)
+        from filodb_tpu.core.flush import FlushScheduler
+        sched = FlushScheduler(ms, "prometheus", interval_s=0.01,
+                               headroom=False).start()
+        deadline = time.time() + 20
+        while sched.flushes < sh._groups and time.time() < deadline:
+            time.sleep(0.01)
+        sched.stop(final_flush=False)
+        assert sched.flushes >= sh._groups
+        assert sched.errors == 0
+        # every series sealed: background rotation covered all groups
+        store = sh.stores["prom-counter"]
+        n = store.num_series
+        assert (store.sealed[:n] == store.counts[:n]).all()
+    finally:
+        sh.config.store.min_flush_samples = prev_min
+
+
+def test_flush_batching_skips_small_then_force_seals():
+    """Background flushes with min_samples leave small partitions
+    accumulating (fewer, bigger chunks) and hold the checkpoint back;
+    after 8 skipping rounds the group force-seals and the checkpoint
+    catches up — the bounded-replay-window contract."""
+    ms = TimeSeriesMemStore()
+    sh = ms.setup("prometheus", 0)
     sh.ingest(_slice_batch(0, 30), offset=5)
-    from filodb_tpu.core.flush import FlushScheduler
-    sched = FlushScheduler(ms, "prometheus", interval_s=0.01,
-                           headroom=False).start()
-    deadline = time.time() + 20
-    while sched.flushes < sh._groups and time.time() < deadline:
-        time.sleep(0.01)
-    sched.stop(final_flush=False)
-    assert sched.flushes >= sh._groups
-    assert sched.errors == 0
-    # every series sealed: background rotation covered all groups
     store = sh.stores["prom-counter"]
-    n = store.num_series
-    assert (store.sealed[:n] == store.counts[:n]).all()
+    groups = {p.group for p in sh.partitions if p is not None}
+    g = sorted(groups)[0]
+    # round 1: everything is small -> nothing seals, no checkpoint
+    assert sh.flush_group(g, min_samples=128) == 0
+    assert (store.sealed[:store.num_series] == 0).all()
+    assert g not in sh.meta_store.read_checkpoints("prometheus", 0)
+    # further rounds keep skipping until the 8-round bound forces a full
+    # seal (skip_rounds reaches 7, the next round seals everything)
+    forced = sum(sh.flush_group(g, min_samples=128) for _ in range(7))
+    assert forced > 0
+    cps = sh.meta_store.read_checkpoints("prometheus", 0)
+    assert cps.get(g) == 5
+    # a big partition seals immediately even in batching mode
+    sh.ingest(_slice_batch(30, 200), offset=6)
+    got = sh.flush_group(g, min_samples=128)
+    assert got > 0
+    assert sh.meta_store.read_checkpoints("prometheus", 0).get(g) == 6
 
 
 def test_write_lock_stall_detection():

@@ -1,0 +1,310 @@
+"""Columnar ingest pipeline: grid fast path vs per-record equivalence.
+
+The PR contract: `shard.ingest_columns` (and the grid-shape detection in
+`shard.ingest`) must be observationally identical to flat per-record
+ingest — same stored cells, same encoded chunks at flush, same query
+results — while never running per-row Python on the append path.
+"""
+import numpy as np
+import pytest
+
+from filodb_tpu.core.memstore import TimeSeriesMemStore
+from filodb_tpu.core.records import RecordBatch
+from filodb_tpu.ingest.generator import (counter_batch, gauge_part_keys,
+                                         histogram_batch)
+from filodb_tpu.persist.localstore import (LocalDiskColumnStore,
+                                           LocalDiskMetaStore)
+
+START = 1_600_000_000_000
+
+
+def _grid_data(rng, S, k, jitter=True):
+    ts = START + np.arange(k, dtype=np.int64)[None, :] * 10_000 \
+        + np.zeros((S, 1), dtype=np.int64)
+    if jitter:
+        ts = ts + rng.integers(0, 3, size=(S, k))
+        ts = np.sort(ts, axis=1) + np.arange(k, dtype=np.int64)[None, :]
+    vals = rng.normal(100, 10, size=(S, k))
+    return ts, vals
+
+
+def _record_major_batch(schema, keys, ts2d, vals2d):
+    """The SAME samples flattened record-major (sample j of every series,
+    then sample j+1 ...) — deliberately NOT the grid layout, so this
+    exercises the argsort/cumcount flat path."""
+    S, k = ts2d.shape
+    part_idx = np.tile(np.arange(S, dtype=np.int32), k)
+    ts = ts2d.T.reshape(-1)
+    vals = vals2d.T.reshape(-1)
+    return RecordBatch(schema, keys, part_idx, ts, {"count": vals})
+
+
+def test_columnar_matches_per_record_cells(rng):
+    S, k = 300, 9
+    base = counter_batch(S, 1, start_ms=START)
+    ts2d, vals2d = _grid_data(rng, S, k)
+
+    ms_a = TimeSeriesMemStore()
+    sh_a = ms_a.setup("a", 0)
+    n_a = sh_a.ingest_columns("prom-counter", base.part_keys, ts2d,
+                              {"count": vals2d})
+    ms_b = TimeSeriesMemStore()
+    sh_b = ms_b.setup("b", 0)
+    n_b = sh_b.ingest(_record_major_batch(base.schema, base.part_keys,
+                                          ts2d, vals2d))
+    assert n_a == n_b == S * k
+    st_a, st_b = sh_a.stores["prom-counter"], sh_b.stores["prom-counter"]
+    np.testing.assert_array_equal(st_a.counts[:S], st_b.counts[:S])
+    np.testing.assert_array_equal(st_a.ts[:S, :k], st_b.ts[:S, :k])
+    np.testing.assert_array_equal(st_a.cols["count"][:S, :k],
+                                  st_b.cols["count"][:S, :k])
+
+
+def test_grid_shaped_record_batch_detected(rng):
+    """A grid-shaped RecordBatch through plain shard.ingest must produce
+    the same store state as ingest_columns of the matrices (the detection
+    fast path), including when later batches extend earlier ones."""
+    S, k = 200, 4
+    base = counter_batch(S, 1, start_ms=START)
+    ms_a = TimeSeriesMemStore()
+    sh_a = ms_a.setup("a", 0)
+    ms_b = TimeSeriesMemStore()
+    sh_b = ms_b.setup("b", 0)
+    for i in range(3):
+        ts2d, vals2d = _grid_data(rng, S, k, jitter=False)
+        ts2d = ts2d + i * k * 10_000
+        vals2d = vals2d + i
+        sh_a.ingest_columns("prom-counter", base.part_keys, ts2d,
+                            {"count": vals2d}, offset=i)
+        batch = RecordBatch.from_grid(base.schema, base.part_keys, ts2d,
+                                      {"count": vals2d})
+        assert sh_a._grid_samples(batch) == k
+        sh_b.ingest(batch, offset=i)
+    st_a, st_b = sh_a.stores["prom-counter"], sh_b.stores["prom-counter"]
+    np.testing.assert_array_equal(st_a.counts[:S], st_b.counts[:S])
+    np.testing.assert_array_equal(st_a.ts[:S, :3 * k], st_b.ts[:S, :3 * k])
+    np.testing.assert_array_equal(st_a.cols["count"][:S, :3 * k],
+                                  st_b.cols["count"][:S, :3 * k])
+    assert sh_a.ingested_offset == sh_b.ingested_offset == 2
+
+
+def test_columnar_same_chunks_and_query_results(rng, tmp_path):
+    """End-to-end: flush both pipelines to disk and compare the encoded
+    chunk payloads byte-for-byte, then compare PromQL results."""
+    from filodb_tpu.query.engine import QueryEngine
+
+    S, k = 64, 120
+    base = counter_batch(S, 1, start_ms=START)
+    ts2d, _ = _grid_data(rng, S, k, jitter=False)
+    vals2d = np.cumsum(rng.exponential(5.0, size=(S, k)), axis=1)
+
+    results = {}
+    chunks = {}
+    for name, columnar in (("colmnr", True), ("record", False)):
+        store_dir = str(tmp_path / name)
+        ms = TimeSeriesMemStore(column_store=LocalDiskColumnStore(store_dir),
+                                meta_store=LocalDiskMetaStore(store_dir))
+        sh = ms.setup("ds", 0)
+        if columnar:
+            sh.ingest_columns("prom-counter", base.part_keys, ts2d,
+                              {"count": vals2d}, offset=1)
+        else:
+            sh.ingest(_record_major_batch(base.schema, base.part_keys,
+                                          ts2d, vals2d), offset=1)
+        sh.flush_all_groups()
+        got = {}
+        for info in sh.partitions:
+            css = list(ms.column_store.read_chunks(
+                "ds", 0, info.part_key, START, START + k * 10_000))
+            got[info.part_key.to_bytes()] = [
+                (cs.info.num_rows, cs.info.start_time_ms,
+                 cs.info.end_time_ms,
+                 {c: (col.kind, col.payload, col.base, col.slope)
+                  for c, col in cs.columns.items()})
+                for cs in css]
+        chunks[name] = got
+        eng = QueryEngine("ds", ms)
+        s = START // 1000
+        res = eng.query_range('sum by (_ns_)(rate(request_total[5m]))',
+                              s + 600, 60, s + k * 10)
+        assert res.error is None
+        results[name] = sorted(
+            (str(key), np.asarray(vs).tolist())
+            for key, _, vs in res.series())
+
+    assert chunks["colmnr"] == chunks["record"]
+    assert results["colmnr"] == results["record"]
+
+
+def test_columnar_out_of_order_drops_match_flat(rng):
+    """Rows violating monotonicity degrade per-row to the flat path's
+    per-sample drop semantics; clean rows still land."""
+    S, k = 50, 5
+    base = counter_batch(S, 1, start_ms=START)
+    ts2d, vals2d = _grid_data(rng, S, k, jitter=False)
+
+    ms_a = TimeSeriesMemStore()
+    sh_a = ms_a.setup("a", 0)
+    ms_b = TimeSeriesMemStore()
+    sh_b = ms_b.setup("b", 0)
+    for sh, col in ((sh_a, True), (sh_b, False)):
+        if col:
+            sh.ingest_columns("prom-counter", base.part_keys, ts2d,
+                              {"count": vals2d})
+        else:
+            sh.ingest(_record_major_batch(base.schema, base.part_keys,
+                                          ts2d, vals2d))
+    # second round: half the rows re-send the SAME timestamps (drop),
+    # half advance cleanly
+    ts2 = ts2d.copy()
+    ts2[::2] += k * 10_000
+    for sh, col in ((sh_a, True), (sh_b, False)):
+        if col:
+            n = sh.ingest_columns("prom-counter", base.part_keys, ts2,
+                                  {"count": vals2d})
+        else:
+            n = sh.ingest(_record_major_batch(base.schema, base.part_keys,
+                                              ts2, vals2d))
+        assert n == (S // 2) * k
+    st_a, st_b = sh_a.stores["prom-counter"], sh_b.stores["prom-counter"]
+    np.testing.assert_array_equal(st_a.counts[:S], st_b.counts[:S])
+    np.testing.assert_array_equal(st_a.ts[:S, :2 * k], st_b.ts[:S, :2 * k])
+    assert sh_a.stats.rows_dropped == sh_b.stats.rows_dropped
+
+
+def test_columnar_histograms(rng):
+    S, k, B = 24, 6, 8
+    hb = histogram_batch(S, 1, start_ms=START)
+    ts2d = START + np.arange(k, dtype=np.int64)[None, :] * 10_000 \
+        + np.zeros((S, 1), dtype=np.int64)
+    hist = rng.poisson(3.0, size=(S, k, B)).cumsum(axis=1).cumsum(axis=2) \
+        .astype(np.float64)
+    cnt = hist[:, :, -1].copy()
+    sm = cnt * 3.0
+    les = np.asarray(hb.bucket_les)
+
+    ms_a = TimeSeriesMemStore()
+    sh_a = ms_a.setup("a", 0)
+    n = sh_a.ingest_columns("prom-histogram", hb.part_keys, ts2d,
+                            {"sum": sm, "count": cnt, "h": hist},
+                            bucket_les=les)
+    assert n == S * k
+    ms_b = TimeSeriesMemStore()
+    sh_b = ms_b.setup("b", 0)
+    flat = RecordBatch.from_grid(hb.schema, hb.part_keys, ts2d,
+                                 {"sum": sm, "count": cnt, "h": hist},
+                                 bucket_les=les)
+    assert sh_b.ingest(flat) == S * k
+    st_a, st_b = sh_a.stores["prom-histogram"], sh_b.stores["prom-histogram"]
+    np.testing.assert_array_equal(st_a.cols["h"][:S, :k], hist)
+    np.testing.assert_array_equal(st_a.cols["h"][:S, :k],
+                                  st_b.cols["h"][:S, :k])
+
+
+def test_duplicate_keys_fall_back_correctly(rng):
+    """Duplicate part keys alias one pid — the grid path must detect this
+    and degrade to the flat path's cumcount semantics, appending all
+    samples of the duplicated series in order."""
+    keys = gauge_part_keys(4)
+    dup_keys = [keys[0], keys[1], keys[0], keys[2]]     # keys[0] twice
+    base = counter_batch(1, 1, start_ms=START)
+    ts2d = START + (np.arange(2, dtype=np.int64)[None, :] * 10_000
+                    + np.asarray([[0], [0], [20_000], [0]], dtype=np.int64))
+    vals2d = rng.normal(size=(4, 2))
+    ms = TimeSeriesMemStore()
+    sh = ms.setup("a", 0)
+    n = sh.ingest_columns("prom-counter", dup_keys, ts2d, {"count": vals2d})
+    assert n == 8
+    st = sh.stores["prom-counter"]
+    # the duplicated series holds all 4 of its samples, time-ascending
+    row0 = sh.partitions[0].row
+    assert st.counts[row0] == 4
+    assert (np.diff(st.ts[row0, :4]) > 0).all()
+
+
+def test_quota_hole_retry_uses_right_first_ts(rng):
+    """A quota-rejected series leaves a -1 hole mid-table; when a later
+    batch retries it (quota raised), partition creation must read THAT
+    key's first timestamp, not a positionally-compacted array (which
+    either crashes or steals another series' start time)."""
+    from filodb_tpu.core.ratelimit import QuotaReachedException
+
+    S, k = 8, 3
+    base = counter_batch(S, 1, start_ms=START)
+    ms = TimeSeriesMemStore()
+    sh = ms.setup("a", 0)
+
+    class OneShotQuota:
+        def __init__(self, reject_at):
+            self.reject_at = reject_at
+            self.calls = 0
+
+        def series_created(self, key):
+            self.calls += 1
+            if self.calls == self.reject_at:
+                raise QuotaReachedException(key, 1)
+
+        def series_stopped(self, key):
+            pass
+
+        def flush(self):
+            pass
+
+    sh.cardinality_tracker = OneShotQuota(reject_at=6)   # key index 5
+    ts2d, vals2d = _grid_data(rng, S, k, jitter=False)
+    n = sh.ingest_columns("prom-counter", base.part_keys, ts2d,
+                          {"count": vals2d})
+    assert n == (S - 1) * k and sh.stats.quota_dropped == 1
+    # retry batch: the hole at index 5 resolves now, with ITS start time
+    ts2 = ts2d + k * 10_000
+    n2 = sh.ingest_columns("prom-counter", base.part_keys, ts2,
+                           {"count": vals2d})
+    assert n2 == S * k
+    pid = sh.part_set[base.part_keys[5].to_bytes()]
+    assert sh.index.start_time(pid) == int(ts2[5, 0])
+
+
+def test_grid_fallback_eviction_repositions_clean_rows(rng):
+    """A mixed batch whose dirty rows trigger store-wide eviction through
+    the flat fallback must re-derive the clean rows' append positions —
+    stale positions would land outside the live window (data loss)."""
+    from filodb_tpu.core.blockstore import DenseSeriesStore
+    from filodb_tpu.core.schemas import DEFAULT_SCHEMAS
+
+    store = DenseSeriesStore(DEFAULT_SCHEMAS["prom-counter"],
+                             initial_series=2, initial_time=16,
+                             max_time_cap=64)
+    r0, r1 = store.new_row(), store.new_row()
+    # row1 near the cap, row0 short; everything sealed (evictable)
+    ts1 = START + np.arange(60, dtype=np.int64) * 10
+    store.append_batch(np.full(60, r1, dtype=np.int64), ts1,
+                       {"count": np.ones(60)})
+    ts0 = START + np.arange(10, dtype=np.int64) * 10
+    store.append_batch(np.full(10, r0, dtype=np.int64), ts0,
+                       {"count": np.ones(10)})
+    store.mark_sealed(r0, 10)
+    store.mark_sealed(r1, 60)
+    # grid: row1 out-of-order (re-sends old ts -> flat fallback; its big
+    # appended tail forces eviction), row0 clean and past its last ts
+    kk = 6
+    grid_ts = np.stack([ts0[-1] + (np.arange(kk, dtype=np.int64) + 1) * 10,
+                        ts1[0] + np.arange(kk, dtype=np.int64)])
+    grid_vals = np.full((2, kk), 7.0)
+    n = store.append_grid(np.asarray([r0, r1]), grid_ts,
+                          {"count": grid_vals})
+    assert n >= kk                      # row0's samples all landed
+    c0 = int(store.counts[r0])
+    got = store.ts[r0, :c0]
+    # row0's visible window must END with the new samples, no PAD holes
+    assert (got < np.iinfo(np.int64).max).all()
+    assert int(got[-1]) == int(grid_ts[0, -1])
+    assert np.isin(grid_ts[0], got).all()
+
+
+def test_ingest_columns_validates_shape():
+    base = counter_batch(4, 1, start_ms=START)
+    ms = TimeSeriesMemStore()
+    sh = ms.setup("a", 0)
+    with pytest.raises(ValueError):
+        sh.ingest_columns("prom-counter", base.part_keys,
+                          np.zeros(8, dtype=np.int64), {"count": np.zeros(8)})

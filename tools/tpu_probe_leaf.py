@@ -123,7 +123,9 @@ def main():
     if plat == "cpu":
         print("refusing: cpu backend")
         sys.exit(2)
-    DOC["platform"] = "tpu"
+    # record the REAL backend, like tools/tpu_chain.py — a GPU run must
+    # not mislabel the artifact (the tunneled TPU registers as 'axon')
+    DOC["platform"] = "tpu" if plat == "axon" else plat
     DOC["device"] = str(jax.devices()[0])
     for name, S in (("probe_262k", 262_144), ("probe_1m", 1_048_576)):
         probe_shape(jax, jnp, name, S)
