@@ -581,6 +581,134 @@ def measure_dashboard_batch(platform):
     return st
 
 
+def measure_query_frontend(quick=False, series=None, iters=7):
+    """Query-serving frontend (PR 2): cached re-poll latency and
+    concurrent dashboard-repeat QPS against the sequential no-frontend
+    baseline, on one live store at the 262k-series acceptance scale
+    (8k under --quick).
+
+    Two numbers ride into the one-line JSON:
+      cached_repoll_p50_s — warm identical re-poll through the frontend
+        (result-cache hit) vs cold_p50_s (cache cleared per iteration;
+        kernel/mirror caches warm in both, so the delta is the frontend's)
+      concurrent_qps — 8 threads polling one dashboard panel through the
+        frontend (singleflight + cache) vs sequential_baseline_qps (one
+        thread straight into the engine: the pre-frontend serving path)
+    """
+    import threading
+
+    import numpy as np
+
+    from filodb_tpu.core.memstore import TimeSeriesMemStore
+    from filodb_tpu.ingest.generator import counter_batch
+    from filodb_tpu.query.engine import QueryEngine
+    from filodb_tpu.query.frontend import QueryFrontend
+    from filodb_tpu.query.rangevector import PlannerParams
+    from filodb_tpu.utils.metrics import registry
+
+    S = series or (8_192 if quick else 262_144)
+    T = 120                              # 20 min of 10s scrapes
+    START = 1_600_000_000_000
+    ms = TimeSeriesMemStore()
+    sh = ms.setup("bench_frontend", 0)
+    base = counter_batch(S, 1, start_ms=START)
+    row_base = np.arange(S, dtype=np.float64)[:, None]
+    for t0 in range(0, T, 40):
+        n = min(40, T - t0)
+        ts2d = np.broadcast_to(
+            START + (t0 + np.arange(n, dtype=np.int64)) * 10_000, (S, n))
+        vals = (t0 + np.arange(n, dtype=np.float64))[None, :] * 5.0 \
+            + row_base
+        sh.ingest_columns("prom-counter", base.part_keys, ts2d,
+                          {"count": vals}, offset=t0)
+    eng = QueryEngine("bench_frontend", ms)
+    fe = QueryFrontend(eng)
+    pp = PlannerParams(sample_limit=2_000_000_000, scan_limit=2_000_000_000)
+    q = 'sum by (_ns_)(rate(request_total[5m]))'
+    s = START // 1000
+    start_s, end_s = s + 600, s + (T - 1) * 10   # end == newest sample
+    r = fe.query_range(q, start_s, 60, end_s, pp)      # warm everything
+    if r.error:
+        return {"series": S, "error": r.error[:200]}
+    st = {"series": S, "samples_per_series": T, "result_series":
+          r.num_series}
+
+    def p50(fn, n=iters):
+        ts = []
+        for _ in range(n):
+            t0 = time.perf_counter()
+            res = fn()
+            ts.append(time.perf_counter() - t0)
+            assert res.error is None, res.error
+        ts.sort()
+        return ts[len(ts) // 2]
+
+    def cold():
+        if fe.cache is not None:
+            fe.cache.clear()
+        return fe.query_range(q, start_s, 60, end_s, pp)
+
+    st["cold_p50_s"] = round(p50(cold), 5)
+    fe.query_range(q, start_s, 60, end_s, pp)          # fill the cache
+    st["cached_repoll_p50_s"] = round(
+        p50(lambda: fe.query_range(q, start_s, 60, end_s, pp)), 5)
+    st["repoll_ratio"] = round(
+        st["cached_repoll_p50_s"] / max(st["cold_p50_s"], 1e-9), 4)
+
+    # --- concurrent dashboard-repeat QPS vs the pre-frontend baseline ---
+    dur_s = 4.0 if quick else 8.0
+
+    def pump(fn):
+        stop_t = time.perf_counter() + dur_s
+        n = 0
+        while time.perf_counter() < stop_t:
+            res = fn()
+            assert res.error is None, res.error
+            n += 1
+        return n / dur_s
+
+    # sequential baseline: the serving path before this PR — every poll
+    # pays the full engine cost
+    st["sequential_baseline_qps"] = round(
+        pump(lambda: eng.query_range(q, start_s, 60, end_s, pp)), 1)
+    sf0 = registry.counter("query_singleflight_hits").value
+    counts = []
+    errors = []
+    stop_t = [0.0]
+
+    def client():
+        n = 0
+        while time.perf_counter() < stop_t[0]:
+            res = fe.query_range(q, start_s, 60, end_s, pp)
+            if res.error is not None:
+                # surface, don't swallow: a thread dying silently would
+                # leave a passing-looking concurrent_qps behind
+                errors.append(res.error)
+                break
+            n += 1
+        counts.append(n)
+
+    threads = [threading.Thread(target=client) for _ in range(8)]
+    stop_t[0] = time.perf_counter() + dur_s
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+    if errors:
+        st["error"] = f"concurrent stage: {errors[0]}"[:200]
+        st["concurrent_errors"] = len(errors)
+        return st
+    st["concurrent_qps"] = round(sum(counts) / max(wall, 1e-9), 1)
+    st["concurrent_threads"] = 8
+    st["singleflight_hits"] = int(
+        registry.counter("query_singleflight_hits").value - sf0)
+    st["qps_vs_sequential"] = round(
+        st["concurrent_qps"] / max(st["sequential_baseline_qps"], 1e-9), 1)
+    return st
+
+
 def host_baselines(ts_row, vals, gids, wends, range_ms, span):
     """CPU reference numbers: vectorized numpy, per-window Python-loop
     iterator, and the single-core C iterator (the compiled
@@ -680,6 +808,14 @@ def assemble_result(platform, stages, vec_sps, it_sps, c_sps=0.0,
     db = stages.get("dashboard_batch", {})
     if "speedup_p50" in db:
         result["dashboard_batch_speedup"] = db["speedup_p50"]
+    qf = stages.get("query_frontend", {})
+    for k in ("concurrent_qps", "cached_repoll_p50_s", "cold_p50_s",
+              "sequential_baseline_qps", "qps_vs_sequential",
+              "repoll_ratio"):
+        if k in qf:
+            # the PR-2 serving acceptance pair (+ context): concurrent
+            # dashboard QPS through the frontend and the warm re-poll p50
+            result[k] = qf[k]
     ns = stages.get("north_star_1m") or stages.get("cpu_north_star_1m")
     if ns and "samples_per_sec" in ns:
         result.update({
@@ -803,6 +939,14 @@ def run_worker(args):
         except Exception as e:  # noqa: BLE001 — must not sink the run
             writer.stage("dashboard_batch",
                          {"error": f"{type(e).__name__}: {e}"[:300]})
+
+    try:
+        qf = measure_query_frontend(quick=quick)
+        writer.stage("query_frontend", qf)
+        stages["query_frontend"] = qf
+    except Exception as e:  # noqa: BLE001 — must not sink the run
+        writer.stage("query_frontend",
+                     {"error": f"{type(e).__name__}: {e}"[:300]})
 
     result = assemble_result(platform, stages, vec_sps, it_sps,
                              c_sps)

@@ -31,6 +31,42 @@ def _emit(harness: str, ok: bool, **extra):
     print(json.dumps({"stress": harness, "ok": ok, **extra}), flush=True)
 
 
+def _overlap_flags(sh):
+    """(eviction_in_progress, mirror_rebuild_in_progress) for latency
+    attribution: every recorded query latency is tagged with these so a
+    tail outlier (like SOAK_LONG_r05's 752 s p99) is attributable to its
+    overlapping maintenance window from the artifact alone."""
+    evicting = bool(getattr(sh, "eviction_in_progress", False))
+    rebuilding = any(
+        getattr(getattr(st, "device_mirror", None), "rebuild_in_progress",
+                False)
+        for st in sh.stores.values())
+    return evicting, rebuilding
+
+
+def _flag_breakdown(lat, flags):
+    """Per-overlap-category counts and percentiles from parallel lists of
+    latencies and (evict, rebuild) flag tuples."""
+    import numpy as np
+    cats = {"clean": [], "evict_overlap": [], "rebuild_overlap": []}
+    for dt, (ev, rb) in zip(lat, flags):
+        if rb:
+            cats["rebuild_overlap"].append(dt)
+        elif ev:
+            cats["evict_overlap"].append(dt)
+        else:
+            cats["clean"].append(dt)
+    out = {}
+    for name, vals in cats.items():
+        out[name] = {"n": len(vals)}
+        if vals:
+            arr = np.asarray(vals)
+            out[name]["p50_s"] = round(float(np.percentile(arr, 50)), 4)
+            out[name]["p99_s"] = round(float(np.percentile(arr, 99)), 4)
+            out[name]["max_s"] = round(float(arr.max()), 4)
+    return out
+
+
 def ingestion_stress(minutes: float, series: int = 5_000) -> bool:
     """Continuous ingest + background flush + memory enforcement; asserts
     zero drops/errors and a stable RSS after warm-up (the
@@ -350,6 +386,7 @@ def north_star_soak(minutes: float, series: int = 1_048_576,
     stop = threading.Event()
     state = {"t_idx": 0, "ingested": 0, "iters": 0}
     lat: List[float] = []
+    lat_flags: List[tuple] = []
     errors: List[str] = []
     troughs: List[float] = []
     last_evictions = 0
@@ -419,14 +456,17 @@ def north_star_soak(minutes: float, series: int = 1_048_576,
             if hi <= lo:
                 time.sleep(1.0)
                 continue
+            f0 = _overlap_flags(sh)
             t0 = time.perf_counter()
             res = eng.query_range(
                 'sum by (_ns_)(rate(request_total[5m]))', lo, 60, hi, pp)
             dt = time.perf_counter() - t0
+            f1 = _overlap_flags(sh)
             if res.error is not None:
                 errors.append(res.error)
                 return
             lat.append(dt)
+            lat_flags.append((f0[0] or f1[0], f0[1] or f1[1]))
             for _, _, vs in res.series():
                 arr = np.asarray(vs)
                 finite = arr[np.isfinite(arr)]
@@ -495,6 +535,9 @@ def north_star_soak(minutes: float, series: int = 1_048_576,
         "query_p50_idle_s": round(idle_p50, 3),
         "query_p50_s": round(p50_under, 3),
         "query_p99_s": round(float(np.nanpercentile(larr, 99)), 3),
+        # overlap-tagged breakdown: tail outliers are attributable to
+        # their eviction / mirror-rebuild window from the artifact alone
+        "query_overlap_breakdown": _flag_breakdown(lat, lat_flags),
         "under_ingest_vs_idle": round(p50_under / idle_p50, 2)
         if idle_p50 and np.isfinite(idle_p50) else None,
         "cpu_cores": os.cpu_count(),
@@ -510,10 +553,175 @@ def north_star_soak(minutes: float, series: int = 1_048_576,
     return ok
 
 
+def eviction_window_soak(minutes: float = 2.0, series: int = 20_000,
+                         report_path: str = "SOAK_PR2_EVICT.json") -> bool:
+    """Eviction-window soak (PR 2 acceptance): continuous frontend queries
+    while memory enforcement repeatedly shifts store rows (shift_version
+    bumps -> full DeviceMirror rebuilds).  Every latency is tagged with
+    overlap flags, and the harness asserts STRUCTURALLY that no query
+    thread ever ran a post-eviction full `_refresh` — queries must ride
+    the host-gather fallback while the rebuild happens in the background
+    (the SOAK_LONG_r05 752 s p99 was one query paying that rebuild
+    inline)."""
+    import numpy as np
+
+    from filodb_tpu.core.devicecache import DeviceMirror
+    from filodb_tpu.core.memstore import TimeSeriesMemStore
+    from filodb_tpu.ingest.generator import counter_batch
+    from filodb_tpu.query.engine import QueryEngine
+    from filodb_tpu.query.frontend import QueryFrontend
+    from filodb_tpu.query.rangevector import PlannerParams
+    from filodb_tpu.utils.metrics import registry
+
+    START = 1_600_000_000_000
+    ms = TimeSeriesMemStore()
+    sh = ms.setup("stress", 0)
+    base = counter_batch(series, 1, start_ms=START)
+    warm = 240
+    row_base = np.arange(series, dtype=np.float64)[:, None]
+
+    def ingest_slab(t_idx, n):
+        ts2d = np.broadcast_to(
+            START + (t_idx + np.arange(n, dtype=np.int64)) * 10_000,
+            (series, n))
+        vals = (t_idx + np.arange(n, dtype=np.float64))[None, :] * 5.0 \
+            + row_base
+        sh.ingest_columns("prom-counter", base.part_keys, ts2d,
+                          {"count": vals}, offset=t_idx)
+
+    for t0 in range(0, warm, 60):
+        ingest_slab(t0, min(60, warm - t0))
+    # budget sized so enforcement fires repeatedly as the stream grows;
+    # each enforcement truncates to the active tail = a shift_version bump
+    budget = int(sum(s.nbytes for s in sh.stores.values()) * 0.75)
+    tail_rows = warm // 2
+
+    eng = QueryEngine("stress", ms)
+    fe = QueryFrontend(eng)
+    pp = PlannerParams(sample_limit=2_000_000_000, scan_limit=2_000_000_000)
+    s = START // 1000
+    stop = threading.Event()
+    state = {"t_idx": warm}
+    errors: List[str] = []
+    lat: List[float] = []
+    flags: List[tuple] = []
+
+    # structural instrumentation: record which THREAD runs every full
+    # mirror upload and whether it was the post-eviction (shift moved)
+    # case — those must only ever run on mirror-rebuild threads
+    refresh_calls: List[dict] = []
+    orig_refresh = DeviceMirror._refresh
+
+    def traced_refresh(self, store):
+        snap = self._snap
+        refresh_calls.append({
+            "thread": threading.current_thread().name,
+            "shift_moved": bool(snap is not None and
+                                snap.shift_version != store.shift_version)})
+        return orig_refresh(self, store)
+
+    DeviceMirror._refresh = traced_refresh
+
+    def ingester():
+        while not stop.is_set():
+            ingest_slab(state["t_idx"], 5)
+            state["t_idx"] += 5
+            time.sleep(0.05)
+
+    def evictor():
+        while not stop.is_set():
+            time.sleep(8.0)
+            try:
+                sh.enforce_memory(budget, tail_rows)
+            except Exception as e:  # noqa: BLE001 — soak must report it
+                errors.append(f"evictor: {type(e).__name__}: {e}")
+                return
+
+    def querier():
+        q = 'sum by (_ns_)(rate(request_total[5m]))'
+        while not stop.is_set() and not errors:
+            # step-aligned poll grid (Grafana aligns start/end to the
+            # step): sliding re-polls share a window grid, so the result
+            # cache serves the frozen prefix and computes only the tail
+            hi = s + (state["t_idx"] * 10 // 60) * 60
+            lo = max(s + 600, hi - 600)
+            f0 = _overlap_flags(sh)
+            t0 = time.perf_counter()
+            res = fe.query_range(q, lo, 60, hi, pp)
+            dt = time.perf_counter() - t0
+            f1 = _overlap_flags(sh)
+            if res.error is not None:
+                errors.append(res.error)
+                return
+            lat.append(dt)
+            flags.append((f0[0] or f1[0], f0[1] or f1[1]))
+            time.sleep(0.1)
+
+    fe.query_range('sum by (_ns_)(rate(request_total[5m]))',
+                   s + 600, 60, s + warm * 10, pp)       # warm the mirror
+    bg0 = registry.counter("device_mirror_bg_rebuilds").value
+    fb0 = registry.counter("device_mirror_query_fallbacks").value
+    threads = [threading.Thread(target=fn, daemon=True)
+               for fn in (ingester, evictor, querier)]
+    try:
+        for t in threads:
+            t.start()
+        time.sleep(minutes * 60)
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=60)
+        DeviceMirror._refresh = orig_refresh
+
+    bg_rebuilds = int(
+        registry.counter("device_mirror_bg_rebuilds").value - bg0)
+    fallbacks = int(
+        registry.counter("device_mirror_query_fallbacks").value - fb0)
+    # the acceptance invariant: every post-eviction full upload ran on a
+    # background rebuild thread, never on a query's critical path
+    inline_shift_refreshes = [
+        c for c in refresh_calls
+        if c["shift_moved"] and not c["thread"].startswith("mirror-rebuild")]
+    larr = np.asarray(lat) if lat else np.asarray([float("nan")])
+    ok = (not errors and len(lat) > 10 and bg_rebuilds >= 1
+          and fallbacks >= 1 and not inline_shift_refreshes)
+    report = {
+        "stress": "eviction_window_soak", "ok": ok, "series": series,
+        "minutes": round(minutes, 1), "queries": len(lat),
+        "errors": errors[:3],
+        "query_p50_s": round(float(np.nanpercentile(larr, 50)), 4),
+        "query_p99_s": round(float(np.nanpercentile(larr, 99)), 4),
+        "query_max_s": round(float(np.nanmax(larr)), 4),
+        "query_overlap_breakdown": _flag_breakdown(lat, flags),
+        "mirror_bg_rebuilds": bg_rebuilds,
+        "mirror_query_fallbacks": fallbacks,
+        "full_refresh_calls": len(refresh_calls),
+        "inline_shift_refreshes": inline_shift_refreshes,
+        "result_cache_invalidations": int(registry.counter(
+            "query_result_cache_invalidations").value),
+        "result_cache_partial_hits": int(registry.counter(
+            "query_result_cache_partial_hits").value),
+        "evictions": sh.stats.evictions,
+        "rss_mb": round(_rss_mb(), 1),
+        # every latency, tagged (ms, evict_overlap, rebuild_overlap):
+        # tail outliers are attributable from the artifact alone
+        "query_latencies_tagged": [
+            [round(dt * 1000, 1), int(ev), int(rb)]
+            for dt, (ev, rb) in zip(lat, flags)],
+    }
+    print(json.dumps({k: v for k, v in report.items()
+                      if k != "query_latencies_tagged"}), flush=True)
+    if report_path:
+        with open(report_path, "w") as f:
+            json.dump(report, f, indent=1)
+    return ok
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description="filodb-tpu stress harnesses")
     ap.add_argument("harness",
-                    choices=["ingest", "query", "batch", "soak", "all"])
+                    choices=["ingest", "query", "batch", "soak", "evict",
+                             "all"])
     ap.add_argument("--minutes", type=float, default=10.0)
     ap.add_argument("--series", type=int, default=1_048_576)
     ap.add_argument("--report", default="")
@@ -534,6 +742,11 @@ def main(argv=None):
         ok &= north_star_soak(args.minutes, series=args.series,
                               report_path=args.report,
                               target_ingest_per_s=args.target_rate)
+    if args.harness == "evict":
+        ok &= eviction_window_soak(
+            args.minutes,
+            series=args.series if args.series != 1_048_576 else 20_000,
+            report_path=args.report or "SOAK_PR2_EVICT.json")
     raise SystemExit(0 if ok else 1)
 
 

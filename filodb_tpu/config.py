@@ -58,6 +58,24 @@ class QueryConfig:
     # 0 disables.  Decision is observable: `leaf_host_routed` counter +
     # the execplan span's route tag.
     host_route_max_samples: int = 2_000_000
+    # --- query-serving frontend (query/frontend.py; PR 2) ---
+    # step-aligned incremental result cache (the Thanos/Cortex
+    # query-frontend pattern): a dashboard re-poll recomputes only the
+    # windows past the append horizon and merges them with the cached
+    # prefix.  Invalidation: shard keys_epoch / index.mutations changes
+    # drop entries; append-only ingest only shrinks the reusable prefix.
+    result_cache_enabled: bool = True
+    result_cache_max_entries: int = 256
+    # per-entry size cap — raw-selector queries over huge working sets
+    # must not pin the result set in host RAM (aggregated dashboards do)
+    result_cache_max_entry_bytes: int = 32 << 20
+    # byte-identical in-flight query_range requests share ONE execution
+    # (singleflight dedup; `query_singleflight_hits` counts the shares)
+    singleflight_enabled: bool = True
+    # bound on concurrently EXECUTING queries (cache hits and dedup'd
+    # followers don't count): keeps N dashboard fanouts from stampeding
+    # the device dispatch path.  0 = unbounded.
+    max_concurrent_queries: int = 8
 
 
 @dataclasses.dataclass
@@ -92,6 +110,13 @@ class StoreConfig:
     resident_cache_bytes: int = 256 << 20
     # samples per series retained dense after memory enforcement
     active_tail_rows: int = 512
+    # run the post-eviction full DeviceMirror re-upload on a background
+    # thread instead of the first query's critical path (queries host-
+    # gather until the new snapshot publishes) — the 752 s eviction-window
+    # query p99 in SOAK_LONG_r05 was one query paying a 1M-series
+    # re-upload inline.  Incremental (append-only) refreshes and the
+    # cold first build stay inline.
+    mirror_background_rebuild: bool = True
 
 
 @dataclasses.dataclass

@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import dataclasses
 import itertools
+import threading
 from typing import Dict, Optional, Tuple
 
 import numpy as np
@@ -121,6 +122,11 @@ class DeviceMirror:
         # process-unique identity for external caches: id() can be reused
         # by a later allocation after this mirror is collected
         self.serial = next(_mirror_serial)
+        # background full-rebuild state (post-eviction shift_version bumps:
+        # the O(S*T) re-upload runs here, never on a query's critical path
+        # — see request_background_refresh)
+        self._bg_lock = threading.Lock()
+        self._bg_thread: Optional[threading.Thread] = None
 
     def _nbytes(self, store) -> int:
         t = max(store.time_used, 1)
@@ -224,11 +230,62 @@ class DeviceMirror:
             try:
                 if self._refresh_incremental(store, snap):
                     return True
-            except Exception:  # noqa: BLE001 — incremental is an optimization
-                from filodb_tpu.utils.metrics import registry
+            except Exception as e:  # noqa: BLE001 — incremental is an
+                # optimization, but its failures must be DIAGNOSABLE: a
+                # bare counter hid incremental-path regressions in soaks
+                # (every query silently re-paying the full upload)
+                from filodb_tpu.utils.metrics import (log_error_once,
+                                                      registry)
                 registry.counter(
                     "device_mirror_incremental_errors").increment()
+                log_error_once("device_mirror_incremental", e)
         return self._refresh(store)
+
+    # ------------------------------------------------- background rebuild
+
+    def can_update_inline(self, store) -> bool:
+        """True when freshness is restorable without an O(S*T) full
+        re-upload: the cold first build (nothing to serve from anyway)
+        and append-only growth (incremental tail upload).  False exactly
+        when eviction/compaction REARRANGED cells (shift_version moved) —
+        the case whose inline cost was the 752 s query p99 in
+        SOAK_LONG_r05."""
+        snap = self._snap
+        return snap is None or snap.shift_version == store.shift_version
+
+    @property
+    def rebuild_in_progress(self) -> bool:
+        t = self._bg_thread
+        return t is not None and t.is_alive()
+
+    def request_background_refresh(self, shard, store) -> bool:
+        """Kick off (at most one) background full rebuild; returns True if
+        this call started it.  Queries keep serving via the host-gather
+        fallback until the new snapshot publishes; the rebuild takes the
+        shard write lock only for its host-copy + upload, exactly like
+        the inline path did — just not on any query's critical path."""
+        with self._bg_lock:
+            if self._bg_thread is not None and self._bg_thread.is_alive():
+                return False
+            t = threading.Thread(target=self._bg_refresh,
+                                 args=(shard, store), daemon=True,
+                                 name=f"mirror-rebuild-{self.serial}")
+            self._bg_thread = t
+            t.start()
+            return True
+
+    def _bg_refresh(self, shard, store) -> None:
+        from filodb_tpu.utils.metrics import (log_error_once, registry,
+                                              span)
+        try:
+            with span("mirror_bg_rebuild"):
+                with shard._write_locked("mirror_bg_rebuild"):
+                    ok = self.ensure_fresh(store)
+            if ok:
+                registry.counter("device_mirror_bg_rebuilds").increment()
+        except Exception as e:  # noqa: BLE001 — queries already fall back
+            registry.counter("device_mirror_bg_rebuild_errors").increment()
+            log_error_once("device_mirror_bg_rebuild", e)
 
     def _refresh_incremental(self, store, snap: _MirrorSnapshot) -> bool:
         """Upload only the appended tail cells.  Sound exactly when nothing

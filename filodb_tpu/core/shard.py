@@ -21,6 +21,11 @@ from typing import Callable, Dict, Iterable, List, Optional, Sequence, Tuple
 _log = logging.getLogger("filodb.shard")
 
 _SHARD_KEYS_SERIAL = itertools.count(1)  # see TimeSeriesShard.keys_serial
+
+# append_horizon_ms sentinel: "nothing is immutable" (a registered row
+# with zero samples accepts arbitrary-time appends).  Shared with the
+# query frontend's cache-bypass check — one constant, not two literals.
+NO_HORIZON_MS = -(1 << 62)
 _KEY_RESOLVE_CACHE_MAX = 4               # live key tables per shard (schemas)
 _LOOKUP_CACHE_MAX = 32                   # memoized lookup_partitions results
 
@@ -215,6 +220,12 @@ class TimeSeriesShard:
         # A deque: mass-expiry pushes 100k+ entries and list.pop(0) would
         # make the prune quadratic
         self._evicted_tombstones: collections.deque = collections.deque()
+        # overlap flag for latency attribution (bench/stress soaks tag
+        # each recorded query with it): True while an eviction sweep or
+        # memory enforcement is tearing down partitions / shifting rows
+        self.eviction_in_progress = False
+        # append_horizon_ms memo: store name -> (generation, horizon)
+        self._horizon_memo: Dict[str, tuple] = {}
 
     # --------------------------------------------------------------- locking
 
@@ -974,6 +985,40 @@ class TimeSeriesShard:
         """Store rows for a pid array — vectorized pid->row map."""
         return self._pid_row[pids]
 
+    def append_horizon_ms(self) -> int:
+        """Largest timestamp T such that every FUTURE append lands strictly
+        after T: the min over rows of each row's newest sample (ingest
+        drops out-of-order samples against last_ts, so appends only move
+        forward).  The query frontend's result cache treats windows ending
+        at or before T as immutable.  Registered rows with zero samples
+        accept arbitrary timestamps, so their presence collapses the
+        horizon (NO_HORIZON_MS; series-SET changes are tracked separately
+        via keys_epoch/index.mutations).
+
+        Memoized per store generation: the frontend calls this on EVERY
+        request including sub-ms cache hits, and the O(S) scan would
+        dominate the hit path at 262k+ series.  A torn scan racing a
+        mutation is still sound (each per-row read lower-bounds that
+        row's future appends) and the memo self-heals on the next
+        generation tick."""
+        horizon = None
+        # list(): runs lock-free on query threads while ingest may insert
+        # a new schema store — don't iterate the live dict
+        for name, store in list(self.stores.items()):
+            s = store.num_series
+            if s == 0:
+                continue
+            gen = store.generation
+            memo = self._horizon_memo.get(name)
+            if memo is not None and memo[0] == gen:
+                h = memo[1]
+            else:
+                h = (NO_HORIZON_MS if (store.counts[:s] == 0).any()
+                     else int(store.last_ts[:s].min()))
+                self._horizon_memo[name] = (gen, h)
+            horizon = h if horizon is None else min(horizon, h)
+        return horizon if horizon is not None else NO_HORIZON_MS
+
     def keys_for(self, pids: np.ndarray) -> List:
         """RangeVectorKeys for a pid array, built once per partition lifetime
         and cached — repeat queries do list indexing, not dict construction
@@ -1280,6 +1325,13 @@ class TimeSeriesShard:
                                shard=str(self.shard_num)).update(dense)
         if dense <= budget:
             return 0
+        self.eviction_in_progress = True
+        try:
+            return self._enforce_memory_inner(budget, tail)
+        finally:
+            self.eviction_in_progress = False
+
+    def _enforce_memory_inner(self, budget: int, tail: int) -> int:
         # Seal everything OUTSIDE the write lock: flush manages its own
         # lock phases (copy/seal brief, encode+persist lock-free).  The
         # old whole-enforcement write_lock hold spanned this full forced
@@ -1320,6 +1372,13 @@ class TimeSeriesShard:
         sweep — the eviction-shaped p99 tail the r5 soak exposed.  Evicted
         pids join the tombstone queue; _prune_tombstones reclaims them
         after the reader grace period."""
+        self.eviction_in_progress = True
+        try:
+            return self._evict_ended_inner(before_ms, max_per_lock)
+        finally:
+            self.eviction_in_progress = False
+
+    def _evict_ended_inner(self, before_ms: int, max_per_lock: int) -> int:
         total = 0
         while True:
             with self._write_locked("evict_ended"):

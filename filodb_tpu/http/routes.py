@@ -32,24 +32,34 @@ class PromHttpApi:
                  gateways: Optional[Dict[str, object]] = None,  # GatewayPipeline per dataset
                  shard_mappers: Optional[Dict[str, object]] = None,
                  default_dataset: Optional[str] = None,
-                 batch_window_ms: Optional[float] = None):
+                 batch_window_ms: Optional[float] = None,
+                 config=None):
         self.engines = engines
         self.gateways = gateways or {}
         self.shard_mappers = shard_mappers or {}
         self.default_dataset = default_dataset or next(iter(engines), None)
-        # server-side micro-batching (query.batch_window_ms > 0):
-        # concurrent query_range requests over one window grid coalesce
-        # into merged kernel dispatches for unmodified dashboard clients.
-        # The window comes from the CALLER's config when given (FiloServer
-        # injects its own FilodbSettings); the settings() singleton is
-        # only the fallback for bare constructions.
-        from filodb_tpu.query.coalesce import QueryCoalescer
-        if batch_window_ms is None:
+        # Query-serving frontend per dataset (query/frontend.py):
+        # singleflight dedup of byte-identical in-flight requests, the
+        # step-aligned incremental result cache, a bounded concurrent
+        # scheduler, and the window-grid coalescer (query.batch_window_ms
+        # > 0: concurrent same-grid requests merge into one
+        # engine.query_range_batch kernel dispatch).  Knobs come from the
+        # CALLER's config when given (FiloServer injects its own
+        # FilodbSettings); the settings() singleton is only the fallback
+        # for bare constructions.
+        from filodb_tpu.query.frontend import QueryFrontend
+        if config is None:
             from filodb_tpu.config import settings
-            batch_window_ms = settings().query.batch_window_ms
-        self.coalescers = {name: QueryCoalescer(eng,
-                                                batch_window_ms / 1000.0)
-                           for name, eng in engines.items()}
+            config = settings()
+        if batch_window_ms is None:
+            batch_window_ms = config.query.batch_window_ms
+        self.frontends = {name: QueryFrontend(eng,
+                                              batch_window_ms / 1000.0,
+                                              config=config)
+                          for name, eng in engines.items()}
+        # back-compat alias (tests/tools reach the coalescer through it)
+        self.coalescers = {name: fe.coalescer
+                          for name, fe in self.frontends.items()}
 
     # ------------------------------------------------------------ dispatch
 
@@ -114,7 +124,7 @@ class PromHttpApi:
             step = _step_param(params.get("step", "15"))
             if params.get("explain") in ("true", "1"):
                 return self._explain(eng, q, start, step, end)
-            res = self.coalescers[dataset].query_range(
+            res = self.frontends[dataset].query_range(
                 q, start, step, end, planner_params)
             payload = QueryEngine.to_prom_matrix(res)
             if res.trace_id:

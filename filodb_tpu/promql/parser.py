@@ -655,10 +655,36 @@ class _Converter:
                              on=m.on, ignoring=m.ignoring, include=include)
 
 
+# Parsed-AST memo: dashboards re-poll the SAME query strings every few
+# seconds with only the time window moving, so the tokenize+parse cost
+# (~0.1-0.5 ms of pure Python per query) is paid once per distinct
+# string, not once per poll.  Safe to share: the parser mutates AST nodes
+# only while building them (offset/@ application); _Converter and every
+# downstream consumer read without mutating.  Bounded LRU under a lock —
+# queries run on HTTP handler threads.
+_AST_CACHE: dict = {}
+_AST_CACHE_MAX = 512
+_AST_LOCK = __import__("threading").Lock()
+
+
+def parse_query_cached(query: str) -> A.Expr:
+    with _AST_LOCK:
+        expr = _AST_CACHE.get(query)
+        if expr is not None:
+            _AST_CACHE[query] = _AST_CACHE.pop(query)     # LRU touch
+            return expr
+    expr = _Parser(query).parse()
+    with _AST_LOCK:
+        _AST_CACHE[query] = expr
+        while len(_AST_CACHE) > _AST_CACHE_MAX:
+            _AST_CACHE.pop(next(iter(_AST_CACHE)))
+    return expr
+
+
 def query_range_to_logical_plan(query: str,
                                 params: TimeStepParams) -> lp.LogicalPlan:
     """ref: Parser.queryRangeToLogicalPlan (parse/Parser.scala:135)."""
-    expr = parse_query(query)
+    expr = parse_query_cached(query)
     return _Converter(params).convert(expr)
 
 

@@ -54,9 +54,15 @@ class QueryEngine:
     def query_range(self, promql: str, start_s: int, step_s: int, end_s: int,
                     planner_params: Optional[PlannerParams] = None
                     ) -> QueryResult:
+        from filodb_tpu.utils.metrics import span
         try:
-            plan = query_range_to_logical_plan(
-                promql, TimeStepParams(start_s, step_s, end_s))
+            # span: the parse share of the fixed per-query floor is
+            # attributable in traces (parse itself is AST-memoized —
+            # promql.parser.parse_query_cached — so re-polled dashboard
+            # strings skip tokenization entirely)
+            with span("query_parse"):
+                plan = query_range_to_logical_plan(
+                    promql, TimeStepParams(start_s, step_s, end_s))
         except Exception as e:  # noqa: BLE001 — parse errors surface in result
             return QueryResult([], error=f"parse error: {e}")
         return self.exec_logical_plan(plan, planner_params)
@@ -148,9 +154,11 @@ class QueryEngine:
     def exec_logical_plan(self, plan: lp.LogicalPlan,
                           planner_params: Optional[PlannerParams] = None
                           ) -> QueryResult:
+        from filodb_tpu.utils.metrics import span
         ctx = self._ctx(planner_params)
         try:
-            ep = self.planner.materialize(plan, ctx)
+            with span("query_plan"):
+                ep = self.planner.materialize(plan, ctx)
         except Exception as e:  # noqa: BLE001
             return QueryResult([], error=f"planning error: {e}")
         if isinstance(plan, lp.MetadataQueryPlan):
@@ -191,14 +199,23 @@ class QueryEngine:
             return {"status": "error", "errorType": "query_error",
                     "error": result.error}
         out = []
-        for key, wends, vals in result.series():
-            if vals.ndim == 2:      # histogram series -> skip buckets here
+        for b in result.blocks:
+            vals = np.asarray(b.values)
+            if vals.ndim != 2:      # histogram series -> skip buckets here
                 continue
-            pairs = [[int(t) / 1000.0, _fmt(v)]
-                     for t, v in zip(wends, vals) if not math.isnan(v)]
-            if pairs:
+            # block-level assembly: one seconds conversion + one NaN mask
+            # per block instead of per-sample Python math — the result-
+            # serialization share of the fixed per-query floor
+            secs = (np.asarray(b.wends, np.int64) / 1000.0).tolist()
+            present = ~np.isnan(vals)
+            for i, key in enumerate(b.keys):
+                idx = np.flatnonzero(present[i]).tolist()
+                if not idx:
+                    continue
+                row = vals[i]
                 out.append({"metric": _prom_labels(key.labels_dict),
-                            "values": pairs})
+                            "values": [[secs[j], _fmt(row[j])]
+                                       for j in idx]})
         payload = {"status": "success",
                    "data": {"resultType": "matrix", "result": out}}
         if result.partial:

@@ -1,0 +1,163 @@
+"""Query-serving frontend: turns a fast single-query engine into fast
+concurrent TRAFFIC.
+
+Three layers wrap one QueryEngine, outermost first (ref: the Cortex/
+Thanos query-frontend split — dedup, result caching and scheduling live
+in front of the querier, not inside it):
+
+  1. singleflight — byte-identical in-flight `query_range` requests
+     share ONE execution (N dashboard clients polling the same panel
+     cost one query; `query_singleflight_hits` counts the shares).
+  2. incremental result cache (query/resultcache.py) — a re-poll
+     computes only the windows past the append horizon and merges them
+     with the cached prefix.
+  3. scheduler — a semaphore bounds concurrently EXECUTING queries
+     (query.max_concurrent_queries), and the window-grid coalescer
+     (query/coalesce.py) still merges same-grid peers into one
+     engine.query_range_batch when query.batch_window_ms > 0.
+
+Cache hits and dedup'd followers never touch the semaphore, so the
+bound applies exactly to the expensive device-dispatching work.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional, Tuple
+
+from filodb_tpu.core.shard import NO_HORIZON_MS
+from filodb_tpu.query.coalesce import QueryCoalescer
+from filodb_tpu.query.resultcache import ResultCache, _plan_cacheable
+
+
+class _Flight:
+    __slots__ = ("done", "result")
+
+    def __init__(self):
+        self.done = threading.Event()
+        self.result = None
+
+
+class QueryFrontend:
+    """Per-dataset serving frontend around one QueryEngine."""
+
+    def __init__(self, engine, window_s: float = 0.0, config=None):
+        if config is None:
+            from filodb_tpu.config import settings
+            config = settings()
+        q = config.query
+        self.engine = engine
+        self.coalescer = QueryCoalescer(engine, window_s)
+        self.cache: Optional[ResultCache] = (
+            ResultCache(q.result_cache_max_entries,
+                        q.result_cache_max_entry_bytes)
+            if q.result_cache_enabled else None)
+        self._sf_enabled = q.singleflight_enabled
+        self._sf_lock = threading.Lock()
+        self._inflight: Dict[Tuple, _Flight] = {}
+        n = q.max_concurrent_queries
+        self._sem = threading.BoundedSemaphore(n) if n > 0 else None
+        self._ask_timeout_s = q.ask_timeout_s
+        # promql -> cacheability memo (parse once per distinct string)
+        self._cacheable: Dict[str, bool] = {}
+
+    # ------------------------------------------------------------ public
+
+    def query_range(self, promql: str, start_s: int, step_s: int,
+                    end_s: int, planner_params=None):
+        if not self._sf_enabled:
+            return self._cached_query(promql, start_s, step_s, end_s,
+                                      planner_params)
+        key = (promql, start_s, step_s, end_s, repr(planner_params))
+        with self._sf_lock:
+            flight = self._inflight.get(key)
+            leader = flight is None
+            if leader:
+                flight = self._inflight[key] = _Flight()
+        if not leader:
+            from filodb_tpu.utils.metrics import registry
+            registry.counter("query_singleflight_hits").increment()
+            # generous bound mirroring the coalescer's: a wedged leader
+            # must not strand followers — they fall back to running solo
+            flight.done.wait(timeout=max(300.0, 3 * self._ask_timeout_s))
+            if flight.result is not None:
+                return flight.result
+            return self._cached_query(promql, start_s, step_s, end_s,
+                                      planner_params)
+        try:
+            res = self._cached_query(promql, start_s, step_s, end_s,
+                                     planner_params)
+            flight.result = res
+            return res
+        finally:
+            with self._sf_lock:
+                if self._inflight.get(key) is flight:
+                    del self._inflight[key]
+            flight.done.set()
+
+    # ----------------------------------------------------------- layers
+
+    def _cached_query(self, promql, start_s, step_s, end_s, pp):
+        cache = self.cache
+        if cache is None or not self._promql_cacheable(promql):
+            return self._run(promql, start_s, step_s, end_s, pp)
+
+        def run(s0, e0):
+            return self._run(promql, s0, step_s, e0, pp)
+
+        return cache.query_range(run, promql, start_s, step_s, end_s,
+                                 repr(pp), self._state())
+
+    def _run(self, promql, start_s, step_s, end_s, pp):
+        sem = self._sem
+        if sem is None:
+            return self.coalescer.query_range(promql, start_s, step_s,
+                                              end_s, pp)
+        # never fail a query on queue pressure: a full queue just means
+        # this request executes unthrottled after the wait (observable
+        # via the counter rather than a user-visible error)
+        acquired = sem.acquire(timeout=self._ask_timeout_s)
+        if not acquired:
+            from filodb_tpu.utils.metrics import registry
+            registry.counter("query_scheduler_timeouts").increment()
+        try:
+            return self.coalescer.query_range(promql, start_s, step_s,
+                                              end_s, pp)
+        finally:
+            if acquired:
+                sem.release()
+
+    def _promql_cacheable(self, promql: str) -> bool:
+        ok = self._cacheable.get(promql)
+        if ok is None:
+            ok = _plan_cacheable(promql)
+            if len(self._cacheable) > 1024:
+                self._cacheable.clear()
+            self._cacheable[promql] = ok
+        return ok
+
+    # ------------------------------------------------------ store state
+
+    def _state(self) -> Optional[Tuple[Tuple, int]]:
+        """(series-set token, append horizon ms) across the engine's local
+        shards, or None when the source can't vouch for them (remote /
+        unknown sources bypass the cache)."""
+        source = getattr(self.engine, "source", None)
+        shards_for = getattr(source, "shards_for", None)
+        if shards_for is None:
+            return None
+        try:
+            shards = shards_for(self.engine.dataset)
+        except Exception:  # noqa: BLE001 — exotic sources: just bypass
+            return None
+        if not shards:
+            return None
+        token = []
+        horizon = None
+        for sh in shards:
+            token.append((sh.keys_serial, sh.keys_epoch,
+                          sh.index.mutations))
+            h = sh.append_horizon_ms()
+            horizon = h if horizon is None else min(horizon, h)
+        if horizon is None or horizon <= NO_HORIZON_MS:
+            return None
+        return tuple(token), horizon

@@ -632,8 +632,27 @@ class MultiSchemaPartitionsExec(LeafExecPlan):
         if mirror is not None:
             ok = mirror.is_fresh(store)
             if not ok:
-                with shard._write_locked("mirror_refresh"):
-                    ok = mirror.ensure_fresh(store)
+                bg = getattr(shard.config.store,
+                             "mirror_background_rebuild", True)
+                if mirror.can_update_inline(store) or not bg:
+                    with shard._write_locked("mirror_refresh"):
+                        # re-check under the lock: an eviction may bump
+                        # shift_version between the unlocked check and
+                        # lock acquisition, and the full rebuild must
+                        # still not run on this query's critical path
+                        if not bg or mirror.can_update_inline(store):
+                            ok = mirror.ensure_fresh(store)
+                if not ok and bg and not mirror.can_update_inline(store):
+                    # eviction rearranged rows (shift_version moved): the
+                    # full O(S*T) re-upload must not run on THIS query's
+                    # critical path — rebuild in the background and serve
+                    # this query via the host windowed gather below
+                    # (eviction-proof serving; SOAK_LONG_r05's 752 s p99
+                    # was one query paying this inline)
+                    mirror.request_background_refresh(shard, store)
+                    from filodb_tpu.utils.metrics import registry as _reg
+                    _reg.counter(
+                        "device_mirror_query_fallbacks").increment()
             if ok:
                 # one snapshot read serves gather AND fused-eligibility:
                 # pairing a newer snapshot's grid with an older one's values

@@ -73,7 +73,8 @@ class FiloServer:
                                shard_mappers=self.mappers,
                                default_dataset=first,
                                batch_window_ms=self.config.query
-                               .batch_window_ms)
+                               .batch_window_ms,
+                               config=self.config)
         self.http = FiloHttpServer(self.api, http_host, http_port)
 
     # ------------------------------------------------------------- wiring
@@ -173,6 +174,17 @@ class FiloServer:
                     return ds_store.get_shard(dataset, shard_num) \
                         if ds_store else None
                 return server.memstore.get_shard(dataset, shard_num)
+
+            def shards_for(self, dataset: str):
+                # the query frontend's result cache derives its
+                # invalidation token from these shards.  Downsample
+                # datasets — and raw datasets the planner may ROUTE to a
+                # downsample store — return [] so the cache bypasses
+                # them: downsampled points land with timestamps behind
+                # the raw append horizon, invisible to the raw token
+                if "::ds::" in dataset or dataset in server.ds_stores:
+                    return []
+                return server.memstore.shards_for(dataset)
         return _Source()
 
     # ------------------------------------------------------------ lifecycle

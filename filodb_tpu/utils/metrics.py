@@ -345,3 +345,21 @@ def log_fused_degradation(where: str, exc: BaseException,
         _degrade_log.warning(
             "%s fused path degraded to general path: %s: %s",
             where, type(exc).__name__, exc)
+
+
+def log_error_once(where: str, exc: BaseException,
+                   min_interval_s: float = 300.0,
+                   logger_name: str = "filodb") -> None:
+    """Log a swallowed optimization-path exception once per (site, error
+    class), rate-limited — the general form of log_fused_degradation for
+    paths whose failures otherwise vanish into a bare counter (e.g. the
+    device mirror's incremental-refresh fallback).  A new error CLASS at
+    the same site always logs immediately, so a regression that changes
+    failure mode is visible even inside the rate window."""
+    key = f"{where}:{type(exc).__name__}"
+    now = time.monotonic()
+    if now - _degrade_last.get(key, -1e9) >= min_interval_s:
+        _degrade_last[key] = now
+        logging.getLogger(logger_name).warning(
+            "%s suppressed (optimization path fell back): %s: %s",
+            where, type(exc).__name__, exc)

@@ -351,6 +351,13 @@ def test_http_micro_batching_coalesces_panels(monkeypatch):
              query=queries[0], **args)
         want = [_get(srv, "/promql/prometheus/api/v1/query_range",
                      query=q, **args)[1] for q in queries]
+        # the sequential `want` round populated the frontend's result
+        # cache, which would serve the concurrent round without ever
+        # reaching the coalescer — this test is about FIRST-CONTACT
+        # coalescing of distinct panels, so start it cold
+        cache = srv.api.frontends["prometheus"].cache
+        if cache is not None:
+            cache.clear()
         merged0 = registry.counter("fused_batch_merged_panels").value
         got = {}
 
