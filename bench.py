@@ -581,6 +581,42 @@ def measure_dashboard_batch(platform):
     return st
 
 
+def _frontend_fixture(S, T, dataset):
+    """Shared workload for the query_frontend and observability stages:
+    one live store of S counter series x T 10s scrapes, a QueryFrontend
+    over it, and the dashboard-panel query — ONE definition so the two
+    acceptance stages can never silently measure different workloads.
+    Returns (frontend, engine, query, start_s, end_s, planner_params)."""
+    import numpy as np
+
+    from filodb_tpu.core.memstore import TimeSeriesMemStore
+    from filodb_tpu.ingest.generator import counter_batch
+    from filodb_tpu.query.engine import QueryEngine
+    from filodb_tpu.query.frontend import QueryFrontend
+    from filodb_tpu.query.rangevector import PlannerParams
+
+    START = 1_600_000_000_000
+    ms = TimeSeriesMemStore()
+    sh = ms.setup(dataset, 0)
+    base = counter_batch(S, 1, start_ms=START)
+    row_base = np.arange(S, dtype=np.float64)[:, None]
+    for t0 in range(0, T, 40):
+        n = min(40, T - t0)
+        ts2d = np.broadcast_to(
+            START + (t0 + np.arange(n, dtype=np.int64)) * 10_000, (S, n))
+        vals = (t0 + np.arange(n, dtype=np.float64))[None, :] * 5.0 \
+            + row_base
+        sh.ingest_columns("prom-counter", base.part_keys, ts2d,
+                          {"count": vals}, offset=t0)
+    eng = QueryEngine(dataset, ms)
+    fe = QueryFrontend(eng)
+    pp = PlannerParams(sample_limit=2_000_000_000, scan_limit=2_000_000_000)
+    q = 'sum by (_ns_)(rate(request_total[5m]))'
+    s = START // 1000
+    start_s, end_s = s + 600, s + (T - 1) * 10   # end == newest sample
+    return fe, eng, q, start_s, end_s, pp
+
+
 def measure_query_frontend(quick=False, series=None, iters=7):
     """Query-serving frontend (PR 2): cached re-poll latency and
     concurrent dashboard-repeat QPS against the sequential no-frontend
@@ -597,36 +633,12 @@ def measure_query_frontend(quick=False, series=None, iters=7):
     """
     import threading
 
-    import numpy as np
-
-    from filodb_tpu.core.memstore import TimeSeriesMemStore
-    from filodb_tpu.ingest.generator import counter_batch
-    from filodb_tpu.query.engine import QueryEngine
-    from filodb_tpu.query.frontend import QueryFrontend
-    from filodb_tpu.query.rangevector import PlannerParams
     from filodb_tpu.utils.metrics import registry
 
     S = series or (8_192 if quick else 262_144)
     T = 120                              # 20 min of 10s scrapes
-    START = 1_600_000_000_000
-    ms = TimeSeriesMemStore()
-    sh = ms.setup("bench_frontend", 0)
-    base = counter_batch(S, 1, start_ms=START)
-    row_base = np.arange(S, dtype=np.float64)[:, None]
-    for t0 in range(0, T, 40):
-        n = min(40, T - t0)
-        ts2d = np.broadcast_to(
-            START + (t0 + np.arange(n, dtype=np.int64)) * 10_000, (S, n))
-        vals = (t0 + np.arange(n, dtype=np.float64))[None, :] * 5.0 \
-            + row_base
-        sh.ingest_columns("prom-counter", base.part_keys, ts2d,
-                          {"count": vals}, offset=t0)
-    eng = QueryEngine("bench_frontend", ms)
-    fe = QueryFrontend(eng)
-    pp = PlannerParams(sample_limit=2_000_000_000, scan_limit=2_000_000_000)
-    q = 'sum by (_ns_)(rate(request_total[5m]))'
-    s = START // 1000
-    start_s, end_s = s + 600, s + (T - 1) * 10   # end == newest sample
+    fe, eng, q, start_s, end_s, pp = _frontend_fixture(
+        S, T, "bench_frontend")
     r = fe.query_range(q, start_s, 60, end_s, pp)      # warm everything
     if r.error:
         return {"series": S, "error": r.error[:200]}
@@ -706,6 +718,83 @@ def measure_query_frontend(quick=False, series=None, iters=7):
         registry.counter("query_singleflight_hits").value - sf0)
     st["qps_vs_sequential"] = round(
         st["concurrent_qps"] / max(st["sequential_baseline_qps"], 1e-9), 1)
+    return st
+
+
+def measure_observability(quick=False, series=None):
+    """PR 3 acceptance: the span+stats attribution layer must cost <= 5%
+    of the query_frontend concurrent QPS.  Same workload shape as
+    measure_query_frontend (8 threads polling one panel through the
+    frontend: singleflight + result cache + stats accounting), measured
+    with the span pipeline ON vs OFF (utils.metrics.set_spans_enabled)
+    in interleaved pairs; `span_overhead_pct` rides the one-line JSON.
+    Also sanity-checks the stats payload itself: a run whose overhead is
+    low because attribution silently broke must not pass."""
+    import threading
+
+    from filodb_tpu.utils import metrics as um
+
+    S = series or (4_096 if quick else 65_536)
+    T = 120
+    fe, eng, q, start_s, end_s, pp = _frontend_fixture(S, T, "bench_obs")
+    r = fe.query_range(q, start_s, 60, end_s, pp)
+    if r.error:
+        return {"series": S, "error": r.error[:200]}
+    st = {"series": S}
+    # the attribution payload itself must be live before we credit any
+    # overhead number: phases populated, scan counters nonzero
+    d = r.stats.to_dict()
+    st["stats_phases_ok"] = bool(
+        d["phases"]["exec_s"] > 0 and d["samplesScanned"] > 0
+        and d["phases"]["parse_s"] >= 0 and "cache" in d)
+
+    dur_s = 1.0 if quick else 2.0
+    errors = []
+
+    def pump():
+        counts = []
+        stop_t = time.perf_counter() + dur_s
+
+        def client():
+            n = 0
+            while time.perf_counter() < stop_t:
+                res = fe.query_range(q, start_s, 60, end_s, pp)
+                if res.error is not None:
+                    # surface, don't swallow (same stance as the
+                    # query_frontend stage): a thread dying silently
+                    # would ship a passing-looking overhead number
+                    errors.append(res.error)
+                    break
+                n += 1
+            counts.append(n)
+
+        threads = [threading.Thread(target=client) for _ in range(8)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        return sum(counts) / max(time.perf_counter() - t0, 1e-9)
+
+    on, off = [], []
+    try:
+        for _ in range(2 if quick else 3):
+            um.set_spans_enabled(True)
+            on.append(pump())
+            um.set_spans_enabled(False)
+            off.append(pump())
+    finally:
+        um.set_spans_enabled(True)
+    if errors:
+        st["error"] = f"pump: {errors[0]}"[:200]
+        st["pump_errors"] = len(errors)
+        return st
+    on.sort(); off.sort()
+    st["qps_spans_on"] = round(on[len(on) // 2], 1)
+    st["qps_spans_off"] = round(off[len(off) // 2], 1)
+    st["span_overhead_pct"] = round(
+        100.0 * (st["qps_spans_off"] - st["qps_spans_on"])
+        / max(st["qps_spans_off"], 1e-9), 2)
     return st
 
 
@@ -816,6 +905,12 @@ def assemble_result(platform, stages, vec_sps, it_sps, c_sps=0.0,
             # the PR-2 serving acceptance pair (+ context): concurrent
             # dashboard QPS through the frontend and the warm re-poll p50
             result[k] = qf[k]
+    obs = stages.get("observability", {})
+    if "span_overhead_pct" in obs:
+        # PR-3 acceptance: span+stats attribution overhead on the
+        # query_frontend QPS number (gate: <= 5%)
+        result["span_overhead_pct"] = obs["span_overhead_pct"]
+        result["observability_stats_ok"] = obs.get("stats_phases_ok")
     ns = stages.get("north_star_1m") or stages.get("cpu_north_star_1m")
     if ns and "samples_per_sec" in ns:
         result.update({
@@ -946,6 +1041,14 @@ def run_worker(args):
         stages["query_frontend"] = qf
     except Exception as e:  # noqa: BLE001 — must not sink the run
         writer.stage("query_frontend",
+                     {"error": f"{type(e).__name__}: {e}"[:300]})
+
+    try:
+        obs = measure_observability(quick=quick)
+        writer.stage("observability", obs)
+        stages["observability"] = obs
+    except Exception as e:  # noqa: BLE001 — must not sink the run
+        writer.stage("observability",
                      {"error": f"{type(e).__name__}: {e}"[:300]})
 
     result = assemble_result(platform, stages, vec_sps, it_sps,

@@ -76,6 +76,24 @@ class QueryConfig:
     # followers don't count): keeps N dashboard fanouts from stampeding
     # the device dispatch path.  0 = unbounded.
     max_concurrent_queries: int = 8
+    # --- observability (PR 3) ---
+    # slow-query flight recorder (utils/slowlog.py): queries whose total
+    # serving wall exceeds this land in the /admin/slowlog ring buffer
+    # with their full QueryStats + stitched span tree.  <= 0 disables.
+    slow_query_threshold_s: float = 10.0
+    slowlog_max_entries: int = 128
+    # optional JSONL mirror of every slowlog record (empty disables);
+    # the ring buffer stays bounded either way
+    slowlog_path: str = ""
+    # per-tenant (_ws_/_ns_) usage accounting (utils/usage.py): counters
+    # at /metrics + the /api/v1/usage endpoint.  Limits count samples
+    # SCANNED per tenant over a rolling window; warn logs + counts,
+    # fail rejects the query with a structured tenant_limit_exceeded
+    # error (Monarch-style per-tenant fairness floor).  0 = no limit.
+    tenant_usage_enabled: bool = True
+    tenant_limit_window_s: float = 60.0
+    tenant_samples_warn_limit: int = 0
+    tenant_samples_fail_limit: int = 0
 
 
 @dataclasses.dataclass

@@ -107,6 +107,22 @@ def _tails_matrix(col: np.ndarray, rows: np.ndarray, counts_old: np.ndarray,
 
 _mirror_serial = itertools.count(1)
 
+# process-wide count of background rebuilds in flight (drives the
+# device_mirror_rebuild_in_progress gauge): per-rebuild set/clear would
+# let the first of two overlapping rebuilds zero the gauge while the
+# second still runs
+_rebuilds_lock = threading.Lock()
+_rebuilds_in_flight = 0
+
+
+def _note_rebuild(delta: int) -> None:
+    global _rebuilds_in_flight
+    from filodb_tpu.utils.metrics import registry
+    with _rebuilds_lock:
+        _rebuilds_in_flight += delta
+        registry.gauge("device_mirror_rebuild_in_progress").update(
+            _rebuilds_in_flight)
+
 # Default mirror HBM budget — the single source for this constant (also
 # mirrored by config.device_mirror_hbm_limit and subtracted by the fused
 # padded-values cache budget in query/exec._fused_vals_budget).
@@ -138,9 +154,13 @@ class DeviceMirror:
         return n
 
     def _refresh(self, store) -> bool:
+        import time as _time
+
         import jax
 
-        from filodb_tpu.utils.metrics import registry as metrics_registry
+        from filodb_tpu.utils.metrics import (note_mirror_refresh,
+                                              note_transfer,
+                                              registry as metrics_registry)
         # capture the version BEFORE copying host arrays: if a mutation
         # lands mid-copy the recorded generation is stale, so the caller's
         # snapshot_read retry forces a clean re-upload (seqlock protocol,
@@ -151,8 +171,29 @@ class DeviceMirror:
             # silently-degraded path flagged in round 1: make it observable
             metrics_registry.counter("device_mirror_over_cap").increment()
             return False
+        _t0 = _time.perf_counter()
+        # transfer attribution times ONLY the device_put dispatches —
+        # the surrounding host prep (offset/vbase/counter math) belongs
+        # in exec_s, and booking it as transfer would point an operator
+        # at the interconnect for a host-CPU cost
+        xfer_s = 0.0
+
+        def dput(x):
+            nonlocal xfer_s
+            t = _time.perf_counter()
+            out = jax.device_put(x)
+            xfer_s += _time.perf_counter() - t
+            return out
+
         metrics_registry.counter("device_mirror_refreshes").increment()
         metrics_registry.gauge("device_mirror_bytes").update(nbytes)
+        # occupancy vs limit on every upload: a transfer regression or a
+        # store creeping toward its HBM cap is visible at /metrics without
+        # a profiler (PR 3 device-side accounting)
+        metrics_registry.gauge("device_mirror_hbm_limit_bytes").update(
+            self.hbm_limit_bytes)
+        metrics_registry.counter("device_mirror_upload_bytes",
+                                 kind="full").increment(nbytes)
         s, t = store.num_series, max(store.time_used, 1)
         ts = store.ts[:s, :t]
         live = ts[ts > 0]
@@ -181,8 +222,8 @@ class DeviceMirror:
                 is_counter = name in counter_cols
                 rebased, vb, corrected = rebase_values(
                     arr[:s, :t], is_counter, return_corrected=True)
-                cols[name] = jax.device_put(rebased)
-                vbases[name] = jax.device_put(vb)
+                cols[name] = dput(rebased)
+                vbases[name] = dput(vb)
                 host_vbases[name] = np.asarray(vb, np.float64)
                 fin = np.isfinite(corrected)
                 vbase_valid[name] = fin.any(axis=1)
@@ -199,7 +240,7 @@ class DeviceMirror:
                     cum_drop[name] = cd
         # single publication point (GIL-atomic): see _MirrorSnapshot
         self._snap = _MirrorSnapshot(gen0, base_ms, t,
-                                     jax.device_put(ts_off), cols, vbases,
+                                     dput(ts_off), cols, vbases,
                                      shift_version=store.shift_version,
                                      counts=counts, host_vbases=host_vbases,
                                      tail_last_raw=last_raw,
@@ -209,6 +250,15 @@ class DeviceMirror:
                                      ts_row0=(ts_off[0].copy() if uniform
                                               else None),
                                      col_finite=col_finite)
+        # the histogram records the WHOLE refresh wall (host prep +
+        # uploads: the operational "how long did the rebuild take");
+        # the per-query tally gets only the device-dispatch share
+        metrics_registry.histogram("device_mirror_full_upload_seconds") \
+            .record(_time.perf_counter() - _t0)
+        # attribute the upload to whichever exec node triggered it (the
+        # background-rebuild thread's tally is simply never consumed)
+        note_transfer(nbytes, xfer_s)
+        note_mirror_refresh("full")
         return True
 
     def is_fresh(self, store) -> bool:
@@ -277,6 +327,10 @@ class DeviceMirror:
     def _bg_refresh(self, shard, store) -> None:
         from filodb_tpu.utils.metrics import (log_error_once, registry,
                                               span)
+        # progress gauge: >0 while rebuilds are off-path in flight, so an
+        # operator watching /metrics sees the eviction recovery running
+        # (the span histogram records its duration when it completes)
+        _note_rebuild(+1)
         try:
             with span("mirror_bg_rebuild"):
                 with shard._write_locked("mirror_bg_rebuild"):
@@ -286,17 +340,23 @@ class DeviceMirror:
         except Exception as e:  # noqa: BLE001 — queries already fall back
             registry.counter("device_mirror_bg_rebuild_errors").increment()
             log_error_once("device_mirror_bg_rebuild", e)
+        finally:
+            _note_rebuild(-1)
 
     def _refresh_incremental(self, store, snap: _MirrorSnapshot) -> bool:
         """Upload only the appended tail cells.  Sound exactly when nothing
         rearranged existing cells (shift_version unchanged) and counts only
         grew; returns False to request a full refresh otherwise."""
+        import time as _time
+
         import jax
         import jax.numpy as jnp
 
         from filodb_tpu.ops.counter import host_counter_correct
         from filodb_tpu.ops.timewindow import series_value_base
-        from filodb_tpu.utils.metrics import registry as metrics_registry
+        from filodb_tpu.utils.metrics import (note_mirror_refresh,
+                                              note_transfer,
+                                              registry as metrics_registry)
 
         gen0 = store.generation
         s_old = snap.counts.shape[0]
@@ -338,12 +398,18 @@ class DeviceMirror:
         if off.size and (off.min() <= -(1 << 30) or off.max() >= (1 << 30)):
             return False                 # out of int32 offset range: re-base
 
+        # device-dispatch share of the refresh (scatter/pad/upload ops);
+        # host math (counter correction, vbase bookkeeping) stays out so
+        # the per-query transfer attribution names actual device work
+        xfer_s = 0.0
         dS, dT = s_new - s_old, t_new - snap.t_used
+        _td = _time.perf_counter()
         ts_dev = snap.ts_off
         if dS or dT:
             ts_dev = jnp.pad(ts_dev, ((0, dS), (0, dT)),
                              constant_values=PAD_TS)
         ts_dev = ts_dev.at[idx_r, idx_p].set(off.astype(np.int32))
+        xfer_s += _time.perf_counter() - _td
 
         # uniform-grid preservation: every row appended the same offsets
         uniform = (snap.uniform_grid and s_new == s_old
@@ -420,6 +486,7 @@ class DeviceMirror:
             flat = rb[valid]
             col_finite[name] = bool(col_finite.get(name, False)
                                     and np.isfinite(flat).all())
+            _td = _time.perf_counter()
             col_dev = dev
             if dS or dT:
                 pad = ((0, dS), (0, dT)) + (((0, 0),) if hist else ())
@@ -432,6 +499,7 @@ class DeviceMirror:
                     vb_new.astype(vb_dev.dtype))
             else:
                 new_vbases[name] = vb_dev
+            xfer_s += _time.perf_counter() - _td
 
         metrics_registry.counter("device_mirror_incremental").increment()
         metrics_registry.gauge("device_mirror_bytes").update(
@@ -442,6 +510,16 @@ class DeviceMirror:
             host_vbases=host_vbases, tail_last_raw=last_raw,
             tail_cum_drop=cum_drop, vbase_valid=vbase_valid,
             uniform_grid=uniform, ts_row0=ts_row0, col_finite=col_finite)
+        # appended-tail transfer size: int32 ts offsets + each column's
+        # per-cell bytes over the new cells only
+        per_cell = 4 + sum(
+            a.itemsize * (a.shape[2] if a.ndim == 3 else 1)
+            for a in (store.cols[n] for n in snap.cols) if a is not None)
+        metrics_registry.counter("device_mirror_upload_bytes",
+                                 kind="incremental").increment(
+                                     total_new * per_cell)
+        note_transfer(total_new * per_cell, xfer_s)
+        note_mirror_refresh("incremental")
         return True
 
     def _refresh_pad_only(self, store, snap, gen0: int, s_new: int,

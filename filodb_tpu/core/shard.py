@@ -167,6 +167,13 @@ class TimeSeriesShard:
             self.config.store.resident_cache_bytes, dataset, shard_num,
             persistent=not isinstance(self.column_store, NullColumnStore))
         self.stats = ShardStats()
+        # per-tenant (_ws_/_ns_) ingest attribution (utils/usage.py):
+        # pid -> small tenant id resolved once at partition creation, so
+        # the hot ingest paths pay ONE vectorized bincount per batch
+        self._usage_enabled = self.config.query.tenant_usage_enabled
+        self._pid_tenant = np.zeros(0, dtype=np.int32)
+        self._tenant_ids: Dict[Tuple[str, str], int] = {}
+        self._tenant_names: List[Tuple[str, str]] = []
         self.ingested_offset = -1                   # latest ingest offset seen
         self._groups = self.config.store.groups_per_shard
         self._dirty_part_keys: set = set()          # partIds needing pk upsert
@@ -297,6 +304,15 @@ class TimeSeriesShard:
         self._pid_schema_code[pid] = code
         self._pid_row[pid] = info.row
         self._pid_alive[pid] = True
+        self._pid_tenant = _grow_to(self._pid_tenant, n)
+        if self._usage_enabled:
+            tags = part_key.tags_dict
+            tk = (tags.get("_ws_", ""), tags.get("_ns_", ""))
+            tid = self._tenant_ids.get(tk)
+            if tid is None:
+                tid = self._tenant_ids[tk] = len(self._tenant_names)
+                self._tenant_names.append(tk)
+            self._pid_tenant[pid] = tid
         self._rv_keys.append(None)
         self._group_pids[info.group].append(pid)
         self.part_set[kb] = pid
@@ -493,6 +509,7 @@ class TimeSeriesShard:
             self.stats.rows_dropped += ts2d.size - n
             metrics_registry.counter("ingested_rows", dataset=self.dataset,
                                      shard=str(self.shard_num)).increment(n)
+            self._account_ingest(pids_for_key[keep], grid_k)
             if offset >= 0:
                 self.ingested_offset = offset
             return n
@@ -531,9 +548,30 @@ class TimeSeriesShard:
         self.stats.rows_dropped += batch.num_records - n
         metrics_registry.counter("ingested_rows", dataset=self.dataset,
                                  shard=str(self.shard_num)).increment(n)
+        self._account_ingest(pid_sel[keep], 1)
         if offset >= 0:
             self.ingested_offset = offset
         return n
+
+    def _account_ingest(self, pids: np.ndarray, samples_per_key) -> None:
+        """Per-tenant ingest attribution: one vectorized bincount over
+        the batch's tenant ids.  `samples_per_key` is a scalar (grid
+        paths: every key gained k cells) or a per-entry weight array.
+        Counts OFFERED samples on the kept keys — the tenant asked for
+        that ingest work whether or not OOO/dup rows were dropped."""
+        if not self._usage_enabled or pids.size == 0 \
+                or not self._tenant_names:
+            return
+        from filodb_tpu.utils.usage import usage
+        tids = self._pid_tenant[pids]
+        n_t = len(self._tenant_names)
+        if np.ndim(samples_per_key) == 0:
+            cnt = np.bincount(tids, minlength=n_t) * samples_per_key
+        else:
+            cnt = np.bincount(tids, weights=samples_per_key, minlength=n_t)
+        for tid in np.flatnonzero(cnt):
+            ws, ns = self._tenant_names[tid]
+            usage.record_ingest(ws, ns, int(cnt[tid]), dataset=self.dataset)
 
     def _trace_touch_resolved(self, pids_for_key: np.ndarray,
                               offset: int) -> None:
@@ -592,6 +630,7 @@ class TimeSeriesShard:
             self.stats.rows_dropped += ts.size - n
             metrics_registry.counter("ingested_rows", dataset=self.dataset,
                                      shard=str(self.shard_num)).increment(n)
+            self._account_ingest(pids_for_key[keep], ts.shape[1])
             if offset >= 0:
                 self.ingested_offset = offset
             return n

@@ -60,6 +60,13 @@ class PromHttpApi:
         # back-compat alias (tests/tools reach the coalescer through it)
         self.coalescers = {name: fe.coalescer
                           for name, fe in self.frontends.items()}
+        # last-seen jit compile-cache sizes (scrape-over-scrape deltas
+        # feed the jit_compile_events counter in _own_metrics); locked —
+        # ThreadingHTTPServer can run two scrapes concurrently, and an
+        # unsynchronized read-increment-write would double-count events
+        import threading as _threading
+        self._jit_cache_sizes: Dict[str, int] = {}
+        self._jit_lock = _threading.Lock()
 
     # ------------------------------------------------------------ dispatch
 
@@ -91,6 +98,9 @@ class PromHttpApi:
                 return self._loglevel(parts[2], body.decode().strip())
             if parts[:2] == ["admin", "profiler"] and len(parts) == 3:
                 return self._profiler(parts[2], params, method)
+            if parts[:2] == ["admin", "slowlog"] and len(parts) in (2, 3):
+                return self._slowlog(parts[2] if len(parts) == 3 else None,
+                                     params, method)
             if parts[:2] == ["admin", "traces"] and len(parts) in (2, 3):
                 return self._traces(parts[2] if len(parts) == 3 else None)
             if parts[:2] == ["admin", "tracedfilters"] and method == "POST":
@@ -129,7 +139,24 @@ class PromHttpApi:
             payload = QueryEngine.to_prom_matrix(res)
             if res.trace_id:
                 payload["traceID"] = res.trace_id
+            if _want_stats(params):
+                # per-query resource attribution (the Prometheus
+                # `stats=all` analogue): phase seconds + samples/bytes
+                # + cache verdicts, merged across every exec node
+                payload["stats"] = res.stats.to_dict()
             return (200 if payload["status"] == "success" else 400), payload
+        if rest == ["explain"]:
+            q = params.get("query", "")
+            start = _num_param(params, "start")
+            end = _num_param(params, "end")
+            step = _step_param(params.get("step", "15"))
+            if params.get("analyze") in ("true", "1"):
+                return self._explain_analyze(dataset, q, start, step, end,
+                                             planner_params)
+            return self._explain(eng, q, start, step, end)
+        if rest == ["usage"]:
+            from filodb_tpu.utils.usage import usage
+            return 200, {"status": "success", "data": usage.snapshot()}
         if rest == ["query_range_batch"] and method == "POST":
             # dashboard batch: JSON {"queries": [...], "start", "step",
             # "end"} -> list of prom matrix payloads, compatible fused
@@ -150,10 +177,14 @@ class PromHttpApi:
             results = eng.query_range_batch(queries, start, step, end,
                                             planner_params)
             payloads = []
+            want_stats = _want_stats(params) or req.get("stats") in (
+                True, "true", "1", "all")
             for res in results:
                 p = QueryEngine.to_prom_matrix(res)
                 if res.trace_id:
                     p["traceID"] = res.trace_id
+                if want_stats:
+                    p["stats"] = res.stats.to_dict()
                 payloads.append(p)
             return 200, {"status": "success", "results": payloads}
         if rest == ["query"]:
@@ -165,6 +196,8 @@ class PromHttpApi:
             payload = QueryEngine.to_prom_vector(res)
             if res.trace_id:
                 payload["traceID"] = res.trace_id
+            if _want_stats(params):
+                payload["stats"] = res.stats.to_dict()
             return (200 if payload["status"] == "success" else 400), payload
         if rest == ["labels"]:
             return self._metadata(eng, "labels", params, multi)
@@ -282,6 +315,30 @@ class PromHttpApi:
                      "data": {"resultType": "execPlan",
                               "result": ep.print_tree().splitlines()}}
 
+    def _explain_analyze(self, dataset: str, q: str, start: int, step: int,
+                         end: int, planner_params) -> Tuple[int, object]:
+        """EXPLAIN ANALYZE: the plan is EXECUTED and every locally-run
+        node's line carries its exclusive time / device / transfer /
+        samples attribution plus the root QueryStats.  Goes through the
+        dataset's frontend so the tenant limits, scheduler bound, and
+        usage/slowlog accounting apply exactly as for query_range — an
+        unaccounted analyze verb would be a free pass around them."""
+        res, rec, ep = self.frontends[dataset].analyze_range(
+            q, start, step, end, planner_params)
+        if rec is None:                  # tenant admission rejected it
+            return 400, _err(res.error or "rejected")
+        if res.error:
+            # same contract as query_range: execution failure is a 400
+            # with status error, not a success-shaped payload
+            return 400, _err(res.error)
+        lines = ep.print_tree(annot=rec.annotation).splitlines()
+        data = {"resultType": "execPlanAnalysis",
+                "result": lines,
+                "stats": res.stats.to_dict(),
+                "nodes": rec.order,
+                "traceID": res.trace_id}
+        return 200, {"status": "success", "data": data}
+
     def _metadata(self, eng: QueryEngine, kind: str, params: Dict[str, str],
                   multi: Dict[str, List[str]],
                   label: Optional[str] = None) -> Tuple[int, object]:
@@ -370,7 +427,42 @@ class PromHttpApi:
                     shard.stats.rows_dropped)
                 registry.gauge("quota_dropped", **tags).update(
                     shard.stats.quota_dropped)
+        # jit compile-cache sizes (device-side accounting, PR 3): a
+        # compile storm — new shapes forcing fresh XLA compiles per
+        # query — shows as these gauges climbing scrape over scrape,
+        # plus an event counter for the deltas
+        try:
+            from filodb_tpu.ops.pallas_fused import jit_cache_stats
+            with self._jit_lock:
+                for fn_name, size in jit_cache_stats().items():
+                    registry.gauge("jit_cache_entries",
+                                   fn=fn_name).update(size)
+                    prev = self._jit_cache_sizes.get(fn_name, 0)
+                    if size > prev:
+                        registry.counter("jit_compile_events",
+                                         fn=fn_name).increment(size - prev)
+                    self._jit_cache_sizes[fn_name] = size
+        except Exception:  # noqa: BLE001 — private jax API: best-effort
+            pass
         return 200, registry.expose_prometheus()
+
+    def _slowlog(self, action, params: Dict[str, str],
+                 method: str) -> Tuple[int, object]:
+        """Slow-query flight recorder (utils/slowlog.py): GET
+        /admin/slowlog returns the ring buffer newest-last (?limit=N
+        tails it); POST /admin/slowlog/clear empties it."""
+        from filodb_tpu.utils.slowlog import slowlog
+        if action is None and method == "GET":
+            limit = _num_param(params, "limit", "0")
+            entries = slowlog.entries(limit)
+            return 200, {"status": "success",
+                         "data": {"count": len(entries),
+                                  "thresholdSeconds": slowlog.threshold_s,
+                                  "entries": entries}}
+        if action == "clear" and method == "POST":
+            return 200, {"status": "success",
+                         "data": {"cleared": slowlog.clear()}}
+        return 404, _err(f"unknown slowlog action {action!r} ({method})")
 
     def _traces(self, trace_id) -> Tuple[int, object]:
         """Stitched cross-node span tree for one query (the Zipkin-query
@@ -446,6 +538,13 @@ class PromHttpApi:
             if not profiler.stop():
                 raise _BadRequest("profiler not running")
             return 200, {"status": "stopped", "samples": profiler.samples}
+        fmt = params.get("format", "flat")
+        if fmt == "collapsed":
+            # semicolon-joined stacks, speedscope/flamegraph.pl-compatible
+            return 200, profiler.report_collapsed()
+        if fmt != "flat":
+            raise _BadRequest(f"unknown report format {fmt!r} "
+                              "(flat | collapsed)")
         return 200, profiler.report(_num_param(params, "top", "30"))
 
     # -------------------------------------------------------------- influx
@@ -538,6 +637,11 @@ def _planner_params(params: Dict[str, str]) -> Optional[PlannerParams]:
         pp.allow_partial_results = True
         changed = True
     return pp if changed else None
+
+
+def _want_stats(params: Dict[str, str]) -> bool:
+    """`stats=true` / `stats=1` / the Prometheus-style `stats=all`."""
+    return params.get("stats") in ("true", "1", "all")
 
 
 def _err(msg: str) -> Dict[str, str]:

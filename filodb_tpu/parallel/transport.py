@@ -157,9 +157,12 @@ class RemoteNodeDispatcher(PlanDispatcher):
                 self._tls.sock = None
 
     def dispatch(self, plan, source) -> QueryResultLike:
+        import time as _time
+
         from filodb_tpu.query.execbase import QueryError
         payload = serialize.dumps(plan)
         where = f"{self.host}:{self.port}"
+        t_wire0 = _time.perf_counter()
         try:
             sock, fresh = self._sock()
         except OSError as e:
@@ -169,7 +172,8 @@ class RemoteNodeDispatcher(PlanDispatcher):
                              f"node {where} unreachable: {e}") from e
         try:
             _send_frame(sock, payload)
-            reply = serialize.loads(_recv_frame(sock))
+            raw = _recv_frame(sock)
+            reply = serialize.loads(raw)
         except socket.timeout as e:
             # NEVER retry a timeout: the remote may still be executing the
             # plan, and a re-send would run the query twice
@@ -196,7 +200,8 @@ class RemoteNodeDispatcher(PlanDispatcher):
                                  f"{e2}") from e2
             try:
                 _send_frame(sock, payload)
-                reply = serialize.loads(_recv_frame(sock))
+                raw = _recv_frame(sock)
+                reply = serialize.loads(raw)
             except socket.timeout as e2:
                 self._reset()
                 raise QueryError(
@@ -221,4 +226,16 @@ class RemoteNodeDispatcher(PlanDispatcher):
                 if isinstance(ev, dict):
                     collector.record(tid, ev)
         stats = reply["stats"] or QueryStats()
+        # resource attribution across the wire (PR 3): the remote's own
+        # phase seconds arrived inside `stats`; the round trip minus the
+        # remote's busy time is serialization + network — transfer.  The
+        # whole round trip is credited as CHILD wall so the coordinator
+        # node's exclusive cpu_seconds never claims the network wait.
+        from filodb_tpu.utils.metrics import exec_tally
+        wire_wall = _time.perf_counter() - t_wire0
+        exec_tally.child_wall += wire_wall
+        remote_busy = (stats.cpu_seconds + stats.device_seconds
+                       + stats.transfer_s)
+        stats.transfer_s += max(wire_wall - remote_busy, 0.0)
+        stats.bytes_transferred += len(payload) + len(raw)
         return reply["data"], stats

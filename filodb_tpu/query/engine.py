@@ -55,6 +55,7 @@ class QueryEngine:
                     planner_params: Optional[PlannerParams] = None
                     ) -> QueryResult:
         from filodb_tpu.utils.metrics import span
+        t_parse0 = _time.perf_counter()
         try:
             # span: the parse share of the fixed per-query floor is
             # attributable in traces (parse itself is AST-memoized —
@@ -65,7 +66,10 @@ class QueryEngine:
                     promql, TimeStepParams(start_s, step_s, end_s))
         except Exception as e:  # noqa: BLE001 — parse errors surface in result
             return QueryResult([], error=f"parse error: {e}")
-        return self.exec_logical_plan(plan, planner_params)
+        parse_t = _time.perf_counter() - t_parse0
+        res = self.exec_logical_plan(plan, planner_params)
+        res.stats.parse_s += parse_t
+        return res
 
     def query_instant(self, promql: str, time_s: int,
                       planner_params: Optional[PlannerParams] = None
@@ -102,24 +106,29 @@ class QueryEngine:
         results: List[Optional[QueryResult]] = [None] * len(promqls)
         entries = []
         for i, q in enumerate(promqls):
+            t0 = _time.perf_counter()
             try:
                 plan = query_range_to_logical_plan(
                     q, TimeStepParams(start_s, step_s, end_s))
             except Exception as e:  # noqa: BLE001
                 results[i] = QueryResult([], error=f"parse error: {e}")
                 continue
+            parse_t = _time.perf_counter() - t0
             if isinstance(plan, lp.MetadataQueryPlan):
                 results[i] = self.exec_logical_plan(plan, planner_params)
+                results[i].stats.parse_s += parse_t
                 continue
             ctx = self._ctx(planner_params)
+            t0 = _time.perf_counter()
             try:
                 ep = self.planner.materialize(plan, ctx)
             except Exception as e:  # noqa: BLE001
                 results[i] = QueryResult([], error=f"planning error: {e}")
                 continue
-            entries.append((i, ep, ctx, plan))
+            entries.append((i, ep, ctx, plan,
+                            parse_t, _time.perf_counter() - t0))
         calls = []
-        for _, ep, _, _ in entries:
+        for _, ep, _, _, _, _ in entries:
             for leaf in _walk_plan(ep):
                 if isinstance(leaf, MultiSchemaPartitionsExec) and \
                         isinstance(leaf.dispatcher, InProcessPlanDispatcher):
@@ -138,7 +147,7 @@ class QueryEngine:
             for (leaf, fc), partial in zip(calls, partials):
                 if partial is not None:
                     leaf.inject_fused(partial)
-        for i, ep, ctx, plan in entries:
+        for i, ep, ctx, plan, parse_t, plan_t in entries:
             res = ep.execute(self.source)
             res.trace_id = ctx.query_id
             if res.error and res.error.startswith("shard_unavailable") \
@@ -148,6 +157,8 @@ class QueryEngine:
                 # loses this batch's fusion, which is moot — its shard
                 # owner just died)
                 res = self.exec_logical_plan(plan, planner_params)
+            res.stats.parse_s += parse_t
+            res.stats.plan_s += plan_t
             results[i] = res
         return results
 
@@ -156,17 +167,21 @@ class QueryEngine:
                           ) -> QueryResult:
         from filodb_tpu.utils.metrics import span
         ctx = self._ctx(planner_params)
+        t_plan0 = _time.perf_counter()
         try:
             with span("query_plan"):
                 ep = self.planner.materialize(plan, ctx)
         except Exception as e:  # noqa: BLE001
             return QueryResult([], error=f"planning error: {e}")
+        plan_t = _time.perf_counter() - t_plan0
         if isinstance(plan, lp.MetadataQueryPlan):
             data, stats = ep.execute_internal(self.source)
+            stats.plan_s += plan_t
             if isinstance(data, QueryResult):
                 return data
             return QueryResult([], stats)
         res = ep.execute(self.source)
+        res.stats.plan_s += plan_t
         res.trace_id = ctx.query_id
         if res.error and res.error.startswith("shard_unavailable") \
                 and self.replan_hook is not None:

@@ -20,7 +20,8 @@ nonleaf / metaexec (each under 800 LoC); this module re-exports every name
 so existing import paths keep working.
 """
 from filodb_tpu.query.execbase import (  # noqa: F401
-    AggPartial, Data, EmptyResultExec, ExecPlan, GroupCardinalityError,
+    AggPartial, AnalyzeRecorder, Data, EmptyResultExec, ExecPlan,
+    GroupCardinalityError,
     InProcessPlanDispatcher, LeafExecPlan, NonLeafExecPlan, PlanDispatcher,
     QueryResultLike, RawBlock, ScalarResult, _FUSED_CACHE_LOCK,
     _FUSED_GROUP_CACHE, _FUSED_MINMAX_PAD_CACHE, _FUSED_PLAN_CACHE,

@@ -12,6 +12,7 @@ import dataclasses
 import logging
 import os
 import threading
+import time as _time
 from typing import Callable, Dict, List, Optional, Sequence, Tuple, Union
 
 import numpy as np
@@ -462,6 +463,38 @@ def reduce_partials(parts: List[AggPartial]) -> Optional[AggPartial]:
 # ---------------------------------------------------------------- exec plans
 
 
+class AnalyzeRecorder:
+    """Per-node resource records for `/api/v1/explain?analyze=true` (the
+    EXPLAIN ANALYZE of the exec tree): every locally-executed node
+    appends its EXCLUSIVE wall/device/transfer attribution plus the
+    cumulative scan counters its subtree produced.  Attach by setting
+    `ctx.analyze = AnalyzeRecorder()` on the QueryContext BEFORE
+    execution (a plain attribute, deliberately not a dataclass field, so
+    remote-dispatched subtrees serialize without it — their spans still
+    stitch into the trace; their per-node detail stays on their node)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.by_node: Dict[int, dict] = {}      # id(node) -> record
+        self.order: List[dict] = []
+
+    def add(self, node, rec: dict) -> None:
+        with self._lock:
+            self.by_node[id(node)] = rec
+            self.order.append(rec)
+
+    def annotation(self, node) -> str:
+        """Tree-line suffix for print_tree(annot=...)."""
+        r = self.by_node.get(id(node))
+        if r is None:
+            return "  [not executed locally]"
+        return ("  [self=%.3fms device=%.3fms transfer=%.3fms "
+                "bytes=%d samples=%d series=%d]"
+                % (r["self_s"] * 1e3, r["device_s"] * 1e3,
+                   r["transfer_s"] * 1e3, r["bytes_transferred"],
+                   r["samples_scanned"], r["series_scanned"]))
+
+
 class PlanDispatcher:
     """ref: exec/PlanDispatcher.scala:20."""
 
@@ -502,10 +535,55 @@ class ExecPlan:
     def _do_execute(self, source) -> QueryResultLike:
         raise NotImplementedError
 
-    def execute_internal(self, source) -> QueryResultLike:
+    def _execute_impl(self, source) -> QueryResultLike:
         data, stats = self._do_execute(source)
         for t in self.transformers:
             data = t.apply(data, self.ctx, stats, source)
+        return data, stats
+
+    def execute_internal(self, source) -> QueryResultLike:
+        """_execute_impl wrapped in the resource tally: each node's
+        EXCLUSIVE wall time (total minus nested nodes') plus whatever
+        device/transfer work the thread accumulated while this node ran
+        lands in ITS QueryStats — children's contributions arrive via
+        stats.merge, so the root totals are exact sums over nodes."""
+        from filodb_tpu.utils.metrics import exec_tally
+        snap = exec_tally.snapshot()
+        t0 = _time.perf_counter()
+        try:
+            data, stats = self._execute_impl(source)
+        except BaseException:
+            # attribution on the error path: the parent sees the whole
+            # failed subtree as child time, never as its own cpu
+            exec_tally.restore(snap, _time.perf_counter() - t0)
+            raise
+        total = _time.perf_counter() - t0
+        # exclusive HOST cpu: nested nodes' wall AND this node's own
+        # synchronous device/transfer waits are carved out, so the three
+        # phase columns (exec/device/transfer) partition wall time
+        # instead of double-counting it
+        self_wall = max(total - exec_tally.child_wall
+                        - exec_tally.device_s - exec_tally.transfer_s, 0.0)
+        stats.cpu_seconds += self_wall
+        stats.device_seconds += exec_tally.device_s
+        stats.transfer_s += exec_tally.transfer_s
+        stats.bytes_transferred += exec_tally.transfer_bytes
+        stats.mirror_full_rebuilds += exec_tally.mirror_full
+        stats.mirror_incremental += exec_tally.mirror_incremental
+        rec = getattr(self.ctx, "analyze", None)
+        if rec is not None:
+            rec.add(self, {
+                "plan": type(self).__name__,
+                "self_s": self_wall,
+                "device_s": exec_tally.device_s,
+                "transfer_s": exec_tally.transfer_s,
+                "bytes_transferred": exec_tally.transfer_bytes,
+                # cumulative over this node's subtree (leaves: own scan)
+                "samples_scanned": stats.samples_scanned,
+                "series_scanned": stats.series_scanned,
+                "shards_queried": stats.shards_queried,
+            })
+        exec_tally.restore(snap, total)
         return data, stats
 
     def execute(self, source) -> QueryResult:
@@ -543,6 +621,8 @@ class ExecPlan:
                                error=f"sample limit {limit} exceeded "
                                      f"({result_samples} samples)")
         stats.result_samples = result_samples
+        stats.result_bytes = sum(int(np.asarray(b.values).nbytes)
+                                 for b in blocks)
         return QueryResult(blocks, stats, partial=stats.partial)
 
     # -- plan printing (ref: ExecPlan.printTree, doc/query-engine.md:174-204)
@@ -550,12 +630,15 @@ class ExecPlan:
     def args_str(self) -> str:
         return ""
 
-    def print_tree(self, level: int = 0) -> str:
+    def print_tree(self, level: int = 0, annot=None) -> str:
+        """annot: optional node -> suffix-string callable (the explain
+        analyze mode passes AnalyzeRecorder.annotation)."""
         transf = [f"{'-' * (level + i + 1)}T~{type(t).__name__}({t.args_str()})"
                   for i, t in enumerate(reversed(self.transformers))]
         me = (f"{'-' * (level + len(self.transformers) + 1)}"
-              f"E~{type(self).__name__}({self.args_str()})")
-        kids = [c.print_tree(level + len(self.transformers) + 1)
+              f"E~{type(self).__name__}({self.args_str()})"
+              + (annot(self) if annot is not None else ""))
+        kids = [c.print_tree(level + len(self.transformers) + 1, annot)
                 for c in self.children]
         return "\n".join(transf + [me] + kids)
 

@@ -22,6 +22,7 @@ bound applies exactly to the expensive device-dispatching work.
 from __future__ import annotations
 
 import threading
+import time as _time
 from typing import Dict, Optional, Tuple
 
 from filodb_tpu.core.shard import NO_HORIZON_MS
@@ -59,14 +60,56 @@ class QueryFrontend:
         self._ask_timeout_s = q.ask_timeout_s
         # promql -> cacheability memo (parse once per distinct string)
         self._cacheable: Dict[str, bool] = {}
+        # --- observability (PR 3): slowlog + per-tenant usage/limits ---
+        self._slow_s = q.slow_query_threshold_s
+        self._usage_enabled = q.tenant_usage_enabled
+        self._warn_limit = q.tenant_samples_warn_limit
+        self._fail_limit = q.tenant_samples_fail_limit
 
     # ------------------------------------------------------------ public
 
     def query_range(self, promql: str, start_s: int, step_s: int,
                     end_s: int, planner_params=None):
+        """The serving entry point: tenant admission, then the
+        singleflight/cache/scheduler stack, then usage accounting + the
+        slow-query flight recorder on the way out.  The recorded
+        duration is the CLIENT-OBSERVED wall (queue wait and dedup wait
+        included) — that's the latency an operator is paged for."""
+        from filodb_tpu.query.rangevector import QueryResult
+        from filodb_tpu.utils.slowlog import slowlog
+        from filodb_tpu.utils.usage import tenant_of, usage
+        tenant = ("", "")
+        if self._usage_enabled:
+            tenant = tenant_of(promql)
+            err = usage.admit(tenant[0], tenant[1], self._warn_limit,
+                              self._fail_limit)
+            if err is not None:
+                return QueryResult([], error=err)
+        t0 = _time.perf_counter()
+        res, shared = self._sf_query_range(promql, start_s, step_s, end_s,
+                                           planner_params)
+        dur = _time.perf_counter() - t0
+        # singleflight followers received the LEADER's result: the work
+        # (and its samples_scanned) happened once — re-recording it per
+        # follower would bill a tenant N× for one execution and write N
+        # identical slowlog records, throttling tenants fastest exactly
+        # when dedup makes their traffic cheapest
+        if not shared:
+            if self._usage_enabled and res is not None:
+                usage.record_query(tenant[0], tenant[1], dur,
+                                   res.stats.samples_scanned,
+                                   res.stats.result_bytes)
+            slowlog.maybe_record(promql, start_s, step_s, end_s, dur, res,
+                                 tenant=tenant, threshold_s=self._slow_s)
+        return res
+
+    def _sf_query_range(self, promql: str, start_s: int, step_s: int,
+                        end_s: int, planner_params=None):
+        """Returns (result, shared): shared=True iff this caller rode a
+        singleflight leader's execution instead of running its own."""
         if not self._sf_enabled:
             return self._cached_query(promql, start_s, step_s, end_s,
-                                      planner_params)
+                                      planner_params), False
         key = (promql, start_s, step_s, end_s, repr(planner_params))
         with self._sf_lock:
             flight = self._inflight.get(key)
@@ -80,19 +123,81 @@ class QueryFrontend:
             # must not strand followers — they fall back to running solo
             flight.done.wait(timeout=max(300.0, 3 * self._ask_timeout_s))
             if flight.result is not None:
-                return flight.result
+                return flight.result, True
             return self._cached_query(promql, start_s, step_s, end_s,
-                                      planner_params)
+                                      planner_params), False
         try:
             res = self._cached_query(promql, start_s, step_s, end_s,
                                      planner_params)
             flight.result = res
-            return res
+            return res, False
         finally:
             with self._sf_lock:
                 if self._inflight.get(key) is flight:
                     del self._inflight[key]
             flight.done.set()
+
+    def analyze_range(self, promql: str, start_s: int, step_s: int,
+                      end_s: int, planner_params=None):
+        """EXPLAIN ANALYZE execution (/api/v1/explain?analyze=true):
+        the SAME tenant admission, scheduler bound, and usage/slowlog
+        accounting as query_range — an unaccounted analyze endpoint
+        would be a free pass around the limits and the concurrency
+        bound — but runs a recorder-attached plan and bypasses the
+        result caches (annotations must reflect a real execution).
+        Returns (result, recorder, exec_tree); recorder/tree are None
+        when admission rejected the query.  Parse/planning errors
+        propagate (the HTTP edge turns them into 400s, exactly like the
+        plain explain path)."""
+        import uuid as _uuid
+
+        from filodb_tpu.promql.parser import (TimeStepParams,
+                                              query_range_to_logical_plan)
+        from filodb_tpu.query.execbase import AnalyzeRecorder
+        from filodb_tpu.query.rangevector import QueryContext, QueryResult
+        from filodb_tpu.utils.slowlog import slowlog
+        from filodb_tpu.utils.usage import tenant_of, usage
+        tenant = ("", "")
+        if self._usage_enabled:
+            tenant = tenant_of(promql)
+            err = usage.admit(tenant[0], tenant[1], self._warn_limit,
+                              self._fail_limit)
+            if err is not None:
+                return QueryResult([], error=err), None, None
+        t0 = _time.perf_counter()
+        plan = query_range_to_logical_plan(
+            promql, TimeStepParams(start_s, step_s, end_s))
+        ctx = QueryContext(query_id=_uuid.uuid4().hex[:16])
+        if planner_params is not None:
+            ctx.planner_params = planner_params
+        ep = self.engine.planner.materialize(plan, ctx)
+        rec = AnalyzeRecorder()
+        # plain attribute, NOT a dataclass field: remote-dispatched
+        # subtrees must serialize without it (see AnalyzeRecorder doc)
+        ctx.analyze = rec
+        sem = self._sem
+        waited = 0.0
+        acquired = False
+        if sem is not None:
+            tq = _time.perf_counter()
+            acquired = sem.acquire(timeout=self._ask_timeout_s)
+            waited = _time.perf_counter() - tq
+        try:
+            res = ep.execute(self.engine.source)
+        finally:
+            if acquired:
+                sem.release()
+        res.trace_id = ctx.query_id
+        res.stats.queue_wait_s += waited
+        dur = _time.perf_counter() - t0
+        if self._usage_enabled:
+            usage.record_query(tenant[0], tenant[1], dur,
+                               res.stats.samples_scanned,
+                               res.stats.result_bytes)
+        slowlog.maybe_record(promql, start_s, step_s, end_s, dur, res,
+                             tenant=tenant, origin="explain_analyze",
+                             threshold_s=self._slow_s)
+        return res, rec, ep
 
     # ----------------------------------------------------------- layers
 
@@ -115,13 +220,19 @@ class QueryFrontend:
         # never fail a query on queue pressure: a full queue just means
         # this request executes unthrottled after the wait (observable
         # via the counter rather than a user-visible error)
+        t0 = _time.perf_counter()
         acquired = sem.acquire(timeout=self._ask_timeout_s)
+        waited = _time.perf_counter() - t0
         if not acquired:
             from filodb_tpu.utils.metrics import registry
             registry.counter("query_scheduler_timeouts").increment()
         try:
-            return self.coalescer.query_range(promql, start_s, step_s,
-                                              end_s, pp)
+            res = self.coalescer.query_range(promql, start_s, step_s,
+                                             end_s, pp)
+            # queue attribution: scheduler wait is part of the query's
+            # serving cost but not of any exec node's cpu time
+            res.stats.queue_wait_s += waited
+            return res
         finally:
             if acquired:
                 sem.release()

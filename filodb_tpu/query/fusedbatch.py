@@ -126,12 +126,19 @@ def finish_fused_calls(calls: List[FusedCall]) -> List[AggPartial]:
                     .increment(launches)
                 registry.counter("fused_batch_merged_panels") \
                     .increment(len(take))
+            import time as _time
+
+            from filodb_tpu.utils.metrics import note_device_time
+            _t0 = _time.perf_counter()
             comps = pf.fused_leaf_agg_batch(
                 fc0.plan, fc0.values, panels, fc0.fn,
                 precorrected=fc0.precorrected, interpret=fc0.interpret,
                 ragged=fc0.ragged, num_series=fc0.num_series)
             for i, comp in zip(take, comps):
                 out[i] = _present(calls[i], comp)
+            # kernel dispatch + result readback (np conversion in _present
+            # synchronizes), attributed to the node that triggered it
+            note_device_time(_time.perf_counter() - _t0)
             idxs = idxs[len(take):]
     for i, j in alias.items():
         src = out[j]

@@ -11,6 +11,7 @@ import dataclasses
 import logging
 import os
 import threading
+import time as _time
 from typing import Callable, Dict, List, Optional, Sequence, Tuple, Union
 
 import numpy as np
@@ -61,7 +62,8 @@ class MultiSchemaPartitionsExec(LeafExecPlan):
         self._transformer_overrides: Dict[int, RangeVectorTransformer] = {}
         self._prefused = None
 
-    def execute_internal(self, source) -> QueryResultLike:
+    def _execute_impl(self, source) -> QueryResultLike:
+        # (wrapped by ExecPlan.execute_internal's resource tally)
         pre = getattr(self, "_prefused", None)
         if pre is not None:
             # phase-3 of engine.query_range_batch: the gather and fused
@@ -658,7 +660,10 @@ class MultiSchemaPartitionsExec(LeafExecPlan):
                 # pairing a newer snapshot's grid with an older one's values
                 # would feed the kernel zero-padded phantom columns
                 snap = mirror.snapshot()
+                from filodb_tpu.utils.metrics import note_device_time
+                _g0 = _time.perf_counter()
                 mirrored = mirror.gather_cached(rows, snap)
+                note_device_time(_time.perf_counter() - _g0)
         # value column selection: histograms gather [S, T, B]
         shared_ts_row = None
         dense = True

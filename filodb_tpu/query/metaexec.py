@@ -148,6 +148,9 @@ class PartKeysExec(LeafExecPlan):
             for p in parts:
                 keys.append({**p.part_key.tags_dict,
                              "_metric_": p.part_key.metric})
+        # metadata scans report their touched-series count too, so
+        # ?stats=true attribution covers /series like data queries
+        stats.series_scanned = len(keys)
         data = QueryResult([], stats, data=keys)
         return data, stats
 

@@ -54,6 +54,11 @@ class DistConcatExec(NonLeafExecPlan):
                     raise ValueError(
                         "cannot concat histogram blocks: some shards carry "
                         "no bucket boundaries")
+                # scheme drift across shards is a data-model event worth
+                # seeing at /metrics: rebucketing is correct but costs an
+                # O(S*T*B) remap per query until retention ages it out
+                from filodb_tpu.utils.metrics import registry
+                registry.counter("hist_concat_rebuckets").increment()
                 from filodb_tpu.memory.histogram import rebucket
                 raws = [dataclasses.replace(
                             r,

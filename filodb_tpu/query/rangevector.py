@@ -89,7 +89,15 @@ def concat_blocks(blocks: Sequence[ResultBlock]) -> Optional[ResultBlock]:
 
 @dataclasses.dataclass
 class QueryStats:
-    """ref: QueryStats / TimeSeriesShardStats query-side counters."""
+    """Per-query resource attribution, merged bottom-up through the exec
+    tree and carried over the wire with dispatch replies (ref: the
+    reference's QueryStats threaded through every ExecPlan +
+    TimeSeriesShardStats query-side counters; Prometheus `stats=all`).
+
+    Phase seconds are EXCLUSIVE per node and therefore additive: the
+    root's cpu_seconds is the sum of every node's own work (remote nodes
+    included — their stats merge in from the reply), never a
+    double-count of nested wall time.  See utils.metrics._ExecTally."""
     samples_scanned: int = 0
     series_scanned: int = 0
     result_samples: int = 0
@@ -97,6 +105,23 @@ class QueryStats:
     # set when allow_partial_results dropped an unreachable child —
     # propagates bottom-up through merge() to the root QueryResult
     partial: bool = False
+    # --- phase attribution (seconds) ---
+    queue_wait_s: float = 0.0       # frontend scheduler semaphore wait
+    parse_s: float = 0.0            # PromQL → logical plan
+    plan_s: float = 0.0             # logical plan → exec tree
+    cpu_seconds: float = 0.0        # host work inside exec nodes (exclusive)
+    device_seconds: float = 0.0     # device gather + kernel dispatch wall
+    transfer_s: float = 0.0         # host→device uploads + wire round-trips
+    # --- bytes ---
+    bytes_transferred: int = 0      # host→device upload + wire reply bytes
+    result_bytes: int = 0           # final result-matrix bytes at the root
+    # --- cache attribution ---
+    # result-cache verdict for this poll: "" (bypass) | "hit" | "partial"
+    # | "miss" — set by the serving frontend, not merged bottom-up
+    result_cache: str = ""
+    # device-mirror uploads THIS query paid for on its critical path
+    mirror_full_rebuilds: int = 0
+    mirror_incremental: int = 0
 
     def merge(self, other: "QueryStats") -> None:
         self.samples_scanned += other.samples_scanned
@@ -104,6 +129,42 @@ class QueryStats:
         self.result_samples += other.result_samples
         self.shards_queried += other.shards_queried
         self.partial = self.partial or other.partial
+        self.queue_wait_s += other.queue_wait_s
+        self.parse_s += other.parse_s
+        self.plan_s += other.plan_s
+        self.cpu_seconds += other.cpu_seconds
+        self.device_seconds += other.device_seconds
+        self.transfer_s += other.transfer_s
+        self.bytes_transferred += other.bytes_transferred
+        self.result_bytes += other.result_bytes
+        self.result_cache = self.result_cache or other.result_cache
+        self.mirror_full_rebuilds += other.mirror_full_rebuilds
+        self.mirror_incremental += other.mirror_incremental
+
+    def to_dict(self) -> Dict[str, object]:
+        """The `?stats=true` wire shape (http/routes attaches it to the
+        query_range payload; doc/observability.md documents the fields)."""
+        return {
+            "samplesScanned": self.samples_scanned,
+            "seriesScanned": self.series_scanned,
+            "resultSamples": self.result_samples,
+            "resultBytes": self.result_bytes,
+            "shardsQueried": self.shards_queried,
+            "bytesTransferred": self.bytes_transferred,
+            "phases": {
+                "queue_s": round(self.queue_wait_s, 6),
+                "parse_s": round(self.parse_s, 6),
+                "plan_s": round(self.plan_s, 6),
+                "exec_s": round(self.cpu_seconds, 6),
+                "device_s": round(self.device_seconds, 6),
+                "transfer_s": round(self.transfer_s, 6),
+            },
+            "cache": {
+                "result": self.result_cache,
+                "mirrorFullRebuilds": self.mirror_full_rebuilds,
+                "mirrorIncremental": self.mirror_incremental,
+            },
+        }
 
 
 @dataclasses.dataclass

@@ -175,11 +175,14 @@ class ResultCache:
         if n_reuse == 0:
             registry.counter("query_result_cache_misses").increment()
             res = run(start_s, end_s)
+            res.stats.result_cache = "miss"
             self._store(key, wends_new, res, token, horizon_ms)
             return res
         if n_reuse == wends_new.size:
             registry.counter("query_result_cache_hits").increment()
-            return self._from_cache(ent, wends_new)
+            res = self._from_cache(ent, wends_new)
+            res.stats.result_cache = "hit"
+            return res
         # partial hit: compute only the non-final tail and merge
         registry.counter("query_result_cache_partial_hits").increment()
         tail_start_s = int(wends_new[n_reuse]) // 1000
@@ -190,11 +193,15 @@ class ResultCache:
             # the entry so a degraded system pays ONE full run per poll
             # from here on, not tail + full every time
             self._drop(key, ent)
-            return run(start_s, end_s)
+            res = run(start_s, end_s)
+            res.stats.result_cache = "miss"
+            return res
         tail_map = _series_map(tail, wends_new.size - n_reuse)
         if tail_map is None:
             self._drop(key, ent)
-            return run(start_s, end_s)
+            res = run(start_s, end_s)
+            res.stats.result_cache = "miss"
+            return res
         merged: Dict[RangeVectorKey, np.ndarray] = {}
         W = wends_new.size
         off = int(np.searchsorted(ent.wends, wends_new[0]))
@@ -208,6 +215,7 @@ class ResultCache:
                 out = merged[k] = np.full(W, np.nan)
             out[n_reuse:] = row
         res = self._build_result(merged, wends_new, tail.stats)
+        res.stats.result_cache = "partial"
         res.trace_id = tail.trace_id
         self._insert(key, _Entry(
             wends_new, merged, min(horizon_ms, int(wends_new[-1])), token,
@@ -230,8 +238,10 @@ class ResultCache:
         keys = list(series)
         vals = np.stack([series[k] for k in keys])
         block = remove_nan_series(ResultBlock(keys, wends, vals))
-        st = QueryStats(result_samples=int(vals.size),
-                        shards_queried=stats.shards_queried)
+        # keep the tail run's phase/resource attribution (that IS the
+        # cost this poll paid) — only the result-shape counters change
+        st = dataclasses.replace(stats, result_samples=int(vals.size),
+                                 result_bytes=int(vals.nbytes))
         return QueryResult([block] if block is not None else [], st)
 
     def _drop(self, key, ent: _Entry) -> None:

@@ -66,6 +66,16 @@ class FiloServer:
         self.ds_stores: Dict[str, object] = {}
         self.flush_schedulers: Dict[str, object] = {}
         self._earliest_cache: Dict[str, tuple] = {}
+        # observability singletons take their knobs from THIS server's
+        # settings: the slow-query flight recorder (ring size, JSONL
+        # sink) and the per-tenant usage window (utils/slowlog, usage)
+        from filodb_tpu.utils.slowlog import slowlog
+        from filodb_tpu.utils.usage import usage
+        slowlog.configure(
+            threshold_s=self.config.query.slow_query_threshold_s,
+            max_entries=self.config.query.slowlog_max_entries,
+            path=self.config.query.slowlog_path)
+        usage.window_s = self.config.query.tenant_limit_window_s
         for dc in self.datasets:
             self._setup_dataset(dc)
         first = self.datasets[0].name

@@ -57,13 +57,17 @@ _DEFAULT_BOUNDS = (0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1, 5, 10, 50, 100,
 
 
 class Histogram:
-    __slots__ = ("bounds", "counts", "sum", "count", "_lock")
+    __slots__ = ("bounds", "counts", "sum", "count", "max", "_lock")
 
     def __init__(self, bounds: Sequence[float] = _DEFAULT_BOUNDS):
         self.bounds = tuple(bounds)
         self.counts = [0] * (len(self.bounds) + 1)
         self.sum = 0.0
         self.count = 0
+        # largest value ever recorded: bounds the overflow-bucket
+        # percentile estimate (a 752 s p99 and a 5.1 s p99 both land in
+        # the +Inf bucket; without the max they'd report identically)
+        self.max = 0.0
         self._lock = threading.Lock()
 
     def record(self, v: float) -> None:
@@ -72,20 +76,32 @@ class Histogram:
             self.counts[i] += 1
             self.sum += v
             self.count += 1
+            if v > self.max:
+                self.max = v
 
     def percentile(self, q: float) -> float:
-        """Approximate percentile from bucket upper bounds."""
+        """Approximate percentile, linearly interpolated within the
+        containing bucket (Prometheus histogram_quantile semantics)
+        instead of reporting the bucket's upper bound.  The overflow
+        (+Inf) bucket interpolates between the last finite bound and the
+        maximum value observed — an explicit estimate rather than the
+        old behavior of capping at the top bound."""
         with self._lock:
             if self.count == 0:
                 return 0.0
             target = q * self.count
             acc = 0
             for i, c in enumerate(self.counts):
+                if c == 0:
+                    continue
+                if acc + c >= target:
+                    lo = self.bounds[i - 1] if i > 0 else 0.0
+                    hi = self.bounds[i] if i < len(self.bounds) \
+                        else max(self.max, self.bounds[-1])
+                    frac = (target - acc) / c
+                    return lo + frac * (hi - lo)
                 acc += c
-                if acc >= target:
-                    return self.bounds[i] if i < len(self.bounds) \
-                        else self.bounds[-1]
-        return self.bounds[-1]
+            return max(self.max, self.bounds[-1])
 
 
 class MetricsRegistry:
@@ -134,8 +150,13 @@ class MetricsRegistry:
         (ref: Kamon prometheus reporter, README:812-819)."""
         out: List[str] = []
 
+        def esc(v: str) -> str:
+            # the exposition-format label escapes: backslash, quote, newline
+            return str(v).replace("\\", "\\\\").replace('"', '\\"') \
+                .replace("\n", "\\n")
+
         def fmt_tags(tags: TagTuple, extra: str = "") -> str:
-            items = [f'{k}="{v}"' for k, v in tags]
+            items = [f'{k}="{esc(v)}"' for k, v in tags]
             if extra:
                 items.append(extra)
             return "{" + ",".join(items) + "}" if items else ""
@@ -151,21 +172,91 @@ class MetricsRegistry:
         for (name, tags), g in sorted(gauges):
             out.append(f"{name}{fmt_tags(tags)} {g.value:g}")
         for (name, tags), h in sorted(hists):
+            # per-histogram snapshot under ITS lock: counts/sum/count
+            # mutate together in record(), and reading them lock-free
+            # while formatting could emit a bucket total above _count
+            # (sum updated, count not yet) — a torn exposition
+            with h._lock:
+                counts = list(h.counts)
+                h_sum, h_count = h.sum, h.count
             acc = 0
             for i, b in enumerate(h.bounds):
-                acc += h.counts[i]
+                acc += counts[i]
                 le_tag = 'le="%g"' % b
                 out.append(f"{name}_bucket{fmt_tags(tags, le_tag)} "
                            f"{acc}")
             inf_tag = 'le="+Inf"'
             out.append(f"{name}_bucket{fmt_tags(tags, inf_tag)} "
-                       f"{h.count}")
-            out.append(f"{name}_sum{fmt_tags(tags)} {h.sum:g}")
-            out.append(f"{name}_count{fmt_tags(tags)} {h.count}")
+                       f"{h_count}")
+            out.append(f"{name}_sum{fmt_tags(tags)} {h_sum:g}")
+            out.append(f"{name}_count{fmt_tags(tags)} {h_count}")
         return "\n".join(out) + "\n"
 
 
 registry = MetricsRegistry()
+
+
+# ----------------------------------------------------- exec resource tally
+
+class _ExecTally(threading.local):
+    """Per-thread accumulators attributing device time, host→device
+    transfer, and mirror-refresh events to the exec node that triggered
+    them (the Kamon-context analogue for QueryStats attribution; PR 3).
+
+    Protocol: ExecPlan.execute_internal snapshots + zeroes the fields on
+    entry, folds whatever its own work accumulated into its QueryStats on
+    exit, then restores the outer values — so a parent node never
+    re-claims what a child already attributed (child contributions arrive
+    via QueryStats.merge instead).  `child_wall` carries nested nodes'
+    wall seconds up, letting each node compute its EXCLUSIVE cpu time."""
+
+    def __init__(self):
+        self.child_wall = 0.0
+        self.device_s = 0.0
+        self.transfer_s = 0.0
+        self.transfer_bytes = 0
+        self.mirror_full = 0
+        self.mirror_incremental = 0
+
+    def snapshot(self):
+        s = (self.child_wall, self.device_s, self.transfer_s,
+             self.transfer_bytes, self.mirror_full, self.mirror_incremental)
+        self.child_wall = 0.0
+        self.device_s = 0.0
+        self.transfer_s = 0.0
+        self.transfer_bytes = 0
+        self.mirror_full = 0
+        self.mirror_incremental = 0
+        return s
+
+    def restore(self, snap, total_wall: float) -> None:
+        (self.child_wall, self.device_s, self.transfer_s,
+         self.transfer_bytes, self.mirror_full,
+         self.mirror_incremental) = snap
+        self.child_wall += total_wall
+
+
+exec_tally = _ExecTally()
+
+
+def note_device_time(seconds: float) -> None:
+    """Attribute device dispatch/kernel wall time to the current node."""
+    exec_tally.device_s += seconds
+
+
+def note_transfer(nbytes: int, seconds: float) -> None:
+    """Attribute a host→device (or wire) transfer to the current node."""
+    exec_tally.transfer_bytes += int(nbytes)
+    exec_tally.transfer_s += seconds
+
+
+def note_mirror_refresh(kind: str) -> None:
+    """kind: 'full' | 'incremental' — query-path mirror uploads, so
+    QueryStats can say WHICH query paid for a rebuild."""
+    if kind == "full":
+        exec_tally.mirror_full += 1
+    else:
+        exec_tally.mirror_incremental += 1
 
 
 # ------------------------------------------------------------------ spans
@@ -173,6 +264,16 @@ registry = MetricsRegistry()
 SpanReporter = Callable[[str, float, Dict[str, str]], None]
 _reporters: List[SpanReporter] = []
 _active = threading.local()
+
+# process-wide span kill switch (bench.py observability stage: measures
+# the span pipeline's own overhead by toggling this off).  Stats tallies
+# are NOT affected — only histogram/trace/reporter work is skipped.
+SPANS_ENABLED = True
+
+
+def set_spans_enabled(flag: bool) -> None:
+    global SPANS_ENABLED
+    SPANS_ENABLED = bool(flag)
 
 # node identity stamped on every collected span event (set by nodeapp /
 # standalone at startup) so a stitched cross-node trace shows placement
@@ -282,6 +383,9 @@ class span:
         self.tags = tags
 
     def __enter__(self):
+        if not SPANS_ENABLED:
+            self._t0 = None
+            return self
         stack = getattr(_active, "stack", None)
         if stack is None:
             stack = _active.stack = []
@@ -290,6 +394,8 @@ class span:
         return self
 
     def __exit__(self, exc_type, exc, tb):
+        if self._t0 is None:
+            return False
         elapsed = time.perf_counter() - self._t0
         stack = _active.stack
         full = ".".join(stack)

@@ -26,6 +26,10 @@ FrameKey = Tuple[str, str, int]       # (function, file, first line)
 class SamplingProfiler:
 
     MAX_HZ = 1000.0
+    # bound on distinct collapsed stacks kept (each full stack tuple is
+    # one Counter key); overflow hits aggregate under a sentinel frame so
+    # the report says truncation happened instead of silently dropping
+    MAX_STACKS = 10_000
 
     def __init__(self):
         self._lock = threading.Lock()
@@ -37,6 +41,10 @@ class SamplingProfiler:
         self.samples = 0
         self._self_hits: Dict[FrameKey, int] = collections.Counter()
         self._cum_hits: Dict[FrameKey, int] = collections.Counter()
+        # root-first stack tuples -> hit counts (the collapsed-stack /
+        # flamegraph source; /admin/profiler/report?format=collapsed)
+        self._stack_hits: Dict[Tuple[FrameKey, ...], int] = \
+            collections.Counter()
         self.started_at: Optional[float] = None
         self.hz = 0.0
 
@@ -57,6 +65,7 @@ class SamplingProfiler:
             self.samples = 0
             self._self_hits = collections.Counter()
             self._cum_hits = collections.Counter()
+            self._stack_hits = collections.Counter()
             self.started_at = time.time()
             stop_evt = threading.Event()
             self._stop = stop_evt
@@ -95,10 +104,12 @@ class SamplingProfiler:
                     seen = set()
                     top = True
                     f = frame
+                    stack = []                  # leaf-first while walking
                     while f is not None:
                         code = f.f_code
                         key = (code.co_name, code.co_filename,
                                code.co_firstlineno)
+                        stack.append(key)
                         if top:
                             self._self_hits[key] += 1
                             top = False
@@ -106,6 +117,13 @@ class SamplingProfiler:
                             self._cum_hits[key] += 1
                             seen.add(key)
                         f = f.f_back
+                    # collapsed form is root-first; cap distinct stacks
+                    skey = tuple(reversed(stack))
+                    if skey in self._stack_hits or \
+                            len(self._stack_hits) < self.MAX_STACKS:
+                        self._stack_hits[skey] += 1
+                    else:
+                        self._stack_hits[_TRUNCATED] += 1
 
     # ------------------------------------------------------------- report
 
@@ -133,6 +151,26 @@ class SamplingProfiler:
                          f"{100.0 * cum / samples:6.2f}  "
                          f"{name} ({fname}:{line})")
         return "\n".join(lines)
+
+
+    def report_collapsed(self) -> str:
+        """Collapsed-stack output: one line per distinct stack,
+        root-first frames `;`-joined, trailing hit count — directly
+        loadable by speedscope / Brendan Gregg's flamegraph.pl (served
+        at /admin/profiler/report?format=collapsed)."""
+        with self._lock:
+            stacks = dict(self._stack_hits)
+        lines = []
+        for skey, hits in sorted(stacks.items(),
+                                 key=lambda kv: -kv[1]):
+            frames = ";".join(
+                f"{name} ({fname}:{line})" for name, fname, line in skey)
+            lines.append(f"{frames} {hits}")
+        return "\n".join(lines) + ("\n" if lines else "")
+
+
+# sentinel stack for hits past the MAX_STACKS distinct-stack cap
+_TRUNCATED: Tuple[FrameKey, ...] = (("[stacks-truncated]", "", 0),)
 
 
 # process-wide instance the HTTP admin routes drive

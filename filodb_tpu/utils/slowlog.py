@@ -1,0 +1,132 @@
+"""Slow-query flight recorder.
+
+The serving frontend records every query whose total wall (queue wait
+included) exceeds `query.slow_query_threshold_s` into a bounded ring
+buffer: the promql, grid params, tenant, the full QueryStats phase
+attribution, and the stitched cross-node span tree captured at record
+time (trace buffers are bounded and recycle — a slowlog entry must not
+dangle a trace id that has already been evicted).  Exposed at
+GET /admin/slowlog and optionally mirrored to a JSONL sink
+(`query.slowlog_path`) for offline triage.
+
+This is the MySQL-slow-log / Monarch-query-annal shape: when the p99
+spikes, the operator reads the actual offending queries with their
+queue/parse/plan/exec/device/transfer breakdown instead of inferring
+from aggregate histograms.  SOAK_LONG_r05's 752 s eviction-window query
+is exactly the record this would have captured.
+"""
+from __future__ import annotations
+
+import collections
+import json
+import logging
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+log = logging.getLogger("filodb.slowlog")
+
+
+class SlowQueryLog:
+
+    def __init__(self, threshold_s: float = 10.0, max_entries: int = 128,
+                 path: str = ""):
+        self.threshold_s = threshold_s
+        self.path = path
+        self._lock = threading.Lock()
+        self._entries: collections.deque = collections.deque(
+            maxlen=max_entries)
+        self._seq = 0
+
+    def configure(self, threshold_s: Optional[float] = None,
+                  max_entries: Optional[int] = None,
+                  path: Optional[str] = None) -> "SlowQueryLog":
+        """Apply config (standalone.FiloServer at boot; tests directly).
+        Shrinking max_entries keeps the newest records."""
+        with self._lock:
+            if threshold_s is not None:
+                self.threshold_s = threshold_s
+            if path is not None:
+                self.path = path
+            if max_entries is not None and \
+                    max_entries != self._entries.maxlen:
+                self._entries = collections.deque(self._entries,
+                                                  maxlen=max_entries)
+        return self
+
+    # ------------------------------------------------------------ record
+
+    def maybe_record(self, promql: str, start_s: int, step_s: int,
+                     end_s: int, duration_s: float, result,
+                     tenant: Tuple[str, str] = ("", ""),
+                     origin: str = "query_range",
+                     threshold_s: Optional[float] = None) -> bool:
+        """Record iff duration crossed the threshold (the caller's
+        config override wins over the singleton's).  `result` is the
+        QueryResult (stats + trace_id + error ride along).  Returns
+        whether a record was taken."""
+        thr = self.threshold_s if threshold_s is None else threshold_s
+        if thr <= 0 or duration_s < thr:
+            return False
+        from filodb_tpu.utils.metrics import collector, registry
+        trace_id = getattr(result, "trace_id", "") or ""
+        spans: List[dict] = []
+        if trace_id:
+            # copy NOW: the trace collector's ring recycles old traces
+            spans = sorted(collector.trace(trace_id),
+                           key=lambda e: e.get("end_unix_s", 0))
+        stats = getattr(result, "stats", None)
+        rec = {
+            "unix_ts": round(time.time(), 3),
+            "origin": origin,
+            "promql": promql,
+            "start_s": int(start_s), "step_s": int(step_s),
+            "end_s": int(end_s),
+            "duration_s": round(duration_s, 6),
+            "tenant": {"ws": tenant[0], "ns": tenant[1]},
+            "trace_id": trace_id,
+            "error": getattr(result, "error", None),
+            "partial": bool(getattr(result, "partial", False)),
+            "stats": stats.to_dict() if stats is not None else None,
+            "spans": spans,
+        }
+        with self._lock:
+            self._seq += 1
+            rec["seq"] = self._seq
+            self._entries.append(rec)
+        registry.counter("slow_queries", origin=origin).increment()
+        log.warning("slow query (%.2fs > %.2fs): %s [%s..%s step %s] "
+                    "trace=%s", duration_s, thr, promql,
+                    start_s, end_s, step_s, trace_id)
+        if self.path:
+            try:
+                with self._lock:   # serialize appends; keep lines whole
+                    with open(self.path, "a") as f:
+                        f.write(json.dumps(rec) + "\n")
+            except OSError as e:
+                # the sink is best-effort; the ring buffer is the record
+                registry.counter("slowlog_sink_errors").increment()
+                log.warning("slowlog sink %s failed: %s", self.path, e)
+        return True
+
+    # ------------------------------------------------------------- read
+
+    def entries(self, limit: int = 0) -> List[dict]:
+        """Newest-last snapshot (the /admin/slowlog payload)."""
+        with self._lock:
+            out = list(self._entries)
+        return out[-limit:] if limit else out
+
+    def clear(self) -> int:
+        with self._lock:
+            n = len(self._entries)
+            self._entries.clear()
+        return n
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+
+# process-wide instance: the frontend records into it, /admin/slowlog
+# reads it, standalone.FiloServer configures it from FilodbSettings
+slowlog = SlowQueryLog()

@@ -1,0 +1,165 @@
+"""Strict Prometheus text-exposition validation of /metrics (tier-1).
+
+A malformed exposition must never ship: Prometheus silently drops bad
+scrape bodies, which reads as "the server is fine" while every alert
+goes dark.  This parses EVERY line of the live registry's output —
+including metrics other tests seeded — against the exposition grammar:
+metric-name regex, fully-escaped label values, monotone non-decreasing
+bucket counts with ascending `le` bounds, and `_sum`/`_count`
+consistency per histogram family.
+"""
+import math
+import re
+
+import numpy as np
+
+from filodb_tpu.utils.metrics import Histogram, registry
+
+_NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+_LABEL_NAME_RE = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
+_VALUE_RE = re.compile(
+    r"^(-?[0-9]+(\.[0-9]+)?([eE][+-]?[0-9]+)?|[-+]?Inf|NaN)$")
+# one label pair: name="value" with only \\ \" \n escapes inside
+_PAIR_RE = re.compile(
+    r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\\n]|\\\\|\\"|\\n)*)"(?:,|$)')
+
+
+def _parse_line(line):
+    """(name, labels_dict, value) or raise AssertionError."""
+    m = re.match(r"^([^{ ]+)(\{(.*)\})? (.+)$", line)
+    assert m, f"unparsable exposition line: {line!r}"
+    name, _, labels_raw, value = m.groups()
+    assert _NAME_RE.match(name), f"bad metric name: {name!r}"
+    labels = {}
+    if labels_raw:
+        pos = 0
+        while pos < len(labels_raw):
+            pm = _PAIR_RE.match(labels_raw, pos)
+            assert pm, (f"bad label syntax at {labels_raw[pos:]!r} "
+                        f"in: {line!r}")
+            assert _LABEL_NAME_RE.match(pm.group(1))
+            labels[pm.group(1)] = pm.group(2)
+            pos = pm.end()
+    assert _VALUE_RE.match(value), f"bad sample value {value!r} in {line!r}"
+    return name, labels, float(value.replace("Inf", "inf")
+                               .replace("NaN", "nan"))
+
+
+def _strict_parse(text):
+    """Parse a full exposition body; returns {(name, frozen_labels): value}
+    and the per-histogram family structures for consistency checks."""
+    samples = {}
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        name, labels, value = _parse_line(line)
+        key = (name, tuple(sorted(labels.items())))
+        assert key not in samples, f"duplicate sample: {key}"
+        samples[key] = value
+    return samples
+
+
+def _histogram_families(samples):
+    """{(base, labels-without-le): {"buckets": [(le, v)], "sum", "count"}}"""
+    fams = {}
+    for (name, labels), value in samples.items():
+        if name.endswith("_bucket"):
+            base = name[:-len("_bucket")]
+            lab = dict(labels)
+            le = lab.pop("le")
+            fam = fams.setdefault((base, tuple(sorted(lab.items()))), {})
+            fam.setdefault("buckets", []).append((le, value))
+        elif name.endswith("_sum") and (name[:-4], labels) not in samples:
+            # a histogram's _sum (counters end _total, gauges are bare)
+            fams.setdefault((name[:-4], labels), {})["sum"] = value
+        elif name.endswith("_count"):
+            fams.setdefault((name[:-6], labels), {})["count"] = value
+    return {k: v for k, v in fams.items() if "buckets" in v}
+
+
+def test_metrics_exposition_is_strictly_parseable():
+    # seed nasty label values: the escaping satellite's regression net
+    registry.counter("expo_strict_ops",
+                     path='a"b\\c\nd', ok="yes").increment(3)
+    registry.gauge("expo_strict_depth", unit="ms").update(-1.5)
+    h = registry.histogram("expo_strict_lat", route="/x")
+    for v in (0.002, 0.04, 7.0, 1e9):      # incl. overflow bucket
+        h.record(v)
+    text = registry.expose_prometheus()
+    samples = _strict_parse(text)
+    # the escaped label round-trips: unescape recovers the original
+    esc = [v for (n, labels), v in samples.items()
+           if n == "expo_strict_ops_total" and dict(labels).get("ok") == "yes"]
+    assert len(esc) == 1
+    raw = [dict(labels)["path"] for (n, labels) in samples
+           if n == "expo_strict_ops_total"][0]
+    assert raw.replace("\\\\", "\x00").replace('\\"', '"') \
+        .replace("\\n", "\n").replace("\x00", "\\") == 'a"b\\c\nd'
+
+    fams = _histogram_families(samples)
+    assert ("expo_strict_lat", (("route", "/x"),)) in fams
+    for (base, labels), fam in fams.items():
+        where = f"{base}{dict(labels)}"
+        assert "sum" in fam, f"{where}: missing _sum"
+        assert "count" in fam, f"{where}: missing _count"
+        # le bounds ascending with +Inf last; cumulative counts monotone
+        les = [le for le, _ in fam["buckets"]]
+        assert les.count("+Inf") == 1 and les[-1] == "+Inf", where
+        bounds = [float(le) for le in les[:-1]]
+        assert bounds == sorted(bounds), f"{where}: le not ascending"
+        counts = [v for _, v in fam["buckets"]]
+        assert all(b >= a for a, b in zip(counts, counts[1:])), \
+            f"{where}: bucket counts not monotone"
+        assert counts[-1] == fam["count"], \
+            f"{where}: +Inf bucket != _count"
+        assert math.isfinite(fam["sum"]), where
+
+
+def test_exposition_survives_concurrent_histogram_writes():
+    """The expose-vs-record race (satellite 1): a scrape formatting a
+    histogram mid-record must never emit a cumulative bucket count above
+    its _count.  Hammer one histogram from threads while scraping."""
+    import threading
+
+    h = registry.histogram("expo_race_lat")
+    stop = threading.Event()
+
+    def writer():
+        rng = np.random.default_rng(0)
+        while not stop.is_set():
+            h.record(float(rng.random() * 10))
+
+    threads = [threading.Thread(target=writer, daemon=True)
+               for _ in range(4)]
+    for t in threads:
+        t.start()
+    try:
+        for _ in range(50):
+            samples = _strict_parse(registry.expose_prometheus())
+            fams = _histogram_families(samples)
+            fam = fams.get(("expo_race_lat", ()))
+            assert fam is not None
+            counts = [v for _, v in fam["buckets"]]
+            assert all(b >= a for a, b in zip(counts, counts[1:]))
+            assert counts[-1] == fam["count"]
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=5)
+
+
+def test_percentile_interpolates_and_estimates_overflow():
+    h = Histogram(bounds=(1.0, 10.0))
+    for _ in range(99):
+        h.record(5.0)
+    h.record(752.0)                      # the SOAK_LONG_r05 outlier shape
+    # p50 interpolated inside (1, 10], not snapped to 10
+    assert 1.0 < h.percentile(0.5) < 10.0
+    # p100 reaches toward the observed max instead of capping at 10
+    assert h.percentile(1.0) == 752.0
+    # two histograms equal except their overflow magnitude now DIFFER
+    h2 = Histogram(bounds=(1.0, 10.0))
+    for _ in range(99):
+        h2.record(5.0)
+    h2.record(11.0)
+    assert h.percentile(1.0) > h2.percentile(1.0)

@@ -1,0 +1,469 @@
+"""PR 3 observability: per-query resource attribution (QueryStats phase
+seconds merged bottom-up and over the wire), explain?analyze per-node
+annotations, result-cache / device-mirror cache attribution, the
+slow-query flight recorder, and per-tenant usage accounting + limits.
+"""
+import json
+import time
+
+import numpy as np
+import pytest
+
+from filodb_tpu.config import FilodbSettings
+from filodb_tpu.core.index import Equals
+from filodb_tpu.core.memstore import TimeSeriesMemStore
+from filodb_tpu.core.records import RecordBatch
+from filodb_tpu.ingest.generator import counter_batch
+from filodb_tpu.parallel.shardmapper import ShardEvent, ShardMapper
+from filodb_tpu.parallel.transport import (NodeQueryServer,
+                                           RemoteNodeDispatcher)
+from filodb_tpu.query.engine import QueryEngine
+from filodb_tpu.query.exec import (AggregateMapReduce, AnalyzeRecorder,
+                                   DistConcatExec, MultiSchemaPartitionsExec,
+                                   PeriodicSamplesMapper)
+from filodb_tpu.query.frontend import QueryFrontend
+from filodb_tpu.query.rangevector import QueryContext
+from filodb_tpu.utils.slowlog import SlowQueryLog, slowlog
+from filodb_tpu.utils.usage import UsageAccountant, tenant_of, usage
+
+START = 1_600_000_000_000
+S_SEC = START // 1000
+Q = 'sum by (_ns_)(rate(request_total[5m]))'
+
+
+def _slice(full, lo_i, hi_i):
+    keep = ((full.timestamps >= START + lo_i * 10_000)
+            & (full.timestamps < START + hi_i * 10_000))
+    return RecordBatch(full.schema, full.part_keys, full.part_idx[keep],
+                       full.timestamps[keep],
+                       {k: v[keep] for k, v in full.columns.items()},
+                       full.bucket_les)
+
+
+@pytest.fixture()
+def store2shard():
+    ms = TimeSeriesMemStore()
+    full = counter_batch(40, 300, start_ms=START)
+    for s in (0, 1):
+        ms.setup("prometheus", s)
+    # route half the keys to each shard by part_idx parity
+    even = full.part_idx % 2 == 0
+    for s, mask in ((0, even), (1, ~even)):
+        ms.get_shard("prometheus", s).ingest(RecordBatch(
+            full.schema, full.part_keys, full.part_idx[mask],
+            full.timestamps[mask],
+            {k: v[mask] for k, v in full.columns.items()},
+            full.bucket_les))
+    mapper = ShardMapper(2)
+    for s in (0, 1):
+        mapper.update_from_event(
+            ShardEvent("IngestionStarted", "prometheus", s, "local"))
+    return ms, QueryEngine("prometheus", ms, mapper)
+
+
+# ----------------------------------------------- stats totals vs the tree
+
+
+def test_stats_totals_equal_sum_over_exec_nodes(store2shard):
+    ms, eng = store2shard
+    from filodb_tpu.promql.parser import (TimeStepParams,
+                                          query_range_to_logical_plan)
+    plan = query_range_to_logical_plan(
+        Q, TimeStepParams(S_SEC + 600, 60, S_SEC + 2800))
+    ctx = QueryContext(query_id="analyze-1")
+    ep = eng.planner.materialize(plan, ctx)
+    rec = AnalyzeRecorder()
+    ctx.analyze = rec
+    res = ep.execute(ms)
+    assert res.error is None, res.error
+    st = res.stats
+    # exclusive per-node seconds are additive: their sum IS the root cpu
+    assert rec.order, "no nodes recorded"
+    assert sum(n["self_s"] for n in rec.order) == pytest.approx(
+        st.cpu_seconds, rel=1e-6)
+    assert sum(n["device_s"] for n in rec.order) == pytest.approx(
+        st.device_seconds, rel=1e-6)
+    # leaf scan counters sum to the root's (leaves report their own scan)
+    leaves = [n for n in rec.order
+              if n["plan"] == "MultiSchemaPartitionsExec"]
+    assert len(leaves) == 2              # one per shard
+    assert sum(n["samples_scanned"] for n in leaves) == st.samples_scanned
+    assert sum(n["series_scanned"] for n in leaves) == st.series_scanned
+    assert st.shards_queried == 2
+    # annotated tree carries the attribution inline
+    tree = ep.print_tree(annot=rec.annotation)
+    assert "[self=" in tree and "samples=" in tree
+    # the wire dict exposes the same totals
+    d = st.to_dict()
+    assert d["phases"]["exec_s"] == pytest.approx(st.cpu_seconds, abs=1e-6)
+    assert d["samplesScanned"] == st.samples_scanned
+
+
+def test_stats_reconcile_with_stitched_trace(store2shard):
+    """The per-phase attribution must agree with the span tree: every
+    exec node produced a span under the query's trace id, and the trace's
+    execplan span durations bound the stats' exec seconds from above
+    (span wall includes children; cpu_seconds is exclusive)."""
+    ms, eng = store2shard
+    from filodb_tpu.utils.metrics import collector
+    res = eng.query_range(Q, S_SEC + 600, 60, S_SEC + 2800)
+    assert res.error is None
+    evs = collector.trace(res.trace_id)
+    exec_spans = [e for e in evs if e["span"].startswith("execplan")]
+    assert exec_spans, "exec nodes left no spans in the trace"
+    root_wall = max(e["dur_s"] for e in exec_spans)
+    assert res.stats.cpu_seconds <= root_wall + 0.05
+    assert res.stats.cpu_seconds > 0
+
+
+# ----------------------------------------------------- wire round-trip
+
+
+def test_stats_survive_wire_roundtrip_two_nodes():
+    ms = TimeSeriesMemStore()
+    ms.setup("prometheus", 0).ingest(counter_batch(16, 240, start_ms=START))
+    server = NodeQueryServer(ms).start()
+    try:
+        host, port = server.address
+        ctx = QueryContext(query_id="wire-1")
+        leaf = MultiSchemaPartitionsExec(
+            ctx, "prometheus", 0, [Equals("_metric_", "request_total")],
+            START, START + 3_600_000)
+        leaf.add_transformer(PeriodicSamplesMapper(
+            START + 600_000, 60_000, START + 2_400_000, 300_000, "rate", ()))
+        leaf.add_transformer(AggregateMapReduce("sum", (), (), ()))
+        leaf.dispatcher = RemoteNodeDispatcher(host, port, timeout_s=30)
+        root = DistConcatExec(ctx, [leaf])
+        res = root.execute(ms)
+        assert res.error is None, res.error
+        st = res.stats
+        # the remote's exec attribution merged into the coordinator root
+        assert st.samples_scanned > 0 and st.shards_queried == 1
+        assert st.cpu_seconds > 0
+        # wire bytes: request frame + reply frame counted
+        assert st.bytes_transferred > 0
+    finally:
+        server.stop()
+
+
+# ------------------------------------------------- cache attribution
+
+
+def test_cold_vs_cached_repoll_cache_attribution():
+    ms = TimeSeriesMemStore()
+    sh = ms.setup("prometheus", 0)
+    full = counter_batch(30, 360, start_ms=START)
+    sh.ingest(_slice(full, 0, 240), offset=0)
+    eng = QueryEngine("prometheus", ms)
+    fe = QueryFrontend(eng)
+    args = (S_SEC + 600, 60, S_SEC + 2390)
+    cold = fe.query_range(Q, *args)
+    assert cold.error is None
+    assert cold.stats.result_cache == "miss"
+    assert cold.stats.samples_scanned > 0
+    warm = fe.query_range(Q, *args)
+    assert warm.stats.result_cache == "hit"
+    assert warm.stats.samples_scanned == 0      # nothing rescanned
+    # live edge advances -> slid re-poll recomputes only the tail (the
+    # device-mirror leaf gathers whole rows, so the scan COUNT can match
+    # a full recompute's — the attribution verdict is what must differ)
+    sh.ingest(_slice(full, 240, 360), offset=1)
+    part = fe.query_range(Q, S_SEC + 720, 60, S_SEC + 3590)
+    assert part.stats.result_cache == "partial"
+    recompute = eng.query_range(Q, S_SEC + 720, 60, S_SEC + 3590)
+    assert 0 < part.stats.samples_scanned \
+        <= recompute.stats.samples_scanned
+    # the tail recomputed fewer windows than the full range carries
+    assert part.stats.result_samples == recompute.stats.result_samples
+
+
+def test_mirror_rebuild_attribution():
+    """The query that pays a device-mirror upload on its critical path
+    says so in its stats; the warm repeat does not."""
+    ms = TimeSeriesMemStore()
+    sh = ms.setup("prometheus", 0)
+    sh.ingest(counter_batch(16, 240, start_ms=START))
+    eng = QueryEngine("prometheus", ms)
+    r1 = eng.query_range(Q, S_SEC + 600, 60, S_SEC + 2390)
+    assert r1.error is None
+    assert r1.stats.mirror_full_rebuilds >= 1
+    assert r1.stats.bytes_transferred > 0
+    r2 = eng.query_range(Q, S_SEC + 600, 60, S_SEC + 2390)
+    assert r2.stats.mirror_full_rebuilds == 0
+    assert r2.stats.mirror_incremental == 0
+
+
+# ------------------------------------------------------------- slowlog
+
+
+def test_slowlog_captures_slow_query_with_trace():
+    ms = TimeSeriesMemStore()
+    ms.setup("prometheus", 0).ingest(counter_batch(16, 240, start_ms=START))
+    eng = QueryEngine("prometheus", ms)
+    cfg = FilodbSettings()
+    cfg.query.slow_query_threshold_s = 1e-9     # everything is slow
+    fe = QueryFrontend(eng, config=cfg)
+    slowlog.clear()
+    res = fe.query_range(Q, S_SEC + 600, 60, S_SEC + 2390)
+    assert res.error is None
+    entries = slowlog.entries()
+    assert entries, "slow query was not recorded"
+    rec = entries[-1]
+    assert rec["promql"] == Q
+    assert rec["duration_s"] > 0
+    assert rec["trace_id"] == res.trace_id
+    assert rec["stats"]["phases"]["exec_s"] > 0
+    # the stitched span tree rode along (copied at record time)
+    assert any(e["span"].startswith("execplan") for e in rec["spans"])
+    json.dumps(rec)                             # JSONL-sink serializable
+    slowlog.clear()
+
+
+def test_slowlog_jsonl_sink_and_threshold(tmp_path):
+    sl = SlowQueryLog(threshold_s=10.0, max_entries=4,
+                      path=str(tmp_path / "slow.jsonl"))
+
+    class _Res:
+        trace_id = ""
+        error = None
+        partial = False
+        stats = None
+
+    assert not sl.maybe_record("q", 0, 60, 100, 0.5, _Res())   # under
+    assert sl.maybe_record("q", 0, 60, 100, 11.0, _Res())      # over
+    assert len(sl) == 1
+    lines = (tmp_path / "slow.jsonl").read_text().splitlines()
+    assert len(lines) == 1 and json.loads(lines[0])["promql"] == "q"
+    # ring bound holds
+    for i in range(10):
+        sl.maybe_record(f"q{i}", 0, 60, 100, 12.0, _Res())
+    assert len(sl) == 4
+
+
+# ------------------------------------------------------- tenant usage
+
+
+def test_tenant_usage_accounting_ingest_and_query():
+    usage.clear()
+    ms = TimeSeriesMemStore()
+    sh = ms.setup("prometheus", 0)
+    sh.ingest(counter_batch(20, 120, start_ms=START))
+    eng = QueryEngine("prometheus", ms)
+    fe = QueryFrontend(eng)
+    q = 'sum(rate(request_total{_ws_="demo",_ns_="App-0"}[5m]))'
+    assert tenant_of(q) == ("demo", "App-0")
+    res = fe.query_range(q, S_SEC + 600, 60, S_SEC + 1190)
+    assert res.error is None
+    rows = {(r["ws"], r["ns"]): r for r in usage.snapshot()}
+    # ingest attributed per tenant (generator tags _ws_=demo, _ns_=App-N)
+    assert rows[("demo", "App-0")]["ingestSamples"] > 0
+    # the query charged to its shard-key tenant
+    assert rows[("demo", "App-0")]["queries"] == 1
+    assert rows[("demo", "App-0")]["samplesScanned"] > 0
+    assert rows[("demo", "App-0")]["querySeconds"] > 0
+
+
+def test_tenant_fail_limit_rejects_with_structured_error():
+    usage.clear()
+    ms = TimeSeriesMemStore()
+    ms.setup("prometheus", 0).ingest(counter_batch(20, 120, start_ms=START))
+    eng = QueryEngine("prometheus", ms)
+    cfg = FilodbSettings()
+    cfg.query.tenant_samples_warn_limit = 1
+    cfg.query.tenant_samples_fail_limit = 10
+    fe = QueryFrontend(eng, config=cfg)
+    q = 'sum(rate(request_total{_ws_="demo",_ns_="App-1"}[5m]))'
+    first = fe.query_range(q, S_SEC + 600, 60, S_SEC + 1190)
+    assert first.error is None           # the crossing query still runs
+    assert first.stats.samples_scanned > 10
+    second = fe.query_range(q, S_SEC + 600, 60, S_SEC + 1190)
+    assert second.error is not None
+    assert second.error.split(":", 1)[0] == "tenant_limit_exceeded"
+    # window roll re-admits
+    usage.clear()
+    third = fe.query_range(q, S_SEC + 600, 60, S_SEC + 1190)
+    assert third.error is None
+
+
+def test_singleflight_followers_do_not_multiply_usage():
+    """Dedup'd followers ride the leader's execution: the tenant must be
+    billed once per EXECUTION, not once per client, and the slowlog must
+    not record N identical entries for one shared run."""
+    import threading
+
+    from filodb_tpu.query.rangevector import QueryResult
+
+    usage.clear()
+    slowlog.clear()
+    calls = [0]
+    lock = threading.Lock()
+
+    class StubEngine:
+        dataset = "d"
+        source = None                    # no shard state -> cache bypass
+
+        def query_range(self, q, s, st, e, pp=None):
+            with lock:
+                calls[0] += 1
+            time.sleep(0.15)
+            return QueryResult([])
+
+    cfg = FilodbSettings()
+    cfg.query.slow_query_threshold_s = 1e-9
+    fe = QueryFrontend(StubEngine(), config=cfg)
+    barrier = threading.Barrier(8)
+
+    def client():
+        barrier.wait()
+        fe.query_range('m{_ws_="sfw"}', 1, 60, 100)
+
+    threads = [threading.Thread(target=client) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    rows = {(r["ws"], r["ns"]): r for r in usage.snapshot()}
+    assert rows[("sfw", "")]["queries"] == calls[0] < 8
+    assert len(slowlog.entries()) == calls[0]
+    usage.clear()
+    slowlog.clear()
+
+
+def test_explain_analyze_respects_tenant_limits_and_accounting():
+    """analyze_range goes through the same admission + accounting as
+    query_range: it must not be a free pass around the tenant limits."""
+    usage.clear()
+    ms = TimeSeriesMemStore()
+    ms.setup("prometheus", 0).ingest(counter_batch(20, 120, start_ms=START))
+    eng = QueryEngine("prometheus", ms)
+    cfg = FilodbSettings()
+    cfg.query.tenant_samples_fail_limit = 10
+    fe = QueryFrontend(eng, config=cfg)
+    q = 'sum(rate(request_total{_ws_="demo",_ns_="App-2"}[5m]))'
+    res, rec, ep = fe.analyze_range(q, S_SEC + 600, 60, S_SEC + 1190)
+    assert res.error is None and rec is not None and rec.order
+    rows = {(r["ws"], r["ns"]): r for r in usage.snapshot()}
+    assert rows[("demo", "App-2")]["queries"] == 1       # analyze billed
+    assert rows[("demo", "App-2")]["samplesScanned"] > 10
+    res2, rec2, _ = fe.analyze_range(q, S_SEC + 600, 60, S_SEC + 1190)
+    assert rec2 is None
+    assert res2.error.startswith("tenant_limit_exceeded")
+    usage.clear()
+
+
+def test_usage_tenant_cardinality_bounded():
+    """Query text is client-controlled: distinct (_ws_, _ns_) pairs past
+    the cap must fold into the overflow tenant, not grow the accountant
+    (and the registry's tenant-tagged counters) without bound."""
+    from filodb_tpu.utils.usage import OVERFLOW_TENANT
+    acc = UsageAccountant()
+    for i in range(acc.MAX_TENANTS + 50):
+        acc.record_query(f"ws{i}", "n", 0.001, 10, 1)
+    rows = {(r["ws"], r["ns"]): r for r in acc.snapshot()}
+    assert len(rows) <= acc.MAX_TENANTS + 1
+    assert rows[OVERFLOW_TENANT]["queries"] >= 50
+    # known tenants keep accounting under their own key
+    acc.record_query("ws0", "n", 0.001, 10, 1)
+    assert rows is not None and acc.resolve("ws0", "n") == ("ws0", "n")
+    assert acc.resolve("brand-new", "n") == OVERFLOW_TENANT
+
+
+def test_usage_window_rolls():
+    acc = UsageAccountant(window_s=0.05)
+    acc.record_query("w", "n", 0.1, 100, 10)
+    assert acc.window_samples("w", "n") == 100
+    time.sleep(0.06)
+    assert acc.window_samples("w", "n") == 0
+    assert acc.admit("w", "n", 0, 50) is None
+    acc.record_query("w", "n", 0.1, 100, 10)
+    err = acc.admit("w", "n", 0, 50)
+    assert err and err.startswith("tenant_limit_exceeded")
+
+
+# ----------------------------------------------------------- HTTP edges
+
+
+def test_http_stats_explain_usage_slowlog_routes():
+    from filodb_tpu.http.routes import PromHttpApi
+    usage.clear()
+    slowlog.clear()
+    ms = TimeSeriesMemStore()
+    ms.setup("prometheus", 0).ingest(counter_batch(16, 240, start_ms=START))
+    eng = QueryEngine("prometheus", ms)
+    api = PromHttpApi({"prometheus": eng})
+    params = {"query": Q, "start": str(S_SEC + 600), "end": str(S_SEC + 2390),
+              "step": "60", "stats": "true"}
+    status, payload = api.handle("GET", "/api/v1/query_range", params)
+    assert status == 200, payload
+    st = payload["stats"]
+    assert st["phases"]["exec_s"] > 0
+    assert st["samplesScanned"] > 0
+    assert st["cache"]["result"] in ("miss", "hit", "partial", "")
+    # instant query stats
+    status, payload = api.handle(
+        "GET", "/api/v1/query",
+        {"query": "request_total", "time": str(S_SEC + 1200),
+         "stats": "all"})
+    assert status == 200 and payload["stats"]["samplesScanned"] > 0
+    # explain analyze: annotated tree + per-node records
+    status, payload = api.handle(
+        "GET", "/api/v1/explain",
+        {"query": Q, "start": str(S_SEC + 600), "end": str(S_SEC + 2390),
+         "step": "60", "analyze": "true"})
+    assert status == 200, payload
+    data = payload["data"]
+    assert data["resultType"] == "execPlanAnalysis"
+    assert any("[self=" in line for line in data["result"])
+    assert data["nodes"] and data["stats"]["phases"]["exec_s"] > 0
+    # plain explain still works
+    status, payload = api.handle(
+        "GET", "/api/v1/explain",
+        {"query": Q, "start": str(S_SEC + 600), "end": str(S_SEC + 2390),
+         "step": "60"})
+    assert status == 200
+    assert payload["data"]["resultType"] == "execPlan"
+    # usage endpoint
+    status, payload = api.handle("GET", "/api/v1/usage", {})
+    assert status == 200 and isinstance(payload["data"], list)
+    # slowlog endpoints
+    status, payload = api.handle("GET", "/admin/slowlog", {})
+    assert status == 200 and "entries" in payload["data"]
+    status, payload = api.handle("POST", "/admin/slowlog/clear", {})
+    assert status == 200
+
+
+def test_profiler_collapsed_format_route():
+    import threading
+
+    from filodb_tpu.http.routes import PromHttpApi
+    api = PromHttpApi({})
+    stop = threading.Event()
+
+    def hot_spin():
+        x = 0
+        while not stop.is_set():
+            for i in range(2000):
+                x += i * i
+        return x
+
+    t = threading.Thread(target=hot_spin, daemon=True)
+    t.start()
+    status, _ = api.handle("POST", "/admin/profiler/start", {"hz": "200"})
+    assert status == 200
+    time.sleep(0.4)
+    status, rep = api.handle("GET", "/admin/profiler/report",
+                             {"format": "collapsed"})
+    assert status == 200
+    stop.set(); t.join(timeout=5)
+    api.handle("POST", "/admin/profiler/stop", {})
+    lines = [ln for ln in rep.splitlines() if ln]
+    assert lines, "no collapsed stacks"
+    # every line: `frame;frame;... count` with the count numeric
+    for ln in lines:
+        frames, _, count = ln.rpartition(" ")
+        assert frames and count.isdigit(), ln
+    assert any("hot_spin" in ln for ln in lines)
+    # unknown format rejected
+    status, _ = api.handle("GET", "/admin/profiler/report",
+                           {"format": "bogus"})
+    assert status == 400
