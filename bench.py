@@ -798,6 +798,204 @@ def measure_observability(quick=False, series=None):
     return st
 
 
+def run_chaos(quick=False, series=None):
+    """Failure-domain chaos stage (PR 4 acceptance): two real data-node
+    processes serve one dataset over the cross-node transport while this
+    process drives query traffic with `allow_partial_results=on` and a
+    per-query deadline; mid-traffic one node is SIGKILLed, later
+    restarted on the same address.  Emits:
+
+      chaos_availability        — fraction of fault-phase queries that
+                                  returned within their deadline
+                                  (partial or full, no error)
+      chaos_partial_rate        — fraction of fault-phase results
+                                  flagged partial
+      chaos_p99_during_fault_s  — fault-phase p99 vs healthy_p99_s
+                                  (gate: <= 2x — breaker fail-fast, no
+                                  connect-timeout serialization)
+      chaos_wrong_full_results  — fault-phase results claiming to be
+                                  FULL while missing the dead node's
+                                  series (gate: 0 — partials are never
+                                  silent)
+
+    Full phase detail lands in SOAK_CHAOS.json."""
+    import signal
+    import socket as _socket
+
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    from filodb_tpu.core.memstore import TimeSeriesMemStore
+    from filodb_tpu.parallel.breaker import breakers
+    from filodb_tpu.parallel.shardmapper import (ShardEvent, ShardMapper,
+                                                 SpreadProvider)
+    from filodb_tpu.parallel.transport import RemoteNodeDispatcher
+    from filodb_tpu.query.engine import QueryEngine
+    from filodb_tpu.query.planner import SingleClusterPlanner
+    from filodb_tpu.query.rangevector import PlannerParams
+
+    S_NODE = series or (512 if quick else 8_192)
+    T = 420                              # 70 min of 10s scrapes
+    START = 1_600_000_000_000
+    # per-query deadline: generous vs the CPU backend's per-new-shape
+    # XLA recompile under live ingest (~1s/query here; the TPU path
+    # amortizes via the device mirror) — the chaos gates compare fault
+    # p99 against HEALTHY p99, so the budget only needs to not clip the
+    # healthy path
+    BUDGET_S = 5.0
+    phase_s = 4.0 if quick else 10.0
+    dataset = "chaos"
+    worker = os.path.join(REPO_DIR, "bench", "chaosnode.py")
+
+    def free_port():
+        with _socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            return s.getsockname()[1]
+
+    env = {k: v for k, v in os.environ.items()}
+    env["PYTHONPATH"] = REPO_DIR
+    env["JAX_PLATFORMS"] = "cpu"
+    logs = {"A": open(os.path.join(REPO_DIR, ".chaos_nodeA.log"), "w"),
+            "B": open(os.path.join(REPO_DIR, ".chaos_nodeB.log"), "w")}
+
+    def spawn(name, port, shard):
+        proc = subprocess.Popen(
+            [sys.executable, worker, "--name", name, "--port", str(port),
+             "--shard", str(shard), "--dataset", dataset,
+             "--series", str(S_NODE), "--samples", str(T),
+             "--start-ms", str(START), "--ingest-interval", "1.0",
+             "--platform", "cpu"],
+            stdout=subprocess.PIPE, stderr=logs[name], text=True,
+            env=env, cwd=REPO_DIR)
+        line = proc.stdout.readline()
+        ready = json.loads(line) if line.strip().startswith("{") else {}
+        if not ready.get("ready"):
+            raise RuntimeError(f"chaos node {name} failed to start: "
+                               f"{line!r}")
+        return proc
+
+    ports = {"A": free_port(), "B": free_port()}
+    procs = {"A": spawn("A", ports["A"], 0),
+             "B": spawn("B", ports["B"], 1)}
+
+    # coordinator: scatter-gather over both nodes, no local data
+    mapper = ShardMapper(2)
+    for shard, name in ((0, "A"), (1, "B")):
+        mapper.update_from_event(
+            ShardEvent("IngestionStarted", dataset, shard, name))
+    dispatchers = {name: RemoteNodeDispatcher("127.0.0.1", port,
+                                              timeout_s=30.0)
+                   for name, port in ports.items()}
+    owner = {0: "A", 1: "B"}
+    planner = SingleClusterPlanner(
+        dataset, mapper, SpreadProvider(default_spread=1),
+        dispatcher_factory=lambda s: dispatchers[owner[s]])
+    engine = QueryEngine(dataset, TimeSeriesMemStore(), mapper,
+                         planner=planner)
+    breakers.reset()
+    breakers.configure(failure_threshold=3, open_base_s=0.3,
+                       open_max_s=2.0, jitter=0.1)
+    pp = PlannerParams(allow_partial_results=True, timeout_s=BUDGET_S,
+                      sample_limit=2_000_000_000,
+                      scan_limit=2_000_000_000)
+    Q = 'sum by (_ns_)(rate(chaos_total[5m]))'
+    qs, qe = START // 1000 + 600, START // 1000 + (T - 1) * 10
+
+    def drive(phase_name, dur_s):
+        """Query loop for one phase; each record: latency, partial flag,
+        error, which node groups answered."""
+        recs = []
+        t_end = time.perf_counter() + dur_s
+        while time.perf_counter() < t_end:
+            t0 = time.perf_counter()
+            res = engine.query_range(Q, qs, 60, qe, pp)
+            lat = time.perf_counter() - t0
+            groups = {k.labels_dict.get("_ns_") for k, _, _ in
+                      res.series()} if res.error is None else set()
+            recs.append({"lat_s": lat, "error": res.error,
+                         "partial": bool(res.partial),
+                         "groups": sorted(g for g in groups if g)})
+        return recs
+
+    def p99(recs):
+        if not recs:
+            return 0.0
+        lats = sorted(r["lat_s"] for r in recs)
+        return lats[min(int(len(lats) * 0.99), len(lats) - 1)]
+
+    # warmup WITHOUT the deadline: first-hit XLA compiles (coordinator
+    # merge + node-side leaf kernels) must not eat the chaos budget —
+    # production servers warm these at boot (standalone warmup_shapes)
+    warm_pp = PlannerParams(allow_partial_results=True,
+                            sample_limit=2_000_000_000,
+                            scan_limit=2_000_000_000)
+    warm = engine.query_range(Q, qs, 60, qe, warm_pp)
+    if warm.error:
+        raise RuntimeError(f"chaos warmup failed: {warm.error}")
+
+    # phase 1: healthy baseline
+    healthy = drive("healthy", phase_s)
+
+    # phase 2: SIGKILL node B mid-traffic
+    os.kill(procs["B"].pid, signal.SIGKILL)
+    procs["B"].wait()
+    fault = drive("fault", phase_s)
+
+    # phase 3: node B returns on the SAME address; breaker half-open
+    # probes detect it and traffic heals back to full results
+    procs["B"] = spawn("B", ports["B"], 1)
+    recovery = drive("recovery", phase_s)
+
+    for name, proc in procs.items():
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait()
+    for f in logs.values():
+        f.close()
+
+    def ok_within_budget(r):
+        return r["error"] is None and r["lat_s"] <= BUDGET_S
+
+    wrong_full = [r for r in fault
+                  if r["error"] is None and not r["partial"]
+                  and r["groups"] != ["A", "B"]]
+    avail = (sum(ok_within_budget(r) for r in fault) / len(fault)
+             if fault else 0.0)
+    partial_rate = (sum(r["partial"] for r in fault) / len(fault)
+                    if fault else 0.0)
+    healthy_p99 = p99(healthy)
+    fault_p99 = p99(fault)
+    recovered_full = sum(1 for r in recovery
+                         if r["error"] is None and not r["partial"]
+                         and r["groups"] == ["A", "B"])
+    result = {
+        "metric": "chaos_availability", "unit": "fraction",
+        "value": round(avail, 4),
+        "chaos_availability": round(avail, 4),
+        "chaos_partial_rate": round(partial_rate, 4),
+        "chaos_p99_during_fault_s": round(fault_p99, 4),
+        "healthy_p99_s": round(healthy_p99, 4),
+        "chaos_p99_ratio": round(fault_p99 / max(healthy_p99, 1e-9), 2),
+        "chaos_wrong_full_results": len(wrong_full),
+        "chaos_queries": {"healthy": len(healthy), "fault": len(fault),
+                          "recovery": len(recovery)},
+        "chaos_recovered_full_results": recovered_full,
+        "breakers": breakers.snapshot(),
+        "series_per_node": S_NODE, "budget_s": BUDGET_S,
+        "platform": "cpu",
+    }
+    artifact = {
+        "run": "chaos", "quick": quick, "result": result,
+        "phases": {"healthy": healthy, "fault": fault,
+                   "recovery": recovery},
+    }
+    with open(os.path.join(REPO_DIR, "SOAK_CHAOS.json"), "w") as f:
+        json.dump(artifact, f, indent=1)
+    breakers.configure()
+    breakers.reset()
+    return result
+
+
 def host_baselines(ts_row, vals, gids, wends, range_ms, span):
     """CPU reference numbers: vectorized numpy, per-window Python-loop
     iterator, and the single-core C iterator (the compiled
@@ -828,6 +1026,11 @@ def host_baselines(ts_row, vals, gids, wends, range_ms, span):
 
 def parse_args(argv=None):
     ap = argparse.ArgumentParser()
+    ap.add_argument("stage", nargs="?", default="",
+                    choices=["", "chaos"],
+                    help="optional standalone stage: 'chaos' runs the "
+                         "failure-domain chaos harness (SIGKILL a data "
+                         "node mid-traffic) and writes SOAK_CHAOS.json")
     ap.add_argument("--quick", action="store_true",
                     help="small config for smoke runs")
     ap.add_argument("--series", type=int, default=0)
@@ -1131,6 +1334,14 @@ def _probe_default_backend(timeout_s):
 
 def main():
     args = parse_args()
+    if args.stage == "chaos":
+        # standalone failure-domain stage: runs IN THIS process (CPU-
+        # pinned; chaos measures degradation machinery, not kernels),
+        # SIGKILLs and restarts a real data-node subprocess mid-traffic,
+        # prints the one-line chaos JSON and writes SOAK_CHAOS.json
+        print(json.dumps(run_chaos(quick=args.quick,
+                                   series=args.series or None)))
+        return
     if args._worker:
         run_worker(args)
         return

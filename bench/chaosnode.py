@@ -1,0 +1,111 @@
+"""Chaos-bench data node: one OS process = one shard owner.
+
+Spawned (and SIGKILLed, and respawned) by `python bench.py chaos`: builds
+a deterministic counter dataset for its shard, serves it over the real
+cross-node query transport, and keeps ingesting fresh scrape columns
+while it lives — so the chaos run exercises mixed ingest+query traffic
+through genuine process death, not a mock.  Series are tagged
+`_ns_=<node name>`, which is what lets the coordinator distinguish a
+correct partial result (dead node's group absent, flagged) from a
+silently-wrong full one (group absent, NOT flagged).
+
+Run: python bench/chaosnode.py --name A --port 7071 --shard 0 \
+         --series 2048 [--platform cpu]
+Prints one JSON line {"ready": true, ...} once serving.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+# REPLACE the script-dir path entry (bench/) with the repo root: bench/
+# contains a platform.py that would shadow the stdlib module jax needs
+sys.path[0] = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+import numpy as np  # noqa: E402
+
+
+def main(argv=None) -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--name", required=True)
+    ap.add_argument("--port", type=int, required=True)
+    ap.add_argument("--shard", type=int, required=True)
+    ap.add_argument("--dataset", default="chaos")
+    ap.add_argument("--series", type=int, default=2048)
+    ap.add_argument("--samples", type=int, default=420)
+    ap.add_argument("--start-ms", type=int, default=1_600_000_000_000)
+    ap.add_argument("--step-ms", type=int, default=10_000)
+    ap.add_argument("--ingest-interval", type=float, default=0.5)
+    ap.add_argument("--platform", default="cpu",
+                    help="pin jax platform ('' keeps the default)")
+    args = ap.parse_args(argv)
+
+    if args.platform:
+        import jax
+        jax.config.update("jax_platforms", args.platform)
+
+    from filodb_tpu.core.memstore import TimeSeriesMemStore
+    from filodb_tpu.core.partkey import PartKey
+    from filodb_tpu.core.records import RecordBatch
+    from filodb_tpu.core.schemas import PROM_COUNTER
+    from filodb_tpu.parallel.transport import NodeQueryServer
+    from filodb_tpu.utils import metrics as _metrics
+
+    _metrics.NODE_NAME = args.name
+    S, T, step = args.series, args.samples, args.step_ms
+    keys = [PartKey.make("chaos_total",
+                         {"_ws_": "chaos", "_ns_": args.name,
+                          "instance": f"{args.name}-{i}"})
+            for i in range(S)]
+    # deterministic monotonic counters: value = 5.0 * sample index + row
+    part_idx = np.repeat(np.arange(S, dtype=np.int32), T)
+    ts = np.tile(args.start_ms
+                 + np.arange(T, dtype=np.int64) * step, S)
+    vals = (np.arange(T, dtype=np.float64)[None, :] * 5.0
+            + np.arange(S, dtype=np.float64)[:, None])
+    batch = RecordBatch(PROM_COUNTER, keys, part_idx, ts,
+                        {"count": vals.ravel()})
+    ms = TimeSeriesMemStore()
+    sh = ms.setup(args.dataset, args.shard)
+    sh.ingest(batch)
+    # warm the leaf query path BEFORE reporting ready: a restarted
+    # node's first dispatched plan must answer within the probing
+    # query's remaining deadline budget, not pay cold XLA compiles on
+    # it (production nodes warm at boot via standalone warmup_shapes).
+    # Execute exactly the subtree the coordinator dispatches.
+    from filodb_tpu.core.index import Equals
+    from filodb_tpu.query.exec import (AggregateMapReduce,
+                                       MultiSchemaPartitionsExec,
+                                       PeriodicSamplesMapper)
+    from filodb_tpu.query.rangevector import QueryContext
+    q_start = (args.start_ms // 1000 + 600) * 1000
+    q_end = args.start_ms + (T - 1) * step
+    warm = MultiSchemaPartitionsExec(
+        QueryContext(), args.dataset, args.shard,
+        [Equals("_metric_", "chaos_total")], args.start_ms, q_end)
+    warm.add_transformer(PeriodicSamplesMapper(
+        q_start, 60_000, q_end, 300_000, "rate", ()))
+    warm.add_transformer(AggregateMapReduce("sum", (), ("_ns_",), ()))
+    warm.execute_internal(ms)
+    srv = NodeQueryServer(ms, port=args.port).start()
+    print(json.dumps({"ready": True, "name": args.name,
+                      "port": srv.address[1], "series": S,
+                      "samples": T}), flush=True)
+    # live ingest: one fresh scrape column per tick past the base window
+    # (the chaos run's "mixed ingest+query" half) until we are killed
+    t_idx = T
+    while True:
+        time.sleep(args.ingest_interval)
+        col_ts = np.full((S, 1), args.start_ms + t_idx * step, np.int64)
+        col_v = (np.full((S, 1), t_idx * 5.0)
+                 + np.arange(S, dtype=np.float64)[:, None])
+        sh.ingest_columns(PROM_COUNTER.name, keys, col_ts,
+                          {"count": col_v})
+        t_idx += 1
+
+
+if __name__ == "__main__":
+    main()

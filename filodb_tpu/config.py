@@ -31,6 +31,30 @@ class ConfigError(ValueError):
 class QueryConfig:
     """ref: filodb-defaults.conf:166-204 `filodb.query`."""
     ask_timeout_s: float = 120.0
+    # --- failure-domain hardening (doc/robustness.md; PR 4) ---
+    # end-to-end per-query time budget: stamped on the QueryContext at
+    # admission (frontend) or execution start (bare engine), checked at
+    # every exec-node boundary, and shrinking each remote hop's socket
+    # timeout to the REMAINING budget.  Queue wait in the frontend
+    # scheduler counts against it.  The Prometheus `timeout=` HTTP param
+    # overrides per request, capped at this value.  <= 0 disables.
+    default_timeout_s: float = 120.0
+    # server-side default for PlannerParams.allow_partial_results: when a
+    # shard stays unreachable after the re-plan retries (or a peer blows
+    # its deadline share), scatter-gathers drop it and FLAG the result
+    # partial instead of failing the query (the Thanos/Cortex
+    # partial-response stance).  Per-request `partial_response=` wins.
+    allow_partial_results: bool = False
+    # deadline SHARE: when partial results are allowed, one remote hop's
+    # socket wait is capped at this fraction of the query's REMAINING
+    # budget (never above ask_timeout_s).  Without it a wedged peer —
+    # accepting connections but never replying — consumes the entire
+    # budget and the whole query times out even though degradation was
+    # allowed; with it the hop expires early as a droppable
+    # dispatch_timeout and the survivors still have (1 - share) of the
+    # budget.  >= 1 disables the cap (a hop may spend the full
+    # remainder); only meaningful when a deadline is set.
+    peer_deadline_share: float = 0.5
     # shard_unavailable re-plan retries at the engine root (a node died
     # mid-query; after failover the re-planned query lands on the
     # reassigned owner).  dispatch_timeout is NEVER retried — the remote
@@ -94,6 +118,24 @@ class QueryConfig:
     tenant_limit_window_s: float = 60.0
     tenant_samples_warn_limit: int = 0
     tenant_samples_fail_limit: int = 0
+
+
+@dataclasses.dataclass
+class BreakerConfig:
+    """Per-peer circuit breakers around the remote query dispatcher
+    (parallel/breaker.py; doc/robustness.md): after `failure_threshold`
+    CONSECUTIVE shard_unavailable/connect failures to one node address
+    the breaker opens and dispatches to that peer fail fast in
+    microseconds (so the partial-result path engages immediately instead
+    of serializing connect timeouts), until a half-open probe succeeds.
+    Open intervals back off exponentially from `open_base_s` to
+    `open_max_s` with `jitter` fractional randomization (0 disables —
+    tests pin it for determinism)."""
+    enabled: bool = True
+    failure_threshold: int = 3
+    open_base_s: float = 1.0
+    open_max_s: float = 30.0
+    jitter: float = 0.2
 
 
 @dataclasses.dataclass
@@ -167,6 +209,7 @@ class FilodbSettings:
     spread_assignment: List[SpreadAssignment] = dataclasses.field(default_factory=list)
     query: QueryConfig = dataclasses.field(default_factory=QueryConfig)
     store: StoreConfig = dataclasses.field(default_factory=StoreConfig)
+    breaker: BreakerConfig = dataclasses.field(default_factory=BreakerConfig)
     shard_key_level_metrics: bool = True
     quota_default: int = 2_000_000_000
     reassignment_min_interval_s: float = 2 * 3600.0
@@ -199,7 +242,8 @@ class FilodbSettings:
                 # AttributeError/TypeError: non-dict where a block was
                 # expected — still a config mistake, same error surface
                 raise ConfigError(f"{source}: {e}")
-        for section, obj in (("query", self.query), ("store", self.store)):
+        for section, obj in (("query", self.query), ("store", self.store),
+                             ("breaker", self.breaker)):
             for k, v in (raw.pop(section, None) or {}).items():
                 _set_field(obj, k, v, f"{source}: {section}.{k}")
         if "spread_assignment" in raw:
@@ -244,7 +288,7 @@ class FilodbSettings:
             # durations ("30 minutes") and booleans behave identically
             from filodb_tpu.utils.hoconlite import _parse_scalar
             parsed = _parse_scalar(val)
-            for section in ("query_", "store_"):
+            for section in ("query_", "store_", "breaker_"):
                 if rest.startswith(section):
                     overlay.setdefault(section[:-1], {})[
                         rest[len(section):]] = parsed

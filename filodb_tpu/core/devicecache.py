@@ -165,6 +165,8 @@ class DeviceMirror:
         # lands mid-copy the recorded generation is stale, so the caller's
         # snapshot_read retry forces a clean re-upload (seqlock protocol,
         # see DenseSeriesStore.mutation)
+        from filodb_tpu.utils.faults import faults
+        faults.fire("device.upload")
         gen0 = store.generation
         nbytes = self._nbytes(store)
         if nbytes > self.hbm_limit_bytes:

@@ -18,24 +18,39 @@ from __future__ import annotations
 
 import logging
 import threading
-from typing import Optional
+import time
+from typing import Dict, Optional
 
 _log = logging.getLogger("filodb.flush")
 
 
 class FlushScheduler:
-    """Rotates flush groups of every shard of a dataset on a timer."""
+    """Rotates flush groups of every shard of a dataset on a timer.
+
+    Failure domain (PR 4): a shard whose flushes keep failing (store
+    down, disk full) backs off EXPONENTIALLY — base one tick, doubling
+    per consecutive error up to `backoff_max_s` — instead of hammering
+    the broken store at full tick rate forever; the first success
+    resets it.  Observable at /metrics: `flush_errors` (per shard) and
+    the `flush_backoff_active` gauge (shards currently backing off) —
+    previously `self.errors` was only an attribute nobody exported."""
 
     def __init__(self, memstore, dataset: str, interval_s: float = 60.0,
-                 headroom: bool = True):
+                 headroom: bool = True, backoff_max_s: Optional[float] = None):
         self.memstore = memstore
         self.dataset = dataset
         self.interval_s = interval_s
         self.headroom = headroom
+        self.backoff_max_s = (8 * interval_s if backoff_max_s is None
+                              else backoff_max_s)
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self.flushes = 0
         self.errors = 0
+        # per-shard consecutive-failure streaks and monotonic backoff
+        # horizons (only the flush thread touches them)
+        self._err_streak: Dict[int, int] = {}
+        self._backoff_until: Dict[int, float] = {}
 
     # ------------------------------------------------------------------ control
 
@@ -62,10 +77,46 @@ class FlushScheduler:
 
     # ------------------------------------------------------------------- loop
 
+    def _note_flush_error(self, shard, tick: float) -> None:
+        from filodb_tpu.utils.metrics import registry
+        self.errors += 1
+        registry.counter("flush_errors", dataset=self.dataset,
+                         shard=str(shard.shard_num)).increment()
+        streak = self._err_streak.get(shard.shard_num, 0) + 1
+        self._err_streak[shard.shard_num] = streak
+        # exponential: one tick after the first failure, doubling per
+        # consecutive failure, capped so a recovered store is retried
+        # within a bounded window
+        delay = min(max(tick, 0.01) * (2 ** (streak - 1)),
+                    self.backoff_max_s)
+        self._backoff_until[shard.shard_num] = time.monotonic() + delay
+        registry.gauge("flush_backoff_active", dataset=self.dataset
+                       ).update(len(self._backoff_until))
+
+    def _note_flush_ok(self, shard) -> None:
+        if self._err_streak.pop(shard.shard_num, None) is not None:
+            from filodb_tpu.utils.metrics import registry
+            self._backoff_until.pop(shard.shard_num, None)
+            registry.gauge("flush_backoff_active", dataset=self.dataset
+                           ).update(len(self._backoff_until))
+
     def _run(self) -> None:
         group = 0
         while not self._stop.is_set():
             shards = self.memstore.shards_for(self.dataset)
+            live = {s.shard_num for s in shards}
+            stale = [sn for sn in (self._backoff_until.keys()
+                                   | self._err_streak.keys())
+                     if sn not in live]
+            if stale:
+                # a shard torn down / reassigned away mid-backoff must
+                # not count in flush_backoff_active forever
+                from filodb_tpu.utils.metrics import registry
+                for sn in stale:
+                    self._backoff_until.pop(sn, None)
+                    self._err_streak.pop(sn, None)
+                registry.gauge("flush_backoff_active", dataset=self.dataset
+                               ).update(len(self._backoff_until))
             n_groups = max((s._groups for s in shards), default=1)
             # one group per tick across all shards -> every group flushes
             # once per interval_s, like the reference's flush stream
@@ -73,6 +124,9 @@ class FlushScheduler:
             for shard in shards:
                 if self._stop.is_set():
                     return
+                until = self._backoff_until.get(shard.shard_num)
+                if until is not None and time.monotonic() < until:
+                    continue            # shard backing off after errors
                 try:
                     if group < shard._groups:
                         # background flushes batch small partitions (the
@@ -81,10 +135,13 @@ class FlushScheduler:
                             group,
                             min_samples=shard.config.store.min_flush_samples)
                         self.flushes += 1
+                        self._note_flush_ok(shard)
                 except Exception:  # noqa: BLE001
-                    self.errors += 1
-                    _log.exception("background flush failed shard=%d group=%d",
-                                   shard.shard_num, group)
+                    self._note_flush_error(shard, tick)
+                    _log.exception("background flush failed shard=%d group=%d "
+                                   "(streak=%d, backing off)",
+                                   shard.shard_num, group,
+                                   self._err_streak[shard.shard_num])
             group += 1
             if group >= n_groups:
                 group = 0

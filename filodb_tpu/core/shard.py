@@ -66,6 +66,7 @@ from filodb_tpu.core.store import (ColumnStore, MetaStore, NullColumnStore,
                                    InMemoryMetaStore, PartKeyRecord)
 from filodb_tpu.memory.chunks import ChunkSet, encode_chunkset
 from filodb_tpu.memory.histogram import HistogramBuckets
+from filodb_tpu.utils.faults import faults
 from filodb_tpu.utils.metrics import (registry as metrics_registry,
                                       span as metrics_span)
 
@@ -377,6 +378,7 @@ class TimeSeriesShard:
         Returns number of samples ingested.  Thread-safe: serialized with
         flush/eviction/paging via write_lock; concurrent queries read
         through the seqlock (snapshot_read)."""
+        faults.fire("ingest.batch")
         with self._write_locked("ingest"):
             return self._ingest(batch, offset)
 
@@ -595,6 +597,7 @@ class TimeSeriesShard:
         ts = np.asarray(ts)
         if ts.ndim != 2 or len(part_keys) != ts.shape[0]:
             raise ValueError("ingest_columns: ts must be [num_keys, k]")
+        faults.fire("ingest.batch")
         with self._write_locked("ingest"):
             if ts.size == 0:
                 return 0
@@ -849,6 +852,8 @@ class TimeSeriesShard:
         written = 0
         encoded = []
         chunksets = self._encode_pending(pending, ingestion_time_ms)
+        if pending:
+            faults.fire("flush.persist")
         for (pid, info, hi, ts, cols, les), cs in zip(pending, chunksets):
             self.column_store.write_chunks(
                 self.dataset, self.shard_num, info.part_key, [cs],

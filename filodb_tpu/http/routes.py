@@ -22,7 +22,7 @@ from typing import Callable, Dict, List, Optional, Tuple
 import re
 
 from filodb_tpu.promql.lexer import ParseError, duration_to_ms
-from filodb_tpu.query.engine import QueryEngine
+from filodb_tpu.query.engine import QueryEngine, _prom_error_payload
 from filodb_tpu.query.rangevector import PlannerParams
 
 
@@ -51,6 +51,7 @@ class PromHttpApi:
         if config is None:
             from filodb_tpu.config import settings
             config = settings()
+        self._qconfig = config.query
         if batch_window_ms is None:
             batch_window_ms = config.query.batch_window_ms
         self.frontends = {name: QueryFrontend(eng,
@@ -101,6 +102,9 @@ class PromHttpApi:
             if parts[:2] == ["admin", "slowlog"] and len(parts) in (2, 3):
                 return self._slowlog(parts[2] if len(parts) == 3 else None,
                                      params, method)
+            if parts[:2] == ["admin", "breakers"] and len(parts) == 2 \
+                    and method == "GET":
+                return self._breakers()
             if parts[:2] == ["admin", "traces"] and len(parts) in (2, 3):
                 return self._traces(parts[2] if len(parts) == 3 else None)
             if parts[:2] == ["admin", "tracedfilters"] and method == "POST":
@@ -126,7 +130,7 @@ class PromHttpApi:
         eng = self.engines.get(dataset)
         if eng is None:
             return 404, _err(f"dataset {dataset!r} not found")
-        planner_params = _planner_params(params)
+        planner_params = _planner_params(params, self._qconfig)
         if rest == ["query_range"]:
             q = params.get("query", "")
             start = _num_param(params, "start")
@@ -200,26 +204,33 @@ class PromHttpApi:
                 payload["stats"] = res.stats.to_dict()
             return (200 if payload["status"] == "success" else 400), payload
         if rest == ["labels"]:
-            return self._metadata(eng, "labels", params, multi)
+            return self._metadata(eng, "labels", params, multi,
+                                  planner_params=planner_params)
         if len(rest) == 3 and rest[0] == "label" and rest[2] == "values":
             return self._metadata(eng, "label_values", params, multi,
-                                  label=rest[1])
+                                  label=rest[1],
+                                  planner_params=planner_params)
         if rest == ["series"]:
-            return self._metadata(eng, "series", params, multi)
+            return self._metadata(eng, "series", params, multi,
+                                  planner_params=planner_params)
         if rest == ["metering", "cardinality"]:
             return self._cardinality(dataset, params)
         if rest == ["read"] and method == "POST":
-            return self._remote_read(eng, body)
+            return self._remote_read(eng, body, planner_params)
         return 404, _err(f"unknown api/v1 endpoint {'/'.join(rest)}")
 
     # --------------------------------------------------------- remote read
 
-    def _remote_read(self, eng: QueryEngine, body: bytes) -> Tuple[int, bytes]:
+    def _remote_read(self, eng: QueryEngine, body: bytes,
+                     planner_params: Optional[PlannerParams] = None
+                     ) -> Tuple[int, bytes]:
         """Prometheus remote-read: snappy-compressed protobuf ReadRequest in,
         snappy-compressed ReadResponse of raw samples out (ref:
         PrometheusApiRoute.scala:37-62, remote/RemoteStorage.java).  A bytes
         payload tells the server shell to send application/x-protobuf with
         Content-Encoding: snappy."""
+        import dataclasses as _dc
+
         import numpy as np
 
         from filodb_tpu.core.index import (Equals, EqualsRegex, NotEquals,
@@ -234,6 +245,13 @@ class PromHttpApi:
             # IndexError/struct.error: truncated snappy or protobuf bytes —
             # still the client's fault, so a 400 like any other bad payload
             raise _BadRequest(f"bad remote-read payload: {e}")
+        # the remote-read protobuf has NO channel for a partial flag or
+        # warnings, so degradation here would be exactly the silent
+        # partial the contract forbids: always fail hard on dead shards
+        # (timeout=/limit overrides still apply)
+        pp = planner_params if planner_params is not None else PlannerParams()
+        if pp.allow_partial_results:
+            pp = _dc.replace(pp, allow_partial_results=False)
         matcher_map = {remotepb.EQ: Equals, remotepb.NEQ: NotEquals,
                        remotepb.RE: EqualsRegex, remotepb.NRE: NotEqualsRegex}
         results = []
@@ -248,7 +266,7 @@ class PromHttpApi:
             plan = lp.RawSeries(
                 lp.IntervalSelector(q.start_timestamp_ms, q.end_timestamp_ms),
                 tuple(filters))
-            res = eng.exec_logical_plan(plan)
+            res = eng.exec_logical_plan(plan, pp)
             if res.error:
                 raise _BadRequest(res.error)
             series_out = []
@@ -341,7 +359,9 @@ class PromHttpApi:
 
     def _metadata(self, eng: QueryEngine, kind: str, params: Dict[str, str],
                   multi: Dict[str, List[str]],
-                  label: Optional[str] = None) -> Tuple[int, object]:
+                  label: Optional[str] = None,
+                  planner_params: Optional[PlannerParams] = None
+                  ) -> Tuple[int, object]:
         from filodb_tpu.promql.parser import parse_query, _filters
         from filodb_tpu.promql import ast as A
         from filodb_tpu.query import logical as lp
@@ -350,6 +370,10 @@ class PromHttpApi:
         # the Prometheus API unions results over repeated match[] selectors
         matches = (multi.get("match[]") or multi.get("match") or [None])
         merged: Optional[object] = None
+        # degradation across the union: any match[] leg served from
+        # survivors only flags the WHOLE payload partial (never silent)
+        partial = False
+        warnings: List[str] = []
         for match in matches:
             filters: Tuple = ()
             if match:
@@ -363,9 +387,14 @@ class PromHttpApi:
                 plan = lp.LabelValues((label,), filters, start, end)
             else:
                 plan = lp.SeriesKeysByFilters(filters, start, end)
-            res = eng.exec_logical_plan(plan)
+            res = eng.exec_logical_plan(plan, planner_params)
             if res.error:
-                return 400, _err(res.error)
+                # same errorType taxonomy as query_range (deadline
+                # expiry routes as "timeout", not "bad_data") — clients
+                # route on errorType for /labels and /series too
+                return 400, _prom_error_payload(res)
+            partial = partial or res.partial
+            warnings.extend(res.stats.warnings)
             data = res.data or []
             if kind == "label_values" and isinstance(data, dict):
                 data = sorted(data.get(label, []))
@@ -393,7 +422,9 @@ class PromHttpApi:
             from filodb_tpu.query.engine import _prom_labels
             merged = [_prom_labels(x) if isinstance(x, dict) else x
                       for x in merged]
-        return 200, {"status": "success", "data": merged or []}
+        from filodb_tpu.query.engine import _attach_partial_fields
+        return 200, _attach_partial_fields(
+            {"status": "success", "data": merged or []}, partial, warnings)
 
     # ------------------------------------------------------------- cluster
 
@@ -463,6 +494,15 @@ class PromHttpApi:
             return 200, {"status": "success",
                          "data": {"cleared": slowlog.clear()}}
         return 404, _err(f"unknown slowlog action {action!r} ({method})")
+
+    def _breakers(self) -> Tuple[int, object]:
+        """Per-peer circuit-breaker states (parallel/breaker.py): which
+        remote nodes the query transport is currently failing fast on,
+        with consecutive-failure counts and backoff windows — the view an
+        operator checks when a chaos/partial-results event is suspected."""
+        from filodb_tpu.parallel.breaker import breakers
+        return 200, {"status": "success",
+                     "data": {"breakers": breakers.snapshot()}}
 
     def _traces(self, trace_id) -> Tuple[int, object]:
         """Stitched cross-node span tree for one query (the Zipkin-query
@@ -617,10 +657,15 @@ def _step_param(raw) -> int:
             from None
 
 
-def _planner_params(params: Dict[str, str]) -> Optional[PlannerParams]:
-    """spread / sample-limit overrides (ref: PrometheusApiRoute query params
-    `spread`, `histogramMap`)."""
+def _planner_params(params: Dict[str, str],
+                    qconfig=None) -> Optional[PlannerParams]:
+    """spread / sample-limit / timeout / partial-response overrides (ref:
+    PrometheusApiRoute query params `spread`, `histogramMap`; the
+    Prometheus `timeout=` param; Thanos' `partial_response=`)."""
     pp = PlannerParams()
+    if qconfig is not None:
+        # server-side default; the per-request params below override it
+        pp.allow_partial_results = qconfig.allow_partial_results
     changed = False
     if "spread" in params:
         pp.spread = _num_param(params, "spread")
@@ -631,12 +676,53 @@ def _planner_params(params: Dict[str, str]) -> Optional[PlannerParams]:
     if "scanLimit" in params:
         pp.scan_limit = _num_param(params, "scanLimit")
         changed = True
-    if params.get("allowPartialResults") in ("true", "1"):
-        # opt-in: unreachable shard owners are dropped and the payload
-        # carries "partial": true + a warning (never silent partials)
-        pp.allow_partial_results = True
+    if "timeout" in params:
+        # per-request end-to-end budget (Prometheus `timeout=`: float
+        # seconds or a duration string), capped server-side at
+        # query.default_timeout_s by the frontend/engine
+        pp.timeout_s = _timeout_param(params["timeout"])
+        changed = True
+    # partial_response (the Thanos spelling) and allowPartialResults
+    # (the reference's) both work; an explicit false overrides the
+    # server default, so a client can insist on fail-on-partial.  Only
+    # explicit booleans are accepted — a typo silently coerced to
+    # "false" would flip a server-enabled degradation stance into
+    # hard-fail with nobody told
+    partial = params.get("partial_response",
+                         params.get("allowPartialResults"))
+    if partial is not None:
+        if partial in ("true", "1"):
+            pp.allow_partial_results = True
+        elif partial in ("false", "0"):
+            pp.allow_partial_results = False
+        else:
+            raise _BadRequest(
+                "parameter 'partial_response' must be a boolean "
+                f"(true/false/1/0): {partial!r}")
         changed = True
     return pp if changed else None
+
+
+def _timeout_param(raw) -> float:
+    """Prometheus `timeout=`: float seconds ("0.5") or a duration string
+    ("30s", "1m30s").  Must be positive — a zero/negative budget is a
+    client error, not an instant timeout."""
+    try:
+        t = float(raw)
+    except (ValueError, OverflowError, TypeError):
+        s = str(raw)
+        m = _DURATION_RE.fullmatch(s)
+        if not m or not any(m.groups()):
+            raise _BadRequest(
+                f"parameter 'timeout' is not a number or duration: {raw!r}")
+        try:
+            t = duration_to_ms(s) / 1000.0
+        except (OverflowError, ValueError):
+            raise _BadRequest(
+                f"parameter 'timeout' is out of range: {raw!r}") from None
+    if not (t > 0):
+        raise _BadRequest(f"parameter 'timeout' must be positive: {raw!r}")
+    return t
 
 
 def _want_stats(params: Dict[str, str]) -> bool:

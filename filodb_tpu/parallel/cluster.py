@@ -335,8 +335,10 @@ class NodeAgent:
             return {ds: sorted(s) for ds, s in self._owned.items()}
 
     def _heartbeat_loop(self) -> None:
+        from filodb_tpu.utils.faults import faults
         while not self._stop.wait(self.heartbeat_interval_s):
             try:
+                faults.fire("cluster.heartbeat")
                 reply = _rpc(self.coordinator_addr,
                              {"cmd": "heartbeat", "node": self.node,
                               "active": self.owned},  # locked snapshot

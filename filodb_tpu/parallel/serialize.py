@@ -84,6 +84,12 @@ class _Encoder:
         if isinstance(obj, np.ndarray):
             self.buffers.append(np.ascontiguousarray(obj))
             return {"$nd": len(self.buffers) - 1}
+        if isinstance(obj, exec_mod.LazyKeys):
+            # deferred key facades materialize at the wire: the remote's
+            # shard/pid handles mean nothing on the coordinator (found by
+            # the PR-4 partial-results tests: raw un-aggregated blocks
+            # failed to dispatch remotely at all)
+            return [self.enc(k) for k in obj]
         if isinstance(obj, tuple):
             return {"$t": [self.enc(x) for x in obj]}
         if isinstance(obj, list):
@@ -96,8 +102,14 @@ class _Encoder:
             name = type(obj).__name__
             if name not in _DATACLASSES:
                 raise NotSerializable(f"unregistered dataclass {name}")
+            # cache_token is PROCESS-LOCAL working-set identity (shard
+            # keys_serial/keys_epoch/pid bytes): two processes can mint
+            # colliding tokens for different key sets, so a token must
+            # never cross the wire — the coordinator's group-id cache
+            # would serve another node's group ids (PR 4 hardening)
             return {"$c": name,
-                    "f": {f.name: self.enc(getattr(obj, f.name))
+                    "f": {f.name: self.enc(None if f.name == "cache_token"
+                                           else getattr(obj, f.name))
                           for f in dataclasses.fields(obj)}}
         name = type(obj).__name__
         if name in _SIMPLE:

@@ -65,9 +65,24 @@ class NodeQueryServer:
 
     def __init__(self, source, host: str = "127.0.0.1", port: int = 0):
         self.source = source
+        # live handler connections: stop() severs them so a stopped
+        # in-proc node looks EXACTLY like a SIGKILLed one to peers with
+        # pooled sockets (shutdown() alone only stops accepting; pooled
+        # dispatcher connections would keep being served by the handler
+        # threads, hiding the death from failure-domain tests)
+        self._conns: set = set()
+        self._conns_lock = threading.Lock()
         outer = self
 
         class _Handler(socketserver.BaseRequestHandler):
+            def setup(self):
+                with outer._conns_lock:
+                    outer._conns.add(self.request)
+
+            def finish(self):
+                with outer._conns_lock:
+                    outer._conns.discard(self.request)
+
             def handle(self):
                 try:
                     while True:
@@ -90,9 +105,20 @@ class NodeQueryServer:
                                  "spans": (collector.take(tid)
                                            if tid else [])})
                         except Exception as e:  # noqa: BLE001 — errors ride the wire
-                            reply = serialize.dumps(
-                                {"ok": False,
-                                 "error": f"{type(e).__name__}: {e}"})
+                            from filodb_tpu.query.execbase import \
+                                QueryError
+                            if isinstance(e, QueryError):
+                                # preserve the typed code across the
+                                # wire: a deadline expiring on THIS node
+                                # must surface at the coordinator as
+                                # query_timeout, not remote_failure
+                                reply = serialize.dumps(
+                                    {"ok": False, "error_code": e.code,
+                                     "error": str(e)})
+                            else:
+                                reply = serialize.dumps(
+                                    {"ok": False,
+                                     "error": f"{type(e).__name__}: {e}"})
                         _send_frame(self.request, reply)
                 except (ConnectionError, OSError):
                     return              # client went away
@@ -117,6 +143,17 @@ class NodeQueryServer:
     def stop(self) -> None:
         self._server.shutdown()
         self._server.server_close()
+        with self._conns_lock:
+            conns = list(self._conns)
+        for conn in conns:
+            try:
+                conn.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                conn.close()
+            except OSError:
+                pass
         if self._thread:
             self._thread.join(timeout=5)
 
@@ -128,24 +165,35 @@ class RemoteNodeDispatcher(PlanDispatcher):
     def __init__(self, host: str, port: int,
                  timeout_s: Optional[float] = None):
         self.host, self.port = host, port
+        from filodb_tpu.config import settings
+        q = settings().query
         if timeout_s is None:
             # the ask-timeout knob (ref: filodb-defaults.conf
             # query.ask-timeout; PlanDispatcher.scala:31 Akka ask)
-            from filodb_tpu.config import settings
-            timeout_s = settings().query.ask_timeout_s
+            timeout_s = q.ask_timeout_s
         self.timeout_s = timeout_s
+        # fraction of the REMAINING deadline budget one hop may spend
+        # when partial results are allowed — without it a wedged peer
+        # (accepts, never replies) consumes the whole budget and the
+        # query times out even though degradation was allowed
+        self.deadline_share = q.peer_deadline_share
         self._tls = threading.local()
 
-    def _sock(self) -> Tuple[socket.socket, bool]:
+    def _sock(self, timeout_s: Optional[float] = None
+              ) -> Tuple[socket.socket, bool]:
         """Returns (socket, fresh): `fresh` distinguishes a just-opened
-        connection from a pooled one that may have gone stale."""
+        connection from a pooled one that may have gone stale.  The
+        timeout (per-hop ask timeout shrunk to the query's remaining
+        deadline budget) applies to connect AND subsequent frame I/O."""
+        timeout_s = self.timeout_s if timeout_s is None else timeout_s
         s = getattr(self._tls, "sock", None)
         if s is None:
             s = socket.create_connection((self.host, self.port),
-                                         timeout=self.timeout_s)
+                                         timeout=timeout_s)
             s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             self._tls.sock = s
             return s, True
+        s.settimeout(timeout_s)
         return s, False
 
     def _reset(self) -> None:
@@ -156,66 +204,196 @@ class RemoteNodeDispatcher(PlanDispatcher):
             finally:
                 self._tls.sock = None
 
+    def _roundtrip(self, sock: socket.socket, payload: bytes) -> bytes:
+        """One framed request/response, with the transport fault points:
+        `transport.send` fires before the plan frame is written (corrupt
+        plans mutate the payload the server will fail to decode), and
+        `transport.recv` fires on the raw reply bytes."""
+        from filodb_tpu.utils.faults import faults
+        _send_frame(sock, faults.fire("transport.send", payload))
+        return faults.fire("transport.recv", _recv_frame(sock))
+
     def dispatch(self, plan, source) -> QueryResultLike:
         import time as _time
 
+        from filodb_tpu.parallel.breaker import breakers
         from filodb_tpu.query.execbase import QueryError
-        payload = serialize.dumps(plan)
         where = f"{self.host}:{self.port}"
+        dl = getattr(plan.ctx, "deadline_unix_s", 0.0)
+        allow_partial = getattr(plan.ctx.planner_params,
+                                "allow_partial_results", False)
+
+        def _hop_timeout(what: str):
+            """(socket timeout, budget_bounded) for one hop: the per-hop
+            ask timeout, shrunk to the query's REMAINING deadline budget
+            — each hop of a deep scatter spends from one end-to-end
+            budget, not a fresh 120 s — and, when partial results are
+            allowed, to the deadline SHARE (query.peer_deadline_share):
+            one wedged peer may spend at most that fraction of the
+            remainder, so its expiry is a droppable dispatch_timeout
+            while the survivors still have budget.  Raises query_timeout
+            when nothing remains."""
+            t = self.timeout_s
+            bounded = False
+            remaining = dl - _time.time()
+            if remaining <= 0:
+                raise QueryError("query_timeout",
+                                 f"no budget left {what} {where}")
+            cap = remaining
+            if allow_partial and 0 < self.deadline_share < 1:
+                cap = remaining * self.deadline_share
+            if cap < t:
+                t = cap
+                bounded = True
+            return t, bounded
+
+        # effective timeout derived BEFORE the breaker so an already-
+        # expired query can never consume (and then strand) a half-open
+        # probe slot.
+        timeout_s = self.timeout_s
+        budget_bounded = False
+        if dl:
+            timeout_s, budget_bounded = _hop_timeout("before dispatch to")
+        # serialize BEFORE the breaker admits us: a NotSerializable (or
+        # any unexpected dumps failure) after allow() granted the half-
+        # open probe slot would bypass every on_success/on_failure/
+        # on_abort path and wedge the breaker half-open forever
+        payload = serialize.dumps(plan)
+        # per-peer circuit breaker: a peer that keeps failing
+        # shard_unavailable is failed FAST (microseconds, no socket) so
+        # the partial-result path engages immediately instead of every
+        # query serializing connect attempts to a dead node
+        br = breakers.get(where) if breakers.enabled() else None
+        if br is not None and not br.allow():
+            raise QueryError(
+                "shard_unavailable",
+                f"node {where} circuit open "
+                f"({br.consecutive_failures} consecutive failures; "
+                f"failing fast until the half-open probe succeeds)")
+
+        def _timeout_err(e):
+            # classified by the CLOCK, not by which cap bounded the
+            # wait: expiry at/after the global deadline IS the query's
+            # deadline expiring (query_timeout — never dropped, the
+            # budget is global); a wait the deadline SHARE cut short
+            # leaves the survivors their budget, so it is the taxonomy's
+            # droppable dispatch_timeout, exactly like an ask-bounded
+            # wait.  Neither is EVER retried: the remote may still be
+            # executing, and a re-send would run the query twice.  The
+            # breaker learns NOTHING about liveness from a timeout — but
+            # an admitted half-open probe must release its slot
+            # (on_abort), or the breaker wedges.
+            self._reset()
+            if br is not None:
+                br.on_abort()
+            if dl and _time.time() >= dl:
+                return QueryError(
+                    "query_timeout",
+                    f"node {where} gave no reply within the remaining "
+                    f"deadline budget ({timeout_s:.3f}s)")
+            return QueryError(
+                "dispatch_timeout",
+                f"node {where} gave no reply within {timeout_s:.3f}s "
+                f"(not retried: the remote may still be executing)")
+
+        def _unavailable(e, what):
+            if br is not None:
+                br.on_failure()
+            return QueryError("shard_unavailable",
+                              f"node {where} {what}: {e}")
+
         t_wire0 = _time.perf_counter()
         try:
-            sock, fresh = self._sock()
+            sock, fresh = self._sock(timeout_s)
+        except socket.timeout as e:
+            # connect timeout: unreachable (same class as refused) — but
+            # a budget-bounded connect wait expired by the deadline or
+            # its share teaches the breaker nothing about liveness
+            if budget_bounded:
+                raise _timeout_err(e) from e
+            raise _unavailable(e, "unreachable") from e
         except OSError as e:
             # connect refused/unreachable: the owner is gone (SIGKILL,
             # network partition) — the taxonomy's shard_unavailable
-            raise QueryError("shard_unavailable",
-                             f"node {where} unreachable: {e}") from e
+            raise _unavailable(e, "unreachable") from e
         try:
-            _send_frame(sock, payload)
-            raw = _recv_frame(sock)
-            reply = serialize.loads(raw)
+            raw = self._roundtrip(sock, payload)
         except socket.timeout as e:
-            # NEVER retry a timeout: the remote may still be executing the
-            # plan, and a re-send would run the query twice
-            self._reset()
-            raise QueryError(
-                "dispatch_timeout",
-                f"node {where} gave no reply within {self.timeout_s}s "
-                f"(not retried: the remote may still be executing)") from e
+            raise _timeout_err(e) from e
         except (ConnectionError, OSError) as e:
             self._reset()
             if fresh:
-                raise QueryError("shard_unavailable",
-                                 f"node {where} died mid-dispatch: "
-                                 f"{e}") from e
-            # pooled socket had gone stale — one retry on a fresh one.
-            # The CONNECT is classified separately: a connect timeout
-            # means the node is unreachable (shard_unavailable, same as
-            # the first-attempt path), not "accepted but silent"
+                raise _unavailable(e, "died mid-dispatch") from e
+            # pooled socket had gone stale — one retry on a fresh one,
+            # counted + tagged so chaos runs can tell stale-pool churn
+            # from real peer death.  The CONNECT is classified
+            # separately: a connect timeout means the node is
+            # unreachable (shard_unavailable, same as the first-attempt
+            # path), not "accepted but silent"
+            from filodb_tpu.utils.metrics import registry, span
+            registry.counter("transport_stale_socket_retries").increment()
+            # re-derive the remaining budget for the retry: the first
+            # attempt may have burned most of it before dying, and
+            # reusing the stale value could block up to 2x the deadline
+            if dl:
+                try:
+                    timeout_s, budget_bounded = _hop_timeout(
+                        "to retry stale socket to")
+                except QueryError:
+                    # release an admitted half-open probe slot before
+                    # bailing (every exit path must: a leaked slot
+                    # wedges the breaker half-open forever)
+                    if br is not None:
+                        br.on_abort()
+                    raise
             try:
-                sock, _ = self._sock()
-            except OSError as e2:
-                raise QueryError("shard_unavailable",
-                                 f"node {where} unreachable: "
-                                 f"{e2}") from e2
-            try:
-                _send_frame(sock, payload)
-                raw = _recv_frame(sock)
-                reply = serialize.loads(raw)
+                with span("transport_reconnect", peer=where,
+                          reason="stale_pool"):
+                    sock, _ = self._sock(timeout_s)
             except socket.timeout as e2:
-                self._reset()
-                raise QueryError(
-                    "dispatch_timeout",
-                    f"node {where} gave no reply within "
-                    f"{self.timeout_s}s") from e2
+                # same classification as the first-attempt connect: a
+                # budget-bounded connect timeout is the deadline (or its
+                # share) expiring, NOT evidence of peer death — it must
+                # not feed the breaker's failure count
+                if budget_bounded:
+                    raise _timeout_err(e2) from e2
+                raise _unavailable(e2, "unreachable") from e2
+            except OSError as e2:
+                raise _unavailable(e2, "unreachable") from e2
+            try:
+                raw = self._roundtrip(sock, payload)
+            except socket.timeout as e2:
+                raise _timeout_err(e2) from e2
             except (ConnectionError, OSError) as e2:
                 self._reset()
-                raise QueryError("shard_unavailable",
-                                 f"node {where} died mid-dispatch: "
-                                 f"{e2}") from e2
+                raise _unavailable(e2, "died mid-dispatch") from e2
+        if br is not None:
+            # a reply frame arrived: the peer is alive (even a
+            # remote_failure reply resets the consecutive-failure run)
+            br.on_success()
+        try:
+            reply = serialize.loads(raw)
+        except Exception as e:  # noqa: BLE001 — garbage frame, any shape
+            # corrupt reply: the stream may be out of sync — drop the
+            # pooled connection; NOT retried (the remote did execute)
+            self._reset()
+            raise QueryError(
+                "remote_failure",
+                f"node {where} sent a corrupt reply frame: "
+                f"{type(e).__name__}: {e}") from e
         if not reply["ok"]:
+            # a typed QueryError that fired ON the remote keeps its code
+            # (query_timeout stays errorType "timeout" at the HTTP edge;
+            # a nested shard_unavailable stays retry/drop-eligible) —
+            # everything else is the taxonomy's remote_failure
+            code = reply.get("error_code")
+            detail = reply["error"]
+            if code:
+                if detail.startswith(code + ":"):
+                    detail = detail[len(code) + 1:].strip()
+                raise QueryError(code, f"(via node {where}) {detail}")
             raise QueryError("remote_failure",
-                             f"node {where} failed: {reply['error']}")
+                             f"node {where} failed: {detail}")
         # stitch the remote node's spans into the caller's trace (they
         # arrive stamped with the remote NODE_NAME)
         spans = reply.get("spans")

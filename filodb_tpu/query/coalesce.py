@@ -59,6 +59,9 @@ class QueryCoalescer:
                 self._groups[key] = grp
             idx = len(grp.queries)
             grp.queries.append(promql)
+        completed = True
+        dl = getattr(planner_params, "deadline_unix_s", 0.0) \
+            if planner_params is not None else 0.0
         if leader:
             time.sleep(self.window_s)
             with self._lock:
@@ -81,10 +84,36 @@ class QueryCoalescer:
             else:
                 grp.done.set()
         else:
-            # generous bound: a wedged leader must not strand followers
-            grp.done.wait(timeout=max(300.0, 10 * self.window_s))
+            # generous bound: a wedged leader must not strand followers.
+            # The follower's deadline bounds the wait too — the solo
+            # fallback then returns the structured query_timeout from
+            # the exec-boundary check instead of blocking past budget.
+            from filodb_tpu.query.rangevector import remaining_budget
+            bound = remaining_budget(planner_params,
+                                     max(300.0, 10 * self.window_s))
+            completed = grp.done.wait(timeout=bound)
         if grp.error is not None or grp.results is None:
             # batch failed (or leader timed out): run alone
+            res = self.engine.query_range(promql, start_s, step_s, end_s,
+                                          planner_params)
+            deadline_expired = (not leader and dl and time.time() >= dl)
+            if not completed and not deadline_expired:
+                # the wedged-leader fallback must be visible: count it
+                # and flag the follower's stats so an operator can see
+                # WHY this poll ran solo (satellite of PR 4)
+                from filodb_tpu.utils.metrics import registry
+                registry.counter("coalesce_leader_timeouts").increment()
+                if res is not None:
+                    res.stats.warnings.append(
+                        "coalesce leader timed out; follower fell back "
+                        "to solo execution")
+            return res
+        res = grp.results[idx]
+        if not leader and res is not None and res.error is not None \
+                and res.error.startswith("query_timeout"):
+            # the LEADER's budget expired, not this follower's (budgets
+            # are repr-excluded from the group key): re-run solo under
+            # our own deadline instead of inheriting the expiry
             return self.engine.query_range(promql, start_s, step_s, end_s,
                                            planner_params)
-        return grp.results[idx]
+        return res

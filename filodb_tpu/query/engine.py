@@ -28,9 +28,13 @@ class QueryEngine:
                  shard_mapper: Optional[ShardMapper] = None,
                  spread_provider: Optional[SpreadProvider] = None,
                  planner: Optional[SingleClusterPlanner] = None,
-                 replan_hook=None):
+                 replan_hook=None, config=None):
         self.dataset = dataset
         self.source = source
+        # deployment-injected FilodbSettings (FiloServer passes its own,
+        # matching the frontends') — None falls back to the settings()
+        # singleton per call so bare constructions track config reloads
+        self.config = config
         # embedded-engine deployments (no FiloServer) still get the
         # persistent compile cache; idempotent under the standalone path
         from filodb_tpu.config import apply_jax_runtime, settings
@@ -47,9 +51,26 @@ class QueryEngine:
         self.replan_hook = replan_hook
 
     def _ctx(self, planner_params: Optional[PlannerParams]) -> QueryContext:
+        from filodb_tpu.query.rangevector import compute_deadline
+        q = self._qconfig()
+        if planner_params is None:
+            # bare-engine callers inherit the server's partial-results
+            # stance; explicit PlannerParams always win
+            planner_params = PlannerParams(
+                allow_partial_results=q.allow_partial_results)
+        # end-to-end deadline: the frontend stamps deadline_unix_s at
+        # ADMISSION (queue wait counts); otherwise the budget starts now
         return QueryContext(query_id=str(uuid.uuid4()),
                             submit_time_ms=int(_time.time() * 1000),
-                            planner_params=planner_params or PlannerParams())
+                            planner_params=planner_params,
+                            deadline_unix_s=compute_deadline(
+                                planner_params, q.default_timeout_s))
+
+    def _qconfig(self):
+        if self.config is not None:
+            return self.config.query
+        from filodb_tpu.config import settings
+        return settings().query
 
     def query_range(self, promql: str, start_s: int, step_s: int, end_s: int,
                     planner_params: Optional[PlannerParams] = None
@@ -151,8 +172,10 @@ class QueryEngine:
             res = ep.execute(self.source)
             res.trace_id = ctx.query_id
             if res.error and res.error.startswith("shard_unavailable") \
-                    and self.replan_hook is not None:
-                # failover retry for the dashboard-batch path too: the
+                    and (self.replan_hook is not None
+                         or ctx.planner_params.allow_partial_results):
+                # failover retry (and, past the retries, the partial-
+                # result degrade) for the dashboard-batch path too: the
                 # retried query re-plans through exec_logical_plan (it
                 # loses this batch's fusion, which is moot — its shard
                 # owner just died)
@@ -161,6 +184,23 @@ class QueryEngine:
             res.stats.plan_s += plan_t
             results[i] = res
         return results
+
+    def _engage_partial_replan(self, plan: lp.LogicalPlan, ctx):
+        """The shard STAYED unavailable after the re-plan retries and
+        partials are allowed: degrade instead of fail — engage the
+        scatter-gather drop (partial_now) and re-materialize; with the
+        peer's breaker now open the next pass fails fast per dropped
+        child and the survivors merge into a FLAGGED partial result
+        (ref: the Thanos/Cortex partial-response stance).  One home for
+        the degrade protocol shared by the metadata and data paths; the
+        dataclasses copy keeps the caller's PlannerParams unmutated."""
+        import dataclasses as _dc
+
+        from filodb_tpu.utils.metrics import registry
+        registry.counter("query_partial_engaged").increment()
+        ctx.planner_params = _dc.replace(ctx.planner_params,
+                                         partial_now=True)
+        return self.planner.materialize(plan, ctx)
 
     def exec_logical_plan(self, plan: lp.LogicalPlan,
                           planner_params: Optional[PlannerParams] = None
@@ -175,9 +215,39 @@ class QueryEngine:
             return QueryResult([], error=f"planning error: {e}")
         plan_t = _time.perf_counter() - t_plan0
         if isinstance(plan, lp.MetadataQueryPlan):
-            data, stats = ep.execute_internal(self.source)
+            from filodb_tpu.query.execbase import QueryError
+            try:
+                try:
+                    data, stats = ep.execute_internal(self.source)
+                except QueryError as e:
+                    if e.code != "shard_unavailable" or \
+                            not ctx.planner_params.allow_partial_results:
+                        raise
+                    # metadata scatters degrade like data queries: a
+                    # shard that stays down is dropped and the merged
+                    # result flagged partial (labels/series from the
+                    # survivors beat a hard error on every dashboard's
+                    # label dropdown)
+                    try:
+                        ep = self._engage_partial_replan(plan, ctx)
+                    except QueryError:
+                        raise
+                    except Exception as e2:  # noqa: BLE001
+                        return QueryResult([], error=f"replan error: {e2}")
+                    data, stats = ep.execute_internal(self.source)
+            except QueryError as e:
+                # same structured surface as data queries: a dead peer
+                # or an expired deadline on a metadata scatter is a
+                # typed result error, not a 500
+                return QueryResult([], error=str(e))
             stats.plan_s += plan_t
             if isinstance(data, QueryResult):
+                if data.partial:
+                    # same root-level counter data queries get from
+                    # ExecPlan.execute (metadata plans run through
+                    # execute_internal, which never increments it)
+                    from filodb_tpu.utils.metrics import registry
+                    registry.counter("query_partial_results").increment()
                 return data
             return QueryResult([], stats)
         res = ep.execute(self.source)
@@ -185,9 +255,8 @@ class QueryEngine:
         res.trace_id = ctx.query_id
         if res.error and res.error.startswith("shard_unavailable") \
                 and self.replan_hook is not None:
-            from filodb_tpu.config import settings
             from filodb_tpu.utils.metrics import registry
-            for _ in range(max(settings().query.dispatch_retries, 0)):
+            for _ in range(max(self._qconfig().dispatch_retries, 0)):
                 # a shard owner died mid-query: re-plan against a fresh
                 # shard-map snapshot and retry on the reassigned owner
                 # (only shard_unavailable — dispatch_timeout is never
@@ -203,6 +272,14 @@ class QueryEngine:
                 if not (res.error
                         and res.error.startswith("shard_unavailable")):
                     break
+        if res.error and res.error.startswith("shard_unavailable") \
+                and ctx.planner_params.allow_partial_results:
+            try:
+                ep = self._engage_partial_replan(plan, ctx)
+            except Exception as e:  # noqa: BLE001
+                return QueryResult([], error=f"replan error: {e}")
+            res = ep.execute(self.source)
+            res.trace_id = ctx.query_id
         return res
 
     # ------------------------------------------------- Prometheus JSON model
@@ -210,9 +287,9 @@ class QueryEngine:
     @staticmethod
     def to_prom_matrix(result: QueryResult) -> Dict:
         """ref: PrometheusModel.toPromSuccessResponse (matrix result)."""
-        if result.error:
-            return {"status": "error", "errorType": "query_error",
-                    "error": result.error}
+        err = _prom_error_payload(result)
+        if err is not None:
+            return err
         out = []
         for b in result.blocks:
             vals = np.asarray(b.values)
@@ -233,18 +310,15 @@ class QueryEngine:
                                        for j in idx]})
         payload = {"status": "success",
                    "data": {"resultType": "matrix", "result": out}}
-        if result.partial:
-            payload["warnings"] = ["partial results: one or more shards "
-                                   "were unreachable"]
-            payload["partial"] = True
-        return payload
+        return _attach_partial_fields(payload, result.partial,
+                                      result.stats.warnings)
 
     @staticmethod
     def to_prom_vector(result: QueryResult) -> Dict:
         """Instant-vector response (last step of each series)."""
-        if result.error:
-            return {"status": "error", "errorType": "query_error",
-                    "error": result.error}
+        err = _prom_error_payload(result)
+        if err is not None:
+            return err
         out = []
         for key, wends, vals in result.series():
             if vals.ndim == 2 or len(vals) == 0:
@@ -253,8 +327,10 @@ class QueryEngine:
             if not math.isnan(v):
                 out.append({"metric": _prom_labels(key.labels_dict),
                             "value": [int(wends[-1]) / 1000.0, _fmt(v)]})
-        return {"status": "success",
-                "data": {"resultType": "vector", "result": out}}
+        payload = {"status": "success",
+                   "data": {"resultType": "vector", "result": out}}
+        return _attach_partial_fields(payload, result.partial,
+                                      result.stats.warnings)
 
 
 def _walk_plan(ep):
@@ -262,6 +338,33 @@ def _walk_plan(ep):
     yield ep
     for c in ep.children:
         yield from _walk_plan(c)
+
+
+def _prom_error_payload(result: QueryResult) -> Optional[Dict]:
+    """Error half of the Prometheus envelope, or None for success.  One
+    home for the errorType taxonomy (deadline expiry maps to "timeout"
+    so clients can route on it) shared by the matrix and vector
+    serializers."""
+    if not result.error:
+        return None
+    etype = ("timeout" if result.error.startswith("query_timeout")
+             else "query_error")
+    return {"status": "error", "errorType": etype, "error": result.error}
+
+
+def _attach_partial_fields(payload: Dict, partial: bool,
+                           warnings: List[str]) -> Dict:
+    """Degradation fields of the envelope — never-silent partials: the
+    warnings list plus "partial": true.  Shared by the matrix and vector
+    serializers AND the metadata route handlers (labels/series payloads
+    flag dropped shards the same way)."""
+    if partial or warnings:
+        payload["warnings"] = (
+            list(warnings)
+            or ["partial results: one or more shards were unreachable"])
+    if partial:
+        payload["partial"] = True
+    return payload
 
 
 def _prom_labels(labels: Dict[str, str]) -> Dict[str, str]:

@@ -21,7 +21,7 @@ so existing import paths keep working.
 """
 from filodb_tpu.query.execbase import (  # noqa: F401
     AggPartial, AnalyzeRecorder, Data, EmptyResultExec, ExecPlan,
-    GroupCardinalityError,
+    GroupCardinalityError, LazyKeys, QueryError,
     InProcessPlanDispatcher, LeafExecPlan, NonLeafExecPlan, PlanDispatcher,
     QueryResultLike, RawBlock, ScalarResult, _FUSED_CACHE_LOCK,
     _FUSED_GROUP_CACHE, _FUSED_MINMAX_PAD_CACHE, _FUSED_PLAN_CACHE,

@@ -198,6 +198,13 @@ class QueryError(Exception):
       remote_failure — the remote executed the plan and returned an
           error (its exception text rides along).  Not retryable here;
           the same plan would fail the same way.
+      query_timeout — the query's end-to-end deadline
+          (query.default_timeout_s / the `timeout=` request param)
+          expired: at an exec-node boundary, while queued in the
+          frontend scheduler, or mid-dispatch when the remaining budget
+          (not the per-hop ask timeout) bounded the socket wait.  Never
+          retried and never dropped-for-partial — the budget is global,
+          so continuing cannot produce a timely answer.
 
     The string form is always "<code>: <detail>", so HTTP/CLI clients
     (and tests) can route on `error.split(':', 1)[0]`."""
@@ -548,6 +555,15 @@ class ExecPlan:
         lands in ITS QueryStats — children's contributions arrive via
         stats.merge, so the root totals are exact sums over nodes."""
         from filodb_tpu.utils.metrics import exec_tally
+        # deadline check at every node boundary: a query past its budget
+        # stops HERE instead of fanning out more work (getattr: contexts
+        # serialized by an older peer lack the field)
+        dl = getattr(self.ctx, "deadline_unix_s", 0.0)
+        if dl and _time.time() >= dl:
+            raise QueryError(
+                "query_timeout",
+                f"deadline exceeded at {type(self).__name__} "
+                f"(budget expired {_time.time() - dl:.3f}s ago)")
         snap = exec_tally.snapshot()
         t0 = _time.perf_counter()
         try:
@@ -623,6 +639,10 @@ class ExecPlan:
         stats.result_samples = result_samples
         stats.result_bytes = sum(int(np.asarray(b.values).nbytes)
                                  for b in blocks)
+        if stats.partial:
+            # root-level degradation counter (execute() runs once per
+            # root; children go through execute_internal)
+            registry.counter("query_partial_results").increment()
         return QueryResult(blocks, stats, partial=stats.partial)
 
     # -- plan printing (ref: ExecPlan.printTree, doc/query-engine.md:174-204)
@@ -672,21 +692,36 @@ class NonLeafExecPlan(ExecPlan):
     def _gather(self, source) -> Tuple[List[Data], QueryStats]:
         stats = QueryStats()
         results = []
-        allow_partial = self.ctx.planner_params.allow_partial_results
+        pp = self.ctx.planner_params
+        allow_partial = pp.allow_partial_results
+        # shard_unavailable drops only once the ENGINE has engaged
+        # degradation (partial_now: re-plan retries exhausted) — so a
+        # transient owner death still gets routed around before any data
+        # is given up.  A peer blowing its deadline share
+        # (dispatch_timeout) drops under the gate alone: retrying cannot
+        # help inside the budget.  query_timeout NEVER drops — the
+        # budget is global, so the root propagates the structured error.
+        droppable = set()
+        if allow_partial:
+            droppable.add("dispatch_timeout")
+            if getattr(pp, "partial_now", False):
+                droppable.add("shard_unavailable")
         for c in self._children:
             try:
                 data, st = c.dispatcher.dispatch(c, source)
             except QueryError as e:
                 # a dead shard owner mid-query: fail the whole query with
-                # the typed error — or, when the caller opted into
-                # partial results, drop the child and FLAG the result
-                # (never silent partials; ref: PlanDispatcher.scala:31-55,
+                # the typed error — or, when partial results are engaged,
+                # drop the child and FLAG the result (never silent
+                # partials; ref: PlanDispatcher.scala:31-55,
                 # PlannerParams.allowPartialResults)
-                if allow_partial and e.code == "shard_unavailable":
+                if e.code in droppable:
                     from filodb_tpu.utils.metrics import registry
                     registry.counter("query_partial_children",
-                                     plan=type(self).__name__).increment()
+                                     plan=type(self).__name__,
+                                     code=e.code).increment()
                     stats.partial = True
+                    stats.warnings.append(f"shard dropped ({e})")
                     # placeholder, NOT continue: BinaryJoin/SetOperator
                     # split `results` positionally at n_lhs, so a dropped
                     # child must keep its slot (every compose filters by

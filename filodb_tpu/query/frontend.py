@@ -27,6 +27,8 @@ from typing import Dict, Optional, Tuple
 
 from filodb_tpu.core.shard import NO_HORIZON_MS
 from filodb_tpu.query.coalesce import QueryCoalescer
+from filodb_tpu.query.rangevector import (PlannerParams, QueryResult,
+                                          remaining_budget)
 from filodb_tpu.query.resultcache import ResultCache, _plan_cacheable
 
 
@@ -65,6 +67,9 @@ class QueryFrontend:
         self._usage_enabled = q.tenant_usage_enabled
         self._warn_limit = q.tenant_samples_warn_limit
         self._fail_limit = q.tenant_samples_fail_limit
+        # --- failure-domain hardening (PR 4): end-to-end deadlines ---
+        self._default_timeout_s = q.default_timeout_s
+        self._allow_partial_default = q.allow_partial_results
 
     # ------------------------------------------------------------ public
 
@@ -75,9 +80,12 @@ class QueryFrontend:
         slow-query flight recorder on the way out.  The recorded
         duration is the CLIENT-OBSERVED wall (queue wait and dedup wait
         included) — that's the latency an operator is paged for."""
-        from filodb_tpu.query.rangevector import QueryResult
         from filodb_tpu.utils.slowlog import slowlog
         from filodb_tpu.utils.usage import tenant_of, usage
+        # the deadline clock starts at ADMISSION: scheduler queue wait
+        # and singleflight dedup wait spend from the same budget the
+        # exec tree enforces (doc/robustness.md deadline semantics)
+        planner_params = self._admit_params(planner_params)
         tenant = ("", "")
         if self._usage_enabled:
             tenant = tenant_of(promql)
@@ -120,12 +128,37 @@ class QueryFrontend:
             from filodb_tpu.utils.metrics import registry
             registry.counter("query_singleflight_hits").increment()
             # generous bound mirroring the coalescer's: a wedged leader
-            # must not strand followers — they fall back to running solo
-            flight.done.wait(timeout=max(300.0, 3 * self._ask_timeout_s))
+            # must not strand followers — they fall back to running solo.
+            # The follower's DEADLINE bounds the wait too (dedup wait
+            # spends the same budget as execution); an expired budget
+            # then surfaces as the structured query_timeout via the solo
+            # path's scheduler/exec-boundary checks.
+            bound = remaining_budget(planner_params,
+                                     max(300.0, 3 * self._ask_timeout_s))
+            dl = getattr(planner_params, "deadline_unix_s", 0.0) \
+                if planner_params is not None else 0.0
+            completed = flight.done.wait(timeout=bound)
             if flight.result is not None:
-                return flight.result, True
-            return self._cached_query(promql, start_s, step_s, end_s,
-                                      planner_params), False
+                shared = flight.result
+                # never inherit the LEADER's deadline expiry: budgets
+                # are per-request (repr-excluded from the dedup key), so
+                # a short-timeout leader must not fail long-budget
+                # followers — they run solo under their own deadline
+                if not (shared.error is not None
+                        and shared.error.startswith("query_timeout")):
+                    return shared, True
+            res = self._cached_query(promql, start_s, step_s, end_s,
+                                     planner_params)
+            if not completed and not (dl and _time.time() >= dl):
+                # the leader wedged past the full bound (NOT our own
+                # deadline expiring): the fallback must be visible to
+                # operators, not a silent doubled execution
+                registry.counter("singleflight_leader_timeouts").increment()
+                if res is not None:
+                    res.stats.warnings.append(
+                        "singleflight leader timed out; follower fell "
+                        "back to solo execution")
+            return res, False
         try:
             res = self._cached_query(promql, start_s, step_s, end_s,
                                      planner_params)
@@ -168,8 +201,17 @@ class QueryFrontend:
         plan = query_range_to_logical_plan(
             promql, TimeStepParams(start_s, step_s, end_s))
         ctx = QueryContext(query_id=_uuid.uuid4().hex[:16])
-        if planner_params is not None:
-            ctx.planner_params = planner_params
+        # same deadline semantics as query_range: the budget starts at
+        # admission and the exec tree below enforces it.  analyze has no
+        # re-plan/retry layer, so the partial-results gate engages the
+        # scatter-gather drop directly — a dead shard yields a flagged
+        # partial analysis, not a hard error
+        import dataclasses as _dc
+        planner_params = self._admit_params(planner_params)
+        if planner_params.allow_partial_results:
+            planner_params = _dc.replace(planner_params, partial_now=True)
+        ctx.planner_params = planner_params
+        ctx.deadline_unix_s = planner_params.deadline_unix_s
         ep = self.engine.planner.materialize(plan, ctx)
         rec = AnalyzeRecorder()
         # plain attribute, NOT a dataclass field: remote-dispatched
@@ -212,21 +254,52 @@ class QueryFrontend:
         return cache.query_range(run, promql, start_s, step_s, end_s,
                                  repr(pp), self._state())
 
+    def _admit_params(self, pp):
+        """Copy of the caller's PlannerParams with the end-to-end
+        deadline stamped (None → server defaults).  The request's
+        timeout_s is CAPPED by query.default_timeout_s; the returned
+        copy keys identically to the input (deadline is repr-excluded),
+        so singleflight/coalescer/result-cache keys are unaffected."""
+        import dataclasses as _dc
+
+        from filodb_tpu.query.rangevector import compute_deadline
+        if pp is None:
+            pp = PlannerParams(
+                allow_partial_results=self._allow_partial_default)
+        deadline = compute_deadline(pp, self._default_timeout_s)
+        if deadline == pp.deadline_unix_s:
+            return pp
+        return _dc.replace(pp, deadline_unix_s=deadline)
+
     def _run(self, promql, start_s, step_s, end_s, pp):
         sem = self._sem
         if sem is None:
             return self.coalescer.query_range(promql, start_s, step_s,
                                               end_s, pp)
-        # never fail a query on queue pressure: a full queue just means
-        # this request executes unthrottled after the wait (observable
-        # via the counter rather than a user-visible error)
+        # never fail a query on queue pressure ALONE: a full queue just
+        # means this request executes unthrottled after the wait
+        # (observable via the counter rather than a user-visible error).
+        # The query's DEADLINE does bound the wait, though — time queued
+        # spends from the same end-to-end budget as execution, and a
+        # request whose budget died in the queue returns the structured
+        # query_timeout error instead of launching doomed work.
+        dl = getattr(pp, "deadline_unix_s", 0.0) if pp is not None else 0.0
+        timeout = remaining_budget(pp, self._ask_timeout_s)
         t0 = _time.perf_counter()
-        acquired = sem.acquire(timeout=self._ask_timeout_s)
+        acquired = sem.acquire(timeout=timeout)
         waited = _time.perf_counter() - t0
         if not acquired:
             from filodb_tpu.utils.metrics import registry
             registry.counter("query_scheduler_timeouts").increment()
         try:
+            if dl and _time.time() >= dl:
+                from filodb_tpu.utils.metrics import registry
+                registry.counter("query_timeouts_in_queue").increment()
+                res = QueryResult(
+                    [], error=("query_timeout: deadline exceeded after "
+                               f"{waited:.3f}s in the scheduler queue"))
+                res.stats.queue_wait_s += waited
+                return res
             res = self.coalescer.query_range(promql, start_s, step_s,
                                              end_s, pp)
             # queue attribution: scheduler wait is part of the query's

@@ -209,5 +209,9 @@ class MetadataMergeExec(NonLeafExecPlan):
                 for k, v in r.data.items():
                     vals = set(merged.get(k, [])) | set(v)
                     merged[k] = sorted(vals)
-        return QueryResult([], stats, data=merged)
+        # a dropped shard set stats.partial in _gather: the flag must
+        # ride the RESULT too (the metadata HTTP payloads surface it —
+        # a label dropdown missing a dead node's values is exactly the
+        # silent partial the contract forbids)
+        return QueryResult([], stats, data=merged, partial=stats.partial)
 

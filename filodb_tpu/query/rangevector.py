@@ -105,6 +105,11 @@ class QueryStats:
     # set when allow_partial_results dropped an unreachable child —
     # propagates bottom-up through merge() to the root QueryResult
     partial: bool = False
+    # human-readable degradation notes (one per dropped child / wedged
+    # leader fallback), merged bottom-up and over the wire; surfaced as
+    # the Prometheus envelope's `warnings` list, in `?stats=true`, and
+    # in slowlog records — degradation is NEVER silent
+    warnings: List[str] = dataclasses.field(default_factory=list)
     # --- phase attribution (seconds) ---
     queue_wait_s: float = 0.0       # frontend scheduler semaphore wait
     parse_s: float = 0.0            # PromQL → logical plan
@@ -129,6 +134,8 @@ class QueryStats:
         self.result_samples += other.result_samples
         self.shards_queried += other.shards_queried
         self.partial = self.partial or other.partial
+        if other.warnings:
+            self.warnings.extend(other.warnings)
         self.queue_wait_s += other.queue_wait_s
         self.parse_s += other.parse_s
         self.plan_s += other.plan_s
@@ -151,6 +158,8 @@ class QueryStats:
             "resultBytes": self.result_bytes,
             "shardsQueried": self.shards_queried,
             "bytesTransferred": self.bytes_transferred,
+            "partial": self.partial,
+            "warnings": list(self.warnings),
             "phases": {
                 "queue_s": round(self.queue_wait_s, 6),
                 "parse_s": round(self.parse_s, 6),
@@ -213,8 +222,29 @@ class PlannerParams:
     process_multi_partition: bool = False
     # scatter-gather children whose shard owner is unreachable are
     # DROPPED (result flagged partial) instead of failing the query
-    # (ref: PlannerParams.allowPartialResults)
+    # (ref: PlannerParams.allowPartialResults).  This is the GATE: a
+    # shard_unavailable still gets the engine's re-plan retries first;
+    # only when those are exhausted does the engine engage the drop via
+    # `partial_now` (peers blowing their deadline share — dispatch
+    # timeouts — drop under the gate alone, since retrying them cannot
+    # help within the budget)
     allow_partial_results: bool = False
+    # --- deadline/degradation fields, repr=False: the serving keys
+    # (singleflight, coalescer, result cache) are repr(planner_params),
+    # and neither per-request budgets, absolute deadlines, nor engine-
+    # engaged degradation state may split byte-identical requests into
+    # distinct keys (two clients polling one panel with different
+    # timeouts share one execution; each follower's own deadline still
+    # bounds its wait in the frontend) ---
+    # per-request time budget in seconds; 0 = query.default_timeout_s.
+    # The server config CAPS it (a client cannot extend past the cap).
+    timeout_s: float = dataclasses.field(default=0.0, repr=False)
+    # absolute unix deadline stamped at admission (frontend) so queue
+    # wait counts against the budget; 0 = engine stamps at exec start
+    deadline_unix_s: float = dataclasses.field(default=0.0, repr=False)
+    # set by the ENGINE after re-plan retries are exhausted: scatter-
+    # gathers may now drop unreachable children (see gate note above)
+    partial_now: bool = dataclasses.field(default=False, repr=False)
 
 
 @dataclasses.dataclass
@@ -226,6 +256,44 @@ class QueryContext:
     origin: str = ""
     planner_params: PlannerParams = dataclasses.field(default_factory=PlannerParams)
     lookback_ms: int = 5 * 60 * 1000                # staleness window
+    # end-to-end deadline (unix seconds; 0 = none): checked at every
+    # exec-node boundary (execbase.execute_internal) and shrinking each
+    # remote hop's socket timeout to the remaining budget — it rides the
+    # wire with dispatched subtrees, so remote nodes enforce it too
+    # (nodes share one clock here; document skew bounds for real WANs)
+    deadline_unix_s: float = 0.0
+
+
+def compute_deadline(pp: PlannerParams, default_timeout_s: float) -> float:
+    """Absolute unix deadline for a request: an already-stamped deadline
+    wins; otherwise the request's timeout_s CAPPED by the server default
+    (a client can shrink its budget, never extend it); 0 = no deadline.
+    The single home of the cap rule — the frontend (admission stamp) and
+    the bare engine (execution-start stamp) must never drift."""
+    if pp.deadline_unix_s:
+        return pp.deadline_unix_s
+    budget = pp.timeout_s or default_timeout_s
+    if pp.timeout_s > 0 and default_timeout_s > 0:
+        budget = min(pp.timeout_s, default_timeout_s)
+    if budget <= 0:
+        return 0.0
+    import time as _t
+    return _t.time() + budget
+
+
+def remaining_budget(pp: Optional[PlannerParams], bound: float) -> float:
+    """`bound` shrunk to the time left on pp's stamped deadline (floored
+    at 0); `bound` unchanged when no deadline rides the params.  The
+    single home of the wait-bound rule shared by the singleflight dedup
+    wait, the scheduler queue wait, and the coalescer follower wait —
+    every place a query BLOCKS must spend from the same budget the exec
+    tree enforces (getattr: params serialized by an older peer may lack
+    the field)."""
+    dl = getattr(pp, "deadline_unix_s", 0.0) if pp is not None else 0.0
+    if not dl:
+        return bound
+    import time as _t
+    return min(bound, max(dl - _t.time(), 0.0))
 
 
 def remove_nan_series(block: Optional[ResultBlock]) -> Optional[ResultBlock]:

@@ -123,7 +123,8 @@ class FiloServer:
         planner = ShardKeyRegexPlanner(planner, matcher)
         self.mappers[dc.name] = mapper
         self.engines[dc.name] = QueryEngine(dc.name, self._source(), mapper,
-                                            planner=planner)
+                                            planner=planner,
+                                            config=self.config)
         self.gateways[dc.name] = GatewayPipeline(self.memstore, dc.name,
                                                  mapper, spread)
 

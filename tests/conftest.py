@@ -45,3 +45,16 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "slow: multi-process / long-running tests (run by "
         "default; deselect with -m 'not slow')")
+    config.addinivalue_line(
+        "markers", "chaos: fault-injection / node-kill chaos tests "
+        "(subprocess clusters, SIGKILL, wall-clock waits). Implies slow, "
+        "so tier-1's -m 'not slow' excludes them; run explicitly with "
+        "-m chaos or via `python bench.py chaos`.")
+
+
+def pytest_collection_modifyitems(config, items):
+    # chaos implies slow: the tier-1 gate (-m 'not slow') must never pay
+    # for subprocess spawn + SIGKILL + restart cycles
+    for item in items:
+        if "chaos" in item.keywords and "slow" not in item.keywords:
+            item.add_marker(pytest.mark.slow)

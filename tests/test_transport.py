@@ -215,8 +215,12 @@ def test_partial_results_keep_join_child_positions():
         def _do_execute(self, source):
             raise QueryError("shard_unavailable", "owner SIGKILLed")
 
+    # partial_now is what the ENGINE sets once re-plan retries are
+    # exhausted (PR 4 retry-then-degrade); at the _gather level it is
+    # the switch that actually authorizes dropping a dead child
     ctx = QueryContext(
-        planner_params=PlannerParams(allow_partial_results=True))
+        planner_params=PlannerParams(allow_partial_results=True,
+                                     partial_now=True))
     dead = _Dead(ctx)
     lhs_ok = _Static(ctx, "a", 10.0)
     rhs_a = _Static(ctx, "a", 1.0)
