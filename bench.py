@@ -798,6 +798,152 @@ def measure_observability(quick=False, series=None):
     return st
 
 
+def measure_ruler(quick=False, series=None):
+    """PR 5 acceptance: the ruler as a precompute engine.  A group of 8
+    aggregation rules (the dashboard-panel shapes) evaluates against the
+    live store at ticks spanning the query window, then:
+
+      ruler_eval_p50_s         — one full group iteration (8 instant
+                                 queries through the frontend + columnar
+                                 write-back) at the acceptance scale
+      recorded_query_speedup_x — the SAME dashboard aggregate served
+                                 from the recorded series vs evaluating
+                                 the raw expression over the range
+                                 (gate: >= 10x — the entire point of
+                                 recording rules)
+      ruler_overhead_pct       — frontend QPS with the ruler's
+                                 evaluation loops live vs stopped (the
+                                 standing-query tax on serving traffic,
+                                 result-cache invalidation churn from
+                                 the write-backs included)
+    """
+    import threading
+
+    from filodb_tpu.config import RulesConfig
+    from filodb_tpu.rules import MemstoreSink, Ruler, WebhookNotifier
+    from filodb_tpu.rules.config import Rule, RuleGroup
+
+    S = series or (8_192 if quick else 262_144)
+    T = 120
+    fe, eng, q, start_s, end_s, pp = _frontend_fixture(S, T, "bench_ruler")
+    rules = tuple(
+        Rule(name, expr, "recording") for name, expr in [
+            ("ns:request_total:rate5m",
+             "sum by (_ns_)(rate(request_total[5m]))"),
+            ("dc:request_total:rate5m",
+             "sum by (dc)(rate(request_total[5m]))"),
+            ("total:request_total:rate5m",
+             "sum(rate(request_total[5m]))"),
+            ("ns:request_total:avg_rate5m",
+             "avg by (_ns_)(rate(request_total[5m]))"),
+            ("ns:request_total:max_rate5m",
+             "max by (_ns_)(rate(request_total[5m]))"),
+            ("dc:request_total:increase1m",
+             "sum by (dc)(increase(request_total[1m]))"),
+            ("ns:request_total:series",
+             "count by (_ns_)(rate(request_total[5m]))"),
+            ("total:recorded:rate5m",      # 2nd-order: reads rule 1
+             "sum(ns:request_total:rate5m)"),
+        ])
+    group = RuleGroup("bench", 30.0, rules)
+    ruler = Ruler(fe, MemstoreSink(eng.source, "bench_ruler"),
+                  groups=[group], config=RulesConfig(),
+                  notifier=WebhookNotifier(sleep=lambda s: None))
+    st = {"series": S, "rules": len(rules)}
+
+    # materialize the recorded series across the query window (30s
+    # ticks), timing each full iteration
+    ticks = list(range(start_s, end_s + 1, 30))
+    durs = []
+    for ts in ticks:
+        t0 = time.perf_counter()
+        if not ruler.evaluate_group("bench", ts=ts):
+            bad = [r["lastError"]
+                   for r in ruler.rules_payload()["groups"][0]["rules"]
+                   if r["lastError"]]
+            return {**st, "error": f"rule eval failed: {bad[:1]}"[:200]}
+        durs.append(time.perf_counter() - t0)
+    durs.sort()
+    st["iterations"] = len(ticks)
+    st["ruler_eval_p50_s"] = round(durs[len(durs) // 2], 5)
+
+    # the dashboard aggregate from the recorded series vs the raw expr
+    def p50(fn, n=5):
+        ts = []
+        for _ in range(n):
+            t0 = time.perf_counter()
+            res = fn()
+            if res.error:
+                raise RuntimeError(res.error)
+            ts.append(time.perf_counter() - t0)
+        ts.sort()
+        return ts[len(ts) // 2]
+
+    raw_p50 = p50(lambda: eng.query_range(
+        "sum by (_ns_)(rate(request_total[5m]))", start_s, 30, end_s, pp))
+    rec_p50 = p50(lambda: eng.query_range(
+        "ns:request_total:rate5m", start_s, 30, end_s, pp))
+    st["raw_aggregate_p50_s"] = round(raw_p50, 5)
+    st["recorded_aggregate_p50_s"] = round(rec_p50, 5)
+    st["recorded_query_speedup_x"] = round(raw_p50 / max(rec_p50, 1e-9), 1)
+
+    # serving overhead: frontend QPS with the evaluation loops live vs
+    # stopped.  The ruler's clock is pinned into the data window so the
+    # rules do real work; a short interval keeps several iterations
+    # inside the measurement window.
+    dur_s = 2.0 if quick else 4.0
+    errors = []
+
+    def pump(seconds=None):
+        counts = []
+        stop_t = time.perf_counter() + (seconds or dur_s)
+
+        def client():
+            n = 0
+            while time.perf_counter() < stop_t:
+                res = fe.query_range(q, start_s, 60, end_s, pp)
+                if res.error is not None:
+                    errors.append(res.error)
+                    break
+                n += 1
+            counts.append(n)
+
+        threads = [threading.Thread(target=client) for _ in range(4)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        return sum(counts) / max(time.perf_counter() - t0, 1e-9)
+
+    pump(1.0)     # warm the serving path: off/on must differ only by
+    qps_off = pump()  # the ruler, not by who ran first on cold caches
+    interval = max(1.0, 2.0 * st["ruler_eval_p50_s"])
+    offset = end_s - time.time()
+    live = Ruler(fe, MemstoreSink(eng.source, "bench_ruler"),
+                 groups=[RuleGroup("bench", interval, rules)],
+                 config=RulesConfig(),
+                 notifier=WebhookNotifier(sleep=lambda s: None),
+                 clock=lambda: time.time() + offset)
+    live.start()
+    try:
+        # the loop's first tick lands anywhere up to one interval +
+        # stagger after start(): measure over >= 1.5 intervals so the
+        # window is guaranteed to contain evaluations — otherwise a
+        # short pump can miss the phase entirely and report ~0 overhead
+        qps_on = pump(max(dur_s, 1.5 * interval))
+    finally:
+        live.stop()
+    if errors:
+        st["error"] = f"pump: {errors[0]}"[:200]
+        return st
+    st["qps_ruler_off"] = round(qps_off, 1)
+    st["qps_ruler_on"] = round(qps_on, 1)
+    st["ruler_overhead_pct"] = round(
+        100.0 * (qps_off - qps_on) / max(qps_off, 1e-9), 2)
+    return st
+
+
 def run_chaos(quick=False, series=None):
     """Failure-domain chaos stage (PR 4 acceptance): two real data-node
     processes serve one dataset over the cross-node transport while this
@@ -1114,6 +1260,15 @@ def assemble_result(platform, stages, vec_sps, it_sps, c_sps=0.0,
         # query_frontend QPS number (gate: <= 5%)
         result["span_overhead_pct"] = obs["span_overhead_pct"]
         result["observability_stats_ok"] = obs.get("stats_phases_ok")
+    rul = stages.get("ruler", {})
+    for k in ("ruler_eval_p50_s", "recorded_query_speedup_x",
+              "ruler_overhead_pct"):
+        if k in rul:
+            # PR-5 acceptance: full group-iteration p50 (8 rules through
+            # the frontend + write-back), the dashboard aggregate served
+            # from the recorded series vs the raw expression (gate:
+            # >= 10x), and the standing-query tax on serving QPS
+            result[k] = rul[k]
     ns = stages.get("north_star_1m") or stages.get("cpu_north_star_1m")
     if ns and "samples_per_sec" in ns:
         result.update({
@@ -1253,6 +1408,13 @@ def run_worker(args):
     except Exception as e:  # noqa: BLE001 — must not sink the run
         writer.stage("observability",
                      {"error": f"{type(e).__name__}: {e}"[:300]})
+
+    try:
+        rul = measure_ruler(quick=quick)
+        writer.stage("ruler", rul)
+        stages["ruler"] = rul
+    except Exception as e:  # noqa: BLE001 — must not sink the run
+        writer.stage("ruler", {"error": f"{type(e).__name__}: {e}"[:300]})
 
     result = assemble_result(platform, stages, vec_sps, it_sps,
                              c_sps)

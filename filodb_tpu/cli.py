@@ -249,6 +249,42 @@ def cmd_status(args) -> int:
     return 0
 
 
+def cmd_rules(args) -> int:
+    """Ruler state over HTTP: rule groups with per-rule health/timings
+    (`rules`), active alerts (`rules --alerts`), or a hot reload of the
+    rules config (`rules --reload`).  ref: promtool's rules subcommands
+    against a live server; doc/recording_rules.md."""
+    if args.reload:
+        payload = _http_get(args.host, "/admin/rules/reload", {}, data=b"")
+    elif args.alerts:
+        payload = _http_get(args.host, "/api/v1/alerts", {})
+    else:
+        params = {"type": args.type} if args.type else {}
+        payload = _http_get(args.host, "/api/v1/rules", params)
+    print(json.dumps(payload, indent=2))
+    return 0 if payload.get("status") == "success" else 1
+
+
+def cmd_checkrules(args) -> int:
+    """Validate a rules file offline (the promtool `check rules`
+    analogue): parse + validate every group/expr without a server."""
+    from filodb_tpu.config import RulesConfig
+    from filodb_tpu.rules import RulesConfigError, load_rule_groups
+    try:
+        groups = load_rule_groups(RulesConfig(file=args.file))
+    except RulesConfigError as e:
+        print(f"FAILED: {e}", file=sys.stderr)
+        return 1
+    n_rules = sum(len(g.rules) for g in groups)
+    print(f"OK: {len(groups)} group(s), {n_rules} rule(s)")
+    for g in groups:
+        kinds = [r.kind for r in g.rules]
+        print(f"  {g.name}: interval={g.interval_s}s "
+              f"recording={kinds.count('recording')} "
+              f"alerting={kinds.count('alerting')}")
+    return 0
+
+
 def cmd_validate_schemas(args) -> int:
     """ref: CliMain `validateSchemas`."""
     from filodb_tpu.core.schemas import DEFAULT_SCHEMAS
@@ -529,6 +565,21 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--host", required=True)
     sp.add_argument("--dataset", default="prometheus")
     sp.set_defaults(fn=cmd_status)
+
+    sp = sub.add_parser("rules", help="ruler state over HTTP "
+                                      "(groups / alerts / reload)")
+    sp.add_argument("--host", required=True)
+    sp.add_argument("--alerts", action="store_true",
+                    help="show active alerts instead of rule groups")
+    sp.add_argument("--reload", action="store_true",
+                    help="POST /admin/rules/reload")
+    sp.add_argument("--type", choices=["record", "alert"], default="",
+                    help="filter rule groups by rule type")
+    sp.set_defaults(fn=cmd_rules)
+
+    sp = sub.add_parser("checkrules", help="validate a rules file offline")
+    sp.add_argument("file", help="rules file (.json or HOCON-lite .conf)")
+    sp.set_defaults(fn=cmd_checkrules)
 
     sp = sub.add_parser("validateSchemas", help="check schema registry")
     sp.set_defaults(fn=cmd_validate_schemas)

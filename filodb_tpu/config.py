@@ -121,6 +121,49 @@ class QueryConfig:
 
 
 @dataclasses.dataclass
+class RulesConfig:
+    """Ruler — recording & alerting rules (filodb_tpu/rules/;
+    doc/recording_rules.md).  Standing queries evaluated per group on an
+    interval through the QueryFrontend (admission, deadlines, tenant
+    `_rules_` accounting) whose outputs write back through the columnar
+    ingest path, so recorded series are immediately queryable, flushable
+    and downsample-eligible like any ingested series.
+
+    Groups come from two places, merged (group names must be unique
+    across both): an inline dict-shaped `groups {}` block here, and a
+    standalone rules `file` (.json with the Prometheus list shape, or a
+    HOCON-lite .conf mirroring the inline shape).  POST
+    /admin/rules/reload re-reads both without a restart."""
+    enabled: bool = False
+    # standalone rules file; "" = inline groups only.  JSON files use the
+    # Prometheus shape ({"groups": [{"name", "interval", "rules": [...]}]}),
+    # .conf files the dict shape of the inline block below.
+    file: str = ""
+    # evaluated dataset; "" = the server's default (first) dataset
+    dataset: str = ""
+    # group eval interval when a group declares none
+    default_interval_s: float = 30.0
+    # alert webhook (Alertmanager v4 payload shape); "" keeps
+    # notifications in the in-process sink (visible to tests/ops)
+    notify_url: str = ""
+    notify_retries: int = 3
+    notify_backoff_s: float = 0.5
+    notify_timeout_s: float = 5.0
+    # re-send still-firing alerts every this many seconds (Prometheus
+    # rules.alert.resend-delay, same 1m default); 0 = notify on
+    # transitions only — only safe without a notify_url, where a batch
+    # whose async delivery is dropped after retries would otherwise
+    # never be re-sent (and a real Alertmanager's resolve_timeout
+    # auto-resolves live alerts between deliveries).
+    notify_resend_delay_s: float = 60.0
+    # inline conf-tree groups: {group: {interval, limit?, rules: {name:
+    # {record|alert, expr, labels{}, annotations{}, for, keep_firing_for}}}}
+    # — dict-shaped because HOCON-lite has no object lists; the JSON/YAML
+    # file path accepts the Prometheus list shape too
+    groups: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass
 class BreakerConfig:
     """Per-peer circuit breakers around the remote query dispatcher
     (parallel/breaker.py; doc/robustness.md): after `failure_threshold`
@@ -210,6 +253,7 @@ class FilodbSettings:
     query: QueryConfig = dataclasses.field(default_factory=QueryConfig)
     store: StoreConfig = dataclasses.field(default_factory=StoreConfig)
     breaker: BreakerConfig = dataclasses.field(default_factory=BreakerConfig)
+    rules: RulesConfig = dataclasses.field(default_factory=RulesConfig)
     shard_key_level_metrics: bool = True
     quota_default: int = 2_000_000_000
     reassignment_min_interval_s: float = 2 * 3600.0
@@ -243,7 +287,8 @@ class FilodbSettings:
                 # expected — still a config mistake, same error surface
                 raise ConfigError(f"{source}: {e}")
         for section, obj in (("query", self.query), ("store", self.store),
-                             ("breaker", self.breaker)):
+                             ("breaker", self.breaker),
+                             ("rules", self.rules)):
             for k, v in (raw.pop(section, None) or {}).items():
                 _set_field(obj, k, v, f"{source}: {section}.{k}")
         if "spread_assignment" in raw:
@@ -288,7 +333,7 @@ class FilodbSettings:
             # durations ("30 minutes") and booleans behave identically
             from filodb_tpu.utils.hoconlite import _parse_scalar
             parsed = _parse_scalar(val)
-            for section in ("query_", "store_", "breaker_"):
+            for section in ("query_", "store_", "breaker_", "rules_"):
                 if rest.startswith(section):
                     overlay.setdefault(section[:-1], {})[
                         rest[len(section):]] = parsed

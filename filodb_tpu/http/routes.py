@@ -33,11 +33,18 @@ class PromHttpApi:
                  shard_mappers: Optional[Dict[str, object]] = None,
                  default_dataset: Optional[str] = None,
                  batch_window_ms: Optional[float] = None,
-                 config=None):
+                 config=None, ruler=None):
+        import time as _time
         self.engines = engines
         self.gateways = gateways or {}
         self.shard_mappers = shard_mappers or {}
         self.default_dataset = default_dataset or next(iter(engines), None)
+        # the rules engine (filodb_tpu/rules), when this deployment runs
+        # one: serves /api/v1/rules + /api/v1/alerts and the
+        # /admin/rules/reload verb.  FiloServer attaches it post-
+        # construction (the ruler needs this API's frontends to exist).
+        self.ruler = ruler
+        self._start_unix = _time.time()
         # Query-serving frontend per dataset (query/frontend.py):
         # singleflight dedup of byte-identical in-flight requests, the
         # step-aligned incremental result cache, a bounded concurrent
@@ -51,6 +58,7 @@ class PromHttpApi:
         if config is None:
             from filodb_tpu.config import settings
             config = settings()
+        self._config = config
         self._qconfig = config.query
         if batch_window_ms is None:
             batch_window_ms = config.query.batch_window_ms
@@ -105,6 +113,8 @@ class PromHttpApi:
             if parts[:2] == ["admin", "breakers"] and len(parts) == 2 \
                     and method == "GET":
                 return self._breakers()
+            if parts == ["admin", "rules", "reload"] and method == "POST":
+                return self._rules_reload()
             if parts[:2] == ["admin", "traces"] and len(parts) in (2, 3):
                 return self._traces(parts[2] if len(parts) == 3 else None)
             if parts[:2] == ["admin", "tracedfilters"] and method == "POST":
@@ -196,7 +206,12 @@ class PromHttpApi:
             t = _num_param(params, "time", "0")
             if params.get("explain") in ("true", "1"):
                 return self._explain(eng, q, t, 1, t)
-            res = eng.query_instant(q, t, planner_params)
+            # through the frontend like query_range: admission
+            # (concurrency semaphore), deadline stamped at admission,
+            # singleflight, tenant accounting/limits — the direct
+            # eng.query_instant call was a free pass around all four
+            res = self.frontends[dataset].query_instant(
+                q, t, planner_params)
             payload = QueryEngine.to_prom_vector(res)
             if res.trace_id:
                 payload["traceID"] = res.trace_id
@@ -217,6 +232,14 @@ class PromHttpApi:
             return self._cardinality(dataset, params)
         if rest == ["read"] and method == "POST":
             return self._remote_read(eng, body, planner_params)
+        if rest == ["rules"]:
+            return self._rules(params)
+        if rest == ["alerts"]:
+            return self._alerts()
+        if rest == ["status", "buildinfo"]:
+            return self._buildinfo()
+        if rest == ["status", "runtimeinfo"]:
+            return self._runtimeinfo()
         return 404, _err(f"unknown api/v1 endpoint {'/'.join(rest)}")
 
     # --------------------------------------------------------- remote read
@@ -503,6 +526,90 @@ class PromHttpApi:
         from filodb_tpu.parallel.breaker import breakers
         return 200, {"status": "success",
                      "data": {"breakers": breakers.snapshot()}}
+
+    # --------------------------------------------------------------- ruler
+
+    def _rules(self, params: Dict[str, str]) -> Tuple[int, object]:
+        """Prometheus RuleDiscovery payload (doc/recording_rules.md).
+        `?type=record|alert` filters like upstream; a deployment with no
+        ruler answers an empty group list (Grafana's alerting UI probes
+        this on every datasource)."""
+        data = (self.ruler.rules_payload() if self.ruler is not None
+                else {"groups": []})
+        want = params.get("type")
+        if want in ("record", "alert"):
+            kind = "recording" if want == "record" else "alerting"
+            data = {"groups": [
+                {**g, "rules": [r for r in g["rules"]
+                                if r["type"] == kind]}
+                for g in data["groups"]]}
+        return 200, {"status": "success", "data": data}
+
+    def _alerts(self) -> Tuple[int, object]:
+        data = (self.ruler.alerts_payload() if self.ruler is not None
+                else {"alerts": []})
+        return 200, {"status": "success", "data": data}
+
+    def _rules_reload(self) -> Tuple[int, object]:
+        """POST /admin/rules/reload: re-read the conf-tree groups + the
+        standalone rules file.  Invalid config is a 400 and the RUNNING
+        rules keep evaluating (Prometheus reload semantics)."""
+        if self.ruler is None:
+            return 400, _err("no ruler configured (rules.enabled=false)")
+        from filodb_tpu.rules.config import RulesConfigError
+        try:
+            summary = self.ruler.reload()
+        except RulesConfigError as e:
+            return 400, _err(f"rules reload rejected: {e}")
+        return 200, {"status": "success", "data": summary}
+
+    # -------------------------------------------------------------- status
+
+    def _buildinfo(self) -> Tuple[int, object]:
+        """Grafana probes /api/v1/status/buildinfo on datasource setup to
+        pick API features by version — answer the Prometheus shape."""
+        import platform as _platform
+
+        from filodb_tpu import __version__
+        return 200, {"status": "success", "data": {
+            "version": __version__,
+            "revision": "",
+            "branch": "",
+            "buildUser": "",
+            "buildDate": "",
+            "goVersion": f"python-{_platform.python_version()}",
+        }}
+
+    def _runtimeinfo(self) -> Tuple[int, object]:
+        import os as _os
+        import threading as _threading
+        import time as _time
+
+        from filodb_tpu.utils import iso_utc as iso
+
+        n_series = 0
+        for dataset, eng in self.engines.items():
+            source = getattr(eng, "source", None)
+            mapper = self.shard_mappers.get(dataset)
+            if source is None or mapper is None:
+                continue
+            for s in mapper.all_shards():
+                shard = source.get_shard(dataset, s)
+                if shard is not None:
+                    n_series += shard.num_partitions
+        retention_s = self._config.store.disk_time_to_live_s
+        return 200, {"status": "success", "data": {
+            "startTime": iso(self._start_unix),
+            "CWD": _os.getcwd(),
+            "reloadConfigSuccess": True,
+            "lastConfigTime": iso(self._start_unix),
+            "corruptionCount": 0,
+            "goroutineCount": _threading.active_count(),
+            "GOMAXPROCS": _os.cpu_count() or 1,
+            "storageRetention": f"{retention_s}s",
+            "timeSeriesCount": n_series,
+            "serverTime": iso(_time.time()),
+        }}
 
     def _traces(self, trace_id) -> Tuple[int, object]:
         """Stitched cross-node span tree for one query (the Zipkin-query

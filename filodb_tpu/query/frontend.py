@@ -80,22 +80,52 @@ class QueryFrontend:
         slow-query flight recorder on the way out.  The recorded
         duration is the CLIENT-OBSERVED wall (queue wait and dedup wait
         included) — that's the latency an operator is paged for."""
-        from filodb_tpu.utils.slowlog import slowlog
-        from filodb_tpu.utils.usage import tenant_of, usage
         # the deadline clock starts at ADMISSION: scheduler queue wait
         # and singleflight dedup wait spend from the same budget the
         # exec tree enforces (doc/robustness.md deadline semantics)
-        planner_params = self._admit_params(planner_params)
-        tenant = ("", "")
+        pp = self._admit_params(planner_params)
+        key = (promql, start_s, step_s, end_s, repr(pp))
+        return self._serve(
+            key, lambda: self._cached_query(promql, start_s, step_s,
+                                            end_s, pp),
+            promql, (start_s, step_s, end_s), pp, None, "query_range")
+
+    def query_instant(self, promql: str, time_s: int, planner_params=None,
+                      tenant=None, origin: str = "query"):
+        """Instant queries through the SAME serving stack as query_range
+        — tenant admission/limits, deadline stamped at admission,
+        singleflight dedup, the concurrency semaphore, usage accounting
+        and the slowlog — minus the step-aligned result cache (a
+        one-step grid has no reusable prefix).  Before this the
+        /api/v1/query route called eng.query_instant directly, a free
+        pass around every one of those; the ruler evaluates every rule
+        through here (`tenant` override -> the `_rules_` accounting
+        bucket, `origin` tags its slowlog records)."""
+        pp = self._admit_params(planner_params)
+        # an instant query at t IS the range query (t, 1, t): sharing
+        # the range key-space lets a dashboard's instant poll dedup
+        # against an identical in-flight one
+        key = (promql, time_s, 1, time_s, repr(pp))
+        return self._serve(
+            key, lambda: self._run(promql, time_s, 1, time_s, pp),
+            promql, (time_s, 1, time_s), pp, tenant, origin)
+
+    def _serve(self, key, run, promql, grid, pp, tenant, origin):
+        """Admission -> singleflight -> accounting: the shared serving
+        wrapper for both query shapes."""
+        from filodb_tpu.utils.slowlog import slowlog
+        from filodb_tpu.utils.usage import tenant_of, usage
         if self._usage_enabled:
-            tenant = tenant_of(promql)
+            if tenant is None:
+                tenant = tenant_of(promql)
             err = usage.admit(tenant[0], tenant[1], self._warn_limit,
                               self._fail_limit)
             if err is not None:
                 return QueryResult([], error=err)
+        if tenant is None:
+            tenant = ("", "")
         t0 = _time.perf_counter()
-        res, shared = self._sf_query_range(promql, start_s, step_s, end_s,
-                                           planner_params)
+        res, shared = self._singleflight(key, run, pp)
         dur = _time.perf_counter() - t0
         # singleflight followers received the LEADER's result: the work
         # (and its samples_scanned) happened once — re-recording it per
@@ -107,18 +137,16 @@ class QueryFrontend:
                 usage.record_query(tenant[0], tenant[1], dur,
                                    res.stats.samples_scanned,
                                    res.stats.result_bytes)
-            slowlog.maybe_record(promql, start_s, step_s, end_s, dur, res,
-                                 tenant=tenant, threshold_s=self._slow_s)
+            slowlog.maybe_record(promql, grid[0], grid[1], grid[2], dur,
+                                 res, tenant=tenant, origin=origin,
+                                 threshold_s=self._slow_s)
         return res
 
-    def _sf_query_range(self, promql: str, start_s: int, step_s: int,
-                        end_s: int, planner_params=None):
+    def _singleflight(self, key, run, planner_params=None):
         """Returns (result, shared): shared=True iff this caller rode a
         singleflight leader's execution instead of running its own."""
         if not self._sf_enabled:
-            return self._cached_query(promql, start_s, step_s, end_s,
-                                      planner_params), False
-        key = (promql, start_s, step_s, end_s, repr(planner_params))
+            return run(), False
         with self._sf_lock:
             flight = self._inflight.get(key)
             leader = flight is None
@@ -147,8 +175,7 @@ class QueryFrontend:
                 if not (shared.error is not None
                         and shared.error.startswith("query_timeout")):
                     return shared, True
-            res = self._cached_query(promql, start_s, step_s, end_s,
-                                     planner_params)
+            res = run()
             if not completed and not (dl and _time.time() >= dl):
                 # the leader wedged past the full bound (NOT our own
                 # deadline expiring): the fallback must be visible to
@@ -160,8 +187,7 @@ class QueryFrontend:
                         "back to solo execution")
             return res, False
         try:
-            res = self._cached_query(promql, start_s, step_s, end_s,
-                                     planner_params)
+            res = run()
             flight.result = res
             return res, False
         finally:

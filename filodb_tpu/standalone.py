@@ -11,6 +11,7 @@ filodb_tpu.parallel (multi-node assignment) on top of the same pieces.
 from __future__ import annotations
 
 import dataclasses
+import os
 from typing import Dict, List, Optional, Sequence
 
 from filodb_tpu.config import (FilodbSettings, apply_jax_runtime,
@@ -61,6 +62,7 @@ class FiloServer:
             column_store=self.column_store, meta_store=self.meta_store,
             config=self.config)
         self.mappers: Dict[str, ShardMapper] = {}
+        self.spreads: Dict[str, SpreadProvider] = {}
         self.engines: Dict[str, QueryEngine] = {}
         self.gateways: Dict[str, GatewayPipeline] = {}
         self.ds_stores: Dict[str, object] = {}
@@ -86,6 +88,35 @@ class FiloServer:
                                .batch_window_ms,
                                config=self.config)
         self.http = FiloHttpServer(self.api, http_host, http_port)
+        # Ruler — recording & alerting rules (filodb_tpu/rules): standing
+        # queries evaluated through this server's QueryFrontend whose
+        # outputs write back through the columnar ingest path of the
+        # configured dataset's shards.  Built AFTER the API so the
+        # frontends exist; evaluation loops start in start().
+        self.ruler = None
+        if self.config.rules.enabled:
+            from filodb_tpu.rules import MemstoreSink, Ruler
+            ds = self.config.rules.dataset or first
+            if ds not in self.engines:
+                from filodb_tpu.config import ConfigError
+                raise ConfigError(
+                    f"rules.dataset {ds!r} is not a served dataset "
+                    f"(have: {sorted(self.engines)})")
+            # reload() re-reads the conf file from disk when one backs
+            # the process, so /admin/rules/reload picks up edits to the
+            # inline rules.groups block too (not just rules.file)
+            conf_path = os.environ.get("FILODB_TPU_CONFIG")
+            config_source = None
+            if conf_path:
+                config_source = (lambda p=conf_path:
+                                 FilodbSettings.load(p).rules)
+            self.ruler = Ruler(
+                self.api.frontends[ds],
+                MemstoreSink(self.memstore, ds, self.mappers[ds],
+                             self.spreads[ds]),
+                config=self.config.rules,
+                config_source=config_source)
+            self.api.ruler = self.ruler
 
     # ------------------------------------------------------------- wiring
 
@@ -122,6 +153,7 @@ class FiloServer:
             label_vals, self.memstore.schemas.part.options.shard_key_columns)
         planner = ShardKeyRegexPlanner(planner, matcher)
         self.mappers[dc.name] = mapper
+        self.spreads[dc.name] = spread
         self.engines[dc.name] = QueryEngine(dc.name, self._source(), mapper,
                                             planner=planner,
                                             config=self.config)
@@ -239,8 +271,12 @@ class FiloServer:
                     self.memstore, dc.name,
                     interval_s=self.config.store.flush_interval_ms / 1000.0)
                 self.flush_schedulers[dc.name] = sched.start()
+        if self.ruler is not None:
+            self.ruler.start()
 
     def shutdown(self) -> None:
+        if self.ruler is not None:
+            self.ruler.stop()
         for sched in self.flush_schedulers.values():
             sched.stop(final_flush=True)
         self.flush_schedulers.clear()

@@ -18,8 +18,11 @@ Catalog (the production call sites):
     flush.persist     — background flush, before chunks are written to
                         the column store (core/shard.py)
     device.upload     — DeviceMirror full refresh (core/devicecache.py)
-    ingest.batch      — shard ingest entry (core/shard.py)
+    ingest.batch      — shard ingest entry (core/shard.py; also covers
+                        the ruler's recorded-series write-back)
     cluster.heartbeat — NodeAgent heartbeat RPC (parallel/cluster.py)
+    ruler.notify      — alert webhook delivery attempt
+                        (rules/notifier.py; retry/backoff chaos)
 
 Plan kinds and how they surface at the call site:
 
@@ -55,7 +58,7 @@ from typing import Dict, List, Optional
 
 POINTS = frozenset({
     "transport.send", "transport.recv", "flush.persist", "device.upload",
-    "ingest.batch", "cluster.heartbeat",
+    "ingest.batch", "cluster.heartbeat", "ruler.notify",
 })
 
 KINDS = frozenset({"error", "delay", "drop", "corrupt"})

@@ -129,12 +129,18 @@ class MetricsRegistry:
                 g = self._gauges.setdefault(key, Gauge())
         return g
 
-    def histogram(self, name: str, **tags) -> Histogram:
+    def histogram(self, name: str, bounds: Optional[Sequence[float]] = None,
+                  **tags) -> Histogram:
+        """`bounds` applies on FIRST creation of a (name, tags) series
+        only (later callers get the existing histogram unchanged) — the
+        default log2-ish bounds suit millisecond latencies; seconds-scale
+        series (e.g. the ruler's group-eval durations) pass their own."""
         key = (name, _tags_key(tags))
         h = self._hists.get(key)
         if h is None:
             with self._lock:
-                h = self._hists.setdefault(key, Histogram())
+                h = self._hists.setdefault(
+                    key, Histogram(bounds) if bounds else Histogram())
         return h
 
     def clear(self) -> None:

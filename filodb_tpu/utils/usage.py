@@ -51,6 +51,13 @@ class _Tenant:
 # registry/accountant without bound (each pair pins counters forever)
 OVERFLOW_TENANT: TenantKey = ("_overflow_", "")
 
+# synthetic workspaces of INTERNAL subsystems (the ruler bills as
+# `_rules_`): accounted like any tenant, but exempt from the scan-limit
+# gate — aggregation rules legitimately scan the whole store every
+# interval, so a fail limit sized for external tenants would starve
+# recording/alerting precisely on the heaviest (most valuable) rules
+INTERNAL_WORKSPACES = frozenset({"_rules_"})
+
 
 class UsageAccountant:
 
@@ -128,6 +135,8 @@ class UsageAccountant:
         the query that crosses the line still runs (limits bound the
         window's cumulative burn, not predict a query's cost)."""
         if not (warn_limit or fail_limit):
+            return None
+        if ws in INTERNAL_WORKSPACES:
             return None
         from filodb_tpu.utils.metrics import registry
         now = time.monotonic()

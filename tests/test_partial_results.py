@@ -386,7 +386,9 @@ def test_singleflight_follower_does_not_inherit_leader_timeout():
     with fe._sf_lock:
         fe._inflight[key] = flight
     try:
-        res, shared = fe._sf_query_range(Q, S + 600, 60, S + 3600, pp)
+        res, shared = fe._singleflight(
+            key, lambda: fe._cached_query(Q, S + 600, 60, S + 3600, pp),
+            pp)
     finally:
         with fe._sf_lock:
             fe._inflight.pop(key, None)

@@ -1,0 +1,710 @@
+"""Ruler — recording & alerting rules engine (filodb_tpu/rules;
+doc/recording_rules.md).
+
+The contracts under test:
+  * recorded series are numerically identical to hand-running the rule
+    expr as an instant query at the same timestamps, and later rules in
+    a group see earlier rules' output (sequential Prometheus semantics);
+  * the alert state machine walks inactive -> pending (`for:`) ->
+    firing -> `keep_firing_for` on a driven clock, and state survives a
+    restart by replaying `ALERTS_FOR_STATE`;
+  * an injected dead shard fails (and counts) the iteration WITHOUT
+    recording partial output or flapping a firing alert;
+  * hot reload adds/removes/modifies groups while carrying alert state
+    for unchanged rules.
+"""
+import json
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from filodb_tpu.config import FilodbSettings, RulesConfig
+from filodb_tpu.core.memstore import TimeSeriesMemStore
+from filodb_tpu.ingest.generator import counter_batch
+from filodb_tpu.query.engine import QueryEngine
+from filodb_tpu.query.frontend import QueryFrontend
+from filodb_tpu.rules import (MemstoreSink, Rule, RuleGroup, Ruler,
+                              RulesConfigError, WebhookNotifier,
+                              load_rule_groups)
+from filodb_tpu.utils.faults import faults
+from filodb_tpu.utils.metrics import registry
+
+START = 1_600_000_000_000
+S_SEC = START // 1000
+T = 120                                    # 20 min of 10s scrapes
+DATA_END_S = S_SEC + (T - 1) * 10
+
+EXPR = 'sum by (_ns_)(rate(request_total[5m]))'
+REC = "ns:request_total:rate5m"
+
+
+def _counter(name, **tags):
+    return registry.counter(name, **tags).value
+
+
+class _FlakySource:
+    """Source wrapper whose shards can be 'killed': get_shard raises a
+    ConnectionError for dead shards — the in-process analogue of a node
+    death mid-evaluation."""
+
+    def __init__(self, inner):
+        self.inner = inner
+        self.dead = set()
+
+    def get_shard(self, dataset, shard):
+        if shard in self.dead:
+            raise ConnectionError(f"injected: shard {shard} dead")
+        return self.inner.get_shard(dataset, shard)
+
+    def shards_for(self, dataset):
+        return self.inner.shards_for(dataset)
+
+
+def _fixture(S=20, flaky=False):
+    """(memstore, frontend, sink): S counter series on one shard with a
+    frontend over them."""
+    ms = TimeSeriesMemStore()
+    sh = ms.setup("d", 0)
+    base = counter_batch(S, 1, start_ms=START)
+    row_base = np.arange(S, dtype=np.float64)[:, None]
+    ts2d = np.broadcast_to(START + np.arange(T, dtype=np.int64) * 10_000,
+                           (S, T))
+    vals = np.arange(T, dtype=np.float64)[None, :] * 5.0 + row_base
+    sh.ingest_columns("prom-counter", base.part_keys, ts2d,
+                      {"count": vals})
+    source = _FlakySource(ms) if flaky else ms
+    eng = QueryEngine("d", source)
+    fe = QueryFrontend(eng)
+    return ms, fe, MemstoreSink(ms, "d"), source
+
+
+def _ruler(fe, sink, groups, **kw):
+    kw.setdefault("notifier", WebhookNotifier(sleep=lambda s: None))
+    kw.setdefault("config", RulesConfig())
+    return Ruler(fe, sink, groups=groups, **kw)
+
+
+def _vec(res):
+    assert res.error is None, res.error
+    out = {}
+    for k, _, v in res.series():
+        out[k.labels_dict.get("_ns_", "")] = float(np.asarray(v)[-1])
+    return out
+
+
+# ------------------------------------------------------------ config
+
+
+def test_config_loads_inline_and_file(tmp_path):
+    f = tmp_path / "rules.json"
+    f.write_text(json.dumps({"groups": [
+        {"name": "filegroup", "interval": "1m", "rules": [
+            {"record": "file:metric", "expr": "sum(request_total)"},
+            {"alert": "FileAlert", "expr": "sum(request_total) > 0",
+             "for": "90s", "keep_firing_for": 120,
+             "labels": {"severity": "page"},
+             "annotations": {"summary": "hot"}},
+        ]}]}))
+    cfg = RulesConfig(file=str(f), groups={
+        "inline": {"interval": 15, "rules": {
+            "r": {"record": "inline:metric", "expr": "sum(heap_usage)"}}}})
+    groups = {g.name: g for g in load_rule_groups(cfg)}
+    assert set(groups) == {"filegroup", "inline"}
+    fg = groups["filegroup"]
+    assert fg.interval_s == 60.0 and fg.source == str(f)
+    assert fg.rules[0].kind == "recording"
+    al = fg.rules[1]
+    assert (al.kind, al.for_s, al.keep_firing_for_s) == ("alerting",
+                                                         90.0, 120.0)
+    assert al.labels_dict == {"severity": "page"}
+    assert groups["inline"].interval_s == 15.0
+
+
+@pytest.mark.parametrize("raw", [
+    {"record": "bad name", "expr": "sum(x)"},        # bad metric name
+    {"record": "ok", "expr": "sum(("},               # bad PromQL
+    {"record": "ok", "expr": "sum(x)", "for": "1m"},  # for on recording
+    {"alert": "A"},                                  # missing expr
+    {"record": "ok", "alert": "A", "expr": "x"},     # both kinds
+    {"record": "ok", "expr": "x", "bogus": 1},       # unknown key
+])
+def test_config_rejects_bad_rules(raw):
+    cfg = RulesConfig(groups={"g": {"rules": {"r": raw}}})
+    with pytest.raises(RulesConfigError):
+        load_rule_groups(cfg)
+
+
+def test_config_rejects_duplicate_groups(tmp_path):
+    f = tmp_path / "rules.json"
+    f.write_text(json.dumps({"groups": [
+        {"name": "g", "rules": [{"record": "a:b", "expr": "sum(x)"}]}]}))
+    cfg = RulesConfig(file=str(f), groups={
+        "g": {"rules": {"r": {"record": "a:b", "expr": "sum(x)"}}}})
+    with pytest.raises(RulesConfigError, match="defined twice"):
+        load_rule_groups(cfg)
+
+
+def test_settings_overlay_parses_rules_block():
+    s = FilodbSettings()
+    s.overlay({"rules": {"enabled": True, "default_interval_s": 15,
+                         "groups": {"g": {"rules": {
+                             "r": {"record": "a:b", "expr": "sum(x)"}}}}}})
+    assert s.rules.enabled is True
+    groups = load_rule_groups(s.rules)
+    assert groups[0].interval_s == 15.0
+
+
+# --------------------------------------------------------- recording
+
+
+def test_recorded_identical_to_adhoc_instant_queries():
+    _, fe, sink, _ = _fixture()
+    g = RuleGroup("g", 30.0, (Rule(REC, EXPR, "recording"),))
+    ruler = _ruler(fe, sink, [g])
+    ticks = [DATA_END_S - 60, DATA_END_S - 30, DATA_END_S]
+    for ts in ticks:
+        assert ruler.evaluate_group("g", ts=ts)
+    for ts in ticks:
+        hand = _vec(fe.query_instant(EXPR, ts))
+        rec = _vec(fe.query_instant(REC, ts))
+        assert set(hand) == set(rec) and len(hand) > 0
+        for ns in hand:
+            # bit-identical: the recorded sample IS the evaluated value
+            assert rec[ns] == hand[ns], (ts, ns)
+
+
+def test_later_rules_see_earlier_rules_output():
+    """Prometheus sequential-evaluation semantics: rule 2 aggregates
+    rule 1's freshly-recorded series AT THE SAME evaluation ts."""
+    _, fe, sink, _ = _fixture()
+    g = RuleGroup("g", 30.0, (
+        Rule(REC, EXPR, "recording"),
+        Rule("total:rate5m", f"sum({REC})", "recording"),
+    ))
+    ruler = _ruler(fe, sink, [g])
+    ts = DATA_END_S
+    assert ruler.evaluate_group("g", ts=ts)
+    first = _vec(fe.query_instant(REC, ts))
+    second = fe.query_instant("total:rate5m", ts)
+    vals = [float(np.asarray(v)[-1]) for _, _, v in second.series()]
+    assert len(vals) == 1
+    np.testing.assert_allclose(vals[0], sum(first.values()), rtol=1e-6)
+
+
+def test_recording_labels_override_and_rename():
+    _, fe, sink, _ = _fixture()
+    g = RuleGroup("g", 30.0, (
+        Rule(REC, EXPR, "recording", labels=(("tier", "gold"),)),))
+    ruler = _ruler(fe, sink, [g])
+    assert ruler.evaluate_group("g", ts=DATA_END_S)
+    res = fe.query_instant(REC + '{tier="gold"}', DATA_END_S)
+    assert res.error is None and res.num_series > 0
+    for k, _, _v in res.series():
+        lab = k.labels_dict
+        assert lab["_metric_"] == REC and lab["tier"] == "gold"
+
+
+# ------------------------------------------------------ alert machine
+
+
+def test_alert_transitions_pending_firing_keep_firing():
+    _, fe, sink, _ = _fixture()
+    alert = Rule("HighRate", "sum(rate(request_total[5m])) > 0",
+                 "alerting", labels=(("severity", "page"),),
+                 annotations=(("summary", "traffic exists"),),
+                 for_s=60.0, keep_firing_for_s=120.0)
+    g = RuleGroup("g", 30.0, (alert,))
+    # resend disabled: this test asserts transitions-only delivery
+    ruler = _ruler(fe, sink, [g],
+                   config=RulesConfig(notify_resend_delay_s=0.0))
+    t1 = DATA_END_S - 120
+    # inactive -> pending
+    assert ruler.evaluate_group("g", ts=t1)
+    alerts = ruler.alerts_payload()["alerts"]
+    assert [a["state"] for a in alerts] == ["pending"]
+    assert alerts[0]["labels"] == {"alertname": "HighRate",
+                                   "severity": "page"}
+    assert ruler.notifier.snapshot() == []
+    # still pending inside `for:`
+    assert ruler.evaluate_group("g", ts=t1 + 30)
+    assert ruler.alerts_payload()["alerts"][0]["state"] == "pending"
+    # pending -> firing once `for:` elapses; ONE notification
+    assert ruler.evaluate_group("g", ts=t1 + 60)
+    fired = ruler.alerts_payload()["alerts"]
+    assert fired[0]["state"] == "firing"
+    sent = ruler.notifier.snapshot()
+    assert len(sent) == 1
+    assert sent[0]["alerts"][0]["labels"]["alertname"] == "HighRate"
+    assert sent[0]["alerts"][0]["annotations"] == {
+        "summary": "traffic exists"}
+    # ALERTS/ALERTS_FOR_STATE synthetic series are queryable
+    res = fe.query_instant('ALERTS{alertstate="firing"}', t1 + 60)
+    assert res.error is None and res.num_series == 1
+    res = fe.query_instant('ALERTS_FOR_STATE{alertname="HighRate"}',
+                           t1 + 60)
+    assert [float(np.asarray(v)[-1])
+            for _, _, v in res.series()] == [float(t1)]
+    # expr goes absent (past the data + rate window): keep_firing_for
+    # holds the firing state...
+    t_gone = DATA_END_S + 400
+    assert ruler.evaluate_group("g", ts=t_gone)
+    assert ruler.alerts_payload()["alerts"][0]["state"] == "firing"
+    # ...until it elapses -> inactive
+    assert ruler.evaluate_group("g", ts=t_gone + 121)
+    assert ruler.alerts_payload()["alerts"] == []
+    assert len(ruler.notifier.snapshot()) == 1    # no re-notify spam
+
+
+def test_pending_alert_clears_without_firing():
+    _, fe, sink, _ = _fixture()
+    g = RuleGroup("g", 30.0, (
+        Rule("A", "sum(rate(request_total[5m])) > 0", "alerting",
+             for_s=600.0),))
+    ruler = _ruler(fe, sink, [g])
+    assert ruler.evaluate_group("g", ts=DATA_END_S)
+    assert ruler.alerts_payload()["alerts"][0]["state"] == "pending"
+    assert ruler.evaluate_group("g", ts=DATA_END_S + 400)  # expr absent
+    assert ruler.alerts_payload()["alerts"] == []
+    assert ruler.notifier.snapshot() == []
+
+
+def test_alert_state_restored_after_restart():
+    """`for:` clocks survive restart: a new Ruler over the same store
+    replays ALERTS_FOR_STATE and fires WITHOUT resetting the pending
+    window."""
+    _, fe, sink, _ = _fixture()
+    mk = lambda: RuleGroup("g", 30.0, (
+        Rule("Slow", "sum(rate(request_total[5m])) > 0", "alerting",
+             for_s=240.0),))
+    t1 = DATA_END_S - 240
+    r1 = _ruler(fe, sink, [mk()])
+    assert r1.evaluate_group("g", ts=t1)
+    assert r1.alerts_payload()["alerts"][0]["state"] == "pending"
+    # "restart": fresh Ruler, no in-memory state
+    r2 = _ruler(fe, sink, [mk()])
+    assert r2.evaluate_group("g", ts=t1 + 240)
+    alerts = r2.alerts_payload()["alerts"]
+    assert [a["state"] for a in alerts] == ["firing"]
+    # activeAt is the ORIGINAL activation, not the restart time
+    from filodb_tpu.rules.ruler import _iso
+    assert alerts[0]["activeAt"] == _iso(float(t1))
+    assert len(r2.notifier.snapshot()) == 1
+
+
+# ------------------------------------------------------ failure domain
+
+
+def test_dead_shard_fails_iteration_without_partials_or_flapping():
+    ms, fe, sink, source = _fixture(flaky=True)
+    g = RuleGroup("g", 30.0, (
+        Rule(REC, EXPR, "recording"),
+        Rule("Any", "sum(rate(request_total[5m])) > 0", "alerting"),))
+    ruler = _ruler(fe, sink, [g])
+    t1 = DATA_END_S - 60
+    assert ruler.evaluate_group("g", ts=t1)
+    assert ruler.alerts_payload()["alerts"][0]["state"] == "firing"
+    sh = ms.get_shard("d", 0)
+    rows_before = sh.stats.rows_ingested
+    fails0 = _counter("rule_evaluation_failures", group="g")
+    # kill the shard mid-evaluation-cycle
+    source.dead.add(0)
+    assert ruler.evaluate_group("g", ts=t1 + 30) is False
+    assert _counter("rule_evaluation_failures", group="g") - fails0 == 2
+    # nothing recorded from the failed iteration (no partial write-back)
+    assert sh.stats.rows_ingested == rows_before
+    # the firing alert did NOT flap: state + activeAt held, no resolve,
+    # no duplicate notification
+    alerts = ruler.alerts_payload()["alerts"]
+    assert [a["state"] for a in alerts] == ["firing"]
+    assert len(ruler.notifier.snapshot()) == 1
+    # per-rule health surfaces the error
+    payload = ruler.rules_payload()["groups"][0]
+    assert all(r["health"] == "err" and r["lastError"]
+               for r in payload["rules"])
+    # shard comes back: evaluation resumes cleanly
+    source.dead.discard(0)
+    assert ruler.evaluate_group("g", ts=t1 + 60)
+    assert sh.stats.rows_ingested > rows_before
+    assert all(r["health"] == "ok"
+               for r in ruler.rules_payload()["groups"][0]["rules"])
+
+
+def test_write_back_fault_fails_iteration():
+    """ingest.batch chaos (utils/faults): the write-back raising fails
+    the iteration BEFORE any sample lands."""
+    ms, fe, sink, _ = _fixture()
+    g = RuleGroup("g", 30.0, (Rule(REC, EXPR, "recording"),))
+    ruler = _ruler(fe, sink, [g])
+    sh = ms.get_shard("d", 0)
+    rows_before = sh.stats.rows_ingested
+    with faults.plan("ingest.batch", "error", first_k=1):
+        assert ruler.evaluate_group("g", ts=DATA_END_S) is False
+    assert sh.stats.rows_ingested == rows_before
+
+
+def test_notifier_retry_backoff_and_drop():
+    sleeps = []
+    n = WebhookNotifier(retries=3, backoff_s=0.5, sleep=sleeps.append)
+    with faults.plan("ruler.notify", "error", first_k=2):
+        assert n.notify([{"labels": {"alertname": "A"}}]) is True
+    assert sleeps == [0.5, 1.0]            # exponential backoff
+    assert len(n.snapshot()) == 1
+    dropped0 = _counter("rule_notifications_dropped")
+    with faults.plan("ruler.notify", "error", first_k=99):
+        assert n.notify([{"labels": {"alertname": "A"}}]) is False
+    assert _counter("rule_notifications_dropped") - dropped0 == 1
+
+
+# --------------------------------------------------------- hot reload
+
+
+def test_hot_reload_add_remove_modify_preserves_state():
+    _, fe, sink, _ = _fixture()
+    alert = Rule("Any", "sum(rate(request_total[5m])) > 0", "alerting",
+                 for_s=0.0)
+    ga = RuleGroup("a", 30.0, (alert, Rule(REC, EXPR, "recording")))
+    gb = RuleGroup("b", 30.0, (Rule("b:m", "sum(heap_usage)",
+                                    "recording"),))
+    ruler = _ruler(fe, sink, [ga, gb])
+    t1 = DATA_END_S
+    assert ruler.evaluate_group("a", ts=t1)
+    active_at = ruler.alerts_payload()["alerts"][0]["activeAt"]
+    # modify a: new recording rule rides along, alert rule unchanged;
+    # drop b; add c
+    ga2 = RuleGroup("a", 30.0, (alert, Rule(REC, EXPR, "recording"),
+                                Rule("extra:m", "sum(request_total)",
+                                     "recording")))
+    gc = RuleGroup("c", 60.0, (Rule("c:m", "sum(request_total)",
+                                    "recording"),))
+    summary = ruler.reload([ga2, gc])
+    assert summary == {"groups": 2, "added": ["c"], "removed": ["b"],
+                       "changed": ["a"]}
+    assert ruler.group_names() == ["a", "c"]
+    # the unchanged alert rule kept its instance (activeAt preserved)
+    alerts = ruler.alerts_payload()["alerts"]
+    assert [a["activeAt"] for a in alerts] == [active_at]
+    assert ruler.evaluate_group("c", ts=t1 + 30)
+    with pytest.raises(KeyError):
+        ruler.evaluate_group("b", ts=t1 + 30)
+    # invalid reload leaves running state untouched
+    with pytest.raises(RulesConfigError):
+        ruler.reload([gc, gc])
+    assert ruler.group_names() == ["a", "c"]
+
+
+def test_reload_rereads_config_source():
+    """An argless reload() pulls a FRESH config through config_source
+    (standalone wires one that re-reads the conf file from disk), so
+    edits to the inline rules.groups block land without a restart."""
+    _, fe, sink, _ = _fixture()
+    cfgs = [RulesConfig(groups={"g1": {"interval": 30, "rules": {
+                "r": {"record": REC, "expr": EXPR}}}}),
+            RulesConfig(groups={"g2": {"interval": 60, "rules": {
+                "r": {"record": "other:m", "expr": "sum(heap_usage)"}}}})]
+    ruler = _ruler(fe, sink, None, config_source=lambda: cfgs.pop(0))
+    summary = ruler.reload()
+    assert summary["added"] == ["g1"]
+    summary = ruler.reload()
+    assert summary == {"groups": 1, "added": ["g2"], "removed": ["g1"],
+                       "changed": []}
+    # a config_source that blows up (bad conf file) is a RulesConfigError
+    # (-> HTTP 400) and the running groups stay live
+    ruler.config_source = lambda: (_ for _ in ()).throw(OSError("gone"))
+    with pytest.raises(RulesConfigError):
+        ruler.reload()
+    assert ruler.group_names() == ["g2"]
+
+
+# ---------------------------------------------------------- scheduler
+
+
+def test_scheduler_evaluates_on_interval():
+    import time as _time
+    _, fe, sink, _ = _fixture(S=4)
+    g = RuleGroup("sched", 0.2, (Rule(REC, EXPR, "recording"),))
+    # clock pinned inside the data window so the expr yields output
+    offset = DATA_END_S - _time.time()
+    ruler = _ruler(fe, sink, [g], clock=lambda: _time.time() + offset)
+    ruler.start()
+    try:
+        deadline = _time.time() + 10.0
+        while _time.time() < deadline:
+            gs = ruler.rules_payload()["groups"][0]
+            if gs["rules"][0]["health"] == "ok":
+                break
+            _time.sleep(0.05)
+        assert ruler.rules_payload()["groups"][0]["rules"][0][
+            "health"] == "ok", "scheduler never evaluated the group"
+    finally:
+        ruler.stop()
+
+
+def test_stagger_is_deterministic_per_group():
+    from filodb_tpu.utils.hashing import xxhash32
+    s1 = (xxhash32(b"group-one") % 30_000) / 1000.0
+    s2 = (xxhash32(b"group-one") % 30_000) / 1000.0
+    s3 = (xxhash32(b"group-two") % 30_000) / 1000.0
+    assert s1 == s2
+    assert 0.0 <= s1 < 30.0 and s1 != s3
+
+
+# ----------------------------------------------------------- HTTP API
+
+
+@pytest.fixture()
+def server():
+    from filodb_tpu.standalone import DatasetConfig, FiloServer
+    cfg = FilodbSettings()
+    cfg.rules.enabled = True
+    cfg.rules.groups = {
+        "agg": {"interval": "30s", "rules": {
+            "r1": {"record": REC, "expr": EXPR},
+            "a1": {"alert": "AnyTraffic",
+                   "expr": "sum(rate(request_total[5m])) > 0",
+                   "labels": {"severity": "page"}},
+        }}}
+    srv = FiloServer([DatasetConfig("prometheus", num_shards=1)],
+                     config=cfg, http_port=0)
+    sh = srv.memstore.get_shard("prometheus", 0)
+    sh.ingest(counter_batch(6, T, start_ms=START))
+    srv.start(background_flush=False)
+    yield srv
+    srv.shutdown()
+
+
+def _get(srv, path, method="GET", **params):
+    import urllib.parse
+    url = f"http://127.0.0.1:{srv.http.port}{path}"
+    if params:
+        url += "?" + urllib.parse.urlencode(params)
+    req = urllib.request.Request(
+        url, data=(b"" if method == "POST" else None), method=method)
+    with urllib.request.urlopen(req, timeout=30) as r:
+        return r.status, json.loads(r.read())
+
+
+def test_http_rules_and_alerts_payload_shape(server):
+    server.ruler.evaluate_group("agg", ts=DATA_END_S)
+    st, payload = _get(server, "/api/v1/rules")
+    assert st == 200 and payload["status"] == "success"
+    groups = payload["data"]["groups"]
+    assert len(groups) == 1 and groups[0]["name"] == "agg"
+    by_type = {r["type"]: r for r in groups[0]["rules"]}
+    rec = by_type["recording"]
+    assert rec["name"] == REC and rec["health"] == "ok"
+    assert rec["lastEvaluation"].endswith("Z")
+    assert rec["evaluationTime"] >= 0
+    al = by_type["alerting"]
+    assert al["state"] == "firing" and al["duration"] == 0.0
+    assert al["alerts"][0]["labels"]["severity"] == "page"
+    # ?type= filter (the Prometheus param)
+    st, only_rec = _get(server, "/api/v1/rules", type="record")
+    kinds = {r["type"] for g in only_rec["data"]["groups"]
+             for r in g["rules"]}
+    assert kinds == {"recording"}
+    st, alerts = _get(server, "/api/v1/alerts")
+    assert st == 200
+    assert [a["state"] for a in alerts["data"]["alerts"]] == ["firing"]
+
+
+def test_http_rules_reload(server):
+    st, payload = _get(server, "/admin/rules/reload", method="POST")
+    assert st == 200 and payload["data"]["groups"] == 1
+    # recorded series from before the reload still serve
+    server.ruler.evaluate_group("agg", ts=DATA_END_S)
+    st, q = _get(server, "/api/v1/query", query=REC, time=DATA_END_S)
+    assert st == 200 and len(q["data"]["result"]) > 0
+
+
+def test_http_status_endpoints(server):
+    from filodb_tpu import __version__
+    st, b = _get(server, "/api/v1/status/buildinfo")
+    assert st == 200 and b["data"]["version"] == __version__
+    st, r = _get(server, "/api/v1/status/runtimeinfo")
+    assert st == 200
+    data = r["data"]
+    assert data["startTime"].endswith("Z")
+    assert data["timeSeriesCount"] >= 6
+    assert data["reloadConfigSuccess"] is True
+    assert data["storageRetention"].endswith("s")
+
+
+def test_http_instant_query_goes_through_frontend(server):
+    """Satellite: /api/v1/query rides the QueryFrontend — tenant usage
+    accounting (and therefore admission/limits) now sees instant
+    queries, which the old direct-engine call bypassed."""
+    from filodb_tpu.utils.usage import usage
+    usage.clear()
+    st, _p = _get(server, "/api/v1/query",
+                  query='request_total{_ws_="demo"}', time=DATA_END_S)
+    assert st == 200
+    st, u = _get(server, "/api/v1/usage")
+    tenants = {(t["ws"], t["ns"]) for t in u["data"]}
+    assert ("demo", "") in tenants
+    # and the ruler's evaluations bill to the `_rules_` bucket
+    server.ruler.evaluate_group("agg", ts=DATA_END_S)
+    st, u = _get(server, "/api/v1/usage")
+    tenants = {(t["ws"], t["ns"]) for t in u["data"]}
+    assert ("_rules_", "agg") in tenants
+
+
+# ---------------------------------------------------- review-pass fixes
+
+
+def test_fractional_tick_records_at_eval_timestamp():
+    """Production ticks carry a sub-second stagger: evaluation and
+    write-back must land on the SAME whole-second timestamp or a
+    second-order rule in the group queries 'before' the sample its
+    predecessor just recorded."""
+    _, fe, sink, _ = _fixture()
+    g = RuleGroup("g", 30.0, (
+        Rule(REC, EXPR, "recording"),
+        Rule("total:sum", f"sum({REC})", "recording"),))
+    ruler = _ruler(fe, sink, [g])
+    assert ruler.evaluate_group("g", ts=DATA_END_S + 0.345)
+    # the second-order rule saw the first rule's output in THIS iteration
+    res = fe.query_instant("total:sum", DATA_END_S)
+    assert res.error is None and res.num_series == 1
+    ts_ms = [int(np.asarray(w)[-1]) for _, w, _ in res.series()]
+    assert ts_ms == [DATA_END_S * 1000]
+
+
+def test_alert_state_holds_when_synthetic_write_back_fails():
+    """A failed ALERTS/ALERTS_FOR_STATE write fails the iteration BEFORE
+    the new alert map publishes: no transition the store never saw."""
+    _, fe, sink, _ = _fixture()
+    g = RuleGroup("g", 30.0, (
+        Rule("Any", "sum(rate(request_total[5m])) > 0", "alerting",
+             for_s=0.0),))
+    ruler = _ruler(fe, sink, [g])
+    with faults.plan("ingest.batch", "error", first_k=1):
+        assert ruler.evaluate_group("g", ts=DATA_END_S) is False
+    assert ruler.alerts_payload()["alerts"] == []   # no phantom firing
+    # clean retry transitions normally
+    assert ruler.evaluate_group("g", ts=DATA_END_S + 30)
+    assert ruler.alerts_payload()["alerts"][0]["state"] == "firing"
+
+
+def test_notifier_batch_is_webhook_shaped():
+    """Delivered batches use the Alertmanager v4 *webhook* alert shape
+    (status/startsAt/endsAt), not the /api/v1/alerts API shape."""
+    _, fe, sink, _ = _fixture()
+    g = RuleGroup("g", 30.0, (
+        Rule("Any", "sum(rate(request_total[5m])) > 0", "alerting",
+             for_s=0.0),))
+    ruler = _ruler(fe, sink, [g])
+    assert ruler.evaluate_group("g", ts=DATA_END_S)
+    (sent,) = ruler.notifier.snapshot()
+    assert sent["version"] == "4" and sent["status"] == "firing"
+    (alert,) = sent["alerts"]
+    assert alert["status"] == "firing"
+    assert alert["startsAt"].endswith("Z") and alert["endsAt"] == ""
+    assert alert["labels"]["alertname"] == "Any"
+    assert "state" not in alert and "activeAt" not in alert
+
+
+def test_argless_reload_refused_without_config_source():
+    """Ruler(groups=[...]) with a bare RulesConfig: an argless reload()
+    must refuse (RulesConfigError -> HTTP 400) instead of loading an
+    empty config and silently retiring every running group."""
+    _, fe, sink, _ = _fixture()
+    g = RuleGroup("g", 30.0, (Rule(REC, EXPR, "recording"),))
+    ruler = _ruler(fe, sink, [g])
+    with pytest.raises(RulesConfigError, match="no reloadable"):
+        ruler.reload()
+    assert ruler.group_names() == ["g"]             # untouched
+    assert ruler.evaluate_group("g", ts=DATA_END_S)
+
+
+def test_group_deadline_not_capped_by_default_timeout():
+    """A group interval above query.default_timeout_s still gets its
+    full slot: the ruler stamps an absolute deadline (uncapped by
+    compute_deadline's min() rule) instead of passing timeout_s."""
+    from filodb_tpu.query.rangevector import compute_deadline
+    _, fe, sink, _ = _fixture()
+    g = RuleGroup("g", 300.0, (Rule(REC, EXPR, "recording"),))
+    ruler = _ruler(fe, sink, [g])
+    t0 = time.time()
+    pp = ruler._planner_params(g)
+    assert pp.deadline_unix_s >= t0 + 299.0
+    # compute_deadline honors the stamp uncapped (default cap is 120 s)
+    assert compute_deadline(pp, 120.0) == pp.deadline_unix_s
+    assert ruler.evaluate_group("g", ts=DATA_END_S)
+
+
+def test_resolved_alert_not_resurrected_by_restart():
+    """A resolved episode writes NaN staleness markers: a restart inside
+    the stale-lookback window must NOT replay the old activeAt (which
+    would skip the `for:` hold and fire immediately)."""
+    ms, fe, sink, _ = _fixture()
+    mk = lambda: RuleGroup("g", 30.0, (
+        Rule("Any", "sum(rate(request_total[5m])) > 0", "alerting",
+             for_s=120.0),))
+    r1 = _ruler(fe, sink, [mk()])
+    t1 = DATA_END_S - 120
+    assert r1.evaluate_group("g", ts=t1)                  # pending
+    assert r1.evaluate_group("g", ts=DATA_END_S)          # firing
+    assert r1.evaluate_group("g", ts=DATA_END_S + 250)    # still firing
+    assert [a["state"] for a in r1.alerts_payload()["alerts"]] \
+        == ["firing"]
+    # expr absent past data + rate window: resolves, markers written
+    assert r1.evaluate_group("g", ts=DATA_END_S + 310)
+    assert r1.alerts_payload()["alerts"] == []
+    # traffic returns in a SECOND data window
+    sh = ms.get_shard("d", 0)
+    base = counter_batch(20, 1, start_ms=START)
+    row_base = np.arange(20, dtype=np.float64)[:, None]
+    ts2 = np.broadcast_to(
+        (DATA_END_S + 320) * 1000
+        + np.arange(30, dtype=np.int64) * 10_000, (20, 30))
+    vals2 = np.arange(30, dtype=np.float64)[None, :] * 7.0 + row_base
+    sh.ingest_columns("prom-counter", base.part_keys, ts2,
+                      {"count": vals2})
+    # restart INSIDE the lookback of the resolved episode's last real
+    # ALERTS_FOR_STATE sample (DATA_END+250): the NaN marker at +310
+    # must hide it, so this is a FRESH pending episode, not instant fire
+    r2 = _ruler(fe, sink, [mk()])
+    t_restart = DATA_END_S + 450
+    assert r2.evaluate_group("g", ts=t_restart)
+    alerts = r2.alerts_payload()["alerts"]
+    assert [a["state"] for a in alerts] == ["pending"]
+    from filodb_tpu.rules.ruler import _iso
+    assert alerts[0]["activeAt"] == _iso(float(t_restart))
+
+
+def test_reload_rebuilds_owned_notifier():
+    """notify_* edits land on /admin/rules/reload when the ruler built
+    its own notifier from config; injected notifiers are untouched."""
+    _, fe, sink, _ = _fixture()
+    grp = {"g": {"interval": 30, "rules": {
+        "r": {"record": REC, "expr": EXPR}}}}
+    owned = Ruler(fe, sink, config=RulesConfig(groups=grp))
+    assert owned.notifier.url == ""
+    owned.config_source = lambda: RulesConfig(
+        groups=grp, notify_url="http://am.example/webhook",
+        notify_retries=1)
+    owned.reload()
+    assert owned.notifier.url == "http://am.example/webhook"
+    assert owned.notifier.retries == 1
+    injected = WebhookNotifier(sleep=lambda s: None)
+    ruler = Ruler(fe, sink, config=RulesConfig(groups=grp),
+                  notifier=injected)
+    ruler.config_source = lambda: RulesConfig(
+        groups=grp, notify_url="http://other/")
+    ruler.reload()
+    assert ruler.notifier is injected
+
+
+def test_rules_tenant_exempt_from_scan_limits():
+    """query.tenant_samples_*_limit must not starve the ruler: the
+    `_rules_` workspace is accounted but exempt from the admit gate
+    (aggregation rules legitimately scan the whole store every tick)."""
+    from filodb_tpu.utils.usage import usage
+    usage.clear()
+    usage.record_query("_rules_", "g", 0.1, 10_000, 0)
+    usage.record_query("heavy", "", 0.1, 10_000, 0)
+    assert usage.admit("_rules_", "g", 10, 100) is None
+    err = usage.admit("heavy", "", 10, 100)
+    assert err is not None and "tenant_limit_exceeded" in err
