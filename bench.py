@@ -944,6 +944,205 @@ def measure_ruler(quick=False, series=None):
     return st
 
 
+def _multichip_block(START, t0, n, r0, r1):
+    """One [r1-r0, n] (timestamps, values) block of the multichip
+    stage's monotone counter workload starting at scrape index t0 —
+    the SINGLE home of the value formula, shared by the store builder
+    and the acceptance probe's tail ingest (a divergent tail would
+    introduce counter resets and invalidate the pack-memo check)."""
+    import numpy as np
+    ts2d = np.broadcast_to(
+        START + (t0 + np.arange(n, dtype=np.int64)) * 10_000, (r1 - r0, n))
+    vals = (t0 + np.arange(n, dtype=np.float64))[None, :] * 5.0 \
+        + np.arange(r0, r1, dtype=np.float64)[:, None]
+    return ts2d, vals
+
+
+def _multichip_store(dataset, total_series, T, n_shard):
+    """n_shard-sharded memstore of monotone counter series — the
+    multichip stage's workload, split contiguously across shards so the
+    mesh's 'shard' axis maps 1:1 onto memstore shards."""
+    from filodb_tpu.core.memstore import TimeSeriesMemStore
+    from filodb_tpu.ingest.generator import counter_batch
+
+    START = 1_600_000_000_000
+    ms = TimeSeriesMemStore()
+    base = counter_batch(total_series, 1, start_ms=START)
+    per = total_series // n_shard
+    for s in range(n_shard):
+        sh = ms.setup(dataset, s)
+        r0 = s * per
+        r1 = total_series if s == n_shard - 1 else r0 + per
+        keys = base.part_keys[r0:r1]
+        for t0 in range(0, T, 40):
+            n = min(40, T - t0)
+            ts2d, vals = _multichip_block(START, t0, n, r0, r1)
+            sh.ingest_columns("prom-counter", keys, ts2d, {"count": vals},
+                              offset=t0)
+    return ms, START
+
+
+def measure_multichip(quick=False, series=None, iters=0):
+    """Multi-chip fused scan stage (ISSUE 6 / ROADMAP item 2): the
+    flagship `sum by (rate())` aggregate over an n-device
+    ('shard' x 'time') mesh through MeshExecutor.run_agg, which routes
+    fused-eligible aggregates through PER-DEVICE dispatch of the
+    single-chip kernel + partial-only merges (parallel/mesh.py) — never
+    the fused-in-shard_map composition that inverted the single-chip win
+    ~30x (MULTICHIP_r05.json: warm 25.3 s vs 0.88 s general).
+
+    Emits (one-line JSON keys):
+      multichip_fused_warm_s   — warm p50 of the per-device fused route
+      multichip_general_warm_s — warm p50 of the general mesh path over
+                                 the SAME pack (the shard_map XLA path)
+      multichip_scaling_x      — single-device warm p50 / mesh warm p50
+                                 for the same total workload
+    Gate: fused warm <= general warm (the inversion is dead), checked in
+    `multichip_inversion_gone`.
+
+    A box that claims TPU but exposes < 2 local devices FAILS this stage
+    (raises — recorded as a loud stage error, never a silent skip): a
+    single-chip tunnel must not masquerade as a scaling measurement.
+    Host platforms need XLA_FLAGS=--xla_force_host_platform_device_count
+    (the `bench.py multichip` standalone entry sets it before jax init).
+    """
+    import jax
+
+    from filodb_tpu.core.index import Equals
+    from filodb_tpu.ops import agg as agg_ops
+    from filodb_tpu.ops.timewindow import make_window_ends
+    from filodb_tpu.parallel.mesh import (MeshExecutor, make_mesh,
+                                          distributed_window_agg)
+    from filodb_tpu.utils.metrics import registry
+
+    n_dev = jax.local_device_count()
+    platform = jax.default_backend()
+    if n_dev < 2:
+        raise RuntimeError(
+            f"multichip stage needs >= 2 local devices, have {n_dev} on "
+            f"backend {platform!r}"
+            + ("" if platform == "tpu" else " — run `python bench.py "
+               "multichip` (forces 8 virtual host devices) or set "
+               "XLA_FLAGS=--xla_force_host_platform_device_count=8"))
+    n_time = 2 if n_dev % 2 == 0 and n_dev >= 4 else 1
+    n_shard = n_dev // n_time
+    total = series or (8_192 if quick else 262_144)
+    total -= total % n_shard
+    T = 120                              # 20 min of 10s scrapes
+    iters = iters or (3 if quick else 5)
+    st = {"devices": n_dev, "mesh": f"{n_shard}x{n_time}",
+          "series": total, "samples_per_series": T}
+
+    ms, START = _multichip_store("bench_multichip", total, T, n_shard)
+    mesh = make_mesh(n_shard, n_time, devices=jax.devices()[:n_dev])
+    ex = MeshExecutor(ms, "bench_multichip", mesh)
+    filters = [Equals("_metric_", "request_total")]
+    end_ms = START + (T - 1) * 10_000
+    wends = make_window_ends(START + 600_000, end_ms, 60_000)
+    range_ms = 300_000
+    span = total * (T - 60)              # samples inside the queried span
+
+    def p50(fn, n=iters):
+        ts = []
+        for _ in range(n):
+            t0 = time.perf_counter()
+            fn()
+            ts.append(time.perf_counter() - t0)
+        ts.sort()
+        return ts[len(ts) // 2]
+
+    packed = ex.lookup_and_pack(filters, START, end_ms, by=("_ns_",),
+                                fn_name="rate")
+    k0 = registry.counter("mesh_fused_kernel").value
+    h0 = registry.counter("mesh_fused_host").value
+
+    def fused_once():
+        out, _ = ex.run_agg(packed, wends, range_ms=range_ms,
+                            fn_name="rate", agg_op="sum")
+        return out
+
+    t0 = time.perf_counter()
+    fused_res = fused_once()             # compile + warm every cache
+    st["fused_cold_s"] = round(time.perf_counter() - t0, 4)
+    took_kernel = registry.counter("mesh_fused_kernel").value > k0
+    took_host = registry.counter("mesh_fused_host").value > h0
+    st["multichip_fused_route"] = ("kernel" if took_kernel
+                                   else "host" if took_host
+                                   else "general(fallback)")
+    fused_warm = p50(fused_once)
+    st["multichip_fused_warm_s"] = round(fused_warm, 5)
+    st["multichip_samples_per_sec"] = round(span / fused_warm, 1)
+    st["multichip_perdevice_dispatches"] = \
+        registry.counter("mesh_fused_perdevice_dispatches").value
+
+    # general mesh path (shard_map XLA kernels) over the SAME pack — the
+    # 0.88 s side of the MULTICHIP_r05 inversion
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    wends_p, W = ex._prep_wends(packed, wends)
+    wends_dev = jax.device_put(wends_p, NamedSharding(mesh, P("time")))
+
+    def general_once():
+        partials = distributed_window_agg(
+            mesh, packed.ts_off, packed.values, packed.group_ids,
+            wends_dev, range_ms=range_ms, fn_name="rate", agg_op="sum",
+            num_groups=packed.num_groups, base_ms=packed.base_ms,
+            vbase=packed.vbase, precorrected=packed.precorrected,
+            dense=packed.dense)
+        return np.asarray(agg_ops.present("sum", partials))[:, :W]
+
+    t0 = time.perf_counter()
+    general_res = general_once()
+    st["general_cold_s"] = round(time.perf_counter() - t0, 4)
+    general_warm = p50(general_once)
+    st["multichip_general_warm_s"] = round(general_warm, 5)
+    # the gate needs dispatch EVIDENCE, not just timing: a silent
+    # fallback to the general path makes fused ~= general and would
+    # pass a coin-flip comparison with zero per-device work measured
+    st["multichip_inversion_gone"] = bool(
+        (took_kernel or took_host) and fused_warm <= general_warm)
+    err = float(np.nanmax(np.abs(np.asarray(fused_res, np.float64)
+                                 - general_res)
+                          / np.maximum(np.abs(general_res), 1e-9)))
+    st["max_rel_err_vs_general"] = round(err, 9)
+
+    # scaling: same total workload on ONE device (1x1 mesh, 1-shard
+    # store) — the denominator every later device should shrink
+    ms1, _ = _multichip_store("bench_multichip1", total, T, 1)
+    mesh1 = make_mesh(1, 1, devices=jax.devices()[:1])
+    ex1 = MeshExecutor(ms1, "bench_multichip1", mesh1)
+    packed1 = ex1.lookup_and_pack(filters, START, end_ms, by=("_ns_",),
+                                  fn_name="rate")
+
+    def single_once():
+        out, _ = ex1.run_agg(packed1, wends, range_ms=range_ms,
+                             fn_name="rate", agg_op="sum")
+        return out
+
+    single_once()                        # compile
+    single_warm = p50(single_once)
+    st["multichip_single_device_warm_s"] = round(single_warm, 5)
+    st["multichip_scaling_x"] = round(single_warm / fused_warm, 3)
+
+    # ISSUE-6 acceptance: a re-poll after value-only ingest must hit the
+    # packing-layout memo (repack out of the warm-query profile)
+    m0 = registry.counter("mesh_pack_memo_hits").value
+    from filodb_tpu.ingest.generator import counter_batch as _cb
+    tail = _cb(total, 1, start_ms=START)
+    per = total // n_shard
+    for s in range(n_shard):
+        r0 = s * per
+        r1 = total if s == n_shard - 1 else r0 + per
+        ts2d, vals = _multichip_block(START, T, 1, r0, r1)
+        ms.get_shard("bench_multichip", s).ingest_columns(
+            "prom-counter", tail.part_keys[r0:r1], ts2d, {"count": vals},
+            offset=T)
+    ex.lookup_and_pack(filters, START, end_ms + 10_000, by=("_ns_",),
+                       fn_name="rate")
+    st["multichip_pack_memo_hits"] = \
+        registry.counter("mesh_pack_memo_hits").value - m0
+    return st
+
+
 def run_chaos(quick=False, series=None):
     """Failure-domain chaos stage (PR 4 acceptance): two real data-node
     processes serve one dataset over the cross-node transport while this
@@ -1173,10 +1372,14 @@ def host_baselines(ts_row, vals, gids, wends, range_ms, span):
 def parse_args(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("stage", nargs="?", default="",
-                    choices=["", "chaos"],
+                    choices=["", "chaos", "multichip"],
                     help="optional standalone stage: 'chaos' runs the "
                          "failure-domain chaos harness (SIGKILL a data "
-                         "node mid-traffic) and writes SOAK_CHAOS.json")
+                         "node mid-traffic) and writes SOAK_CHAOS.json; "
+                         "'multichip' runs the multi-device fused-scan "
+                         "stage in-process (8 virtual devices on host "
+                         "platforms) and exits nonzero if the fused "
+                         "path loses to the general path")
     ap.add_argument("--quick", action="store_true",
                     help="small config for smoke runs")
     ap.add_argument("--series", type=int, default=0)
@@ -1269,6 +1472,20 @@ def assemble_result(platform, stages, vec_sps, it_sps, c_sps=0.0,
             # from the recorded series vs the raw expression (gate:
             # >= 10x), and the standing-query tax on serving QPS
             result[k] = rul[k]
+    mc = stages.get("multichip", {})
+    for k in ("multichip_fused_warm_s", "multichip_general_warm_s",
+              "multichip_scaling_x", "multichip_inversion_gone",
+              "multichip_fused_route", "multichip_pack_memo_hits"):
+        if k in mc:
+            # ISSUE-6 acceptance: per-device fused dispatch vs the
+            # general mesh path (gate: fused <= general — the
+            # MULTICHIP_r05 30x inversion is dead) + mesh scaling vs one
+            # device and the repack-memo hit evidence
+            result[k] = mc[k]
+    if "error" in mc:
+        # the loud-fail contract: a TPU box without >= 2 devices (or any
+        # multichip failure) rides into the parsed line, never vanishes
+        result["multichip_error"] = mc["error"]
     ns = stages.get("north_star_1m") or stages.get("cpu_north_star_1m")
     if ns and "samples_per_sec" in ns:
         result.update({
@@ -1416,6 +1633,23 @@ def run_worker(args):
     except Exception as e:  # noqa: BLE001 — must not sink the run
         writer.stage("ruler", {"error": f"{type(e).__name__}: {e}"[:300]})
 
+    try:
+        # measure_fused_coverage leaves FILODB_TPU_FUSED_INTERPRET=1
+        # behind for the dashboard stage's interpret-mode CPU kernel
+        # runs; inheriting it here would reroute the per-device unit
+        # from the host fused leaf into interpret-mode Pallas at full
+        # scale — orders of magnitude slower, and a route production
+        # never takes.  Nothing after this stage reads the var.
+        os.environ.pop("FILODB_TPU_FUSED_INTERPRET", None)
+        mc = measure_multichip(quick=quick)
+        writer.stage("multichip", mc)
+        stages["multichip"] = mc
+    except Exception as e:  # noqa: BLE001 — a 1-device box records a
+        # LOUD error here (never a skip): a TPU claim without >= 2
+        # devices must surface in the one-line JSON (ISSUE 6 satellite)
+        stages["multichip"] = {"error": f"{type(e).__name__}: {e}"[:300]}
+        writer.stage("multichip", stages["multichip"])
+
     result = assemble_result(platform, stages, vec_sps, it_sps,
                              c_sps)
     result["jax_platform"] = raw_platform
@@ -1496,6 +1730,29 @@ def _probe_default_backend(timeout_s):
 
 def main():
     args = parse_args()
+    if args.stage == "multichip":
+        # standalone multi-chip stage: runs IN THIS process.  Host
+        # platforms get 8 virtual devices — XLA_FLAGS must land before
+        # the first backend init (jax may already be imported by the
+        # sitecustomize hook; backends initialize lazily, so the env var
+        # still takes).  A TPU backend ignores the host-platform flag.
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8").strip()
+        try:
+            mc = measure_multichip(quick=args.quick,
+                                   series=args.series or None,
+                                   iters=args.iters)
+        except Exception as e:  # noqa: BLE001 — loud one-line fail
+            print(json.dumps({
+                "metric": "multichip_fused_warm_s", "unit": "s",
+                "error": f"{type(e).__name__}: {e}"[:300]}))
+            sys.exit(1)
+        mc = {"metric": "multichip_fused_warm_s", "unit": "s",
+              "value": mc.get("multichip_fused_warm_s"), **mc}
+        print(json.dumps(mc))
+        sys.exit(0 if mc.get("multichip_inversion_gone") else 1)
     if args.stage == "chaos":
         # standalone failure-domain stage: runs IN THIS process (CPU-
         # pinned; chaos measures degradation machinery, not kernels),

@@ -207,6 +207,13 @@ class StoreConfig:
     # so repeat queries skip the host->device transfer (devicecache.py)
     device_mirror_enabled: bool = True
     device_mirror_hbm_limit: int = 8 << 30
+    # sharded mirror mode (multi-chip boxes): place each shard's mirror
+    # on its own device via core/devicecache.MirrorPlacer (HBM-aware
+    # against device_mirror_hbm_limit), so the per-device fused dispatch
+    # runs every shard's kernel on the chip that holds its columns.
+    # Engages only with >= 2 local devices on a TPU backend (or under
+    # FILODB_TPU_FORCE_SHARDED_MIRROR=1 for host-platform tests).
+    device_mirror_sharded: bool = True
     # compressed resident tier: sealed chunks kept NibblePack'd in host RAM
     # under this budget so the dense tier holds only the active tail
     # (memory/resident.py; ref: doc/ingestion.md:110 in-memory compression)

@@ -19,6 +19,7 @@ from __future__ import annotations
 import dataclasses
 import itertools
 import threading
+import weakref
 from typing import Dict, Optional, Tuple
 
 import numpy as np
@@ -129,11 +130,138 @@ def _note_rebuild(delta: int) -> None:
 DEFAULT_HBM_LIMIT_BYTES = 8 << 30
 
 
-class DeviceMirror:
-    """One mirror per DenseSeriesStore (lazily attached)."""
+def store_nbytes(store) -> int:
+    """Estimated device bytes of a store's mirror (ts offsets + columns)."""
+    t = max(store.time_used, 1)
+    n = store.num_series * t * 4
+    for arr in store.cols.values():
+        if arr is not None:
+            n += store.num_series * t * arr.itemsize * \
+                (arr.shape[2] if arr.ndim == 3 else 1)
+    return n
 
-    def __init__(self, hbm_limit_bytes: int = DEFAULT_HBM_LIMIT_BYTES):
+
+class MirrorPlacer:
+    """HBM-aware shard-mirror placement across the local devices — the
+    sharded DeviceMirror mode: each chip holds its shard-subset's
+    columns, so a multi-shard box spreads the working set over every
+    HBM instead of piling all mirrors onto device 0 (and the per-device
+    fused dispatch then runs each shard's kernel on its own chip).
+
+    A shard prefers its round-robin home (shard_num % n_devices); when
+    that device's booked bytes + the incoming estimate would exceed
+    device_mirror_hbm_limit_bytes, the least-booked device that fits
+    takes it; when nothing fits, the least-booked device takes it anyway
+    and the mirror's aggregate-occupancy check in _refresh degrades that
+    store to host gathers (same stance as the single-device over-cap
+    path).  assign() RESERVES the estimate on the chosen device inside
+    the same lock, so concurrent first-query mirror creations see each
+    other's bookings instead of all landing on one home; the caller
+    hands the reservation to DeviceMirror(reserved_bytes=) and _book
+    later adjusts it to the actual upload size."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._booked: Dict[object, int] = {}
+
+    def assign(self, shard_num: int, est_bytes: int,
+               limit_bytes: int) -> object:
+        import jax
+        devs = jax.local_devices()
+        home = devs[shard_num % len(devs)]
+        with self._lock:
+            if self._booked.get(home, 0) + est_bytes <= limit_bytes:
+                chosen = home
+            else:
+                fits = [d for d in devs
+                        if self._booked.get(d, 0) + est_bytes
+                        <= limit_bytes]
+                chosen = min(fits or devs,
+                             key=lambda d: (self._booked.get(d, 0),
+                                            str(d)))
+                if not fits:
+                    from filodb_tpu.utils.metrics import registry
+                    registry.counter(
+                        "device_mirror_placement_overflow").increment()
+            self._booked[chosen] = self._booked.get(chosen, 0) + est_bytes
+            used = sum(1 for v in self._booked.values() if v > 0)
+        from filodb_tpu.utils.metrics import registry
+        registry.gauge("device_mirror_devices_used").update(used)
+        return chosen
+
+    def book(self, device, delta: int) -> None:
+        if device is None:
+            return
+        from filodb_tpu.utils.metrics import registry
+        with self._lock:
+            self._booked[device] = max(
+                self._booked.get(device, 0) + delta, 0)
+            used = sum(1 for v in self._booked.values() if v > 0)
+        registry.gauge("device_mirror_devices_used").update(used)
+
+    def booked(self, device) -> int:
+        with self._lock:
+            return self._booked.get(device, 0)
+
+
+placer = MirrorPlacer()
+
+# serializes mirror creation (the check-then-set on store.device_mirror):
+# two concurrent first queries would otherwise each placer.assign — the
+# loser's reservation then leaks until GC collects its orphan mirror
+mirror_create_lock = threading.Lock()
+
+
+def _release_booking(cell) -> None:
+    """weakref.finalize target: give a collected mirror's booked bytes
+    back to the placer (must be module-level — a bound method would pin
+    the mirror alive)."""
+    device, nbytes = cell
+    if nbytes:
+        placer.book(device, -nbytes)
+
+
+def sharded_mirrors_enabled(config_store) -> bool:
+    """Sharded placement engages when configured AND there is more than
+    one local device AND the backend actually benefits (TPU chips with
+    their own HBM).  FILODB_TPU_FORCE_SHARDED_MIRROR=1 forces it on host
+    platforms — the CPU multi-device equivalence tests run under it."""
+    import os
+
+    import jax
+    if not getattr(config_store, "device_mirror_sharded", True):
+        return False
+    try:
+        if jax.local_device_count() < 2:
+            return False
+        return (jax.default_backend() == "tpu"
+                or os.environ.get("FILODB_TPU_FORCE_SHARDED_MIRROR") == "1")
+    except Exception:  # noqa: BLE001 — uninitialized backend
+        return False
+
+
+class DeviceMirror:
+    """One mirror per DenseSeriesStore (lazily attached).
+
+    `device` pins every upload to that chip (sharded mode, placed by
+    MirrorPlacer); None keeps the classic default-device behavior."""
+
+    def __init__(self, hbm_limit_bytes: int = DEFAULT_HBM_LIMIT_BYTES,
+                 device=None, shard_num: Optional[int] = None,
+                 reserved_bytes: int = 0):
         self.hbm_limit_bytes = hbm_limit_bytes
+        self.device = device
+        self.shard_num = shard_num
+        # reserved_bytes: the estimate MirrorPlacer.assign already booked
+        # for this mirror — _book later adjusts it to the actual size
+        self._booked_bytes = reserved_bytes if device is not None else 0
+        if device is not None:
+            # release the booking when the mirror is collected: store /
+            # memstore rebuilds drop mirrors without a teardown call,
+            # and leaked bookings would eventually push every device
+            # past the placement limit
+            self._booking = [device, self._booked_bytes]
+            weakref.finalize(self, _release_booking, self._booking)
         self._snap: Optional[_MirrorSnapshot] = None
         # process-unique identity for external caches: id() can be reused
         # by a later allocation after this mirror is collected
@@ -145,13 +273,15 @@ class DeviceMirror:
         self._bg_thread: Optional[threading.Thread] = None
 
     def _nbytes(self, store) -> int:
-        t = max(store.time_used, 1)
-        n = store.num_series * t * 4
-        for arr in store.cols.values():
-            if arr is not None:
-                n += store.num_series * t * arr.itemsize * \
-                    (arr.shape[2] if arr.ndim == 3 else 1)
-        return n
+        return store_nbytes(store)
+
+    def _book(self, nbytes: int) -> None:
+        """Track this mirror's device-HBM footprint with the placer so
+        later shard placements see current occupancy."""
+        if self.device is not None and nbytes != self._booked_bytes:
+            placer.book(self.device, nbytes - self._booked_bytes)
+            self._booked_bytes = nbytes
+            self._booking[1] = nbytes
 
     def _refresh(self, store) -> bool:
         import time as _time
@@ -172,7 +302,27 @@ class DeviceMirror:
         if nbytes > self.hbm_limit_bytes:
             # silently-degraded path flagged in round 1: make it observable
             metrics_registry.counter("device_mirror_over_cap").increment()
+            # a stale snapshot's device arrays would keep HBM allocated
+            # (and, sharded, make the zeroed booking a lie the placer
+            # trusts) — drop it; host gathers serve from here
+            self._snap = None
+            self._book(0)
             return False
+        if self.device is not None:
+            # aggregate occupancy on the placed device (sharded mode):
+            # RESERVE this upload's size first, then re-read the total —
+            # check-then-upload would let two concurrent refreshes of
+            # co-located mirrors both pass and jointly OOM the chip.
+            # Over the limit means the placer found no device that fits:
+            # degrade to host gathers and release our reservation so
+            # better-fitting shards can take the device.
+            self._book(nbytes)
+            if placer.booked(self.device) > self.hbm_limit_bytes:
+                metrics_registry.counter(
+                    "device_mirror_device_over_cap").increment()
+                self._snap = None
+                self._book(0)
+                return False
         _t0 = _time.perf_counter()
         # transfer attribution times ONLY the device_put dispatches —
         # the surrounding host prep (offset/vbase/counter math) belongs
@@ -183,7 +333,7 @@ class DeviceMirror:
         def dput(x):
             nonlocal xfer_s
             t = _time.perf_counter()
-            out = jax.device_put(x)
+            out = jax.device_put(x, self.device)
             xfer_s += _time.perf_counter() - t
             return out
 
@@ -257,6 +407,7 @@ class DeviceMirror:
         # the per-query tally gets only the device-dispatch share
         metrics_registry.histogram("device_mirror_full_upload_seconds") \
             .record(_time.perf_counter() - _t0)
+        self._book(nbytes)
         # attribute the upload to whichever exec node triggered it (the
         # background-rebuild thread's tally is simply never consumed)
         note_transfer(nbytes, xfer_s)
@@ -369,8 +520,18 @@ class DeviceMirror:
         if set(n for n, a in store.cols.items() if a is not None) \
                 != set(snap.cols):
             return False                 # a column appeared (e.g. hist alloc)
-        if self._nbytes(store) > self.hbm_limit_bytes:
+        nbytes_new = self._nbytes(store)
+        if nbytes_new > self.hbm_limit_bytes:
             return False
+        if self.device is not None:
+            # reserve the grown size BEFORE the tail upload (same
+            # check-then-upload hazard as the full path: co-located
+            # mirrors appending concurrently must see each other);
+            # over the aggregate limit falls through to _refresh,
+            # whose own check degrades to host gathers
+            self._book(nbytes_new)
+            if placer.booked(self.device) > self.hbm_limit_bytes:
+                return False
         counts_new = store.counts[:s_new].astype(np.int32).copy()
         counts_old = np.zeros(s_new, dtype=np.int32)
         counts_old[:s_old] = snap.counts
@@ -498,7 +659,7 @@ class DeviceMirror:
             vb_dev = snap.vbases[name]
             if dS or vb_changed:
                 new_vbases[name] = jax.device_put(
-                    vb_new.astype(vb_dev.dtype))
+                    vb_new.astype(vb_dev.dtype), self.device)
             else:
                 new_vbases[name] = vb_dev
             xfer_s += _time.perf_counter() - _td
@@ -522,6 +683,7 @@ class DeviceMirror:
                                      total_new * per_cell)
         note_transfer(total_new * per_cell, xfer_s)
         note_mirror_refresh("incremental")
+        self._book(self._nbytes(store))
         return True
 
     def _refresh_pad_only(self, store, snap, gen0: int, s_new: int,
@@ -561,12 +723,13 @@ class DeviceMirror:
             if dS:
                 import jax
                 vb_dev = jax.device_put(
-                    host_vbases[name].astype(vb_dev.dtype))
+                    host_vbases[name].astype(vb_dev.dtype), self.device)
             new_vbases[name] = vb_dev
 
         counts_new = np.zeros(s_new, dtype=np.int32)
         counts_new[:s_old] = snap.counts
         metrics_registry.counter("device_mirror_incremental").increment()
+        self._book(self._nbytes(store))
         # pad-only is only reachable with new (empty) rows — dS > 0, since
         # time_used == counts.max() makes pure time growth impossible with
         # zero new cells — and empty rows always break grid uniformity

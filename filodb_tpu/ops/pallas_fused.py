@@ -268,9 +268,9 @@ _PLAN_MATS_CACHE: dict = {}
 _PLAN_MATS_LOCK = threading.Lock()
 
 
-def plan_device_mats(plan: "FusedPlan") -> tuple:
+def plan_device_mats(plan: "FusedPlan", device=None) -> tuple:
     """Device-resident copies of a plan's selection matrices + window
-    rows, uploaded ONCE per plan object.
+    rows, uploaded ONCE per (plan object, device).
 
     Measured on the tunneled v5e (TPU_CHAIN_r05.json): the kernel's true
     device time at 262k x 720 is ~6 ms, but the per-call p50 was ~113 ms
@@ -278,48 +278,83 @@ def plan_device_mats(plan: "FusedPlan") -> tuple:
     this function's absence: every query re-uploaded ~1.6 MB of numpy
     plan matrices through `jnp.asarray`.  Keyed by id(plan) with the
     plan pinned (id-reuse safe), matching the leaf/mesh plan caches'
-    lifetime."""
+    lifetime.  One cache entry per plan holds ALL its per-device uploads
+    (the multi-chip per-device dispatch path pins the same plan on every
+    participating device), so device fan-out can't thrash the LRU."""
     k = id(plan)
+    dk = None if device is None else device
     with _PLAN_MATS_LOCK:
         ent = _PLAN_MATS_CACHE.get(k)
-        if ent is not None and ent[0] is plan:
-            return ent[1]
+        if ent is not None and ent[0] is plan and dk in ent[1]:
+            # LRU touch: eviction pops the oldest entry, and a hot mesh
+            # plan hit on every query must not age out under mixed
+            # leaf+mesh traffic filling the cap
+            _PLAN_MATS_CACHE.pop(k)
+            _PLAN_MATS_CACHE[k] = ent
+            return ent[1][dk]
     W = plan.t1.shape[1]
     idx1 = plan.idx1 if plan.idx1 is not None else np.zeros((1, W),
                                                             np.float32)
     idx2 = plan.idx2 if plan.idx2 is not None else np.zeros((1, W),
                                                             np.float32)
-    mats = tuple(jnp.asarray(m) for m in
+    put = (jnp.asarray if device is None
+           else (lambda m: jax.device_put(m, device)))
+    mats = tuple(put(m) for m in
                  (plan.o1, plan.o2, plan.l1, plan.l2, plan.t1, plan.t2,
                   plan.n, plan.n1, plan.wstart_x, plan.wend_x, plan.tsrow,
                   idx1, idx2))
     with _PLAN_MATS_LOCK:
-        _PLAN_MATS_CACHE[k] = (plan, mats)
+        ent = _PLAN_MATS_CACHE.get(k)
+        if ent is None or ent[0] is not plan:
+            ent = (plan, {})
+            _PLAN_MATS_CACHE[k] = ent
+        ent[1][dk] = mats
         while len(_PLAN_MATS_CACHE) > 8:
             _PLAN_MATS_CACHE.pop(next(iter(_PLAN_MATS_CACHE)))
     return mats
 
 
-_SEL_DUMMY = None
+_SEL_DUMMY: dict = {}
 
 
-def _sel_dummy():
+def _sel_dummy(device=None):
     """Tiny stand-in for the unused selection matrices in gather mode —
     the kernel never reads them, and the small block frees their ~1.5 MB
-    of VMEM for larger series blocks."""
-    global _SEL_DUMMY
-    if _SEL_DUMMY is None:
-        _SEL_DUMMY = jnp.zeros((8, _LANE), jnp.float32)
-    return _SEL_DUMMY
+    of VMEM for larger series blocks.  One per device: the per-device
+    dispatch path needs every kernel operand committed to ITS chip."""
+    dk = None if device is None else device
+    d = _SEL_DUMMY.get(dk)
+    if d is None:
+        z = np.zeros((8, _LANE), np.float32)
+        d = jnp.asarray(z) if device is None else jax.device_put(z, device)
+        _SEL_DUMMY[dk] = d
+    return d
+
+
+def _committed_device(arr):
+    """The single device `arr` is committed to, else None — uncommitted
+    arrays follow jax's default placement, no pin needed.  Used to route
+    plan-matrix uploads to the chip that already holds a working set
+    (sharded DeviceMirror mode), so dispatch never drags the ~1.6 MB of
+    selection matrices cross-device per call."""
+    try:
+        if getattr(arr, "committed", False):
+            devs = arr.devices()
+            if len(devs) == 1:
+                return next(iter(devs))
+    except Exception:  # noqa: BLE001 — non-jax arrays (numpy fallback)
+        pass
+    return None
 
 
 def _kernel_mats(plan: "FusedPlan", over_time: bool,
-                 gather: bool = False) -> tuple:
+                 gather: bool = False, device=None) -> tuple:
     """The 12 operands _run expects after (vals, vbase, gids), with `n`
     resolved to true counts for the over_time kinds and the o1..l2
-    selection matrices swapped for dummies in gather mode."""
-    m = plan_device_mats(plan)
-    sel = (_sel_dummy(),) * 4 if gather else m[:4]
+    selection matrices swapped for dummies in gather mode.  `device`
+    pins the upload (per-device dispatch, parallel/mesh.py)."""
+    m = plan_device_mats(plan, device)
+    sel = (_sel_dummy(device),) * 4 if gather else m[:4]
     return sel + m[4:6] + (m[7] if over_time else m[6],) + m[8:]
 
 
@@ -836,29 +871,43 @@ class PaddedGroups(NamedTuple):
     gsize: np.ndarray    # [num_groups]
 
 
-def pad_values(vals, vbase, plan: FusedPlan) -> PaddedValues:
+def pad_values(vals, vbase, plan: FusedPlan, device=None) -> PaddedValues:
     S = vals.shape[0]
     Sp = pad_series_count(S)
+    if device is not None:
+        # commit the inputs straight to the owning chip so the pad
+        # computes (and its result lives) there — staging through
+        # jnp.asarray would materialize the full [S, T] block on the
+        # default device first and pay the copy twice; uncommitted
+        # operands then follow the committed ones
+        v = jax.device_put(np.asarray(vals, np.float32), device)
+        vb = jax.device_put(np.asarray(vbase, np.float32), device)
+    else:
+        v = jnp.asarray(vals, jnp.float32)
+        vb = jnp.asarray(vbase, jnp.float32)
     vals_p = jnp.zeros((Sp, plan.Tp), jnp.float32)
-    vals_p = vals_p.at[:S, :vals.shape[1]].set(jnp.asarray(vals, jnp.float32))
+    vals_p = vals_p.at[:S, :vals.shape[1]].set(v)
     vbase_p = jnp.zeros((Sp, 1), jnp.float32)
-    vbase_p = vbase_p.at[:S, 0].set(jnp.asarray(vbase, jnp.float32))
+    vbase_p = vbase_p.at[:S, 0].set(vb)
     return PaddedValues(vals_p, vbase_p)
 
 
-def pad_groups(gids, S: int, num_groups: int) -> PaddedGroups:
+def pad_groups(gids, S: int, num_groups: int,
+               device=None) -> PaddedGroups:
     Sp = pad_series_count(S)
     gids_np = np.asarray(gids, np.int32)
+    g = (jnp.asarray(gids_np) if device is None
+         else jax.device_put(gids_np, device))
     gids_p = jnp.full((Sp, 1), -1, jnp.int32)
-    gids_p = gids_p.at[:S, 0].set(jnp.asarray(gids_np))
+    gids_p = gids_p.at[:S, 0].set(g)
     gsize = np.bincount(gids_np, minlength=num_groups)[:num_groups]
     return PaddedGroups(gids_p, gsize)
 
 
 def pad_inputs(vals, vbase, gids, plan: FusedPlan,
-               num_groups: int) -> PreparedInputs:
-    v = pad_values(vals, vbase, plan)
-    g = pad_groups(gids, vals.shape[0], num_groups)
+               num_groups: int, device=None) -> PreparedInputs:
+    v = pad_values(vals, vbase, plan, device=device)
+    g = pad_groups(gids, vals.shape[0], num_groups, device=device)
     return PreparedInputs(v.vals_p, v.vbase_p, g.gids_p, g.gsize)
 
 
@@ -868,7 +917,8 @@ def fused_rate_groupsum(vals, vbase, gids, plan: FusedPlan,
                         interpret: bool = False,
                         prepared: Optional[PreparedInputs] = None,
                         ragged: bool = False,
-                        gather: Optional[bool] = None
+                        gather: Optional[bool] = None,
+                        device=None
                         ) -> Tuple[jax.Array, np.ndarray]:
     """-> (sums [G, W] device array, counts [G, W] numpy).
 
@@ -879,6 +929,11 @@ def fused_rate_groupsum(vals, vbase, gids, plan: FusedPlan,
     — NaN where 0, matching ops/agg.py present().  ragged=True runs the
     validity-aware kernel variant instead; counts then come back from the
     kernel's per-cell presence output.
+
+    `device` pins every operand (values, plan mats) to that chip so the
+    jit executes THERE — the per-device unit of the multi-chip dispatch
+    path (parallel/mesh.py), which runs this exact function once per
+    device and merges the [G, W] partials it returns.
     """
     is_counter = fn_name in ("rate", "increase")
     is_rate = fn_name == "rate"
@@ -886,12 +941,17 @@ def fused_rate_groupsum(vals, vbase, gids, plan: FusedPlan,
     over_time = fn_name in OVER_TIME_FNS
     kind = fn_name if over_time else "rate_family"
     if prepared is None:
-        prepared = pad_inputs(vals, vbase, gids, plan, num_groups)
+        prepared = pad_inputs(vals, vbase, gids, plan, num_groups,
+                              device=device)
+    elif device is None:
+        # caller-prepared inputs may already be pinned (sharded mirror
+        # mode) — keep the plan matrices on the same chip
+        device = _committed_device(prepared.vals_p)
     Gp = pad_group_count(num_groups)
     if gather is None:
         gather = gather_default(kind) and plan.idx1 is not None
     res = _run(prepared.vals_p, prepared.vbase_p, prepared.gids_p,
-               *_kernel_mats(plan, over_time, gather),
+               *_kernel_mats(plan, over_time, gather, device=device),
                num_groups=Gp, is_counter=is_counter, is_rate=is_rate,
                with_drops=with_drops, interpret=interpret, kind=kind,
                ragged=ragged, gather=gather)
@@ -1093,7 +1153,8 @@ def merge_groups(groups_list, num_groups_list):
 def fused_leaf_agg_batch(plan: FusedPlan, values: PaddedValues, panels,
                          fn_name: str, precorrected: bool = False,
                          interpret: bool = False, ragged: bool = False,
-                         num_series: Optional[int] = None):
+                         num_series: Optional[int] = None,
+                         lazy: bool = False):
     """Evaluate P aggregation panels over ONE working set in at most two
     kernel dispatches — the dashboard case (same metric + window grid,
     different `by (...)` groupings / agg ops), where the per-call
@@ -1105,7 +1166,13 @@ def fused_leaf_agg_batch(plan: FusedPlan, values: PaddedValues, panels,
     multi-hot epilogue); min/max panels share one per-series-mode run
     finished by per-panel XLA segment reductions; dense count panels are
     host-only math.  Returns per-panel [G, W, C] float64 components in
-    input order (ops/agg.AGGREGATORS layout)."""
+    input order (ops/agg.AGGREGATORS layout).
+
+    lazy=True returns a zero-arg finisher instead: the kernel work is
+    DISPATCHED before returning, but the synchronizing host readback
+    waits until the finisher is called — so a multi-shard batch whose
+    working sets live on different chips (sharded DeviceMirror mode)
+    dispatches everything first and the chips compute concurrently."""
     is_counter = fn_name in ("rate", "increase")
     is_rate = fn_name == "rate"
     with_drops = is_counter and not precorrected
@@ -1114,10 +1181,15 @@ def fused_leaf_agg_batch(plan: FusedPlan, values: PaddedValues, panels,
     wvalid = plan.wvalid1 if over_time else plan.wvalid
 
     gather = gather_default(kind) and plan.idx1 is not None
+    # sharded DeviceMirror mode: the working set is committed to its
+    # shard's chip — pin the plan matrices there too, or every dispatch
+    # re-ships them from the default device (the per-call upload
+    # pathology plan_device_mats exists to kill)
+    device = _committed_device(values.vals_p)
 
     def run(gids_p, Gp, per_series):
         return _run(values.vals_p, values.vbase_p, gids_p,
-                    *_kernel_mats(plan, over_time, gather),
+                    *_kernel_mats(plan, over_time, gather, device=device),
                     num_groups=Gp, is_counter=is_counter, is_rate=is_rate,
                     with_drops=with_drops, interpret=interpret, kind=kind,
                     ragged=ragged, per_series=per_series, gather=gather)
@@ -1136,26 +1208,15 @@ def fused_leaf_agg_batch(plan: FusedPlan, values: PaddedValues, panels,
         raise ValueError(f"unsupported fused agg {bad[0]}")
 
     out: list = [None] * len(panels)
+    # ---- dispatch phase: every device call is issued here, nothing is
+    # read back — all results below are lazy device arrays
+    mm_res = offsets = None
     if mm_idx:
         gids_multi, offsets, total = merge_groups(
             [panels[i][0] for i in mm_idx], [panels[i][1] for i in mm_idx])
         Gp = pad_group_count(total)
-        res = run(gids_multi, Gp, per_series=False)
-        if ragged:
-            sums_all, cnts_all = (np.asarray(r, np.float64) for r in res)
-        else:
-            sums_all = np.asarray(res, np.float64)
-            cnts_all = None
-        for j, i in enumerate(mm_idx):
-            groups, G, op = panels[i]
-            lo = offsets[j]
-            sums = sums_all[lo:lo + G, :plan.W]
-            counts = (cnts_all[lo:lo + G, :plan.W] if ragged
-                      else dense_counts(groups))
-            if op == "count":
-                out[i] = counts[..., None]
-            else:
-                out[i] = np.stack([sums * (counts > 0), counts], axis=-1)
+        mm_res = run(gids_multi, Gp, per_series=False)
+    ps_comps: dict = {}
     if ps_idx:
         from filodb_tpu.ops import agg as agg_ops
         S = num_series
@@ -1173,9 +1234,34 @@ def fused_leaf_agg_batch(plan: FusedPlan, values: PaddedValues, panels,
                             res[:S, :plan.W], jnp.nan)
         for i in ps_idx:
             groups, G, op = panels[i]
-            comp = agg_ops.map_phase(op, per, groups.gids_p[:S, 0], G)
-            out[i] = np.asarray(comp, np.float64)
-    for i, (groups, G, op) in enumerate(panels):
-        if out[i] is None:              # dense count: pure host math
-            out[i] = dense_counts(groups)[..., None]
-    return out
+            ps_comps[i] = agg_ops.map_phase(op, per, groups.gids_p[:S, 0],
+                                            G)
+
+    # ---- finish phase: synchronizing host readbacks + assembly
+    def finish():
+        if mm_idx:
+            if ragged:
+                sums_all, cnts_all = (np.asarray(r, np.float64)
+                                      for r in mm_res)
+            else:
+                sums_all = np.asarray(mm_res, np.float64)
+                cnts_all = None
+            for j, i in enumerate(mm_idx):
+                groups, G, op = panels[i]
+                lo = offsets[j]
+                sums = sums_all[lo:lo + G, :plan.W]
+                counts = (cnts_all[lo:lo + G, :plan.W] if ragged
+                          else dense_counts(groups))
+                if op == "count":
+                    out[i] = counts[..., None]
+                else:
+                    out[i] = np.stack([sums * (counts > 0), counts],
+                                      axis=-1)
+        for i in ps_idx:
+            out[i] = np.asarray(ps_comps[i], np.float64)
+        for i, (groups, G, op) in enumerate(panels):
+            if out[i] is None:          # dense count: pure host math
+                out[i] = dense_counts(groups)[..., None]
+        return out
+
+    return finish if lazy else finish()

@@ -41,17 +41,35 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from filodb_tpu.ops import agg as agg_ops
 from filodb_tpu.ops.rangefns import evaluate_range_function
 from filodb_tpu.ops.timewindow import PAD_TS
+from filodb_tpu.utils.jaxcompat import has_ici, shard_map
 
 
 # --------------------------------------------------------------------- mesh
 
 def make_mesh(n_shard: int, n_time: int = 1,
               devices: Optional[Sequence] = None) -> Mesh:
-    """Build a ('shard', 'time') mesh from the first n_shard*n_time devices."""
+    """Build a ('shard', 'time') mesh from the first n_shard*n_time devices.
+
+    Devices beyond n_shard*n_time are left out of the mesh; that
+    truncation used to be silent — an operator sizing a pod for 8-way
+    scaling with a 6-shard dataset would quietly idle 2 chips.  The
+    unused count is logged once and the chosen shape exposed as gauges
+    (`mesh_shard_axis` / `mesh_time_axis` / `mesh_unused_devices`)."""
+    from filodb_tpu.utils.metrics import log_error_once, registry
     devs = list(devices if devices is not None else jax.devices())
     need = n_shard * n_time
     if len(devs) < need:
         raise ValueError(f"need {need} devices, have {len(devs)}")
+    if len(devs) > need:
+        log_error_once(
+            "mesh_unused_devices",
+            RuntimeWarning(
+                f"mesh ({n_shard} shard x {n_time} time) uses {need} of "
+                f"{len(devs)} devices; {len(devs) - need} idle — resize "
+                f"the mesh axes to cover the pod"))
+    registry.gauge("mesh_shard_axis").update(n_shard)
+    registry.gauge("mesh_time_axis").update(n_time)
+    registry.gauge("mesh_unused_devices").update(len(devs) - need)
     grid = np.array(devs[:need]).reshape(n_shard, n_time)
     return Mesh(grid, ("shard", "time"))
 
@@ -101,6 +119,13 @@ class PackedShards:
     # rows without re-gathering (the mesh analogue of the leaf path's
     # PaddedValues/PaddedGroups split)
     pids_by_shard: Optional[List[np.ndarray]] = None
+    # host-side views of the packed arrays, kept on backends without an
+    # MXU (device_put_packed): the per-device dispatcher's host fused
+    # route (ops/hostleaf) reads these instead of pulling device copies
+    # back per query.  None on TPU — there the kernel path serves.
+    host_values: Optional[np.ndarray] = None
+    host_vbase: Optional[np.ndarray] = None
+    host_group_ids: Optional[np.ndarray] = None
 
     @property
     def n_shards(self) -> int:
@@ -240,16 +265,61 @@ def device_put_packed(packed: PackedShards, mesh: Mesh) -> PackedShards:
     any window slice — windows reach back `range` into the data)."""
     data_spec = NamedSharding(mesh, P("shard", None, None))
     gid_spec = NamedSharding(mesh, P("shard", None))
+    # host-side views feed only the host fused route, which serves dense
+    # packs exclusively — keeping them for ragged packs would hold a
+    # full extra [D, S, T] copy per cache entry that nothing ever reads
+    keep_host = jax.default_backend() != "tpu" and packed.dense
     return dataclasses.replace(
         packed,
         ts_off=jax.device_put(packed.ts_off, data_spec),
         values=jax.device_put(packed.values, data_spec),
         group_ids=jax.device_put(packed.group_ids, gid_spec),
         vbase=(None if packed.vbase is None
-               else jax.device_put(packed.vbase, gid_spec)))
+               else jax.device_put(packed.vbase, gid_spec)),
+        host_values=(np.asarray(packed.values) if keep_host else None),
+        host_vbase=(np.asarray(packed.vbase)
+                    if keep_host and packed.vbase is not None else None),
+        host_group_ids=(np.asarray(packed.group_ids)
+                        if keep_host else None))
 
 
 # ------------------------------------------------------------ SPMD kernels
+
+@functools.partial(jax.jit, static_argnames=(
+    "G", "S", "T", "Tp", "gather", "is_counter", "is_rate", "interpret",
+    "kind", "ragged"))
+def _pad_run_single(v, vb, g, mats, *, G: int, S: int, T: int, Tp: int,
+                    gather: bool, is_counter: bool, is_rate: bool,
+                    interpret: bool, kind: str, ragged: bool):
+    """Pad ONE device's [S, T] values + [S, P] grouping (P > 1:
+    run_agg_batch panels over disjoint group-id ranges, multi-hot kernel
+    epilogue) to kernel tile shapes and run the single-chip kernel — the
+    shared map-phase body of the per-device dispatch
+    (_device_fused_call) and the legacy fused-in-shard_map A/B probe
+    (_mesh_fused_call), so their padding semantics can never diverge.
+
+    Dense packs: NaN cells are exactly pad rows / beyond-count columns,
+    zeroed they contribute nothing (pack pad rows carry gid 0 but add +0
+    to its sums).  Ragged packs keep their NaNs — the kernel's fill
+    scans treat them as absent samples; pad rows become all-NaN rows
+    whose presence is 0.  with_drops is always False here: counter
+    functions require a precorrected pack."""
+    from filodb_tpu.ops import pallas_fused as pf
+    Gp = pf.pad_group_count(G)
+    Sp = pf.pad_series_count(S)
+    v = v.astype(jnp.float32)
+    if ragged:
+        v = jnp.pad(v, ((0, Sp - S), (0, Tp - T)), constant_values=np.nan)
+    else:
+        v = jnp.pad(jnp.nan_to_num(v), ((0, Sp - S), (0, Tp - T)))
+    vb = jnp.pad(vb.astype(jnp.float32), (0, Sp - S))[:, None]
+    g = jnp.pad(g.astype(jnp.int32), ((0, Sp - S), (0, 0)),
+                constant_values=-1)
+    return pf.run_kernel(v, vb, g, *mats, gather=gather, num_groups=Gp,
+                         is_counter=is_counter, is_rate=is_rate,
+                         with_drops=False, interpret=interpret, kind=kind,
+                         ragged=ragged)
+
 
 @functools.partial(jax.jit, static_argnames=(
     "mesh", "G", "S", "T", "Tp", "is_counter", "is_rate", "interpret",
@@ -259,47 +329,32 @@ def _mesh_fused_call(mesh: Mesh, values, group_ids, vbase,
                      G: int, S: int, T: int, Tp: int,
                      is_counter: bool, is_rate: bool, interpret: bool,
                      kind: str = "rate_family", ragged: bool = False):
-    """Pallas fused sum(rate)-family kernel inside shard_map: values sharded
-    over 'shard', per-slice selection matrices over 'time', group sums psum
-    over 'shard'.  jit-cached on the static shape/flag tuple so repeat
-    queries don't re-trace (the closure-per-call anti-pattern)."""
+    """LEGACY A/B path: the Pallas fused kernel traced INSIDE shard_map.
+
+    Kept only for measurement tooling (tools/tpu_extra.py, the driver
+    dryrun, bench.py multichip's inversion probe): on a multi-device
+    mesh this composition collapses ~30x vs the general path
+    (MULTICHIP_r05.json) because the kernel re-traces and schedules per
+    mesh program.  Production queries route through the per-device
+    dispatch below (_device_fused_call + merge_device_partials), which
+    never puts the kernel under shard_map; see doc/multichip.md."""
     from filodb_tpu.ops import pallas_fused as pf
-    Gp = pf.pad_group_count(G)
-    Sp = pf.pad_series_count(S)
     gather = pf.gather_default(kind)
 
-    def step(val_blk, gid_blk, vb_blk, o1b, o2b, l1b, l2b,
-             t1b, t2b, nb, wsb, web, tsb, i1b, i2b):
-        # Dense packs: NaN cells are exactly pad rows / beyond-count
-        # columns, zeroed they contribute nothing (pack pad rows carry
-        # gid 0 but add +0 to its sums).  Ragged packs keep their NaNs —
-        # the kernel's fill scans treat them as absent samples; pad rows
-        # become all-NaN rows whose presence is 0.  with_drops is always
-        # False here: counter functions require a precorrected pack.
-        v = val_blk[0].astype(jnp.float32)
-        if ragged:
-            v = jnp.pad(v, ((0, Sp - S), (0, Tp - T)),
-                        constant_values=np.nan)
-        else:
-            v = jnp.pad(jnp.nan_to_num(v), ((0, Sp - S), (0, Tp - T)))
-        vb = jnp.pad(vb_blk[0].astype(jnp.float32), (0, Sp - S))[:, None]
-        # [S, P] grouping columns (P > 1: run_agg_batch panels over
-        # disjoint group-id ranges, multi-hot kernel epilogue)
-        g = jnp.pad(gid_blk[0].astype(jnp.int32), ((0, Sp - S), (0, 0)),
-                    constant_values=-1)
-        res = pf.run_kernel(v, vb, g, o1b[0], o2b[0], l1b[0], l2b[0],
-                            t1b[0], t2b[0], nb[0], wsb[0], web[0], tsb[0],
-                            i1b[0], i2b[0], gather=gather,
-                            num_groups=Gp, is_counter=is_counter,
-                            is_rate=is_rate, with_drops=False,
-                            interpret=interpret, kind=kind, ragged=ragged)
+    def step(val_blk, gid_blk, vb_blk, *mat_blks):
+        res = _pad_run_single(val_blk[0], vb_blk[0], gid_blk[0],
+                              tuple(m[0] for m in mat_blks), G=G, S=S,
+                              T=T, Tp=Tp, gather=gather,
+                              is_counter=is_counter, is_rate=is_rate,
+                              interpret=interpret, kind=kind,
+                              ragged=ragged)
         if ragged:
             sums, cnts = res
             return (jax.lax.psum(sums[:G], "shard"),
                     jax.lax.psum(cnts[:G], "shard"))
         return jax.lax.psum(res[:G], "shard")          # [G, Wlp]
 
-    return jax.shard_map(
+    return shard_map(
         step, mesh=mesh,
         in_specs=(P("shard", None, None), P("shard", None, None),
                   P("shard", None)) + (P("time", None, None),) * 12,
@@ -310,6 +365,108 @@ def _mesh_fused_call(mesh: Mesh, values, group_ids, vbase,
         # replicated over 'shard' by construction
         check_vma=False)(values, group_ids, vbase,
                          o1, o2, l1, l2, t1, t2, n, ws, we, ts, i1, i2)
+
+
+# ------------------------------------------------ per-device fused dispatch
+#
+# The multi-chip fused scan.  Tracing the Pallas kernel INSIDE shard_map
+# (the _mesh_fused_call path above, kept for A/B tooling) inverted the
+# kernel's single-chip win ~30x on an 8-device mesh (MULTICHIP_r05.json:
+# warm 25.3 s fused vs 0.88 s general): the kernel + its grid loop were
+# re-traced and scheduled per mesh program instead of dispatched as the
+# single-chip binary.  The production path below never puts the kernel
+# under shard_map: device (s, t) runs the SINGLE-CHIP kernel over its
+# committed [S, T] shard block with time-slice t's plan, and only the
+# [G, Wl] group partials cross chips — one tiny psum collective on ICI,
+# a host-side ops/agg.reduce_phase merge otherwise.  That is exactly the
+# reference's 3-phase map/reduce/present contract (doc/query-engine.md
+# :311-330) with the map phase on-chip and the reduce over partials only.
+
+@functools.partial(jax.jit, static_argnames=(
+    "G", "S", "T", "Tp", "is_counter", "is_rate", "interpret", "kind",
+    "ragged"))
+def _device_fused_call(values, group_ids, vbase, o1, o2, l1, l2, t1, t2,
+                       n, ws, we, ts, i1, i2, *, G: int, S: int, T: int,
+                       Tp: int, is_counter: bool, is_rate: bool,
+                       interpret: bool, kind: str = "rate_family",
+                       ragged: bool = False):
+    """One device's share of the multi-chip fused scan: the single-chip
+    Pallas kernel over this device's [1, S, T] shard block.  Every
+    operand is committed to the owning device, so the jit executes THERE
+    (device-pinned dispatch — never inside shard_map) and only the
+    [G, Wlp] group partials leave the chip.  The leading shard axis is
+    kept so the pack's addressable shards feed straight in."""
+    from filodb_tpu.ops import pallas_fused as pf
+    res = _pad_run_single(values[0], vbase[0], group_ids[0],
+                          (o1, o2, l1, l2, t1, t2, n, ws, we, ts, i1, i2),
+                          G=G, S=S, T=T, Tp=Tp,
+                          gather=pf.gather_default(kind),
+                          is_counter=is_counter, is_rate=is_rate,
+                          interpret=interpret, kind=kind, ragged=ragged)
+    if ragged:
+        return res[0][:G], res[1][:G]
+    return res[:G]
+
+
+@functools.partial(jax.jit, static_argnames=("mesh", "comb"))
+def _merge_partials_collective(mesh: Mesh, x, *, comb: str = "sum"):
+    """The cross-chip reduce of the 3-phase contract as ONE tiny jitted
+    collective over group partials [D, G, n_time, Wlp] (psum/pmin/pmax
+    over 'shard'; the [S, T] series blocks never ride a collective)."""
+    def step(blk):
+        p = blk[0]
+        if comb == "sum":
+            return jax.lax.psum(p, "shard")
+        return (jax.lax.pmin if comb == "min" else jax.lax.pmax)(p, "shard")
+    return shard_map(step, mesh=mesh,
+                     in_specs=P("shard", None, "time", None),
+                     out_specs=P(None, "time", None))(x)
+
+
+def merge_device_partials(parts: Dict[Tuple[int, int], jax.Array],
+                          mesh: Mesh, comb: str = "sum",
+                          collective: Optional[bool] = None) -> np.ndarray:
+    """Merge per-device [G, Wlp] partials -> [G, n_time * Wlp] float64.
+
+    parts[(s, t)] is mesh device (s, t)'s partial (shard s, time-slice
+    t).  With ICI (TPU backend) the merge is one jitted collective over
+    the partials only; host platforms emulate collectives through host
+    memory anyway, so there the partials come host-side in one
+    device_get and merge with ops/agg.reduce_phase combiner semantics in
+    ascending shard order — deterministic, and bit-stable across runs."""
+    n_shard, n_time = mesh.shape["shard"], mesh.shape["time"]
+    G, Wlp = parts[(0, 0)].shape
+    if collective is None:
+        collective = has_ici()
+    if collective and n_shard > 1:
+        pieces = [jnp.reshape(parts[(s, t)], (1, G, 1, Wlp))
+                  for s in range(n_shard) for t in range(n_time)]
+        sh = NamedSharding(mesh, P("shard", None, "time", None))
+        glob = jax.make_array_from_single_device_arrays(
+            (n_shard, G, n_time, Wlp), sh, pieces)
+        from filodb_tpu.utils.metrics import registry
+        registry.counter("mesh_partials_collective_merge").increment()
+        out = np.asarray(_merge_partials_collective(mesh, glob, comb=comb),
+                         dtype=np.float64)
+        return out.reshape(G, n_time * Wlp)
+    ordered = [parts[(s, t)] for t in range(n_time)
+               for s in range(n_shard)]
+    host = [np.asarray(a, np.float64) for a in jax.device_get(ordered)]
+    from filodb_tpu.utils.metrics import registry
+    registry.counter("mesh_partials_host_merge").increment()
+    cols = []
+    for t in range(n_time):
+        acc = host[t * n_shard]
+        for s in range(1, n_shard):
+            nxt = host[t * n_shard + s]
+            if comb == "sum":
+                acc = acc + nxt
+            elif comb == "min":
+                acc = np.minimum(acc, nxt)
+            else:
+                acc = np.maximum(acc, nxt)
+        cols.append(acc)
+    return np.concatenate(cols, axis=1)
 
 
 def distributed_window_agg(mesh: Mesh, ts_off, values, group_ids, wends, *,
@@ -369,7 +526,7 @@ def _distributed_window_agg(mesh: Mesh,
         return jnp.stack([_collective(c, part[..., i])
                           for i, c in enumerate(combs)], axis=-1)
 
-    return jax.shard_map(
+    return shard_map(
         step, mesh=mesh,
         in_specs=(P("shard", None, None), P("shard", None, None),
                   P("shard", None), P("time"), P("shard", None)),
@@ -412,11 +569,19 @@ def _distributed_window_raw(mesh: Mesh,
                                       dense=dense)
         return res[None]
 
-    return jax.shard_map(
+    return shard_map(
         step, mesh=mesh,
         in_specs=(P("shard", None, None), P("shard", None, None), P("time"),
                   P("shard", None)),
         out_specs=P("shard", None, "time"))(ts_off, values, wends, vbase)
+
+
+def _host_counts(gsize: np.ndarray, wvalid: np.ndarray) -> np.ndarray:
+    """Dense-pack present counts: every REAL series emits a value exactly
+    where the shared window is valid — counts[g, w] = |group g| * valid[w].
+    The single home of the formula for both the kernel-route epilogue and
+    the dense count panels (_finish_count_panels)."""
+    return gsize[:, None] * wvalid[None, :].astype(np.float64)
 
 
 # ----------------------------------------------------------- executor glue
@@ -449,6 +614,12 @@ class MeshExecutor:
         # next query pays one re-upload (never worse than uncached).
         self._pack_cache: Dict[Tuple, Dict] = {}
         self._pack_cache_max = 8
+        # packing-LAYOUT memo, validated against the actual per-shard
+        # pid sets the index lookup returns: survives value-level
+        # invalidations of _pack_cache, so live-ingest re-polls
+        # re-upload values but never repack the layout (see
+        # lookup_and_pack; mesh_pack_memo_hits counts the wins)
+        self._pack_layout_memo: Dict[Tuple, Dict] = {}
         # fused-path plan/mats cache: (shared_ts_row, wends, range) ->
         # (device selection matrices, wvalid); see _run_agg_fused
         self._fused_plan_cache: Dict[Tuple, Tuple] = {}
@@ -541,21 +712,12 @@ class MeshExecutor:
                 return ent["packed"]
         spec = RANGE_FUNCTIONS.get(fn_name or "")
         fn_is_counter = spec.is_counter if spec else False
-        blocks = []
-        precorrected = True
-        registry = None
-        pids_by_shard = []
-        for shard in self.memstore.shards_for(self.dataset):
-            lookup = shard.lookup_partitions(filters, start_ms, end_ms)
-            schema_name = lookup.first_schema
-            pids = (lookup.pids_by_schema.get(schema_name)
-                    if schema_name else None)
-            if pids is None or pids.size == 0:
-                blocks.append((np.full((1, 1), PAD_TS, np.int32),
-                               np.full((1, 1), np.nan), []))
-                pids_by_shard.append(None)
-                continue
-            pids_by_shard.append(np.asarray(pids))
+        shards = list(self.memstore.shards_for(self.dataset))
+        if not shards:
+            return None
+
+        def gather_block(shard, pids, schema_name, state):
+            """Value-level (re)gather for one shard's memoized row set."""
             shard.ensure_paged_pids(schema_name, pids, start_ms, end_ms)
             store = shard.stores[schema_name]
             rows = shard.rows_for(pids)
@@ -567,13 +729,111 @@ class MeshExecutor:
             counter_col = col_def is not None and (col_def.detect_drops
                                                    or col_def.counter)
             correct = counter_col and fn_is_counter
-            precorrected = precorrected and correct
+            state["precorrected"] = state["precorrected"] and correct
             vals, vbase = rebase_values(cols[schema.value_column], correct)
-            ts_off = to_offsets(ts, counts, start_ms)
-            gids, registry = self._gids_for(shard, pids, by, without)
-            blocks.append((ts_off, vals, gids, vbase))
-        if not blocks:
-            return None
+            return to_offsets(ts, counts, start_ms), vals, vbase
+
+        # Packing LAYOUT memo: the row order, group-slot arrays, labels
+        # and schema routing depend only on the per-shard pid SETS the
+        # index lookup returns — so a re-poll whose lookup yields the
+        # SAME pid sets (the common live-ingest case: values appended,
+        # no index change admitting or pruning different series for the
+        # new range) reuses the memoized grouping/labels and skips the
+        # per-series Python of group resolution + slot compaction.
+        # Validity is checked against the ACTUAL lookup result, never
+        # inferred from generation counters: new-series ingest and
+        # time-range drift both change the pid sets without necessarily
+        # moving keys_serial/keys_epoch.  lookup_partitions is itself
+        # memoized per (filters, range, index.mutations, keys_epoch)
+        # (core/shard.py), so the guard costs one cached lookup + pid
+        # array compare per shard.
+        lookups: List[Tuple[Optional[np.ndarray], Optional[str]]] = []
+        for shard in shards:
+            lookup = shard.lookup_partitions(filters, start_ms, end_ms)
+            schema_name = lookup.first_schema
+            pids = (lookup.pids_by_schema.get(schema_name)
+                    if schema_name else None)
+            if pids is None or pids.size == 0:
+                lookups.append((None, None))
+            else:
+                lookups.append((np.asarray(pids), schema_name))
+
+        def _memo_valid(memo):
+            if len(memo["pids"]) != len(lookups):
+                return False
+            return all(
+                sch == msch and ((pids is None and mp is None)
+                                 or (pids is not None and mp is not None
+                                     and np.array_equal(pids, mp)))
+                for (pids, sch), mp, msch in zip(lookups, memo["pids"],
+                                                 memo["schemas"]))
+
+        with self._cache_lock:
+            memo = self._pack_layout_memo.get(ck)
+            if memo is not None and _memo_valid(memo):
+                self._pack_layout_memo[ck] = self._pack_layout_memo.pop(ck)
+            else:
+                memo = None
+        state = {"precorrected": True}
+        blocks = []
+        pids_by_shard = []
+        if memo is not None:
+            metrics_registry.counter("mesh_pack_memo_hits").increment()
+            for shard, (pids, schema_name), gids in zip(
+                    shards, lookups, memo["gids"]):
+                if pids is None:
+                    blocks.append((np.full((1, 1), PAD_TS, np.int32),
+                                   np.full((1, 1), np.nan), []))
+                    pids_by_shard.append(None)
+                    continue
+                pids_by_shard.append(pids)
+                ts_off, vals, vbase = gather_block(shard, pids,
+                                                   schema_name, state)
+                blocks.append((ts_off, vals, gids, vbase))
+            labels = memo["labels"]
+        else:
+            metrics_registry.counter("mesh_pack_memo_misses").increment()
+            registry = None
+            schemas_by_shard: List[Optional[str]] = []
+            for shard, (pids, schema_name) in zip(shards, lookups):
+                if pids is None:
+                    blocks.append((np.full((1, 1), PAD_TS, np.int32),
+                                   np.full((1, 1), np.nan), []))
+                    pids_by_shard.append(None)
+                    schemas_by_shard.append(None)
+                    continue
+                pids_by_shard.append(pids)
+                schemas_by_shard.append(schema_name)
+                ts_off, vals, vbase = gather_block(shard, pids,
+                                                   schema_name, state)
+                gids, registry = self._gids_for(shard, pids, by, without)
+                blocks.append((ts_off, vals, gids, vbase))
+            # Compact global registry slots to this query's groups only,
+            # so a narrow filter never emits phantom groups from earlier
+            # queries and num_groups (-> jit shapes) doesn't grow
+            # unboundedly.
+            labels = None
+            if registry is not None:
+                arrs = [b[2] for b in blocks
+                        if isinstance(b[2], np.ndarray)]
+                uniq = (np.unique(np.concatenate(arrs)) if arrs
+                        else np.zeros(0, dtype=np.int32))
+                labels = [registry.labels[int(g)] for g in uniq]
+                blocks = [(b[0], b[1],
+                           (np.searchsorted(uniq, b[2]).astype(np.int32)
+                            if isinstance(b[2], np.ndarray) else b[2]),
+                           *b[3:]) for b in blocks]
+            with self._cache_lock:
+                self._pack_layout_memo[ck] = {
+                    "pids": list(pids_by_shard),
+                    "gids": [(b[2] if isinstance(b[2], np.ndarray)
+                              else None) for b in blocks],
+                    "schemas": schemas_by_shard,
+                    "labels": labels}
+                while len(self._pack_layout_memo) > 8:
+                    self._pack_layout_memo.pop(
+                        next(iter(self._pack_layout_memo)))
+        precorrected = state["precorrected"]
         if len(blocks) > self.n_shard:
             raise ValueError(
                 f"memstore has {len(blocks)} shards but mesh shard axis is "
@@ -582,19 +842,6 @@ class MeshExecutor:
         while len(blocks) < self.n_shard:
             blocks.append((np.full((1, 1), PAD_TS, np.int32),
                            np.full((1, 1), np.nan), []))
-        # Compact global registry slots to this query's groups only, so a
-        # narrow filter never emits phantom groups from earlier queries
-        # and num_groups (-> jit shapes) doesn't grow unboundedly.
-        labels = None
-        if registry is not None:
-            arrs = [b[2] for b in blocks if isinstance(b[2], np.ndarray)]
-            uniq = (np.unique(np.concatenate(arrs)) if arrs
-                    else np.zeros(0, dtype=np.int32))
-            labels = [registry.labels[int(g)] for g in uniq]
-            blocks = [(b[0], b[1],
-                       (np.searchsorted(uniq, b[2]).astype(np.int32)
-                        if isinstance(b[2], np.ndarray) else b[2]),
-                       *b[3:]) for b in blocks]
         packed = pack_shards(blocks, by=by, without=without, base_ms=start_ms,
                              precorrected=precorrected, group_labels=labels)
         packed.pids_by_shard = pids_by_shard
@@ -700,17 +947,6 @@ class MeshExecutor:
                                             fn_name=fn_name, agg_op=op)
         return results
 
-    def _sel_dummy(self, n_time: int):
-        """Stacked [n_time, 8, 128] zeros standing in for the unused
-        selection matrices on the gather path, uploaded once."""
-        d = getattr(self, "_sel_dummy_dev", None)
-        if d is None or d.shape[0] != n_time:
-            d = jax.device_put(
-                np.zeros((n_time, 8, 128), np.float32),
-                NamedSharding(self.mesh, P("time", None, None)))
-            self._sel_dummy_dev = d
-        return d
-
     def _panel_groupings(self, packed: PackedShards, panels):
         """Per-panel (gids, G, op, gsize) + labels over the pack's rows —
         the host remap work run_agg_batch caches per (pack, panels)."""
@@ -804,14 +1040,19 @@ class MeshExecutor:
                              merged_key: Optional[Tuple] = None
                              ) -> Optional[List[np.ndarray]]:
         """sum/avg/count(rate|increase|delta|*_over_time) over a
-        uniform-grid pack via the Pallas MXU kernel (ops/pallas_fused.py)
-        composed inside shard_map: per-time-slice selection-matrix plans
-        shard over the 'time' axis, the kernel runs per shard device,
-        group sums psum over 'shard' — one HBM pass per device instead of
-        the general path's several.  NaN-holed (ragged) packs run the
-        kernel's valid-boundary variant with per-cell presence psum'd as
-        a second output (r4).  On a dense pack count needs NO device work
-        (identical per-window counts); avg divides sums by counts.
+        uniform-grid pack via PER-DEVICE dispatch of the single-chip MXU
+        kernel (ops/pallas_fused.py): device (s, t) runs the kernel over
+        its committed shard block with time-slice t's selection-matrix
+        plan, and only the [G] group partials merge across chips
+        (merge_device_partials — psum collective on ICI, host reduce
+        otherwise).  The kernel is NEVER traced inside shard_map: that
+        composition inverted the single-chip win ~30x (MULTICHIP_r05).
+        One HBM pass per device instead of the general path's several.
+        NaN-holed (ragged) packs run the kernel's valid-boundary variant
+        with per-cell presence merged as a second partial (r4).  On a
+        dense pack count needs NO device work (identical per-window
+        counts); avg divides sums by counts.  Backends without an MXU
+        dispatch ops/hostleaf per shard instead (same merge contract).
 
         kpanels: [(gids [D, S] int32 or None for the pack's own grouping,
         G, agg_op, gsize [G])] — multiple panels (run_agg_batch) merge
@@ -840,18 +1081,12 @@ class MeshExecutor:
         minsamp = 2 if fn_name in ("rate", "increase", "delta") else 1
         over_time = fn_name in pf.OVER_TIME_FNS
 
-        def host_counts(gsize, wvalid):
-            return gsize[:, None] * wvalid[None, :].astype(np.float64)
-
         out: List[Optional[np.ndarray]] = [None] * len(kpanels)
         # dense count panels: every REAL series emits a value exactly
         # where the shared window is valid — pure host math
         kidx = [i for i, (_, _, op, _) in enumerate(kpanels)
                 if not (op == "count" and dense)]
         if kidx:
-            interpret = jax.default_backend() != "tpu"
-            if interpret and not os.environ.get("FILODB_TPU_FUSED_INTERPRET"):
-                return None
             if fn_name in ("rate", "increase") and not packed.precorrected:
                 return None
             n_time = self.mesh.shape["time"]
@@ -873,8 +1108,24 @@ class MeshExecutor:
                     panels=max(len(kidx), 1),
                     gather=pf.gather_default(kind_k)) is None:
                 return None
-            # plan + device-mats cache: repeat queries (the pack-cache
-            # pattern) skip the host selection-matrix rebuild + 9 uploads
+            interpret = jax.default_backend() != "tpu"
+            if interpret and not os.environ.get("FILODB_TPU_FUSED_INTERPRET"):
+                # no MXU here: the per-device unit becomes the host fused
+                # leaf (ops/hostleaf), same dispatch + partial-merge shape
+                # — the single-chip cost-based router's host path scaled
+                # out over shards.  Ragged sets have no host variant.
+                host_out = self._run_agg_fused_host(
+                    packed, wends_p, W, range_ms, fn_name, kpanels, kidx)
+                if host_out is None:
+                    return None
+                for i, arr in zip(kidx, host_out):
+                    out[i] = arr
+                return self._finish_count_panels(packed, wends_p, W,
+                                                 range_ms, kpanels, out,
+                                                 minsamp)
+            # plan cache: per-time-slice plans; the per-(plan, device)
+            # selection-matrix uploads live in pallas_fused's own cache
+            # (plan_device_mats), keyed by these pinned plan objects
             plan_key = (packed.shared_ts_row.tobytes(), wends_p.tobytes(),
                         range_ms)
             from filodb_tpu.query.exec import _lru_touch
@@ -885,31 +1136,15 @@ class MeshExecutor:
                 plans = [pf.build_plan(
                     ts_row, wends_p[i * Wl:(i + 1) * Wl].astype(np.int64),
                     range_ms) for i in range(n_time)]
-                st = lambda a: np.stack([getattr(p, a) for p in plans])  # noqa: E731
-                mats = tuple(
-                    jax.device_put(st(a), NamedSharding(
-                        self.mesh, P("time", None, None)))
-                    for a in ("o1", "o2", "l1", "l2", "t1", "t2", "n",
-                              "wstart_x", "wend_x", "n1", "tsrow",
-                              "idx1", "idx2"))
-                wvalid = np.concatenate([p.wvalid for p in plans])
-                wvalid1 = np.concatenate([p.wvalid1 for p in plans])
-                ent = (mats, wvalid, wvalid1)
+                ent = (plans,
+                       np.concatenate([p.wvalid for p in plans]),
+                       np.concatenate([p.wvalid1 for p in plans]))
                 with self._cache_lock:
                     self._fused_plan_cache[plan_key] = ent
                     while len(self._fused_plan_cache) > 4:
                         self._fused_plan_cache.pop(
                             next(iter(self._fused_plan_cache)))
-            mats, wvalid, wvalid1 = ent
-            # the kernel's `n` slot carries TRUE counts for the over_time
-            # kinds and the rate family's clamped counts otherwise
-            mats = (mats[:6] + ((mats[9] if over_time else mats[6]),)
-                    + mats[7:9] + (mats[10], mats[11], mats[12]))
-            if pf.gather_default(fn_name if over_time else "rate_family"):
-                # gather mode never reads o1..l2: ship 4 KB dummies so
-                # each grid step skips ~1.5 MB of dead VMEM loads (same
-                # swap the leaf path does in _kernel_mats)
-                mats = (self._sel_dummy(n_time),) * 4 + mats[4:]
+            plans, wvalid, wvalid1 = ent
             vbase = packed.vbase
             if vbase is None:
                 vbase = jax.device_put(
@@ -949,24 +1184,64 @@ class MeshExecutor:
                             while len(self._batch_gid_cache) > 4:
                                 self._batch_gid_cache.pop(
                                     next(iter(self._batch_gid_cache)))
-            res = _mesh_fused_call(
-                self.mesh, packed.values, gids_dev, vbase, *mats,
-                G=Gtot, S=S, T=T, Tp=Tp,
-                is_counter=(fn_name in ("rate", "increase")),
-                is_rate=(fn_name == "rate"), interpret=interpret,
-                kind=(fn_name if over_time else "rate_family"),
-                ragged=ragged)
+            # per-device dispatch: device (s, t) runs the SINGLE-CHIP
+            # kernel over its committed shard block with time-slice t's
+            # plan — all D*n_time dispatches are issued before any
+            # result is touched, so the chips compute concurrently; only
+            # the [Gtot, Wlp] partials then merge (collective on ICI,
+            # host reduce otherwise).  The kernel never traces inside
+            # shard_map (the MULTICHIP_r05 30x inversion).
+            gather = pf.gather_default(kind_k)
+            is_counter = fn_name in ("rate", "increase")
+            vblocks = {s.device: s.data
+                       for s in packed.values.addressable_shards}
+            grid = self.mesh.devices
+            if any(dev not in vblocks for dev in grid.flat):
+                # multi-host mesh: remote devices' blocks are not
+                # addressable from this process, so per-device dispatch
+                # cannot read them — route the general SPMD path (the
+                # multi-host-correct shard_map composition) instead of
+                # raising a KeyError per query
+                from filodb_tpu.utils.metrics import registry
+                registry.counter("mesh_fused_unaddressable").increment()
+                return None
+            gblocks = {s.device: s.data
+                       for s in gids_dev.addressable_shards}
+            vbblocks = {s.device: s.data
+                        for s in vbase.addressable_shards}
+            parts_sums: Dict[Tuple[int, int], jax.Array] = {}
+            parts_cnts: Dict[Tuple[int, int], jax.Array] = {}
+            for si in range(D):
+                for ti in range(n_time):
+                    dev = grid[si, ti]
+                    mats_d = pf._kernel_mats(plans[ti], over_time, gather,
+                                             device=dev)
+                    res = _device_fused_call(
+                        vblocks[dev], gblocks[dev], vbblocks[dev],
+                        *mats_d, G=Gtot, S=S, T=T, Tp=Tp,
+                        is_counter=is_counter,
+                        is_rate=(fn_name == "rate"), interpret=interpret,
+                        kind=kind_k, ragged=ragged)
+                    if ragged:
+                        parts_sums[(si, ti)], parts_cnts[(si, ti)] = res
+                    else:
+                        parts_sums[(si, ti)] = res
+            merged = merge_device_partials(parts_sums, self.mesh, "sum")
 
             def unslice(a):
-                return np.asarray(a).reshape(Gtot, n_time, Wlp)[:, :, :Wl] \
+                return a.reshape(Gtot, n_time, Wlp)[:, :, :Wl] \
                     .reshape(Gtot, Wp)[:, :W]
 
             if ragged:
-                all_out, all_counts = unslice(res[0]), unslice(res[1])
+                all_out = unslice(merged)
+                all_counts = unslice(
+                    merge_device_partials(parts_cnts, self.mesh, "sum"))
             else:
-                all_out, all_counts = unslice(res), None
+                all_out, all_counts = unslice(merged), None
             from filodb_tpu.utils.metrics import registry
             registry.counter("mesh_fused_kernel").increment()
+            registry.counter("mesh_fused_perdevice_dispatches") \
+                .increment(D * n_time)
             if len(kidx) > 1:
                 registry.counter("mesh_fused_batch_panels") \
                     .increment(len(kidx))
@@ -975,9 +1250,9 @@ class MeshExecutor:
                 lo = offsets[j]
                 pout = all_out[lo:lo + G]
                 counts = (all_counts[lo:lo + G] if ragged
-                          else host_counts(gsize,
-                                           wvalid1 if over_time
-                                           else wvalid)[:, :W])
+                          else _host_counts(gsize,
+                                            wvalid1 if over_time
+                                            else wvalid)[:, :W])
                 if op == "count":             # ragged: kernel presence
                     out[i] = np.where(counts > 0,
                                       counts.astype(np.float64), np.nan)
@@ -987,6 +1262,17 @@ class MeshExecutor:
                         pout = np.asarray(pout, np.float64) \
                             / np.maximum(counts, 1.0)
                 out[i] = pf.present_sum(pout, counts)
+        return self._finish_count_panels(packed, wends_p, W, range_ms,
+                                         kpanels, out, minsamp)
+
+    def _finish_count_panels(self, packed: PackedShards,
+                             wends_p: np.ndarray, W: int, range_ms: int,
+                             kpanels, out: List[Optional[np.ndarray]],
+                             minsamp: int) -> List[np.ndarray]:
+        """Dense count panels: every REAL series emits a value exactly
+        where the shared window is valid — pure host math, no device
+        work (shared epilogue of the kernel and host dispatch routes)."""
+        from filodb_tpu.ops import pallas_fused as pf
         valid = None                          # panel-independent; lazy
         for i, (_, _, op, gsize) in enumerate(kpanels):
             if out[i] is None:                # dense count: host math
@@ -995,8 +1281,73 @@ class MeshExecutor:
                         packed.shared_ts_row.astype(np.int64),
                         wends_p[:W].astype(np.int64), range_ms)
                     valid = (n >= minsamp).astype(np.float64)
-                counts = host_counts(gsize, valid)
+                counts = _host_counts(gsize, valid)
                 from filodb_tpu.utils.metrics import registry
                 registry.counter("mesh_fused_count_host").increment()
                 out[i] = np.where(counts > 0, counts, np.nan)
         return out
+
+    def _host_plan(self, packed: PackedShards, wends_p: np.ndarray,
+                   W: int, range_ms: int):
+        """Full-grid FusedPlan for the host dispatch route, cached next
+        to the per-slice device plans."""
+        from filodb_tpu.ops import pallas_fused as pf
+        from filodb_tpu.query.exec import _lru_touch
+        plan_key = ("host", packed.shared_ts_row.tobytes(),
+                    wends_p[:W].tobytes(), range_ms)
+        with self._cache_lock:
+            plan = _lru_touch(self._fused_plan_cache, plan_key)
+        if plan is None:
+            plan = pf.build_plan(packed.shared_ts_row.astype(np.int64),
+                                 wends_p[:W].astype(np.int64), range_ms)
+            with self._cache_lock:
+                self._fused_plan_cache[plan_key] = plan
+                while len(self._fused_plan_cache) > 4:
+                    self._fused_plan_cache.pop(
+                        next(iter(self._fused_plan_cache)))
+        return plan
+
+    def _run_agg_fused_host(self, packed: PackedShards,
+                            wends_p: np.ndarray, W: int, range_ms: int,
+                            fn_name: Optional[str], kpanels, kidx
+                            ) -> Optional[List[np.ndarray]]:
+        """Per-shard HOST fused evaluation (ops/hostleaf) with the same
+        dispatch + partial-merge shape as the per-device kernel path —
+        the dispatch unit on backends without an MXU, mirroring the
+        single-chip cost-based router's host route.  Dense shared-grid
+        packs only (hostleaf has no ragged variant); partials merge in
+        ascending shard order via the sum combiner (ops/agg.reduce_phase
+        semantics).  Returns finished [G, W] arrays in kidx order, or
+        None to divert to the general path."""
+        if not packed.dense or packed.host_values is None:
+            return None
+        from filodb_tpu.ops import hostleaf
+        plan = self._host_plan(packed, wends_p, W, range_ms)
+        if plan.idx1 is None:
+            return None
+        hv = packed.host_values
+        hvb = packed.host_vbase
+        hg = packed.host_group_ids
+        outs: List[np.ndarray] = []
+        for i in kidx:
+            g, G, op, _ = kpanels[i]
+            comp = None
+            for d in range(hv.shape[0]):
+                nser = int(packed.n_series[d])
+                if nser == 0:
+                    continue
+                gids_d = (hg[d, :nser] if g is None
+                          else np.asarray(g[d, :nser]))
+                vb_d = None if hvb is None else hvb[d, :nser]
+                c = hostleaf.host_leaf_agg(plan, hv[d, :nser], vb_d,
+                                           gids_d, G, fn_name, op)
+                comp = c if comp is None else comp + c
+            if comp is None:
+                comp = np.zeros((G, W, 2))
+            s, cnt = comp[..., 0], comp[..., 1]
+            vals = s / np.maximum(cnt, 1.0) if op == "avg" else s
+            outs.append(np.where(cnt > 0, vals, np.nan))
+        from filodb_tpu.utils.metrics import registry
+        registry.counter("mesh_fused_host").increment()
+        registry.counter("mesh_partials_host_merge").increment()
+        return outs

@@ -81,6 +81,17 @@ def finish_fused_calls(calls: List[FusedCall]) -> List[AggPartial]:
         # histogram panels aggregate over (group, bucket) SLOTS
         return len(calls[i].gkeys) * calls[i].num_buckets
 
+    import time as _time
+
+    from filodb_tpu.utils.metrics import note_device_time
+    # two-phase execution: phase A dispatches every merged set's kernel
+    # work WITHOUT reading anything back, phase B synchronizes.  With
+    # sharded DeviceMirrors a multi-shard query's leaves hold their
+    # working sets on different chips — dispatching everything first
+    # lets those chips compute concurrently instead of serializing on
+    # each set's host readback (the per-device dispatch contract,
+    # doc/multichip.md).
+    pending = []
     for idxs in by_key.values():
         fc0 = calls[idxs[0]]
         while idxs:
@@ -126,20 +137,21 @@ def finish_fused_calls(calls: List[FusedCall]) -> List[AggPartial]:
                     .increment(launches)
                 registry.counter("fused_batch_merged_panels") \
                     .increment(len(take))
-            import time as _time
-
-            from filodb_tpu.utils.metrics import note_device_time
             _t0 = _time.perf_counter()
-            comps = pf.fused_leaf_agg_batch(
+            finisher = pf.fused_leaf_agg_batch(
                 fc0.plan, fc0.values, panels, fc0.fn,
                 precorrected=fc0.precorrected, interpret=fc0.interpret,
-                ragged=fc0.ragged, num_series=fc0.num_series)
-            for i, comp in zip(take, comps):
-                out[i] = _present(calls[i], comp)
-            # kernel dispatch + result readback (np conversion in _present
-            # synchronizes), attributed to the node that triggered it
-            note_device_time(_time.perf_counter() - _t0)
+                ragged=fc0.ragged, num_series=fc0.num_series, lazy=True)
+            pending.append((take, finisher, _time.perf_counter() - _t0))
             idxs = idxs[len(take):]
+    for take, finisher, disp_s in pending:
+        _t0 = _time.perf_counter()
+        comps = finisher()
+        for i, comp in zip(take, comps):
+            out[i] = _present(calls[i], comp)
+        # kernel dispatch + result readback (np conversion in _present
+        # synchronizes), attributed to the node that triggered it
+        note_device_time(disp_s + (_time.perf_counter() - _t0))
     for i, j in alias.items():
         src = out[j]
         out[i] = dataclasses.replace(src) if src is not None else None

@@ -618,12 +618,30 @@ class MultiSchemaPartitionsExec(LeafExecPlan):
             mirror = getattr(store, "device_mirror", None)
             if mirror is None:
                 from filodb_tpu.core.devicecache import (
-                    DEFAULT_HBM_LIMIT_BYTES, DeviceMirror)
+                    DEFAULT_HBM_LIMIT_BYTES, DeviceMirror,
+                    mirror_create_lock, placer, sharded_mirrors_enabled,
+                    store_nbytes)
                 limit = getattr(shard.config.store,
                                 "device_mirror_hbm_limit",
                                 DEFAULT_HBM_LIMIT_BYTES)
-                mirror = store.device_mirror = DeviceMirror(limit)
-                _note_mirror_limit(limit)
+                # sharded mirror mode: pin this shard's mirror to its
+                # placed device so the fused kernel dispatches THERE and
+                # multi-shard queries fan out across chips (the
+                # per-device dispatch contract, doc/multichip.md).
+                # Creation is serialized: concurrent first queries each
+                # calling placer.assign would double-book the device
+                # until GC collects the losing mirror.
+                with mirror_create_lock:
+                    mirror = getattr(store, "device_mirror", None)
+                    if mirror is None:
+                        device, est = None, 0
+                        if sharded_mirrors_enabled(shard.config.store):
+                            est = store_nbytes(store)
+                            device = placer.assign(self.shard, est, limit)
+                        mirror = store.device_mirror = DeviceMirror(
+                            limit, device=device, shard_num=self.shard,
+                            reserved_bytes=est)
+                        _note_mirror_limit(limit)
 
         # Mirror refresh (a full host->device upload) runs at most once per
         # query, under the write lock so it can't race a mutation; the

@@ -50,11 +50,22 @@ def pytest_configure(config):
         "(subprocess clusters, SIGKILL, wall-clock waits). Implies slow, "
         "so tier-1's -m 'not slow' excludes them; run explicitly with "
         "-m chaos or via `python bench.py chaos`.")
+    config.addinivalue_line(
+        "markers", "multichip: multi-device equivalence tests (per-device "
+        "fused dispatch, sharded DeviceMirror, partial merges). Auto-skip "
+        "below 2 local devices so tier-1 stays green on 1-device boxes; "
+        "this harness forces 8 virtual CPU devices, so they normally run.")
 
 
 def pytest_collection_modifyitems(config, items):
     # chaos implies slow: the tier-1 gate (-m 'not slow') must never pay
     # for subprocess spawn + SIGKILL + restart cycles
+    few_devices = jax.local_device_count() < 2
+    skip_multichip = pytest.mark.skip(
+        reason="multichip tests need >= 2 local devices "
+               "(XLA_FLAGS=--xla_force_host_platform_device_count=8)")
     for item in items:
         if "chaos" in item.keywords and "slow" not in item.keywords:
             item.add_marker(pytest.mark.slow)
+        if few_devices and "multichip" in item.keywords:
+            item.add_marker(skip_multichip)

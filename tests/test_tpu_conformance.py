@@ -21,6 +21,7 @@ import numpy as np
 import pytest
 
 from filodb_tpu.ops.counter import host_counter_correct
+from filodb_tpu.utils.jaxcompat import enable_x64
 from filodb_tpu.ops.rangefns import RANGE_FUNCTIONS, evaluate_range_function
 from filodb_tpu.ops.timewindow import (make_window_ends, series_value_base,
                                        to_offsets)
@@ -63,7 +64,7 @@ def _run_kernel_f32(ts, vals_abs, wends, fn, params=()):
     rebased = (v64 - vbase[:, None]).astype(np.float32)
     counts = np.full(S, T)
     ts_off = to_offsets(np.tile(ts, (S, 1)), counts, 0)
-    with jax.enable_x64(False):
+    with enable_x64(False):
         out = evaluate_range_function(
             jnp.asarray(ts_off), jnp.asarray(rebased),
             jnp.asarray(wends.astype(np.int32)), RANGE_MS, fn,
@@ -150,7 +151,7 @@ def test_naive_f32_rate_is_wrong_at_2_30():
     S = vals.shape[0]
     counts = np.full(S, T)
     ts_off = to_offsets(np.tile(ts, (S, 1)), counts, 0)
-    with jax.enable_x64(False):
+    with enable_x64(False):
         naive = np.asarray(evaluate_range_function(
             jnp.asarray(ts_off), jnp.asarray(vals.astype(np.float32)),
             jnp.asarray(WENDS.astype(np.int32)), RANGE_MS, "rate"))
@@ -179,7 +180,7 @@ def test_end_to_end_sum_rate_f32_large_counters():
 
     start_s = START_MS // 1000 + 600
     end_s = START_MS // 1000 + (T - 1) * 10
-    with jax.enable_x64(False):
+    with enable_x64(False):
         res = engine.query_range('sum(rate(request_total[5m]))',
                                  start_s, 60, end_s)
     assert res.error is None
@@ -216,7 +217,7 @@ def test_fused_kernel_f32_vs_oracle(base, fn):
     plan = build_plan(ts, WENDS, RANGE_MS)
     is_counter = fn in ("rate", "increase")
     reb, vbase = rebase_values(vals, is_counter)
-    with jax.enable_x64(False):
+    with enable_x64(False):
         sums, counts = fused_rate_groupsum(
             reb.astype(np.float32), vbase.astype(np.float32), gids, plan,
             G, fn_name=fn, precorrected=is_counter, interpret=True)

@@ -244,9 +244,11 @@ def section_shardmap(jax, jnp):
         "shardmap_samples_per_sec": round(span / m50, 1),
         "shardmap_overhead_vs_direct": round(m50 / d50, 3),
         "max_rel_err_vs_direct": round(err, 9),
-        "note": ("CPU interpret mode made fused-in-shard_map look 7.8x "
-                 "slower (MULTICHIP_r03); on real TPU the wrapper costs "
-                 "shardmap_overhead_vs_direct"),
+        "note": ("fused-in-shard_map is the LEGACY A/B probe: on the "
+                 "real 8-device mesh it inverted the single-chip win "
+                 "~30x (MULTICHIP_r05, warm 25.3s vs 0.88s general); "
+                 "production routes per-device dispatch + partial-only "
+                 "merges instead (doc/multichip.md, bench.py multichip)"),
     })
     persist()
 

@@ -1,0 +1,132 @@
+"""Round-over-round bench trend collection -> BENCH_TREND.json.
+
+The driver captures each round's one-line bench JSON inside a
+``BENCH_rNN.json`` artifact (shape ``{"n": round, "rc": .., "tail": ..}``
+— the line is the last JSON object in the tail).  This tool folds those
+artifacts into ``BENCH_TREND.json``'s ``rounds`` list so the trajectory
+of every headline metric is greppable in one file:
+
+  - the scan headline (``headline_samples_per_sec`` + p50/kernel/series)
+  - the ingest number (``ingest_samples_per_sec``, PR 1)
+  - the serving numbers (``concurrent_qps`` / ``cached_repoll_p50_s``,
+    PR 2; ``span_overhead_pct``, PR 3; ``ruler_*``, PR 5)
+  - the multi-chip fused-scan numbers (``multichip_fused_warm_s`` /
+    ``multichip_general_warm_s`` / ``multichip_scaling_x`` /
+    ``multichip_inversion_gone``, PR 6) — including a LOUD
+    ``multichip_error`` when a box that claims TPU exposed < 2 devices
+    (the bench stage fails rather than skips; the trend must show it).
+
+Existing hand-written round entries are MERGED, never clobbered: only
+missing keys are added, so curated notes survive re-runs.
+
+Usage:
+    python tools/trend.py            # print the merged trend to stdout
+    python tools/trend.py --write    # update BENCH_TREND.json in place
+"""
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# one-line keys carried into the trend, in display order
+CARRY = [
+    "platform", "value", "p50_query_latency_s", "kernel", "series",
+    "headline_stage", "vs_baseline",
+    "ingest_samples_per_sec",
+    "concurrent_qps", "cached_repoll_p50_s", "qps_vs_sequential",
+    "span_overhead_pct",
+    "ruler_eval_p50_s", "recorded_query_speedup_x", "ruler_overhead_pct",
+    "multichip_fused_warm_s", "multichip_general_warm_s",
+    "multichip_scaling_x", "multichip_inversion_gone",
+    "multichip_fused_route", "multichip_pack_memo_hits",
+    "multichip_error",
+]
+RENAME = {"value": "headline_samples_per_sec",
+          "p50_query_latency_s": "p50_s"}
+
+
+def parse_oneline(tail: str):
+    """Last parseable JSON object line in a driver artifact's tail."""
+    for line in reversed((tail or "").splitlines()):
+        line = line.strip()
+        if not (line.startswith("{") and line.endswith("}")):
+            continue
+        try:
+            doc = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(doc, dict) and ("metric" in doc or "value" in doc):
+            return doc
+    return None
+
+
+def collect_rounds(repo: str):
+    """{round: trend-entry} from every BENCH_rNN.json artifact."""
+    rounds = {}
+    for path in sorted(glob.glob(os.path.join(repo, "BENCH_r*.json"))):
+        m = re.match(r"BENCH_r(\d+)\.json$", os.path.basename(path))
+        if not m:
+            continue
+        try:
+            with open(path) as f:
+                art = json.load(f)
+        except ValueError:
+            continue
+        n = int(art.get("n", m.group(1)))
+        entry = {"round": n, "artifact": os.path.basename(path),
+                 "rc": art.get("rc")}
+        line = parse_oneline(art.get("tail", ""))
+        if line is None:
+            entry["note"] = "no parseable one-line JSON in artifact tail"
+        else:
+            for k in CARRY:
+                if k in line:
+                    entry[RENAME.get(k, k)] = line[k]
+        rounds[n] = entry
+    return rounds
+
+
+def merge(trend: dict, rounds: dict) -> dict:
+    """Fold collected rounds into the trend doc; hand keys win."""
+    have = {r.get("round"): r for r in trend.setdefault("rounds", [])}
+    for n in sorted(rounds):
+        if n in have:
+            for k, v in rounds[n].items():
+                have[n].setdefault(k, v)
+        else:
+            trend["rounds"].append(rounds[n])
+    trend["rounds"].sort(key=lambda r: (r.get("round") or 0))
+    return trend
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--repo", default=REPO)
+    ap.add_argument("--write", action="store_true",
+                    help="update BENCH_TREND.json in place (default: "
+                         "print the merged doc to stdout)")
+    args = ap.parse_args(argv)
+    path = os.path.join(args.repo, "BENCH_TREND.json")
+    trend = {}
+    if os.path.exists(path):
+        with open(path) as f:
+            trend = json.load(f)
+    merged = merge(trend, collect_rounds(args.repo))
+    out = json.dumps(merged, indent=1)
+    if args.write:
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(out + "\n")
+        os.replace(tmp, path)
+        print(f"wrote {path} ({len(merged['rounds'])} rounds)")
+    else:
+        print(out)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
