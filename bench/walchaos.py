@@ -1,0 +1,74 @@
+"""WAL kill-chaos child: ingest forever through the WAL, print each ack.
+
+The bench's durability proof (bench.py `measure_wal` / the `wal` stage)
+runs this as a REAL subprocess, SIGKILLs it mid-ingest, and then replays
+the WAL directory it left behind.  The parent is the "client": the only
+batches it counts as acknowledged are the ones whose `ACKED <batch>
+<seq>` line it read — printed strictly AFTER the group commit returned —
+so "zero acknowledged samples lost" is measured from the client's side
+of the ack, exactly the contract remote_write makes.
+
+Batches are DETERMINISTIC in (series, k, batch index): the parent
+regenerates the same grids to build the uninterrupted-run reference
+store and compares query results bit-for-bit against the recovered one.
+"""
+from __future__ import annotations
+
+import argparse
+import sys
+
+import numpy as np
+
+
+def chaos_batch(series: int, k: int, b: int, start_ms: int):
+    """Deterministic batch b: ts [S, k] and values [S, k] (shared with
+    the parent's reference-store rebuild — one formula, no drift)."""
+    ts_row = start_ms + (np.arange(k, dtype=np.int64) + b * k) * 10_000
+    ts = np.broadcast_to(ts_row, (series, k))
+    vals = (np.arange(series, dtype=np.float64)[:, None] * 3.0
+            + (np.arange(k, dtype=np.float64) + b * k)[None, :])
+    return ts, vals
+
+
+def chaos_keys(series: int):
+    from filodb_tpu.core.partkey import PartKey
+    return [PartKey.make("wal_chaos_total",
+                         {"_ws_": "chaos", "_ns_": "wal",
+                          "inst": f"i{i:05d}"})
+            for i in range(series)]
+
+
+START_MS = 1_600_000_000_000
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--wal-dir", required=True)
+    ap.add_argument("--dataset", default="prometheus")
+    ap.add_argument("--series", type=int, default=2048)
+    ap.add_argument("--k", type=int, default=2)
+    ap.add_argument("--max-batches", type=int, default=1_000_000)
+    args = ap.parse_args(argv)
+
+    from filodb_tpu.config import WalConfig
+    from filodb_tpu.core.memstore import TimeSeriesMemStore
+    from filodb_tpu.wal import WalManager
+
+    ms = TimeSeriesMemStore()
+    shard = ms.setup(args.dataset, 0)
+    wal = WalManager(args.wal_dir, args.dataset, WalConfig(enabled=True))
+    keys = chaos_keys(args.series)
+    print(f"CHAOS_READY series={args.series} k={args.k}", flush=True)
+    for b in range(args.max_batches):
+        ts, vals = chaos_batch(args.series, args.k, b, START_MS)
+        seq = wal.append_grid(0, "gauge", keys, ts, {"value": vals})
+        shard.ingest_columns("gauge", keys, ts, {"value": vals},
+                             offset=seq)
+        # the ack the parent counts: printed only after the group commit
+        # (wal.append_grid blocks on it) — the client-visible 2xx
+        print(f"ACKED {b} {seq}", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

@@ -118,6 +118,15 @@ class QueryConfig:
     tenant_limit_window_s: float = 60.0
     tenant_samples_warn_limit: int = 0
     tenant_samples_fail_limit: int = 0
+    # per-tenant INGEST admission (the write-side counterpart of the scan
+    # limits, enforced at every ingest door — remote_write, the Influx
+    # TCP gateway, the /influx endpoint): samples OFFERED per tenant over
+    # the same rolling tenant_limit_window_s window.  Over the limit,
+    # remote_write answers 429 + Retry-After (backpressure — a compliant
+    # client re-sends, nothing is silently lost); the TCP gateway, which
+    # has no reply channel, drops WITH per-reason accounting
+    # (`tenant_ingest_rejections` + the gateway drop log).  0 = no limit.
+    tenant_ingest_samples_limit: int = 0
 
 
 @dataclasses.dataclass
@@ -179,6 +188,36 @@ class BreakerConfig:
     open_base_s: float = 1.0
     open_max_s: float = 30.0
     jitter: float = 0.2
+
+
+@dataclasses.dataclass
+class WalConfig:
+    """Write-ahead log (filodb_tpu/wal/; doc/ingestion.md WAL section).
+
+    Every acknowledged ingest through a WAL-fronted door (remote_write)
+    is appended to a segmented on-disk log and group-committed BEFORE the
+    ack returns, so a crash between scrape and flush loses nothing —
+    replay on restart re-drives the same columnar ingest path (the
+    Gorilla checkpoint+log stance: the in-memory store is the serving
+    tier, the WAL makes it a system of record).  Segments rotate by size
+    and are tombstoned once the flush scheduler reports every shard's
+    checkpoint past the segment's last append."""
+    enabled: bool = False
+    # one subdirectory per dataset is created under this root
+    dir: str = ".filodb_wal"
+    # group-commit pacing: 0 commits as soon as there is uncommitted data
+    # (ack latency = one fsync; concurrent writers batch for free while
+    # the fsync runs).  > 0 additionally spaces fsyncs by this many ms —
+    # fewer, bigger commits at the cost of up to this much ack latency —
+    # unless commit_bytes of uncommitted appends force an early commit.
+    commit_interval_ms: float = 0.0
+    commit_bytes: int = 1 << 20
+    segment_max_bytes: int = 64 << 20
+    # False: group commit flushes to the OS page cache but skips fsync —
+    # survives process crash, not host crash (bench/CI on slow disks)
+    fsync: bool = True
+    # replay the log into the memstore before serving on boot
+    replay_on_start: bool = True
 
 
 @dataclasses.dataclass
@@ -261,6 +300,7 @@ class FilodbSettings:
     store: StoreConfig = dataclasses.field(default_factory=StoreConfig)
     breaker: BreakerConfig = dataclasses.field(default_factory=BreakerConfig)
     rules: RulesConfig = dataclasses.field(default_factory=RulesConfig)
+    wal: WalConfig = dataclasses.field(default_factory=WalConfig)
     shard_key_level_metrics: bool = True
     quota_default: int = 2_000_000_000
     reassignment_min_interval_s: float = 2 * 3600.0
@@ -295,7 +335,7 @@ class FilodbSettings:
                 raise ConfigError(f"{source}: {e}")
         for section, obj in (("query", self.query), ("store", self.store),
                              ("breaker", self.breaker),
-                             ("rules", self.rules)):
+                             ("rules", self.rules), ("wal", self.wal)):
             for k, v in (raw.pop(section, None) or {}).items():
                 _set_field(obj, k, v, f"{source}: {section}.{k}")
         if "spread_assignment" in raw:
@@ -340,7 +380,8 @@ class FilodbSettings:
             # durations ("30 minutes") and booleans behave identically
             from filodb_tpu.utils.hoconlite import _parse_scalar
             parsed = _parse_scalar(val)
-            for section in ("query_", "store_", "breaker_", "rules_"):
+            for section in ("query_", "store_", "breaker_", "rules_",
+                            "wal_"):
                 if rest.startswith(section):
                     overlay.setdefault(section[:-1], {})[
                         rest[len(section):]] = parsed

@@ -36,13 +36,21 @@ class FlushScheduler:
     previously `self.errors` was only an attribute nobody exported."""
 
     def __init__(self, memstore, dataset: str, interval_s: float = 60.0,
-                 headroom: bool = True, backoff_max_s: Optional[float] = None):
+                 headroom: bool = True, backoff_max_s: Optional[float] = None,
+                 wal=None):
         self.memstore = memstore
         self.dataset = dataset
         self.interval_s = interval_s
         self.headroom = headroom
         self.backoff_max_s = (8 * interval_s if backoff_max_s is None
                               else backoff_max_s)
+        # WAL manager (wal/WalManager) to report persisted append
+        # horizons to after each full rotation: every group checkpoint
+        # of a shard at or past offset X means all its WAL records with
+        # seq <= X are in the column store, so segments wholly below the
+        # min across shards are tombstoned (doc/operations.md WAL
+        # runbook).  None when the dataset is not WAL-fronted.
+        self.wal = wal
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self.flushes = 0
@@ -153,4 +161,21 @@ class FlushScheduler:
                             self.errors += 1
                             _log.exception("headroom task failed shard=%d",
                                            shard.shard_num)
+                if self.wal is not None:
+                    self._report_wal_horizons(shards)
             self._stop.wait(tick)
+
+    def _report_wal_horizons(self, shards) -> None:
+        """After a full rotation every group has had a flush pass: report
+        each shard's persisted horizon (min over its group checkpoints —
+        the only offset every group's data is guaranteed on disk past)
+        so the WAL can tombstone fully-covered segments."""
+        for shard in shards:
+            try:
+                horizon = shard.meta_store.read_earliest_checkpoint(
+                    self.dataset, shard.shard_num)
+                if horizon >= 0:
+                    self.wal.note_persisted(shard.shard_num, horizon)
+            except Exception:  # noqa: BLE001 — pruning is best-effort;
+                _log.exception("WAL horizon report failed shard=%d",
+                               shard.shard_num)

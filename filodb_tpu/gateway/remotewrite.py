@@ -1,0 +1,182 @@
+"""Prometheus remote_write ingest sink: protobuf series → columnar slabs.
+
+The planet-scale ingest protocol (the Cortex / Thanos-receive front
+door; FiloDB's gateway+Kafka layer in spirit, PAPER.md §1): snappy-
+compressed protobuf WriteRequests arrive at POST /api/v1/write
+(http/routes.py), decode via the shared prompb codec table
+(http/remotepb.py), and land here.  This sink's job is SHAPE: a request
+is a ragged bag of series with per-series sample lists, and the shard
+wants rectangular [S, k] grids (`TimeSeriesShard.ingest_columns`) — so
+series are grouped by (shard, sample-count) into RecordBatch.from_grid-
+shaped slabs and appended as whole matrices, never per-sample Python
+loops through the store.
+
+Durability: with a WAL attached (wal/WalManager), every slab is
+appended to the log first and the whole request waits for ONE group
+commit before any ack — a crash after the 2xx replays the same slabs
+through the same ingest_columns path on restart.
+
+Backpressure: the caller (routes.py) admits the request through
+usage.admit_ingest BEFORE decode work is spent on slab-building; over
+the per-tenant limit the request bounces with 429 + Retry-After, never
+a silent drop.
+"""
+from __future__ import annotations
+
+import logging
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from filodb_tpu.core.partkey import PartKey
+from filodb_tpu.core.schemas import Schemas, DEFAULT_SCHEMAS
+from filodb_tpu.parallel.shardmapper import ShardMapper, SpreadProvider
+from filodb_tpu.utils.metrics import registry as metrics_registry
+
+log = logging.getLogger("filodb.remotewrite")
+
+SCHEMA = "gauge"          # remote_write samples are untyped doubles; the
+                          # gauge schema is the Prometheus-wire-compatible
+                          # landing shape (counters still rate() correctly:
+                          # correction happens at query time)
+
+
+class RemoteWriteSink:
+    """series (decoded prompb TimeSeries) → WAL → shard-routed columnar
+    ingest.  One instance per dataset, shared across HTTP handler
+    threads (stateless apart from counters; shard ingest serializes
+    internally)."""
+
+    def __init__(self, memstore, dataset: str,
+                 mapper: Optional[ShardMapper] = None,
+                 spread_provider: Optional[SpreadProvider] = None,
+                 schemas: Schemas = DEFAULT_SCHEMAS, wal=None):
+        self.memstore = memstore
+        self.dataset = dataset
+        self.mapper = mapper
+        self.spread = spread_provider or SpreadProvider(0)
+        self.schemas = schemas
+        self.wal = wal
+
+    # ------------------------------------------------------------- ingest
+
+    def ingest_series(self, series) -> Tuple[int, int]:
+        """Ingest decoded remotepb.PromTimeSeries; returns (samples
+        ingested, samples dropped by the store — OOO/dup/quota).  Raises
+        WalWriteError when durability cannot be claimed (the route turns
+        it into a 503: the client must retry, the data was NOT acked)."""
+        slabs = self._build_slabs(series)
+        n = dropped = 0
+        # WAL appends go first WITHOUT waiting: the committer thread's
+        # flush+fsync overlaps the in-memory ingest below (both release
+        # the GIL), and ONE group-commit wait at the end covers every
+        # slab — the ack is still strictly after durability, and a crash
+        # in between leaves only unacknowledged in-memory samples the
+        # client will re-send
+        last_seq = -1
+        seqs = []
+        if self.wal is not None:
+            for shard_num, keys, ts, vals in slabs:
+                last_seq = self.wal.append_grid(
+                    shard_num, SCHEMA, keys, ts, {"value": vals},
+                    wait=False)
+                seqs.append(last_seq)
+        for i, (shard_num, keys, ts, vals) in enumerate(slabs):
+            shard = self.memstore.get_shard(self.dataset, shard_num)
+            if shard is None:
+                raise ConnectionError(
+                    f"remote_write: shard {shard_num} of "
+                    f"{self.dataset!r} is not locally owned")
+            offset = seqs[i] if self.wal is not None else -1
+            got = shard.ingest_columns(SCHEMA, keys, ts, {"value": vals},
+                                       offset=offset)
+            n += got
+            dropped += ts.size - got
+        if last_seq >= 0:
+            self.wal.commit(last_seq)
+        metrics_registry.counter("remote_write_samples",
+                                 dataset=self.dataset).increment(n)
+        return n, dropped
+
+    # -------------------------------------------------------- slab build
+
+    def _build_slabs(self, series
+                     ) -> List[Tuple[int, List[PartKey], np.ndarray,
+                                     np.ndarray]]:
+        """Group the request's series into rectangular (shard, keys,
+        ts [S, k], values [S, k]) slabs: one per (shard, sample-count)
+        pair, matching RecordBatch.from_grid's grid contract.  A scrape
+        push's natural shape — every series carrying the same k samples
+        — collapses to one slab per shard."""
+        part_schema = self.schemas.part
+        by_group: Dict[Tuple[int, int], List[Tuple[PartKey, list]]] = {}
+        for ts_msg in series:
+            if not ts_msg.samples:
+                continue
+            labels = dict(ts_msg.labels)
+            metric = labels.pop("__name__", "") or "_unnamed_"
+            pk = PartKey.make(metric, labels, part_schema)
+            if self.mapper is not None:
+                shard_num = self.mapper.ingestion_shard(
+                    pk.shard_key_hash(), pk.partition_hash(),
+                    self.spread.spread_for(pk.shard_key()))
+            else:
+                shard_num = 0
+            by_group.setdefault((shard_num, len(ts_msg.samples)),
+                                []).append((pk, ts_msg.samples))
+        slabs = []
+        for (shard_num, k), rows in by_group.items():
+            keys = [pk for pk, _ in rows]
+            # one [S, k, 2] pass over the decoded tuples, then split —
+            # the only per-sample cost is the protobuf decode itself
+            mat = np.asarray([samples for _, samples in rows],
+                             dtype=np.float64)          # [S, k, 2]
+            vals = np.ascontiguousarray(mat[:, :, 0])
+            ts = np.ascontiguousarray(mat[:, :, 1]).astype(np.int64)
+            slabs.append((shard_num, keys, ts, vals))
+        return slabs
+
+
+def admit_series(series, header_org: Optional[str], limit: int):
+    """Per-tenant ingest admission for a WriteRequest — the same ledger
+    (`usage.admit_ingest`) every other door runs.
+
+    Returns (admitted_series, retry_after_or_None, rejected_samples).
+    With an X-Scope-OrgID header ("ws" or "ws/ns", the Cortex
+    convention) the WHOLE request is one tenant.  Otherwise EVERY series
+    is admitted under its own `_ws_`/`_ns_` labels — admission keyed off
+    one representative series would let an over-limit tenant smuggle
+    samples behind a foreign first series.  Mixed requests keep the
+    admitted tenants' series; the caller still answers 429 when anything
+    was rejected (a resend's admitted-tenant duplicates drop in store
+    dedup, so nothing is lost OR double-counted in the store)."""
+    from filodb_tpu.utils.usage import usage
+    if not limit:
+        return list(series), None, 0
+    if header_org:
+        ws, _, ns = header_org.partition("/")
+        n = count_samples(series)
+        ra = usage.admit_ingest(ws, ns, n, limit)
+        return (list(series), None, 0) if ra is None else ([], ra, n)
+    groups: Dict[Tuple[str, str], list] = {}
+    for ts_msg in series:
+        labels = dict(ts_msg.labels)
+        tenant = (labels.get("_ws_", ""), labels.get("_ns_", ""))
+        g = groups.setdefault(tenant, [[], 0])
+        g[0].append(ts_msg)
+        g[1] += len(ts_msg.samples)
+    admitted: list = []
+    retry_after = None
+    rejected = 0
+    for (ws, ns), (ser, n) in groups.items():
+        ra = usage.admit_ingest(ws, ns, n, limit)
+        if ra is None:
+            admitted.extend(ser)
+        else:
+            rejected += n
+            retry_after = max(retry_after or 0.0, ra)
+    return admitted, retry_after, rejected
+
+
+def count_samples(series) -> int:
+    return sum(len(ts_msg.samples) for ts_msg in series)

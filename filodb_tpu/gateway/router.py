@@ -46,17 +46,40 @@ class GatewayPipeline:
 
     def __init__(self, memstore, dataset: str, mapper: ShardMapper,
                  spread_provider: Optional[SpreadProvider] = None,
-                 schemas: Schemas = DEFAULT_SCHEMAS):
+                 schemas: Schemas = DEFAULT_SCHEMAS,
+                 config=None):
         self.memstore = memstore
         self.dataset = dataset
         self.mapper = mapper
         self.spread = spread_provider or SpreadProvider(0)
         self.schemas = schemas
         self.lines_dropped = 0
+        # per-tenant ingest admission parity with the remote_write front
+        # door: no door bypasses the limits (utils/usage.admit_ingest)
+        if config is None:
+            from filodb_tpu.config import settings
+            config = settings()
+        self.ingest_limit = config.query.tenant_ingest_samples_limit
+        # WAL manager when this dataset is durability-fronted (attached
+        # by FiloServer; the remote_write sink built over this pipeline
+        # reads it)
+        self.wal = None
         # per-reason drop accounting + rate-limited warn (VERDICT r2
         # weak #6), shared with the decoupled sink (gateway/accounting.py)
         from filodb_tpu.gateway.accounting import DropLog
         self._drop_log = DropLog()
+        # per-THREAD retry hint: the pipeline is shared across HTTP
+        # handler threads, and instance-level state would let tenant A's
+        # all-rejected call read tenant B's reset (silent drop where the
+        # contract promises a 429) or vice versa
+        import threading
+        self._tls = threading.local()
+
+    @property
+    def last_retry_after(self):
+        """Retry-After seconds when THIS thread's last ingest_lines call
+        rejected records, else None."""
+        return getattr(self._tls, "retry_after", None)
 
     @property
     def drops(self) -> Dict[str, int]:
@@ -64,7 +87,12 @@ class GatewayPipeline:
 
     def ingest_lines(self, lines: Iterable[str],
                      now_ms: Optional[int] = None,
-                     offset: int = -1) -> int:
+                     offset: int = -1):
+        """Returns samples ingested.  Over-limit tenants' records drop
+        with accounting; `last_retry_after` carries the window-roll hint
+        for callers with a reply channel (the /influx HTTP endpoint
+        turns an everything-rejected call into 429 + Retry-After)."""
+        from filodb_tpu.gateway.accounting import admit_batch
         from filodb_tpu.gateway.influx import influx_lines_to_batches
         lines = list(lines)
         drops: Dict[str, int] = {}
@@ -72,8 +100,15 @@ class GatewayPipeline:
                                           drops=drops)
         n = 0
         got = 0
+        self._tls.retry_after = None
         for batch in batches:
             got += batch.num_records
+            batch, retry_after = admit_batch(batch, self.ingest_limit,
+                                             drops)
+            if retry_after is not None:
+                self._tls.retry_after = retry_after
+            if batch is None:
+                continue
             for shard_num, sub in split_batch_by_shard(
                     batch, self.mapper, self.spread).items():
                 shard = self.memstore.get_shard(self.dataset, shard_num)

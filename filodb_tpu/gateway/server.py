@@ -52,12 +52,22 @@ class KafkaContainerSink:
     def __init__(self, produce: Callable[[str, int, bytes], int],
                  topic: str, mapper: ShardMapper,
                  spread_provider: Optional[SpreadProvider] = None,
-                 schemas: Schemas = DEFAULT_SCHEMAS):
+                 schemas: Schemas = DEFAULT_SCHEMAS,
+                 config=None):
         self.produce = produce
         self.topic = topic
         self.mapper = mapper
         self.spread = spread_provider or SpreadProvider(0)
         self.schemas = schemas
+        # per-tenant ingest admission parity with the remote_write front
+        # door (utils/usage.admit_ingest): the TCP gateway has no reply
+        # channel, so over-limit tenants' records drop WITH accounting —
+        # `tenant_limit_exceeded` in the drop log plus the
+        # tenant_ingest_rejections counter — never silently
+        if config is None:
+            from filodb_tpu.config import settings
+            config = settings()
+        self.ingest_limit = config.query.tenant_ingest_samples_limit
         self.lines_in = 0
         self.records_out = 0
         self.frames_out = 0
@@ -67,12 +77,16 @@ class KafkaContainerSink:
     def publish_lines(self, lines: Iterable[str],
                       now_ms: Optional[int] = None) -> int:
         """Parse, route, and publish; returns records published."""
+        from filodb_tpu.gateway.accounting import admit_batch
         lines = list(lines)
         drops: Dict[str, int] = {}
         batches = influx_lines_to_batches(lines, self.schemas, now_ms,
                                           drops=drops)
         published = 0
         for batch in batches:
+            batch, _retry = admit_batch(batch, self.ingest_limit, drops)
+            if batch is None:
+                continue
             for shard_num, sub in split_batch_by_shard(
                     batch, self.mapper, self.spread).items():
                 self.produce(self.topic, shard_num, sub.to_bytes())

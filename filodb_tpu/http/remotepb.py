@@ -1,11 +1,12 @@
-"""Prometheus remote-read protobuf messages, hand-coded wire format.
+"""Prometheus remote read/write protobuf messages, hand-coded wire format.
 
-Implements exactly the prompb subset the remote-read endpoint needs
-(ref: prometheus/src/main/java/remote/RemoteStorage.java — ReadRequest /
-ReadResponse and friends; http/.../PrometheusApiRoute.scala:37-62 drives
-them).  The wire format is standard protobuf encoding (varint keys,
-length-delimited submessages); coding it directly keeps the dependency
-surface at zero and the schema auditable in one file.
+Implements exactly the prompb subset the remote-read AND remote-write
+endpoints need (ref: prometheus/src/main/java/remote/RemoteStorage.java —
+ReadRequest / ReadResponse and friends; http/.../PrometheusApiRoute.scala:
+37-62 drives remote-read; the write half is the Cortex / Thanos-receive
+front-door contract).  The wire format is standard protobuf encoding
+(varint keys, length-delimited submessages); coding it directly keeps the
+dependency surface at zero and the schema auditable in one file.
 
 Message numbering matches prompb/remote.proto + prompb/types.proto:
 
@@ -16,9 +17,18 @@ Message numbering matches prompb/remote.proto + prompb/types.proto:
                  string name = 2; string value = 3; }
   ReadResponse { repeated QueryResult results = 1; }
   QueryResult  { repeated TimeSeries timeseries = 1; }
+  WriteRequest { repeated TimeSeries timeseries = 1; }
   TimeSeries   { repeated Label labels = 1; repeated Sample samples = 2; }
   Label        { string name = 1; string value = 2; }
   Sample       { double value = 1; int64 timestamp = 2; }
+
+Label / Sample / TimeSeries appear on BOTH directions of BOTH protocols
+(read responses carry them out, write requests carry them in), so their
+encoders/decoders live in one codec table (CODECS) that the request/
+response-level functions compose — one wire implementation per message,
+never a read-side and a write-side copy drifting apart (see
+tests/test_remote_write.py::test_codec_table_parity for the enforced
+encode/decode parity against hand-built wire fixtures).
 """
 from __future__ import annotations
 
@@ -73,23 +83,11 @@ def _ld(field: int, payload: bytes) -> bytes:
     return _key(field, 2) + _uvarint(len(payload)) + payload
 
 
-def _skip(data: bytes, pos: int, wire: int) -> int:
-    if wire == 0:
-        _, pos = _read_uvarint(data, pos)
-    elif wire == 1:
-        pos += 8
-    elif wire == 2:
-        ln, pos = _read_uvarint(data, pos)
-        pos += ln
-    elif wire == 5:
-        pos += 4
-    else:
-        raise ValueError(f"unsupported wire type {wire}")
-    return pos
-
-
 def _fields(data: bytes):
-    """Iterate (field_num, wire_type, value, next_pos) over a message."""
+    """Iterate (field_num, wire_type, value) over a message.  Raises
+    ValueError on truncation: a length-delimited field promising bytes
+    past the end must fail decode loudly (a real protobuf parser's
+    behavior), never yield a silently-shortened value."""
     pos = 0
     n = len(data)
     while pos < n:
@@ -98,13 +96,19 @@ def _fields(data: bytes):
         if wire == 0:
             v, pos = _read_uvarint(data, pos)
         elif wire == 1:
+            if pos + 8 > n:
+                raise ValueError("truncated fixed64 field")
             v = data[pos:pos + 8]
             pos += 8
         elif wire == 2:
             ln, pos = _read_uvarint(data, pos)
+            if pos + ln > n:
+                raise ValueError("truncated length-delimited field")
             v = data[pos:pos + ln]
             pos += ln
         elif wire == 5:
+            if pos + 4 > n:
+                raise ValueError("truncated fixed32 field")
             v = data[pos:pos + 4]
             pos += 4
         else:
@@ -112,7 +116,73 @@ def _fields(data: bytes):
         yield field, wire, v
 
 
-# -------------------------------------------------------------- decoding
+# ------------------------------------------------- shared message codecs
+#
+# Each codec is an (encode, decode) pair over the Python-native shape the
+# rest of the codebase consumes: Label <-> (name, value), Sample <->
+# (value, ts_ms), TimeSeries <-> PromTimeSeries.  Both the read and the
+# write protocol compose exclusively these for the shared messages.
+
+def encode_label(pair: Tuple[str, str]) -> bytes:
+    name, value = pair
+    return _ld(1, name.encode("utf-8")) + _ld(2, value.encode("utf-8"))
+
+
+def decode_label(data: bytes) -> Tuple[str, str]:
+    name, value = "", ""
+    for field, wire, v in _fields(data):
+        if field == 1 and wire == 2:
+            name = v.decode("utf-8")
+        elif field == 2 and wire == 2:
+            value = v.decode("utf-8")
+    return name, value
+
+
+def encode_sample(sample: Tuple[float, int]) -> bytes:
+    value, ts = sample
+    return _key(1, 1) + struct.pack("<d", value) + _key(2, 0) + _varint64(ts)
+
+
+def decode_sample(data: bytes) -> Tuple[float, int]:
+    value, ts = 0.0, 0
+    for field, wire, v in _fields(data):
+        if field == 1 and wire == 1:
+            value = struct.unpack("<d", v)[0]
+        elif field == 2 and wire == 0:
+            ts = _to_int64(v)
+    return value, ts
+
+
+def encode_timeseries(ts: PromTimeSeries) -> bytes:
+    body = bytearray()
+    for pair in ts.labels:
+        body += _ld(1, encode_label(pair))
+    for sample in ts.samples:
+        body += _ld(2, encode_sample(sample))
+    return bytes(body)
+
+
+def decode_timeseries(data: bytes) -> PromTimeSeries:
+    labels, samples = [], []
+    for field, wire, v in _fields(data):
+        if field == 1 and wire == 2:
+            labels.append(decode_label(v))
+        elif field == 2 and wire == 2:
+            samples.append(decode_sample(v))
+    return PromTimeSeries(labels, samples)
+
+
+# the one codec table shared by remote-read and remote-write: message
+# name -> (encode, decode).  Request/response functions below never
+# hand-roll these messages.
+CODECS = {
+    "Label": (encode_label, decode_label),
+    "Sample": (encode_sample, decode_sample),
+    "TimeSeries": (encode_timeseries, decode_timeseries),
+}
+
+
+# -------------------------------------------------- remote-read messages
 
 def _decode_matcher(data: bytes) -> LabelMatcher:
     t, name, value = EQ, "", ""
@@ -146,32 +216,6 @@ def decode_read_request(data: bytes) -> List[PromQuery]:
     return queries
 
 
-def _decode_sample(data: bytes) -> Tuple[float, int]:
-    value, ts = 0.0, 0
-    for field, wire, v in _fields(data):
-        if field == 1 and wire == 1:
-            value = struct.unpack("<d", v)[0]
-        elif field == 2 and wire == 0:
-            ts = _to_int64(v)
-    return value, ts
-
-
-def _decode_timeseries(data: bytes) -> PromTimeSeries:
-    labels, samples = [], []
-    for field, wire, v in _fields(data):
-        if field == 1 and wire == 2:
-            name, value = "", ""
-            for f2, w2, v2 in _fields(v):
-                if f2 == 1 and w2 == 2:
-                    name = v2.decode("utf-8")
-                elif f2 == 2 and w2 == 2:
-                    value = v2.decode("utf-8")
-            labels.append((name, value))
-        elif field == 2 and wire == 2:
-            samples.append(_decode_sample(v))
-    return PromTimeSeries(labels, samples)
-
-
 def decode_read_response(data: bytes) -> List[List[PromTimeSeries]]:
     results = []
     for field, wire, v in _fields(data):
@@ -179,12 +223,10 @@ def decode_read_response(data: bytes) -> List[List[PromTimeSeries]]:
             series = []
             for f2, w2, v2 in _fields(v):
                 if f2 == 1 and w2 == 2:
-                    series.append(_decode_timeseries(v2))
+                    series.append(decode_timeseries(v2))
             results.append(series)
     return results
 
-
-# -------------------------------------------------------------- encoding
 
 def encode_read_request(queries: List[PromQuery]) -> bytes:
     out = bytearray()
@@ -203,17 +245,6 @@ def encode_read_request(queries: List[PromQuery]) -> bytes:
     return bytes(out)
 
 
-def encode_timeseries(ts: PromTimeSeries) -> bytes:
-    body = bytearray()
-    for name, value in ts.labels:
-        lb = _ld(1, name.encode("utf-8")) + _ld(2, value.encode("utf-8"))
-        body += _ld(1, lb)
-    for value, t in ts.samples:
-        sb = _key(1, 1) + struct.pack("<d", value) + _key(2, 0) + _varint64(t)
-        body += _ld(2, sb)
-    return bytes(body)
-
-
 def encode_read_response(results: List[List[PromTimeSeries]]) -> bytes:
     out = bytearray()
     for series_list in results:
@@ -221,4 +252,25 @@ def encode_read_response(results: List[List[PromTimeSeries]]) -> bytes:
         for ts in series_list:
             qr += _ld(1, encode_timeseries(ts))
         out += _ld(1, bytes(qr))
+    return bytes(out)
+
+
+# ------------------------------------------------- remote-write messages
+
+def decode_write_request(data: bytes) -> List[PromTimeSeries]:
+    """WriteRequest { repeated TimeSeries timeseries = 1; } — the body a
+    Prometheus/Grafana-agent/Cortex-shaped client POSTs (after snappy
+    decompression) to /api/v1/write.  Unknown fields (metadata = 3,
+    exemplars inside TimeSeries) are skipped per proto3 semantics."""
+    series = []
+    for field, wire, v in _fields(data):
+        if field == 1 and wire == 2:
+            series.append(decode_timeseries(v))
+    return series
+
+
+def encode_write_request(series: List[PromTimeSeries]) -> bytes:
+    out = bytearray()
+    for ts in series:
+        out += _ld(1, encode_timeseries(ts))
     return bytes(out)

@@ -76,12 +76,16 @@ class PromHttpApi:
         import threading as _threading
         self._jit_cache_sizes: Dict[str, int] = {}
         self._jit_lock = _threading.Lock()
+        # remote_write sinks, built lazily per dataset (the WAL manager
+        # is attached to the gateway pipeline after construction)
+        self._rw_sinks: Dict[str, object] = {}
 
     # ------------------------------------------------------------ dispatch
 
     def handle(self, method: str, path: str, params: Dict[str, str],
                body: bytes = b"",
-               multi_params: Optional[Dict[str, List[str]]] = None
+               multi_params: Optional[Dict[str, List[str]]] = None,
+               headers: Optional[Dict[str, str]] = None
                ) -> Tuple[int, object]:
         parts = [p for p in path.split("/") if p]
         multi = multi_params or {k: [v] for k, v in params.items()}
@@ -93,12 +97,12 @@ class PromHttpApi:
             if parts[:1] == ["promql"] and len(parts) >= 4 \
                     and parts[2] == "api" and parts[3] == "v1":
                 return self._api_v1(parts[1], parts[4:], method, params,
-                                    body, multi)
+                                    body, multi, headers)
             if parts[:2] == ["api", "v1"]:
                 if self.default_dataset is None:
                     return 404, _err("no datasets registered")
                 return self._api_v1(self.default_dataset, parts[2:], method,
-                                    params, body, multi)
+                                    params, body, multi, headers)
             if parts[:1] == ["cluster"] and len(parts) >= 3 \
                     and parts[2] == "status":
                 return self._cluster_status(parts[1])
@@ -136,10 +140,14 @@ class PromHttpApi:
 
     def _api_v1(self, dataset: str, rest: List[str], method: str,
                 params: Dict[str, str], body: bytes,
-                multi: Dict[str, List[str]]) -> Tuple[int, object]:
+                multi: Dict[str, List[str]],
+                headers: Optional[Dict[str, str]] = None
+                ) -> Tuple[int, object]:
         eng = self.engines.get(dataset)
         if eng is None:
             return 404, _err(f"dataset {dataset!r} not found")
+        if rest == ["write"] and method == "POST":
+            return self._remote_write_ingest(dataset, body, headers or {})
         planner_params = _planner_params(params, self._qconfig)
         if rest == ["query_range"]:
             q = params.get("query", "")
@@ -241,6 +249,94 @@ class PromHttpApi:
         if rest == ["status", "runtimeinfo"]:
             return self._runtimeinfo()
         return 404, _err(f"unknown api/v1 endpoint {'/'.join(rest)}")
+
+    # -------------------------------------------------------- remote write
+
+    def _remote_write_ingest(self, dataset: str, body: bytes,
+                             headers: Dict[str, str]) -> Tuple[int, object]:
+        """POST /api/v1/write — the Prometheus remote_write front door
+        (snappy-compressed protobuf WriteRequest; the Cortex /
+        Thanos-receive ingest contract).  Pipeline: snappy block
+        decompress → shared prompb codec decode → per-tenant admission →
+        WAL group commit (when configured) → rectangular columnar slabs
+        into `ingest_columns` (gateway/remotewrite.RemoteWriteSink).
+        Responses: 204 on success (the Prometheus client contract is any
+        2xx), 400 on malformed payloads, 429 + Retry-After when the
+        tenant's rolling ingest window is over its limit (backpressure —
+        the client re-sends, nothing is silently dropped), 503 when the
+        WAL cannot claim durability (ack withheld, client must retry)."""
+        from filodb_tpu.http import remotepb
+        from filodb_tpu.utils import snappy
+        from filodb_tpu.utils.metrics import registry
+        from filodb_tpu.utils.usage import usage
+        from filodb_tpu.gateway.remotewrite import (admit_series,
+                                                    count_samples)
+        registry.counter("remote_write_requests",
+                         dataset=dataset).increment()
+        try:
+            series = remotepb.decode_write_request(snappy.decompress(body))
+        except (ValueError, IndexError, struct.error) as e:
+            # truncated/garbled snappy or protobuf bytes: the client's
+            # fault, counted and answered 400 like any bad payload
+            registry.counter("remote_write_bad_payloads",
+                             dataset=dataset).increment()
+            raise _BadRequest(f"bad remote-write payload: {e}")
+        if count_samples(series) == 0:
+            return 204, {}
+        org = next((v for k, v in headers.items()
+                    if k.lower() == "x-scope-orgid"), None)
+        # PER-TENANT admission over every series in the request (header
+        # org = one tenant for the whole request): an over-limit tenant
+        # must not ride in behind another tenant's series
+        admitted, retry_after, rejected = admit_series(
+            series, org, self._qconfig.tenant_ingest_samples_limit)
+        if admitted:
+            sink = self._remote_write_sink(dataset)
+            from filodb_tpu.wal import WalWriteError
+            try:
+                sink.ingest_series(admitted)
+            except WalWriteError as e:
+                # durability could not be claimed: withhold the ack — a
+                # compliant remote_write client retries 5xx with backoff
+                return 503, {"status": "error",
+                             "errorType": "unavailable",
+                             "error":
+                                 f"write-ahead log commit failed: {e}"}
+        if rejected:
+            # anything rejected makes the WHOLE response a 429 so the
+            # client re-sends (never a silent drop): the re-send's
+            # already-admitted samples are same-timestamp duplicates the
+            # store drops, the rejected tenant's land after Retry-After
+            registry.counter("remote_write_rejected",
+                             dataset=dataset).increment()
+            return 429, {
+                "status": "error", "errorType": "too_many_requests",
+                "error": (f"{rejected} samples over a tenant ingest "
+                          f"limit "
+                          f"({self._qconfig.tenant_ingest_samples_limit}"
+                          f" samples per {usage.window_s:g}s window) — "
+                          f"retry after the window rolls"),
+                "_headers": {"Retry-After":
+                             str(max(1, int(-(-retry_after // 1))))}}
+        return 204, {}
+
+    def _remote_write_sink(self, dataset: str):
+        """Lazily-built RemoteWriteSink per dataset, assembled from the
+        dataset's gateway pipeline (memstore/mapper/spread/schemas + the
+        WAL manager FiloServer attached when wal.enabled)."""
+        sink = self._rw_sinks.get(dataset)
+        if sink is None:
+            gw = self.gateways.get(dataset)
+            if gw is None:
+                raise _BadRequest(
+                    f"no ingestion pipeline for dataset {dataset!r}")
+            from filodb_tpu.gateway.remotewrite import RemoteWriteSink
+            sink = RemoteWriteSink(
+                gw.memstore, dataset, mapper=gw.mapper,
+                spread_provider=gw.spread, schemas=gw.schemas,
+                wal=getattr(gw, "wal", None))
+            self._rw_sinks[dataset] = sink
+        return sink
 
     # --------------------------------------------------------- remote read
 
@@ -718,7 +814,18 @@ class PromHttpApi:
         if gateway is None:
             return 404, _err(f"no gateway for dataset {dataset!r}")
         lines = body.decode("utf-8", errors="replace").splitlines()
-        gateway.ingest_lines(lines)
+        n = gateway.ingest_lines(lines)
+        retry_after = gateway.last_retry_after
+        if n == 0 and retry_after is not None:
+            # every record bounced off the per-tenant ingest limit: this
+            # door HAS a reply channel, so backpressure like the
+            # remote_write front door instead of a silent drop
+            return 429, {
+                "status": "error", "errorType": "too_many_requests",
+                "error": "tenant ingest limit exceeded — retry after "
+                         "the window rolls",
+                "_headers": {"Retry-After":
+                             str(max(1, int(-(-retry_after // 1))))}}
         return 204, {}
 
 

@@ -31,18 +31,35 @@ class FiloHttpServer:
                 body = self.rfile.read(length) if length else b""
                 # form-decode only for the API routes: write endpoints
                 # (/influx, /admin) carry raw line-protocol / text bodies
-                # even when clients default the form content-type
+                # even when clients default the form content-type.  The
+                # BINARY api/v1 endpoints (remote read/write: snappy
+                # protobuf) are excluded too — simple clients POST them
+                # with the default form content-type, and utf-8-decoding
+                # compressed bytes must be a clean 400 at worst, never a
+                # crashed handler
                 if method == "POST" and body and \
                         parsed.path.startswith(("/promql", "/api")) and \
+                        not parsed.path.endswith(("/read", "/write")) and \
                         self.headers.get("Content-Type", "").startswith(
                             "application/x-www-form-urlencoded"):
-                    form_multi = urllib.parse.parse_qs(body.decode())
+                    try:
+                        form_multi = urllib.parse.parse_qs(body.decode())
+                    except UnicodeDecodeError:
+                        self.send_response(400)
+                        blob = (b'{"status":"error","errorType":"bad_data",'
+                                b'"error":"form-encoded body is not utf-8"}')
+                        self.send_header("Content-Type", "application/json")
+                        self.send_header("Content-Length", str(len(blob)))
+                        self.end_headers()
+                        self.wfile.write(blob)
+                        return
                     form = {k: v[-1] for k, v in form_multi.items()}
                     params = {**form, **params}
                     multi = {**form_multi, **multi}
                     body = b""
                 status, payload = api_ref.handle(method, parsed.path, params,
-                                                 body, multi_params=multi)
+                                                 body, multi_params=multi,
+                                                 headers=dict(self.headers))
                 extra_headers = {}
                 if isinstance(payload, bytes):      # binary (remote-read)
                     blob = payload

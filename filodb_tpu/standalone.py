@@ -67,6 +67,7 @@ class FiloServer:
         self.gateways: Dict[str, GatewayPipeline] = {}
         self.ds_stores: Dict[str, object] = {}
         self.flush_schedulers: Dict[str, object] = {}
+        self.wals: Dict[str, object] = {}
         self._earliest_cache: Dict[str, tuple] = {}
         # observability singletons take their knobs from THIS server's
         # settings: the slow-query flight recorder (ring size, JSONL
@@ -158,7 +159,23 @@ class FiloServer:
                                             planner=planner,
                                             config=self.config)
         self.gateways[dc.name] = GatewayPipeline(self.memstore, dc.name,
-                                                 mapper, spread)
+                                                 mapper, spread,
+                                                 config=self.config)
+        if self.config.wal.enabled:
+            # durability front: the remote_write door appends through
+            # this manager and acks only after the group commit; boot
+            # replays the log through the same columnar ingest path
+            # BEFORE the HTTP server opens (filodb_tpu/wal)
+            from filodb_tpu.wal import WalManager
+            wal = WalManager(self.config.wal.dir, dc.name,
+                             config=self.config.wal)
+            self.wals[dc.name] = wal
+            self.gateways[dc.name].wal = wal
+            if self.config.wal.replay_on_start:
+                restart_points = {
+                    s: self.meta_store.read_earliest_checkpoint(dc.name, s)
+                    for s in range(dc.num_shards)}
+                wal.replay(self.memstore, restart_points)
 
     def _with_downsample(self, dc: DatasetConfig, mapper: ShardMapper,
                          raw_planner: SingleClusterPlanner):
@@ -269,7 +286,8 @@ class FiloServer:
             for dc in self.datasets:
                 sched = FlushScheduler(
                     self.memstore, dc.name,
-                    interval_s=self.config.store.flush_interval_ms / 1000.0)
+                    interval_s=self.config.store.flush_interval_ms / 1000.0,
+                    wal=self.wals.get(dc.name))
                 self.flush_schedulers[dc.name] = sched.start()
         if self.ruler is not None:
             self.ruler.start()
@@ -284,6 +302,9 @@ class FiloServer:
             self.trace_exporter.stop()
             self.trace_exporter = None
         self.http.stop()
+        for wal in self.wals.values():
+            wal.close()
+        self.wals.clear()
 
     def flush_and_downsample(self, dataset: str) -> int:
         """Flush all shards, then feed accumulated downsample records into

@@ -23,6 +23,13 @@ Catalog (the production call sites):
     cluster.heartbeat — NodeAgent heartbeat RPC (parallel/cluster.py)
     ruler.notify      — alert webhook delivery attempt
                         (rules/notifier.py; retry/backoff chaos)
+    wal.append        — WAL record framing/enqueue, before the bytes
+                        reach the segment file (wal/writer.py)
+    wal.fsync         — group commit, before the fsync that makes the
+                        batch durable (wal/writer.py; a failure here
+                        must fail every writer waiting on the group)
+    wal.replay        — per decoded record during restart replay
+                        (wal/replay.py; corrupt-mid-log chaos)
 
 Plan kinds and how they surface at the call site:
 
@@ -59,6 +66,7 @@ from typing import Dict, List, Optional
 POINTS = frozenset({
     "transport.send", "transport.recv", "flush.persist", "device.upload",
     "ingest.batch", "cluster.heartbeat", "ruler.notify",
+    "wal.append", "wal.fsync", "wal.replay",
 })
 
 KINDS = frozenset({"error", "delay", "drop", "corrupt"})

@@ -32,7 +32,8 @@ TenantKey = Tuple[str, str]                 # (_ws_, _ns_)
 class _Tenant:
     __slots__ = ("queries", "query_seconds", "samples_scanned",
                  "result_bytes", "ingest_samples", "rejected",
-                 "win_start", "win_samples", "win_warned")
+                 "win_start", "win_samples", "win_warned",
+                 "ingest_rejected", "ingest_win_start", "win_ingest")
 
     def __init__(self):
         self.queries = 0
@@ -44,6 +45,11 @@ class _Tenant:
         self.win_start = time.monotonic()
         self.win_samples = 0
         self.win_warned = False
+        # write-side rolling window (admit_ingest): samples OFFERED this
+        # window + rejections, independent of the scan-limit window
+        self.ingest_rejected = 0
+        self.ingest_win_start = time.monotonic()
+        self.win_ingest = 0
 
 
 # tenants past the cap fold into this sentinel row: query text is
@@ -89,10 +95,15 @@ class UsageAccountant:
         return t
 
     def _roll(self, t: _Tenant, now: float) -> None:
+        """Roll BOTH rolling windows (scan + ingest) when expired — the
+        one place window state resets."""
         if now - t.win_start >= self.window_s:
             t.win_start = now
             t.win_samples = 0
             t.win_warned = False
+        if now - t.ingest_win_start >= self.window_s:
+            t.ingest_win_start = now
+            t.win_ingest = 0
 
     # ----------------------------------------------------------- account
 
@@ -167,6 +178,40 @@ class UsageAccountant:
                     f"rolls")
         return None
 
+    def admit_ingest(self, ws: str, ns: str, samples: int,
+                     fail_limit: int) -> Optional[float]:
+        """Write-side admission at every ingest door (remote_write, the
+        Influx TCP gateway, /influx): None admits `samples` and books
+        them against the tenant's rolling ingest window; a float rejects
+        and is the seconds until the window rolls — remote_write turns
+        it into `429` + `Retry-After` (backpressure: the client re-sends,
+        nothing is silently dropped).  Like the scan limits, the batch
+        that CROSSES the line still lands (limits bound the window's
+        cumulative offer, not predict a batch's size); everything after
+        it bounces until the window resets."""
+        if not fail_limit:
+            return None
+        if ws in INTERNAL_WORKSPACES:
+            return None
+        from filodb_tpu.utils.metrics import registry
+        now = time.monotonic()
+        with self._lock:
+            ws, ns = self.resolve(ws, ns)
+            t = self._get((ws, ns))
+            self._roll(t, now)
+            if t.win_ingest > fail_limit:
+                t.ingest_rejected += 1
+                retry_after = max(
+                    self.window_s - (now - t.ingest_win_start), 0.001)
+            else:
+                t.win_ingest += samples
+                retry_after = None
+        if retry_after is not None:
+            registry.counter("tenant_ingest_rejections", ws=ws,
+                             ns=ns).increment()
+            return retry_after
+        return None
+
     def window_samples(self, ws: str, ns: str) -> int:
         now = time.monotonic()
         with self._lock:
@@ -194,7 +239,9 @@ class UsageAccountant:
                     "resultBytes": t.result_bytes,
                     "ingestSamples": t.ingest_samples,
                     "rejected": t.rejected,
+                    "ingestRejected": t.ingest_rejected,
                     "windowSamplesScanned": t.win_samples,
+                    "windowSamplesOffered": t.win_ingest,
                 })
         out.sort(key=lambda r: (-r["querySeconds"], r["ws"], r["ns"]))
         return out

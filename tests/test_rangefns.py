@@ -72,9 +72,28 @@ def test_kernel_matches_oracle(fn):
     out = _run_kernel([ts1, ts2, ts3], [v1, v2, v3], wends, range_ms, fn)
     # linear-regression-based fns accumulate rounding over large ts offsets
     rtol = 1e-6 if fn in ("deriv", "z_score", "predict_linear") else 1e-9
+    # dtype-aware tolerance for the variance family: the kernel computes
+    # variance from running sums (cumsum window differences), so a
+    # zero-variance window (e.g. one sample) leaves O(n * x^2 * eps)
+    # cancellation noise that sqrt() amplifies — ~3e-6 even at f64,
+    # ~1e-1 at f32 on TPU runs.  Scale the floor by the OUTPUT dtype.
+    eps = float(np.finfo(np.asarray(out).dtype).eps)
+    n_max, x_max = 120, 100.0
+    var_floor = (n_max * x_max ** 2 * eps) ** 0.5
+    atol = var_floor if fn == "stddev_over_time" else 1e-9
     for i, (t, v) in enumerate([(ts1, v1), (ts2, v2), (ts3, v3)]):
         expect = eval_series(t, v, wends, range_ms, fn)
-        np.testing.assert_allclose(out[i], expect, rtol=rtol, atol=1e-9,
+        got = np.asarray(out[i], dtype=np.float64)
+        if fn == "z_score":
+            # degenerate windows (oracle stddev exactly 0): the oracle's
+            # 0/0 is NaN while the kernel's noise/noise is a tiny finite
+            # value — both are correct answers to an ill-posed window, so
+            # treat kernel values under the noise floor as the NaN
+            std = eval_series(t, v, wends, range_ms, "stddev_over_time")
+            degenerate = np.isnan(expect) & (std == 0) \
+                & (np.abs(got) <= eps ** 0.5 * 100)
+            got = np.where(degenerate, np.nan, got)
+        np.testing.assert_allclose(got, expect, rtol=rtol, atol=atol,
                                    err_msg=f"{fn} series {i}")
 
 

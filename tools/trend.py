@@ -15,6 +15,11 @@ of every headline metric is greppable in one file:
     ``multichip_inversion_gone``, PR 6) — including a LOUD
     ``multichip_error`` when a box that claims TPU exposed < 2 devices
     (the bench stage fails rather than skips; the trend must show it).
+  - the durability numbers (PR 7): ``remote_write_samples_per_sec``,
+    ``wal_overhead_pct`` / ``wal_on_vs_off_pct`` (gate: WAL-on >= 50%
+    of WAL-off), ``wal_replay_samples_per_sec``, and the kill-chaos
+    proof ``wal_kill_acked_lost`` (gate: 0) /
+    ``wal_kill_query_identical`` — plus a loud ``wal_error``.
 
 Existing hand-written round entries are MERGED, never clobbered: only
 missing keys are added, so curated notes survive re-runs.
@@ -44,6 +49,10 @@ CARRY = [
     "multichip_scaling_x", "multichip_inversion_gone",
     "multichip_fused_route", "multichip_pack_memo_hits",
     "multichip_error",
+    "remote_write_samples_per_sec", "wal_overhead_pct",
+    "wal_on_vs_off_pct", "wal_on_samples_per_sec",
+    "wal_replay_samples_per_sec", "wal_kill_acked_lost",
+    "wal_kill_query_identical", "wal_error",
 ]
 RENAME = {"value": "headline_samples_per_sec",
           "p50_query_latency_s": "p50_s"}
