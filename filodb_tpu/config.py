@@ -266,6 +266,31 @@ class StoreConfig:
     # re-upload inline.  Incremental (append-only) refreshes and the
     # cold first build stay inline.
     mirror_background_rebuild: bool = True
+    # --- historical tier (persist/segments + compactor; doc/operations.md
+    # compaction runbook) ---
+    # background segment compaction: rewrite flushed chunkset frames of
+    # closed time windows into columnar [S, T] segments the query path
+    # scans at device speed.  Engages only with a disk-backed column
+    # store (LocalDiskColumnStore).
+    segment_compaction_enabled: bool = True
+    # segment window width: one segment file per (shard, schema, window).
+    # Bigger windows = fewer/larger cold uploads; smaller = finer LRU
+    # eviction granularity in the cold region.
+    segment_window_ms: int = 6 * 3600 * 1000
+    # a window compacts once its end is this far in the past (late
+    # flushes for it have landed); >= the flush interval
+    segment_closed_lag_ms: int = 60 * 60 * 1000
+    # how often the compactor sweeps (also runs retention)
+    segment_compact_interval_ms: int = 5 * 60 * 1000
+    # retention: age raw chunk frames out of the chunk log once a
+    # covering segment exists AND the frames are at least this old
+    # (0 disables pruning — the log grows forever)
+    segment_retain_raw_ms: int = 24 * 3600 * 1000
+    # byte budget of the cold DeviceMirror region: persisted-segment
+    # blocks uploaded on demand, LRU-evicted at segment granularity.
+    # A single query whose working set exceeds the budget degrades to a
+    # host-side segment scan (never an error, never an OOM).
+    device_mirror_cold_limit_bytes: int = 2 << 30
 
 
 @dataclasses.dataclass

@@ -240,6 +240,127 @@ def sharded_mirrors_enabled(config_store) -> bool:
         return False
 
 
+class ColdSegmentCache:
+    """LRU-paged cold region of the device mirror: whole persisted-segment
+    blocks uploaded on demand under a byte budget
+    (`store.device_mirror_cold_limit_bytes`), evicted at SEGMENT
+    granularity — the Thanos store-gateway page cache, HBM-resident.
+
+    Invariants the longrange bench/tests counter-assert:
+      - booked bytes NEVER exceed the budget: eviction runs BEFORE the
+        upload (using the caller's size estimate), not after;
+      - a single block larger than the whole budget degrades to a
+        host-side build (`device='host'`) — served, not cached, never an
+        error and never an OOM.
+
+    Placement reuses the PR 6 MirrorPlacer so cold blocks land HBM-aware
+    on the shard's owning chip (sharded-mirror mode); on single-device /
+    host platforms blocks go to the default device and only this cache's
+    own byte accounting applies."""
+
+    def __init__(self, limit_bytes: int, use_placer: Optional[bool] = None):
+        self.limit_bytes = int(limit_bytes)
+        self._lock = threading.Lock()
+        self._entries: Dict[tuple, object] = {}      # key -> block (LRU)
+        self._bytes = 0
+        self._use_placer = use_placer
+
+    @property
+    def bytes_booked(self) -> int:
+        with self._lock:
+            return self._bytes
+
+    def _placer_on(self) -> bool:
+        if self._use_placer is not None:
+            return self._use_placer
+        try:
+            import jax
+            return jax.local_device_count() > 1
+        except Exception:  # noqa: BLE001 — uninitialized backend
+            return False
+
+    def _evict_until(self, need: int) -> None:
+        """Caller holds the lock.  Evict LRU entries until `need` more
+        bytes fit under the budget."""
+        from filodb_tpu.utils.metrics import registry
+        while self._entries and self._bytes + need > self.limit_bytes:
+            oldest = next(iter(self._entries))
+            block = self._entries.pop(oldest)
+            self._bytes -= getattr(block, "nbytes", 0)
+            dev = getattr(block, "device", None)
+            if dev is not None and dev != "host":
+                placer.book(dev, -getattr(block, "nbytes", 0))
+            registry.counter("device_mirror_cold_evictions").increment()
+
+    def get(self, key: tuple, est_bytes: int, shard_num: int,
+            build) -> Tuple[object, str]:
+        """-> (block, verdict).  `build(device)` decodes + uploads the
+        block; device is a jax Device (placed), None (default device), or
+        the string 'host' for the over-budget degrade."""
+        from filodb_tpu.utils.metrics import registry
+        with self._lock:
+            block = self._entries.get(key)
+            if block is not None:
+                self._entries[key] = self._entries.pop(key)   # LRU touch
+                registry.counter("device_mirror_cold_hits").increment()
+                return block, "cold_hit"
+        if est_bytes > self.limit_bytes:
+            # one block alone blows the budget: host-side segment scan —
+            # slower, bounded, never an error (uncached: the next query
+            # re-decodes rather than pinning an over-budget block)
+            registry.counter("device_mirror_cold_over_budget").increment()
+            return build("host"), "cold_paged"
+        device = None
+        with self._lock:
+            # reserve BEFORE the upload so concurrent page-ins see each
+            # other's bookings and the budget is never exceeded
+            self._evict_until(est_bytes)
+            self._bytes += est_bytes
+        try:
+            if self._placer_on():
+                device = placer.assign(shard_num, est_bytes,
+                                       self.limit_bytes)
+            block = build(device)
+        except Exception:
+            with self._lock:
+                self._bytes -= est_bytes
+            if device is not None:
+                placer.book(device, -est_bytes)
+            raise
+        actual = getattr(block, "nbytes", est_bytes)
+        with self._lock:
+            existing = self._entries.get(key)
+            if existing is not None:
+                # a concurrent page-in won the race: keep theirs, release
+                # this build's reservation
+                self._bytes -= est_bytes
+                if device is not None:
+                    placer.book(device, -est_bytes)
+                self._entries[key] = self._entries.pop(key)
+                return existing, "cold_hit"
+            # adjust the reservation to the measured size (still pre-
+            # bounded: actual <= est for f32 uploads of the estimate)
+            self._bytes += actual - est_bytes
+            self._evict_until(0)
+            self._entries[key] = block
+        if device is not None and actual != est_bytes:
+            placer.book(device, actual - est_bytes)
+        registry.counter("device_mirror_cold_misses").increment()
+        registry.gauge("device_mirror_cold_bytes").update(self.bytes_booked)
+        registry.gauge("device_mirror_cold_limit_bytes").update(
+            self.limit_bytes)
+        return block, "cold_paged"
+
+    def clear(self) -> None:
+        with self._lock:
+            for block in self._entries.values():
+                dev = getattr(block, "device", None)
+                if dev is not None and dev != "host":
+                    placer.book(dev, -getattr(block, "nbytes", 0))
+            self._entries.clear()
+            self._bytes = 0
+
+
 class DeviceMirror:
     """One mirror per DenseSeriesStore (lazily attached).
 

@@ -98,6 +98,26 @@ class ShardStats:
 from filodb_tpu.utils.growable import grow_to as _grow_to
 
 
+class PagedLimitExceeded(ValueError):
+    """Demand paging hit the query's scan limit.  A ValueError subclass so
+    existing handlers keep working, but typed and self-describing: carries
+    how much paging WORK already happened (that work is kept — paged
+    chunks are valid cache; `paged_floor`/`paged_ceil` advanced for the
+    completed rows) so the query layer can surface a structured
+    `paged_limit_exceeded` error instead of a bare 500."""
+
+    def __init__(self, limit: int, samples_paged: int,
+                 partitions_paged: int):
+        self.limit = limit
+        self.samples_paged = samples_paged
+        self.partitions_paged = partitions_paged
+        super().__init__(
+            f"demand paging exceeded the scan limit {limit} after paging "
+            f"{samples_paged} samples across {partitions_paged} "
+            f"partitions — narrow the filters or time range (the paged "
+            f"data is kept warm for a narrower retry)")
+
+
 @dataclasses.dataclass
 class PartLookupResult:
     """ref: TimeSeriesShard.scala:212 PartLookupResult.
@@ -1102,10 +1122,7 @@ class TimeSeriesShard:
         decoded_total = 0
         for cs in sorted(chunks, key=lambda c: c.info.start_time_ms):
             if max_samples is not None and decoded_total > max_samples:
-                raise ValueError(
-                    f"demand paging exceeded the scan limit {max_samples} "
-                    f"inside one partition — narrow the filters or time "
-                    f"range")
+                raise PagedLimitExceeded(max_samples, decoded_total, 1)
             decoded_total += cs.info.num_rows
             chunk_les = None
             if cs.bucket_scheme is not None:
@@ -1149,20 +1166,25 @@ class TimeSeriesShard:
                  for k in col_parts[0]})
 
     def _read_sealed_chunks(self, info: PartitionInfo, start_time_ms: int,
-                            end_time_ms: int) -> list:
+                            end_time_ms: int,
+                            disk_chunks: Optional[list] = None) -> list:
         """Sealed chunks overlapping the range: the compressed RAM tier
         first, disk only for history older than what RAM retains (ref:
         OnDemandPagingShard paging order — block memory, then Cassandra).
-        Duplicates are harmless: _decode_paged_chunks drops overlap."""
+        Duplicates are harmless: _decode_paged_chunks drops overlap.
+        `disk_chunks`: a batched read_chunks_multi prefetch for this range
+        (ensure_paged) — used instead of a per-partition store read."""
         chunks = self.resident.read(info.part_id, start_time_ms, end_time_ms)
         floor = self.resident.coverage_floor(info.part_id)
         ram_covers = (floor is not None and floor <= start_time_ms
                       and bool(chunks))
         if not ram_covers and not isinstance(self.column_store,
                                              NullColumnStore):
-            chunks = list(self.column_store.read_chunks(
-                self.dataset, self.shard_num, info.part_key,
-                start_time_ms, end_time_ms)) + chunks
+            if disk_chunks is None:
+                disk_chunks = list(self.column_store.read_chunks(
+                    self.dataset, self.shard_num, info.part_key,
+                    start_time_ms, end_time_ms))
+            chunks = list(disk_chunks) + chunks
         return chunks
 
     def ensure_paged_pids(self, schema_name: str, pids: np.ndarray,
@@ -1212,16 +1234,44 @@ class TimeSeriesShard:
         if (isinstance(self.column_store, NullColumnStore)
                 and self.resident.num_chunks == 0):
             return 0
+        # Batched disk prefetch: ONE read_chunks_multi for every partition
+        # whose below-floor range needs the column store, instead of a
+        # round trip per partition (the netstore win; free locally).
+        prefetch: Dict[int, list] = {}
+        if not isinstance(self.column_store, NullColumnStore):
+            reqs, req_pids = [], []
+            for info in parts:
+                store = self.stores[info.schema_name]
+                row = info.row
+                cnt = int(store.counts[row])
+                first_mem = int(store.ts[row, 0]) if cnt else MAX_TIME
+                covered = min(int(store.paged_floor[row]), first_mem)
+                if start_time_ms >= covered:
+                    continue
+                hi = end_time_ms if cnt == 0 else first_mem - 1
+                if hi < start_time_ms:
+                    continue
+                floor = self.resident.coverage_floor(info.part_id)
+                if floor is not None and floor <= start_time_ms:
+                    continue            # RAM tier likely covers it
+                reqs.append((info.part_key, start_time_ms, hi))
+                req_pids.append(info.part_id)
+            if reqs:
+                for pid, chunks in zip(req_pids,
+                                       self.column_store.read_chunks_multi(
+                                           self.dataset, self.shard_num,
+                                           reqs)):
+                    prefetch[pid] = chunks
         paged = 0
+        parts_paged = 0
         for info in parts:
             # abort BEFORE materializing more history than the query may
             # scan — demand paging itself must not be the OOM (ref:
-            # capDataScannedPerShardCheck runs pre-ODP on chunk metadata)
+            # capDataScannedPerShardCheck runs pre-ODP on chunk metadata).
+            # Work already done is KEPT (floors advanced, chunks resident):
+            # it is valid cache for a narrower retry.
             if max_samples is not None and paged > max_samples:
-                raise ValueError(
-                    f"demand paging exceeded the scan limit {max_samples} "
-                    f"after {paged} samples — narrow the filters or time "
-                    f"range")
+                raise PagedLimitExceeded(max_samples, paged, parts_paged)
             store = self.stores[info.schema_name]
             row = info.row
             cnt = int(store.counts[row])
@@ -1236,14 +1286,23 @@ class TimeSeriesShard:
                 # paged_floor/paged_ceil as an interval)
                 hi = end_time_ms if cnt == 0 else first_mem - 1
                 if hi >= start_time_ms:
-                    chunks = self._read_sealed_chunks(info, start_time_ms, hi)
-                    ts_all, cols_all = self._decode_paged_chunks(
-                        store, chunks, start_time_ms - 1, hi,
-                        max_samples=(None if max_samples is None
-                                     else max_samples - paged))
+                    chunks = self._read_sealed_chunks(
+                        info, start_time_ms, hi,
+                        disk_chunks=prefetch.get(info.part_id))
+                    try:
+                        ts_all, cols_all = self._decode_paged_chunks(
+                            store, chunks, start_time_ms - 1, hi,
+                            max_samples=(None if max_samples is None
+                                         else max_samples - paged))
+                    except PagedLimitExceeded as e:
+                        raise PagedLimitExceeded(
+                            max_samples, paged + e.samples_paged,
+                            parts_paged) from None
                     if ts_all is not None:
                         n = store.prepend_row(row, ts_all, cols_all)
                         paged += n
+                        if n:
+                            parts_paged += 1
                         # trimmed page-ins must not claim full coverage
                         if n == len(ts_all):
                             store.paged_floor[row] = start_time_ms
@@ -1262,13 +1321,20 @@ class TimeSeriesShard:
                 if end_time_ms > ceil:
                     chunks = self._read_sealed_chunks(info, ceil + 1,
                                                       end_time_ms)
-                    ts_all, cols_all = self._decode_paged_chunks(
-                        store, chunks, last_mem, end_time_ms,
-                        max_samples=(None if max_samples is None
-                                     else max_samples - paged))
+                    try:
+                        ts_all, cols_all = self._decode_paged_chunks(
+                            store, chunks, last_mem, end_time_ms,
+                            max_samples=(None if max_samples is None
+                                         else max_samples - paged))
+                    except PagedLimitExceeded as e:
+                        raise PagedLimitExceeded(
+                            max_samples, paged + e.samples_paged,
+                            parts_paged) from None
                     if ts_all is not None:
                         n = store.append_row(row, ts_all, cols_all)
                         paged += n
+                        if n:
+                            parts_paged += 1
                         # a trimmed page-in must not claim full coverage
                         if n == len(ts_all):
                             store.paged_ceil[row] = end_time_ms

@@ -48,6 +48,16 @@ class ColumnStore:
                     start_time_ms: int, end_time_ms: int) -> List[ChunkSet]:
         raise NotImplementedError
 
+    def read_chunks_multi(self, dataset: str, shard: int,
+                          requests: Iterable[Tuple[PartKey, int, int]]
+                          ) -> List[List[ChunkSet]]:
+        """Batched read_chunks: one result list per (part_key, start_ms,
+        end_ms) request, aligned with the input.  The default loops; disk
+        and network stores override (one lock pass / one round trip) —
+        the demand-paging and compaction read shape."""
+        return [self.read_chunks(dataset, shard, pk, t0, t1)
+                for pk, t0, t1 in requests]
+
     def scan_chunks_by_ingestion_time(self, dataset: str, shard: int,
                                       ingestion_start_ms: int,
                                       ingestion_end_ms: int):

@@ -44,6 +44,8 @@ from filodb_tpu.memory.histogram import HistogramBuckets
 _MAGIC_CHUNK = 0xF1D0C401
 _MAGIC_PK = 0xF1D0C402
 _MAGIC_PK_DEL = 0xF1D0C403      # part-key tombstone (CardinalityBuster)
+_MAGIC_IDX = 0xF1D0C404         # sidecar frame index (chunks.log.idx)
+_IDX_VERSION = 1
 
 
 # ---------------------------------------------------------------- frame codec
@@ -191,6 +193,53 @@ class _FrameRef:
         self.chunk_id = chunk_id
 
 
+# ------------------------------------------------------- sidecar index
+
+def _encode_idx(src_size: int, src_mtime_ns: int,
+                chunks: Dict[bytes, List["_FrameRef"]]) -> bytes:
+    """Sidecar frame index payload: everything _load_shard's full scan
+    recovers, without reading the chunk log."""
+    n = sum(len(v) for v in chunks.values())
+    parts = [struct.pack("<IHQQI", _MAGIC_IDX, _IDX_VERSION, src_size,
+                         src_mtime_ns, n)]
+    for pk_bytes, refs in chunks.items():
+        for r in refs:
+            sn = r.schema_name.encode()
+            parts.append(struct.pack("<QqqqiqHH", r.offset, r.start_ms,
+                                     r.end_ms, r.ingestion_ms, r.num_rows,
+                                     r.chunk_id, len(sn), len(pk_bytes)))
+            parts.append(sn)
+            parts.append(pk_bytes)
+    return b"".join(parts)
+
+
+def _decode_idx(data: bytes, src_size: int, src_mtime_ns: int
+                ) -> Optional[Dict[bytes, List["_FrameRef"]]]:
+    """-> chunk index, or None when the sidecar is stale (size/mtime
+    mismatch) or malformed — callers fall back to the full scan."""
+    try:
+        magic, version, size, mtime, n = struct.unpack_from("<IHQQI", data,
+                                                            0)
+        if magic != _MAGIC_IDX or version != _IDX_VERSION \
+                or size != src_size or mtime != src_mtime_ns:
+            return None
+        off = 26
+        chunks: Dict[bytes, List[_FrameRef]] = {}
+        for _ in range(n):
+            (offset, start_ms, end_ms, ing_ms, nrows, cid, sn_len,
+             pk_len) = struct.unpack_from("<QqqqiqHH", data, off)
+            off += 48
+            sn = data[off: off + sn_len].decode()
+            off += sn_len
+            pk_bytes = bytes(data[off: off + pk_len])
+            off += pk_len
+            chunks.setdefault(pk_bytes, []).append(
+                _FrameRef(offset, start_ms, end_ms, ing_ms, sn, nrows, cid))
+        return chunks
+    except (struct.error, UnicodeDecodeError):
+        return None
+
+
 class LocalDiskColumnStore(ColumnStore):
     """Append-only chunk + partkey logs per shard.
 
@@ -224,6 +273,9 @@ class LocalDiskColumnStore(ColumnStore):
         return os.path.join(self._shard_dir(dataset, shard),
                             "partkeys.deleted.log")
 
+    def _idx_path(self, dataset: str, shard: int) -> str:
+        return self._chunk_path(dataset, shard) + ".idx"
+
     def initialize(self, dataset: str, num_shards: int) -> None:
         for s in range(num_shards):
             os.makedirs(self._shard_dir(dataset, s), exist_ok=True)
@@ -247,17 +299,20 @@ class LocalDiskColumnStore(ColumnStore):
         key = (dataset, shard)
         if key in self._chunk_idx:
             return
-        chunks: Dict[bytes, List[_FrameRef]] = {}
-        for offset, payload in _iter_frames(self._chunk_path(dataset, shard),
-                                            _MAGIC_CHUNK):
-            (pk_bytes, sn, start_ms, end_ms, ing_ms, nrows,
-             cid) = _peek_chunk_meta(payload)
-            bucket = chunks.setdefault(pk_bytes, [])
-            # duplicate appends (lost-reply write retries) index once
-            if any(r.chunk_id == cid for r in bucket):
-                continue
-            bucket.append(
-                _FrameRef(offset, start_ms, end_ms, ing_ms, sn, nrows, cid))
+        chunks = self._load_chunk_index_sidecar(dataset, shard)
+        if chunks is None:
+            chunks = {}
+            for offset, payload in _iter_frames(
+                    self._chunk_path(dataset, shard), _MAGIC_CHUNK):
+                (pk_bytes, sn, start_ms, end_ms, ing_ms, nrows,
+                 cid) = _peek_chunk_meta(payload)
+                bucket = chunks.setdefault(pk_bytes, [])
+                # duplicate appends (lost-reply write retries) index once
+                if any(r.chunk_id == cid for r in bucket):
+                    continue
+                bucket.append(
+                    _FrameRef(offset, start_ms, end_ms, ing_ms, sn, nrows,
+                              cid))
         pks: Dict[bytes, PartKeyRecord] = {}
         last_upsert: Dict[bytes, int] = {}
         for off, payload in _iter_frames(self._pk_path(dataset, shard),
@@ -278,6 +333,52 @@ class LocalDiskColumnStore(ColumnStore):
                 pks.pop(kb, None)
         self._chunk_idx[key] = chunks
         self._pk_idx[key] = pks
+
+    def _load_chunk_index_sidecar(self, dataset: str, shard: int
+                                  ) -> Optional[Dict[bytes,
+                                                     List[_FrameRef]]]:
+        """Trust chunks.log.idx when its recorded size/mtime match the
+        chunk log; any mismatch (appends since the index was written, torn
+        write, old version) falls back to the full frame scan.  Kills the
+        O(log) re-scan every open paid on large shards."""
+        from filodb_tpu.utils.metrics import registry
+        idx_path = self._idx_path(dataset, shard)
+        chunk_path = self._chunk_path(dataset, shard)
+        try:
+            st = os.stat(chunk_path)
+            with open(idx_path, "rb") as f:
+                data = f.read()
+        except OSError:
+            return None
+        chunks = _decode_idx(data, st.st_size, st.st_mtime_ns)
+        registry.counter("chunk_index_sidecar",
+                         verdict="hit" if chunks is not None
+                         else "stale").increment()
+        return chunks
+
+    def write_frame_index(self, dataset: str, shard: int) -> bool:
+        """Write the sidecar for one LOADED shard (atomic tmp+rename);
+        called from close() so the next open boots from the index."""
+        key = (dataset, shard)
+        chunks = self._chunk_idx.get(key)
+        if chunks is None:
+            return False
+        chunk_path = self._chunk_path(dataset, shard)
+        # flush any open append handle first: the recorded size must match
+        # what a fresh open will stat
+        f = self._files.get(chunk_path)
+        if f is not None:
+            f.flush()
+        try:
+            st = os.stat(chunk_path)
+        except OSError:
+            return False
+        idx_path = self._idx_path(dataset, shard)
+        tmp = idx_path + ".tmp"
+        with open(tmp, "wb") as out:
+            out.write(_encode_idx(st.st_size, st.st_mtime_ns, chunks))
+        os.replace(tmp, idx_path)
+        return True
 
     def _fetch(self, dataset: str, shard: int, ref: _FrameRef) -> Optional[ChunkSet]:
         payload = _read_frame_at(self._chunk_path(dataset, shard), ref.offset,
@@ -358,6 +459,84 @@ class LocalDiskColumnStore(ColumnStore):
                     out.append(cs)
             return out
 
+    def read_chunks_multi(self, dataset, shard, requests):
+        """Batched read_chunks: one lock acquisition + one index pass for
+        a list of (part_key, start_ms, end_ms) requests — the replay /
+        compaction read shape (and one round trip on the netstore)."""
+        with self._lock:
+            self._load_shard(dataset, shard)
+            idx = self._chunk_idx[(dataset, shard)]
+            out = []
+            for part_key, t0, t1 in requests:
+                refs = [r for r in idx.get(part_key.to_bytes(), [])
+                        if r.start_ms <= t1 and r.end_ms >= t0]
+                chunks = []
+                for ref in refs:
+                    cs = self._fetch(dataset, shard, ref)
+                    if cs is not None:
+                        chunks.append(cs)
+                out.append(chunks)
+            return out
+
+    def iter_chunk_refs(self, dataset: str, shard: int):
+        """(pk_bytes, frame-ref) pairs from index metadata only — the
+        compactor's window-planning read (no payload decode)."""
+        with self._lock:
+            self._load_shard(dataset, shard)
+            items = [(pk, ref)
+                     for pk, lst in self._chunk_idx[(dataset, shard)].items()
+                     for ref in lst]
+        return items
+
+    def prune_chunks_before(self, dataset: str, shard: int,
+                            cutoff_ms: int,
+                            ingested_before_ms: Optional[int] = None
+                            ) -> int:
+        """Retention: rewrite the chunk log keeping only frames whose data
+        reaches cutoff_ms or later (end_ms >= cutoff).  With
+        `ingested_before_ms`, frames ingested at/after it are kept
+        regardless of data age (the compactor's late-backfill guard — a
+        frame flushed after the last compaction pass may not be in any
+        segment yet).  Atomic (tmp + rename); the in-memory index and the
+        sidecar are rebuilt from the surviving frames.  Returns frames
+        dropped."""
+        def _doomed(r) -> bool:
+            return r.end_ms < cutoff_ms and (
+                ingested_before_ms is None
+                or r.ingestion_ms < ingested_before_ms)
+        with self._lock:
+            self._load_shard(dataset, shard)
+            idx = self._chunk_idx[(dataset, shard)]
+            doomed = sum(1 for refs in idx.values()
+                         for r in refs if _doomed(r))
+            if doomed == 0:
+                return 0
+            path = self._chunk_path(dataset, shard)
+            f = self._files.pop(path, None)
+            if f is not None:
+                f.close()
+            tmp = path + ".compact"
+            new_idx: Dict[bytes, List[_FrameRef]] = {}
+            with open(tmp, "wb") as out:
+                for offset, payload in _iter_frames(path, _MAGIC_CHUNK):
+                    (pk_bytes, sn, start_ms, end_ms, ing_ms, nrows,
+                     cid) = _peek_chunk_meta(payload)
+                    if end_ms < cutoff_ms and (
+                            ingested_before_ms is None
+                            or ing_ms < ingested_before_ms):
+                        continue
+                    new_off = out.tell()
+                    _write_frame(out, _MAGIC_CHUNK, payload)
+                    new_idx.setdefault(pk_bytes, []).append(
+                        _FrameRef(new_off, start_ms, end_ms, ing_ms, sn,
+                                  nrows, cid))
+                out.flush()
+                os.fsync(out.fileno())
+            os.replace(tmp, path)
+            self._chunk_idx[(dataset, shard)] = new_idx
+            self.write_frame_index(dataset, shard)
+            return doomed
+
     def scan_chunks_by_ingestion_time(
             self, dataset: str, shard: int,
             ingestion_start_ms: int, ingestion_end_ms: int,
@@ -384,6 +563,13 @@ class LocalDiskColumnStore(ColumnStore):
 
     def close(self) -> None:
         with self._lock:
+            # persist the frame index for every loaded shard so the next
+            # open trusts it instead of re-scanning the whole chunk log
+            for (dataset, shard) in list(self._chunk_idx):
+                try:
+                    self.write_frame_index(dataset, shard)
+                except OSError:
+                    pass                # index is an optimization only
             for f in self._files.values():
                 f.close()
             self._files.clear()

@@ -126,6 +126,21 @@ class ChunkServiceServer:
             _send_json_frame(sock, {"ok": True, "n": len(chunks)})
             for c in chunks:
                 _send_frame(sock, _encode_chunkset_frame(pk, "", c))
+        elif op == "read_chunks_multi":
+            # batched partition reads: the server iterates locally and
+            # streams ONE reply (header carries per-request counts) —
+            # replay/compaction paths stop paying a round trip per
+            # partition
+            reqs = [(PartKey.from_bytes(bytes.fromhex(pk)), t0, t1)
+                    for pk, t0, t1 in req["reqs"]]
+            per_part = cs.read_chunks_multi(req["dataset"], req["shard"],
+                                            reqs)
+            counts = [len(chunks) for chunks in per_part]
+            _send_json_frame(sock, {"ok": True, "n": sum(counts),
+                                    "counts": counts})
+            for (pk, _, _), chunks in zip(reqs, per_part):
+                for c in chunks:
+                    _send_frame(sock, _encode_chunkset_frame(pk, "", c))
         elif op == "scan_ingestion":
             hits = list(cs.scan_chunks_by_ingestion_time(
                 req["dataset"], req["shard"], req["lo"], req["hi"]))
@@ -244,6 +259,23 @@ class RemoteColumnStore(_RemoteBase, ColumnStore):
                                 "t0": int(start_time_ms),
                                 "t1": int(end_time_ms)}, recv_frames=True)
         return [_decode_chunkset_frame(fr)[2] for fr in frames]
+
+    def read_chunks_multi(self, dataset, shard, requests
+                          ) -> List[List[ChunkSet]]:
+        """One round trip for N partition reads (vs N for the loop
+        default) — the ensure_paged prefetch / compactor read path."""
+        requests = [(pk, int(t0), int(t1)) for pk, t0, t1 in requests]
+        reply, frames = self._call(
+            {"op": "read_chunks_multi", "dataset": dataset, "shard": shard,
+             "reqs": [[pk.to_bytes().hex(), t0, t1]
+                      for pk, t0, t1 in requests]}, recv_frames=True)
+        out: List[List[ChunkSet]] = []
+        i = 0
+        for n in reply["counts"]:
+            out.append([_decode_chunkset_frame(fr)[2]
+                        for fr in frames[i: i + n]])
+            i += n
+        return out
 
     def scan_chunks_by_ingestion_time(
             self, dataset, shard, ingestion_start_ms, ingestion_end_ms

@@ -38,7 +38,8 @@ from filodb_tpu.query.transformers import (  # noqa: F401
     ScalarOperationMapper, SortFunctionMapper, VectorFunctionMapper,
     _CANDIDATE_OPS, _dollar_to_backslash, _group_ids)
 from filodb_tpu.query.leafexec import (  # noqa: F401
-    MultiSchemaPartitionsExec, ScalarBinaryOperationExec,
+    MultiSchemaPartitionsExec, SelectPersistedSegmentsExec,
+    ScalarBinaryOperationExec,
     ScalarFixedDoubleExec, TimeScalarGeneratorExec, _estimate_scan)
 from filodb_tpu.query.nonleaf import (  # noqa: F401
     BinaryJoinExec, DistConcatExec, LocalPartitionDistConcatExec,

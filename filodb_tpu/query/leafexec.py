@@ -545,10 +545,21 @@ class MultiSchemaPartitionsExec(LeafExecPlan):
                     f"narrow the filters or time range")
 
         _check_scan_cap("resident")
-        paged = shard.ensure_paged_pids(
-            schema_name, pids, self.chunk_start_ms, self.chunk_end_ms,
-            max_samples=limit if enforced else None)
+        from filodb_tpu.core.shard import PagedLimitExceeded
+        from filodb_tpu.query.execbase import QueryError
+        try:
+            paged = shard.ensure_paged_pids(
+                schema_name, pids, self.chunk_start_ms, self.chunk_end_ms,
+                max_samples=limit if enforced else None)
+        except PagedLimitExceeded as e:
+            # structured query error, not a 500: the partial paging work
+            # is kept (valid cache for a narrower retry) and the error
+            # says how much was paged before the limit hit
+            raise QueryError("paged_limit_exceeded", str(e)) from None
+        stats.cold_tier = "hot"
         if paged:
+            stats.samples_paged += int(paged)
+            stats.cold_tier = "cold_paged"
             # ODP grew some series' extents, so the resident estimate is
             # stale; when nothing paged the second O(S) estimate would
             # be identical to the first — skip it (dashboard panels pay
@@ -745,6 +756,340 @@ class MultiSchemaPartitionsExec(LeafExecPlan):
                         cache_token=(shard.keys_serial, shard.keys_epoch,
                                      pids.tobytes()),
                         route_host=route_host), stats
+
+
+class SelectPersistedSegmentsExec(MultiSchemaPartitionsExec):
+    """Leaf for the persisted-segment (historical) tier: gathers rows from
+    cold-region segment blocks instead of the shard's dense store, then
+    runs the SAME transformer / fused pipeline as the hot leaf — cold
+    scans take the device path, not `ensure_paged`'s host decode.
+
+    `tier` is a persist.segments.PersistedTier bound at plan time by
+    PersistedClusterPlanner (this tier is node-local: segment files +
+    the cold DeviceMirror region live on the serving node)."""
+
+    def __init__(self, ctx: QueryContext, dataset: str, shard: int,
+                 filters: Sequence[ColumnFilter], chunk_start_ms: int,
+                 chunk_end_ms: int, tier, columns: Sequence[str] = (),
+                 schema: Optional[str] = None):
+        super().__init__(ctx, dataset, shard, filters, chunk_start_ms,
+                         chunk_end_ms, columns=columns, schema=schema)
+        self.tier = tier
+
+    def args_str(self):
+        fs = ",".join(str(f) for f in self.filters)
+        return (f"dataset={self.dataset}, shard={self.shard}, tier=cold, "
+                f"chunkMethod=TimeRangeChunkScan({self.chunk_start_ms},"
+                f"{self.chunk_end_ms}), filters=[{fs}]")
+
+    def _do_execute(self, source) -> QueryResultLike:
+        stats = QueryStats(shards_queried=1)
+        segs = self.tier.covering(self.shard, self.chunk_start_ms,
+                                  self.chunk_end_ms, self.schema)
+        if not segs:
+            return None, stats
+        by_schema: Dict[str, list] = {}
+        for m in segs:
+            by_schema.setdefault(m.schema_name, []).append(m)
+        schema_name = self.schema or next(iter(by_schema))
+        metas = sorted(by_schema.get(schema_name, ()),
+                       key=lambda m: m.start_ms)
+        if not metas:
+            return None, stats
+        schema = self.tier.schemas[schema_name]
+        col_name = (self.columns[0] if self.columns
+                    else schema.value_column)
+        verdict = "cold_hit"
+        picked = []                       # (block, rows)
+        if len(metas) > 1:
+            # page the slice's segments in concurrently: decode + upload
+            # overlap, so the cold wall is ~one segment, not the sum (the
+            # per-column decode inside each is pooled too)
+            import concurrent.futures
+            with concurrent.futures.ThreadPoolExecutor(
+                    max_workers=min(4, len(metas))) as pool:
+                fetched = list(pool.map(self.tier.get_block, metas))
+        else:
+            fetched = [self.tier.get_block(metas[0])]
+        for m, (block, v) in zip(metas, fetched):
+            rows = block.match_rows(self.filters, self.chunk_start_ms,
+                                    self.chunk_end_ms)
+            if v == "cold_paged":
+                verdict = "cold_paged"
+                stats.samples_paged += int(block.counts.sum())
+                stats.bytes_paged += int(block.nbytes)
+            if rows.size:
+                picked.append((block, rows))
+        stats.cold_tier = verdict
+        if not picked:
+            return None, stats
+        # scan cap over the FILTER-MATCHED rows (hot-leaf parity: the
+        # estimate must reflect what this query scans, not the shard's
+        # total segment volume), checked before the gather/merge
+        # materializes anything; page-in granularity is the segment and
+        # stays bounded by the cold region's byte budget either way
+        limit = self.ctx.planner_params.scan_limit
+        if limit and self.ctx.planner_params.enforced_limits:
+            est = sum(int(b.counts[r].sum()) for b, r in picked)
+            if est > limit:
+                raise ValueError(
+                    f"shard {self.shard}: persisted-tier query would scan "
+                    f"~{est} samples, over the scan limit {limit} — "
+                    f"narrow the filters or time range")
+        base_ms = picked[0][0].meta.start_ms
+        span = max(b.meta.end_ms for b, _ in picked) - base_ms
+        if span >= (1 << 30):
+            raise ValueError(
+                "persisted-tier slice spans >2^30 ms — the planner must "
+                "split long ranges (PersistedClusterPlanner.plan_split_ms)")
+        raw = self._gather_cold(picked, schema, col_name, base_ms, stats)
+        return raw, stats
+
+    def _gather_cold(self, picked, schema, col_name: str, base_ms: int,
+                     stats: QueryStats):
+        from filodb_tpu.query.execbase import RawBlock
+        counter_col = col_name in picked[0][0].counter_cols
+        fn_is_counter = False
+        for t in self.transformers:
+            if isinstance(t, PeriodicSamplesMapper):
+                spec = RANGE_FUNCTIONS.get(t.function or "")
+                fn_is_counter = spec.is_counter if spec else False
+                break
+        if counter_col and not fn_is_counter:
+            # resets/delta/changes need RAW counter values: re-decode the
+            # segments host-side (uncached — this is the rare path), like
+            # the hot leaf bypassing the pre-corrected mirror
+            return self._gather_cold_raw(picked, col_name, base_ms, stats)
+        host = any(b.is_host for b, _ in picked)
+        seg_inputs = []
+        for block, rows in picked:
+            ts_off = block.ts_off
+            vals = block.cols[col_name]
+            if host:
+                ts_off = np.asarray(ts_off)
+                vals = np.asarray(vals)
+            if host or isinstance(vals, np.ndarray):
+                ts_g = np.asarray(ts_off)[rows]
+                v_g = np.asarray(vals)[rows]
+            else:
+                idx = jnp.asarray(rows.astype(np.int32))
+                ts_g = jnp.take(ts_off, idx, axis=0)
+                v_g = jnp.take(vals, idx, axis=0)
+            seg_inputs.append({
+                "block": block, "rows": rows, "ts_off": ts_g, "vals": v_g,
+                "counts": block.counts[rows],
+                "t0": block.meta.start_ms,
+                "vbase": block.vbase[col_name][rows],
+            })
+        samples = int(sum(int(si["counts"].sum()) for si in seg_inputs))
+        stats.series_scanned = 0
+        stats.samples_scanned = samples
+        if len(seg_inputs) == 1:
+            si = seg_inputs[0]
+            block, rows = picked[0]
+            keys = block.keys_for(rows)
+            stats.series_scanned = int(rows.size)
+            dense = block.dense.get(col_name, False)
+            shared = block.ts_row0 if block.uniform else None
+            self._fused_cache_key = (("cold", block.serial), 0, col_name,
+                                     rows.tobytes())
+            return RawBlock(keys, si["ts_off"], si["vals"], si["t0"],
+                            None, samples=samples, vbase=si["vbase"],
+                            precorrected=counter_col,
+                            shared_ts_row=shared, dense=dense,
+                            cache_token=("cold", block.serial,
+                                         rows.tobytes()))
+        return self._merge_cold(seg_inputs, picked, col_name, counter_col,
+                                base_ms, stats, samples, host)
+
+    def _merge_cold(self, seg_inputs, picked, col_name: str,
+                    counter_col: bool, base_ms: int, stats, samples: int,
+                    host: bool):
+        """Stitch K time-ordered segment gathers into one packed [Su, Tt]
+        RawBlock: union the row sets, chain counter corrections across
+        segment boundaries, and pack each union row's samples contiguously
+        (the general windowing path needs per-row-sorted offsets with pads
+        only at the end)."""
+        from filodb_tpu.query.execbase import RawBlock
+        serials = tuple(b.serial for b, _ in picked)
+        rows_token = b"".join(r.tobytes() for _, r in picked)
+        mkey = (serials, col_name, rows_token, base_ms)
+        cached = self.tier.merged_get(mkey)
+        if cached is not None:
+            # repeat query over the same cold row set: reuse the packed
+            # merge (the cold analogue of the fused prepared-input cache)
+            union_keys, ts_out, v_out, out_vbase, shared, dense, Su = cached
+            stats.series_scanned = Su
+            self._fused_cache_key = (("cold",) + serials, 0, col_name,
+                                     rows_token)
+            return RawBlock(union_keys, ts_out, v_out, base_ms, None,
+                            samples=samples, vbase=out_vbase,
+                            precorrected=counter_col, shared_ts_row=shared,
+                            dense=dense,
+                            cache_token=("cold", serials, rows_token))
+        union: Dict[bytes, int] = {}
+        union_keys = []
+        urows_per = []
+        for block, rows in picked:
+            pk_bytes = block.identity.pk_bytes
+            rl = rows.tolist()
+            urows = np.empty(len(rl), dtype=np.int64)
+            new_local = []
+            for i, r in enumerate(rl):
+                u = union.get(pk_bytes[r])
+                if u is None:
+                    u = union[pk_bytes[r]] = len(union)
+                    new_local.append(i)
+                urows[i] = u
+            if new_local:
+                union_keys.extend(
+                    block.keys_for(rows[np.asarray(new_local)]))
+            urows_per.append(urows)
+        Su = len(union)
+        stats.series_scanned = Su
+        # per-union-row packed layout + cross-segment counter carry
+        out_vbase = np.full(Su, np.nan)
+        carry = np.zeros(Su)
+        prev_last = np.full(Su, np.nan)
+        flat_parts_ts, flat_parts_v = [], []
+        flat_base = 0
+        src_of: list = []                # (flat_base, Tk, urows, counts, adj)
+        for si, ur in zip(seg_inputs, urows_per):
+            block = si["block"]
+            cnt = np.asarray(si["counts"], dtype=np.int64)
+            vb = np.asarray(si["vbase"], np.float64)
+            first_seen = np.isnan(out_vbase[ur])
+            out_vbase[ur] = np.where(first_seen, vb, out_vbase[ur])
+            if counter_col:
+                fr = block.first_raw[col_name][si["rows"]]
+                boundary = (~np.isnan(prev_last[ur])) & \
+                    np.less(fr, prev_last[ur],
+                            where=~np.isnan(fr) & ~np.isnan(prev_last[ur]),
+                            out=np.zeros(len(ur), dtype=bool))
+                carry[ur] += np.where(boundary, prev_last[ur], 0.0)
+            adj = vb + carry[ur] - out_vbase[ur]          # f64 [Rk]
+            if counter_col:
+                carry[ur] += block.cum_drop[col_name][si["rows"]]
+                lr = block.last_raw[col_name][si["rows"]]
+                prev_last[ur] = np.where(np.isnan(lr), prev_last[ur], lr)
+            Tk = int(np.asarray(si["ts_off"]).shape[1]) if host else \
+                int(si["ts_off"].shape[1])
+            delta = int(si["t0"] - base_ms)
+            if host:
+                ts_adj = np.asarray(si["ts_off"])
+                ts_adj = np.where(ts_adj == PAD_TS, PAD_TS,
+                                  ts_adj + np.int32(delta))
+                src = np.asarray(si["vals"])
+                v_adj = (src.astype(np.float64)
+                         + adj[:, None]).astype(src.dtype)
+            else:
+                ts_adj = jnp.where(si["ts_off"] == PAD_TS, PAD_TS,
+                                   si["ts_off"] + np.int32(delta))
+                v_adj = si["vals"] + jnp.asarray(adj[:, None],
+                                                 si["vals"].dtype)
+            flat_parts_ts.append(ts_adj.reshape(-1))
+            flat_parts_v.append(v_adj.reshape(-1))
+            src_of.append((flat_base, Tk, ur, cnt))
+            flat_base += len(ur) * Tk
+        ct = np.zeros(Su, dtype=np.int64)
+        for _, _, ur, cnt in src_of:
+            ct[ur] += cnt
+        Tmax = int(ct.max()) if Su else 0
+        pad_pos = flat_base                    # one sentinel slot appended
+        out_idx = np.full((Su, Tmax), pad_pos, dtype=np.int64)
+        write_pos = np.zeros(Su, dtype=np.int64)
+        for base_k, Tk, ur, cnt in src_of:
+            jj = np.arange(Tk)
+            valid = jj[None, :] < cnt[:, None]
+            src = base_k + np.arange(len(ur))[:, None] * Tk + jj[None, :]
+            rows_rep = np.repeat(ur, cnt)
+            cols_rep = (write_pos[ur][:, None] + jj[None, :])[valid]
+            out_idx[rows_rep, cols_rep] = src[valid]
+            write_pos[ur] += cnt
+        if host:
+            flat_ts = np.concatenate(
+                flat_parts_ts + [np.asarray([PAD_TS], np.int32)])
+            flat_v = np.concatenate(
+                flat_parts_v + [np.asarray([np.nan],
+                                           flat_parts_v[0].dtype)])
+            ts_out = flat_ts[out_idx]
+            v_out = flat_v[out_idx]
+        else:
+            flat_ts = jnp.concatenate(
+                flat_parts_ts + [jnp.asarray([PAD_TS], np.int32)])
+            flat_v = jnp.concatenate(
+                flat_parts_v
+                + [jnp.asarray([np.nan], flat_parts_v[0].dtype)])
+            idx_dev = jnp.asarray(out_idx)
+            ts_out = jnp.take(flat_ts, idx_dev)
+            v_out = jnp.take(flat_v, idx_dev)
+        dense = all(b.dense.get(col_name, False) for b, _ in picked)
+        # shared grid survives the merge only when every union row took
+        # every segment's full uniform grid
+        shared = None
+        if all(b.uniform for b, _ in picked) \
+                and all(len(ur) == Su for _, _, ur, _ in src_of) \
+                and Su > 0 and (ct == ct[0]).all():
+            parts = []
+            for b, _ in picked:
+                row0 = b.ts_row0[:int(b.counts[0])].astype(np.int64) \
+                    + (b.meta.start_ms - base_ms)
+                parts.append(row0.astype(np.int32))
+            cat = np.concatenate(parts)
+            if cat.size == Tmax:
+                shared = cat
+        self._fused_cache_key = (("cold",) + serials, 0, col_name,
+                                 rows_token)
+        self.tier.merged_put(mkey, (union_keys, ts_out, v_out, out_vbase,
+                                    shared, dense, Su))
+        return RawBlock(union_keys, ts_out, v_out, base_ms, None,
+                        samples=samples, vbase=out_vbase,
+                        precorrected=counter_col, shared_ts_row=shared,
+                        dense=dense,
+                        cache_token=("cold", serials, rows_token))
+
+    def _gather_cold_raw(self, picked, col_name: str, base_ms: int,
+                         stats):
+        """Raw-value host path (non-counter function on a counter column):
+        re-decode the segments and merge uncorrected values."""
+        from filodb_tpu.query.execbase import RawBlock
+        series: Dict[bytes, list] = {}
+        keys: Dict[bytes, object] = {}
+        for block, rows in picked:
+            hdr, ts, cols = self.tier.store.load(block.meta)
+            vals = cols.get(col_name)
+            if vals is None:
+                continue
+            for r in rows.tolist():
+                kb = block.part_keys[r].to_bytes()
+                n = int(hdr["counts"][r])
+                series.setdefault(kb, []).append((ts[r, :n], vals[r, :n]))
+                keys.setdefault(kb, block.keys_for(np.asarray([r]))[0])
+        if not series:
+            return None
+        Su = len(series)
+        merged = []
+        for kb, parts in series.items():
+            parts.sort(key=lambda p: p[0][0] if len(p[0]) else 0)
+            merged.append((np.concatenate([p[0] for p in parts]),
+                           np.concatenate([p[1] for p in parts])))
+        Tmax = max(len(t) for t, _ in merged)
+        counts = np.asarray([len(t) for t, _ in merged], dtype=np.int64)
+        ts_grid = np.zeros((Su, Tmax), dtype=np.int64)
+        v_grid = np.full((Su, Tmax), np.nan)
+        for i, (t, v) in enumerate(merged):
+            ts_grid[i, :len(t)] = t
+            v_grid[i, :len(v)] = v
+        stats.series_scanned = Su
+        stats.samples_scanned = int(counts.sum())
+        ts_off = to_offsets(ts_grid, counts, base_ms)
+        vals, vbase = counter_ops.rebase_values(v_grid, False)
+        dense = not bool(np.isnan(
+            vals[np.arange(Tmax)[None, :] < counts[:, None]]).any())
+        return RawBlock(list(keys.values()), ts_off, vals, base_ms, None,
+                        samples=stats.samples_scanned, vbase=vbase,
+                        precorrected=False, shared_ts_row=None,
+                        dense=dense)
 
 
 def _estimate_scan(store, rows: np.ndarray, start_ms: int,

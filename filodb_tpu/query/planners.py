@@ -102,18 +102,34 @@ def _matrix_json_to_block(payload: Dict) -> Optional[ResultBlock]:
 class LongTimeRangePlanner(QueryPlanner):
     """Route recent ranges to the raw cluster, old ranges to the downsample
     cluster, split + stitch when a query straddles raw retention
-    (ref: queryplanner/LongTimeRangePlanner.scala:27-40)."""
+    (ref: queryplanner/LongTimeRangePlanner.scala:27-40).
+
+    With a `persisted_planner` wired, a THIRD tier sits between them: the
+    full-resolution persisted-segment tier (the compacted historical
+    store).  Instants too old for the in-memory working set but covered by
+    segments route there; only instants older than segment coverage fall
+    to downsample.  One query over months stitches all three into one
+    grid (the real-LTS contract: raw | persisted | downsample)."""
 
     def __init__(self, raw_planner: QueryPlanner,
-                 downsample_planner: QueryPlanner,
+                 downsample_planner: Optional[QueryPlanner],
                  earliest_raw_time_fn: Callable[[], int],
                  latest_downsample_time_fn: Callable[[], int],
-                 stale_lookback_ms: int = 5 * 60 * 1000):
+                 stale_lookback_ms: int = 5 * 60 * 1000,
+                 persisted_planner: Optional[QueryPlanner] = None,
+                 persisted_range_fn: Optional[Callable] = None):
         self.raw = raw_planner
         self.downsample = downsample_planner
         self.earliest_raw_time_fn = earliest_raw_time_fn
         self.latest_downsample_time_fn = latest_downsample_time_fn
         self.stale_lookback_ms = stale_lookback_ms
+        self.persisted = persisted_planner
+        # () -> (floor_ms, ceil_ms) of segment coverage, or None when no
+        # segments exist yet (PersistedTier.range)
+        self.persisted_range_fn = persisted_range_fn
+
+    def _downsample_or_raw(self):
+        return self.downsample if self.downsample is not None else self.raw
 
     def materialize(self, plan: lp.LogicalPlan, ctx: QueryContext) -> ExecPlan:
         if not isinstance(plan, lp.PeriodicSeriesPlan):
@@ -122,14 +138,21 @@ class LongTimeRangePlanner(QueryPlanner):
             # @ (anywhere in the tree) reads data at pinned times, not the
             # outer grid: route the WHOLE query by the true data range —
             # straddle-splitting the outer grid cannot relocate pinned
-            # reads.  Fits-raw wins; else downsample only when it covers
-            # the range end; else conservatively raw.
+            # reads.  Fits-raw wins; else persisted when it covers the
+            # whole data range; else downsample when it covers the range
+            # end; else conservatively raw.
             dr = lp.pinned_data_range(plan, self.stale_lookback_ms)
             if dr is None:
                 return self.raw.materialize(plan, ctx)
             if dr[0] >= self.earliest_raw_time_fn():
                 return self.raw.materialize(plan, ctx)
-            if dr[1] <= self.latest_downsample_time_fn():
+            pr = self.persisted_range_fn() \
+                if (self.persisted is not None
+                    and self.persisted_range_fn is not None) else None
+            if pr is not None and dr[0] >= pr[0] and dr[1] <= pr[1]:
+                return self.persisted.materialize(plan, ctx)
+            if self.downsample is not None \
+                    and dr[1] <= self.latest_downsample_time_fn():
                 return self.downsample.materialize(plan, ctx)
             return self.raw.materialize(plan, ctx)
         earliest_raw = self.earliest_raw_time_fn()
@@ -140,23 +163,122 @@ class LongTimeRangePlanner(QueryPlanner):
         # retention can be answered by the raw cluster alone
         if start - lookback - offset >= earliest_raw:
             return self.raw.materialize(plan, ctx)
+        pr = self.persisted_range_fn() \
+            if (self.persisted is not None
+                and self.persisted_range_fn is not None) else None
         if end - offset < earliest_raw:
-            return self.downsample.materialize(plan, ctx)
+            if pr is None:
+                return self._downsample_or_raw().materialize(plan, ctx)
+            return self._materialize_old(plan, ctx, pr, lookback, offset)
         # first grid instant fully covered by raw data
         need = earliest_raw + lookback + offset
         k = -((start - need) // step)                # ceil((need-start)/step)
         first_raw_instant = start + k * step
         if first_raw_instant > end:
-            return self.downsample.materialize(plan, ctx)
+            if pr is None:
+                return self._downsample_or_raw().materialize(plan, ctx)
+            return self._materialize_old(plan, ctx, pr, lookback, offset)
+        old_end = first_raw_instant - step
+        raw_plan = pu.copy_with_time_range(plan, TimeRange(first_raw_instant,
+                                                           end))
+        if old_end < start:
+            return self.raw.materialize(plan, ctx)
+        old_plan = pu.copy_with_time_range(plan, TimeRange(start, old_end))
+        if pr is not None:
+            old = self._materialize_old(old_plan, ctx, pr, lookback, offset)
+            return StitchRvsExec(ctx, [old,
+                                       self.raw.materialize(raw_plan, ctx)])
         latest_ds = self.latest_downsample_time_fn()
-        ds_end = min(first_raw_instant - step, latest_ds)
+        ds_end = min(old_end, latest_ds)
         if ds_end < start:
             return self.raw.materialize(plan, ctx)
         ds_plan = pu.copy_with_time_range(plan, TimeRange(start, ds_end))
-        raw_plan = pu.copy_with_time_range(plan, TimeRange(first_raw_instant,
-                                                           end))
-        return StitchRvsExec(ctx, [self.downsample.materialize(ds_plan, ctx),
-                                   self.raw.materialize(raw_plan, ctx)])
+        return StitchRvsExec(
+            ctx, [self._downsample_or_raw().materialize(ds_plan, ctx),
+                  self.raw.materialize(raw_plan, ctx)])
+
+    def _materialize_old(self, plan, ctx, pr, lookback: int,
+                         offset: int) -> ExecPlan:
+        """Route a fully-before-raw plan across persisted + downsample:
+        instants whose window [t-lookback-offset, t-offset] sits inside
+        segment coverage go to the persisted tier at full resolution; only
+        older instants fall to downsample."""
+        start, step, end = plan.start_ms, plan.step_ms, plan.end_ms
+        p0, p1 = pr
+        # first grid instant whose whole window is inside segment coverage
+        # (clamped to the grid start: coverage reaching further back than
+        # the query must not mint extra instants before it)
+        need = p0 + lookback + offset
+        k = max(-((start - need) // step), 0)
+        first_p = start + k * step
+        # last instant whose data end (t - offset) segments still cover
+        last_p = end if p1 >= end - offset else \
+            start + ((p1 + offset - start) // step) * step
+        if first_p > end or last_p < start or first_p > last_p:
+            # segments cover none of the grid
+            return self._downsample_or_raw().materialize(plan, ctx)
+        children: List[ExecPlan] = []
+        if first_p > start:
+            # grid head older than segment coverage: downsample when
+            # wired, else the raw cluster's chunk-paging path (retention
+            # never prunes frames no segment covers, so raw still holds
+            # that data — dropping the head would silently truncate)
+            if self.downsample is not None:
+                ds_end = min(first_p - step,
+                             self.latest_downsample_time_fn())
+            else:
+                ds_end = first_p - step
+            if ds_end >= start:
+                head = pu.copy_with_time_range(plan,
+                                               TimeRange(start, ds_end))
+                children.append(
+                    self._downsample_or_raw().materialize(head, ctx))
+        children.append(self.persisted.materialize(
+            pu.copy_with_time_range(plan, TimeRange(first_p, last_p)), ctx))
+        if last_p < end:
+            # newer than segment coverage but older than raw: the raw
+            # cluster's chunk-paging path is the only source
+            children.append(self.raw.materialize(
+                pu.copy_with_time_range(plan,
+                                        TimeRange(last_p + step, end)),
+                ctx))
+        if len(children) == 1:
+            return children[0]
+        return StitchRvsExec(ctx, children)
+
+
+class PersistedClusterPlanner(SingleClusterPlanner):
+    """SingleClusterPlanner variant whose leaves read the persisted-segment
+    tier (SelectPersistedSegmentsExec) instead of shard memory.  Long
+    ranges split on the step grid (`tier.plan_split_ms` slices, stitched)
+    so each leaf merges a bounded number of segments and int32 time
+    offsets never overflow."""
+
+    def __init__(self, dataset: str, shard_mapper, tier, **kwargs):
+        super().__init__(dataset, shard_mapper, **kwargs)
+        self.tier = tier
+
+    def materialize(self, plan: lp.LogicalPlan, ctx: QueryContext) -> ExecPlan:
+        split = getattr(self.tier, "plan_split_ms", 0)
+        if split and isinstance(plan, lp.PeriodicSeriesPlan) \
+                and not lp.contains_at_pin(plan) \
+                and plan.end_ms - plan.start_ms > split:
+            parts = pu.split_plans(plan, split)
+            if len(parts) > 1:
+                return StitchRvsExec(
+                    ctx, [super(PersistedClusterPlanner, self)
+                          .materialize(p, ctx) for p in parts])
+        return super().materialize(plan, ctx)
+
+    def _m_RawSeries(self, p: lp.RawSeries, ctx: QueryContext):
+        from filodb_tpu.query.leafexec import SelectPersistedSegmentsExec
+        candidates = self.shards_from_filters(p.filters, ctx)
+        shards = self.shard_mapper.active_shards(candidates) or candidates
+        plans = [SelectPersistedSegmentsExec(
+            ctx, self.dataset, s, p.filters,
+            p.range_selector.from_ms, p.range_selector.to_ms, self.tier,
+            columns=p.columns) for s in shards]
+        return plans
 
 
 # ------------------------------------------------------------ HA routing

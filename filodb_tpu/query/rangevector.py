@@ -127,6 +127,18 @@ class QueryStats:
     # device-mirror uploads THIS query paid for on its critical path
     mirror_full_rebuilds: int = 0
     mirror_incremental: int = 0
+    # --- historical-tier attribution ---
+    # samples materialized from persistence on THIS query's critical path
+    # (chunk-frame ODP page-ins + cold-segment builds); counted into
+    # samples_scanned too, so tenant scan limits see paged work
+    samples_paged: int = 0
+    bytes_paged: int = 0            # decoded segment bytes uploaded/built
+    # tier verdict (result_cache-style): "" (no cold-capable leaf) |
+    # "hot" (all in memory) | "cold_hit" (served from the resident cold
+    # region) | "cold_paged" (paid a page-in).  merge keeps the WORST.
+    cold_tier: str = ""
+
+    _COLD_ORDER = ("", "hot", "cold_hit", "cold_paged")
 
     def merge(self, other: "QueryStats") -> None:
         self.samples_scanned += other.samples_scanned
@@ -147,6 +159,11 @@ class QueryStats:
         self.result_cache = self.result_cache or other.result_cache
         self.mirror_full_rebuilds += other.mirror_full_rebuilds
         self.mirror_incremental += other.mirror_incremental
+        self.samples_paged += other.samples_paged
+        self.bytes_paged += other.bytes_paged
+        if self._COLD_ORDER.index(other.cold_tier) > \
+                self._COLD_ORDER.index(self.cold_tier):
+            self.cold_tier = other.cold_tier
 
     def to_dict(self) -> Dict[str, object]:
         """The `?stats=true` wire shape (http/routes attaches it to the
@@ -168,10 +185,13 @@ class QueryStats:
                 "device_s": round(self.device_seconds, 6),
                 "transfer_s": round(self.transfer_s, 6),
             },
+            "samplesPaged": self.samples_paged,
+            "bytesPaged": self.bytes_paged,
             "cache": {
                 "result": self.result_cache,
                 "mirrorFullRebuilds": self.mirror_full_rebuilds,
                 "mirrorIncremental": self.mirror_incremental,
+                "coldTier": self.cold_tier,
             },
         }
 

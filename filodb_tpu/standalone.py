@@ -69,6 +69,18 @@ class FiloServer:
         self.flush_schedulers: Dict[str, object] = {}
         self.wals: Dict[str, object] = {}
         self._earliest_cache: Dict[str, tuple] = {}
+        # historical tier: one cold DeviceMirror region (byte-budgeted LRU
+        # of persisted-segment blocks) shared across datasets, plus a
+        # per-dataset PersistedTier + compaction scheduler — wired only
+        # when the column store is disk-backed (LocalDiskColumnStore)
+        self.cold_cache = None
+        self.persisted_tiers: Dict[str, object] = {}
+        self.compaction_schedulers: Dict[str, object] = {}
+        if self.config.store.segment_compaction_enabled and \
+                hasattr(self.column_store, "iter_chunk_refs"):
+            from filodb_tpu.core.devicecache import ColdSegmentCache
+            self.cold_cache = ColdSegmentCache(
+                self.config.store.device_mirror_cold_limit_bytes)
         # observability singletons take their knobs from THIS server's
         # settings: the slow-query flight recorder (ring size, JSONL
         # sink) and the per-tenant usage window (utils/slowlog, usage)
@@ -139,9 +151,29 @@ class FiloServer:
             shards.append(shard)
             mapper.update_from_event(
                 ShardEvent("IngestionStarted", dc.name, s, self.node_name))
-        planner = SingleClusterPlanner(dc.name, mapper, spread)
+        raw_planner = SingleClusterPlanner(dc.name, mapper, spread)
+        planner = raw_planner
+        ds_planner = None
         if dc.downsample_resolutions:
-            planner = self._with_downsample(dc, mapper, planner)
+            ds_planner = self._make_downsample(dc, mapper)
+        persisted_planner = None
+        tier = None
+        if self.cold_cache is not None \
+                and getattr(self.column_store, "root", None):
+            tier = self._make_persisted_tier(dc, spread)
+            from filodb_tpu.query.planners import PersistedClusterPlanner
+            persisted_planner = PersistedClusterPlanner(
+                dc.name, mapper, tier, spread_provider=spread)
+        if ds_planner is not None or persisted_planner is not None:
+            from filodb_tpu.query.planners import LongTimeRangePlanner
+            earliest = self._earliest_raw_time
+            planner = LongTimeRangePlanner(
+                raw_planner, ds_planner,
+                earliest_raw_time_fn=lambda: earliest(dc.name),
+                latest_downsample_time_fn=lambda: 1 << 62,
+                persisted_planner=persisted_planner,
+                persisted_range_fn=(tier.range if tier is not None
+                                    else None))
 
         def label_vals(col: str) -> List[str]:
             out = set()
@@ -177,12 +209,10 @@ class FiloServer:
                     for s in range(dc.num_shards)}
                 wal.replay(self.memstore, restart_points)
 
-    def _with_downsample(self, dc: DatasetConfig, mapper: ShardMapper,
-                         raw_planner: SingleClusterPlanner):
+    def _make_downsample(self, dc: DatasetConfig, mapper: ShardMapper):
         from filodb_tpu.downsample import (DownsampleClusterPlanner,
                                            DownsampledTimeSeriesStore,
                                            ShardDownsampler)
-        from filodb_tpu.query.planners import LongTimeRangePlanner
         ds_store = DownsampledTimeSeriesStore(
             dc.name, column_store=self.column_store,
             meta_store=self.meta_store,
@@ -194,12 +224,30 @@ class FiloServer:
             dsr = ShardDownsampler(resolutions=dc.downsample_resolutions)
             raw_shard = self.memstore.get_shard(dc.name, s)
             raw_shard.shard_downsampler = dsr
-        ds_planner = DownsampleClusterPlanner(ds_store, mapper)
-        earliest = self._earliest_raw_time
-        return LongTimeRangePlanner(
-            raw_planner, ds_planner,
-            earliest_raw_time_fn=lambda: earliest(dc.name),
-            latest_downsample_time_fn=lambda: 1 << 62)
+        return DownsampleClusterPlanner(ds_store, mapper)
+
+    def _make_persisted_tier(self, dc: DatasetConfig, spread):
+        """Segment store + cold tier + compaction job for one dataset
+        (historical tier, doc/operations.md compaction runbook)."""
+        from filodb_tpu.persist.compactor import (CompactionScheduler,
+                                                  SegmentCompactor)
+        from filodb_tpu.persist.segments import PersistedTier, SegmentStore
+        seg_store = SegmentStore(self.column_store.root)
+        tier = PersistedTier(seg_store, dc.name, dc.num_shards,
+                             self.cold_cache,
+                             schemas=self.memstore.schemas)
+        self.persisted_tiers[dc.name] = tier
+        compactor = SegmentCompactor(
+            self.column_store, seg_store, dc.name, dc.num_shards,
+            window_ms=self.config.store.segment_window_ms,
+            closed_lag_ms=self.config.store.segment_closed_lag_ms,
+            schemas=self.memstore.schemas, tier=tier)
+        self.compaction_schedulers[dc.name] = CompactionScheduler(
+            compactor,
+            interval_s=self.config.store.segment_compact_interval_ms
+            / 1000.0,
+            retain_raw_ms=self.config.store.segment_retain_raw_ms)
+        return tier
 
     def _earliest_raw_time(self, dataset: str) -> int:
         """Raw retention floor: earliest live sample across shards, cached a
@@ -289,12 +337,17 @@ class FiloServer:
                     interval_s=self.config.store.flush_interval_ms / 1000.0,
                     wal=self.wals.get(dc.name))
                 self.flush_schedulers[dc.name] = sched.start()
+        for sched in self.compaction_schedulers.values():
+            sched.start()
         if self.ruler is not None:
             self.ruler.start()
 
     def shutdown(self) -> None:
         if self.ruler is not None:
             self.ruler.stop()
+        for sched in self.compaction_schedulers.values():
+            sched.stop()
+        self.compaction_schedulers.clear()
         for sched in self.flush_schedulers.values():
             sched.stop(final_flush=True)
         self.flush_schedulers.clear()

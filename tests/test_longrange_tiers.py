@@ -1,0 +1,436 @@
+"""Tier-stitched planning tests: LongTimeRangePlanner's third (persisted)
+tier, boundary stitching at raw-retention and latest-downsample edges —
+including a range function whose lookback window straddles the split (the
+known Prometheus-stitch hazard) — asserted bit-identical against a
+single-tier store holding the same samples."""
+import numpy as np
+import pytest
+
+from filodb_tpu.core.devicecache import ColdSegmentCache
+from filodb_tpu.core.memstore import TimeSeriesMemStore
+from filodb_tpu.core.partkey import PartKey
+from filodb_tpu.parallel.shardmapper import ShardEvent, ShardMapper
+from filodb_tpu.persist.compactor import SegmentCompactor
+from filodb_tpu.persist.localstore import LocalDiskColumnStore
+from filodb_tpu.persist.segments import PersistedTier, SegmentStore
+from filodb_tpu.query.engine import QueryEngine
+from filodb_tpu.query.exec import SelectPersistedSegmentsExec, StitchRvsExec
+from filodb_tpu.query.planner import SingleClusterPlanner
+from filodb_tpu.query.planners import (LongTimeRangePlanner,
+                                       PersistedClusterPlanner)
+from filodb_tpu.query.rangevector import QueryContext
+from filodb_tpu.promql.parser import (TimeStepParams,
+                                      query_range_to_logical_plan)
+
+DS = "ltr-test"
+WINDOW = 3600 * 1000
+T0 = 1_600_000_000_000 - (1_600_000_000_000 % WINDOW)
+INTERVAL = 60_000
+N_WINDOWS = 4
+NS = N_WINDOWS * WINDOW // INTERVAL
+S = 6
+
+
+def _grid():
+    return T0 + np.arange(NS, dtype=np.int64) * INTERVAL
+
+
+def _pks():
+    return [PartKey("m", (("inst", f"i{i}"), ("_ws_", "w"), ("_ns_", "n")))
+            for i in range(S)]
+
+
+def _vals():
+    # small integers: every arithmetic step is exact in f32, so hot and
+    # cold paths must agree BIT-identically
+    return (np.arange(S)[:, None] * 50.0 + (np.arange(NS) % 11)[None, :])
+
+
+def _mapper():
+    m = ShardMapper(1)
+    m.update_from_event(ShardEvent("IngestionStarted", DS, 0, "n"))
+    return m
+
+
+class _Src:
+    def __init__(self, store):
+        self.store = store
+
+    def get_shard(self, dataset, shard_num):
+        return self.store.get_shard(dataset, shard_num)
+
+    def shards_for(self, dataset):
+        return self.store.shards_for(dataset)
+
+
+@pytest.fixture()
+def tiered(tmp_path):
+    """A tiered setup: persisted segments hold ALL history; the live
+    memstore holds only the last window (the working set); a separate
+    single-tier reference store holds everything in memory."""
+    ts_grid, pks, vals = _grid(), _pks(), _vals()
+    cs = LocalDiskColumnStore(str(tmp_path))
+    ms_full = TimeSeriesMemStore(column_store=cs)
+    sh = ms_full.setup(DS, 0)
+    sh.ingest_columns("gauge", pks, np.broadcast_to(ts_grid, (S, NS)),
+                      {"value": vals})
+    sh.flush_all_groups()
+    seg_store = SegmentStore(str(tmp_path))
+    comp = SegmentCompactor(cs, seg_store, DS, 1, window_ms=WINDOW,
+                            closed_lag_ms=0)
+    assert comp.compact_all(now_ms=int(ts_grid[-1]) + 10 * WINDOW) \
+        == N_WINDOWS
+    tier = PersistedTier(seg_store, DS, 1,
+                         ColdSegmentCache(256 << 20, use_placer=False))
+    # live store: last window only (the in-memory working set)
+    tail_from = NS - WINDOW // INTERVAL
+    ms_live = TimeSeriesMemStore()
+    live = ms_live.setup(DS, 0)
+    live.ingest_columns("gauge", pks,
+                        np.broadcast_to(ts_grid[tail_from:],
+                                        (S, NS - tail_from)),
+                        {"value": vals[:, tail_from:]})
+    # reference: everything in memory
+    ms_ref = TimeSeriesMemStore()
+    ref = ms_ref.setup(DS, 0)
+    ref.ingest_columns("gauge", pks, np.broadcast_to(ts_grid, (S, NS)),
+                       {"value": vals})
+    mapper = _mapper()
+    earliest_raw = int(ts_grid[tail_from])
+    ltr = LongTimeRangePlanner(
+        SingleClusterPlanner(DS, mapper), None,
+        earliest_raw_time_fn=lambda: earliest_raw,
+        latest_downsample_time_fn=lambda: 1 << 62,
+        persisted_planner=PersistedClusterPlanner(DS, mapper, tier),
+        persisted_range_fn=tier.range)
+    eng_tiered = QueryEngine(DS, _Src(ms_live), mapper, planner=ltr)
+    eng_ref = QueryEngine(DS, _Src(ms_ref), mapper,
+                          planner=SingleClusterPlanner(DS, mapper))
+    return eng_tiered, eng_ref, ts_grid, earliest_raw
+
+
+def _assert_identical(res_a, res_b, q):
+    assert res_a.error is None, (q, res_a.error)
+    assert res_b.error is None, (q, res_b.error)
+    a = {k: (w, v) for k, w, v in res_a.series()}
+    b = {k: (w, v) for k, w, v in res_b.series()}
+    assert set(a) == set(b), q
+    for k in a:
+        assert np.array_equal(a[k][0], b[k][0]), q
+        va, vb = a[k][1], b[k][1]
+        both_nan = np.isnan(va) & np.isnan(vb)
+        assert np.array_equal(va[~both_nan], vb[~both_nan]), \
+            (q, va[:8], vb[:8])
+
+
+QUERIES = [
+    "m",
+    "sum(m)",
+    "sum(rate(m[10m]))",            # lookback straddles the tier split
+    "avg_over_time(m[30m])",        # wide window across the boundary
+    "max by (inst) (m)",
+]
+
+
+@pytest.mark.parametrize("q", QUERIES)
+def test_stitched_matches_single_tier(tiered, q):
+    eng_tiered, eng_ref, ts_grid, earliest_raw = tiered
+    start_s = int(ts_grid[0]) // 1000 + 1800
+    end_s = int(ts_grid[-1]) // 1000
+    res_t = eng_tiered.query_range(q, start_s, 300, end_s)
+    res_r = eng_ref.query_range(q, start_s, 300, end_s)
+    _assert_identical(res_t, res_r, q)
+    assert res_t.stats.cold_tier in ("cold_hit", "cold_paged")
+
+
+def test_query_exactly_at_raw_retention_edge(tiered):
+    """Instants at the exact retention boundary: the straddle hazard —
+    the raw tier serves only instants whose FULL lookback is in memory;
+    the instant straddling the edge comes from the persisted tier."""
+    eng_tiered, eng_ref, ts_grid, earliest_raw = tiered
+    # grid aligned so one instant lands exactly on earliest_raw
+    start_s = earliest_raw // 1000 - 1200
+    end_s = earliest_raw // 1000 + 1200
+    for q in ("sum(rate(m[10m]))", "m"):
+        res_t = eng_tiered.query_range(q, start_s, 300, end_s)
+        res_r = eng_ref.query_range(q, start_s, 300, end_s)
+        _assert_identical(res_t, res_r, q)
+
+
+def test_query_entirely_before_raw(tiered):
+    eng_tiered, eng_ref, ts_grid, earliest_raw = tiered
+    start_s = int(ts_grid[0]) // 1000 + 1800
+    end_s = earliest_raw // 1000 - 3600
+    res_t = eng_tiered.query_range("sum(rate(m[10m]))", start_s, 300, end_s)
+    res_r = eng_ref.query_range("sum(rate(m[10m]))", start_s, 300, end_s)
+    _assert_identical(res_t, res_r, "pre-raw")
+
+
+def test_downsample_edge_with_three_tiers(tmp_path):
+    """Oldest data only in downsample, middle in segments, tail in raw
+    memory — one query stitches all three, identical to a single-tier
+    store (downsample at the scrape resolution: periods hold exactly one
+    sample, so ds values/timestamps equal raw)."""
+    from filodb_tpu.downsample import (DownsampleClusterPlanner,
+                                       DownsampledTimeSeriesStore,
+                                       ShardDownsampler)
+    ts_grid, pks, vals = _grid(), _pks(), _vals()
+    res_ms = 300_000
+    ts_grid = T0 + np.arange(NS, dtype=np.int64) * res_ms   # 5m scrape
+    cs = LocalDiskColumnStore(str(tmp_path))
+    ms_full = TimeSeriesMemStore(column_store=cs)
+    sh = ms_full.setup(DS, 0)
+    sh.shard_downsampler = ShardDownsampler(resolutions=(res_ms,))
+    sh.ingest_columns("gauge", pks, np.broadcast_to(ts_grid, (S, NS)),
+                      {"value": vals})
+    sh.flush_all_groups()
+    ds_store = DownsampledTimeSeriesStore(DS, column_store=cs,
+                                          resolutions=(res_ms,))
+    ds_store.setup_shard(0)
+    ds_store.ingest_downsample_batches(
+        0, sh.shard_downsampler.result_batches())
+    # segments cover only the MIDDLE of history: windows [1, N)
+    seg_store = SegmentStore(str(tmp_path))
+    comp = SegmentCompactor(cs, seg_store, DS, 1,
+                            window_ms=WINDOW * 2, closed_lag_ms=0)
+    comp.compact_all(now_ms=int(ts_grid[-1]) + 100 * WINDOW)
+    metas = seg_store.list(DS, 0)
+    seg_store.remove(metas[0])           # oldest window: downsample-only
+    tier = PersistedTier(seg_store, DS, 1,
+                         ColdSegmentCache(256 << 20, use_placer=False))
+    assert tier.range()[0] > int(ts_grid[0])
+    # live memory: last quarter
+    tail_from = 3 * NS // 4
+    ms_live = TimeSeriesMemStore()
+    live = ms_live.setup(DS, 0)
+    live.ingest_columns("gauge", pks,
+                        np.broadcast_to(ts_grid[tail_from:],
+                                        (S, NS - tail_from)),
+                        {"value": vals[:, tail_from:]})
+    ms_ref = TimeSeriesMemStore()
+    ref = ms_ref.setup(DS, 0)
+    ref.ingest_columns("gauge", pks, np.broadcast_to(ts_grid, (S, NS)),
+                       {"value": vals})
+    mapper = _mapper()
+    earliest_raw = int(ts_grid[tail_from])
+
+    class _DsSrc(_Src):
+        def get_shard(self, dataset, shard_num):
+            if "::ds::" in dataset:
+                return ds_store.get_shard(dataset, shard_num)
+            return self.store.get_shard(dataset, shard_num)
+
+    ltr = LongTimeRangePlanner(
+        SingleClusterPlanner(DS, mapper),
+        DownsampleClusterPlanner(ds_store, mapper),
+        earliest_raw_time_fn=lambda: earliest_raw,
+        latest_downsample_time_fn=lambda: 1 << 62,
+        persisted_planner=PersistedClusterPlanner(DS, mapper, tier),
+        persisted_range_fn=tier.range)
+    eng_tiered = QueryEngine(DS, _DsSrc(ms_live), mapper, planner=ltr)
+    eng_ref = QueryEngine(DS, _Src(ms_ref), mapper,
+                          planner=SingleClusterPlanner(DS, mapper))
+    start_s = int(ts_grid[0]) // 1000 + 3600
+    end_s = int(ts_grid[-1]) // 1000
+    for q in ("m", "sum(m)"):
+        res_t = eng_tiered.query_range(q, start_s, 600, end_s)
+        res_r = eng_ref.query_range(q, start_s, 600, end_s)
+        _assert_identical(res_t, res_r, q)
+
+
+# -------------------------------------------------- planner-level (unit)
+
+
+class _RecordingPlanner:
+    def __init__(self, tag):
+        self.tag = tag
+        self.materialized = []
+
+    def materialize(self, plan, ctx):
+        from filodb_tpu.query.exec import ExecPlan
+        from filodb_tpu.query.rangevector import QueryStats
+
+        class _D(ExecPlan):
+            def __init__(self, tag, plan):
+                super().__init__(QueryContext())
+                self.tag, self.plan = tag, plan
+
+            def _do_execute(self, source):
+                return None, QueryStats()
+        self.materialized.append(plan)
+        return _D(self.tag, plan)
+
+
+def _plan(q, start_s, end_s, step_s=60):
+    return query_range_to_logical_plan(
+        q, TimeStepParams(start_s, step_s, end_s))
+
+
+def test_ltr_three_way_split_routes_and_abuts():
+    start_ms = 1_600_000_000_000
+    raw, ds, pers = (_RecordingPlanner("raw"), _RecordingPlanner("ds"),
+                     _RecordingPlanner("pers"))
+    earliest_raw = start_ms + 3 * 3600_000
+    p_range = (start_ms + 3600_000, start_ms + 10 * 86_400_000)
+    ltr = LongTimeRangePlanner(
+        raw, ds, lambda: earliest_raw, lambda: 1 << 62,
+        persisted_planner=pers, persisted_range_fn=lambda: p_range)
+    p = _plan("rate(foo[5m])", start_ms // 1000,
+              (start_ms + 6 * 3600_000) // 1000)
+    out = ltr.materialize(p, QueryContext())
+    assert isinstance(out, StitchRvsExec)
+    assert len(ds.materialized) == 1
+    assert len(pers.materialized) == 1
+    assert len(raw.materialized) == 1
+    dsp, pp, rp = (ds.materialized[0], pers.materialized[0],
+                   raw.materialized[0])
+    # raw starts at the first instant whose full 5m window is in memory
+    assert rp.start_ms >= earliest_raw + 300_000
+    assert (rp.start_ms - p.start_ms) % p.step_ms == 0
+    # persisted ends right before raw begins; ds right before persisted
+    assert pp.end_ms == rp.start_ms - p.step_ms
+    assert pp.start_ms >= p_range[0] + 300_000
+    assert dsp.end_ms == pp.start_ms - p.step_ms
+    assert dsp.start_ms == p.start_ms
+
+
+def test_ltr_no_segments_falls_back_to_downsample():
+    start_ms = 1_600_000_000_000
+    raw, ds, pers = (_RecordingPlanner("raw"), _RecordingPlanner("ds"),
+                     _RecordingPlanner("pers"))
+    ltr = LongTimeRangePlanner(
+        raw, ds, lambda: start_ms + 10 * 3600_000, lambda: 1 << 62,
+        persisted_planner=pers, persisted_range_fn=lambda: None)
+    p = _plan("rate(foo[5m])", start_ms // 1000,
+              (start_ms + 3600_000) // 1000)
+    ltr.materialize(p, QueryContext())
+    assert len(pers.materialized) == 0
+    assert len(ds.materialized) == 1
+
+
+def test_ltr_fully_in_raw_never_touches_cold_tiers():
+    start_ms = 1_600_000_000_000
+    raw, ds, pers = (_RecordingPlanner("raw"), _RecordingPlanner("ds"),
+                     _RecordingPlanner("pers"))
+    ltr = LongTimeRangePlanner(
+        raw, ds, lambda: start_ms - 86_400_000, lambda: 1 << 62,
+        persisted_planner=pers,
+        persisted_range_fn=lambda: (0, start_ms))
+    p = _plan("rate(foo[5m])", start_ms // 1000,
+              (start_ms + 3600_000) // 1000)
+    ltr.materialize(p, QueryContext())
+    assert len(raw.materialized) == 1
+    assert not ds.materialized and not pers.materialized
+
+
+def test_ltr_head_older_than_segments_falls_back_to_raw():
+    """No downsample tier: grid instants older than segment coverage must
+    route to the raw cluster's chunk-paging path, never be dropped."""
+    start_ms = 1_600_000_000_000
+    raw, pers = _RecordingPlanner("raw"), _RecordingPlanner("pers")
+    earliest_raw = start_ms + 5 * 3600_000
+    p_range = (start_ms + 2 * 3600_000, start_ms + 10 * 86_400_000)
+    ltr = LongTimeRangePlanner(
+        raw, None, lambda: earliest_raw, lambda: 1 << 62,
+        persisted_planner=pers, persisted_range_fn=lambda: p_range)
+    p = _plan("rate(foo[5m])", start_ms // 1000,
+              (start_ms + 8 * 3600_000) // 1000)
+    out = ltr.materialize(p, QueryContext())
+    assert isinstance(out, StitchRvsExec)
+    assert len(pers.materialized) == 1
+    # head before segment coverage AND the in-memory tail both go to raw
+    assert len(raw.materialized) == 2
+    head = min(raw.materialized, key=lambda pl: pl.start_ms)
+    assert head.start_ms == p.start_ms
+    assert head.end_ms == pers.materialized[0].start_ms - p.step_ms
+
+
+def test_retention_keeps_frames_ingested_after_last_compaction(tmp_path):
+    """A backfill frame flushed AFTER the compaction pass read the index
+    must survive retention until a later pass folds it into a segment."""
+    ts_grid, pks, vals = _grid(), _pks(), _vals()
+    cs = LocalDiskColumnStore(str(tmp_path))
+    ms = TimeSeriesMemStore(column_store=cs)
+    sh = ms.setup(DS, 0)
+    sh.ingest_columns("gauge", pks, np.broadcast_to(ts_grid, (S, NS)),
+                      {"value": vals})
+    sh.flush_all_groups()
+    seg_store = SegmentStore(str(tmp_path))
+    comp = SegmentCompactor(cs, seg_store, DS, 1, window_ms=WINDOW,
+                            closed_lag_ms=0)
+    now = int(ts_grid[-1]) + 10 * WINDOW
+    comp.compact_all(now_ms=now)
+    # backfill lands AFTER the pass: old data timestamps, fresh ingestion
+    late_pk = [PartKey("m", (("inst", "late"), ("_ws_", "w"),
+                             ("_ns_", "n")))]
+    sh.ingest_columns("gauge", late_pk, ts_grid[None, :5],
+                      {"value": np.full((1, 5), 3.0)})
+    sh.flush_all_groups()
+    comp.enforce_retention(retain_raw_ms=1, now_ms=now)
+    # the late frame survived (its ingestion time postdates the pass)
+    assert cs.read_chunks(DS, 0, late_pk[0], int(ts_grid[0]),
+                          int(ts_grid[-1]))
+    # a later compact pass folds it in; only then is it prunable
+    assert comp.compact_all(now_ms=now) >= 1
+    comp.enforce_retention(retain_raw_ms=1, now_ms=now)
+    assert cs.read_chunks(DS, 0, late_pk[0], int(ts_grid[0]),
+                          int(ts_grid[-1])) == []
+    metas = seg_store.list(DS, 0)
+    blockful = sum(m.num_samples for m in metas)
+    assert blockful == S * NS + 5        # nothing lost
+
+
+def test_persisted_scan_cap_counts_matched_rows_only(tmp_path):
+    """The cold scan cap must reflect the FILTERED working set (hot-leaf
+    parity), not the shard's total segment volume."""
+    from filodb_tpu.query.rangevector import PlannerParams
+    ts_grid, pks, vals = _grid(), _pks(), _vals()
+    cs = LocalDiskColumnStore(str(tmp_path))
+    ms = TimeSeriesMemStore(column_store=cs)
+    sh = ms.setup(DS, 0)
+    sh.ingest_columns("gauge", pks, np.broadcast_to(ts_grid, (S, NS)),
+                      {"value": vals})
+    sh.flush_all_groups()
+    seg_store = SegmentStore(str(tmp_path))
+    SegmentCompactor(cs, seg_store, DS, 1, window_ms=WINDOW,
+                     closed_lag_ms=0).compact_all(
+        now_ms=int(ts_grid[-1]) + 10 * WINDOW)
+    tier = PersistedTier(seg_store, DS, 1,
+                         ColdSegmentCache(256 << 20, use_placer=False))
+    mapper = _mapper()
+    eng = QueryEngine(DS, _Src(ms), mapper,
+                      planner=PersistedClusterPlanner(DS, mapper, tier))
+    start_s = int(ts_grid[0]) // 1000 + 1800
+    end_s = int(ts_grid[-1]) // 1000
+    # limit sized for ONE series' samples (+ slack), far below total
+    params = PlannerParams(scan_limit=NS + NS // 2, enforced_limits=True)
+    res = eng.query_range('m{inst="i1"}', start_s, 300, end_s,
+                          planner_params=params)
+    assert res.error is None, res.error
+    assert res.num_series == 1
+    # the broad query over the same limit is rejected
+    res = eng.query_range("m", start_s, 300, end_s, planner_params=params)
+    assert res.error is not None and "scan limit" in res.error
+
+
+def test_persisted_planner_splits_long_ranges():
+    mapper = _mapper()
+
+    class _FakeTier:
+        plan_split_ms = 24 * 3600 * 1000
+        schemas = None
+
+        def covering(self, *a, **k):
+            return []
+
+    planner = PersistedClusterPlanner(DS, mapper, _FakeTier())
+    start_s = 1_600_000_000
+    p = _plan('sum(rate(m[5m]))', start_s, start_s + 5 * 86_400, step_s=300)
+    out = planner.materialize(p, QueryContext())
+    assert isinstance(out, StitchRvsExec)
+    assert len(out.children) >= 5
+    # leaves are persisted-segment execs
+    leaf = out.children[0]
+    while getattr(leaf, "children", None):
+        leaf = leaf.children[0]
+    assert isinstance(leaf, SelectPersistedSegmentsExec)

@@ -20,6 +20,14 @@ of every headline metric is greppable in one file:
     of WAL-off), ``wal_replay_samples_per_sec``, and the kill-chaos
     proof ``wal_kill_acked_lost`` (gate: 0) /
     ``wal_kill_query_identical`` — plus a loud ``wal_error``.
+  - the historical-tier numbers (PR 8):
+    ``longrange_cold_scan_samples_per_sec`` (gate: >= 1/10 of the
+    in-memory first-scan number), ``longrange_warm_cold_ratio``
+    (gate: >= 0.5), ``longrange_stitch_identical`` (gate: true — the
+    raw+downsample+persisted stitch is bit-identical to a single-tier
+    store), ``longrange_lru_bounded`` (the cold region never exceeded
+    its byte budget) — plus a loud ``longrange_error`` when the stage
+    fails (merge-not-clobber like every other key).
 
 Existing hand-written round entries are MERGED, never clobbered: only
 missing keys are added, so curated notes survive re-runs.
@@ -53,6 +61,9 @@ CARRY = [
     "wal_on_vs_off_pct", "wal_on_samples_per_sec",
     "wal_replay_samples_per_sec", "wal_kill_acked_lost",
     "wal_kill_query_identical", "wal_error",
+    "longrange_cold_scan_samples_per_sec", "longrange_warm_cold_ratio",
+    "longrange_stitch_identical", "longrange_cold_vs_mem_ratio",
+    "longrange_lru_bounded", "longrange_gate_ok", "longrange_error",
 ]
 RENAME = {"value": "headline_samples_per_sec",
           "p50_query_latency_s": "p50_s"}
