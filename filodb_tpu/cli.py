@@ -265,6 +265,69 @@ def cmd_rules(args) -> int:
     return 0 if payload.get("status") == "success" else 1
 
 
+def cmd_health(args) -> int:
+    """Node health over HTTP: the full per-subsystem verdict tree
+    (GET /api/v1/status/health) or the readiness probe (`--ready`:
+    GET /ready, exit 0 ready / 1 unready — scriptable in rolling-restart
+    loops).  Exit codes mirror the verdict: 0 ok, 1 degraded, 2 failed
+    or unreachable."""
+    if args.ready:
+        payload = _http_get(args.host, "/ready", {})
+        print(json.dumps(payload, indent=2))
+        return 0 if payload.get("status") == "ready" else 1
+    payload = _http_get(args.host, "/api/v1/status/health", {})
+    print(json.dumps(payload, indent=2))
+    if payload.get("status") != "success":
+        return 2
+    verdict = payload["data"].get("status")
+    return {"ok": 0, "degraded": 1}.get(verdict, 2)
+
+
+def cmd_jobs(args) -> int:
+    """Background-job registry over HTTP (GET /admin/jobs): one line per
+    recurring worker — streak, last duration, progress — the "what is
+    this node doing" table."""
+    payload = _http_get(args.host, "/admin/jobs", {})
+    if payload.get("status") != "success":
+        print(json.dumps(payload, indent=2))
+        return 1
+    if args.raw:
+        print(json.dumps(payload, indent=2))
+        return 0
+    rows = payload["data"]["jobs"]
+    print(f"{'JOB':<24} {'DATASET':<12} {'RUNS':>7} {'ERRS':>6} "
+          f"{'STREAK':>6} {'LAST_S':>9}  PROGRESS")
+    for j in rows:
+        print(f"{j['job']:<24} {j['dataset'] or '-':<12} "
+              f"{j['runs']:>7} {j['errors']:>6} "
+              f"{j['consecutiveErrors']:>6} "
+              f"{j['lastDurationSeconds']:>9.4f}  "
+              f"{j['progress'] or j['lastError'] or ''}")
+    return 0
+
+
+def cmd_events(args) -> int:
+    """Tail the structured event journal over HTTP (GET /admin/events):
+    newest events once, from a sequence number (`--since-seq`), or
+    continuously (`--follow`, resuming by sequence so nothing is missed
+    between polls) — the "what changed?" flight recorder."""
+    since = args.since_seq
+    while True:
+        params = {"since_seq": str(since), "limit": str(args.limit)}
+        if args.kind:
+            params["kind"] = args.kind
+        payload = _http_get(args.host, "/admin/events", params)
+        if payload.get("status") != "success":
+            print(json.dumps(payload, indent=2))
+            return 1
+        for ev in payload["data"]["events"]:
+            print(json.dumps(ev, separators=(",", ":")))
+            since = max(since, ev["seq"])
+        if not args.follow:
+            return 0
+        time.sleep(args.interval)
+
+
 def cmd_checkrules(args) -> int:
     """Validate a rules file offline (the promtool `check rules`
     analogue): parse + validate every group/expr without a server."""
@@ -576,6 +639,34 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--type", choices=["record", "alert"], default="",
                     help="filter rule groups by rule type")
     sp.set_defaults(fn=cmd_rules)
+
+    sp = sub.add_parser("health", help="node health verdict tree over "
+                                       "HTTP (exit 0 ok / 1 degraded / "
+                                       "2 failed)")
+    sp.add_argument("--host", required=True)
+    sp.add_argument("--ready", action="store_true",
+                    help="probe GET /ready instead (exit 0/1)")
+    sp.set_defaults(fn=cmd_health)
+
+    sp = sub.add_parser("jobs", help="background-job registry over HTTP")
+    sp.add_argument("--host", required=True)
+    sp.add_argument("--raw", action="store_true",
+                    help="print the raw JSON payload")
+    sp.set_defaults(fn=cmd_jobs)
+
+    sp = sub.add_parser("events", help="tail the event journal over HTTP")
+    sp.add_argument("--host", required=True)
+    sp.add_argument("--since-seq", type=int, default=0,
+                    help="resume from this sequence number (exclusive)")
+    sp.add_argument("--limit", type=int, default=100,
+                    help="newest N events per poll (0 = all available)")
+    sp.add_argument("--kind", default="",
+                    help="only events of this kind")
+    sp.add_argument("--follow", action="store_true",
+                    help="poll continuously, resuming by sequence")
+    sp.add_argument("--interval", type=float, default=2.0,
+                    help="poll interval with --follow (seconds)")
+    sp.set_defaults(fn=cmd_events)
 
     sp = sub.add_parser("checkrules", help="validate a rules file offline")
     sp.add_argument("file", help="rules file (.json or HOCON-lite .conf)")

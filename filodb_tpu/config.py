@@ -221,6 +221,23 @@ class WalConfig:
 
 
 @dataclasses.dataclass
+class SelfMonConfig:
+    """Self-scrape meta-monitoring (utils/selfmon.py;
+    doc/observability.md): an in-process loop snapshots the metrics
+    registry every `interval_s` and writes every counter/gauge/histogram
+    through the columnar ingest path under the reserved `_self_` tenant
+    (gauge schema, `job="filodb"`, `instance` = the node id) — making
+    the TSDB's own telemetry PromQL-queryable and ruler-alertable
+    through its own engines (the Prometheus meta-monitoring / Monarch
+    monitors-itself stance).  `_self_` is exempt from the scan-limit
+    gate like `_rules_` but fully accounted."""
+    enabled: bool = False
+    interval_s: float = 15.0
+    # target dataset; "" = the server's default (first) dataset
+    dataset: str = ""
+
+
+@dataclasses.dataclass
 class StoreConfig:
     """Per-dataset store tuning (ref: core/.../store/IngestionConfig.scala:211 area,
     conf/timeseries-dev-source.conf `store {}` block)."""
@@ -321,11 +338,17 @@ class FilodbSettings:
     # store stays bounded either way (256 traces x 512 events).
     trace_export_url: str = ""
     spread_assignment: List[SpreadAssignment] = dataclasses.field(default_factory=list)
+    # structured event journal (utils/events.py; served at /admin/events):
+    # bounded ring size + optional JSONL mirror ("" disables the sink —
+    # the ring stays bounded either way)
+    event_journal_max_entries: int = 2048
+    event_journal_path: str = ""
     query: QueryConfig = dataclasses.field(default_factory=QueryConfig)
     store: StoreConfig = dataclasses.field(default_factory=StoreConfig)
     breaker: BreakerConfig = dataclasses.field(default_factory=BreakerConfig)
     rules: RulesConfig = dataclasses.field(default_factory=RulesConfig)
     wal: WalConfig = dataclasses.field(default_factory=WalConfig)
+    selfmon: SelfMonConfig = dataclasses.field(default_factory=SelfMonConfig)
     shard_key_level_metrics: bool = True
     quota_default: int = 2_000_000_000
     reassignment_min_interval_s: float = 2 * 3600.0
@@ -360,7 +383,8 @@ class FilodbSettings:
                 raise ConfigError(f"{source}: {e}")
         for section, obj in (("query", self.query), ("store", self.store),
                              ("breaker", self.breaker),
-                             ("rules", self.rules), ("wal", self.wal)):
+                             ("rules", self.rules), ("wal", self.wal),
+                             ("selfmon", self.selfmon)):
             for k, v in (raw.pop(section, None) or {}).items():
                 _set_field(obj, k, v, f"{source}: {section}.{k}")
         if "spread_assignment" in raw:
@@ -406,7 +430,7 @@ class FilodbSettings:
             from filodb_tpu.utils.hoconlite import _parse_scalar
             parsed = _parse_scalar(val)
             for section in ("query_", "store_", "breaker_", "rules_",
-                            "wal_"):
+                            "wal_", "selfmon_"):
                 if rest.startswith(section):
                     overlay.setdefault(section[:-1], {})[
                         rest[len(section):]] = parsed

@@ -423,6 +423,10 @@ class DeviceMirror:
         if nbytes > self.hbm_limit_bytes:
             # silently-degraded path flagged in round 1: make it observable
             metrics_registry.counter("device_mirror_over_cap").increment()
+            from filodb_tpu.utils.events import journal
+            journal.emit("mirror_over_cap", subsystem="mirror",
+                         scope="store", nbytes=nbytes,
+                         limit=self.hbm_limit_bytes)
             # a stale snapshot's device arrays would keep HBM allocated
             # (and, sharded, make the zeroed booking a lie the placer
             # trusts) — drop it; host gathers serve from here
@@ -441,6 +445,10 @@ class DeviceMirror:
             if placer.booked(self.device) > self.hbm_limit_bytes:
                 metrics_registry.counter(
                     "device_mirror_device_over_cap").increment()
+                from filodb_tpu.utils.events import journal
+                journal.emit("mirror_over_cap", subsystem="mirror",
+                             scope="device", nbytes=nbytes,
+                             limit=self.hbm_limit_bytes)
                 self._snap = None
                 self._book(0)
                 return False
@@ -599,21 +607,38 @@ class DeviceMirror:
             return True
 
     def _bg_refresh(self, shard, store) -> None:
+        from filodb_tpu.utils.events import journal
+        from filodb_tpu.utils.jobs import jobs
         from filodb_tpu.utils.metrics import (log_error_once, registry,
                                               span)
         # progress gauge: >0 while rebuilds are off-path in flight, so an
         # operator watching /metrics sees the eviction recovery running
         # (the span histogram records its duration when it completes)
         _note_rebuild(+1)
+        # per-shard handle: concurrent rebuilds of different shards must
+        # not share tick state (one shard's success would reset another
+        # persistently-failing shard's streak mid-tick)
+        sn = getattr(shard, "shard_num", -1)
+        job = jobs.register(
+            "mirror_rebuild",
+            dataset=f"{getattr(shard, 'dataset', '')}/{sn}")
+        journal.emit("mirror_rebuild_started", subsystem="mirror",
+                     shard=sn)
         try:
-            with span("mirror_bg_rebuild"):
-                with shard._write_locked("mirror_bg_rebuild"):
-                    ok = self.ensure_fresh(store)
+            with job.tick():
+                job.set_progress(f"shard {sn}")
+                with span("mirror_bg_rebuild"):
+                    with shard._write_locked("mirror_bg_rebuild"):
+                        ok = self.ensure_fresh(store)
             if ok:
                 registry.counter("device_mirror_bg_rebuilds").increment()
+            journal.emit("mirror_rebuild_done", subsystem="mirror",
+                         shard=sn, ok=ok)
         except Exception as e:  # noqa: BLE001 — queries already fall back
             registry.counter("device_mirror_bg_rebuild_errors").increment()
             log_error_once("device_mirror_bg_rebuild", e)
+            journal.emit("mirror_rebuild_failed", subsystem="mirror",
+                         shard=sn, error=f"{type(e).__name__}: {e}")
         finally:
             _note_rebuild(-1)
 

@@ -59,6 +59,12 @@ class FlushScheduler:
         # horizons (only the flush thread touches them)
         self._err_streak: Dict[int, int] = {}
         self._backoff_until: Dict[int, float] = {}
+        # unified job registry (utils/jobs): last tick / duration / lag /
+        # error streak at GET /admin/jobs; critical — a flush scheduler
+        # failing across shards flips /ready (data is not persisting)
+        from filodb_tpu.utils.jobs import jobs
+        self.job = jobs.register("flush", interval_s=interval_s,
+                                 dataset=dataset, critical=True)
 
     # ------------------------------------------------------------------ control
 
@@ -100,6 +106,13 @@ class FlushScheduler:
         self._backoff_until[shard.shard_num] = time.monotonic() + delay
         registry.gauge("flush_backoff_active", dataset=self.dataset
                        ).update(len(self._backoff_until))
+        if streak == 1:
+            # journal the ok->backing-off edge only (a broken store must
+            # not flood the flight recorder once per tick)
+            from filodb_tpu.utils.events import journal
+            journal.emit("flush_backoff", subsystem="flush",
+                         dataset=self.dataset, shard=shard.shard_num,
+                         delay_s=round(delay, 3))
 
     def _note_flush_ok(self, shard) -> None:
         if self._err_streak.pop(shard.shard_num, None) is not None:
@@ -129,27 +142,50 @@ class FlushScheduler:
             # one group per tick across all shards -> every group flushes
             # once per interval_s, like the reference's flush stream
             tick = self.interval_s / max(n_groups, 1)
-            for shard in shards:
-                if self._stop.is_set():
-                    return
-                until = self._backoff_until.get(shard.shard_num)
-                if until is not None and time.monotonic() < until:
-                    continue            # shard backing off after errors
-                try:
-                    if group < shard._groups:
-                        # background flushes batch small partitions (the
-                        # write-buffer behavior); direct flush calls seal all
-                        shard.flush_group(
-                            group,
-                            min_samples=shard.config.store.min_flush_samples)
-                        self.flushes += 1
-                        self._note_flush_ok(shard)
-                except Exception:  # noqa: BLE001
-                    self._note_flush_error(shard, tick)
-                    _log.exception("background flush failed shard=%d group=%d "
-                                   "(streak=%d, backing off)",
-                                   shard.shard_num, group,
-                                   self._err_streak[shard.shard_num])
+            # per-pass job accounting: a pass is one group across every
+            # shard, so the declared schedule the lag histogram measures
+            # against is the per-group tick, not the full rotation
+            self.job.interval_s = tick
+            with self.job.tick() as jt:
+                self.job.set_progress(
+                    f"group {group + 1}/{n_groups}, "
+                    f"{len(shards)} shard(s)")
+                wrote = 0
+                for shard in shards:
+                    if self._stop.is_set():
+                        return
+                    until = self._backoff_until.get(shard.shard_num)
+                    if until is not None and time.monotonic() < until:
+                        continue        # shard backing off after errors
+                    try:
+                        if group < shard._groups:
+                            # background flushes batch small partitions
+                            # (the write-buffer behavior); direct flush
+                            # calls seal all
+                            wrote += shard.flush_group(
+                                group,
+                                min_samples=shard.config.store
+                                .min_flush_samples)
+                            self.flushes += 1
+                            self._note_flush_ok(shard)
+                    except Exception as e:  # noqa: BLE001
+                        self._note_flush_error(shard, tick)
+                        self.job.note_error(e)
+                        _log.exception(
+                            "background flush failed shard=%d group=%d "
+                            "(streak=%d, backing off)",
+                            shard.shard_num, group,
+                            self._err_streak[shard.shard_num])
+                if wrote == 0:
+                    # a pass that PERSISTED nothing is NEUTRAL for the
+                    # job streak: empty groups and backed-off shards
+                    # prove nothing about the store, and counting them
+                    # as successes would reset the consecutive-error
+                    # streak while persists are still failing — the
+                    # /ready flip for a broken store could never engage
+                    # (per-shard streaks/backoff are tracked separately
+                    # above and unaffected)
+                    jt.skip()
             group += 1
             if group >= n_groups:
                 group = 0

@@ -1465,6 +1465,10 @@ class TimeSeriesShard:
         metrics_registry.counter("memory_pressure_evictions",
                                  dataset=self.dataset).increment()
         self.stats.evictions += 1
+        from filodb_tpu.utils.events import journal
+        journal.emit("eviction_sweep", subsystem="memstore",
+                     reason="memory_pressure", dataset=self.dataset,
+                     shard=self.shard_num, bytes_released=released)
         return released
 
     # ---------------------------------------------------------------- eviction
@@ -1526,6 +1530,14 @@ class TimeSeriesShard:
                     self._key_resolve_cache.clear()
                 total += evicted
                 if cand.size <= max_per_lock:
+                    if total:
+                        from filodb_tpu.utils.events import journal
+                        journal.emit("eviction_sweep",
+                                     subsystem="memstore",
+                                     reason="ended_partitions",
+                                     dataset=self.dataset,
+                                     shard=self.shard_num,
+                                     partitions_evicted=total)
                     return total
 
     @property

@@ -33,7 +33,7 @@ class PromHttpApi:
                  shard_mappers: Optional[Dict[str, object]] = None,
                  default_dataset: Optional[str] = None,
                  batch_window_ms: Optional[float] = None,
-                 config=None, ruler=None):
+                 config=None, ruler=None, health=None):
         import time as _time
         self.engines = engines
         self.gateways = gateways or {}
@@ -44,7 +44,22 @@ class PromHttpApi:
         # /admin/rules/reload verb.  FiloServer attaches it post-
         # construction (the ruler needs this API's frontends to exist).
         self.ruler = ruler
+        # health model (utils/health.py): FiloServer injects its own
+        # evaluator with real phase transitions; a bare API construction
+        # gets a default already in `serving` so route-level tests see
+        # /ready 200 without a server lifecycle.  Shard mappers feed the
+        # shard-recovery verdict.
+        if health is None:
+            from filodb_tpu.utils.health import HealthEvaluator
+            health = HealthEvaluator()
+        self.health = health
+        self.health.shard_mappers = self.shard_mappers
         self._start_unix = _time.time()
+        # last-config-reload status for /api/v1/status/runtimeinfo (the
+        # Prometheus reloadConfigSuccess/lastConfigTime pair); rules
+        # reloads are the live config-reload surface this server has
+        self._last_reload_unix = self._start_unix
+        self._last_reload_ok = True
         # Query-serving frontend per dataset (query/frontend.py):
         # singleflight dedup of byte-identical in-flight requests, the
         # step-aligned incremental result cache, a bounded concurrent
@@ -92,6 +107,13 @@ class PromHttpApi:
         try:
             if parts == ["__health"]:
                 return 200, {"status": "healthy"}
+            if parts == ["healthz"]:
+                # liveness: the process + HTTP loop answered — that IS
+                # the signal (Prometheus /-/healthy semantics)
+                return 200, {"status": "alive",
+                             "phase": self.health.phase}
+            if parts == ["ready"]:
+                return self._ready()
             if parts == ["metrics"]:
                 return self._own_metrics()
             if parts[:1] == ["promql"] and len(parts) >= 4 \
@@ -117,6 +139,10 @@ class PromHttpApi:
             if parts[:2] == ["admin", "breakers"] and len(parts) == 2 \
                     and method == "GET":
                 return self._breakers()
+            if parts == ["admin", "jobs"] and method == "GET":
+                return self._jobs()
+            if parts == ["admin", "events"] and method == "GET":
+                return self._events(params)
             if parts == ["admin", "rules", "reload"] and method == "POST":
                 return self._rules_reload()
             if parts[:2] == ["admin", "traces"] and len(parts) in (2, 3):
@@ -248,6 +274,9 @@ class PromHttpApi:
             return self._buildinfo()
         if rest == ["status", "runtimeinfo"]:
             return self._runtimeinfo()
+        if rest == ["status", "health"]:
+            return 200, {"status": "success",
+                         "data": self.health.evaluate()}
         return 404, _err(f"unknown api/v1 endpoint {'/'.join(rest)}")
 
     # -------------------------------------------------------- remote write
@@ -614,6 +643,38 @@ class PromHttpApi:
                          "data": {"cleared": slowlog.clear()}}
         return 404, _err(f"unknown slowlog action {action!r} ({method})")
 
+    def _ready(self) -> Tuple[int, object]:
+        """Readiness probe (Prometheus /-/ready semantics): 503 during
+        boot WAL replay / shard recovery and while a critical subsystem
+        is failed — the signal a load balancer or rolling restart waits
+        on before routing traffic here (doc/operations.md)."""
+        ok, reason = self.health.ready()
+        if ok:
+            return 200, {"status": "ready"}
+        return 503, {"status": "unready", "reason": reason}
+
+    def _jobs(self) -> Tuple[int, object]:
+        """Unified background-job registry (utils/jobs.py): every
+        recurring worker's last start/end, duration, lag vs schedule,
+        consecutive-error streak, and progress string in one place."""
+        from filodb_tpu.utils.jobs import jobs
+        snaps = jobs.snapshot()
+        return 200, {"status": "success",
+                     "data": {"count": len(snaps), "jobs": snaps}}
+
+    def _events(self, params: Dict[str, str]) -> Tuple[int, object]:
+        """Structured event journal (utils/events.py): typed lifecycle
+        events with monotonic sequence numbers — GET
+        /admin/events?since_seq=N&limit=K resumes from a sequence (the
+        CLI's `events --follow` tail), ?kind= filters one event type."""
+        from filodb_tpu.utils.events import journal
+        since = _num_param(params, "since_seq", "0")
+        limit = _num_param(params, "limit", "0")
+        evs = journal.since(since, limit, kind=params.get("kind", ""))
+        return 200, {"status": "success",
+                     "data": {"nextSeq": journal.next_seq,
+                              "count": len(evs), "events": evs}}
+
     def _breakers(self) -> Tuple[int, object]:
         """Per-peer circuit-breaker states (parallel/breaker.py): which
         remote nodes the query transport is currently failing fast on,
@@ -650,13 +711,20 @@ class PromHttpApi:
         """POST /admin/rules/reload: re-read the conf-tree groups + the
         standalone rules file.  Invalid config is a 400 and the RUNNING
         rules keep evaluating (Prometheus reload semantics)."""
+        import time as _time
         if self.ruler is None:
             return 400, _err("no ruler configured (rules.enabled=false)")
         from filodb_tpu.rules.config import RulesConfigError
         try:
             summary = self.ruler.reload()
         except RulesConfigError as e:
+            # runtimeinfo's reloadConfigSuccess mirrors the Prometheus
+            # field: the last reload ATTEMPT failed (running rules keep
+            # evaluating on the previous config)
+            self._last_reload_ok = False
             return 400, _err(f"rules reload rejected: {e}")
+        self._last_reload_ok = True
+        self._last_reload_unix = _time.time()
         return 200, {"status": "success", "data": summary}
 
     # -------------------------------------------------------------- status
@@ -694,17 +762,27 @@ class PromHttpApi:
                 if shard is not None:
                     n_series += shard.num_partitions
         retention_s = self._config.store.disk_time_to_live_s
+        # WAL posture for runbooks: enabled datasets + whether the boot
+        # replay completed (a restarted node mid-replay shows false —
+        # the same signal /ready turns into a 503)
+        wal = self.health.wal_summary()
+        wal_enabled = any(e["enabled"] for e in wal.values())
+        replay_done = all(e["replayDone"] for e in wal.values()
+                          if e["enabled"]) if wal_enabled else True
         return 200, {"status": "success", "data": {
             "startTime": iso(self._start_unix),
             "CWD": _os.getcwd(),
-            "reloadConfigSuccess": True,
-            "lastConfigTime": iso(self._start_unix),
+            "reloadConfigSuccess": self._last_reload_ok,
+            "lastConfigTime": iso(self._last_reload_unix),
             "corruptionCount": 0,
             "goroutineCount": _threading.active_count(),
             "GOMAXPROCS": _os.cpu_count() or 1,
             "storageRetention": f"{retention_s}s",
             "timeSeriesCount": n_series,
             "serverTime": iso(_time.time()),
+            "walEnabled": wal_enabled,
+            "walReplayDone": replay_done,
+            "serverPhase": self.health.phase,
         }}
 
     def _traces(self, trace_id) -> Tuple[int, object]:

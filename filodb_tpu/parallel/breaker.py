@@ -125,6 +125,13 @@ class CircuitBreaker:
         if state != self.state:
             registry.counter("breaker_transitions", peer=self.peer,
                              to=state).increment()
+            # the flight recorder sees every transition: "which peer
+            # opened right before the partial-results spike" is one
+            # GET /admin/events, correlated with slowlog by timestamp
+            from filodb_tpu.utils.events import journal
+            journal.emit(f"breaker_{state}", subsystem="peers",
+                         peer=self.peer,
+                         consecutive_failures=self.consecutive_failures)
         self.state = state
         registry.gauge("breaker_state",
                        peer=self.peer).update(_STATE_NUM[state])

@@ -139,6 +139,9 @@ class ClusterCoordinator:
                 self._nodes[node] = {"query_addr": tuple(req["query_addr"]),
                                      "last_seen": time.time()}
                 self.sm.add_member(node)
+                from filodb_tpu.utils.events import journal
+                journal.emit("node_joined", subsystem="cluster",
+                             node=node, members=len(self.sm.members))
                 _log.info("node %s registered (%d members)", node,
                           len(self.sm.members))
                 return {"ok": True,
@@ -185,6 +188,11 @@ class ClusterCoordinator:
                     _log.warning("node %s missed heartbeats for %.1fs — "
                                  "removing and reassigning its shards",
                                  node, now - self._nodes[node]["last_seen"])
+                    from filodb_tpu.utils.events import journal
+                    journal.emit(
+                        "node_dead", subsystem="cluster", node=node,
+                        last_seen_ago_s=round(
+                            now - self._nodes[node]["last_seen"], 2))
                     del self._nodes[node]
                     self.sm.remove_member(node)
 

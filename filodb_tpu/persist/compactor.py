@@ -275,6 +275,9 @@ class CompactionScheduler:
         self._thread: Optional[threading.Thread] = None
         self.passes = 0
         self.errors = 0
+        from filodb_tpu.utils.jobs import jobs
+        self.job = jobs.register("compaction", interval_s=interval_s,
+                                 dataset=compactor.dataset)
 
     def start(self) -> "CompactionScheduler":
         if self._thread is not None:
@@ -292,10 +295,23 @@ class CompactionScheduler:
             self._thread = None
 
     def run_once(self) -> int:
-        n = self.compactor.compact_all()
-        if self.retain_raw_ms > 0:
-            self.compactor.enforce_retention(self.retain_raw_ms)
-        self.passes += 1
+        with self.job.tick():
+            self.job.set_progress("compacting")
+            n = self.compactor.compact_all()
+            pruned = 0
+            if self.retain_raw_ms > 0:
+                self.job.set_progress("retention")
+                pruned = self.compactor.enforce_retention(
+                    self.retain_raw_ms)
+            self.passes += 1
+            self.job.set_progress(
+                f"pass {self.passes}: {n} segment(s), "
+                f"{pruned} frame(s) pruned")
+        if n or pruned:
+            from filodb_tpu.utils.events import journal
+            journal.emit("compaction_run", subsystem="compaction",
+                         dataset=self.compactor.dataset,
+                         segments_written=n, frames_pruned=pruned)
         return n
 
     def _run(self) -> None:

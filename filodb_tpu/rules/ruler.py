@@ -304,6 +304,7 @@ class Ruler:
 
     def _evaluate_state(self, gs: _GroupState,
                         ts: Optional[float] = None) -> bool:
+        from filodb_tpu.utils.jobs import jobs
         from filodb_tpu.utils.metrics import registry
         g = gs.group
         # whole-second evaluation timestamp: the instant-query API takes
@@ -315,8 +316,21 @@ class Ruler:
         ts = float(int(ts if ts is not None else self.clock()))
         t0 = time.perf_counter()
         ok = True
-        for rt in gs.runtimes:
-            ok = self._eval_rule(g, rt, ts) and ok
+        # unified job registry: one handle per group (idempotent across
+        # reloads — history carries over), so a group whose evaluations
+        # keep failing shows its streak at /admin/jobs and in the
+        # job_consecutive_errors gauge the shipped self-scrape alert
+        # group watches
+        job = jobs.register(f"ruler:{g.name}", interval_s=g.interval_s)
+        with job.tick():
+            for i, rt in enumerate(gs.runtimes):
+                job.set_progress(
+                    f"rule {i + 1}/{len(gs.runtimes)}: {rt.rule.name}")
+                ok = self._eval_rule(g, rt, ts) and ok
+            if not ok:
+                errs = "; ".join(rt.last_error for rt in gs.runtimes
+                                 if rt.last_error)[:300]
+                job.note_error(errs or "rule evaluation failed")
         gs.eval_seconds = time.perf_counter() - t0
         gs.last_eval_unix_s = ts
         registry.histogram("rule_group_eval_seconds",
@@ -609,8 +623,19 @@ class Ruler:
             if self._started:
                 for name in added + changed:
                     self._start_runner(nxt[name])
+        # a removed group's job handle must leave the registry with it:
+        # a stale failing-group streak would otherwise hold the health
+        # verdict degraded (and keep the self-scraped
+        # job_consecutive_errors gauge alerting) until process restart
+        from filodb_tpu.utils.jobs import jobs
+        for name in removed:
+            jobs.unregister(f"ruler:{name}")
+        from filodb_tpu.utils.events import journal
         from filodb_tpu.utils.metrics import registry
         registry.counter("rule_config_reloads").increment()
+        journal.emit("rules_reloaded", subsystem="rules",
+                     groups=len(new_groups), added=len(added),
+                     removed=len(removed), changed=len(changed))
         return {"groups": len(new_groups), "added": sorted(added),
                 "removed": sorted(removed), "changed": sorted(changed)}
 

@@ -50,6 +50,17 @@ class FiloServer:
                  http_host: str = "127.0.0.1", http_port: int = 0,
                  node_name: str = "local"):
         self.config = config or default_settings()
+        # health model (utils/health.py): phase machinery + per-subsystem
+        # verdicts, served at /healthz, /ready and /api/v1/status/health.
+        # Created FIRST so every boot step below lands as a phase/journal
+        # event — the flight recorder starts at "booting"
+        from filodb_tpu.utils.events import journal
+        from filodb_tpu.utils.health import BOOTING, HealthEvaluator
+        self.health = HealthEvaluator(node_name=node_name, phase=BOOTING)
+        journal.configure(
+            max_entries=self.config.event_journal_max_entries,
+            path=self.config.event_journal_path)
+        journal.emit("server_boot", subsystem="server", node=node_name)
         # persistent XLA compile cache BEFORE any jit runs: a restarted
         # server must answer its first heavy query from cached programs
         # (round-5 verdict item 2; measured 43.6-73.4 s cold compiles)
@@ -99,7 +110,7 @@ class FiloServer:
                                default_dataset=first,
                                batch_window_ms=self.config.query
                                .batch_window_ms,
-                               config=self.config)
+                               config=self.config, health=self.health)
         self.http = FiloHttpServer(self.api, http_host, http_port)
         # Ruler — recording & alerting rules (filodb_tpu/rules): standing
         # queries evaluated through this server's QueryFrontend whose
@@ -130,6 +141,46 @@ class FiloServer:
                 config=self.config.rules,
                 config_source=config_source)
             self.api.ruler = self.ruler
+        # self-scrape meta-monitoring (utils/selfmon.py): built here so a
+        # misconfigured dataset fails boot loudly; the loop starts in
+        # start() next to the other background jobs
+        self.selfmon = None
+        if self.config.selfmon.enabled:
+            from filodb_tpu.utils.selfmon import SelfScraper
+            sm_ds = self.config.selfmon.dataset or first
+            if sm_ds not in self.engines:
+                from filodb_tpu.config import ConfigError
+                raise ConfigError(
+                    f"selfmon.dataset {sm_ds!r} is not a served dataset "
+                    f"(have: {sorted(self.engines)})")
+            self.selfmon = SelfScraper(
+                self.memstore, sm_ds, self.mappers[sm_ds],
+                self.spreads[sm_ds], node_name=self.node_name,
+                interval_s=self.config.selfmon.interval_s)
+        # boot WAL replay: runs AFTER the API exists (the transport-
+        # agnostic routes answer /healthz — and /ready with 503 — while
+        # the log replays) and BEFORE start() declares the node serving;
+        # by the time the constructor returns, replay is complete, so
+        # embedders that query without start() see the recovered store
+        self._replay_wals()
+        from filodb_tpu.utils.health import BOOTED
+        self.health.set_phase(BOOTED)
+
+    def _replay_wals(self) -> None:
+        from filodb_tpu.utils.health import REPLAYING_WAL
+        if not self.wals or not self.config.wal.replay_on_start:
+            return
+        self.health.set_phase(REPLAYING_WAL)
+        for dc in self.datasets:
+            wal = self.wals.get(dc.name)
+            if wal is None:
+                continue
+            restart_points = {
+                s: self.meta_store.read_earliest_checkpoint(dc.name, s)
+                for s in range(dc.num_shards)}
+            stats = wal.replay(self.memstore, restart_points)
+            self.health.note_wal(dc.name, enabled=True,
+                                 replay_done=True, stats=stats)
 
     # ------------------------------------------------------------- wiring
 
@@ -197,17 +248,17 @@ class FiloServer:
             # durability front: the remote_write door appends through
             # this manager and acks only after the group commit; boot
             # replays the log through the same columnar ingest path
-            # BEFORE the HTTP server opens (filodb_tpu/wal)
+            # BEFORE the HTTP server opens (filodb_tpu/wal).  The replay
+            # itself runs from __init__ AFTER the API is built (see
+            # _replay_wals) so /ready can answer 503 while it runs.
             from filodb_tpu.wal import WalManager
             wal = WalManager(self.config.wal.dir, dc.name,
                              config=self.config.wal)
             self.wals[dc.name] = wal
             self.gateways[dc.name].wal = wal
-            if self.config.wal.replay_on_start:
-                restart_points = {
-                    s: self.meta_store.read_earliest_checkpoint(dc.name, s)
-                    for s in range(dc.num_shards)}
-                wal.replay(self.memstore, restart_points)
+            self.health.note_wal(dc.name, enabled=True,
+                                 replay_done=not
+                                 self.config.wal.replay_on_start)
 
     def _make_downsample(self, dc: DatasetConfig, mapper: ShardMapper):
         from filodb_tpu.downsample import (DownsampleClusterPlanner,
@@ -341,8 +392,19 @@ class FiloServer:
             sched.start()
         if self.ruler is not None:
             self.ruler.start()
+        if self.selfmon is not None:
+            self.selfmon.start()
+        # the readiness flip: phase -> serving lands in the event
+        # journal, so "replayed, recovered, took traffic" is one
+        # greppable sequence at /admin/events
+        from filodb_tpu.utils.health import SERVING
+        self.health.set_phase(SERVING)
 
     def shutdown(self) -> None:
+        from filodb_tpu.utils.health import STOPPING
+        self.health.set_phase(STOPPING)
+        if self.selfmon is not None:
+            self.selfmon.stop()
         if self.ruler is not None:
             self.ruler.stop()
         for sched in self.compaction_schedulers.values():

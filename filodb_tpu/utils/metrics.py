@@ -149,6 +149,39 @@ class MetricsRegistry:
             self._gauges.clear()
             self._hists.clear()
 
+    # ----------------------------------------------------- sample snapshot
+
+    def snapshot_samples(self):
+        """Every metric as (series_name, tag_tuple, value) with the
+        Prometheus exposition naming — counters as `name_total`,
+        histograms as cumulative `name_bucket{le=...}` + `name_sum` +
+        `name_count`.  The self-scrape loop (utils/selfmon.py) ingests
+        exactly this set, so PromQL written against a real /metrics
+        scrape works unchanged against the self-scraped series."""
+        with self._lock:
+            counters = list(self._counters.items())
+            gauges = list(self._gauges.items())
+            hists = list(self._hists.items())
+        out = []
+        for (name, tags), c in counters:
+            out.append((f"{name}_total", tags, c.value))
+        for (name, tags), g in gauges:
+            out.append((name, tags, g.value))
+        for (name, tags), h in hists:
+            with h._lock:                  # torn-read guard, as exposition
+                counts = list(h.counts)
+                h_sum, h_count = h.sum, h.count
+            acc = 0
+            for i, b in enumerate(h.bounds):
+                acc += counts[i]
+                out.append((f"{name}_bucket",
+                            tags + (("le", "%g" % b),), acc))
+            out.append((f"{name}_bucket", tags + (("le", "+Inf"),),
+                        h_count))
+            out.append((f"{name}_sum", tags, h_sum))
+            out.append((f"{name}_count", tags, h_count))
+        return out
+
     # -------------------------------------------------- prometheus format
 
     def expose_prometheus(self) -> str:
@@ -467,7 +500,15 @@ def log_error_once(where: str, exc: BaseException,
     paths whose failures otherwise vanish into a bare counter (e.g. the
     device mirror's incremental-refresh fallback).  A new error CLASS at
     the same site always logs immediately, so a regression that changes
-    failure mode is visible even inside the rate window."""
+    failure mode is visible even inside the rate window.
+
+    Every call — logged or rate-suppressed — also increments
+    `suppressed_errors_total{site,class}`, so swallowed
+    optimization-path errors are visible at /metrics and alertable via
+    the self-scrape loop, not only greppable in logs."""
+    registry.counter("suppressed_errors",
+                     **{"site": where,
+                        "class": type(exc).__name__}).increment()
     key = f"{where}:{type(exc).__name__}"
     now = time.monotonic()
     if now - _degrade_last.get(key, -1e9) >= min_interval_s:

@@ -67,6 +67,11 @@ class TraceExporter:
         self._q: "queue.Queue[dict]" = queue.Queue(maxsize=max_queue)
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        # unified job registry: export ticks/streaks at /admin/jobs (NOT
+        # critical — a dead Zipkin collector must never flip /ready)
+        from filodb_tpu.utils.jobs import jobs
+        self.job = jobs.register("trace_export",
+                                 interval_s=flush_interval_s)
 
     # -- the collector sink (called under the query path: must not block)
 
@@ -109,15 +114,21 @@ class TraceExporter:
         return spans
 
     def _flush(self) -> None:
+        shipped = 0
         while True:
             spans = self._drain()
             if not spans:
+                if shipped:
+                    self.job.note_ok()
+                    self.job.set_progress(f"shipped {shipped} span(s)")
                 return
             try:
                 self._ship(spans)
+                shipped += len(spans)
                 registry.counter("trace_export_spans").increment(len(spans))
-            except Exception:  # noqa: BLE001 — export is best-effort
+            except Exception as e:  # noqa: BLE001 — export is best-effort
                 registry.counter("trace_export_errors").increment()
+                self.job.note_error(e)
                 return
 
     def _ship(self, spans) -> None:

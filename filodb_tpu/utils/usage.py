@@ -58,11 +58,12 @@ class _Tenant:
 OVERFLOW_TENANT: TenantKey = ("_overflow_", "")
 
 # synthetic workspaces of INTERNAL subsystems (the ruler bills as
-# `_rules_`): accounted like any tenant, but exempt from the scan-limit
-# gate — aggregation rules legitimately scan the whole store every
-# interval, so a fail limit sized for external tenants would starve
-# recording/alerting precisely on the heaviest (most valuable) rules
-INTERNAL_WORKSPACES = frozenset({"_rules_"})
+# `_rules_`, the self-scrape loop as `_self_`): accounted like any
+# tenant, but exempt from the scan-limit gate — aggregation rules
+# legitimately scan the whole store every interval, and self-monitoring
+# must never starve itself out of its own answers; a fail limit sized
+# for external tenants would break both precisely under load
+INTERNAL_WORKSPACES = frozenset({"_rules_", "_self_"})
 
 
 class UsageAccountant:

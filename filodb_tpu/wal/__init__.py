@@ -117,7 +117,16 @@ class WalManager:
     def replay(self, memstore,
                restart_points: Optional[Dict[int, int]] = None
                ) -> ReplayStats:
+        from filodb_tpu.utils.events import journal
+        journal.emit("wal_replay_started", subsystem="wal",
+                     dataset=self.dataset)
         stats = replay_dir(self.dir, memstore, self.dataset, restart_points)
+        journal.emit("wal_replay_done", subsystem="wal",
+                     dataset=self.dataset, records=stats.records,
+                     samples=stats.samples,
+                     skipped_records=stats.skipped_records,
+                     corrupt_segments=stats.corrupt_segments,
+                     elapsed_s=round(stats.elapsed_s, 3))
         restart_points = restart_points or {}
         with self._lock:
             # only shards with RECORDS in the log gate pruning — a shard

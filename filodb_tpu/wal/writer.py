@@ -88,6 +88,12 @@ class WalWriter:
         # segment (cleared at rotation: every segment self-contained)
         self._seg_tables: set = set()
         self._file = self._open_segment(self._active_first)
+        # unified job registry: the committer is the durability heart —
+        # a failing group commit means acks are being withheld, so it is
+        # critical for the readiness verdict (utils/health.py)
+        from filodb_tpu.utils.jobs import jobs
+        self.job = jobs.register("wal_commit", dataset=dataset,
+                                 critical=True)
         self._committer = threading.Thread(
             target=self._run_committer, daemon=True,
             name=f"wal-commit-{dataset or os.path.basename(dir_path)}")
@@ -240,6 +246,12 @@ class WalWriter:
                     self._commit_cv.notify_all()
             metrics_registry.counter(
                 "wal_commit_errors", dataset=self.dataset).increment()
+            self.job.note_error(e)
+            from filodb_tpu.utils.events import journal
+            journal.emit("wal_commit_failed", subsystem="wal",
+                         dataset=self.dataset,
+                         first_seq=self._committed_seq + 1,
+                         last_seq=batch_end, error=f"{e}")
             _log.error("WAL group commit failed (seqs %d..%d): %s",
                        self._committed_seq + 1, batch_end, e)
             return
@@ -264,12 +276,19 @@ class WalWriter:
                 metrics_registry.counter(
                     "wal_segment_rotations", dataset=self.dataset
                 ).increment()
+                from filodb_tpu.utils.events import journal
+                journal.emit("wal_segment_rotated", subsystem="wal",
+                             dataset=self.dataset,
+                             sealed_first_seq=self._sealed[-1][0],
+                             sealed_last_seq=self._sealed[-1][1],
+                             sealed_segments=len(self._sealed))
             with self._commit_cv:
                 self._commit_cv.notify_all()
         metrics_registry.counter("wal_commits",
                                  dataset=self.dataset).increment()
         metrics_registry.histogram("wal_fsync_seconds",
                                    dataset=self.dataset).record(fsync_s)
+        self.job.note_ok(duration_s=fsync_s)
 
     # --------------------------------------------------------------- prune
 
@@ -293,6 +312,10 @@ class WalWriter:
         if removed:
             metrics_registry.counter("wal_segments_pruned",
                                      dataset=self.dataset).increment(removed)
+            from filodb_tpu.utils.events import journal
+            journal.emit("wal_segments_pruned", subsystem="wal",
+                         dataset=self.dataset, removed=removed,
+                         horizon_seq=horizon_seq)
         return removed
 
     def segment_count(self) -> int:

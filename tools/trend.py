@@ -28,6 +28,10 @@ of every headline metric is greppable in one file:
     store), ``longrange_lru_bounded`` (the cold region never exceeded
     its byte budget) — plus a loud ``longrange_error`` when the stage
     fails (merge-not-clobber like every other key).
+  - the self-observability numbers (PR 10): ``selfmon_overhead_pct``
+    (gate: <= 2% at the default ``selfmon.interval_s``),
+    ``selfmon_scrape_p50_s`` / ``selfmon_scrape_series``, and a loud
+    ``selfmon_error`` when the stage fails.
 
 Existing hand-written round entries are MERGED, never clobbered: only
 missing keys are added, so curated notes survive re-runs.
@@ -64,6 +68,8 @@ CARRY = [
     "longrange_cold_scan_samples_per_sec", "longrange_warm_cold_ratio",
     "longrange_stitch_identical", "longrange_cold_vs_mem_ratio",
     "longrange_lru_bounded", "longrange_gate_ok", "longrange_error",
+    "selfmon_overhead_pct", "selfmon_scrape_p50_s",
+    "selfmon_scrape_series", "selfmon_gate_ok", "selfmon_error",
 ]
 RENAME = {"value": "headline_samples_per_sec",
           "p50_query_latency_s": "p50_s"}
