@@ -1491,53 +1491,70 @@ def measure_multichip(quick=False, series=None, iters=0):
 
 
 def run_chaos(quick=False, series=None):
-    """Failure-domain chaos stage (PR 4 acceptance): two real data-node
-    processes serve one dataset over the cross-node transport while this
-    process drives query traffic with `allow_partial_results=on` and a
-    per-query deadline; mid-traffic one node is SIGKILLed, later
-    restarted on the same address.  Emits:
+    """Failure-domain chaos stage — REPLICATED (ISSUE 11, flipping the
+    PR 4 gate): three real data-node processes each own copies of
+    shards at RF=2 (primary + replica, never co-located); this process
+    is the distributor (replication/replicator.py fan-out with quorum
+    acks) AND the query coordinator (ReplicaFailoverDispatcher per
+    shard).  Mid-traffic one node is SIGKILLed, later respawned on the
+    same address and repaired by WAL-segment catch-up.  Gates:
 
-      chaos_availability        — fraction of fault-phase queries that
-                                  returned within their deadline
-                                  (partial or full, no error)
-      chaos_partial_rate        — fraction of fault-phase results
-                                  flagged partial
-      chaos_p99_during_fault_s  — fault-phase p99 vs healthy_p99_s
-                                  (gate: <= 2x — breaker fail-fast, no
-                                  connect-timeout serialization)
-      chaos_wrong_full_results  — fault-phase results claiming to be
-                                  FULL while missing the dead node's
-                                  series (gate: 0 — partials are never
-                                  silent)
+      chaos_availability        == 1.0 — every fault-phase query
+                                  answers in budget, served FULL via
+                                  replica failover
+      chaos_partial_rate        == 0.0 — the partial path never engages
+                                  while any owner of a shard lives
+      chaos_acked_lost          == 0  — every slab acked during the
+                                  fault is queryable afterwards (the
+                                  surviving owner held it; catch-up
+                                  repaired the respawn)
+      chaos_wrong_full_results  == 0  — a FULL result always carries
+                                  every shard's group
 
     Full phase detail lands in SOAK_CHAOS.json."""
     import signal
     import socket as _socket
+    import tempfile
+
+    import numpy as np
 
     import jax
     jax.config.update("jax_platforms", "cpu")
 
+    from bench.chaosnode import chaos_column
+    from filodb_tpu.config import ReplicationConfig
     from filodb_tpu.core.memstore import TimeSeriesMemStore
+    from filodb_tpu.core.partkey import PartKey
+    from filodb_tpu.core.schemas import PROM_COUNTER
     from filodb_tpu.parallel.breaker import breakers
     from filodb_tpu.parallel.shardmapper import (ShardEvent, ShardMapper,
+                                                 ShardStatus,
                                                  SpreadProvider)
     from filodb_tpu.parallel.transport import RemoteNodeDispatcher
     from filodb_tpu.query.engine import QueryEngine
     from filodb_tpu.query.planner import SingleClusterPlanner
     from filodb_tpu.query.rangevector import PlannerParams
+    from filodb_tpu.replication import (ReplicaClient, ReplicationManager,
+                                        failover_dispatcher_factory)
+    from filodb_tpu.replication.catchup import relay_wal
 
-    S_NODE = series or (512 if quick else 8_192)
+    S_NODE = series or (512 if quick else 4_096)
     T = 420                              # 70 min of 10s scrapes
     START = 1_600_000_000_000
-    # per-query deadline: generous vs the CPU backend's per-new-shape
-    # XLA recompile under live ingest (~1s/query here; the TPU path
-    # amortizes via the device mirror) — the chaos gates compare fault
-    # p99 against HEALTHY p99, so the budget only needs to not clip the
-    # healthy path
+    STEP = 10_000
     BUDGET_S = 5.0
     phase_s = 4.0 if quick else 10.0
     dataset = "chaos"
+    NODES = ("A", "B", "C")
+    NUM_SHARDS = 4
+    # RF-2 placement, replicas never co-located: shard s -> primary
+    # NODES[s % 3], replica NODES[(s + 1) % 3]
+    owners = {s: (NODES[s % 3], NODES[(s + 1) % 3])
+              for s in range(NUM_SHARDS)}
+    shards_of = {n: sorted(s for s, (p, r) in owners.items()
+                           if n in (p, r)) for n in NODES}
     worker = os.path.join(REPO_DIR, "bench", "chaosnode.py")
+    wal_root = tempfile.mkdtemp(prefix="filodb-chaos-wal-")
 
     def free_port():
         with _socket.socket() as s:
@@ -1547,15 +1564,19 @@ def run_chaos(quick=False, series=None):
     env = {k: v for k, v in os.environ.items()}
     env["PYTHONPATH"] = REPO_DIR
     env["JAX_PLATFORMS"] = "cpu"
-    logs = {"A": open(os.path.join(REPO_DIR, ".chaos_nodeA.log"), "w"),
-            "B": open(os.path.join(REPO_DIR, ".chaos_nodeB.log"), "w")}
+    logs = {n: open(os.path.join(REPO_DIR, f".chaos_node{n}.log"), "w")
+            for n in NODES}
 
-    def spawn(name, port, shard):
+    def spawn(name):
         proc = subprocess.Popen(
-            [sys.executable, worker, "--name", name, "--port", str(port),
-             "--shard", str(shard), "--dataset", dataset,
+            [sys.executable, worker, "--name", name,
+             "--port", str(qports[name]),
+             "--repl-port", str(rports[name]),
+             "--shards", ",".join(str(s) for s in shards_of[name]),
+             "--dataset", dataset,
              "--series", str(S_NODE), "--samples", str(T),
-             "--start-ms", str(START), "--ingest-interval", "1.0",
+             "--start-ms", str(START),
+             "--wal-dir", os.path.join(wal_root, name),
              "--platform", "cpu"],
             stdout=subprocess.PIPE, stderr=logs[name], text=True,
             env=env, cwd=REPO_DIR)
@@ -1566,24 +1587,32 @@ def run_chaos(quick=False, series=None):
                                f"{line!r}")
         return proc
 
-    ports = {"A": free_port(), "B": free_port()}
-    procs = {"A": spawn("A", ports["A"], 0),
-             "B": spawn("B", ports["B"], 1)}
+    qports = {n: free_port() for n in NODES}
+    rports = {n: free_port() for n in NODES}
+    procs = {n: spawn(n) for n in NODES}
 
-    # coordinator: scatter-gather over both nodes, no local data
-    mapper = ShardMapper(2)
-    for shard, name in ((0, "A"), (1, "B")):
+    # coordinator state: replica-aware mapper, failover dispatchers,
+    # quorum fan-out manager — no local data
+    mapper = ShardMapper(NUM_SHARDS, replication_factor=2)
+    for s, (p, r) in owners.items():
         mapper.update_from_event(
-            ShardEvent("IngestionStarted", dataset, shard, name))
-    dispatchers = {name: RemoteNodeDispatcher("127.0.0.1", port,
-                                              timeout_s=30.0)
-                   for name, port in ports.items()}
-    owner = {0: "A", 1: "B"}
+            ShardEvent("IngestionStarted", dataset, s, p))
+        mapper.register_replica(s, r, status=ShardStatus.ACTIVE)
+    dispatchers = {n: RemoteNodeDispatcher("127.0.0.1", qports[n],
+                                           timeout_s=30.0)
+                   for n in NODES}
+    repl_clients = {n: ReplicaClient("127.0.0.1", rports[n],
+                                     timeout_s=5.0) for n in NODES}
     planner = SingleClusterPlanner(
         dataset, mapper, SpreadProvider(default_spread=1),
-        dispatcher_factory=lambda s: dispatchers[owner[s]])
+        dispatcher_factory=failover_dispatcher_factory(
+            mapper, lambda n: dispatchers[n]))
     engine = QueryEngine(dataset, TimeSeriesMemStore(), mapper,
                          planner=planner)
+    manager = ReplicationManager(
+        dataset, mapper, lambda n: repl_clients[n],
+        config=ReplicationConfig(enabled=True, factor=2,
+                                 ack_mode="quorum"))
     breakers.reset()
     breakers.configure(failure_threshold=3, open_base_s=0.3,
                        open_max_s=2.0, jitter=0.1)
@@ -1592,13 +1621,51 @@ def run_chaos(quick=False, series=None):
                       scan_limit=2_000_000_000)
     Q = 'sum by (_ns_)(rate(chaos_total[5m]))'
     qs, qe = START // 1000 + 600, START // 1000 + (T - 1) * 10
+    ALL_GROUPS = sorted(f"s{s}" for s in range(NUM_SHARDS))
+
+    skeys = {s: [PartKey.make("chaos_total",
+                              {"_ws_": "chaos", "_ns_": f"s{s}",
+                               "instance": f"s{s}-{i}"})
+                 for i in range(S_NODE)] for s in range(NUM_SHARDS)}
+    tick = {"n": T}
+    acked = {s: -1 for s in range(NUM_SHARDS)}   # last acked tick
+    seq = {"n": 0}
+
+    def ingest_tick():
+        """One fresh scrape column per shard through the quorum
+        fan-out; on a primary-owner death the coordinator promotes the
+        replica (the ClusterCoordinator deathwatch path, exercised
+        in-process by tests) and keeps acking on the survivor."""
+        t_idx = tick["n"]
+        tick["n"] += 1
+        for s in range(NUM_SHARDS):
+            col_ts, col_v = chaos_column(s, S_NODE, t_idx, START, STEP)
+            res = manager.replicate(s, PROM_COUNTER.name, skeys[s],
+                                    col_ts, {"count": col_v},
+                                    seq=seq["n"], require_primary=False)
+            seq["n"] += 1
+            primary = mapper.node_for_shard(s)
+            if primary not in res.acked:
+                live = [n for n in mapper.replicas[s]
+                        if n in res.acked]
+                if live:
+                    # demote_old=False — the dead primary must NOT
+                    # re-enter the owner list as a query-ready replica
+                    # (same stance as ShardManager.remove_member); the
+                    # respawn re-registers it after catch-up
+                    mapper.promote_replica(s, live[0], demote_old=False)
+            if res.acked:
+                acked[s] = t_idx
 
     def drive(phase_name, dur_s):
-        """Query loop for one phase; each record: latency, partial flag,
-        error, which node groups answered."""
+        """Mixed ingest+query loop for one phase."""
         recs = []
         t_end = time.perf_counter() + dur_s
+        last_ingest = 0.0
         while time.perf_counter() < t_end:
+            if time.perf_counter() - last_ingest >= 1.0:
+                ingest_tick()
+                last_ingest = time.perf_counter()
             t0 = time.perf_counter()
             res = engine.query_range(Q, qs, 60, qe, pp)
             lat = time.perf_counter() - t0
@@ -1616,8 +1683,7 @@ def run_chaos(quick=False, series=None):
         return lats[min(int(len(lats) * 0.99), len(lats) - 1)]
 
     # warmup WITHOUT the deadline: first-hit XLA compiles (coordinator
-    # merge + node-side leaf kernels) must not eat the chaos budget —
-    # production servers warm these at boot (standalone warmup_shapes)
+    # merge + node-side leaf kernels) must not eat the chaos budget
     warm_pp = PlannerParams(allow_partial_results=True,
                             sample_limit=2_000_000_000,
                             scan_limit=2_000_000_000)
@@ -1625,18 +1691,73 @@ def run_chaos(quick=False, series=None):
     if warm.error:
         raise RuntimeError(f"chaos warmup failed: {warm.error}")
 
-    # phase 1: healthy baseline
+    # phase 1: healthy baseline (replicated ingest + full queries)
     healthy = drive("healthy", phase_s)
 
-    # phase 2: SIGKILL node B mid-traffic
-    os.kill(procs["B"].pid, signal.SIGKILL)
-    procs["B"].wait()
+    # phase 2: SIGKILL node B mid-traffic.  B is primary for some
+    # shards and replica for others — queries must stay FULL (failover)
+    # and ingest must keep acking (promotion + surviving owner)
+    victim = "B"
+    os.kill(procs[victim].pid, signal.SIGKILL)
+    procs[victim].wait()
     fault = drive("fault", phase_s)
 
-    # phase 3: node B returns on the SAME address; breaker half-open
-    # probes detect it and traffic heals back to full results
-    procs["B"] = spawn("B", ports["B"], 1)
+    # phase 3: B respawns on the same address: replays its own WAL,
+    # then the coordinator repairs the gap by relaying the current
+    # primaries' WAL segments through B's door, and only THEN lists B
+    # as a query-ready replica again
+    procs[victim] = spawn(victim)
+    repl_clients[victim].reset()
+    dispatchers[victim]._reset()
+    caught_up = 0
+    by_src = {}
+    for s in shards_of[victim]:
+        src = mapper.node_for_shard(s)
+        if src != victim and src is not None:
+            by_src.setdefault(src, []).append(s)
+    for src, shards in by_src.items():
+        # one relay per SOURCE (not per shard — each relay streams the
+        # source's whole log); restore windows buffer live fan-out
+        # probes reaching B mid-relay so a fresh tick can never
+        # OOO-drop the relayed gap
+        for s in shards:
+            repl_clients[victim].begin_restore(dataset, s)
+        caught_up += relay_wal(repl_clients[src], repl_clients[victim],
+                               dataset, shards=shards)
+        for s in shards:
+            repl_clients[victim].end_restore(dataset, s)
+    if by_src:
+        manager.mark_repaired(victim)
+    for s in shards_of[victim]:
+        if mapper.node_for_shard(s) != victim \
+                and victim not in mapper.replicas[s]:
+            mapper.register_replica(s, victim,
+                                    status=ShardStatus.ACTIVE)
     recovery = drive("recovery", phase_s)
+
+    # zero acked-ingest loss: for every shard, the latest ACKED tick's
+    # column must be queryable now (value = 5*tick + row; max over the
+    # shard's series at the acked tick's timestamp = 5*tick + S-1)
+    acked_lost = 0
+    loss_detail = {}
+    for s in range(NUM_SHARDS):
+        t_idx = acked[s]
+        if t_idx < 0:
+            continue
+        t_s = (START + t_idx * STEP) // 1000
+        res = engine.query_range(
+            f'max(chaos_total{{_ns_="s{s}"}})', t_s, 1, t_s, warm_pp)
+        want = 5.0 * t_idx + (S_NODE - 1)
+        got = None
+        if res.error is None:
+            for _k, _w, vals in res.series():
+                v = np.asarray(vals)
+                if v.size and not np.isnan(v[-1]):
+                    got = float(v[-1])
+        if got is None or abs(got - want) > 1e-6:
+            acked_lost += 1
+            loss_detail[s] = {"want": want, "got": got,
+                              "acked_tick": t_idx}
 
     for name, proc in procs.items():
         if proc.poll() is None:
@@ -1650,7 +1771,7 @@ def run_chaos(quick=False, series=None):
 
     wrong_full = [r for r in fault
                   if r["error"] is None and not r["partial"]
-                  and r["groups"] != ["A", "B"]]
+                  and r["groups"] != ALL_GROUPS]
     avail = (sum(ok_within_budget(r) for r in fault) / len(fault)
              if fault else 0.0)
     partial_rate = (sum(r["partial"] for r in fault) / len(fault)
@@ -1659,12 +1780,13 @@ def run_chaos(quick=False, series=None):
     fault_p99 = p99(fault)
     recovered_full = sum(1 for r in recovery
                          if r["error"] is None and not r["partial"]
-                         and r["groups"] == ["A", "B"])
+                         and r["groups"] == ALL_GROUPS)
     result = {
         "metric": "chaos_availability", "unit": "fraction",
         "value": round(avail, 4),
         "chaos_availability": round(avail, 4),
         "chaos_partial_rate": round(partial_rate, 4),
+        "chaos_acked_lost": acked_lost,
         "chaos_p99_during_fault_s": round(fault_p99, 4),
         "healthy_p99_s": round(healthy_p99, 4),
         "chaos_p99_ratio": round(fault_p99 / max(healthy_p99, 1e-9), 2),
@@ -1672,20 +1794,247 @@ def run_chaos(quick=False, series=None):
         "chaos_queries": {"healthy": len(healthy), "fault": len(fault),
                           "recovery": len(recovery)},
         "chaos_recovered_full_results": recovered_full,
+        "chaos_catchup_records": caught_up,
+        "chaos_rf": 2, "chaos_nodes": len(NODES),
+        "chaos_gate_ok": bool(avail == 1.0 and partial_rate == 0.0
+                              and acked_lost == 0
+                              and not wrong_full),
         "breakers": breakers.snapshot(),
-        "series_per_node": S_NODE, "budget_s": BUDGET_S,
+        "replica_lag": manager.snapshot(),
+        "series_per_shard": S_NODE, "budget_s": BUDGET_S,
         "platform": "cpu",
     }
+    if loss_detail:
+        result["chaos_acked_loss_detail"] = loss_detail
     artifact = {
         "run": "chaos", "quick": quick, "result": result,
+        "owners": {str(s): list(o) for s, o in owners.items()},
         "phases": {"healthy": healthy, "fault": fault,
                    "recovery": recovery},
     }
     with open(os.path.join(REPO_DIR, "SOAK_CHAOS.json"), "w") as f:
         json.dump(artifact, f, indent=1)
+    manager.stop()
     breakers.configure()
     breakers.reset()
+    import shutil as _shutil
+    _shutil.rmtree(wal_root, ignore_errors=True)
     return result
+
+
+def run_replication(quick=False, series=None):
+    """Replication stage (ISSUE 11): in-process RF-2 cluster on the real
+    transports.  Three measurements + gates:
+
+      replication_rf2_vs_rf1_pct   — quorum-acked RF-2 fan-out ingest
+                                     throughput vs RF-1 (gate >= 50%:
+                                     the durability copy may not halve
+                                     the front door twice over)
+      replication_catchup_samples_per_sec — WAL-segment catch-up drain
+                                     rate into a fresh replica
+      replication_handoff_*        — live handoff of a shard during
+                                     mixed ingest+query traffic: zero
+                                     failed queries, zero partials, and
+                                     the final query_range byte-
+                                     identical to an undisturbed
+                                     single-store truth run
+    """
+    import tempfile
+    import threading
+
+    import numpy as np
+
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    from filodb_tpu.core.memstore import TimeSeriesMemStore
+    from filodb_tpu.core.partkey import PartKey
+    from filodb_tpu.core.schemas import PROM_COUNTER
+    from filodb_tpu.parallel.shardmapper import ShardEvent, ShardMapper
+    from filodb_tpu.parallel.testcluster import make_replicated_cluster
+    from filodb_tpu.query.engine import QueryEngine
+    from filodb_tpu.query.rangevector import PlannerParams
+    from filodb_tpu.replication import HandoffCoordinator
+
+    S = series or (256 if quick else 2_048)
+    K = 8                                # samples per slab column
+    T = 64                               # base samples per series
+    START = 1_600_000_000_000
+    STEP = 10_000
+    dataset = "prometheus"
+    pump_s = 1.5 if quick else 4.0
+
+    def skeys_for(shard, n):
+        return [PartKey.make("repl_total",
+                             {"_ws_": "w", "_ns_": f"s{shard}",
+                              "i": str(i)}) for i in range(n)]
+
+    def grid(n_series, n_samples, base_idx=0):
+        ts = (np.arange(n_samples, dtype=np.int64)[None, :]
+              + base_idx) * STEP + START
+        ts = np.repeat(ts, n_series, axis=0)
+        vals = (np.arange(n_samples, dtype=np.float64)[None, :]
+                + base_idx) * 5.0 \
+            + np.arange(n_series, dtype=np.float64)[:, None]
+        return ts, vals
+
+    # ---------------------------------------- RF-1 vs RF-2 throughput
+    def pump(cluster, dur_s):
+        keys = {s: skeys_for(s, S) for s in range(2)}
+        n = 0
+        b = 0
+        t0 = time.perf_counter()
+        while time.perf_counter() - t0 < dur_s:
+            for s in range(2):
+                ts, vals = grid(S, K, base_idx=b * K)
+                cluster.manager.replicate(s, PROM_COUNTER.name, keys[s],
+                                          ts, {"count": vals},
+                                          require_primary=True)
+                n += S * K
+            b += 1
+        return n / (time.perf_counter() - t0)
+
+    rates = {}
+    for rf in (1, 2):
+        cluster = make_replicated_cluster(num_shards=2,
+                                          replication_factor=rf)
+        try:
+            pump(cluster, 0.3)           # warm sockets + key memos
+            rates[rf] = pump(cluster, pump_s)
+        finally:
+            cluster.stop()
+    rf2_pct = 100.0 * rates[2] / max(rates[1], 1e-9)
+
+    # ------------------------------------------------ catch-up drain
+    from filodb_tpu.replication import (ReplicaClient, ReplicationServer,
+                                        catchup_shards)
+    from filodb_tpu.wal import WalManager
+    wal_root = tempfile.mkdtemp(prefix="filodb-replbench-")
+    primary = TimeSeriesMemStore()
+    primary.setup(dataset, 0)
+    wal = WalManager(wal_root, dataset)
+    keys0 = skeys_for(0, S)
+    n_grids = 20 if quick else 60
+    for b in range(n_grids):
+        ts, vals = grid(S, K, base_idx=b * K)
+        seq = wal.append_grid(0, PROM_COUNTER.name, keys0, ts,
+                              {"count": vals})
+        primary.get_shard(dataset, 0).ingest_columns(
+            PROM_COUNTER.name, keys0, ts, {"count": vals}, offset=seq)
+    srv = ReplicationServer(primary, node="P",
+                            wals={dataset: wal}).start()
+    try:
+        replica = TimeSeriesMemStore()
+        stats = catchup_shards(ReplicaClient(*srv.address), dataset,
+                               replica, shards=[0], node="bench")
+        catchup_sps = stats.samples_per_sec
+        catchup_ok = stats.records == n_grids
+    finally:
+        srv.stop()
+        wal.close()
+        import shutil as _shutil
+        _shutil.rmtree(wal_root, ignore_errors=True)
+
+    # ------------------------------- live handoff under mixed traffic
+    Q = 'sum by (_ns_)(rate(repl_total[5m]))'
+    qs, qe = START // 1000 + 600, START // 1000 + 630
+    cluster = make_replicated_cluster(nodes=("A", "B", "C"),
+                                      num_shards=2, with_truth=True)
+    handoff_summary = {}
+    try:
+        skeys = {s: skeys_for(s, S) for s in range(2)}
+        ts, vals = grid(S, T)
+        for s in range(2):
+            cluster.ingest_grid(s, PROM_COUNTER.name, skeys[s], ts,
+                                {"count": vals})
+        pp = PlannerParams(allow_partial_results=True)
+        warm = cluster.engine.query_range(Q, qs, 30, qe, pp)
+        if warm.error:
+            raise RuntimeError(f"replication warmup failed: "
+                               f"{warm.error}")
+        stop = threading.Event()
+        qerrs, qpartials, qok = [], [], [0]
+        tick = [T]
+
+        def query_loop():
+            while not stop.is_set():
+                res = cluster.engine.query_range(Q, qs, 30, qe, pp)
+                if res.error is not None:
+                    qerrs.append(res.error)
+                elif res.partial:
+                    qpartials.append(True)
+                else:
+                    qok[0] += 1
+                time.sleep(0.02)
+
+        def ingest_loop():
+            while not stop.is_set():
+                b = tick[0]
+                tick[0] += 1
+                for s in range(2):
+                    ts2, vals2 = grid(S, 1, base_idx=b)
+                    cluster.ingest_grid(s, PROM_COUNTER.name, skeys[s],
+                                        ts2, {"count": vals2})
+                time.sleep(0.05)
+
+        threads = [threading.Thread(target=query_loop, daemon=True),
+                   threading.Thread(target=ingest_loop, daemon=True)]
+        for t in threads:
+            t.start()
+        time.sleep(1.0)
+        shard = 0
+        owners = set(cluster.mapper.owners(shard))
+        target = next(n for n in ("A", "B", "C") if n not in owners)
+        coord = HandoffCoordinator(dataset, cluster.mapper,
+                                   lambda n: cluster.repl_clients[n])
+        t0 = time.perf_counter()
+        handoff_summary = coord.handoff(shard, target)
+        handoff_s = time.perf_counter() - t0
+        time.sleep(1.0)
+        stop.set()
+        for t in threads:
+            t.join(timeout=10)
+        # quiesced comparison vs the undisturbed truth store
+        res = cluster.engine.query_range(Q, qs, 30, qe, PlannerParams())
+        tmapper = ShardMapper(2)
+        for s in range(2):
+            tmapper.update_from_event(
+                ShardEvent("IngestionStarted", dataset, s, "local"))
+        truth_engine = QueryEngine(dataset, cluster.truth, tmapper)
+        want = truth_engine.query_range(Q, qs, 30, qe, PlannerParams())
+
+        def payload(r):
+            p = QueryEngine.to_prom_matrix(r)
+            p.pop("traceID", None)
+            return json.dumps(p, sort_keys=True)
+
+        handoff_identical = (res.error is None and want.error is None
+                             and payload(res) == payload(want))
+        handoff_failed_queries = len(qerrs)
+        handoff_partials = len(qpartials)
+        handoff_queries_ok = qok[0]
+    finally:
+        cluster.stop()
+
+    gate_ok = bool(rf2_pct >= 50.0 and catchup_ok
+                   and handoff_failed_queries == 0
+                   and handoff_partials == 0 and handoff_identical)
+    return {
+        "metric": "replication_rf2_vs_rf1_pct", "unit": "%",
+        "value": round(rf2_pct, 1),
+        "replication_rf1_samples_per_sec": round(rates[1]),
+        "replication_rf2_samples_per_sec": round(rates[2]),
+        "replication_rf2_vs_rf1_pct": round(rf2_pct, 1),
+        "replication_catchup_samples_per_sec": round(catchup_sps),
+        "replication_handoff_failed_queries": handoff_failed_queries,
+        "replication_handoff_partials": handoff_partials,
+        "replication_handoff_identical": handoff_identical,
+        "replication_handoff_seconds": round(handoff_s, 3),
+        "replication_handoff_queries_ok": handoff_queries_ok,
+        "replication_handoff_states": handoff_summary.get("states", []),
+        "replication_gate_ok": gate_ok,
+        "series_per_shard": S, "platform": "cpu",
+    }
 
 
 def measure_longrange(quick=False, series=None):
@@ -1968,10 +2317,17 @@ def parse_args(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("stage", nargs="?", default="",
                     choices=["", "chaos", "multichip", "wal", "longrange",
-                             "selfmon"],
+                             "selfmon", "replication"],
                     help="optional standalone stage: 'chaos' runs the "
-                         "failure-domain chaos harness (SIGKILL a data "
-                         "node mid-traffic) and writes SOAK_CHAOS.json; "
+                         "failure-domain chaos harness (SIGKILL one of "
+                         "three RF-2 data nodes mid-traffic; gates "
+                         "availability=1.0 with zero partials and zero "
+                         "acked loss) and writes SOAK_CHAOS.json; "
+                         "'replication' runs the in-process replication "
+                         "stage (RF-2 vs RF-1 fan-out throughput, WAL-"
+                         "segment catch-up drain, live shard handoff "
+                         "under traffic) and exits nonzero on a gate "
+                         "failure; "
                          "'multichip' runs the multi-device fused-scan "
                          "stage in-process (8 virtual devices on host "
                          "platforms) and exits nonzero if the fused "
@@ -2515,11 +2871,30 @@ def main():
     if args.stage == "chaos":
         # standalone failure-domain stage: runs IN THIS process (CPU-
         # pinned; chaos measures degradation machinery, not kernels),
-        # SIGKILLs and restarts a real data-node subprocess mid-traffic,
-        # prints the one-line chaos JSON and writes SOAK_CHAOS.json
-        print(json.dumps(run_chaos(quick=args.quick,
-                                   series=args.series or None)))
-        return
+        # SIGKILLs and respawns one of three RF-2 data-node
+        # subprocesses mid-traffic, prints the one-line chaos JSON and
+        # writes SOAK_CHAOS.json; nonzero exit when the flipped gate
+        # (availability 1.0, zero partials, zero acked loss) fails
+        try:
+            r = run_chaos(quick=args.quick, series=args.series or None)
+        except Exception as e:  # noqa: BLE001 — loud one-line fail
+            print(json.dumps({
+                "metric": "chaos_availability", "unit": "fraction",
+                "chaos_error": f"{type(e).__name__}: {e}"[:300]}))
+            sys.exit(1)
+        print(json.dumps(r))
+        sys.exit(0 if r.get("chaos_gate_ok") else 1)
+    if args.stage == "replication":
+        try:
+            r = run_replication(quick=args.quick,
+                                series=args.series or None)
+        except Exception as e:  # noqa: BLE001 — loud one-line fail
+            print(json.dumps({
+                "metric": "replication_rf2_vs_rf1_pct", "unit": "%",
+                "replication_error": f"{type(e).__name__}: {e}"[:300]}))
+            sys.exit(1)
+        print(json.dumps(r))
+        sys.exit(0 if r.get("replication_gate_ok") else 1)
     if args._worker:
         run_worker(args)
         return

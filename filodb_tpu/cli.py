@@ -306,6 +306,38 @@ def cmd_jobs(args) -> int:
     return 0
 
 
+def cmd_shards(args) -> int:
+    """Shard assignment table over HTTP (GET /admin/shards): one line
+    per shard — primary owner + status, the ordered replica list with
+    per-replica statuses, live-owner count — plus the replication
+    fan-out lag table when the server runs one.  The view an operator
+    checks before/after a handoff or node kill."""
+    params = {"dataset": args.dataset} if args.dataset else {}
+    payload = _http_get(args.host, "/admin/shards", params)
+    if payload.get("status") != "success":
+        print(json.dumps(payload, indent=2))
+        return 1
+    if args.raw:
+        print(json.dumps(payload, indent=2))
+        return 0
+    for ds, ent in payload["data"]["datasets"].items():
+        print(f"dataset {ds!r}: {ent['numShards']} shard(s), "
+              f"rf={ent['replicationFactor']}")
+        print(f"  {'SHARD':>5} {'PRIMARY':<16} {'STATUS':<12} "
+              f"{'LIVE':>4}  REPLICAS")
+        for row in ent["shards"]:
+            reps = ", ".join(f"{r['node']}({r['status']})"
+                             for r in row["replicas"]) or "-"
+            print(f"  {row['shard']:>5} {row['primary'] or '-':<16} "
+                  f"{row['status']:<12} {row['liveOwners']:>4}  {reps}")
+        for lag in ent.get("replicaLag", []):
+            flag = " LAGGING" if lag["lagging"] else ""
+            print(f"  peer {lag['peer']}: acked={lag['acked']} "
+                  f"failed={lag['failed']} "
+                  f"pending={lag['pendingRecords']}{flag}")
+    return 0
+
+
 def cmd_events(args) -> int:
     """Tail the structured event journal over HTTP (GET /admin/events):
     newest events once, from a sequence number (`--since-seq`), or
@@ -653,6 +685,15 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--raw", action="store_true",
                     help="print the raw JSON payload")
     sp.set_defaults(fn=cmd_jobs)
+
+    sp = sub.add_parser("shards", help="shard assignment/replica table "
+                                       "over HTTP (GET /admin/shards)")
+    sp.add_argument("--host", required=True)
+    sp.add_argument("--dataset", default="",
+                    help="narrow to one dataset (default: all)")
+    sp.add_argument("--raw", action="store_true",
+                    help="print the raw JSON payload")
+    sp.set_defaults(fn=cmd_shards)
 
     sp = sub.add_parser("events", help="tail the event journal over HTTP")
     sp.add_argument("--host", required=True)

@@ -221,6 +221,40 @@ class WalConfig:
 
 
 @dataclasses.dataclass
+class ReplicationConfig:
+    """Shard replication (filodb_tpu/replication/; doc/replication.md).
+
+    Every shard gets an ordered owner list — one primary plus
+    `factor - 1` replicas, never co-located on one node — and ingest
+    fans each columnar slab to all live owners, so a node SIGKILL
+    degrades into a query-time failover to the replica instead of a
+    flagged partial (the FiloDB ShardMapper/coordinator stance;
+    Cortex/Monarch replica sets).  Replicas that fall behind catch up
+    by streaming WAL segments from the primary (never by re-scraping)."""
+    enabled: bool = False
+    # owners per shard (primary + replicas).  1 = replication off.
+    factor: int = 2
+    # when the ack returns to the ingest client:
+    #   "primary" — primary durable; replica appends are async (lag
+    #               tracked, catch-up repairs)
+    #   "quorum"  — primary durable AND every LIVE replica acked (a
+    #               dead replica is marked lagging and skipped so one
+    #               corpse cannot wedge ingest; catch-up repairs it)
+    ack_mode: str = "quorum"
+    # per-replica append RPC timeout
+    append_timeout_s: float = 5.0
+    # a replica this many unacked records behind is journaled
+    # `replica_lagging` (and `replica_caught_up` when it drains)
+    lag_records_threshold: int = 256
+    # async (ack_mode=primary) per-replica send queue bound; overflow
+    # marks the replica lagging and drops (WAL catch-up repairs)
+    send_queue_max: int = 1024
+    # handoff: seconds the old owner keeps serving after cutover before
+    # its copy is tombstoned (lets in-flight queries drain)
+    handoff_tombstone_grace_s: float = 0.0
+
+
+@dataclasses.dataclass
 class SelfMonConfig:
     """Self-scrape meta-monitoring (utils/selfmon.py;
     doc/observability.md): an in-process loop snapshots the metrics
@@ -349,6 +383,8 @@ class FilodbSettings:
     rules: RulesConfig = dataclasses.field(default_factory=RulesConfig)
     wal: WalConfig = dataclasses.field(default_factory=WalConfig)
     selfmon: SelfMonConfig = dataclasses.field(default_factory=SelfMonConfig)
+    replication: ReplicationConfig = dataclasses.field(
+        default_factory=ReplicationConfig)
     shard_key_level_metrics: bool = True
     quota_default: int = 2_000_000_000
     reassignment_min_interval_s: float = 2 * 3600.0
@@ -384,7 +420,8 @@ class FilodbSettings:
         for section, obj in (("query", self.query), ("store", self.store),
                              ("breaker", self.breaker),
                              ("rules", self.rules), ("wal", self.wal),
-                             ("selfmon", self.selfmon)):
+                             ("selfmon", self.selfmon),
+                             ("replication", self.replication)):
             for k, v in (raw.pop(section, None) or {}).items():
                 _set_field(obj, k, v, f"{source}: {section}.{k}")
         if "spread_assignment" in raw:
@@ -430,7 +467,7 @@ class FilodbSettings:
             from filodb_tpu.utils.hoconlite import _parse_scalar
             parsed = _parse_scalar(val)
             for section in ("query_", "store_", "breaker_", "rules_",
-                            "wal_", "selfmon_"):
+                            "wal_", "selfmon_", "replication_"):
                 if rest.startswith(section):
                     overlay.setdefault(section[:-1], {})[
                         rest[len(section):]] = parsed

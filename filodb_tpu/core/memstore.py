@@ -46,6 +46,20 @@ class TimeSeriesMemStore:
     def shards_for(self, dataset: str) -> List[TimeSeriesShard]:
         return list(self._shards.get(dataset, {}).values())
 
+    def shard_map(self) -> Dict[str, List[int]]:
+        """dataset -> sorted shard numbers held locally."""
+        return {ds: sorted(sh) for ds, sh in self._shards.items()}
+
+    def drop_shard(self, dataset: str, shard_num: int) -> bool:
+        """Tombstone a local shard copy (live-handoff completion,
+        replication/handoff.py): the in-memory working set is released;
+        persisted chunks stay in the column store for the new owner."""
+        shards = self._shards.get(dataset)
+        if shards is None or shard_num not in shards:
+            return False
+        shards.pop(shard_num)
+        return True
+
     def ingest(self, dataset: str, shard_num: int, batch: RecordBatch,
                offset: int = -1) -> int:
         shard = self.get_shard(dataset, shard_num)

@@ -50,13 +50,19 @@ class RemoteWriteSink:
     def __init__(self, memstore, dataset: str,
                  mapper: Optional[ShardMapper] = None,
                  spread_provider: Optional[SpreadProvider] = None,
-                 schemas: Schemas = DEFAULT_SCHEMAS, wal=None):
+                 schemas: Schemas = DEFAULT_SCHEMAS, wal=None,
+                 replicator=None):
         self.memstore = memstore
         self.dataset = dataset
         self.mapper = mapper
         self.spread = spread_provider or SpreadProvider(0)
         self.schemas = schemas
         self.wal = wal
+        # replication fan-out (replication/replicator.py): every slab
+        # additionally ships to the shard's other owners; a shard NOT
+        # locally owned routes entirely through the fan-out (distributor
+        # mode) and the ack requires at least its primary's append
+        self.replicator = replicator
 
     # ------------------------------------------------------------- ingest
 
@@ -83,15 +89,38 @@ class RemoteWriteSink:
                 seqs.append(last_seq)
         for i, (shard_num, keys, ts, vals) in enumerate(slabs):
             shard = self.memstore.get_shard(self.dataset, shard_num)
-            if shard is None:
+            offset = seqs[i] if self.wal is not None else -1
+            if shard is not None:
+                got = shard.ingest_columns(SCHEMA, keys, ts,
+                                           {"value": vals}, offset=offset)
+                n += got
+                dropped += ts.size - got
+            elif self.replicator is None:
                 raise ConnectionError(
                     f"remote_write: shard {shard_num} of "
                     f"{self.dataset!r} is not locally owned")
-            offset = seqs[i] if self.wal is not None else -1
-            got = shard.ingest_columns(SCHEMA, keys, ts, {"value": vals},
-                                       offset=offset)
-            n += got
-            dropped += ts.size - got
+            # replication fan-out: the slab ships to every OTHER owner
+            # of the shard.  Locally-owned shards ack on local WAL
+            # durability (replica failures degrade to lag + catch-up);
+            # a shard owned elsewhere must land on at least one owner
+            # (require_primary) or the request bounces un-acked
+            if self.replicator is not None:
+                res = self.replicator.replicate(
+                    shard_num, SCHEMA, keys, ts, {"value": vals},
+                    seq=offset, require_primary=shard is None)
+                if shard is None:
+                    # account what the shard's OWNER actually ingested
+                    # (its OOO/dup drops count as drops here, exactly
+                    # like the locally-owned path); fall back to any
+                    # acking owner when the primary's ack was missing
+                    primary = self.mapper.node_for_shard(shard_num) \
+                        if self.mapper is not None else None
+                    got = res.ingested.get(primary) if primary else None
+                    if got is None and res.ingested:
+                        got = max(res.ingested.values())
+                    got = int(got or 0)
+                    n += got
+                    dropped += int(ts.size) - got
         if last_seq >= 0:
             self.wal.commit(last_seq)
         metrics_registry.counter("remote_write_samples",

@@ -94,6 +94,13 @@ class PromHttpApi:
         # remote_write sinks, built lazily per dataset (the WAL manager
         # is attached to the gateway pipeline after construction)
         self._rw_sinks: Dict[str, object] = {}
+        # replication layer attachments (FiloServer/deployments wire
+        # them post-construction, like the ruler): per-dataset ingest
+        # fan-out managers (replication/replicator.py — their lag table
+        # feeds /admin/shards) and live-handoff coordinators
+        # (replication/handoff.py — POST /admin/shards/{s}/handoff)
+        self.replicators: Dict[str, object] = {}
+        self.handoffs: Dict[str, object] = {}
 
     # ------------------------------------------------------------ dispatch
 
@@ -141,6 +148,11 @@ class PromHttpApi:
                 return self._breakers()
             if parts == ["admin", "jobs"] and method == "GET":
                 return self._jobs()
+            if parts == ["admin", "shards"] and method == "GET":
+                return self._shards(params)
+            if parts[:2] == ["admin", "shards"] and len(parts) == 4 \
+                    and parts[3] == "handoff" and method == "POST":
+                return self._shard_handoff(parts[2], params, body)
             if parts == ["admin", "events"] and method == "GET":
                 return self._events(params)
             if parts == ["admin", "rules", "reload"] and method == "POST":
@@ -321,6 +333,8 @@ class PromHttpApi:
             series, org, self._qconfig.tenant_ingest_samples_limit)
         if admitted:
             sink = self._remote_write_sink(dataset)
+            from filodb_tpu.replication.replicator import \
+                ReplicationSendError
             from filodb_tpu.wal import WalWriteError
             try:
                 sink.ingest_series(admitted)
@@ -331,6 +345,13 @@ class PromHttpApi:
                              "errorType": "unavailable",
                              "error":
                                  f"write-ahead log commit failed: {e}"}
+            except ReplicationSendError as e:
+                # distributor mode: a remotely-owned shard's slab landed
+                # on NO owner — same un-acked contract as a failed WAL
+                # commit (the client re-sends; dedup absorbs overlap)
+                return 503, {"status": "error",
+                             "errorType": "unavailable",
+                             "error": f"replication failed: {e}"}
         if rejected:
             # anything rejected makes the WHOLE response a 429 so the
             # client re-sends (never a silent drop): the re-send's
@@ -363,7 +384,8 @@ class PromHttpApi:
             sink = RemoteWriteSink(
                 gw.memstore, dataset, mapper=gw.mapper,
                 spread_provider=gw.spread, schemas=gw.schemas,
-                wal=getattr(gw, "wal", None))
+                wal=getattr(gw, "wal", None),
+                replicator=self.replicators.get(dataset))
             self._rw_sinks[dataset] = sink
         return sink
 
@@ -661,6 +683,72 @@ class PromHttpApi:
         snaps = jobs.snapshot()
         return 200, {"status": "success",
                      "data": {"count": len(snaps), "jobs": snaps}}
+
+    def _shards(self, params: Dict[str, str]) -> Tuple[int, object]:
+        """GET /admin/shards — the ShardMapper assignment table as JSON:
+        per shard the primary, its status, the ordered replica list with
+        per-replica status, live-owner count, and (when a replication
+        manager is attached) the per-peer fan-out lag table.  ?dataset=
+        narrows to one dataset (default: all registered)."""
+        want = params.get("dataset", "")
+        datasets = {}
+        for ds, mapper in self.shard_mappers.items():
+            if want and ds != want:
+                continue
+            ent = {"numShards": mapper.num_shards,
+                   "replicationFactor": getattr(mapper,
+                                                "replication_factor", 1),
+                   "shards": (mapper.assignment_table()
+                              if hasattr(mapper, "assignment_table")
+                              else [])}
+            repl = self.replicators.get(ds)
+            if repl is not None:
+                ent["replicaLag"] = repl.snapshot()
+            datasets[ds] = ent
+        if want and not datasets:
+            return 404, _err(f"dataset {want!r} not found")
+        return 200, {"status": "success", "data": {"datasets": datasets}}
+
+    def _shard_handoff(self, shard_s: str, params: Dict[str, str],
+                       body: bytes) -> Tuple[int, object]:
+        """POST /admin/shards/{s}/handoff — trigger a live handoff of
+        one shard to `to=<node>` (param or JSON body {"to": ...});
+        ?dataset= picks the dataset (default: the server's first).
+        `drain=true` additionally flips this node's /ready to 503 once
+        the move completes (the rolling-restart drain step,
+        doc/operations.md)."""
+        try:
+            shard = int(shard_s)
+        except ValueError:
+            raise _BadRequest(f"bad shard number {shard_s!r}")
+        req = {}
+        if body:
+            try:
+                req = json.loads(body.decode() or "{}")
+            except ValueError as e:
+                raise _BadRequest(f"bad handoff body: {e}")
+        to_node = params.get("to") or req.get("to")
+        if not to_node:
+            raise _BadRequest("handoff needs a target node "
+                              "(?to=<node> or body {\"to\": ...})")
+        dataset = params.get("dataset") or req.get("dataset") \
+            or self.default_dataset
+        coord = self.handoffs.get(dataset)
+        if coord is None:
+            return 400, _err(
+                f"no handoff coordinator for dataset {dataset!r} "
+                "(replication.enabled=false, or not wired)")
+        drain = str(params.get("drain", req.get("drain", ""))
+                    ).lower() in ("1", "true")
+        from filodb_tpu.replication.handoff import HandoffError
+        try:
+            summary = coord.handoff(shard, to_node)
+        except HandoffError as e:
+            return 409, _err(str(e))
+        if drain:
+            self.health.draining = (f"shard {shard} handed off to "
+                                    f"{to_node}")
+        return 200, {"status": "success", "data": summary}
 
     def _events(self, params: Dict[str, str]) -> Tuple[int, object]:
         """Structured event journal (utils/events.py): typed lifecycle

@@ -59,8 +59,10 @@ class ClusterCoordinator:
     def __init__(self, shard_manager: Optional[ShardManager] = None,
                  host: str = "127.0.0.1", port: int = 0,
                  liveness_timeout_s: float = 5.0,
-                 check_interval_s: float = 0.5):
-        self.sm = shard_manager or ShardManager()
+                 check_interval_s: float = 0.5,
+                 replication_factor: int = 1):
+        self.sm = shard_manager or ShardManager(
+            replication_factor=replication_factor)
         self.liveness_timeout_s = liveness_timeout_s
         self.check_interval_s = check_interval_s
         self._lock = threading.RLock()
@@ -124,9 +126,14 @@ class ClusterCoordinator:
     # ------------------------------------------------------------- handlers
 
     def _assignments_for(self, node: str) -> Dict[str, List[int]]:
+        """Shards `node` should hold a copy of: primaries AND replicas
+        (the node-side contract is identical — set up the shard, ingest
+        its stream; the coordinator's mapper keeps the roles)."""
         out = {}
         for ds in self.sm.datasets():
-            shards = self.sm.mapper(ds).shards_for_node(node)
+            m = self.sm.mapper(ds)
+            shards = sorted(set(m.shards_for_node(node))
+                            | set(m.replica_shards_for_node(node)))
             if shards:
                 out[ds] = shards
         return out
@@ -161,6 +168,13 @@ class ClusterCoordinator:
                                 mapper.statuses[s] != ShardStatus.ACTIVE:
                             self.sm.on_shard_event(
                                 ShardEvent("IngestionStarted", ds, s, node))
+                        elif node in mapper.replicas[s] and \
+                                mapper.owner_status(s, node) != \
+                                ShardStatus.ACTIVE:
+                            # a replica copy went live: it becomes a
+                            # query-time failover target
+                            self.sm.on_shard_event(
+                                ShardEvent("ReplicaActive", ds, s, node))
                 return {"ok": True,
                         "assignments": self._assignments_for(node)}
             if cmd == "state":
@@ -172,7 +186,15 @@ class ClusterCoordinator:
         datasets = {}
         for ds in self.sm.datasets():
             snap = self.sm.snapshot(ds)
-            datasets[ds] = {"nodes": snap.nodes, "statuses": snap.statuses}
+            m = self.sm.mapper(ds)
+            datasets[ds] = {
+                "nodes": snap.nodes, "statuses": snap.statuses,
+                # ordered replica owners + per-replica statuses, so a
+                # ClusterClient can rebuild failover dispatchers
+                "replicas": [list(r) for r in m.replicas],
+                "replica_statuses": {
+                    f"{s}:{n}": st.value
+                    for (s, n), st in m.replica_statuses.items()}}
         return {"members": self.sm.members, "nodes": nodes,
                 "datasets": datasets}
 
@@ -212,7 +234,8 @@ class ClusterClient:
         return reply["state"]
 
     def mapper(self, dataset: str) -> Tuple[ShardMapper, Dict[str, Tuple[str, int]]]:
-        """(ShardMapper, node -> query address) reflecting current state."""
+        """(ShardMapper, node -> query address) reflecting current state,
+        including the replica tails of each shard's assignment list."""
         st = self.state()
         ds = st["datasets"][dataset]
         mapper = ShardMapper(len(ds["nodes"]))
@@ -224,6 +247,13 @@ class ClusterClient:
             if status == ShardStatus.ACTIVE.value:
                 mapper.update_from_event(
                     ShardEvent("IngestionStarted", dataset, shard, node))
+        rstatus = ds.get("replica_statuses") or {}
+        for shard, repls in enumerate(ds.get("replicas") or []):
+            for node in repls:
+                mapper.register_replica(
+                    shard, node,
+                    status=ShardStatus(rstatus.get(f"{shard}:{node}",
+                                                   "Assigned")))
         addrs = {n: tuple(a) for n, a in st["nodes"].items()}
         return mapper, addrs
 

@@ -69,6 +69,11 @@ def main(argv=None) -> None:
     memstore = TimeSeriesMemStore(column_store=column_store,
                                   meta_store=meta_store)
     qsrv = NodeQueryServer(memstore).start()
+    # replication door (filodb_tpu/replication): peers fan ingest slabs
+    # here, and a joining replica streams WAL segments / handoff
+    # snapshots out of it
+    from filodb_tpu.replication import ReplicationServer
+    rsrv = ReplicationServer(memstore, node=args.name).start()
 
     def on_assign(dataset: str, shard: int) -> None:
         sh = memstore.get_shard(dataset, shard) or \
@@ -135,6 +140,7 @@ def main(argv=None) -> None:
     def _shutdown():
         agent.stop()
         qsrv.stop()
+        rsrv.stop()
         ctrl.shutdown()
         stop_evt.set()
 
@@ -143,6 +149,7 @@ def main(argv=None) -> None:
     t.start()
     print(json.dumps({"ready": True, "query_port": qsrv.address[1],
                       "control_port": ctrl.server_address[1],
+                      "replication_port": rsrv.address[1],
                       "node": args.name}), flush=True)
     try:
         stop_evt.wait()

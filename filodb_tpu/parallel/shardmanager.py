@@ -86,10 +86,15 @@ class ShardManager:
     def __init__(self,
                  strategy: Optional[ShardAssignmentStrategy] = None,
                  reassignment_min_interval_s: float = 2 * 3600.0,
-                 clock: Callable[[], float] = _time.time):
+                 clock: Callable[[], float] = _time.time,
+                 replication_factor: int = 1):
         self.strategy = strategy or DefaultShardAssignmentStrategy()
         self.reassignment_min_interval_s = reassignment_min_interval_s
         self.clock = clock
+        # owners per shard (primary + replicas); 1 = replication off —
+        # everything below then behaves exactly as before the
+        # replication layer (doc/replication.md)
+        self.replication_factor = max(int(replication_factor), 1)
         # deploy order: index = join order (reverse-deploy assignment walks
         # from the most recently joined, ref: ShardManager.addMember)
         self._members: List[str] = []
@@ -142,10 +147,12 @@ class ShardManager:
         if dataset in self._datasets:
             return self._mappers[dataset]
         self._datasets[dataset] = resources
-        mapper = ShardMapper(resources.num_shards)
+        mapper = ShardMapper(resources.num_shards,
+                             replication_factor=self.replication_factor)
         self._mappers[dataset] = mapper
         for node in reversed(self._members):
             self._assign_to(node, dataset)
+        self._assign_replicas(dataset)
         return mapper
 
     # --------------------------------------------------------------- members
@@ -161,6 +168,7 @@ class ShardManager:
             got = self._assign_to(node, dataset)
             if got:
                 out[dataset] = got
+            self._assign_replicas(dataset)
         return out
 
     def remove_member(self, node: str) -> Dict[str, List[int]]:
@@ -173,14 +181,29 @@ class ShardManager:
         affected: Dict[str, List[int]] = {}
         for dataset, mapper in self._mappers.items():
             shards = mapper.shards_for_node(node)
-            if not shards:
-                continue
-            affected[dataset] = shards
+            replica_shards = mapper.replica_shards_for_node(node)
+            if shards:
+                affected[dataset] = list(shards)
             for s in shards:
+                # RF >= 2: a live replica is promoted IN PLACE of the
+                # dead primary — the shard never goes Down, queries fail
+                # over without a gap (the point of the replication
+                # layer); the dead node leaves the owner list entirely
+                live = [n for n in mapper.replicas[s]
+                        if mapper.owner_status(s, n).query_ready]
+                if live:
+                    mapper.promote_replica(s, live[0], demote_old=False)
+                    ev = ShardEvent("ReplicaPromoted", dataset, s, live[0])
+                    self._publish(ev)
+                    continue
                 mapper.update_from_event(
                     ShardEvent("ShardDown", dataset, s, node))
                 self._publish(ShardEvent("ShardDown", dataset, s, node))
+            for s in replica_shards:
+                mapper.unassign_replica(s, node)
+                self._publish(ShardEvent("ReplicaDown", dataset, s, node))
             self._reassign_down_shards(dataset)
+            self._assign_replicas(dataset)
         return affected
 
     # ------------------------------------------------------------ assignment
@@ -230,6 +253,40 @@ class ShardManager:
         for node in reversed(self._members):
             moved.extend(self._assign_to(node, dataset))
         return moved
+
+    # -------------------------------------------------------------- replicas
+
+    def _assign_replicas(self, dataset: str) -> List[Tuple[int, str]]:
+        """Fill every shard's assignment list to `replication_factor`
+        owners: replicas are never co-located with the primary (or each
+        other), and spread by current replica load, least-loaded node
+        first.  No-op at RF 1.  Returns [(shard, node)] newly assigned."""
+        rf = self.replication_factor
+        if rf <= 1 or len(self._members) < 2:
+            return []
+        mapper = self._mappers[dataset]
+        load = {n: len(mapper.shards_for_node(n))
+                + len(mapper.replica_shards_for_node(n))
+                for n in self._members}
+        added: List[Tuple[int, str]] = []
+        for s in range(mapper.num_shards):
+            primary = mapper.node_for_shard(s)
+            if primary is None:
+                continue            # replicas follow a placed primary
+            while len(mapper.owners(s)) < rf:
+                taken = set(mapper.owners(s))
+                candidates = sorted(
+                    (n for n in self._members if n not in taken),
+                    key=lambda n: (load[n], self._members.index(n)))
+                if not candidates:
+                    break           # not enough nodes for full RF
+                node = candidates[0]
+                mapper.register_replica(s, node)
+                load[node] += 1
+                ev = ShardEvent("ReplicaAssigned", dataset, s, node)
+                self._publish(ev)
+                added.append((s, node))
+        return added
 
     # -------------------------------------------------------- ingest events
 

@@ -57,16 +57,39 @@ _EVENT_STATUS = {
     "ShardDown": ShardStatus.DOWN,
 }
 
+# replica-copy lifecycle events (replication layer, doc/replication.md):
+# they address ONE owner in the shard's ordered assignment list, never
+# the shard's primary status column
+_REPLICA_EVENT_STATUS = {
+    "ReplicaAssigned": ShardStatus.ASSIGNED,
+    "ReplicaRecovery": ShardStatus.RECOVERY,
+    "ReplicaActive": ShardStatus.ACTIVE,
+    "ReplicaDown": ShardStatus.DOWN,
+}
+
 
 class ShardMapper:
-    """Tracks shard -> (node, status) and does spread-based shard math."""
+    """Tracks shard -> ordered owner list (primary + replicas) with
+    per-owner status, and does spread-based shard math.
 
-    def __init__(self, num_shards: int):
+    RF-1 view (the pre-replication API) is unchanged: `nodes` /
+    `statuses` are the PRIMARY columns.  Replication adds an ordered
+    replica list per shard (`owners(s)` = [primary] + replicas) with
+    per-replica statuses, and `promote_replica` for the atomic cutover
+    a query-time failover or live handoff rides on."""
+
+    def __init__(self, num_shards: int, replication_factor: int = 1):
         assert num_shards > 0 and (num_shards & (num_shards - 1)) == 0, \
             "numShards must be a power of 2"
         self.num_shards = num_shards
         self.nodes: List[Optional[str]] = [None] * num_shards
         self.statuses: List[ShardStatus] = [ShardStatus.UNASSIGNED] * num_shards
+        # intended owners per shard (1 = unreplicated); the health
+        # evaluator compares live owners against it
+        self.replication_factor = max(int(replication_factor), 1)
+        # ordered NON-primary owners per shard (assignment-list tail)
+        self.replicas: List[List[str]] = [[] for _ in range(num_shards)]
+        self.replica_statuses: Dict[Tuple[int, str], ShardStatus] = {}
 
     # ------------------------------------------------------------ shard math
 
@@ -95,9 +118,32 @@ class ShardMapper:
     # --------------------------------------------------------- status state
 
     def update_from_event(self, ev: ShardEvent) -> None:
+        if ev.kind == "ReplicaPromoted":
+            if ev.node is not None and ev.node != self.nodes[ev.shard]:
+                self.promote_replica(ev.shard, ev.node, demote_old=False)
+            return
+        rst = _REPLICA_EVENT_STATUS.get(ev.kind)
+        if rst is not None:
+            if ev.node is None:
+                raise ValueError(f"replica event {ev.kind} needs a node")
+            if rst == ShardStatus.DOWN:
+                self.unassign_replica(ev.shard, ev.node)
+            else:
+                self.register_replica(ev.shard, ev.node, status=rst)
+            return
         st = _EVENT_STATUS.get(ev.kind)
         if st is None:
             raise ValueError(f"unknown shard event {ev.kind}")
+        if ev.node is not None and ev.node != self.nodes[ev.shard] \
+                and ev.node in self.replicas[ev.shard]:
+            # a primary-lifecycle event addressed to a REPLICA owner
+            # (e.g. ShardDown for a dead replica node) touches only that
+            # owner's column, never the primary's
+            if st in (ShardStatus.DOWN, ShardStatus.UNASSIGNED):
+                self.unassign_replica(ev.shard, ev.node)
+            else:
+                self.replica_statuses[(ev.shard, ev.node)] = st
+            return
         self.statuses[ev.shard] = st
         if ev.node is not None:
             self.nodes[ev.shard] = ev.node
@@ -131,6 +177,84 @@ class ShardMapper:
     def status_snapshot(self) -> Dict[int, Tuple[Optional[str], str]]:
         return {i: (self.nodes[i], self.statuses[i].value)
                 for i in range(self.num_shards)}
+
+    # ------------------------------------------------------------- replicas
+
+    def register_replica(self, shard: int, node: str,
+                         status: ShardStatus = ShardStatus.ASSIGNED) -> None:
+        """Append `node` to the shard's ordered assignment-list tail.
+        Registering the current primary is a no-op; re-registering an
+        existing replica only refreshes its status."""
+        if node == self.nodes[shard]:
+            return
+        if node not in self.replicas[shard]:
+            self.replicas[shard].append(node)
+        self.replica_statuses[(shard, node)] = status
+
+    def unassign_replica(self, shard: int, node: str) -> None:
+        if node in self.replicas[shard]:
+            self.replicas[shard].remove(node)
+        self.replica_statuses.pop((shard, node), None)
+
+    def owners(self, shard: int) -> List[str]:
+        """Ordered assignment list: primary first, then replicas."""
+        head = [self.nodes[shard]] if self.nodes[shard] is not None else []
+        return head + list(self.replicas[shard])
+
+    def owner_status(self, shard: int, node: str) -> ShardStatus:
+        if node == self.nodes[shard]:
+            return self.statuses[shard]
+        return self.replica_statuses.get((shard, node),
+                                         ShardStatus.UNASSIGNED)
+
+    def live_owners(self, shard: int) -> List[str]:
+        return [n for n in self.owners(shard)
+                if self.owner_status(shard, n).query_ready]
+
+    def replica_shards_for_node(self, node: str) -> List[int]:
+        return [s for s in range(self.num_shards)
+                if node in self.replicas[s]]
+
+    def promote_replica(self, shard: int, node: str,
+                        demote_old: bool = True) -> Optional[str]:
+        """Atomic cutover: `node` (a registered replica) becomes the
+        shard's primary; the old primary (returned) becomes the FIRST
+        replica when `demote_old` (failover promotion — its copy is
+        still the freshest fallback) or leaves the owner list entirely
+        (handoff tombstone path).  The shard's primary status carries
+        the promoted owner's replica status so an ACTIVE replica yields
+        an immediately query-ready primary."""
+        if node not in self.replicas[shard]:
+            raise ValueError(
+                f"cannot promote {node!r}: not a replica of shard {shard}")
+        old = self.nodes[shard]
+        old_status = self.statuses[shard]
+        new_status = self.replica_statuses.get(
+            (shard, node), ShardStatus.ASSIGNED)
+        self.replicas[shard].remove(node)
+        self.replica_statuses.pop((shard, node), None)
+        self.nodes[shard] = node
+        self.statuses[shard] = new_status
+        if old is not None and demote_old:
+            self.replicas[shard].insert(0, old)
+            self.replica_statuses[(shard, old)] = old_status
+        return old
+
+    def assignment_table(self) -> List[Dict]:
+        """Per-shard assignment/status rows for GET /admin/shards."""
+        out = []
+        for s in range(self.num_shards):
+            out.append({
+                "shard": s,
+                "primary": self.nodes[s],
+                "status": self.statuses[s].value,
+                "replicas": [
+                    {"node": n,
+                     "status": self.owner_status(s, n).value}
+                    for n in self.replicas[s]],
+                "liveOwners": len(self.live_owners(s)),
+            })
+        return out
 
 
 @dataclasses.dataclass(frozen=True)

@@ -36,6 +36,130 @@ class TwoNodeCluster:
             srv.stop()
 
 
+@dataclasses.dataclass
+class ReplicatedCluster:
+    """N in-process nodes, every shard owned RF times: query servers on
+    the real cross-node transport, replication doors on the real framed
+    protocol, ingest fanned out by a ReplicationManager (distributor
+    mode), queries planned through ReplicaFailoverDispatchers.  The
+    shared fixture of the replication tests AND `bench.py replication`."""
+    dataset: str
+    engine: QueryEngine
+    mapper: ShardMapper
+    manager: "object"                     # ReplicationManager
+    stores: Dict[str, TimeSeriesMemStore]
+    query_servers: Dict[str, "NodeQueryServer"]
+    repl_servers: Dict[str, "object"]     # ReplicationServer per node
+    repl_clients: Dict[str, "object"]     # ReplicaClient per node
+    truth: Optional[TimeSeriesMemStore]
+    sm: "object"                          # ShardManager
+
+    def ingest_grid(self, shard: int, schema: str, keys, ts, columns,
+                    require_primary: bool = True):
+        """One slab through the replicated ingest path (all owners) +
+        the truth store when present."""
+        res = self.manager.replicate(shard, schema, keys, ts, columns,
+                                     require_primary=require_primary)
+        if self.truth is not None:
+            self.truth.get_shard(self.dataset, shard).ingest_columns(
+                schema, keys, ts, columns)
+        return res
+
+    def kill(self, node: str) -> None:
+        """In-process node death with the SIGKILL signature: live
+        transport connections sever, new connects refuse."""
+        self.query_servers[node].stop()
+        self.repl_servers[node].stop()
+
+    def stop(self) -> None:
+        self.manager.stop()
+        for srv in self.query_servers.values():
+            try:
+                srv.stop()
+            except OSError:
+                pass
+        for srv in self.repl_servers.values():
+            try:
+                srv.stop()
+            except OSError:
+                pass
+
+
+def make_replicated_cluster(nodes=("A", "B", "C"), num_shards: int = 4,
+                            dataset: str = "prometheus",
+                            replication_factor: int = 2,
+                            ack_mode: str = "quorum",
+                            with_truth: bool = False,
+                            wal_root: Optional[str] = None
+                            ) -> ReplicatedCluster:
+    from filodb_tpu.config import ReplicationConfig
+    from filodb_tpu.parallel.shardmanager import (DatasetResourceSpec,
+                                                  ShardManager)
+    from filodb_tpu.replication import (ReplicaClient, ReplicationManager,
+                                        ReplicationServer,
+                                        failover_dispatcher_factory)
+    sm = ShardManager(replication_factor=replication_factor)
+    for n in nodes:
+        sm.add_member(n)
+    mapper = sm.setup_dataset(
+        dataset, DatasetResourceSpec(num_shards, len(nodes)))
+    stores = {n: TimeSeriesMemStore() for n in nodes}
+    wals: Dict[str, Dict] = {n: {} for n in nodes}
+    if wal_root is not None:
+        import os
+
+        from filodb_tpu.wal import WalManager
+        for n in nodes:
+            wals[n] = {dataset: WalManager(
+                os.path.join(wal_root, n), dataset)}
+    for s in range(num_shards):
+        for n in mapper.owners(s):
+            stores[n].setup(dataset, s)
+    # every owner copy is live from the start (in-process fixture — the
+    # cluster path flips these through heartbeats)
+    for s in range(num_shards):
+        primary = mapper.node_for_shard(s)
+        mapper.update_from_event(
+            ShardEvent("IngestionStarted", dataset, s, primary))
+        for n in list(mapper.replicas[s]):
+            mapper.update_from_event(
+                ShardEvent("ReplicaActive", dataset, s, n))
+    query_servers = {n: NodeQueryServer(st).start()
+                     for n, st in stores.items()}
+    repl_servers = {n: ReplicationServer(stores[n], node=n,
+                                         wals=wals[n]).start()
+                    for n in nodes}
+    repl_clients = {n: ReplicaClient(*srv.address)
+                    for n, srv in repl_servers.items()}
+    cfg = ReplicationConfig(enabled=True, factor=replication_factor,
+                            ack_mode=ack_mode)
+    manager = ReplicationManager(dataset, mapper,
+                                 lambda n: repl_clients[n], config=cfg)
+    dispatchers: Dict[str, RemoteNodeDispatcher] = {}
+
+    def dispatcher_for(node: str) -> RemoteNodeDispatcher:
+        d = dispatchers.get(node)
+        if d is None:
+            dispatchers[node] = d = RemoteNodeDispatcher(
+                *query_servers[node].address)
+        return d
+
+    planner = SingleClusterPlanner(
+        dataset, mapper, SpreadProvider(default_spread=1),
+        dispatcher_factory=failover_dispatcher_factory(mapper,
+                                                       dispatcher_for))
+    engine = QueryEngine(dataset, TimeSeriesMemStore(), mapper,
+                         planner=planner)
+    truth = None
+    if with_truth:
+        truth = TimeSeriesMemStore()
+        for s in range(num_shards):
+            truth.setup(dataset, s)
+    return ReplicatedCluster(dataset, engine, mapper, manager, stores,
+                             query_servers, repl_servers, repl_clients,
+                             truth, sm)
+
+
 def make_two_node_cluster(batches: Iterable = (), num_shards: int = 4,
                           dataset: str = "prometheus",
                           default_spread: int = 1,

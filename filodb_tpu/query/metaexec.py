@@ -166,7 +166,11 @@ class LabelValuesExec(LeafExecPlan):
         self.start_ms, self.end_ms = start_ms, end_ms
 
     def args_str(self):
-        return f"shard={self.shard}, labels={self.labels}"
+        # filters are part of the string: the gather's duplicate-shard
+        # dedup keys on args_str, and two same-shard children with
+        # different selectors must never collapse
+        return (f"shard={self.shard}, labels={self.labels}, "
+                f"filters={[str(f) for f in self.filters]}")
 
     def _do_execute(self, source) -> QueryResultLike:
         shard = source.get_shard(self.dataset, self.shard)
@@ -189,6 +193,9 @@ def _canon(x):
 
 class MetadataMergeExec(NonLeafExecPlan):
     """Merge metadata results across shards."""
+
+    # per-shard metadata leaves: dup shards (handoff window) answer once
+    dedup_shard_children = True
 
     def compose(self, results, stats):
         merged = None

@@ -37,6 +37,10 @@ from filodb_tpu.query.transformers import _group_ids
 class DistConcatExec(NonLeafExecPlan):
     """Concatenate child results (ref: exec/DistConcatExec.scala)."""
 
+    # children are same-selector per-shard leaves: a shard listed twice
+    # (both owners during a live handoff) must contribute exactly once
+    dedup_shard_children = True
+
     def compose(self, results, stats):
         blocks = [r for r in results if isinstance(r, ResultBlock)]
         raws = [r for r in results if isinstance(r, RawBlock)]
@@ -109,6 +113,10 @@ class LocalPartitionDistConcatExec(DistConcatExec):
 
 class ReduceAggregateExec(NonLeafExecPlan):
     """Reduce phase across shards (ref: AggrOverRangeVectors.scala:51)."""
+
+    # a duplicate shard here would double-count its samples into the
+    # aggregate — the dedup contract matters most on this plan
+    dedup_shard_children = True
 
     def __init__(self, ctx, children, op: str, params: Tuple = ()):
         super().__init__(ctx, children)

@@ -1,0 +1,53 @@
+"""Replication layer — RF-2 ingest, WAL-segment catch-up, query-time
+replica failover, live shard handoff (doc/replication.md).
+
+PR 4 made node failures degrade into FLAGGED partial results; a
+production time-series store serves FULL results through a node kill by
+owning every shard twice (ref: FiloDB's ShardMapper/coordinator layer,
+PAPER.md §1; the Cortex distributor / Monarch replica-set stance).  The
+four pieces, each its own module:
+
+  placement   parallel/shardmapper.py + shardmanager.py grew ordered
+              per-shard assignment lists (primary + replicas, never
+              co-located) — this package consumes them.
+  service.py  the node-side replication door: framed-TCP server
+              accepting slab appends, WAL-segment fetches, working-set
+              snapshot streams, and shard drops; `ReplicaClient` is the
+              pooled client every other module dials peers with.
+  replicator.py  ingest fan-out (the distributor): every columnar slab
+              goes to all live owners of its shard, acked
+              primary-durable (+ replica-acked under
+              `replication.ack_mode = quorum`), with per-replica lag
+              tracked as metrics and `replica_lagging` /
+              `replica_caught_up` journal events.
+  catchup.py  a replica joining or falling behind streams WAL segments
+              from the primary (never re-scrapes) and replays them
+              through the ordinary wal/replay.py ingest path, as a
+              `replication_catchup` job in the PR 10 registry.
+  failover.py the query-time half: `ReplicaFailoverDispatcher` prefers
+              the primary and fails over to replicas on
+              shard_unavailable / breaker-open BEFORE the PR 4 partial
+              path engages — partials only when ALL owners are dead.
+  handoff.py  admin-triggered live shard handoff: stream working set +
+              WAL tail to the new owner while the old one keeps
+              serving, cut the ShardMapper over atomically, then
+              tombstone — every transition journaled; rolling restarts
+              drain through it (`/ready` flips 503).
+"""
+from filodb_tpu.replication.service import (ReplicaClient,  # noqa: F401
+                                            ReplicationServer,
+                                            ReplicationError)
+from filodb_tpu.replication.replicator import (ReplicationManager,  # noqa: F401
+                                               ReplicateResult)
+from filodb_tpu.replication.catchup import (CatchupStats,  # noqa: F401
+                                            catchup_shards)
+from filodb_tpu.replication.failover import (  # noqa: F401
+    ReplicaFailoverDispatcher, failover_dispatcher_factory)
+from filodb_tpu.replication.handoff import (HandoffCoordinator,  # noqa: F401
+                                            HandoffError)
+
+__all__ = ["ReplicaClient", "ReplicationServer", "ReplicationError",
+           "ReplicationManager", "ReplicateResult", "CatchupStats",
+           "catchup_shards", "ReplicaFailoverDispatcher",
+           "failover_dispatcher_factory", "HandoffCoordinator",
+           "HandoffError"]

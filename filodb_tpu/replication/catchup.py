@@ -1,0 +1,166 @@
+"""WAL-segment catch-up: a replica repairs its copy from the primary's
+log, never by re-scraping.
+
+A replica that joined late, fell behind (lagging fan-out), or restarted
+empty streams WAL segment FILES from the shard's primary
+(service.ReplicaClient.fetch_segments), lands them in a scratch
+directory, and replays them through the ordinary wal/replay.py ingest
+path with a shard filter and its resume point — so catch-up is the boot
+recovery path pointed at a peer instead of the local disk, not a second
+ingest implementation.  Idempotence comes for free from the same
+store-level OOO/dup handling replay already rides.
+
+Every run registers a `replication_catchup` job in the PR 10 registry
+(GET /admin/jobs shows progress; a failing catch-up streak feeds the
+health verdict) and journals `replica_caught_up` on success.
+"""
+from __future__ import annotations
+
+import dataclasses
+import logging
+import os
+import shutil
+import tempfile
+import time
+from typing import Dict, Iterable, Optional
+
+from filodb_tpu.utils.events import journal
+from filodb_tpu.utils.jobs import jobs
+from filodb_tpu.utils.metrics import registry as metrics_registry
+from filodb_tpu.wal.replay import replay_dir
+from filodb_tpu.wal.segment import segment_path
+
+_log = logging.getLogger("filodb.replication")
+
+
+@dataclasses.dataclass
+class CatchupStats:
+    segments: int = 0
+    records: int = 0
+    samples: int = 0
+    skipped_records: int = 0
+    last_seq: int = -1
+    elapsed_s: float = 0.0
+
+    @property
+    def samples_per_sec(self) -> float:
+        return self.samples / self.elapsed_s if self.elapsed_s > 0 else 0.0
+
+
+def relay_wal(src_client, dst_client, dataset: str,
+              shards: Optional[Iterable[int]] = None,
+              since_seq: int = -1, restore: bool = True) -> int:
+    """Coordinator-mediated catch-up: stream WAL segments from one
+    peer, decode + shard-filter here, re-append through the other
+    peer's ordinary replication door (so the records land in ITS WAL
+    too).  Relayed records are restore-flagged by default — they are
+    history, and must apply inside an open restore window instead of
+    being buffered behind it.  Returns records relayed.  Used by the
+    handoff WAL-tail phase and the chaos bench's respawn repair; a
+    source without a WAL relays nothing."""
+    from filodb_tpu.replication.service import ReplicationError
+    from filodb_tpu.wal.segment import (WalCorruption, WalRecord,
+                                        read_records, segment_path)
+    shard_set = set(int(s) for s in shards) if shards is not None else None
+    tmp = tempfile.mkdtemp(prefix="filodb-relay-")
+    sent = 0
+    try:
+        try:
+            segs = list(src_client.fetch_segments(dataset, since_seq))
+        except ReplicationError:
+            return 0
+        for first_seq, data in segs:
+            path = segment_path(tmp, first_seq)
+            with open(path, "wb") as f:
+                f.write(data)
+            tables: dict = {}
+            try:
+                for body in read_records(path):
+                    rec = WalRecord.decode(body, tables)
+                    if shard_set is not None \
+                            and rec.shard not in shard_set:
+                        continue
+                    if rec.seq <= since_seq:
+                        continue
+                    dst_client.append_record(dataset, rec.encode(),
+                                             seq=rec.seq,
+                                             restore=restore)
+                    sent += 1
+            except WalCorruption as e:
+                _log.warning("WAL relay: segment %s corrupt (%s) — "
+                             "continuing", path, e)
+            finally:
+                os.unlink(path)
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    return sent
+
+
+def catchup_shards(client, dataset: str, memstore,
+                   shards: Optional[Iterable[int]] = None,
+                   since: Optional[Dict[int, int]] = None,
+                   node: str = "local",
+                   scratch_dir: Optional[str] = None) -> CatchupStats:
+    """Stream WAL segments from `client`'s peer and replay the shards in
+    `shards` (None = every shard in the log) into `memstore`.  `since`
+    maps shard -> resume seq (records at or below it skip — typically
+    the replica's horizon from ReplicationServer.horizon).  Returns
+    CatchupStats; raises on transport failure (the caller's job streak
+    then feeds the health verdict)."""
+    t0 = time.perf_counter()
+    since = dict(since or {})
+    shard_set = set(int(s) for s in shards) if shards is not None else None
+    job = jobs.register("replication_catchup", dataset=dataset)
+    stats = CatchupStats()
+    with job.tick() as tick:
+        # fetch horizon: the MIN resume point over the TARGET shards,
+        # where a shard absent from `since` replays from the beginning
+        # (-1) — min(since.values()) alone would let one caught-up
+        # shard's horizon skip segments a brand-new shard still needs
+        if shard_set is not None:
+            min_since = min((since.get(s, -1) for s in shard_set),
+                            default=-1)
+        else:
+            # unknown target set: the log may hold shards `since` never
+            # mapped, so nothing can safely bound the fetch — stream
+            # everything; replay's restart_points still skip per shard
+            min_since = -1
+        tmp = scratch_dir or tempfile.mkdtemp(prefix="filodb-catchup-")
+        own_tmp = scratch_dir is None
+        try:
+            os.makedirs(tmp, exist_ok=True)
+            job.set_progress("streaming segments")
+            for first_seq, data in client.fetch_segments(dataset,
+                                                         min_since):
+                path = segment_path(tmp, first_seq)
+                with open(path, "wb") as f:
+                    f.write(data)
+                stats.segments += 1
+            job.set_progress(
+                f"replaying {stats.segments} segment(s)")
+            rstats = replay_dir(tmp, memstore, dataset,
+                                restart_points=since,
+                                shard_filter=shard_set)
+            stats.records = rstats.records
+            stats.samples = rstats.samples
+            stats.skipped_records = rstats.skipped_records
+            stats.last_seq = rstats.last_seq
+            if rstats.corrupt_segments:
+                # acknowledged data on the PRIMARY was unreadable — the
+                # copy may still be short; surface it as a failed run
+                tick.handle.note_error(
+                    f"{rstats.corrupt_segments} corrupt segment(s) "
+                    "during catch-up")
+        finally:
+            if own_tmp:
+                shutil.rmtree(tmp, ignore_errors=True)
+    stats.elapsed_s = time.perf_counter() - t0
+    metrics_registry.counter("replication_catchup_samples",
+                             dataset=dataset).increment(stats.samples)
+    journal.emit("replica_caught_up", subsystem="replication",
+                 dataset=dataset, peer=client.where, node=node,
+                 records=stats.records, samples=stats.samples,
+                 last_seq=stats.last_seq,
+                 elapsed_s=round(stats.elapsed_s, 3))
+    job.set_progress(f"caught up to seq {stats.last_seq}")
+    return stats

@@ -1,0 +1,101 @@
+"""Query-time replica failover — full results through a SIGKILL.
+
+`ReplicaFailoverDispatcher` wraps one dispatcher per owner of a shard,
+in assignment-list order: the primary is preferred; `shard_unavailable`
+(connection refused/reset, or the peer's circuit breaker failing fast)
+falls through to the next owner BEFORE the PR 4 partial-results path
+ever engages.  Only when EVERY owner of the shard is unreachable does
+the typed error propagate — and then the existing retry-then-degrade
+machinery (engine re-plan, partial_now) takes over, so partials happen
+exactly when all copies of a shard are dead.
+
+`dispatch_timeout` / `query_timeout` / `remote_failure` do NOT fail
+over: a timeout means the remote may still be executing (re-sending
+elsewhere wastes the survivors' budget), and a remote_failure would
+fail identically on the replica (same plan, same data).
+"""
+from __future__ import annotations
+
+import logging
+from typing import Callable, List, Optional, Sequence, Tuple
+
+from filodb_tpu.query.execbase import PlanDispatcher, QueryError
+
+_log = logging.getLogger("filodb.replication")
+
+
+class ReplicaFailoverDispatcher(PlanDispatcher):
+    """Ordered owner list -> first owner that answers.  `targets` is
+    [(node_name, dispatcher)] in assignment order (primary first)."""
+
+    def __init__(self, targets: Sequence[Tuple[str, PlanDispatcher]],
+                 shard: Optional[int] = None):
+        self.targets = list(targets)
+        self.shard = shard
+
+    def dispatch(self, plan, source):
+        from filodb_tpu.utils.metrics import registry
+        last: Optional[QueryError] = None
+        for i, (node, disp) in enumerate(self.targets):
+            try:
+                out = disp.dispatch(plan, source)
+                if i > 0:
+                    # served by a replica: a FULL answer, not a partial
+                    # — counted so chaos runs can prove failover (not
+                    # luck) kept availability at 1.0
+                    registry.counter("query_replica_failovers",
+                                     peer=node).increment()
+                return out
+            except QueryError as e:
+                if e.code != "shard_unavailable":
+                    raise
+                last = e
+                if i + 1 < len(self.targets):
+                    _log.debug("shard %s owner %s unavailable (%s) — "
+                               "failing over to %s", self.shard, node,
+                               e, self.targets[i + 1][0])
+        if last is None:
+            raise QueryError(
+                "shard_unavailable",
+                f"shard {self.shard} has no owners to dispatch to")
+        raise QueryError(
+            "shard_unavailable",
+            f"all {len(self.targets)} owner(s) of shard {self.shard} "
+            f"unavailable (last: {last})")
+
+
+def failover_dispatcher_factory(
+        mapper, dispatcher_for: Callable[[str], PlanDispatcher],
+        local_node: Optional[str] = None,
+        local_dispatcher: Optional[PlanDispatcher] = None
+        ) -> Callable[[int], Optional[PlanDispatcher]]:
+    """Build a planner `dispatcher_factory(shard)` from a replica-aware
+    ShardMapper: each shard's dispatcher walks its CURRENT owner list
+    (read per materialization, so a promotion or handoff cutover is
+    picked up by the very next query).  `dispatcher_for(node)` dials a
+    remote owner; `local_node`'s copy (when this process IS an owner)
+    executes through `local_dispatcher` (defaults to in-process)."""
+    from filodb_tpu.query.execbase import InProcessPlanDispatcher
+
+    def factory(shard: int) -> Optional[PlanDispatcher]:
+        # primary always dispatches; replicas only once query-ready
+        # (ACTIVE/RECOVERY) — an ASSIGNED copy still catching up would
+        # serve a silently-short "full" result on failover
+        primary = mapper.node_for_shard(shard)
+        owners = ([primary] if primary is not None else []) + [
+            n for n in mapper.replicas[shard]
+            if mapper.owner_status(shard, n).query_ready]
+        if not owners:
+            return None
+        targets: List[Tuple[str, PlanDispatcher]] = []
+        for node in owners:
+            if local_node is not None and node == local_node:
+                targets.append((node, local_dispatcher
+                                or InProcessPlanDispatcher()))
+            else:
+                targets.append((node, dispatcher_for(node)))
+        if len(targets) == 1:
+            return targets[0][1]
+        return ReplicaFailoverDispatcher(targets, shard=shard)
+
+    return factory

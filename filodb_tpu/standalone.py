@@ -25,7 +25,7 @@ from filodb_tpu.gateway.router import GatewayPipeline
 from filodb_tpu.http.routes import PromHttpApi
 from filodb_tpu.http.server import FiloHttpServer
 from filodb_tpu.parallel.shardmapper import (ShardEvent, ShardMapper,
-                                             SpreadProvider)
+                                             ShardStatus, SpreadProvider)
 from filodb_tpu.query.engine import QueryEngine
 from filodb_tpu.query.planner import SingleClusterPlanner
 from filodb_tpu.query.planners import (ShardKeyRegexPlanner,
@@ -48,7 +48,8 @@ class FiloServer:
                  meta_store: Optional[MetaStore] = None,
                  config: Optional[FilodbSettings] = None,
                  http_host: str = "127.0.0.1", http_port: int = 0,
-                 node_name: str = "local"):
+                 node_name: str = "local",
+                 replication_peers: Optional[Dict[str, tuple]] = None):
         self.config = config or default_settings()
         # health model (utils/health.py): phase machinery + per-subsystem
         # verdicts, served at /healthz, /ready and /api/v1/status/health.
@@ -157,6 +158,79 @@ class FiloServer:
                 self.memstore, sm_ds, self.mappers[sm_ds],
                 self.spreads[sm_ds], node_name=self.node_name,
                 interval_s=self.config.selfmon.interval_s)
+        # Replication layer (filodb_tpu/replication; doc/replication.md):
+        # this node's replication door accepts slab appends / WAL-
+        # segment fetches / snapshot streams from peers; with a peer
+        # address book, ingest fans out through a ReplicationManager and
+        # live handoffs drive through a HandoffCoordinator (both
+        # surfaced at /admin/shards).  Single-node deployments without
+        # peers still get the door — a future replica catches up from it.
+        self.replication_server = None
+        self.replicators: Dict[str, object] = {}
+        self.handoff_coordinators: Dict[str, object] = {}
+        if self.config.replication.enabled:
+            from filodb_tpu.replication import (HandoffCoordinator,
+                                                ReplicaClient,
+                                                ReplicationManager,
+                                                ReplicationServer)
+            self.replication_server = ReplicationServer(
+                self.memstore, node=node_name, wals=self.wals)
+            peers = dict(replication_peers or {})
+            clients: Dict[str, ReplicaClient] = {}
+
+            def client_for(node: str) -> ReplicaClient:
+                cli = clients.get(node)
+                if cli is None:
+                    if node == node_name and node not in peers:
+                        # a handoff OFF this node dials its own door
+                        # (the from-node side of the stream)
+                        host, port = self.replication_server.address
+                    else:
+                        host, port = peers[node]
+                    clients[node] = cli = ReplicaClient(
+                        host, port,
+                        timeout_s=self.config.replication.append_timeout_s)
+                return cli
+
+            peer_names = sorted(n for n in peers if n != node_name)
+            for dc in self.datasets:
+                mapper = self.mappers[dc.name]
+                if peers:
+                    # the RF intent lands on the mapper only when peers
+                    # exist to place replicas on — a single node running
+                    # just the door must not pin the health verdict at
+                    # degraded-underReplicated forever
+                    mapper.replication_factor = \
+                        self.config.replication.factor
+                    # static placement: every shard's replica tail
+                    # fills from the peer address book, rotated by
+                    # shard so copies spread — without this the
+                    # documented conf would build a fan-out manager
+                    # whose owner lists never contain a replica (a
+                    # silent no-op pinned at degraded).  ACTIVE: a
+                    # configured peer door is the deployment's claim
+                    # that the copy serves (the cluster path flips
+                    # these from heartbeats instead).
+                    for s in range(dc.num_shards):
+                        for i in range(
+                                self.config.replication.factor - 1):
+                            if not peer_names:
+                                break
+                            peer = peer_names[(s + i) % len(peer_names)]
+                            mapper.register_replica(
+                                s, peer, status=ShardStatus.ACTIVE)
+                    self.replicators[dc.name] = ReplicationManager(
+                        dc.name, mapper, client_for,
+                        config=self.config.replication,
+                        local_node=node_name)
+                    self.handoff_coordinators[dc.name] = \
+                        HandoffCoordinator(
+                            dc.name, mapper, client_for,
+                            tombstone_grace_s=self.config.replication
+                            .handoff_tombstone_grace_s,
+                            health=self.health)
+            self.api.replicators = self.replicators
+            self.api.handoffs = self.handoff_coordinators
         # boot WAL replay: runs AFTER the API exists (the transport-
         # agnostic routes answer /healthz — and /ready with 503 — while
         # the log replays) and BEFORE start() declares the node serving;
@@ -350,6 +424,8 @@ class FiloServer:
 
     def start(self, background_flush: bool = True) -> None:
         self.http.start()
+        if self.replication_server is not None:
+            self.replication_server.start()
         self.trace_exporter = None
         if self.config.trace_export_url:
             from filodb_tpu.utils.traceexport import TraceExporter
@@ -416,6 +492,11 @@ class FiloServer:
         if getattr(self, "trace_exporter", None) is not None:
             self.trace_exporter.stop()
             self.trace_exporter = None
+        for repl in self.replicators.values():
+            repl.stop()
+        if self.replication_server is not None:
+            self.replication_server.stop()
+            self.replication_server = None
         self.http.stop()
         for wal in self.wals.values():
             wal.close()

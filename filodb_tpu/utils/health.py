@@ -62,6 +62,11 @@ class HealthEvaluator:
     def __init__(self, node_name: str = "local", phase: str = SERVING):
         self.node = node_name
         self.phase = phase
+        # non-empty while this node is drained for a rolling restart
+        # (replication/handoff.py drain; POST /admin/shards/../handoff
+        # with drain=true): /ready answers 503 so the load balancer
+        # stops routing here before the process restarts
+        self.draining = ""
         self._lock = threading.Lock()
         self.started_unix_s = time.time()
         # dataset -> {"enabled", "replayDone", "replayRecords", ...}
@@ -172,8 +177,30 @@ class HealthEvaluator:
                 v = DEGRADED
             if len(snap) and active == 0:
                 v = FAILED
+            ent = {"counts": by_status}
+            # replication intent vs reality (doc/replication.md): a
+            # shard short of its owner target — in particular a primary
+            # serving with ZERO live replicas — is one failure from
+            # partials, so the verdict degrades even though serving is
+            # currently fine
+            rf = getattr(mapper, "replication_factor", 1)
+            if rf >= 2 and hasattr(mapper, "live_owners"):
+                under = dead = 0
+                for s in range(mapper.num_shards):
+                    live = len(mapper.live_owners(s))
+                    if live == 0:
+                        dead += 1
+                    elif live < rf:
+                        under += 1
+                ent["underReplicated"] = under
+                ent["noLiveOwners"] = dead
+                if dead:
+                    v = FAILED
+                elif under:
+                    v = _worst((v, DEGRADED))
             worst = _worst((worst, v))
-            datasets[ds] = {"status": v, "counts": by_status}
+            ent["status"] = v
+            datasets[ds] = ent
         return {"status": worst, "datasets": datasets,
                 "recovering": recovering}
 
@@ -215,6 +242,8 @@ class HealthEvaluator:
         signal a load balancer or rolling restart needs."""
         if self.phase != SERVING:
             return False, f"phase={self.phase}"
+        if self.draining:
+            return False, f"draining: {self.draining}"
         jv = self._jobs_verdict()
         if jv["criticalFailed"]:
             return False, ("critical job failed: "

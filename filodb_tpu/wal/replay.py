@@ -50,13 +50,16 @@ class ReplayStats:
 
 
 def replay_dir(dir_path: str, memstore, dataset: str,
-               restart_points: Optional[Dict[int, int]] = None
-               ) -> ReplayStats:
+               restart_points: Optional[Dict[int, int]] = None,
+               shard_filter: Optional[set] = None) -> ReplayStats:
     """Replay every WAL segment under `dir_path` into `memstore`'s shards
     of `dataset`.  `restart_points` maps shard -> persisted horizon seq
     (records with seq <= horizon skip); missing shards replay from the
-    beginning.  Returns ReplayStats; the memstore's shards are created on
-    demand (a restarted node re-learns its shard set from the log)."""
+    beginning.  `shard_filter` (replication catch-up: a replica replays
+    a primary's shipped segments for only the shards it owns a copy of)
+    drops foreign-shard records before any stats tracking.  Returns
+    ReplayStats; the memstore's shards are created on demand (a
+    restarted node re-learns its shard set from the log)."""
     stats = ReplayStats()
     restart_points = restart_points or {}
     t0 = time.perf_counter()
@@ -67,6 +70,9 @@ def replay_dir(dir_path: str, memstore, dataset: str,
             for body in read_records(path):
                 rec = WalRecord.decode(body, tables)
                 faults.fire("wal.replay")
+                if shard_filter is not None \
+                        and rec.shard not in shard_filter:
+                    continue
                 stats.last_seq = max(stats.last_seq, rec.seq)
                 stats.shards[rec.shard] = max(
                     stats.shards.get(rec.shard, -1), rec.seq)

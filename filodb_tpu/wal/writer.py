@@ -322,6 +322,33 @@ class WalWriter:
         with self._lock:
             return len(self._sealed) + 1
 
+    def snapshot_segments(self) -> Tuple[List[Tuple[int, int, str, int]], int]:
+        """Read snapshot for replication catch-up streaming
+        (replication/service.py `fetch_wal`): ([(first_seq, last_seq,
+        path, safe_bytes)], committed_seq).  The active segment's
+        buffered frames are flushed to the OS first — appends hold the
+        same lock, so every frame within `safe_bytes` is whole (a
+        reader must still stop at `safe_bytes`: bytes past it may be a
+        frame mid-write).  Records past `committed_seq` may ride along;
+        they were never acknowledged, and the replica's replay is
+        idempotent either way."""
+        with self._lock:
+            try:
+                self._file.flush()
+            except (OSError, ValueError):
+                pass
+            out = []
+            for first, last, path in self._sealed:
+                try:
+                    out.append((first, last, path, os.path.getsize(path)))
+                except OSError:
+                    continue             # pruned underneath us
+            if self._active_last >= self._active_first:
+                out.append((self._active_first, self._active_last,
+                            segment_path(self.dir, self._active_first),
+                            self._file.tell()))
+            return out, self._committed_seq
+
     # --------------------------------------------------------------- close
 
     def close(self) -> None:

@@ -55,6 +55,12 @@ def pytest_configure(config):
         "fused dispatch, sharded DeviceMirror, partial merges). Auto-skip "
         "below 2 local devices so tier-1 stays green on 1-device boxes; "
         "this harness forces 8 virtual CPU devices, so they normally run.")
+    config.addinivalue_line(
+        "markers", "replication: chaos-style replication tests (multi-"
+        "store clusters under live ingest+query traffic, handoff drills, "
+        "wall-clock waits). Implies slow, so tier-1's -m 'not slow' "
+        "excludes them; run explicitly with -m replication or via "
+        "`python bench.py replication`.")
 
 
 def pytest_collection_modifyitems(config, items):
@@ -66,6 +72,8 @@ def pytest_collection_modifyitems(config, items):
                "(XLA_FLAGS=--xla_force_host_platform_device_count=8)")
     for item in items:
         if "chaos" in item.keywords and "slow" not in item.keywords:
+            item.add_marker(pytest.mark.slow)
+        if "replication" in item.keywords and "slow" not in item.keywords:
             item.add_marker(pytest.mark.slow)
         if few_devices and "multichip" in item.keywords:
             item.add_marker(skip_multichip)

@@ -70,6 +70,17 @@ CARRY = [
     "longrange_lru_bounded", "longrange_gate_ok", "longrange_error",
     "selfmon_overhead_pct", "selfmon_scrape_p50_s",
     "selfmon_scrape_series", "selfmon_gate_ok", "selfmon_error",
+    # replication layer (ISSUE 11): RF-2 fan-out throughput, catch-up
+    # drain, live-handoff drill, and the FLIPPED chaos gates
+    # (availability 1.0 / zero partials / zero acked loss at RF-2)
+    "replication_rf1_samples_per_sec", "replication_rf2_samples_per_sec",
+    "replication_rf2_vs_rf1_pct", "replication_catchup_samples_per_sec",
+    "replication_handoff_failed_queries", "replication_handoff_partials",
+    "replication_handoff_identical", "replication_handoff_seconds",
+    "replication_gate_ok", "replication_error",
+    "chaos_availability", "chaos_partial_rate", "chaos_acked_lost",
+    "chaos_p99_ratio", "chaos_wrong_full_results", "chaos_gate_ok",
+    "chaos_error",
 ]
 RENAME = {"value": "headline_samples_per_sec",
           "p50_query_latency_s": "p50_s"}
