@@ -645,6 +645,247 @@ def _wal_kill_chaos(root, quick):
             "wal_kill_query_identical": bool(identical)}
 
 
+def _rw_payloads(series, k, batches, start_ms=None, ws="trc"):
+    """Pre-encoded remote_write payloads (snappy+prompb) with distinct,
+    near-now timestamps per batch — client encode cost stays out of the
+    measured server path, and now-ish stamps keep the freshness
+    histograms meaningful."""
+    from filodb_tpu.http import remotepb
+    from filodb_tpu.utils import snappy as fsnappy
+    start = start_ms or (int(time.time() * 1000) - batches * k * 1000)
+    payloads = []
+    for b in range(batches):
+        srs = []
+        for i in range(series):
+            labels = [("__name__", "trace_bench_total"), ("_ws_", ws),
+                      ("_ns_", "bench"), ("inst", f"i{i:05d}")]
+            samples = [(float(i + j), start + (b * k + j) * 1000)
+                       for j in range(k)]
+            srs.append(remotepb.PromTimeSeries(labels, samples))
+        payloads.append(fsnappy.compress(
+            remotepb.encode_write_request(srs)))
+    return payloads
+
+
+def measure_ingesttrace(quick=False, series=None):
+    """Write-path tracing stage (ISSUE 12): the observability tax on the
+    ingest path, the stitched 2-node trace proof, and the fault-
+    visibility drill.
+
+    One-line JSON keys:
+      ingest_trace_overhead_pct / ingest_trace_on_samples_per_sec —
+          remote_write door throughput with the span+exemplar pipeline
+          on vs off (fresh server each round, interleaved, best-of;
+          acceptance gate: tracing-on >= 98% of tracing-off)
+      ingest_trace_stitched / ingest_trace_nodes / ingest_trace_spans —
+          a 2-node RF-2 run (real replica subprocess, quorum acks)
+          produces ONE trace id whose span tree covers door -> WAL
+          append -> fsync wait -> replication fan-out -> replica WAL ->
+          memstore ingest on BOTH nodes
+      ingesttrace_fault_visible — an injected wal.fsync delay
+          (utils/faults.py) shows up in the fsync-latency histogram,
+          the ingest slowlog, AND the freshness histograms, and flips
+          health to degraded while sustained
+      ingest_freshness_p99_s — the ingest-to-ack p99 over the traced
+          run's batches
+    """
+    import shutil
+    import tempfile
+
+    from filodb_tpu.standalone import DatasetConfig, FiloServer
+    from filodb_tpu.utils.metrics import (collector, registry,
+                                          set_exemplars_enabled,
+                                          set_spans_enabled)
+
+    S = series or (1_024 if quick else 2_048)
+    k = 4
+    batches = 17 if quick else 49
+    out = {"ingest_trace_series": S}
+    root = tempfile.mkdtemp(prefix="filodb-ingesttrace-")
+
+    # --- tracing tax on the remote_write door.  The per-POST fixed cost
+    # (protobuf decode + per-series key hashing) is ~4 orders above the
+    # span pipeline's, so a rate-over-rounds compare is pure noise at a
+    # 2% gate; instead INTERLEAVE modes POST by POST on one server
+    # (distinct pre-encoded payloads, store grows identically under
+    # both modes) and compare per-POST MEDIANS — the observability
+    # stage's measured-pairs pattern
+    def door_tax():
+        import gc
+        import statistics
+        srv = FiloServer(
+            datasets=[DatasetConfig("prometheus", num_shards=2)])
+        times = {True: [], False: []}
+        try:
+            payloads = _rw_payloads(S, k, batches)
+            st, _ = srv.api.handle("POST", "/api/v1/write", {},
+                                   payloads[0])
+            assert st == 204, f"ingesttrace warm got {st}"
+            # GC pinned: the decode path allocates ~100 objects per
+            # series per POST, and gen-2 collections landing on random
+            # POSTs are a bimodal ±30% that buries a 2% gate; collect
+            # OUTSIDE each timed window instead
+            gc.disable()
+            for i, p in enumerate(payloads[1:]):
+                # ABBA pairing: per-POST cost drifts as the store
+                # grows, and a fixed on-then-off order would book the
+                # drift entirely against one mode
+                pair, first = divmod(i, 2)
+                on = (first == 0) == (pair % 2 == 0)
+                set_spans_enabled(on)
+                set_exemplars_enabled(on)
+                gc.collect()
+                t0 = time.perf_counter()
+                st, _ = srv.api.handle("POST", "/api/v1/write", {}, p)
+                assert st == 204, f"ingesttrace bench got {st}"
+                times[on].append(time.perf_counter() - t0)
+        finally:
+            gc.enable()
+            srv.shutdown()
+
+        def fastq(xs):
+            # mean of the fastest quartile: the modes' best-case paths
+            # are the comparable ones — residual scheduler/IO stalls
+            # land in the slow tail of BOTH modes but not evenly
+            xs = sorted(xs)
+            q = max(len(xs) // 4, 1)
+            return statistics.mean(xs[:q])
+
+        return fastq(times[True]), fastq(times[False])
+
+    try:
+        on_p50, off_p50 = door_tax()
+    finally:
+        set_spans_enabled(True)
+        set_exemplars_enabled(True)
+    on_sps = S * k / max(on_p50, 1e-9)
+    off_sps = S * k / max(off_p50, 1e-9)
+    out["ingest_trace_off_samples_per_sec"] = round(off_sps, 1)
+    out["ingest_trace_on_samples_per_sec"] = round(on_sps, 1)
+    out["ingest_trace_overhead_pct"] = round(
+        (1.0 - on_sps / max(off_sps, 1e-9)) * 100.0, 2)
+    overhead_ok = on_sps >= 0.98 * off_sps
+
+    # --- stitched 2-node trace + fault drill: node B is a REAL replica
+    # subprocess (bench/chaosnode.py — replication door + its own WAL),
+    # node A an in-process FiloServer fanning out at RF-2/quorum
+    from filodb_tpu.config import FilodbSettings
+    from filodb_tpu.utils.freshness import freshness
+    from filodb_tpu.utils.metrics import make_traceparent, mint_trace_id
+    from filodb_tpu.utils.slowlog import ingestlog
+
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO_DIR
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.Popen(
+        [sys.executable, os.path.join(REPO_DIR, "bench", "chaosnode.py"),
+         "--name", "B", "--port", "0", "--repl-port", "0",
+         "--shards", "0", "--dataset", "tracetest",
+         "--series", "8", "--samples", "4",
+         "--wal-dir", os.path.join(root, "walB")],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
+        env=env, cwd=REPO_DIR)
+    srv = None
+    try:
+        ready = json.loads(proc.stdout.readline())
+        assert ready.get("ready"), f"chaosnode: {ready}"
+        cfg = FilodbSettings()
+        cfg.wal.enabled = True
+        cfg.wal.dir = os.path.join(root, "walA")
+        cfg.replication.enabled = True
+        cfg.replication.factor = 2
+        cfg.replication.ack_mode = "quorum"
+        # a tight SLO so the injected fsync delay below counts as a
+        # sustained breach within a few batches
+        cfg.ingest.slow_batch_threshold_s = 0.05
+        cfg.ingest.freshness_breach_count = 3
+        freshness.reset()
+        ingestlog.clear()
+        srv = FiloServer(
+            datasets=[DatasetConfig("tracetest", num_shards=1)],
+            config=cfg, node_name="A",
+            replication_peers={"B": ("127.0.0.1", ready["repl_port"])})
+        tid = mint_trace_id()
+        ws = "trc"
+        st, pay = srv.api.handle(
+            "POST", "/api/v1/write", {}, _rw_payloads(64, 2, 1)[0],
+            headers={"traceparent": make_traceparent(tid)})
+        assert st == 204, f"traced write got {st}: {pay}"
+        assert pay["_headers"]["X-Trace-Id"] == tid
+        evs = collector.trace(tid)
+        by_node = {}
+        for e in evs:
+            leaf = e["span"].rsplit(".", 1)[-1]
+            by_node.setdefault(e.get("node", ""), set()).add(leaf)
+        a_spans = by_node.get("A", set())
+        b_spans = by_node.get("B", set())
+        stitched = (
+            {"remote_write", "wal_append", "wal_commit_wait",
+             "replication_fanout", "replica_append",
+             "ingest_columns"} <= a_spans
+            and {"wal_append", "ingest_columns"} <= b_spans)
+        out["ingest_trace_spans"] = len(evs)
+        out["ingest_trace_nodes"] = sorted(by_node)
+        out["ingest_trace_stitched"] = bool(stitched)
+        if not stitched:
+            out["ingest_trace_span_tree"] = {
+                n: sorted(s) for n, s in by_node.items()}
+
+        # --- fault drill: delay node A's group-commit fsync; the delay
+        # must surface in the fsync histogram, the ingest slowlog, the
+        # freshness histograms, AND the health verdict (sustained)
+        from filodb_tpu.utils.faults import faults
+        delay = 0.25
+        fsync_hist = registry.histogram("wal_fsync_seconds",
+                                        dataset="tracetest")
+        ack_hist = registry.histogram("ingest_ack_seconds", ws=ws,
+                                      origin="remote_write")
+        with faults.plan("wal.fsync", "delay", first_k=8,
+                         delay_s=delay):
+            for p in _rw_payloads(64, 2, 4, ws=ws):
+                st, _ = srv.api.handle("POST", "/api/v1/write", {}, p)
+                assert st == 204
+        slow_recs = [r for r in ingestlog.entries()
+                     if r["stages"]["wal_commit_wait_s"] >= delay * 0.5
+                     and r["trace_id"]]
+        fresh_hist = registry.histogram("ingest_freshness_seconds",
+                                        ws=ws)
+        health = srv.api.handle("GET", "/api/v1/status/health",
+                                {}, b"")[1]["data"]
+        ingest_verdict = health["subsystems"]["ingest"]
+        fault_visible = (fsync_hist.max >= delay * 0.8
+                         and len(slow_recs) >= 3
+                         and ack_hist.max >= delay * 0.8
+                         and fresh_hist.count >= 4
+                         and ingest_verdict["status"] == "degraded"
+                         and health["status"] != "ok")
+        out["ingesttrace_fault_visible"] = bool(fault_visible)
+        out["ingest_freshness_p99_s"] = round(
+            ack_hist.percentile(0.99), 4)
+        if not fault_visible:
+            out["ingesttrace_fault_detail"] = {
+                "fsync_max_s": round(fsync_hist.max, 4),
+                "slow_recs": len(slow_recs),
+                "ack_max_s": round(ack_hist.max, 4),
+                "freshness_count": fresh_hist.count,
+                "ingest_verdict": ingest_verdict}
+    finally:
+        proc.kill()
+        proc.wait(timeout=10)
+        if srv is not None:
+            srv.shutdown()
+        freshness.reset()
+        freshness.configure(threshold_s=5.0, breach_count=3,
+                            window_s=60.0)
+        shutil.rmtree(root, ignore_errors=True)
+
+    out["ingesttrace_gate_ok"] = bool(
+        out.get("ingest_trace_stitched")
+        and out.get("ingesttrace_fault_visible")
+        and (quick or overhead_ok))
+    return out
+
+
 COVERAGE_QUERIES = [
     # (name, promql, ragged_ok) — a realistic dashboard mix, expanded from
     # the reference's QueryInMemoryBenchmark set (QUERY_SET in bench/suite).
@@ -2317,7 +2558,7 @@ def parse_args(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("stage", nargs="?", default="",
                     choices=["", "chaos", "multichip", "wal", "longrange",
-                             "selfmon", "replication"],
+                             "selfmon", "replication", "ingesttrace"],
                     help="optional standalone stage: 'chaos' runs the "
                          "failure-domain chaos harness (SIGKILL one of "
                          "three RF-2 data nodes mid-traffic; gates "
@@ -2342,7 +2583,14 @@ def parse_args(argv=None):
                          "or stitch gate fails; 'selfmon' runs the "
                          "self-scrape meta-monitoring stage (overhead "
                          "on concurrent QPS + scrape p50) and exits "
-                         "nonzero when overhead exceeds 2%")
+                         "nonzero when overhead exceeds 2%; "
+                         "'ingesttrace' runs the write-path tracing "
+                         "stage (span-pipeline tax on the remote_write "
+                         "door, the stitched 2-node trace proof, the "
+                         "wal.fsync fault-visibility drill) and exits "
+                         "nonzero when tracing-on falls under 98% of "
+                         "tracing-off or the trace/fault evidence is "
+                         "missing")
     ap.add_argument("--quick", action="store_true",
                     help="small config for smoke runs")
     ap.add_argument("--series", type=int, default=0)
@@ -2475,6 +2723,23 @@ def assemble_result(platform, stages, vec_sps, it_sps, c_sps=0.0,
     for k in ("error", "wal_kill_error"):
         if k in wl:
             result["wal_error"] = wl[k]
+    it = stages.get("ingesttrace", {})
+    for k in ("ingest_trace_overhead_pct",
+              "ingest_trace_on_samples_per_sec",
+              "ingest_trace_stitched", "ingest_trace_nodes",
+              "ingest_freshness_p99_s", "ingesttrace_fault_visible",
+              "ingesttrace_gate_ok"):
+        if k in it:
+            # ISSUE-12 acceptance: tracing-on >= 98% of tracing-off on
+            # the remote_write door, ONE stitched 2-node write-path
+            # trace, and the wal.fsync fault drill visible in the fsync
+            # histogram + ingest slowlog + freshness histograms + the
+            # health verdict
+            result[k] = it[k]
+    if "error" in it:
+        # loud-fail contract (like wal/selfmon): a broken write-path
+        # tracing stage rides into the parsed line, never vanishes
+        result["ingesttrace_error"] = it["error"]
     lr = stages.get("longrange", {})
     for k in ("longrange_cold_scan_samples_per_sec",
               "longrange_warm_cold_ratio", "longrange_stitch_identical",
@@ -2657,6 +2922,16 @@ def run_worker(args):
     except Exception as e:  # noqa: BLE001 — must not sink the run
         stages["wal"] = {"error": f"{type(e).__name__}: {e}"[:300]}
         writer.stage("wal", stages["wal"])
+
+    try:
+        # write-path tracing stage (ISSUE 12): span-pipeline tax on the
+        # remote_write door, stitched 2-node trace, fault visibility
+        it = measure_ingesttrace(quick=quick)
+        writer.stage("ingesttrace", it)
+        stages["ingesttrace"] = it
+    except Exception as e:  # noqa: BLE001 — must not sink the run
+        stages["ingesttrace"] = {"error": f"{type(e).__name__}: {e}"[:300]}
+        writer.stage("ingesttrace", stages["ingesttrace"])
 
     try:
         # historical-tier stage (ISSUE 8): compacted segments, cold
@@ -2868,6 +3143,27 @@ def main():
         # measured number still rides the line
         sys.exit(0 if "error" not in sm
                  and (args.quick or sm.get("selfmon_gate_ok")) else 1)
+    if args.stage == "ingesttrace":
+        # standalone write-path tracing stage: CPU-pinned (it measures
+        # the door + WAL + replication path, not kernels); prints the
+        # one-line ingesttrace JSON and exits nonzero when a gate fails
+        # (loud-fail contract like wal/selfmon)
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        try:
+            it = measure_ingesttrace(quick=args.quick,
+                                     series=args.series or None)
+        except Exception as e:  # noqa: BLE001 — loud one-line fail
+            print(json.dumps({
+                "metric": "ingest_trace_overhead_pct", "unit": "%",
+                "ingesttrace_error": f"{type(e).__name__}: {e}"[:300]}))
+            sys.exit(1)
+        it = {"metric": "ingest_trace_overhead_pct", "unit": "%",
+              "value": it.get("ingest_trace_overhead_pct"), **it}
+        print(json.dumps(it))
+        # the stitched-trace and fault-visibility proofs always gate;
+        # the 2% throughput tax is judged at FULL scale only (quick's
+        # toy batches cannot average out scheduler noise)
+        sys.exit(0 if it.get("ingesttrace_gate_ok") else 1)
     if args.stage == "chaos":
         # standalone failure-domain stage: runs IN THIS process (CPU-
         # pinned; chaos measures degradation machinery, not kernels),

@@ -255,6 +255,29 @@ class ReplicationConfig:
 
 
 @dataclasses.dataclass
+class IngestConfig:
+    """Write-path observability (doc/observability.md write-path tracing
+    section): the ingest slowlog + the freshness SLO fold.  The write
+    path mirrors the query side's flight-recorder knobs — batches whose
+    door-to-ack wall crosses `slow_batch_threshold_s` land in the
+    /admin/ingestlog ring with their per-stage breakdown and trace id,
+    and SUSTAINED breaches (>= freshness_breach_count inside
+    freshness_window_s) flip the health evaluator's `ingest` subsystem
+    to degraded until they age out."""
+    # door-to-ack wall past this = one slowlog record + one freshness
+    # breach.  <= 0 disables both the ingest slowlog and the breach fold
+    # (the ack/freshness histograms record regardless).
+    slow_batch_threshold_s: float = 5.0
+    ingestlog_max_entries: int = 128
+    # optional JSONL mirror of every ingestlog record ("" disables)
+    ingestlog_path: str = ""
+    # sustained-breach fold: this many breaches inside the window =>
+    # health `ingest` subsystem degraded
+    freshness_breach_count: int = 3
+    freshness_window_s: float = 60.0
+
+
+@dataclasses.dataclass
 class SelfMonConfig:
     """Self-scrape meta-monitoring (utils/selfmon.py;
     doc/observability.md): an in-process loop snapshots the metrics
@@ -377,11 +400,18 @@ class FilodbSettings:
     # the ring stays bounded either way)
     event_journal_max_entries: int = 2048
     event_journal_path: str = ""
+    # OpenMetrics exemplars on latency histograms: Histogram.record
+    # attaches the active trace id per bucket and
+    # /metrics?format=openmetrics emits `# {trace_id="..."}` exemplar
+    # suffixes (doc/observability.md).  Off = the record path drops the
+    # exemplar argument and the exposition emits none.
+    exemplars_enabled: bool = True
     query: QueryConfig = dataclasses.field(default_factory=QueryConfig)
     store: StoreConfig = dataclasses.field(default_factory=StoreConfig)
     breaker: BreakerConfig = dataclasses.field(default_factory=BreakerConfig)
     rules: RulesConfig = dataclasses.field(default_factory=RulesConfig)
     wal: WalConfig = dataclasses.field(default_factory=WalConfig)
+    ingest: IngestConfig = dataclasses.field(default_factory=IngestConfig)
     selfmon: SelfMonConfig = dataclasses.field(default_factory=SelfMonConfig)
     replication: ReplicationConfig = dataclasses.field(
         default_factory=ReplicationConfig)
@@ -420,6 +450,7 @@ class FilodbSettings:
         for section, obj in (("query", self.query), ("store", self.store),
                              ("breaker", self.breaker),
                              ("rules", self.rules), ("wal", self.wal),
+                             ("ingest", self.ingest),
                              ("selfmon", self.selfmon),
                              ("replication", self.replication)):
             for k, v in (raw.pop(section, None) or {}).items():
@@ -467,7 +498,7 @@ class FilodbSettings:
             from filodb_tpu.utils.hoconlite import _parse_scalar
             parsed = _parse_scalar(val)
             for section in ("query_", "store_", "breaker_", "rules_",
-                            "wal_", "selfmon_", "replication_"):
+                            "wal_", "ingest_", "selfmon_", "replication_"):
                 if rest.startswith(section):
                     overlay.setdefault(section[:-1], {})[
                         rest[len(section):]] = parsed

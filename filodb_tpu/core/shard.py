@@ -618,7 +618,10 @@ class TimeSeriesShard:
         if ts.ndim != 2 or len(part_keys) != ts.shape[0]:
             raise ValueError("ingest_columns: ts must be [num_keys, k]")
         faults.fire("ingest.batch")
-        with self._write_locked("ingest"):
+        # write-path trace: the memstore-visibility stage of an ingest
+        # batch (one span per slab; stitches under the door's trace id)
+        with metrics_span("ingest_columns", dataset=self.dataset), \
+                self._write_locked("ingest"):
             if ts.size == 0:
                 return 0
             store = self._store_for(schema_name)

@@ -32,6 +32,7 @@ from filodb_tpu.core.partkey import PartKey
 from filodb_tpu.core.schemas import Schemas, DEFAULT_SCHEMAS
 from filodb_tpu.parallel.shardmapper import ShardMapper, SpreadProvider
 from filodb_tpu.utils.metrics import registry as metrics_registry
+from filodb_tpu.utils.metrics import span as metrics_span
 
 log = logging.getLogger("filodb.remotewrite")
 
@@ -66,12 +67,24 @@ class RemoteWriteSink:
 
     # ------------------------------------------------------------- ingest
 
-    def ingest_series(self, series) -> Tuple[int, int]:
+    def ingest_series(self, series, stats=None) -> Tuple[int, int]:
         """Ingest decoded remotepb.PromTimeSeries; returns (samples
         ingested, samples dropped by the store — OOO/dup/quota).  Raises
         WalWriteError when durability cannot be claimed (the route turns
-        it into a 503: the client must retry, the data was NOT acked)."""
-        slabs = self._build_slabs(series)
+        it into a 503: the client must retry, the data was NOT acked).
+
+        `stats` (utils/freshness.IngestStats, optional) is filled with
+        the batch's per-stage breakdown — slab build, WAL append,
+        group-commit fsync wait, replication fan-out, memstore ingest —
+        plus slab/shard counts and per-tenant newest sample timestamps;
+        the door feeds it to the ingest slowlog and the freshness
+        histograms.  Every stage runs under the caller's trace context,
+        so the spans stitch into one write-path trace."""
+        import time as _time
+        t0 = _time.perf_counter()
+        with metrics_span("rw_build_slabs", dataset=self.dataset):
+            slabs = self._build_slabs(series, stats=stats)
+        t_slabs = _time.perf_counter()
         n = dropped = 0
         # WAL appends go first WITHOUT waiting: the committer thread's
         # flush+fsync overlaps the in-memory ingest below (both release
@@ -87,6 +100,8 @@ class RemoteWriteSink:
                     shard_num, SCHEMA, keys, ts, {"value": vals},
                     wait=False)
                 seqs.append(last_seq)
+        t_wal = _time.perf_counter()
+        repl_s = 0.0
         for i, (shard_num, keys, ts, vals) in enumerate(slabs):
             shard = self.memstore.get_shard(self.dataset, shard_num)
             offset = seqs[i] if self.wal is not None else -1
@@ -105,9 +120,11 @@ class RemoteWriteSink:
             # a shard owned elsewhere must land on at least one owner
             # (require_primary) or the request bounces un-acked
             if self.replicator is not None:
+                tr = _time.perf_counter()
                 res = self.replicator.replicate(
                     shard_num, SCHEMA, keys, ts, {"value": vals},
                     seq=offset, require_primary=shard is None)
+                repl_s += _time.perf_counter() - tr
                 if shard is None:
                     # account what the shard's OWNER actually ingested
                     # (its OOO/dup drops count as drops here, exactly
@@ -121,29 +138,53 @@ class RemoteWriteSink:
                     got = int(got or 0)
                     n += got
                     dropped += int(ts.size) - got
+        t_ingest = _time.perf_counter()
         if last_seq >= 0:
             self.wal.commit(last_seq)
+        t_commit = _time.perf_counter()
         metrics_registry.counter("remote_write_samples",
                                  dataset=self.dataset).increment(n)
+        if stats is not None:
+            stats.dataset = stats.dataset or self.dataset
+            stats.slabs = len(slabs)
+            stats.shards = sorted({s for s, *_ in slabs})
+            stats.ingested += n
+            stats.dropped += dropped
+            stats.build_slabs_s += t_slabs - t0
+            stats.wal_append_s += t_wal - t_slabs
+            # the fan-out ran interleaved with the local ingest loop:
+            # split the loop's wall into its replication share and the
+            # memstore remainder
+            stats.replication_s += repl_s
+            stats.ingest_s += max(t_ingest - t_wal - repl_s, 0.0)
+            stats.wal_commit_wait_s += t_commit - t_ingest
         return n, dropped
 
     # -------------------------------------------------------- slab build
 
-    def _build_slabs(self, series
+    def _build_slabs(self, series, stats=None
                      ) -> List[Tuple[int, List[PartKey], np.ndarray,
                                      np.ndarray]]:
         """Group the request's series into rectangular (shard, keys,
         ts [S, k], values [S, k]) slabs: one per (shard, sample-count)
         pair, matching RecordBatch.from_grid's grid contract.  A scrape
         push's natural shape — every series carrying the same k samples
-        — collapses to one slab per shard."""
+        — collapses to one slab per shard.  With `stats`, the per-tenant
+        newest sample timestamp is tracked in the same pass (the
+        ingest-to-queryable freshness input — zero extra iteration)."""
         part_schema = self.schemas.part
+        newest = stats.newest_ts_ms if stats is not None else None
         by_group: Dict[Tuple[int, int], List[Tuple[PartKey, list]]] = {}
         for ts_msg in series:
             if not ts_msg.samples:
                 continue
             labels = dict(ts_msg.labels)
             metric = labels.pop("__name__", "") or "_unnamed_"
+            if newest is not None:
+                ws = labels.get("_ws_", "")
+                ts_max = int(max(t for _, t in ts_msg.samples))
+                if ts_max > newest.get(ws, -1):
+                    newest[ws] = ts_max
             pk = PartKey.make(metric, labels, part_schema)
             if self.mapper is not None:
                 shard_num = self.mapper.ingestion_shard(

@@ -76,27 +76,41 @@ class KafkaContainerSink:
 
     def publish_lines(self, lines: Iterable[str],
                       now_ms: Optional[int] = None) -> int:
-        """Parse, route, and publish; returns records published."""
+        """Parse, route, and publish; returns records published.  The
+        TCP door has no headers to carry a traceparent, so each flush
+        batch runs under a MINTED write-path trace id (doc/
+        observability.md): the parse/route/produce spans land in the
+        trace ring and slow batches in /admin/ingestlog like the HTTP
+        doors."""
+        from filodb_tpu.utils.freshness import DoorTrace
+        from filodb_tpu.utils.metrics import span
         from filodb_tpu.gateway.accounting import admit_batch
         lines = list(lines)
-        drops: Dict[str, int] = {}
-        batches = influx_lines_to_batches(lines, self.schemas, now_ms,
-                                          drops=drops)
+        door = DoorTrace("gateway", self.topic,
+                         body_bytes=sum(len(ln) for ln in lines))
         published = 0
-        for batch in batches:
-            batch, _retry = admit_batch(batch, self.ingest_limit, drops)
-            if batch is None:
-                continue
-            for shard_num, sub in split_batch_by_shard(
-                    batch, self.mapper, self.spread).items():
-                self.produce(self.topic, shard_num, sub.to_bytes())
-                published += sub.num_records
-                with self._lock:
-                    self.frames_out += 1
+        with door, span("gateway_publish"):
+            drops: Dict[str, int] = {}
+            batches = influx_lines_to_batches(lines, self.schemas, now_ms,
+                                              drops=drops)
+            for batch in batches:
+                batch, _retry = admit_batch(batch, self.ingest_limit,
+                                            drops)
+                if batch is None:
+                    continue
+                for shard_num, sub in split_batch_by_shard(
+                        batch, self.mapper, self.spread).items():
+                    self.produce(self.topic, shard_num, sub.to_bytes())
+                    published += sub.num_records
+                    with self._lock:
+                        self.frames_out += 1
         with self._lock:
             self.lines_in += len(lines)
             self.records_out += published
         self._drop_log.record(drops)
+        door.stats.series = len(lines)
+        door.stats.samples = door.stats.ingested = published
+        door.finish()
         return published
 
     @property

@@ -122,7 +122,7 @@ class PromHttpApi:
             if parts == ["ready"]:
                 return self._ready()
             if parts == ["metrics"]:
-                return self._own_metrics()
+                return self._own_metrics(params)
             if parts[:1] == ["promql"] and len(parts) >= 4 \
                     and parts[2] == "api" and parts[3] == "v1":
                 return self._api_v1(parts[1], parts[4:], method, params,
@@ -143,6 +143,9 @@ class PromHttpApi:
             if parts[:2] == ["admin", "slowlog"] and len(parts) in (2, 3):
                 return self._slowlog(parts[2] if len(parts) == 3 else None,
                                      params, method)
+            if parts[:2] == ["admin", "ingestlog"] and len(parts) in (2, 3):
+                return self._ingestlog(
+                    parts[2] if len(parts) == 3 else None, params, method)
             if parts[:2] == ["admin", "breakers"] and len(parts) == 2 \
                     and method == "GET":
                 return self._breakers()
@@ -158,12 +161,13 @@ class PromHttpApi:
             if parts == ["admin", "rules", "reload"] and method == "POST":
                 return self._rules_reload()
             if parts[:2] == ["admin", "traces"] and len(parts) in (2, 3):
-                return self._traces(parts[2] if len(parts) == 3 else None)
+                return self._traces(parts[2] if len(parts) == 3 else None,
+                                    params)
             if parts[:2] == ["admin", "tracedfilters"] and method == "POST":
                 return self._traced_filters(body)
             if parts[:1] == ["influx"] and len(parts) == 2 \
                     and parts[1] == "write" and method == "POST":
-                return self._influx_write_traced(params, body)
+                return self._influx_write_traced(params, body, headers)
             return 404, _err(f"no route for {method} {path}")
         except _BadRequest as e:
             return 400, _err(str(e))
@@ -305,39 +309,90 @@ class PromHttpApi:
         2xx), 400 on malformed payloads, 429 + Retry-After when the
         tenant's rolling ingest window is over its limit (backpressure —
         the client re-sends, nothing is silently dropped), 503 when the
-        WAL cannot claim durability (ack withheld, client must retry)."""
+        WAL cannot claim durability (ack withheld, client must retry).
+
+        Write-path tracing (doc/observability.md): a W3C `traceparent`
+        request header's trace id is ACCEPTED (the client's trace
+        continues through decode → WAL → replication → memstore), else
+        one is minted; every response — errors included — carries
+        `X-Trace-Id` plus a `traceparent` echo, the per-stage breakdown
+        lands in an IngestStats fed to the freshness histograms, and
+        batches over `ingest.slow_batch_threshold_s` land in
+        /admin/ingestlog."""
+        from filodb_tpu.utils.freshness import DoorTrace
+        from filodb_tpu.utils.metrics import registry, span
+        registry.counter("remote_write_requests",
+                         dataset=dataset).increment()
+        door = DoorTrace(
+            "remote_write", dataset, headers, len(body),
+            threshold_s=self._config.ingest.slow_batch_threshold_s)
+        try:
+            with door, span("remote_write", dataset=dataset):
+                status, payload = self._remote_write_traced(
+                    dataset, body, door.headers, door.stats)
+        except _BadRequest as e:
+            # a rejected payload still answers with its trace headers
+            # (the documented contract: EVERY response correlates)
+            return 400, {**_err(str(e)),
+                         "_headers": door.trace_headers()}
+        if isinstance(payload, dict):
+            payload.setdefault("_headers", {}).update(
+                door.finish(status))
+        return status, payload
+
+    def _remote_write_traced(self, dataset: str, body: bytes,
+                             hdr: Dict[str, str], stats
+                             ) -> Tuple[int, object]:
+        """The remote_write pipeline body, running under the request's
+        trace context (split out so _remote_write_ingest owns the trace
+        bookkeeping and this owns the protocol)."""
+        import time as _time
+
         from filodb_tpu.http import remotepb
         from filodb_tpu.utils import snappy
-        from filodb_tpu.utils.metrics import registry
+        from filodb_tpu.utils.metrics import registry, span
         from filodb_tpu.utils.usage import usage
         from filodb_tpu.gateway.remotewrite import (admit_series,
                                                     count_samples)
-        registry.counter("remote_write_requests",
-                         dataset=dataset).increment()
+        t0 = _time.perf_counter()
         try:
-            series = remotepb.decode_write_request(snappy.decompress(body))
+            with span("rw_decode", dataset=dataset):
+                series = remotepb.decode_write_request(
+                    snappy.decompress(body))
         except (ValueError, IndexError, struct.error) as e:
             # truncated/garbled snappy or protobuf bytes: the client's
             # fault, counted and answered 400 like any bad payload
             registry.counter("remote_write_bad_payloads",
                              dataset=dataset).increment()
             raise _BadRequest(f"bad remote-write payload: {e}")
-        if count_samples(series) == 0:
+        stats.decode_s = _time.perf_counter() - t0
+        stats.series = len(series)
+        stats.samples = count_samples(series)
+        if stats.samples == 0:
             return 204, {}
-        org = next((v for k, v in headers.items()
-                    if k.lower() == "x-scope-orgid"), None)
+        org = hdr.get("x-scope-orgid")
+        if org:
+            ws, _, ns = org.partition("/")
+            stats.tenant_ws, stats.tenant_ns = ws, ns
+        elif series:
+            labels = dict(series[0].labels)
+            stats.tenant_ws = labels.get("_ws_", "")
+            stats.tenant_ns = labels.get("_ns_", "")
         # PER-TENANT admission over every series in the request (header
         # org = one tenant for the whole request): an over-limit tenant
         # must not ride in behind another tenant's series
-        admitted, retry_after, rejected = admit_series(
-            series, org, self._qconfig.tenant_ingest_samples_limit)
+        t_adm = _time.perf_counter()
+        with span("rw_admission", dataset=dataset):
+            admitted, retry_after, rejected = admit_series(
+                series, org, self._qconfig.tenant_ingest_samples_limit)
+        stats.admission_s = _time.perf_counter() - t_adm
         if admitted:
             sink = self._remote_write_sink(dataset)
             from filodb_tpu.replication.replicator import \
                 ReplicationSendError
             from filodb_tpu.wal import WalWriteError
             try:
-                sink.ingest_series(admitted)
+                sink.ingest_series(admitted, stats=stats)
             except WalWriteError as e:
                 # durability could not be claimed: withhold the ack — a
                 # compliant remote_write client retries 5xx with backoff
@@ -607,11 +662,18 @@ class PromHttpApi:
                     for i, (addr, st) in sorted(mapper.status_snapshot().items())]
         return 200, {"status": "success", "data": statuses}
 
-    def _own_metrics(self) -> Tuple[int, str]:
+    def _own_metrics(self, params: Optional[Dict[str, str]] = None
+                     ) -> Tuple[int, str]:
         """The framework's OWN metrics in Prometheus text format
         (ref: Kamon prometheus reporter endpoint, README:812-819).  Shard
-        gauges refresh on scrape."""
+        gauges refresh on scrape.  `?format=openmetrics` switches to the
+        OpenMetrics 1.0 exposition — `# TYPE` metadata, canonical-float
+        `le` values, per-bucket `# {trace_id="..."}` exemplars on the
+        latency histograms, `# EOF` terminator — under its own content
+        type; the plain format stays byte-identical."""
         from filodb_tpu.utils.metrics import registry
+        import time as _time
+        now_ms = int(_time.time() * 1000)
         for dataset, eng in self.engines.items():
             source = getattr(eng, "source", None)
             mapper = self.shard_mappers.get(dataset)
@@ -628,6 +690,15 @@ class PromHttpApi:
                     shard.stats.rows_dropped)
                 registry.gauge("quota_dropped", **tags).update(
                     shard.stats.quota_dropped)
+                # freshness SLO companion gauge: how far "queryable for
+                # every series" (the result cache's append-horizon
+                # immutability line) trails wall clock — a stuck series
+                # or stalled scrape stream shows here at scrape time
+                horizon = shard.append_horizon_ms()
+                if 0 < horizon <= now_ms:
+                    registry.gauge("append_horizon_lag_seconds",
+                                   **tags).update(
+                        (now_ms - horizon) / 1000.0)
         # jit compile-cache sizes (device-side accounting, PR 3): a
         # compile storm — new shapes forcing fresh XLA compiles per
         # query — shows as these gauges climbing scrape over scrape,
@@ -645,6 +716,16 @@ class PromHttpApi:
                     self._jit_cache_sizes[fn_name] = size
         except Exception:  # noqa: BLE001 — private jax API: best-effort
             pass
+        fmt = (params or {}).get("format", "")
+        if fmt == "openmetrics":
+            return 200, _TextPayload(
+                registry.expose_openmetrics(),
+                "application/openmetrics-text; version=1.0.0; "
+                "charset=utf-8")
+        if fmt not in ("", "prometheus"):
+            raise _BadRequest(
+                f"unknown metrics format {fmt!r} "
+                "(prometheus | openmetrics)")
         return 200, registry.expose_prometheus()
 
     def _slowlog(self, action, params: Dict[str, str],
@@ -664,6 +745,28 @@ class PromHttpApi:
             return 200, {"status": "success",
                          "data": {"cleared": slowlog.clear()}}
         return 404, _err(f"unknown slowlog action {action!r} ({method})")
+
+    def _ingestlog(self, action, params: Dict[str, str],
+                   method: str) -> Tuple[int, object]:
+        """Ingest-batch flight recorder (utils/slowlog.IngestSlowLog):
+        GET /admin/ingestlog returns the write-path ring newest-last —
+        batches over `ingest.slow_batch_threshold_s` door-to-ack with
+        tenant, byte/sample counts, per-stage breakdown and trace id;
+        ?limit=N tails it, POST /admin/ingestlog/clear empties it."""
+        from filodb_tpu.utils.slowlog import ingestlog
+        if action is None and method == "GET":
+            limit = _num_param(params, "limit", "0")
+            entries = ingestlog.entries(limit)
+            return 200, {"status": "success",
+                         "data": {"count": len(entries),
+                                  "thresholdSeconds":
+                                      self._config.ingest
+                                      .slow_batch_threshold_s,
+                                  "entries": entries}}
+        if action == "clear" and method == "POST":
+            return 200, {"status": "success",
+                         "data": {"cleared": ingestlog.clear()}}
+        return 404, _err(f"unknown ingestlog action {action!r} ({method})")
 
     def _ready(self) -> Tuple[int, object]:
         """Readiness probe (Prometheus /-/ready semantics): 503 during
@@ -873,18 +976,42 @@ class PromHttpApi:
             "serverPhase": self.health.phase,
         }}
 
-    def _traces(self, trace_id) -> Tuple[int, object]:
-        """Stitched cross-node span tree for one query (the Zipkin-query
-        analogue; spans from remote nodes arrive via the dispatch reply and
-        carry their node name).  GET /admin/traces lists known ids;
-        /admin/traces/<id> returns the events sorted by end time."""
+    def _traces(self, trace_id,
+                params: Optional[Dict[str, str]] = None
+                ) -> Tuple[int, object]:
+        """Stitched cross-node span tree for one request (the
+        Zipkin-query analogue; spans from remote nodes arrive via the
+        dispatch/ack replies and carry their node name).  GET
+        /admin/traces lists known ids — `?limit=N` (default 50) keeps
+        the newest N, `?origin=query|rule_eval|remote_write` filters to
+        one door's traces; /admin/traces/<id> returns the events sorted
+        by end time, answering 410 for an id the bounded ring has
+        EVICTED (it existed; the buffer recycled it) vs 404 for one it
+        never saw."""
         from filodb_tpu.utils.metrics import collector
+        params = params or {}
         if trace_id is None:
+            origin = params.get("origin", "")
+            if origin and origin not in ("query", "rule_eval",
+                                         "remote_write"):
+                raise _BadRequest(
+                    f"unknown trace origin {origin!r} "
+                    "(query | rule_eval | remote_write)")
+            limit = _num_param(params, "limit", "50")
+            if limit < 0:
+                raise _BadRequest("limit must be >= 0")
             return 200, {"status": "success",
-                         "data": collector.trace_ids()[-50:]}
+                         "data": collector.trace_ids(origin=origin,
+                                                     limit=limit)}
         evs = sorted(collector.trace(trace_id),
                      key=lambda e: e.get("end_unix_s", 0))
         if not evs:
+            if collector.was_evicted(trace_id):
+                return 410, {"status": "error", "errorType": "gone",
+                             "error": f"trace {trace_id!r} was evicted "
+                                      "from the bounded trace ring "
+                                      "(raise max_traces or export "
+                                      "spans via trace_export_url)"}
             return 404, _err(f"no trace {trace_id!r}")
         return 200, {"status": "success",
                      "data": {"traceID": trace_id, "spans": evs}}
@@ -958,29 +1085,41 @@ class PromHttpApi:
 
     # -------------------------------------------------------------- influx
 
-    def _influx_write_traced(self, params, body):
+    def _influx_write_traced(self, params, body, headers=None):
         """Gateway-side trace context: the write path's spans collect
-        under one trace id, returned in the X-Trace-Id response header
-        (Influx writes answer 204 with no body; ref: the ingest half of
-        the Kamon span pipeline, KamonLogger.scala:16-40)."""
-        import uuid as _uuid
-
-        from filodb_tpu.utils.metrics import span, trace_context
-        tid = _uuid.uuid4().hex[:16]
-        with trace_context(tid), span("influx_write"):
-            status, payload = self._influx_write(params, body)
+        under one trace id — ACCEPTED from a W3C `traceparent` request
+        header when present, minted otherwise — returned in the
+        X-Trace-Id / traceparent response headers (Influx writes answer
+        204 with no body; ref: the ingest half of the Kamon span
+        pipeline, KamonLogger.scala:16-40).  Batches over
+        `ingest.slow_batch_threshold_s` land in /admin/ingestlog with
+        the same freshness accounting as the remote_write door."""
+        from filodb_tpu.utils.freshness import DoorTrace
+        from filodb_tpu.utils.metrics import span
+        door = DoorTrace(
+            "influx", params.get("db") or self.default_dataset or "",
+            headers, len(body),
+            threshold_s=self._config.ingest.slow_batch_threshold_s)
+        with door, span("influx_write"):
+            status, payload = self._influx_write(params, body,
+                                                 door.stats)
         if isinstance(payload, dict):
-            payload.setdefault("_headers", {})["X-Trace-Id"] = tid
+            payload.setdefault("_headers", {}).update(
+                door.finish(status))
         return status, payload
 
     def _influx_write(self, params: Dict[str, str],
-                      body: bytes) -> Tuple[int, object]:
+                      body: bytes, stats=None) -> Tuple[int, object]:
         dataset = params.get("db") or self.default_dataset
         gateway = self.gateways.get(dataset)
         if gateway is None:
             return 404, _err(f"no gateway for dataset {dataset!r}")
         lines = body.decode("utf-8", errors="replace").splitlines()
         n = gateway.ingest_lines(lines)
+        if stats is not None:
+            stats.series = len(lines)
+            stats.samples = n
+            stats.ingested = n
         retry_after = gateway.last_retry_after
         if n == 0 and retry_after is not None:
             # every record bounced off the per-tenant ingest limit: this
@@ -993,6 +1132,20 @@ class PromHttpApi:
                 "_headers": {"Retry-After":
                              str(max(1, int(-(-retry_after // 1))))}}
         return 204, {}
+
+
+class _TextPayload(str):
+    """A text route payload carrying its own content type (the server
+    shell defaults str payloads to the Prometheus exposition type; the
+    OpenMetrics format needs its negotiated one)."""
+
+    content_type = "text/plain; version=0.0.4"
+
+    def __new__(cls, s: str, content_type: Optional[str] = None):
+        out = super().__new__(cls, s)
+        if content_type:
+            out.content_type = content_type
+        return out
 
 
 class _BadRequest(Exception):

@@ -67,7 +67,11 @@ class FiloHttpServer:
                     extra_headers["Content-Encoding"] = "snappy"
                 elif isinstance(payload, str):      # text routes (/metrics)
                     blob = payload.encode()
-                    ctype = "text/plain; version=0.0.4"
+                    # routes may carry a negotiated content type (the
+                    # OpenMetrics exposition); plain strings keep the
+                    # Prometheus text type
+                    ctype = getattr(payload, "content_type",
+                                    "text/plain; version=0.0.4")
                 else:
                     if isinstance(payload, dict) and "_headers" in payload:
                         extra_headers.update(payload.pop("_headers"))

@@ -140,6 +140,19 @@ class QueryFrontend:
             slowlog.maybe_record(promql, grid[0], grid[1], grid[2], dur,
                                  res, tenant=tenant, origin=origin,
                                  threshold_s=self._slow_s)
+            # serving-latency histogram with the trace id as its
+            # OpenMetrics exemplar (p99 spike -> the exact trace in one
+            # hop), and the trace tagged with its door for the
+            # /admin/traces?origin= filter
+            from filodb_tpu.utils.metrics import collector, registry
+            tid = getattr(res, "trace_id", "") if res is not None else ""
+            registry.histogram("query_latency_seconds",
+                               origin=origin).record(dur,
+                                                     exemplar=tid or None)
+            if tid:
+                collector.note_origin(
+                    tid, "rule_eval" if origin.startswith("rule_")
+                    else "query")
         return res
 
     def _singleflight(self, key, run, planner_params=None):

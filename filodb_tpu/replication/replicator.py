@@ -27,8 +27,12 @@ import queue
 import threading
 from typing import Callable, Dict, List, Optional, Tuple
 
+import time
+
 from filodb_tpu.utils.events import journal
-from filodb_tpu.utils.metrics import registry as metrics_registry
+from filodb_tpu.utils.metrics import (collector, current_trace_id,
+                                      registry as metrics_registry,
+                                      span as metrics_span)
 from filodb_tpu.wal.segment import WalRecord
 
 _log = logging.getLogger("filodb.replication")
@@ -41,6 +45,17 @@ class ReplicationSendError(IOError):
 # a lagging replica gets one real append attempt per this many slabs (a
 # cheap liveness probe); the rest are skipped and left to catch-up
 _LAG_PROBE_EVERY = 16
+
+
+def _restitch_spans(trace, reply) -> None:
+    """Re-record the replica-side span events that rode back in the ack
+    (service.py drains them per reply, like the query transport) so the
+    coordinator's collector holds ONE stitched write-path trace."""
+    if not trace:
+        return
+    for ev in reply.get("spans") or ():
+        if isinstance(ev, dict):
+            collector.record(trace, ev)
 
 
 @dataclasses.dataclass
@@ -82,6 +97,12 @@ class _PeerState:
         # so `lagging` never self-clears into a silently-short replica
         self.lost = 0
         self.last_error = ""
+        # unix time this peer's copy FIRST fell behind (pending or lost
+        # records outstanding); 0 = fully caught up.  Exported as the
+        # replica_lag_seconds gauge — the newest-unreplicated-record AGE
+        # complementing the records-count gauge (a replica 10 records
+        # behind for an hour is a worse story than 1000 behind for 2 s).
+        self.behind_since = 0.0
         self.q: "queue.Queue" = queue.Queue(maxsize=max(queue_max, 1))
         # manager hook fired once at the ok->lagging edge (demotes the
         # peer's replica copies out of the query-ready set)
@@ -100,8 +121,19 @@ class _PeerState:
             return self.pending_locked()
 
     def _export_lag(self) -> None:
+        with self.lock:
+            behind = self.pending_locked() > 0 or self.lost > 0
+            if behind and not self.behind_since:
+                self.behind_since = time.time()
+            elif not behind:
+                self.behind_since = 0.0
+            since = self.behind_since
+            pending = self.pending_locked()
         metrics_registry.gauge("replica_lag_records", dataset=self.dataset,
-                               peer=self.node).update(self.pending)
+                               peer=self.node).update(pending)
+        metrics_registry.gauge("replica_lag_seconds", dataset=self.dataset,
+                               peer=self.node).update(
+            max(time.time() - since, 0.0) if since else 0.0)
 
     def note_ack(self) -> None:
         with self.lock:
@@ -179,13 +211,15 @@ class _PeerState:
     def _drain(self) -> None:
         while not self._stop.is_set():
             try:
-                body, seq = self.q.get(timeout=0.2)
+                body, seq, trace = self.q.get(timeout=0.2)
             except queue.Empty:
                 continue
             with self.lock:
                 self.sent += 1
             try:
-                self.client.append_record(self.dataset, body, seq=seq)
+                reply = self.client.append_record(self.dataset, body,
+                                                  seq=seq, trace=trace)
+                _restitch_spans(trace, reply)
                 self.note_ack()
             except Exception as e:  # noqa: BLE001 — peer death is data
                 self.note_failure(e)
@@ -197,11 +231,14 @@ class _PeerState:
 
     def snapshot(self) -> dict:
         with self.lock:
+            since = self.behind_since
             return {"peer": self.node, "sent": self.sent,
                     "acked": self.acked, "failed": self.failed,
                     "skipped": self.skipped, "lostRecords": self.lost,
                     "pendingRecords": self.pending_locked(),
                     "lagging": self.lagging,
+                    "lagSeconds": round(time.time() - since, 3)
+                    if since else 0.0,
                     "lastError": self.last_error}
 
 
@@ -306,44 +343,53 @@ class ReplicationManager:
         body = rec.encode()
         sync_quorum = self.cfg.ack_mode == "quorum"
         primary_owner = self.mapper.node_for_shard(shard)
-        for node in owners:
-            st = self._peer(node)
-            is_primary_target = node == primary_owner
-            if st.lagging and not is_primary_target:
-                # a LAGGING replica is skipped (probed every Nth slab so
-                # recovery is noticed without an operator): paying a
-                # connect failure per slab would collapse ingest
-                # throughput behind one corpse — catch-up repairs it
-                with st.lock:
-                    st.skipped += 1
-                    probe = st.skipped % _LAG_PROBE_EVERY == 0
+        # the write-path trace id rides the door frames: the replica
+        # executes its WAL append + ingest under it and ships its span
+        # events back in the ack, stitching into ONE trace (the same
+        # shape the query transport's remote_exec spans use)
+        trace = current_trace_id()
+        with metrics_span("replication_fanout", dataset=self.dataset):
+            for node in owners:
+                st = self._peer(node)
+                is_primary_target = node == primary_owner
+                if st.lagging and not is_primary_target:
+                    # a LAGGING replica is skipped (probed every Nth slab
+                    # so recovery is noticed without an operator): paying
+                    # a connect failure per slab would collapse ingest
+                    # throughput behind one corpse — catch-up repairs it
+                    with st.lock:
+                        st.skipped += 1
+                        probe = st.skipped % _LAG_PROBE_EVERY == 0
+                        if not probe:
+                            # the skipped slab exists only on other
+                            # owners until a catch-up repairs this peer
+                            st.lost += 1
                     if not probe:
-                        # the skipped slab exists only on other owners
-                        # until a catch-up repairs this peer
-                        st.lost += 1
-                if not probe:
-                    res.failed.append((node, "skipped: lagging"))
-                    continue
-            if sync_quorum or is_primary_target:
-                with st.lock:
-                    st.sent += 1
-                try:
-                    reply = st.client.append_record(self.dataset, body,
-                                                    seq=seq)
-                    st.note_ack()
-                    res.acked.append(node)
-                    res.ingested[node] = int(reply.get("ingested", 0))
-                except Exception as e:  # noqa: BLE001 — a dead owner is data
-                    st.note_failure(e)
-                    res.failed.append((node, f"{type(e).__name__}: {e}"))
-            else:
-                st.ensure_worker()
-                try:
-                    st.q.put_nowait((body, seq))
-                    res.queued.append(node)
-                except queue.Full:
-                    st.note_overflow()
-                    res.failed.append((node, "send queue overflow"))
+                        res.failed.append((node, "skipped: lagging"))
+                        continue
+                if sync_quorum or is_primary_target:
+                    with st.lock:
+                        st.sent += 1
+                    try:
+                        with metrics_span("replica_append", peer=node):
+                            reply = st.client.append_record(
+                                self.dataset, body, seq=seq, trace=trace)
+                        _restitch_spans(trace, reply)
+                        st.note_ack()
+                        res.acked.append(node)
+                        res.ingested[node] = int(reply.get("ingested", 0))
+                    except Exception as e:  # noqa: BLE001 — a dead owner is data
+                        st.note_failure(e)
+                        res.failed.append((node,
+                                           f"{type(e).__name__}: {e}"))
+                else:
+                    st.ensure_worker()
+                    try:
+                        st.q.put_nowait((body, seq, trace))
+                        res.queued.append(node)
+                    except queue.Full:
+                        st.note_overflow()
+                        res.failed.append((node, "send queue overflow"))
         metrics_registry.counter("replication_slabs",
                                  dataset=self.dataset).increment()
         if require_primary and not res.acked:

@@ -177,7 +177,12 @@ class ReplicationServer:
         return self
 
     def stop(self) -> None:
-        self._server.shutdown()
+        # stdlib shutdown() BLOCKS until serve_forever acknowledges —
+        # forever if the serving thread was never started (an embedder
+        # that built the door but never start()ed it must still be able
+        # to tear down; same guard as http.FiloHttpServer.stop)
+        if self._thread is not None:
+            self._server.shutdown()
         self._server.server_close()
         with self._conns_lock:
             conns = list(self._conns)
@@ -244,11 +249,18 @@ class ReplicationServer:
         (applied in order at end_restore) so a fresh sample can never
         land before its series' older snapshot history and trigger the
         store's OOO drop of that history; restore-flagged slabs (the
-        snapshot / WAL-tail stream itself) apply immediately."""
+        snapshot / WAL-tail stream itself) apply immediately.
+
+        A `trace` field in the header is the distributor's write-path
+        trace id: the local WAL append + ingest run under it and the
+        span events recorded here ride back in the ack (`spans`), so
+        the distributor's collector holds ONE stitched cross-node trace
+        — the same drain-per-reply protocol the query transport uses."""
         body = _recv_frame(sock)
         rec = WalRecord.decode(body)
         dataset = req["dataset"]
         seq = int(req.get("seq", -1))
+        trace = req.get("trace") or ""
         # the buffering decision comes FIRST: a buffered live slab is
         # WAL'd at end_restore drain time, not on arrival — otherwise a
         # crash mid-window replays the live tick BEFORE the relayed
@@ -273,14 +285,29 @@ class ReplicationServer:
                 elif key in self._staging:
                     buffered = True      # poisoned: ack, restore fails
         got = 0
+        spans = []
         if not buffered:
-            offset = self._wal_append(dataset, rec)
-            got = self._apply(dataset, rec, offset, seq)
+            if trace:
+                from filodb_tpu.utils.metrics import (collector,
+                                                      trace_context)
+                with trace_context(trace):
+                    offset = self._wal_append(dataset, rec)
+                    got = self._apply(dataset, rec, offset, seq)
+                # drain exactly the events recorded since the last reply
+                # (take — never trace — so a reused connection can't
+                # double-ship) and stitch them into the distributor's
+                # collector via the ack
+                spans = collector.take(trace)
+            else:
+                offset = self._wal_append(dataset, rec)
+                got = self._apply(dataset, rec, offset, seq)
         metrics_registry.counter("replication_appends_received",
                                  dataset=dataset).increment()
-        send_json_frame(sock, {"ok": True, "seq": seq,
-                               "ingested": int(got),
-                               "buffered": buffered})
+        reply = {"ok": True, "seq": seq, "ingested": int(got),
+                 "buffered": buffered}
+        if spans:
+            reply["spans"] = spans
+        send_json_frame(sock, reply)
 
     def _wal_append(self, dataset: str, rec: WalRecord) -> int:
         wal = self.wals.get(dataset)
@@ -466,14 +493,18 @@ class ReplicaClient:
         return self._call({"cmd": "ping"})
 
     def append_record(self, dataset: str, body: bytes,
-                      seq: int = -1, restore: bool = False) -> Dict:
+                      seq: int = -1, restore: bool = False,
+                      trace: str = "") -> Dict:
         """Ship one WalRecord-encoded slab (`seq` = the primary's WAL
         seq for replica-horizon bookkeeping; `restore` = part of a
-        restore stream, applied even inside an open restore window);
-        returns the peer's ack."""
+        restore stream, applied even inside an open restore window;
+        `trace` = the write-path trace id — the peer's WAL/ingest spans
+        ride back in the ack under `spans`); returns the peer's ack."""
         hdr = {"cmd": "append", "dataset": dataset, "seq": seq}
         if restore:
             hdr["restore"] = True
+        if trace:
+            hdr["trace"] = trace
         return self._call(hdr, (body,))
 
     def begin_restore(self, dataset: str, shard: int) -> None:

@@ -96,13 +96,33 @@ class FiloServer:
         # observability singletons take their knobs from THIS server's
         # settings: the slow-query flight recorder (ring size, JSONL
         # sink) and the per-tenant usage window (utils/slowlog, usage)
-        from filodb_tpu.utils.slowlog import slowlog
+        from filodb_tpu.utils.slowlog import ingestlog, slowlog
         from filodb_tpu.utils.usage import usage
         slowlog.configure(
             threshold_s=self.config.query.slow_query_threshold_s,
             max_entries=self.config.query.slowlog_max_entries,
             path=self.config.query.slowlog_path)
         usage.window_s = self.config.query.tenant_limit_window_s
+        # write-path observability (doc/observability.md): the ingest
+        # flight recorder, the freshness SLO fold feeding the health
+        # evaluator's `ingest` verdict, the exemplar toggle, and the
+        # node name stamped on every span this process records
+        from filodb_tpu.utils import metrics as _metrics
+        from filodb_tpu.utils.freshness import freshness
+        ingestlog.configure(
+            threshold_s=self.config.ingest.slow_batch_threshold_s,
+            max_entries=self.config.ingest.ingestlog_max_entries,
+            path=self.config.ingest.ingestlog_path)
+        freshness.configure(
+            threshold_s=self.config.ingest.slow_batch_threshold_s,
+            breach_count=self.config.ingest.freshness_breach_count,
+            window_s=self.config.ingest.freshness_window_s)
+        _metrics.set_exemplars_enabled(self.config.exemplars_enabled)
+        if node_name != "local" or not _metrics.NODE_NAME:
+            # an explicitly-named server stamps its spans (the cross-
+            # node trace evidence); default-named embedded servers only
+            # fill an empty slot so they never clobber a real identity
+            _metrics.NODE_NAME = node_name
         for dc in self.datasets:
             self._setup_dataset(dc)
         first = self.datasets[0].name

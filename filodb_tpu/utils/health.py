@@ -204,6 +204,15 @@ class HealthEvaluator:
         return {"status": worst, "datasets": datasets,
                 "recovering": recovering}
 
+    def _ingest_verdict(self) -> dict:
+        """Write-path freshness SLO (utils/freshness.py): sustained
+        ingest-to-ack breaches — e.g. a disk whose fsyncs started
+        stalling — degrade the verdict until the breach window drains.
+        A single slow batch never colors it; the tracker requires
+        `ingest.freshness_breach_count` breaches inside the window."""
+        from filodb_tpu.utils.freshness import freshness
+        return freshness.verdict()
+
     def _mirror_verdict(self) -> dict:
         from filodb_tpu.utils.events import journal
         cutoff = time.time() - RECENT_WINDOW_S
@@ -221,6 +230,7 @@ class HealthEvaluator:
             "wal": self._wal_verdict(),
             "shards": self._shards_verdict(),
             "mirror": self._mirror_verdict(),
+            "ingest": self._ingest_verdict(),
         }
         for name, probe in self.probes.items():
             try:

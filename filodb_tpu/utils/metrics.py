@@ -55,9 +55,20 @@ class Gauge:
 _DEFAULT_BOUNDS = (0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1, 5, 10, 50, 100,
                    500, 1000, 5000, 10000, 60000)
 
+# process-wide exemplar kill switch (config `exemplars_enabled`): when
+# off, Histogram.record drops the exemplar argument on the floor so the
+# per-record cost is identical to the pre-exemplar code path
+EXEMPLARS_ENABLED = True
+
+
+def set_exemplars_enabled(flag: bool) -> None:
+    global EXEMPLARS_ENABLED
+    EXEMPLARS_ENABLED = bool(flag)
+
 
 class Histogram:
-    __slots__ = ("bounds", "counts", "sum", "count", "max", "_lock")
+    __slots__ = ("bounds", "counts", "sum", "count", "max", "exemplars",
+                 "_lock")
 
     def __init__(self, bounds: Sequence[float] = _DEFAULT_BOUNDS):
         self.bounds = tuple(bounds)
@@ -68,9 +79,18 @@ class Histogram:
         # percentile estimate (a 752 s p99 and a 5.1 s p99 both land in
         # the +Inf bucket; without the max they'd report identically)
         self.max = 0.0
+        # bucket index -> (trace_id, value, unix_ts): the most recent
+        # exemplar per bucket (the OpenMetrics bridge from a latency
+        # histogram to the exact trace that caused it).  Lazily created —
+        # histograms that never see an exemplar pay nothing.
+        self.exemplars: Optional[Dict[int, Tuple[str, float, float]]] = None
         self._lock = threading.Lock()
 
-    def record(self, v: float) -> None:
+    def record(self, v: float, exemplar: Optional[str] = None) -> None:
+        """Record one observation.  `exemplar` is an optional trace id
+        attached to the containing bucket (latest wins), emitted by the
+        OpenMetrics exposition as `# {trace_id="..."} value ts` so an
+        operator can jump from a latency spike straight to the trace."""
         i = bisect.bisect_left(self.bounds, v)
         with self._lock:
             self.counts[i] += 1
@@ -78,6 +98,13 @@ class Histogram:
             self.count += 1
             if v > self.max:
                 self.max = v
+            if exemplar and EXEMPLARS_ENABLED:
+                if self.exemplars is None:
+                    self.exemplars = {}
+                self.exemplars[i] = (str(exemplar), float(v), time.time())
+
+    # Prometheus-client parity name for the same operation
+    observe = record
 
     def percentile(self, q: float) -> float:
         """Approximate percentile, linearly interpolated within the
@@ -102,6 +129,20 @@ class Histogram:
                     return lo + frac * (hi - lo)
                 acc += c
             return max(self.max, self.bounds[-1])
+
+
+def _esc_label(v: str) -> str:
+    # the exposition-format label escapes: backslash, quote, newline
+    # (shared by both exposition grammars — one home, no drift)
+    return str(v).replace("\\", "\\\\").replace('"', '\\"') \
+        .replace("\n", "\\n")
+
+
+def _fmt_tags(tags: TagTuple, extra: str = "") -> str:
+    items = [f'{k}="{_esc_label(v)}"' for k, v in tags]
+    if extra:
+        items.append(extra)
+    return "{" + ",".join(items) + "}" if items else ""
 
 
 class MetricsRegistry:
@@ -188,17 +229,7 @@ class MetricsRegistry:
         """Prometheus text exposition of the framework's own metrics
         (ref: Kamon prometheus reporter, README:812-819)."""
         out: List[str] = []
-
-        def esc(v: str) -> str:
-            # the exposition-format label escapes: backslash, quote, newline
-            return str(v).replace("\\", "\\\\").replace('"', '\\"') \
-                .replace("\n", "\\n")
-
-        def fmt_tags(tags: TagTuple, extra: str = "") -> str:
-            items = [f'{k}="{esc(v)}"' for k, v in tags]
-            if extra:
-                items.append(extra)
-            return "{" + ",".join(items) + "}" if items else ""
+        fmt_tags = _fmt_tags
 
         # snapshot under the lock: concurrent first-seen metric creation must
         # not blow up a scrape mid-iteration
@@ -230,6 +261,78 @@ class MetricsRegistry:
             out.append(f"{name}_sum{fmt_tags(tags)} {h_sum:g}")
             out.append(f"{name}_count{fmt_tags(tags)} {h_count}")
         return "\n".join(out) + "\n"
+
+    # -------------------------------------------------- openmetrics format
+
+    def expose_openmetrics(self) -> str:
+        """OpenMetrics 1.0 text exposition (`/metrics?format=openmetrics`):
+        `# TYPE` metadata per family, canonical-float `le` values,
+        counter samples under their `_total` name, per-bucket exemplars
+        (`# {trace_id="..."} value ts` — the standard bridge from a
+        latency histogram to the exact trace that caused it), and the
+        mandatory `# EOF` terminator.  The plain Prometheus format
+        (expose_prometheus) is untouched — scrapers negotiate via the
+        query param, and the legacy output stays byte-identical."""
+        out: List[str] = []
+        fmt_tags = _fmt_tags
+
+        def om_float(b: float) -> str:
+            # canonical float form: OpenMetrics `le` values are floats,
+            # never bare ints ("1.0", not "1")
+            s = "%g" % b
+            return s if ("." in s or "e" in s or "inf" in s) else s + ".0"
+
+        with self._lock:
+            counters = list(self._counters.items())
+            gauges = list(self._gauges.items())
+            hists = list(self._hists.items())
+
+        def grouped(items):
+            fams: Dict[str, list] = {}
+            for (name, tags), m in sorted(items):
+                fams.setdefault(name, []).append((tags, m))
+            return fams
+
+        for name, series in grouped(counters).items():
+            out.append(f"# TYPE {name} counter")
+            for tags, c in series:
+                out.append(f"{name}_total{fmt_tags(tags)} {c.value:g}")
+        for name, series in grouped(gauges).items():
+            out.append(f"# TYPE {name} gauge")
+            for tags, g in series:
+                out.append(f"{name}{fmt_tags(tags)} {g.value:g}")
+        for name, series in grouped(hists).items():
+            out.append(f"# TYPE {name} histogram")
+            for tags, h in series:
+                with h._lock:
+                    counts = list(h.counts)
+                    h_sum, h_count = h.sum, h.count
+                    ex = dict(h.exemplars) if h.exemplars else {}
+                acc = 0
+                for i, b in enumerate(h.bounds):
+                    acc += counts[i]
+                    le_tag = 'le="%s"' % om_float(b)
+                    line = (f"{name}_bucket{fmt_tags(tags, le_tag)} "
+                            f"{acc}")
+                    out.append(line + _om_exemplar(ex.get(i)))
+                inf_tag = 'le="+Inf"'
+                line = (f"{name}_bucket{fmt_tags(tags, inf_tag)} "
+                        f"{h_count}")
+                out.append(line + _om_exemplar(ex.get(len(h.bounds))))
+                out.append(f"{name}_sum{fmt_tags(tags)} {h_sum:g}")
+                out.append(f"{name}_count{fmt_tags(tags)} {h_count}")
+        out.append("# EOF")
+        return "\n".join(out) + "\n"
+
+
+def _om_exemplar(ex) -> str:
+    """One bucket's exemplar suffix, or "" (OpenMetrics exemplar syntax:
+    ` # {trace_id="..."} value timestamp`)."""
+    if not ex:
+        return ""
+    tid, v, ts = ex
+    tid = str(tid).replace("\\", "").replace('"', "").replace("\n", "")
+    return f' # {{trace_id="{tid}"}} {v:g} {ts:.3f}'
 
 
 registry = MetricsRegistry()
@@ -327,10 +430,20 @@ class TraceCollector:
     `trace(tid)` returns ONE stitched cross-node trace."""
 
     def __init__(self, max_traces: int = 256, max_events: int = 512):
+        import collections as _collections
         self.max_traces = max_traces
         self.max_events = max_events
         self._traces: Dict[str, List[dict]] = {}
         self._order: List[str] = []
+        # trace -> origin tag (query | rule_eval | remote_write), set by
+        # the doors; /admin/traces?origin= filters on it
+        self._origins: Dict[str, str] = {}
+        # ids evicted from the bounded ring: /traces/{id} answers "410
+        # gone" (the trace existed, the ring recycled it) instead of a
+        # 404 indistinguishable from a typo.  Bounded itself so hostile
+        # churn cannot grow it without bound.
+        self._evicted = _collections.deque(maxlen=max(4 * max_traces, 64))
+        self._evicted_set: set = set()
         self._lock = threading.Lock()
         # push-export hooks (utils/traceexport.TraceExporter): called
         # outside the lock with every recorded event; must not block
@@ -343,18 +456,65 @@ class TraceCollector:
         if sink in self._sinks:
             self._sinks.remove(sink)
 
-    def record(self, trace_id: str, event: dict) -> None:
+    def record(self, trace_id: str, event: Optional[dict]) -> None:
+        """Record one span event (event=None registers the trace id in
+        the ring without an event — the doors tag origins before their
+        first span exits)."""
+        evicted = 0
         with self._lock:
             evs = self._traces.get(trace_id)
             if evs is None:
                 evs = self._traces[trace_id] = []
                 self._order.append(trace_id)
                 while len(self._order) > self.max_traces:
-                    self._traces.pop(self._order.pop(0), None)
-            if len(evs) < self.max_events:
+                    old = self._order.pop(0)
+                    self._traces.pop(old, None)
+                    self._origins.pop(old, None)
+                    if old in self._evicted_set:
+                        # a re-registered-then-re-evicted id: refresh
+                        # its position instead of duplicating it (a
+                        # duplicate would let the rotation discard the
+                        # set entry while a deque copy remains, turning
+                        # a promised 410 into a 404).  O(n) on a small
+                        # bounded deque, and only on this rare path.
+                        try:
+                            self._evicted.remove(old)
+                        except ValueError:
+                            pass
+                    elif len(self._evicted) == self._evicted.maxlen:
+                        self._evicted_set.discard(self._evicted[0])
+                    self._evicted.append(old)
+                    self._evicted_set.add(old)
+                    evicted += 1
+            if event is not None and len(evs) < self.max_events:
                 evs.append(event)
-        for sink in self._sinks:
-            sink(trace_id, event)
+        if evicted:
+            registry.counter("trace_evictions").increment(evicted)
+        if event is not None:
+            for sink in self._sinks:
+                sink(trace_id, event)
+
+    def note_origin(self, trace_id: str, origin: str) -> None:
+        """Tag a trace with its door (query | rule_eval | remote_write).
+        The doors tag BEFORE their first span exits, so an unknown id is
+        registered in the ring (empty event list) rather than dropped —
+        the origins map shares the ring's bound either way."""
+        if not trace_id or not origin:
+            return
+        with self._lock:
+            if trace_id in self._traces:
+                self._origins[trace_id] = origin
+                return
+        # register through record()'s eviction bookkeeping, then tag
+        self.record(trace_id, None)
+        with self._lock:
+            if trace_id in self._traces:
+                self._origins[trace_id] = origin
+
+    def was_evicted(self, trace_id: str) -> bool:
+        with self._lock:
+            return trace_id in self._evicted_set \
+                and trace_id not in self._traces
 
     def trace(self, trace_id: str) -> List[dict]:
         with self._lock:
@@ -372,12 +532,63 @@ class TraceCollector:
             evs.clear()
             return out
 
-    def trace_ids(self) -> List[str]:
+    def trace_ids(self, origin: str = "", limit: int = 0) -> List[str]:
+        """Known ids, oldest first.  `origin` filters to one door's
+        traces; `limit` keeps the newest N."""
         with self._lock:
-            return list(self._order)
+            if origin:
+                ids = [t for t in self._order
+                       if self._origins.get(t) == origin]
+            else:
+                ids = list(self._order)
+        return ids[-limit:] if limit > 0 else ids
 
 
 collector = TraceCollector()
+
+
+# ------------------------------------------------------- W3C traceparent
+
+# the W3C Trace Context header: 00-<32 hex trace id>-<16 hex span id>-<flags>
+_TRACEPARENT_RE = None
+
+
+def parse_traceparent(header: Optional[str]) -> Optional[str]:
+    """Extract the 32-hex trace id from a `traceparent` request header
+    (W3C Trace Context).  Returns None for missing/malformed headers and
+    for the all-zero (invalid) trace id — the caller mints its own."""
+    global _TRACEPARENT_RE
+    if not header:
+        return None
+    if _TRACEPARENT_RE is None:
+        import re as _re
+        _TRACEPARENT_RE = _re.compile(
+            r"^([0-9a-f]{2})-([0-9a-f]{32})-([0-9a-f]{16})-([0-9a-f]{2})$")
+    m = _TRACEPARENT_RE.match(header.strip().lower())
+    if not m or m.group(1) == "ff":
+        return None
+    tid = m.group(2)
+    if tid == "0" * 32 or m.group(3) == "0" * 16:
+        return None
+    return tid
+
+
+def make_traceparent(trace_id: str) -> str:
+    """Format a trace id as an outgoing `traceparent` header (a fresh
+    16-hex span id per call; non-32-hex internal ids are hashed into
+    shape, matching the trace-export normalization)."""
+    import uuid as _uuid
+    tid = str(trace_id).replace("-", "").lower()
+    if len(tid) != 32 or any(c not in "0123456789abcdef" for c in tid):
+        tid = _uuid.uuid5(_uuid.NAMESPACE_OID, str(trace_id)).hex
+    return f"00-{tid}-{_uuid.uuid4().hex[:16]}-01"
+
+
+def mint_trace_id() -> str:
+    """A fresh W3C-shaped (32 lower hex) trace id for a request that
+    arrived without one."""
+    import uuid as _uuid
+    return _uuid.uuid4().hex
 
 
 class trace_context:
@@ -439,9 +650,12 @@ class span:
         stack = _active.stack
         full = ".".join(stack)
         stack.pop()
-        registry.histogram(f"span_{self.name}_seconds",
-                           **self.tags).record(elapsed)
         tid = current_trace_id()
+        # the active trace id doubles as the span histogram's exemplar,
+        # so every span_*_seconds family carries OpenMetrics exemplars
+        # for free (histogram spike -> /admin/traces/<id> in one hop)
+        registry.histogram(f"span_{self.name}_seconds",
+                           **self.tags).record(elapsed, exemplar=tid)
         if tid:
             collector.record(tid, {
                 "span": full, "dur_s": round(elapsed, 6),

@@ -1,4 +1,4 @@
-"""Slow-query flight recorder.
+"""Slow-operation flight recorders (query + ingest).
 
 The serving frontend records every query whose total wall (queue wait
 included) exceeds `query.slow_query_threshold_s` into a bounded ring
@@ -9,11 +9,19 @@ dangle a trace id that has already been evicted).  Exposed at
 GET /admin/slowlog and optionally mirrored to a JSONL sink
 (`query.slowlog_path`) for offline triage.
 
+The WRITE path gets the same flight recorder: remote_write / gateway
+batches whose door-to-ack wall exceeds `ingest.slow_batch_threshold_s`
+land in a second ring (`IngestSlowLog`, GET /admin/ingestlog) with
+tenant, byte/sample counts, the per-stage breakdown (decode, WAL
+append, fsync wait, replication fan-out, memstore ingest) and the
+batch's trace id — when `wal_on_vs_off_pct` dips or a replica lags, the
+operator reads the actual offending batches instead of inferring from
+aggregate histograms.
+
 This is the MySQL-slow-log / Monarch-query-annal shape: when the p99
-spikes, the operator reads the actual offending queries with their
-queue/parse/plan/exec/device/transfer breakdown instead of inferring
-from aggregate histograms.  SOAK_LONG_r05's 752 s eviction-window query
-is exactly the record this would have captured.
+spikes, the operator reads the actual offending operations with their
+breakdown.  SOAK_LONG_r05's 752 s eviction-window query is exactly the
+record the query ring would have captured.
 """
 from __future__ import annotations
 
@@ -27,9 +35,11 @@ from typing import Dict, List, Optional, Tuple
 log = logging.getLogger("filodb.slowlog")
 
 
-class SlowQueryLog:
+class _RingLog:
+    """Bounded ring + monotonic seq + optional JSONL mirror — the shared
+    flight-recorder mechanics both slow logs ride on."""
 
-    def __init__(self, threshold_s: float = 10.0, max_entries: int = 128,
+    def __init__(self, threshold_s: float, max_entries: int,
                  path: str = ""):
         self.threshold_s = threshold_s
         self.path = path
@@ -40,7 +50,7 @@ class SlowQueryLog:
 
     def configure(self, threshold_s: Optional[float] = None,
                   max_entries: Optional[int] = None,
-                  path: Optional[str] = None) -> "SlowQueryLog":
+                  path: Optional[str] = None) -> "_RingLog":
         """Apply config (standalone.FiloServer at boot; tests directly).
         Shrinking max_entries keeps the newest records."""
         with self._lock:
@@ -53,6 +63,47 @@ class SlowQueryLog:
                 self._entries = collections.deque(self._entries,
                                                   maxlen=max_entries)
         return self
+
+    def _append(self, rec: dict) -> None:
+        """Sequence + ring-append + best-effort JSONL mirror."""
+        with self._lock:
+            self._seq += 1
+            rec["seq"] = self._seq
+            self._entries.append(rec)
+        if self.path:
+            try:
+                with self._lock:   # serialize appends; keep lines whole
+                    with open(self.path, "a") as f:
+                        f.write(json.dumps(rec) + "\n")
+            except OSError as e:
+                # the sink is best-effort; the ring buffer is the record
+                from filodb_tpu.utils.metrics import registry
+                registry.counter("slowlog_sink_errors").increment()
+                log.warning("slowlog sink %s failed: %s", self.path, e)
+
+    # ------------------------------------------------------------- read
+
+    def entries(self, limit: int = 0) -> List[dict]:
+        """Newest-last snapshot (the /admin payload)."""
+        with self._lock:
+            out = list(self._entries)
+        return out[-limit:] if limit else out
+
+    def clear(self) -> int:
+        with self._lock:
+            n = len(self._entries)
+            self._entries.clear()
+        return n
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+
+class SlowQueryLog(_RingLog):
+
+    def __init__(self, threshold_s: float = 10.0, max_entries: int = 128,
+                 path: str = ""):
+        super().__init__(threshold_s, max_entries, path)
 
     # ------------------------------------------------------------ record
 
@@ -90,43 +141,51 @@ class SlowQueryLog:
             "stats": stats.to_dict() if stats is not None else None,
             "spans": spans,
         }
-        with self._lock:
-            self._seq += 1
-            rec["seq"] = self._seq
-            self._entries.append(rec)
+        self._append(rec)
         registry.counter("slow_queries", origin=origin).increment()
         log.warning("slow query (%.2fs > %.2fs): %s [%s..%s step %s] "
                     "trace=%s", duration_s, thr, promql,
                     start_s, end_s, step_s, trace_id)
-        if self.path:
-            try:
-                with self._lock:   # serialize appends; keep lines whole
-                    with open(self.path, "a") as f:
-                        f.write(json.dumps(rec) + "\n")
-            except OSError as e:
-                # the sink is best-effort; the ring buffer is the record
-                registry.counter("slowlog_sink_errors").increment()
-                log.warning("slowlog sink %s failed: %s", self.path, e)
         return True
 
-    # ------------------------------------------------------------- read
 
-    def entries(self, limit: int = 0) -> List[dict]:
-        """Newest-last snapshot (the /admin/slowlog payload)."""
-        with self._lock:
-            out = list(self._entries)
-        return out[-limit:] if limit else out
+class IngestSlowLog(_RingLog):
+    """The write path's flight recorder: batches over
+    `ingest.slow_batch_threshold_s` door-to-ack, with per-stage
+    breakdown and trace id (GET /admin/ingestlog)."""
 
-    def clear(self) -> int:
-        with self._lock:
-            n = len(self._entries)
-            self._entries.clear()
-        return n
+    def __init__(self, threshold_s: float = 5.0, max_entries: int = 128,
+                 path: str = ""):
+        super().__init__(threshold_s, max_entries, path)
 
-    def __len__(self) -> int:
-        return len(self._entries)
+    def maybe_record(self, stats,
+                     threshold_s: Optional[float] = None) -> bool:
+        """`stats` is a utils.freshness.IngestStats; records iff its
+        total wall crossed the threshold.  The stitched span tree is
+        copied at record time, like the query ring."""
+        thr = self.threshold_s if threshold_s is None else threshold_s
+        if thr <= 0 or stats.total_s < thr:
+            return False
+        from filodb_tpu.utils.metrics import collector, registry
+        spans: List[dict] = []
+        if stats.trace_id:
+            spans = sorted(collector.trace(stats.trace_id),
+                           key=lambda e: e.get("end_unix_s", 0))
+        rec = stats.to_dict()
+        rec["unix_ts"] = round(time.time(), 3)
+        rec["spans"] = spans
+        self._append(rec)
+        registry.counter("slow_ingest_batches",
+                         origin=stats.origin).increment()
+        log.warning("slow ingest batch (%.3fs > %.3fs): %d samples / "
+                    "%d series / %d bytes [%s] trace=%s",
+                    stats.total_s, thr, stats.samples, stats.series,
+                    stats.bytes_in, stats.origin, stats.trace_id)
+        return True
 
 
-# process-wide instance: the frontend records into it, /admin/slowlog
-# reads it, standalone.FiloServer configures it from FilodbSettings
+# process-wide instances: the frontend / ingest doors record into them,
+# /admin/slowlog and /admin/ingestlog read them, standalone.FiloServer
+# configures both from FilodbSettings
 slowlog = SlowQueryLog()
+ingestlog = IngestSlowLog()

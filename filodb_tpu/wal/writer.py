@@ -37,6 +37,7 @@ from typing import List, Optional, Tuple
 
 from filodb_tpu.utils.faults import faults
 from filodb_tpu.utils.metrics import registry as metrics_registry
+from filodb_tpu.utils.metrics import span as metrics_span
 from filodb_tpu.wal.segment import (frame_record, list_segments,
                                     read_records, segment_path,
                                     write_segment_header, WalRecord)
@@ -105,9 +106,16 @@ class WalWriter:
         """Assign rec.seq, buffer the framed record, return the seq
         WITHOUT waiting for durability (callers batch several appends,
         then `wait_committed` once for the last seq)."""
+        faults.fire("wal.append")
+        # write-path trace: one span per buffered append (encode + frame
+        # + buffer write; the fsync is the committer's and shows up as
+        # the caller's wal_commit_wait span instead)
+        with metrics_span("wal_append", dataset=self.dataset):
+            return self._append_record(rec)
+
+    def _append_record(self, rec: WalRecord) -> int:
         from filodb_tpu.wal.segment import (TABLE_INLINE, TABLE_REF,
                                             key_table_entry)
-        faults.fire("wal.append")
         # blob+hash come from the identity memo OUTSIDE the lock (the
         # only per-series work on this path)
         blob, h = key_table_entry(rec.part_keys)
@@ -145,6 +153,13 @@ class WalWriter:
         """Block until `seq` is durable; WalWriteError if its group's
         commit failed or the wait times out (a wedged disk must surface
         as a failed ack, not an ingest hang)."""
+        # the group-commit fsync wait: THE write-path latency suspect,
+        # so it gets its own span (stitches under the batch's trace) on
+        # top of the committer's wal_fsync_seconds histogram
+        with metrics_span("wal_commit_wait", dataset=self.dataset):
+            self._wait_committed(seq, timeout_s)
+
+    def _wait_committed(self, seq: int, timeout_s: float = 30.0) -> None:
         with self._commit_cv:
             ok = self._commit_cv.wait_for(
                 lambda: self._committed_seq >= seq
@@ -231,8 +246,11 @@ class WalWriter:
                 return
             f = self._file
         try:
-            faults.fire("wal.fsync")
+            # the fault point sits INSIDE the timed window: an injected
+            # wal.fsync delay must show in the fsync-latency histogram
+            # exactly like a real disk stall would
             t0 = _time.perf_counter()
+            faults.fire("wal.fsync")
             f.flush()
             if self.fsync:
                 os.fsync(f.fileno())

@@ -1,0 +1,71 @@
+"""Tier-1 wiring for the metric-hygiene gate (tools/check_metrics.py).
+
+Runs the tool in a SUBPROCESS so the registry it walks holds exactly
+its own boot's metrics — the shared test-session registry is full of
+deliberately-nasty seeds (escaping fuzz, race hammers) that are not
+production metric families.  A second in-process test covers the
+checker's own detection logic against a synthetic registry.
+"""
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TOOL = os.path.join(REPO, "tools", "check_metrics.py")
+
+
+def test_live_registry_passes_hygiene_gate():
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO
+    proc = subprocess.run([sys.executable, TOOL], capture_output=True,
+                          text=True, env=env, cwd=REPO, timeout=600)
+    assert proc.returncode == 0, \
+        f"metric hygiene violations:\n{proc.stderr}\n{proc.stdout}"
+    assert "OK" in proc.stdout
+
+
+def test_checker_detects_violations(tmp_path):
+    from filodb_tpu.utils.metrics import MetricsRegistry
+    from tools import check_metrics as cm
+
+    reg = MetricsRegistry()
+    reg.counter("good_ops", site="a").increment()
+    reg.histogram("good_lat").record(0.1)
+    doc = tmp_path / "obs.md"
+    doc.write_text("## Metrics reference\n\n| metric | kind |\n|---|---|\n"
+                   "| `good_ops` | counter |\n| `good_lat` | histogram |\n")
+    assert cm.check(reg, str(doc)) == []
+
+    # undocumented metric
+    reg.gauge("rogue_gauge").update(1)
+    viol = cm.check(reg, str(doc))
+    assert any("undocumented" in v and "rogue_gauge" in v for v in viol)
+    doc.write_text(doc.read_text() + "| `rogue_gauge` | gauge |\n")
+    assert cm.check(reg, str(doc)) == []
+
+    # cross-kind exposed-name collision: gauge literally named like the
+    # counter's exposed _total sample
+    reg.gauge("good_ops_total").update(1)
+    doc.write_text(doc.read_text() + "| `good_ops_total` | gauge |\n")
+    viol = cm.check(reg, str(doc))
+    assert any("collision" in v for v in viol)
+
+    # illegal label name + reserved `le`
+    reg2 = MetricsRegistry()
+    reg2.counter("ok_ops", **{"le": "x"}).increment()
+    doc2 = tmp_path / "obs2.md"
+    doc2.write_text("## Metrics reference\n| `ok_ops` | counter |\n")
+    viol = cm.check(reg2, str(doc2))
+    assert any("reserved" in v or "illegal" in v for v in viol)
+
+    # a missing reference table is itself a violation
+    viol = cm.check(reg2, str(tmp_path / "absent.md"))
+    assert any("reference table missing" in v for v in viol)
+
+    # glob entries cover per-name families
+    reg3 = MetricsRegistry()
+    reg3.histogram("span_foo_seconds").record(0.1)
+    doc3 = tmp_path / "obs3.md"
+    doc3.write_text("## Metrics reference\n| `span_*_seconds` | histogram |\n")
+    assert cm.check(reg3, str(doc3)) == []

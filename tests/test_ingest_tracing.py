@@ -1,0 +1,429 @@
+"""Write-path distributed tracing, freshness SLOs, and trace-ring
+satellites (ISSUE 12; doc/observability.md write-path sections).
+
+Covers: W3C traceparent accept/mint at the doors, the write-path span
+tree (door -> WAL append -> fsync wait -> replication fan-out ->
+replica WAL/ingest) stitched into ONE trace over real sockets, the
+ingest slowlog, the freshness histograms + sustained-breach health
+fold, trace-ring eviction (410-gone vs 404) and the /admin/traces
+limit/origin filters.
+"""
+import json
+import tempfile
+import time
+
+import numpy as np
+import pytest
+
+from filodb_tpu.config import FilodbSettings
+from filodb_tpu.http import remotepb
+from filodb_tpu.standalone import DatasetConfig, FiloServer
+from filodb_tpu.utils import snappy as fsnappy
+from filodb_tpu.utils.metrics import (TraceCollector, collector,
+                                      make_traceparent, mint_trace_id,
+                                      parse_traceparent, registry)
+
+
+def _write_payload(series=6, k=3, ws="trc", start_ms=None):
+    start = start_ms or (int(time.time() * 1000) - 60_000)
+    out = []
+    for i in range(series):
+        labels = [("__name__", "trace_test_total"), ("_ws_", ws),
+                  ("_ns_", "t"), ("inst", f"i{i}")]
+        samples = [(float(i + j), start + j * 10_000) for j in range(k)]
+        out.append(remotepb.PromTimeSeries(labels, samples))
+    return fsnappy.compress(remotepb.encode_write_request(out))
+
+
+@pytest.fixture
+def server():
+    srv = FiloServer(datasets=[DatasetConfig("prometheus", num_shards=2)])
+    yield srv
+    srv.shutdown()
+
+
+# ------------------------------------------------------ traceparent
+
+
+def test_traceparent_parse_and_mint():
+    tid = mint_trace_id()
+    assert len(tid) == 32 and int(tid, 16) >= 0
+    hdr = make_traceparent(tid)
+    assert parse_traceparent(hdr) == tid
+    # malformed / invalid headers are rejected, not crashed on
+    assert parse_traceparent(None) is None
+    assert parse_traceparent("") is None
+    assert parse_traceparent("garbage") is None
+    assert parse_traceparent("00-" + "0" * 32 + "-" + "1" * 16 + "-01") \
+        is None                                  # all-zero trace id
+    assert parse_traceparent("00-" + "a" * 32 + "-" + "0" * 16 + "-01") \
+        is None                                  # all-zero span id
+    assert parse_traceparent("ff-" + "a" * 32 + "-" + "b" * 16 + "-01") \
+        is None                                  # forbidden version
+    # non-32-hex internal ids are hashed into shape, not emitted raw
+    weird = make_traceparent("not-hex!")
+    assert parse_traceparent(weird)
+
+
+# ------------------------------------------------- door span trees
+
+
+def test_remote_write_minted_trace_and_span_tree(server):
+    st, pay = server.api.handle("POST", "/api/v1/write", {},
+                                _write_payload())
+    assert st == 204
+    hdrs = pay["_headers"]
+    tid = hdrs["X-Trace-Id"]
+    assert parse_traceparent(hdrs["traceparent"]) == tid
+    leaves = {e["span"].rsplit(".", 1)[-1] for e in collector.trace(tid)}
+    assert {"remote_write", "rw_decode", "rw_admission",
+            "rw_build_slabs", "ingest_columns"} <= leaves
+    # the trace is listed under the remote_write origin
+    st, listing = server.api.handle("GET", "/admin/traces",
+                                    {"origin": "remote_write"}, b"")
+    assert st == 200 and tid in listing["data"]
+    # and served back as one tree
+    st, tree = server.api.handle("GET", f"/admin/traces/{tid}", {}, b"")
+    assert st == 200 and tree["data"]["traceID"] == tid
+
+
+def test_remote_write_accepts_client_traceparent(server):
+    tid = mint_trace_id()
+    st, pay = server.api.handle(
+        "POST", "/api/v1/write", {}, _write_payload(),
+        headers={"Traceparent": make_traceparent(tid)})
+    assert st == 204
+    assert pay["_headers"]["X-Trace-Id"] == tid
+    assert collector.trace(tid), "client trace id must carry the spans"
+
+
+def test_rejected_payload_still_carries_trace_headers(server):
+    """The documented contract: EVERY response — a 400 included —
+    answers with its trace headers so the operator can correlate."""
+    tid = mint_trace_id()
+    st, pay = server.api.handle(
+        "POST", "/api/v1/write", {}, b"\x00garbled",
+        headers={"traceparent": make_traceparent(tid)})
+    assert st == 400 and pay["errorType"] == "bad_data"
+    assert pay["_headers"]["X-Trace-Id"] == tid
+    assert parse_traceparent(pay["_headers"]["traceparent"]) == tid
+
+
+def test_influx_door_traceparent(server):
+    tid = mint_trace_id()
+    st, pay = server.api.handle(
+        "POST", "/influx/write", {},
+        b"m,_ws_=trc,_ns_=t,inst=a value=1.0\n",
+        headers={"traceparent": make_traceparent(tid)})
+    assert st == 204
+    assert pay["_headers"]["X-Trace-Id"] == tid
+    leaves = {e["span"].rsplit(".", 1)[-1] for e in collector.trace(tid)}
+    assert "influx_write" in leaves
+
+
+# ----------------------------------------- stitched RF-2 write trace
+
+
+def test_replicated_write_stitches_one_trace(tmp_path):
+    """An RF-2 write through real replication sockets produces ONE
+    trace: door + WAL + fan-out spans locally, the replica's WAL append
+    / commit wait / ingest spans shipped back in the ack."""
+    cfg = FilodbSettings()
+    cfg.wal.enabled = True
+    cfg.wal.dir = str(tmp_path / "walA")
+    cfg.replication.enabled = True
+    cfg.replication.factor = 2
+    cfg.replication.ack_mode = "quorum"
+    # the replica: a bare memstore + WAL behind a replication door
+    from filodb_tpu.core.memstore import TimeSeriesMemStore
+    from filodb_tpu.replication import ReplicationServer
+    from filodb_tpu.wal import WalManager
+    ms_b = TimeSeriesMemStore()
+    wal_b = WalManager(str(tmp_path / "walB"), "prometheus")
+    door_b = ReplicationServer(ms_b, node="B",
+                               wals={"prometheus": wal_b}).start()
+    srv = None
+    try:
+        srv = FiloServer(
+            datasets=[DatasetConfig("prometheus", num_shards=1)],
+            config=cfg, node_name="A",
+            replication_peers={"B": ("127.0.0.1", door_b.address[1])})
+        tid = mint_trace_id()
+        st, pay = srv.api.handle(
+            "POST", "/api/v1/write", {}, _write_payload(),
+            headers={"traceparent": make_traceparent(tid)})
+        assert st == 204 and pay["_headers"]["X-Trace-Id"] == tid
+        leaves = {e["span"].rsplit(".", 1)[-1]
+                  for e in collector.trace(tid)}
+        assert {"remote_write", "wal_append", "wal_commit_wait",
+                "replication_fanout", "replica_append",
+                "ingest_columns"} <= leaves, leaves
+        # the replica actually ingested under the same trace: its copy
+        # holds the samples
+        assert ms_b.get_shard("prometheus", 0).stats.rows_ingested > 0
+    finally:
+        if srv is not None:
+            srv.shutdown()
+        door_b.stop()
+        wal_b.close()
+
+
+# ------------------------------------------- ingest slowlog + freshness
+
+
+def test_ingestlog_records_slow_batches_with_breakdown(server):
+    from filodb_tpu.utils.slowlog import ingestlog
+    ingestlog.clear()
+    server.api._config.ingest.slow_batch_threshold_s = 1e-9
+    try:
+        st, pay = server.api.handle("POST", "/api/v1/write", {},
+                                    _write_payload(ws="slowws"))
+        assert st == 204
+        st, il = server.api.handle("GET", "/admin/ingestlog", {}, b"")
+        assert st == 200
+        recs = il["data"]["entries"]
+        assert recs, "a sub-ns threshold must record every batch"
+        rec = recs[-1]
+        assert rec["origin"] == "remote_write"
+        assert rec["tenant"]["ws"] == "slowws"
+        assert rec["samples"] == 18 and rec["series"] == 6
+        assert rec["bytes_in"] > 0 and rec["shards"]
+        assert rec["trace_id"] == pay["_headers"]["X-Trace-Id"]
+        for stage in ("decode_s", "admission_s", "build_slabs_s",
+                      "wal_append_s", "wal_commit_wait_s",
+                      "replication_s", "ingest_s"):
+            assert stage in rec["stages"]
+        assert rec["spans"], "span tree copied at record time"
+        # clear empties the ring
+        st, cleared = server.api.handle("POST", "/admin/ingestlog/clear",
+                                        {}, b"")
+        assert st == 200 and cleared["data"]["cleared"] >= 1
+        assert server.api.handle("GET", "/admin/ingestlog",
+                                 {}, b"")[1]["data"]["count"] == 0
+    finally:
+        server.api._config.ingest.slow_batch_threshold_s = 5.0
+
+
+def test_freshness_histograms_and_sustained_breach_health(server):
+    from filodb_tpu.utils.freshness import freshness
+    freshness.reset()
+    freshness.configure(threshold_s=1e-9, breach_count=3, window_s=60.0)
+    try:
+        now = int(time.time() * 1000)
+        for _ in range(2):
+            st, _ = server.api.handle(
+                "POST", "/api/v1/write", {},
+                _write_payload(ws="fresh", start_ms=now - 30_000))
+            assert st == 204
+        ack = registry.histogram("ingest_ack_seconds", ws="fresh",
+                                 origin="remote_write")
+        fresh = registry.histogram("ingest_freshness_seconds", ws="fresh")
+        assert ack.count >= 2 and fresh.count >= 2
+        # freshness = ack wall clock minus newest sample ts (~10 s here:
+        # the payload's newest stamp is start + 2*10 s = now - 10 s)
+        assert 1.0 < fresh.max < 120.0
+        # 2 breaches < breach_count: still ok
+        assert freshness.verdict()["status"] == "ok"
+        st, _ = server.api.handle("POST", "/api/v1/write", {},
+                                  _write_payload(ws="fresh"))
+        v = freshness.verdict()
+        assert v["status"] == "degraded" and v["recentBreaches"] >= 3
+        # the health tree folds it in
+        h = server.api.handle("GET", "/api/v1/status/health",
+                              {}, b"")[1]["data"]
+        assert h["subsystems"]["ingest"]["status"] == "degraded"
+        assert h["status"] != "ok"
+        # breaches age out -> self-clears
+        freshness.configure(window_s=0.05)
+        time.sleep(0.1)
+        assert freshness.verdict()["status"] == "ok"
+    finally:
+        freshness.reset()
+        freshness.configure(threshold_s=5.0, breach_count=3,
+                            window_s=60.0)
+
+
+def test_injected_fsync_delay_visible_everywhere(tmp_path):
+    """The acceptance drill in unit form: a wal.fsync delay surfaces in
+    the fsync histogram, the ingest slowlog, the freshness histograms,
+    and the health verdict."""
+    from filodb_tpu.utils.faults import faults
+    from filodb_tpu.utils.freshness import freshness
+    from filodb_tpu.utils.slowlog import ingestlog
+    cfg = FilodbSettings()
+    cfg.wal.enabled = True
+    cfg.wal.dir = str(tmp_path / "wal")
+    cfg.ingest.slow_batch_threshold_s = 0.02
+    cfg.ingest.freshness_breach_count = 2
+    freshness.reset()
+    ingestlog.clear()
+    srv = FiloServer(datasets=[DatasetConfig("prometheus",
+                                             num_shards=1)], config=cfg)
+    try:
+        delay = 0.1
+        with faults.plan("wal.fsync", "delay", first_k=4,
+                         delay_s=delay):
+            for _ in range(2):
+                st, _ = srv.api.handle("POST", "/api/v1/write", {},
+                                       _write_payload(ws="fault"))
+                assert st == 204
+        assert registry.histogram(
+            "wal_fsync_seconds",
+            dataset="prometheus").max >= delay * 0.8
+        recs = [r for r in ingestlog.entries()
+                if r["stages"]["wal_commit_wait_s"] >= delay * 0.5]
+        assert recs, "the slow batches must carry the fsync wait"
+        assert registry.histogram("ingest_ack_seconds", ws="fault",
+                                  origin="remote_write").max \
+            >= delay * 0.8
+        h = srv.api.handle("GET", "/api/v1/status/health",
+                           {}, b"")[1]["data"]
+        assert h["subsystems"]["ingest"]["status"] == "degraded"
+    finally:
+        srv.shutdown()
+        freshness.reset()
+        freshness.configure(threshold_s=5.0, breach_count=3,
+                            window_s=60.0)
+
+
+def test_openmetrics_route_carries_ingest_exemplar(server):
+    """The acceptance criterion end to end: after a traced write,
+    /metrics?format=openmetrics serves an exemplar on an ingest latency
+    histogram under the OpenMetrics content type, while plain /metrics
+    stays exemplar- and metadata-free."""
+    st, pay = server.api.handle("POST", "/api/v1/write", {},
+                                _write_payload(ws="omws"))
+    assert st == 204
+    tid = pay["_headers"]["X-Trace-Id"]
+    st, om = server.api.handle("GET", "/metrics",
+                               {"format": "openmetrics"}, b"")
+    assert st == 200
+    assert om.content_type.startswith("application/openmetrics-text")
+    assert om.endswith("# EOF\n")
+    ex_lines = [ln for ln in om.splitlines()
+                if ln.startswith("ingest_ack_seconds_bucket")
+                and f'# {{trace_id="{tid}"}}' in ln]
+    assert ex_lines, "ingest latency histogram must carry the exemplar"
+    st, plain = server.api.handle("GET", "/metrics", {}, b"")
+    assert "# " not in plain and "trace_id=" not in plain
+    # unknown formats are a clean 400
+    st, _ = server.api.handle("GET", "/metrics", {"format": "bogus"},
+                              b"")
+    assert st == 400
+
+
+# -------------------------------------------- trace-ring satellites
+
+
+def test_trace_collector_eviction_ring_and_counter():
+    c = TraceCollector(max_traces=3, max_events=8)
+    before = registry.counter("trace_evictions").value
+    for i in range(5):
+        c.record(f"t{i}", {"span": "s", "dur_s": 0.0})
+    assert c.trace_ids() == ["t2", "t3", "t4"]
+    assert c.was_evicted("t0") and c.was_evicted("t1")
+    assert not c.was_evicted("t3")
+    assert not c.was_evicted("never-seen")
+    assert registry.counter("trace_evictions").value == before + 2
+    # a re-recorded evicted id is live again
+    c.record("t0", {"span": "s", "dur_s": 0.0})
+    assert not c.was_evicted("t0")
+    # ...and a RE-eviction refreshes its ring slot instead of
+    # duplicating it: rotate the evicted ring fully (maxlen is
+    # 4*max_traces here, floored at 64) and t0 must still answer
+    # evicted (a deque duplicate would let the rotation discard the
+    # set entry early -> 404 where 410 was promised)
+    for t in ("tx", "ty", "tz"):          # t3, t4, then t0 evict again
+        c.record(t, {"span": "s", "dur_s": 0.0})
+    assert c.was_evicted("t0")
+    for i in range(c._evicted.maxlen):
+        c.record(f"fill{i}a", {"span": "s", "dur_s": 0.0})
+        c.record(f"fill{i}b", {"span": "s", "dur_s": 0.0})
+    assert len(c._evicted) == len(c._evicted_set) == c._evicted.maxlen
+    # origins evict alongside their traces
+    c.note_origin("t3", "query")
+    assert c.trace_ids(origin="query") == ["t3"]
+    for i in range(10, 14):
+        c.record(f"t{i}", {"span": "s", "dur_s": 0.0})
+    assert c.trace_ids(origin="query") == []
+
+
+def test_traces_route_410_gone_vs_404(monkeypatch, server):
+    from filodb_tpu.utils import metrics as m
+    small = TraceCollector(max_traces=2, max_events=8)
+    monkeypatch.setattr(m, "collector", small)
+    for i in range(4):
+        small.record(f"tr{i}", {"span": "s", "dur_s": 0.0,
+                                "end_unix_s": i})
+    st, _ = server.api.handle("GET", "/admin/traces/tr3", {}, b"")
+    assert st == 200
+    st, pay = server.api.handle("GET", "/admin/traces/tr0", {}, b"")
+    assert st == 410 and pay["errorType"] == "gone"
+    st, _ = server.api.handle("GET", "/admin/traces/nope", {}, b"")
+    assert st == 404
+
+
+def test_traces_list_limit_and_origin_filters(monkeypatch, server):
+    from filodb_tpu.utils import metrics as m
+    c = TraceCollector(max_traces=32, max_events=8)
+    monkeypatch.setattr(m, "collector", c)
+    for i in range(6):
+        c.record(f"q{i}", {"span": "s", "dur_s": 0.0})
+        c.note_origin(f"q{i}", "query")
+    c.record("w0", {"span": "s", "dur_s": 0.0})
+    c.note_origin("w0", "remote_write")
+    c.record("r0", {"span": "s", "dur_s": 0.0})
+    c.note_origin("r0", "rule_eval")
+    st, pay = server.api.handle("GET", "/admin/traces", {"limit": "3"},
+                                b"")
+    assert st == 200 and pay["data"] == ["q5", "w0", "r0"]
+    st, pay = server.api.handle("GET", "/admin/traces",
+                                {"origin": "query", "limit": "2"}, b"")
+    assert st == 200 and pay["data"] == ["q4", "q5"]
+    st, pay = server.api.handle("GET", "/admin/traces",
+                                {"origin": "rule_eval"}, b"")
+    assert st == 200 and pay["data"] == ["r0"]
+    st, _ = server.api.handle("GET", "/admin/traces",
+                              {"origin": "bogus"}, b"")
+    assert st == 400
+
+
+def test_query_traces_tagged_with_query_origin(server):
+    sh = server.memstore.get_shard("prometheus", 0)
+    from filodb_tpu.ingest.generator import gauge_batch
+    START = 1_600_000_000_000
+    sh.ingest(gauge_batch(4, 30, start_ms=START))
+    st, pay = server.api.handle(
+        "GET", "/api/v1/query_range",
+        {"query": "sum(heap_usage)", "start": str(START // 1000 + 60),
+         "end": str(START // 1000 + 300), "step": "60"}, b"")
+    assert st == 200 and pay.get("traceID")
+    ids = server.api.handle("GET", "/admin/traces",
+                            {"origin": "query"}, b"")[1]["data"]
+    assert pay["traceID"] in ids
+
+
+# --------------------------------------------------- replica lag age
+
+
+def test_replica_lag_seconds_tracks_behind_age():
+    from filodb_tpu.replication.replicator import _PeerState
+
+    class _DeadClient:
+        def append_record(self, *a, **k):
+            raise ConnectionError("dead")
+
+    st = _PeerState("peer1", _DeadClient(), "lagds", lag_threshold=4,
+                    queue_max=8)
+    g = registry.gauge("replica_lag_seconds", dataset="lagds",
+                       peer="peer1")
+    st.note_failure("dead")
+    assert st.behind_since > 0
+    assert st.snapshot()["lagSeconds"] >= 0.0
+    time.sleep(0.05)
+    st.note_failure("dead")
+    assert g.value >= 0.05
+    # repair clears both the debt and the age
+    st.note_repaired()
+    assert st.behind_since == 0.0 and g.value == 0.0
+    assert st.snapshot()["lagSeconds"] == 0.0

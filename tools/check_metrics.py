@@ -1,0 +1,214 @@
+"""Metric-hygiene gate: boot a test server, drive the main paths, and
+fail on any metric that is illegally named, collides across metric
+kinds, or is missing from doc/observability.md's reference table.
+
+The exposition grammar is already tier-1-gated
+(tests/test_metrics_exposition.py); this tool closes the remaining
+gaps a grammar check can't see:
+
+  * duplicate/colliding families — a counter `foo` exposes `foo_total`,
+    a histogram `foo` exposes `foo_bucket`/`foo_sum`/`foo_count`; a
+    second metric registered under one of those EXPOSED names silently
+    produces duplicate sample lines a Prometheus scraper drops;
+  * illegal names/labels that only appear under traffic (tag values are
+    escaped, tag NAMES are not — a bad tag name poisons every scrape);
+  * undocumented metrics — every live metric family must appear in the
+    `## Metrics reference` table in doc/observability.md (entries may
+    use `*` globs for per-name families like `span_*_seconds`), so the
+    operator-facing catalog can never silently rot behind the code.
+
+Run:  python tools/check_metrics.py            # exit 0 clean / 1 dirty
+      python tools/check_metrics.py --emit-table   # print a fresh table
+
+Wired as a tier-1 test (tests/test_check_metrics.py runs it in a
+subprocess so the walked registry holds exactly this boot's metrics).
+"""
+from __future__ import annotations
+
+import argparse
+import fnmatch
+import os
+import re
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+LABEL_RE = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
+DOC_PATH = os.path.join(REPO, "doc", "observability.md")
+TABLE_HEADER = "## Metrics reference"
+
+
+def boot_and_drive():
+    """A small standalone server + one pass over every major path:
+    remote_write (traced), influx, query_range (cold + warm), metadata,
+    a scrape, the self-scrape snapshot, a WAL commit, and a slow-batch
+    record — so the registry holds a representative live metric set."""
+    import tempfile
+
+    from filodb_tpu.config import FilodbSettings
+    from filodb_tpu.http import remotepb
+    from filodb_tpu.standalone import DatasetConfig, FiloServer
+    from filodb_tpu.utils import snappy as fsnappy
+
+    cfg = FilodbSettings()
+    cfg.wal.enabled = True
+    cfg.wal.dir = tempfile.mkdtemp(prefix="filodb-checkmetrics-wal-")
+    srv = FiloServer(datasets=[DatasetConfig("prometheus", num_shards=2)],
+                     config=cfg)
+    try:
+        now = int(time.time() * 1000)
+        series = []
+        for i in range(32):
+            labels = [("__name__", "hygiene_total"), ("_ws_", "hy"),
+                      ("_ns_", "check"), ("inst", f"i{i:03d}")]
+            samples = [(float(i + j), now - 60_000 + j * 10_000)
+                       for j in range(6)]
+            series.append(remotepb.PromTimeSeries(labels, samples))
+        payload = fsnappy.compress(remotepb.encode_write_request(series))
+        st, _ = srv.api.handle("POST", "/api/v1/write", {}, payload)
+        assert st == 204, f"remote_write drive got {st}"
+        st, _ = srv.api.handle(
+            "POST", "/influx/write", {},
+            b"gw,_ws_=hy,_ns_=check,inst=i0 value=1.5\n")
+        assert st in (204, 200), f"influx drive got {st}"
+        q = {"query": "sum(hygiene_total)",
+             "start": str(now // 1000 - 120), "end": str(now // 1000),
+             "step": "15"}
+        for _ in range(2):                      # cold + cached re-poll
+            st, _ = srv.api.handle("GET", "/api/v1/query_range",
+                                   dict(q), b"")
+            assert st == 200, f"query drive got {st}"
+        srv.api.handle("GET", "/api/v1/labels", {}, b"")
+        for fmt in ({}, {"format": "openmetrics"}):
+            st, _ = srv.api.handle("GET", "/metrics", dict(fmt), b"")
+            assert st == 200
+        # one registry self-snapshot (what the selfmon loop ingests)
+        from filodb_tpu.utils.metrics import registry
+        registry.snapshot_samples()
+        srv.memstore.get_shard("prometheus", 0).flush_all_groups()
+    finally:
+        srv.shutdown()
+    from filodb_tpu.utils.metrics import registry
+    return registry
+
+
+def live_families(registry):
+    """{(base_name, kind)} + the tag-name set, walked off the live
+    registry."""
+    fams = set()
+    labels = set()
+    with registry._lock:
+        keys = ([(n, t, "counter") for (n, t) in registry._counters]
+                + [(n, t, "gauge") for (n, t) in registry._gauges]
+                + [(n, t, "histogram") for (n, t) in registry._hists])
+    for name, tags, kind in keys:
+        fams.add((name, kind))
+        labels.update(k for k, _ in tags)
+    return fams, labels
+
+
+def exposed_names(name: str, kind: str):
+    if kind == "counter":
+        return [name + "_total"]
+    if kind == "histogram":
+        return [name + "_bucket", name + "_sum", name + "_count"]
+    return [name]
+
+
+def doc_table_names(doc_path: str = DOC_PATH):
+    """Backticked first-column entries of the `## Metrics reference`
+    table (globs allowed)."""
+    try:
+        with open(doc_path) as f:
+            text = f.read()
+    except OSError:
+        return None
+    if TABLE_HEADER not in text:
+        return None
+    section = text.split(TABLE_HEADER, 1)[1]
+    # the table runs until the next heading
+    section = re.split(r"\n## ", section, 1)[0]
+    return set(re.findall(r"^\|\s*`([^`]+)`", section, re.MULTILINE))
+
+
+def check(registry, doc_path: str = DOC_PATH):
+    """Returns the violation list (empty = clean)."""
+    fams, labels = live_families(registry)
+    violations = []
+    for name, kind in sorted(fams):
+        if not NAME_RE.match(name):
+            violations.append(f"illegal metric name: {name!r} ({kind})")
+    for lab in sorted(labels):
+        if not LABEL_RE.match(lab) or lab == "le":
+            # `le` is the histogram exposition's reserved label
+            violations.append(f"illegal/reserved label name: {lab!r}")
+    # cross-kind collisions on EXPOSED sample names
+    seen = {}
+    for name, kind in sorted(fams):
+        for exp in exposed_names(name, kind):
+            prev = seen.get(exp)
+            if prev is not None and prev != (name, kind):
+                violations.append(
+                    f"exposed-name collision: {exp!r} produced by both "
+                    f"{prev[1]} {prev[0]!r} and {kind} {name!r}")
+            seen[exp] = (name, kind)
+    documented = doc_table_names(doc_path)
+    if documented is None:
+        violations.append(
+            f"doc reference table missing: no {TABLE_HEADER!r} section "
+            f"in {doc_path}")
+        return violations
+    for name, kind in sorted(fams):
+        if not any(fnmatch.fnmatchcase(name, pat) for pat in documented):
+            violations.append(
+                f"undocumented metric: {kind} {name!r} absent from the "
+                f"{TABLE_HEADER!r} table in doc/observability.md")
+    return violations
+
+
+def emit_table(registry) -> str:
+    """A fresh markdown table skeleton off the live registry — the
+    starting point when the doc drifts far behind."""
+    fams, _ = live_families(registry)
+    # collapse the per-span families into their documented globs
+    rows = set()
+    for name, kind in fams:
+        if name.startswith("span_") and name.endswith("_seconds"):
+            rows.add(("span_*_seconds", "histogram"))
+        else:
+            rows.add((name, kind))
+    out = ["| metric | kind |", "|---|---|"]
+    out += [f"| `{n}` | {k} |" for n, k in sorted(rows)]
+    return "\n".join(out)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--doc", default=DOC_PATH)
+    ap.add_argument("--emit-table", action="store_true",
+                    help="print a fresh reference-table skeleton "
+                         "instead of checking")
+    args = ap.parse_args(argv)
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    registry = boot_and_drive()
+    if args.emit_table:
+        print(emit_table(registry))
+        return 0
+    violations = check(registry, args.doc)
+    if violations:
+        for v in violations:
+            print(f"check_metrics: {v}", file=sys.stderr)
+        print(f"check_metrics: {len(violations)} violation(s)",
+              file=sys.stderr)
+        return 1
+    fams, _ = live_families(registry)
+    print(f"check_metrics: OK ({len(fams)} live metric families)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -32,6 +32,15 @@ of every headline metric is greppable in one file:
     (gate: <= 2% at the default ``selfmon.interval_s``),
     ``selfmon_scrape_p50_s`` / ``selfmon_scrape_series``, and a loud
     ``selfmon_error`` when the stage fails.
+  - the write-path tracing numbers (PR 12):
+    ``ingest_trace_overhead_pct`` (gate: tracing-on >= 98% of
+    tracing-off on the remote_write door),
+    ``ingest_trace_stitched`` (gate: ONE 2-node trace covering door ->
+    WAL -> fsync wait -> fan-out -> replica WAL -> memstore ingest),
+    ``ingesttrace_fault_visible`` (an injected wal.fsync delay surfaces
+    in the fsync histogram + ingest slowlog + freshness histograms +
+    health), ``ingest_freshness_p99_s`` — plus a loud
+    ``ingesttrace_error``.
 
 Existing hand-written round entries are MERGED, never clobbered: only
 missing keys are added, so curated notes survive re-runs.
@@ -81,6 +90,14 @@ CARRY = [
     "chaos_availability", "chaos_partial_rate", "chaos_acked_lost",
     "chaos_p99_ratio", "chaos_wrong_full_results", "chaos_gate_ok",
     "chaos_error",
+    # write-path tracing (ISSUE 12): the span+exemplar pipeline's tax on
+    # the remote_write door (gate: tracing-on >= 98% of tracing-off),
+    # the stitched 2-node trace proof, the wal.fsync fault-visibility
+    # drill, and the ingest-to-ack p99 — plus a loud ingesttrace_error
+    "ingest_trace_overhead_pct", "ingest_trace_on_samples_per_sec",
+    "ingest_trace_stitched", "ingest_freshness_p99_s",
+    "ingesttrace_fault_visible", "ingesttrace_gate_ok",
+    "ingesttrace_error",
 ]
 RENAME = {"value": "headline_samples_per_sec",
           "p50_query_latency_s": "p50_s"}
