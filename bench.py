@@ -1276,6 +1276,246 @@ def measure_observability(quick=False, series=None):
     return st
 
 
+def measure_activequeries(quick=False, series=None):
+    """ISSUE-13 acceptance: live query introspection.
+
+    Two halves ride the one-line JSON:
+      activequeries_overhead_pct — the registry's tax on the
+        query_frontend concurrent-QPS workload (8 threads polling one
+        panel), registry ON vs OFF in interleaved pairs (gate: <= 2%).
+      the kill drill — a long COLD two-node query (all data flushed to
+        the column store; every leaf demand-pages) is listed in the
+        registry with live phase/counters on the coordinator AND the
+        remote node, then killed mid-execution: the client gets the
+        structured query_canceled, the concurrency slot frees (a
+        follow-up query admits without queue wait), and the remote
+        leaf's counters stop advancing (registry drains) within 250 ms.
+    """
+    import threading
+
+    from filodb_tpu.config import FilodbSettings
+    from filodb_tpu.query.activequeries import active_queries
+    from filodb_tpu.query.frontend import QueryFrontend
+
+    st = {}
+    # --- half 1: registry overhead on the concurrent-QPS workload ---
+    # cache and singleflight are DISABLED for the pump: a cache hit or
+    # dedup follower never registers (by design — it pays two thread-
+    # local writes), so the honest tax measurement needs every query to
+    # take the registration path: scheduler slot -> engine -> exec tree.
+    # The pump scale is pinned SMALL (per-query a few ms): the ratio
+    # needs thousands of queries per window to resolve a 2% gate — at
+    # 65k series a cache-off query costs ~1 s on CPU, so a 2 s pump
+    # would measure ~20 queries of noise, not a tax
+    S = series or 2_048
+    fe0, eng, q, start_s, end_s, pp = _frontend_fixture(S, 120, "bench_aq")
+    cfg = FilodbSettings()
+    cfg.query.result_cache_enabled = False
+    cfg.query.singleflight_enabled = False
+    cfg.query.tenant_usage_enabled = False
+    fe = QueryFrontend(eng, config=cfg)
+    r = fe.query_range(q, start_s, 60, end_s, pp)
+    if r.error:
+        return {"series": S, "error": r.error[:200]}
+    st["series"] = S
+    dur_s = 1.0 if quick else 3.0
+    errors = []
+
+    def pump():
+        counts = []
+        stop_t = time.perf_counter() + dur_s
+
+        def client():
+            n = 0
+            while time.perf_counter() < stop_t:
+                res = fe.query_range(q, start_s, 60, end_s, pp)
+                if res.error is not None:
+                    errors.append(res.error)
+                    break
+                n += 1
+            counts.append(n)
+
+        threads = [threading.Thread(target=client) for _ in range(8)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        return sum(counts) / max(time.perf_counter() - t0, 1e-9)
+
+    on, off = [], []
+    try:
+        # alternate which mode leads each pair: a monotone warm-up
+        # drift across the run must not systematically favor the
+        # second-of-pair mode
+        for i in range(2 if quick else 5):
+            for enabled in ((True, False) if i % 2 == 0
+                            else (False, True)):
+                active_queries.configure(enabled=enabled)
+                (on if enabled else off).append(pump())
+    finally:
+        active_queries.configure(enabled=True)
+    if errors:
+        st["error"] = f"pump: {errors[0]}"[:200]
+        return st
+    on.sort(); off.sort()
+    st["qps_registry_on"] = round(on[len(on) // 2], 1)
+    st["qps_registry_off"] = round(off[len(off) // 2], 1)
+    st["activequeries_overhead_pct"] = round(
+        100.0 * (st["qps_registry_off"] - st["qps_registry_on"])
+        / max(st["qps_registry_off"], 1e-9), 2)
+
+    # --- half 2: the end-to-end kill drill ---
+    drill = _activequeries_kill_drill(quick=quick)
+    st.update(drill)
+    st["activequeries_gate_ok"] = bool(
+        drill.get("activequeries_kill_structured")
+        and drill.get("activequeries_listed_remote")
+        and drill.get("activequeries_slot_freed")
+        and (quick or (st["activequeries_overhead_pct"] <= 2.0
+                       and drill.get("activequeries_stop_ms", 1e9)
+                       <= 250.0)))
+    return st
+
+
+def _activequeries_kill_drill(quick=False):
+    """Two in-process nodes over the real cross-node transport, every
+    shard COLD (flushed to a column store, memstore recovered from the
+    index only), a frontend coordinator with ONE concurrency slot — the
+    'query eating the node' scenario the runbook kills."""
+    import threading
+
+    from filodb_tpu.config import FilodbSettings
+    from filodb_tpu.core.memstore import TimeSeriesMemStore
+    from filodb_tpu.core.store import InMemoryColumnStore, InMemoryMetaStore
+    from filodb_tpu.gateway.router import split_batch_by_shard
+    from filodb_tpu.ingest.generator import gauge_batch
+    from filodb_tpu.parallel.shardmapper import (ShardEvent, ShardMapper,
+                                                 SpreadProvider)
+    from filodb_tpu.parallel.transport import (NodeQueryServer,
+                                               RemoteNodeDispatcher)
+    from filodb_tpu.query.activequeries import active_queries
+    from filodb_tpu.query.engine import QueryEngine
+    from filodb_tpu.query.frontend import QueryFrontend
+    from filodb_tpu.query.planner import SingleClusterPlanner
+    from filodb_tpu.query.rangevector import PlannerParams
+
+    S = 1_024 if quick else 8_192
+    T = 240
+    num_shards = 4
+    mapper = ShardMapper(num_shards)
+    spread = SpreadProvider(default_spread=1)
+    owner = {s: ("nodeA" if s < num_shards // 2 else "nodeB")
+             for s in range(num_shards)}
+    batch = gauge_batch(S, T)
+    cold_stores = {}
+    for node in ("nodeA", "nodeB"):
+        cs, meta = InMemoryColumnStore(), InMemoryMetaStore()
+        warm = TimeSeriesMemStore(column_store=cs, meta_store=meta)
+        for s, n in owner.items():
+            if n == node:
+                warm.setup("prometheus", s)
+                mapper.update_from_event(
+                    ShardEvent("IngestionStarted", "prometheus", s, n))
+        for s, sub in split_batch_by_shard(batch, mapper, spread).items():
+            if owner[s] == node:
+                warm.get_shard("prometheus", s).ingest(sub)
+        for s, n in owner.items():
+            if n == node:
+                warm.get_shard("prometheus", s).flush_all_groups()
+        # the COLD node: index recovered, zero resident samples — every
+        # query demand-pages through the cancellable loop
+        cold = TimeSeriesMemStore(column_store=cs, meta_store=meta)
+        for s, n in owner.items():
+            if n == node:
+                cold.setup("prometheus", s).recover_index()
+        cold_stores[node] = cold
+    servers = {n: NodeQueryServer(st_).start()
+               for n, st_ in cold_stores.items()}
+    dispatchers = {n: RemoteNodeDispatcher(*srv.address)
+                   for n, srv in servers.items()}
+    planner = SingleClusterPlanner(
+        "prometheus", mapper, spread,
+        dispatcher_factory=lambda s: dispatchers[owner[s]])
+    eng = QueryEngine("prometheus", TimeSeriesMemStore(), mapper,
+                      planner=planner)
+    cfg = FilodbSettings()
+    cfg.query.max_concurrent_queries = 1
+    cfg.query.result_cache_enabled = False
+    cfg.query.tenant_usage_enabled = False
+    fe = QueryFrontend(eng, config=cfg)
+    pp = PlannerParams(sample_limit=2_000_000_000,
+                       scan_limit=2_000_000_000)
+    s0 = 1_600_000_000
+    out = {}
+    res_box = {}
+
+    def victim():
+        res_box["res"] = fe.query_range(
+            "avg by (_ns_)(avg_over_time(heap_usage[5m]))",
+            s0 + 300, 30, s0 + (T - 1) * 10, pp)
+
+    try:
+        t = threading.Thread(target=victim)
+        t.start()
+        # wait for the distributed query to be LIVE: the coordinator
+        # entry past the queue AND a remote-role entry with counters
+        listed_remote = False
+        coord_ent = None
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline:
+            ents = active_queries.entries()
+            for e in ents:
+                if e.role == "frontend" and e.phase in ("executing",
+                                                        "gathering"):
+                    coord_ent = e
+                if e.role == "remote":
+                    listed_remote = True
+            if coord_ent is not None and listed_remote:
+                break
+            time.sleep(0.002)
+        out["activequeries_listed_remote"] = bool(
+            coord_ent is not None and listed_remote)
+        if coord_ent is None:
+            out["activequeries_error"] = \
+                "victim query never reached execution"
+            return out
+        t_kill = time.perf_counter()
+        fe_kill = active_queries.kill(coord_ent.query_id, reason="admin")
+        out["activequeries_kill_fanout_nodes"] = \
+            len(fe_kill.get("remoteNodes", []))
+        t.join(timeout=30)
+        out["activequeries_kill_to_client_ms"] = round(
+            (time.perf_counter() - t_kill) * 1e3, 1)
+        res = res_box.get("res")
+        out["activequeries_kill_structured"] = bool(
+            res is not None and res.error is not None
+            and res.error.startswith("query_canceled"))
+        # remote leaves must STOP: all entries under the id drain (their
+        # counters cannot advance after deregistration)
+        stop_deadline = time.monotonic() + 5.0
+        while active_queries.get(coord_ent.query_id) \
+                and time.monotonic() < stop_deadline:
+            time.sleep(0.002)
+        out["activequeries_stop_ms"] = round(
+            (time.perf_counter() - t_kill) * 1e3, 1)
+        out["activequeries_remote_drained"] = \
+            not active_queries.get(coord_ent.query_id)
+        # the slot freed: a follow-up query admits with no queue wait
+        # (1-slot semaphore — a leaked slot would park it for the full
+        # ask timeout)
+        res2 = fe.query_range("count(heap_usage)", s0 + 300, 60,
+                              s0 + 600, pp)
+        out["activequeries_followup_queue_wait_s"] = round(
+            res2.stats.queue_wait_s, 4)
+        out["activequeries_slot_freed"] = bool(
+            res2.error is None and res2.stats.queue_wait_s < 0.5)
+    finally:
+        for srv in servers.values():
+            srv.stop()
+    return out
+
+
 def measure_selfmon(quick=False, series=None):
     """ISSUE-10 acceptance: self-scrape meta-monitoring must cost <= 2%
     of the concurrent-QPS number at the default `selfmon.interval_s`.
@@ -2558,7 +2798,8 @@ def parse_args(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("stage", nargs="?", default="",
                     choices=["", "chaos", "multichip", "wal", "longrange",
-                             "selfmon", "replication", "ingesttrace"],
+                             "selfmon", "replication", "ingesttrace",
+                             "activequeries"],
                     help="optional standalone stage: 'chaos' runs the "
                          "failure-domain chaos harness (SIGKILL one of "
                          "three RF-2 data nodes mid-traffic; gates "
@@ -2590,7 +2831,13 @@ def parse_args(argv=None):
                          "wal.fsync fault-visibility drill) and exits "
                          "nonzero when tracing-on falls under 98% of "
                          "tracing-off or the trace/fault evidence is "
-                         "missing")
+                         "missing; 'activequeries' runs the live-"
+                         "introspection stage (registry tax on "
+                         "concurrent QPS, gate <= 2%, plus the two-node "
+                         "cold-query kill drill: structured "
+                         "query_canceled, slot freed, remote drained "
+                         "within 250 ms) and exits nonzero on a gate "
+                         "failure")
     ap.add_argument("--quick", action="store_true",
                     help="small config for smoke runs")
     ap.add_argument("--series", type=int, default=0)
@@ -2674,6 +2921,17 @@ def assemble_result(platform, stages, vec_sps, it_sps, c_sps=0.0,
         # query_frontend QPS number (gate: <= 5%)
         result["span_overhead_pct"] = obs["span_overhead_pct"]
         result["observability_stats_ok"] = obs.get("stats_phases_ok")
+    aq = stages.get("activequeries", {})
+    for k in ("activequeries_overhead_pct", "activequeries_gate_ok",
+              "activequeries_kill_structured", "activequeries_stop_ms",
+              "activequeries_slot_freed", "activequeries_listed_remote",
+              "activequeries_kill_to_client_ms"):
+        if k in aq:
+            # ISSUE-13 acceptance: registry tax on concurrent QPS
+            # (gate <= 2%) + the kill-drill evidence
+            result[k] = aq[k]
+    if "error" in aq:
+        result["activequeries_error"] = aq["error"]
     sm = stages.get("selfmon", {})
     for k in ("selfmon_overhead_pct", "selfmon_scrape_p50_s",
               "selfmon_scrape_series", "selfmon_gate_ok"):
@@ -2895,6 +3153,17 @@ def run_worker(args):
     except Exception as e:  # noqa: BLE001 — must not sink the run
         writer.stage("observability",
                      {"error": f"{type(e).__name__}: {e}"[:300]})
+
+    try:
+        # live-introspection stage (ISSUE 13): registry tax on the
+        # concurrent-QPS workload (gate: <= 2%) + the two-node cold-
+        # query kill drill
+        aq = measure_activequeries(quick=quick)
+        writer.stage("activequeries", aq)
+        stages["activequeries"] = aq
+    except Exception as e:  # noqa: BLE001 — must not sink the run
+        stages["activequeries"] = {"error": f"{type(e).__name__}: {e}"[:300]}
+        writer.stage("activequeries", stages["activequeries"])
 
     try:
         # self-observability stage (ISSUE 10): self-scrape overhead on
@@ -3164,6 +3433,30 @@ def main():
         # the 2% throughput tax is judged at FULL scale only (quick's
         # toy batches cannot average out scheduler noise)
         sys.exit(0 if it.get("ingesttrace_gate_ok") else 1)
+    if args.stage == "activequeries":
+        # standalone live-introspection stage: CPU-pinned (it measures
+        # registry/kill machinery, not kernels); prints the one-line
+        # activequeries JSON and exits nonzero when a gate fails
+        # (loud-fail contract like selfmon/ingesttrace)
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        try:
+            aq = measure_activequeries(quick=args.quick,
+                                       series=args.series or None)
+        except Exception as e:  # noqa: BLE001 — loud one-line fail
+            print(json.dumps({
+                "metric": "activequeries_overhead_pct", "unit": "%",
+                "activequeries_error": f"{type(e).__name__}: {e}"[:300]}))
+            sys.exit(1)
+        aq = {"metric": "activequeries_overhead_pct", "unit": "%",
+              "value": aq.get("activequeries_overhead_pct"), **aq}
+        if "error" in aq:
+            aq["activequeries_error"] = aq["error"]
+        print(json.dumps(aq))
+        # the kill-drill correctness gates always hold; the 2% overhead
+        # and 250 ms drain ratios are judged at FULL scale only (quick's
+        # short pumps are too noisy)
+        sys.exit(0 if "error" not in aq
+                 and aq.get("activequeries_gate_ok") else 1)
     if args.stage == "chaos":
         # standalone failure-domain stage: runs IN THIS process (CPU-
         # pinned; chaos measures degradation machinery, not kernels),

@@ -338,6 +338,46 @@ def cmd_shards(args) -> int:
     return 0
 
 
+def cmd_queries(args) -> int:
+    """Live query introspection over HTTP: list the in-flight queries
+    (GET /admin/queries) once or continuously (`--follow`), or kill one
+    (`--kill <id>` -> POST /admin/queries/<id>/kill) — the operator's
+    "a query is eating the node" loop (doc/operations.md runbook)."""
+    if args.kill:
+        payload = _http_get(args.host,
+                            f"/admin/queries/{args.kill}/kill",
+                            {"reason": args.reason}, data=b"")
+        print(json.dumps(payload, indent=2))
+        return 0 if payload.get("status") == "success" and \
+            payload.get("data", {}).get("killed") else 1
+    while True:
+        params = {"tenant": args.tenant} if args.tenant else {}
+        payload = _http_get(args.host, "/admin/queries", params)
+        if payload.get("status") != "success":
+            print(json.dumps(payload, indent=2))
+            return 1
+        if args.raw:
+            print(json.dumps(payload, indent=2))
+        else:
+            rows = payload["data"]["queries"]
+            print(f"{'QUERY_ID':<34} {'WS':<10} {'ORIGIN':<10} "
+                  f"{'ROLE':<8} {'PHASE':<10} {'AGE_S':>8} "
+                  f"{'SAMPLES':>12} {'PAGED_B':>10} {'DISP':>5}  PROMQL")
+            for q in rows:
+                c = q["counters"]
+                print(f"{q['queryID']:<34} "
+                      f"{q['tenant']['ws'] or '-':<10} "
+                      f"{q['origin']:<10} {q['role']:<8} "
+                      f"{q['phase']:<10} {q['ageSeconds']:>8.2f} "
+                      f"{c['samplesScanned']:>12} "
+                      f"{c['bytesPaged']:>10} "
+                      f"{c['deviceDispatches']:>5}  "
+                      f"{q['promql'][:60]}")
+        if not args.follow:
+            return 0
+        time.sleep(args.interval)
+
+
 def cmd_events(args) -> int:
     """Tail the structured event journal over HTTP (GET /admin/events):
     newest events once, from a sequence number (`--since-seq`), or
@@ -694,6 +734,23 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--raw", action="store_true",
                     help="print the raw JSON payload")
     sp.set_defaults(fn=cmd_shards)
+
+    sp = sub.add_parser("queries", help="live in-flight queries over "
+                                        "HTTP (list / --follow / --kill)")
+    sp.add_argument("--host", required=True)
+    sp.add_argument("--kill", default="",
+                    help="kill this query id instead of listing")
+    sp.add_argument("--reason", default="admin",
+                    choices=["admin", "disconnect", "deadline"],
+                    help="kill-reason tag for queries_killed_total")
+    sp.add_argument("--tenant", default="",
+                    help="only queries of this workspace")
+    sp.add_argument("--follow", action="store_true",
+                    help="poll continuously")
+    sp.add_argument("--interval", type=float, default=1.0,
+                    help="poll interval with --follow (seconds)")
+    sp.add_argument("--raw", action="store_true", help="raw JSON")
+    sp.set_defaults(fn=cmd_queries)
 
     sp = sub.add_parser("events", help="tail the event journal over HTTP")
     sp.add_argument("--host", required=True)

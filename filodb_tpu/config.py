@@ -127,6 +127,20 @@ class QueryConfig:
     # has no reply channel, drops WITH per-reason accounting
     # (`tenant_ingest_rejections` + the gateway drop log).  0 = no limit.
     tenant_ingest_samples_limit: int = 0
+    # --- live query introspection (query/activequeries.py; PR 13) ---
+    # the active-query registry: every query listable at
+    # GET /admin/queries from admission to completion and killable via
+    # POST /admin/queries/<id>/kill (cooperative CancellationToken,
+    # propagated to remote leaf nodes as kill frames).  Disabling turns
+    # registration into a no-op (kill/introspection unavailable).
+    active_queries_enabled: bool = True
+    # crash-durable active-query file (the Prometheus
+    # --query.active-query-tracker pattern): entries appended at
+    # admission, tombstoned at completion; on boot, leftovers are
+    # journaled as `query_active_at_crash` events so "what was running
+    # when the node died" is answerable.  "" disables; FiloServer
+    # defaults it under the WAL dir when one is configured.
+    active_query_log_path: str = ""
 
 
 @dataclasses.dataclass

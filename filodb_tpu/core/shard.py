@@ -1192,7 +1192,8 @@ class TimeSeriesShard:
 
     def ensure_paged_pids(self, schema_name: str, pids: np.ndarray,
                           start_time_ms: int, end_time_ms: int,
-                          max_samples: Optional[int] = None) -> int:
+                          max_samples: Optional[int] = None,
+                          cancel=None) -> int:
         """Vectorized ensure_paged precheck: computes which pids actually
         need on-demand paging with numpy over the whole pid array, then runs
         the per-partition paging loop only on that (usually empty) subset —
@@ -1220,11 +1221,12 @@ class TimeSeriesShard:
         parts = [self.partitions[p] for p in np.asarray(pids)[need].tolist()]
         with self._write_locked("demand_paging"):
             return self.ensure_paged(parts, start_time_ms, end_time_ms,
-                                     max_samples=max_samples)
+                                     max_samples=max_samples, cancel=cancel)
 
     def ensure_paged(self, parts: Sequence[PartitionInfo],
                      start_time_ms: int, end_time_ms: int,
-                     max_samples: Optional[int] = None) -> int:
+                     max_samples: Optional[int] = None,
+                     cancel=None) -> int:
         """On-demand paging: load persisted chunks not in the in-memory
         working set so the query sees full history (ref:
         OnDemandPagingShard.scala:27-39, DemandPagedChunkStore.scala:17-34).
@@ -1275,6 +1277,13 @@ class TimeSeriesShard:
             # it is valid cache for a narrower retry.
             if max_samples is not None and paged > max_samples:
                 raise PagedLimitExceeded(max_samples, paged, parts_paged)
+            # cooperative cancellation (query/activequeries.py): a killed
+            # query stops paging between partitions; the callable raises
+            # the caller's structured error (the shard stays query-layer
+            # agnostic).  Paged work is kept — valid cache, like the
+            # scan-limit abort above.
+            if cancel is not None:
+                cancel()
             store = self.stores[info.schema_name]
             row = info.row
             cnt = int(store.counts[row])

@@ -156,6 +156,8 @@ class PromHttpApi:
             if parts[:2] == ["admin", "shards"] and len(parts) == 4 \
                     and parts[3] == "handoff" and method == "POST":
                 return self._shard_handoff(parts[2], params, body)
+            if parts[:2] == ["admin", "queries"] and len(parts) <= 4:
+                return self._active_queries(parts[2:], params, method)
             if parts == ["admin", "events"] and method == "GET":
                 return self._events(params)
             if parts == ["admin", "rules", "reload"] and method == "POST":
@@ -699,6 +701,10 @@ class PromHttpApi:
                     registry.gauge("append_horizon_lag_seconds",
                                    **tags).update(
                         (now_ms - horizon) / 1000.0)
+        # live per-tenant query-load gauges (PR 13): refreshed at scrape
+        # like the shard gauges — the serving hot path only bumps dicts
+        from filodb_tpu.query.activequeries import active_queries
+        active_queries.refresh_gauges()
         # jit compile-cache sizes (device-side accounting, PR 3): a
         # compile storm — new shapes forcing fresh XLA compiles per
         # query — shows as these gauges climbing scrape over scrape,
@@ -852,6 +858,50 @@ class PromHttpApi:
             self.health.draining = (f"shard {shard} handed off to "
                                     f"{to_node}")
         return 200, {"status": "success", "data": summary}
+
+    def _active_queries(self, rest: List[str], params: Dict[str, str],
+                        method: str) -> Tuple[int, object]:
+        """Live query introspection (query/activequeries.py):
+
+        - GET /admin/queries — every in-flight query on this node
+          (coordinator entries AND remote-leaf executions), with phase,
+          age, tenant, live counters, and remote child nodes.
+          ?tenant=<ws> narrows to one workspace.
+        - GET /admin/queries/<id> — the entries under one query id.
+        - POST /admin/queries/<id>/kill — cooperative kill: flips the
+          CancellationToken locally and propagates kill frames to the
+          recorded remote children (?reason= tags the metric; default
+          admin).  Idempotent: an unknown or already-finished id answers
+          404 / killed=false instead of erroring.
+        """
+        from filodb_tpu.query.activequeries import active_queries
+        if not rest and method == "GET":
+            rows = active_queries.snapshot()
+            want = params.get("tenant", "")
+            if want:
+                rows = [r for r in rows if r["tenant"]["ws"] == want]
+            return 200, {"status": "success",
+                         "data": {"count": len(rows), "queries": rows}}
+        if len(rest) == 1 and method == "GET":
+            ents = active_queries.get(rest[0])
+            if not ents:
+                return 404, _err(f"no active query {rest[0]!r}")
+            return 200, {"status": "success",
+                         "data": {"queries": [e.to_dict() for e in ents]}}
+        if len(rest) == 2 and rest[1] == "kill" and method == "POST":
+            qid = rest[0]
+            if not active_queries.get(qid):
+                return 404, _err(f"no active query {qid!r} "
+                                 "(already completed, or never ran here)")
+            reason = params.get("reason", "admin")
+            if reason not in ("admin", "disconnect", "deadline"):
+                raise _BadRequest(f"unknown kill reason {reason!r} "
+                                  "(admin | disconnect | deadline)")
+            out = active_queries.kill(qid, reason=reason,
+                                      detail="POST /admin/queries/kill")
+            return 200, {"status": "success", "data": out}
+        return 404, _err(f"unknown queries action {'/'.join(rest)!r} "
+                         f"({method})")
 
     def _events(self, params: Dict[str, str]) -> Tuple[int, object]:
         """Structured event journal (utils/events.py): typed lifecycle
@@ -1013,8 +1063,19 @@ class PromHttpApi:
                                       "(raise max_traces or export "
                                       "spans via trace_export_url)"}
             return 404, _err(f"no trace {trace_id!r}")
-        return 200, {"status": "success",
-                     "data": {"traceID": trace_id, "spans": evs}}
+        data = {"traceID": trace_id, "queryID": trace_id, "spans": evs}
+        # cross-links (PR 13): the final verdict (completed/killed/
+        # deadline) and, when this query also left a slowlog record, its
+        # ring seq — so trace <-> slowlog correlation works BOTH ways
+        # instead of being a manual join
+        verdict = collector.verdict(trace_id)
+        if verdict:
+            data["verdict"] = verdict
+        from filodb_tpu.utils.slowlog import slowlog
+        seq = slowlog.seq_for_trace(trace_id)
+        if seq is not None:
+            data["slowlogSeq"] = seq
+        return 200, {"status": "success", "data": data}
 
     def _traced_filters(self, body: bytes) -> Tuple[int, object]:
         """Set per-series debug-follow filters on every local shard (ref:

@@ -57,9 +57,17 @@ class FiloHttpServer:
                     params = {**form, **params}
                     multi = {**form_multi, **multi}
                     body = b""
-                status, payload = api_ref.handle(method, parsed.path, params,
-                                                 body, multi_params=multi,
-                                                 headers=dict(self.headers))
+                # bind the client socket for the duration of the request
+                # so a query registered on this thread carries it: the
+                # disconnect watcher (query/activequeries.py) detects the
+                # peer closing mid-query and trips the CancellationToken
+                # — abandoned dashboard polls stop consuming the
+                # concurrency semaphore and device time
+                from filodb_tpu.query.activequeries import bind_client_conn
+                with bind_client_conn(self.connection):
+                    status, payload = api_ref.handle(
+                        method, parsed.path, params, body,
+                        multi_params=multi, headers=dict(self.headers))
                 extra_headers = {}
                 if isinstance(payload, bytes):      # binary (remote-read)
                     blob = payload
@@ -77,14 +85,21 @@ class FiloHttpServer:
                         extra_headers.update(payload.pop("_headers"))
                     blob = b"" if status == 204 else json.dumps(payload).encode()
                     ctype = "application/json"
-                self.send_response(status)
-                self.send_header("Content-Type", ctype)
-                for k, v in extra_headers.items():
-                    self.send_header(k, v)
-                self.send_header("Content-Length", str(len(blob)))
-                self.end_headers()
-                if blob:
-                    self.wfile.write(blob)
+                try:
+                    self.send_response(status)
+                    self.send_header("Content-Type", ctype)
+                    for k, v in extra_headers.items():
+                        self.send_header(k, v)
+                    self.send_header("Content-Length", str(len(blob)))
+                    self.end_headers()
+                    if blob:
+                        self.wfile.write(blob)
+                except (BrokenPipeError, ConnectionResetError):
+                    # the client hung up mid-request — routine since the
+                    # disconnect watcher aborts abandoned queries (their
+                    # canceled response has nowhere to go); the stdlib
+                    # handler would traceback to stderr on every one
+                    self.close_connection = True
 
             def do_GET(self):       # noqa: N802 — BaseHTTPRequestHandler API
                 self._serve("GET")

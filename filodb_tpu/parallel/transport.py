@@ -24,6 +24,12 @@ from filodb_tpu.query.exec import PlanDispatcher, QueryResultLike
 from filodb_tpu.query.rangevector import QueryStats
 
 _MAGIC = b"FQ01"
+# control-plane kill frame: payloads with this prefix carry a JSON kill
+# request ({"id", "reason"}) instead of a serialized plan — recognized
+# BEFORE serialize.loads, so a kill lands on a node whose handler
+# threads are all busy executing (ThreadingTCPServer: the kill arrives
+# on its own fresh connection)
+_KILL_MAGIC = b"FKILL1"
 
 
 def _send_frame(sock: socket.socket, payload: bytes) -> None:
@@ -87,11 +93,39 @@ class NodeQueryServer:
                 try:
                     while True:
                         payload = _recv_frame(self.request)
+                        if payload.startswith(_KILL_MAGIC):
+                            # cross-node cooperative cancellation: flip
+                            # every token registered under the id on
+                            # THIS node (idempotent; an already-
+                            # completed child answers killed=False)
+                            _send_frame(self.request,
+                                        outer._handle_kill(payload))
+                            continue
+                        ent = None
+                        verdict = "completed"
                         try:
+                            from filodb_tpu.query.activequeries import \
+                                active_queries
                             from filodb_tpu.utils.metrics import (
                                 collector, span, trace_context)
                             plan = serialize.loads(payload)
                             tid = getattr(plan.ctx, "query_id", "")
+                            # register the dispatched subtree in the
+                            # LOCAL active-query registry under the
+                            # coordinator's query id: one id names the
+                            # whole distributed query, and a kill frame
+                            # keyed by it stops this leaf's scan
+                            if tid:
+                                ent = active_queries.register(
+                                    tid,
+                                    promql=(f"[remote] "
+                                            f"{type(plan).__name__}"
+                                            f"({plan.args_str()})")[:300],
+                                    origin="remote", role="remote")
+                                if ent is not None:
+                                    plan.ctx.cancel = ent.token
+                                    plan.ctx.active = ent
+                                    ent.set_phase("executing")
                             # execute under the CALLER's trace id so this
                             # node's spans stitch into the same trace; ship
                             # them back with the reply (the Kamon-context-
@@ -115,10 +149,21 @@ class NodeQueryServer:
                                 reply = serialize.dumps(
                                     {"ok": False, "error_code": e.code,
                                      "error": str(e)})
+                                verdict = ("killed"
+                                           if e.code == "query_canceled"
+                                           else "deadline"
+                                           if e.code == "query_timeout"
+                                           else "error")
                             else:
                                 reply = serialize.dumps(
                                     {"ok": False,
                                      "error": f"{type(e).__name__}: {e}"})
+                                verdict = "error"
+                        finally:
+                            if ent is not None:
+                                from filodb_tpu.query.activequeries \
+                                    import active_queries
+                                active_queries.deregister(ent, verdict)
                         _send_frame(self.request, reply)
                 except (ConnectionError, OSError):
                     return              # client went away
@@ -129,6 +174,24 @@ class NodeQueryServer:
 
         self._server = _Server((host, port), _Handler)
         self._thread: Optional[threading.Thread] = None
+
+    @staticmethod
+    def _handle_kill(payload: bytes) -> bytes:
+        """Serve one kill frame: flip the tokens registered under the
+        query id and report what happened (killed=False for an unknown
+        or already-completed id — the idempotent contract)."""
+        from filodb_tpu.query.activequeries import active_queries
+        try:
+            req = json.loads(payload[len(_KILL_MAGIC):].decode("utf-8"))
+            out = active_queries.kill(str(req.get("id", "")),
+                                      reason=str(req.get("reason",
+                                                         "admin")),
+                                      detail="kill frame from coordinator")
+            return serialize.dumps({"ok": True, "data": out,
+                                    "stats": None})
+        except Exception as e:  # noqa: BLE001 — a bad kill frame must not
+            return serialize.dumps(  # kill the handler connection
+                {"ok": False, "error": f"{type(e).__name__}: {e}"})
 
     @property
     def address(self) -> Tuple[str, int]:
@@ -156,6 +219,24 @@ class NodeQueryServer:
                 pass
         if self._thread:
             self._thread.join(timeout=5)
+
+
+def send_kill(host: str, port: int, query_id: str, reason: str = "admin",
+              timeout_s: float = 2.0) -> dict:
+    """Ship one kill frame to a remote node on a FRESH connection (the
+    pooled dispatcher sockets are per-thread and may be blocked inside
+    the very round-trip the kill is meant to cut short).  Returns the
+    node's kill verdict dict; raises on transport failure (the caller
+    counts propagation errors — a dead child needs no kill)."""
+    payload = _KILL_MAGIC + json.dumps(
+        {"id": query_id, "reason": reason}).encode("utf-8")
+    with socket.create_connection((host, port), timeout=timeout_s) as s:
+        s.settimeout(timeout_s)
+        _send_frame(s, payload)
+        reply = serialize.loads(_recv_frame(s))
+    if not reply.get("ok"):
+        raise ConnectionError(f"kill frame rejected: {reply.get('error')}")
+    return reply.get("data") or {}
 
 
 class RemoteNodeDispatcher(PlanDispatcher):
@@ -219,6 +300,12 @@ class RemoteNodeDispatcher(PlanDispatcher):
         from filodb_tpu.parallel.breaker import breakers
         from filodb_tpu.query.execbase import QueryError
         where = f"{self.host}:{self.port}"
+        # record the child node on the query's live registry entry
+        # BEFORE any wire I/O: a kill issued while this hop is blocked
+        # in its round-trip must know where to send the kill frame
+        act = getattr(plan.ctx, "active", None)
+        if act is not None:
+            act.note_remote(where)
         dl = getattr(plan.ctx, "deadline_unix_s", 0.0)
         allow_partial = getattr(plan.ctx.planner_params,
                                 "allow_partial_results", False)
@@ -404,6 +491,14 @@ class RemoteNodeDispatcher(PlanDispatcher):
                 if isinstance(ev, dict):
                     collector.record(tid, ev)
         stats = reply["stats"] or QueryStats()
+        # live-counter mirror: the remote leaf's scan work lands on the
+        # coordinator's registry entry too (its own entry on the remote
+        # node deregisters with the reply), so /admin/queries on the
+        # coordinator shows the whole distributed query's burn
+        if act is not None:
+            act.add(samples=stats.samples_scanned,
+                    paged_samples=stats.samples_paged,
+                    paged_bytes=stats.bytes_paged)
         # resource attribution across the wire (PR 3): the remote's own
         # phase seconds arrived inside `stats`; the round trip minus the
         # remote's busy time is serialization + network — transfer.  The

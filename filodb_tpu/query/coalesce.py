@@ -88,10 +88,33 @@ class QueryCoalescer:
             # The follower's deadline bounds the wait too — the solo
             # fallback then returns the structured query_timeout from
             # the exec-boundary check instead of blocking past budget.
+            # The wait is sliced against the follower's OWN cancel token
+            # (it is registered and holds a scheduler slot while parked
+            # here): a kill/disconnect frees the slot within ~50 ms
+            # instead of riding out the leader.
+            from filodb_tpu.query.activequeries import peek_admission
             from filodb_tpu.query.rangevector import remaining_budget
             bound = remaining_budget(planner_params,
                                      max(300.0, 10 * self.window_s))
-            completed = grp.done.wait(timeout=bound)
+            ent = peek_admission()
+            tok = ent.token if ent is not None else None
+            if tok is None:
+                completed = grp.done.wait(timeout=bound)
+            else:
+                deadline = time.perf_counter() + bound
+                completed = False
+                while not completed:
+                    if tok.cancelled:
+                        from filodb_tpu.query.rangevector import \
+                            QueryResult
+                        return QueryResult(
+                            [], error=("query_canceled: query killed "
+                                       "waiting on a coalesce leader "
+                                       f"(reason={tok.reason or 'admin'})"))
+                    left = deadline - time.perf_counter()
+                    if left <= 0:
+                        break
+                    completed = grp.done.wait(timeout=min(left, 0.05))
         if grp.error is not None or grp.results is None:
             # batch failed (or leader timed out): run alone
             res = self.engine.query_range(promql, start_s, step_s, end_s,
@@ -110,10 +133,12 @@ class QueryCoalescer:
             return res
         res = grp.results[idx]
         if not leader and res is not None and res.error is not None \
-                and res.error.startswith("query_timeout"):
-            # the LEADER's budget expired, not this follower's (budgets
-            # are repr-excluded from the group key): re-run solo under
-            # our own deadline instead of inheriting the expiry
+                and (res.error.startswith("query_timeout")
+                     or res.error.startswith("query_canceled")):
+            # the LEADER's budget expired or it was killed — not this
+            # follower (budgets/kills are per-request, repr-excluded
+            # from the group key): re-run solo under our own
+            # deadline/token instead of inheriting the expiry
             return self.engine.query_range(promql, start_s, step_s, end_s,
                                            planner_params)
         return res

@@ -51,6 +51,7 @@ class QueryEngine:
         self.replan_hook = replan_hook
 
     def _ctx(self, planner_params: Optional[PlannerParams]) -> QueryContext:
+        from filodb_tpu.query.activequeries import take_admission
         from filodb_tpu.query.rangevector import compute_deadline
         q = self._qconfig()
         if planner_params is None:
@@ -58,13 +59,26 @@ class QueryEngine:
             # stance; explicit PlannerParams always win
             planner_params = PlannerParams(
                 allow_partial_results=q.allow_partial_results)
+        # frontend-admitted queries carry their ActiveQuery entry across
+        # the layer gap on a thread-local: the context adopts its id —
+        # so the registry key, the trace id, and ctx.query_id are ONE
+        # stable identifier — and its CancellationToken
+        ent = take_admission()
+        qid = ent.query_id if ent is not None else str(uuid.uuid4())
         # end-to-end deadline: the frontend stamps deadline_unix_s at
         # ADMISSION (queue wait counts); otherwise the budget starts now
-        return QueryContext(query_id=str(uuid.uuid4()),
-                            submit_time_ms=int(_time.time() * 1000),
-                            planner_params=planner_params,
-                            deadline_unix_s=compute_deadline(
-                                planner_params, q.default_timeout_s))
+        ctx = QueryContext(query_id=qid,
+                           submit_time_ms=int(_time.time() * 1000),
+                           planner_params=planner_params,
+                           deadline_unix_s=compute_deadline(
+                               planner_params, q.default_timeout_s))
+        if ent is not None:
+            # plain attributes, NOT dataclass fields: a dispatched
+            # subtree serializes without them (remote nodes register
+            # their own entry under the same query id)
+            ctx.cancel = ent.token
+            ctx.active = ent
+        return ctx
 
     def _qconfig(self):
         if self.config is not None:
@@ -75,7 +89,11 @@ class QueryEngine:
     def query_range(self, promql: str, start_s: int, step_s: int, end_s: int,
                     planner_params: Optional[PlannerParams] = None
                     ) -> QueryResult:
+        from filodb_tpu.query.activequeries import peek_admission
         from filodb_tpu.utils.metrics import span
+        ent = peek_admission()
+        if ent is not None:
+            ent.set_phase("parsing")
         t_parse0 = _time.perf_counter()
         try:
             # span: the parse share of the fixed per-query floor is
@@ -121,18 +139,32 @@ class QueryEngine:
         series cost either way; this is a TPU-shaped throughput feature
         (amortizing dispatch the way the MXU amortizes FLOPs).
         """
+        from filodb_tpu.query.activequeries import (set_admission,
+                                                    take_admission)
         from filodb_tpu.query.execbase import InProcessPlanDispatcher
         from filodb_tpu.query.fusedbatch import finish_fused_calls
         from filodb_tpu.query.leafexec import MultiSchemaPartitionsExec
+        # the coalesce LEADER's admission entry must bind to ITS query,
+        # not to whichever batch member happens to mint a context first
+        # (a parse failure on the leader's own query would otherwise
+        # hand its id/token to another client's query — a kill of the
+        # leader's id would then cancel the wrong tenant's work)
+        adm = take_admission()
         results: List[Optional[QueryResult]] = [None] * len(promqls)
         entries = []
         for i, q in enumerate(promqls):
+            mine = adm is not None and q == adm.promql
+            if mine:
+                set_admission(adm)
+                adm = None
             t0 = _time.perf_counter()
             try:
                 plan = query_range_to_logical_plan(
                     q, TimeStepParams(start_s, step_s, end_s))
             except Exception as e:  # noqa: BLE001
                 results[i] = QueryResult([], error=f"parse error: {e}")
+                if mine:
+                    take_admission()     # never leak to the next query
                 continue
             parse_t = _time.perf_counter() - t0
             if isinstance(plan, lp.MetadataQueryPlan):
@@ -207,6 +239,9 @@ class QueryEngine:
                           ) -> QueryResult:
         from filodb_tpu.utils.metrics import span
         ctx = self._ctx(planner_params)
+        ent = getattr(ctx, "active", None)
+        if ent is not None:
+            ent.set_phase("planning")
         t_plan0 = _time.perf_counter()
         try:
             with span("query_plan"):
@@ -214,6 +249,8 @@ class QueryEngine:
         except Exception as e:  # noqa: BLE001
             return QueryResult([], error=f"planning error: {e}")
         plan_t = _time.perf_counter() - t_plan0
+        if ent is not None:
+            ent.set_phase("executing")
         if isinstance(plan, lp.MetadataQueryPlan):
             from filodb_tpu.query.execbase import QueryError
             try:
@@ -342,13 +379,17 @@ def _walk_plan(ep):
 
 def _prom_error_payload(result: QueryResult) -> Optional[Dict]:
     """Error half of the Prometheus envelope, or None for success.  One
-    home for the errorType taxonomy (deadline expiry maps to "timeout"
-    so clients can route on it) shared by the matrix and vector
-    serializers."""
+    home for the errorType taxonomy (deadline expiry maps to "timeout",
+    a kill to "canceled", so clients can route on it) shared by the
+    matrix and vector serializers."""
     if not result.error:
         return None
-    etype = ("timeout" if result.error.startswith("query_timeout")
-             else "query_error")
+    if result.error.startswith("query_timeout"):
+        etype = "timeout"
+    elif result.error.startswith("query_canceled"):
+        etype = "canceled"
+    else:
+        etype = "query_error"
     return {"status": "error", "errorType": etype, "error": result.error}
 
 

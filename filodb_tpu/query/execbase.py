@@ -205,6 +205,13 @@ class QueryError(Exception):
           (not the per-hop ask timeout) bounded the socket wait.  Never
           retried and never dropped-for-partial — the budget is global,
           so continuing cannot produce a timely answer.
+      query_canceled — the query's CancellationToken was tripped
+          (admin kill via POST /admin/queries/<id>/kill, a client
+          disconnect detected mid-query, or a kill frame from the
+          coordinator): checked at every exec-node boundary, inside
+          the demand-paging loop, and before fused kernel dispatches.
+          Never retried, never dropped-for-partial, never cached —
+          nobody is waiting for the answer.
 
     The string form is always "<code>: <detail>", so HTTP/CLI clients
     (and tests) can route on `error.split(':', 1)[0]`."""
@@ -564,6 +571,13 @@ class ExecPlan:
                 "query_timeout",
                 f"deadline exceeded at {type(self).__name__} "
                 f"(budget expired {_time.time() - dl:.3f}s ago)")
+        # cooperative cancellation at the same boundary: a killed query
+        # stops HERE instead of fanning out more work (the token is a
+        # plain attribute — it never rides the wire; remote nodes mint
+        # their own, keyed by query id)
+        tok = getattr(self.ctx, "cancel", None)
+        if tok is not None and tok.cancelled:
+            tok.raise_if_cancelled(f"at {type(self).__name__}")
         snap = exec_tally.snapshot()
         t0 = _time.perf_counter()
         try:
@@ -599,6 +613,14 @@ class ExecPlan:
                 "series_scanned": stats.series_scanned,
                 "shards_queried": stats.shards_queried,
             })
+        # live-counter hook (query/activequeries.py): the registry entry
+        # riding the context sees this node's contribution IN PLACE —
+        # leaves add their scan counters, every node its exclusive
+        # device work — so GET /admin/queries shows a query progressing,
+        # not just existing
+        ent = getattr(self.ctx, "active", None)
+        if ent is not None:
+            ent.tally(self, stats, exec_tally)
         exec_tally.restore(snap, total)
         return data, stats
 
@@ -728,6 +750,9 @@ class NonLeafExecPlan(ExecPlan):
     def _gather(self, source) -> Tuple[List[Data], QueryStats]:
         stats = QueryStats()
         results = []
+        ent = getattr(self.ctx, "active", None)
+        if ent is not None:
+            ent.set_phase("gathering")
         pp = self.ctx.planner_params
         allow_partial = pp.allow_partial_results
         # shard_unavailable drops only once the ENGINE has engaged

@@ -40,6 +40,34 @@ class _Flight:
         self.result = None
 
 
+def _canceled_result(tok, where: str) -> QueryResult:
+    """The structured query_canceled result for a kill that landed while
+    the request was BLOCKED in the serving stack (queue / dedup wait) —
+    before any exec node existed to raise it."""
+    return QueryResult([], error=("query_canceled: query killed "
+                                  f"{where} (reason={tok.reason or 'admin'})"
+                                  + (f": {tok.detail}" if tok.detail
+                                     else "")))
+
+
+def _acquire_cancellable(sem, timeout: float, tok) -> bool:
+    """Semaphore acquire in short slices: a killed request stops waiting
+    within ~50 ms and returns WITHOUT ever holding the slot (the
+    'kill during queue wait' contract — the follow-up query admits
+    immediately)."""
+    if tok is None:
+        return sem.acquire(timeout=timeout)
+    deadline = _time.perf_counter() + max(timeout, 0.0)
+    while True:
+        if tok.cancelled:
+            return False
+        left = deadline - _time.perf_counter()
+        if left <= 0:
+            return False
+        if sem.acquire(timeout=min(left, 0.05)):
+            return True
+
+
 class QueryFrontend:
     """Per-dataset serving frontend around one QueryEngine."""
 
@@ -113,6 +141,7 @@ class QueryFrontend:
     def _serve(self, key, run, promql, grid, pp, tenant, origin):
         """Admission -> singleflight -> accounting: the shared serving
         wrapper for both query shapes."""
+        from filodb_tpu.query.activequeries import set_pending, verdict_of
         from filodb_tpu.utils.slowlog import slowlog
         from filodb_tpu.utils.usage import tenant_of, usage
         if self._usage_enabled:
@@ -124,8 +153,18 @@ class QueryFrontend:
                 return QueryResult([], error=err)
         if tenant is None:
             tenant = ("", "")
+        # live introspection (query/activequeries.py): mark the request
+        # so the SCHEDULER layer registers it the moment real work
+        # begins (before the semaphore wait).  Cache hits and dedup'd
+        # followers finish inside the serving layers holding nothing —
+        # they pay these two thread-local writes and never register.
+        set_pending((tenant, origin))
         t0 = _time.perf_counter()
-        res, shared = self._singleflight(key, run, pp)
+        res = None
+        try:
+            res, shared = self._singleflight(key, run, pp)
+        finally:
+            set_pending(None)
         dur = _time.perf_counter() - t0
         # singleflight followers received the LEADER's result: the work
         # (and its samples_scanned) happened once — re-recording it per
@@ -153,11 +192,17 @@ class QueryFrontend:
                 collector.note_origin(
                     tid, "rule_eval" if origin.startswith("rule_")
                     else "query")
+                # final verdict on the trace (completed/killed/deadline)
+                # so /admin/traces/<id> answers "how did it end" —
+                # the slowlog cross-link's other half
+                collector.note_verdict(tid, verdict_of(res))
         return res
 
     def _singleflight(self, key, run, planner_params=None):
         """Returns (result, shared): shared=True iff this caller rode a
-        singleflight leader's execution instead of running its own."""
+        singleflight leader's execution instead of running its own.
+        A killed LEADER's result is never inherited — followers
+        re-execute under their own (freshly-registered) token."""
         if not self._sf_enabled:
             return run(), False
         with self._sf_lock:
@@ -181,12 +226,14 @@ class QueryFrontend:
             completed = flight.done.wait(timeout=bound)
             if flight.result is not None:
                 shared = flight.result
-                # never inherit the LEADER's deadline expiry: budgets
-                # are per-request (repr-excluded from the dedup key), so
-                # a short-timeout leader must not fail long-budget
-                # followers — they run solo under their own deadline
+                # never inherit the LEADER's deadline expiry OR its
+                # kill: budgets and kills are per-request (repr-excluded
+                # from the dedup key), so a short-timeout or killed
+                # leader must not fail its followers — they run solo
+                # under their own deadline/token
                 if not (shared.error is not None
-                        and shared.error.startswith("query_timeout")):
+                        and (shared.error.startswith("query_timeout")
+                             or shared.error.startswith("query_canceled"))):
                     return shared, True
             res = run()
             if not completed and not (dl and _time.time() >= dl):
@@ -240,6 +287,18 @@ class QueryFrontend:
         plan = query_range_to_logical_plan(
             promql, TimeStepParams(start_s, step_s, end_s))
         ctx = QueryContext(query_id=_uuid.uuid4().hex[:16])
+        # analyze executions are live-listable/killable like any other
+        # (an unkillable analyze verb would be a free pass around the
+        # introspection layer, exactly like the limits)
+        from filodb_tpu.query.activequeries import (active_queries,
+                                                    verdict_of)
+        ent = active_queries.register(ctx.query_id, promql=promql,
+                                      tenant=tenant,
+                                      origin="explain_analyze")
+        if ent is not None:
+            ctx.cancel = ent.token
+            ctx.active = ent
+            ent.set_phase("planning")
         # same deadline semantics as query_range: the budget starts at
         # admission and the exec tree below enforces it.  analyze has no
         # re-plan/retry layer, so the partial-results gate engages the
@@ -259,15 +318,21 @@ class QueryFrontend:
         sem = self._sem
         waited = 0.0
         acquired = False
+        res = None
         if sem is not None:
             tq = _time.perf_counter()
-            acquired = sem.acquire(timeout=self._ask_timeout_s)
+            acquired = _acquire_cancellable(
+                sem, self._ask_timeout_s,
+                ent.token if ent is not None else None)
             waited = _time.perf_counter() - tq
         try:
+            if ent is not None:
+                ent.set_phase("executing")
             res = ep.execute(self.engine.source)
         finally:
             if acquired:
                 sem.release()
+            active_queries.deregister(ent, verdict_of(res))
         res.trace_id = ctx.query_id
         res.stats.queue_wait_s += waited
         dur = _time.perf_counter() - t0
@@ -311,7 +376,40 @@ class QueryFrontend:
         return _dc.replace(pp, deadline_unix_s=deadline)
 
     def _run(self, promql, start_s, step_s, end_s, pp):
+        """The registration boundary (query/activequeries.py): the
+        pending marker set at admission becomes a live ActiveQuery HERE
+        — the moment the request is about to consume real resources
+        (scheduler slot, engine, device).  The entry's id becomes
+        ctx.query_id (= the trace id) via the thread-local handoff the
+        engine adopts in _ctx; deregistration (with the final verdict)
+        happens when execution returns, canceled-in-queue included."""
+        from filodb_tpu.query.activequeries import (active_queries,
+                                                    set_admission,
+                                                    take_admission,
+                                                    take_pending,
+                                                    verdict_of)
+        info = take_pending()
+        ent = None
+        if info is not None:
+            from filodb_tpu.utils.metrics import mint_trace_id
+            ent = active_queries.register(mint_trace_id(), promql=promql,
+                                          tenant=info[0], origin=info[1])
+        if ent is None:
+            return self._run_scheduled(promql, start_s, step_s, end_s,
+                                       pp, None)
+        set_admission(ent)
+        res = None
+        try:
+            res = self._run_scheduled(promql, start_s, step_s, end_s,
+                                      pp, ent)
+            return res
+        finally:
+            take_admission()         # clear if the engine never adopted
+            active_queries.deregister(ent, verdict_of(res))
+
+    def _run_scheduled(self, promql, start_s, step_s, end_s, pp, ent):
         sem = self._sem
+        tok = ent.token if ent is not None else None
         if sem is None:
             return self.coalescer.query_range(promql, start_s, step_s,
                                               end_s, pp)
@@ -325,12 +423,19 @@ class QueryFrontend:
         dl = getattr(pp, "deadline_unix_s", 0.0) if pp is not None else 0.0
         timeout = remaining_budget(pp, self._ask_timeout_s)
         t0 = _time.perf_counter()
-        acquired = sem.acquire(timeout=timeout)
+        acquired = _acquire_cancellable(sem, timeout, tok)
         waited = _time.perf_counter() - t0
-        if not acquired:
+        if not acquired and not (tok is not None and tok.cancelled):
             from filodb_tpu.utils.metrics import registry
             registry.counter("query_scheduler_timeouts").increment()
         try:
+            if tok is not None and tok.cancelled:
+                # killed while queued: the structured error, with the
+                # slot either never held (kill interrupted the wait) or
+                # released by the finally below before anyone noticed
+                res = _canceled_result(tok, "in the scheduler queue")
+                res.stats.queue_wait_s += waited
+                return res
             if dl and _time.time() >= dl:
                 from filodb_tpu.utils.metrics import registry
                 registry.counter("query_timeouts_in_queue").increment()

@@ -31,7 +31,7 @@ from filodb_tpu.query.rangevector import (QueryContext, QueryResult, QueryStats,
 
 from filodb_tpu.query.execbase import (
     AggPartial, GroupCardinalityError, LazyKeys, LeafExecPlan,
-    QueryResultLike, RawBlock, ScalarResult,
+    QueryError, QueryResultLike, RawBlock, ScalarResult,
     _FUSED_CACHE_LOCK, _FUSED_MINMAX_PAD_CACHE, _FUSED_PLAN_CACHE,
     _FUSED_VALS_CACHE, _block_empty, _group_cache_insert,
     _group_cache_lookup, _lru_touch, _note_mirror_limit,
@@ -81,8 +81,10 @@ class MultiSchemaPartitionsExec(LeafExecPlan):
             data, stats = self._do_execute(source)
             try:
                 fused = self._try_fused(data, stats)
-            except GroupCardinalityError:
-                raise                    # real query error — must surface
+            except (GroupCardinalityError, QueryError):
+                # real query errors (cardinality limit, cancellation)
+                # must surface, never degrade to the general path
+                raise
             except Exception as e:  # noqa: BLE001 — fusion is an optimization
                 from filodb_tpu.utils.metrics import (log_fused_degradation,
                                                       registry)
@@ -116,6 +118,8 @@ class MultiSchemaPartitionsExec(LeafExecPlan):
             # lookup + dense gather twice
             self._prefused = (data, stats, None)
             return None
+        except QueryError:
+            raise                        # cancellation must surface
         except Exception as e:  # noqa: BLE001 — fusion is an optimization
             from filodb_tpu.utils.metrics import (log_fused_degradation,
                                                   registry)
@@ -132,8 +136,11 @@ class MultiSchemaPartitionsExec(LeafExecPlan):
         self._prefused = (data, stats, partial)
 
     def _finish_or_degrade(self, fc):
+        self._check_cancel("fused kernel dispatch")
         try:
             return finish_fused_calls([fc])[0]
+        except QueryError:
+            raise                        # cancellation must surface
         except Exception as e:  # noqa: BLE001 — fusion is an optimization
             from filodb_tpu.utils.metrics import (log_fused_degradation,
                                                   registry)
@@ -313,6 +320,7 @@ class MultiSchemaPartitionsExec(LeafExecPlan):
                 ragged=not dense, num_series=vals.shape[0], cache_key=ck)
             if defer:
                 return fc
+            self._check_cancel("fused kernel dispatch")
             return finish_fused_calls([fc])[0]
         # histogram leaf (sum(rate(bucket_metric))): (group, bucket)
         # slots ride the same FusedCall machinery so quantile dashboards
@@ -330,6 +338,7 @@ class MultiSchemaPartitionsExec(LeafExecPlan):
             bucket_les=data.bucket_les, num_buckets=B)
         if defer:
             return fc
+        self._check_cancel("fused hist kernel dispatch")
         return finish_fused_calls([fc])[0]
 
     def _try_host_routed(self, data, t0, t1, wends, eval_wends, fn,
@@ -507,6 +516,15 @@ class MultiSchemaPartitionsExec(LeafExecPlan):
                 f"group-by cardinality limit {limit} exceeded "
                 f"({len(gkeys)} groups)")
 
+    def _check_cancel(self, where: str) -> None:
+        """Cooperative cancellation between the exec-node boundary
+        checks: before device dispatches and around the paging loops, so
+        a killed cold-tier scan stops mid-leaf instead of finishing a
+        result nobody will read."""
+        tok = getattr(self.ctx, "cancel", None)
+        if tok is not None and tok.cancelled:
+            tok.raise_if_cancelled(f"before {where} (shard {self.shard})")
+
     def _do_execute(self, source) -> QueryResultLike:
         stats = QueryStats(shards_queried=1)
         shard = source.get_shard(self.dataset, self.shard)
@@ -546,11 +564,16 @@ class MultiSchemaPartitionsExec(LeafExecPlan):
 
         _check_scan_cap("resident")
         from filodb_tpu.core.shard import PagedLimitExceeded
-        from filodb_tpu.query.execbase import QueryError
         try:
+            # the cancel callable rides into the per-partition paging
+            # loop: a killed query stops paging history mid-scan (the
+            # work already paged is kept — valid cache for a retry)
+            tok = getattr(self.ctx, "cancel", None)
             paged = shard.ensure_paged_pids(
                 schema_name, pids, self.chunk_start_ms, self.chunk_end_ms,
-                max_samples=limit if enforced else None)
+                max_samples=limit if enforced else None,
+                cancel=(None if tok is None else
+                        lambda: self._check_cancel("demand paging")))
         except PagedLimitExceeded as e:
             # structured query error, not a 500: the partial paging work
             # is kept (valid cache for a narrower retry) and the error
@@ -801,16 +824,25 @@ class SelectPersistedSegmentsExec(MultiSchemaPartitionsExec):
                     else schema.value_column)
         verdict = "cold_hit"
         picked = []                       # (block, rows)
+        self._check_cancel("cold-segment page-in")
         if len(metas) > 1:
             # page the slice's segments in concurrently: decode + upload
             # overlap, so the cold wall is ~one segment, not the sum (the
             # per-column decode inside each is pooled too)
             import concurrent.futures
+
+            def _fetch(m):
+                # per-segment cancel check: a killed 30-day scan stops
+                # between page-ins instead of decoding the whole slice
+                self._check_cancel("cold-segment page-in")
+                return self.tier.get_block(m)
+
             with concurrent.futures.ThreadPoolExecutor(
                     max_workers=min(4, len(metas))) as pool:
-                fetched = list(pool.map(self.tier.get_block, metas))
+                fetched = list(pool.map(_fetch, metas))
         else:
             fetched = [self.tier.get_block(metas[0])]
+        self._check_cancel("cold-segment gather")
         for m, (block, v) in zip(metas, fetched):
             rows = block.match_rows(self.filters, self.chunk_start_ms,
                                     self.chunk_end_ms)

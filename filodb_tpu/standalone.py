@@ -118,6 +118,22 @@ class FiloServer:
             breach_count=self.config.ingest.freshness_breach_count,
             window_s=self.config.ingest.freshness_window_s)
         _metrics.set_exemplars_enabled(self.config.exemplars_enabled)
+        # live query introspection (query/activequeries.py): wire the
+        # registry's knobs, default the crash-durable active-query file
+        # next to the WAL when one is configured, and journal whatever
+        # the PREVIOUS process left running at crash time
+        from filodb_tpu.query.activequeries import active_queries
+        aq_path = self.config.query.active_query_log_path
+        if not aq_path and self.config.wal.enabled and self.config.wal.dir:
+            import os as _os
+            aq_path = _os.path.join(self.config.wal.dir, "queries.active")
+        active_queries.configure(
+            enabled=self.config.query.active_queries_enabled,
+            path=aq_path)
+        n_crash = active_queries.replay_crash_log()
+        if n_crash:
+            journal.emit("query_crash_replay", subsystem="query",
+                         queries_active_at_crash=n_crash)
         if node_name != "local" or not _metrics.NODE_NAME:
             # an explicitly-named server stamps its spans (the cross-
             # node trace evidence); default-named embedded servers only

@@ -438,6 +438,10 @@ class TraceCollector:
         # trace -> origin tag (query | rule_eval | remote_write), set by
         # the doors; /admin/traces?origin= filters on it
         self._origins: Dict[str, str] = {}
+        # trace -> final verdict (completed | killed | deadline | error),
+        # set by the query frontend at completion; /admin/traces/<id>
+        # carries it so "how did this query end" needs no slowlog join
+        self._verdicts: Dict[str, str] = {}
         # ids evicted from the bounded ring: /traces/{id} answers "410
         # gone" (the trace existed, the ring recycled it) instead of a
         # 404 indistinguishable from a typo.  Bounded itself so hostile
@@ -470,6 +474,7 @@ class TraceCollector:
                     old = self._order.pop(0)
                     self._traces.pop(old, None)
                     self._origins.pop(old, None)
+                    self._verdicts.pop(old, None)
                     if old in self._evicted_set:
                         # a re-registered-then-re-evicted id: refresh
                         # its position instead of duplicating it (a
@@ -510,6 +515,20 @@ class TraceCollector:
         with self._lock:
             if trace_id in self._traces:
                 self._origins[trace_id] = origin
+
+    def note_verdict(self, trace_id: str, verdict: str) -> None:
+        """Tag a trace with its query's final verdict (completed |
+        killed | deadline | error).  Only known ids are tagged — a
+        verdict for an evicted trace would re-register it for nothing."""
+        if not trace_id or not verdict:
+            return
+        with self._lock:
+            if trace_id in self._traces:
+                self._verdicts[trace_id] = verdict
+
+    def verdict(self, trace_id: str) -> str:
+        with self._lock:
+            return self._verdicts.get(trace_id, "")
 
     def was_evicted(self, trace_id: str) -> bool:
         with self._lock:

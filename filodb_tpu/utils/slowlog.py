@@ -119,6 +119,7 @@ class SlowQueryLog(_RingLog):
         thr = self.threshold_s if threshold_s is None else threshold_s
         if thr <= 0 or duration_s < thr:
             return False
+        from filodb_tpu.query.activequeries import verdict_of
         from filodb_tpu.utils.metrics import collector, registry
         trace_id = getattr(result, "trace_id", "") or ""
         spans: List[dict] = []
@@ -135,7 +136,13 @@ class SlowQueryLog(_RingLog):
             "end_s": int(end_s),
             "duration_s": round(duration_s, 6),
             "tenant": {"ws": tenant[0], "ns": tenant[1]},
+            # the stable query id IS the trace id (PR 13): both names,
+            # so slowlog <-> /admin/traces/<id> correlation is a copy-
+            # paste, not a manual join — and the final VERDICT
+            # (completed/killed/deadline/error) rides both records
             "trace_id": trace_id,
+            "query_id": trace_id,
+            "verdict": verdict_of(result),
             "error": getattr(result, "error", None),
             "partial": bool(getattr(result, "partial", False)),
             "stats": stats.to_dict() if stats is not None else None,
@@ -147,6 +154,17 @@ class SlowQueryLog(_RingLog):
                     "trace=%s", duration_s, thr, promql,
                     start_s, end_s, step_s, trace_id)
         return True
+
+    def seq_for_trace(self, trace_id: str) -> Optional[int]:
+        """Ring seq of the newest record carrying this trace id, or None
+        — the /admin/traces/<id> -> slowlog half of the cross-link."""
+        if not trace_id:
+            return None
+        with self._lock:
+            for rec in reversed(self._entries):
+                if rec.get("trace_id") == trace_id:
+                    return rec.get("seq")
+        return None
 
 
 class IngestSlowLog(_RingLog):

@@ -1,0 +1,520 @@
+"""Live query introspection (PR 13): the active-query registry,
+cooperative cancellation races, kill propagation, and the crash log.
+
+The race matrix the ISSUE names explicitly:
+  * kill during queue wait — the slot is never held
+  * kill between exec nodes — the next node never runs
+  * kill of a singleflight leader — waiting followers re-execute
+  * remote kill frame vs. an already-completed child — idempotent no-op
+  * double-kill — second kill reports killed=False, counter moves once
+"""
+import json
+import os
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from filodb_tpu.config import FilodbSettings
+from filodb_tpu.query.activequeries import (ActiveQueryRegistry,
+                                            active_queries,
+                                            bind_client_conn, verdict_of)
+from filodb_tpu.query.execbase import (ExecPlan, LeafExecPlan,
+                                       NonLeafExecPlan, QueryError)
+from filodb_tpu.query.frontend import QueryFrontend
+from filodb_tpu.query.rangevector import (PlannerParams, QueryContext,
+                                          QueryResult, QueryStats)
+from filodb_tpu.utils.metrics import registry
+
+
+def _drain_registry():
+    """Tests must not leak entries into each other (the registry is
+    process-wide, like the metrics registry)."""
+    for ent in active_queries.entries():
+        active_queries.deregister(ent, "error")
+
+
+@pytest.fixture(autouse=True)
+def _clean_registry():
+    _drain_registry()
+    yield
+    _drain_registry()
+
+
+class _FakeEngine:
+    """Engine stand-in: blocks until released or its query's token is
+    cancelled (polling — the cooperative contract), counting calls."""
+
+    def __init__(self, block: bool = False):
+        self.dataset = "ds"
+        self.block = block
+        self.release = threading.Event()
+        self.calls = 0
+        self.lock = threading.Lock()
+
+    def query_range(self, promql, s, st, e, pp=None):
+        from filodb_tpu.query.activequeries import take_admission
+        ent = take_admission()           # the real engine pops it too
+        with self.lock:
+            self.calls += 1
+        if self.block:
+            deadline = time.monotonic() + 5.0
+            while time.monotonic() < deadline:
+                if self.release.is_set():
+                    break
+                if ent is not None and ent.token.cancelled:
+                    return QueryResult(
+                        [], error="query_canceled: killed mid-execution")
+                time.sleep(0.01)
+        res = QueryResult([])
+        res.trace_id = ent.query_id if ent is not None else ""
+        return res
+
+
+def _frontend(engine, max_concurrent=0, singleflight=True):
+    cfg = FilodbSettings()
+    cfg.query.max_concurrent_queries = max_concurrent
+    cfg.query.singleflight_enabled = singleflight
+    cfg.query.tenant_usage_enabled = False
+    cfg.query.result_cache_enabled = False
+    return QueryFrontend(engine, config=cfg)
+
+
+# ------------------------------------------------------------ registry
+
+
+def test_register_kill_deregister_and_gauges():
+    ent = active_queries.register("q1", promql="up", tenant=("acme", "ns"),
+                                  origin="query_range")
+    assert ent.phase == "queued"
+    active_queries.refresh_gauges()      # gauges publish at scrape time
+    assert registry.gauge("queries_inflight", ws="acme").value == 1
+    assert registry.gauge("query_queue_depth", ws="acme").value == 1
+    ent.set_phase("executing")
+    active_queries.refresh_gauges()
+    assert registry.gauge("query_queue_depth", ws="acme").value == 0
+    before = registry.counter("queries_killed", reason="admin").value
+    out = active_queries.kill("q1")
+    assert out["killed"] is True
+    assert ent.token.cancelled and ent.token.reason == "admin"
+    assert registry.counter("queries_killed",
+                            reason="admin").value == before + 1
+    active_queries.deregister(ent, "killed")
+    active_queries.refresh_gauges()
+    assert registry.gauge("queries_inflight", ws="acme").value == 0
+    assert active_queries.get("q1") == []
+
+
+def test_double_kill_is_idempotent():
+    ent = active_queries.register("q2", promql="up")
+    before = registry.counter("queries_killed", reason="admin").value
+    assert active_queries.kill("q2")["killed"] is True
+    assert active_queries.kill("q2")["killed"] is False
+    assert registry.counter("queries_killed",
+                            reason="admin").value == before + 1
+    active_queries.deregister(ent, "killed")
+    # a kill AFTER completion: unknown id, nothing happens
+    assert active_queries.kill("q2")["killed"] is False
+
+
+def test_double_deregister_is_a_noop():
+    # the sole entry under its id: the second deregister must not
+    # decrement the tenant's inflight count again
+    ent = active_queries.register("qdd", promql="up", tenant=("dd", ""))
+    other = active_queries.register("qdd2", promql="up", tenant=("dd", ""))
+    active_queries.deregister(ent, "completed")
+    active_queries.deregister(ent, "completed")
+    active_queries.refresh_gauges()
+    assert registry.gauge("queries_inflight", ws="dd").value == 1
+    active_queries.deregister(other, "completed")
+
+
+def test_disabled_registry_returns_none_entries():
+    reg = ActiveQueryRegistry()
+    reg.configure(enabled=False)
+    assert reg.register("qx", promql="up") is None
+    reg.deregister(None)                 # no-op, no crash
+    assert reg.kill("qx")["killed"] is False
+
+
+def test_verdict_of():
+    assert verdict_of(QueryResult([])) == "completed"
+    assert verdict_of(QueryResult([], error="query_canceled: x")) == "killed"
+    assert verdict_of(QueryResult([], error="query_timeout: x")) == "deadline"
+    assert verdict_of(QueryResult([], error="boom")) == "error"
+    assert verdict_of(None) == "completed"
+
+
+# ----------------------------------------------------- race: queue wait
+
+
+def test_kill_during_queue_wait_never_holds_slot():
+    eng = _FakeEngine(block=True)
+    fe = _frontend(eng, max_concurrent=1, singleflight=False)
+    pp = PlannerParams()
+    results = {}
+
+    def client(name, promql):
+        results[name] = fe.query_range(promql, 0, 15, 600, pp)
+
+    t1 = threading.Thread(target=client, args=("a", "up"))
+    t1.start()
+    # wait until A holds the slot (inside the blocking engine)
+    deadline = time.monotonic() + 2.0
+    while eng.calls == 0 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert eng.calls == 1
+    t2 = threading.Thread(target=client, args=("b", "up{x=\"1\"}"))
+    t2.start()
+    # B is queued: find its entry and kill it
+    ent_b = None
+    deadline = time.monotonic() + 2.0
+    while ent_b is None and time.monotonic() < deadline:
+        for e in active_queries.entries():
+            if e.promql == 'up{x="1"}' and e.phase == "queued":
+                ent_b = e
+        time.sleep(0.01)
+    assert ent_b is not None
+    active_queries.kill(ent_b.query_id)
+    t2.join(timeout=3)
+    assert not t2.is_alive()
+    assert results["b"].error.startswith("query_canceled")
+    # the killed query never held (or has released) the slot: a third
+    # query admits as soon as A releases, with no queue-timeout path
+    eng.release.set()
+    t1.join(timeout=3)
+    assert results["a"].error is None
+    eng.block = False
+    t0 = time.monotonic()
+    res_c = fe.query_range("up_c", 0, 15, 600, pp)
+    assert res_c.error is None
+    assert time.monotonic() - t0 < 1.0
+    # the engine ran A and C, never B
+    assert eng.calls == 2
+
+
+# ------------------------------------------ race: between exec nodes
+
+
+class _SleepLeaf(LeafExecPlan):
+    ran = 0
+
+    def _do_execute(self, source):
+        type(self).ran += 1
+        return None, QueryStats()
+
+
+class _KillingLeaf(LeafExecPlan):
+    """Simulates the kill landing while this node executes."""
+
+    def _do_execute(self, source):
+        self.ctx.cancel.cancel("admin", "test kill between nodes")
+        return None, QueryStats()
+
+
+class _Concat(NonLeafExecPlan):
+    def compose(self, results, stats):
+        return None
+
+
+def test_kill_between_exec_nodes_stops_the_tree():
+    from filodb_tpu.query.activequeries import CancellationToken
+    ctx = QueryContext(query_id="qtree")
+    ctx.cancel = CancellationToken()
+    _SleepLeaf.ran = 0
+    root = _Concat(ctx, [_KillingLeaf(ctx), _SleepLeaf(ctx),
+                         _SleepLeaf(ctx)])
+    res = root.execute(None)
+    assert res.error is not None and res.error.startswith("query_canceled")
+    # the boundary check stopped the scatter: later leaves never ran
+    assert _SleepLeaf.ran == 0
+
+
+def test_paging_loop_honors_cancel():
+    from filodb_tpu.core.memstore import TimeSeriesMemStore
+    from filodb_tpu.core.store import (InMemoryColumnStore,
+                                       InMemoryMetaStore)
+    from filodb_tpu.ingest.generator import batch_stream, gauge_batch
+    cs, meta = InMemoryColumnStore(), InMemoryMetaStore()
+    ms = TimeSeriesMemStore(column_store=cs, meta_store=meta)
+    shard = ms.setup("prometheus", 0)
+    batch = gauge_batch(8, 40)
+    for b, off in batch_stream(batch, samples_per_chunk=10):
+        shard.ingest(b, off)
+    shard.flush_all_groups()
+    # fresh node: data only on the column store — a query must page
+    ms2 = TimeSeriesMemStore(column_store=cs, meta_store=meta)
+    shard2 = ms2.setup("prometheus", 0)
+    shard2.recover_index()
+    pids = np.arange(shard2.num_partitions, dtype=np.int64)
+
+    calls = []
+
+    def cancel():
+        calls.append(1)
+        if len(calls) >= 2:
+            raise QueryError("query_canceled", "killed during paging")
+
+    with pytest.raises(QueryError, match="query_canceled"):
+        shard2.ensure_paged_pids("gauge", pids, 0, 10_000_000,
+                                 cancel=cancel)
+    assert len(calls) >= 2
+
+
+# ----------------------------------------- race: singleflight leader
+
+
+def test_singleflight_leader_killed_followers_reexecute():
+    eng = _FakeEngine(block=True)
+    fe = _frontend(eng, max_concurrent=0, singleflight=True)
+    pp = PlannerParams()
+    results = {}
+
+    def client(name):
+        results[name] = fe.query_range("up", 0, 15, 600, pp)
+
+    t_leader = threading.Thread(target=client, args=("leader",))
+    t_leader.start()
+    deadline = time.monotonic() + 2.0
+    while eng.calls == 0 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    t_follower = threading.Thread(target=client, args=("follower",))
+    t_follower.start()
+    # only the LEADER registers (followers ride its flight holding
+    # nothing); give the follower a moment to park on the dedup wait,
+    # then kill the leader
+    deadline = time.monotonic() + 2.0
+    leader_ent = None
+    while time.monotonic() < deadline:
+        ents = [e for e in active_queries.entries() if e.promql == "up"]
+        if ents:
+            leader_ent = ents[0]
+            break
+        time.sleep(0.01)
+    time.sleep(0.1)
+    assert leader_ent is not None
+    assert len([e for e in active_queries.entries()
+                if e.promql == "up"]) == 1
+    # follower must NOT block the engine again: release lets any
+    # re-execution return instantly
+    eng.block = False
+    active_queries.kill(leader_ent.query_id)
+    t_leader.join(timeout=3)
+    t_follower.join(timeout=3)
+    assert results["leader"].error.startswith("query_canceled")
+    # the follower saw the leader's cancellation and re-executed solo
+    assert results["follower"].error is None
+    assert eng.calls == 2
+
+
+# ------------------------------------- remote kill frames (transport)
+
+
+def test_remote_kill_frame_and_already_completed_child():
+    from filodb_tpu.core.memstore import TimeSeriesMemStore
+    from filodb_tpu.parallel.transport import NodeQueryServer, send_kill
+    srv = NodeQueryServer(TimeSeriesMemStore()).start()
+    host, port = srv.address
+    try:
+        # a live "remote execution" on this node: the kill frame finds
+        # its token by query id
+        ent = active_queries.register("rq1", promql="[remote] leaf",
+                                      origin="remote", role="remote")
+        out = send_kill(host, port, "rq1")
+        assert out["killed"] is True
+        assert ent.token.cancelled
+        active_queries.deregister(ent, "killed")
+        # already-completed (or never-seen) child: idempotent no-op
+        out = send_kill(host, port, "rq1")
+        assert out["killed"] is False
+        out = send_kill(host, port, "never-existed")
+        assert out["killed"] is False
+    finally:
+        srv.stop()
+
+
+def test_remote_execution_registers_and_kill_mid_dispatch():
+    """A dispatched subtree registers under the coordinator's query id
+    on the remote node, and a kill frame arriving mid-execution stops
+    the scan: the coordinator gets the structured query_canceled."""
+    from filodb_tpu.parallel.testcluster import make_two_node_cluster
+    from filodb_tpu.ingest.generator import gauge_batch
+    cluster = make_two_node_cluster([gauge_batch(64, 60)], num_shards=4)
+    try:
+        qid_seen = []
+        orig_register = active_queries.register
+
+        def spy_register(qid, **kw):
+            if kw.get("role") == "remote":
+                qid_seen.append(qid)
+            return orig_register(qid, **kw)
+
+        s0 = 1_600_000_000
+        active_queries.register = spy_register
+        try:
+            res = cluster.engine.query_range("sum(heap_usage)",
+                                             s0 + 120, 15, s0 + 590)
+        finally:
+            active_queries.register = orig_register
+        assert res.error is None
+        # every remote dispatch registered under ONE query id
+        assert qid_seen and all(q == qid_seen[0] for q in qid_seen)
+    finally:
+        cluster.stop()
+
+
+# ---------------------------------------------- disconnect detection
+
+
+def test_client_disconnect_trips_token():
+    a, b = socket.socketpair()
+    try:
+        active_queries.watch_interval_s = 0.02
+        with bind_client_conn(b):
+            ent = active_queries.register("qdisc", promql="up",
+                                          tenant=("t", ""))
+        assert ent.client_conn is b
+        a.close()                        # the client hangs up mid-query
+        deadline = time.monotonic() + 3.0
+        while not ent.token.cancelled and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert ent.token.cancelled
+        assert ent.token.reason == "disconnect"
+        active_queries.deregister(ent, "killed")
+    finally:
+        active_queries.watch_interval_s = 0.1
+        b.close()
+
+
+# ------------------------------------------------- crash-durable file
+
+
+def test_crash_log_replay(tmp_path):
+    from filodb_tpu.utils.events import journal
+    path = str(tmp_path / "queries.active")
+    reg = ActiveQueryRegistry()
+    reg.configure(path=path)
+    done = reg.register("done1", promql="up", tenant=("t", ""))
+    reg.deregister(done, "completed")
+    reg.register("crashed1", promql="sum(rate(x[30d]))", tenant=("t", ""))
+    # "crash": a fresh process replays the file
+    reg2 = ActiveQueryRegistry()
+    reg2.configure(path=path)
+    seq0 = journal.next_seq
+    assert reg2.replay_crash_log() == 1
+    evs = [e for e in journal.since(seq0 - 1)
+           if e["kind"] == "query_active_at_crash"]
+    assert len(evs) == 1
+    assert evs[0]["query_id"] == "crashed1"
+    # file truncated: a second replay finds nothing
+    assert reg2.replay_crash_log() == 0
+
+
+# ------------------------------------------------------- HTTP routes
+
+
+def _api():
+    from filodb_tpu.http.routes import PromHttpApi
+    cfg = FilodbSettings()
+    cfg.query.tenant_usage_enabled = False
+    return PromHttpApi({}, config=cfg)
+
+
+def test_admin_queries_routes():
+    api = _api()
+    st, payload = api.handle("GET", "/admin/queries", {})
+    assert st == 200 and payload["data"]["count"] == 0
+    ent = active_queries.register("qhttp", promql="sum(up)",
+                                  tenant=("acme", "ns"), origin="query")
+    ent.set_phase("executing")
+    ent.add(samples=123, paged_bytes=456, dispatches=2)
+    ent.note_remote("127.0.0.1:9999")
+    st, payload = api.handle("GET", "/admin/queries", {})
+    assert st == 200
+    rows = payload["data"]["queries"]
+    assert len(rows) == 1
+    q = rows[0]
+    assert q["queryID"] == "qhttp" and q["phase"] == "executing"
+    assert q["counters"]["samplesScanned"] == 123
+    assert q["counters"]["bytesPaged"] == 456
+    assert q["remoteNodes"] == ["127.0.0.1:9999"]
+    # tenant filter
+    st, payload = api.handle("GET", "/admin/queries", {"tenant": "other"})
+    assert payload["data"]["count"] == 0
+    # detail + kill (propagation to the dead 9999 child is counted, not
+    # fatal)
+    st, payload = api.handle("GET", "/admin/queries/qhttp", {})
+    assert st == 200
+    st, payload = api.handle("POST", "/admin/queries/qhttp/kill", {})
+    assert st == 200 and payload["data"]["killed"] is True
+    assert payload["data"]["propagationErrors"] == 1
+    assert ent.token.cancelled
+    active_queries.deregister(ent, "killed")
+    # unknown id: 404, not an error
+    st, payload = api.handle("POST", "/admin/queries/qhttp/kill", {})
+    assert st == 404
+    # bad reason: 400
+    ent2 = active_queries.register("q2http", promql="up")
+    st, payload = api.handle("POST", "/admin/queries/q2http/kill",
+                             {"reason": "zap"})
+    assert st == 400
+    active_queries.deregister(ent2, "completed")
+
+
+def test_trace_verdict_and_slowlog_crosslink():
+    from filodb_tpu.utils.metrics import collector
+    from filodb_tpu.utils.slowlog import slowlog
+    api = _api()
+    tid = "croslnk1"
+    collector.record(tid, {"span": "execplan", "end_unix_s": 1.0})
+    collector.note_verdict(tid, "killed")
+    res = QueryResult([], error="query_canceled: killed")
+    res.trace_id = tid
+    slowlog.maybe_record("sum(up)", 0, 15, 600, 99.0, res,
+                         tenant=("t", ""), threshold_s=1.0)
+    st, payload = api.handle("GET", f"/admin/traces/{tid}", {})
+    assert st == 200
+    data = payload["data"]
+    assert data["verdict"] == "killed"
+    assert data["queryID"] == tid
+    assert isinstance(data.get("slowlogSeq"), int)
+    # the slowlog entry cross-links back: query id + verdict ride it
+    entry = [e for e in slowlog.entries() if e["trace_id"] == tid][-1]
+    assert entry["query_id"] == tid
+    assert entry["verdict"] == "killed"
+    assert entry["seq"] == data["slowlogSeq"]
+
+
+# ------------------------------------------- end-to-end kill via HTTP
+
+
+def test_frontend_kill_mid_execution_structured_error():
+    eng = _FakeEngine(block=True)
+    fe = _frontend(eng)
+    pp = PlannerParams()
+    out = {}
+
+    def client():
+        out["res"] = fe.query_range("up", 0, 15, 600, pp)
+
+    t = threading.Thread(target=client)
+    t.start()
+    deadline = time.monotonic() + 2.0
+    ent = None
+    while ent is None and time.monotonic() < deadline:
+        ents = active_queries.entries()
+        if ents:
+            ent = ents[0]
+        time.sleep(0.01)
+    assert ent is not None
+    active_queries.kill(ent.query_id, reason="admin")
+    t.join(timeout=3)
+    res = out["res"]
+    assert res.error is not None and res.error.startswith("query_canceled")
+    # verdict landed on the trace
+    from filodb_tpu.utils.metrics import collector
+    assert collector.verdict(res.trace_id) in ("killed", "")
+    # registry is clean again
+    assert active_queries.get(ent.query_id) == []

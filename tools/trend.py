@@ -41,6 +41,12 @@ of every headline metric is greppable in one file:
     in the fsync histogram + ingest slowlog + freshness histograms +
     health), ``ingest_freshness_p99_s`` — plus a loud
     ``ingesttrace_error``.
+  - the live-introspection numbers (PR 13):
+    ``activequeries_overhead_pct`` (gate: registry tax <= 2% of
+    concurrent QPS), ``activequeries_kill_structured`` /
+    ``activequeries_slot_freed`` / ``activequeries_listed_remote`` /
+    ``activequeries_stop_ms`` (gate: <= 250 ms) from the two-node
+    cold-query kill drill — plus a loud ``activequeries_error``.
 
 Existing hand-written round entries are MERGED, never clobbered: only
 missing keys are added, so curated notes survive re-runs.
@@ -98,6 +104,15 @@ CARRY = [
     "ingest_trace_stitched", "ingest_freshness_p99_s",
     "ingesttrace_fault_visible", "ingesttrace_gate_ok",
     "ingesttrace_error",
+    # live query introspection (ISSUE 13): the registry's tax on the
+    # concurrent-QPS stage (gate: <= 2%) and the two-node cold-query
+    # kill-drill evidence (structured query_canceled, semaphore slot
+    # freed, remote leaf drained within 250 ms) — plus a loud
+    # activequeries_error
+    "activequeries_overhead_pct", "activequeries_gate_ok",
+    "activequeries_kill_structured", "activequeries_stop_ms",
+    "activequeries_slot_freed", "activequeries_listed_remote",
+    "activequeries_kill_to_client_ms", "activequeries_error",
 ]
 RENAME = {"value": "headline_samples_per_sec",
           "p50_query_latency_s": "p50_s"}
