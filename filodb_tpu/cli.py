@@ -378,6 +378,37 @@ def cmd_queries(args) -> int:
         time.sleep(args.interval)
 
 
+def cmd_tenants(args) -> int:
+    """The per-tenant QoS control panel over HTTP (GET /admin/tenants):
+    one row per workspace — configured share, live running/queued
+    counts in the weighted-fair scheduler, lifetime sheds, and the
+    usage accountant's burn columns — once or continuously
+    (`--follow`).  The "a tenant is flooding the frontend" runbook's
+    first command (doc/operations.md)."""
+    while True:
+        payload = _http_get(args.host, "/admin/tenants", {})
+        if payload.get("status") != "success":
+            print(json.dumps(payload, indent=2))
+            return 1
+        if args.raw:
+            print(json.dumps(payload, indent=2))
+        else:
+            rows = payload["data"]["tenants"]
+            print(f"{'WS':<16} {'SHARE':>6} {'RUN':>4} {'QUEUED':>6} "
+                  f"{'SHED':>8} {'QUERIES':>9} {'Q_SECONDS':>10} "
+                  f"{'WIN_SCANNED':>12} {'REJECTED':>8}")
+            for t in rows:
+                print(f"{t['ws'] or '-':<16} {t['share']:>6g} "
+                      f"{t['running']:>4} {t['queued']:>6} "
+                      f"{t['shed']:>8} {t['queries']:>9} "
+                      f"{t['querySeconds']:>10.2f} "
+                      f"{t['windowSamplesScanned']:>12} "
+                      f"{t['rejected']:>8}")
+        if not args.follow:
+            return 0
+        time.sleep(args.interval)
+
+
 def cmd_events(args) -> int:
     """Tail the structured event journal over HTTP (GET /admin/events):
     newest events once, from a sequence number (`--since-seq`), or
@@ -751,6 +782,17 @@ def build_parser() -> argparse.ArgumentParser:
                     help="poll interval with --follow (seconds)")
     sp.add_argument("--raw", action="store_true", help="raw JSON")
     sp.set_defaults(fn=cmd_queries)
+
+    sp = sub.add_parser("tenants", help="per-tenant QoS table over HTTP "
+                                        "(usage + shares + live queue "
+                                        "depth)")
+    sp.add_argument("--host", required=True)
+    sp.add_argument("--follow", action="store_true",
+                    help="poll continuously")
+    sp.add_argument("--interval", type=float, default=2.0,
+                    help="poll interval with --follow (seconds)")
+    sp.add_argument("--raw", action="store_true", help="raw JSON")
+    sp.set_defaults(fn=cmd_tenants)
 
     sp = sub.add_parser("events", help="tail the event journal over HTTP")
     sp.add_argument("--host", required=True)

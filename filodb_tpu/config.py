@@ -93,6 +93,12 @@ class QueryConfig:
     # per-entry size cap — raw-selector queries over huge working sets
     # must not pin the result set in host RAM (aggregated dashboards do)
     result_cache_max_entry_bytes: int = 32 << 20
+    # per-tenant (_ws_) byte quota inside the result cache: inserting
+    # past it evicts that tenant's OWN oldest entries, never another
+    # tenant's — one tenant's dashboard churn cannot flush everyone
+    # else's warm entries (the cache half of noisy-neighbor isolation).
+    # 0 disables (global LRU only).
+    result_cache_tenant_quota_bytes: int = 64 << 20
     # byte-identical in-flight query_range requests share ONE execution
     # (singleflight dedup; `query_singleflight_hits` counts the shares)
     singleflight_enabled: bool = True
@@ -100,6 +106,32 @@ class QueryConfig:
     # followers don't count): keeps N dashboard fanouts from stampeding
     # the device dispatch path.  0 = unbounded.
     max_concurrent_queries: int = 8
+    # --- multi-tenant QoS (query/qos.py; doc/query_frontend.md) ---
+    # weighted-fair scheduling over the max_concurrent_queries capacity:
+    # per-workspace concurrency shares dispatched by deficit round robin
+    # (an idle tenant's share redistributes to the busy ones).  Keys are
+    # workspace (_ws_) names, values relative weights; absent tenants
+    # get tenant_default_share.  {} = every tenant equal.
+    tenant_shares: Dict[str, float] = dataclasses.field(
+        default_factory=dict)
+    tenant_default_share: float = 1.0
+    # per-tenant scheduler queue bound: a tenant with this many queries
+    # already WAITING is shed with the structured `tenant_overloaded`
+    # error (HTTP 429 + Retry-After) instead of queueing deeper.
+    # 0 = unbounded queues (shedding then only via the deadline check).
+    tenant_max_queue_depth: int = 32
+    # adaptive read-side load shedding (write-side parity with PR 7's
+    # ingest 429s): reject at admission when the PREDICTED queue wait —
+    # live queue depth x an EWMA of slot-hold times at the tenant's
+    # effective share — would blow the query's deadline budget.
+    # Internal workspaces (_rules_/_self_) are never shed.
+    shed_enabled: bool = True
+    # shuffle sharding (query/qos.shuffle_shard_nodes): each tenant's
+    # scatter-gather prefers a deterministic k-of-N subset of the data
+    # nodes when walking replica owner lists, bounding a hot tenant's
+    # blast radius.  0 disables (every tenant may land anywhere);
+    # only meaningful with replicated multi-node owner lists.
+    shuffle_shard_factor: int = 0
     # --- observability (PR 3) ---
     # slow-query flight recorder (utils/slowlog.py): queries whose total
     # serving wall exceeds this land in the /admin/slowlog ring buffer

@@ -158,6 +158,8 @@ class PromHttpApi:
                 return self._shard_handoff(parts[2], params, body)
             if parts[:2] == ["admin", "queries"] and len(parts) <= 4:
                 return self._active_queries(parts[2:], params, method)
+            if parts == ["admin", "tenants"] and method == "GET":
+                return self._tenants()
             if parts == ["admin", "events"] and method == "GET":
                 return self._events(params)
             if parts == ["admin", "rules", "reload"] and method == "POST":
@@ -210,7 +212,8 @@ class PromHttpApi:
                 # `stats=all` analogue): phase seconds + samples/bytes
                 # + cache verdicts, merged across every exec node
                 payload["stats"] = res.stats.to_dict()
-            return (200 if payload["status"] == "success" else 400), payload
+            status = 200 if payload["status"] == "success" else 400
+            return (_throttled_status(res, payload) or status), payload
         if rest == ["explain"]:
             q = params.get("query", "")
             start = _num_param(params, "start")
@@ -269,7 +272,8 @@ class PromHttpApi:
                 payload["traceID"] = res.trace_id
             if _want_stats(params):
                 payload["stats"] = res.stats.to_dict()
-            return (200 if payload["status"] == "success" else 400), payload
+            status = 200 if payload["status"] == "success" else 400
+            return (_throttled_status(res, payload) or status), payload
         if rest == ["labels"]:
             return self._metadata(eng, "labels", params, multi,
                                   planner_params=planner_params)
@@ -570,8 +574,11 @@ class PromHttpApi:
         unaccounted analyze verb would be a free pass around them."""
         res, rec, ep = self.frontends[dataset].analyze_range(
             q, start, step, end, planner_params)
-        if rec is None:                  # tenant admission rejected it
-            return 400, _err(res.error or "rejected")
+        if rec is None:                  # tenant admission rejected/shed it
+            # same errorType taxonomy as query_range (a shed analyze is
+            # "too_many_requests", not "bad_data" — clients route on it)
+            payload = _prom_error_payload(res) or _err("rejected")
+            return (_throttled_status(res, payload) or 400), payload
         if res.error:
             # same contract as query_range: execution failure is a 400
             # with status error, not a success-shaped payload
@@ -705,6 +712,11 @@ class PromHttpApi:
         # like the shard gauges — the serving hot path only bumps dicts
         from filodb_tpu.query.activequeries import active_queries
         active_queries.refresh_gauges()
+        # per-tenant scheduler queue depth (PR 14): same refresh-on-
+        # scrape pattern, read from each frontend's qos scheduler
+        for fe in self.frontends.values():
+            if fe.scheduler is not None:
+                fe.scheduler.refresh_gauges()
         # jit compile-cache sizes (device-side accounting, PR 3): a
         # compile storm — new shapes forcing fresh XLA compiles per
         # query — shows as these gauges climbing scrape over scrape,
@@ -783,6 +795,55 @@ class PromHttpApi:
         if ok:
             return 200, {"status": "ready"}
         return 503, {"status": "unready", "reason": reason}
+
+    def _tenants(self) -> Tuple[int, object]:
+        """GET /admin/tenants — the per-tenant QoS control panel in one
+        payload: usage-accountant rows (cumulative + rolling-window
+        burn) joined with the live scheduler state (share, running,
+        queued, lifetime sheds) merged across this node's frontends.
+        The `filo-cli tenants` table renders it; the runbook in
+        doc/operations.md reads it when a tenant floods the frontend."""
+        from filodb_tpu.utils.usage import usage
+        rows: Dict[str, dict] = {}
+
+        def row_for(ws: str) -> dict:
+            row = rows.get(ws)
+            if row is None:
+                row = rows[ws] = {
+                    "ws": ws,
+                    "share": self._qconfig.tenant_default_share,
+                    "running": 0, "queued": 0, "shed": 0,
+                    "queries": 0, "querySeconds": 0.0,
+                    "samplesScanned": 0, "ingestSamples": 0,
+                    "rejected": 0, "windowSamplesScanned": 0}
+            return row
+
+        # usage rows are per (ws, ns); the QoS unit is the workspace —
+        # fold namespaces together (the /api/v1/usage endpoint keeps
+        # the fine-grained split)
+        for r in usage.snapshot():
+            row = row_for(r["ws"])
+            row["queries"] += r["queries"]
+            row["querySeconds"] = round(
+                row["querySeconds"] + r["querySeconds"], 6)
+            row["samplesScanned"] += r["samplesScanned"]
+            row["ingestSamples"] += r["ingestSamples"]
+            row["rejected"] += r["rejected"]
+            row["windowSamplesScanned"] += r["windowSamplesScanned"]
+        for fe in self.frontends.values():
+            if fe.scheduler is None:
+                continue
+            for s in fe.scheduler.snapshot():
+                row = row_for(s["ws"])
+                row["share"] = s["share"]
+                row["running"] += s["running"]
+                row["queued"] += s["queued"]
+                row["shed"] += s["shed"]
+        out = sorted(rows.values(),
+                     key=lambda r: (-(r["queued"] + r["running"]),
+                                    -r["querySeconds"], r["ws"]))
+        return 200, {"status": "success",
+                     "data": {"count": len(out), "tenants": out}}
 
     def _jobs(self) -> Tuple[int, object]:
         """Unified background-job registry (utils/jobs.py): every
@@ -1326,3 +1387,19 @@ def _want_stats(params: Dict[str, str]) -> bool:
 
 def _err(msg: str) -> Dict[str, str]:
     return {"status": "error", "errorType": "bad_data", "error": msg}
+
+
+def _throttled_status(res, payload) -> Optional[int]:
+    """429 + Retry-After for read-side throttles — the scheduler's
+    `tenant_overloaded` sheds and the scan-limit `tenant_limit_exceeded`
+    rejections answer exactly like the write-side ingest limits (a
+    compliant client backs off instead of retrying into the overload).
+    Returns the status override (429) or None for every other result;
+    mutates the payload to carry the Retry-After header (same ceil
+    rule as the remote_write door)."""
+    err = getattr(res, "error", None) or ""
+    if not err.startswith(("tenant_overloaded", "tenant_limit_exceeded")):
+        return None
+    ra = float(getattr(res, "retry_after_s", 0.0) or 0.0)
+    payload["_headers"] = {"Retry-After": str(max(1, int(-(-ra // 1))))}
+    return 429

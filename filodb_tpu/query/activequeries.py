@@ -197,6 +197,8 @@ def verdict_of(result) -> str:
         return "killed"
     if err.startswith("query_timeout"):
         return "deadline"
+    if err.startswith("tenant_overloaded"):
+        return "shed"
     return "error"
 
 

@@ -78,6 +78,10 @@ class QueryEngine:
             # their own entry under the same query id)
             ctx.cancel = ent.token
             ctx.active = ent
+            # the tenant workspace rides the context so the replica-
+            # failover dispatcher can apply the tenant's shuffle-shard
+            # node preference (query/qos.py) at dispatch time
+            ctx.tenant_ws = ent.tenant_ws
         return ctx
 
     def _qconfig(self):
@@ -388,6 +392,11 @@ def _prom_error_payload(result: QueryResult) -> Optional[Dict]:
         etype = "timeout"
     elif result.error.startswith("query_canceled"):
         etype = "canceled"
+    elif result.error.startswith(("tenant_overloaded",
+                                  "tenant_limit_exceeded")):
+        # read-side throttles share the write side's errorType (the
+        # remote_write 429s use it too): clients route on it to back off
+        etype = "too_many_requests"
     else:
         etype = "query_error"
     return {"status": "error", "errorType": etype, "error": result.error}

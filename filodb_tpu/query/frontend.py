@@ -11,12 +11,19 @@ in front of the querier, not inside it):
   2. incremental result cache (query/resultcache.py) — a re-poll
      computes only the windows past the append horizon and merges them
      with the cached prefix.
-  3. scheduler — a semaphore bounds concurrently EXECUTING queries
-     (query.max_concurrent_queries), and the window-grid coalescer
-     (query/coalesce.py) still merges same-grid peers into one
-     engine.query_range_batch when query.batch_window_ms > 0.
+  3. scheduler — a WEIGHTED-FAIR scheduler (query/qos.py) bounds
+     concurrently EXECUTING queries (query.max_concurrent_queries) with
+     per-tenant queues, configurable concurrency shares and deficit-
+     round-robin dispatch (an idle tenant's share redistributes), plus
+     adaptive load shedding: queries whose predicted queue wait would
+     blow their deadline budget — or whose tenant queue is already at
+     query.tenant_max_queue_depth — are rejected at admission with the
+     structured `tenant_overloaded` error (HTTP 429 + Retry-After,
+     write-side parity with the ingest limits).  The window-grid
+     coalescer (query/coalesce.py) still merges same-grid peers into
+     one engine.query_range_batch when query.batch_window_ms > 0.
 
-Cache hits and dedup'd followers never touch the semaphore, so the
+Cache hits and dedup'd followers never touch the scheduler, so the
 bound applies exactly to the expensive device-dispatching work.
 """
 from __future__ import annotations
@@ -27,6 +34,8 @@ from typing import Dict, Optional, Tuple
 
 from filodb_tpu.core.shard import NO_HORIZON_MS
 from filodb_tpu.query.coalesce import QueryCoalescer
+from filodb_tpu.query.qos import (SHED_ERROR_CODE, WeightedFairScheduler,
+                                  account_wait)
 from filodb_tpu.query.rangevector import (PlannerParams, QueryResult,
                                           remaining_budget)
 from filodb_tpu.query.resultcache import ResultCache, _plan_cacheable
@@ -50,24 +59,6 @@ def _canceled_result(tok, where: str) -> QueryResult:
                                      else "")))
 
 
-def _acquire_cancellable(sem, timeout: float, tok) -> bool:
-    """Semaphore acquire in short slices: a killed request stops waiting
-    within ~50 ms and returns WITHOUT ever holding the slot (the
-    'kill during queue wait' contract — the follow-up query admits
-    immediately)."""
-    if tok is None:
-        return sem.acquire(timeout=timeout)
-    deadline = _time.perf_counter() + max(timeout, 0.0)
-    while True:
-        if tok.cancelled:
-            return False
-        left = deadline - _time.perf_counter()
-        if left <= 0:
-            return False
-        if sem.acquire(timeout=min(left, 0.05)):
-            return True
-
-
 class QueryFrontend:
     """Per-dataset serving frontend around one QueryEngine."""
 
@@ -80,13 +71,23 @@ class QueryFrontend:
         self.coalescer = QueryCoalescer(engine, window_s)
         self.cache: Optional[ResultCache] = (
             ResultCache(q.result_cache_max_entries,
-                        q.result_cache_max_entry_bytes)
+                        q.result_cache_max_entry_bytes,
+                        tenant_quota_bytes=q
+                        .result_cache_tenant_quota_bytes)
             if q.result_cache_enabled else None)
         self._sf_enabled = q.singleflight_enabled
         self._sf_lock = threading.Lock()
         self._inflight: Dict[Tuple, _Flight] = {}
         n = q.max_concurrent_queries
-        self._sem = threading.BoundedSemaphore(n) if n > 0 else None
+        # weighted-fair admission over the execution capacity (PR 14):
+        # the old global BoundedSemaphore let one abusive tenant fill
+        # every slot; the scheduler dispatches per-tenant queues by
+        # deficit round robin and sheds doomed queries at admission
+        self._sched = WeightedFairScheduler(
+            n, shares=q.tenant_shares,
+            default_share=q.tenant_default_share,
+            max_queue_depth=q.tenant_max_queue_depth,
+            shed_enabled=q.shed_enabled) if n > 0 else None
         self._ask_timeout_s = q.ask_timeout_s
         # promql -> cacheability memo (parse once per distinct string)
         self._cacheable: Dict[str, bool] = {}
@@ -98,8 +99,20 @@ class QueryFrontend:
         # --- failure-domain hardening (PR 4): end-to-end deadlines ---
         self._default_timeout_s = q.default_timeout_s
         self._allow_partial_default = q.allow_partial_results
+        # shed slowlog records are rate-limited PER TENANT (one per
+        # second): a flood producing hundreds of sheds/s must not turn
+        # the flight recorder into the overload's biggest CPU consumer —
+        # the counter counts every shed; the slowlog keeps representative
+        # records
+        self._last_shed_log: Dict[str, float] = {}
 
     # ------------------------------------------------------------ public
+
+    @property
+    def scheduler(self):
+        """The weighted-fair admission scheduler (query/qos.py), or
+        None when max_concurrent_queries == 0 (unbounded)."""
+        return self._sched
 
     def query_range(self, promql: str, start_s: int, step_s: int,
                     end_s: int, planner_params=None):
@@ -150,7 +163,13 @@ class QueryFrontend:
             err = usage.admit(tenant[0], tenant[1], self._warn_limit,
                               self._fail_limit)
             if err is not None:
-                return QueryResult([], error=err)
+                res = QueryResult([], error=err)
+                # scan-limit 429s answer with the same Retry-After
+                # contract as the ingest limits and the overload sheds:
+                # seconds until the tenant's rolling window resets
+                res.retry_after_s = usage.scan_retry_after(tenant[0],
+                                                           tenant[1])
+                return res
         if tenant is None:
             tenant = ("", "")
         # live introspection (query/activequeries.py): mark the request
@@ -176,9 +195,26 @@ class QueryFrontend:
                 usage.record_query(tenant[0], tenant[1], dur,
                                    res.stats.samples_scanned,
                                    res.stats.result_bytes)
+            # shed queries are force-recorded (verdict `shed`): an
+            # operator triaging "why is this tenant getting 429s" reads
+            # the actual shed requests, not just a counter — they never
+            # cross the slow threshold on their own (shedding is fast;
+            # that is the point).  Rate-limited to one record per tenant
+            # per second so a shed storm can't make the recorder itself
+            # a load source.
+            shed = (res is not None and res.error is not None
+                    and res.error.startswith(SHED_ERROR_CODE))
+            if shed:
+                now = _time.monotonic()
+                shed = now - self._last_shed_log.get(tenant[0],
+                                                     -1e9) >= 1.0
+                if shed:
+                    if len(self._last_shed_log) > 1024:
+                        self._last_shed_log.clear()  # hostile ws churn
+                    self._last_shed_log[tenant[0]] = now
             slowlog.maybe_record(promql, grid[0], grid[1], grid[2], dur,
                                  res, tenant=tenant, origin=origin,
-                                 threshold_s=self._slow_s)
+                                 threshold_s=self._slow_s, force=shed)
             # serving-latency histogram with the trace id as its
             # OpenMetrics exemplar (p99 spike -> the exact trace in one
             # hop), and the trace tagged with its door for the
@@ -315,26 +351,33 @@ class QueryFrontend:
         # plain attribute, NOT a dataclass field: remote-dispatched
         # subtrees must serialize without it (see AnalyzeRecorder doc)
         ctx.analyze = rec
-        sem = self._sem
-        waited = 0.0
-        acquired = False
+        sched = self._sched
+        adm = None
         res = None
-        if sem is not None:
-            tq = _time.perf_counter()
-            acquired = _acquire_cancellable(
-                sem, self._ask_timeout_s,
-                ent.token if ent is not None else None)
-            waited = _time.perf_counter() - tq
+        if sched is not None:
+            adm = sched.admit(
+                tenant[0],
+                remaining_budget(planner_params, self._ask_timeout_s),
+                ent.token if ent is not None else None,
+                deadline_unix_s=planner_params.deadline_unix_s)
+            if adm.status == "shed":
+                # analyze is accounted and scheduled like any query —
+                # and therefore SHED like any query (an unsheddable
+                # analyze verb would be a free pass around the overload
+                # protection, exactly like the limits)
+                res = self._shed_result(tenant[0], adm)
+                active_queries.deregister(ent, verdict_of(res))
+                return res, None, None
         try:
             if ent is not None:
                 ent.set_phase("executing")
             res = ep.execute(self.engine.source)
         finally:
-            if acquired:
-                sem.release()
+            if adm is not None and adm.acquired:
+                sched.release(tenant[0])
             active_queries.deregister(ent, verdict_of(res))
         res.trace_id = ctx.query_id
-        res.stats.queue_wait_s += waited
+        account_wait(res, adm)
         dur = _time.perf_counter() - t0
         if self._usage_enabled:
             usage.record_query(tenant[0], tenant[1], dur,
@@ -389,6 +432,7 @@ class QueryFrontend:
                                                     take_pending,
                                                     verdict_of)
         info = take_pending()
+        ws = info[0][0] if info is not None else ""
         ent = None
         if info is not None:
             from filodb_tpu.utils.metrics import mint_trace_id
@@ -396,63 +440,83 @@ class QueryFrontend:
                                           tenant=info[0], origin=info[1])
         if ent is None:
             return self._run_scheduled(promql, start_s, step_s, end_s,
-                                       pp, None)
+                                       pp, None, ws)
         set_admission(ent)
         res = None
         try:
             res = self._run_scheduled(promql, start_s, step_s, end_s,
-                                      pp, ent)
+                                      pp, ent, ws)
             return res
         finally:
             take_admission()         # clear if the engine never adopted
             active_queries.deregister(ent, verdict_of(res))
 
-    def _run_scheduled(self, promql, start_s, step_s, end_s, pp, ent):
-        sem = self._sem
+    def _shed_result(self, ws: str, adm) -> QueryResult:
+        """One home for the shed surface: the structured
+        tenant_overloaded result (Retry-After riding along for the HTTP
+        edge), the queries_shed{ws,reason} counter, and the queue-wait
+        attribution every outcome gets.  The counter tags the
+        scheduler's FOLDED ws (adm.ws), never the raw client-controlled
+        one — hostile ws churn must not grow metric cardinality."""
+        from filodb_tpu.utils.metrics import registry
+        registry.counter("queries_shed", ws=adm.ws or ws,
+                         reason=adm.reason).increment()
+        res = QueryResult([], error=adm.shed_error())
+        res.retry_after_s = adm.retry_after_s
+        account_wait(res, adm)
+        return res
+
+    def _run_scheduled(self, promql, start_s, step_s, end_s, pp, ent,
+                       ws=""):
+        sched = self._sched
         tok = ent.token if ent is not None else None
-        if sem is None:
+        if sched is None:
             return self.coalescer.query_range(promql, start_s, step_s,
                                               end_s, pp)
-        # never fail a query on queue pressure ALONE: a full queue just
-        # means this request executes unthrottled after the wait
-        # (observable via the counter rather than a user-visible error).
-        # The query's DEADLINE does bound the wait, though — time queued
-        # spends from the same end-to-end budget as execution, and a
-        # request whose budget died in the queue returns the structured
-        # query_timeout error instead of launching doomed work.
+        # weighted-fair admission: the tenant's queue, the tenant's
+        # share.  Shedding happens HERE, before any wait — a query whose
+        # predicted queue wait would blow its deadline (or whose tenant
+        # queue is full) 429s immediately instead of burning a slot
+        # until query_timeout.  Past that gate the pre-QoS stances hold:
+        # never fail a query on queue pressure alone (a scheduler-wait
+        # timeout runs unthrottled, observable via the counter), but the
+        # DEADLINE does bound the wait — time queued spends from the
+        # same end-to-end budget as execution.
         dl = getattr(pp, "deadline_unix_s", 0.0) if pp is not None else 0.0
         timeout = remaining_budget(pp, self._ask_timeout_s)
-        t0 = _time.perf_counter()
-        acquired = _acquire_cancellable(sem, timeout, tok)
-        waited = _time.perf_counter() - t0
-        if not acquired and not (tok is not None and tok.cancelled):
-            from filodb_tpu.utils.metrics import registry
-            registry.counter("query_scheduler_timeouts").increment()
+        adm = sched.admit(ws, timeout, tok, deadline_unix_s=dl)
+        if adm.status == "shed":
+            return self._shed_result(ws, adm)
         try:
-            if tok is not None and tok.cancelled:
+            if adm.status == "cancelled" or (tok is not None
+                                             and tok.cancelled):
                 # killed while queued: the structured error, with the
                 # slot either never held (kill interrupted the wait) or
                 # released by the finally below before anyone noticed
                 res = _canceled_result(tok, "in the scheduler queue")
-                res.stats.queue_wait_s += waited
+                account_wait(res, adm)
                 return res
             if dl and _time.time() >= dl:
                 from filodb_tpu.utils.metrics import registry
                 registry.counter("query_timeouts_in_queue").increment()
                 res = QueryResult(
                     [], error=("query_timeout: deadline exceeded after "
-                               f"{waited:.3f}s in the scheduler queue"))
-                res.stats.queue_wait_s += waited
+                               f"{adm.waited_s:.3f}s in the scheduler "
+                               "queue"))
+                account_wait(res, adm)
                 return res
+            if not adm.acquired:
+                from filodb_tpu.utils.metrics import registry
+                registry.counter("query_scheduler_timeouts").increment()
             res = self.coalescer.query_range(promql, start_s, step_s,
                                              end_s, pp)
             # queue attribution: scheduler wait is part of the query's
             # serving cost but not of any exec node's cpu time
-            res.stats.queue_wait_s += waited
+            account_wait(res, adm)
             return res
         finally:
-            if acquired:
-                sem.release()
+            if adm.acquired:
+                sched.release(ws)
 
     def _promql_cacheable(self, promql: str) -> bool:
         ok = self._cacheable.get(promql)

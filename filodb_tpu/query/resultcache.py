@@ -96,6 +96,7 @@ class _Entry:
     immutable_upto: int                        # wends <= this are final
     token: Tuple                               # shard series-set identity
     nbytes: int
+    ws: str = ""                               # owning tenant workspace
 
 
 def _series_map(res: QueryResult, width: int) -> Optional[
@@ -120,18 +121,32 @@ class ResultCache:
 
     def __init__(self, max_entries: int = 256,
                  max_entry_bytes: int = 32 << 20,
-                 max_total_bytes: int = 256 << 20):
+                 max_total_bytes: int = 256 << 20,
+                 tenant_quota_bytes: int = 0):
         self.max_entries = max_entries
         self.max_entry_bytes = max_entry_bytes
         self.max_total_bytes = max_total_bytes
+        # per-tenant (_ws_) byte quota — the cache half of noisy-
+        # neighbor isolation (query.result_cache_tenant_quota_bytes):
+        # inserting past it evicts the tenant's OWN oldest entries, and
+        # an entry that cannot fit inside the quota is rejected outright
+        # — another tenant's entry is NEVER evicted to make room for an
+        # over-quota one.  0 disables (global LRU only).
+        self.tenant_quota_bytes = tenant_quota_bytes
         self._lock = threading.Lock()
         self._entries: Dict[Tuple, _Entry] = {}
         self._total_bytes = 0
+        self._tenant_bytes: Dict[str, int] = {}
 
     def clear(self) -> None:
         with self._lock:
             self._entries.clear()
             self._total_bytes = 0
+            self._tenant_bytes.clear()
+
+    def tenant_bytes(self, ws: str) -> int:
+        with self._lock:
+            return self._tenant_bytes.get(ws, 0)
 
     def __len__(self) -> int:
         return len(self._entries)
@@ -187,6 +202,14 @@ class ResultCache:
         registry.counter("query_result_cache_partial_hits").increment()
         tail_start_s = int(wends_new[n_reuse]) // 1000
         tail = run(tail_start_s, end_s)
+        if tail.error is not None and tail.error.startswith(
+                "tenant_overloaded"):
+            # the scheduler SHED the tail run: the cached prefix is
+            # still perfectly valid (nothing about the data changed) —
+            # keep it, surface the 429 as-is, and do NOT burn a second
+            # full run through the very admission gate that just shed
+            # us (that would amplify load exactly when shedding it)
+            return tail
         if tail.error is not None or tail.partial or tail.data is not None:
             # errors/partials must surface exactly as a full run would —
             # and never be merged into or stored over good windows.  Drop
@@ -248,7 +271,15 @@ class ResultCache:
         with self._lock:
             if self._entries.get(key) is ent:
                 del self._entries[key]
-                self._total_bytes -= ent.nbytes
+                self._uncount_locked(ent)
+
+    def _uncount_locked(self, ent: _Entry) -> None:
+        self._total_bytes -= ent.nbytes
+        left = self._tenant_bytes.get(ent.ws, 0) - ent.nbytes
+        if left > 0:
+            self._tenant_bytes[ent.ws] = left
+        else:
+            self._tenant_bytes.pop(ent.ws, None)
 
     def _store(self, key, wends: np.ndarray, res: QueryResult, token,
                horizon_ms: int) -> None:
@@ -267,16 +298,44 @@ class ResultCache:
     def _insert(self, key, ent: _Entry) -> None:
         if ent.nbytes > self.max_entry_bytes:
             return
+        # the owning tenant: the query's _ws_ shard key (memoized parse)
+        from filodb_tpu.utils.usage import tenant_of
+        ent.ws = tenant_of(key[0])[0]
+        quota = self.tenant_quota_bytes
+        if quota and ent.nbytes > quota:
+            # over-quota entries are REJECTED, never fitted by evicting
+            # someone else (isolation invariant: a tenant's churn only
+            # ever costs that tenant's entries under the quota rule)
+            from filodb_tpu.utils.metrics import registry
+            registry.counter("result_cache_tenant_quota_rejections",
+                             ws=ent.ws).increment()
+            return
         with self._lock:
             old = self._entries.pop(key, None)
             if old is not None:
-                self._total_bytes -= old.nbytes
+                self._uncount_locked(old)
+            if quota:
+                # evict this tenant's OWN oldest entries until the new
+                # one fits inside its quota — other tenants' entries are
+                # untouchable here by construction
+                while self._tenant_bytes.get(ent.ws, 0) + ent.nbytes \
+                        > quota:
+                    victim = next((k for k, e in self._entries.items()
+                                   if e.ws == ent.ws), None)
+                    if victim is None:
+                        break
+                    self._uncount_locked(self._entries.pop(victim))
+                    from filodb_tpu.utils.metrics import registry
+                    registry.counter("result_cache_tenant_quota_evictions",
+                                     ws=ent.ws).increment()
             self._entries[key] = ent
             self._total_bytes += ent.nbytes
+            self._tenant_bytes[ent.ws] = \
+                self._tenant_bytes.get(ent.ws, 0) + ent.nbytes
             while self._entries and (
                     len(self._entries) > self.max_entries
                     or self._total_bytes > self.max_total_bytes):
                 if len(self._entries) == 1:
                     break                # always keep the newest entry
                 k = next(iter(self._entries))
-                self._total_bytes -= self._entries.pop(k).nbytes
+                self._uncount_locked(self._entries.pop(k))

@@ -26,17 +26,51 @@ _log = logging.getLogger("filodb.replication")
 
 class ReplicaFailoverDispatcher(PlanDispatcher):
     """Ordered owner list -> first owner that answers.  `targets` is
-    [(node_name, dispatcher)] in assignment order (primary first)."""
+    [(node_name, dispatcher)] in assignment order (primary first).
+
+    Shuffle sharding (query.shuffle_shard_factor > 0): when the plan's
+    context carries a tenant workspace, the walk order is re-ranked so
+    owners inside the tenant's deterministic k-of-N node subset
+    (qos.shuffle_shard_nodes over `all_nodes`, the cluster's node
+    universe) are tried FIRST — each tenant's scatter-gather load lands
+    on a bounded, tenant-stable blast radius, and a hot tenant browns
+    out its own subset before anyone else's.  Failover semantics are
+    unchanged: non-preferred owners remain fallbacks, so availability
+    never loses to affinity."""
 
     def __init__(self, targets: Sequence[Tuple[str, PlanDispatcher]],
-                 shard: Optional[int] = None):
+                 shard: Optional[int] = None,
+                 all_nodes: Optional[Sequence[str]] = None,
+                 shuffle_k: Optional[int] = None):
         self.targets = list(targets)
         self.shard = shard
+        self.all_nodes = list(all_nodes) if all_nodes else \
+            [n for n, _ in self.targets]
+        self.shuffle_k = shuffle_k
+
+    def _walk_order(self, plan) -> Sequence[Tuple[str, PlanDispatcher]]:
+        ws = getattr(getattr(plan, "ctx", None), "tenant_ws", "")
+        k = self.shuffle_k
+        if k is None:
+            from filodb_tpu.config import settings
+            k = settings().query.shuffle_shard_factor
+        if not ws or k <= 0 or len(self.targets) < 2:
+            return self.targets
+        from filodb_tpu.query.qos import shuffle_shard_nodes
+        preferred = set(shuffle_shard_nodes(ws, self.all_nodes, k))
+        ordered = ([t for t in self.targets if t[0] in preferred]
+                   + [t for t in self.targets if t[0] not in preferred])
+        if ordered[0][0] != self.targets[0][0]:
+            from filodb_tpu.utils.metrics import registry
+            registry.counter("query_shuffle_shard_routed",
+                             ws=ws).increment()
+        return ordered
 
     def dispatch(self, plan, source):
         from filodb_tpu.utils.metrics import registry
         last: Optional[QueryError] = None
-        for i, (node, disp) in enumerate(self.targets):
+        targets = self._walk_order(plan)
+        for i, (node, disp) in enumerate(targets):
             try:
                 out = disp.dispatch(plan, source)
                 if i > 0:
@@ -50,10 +84,10 @@ class ReplicaFailoverDispatcher(PlanDispatcher):
                 if e.code != "shard_unavailable":
                     raise
                 last = e
-                if i + 1 < len(self.targets):
+                if i + 1 < len(targets):
                     _log.debug("shard %s owner %s unavailable (%s) — "
                                "failing over to %s", self.shard, node,
-                               e, self.targets[i + 1][0])
+                               e, targets[i + 1][0])
         if last is None:
             raise QueryError(
                 "shard_unavailable",
@@ -67,14 +101,17 @@ class ReplicaFailoverDispatcher(PlanDispatcher):
 def failover_dispatcher_factory(
         mapper, dispatcher_for: Callable[[str], PlanDispatcher],
         local_node: Optional[str] = None,
-        local_dispatcher: Optional[PlanDispatcher] = None
+        local_dispatcher: Optional[PlanDispatcher] = None,
+        shuffle_k: Optional[int] = None
         ) -> Callable[[int], Optional[PlanDispatcher]]:
     """Build a planner `dispatcher_factory(shard)` from a replica-aware
     ShardMapper: each shard's dispatcher walks its CURRENT owner list
     (read per materialization, so a promotion or handoff cutover is
     picked up by the very next query).  `dispatcher_for(node)` dials a
     remote owner; `local_node`'s copy (when this process IS an owner)
-    executes through `local_dispatcher` (defaults to in-process)."""
+    executes through `local_dispatcher` (defaults to in-process).
+    `shuffle_k` pins the shuffle-shard subset size (None = the
+    query.shuffle_shard_factor setting at dispatch time)."""
     from filodb_tpu.query.execbase import InProcessPlanDispatcher
 
     def factory(shard: int) -> Optional[PlanDispatcher]:
@@ -96,6 +133,14 @@ def failover_dispatcher_factory(
                 targets.append((node, dispatcher_for(node)))
         if len(targets) == 1:
             return targets[0][1]
-        return ReplicaFailoverDispatcher(targets, shard=shard)
+        # the node universe for the tenant's k-of-N subset: every node
+        # holding any copy of any shard (snapshot per materialization,
+        # like the owner list)
+        all_nodes = sorted(
+            {n for n in mapper.nodes if n is not None}
+            | {n for repls in mapper.replicas for n in repls})
+        return ReplicaFailoverDispatcher(targets, shard=shard,
+                                         all_nodes=all_nodes,
+                                         shuffle_k=shuffle_k)
 
     return factory

@@ -103,6 +103,36 @@ class FiloServer:
             max_entries=self.config.query.slowlog_max_entries,
             path=self.config.query.slowlog_path)
         usage.window_s = self.config.query.tenant_limit_window_s
+        # multi-tenant QoS (query/qos.py): validate the share map at
+        # boot — a typo'd share must fail the deploy loudly, not
+        # silently schedule that tenant at the default — and journal the
+        # effective config so "who had what share when" is answerable
+        # from the flight recorder next to the overload events
+        qc = self.config.query
+        from filodb_tpu.config import ConfigError
+        for ws, share in qc.tenant_shares.items():
+            try:
+                bad = not (float(share) > 0)
+            except (TypeError, ValueError):
+                bad = True
+            if bad:
+                raise ConfigError(
+                    f"query.tenant_shares.{ws}: expected a positive "
+                    f"number, got {share!r}")
+        if qc.tenant_max_queue_depth < 0:
+            raise ConfigError("query.tenant_max_queue_depth must be "
+                              ">= 0 (0 = unbounded)")
+        if qc.shuffle_shard_factor < 0:
+            raise ConfigError("query.shuffle_shard_factor must be "
+                              ">= 0 (0 = disabled)")
+        journal.emit(
+            "qos_config", subsystem="query",
+            max_concurrent=qc.max_concurrent_queries,
+            shares=",".join(f"{k}={float(v):g}" for k, v in
+                            sorted(qc.tenant_shares.items())) or "equal",
+            max_queue_depth=qc.tenant_max_queue_depth,
+            shed_enabled=qc.shed_enabled,
+            shuffle_shard_factor=qc.shuffle_shard_factor)
         # write-path observability (doc/observability.md): the ingest
         # flight recorder, the freshness SLO fold feeding the health
         # evaluator's `ingest` verdict, the exemplar toggle, and the

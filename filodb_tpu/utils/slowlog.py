@@ -111,13 +111,17 @@ class SlowQueryLog(_RingLog):
                      end_s: int, duration_s: float, result,
                      tenant: Tuple[str, str] = ("", ""),
                      origin: str = "query_range",
-                     threshold_s: Optional[float] = None) -> bool:
+                     threshold_s: Optional[float] = None,
+                     force: bool = False) -> bool:
         """Record iff duration crossed the threshold (the caller's
         config override wins over the singleton's).  `result` is the
-        QueryResult (stats + trace_id + error ride along).  Returns
-        whether a record was taken."""
+        QueryResult (stats + trace_id + error ride along).  `force`
+        records regardless of duration — the frontend uses it for SHED
+        queries (verdict `shed`), which are fast by design but exactly
+        what an operator triaging a tenant's 429s needs to read.
+        Returns whether a record was taken."""
         thr = self.threshold_s if threshold_s is None else threshold_s
-        if thr <= 0 or duration_s < thr:
+        if not force and (thr <= 0 or duration_s < thr):
             return False
         from filodb_tpu.query.activequeries import verdict_of
         from filodb_tpu.utils.metrics import collector, registry
@@ -149,10 +153,13 @@ class SlowQueryLog(_RingLog):
             "spans": spans,
         }
         self._append(rec)
-        registry.counter("slow_queries", origin=origin).increment()
-        log.warning("slow query (%.2fs > %.2fs): %s [%s..%s step %s] "
-                    "trace=%s", duration_s, thr, promql,
-                    start_s, end_s, step_s, trace_id)
+        if duration_s >= thr > 0:
+            # genuinely slow (force-recorded sheds keep their own
+            # queries_shed accounting — they are fast, that's the point)
+            registry.counter("slow_queries", origin=origin).increment()
+            log.warning("slow query (%.2fs > %.2fs): %s [%s..%s step %s] "
+                        "trace=%s", duration_s, thr, promql,
+                        start_s, end_s, step_s, trace_id)
         return True
 
     def seq_for_trace(self, trace_id: str) -> Optional[int]:

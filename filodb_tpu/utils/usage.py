@@ -106,6 +106,25 @@ class UsageAccountant:
             t.ingest_win_start = now
             t.win_ingest = 0
 
+    def _retry_after(self, win_start: float, now: float) -> float:
+        """Seconds until a rolling window (scan OR ingest) resets — the
+        Retry-After value every 429 this accountant produces shares, so
+        scan-limit rejections, ingest rejections and the scheduler's
+        overload sheds all answer a compliant client identically."""
+        return max(self.window_s - (now - win_start), 0.001)
+
+    def scan_retry_after(self, ws: str, ns: str) -> float:
+        """Retry-After for a scan-limit (admit) rejection: how long
+        until this tenant's scan window rolls and queries admit again.
+        The read-side twin of admit_ingest's return value."""
+        now = time.monotonic()
+        with self._lock:
+            t = self._tenants.get(self.resolve(ws, ns))
+            if t is None:
+                return 0.001
+            self._roll(t, now)
+            return self._retry_after(t.win_start, now)
+
     # ----------------------------------------------------------- account
 
     def record_query(self, ws: str, ns: str, seconds: float,
@@ -202,8 +221,7 @@ class UsageAccountant:
             self._roll(t, now)
             if t.win_ingest > fail_limit:
                 t.ingest_rejected += 1
-                retry_after = max(
-                    self.window_s - (now - t.ingest_win_start), 0.001)
+                retry_after = self._retry_after(t.ingest_win_start, now)
             else:
                 t.win_ingest += samples
                 retry_after = None

@@ -259,14 +259,16 @@ def test_deadline_expiry_in_scheduler_queue_attributes_queue_wait():
     cfg.query.max_concurrent_queries = 1
     fe = QueryFrontend(eng, config=cfg)
     # hog the single execution slot so the query dies IN THE QUEUE
-    assert fe._sem.acquire(timeout=1.0)
+    # (the qos scheduler replaced the semaphore; an admit under another
+    # tenant's name holds the one global capacity slot the same way)
+    assert fe.scheduler.admit("hog", 1.0).acquired
     try:
         t0 = time.perf_counter()
         res = fe.query_range(Q, S + 600, 60, S + 3600,
                              PlannerParams(timeout_s=0.3))
         waited = time.perf_counter() - t0
     finally:
-        fe._sem.release()
+        fe.scheduler.release("hog")
     assert res.error is not None and res.error.startswith("query_timeout")
     assert "queue" in res.error
     # queue wait is attributed in the stats the error ships with

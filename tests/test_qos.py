@@ -1,0 +1,523 @@
+"""Multi-tenant QoS (PR 14): the weighted-fair scheduler, adaptive
+read-side shedding, result-cache tenant quotas, and shuffle sharding.
+
+The edge-case matrix the ISSUE names explicitly:
+  * share redistribution when a tenant goes idle mid-burst (and no
+    credit banking while idle)
+  * kill during tenant-queue wait releases the right queue slot
+  * result-cache quota eviction never evicts another tenant's entry to
+    fit an over-quota one
+Plus: DRR honors weights under saturation, queue-full/deadline sheds
+carry Retry-After and surface as HTTP 429, internal workspaces are
+never shed, and the scan-limit 429s answer like the ingest ones.
+"""
+import collections
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from filodb_tpu.config import FilodbSettings
+from filodb_tpu.query.activequeries import (CancellationToken,
+                                            active_queries, verdict_of)
+from filodb_tpu.query.qos import (Admission, WeightedFairScheduler,
+                                  account_wait, shuffle_shard_nodes)
+from filodb_tpu.query.rangevector import QueryResult
+from filodb_tpu.utils.usage import UsageAccountant
+
+
+# ------------------------------------------------------- DRR mechanics
+
+
+def _saturate(sched, shares_of_tenants, dur_s=1.2, workers_per=3,
+              work_s=0.002):
+    """Saturating workers per tenant; returns grant counts."""
+    grants = collections.Counter()
+    stop = threading.Event()
+
+    def worker(ws):
+        while not stop.is_set():
+            adm = sched.admit(ws, 5.0)
+            if adm.acquired:
+                grants[ws] += 1
+                time.sleep(work_s)
+                sched.release(ws)
+
+    threads = [threading.Thread(target=worker, args=(ws,))
+               for ws in shares_of_tenants for _ in range(workers_per)]
+    for t in threads:
+        t.start()
+    time.sleep(dur_s)
+    stop.set()
+    for t in threads:
+        t.join(timeout=5)
+    return grants
+
+
+def test_drr_equal_shares_split_evenly():
+    sched = WeightedFairScheduler(1, shed_enabled=False)
+    g = _saturate(sched, ["a", "b", "c"])
+    lo, hi = min(g.values()), max(g.values())
+    assert lo > 0 and hi / lo < 1.3
+
+
+def test_drr_weighted_shares_honored():
+    """A share of 3 is worth ~3x the grants of a share of 1 under
+    saturation — the bug class where rotation hands every tenant one
+    grant per round regardless of weight."""
+    sched = WeightedFairScheduler(1, shares={"big": 3.0},
+                                  shed_enabled=False)
+    g = _saturate(sched, ["big", "small"])
+    ratio = g["big"] / max(g["small"], 1)
+    assert 2.2 < ratio < 4.0, g
+
+
+def test_share_redistribution_when_tenant_goes_idle_mid_burst():
+    """Mid-burst, one tenant stops: the other's grant rate must absorb
+    the freed share (work conservation), and the returning tenant must
+    NOT burst past its share on banked deficit."""
+    sched = WeightedFairScheduler(1, shed_enabled=False)
+    counts = collections.Counter()
+    stop_b = threading.Event()
+    stop_all = threading.Event()
+
+    def worker(ws, stop_mine):
+        while not (stop_all.is_set() or stop_mine.is_set()):
+            adm = sched.admit(ws, 5.0)
+            if adm.acquired:
+                counts[ws] += 1
+                time.sleep(0.002)
+                sched.release(ws)
+
+    threads = [threading.Thread(target=worker,
+                                args=("a", threading.Event()))
+               for _ in range(2)]
+    threads += [threading.Thread(target=worker, args=("b", stop_b))
+                for _ in range(2)]
+    for t in threads:
+        t.start()
+    time.sleep(0.6)
+    phase1 = dict(counts)
+    stop_b.set()                          # b goes idle mid-burst
+    time.sleep(0.3)                       # let b's queue drain fully
+    a_mark = counts["a"]
+    t0 = time.monotonic()
+    time.sleep(0.6)
+    a_rate_solo = (counts["a"] - a_mark) / (time.monotonic() - t0)
+    stop_all.set()
+    for t in threads:
+        t.join(timeout=5)
+    # phase 1 split roughly evenly...
+    assert phase1["a"] > 0 and phase1["b"] > 0
+    assert phase1["b"] / phase1["a"] > 0.6
+    # ...and a's solo rate absorbed b's share (≈ 2x its shared rate)
+    a_rate_shared = phase1["a"] / 0.6
+    assert a_rate_solo > 1.5 * a_rate_shared
+    # b forfeited its banked deficit while idle: the scheduler's
+    # rotation no longer contains it and its deficit is gone
+    assert "b" not in sched._order
+    assert "b" not in sched._deficit
+
+
+def test_kill_during_tenant_queue_wait_releases_right_slot():
+    """A cancelled waiter leaves ITS tenant queue (not another's), the
+    slot is never held, and a follow-up admit for the same tenant goes
+    straight through once capacity frees."""
+    sched = WeightedFairScheduler(1)
+    hold = sched.admit("hog", 1.0)
+    assert hold.acquired
+    tok = CancellationToken()
+    other_queued = threading.Event()
+
+    def other():
+        # an innocent bystander queued under a different tenant
+        other_queued.set()
+        adm = sched.admit("bystander", 5.0)
+        assert adm.acquired
+        sched.release("bystander")
+
+    t_other = threading.Thread(target=other)
+    t_other.start()
+    other_queued.wait(1.0)
+    time.sleep(0.05)
+    got = {}
+
+    def victim():
+        got["adm"] = sched.admit("victim", 5.0, tok=tok)
+
+    t = threading.Thread(target=victim)
+    t.start()
+    time.sleep(0.15)                      # victim is queued
+    assert sched.queue_depths().get("victim") == 1
+    tok.cancel("admin")
+    t.join(timeout=2)
+    assert not t.is_alive()
+    assert got["adm"].status == "cancelled"
+    # the RIGHT queue slot was released: victim's queue is empty, the
+    # bystander still waits (then gets the slot on release)
+    assert sched.queue_depths().get("victim", 0) == 0
+    assert sched.queue_depths().get("bystander") == 1
+    sched.release("hog")
+    t_other.join(timeout=2)
+    assert not t_other.is_alive()
+
+
+def test_shed_on_queue_full_with_retry_after():
+    sched = WeightedFairScheduler(1, max_queue_depth=1)
+    assert sched.admit("t", 1.0).acquired
+
+    def queued():
+        adm = sched.admit("t", 5.0)
+        if adm.acquired:
+            sched.release("t")
+
+    t = threading.Thread(target=queued)
+    t.start()
+    time.sleep(0.1)                       # fill the depth-1 queue
+    adm = sched.admit("t", 1.0)
+    assert adm.status == "shed" and adm.reason == "queue_full"
+    assert adm.retry_after_s > 0
+    assert "tenant_overloaded" in adm.shed_error()
+    sched.release("t")
+    t.join(timeout=2)
+
+
+def test_shed_on_predicted_deadline_blowout():
+    sched = WeightedFairScheduler(1, max_queue_depth=0)
+    sched._hold_ewma_s = 10.0             # recent queries held 10 s
+    assert sched.admit("hog", 1.0).acquired
+    adm = sched.admit("t", 1.0, deadline_unix_s=time.time() + 0.5)
+    assert adm.status == "shed" and adm.reason == "deadline"
+    assert adm.retry_after_s > 0.5
+    sched.release("hog")
+
+
+def test_internal_workspaces_never_shed():
+    """_rules_/_self_ schedule like anyone but are exempt from the shed
+    gate — the ruler must not be starved out of its standing queries by
+    the very overload it alerts on."""
+    sched = WeightedFairScheduler(1, max_queue_depth=1)
+    sched._hold_ewma_s = 100.0
+    assert sched.admit("hog", 1.0).acquired
+    adm = sched.admit("_rules_", 0.05,
+                      deadline_unix_s=time.time() + 0.01)
+    # not shed: it waited (and timed out) instead
+    assert adm.status == "timeout"
+    sched.release("hog")
+
+
+def test_hostile_ws_churn_folds_into_overflow():
+    """ws comes from client-controlled query text: past MAX_TENANTS
+    distinct workspaces the scheduler folds strangers into the overflow
+    sentinel — its tables (and the metric cardinality keyed off
+    Admission.ws) stay bounded."""
+    from filodb_tpu.utils.usage import OVERFLOW_TENANT
+    sched = WeightedFairScheduler(4)
+    for i in range(sched.MAX_TENANTS + 40):
+        adm = sched.admit(f"ws{i}", 1.0)
+        assert adm.acquired
+        sched.release(adm.ws)
+    assert len(sched._seen) == sched.MAX_TENANTS
+    adm = sched.admit("one-more-stranger", 1.0)
+    assert adm.ws == OVERFLOW_TENANT[0]
+    sched.release(adm.ws)
+    # zeroed/empty rows are dropped, not accumulated per ws ever seen
+    assert not sched._active and not sched._queues
+
+
+def test_result_cache_partial_hit_survives_shed_tail():
+    """A shed tail run must NOT drop the still-valid warm prefix nor
+    trigger a second full run through the gate that just shed it."""
+    from filodb_tpu.query.resultcache import ResultCache
+    from filodb_tpu.query.rangevector import QueryStats, ResultBlock
+    cache = ResultCache()
+    token, horizon = ("t",), 10 * 60_000
+    calls = []
+
+    def ok_run(s, e):
+        calls.append((s, e))
+        wends = np.arange(s * 1000, e * 1000 + 1, 60_000)
+        from filodb_tpu.query.rangevector import RangeVectorKey
+        k = RangeVectorKey((("x", "1"),))
+        return QueryResult([ResultBlock(
+            [k], wends, np.ones((1, wends.size)))], QueryStats())
+
+    res1 = cache.query_range(ok_run, "up", 0, 60, 300, "pp",
+                             (token, horizon))
+    assert res1.error is None and len(cache) == 1
+
+    def shed_run(s, e):
+        calls.append((s, e))
+        r = QueryResult([], error="tenant_overloaded: queue full")
+        r.retry_after_s = 1.0
+        return r
+
+    res2 = cache.query_range(shed_run, "up", 0, 60, 600, "pp",
+                             (token, horizon))
+    assert res2.error.startswith("tenant_overloaded")
+    assert len(cache) == 1                # warm prefix kept
+    # exactly ONE run attempt for the shed poll (the tail), no full
+    # recompute through the shedding gate
+    assert len(calls) == 2 and calls[0] == (0, 300)
+    assert calls[1][1] == 600 and calls[1][0] > 0
+
+
+def test_account_wait_single_home():
+    res = QueryResult([])
+    account_wait(res, Admission("shed", waited_s=0.25))
+    account_wait(res, None)               # no scheduler: no-op
+    account_wait(None, Admission("acquired", waited_s=1.0))
+    assert res.stats.queue_wait_s == pytest.approx(0.25)
+
+
+def test_verdict_of_shed():
+    assert verdict_of(QueryResult(
+        [], error="tenant_overloaded: queue full")) == "shed"
+
+
+# --------------------------------------------------- frontend + routes
+
+
+def _store_frontend(cfg=None, series=24):
+    from filodb_tpu.core.memstore import TimeSeriesMemStore
+    from filodb_tpu.ingest.generator import counter_batch
+    from filodb_tpu.query.engine import QueryEngine
+    from filodb_tpu.query.frontend import QueryFrontend
+    START = 1_600_000_000_000
+    ms = TimeSeriesMemStore()
+    ms.setup("prometheus", 0).ingest(
+        counter_batch(series, 120, start_ms=START))
+    eng = QueryEngine("prometheus", ms)
+    if cfg is None:
+        cfg = FilodbSettings()
+    return QueryFrontend(eng, config=cfg), eng, START // 1000
+
+
+def test_frontend_shed_surfaces_structured_error_and_slowlog():
+    from filodb_tpu.utils.slowlog import slowlog
+    from filodb_tpu.utils.usage import usage
+    usage.clear()
+    slowlog.clear()
+    cfg = FilodbSettings()
+    cfg.query.max_concurrent_queries = 1
+    cfg.query.tenant_max_queue_depth = 1
+    cfg.query.result_cache_enabled = False
+    cfg.query.singleflight_enabled = False
+    fe, eng, s = _store_frontend(cfg)
+    q = 'sum(rate(request_total{_ws_="demo"}[5m]))'
+    # hold the only slot and fill demo's queue
+    assert fe.scheduler.admit("hog", 1.0).acquired
+    done = threading.Event()
+
+    def queued():
+        fe.query_range(q, s + 600, 60, s + 1190)
+        done.set()
+
+    t = threading.Thread(target=queued)
+    t.start()
+    time.sleep(0.15)
+    try:
+        res = fe.query_range(q, s + 600, 60, s + 1190)
+    finally:
+        fe.scheduler.release("hog")
+    t.join(timeout=5)
+    assert done.is_set()
+    assert res.error is not None
+    assert res.error.split(":", 1)[0] == "tenant_overloaded"
+    assert getattr(res, "retry_after_s", 0.0) > 0
+    assert verdict_of(res) == "shed"
+    # force-recorded in the slowlog with verdict shed, tenant attributed
+    recs = [r for r in slowlog.entries() if r["verdict"] == "shed"]
+    assert recs and recs[-1]["tenant"]["ws"] == "demo"
+    usage.clear()
+
+
+def test_http_shed_and_scan_limit_answer_429_with_retry_after():
+    from filodb_tpu.http.routes import PromHttpApi
+    from filodb_tpu.utils.usage import usage
+    usage.clear()
+    cfg = FilodbSettings()
+    cfg.query.max_concurrent_queries = 1
+    cfg.query.tenant_max_queue_depth = 0
+    cfg.query.result_cache_enabled = False
+    cfg.query.singleflight_enabled = False
+    fe, eng, s = _store_frontend(cfg)
+    api = PromHttpApi({"prometheus": eng}, config=cfg)
+    fe = api.frontends["prometheus"]
+    # deadline-based shed: recent holds are long, budget is short
+    fe.scheduler._hold_ewma_s = 100.0
+    assert fe.scheduler.admit("hog", 1.0).acquired
+    try:
+        st, payload = api.handle(
+            "GET", "/api/v1/query_range",
+            {"query": 'sum(rate(request_total{_ws_="demo"}[5m]))',
+             "start": str(s + 600), "end": str(s + 1190), "step": "60",
+             "timeout": "1"})
+    finally:
+        fe.scheduler.release("hog")
+    assert st == 429
+    assert payload["errorType"] == "too_many_requests"
+    assert int(payload["_headers"]["Retry-After"]) >= 1
+    # scan-limit rejection: same 429 + Retry-After contract
+    cfg2 = FilodbSettings()
+    cfg2.query.tenant_samples_fail_limit = 10
+    fe2, eng2, s2 = _store_frontend(cfg2)
+    api2 = PromHttpApi({"prometheus": eng2}, config=cfg2)
+    q = {"query": 'sum(rate(request_total{_ws_="demo"}[5m]))',
+         "start": str(s2 + 600), "end": str(s2 + 1190), "step": "60"}
+    st1, _ = api2.handle("GET", "/api/v1/query_range", dict(q))
+    assert st1 == 200                     # the crossing query runs
+    st2, pay2 = api2.handle("GET", "/api/v1/query_range", dict(q))
+    assert st2 == 429
+    assert pay2["error"].startswith("tenant_limit_exceeded")
+    ra = int(pay2["_headers"]["Retry-After"])
+    assert 1 <= ra <= int(cfg2.query.tenant_limit_window_s) + 1
+    usage.clear()
+
+
+def test_admin_tenants_payload():
+    from filodb_tpu.http.routes import PromHttpApi
+    from filodb_tpu.utils.usage import usage
+    usage.clear()
+    cfg = FilodbSettings()
+    cfg.query.tenant_shares = {"demo": 2.5}
+    fe, eng, s = _store_frontend(cfg)
+    api = PromHttpApi({"prometheus": eng}, config=cfg)
+    r = api.frontends["prometheus"].query_range(
+        'sum(rate(request_total{_ws_="demo"}[5m]))',
+        s + 600, 60, s + 1190)
+    assert r.error is None
+    st, payload = api.handle("GET", "/admin/tenants", {})
+    assert st == 200
+    rows = {t["ws"]: t for t in payload["data"]["tenants"]}
+    assert rows["demo"]["queries"] >= 1
+    assert rows["demo"]["share"] == 2.5
+    assert rows["demo"]["queued"] == 0
+    usage.clear()
+
+
+def test_scan_retry_after_tracks_window():
+    acc = UsageAccountant(window_s=30.0)
+    acc.record_query("w", "n", 0.1, 1000, 10)
+    assert acc.admit("w", "n", 0, 50) is not None
+    ra = acc.scan_retry_after("w", "n")
+    assert 0 < ra <= 30.0
+    # unknown tenants answer a tiny positive hint, never a crash
+    assert acc.scan_retry_after("nobody", "") > 0
+
+
+# ------------------------------------------------- result-cache quotas
+
+
+def _entry(nbytes):
+    from filodb_tpu.query.resultcache import _Entry
+    wends = np.arange(1, 3, dtype=np.int64) * 60_000
+    return _Entry(wends, {}, int(wends[-1]), ("tok",), nbytes)
+
+
+def test_result_cache_tenant_quota_evicts_own_entries_only():
+    from filodb_tpu.query.resultcache import ResultCache
+    cache = ResultCache(max_entries=64, max_entry_bytes=1 << 20,
+                        tenant_quota_bytes=100)
+
+    def key(ws, i):
+        return (f'up{{_ws_="{ws}",x="{i}"}}', 60_000, 0, "pp")
+
+    cache._insert(key("a", 1), _entry(40))
+    cache._insert(key("a", 2), _entry(40))
+    cache._insert(key("b", 1), _entry(40))
+    assert len(cache) == 3
+    # a's third entry pushes a over quota: a's OLDEST goes, b survives
+    cache._insert(key("a", 3), _entry(40))
+    assert key("a", 1) not in cache._entries
+    assert key("a", 2) in cache._entries
+    assert key("a", 3) in cache._entries
+    assert key("b", 1) in cache._entries
+    assert cache.tenant_bytes("a") == 80
+    assert cache.tenant_bytes("b") == 40
+
+
+def test_result_cache_over_quota_entry_rejected_not_fitted():
+    """An entry bigger than the quota must be REJECTED — never evict
+    another tenant's entries (or even all of your own) to fit it."""
+    from filodb_tpu.query.resultcache import ResultCache
+    cache = ResultCache(max_entries=64, max_entry_bytes=1 << 20,
+                        tenant_quota_bytes=100)
+    cache._insert(('up{_ws_="b"}', 60_000, 0, "pp"), _entry(40))
+    cache._insert(('up{_ws_="a"}', 60_000, 0, "pp"), _entry(240))
+    assert ('up{_ws_="a"}', 60_000, 0, "pp") not in cache._entries
+    assert cache.tenant_bytes("b") == 40
+    assert len(cache) == 1
+
+
+def test_result_cache_quota_disabled_keeps_global_lru():
+    from filodb_tpu.query.resultcache import ResultCache
+    cache = ResultCache(max_entries=2, max_entry_bytes=1 << 20,
+                        tenant_quota_bytes=0)
+    for i in range(3):
+        cache._insert((f'up{{x="{i}"}}', 60_000, 0, "pp"), _entry(40))
+    assert len(cache) == 2                # plain LRU cap
+
+
+# ----------------------------------------------------- shuffle sharding
+
+
+def test_shuffle_shard_nodes_deterministic_k_of_n():
+    nodes = [f"n{i}" for i in range(8)]
+    a1 = shuffle_shard_nodes("tenantA", nodes, 2)
+    a2 = shuffle_shard_nodes("tenantA", list(reversed(nodes)), 2)
+    assert a1 == a2 and len(a1) == 2      # order-independent, stable
+    subsets = {shuffle_shard_nodes(f"t{i}", nodes, 2) for i in range(30)}
+    assert len(subsets) > 5               # tenants spread across subsets
+    assert shuffle_shard_nodes("t", nodes, 0) == tuple(sorted(nodes))
+    assert shuffle_shard_nodes("t", nodes, 99) == tuple(sorted(nodes))
+
+
+def test_failover_dispatcher_prefers_tenant_subset():
+    from filodb_tpu.query.execbase import PlanDispatcher, QueryError
+    from filodb_tpu.query.rangevector import QueryContext
+    from filodb_tpu.replication.failover import ReplicaFailoverDispatcher
+
+    calls = []
+
+    class _D(PlanDispatcher):
+        def __init__(self, name, fail=False):
+            self.name, self.fail = name, fail
+
+        def dispatch(self, plan, source):
+            calls.append(self.name)
+            if self.fail:
+                raise QueryError("shard_unavailable", self.name)
+            return f"ok:{self.name}"
+
+    class _Plan:
+        def __init__(self):
+            self.ctx = QueryContext()
+
+    nodes = ["n0", "n1", "n2", "n3"]
+    # find a tenant whose k=1 subset is NOT the primary n0, so the
+    # reorder is observable
+    ws = next(w for w in (f"w{i}" for i in range(64))
+              if shuffle_shard_nodes(w, nodes, 1)[0] != "n0")
+    pref = shuffle_shard_nodes(ws, nodes, 1)[0]
+    targets = [(n, _D(n)) for n in nodes]
+    disp = ReplicaFailoverDispatcher(targets, shard=0, all_nodes=nodes,
+                                     shuffle_k=1)
+    plan = _Plan()
+    plan.ctx.tenant_ws = ws
+    assert disp.dispatch(plan, None) == f"ok:{pref}"
+    assert calls == [pref]
+    # failover is preserved: a dead preferred node falls through in the
+    # reordered walk (preferred first, everyone else still a fallback)
+    calls.clear()
+    targets2 = [(n, _D(n, fail=(n == pref))) for n in nodes]
+    disp2 = ReplicaFailoverDispatcher(targets2, shard=0, all_nodes=nodes,
+                                      shuffle_k=1)
+    out = disp2.dispatch(plan, None)
+    assert out.startswith("ok:") and calls[0] == pref and len(calls) == 2
+    # no tenant on the context -> assignment order untouched
+    calls.clear()
+    plain = _Plan()
+    assert disp.dispatch(plain, None) == "ok:n0"
+    assert calls == ["n0"]

@@ -47,6 +47,13 @@ of every headline metric is greppable in one file:
     ``activequeries_slot_freed`` / ``activequeries_listed_remote`` /
     ``activequeries_stop_ms`` (gate: <= 250 ms) from the two-node
     cold-query kill drill — plus a loud ``activequeries_error``.
+  - the multi-tenant QoS numbers (PR 14): ``qos_p99_ratio`` (gate:
+    good-tenant p99 under one abusive tenant's full-concurrency flood
+    stays <= 1.5x of idle), ``qos_abuser_shed`` /
+    ``qos_shed_retry_after_ok`` (the abuser gets structured 429 +
+    Retry-After), ``qos_abuser_timeouts`` (gate: 0 — doomed queries
+    shed at admission, never left to die in the queue) — plus a loud
+    ``qos_error`` when the stage fails.
 
 Existing hand-written round entries are MERGED, never clobbered: only
 missing keys are added, so curated notes survive re-runs.
@@ -113,6 +120,15 @@ CARRY = [
     "activequeries_kill_structured", "activequeries_stop_ms",
     "activequeries_slot_freed", "activequeries_listed_remote",
     "activequeries_kill_to_client_ms", "activequeries_error",
+    # multi-tenant QoS (ISSUE 14): the noisy-neighbor drill — good-
+    # tenant p99 under flood vs idle (gate: <= 1.5x), the abuser's
+    # structured-shed evidence (429 + Retry-After, zero query_timeout,
+    # zero silent starvation) — plus a loud qos_error when the stage
+    # fails
+    "qos_p99_ratio", "qos_good_p99_idle_s", "qos_good_p99_noisy_s",
+    "qos_abuser_shed", "qos_abuser_timeouts", "qos_abuser_completed",
+    "qos_shed_retry_after_ok", "qos_capacity", "qos_gate_ok",
+    "qos_error",
 ]
 RENAME = {"value": "headline_samples_per_sec",
           "p50_query_latency_s": "p50_s"}
