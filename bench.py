@@ -2983,12 +2983,176 @@ def host_baselines(ts_row, vals, gids, wends, range_ms, span):
     return vec_sps, it_sps, c_sps
 
 
+def measure_distexec(quick=False, series=None):
+    """ISSUE-15 acceptance: aggregation pushdown + streaming distributed
+    execution.
+
+    Three proofs ride the one-line JSON:
+      distexec_wire_bytes_ratio — a fan-out `sum by (...)` over FOUR
+        data nodes with node-level pushdown ON vs the ship-everything
+        baseline (map phase on the coordinator, full per-shard series
+        blocks crossing the wire), measured from QueryStats.wire_bytes.
+        Gate: >= 10x fewer bytes, results BIT-identical (integer-valued
+        samples keep every partial-sum component exact, so the merge
+        tree's association cannot perturb a bit).
+      distexec_frontend_peak_rss_mb — a long-range-shaped (30-day-grid-
+        sized, W~3k steps) single-node query whose [S, W] reply streams
+        as bounded CRC frames into a preallocated block, traced-peak
+        (tracemalloc, numpy included) vs the materialize-everything
+        single-frame baseline.  Gate: streamed peak under a FIXED
+        budget (3/4 of the bytes the children shipped + 2 MB frame
+        slack) that the materialize-everything baseline exceeds.
+      distexec_pushdown_speedup_x — wall p50 of the fan-out aggregation
+        pushed vs ship-everything (reported, not gated: the wire is
+        loopback here; real networks only widen it).
+    """
+    import tracemalloc
+
+    from filodb_tpu.config import settings
+    from filodb_tpu.ingest.generator import gauge_batch
+    from filodb_tpu.parallel.testcluster import make_fanout_cluster
+    from filodb_tpu.query.rangevector import PlannerParams
+
+    st = {}
+    START = 1_600_000_020_000
+    S0 = START // 1000
+
+    def as_map(res):
+        out = {}
+        for b in res.blocks:
+            vals = np.asarray(b.values)
+            for i, k in enumerate(b.keys):
+                out[k] = (tuple(np.asarray(b.wends).tolist()),
+                          vals[i].tobytes())
+        return out
+
+    # ---- half 1: 4-node fan-out aggregation, pushed vs ship-everything
+    S = series or (2_048 if quick else 16_384)
+    T = 360 if quick else 720                    # 10 s scrape samples
+    batch = gauge_batch(S, T, start_ms=START, metric="bench_gauge")
+    batch.columns["value"] = np.floor(batch.columns["value"])
+    cluster = make_fanout_cluster([batch], num_shards=8,
+                                  nodes=("n1", "n2", "n3", "n4"),
+                                  with_truth=False)
+    st["series"] = S
+    try:
+        q = "sum by (dc)(bench_gauge)"
+        rng_args = (S0 + 600, 60, S0 + 600 + 60 * (60 if quick else 110))
+        runs = {}
+        iters = 3 if quick else 5
+        for push in (True, False):
+            # the off side is the SHIP-EVERYTHING strawman (full per-
+            # series blocks over the wire), not pushdown=False — that
+            # merely restores the per-shard [G, W] partial dispatch
+            pp = PlannerParams(aggregation_pushdown=push,
+                               ship_raw_series=not push)
+            walls, wires, frames, verdicts, rmap = [], [], [], [], None
+            for _ in range(iters):
+                t0 = time.perf_counter()
+                r = cluster.engine.query_range(q, *rng_args, pp)
+                walls.append(time.perf_counter() - t0)
+                if r.error:
+                    st["error"] = f"fanout ({push=}): {r.error}"[:300]
+                    return st
+                wires.append(r.stats.wire_bytes)
+                frames.append(r.stats.streamed_frames)
+                verdicts.append((r.stats.pushdown_pushed,
+                                 r.stats.pushdown_fallback))
+                rmap = as_map(r)
+            runs[push] = {"wall_p50": sorted(walls)[len(walls) // 2],
+                          "wire": sorted(wires)[len(wires) // 2],
+                          "frames": max(frames),
+                          "verdicts": verdicts[-1], "map": rmap}
+        on, off = runs[True], runs[False]
+        st["distexec_wire_on_bytes"] = int(on["wire"])
+        st["distexec_wire_off_bytes"] = int(off["wire"])
+        st["distexec_wire_bytes_ratio"] = round(
+            off["wire"] / max(on["wire"], 1), 1)
+        st["distexec_pushdown_speedup_x"] = round(
+            off["wall_p50"] / max(on["wall_p50"], 1e-9), 2)
+        st["distexec_bit_identical"] = bool(on["map"] == off["map"]
+                                            and on["map"])
+        st["distexec_pushed_nodes"] = int(on["verdicts"][0])
+    finally:
+        cluster.stop()
+
+    # ---- half 2: long-range streamed aggregation vs materialize-all.
+    # A 30-day-grid-sized [S, W] block lives on ONE data node; the
+    # coordinator runs `sum by (...)` over ship_raw_series children (the
+    # full-series-over-the-wire shape raw selectors and non-pushable
+    # ops always have, forced here for a deterministic bound).  Baseline
+    # buffers each whole reply + decode copies; streamed mode folds
+    # every CRC frame through map+reduce as it arrives, so the
+    # coordinator never holds more than a frame and the [G, W] partial.
+    Sw = 512 if quick else 1_024
+    W = 1_440 if quick else 2_880               # 30-day-grid-sized [S, W]
+    wide = gauge_batch(Sw, W, start_ms=START, step_ms=60_000,
+                       metric="wide_gauge")
+    wide.columns["value"] = np.floor(wide.columns["value"])
+    one = make_fanout_cluster([wide], num_shards=2, nodes=("n1",),
+                              with_truth=False)
+    saved_frame = settings().query.stream_frame_bytes
+    try:
+        qw = "sum by (_ns_)(wide_gauge)"
+        wargs = (S0 + 600, 60, S0 + 60 * W)
+        pp = PlannerParams(aggregation_pushdown=False,
+                           ship_raw_series=True,
+                           sample_limit=200_000_000)
+        peaks = {}
+        maps = {}
+        shipped = 0
+        # frame bound scaled to the stage size so quick mode streams too
+        # (production default stays 2 MiB; the bound just has to be well
+        # under one shard's reply for the fold to engage)
+        frame = (256 << 10) if quick else (1 << 20)
+        for mode, frame_bytes in (("baseline", 0), ("streamed", frame)):
+            settings().query.stream_frame_bytes = frame_bytes
+            one.engine.query_range(qw, *wargs, pp)      # warm the path
+            tracemalloc.start()
+            tracemalloc.reset_peak()
+            r = one.engine.query_range(qw, *wargs, pp)
+            _, peak = tracemalloc.get_traced_memory()
+            tracemalloc.stop()
+            if r.error:
+                st["error"] = f"longrange ({mode}): {r.error}"[:300]
+                return st
+            peaks[mode] = peak
+            maps[mode] = as_map(r)
+            shipped = max(shipped, r.stats.wire_bytes)
+            if mode == "streamed":
+                st["distexec_stream_frames"] = int(r.stats.streamed_frames)
+        st["distexec_frontend_peak_rss_mb"] = round(
+            peaks["streamed"] / (1 << 20), 1)
+        st["distexec_baseline_peak_rss_mb"] = round(
+            peaks["baseline"] / (1 << 20), 1)
+        # FIXED budget: 3/4 of the bytes the children ship plus frame
+        # slack — the materialize-everything baseline necessarily
+        # exceeds the shipped bytes (whole reply buffer + decode
+        # copies), while the fold holds a frame and a [G, W] partial
+        budget_mb = round(shipped / (1 << 20) * 0.75 + 2.0, 1)
+        st["distexec_rss_budget_mb"] = budget_mb
+        st["distexec_stream_identical"] = bool(
+            maps["streamed"] == maps["baseline"] and maps["streamed"])
+    finally:
+        settings().query.stream_frame_bytes = saved_frame
+        one.stop()
+
+    st["distexec_gate_ok"] = bool(
+        st["distexec_wire_bytes_ratio"] >= 10.0
+        and st["distexec_bit_identical"]
+        and st["distexec_stream_identical"]
+        and st["distexec_stream_frames"] > 1
+        and st["distexec_frontend_peak_rss_mb"] <= budget_mb
+        and st["distexec_baseline_peak_rss_mb"] > budget_mb)
+    return st
+
+
 def parse_args(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("stage", nargs="?", default="",
                     choices=["", "chaos", "multichip", "wal", "longrange",
                              "selfmon", "replication", "ingesttrace",
-                             "activequeries", "qos"],
+                             "activequeries", "qos", "distexec"],
                     help="optional standalone stage: 'chaos' runs the "
                          "failure-domain chaos harness (SIGKILL one of "
                          "three RF-2 data nodes mid-traffic; gates "
@@ -3210,6 +3374,21 @@ def assemble_result(platform, stages, vec_sps, it_sps, c_sps=0.0,
         # loud-fail contract (like multichip): a broken historical tier
         # rides into the parsed line, never vanishes
         result["longrange_error"] = lr["error"]
+    dx = stages.get("distexec", {})
+    for k in ("distexec_wire_bytes_ratio", "distexec_pushdown_speedup_x",
+              "distexec_bit_identical", "distexec_frontend_peak_rss_mb",
+              "distexec_baseline_peak_rss_mb", "distexec_rss_budget_mb",
+              "distexec_stream_frames", "distexec_stream_identical",
+              "distexec_pushed_nodes", "distexec_gate_ok"):
+        if k in dx:
+            # ISSUE-15 acceptance: 4-node fan-out aggregation moves
+            # >= 10x fewer wire bytes pushed vs ship-everything (bit-
+            # identical), and a long-range streamed reply holds traced
+            # peak memory under a fixed budget that the materialize-
+            # everything baseline exceeds
+            result[k] = dx[k]
+    if "error" in dx:
+        result["distexec_error"] = dx["error"]
     ns = stages.get("north_star_1m") or stages.get("cpu_north_star_1m")
     if ns and "samples_per_sec" in ns:
         result.update({
@@ -3407,6 +3586,17 @@ def run_worker(args):
     except Exception as e:  # noqa: BLE001 — must not sink the run
         stages["longrange"] = {"error": f"{type(e).__name__}: {e}"[:300]}
         writer.stage("longrange", stages["longrange"])
+
+    try:
+        # distributed-execution stage (ISSUE 15): 4-node aggregation
+        # pushdown wire ratio + bit-identity, streamed-reply peak-RSS
+        # bound vs the materialize-everything baseline
+        dx = measure_distexec(quick=quick)
+        writer.stage("distexec", dx)
+        stages["distexec"] = dx
+    except Exception as e:  # noqa: BLE001 — must not sink the run
+        stages["distexec"] = {"error": f"{type(e).__name__}: {e}"[:300]}
+        writer.stage("distexec", stages["distexec"])
 
     try:
         # measure_fused_coverage leaves FILODB_TPU_FUSED_INTERPRET=1
@@ -3673,6 +3863,27 @@ def main():
             qs["qos_error"] = qs["error"]
         print(json.dumps(qs))
         sys.exit(0 if "error" not in qs and qs.get("qos_gate_ok")
+                 else 1)
+    if args.stage == "distexec":
+        # standalone distributed-execution stage: CPU-pinned (it
+        # measures wire/merge machinery, not kernels); prints the
+        # one-line distexec JSON and exits nonzero when a gate fails
+        # (loud-fail contract like selfmon/activequeries)
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        try:
+            dx = measure_distexec(quick=args.quick,
+                                  series=args.series or None)
+        except Exception as e:  # noqa: BLE001 — loud one-line fail
+            print(json.dumps({
+                "metric": "distexec_wire_bytes_ratio", "unit": "x",
+                "distexec_error": f"{type(e).__name__}: {e}"[:300]}))
+            sys.exit(1)
+        dx = {"metric": "distexec_wire_bytes_ratio", "unit": "x",
+              "value": dx.get("distexec_wire_bytes_ratio"), **dx}
+        if "error" in dx:
+            dx["distexec_error"] = dx["error"]
+        print(json.dumps(dx))
+        sys.exit(0 if "error" not in dx and dx.get("distexec_gate_ok")
                  else 1)
     if args.stage == "chaos":
         # standalone failure-domain stage: runs IN THIS process (CPU-

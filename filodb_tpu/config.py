@@ -173,6 +173,30 @@ class QueryConfig:
     # when the node died" is answerable.  "" disables; FiloServer
     # defaults it under the WAL dir when one is configured.
     active_query_log_path: str = ""
+    # --- distributed execution (query/pushdown.py, parallel/streams.py;
+    # doc/query-engine.md "Aggregation pushdown & streaming") ---
+    # node-level aggregation pushdown: when an aggregation fans out to
+    # remote data nodes, the per-shard map subtrees owned by one node
+    # are wrapped in a RemoteAggregateExec and dispatched to that node
+    # as ONE unit — the node runs the reduce phase locally and only a
+    # tiny [G, W] AggPartial crosses the wire (the FiloDB queryplanner
+    # map/reduce split; Thanos/Cortex query-frontend pushdown).  A node
+    # that is unreachable falls back to today's per-shard dispatch path
+    # (replica failover preserved); non-pushable shapes (joins, topk's
+    # per-series output, raw selectors) always take today's path.
+    # false restores the per-shard dispatch exactly — every shard still
+    # replies with its [G, W] map partial, just one round trip per
+    # SHARD instead of per node.  Per-request override:
+    # PlannerParams.aggregation_pushdown.
+    aggregation_pushdown: bool = True
+    # chunked streaming replies on the cross-node query transport: a
+    # reply larger than this many bytes is split into CRC-framed row
+    # slices so the coordinator assembles it incrementally under a
+    # bounded frame buffer instead of buffering the whole reply twice
+    # (raw frame + decoded arrays).  The query deadline applies per
+    # frame and a kill lands between frames.  0 disables (single-frame
+    # replies, the pre-PR-15 wire shape).
+    stream_frame_bytes: int = 2 << 20
 
 
 @dataclasses.dataclass

@@ -62,6 +62,14 @@ _LEAF_PLANS: Dict[str, Tuple[type, List[str]]] = {
         ["start_ms", "step_ms", "end_ms", "value"]),
 }
 
+# the ONLY non-leaf plans allowed over the wire: node-level aggregation
+# pushdown subtrees (query/pushdown.py) whose children are themselves
+# serializable leaves.  Everything else (joins, concats, stitches) keeps
+# refusing — composition stays on the coordinator.
+_PUSHDOWN_PLANS: Dict[str, Tuple[type, List[str]]] = {
+    "RemoteAggregateExec": (exec_mod.RemoteAggregateExec, ["op", "params"]),
+}
+
 
 class NotSerializable(TypeError):
     pass
@@ -120,10 +128,18 @@ class _Encoder:
 
     def _enc_plan(self, plan: exec_mod.ExecPlan):
         name = type(plan).__name__
+        if name in _PUSHDOWN_PLANS:
+            _, attrs = _PUSHDOWN_PLANS[name]
+            return {"$plan": name,
+                    "ctx": self.enc(plan.ctx),
+                    "transformers": [self.enc(t) for t in plan.transformers],
+                    "children": [self._enc_plan(c) for c in plan.children],
+                    "f": {a: self.enc(getattr(plan, a)) for a in attrs}}
         if name not in _LEAF_PLANS:
             raise NotSerializable(
                 f"plan {name} does not cross node boundaries — only leaf "
-                f"subtrees are dispatched (ref: PlanDispatcher)")
+                f"subtrees and pushdown aggregation groups are dispatched "
+                f"(ref: PlanDispatcher)")
         _, attrs = _LEAF_PLANS[name]
         return {"$plan": name,
                 "ctx": self.enc(plan.ctx),
@@ -154,7 +170,20 @@ class _Decoder:
                 cls, _ = _SIMPLE[node["$s"]]
                 return cls(**{k: self.dec(v) for k, v in node["f"].items()})
             if "$plan" in node:
-                cls, attrs = _LEAF_PLANS[node["$plan"]]
+                name = node["$plan"]
+                if name in _PUSHDOWN_PLANS:
+                    cls, attrs = _PUSHDOWN_PLANS[name]
+                    ctx = self.dec(node["ctx"])
+                    children = [self.dec(c) for c in node["children"]]
+                    kwargs = {k: self.dec(v) for k, v in node["f"].items()}
+                    # children revive with the default in-process
+                    # dispatcher: on the data node the group executes as
+                    # an ordinary local scatter-gather + reduce
+                    plan = cls(ctx, children, **kwargs)
+                    plan.transformers = [self.dec(t)
+                                         for t in node["transformers"]]
+                    return plan
+                cls, attrs = _LEAF_PLANS[name]
                 ctx = self.dec(node["ctx"])
                 kwargs = {k: self.dec(v) for k, v in node["f"].items()}
                 plan = cls(ctx, **kwargs)

@@ -160,17 +160,23 @@ def make_replicated_cluster(nodes=("A", "B", "C"), num_shards: int = 4,
                              truth, sm)
 
 
-def make_two_node_cluster(batches: Iterable = (), num_shards: int = 4,
-                          dataset: str = "prometheus",
-                          default_spread: int = 1,
-                          with_truth: bool = False) -> TwoNodeCluster:
-    """Two node processes (in-process servers), shards split half/half,
-    coordinator holding NO data with remote dispatchers — the multi-JVM
-    IngestionAndRecoverySpec shape."""
+def make_fanout_cluster(batches: Iterable = (), num_shards: int = 4,
+                        dataset: str = "prometheus",
+                        default_spread: int = 1,
+                        with_truth: bool = False,
+                        nodes: Iterable = ("nodeA", "nodeB")
+                        ) -> TwoNodeCluster:
+    """N node processes (in-process servers), shards round-split across
+    them, coordinator holding NO data with remote dispatchers — the
+    multi-JVM IngestionAndRecoverySpec shape generalized for the
+    distributed-execution fan-out bench (`bench.py distexec` drives a
+    4-node shape through exactly this wiring)."""
+    nodes = list(nodes)
     mapper = ShardMapper(num_shards)
     spread = SpreadProvider(default_spread=default_spread)
-    stores = {"nodeA": TimeSeriesMemStore(), "nodeB": TimeSeriesMemStore()}
-    owner = {s: ("nodeA" if s < num_shards // 2 else "nodeB")
+    stores = {n: TimeSeriesMemStore() for n in nodes}
+    per = max(1, -(-num_shards // len(nodes)))      # ceil split, in order
+    owner = {s: nodes[min(s // per, len(nodes) - 1)]
              for s in range(num_shards)}
     for s, node in owner.items():
         stores[node].setup(dataset, s)
@@ -193,3 +199,14 @@ def make_two_node_cluster(batches: Iterable = (), num_shards: int = 4,
     engine = QueryEngine(dataset, TimeSeriesMemStore(), mapper,
                          planner=planner)
     return TwoNodeCluster(engine, mapper, stores, owner, servers, truth)
+
+
+def make_two_node_cluster(batches: Iterable = (), num_shards: int = 4,
+                          dataset: str = "prometheus",
+                          default_spread: int = 1,
+                          with_truth: bool = False) -> TwoNodeCluster:
+    """Two node processes, shards split half/half — the original
+    fixture shape, now a 2-node `make_fanout_cluster`."""
+    return make_fanout_cluster(batches, num_shards, dataset,
+                               default_spread, with_truth,
+                               nodes=("nodeA", "nodeB"))

@@ -8,6 +8,13 @@ length-prefixed request/response over a plain TCP socket; the node side
 executes against its local memstore source, so the coordinator's
 NonLeafExecPlan scatter-gathers across machines exactly like the
 single-process path.
+
+Replies bigger than `query.stream_frame_bytes` stream as multiple
+CRC-framed row slices (PR 15, parallel/streams.py): the coordinator
+merges them incrementally (preallocated assembly, or the parent's
+map+reduce fold), the query deadline applies PER frame, kills land
+between frames, and a torn stream is the typed remote_failure — see
+doc/query-engine.md "Aggregation pushdown & streaming".
 """
 from __future__ import annotations
 
@@ -17,6 +24,7 @@ import json
 import socketserver
 import struct
 import threading
+import zlib
 from typing import Callable, Optional, Tuple
 
 from filodb_tpu.parallel import serialize
@@ -30,10 +38,60 @@ _MAGIC = b"FQ01"
 # threads are all busy executing (ThreadingTCPServer: the kill arrives
 # on its own fresh connection)
 _KILL_MAGIC = b"FKILL1"
+# plan-request envelope (PR 15): _PLAN_MAGIC + u32 flags + plan bytes.
+# Bit 0 of flags = the caller accepts a streamed (multi-frame) reply.
+# Bare payloads without the envelope remain valid requests and get the
+# legacy single-frame reply, so an old CLIENT can talk to a new server;
+# new clients always envelope, so data nodes must upgrade before
+# coordinators in a rolling deploy.
+_PLAN_MAGIC = b"FPLN2"
+_REQ_FLAG_STREAM = 1
+# streamed-reply frame: _STREAM_MAGIC + u8 flags (bit 0 = last frame) +
+# u32 seq + u32 crc32(body) + body.  Non-last bodies carry {"begin"} /
+# {"piece"} chunks (parallel/streams.py); the last frame carries the
+# usual reply dict (ok/stats/spans or the typed error) — the per-frame
+# CRC is the WAL's torn-write stance applied to the query wire.
+_STREAM_MAGIC = b"FSTR1"
+_STREAM_FLAG_LAST = 1
+_STREAM_HDR = len(_STREAM_MAGIC) + 9
+
+
+def _pack_stream_frame(seq: int, body: bytes, last: bool) -> bytes:
+    return (_STREAM_MAGIC
+            + struct.pack("<BII", _STREAM_FLAG_LAST if last else 0,
+                          seq & 0xFFFFFFFF, zlib.crc32(body) & 0xFFFFFFFF)
+            + body)
+
+
+def _unpack_stream_frame(raw: bytes) -> Tuple[bool, int, bytes]:
+    """(last, seq, body) — raises ValueError on a short header or a CRC
+    mismatch (the caller maps that to the typed remote_failure)."""
+    if len(raw) < _STREAM_HDR:
+        raise ValueError("stream frame shorter than its header")
+    flags, seq, crc = struct.unpack_from("<BII", raw, len(_STREAM_MAGIC))
+    body = raw[_STREAM_HDR:]
+    if zlib.crc32(body) & 0xFFFFFFFF != crc:
+        raise ValueError(f"stream frame {seq} CRC mismatch")
+    return bool(flags & _STREAM_FLAG_LAST), seq, body
 
 
 def _send_frame(sock: socket.socket, payload: bytes) -> None:
     sock.sendall(_MAGIC + struct.pack("<Q", len(payload)) + payload)
+
+
+def _attach_registration(plan, ent) -> None:
+    """Stamp the local registry entry's kill token onto EVERY ctx in a
+    dispatched subtree: serialization gives each exec node its own
+    QueryContext, and for a pushed-down group (RemoteAggregateExec) it
+    is the per-shard LEAVES whose exec-boundary cancel checks actually
+    stop the scans — a token only on the group root would let every
+    shard run to completion after a kill."""
+    stack = [plan]
+    while stack:
+        node = stack.pop()
+        node.ctx.cancel = ent.token
+        node.ctx.active = ent
+        stack.extend(getattr(node, "children", ()) or ())
 
 
 def _recv_exact(sock: socket.socket, n: int) -> bytes:
@@ -101,70 +159,102 @@ class NodeQueryServer:
                             _send_frame(self.request,
                                         outer._handle_kill(payload))
                             continue
+                        stream_ok = False
                         ent = None
                         verdict = "completed"
+                        plan = None
                         try:
-                            from filodb_tpu.query.activequeries import \
-                                active_queries
-                            from filodb_tpu.utils.metrics import (
-                                collector, span, trace_context)
-                            plan = serialize.loads(payload)
-                            tid = getattr(plan.ctx, "query_id", "")
-                            # register the dispatched subtree in the
-                            # LOCAL active-query registry under the
-                            # coordinator's query id: one id names the
-                            # whole distributed query, and a kill frame
-                            # keyed by it stops this leaf's scan
-                            if tid:
-                                ent = active_queries.register(
-                                    tid,
-                                    promql=(f"[remote] "
-                                            f"{type(plan).__name__}"
-                                            f"({plan.args_str()})")[:300],
-                                    origin="remote", role="remote")
-                                if ent is not None:
-                                    plan.ctx.cancel = ent.token
-                                    plan.ctx.active = ent
-                                    ent.set_phase("executing")
-                            # execute under the CALLER's trace id so this
-                            # node's spans stitch into the same trace; ship
-                            # them back with the reply (the Kamon-context-
-                            # over-Akka analogue, ref: ExecPlan.scala:102)
-                            with trace_context(tid),                                     span("remote_exec",
-                                         plan=type(plan).__name__):
-                                data, stats = plan.execute_internal(
-                                    outer.source)
-                            reply = serialize.dumps(
-                                {"ok": True, "data": data, "stats": stats,
-                                 "spans": (collector.take(tid)
-                                           if tid else [])})
-                        except Exception as e:  # noqa: BLE001 — errors ride the wire
-                            from filodb_tpu.query.execbase import \
-                                QueryError
-                            if isinstance(e, QueryError):
-                                # preserve the typed code across the
-                                # wire: a deadline expiring on THIS node
-                                # must surface at the coordinator as
-                                # query_timeout, not remote_failure
-                                reply = serialize.dumps(
-                                    {"ok": False, "error_code": e.code,
-                                     "error": str(e)})
-                                verdict = ("killed"
-                                           if e.code == "query_canceled"
-                                           else "deadline"
-                                           if e.code == "query_timeout"
-                                           else "error")
+                            try:
+                                from filodb_tpu.query.activequeries import \
+                                    active_queries
+                                from filodb_tpu.utils.metrics import (
+                                    collector, span, trace_context)
+                                # envelope parse INSIDE the try: a
+                                # truncated FPLN2 header answers typed
+                                # on a live connection, never a torn
+                                # socket the coordinator misreads as a
+                                # dead node
+                                if payload.startswith(_PLAN_MAGIC):
+                                    (rflags,) = struct.unpack_from(
+                                        "<I", payload, len(_PLAN_MAGIC))
+                                    stream_ok = bool(rflags
+                                                     & _REQ_FLAG_STREAM)
+                                    payload = payload[len(_PLAN_MAGIC)
+                                                      + 4:]
+                                plan = serialize.loads(payload)
+                                tid = getattr(plan.ctx, "query_id", "")
+                                # register the dispatched subtree in the
+                                # LOCAL active-query registry under the
+                                # coordinator's query id: one id names the
+                                # whole distributed query, and a kill frame
+                                # keyed by it stops this leaf's scan
+                                if tid:
+                                    ent = active_queries.register(
+                                        tid,
+                                        promql=(f"[remote] "
+                                                f"{type(plan).__name__}"
+                                                f"({plan.args_str()})")[:300],
+                                        origin="remote", role="remote")
+                                    if ent is not None:
+                                        _attach_registration(plan, ent)
+                                        ent.set_phase("executing")
+                                # execute under the CALLER's trace id so this
+                                # node's spans stitch into the same trace; ship
+                                # them back with the reply (the Kamon-context-
+                                # over-Akka analogue, ref: ExecPlan.scala:102)
+                                with trace_context(tid),                                         span("remote_exec",
+                                             plan=type(plan).__name__):
+                                    data, stats = plan.execute_internal(
+                                        outer.source)
+                                spans = collector.take(tid) if tid else []
+                            except Exception as e:  # noqa: BLE001 — errors ride the wire
+                                from filodb_tpu.query.execbase import \
+                                    QueryError
+                                if isinstance(e, QueryError):
+                                    # preserve the typed code across the
+                                    # wire: a deadline expiring on THIS node
+                                    # must surface at the coordinator as
+                                    # query_timeout, not remote_failure
+                                    err = {"ok": False, "error_code": e.code,
+                                           "error": str(e)}
+                                    verdict = ("killed"
+                                               if e.code == "query_canceled"
+                                               else "deadline"
+                                               if e.code == "query_timeout"
+                                               else "error")
+                                else:
+                                    err = {"ok": False,
+                                           "error": f"{type(e).__name__}: {e}"}
+                                    verdict = "error"
+                                outer._send_error(self.request, stream_ok,
+                                                  err)
                             else:
-                                reply = serialize.dumps(
-                                    {"ok": False,
-                                     "error": f"{type(e).__name__}: {e}"})
-                                verdict = "error"
+                                # reply while the registration is alive:
+                                # a kill frame landing mid-STREAM must
+                                # still find this entry's token
+                                try:
+                                    verdict = outer._send_reply(
+                                        self.request, stream_ok, plan,
+                                        data, stats, spans) or verdict
+                                except (ConnectionError, OSError):
+                                    raise       # client went away
+                                except Exception as e:  # noqa: BLE001
+                                    # reply serialization failed (e.g.
+                                    # NotSerializable): answer typed —
+                                    # tearing the connection would make
+                                    # the client retry a stale socket
+                                    # and re-execute the plan
+                                    outer._send_error(
+                                        self.request, stream_ok,
+                                        {"ok": False,
+                                         "error":
+                                         f"{type(e).__name__}: {e}"})
+                                    verdict = "error"
                         finally:
                             if ent is not None:
                                 from filodb_tpu.query.activequeries \
                                     import active_queries
                                 active_queries.deregister(ent, verdict)
-                        _send_frame(self.request, reply)
                 except (ConnectionError, OSError):
                     return              # client went away
 
@@ -192,6 +282,67 @@ class NodeQueryServer:
         except Exception as e:  # noqa: BLE001 — a bad kill frame must not
             return serialize.dumps(  # kill the handler connection
                 {"ok": False, "error": f"{type(e).__name__}: {e}"})
+
+    @staticmethod
+    def _send_error(sock: socket.socket, stream_ok: bool, err: dict) -> None:
+        body = serialize.dumps(err)
+        if stream_ok:
+            _send_frame(sock, _pack_stream_frame(0, body, last=True))
+        else:
+            _send_frame(sock, body)
+
+    @staticmethod
+    def _send_reply(sock: socket.socket, stream_ok: bool, plan, data,
+                    stats, spans) -> Optional[str]:
+        """Send one success reply — single-frame (legacy / small) or a
+        chunked stream of CRC-framed row slices (parallel/streams.py)
+        when the caller accepts it and the payload is big enough.
+        Between piece frames the plan's cancellation token and deadline
+        are re-checked, so a kill or an expired budget cuts the stream
+        short with a typed error frame instead of pushing megabytes
+        nobody is waiting for.  Returns a verdict override for the
+        active-query registry ('killed'/'deadline') or None."""
+        if not stream_ok:
+            _send_frame(sock, serialize.dumps(
+                {"ok": True, "data": data, "stats": stats, "spans": spans}))
+            return None
+        from filodb_tpu.config import settings
+        from filodb_tpu.parallel import streams
+        frame_bytes = settings().query.stream_frame_bytes
+        split = (streams.split_for_stream(data, frame_bytes)
+                 if frame_bytes > 0 else None)
+        if split is None:
+            _send_frame(sock, _pack_stream_frame(0, serialize.dumps(
+                {"ok": True, "data": data, "stats": stats,
+                 "spans": spans}), last=True))
+            return None
+        import time as _time
+        begin, pieces = split
+        seq = 0
+        _send_frame(sock, _pack_stream_frame(
+            seq, serialize.dumps({"begin": begin}), last=False))
+        tok = getattr(plan.ctx, "cancel", None)
+        dl = getattr(plan.ctx, "deadline_unix_s", 0.0)
+        for piece in pieces:
+            code = None
+            if tok is not None and tok.cancelled:
+                code, why = "query_canceled", "query killed mid-stream"
+            elif dl and _time.time() >= dl:
+                code, why = "query_timeout", "deadline expired mid-stream"
+            if code is not None:
+                seq += 1
+                _send_frame(sock, _pack_stream_frame(seq, serialize.dumps(
+                    {"ok": False, "error_code": code,
+                     "error": f"{why} after {seq - 1} frames"}), last=True))
+                return "killed" if code == "query_canceled" else "deadline"
+            seq += 1
+            _send_frame(sock, _pack_stream_frame(
+                seq, serialize.dumps({"piece": piece}), last=False))
+        seq += 1
+        _send_frame(sock, _pack_stream_frame(seq, serialize.dumps(
+            {"ok": True, "data": None, "streamed": True, "stats": stats,
+             "spans": spans}), last=True))
+        return None
 
     @property
     def address(self) -> Tuple[str, int]:
@@ -259,6 +410,11 @@ class RemoteNodeDispatcher(PlanDispatcher):
         # query times out even though degradation was allowed
         self.deadline_share = q.peer_deadline_share
         self._tls = threading.local()
+
+    def pushdown_target(self) -> "RemoteNodeDispatcher":
+        """This dispatcher IS a node address — aggregation pushdown can
+        group same-node leaves behind it (query/pushdown.py)."""
+        return self
 
     def _sock(self, timeout_s: Optional[float] = None
               ) -> Tuple[socket.socket, bool]:
@@ -345,7 +501,12 @@ class RemoteNodeDispatcher(PlanDispatcher):
         # any unexpected dumps failure) after allow() granted the half-
         # open probe slot would bypass every on_success/on_failure/
         # on_abort path and wedge the breaker half-open forever
-        payload = serialize.dumps(plan)
+        from filodb_tpu.config import settings as _settings
+        stream_req = _settings().query.stream_frame_bytes > 0
+        payload = (_PLAN_MAGIC
+                   + struct.pack("<I",
+                                 _REQ_FLAG_STREAM if stream_req else 0)
+                   + serialize.dumps(plan))
         # per-peer circuit breaker: a peer that keeps failing
         # shard_unavailable is failed FAST (microseconds, no socket) so
         # the partial-result path engages immediately instead of every
@@ -458,16 +619,116 @@ class RemoteNodeDispatcher(PlanDispatcher):
             # a reply frame arrived: the peer is alive (even a
             # remote_failure reply resets the consecutive-failure run)
             br.on_success()
-        try:
-            reply = serialize.loads(raw)
-        except Exception as e:  # noqa: BLE001 — garbage frame, any shape
-            # corrupt reply: the stream may be out of sync — drop the
-            # pooled connection; NOT retried (the remote did execute)
-            self._reset()
-            raise QueryError(
-                "remote_failure",
-                f"node {where} sent a corrupt reply frame: "
-                f"{type(e).__name__}: {e}") from e
+        total_raw = len(raw)
+        frames = 0
+        assembler = None
+        if stream_req and raw.startswith(_STREAM_MAGIC):
+            # streamed (multi-frame) reply: fold each CRC-checked row
+            # slice into the preallocated assembler as it arrives —
+            # bounded coordinator memory per child regardless of range.
+            # The deadline applies PER FRAME (a stalled peer expires by
+            # the clock like any hop) and the query's own kill token is
+            # re-checked between frames.  A torn stream is the typed
+            # remote_failure, never a hang and never a silent partial
+            # (the assembler refuses to finish() short).
+            from filodb_tpu.parallel import streams
+            frames = 1
+            tok = getattr(plan.ctx, "cancel", None)
+            reply = None
+            try:
+                while True:
+                    last, _seq, body = _unpack_stream_frame(raw)
+                    msg = serialize.loads(body)
+                    if last:
+                        reply = msg
+                        break
+                    if "begin" in msg:
+                        # a parent that can merge row slices in place
+                        # (ReduceAggregateExec's map+reduce fold) gets
+                        # each piece as a mini block and the child is
+                        # NEVER materialized whole on the coordinator
+                        ff = getattr(plan, "_stream_fold", None)
+                        if ff is not None and \
+                                msg["begin"].get("type") == "ResultBlock":
+                            assembler = streams.StreamFold(msg["begin"],
+                                                           ff())
+                        else:
+                            assembler = streams.StreamAssembler(
+                                msg["begin"])
+                    elif "piece" in msg:
+                        if assembler is None:
+                            raise ValueError("stream piece before begin")
+                        assembler.add(msg["piece"])
+                    else:
+                        raise ValueError(
+                            f"unknown stream frame keys {sorted(msg)}")
+                    if tok is not None and tok.cancelled:
+                        # the stream is mid-flight: the pooled socket is
+                        # out of sync with the peer — drop it
+                        self._reset()
+                        tok.raise_if_cancelled(
+                            f"mid-stream from node {where}")
+                    if dl:
+                        left = dl - _time.time()
+                        if left <= 0:
+                            self._reset()
+                            raise QueryError(
+                                "query_timeout",
+                                f"deadline expired mid-stream from node "
+                                f"{where} ({frames} frames in)")
+                        # same share cap as the initial hop: under
+                        # partial results one stalled peer may burn at
+                        # most its deadline SHARE of the remainder per
+                        # frame wait (a droppable dispatch_timeout),
+                        # never the survivors' whole budget
+                        if allow_partial and 0 < self.deadline_share < 1:
+                            left *= self.deadline_share
+                        sock.settimeout(min(self.timeout_s, left))
+                    raw = _recv_frame(sock)
+                    frames += 1
+                    total_raw += len(raw)
+            except QueryError:
+                raise
+            except streams.FoldError as fe:
+                # application error inside the parent's fold (group-by
+                # cardinality limit, ...): the socket is out of sync
+                # mid-stream — drop it, but surface the REAL error
+                self._reset()
+                raise fe.cause
+            except socket.timeout as e:
+                self._reset()
+                if dl and _time.time() >= dl:
+                    raise QueryError(
+                        "query_timeout",
+                        f"node {where} stalled mid-stream past the "
+                        f"remaining deadline budget") from e
+                raise QueryError(
+                    "dispatch_timeout",
+                    f"node {where} stalled mid-stream (not retried: the "
+                    f"remote may still be sending)") from e
+            except (ConnectionError, OSError) as e:
+                self._reset()
+                raise QueryError(
+                    "remote_failure",
+                    f"node {where} stream torn mid-frame after {frames} "
+                    f"frames: {type(e).__name__}: {e}") from e
+            except Exception as e:  # noqa: BLE001 — CRC/decode garbage
+                self._reset()
+                raise QueryError(
+                    "remote_failure",
+                    f"node {where} sent a corrupt stream frame: "
+                    f"{type(e).__name__}: {e}") from e
+        else:
+            try:
+                reply = serialize.loads(raw)
+            except Exception as e:  # noqa: BLE001 — garbage frame, any shape
+                # corrupt reply: the stream may be out of sync — drop the
+                # pooled connection; NOT retried (the remote did execute)
+                self._reset()
+                raise QueryError(
+                    "remote_failure",
+                    f"node {where} sent a corrupt reply frame: "
+                    f"{type(e).__name__}: {e}") from e
         if not reply["ok"]:
             # a typed QueryError that fired ON the remote keeps its code
             # (query_timeout stays errorType "timeout" at the HTTP edge;
@@ -510,5 +771,29 @@ class RemoteNodeDispatcher(PlanDispatcher):
         remote_busy = (stats.cpu_seconds + stats.device_seconds
                        + stats.transfer_s)
         stats.transfer_s += max(wire_wall - remote_busy, 0.0)
-        stats.bytes_transferred += len(payload) + len(raw)
-        return reply["data"], stats
+        stats.bytes_transferred += len(payload) + total_raw
+        # true wire attribution (PR 15): bytes_transferred above also
+        # counts host→device uploads the remote's stats brought along,
+        # so the slowlog/?stats=true wire column gets its own counter
+        stats.wire_bytes += len(payload) + total_raw
+        data_out = reply["data"]
+        if reply.get("streamed"):
+            from filodb_tpu.utils.metrics import registry
+            registry.counter("transport_stream_frames").increment(frames)
+            stats.streamed_frames += frames
+            if assembler is None:
+                raise QueryError(
+                    "remote_failure",
+                    f"node {where} flagged a streamed reply without a "
+                    f"begin frame")
+            from filodb_tpu.parallel import streams
+            try:
+                data_out = assembler.finish()
+            except streams.FoldError as fe:
+                raise fe.cause
+            except ValueError as e:
+                # a short stream must NEVER pass as a full result
+                raise QueryError(
+                    "remote_failure",
+                    f"node {where} stream incomplete: {e}") from e
+        return data_out, stats

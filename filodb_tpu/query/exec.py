@@ -43,7 +43,8 @@ from filodb_tpu.query.leafexec import (  # noqa: F401
     ScalarFixedDoubleExec, TimeScalarGeneratorExec, _estimate_scan)
 from filodb_tpu.query.nonleaf import (  # noqa: F401
     BinaryJoinExec, DistConcatExec, LocalPartitionDistConcatExec,
-    ReduceAggregateExec, SetOperatorExec, StitchRvsExec, SubqueryExec)
+    ReduceAggregateExec, RemoteAggregateExec, SetOperatorExec,
+    StitchRvsExec, SubqueryExec)
 from filodb_tpu.query.metaexec import (  # noqa: F401
     LabelValuesExec, MetadataMergeExec, PartKeysExec, SelectChunkInfosExec,
     _canon)

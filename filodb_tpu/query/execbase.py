@@ -502,11 +502,15 @@ class AnalyzeRecorder:
         r = self.by_node.get(id(node))
         if r is None:
             return "  [not executed locally]"
-        return ("  [self=%.3fms device=%.3fms transfer=%.3fms "
-                "bytes=%d samples=%d series=%d]"
-                % (r["self_s"] * 1e3, r["device_s"] * 1e3,
-                   r["transfer_s"] * 1e3, r["bytes_transferred"],
-                   r["samples_scanned"], r["series_scanned"]))
+        out = ("  [self=%.3fms device=%.3fms transfer=%.3fms "
+               "bytes=%d samples=%d series=%d]"
+               % (r["self_s"] * 1e3, r["device_s"] * 1e3,
+                  r["transfer_s"] * 1e3, r["bytes_transferred"],
+                  r["samples_scanned"], r["series_scanned"]))
+        if r.get("pushdown"):
+            # node-group aggregation pushdown verdict (query/pushdown.py)
+            out += f" [pushdown={r['pushdown']}]"
+        return out
 
 
 class PlanDispatcher:
@@ -747,6 +751,16 @@ class NonLeafExecPlan(ExecPlan):
         return {i: key for key, idxs in by_key.items()
                 if len(idxs) > 1 for i in idxs}
 
+    def child_stream_fold(self, child) -> Optional[Callable]:
+        """Factory for an incremental fold of a STREAMED child reply
+        (parallel/streams.StreamFold): when non-None, the transport
+        hands each row-slice frame to `factory().add(mini_block)` as it
+        arrives and returns `.result()` — the child's full block never
+        materializes on the coordinator.  Default: None (whole-block
+        assembly).  ReduceAggregateExec overrides with its map+reduce
+        fold."""
+        return None
+
     def _gather(self, source) -> Tuple[List[Data], QueryStats]:
         stats = QueryStats()
         results = []
@@ -783,6 +797,11 @@ class NonLeafExecPlan(ExecPlan):
                 continue
             has_later_twin = key is not None and any(
                 j > i for j, k in dedup_groups.items() if k == key)
+            ff = self.child_stream_fold(c)
+            if ff is not None:
+                # plain attribute, never serialized: the remote side
+                # streams row slices and THIS side folds them in place
+                c._stream_fold = ff
             try:
                 data, st = c.dispatcher.dispatch(c, source)
                 if key is not None:

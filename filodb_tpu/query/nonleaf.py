@@ -111,24 +111,124 @@ class LocalPartitionDistConcatExec(DistConcatExec):
     """ref: exec/DistConcatExec.scala LocalPartitionDistConcatExec."""
 
 
+class _AggStreamFold:
+    """Incremental fold for a STREAMED ship-everything child: each
+    arriving row-slice mini block runs the map phase immediately and
+    merges into ONE running AggPartial — the coordinator holds a frame
+    plus a [G, W] partial, never the child's full [S, W] block.  The
+    candidate ops stay correct piecewise for the same reason they are
+    correct per shard: per-piece top-k is a superset of each group's
+    true top-k, and the present phase applies the final mask."""
+
+    def __init__(self, op, params, by, without, ctx):
+        from filodb_tpu.query.transformers import AggregateMapReduce
+        self._mapper = AggregateMapReduce(op, params, by, without)
+        self._ctx = ctx
+        self._stats = QueryStats()
+        self._partial = None
+
+    def add(self, block) -> None:
+        p = self._mapper.apply(block, self._ctx, self._stats)
+        if p is None:
+            return
+        self._partial = p if self._partial is None else \
+            reduce_partials([self._partial, p])
+        # the per-slice map only sees one slice's worth of groups, so
+        # the limit must also be enforced on the MERGED partial — the
+        # streamed fold raises exactly where non-streamed compose would
+        limit = self._ctx.planner_params.group_by_cardinality_limit
+        if limit and len(self._partial.group_keys) > limit:
+            from filodb_tpu.query.execbase import GroupCardinalityError
+            raise GroupCardinalityError(
+                f"group-by cardinality limit {limit} exceeded "
+                f"({len(self._partial.group_keys)} groups in the "
+                f"streamed fold)")
+
+    def result(self):
+        return self._partial
+
+
+# ops whose map phase may run per row slice and reduce across slices
+# without changing the presented result (quantile's sketch
+# re-compression is merge-tree-dependent — it assembles whole)
+_FOLDABLE_OPS = frozenset({"sum", "count", "avg", "min", "max", "stddev",
+                           "stdvar", "group", "topk", "bottomk",
+                           "count_values"})
+
+
 class ReduceAggregateExec(NonLeafExecPlan):
-    """Reduce phase across shards (ref: AggrOverRangeVectors.scala:51)."""
+    """Reduce phase across shards (ref: AggrOverRangeVectors.scala:51).
+
+    Children normally reply with AggPartial (the map phase rides the
+    leaves).  With aggregation pushdown DISABLED (the ship-everything
+    A/B baseline, query/pushdown.py), remote children ship their full
+    per-series ResultBlocks instead and the map phase runs HERE — by/
+    without are carried so the coordinator-side map is possible."""
 
     # a duplicate shard here would double-count its samples into the
     # aggregate — the dedup contract matters most on this plan
     dedup_shard_children = True
 
-    def __init__(self, ctx, children, op: str, params: Tuple = ()):
+    def __init__(self, ctx, children, op: str, params: Tuple = (),
+                 by: Tuple[str, ...] = (), without: Tuple[str, ...] = ()):
         super().__init__(ctx, children)
         self.op = op
         self.params = params
+        self.by = tuple(by)
+        self.without = tuple(without)
 
     def args_str(self):
         return f"aggrOp={self.op}, aggrParams={list(self.params)}"
 
     def compose(self, results, stats):
-        parts = [r for r in results if isinstance(r, AggPartial)]
+        from filodb_tpu.query.transformers import AggregateMapReduce
+        mapper = None
+        parts = []
+        for r in results:
+            if isinstance(r, ResultBlock) and r.num_series:
+                # ship-everything child (pushdown off): map phase runs
+                # coordinator-side over the full shipped series block
+                if mapper is None:
+                    mapper = AggregateMapReduce(self.op, self.params,
+                                                self.by, self.without)
+                r = mapper.apply(r, self.ctx, stats)
+            if isinstance(r, AggPartial):
+                parts.append(r)
         return reduce_partials(parts)
+
+    def child_stream_fold(self, child):
+        if self.op not in _FOLDABLE_OPS:
+            return None
+        return lambda: _AggStreamFold(self.op, self.params, self.by,
+                                      self.without, self.ctx)
+
+    def _do_execute(self, source):
+        results, stats = self._gather(source)
+        # plan-time pushdown verdict (query/pushdown.py): remote children
+        # this aggregation could NOT push surface in ?stats=true /
+        # explain analyze / the slowlog next to the pushed counts the
+        # dispatchers booked
+        npn = getattr(self, "pushdown_not_pushable", 0)
+        if npn:
+            stats.pushdown_not_pushable += npn
+        return self.compose(results, stats), stats
+
+
+class RemoteAggregateExec(ReduceAggregateExec):
+    """Node-level reduce pushdown (query/pushdown.py): children are the
+    per-shard map subtrees owned by ONE data node, and the whole plan
+    serializes to that node via its PushdownDispatcher — the node runs
+    leaf scan + range function + map phase per shard, reduces locally
+    (inherited compose = reduce_partials), and replies with a single
+    [G, W] AggPartial.  Decoded on the data node the children fall back
+    to InProcessPlanDispatcher, so execution there is the ordinary
+    scatter-gather one level down (the PR-6 chip-level partial merge,
+    promoted to nodes)."""
+
+    def args_str(self):
+        shards = sorted(getattr(c, "shard", -1) for c in self._children)
+        return (f"aggrOp={self.op}, aggrParams={list(self.params)}, "
+                f"shards={shards}")
 
 
 class BinaryJoinExec(NonLeafExecPlan):
@@ -255,16 +355,36 @@ class SetOperatorExec(NonLeafExecPlan):
 
     def _presence_by_key(self, block: ResultBlock) -> Dict[RangeVectorKey, np.ndarray]:
         """match-key -> [W] bool, True where any series with that key has a
-        sample at the step."""
+        sample at the step.  Vectorized: one `_group_ids` pass maps each
+        series to its match-key group, then a single grouped OR
+        (`np.logical_or.reduceat` over gid-sorted rows) replaces the old
+        per-series Python loop — this sits on every and/or/unless path."""
         vals = np.asarray(block.values)
         if vals.ndim == 3:                       # histogram block
             vals = vals[..., 0]
-        present: Dict[RangeVectorKey, np.ndarray] = {}
-        for i, k in enumerate(block.keys):
-            mk = self._match_key(k)
-            pres = ~np.isnan(vals[i])
-            present[mk] = present.get(mk, False) | pres
-        return present
+        S = len(block.keys)
+        if S == 0:
+            return {}
+        if self.on is not None and not self.on:
+            # on() with an empty label list: everything shares the empty
+            # match key (k.only(()) — _group_ids' falsy-by branch would
+            # wrongly take `without` semantics here)
+            gids = np.zeros(S, dtype=np.int32)
+            gkeys = [RangeVectorKey(())]
+        elif self.on is not None:
+            gids, gkeys = _group_ids(block.keys, tuple(self.on), ())
+        else:
+            # ignoring=() must still strip only _metric_/__name__ (the
+            # _match_key rule); _group_ids' empty-without branch would
+            # collapse everything onto the empty key, so pad with a
+            # name no real label can carry
+            gids, gkeys = _group_ids(block.keys, (),
+                                     tuple(self.ignoring) or ("\x00",))
+        present = ~np.isnan(vals)
+        order = np.argsort(gids, kind="stable")
+        starts = np.searchsorted(gids[order], np.arange(len(gkeys)))
+        grouped = np.logical_or.reduceat(present[order], starts, axis=0)
+        return {gk: grouped[g] for g, gk in enumerate(gkeys)}
 
     def compose(self, results, stats):
         lhs = concat_blocks([r for r in results[:self.n_lhs]
@@ -373,25 +493,49 @@ class SubqueryExec(NonLeafExecPlan):
 
 class StitchRvsExec(NonLeafExecPlan):
     """Merge same-key series evaluated over adjacent time ranges
-    (ref: exec/StitchRvsExec.scala)."""
+    (ref: exec/StitchRvsExec.scala).
+
+    Vectorized (PR 15): the old per-series dict-of-rows Python loop ran
+    once per series per tier on EVERY long-range query's stitch path; it
+    is now one searchsorted + one fancy-indexed scatter per block into a
+    preallocated [S, W_union] output (histogram [S, W, B] blocks stitch
+    bucketwise the same way — the old loop could not)."""
 
     def compose(self, results, stats):
         blocks = [r for r in results if isinstance(r, ResultBlock)]
         if not blocks:
             return None
-        wends = np.unique(np.concatenate([b.wends for b in blocks]))
-        merged: Dict[RangeVectorKey, np.ndarray] = {}
+        if len(blocks) == 1:
+            return blocks[0]
+        wends = np.unique(np.concatenate([np.asarray(b.wends)
+                                          for b in blocks]))
+        row_of: Dict[RangeVectorKey, int] = {}
+        keys: List[RangeVectorKey] = []
         for b in blocks:
-            pos = np.searchsorted(wends, b.wends)
+            for k in b.keys:
+                if k not in row_of:
+                    row_of[k] = len(keys)
+                    keys.append(k)
+        # shape + bucket scheme come from the widest block, not
+        # blocks[0]: an EMPTY tier (0 series, 2-D values) may arrive
+        # first while a later tier carries [S, W, B] histogram data
+        ref = max(blocks, key=lambda b: np.asarray(b.values).ndim)
+        extra = np.asarray(ref.values).shape[2:]
+        out = np.full((len(keys), len(wends)) + extra, np.nan)
+        for b in blocks:
+            if b.num_series == 0:
+                continue
             vals = np.asarray(b.values)
-            for i, k in enumerate(b.keys):
-                row = merged.get(k)
-                if row is None:
-                    row = np.full(len(wends), np.nan)
-                    merged[k] = row
-                fill = vals[i]
-                take = ~np.isnan(fill)
-                row[pos[take]] = fill[take]
-        keys = list(merged)
-        return ResultBlock(keys, wends, np.stack([merged[k] for k in keys]))
+            pos = np.searchsorted(wends, np.asarray(b.wends))
+            rows = np.fromiter((row_of[k] for k in b.keys),
+                               dtype=np.int64, count=len(b.keys))
+            # scatter present samples; absent (NaN) steps keep whatever
+            # an earlier tier put there (later blocks win on overlap,
+            # exactly the old loop's fill rule)
+            idx = np.ix_(rows, pos)
+            take = ~np.isnan(vals)
+            out[idx] = np.where(take, vals, out[idx])
+        les = next((b.bucket_les for b in blocks
+                    if b.bucket_les is not None), None)
+        return ResultBlock(keys, wends, out, les)
 

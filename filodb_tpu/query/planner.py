@@ -189,11 +189,36 @@ class SingleClusterPlanner(QueryPlanner):
     # aggregates --------------------------------------------------------------
 
     def _m_Aggregate(self, p: lp.Aggregate, ctx: QueryContext) -> ExecPlan:
+        from filodb_tpu.query.exec import InProcessPlanDispatcher
+        from filodb_tpu.query.pushdown import plan_aggregate_pushdown
         children = self._leaves(p.vectors, ctx)
+        ship_raw = bool(getattr(ctx.planner_params, "ship_raw_series",
+                                False))
         for c in children:
-            c.add_transformer(AggregateMapReduce(
-                p.operator, tuple(p.params), tuple(p.by), tuple(p.without)))
-        reducer = ReduceAggregateExec(ctx, children, p.operator, tuple(p.params))
+            # every child keeps its leaf-side map phase (the pre-pushdown
+            # contract: per-shard dispatches reply with [G, W] partials,
+            # so aggregation_pushdown=false restores exactly today's
+            # path).  The one exception is the bench-only ship_raw_series
+            # strawman, which forces remote leaves to reply with FULL
+            # per-series blocks so bench.py distexec can measure the
+            # ship-everything wire cost; the map then runs on the
+            # coordinator (ReduceAggregateExec.compose).  Local children
+            # always map in place — there is no wire to win by hoisting.
+            if not ship_raw or isinstance(c.dispatcher,
+                                          InProcessPlanDispatcher):
+                c.add_transformer(AggregateMapReduce(
+                    p.operator, tuple(p.params), tuple(p.by),
+                    tuple(p.without)))
+        # node-level pushdown (query/pushdown.py): same-node map subtrees
+        # collapse into RemoteAggregateExec groups whose reduce runs ON
+        # the data node — only a [G, W] partial per NODE crosses the wire
+        children, not_pushable = plan_aggregate_pushdown(
+            children, p.operator, tuple(p.params), ctx)
+        reducer = ReduceAggregateExec(ctx, children, p.operator,
+                                      tuple(p.params), by=tuple(p.by),
+                                      without=tuple(p.without))
+        if not_pushable:
+            reducer.pushdown_not_pushable = not_pushable
         reducer.add_transformer(AggregatePresenter(p.operator, tuple(p.params)))
         return reducer
 

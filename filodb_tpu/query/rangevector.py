@@ -120,6 +120,20 @@ class QueryStats:
     # --- bytes ---
     bytes_transferred: int = 0      # host→device upload + wire reply bytes
     result_bytes: int = 0           # final result-matrix bytes at the root
+    # --- distributed execution (PR 15) ---
+    # bytes that actually crossed node-to-node sockets (request + reply
+    # frames) — bytes_transferred conflates these with host→device
+    # uploads, so wire attribution gets its own counter
+    wire_bytes: int = 0
+    # reply frames received on streamed (multi-frame) dispatches
+    streamed_frames: int = 0
+    # per-node aggregation-pushdown verdicts: node groups whose reduce
+    # ran ON the data node (pushed), groups that fell back to per-shard
+    # dispatch because the node was unreachable (fallback), and remote
+    # children an aggregation could not push (not_pushable)
+    pushdown_pushed: int = 0
+    pushdown_fallback: int = 0
+    pushdown_not_pushable: int = 0
     # --- cache attribution ---
     # result-cache verdict for this poll: "" (bypass) | "hit" | "partial"
     # | "miss" — set by the serving frontend, not merged bottom-up
@@ -156,6 +170,11 @@ class QueryStats:
         self.transfer_s += other.transfer_s
         self.bytes_transferred += other.bytes_transferred
         self.result_bytes += other.result_bytes
+        self.wire_bytes += other.wire_bytes
+        self.streamed_frames += other.streamed_frames
+        self.pushdown_pushed += other.pushdown_pushed
+        self.pushdown_fallback += other.pushdown_fallback
+        self.pushdown_not_pushable += other.pushdown_not_pushable
         self.result_cache = self.result_cache or other.result_cache
         self.mirror_full_rebuilds += other.mirror_full_rebuilds
         self.mirror_incremental += other.mirror_incremental
@@ -187,6 +206,13 @@ class QueryStats:
             },
             "samplesPaged": self.samples_paged,
             "bytesPaged": self.bytes_paged,
+            "wireBytes": self.wire_bytes,
+            "streamedFrames": self.streamed_frames,
+            "pushdown": {
+                "pushed": self.pushdown_pushed,
+                "fallback": self.pushdown_fallback,
+                "notPushable": self.pushdown_not_pushable,
+            },
             "cache": {
                 "result": self.result_cache,
                 "mirrorFullRebuilds": self.mirror_full_rebuilds,
@@ -265,6 +291,19 @@ class PlannerParams:
     # set by the ENGINE after re-plan retries are exhausted: scatter-
     # gathers may now drop unreachable children (see gate note above)
     partial_now: bool = dataclasses.field(default=False, repr=False)
+    # per-request override of query.aggregation_pushdown (None = server
+    # config).  repr=False: pushdown on/off is bit-identical by contract
+    # (exactly-mergeable partials only), so the serving keys must not
+    # split identical requests by routing stance.
+    aggregation_pushdown: Optional[bool] = dataclasses.field(
+        default=None, repr=False)
+    # benchmark-only strawman: suppress the leaf-side map phase so
+    # remote children ship FULL per-series blocks (the "ship everything"
+    # baseline bench.py distexec measures wire bytes against).  Off
+    # (False) is the only supported production value — pushdown=False
+    # already restores the per-shard dispatch where every shard still
+    # replies with its [G, W] map partial.
+    ship_raw_series: bool = dataclasses.field(default=False, repr=False)
 
 
 @dataclasses.dataclass

@@ -48,6 +48,17 @@ class ReplicaFailoverDispatcher(PlanDispatcher):
             [n for n, _ in self.targets]
         self.shuffle_k = shuffle_k
 
+    def pushdown_target(self):
+        """Node address for aggregation pushdown (query/pushdown.py):
+        the PRIMARY owner's remote dispatcher.  A pushdown group that
+        cannot reach it falls back to per-shard dispatch, where this
+        dispatcher's owner walk provides the replica failover — so
+        grouping by primary never costs availability."""
+        if not self.targets:
+            return None
+        fn = getattr(self.targets[0][1], "pushdown_target", None)
+        return fn() if fn is not None else None
+
     def _walk_order(self, plan) -> Sequence[Tuple[str, PlanDispatcher]]:
         ws = getattr(getattr(plan, "ctx", None), "tenant_ws", "")
         k = self.shuffle_k

@@ -1,0 +1,663 @@
+"""Aggregation pushdown + streaming distributed execution (PR 15).
+
+Covers the ISSUE-15 contract: node-level reduce pushdown is bit-
+identical to the ship-everything baseline across dense/ragged/histogram
+aggregations, unreachable nodes fall back to the per-shard (failover)
+path, duplicate-shard gather dedup keeps working on partials, streamed
+multi-frame replies round-trip with CRC framing, and a torn stream is a
+typed remote_failure — never a hang, never a partial passed off as
+full."""
+import socket
+import struct
+import threading
+import zlib
+
+import numpy as np
+import pytest
+
+from filodb_tpu.core.index import Equals
+from filodb_tpu.ingest.generator import (counter_batch, gauge_batch,
+                                         histogram_batch)
+from filodb_tpu.parallel import serialize, streams
+from filodb_tpu.parallel import transport as tr
+from filodb_tpu.parallel.shardmapper import SpreadProvider
+from filodb_tpu.parallel.testcluster import make_fanout_cluster
+from filodb_tpu.query.engine import QueryEngine
+from filodb_tpu.query.exec import (AggPartial, AggregateMapReduce,
+                                   DistConcatExec, MultiSchemaPartitionsExec,
+                                   PeriodicSamplesMapper, RawBlock,
+                                   ReduceAggregateExec, RemoteAggregateExec,
+                                   StitchRvsExec)
+from filodb_tpu.query.execbase import QueryError
+from filodb_tpu.query.pushdown import (PUSHABLE_OPS, PushdownDispatcher,
+                                       plan_aggregate_pushdown)
+from filodb_tpu.query.rangevector import (PlannerParams, QueryContext,
+                                          RangeVectorKey, ResultBlock)
+
+START = 1_600_000_020_000
+S = START // 1000
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    """4 data nodes x 2 shards each, coordinator with remote dispatchers
+    — the ISSUE-15 fan-out shape.  `int_gauge` carries integer samples:
+    every partial-sum component is then exactly representable, so the
+    bitwise on/off contract holds regardless of how the merge tree
+    associates (float data only guarantees last-ulp equality when a
+    group's series cross shard boundaries)."""
+    int_gauge = gauge_batch(192, 180, start_ms=START, metric="int_gauge")
+    int_gauge.columns["value"] = np.floor(int_gauge.columns["value"])
+    c = make_fanout_cluster(
+        [gauge_batch(192, 180, start_ms=START), int_gauge,
+         counter_batch(64, 180, start_ms=START),
+         histogram_batch(48, 180, start_ms=START)],
+        num_shards=8, nodes=("n1", "n2", "n3", "n4"), with_truth=True)
+    truth = QueryEngine("prometheus", c.truth, c.mapper,
+                        SpreadProvider(default_spread=1))
+    yield c, truth
+    c.stop()
+
+
+def _as_map(res):
+    out = {}
+    for b in res.blocks:
+        vals = np.asarray(b.values)
+        for i, k in enumerate(b.keys):
+            out[k] = (tuple(np.asarray(b.wends).tolist()),
+                      vals[i].tobytes())
+    return out
+
+
+def _range(eng, q, **kw):
+    pp = PlannerParams(**kw) if kw else None
+    return eng.query_range(q, S + 600, 60, S + 3600, pp)
+
+
+# ------------------------------------------------- pushdown A/B identity
+
+
+@pytest.mark.parametrize("q", [
+    'sum by (_ns_)(heap_usage)',                    # dense gauge
+    'sum by (dc)(int_gauge)',                       # cross-shard groups
+    'avg by (dc)(int_gauge)',
+    'stddev by (dc)(int_gauge)',
+    'min(heap_usage)',
+    'max by (_ns_)(heap_usage)',
+    'count by (_ns_)(heap_usage)',
+    'group by (dc)(heap_usage)',
+    'sum by (_ns_)(rate(request_total[5m]))',       # counter + range fn
+    'sum by (_ns_)(http_latency)',                  # histogram [G, W, B]
+])
+def test_pushdown_on_off_bit_identical(cluster, q):
+    c, truth = cluster
+    on = _range(c.engine, q, aggregation_pushdown=True)
+    off = _range(c.engine, q, aggregation_pushdown=False)
+    want = _range(truth, q)
+    assert on.error is None and off.error is None and want.error is None
+    assert on.num_series > 0                    # never vacuously equal
+    assert on.stats.pushdown_pushed >= 2        # >= 2 node groups engaged
+    assert off.stats.pushdown_pushed == 0
+    assert _as_map(on) == _as_map(off)
+    # same association order as the single-store truth engine (shard
+    # partials merge in shard order both ways at this integer scale)
+    assert _as_map(on) == _as_map(want)
+    # ship-everything moves strictly more wire bytes than the pushed path
+    assert off.stats.wire_bytes > on.stats.wire_bytes
+
+
+def test_pushdown_ragged_identical(cluster):
+    """Series born mid-range (NaN holes) aggregate identically."""
+    c, truth = cluster
+    q = 'sum by (_ns_)(heap_usage offset 10m)'
+    on = _range(c.engine, q, aggregation_pushdown=True)
+    off = _range(c.engine, q, aggregation_pushdown=False)
+    assert on.error is None and off.error is None
+    assert _as_map(on) == _as_map(off) == _as_map(_range(truth, q))
+
+
+def test_non_pushable_ops_keep_per_shard_path(cluster):
+    c, _ = cluster
+    assert "topk" not in PUSHABLE_OPS and "quantile" not in PUSHABLE_OPS
+    res = _range(c.engine, 'topk(3, heap_usage)')
+    assert res.error is None
+    assert res.stats.pushdown_pushed == 0
+    assert res.stats.pushdown_not_pushable >= 8     # one per remote shard
+    # stats surface the verdicts in the wire shape
+    d = res.stats.to_dict()
+    assert d["pushdown"]["notPushable"] >= 8
+    assert d["wireBytes"] > 0
+
+
+def test_pushdown_stats_and_wire_attribution(cluster):
+    c, _ = cluster
+    res = _range(c.engine, 'sum by (_ns_)(heap_usage)')
+    assert res.error is None
+    d = res.stats.to_dict()
+    assert d["pushdown"]["pushed"] == 4             # one group per node
+    assert d["wireBytes"] > 0
+    # wire bytes are a SUBSET of bytes_transferred (which also counts
+    # host->device uploads)
+    assert res.stats.wire_bytes <= res.stats.bytes_transferred
+
+
+# ------------------------------------------------- dedup + fallback
+
+
+def _leaf(ctx, shard, with_agg=True):
+    leaf = MultiSchemaPartitionsExec(
+        ctx, "prometheus", shard, [Equals("_metric_", "heap_usage")],
+        START, START + 3_600_000)
+    leaf.add_transformer(PeriodicSamplesMapper(
+        START + 600_000, 60_000, START + 3_600_000, None, None, ()))
+    if with_agg:
+        leaf.add_transformer(AggregateMapReduce("sum", (), ("_ns_",), ()))
+    return leaf
+
+
+def test_duplicate_shards_never_grouped(cluster):
+    """Both owners of a shard materialized (live-handoff window): the
+    twins stay DIRECT children so the PR-11 gather dedup contract keeps
+    holding on partials."""
+    c, _ = cluster
+    ctx = QueryContext()
+    disp = list(c.servers.values())[0]
+    rd = tr.RemoteNodeDispatcher(*disp.address)
+    kids = [_leaf(ctx, 0), _leaf(ctx, 0), _leaf(ctx, 1)]
+    for k in kids:
+        k.dispatcher = rd
+    out, _ = plan_aggregate_pushdown(kids, "sum", (), ctx)
+    dups = [p for p in out if isinstance(p, MultiSchemaPartitionsExec)]
+    groups = [p for p in out if isinstance(p, RemoteAggregateExec)]
+    assert len(dups) == 2 and all(p.shard == 0 for p in dups)
+    assert len(groups) == 1 and [k.shard for k in groups[0].children] == [1]
+
+
+def test_dedup_on_partials_no_double_count(cluster):
+    """A shard listed twice contributes EXACTLY once to the aggregate —
+    executed end to end against a real node."""
+    c, truth = cluster
+    ctx = QueryContext()
+    node = c.owner[0]
+    rd = tr.RemoteNodeDispatcher(*c.servers[node].address)
+    kids = [_leaf(ctx, 0), _leaf(ctx, 0)]
+    for k in kids:
+        k.dispatcher = rd
+    plan = ReduceAggregateExec(ctx, kids, "sum")
+    from filodb_tpu.query.exec import AggregatePresenter
+    plan.add_transformer(AggregatePresenter("sum", ()))
+    res = plan.execute(None)
+    assert res.error is None
+    single = ReduceAggregateExec(ctx, [_leaf(QueryContext(), 0)], "sum")
+    single.children[0].dispatcher = rd
+    single.add_transformer(AggregatePresenter("sum", ()))
+    want = single.execute(None)
+    assert _as_map(res) == _as_map(want)
+
+
+def test_fallback_when_node_group_unreachable(cluster):
+    """PushdownDispatcher: dead node -> the group degrades to the
+    per-shard path (here: leaves with live per-shard dispatchers on a
+    DIFFERENT address), counted as a fallback verdict."""
+    c, _ = cluster
+    ctx = QueryContext()
+    # dead target: a fresh unused port
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    dead_addr = s.getsockname()
+    s.close()
+    live = tr.RemoteNodeDispatcher(*c.servers[c.owner[0]].address)
+    kids = [_leaf(ctx, 0), _leaf(ctx, 1)]
+    for k in kids:
+        k.dispatcher = live                   # per-shard fallback target
+    group = RemoteAggregateExec(ctx, kids, "sum", ())
+    group.dispatcher = PushdownDispatcher(
+        tr.RemoteNodeDispatcher(*dead_addr, timeout_s=0.5))
+    data, stats = group.dispatcher.dispatch(group, None)
+    assert isinstance(data, AggPartial)
+    assert stats.pushdown_fallback == 1 and stats.pushdown_pushed == 0
+
+
+# ------------------------------------------------- wire round-trips
+
+
+def test_remote_aggregate_subtree_roundtrip():
+    ctx = QueryContext(query_id="pd1")
+    kids = [_leaf(ctx, 0), _leaf(ctx, 1)]
+    plan = RemoteAggregateExec(ctx, kids, "sum", ())
+    plan2 = serialize.loads(serialize.dumps(plan))
+    assert isinstance(plan2, RemoteAggregateExec)
+    assert plan2.print_tree() == plan.print_tree()
+    from filodb_tpu.query.execbase import InProcessPlanDispatcher
+    assert all(isinstance(k.dispatcher, InProcessPlanDispatcher)
+               for k in plan2.children)
+
+
+def test_kill_token_reaches_pushed_leaves():
+    """serialize gives every exec node its own QueryContext; the data-
+    node registration must stamp the kill token on every LEAF of a
+    pushed group — the leaves' exec-boundary cancel checks are what
+    actually stop the scans."""
+    ctx = QueryContext(query_id="kt1")
+    plan = RemoteAggregateExec(ctx, [_leaf(ctx, 0), _leaf(ctx, 1)],
+                               "sum", ())
+    plan2 = serialize.loads(serialize.dumps(plan))
+
+    class _Ent:
+        token = object()
+
+    ent = _Ent()
+    tr._attach_registration(plan2, ent)
+    assert plan2.ctx.cancel is ent.token
+    assert plan2.children                       # non-vacuous
+    for k in plan2.children:
+        assert k.ctx.cancel is ent.token
+
+
+def test_nonleaf_concat_still_refuses():
+    with pytest.raises(serialize.NotSerializable):
+        serialize.dumps(DistConcatExec(QueryContext(), []))
+
+
+def test_hist_rawblock_scheme_drift_roundtrip_and_concat():
+    """Histogram RawBlocks from two shards with DIFFERENT bucket schemes
+    survive the wire and rebucket onto the union at concat."""
+    rng = np.random.default_rng(7)
+    les_a = np.array([1.0, 2.0, 4.0, np.inf])
+    les_b = np.array([1.0, 4.0, 8.0, np.inf])
+
+    def mk(les, base_val):
+        counts = np.cumsum(
+            rng.integers(0, 3, size=(2, 5, len(les))), axis=2).astype(float)
+        counts += base_val
+        return RawBlock(
+            [RangeVectorKey.make({"inst": f"i{base_val}-{j}"})
+             for j in range(2)],
+            np.tile(np.arange(5, dtype=np.int32) * 1000, (2, 1)),
+            counts, START, bucket_les=les, samples=10)
+
+    ra, rb = mk(les_a, 0), mk(les_b, 100)
+    ra2 = serialize.loads(serialize.dumps(ra))
+    np.testing.assert_array_equal(ra2.bucket_les, les_a)
+    np.testing.assert_array_equal(np.asarray(ra2.values),
+                                  np.asarray(ra.values))
+    out = DistConcatExec(QueryContext(), []).compose(
+        [serialize.loads(serialize.dumps(r)) for r in (ra, rb)], None)
+    assert isinstance(out, RawBlock)
+    np.testing.assert_array_equal(out.bucket_les,
+                                  np.array([1.0, 2.0, 4.0, 8.0, np.inf]))
+    assert np.asarray(out.values).shape == (4, 5, 5)
+
+
+def test_agg_partial_sketch_roundtrip():
+    keys = [RangeVectorKey.make({"g": "x"})]
+    wends = np.asarray([1000, 2000], dtype=np.int64)
+    sk = np.zeros((1, 2, 4, 2))
+    sk[..., 0] = np.nan
+    p = AggPartial("quantile", keys, wends, sketch=sk, params=(0.5,))
+    p2 = serialize.loads(serialize.dumps(p))
+    np.testing.assert_array_equal(p2.sketch, sk)
+    assert p2.params == (0.5,)
+
+
+# ------------------------------------------------- stream split/assemble
+
+
+def _assemble(begin, pieces):
+    asm = streams.StreamAssembler(begin)
+    for p in pieces:
+        asm.add(p)
+    return asm.finish()
+
+
+def test_split_assemble_rawblock_roundtrip():
+    rng = np.random.default_rng(0)
+    Srows = 64
+    blk = RawBlock(
+        [RangeVectorKey.make({"i": str(i)}) for i in range(Srows)],
+        rng.integers(0, 1000, size=(Srows, 32)).astype(np.int32),
+        rng.normal(size=(Srows, 32)), START,
+        samples=123, vbase=rng.normal(size=Srows), dense=False)
+    split = streams.split_for_stream(blk, 4096)
+    assert split is not None
+    begin, pieces = split
+    assert len(pieces) > 1
+    out = _assemble(begin, pieces)
+    assert out.keys == blk.keys
+    np.testing.assert_array_equal(out.ts_off, blk.ts_off)
+    np.testing.assert_array_equal(out.values, blk.values)
+    np.testing.assert_array_equal(out.vbase, blk.vbase)
+    assert out.samples == 123 and out.dense is False
+
+
+def test_split_assemble_result_and_partial_forms():
+    rng = np.random.default_rng(1)
+    wends = np.arange(16, dtype=np.int64) * 1000
+    rb = ResultBlock([RangeVectorKey.make({"i": str(i)}) for i in range(32)],
+                     wends, rng.normal(size=(32, 16, 3)),
+                     bucket_les=np.array([1.0, 2.0, np.inf]))
+    begin, pieces = streams.split_for_stream(rb, 2048)
+    out = _assemble(begin, pieces)
+    assert out.keys == rb.keys
+    np.testing.assert_array_equal(out.values, rb.values)
+    np.testing.assert_array_equal(out.bucket_les, rb.bucket_les)
+    # component-form partial splits over groups
+    gk = [RangeVectorKey.make({"g": str(i)}) for i in range(64)]
+    comp = rng.normal(size=(64, 16, 2))
+    pa = AggPartial("sum", gk, wends, comp=comp)
+    out = _assemble(*streams.split_for_stream(pa, 4096))
+    assert out.op == "sum" and out.group_keys == gk
+    np.testing.assert_array_equal(out.comp, comp)
+    # candidate form splits over candidate rows, groups ride whole
+    cand = AggPartial("topk", gk[:2], wends,
+                      cand_keys=[RangeVectorKey.make({"i": str(i)})
+                                 for i in range(64)],
+                      cand_vals=rng.normal(size=(64, 16)),
+                      cand_groups=rng.integers(0, 2, size=64),
+                      params=(3.0,))
+    out = _assemble(*streams.split_for_stream(cand, 2048))
+    assert out.group_keys == gk[:2] and out.params == (3.0,)
+    np.testing.assert_array_equal(out.cand_vals, cand.cand_vals)
+    np.testing.assert_array_equal(out.cand_groups, cand.cand_groups)
+
+
+def test_assembler_refuses_short_stream():
+    rng = np.random.default_rng(2)
+    blk = ResultBlock([RangeVectorKey.make({"i": str(i)}) for i in range(32)],
+                      np.arange(8, dtype=np.int64), rng.normal(size=(32, 8)))
+    begin, pieces = streams.split_for_stream(blk, 512)
+    asm = streams.StreamAssembler(begin)
+    for p in pieces[:-1]:
+        asm.add(p)
+    with pytest.raises(ValueError, match="short stream"):
+        asm.finish()
+
+
+# ------------------------------------------------- streamed dispatch e2e
+
+
+def test_streamed_reply_multi_frame_identical(cluster, monkeypatch):
+    """Small frames force a many-frame stream; the result is identical
+    to the single-store truth and the frame count lands in stats."""
+    c, truth = cluster
+    from filodb_tpu.config import settings
+    monkeypatch.setattr(settings().query, "stream_frame_bytes", 4096)
+    q = 'heap_usage'
+    res = _range(c.engine, q)
+    want = _range(truth, q)
+    assert res.error is None
+    assert res.stats.streamed_frames > 8
+    assert _as_map(res) == _as_map(want)
+
+
+def test_streamed_shipeverything_fold_identical(cluster, monkeypatch):
+    """ship_raw_series (the bench strawman) + tiny frames: children ship
+    full series blocks as many-frame streams and ReduceAggregateExec
+    folds every slice through map+reduce as it arrives — result
+    identical to the unstreamed ship-everything path AND the pushed
+    path (integer data)."""
+    c, truth = cluster
+    from filodb_tpu.config import settings
+    q = 'sum by (dc)(int_gauge)'
+    monkeypatch.setattr(settings().query, "stream_frame_bytes", 0)
+    plain = _range(c.engine, q, aggregation_pushdown=False,
+                   ship_raw_series=True)
+    monkeypatch.setattr(settings().query, "stream_frame_bytes", 4096)
+    folded = _range(c.engine, q, aggregation_pushdown=False,
+                    ship_raw_series=True)
+    assert plain.error is None and folded.error is None
+    assert folded.stats.streamed_frames > 8
+    assert _as_map(folded) == _as_map(plain) == _as_map(_range(truth, q))
+
+
+def test_fold_surfaces_group_cardinality_error(cluster, monkeypatch):
+    """An application error raised INSIDE the per-frame fold (group-by
+    cardinality limit) surfaces as the real error, not remote_failure."""
+    c, _ = cluster
+    from filodb_tpu.config import settings
+    monkeypatch.setattr(settings().query, "stream_frame_bytes", 4096)
+    res = c.engine.query_range(
+        'sum by (instance)(heap_usage)', S + 600, 60, S + 3600,
+        PlannerParams(aggregation_pushdown=False, ship_raw_series=True,
+                      group_by_cardinality_limit=2))
+    assert res.error is not None
+    assert "cardinality limit" in res.error
+    assert "remote_failure" not in res.error
+
+
+def test_fold_cardinality_limit_across_slices(cluster, monkeypatch):
+    """Each row slice stays UNDER the group-by limit but the merged
+    partial exceeds it: the streamed fold must still raise, exactly
+    like the non-streamed compose would."""
+    c, _ = cluster
+    from filodb_tpu.config import settings
+    monkeypatch.setattr(settings().query, "stream_frame_bytes", 4096)
+    # the limit is enforced per map invocation (per child): one shard
+    # holds 24 heap_usage series = 24 groups, but a 4 KiB row slice
+    # carries ~10 of them — only the merged-partial check can trip
+    res = c.engine.query_range(
+        'sum by (instance)(heap_usage)', S + 600, 60, S + 3600,
+        PlannerParams(aggregation_pushdown=False, ship_raw_series=True,
+                      group_by_cardinality_limit=20))
+    assert res.error is not None
+    assert "cardinality limit" in res.error
+    assert "remote_failure" not in res.error
+
+
+def test_stream_disabled_single_frame(cluster, monkeypatch):
+    c, truth = cluster
+    from filodb_tpu.config import settings
+    monkeypatch.setattr(settings().query, "stream_frame_bytes", 0)
+    res = _range(c.engine, 'heap_usage')
+    assert res.error is None and res.stats.streamed_frames == 0
+    assert _as_map(res) == _as_map(_range(truth, 'heap_usage'))
+
+
+def test_torn_stream_is_typed_remote_failure(cluster, monkeypatch):
+    """The server dies mid-stream (connection severed between frames):
+    the dispatch raises the typed remote_failure promptly — no hang, no
+    partial block handed to the exec tree."""
+    c, _ = cluster
+    from filodb_tpu.config import settings
+    monkeypatch.setattr(settings().query, "stream_frame_bytes", 2048)
+    real_pack = tr._pack_stream_frame
+    state = {"n": 0}
+
+    def sabotage(seq, body, last):
+        state["n"] += 1
+        if state["n"] == 3:
+            raise ConnectionResetError("server died mid-stream")
+        return real_pack(seq, body, last)
+
+    monkeypatch.setattr(tr, "_pack_stream_frame", sabotage)
+    node = c.owner[0]
+    rd = tr.RemoteNodeDispatcher(*c.servers[node].address, timeout_s=5.0)
+    plan = _leaf(QueryContext(query_id="torn1"), 0, with_agg=False)
+    plan.dispatcher = rd
+    with pytest.raises(QueryError) as ei:
+        rd.dispatch(plan, None)
+    assert ei.value.code == "remote_failure"
+    assert "torn" in str(ei.value) or "corrupt" in str(ei.value)
+
+
+def test_corrupt_stream_frame_crc_rejected(cluster, monkeypatch):
+    c, _ = cluster
+    from filodb_tpu.config import settings
+    monkeypatch.setattr(settings().query, "stream_frame_bytes", 2048)
+    real_pack = tr._pack_stream_frame
+    state = {"n": 0}
+
+    def flip(seq, body, last):
+        raw = real_pack(seq, body, last)
+        state["n"] += 1
+        if state["n"] == 2:          # corrupt the first piece frame body
+            raw = raw[:-1] + bytes([raw[-1] ^ 0xFF])
+        return raw
+
+    monkeypatch.setattr(tr, "_pack_stream_frame", flip)
+    node = c.owner[0]
+    rd = tr.RemoteNodeDispatcher(*c.servers[node].address, timeout_s=5.0)
+    plan = _leaf(QueryContext(query_id="crc1"), 0, with_agg=False)
+    plan.dispatcher = rd
+    with pytest.raises(QueryError) as ei:
+        rd.dispatch(plan, None)
+    assert ei.value.code == "remote_failure"
+    assert "CRC" in str(ei.value)
+
+
+def test_reply_serialize_failure_is_typed_error(cluster, monkeypatch):
+    """A reply the server cannot serialize answers with a TYPED error
+    reply on the same connection — never a torn socket that makes the
+    client retry (and the node re-execute) the plan."""
+    c, _ = cluster
+    calls = {"n": 0}
+
+    def boom(sock, stream_ok, plan, data, stats, spans):
+        calls["n"] += 1
+        raise TypeError("NotSerializable: <object at 0x0>")
+
+    monkeypatch.setattr(tr.NodeQueryServer, "_send_reply",
+                        staticmethod(boom))
+    node = c.owner[0]
+    rd = tr.RemoteNodeDispatcher(*c.servers[node].address, timeout_s=5.0)
+    plan = _leaf(QueryContext(query_id="ser1"), 0, with_agg=False)
+    plan.dispatcher = rd
+    with pytest.raises(QueryError) as ei:
+        rd.dispatch(plan, None)
+    assert ei.value.code == "remote_failure"
+    assert "NotSerializable" in str(ei.value)
+    assert calls["n"] == 1                      # executed exactly once
+
+
+def test_kill_mid_stream_is_structured_cancel(cluster, monkeypatch):
+    """A kill landing between stream frames stops the stream with the
+    typed query_canceled — the server checks the token per frame."""
+    c, _ = cluster
+    from filodb_tpu.config import settings
+    from filodb_tpu.query.activequeries import active_queries
+    monkeypatch.setattr(settings().query, "stream_frame_bytes", 2048)
+    real_pack = tr._pack_stream_frame
+    state = {"n": 0}
+
+    def kill_after_first_piece(seq, body, last):
+        state["n"] += 1
+        if state["n"] == 3:
+            active_queries.kill("killmid1", reason="admin",
+                                detail="test kill mid-stream")
+        return real_pack(seq, body, last)
+
+    monkeypatch.setattr(tr, "_pack_stream_frame", kill_after_first_piece)
+    node = c.owner[0]
+    rd = tr.RemoteNodeDispatcher(*c.servers[node].address, timeout_s=5.0)
+    plan = _leaf(QueryContext(query_id="killmid1"), 0, with_agg=False)
+    plan.dispatcher = rd
+    with pytest.raises(QueryError) as ei:
+        rd.dispatch(plan, None)
+    assert ei.value.code == "query_canceled"
+
+
+# ------------------------------------------------- vectorized satellites
+
+
+def test_stitch_vectorized_matches_reference():
+    """StitchRvsExec.compose (searchsorted scatter) == the old per-series
+    dict-of-rows loop, on ragged overlapping blocks."""
+    rng = np.random.default_rng(3)
+
+    def ref_compose(blocks):
+        wends = np.unique(np.concatenate([b.wends for b in blocks]))
+        merged = {}
+        for b in blocks:
+            pos = np.searchsorted(wends, b.wends)
+            vals = np.asarray(b.values)
+            for i, k in enumerate(b.keys):
+                row = merged.get(k)
+                if row is None:
+                    row = np.full(len(wends), np.nan)
+                    merged[k] = row
+                fill = vals[i]
+                take = ~np.isnan(fill)
+                row[pos[take]] = fill[take]
+        keys = list(merged)
+        return ResultBlock(keys, wends,
+                           np.stack([merged[k] for k in keys]))
+
+    def mk(keys, t0, n):
+        vals = rng.normal(size=(len(keys), n))
+        vals[rng.random(vals.shape) < 0.3] = np.nan
+        return ResultBlock(keys, np.arange(t0, t0 + n, dtype=np.int64),
+                           vals)
+
+    ka = [RangeVectorKey.make({"i": str(i)}) for i in range(12)]
+    kb = ka[6:] + [RangeVectorKey.make({"i": f"x{i}"}) for i in range(4)]
+    blocks = [mk(ka, 0, 20), mk(kb, 15, 20), mk(ka[:3], 30, 10)]
+    want = ref_compose(blocks)
+    got = StitchRvsExec(QueryContext(), []).compose(list(blocks), None)
+    assert got.keys == want.keys
+    np.testing.assert_array_equal(np.asarray(got.wends),
+                                  np.asarray(want.wends))
+    np.testing.assert_array_equal(np.asarray(got.values),
+                                  np.asarray(want.values))
+
+
+def test_stitch_vectorized_histogram_blocks():
+    """[S, W, B] blocks stitch bucketwise (the old loop could not)."""
+    rng = np.random.default_rng(4)
+    keys = [RangeVectorKey.make({"i": str(i)}) for i in range(4)]
+    les = np.array([1.0, np.inf])
+    a = ResultBlock(keys, np.arange(0, 8, dtype=np.int64),
+                    rng.normal(size=(4, 8, 2)), bucket_les=les)
+    b = ResultBlock(keys, np.arange(8, 16, dtype=np.int64),
+                    rng.normal(size=(4, 8, 2)), bucket_les=les)
+    out = StitchRvsExec(QueryContext(), []).compose([a, b], None)
+    assert np.asarray(out.values).shape == (4, 16, 2)
+    np.testing.assert_array_equal(out.values[:, :8], a.values)
+    np.testing.assert_array_equal(out.values[:, 8:], b.values)
+    np.testing.assert_array_equal(out.bucket_les, les)
+
+
+def test_stitch_empty_first_tier_histogram():
+    """An empty tier (0 series, 2-D values) arriving FIRST must not
+    poison the output shape or drop the bucket scheme of a later
+    histogram tier."""
+    rng = np.random.default_rng(6)
+    keys = [RangeVectorKey.make({"i": str(i)}) for i in range(3)]
+    les = np.array([0.5, np.inf])
+    empty = ResultBlock([], np.arange(0, 4, dtype=np.int64),
+                        np.empty((0, 4)))
+    hist = ResultBlock(keys, np.arange(4, 12, dtype=np.int64),
+                       rng.normal(size=(3, 8, 2)), bucket_les=les)
+    out = StitchRvsExec(QueryContext(), []).compose([empty, hist], None)
+    assert np.asarray(out.values).shape == (3, 12, 2)
+    np.testing.assert_array_equal(out.values[:, 4:], hist.values)
+    assert np.isnan(np.asarray(out.values)[:, :4]).all()
+    np.testing.assert_array_equal(out.bucket_les, les)
+
+
+def test_presence_by_key_vectorized_matches_reference():
+    from filodb_tpu.query.nonleaf import SetOperatorExec
+    rng = np.random.default_rng(5)
+    keys = [RangeVectorKey.make({"a": str(i % 3), "b": str(i % 2),
+                                 "_metric_": "m"})
+            for i in range(24)]
+    vals = rng.normal(size=(24, 10))
+    vals[rng.random(vals.shape) < 0.4] = np.nan
+    block = ResultBlock(keys, np.arange(10, dtype=np.int64), vals)
+
+    def ref(op):
+        present = {}
+        for i, k in enumerate(block.keys):
+            mk = op._match_key(k)
+            pres = ~np.isnan(vals[i])
+            prev = present.get(mk, np.zeros(10, bool))
+            present[mk] = prev | pres
+        return present
+
+    for kw in ({"on": ("a",)}, {"on": ("a", "b")}, {"on": ()},
+               {"ignoring": ("b",)}, {}):
+        op = SetOperatorExec(QueryContext(), [], [], "and", **kw)
+        got = op._presence_by_key(block)
+        want = ref(op)
+        assert set(got) == set(want)
+        for k in want:
+            np.testing.assert_array_equal(got[k], want[k])

@@ -196,7 +196,10 @@ def test_transport_points_fire_on_dispatch_path():
             with pytest.raises(QueryError) as ei:
                 disp.dispatch(mk_plan(), None)
             assert ei.value.code == "remote_failure"
-            assert "corrupt reply" in str(ei.value)
+            # streamed replies report a per-frame CRC mismatch, legacy
+            # single-frame replies a corrupt reply — both are the
+            # typed remote_failure
+            assert "corrupt" in str(ei.value)
 
         # dropped frame -> the timeout handling path, deterministically
         with faults.plan("transport.recv", "drop", first_k=1):

@@ -33,13 +33,15 @@ def test_cross_node_query_stitches_one_trace():
         assert res.trace_id, "query result must carry its trace id"
         evs = collector.trace(res.trace_id)
         names = [e["span"] for e in evs]
-        # remote subtree spans crossed the wire, tagged with their plan...
+        # remote subtree spans crossed the wire, tagged with their plan:
+        # with aggregation pushdown the dispatched subtree is the node's
+        # RemoteAggregateExec group (one per NODE, not per shard)
         remotes = [e for e in evs if e["span"].startswith("remote_exec")]
         assert remotes and all(
-            r.get("plan") == "MultiSchemaPartitionsExec" for r in remotes)
-        # one per dispatched leaf (4 shards), no duplication from the
-        # drain-per-reply protocol
-        assert len(remotes) == 4, names
+            r.get("plan") == "RemoteAggregateExec" for r in remotes)
+        # one per dispatched node group (2 nodes x 2 shards), no
+        # duplication from the drain-per-reply protocol
+        assert len(remotes) == 2, names
         # and the coordinator's root plan span is present
         assert any(n == "execplan" or n.startswith("execplan")
                    for n in names), names

@@ -54,6 +54,15 @@ of every headline metric is greppable in one file:
     Retry-After), ``qos_abuser_timeouts`` (gate: 0 — doomed queries
     shed at admission, never left to die in the queue) — plus a loud
     ``qos_error`` when the stage fails.
+  - the distributed-execution numbers (PR 15):
+    ``distexec_wire_bytes_ratio`` (gate: a 4-node fan-out
+    ``sum by (...)`` moves >= 10x fewer wire bytes pushed vs the
+    ship-everything baseline, results BIT-identical),
+    ``distexec_frontend_peak_rss_mb`` vs ``distexec_rss_budget_mb``
+    (gate: the streamed long-range aggregation holds traced peak
+    memory under a fixed budget the materialize-everything baseline
+    exceeds), ``distexec_pushdown_speedup_x`` — plus a loud
+    ``distexec_error`` when the stage fails.
 
 Existing hand-written round entries are MERGED, never clobbered: only
 missing keys are added, so curated notes survive re-runs.
@@ -129,6 +138,17 @@ CARRY = [
     "qos_abuser_shed", "qos_abuser_timeouts", "qos_abuser_completed",
     "qos_shed_retry_after_ok", "qos_capacity", "qos_gate_ok",
     "qos_error",
+    # distributed execution (ISSUE 15): the 4-node fan-out aggregation's
+    # pushed-vs-ship-everything wire ratio (gate: >= 10x, BIT-identical
+    # results), the long-range streamed-reply traced-peak bound (gate:
+    # streamed under a fixed budget the materialize-everything baseline
+    # exceeds), and the pushdown wall speedup — plus a loud
+    # distexec_error when the stage fails
+    "distexec_wire_bytes_ratio", "distexec_pushdown_speedup_x",
+    "distexec_bit_identical", "distexec_frontend_peak_rss_mb",
+    "distexec_baseline_peak_rss_mb", "distexec_rss_budget_mb",
+    "distexec_stream_frames", "distexec_stream_identical",
+    "distexec_pushed_nodes", "distexec_gate_ok", "distexec_error",
 ]
 RENAME = {"value": "headline_samples_per_sec",
           "p50_query_latency_s": "p50_s"}
