@@ -409,6 +409,55 @@ def cmd_tenants(args) -> int:
         time.sleep(args.interval)
 
 
+def cmd_cardinality(args) -> int:
+    """Head-block cardinality over HTTP (GET /api/v1/status/tsdb, the
+    Prometheus-compatible TSDB status shape): total alive series, top-k
+    metrics / label-value pairs / per-label value counts and index
+    memory, and the per-tenant series table with budget rejections.
+    The "which tenant blew up the index" runbook's first command
+    (doc/index.md)."""
+    path = "/api/v1/status/tsdb" if not args.dataset \
+        else f"/promql/{args.dataset}/api/v1/status/tsdb"
+    payload = _http_get(args.host, path, {"limit": str(args.k)})
+    if payload.get("status") != "success":
+        print(json.dumps(payload, indent=2))
+        return 1
+    if args.raw:
+        print(json.dumps(payload, indent=2))
+        return 0
+    data = payload["data"]
+    head = data.get("headStats", {})
+    tenants = data.get("seriesCountByTenant", [])
+    if args.tenant is not None:
+        rows = [t for t in tenants if t["name"] == args.tenant]
+        print(f"{'TENANT':<24} {'SERIES':>10}")
+        for t in rows:
+            print(f"{t['name']:<24} {t['value']:>10}")
+        if not rows:
+            print(f"(tenant {args.tenant!r} holds no alive series)")
+        return 0
+    print(f"numSeries={head.get('numSeries', 0)} "
+          f"numLabelPairs={head.get('numLabelPairs', 0)} "
+          f"tenantSeriesLimit={head.get('tenantSeriesLimit', 0)} "
+          f"tenantSeriesRejected={head.get('tenantSeriesRejected', 0)}")
+    sections = [
+        ("TOP METRICS", "seriesCountByMetricName", "SERIES"),
+        ("TOP TENANTS", "seriesCountByTenant", "SERIES"),
+        ("TOP LABEL=VALUE PAIRS", "seriesCountByLabelValuePair", "SERIES"),
+        ("VALUES PER LABEL", "labelValueCountByLabelName", "VALUES"),
+        ("INDEX MEMORY PER LABEL", "memoryInBytesByLabelName", "BYTES"),
+    ]
+    for title, key, unit in sections:
+        rows = data.get(key, [])
+        if not rows:
+            continue
+        print(f"\n{title}")
+        print(f"{'NAME':<40} {unit:>10}")
+        for r in rows:
+            print(f"{r['name'][:40]:<40} {r['value']:>10}")
+    return 0
+
+
 def cmd_events(args) -> int:
     """Tail the structured event journal over HTTP (GET /admin/events):
     newest events once, from a sequence number (`--since-seq`), or
@@ -793,6 +842,19 @@ def build_parser() -> argparse.ArgumentParser:
                     help="poll interval with --follow (seconds)")
     sp.add_argument("--raw", action="store_true", help="raw JSON")
     sp.set_defaults(fn=cmd_tenants)
+
+    sp = sub.add_parser("cardinality",
+                        help="head-block cardinality over HTTP "
+                             "(top-k metrics/tenants/label pairs from "
+                             "/api/v1/status/tsdb)")
+    sp.add_argument("--host", required=True)
+    sp.add_argument("--dataset", default="",
+                    help="dataset (default: the server's default dataset)")
+    sp.add_argument("--tenant", default=None,
+                    help="show only this workspace's series count")
+    sp.add_argument("--k", type=int, default=10, help="top-k per section")
+    sp.add_argument("--raw", action="store_true", help="raw JSON")
+    sp.set_defaults(fn=cmd_cardinality)
 
     sp = sub.add_parser("events", help="tail the event journal over HTTP")
     sp.add_argument("--host", required=True)

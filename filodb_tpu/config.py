@@ -438,6 +438,25 @@ class StoreConfig:
 
 
 @dataclasses.dataclass
+class IndexConfig:
+    """Tag-index engine knobs (core/index.py bitmap postings)."""
+    # per-tenant (_ws_) alive-series budget per shard, enforced at
+    # partition creation: an over-budget tenant's new series get a
+    # structured drop + the tenant_series_rejected counter (existing
+    # series keep ingesting).  0 disables.  Internal workspaces
+    # (_rules_, _self_) and series without _ws_ are exempt, like the
+    # usage scan limits.
+    tenant_series_limit: int = 0
+    # index_compaction background job cadence (standalone server):
+    # every interval each shard's index prunes tombstoned postings once
+    # its backlog crosses the threshold below.  <= 0 disables the job.
+    compaction_interval_s: float = 30.0
+    # tombstone backlog that triggers a compaction pass per shard; the
+    # churn-soak memory-flatness gate assumes this stays bounded
+    compaction_tombstone_threshold: int = 8192
+
+
+@dataclasses.dataclass
 class SpreadAssignment:
     """Per-shard-key spread override (ref: filodb-defaults.conf:157-161)."""
     shard_key: Dict[str, str]
@@ -485,6 +504,7 @@ class FilodbSettings:
     selfmon: SelfMonConfig = dataclasses.field(default_factory=SelfMonConfig)
     replication: ReplicationConfig = dataclasses.field(
         default_factory=ReplicationConfig)
+    index: IndexConfig = dataclasses.field(default_factory=IndexConfig)
     shard_key_level_metrics: bool = True
     quota_default: int = 2_000_000_000
     reassignment_min_interval_s: float = 2 * 3600.0
@@ -522,7 +542,8 @@ class FilodbSettings:
                              ("rules", self.rules), ("wal", self.wal),
                              ("ingest", self.ingest),
                              ("selfmon", self.selfmon),
-                             ("replication", self.replication)):
+                             ("replication", self.replication),
+                             ("index", self.index)):
             for k, v in (raw.pop(section, None) or {}).items():
                 _set_field(obj, k, v, f"{source}: {section}.{k}")
         if "spread_assignment" in raw:
@@ -568,7 +589,8 @@ class FilodbSettings:
             from filodb_tpu.utils.hoconlite import _parse_scalar
             parsed = _parse_scalar(val)
             for section in ("query_", "store_", "breaker_", "rules_",
-                            "wal_", "ingest_", "selfmon_", "replication_"):
+                            "wal_", "ingest_", "selfmon_", "replication_",
+                            "index_"):
                 if rest.startswith(section):
                     overlay.setdefault(section[:-1], {})[
                         rest[len(section):]] = parsed

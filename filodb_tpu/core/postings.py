@@ -1,0 +1,538 @@
+"""Roaring-style compressed bitmaps for the tag index's posting lists.
+
+A posting set over int64 partIds is chunked into 2^16-id containers
+keyed by `pid >> 16`.  Each container is either
+
+  * sparse — a sorted-unique ``uint16`` array of low bits (the classic
+    roaring array container), or
+  * dense  — a 1024-word ``uint64`` bitset (8 KB covering all 65536
+    slots), chosen once a container crosses ``SPARSE_MAX`` members.
+
+Set algebra (AND/OR/ANDNOT, intersection tests, cardinalities) runs as
+NumPy word ops / probes over aligned containers, so a multi-filter
+selector is a handful of array operations instead of K ``intersect1d``
+passes over full id arrays, and negative matchers are an ANDNOT against
+an alive bitmap instead of a ``setdiff1d`` complement.
+
+Below ``SMALL_MAX`` total members a bitmap skips containers entirely
+and holds one sorted-unique ``int64`` id array (**array mode**).  At
+high cardinality most posting sets are tiny but their ids spread over
+the whole pid range — a 100-member value bitmap in a 10M-id shard
+touches ~150 containers, so per-container constant costs (dict probes,
+8-byte numpy dispatches) dominate every operation.  Array mode keeps
+those sets as a single vector: AND is one ``intersect1d``, a fan-in
+union is one ``concatenate``+``unique``, and the index's materialize
+step probes the alive bitset with one fancy-index.  A set crossing
+``SMALL_MAX`` converts to containers once and never back (until a
+bulk removal empties it).
+
+Appends are O(1): new ids land in a pending list (global in array
+mode, per-container otherwise) and are folded into normalized form
+lazily on first read (the write path of a 10M-key index build must
+not re-sort an array per insert).
+
+The module-level ``_c_*`` helpers operate on bare containers (dtype
+tells sparse from dense) so callers holding raw dense word blocks —
+the index's flat alive bitset — can participate in the same algebra
+without wrapping them in a Bitmap.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, Iterable, List, Optional
+
+import numpy as np
+
+HI_SHIFT = 16
+CONTAINER_SIZE = 1 << HI_SHIFT          # ids per container
+LO_MASK = CONTAINER_SIZE - 1
+DENSE_WORDS = CONTAINER_SIZE // 64      # 1024 uint64 words = 8 KB
+SPARSE_MAX = 4096                       # sparse flips dense above this
+SMALL_MAX = 4096                        # array mode flips containers above
+UNION_ARRAY_MAX = 1 << 17               # all-array union stays an array
+                                        # up to this many raw ids
+
+_ONE = np.uint64(1)
+_EMPTY_IDS = np.zeros(0, dtype=np.int64)
+# 16-bit popcount table: dense-container cardinality = LUT over the
+# words reinterpreted as uint16 (no np.bitwise_count dependency)
+_POP16 = np.array([bin(i).count("1") for i in range(1 << 16)],
+                  dtype=np.uint8)
+# Striped fold locks: lookups are lock-free, but the lazy pending->
+# normalized fold mutates shared state, so two concurrent readers (or
+# a reader racing the ingest writer) must not each run it.  The fold
+# only ever consumes a length-stable PREFIX of a pending list and
+# never detaches the list object, so a writer's lock-free append can
+# land mid-fold without being lost.  Folds are rare (once per bitmap
+# per write burst): 64 shared locks cover millions of bitmaps without
+# per-instance lock memory.
+_FOLD_LOCKS = tuple(threading.Lock() for _ in range(64))
+
+
+def _dense_from_sparse(s: np.ndarray) -> np.ndarray:
+    w = np.zeros(DENSE_WORDS, dtype=np.uint64)
+    np.bitwise_or.at(w, s >> 6,
+                     np.left_shift(_ONE, (s & 63).astype(np.uint64)))
+    return w
+
+
+def _dense_popcount(w: np.ndarray) -> int:
+    return int(_POP16[w.view(np.uint16)].sum())
+
+
+def _c_card(c: np.ndarray) -> int:
+    return _dense_popcount(c) if c.dtype == np.uint64 else int(c.size)
+
+
+def _c_lo_ids(c: np.ndarray) -> np.ndarray:
+    """Container -> ascending int64 low bits."""
+    if c.dtype == np.uint64:
+        return np.flatnonzero(
+            np.unpackbits(c.view(np.uint8), bitorder="little"))
+    return c.astype(np.int64)
+
+
+def _probe(words: np.ndarray, s: np.ndarray) -> np.ndarray:
+    """Bool mask: which sparse members have their dense bit set."""
+    bits = (words[s >> 6] >> (s & 63).astype(np.uint64)) & _ONE
+    return bits.astype(bool)
+
+
+def _c_and(a: Optional[np.ndarray],
+           b: Optional[np.ndarray]) -> Optional[np.ndarray]:
+    """Container AND; None in/out means empty."""
+    if a is None or b is None:
+        return None
+    da, db = a.dtype == np.uint64, b.dtype == np.uint64
+    if da and db:
+        out = np.bitwise_and(a, b)
+        return out if out.any() else None
+    if da:
+        out = b[_probe(a, b)]
+    elif db:
+        out = a[_probe(b, a)]
+    else:
+        out = np.intersect1d(a, b, assume_unique=True)
+    return out if out.size else None
+
+
+def _c_andnot(a: Optional[np.ndarray],
+              b: Optional[np.ndarray]) -> Optional[np.ndarray]:
+    """Container a minus b."""
+    if a is None or b is None:
+        return a
+    da, db = a.dtype == np.uint64, b.dtype == np.uint64
+    if da and db:
+        out = np.bitwise_and(a, np.bitwise_not(b))
+        return out if out.any() else None
+    if da:
+        out = a.copy()
+        np.bitwise_and.at(
+            out, b >> 6,
+            np.bitwise_not(np.left_shift(_ONE,
+                                         (b & 63).astype(np.uint64))))
+        return out if out.any() else None
+    if db:
+        out = a[~_probe(b, a)]
+    else:
+        out = np.setdiff1d(a, b, assume_unique=True)
+    return out if out.size else None
+
+
+def _c_intersects(a: Optional[np.ndarray],
+                  b: Optional[np.ndarray]) -> bool:
+    if a is None or b is None:
+        return False
+    da, db = a.dtype == np.uint64, b.dtype == np.uint64
+    if da and db:
+        return bool(np.bitwise_and(a, b).any())
+    if da:
+        return bool(_probe(a, b).any())
+    if db:
+        return bool(_probe(b, a).any())
+    return bool(np.intersect1d(a, b, assume_unique=True).size)
+
+
+def _c_and_card(a: Optional[np.ndarray],
+                b: Optional[np.ndarray]) -> int:
+    if a is None or b is None:
+        return 0
+    da, db = a.dtype == np.uint64, b.dtype == np.uint64
+    if da and db:
+        return _dense_popcount(np.bitwise_and(a, b))
+    if da:
+        return int(_probe(a, b).sum())
+    if db:
+        return int(_probe(b, a).sum())
+    return int(np.intersect1d(a, b, assume_unique=True).size)
+
+
+class Bitmap:
+    """A bitmap over non-negative int64 ids: one sorted id array while
+    small (``_s``/``_sp``), chunked sparse/dense containers
+    (``_c``/``_p``) above ``SMALL_MAX``."""
+
+    __slots__ = ("_c", "_p", "_s", "_sp")
+
+    def __init__(self):
+        self._c: Dict[int, np.ndarray] = {}    # hi -> container
+        self._p: Dict[int, List[int]] = {}     # hi -> pending low bits
+        self._s: Optional[np.ndarray] = None   # array mode: sorted ids
+        self._sp: List[int] = []               # array mode: pending ids
+
+    # ------------------------------------------------------- array mode
+
+    def _is_small(self) -> bool:
+        """Array mode: no container holds data.  (An emptied container
+        bitmap degrades to an empty array-mode one — harmless.)"""
+        return not self._c and not self._p
+
+    def _small_ids(self) -> np.ndarray:
+        """Array-mode ids, sorted unique int64 (callers must treat the
+        result as read-only — it may be the internal array)."""
+        if self._sp:
+            with _FOLD_LOCKS[(id(self) >> 6) & 63]:
+                sp = self._sp
+                n = len(sp)          # stable prefix: concurrent appends
+                if n:                # land past it and survive the del
+                    new = np.asarray(sp[:n], dtype=np.int64)
+                    self._s = np.unique(new) if self._s is None \
+                        else np.unique(np.concatenate([self._s, new]))
+                    del sp[:n]
+        return self._s if self._s is not None else _EMPTY_IDS
+
+    def _to_containers(self) -> None:
+        """One-way flip out of array mode (set crossed SMALL_MAX).
+        The pending dict is built complete and published with single
+        assignments so a concurrent reader sees either the full array
+        form or the full container form, never a torn mix."""
+        ids = self._small_ids()
+        pend: Dict[int, List[int]] = {}
+        for pid in ids.tolist():
+            pend.setdefault(pid >> HI_SHIFT, []).append(pid & LO_MASK)
+        self._p = pend
+        self._s = None
+
+    def _container_view(self) -> Dict[int, np.ndarray]:
+        """hi -> container dict without mutating the representation
+        (array-mode bitmaps get a transient sparse view)."""
+        if not self._is_small():
+            self._normalize()
+            return self._c
+        a = self._small_ids()
+        if a.size == 0:
+            return {}
+        his = a >> HI_SHIFT
+        return {hi: (a[his == hi] & LO_MASK).astype(np.uint16)
+                for hi in np.unique(his).tolist()}
+
+    def _member_mask(self, ids: np.ndarray) -> np.ndarray:
+        """Vectorized membership of sorted `ids` in this bitmap."""
+        if self._is_small():
+            a = self._small_ids()
+            mask = np.zeros(ids.shape[0], dtype=bool)
+            if a.size:
+                i = np.searchsorted(a, ids)
+                ok = i < a.size
+                mask[ok] = a[i[ok]] == ids[ok]
+            return mask
+        self._normalize()
+        mask = np.zeros(ids.shape[0], dtype=bool)
+        his = ids >> HI_SHIFT
+        for hi in np.unique(his).tolist():
+            c = self._c.get(hi)
+            if c is None:
+                continue
+            sel = his == hi
+            los = ids[sel] & LO_MASK
+            if c.dtype == np.uint64:
+                mask[sel] = _probe(c, los)
+            else:
+                i = np.searchsorted(c, los)
+                ok = i < c.size
+                hit = np.zeros(los.shape[0], dtype=bool)
+                hit[ok] = c[i[ok]] == los[ok]
+                mask[sel] = hit
+        return mask
+
+    # ------------------------------------------------------------ write
+
+    def add(self, pid: int) -> None:
+        if self._is_small():
+            self._sp.append(pid)
+            if len(self._sp) + (0 if self._s is None
+                                else self._s.shape[0]) > SMALL_MAX:
+                self._to_containers()
+            return
+        hi, lo = pid >> HI_SHIFT, pid & LO_MASK
+        c = self._c.get(hi)
+        if c is not None and c.dtype == np.uint64:
+            # dense containers absorb the bit in place, no pending pass
+            c[lo >> 6] |= _ONE << np.uint64(lo & 63)
+            return
+        while True:
+            lst = self._p.setdefault(hi, [])
+            lst.append(lo)
+            if self._p.get(hi) is lst:
+                return
+            # a concurrent fold drained and dropped the list between
+            # our setdefault and append — the bit may have missed the
+            # fold, so re-append (a double-landed bit dedups in the
+            # fold's unique/union)
+
+    def add_many(self, ids: np.ndarray) -> None:
+        for pid in np.asarray(ids, dtype=np.int64).tolist():
+            self.add(pid)
+
+    def discard(self, pid: int) -> None:
+        if self._is_small():
+            a = self._small_ids()
+            i = int(np.searchsorted(a, pid))
+            if i < a.size and a[i] == pid:
+                self._s = np.delete(a, i)
+            return
+        hi, lo = pid >> HI_SHIFT, pid & LO_MASK
+        c = self._norm(hi)
+        if c is None:
+            return
+        if c.dtype == np.uint64:
+            c[lo >> 6] &= ~(_ONE << np.uint64(lo & 63))
+            if not c.any():
+                del self._c[hi]
+        else:
+            i = int(np.searchsorted(c, lo))
+            if i < c.size and c[i] == lo:
+                c = np.delete(c, i)
+                if c.size:
+                    self._c[hi] = c
+                else:
+                    del self._c[hi]
+
+    def remove_many(self, ids: np.ndarray) -> None:
+        """Bulk removal (compaction path): ids grouped per container so a
+        dense container clears all its dead bits in one scatter."""
+        ids = np.asarray(ids, dtype=np.int64)
+        if ids.size == 0:
+            return
+        if self._is_small():
+            a = np.setdiff1d(self._small_ids(), ids, assume_unique=False)
+            self._s = a if a.size else None
+            return
+        his = ids >> HI_SHIFT
+        for hi in np.unique(his).tolist():
+            c = self._norm(hi)
+            if c is None:
+                continue
+            los = (ids[his == hi] & LO_MASK)
+            if c.dtype == np.uint64:
+                np.bitwise_and.at(
+                    c, los >> 6,
+                    np.bitwise_not(np.left_shift(
+                        _ONE, (los & 63).astype(np.uint64))))
+                if not c.any():
+                    del self._c[hi]
+            else:
+                c = np.setdiff1d(c, los.astype(np.uint16),
+                                 assume_unique=False)
+                if c.size:
+                    self._c[hi] = c
+                else:
+                    del self._c[hi]
+
+    # -------------------------------------------------------- normalize
+
+    def _norm(self, hi: int) -> Optional[np.ndarray]:
+        """The normalized container for `hi` (pending folded in), or
+        None when empty.  The emptied pending list stays in `_p` (a
+        lock-free writer may already hold a reference to it — removing
+        the dict entry would strand its next append)."""
+        lst = self._p.get(hi)
+        if lst:
+            with _FOLD_LOCKS[(id(self) >> 6) & 63]:
+                lst = self._p.get(hi)
+                n = len(lst) if lst else 0
+                if n:
+                    new = np.array(lst[:n], dtype=np.uint16)
+                    c = self._c.get(hi)
+                    if c is None:
+                        c = np.unique(new)
+                    elif c.dtype == np.uint64:
+                        np.bitwise_or.at(
+                            c, new >> 6,
+                            np.left_shift(_ONE,
+                                          (new & 63).astype(np.uint64)))
+                    else:
+                        c = np.union1d(c, new)
+                    if c.dtype != np.uint64 and c.size > SPARSE_MAX:
+                        c = _dense_from_sparse(c)
+                    self._c[hi] = c
+                    del lst[:n]
+                    if not lst:
+                        # drop the emptied entry so _normalize stays
+                        # O(pending), not O(containers-ever-touched);
+                        # add() re-checks list identity after append,
+                        # so a stranded concurrent append retries
+                        self._p.pop(hi, None)
+        return self._c.get(hi)
+
+    def _normalize(self) -> None:
+        if self._p:
+            for hi in list(self._p):
+                self._norm(hi)
+
+    def container(self, hi: int) -> Optional[np.ndarray]:
+        if self._is_small():
+            a = self._small_ids()
+            lo, hi_end = hi << HI_SHIFT, (hi + 1) << HI_SHIFT
+            seg = a[(a >= lo) & (a < hi_end)]
+            return (seg & LO_MASK).astype(np.uint16) if seg.size else None
+        if hi in self._p:
+            return self._norm(hi)
+        return self._c.get(hi)
+
+    def container_his(self) -> List[int]:
+        if self._is_small():
+            a = self._small_ids()
+            return np.unique(a >> HI_SHIFT).tolist() if a.size else []
+        self._normalize()
+        return sorted(self._c)
+
+    # ------------------------------------------------------------- read
+
+    def cardinality(self) -> int:
+        if self._is_small():
+            return int(self._small_ids().shape[0])
+        self._normalize()
+        return sum(_c_card(c) for c in self._c.values())
+
+    def __bool__(self) -> bool:
+        if self._is_small():
+            return bool(self._small_ids().shape[0])
+        self._normalize()
+        return bool(self._c)
+
+    def to_array(self) -> np.ndarray:
+        """All ids, ascending int64 (read-only — may alias internals)."""
+        if self._is_small():
+            return self._small_ids()
+        self._normalize()
+        if not self._c:
+            return _EMPTY_IDS
+        parts = []
+        for hi in sorted(self._c):
+            parts.append((hi << HI_SHIFT) + _c_lo_ids(self._c[hi]))
+        return parts[0] if len(parts) == 1 else np.concatenate(parts)
+
+    def contains(self, pid: int) -> bool:
+        if self._is_small():
+            a = self._small_ids()
+            i = int(np.searchsorted(a, pid))
+            return i < a.size and int(a[i]) == pid
+        c = self.container(pid >> HI_SHIFT)
+        if c is None:
+            return False
+        lo = pid & LO_MASK
+        if c.dtype == np.uint64:
+            return bool((c[lo >> 6] >> np.uint64(lo & 63)) & _ONE)
+        i = int(np.searchsorted(c, lo))
+        return i < c.size and int(c[i]) == lo
+
+    def memory_bytes(self) -> int:
+        """Rough resident estimate: payloads + pending lists + dict
+        slot overhead."""
+        if self._is_small():
+            return (0 if self._s is None else self._s.nbytes) \
+                + len(self._sp) * 8 + 96
+        n = sum(c.nbytes for c in self._c.values())
+        n += sum(len(p) * 8 for p in self._p.values())
+        return n + 96 * (len(self._c) + len(self._p))
+
+    def container_count(self) -> int:
+        if self._is_small():
+            return len(self.container_his())
+        self._normalize()
+        return len(self._c)
+
+    # ---------------------------------------------------------- algebra
+
+    def intersects(self, other: "Bitmap") -> bool:
+        if self._is_small():
+            return bool(other._member_mask(self._small_ids()).any())
+        if other._is_small():
+            return bool(self._member_mask(other._small_ids()).any())
+        self._normalize()
+        other._normalize()
+        a, b = self._c, other._c
+        if len(b) < len(a):
+            a, b = b, a
+        return any(_c_intersects(c, b.get(hi)) for hi, c in a.items())
+
+    def intersection_cardinality(self, other: "Bitmap") -> int:
+        if self._is_small():
+            return int(other._member_mask(self._small_ids()).sum())
+        if other._is_small():
+            return int(self._member_mask(other._small_ids()).sum())
+        self._normalize()
+        other._normalize()
+        a, b = self._c, other._c
+        if len(b) < len(a):
+            a, b = b, a
+        return sum(_c_and_card(c, b.get(hi)) for hi, c in a.items())
+
+
+def union_many(bitmaps: Iterable[Bitmap]) -> Bitmap:
+    """OR of many posting bitmaps (the In / regex-survivor fan-in).
+    All-array inputs union as one concatenate+unique (the hot fan-in
+    at high cardinality: hundreds of tiny spread-out value sets);
+    otherwise containers sharing a hi accumulate into one dense word
+    block, and a hi held by a single input reuses its container array
+    (inputs must be treated as immutable by the caller for the
+    result's lifetime)."""
+    bms = list(bitmaps)
+    arrs: List[np.ndarray] = []
+    big: List[Bitmap] = []
+    for bm in bms:
+        if bm._is_small():
+            a = bm._small_ids()
+            if a.size:
+                arrs.append(a)
+        else:
+            big.append(bm)
+    out = Bitmap()
+    if not big and sum(a.shape[0] for a in arrs) <= UNION_ARRAY_MAX:
+        if len(arrs) == 1:
+            out._s = arrs[0]
+        elif arrs:
+            out._s = np.unique(np.concatenate(arrs))
+        return out
+    by_hi: Dict[int, List[np.ndarray]] = {}
+    for bm in big:
+        bm._normalize()
+        for hi, c in bm._c.items():
+            by_hi.setdefault(hi, []).append(c)
+    for a in arrs:
+        his = a >> HI_SHIFT
+        for hi in np.unique(his).tolist():
+            by_hi.setdefault(hi, []).append(
+                (a[his == hi] & LO_MASK).astype(np.uint16))
+    for hi, cs in by_hi.items():
+        if len(cs) == 1:
+            out._c[hi] = cs[0]
+            continue
+        if all(c.dtype != np.uint64 for c in cs) \
+                and sum(c.shape[0] for c in cs) <= SPARSE_MAX:
+            # small all-sparse fan-in (the common 2-3-value alternation):
+            # keep the result sparse so downstream AND/decode stays
+            # O(set bits), not O(container)
+            out._c[hi] = np.unique(np.concatenate(cs))
+            continue
+        w = np.zeros(DENSE_WORDS, dtype=np.uint64)
+        for c in cs:
+            if c.dtype == np.uint64:
+                np.bitwise_or(w, c, out=w)
+            else:
+                np.bitwise_or.at(
+                    w, c >> 6,
+                    np.left_shift(_ONE, (c & 63).astype(np.uint64)))
+        out._c[hi] = w
+    return out

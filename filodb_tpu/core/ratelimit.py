@@ -42,6 +42,26 @@ class QuotaReachedException(Exception):
         self.quota = quota
 
 
+class TenantBudgetExceeded(QuotaReachedException):
+    """A workspace hit `index.tenant_series_limit` alive series on one
+    shard.  Subclasses QuotaReachedException so every existing drop site
+    (ingest _create_missing, WAL/index recovery) handles the structured
+    rejection unchanged — the series' records are dropped and counted,
+    never half-created."""
+
+    def __init__(self, ws: str, limit: int, alive: int):
+        # deliberately skip QuotaReachedException.__init__: the budget is
+        # per-workspace, not per shard-key prefix
+        Exception.__init__(
+            self, f"tenant_series_budget_exceeded: ws={ws!r} holds "
+                  f"{alive} alive series on this shard, over the "
+                  f"index.tenant_series_limit {limit}")
+        self.prefix = (ws,)
+        self.quota = limit
+        self.ws = ws
+        self.alive = alive
+
+
 class QuotaSource:
     """Default + override quotas per prefix (ref: QuotaSource.scala)."""
 

@@ -59,7 +59,8 @@ from filodb_tpu.config import FilodbSettings, settings as default_settings
 from filodb_tpu.core.blockstore import DenseSeriesStore
 from filodb_tpu.core.index import ColumnFilter, PartKeyIndex, MAX_TIME
 from filodb_tpu.core.partkey import PartKey
-from filodb_tpu.core.ratelimit import QuotaReachedException
+from filodb_tpu.core.ratelimit import (QuotaReachedException,
+                                       TenantBudgetExceeded)
 from filodb_tpu.core.records import RecordBatch
 from filodb_tpu.core.schemas import Schemas, DEFAULT_SCHEMAS
 from filodb_tpu.core.store import (ColumnStore, MetaStore, NullColumnStore,
@@ -93,6 +94,7 @@ class ShardStats:
     flushes: int = 0
     evictions: int = 0
     quota_dropped: int = 0          # series rejected by cardinality quota
+    tenant_rejected: int = 0        # series rejected by the per-ws budget
 
 
 from filodb_tpu.utils.growable import grow_to as _grow_to
@@ -192,6 +194,12 @@ class TimeSeriesShard:
         # pid -> small tenant id resolved once at partition creation, so
         # the hot ingest paths pay ONE vectorized bincount per batch
         self._usage_enabled = self.config.query.tenant_usage_enabled
+        # per-workspace alive-series counts backing the
+        # index.tenant_series_limit cardinality budget (0 = off).
+        # Internal workspaces (_rules_, _self_) and _ws_-less series are
+        # exempt from the gate but still counted when present.
+        self._tenant_series_limit = self.config.index.tenant_series_limit
+        self._ws_series: Dict[str, int] = {}
         self._pid_tenant = np.zeros(0, dtype=np.int32)
         self._tenant_ids: Dict[Tuple[str, str], int] = {}
         self._tenant_names: List[Tuple[str, str]] = []
@@ -305,6 +313,23 @@ class TimeSeriesShard:
             self.cardinality_tracker.series_created(
                 tuple(sk.get(c, "") for c in
                       self.schemas.part.options.shard_key_columns))
+        ws = ""
+        if self._tenant_series_limit:
+            # per-tenant cardinality budget: raises BEFORE any state is
+            # touched, like the quota protocol above.  _ws_-less and
+            # internal (_rules_/_self_) series are exempt, matching the
+            # usage scan-limit exemptions.
+            from filodb_tpu.utils.usage import INTERNAL_WORKSPACES
+            ws = part_key.tags_dict.get("_ws_", "")
+            if ws and ws not in INTERNAL_WORKSPACES:
+                alive = self._ws_series.get(ws, 0)
+                if alive >= self._tenant_series_limit:
+                    self.stats.tenant_rejected += 1
+                    metrics_registry.counter(
+                        "tenant_series_rejected", dataset=self.dataset,
+                        ws=ws).increment()
+                    raise TenantBudgetExceeded(
+                        ws, self._tenant_series_limit, alive)
         pid = len(self.partitions)
         store = self._store_for(schema_name)
         # group from the stable partKey hash, NOT partId: replay filtering by
@@ -339,6 +364,11 @@ class TimeSeriesShard:
         self.part_set[kb] = pid
         self.index.add_partition(pid, part_key, start_time_ms)
         self._dirty_part_keys.add(pid)
+        if self._tenant_series_limit:
+            if not ws:
+                ws = part_key.tags_dict.get("_ws_", "")
+            if ws:
+                self._ws_series[ws] = self._ws_series.get(ws, 0) + 1
         self.stats.partitions_created += 1
         if self.traced_part_filters or self._traced_groups:
             if self._trace_match(part_key):
@@ -1532,6 +1562,14 @@ class TimeSeriesShard:
                         self.cardinality_tracker.series_stopped(
                             tuple(sk.get(c, "") for c in
                                   self.schemas.part.options.shard_key_columns))
+                    if self._tenant_series_limit:
+                        ws = info.part_key.tags_dict.get("_ws_", "")
+                        if ws:
+                            n = self._ws_series.get(ws, 0) - 1
+                            if n > 0:
+                                self._ws_series[ws] = n
+                            else:
+                                self._ws_series.pop(ws, None)
                     evicted += 1
                     self.stats.evictions += 1
                 if evicted:
@@ -1555,3 +1593,18 @@ class TimeSeriesShard:
     @property
     def num_partitions(self) -> int:
         return int(self._pid_alive[:len(self.partitions)].sum())
+
+    def compact_index(self, tombstone_threshold: int = 0) -> bool:
+        """Prune the tag index's tombstoned postings under the shard
+        write lock (the index_compaction job's per-shard entry point) —
+        compaction swaps the index's linear-state holder and rewrites
+        posting containers, so it must not race ingest/eviction.  With a
+        threshold, compacts only once the backlog crossed it; returns
+        whether a compaction ran."""
+        with self._write_locked("index_compaction"):
+            if tombstone_threshold:
+                return self.index.maybe_compact(tombstone_threshold)
+            if self.index.tombstone_count == 0:
+                return False
+            self.index.compact()
+            return True

@@ -299,6 +299,8 @@ class PromHttpApi:
         if rest == ["status", "health"]:
             return 200, {"status": "success",
                          "data": self.health.evaluate()}
+        if rest == ["status", "tsdb"]:
+            return self._status_tsdb(dataset, params)
         return 404, _err(f"unknown api/v1 endpoint {'/'.join(rest)}")
 
     # -------------------------------------------------------- remote write
@@ -550,6 +552,70 @@ class PromHttpApi:
                 for p, v in merged.items()]
         rows.sort(key=lambda r: -r["tsCount"])
         return 200, {"status": "success", "data": rows[:k]}
+
+    def _status_tsdb(self, dataset: str,
+                     params: Dict[str, str]) -> Tuple[int, object]:
+        """GET /api/v1/status/tsdb — the Prometheus-compatible
+        cardinality explorer, built on the tag index's alive
+        label_value_counts and merged across shards: top-k metrics,
+        label-value pairs and value counts per label name, plus
+        per-tenant (_ws_) series totals and the per-ws budget rejection
+        count (the "which tenant is exploding cardinality" runbook view,
+        doc/index.md)."""
+        eng = self.engines[dataset]
+        k = _num_param(params, "limit", "10")
+        source = getattr(eng, "source", None)
+        mapper = self.shard_mappers.get(dataset)
+        shard_ids = mapper.all_shards() if mapper is not None else [0]
+        num_series = 0
+        rejected = 0
+        by_metric: Dict[str, int] = {}
+        values_by_label: Dict[str, int] = {}
+        mem_by_label: Dict[str, int] = {}
+        by_pair: Dict[str, int] = {}
+        by_tenant: Dict[str, int] = {}
+        for s in shard_ids:
+            shard = source.get_shard(dataset, s) if source else None
+            idx = getattr(shard, "index", None)
+            if idx is None:
+                continue
+            num_series += idx.num_docs
+            rejected += shard.stats.tenant_rejected
+            for label in idx.label_names():
+                counts = idx.label_value_counts(label)
+                values_by_label[label] = (values_by_label.get(label, 0)
+                                          + len(counts))
+                mem_by_label[label] = (mem_by_label.get(label, 0)
+                                       + idx.label_memory_bytes(label))
+                for v, c in counts:
+                    if c <= 0:
+                        continue
+                    if label == "__name__":
+                        by_metric[v] = by_metric.get(v, 0) + c
+                    elif label == "_ws_":
+                        by_tenant[v] = by_tenant.get(v, 0) + c
+                    pair = f"{label}={v}"
+                    by_pair[pair] = by_pair.get(pair, 0) + c
+
+        def topk(d: Dict[str, int]) -> list:
+            rows = sorted(d.items(), key=lambda kv: (-kv[1], kv[0]))
+            return [{"name": n, "value": v} for n, v in rows[:k]]
+
+        data = {
+            "headStats": {
+                "numSeries": num_series,
+                "numLabelPairs": len(by_pair),
+                "tenantSeriesRejected": rejected,
+                "tenantSeriesLimit":
+                    self._config.index.tenant_series_limit,
+            },
+            "seriesCountByMetricName": topk(by_metric),
+            "labelValueCountByLabelName": topk(values_by_label),
+            "memoryInBytesByLabelName": topk(mem_by_label),
+            "seriesCountByLabelValuePair": topk(by_pair),
+            "seriesCountByTenant": topk(by_tenant),
+        }
+        return 200, {"status": "success", "data": data}
 
     def _explain(self, eng: QueryEngine, q: str, start: int, step: int,
                  end: int) -> Tuple[int, object]:

@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import dataclasses
 import os
+import threading
 from typing import Dict, List, Optional, Sequence
 
 from filodb_tpu.config import (FilodbSettings, apply_jax_runtime,
@@ -39,6 +40,67 @@ class DatasetConfig:
     name: str = "prometheus"
     num_shards: int = 4
     downsample_resolutions: Sequence[int] = ()
+
+
+class IndexCompactionLoop:
+    """Churn maintenance for the part-key index (doc/index.md runbook).
+
+    Eviction flips an alive bit and leaves a tombstone — O(1), no posting
+    rewrite on the ingest path.  This daemon sweeps every shard of every
+    dataset each interval and runs PartKeyIndex.compact() once a shard's
+    tombstone backlog crosses `index.compaction_tombstone_threshold`,
+    pruning dead postings, empty value/label dict entries, and fully-dead
+    leading containers so index memory stays flat under series churn.
+    Registered as the `index_compaction` job (GET /admin/jobs)."""
+
+    def __init__(self, memstore, datasets: Sequence[str], interval_s: float,
+                 tombstone_threshold: int):
+        from filodb_tpu.utils.jobs import jobs
+        self.memstore = memstore
+        self.datasets = list(datasets)
+        self.interval_s = interval_s
+        self.tombstone_threshold = tombstone_threshold
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.job = jobs.register("index_compaction", interval_s=interval_s)
+
+    def start(self) -> "IndexCompactionLoop":
+        if self._thread is None:
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._run, name="filodb-index-compaction", daemon=True)
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        thread = self._thread
+        if thread is not None:
+            thread.join(timeout=30)
+            self._thread = None
+
+    def run_once(self) -> int:
+        """One sweep over every shard; returns shards compacted."""
+        compacted = 0
+        for name in self.datasets:
+            for sh in self.memstore.shards_for(name):
+                if sh.compact_index(self.tombstone_threshold):
+                    compacted += 1
+        return compacted
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                with self.job.tick() as jt:
+                    n = self.run_once()
+                    if n == 0:
+                        # below threshold everywhere: neutral tick, the
+                        # backlog keeps accruing until worth a rewrite
+                        jt.skip()
+                    else:
+                        self.job.set_progress(f"compacted {n} shard indexes")
+            except Exception:  # noqa: BLE001 — recorded by tick(); the
+                pass           # sweep must survive one bad shard
 
 
 class FiloServer:
@@ -79,6 +141,7 @@ class FiloServer:
         self.gateways: Dict[str, GatewayPipeline] = {}
         self.ds_stores: Dict[str, object] = {}
         self.flush_schedulers: Dict[str, object] = {}
+        self.index_compactor: Optional[IndexCompactionLoop] = None
         self.wals: Dict[str, object] = {}
         self._earliest_cache: Dict[str, tuple] = {}
         # historical tier: one cold DeviceMirror region (byte-budgeted LRU
@@ -532,6 +595,12 @@ class FiloServer:
                 self.flush_schedulers[dc.name] = sched.start()
         for sched in self.compaction_schedulers.values():
             sched.start()
+        if self.config.index.compaction_interval_s > 0:
+            self.index_compactor = IndexCompactionLoop(
+                self.memstore, [dc.name for dc in self.datasets],
+                interval_s=self.config.index.compaction_interval_s,
+                tombstone_threshold=self.config.index
+                .compaction_tombstone_threshold).start()
         if self.ruler is not None:
             self.ruler.start()
         if self.selfmon is not None:
@@ -549,6 +618,9 @@ class FiloServer:
             self.selfmon.stop()
         if self.ruler is not None:
             self.ruler.stop()
+        if self.index_compactor is not None:
+            self.index_compactor.stop()
+            self.index_compactor = None
         for sched in self.compaction_schedulers.values():
             sched.stop()
         self.compaction_schedulers.clear()

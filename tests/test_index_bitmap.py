@@ -1,0 +1,648 @@
+"""Bitmap posting-engine parity + churn suite (core/postings.py,
+core/index.py rewrite).
+
+The rewritten PartKeyIndex must be OBSERVABLY identical to the
+sorted-array engine it replaced: same ids, same order (endTime-stable),
+same ""-absent semantics, same metadata walks.  The old engine rides
+along below as `OracleIndex` (verbatim from the pre-bitmap index.py)
+and a seeded fuzz drives both through the same add / evict /
+end-time-update / compact / query schedule, comparing every answer.
+
+Divergence contract (the ONLY allowed differences, all from lazy vs
+eager deletion):
+  - pre-compaction both engines keep emptied values/labels in their
+    dicts, so no-filter walks match exactly;
+  - after the bitmap engine compacts, it prunes dead values AND dead
+    labels (the "label_names lists dead labels" fix) while the oracle
+    keeps empty entries forever — so post-compaction the bitmap walks
+    must equal the oracle's walks filtered to non-empty postings, and
+    stay a superset of those / subset of the oracle's full dict.
+Everything id-shaped (part_ids_from_filters, ended_pids, counts>0) is
+bit-identical always.
+"""
+import random
+import re
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+import pytest
+
+from filodb_tpu.core.index import (ColumnFilter, Equals, EqualsRegex, In,
+                                   MAX_TIME, NotEquals, NotEqualsRegex,
+                                   NotIn, PartKeyIndex, Prefix)
+from filodb_tpu.core.partkey import PartKey
+from filodb_tpu.utils.growable import grow_to
+
+
+def _full_match(pattern: str, value: str) -> bool:
+    return re.fullmatch(pattern, value) is not None
+
+
+class OracleIndex:
+    """The pre-bitmap PartKeyIndex (sorted numpy posting arrays, eager
+    removal) — kept verbatim as the behavioral oracle."""
+
+    def __init__(self):
+        self._postings: Dict[str, Dict[str, List[int]]] = {}
+        self._frozen: Dict[Tuple[str, str], np.ndarray] = {}
+        self._having: Dict[str, np.ndarray] = {}
+        self._start: np.ndarray = np.zeros(0, dtype=np.int64)
+        self._end: np.ndarray = np.zeros(0, dtype=np.int64)
+        self._alive: np.ndarray = np.zeros(0, dtype=bool)
+        self._part_keys: List[Optional[PartKey]] = []
+        self.num_docs = 0
+        self.mutations = 0
+
+    def add_partition(self, part_id: int, part_key: PartKey,
+                      start_time_ms: int, end_time_ms: int = MAX_TIME) -> None:
+        if part_id >= len(self._part_keys):
+            n = part_id + 1
+            self._start = grow_to(self._start, n)
+            self._end = grow_to(self._end, n, fill=MAX_TIME)
+            self._alive = grow_to(self._alive, n, fill=False)
+            self._part_keys.extend(
+                [None] * (self._start.shape[0] - len(self._part_keys)))
+        self._part_keys[part_id] = part_key
+        self._start[part_id] = start_time_ms
+        self._end[part_id] = end_time_ms
+        self._alive[part_id] = True
+        self._index_label("__name__", part_key.metric, part_id)
+        for k, v in part_key.tags:
+            self._index_label(k, v, part_id)
+        self.num_docs += 1
+        self.mutations += 1
+
+    def _index_label(self, key: str, value: str, part_id: int) -> None:
+        self._postings.setdefault(key, {}).setdefault(value, []) \
+            .append(part_id)
+        self._frozen.pop((key, value), None)
+        self._having.pop(key, None)
+
+    def update_end_time(self, part_id: int, end_time_ms: int) -> None:
+        self._end[part_id] = end_time_ms
+        self.mutations += 1
+
+    def start_time(self, part_id: int) -> int:
+        return int(self._start[part_id])
+
+    def end_time(self, part_id: int) -> int:
+        return int(self._end[part_id])
+
+    def part_key(self, part_id: int) -> Optional[PartKey]:
+        return self._part_keys[part_id] \
+            if part_id < len(self._part_keys) else None
+
+    def _ids_for(self, key: str, value: str) -> np.ndarray:
+        arr = self._frozen.get((key, value))
+        if arr is None:
+            lst = self._postings.get(key, {}).get(value, [])
+            arr = np.asarray(lst, dtype=np.int64)
+            self._frozen[(key, value)] = arr
+        return arr
+
+    def _all_ids(self) -> np.ndarray:
+        return np.nonzero(self._alive)[0].astype(np.int64)
+
+    def _union(self, parts) -> np.ndarray:
+        parts = list(parts)
+        return (np.unique(np.concatenate(parts)) if parts
+                else np.zeros(0, dtype=np.int64))
+
+    def _absent_or_empty(self, key: str) -> np.ndarray:
+        having = self._having.get(key)
+        if having is None:
+            having = self._union(self._ids_for(key, v)
+                                 for v in self._postings.get(key, {}) if v)
+            self._having[key] = having
+        return np.setdiff1d(self._all_ids(), having, assume_unique=False)
+
+    def _match_filter(self, f: ColumnFilter) -> np.ndarray:
+        key = "__name__" if f.column in ("__name__", "_metric_") \
+            else f.column
+        values = self._postings.get(key, {})
+        if isinstance(f, Equals):
+            return self._absent_or_empty(key) if f.value == "" \
+                else self._ids_for(key, f.value)
+        if isinstance(f, In):
+            parts = [self._ids_for(key, v) for v in f.values if v]
+            if "" in f.values:
+                parts.append(self._absent_or_empty(key))
+            return self._union(parts)
+        if isinstance(f, Prefix):
+            return self._union(self._ids_for(key, v) for v in values
+                               if v.startswith(f.prefix))
+        if isinstance(f, EqualsRegex):
+            parts = [self._ids_for(key, v) for v in values
+                     if v and _full_match(f.pattern, v)]
+            if _full_match(f.pattern, ""):
+                parts.append(self._absent_or_empty(key))
+            return self._union(parts)
+        if isinstance(f, (NotEquals, NotIn, NotEqualsRegex)):
+            if isinstance(f, NotEquals):
+                pos = Equals(f.column, f.value)
+            elif isinstance(f, NotIn):
+                pos = In(f.column, f.values)
+            else:
+                pos = EqualsRegex(f.column, f.pattern)
+            return np.setdiff1d(self._all_ids(), self._match_filter(pos),
+                                assume_unique=False)
+        raise TypeError(f"unsupported filter {f!r}")
+
+    def part_ids_from_filters(self, filters: Sequence[ColumnFilter],
+                              start_time_ms: int, end_time_ms: int,
+                              limit: Optional[int] = None) -> np.ndarray:
+        ids: Optional[np.ndarray] = None
+        for f in filters:
+            cur = self._match_filter(f)
+            ids = cur if ids is None \
+                else np.intersect1d(ids, cur, assume_unique=False)
+            if ids.size == 0:
+                return ids
+        if ids is None:
+            ids = self._all_ids()
+        mask = (self._start[ids] <= end_time_ms) \
+            & (self._end[ids] >= start_time_ms)
+        ids = ids[mask]
+        ids = ids[np.argsort(self._end[ids], kind="stable")]
+        return ids[:limit] if limit is not None else ids
+
+    def label_values(self, label: str,
+                     filters: Sequence[ColumnFilter] = (),
+                     start_time_ms: int = 0, end_time_ms: int = MAX_TIME,
+                     limit: Optional[int] = None) -> List[str]:
+        key = "__name__" if label in ("__name__", "_metric_") else label
+        if not filters:
+            vals = sorted(self._postings.get(key, {}).keys())
+            return vals[:limit] if limit else vals
+        ids = set(self.part_ids_from_filters(
+            filters, start_time_ms, end_time_ms).tolist())
+        out = set()
+        for value, plist in self._postings.get(key, {}).items():
+            if not ids.isdisjoint(plist):
+                out.add(value)
+        vals = sorted(out)
+        return vals[:limit] if limit else vals
+
+    def label_value_counts(self, label: str) -> List[Tuple[str, int]]:
+        key = "__name__" if label in ("__name__", "_metric_") else label
+        out = [(v, len(plist))
+               for v, plist in self._postings.get(key, {}).items()]
+        return sorted(out, key=lambda kv: (-kv[1], kv[0]))
+
+    def label_names(self, filters: Sequence[ColumnFilter] = (),
+                    start_time_ms: int = 0,
+                    end_time_ms: int = MAX_TIME) -> List[str]:
+        if not filters:
+            return sorted(self._postings.keys())
+        ids = set(self.part_ids_from_filters(
+            filters, start_time_ms, end_time_ms).tolist())
+        out = set()
+        for key, vals in self._postings.items():
+            for plist in vals.values():
+                if not ids.isdisjoint(plist):
+                    out.add(key)
+                    break
+        return sorted(out)
+
+    def ended_pids(self, before_ms: int) -> np.ndarray:
+        n = len(self._part_keys)
+        return np.flatnonzero(self._alive[:n] & (self._end[:n] < before_ms))
+
+    def remove_partition(self, part_id: int) -> None:
+        pk = self._part_keys[part_id]
+        if pk is None:
+            return
+        for k, v in [("__name__", pk.metric)] + list(pk.tags):
+            lst = self._postings.get(k, {}).get(v)
+            if lst and part_id in lst:
+                lst.remove(part_id)
+                self._frozen.pop((k, v), None)
+                self._having.pop(k, None)
+        self._part_keys[part_id] = None
+        self._alive[part_id] = False
+        self.num_docs -= 1
+        self.mutations += 1
+
+
+# --------------------------------------------------------------- fuzz
+
+
+METRICS = ["heap_usage", "req_total", "req_latency", "up", "gc_pause"]
+WORKSPACES = ["demo", "prod", "stage"]
+NAMESPACES = [f"App-{i}" for i in range(6)]
+INSTANCES = [f"inst-{i:03d}" for i in range(25)]
+JOBS = ["scraper", "api", "batch"]           # present on ~half the series
+
+
+def _random_part_key(rng: random.Random) -> PartKey:
+    tags = {
+        "_ws_": rng.choice(WORKSPACES),
+        "_ns_": rng.choice(NAMESPACES),
+        "instance": rng.choice(INSTANCES),
+    }
+    if rng.random() < 0.5:                   # absent on the other half:
+        tags["job"] = rng.choice(JOBS)       # exercises ""-semantics
+    if rng.random() < 0.2:
+        tags["path"] = f"/api/v{rng.randrange(3)}/x{rng.randrange(50)}"
+    return PartKey.make(rng.choice(METRICS), tags)
+
+
+def _filter_battery(rng: random.Random) -> List[List[ColumnFilter]]:
+    """Every matcher shape the index supports, including the planner's
+    edge cases: literal alternation, prefix extraction, trigram runs,
+    empty-matching regexes, and patterns the planner must refuse to
+    plan (lookahead) yet still answer correctly via full scan."""
+    met = rng.choice(METRICS)
+    ns = rng.choice(NAMESPACES)
+    job = rng.choice(JOBS)
+    inst = rng.choice(INSTANCES)
+    return [
+        [Equals("__name__", met)],
+        [Equals("_metric_", met), Equals("_ns_", ns)],
+        [Equals("job", job)],
+        [Equals("job", "")],                       # absent-or-empty
+        [Equals("_ns_", "no-such-ns")],
+        [NotEquals("job", job)],
+        [NotEquals("job", "")],                    # "has a job label"
+        [In("_ns_", (ns, rng.choice(NAMESPACES)))],
+        [In("job", ("", job))],
+        [NotIn("_ns_", (ns,))],
+        [Prefix("instance", inst[:6])],
+        [Prefix("_ns_", "App")],
+        [Prefix("_ns_", "zzz")],
+        [EqualsRegex("_ns_", f"{ns}|App-0")],      # literal alternation
+        [EqualsRegex("instance", "inst-0.*")],     # literal prefix
+        [EqualsRegex("instance", ".*-01.*")],      # trigram runs
+        [EqualsRegex("job", f"({job})?")],         # matches "" -> absent
+        [EqualsRegex("_ns_", "App-[0-3]")],        # class: scan fallback
+        [EqualsRegex("job", "(?=s).*")],           # lookahead: no plan
+        [EqualsRegex("path", ".*")],               # match-all incl absent
+        [NotEqualsRegex("_ns_", f"{ns}|App-1")],
+        [Equals("__name__", met), NotEqualsRegex("job", ".+")],
+        [Equals("_ws_", rng.choice(WORKSPACES)),
+         EqualsRegex("_ns_", "App-.*"),
+         NotEquals("instance", inst)],
+    ]
+
+
+def _assert_walk_parity(new: PartKeyIndex, oracle: OracleIndex,
+                        compacted: bool) -> None:
+    labels = set(oracle._postings) | set(new.label_names())
+    for label in labels:
+        o_all = oracle._postings.get(label, {})
+        o_live = {v for v, lst in o_all.items() if lst}
+        n_vals = set(new.label_values(label))
+        if not compacted:
+            assert n_vals == set(o_all), label
+        else:
+            assert o_live <= n_vals <= set(o_all), label
+        # counts: identical for every value still holding live series,
+        # in identical (-count, value) order over the >0 prefix
+        o_counts = [kv for kv in oracle.label_value_counts(label)
+                    if kv[1] > 0]
+        n_counts = [kv for kv in new.label_value_counts(label) if kv[1] > 0]
+        assert n_counts == o_counts, label
+    o_names = set(oracle.label_names())
+    o_live_names = {k for k, vals in oracle._postings.items()
+                    if any(vals.values())}
+    n_names = set(new.label_names())
+    if not compacted:
+        assert n_names == o_names
+    else:
+        assert o_live_names <= n_names <= o_names
+
+
+def _assert_parity(new: PartKeyIndex, oracle: OracleIndex,
+                   rng: random.Random, compacted: bool) -> None:
+    assert new.num_docs == oracle.num_docs
+    windows = [(0, MAX_TIME), (0, 5_000_000), (2_000_000, MAX_TIME),
+               (1_500_000, 3_500_000)]
+    for filters in _filter_battery(rng):
+        s, e = rng.choice(windows)
+        limit = rng.choice([None, None, 1, 7])
+        got = new.part_ids_from_filters(filters, s, e, limit=limit)
+        want = oracle.part_ids_from_filters(filters, s, e, limit=limit)
+        assert np.array_equal(got, want), (filters, s, e, limit)
+        # filtered metadata walks ride the same id sets: exact always
+        lbl = rng.choice(["_ns_", "job", "__name__", "instance"])
+        assert new.label_values(lbl, filters, s, e) \
+            == oracle.label_values(lbl, filters, s, e), (lbl, filters)
+        assert new.label_names(filters, s, e) \
+            == oracle.label_names(filters, s, e), filters
+    for cutoff in (0, 2_000_000, MAX_TIME):
+        assert np.array_equal(new.ended_pids(cutoff),
+                              oracle.ended_pids(cutoff))
+    alive = oracle._all_ids()
+    for pid in rng.sample(alive.tolist(), min(10, alive.size)):
+        assert new.start_time(pid) == oracle.start_time(pid)
+        assert new.end_time(pid) == oracle.end_time(pid)
+        assert new.part_key(pid) == oracle.part_key(pid)
+    _assert_walk_parity(new, oracle, compacted)
+
+
+@pytest.mark.parametrize("seed", [7, 1234])
+def test_fuzz_parity_with_sorted_array_oracle(seed):
+    rng = random.Random(seed)
+    new, oracle = PartKeyIndex(), OracleIndex()
+    alive_pids: List[int] = []
+    next_pid = 0
+    # seed population
+    for _ in range(400):
+        pk = _random_part_key(rng)
+        start = rng.randrange(1_000_000, 4_000_000)
+        new.add_partition(next_pid, pk, start)
+        oracle.add_partition(next_pid, pk, start)
+        alive_pids.append(next_pid)
+        next_pid += 1
+    _assert_parity(new, oracle, rng, compacted=False)
+    compacted = False
+    for step in range(6):
+        for _ in range(120):
+            op = rng.random()
+            if op < 0.45 or not alive_pids:
+                pk = _random_part_key(rng)
+                start = rng.randrange(1_000_000, 4_000_000)
+                new.add_partition(next_pid, pk, start)
+                oracle.add_partition(next_pid, pk, start)
+                alive_pids.append(next_pid)
+                next_pid += 1
+            elif op < 0.75:
+                pid = alive_pids.pop(rng.randrange(len(alive_pids)))
+                new.remove_partition(pid)
+                oracle.remove_partition(pid)
+            else:
+                pid = rng.choice(alive_pids)
+                end = rng.randrange(1_500_000, 5_000_000)
+                new.update_end_time(pid, end)
+                oracle.update_end_time(pid, end)
+        if step % 2 == 1:
+            stats = new.compact()
+            assert new.tombstone_count == 0
+            assert stats["tombstones_pruned"] >= 0
+            compacted = True
+        _assert_parity(new, oracle, rng, compacted=compacted)
+
+
+def test_pid_reuse_after_tombstone():
+    """A pid evicted then reassigned to a DIFFERENT key before any
+    compaction ran (flush/recovery reassigns pids densely) must shed its
+    old postings — the lazy tombstone cannot leak the old key's bits
+    into the new key's lookups."""
+    new, oracle = PartKeyIndex(), OracleIndex()
+    a = PartKey.make("m", {"_ws_": "w", "_ns_": "n1"})
+    b = PartKey.make("m", {"_ws_": "w", "_ns_": "n2"})
+    for idx in (new, oracle):
+        idx.add_partition(0, a, 1000)
+        idx.remove_partition(0)
+        idx.add_partition(0, b, 2000)
+    for f in ([Equals("_ns_", "n1")], [Equals("_ns_", "n2")]):
+        assert np.array_equal(
+            new.part_ids_from_filters(f, 0, MAX_TIME),
+            oracle.part_ids_from_filters(f, 0, MAX_TIME)), f
+
+
+def test_dead_labels_pruned_after_compaction():
+    """Satellite: a label carried only by evicted series must vanish
+    from label_names() once compaction runs (the old engine listed dead
+    labels forever)."""
+    idx = PartKeyIndex()
+    keep = PartKey.make("m", {"_ws_": "w", "common": "x"})
+    churn = PartKey.make("m", {"_ws_": "w", "ephemeral": "y"})
+    idx.add_partition(0, keep, 1000)
+    idx.add_partition(1, churn, 1000)
+    assert "ephemeral" in idx.label_names()
+    idx.remove_partition(1)
+    idx.compact()
+    assert "ephemeral" not in idx.label_names()
+    assert "common" in idx.label_names()
+    assert idx.label_values("ephemeral") == []
+
+
+def test_churn_compaction_reclaims_memory():
+    """3x-shard-size churn soak in miniature: evict-all / refill cycles
+    with ever-increasing pids.  Compaction must purge every tombstone
+    and rebase fully-dead leading containers, holding memory_bytes()
+    flat instead of growing with lifetime pid count."""
+    idx = PartKeyIndex()
+    n_per_cycle = 70_000         # > one 65536-pid container per cycle
+    next_pid = 0
+    sizes = []
+    for cycle in range(3):
+        pids = []
+        for i in range(n_per_cycle):
+            pk = PartKey.make(
+                "m", {"_ws_": "w", "_ns_": f"ns-{i % 40}",
+                      "instance": f"i{i % 997}"})
+            idx.add_partition(next_pid, pk, 1000)
+            pids.append(next_pid)
+            next_pid += 1
+        assert idx.num_docs == n_per_cycle
+        sizes.append(idx.memory_bytes())   # full-shard footprint per gen
+        if cycle < 2:
+            for pid in pids:
+                idx.remove_partition(pid)
+            assert idx.tombstone_count == n_per_cycle
+            stats = idx.compact()
+            assert idx.tombstone_count == 0
+            assert stats["tombstones_pruned"] == n_per_cycle
+            assert stats["ids_rebased"] >= 65536   # container rebase ran
+    # steady state: a full shard after 3 churn generations costs no more
+    # than +10% over the first generation
+    assert sizes[-1] <= sizes[0] * 1.10, sizes
+    # and queries on the rebased id space still resolve
+    ids = idx.part_ids_from_filters([Equals("_ns_", "ns-7")], 0, MAX_TIME)
+    assert ids.size == n_per_cycle // 40
+    assert int(ids.min()) >= 2 * n_per_cycle
+
+
+def test_bitmap_array_vs_container_mode_parity():
+    """The Bitmap's two representations (array mode below SMALL_MAX,
+    containers above) must agree on every operation.  The index-level
+    fuzz universe is small enough to stay in array mode throughout, so
+    this drives the container algebra directly by force-converting one
+    side of each pair."""
+    from filodb_tpu.core.postings import Bitmap, union_many
+
+    rng = np.random.default_rng(99)
+
+    def make_pair(ids):
+        a, b = Bitmap(), Bitmap()
+        for pid in ids:
+            a.add(int(pid))
+            b.add(int(pid))
+        b._to_containers()          # force the container representation
+        return a, b
+
+    for trial in range(20):
+        span = int(rng.integers(1 << 16, 1 << 21))
+        n = int(rng.integers(1, 3000))
+        ids = rng.choice(span, size=n, replace=False)
+        a, b = make_pair(ids)
+        assert np.array_equal(a.to_array(), b.to_array())
+        assert a.cardinality() == b.cardinality()
+        probes = rng.integers(0, span, size=50)
+        for p in probes.tolist():
+            assert a.contains(p) == b.contains(p)
+        # removal keeps both sides aligned
+        dead = rng.choice(ids, size=n // 3, replace=False) \
+            if n >= 3 else ids[:0]
+        a.remove_many(dead.astype(np.int64))
+        b.remove_many(dead.astype(np.int64))
+        assert np.array_equal(a.to_array(), b.to_array())
+        one = int(ids[0])
+        a.discard(one)
+        b.discard(one)
+        assert np.array_equal(a.to_array(), b.to_array())
+        # cross-mode algebra: intersects / intersection_cardinality
+        other_ids = rng.choice(span, size=max(1, n // 2), replace=False)
+        oa, ob = make_pair(other_ids)
+        want = np.intersect1d(a.to_array(), oa.to_array()).size
+        for x in (a, b):
+            for y in (oa, ob):
+                assert x.intersection_cardinality(y) == want
+                assert x.intersects(y) == (want > 0)
+        # unions across mixed modes agree with the set union
+        exp = np.union1d(a.to_array(), oa.to_array())
+        for combo in ([a, oa], [a, ob], [b, oa], [b, ob]):
+            assert np.array_equal(union_many(combo).to_array(), exp)
+
+
+def test_bitmap_array_mode_converts_past_threshold():
+    from filodb_tpu.core.postings import SMALL_MAX, Bitmap
+    bm = Bitmap()
+    for pid in range(0, (SMALL_MAX + 10) * 7, 7):   # spread over ids
+        bm.add(pid)
+    assert not bm._is_small()                       # flipped to containers
+    assert bm.cardinality() == SMALL_MAX + 10
+    assert bm.contains(7) and not bm.contains(8)
+
+
+def test_maybe_compact_threshold():
+    idx = PartKeyIndex()
+    for i in range(10):
+        idx.add_partition(i, PartKey.make("m", {"_ws_": "w", "i": str(i)}),
+                          1000)
+    for i in range(4):
+        idx.remove_partition(i)
+    assert not idx.maybe_compact(5)      # backlog 4 < threshold 5
+    assert idx.tombstone_count == 4
+    assert idx.maybe_compact(4)          # backlog 4 >= threshold 4
+    assert idx.tombstone_count == 0
+
+
+# ------------------------------------------- tenant cardinality budget
+
+
+def _shard_with_limit(limit: int):
+    from filodb_tpu.config import FilodbSettings
+    from filodb_tpu.core.memstore import TimeSeriesMemStore
+    cfg = FilodbSettings()
+    cfg.index.tenant_series_limit = limit
+    ms = TimeSeriesMemStore(config=cfg)
+    return ms.setup("prometheus", 0)
+
+
+def test_tenant_budget_rejects_over_limit():
+    from filodb_tpu.core.ratelimit import (QuotaReachedException,
+                                           TenantBudgetExceeded)
+    shard = _shard_with_limit(3)
+    for i in range(3):
+        shard.get_or_create_partition(
+            PartKey.make("m", {"_ws_": "noisy", "_ns_": "n",
+                               "i": str(i)}), "gauge", 1_000_000)
+    with pytest.raises(TenantBudgetExceeded) as exc:
+        shard.get_or_create_partition(
+            PartKey.make("m", {"_ws_": "noisy", "_ns_": "n", "i": "3"}),
+            "gauge", 1_000_000)
+    # structured: drop sites catch QuotaReachedException
+    assert isinstance(exc.value, QuotaReachedException)
+    assert exc.value.ws == "noisy" and exc.value.quota == 3
+    assert shard.stats.tenant_rejected == 1
+    # an existing series re-resolves fine at the limit
+    shard.get_or_create_partition(
+        PartKey.make("m", {"_ws_": "noisy", "_ns_": "n", "i": "0"}),
+        "gauge", 1_000_000)
+    # other tenants are unaffected
+    shard.get_or_create_partition(
+        PartKey.make("m", {"_ws_": "quiet", "_ns_": "n", "i": "0"}),
+        "gauge", 1_000_000)
+    assert shard.stats.tenant_rejected == 1
+
+
+def test_tenant_budget_exemptions():
+    """_rules_/_self_ (internal recording/selfmon series) and series
+    without a _ws_ tag are never budget-limited."""
+    shard = _shard_with_limit(2)
+    for ws in ("_rules_", "_self_"):
+        for i in range(5):
+            shard.get_or_create_partition(
+                PartKey.make("m", {"_ws_": ws, "_ns_": "n", "i": str(i)}),
+                "gauge", 1_000_000)
+    for i in range(5):
+        shard.get_or_create_partition(
+            PartKey.make("m", {"i": str(i)}), "gauge", 1_000_000)
+    assert shard.stats.tenant_rejected == 0
+
+
+def test_tenant_budget_freed_by_eviction():
+    from filodb_tpu.core.ratelimit import TenantBudgetExceeded
+    shard = _shard_with_limit(2)
+    for i in range(2):
+        shard.get_or_create_partition(
+            PartKey.make("m", {"_ws_": "w", "_ns_": "n", "i": str(i)}),
+            "gauge", 1_000_000)
+    with pytest.raises(TenantBudgetExceeded):
+        shard.get_or_create_partition(
+            PartKey.make("m", {"_ws_": "w", "_ns_": "n", "i": "2"}),
+            "gauge", 1_000_000)
+    for pid in range(2):
+        shard.index.update_end_time(pid, 1_050_000)
+    assert shard.evict_ended_partitions(2_000_000) == 2
+    # eviction returned the budget: the tenant can create again
+    shard.get_or_create_partition(
+        PartKey.make("m", {"_ws_": "w", "_ns_": "n", "i": "2"}),
+        "gauge", 3_000_000)
+
+
+def test_status_tsdb_endpoint():
+    """GET /api/v1/status/tsdb: Prometheus-compatible head stats with
+    the tenant table and budget-rejection counter folded in."""
+    from filodb_tpu.standalone import DatasetConfig, FiloServer
+    from filodb_tpu.config import FilodbSettings
+    cfg = FilodbSettings()
+    cfg.index.tenant_series_limit = 4
+    srv = FiloServer([DatasetConfig("prometheus", num_shards=1)],
+                     http_port=0, config=cfg)
+    try:
+        shard = srv.memstore.get_shard("prometheus", 0)
+        for i in range(4):
+            shard.get_or_create_partition(
+                PartKey.make("heap_usage",
+                             {"_ws_": "demo", "_ns_": "n", "i": str(i)}),
+                "gauge", 1_000_000)
+        from filodb_tpu.core.ratelimit import TenantBudgetExceeded
+        with pytest.raises(TenantBudgetExceeded):
+            shard.get_or_create_partition(
+                PartKey.make("heap_usage",
+                             {"_ws_": "demo", "_ns_": "n", "i": "4"}),
+                "gauge", 1_000_000)
+        st, payload = srv.api.handle(
+            "GET", "/api/v1/status/tsdb", {"limit": "5"})
+        assert st == 200 and payload["status"] == "success"
+        data = payload["data"]
+        head = data["headStats"]
+        assert head["numSeries"] == 4
+        assert head["tenantSeriesLimit"] == 4
+        assert head["tenantSeriesRejected"] == 1
+        tenants = {r["name"]: r["value"]
+                   for r in data["seriesCountByTenant"]}
+        assert tenants == {"demo": 4}
+        metrics = {r["name"]: r["value"]
+                   for r in data["seriesCountByMetricName"]}
+        assert metrics == {"heap_usage": 4}
+        assert any(r["name"] == "_ws_=demo"
+                   for r in data["seriesCountByLabelValuePair"])
+        assert all(r["value"] > 0
+                   for r in data["memoryInBytesByLabelName"])
+    finally:
+        srv.shutdown()

@@ -149,6 +149,17 @@ CARRY = [
     "distexec_baseline_peak_rss_mb", "distexec_rss_budget_mb",
     "distexec_stream_frames", "distexec_stream_identical",
     "distexec_pushed_nodes", "distexec_gate_ok", "distexec_error",
+    # high-cardinality bitmap index (ISSUE 16): `=~` first-plan p50
+    # (gate: < 10 ms on the zipf shard), equals point-lookup p50 (gate:
+    # < 1 ms), churn-soak memory growth across evict-all generations
+    # (gate: <= 10%, compaction + container rebase holding the line),
+    # plus build throughput and the one-time trigram-map build — and a
+    # loud index_error when the stage fails
+    "index_series", "index_build_keys_per_sec",
+    "index_equals_lookup_p50_ms", "index_regex_plan_p50_ms",
+    "index_regex_plan_max_ms", "index_regex_memo_p50_ms",
+    "index_trigram_build_ms", "index_churn_rss_growth_pct",
+    "index_memory_bytes", "index_gate_ok", "index_error",
 ]
 RENAME = {"value": "headline_samples_per_sec",
           "p50_query_latency_s": "p50_s"}
