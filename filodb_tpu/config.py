@@ -197,6 +197,22 @@ class QueryConfig:
     # frame and a kill lands between frames.  0 disables (single-frame
     # replies, the pre-PR-15 wire shape).
     stream_frame_bytes: int = 2 << 20
+    # --- whole-expression compilation (query/exprfuse.py; PR 17;
+    # doc/query-engine.md "Whole-expression compilation") ---
+    # compile whole expression trees, not just leaves: a multi-leaf
+    # query (joins, multi-shard scatter) and every query_range_batch
+    # dashboard batch run their leaves' fused preflights together and
+    # merge the kernel work into batched dispatches; binary-join label
+    # matching is memoized on the operands' working-set identity.
+    # Unsupported shapes degrade leaf-by-leaf to the general engine
+    # (query_exprfuse{verdict="degraded"}, stats.exprfuse) with
+    # bit-identical results — false disables the compiler entirely and
+    # restores per-leaf dispatch.
+    exprfuse_enabled: bool = True
+    # LRU capacity of the binary-join index-map cache (resolved label
+    # match maps keyed on the operand blocks' cache_token; one entry
+    # per distinct join x working set — a dashboard holds a few)
+    exprfuse_join_cache_entries: int = 64
 
 
 @dataclasses.dataclass

@@ -19,7 +19,58 @@ query.host_route_max_samples) and is observable via the
 """
 from __future__ import annotations
 
+import contextlib
+import threading
+
 import numpy as np
+
+# ------------------------------------------------- batch gather memo (PR 17)
+#
+# Under engine.query_range_batch, N panels over one working set each ran
+# the SAME per-shard windowed host gather AND its post-processing during
+# their fused preflight (the PR 6 deferred host-route inefficiency): the
+# scan + to_offsets + rebase_values/host_counter_correct chain is keyed
+# by (dataset, shard, chunk span, column, correction mode, row set, keys
+# epoch), all identical across the merged set — and the counter
+# correction alone costs more than the scan.  The engine opens this
+# scope around the batch's prepare phase; leafexec._do_execute consults
+# it so the working set is scanned and corrected ONCE and the processed
+# (ts_off, vals, vbase, counts, dense) arrays are shared — safe because
+# every downstream consumer (the host/kernel fused paths and the general
+# transformers) reads them immutably; none writes in place.  Scope is
+# thread-local: concurrent batches on other threads never see each
+# other's entries, and outside a scope the memo is inert (zero overhead
+# on the single-query path).
+
+_MEMO = threading.local()
+
+
+@contextlib.contextmanager
+def batch_gather_memo():
+    """Scope the per-shard gather memo over one batch's prepare phase."""
+    prev = getattr(_MEMO, "entries", None)
+    _MEMO.entries = {}
+    try:
+        yield
+    finally:
+        _MEMO.entries = prev
+
+
+def memo_get(key):
+    entries = getattr(_MEMO, "entries", None)
+    if entries is None:
+        return None
+    hit = entries.get(key)
+    if hit is not None:
+        from filodb_tpu.utils.metrics import registry
+        registry.counter("leaf_gather_memo_hits").increment()
+    return hit
+
+
+def memo_put(key, value) -> None:
+    entries = getattr(_MEMO, "entries", None)
+    if entries is not None:
+        entries[key] = value
 
 
 def host_leaf_agg(plan, vals: np.ndarray, vbase, gids: np.ndarray,

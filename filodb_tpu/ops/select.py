@@ -1,0 +1,64 @@
+"""Device select / binary-op kernels for whole-expression fusion (PR 17).
+
+Two small jitted programs that let non-leaf PromQL nodes stay on the
+device instead of round-tripping through host Python:
+
+  * ``gather_binop`` — a vector-matching binary operator as ONE compiled
+    program: gather the matched rows from both sides and apply the
+    arithmetic/comparison op.  The host resolves label matching once
+    into ``(mi, oi)`` index maps (see query/exprfuse.py, which caches
+    them on the block's ``cache_token``); the device never sees labels.
+  * ``topk_keep_rows`` — the node-local partial-select behind exact
+    ``topk``/``bottomk`` pushdown: a row may be pruned from a candidate
+    partial iff it makes NO per-window node-local top-k, because the
+    global top-k over a union is contained in the union of local
+    top-ks (same containment argument as the streaming fold, see
+    query/nonleaf.py ``_AggStreamFold``).
+
+Pure-XLA path — runs on any backend; no Pallas, no host callbacks.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from .agg import topk_mask
+from .instant import apply_binary_op
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("op", "bool_modifier", "keep_side"))
+def gather_binop(lhs_vals: jax.Array, rhs_vals: jax.Array,
+                 mi: jax.Array, oi: jax.Array, *, op: str,
+                 bool_modifier: bool = False,
+                 keep_side: str = "lhs") -> jax.Array:
+    """``lhs_vals[mi] <op> rhs_vals[oi]`` fused into one program.
+
+    ``lhs_vals``/``rhs_vals`` are the two sides' value blocks
+    ``[N_l, W]`` / ``[N_r, W]``; ``mi``/``oi`` are the host-resolved
+    match index maps ``[P]`` (one entry per output pair).  Returns the
+    ``[P, W]`` result with PromQL absent/NaN semantics from
+    ``apply_binary_op``.
+    """
+    a = jnp.take(lhs_vals, mi, axis=0)
+    b = jnp.take(rhs_vals, oi, axis=0)
+    return apply_binary_op(a, b, op=op, bool_modifier=bool_modifier,
+                           keep_side=keep_side)
+
+
+@functools.partial(jax.jit, static_argnames=("num_groups", "k", "largest"))
+def topk_keep_rows(vals: jax.Array, group_ids: jax.Array,
+                   num_groups: int, k: int,
+                   largest: bool = True) -> jax.Array:
+    """Rows worth shipping for an exact distributed top/bottom-k.
+
+    ``vals`` is a candidate partial's ``[N, W]`` value block.  Returns a
+    ``[N]`` bool mask: True iff the row lands in its group's per-window
+    top-k for AT LEAST ONE window.  Rows outside every window's local
+    top-k cannot appear in any global top-k and are safe to drop before
+    the partial crosses the wire.
+    """
+    return jnp.any(topk_mask(vals, group_ids, num_groups, k,
+                             largest=largest), axis=1)

@@ -67,6 +67,19 @@ def sketch_from_values(vals: np.ndarray, gids: np.ndarray, num_groups: int,
     return out
 
 
+def _centroid_order(means: np.ndarray, wts: np.ndarray) -> np.ndarray:
+    """Content-based total order over the centroid axis: live before
+    dead, then by (mean, weight).  A pure function of the centroid
+    MULTISET — centroids tied on both mean and weight are identical and
+    interchangeable — so every consumer below is insensitive to the
+    order shards/nodes concatenated in (a mean-only sort left equal-
+    mean ties at the mercy of concat order, which broke bit-identity
+    once node-level pushdown regrouped the shard merge tree)."""
+    key_mean = np.where(wts > 0, means, np.inf)
+    key_wt = np.where(wts > 0, wts, np.inf)
+    return np.lexsort((key_wt, key_mean), axis=-1)
+
+
 def merge_sketches(sk: np.ndarray, k: int = K_DEFAULT) -> np.ndarray:
     """Compress [G, W, M, 2] (concatenated centroids) back to [G, W, K, 2].
     Whole centroids are assigned to equal-weight bins by their cumulative
@@ -78,7 +91,7 @@ def merge_sketches(sk: np.ndarray, k: int = K_DEFAULT) -> np.ndarray:
         out[:, :, :M] = sk
         return out
     means, wts = sk[..., 0], sk[..., 1]
-    order = np.argsort(np.where(wts > 0, means, np.inf), axis=-1)
+    order = _centroid_order(means, wts)
     means = np.take_along_axis(means, order, axis=-1)
     wts = np.take_along_axis(wts, order, axis=-1)
     cum = np.cumsum(wts, axis=-1)
@@ -117,7 +130,7 @@ def sketch_quantile(sk: np.ndarray, q: float) -> np.ndarray:
     ranks reproduces Prometheus' `quantile()` exactly for singleton
     centroids and is the standard t-digest estimator otherwise."""
     means, wts = sk[..., 0], sk[..., 1]
-    order = np.argsort(np.where(wts > 0, means, np.inf), axis=-1)
+    order = _centroid_order(means, wts)
     means = np.take_along_axis(means, order, axis=-1)
     wts = np.take_along_axis(wts, order, axis=-1)
     cum = np.cumsum(wts, axis=-1)

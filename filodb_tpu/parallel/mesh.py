@@ -947,6 +947,60 @@ class MeshExecutor:
                                             fn_name=fn_name, agg_op=op)
         return results
 
+    def run_binop_agg(self, filters_l, filters_r, start_ms: int,
+                      end_ms: int, wends: np.ndarray, *, range_ms: int,
+                      fn_name: Optional[str], op: str,
+                      agg_op_l: str = "sum", agg_op_r: str = "sum",
+                      by=(), without=(), bool_modifier: bool = False
+                      ) -> Tuple[np.ndarray, List[Dict[str, str]]]:
+        """Mesh-wide vector-matching binary op between two aggregated
+        expressions: ``aggL by(...)(fnL(selL)) <op> aggR by(...)(selR)``
+        matched on the (shared) group labels.  Returns
+        (values [P, W], per-pair label dicts).
+
+        Whole-expression dispatch (PR 17): when both sides select the
+        SAME working set the two panels ride ONE run_agg_batch — one
+        pack, one merged kernel dispatch across the mesh; otherwise each
+        side runs its own fused scan.  Either way only the two sides'
+        [G, W] partials cross chips; the label match resolves host-side
+        into index maps and the op itself is one jitted gather+binop
+        program (ops/select.gather_binop)."""
+        from filodb_tpu.ops.select import gather_binop
+        by, without = tuple(by), tuple(without)
+        if list(filters_l) == list(filters_r):
+            (lv, ll), (rv, rl) = self.run_agg_batch(
+                filters_l, start_ms, end_ms, wends, range_ms=range_ms,
+                fn_name=fn_name,
+                panels=[(by, without, agg_op_l), (by, without, agg_op_r)])
+        else:
+            pl = self.lookup_and_pack(filters_l, start_ms, end_ms, by=by,
+                                      without=without, fn_name=fn_name)
+            pr = self.lookup_and_pack(filters_r, start_ms, end_ms, by=by,
+                                      without=without, fn_name=fn_name)
+            W = np.asarray(wends).shape[0]
+            lv, ll = ((np.zeros((0, W)), []) if pl is None else
+                      self.run_agg(pl, np.asarray(wends), range_ms=range_ms,
+                                   fn_name=fn_name, agg_op=agg_op_l))
+            rv, rl = ((np.zeros((0, W)), []) if pr is None else
+                      self.run_agg(pr, np.asarray(wends), range_ms=range_ms,
+                                   fn_name=fn_name, agg_op=agg_op_r))
+        # group labels are unique per side: one-to-one match on the
+        # label dict (both sides grouped by the same by/without)
+        rindex = {tuple(sorted(d.items())): j for j, d in enumerate(rl)}
+        pairs = [(i, rindex[tuple(sorted(d.items()))])
+                 for i, d in enumerate(ll)
+                 if tuple(sorted(d.items())) in rindex]
+        W = lv.shape[1] if lv.ndim == 2 else np.asarray(wends).shape[0]
+        if not pairs:
+            return np.zeros((0, W)), []
+        mi = np.asarray([p[0] for p in pairs], np.int64)
+        oi = np.asarray([p[1] for p in pairs], np.int64)
+        out = np.asarray(gather_binop(
+            jnp.asarray(np.asarray(lv)), jnp.asarray(np.asarray(rv)),
+            jnp.asarray(mi), jnp.asarray(oi), op=op,
+            bool_modifier=bool_modifier, keep_side="lhs"))
+        return out, [ll[i] for i, _ in pairs]
+
     def _panel_groupings(self, packed: PackedShards, panels):
         """Per-panel (gids, G, op, gsize) + labels over the pack's rows —
         the host remap work run_agg_batch caches per (pack, panels)."""

@@ -48,6 +48,14 @@ _LEAF_PLANS: Dict[str, Tuple[type, List[str]]] = {
         exec_mod.MultiSchemaPartitionsExec,
         ["dataset", "shard", "filters", "chunk_start_ms", "chunk_end_ms",
          "columns", "schema"]),
+    # cold-tier leaf (PR 17): `tier` crosses the wire as a dataset-name
+    # marker and rebinds to the RECEIVING node's PersistedTier on decode
+    # (persist.segments.query_tier) — so cold leaves can ride pushed
+    # RemoteAggregateExec node groups like hot ones
+    "SelectPersistedSegmentsExec": (
+        exec_mod.SelectPersistedSegmentsExec,
+        ["dataset", "shard", "filters", "chunk_start_ms", "chunk_end_ms",
+         "tier", "columns", "schema"]),
     "LabelValuesExec": (
         exec_mod.LabelValuesExec,
         ["dataset", "shard", "filters", "labels", "start_ms", "end_ms"]),
@@ -98,6 +106,12 @@ class _Encoder:
             # the PR-4 partial-results tests: raw un-aggregated blocks
             # failed to dispatch remotely at all)
             return [self.enc(k) for k in obj]
+        from filodb_tpu.persist.segments import PersistedTier
+        if isinstance(obj, PersistedTier):
+            # node-local (segment files + cold region): only the dataset
+            # name crosses the wire; the decoder rebinds to the
+            # receiving node's registered tier
+            return {"$tier": obj.dataset}
         if isinstance(obj, tuple):
             return {"$t": [self.enc(x) for x in obj]}
         if isinstance(obj, list):
@@ -159,6 +173,14 @@ class _Decoder:
         if isinstance(node, dict):
             if "$nd" in node:
                 return self.buffers[node["$nd"]]
+            if "$tier" in node:
+                from filodb_tpu.persist.segments import query_tier
+                tier = query_tier(node["$tier"])
+                if tier is None:
+                    raise NotSerializable(
+                        f"no persisted tier registered for dataset "
+                        f"{node['$tier']!r} on this node")
+                return tier
             if "$t" in node:
                 return tuple(self.dec(x) for x in node["$t"])
             if "$m" in node:

@@ -143,11 +143,10 @@ class QueryEngine:
         series cost either way; this is a TPU-shaped throughput feature
         (amortizing dispatch the way the MXU amortizes FLOPs).
         """
+        from filodb_tpu.ops import hostleaf
+        from filodb_tpu.query import exprfuse
         from filodb_tpu.query.activequeries import (set_admission,
                                                     take_admission)
-        from filodb_tpu.query.execbase import InProcessPlanDispatcher
-        from filodb_tpu.query.fusedbatch import finish_fused_calls
-        from filodb_tpu.query.leafexec import MultiSchemaPartitionsExec
         # the coalesce LEADER's admission entry must bind to ITS query,
         # not to whichever batch member happens to mint a context first
         # (a parse failure on the leader's own query would otherwise
@@ -184,26 +183,21 @@ class QueryEngine:
                 continue
             entries.append((i, ep, ctx, plan,
                             parse_t, _time.perf_counter() - t0))
+        # whole-expression compilation (query/exprfuse.py): EVERY tree's
+        # in-process leaves run their fused preflight — under one gather
+        # memo scope, so N panels over a shared working set scan it once
+        # — then all the prepared kernel work merges into the batched
+        # dispatch (killed queries filtered out before the dispatch)
         calls = []
-        for _, ep, _, _, _, _ in entries:
-            for leaf in _walk_plan(ep):
-                if isinstance(leaf, MultiSchemaPartitionsExec) and \
-                        isinstance(leaf.dispatcher, InProcessPlanDispatcher):
-                    try:
-                        fc = leaf.prepare_fused(self.source)
-                    except Exception:  # noqa: BLE001 — leaf will re-execute
-                        leaf._prefused = None
-                        fc = None
-                    if fc is not None:
-                        calls.append((leaf, fc))
-        if calls:
-            try:
-                partials = finish_fused_calls([fc for _, fc in calls])
-            except Exception:  # noqa: BLE001 — leaves finish standalone
-                partials = [None] * len(calls)
-            for (leaf, fc), partial in zip(calls, partials):
-                if partial is not None:
-                    leaf.inject_fused(partial)
+        comps = {}
+        if self._qconfig().exprfuse_enabled:
+            with hostleaf.batch_gather_memo():
+                for i, ep, _, _, _, _ in entries:
+                    comp = exprfuse.compile_tree(ep, self.source)
+                    if comp is not None:
+                        comps[i] = comp
+                        calls.extend(comp.calls)
+            exprfuse.finish_prepared(calls)
         for i, ep, ctx, plan, parse_t, plan_t in entries:
             res = ep.execute(self.source)
             res.trace_id = ctx.query_id
@@ -218,6 +212,10 @@ class QueryEngine:
                 res = self.exec_logical_plan(plan, planner_params)
             res.stats.parse_s += parse_t
             res.stats.plan_s += plan_t
+            comp = comps.get(i)
+            if comp is not None:
+                res.stats.exprfuse_fused += comp.fused
+                res.stats.exprfuse_degraded += comp.degraded
             results[i] = res
         return results
 
@@ -291,7 +289,20 @@ class QueryEngine:
                     registry.counter("query_partial_results").increment()
                 return data
             return QueryResult([], stats)
+        # whole-expression compilation (query/exprfuse.py): a multi-leaf
+        # tree (joins, multi-shard scatter) batches its leaves' fused
+        # preflights into one merged dispatch; single-leaf trees keep
+        # the leaf's exact standalone path (min_leaves=2)
+        comp = None
+        if self._qconfig().exprfuse_enabled:
+            from filodb_tpu.query import exprfuse
+            comp = exprfuse.compile_tree(ep, self.source, min_leaves=2)
+            if comp is not None:
+                exprfuse.finish_prepared(comp.calls)
         res = ep.execute(self.source)
+        if comp is not None:
+            res.stats.exprfuse_fused += comp.fused
+            res.stats.exprfuse_degraded += comp.degraded
         res.stats.plan_s += plan_t
         res.trace_id = ctx.query_id
         if res.error and res.error.startswith("shard_unavailable") \

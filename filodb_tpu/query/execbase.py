@@ -299,6 +299,22 @@ class AggPartial:
     # cost instead of shipping every candidate series row
     # (ref: QuantileRowAggregator.scala:87 t-digest partials)
     sketch: Optional[np.ndarray] = None
+    # working-set identity of the aggregated KEYS — ("agg", op, by,
+    # without, source token): group keys are a pure function of the
+    # source series set and the grouping, so downstream keys-only caches
+    # (the PR 17 binary-join index maps) can reuse resolved matches
+    # across dashboard re-polls.  Value-level identity is NOT implied
+    # (rate and increase over one working set share a token by design).
+    # Process-local like every cache_token — serialize nulls it.
+    cache_token: Optional[Tuple] = None
+
+
+def agg_token(op: str, by, without,
+              data_token: Optional[Tuple]) -> Optional[Tuple]:
+    """Token for an AggPartial built from a block carrying data_token."""
+    if data_token is None:
+        return None
+    return ("agg", op, tuple(by), tuple(without), data_token)
 
 
 Data = Union[RawBlock, ResultBlock, ScalarResult, AggPartial, None]
@@ -315,16 +331,19 @@ def present_partial(p: AggPartial) -> Optional[ResultBlock]:
         from filodb_tpu.ops import sketch as sketch_ops
         q = float(p.params[0])
         out = sketch_ops.sketch_quantile(p.sketch, q)
-        return ResultBlock(p.group_keys, p.wends, out)
+        return ResultBlock(p.group_keys, p.wends, out,
+                           cache_token=p.cache_token)
     if p.comp is not None:
         if p.op == "hist_sum":
             # [G, W, B+1] with present-series count in the last slot
             buckets = p.comp[..., :-1]
             present_cnt = p.comp[..., -1]
             out = np.where(present_cnt[..., None] > 0, buckets, np.nan)
-            return ResultBlock(p.group_keys, p.wends, out, p.bucket_les)
+            return ResultBlock(p.group_keys, p.wends, out, p.bucket_les,
+                               cache_token=p.cache_token)
         out = np.asarray(agg_ops.present(p.op, jnp.asarray(p.comp)))
-        return ResultBlock(p.group_keys, p.wends, out)
+        return ResultBlock(p.group_keys, p.wends, out,
+                           cache_token=p.cache_token)
     # candidate form
     if p.op in ("topk", "bottomk"):
         k = int(p.params[0])
@@ -410,8 +429,24 @@ def _align_hist_schemes(parts: List[AggPartial]) -> List[AggPartial]:
             for p in parts]
 
 
-def reduce_partials(parts: List[AggPartial]) -> Optional[AggPartial]:
-    """Inter-shard reduce (ReduceAggregateExec): merge partials by group key."""
+def _reduced_token(parts: List[AggPartial]) -> Optional[Tuple]:
+    """Composite identity of a merged partial: the children's tokens in
+    merge order (the merged key order is a pure function of them)."""
+    toks = tuple(p.cache_token for p in parts)
+    return ("red",) + toks if all(t is not None for t in toks) else None
+
+
+def reduce_partials(parts: List[AggPartial],
+                    compress: bool = True) -> Optional[AggPartial]:
+    """Inter-shard reduce (ReduceAggregateExec): merge partials by group key.
+
+    ``compress=False`` is the node-level pushdown mode for quantile
+    sketches: the centroid axes are concatenated (zero-weight padded)
+    but NOT re-compressed, so the coordinator's single
+    ``merge_sketches`` over the node partials sees the same centroid
+    multiset — in the same order, since pushdown groups children
+    contiguously — as a flat per-shard merge would, making quantile
+    pushdown bit-identical to the ship-everything path."""
     parts = [p for p in parts if p is not None]
     if not parts:
         return None
@@ -442,8 +477,10 @@ def reduce_partials(parts: List[AggPartial]) -> Optional[AggPartial]:
             cat[idx, :, off:off + m] = p.sketch
             off += m
         return AggPartial(op, gkeys, wends,
-                          sketch=sketch_ops.merge_sketches(cat),
-                          params=parts[0].params)
+                          sketch=(sketch_ops.merge_sketches(cat)
+                                  if compress else cat),
+                          params=parts[0].params,
+                          cache_token=_reduced_token(parts))
     if parts[0].comp is not None:
         C = parts[0].comp.shape[-1]
         W = parts[0].comp.shape[1]
@@ -459,7 +496,8 @@ def reduce_partials(parts: List[AggPartial]) -> Optional[AggPartial]:
                          "max": np.maximum}[comb]
                 ufunc.at(out[..., i], idx, p.comp[..., i])
         return AggPartial(op, gkeys, wends, comp=out, params=parts[0].params,
-                          bucket_les=parts[0].bucket_les)
+                          bucket_les=parts[0].bucket_les,
+                          cache_token=_reduced_token(parts))
     # candidate form: concat and remap groups
     ck: List[RangeVectorKey] = []
     cv: List[np.ndarray] = []

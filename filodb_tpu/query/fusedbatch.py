@@ -42,6 +42,10 @@ class FusedCall:
     # appends the present-series count (AggPartial op "hist_sum")
     bucket_les: Optional[np.ndarray] = None
     num_buckets: int = 1
+    # keys-identity token for the produced AggPartial (execbase.agg_token
+    # semantics) — rides through _present so kernel-path join operands
+    # hit the exprfuse index-map cache like the host-routed ones
+    cache_token: Optional[tuple] = None
 
     def compat_key(self):
         base = (self.fn, self.precorrected, self.interpret, self.ragged)
@@ -160,7 +164,8 @@ def finish_fused_calls(calls: List[FusedCall]) -> List[AggPartial]:
 
 def _present(fc: FusedCall, comp) -> AggPartial:
     if fc.bucket_les is None:
-        return AggPartial(fc.op, fc.gkeys, fc.wends, comp=comp)
+        return AggPartial(fc.op, fc.gkeys, fc.wends, comp=comp,
+                          cache_token=fc.cache_token)
     # histogram: comp[..., 0] is the per-(group, bucket)-slot sum, masked
     # where the window has no samples — the hist_sum presenter NaNs those
     # windows via the count column anyway, so the mask is invisible
@@ -179,4 +184,4 @@ def _present(fc: FusedCall, comp) -> AggPartial:
         cnt = gsize[:, None] * fc.plan.wvalid[None, :].astype(np.float64)
     hist_comp = np.concatenate([buckets, cnt[..., None]], axis=2)
     return AggPartial("hist_sum", fc.gkeys, fc.wends, comp=hist_comp,
-                      bucket_les=fc.bucket_les)
+                      bucket_les=fc.bucket_les, cache_token=fc.cache_token)

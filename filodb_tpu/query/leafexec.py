@@ -35,10 +35,10 @@ from filodb_tpu.query.execbase import (
     _FUSED_CACHE_LOCK, _FUSED_MINMAX_PAD_CACHE, _FUSED_PLAN_CACHE,
     _FUSED_VALS_CACHE, _block_empty, _group_cache_insert,
     _group_cache_lookup, _lru_touch, _note_mirror_limit,
-    _vals_cache_insert)
+    _vals_cache_insert, agg_token)
 from filodb_tpu.query.transformers import (
     AggregateMapReduce, PeriodicSamplesMapper, RangeVectorTransformer,
-    _group_ids)
+    _group_ids, _group_ids_cached)
 from filodb_tpu.query.fusedbatch import FusedCall, finish_fused_calls
 
 
@@ -258,7 +258,8 @@ class MultiSchemaPartitionsExec(LeafExecPlan):
                     while len(_FUSED_PLAN_CACHE) > 8:
                         _FUSED_PLAN_CACHE.pop(next(iter(_FUSED_PLAN_CACHE)))
         if gkeys is None:
-            gids, gkeys = _group_ids(data.keys, t1.by, t1.without)
+            gids, gkeys = _group_ids_cached(data.cache_token, data.keys,
+                                            t1.by, t1.without)
         self._check_group_limit(gkeys)
         B = vals.shape[2] if is_hist else 1
         num_slots = len(gkeys) * B      # hist: one kernel group per (g, b)
@@ -317,7 +318,9 @@ class MultiSchemaPartitionsExec(LeafExecPlan):
                 plan=plan, values=padded_vals, groups=groups, gkeys=gkeys,
                 wends=wends, fn=fn, op=t1.op,
                 precorrected=data.precorrected, interpret=interpret,
-                ragged=not dense, num_series=vals.shape[0], cache_key=ck)
+                ragged=not dense, num_series=vals.shape[0], cache_key=ck,
+                cache_token=agg_token(t1.op, t1.by, t1.without,
+                                      data.cache_token))
             if defer:
                 return fc
             self._check_cancel("fused kernel dispatch")
@@ -335,7 +338,9 @@ class MultiSchemaPartitionsExec(LeafExecPlan):
             groups=groups, gkeys=gkeys, wends=wends, fn=fn, op="sum",
             precorrected=data.precorrected, interpret=interpret,
             ragged=not dense, num_series=vals.shape[0] * B, cache_key=ck,
-            bucket_les=data.bucket_les, num_buckets=B)
+            bucket_les=data.bucket_les, num_buckets=B,
+            cache_token=agg_token("hist_sum", t1.by, t1.without,
+                                  data.cache_token))
         if defer:
             return fc
         self._check_cancel("fused hist kernel dispatch")
@@ -357,12 +362,33 @@ class MultiSchemaPartitionsExec(LeafExecPlan):
         from filodb_tpu.ops import hostleaf
         from filodb_tpu.ops import pallas_fused as pf
         from filodb_tpu.utils.metrics import registry, span
+        # batch-scoped FINISHED-partial memo: a dashboard repeats whole
+        # subexpressions (sum by (ns)(rate(m[5m])) rides alone AND as a
+        # ratio operand AND under topk), and within one gather-memo
+        # scope an identical (working set, fn, op, grouping, grid) key
+        # means identical inputs — so the evaluation is shared like the
+        # scan.  Inert outside engine.query_range_batch's memo scope.
+        mkey = None
+        if data.cache_token is not None:
+            mkey = ("hpartial", data.cache_token, fn, t1.op,
+                    tuple(t1.by), tuple(t1.without), t0.start_ms,
+                    t0.step_ms, t0.end_ms, t0.offset_ms, t0.window_ms,
+                    data.base_ms)
+            hit = hostleaf.memo_get(mkey)
+            if hit is not None:
+                self._check_group_limit(hit.group_keys)
+                registry.counter("leaf_host_routed").increment()
+                self.route = "host"
+                return dataclasses.replace(hit)
         plan = pf.build_plan(
             np.asarray(data.shared_ts_row, np.int64), eval_wends,
             t0.window_ms)
         if plan.idx1 is None:
             return None
-        gids, gkeys = _group_ids(data.keys, t1.by, t1.without)
+        # token-keyed group cache: the O(S) key.only() loop dominated
+        # repeat host-routed leaves (same working set, new panel)
+        gids, gkeys = _group_ids_cached(data.cache_token, data.keys,
+                                        t1.by, t1.without)
         self._check_group_limit(gkeys)
         with span("leaf_host_routed", fn=fn, op=t1.op):
             comp = hostleaf.host_leaf_agg(
@@ -370,7 +396,12 @@ class MultiSchemaPartitionsExec(LeafExecPlan):
                 len(gkeys), fn, t1.op)
         registry.counter("leaf_host_routed").increment()
         self.route = "host"
-        return AggPartial(t1.op, gkeys, wends, comp=comp)
+        p = AggPartial(t1.op, gkeys, wends, comp=comp,
+                       cache_token=agg_token(t1.op, t1.by, t1.without,
+                                             data.cache_token))
+        if mkey is not None:
+            hostleaf.memo_put(mkey, p)
+        return p
 
     def args_str(self):
         fs = ",".join(str(f) for f in self.filters)
@@ -389,7 +420,8 @@ class MultiSchemaPartitionsExec(LeafExecPlan):
         if eval_wends.size == 0 or abs(eval_wends).max() >= (1 << 30):
             return None
         from filodb_tpu.ops import pallas_fused as pf
-        gids, gkeys = _group_ids(data.keys, t1.by, t1.without)
+        gids, gkeys = _group_ids_cached(data.cache_token, data.keys,
+                                        t1.by, t1.without)
         self._check_group_limit(gkeys)
         n = pf.window_counts(data.shared_ts_row.astype(np.int64),
                              eval_wends, t0.window_ms).astype(np.float64)
@@ -421,7 +453,9 @@ class MultiSchemaPartitionsExec(LeafExecPlan):
                  gsize[:, None] * valid[None, :]], axis=-1)
         from filodb_tpu.utils.metrics import registry
         registry.counter("leaf_fused_count_host").increment()
-        return AggPartial(op, gkeys, wends, comp=comp)
+        return AggPartial(op, gkeys, wends, comp=comp,
+                          cache_token=agg_token(op, t1.by, t1.without,
+                                                data.cache_token))
 
     def _fused_count_agg(self, data, t0, t1):
         """count by (fn(...)) on a dense shared grid: the count of series
@@ -436,7 +470,9 @@ class MultiSchemaPartitionsExec(LeafExecPlan):
         from filodb_tpu.utils.metrics import registry
         registry.counter("leaf_fused_count_host").increment()
         comp = (gsize[:, None] * valid[None, :])[..., None]
-        return AggPartial("count", gkeys, wends, comp=comp)
+        return AggPartial("count", gkeys, wends, comp=comp,
+                          cache_token=agg_token("count", t1.by, t1.without,
+                                                data.cache_token))
 
     def _fused_minmax(self, data, t0, t1, wends, eval_wends):
         """min/max_over_time + any aggregate in one jit via the XLA
@@ -507,7 +543,9 @@ class MultiSchemaPartitionsExec(LeafExecPlan):
         from filodb_tpu.utils.metrics import registry
         registry.counter("leaf_fused_minmax").increment()
         return AggPartial(t1.op, gkeys, wends,
-                          comp=np.asarray(comp, np.float64))
+                          comp=np.asarray(comp, np.float64),
+                          cache_token=agg_token(t1.op, t1.by, t1.without,
+                                                data.cache_token))
 
     def _check_group_limit(self, gkeys) -> None:
         limit = self.ctx.planner_params.group_by_cardinality_limit
@@ -745,18 +783,53 @@ class MultiSchemaPartitionsExec(LeafExecPlan):
             # seqlock-tear exposure under live ingest (the r4 soak's 9x
             # under-ingest degradation was full-row gathers being torn
             # and retried against continuous appends)
-            ts, cols, counts = shard.snapshot_read(
-                store, lambda: store.gather_rows(rows, self.chunk_start_ms,
-                                                 self.chunk_end_ms))
-            base = self.chunk_start_ms
-            ts_off = to_offsets(ts, counts, base)
-            # correct (f64) + rebase so counter deltas stay exact on chip
+            # batch gather memo (PR 17, ops/hostleaf.py): under a
+            # query_range_batch prepare scope, N panels over one working
+            # set share ONE windowed scan AND its post-processing — the
+            # offset grid, the counter-corrected/rebased value matrix,
+            # and the density verdict are all pure functions of the key
+            # (exact row set, span, column, correction mode, keys
+            # epoch), and every downstream consumer treats the arrays
+            # as immutable.  Memoizing only the raw gather was measured
+            # to leave ~80% of a repeat leaf's cost on the table —
+            # host_counter_correct + to_offsets dominate the scan.
+            from filodb_tpu.ops import hostleaf as _hostleaf
             precorrected = counter_col and fn_is_counter
-            vals, vbase = counter_ops.rebase_values(cols[col_name],
-                                                    precorrected)
-            # NaN anywhere (staleness markers or ragged-length padding)
-            # routes the rate family onto its valid-boundary variant
-            dense = not bool(np.isnan(vals).any())
+            base = self.chunk_start_ms
+            _memo_key = (shard.keys_serial, shard.keys_epoch, self.dataset,
+                         self.shard, self.chunk_start_ms, self.chunk_end_ms,
+                         col_name, precorrected, rows.tobytes())
+            _hit = _hostleaf.memo_get(_memo_key)
+            if _hit is not None:
+                ts_off, vals, vbase, counts, dense = _hit
+            else:
+                # raw-gather sub-memo: panels that share the span but
+                # differ in column/correction mode (e.g. a gauge window
+                # next to a counter rate) still share the scan itself
+                _raw_key = ("raw",) + _memo_key[:6] + (rows.tobytes(),)
+                _raw = _hostleaf.memo_get(_raw_key)
+                if _raw is not None:
+                    ts, cols, counts = _raw
+                    ts_off = _hostleaf.memo_get(("off",) + _raw_key[1:])
+                else:
+                    ts, cols, counts = shard.snapshot_read(
+                        store, lambda: store.gather_rows(
+                            rows, self.chunk_start_ms, self.chunk_end_ms))
+                    _hostleaf.memo_put(_raw_key, (ts, cols, counts))
+                    ts_off = None
+                if ts_off is None:
+                    ts_off = to_offsets(ts, counts, base)
+                    _hostleaf.memo_put(("off",) + _raw_key[1:], ts_off)
+                # correct (f64) + rebase so counter deltas stay exact on
+                # chip
+                vals, vbase = counter_ops.rebase_values(cols[col_name],
+                                                        precorrected)
+                # NaN anywhere (staleness markers or ragged-length
+                # padding) routes the rate family onto its
+                # valid-boundary variant
+                dense = not bool(np.isnan(vals).any())
+                _hostleaf.memo_put(_memo_key,
+                                   (ts_off, vals, vbase, counts, dense))
         keys = LazyKeys(shard, pids)
         stats.series_scanned = int(pids.size)
         stats.samples_scanned = int(counts.sum())

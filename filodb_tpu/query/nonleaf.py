@@ -169,6 +169,12 @@ class ReduceAggregateExec(NonLeafExecPlan):
     # aggregate — the dedup contract matters most on this plan
     dedup_shard_children = True
 
+    # node-level reduce (RemoteAggregateExec): the composed partial is an
+    # INTERMEDIATE that another reduce will merge — quantile sketches must
+    # not re-compress here (reduce_partials compress=False) and candidate
+    # partials may prune to the node-local top-k before crossing the wire
+    node_level = False
+
     def __init__(self, ctx, children, op: str, params: Tuple = (),
                  by: Tuple[str, ...] = (), without: Tuple[str, ...] = ()):
         super().__init__(ctx, children)
@@ -194,7 +200,7 @@ class ReduceAggregateExec(NonLeafExecPlan):
                 r = mapper.apply(r, self.ctx, stats)
             if isinstance(r, AggPartial):
                 parts.append(r)
-        return reduce_partials(parts)
+        return reduce_partials(parts, compress=not self.node_level)
 
     def child_stream_fold(self, child):
         if self.op not in _FOLDABLE_OPS:
@@ -223,7 +229,35 @@ class RemoteAggregateExec(ReduceAggregateExec):
     [G, W] AggPartial.  Decoded on the data node the children fall back
     to InProcessPlanDispatcher, so execution there is the ordinary
     scatter-gather one level down (the PR-6 chip-level partial merge,
-    promoted to nodes)."""
+    promoted to nodes).
+
+    Rank/sketch aggregations push exactly (PR 17): quantile node
+    partials concatenate their shards' centroids WITHOUT re-compressing
+    (node_level -> reduce_partials compress=False), so the
+    coordinator's single merge sees the flat per-shard centroid layout;
+    topk/bottomk node partials prune to the per-window node-local
+    top-k before replying (ops/select.topk_keep_rows) — rows outside
+    every window's local top-k cannot reach any global top-k, the same
+    containment the streaming fold relies on."""
+
+    node_level = True
+
+    def compose(self, results, stats):
+        part = super().compose(results, stats)
+        if part is not None and part.cand_vals is not None \
+                and self.op in ("topk", "bottomk") and len(part.cand_vals):
+            from filodb_tpu.ops import select as select_ops
+            keep = np.asarray(select_ops.topk_keep_rows(
+                jnp.asarray(part.cand_vals), jnp.asarray(part.cand_groups),
+                len(part.group_keys), int(self.params[0]),
+                largest=(self.op == "topk")))
+            if not keep.all():
+                part = dataclasses.replace(
+                    part,
+                    cand_keys=[k for k, m in zip(part.cand_keys, keep) if m],
+                    cand_vals=part.cand_vals[keep],
+                    cand_groups=part.cand_groups[keep])
+        return part
 
     def args_str(self):
         shards = sorted(getattr(c, "shard", -1) for c in self._children)
@@ -275,45 +309,22 @@ class BinaryJoinExec(NonLeafExecPlan):
         if self.cardinality == "OneToMany":
             many_side, one_side = rhs, lhs
             flip = True
-        # index the "one" side by match key; duplicates are an error
-        one_index: Dict[RangeVectorKey, int] = {}
-        for i, k in enumerate(one_side.keys):
-            mk = self._match_key(k)
-            if mk in one_index:
-                raise ValueError(
-                    "many-to-many matching not allowed: duplicate series on "
-                    f"'one' side for key {mk}")
-            one_index[mk] = i
-        card_limit = self.ctx.planner_params.join_cardinality_limit
-        pairs: List[Tuple[int, int]] = []
-        for i, k in enumerate(many_side.keys):
-            j = one_index.get(self._match_key(k))
-            if j is not None:
-                pairs.append((i, j))
-                if len(pairs) > card_limit:
-                    raise ValueError(f"join cardinality limit {card_limit} exceeded")
-        if self.cardinality == "OneToOne":
-            seen: Dict[int, int] = {}
-            for i, j in pairs:
-                if j in seen:
-                    raise ValueError("one-to-one join has many-to-one matches; "
-                                     "use group_left/group_right")
-                seen[j] = i
-        if not pairs:
+        # label matching resolves host-side ONCE into (mi, oi) index
+        # maps, memoized on the operand blocks' cache_token (PR 17 —
+        # query/exprfuse.py); the join itself is one jitted
+        # gather+binop program over the full value blocks
+        from filodb_tpu.query.exprfuse import join_index_maps
+        from filodb_tpu.ops.select import gather_binop
+        mi, oi, keys = join_index_maps(self, many_side, one_side)
+        if not len(mi):
             return None
-        mi = np.asarray([p[0] for p in pairs])
-        oi = np.asarray([p[1] for p in pairs])
-        mv = np.asarray(many_side.values)[mi]
-        ov = np.asarray(one_side.values)[oi]
-        a, b = (ov, mv) if flip else (mv, ov)   # a = query LHS values
-        out = np.asarray(apply_binary_op(
-            jnp.asarray(a), jnp.asarray(b), op=self.operator,
+        mv = jnp.asarray(np.asarray(many_side.values))
+        ov = jnp.asarray(np.asarray(one_side.values))
+        # a = query LHS values
+        a, b, ai, bi = (ov, mv, oi, mi) if flip else (mv, ov, mi, oi)
+        out = np.asarray(gather_binop(
+            a, b, jnp.asarray(ai), jnp.asarray(bi), op=self.operator,
             bool_modifier=self.bool_modifier, keep_side="lhs"))
-        keys = []
-        for i, j in pairs:
-            mk = many_side.keys[i]
-            lbls = self._result_labels(mk, one_side.keys[j])
-            keys.append(lbls)
         return ResultBlock(keys, many_side.wends, out)
 
     def _result_labels(self, many_key: RangeVectorKey,

@@ -278,7 +278,10 @@ class PersistedClusterPlanner(SingleClusterPlanner):
             ctx, self.dataset, s, p.filters,
             p.range_selector.from_ms, p.range_selector.to_ms, self.tier,
             columns=p.columns) for s in shards]
-        return plans
+        # same owner routing as the memstore leaves: a cluster-mode
+        # persisted planner dispatches cold leaves to the shard's node
+        # (and the PR-15 pushdown can then group them per node)
+        return self._with_dispatcher(plans, shards)
 
 
 # ------------------------------------------------------------ HA routing

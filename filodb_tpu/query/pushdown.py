@@ -20,12 +20,14 @@ partial per NODE, merged coordinator-side by the unchanged
 
 Correctness rules:
 
-  - Only EXACTLY-mergeable partial forms push (PUSHABLE_OPS): the
+  - Only EXACTLY-mergeable partial forms push.  PUSHABLE_OPS are the
     component-form aggregators whose reduce is an order-insensitive
-    elementwise sum/min/max.  `topk`/`bottomk`/`count_values` ship
-    per-series candidate rows (no wire win, per-series output) and
-    `quantile`'s sketch re-compression is merge-tree-dependent — both
-    keep today's per-shard path, as do joins and raw selectors.
+    elementwise sum/min/max.  CANDIDATE_PUSHABLE_OPS (PR 17) push via
+    the node-level intermediate mode (nonleaf.RemoteAggregateExec
+    docstring): `quantile` concatenates centroids without
+    re-compressing, `topk`/`bottomk` prune candidates to the node-
+    local per-window top-k, `count_values` ships candidate rows.
+    Joins and raw selectors keep the per-shard path.
   - A shard listed TWICE (both owners during a live-handoff window)
     never enters a node group: the duplicate leaves stay direct
     children of the coordinator reducer so the PR-11 gather dedup
@@ -52,6 +54,16 @@ from filodb_tpu.query.execbase import (InProcessPlanDispatcher,
 # (histogram sum rides op="sum" and merges bucketwise the same way)
 PUSHABLE_OPS = frozenset({"sum", "count", "avg", "min", "max",
                           "stddev", "stdvar", "group"})
+
+# rank/candidate/sketch aggregations made exactly-pushable by PR 17
+# (query/nonleaf.py RemoteAggregateExec.node_level): quantile node
+# partials concatenate centroids without re-compressing, topk/bottomk
+# prune to the node-local per-window top-k (ops/select.topk_keep_rows),
+# count_values ships its candidate rows — in every case the
+# coordinator's final merge sees data bit-identical to the flat
+# per-shard path
+CANDIDATE_PUSHABLE_OPS = frozenset({"topk", "bottomk", "quantile",
+                                    "count_values"})
 
 
 def pushdown_enabled(ctx) -> bool:
@@ -145,7 +157,7 @@ def plan_aggregate_pushdown(children: List, op: str, params: Tuple,
         return children, 0
     if not pushdown_enabled(ctx):
         return children, 0
-    if op not in PUSHABLE_OPS:
+    if op not in PUSHABLE_OPS and op not in CANDIDATE_PUSHABLE_OPS:
         _count_not_pushable(n_remote)
         return children, n_remote
     # duplicate shards (both owners materialized during a live handoff)

@@ -82,9 +82,15 @@ def concat_blocks(blocks: Sequence[ResultBlock]) -> Optional[ResultBlock]:
     keys: List[RangeVectorKey] = []
     for b in blocks:
         keys.extend(b.keys)
+    # the concatenation's identity is the ordered tuple of part tokens —
+    # valid (keys are the parts' keys, in order) iff every part carries
+    # one; used by the PR 17 join index-map cache
+    token = None
+    if all(b.cache_token is not None for b in blocks):
+        token = ("cat",) + tuple(b.cache_token for b in blocks)
     return ResultBlock(keys, blocks[0].wends,
                        np.concatenate([np.asarray(b.values) for b in blocks]),
-                       blocks[0].bucket_les)
+                       blocks[0].bucket_les, cache_token=token)
 
 
 @dataclasses.dataclass
@@ -151,6 +157,12 @@ class QueryStats:
     # "hot" (all in memory) | "cold_hit" (served from the resident cold
     # region) | "cold_paged" (paid a page-in).  merge keeps the WORST.
     cold_tier: str = ""
+    # --- whole-expression compilation (PR 17, query/exprfuse.py) ---
+    # per-leaf verdicts when the expression compiler engaged: leaves
+    # whose work joined a fused/batched dispatch vs leaves that
+    # degraded to the general path (both zero = compiler not engaged)
+    exprfuse_fused: int = 0
+    exprfuse_degraded: int = 0
 
     _COLD_ORDER = ("", "hot", "cold_hit", "cold_paged")
 
@@ -183,6 +195,8 @@ class QueryStats:
         if self._COLD_ORDER.index(other.cold_tier) > \
                 self._COLD_ORDER.index(self.cold_tier):
             self.cold_tier = other.cold_tier
+        self.exprfuse_fused += other.exprfuse_fused
+        self.exprfuse_degraded += other.exprfuse_degraded
 
     def to_dict(self) -> Dict[str, object]:
         """The `?stats=true` wire shape (http/routes attaches it to the
@@ -212,6 +226,10 @@ class QueryStats:
                 "pushed": self.pushdown_pushed,
                 "fallback": self.pushdown_fallback,
                 "notPushable": self.pushdown_not_pushable,
+            },
+            "exprfuse": {
+                "fused": self.exprfuse_fused,
+                "degraded": self.exprfuse_degraded,
             },
             "cache": {
                 "result": self.result_cache,

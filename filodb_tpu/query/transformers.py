@@ -32,7 +32,7 @@ from filodb_tpu.query.rangevector import (QueryContext, QueryResult, QueryStats,
 
 from filodb_tpu.query.execbase import (
     AggPartial, Data, GroupCardinalityError, RawBlock, ScalarResult,
-    _block_empty, _lru_touch, present_partial)
+    _block_empty, _lru_touch, agg_token, present_partial)
 
 
 # ------------------------------------------------------------- transformers
@@ -351,15 +351,25 @@ def _group_ids_cached(token, keys, by, without):
         # reclaimed pid may have been recycled for a different series.
         # Strictly older only: an in-flight query holding a pre-prune
         # token must not evict valid newer-epoch entries, nor install
-        # its own never-hittable stale one.
-        for old in [o for o in _HOST_GROUP_CACHE
-                    if o[0][0] == token[0] and o[0][1] < token[1]]:
-            del _HOST_GROUP_CACHE[old]
-        if not any(o[0][0] == token[0] and o[0][1] > token[1]
+        # its own never-hittable stale one.  Only LEAF tokens carry the
+        # (serial, epoch:int, ...) shape this compares; derived tokens
+        # (execbase.agg_token / _reduced_token) embed the leaf epoch
+        # inside themselves — a prune mints a NEW token, and the stale
+        # entry ages out through the LRU cap instead.
+        if len(token) > 1 and isinstance(token[1], int):
+            def _epoch(o):
+                t = o[0]
+                return (t[1] if t[0] == token[0] and len(t) > 1
+                        and isinstance(t[1], int) else None)
+            for old in [o for o in _HOST_GROUP_CACHE
+                        if _epoch(o) is not None and _epoch(o) < token[1]]:
+                del _HOST_GROUP_CACHE[old]
+            if any(_epoch(o) is not None and _epoch(o) > token[1]
                    for o in _HOST_GROUP_CACHE):
-            _HOST_GROUP_CACHE[k] = (gids, gkeys)
-            while len(_HOST_GROUP_CACHE) > 8:
-                _HOST_GROUP_CACHE.pop(next(iter(_HOST_GROUP_CACHE)))
+                return gids, gkeys
+        _HOST_GROUP_CACHE[k] = (gids, gkeys)
+        while len(_HOST_GROUP_CACHE) > 8:
+            _HOST_GROUP_CACHE.pop(next(iter(_HOST_GROUP_CACHE)))
     return gids, gkeys
 
 
@@ -400,12 +410,18 @@ class AggregateMapReduce(RangeVectorTransformer):
             np.add.at(agg[..., :B], gids, comp)     # view write-through
             np.add.at(agg[..., B], gids, present.any(axis=2).astype(float))
             return AggPartial("hist_sum", gkeys, data.wends, comp=agg,
-                              params=self.params, bucket_les=data.bucket_les)
+                              params=self.params, bucket_les=data.bucket_les,
+                              cache_token=agg_token(
+                                  "hist_sum", self.by, self.without,
+                                  data.cache_token))
         if self.op == "quantile" and vals.ndim == 2:
             from filodb_tpu.ops import sketch as sketch_ops
             sk = sketch_ops.sketch_from_values(vals, gids, len(gkeys))
             return AggPartial(self.op, gkeys, data.wends, sketch=sk,
-                              params=self.params)
+                              params=self.params,
+                              cache_token=agg_token(
+                                  self.op, self.by, self.without,
+                                  data.cache_token))
         if self.op in _CANDIDATE_OPS or self.op == "quantile":
             cand_keys, cand_vals, cand_groups = self._candidates(
                 data, vals, gids, len(gkeys))
@@ -415,7 +431,10 @@ class AggregateMapReduce(RangeVectorTransformer):
         comp = np.asarray(agg_ops.map_phase(
             self.op, jnp.asarray(vals), jnp.asarray(gids), len(gkeys)))
         return AggPartial(self.op, gkeys, data.wends, comp=comp,
-                          params=self.params)
+                          params=self.params,
+                          cache_token=agg_token(self.op, self.by,
+                                                self.without,
+                                                data.cache_token))
 
     def _candidates(self, data, vals, gids, num_groups):
         if self.op in ("topk", "bottomk"):

@@ -116,10 +116,13 @@ def test_pushdown_ragged_identical(cluster):
     assert _as_map(on) == _as_map(off) == _as_map(_range(truth, q))
 
 
-def test_non_pushable_ops_keep_per_shard_path(cluster):
+def test_non_pushable_shapes_keep_per_shard_path(cluster):
     c, _ = cluster
-    assert "topk" not in PUSHABLE_OPS and "quantile" not in PUSHABLE_OPS
-    res = _range(c.engine, 'topk(3, heap_usage)')
+    # ship-raw children carry no map-phase transformer, which breaks the
+    # pushable transformer chain — the aggregation stays on the
+    # per-shard path even with pushdown enabled
+    res = _range(c.engine, 'sum by (_ns_)(heap_usage)',
+                 aggregation_pushdown=True, ship_raw_series=True)
     assert res.error is None
     assert res.stats.pushdown_pushed == 0
     assert res.stats.pushdown_not_pushable >= 8     # one per remote shard
@@ -127,6 +130,30 @@ def test_non_pushable_ops_keep_per_shard_path(cluster):
     d = res.stats.to_dict()
     assert d["pushdown"]["notPushable"] >= 8
     assert d["wireBytes"] > 0
+
+
+@pytest.mark.parametrize("q", [
+    'topk(3, heap_usage)',
+    'bottomk(2, heap_usage)',
+    'quantile(0.9, heap_usage)',
+    'quantile by (_ns_)(0.5, int_gauge)',
+    'count_values("v", int_gauge)',
+])
+def test_rank_aggregations_push_bit_identical(cluster, q):
+    """PR 17: topk/bottomk/quantile/count_values report `pushed` (not
+    `notPushable`) and stay bit-identical to the ship-everything path
+    and the single-store truth engine."""
+    c, truth = cluster
+    on = _range(c.engine, q, aggregation_pushdown=True)
+    off = _range(c.engine, q, aggregation_pushdown=False)
+    want = _range(truth, q)
+    assert on.error is None and off.error is None and want.error is None
+    assert on.num_series > 0
+    assert on.stats.pushdown_pushed >= 2
+    assert on.stats.pushdown_not_pushable == 0
+    assert off.stats.pushdown_pushed == 0
+    assert _as_map(on) == _as_map(off)
+    assert _as_map(on) == _as_map(want)
 
 
 def test_pushdown_stats_and_wire_attribution(cluster):

@@ -461,8 +461,11 @@ def test_selfmon_alert_fires_through_frontend_end_to_end():
     """The acceptance e2e: an induced job error streak -> self-scraped
     `job_consecutive_errors` series -> ruler alert group evaluated
     through the ORDINARY frontend path -> firing at /api/v1/alerts."""
+    # interval doubles as the per-eval deadline (ruler._planner_params);
+    # 1 s sits at the edge of a cold-jit eval under a loaded suite, and
+    # the deadline is not what this test verifies
     groups = {"self_monitoring": {
-        "interval": 1,
+        "interval": 10,
         "rules": {"job_err": {
             "alert": "BackgroundJobFailing",
             "expr": 'max by (exported_job) '

@@ -63,6 +63,15 @@ of every headline metric is greppable in one file:
     memory under a fixed budget the materialize-everything baseline
     exceeds), ``distexec_pushdown_speedup_x`` — plus a loud
     ``distexec_error`` when the stage fails.
+  - the whole-expression compilation numbers (PR 17):
+    ``exprfuse_speedup_x`` (gate: the 8-panel mixed dashboard —
+    aggregated rates, a ratio and a comparison binary op, increase,
+    topk — compiled as ONE fused batch runs >= 5x faster than
+    per-node assembly, results BIT-identical per
+    ``exprfuse_identical``), ``exprfuse_fused`` / ``exprfuse_degraded``
+    verdict counts (gate: 0 degraded on the eligible mix) and
+    ``exprfuse_memo_hits`` (the shared per-shard gather memo doing the
+    work) — plus a loud ``exprfuse_error`` when the stage fails.
 
 Existing hand-written round entries are MERGED, never clobbered: only
 missing keys are added, so curated notes survive re-runs.
@@ -160,6 +169,14 @@ CARRY = [
     "index_regex_plan_max_ms", "index_regex_memo_p50_ms",
     "index_trigram_build_ms", "index_churn_rss_growth_pct",
     "index_memory_bytes", "index_gate_ok", "index_error",
+    # whole-expression compilation (ISSUE 17): the 8-panel dashboard's
+    # fused-batch p50 vs per-node-assembly baseline (gate: >= 5x,
+    # results BIT-identical), the fused/degraded verdict counts (gate:
+    # 0 degraded on the eligible panel mix) and the batch gather-memo
+    # hit count — plus a loud exprfuse_error when the stage fails
+    "exprfuse_p50_s", "exprfuse_baseline_p50_s", "exprfuse_speedup_x",
+    "exprfuse_identical", "exprfuse_fused", "exprfuse_degraded",
+    "exprfuse_memo_hits", "exprfuse_gate_ok", "exprfuse_error",
 ]
 RENAME = {"value": "headline_samples_per_sec",
           "p50_query_latency_s": "p50_s"}
