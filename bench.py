@@ -1276,6 +1276,249 @@ def measure_observability(quick=False, series=None):
     return st
 
 
+def measure_devicetelem(quick=False, series=None, iters=0):
+    """ISSUE-18 acceptance: the per-chip device telemetry subsystem
+    (utils/devicetelem.py) measured three ways:
+
+      devicetelem_overhead_pct — the kernel ledger's tax on a concurrent
+        8-thread ENGINE workload (every poll dispatches real kernels —
+        a frontend cache-hit pump would never touch the ledger), telem
+        on vs off in interleaved pairs, medians; gate <= 2%.
+      devicetelem_fused_overhead_pct — the same tax on the flagship
+        single-thread fused scan p50; gate <= 2%.
+      the compile-storm drill — 12 distinct shapes through watched_call
+        under one trace id: every compile must land in the ledger with
+        shape + origin, fill jit_compile_seconds{kernel}, and flip the
+        health `device` subsystem to degraded while sustained.
+      devicetelem_mesh_reconciled (>= 2 devices only; the standalone
+        `bench.py devicetelem` entry forces 8 virtual host devices) —
+        per-device ledger mesh_fused counts reconcile 1:1 with
+        mesh_fused_perdevice_dispatches and every mesh chip appears in
+        the /admin/devices table.
+
+    A parity check (the ?stats=true per-device split sums to the
+    device_s phase) must hold before any overhead number is credited —
+    a run whose overhead is low because attribution silently broke must
+    not pass."""
+    import threading
+
+    import jax
+
+    from filodb_tpu.utils import devicetelem as dt
+    from filodb_tpu.utils.health import DEGRADED, HealthEvaluator
+    from filodb_tpu.utils.metrics import registry, trace_context
+
+    # flagship scale: the ledger's tax is a fixed few-tens-of-us per
+    # dispatch, so the honest denominator is the flagship fused scan's
+    # real query time, not a toy store whose 3 ms queries inflate the
+    # same microseconds into a fake 2%
+    S = series or (16_384 if quick else 65_536)
+    T = 120
+    fe, eng, q, start_s, end_s, pp = _frontend_fixture(
+        S, T, "bench_devtelem")
+    r = eng.query_range(q, start_s, 60, end_s, pp)   # cold: real kernels
+    if r.error:
+        return {"series": S, "error": r.error[:200]}
+    st = {"series": S}
+    d = r.stats.to_dict()
+    split = sum(k["seconds"] for dev in d["devices"].values()
+                for k in dev.values())
+    dev_s = d["phases"]["device_s"]
+    st["devicetelem_parity_ok"] = bool(
+        abs(split - dev_s) <= max(1e-4, 0.02 * dev_s)
+        and (dev_s == 0 or d["devices"]))
+
+    # --- tax on the concurrent engine workload, telem on vs off ---
+    dur_s = 1.5 if quick else 3.0
+    errors = []
+
+    def pump():
+        counts = []
+        stop_t = time.perf_counter() + dur_s
+
+        def client():
+            n = 0
+            while time.perf_counter() < stop_t:
+                res = eng.query_range(q, start_s, 60, end_s, pp)
+                if res.error is not None:
+                    # surface, don't swallow: a thread dying silently
+                    # would ship a passing-looking overhead number
+                    errors.append(res.error)
+                    break
+                n += 1
+            counts.append(n)
+
+        threads = [threading.Thread(target=client) for _ in range(8)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        return sum(counts) / max(time.perf_counter() - t0, 1e-9)
+
+    on, off = [], []
+    try:
+        pump()                       # discarded: thread/alloc warmup
+        # alternate which arm goes first per pair — CPU frequency ramp
+        # and cache warmup drift monotonically across the run, and a
+        # fixed on-first order books all of that drift against the
+        # ledger.  BEST-of-N per arm (timeit methodology): co-tenant
+        # interference only ever subtracts throughput, so the max over
+        # attempts compares the two arms on the clean machine instead
+        # of on whichever arm a noise spike happened to land on.
+        for i in range(4 if quick else 5):
+            first_on = (i % 2 == 0)
+            dt.set_enabled(first_on)
+            (on if first_on else off).append(pump())
+            dt.set_enabled(not first_on)
+            (off if first_on else on).append(pump())
+    finally:
+        dt.set_enabled(True)
+    if errors:
+        st["error"] = f"pump: {errors[0]}"[:200]
+        st["pump_errors"] = len(errors)
+        return st
+    st["devicetelem_qps_on"] = round(max(on), 1)
+    st["devicetelem_qps_off"] = round(max(off), 1)
+    st["devicetelem_overhead_pct"] = round(
+        100.0 * (st["devicetelem_qps_off"] - st["devicetelem_qps_on"])
+        / max(st["devicetelem_qps_off"], 1e-9), 2)
+
+    # --- tax on the flagship single-thread fused scan ---
+    # query-level PAIRED comparison: adjacent queries (ms apart) see
+    # near-identical machine state, so per-pair relative deltas cancel
+    # the drift that swamps independent p50s; the 20%-trimmed mean
+    # drops GC/interrupt outliers without the median's tiny-sample
+    # noise.  Order within a pair alternates so toggling cost (if any)
+    # can't book against one arm.
+    n_pairs = iters or (50 if quick else 40)
+    diffs, on_ts, off_ts = [], [], []
+
+    def one():
+        t0 = time.perf_counter()
+        res = eng.query_range(q, start_s, 60, end_s, pp)
+        assert res.error is None, res.error
+        return time.perf_counter() - t0
+
+    try:
+        for _ in range(3):                      # discarded warmup
+            eng.query_range(q, start_s, 60, end_s, pp)
+        for i in range(n_pairs):
+            first_on = (i % 2 == 0)
+            dt.set_enabled(first_on)
+            a = one()
+            dt.set_enabled(not first_on)
+            b = one()
+            on_t, off_t = (a, b) if first_on else (b, a)
+            on_ts.append(on_t)
+            off_ts.append(off_t)
+            diffs.append((on_t - off_t) / off_t)
+    finally:
+        dt.set_enabled(True)
+    on_ts.sort(); off_ts.sort(); diffs.sort()
+    k = n_pairs // 5
+    core = diffs[k:n_pairs - k]
+    st["devicetelem_fused_p50_on_s"] = round(on_ts[n_pairs // 2], 5)
+    st["devicetelem_fused_p50_off_s"] = round(off_ts[n_pairs // 2], 5)
+    st["devicetelem_fused_overhead_pct"] = round(
+        100.0 * sum(core) / max(len(core), 1), 2)
+
+    # --- the compile-storm drill: attributable and health-visible ---
+    import jax.numpy as jnp
+    storm_fn = jax.jit(lambda x: (x * 2.0).sum())
+    origin = "benchstorm" + "0" * 22
+    n_storm = 12
+    c0 = registry.counter("jit_compile_events", fn="bench_storm").value
+    with trace_context(origin):
+        for i in range(n_storm):
+            x = jnp.zeros((i + 31,))
+            dt.watched_call("bench_storm", storm_fn, f"S{i + 31}",
+                            lambda x=x: storm_fn(x))
+    compiled = int(registry.counter("jit_compile_events",
+                                    fn="bench_storm").value - c0)
+    st["devicetelem_storm_compiles"] = compiled
+    mine = [e for e in dt.telem.recent(limit=200, kind="compile")
+            if e["kernel"] == "bench_storm"]
+    st["devicetelem_storm_attributed"] = bool(
+        len(mine) >= n_storm
+        and all(e["origin"] == origin and e["shape"] for e in mine))
+    hist_count = 0
+    for name, tags, value in registry.snapshot_samples():
+        if name == "jit_compile_seconds_count" \
+                and ("kernel", "bench_storm") in tags:
+            hist_count = int(value)
+    st["devicetelem_storm_hist_count"] = hist_count
+    dv = HealthEvaluator().evaluate()["subsystems"]["device"]
+    st["devicetelem_storm_health_degraded"] = bool(
+        dv["status"] == DEGRADED and "compile_storm" in dv["reasons"])
+
+    # --- per-chip placement reconcile (multi-device boxes only) ---
+    n_dev = jax.local_device_count()
+    st["devicetelem_devices"] = n_dev
+    if n_dev >= 2:
+        from filodb_tpu.core.index import Equals
+        from filodb_tpu.ops.timewindow import make_window_ends
+        from filodb_tpu.parallel.mesh import MeshExecutor, make_mesh
+        n_time = 2 if n_dev % 2 == 0 and n_dev >= 4 else 1
+        n_shard = n_dev // n_time
+        total = 512 - (512 % n_shard)
+        ms, START = _multichip_store("bench_devtelem_mesh", total, T,
+                                     n_shard)
+        mesh = make_mesh(n_shard, n_time, devices=jax.devices()[:n_dev])
+        ex = MeshExecutor(ms, "bench_devtelem_mesh", mesh)
+        end_ms = START + (T - 1) * 10_000
+        packed = ex.lookup_and_pack(
+            [Equals("_metric_", "request_total")], START, end_ms,
+            by=("_ns_",), fn_name="rate")
+        wends = make_window_ends(START + 600_000, end_ms, 60_000)
+
+        def counts_by_dev():
+            snap = dt.telem.snapshot(recent=0)
+            return {dev: row["kernels"].get("mesh_fused",
+                                            {}).get("count", 0)
+                    for dev, row in snap["devices"].items()}
+
+        before = counts_by_dev()
+        pc0 = registry.counter("mesh_fused_perdevice_dispatches").value
+        # the reconcile needs the PER-DEVICE kernel branch, which the
+        # host-platform router diverts to ops/hostleaf (one host pass,
+        # no per-chip dispatches) — interpret-mode Pallas restores the
+        # real dispatch topology at this deliberately tiny scale
+        had_interp = os.environ.get("FILODB_TPU_FUSED_INTERPRET")
+        os.environ["FILODB_TPU_FUSED_INTERPRET"] = "1"
+        try:
+            for _ in range(3):
+                ex.run_agg(packed, wends, range_ms=300_000,
+                           fn_name="rate", agg_op="sum")
+        finally:
+            if had_interp is None:
+                os.environ.pop("FILODB_TPU_FUSED_INTERPRET", None)
+            else:
+                os.environ["FILODB_TPU_FUSED_INTERPRET"] = had_interp
+        pc_delta = int(registry.counter(
+            "mesh_fused_perdevice_dispatches").value - pc0)
+        after = counts_by_dev()
+        deltas = {dev: after.get(dev, 0) - before.get(dev, 0)
+                  for dev in after}
+        touched = {dev for dev, v in deltas.items() if v > 0}
+        st["devicetelem_mesh_perdevice_dispatches"] = pc_delta
+        st["devicetelem_mesh_devices_touched"] = len(touched)
+        st["devicetelem_mesh_reconciled"] = bool(
+            pc_delta > 0 and sum(deltas.values()) == pc_delta
+            and len(touched) >= 2)
+
+    st["devicetelem_gate_ok"] = bool(
+        st["devicetelem_overhead_pct"] <= 2.0
+        and st["devicetelem_fused_overhead_pct"] <= 2.0
+        and st["devicetelem_parity_ok"]
+        and compiled >= 10
+        and st["devicetelem_storm_attributed"]
+        and st["devicetelem_storm_hist_count"] >= 10
+        and st["devicetelem_storm_health_degraded"]
+        and st.get("devicetelem_mesh_reconciled", True))
+    return st
+
+
 def measure_activequeries(quick=False, series=None):
     """ISSUE-13 acceptance: live query introspection.
 
@@ -3453,7 +3696,7 @@ def parse_args(argv=None):
                     choices=["", "chaos", "multichip", "wal", "longrange",
                              "selfmon", "replication", "ingesttrace",
                              "activequeries", "qos", "distexec", "index",
-                             "exprfuse"],
+                             "exprfuse", "devicetelem"],
                     help="optional standalone stage: 'chaos' runs the "
                          "failure-domain chaos harness (SIGKILL one of "
                          "three RF-2 data nodes mid-traffic; gates "
@@ -3509,7 +3752,15 @@ def parse_args(argv=None):
                          "binary ops over a 1M-series store, compiled "
                          "batch vs per-node assembly; gates >= 5x p50 "
                          "and bit-identical results) and exits nonzero "
-                         "on a gate failure")
+                         "on a gate failure; 'devicetelem' runs the "
+                         "device-telemetry stage on 8 virtual devices "
+                         "(kernel-ledger tax on concurrent engine QPS "
+                         "and on the flagship fused scan, both gated "
+                         "<= 2%; a 12-shape compile-storm drill that "
+                         "must be attributable in the ledger, fill "
+                         "jit_compile_seconds, and flip device health; "
+                         "per-chip mesh dispatch reconcile) and exits "
+                         "nonzero on a gate failure")
     ap.add_argument("--quick", action="store_true",
                     help="small config for smoke runs")
     ap.add_argument("--series", type=int, default=0)
@@ -3729,6 +3980,22 @@ def assemble_result(platform, stages, vec_sps, it_sps, c_sps=0.0,
     for k in ("error", "exprfuse_error"):
         if k in ef:
             result["exprfuse_error"] = ef[k]
+    dtl = stages.get("devicetelem", {})
+    for k in ("devicetelem_overhead_pct", "devicetelem_fused_overhead_pct",
+              "devicetelem_parity_ok", "devicetelem_storm_compiles",
+              "devicetelem_storm_attributed",
+              "devicetelem_storm_hist_count",
+              "devicetelem_storm_health_degraded",
+              "devicetelem_mesh_reconciled", "devicetelem_gate_ok"):
+        if k in dtl:
+            # ISSUE-18 acceptance: the per-chip kernel ledger costs
+            # <= 2% on concurrent QPS and on the flagship fused scan,
+            # an injected recompile storm is attributable (shape +
+            # origin) and flips device health, and per-device mesh
+            # dispatch counts reconcile with the untagged counter
+            result[k] = dtl[k]
+    if "error" in dtl:
+        result["devicetelem_error"] = dtl["error"]
     ns = stages.get("north_star_1m") or stages.get("cpu_north_star_1m")
     if ns and "samples_per_sec" in ns:
         result.update({
@@ -3960,6 +4227,18 @@ def run_worker(args):
     except Exception as e:  # noqa: BLE001 — must not sink the run
         stages["exprfuse"] = {"error": f"{type(e).__name__}: {e}"[:300]}
         writer.stage("exprfuse", stages["exprfuse"])
+
+    try:
+        # kernel-ledger tax + compile-storm drill; the mesh reconcile
+        # leg self-skips on a 1-device box (the standalone entry forces
+        # 8 virtual devices for it)
+        dtl = measure_devicetelem(quick=quick)
+        writer.stage("devicetelem", dtl)
+        stages["devicetelem"] = dtl
+    except Exception as e:  # noqa: BLE001 — must not sink the run
+        stages["devicetelem"] = {
+            "error": f"{type(e).__name__}: {e}"[:300]}
+        writer.stage("devicetelem", stages["devicetelem"])
 
     try:
         # measure_fused_coverage leaves FILODB_TPU_FUSED_INTERPRET=1
@@ -4294,6 +4573,35 @@ def main():
         print(json.dumps(ef))
         sys.exit(0 if "error" not in ef and "exprfuse_error" not in ef
                  and ef.get("exprfuse_gate_ok") else 1)
+    if args.stage == "devicetelem":
+        # standalone device-telemetry stage: CPU-pinned with 8 virtual
+        # host devices so the per-chip mesh reconcile leg runs (ISSUE-18
+        # acceptance wants /admin/devices reflecting real per-chip
+        # placement, not a 1-device degenerate); prints the one-line
+        # devicetelem JSON and exits nonzero when a gate fails
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8"
+            ).strip()
+        try:
+            dtl = measure_devicetelem(quick=args.quick,
+                                      series=args.series or None,
+                                      iters=args.iters)
+        except Exception as e:  # noqa: BLE001 — loud one-line fail
+            print(json.dumps({
+                "metric": "devicetelem_overhead_pct", "unit": "%",
+                "devicetelem_error": f"{type(e).__name__}: {e}"[:300]}))
+            sys.exit(1)
+        dtl = {"metric": "devicetelem_overhead_pct", "unit": "%",
+               "value": dtl.get("devicetelem_overhead_pct"), **dtl}
+        if "error" in dtl:
+            dtl["devicetelem_error"] = dtl["error"]
+        print(json.dumps(dtl))
+        sys.exit(0 if "error" not in dtl
+                 and "devicetelem_error" not in dtl
+                 and dtl.get("devicetelem_gate_ok") else 1)
     if args.stage == "chaos":
         # standalone failure-domain stage: runs IN THIS process (CPU-
         # pinned; chaos measures degradation machinery, not kernels),

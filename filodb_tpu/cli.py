@@ -409,6 +409,49 @@ def cmd_tenants(args) -> int:
         time.sleep(args.interval)
 
 
+def cmd_devices(args) -> int:
+    """The per-chip device telemetry table over HTTP (GET
+    /admin/devices): utilization EWMA, booked HBM by region, cumulative
+    dispatch/compile counters, and the newest kernel-ledger entries —
+    once or continuously (`--follow`).  The "queries are slow — is it
+    the device?" runbook's first command (doc/operations.md)."""
+    while True:
+        payload = _http_get(args.host, "/admin/devices",
+                            {"recent": str(args.recent)})
+        if payload.get("status") != "success":
+            print(json.dumps(payload, indent=2))
+            return 1
+        if args.raw:
+            print(json.dumps(payload, indent=2))
+        else:
+            data = payload["data"]
+            print(f"{'DEVICE':<18} {'UTIL':>6} {'DISP':>8} "
+                  f"{'BUSY_S':>10} {'HBM_HOT':>10} {'HBM_COLD':>10} "
+                  f"{'HBM_HW':>10} {'COMPILES':>8} {'TOP_KERNEL':<20}")
+            for dev, row in data["devices"].items():
+                hbm = row["hbm"]
+                top = next(iter(row["kernels"]), "-")
+                print(f"{dev:<18} {row['utilEwma']:>6.2f} "
+                      f"{row['dispatches']:>8} "
+                      f"{row['busySeconds']:>10.3f} "
+                      f"{hbm.get('hot', 0):>10} "
+                      f"{hbm.get('cold', 0):>10} "
+                      f"{row['hbmHighWaterBytes']:>10} "
+                      f"{row['compiles']:>8} {top:<20}")
+            if data["recent"]:
+                print(f"\n{'SEQ':>6} {'KIND':<9} {'KERNEL':<18} "
+                      f"{'DEVICE':<18} {'SECONDS':>9} {'SHAPE':<26} "
+                      f"ORIGIN")
+                for e in data["recent"]:
+                    print(f"{e['seq']:>6} {e['kind']:<9} "
+                          f"{e['kernel'][:18]:<18} {e['device']:<18} "
+                          f"{e['seconds']:>9.4f} {e['shape'][:26]:<26} "
+                          f"{e['origin'][:16]}")
+        if not args.follow:
+            return 0
+        time.sleep(args.interval)
+
+
 def cmd_cardinality(args) -> int:
     """Head-block cardinality over HTTP (GET /api/v1/status/tsdb, the
     Prometheus-compatible TSDB status shape): total alive series, top-k
@@ -842,6 +885,19 @@ def build_parser() -> argparse.ArgumentParser:
                     help="poll interval with --follow (seconds)")
     sp.add_argument("--raw", action="store_true", help="raw JSON")
     sp.set_defaults(fn=cmd_tenants)
+
+    sp = sub.add_parser("devices", help="per-chip device telemetry over "
+                                        "HTTP (kernel ledger, HBM by "
+                                        "region, compile events)")
+    sp.add_argument("--host", required=True)
+    sp.add_argument("--follow", action="store_true",
+                    help="poll continuously")
+    sp.add_argument("--interval", type=float, default=2.0,
+                    help="poll interval with --follow (seconds)")
+    sp.add_argument("--recent", type=int, default=8,
+                    help="ledger tail length to show (0 hides it)")
+    sp.add_argument("--raw", action="store_true", help="raw JSON")
+    sp.set_defaults(fn=cmd_devices)
 
     sp = sub.add_parser("cardinality",
                         help="head-block cardinality over HTTP "

@@ -165,7 +165,7 @@ class MirrorPlacer:
         self._booked: Dict[object, int] = {}
 
     def assign(self, shard_num: int, est_bytes: int,
-               limit_bytes: int) -> object:
+               limit_bytes: int, region: str = "hot") -> object:
         import jax
         devs = jax.local_devices()
         home = devs[shard_num % len(devs)]
@@ -187,9 +187,11 @@ class MirrorPlacer:
             used = sum(1 for v in self._booked.values() if v > 0)
         from filodb_tpu.utils.metrics import registry
         registry.gauge("device_mirror_devices_used").update(used)
+        from filodb_tpu.utils.devicetelem import telem
+        telem.hbm_book(chosen, region, est_bytes)
         return chosen
 
-    def book(self, device, delta: int) -> None:
+    def book(self, device, delta: int, region: str = "hot") -> None:
         if device is None:
             return
         from filodb_tpu.utils.metrics import registry
@@ -198,6 +200,11 @@ class MirrorPlacer:
                 self._booked.get(device, 0) + delta, 0)
             used = sum(1 for v in self._booked.values() if v > 0)
         registry.gauge("device_mirror_devices_used").update(used)
+        # every placer mutation pairs with one equal-delta feed into the
+        # per-device, per-region HBM occupancy model (PR 18): the gauges
+        # and the placer's table reconcile by construction
+        from filodb_tpu.utils.devicetelem import telem
+        telem.hbm_book(device, region, delta)
 
     def booked(self, device) -> int:
         with self._lock:
@@ -215,10 +222,16 @@ mirror_create_lock = threading.Lock()
 def _release_booking(cell) -> None:
     """weakref.finalize target: give a collected mirror's booked bytes
     back to the placer (must be module-level — a bound method would pin
-    the mirror alive)."""
+    the mirror alive).  Default-device mirrors (device None) have no
+    placer booking but still occupy HBM — release their occupancy-model
+    bytes directly."""
     device, nbytes = cell
     if nbytes:
-        placer.book(device, -nbytes)
+        if device is None:
+            from filodb_tpu.utils.devicetelem import telem
+            telem.hbm_book(None, "hot", -nbytes)
+        else:
+            placer.book(device, -nbytes)
 
 
 def sharded_mirrors_enabled(config_store) -> bool:
@@ -289,7 +302,12 @@ class ColdSegmentCache:
             self._bytes -= getattr(block, "nbytes", 0)
             dev = getattr(block, "device", None)
             if dev is not None and dev != "host":
-                placer.book(dev, -getattr(block, "nbytes", 0))
+                placer.book(dev, -getattr(block, "nbytes", 0),
+                            region="cold")
+            elif dev is None:
+                from filodb_tpu.utils.devicetelem import telem
+                telem.hbm_book(None, "cold",
+                               -getattr(block, "nbytes", 0))
             registry.counter("device_mirror_cold_evictions").increment()
 
     def get(self, key: tuple, est_bytes: int, shard_num: int,
@@ -310,24 +328,40 @@ class ColdSegmentCache:
             # re-decodes rather than pinning an over-budget block)
             registry.counter("device_mirror_cold_over_budget").increment()
             return build("host"), "cold_paged"
+        from filodb_tpu.utils.devicetelem import telem
         device = None
+        none_booked = False
         with self._lock:
             # reserve BEFORE the upload so concurrent page-ins see each
             # other's bookings and the budget is never exceeded
             self._evict_until(est_bytes)
             self._bytes += est_bytes
+        import time as _t
+        _b0 = _t.perf_counter()
         try:
             if self._placer_on():
                 device = placer.assign(shard_num, est_bytes,
-                                       self.limit_bytes)
+                                       self.limit_bytes, region="cold")
+            else:
+                # default-device page-in: no placer booking exists, feed
+                # the occupancy model directly (same release points)
+                telem.hbm_book(None, "cold", est_bytes)
+                none_booked = True
             block = build(device)
         except Exception:
             with self._lock:
                 self._bytes -= est_bytes
             if device is not None:
-                placer.book(device, -est_bytes)
+                placer.book(device, -est_bytes, region="cold")
+            elif none_booked:
+                telem.hbm_book(None, "cold", -est_bytes)
             raise
         actual = getattr(block, "nbytes", est_bytes)
+        telem.record_dispatch("cold_page_in", device=device,
+                              shape=f"seg{est_bytes >> 10}k",
+                              seconds=_t.perf_counter() - _b0,
+                              bytes_in=actual, kind="transfer",
+                              note=False)
         with self._lock:
             existing = self._entries.get(key)
             if existing is not None:
@@ -335,7 +369,9 @@ class ColdSegmentCache:
                 # this build's reservation
                 self._bytes -= est_bytes
                 if device is not None:
-                    placer.book(device, -est_bytes)
+                    placer.book(device, -est_bytes, region="cold")
+                elif none_booked:
+                    telem.hbm_book(None, "cold", -est_bytes)
                 self._entries[key] = self._entries.pop(key)
                 return existing, "cold_hit"
             # adjust the reservation to the measured size (still pre-
@@ -343,8 +379,11 @@ class ColdSegmentCache:
             self._bytes += actual - est_bytes
             self._evict_until(0)
             self._entries[key] = block
-        if device is not None and actual != est_bytes:
-            placer.book(device, actual - est_bytes)
+        if actual != est_bytes:
+            if device is not None:
+                placer.book(device, actual - est_bytes, region="cold")
+            elif none_booked:
+                telem.hbm_book(None, "cold", actual - est_bytes)
         registry.counter("device_mirror_cold_misses").increment()
         registry.gauge("device_mirror_cold_bytes").update(self.bytes_booked)
         registry.gauge("device_mirror_cold_limit_bytes").update(
@@ -356,7 +395,12 @@ class ColdSegmentCache:
             for block in self._entries.values():
                 dev = getattr(block, "device", None)
                 if dev is not None and dev != "host":
-                    placer.book(dev, -getattr(block, "nbytes", 0))
+                    placer.book(dev, -getattr(block, "nbytes", 0),
+                                region="cold")
+                elif dev is None:
+                    from filodb_tpu.utils.devicetelem import telem
+                    telem.hbm_book(None, "cold",
+                                   -getattr(block, "nbytes", 0))
             self._entries.clear()
             self._bytes = 0
 
@@ -376,13 +420,14 @@ class DeviceMirror:
         # reserved_bytes: the estimate MirrorPlacer.assign already booked
         # for this mirror — _book later adjusts it to the actual size
         self._booked_bytes = reserved_bytes if device is not None else 0
-        if device is not None:
-            # release the booking when the mirror is collected: store /
-            # memstore rebuilds drop mirrors without a teardown call,
-            # and leaked bookings would eventually push every device
-            # past the placement limit
-            self._booking = [device, self._booked_bytes]
-            weakref.finalize(self, _release_booking, self._booking)
+        # release the booking when the mirror is collected: store /
+        # memstore rebuilds drop mirrors without a teardown call, and
+        # leaked bookings would eventually push every device past the
+        # placement limit.  Default-device mirrors register too — their
+        # bytes live only in the HBM occupancy model (PR 18), which must
+        # see the release just the same.
+        self._booking = [device, self._booked_bytes]
+        weakref.finalize(self, _release_booking, self._booking)
         self._snap: Optional[_MirrorSnapshot] = None
         # process-unique identity for external caches: id() can be reused
         # by a later allocation after this mirror is collected
@@ -398,9 +443,16 @@ class DeviceMirror:
 
     def _book(self, nbytes: int) -> None:
         """Track this mirror's device-HBM footprint with the placer so
-        later shard placements see current occupancy."""
-        if self.device is not None and nbytes != self._booked_bytes:
-            placer.book(self.device, nbytes - self._booked_bytes)
+        later shard placements see current occupancy.  Default-device
+        mirrors (no placer booking) still feed the per-device occupancy
+        model, so `device_hbm_booked_bytes{device="default",region="hot"}`
+        is real on single-chip boxes too."""
+        if nbytes != self._booked_bytes:
+            if self.device is not None:
+                placer.book(self.device, nbytes - self._booked_bytes)
+            else:
+                from filodb_tpu.utils.devicetelem import telem
+                telem.hbm_book(None, "hot", nbytes - self._booked_bytes)
             self._booked_bytes = nbytes
             self._booking[1] = nbytes
 
@@ -541,6 +593,14 @@ class DeviceMirror:
         # background-rebuild thread's tally is simply never consumed)
         note_transfer(nbytes, xfer_s)
         note_mirror_refresh("full")
+        # ledger entry (kind=transfer): stats attribution is already
+        # handled by note_transfer above, so note=False — the ring and
+        # per-device byte counters still see the upload
+        from filodb_tpu.utils.devicetelem import telem
+        telem.record_dispatch("mirror_upload_full", device=self.device,
+                              shape=f"S{s}xT{t}", seconds=xfer_s,
+                              bytes_in=nbytes, kind="transfer",
+                              note=False)
         return True
 
     def is_fresh(self, store) -> bool:
@@ -830,6 +890,11 @@ class DeviceMirror:
         note_transfer(total_new * per_cell, xfer_s)
         note_mirror_refresh("incremental")
         self._book(self._nbytes(store))
+        from filodb_tpu.utils.devicetelem import telem
+        telem.record_dispatch("mirror_upload_incr", device=self.device,
+                              shape=f"cells{total_new}", seconds=xfer_s,
+                              bytes_in=total_new * per_cell,
+                              kind="transfer", note=False)
         return True
 
     def _refresh_pad_only(self, store, snap, gen0: int, s_new: int,

@@ -84,13 +84,6 @@ class PromHttpApi:
         # back-compat alias (tests/tools reach the coalescer through it)
         self.coalescers = {name: fe.coalescer
                           for name, fe in self.frontends.items()}
-        # last-seen jit compile-cache sizes (scrape-over-scrape deltas
-        # feed the jit_compile_events counter in _own_metrics); locked —
-        # ThreadingHTTPServer can run two scrapes concurrently, and an
-        # unsynchronized read-increment-write would double-count events
-        import threading as _threading
-        self._jit_cache_sizes: Dict[str, int] = {}
-        self._jit_lock = _threading.Lock()
         # remote_write sinks, built lazily per dataset (the WAL manager
         # is attached to the gateway pipeline after construction)
         self._rw_sinks: Dict[str, object] = {}
@@ -160,6 +153,8 @@ class PromHttpApi:
                 return self._active_queries(parts[2:], params, method)
             if parts == ["admin", "tenants"] and method == "GET":
                 return self._tenants()
+            if parts == ["admin", "devices"] and method == "GET":
+                return self._devices(params)
             if parts == ["admin", "events"] and method == "GET":
                 return self._events(params)
             if parts == ["admin", "rules", "reload"] and method == "POST":
@@ -783,23 +778,12 @@ class PromHttpApi:
         for fe in self.frontends.values():
             if fe.scheduler is not None:
                 fe.scheduler.refresh_gauges()
-        # jit compile-cache sizes (device-side accounting, PR 3): a
-        # compile storm — new shapes forcing fresh XLA compiles per
-        # query — shows as these gauges climbing scrape over scrape,
-        # plus an event counter for the deltas
-        try:
-            from filodb_tpu.ops.pallas_fused import jit_cache_stats
-            with self._jit_lock:
-                for fn_name, size in jit_cache_stats().items():
-                    registry.gauge("jit_cache_entries",
-                                   fn=fn_name).update(size)
-                    prev = self._jit_cache_sizes.get(fn_name, 0)
-                    if size > prev:
-                        registry.counter("jit_compile_events",
-                                         fn=fn_name).increment(size - prev)
-                    self._jit_cache_sizes[fn_name] = size
-        except Exception:  # noqa: BLE001 — private jax API: best-effort
-            pass
+        # jit compile events are no longer sampled here: the device
+        # telemetry layer (utils/devicetelem.watched_call around every
+        # kernel dispatch) pushes jit_compile_events / jit_cache_entries
+        # / jit_compile_seconds in AT COMPILE TIME, so compiles between
+        # scrapes or before a restart are never lost and each one is
+        # attributable to a query + shape (PR 18).
         fmt = (params or {}).get("format", "")
         if fmt == "openmetrics":
             return 200, _TextPayload(
@@ -861,6 +845,27 @@ class PromHttpApi:
         if ok:
             return 200, {"status": "ready"}
         return 503, {"status": "unready", "reason": reason}
+
+    def _devices(self, params: Dict[str, str]) -> Tuple[int, object]:
+        """GET /admin/devices — the per-chip device telemetry table
+        (utils/devicetelem, PR 18): utilization EWMA, booked HBM by
+        region, cumulative kernel/compile counters, and the newest
+        kernel-ledger entries.  ?recent=N sizes the ledger tail
+        (default 10, max the ring capacity); ?device= / ?kind= filter
+        it.  The `filo-cli devices` table renders this; the "queries
+        are slow — is it the device?" runbook in doc/operations.md
+        reads it first."""
+        from filodb_tpu.utils.devicetelem import telem
+        try:
+            recent = int(params.get("recent", "10"))
+        except ValueError:
+            raise _BadRequest("recent must be an integer") from None
+        snap = telem.snapshot(recent=max(0, recent))
+        dev_f, kind_f = params.get("device", ""), params.get("kind", "")
+        if dev_f or kind_f:
+            snap["recent"] = telem.recent(limit=max(0, recent) or 10,
+                                          device=dev_f, kind=kind_f)
+        return 200, {"status": "success", "data": snap}
 
     def _tenants(self) -> Tuple[int, object]:
         """GET /admin/tenants — the per-tenant QoS control panel in one

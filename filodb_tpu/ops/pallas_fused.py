@@ -281,6 +281,7 @@ def plan_device_mats(plan: "FusedPlan", device=None) -> tuple:
     lifetime.  One cache entry per plan holds ALL its per-device uploads
     (the multi-chip per-device dispatch path pins the same plan on every
     participating device), so device fan-out can't thrash the LRU."""
+    from filodb_tpu.utils.devicetelem import telem
     k = id(plan)
     dk = None if device is None else device
     with _PLAN_MATS_LOCK:
@@ -291,7 +292,9 @@ def plan_device_mats(plan: "FusedPlan", device=None) -> tuple:
             # leaf+mesh traffic filling the cap
             _PLAN_MATS_CACHE.pop(k)
             _PLAN_MATS_CACHE[k] = ent
+            telem.record_cache_event("plan_mats", "hit")
             return ent[1][dk]
+    telem.record_cache_event("plan_mats", "miss")
     W = plan.t1.shape[1]
     idx1 = plan.idx1 if plan.idx1 is not None else np.zeros((1, W),
                                                             np.float32)
@@ -303,15 +306,31 @@ def plan_device_mats(plan: "FusedPlan", device=None) -> tuple:
                  (plan.o1, plan.o2, plan.l1, plan.l2, plan.t1, plan.t2,
                   plan.n, plan.n1, plan.wstart_x, plan.wend_x, plan.tsrow,
                   idx1, idx2))
+    released: list = []
     with _PLAN_MATS_LOCK:
         ent = _PLAN_MATS_CACHE.get(k)
         if ent is None or ent[0] is not plan:
+            if ent is not None:
+                released.append(ent)        # id-reuse: old plan replaced
             ent = (plan, {})
             _PLAN_MATS_CACHE[k] = ent
-        ent[1][dk] = mats
+        if dk not in ent[1]:                # a concurrent build may have
+            ent[1][dk] = mats               # won: book each upload once
+            telem.hbm_book(dk, "planmats", _mats_nbytes(mats))
         while len(_PLAN_MATS_CACHE) > 8:
-            _PLAN_MATS_CACHE.pop(next(iter(_PLAN_MATS_CACHE)))
+            released.append(
+                _PLAN_MATS_CACHE.pop(next(iter(_PLAN_MATS_CACHE))))
+    for _, uploads in released:
+        telem.record_cache_event("plan_mats", "evict")
+        for dk2, mats2 in uploads.items():
+            telem.hbm_book(dk2, "planmats", -_mats_nbytes(mats2))
     return mats
+
+
+def _mats_nbytes(mats) -> int:
+    """Device bytes of one plan's uploaded matrix set (the 'planmats'
+    HBM occupancy region)."""
+    return int(sum(getattr(m, "nbytes", 0) for m in mats))
 
 
 _SEL_DUMMY: dict = {}
@@ -665,6 +684,15 @@ def _epilogue(mm, gids_ref, out, pres, out_refs, num_groups: int,
         out_refs[1][:] += (mmb or mm)(onehot, pres)
 
 
+def _run_shape_sig(vals_p, plan, Gp: int, kind: str, ragged: bool) -> str:
+    """The compile-cache shape signature recorded with jit compile
+    events (utils/devicetelem): the padded dims + static flags that key
+    the trace cache, so a recompile storm names the shape that drove it."""
+    Sp, Tp = vals_p.shape
+    return (f"S{Sp}xT{Tp}xW{plan.t1.shape[1]}xG{Gp}:{kind}"
+            + (":ragged" if ragged else ""))
+
+
 @functools.partial(jax.jit, static_argnames=(
     "num_groups", "is_counter", "is_rate", "with_drops", "interpret",
     "kind", "ragged", "per_series", "gather"))
@@ -950,11 +978,18 @@ def fused_rate_groupsum(vals, vbase, gids, plan: FusedPlan,
     Gp = pad_group_count(num_groups)
     if gather is None:
         gather = gather_default(kind) and plan.idx1 is not None
-    res = _run(prepared.vals_p, prepared.vbase_p, prepared.gids_p,
-               *_kernel_mats(plan, over_time, gather, device=device),
-               num_groups=Gp, is_counter=is_counter, is_rate=is_rate,
-               with_drops=with_drops, interpret=interpret, kind=kind,
-               ragged=ragged, gather=gather)
+    from filodb_tpu.utils.devicetelem import watched_call
+    mats = _kernel_mats(plan, over_time, gather, device=device)
+    res = watched_call(
+        "fused_run", _run,
+        _run_shape_sig(prepared.vals_p, plan, Gp, kind, ragged),
+        lambda: _run(prepared.vals_p, prepared.vbase_p, prepared.gids_p,
+                     *mats,
+                     num_groups=Gp, is_counter=is_counter,
+                     is_rate=is_rate, with_drops=with_drops,
+                     interpret=interpret, kind=kind, ragged=ragged,
+                     gather=gather),
+        device=device)
     if ragged:
         sums, cnts = res
         counts = np.asarray(cnts, np.float64)[:num_groups, :plan.W]
@@ -1020,14 +1055,14 @@ def present_sum(sums, counts) -> np.ndarray:
 
 
 def jit_cache_stats() -> dict:
-    """Entry counts of the jitted query kernels' compile caches — a
-    compile storm (new shapes forcing fresh XLA compiles per query)
-    shows up as these climbing, without attaching a profiler.  Exposed
-    as gauges at /metrics (http/routes._own_metrics) per PR 3's
-    device-side accounting."""
+    """Entry counts of the jitted query kernels' compile caches.  Kept
+    for ad-hoc inspection; the /metrics surface no longer samples this
+    at scrape time — utils/devicetelem pushes compile events in at
+    compile time (watched_call around every dispatch), so events between
+    scrapes or before a restart are never lost."""
     out = {}
     for name, fn in (("fused_run", _run),
-                     ("fused_minmax", fused_minmax_agg)):
+                     ("fused_minmax", _fused_minmax_jit)):
         try:
             out[name] = int(fn._cache_size())
         except Exception:  # noqa: BLE001 — private jax API: best-effort
@@ -1074,12 +1109,29 @@ def uniform_window_geometry(ts_row: np.ndarray, wends: np.ndarray,
     return f0, stride, width, t_needed
 
 
-@functools.partial(jax.jit, static_argnames=(
-    "f0", "stride", "width", "W", "fn_name", "agg_op", "num_groups",
-    "ragged"))
 def fused_minmax_agg(vals, vbase, gids, f0: int, stride: int, width: int,
                      W: int, fn_name: str, agg_op: str, num_groups: int,
                      ragged: bool):
+    """Compile-watched wrapper over the jitted body (_fused_minmax_jit):
+    the trace-cache delta around the call pushes compile events into the
+    device telemetry ledger at compile time (utils/devicetelem)."""
+    from filodb_tpu.utils.devicetelem import watched_call
+    shape = (f"S{vals.shape[0]}xT{vals.shape[1]}xW{W}xG{num_groups}"
+             f":{fn_name}" + (":ragged" if ragged else ""))
+    return watched_call(
+        "fused_minmax", _fused_minmax_jit, shape,
+        lambda: _fused_minmax_jit(vals, vbase, gids, f0, stride, width,
+                                  W, fn_name, agg_op, num_groups,
+                                  ragged),
+        device=_committed_device(vals))
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "f0", "stride", "width", "W", "fn_name", "agg_op", "num_groups",
+    "ragged"))
+def _fused_minmax_jit(vals, vbase, gids, f0: int, stride: int, width: int,
+                      W: int, fn_name: str, agg_op: str, num_groups: int,
+                      ragged: bool):
     """min/max_over_time + group aggregation in ONE jit: a strided
     lax.reduce_window over the values (one HBM pass; the VPU's native
     windowed order-statistic) straight into the 3-phase map (segment
@@ -1188,11 +1240,17 @@ def fused_leaf_agg_batch(plan: FusedPlan, values: PaddedValues, panels,
     device = _committed_device(values.vals_p)
 
     def run(gids_p, Gp, per_series):
-        return _run(values.vals_p, values.vbase_p, gids_p,
-                    *_kernel_mats(plan, over_time, gather, device=device),
-                    num_groups=Gp, is_counter=is_counter, is_rate=is_rate,
-                    with_drops=with_drops, interpret=interpret, kind=kind,
-                    ragged=ragged, per_series=per_series, gather=gather)
+        from filodb_tpu.utils.devicetelem import watched_call
+        mats = _kernel_mats(plan, over_time, gather, device=device)
+        return watched_call(
+            "fused_run", _run,
+            _run_shape_sig(values.vals_p, plan, Gp, kind, ragged),
+            lambda: _run(values.vals_p, values.vbase_p, gids_p, *mats,
+                         num_groups=Gp, is_counter=is_counter,
+                         is_rate=is_rate, with_drops=with_drops,
+                         interpret=interpret, kind=kind, ragged=ragged,
+                         per_series=per_series, gather=gather),
+            device=device)
 
     def dense_counts(groups):
         return groups.gsize[:, None].astype(np.float64) * \

@@ -995,10 +995,17 @@ class MeshExecutor:
             return np.zeros((0, W)), []
         mi = np.asarray([p[0] for p in pairs], np.int64)
         oi = np.asarray([p[1] for p in pairs], np.int64)
+        import time as _time
+
+        from filodb_tpu.utils.devicetelem import telem
+        _b0 = _time.perf_counter()
         out = np.asarray(gather_binop(
             jnp.asarray(np.asarray(lv)), jnp.asarray(np.asarray(rv)),
             jnp.asarray(mi), jnp.asarray(oi), op=op,
             bool_modifier=bool_modifier, keep_side="lhs"))
+        telem.record_dispatch(
+            "gather_binop", shape=f"P{len(pairs)}xW{W}:{op}",
+            seconds=_time.perf_counter() - _b0, bytes_out=int(out.nbytes))
         return out, [ll[i] for i, _ in pairs]
 
     def _panel_groupings(self, packed: PackedShards, panels):
@@ -1265,21 +1272,41 @@ class MeshExecutor:
                         for s in vbase.addressable_shards}
             parts_sums: Dict[Tuple[int, int], jax.Array] = {}
             parts_cnts: Dict[Tuple[int, int], jax.Array] = {}
+            import time as _time
+
+            from filodb_tpu.utils.devicetelem import telem, watched_call
+            sig = (f"S{S}xT{T}xG{Gtot}:{kind_k}"
+                   + (":ragged" if ragged else ""))
             for si in range(D):
                 for ti in range(n_time):
                     dev = grid[si, ti]
                     mats_d = pf._kernel_mats(plans[ti], over_time, gather,
                                              device=dev)
-                    res = _device_fused_call(
-                        vblocks[dev], gblocks[dev], vbblocks[dev],
-                        *mats_d, G=Gtot, S=S, T=T, Tp=Tp,
-                        is_counter=is_counter,
-                        is_rate=(fn_name == "rate"), interpret=interpret,
-                        kind=kind_k, ragged=ragged)
+                    _d0 = _time.perf_counter()
+                    res = watched_call(
+                        "mesh_fused", _device_fused_call, sig,
+                        lambda: _device_fused_call(
+                            vblocks[dev], gblocks[dev], vbblocks[dev],
+                            *mats_d, G=Gtot, S=S, T=T, Tp=Tp,
+                            is_counter=is_counter,
+                            is_rate=(fn_name == "rate"),
+                            interpret=interpret,
+                            kind=kind_k, ragged=ragged),
+                        device=dev)
+                    # per-chip ledger entry per dispatch: the seconds here
+                    # are issue wall only (the chips compute concurrently;
+                    # the synchronizing merge below carries the wait), but
+                    # the COUNTS reconcile 1:1 with
+                    # mesh_fused_perdevice_dispatches
+                    telem.record_dispatch(
+                        "mesh_fused", device=dev, shape=sig,
+                        seconds=_time.perf_counter() - _d0,
+                        bytes_in=int(getattr(vblocks[dev], "nbytes", 0)))
                     if ragged:
                         parts_sums[(si, ti)], parts_cnts[(si, ti)] = res
                     else:
                         parts_sums[(si, ti)] = res
+            _m0 = _time.perf_counter()
             merged = merge_device_partials(parts_sums, self.mesh, "sum")
 
             def unslice(a):
@@ -1292,6 +1319,14 @@ class MeshExecutor:
                     merge_device_partials(parts_cnts, self.mesh, "sum"))
             else:
                 all_out, all_counts = unslice(merged), None
+            # the merge is where the dispatches above synchronize: its
+            # wall is the fleet's compute+reduce wait, attributed as one
+            # ledger entry so QueryStats.device_seconds covers the mesh
+            # path end to end
+            telem.record_dispatch(
+                "mesh_merge", shape=f"D{D}xG{Gtot}",
+                seconds=_time.perf_counter() - _m0,
+                bytes_out=int(all_out.nbytes))
             from filodb_tpu.utils.metrics import registry
             registry.counter("mesh_fused_kernel").increment()
             registry.counter("mesh_fused_perdevice_dispatches") \

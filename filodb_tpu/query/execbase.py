@@ -642,6 +642,17 @@ class ExecPlan:
         stats.bytes_transferred += exec_tally.transfer_bytes
         stats.mirror_full_rebuilds += exec_tally.mirror_full
         stats.mirror_incremental += exec_tally.mirror_incremental
+        # per-(device, kernel) split of device_seconds (PR 18): folded
+        # under a flat "dev|kernel" key so the generic dataclass wire
+        # codec ships it unchanged with dispatch replies
+        for (dev, kern), cell in exec_tally.device_calls.items():
+            key = f"{dev}|{kern}"
+            mine = stats.device_calls.get(key)
+            if mine is None:
+                stats.device_calls[key] = [cell[0], cell[1]]
+            else:
+                mine[0] += cell[0]
+                mine[1] += cell[1]
         rec = getattr(self.ctx, "analyze", None)
         if rec is not None:
             rec.add(self, {

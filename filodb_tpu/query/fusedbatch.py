@@ -87,7 +87,6 @@ def finish_fused_calls(calls: List[FusedCall]) -> List[AggPartial]:
 
     import time as _time
 
-    from filodb_tpu.utils.metrics import note_device_time
     # two-phase execution: phase A dispatches every merged set's kernel
     # work WITHOUT reading anything back, phase B synchronizes.  With
     # sharded DeviceMirrors a multi-shard query's leaves hold their
@@ -148,14 +147,26 @@ def finish_fused_calls(calls: List[FusedCall]) -> List[AggPartial]:
                 ragged=fc0.ragged, num_series=fc0.num_series, lazy=True)
             pending.append((take, finisher, _time.perf_counter() - _t0))
             idxs = idxs[len(take):]
+    from filodb_tpu.utils.devicetelem import telem
     for take, finisher, disp_s in pending:
         _t0 = _time.perf_counter()
         comps = finisher()
         for i, comp in zip(take, comps):
             out[i] = _present(calls[i], comp)
         # kernel dispatch + result readback (np conversion in _present
-        # synchronizes), attributed to the node that triggered it
-        note_device_time(disp_s + (_time.perf_counter() - _t0))
+        # synchronizes), attributed to the node that triggered it AND
+        # recorded in the per-chip kernel ledger (utils/devicetelem) —
+        # record_dispatch feeds the same exec tally note_device_time
+        # did, so QueryStats.device_seconds is unchanged
+        fc0 = calls[take[0]]
+        telem.record_dispatch(
+            f"fused_{fc0.fn}",
+            device=pf._committed_device(fc0.values.vals_p),
+            shape=(f"S{fc0.num_series}xW{len(fc0.wends)}"
+                   f"x{len(take)}p" + (":ragged" if fc0.ragged else "")),
+            seconds=disp_s + (_time.perf_counter() - _t0),
+            bytes_in=int(getattr(fc0.values.vals_p, "nbytes", 0)),
+            bytes_out=sum(int(getattr(c, "nbytes", 0)) for c in comps))
     for i, j in alias.items():
         src = out[j]
         out[i] = dataclasses.replace(src) if src is not None else None

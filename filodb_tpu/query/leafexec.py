@@ -535,15 +535,24 @@ class MultiSchemaPartitionsExec(LeafExecPlan):
                                 next(iter(_FUSED_MINMAX_PAD_CACHE)))
             vals = padded
             ragged = True
+        _d0 = _time.perf_counter()
         comp = pf.fused_minmax_agg(
             vals, None if vb is None else jnp.asarray(vb),
             jnp.asarray(gids, jnp.int32), f0, stride, width,
             int(eval_wends.size), t0.function, t1.op, len(gkeys),
             ragged=ragged)
+        comp_np = np.asarray(comp, np.float64)   # synchronizing readback
+        from filodb_tpu.utils.devicetelem import telem
+        telem.record_dispatch(
+            "fused_minmax", device=pf._committed_device(vals),
+            shape=f"S{vals.shape[0]}xW{int(eval_wends.size)}xG{len(gkeys)}",
+            seconds=_time.perf_counter() - _d0,
+            bytes_in=int(getattr(vals, "nbytes", 0)),
+            bytes_out=comp_np.nbytes)
         from filodb_tpu.utils.metrics import registry
         registry.counter("leaf_fused_minmax").increment()
         return AggPartial(t1.op, gkeys, wends,
-                          comp=np.asarray(comp, np.float64),
+                          comp=comp_np,
                           cache_token=agg_token(t1.op, t1.by, t1.without,
                                                 data.cache_token))
 
@@ -750,10 +759,13 @@ class MultiSchemaPartitionsExec(LeafExecPlan):
                 # pairing a newer snapshot's grid with an older one's values
                 # would feed the kernel zero-padded phantom columns
                 snap = mirror.snapshot()
-                from filodb_tpu.utils.metrics import note_device_time
+                from filodb_tpu.utils.devicetelem import telem
                 _g0 = _time.perf_counter()
                 mirrored = mirror.gather_cached(rows, snap)
-                note_device_time(_time.perf_counter() - _g0)
+                telem.record_dispatch(
+                    "mirror_gather", device=mirror.device,
+                    shape=f"rows{len(rows)}",
+                    seconds=_time.perf_counter() - _g0)
         # value column selection: histograms gather [S, T, B]
         shared_ts_row = None
         dense = True

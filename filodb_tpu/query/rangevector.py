@@ -163,6 +163,13 @@ class QueryStats:
     # degraded to the general path (both zero = compiler not engaged)
     exprfuse_fused: int = 0
     exprfuse_degraded: int = 0
+    # --- per-device kernel breakdown (PR 18, utils/devicetelem.py) ---
+    # "device|kernel" -> [seconds, dispatches]: the split of
+    # device_seconds by chip and kernel, folded from the exec tally by
+    # execbase and merged additively (locally and over the wire) — the
+    # sum of seconds over entries equals device_seconds
+    device_calls: Dict[str, List[float]] = dataclasses.field(
+        default_factory=dict)
 
     _COLD_ORDER = ("", "hot", "cold_hit", "cold_paged")
 
@@ -197,6 +204,13 @@ class QueryStats:
             self.cold_tier = other.cold_tier
         self.exprfuse_fused += other.exprfuse_fused
         self.exprfuse_degraded += other.exprfuse_degraded
+        for key, cell in other.device_calls.items():
+            mine = self.device_calls.get(key)
+            if mine is None:
+                self.device_calls[key] = [cell[0], cell[1]]
+            else:
+                mine[0] += cell[0]
+                mine[1] += cell[1]
 
     def to_dict(self) -> Dict[str, object]:
         """The `?stats=true` wire shape (http/routes attaches it to the
@@ -237,7 +251,18 @@ class QueryStats:
                 "mirrorIncremental": self.mirror_incremental,
                 "coldTier": self.cold_tier,
             },
+            # device -> kernel -> {seconds, dispatches}: the per-chip
+            # split of phases.device_s (empty when no kernel ran)
+            "devices": self._devices_dict(),
         }
+
+    def _devices_dict(self) -> Dict[str, object]:
+        out: Dict[str, Dict[str, object]] = {}
+        for key, (secs, count) in sorted(self.device_calls.items()):
+            dev, _, kern = key.partition("|")
+            out.setdefault(dev, {})[kern] = {
+                "seconds": round(secs, 6), "dispatches": int(count)}
+        return out
 
 
 @dataclasses.dataclass

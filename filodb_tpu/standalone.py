@@ -552,6 +552,15 @@ class FiloServer:
     # ------------------------------------------------------------ lifecycle
 
     def start(self, background_flush: bool = True) -> None:
+        try:
+            # seed the device-telemetry ledger with every local chip so
+            # /admin/devices lists the fleet before the first dispatch
+            import jax
+
+            from filodb_tpu.utils.devicetelem import telem
+            telem.register_devices(jax.local_devices())
+        except Exception:  # noqa: BLE001 — telemetry boot is advisory
+            pass
         self.http.start()
         if self.replication_server is not None:
             self.replication_server.start()

@@ -221,6 +221,57 @@ class HealthEvaluator:
         return {"status": DEGRADED if recent else OK,
                 "recentOverCap": len(recent)}
 
+    # compile-storm threshold: this many query-attributed jit compiles
+    # of the SAME kernel inside RECENT_WINDOW_S degrade the device
+    # subsystem.  A storm is one kernel recompiling over and over (new
+    # shapes defeating its trace cache); scattered first-compiles across
+    # many kernels are a process warming up, not a storm.  Boot warmup
+    # compiles carry no origin and never count.
+    compile_storm_count = 10
+    # a storm is *rapid* recompilation — 10 same-kernel compiles inside
+    # two minutes, not 10 spread over the journal's lifetime.  Tighter
+    # than RECENT_WINDOW_S on purpose: organic shape churn (new
+    # datasets warming, ad-hoc queries) trickles compiles in slowly.
+    compile_storm_window_s = 120.0
+    # sustained HBM pressure: this many over-cap degrades in the window
+    # (one spill is the mirror subsystem's business; a stream of them
+    # means placement is thrashing)
+    device_over_cap_count = 3
+
+    def _device_verdict(self) -> dict:
+        """Device telemetry verdict (PR 18, utils/devicetelem): a
+        recompile storm (every query paying an XLA compile — new shapes
+        defeating the trace cache) or sustained HBM over-cap degrades ⇒
+        degraded, with the counts an operator needs to pick between
+        /admin/devices and the slowlog as the next hop."""
+        from filodb_tpu.utils.events import journal
+        now = time.time()
+        compiles = [ev for ev in journal.since(0, kind="jit_compile")
+                    if ev["unixSeconds"] >= now - self.compile_storm_window_s
+                    and ev.get("origin")]
+        over_cap = [ev for ev in journal.since(0, kind="mirror_over_cap")
+                    if ev["unixSeconds"] >= now - RECENT_WINDOW_S]
+        by_kernel: dict = {}
+        for ev in compiles:
+            k = ev.get("kernel", "")
+            by_kernel[k] = by_kernel.get(k, 0) + 1
+        storm_kernel, storm_n = "", 0
+        if by_kernel:
+            storm_kernel = max(by_kernel, key=by_kernel.get)
+            storm_n = by_kernel[storm_kernel]
+        status = OK
+        reasons = []
+        if storm_n >= self.compile_storm_count:
+            status = DEGRADED
+            reasons.append("compile_storm")
+        if len(over_cap) >= self.device_over_cap_count:
+            status = DEGRADED
+            reasons.append("hbm_over_cap")
+        return {"status": status, "reasons": reasons,
+                "recentCompiles": len(compiles),
+                "stormKernel": storm_kernel if storm_n >= self.compile_storm_count else "",
+                "recentOverCap": len(over_cap)}
+
     # ----------------------------------------------------------- verdicts
 
     def evaluate(self) -> dict:
@@ -231,6 +282,7 @@ class HealthEvaluator:
             "shards": self._shards_verdict(),
             "mirror": self._mirror_verdict(),
             "ingest": self._ingest_verdict(),
+            "device": self._device_verdict(),
         }
         for name, probe in self.probes.items():
             try:

@@ -359,22 +359,28 @@ class _ExecTally(threading.local):
         self.transfer_bytes = 0
         self.mirror_full = 0
         self.mirror_incremental = 0
+        # (device, kernel) -> [seconds, count]: the per-chip, per-kernel
+        # split of device_s (PR 18 device telemetry) — same snapshot /
+        # restore protocol, folded into QueryStats.device_calls
+        self.device_calls: Dict[Tuple[str, str], List[float]] = {}
 
     def snapshot(self):
         s = (self.child_wall, self.device_s, self.transfer_s,
-             self.transfer_bytes, self.mirror_full, self.mirror_incremental)
+             self.transfer_bytes, self.mirror_full, self.mirror_incremental,
+             self.device_calls)
         self.child_wall = 0.0
         self.device_s = 0.0
         self.transfer_s = 0.0
         self.transfer_bytes = 0
         self.mirror_full = 0
         self.mirror_incremental = 0
+        self.device_calls = {}
         return s
 
     def restore(self, snap, total_wall: float) -> None:
         (self.child_wall, self.device_s, self.transfer_s,
          self.transfer_bytes, self.mirror_full,
-         self.mirror_incremental) = snap
+         self.mirror_incremental, self.device_calls) = snap
         self.child_wall += total_wall
 
 
@@ -384,6 +390,20 @@ exec_tally = _ExecTally()
 def note_device_time(seconds: float) -> None:
     """Attribute device dispatch/kernel wall time to the current node."""
     exec_tally.device_s += seconds
+
+
+def note_device_call(device: str, kernel: str, seconds: float) -> None:
+    """Attribute one device kernel dispatch to the current node, split by
+    (device, kernel) — the sum over entries equals what note_device_time
+    alone would have accumulated, so QueryStats.device_seconds and the
+    per-device breakdown reconcile by construction."""
+    exec_tally.device_s += seconds
+    cell = exec_tally.device_calls.get((device, kernel))
+    if cell is None:
+        exec_tally.device_calls[(device, kernel)] = [seconds, 1]
+    else:
+        cell[0] += seconds
+        cell[1] += 1
 
 
 def note_transfer(nbytes: int, seconds: float) -> None:

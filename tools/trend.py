@@ -177,6 +177,17 @@ CARRY = [
     "exprfuse_p50_s", "exprfuse_baseline_p50_s", "exprfuse_speedup_x",
     "exprfuse_identical", "exprfuse_fused", "exprfuse_degraded",
     "exprfuse_memo_hits", "exprfuse_gate_ok", "exprfuse_error",
+    # device telemetry (ISSUE 18): the per-chip kernel ledger's tax on
+    # concurrent engine QPS and on the flagship fused-scan p50 (both
+    # gated <= 2%), the ?stats=true per-device parity check, the
+    # compile-storm drill (attributable in the ledger, fills
+    # jit_compile_seconds, flips device health), and the per-device
+    # mesh dispatch reconcile — plus a loud devicetelem_error
+    "devicetelem_overhead_pct", "devicetelem_fused_overhead_pct",
+    "devicetelem_parity_ok", "devicetelem_storm_compiles",
+    "devicetelem_storm_attributed", "devicetelem_storm_hist_count",
+    "devicetelem_storm_health_degraded", "devicetelem_mesh_reconciled",
+    "devicetelem_gate_ok", "devicetelem_error",
 ]
 RENAME = {"value": "headline_samples_per_sec",
           "p50_query_latency_s": "p50_s"}
