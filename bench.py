@@ -2950,6 +2950,408 @@ def run_replication(quick=False, series=None):
     }
 
 
+def run_objectstore(quick=False, series=None):
+    """Disaggregated cold-tier stage (ISSUE 19): the disk-loss +
+    elastic-read drills over persist/objectstore.py.  Three parts,
+    each gated:
+
+      (a) disk-kill drill — a FiloServer compacts + uploads two windows
+          to a shared object store, takes a WAL-riding remote_write
+          tail, then loses its ENTIRE store root (chunks.log, segments,
+          meta).  While it is down, a stateless cold-read cluster over
+          the same shared store keeps answering the historical range
+          (objectstore_drill_availability == 1.0).  A reboot on the
+          empty disk restores segments from the manifests, replays the
+          WAL tail, and must answer the full-range query_range
+          byte-identical to the pre-kill baseline (traceID stripped).
+      (b) elastic-read gate — a cold-only 4-shard dataset in the shared
+          store, served by real query-node OS processes
+          (bench/coldnode.py: zero owned shards, manifest mount only).
+          1 node vs 1 data + 2 query-only under the same concurrent
+          client load: objectstore_elastic_qps_ratio >= 1.8 (on hosts
+          with >= 3 cores; no-collapse + identity on smaller hosts) and
+          results bit-identical.
+      (c) dead-store degrade — every objectstore.get errors (fault
+          point + breaker): a partial-tolerant query returns a FLAGGED
+          partial in bounded wall time; a strict query surfaces the
+          typed error.  Never a hang, never a silent full.
+    """
+    import shutil
+    import signal
+    import socket as _socket
+    import tempfile
+    import threading
+
+    import numpy as np
+
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    from filodb_tpu.config import FilodbSettings
+    from filodb_tpu.core.memstore import TimeSeriesMemStore
+    from filodb_tpu.core.partkey import PartKey
+    from filodb_tpu.http import remotepb
+    from filodb_tpu.parallel.breaker import breakers
+    from filodb_tpu.parallel.shardmapper import (ShardEvent, ShardMapper,
+                                                 SpreadProvider)
+    from filodb_tpu.parallel.testcluster import make_cold_read_cluster
+    from filodb_tpu.parallel.transport import RemoteNodeDispatcher
+    from filodb_tpu.persist.compactor import SegmentCompactor
+    from filodb_tpu.persist.localstore import (LocalDiskColumnStore,
+                                               LocalDiskMetaStore)
+    from filodb_tpu.persist.objectstore import (LocalObjectStore,
+                                                SegmentUploader,
+                                                make_query_tier)
+    from filodb_tpu.persist.segments import SegmentStore
+    from filodb_tpu.query.engine import QueryEngine
+    from filodb_tpu.query.planners import PersistedClusterPlanner
+    from filodb_tpu.query.rangevector import PlannerParams
+    from filodb_tpu.replication.failover import cold_dispatcher_factory
+    from filodb_tpu.standalone import DatasetConfig, FiloServer
+    from filodb_tpu.utils import snappy as fsnappy
+    from filodb_tpu.utils.faults import faults
+
+    WINDOW = 3600 * 1000
+    INTERVAL = 60_000
+    root = tempfile.mkdtemp(prefix="filodb-objbench-")
+    procs = []
+    try:
+        # ------------------------------- (a) disk-kill drill (FiloServer)
+        S_a = 128 if quick else 512
+        now_ms = int(time.time() * 1000)
+        t0 = (now_ms - 5 * WINDOW) - ((now_ms - 5 * WINDOW) % WINDOW)
+        na = 2 * WINDOW // INTERVAL
+        grid_a = t0 + np.arange(na, dtype=np.int64) * INTERVAL
+        vals_a = (np.arange(S_a)[:, None] * 7.0
+                  + (np.arange(na) % 13)[None, :])
+        pks_a = [PartKey("m", (("inst", f"i{i}"), ("_ws_", "w"),
+                               ("_ns_", "drill"))) for i in range(S_a)]
+        tail_batches, tail_k = 4, 8
+        tail_start = int(grid_a[-1]) + INTERVAL
+
+        def tail_payload(b):
+            srs = []
+            for i in range(S_a):
+                labels = [("__name__", "m"), ("_ws_", "w"),
+                          ("_ns_", "drill"), ("inst", f"i{i}")]
+                samples = [(float(i + j + b),
+                            tail_start + (b * tail_k + j) * INTERVAL)
+                           for j in range(tail_k)]
+                srs.append(remotepb.PromTimeSeries(labels, samples))
+            return fsnappy.compress(remotepb.encode_write_request(srs))
+
+        cfg = FilodbSettings()
+        cfg.store.segment_window_ms = WINDOW
+        cfg.store.segment_closed_lag_ms = WINDOW
+        cfg.store.segment_retain_raw_ms = 1
+        cfg.objectstore.root = os.path.join(root, "shared-a")
+        cfg.objectstore.retry_base_s = 0.001
+        cfg.objectstore.retry_max_s = 0.01
+        cfg.wal.enabled = True
+        cfg.wal.dir = os.path.join(root, "wal-a")
+        store_root = os.path.join(root, "node-a")
+        tail_end = tail_start + tail_batches * tail_k * INTERVAL
+        # grid chosen so no instant lands inside the raw/cold seam band
+        # [earliest_raw, earliest_raw + lookback): instants there route
+        # to the cold tier, whose coverage legitimately ends before the
+        # WAL tail — the same conservative split FiloDB's raw/downsample
+        # boundary makes.  step 600s > lookback 300s and a +300s phase
+        # puts the grid at seam±300s exactly, where both tiers agree.
+        q_full = {"query": "sum(m)", "start": str(t0 // 1000 + 300),
+                  "end": str(tail_end // 1000), "step": "600"}
+
+        def filo_query(server, query):
+            st, pay = server.api.handle("GET", "/api/v1/query_range",
+                                        dict(query), b"")
+            assert st == 200, pay
+            pay.pop("traceID", None)
+            return pay
+
+        srv = FiloServer([DatasetConfig("prometheus", num_shards=1)],
+                         column_store=LocalDiskColumnStore(store_root),
+                         meta_store=LocalDiskMetaStore(store_root),
+                         config=cfg)
+        try:
+            shard = srv.memstore.get_shard("prometheus", 0)
+            shard.ingest_columns("gauge", pks_a,
+                                 np.broadcast_to(grid_a, (S_a, na)),
+                                 {"value": vals_a})
+            shard.flush_all_groups()
+            # compact -> upload -> retention (upload ack gates the prune)
+            srv.compaction_schedulers["prometheus"].run_once()
+            uploaded = srv.uploaders["prometheus"].uploads
+            tail_acked = 0
+            for b in range(tail_batches):        # WAL-riding tail
+                st, _ = srv.api.handle("POST", "/api/v1/write", {},
+                                       tail_payload(b))
+                assert st == 204, f"remote_write got {st}"
+                tail_acked += 1
+            baseline = filo_query(srv, q_full)
+            assert baseline["data"]["result"], "drill baseline empty"
+        finally:
+            srv.shutdown()
+
+        # the disk dies — WAL and shared store survive, nothing else
+        shutil.rmtree(store_root)
+
+        # while the node is down, stateless readers over the shared tier
+        # keep the historical range answerable: that IS the availability
+        shared_a = LocalObjectStore(cfg.objectstore.root, name="avail")
+        cold = make_cold_read_cluster(shared_a, num_shards=1,
+                                      dataset="prometheus",
+                                      data_nodes=("b0",),
+                                      query_nodes=("qb",))
+        avail_ok = avail_n = 0
+        try:
+            qs_a = t0 // 1000 + 600
+            qe_a = int(grid_a[-1]) // 1000
+            for _ in range(20):
+                avail_n += 1
+                r = cold.engine.query_range("sum(m)", qs_a, 300, qe_a)
+                if r.error is None and not r.partial and \
+                        list(r.series()):
+                    avail_ok += 1
+        finally:
+            cold.stop()
+        availability = avail_ok / max(avail_n, 1)
+
+        # reboot on the empty disk: manifests restore the segments, the
+        # WAL replays the tail, the answer must not have changed a byte
+        srv2 = FiloServer([DatasetConfig("prometheus", num_shards=1)],
+                          column_store=LocalDiskColumnStore(store_root),
+                          meta_store=LocalDiskMetaStore(store_root),
+                          config=cfg)
+        try:
+            restored = len(SegmentStore(store_root).list("prometheus", 0))
+            mount_ok = srv2.health.pending_manifest_mounts() == []
+            rebuilt = filo_query(srv2, q_full)
+            drill_identical = (json.dumps(rebuilt, sort_keys=True)
+                               == json.dumps(baseline, sort_keys=True))
+        finally:
+            srv2.shutdown()
+
+        # -------------------------- (b) elastic read: real node processes
+        DSB = "coldbench"
+        NSH = 4
+        S_b = series or (512 if quick else 2_048)
+        T0B = 1_600_000_000_000 - (1_600_000_000_000 % WINDOW)
+        nb = 2 * WINDOW // INTERVAL
+        grid_b = T0B + np.arange(nb, dtype=np.int64) * INTERVAL
+        broot = os.path.join(root, "shared-b")
+        disk_b = os.path.join(root, "disk-b")
+        cs_b = LocalDiskColumnStore(disk_b)
+        ms_b = TimeSeriesMemStore(column_store=cs_b,
+                                  meta_store=LocalDiskMetaStore(disk_b))
+        for s in range(NSH):
+            sh = ms_b.setup(DSB, s)
+            keys = [PartKey("m", (("inst", f"i{i}"), ("_ws_", "w"),
+                                  ("_ns_", f"s{s}")))
+                    for i in range(S_b)]
+            vals = (np.arange(S_b)[:, None] * 3.0 + s
+                    + (np.arange(nb) % 17)[None, :])
+            sh.ingest_columns("gauge", keys,
+                              np.broadcast_to(grid_b, (S_b, nb)),
+                              {"value": vals})
+            sh.flush_all_groups()
+        seg_b = SegmentStore(disk_b)
+        comp_b = SegmentCompactor(cs_b, seg_b, DSB, NSH,
+                                  window_ms=WINDOW, closed_lag_ms=0)
+        n_segs = comp_b.compact_all(now_ms=int(grid_b[-1]) + 10 * WINDOW)
+        store_b = LocalObjectStore(broot, name="bench-up")
+        up_b = SegmentUploader(store_b, seg_b, DSB, NSH,
+                               retry_base_s=0.001, retry_max_s=0.01)
+        up_b.mount()
+        n_up = up_b.run_once()
+
+        def free_port():
+            with _socket.socket() as s:
+                s.bind(("127.0.0.1", 0))
+                return s.getsockname()[1]
+
+        env = dict(os.environ)
+        env["PYTHONPATH"] = REPO_DIR
+        env["JAX_PLATFORMS"] = "cpu"
+        worker = os.path.join(REPO_DIR, "bench", "coldnode.py")
+        ports = {}
+
+        def spawn_cold(name):
+            port = free_port()
+            p = subprocess.Popen(
+                [sys.executable, worker, "--name", name,
+                 "--port", str(port), "--objstore", broot,
+                 "--dataset", DSB, "--num-shards", str(NSH)],
+                stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+                text=True, env=env, cwd=REPO_DIR)
+            procs.append(p)
+            ready = json.loads(p.stdout.readline())
+            assert ready.get("ready"), f"cold node {name}: {ready}"
+            ports[name] = ready["port"]
+
+        def make_engine(query_nodes=()):
+            mapper = ShardMapper(NSH)
+            for s in range(NSH):
+                mapper.update_from_event(
+                    ShardEvent("IngestionStarted", DSB, s, "data0"))
+            for qn in query_nodes:
+                mapper.register_query_node(qn)
+            dispatchers = {}
+
+            def dispatcher_for(node):
+                d = dispatchers.get(node)
+                if d is None:
+                    dispatchers[node] = d = RemoteNodeDispatcher(
+                        "127.0.0.1", ports[node])
+                return d
+
+            tier, _remote = make_query_tier(store_b, DSB, NSH)
+            planner = PersistedClusterPlanner(
+                DSB, mapper, tier,
+                spread_provider=SpreadProvider(default_spread=1),
+                dispatcher_factory=cold_dispatcher_factory(
+                    mapper, dispatcher_for))
+            return QueryEngine(DSB, TimeSeriesMemStore(), mapper,
+                               planner=planner)
+
+        qs_b = T0B // 1000 + 600
+        qe_b = int(grid_b[-1]) // 1000
+        Q_b = "sum by (_ns_)(m)"
+
+        def payload(res):
+            p = QueryEngine.to_prom_matrix(res)
+            p.pop("traceID", None)
+            return json.dumps(p, sort_keys=True)
+
+        def measure_qps(engine, dur_s, threads=8):
+            for _ in range(3):                   # warm every node's leaves
+                warm = engine.query_range(Q_b, qs_b, 300, qe_b)
+                assert warm.error is None, warm.error
+            stop = time.perf_counter() + dur_s
+            counts = [0] * threads
+            errs = []
+
+            def loop(i):
+                while time.perf_counter() < stop:
+                    r = engine.query_range(Q_b, qs_b, 300, qe_b)
+                    if r.error is not None or r.partial:
+                        errs.append(r.error or "partial")
+                        return
+                    counts[i] += 1
+
+            ths = [threading.Thread(target=loop, args=(i,))
+                   for i in range(threads)]
+            for t in ths:
+                t.start()
+            for t in ths:
+                t.join()
+            assert not errs, f"elastic load errors: {errs[:3]}"
+            return sum(counts) / dur_s
+
+        dur = 2.0 if quick else 5.0
+        spawn_cold("data0")
+        eng1 = make_engine()
+        ref1 = payload(eng1.query_range(Q_b, qs_b, 300, qe_b))
+        qps1 = measure_qps(eng1, dur)
+        spawn_cold("q1")
+        spawn_cold("q2")
+        eng3 = make_engine(query_nodes=("q1", "q2"))
+        ref3 = payload(eng3.query_range(Q_b, qs_b, 300, qe_b))
+        qps3 = measure_qps(eng3, dur)
+        elastic_identical = ref1 == ref3
+        ratio = qps3 / max(qps1, 1e-9)
+        # the 1.8x scale-out gate needs real parallel hardware: three
+        # node processes on a 1-core host share that core, so there the
+        # stage gates on no-collapse + bit-identity instead (the spread
+        # machinery is still exercised end-to-end)
+        cores = len(os.sched_getaffinity(0)) if hasattr(
+            os, "sched_getaffinity") else (os.cpu_count() or 1)
+        if cores >= 3:
+            elastic_gate = "qps_ratio>=1.8"
+            elastic_ok = ratio >= 1.8 and elastic_identical
+        else:
+            elastic_gate = f"no-collapse ({cores} core host)"
+            elastic_ok = ratio >= 0.5 and elastic_identical
+        for p in procs:
+            p.send_signal(signal.SIGKILL)
+        for p in procs:
+            p.wait(timeout=30)
+        procs.clear()
+
+        # ------------------------------------- (c) dead-store degrade
+        def make_local_engine():
+            mapper = ShardMapper(NSH)
+            for s in range(NSH):
+                mapper.update_from_event(
+                    ShardEvent("IngestionStarted", DSB, s, "local"))
+            # fresh tier + cache each time: nothing pre-paged, so the
+            # dead-store query MUST touch objectstore.get
+            tier, _remote = make_query_tier(store_b, DSB, NSH,
+                                            ttl_s=1_000.0)
+            planner = PersistedClusterPlanner(
+                DSB, mapper, tier,
+                spread_provider=SpreadProvider(default_spread=1))
+            return QueryEngine(DSB, TimeSeriesMemStore(), mapper,
+                               planner=planner)
+
+        healthy = make_local_engine().query_range(Q_b, qs_b, 300, qe_b)
+        assert healthy.error is None and not healthy.partial
+        eng_part, eng_strict = make_local_engine(), make_local_engine()
+        breakers.configure(failure_threshold=2, open_base_s=0.05,
+                           open_max_s=0.1, jitter=0.0)
+        try:
+            t_dead = time.perf_counter()
+            with faults.plan("objectstore.get", "error",
+                             first_k=1_000_000):
+                res_p = eng_part.query_range(
+                    Q_b, qs_b, 300, qe_b,
+                    PlannerParams(allow_partial_results=True))
+            dead_s = time.perf_counter() - t_dead
+            partial_flagged = res_p.error is None and bool(res_p.partial)
+            with faults.plan("objectstore.get", "error",
+                             first_k=1_000_000):
+                res_s = eng_strict.query_range(Q_b, qs_b, 300, qe_b)
+            strict_error = res_s.error is not None
+        finally:
+            faults.disarm()
+            breakers.configure()
+            breakers.reset()
+        bounded = dead_s < 10.0
+
+        gate_ok = bool(drill_identical and mount_ok
+                       and availability == 1.0
+                       and restored == 2 and uploaded == 2
+                       and n_segs == n_up == NSH * 2
+                       and elastic_ok
+                       and partial_flagged and strict_error and bounded)
+        return {
+            "metric": "objectstore_elastic_qps_ratio", "unit": "x",
+            "value": round(ratio, 2),
+            "objectstore_drill_identical": drill_identical,
+            "objectstore_drill_availability": round(availability, 3),
+            "objectstore_drill_restored_segments": restored,
+            "objectstore_drill_uploaded_segments": uploaded,
+            "objectstore_drill_wal_tail_batches": tail_acked,
+            "objectstore_elastic_qps_1node": round(qps1, 1),
+            "objectstore_elastic_qps_3node": round(qps3, 1),
+            "objectstore_elastic_qps_ratio": round(ratio, 2),
+            "objectstore_elastic_identical": elastic_identical,
+            "objectstore_elastic_cores": cores,
+            "objectstore_elastic_gate": elastic_gate,
+            "objectstore_deadstore_partial_flagged": partial_flagged,
+            "objectstore_deadstore_strict_error": strict_error,
+            "objectstore_deadstore_seconds": round(dead_s, 3),
+            "objectstore_gate_ok": gate_ok,
+            "series_per_shard": S_b, "platform": "cpu",
+        }
+    finally:
+        for p in procs:
+            try:
+                p.send_signal(signal.SIGKILL)
+                p.wait(timeout=10)
+            except Exception:  # noqa: BLE001 — already dead
+                pass
+        shutil.rmtree(root, ignore_errors=True)
+
+
 def measure_longrange(quick=False, series=None):
     """Historical-tier stage (ISSUE 8): multi-day persisted dataset,
     compacted into columnar segments, served through the cold DeviceMirror
@@ -3696,8 +4098,14 @@ def parse_args(argv=None):
                     choices=["", "chaos", "multichip", "wal", "longrange",
                              "selfmon", "replication", "ingesttrace",
                              "activequeries", "qos", "distexec", "index",
-                             "exprfuse", "devicetelem"],
-                    help="optional standalone stage: 'chaos' runs the "
+                             "exprfuse", "devicetelem", "objectstore"],
+                    help="optional standalone stage: 'objectstore' runs "
+                         "the disaggregated cold-tier stage (disk-kill "
+                         "drill with byte-identical rebuild from shared "
+                         "object store + WAL tail, elastic-read gate "
+                         ">=1.8x QPS with 2 stateless query nodes, "
+                         "dead-store flagged-partial degrade) and exits "
+                         "nonzero on a gate failure; 'chaos' runs the "
                          "failure-domain chaos harness (SIGKILL one of "
                          "three RF-2 data nodes mid-traffic; gates "
                          "availability=1.0 with zero partials and zero "
@@ -4629,6 +5037,17 @@ def main():
             sys.exit(1)
         print(json.dumps(r))
         sys.exit(0 if r.get("replication_gate_ok") else 1)
+    if args.stage == "objectstore":
+        try:
+            r = run_objectstore(quick=args.quick,
+                                series=args.series or None)
+        except Exception as e:  # noqa: BLE001 — loud one-line fail
+            print(json.dumps({
+                "metric": "objectstore_elastic_qps_ratio", "unit": "x",
+                "objectstore_error": f"{type(e).__name__}: {e}"[:300]}))
+            sys.exit(1)
+        print(json.dumps(r))
+        sys.exit(0 if r.get("objectstore_gate_ok") else 1)
     if args._worker:
         run_worker(args)
         return

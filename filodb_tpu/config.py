@@ -454,6 +454,32 @@ class StoreConfig:
 
 
 @dataclasses.dataclass
+class ObjectStoreConfig:
+    """Disaggregated cold tier (persist/objectstore.py): shared,
+    content-addressed segment objects + per-shard manifests, so a node's
+    disk is disposable and read capacity scales with stateless
+    query-only nodes (doc/operations.md disk-loss runbook)."""
+    # shared directory every node mounts (the S3/GCS stand-in); empty
+    # disables the tier entirely
+    root: str = ""
+    # boot-time manifest-driven restore: refetch every manifested
+    # segment the local disk is missing BEFORE serving (/ready holds
+    # 503 until the mount lands)
+    restore_on_boot: bool = True
+    # upload retry schedule (exponential backoff + full jitter through
+    # the objectstore.put/get/list fault points)
+    retry_base_s: float = 0.05
+    retry_max_s: float = 2.0
+    max_attempts: int = 6
+    # query-only nodes: manifest snapshot TTL (staleness feeds the
+    # `persistence` health verdict)
+    manifest_ttl_s: float = 5.0
+    # upload backlog age / manifest staleness past this degrades the
+    # `persistence` health subsystem
+    backlog_warn_s: float = 600.0
+
+
+@dataclasses.dataclass
 class IndexConfig:
     """Tag-index engine knobs (core/index.py bitmap postings)."""
     # per-tenant (_ws_) alive-series budget per shard, enforced at
@@ -521,6 +547,8 @@ class FilodbSettings:
     replication: ReplicationConfig = dataclasses.field(
         default_factory=ReplicationConfig)
     index: IndexConfig = dataclasses.field(default_factory=IndexConfig)
+    objectstore: ObjectStoreConfig = dataclasses.field(
+        default_factory=ObjectStoreConfig)
     shard_key_level_metrics: bool = True
     quota_default: int = 2_000_000_000
     reassignment_min_interval_s: float = 2 * 3600.0
@@ -559,7 +587,8 @@ class FilodbSettings:
                              ("ingest", self.ingest),
                              ("selfmon", self.selfmon),
                              ("replication", self.replication),
-                             ("index", self.index)):
+                             ("index", self.index),
+                             ("objectstore", self.objectstore)):
             for k, v in (raw.pop(section, None) or {}).items():
                 _set_field(obj, k, v, f"{source}: {section}.{k}")
         if "spread_assignment" in raw:
@@ -606,7 +635,7 @@ class FilodbSettings:
             parsed = _parse_scalar(val)
             for section in ("query_", "store_", "breaker_", "rules_",
                             "wal_", "ingest_", "selfmon_", "replication_",
-                            "index_"):
+                            "index_", "objectstore_"):
                 if rest.startswith(section):
                     overlay.setdefault(section[:-1], {})[
                         rest[len(section):]] = parsed

@@ -90,6 +90,11 @@ class ShardMapper:
         # ordered NON-primary owners per shard (assignment-list tail)
         self.replicas: List[List[str]] = [[] for _ in range(num_shards)]
         self.replica_statuses: Dict[Tuple[int, str], ShardStatus] = {}
+        # stateless query-only nodes (persist/objectstore.py): own ZERO
+        # shards, serve COLD leaves from the shared object tier — extra
+        # query-capable targets for the cold-leaf failover walk, never
+        # ingest/upload owners
+        self.query_nodes: List[str] = []
 
     # ------------------------------------------------------------ shard math
 
@@ -196,6 +201,19 @@ class ShardMapper:
             self.replicas[shard].remove(node)
         self.replica_statuses.pop((shard, node), None)
 
+    def register_query_node(self, node: str) -> None:
+        """Register a stateless query-only node (cold-capable dispatch
+        target; owns no shards).  Idempotent."""
+        if node not in self.query_nodes:
+            self.query_nodes.append(node)
+            from filodb_tpu.utils.events import journal
+            journal.emit("query_node_registered", subsystem="cluster",
+                         node=node)
+
+    def unregister_query_node(self, node: str) -> None:
+        if node in self.query_nodes:
+            self.query_nodes.remove(node)
+
     def owners(self, shard: int) -> List[str]:
         """Ordered assignment list: primary first, then replicas."""
         head = [self.nodes[shard]] if self.nodes[shard] is not None else []
@@ -255,6 +273,11 @@ class ShardMapper:
                 "liveOwners": len(self.live_owners(s)),
             })
         return out
+
+    def query_node_table(self) -> List[Dict]:
+        """Query-only node rows for GET /admin/shards."""
+        return [{"node": n, "role": "query-only"}
+                for n in self.query_nodes]
 
 
 @dataclasses.dataclass(frozen=True)

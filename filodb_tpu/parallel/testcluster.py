@@ -201,6 +201,83 @@ def make_fanout_cluster(batches: Iterable = (), num_shards: int = 4,
     return TwoNodeCluster(engine, mapper, stores, owner, servers, truth)
 
 
+@dataclasses.dataclass
+class ColdReadCluster:
+    """Coordinator + N query-capable nodes over ONE shared object store
+    (persist/objectstore.py): the data node nominally owns every shard,
+    query-only nodes own NOTHING — all of them serve cold leaves from
+    the shared tier, walked round-robin by the cold dispatcher.  The
+    shared fixture of the query-only-node tests AND the `bench.py
+    objectstore` elastic-read gate."""
+    dataset: str
+    engine: QueryEngine
+    mapper: ShardMapper
+    object_store: "object"
+    tier: "object"                        # object-store-backed query tier
+    remote_store: "object"                # RemoteSegmentStore behind it
+    servers: Dict[str, NodeQueryServer]
+    query_nodes: tuple
+
+    def stop(self) -> None:
+        for srv in self.servers.values():
+            try:
+                srv.stop()
+            except OSError:
+                pass
+
+
+def make_cold_read_cluster(object_store, num_shards: int = 4,
+                           dataset: str = "prometheus",
+                           data_nodes: Iterable = ("data0",),
+                           query_nodes: Iterable = (),
+                           schemas=None) -> ColdReadCluster:
+    """Cold-read cluster over a pre-populated shared object store: call
+    after segments + manifests are uploaded.  Every node (data-owning or
+    query-only) is an in-process NodeQueryServer with an EMPTY memstore;
+    decoded cold leaves rebind to the object-store query tier through
+    the per-process tier registry, so this models N stateless readers
+    paging one shared tier.  Query-only nodes register on the mapper
+    (`register_query_node`) and the persisted planner routes through
+    `cold_dispatcher_factory` — round-robin across all of them."""
+    from filodb_tpu.persist.objectstore import make_query_tier
+    from filodb_tpu.query.planners import PersistedClusterPlanner
+    from filodb_tpu.replication.failover import cold_dispatcher_factory
+    data_nodes = list(data_nodes)
+    query_nodes = tuple(query_nodes)
+    mapper = ShardMapper(num_shards)
+    spread = SpreadProvider(default_spread=1)
+    for s in range(num_shards):
+        mapper.update_from_event(ShardEvent(
+            "IngestionStarted", dataset, s,
+            data_nodes[s % len(data_nodes)]))
+    for q in query_nodes:
+        mapper.register_query_node(q)
+    stores = {n: TimeSeriesMemStore()
+              for n in list(data_nodes) + list(query_nodes)}
+    servers = {n: NodeQueryServer(st).start() for n, st in stores.items()}
+    dispatchers: Dict[str, RemoteNodeDispatcher] = {}
+
+    def dispatcher_for(node: str) -> RemoteNodeDispatcher:
+        d = dispatchers.get(node)
+        if d is None:
+            dispatchers[node] = d = RemoteNodeDispatcher(
+                *servers[node].address)
+        return d
+
+    # built LAST on purpose: the per-process tier registry resolves
+    # decoded cold leaves to the most recent tier for the dataset, and
+    # this in-process fixture wants that to be the object-store one
+    tier, remote = make_query_tier(object_store, dataset, num_shards,
+                                   schemas=schemas)
+    planner = PersistedClusterPlanner(
+        dataset, mapper, tier, spread_provider=spread,
+        dispatcher_factory=cold_dispatcher_factory(mapper, dispatcher_for))
+    engine = QueryEngine(dataset, TimeSeriesMemStore(), mapper,
+                         planner=planner)
+    return ColdReadCluster(dataset, engine, mapper, object_store, tier,
+                           remote, servers, query_nodes)
+
+
 def make_two_node_cluster(batches: Iterable = (), num_shards: int = 4,
                           dataset: str = "prometheus",
                           default_spread: int = 1,

@@ -1,3 +1,14 @@
 from filodb_tpu.persist.localstore import LocalDiskColumnStore, LocalDiskMetaStore
+from filodb_tpu.persist.objectstore import (LocalObjectStore,
+                                            ObjectStoreCorruption,
+                                            ObjectStoreError,
+                                            ObjectStoreUnavailable,
+                                            RemoteSegmentStore,
+                                            SegmentUploader,
+                                            restore_from_objectstore)
 
-__all__ = ["LocalDiskColumnStore", "LocalDiskMetaStore"]
+__all__ = ["LocalDiskColumnStore", "LocalDiskMetaStore",
+           "LocalObjectStore", "ObjectStoreError",
+           "ObjectStoreUnavailable", "ObjectStoreCorruption",
+           "SegmentUploader", "RemoteSegmentStore",
+           "restore_from_objectstore"]

@@ -891,6 +891,21 @@ class SelectPersistedSegmentsExec(MultiSchemaPartitionsExec):
                 f"{self.chunk_end_ms}), filters=[{fs}]")
 
     def _do_execute(self, source) -> QueryResultLike:
+        # disaggregated cold tier (persist/objectstore.py): a dead or
+        # corrupt object store is a typed shard_unavailable — the leaf's
+        # parent drops it under the partial-results gate (flagged
+        # partial), exactly like a dead peer, never a hang
+        try:
+            return self._cold_execute(source)
+        except Exception as e:  # noqa: BLE001 — re-raise non-store errors
+            from filodb_tpu.persist.objectstore import ObjectStoreError
+            if not isinstance(e, ObjectStoreError):
+                raise
+            raise QueryError(
+                "shard_unavailable",
+                f"shard {self.shard}: cold tier unavailable ({e})")
+
+    def _cold_execute(self, source) -> QueryResultLike:
         stats = QueryStats(shards_queried=1)
         segs = self.tier.covering(self.shard, self.chunk_start_ms,
                                   self.chunk_end_ms, self.schema)

@@ -40,14 +40,17 @@ from filodb_tpu.replication.service import (ReplicaClient,  # noqa: F401
 from filodb_tpu.replication.replicator import (ReplicationManager,  # noqa: F401
                                                ReplicateResult)
 from filodb_tpu.replication.catchup import (CatchupStats,  # noqa: F401
-                                            catchup_shards)
+                                            catchup_shards,
+                                            rebuild_node)
 from filodb_tpu.replication.failover import (  # noqa: F401
-    ReplicaFailoverDispatcher, failover_dispatcher_factory)
+    ReplicaFailoverDispatcher, cold_dispatcher_factory,
+    failover_dispatcher_factory)
 from filodb_tpu.replication.handoff import (HandoffCoordinator,  # noqa: F401
                                             HandoffError)
 
 __all__ = ["ReplicaClient", "ReplicationServer", "ReplicationError",
            "ReplicationManager", "ReplicateResult", "CatchupStats",
-           "catchup_shards", "ReplicaFailoverDispatcher",
+           "catchup_shards", "rebuild_node",
+           "ReplicaFailoverDispatcher", "cold_dispatcher_factory",
            "failover_dispatcher_factory", "HandoffCoordinator",
            "HandoffError"]

@@ -164,3 +164,31 @@ def catchup_shards(client, dataset: str, memstore,
                  elapsed_s=round(stats.elapsed_s, 3))
     job.set_progress(f"caught up to seq {stats.last_seq}")
     return stats
+
+
+def rebuild_node(object_store, segment_store, client, dataset: str,
+                 memstore, num_shards: int,
+                 shards: Optional[Iterable[int]] = None,
+                 since: Optional[Dict[int, int]] = None,
+                 node: str = "local",
+                 scratch_dir: Optional[str] = None):
+    """Disk-loss rebuild: the replacement node recovers its COLD tier
+    from the shared object store (manifest-driven,
+    persist/objectstore.restore_from_objectstore) and its RAW edge from
+    a live peer's WAL through the ordinary catch-up path — nothing but
+    manifests + WAL tail, which is the whole durability claim of the
+    disaggregated tier.  `client` may be None (single-node deployments
+    restore the tail from their own surviving WAL via boot replay).
+    Returns (RestoreStats, CatchupStats)."""
+    from filodb_tpu.persist.objectstore import restore_from_objectstore
+    rstats = restore_from_objectstore(object_store, segment_store,
+                                      dataset, num_shards, node=node)
+    cstats = CatchupStats()
+    if client is not None:
+        cstats = catchup_shards(client, dataset, memstore, shards=shards,
+                                since=since, node=node,
+                                scratch_dir=scratch_dir)
+    journal.emit("node_rebuilt", subsystem="replication", dataset=dataset,
+                 node=node, segments_fetched=rstats.segments_fetched,
+                 wal_records=cstats.records, wal_samples=cstats.samples)
+    return rstats, cstats

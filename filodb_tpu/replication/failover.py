@@ -41,12 +41,22 @@ class ReplicaFailoverDispatcher(PlanDispatcher):
     def __init__(self, targets: Sequence[Tuple[str, PlanDispatcher]],
                  shard: Optional[int] = None,
                  all_nodes: Optional[Sequence[str]] = None,
-                 shuffle_k: Optional[int] = None):
+                 shuffle_k: Optional[int] = None,
+                 rotate: bool = False):
+        import itertools
         self.targets = list(targets)
         self.shard = shard
         self.all_nodes = list(all_nodes) if all_nodes else \
             [n for n, _ in self.targets]
         self.shuffle_k = shuffle_k
+        # cold-leaf load spreading (persist/objectstore.py query-only
+        # nodes): every target can serve the leaf from the shared tier,
+        # so successive dispatches rotate the start of the walk —
+        # elastic read capacity actually takes load instead of idling as
+        # a fallback.  Failover semantics unchanged: the rest of the
+        # rotated list still walks on shard_unavailable.
+        self.rotate = rotate
+        self._rr = itertools.count()
 
     def pushdown_target(self):
         """Node address for aggregation pushdown (query/pushdown.py):
@@ -60,17 +70,21 @@ class ReplicaFailoverDispatcher(PlanDispatcher):
         return fn() if fn is not None else None
 
     def _walk_order(self, plan) -> Sequence[Tuple[str, PlanDispatcher]]:
+        base = self.targets
+        if self.rotate and len(base) > 1:
+            k0 = next(self._rr) % len(base)
+            base = base[k0:] + base[:k0]
         ws = getattr(getattr(plan, "ctx", None), "tenant_ws", "")
         k = self.shuffle_k
         if k is None:
             from filodb_tpu.config import settings
             k = settings().query.shuffle_shard_factor
-        if not ws or k <= 0 or len(self.targets) < 2:
-            return self.targets
+        if not ws or k <= 0 or len(base) < 2:
+            return base
         from filodb_tpu.query.qos import shuffle_shard_nodes
         preferred = set(shuffle_shard_nodes(ws, self.all_nodes, k))
-        ordered = ([t for t in self.targets if t[0] in preferred]
-                   + [t for t in self.targets if t[0] not in preferred])
+        ordered = ([t for t in base if t[0] in preferred]
+                   + [t for t in base if t[0] not in preferred])
         if ordered[0][0] != self.targets[0][0]:
             from filodb_tpu.utils.metrics import registry
             registry.counter("query_shuffle_shard_routed",
@@ -153,5 +167,53 @@ def failover_dispatcher_factory(
         return ReplicaFailoverDispatcher(targets, shard=shard,
                                          all_nodes=all_nodes,
                                          shuffle_k=shuffle_k)
+
+    return factory
+
+
+def cold_dispatcher_factory(
+        mapper, dispatcher_for: Callable[[str], PlanDispatcher],
+        local_node: Optional[str] = None,
+        local_dispatcher: Optional[PlanDispatcher] = None,
+        shuffle_k: Optional[int] = None
+        ) -> Callable[[int], Optional[PlanDispatcher]]:
+    """`dispatcher_factory(shard)` for the PERSISTED (cold) planner: the
+    shared object tier means ANY query-capable node can serve a cold
+    leaf, so targets are the shard's query-ready owners PLUS every
+    registered query-only node (`mapper.query_nodes`), walked
+    round-robin — adding stateless query nodes actually spreads cold
+    read load instead of idling as fallbacks.  Failover semantics are
+    the ordinary owner walk: `shard_unavailable` tries the next target,
+    and only when EVERY target is dead does the partial-results
+    machinery engage."""
+    from filodb_tpu.query.execbase import InProcessPlanDispatcher
+
+    def factory(shard: int) -> Optional[PlanDispatcher]:
+        primary = mapper.node_for_shard(shard)
+        owners = ([primary] if primary is not None else []) + [
+            n for n in mapper.replicas[shard]
+            if mapper.owner_status(shard, n).query_ready]
+        extras = [n for n in getattr(mapper, "query_nodes", [])
+                  if n not in owners]
+        nodes = owners + extras
+        if not nodes:
+            return None
+        targets: List[Tuple[str, PlanDispatcher]] = []
+        for node in nodes:
+            if local_node is not None and node == local_node:
+                targets.append((node, local_dispatcher
+                                or InProcessPlanDispatcher()))
+            else:
+                targets.append((node, dispatcher_for(node)))
+        if len(targets) == 1:
+            return targets[0][1]
+        all_nodes = sorted(
+            {n for n in mapper.nodes if n is not None}
+            | {n for repls in mapper.replicas for n in repls}
+            | set(extras))
+        return ReplicaFailoverDispatcher(targets, shard=shard,
+                                         all_nodes=all_nodes,
+                                         shuffle_k=shuffle_k,
+                                         rotate=True)
 
     return factory

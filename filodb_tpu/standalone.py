@@ -156,6 +156,19 @@ class FiloServer:
             from filodb_tpu.core.devicecache import ColdSegmentCache
             self.cold_cache = ColdSegmentCache(
                 self.config.store.device_mirror_cold_limit_bytes)
+        # disaggregated cold tier (persist/objectstore.py): when a shared
+        # object-store root is configured next to a disk-backed segment
+        # tier, compaction uploads content-addressed segments there,
+        # retention gates on upload acks, and boot restores the local
+        # segment dir from the manifests (doc/operations.md disk-loss
+        # runbook)
+        self.object_store = None
+        self.uploaders: Dict[str, object] = {}
+        if self.config.objectstore.root and self.cold_cache is not None \
+                and getattr(self.column_store, "root", None):
+            from filodb_tpu.persist.objectstore import LocalObjectStore
+            self.object_store = LocalObjectStore(
+                self.config.objectstore.root)
         # observability singletons take their knobs from THIS server's
         # settings: the slow-query flight recorder (ring size, JSONL
         # sink) and the per-tenant usage window (utils/slowlog, usage)
@@ -234,6 +247,13 @@ class FiloServer:
             _metrics.NODE_NAME = node_name
         for dc in self.datasets:
             self._setup_dataset(dc)
+        if self.uploaders:
+            # the `persistence` health subsystem: upload backlog age +
+            # breaker state per dataset, worst-wins into the verdict
+            from filodb_tpu.persist.objectstore import persistence_probe
+            self.health.probes["persistence"] = persistence_probe(
+                self.uploaders,
+                backlog_warn_s=self.config.objectstore.backlog_warn_s)
         first = self.datasets[0].name
         self.api = PromHttpApi(self.engines, gateways=self.gateways,
                                shard_mappers=self.mappers,
@@ -414,7 +434,7 @@ class FiloServer:
         tier = None
         if self.cold_cache is not None \
                 and getattr(self.column_store, "root", None):
-            tier = self._make_persisted_tier(dc, spread)
+            tier = self._make_persisted_tier(dc, spread, mapper)
             from filodb_tpu.query.planners import PersistedClusterPlanner
             persisted_planner = PersistedClusterPlanner(
                 dc.name, mapper, tier, spread_provider=spread)
@@ -480,13 +500,49 @@ class FiloServer:
             raw_shard.shard_downsampler = dsr
         return DownsampleClusterPlanner(ds_store, mapper)
 
-    def _make_persisted_tier(self, dc: DatasetConfig, spread):
+    def _make_persisted_tier(self, dc: DatasetConfig, spread, mapper=None):
         """Segment store + cold tier + compaction job for one dataset
-        (historical tier, doc/operations.md compaction runbook)."""
+        (historical tier, doc/operations.md compaction runbook).  With a
+        shared object store configured, this also mounts the shard
+        manifests (restoring missing segments first when
+        objectstore.restore_on_boot) and hangs a SegmentUploader off the
+        compaction scheduler — /ready answers 503 until the mount
+        lands."""
         from filodb_tpu.persist.compactor import (CompactionScheduler,
                                                   SegmentCompactor)
         from filodb_tpu.persist.segments import PersistedTier, SegmentStore
         seg_store = SegmentStore(self.column_store.root)
+        uploader = None
+        if self.object_store is not None:
+            from filodb_tpu.persist.objectstore import (
+                ObjectStoreError, SegmentUploader, restore_from_objectstore)
+            from filodb_tpu.utils.events import journal
+            oc = self.config.objectstore
+            self.health.note_manifest_mount(dc.name, False)
+            uploader = SegmentUploader(
+                self.object_store, seg_store, dc.name, dc.num_shards,
+                node=self.node_name, mapper=mapper,
+                retry_base_s=oc.retry_base_s, retry_max_s=oc.retry_max_s,
+                max_attempts=oc.max_attempts)
+            self.uploaders[dc.name] = uploader
+            # durability ordering: every raw-chunk prune for this dataset
+            # clamps through the upload-ack gate, whoever asks for it
+            uploader.install_prune_guard(self.column_store)
+            try:
+                if oc.restore_on_boot:
+                    restore_from_objectstore(
+                        self.object_store, seg_store, dc.name,
+                        dc.num_shards, retry_base_s=oc.retry_base_s,
+                        retry_max_s=oc.retry_max_s,
+                        max_attempts=oc.max_attempts, node=self.node_name)
+                uploader.mount()
+                self.health.note_manifest_mount(dc.name, True)
+            except ObjectStoreError as e:
+                # the mount stays pending, so /ready keeps answering 503
+                # — a node that cannot see the shared tier must not serve
+                journal.emit("objectstore_mount_failed",
+                             subsystem="persistence", dataset=dc.name,
+                             node=self.node_name, error=str(e)[:200])
         tier = PersistedTier(seg_store, dc.name, dc.num_shards,
                              self.cold_cache,
                              schemas=self.memstore.schemas)
@@ -500,7 +556,8 @@ class FiloServer:
             compactor,
             interval_s=self.config.store.segment_compact_interval_ms
             / 1000.0,
-            retain_raw_ms=self.config.store.segment_retain_raw_ms)
+            retain_raw_ms=self.config.store.segment_retain_raw_ms,
+            uploader=uploader)
         return tier
 
     def _earliest_raw_time(self, dataset: str) -> int:

@@ -30,6 +30,13 @@ Catalog (the production call sites):
                         must fail every writer waiting on the group)
     wal.replay        — per decoded record during restart replay
                         (wal/replay.py; corrupt-mid-log chaos)
+    objectstore.put   — shared cold-tier object upload, before the
+                        bytes land (persist/objectstore.py; upload
+                        retry/backoff + breaker chaos)
+    objectstore.get   — object fetch (corrupt plans mutate the payload:
+                        content-hash verification must catch it)
+    objectstore.list  — manifest/object listing (a dead store must
+                        degrade cold scans to flagged partials)
 
 Plan kinds and how they surface at the call site:
 
@@ -67,6 +74,7 @@ POINTS = frozenset({
     "transport.send", "transport.recv", "flush.persist", "device.upload",
     "ingest.batch", "cluster.heartbeat", "ruler.notify",
     "wal.append", "wal.fsync", "wal.replay",
+    "objectstore.put", "objectstore.get", "objectstore.list",
 })
 
 KINDS = frozenset({"error", "delay", "drop", "corrupt"})

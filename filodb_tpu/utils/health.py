@@ -77,6 +77,13 @@ class HealthEvaluator:
         # a {"status": ...} dict (lets tests and future subsystems plug
         # in without touching the evaluator)
         self.probes: Dict[str, Callable[[], dict]] = {}
+        # disaggregated cold tier (persist/objectstore.py): dataset ->
+        # manifest mount completed.  A node with the shared tier
+        # configured — data node restoring on boot, or a stateless
+        # query-only node — answers /ready 503 until every mount lands:
+        # serving cold ranges before the catalog is readable would
+        # return silently-short "full" results
+        self._manifest_mounts: Dict[str, bool] = {}
 
     # ------------------------------------------------------------ phases
 
@@ -104,6 +111,20 @@ class HealthEvaluator:
     def wal_summary(self) -> Dict[str, dict]:
         with self._lock:
             return {ds: dict(ent) for ds, ent in self._wal.items()}
+
+    # ------------------------------------------------------- persistence
+
+    def note_manifest_mount(self, dataset: str, mounted: bool) -> None:
+        """Cold-tier manifest mount progress (persist/objectstore.py):
+        registered False when the shared tier is configured, flipped
+        True once the mount/restore lands — /ready gates on it."""
+        with self._lock:
+            self._manifest_mounts[dataset] = bool(mounted)
+
+    def pending_manifest_mounts(self) -> List[str]:
+        with self._lock:
+            return sorted(ds for ds, ok in self._manifest_mounts.items()
+                          if not ok)
 
     # --------------------------------------------------------- subsystems
 
@@ -319,4 +340,8 @@ class HealthEvaluator:
         for ds, ent in wv["datasets"].items():
             if ent["enabled"] and not ent["replayDone"]:
                 return False, f"WAL replay pending for {ds!r}"
+        pending = self.pending_manifest_mounts()
+        if pending:
+            return False, ("cold-tier manifest mount pending for "
+                           + ",".join(repr(d) for d in pending))
         return True, "serving"

@@ -1,0 +1,527 @@
+"""Disaggregated cold tier (persist/objectstore.py): content-addressed
+segment objects + dedup, manifest atomic swap + torn-write recovery,
+upload retry/backoff through the objectstore.* fault points, the
+prune-blocked-on-upload durability gate, disk-kill rebuild
+bit-identity, stateless query-only nodes, and the dead-store ->
+flagged-partial degrade."""
+import os
+import shutil
+
+import numpy as np
+import pytest
+
+from filodb_tpu.core.devicecache import ColdSegmentCache
+from filodb_tpu.core.memstore import TimeSeriesMemStore
+from filodb_tpu.core.partkey import PartKey
+from filodb_tpu.parallel.breaker import breakers
+from filodb_tpu.parallel.shardmapper import (ShardEvent, ShardMapper,
+                                             SpreadProvider)
+from filodb_tpu.persist.compactor import SegmentCompactor
+from filodb_tpu.persist.localstore import (LocalDiskColumnStore,
+                                           LocalDiskMetaStore)
+from filodb_tpu.persist.objectstore import (LocalObjectStore,
+                                            ObjectStoreCorruption,
+                                            ObjectStoreUnavailable,
+                                            RemoteSegmentStore,
+                                            SegmentUploader, content_key,
+                                            restore_from_objectstore)
+from filodb_tpu.persist.segments import PersistedTier, SegmentStore
+from filodb_tpu.query.engine import QueryEngine
+from filodb_tpu.query.planners import PersistedClusterPlanner
+from filodb_tpu.utils.events import journal
+from filodb_tpu.utils.faults import faults
+
+DS = "obj-test"
+WINDOW = 3600 * 1000
+T0 = 1_600_000_000_000 - (1_600_000_000_000 % WINDOW)
+INTERVAL = 60_000
+N_WINDOWS = 2
+NS = N_WINDOWS * WINDOW // INTERVAL
+S = 4
+
+
+@pytest.fixture(autouse=True)
+def _fresh_failure_state():
+    """Faults + breakers are process-global; every test starts closed and
+    disarmed (a breaker left open by one test must not fail-fast the
+    next)."""
+    faults.disarm()
+    breakers.configure(failure_threshold=1000, open_base_s=0.01,
+                       open_max_s=0.05, jitter=0.0)
+    breakers.reset()
+    yield
+    faults.disarm()
+    breakers.configure()
+    breakers.reset()
+
+
+def _pks():
+    return [PartKey("m", (("inst", f"i{i}"), ("_ws_", "w"), ("_ns_", "n")))
+            for i in range(S)]
+
+
+def _grid():
+    return T0 + np.arange(NS, dtype=np.int64) * INTERVAL
+
+
+def _vals():
+    # small integers: exact in f32, so restored/remote reads must agree
+    # BIT-identically with the pre-kill baseline
+    return (np.arange(S)[:, None] * 50.0 + (np.arange(NS) % 11)[None, :])
+
+
+def _disk_setup(tmp_path):
+    """Disk-backed store with two closed windows flushed."""
+    cs = LocalDiskColumnStore(str(tmp_path))
+    ms = TimeSeriesMemStore(column_store=cs,
+                            meta_store=LocalDiskMetaStore(str(tmp_path)))
+    shard = ms.setup(DS, 0)
+    ts_grid, vals = _grid(), _vals()
+    shard.ingest_columns("gauge", _pks(),
+                         np.broadcast_to(ts_grid, (S, NS)),
+                         {"value": vals})
+    shard.flush_all_groups()
+    return cs, ms, shard, ts_grid, vals
+
+
+def _compacted(tmp_path):
+    cs, ms, shard, ts_grid, vals = _disk_setup(tmp_path)
+    seg_store = SegmentStore(str(tmp_path))
+    comp = SegmentCompactor(cs, seg_store, DS, 1, window_ms=WINDOW,
+                            closed_lag_ms=0)
+    now = int(ts_grid[-1]) + 10 * WINDOW
+    assert comp.compact_all(now_ms=now) == N_WINDOWS
+    return cs, seg_store, comp, ts_grid, vals, now
+
+
+def _obj_store(tmp_path, name="shared"):
+    return LocalObjectStore(str(tmp_path / "objstore"), name=name)
+
+
+# ----------------------------------------------------- content addressing
+
+
+def test_content_address_roundtrip_and_dedup(tmp_path):
+    store = _obj_store(tmp_path)
+    key, wrote = store.put_object(b"segment payload")
+    assert wrote and key == content_key(b"segment payload")
+    # second put of identical bytes is a dedup hit, not a rewrite
+    key2, wrote2 = store.put_object(b"segment payload")
+    assert key2 == key and not wrote2
+    assert store.get_object(key) == b"segment payload"
+    assert store.list("objects") == [key]
+
+    # flip one byte in the stored object: the content hash IS the key,
+    # so the corruption is detected and never served as data
+    path = store._path(key)
+    raw = bytearray(open(path, "rb").read())
+    raw[0] ^= 0xFF
+    open(path, "wb").write(bytes(raw))
+    with pytest.raises(ObjectStoreCorruption):
+        store.get_object(key)
+
+
+def test_get_missing_key_is_keyerror_not_store_death(tmp_path):
+    store = _obj_store(tmp_path)
+    with pytest.raises(KeyError):
+        store.get("objects/ab/absent")
+    assert store.breaker.state == "closed"
+
+
+# ----------------------------------------------------- manifest swapping
+
+
+def _upload_all(tmp_path, store=None):
+    cs, seg_store, comp, ts_grid, vals, now = _compacted(tmp_path)
+    store = store or _obj_store(tmp_path)
+    up = SegmentUploader(store, seg_store, DS, 1, retry_base_s=0.001,
+                         retry_max_s=0.01)
+    up.mount()
+    return cs, seg_store, comp, store, up, ts_grid, vals, now
+
+
+def test_manifest_atomic_swap_and_torn_write_recovery(tmp_path):
+    cs, seg_store, comp, store, up, *_ = _upload_all(tmp_path)
+    assert up.run_once() == N_WINDOWS
+    man1 = store.load_manifest(DS, 0)
+    assert len(man1.entries) == N_WINDOWS and man1.generation == 1
+
+    # force a second generation (recompaction drift): bump and swap
+    man2 = store.load_manifest(DS, 0)
+    man2.generation += 1
+    store.put_manifest(man2)        # demotes gen-1 frame to .prev
+
+    # tear the CURRENT manifest mid-frame: reader falls back to .prev,
+    # journals the recovery — never silence, never garbage
+    path = store._path(f"manifests/{DS}/shard-0")
+    raw = open(path, "rb").read()
+    open(path, "wb").write(raw[: len(raw) // 2])
+    seq0 = journal.next_seq - 1
+    rec = store.load_manifest(DS, 0)
+    assert rec.generation == man1.generation
+    assert {e.object_key for e in rec.entries.values()} \
+        == {e.object_key for e in man1.entries.values()}
+    kinds = [e["kind"] for e in journal.since(seq0)]
+    assert "manifest_recovered" in kinds
+
+
+# ----------------------------------------------------- upload + retries
+
+
+def test_upload_retries_through_fault_points_then_succeeds(tmp_path):
+    cs, seg_store, comp, store, up, *_ = _upload_all(tmp_path)
+    with faults.plan("objectstore.put", "error", first_k=2):
+        n = up.run_once()
+    assert n == N_WINDOWS
+    assert up.retries >= 2 and up.failures == 0
+    # uploaded bytes hash-verify straight back out of the store
+    man = store.load_manifest(DS, 0)
+    for ent in man.entries.values():
+        assert len(store.get_object(ent.object_key)) == ent.size_bytes
+
+
+def test_upload_failure_past_budget_keeps_backlog(tmp_path):
+    cs, seg_store, comp, store, up, *_ = _upload_all(tmp_path)
+    up.max_attempts = 2
+    with faults.plan("objectstore.put", "error", first_k=10_000):
+        assert up.run_once() == 0
+    assert up.failures >= 1
+    assert up.backlog_segments() == N_WINDOWS
+    assert up.backlog_age_s() > 0.0
+    assert up.probe()["status"] == "degraded"
+    # store heals: the next pass drains the backlog
+    assert up.run_once() == N_WINDOWS
+    assert up.backlog_segments() == 0
+    assert up.probe()["status"] == "ok"
+
+
+def test_replica_dedup_one_uploader_per_rf_group(tmp_path):
+    cs, seg_store, comp, ts_grid, vals, now = _compacted(tmp_path)
+    store = _obj_store(tmp_path)
+    mapper = ShardMapper(1)
+    mapper.update_from_event(ShardEvent("IngestionStarted", DS, 0, "A"))
+    mapper.register_replica(0, "B")
+    up_a = SegmentUploader(store, seg_store, DS, 1, node="A", mapper=mapper)
+    up_b = SegmentUploader(store, seg_store, DS, 1, node="B", mapper=mapper)
+    up_c = SegmentUploader(store, seg_store, DS, 1, node="C", mapper=mapper)
+    assert up_a.should_upload(0)          # first live owner
+    assert not up_b.should_upload(0)      # replica defers
+    assert not up_c.should_upload(0)      # non-owner never uploads
+    up_b.mount()
+    assert up_b.run_once() == 0
+    up_a.mount()
+    assert up_a.run_once() == N_WINDOWS
+    # even a RACE converges on one copy: B force-syncing the same shard
+    # writes zero new objects (content addressing dedupes)
+    n_objects = len(store.list("objects"))
+    up_b2 = SegmentUploader(store, seg_store, DS, 1, node="B")
+    up_b2.mount()
+    up_b2.run_once()
+    assert len(store.list("objects")) == n_objects
+
+
+# ------------------------------------------------- durability ordering
+
+
+def test_retention_blocked_until_upload_acked(tmp_path):
+    cs, seg_store, comp, store, up, ts_grid, vals, now = \
+        _upload_all(tmp_path)
+    comp.uploader = up
+    up.install_prune_guard(cs)
+    before = cs.num_chunksets(DS, 0)
+    assert before > 0
+    # nothing uploaded yet: retention must refuse to prune ANY covered
+    # window — a disk loss after prune would otherwise lose acked data
+    seq0 = journal.next_seq - 1
+    assert comp.enforce_retention(retain_raw_ms=1, now_ms=now) == 0
+    assert cs.num_chunksets(DS, 0) == before
+    kinds = [e["kind"] for e in journal.since(seq0)]
+    assert "retention_blocked_on_upload" in kinds
+    # the guard holds even for DIRECT column-store prunes (any code path)
+    assert cs.prune_chunks_before(DS, 0, int(ts_grid[-1]) + WINDOW) == 0
+    # upload-acked: the same retention pass now prunes everything
+    assert up.run_once() == N_WINDOWS
+    assert comp.enforce_retention(retain_raw_ms=1, now_ms=now) == before
+    assert cs.num_chunksets(DS, 0) == 0
+
+
+# ----------------------------------------------------- disk-kill rebuild
+
+
+def _query_engine_over(seg_store, schemas=None):
+    mapper = ShardMapper(1)
+    mapper.update_from_event(ShardEvent("IngestionStarted", DS, 0, "n"))
+    tier = PersistedTier(seg_store, DS, 1,
+                         ColdSegmentCache(64 << 20, use_placer=False),
+                         schemas=schemas)
+    planner = PersistedClusterPlanner(DS, mapper, tier,
+                                      spread_provider=SpreadProvider(
+                                          default_spread=1))
+    return QueryEngine(DS, TimeSeriesMemStore(), mapper, planner=planner)
+
+
+def _series_map(res):
+    assert res.error is None, res.error
+    return {k: (tuple(w.tolist()), tuple(v.tolist()))
+            for k, w, v in res.series()}
+
+
+def test_disk_kill_rebuild_is_bit_identical(tmp_path):
+    cs, seg_store, comp, store, up, ts_grid, vals, now = \
+        _upload_all(tmp_path)
+    assert up.run_once() == N_WINDOWS
+    start_s, end_s = int(ts_grid[0]) // 1000 + 600, int(ts_grid[-1]) // 1000
+    baseline = _series_map(_query_engine_over(seg_store).query_range(
+        "sum(m)", start_s, 300, end_s))
+    assert baseline
+
+    # the disk dies: every local segment file is gone
+    shutil.rmtree(seg_store.seg_dir(DS, 0))
+    assert seg_store.list(DS, 0) == []
+
+    # manifest-driven rebuild from the shared store alone
+    stats = restore_from_objectstore(store, seg_store, DS, 1)
+    assert stats.segments_fetched == N_WINDOWS
+    metas = seg_store.list(DS, 0)
+    assert len(metas) == N_WINDOWS
+    rebuilt = _series_map(_query_engine_over(seg_store).query_range(
+        "sum(m)", start_s, 300, end_s))
+    assert rebuilt == baseline
+
+    # idempotent: a second restore fetches nothing (everything present)
+    stats2 = restore_from_objectstore(store, seg_store, DS, 1)
+    assert stats2.segments_fetched == 0
+    assert stats2.segments_present == N_WINDOWS
+
+
+# ----------------------------------------------------- query-only nodes
+
+
+def test_query_only_node_serves_cold_with_zero_owned_shards(tmp_path):
+    from filodb_tpu.parallel.testcluster import make_cold_read_cluster
+    cs, seg_store, comp, store, up, ts_grid, vals, now = \
+        _upload_all(tmp_path)
+    assert up.run_once() == N_WINDOWS
+    start_s, end_s = int(ts_grid[0]) // 1000 + 600, int(ts_grid[-1]) // 1000
+    baseline = _series_map(_query_engine_over(seg_store).query_range(
+        "sum(m)", start_s, 300, end_s))
+
+    c = make_cold_read_cluster(store, num_shards=1, dataset=DS,
+                               data_nodes=("data0",),
+                               query_nodes=("q1", "q2"))
+    try:
+        # the query nodes own NOTHING: zero shards assigned, registered
+        # as query-capable on the mapper only
+        assert c.mapper.query_nodes == ["q1", "q2"]
+        for q in ("q1", "q2"):
+            assert all(q not in c.mapper.owners(s)
+                       for s in range(c.mapper.num_shards))
+        assert c.mapper.query_node_table() == [
+            {"node": "q1", "role": "query-only"},
+            {"node": "q2", "role": "query-only"}]
+        # bit-identical to the local disk tier, served via round-robin
+        # dispatch across data + query-only nodes paging the shared store
+        for _ in range(4):
+            res = c.engine.query_range("sum(m)", start_s, 300, end_s)
+            assert _series_map(res) == baseline
+    finally:
+        c.stop()
+
+
+def test_dead_object_store_degrades_to_flagged_partial(tmp_path):
+    from filodb_tpu.query.rangevector import PlannerParams
+    cs, seg_store, comp, store, up, ts_grid, vals, now = \
+        _upload_all(tmp_path)
+    assert up.run_once() == N_WINDOWS
+    start_s, end_s = int(ts_grid[0]) // 1000 + 600, int(ts_grid[-1]) // 1000
+
+    eng = _query_engine_over_remote(store)
+    pp = PlannerParams(allow_partial_results=True)
+    healthy = eng.query_range("sum(m)", start_s, 300, end_s, pp)
+    assert healthy.error is None and healthy.partial is False
+
+    # the store dies (every get errors): cold scans degrade to a FLAGGED
+    # partial through the typed shard_unavailable path — never a hang,
+    # never a silent full.  Engines are built BEFORE the fault arms (a
+    # node that can't even mount would 503 at /ready instead).
+    eng2 = _query_engine_over_remote(store, ttl_s=1_000.0)
+    eng3 = _query_engine_over_remote(store, ttl_s=1_000.0)
+    breakers.configure(failure_threshold=2, open_base_s=0.05,
+                       open_max_s=0.1, jitter=0.0)
+    with faults.plan("objectstore.get", "error", first_k=1_000_000):
+        res = eng2.query_range("sum(m)", start_s, 300, end_s, pp)
+    assert res.error is None, res.error
+    assert res.partial is True
+    # without the partial waiver the typed error surfaces instead
+    with faults.plan("objectstore.get", "error", first_k=1_000_000):
+        strict = eng3.query_range("sum(m)", start_s, 300, end_s)
+    assert strict.error is not None
+
+
+def _query_engine_over_remote(store, ttl_s=5.0):
+    mapper = ShardMapper(1)
+    mapper.update_from_event(ShardEvent("IngestionStarted", DS, 0, "n"))
+    remote = RemoteSegmentStore(store, DS, 1, ttl_s=ttl_s)
+    remote.mount()
+    tier = PersistedTier(remote, DS, 1,
+                         ColdSegmentCache(64 << 20, use_placer=False))
+    planner = PersistedClusterPlanner(DS, mapper, tier,
+                                      spread_provider=SpreadProvider(
+                                          default_spread=1))
+    return QueryEngine(DS, TimeSeriesMemStore(), mapper, planner=planner)
+
+
+def test_remote_store_serves_stale_manifest_when_store_down(tmp_path):
+    cs, seg_store, comp, store, up, *_ = _upload_all(tmp_path)
+    assert up.run_once() == N_WINDOWS
+    remote = RemoteSegmentStore(store, DS, 1, ttl_s=0.0, max_attempts=1)
+    remote.mount()
+    assert len(remote.list(DS, 0)) == N_WINDOWS
+    breakers.configure(failure_threshold=1, open_base_s=5.0,
+                       open_max_s=5.0, jitter=0.0)
+    with faults.plan("objectstore.get", "error", first_k=1_000_000):
+        # list() survives on the stale cached manifest (staleness_s keeps
+        # the health verdict honest about how stale)
+        metas = remote.list(DS, 0)
+        assert len(metas) == N_WINDOWS
+        assert remote.staleness_s() >= 0.0
+        assert remote.probe()["status"] == "degraded"
+        with pytest.raises(ObjectStoreUnavailable):
+            remote.load(metas[0])
+
+
+# ------------------------------------------------- FiloServer wiring
+
+
+def _filo_config(tmp_path):
+    from filodb_tpu.config import FilodbSettings
+    cfg = FilodbSettings()
+    cfg.store.segment_window_ms = WINDOW
+    cfg.store.segment_closed_lag_ms = WINDOW
+    cfg.store.segment_retain_raw_ms = 1
+    cfg.objectstore.root = str(tmp_path / "objstore")
+    cfg.objectstore.retry_base_s = 0.001
+    cfg.objectstore.retry_max_s = 0.01
+    return cfg
+
+
+def _filo_ingest_epoch(srv, ts_grid, vals):
+    shard = srv.memstore.get_shard("prometheus", 0)
+    shard.ingest_columns("gauge", _pks(),
+                         np.broadcast_to(ts_grid, (S, len(ts_grid))),
+                         {"value": vals})
+    shard.flush_all_groups()
+
+
+def _filo_query(srv, start_s, end_s):
+    st, pay = srv.api.handle("GET", "/api/v1/query_range",
+                             {"query": "sum(m)", "start": str(start_s),
+                              "end": str(end_s), "step": "300"}, b"")
+    assert st == 200, pay
+    pay.pop("traceID", None)
+    return pay
+
+
+@pytest.mark.slow
+def test_filoserver_disk_kill_rebuild_end_to_end(tmp_path):
+    """The operations-runbook drill in miniature: compact + upload on
+    node 1, wipe its entire store root, boot node 2 on the empty disk —
+    the manifests bring every segment back and the query answer is
+    byte-identical (traceID stripped)."""
+    import time as _time
+
+    from filodb_tpu.standalone import DatasetConfig, FiloServer
+    now_ms = int(_time.time() * 1000)
+    t0 = (now_ms - 4 * WINDOW) - ((now_ms - 4 * WINDOW) % WINDOW)
+    ns = 2 * WINDOW // INTERVAL
+    ts_grid = t0 + np.arange(ns, dtype=np.int64) * INTERVAL
+    vals = (np.arange(S)[:, None] * 50.0 + (np.arange(ns) % 11)[None, :])
+    start_s, end_s = t0 // 1000 + 600, int(ts_grid[-1]) // 1000
+
+    store_root = tmp_path / "node-store"
+    cfg = _filo_config(tmp_path)
+    srv = FiloServer([DatasetConfig("prometheus", num_shards=1)],
+                     column_store=LocalDiskColumnStore(str(store_root)),
+                     meta_store=LocalDiskMetaStore(str(store_root)),
+                     config=cfg)
+    try:
+        assert srv.object_store is not None
+        _filo_ingest_epoch(srv, ts_grid, vals)
+        # one compaction pass = compact -> upload -> retention; the
+        # upload ack must land BEFORE retention prunes the raw chunks
+        srv.compaction_schedulers["prometheus"].run_once()
+        up = srv.uploaders["prometheus"]
+        assert up.uploads == 2 and up.backlog_segments() == 0
+        assert srv.column_store.num_chunksets("prometheus", 0) == 0
+        assert srv.health.pending_manifest_mounts() == []
+        assert "persistence" in srv.health.probes
+        assert srv.health.probes["persistence"]()["status"] == "ok"
+        baseline = _filo_query(srv, start_s, end_s)
+        assert baseline["data"]["result"]
+    finally:
+        srv.shutdown()
+
+    # the disk dies: chunks.log, segments, meta — everything local goes
+    shutil.rmtree(store_root)
+
+    srv2 = FiloServer([DatasetConfig("prometheus", num_shards=1)],
+                      column_store=LocalDiskColumnStore(str(store_root)),
+                      meta_store=LocalDiskMetaStore(str(store_root)),
+                      config=cfg)
+    try:
+        assert srv2.health.pending_manifest_mounts() == []
+        # a trickle of live traffic lands post-rebuild (sets the raw
+        # retention floor); the historical range routes to the restored
+        # cold tier
+        fresh = np.asarray([now_ms], np.int64)
+        _filo_ingest_epoch(srv2, fresh, np.full((S, 1), 1.0))
+        rebuilt = _filo_query(srv2, start_s, end_s)
+        assert rebuilt == baseline
+    finally:
+        srv2.shutdown()
+
+
+def test_filoserver_ready_holds_503_when_mount_fails(tmp_path):
+    """A node that cannot see the shared tier at boot must not serve:
+    the manifest mount stays pending and /ready answers 503."""
+    from filodb_tpu.standalone import DatasetConfig, FiloServer
+    store_root = tmp_path / "node-store"
+    cfg = _filo_config(tmp_path)
+    cfg.objectstore.max_attempts = 1
+    # seed the shared store with a manifest so the boot mount has
+    # something to fail reading
+    seed = LocalObjectStore(cfg.objectstore.root)
+    from filodb_tpu.persist.objectstore import ShardManifest
+    seed.put_manifest(ShardManifest("prometheus", 0, generation=1))
+    with faults.plan("objectstore.get", "error", first_k=1_000_000):
+        srv = FiloServer([DatasetConfig("prometheus", num_shards=1)],
+                         column_store=LocalDiskColumnStore(str(store_root)),
+                         meta_store=LocalDiskMetaStore(str(store_root)),
+                         config=cfg)
+    try:
+        assert srv.health.pending_manifest_mounts() == ["prometheus"]
+        from filodb_tpu.utils.health import SERVING
+        srv.health.set_phase(SERVING)
+        ok, reason = srv.health.ready()
+        assert not ok and "manifest mount pending" in reason
+        st, _pay = srv.api.handle("GET", "/ready", {})
+        assert st == 503
+        assert srv.health.probes["persistence"]()["status"] == "degraded"
+    finally:
+        srv.shutdown()
+
+
+# -------------------------------------------------------- readiness gate
+
+
+def test_ready_gates_on_manifest_mount():
+    from filodb_tpu.utils.health import SERVING, HealthEvaluator
+    h = HealthEvaluator(node_name="n", phase=SERVING)
+    ok, _reason = h.ready()
+    assert ok
+    h.note_manifest_mount(DS, False)
+    ok, reason = h.ready()
+    assert not ok and "manifest mount pending" in reason
+    h.note_manifest_mount(DS, True)
+    ok, _reason = h.ready()
+    assert ok

@@ -54,10 +54,22 @@ def boot_and_drive():
     from filodb_tpu.standalone import DatasetConfig, FiloServer
     from filodb_tpu.utils import snappy as fsnappy
 
+    from filodb_tpu.persist.localstore import (LocalDiskColumnStore,
+                                               LocalDiskMetaStore)
+
     cfg = FilodbSettings()
     cfg.wal.enabled = True
     cfg.wal.dir = tempfile.mkdtemp(prefix="filodb-checkmetrics-wal-")
+    # disk-backed store + shared object-store root: the disaggregated
+    # cold tier's metric families (objectstore_*) must go live too
+    disk_root = tempfile.mkdtemp(prefix="filodb-checkmetrics-store-")
+    cfg.objectstore.root = tempfile.mkdtemp(
+        prefix="filodb-checkmetrics-objstore-")
+    cfg.store.segment_window_ms = 3600 * 1000
+    cfg.store.segment_closed_lag_ms = 3600 * 1000
     srv = FiloServer(datasets=[DatasetConfig("prometheus", num_shards=2)],
+                     column_store=LocalDiskColumnStore(disk_root),
+                     meta_store=LocalDiskMetaStore(disk_root),
                      config=cfg)
     try:
         now = int(time.time() * 1000)
@@ -90,6 +102,32 @@ def boot_and_drive():
         from filodb_tpu.utils.metrics import registry
         registry.snapshot_samples()
         srv.memstore.get_shard("prometheus", 0).flush_all_groups()
+        # cold-tier drive: a closed window through compact -> upload ->
+        # manifest swap, then a segment-loss restore — the
+        # objectstore_* families must be live (and documented)
+        import shutil as _shutil
+
+        import numpy as np
+
+        from filodb_tpu.core.partkey import PartKey
+        from filodb_tpu.persist.objectstore import restore_from_objectstore
+        from filodb_tpu.persist.segments import SegmentStore
+        win = cfg.store.segment_window_ms
+        t0 = (now - 4 * win) - ((now - 4 * win) % win)
+        ts = t0 + np.arange(8, dtype=np.int64) * 60_000
+        keys = [PartKey("hygiene_cold", (("inst", f"c{i}"), ("_ws_", "hy"),
+                                         ("_ns_", "check")))
+                for i in range(4)]
+        sh = srv.memstore.get_shard("prometheus", 0)
+        sh.ingest_columns("gauge", keys, np.broadcast_to(ts, (4, 8)),
+                          {"value": np.ones((4, 8))})
+        sh.flush_all_groups()
+        srv.compaction_schedulers["prometheus"].run_once()
+        seg_store = SegmentStore(disk_root)
+        _shutil.rmtree(seg_store.seg_dir("prometheus", 0),
+                       ignore_errors=True)
+        restore_from_objectstore(srv.object_store, seg_store,
+                                 "prometheus", 2)
     finally:
         srv.shutdown()
     from filodb_tpu.utils.metrics import registry

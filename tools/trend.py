@@ -72,6 +72,18 @@ of every headline metric is greppable in one file:
     verdict counts (gate: 0 degraded on the eligible mix) and
     ``exprfuse_memo_hits`` (the shared per-shard gather memo doing the
     work) — plus a loud ``exprfuse_error`` when the stage fails.
+  - the disaggregated cold-tier numbers (PR 19):
+    ``objectstore_drill_identical`` (gate: wipe the entire store root,
+    rebuild from the shared object store + WAL tail, query_range
+    byte-identical) with ``objectstore_drill_availability`` (gate: 1.0
+    — stateless readers keep the historical range answerable while the
+    node is down), ``objectstore_elastic_qps_ratio`` (gate: >= 1.8x
+    with 2 query-only node processes on >= 3-core hosts; no-collapse +
+    bit-identity on smaller ones), and the dead-store degrade proof
+    ``objectstore_deadstore_partial_flagged`` /
+    ``objectstore_deadstore_strict_error`` (flagged partial in bounded
+    time, typed error when strict) — plus a loud ``objectstore_error``
+    when the stage fails.
 
 Existing hand-written round entries are MERGED, never clobbered: only
 missing keys are added, so curated notes survive re-runs.
@@ -188,6 +200,24 @@ CARRY = [
     "devicetelem_storm_attributed", "devicetelem_storm_hist_count",
     "devicetelem_storm_health_degraded", "devicetelem_mesh_reconciled",
     "devicetelem_gate_ok", "devicetelem_error",
+    # disaggregated cold tier (ISSUE 19): the disk-kill drill (wipe the
+    # whole store root, rebuild from shared object store + WAL tail,
+    # byte-identical query_range, availability 1.0 via stateless
+    # readers while the node is down), the elastic-read gate (2
+    # query-only node processes; >= 1.8x QPS on >= 3-core hosts,
+    # no-collapse + identity on smaller ones), and the dead-store
+    # degrade proof (flagged partial in bounded time, typed error when
+    # strict) — plus a loud objectstore_error when the stage fails
+    "objectstore_drill_identical", "objectstore_drill_availability",
+    "objectstore_drill_restored_segments",
+    "objectstore_drill_uploaded_segments",
+    "objectstore_drill_wal_tail_batches",
+    "objectstore_elastic_qps_1node", "objectstore_elastic_qps_3node",
+    "objectstore_elastic_qps_ratio", "objectstore_elastic_identical",
+    "objectstore_elastic_cores", "objectstore_elastic_gate",
+    "objectstore_deadstore_partial_flagged",
+    "objectstore_deadstore_strict_error", "objectstore_deadstore_seconds",
+    "objectstore_gate_ok", "objectstore_error",
 ]
 RENAME = {"value": "headline_samples_per_sec",
           "p50_query_latency_s": "p50_s"}
