@@ -3352,6 +3352,126 @@ def run_objectstore(quick=False, series=None):
         shutil.rmtree(root, ignore_errors=True)
 
 
+def run_federation(quick=False, series=None):
+    """Cross-cluster federation stage (ISSUE 20): the two-cluster
+    testbench over parallel/testcluster.make_federated_pair.  Gated:
+
+      (a) bit-identity — a federated exactly-mergeable `sum by` (west
+          replies one [G, W] cluster partial over the door) and a
+          non-mergeable per-series shape (series shipping) must be
+          bit-identical to a single-cluster truth engine holding every
+          series; a cross-cluster binary join likewise.
+      (b) dead-cluster degrade — west's door dies with the SIGKILL
+          signature mid-bench: a partial-tolerant query must return a
+          FLAGGED partial NAMING cluster:west in bounded wall time
+          (never a hang, never silent short data), and after the door
+          revives the half-open breaker must recover to full
+          bit-identical answers.
+      (c) wire ratio — the same `sum by` against a push_partials=False
+          strawman pair (every remote series ships raw): the pushed
+          wire bytes must be at least federation_wire_ratio_x smaller,
+          the O(groups)-vs-O(series) win federation exists for.
+    """
+    import numpy as np
+
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    from filodb_tpu.parallel.breaker import breakers
+    from filodb_tpu.parallel.testcluster import make_federated_pair
+    from filodb_tpu.query.rangevector import PlannerParams
+
+    S_f = int(series) if series else (8 if quick else 32)
+    n_samples = 60 if quick else 240
+    s0 = 1_600_000_020
+    q_sum = "sum by (_ns_) (fed_gauge)"
+    q_series = "avg_over_time(fed_gauge[2m])"
+    q_join = ('sum by (_ns_) (fed_gauge{region="west"}) '
+              '+ sum by (_ns_) (fed_gauge{region="east"})')
+    args = (s0 + 180, 60, s0 + (n_samples - 2) * 10)
+    pp = PlannerParams(allow_partial_results=True, timeout_s=30.0)
+
+    def identical(res, truth):
+        if res.error is not None or truth.error is not None:
+            return False
+        got = {str(k): np.asarray(v) for k, _, v in res.series()}
+        want = {str(k): np.asarray(v) for k, _, v in truth.series()}
+        return set(got) == set(want) and all(
+            np.array_equal(got[k], want[k], equal_nan=True) for k in want)
+
+    breakers.configure(failure_threshold=3, open_base_s=0.2,
+                       open_max_s=0.5, jitter=0.0)
+    breakers.reset()
+    pair = make_federated_pair(num_series=S_f, num_samples=n_samples,
+                               start=False)
+    try:
+        # --------------------------------------------- (a) bit-identity
+        res_sum = pair.engine.query_range(q_sum, *args)
+        ident = (identical(res_sum, pair.truth.query_range(q_sum, *args))
+                 and res_sum.stats.pushdown_pushed >= 1
+                 and identical(pair.engine.query_range(q_series, *args),
+                               pair.truth.query_range(q_series, *args)))
+        join_ident = identical(pair.engine.query_range(q_join, *args),
+                               pair.truth.query_range(q_join, *args))
+        pushed_bytes = res_sum.stats.wire_bytes
+
+        # --------------------------------------- (b) dead-cluster drill
+        pair.kill_west()
+        t0 = time.perf_counter()
+        dead = pair.engine.query_range(q_sum, *args, planner_params=pp)
+        dead_s = time.perf_counter() - t0
+        partial_flagged = (dead.error is None and dead.partial
+                          and dead_s < 30.0)
+        names_cluster = any("cluster:west" in w
+                            for w in dead.stats.warnings)
+        pair.revive_west()
+        recovered = False
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline:
+            res = pair.engine.query_range(q_sum, *args, planner_params=pp)
+            if res.error is None and not res.partial:
+                recovered = identical(res, pair.truth.query_range(q_sum,
+                                                                  *args))
+                break
+            time.sleep(0.2)
+    finally:
+        pair.stop()
+        breakers.reset()
+
+    # ------------------------------------------------- (c) wire ratio
+    straw = make_federated_pair(num_series=S_f, num_samples=n_samples,
+                                push_partials=False, start=False)
+    try:
+        res = straw.engine.query_range(q_sum, *args)
+        shipped_ok = identical(res, straw.truth.query_range(q_sum, *args))
+        shipped_bytes = res.stats.wire_bytes
+    finally:
+        straw.stop()
+        breakers.configure()
+        breakers.reset()
+    ratio = (shipped_bytes / pushed_bytes) if pushed_bytes else 0.0
+
+    gate_ok = bool(ident and join_ident and partial_flagged
+                   and names_cluster and recovered and shipped_ok
+                   and ratio >= 1.2)
+    return {
+        "metric": "federation_wire_ratio_x",
+        "value": round(ratio, 2), "unit": "x",
+        "federation_identical": 1.0 if ident else 0.0,
+        "federation_join_identical": 1.0 if join_ident else 0.0,
+        "federation_partial_on_dead_cluster":
+            1.0 if partial_flagged else 0.0,
+        "federation_dead_names_cluster": 1.0 if names_cluster else 0.0,
+        "federation_dead_seconds": round(dead_s, 3),
+        "federation_recovered_full": 1.0 if recovered else 0.0,
+        "federation_wire_ratio_x": round(ratio, 2),
+        "federation_pushed_wire_bytes": pushed_bytes,
+        "federation_shipped_wire_bytes": shipped_bytes,
+        "federation_gate_ok": gate_ok,
+        "series_per_region": S_f, "platform": "cpu",
+    }
+
+
 def measure_longrange(quick=False, series=None):
     """Historical-tier stage (ISSUE 8): multi-day persisted dataset,
     compacted into columnar segments, served through the cold DeviceMirror
@@ -4098,8 +4218,17 @@ def parse_args(argv=None):
                     choices=["", "chaos", "multichip", "wal", "longrange",
                              "selfmon", "replication", "ingesttrace",
                              "activequeries", "qos", "distexec", "index",
-                             "exprfuse", "devicetelem", "objectstore"],
-                    help="optional standalone stage: 'objectstore' runs "
+                             "exprfuse", "devicetelem", "objectstore",
+                             "federation"],
+                    help="optional standalone stage: 'federation' runs "
+                         "the cross-cluster federation stage (two-"
+                         "cluster testbench: pushed [G, W] cluster "
+                         "partials and shipped series bit-identical to "
+                         "a single-cluster truth, dead-cluster flagged "
+                         "partial naming the cluster + breaker "
+                         "recovery, pushed-vs-shipped wire ratio >= "
+                         "1.2x) and exits nonzero on a gate failure; "
+                         "'objectstore' runs "
                          "the disaggregated cold-tier stage (disk-kill "
                          "drill with byte-identical rebuild from shared "
                          "object store + WAL tail, elastic-read gate "
@@ -5048,6 +5177,17 @@ def main():
             sys.exit(1)
         print(json.dumps(r))
         sys.exit(0 if r.get("objectstore_gate_ok") else 1)
+    if args.stage == "federation":
+        try:
+            r = run_federation(quick=args.quick,
+                               series=args.series or None)
+        except Exception as e:  # noqa: BLE001 — loud one-line fail
+            print(json.dumps({
+                "metric": "federation_wire_ratio_x", "unit": "x",
+                "federation_error": f"{type(e).__name__}: {e}"[:300]}))
+            sys.exit(1)
+        print(json.dumps(r))
+        sys.exit(0 if r.get("federation_gate_ok") else 1)
     if args._worker:
         run_worker(args)
         return

@@ -338,6 +338,53 @@ def cmd_shards(args) -> int:
     return 0
 
 
+def cmd_federation(args) -> int:
+    """Federation topology + health over HTTP (GET /admin/federation):
+    one line per configured remote cluster — endpoint, ownership
+    matchers / time window, live probe verdict — plus that cluster's
+    circuit-breaker row (peer `cluster:<name>` from /admin/breakers).
+    The first stop of the "a remote cluster is down" runbook
+    (doc/federation.md)."""
+    payload = _http_get(args.host, "/admin/federation", {})
+    if payload.get("status") != "success":
+        print(json.dumps(payload, indent=2))
+        return 1
+    if args.raw:
+        print(json.dumps(payload, indent=2))
+        return 0
+    data = payload["data"]
+    rows = data["clusters"]
+    if not rows:
+        print("federation not configured on this server")
+        return 0
+    brk = {}
+    bp = _http_get(args.host, "/admin/breakers", {})
+    if bp.get("status") == "success":
+        brk = {b["peer"]: b for b in bp["data"]["breakers"]}
+    print(f"local cluster: {data['cluster']!r}")
+    print(f"{'CLUSTER':<14} {'ENDPOINT':<22} {'DATASET':<12} "
+          f"{'HEALTH':<9} {'FAILS':>5} {'FLIPS':>5} {'BREAKER':<9}  "
+          f"OWNERSHIP")
+    degraded = False
+    for r in rows:
+        own = ", ".join(f"{k}=~{v}" for k, v in
+                        sorted(r["match"].items())) or "(all labels)"
+        if r["timeStartMs"] or r["timeEndMs"]:
+            own += (f" time=[{r['timeStartMs']},"
+                    f"{r['timeEndMs'] or 'now'}]")
+        health = ("up" if r["healthy"] else "DOWN") \
+            if r["probed"] else "unprobed"
+        degraded = degraded or (r["probed"] and not r["healthy"])
+        b = brk.get(f"cluster:{r['cluster']}", {})
+        print(f"{r['cluster']:<14} {r['endpoint']:<22} "
+              f"{r['dataset']:<12} {health:<9} "
+              f"{r['consecutiveFailures']:>5} {r['transitions']:>5} "
+              f"{b.get('state', '-'):<9}  {own}")
+        if r["lastError"]:
+            print(f"{'':14} last error: {r['lastError']}")
+    return 1 if degraded else 0
+
+
 def cmd_queries(args) -> int:
     """Live query introspection over HTTP: list the in-flight queries
     (GET /admin/queries) once or continuously (`--follow`), or kill one
@@ -857,6 +904,15 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--raw", action="store_true",
                     help="print the raw JSON payload")
     sp.set_defaults(fn=cmd_shards)
+
+    sp = sub.add_parser("federation",
+                        help="federated-cluster topology + health over "
+                             "HTTP (GET /admin/federation; exit 1 when "
+                             "any remote cluster is down)")
+    sp.add_argument("--host", required=True)
+    sp.add_argument("--raw", action="store_true",
+                    help="print the raw JSON payload")
+    sp.set_defaults(fn=cmd_federation)
 
     sp = sub.add_parser("queries", help="live in-flight queries over "
                                         "HTTP (list / --follow / --kill)")

@@ -480,6 +480,46 @@ class ObjectStoreConfig:
 
 
 @dataclasses.dataclass
+class FederationConfig:
+    """Cross-cluster federation (filodb_tpu/federation/;
+    doc/federation.md): N independent filodb-tpu clusters answer PromQL
+    as one system.  A FederationPlanner above each dataset's planner
+    stack routes whole-expression subtrees to the clusters that OWN the
+    matching series (label matchers and/or time windows), pushes
+    exactly-mergeable aggregations so each remote cluster replies one
+    [G, W] AggPartial over the node-query wire, and degrades a dead or
+    deadline-blown cluster through the partial-results gate (warning
+    names the cluster) behind a `cluster:<name>` circuit breaker."""
+    enabled: bool = False
+    # this cluster's name: announced in door pings, shown in remote
+    # clusters' health/ownership views
+    cluster_name: str = "local"
+    # federation door — the node-query transport endpoint remote
+    # coordinators dispatch FederatedLeafExec plans to.  Starts whenever
+    # federation is enabled (port 0 = ephemeral, fine for tests; fixed
+    # in production so peers can declare it)
+    door_host: str = "127.0.0.1"
+    door_port: int = 0
+    # health probes: each configured remote cluster's door is pinged on
+    # this cadence; failures feed the `cluster:<name>` breaker and the
+    # federation health subsystem + journal
+    probe_interval_s: float = 5.0
+    probe_timeout_s: float = 2.0
+    # push exactly-mergeable aggregations as [G, W] AggPartials (the
+    # cross-cluster pushdown).  False = ship-everything strawman (whole
+    # child series cross the wire) — the wire-ratio baseline bench.py
+    # federation measures against; True is the only production stance.
+    push_partials: bool = True
+    # remote clusters, dict-shaped because HOCON-lite has no object
+    # lists: {name: {host, port, dataset?, match: {label: regex-or-
+    # literal}, time_start_ms?, time_end_ms?}}.  `match` declares label
+    # ownership (a query's selector must match to route there);
+    # time_*_ms bound the cluster's time ownership window (0/absent =
+    # unbounded).  A cluster with neither owns nothing.
+    clusters: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass
 class IndexConfig:
     """Tag-index engine knobs (core/index.py bitmap postings)."""
     # per-tenant (_ws_) alive-series budget per shard, enforced at
@@ -549,6 +589,8 @@ class FilodbSettings:
     index: IndexConfig = dataclasses.field(default_factory=IndexConfig)
     objectstore: ObjectStoreConfig = dataclasses.field(
         default_factory=ObjectStoreConfig)
+    federation: FederationConfig = dataclasses.field(
+        default_factory=FederationConfig)
     shard_key_level_metrics: bool = True
     quota_default: int = 2_000_000_000
     reassignment_min_interval_s: float = 2 * 3600.0
@@ -588,7 +630,8 @@ class FilodbSettings:
                              ("selfmon", self.selfmon),
                              ("replication", self.replication),
                              ("index", self.index),
-                             ("objectstore", self.objectstore)):
+                             ("objectstore", self.objectstore),
+                             ("federation", self.federation)):
             for k, v in (raw.pop(section, None) or {}).items():
                 _set_field(obj, k, v, f"{source}: {section}.{k}")
         if "spread_assignment" in raw:
@@ -635,7 +678,7 @@ class FilodbSettings:
             parsed = _parse_scalar(val)
             for section in ("query_", "store_", "breaker_", "rules_",
                             "wal_", "ingest_", "selfmon_", "replication_",
-                            "index_", "objectstore_"):
+                            "index_", "objectstore_", "federation_"):
                 if rest.startswith(section):
                     overlay.setdefault(section[:-1], {})[
                         rest[len(section):]] = parsed

@@ -94,6 +94,10 @@ class PromHttpApi:
         # (replication/handoff.py — POST /admin/shards/{s}/handoff)
         self.replicators: Dict[str, object] = {}
         self.handoffs: Dict[str, object] = {}
+        # cross-cluster federation registry (federation/registry.py),
+        # attached by FiloServer when federation.enabled — feeds
+        # GET /admin/federation (ownership + live health per cluster)
+        self.federation = None
 
     # ------------------------------------------------------------ dispatch
 
@@ -144,6 +148,8 @@ class PromHttpApi:
                 return self._breakers()
             if parts == ["admin", "jobs"] and method == "GET":
                 return self._jobs()
+            if parts == ["admin", "federation"] and method == "GET":
+                return self._federation()
             if parts == ["admin", "shards"] and method == "GET":
                 return self._shards(params)
             if parts[:2] == ["admin", "shards"] and len(parts) == 4 \
@@ -1056,6 +1062,21 @@ class PromHttpApi:
         from filodb_tpu.parallel.breaker import breakers
         return 200, {"status": "success",
                      "data": {"breakers": breakers.snapshot()}}
+
+    def _federation(self) -> Tuple[int, object]:
+        """GET /admin/federation — every configured remote cluster's
+        ownership declaration (endpoint, label matchers, time window)
+        and live probe state (healthy, last probe/error, transition
+        count) from the FederationRegistry; the first stop of the
+        "a remote cluster is down" runbook (doc/federation.md).  A
+        server without federation answers an empty cluster list."""
+        reg = self.federation
+        if reg is None:
+            return 200, {"status": "success",
+                         "data": {"cluster": "", "clusters": []}}
+        return 200, {"status": "success",
+                     "data": {"cluster": reg.local_name,
+                              "clusters": reg.snapshot()}}
 
     # --------------------------------------------------------------- ruler
 

@@ -98,6 +98,31 @@ def histogram_batch(num_series: int, num_samples: int, num_buckets: int = 8,
                        bucket_les=np.asarray(les))
 
 
+def region_gauge_batch(num_series: int, num_samples: int,
+                       region: str = "east",
+                       start_ms: int = 1_600_000_000_000,
+                       step_ms: int = 10_000, metric: str = "fed_gauge",
+                       seed: int = 0, num_apps: int = 3) -> RecordBatch:
+    """Integer-valued gauges tagged with a `region` ownership label —
+    the federation fixture's data shape (parallel/testcluster.py
+    make_federated_pair).  Integer values make cross-cluster merges
+    bit-comparable against a single-store ground truth: sum/count/avg
+    over exact integers carry no float-ordering noise."""
+    rng = np.random.default_rng(seed)
+    keys = [PartKey.make(metric, {
+        "_ws_": "demo",
+        "_ns_": f"App-{i % num_apps}",
+        "region": region,
+        "instance": f"{region}-{i}",
+    }) for i in range(num_series)]
+    part_idx = np.repeat(np.arange(num_series, dtype=np.int32), num_samples)
+    ts = np.tile(start_ms + np.arange(num_samples, dtype=np.int64) * step_ms,
+                 num_series)
+    values = rng.integers(1, 64,
+                          size=num_series * num_samples).astype(np.float64)
+    return RecordBatch(GAUGE, keys, part_idx, ts, {"value": values})
+
+
 def batch_stream(batch: RecordBatch, samples_per_chunk: int,
                  base_offset: int = 0) -> Iterator[Tuple[RecordBatch, int]]:
     """Split a big columnar batch into a stream of (smaller batch, offset) —

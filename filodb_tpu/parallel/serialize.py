@@ -25,13 +25,17 @@ import numpy as np
 
 from filodb_tpu.core import index as index_mod
 from filodb_tpu.query import exec as exec_mod
+from filodb_tpu.query import logical as lp_mod
 from filodb_tpu.query import rangevector as rv_mod
 
 # ------------------------------------------------------------- registries
 
-# dataclasses revivable by name (transformers, filters, result carriers)
+# dataclasses revivable by name (transformers, filters, result carriers;
+# logical-plan dataclasses ride federation dispatches — the federated
+# leaf ships the EXACT logical subtree instead of an unparse/re-parse
+# round trip, so sub-second clamped grids and offsets survive the hop)
 _DATACLASSES: Dict[str, type] = {}
-for _m in (exec_mod, rv_mod, index_mod):
+for _m in (exec_mod, rv_mod, index_mod, lp_mod):
     for _name in dir(_m):
         _cls = getattr(_m, _name)
         if isinstance(_cls, type) and dataclasses.is_dataclass(_cls):
@@ -81,6 +85,16 @@ _PUSHDOWN_PLANS: Dict[str, Tuple[type, List[str]]] = {
 
 class NotSerializable(TypeError):
     pass
+
+
+def register_leaf_plan(cls: type, attrs: List[str]) -> None:
+    """Register an out-of-package leaf exec plan for wire revival — the
+    closed-registry stance is kept (only explicit registrations revive);
+    this lets higher layers (federation/exec.py FederatedLeafExec) ship
+    their own leaves without a parallel→federation import cycle.  The
+    class must construct as cls(ctx, **{attr: value}) like the built-in
+    `_LEAF_PLANS` entries."""
+    _LEAF_PLANS[cls.__name__] = (cls, list(attrs))
 
 
 # --------------------------------------------------------------- encoding

@@ -278,6 +278,130 @@ def make_cold_read_cluster(object_store, num_shards: int = 4,
                            remote, servers, query_nodes)
 
 
+@dataclasses.dataclass
+class FederatedPair:
+    """Two FULL FiloServer clusters federated over their doors, plus a
+    single-store ground truth holding every series — the shared fixture
+    of tests/test_federation.py AND `bench.py federation`.
+
+    `east` owns region="east" series and is the coordinator the tests
+    query; `west` owns region="west".  Each cluster's config declares
+    the other via `federation.clusters` label matchers, so a query
+    without a region selector fans out to both (west replying cluster
+    partials for mergeable aggregates) and `{region="west"}` routes
+    whole expressions across."""
+    dataset: str
+    metric: str
+    east: "object"                        # FiloServer (coordinator)
+    west: "object"                        # FiloServer (remote)
+    truth: QueryEngine                    # all series in ONE store
+    truth_store: TimeSeriesMemStore
+
+    @property
+    def engine(self) -> QueryEngine:
+        return self.east.engines[self.dataset]
+
+    @property
+    def frontend(self):
+        return self.east.api.frontends[self.dataset]
+
+    def kill_west(self) -> None:
+        """Cluster death with the SIGKILL signature, as east sees it:
+        west's federation door severs live connections and refuses new
+        ones.  (west's own engines keep running — a dead DOOR is what a
+        dead cluster looks like from across the boundary.)"""
+        self.west.federation_door.stop()
+
+    def revive_west(self) -> None:
+        """Bring west's door back on its ORIGINAL configured port
+        (half-open breaker recovery needs the declared endpoint to
+        answer again)."""
+        self.west.federation_door.start()
+
+    def stop(self) -> None:
+        for srv in (self.east, self.west):
+            try:
+                srv.shutdown()
+            except OSError:
+                pass
+
+
+def _free_port() -> int:
+    import socket
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def make_federated_pair(num_series: int = 8, num_samples: int = 120,
+                        num_shards: int = 2, dataset: str = "prometheus",
+                        start_ms: int = 1_600_000_020_000,
+                        step_ms: int = 10_000, metric: str = "fed_gauge",
+                        push_partials: bool = True,
+                        probe_interval_s: float = 0.2,
+                        start: bool = True) -> FederatedPair:
+    """Boot the two-cluster federation testbench: `num_series` integer-
+    valued series per region, split by the `region` ownership label;
+    the truth engine answers the same queries from one store holding
+    everything (bit-identity oracle)."""
+    from filodb_tpu.config import FilodbSettings
+    from filodb_tpu.ingest.generator import region_gauge_batch
+    from filodb_tpu.standalone import DatasetConfig, FiloServer
+    ports = {"east": _free_port(), "west": _free_port()}
+
+    def cfg(me: str, peer: str) -> FilodbSettings:
+        c = FilodbSettings()
+        f = c.federation
+        f.enabled = True
+        f.cluster_name = me
+        f.door_port = ports[me]
+        f.probe_interval_s = probe_interval_s
+        f.probe_timeout_s = 1.0
+        f.push_partials = push_partials
+        f.clusters = {
+            peer: {"host": "127.0.0.1", "port": ports[peer],
+                   "match": {"region": peer}},
+            me: {"local": True, "match": {"region": me}},
+        }
+        return c
+
+    servers = {}
+    batches = {}
+    for i, (me, peer) in enumerate((("east", "west"), ("west", "east"))):
+        srv = FiloServer([DatasetConfig(dataset, num_shards=num_shards)],
+                         config=cfg(me, peer), http_port=0, node_name=me)
+        batches[me] = region_gauge_batch(
+            num_series, num_samples, region=me, start_ms=start_ms,
+            step_ms=step_ms, metric=metric, seed=i + 1)
+        spread = srv.spreads[dataset]
+        for s, sub in split_batch_by_shard(batches[me],
+                                           srv.mappers[dataset],
+                                           spread).items():
+            srv.memstore.get_shard(dataset, s).ingest(sub)
+        servers[me] = srv
+    truth_store = TimeSeriesMemStore()
+    truth_mapper = ShardMapper(num_shards)
+    truth_spread = SpreadProvider(default_spread=1)
+    for s in range(num_shards):
+        truth_store.setup(dataset, s)
+        truth_mapper.update_from_event(
+            ShardEvent("IngestionStarted", dataset, s, "truth"))
+    for batch in batches.values():
+        for s, sub in split_batch_by_shard(batch, truth_mapper,
+                                           truth_spread).items():
+            truth_store.get_shard(dataset, s).ingest(sub)
+    truth = QueryEngine(dataset, truth_store, truth_mapper,
+                        planner=SingleClusterPlanner(dataset, truth_mapper,
+                                                     truth_spread))
+    if start:
+        for srv in servers.values():
+            srv.start()
+    return FederatedPair(dataset, metric, servers["east"],
+                         servers["west"], truth, truth_store)
+
+
 def make_two_node_cluster(batches: Iterable = (), num_shards: int = 4,
                           dataset: str = "prometheus",
                           default_spread: int = 1,

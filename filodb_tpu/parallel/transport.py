@@ -46,6 +46,12 @@ _KILL_MAGIC = b"FKILL1"
 # coordinators in a rolling deploy.
 _PLAN_MAGIC = b"FPLN2"
 _REQ_FLAG_STREAM = 1
+# control-plane liveness/identity probe: payloads with this prefix get
+# the server's `ping_info()` dict back (federation health probes read
+# cluster identity + per-dataset data tokens through it).  Handled
+# before serialize.loads, like kills, so a probe answers even while
+# every handler thread is executing plans.
+_PING_MAGIC = b"FPING1"
 # streamed-reply frame: _STREAM_MAGIC + u8 flags (bit 0 = last frame) +
 # u32 seq + u32 crc32(body) + body.  Non-last bodies carry {"begin"} /
 # {"piece"} chunks (parallel/streams.py); the last frame carries the
@@ -127,8 +133,12 @@ class NodeQueryServer:
     """Executes dispatched leaf plans against this node's source
     (the QueryActor receive loop, ref: coordinator/.../QueryActor.scala:119)."""
 
-    def __init__(self, source, host: str = "127.0.0.1", port: int = 0):
+    def __init__(self, source, host: str = "127.0.0.1", port: int = 0,
+                 ping_info: Optional[Callable[[], dict]] = None):
         self.source = source
+        # optional identity payload for FPING probes (federation doors
+        # answer cluster name + per-dataset data tokens through this)
+        self._ping_info = ping_info
         # live handler connections: stop() severs them so a stopped
         # in-proc node looks EXACTLY like a SIGKILLed one to peers with
         # pooled sockets (shutdown() alone only stops accepting; pooled
@@ -158,6 +168,10 @@ class NodeQueryServer:
                             # completed child answers killed=False)
                             _send_frame(self.request,
                                         outer._handle_kill(payload))
+                            continue
+                        if payload.startswith(_PING_MAGIC):
+                            _send_frame(self.request,
+                                        outer._handle_ping())
                             continue
                         stream_ok = False
                         ent = None
@@ -283,6 +297,16 @@ class NodeQueryServer:
             return serialize.dumps(  # kill the handler connection
                 {"ok": False, "error": f"{type(e).__name__}: {e}"})
 
+    def _handle_ping(self) -> bytes:
+        """Serve one liveness/identity probe frame."""
+        try:
+            info = self._ping_info() if self._ping_info is not None else {}
+            return serialize.dumps({"ok": True, "data": info,
+                                    "stats": None})
+        except Exception as e:  # noqa: BLE001 — a bad probe must not
+            return serialize.dumps(  # kill the handler connection
+                {"ok": False, "error": f"{type(e).__name__}: {e}"})
+
     @staticmethod
     def _send_error(sock: socket.socket, stream_ok: bool, err: dict) -> None:
         body = serialize.dumps(err)
@@ -390,13 +414,33 @@ def send_kill(host: str, port: int, query_id: str, reason: str = "admin",
     return reply.get("data") or {}
 
 
+def send_ping(host: str, port: int, timeout_s: float = 2.0) -> dict:
+    """One FPING probe on a fresh connection: returns the server's
+    `ping_info()` dict (federation health probes carry cluster identity
+    + per-dataset data tokens in it).  Raises on transport failure."""
+    with socket.create_connection((host, port), timeout=timeout_s) as s:
+        s.settimeout(timeout_s)
+        _send_frame(s, _PING_MAGIC)
+        reply = serialize.loads(_recv_frame(s))
+    if not reply.get("ok"):
+        raise ConnectionError(f"ping rejected: {reply.get('error')}")
+    return reply.get("data") or {}
+
+
 class RemoteNodeDispatcher(PlanDispatcher):
     """Coordinator-side dispatcher for one remote node; keeps one pooled
-    connection per thread (ref: ActorPlanDispatcher ask-pattern send)."""
+    connection per thread (ref: ActorPlanDispatcher ask-pattern send).
+
+    `peer` renames the endpoint for breaker keying and error text: the
+    federation layer passes `cluster:<name>` so a remote CLUSTER's
+    breaker rows and degradation warnings carry the cluster name, not a
+    bare host:port (kill fan-out still records the raw address)."""
 
     def __init__(self, host: str, port: int,
-                 timeout_s: Optional[float] = None):
+                 timeout_s: Optional[float] = None,
+                 peer: Optional[str] = None):
         self.host, self.port = host, port
+        self.peer = peer
         from filodb_tpu.config import settings
         q = settings().query
         if timeout_s is None:
@@ -455,13 +499,17 @@ class RemoteNodeDispatcher(PlanDispatcher):
 
         from filodb_tpu.parallel.breaker import breakers
         from filodb_tpu.query.execbase import QueryError
-        where = f"{self.host}:{self.port}"
+        addr = f"{self.host}:{self.port}"
+        # breaker key + error-text identity: the federation layer names
+        # the remote `cluster:<name>`; node fan-out keeps host:port
+        where = self.peer or addr
         # record the child node on the query's live registry entry
         # BEFORE any wire I/O: a kill issued while this hop is blocked
-        # in its round-trip must know where to send the kill frame
+        # in its round-trip must know where to send the kill frame (the
+        # RAW address — kill frames dial it directly)
         act = getattr(plan.ctx, "active", None)
         if act is not None:
-            act.note_remote(where)
+            act.note_remote(addr)
         dl = getattr(plan.ctx, "deadline_unix_s", 0.0)
         allow_partial = getattr(plan.ctx.planner_params,
                                 "allow_partial_results", False)

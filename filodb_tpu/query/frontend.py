@@ -532,7 +532,14 @@ class QueryFrontend:
     def _state(self) -> Optional[Tuple[Tuple, int]]:
         """(series-set token, append horizon ms) across the engine's local
         shards, or None when the source can't vouch for them (remote /
-        unknown sources bypass the cache)."""
+        unknown sources bypass the cache).
+
+        A federated planner additionally folds its registry state —
+        participating cluster set, per-cluster health transitions, and
+        each remote door's per-dataset data tokens (ride FPING replies)
+        — into the token, so a remote cluster dying, recovering or
+        ingesting invalidates cached federated answers exactly like
+        local ingest does (doc/federation.md cache safety)."""
         source = getattr(self.engine, "source", None)
         shards_for = getattr(source, "shards_for", None)
         if shards_for is None:
@@ -552,4 +559,10 @@ class QueryFrontend:
             horizon = h if horizon is None else min(horizon, h)
         if horizon is None or horizon <= NO_HORIZON_MS:
             return None
+        fed_fn = getattr(self.engine.planner, "federation_state", None)
+        if fed_fn is not None:
+            try:
+                return (tuple(token), ("federation",) + fed_fn()), horizon
+            except Exception:  # noqa: BLE001 — registry trouble: bypass
+                return None
         return tuple(token), horizon

@@ -245,8 +245,37 @@ class FiloServer:
             # node trace evidence); default-named embedded servers only
             # fill an empty slot so they never clobber a real identity
             _metrics.NODE_NAME = node_name
+        # Cross-cluster federation (filodb_tpu/federation; doc/
+        # federation.md): the registry parses `federation.clusters` and
+        # probes remote doors; the door is THIS cluster's dispatch
+        # endpoint.  Both exist before the dataset loop so each
+        # dataset's planner stack gains a FederationPlanner outermost
+        # and registers its inner stack at the door.
+        self.federation_registry = None
+        self.federation_door = None
+        fed = self.config.federation
+        if fed.enabled:
+            from filodb_tpu.federation import (FederationDoor,
+                                               FederationRegistry)
+            cluster = fed.cluster_name or node_name
+            self.federation_registry = FederationRegistry(
+                fed, local_name=cluster)
+            self.federation_door = FederationDoor(
+                cluster, host=fed.door_host, port=fed.door_port)
         for dc in self.datasets:
             self._setup_dataset(dc)
+        if self.federation_door is not None:
+            # bound in __init__ (not start()) so embedders that query
+            # without start() — and the two-cluster test pair reading
+            # back an ephemeral port — see a live door immediately
+            self.federation_door.start()
+            self.health.probes["federation"] = \
+                self.federation_registry.health_probe
+            journal.emit("federation_door_open", subsystem="federation",
+                         cluster=self.federation_registry.local_name,
+                         port=self.federation_door.port,
+                         clusters=",".join(
+                             sorted(self.federation_registry.clusters)))
         if self.uploaders:
             # the `persistence` health subsystem: upload backlog age +
             # breaker state per dataset, worst-wins into the verdict
@@ -261,6 +290,8 @@ class FiloServer:
                                batch_window_ms=self.config.query
                                .batch_window_ms,
                                config=self.config, health=self.health)
+        if self.federation_registry is not None:
+            self.api.federation = self.federation_registry
         self.http = FiloHttpServer(self.api, http_host, http_port)
         # Ruler — recording & alerting rules (filodb_tpu/rules): standing
         # queries evaluated through this server's QueryFrontend whose
@@ -459,6 +490,25 @@ class FiloServer:
         matcher = default_shard_key_matcher(
             label_vals, self.memstore.schemas.part.options.shard_key_columns)
         planner = ShardKeyRegexPlanner(planner, matcher)
+        if self.federation_registry is not None:
+            # federation sits OUTERMOST: local-only selectors fall
+            # straight through to the stack above; the door serves THIS
+            # cluster's share of remote coordinators' queries through
+            # the same inner stack (never the federated wrapper — a
+            # mutually-federated pair must not bounce subtrees)
+            from filodb_tpu.federation import FederationPlanner
+            inner = planner
+            planner = FederationPlanner(
+                inner, self.federation_registry, dataset=dc.name,
+                config=self.config.federation)
+            store_source = self._source()
+            shards = self.memstore.shards_for(dc.name)
+            self.federation_door.register(
+                dc.name, inner, store_source,
+                token_fn=lambda sh=shards: [
+                    (s.keys_serial, s.keys_epoch, s.index.mutations,
+                     s.append_horizon_ms()) for s in sh],
+                default=(dc.name == self.datasets[0].name))
         self.mappers[dc.name] = mapper
         self.spreads[dc.name] = spread
         self.engines[dc.name] = QueryEngine(dc.name, self._source(), mapper,
@@ -671,6 +721,8 @@ class FiloServer:
             self.ruler.start()
         if self.selfmon is not None:
             self.selfmon.start()
+        if self.federation_registry is not None:
+            self.federation_registry.start()
         # the readiness flip: phase -> serving lands in the event
         # journal, so "replayed, recovered, took traffic" is one
         # greppable sequence at /admin/events
@@ -680,6 +732,10 @@ class FiloServer:
     def shutdown(self) -> None:
         from filodb_tpu.utils.health import STOPPING
         self.health.set_phase(STOPPING)
+        if self.federation_registry is not None:
+            self.federation_registry.stop()
+        if self.federation_door is not None:
+            self.federation_door.stop()
         if self.selfmon is not None:
             self.selfmon.stop()
         if self.ruler is not None:

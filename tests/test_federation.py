@@ -1,0 +1,424 @@
+"""Cross-cluster federation (doc/federation.md): ownership routing,
+exactly-mergeable cluster partials, bit-identity vs a single-cluster
+ground truth, degradation naming the dead cluster, one trace / one kill
+across the boundary, and result-cache safety for federated answers.
+
+The shared fixture is `make_federated_pair` (parallel/testcluster.py):
+two FULL FiloServer clusters — east owns region="east", west owns
+region="west" — federated over their doors, plus a single-store truth
+engine holding every series."""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from filodb_tpu.config import ConfigError, FilodbSettings
+from filodb_tpu.federation.registry import ClusterDef, FederationRegistry
+from filodb_tpu.core.index import Equals
+from filodb_tpu.parallel.breaker import breakers
+from filodb_tpu.parallel.testcluster import make_federated_pair
+from filodb_tpu.query.planutils import TimeRange
+from filodb_tpu.query.rangevector import PlannerParams, QueryContext
+from filodb_tpu.utils.metrics import collector
+
+S = 1_600_000_020            # first sample (seconds); data spans 1200 s
+
+
+def _series_dict(res):
+    assert res.error is None, res.error
+    return {str(k): np.asarray(v) for k, _, v in res.series()}
+
+
+def _assert_identical(got_res, want_res):
+    got, want = _series_dict(got_res), _series_dict(want_res)
+    assert set(got) == set(want)
+    for k in want:
+        assert np.array_equal(got[k], want[k], equal_nan=True), k
+
+
+# ------------------------------------------------- registry unit tests
+
+
+def test_cluster_def_label_ownership_is_conservative():
+    cd = ClusterDef("west", host="h", port=1, match={"region": "west"})
+    # provably excluded: every group's equality rejects the matcher
+    assert not cd.may_own([[Equals("region", "east")]])
+    assert cd.may_own([[Equals("region", "west")]])
+    # unconstrained label / no region filter at all: stays in
+    assert cd.may_own([[Equals("job", "api")]])
+    # one group of several matching keeps the cluster in
+    assert cd.may_own([[Equals("region", "east")],
+                       [Equals("region", "west")]])
+    # an entry with no matchers and no window owns nothing (inert)
+    assert not ClusterDef("x", host="h", port=1).may_own(
+        [[Equals("region", "west")]])
+
+
+def test_cluster_def_time_overlap():
+    cd = ClusterDef("cold", host="h", port=1,
+                    time_start_ms=1000, time_end_ms=2000)
+    assert cd.windowed
+    eff = cd.time_overlap(TimeRange(0, 5000))
+    assert (eff.start_ms, eff.end_ms) == (1000, 2000)
+    assert cd.time_overlap(TimeRange(3000, 5000)) is None
+
+
+def test_registry_rejects_unknown_keys_and_missing_endpoint():
+    cfg = FilodbSettings().federation
+    cfg.clusters = {"w": {"host": "h", "port": 1, "matchers": {}}}
+    with pytest.raises(ConfigError, match="unknown keys"):
+        FederationRegistry(cfg)
+    cfg.clusters = {"w": {"match": {"region": "w"}}}    # no host/port
+    with pytest.raises(ConfigError, match="host and port"):
+        FederationRegistry(cfg)
+
+
+def test_registry_owners_for_local_exclusion():
+    cfg = FilodbSettings().federation
+    cfg.clusters = {
+        "west": {"host": "h", "port": 1, "match": {"region": "west"}},
+        "east": {"local": True, "match": {"region": "east"}},
+    }
+    reg = FederationRegistry(cfg, local_name="east")
+    tr = TimeRange(0, 1000)
+    local, remotes = reg.owners_for([[Equals("region", "west")]], tr)
+    assert not local and [cd.name for cd, _ in remotes] == ["west"]
+    local, remotes = reg.owners_for([[Equals("region", "east")]], tr)
+    assert local and remotes == []
+    local, remotes = reg.owners_for([[Equals("job", "api")]], tr)
+    assert local and [cd.name for cd, _ in remotes] == ["west"]
+
+
+def test_overlapping_time_windows_raise():
+    from filodb_tpu.federation.planner import FederationPlanner
+    from filodb_tpu.promql.parser import (TimeStepParams,
+                                          query_range_to_logical_plan)
+    cfg = FilodbSettings().federation
+    cfg.clusters = {
+        "a": {"host": "h", "port": 1, "time_end_ms": 2_000_000_000_000},
+        "b": {"host": "h", "port": 2,
+              "time_start_ms": 1_500_000_000_000},
+    }
+    planner = FederationPlanner(None, FederationRegistry(cfg))
+    plan = query_range_to_logical_plan(
+        "sum(foo)", TimeStepParams(S + 60, 60, S + 600))
+    with pytest.raises(ValueError, match="overlap"):
+        planner.materialize(plan, QueryContext())
+
+
+def test_federated_leaf_serialization_roundtrip():
+    from filodb_tpu.federation.exec import FederatedLeafExec
+    from filodb_tpu.parallel import serialize
+    from filodb_tpu.promql.parser import (TimeStepParams,
+                                          query_range_to_logical_plan)
+    from filodb_tpu.query import planutils as pu
+    plan = query_range_to_logical_plan(
+        "sum by (_ns_) (fed_gauge)", TimeStepParams(S + 60, 60, S + 600))
+    leaf = FederatedLeafExec(
+        QueryContext(), dataset="prometheus", plan=plan, mode="partial",
+        cluster="west", promql="sum by (_ns_) (fed_gauge)",
+        traceparent="00-" + "ab" * 16 + "-" + "cd" * 8 + "-01")
+    back = serialize.loads(serialize.dumps(leaf))
+    assert (back.dataset, back.mode, back.cluster) == \
+        ("prometheus", "partial", "west")
+    assert back.traceparent == leaf.traceparent
+    # the logical subtree survived byte-for-byte (grid included)
+    assert pu.unparse(back.plan) == pu.unparse(plan)
+    assert back.plan.start_ms == plan.start_ms
+    assert back.plan.step_ms == plan.step_ms
+
+
+# ------------------------------------- bit-identity vs the truth engine
+
+
+@pytest.fixture(scope="module")
+def pair():
+    p = make_federated_pair(start=False)
+    yield p
+    p.stop()
+    breakers.reset()
+
+
+def test_pushed_aggregate_bit_identical(pair):
+    q, args = "sum by (_ns_) (fed_gauge)", (S + 60, 60, S + 600)
+    res = pair.engine.query_range(q, *args)
+    _assert_identical(res, pair.truth.query_range(q, *args))
+    # the west hop crossed as ONE [G, W] cluster partial
+    assert res.stats.pushdown_pushed >= 1
+    assert res.stats.wire_bytes > 0
+
+
+def test_avg_pushes_exact_partials(pair):
+    q, args = "avg by (_ns_) (fed_gauge)", (S + 60, 60, S + 600)
+    res = pair.engine.query_range(q, *args)
+    _assert_identical(res, pair.truth.query_range(q, *args))
+    assert res.stats.pushdown_pushed >= 1
+
+
+def test_routed_selector_whole_expression(pair):
+    """{region="west"} provably excludes east: the whole expression
+    routes to west and east's local stack never runs."""
+    q = 'fed_gauge{region="west"}'
+    args = (S + 60, 60, S + 600)
+    res = pair.engine.query_range(q, *args)
+    _assert_identical(res, pair.truth.query_range(q, *args))
+    assert res.stats.pushdown_fallback >= 1        # series-mode hop
+    assert len(_series_dict(res)) == 8             # all of west's series
+
+
+def test_non_mergeable_shape_ships_series(pair):
+    """A per-series expression has no mergeable partial: each cluster
+    evaluates its own series and the union is exact."""
+    q = "avg_over_time(fed_gauge[2m])"
+    args = (S + 180, 60, S + 600)
+    res = pair.engine.query_range(q, *args)
+    _assert_identical(res, pair.truth.query_range(q, *args))
+    assert res.stats.pushdown_fallback >= 1
+    assert res.stats.pushdown_pushed == 0
+
+
+def test_cross_cluster_join_bit_identical(pair):
+    q = ('sum by (_ns_) (fed_gauge{region="west"}) '
+         '+ sum by (_ns_) (fed_gauge{region="east"})')
+    args = (S + 60, 60, S + 600)
+    res = pair.engine.query_range(q, *args)
+    _assert_identical(res, pair.truth.query_range(q, *args))
+    assert res.stats.pushdown_pushed >= 1
+
+
+def test_unsupported_shape_is_a_typed_error(pair):
+    """A non-per-series, non-top-level-aggregate expression spanning
+    clusters is a planning error naming the workaround, never silently
+    wrong data."""
+    res = pair.engine.query_range(
+        "topk(2, sum by (_ns_) (fed_gauge)) / 2", S + 60, 60, S + 600)
+    assert res.error is not None
+    assert "federate" in res.error
+
+
+def test_at_pinned_expressions_refuse_federation(pair):
+    res = pair.engine.query_range(
+        f"fed_gauge @ {S + 300}", S + 60, 60, S + 600)
+    assert res.error is not None and "@-pinned" in res.error
+
+
+# ---------------------------------------- one trace, one killable query
+
+
+def test_one_trace_stitches_across_clusters(pair):
+    res = pair.engine.query_range("sum by (_ns_) (fed_gauge)",
+                                  S + 60, 60, S + 600)
+    assert res.error is None and res.trace_id
+    evs = collector.trace(res.trace_id)
+    remotes = [e for e in evs if e["span"].startswith("remote_exec")]
+    # west's spans came back over the wire under the SAME trace id
+    assert remotes, [e["span"] for e in evs]
+
+
+def test_one_query_id_spans_both_clusters(pair):
+    """The federated child registers on west under the COORDINATOR's
+    query id: /admin/queries shows one id, and one kill reaches the
+    remote scan."""
+    from filodb_tpu.query.activequeries import active_queries
+    qids = []
+    orig = active_queries.register
+    lock = threading.Lock()
+
+    def spy(qid, **kw):
+        if kw.get("role") == "remote":
+            with lock:
+                qids.append(qid)
+        return orig(qid, **kw)
+
+    active_queries.register = spy
+    try:
+        res = pair.engine.query_range("sum by (_ns_) (fed_gauge)",
+                                      S + 60, 60, S + 600)
+    finally:
+        active_queries.register = orig
+    assert res.error is None
+    assert qids and all(q == qids[0] for q in qids)
+
+
+def test_kill_frame_crosses_the_door(pair):
+    from filodb_tpu.parallel.transport import send_kill
+    from filodb_tpu.query.activequeries import active_queries
+    ent = active_queries.register("fed-kill-1", promql="[remote] leaf",
+                                  origin="remote", role="remote")
+    try:
+        out = send_kill("127.0.0.1", pair.west.federation_door.port,
+                        "fed-kill-1")
+        assert out["killed"] is True and ent.token.cancelled
+    finally:
+        active_queries.deregister(ent, "killed")
+
+
+# ----------------------------------------------- admin + health surface
+
+
+def test_admin_federation_route(pair):
+    pair.east.federation_registry.probe_once()
+    st, payload = pair.east.api.handle("GET", "/admin/federation", {}, b"")
+    assert st == 200
+    rows = payload["data"]["clusters"]
+    assert payload["data"]["cluster"] == "east"
+    assert [r["cluster"] for r in rows] == ["west"]
+    assert rows[0]["healthy"] and rows[0]["probed"]
+    assert rows[0]["remoteCluster"] == "west"
+    # after dispatches the breaker table carries the cluster row
+    pair.engine.query_range("sum by (_ns_) (fed_gauge)",
+                            S + 60, 60, S + 600)
+    st, payload = pair.east.api.handle("GET", "/admin/breakers", {}, b"")
+    assert st == 200
+    assert any(r["peer"] == "cluster:west"
+               for r in payload["data"]["breakers"])
+
+
+def test_health_probe_degrades_on_dead_cluster(pair):
+    reg = pair.east.federation_registry
+    reg.probe_once()
+    assert reg.health_probe()["status"] == "ok"
+    pair.kill_west()
+    try:
+        reg.probe_once()
+        verdict = reg.health_probe()
+        assert verdict["status"] == "degraded"
+        assert "west" in verdict["reason"]
+    finally:
+        pair.revive_west()
+        reg.probe_once()
+        breakers.reset()
+    assert reg.health_probe()["status"] == "ok"
+
+
+# -------------------------- degradation: flagged partial, breaker, recovery
+
+
+def test_dead_cluster_degrades_breaker_opens_then_recovers():
+    breakers.configure(failure_threshold=3, open_base_s=0.2,
+                       open_max_s=0.5, jitter=0.0)
+    breakers.reset()
+    p = make_federated_pair(start=False)
+    try:
+        q, args = "sum by (_ns_) (fed_gauge)", (S + 60, 60, S + 600)
+        pp = PlannerParams(allow_partial_results=True, timeout_s=10.0)
+        truth = p.truth.query_range(q, *args)
+        full = p.engine.query_range(q, *args, planner_params=pp)
+        _assert_identical(full, truth)
+        assert not full.partial
+        p.kill_west()
+        # never a hang, never silent short data: a flagged partial that
+        # NAMES the dead cluster
+        res = p.engine.query_range(q, *args, planner_params=pp)
+        assert res.error is None and res.partial
+        assert any("cluster:west" in w for w in res.stats.warnings), \
+            res.stats.warnings
+        # consecutive failures open the cluster breaker -> fail fast
+        for _ in range(3):
+            p.engine.query_range(q, *args, planner_params=pp)
+        snap = {b["peer"]: b for b in breakers.snapshot()}
+        assert snap["cluster:west"]["state"] == "open"
+        t0 = time.monotonic()
+        res = p.engine.query_range(q, *args, planner_params=pp)
+        fast_s = time.monotonic() - t0
+        assert res.partial and fast_s < 1.0, fast_s
+        assert any("circuit open" in w for w in res.stats.warnings), \
+            res.stats.warnings
+        # half-open probe recovery: the door answers again -> full results
+        p.revive_west()
+        deadline = time.monotonic() + 20.0
+        while time.monotonic() < deadline:
+            res = p.engine.query_range(q, *args, planner_params=pp)
+            if res.error is None and not res.partial:
+                break
+            time.sleep(0.2)
+        assert res.error is None and not res.partial, \
+            (res.error, res.stats.warnings)
+        _assert_identical(res, truth)
+    finally:
+        p.stop()
+        breakers.configure()
+        breakers.reset()
+
+
+# ----------------------------------------------------- result-cache safety
+
+
+def test_federated_cache_hits_tokens_and_degraded_answers():
+    """Federated answers cache on the cluster set + per-cluster data
+    tokens: a remote's token change invalidates, and a degraded partial
+    is NEVER served from cache."""
+    breakers.configure(failure_threshold=3, open_base_s=0.2,
+                       open_max_s=0.5, jitter=0.0)
+    breakers.reset()
+    p = make_federated_pair(start=False)
+    try:
+        fe = p.frontend
+        reg = p.east.federation_registry
+        reg.probe_once()                 # tokens populated before caching
+        q, args = "sum by (_ns_) (fed_gauge)", (S + 60, 60, S + 600)
+        pp = PlannerParams(allow_partial_results=True, timeout_s=10.0)
+        r1 = fe.query_range(q, *args, planner_params=pp)
+        assert r1.error is None and not r1.partial
+        r2 = fe.query_range(q, *args, planner_params=pp)
+        assert r2.stats.result_cache == "hit"
+        _assert_identical(r2, r1)
+        # a probe transition (west dies) changes the federation token:
+        # the cached full answer can no longer be served
+        p.kill_west()
+        reg.probe_once()
+        r3 = fe.query_range(q, *args, planner_params=pp)
+        assert r3.partial and r3.stats.result_cache != "hit"
+        # and the partial itself is never stored: the re-poll recomputes
+        r4 = fe.query_range(q, *args, planner_params=pp)
+        assert r4.stats.result_cache != "hit"
+        assert r4.partial
+        # recovery: full answers cache again under the new token
+        p.revive_west()
+        reg.probe_once()
+        deadline = time.monotonic() + 20.0
+        while time.monotonic() < deadline:
+            r5 = fe.query_range(q, *args, planner_params=pp)
+            if r5.error is None and not r5.partial:
+                break
+            time.sleep(0.2)
+        assert not r5.partial, (r5.error, r5.stats.warnings)
+        r6 = fe.query_range(q, *args, planner_params=pp)
+        assert r6.stats.result_cache == "hit"
+        _assert_identical(r6, r1)
+    finally:
+        p.stop()
+        breakers.configure()
+        breakers.reset()
+
+
+def test_remote_ingest_invalidates_federated_cache():
+    """West gaining NEW series changes its door's data token (rides the
+    FPING reply): east's cached federated entries drop, exactly like
+    local series-set changes invalidate.  (Appends strictly after the
+    cached window stay a legitimate hit — the append-horizon contract —
+    so the invalidation trigger here is a series-set change.)"""
+    from filodb_tpu.ingest.generator import region_gauge_batch
+    from filodb_tpu.gateway.router import split_batch_by_shard
+    p = make_federated_pair(start=False)
+    try:
+        fe = p.frontend
+        reg = p.east.federation_registry
+        reg.probe_once()
+        q, args = ('sum by (_ns_) (fed_gauge{region="west"})',
+                   (S + 60, 60, S + 600))
+        fe.query_range(q, *args)
+        assert fe.query_range(q, *args).stats.result_cache == "hit"
+        # new SERIES land on WEST only (12 > the 8 existing instances)
+        batch = region_gauge_batch(12, 10, region="west", seed=9,
+                                   start_ms=(S + 2000) * 1000)
+        for s, sub in split_batch_by_shard(
+                batch, p.west.mappers[p.dataset],
+                p.west.spreads[p.dataset]).items():
+            p.west.memstore.get_shard(p.dataset, s).ingest(sub)
+        reg.probe_once()                 # token refresh
+        assert fe.query_range(q, *args).stats.result_cache != "hit"
+    finally:
+        p.stop()
+        breakers.reset()

@@ -130,6 +130,27 @@ def boot_and_drive():
                                  "prometheus", 2)
     finally:
         srv.shutdown()
+    # federation drive (ISSUE 20): one two-cluster pair, one pushed
+    # federated aggregate, one probe round, and one query against a
+    # dead cluster door — the federation_* families (dispatches,
+    # wire_bytes, cluster_up, errors) must be live and documented
+    from filodb_tpu.parallel.testcluster import make_federated_pair
+    from filodb_tpu.query.rangevector import PlannerParams
+    pair = make_federated_pair(num_series=4, num_samples=30, start=False)
+    try:
+        s0 = 1_600_000_020
+        res = pair.engine.query_range("sum by (_ns_) (fed_gauge)",
+                                      s0 + 60, 60, s0 + 240)
+        assert res.error is None, f"federation drive: {res.error}"
+        pair.east.federation_registry.probe_once()
+        pair.kill_west()
+        pair.engine.query_range(
+            "sum by (_ns_) (fed_gauge)", s0 + 120, 60, s0 + 240,
+            planner_params=PlannerParams(allow_partial_results=True,
+                                         timeout_s=10.0))
+        pair.east.federation_registry.probe_once()
+    finally:
+        pair.stop()
     from filodb_tpu.utils.metrics import registry
     return registry
 

@@ -218,6 +218,19 @@ CARRY = [
     "objectstore_deadstore_partial_flagged",
     "objectstore_deadstore_strict_error", "objectstore_deadstore_seconds",
     "objectstore_gate_ok", "objectstore_error",
+    # cross-cluster federation (ISSUE 20): the two-cluster testbench's
+    # bit-identity proof (federated sum-by AND a cross-cluster join vs
+    # the single-store ground truth), the dead-cluster degrade drill
+    # (flagged partial NAMING the cluster, zero hangs / zero wrong-full
+    # results, breaker fail-fast then half-open recovery), and the
+    # partial-pushdown wire ratio vs the ship-everything strawman —
+    # plus a loud federation_error when the stage fails
+    "federation_identical", "federation_join_identical",
+    "federation_partial_on_dead_cluster", "federation_dead_names_cluster",
+    "federation_dead_seconds", "federation_recovered_full",
+    "federation_wire_ratio_x", "federation_pushed_wire_bytes",
+    "federation_shipped_wire_bytes", "federation_gate_ok",
+    "federation_error",
 ]
 RENAME = {"value": "headline_samples_per_sec",
           "p50_query_latency_s": "p50_s"}
